@@ -1,0 +1,19 @@
+"""emqx_tpu — a TPU-native messaging framework with EMQX's capability surface.
+
+Architecture (see SURVEY.md):
+
+* ``emqx_tpu.topic``      — MQTT topic algebra + the wildcard-match oracle.
+* ``emqx_tpu.broker``     — host control plane: trie/router (source of truth),
+  sessions, QoS flows, shared subs, retainer, hooks, auth.
+* ``emqx_tpu.ops``        — device data plane: trie → flattened NFA compiler,
+  batched match kernels (jit/Pallas).
+* ``emqx_tpu.models``     — assembled "flagship" pipelines (matcher model,
+  end-to-end publish pipeline) used by bench/graft entry points.
+* ``emqx_tpu.parallel``   — mesh, shardings, multi-chip match (DP/TP/EP/ring).
+* ``emqx_tpu.rule_engine``— SQL-ish streaming rules co-batched on device.
+* ``emqx_tpu.exhook``     — gRPC HookProvider-compatible sidecar boundary.
+* ``emqx_tpu.mgmt``       — management API, metrics, $SYS.
+* ``emqx_tpu.config``     — typed layered config.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,213 @@
+"""MQTT topic algebra: split/validate/join and the wildcard-match oracle.
+
+Behavioral reference: ``apps/emqx/src/emqx_topic.erl`` [U] (reference mount
+was empty this round — see SURVEY.md provenance header; semantics follow the
+MQTT v3.1.1 / v5.0 specifications and upstream module behavior:
+``words/1``, ``match/2``, ``validate/1``, ``wildcard/1``, share parsing).
+
+This module is the **semantics oracle**: every device kernel (the flattened
+NFA matcher in ``emqx_tpu.ops``) is property-tested against :func:`match`.
+It is deliberately pure Python with no JAX imports.
+
+Key semantics implemented (MQTT spec + emqx behavior):
+
+* Topic levels are separated by ``/``; empty levels are allowed and
+  significant (``"a//b"`` has three levels ``['a', '', 'b']``).
+* ``+`` matches exactly one level; it must occupy a whole level.
+* ``#`` matches zero or more levels; it must be the last level and occupy a
+  whole level.  ``"sport/#"`` matches ``"sport"``.
+* Topics whose **first** level begins with ``$`` (e.g. ``$SYS/...``) are not
+  matched by filters starting with ``+`` or ``#`` (deeper levels are not
+  protected: ``$SYS/#`` matches ``$SYS/broker``).
+* ``$share/<group>/<real-filter>`` denotes a shared subscription; matching
+  operates on the real filter.  ``$queue/<topic>`` is the legacy alias for
+  ``$share/$queue/<topic>``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+MAX_TOPIC_LEN = 65535  # bytes, per MQTT spec (emqx ?MAX_TOPIC_LEN)
+
+SHARE_PREFIX = "$share"
+QUEUE_PREFIX = "$queue"
+
+__all__ = [
+    "TopicError",
+    "words",
+    "join",
+    "levels",
+    "wildcard",
+    "validate",
+    "is_valid",
+    "match",
+    "match_share",
+    "is_sys",
+    "is_shared",
+    "parse_share",
+    "strip_share",
+    "make_share",
+    "feed_var",
+]
+
+
+class TopicError(ValueError):
+    """Raised for malformed topics / filters."""
+
+
+def words(topic: str) -> List[str]:
+    """Split a topic into its levels.  ``"a//b"`` → ``['a', '', 'b']``."""
+    return topic.split("/")
+
+
+def join(ws: Sequence[str]) -> str:
+    """Inverse of :func:`words`."""
+    return "/".join(ws)
+
+
+def levels(topic: str) -> int:
+    return len(words(topic))
+
+
+def wildcard(topic_or_words) -> bool:
+    """True if the filter contains ``+`` or ``#`` at any level."""
+    ws = words(topic_or_words) if isinstance(topic_or_words, str) else topic_or_words
+    return any(w in ("+", "#") for w in ws)
+
+
+def is_sys(topic: str) -> bool:
+    """True for ``$``-prefixed topics (``$SYS/...``, ``$queue/...``, ...)."""
+    return topic.startswith("$")
+
+
+# ---------------------------------------------------------------------------
+# Validation
+# ---------------------------------------------------------------------------
+
+def validate(topic: str, kind: str = "filter") -> None:
+    """Validate a topic name (``kind='name'``) or filter (``kind='filter'``).
+
+    Raises :class:`TopicError` on violation.  Mirrors emqx_topic:validate/2:
+    non-empty, ≤65535 bytes, no NUL; names admit no wildcards; filters admit
+    ``+``/``#`` only as whole levels with ``#`` last; ``$share`` filters
+    need a non-empty wildcard-free group and a valid non-empty real filter.
+    """
+    if kind not in ("name", "filter"):
+        raise ValueError(f"bad kind: {kind!r}")
+    if topic == "":
+        raise TopicError("empty topic")
+    if len(topic.encode("utf-8")) > MAX_TOPIC_LEN:
+        raise TopicError("topic too long")
+    if "\x00" in topic:
+        raise TopicError("NUL character in topic")
+
+    if kind == "filter":
+        share = parse_share(topic)
+        if share is not None:
+            group, real = share
+            if group == "" or "+" in group or "#" in group:
+                raise TopicError(f"invalid $share group: {group!r}")
+            if real == "":
+                raise TopicError("empty $share real filter")
+            return validate(real, "filter")
+
+    ws = words(topic)
+    for i, w in enumerate(ws):
+        if kind == "name":
+            if "+" in w or "#" in w:
+                raise TopicError(f"wildcard in topic name: {topic!r}")
+        else:
+            if w == "#":
+                if i != len(ws) - 1:
+                    raise TopicError(f"'#' not at last level: {topic!r}")
+            elif "#" in w:
+                raise TopicError(f"'#' must occupy a whole level: {topic!r}")
+            elif w != "+" and "+" in w:
+                raise TopicError(f"'+' must occupy a whole level: {topic!r}")
+
+
+def is_valid(topic: str, kind: str = "filter") -> bool:
+    try:
+        validate(topic, kind)
+        return True
+    except TopicError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Share-subscription parsing
+# ---------------------------------------------------------------------------
+
+def parse_share(flt: str) -> Optional[Tuple[str, str]]:
+    """``"$share/g/a/b"`` → ``("g", "a/b")``; ``"$queue/t"`` → ``("$queue", "t")``;
+    anything else → None."""
+    if flt.startswith(SHARE_PREFIX + "/"):
+        rest = flt[len(SHARE_PREFIX) + 1 :]
+        group, sep, real = rest.partition("/")
+        if not sep:
+            return (group, "")
+        return (group, real)
+    if flt.startswith(QUEUE_PREFIX + "/"):
+        return (QUEUE_PREFIX, flt[len(QUEUE_PREFIX) + 1 :])
+    return None
+
+
+def is_shared(flt: str) -> bool:
+    return parse_share(flt) is not None
+
+
+def strip_share(flt: str) -> str:
+    """Return the real filter, share prefix removed (identity otherwise)."""
+    share = parse_share(flt)
+    return share[1] if share is not None else flt
+
+
+def make_share(group: str, real: str) -> str:
+    return f"{SHARE_PREFIX}/{group}/{real}"
+
+
+# ---------------------------------------------------------------------------
+# The match oracle
+# ---------------------------------------------------------------------------
+
+def match(name, flt) -> bool:
+    """Does concrete topic ``name`` match topic filter ``flt``?
+
+    Both arguments may be strings or pre-split word lists.  ``name`` must be
+    wildcard-free (a published topic); ``flt`` may contain ``+``/``#``.
+    Share prefixes are **not** stripped here — see :func:`match_share`.
+    """
+    nw = words(name) if isinstance(name, str) else list(name)
+    fw = words(flt) if isinstance(flt, str) else list(flt)
+    if not nw or not fw:
+        return False
+    # $-topics are not matched by filters starting with a wildcard.
+    if nw[0].startswith("$") and fw[0] in ("+", "#"):
+        return False
+    i = 0
+    for fword in fw:
+        if fword == "#":
+            return True  # zero or more remaining levels
+        if i >= len(nw):
+            return False
+        if fword == "+" or fword == nw[i]:
+            i += 1
+            continue
+        return False
+    return i == len(nw)
+
+
+def match_share(name, flt) -> bool:
+    """Like :func:`match` but strips a ``$share``/``$queue`` prefix first."""
+    f = flt if isinstance(flt, str) else join(flt)
+    return match(name, strip_share(f))
+
+
+# ---------------------------------------------------------------------------
+# Variable substitution (emqx_topic:feed_var/3)
+# ---------------------------------------------------------------------------
+
+def feed_var(var: str, value: str, topic: str) -> str:
+    """Substitute a placeholder level (e.g. ``%c``, ``%u``) with ``value``."""
+    return join([value if w == var else w for w in words(topic)])

@@ -1,0 +1,20 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's multi-node-without-a-cluster CT pattern
+(SURVEY.md §4): correctness/sharding tests run on
+``--xla_force_host_platform_device_count=8`` CPU devices; real-TPU perf is
+exercised only by ``bench.py``.
+
+Must run before any test module imports jax, hence env mutation at
+conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,214 @@
+"""Oracle tests for emqx_tpu.topic — mirrors emqx_topic_SUITE / prop_emqx
+style coverage (SURVEY.md §4): explicit spec cases + property tests."""
+
+import string
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from emqx_tpu import topic as T
+
+
+# ---------------------------------------------------------------------------
+# words / join / levels
+# ---------------------------------------------------------------------------
+
+def test_words_basic():
+    assert T.words("a/b/c") == ["a", "b", "c"]
+    assert T.words("/a") == ["", "a"]
+    assert T.words("a//b") == ["a", "", "b"]
+    assert T.words("a/b/") == ["a", "b", ""]
+    assert T.join(["a", "", "b"]) == "a//b"
+    assert T.levels("a/b/c") == 3
+
+
+@given(st.lists(st.text(alphabet=string.ascii_letters + string.digits, max_size=5), min_size=1, max_size=8))
+def test_words_join_roundtrip(ws):
+    assert T.words(T.join(ws)) == ws
+
+
+# ---------------------------------------------------------------------------
+# validate
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("flt", [
+    "a/b/c", "+", "#", "a/+/b", "a/b/#", "+/+/+", "/", "//", "a//+",
+    "$SYS/#", "$share/g/a/+", "$share/grp/#", "$queue/t", "a/ /b",
+])
+def test_valid_filters(flt):
+    T.validate(flt, "filter")
+
+
+@pytest.mark.parametrize("flt", [
+    "", "a/#/b", "#/a", "a+", "a/b+", "a/#b", "a/b#", "+a/b",
+    "$share//t", "$share/g+/t", "$share/g", "$share/g/",
+])
+def test_invalid_filters(flt):
+    assert not T.is_valid(flt, "filter")
+
+
+@pytest.mark.parametrize("name", ["a/b", "/", "$SYS/broker", "a b/c"])
+def test_valid_names(name):
+    T.validate(name, "name")
+
+
+@pytest.mark.parametrize("name", ["", "a/+", "a/#", "#", "+"])
+def test_invalid_names(name):
+    assert not T.is_valid(name, "name")
+
+
+def test_validate_too_long():
+    assert not T.is_valid("x" * 65536, "name")
+    assert T.is_valid("x" * 65535, "name")
+
+
+def test_validate_nul():
+    assert not T.is_valid("a\x00b", "name")
+
+
+# ---------------------------------------------------------------------------
+# match — explicit spec cases (MQTT v5 §4.7, emqx_topic_SUITE style)
+# ---------------------------------------------------------------------------
+
+MATCH_CASES = [
+    # (name, filter, expected)
+    ("a/b/c", "a/b/c", True),
+    ("a/b/c", "a/b/d", False),
+    ("a/b/c", "+/b/c", True),
+    ("a/b/c", "a/+/c", True),
+    ("a/b/c", "a/b/+", True),
+    ("a/b/c", "+/+/+", True),
+    ("a/b/c", "+/+", False),
+    ("a/b/c", "+/+/+/+", False),
+    ("a/b/c", "#", True),
+    ("a/b/c", "a/#", True),
+    ("a/b/c", "a/b/#", True),
+    ("a/b/c", "a/b/c/#", True),   # '#' matches zero levels
+    ("a/b", "a/b/#", True),
+    ("a", "a/#", True),
+    ("a", "a/+", False),
+    ("a/b/c", "a/c/#", False),
+    ("a/b/c/d", "a/#", True),
+    ("ab", "a+", False),           # '+' is not a glob within a level
+    ("a/b", "a/b/", False),        # trailing empty level is significant
+    ("a/b/", "a/b/+", True),       # '+' matches an empty level
+    ("/b", "+/b", True),
+    ("/", "+/+", True),
+    ("/", "#", True),
+    ("/finance", "+/+", True),
+    ("/finance", "/+", True),
+    ("/finance", "+", False),
+    ("sport/tennis/player1", "sport/tennis/player1/#", True),
+    ("sport/tennis/player1/ranking", "sport/tennis/player1/#", True),
+    ("sport", "sport/#", True),
+    ("sport", "sport/+", False),
+    # $-topic protection (first level only)
+    ("$SYS/broker", "#", False),
+    ("$SYS/broker", "+/broker", False),
+    ("$SYS/broker", "$SYS/#", True),
+    ("$SYS/broker", "$SYS/+", True),
+    ("$SYS/a/b", "$SYS/+/b", True),
+    ("$SYS", "#", False),
+    ("$whatever/x", "#", False),
+    ("a/$SYS/b", "a/+/b", True),   # inner $ levels are not protected
+    ("a/$SYS/b", "a/#", True),
+]
+
+
+@pytest.mark.parametrize("name,flt,expected", MATCH_CASES)
+def test_match_cases(name, flt, expected):
+    assert T.match(name, flt) is expected
+
+
+def test_match_word_lists():
+    assert T.match(["a", "b"], ["a", "+"]) is True
+
+
+def test_match_share():
+    assert T.match_share("a/b", "$share/g/a/+") is True
+    assert T.match_share("a/b", "$queue/a/b") is True
+    assert T.match("a/b", "$share/g/a/+") is False  # no auto-strip in match
+
+
+# ---------------------------------------------------------------------------
+# share parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_share():
+    assert T.parse_share("$share/g/a/b") == ("g", "a/b")
+    assert T.parse_share("$queue/t") == ("$queue", "t")
+    assert T.parse_share("a/b") is None
+    assert T.parse_share("$shared/g/t") is None
+    assert T.strip_share("$share/g/t") == "t"
+    assert T.strip_share("t") == "t"
+    assert T.make_share("g", "a/b") == "$share/g/a/b"
+    assert T.is_shared("$share/g/t") and not T.is_shared("t")
+
+
+# ---------------------------------------------------------------------------
+# property tests (prop_emqx_topic style)
+# ---------------------------------------------------------------------------
+
+word_st = st.text(alphabet=string.ascii_lowercase + string.digits, min_size=0, max_size=4)
+# First level occasionally '$'-prefixed so the $-protection rule is fuzz-covered.
+first_word_st = st.one_of(word_st, word_st.map(lambda w: "$" + w))
+name_words_st = st.builds(
+    lambda head, tail: [head] + tail,
+    first_word_st,
+    st.lists(word_st, min_size=0, max_size=7),
+)
+
+
+@st.composite
+def filter_words_st(draw):
+    ws = draw(st.lists(st.one_of(word_st, st.just("+")), min_size=1, max_size=8))
+    if draw(st.booleans()):
+        ws = ws + ["#"]
+    return ws
+
+
+@settings(max_examples=300, deadline=None)
+@given(name_words_st)
+def test_exact_match_reflexive(ws):
+    name = T.join(ws)
+    assert T.match(name, name)
+
+
+@settings(max_examples=300, deadline=None)
+@given(name_words_st, filter_words_st())
+def test_match_agrees_with_bruteforce(nw, fw):
+    """Compare against an independent brute-force recursive matcher."""
+
+    def brute(n, f):
+        if not f:
+            return not n
+        if f[0] == "#":
+            return True
+        if not n:
+            return False
+        if f[0] == "+" or f[0] == n[0]:
+            return brute(n[1:], f[1:])
+        return False
+
+    expected = brute(nw, fw)
+    if nw[0].startswith("$") and fw[0] in ("+", "#"):
+        expected = False
+    assert T.match(nw, fw) is expected
+
+
+@settings(max_examples=200, deadline=None)
+@given(name_words_st)
+def test_plus_matches_any_single_level(ws):
+    flt = ["+"] * len(ws)
+    expected = not ws[0].startswith("$")
+    assert T.match(ws, flt) is expected
+
+
+@settings(max_examples=200, deadline=None)
+@given(filter_words_st())
+def test_valid_filters_validate(fw):
+    flt = T.join(fw)
+    if flt == "":  # the singleton empty level joins to the invalid empty topic
+        assert not T.is_valid(flt, "filter")
+    else:
+        T.validate(flt, "filter")
