@@ -1,0 +1,11 @@
+"""Host control plane: the authoritative broker state and MQTT semantics.
+
+Subscription tables here are the source of truth; the device NFA in
+``emqx_tpu.ops`` is an eventually-consistent mirror (SURVEY.md §2.2 mria
+notes, §5.4).
+"""
+
+from .trie import FilterTrie, TopicTrie
+from .router import Route, RouteDelta, Router
+
+__all__ = ["FilterTrie", "TopicTrie", "Route", "RouteDelta", "Router"]

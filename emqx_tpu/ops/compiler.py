@@ -1,0 +1,283 @@
+"""Trie → flattened-NFA compiler: the device mirror of the route table.
+
+Behavioral reference: the subscription index semantics of
+``apps/emqx/src/emqx_trie.erl`` / ``emqx_topic.erl`` [U] (SURVEY.md §2.1);
+the mirror/refresh pattern follows mria's bootstrap-then-replay design
+(SURVEY.md §2.2, §5.4).
+
+The wildcard filter set is compiled to static int32 arrays that a
+``lax.scan`` NFA walk consumes (``emqx_tpu.ops.match_kernel``):
+
+* **states** — trie nodes of the wildcard filter trie, BFS-numbered with
+  root = 0.  ``#``-children are *not* states (``#`` is always terminal):
+  they collapse into a per-state ``hash_accept`` id.
+* ``plus_child[s]`` — state id of the ``+`` edge from ``s``, or -1.
+* ``accept[s]``    — accept id if ≥1 filter terminates at ``s``, else -1.
+* ``hash_accept[s]`` — accept id of the ``#``-child of ``s``, else -1.
+* literal edges — open-addressing hash table keyed by (state, word_id)
+  with linear probing; build guarantees probe chains ≤ ``MAX_PROBES`` by
+  growing the table, so the device probe loop is statically bounded.
+* **vocab** — host dict interning literal edge words to int32 ids.
+  Id 0 is reserved UNKNOWN: publish-topic words never seen in any filter
+  map to 0, which has no literal edges by construction (they can still
+  match ``+``/``#``).
+
+Shapes are padded to buckets (powers of two) so that table growth rarely
+changes compiled shapes (XLA recompiles are the p99 killer — SURVEY.md §7
+hard parts).
+
+Accept ids are dense in ``[0, n_accepts)``; ``accept_filters[aid]`` maps
+back to the filter string, and the broker layer maps filters to subscriber
+sets / bitmap rows.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import topic as T
+
+__all__ = ["NfaTable", "compile_filters", "encode_topics", "MAX_PROBES"]
+
+MAX_PROBES = 8  # static device-side probe bound; build grows H to enforce
+
+# multiplicative hash constants (Knuth / murmur-style odd constants)
+_HC1 = np.uint32(2654435761)
+_HC2 = np.uint32(2246822519)
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Next power of two ≥ max(n, minimum)."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _slot(state: np.ndarray, word: np.ndarray, mask: int):
+    """Initial probe slot for (state, word) — uint32 math, identical on
+    host (numpy) and device (jnp).  uint32 wraparound is the point."""
+    with np.errstate(over="ignore"):
+        h = state.astype(np.uint32) * _HC1 + word.astype(np.uint32) * _HC2
+        h ^= h >> np.uint32(15)
+        h *= np.uint32(2246822519)
+        h ^= h >> np.uint32(13)
+        return (h & np.uint32(mask)).astype(np.int32)
+
+
+@dataclass
+class NfaTable:
+    """Flattened NFA snapshot (host numpy; ship with ``.device_arrays()``)."""
+
+    plus_child: np.ndarray   # (S,) int32
+    hash_accept: np.ndarray  # (S,) int32
+    accept: np.ndarray       # (S,) int32
+    tab_state: np.ndarray    # (H,) int32, -1 = empty slot
+    tab_word: np.ndarray     # (H,) int32
+    tab_next: np.ndarray     # (H,) int32
+    n_states: int            # live states (≤ S)
+    depth: int               # max filter levels the table supports (D)
+    vocab: Dict[str, int]
+    accept_filters: List[str]
+    epoch: int = 0
+
+    @property
+    def S(self) -> int:
+        return int(self.plus_child.shape[0])
+
+    @property
+    def H(self) -> int:
+        return int(self.tab_state.shape[0])
+
+    @property
+    def n_accepts(self) -> int:
+        return len(self.accept_filters)
+
+    def device_arrays(self):
+        """The arrays the kernel consumes, in kernel argument order."""
+        return (
+            self.plus_child,
+            self.hash_accept,
+            self.accept,
+            self.tab_state,
+            self.tab_word,
+            self.tab_next,
+        )
+
+    def shape_key(self) -> Tuple[int, int, int]:
+        """Compile-relevant shape signature; same key ⇒ no XLA recompile."""
+        return (self.S, self.H, self.depth)
+
+    # -- host-side reference probe (used by tests / debugging) -----------
+    def lookup_literal(self, state: int, word_id: int) -> int:
+        mask = self.H - 1
+        s = _slot(np.int32(state), np.int32(word_id), mask)
+        for i in range(MAX_PROBES):
+            j = (int(s) + i) & mask
+            if self.tab_state[j] == -1:
+                return -1
+            if self.tab_state[j] == state and self.tab_word[j] == word_id:
+                return int(self.tab_next[j])
+        return -1
+
+
+class _Node:
+    __slots__ = ("sid", "lit", "plus", "hash_aid", "aid")
+
+    def __init__(self) -> None:
+        self.sid = -1
+        self.lit: Dict[str, "_Node"] = {}
+        self.plus: Optional["_Node"] = None
+        self.hash_aid = -1
+        self.aid = -1
+
+
+def compile_filters(
+    filters: Iterable[str],
+    depth: int = 16,
+    state_bucket: int = 1024,
+    epoch: int = 0,
+) -> NfaTable:
+    """Compile a wildcard filter set into an :class:`NfaTable`.
+
+    ``filters`` are real filters (``$share`` already stripped), deduplicated
+    here.  Filters deeper than ``depth`` levels are rejected — the broker
+    keeps them on the host slow path (see config ``tpu.max_levels``).
+    """
+    uniq = sorted(set(filters))
+    root = _Node()
+    accept_filters: List[str] = []
+
+    # -- build the trie with '#' collapsed into hash_accept ---------------
+    for flt in uniq:
+        ws = T.words(flt)
+        if len(ws) > depth:
+            raise ValueError(
+                f"filter {flt!r} has {len(ws)} levels > table depth {depth}"
+            )
+        node = root
+        for i, w in enumerate(ws):
+            if w == "#":
+                assert i == len(ws) - 1, "validated upstream"
+                if node.hash_aid < 0:
+                    node.hash_aid = len(accept_filters)
+                    accept_filters.append(flt)
+                break
+            if w == "+":
+                if node.plus is None:
+                    node.plus = _Node()
+                node = node.plus
+            else:
+                nxt = node.lit.get(w)
+                if nxt is None:
+                    nxt = node.lit[w] = _Node()
+                node = nxt
+        else:
+            if node.aid < 0:
+                node.aid = len(accept_filters)
+                accept_filters.append(flt)
+
+    # -- BFS state numbering ----------------------------------------------
+    order: List[_Node] = []
+    root.sid = 0
+    order.append(root)
+    q = deque([root])
+    while q:
+        node = q.popleft()
+        for child in list(node.lit.values()) + ([node.plus] if node.plus else []):
+            child.sid = len(order)
+            order.append(child)
+            q.append(child)
+
+    n_states = len(order)
+    S = _bucket(n_states, state_bucket)
+
+    plus_child = np.full(S, -1, np.int32)
+    hash_accept = np.full(S, -1, np.int32)
+    accept = np.full(S, -1, np.int32)
+
+    # -- vocab over literal edge words (0 = UNKNOWN) -----------------------
+    vocab: Dict[str, int] = {}
+    edges: List[Tuple[int, int, int]] = []  # (state, word_id, next_state)
+    for node in order:
+        plus_child[node.sid] = node.plus.sid if node.plus is not None else -1
+        hash_accept[node.sid] = node.hash_aid
+        accept[node.sid] = node.aid
+        for w, child in node.lit.items():
+            wid = vocab.get(w)
+            if wid is None:
+                wid = vocab[w] = len(vocab) + 1  # 0 reserved
+            edges.append((node.sid, wid, child.sid))
+
+    # -- open-addressing literal table; grow until probe bound holds -------
+    H = _bucket(max(2 * len(edges), 16))
+    while True:
+        tab_state = np.full(H, -1, np.int32)
+        tab_word = np.full(H, -1, np.int32)
+        tab_next = np.full(H, -1, np.int32)
+        ok = True
+        mask = H - 1
+        for s, w, nxt in edges:
+            j = int(_slot(np.int32(s), np.int32(w), mask))
+            for i in range(MAX_PROBES):
+                k = (j + i) & mask
+                if tab_state[k] == -1:
+                    tab_state[k] = s
+                    tab_word[k] = w
+                    tab_next[k] = nxt
+                    break
+            else:
+                ok = False
+                break
+        if ok:
+            break
+        H <<= 1  # chain too long: double and rebuild
+
+    return NfaTable(
+        plus_child=plus_child,
+        hash_accept=hash_accept,
+        accept=accept,
+        tab_state=tab_state,
+        tab_word=tab_word,
+        tab_next=tab_next,
+        n_states=n_states,
+        depth=depth,
+        vocab=vocab,
+        accept_filters=accept_filters,
+        epoch=epoch,
+    )
+
+
+def encode_topics(
+    table: NfaTable, names: Sequence[str], batch: Optional[int] = None
+):
+    """Tokenize concrete topics for the kernel.
+
+    Returns ``(words (B, D) int32, lens (B,) int32, is_sys (B,) bool)``
+    padded to ``batch`` rows (default: len(names)).  Words beyond depth D
+    are irrelevant to matching (only ``#`` accepts can fire past trie
+    depth, and those depend on the first D words only); lengths are capped
+    at D+1 so "deeper than D" uniformly means "no end-accept fires".
+    Padding rows are inert: len sentinel D+2 (no end-accept can fire),
+    ``is_sys`` True (suppresses root ``+``/``#`` at step 0) and all-UNKNOWN
+    words (no literal edge exists for word id 0), so they match nothing.
+    """
+    D = table.depth
+    B = batch if batch is not None else len(names)
+    if len(names) > B:
+        raise ValueError(f"{len(names)} topics > batch {B}")
+    words = np.zeros((B, D), np.int32)
+    lens = np.full(B, D + 2, np.int32)
+    is_sys = np.ones(B, bool)
+    vocab = table.vocab
+    for r, name in enumerate(names):
+        ws = T.words(name)
+        lens[r] = min(len(ws), D + 1)
+        is_sys[r] = name.startswith("$")
+        for i, w in enumerate(ws[:D]):
+            words[r, i] = vocab.get(w, 0)
+    return words, lens, is_sys

@@ -1,0 +1,188 @@
+"""Batched NFA wildcard-match kernel — the device hot path.
+
+Replaces the per-publish ``emqx_trie:match/1`` walk (reference hot loop #1,
+SURVEY.md §3.4) with ONE ``lax.scan`` NFA evaluation over a whole topic
+batch:
+
+* carry: ``active`` (B, A) int32 — the NFA active-state set per topic,
+  -1 padded.  Active sets are **duplicate-free by construction**: a trie
+  node is reachable from the root by exactly one label path, so at step t
+  each matching depth-t node appears at most once.  Compaction is therefore
+  a plain descending sort (valids first), no dedup pass.
+* per step t ∈ [0, D]:
+
+  - ``#``-accepts fire for every active state (a ``#`` child matches the
+    zero remaining levels too, which is why the scan runs D+1 steps);
+  - end-accepts fire when t == topic length;
+  - transitions gather the literal edge via a statically-bounded
+    linear-probe hash lookup plus the ``+`` edge, masked for t ≥ length
+    and for the root-level-wildcard-vs-$-topic rule at t == 0.
+
+Outputs per topic: up to K matched accept ids (sorted descending, -1
+padded), the exact match count, plus overflow counters (active-set spill
+beyond A, match spill beyond K) for SLO monitoring — spills mean the host
+must re-run those topics on the authoritative trie (fail-open, SURVEY.md
+§5.3).
+
+Everything is int32, static shapes, no data-dependent control flow — one
+XLA compilation per (D, A, K, B, S, H) bucket.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .compiler import MAX_PROBES, NfaTable, encode_topics
+
+__all__ = ["MatchResult", "build_matcher", "match_topics"]
+
+
+class MatchResult(NamedTuple):
+    matches: jax.Array     # (B, K) int32 accept ids, descending, -1 pad
+    n_matches: jax.Array   # (B,) int32 exact count (may exceed K)
+    active_overflow: jax.Array  # () int32 — active-set spills (correctness!)
+    match_overflow: jax.Array   # () int32 — rows with count > K
+
+
+def _slot(state: jax.Array, word: jax.Array, mask: int) -> jax.Array:
+    """Device twin of compiler._slot — identical uint32 mixing."""
+    h = state.astype(jnp.uint32) * jnp.uint32(2654435761) + word.astype(
+        jnp.uint32
+    ) * jnp.uint32(2246822519)
+    h = h ^ (h >> jnp.uint32(15))
+    h = h * jnp.uint32(2246822519)
+    h = h ^ (h >> jnp.uint32(13))
+    return (h & jnp.uint32(mask)).astype(jnp.int32)
+
+
+def _probe(state, word, tab_state, tab_word, tab_next):
+    """Literal-edge lookup for a (B, A) block of (state, word) pairs.
+
+    The build bounds every probe chain to MAX_PROBES slots, and keys are
+    compared exactly, so scanning all MAX_PROBES candidate slots needs no
+    empty-slot early exit."""
+    H = tab_state.shape[0]
+    mask = H - 1
+    h = _slot(state, word, mask)
+    res = jnp.full_like(state, -1)
+    for i in range(MAX_PROBES):
+        idx = (h + i) & mask
+        hit = (tab_state[idx] == state) & (tab_word[idx] == word)
+        res = jnp.where((res < 0) & hit, tab_next[idx], res)
+    return res
+
+
+@partial(jax.jit, static_argnames=("active_slots", "max_matches"))
+def nfa_match(
+    words,        # (B, D) int32
+    lens,         # (B,) int32
+    is_sys,       # (B,) bool
+    plus_child,   # (S,) int32
+    hash_accept,  # (S,) int32
+    accept,       # (S,) int32
+    tab_state,    # (H,) int32
+    tab_word,     # (H,) int32
+    tab_next,     # (H,) int32
+    *,
+    active_slots: int = 32,
+    max_matches: int = 64,
+) -> MatchResult:
+    B, D = words.shape
+    A = active_slots
+    K = max_matches
+
+    # transposed word columns so scan consumes one column per step;
+    # step D has no transition (masked), column is a dummy repeat.
+    wcols = jnp.concatenate([words.T, words.T[-1:]], axis=0)  # (D+1, B)
+    ts = jnp.arange(D + 1, dtype=jnp.int32)
+
+    active0 = jnp.full((B, A), -1, jnp.int32).at[:, 0].set(0)  # {root}
+
+    def step(active, xs):
+        t, w = xs                      # t: (), w: (B,)
+        valid = active >= 0
+        sa = jnp.maximum(active, 0)    # safe gather index
+        sys0 = is_sys & (t == 0)       # (B,) root-wildcard suppression
+
+        # --- fire accepts ---------------------------------------------
+        hacc = jnp.where(valid, hash_accept[sa], -1)
+        hacc = jnp.where(sys0[:, None], -1, hacc)
+        at_end = (t == lens)[:, None]
+        eacc = jnp.where(valid & at_end, accept[sa], -1)
+        accepts_t = jnp.concatenate([hacc, eacc], axis=1)  # (B, 2A)
+
+        # --- transition ------------------------------------------------
+        lit = _probe(
+            jnp.where(valid, active, -1), jnp.broadcast_to(w[:, None], (B, A)),
+            tab_state, tab_word, tab_next,
+        )
+        lit = jnp.where(valid, lit, -1)
+        plus = jnp.where(valid, plus_child[sa], -1)
+        plus = jnp.where(sys0[:, None], -1, plus)
+        cand = jnp.concatenate([lit, plus], axis=1)        # (B, 2A)
+        cand = jnp.where((t < lens)[:, None], cand, -1)
+        cand = -jnp.sort(-cand, axis=1)                    # valids first
+        new_active = cand[:, :A]
+        spill = jnp.sum((cand[:, A:] >= 0).astype(jnp.int32))
+        return new_active, (accepts_t, spill)
+
+    _, (accepts, spills) = jax.lax.scan(step, active0, (ts, wcols))
+    # accepts: (D+1, B, 2A) → (B, (D+1)·2A)
+    flat = jnp.transpose(accepts, (1, 0, 2)).reshape(B, -1)
+    flat = -jnp.sort(-flat, axis=1)
+    n = jnp.sum((flat >= 0).astype(jnp.int32), axis=1)
+    return MatchResult(
+        matches=flat[:, :K],
+        n_matches=n,
+        active_overflow=jnp.sum(spills),
+        match_overflow=jnp.sum((n > K).astype(jnp.int32)),
+    )
+
+
+def build_matcher(active_slots: int = 32, max_matches: int = 64):
+    """Bind the static kernel knobs; returned fn takes (words, lens,
+    is_sys, *table.device_arrays())."""
+
+    def match(words, lens, is_sys, plus_child, hash_accept, accept,
+              tab_state, tab_word, tab_next):
+        return nfa_match(
+            words, lens, is_sys, plus_child, hash_accept, accept,
+            tab_state, tab_word, tab_next,
+            active_slots=active_slots, max_matches=max_matches,
+        )
+
+    return match
+
+
+def match_topics(
+    table: NfaTable,
+    names: Sequence[str],
+    active_slots: int = 32,
+    max_matches: int = 64,
+) -> List[List[str]]:
+    """Convenience end-to-end: encode → kernel → decode to filter strings.
+
+    Raises if the active set overflowed (callers wanting fail-open handle
+    MatchResult directly)."""
+    words, lens, is_sys = encode_topics(table, names)
+    res = nfa_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in table.device_arrays()],
+        active_slots=active_slots, max_matches=max_matches,
+    )
+    if int(res.active_overflow) or int(res.match_overflow):
+        raise OverflowError(
+            f"match overflow: active={int(res.active_overflow)} "
+            f"rows>{max_matches}={int(res.match_overflow)}"
+        )
+    matches = np.asarray(res.matches)
+    counts = np.asarray(res.n_matches)
+    out: List[List[str]] = []
+    for r in range(len(names)):
+        out.append([table.accept_filters[a] for a in matches[r, : counts[r]]])
+    return out

@@ -110,6 +110,8 @@ def validate(topic: str, kind: str = "filter") -> None:
                 raise TopicError(f"invalid $share group: {group!r}")
             if real == "":
                 raise TopicError("empty $share real filter")
+            if parse_share(real) is not None:
+                raise TopicError(f"nested $share filter: {topic!r}")
             return validate(real, "filter")
 
     ws = words(topic)

@@ -1,0 +1,152 @@
+"""NFA compiler + match kernel parity vs the host oracle/trie.
+
+The contract (SURVEY.md §7 stage 4): for any wildcard filter set and any
+topic batch, kernel matches ≡ FilterTrie.match ≡ {f | topic.match(n, f)}.
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from emqx_tpu import topic as T
+from emqx_tpu.broker import FilterTrie
+from emqx_tpu.ops import compile_filters, encode_topics, match_topics, nfa_match
+
+import jax.numpy as jnp
+
+
+FILTERS = [
+    "a/b/c", "a/+/c", "a/#", "#", "+", "+/b", "a/b", "b",
+    "$SYS/#", "$SYS/+/x", "x//y", "+/+/+", "a/+/+", "deep/1/2/3/4/5/6/#",
+]
+TOPICS = [
+    "a/b/c", "a/b", "a", "b", "x//y", "x/y", "$SYS/broker", "$SYS/a/x",
+    "deep/1/2/3/4/5/6/7/8/9", "nomatch/zzz", "a/q/c", "/", "a/b/c/d",
+]
+
+
+def oracle(name, filters):
+    return {f for f in filters if T.match(name, f)}
+
+
+def test_compile_basic_shapes():
+    t = compile_filters(FILTERS, depth=16, state_bucket=8)
+    assert t.n_states <= t.S
+    assert t.n_accepts == len(set(FILTERS))
+    # host-side probe agrees with trie structure: root literal 'a'
+    aid = t.vocab["a"]
+    assert t.lookup_literal(0, aid) > 0
+    assert t.lookup_literal(0, 0) == -1  # UNKNOWN has no edges
+
+
+def test_compile_rejects_too_deep():
+    with pytest.raises(ValueError):
+        compile_filters(["a/b/c"], depth=2)
+
+
+def test_match_kernel_explicit():
+    t = compile_filters(FILTERS, depth=16, state_bucket=8)
+    got = match_topics(t, TOPICS)
+    for name, matched in zip(TOPICS, got):
+        assert set(matched) == oracle(name, FILTERS), name
+
+
+def test_match_kernel_against_trie():
+    tr = FilterTrie()
+    for f in FILTERS:
+        tr.insert(f)
+    t = compile_filters(FILTERS, depth=16, state_bucket=8)
+    got = match_topics(t, TOPICS)
+    for name, matched in zip(TOPICS, got):
+        assert set(matched) == set(tr.match(name)), name
+
+
+def test_batch_padding_rows_inert():
+    t = compile_filters(["#", "+", "a/#"], depth=8, state_bucket=8)
+    words, lens, is_sys = encode_topics(t, ["a/b"], batch=4)
+    res = nfa_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in t.device_arrays()],
+    )
+    n = np.asarray(res.n_matches)
+    assert n[0] == 2  # '#', 'a/#'
+    assert (n[1:] == 0).all()  # padding matches nothing
+
+
+def test_empty_filter_set():
+    t = compile_filters([], depth=8, state_bucket=8)
+    assert match_topics(t, ["a/b", "x"]) == [[], []]
+
+
+def test_unknown_words_still_match_wildcards():
+    t = compile_filters(["+/+", "a/#"], depth=8, state_bucket=8)
+    got = match_topics(t, ["zz/ww", "a/zz"])
+    assert set(got[0]) == {"+/+"}
+    assert set(got[1]) == {"a/#", "+/+"}
+
+
+def test_match_overflow_reported():
+    # 100 filters all matching one topic, K=16 → overflow
+    filters = [f"a/{i}/#" for i in range(100)] + ["a/+/+"]
+    t = compile_filters(filters, depth=8, state_bucket=8)
+    names = [f"a/{i}/x" for i in range(8)]
+    words, lens, is_sys = encode_topics(t, names)
+    res = nfa_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in t.device_arrays()],
+        max_matches=2,
+    )
+    # each topic matches a/<i>/# and a/+/+ = 2 matches → no overflow at K=2
+    assert int(res.match_overflow) == 0
+    res2 = nfa_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in t.device_arrays()],
+        max_matches=1,
+    )
+    assert int(res2.match_overflow) == 8
+    assert (np.asarray(res2.n_matches) == 2).all()  # count is exact beyond K
+
+
+def test_active_overflow_reported():
+    # force active-set spill with tiny A: filters +/+/.../+ at all depths
+    filters = []
+    for d in range(1, 7):
+        for combo in range(2 ** d):
+            ws = [("+" if (combo >> i) & 1 else "w") for i in range(d)]
+            filters.append(T.join(ws))
+    filters = list(set(filters))
+    t = compile_filters(filters, depth=8, state_bucket=8)
+    words, lens, is_sys = encode_topics(t, ["w/w/w/w/w/w"])
+    res = nfa_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in t.device_arrays()],
+        active_slots=4,
+    )
+    assert int(res.active_overflow) > 0
+    with pytest.raises(OverflowError):
+        match_topics(t, ["w/w/w/w/w/w"], active_slots=4)
+
+
+# ---------------------------------------------------------------------------
+# property: kernel ≡ oracle on random tables/batches
+# ---------------------------------------------------------------------------
+
+word_st = st.sampled_from(["a", "b", "c", "", "d1"])
+name_st = st.lists(
+    st.one_of(word_st, st.just("$s")), min_size=1, max_size=6
+).map(T.join)
+filter_st = st.lists(
+    st.one_of(word_st, st.just("+")), min_size=1, max_size=6
+).flatmap(lambda ws: st.sampled_from([ws, ws + ["#"], ["#"]])).map(T.join)
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    st.lists(filter_st, min_size=0, max_size=25),
+    st.lists(name_st, min_size=1, max_size=12),
+)
+def test_kernel_equals_oracle_random(filters, names):
+    t = compile_filters(filters, depth=8, state_bucket=8)
+    got = match_topics(t, names, active_slots=64, max_matches=64)
+    for name, matched in zip(names, got):
+        assert set(matched) == oracle(name, set(filters)), (name, filters)

@@ -212,3 +212,10 @@ def test_valid_filters_validate(fw):
         assert not T.is_valid(flt, "filter")
     else:
         T.validate(flt, "filter")
+
+
+def test_nested_share_rejected():
+    # a nested $share would validate but never match after one-layer strip
+    assert not T.is_valid("$share/g1/$share/g2/sensor", "filter")
+    assert not T.is_valid("$share/g1/$queue/sensor", "filter")
+    assert T.is_valid("$share/g1/sensor", "filter")

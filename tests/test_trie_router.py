@@ -1,0 +1,199 @@
+"""Trie + router behavioral tests, mirroring emqx_trie_SUITE /
+emqx_router_SUITE coverage (SURVEY.md §4), plus property tests proving
+trie.match ≡ the topic.match oracle over the inserted key set."""
+
+import string
+
+from hypothesis import given, settings, strategies as st
+
+from emqx_tpu import topic as T
+from emqx_tpu.broker import FilterTrie, TopicTrie, Router
+
+
+# ---------------------------------------------------------------------------
+# FilterTrie
+# ---------------------------------------------------------------------------
+
+def test_filter_trie_basic():
+    tr = FilterTrie()
+    for f in ["a/b/c", "a/+/c", "a/#", "#", "+/b/c", "x/y"]:
+        tr.insert(f)
+    assert sorted(tr.match("a/b/c")) == sorted(["a/b/c", "a/+/c", "a/#", "#", "+/b/c"])
+    assert sorted(tr.match("a/b")) == sorted(["a/#", "#"])
+    assert sorted(tr.match("a")) == sorted(["a/#", "#"])
+    assert sorted(tr.match("x/y")) == sorted(["x/y", "#"])
+    assert tr.match("$SYS/x") == []
+
+
+def test_filter_trie_sys_protection():
+    tr = FilterTrie()
+    for f in ["#", "+/x", "$SYS/#", "$SYS/+", "$SYS/x"]:
+        tr.insert(f)
+    assert sorted(tr.match("$SYS/x")) == sorted(["$SYS/#", "$SYS/+", "$SYS/x"])
+    assert sorted(tr.match("a/x")) == sorted(["#", "+/x"])
+
+
+def test_filter_trie_refcount_delete():
+    tr = FilterTrie()
+    assert tr.insert("a/+") is True
+    assert tr.insert("a/+") is False
+    assert tr.refcount("a/+") == 2
+    assert tr.delete("a/+") is False  # one ref remains
+    assert tr.match("a/b") == ["a/+"]
+    assert tr.delete("a/+") is True
+    assert tr.match("a/b") == []
+    assert tr.is_empty()
+    assert tr.node_count() == 0  # edges pruned
+
+
+def test_filter_trie_delete_shared_prefix():
+    tr = FilterTrie()
+    tr.insert("a/b/c")
+    tr.insert("a/b")
+    tr.delete("a/b/c")
+    assert tr.match("a/b") == ["a/b"]
+    assert tr.match("a/b/c") == []
+    tr.delete("a/b")
+    assert tr.node_count() == 0
+
+
+def test_filter_trie_delete_absent():
+    tr = FilterTrie()
+    assert tr.delete("nope") is False
+
+
+# ---------------------------------------------------------------------------
+# property: trie.match ≡ oracle over key set
+# ---------------------------------------------------------------------------
+
+word_st = st.sampled_from(["a", "b", "c", "", "x1"])
+name_st = st.lists(
+    st.one_of(word_st, st.just("$sys")), min_size=1, max_size=5
+).map(T.join)
+filter_st = st.lists(
+    st.one_of(word_st, st.just("+")), min_size=1, max_size=5
+).flatmap(lambda ws: st.sampled_from([ws, ws + ["#"], ["#"]])).map(T.join)
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.lists(filter_st, min_size=0, max_size=20), st.lists(name_st, min_size=1, max_size=5))
+def test_trie_match_equals_oracle(filters, names):
+    tr = FilterTrie()
+    for f in filters:
+        tr.insert(f)
+    for n in names:
+        expected = {f for f in set(filters) if T.match(n, f)}
+        assert set(tr.match(n)) == expected
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.lists(filter_st, min_size=1, max_size=15), name_st)
+def test_trie_insert_delete_inverse(filters, name):
+    tr = FilterTrie()
+    for f in filters:
+        tr.insert(f)
+    for f in filters:
+        tr.delete(f)
+    assert tr.is_empty()
+    assert tr.node_count() == 0
+    assert tr.match(name) == []
+
+
+# ---------------------------------------------------------------------------
+# TopicTrie (retained-replay direction)
+# ---------------------------------------------------------------------------
+
+def test_topic_trie_basic():
+    tt = TopicTrie()
+    for t in ["a/b", "a/c", "a/b/c", "x", "$SYS/up"]:
+        tt.insert(t)
+    assert sorted(tt.match("a/+")) == sorted(["a/b", "a/c"])
+    assert sorted(tt.match("a/#")) == sorted(["a/b", "a/c", "a/b/c"])
+    assert sorted(tt.match("#")) == sorted(["a/b", "a/c", "a/b/c", "x"])
+    assert tt.match("$SYS/up") == ["$SYS/up"]
+    assert tt.match("+/up") == []
+    assert sorted(tt.match("$SYS/#")) == ["$SYS/up"]
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.lists(name_st, min_size=0, max_size=20), filter_st)
+def test_topic_trie_equals_oracle(names, flt):
+    tt = TopicTrie()
+    for n in names:
+        tt.insert(n)
+    expected = {n for n in set(names) if T.match(n, flt)}
+    assert set(tt.match(flt)) == expected
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+def test_router_exact_and_wildcard():
+    r = Router()
+    r.add_route("a/b", "node1")
+    r.add_route("a/+", "node2")
+    r.add_route("a/b", "node2")
+    assert r.match_dests("a/b") == {"node1", "node2"}
+    assert r.match_dests("a/c") == {"node2"}
+    assert r.match_dests("zzz") == set()
+    assert r.route_count() == 3
+    assert r.has_route("a/+", "node2")
+
+
+def test_router_delete_and_cleanup():
+    r = Router()
+    r.add_route("a/b", "n1")
+    r.add_route("a/+", "n1")
+    r.add_route("a/+", "n2")
+    assert r.cleanup_routes("n1") == 2
+    assert r.match_dests("a/b") == {"n2"}
+    assert not r.has_route("a/b", "n1")
+    r.delete_route("a/+", "n2")
+    assert r.route_count() == 0
+    assert r.match_routes("a/b") == []
+
+
+def test_router_duplicate_add_is_noop():
+    r = Router()
+    assert r.add_route("t/+", "n1") is True
+    assert r.add_route("t/+", "n1") is False
+    assert r.route_count() == 1
+    e = r.epoch
+    assert r.add_route("t/+", "n1") is False
+    assert r.epoch == e  # no-op does not bump epoch
+
+
+def test_router_delta_log():
+    r = Router(delta_log_cap=4)
+    r.add_route("a", "n1")
+    e1 = r.epoch
+    r.add_route("b/+", "n1")
+    r.delete_route("a", "n1")
+    assert [d.op for d in r.deltas_since(e1)] == ["add", "del"]
+    assert r.deltas_since(r.epoch) == []
+    # overflow the log -> None forces resnapshot
+    for i in range(10):
+        r.add_route(f"c/{i}", "n2")
+    assert r.deltas_since(e1) is None
+
+
+def test_router_share_destinations():
+    # shared subs route with (group, node) style dests — opaque to router
+    r = Router()
+    r.add_route("t/#", ("g1", "node1"))
+    r.add_route("t/#", ("g1", "node2"))
+    assert r.match_dests("t/x") == {("g1", "node1"), ("g1", "node2")}
+
+
+def test_deep_filters_no_recursion_limit():
+    # validate() admits very deep topics; walks must not recurse per level
+    deep = "/".join(["x"] * 5000)
+    tr = FilterTrie()
+    tr.insert(deep)
+    tr.insert("/".join(["x"] * 4999 + ["+"]))
+    assert len(tr.match(deep)) == 2
+    tt = TopicTrie()
+    tt.insert(deep)
+    assert tt.match("#") == [deep]
+    assert tt.match("/".join(["x"] * 4999 + ["+"])) == [deep]
