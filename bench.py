@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Headline benchmark: wildcard topic-match throughput, TPU NFA kernel vs
+the host trie baseline (BASELINE.md config 2/3 shape).
+
+Prints ONE JSON line:
+  {"metric": "wildcard_match_throughput", "value": <topics/s/chip>,
+   "unit": "topics/s/chip", "vs_baseline": <x over CPU trie>}
+
+The CPU denominator is measured here (BASELINE.md: the reference published
+no numbers; a semantics-faithful host trie IS the denominator).  Workload:
+Zipfian-ish depth-capped topic tree with a +/# wildcard mix, per
+BASELINE.json configs.
+
+Usage: python bench.py [--smoke] [--filters N] [--batch B] [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_workload(rng, n_filters: int, n_topics: int, depth: int = 8):
+    """Wildcard-heavy filter set + concrete publish topics over a Zipfian
+    topic tree (hot prefixes), BASELINE config 3 shape.  Vectorized: the
+    per-level Zipf draws happen in bulk numpy; only the joins loop."""
+    level_vocab = [
+        [f"L{d}w{i}" for i in range(max(4, 2 ** (d + 2)))] for d in range(depth)
+    ]
+    zipf_w = []
+    for d in range(depth):
+        n = len(level_vocab[d])
+        w = 1.0 / np.arange(1, n + 1)
+        zipf_w.append(w / w.sum())
+
+    def rand_paths(count):
+        depths = rng.integers(2, depth + 1, size=count)
+        cols = [
+            rng.choice(len(level_vocab[d]), size=count, p=zipf_w[d])
+            for d in range(depth)
+        ]
+        return [
+            [level_vocab[i][cols[i][r]] for i in range(depths[r])]
+            for r in range(count)
+        ]
+
+    filters = set()
+    while len(filters) < n_filters:
+        need = int((n_filters - len(filters)) * 1.3) + 16
+        kinds = rng.random(need)
+        plus_pos = rng.random(need)
+        hash_cut = rng.random(need)
+        for ws, kind, pp, hc in zip(rand_paths(need), kinds, plus_pos, hash_cut):
+            if kind < 0.45:  # '+' somewhere
+                ws[int(pp * len(ws))] = "+"
+            elif kind < 0.75:  # '#' tail (replaces ≥1 tail level, stays ≤ depth)
+                ws = ws[: max(1, int(hc * (len(ws) - 1)) + 1) - 1] or ws[:1]
+                ws = ws + ["#"]
+                if len(ws) > depth:
+                    ws = ws[: depth - 1] + ["#"]
+            filters.add("/".join(ws))
+            if len(filters) >= n_filters:
+                break
+    topics = ["/".join(ws) for ws in rand_paths(n_topics)]
+    return sorted(filters), topics
+
+
+def bench_cpu(filters, topics, budget_s: float = 20.0):
+    from emqx_tpu.broker import FilterTrie
+
+    tr = FilterTrie()
+    t0 = time.perf_counter()
+    for f in filters:
+        tr.insert(f)
+    build_s = time.perf_counter() - t0
+    lat = []
+    deadline = time.perf_counter() + budget_s
+    i = 0
+    while time.perf_counter() < deadline and i < len(topics):
+        t0 = time.perf_counter()
+        tr.match(topics[i])
+        lat.append(time.perf_counter() - t0)
+        i += 1
+    lat = np.array(lat)
+    return {
+        "build_s": build_s,
+        "topics_per_s": 1.0 / lat.mean(),
+        "p50_us": float(np.percentile(lat, 50) * 1e6),
+        "p99_us": float(np.percentile(lat, 99) * 1e6),
+        "measured": int(i),
+    }
+
+
+def bench_tpu(filters, topics, batch: int, iters: int, depth: int = 8):
+    """Timing methodology (matters on remote-attached TPUs):
+
+    * throughput — enqueue ``iters`` kernel calls back-to-back, force the
+      queue once with a single device→host read, divide.  No per-call
+      host sync, which is also how the serving sidecar pipelines batches.
+    * latency — after the queue drains, time individual synchronous
+      calls.  On a tunneled device this includes the relay round trip, so
+      a tiny-op sync floor is measured and reported alongside for a
+      floor-corrected per-batch kernel estimate.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops import compile_filters, encode_topics, nfa_match
+
+    dev = jax.devices()[0]
+    t0 = time.perf_counter()
+    table = compile_filters(filters, depth=depth)
+    compile_s = time.perf_counter() - t0
+
+    # pre-encode batches host-side (encode timed separately)
+    t0 = time.perf_counter()
+    batches = []
+    for i in range(0, min(len(topics), batch * 8), batch):
+        chunk = topics[i : i + batch]
+        if len(chunk) < batch:
+            break
+        batches.append(encode_topics(table, chunk, batch=batch))
+    encode_s = (time.perf_counter() - t0) / max(1, len(batches))
+
+    arrs = [jnp.asarray(a) for a in table.device_arrays()]
+    dev_batches = [tuple(jnp.asarray(a) for a in b) for b in batches]
+    nb = len(dev_batches)
+    # warmup / compile (no device→host reads before throughput timing)
+    r = nfa_match(*dev_batches[0], *arrs)
+    jax.block_until_ready(r)
+
+    # --- pipelined throughput (best of 3 reps) --------------------------
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        rs = [nfa_match(*dev_batches[i % nb], *arrs) for i in range(iters)]
+        _ = np.asarray(rs[-1].matches)  # forces the whole queue
+        best = min(best, (time.perf_counter() - t0) / iters)
+    # overflow audit over EVERY distinct batch (outside the timed loops —
+    # overflow means truncated matches, which would invalidate the number)
+    overflow = sum(
+        int(nfa_match(*b, *arrs).active_overflow) for b in dev_batches
+    )
+
+    # --- sync latency distribution (post-queue; includes relay RTT) -----
+    tiny = jax.jit(lambda x: x + 1)
+    t_ = tiny(jnp.zeros((8, 128), jnp.int32))
+    jax.block_until_ready(t_)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        jax.block_until_ready(tiny(t_))
+    sync_floor = (time.perf_counter() - t0) / 5
+
+    lat = []
+    for it in range(min(iters, 30)):
+        b = dev_batches[it % nb]
+        t0 = time.perf_counter()
+        r = nfa_match(*b, *arrs)
+        jax.block_until_ready(r)
+        lat.append(time.perf_counter() - t0)
+    lat = np.array(lat)
+    p99_sync = float(np.percentile(lat, 99))
+    return {
+        "device": str(dev),
+        "compile_table_s": compile_s,
+        "encode_per_batch_ms": encode_s * 1e3,
+        "batch": batch,
+        "n_states": table.n_states,
+        "pipelined_ms_per_batch": best * 1e3,
+        "topics_per_s": batch / best,
+        "sync_p50_ms": float(np.percentile(lat, 50) * 1e3),
+        "sync_p99_ms": p99_sync * 1e3,
+        "sync_floor_ms": sync_floor * 1e3,
+        "kernel_p99_est_ms": max(p99_sync - sync_floor, best) * 1e3,
+        "active_overflow": overflow,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filters", type=int, default=200_000)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--cpu-budget-s", type=float, default=15.0)
+    ap.add_argument("--smoke", action="store_true", help="tiny sizes, CPU ok")
+    args = ap.parse_args()
+    if args.smoke:
+        args.filters, args.batch, args.iters = 2000, 256, 5
+
+    rng = np.random.default_rng(42)
+    n_topics = max(args.batch * 4, 4096)
+    filters, topics = build_workload(rng, args.filters, n_topics, args.depth)
+
+    cpu = bench_cpu(filters, topics, args.cpu_budget_s)
+    tpu = bench_tpu(filters, topics, args.batch, args.iters, args.depth)
+
+    result = {
+        "metric": "wildcard_match_throughput",
+        "value": round(tpu["topics_per_s"], 1),
+        "unit": "topics/s/chip",
+        "vs_baseline": round(tpu["topics_per_s"] / cpu["topics_per_s"], 2),
+        # per-topic p99: CPU per-match p99 vs floor-corrected device batch
+        # p99 amortized over the batch
+        "p99_speedup": round(
+            cpu["p99_us"] / (tpu["kernel_p99_est_ms"] * 1e3 / tpu["batch"]), 2
+        ),
+        "n_filters": len(filters),
+        "cpu": {k: round(v, 3) if isinstance(v, float) else v for k, v in cpu.items()},
+        "tpu": {k: round(v, 3) if isinstance(v, float) else v for k, v in tpu.items()},
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

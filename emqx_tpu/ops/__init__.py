@@ -1,10 +1,10 @@
 """Device data plane: NFA compiler + batched match kernels."""
 
-from .compiler import MAX_PROBES, NfaTable, compile_filters, encode_topics
+from .compiler import BUCKET_SLOTS, NfaTable, compile_filters, encode_topics
 from .match_kernel import MatchResult, build_matcher, match_topics, nfa_match
 
 __all__ = [
-    "MAX_PROBES",
+    "BUCKET_SLOTS",
     "NfaTable",
     "compile_filters",
     "encode_topics",

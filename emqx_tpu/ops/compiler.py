@@ -5,22 +5,25 @@ Behavioral reference: the subscription index semantics of
 the mirror/refresh pattern follows mria's bootstrap-then-replay design
 (SURVEY.md §2.2, §5.4).
 
-The wildcard filter set is compiled to static int32 arrays that a
-``lax.scan`` NFA walk consumes (``emqx_tpu.ops.match_kernel``):
+The wildcard filter set is compiled to static int32 arrays that an
+unrolled NFA walk consumes (``emqx_tpu.ops.match_kernel``):
 
 * **states** — trie nodes of the wildcard filter trie, BFS-numbered with
   root = 0.  ``#``-children are *not* states (``#`` is always terminal):
   they collapse into a per-state ``hash_accept`` id.
-* ``plus_child[s]`` — state id of the ``+`` edge from ``s``, or -1.
-* ``accept[s]``    — accept id if ≥1 filter terminates at ``s``, else -1.
-* ``hash_accept[s]`` — accept id of the ``#``-child of ``s``, else -1.
-* literal edges — open-addressing hash table keyed by (state, word_id)
-  with linear probing; build guarantees probe chains ≤ ``MAX_PROBES`` by
-  growing the table, so the device probe loop is statically bounded.
+* ``node_tab`` (S, 4) int32 — per-state ``[plus_child, hash_accept,
+  accept, 0]``, fetched with ONE wide gather per step (-1 = absent).
+* ``edge_tab`` (Hb, 16) int32 — literal edges in a **4-way bucketed
+  cuckoo table**: each bucket row holds 4 slots of ``[state, word, next,
+  0]``.  A lookup is exactly TWO wide row-gathers (one per hash seed)
+  plus vector compares — wide sequential slices are the access pattern
+  TPU HBM likes; scattered narrow probes are ~10× slower (measured).
+  2-choice × 4-slot cuckoo sustains ~0.9 load factor, keeping the table
+  small and gather-friendly.
 * **vocab** — host dict interning literal edge words to int32 ids.
   Id 0 is reserved UNKNOWN: publish-topic words never seen in any filter
-  map to 0, which has no literal edges by construction (they can still
-  match ``+``/``#``).
+  map to 0, which has no edges by construction (they still match
+  ``+``/``#``).
 
 Shapes are padded to buckets (powers of two) so that table growth rarely
 changes compiled shapes (XLA recompiles are the p99 killer — SURVEY.md §7
@@ -34,20 +37,17 @@ sets / bitmap rows.
 from __future__ import annotations
 
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import topic as T
 
-__all__ = ["NfaTable", "compile_filters", "encode_topics", "MAX_PROBES"]
+__all__ = ["NfaTable", "compile_filters", "encode_topics", "BUCKET_SLOTS"]
 
-MAX_PROBES = 8  # static device-side probe bound; build grows H to enforce
-
-# multiplicative hash constants (Knuth / murmur-style odd constants)
-_HC1 = np.uint32(2654435761)
-_HC2 = np.uint32(2246822519)
+BUCKET_SLOTS = 4     # slots per cuckoo bucket (row = 4 slots × 4 int32)
+_MAX_KICKS = 500     # cuckoo random-walk bound before growing the table
 
 
 def _bucket(n: int, minimum: int = 8) -> int:
@@ -58,13 +58,17 @@ def _bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
-def _slot(state: np.ndarray, word: np.ndarray, mask: int):
-    """Initial probe slot for (state, word) — uint32 math, identical on
-    host (numpy) and device (jnp).  uint32 wraparound is the point."""
+def _bucket_hash(state, word, seed, mask):
+    """Bucket index for (state, word) — uint32 math identical on host
+    (numpy) and device (jnp).  Wraparound is intentional."""
     with np.errstate(over="ignore"):
-        h = state.astype(np.uint32) * _HC1 + word.astype(np.uint32) * _HC2
-        h ^= h >> np.uint32(15)
-        h *= np.uint32(2246822519)
+        h = (
+            state.astype(np.uint32) * np.uint32(2654435761)
+            + word.astype(np.uint32) * np.uint32(2246822519)
+            + np.uint32(seed)
+        )
+        h ^= h >> np.uint32(16)
+        h *= np.uint32(3266489917)
         h ^= h >> np.uint32(13)
         return (h & np.uint32(mask)).astype(np.int32)
 
@@ -73,25 +77,22 @@ def _slot(state: np.ndarray, word: np.ndarray, mask: int):
 class NfaTable:
     """Flattened NFA snapshot (host numpy; ship with ``.device_arrays()``)."""
 
-    plus_child: np.ndarray   # (S,) int32
-    hash_accept: np.ndarray  # (S,) int32
-    accept: np.ndarray       # (S,) int32
-    tab_state: np.ndarray    # (H,) int32, -1 = empty slot
-    tab_word: np.ndarray     # (H,) int32
-    tab_next: np.ndarray     # (H,) int32
-    n_states: int            # live states (≤ S)
-    depth: int               # max filter levels the table supports (D)
+    node_tab: np.ndarray   # (S, 4) int32: [plus_child, hash_accept, accept, 0]
+    edge_tab: np.ndarray   # (Hb, 16) int32: 4 slots of [state, word, next, 0]
+    seeds: np.ndarray      # (2,) int32 — cuckoo bucket-hash seeds
+    n_states: int          # live states (≤ S)
+    depth: int             # max filter levels the table supports (D)
     vocab: Dict[str, int]
     accept_filters: List[str]
     epoch: int = 0
 
     @property
     def S(self) -> int:
-        return int(self.plus_child.shape[0])
+        return int(self.node_tab.shape[0])
 
     @property
-    def H(self) -> int:
-        return int(self.tab_state.shape[0])
+    def Hb(self) -> int:
+        return int(self.edge_tab.shape[0])
 
     @property
     def n_accepts(self) -> int:
@@ -99,29 +100,21 @@ class NfaTable:
 
     def device_arrays(self):
         """The arrays the kernel consumes, in kernel argument order."""
-        return (
-            self.plus_child,
-            self.hash_accept,
-            self.accept,
-            self.tab_state,
-            self.tab_word,
-            self.tab_next,
-        )
+        return (self.node_tab, self.edge_tab, self.seeds)
 
     def shape_key(self) -> Tuple[int, int, int]:
         """Compile-relevant shape signature; same key ⇒ no XLA recompile."""
-        return (self.S, self.H, self.depth)
+        return (self.S, self.Hb, self.depth)
 
-    # -- host-side reference probe (used by tests / debugging) -----------
+    # -- host-side reference lookup (tests / debugging) -------------------
     def lookup_literal(self, state: int, word_id: int) -> int:
-        mask = self.H - 1
-        s = _slot(np.int32(state), np.int32(word_id), mask)
-        for i in range(MAX_PROBES):
-            j = (int(s) + i) & mask
-            if self.tab_state[j] == -1:
-                return -1
-            if self.tab_state[j] == state and self.tab_word[j] == word_id:
-                return int(self.tab_next[j])
+        mask = self.Hb - 1
+        for seed in self.seeds:
+            b = int(_bucket_hash(np.int32(state), np.int32(word_id), seed, mask))
+            row = self.edge_tab[b].reshape(BUCKET_SLOTS, 4)
+            for s, w, nxt, _ in row:
+                if s == state and w == word_id:
+                    return int(nxt)
         return -1
 
 
@@ -136,11 +129,63 @@ class _Node:
         self.aid = -1
 
 
+def _build_cuckoo(
+    edges: List[Tuple[int, int, int]], rng: np.random.Generator
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Place (state, word, next) edges into a 2-choice 4-slot cuckoo table.
+    Returns (edge_tab (Hb,16) int32, seeds (2,) int32)."""
+    Hb = _bucket(max(1, int(len(edges) / (BUCKET_SLOTS * 0.85))), 8)
+    while True:
+        seeds = rng.integers(1, 2**31 - 1, size=2, dtype=np.int32)
+        mask = Hb - 1
+        slots: List[List[Optional[Tuple[int, int, int]]]] = [
+            [None] * BUCKET_SLOTS for _ in range(Hb)
+        ]
+        ok = True
+        for edge in edges:
+            cur = edge
+            placed = False
+            for _ in range(_MAX_KICKS):
+                s, w, nxt = cur
+                b_opts = [
+                    int(_bucket_hash(np.int32(s), np.int32(w), sd, mask))
+                    for sd in seeds
+                ]
+                for b in b_opts:
+                    row = slots[b]
+                    for i in range(BUCKET_SLOTS):
+                        if row[i] is None:
+                            row[i] = cur
+                            placed = True
+                            break
+                    if placed:
+                        break
+                if placed:
+                    break
+                # evict a random victim from a random candidate bucket
+                b = b_opts[int(rng.integers(2))]
+                i = int(rng.integers(BUCKET_SLOTS))
+                cur, slots[b][i] = slots[b][i], cur
+            if not placed:
+                ok = False
+                break
+        if ok:
+            tab = np.full((Hb, BUCKET_SLOTS, 4), -1, np.int32)
+            for b in range(Hb):
+                for i in range(BUCKET_SLOTS):
+                    if slots[b][i] is not None:
+                        s, w, nxt = slots[b][i]
+                        tab[b, i] = (s, w, nxt, 0)
+            return tab.reshape(Hb, BUCKET_SLOTS * 4), seeds
+        Hb <<= 1  # insertion failed: grow and retry with fresh seeds
+
+
 def compile_filters(
     filters: Iterable[str],
     depth: int = 16,
     state_bucket: int = 1024,
     epoch: int = 0,
+    seed: int = 0xE709,
 ) -> NfaTable:
     """Compile a wildcard filter set into an :class:`NfaTable`.
 
@@ -195,55 +240,29 @@ def compile_filters(
 
     n_states = len(order)
     S = _bucket(n_states, state_bucket)
-
-    plus_child = np.full(S, -1, np.int32)
-    hash_accept = np.full(S, -1, np.int32)
-    accept = np.full(S, -1, np.int32)
+    node_tab = np.full((S, 4), -1, np.int32)
+    node_tab[:, 3] = 0
 
     # -- vocab over literal edge words (0 = UNKNOWN) -----------------------
     vocab: Dict[str, int] = {}
     edges: List[Tuple[int, int, int]] = []  # (state, word_id, next_state)
     for node in order:
-        plus_child[node.sid] = node.plus.sid if node.plus is not None else -1
-        hash_accept[node.sid] = node.hash_aid
-        accept[node.sid] = node.aid
+        node_tab[node.sid, 0] = node.plus.sid if node.plus is not None else -1
+        node_tab[node.sid, 1] = node.hash_aid
+        node_tab[node.sid, 2] = node.aid
         for w, child in node.lit.items():
             wid = vocab.get(w)
             if wid is None:
                 wid = vocab[w] = len(vocab) + 1  # 0 reserved
             edges.append((node.sid, wid, child.sid))
 
-    # -- open-addressing literal table; grow until probe bound holds -------
-    H = _bucket(max(2 * len(edges), 16))
-    while True:
-        tab_state = np.full(H, -1, np.int32)
-        tab_word = np.full(H, -1, np.int32)
-        tab_next = np.full(H, -1, np.int32)
-        ok = True
-        mask = H - 1
-        for s, w, nxt in edges:
-            j = int(_slot(np.int32(s), np.int32(w), mask))
-            for i in range(MAX_PROBES):
-                k = (j + i) & mask
-                if tab_state[k] == -1:
-                    tab_state[k] = s
-                    tab_word[k] = w
-                    tab_next[k] = nxt
-                    break
-            else:
-                ok = False
-                break
-        if ok:
-            break
-        H <<= 1  # chain too long: double and rebuild
+    rng = np.random.default_rng(seed)
+    edge_tab, seeds = _build_cuckoo(edges, rng)
 
     return NfaTable(
-        plus_child=plus_child,
-        hash_accept=hash_accept,
-        accept=accept,
-        tab_state=tab_state,
-        tab_word=tab_word,
-        tab_next=tab_next,
+        node_tab=node_tab,
+        edge_tab=edge_tab,
+        seeds=seeds,
         n_states=n_states,
         depth=depth,
         vocab=vocab,

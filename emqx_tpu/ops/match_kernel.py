@@ -1,22 +1,27 @@
 """Batched NFA wildcard-match kernel — the device hot path.
 
 Replaces the per-publish ``emqx_trie:match/1`` walk (reference hot loop #1,
-SURVEY.md §3.4) with ONE ``lax.scan`` NFA evaluation over a whole topic
-batch:
+SURVEY.md §3.4) with ONE unrolled NFA evaluation over a whole topic batch:
 
 * carry: ``active`` (B, A) int32 — the NFA active-state set per topic,
   -1 padded.  Active sets are **duplicate-free by construction**: a trie
   node is reachable from the root by exactly one label path, so at step t
-  each matching depth-t node appears at most once.  Compaction is therefore
-  a plain descending sort (valids first), no dedup pass.
+  each matching depth-t node appears at most once.  Compaction is a
+  ``top_k`` (valids first), no dedup pass.
 * per step t ∈ [0, D]:
 
   - ``#``-accepts fire for every active state (a ``#`` child matches the
-    zero remaining levels too, which is why the scan runs D+1 steps);
+    zero remaining levels too, which is why the walk runs D+1 steps);
   - end-accepts fire when t == topic length;
-  - transitions gather the literal edge via a statically-bounded
-    linear-probe hash lookup plus the ``+`` edge, masked for t ≥ length
-    and for the root-level-wildcard-vs-$-topic rule at t == 0.
+  - transitions fetch the literal edge from the 4-way bucketed cuckoo
+    table (TWO wide row-gathers — the TPU-friendly access pattern; see
+    compiler docstring) plus the ``+`` edge from the packed per-state
+    node table (ONE wide gather), masked for t ≥ length and for the
+    root-level-wildcard-vs-$-topic rule at t == 0.
+
+The walk is fully unrolled: D is small and static, XLA fuses across
+steps, and no dynamic loop means no per-iteration host round trips on
+remote-attached backends.
 
 Outputs per topic: up to K matched accept ids (sorted descending, -1
 padded), the exact match count, plus overflow counters (active-set spill
@@ -25,7 +30,7 @@ must re-run those topics on the authoritative trie (fail-open, SURVEY.md
 §5.3).
 
 Everything is int32, static shapes, no data-dependent control flow — one
-XLA compilation per (D, A, K, B, S, H) bucket.
+XLA compilation per (D, A, K, B, S, Hb) bucket.
 """
 
 from __future__ import annotations
@@ -37,9 +42,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .compiler import MAX_PROBES, NfaTable, encode_topics
+from .compiler import BUCKET_SLOTS, NfaTable, encode_topics
 
-__all__ = ["MatchResult", "build_matcher", "match_topics"]
+__all__ = ["MatchResult", "build_matcher", "match_topics", "nfa_match"]
 
 
 class MatchResult(NamedTuple):
@@ -49,32 +54,37 @@ class MatchResult(NamedTuple):
     match_overflow: jax.Array   # () int32 — rows with count > K
 
 
-def _slot(state: jax.Array, word: jax.Array, mask: int) -> jax.Array:
-    """Device twin of compiler._slot — identical uint32 mixing."""
-    h = state.astype(jnp.uint32) * jnp.uint32(2654435761) + word.astype(
-        jnp.uint32
-    ) * jnp.uint32(2246822519)
-    h = h ^ (h >> jnp.uint32(15))
-    h = h * jnp.uint32(2246822519)
+def _bucket_hash(state: jax.Array, word: jax.Array, seed: jax.Array, mask: int):
+    """Device twin of compiler._bucket_hash — identical uint32 mixing."""
+    h = (
+        state.astype(jnp.uint32) * jnp.uint32(2654435761)
+        + word.astype(jnp.uint32) * jnp.uint32(2246822519)
+        + seed.astype(jnp.uint32)
+    )
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(3266489917)
     h = h ^ (h >> jnp.uint32(13))
     return (h & jnp.uint32(mask)).astype(jnp.int32)
 
 
-def _probe(state, word, tab_state, tab_word, tab_next):
-    """Literal-edge lookup for a (B, A) block of (state, word) pairs.
+def _edge_lookup(state, word, edge_tab, seeds):
+    """Literal-edge lookup for (B, A) (state, word): 2 wide row-gathers.
 
-    The build bounds every probe chain to MAX_PROBES slots, and keys are
-    compared exactly, so scanning all MAX_PROBES candidate slots needs no
-    empty-slot early exit."""
-    H = tab_state.shape[0]
-    mask = H - 1
-    h = _slot(state, word, mask)
-    res = jnp.full_like(state, -1)
-    for i in range(MAX_PROBES):
-        idx = (h + i) & mask
-        hit = (tab_state[idx] == state) & (tab_word[idx] == word)
-        res = jnp.where((res < 0) & hit, tab_next[idx], res)
-    return res
+    Each gathered row holds BUCKET_SLOTS slots of [state, word, next, 0];
+    at most one slot matches (keys are unique), so a max-reduce extracts
+    the hit (-1 elsewhere)."""
+    Hb = edge_tab.shape[0]
+    mask = Hb - 1
+    B, A = state.shape
+    hits = []
+    for k in range(2):
+        b = _bucket_hash(state, word, seeds[k], mask)      # (B, A)
+        rows = edge_tab[b].reshape(B, A, BUCKET_SLOTS, 4)  # wide gather
+        hit = (rows[..., 0] == state[..., None]) & (
+            rows[..., 1] == word[..., None]
+        )
+        hits.append(jnp.max(jnp.where(hit, rows[..., 2], -1), axis=-1))
+    return jnp.maximum(hits[0], hits[1])                   # (B, A)
 
 
 @partial(jax.jit, static_argnames=("active_slots", "max_matches"))
@@ -82,77 +92,72 @@ def nfa_match(
     words,        # (B, D) int32
     lens,         # (B,) int32
     is_sys,       # (B,) bool
-    plus_child,   # (S,) int32
-    hash_accept,  # (S,) int32
-    accept,       # (S,) int32
-    tab_state,    # (H,) int32
-    tab_word,     # (H,) int32
-    tab_next,     # (H,) int32
+    node_tab,     # (S, 4) int32: [plus_child, hash_accept, accept, 0]
+    edge_tab,     # (Hb, 16) int32 cuckoo buckets
+    seeds,        # (2,) int32
     *,
-    active_slots: int = 32,
-    max_matches: int = 64,
+    active_slots: int = 16,
+    max_matches: int = 32,
 ) -> MatchResult:
     B, D = words.shape
     A = active_slots
     K = max_matches
 
-    # transposed word columns so scan consumes one column per step;
-    # step D has no transition (masked), column is a dummy repeat.
-    wcols = jnp.concatenate([words.T, words.T[-1:]], axis=0)  # (D+1, B)
-    ts = jnp.arange(D + 1, dtype=jnp.int32)
-
-    active0 = jnp.full((B, A), -1, jnp.int32).at[:, 0].set(0)  # {root}
-
-    def step(active, xs):
-        t, w = xs                      # t: (), w: (B,)
+    active = jnp.full((B, A), -1, jnp.int32).at[:, 0].set(0)  # {root}
+    accept_cols = []
+    spills = []
+    for t in range(D + 1):
         valid = active >= 0
-        sa = jnp.maximum(active, 0)    # safe gather index
-        sys0 = is_sys & (t == 0)       # (B,) root-wildcard suppression
+        sa = jnp.maximum(active, 0)        # safe gather index
+        node = node_tab[sa]                # (B, A, 4) wide gather
+        plus_child = node[..., 0]
+        hash_accept = node[..., 1]
+        end_accept = node[..., 2]
 
-        # --- fire accepts ---------------------------------------------
-        hacc = jnp.where(valid, hash_accept[sa], -1)
-        hacc = jnp.where(sys0[:, None], -1, hacc)
+        # --- fire accepts -------------------------------------------------
+        hacc = jnp.where(valid, hash_accept, -1)
+        if t == 0:
+            # root-level wildcard suppression for $-topics (active == {root})
+            hacc = jnp.where(is_sys[:, None], -1, hacc)
         at_end = (t == lens)[:, None]
-        eacc = jnp.where(valid & at_end, accept[sa], -1)
-        accepts_t = jnp.concatenate([hacc, eacc], axis=1)  # (B, 2A)
+        eacc = jnp.where(valid & at_end, end_accept, -1)
+        accept_cols.append(jnp.concatenate([hacc, eacc], axis=1))
 
-        # --- transition ------------------------------------------------
-        lit = _probe(
-            jnp.where(valid, active, -1), jnp.broadcast_to(w[:, None], (B, A)),
-            tab_state, tab_word, tab_next,
-        )
+        if t == D:
+            break
+
+        # --- transition ---------------------------------------------------
+        w = jnp.broadcast_to(words[:, t][:, None], (B, A))
+        lit = _edge_lookup(active, w, edge_tab, seeds)
         lit = jnp.where(valid, lit, -1)
-        plus = jnp.where(valid, plus_child[sa], -1)
-        plus = jnp.where(sys0[:, None], -1, plus)
+        plus = jnp.where(valid, plus_child, -1)
+        if t == 0:
+            plus = jnp.where(is_sys[:, None], -1, plus)
         cand = jnp.concatenate([lit, plus], axis=1)        # (B, 2A)
         cand = jnp.where((t < lens)[:, None], cand, -1)
-        cand = -jnp.sort(-cand, axis=1)                    # valids first
-        new_active = cand[:, :A]
-        spill = jnp.sum((cand[:, A:] >= 0).astype(jnp.int32))
-        return new_active, (accepts_t, spill)
+        active, _ = jax.lax.top_k(cand, A)                 # valids first
+        n_cand = jnp.sum((cand >= 0).astype(jnp.int32))
+        n_kept = jnp.sum((active >= 0).astype(jnp.int32))
+        spills.append(n_cand - n_kept)
 
-    _, (accepts, spills) = jax.lax.scan(step, active0, (ts, wcols))
-    # accepts: (D+1, B, 2A) → (B, (D+1)·2A)
-    flat = jnp.transpose(accepts, (1, 0, 2)).reshape(B, -1)
-    flat = -jnp.sort(-flat, axis=1)
+    flat = jnp.concatenate(accept_cols, axis=1)            # (B, (D+1)·2A)
     n = jnp.sum((flat >= 0).astype(jnp.int32), axis=1)
+    topk, _ = jax.lax.top_k(flat, K)                       # descending, -1 pad
     return MatchResult(
-        matches=flat[:, :K],
+        matches=topk,
         n_matches=n,
-        active_overflow=jnp.sum(spills),
+        active_overflow=jnp.sum(jnp.stack(spills)),
         match_overflow=jnp.sum((n > K).astype(jnp.int32)),
     )
 
 
-def build_matcher(active_slots: int = 32, max_matches: int = 64):
+def build_matcher(active_slots: int = 16, max_matches: int = 32):
     """Bind the static kernel knobs; returned fn takes (words, lens,
     is_sys, *table.device_arrays())."""
 
-    def match(words, lens, is_sys, plus_child, hash_accept, accept,
-              tab_state, tab_word, tab_next):
+    def match(words, lens, is_sys, node_tab, edge_tab, seeds):
         return nfa_match(
-            words, lens, is_sys, plus_child, hash_accept, accept,
-            tab_state, tab_word, tab_next,
+            words, lens, is_sys, node_tab, edge_tab, seeds,
             active_slots=active_slots, max_matches=max_matches,
         )
 
@@ -162,8 +167,8 @@ def build_matcher(active_slots: int = 32, max_matches: int = 64):
 def match_topics(
     table: NfaTable,
     names: Sequence[str],
-    active_slots: int = 32,
-    max_matches: int = 64,
+    active_slots: int = 16,
+    max_matches: int = 32,
 ) -> List[List[str]]:
     """Convenience end-to-end: encode → kernel → decode to filter strings.
 

@@ -1,0 +1,16 @@
+"""Multi-chip parallelism: mesh construction and sharded match/fan-out."""
+
+from .mesh import make_mesh, pick_shape
+from .sharded_match import (
+    FanoutResult,
+    build_sharded_matcher,
+    make_accept_bitmap,
+)
+
+__all__ = [
+    "make_mesh",
+    "pick_shape",
+    "FanoutResult",
+    "build_sharded_matcher",
+    "make_accept_bitmap",
+]

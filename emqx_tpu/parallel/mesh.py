@@ -1,0 +1,51 @@
+"""Device-mesh helpers for the multi-chip match pipeline.
+
+The reference scales by BEAM process scheduling + mria replication
+(SURVEY.md §2.5); our counterpart is a ``jax.sharding.Mesh`` with named
+axes:
+
+* ``dp`` — publish-batch rows (pure fan-out, no comms until reduction);
+* ``tp`` — subscriber-bitmap columns (accept sets sharded; group
+  reductions ``psum`` over ICI);
+* ``ep`` — trie prefix partition (stage 12; topics ``all_to_all``-routed
+  to the shard owning their root word).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["make_mesh", "pick_shape"]
+
+
+def pick_shape(n_devices: int, tp: Optional[int] = None) -> Dict[str, int]:
+    """Default mesh factorization: widest power-of-two tp ≤ 4 that divides
+    the device count, rest dp."""
+    if tp is None:
+        tp = 1
+        for cand in (4, 2):
+            if n_devices % cand == 0:
+                tp = cand
+                break
+    if n_devices % tp:
+        raise ValueError(f"tp={tp} does not divide {n_devices} devices")
+    return {"dp": n_devices // tp, "tp": tp}
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    devs = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = pick_shape(len(devs))
+    sizes = list(shape.values())
+    n = int(np.prod(sizes))
+    if n > len(devs):
+        raise ValueError(f"mesh {shape} needs {n} devices, have {len(devs)}")
+    arr = np.array(devs[:n]).reshape(sizes)
+    return Mesh(arr, tuple(shape.keys()))

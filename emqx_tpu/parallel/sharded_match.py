@@ -1,0 +1,133 @@
+"""Multi-chip publish step: DP-sharded NFA match + TP-sharded subscriber
+bitmaps with ICI reductions.
+
+This is the TPU-native counterpart of the reference's cluster fan-out
+(``emqx_broker:publish`` → route → ``gen_rpc`` forward → per-node dispatch,
+SURVEY.md §3.4), restructured for a device mesh (§2.5):
+
+* the NFA tables are **replicated** on every chip (they are the "model");
+* the topic batch is sharded over ``dp`` — each chip matches its rows with
+  zero communication;
+* the accept→subscriber bitmap matrix is sharded **column-wise** over
+  ``tp`` — each chip OR-assembles its slice of every matched row locally,
+  and per-topic totals (e.g. shared-group member counts) are ``psum``'d
+  over ``tp`` (BASELINE config 4's "$share fan-out with subscriber-bitmap
+  reduction").
+
+Everything runs inside one ``shard_map`` so XLA sees the whole step and
+schedules the collectives on ICI.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..ops.compiler import NfaTable
+from ..ops.match_kernel import nfa_match
+
+__all__ = ["FanoutResult", "build_sharded_matcher", "make_accept_bitmap"]
+
+
+class FanoutResult(NamedTuple):
+    bitmap: jax.Array       # (B, W) uint32 — per-topic subscriber bitmap
+    n_subscribers: jax.Array  # (B,) int32 — popcount over the full row
+    n_matches: jax.Array    # (B,) int32 — matched filter count
+    active_overflow: jax.Array  # () int32
+    match_overflow: jax.Array   # () int32
+
+
+def make_accept_bitmap(
+    table: NfaTable, subscribers_of, n_subs: int, tp: int = 1
+) -> np.ndarray:
+    """Build the accept-id → subscriber-bitmap matrix (F+1, W) uint32.
+
+    ``subscribers_of(filter) -> iterable[int]`` maps each accept filter to
+    subscriber ids in [0, n_subs).  Row F (last) is all-zero and is indexed
+    by invalid match slots.  W is padded so tp divides it.
+    """
+    words = (n_subs + 31) // 32
+    if words % tp:
+        words += tp - (words % tp)
+    F = table.n_accepts
+    bm = np.zeros((F + 1, words), np.uint32)
+    for aid, flt in enumerate(table.accept_filters):
+        for sub in subscribers_of(flt):
+            if not 0 <= sub < n_subs:
+                raise ValueError(f"subscriber id {sub} out of range")
+            bm[aid, sub >> 5] |= np.uint32(1) << np.uint32(sub & 31)
+    return bm
+
+
+def _or_reduce_rows(rows: jax.Array) -> jax.Array:
+    """(B, K, W) uint32 → (B, W) bitwise-OR over K."""
+    return jax.lax.reduce(
+        rows, np.uint32(0), jax.lax.bitwise_or, (1,)
+    )
+
+
+def build_sharded_matcher(
+    mesh: Mesh,
+    active_slots: int = 16,   # keep in lockstep with nfa_match defaults so
+    max_matches: int = 32,    # sharded/unsharded paths agree on truncation
+):
+    """Return a jitted ``step(words, lens, is_sys, *nfa_arrays, accept_bitmap)
+    -> FanoutResult`` sharded over the mesh.
+
+    Input layouts: batch arrays sharded over ``dp``; NFA arrays replicated;
+    ``accept_bitmap`` (F+1, W) sharded over ``tp`` columns.  Output bitmap
+    is (dp, tp)-sharded; counts are dp-sharded (psum'd over tp).
+    """
+    repl = P()
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("dp", None),  # words
+            P("dp"),        # lens
+            P("dp"),        # is_sys
+            repl, repl, repl,  # NFA arrays (node_tab, edge_tab, seeds)
+            P(None, "tp"),  # accept_bitmap columns
+        ),
+        out_specs=FanoutResult(
+            bitmap=P("dp", "tp"),
+            n_subscribers=P("dp"),
+            n_matches=P("dp"),
+            active_overflow=P(),
+            match_overflow=P(),
+        ),
+        check_vma=False,
+    )
+    def step(words, lens, is_sys, node_tab, edge_tab, seeds, accept_bitmap):
+        res = nfa_match(
+            words, lens, is_sys, node_tab, edge_tab, seeds,
+            active_slots=active_slots, max_matches=max_matches,
+        )
+        F = accept_bitmap.shape[0] - 1
+        idx = jnp.where(res.matches >= 0, res.matches, F)   # (Bl, K)
+        rows = accept_bitmap[idx]                            # (Bl, K, Wl)
+        bitmap = _or_reduce_rows(rows)                       # (Bl, Wl)
+        # per-topic total subscribers: popcount local slice, psum over tp
+        local = jnp.sum(
+            jax.lax.population_count(bitmap).astype(jnp.int32), axis=1
+        )
+        total = jax.lax.psum(local, "tp")
+        # overflow counters: sum over the dp axis so the host sees globals
+        aov = jax.lax.psum(res.active_overflow, "dp")
+        mov = jax.lax.psum(res.match_overflow, "dp")
+        return FanoutResult(
+            bitmap=bitmap,
+            n_subscribers=total,
+            n_matches=res.n_matches,
+            active_overflow=aov,
+            match_overflow=mov,
+        )
+
+    return jax.jit(step)

@@ -1,0 +1,102 @@
+"""Sharded match pipeline on the virtual 8-device CPU mesh: DP/TP layouts
+agree with the single-device reference (SURVEY.md §4 multi-node-analog)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from emqx_tpu.broker import FilterTrie
+from emqx_tpu.ops import compile_filters, encode_topics, nfa_match
+from emqx_tpu.parallel import (
+    build_sharded_matcher,
+    make_accept_bitmap,
+    make_mesh,
+    pick_shape,
+)
+
+
+FILTERS = ["a/+", "a/#", "+/b", "#", "x/y/z", "x/+/z", "$SYS/#"]
+N_SUBS = 100
+
+
+def subscribers_of(flt):
+    # deterministic fake subscriber sets: filter index spreads over ids
+    i = FILTERS.index(flt)
+    return [(i * 13 + k * 7) % N_SUBS for k in range(i + 1)]
+
+
+def _setup(batch=64):
+    table = compile_filters(FILTERS, depth=8, state_bucket=8)
+    rng = np.random.default_rng(7)
+    names = [
+        "/".join(rng.choice(["a", "b", "x", "y", "z"], size=rng.integers(1, 4)))
+        for _ in range(batch)
+    ]
+    enc = encode_topics(table, names)
+    return table, names, enc
+
+
+def test_pick_shape():
+    assert pick_shape(8) == {"dp": 2, "tp": 4}
+    assert pick_shape(2) == {"dp": 1, "tp": 2}
+    assert pick_shape(1) == {"dp": 1, "tp": 1}
+    with pytest.raises(ValueError):
+        pick_shape(6, tp=4)
+
+
+def test_sharded_matches_unsharded():
+    assert len(jax.devices()) == 8, "conftest must force 8 cpu devices"
+    table, names, (words, lens, is_sys) = _setup(batch=64)
+    bitmap = make_accept_bitmap(table, subscribers_of, N_SUBS, tp=4)
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    step = build_sharded_matcher(mesh)
+    args = (
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in table.device_arrays()],
+        jnp.asarray(bitmap),
+    )
+    res = step(*args)
+
+    # reference: single-device match + host bitmap OR
+    ref = nfa_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in table.device_arrays()],
+    )
+    m = np.asarray(ref.matches)
+    ref_bm = np.zeros((64, bitmap.shape[1]), np.uint32)
+    for r in range(64):
+        for a in m[r][m[r] >= 0]:
+            ref_bm[r] |= bitmap[a]
+    np.testing.assert_array_equal(np.asarray(res.bitmap), ref_bm)
+    popc = np.array([bin(int.from_bytes(row.tobytes(), "little")).count("1") for row in ref_bm])
+    np.testing.assert_array_equal(np.asarray(res.n_subscribers), popc)
+    np.testing.assert_array_equal(np.asarray(res.n_matches), np.asarray(ref.n_matches))
+    assert int(res.active_overflow) == 0
+
+
+def test_sharded_trie_parity():
+    table, names, (words, lens, is_sys) = _setup(batch=32)
+    tr = FilterTrie()
+    for f in FILTERS:
+        tr.insert(f)
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    bitmap = make_accept_bitmap(table, subscribers_of, N_SUBS, tp=2)
+    step = build_sharded_matcher(mesh)
+    res = step(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in table.device_arrays()],
+        jnp.asarray(bitmap),
+    )
+    n = np.asarray(res.n_matches)
+    for r, name in enumerate(names):
+        assert n[r] == len(tr.match(name)), name
+
+
+def test_accept_bitmap_padding():
+    table = compile_filters(["a"], depth=4, state_bucket=8)
+    bm = make_accept_bitmap(table, lambda f: [0, 31, 32, 99], 100, tp=4)
+    assert bm.shape[1] % 4 == 0
+    assert bm[0, 0] == (1 | (1 << 31))
+    assert bm[0, 1] == 1
+    assert bm[-1].sum() == 0  # invalid row is zeros
