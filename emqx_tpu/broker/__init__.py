@@ -7,5 +7,21 @@ notes, §5.4).
 
 from .trie import FilterTrie, TopicTrie
 from .router import Route, RouteDelta, Router
+from .message import Message, make_message
+from .hooks import Hooks, HOOK_POINTS, OK, STOP
+from .mqueue import MQueue
+from .inflight import Inflight, InflightFullError
+from .session import MAX_PACKET_ID, Publish, Session, SubOpts
+from .shared_sub import STRATEGIES, SharedSub
+from .broker import Broker, DeliverResult
+from .cm import ConnectionManager
+from .channel import Channel
 
-__all__ = ["FilterTrie", "TopicTrie", "Route", "RouteDelta", "Router"]
+__all__ = [
+    "FilterTrie", "TopicTrie", "Route", "RouteDelta", "Router",
+    "Message", "make_message", "Hooks", "HOOK_POINTS", "OK", "STOP",
+    "MQueue", "Inflight", "InflightFullError",
+    "MAX_PACKET_ID", "Publish", "Session", "SubOpts",
+    "STRATEGIES", "SharedSub", "Broker", "DeliverResult",
+    "ConnectionManager", "Channel",
+]

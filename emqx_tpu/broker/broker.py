@@ -1,0 +1,264 @@
+"""The pubsub core: subscribe/unsubscribe/publish/dispatch.
+
+Behavioral reference: ``apps/emqx/src/emqx_broker.erl`` (``publish/1``,
+``subscribe/3``, ``dispatch/2``), ``emqx_broker_helper.erl`` and the
+publish call stack of SURVEY.md §3.4 [U].
+
+Responsibilities kept from the reference:
+
+* subscriber table: filter → {clientid → SubOpts} (the ETS
+  ``emqx_subscriber`` analog), shared groups delegated to
+  :class:`SharedSub`;
+* route table updates on first/last subscriber of a filter
+  (``emqx_router:do_add_route`` / ``do_delete_route``);
+* publish pipeline: ``'message.publish'`` hook fold → route match →
+  per-subscriber QoS cap → session delivery → ``message.delivered`` /
+  ``message.dropped`` hooks;
+* ``$SYS`` messages never match root wildcards (enforced by the match
+  oracle/trie/kernel);
+* No-Local (MQTT5 ``nl``) suppression.
+
+The broker is single-node here; ``dest`` in the router is either this
+node's name (non-shared) or ``(group, node)`` (shared) so that the
+multi-node forwarding layer (``emqx_tpu.cluster``) can ship deliveries
+across nodes using the same tables.  The device NFA mirror subscribes to
+``router.deltas_since`` (SURVEY.md §3.3 note).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from .. import topic as T
+from .hooks import Hooks, HOOK_POINTS, OK, STOP
+from .message import Message, make_message
+from .mqueue import MQueue
+from .router import Router
+from .session import Publish, Session, SubOpts
+from .shared_sub import SharedSub
+
+__all__ = ["Broker", "DeliverResult"]
+
+
+class DeliverResult:
+    """Per-publish outcome: connection-layer sendouts + accounting."""
+
+    __slots__ = ("publishes", "dropped", "matched", "no_subscribers")
+
+    def __init__(self) -> None:
+        self.publishes: Dict[str, List[Publish]] = {}  # clientid -> sends
+        self.dropped: List[Tuple[str, Message]] = []   # (clientid, msg)
+        self.matched: int = 0
+        self.no_subscribers: bool = False
+
+
+class Broker:
+    def __init__(
+        self,
+        node: str = "local",
+        hooks: Optional[Hooks] = None,
+        shared_strategy: str = "random",
+        session_defaults: Optional[dict] = None,
+    ) -> None:
+        self.node = node
+        self.hooks = hooks if hooks is not None else Hooks()
+        self.router = Router()
+        self.shared = SharedSub(shared_strategy)
+        self.sessions: Dict[str, Session] = {}
+        # filter -> {clientid -> SubOpts}; non-shared local subscribers
+        self.subscribers: Dict[str, Dict[str, SubOpts]] = {}
+        self.session_defaults = session_defaults or {}
+
+    # ------------------------------------------------------------------
+    # session lifecycle (emqx_cm:open_session semantics, simplified here;
+    # full takeover lives in emqx_tpu.broker.cm)
+    # ------------------------------------------------------------------
+
+    def open_session(
+        self, clientid: str, clean_start: bool = True, **kw
+    ) -> Tuple[Session, bool]:
+        """Returns (session, session_present)."""
+        old = self.sessions.get(clientid)
+        if old is not None and not clean_start:
+            # a resuming client renegotiates flow-control/expiry knobs
+            if "max_inflight" in kw:
+                old.inflight.max_size = kw["max_inflight"]
+            if "expiry_interval" in kw:
+                old.expiry_interval = kw["expiry_interval"]
+            self.hooks.run("session.resumed", (clientid,))
+            return old, True
+        if old is not None:
+            self._drop_session_state(old)
+            self.hooks.run("session.discarded", (clientid,))
+        opts = {**self.session_defaults, **kw}
+        sess = Session(clientid, clean_start=clean_start, **opts)
+        self.sessions[clientid] = sess
+        self.hooks.run("session.created", (clientid,))
+        return sess, False
+
+    def close_session(self, clientid: str, discard: bool = False) -> None:
+        sess = self.sessions.get(clientid)
+        if sess is None:
+            return
+        if discard or sess.clean_start:
+            self._drop_session_state(sess)
+            del self.sessions[clientid]
+            self.hooks.run("session.terminated", (clientid,))
+
+    def _drop_session_state(self, sess: Session) -> None:
+        for flt in list(sess.subscriptions):
+            self._do_unsubscribe(sess.clientid, flt, sess.subscriptions[flt])
+
+    # ------------------------------------------------------------------
+    # subscribe / unsubscribe (SURVEY.md §3.3)
+    # ------------------------------------------------------------------
+
+    def subscribe(self, clientid: str, raw_filter: str, opts: SubOpts = SubOpts()) -> bool:
+        T.validate(raw_filter, "filter")
+        sess = self.sessions.get(clientid)
+        if sess is None:
+            raise KeyError(f"no session for {clientid!r}")
+        share = T.parse_share(raw_filter)
+        if share is not None:
+            group, flt = share
+            opts = replace(opts, share=group)
+        else:
+            group, flt = None, raw_filter
+        sess.subscribe(raw_filter, opts)
+        if group is not None:
+            self.shared.subscribe(group, flt, clientid, self.node)
+            self.router.add_route(flt, (group, self.node))
+        else:
+            subs = self.subscribers.setdefault(flt, {})
+            first = not subs
+            subs[clientid] = opts
+            if first:
+                self.router.add_route(flt, self.node)
+        self.hooks.run("session.subscribed", (clientid, raw_filter, opts))
+        return True
+
+    def unsubscribe(self, clientid: str, raw_filter: str) -> bool:
+        sess = self.sessions.get(clientid)
+        if sess is None:
+            return False
+        opts = sess.subscriptions.get(raw_filter)
+        if opts is None:
+            return False
+        sess.unsubscribe(raw_filter)
+        self._do_unsubscribe(clientid, raw_filter, opts)
+        self.hooks.run("session.unsubscribed", (clientid, raw_filter))
+        return True
+
+    def _do_unsubscribe(self, clientid: str, raw_filter: str, opts: SubOpts) -> None:
+        share = T.parse_share(raw_filter)
+        if share is not None:
+            group, flt = share
+            self.shared.unsubscribe(group, flt, clientid, self.node)
+            if not self.shared.members(group, flt):
+                self.router.delete_route(flt, (group, self.node))
+        else:
+            flt = raw_filter
+            subs = self.subscribers.get(flt)
+            if subs and clientid in subs:
+                del subs[clientid]
+                if not subs:
+                    del self.subscribers[flt]
+                    self.router.delete_route(flt, self.node)
+
+    # ------------------------------------------------------------------
+    # publish / dispatch (SURVEY.md §3.4 — THE hot path)
+    # ------------------------------------------------------------------
+
+    def publish(self, msg: Message) -> DeliverResult:
+        T.validate(msg.topic, "name")
+        res = DeliverResult()
+        msg = self.hooks.run_fold("message.publish", (), msg)
+        if msg is None or msg.headers.get("allow_publish") is False:
+            res.no_subscribers = True
+            return res
+        routes = self.router.match_routes(msg.topic)
+        if not routes:
+            res.no_subscribers = True
+            self.hooks.run("message.dropped", (msg, "no_subscribers"))
+            return res
+        seen_shared: set = set()
+        for flt, dest in routes:
+            if isinstance(dest, tuple):  # (group, node) shared route
+                group, _node = dest
+                if (group, flt) in seen_shared:
+                    continue
+                seen_shared.add((group, flt))
+                self._dispatch_shared(group, flt, msg, res)
+            else:
+                self._dispatch(flt, msg, res)
+        return res
+
+    def _dispatch(self, flt: str, msg: Message, res: DeliverResult) -> None:
+        for clientid, opts in self.subscribers.get(flt, {}).items():
+            if opts.nl and msg.sender == clientid:
+                continue  # MQTT5 No-Local
+            self._deliver_to(clientid, opts, msg, res)
+
+    def _dispatch_shared(
+        self, group: str, flt: str, msg: Message, res: DeliverResult
+    ) -> None:
+        def try_deliver(member: Tuple[str, str]) -> bool:
+            clientid, node = member
+            if node != self.node:
+                return False  # cross-node forwarding: cluster layer
+            sess = self.sessions.get(clientid)
+            if sess is None:
+                return False
+            # $queue/... sessions store the raw legacy key, not $share form
+            opts = sess.subscriptions.get(T.make_share(group, flt))
+            if opts is None and group == T.QUEUE_PREFIX:
+                opts = sess.subscriptions.get(f"{T.QUEUE_PREFIX}/{flt}")
+            if opts is None:
+                return False
+            before = len(res.dropped)
+            self._deliver_to(clientid, opts, msg, res)
+            return len(res.dropped) == before  # nack if it was dropped
+
+        member = self.shared.dispatch_with_ack(
+            group, flt, msg.topic, try_deliver, msg.sender, self.node
+        )
+        if member is None:
+            self.hooks.run("message.dropped", (msg, "shared_no_available"))
+
+    def _deliver_to(
+        self, clientid: str, opts: SubOpts, msg: Message, res: DeliverResult
+    ) -> None:
+        sess = self.sessions.get(clientid)
+        if sess is None:
+            return
+        eff = msg.with_qos(min(msg.qos, opts.qos))
+        if not opts.rap and not msg.dup:
+            # Retain-As-Published off → clear retain flag on forward
+            eff = eff.clone(retain=False) if eff.retain else eff
+        sends, dropped = sess.deliver([eff])
+        if sends:
+            res.matched += 1
+            res.publishes.setdefault(clientid, []).extend(sends)
+            self.hooks.run("message.delivered", (clientid, eff))
+        for d in dropped:
+            res.dropped.append((clientid, d))
+            self.hooks.run("message.dropped", (d, "queue_full"))
+
+    # ------------------------------------------------------------------
+
+    def match_filters(self, topic: str) -> List[str]:
+        """All filters (wildcard + exact) with local state matching topic —
+        parity surface for the device mirror."""
+        return [flt for flt, _ in self.router.match_routes(topic)]
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "sessions.count": len(self.sessions),
+            "subscriptions.count": sum(
+                len(s.subscriptions) for s in self.sessions.values()
+            ),
+            "subscribers.count": sum(len(v) for v in self.subscribers.values()),
+            "routes.count": self.router.route_count(),
+            "shared_groups.count": len(self.shared.groups()),
+        }

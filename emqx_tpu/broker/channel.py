@@ -1,0 +1,380 @@
+"""Channel: the per-connection MQTT protocol state machine.
+
+Behavioral reference: ``apps/emqx/src/emqx_channel.erl`` (``handle_in/2``,
+``handle_out/3``) [U] (SURVEY.md §2.1, §3.2-3.4): CONNECT/auth flow,
+keepalive, will message, topic aliasing, QoS flows, takeover.
+
+IO-free: :meth:`handle_in` consumes a parsed packet and returns a list of
+actions for the connection layer::
+
+    ("send", pkt)          serialize + write
+    ("close", reason)      shut the transport (after flushing sends)
+
+Routed deliveries enter through :meth:`handle_deliver`; timers call
+:meth:`check_keepalive` / :meth:`retry_deliveries`.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import topic as T
+from ..mqtt import packet as P
+from .broker import Broker
+from .cm import ConnectionManager
+from .message import Message, make_message
+from .session import Publish, Session, SubOpts
+
+__all__ = ["Channel"]
+
+Action = Tuple[str, Any]
+
+# v5 reason code → v3 CONNACK return code
+_V3_CONNACK = {
+    P.RC.SUCCESS: 0,
+    P.RC.UNSPECIFIED_ERROR: 3,
+    P.RC.BAD_USER_NAME_OR_PASSWORD: 4,
+    P.RC.NOT_AUTHORIZED: 5,
+    P.RC.SERVER_UNAVAILABLE: 3,
+    P.RC.BANNED: 5,
+}
+
+
+class Channel:
+    def __init__(
+        self,
+        broker: Broker,
+        cm: ConnectionManager,
+        conninfo: Optional[Dict[str, Any]] = None,
+        max_topic_alias: int = 16,
+        max_inflight: int = 32,
+        server_keepalive: Optional[int] = None,
+    ) -> None:
+        self.broker = broker
+        self.cm = cm
+        self.conninfo = conninfo or {}
+        self.state = "idle"          # idle → connected → disconnected
+        self.proto_ver = 4
+        self.clientid: Optional[str] = None
+        self.username: Optional[str] = None
+        self.session: Optional[Session] = None
+        self.will: Optional[P.Will] = None
+        self.keepalive = 0
+        self.server_keepalive = server_keepalive
+        self.max_topic_alias = max_topic_alias
+        self.max_inflight = max_inflight
+        self._aliases: Dict[int, str] = {}   # inbound alias → topic
+        self.last_rx = time.time()
+
+    # ------------------------------------------------------------------
+
+    def handle_in(self, pkt: Any) -> List[Action]:
+        self.last_rx = time.time()
+        if self.state == "idle":
+            if pkt.type != P.CONNECT:
+                return [("close", "protocol_error: packet before CONNECT")]
+            return self._handle_connect(pkt)
+        if pkt.type == P.CONNECT:
+            return [("close", "protocol_error: duplicate CONNECT")]
+        handler = {
+            P.PUBLISH: self._handle_publish,
+            P.PUBACK: self._handle_puback,
+            P.PUBREC: self._handle_pubrec,
+            P.PUBREL: self._handle_pubrel,
+            P.PUBCOMP: self._handle_pubcomp,
+            P.SUBSCRIBE: self._handle_subscribe,
+            P.UNSUBSCRIBE: self._handle_unsubscribe,
+            P.PINGREQ: lambda _: [("send", P.PingResp())],
+            P.DISCONNECT: self._handle_disconnect,
+            P.AUTH: lambda _: [],
+        }.get(pkt.type)
+        if handler is None:
+            return [("close", f"unexpected packet type {pkt.type}")]
+        return handler(pkt)
+
+    # ------------------------------------------------------------------
+    # CONNECT
+    # ------------------------------------------------------------------
+
+    def _handle_connect(self, pkt: P.Connect) -> List[Action]:
+        self.proto_ver = pkt.proto_ver
+        props: Dict[str, Any] = {}
+        # clientid assignment (v5 §3.1.3.1)
+        clientid = pkt.clientid
+        if not clientid:
+            if pkt.proto_ver < 5 and not pkt.clean_start:
+                return self._connack_error(P.RC.UNSPECIFIED_ERROR)
+            clientid = f"emqx_tpu_{uuid.uuid4().hex[:12]}"
+            if pkt.proto_ver == 5:
+                props["Assigned-Client-Identifier"] = clientid
+
+        if self.broker.hooks.run("client.connect", (clientid, pkt)) == "stop":
+            return self._connack_error(P.RC.NOT_AUTHORIZED)
+
+        ok = self.broker.hooks.run_fold(
+            "client.authenticate",
+            (clientid, pkt.username, pkt.password, self.conninfo),
+            True,
+        )
+        if ok is not True:
+            rc = ok if isinstance(ok, int) else P.RC.NOT_AUTHORIZED
+            return self._connack_error(rc)
+
+        self.clientid = clientid
+        self.username = pkt.username
+        self.will = pkt.will
+        self.keepalive = pkt.keepalive
+        if self.server_keepalive is not None and pkt.proto_ver == 5:
+            self.keepalive = self.server_keepalive
+            props["Server-Keep-Alive"] = self.server_keepalive
+
+        recv_max = pkt.properties.get("Receive-Maximum", self.max_inflight)
+        if recv_max == 0:  # MQTT5 §3.1.2.11: value 0 is a protocol error
+            return self._connack_error(P.RC.PROTOCOL_ERROR)
+        expiry = pkt.properties.get("Session-Expiry-Interval", 0)
+        sess, present, old_chan = self.cm.open_session(
+            clientid, pkt.clean_start, self,
+            max_inflight=min(recv_max, self.max_inflight),
+            expiry_interval=float(expiry),
+        )
+        self.session = sess
+        self.state = "connected"
+        if pkt.proto_ver == 5:
+            props["Topic-Alias-Maximum"] = self.max_topic_alias
+            props["Shared-Subscription-Available"] = 1
+            props["Wildcard-Subscription-Available"] = 1
+            props["Subscription-Identifier-Available"] = 1
+        actions: List[Action] = []
+        if old_chan is not None and old_chan is not self:
+            actions.append(("takeover", old_chan))
+        actions.append(
+            (
+                "send",
+                P.Connack(
+                    session_present=present,
+                    reason_code=P.RC.SUCCESS if self.proto_ver == 5 else 0,
+                    properties=props,
+                ),
+            )
+        )
+        self.broker.hooks.run("client.connected", (clientid, self.conninfo))
+        if present:
+            for pub in sess.resume_publishes():
+                actions.append(("send", self._to_publish_pkt(pub)))
+        return actions
+
+    def _connack_error(self, rc: int) -> List[Action]:
+        code = rc if self.proto_ver == 5 else _V3_CONNACK.get(rc, 3)
+        return [
+            ("send", P.Connack(session_present=False, reason_code=code)),
+            ("close", f"connack error 0x{rc:02x}"),
+        ]
+
+    # ------------------------------------------------------------------
+    # PUBLISH (inbound)
+    # ------------------------------------------------------------------
+
+    def _resolve_alias(self, pkt: P.Publish) -> Optional[str]:
+        alias = pkt.properties.get("Topic-Alias")
+        if alias is not None:
+            if not 1 <= alias <= self.max_topic_alias:
+                return None
+            if pkt.topic:
+                self._aliases[alias] = pkt.topic
+                return pkt.topic
+            return self._aliases.get(alias)
+        return pkt.topic or None
+
+    def _handle_publish(self, pkt: P.Publish) -> List[Action]:
+        topic = self._resolve_alias(pkt)
+        if topic is None:
+            return [("close", "topic alias invalid")]
+        if not T.is_valid(topic, "name"):
+            return self._puback_for(pkt, P.RC.TOPIC_NAME_INVALID)
+        allowed = self.broker.hooks.run_fold(
+            "client.authorize", (self.clientid, "publish", topic), True
+        )
+        if allowed is not True:
+            return self._puback_for(pkt, P.RC.NOT_AUTHORIZED)
+        msg = make_message(
+            self.clientid, topic, pkt.payload, qos=pkt.qos,
+            retain=pkt.retain, properties=dict(pkt.properties),
+        )
+        if pkt.qos == 2:
+            st = self.session.publish_qos2(pkt.packet_id, msg)
+            if st == "full":
+                return [("send", P.PubAck(P.PUBREC, pkt.packet_id, P.RC.QUOTA_EXCEEDED))]
+            if st == "ok":
+                self.broker.publish(msg)
+            return [("send", P.PubAck(P.PUBREC, pkt.packet_id))]
+        res = self.broker.publish(msg)
+        if pkt.qos == 1:
+            rc = (
+                P.RC.NO_MATCHING_SUBSCRIBERS
+                if res.no_subscribers and self.proto_ver == 5
+                else P.RC.SUCCESS
+            )
+            return [("send", P.PubAck(P.PUBACK, pkt.packet_id, rc))]
+        return []
+
+    def _puback_for(self, pkt: P.Publish, rc: int) -> List[Action]:
+        if pkt.qos == 1:
+            return [("send", P.PubAck(P.PUBACK, pkt.packet_id, rc))]
+        if pkt.qos == 2:
+            return [("send", P.PubAck(P.PUBREC, pkt.packet_id, rc))]
+        if rc == P.RC.NOT_AUTHORIZED and self.proto_ver == 5:
+            return [("send", P.Disconnect(reason_code=rc)), ("close", "not authorized")]
+        return []
+
+    # ------------------------------------------------------------------
+    # QoS acks (outbound flow)
+    # ------------------------------------------------------------------
+
+    def _handle_puback(self, pkt: P.PubAck) -> List[Action]:
+        msg, more = self.session.puback(pkt.packet_id)
+        if msg is not None:
+            self.broker.hooks.run("message.acked", (self.clientid, msg))
+        return [("send", self._to_publish_pkt(p)) for p in more]
+
+    def _handle_pubrec(self, pkt: P.PubAck) -> List[Action]:
+        if self.session.pubrec(pkt.packet_id):
+            return [("send", P.PubAck(P.PUBREL, pkt.packet_id))]
+        return [("send", P.PubAck(P.PUBREL, pkt.packet_id, P.RC.PACKET_ID_NOT_FOUND))]
+
+    def _handle_pubrel(self, pkt: P.PubAck) -> List[Action]:
+        if self.session.pubrel_received(pkt.packet_id):
+            return [("send", P.PubAck(P.PUBCOMP, pkt.packet_id))]
+        return [("send", P.PubAck(P.PUBCOMP, pkt.packet_id, P.RC.PACKET_ID_NOT_FOUND))]
+
+    def _handle_pubcomp(self, pkt: P.PubAck) -> List[Action]:
+        known, more = self.session.pubcomp(pkt.packet_id)
+        return [("send", self._to_publish_pkt(p)) for p in more]
+
+    # ------------------------------------------------------------------
+    # SUBSCRIBE / UNSUBSCRIBE
+    # ------------------------------------------------------------------
+
+    def _handle_subscribe(self, pkt: P.Subscribe) -> List[Action]:
+        if self.broker.hooks.run("client.subscribe", (self.clientid, pkt)) == "stop":
+            rcs = [P.RC.NOT_AUTHORIZED] * len(pkt.topic_filters)
+            return [("send", P.Suback(packet_id=pkt.packet_id, reason_codes=rcs))]
+        subid = pkt.properties.get("Subscription-Identifier")
+        rcs: List[int] = []
+        for flt, o in pkt.topic_filters:
+            if not T.is_valid(flt, "filter"):
+                rcs.append(P.RC.TOPIC_FILTER_INVALID)
+                continue
+            allowed = self.broker.hooks.run_fold(
+                "client.authorize", (self.clientid, "subscribe", flt), True
+            )
+            if allowed is not True:
+                rcs.append(P.RC.NOT_AUTHORIZED)
+                continue
+            opts = SubOpts(
+                qos=o.get("qos", 0), nl=bool(o.get("nl", 0)),
+                rap=bool(o.get("rap", 0)), rh=o.get("rh", 0), subid=subid,
+            )
+            self.broker.subscribe(self.clientid, flt, opts)
+            rcs.append(opts.qos)  # granted qos
+        return [("send", P.Suback(packet_id=pkt.packet_id, reason_codes=rcs))]
+
+    def _handle_unsubscribe(self, pkt: P.Unsubscribe) -> List[Action]:
+        rcs = []
+        for flt in pkt.topic_filters:
+            ok = self.broker.unsubscribe(self.clientid, flt)
+            rcs.append(P.RC.SUCCESS if ok else 0x11)  # no-subscription-existed
+        return [("send", P.Unsuback(packet_id=pkt.packet_id, reason_codes=rcs))]
+
+    # ------------------------------------------------------------------
+    # DISCONNECT / close / will
+    # ------------------------------------------------------------------
+
+    def _handle_disconnect(self, pkt: P.Disconnect) -> List[Action]:
+        if pkt.reason_code == 0x04:  # disconnect-with-will
+            pass  # keep will for publication on close
+        else:
+            self.will = None
+        expiry = pkt.properties.get("Session-Expiry-Interval")
+        if expiry is not None and self.session is not None:
+            self.session.expiry_interval = float(expiry)
+        self.state = "disconnected"
+        return [("close", "client disconnect")]
+
+    def handle_close(self, reason: str = "closed") -> None:
+        """Transport gone: publish will (if any), unregister, run hooks."""
+        if self.state == "connected":
+            self.state = "disconnected"
+        if self.will is not None:
+            wmsg = make_message(
+                self.clientid, self.will.topic, self.will.payload,
+                qos=self.will.qos, retain=self.will.retain,
+                properties=dict(self.will.properties),
+            )
+            self.broker.publish(wmsg)
+            self.will = None
+        if self.clientid is not None:
+            # Only the owning channel may tear down broker-side state; a
+            # displaced channel closing late must not destroy its
+            # successor's live session.
+            owner = self.cm.lookup_channel(self.clientid) is self
+            self.cm.unregister_channel(self.clientid, self)
+            if owner:
+                self.broker.close_session(self.clientid)
+                self.broker.hooks.run(
+                    "client.disconnected", (self.clientid, reason)
+                )
+
+    def handle_takeover(self) -> List[Action]:
+        """This channel is displaced by a newer CONNECT of the same id."""
+        self.will = None  # takeover does not fire the will
+        self.state = "disconnected"
+        out: List[Action] = []
+        if self.proto_ver == 5:
+            out.append(("send", P.Disconnect(reason_code=P.RC.SESSION_TAKEN_OVER)))
+        out.append(("close", "session taken over"))
+        return out
+
+    # ------------------------------------------------------------------
+    # outbound deliveries & timers
+    # ------------------------------------------------------------------
+
+    def handle_deliver(self, pubs: List[Publish]) -> List[Action]:
+        return [("send", self._to_publish_pkt(p)) for p in pubs]
+
+    def _to_publish_pkt(self, p: Publish) -> P.Publish:
+        m = p.msg
+        return P.Publish(
+            dup=m.dup, qos=m.qos, retain=m.retain, topic=m.topic,
+            packet_id=p.pid, payload=m.payload,
+            properties={
+                k: v
+                for k, v in m.properties.items()
+                if k in (
+                    "Payload-Format-Indicator", "Message-Expiry-Interval",
+                    "Content-Type", "Response-Topic", "Correlation-Data",
+                    "User-Property",
+                )
+            } if self.proto_ver == 5 else {},
+        )
+
+    def check_keepalive(self, now: Optional[float] = None) -> List[Action]:
+        """MQTT §3.1.2.10: close after 1.5 × keepalive of silence."""
+        if self.state != "connected" or self.keepalive == 0:
+            return []
+        now = now if now is not None else time.time()
+        if now - self.last_rx > self.keepalive * 1.5:
+            return [("close", "keepalive timeout")]
+        return []
+
+    def retry_deliveries(self, now: Optional[float] = None) -> List[Action]:
+        if self.session is None:
+            return []
+        out: List[Action] = []
+        for pid, kind, msg in self.session.retry(now):
+            if kind == "publish":
+                out.append(("send", self._to_publish_pkt(Publish(pid, msg))))
+            else:
+                out.append(("send", P.PubAck(P.PUBREL, pid)))
+        return out

@@ -1,0 +1,72 @@
+"""Inflight window: unacked QoS1/2 deliveries awaiting client response.
+
+Behavioral reference: ``apps/emqx/src/emqx_inflight.erl`` [U] (SURVEY.md
+§2.1): bounded insertion-ordered map packet-id → record, with
+retry/expiry iteration in insertion order.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["Inflight", "InflightFullError"]
+
+
+class InflightFullError(Exception):
+    pass
+
+
+class Inflight:
+    def __init__(self, max_size: int = 32) -> None:
+        self.max_size = max_size
+        self._d: Dict[int, Tuple[float, Any]] = {}  # pid -> (ts, value)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def is_full(self) -> bool:
+        return self.max_size > 0 and len(self._d) >= self.max_size
+
+    def is_empty(self) -> bool:
+        return not self._d
+
+    def contains(self, pid: int) -> bool:
+        return pid in self._d
+
+    def insert(self, pid: int, value: Any) -> None:
+        if self.is_full():
+            raise InflightFullError(f"inflight window full ({self.max_size})")
+        if pid in self._d:
+            raise KeyError(f"packet id {pid} already inflight")
+        self._d[pid] = (time.time(), value)
+
+    def update(self, pid: int, value: Any) -> None:
+        if pid not in self._d:
+            raise KeyError(pid)
+        ts, _ = self._d[pid]
+        self._d[pid] = (ts, value)
+
+    def touch(self, pid: int, now: Optional[float] = None) -> None:
+        """Reset the age clock (after a retransmission)."""
+        if pid not in self._d:
+            raise KeyError(pid)
+        _, v = self._d[pid]
+        self._d[pid] = (now if now is not None else time.time(), v)
+
+    def delete(self, pid: int) -> Optional[Any]:
+        item = self._d.pop(pid, None)
+        return item[1] if item is not None else None
+
+    def lookup(self, pid: int) -> Optional[Any]:
+        item = self._d.get(pid)
+        return item[1] if item is not None else None
+
+    def items(self) -> Iterator[Tuple[int, float, Any]]:
+        """(pid, inserted_at, value) in insertion order."""
+        for pid, (ts, v) in self._d.items():
+            yield pid, ts, v
+
+    def older_than(self, age_s: float, now: Optional[float] = None) -> List[int]:
+        now = now if now is not None else time.time()
+        return [pid for pid, (ts, _) in self._d.items() if now - ts >= age_s]

@@ -1,0 +1,249 @@
+"""Per-client session state: subscriptions, delivery, QoS 0/1/2 flows.
+
+Behavioral reference: ``apps/emqx/src/emqx_session.erl`` [U] (SURVEY.md
+§2.1): subscriptions map, inflight window for unacked QoS1/2, message
+queue for deferred deliveries, ``awaiting_rel`` for inbound QoS2
+exactly-once, packet-id allocation, retry with DUP, session expiry.
+
+The session is a pure state machine: methods return the packets the
+caller (channel/connection layer) must send, never performing IO.
+
+Outbound QoS flows::
+
+    QoS1: deliver → PUBLISH(pid) inflight → puback(pid) → done
+    QoS2: deliver → PUBLISH(pid) inflight → pubrec(pid) → PUBREL(pid)
+          → pubcomp(pid) → done
+
+Inbound QoS2 (exactly-once)::
+
+    recv PUBLISH(pid): awaiting_rel[pid] (dedup) → reply PUBREC
+    recv PUBREL(pid):  release → reply PUBCOMP
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .inflight import Inflight
+from .message import Message
+from .mqueue import MQueue
+
+__all__ = ["SubOpts", "Session", "Publish", "MAX_PACKET_ID"]
+
+MAX_PACKET_ID = 0xFFFF
+
+
+@dataclass(frozen=True)
+class SubOpts:
+    """MQTT subscription options (v5 §3.8.3.1)."""
+
+    qos: int = 0
+    nl: bool = False    # No Local
+    rap: bool = False   # Retain As Published
+    rh: int = 0         # Retain Handling (0/1/2)
+    share: Optional[str] = None  # $share group name
+    subid: Optional[int] = None  # Subscription Identifier
+
+
+@dataclass
+class Publish:
+    """An outbound PUBLISH the connection layer must send."""
+
+    pid: Optional[int]   # None for QoS0
+    msg: Message
+
+
+class Session:
+    def __init__(
+        self,
+        clientid: str,
+        clean_start: bool = True,
+        max_inflight: int = 32,
+        max_awaiting_rel: int = 100,
+        retry_interval: float = 30.0,
+        await_rel_timeout: float = 300.0,
+        expiry_interval: float = 0.0,
+        mqueue: Optional[MQueue] = None,
+    ) -> None:
+        self.clientid = clientid
+        self.clean_start = clean_start
+        self.created_at = time.time()
+        self.subscriptions: Dict[str, SubOpts] = {}
+        self.inflight = Inflight(max_inflight)
+        self.mqueue = mqueue if mqueue is not None else MQueue()
+        self.awaiting_rel: Dict[int, float] = {}  # inbound QoS2 pids
+        self.max_awaiting_rel = max_awaiting_rel
+        self.retry_interval = retry_interval
+        self.await_rel_timeout = await_rel_timeout
+        self.expiry_interval = expiry_interval
+        self._next_pid = 0
+
+    # ------------------------------------------------------------------
+    # subscriptions
+    # ------------------------------------------------------------------
+
+    def subscribe(self, flt: str, opts: SubOpts) -> bool:
+        """Returns True if this is a new subscription (vs an upgrade)."""
+        is_new = flt not in self.subscriptions
+        self.subscriptions[flt] = opts
+        return is_new
+
+    def unsubscribe(self, flt: str) -> bool:
+        return self.subscriptions.pop(flt, None) is not None
+
+    # ------------------------------------------------------------------
+    # packet ids
+    # ------------------------------------------------------------------
+
+    def next_packet_id(self) -> int:
+        """1..65535, skipping ids still inflight (emqx wraps the same way)."""
+        for _ in range(MAX_PACKET_ID):
+            self._next_pid = (self._next_pid % MAX_PACKET_ID) + 1
+            if not self.inflight.contains(self._next_pid):
+                return self._next_pid
+        raise RuntimeError("no free packet id")
+
+    # ------------------------------------------------------------------
+    # outbound delivery
+    # ------------------------------------------------------------------
+
+    def deliver(self, msgs: List[Message]) -> Tuple[List[Publish], List[Message]]:
+        """Accept routed messages for this session.
+
+        Returns (to_send, dropped): QoS0 always sends; QoS1/2 send while
+        the inflight window has room, else queue; queue overflow drops.
+        """
+        out: List[Publish] = []
+        dropped: List[Message] = []
+        for msg in msgs:
+            if msg.qos == 0:
+                out.append(Publish(None, msg))
+                continue
+            if self.inflight.is_full():
+                victim = self.mqueue.insert(msg)
+                if victim is not None:
+                    dropped.append(victim)
+                continue
+            pid = self.next_packet_id()
+            self.inflight.insert(pid, ("publish", msg))
+            out.append(Publish(pid, msg))
+        return out, dropped
+
+    def _dequeue(self) -> List[Publish]:
+        # expire first so drops are accounted in mqueue.dropped (and
+        # visible via Session.info()) like every other drop path
+        self.mqueue.filter_expired()
+        out: List[Publish] = []
+        while not self.inflight.is_full():
+            msg = self.mqueue.pop()
+            if msg is None:
+                break
+            pid = self.next_packet_id()
+            self.inflight.insert(pid, ("publish", msg))
+            out.append(Publish(pid, msg))
+        return out
+
+    def puback(self, pid: int) -> Tuple[Optional[Message], List[Publish]]:
+        """QoS1 ack.  Returns (acked message | None, next publishes)."""
+        item = self.inflight.lookup(pid)
+        if item is None or item[0] != "publish":
+            return None, []
+        self.inflight.delete(pid)
+        return item[1], self._dequeue()
+
+    def pubrec(self, pid: int) -> bool:
+        """QoS2 phase 1 ack; caller must send PUBREL(pid).  False if the
+        pid is unknown (protocol error — reply with reason 0x92)."""
+        item = self.inflight.lookup(pid)
+        if item is None or item[0] != "publish":
+            return False
+        # keep the slot (pid stays allocated) but drop the payload
+        self.inflight.update(pid, ("pubrel", None))
+        return True
+
+    def pubcomp(self, pid: int) -> Tuple[bool, List[Publish]]:
+        """QoS2 completion.  Returns (known?, next publishes)."""
+        item = self.inflight.lookup(pid)
+        if item is None or item[0] != "pubrel":
+            return False, []
+        self.inflight.delete(pid)
+        return True, self._dequeue()
+
+    def retry(self, now: Optional[float] = None) -> List[Tuple[int, str, Optional[Message]]]:
+        """Unacked items older than retry_interval, for re-send with DUP.
+
+        Returns [(pid, kind, msg|None)]: kind 'publish' → resend
+        PUBLISH(dup), kind 'pubrel' → resend PUBREL."""
+        out = []
+        for pid in self.inflight.older_than(self.retry_interval, now):
+            kind, msg = self.inflight.lookup(pid)
+            if kind == "publish":
+                msg = msg.clone(dup=True)
+                self.inflight.update(pid, (kind, msg))
+            self.inflight.touch(pid, now)  # one resend per retry_interval
+            out.append((pid, kind, msg))
+        return out
+
+    # ------------------------------------------------------------------
+    # inbound QoS2
+    # ------------------------------------------------------------------
+
+    def publish_qos2(self, pid: int, msg: Message) -> str:
+        """Register an inbound QoS2 PUBLISH.
+
+        Returns 'ok' (new, broker must route it), 'dup' (already awaiting
+        release — do NOT re-route), or 'full' (awaiting_rel overflow —
+        reply reason 0x9B quota exceeded)."""
+        if pid in self.awaiting_rel:
+            return "dup"
+        if len(self.awaiting_rel) >= self.max_awaiting_rel:
+            return "full"
+        self.awaiting_rel[pid] = time.time()
+        return "ok"
+
+    def pubrel_received(self, pid: int) -> bool:
+        """Inbound PUBREL; caller replies PUBCOMP.  False if unknown
+        (reply reason 0x92 packet-id-not-found)."""
+        return self.awaiting_rel.pop(pid, None) is not None
+
+    def expire_awaiting_rel(self, now: Optional[float] = None) -> List[int]:
+        now = now if now is not None else time.time()
+        stale = [
+            pid for pid, ts in self.awaiting_rel.items()
+            if now - ts >= self.await_rel_timeout
+        ]
+        for pid in stale:
+            del self.awaiting_rel[pid]
+        return stale
+
+    # ------------------------------------------------------------------
+    # takeover / resume (emqx_cm protocol, SURVEY.md §3.2)
+    # ------------------------------------------------------------------
+
+    def pending_count(self) -> int:
+        return len(self.mqueue) + len(self.inflight)
+
+    def resume_publishes(self) -> List[Publish]:
+        """On reconnect: re-send inflight (DUP) then drain the queue."""
+        out: List[Publish] = []
+        for pid, _, (kind, msg) in list(self.inflight.items()):
+            if kind == "publish" and msg is not None:
+                msg = msg.clone(dup=True)
+                self.inflight.update(pid, (kind, msg))
+                out.append(Publish(pid, msg))
+        out.extend(self._dequeue())
+        return out
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "clientid": self.clientid,
+            "clean_start": self.clean_start,
+            "created_at": self.created_at,
+            "subscriptions_cnt": len(self.subscriptions),
+            "inflight_cnt": len(self.inflight),
+            "mqueue_len": len(self.mqueue),
+            "mqueue_dropped": self.mqueue.dropped,
+            "awaiting_rel_cnt": len(self.awaiting_rel),
+        }

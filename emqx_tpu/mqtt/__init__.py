@@ -1,0 +1,6 @@
+"""MQTT protocol layer: packets, wire codec, channel FSM."""
+
+from . import packet
+from .frame import FrameError, Parser, parse_one, serialize
+
+__all__ = ["packet", "FrameError", "Parser", "parse_one", "serialize"]

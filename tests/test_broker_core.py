@@ -1,0 +1,385 @@
+"""Broker core behavioral tests: mqueue/inflight/session QoS flows,
+shared-sub strategies, hooks, end-to-end pub/sub dispatch — mirroring
+emqx_broker_SUITE / emqx_session_SUITE / emqx_shared_sub_SUITE coverage
+(SURVEY.md §4)."""
+
+import pytest
+
+from emqx_tpu.broker import (
+    Broker, Hooks, Inflight, InflightFullError, MQueue, Message, Publish,
+    Session, SharedSub, SubOpts, make_message, OK, STOP,
+)
+
+
+def msg(topic="t", qos=0, payload=b"x", sender="pub", **kw):
+    return make_message(sender, topic, payload, qos=qos, **kw)
+
+
+# ---------------------------------------------------------------------------
+# MQueue
+# ---------------------------------------------------------------------------
+
+def test_mqueue_fifo_and_bound():
+    q = MQueue(max_len=3)
+    for i in range(3):
+        assert q.insert(msg(payload=str(i).encode(), qos=1)) is None
+    victim = q.insert(msg(payload=b"3", qos=1))
+    assert victim is not None and victim.payload == b"0"  # oldest dropped
+    assert q.dropped == 1
+    assert [m.payload for m in q.to_list()] == [b"1", b"2", b"3"]
+    assert q.pop().payload == b"1"
+
+
+def test_mqueue_priorities():
+    q = MQueue(max_len=10, priorities={"hi": 2, "lo": 0})
+    q.insert(msg(topic="lo", qos=1, payload=b"a"))
+    q.insert(msg(topic="hi", qos=1, payload=b"b"))
+    q.insert(msg(topic="lo", qos=1, payload=b"c"))
+    assert q.pop().payload == b"b"  # higher priority first
+    assert q.pop().payload == b"a"
+
+
+def test_mqueue_priority_eviction():
+    q = MQueue(max_len=2, priorities={"hi": 1})
+    q.insert(msg(topic="lo", qos=1, payload=b"a"))
+    q.insert(msg(topic="lo", qos=1, payload=b"b"))
+    v = q.insert(msg(topic="hi", qos=1, payload=b"c"))
+    assert v.payload == b"a"  # low-prio oldest evicted for high-prio
+    # incoming low-prio with queue full of high-prio is itself dropped
+    q2 = MQueue(max_len=1, priorities={"hi": 1})
+    q2.insert(msg(topic="hi", qos=1, payload=b"h"))
+    v2 = q2.insert(msg(topic="lo", qos=1, payload=b"l"))
+    assert v2.payload == b"l"
+
+
+def test_mqueue_store_qos0():
+    q = MQueue(max_len=5, store_qos0=False)
+    v = q.insert(msg(qos=0))
+    assert v is not None and len(q) == 0
+    assert q.insert(msg(qos=1)) is None
+
+
+# ---------------------------------------------------------------------------
+# Inflight
+# ---------------------------------------------------------------------------
+
+def test_inflight_window():
+    f = Inflight(max_size=2)
+    f.insert(1, "a")
+    with pytest.raises(KeyError):
+        f.insert(1, "dup")
+    f.insert(2, "b")
+    assert f.is_full()
+    with pytest.raises(InflightFullError):
+        f.insert(3, "c")
+    assert f.delete(1) == "a"
+    assert f.lookup(2) == "b"
+    assert not f.is_full()
+
+
+# ---------------------------------------------------------------------------
+# Session QoS flows
+# ---------------------------------------------------------------------------
+
+def test_session_qos0_passthrough():
+    s = Session("c1")
+    out, dropped = s.deliver([msg(qos=0)])
+    assert len(out) == 1 and out[0].pid is None
+    assert not dropped and s.inflight.is_empty()
+
+
+def test_session_qos1_flow():
+    s = Session("c1", max_inflight=2)
+    out, _ = s.deliver([msg(qos=1), msg(qos=1), msg(qos=1)])
+    assert len(out) == 2           # window=2, third queued
+    assert len(s.mqueue) == 1
+    acked, more = s.puback(out[0].pid)
+    assert acked is not None
+    assert len(more) == 1          # queued message flushed into window
+    assert s.puback(9999) == (None, [])  # unknown pid ignored
+
+
+def test_session_qos2_outbound_flow():
+    s = Session("c1")
+    out, _ = s.deliver([msg(qos=2)])
+    pid = out[0].pid
+    assert s.pubrec(pid) is True
+    assert s.pubrec(pid) is False      # second PUBREC: already released
+    known, more = s.pubcomp(pid)
+    assert known and s.inflight.is_empty()
+    assert s.pubcomp(pid) == (False, [])
+
+
+def test_session_qos2_inbound_exactly_once():
+    s = Session("c1", max_awaiting_rel=2)
+    assert s.publish_qos2(10, msg(qos=2)) == "ok"
+    assert s.publish_qos2(10, msg(qos=2)) == "dup"   # dedup by packet id
+    assert s.publish_qos2(11, msg(qos=2)) == "ok"
+    assert s.publish_qos2(12, msg(qos=2)) == "full"  # quota exceeded
+    assert s.pubrel_received(10) is True
+    assert s.pubrel_received(10) is False
+    assert s.publish_qos2(10, msg(qos=2)) == "ok"    # pid reusable after rel
+
+
+def test_session_retry_sets_dup():
+    s = Session("c1", retry_interval=0.0)
+    out, _ = s.deliver([msg(qos=1)])
+    retries = s.retry()
+    assert len(retries) == 1
+    pid, kind, m = retries[0]
+    assert kind == "publish" and m.dup is True and pid == out[0].pid
+
+
+def test_session_packet_id_wraps_and_skips_inflight():
+    s = Session("c1", max_inflight=10)
+    s._next_pid = 65534
+    a = s.next_packet_id()
+    s.inflight.insert(a, ("publish", None))
+    assert a == 65535
+    b = s.next_packet_id()
+    assert b == 1  # wrapped past 65535
+    s.inflight.insert(b, ("publish", None))
+    s._next_pid = 65534
+    assert s.next_packet_id() == 2  # skips 65535 (inflight) and 1 (inflight)
+
+
+def test_session_resume_redelivers_dup():
+    s = Session("c1", max_inflight=1)
+    s.deliver([msg(qos=1, payload=b"a"), msg(qos=1, payload=b"b")])
+    pubs = s.resume_publishes()
+    assert pubs[0].msg.payload == b"a" and pubs[0].msg.dup is True
+    assert len(pubs) == 1  # window still full, 'b' stays queued
+
+
+# ---------------------------------------------------------------------------
+# SharedSub strategies
+# ---------------------------------------------------------------------------
+
+def _members(ss):
+    ss.subscribe("g", "t/#", "c1")
+    ss.subscribe("g", "t/#", "c2")
+    ss.subscribe("g", "t/#", "c3")
+
+
+def test_shared_round_robin():
+    ss = SharedSub("round_robin")
+    _members(ss)
+    picks = [ss.pick("g", "t/#", "t/x")[0] for _ in range(6)]
+    assert picks == ["c1", "c2", "c3", "c1", "c2", "c3"]
+
+
+def test_shared_sticky():
+    ss = SharedSub("sticky", seed=1)
+    _members(ss)
+    first = ss.pick("g", "t/#", "t/x")
+    assert all(ss.pick("g", "t/#", "t/y") == first for _ in range(5))
+    ss.unsubscribe("g", "t/#", first[0])
+    second = ss.pick("g", "t/#", "t/z")
+    assert second != first
+
+
+def test_shared_hash_strategies_deterministic():
+    for strat, key in [("hash_clientid", "sender"), ("hash_topic", "topic")]:
+        ss = SharedSub(strat)
+        _members(ss)
+        a = ss.pick("g", "t/#", "t/x", sender="s1")
+        assert all(
+            ss.pick("g", "t/#", "t/x", sender="s1") == a for _ in range(5)
+        )
+
+
+def test_shared_redispatch_on_nack():
+    ss = SharedSub("round_robin")
+    _members(ss)
+    accepted = []
+
+    def try_deliver(m):
+        accepted.append(m[0])
+        return m[0] == "c3"  # others nack
+
+    got = ss.dispatch_with_ack("g", "t/#", "t/x", try_deliver)
+    # redispatch never retries a nacked member and ends on the acceptor
+    assert got[0] == "c3"
+    assert accepted[-1] == "c3" and len(accepted) == len(set(accepted))
+
+    got = ss.dispatch_with_ack("g", "t/#", "t/x", lambda m: False)
+    assert got is None  # every member nacked
+
+
+def test_shared_local_strategy():
+    ss = SharedSub("local", seed=3)
+    ss.subscribe("g", "t", "c1", node="n1")
+    ss.subscribe("g", "t", "c2", node="n2")
+    for _ in range(5):
+        assert ss.pick("g", "t", "t", local_node="n2")[0] == "c2"
+
+
+# ---------------------------------------------------------------------------
+# Hooks
+# ---------------------------------------------------------------------------
+
+def test_hooks_priority_and_stop():
+    h = Hooks()
+    calls = []
+    h.add("p", lambda: calls.append("low"), priority=0)
+    h.add("p", lambda: calls.append("hi"), priority=10)
+    assert h.run("p") == OK
+    assert calls == ["hi", "low"]
+
+    h2 = Hooks()
+    h2.add("p", lambda: STOP, priority=5)
+    h2.add("p", lambda: calls.append("never"), priority=0)
+    assert h2.run("p") == STOP
+    assert "never" not in calls
+
+
+def test_hooks_run_fold():
+    h = Hooks()
+    h.add("m", lambda acc: (OK, acc + 1))
+    h.add("m", lambda acc: (STOP, acc * 10))
+    h.add("m", lambda acc: (OK, acc + 999))  # after STOP: not run
+    assert h.run_fold("m", (), 1) == 20
+
+
+def test_hooks_delete():
+    h = Hooks()
+    fn = lambda: None
+    h.add("p", fn, name="x")
+    assert h.delete("p", "x") is True
+    assert h.callbacks("p") == []
+
+
+# ---------------------------------------------------------------------------
+# Broker end-to-end
+# ---------------------------------------------------------------------------
+
+def test_broker_pubsub_roundtrip():
+    b = Broker()
+    b.open_session("sub1")
+    b.open_session("sub2")
+    b.subscribe("sub1", "sensors/+/temp", SubOpts(qos=1))
+    b.subscribe("sub2", "sensors/#", SubOpts(qos=0))
+    res = b.publish(msg(topic="sensors/kitchen/temp", qos=1))
+    assert res.matched == 2
+    assert res.publishes["sub1"][0].pid is not None       # QoS1 capped at 1
+    assert res.publishes["sub2"][0].pid is None           # QoS capped to 0
+    res2 = b.publish(msg(topic="other/x"))
+    assert res2.no_subscribers
+
+
+def test_broker_qos_cap_is_min():
+    b = Broker()
+    b.open_session("s")
+    b.subscribe("s", "t", SubOpts(qos=2))
+    res = b.publish(msg(topic="t", qos=1))
+    assert res.publishes["s"][0].msg.qos == 1  # min(pub 1, sub 2)
+
+
+def test_broker_no_local():
+    b = Broker()
+    b.open_session("c1")
+    b.subscribe("c1", "t", SubOpts(nl=True))
+    res = b.publish(msg(topic="t", sender="c1"))
+    assert "c1" not in res.publishes
+    res2 = b.publish(msg(topic="t", sender="other"))
+    assert "c1" in res2.publishes
+
+
+def test_broker_shared_group_single_delivery():
+    b = Broker(shared_strategy="round_robin")
+    for c in ("c1", "c2"):
+        b.open_session(c)
+        b.subscribe(c, "$share/g/t/#", SubOpts(qos=1))
+    res1 = b.publish(msg(topic="t/x"))
+    res2 = b.publish(msg(topic="t/x"))
+    got = [list(r.publishes) for r in (res1, res2)]
+    assert got == [["c1"], ["c2"]]  # one member per publish, round robin
+
+
+def test_broker_shared_and_plain_coexist():
+    b = Broker()
+    b.open_session("plain")
+    b.open_session("shared")
+    b.subscribe("plain", "t/#", SubOpts())
+    b.subscribe("shared", "$share/g/t/#", SubOpts())
+    res = b.publish(msg(topic="t/1"))
+    assert set(res.publishes) == {"plain", "shared"}
+
+
+def test_broker_unsubscribe_cleans_routes():
+    b = Broker()
+    b.open_session("c")
+    b.subscribe("c", "a/+", SubOpts())
+    assert b.router.route_count() == 1
+    b.unsubscribe("c", "a/+")
+    assert b.router.route_count() == 0
+    assert b.publish(msg(topic="a/b")).no_subscribers
+
+
+def test_broker_session_takeover_discard():
+    b = Broker()
+    s1, present = b.open_session("c", clean_start=True)
+    assert not present
+    b.subscribe("c", "t", SubOpts())
+    s2, present = b.open_session("c", clean_start=False)
+    assert present and s2 is s1                      # resumed
+    s3, present = b.open_session("c", clean_start=True)
+    assert not present and s3 is not s1              # discarded
+    assert b.router.route_count() == 0               # old subs dropped
+
+
+def test_broker_mqtt5_publish_hook_veto():
+    b = Broker()
+    b.open_session("c")
+    b.subscribe("c", "t", SubOpts())
+
+    def deny(m):
+        m.headers["allow_publish"] = False
+        return (STOP, m)
+
+    b.hooks.add("message.publish", deny)
+    res = b.publish(msg(topic="t"))
+    assert res.publishes == {} and res.no_subscribers
+
+
+def test_broker_sys_topic_protection_end_to_end():
+    b = Broker()
+    b.open_session("c")
+    b.subscribe("c", "#", SubOpts())
+    res = b.publish(msg(topic="$SYS/broker/uptime"))
+    assert res.no_subscribers
+
+
+def test_broker_stats():
+    b = Broker()
+    b.open_session("c")
+    b.subscribe("c", "a", SubOpts())
+    b.subscribe("c", "$share/g/b", SubOpts())
+    st = b.stats()
+    assert st["sessions.count"] == 1
+    assert st["subscriptions.count"] == 2
+    assert st["routes.count"] == 2
+    assert st["shared_groups.count"] == 1
+
+
+def test_queue_legacy_shared_sub_delivers():
+    b = Broker()
+    b.open_session("c1")
+    b.subscribe("c1", "$queue/jobs", SubOpts(qos=1))
+    res = b.publish(msg(topic="jobs", qos=1))
+    assert "c1" in res.publishes
+
+
+def test_expired_queued_messages_accounted():
+    b = Broker()
+    s, _ = b.open_session("c", max_inflight=1)
+    b.subscribe("c", "t", SubOpts(qos=1))
+    b.publish(msg(topic="t", qos=1))  # fills window
+    b.publish(msg(topic="t", qos=1,
+                  properties={"Message-Expiry-Interval": 0}))  # queued, expires
+    import time
+    time.sleep(0.01)
+    dropped_before = s.mqueue.dropped
+    _, more = s.puback(1)
+    assert more == []                     # expired message not delivered
+    assert s.mqueue.dropped == dropped_before + 1

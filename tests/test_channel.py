@@ -1,0 +1,328 @@
+"""Channel FSM integration tests: full CONNECT/SUB/PUB round trips at the
+packet level — the in-VM integration style of emqx CT suites (SURVEY.md
+§4) without protocol mocks."""
+
+import pytest
+
+from emqx_tpu.broker import Broker, SubOpts
+from emqx_tpu.broker.cm import ConnectionManager
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.mqtt import packet as P
+
+
+def mk(broker=None, **kw):
+    broker = broker or Broker()
+    cm = ConnectionManager(broker)
+    return broker, cm, Channel(broker, cm, **kw)
+
+
+def connect(ch, clientid="c1", ver=4, **kw):
+    return ch.handle_in(P.Connect(proto_ver=ver, clientid=clientid, **kw))
+
+
+def sends(actions):
+    return [a[1] for a in actions if a[0] == "send"]
+
+
+def test_connect_connack():
+    _, _, ch = mk()
+    acts = connect(ch)
+    (ack,) = sends(acts)
+    assert ack.type == P.CONNACK and ack.reason_code == 0
+    assert not ack.session_present
+    assert ch.state == "connected"
+
+
+def test_packet_before_connect_closes():
+    _, _, ch = mk()
+    acts = ch.handle_in(P.PingReq())
+    assert acts[0][0] == "close"
+
+
+def test_duplicate_connect_closes():
+    _, _, ch = mk()
+    connect(ch)
+    assert ch.handle_in(P.Connect(clientid="c1"))[0][0] == "close"
+
+
+def test_v5_assigned_clientid():
+    _, _, ch = mk()
+    (ack,) = sends(connect(ch, clientid="", ver=5))
+    assert "Assigned-Client-Identifier" in ack.properties
+    assert ch.clientid.startswith("emqx_tpu_")
+
+
+def test_v3_empty_clientid_no_cleanstart_rejected():
+    _, _, ch = mk()
+    acts = connect(ch, clientid="", clean_start=False)
+    ack = sends(acts)[0]
+    assert ack.reason_code != 0
+    assert acts[-1][0] == "close"
+
+
+def test_auth_hook_rejects():
+    b, _, ch = mk()
+    b.hooks.add(
+        "client.authenticate",
+        lambda cid, u, pw, info, acc: (P.RC.BAD_USER_NAME_OR_PASSWORD
+                                       if pw != b"secret" else acc),
+    )
+    acts = connect(ch, username="u", password=b"wrong")
+    assert sends(acts)[0].reason_code == 4  # v3 bad credentials
+    b2, _, ch2 = mk()
+    b2.hooks.add(
+        "client.authenticate",
+        lambda cid, u, pw, info, acc: acc if pw == b"secret" else 0x86,
+    )
+    acts = connect(ch2, username="u", password=b"secret")
+    assert sends(acts)[0].reason_code == 0
+
+
+def test_subscribe_publish_roundtrip():
+    b, cm, ch_sub = mk()
+    ch_pub = Channel(b, cm)
+    connect(ch_sub, "sub")
+    connect(ch_pub, "pub")
+    (suback,) = sends(
+        ch_sub.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t/+", {"qos": 1})]))
+    )
+    assert suback.reason_codes == [1]
+    acts = ch_pub.handle_in(
+        P.Publish(topic="t/x", qos=1, packet_id=9, payload=b"hi")
+    )
+    (puback,) = sends(acts)
+    assert puback.type == P.PUBACK and puback.packet_id == 9
+    # delivery to subscriber goes through broker result → channel
+    sess = b.sessions["sub"]
+    assert len(sess.inflight) == 1
+
+
+def test_qos2_inbound_exactly_once():
+    b, cm, ch = mk()
+    connect(ch, "c")
+    deliveries = []
+    b.hooks.add("message.publish", lambda m: deliveries.append(m) or m)
+    pub = P.Publish(topic="t", qos=2, packet_id=5, payload=b"x")
+    (rec,) = sends(ch.handle_in(pub))
+    assert rec.type == P.PUBREC
+    # duplicate PUBLISH same pid: PUBREC again but NOT re-published
+    (rec2,) = sends(ch.handle_in(pub))
+    assert rec2.type == P.PUBREC
+    assert len(deliveries) == 1
+    (comp,) = sends(ch.handle_in(P.PubAck(P.PUBREL, 5)))
+    assert comp.type == P.PUBCOMP
+    # after release the pid is fresh
+    sends(ch.handle_in(pub))
+    assert len(deliveries) == 2
+
+
+def test_qos2_outbound_flow():
+    b, cm, ch = mk()
+    connect(ch, "s")
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 2})]))
+    res = b.publish(
+        __import__("emqx_tpu.broker", fromlist=["make_message"]).make_message(
+            "pub", "t", b"x", qos=2
+        )
+    )
+    (pub,) = ch.handle_deliver(res.publishes["s"])
+    pub = pub[1] if isinstance(pub, tuple) else pub
+    assert pub.qos == 2
+    (rel,) = sends(ch.handle_in(P.PubAck(P.PUBREC, pub.packet_id)))
+    assert rel.type == P.PUBREL
+    assert sends(ch.handle_in(P.PubAck(P.PUBCOMP, pub.packet_id))) == []
+    assert b.sessions["s"].inflight.is_empty()
+
+
+def test_unsubscribe():
+    b, cm, ch = mk()
+    connect(ch, "c")
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("a", {"qos": 0})]))
+    (unsuback,) = sends(
+        ch.handle_in(P.Unsubscribe(packet_id=2, topic_filters=["a", "nope"]))
+    )
+    assert unsuback.reason_codes == [0, 0x11]
+
+
+def test_authz_hook_denies_subscribe_and_publish():
+    b, cm, ch = mk()
+    b.hooks.add(
+        "client.authorize",
+        lambda cid, action, topic, acc: False if topic.startswith("secret") else acc,
+    )
+    connect(ch, "c")
+    (suback,) = sends(
+        ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[
+            ("secret/x", {"qos": 0}), ("open/x", {"qos": 0})]))
+    )
+    assert suback.reason_codes == [P.RC.NOT_AUTHORIZED, 0]
+    (puback,) = sends(
+        ch.handle_in(P.Publish(topic="secret/t", qos=1, packet_id=3))
+    )
+    assert puback.reason_code == P.RC.NOT_AUTHORIZED
+
+
+def test_invalid_topic_filter_in_subscribe():
+    b, cm, ch = mk()
+    connect(ch, "c")
+    (suback,) = sends(
+        ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("a/#/b", {"qos": 0})]))
+    )
+    assert suback.reason_codes == [P.RC.TOPIC_FILTER_INVALID]
+
+
+def test_topic_alias_v5():
+    b, cm, ch = mk()
+    connect(ch, "c", ver=5)
+    got = []
+    b.hooks.add("message.publish", lambda m: got.append(m.topic) or m)
+    ch.handle_in(P.Publish(topic="long/topic", payload=b"1",
+                           properties={"Topic-Alias": 3}))
+    ch.handle_in(P.Publish(topic="", payload=b"2",
+                           properties={"Topic-Alias": 3}))
+    assert got == ["long/topic", "long/topic"]
+    acts = ch.handle_in(P.Publish(topic="", payload=b"3",
+                                  properties={"Topic-Alias": 99}))
+    assert acts[0][0] == "close"  # alias above maximum
+
+
+def test_will_published_on_abnormal_close_only():
+    b, cm, ch = mk()
+    got = []
+    b.hooks.add("message.publish", lambda m: got.append(m.topic) or m)
+    connect(ch, "c", will=P.Will("will/t", b"gone"))
+    ch2_actions = ch.handle_in(P.Disconnect())  # normal disconnect
+    ch.handle_close("client disconnect")
+    assert got == []  # will discarded
+    # abnormal close fires the will
+    b2, cm2, chx = mk()
+    got2 = []
+    b2.hooks.add("message.publish", lambda m: got2.append(m.topic) or m)
+    chx.handle_in(P.Connect(clientid="c", will=P.Will("will/t", b"gone")))
+    chx.handle_close("socket error")
+    assert got2 == ["will/t"]
+
+
+def test_disconnect_with_will_0x04():
+    b, cm, ch = mk()
+    got = []
+    b.hooks.add("message.publish", lambda m: got.append(m.topic) or m)
+    connect(ch, "c", ver=5, will=P.Will("w", b"x"))
+    ch.handle_in(P.Disconnect(reason_code=0x04))
+    ch.handle_close()
+    assert got == ["w"]
+
+
+def test_session_takeover():
+    b, cm, ch1 = mk()
+    connect(ch1, "dev1", clean_start=False)
+    ch1.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 1})]))
+    ch2 = Channel(b, cm)
+    acts = ch2.handle_in(P.Connect(clientid="dev1", clean_start=False))
+    takeovers = [a for a in acts if a[0] == "takeover"]
+    assert takeovers and takeovers[0][1] is ch1
+    ack = sends(acts)[0]
+    assert ack.session_present
+    # displaced channel: v3 gets plain close, no will
+    old_acts = ch1.handle_takeover()
+    assert old_acts[-1][0] == "close"
+    # late close of displaced channel must not evict the new one
+    ch1.handle_close("displaced")
+    assert cm.lookup_channel("dev1") is ch2
+    assert "t" in b.sessions["dev1"].subscriptions
+
+
+def test_keepalive_timeout():
+    b, cm, ch = mk()
+    connect(ch, "c", keepalive=10)
+    assert ch.check_keepalive(now=ch.last_rx + 14) == []
+    acts = ch.check_keepalive(now=ch.last_rx + 16)
+    assert acts and acts[0][0] == "close"
+
+
+def test_keepalive_zero_never_times_out():
+    b, cm, ch = mk()
+    connect(ch, "c", keepalive=0)
+    assert ch.check_keepalive(now=ch.last_rx + 1e9) == []
+
+
+def test_retry_resends_dup():
+    b, cm, ch = mk()
+    connect(ch, "s")
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 1})]))
+    from emqx_tpu.broker import make_message
+    res = b.publish(make_message("p", "t", b"x", qos=1))
+    ch.handle_deliver(res.publishes["s"])
+    b.sessions["s"].retry_interval = 0.0
+    (resend,) = sends(ch.retry_deliveries())
+    assert resend.type == P.PUBLISH and resend.dup is True
+
+
+def test_ping():
+    b, cm, ch = mk()
+    connect(ch, "c")
+    (resp,) = sends(ch.handle_in(P.PingReq()))
+    assert resp.type == P.PINGRESP
+
+
+def test_late_close_of_displaced_channel_keeps_new_session():
+    """A displaced channel closing late must not destroy the successor's
+    live session (clean_start=True path)."""
+    b, cm, ch1 = mk()
+    connect(ch1, "dev", clean_start=True)
+    ch2 = Channel(b, cm)
+    ch2.handle_in(P.Connect(clientid="dev", clean_start=True))
+    ch2.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 0})]))
+    ch1.handle_takeover()
+    ch1.handle_close("displaced")
+    assert "dev" in b.sessions
+    assert "t" in b.sessions["dev"].subscriptions
+    assert cm.lookup_channel("dev") is ch2
+
+
+def test_receive_maximum_zero_is_protocol_error():
+    _, _, ch = mk()
+    acts = connect(ch, "c", ver=5, properties={"Receive-Maximum": 0})
+    assert sends(acts)[0].reason_code == P.RC.PROTOCOL_ERROR
+    assert acts[-1][0] == "close"
+
+
+def test_resume_renegotiates_receive_maximum():
+    b, cm, ch1 = mk()
+    connect(ch1, "c", ver=5, clean_start=False,
+            properties={"Receive-Maximum": 32})
+    assert b.sessions["c"].inflight.max_size == 32
+    ch2 = Channel(b, cm)
+    ch2.handle_in(P.Connect(proto_ver=5, clientid="c", clean_start=False,
+                            properties={"Receive-Maximum": 1}))
+    assert b.sessions["c"].inflight.max_size == 1
+
+
+def test_takenover_hook_only_on_resume():
+    b, cm, ch1 = mk()
+    events = []
+    b.hooks.add("session.takenover", lambda cid: events.append("takenover"))
+    b.hooks.add("session.discarded", lambda cid: events.append("discarded"))
+    connect(ch1, "c", clean_start=True)
+    ch2 = Channel(b, cm)
+    ch2.handle_in(P.Connect(clientid="c", clean_start=True))
+    assert events == ["discarded"]
+    ch3 = Channel(b, cm)
+    ch3.handle_in(P.Connect(clientid="c", clean_start=False))
+    assert events == ["discarded", "takenover"]
+
+
+def test_retry_once_per_interval():
+    b, cm, ch = mk()
+    connect(ch, "s")
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("t", {"qos": 1})]))
+    from emqx_tpu.broker import make_message
+    res = b.publish(make_message("p", "t", b"x", qos=1))
+    ch.handle_deliver(res.publishes["s"])
+    sess = b.sessions["s"]
+    sess.retry_interval = 10.0
+    import time as _t
+    now = _t.time()
+    assert len(sess.retry(now + 11)) == 1
+    assert sess.retry(now + 12) == []          # touched: not due again yet
+    assert len(sess.retry(now + 22)) == 1      # due again a full interval later

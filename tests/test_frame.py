@@ -1,0 +1,218 @@
+"""Wire codec tests: explicit spec vectors + parse∘serialize round-trip
+property tests (prop_emqx_frame style, SURVEY.md §4)."""
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from emqx_tpu.mqtt import FrameError, Parser, parse_one, serialize
+from emqx_tpu.mqtt import packet as P
+
+
+def roundtrip(pkt, ver=4):
+    return parse_one(serialize(pkt, ver), ver)
+
+
+# ---------------------------------------------------------------------------
+# explicit vectors
+# ---------------------------------------------------------------------------
+
+def test_connect_311_wire():
+    # canonical 3.1.1 CONNECT from the spec examples
+    raw = serialize(P.Connect(clientid="c1", keepalive=30))
+    assert raw[0] == 0x10
+    pkt = parse_one(raw)
+    assert pkt.clientid == "c1" and pkt.proto_ver == 4 and pkt.clean_start
+
+
+def test_connect_with_will_and_auth():
+    pkt = P.Connect(
+        clientid="c", clean_start=False, keepalive=10,
+        will=P.Will("w/t", b"bye", qos=1, retain=True),
+        username="u", password=b"p",
+    )
+    got = roundtrip(pkt)
+    assert got == pkt
+
+
+def test_connect_v5_properties():
+    pkt = P.Connect(
+        proto_ver=5, clientid="c5",
+        properties={"Session-Expiry-Interval": 3600, "Receive-Maximum": 20,
+                    "User-Property": [("a", "1"), ("a", "2")]},
+        will=P.Will("w", b"x", properties={"Will-Delay-Interval": 5}),
+    )
+    assert roundtrip(pkt, 5) == pkt
+
+
+def test_publish_qos_levels():
+    p0 = P.Publish(topic="t", qos=0, payload=b"hello")
+    assert roundtrip(p0) == p0
+    p1 = P.Publish(topic="t", qos=1, packet_id=7, payload=b"x", dup=True, retain=True)
+    assert roundtrip(p1) == p1
+    with pytest.raises(FrameError):
+        serialize(P.Publish(topic="t", qos=1, packet_id=None))
+
+
+def test_publish_v5_topic_alias():
+    p = P.Publish(topic="", qos=0, payload=b"z", properties={"Topic-Alias": 4})
+    assert roundtrip(p, 5) == p
+
+
+def test_puback_family():
+    for t in (P.PUBACK, P.PUBREC, P.PUBREL, P.PUBCOMP):
+        pkt = P.PubAck(t, packet_id=9)
+        got = roundtrip(pkt)
+        assert got.type == t and got.packet_id == 9
+    v5 = P.PubAck(P.PUBACK, 3, P.RC.NO_MATCHING_SUBSCRIBERS, {"Reason-String": "n"})
+    assert roundtrip(v5, 5) == v5
+
+
+def test_pubrel_flags_enforced():
+    raw = bytearray(serialize(P.PubAck(P.PUBREL, 1)))
+    assert raw[0] == 0x62
+    raw[0] = 0x60  # clear required 0b0010 flags
+    with pytest.raises(FrameError):
+        parse_one(bytes(raw))
+
+
+def test_subscribe_roundtrip_v3_v5():
+    s3 = P.Subscribe(packet_id=5, topic_filters=[("a/+", {"qos": 1}), ("b/#", {"qos": 2})])
+    g3 = roundtrip(s3)
+    assert [(f, o["qos"]) for f, o in g3.topic_filters] == [("a/+", 1), ("b/#", 2)]
+    s5 = P.Subscribe(
+        packet_id=5,
+        topic_filters=[("a", {"qos": 1, "nl": 1, "rap": 1, "rh": 2})],
+        properties={"Subscription-Identifier": 99},
+    )
+    assert roundtrip(s5, 5) == s5
+
+
+def test_empty_subscribe_is_protocol_error():
+    raw = serialize(P.Subscribe(packet_id=1, topic_filters=[("a", {"qos": 0})]))
+    # strip the single filter (2+1 utf8 len + 1 opts byte = 4+... ) manually:
+    bad = bytes([0x82, 2, 0, 1])
+    with pytest.raises(FrameError):
+        parse_one(bad)
+
+
+def test_suback_unsub_roundtrip():
+    sa = P.Suback(packet_id=2, reason_codes=[0, 1, 0x80])
+    assert roundtrip(sa) == sa
+    u = P.Unsubscribe(packet_id=3, topic_filters=["a", "b/#"])
+    assert roundtrip(u) == u
+    ua5 = P.Unsuback(packet_id=3, reason_codes=[0, 17])
+    assert roundtrip(ua5, 5) == ua5
+
+
+def test_ping_disconnect_auth():
+    assert roundtrip(P.PingReq()).type == P.PINGREQ
+    assert roundtrip(P.PingResp()).type == P.PINGRESP
+    d = P.Disconnect(reason_code=P.RC.SESSION_TAKEN_OVER, properties={"Reason-String": "t"})
+    assert roundtrip(d, 5) == d
+    assert roundtrip(P.Disconnect()).reason_code == 0
+    a = P.Auth(reason_code=0x18, properties={"Authentication-Method": "SCRAM"})
+    assert roundtrip(a, 5) == a
+
+
+# ---------------------------------------------------------------------------
+# streaming / incremental
+# ---------------------------------------------------------------------------
+
+def test_streaming_partial_feed():
+    raw = serialize(P.Publish(topic="t/1", qos=1, packet_id=2, payload=b"abc"))
+    raw += serialize(P.PingReq())
+    p = Parser()
+    got = []
+    for i in range(len(raw)):
+        got += p.feed(raw[i : i + 1])  # one byte at a time
+    assert [g.type for g in got] == [P.PUBLISH, P.PINGREQ]
+    assert got[0].payload == b"abc"
+
+
+def test_parser_upgrades_to_v5_after_connect():
+    p = Parser()
+    c = P.Connect(proto_ver=5, clientid="x")
+    pub5 = P.Publish(topic="t", payload=b"", properties={"Topic-Alias": 1})
+    got = p.feed(serialize(c, 5) + serialize(pub5, 5))
+    assert got[0].proto_ver == 5
+    assert got[1].properties == {"Topic-Alias": 1}
+
+
+def test_max_packet_size_enforced():
+    p = Parser(max_packet_size=16)
+    big = serialize(P.Publish(topic="t", payload=b"x" * 64))
+    with pytest.raises(FrameError) as e:
+        p.feed(big)
+    assert e.value.reason_code == P.RC.PACKET_TOO_LARGE
+
+
+def test_malformed_qos3():
+    raw = bytearray(serialize(P.Publish(topic="t", qos=2, packet_id=1)))
+    raw[0] |= 0x06  # qos bits = 3
+    with pytest.raises(FrameError):
+        parse_one(bytes(raw))
+
+
+def test_connect_reserved_flag():
+    raw = bytearray(serialize(P.Connect(clientid="c")))
+    # connect flags byte: proto name(6) + ver(1) -> offset = 2 + 6 + 1
+    raw[9] |= 0x01
+    with pytest.raises(FrameError):
+        parse_one(bytes(raw))
+
+
+# ---------------------------------------------------------------------------
+# property round-trips
+# ---------------------------------------------------------------------------
+
+topic_st = st.text(
+    alphabet=st.characters(min_codepoint=33, max_codepoint=126), min_size=1, max_size=12
+)
+payload_st = st.binary(max_size=64)
+
+
+@settings(max_examples=200, deadline=None)
+@given(topic_st, payload_st, st.integers(0, 2), st.booleans(), st.booleans())
+def test_publish_roundtrip_prop(topic, payload, qos, dup, retain):
+    pkt = P.Publish(
+        topic=topic, qos=qos, payload=payload, dup=dup, retain=retain,
+        packet_id=11 if qos else None,
+    )
+    for ver in (4, 5):
+        assert roundtrip(pkt, ver) == pkt
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    st.text(max_size=10), st.integers(0, 0xFFFF), st.booleans(),
+    st.one_of(st.none(), st.text(max_size=5)),
+    st.one_of(st.none(), st.binary(max_size=5)),
+)
+def test_connect_roundtrip_prop(cid, keepalive, clean, user, pw):
+    pkt = P.Connect(
+        clientid=cid, keepalive=keepalive, clean_start=clean,
+        username=user, password=pw,
+    )
+    assert roundtrip(pkt) == pkt
+
+
+@settings(max_examples=100, deadline=None)
+@given(st.lists(st.tuples(topic_st, st.integers(0, 2)), min_size=1, max_size=5))
+def test_subscribe_roundtrip_prop(filters):
+    pkt = P.Subscribe(
+        packet_id=1, topic_filters=[(f, {"qos": q}) for f, q in filters]
+    )
+    assert roundtrip(pkt) == pkt
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.binary(max_size=200))
+def test_parser_never_crashes_on_garbage(data):
+    """Garbage either parses, needs more bytes, or raises FrameError —
+    never any other exception (the connection layer maps FrameError to a
+    DISCONNECT)."""
+    p = Parser()
+    try:
+        p.feed(data)
+    except FrameError:
+        pass
