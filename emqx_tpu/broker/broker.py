@@ -216,9 +216,7 @@ class Broker:
                 opts = sess.subscriptions.get(f"{T.QUEUE_PREFIX}/{flt}")
             if opts is None:
                 return False
-            before = len(res.dropped)
-            self._deliver_to(clientid, opts, msg, res)
-            return len(res.dropped) == before  # nack if it was dropped
+            return self._deliver_to(clientid, opts, msg, res)
 
         member = self.shared.dispatch_with_ack(
             group, flt, msg.topic, try_deliver, msg.sender, self.node
@@ -228,14 +226,21 @@ class Broker:
 
     def _deliver_to(
         self, clientid: str, opts: SubOpts, msg: Message, res: DeliverResult
-    ) -> None:
+    ) -> bool:
+        """Returns True iff *this* message was accepted (sent or queued) —
+        a queue eviction of an older message is not a nack."""
         sess = self.sessions.get(clientid)
         if sess is None:
-            return
+            return False
         eff = msg.with_qos(min(msg.qos, opts.qos))
-        if not opts.rap and not msg.dup:
+        if not opts.rap:
             # Retain-As-Published off → clear retain flag on forward
             eff = eff.clone(retain=False) if eff.retain else eff
+        if opts.subid is not None:
+            # MQTT5 §3.3.4: echo the Subscription-Identifier with deliveries
+            eff = eff.clone(
+                properties={**eff.properties, "Subscription-Identifier": opts.subid}
+            )
         sends, dropped = sess.deliver([eff])
         if sends:
             res.matched += 1
@@ -244,6 +249,7 @@ class Broker:
         for d in dropped:
             res.dropped.append((clientid, d))
             self.hooks.run("message.dropped", (d, "queue_full"))
+        return all(d.id != eff.id for d in dropped)
 
     # ------------------------------------------------------------------
 

@@ -292,9 +292,9 @@ class Channel:
     # ------------------------------------------------------------------
 
     def _handle_disconnect(self, pkt: P.Disconnect) -> List[Action]:
-        if pkt.reason_code == 0x04:  # disconnect-with-will
-            pass  # keep will for publication on close
-        else:
+        # MQTT5 §3.1.2.5/§3.14: only a normal disconnect (0x00) deletes the
+        # will; 0x04 and every other non-zero reason publish it on close.
+        if pkt.reason_code == 0:
             self.will = None
         expiry = pkt.properties.get("Session-Expiry-Interval")
         if expiry is not None and self.session is not None:
@@ -354,7 +354,7 @@ class Channel:
                 if k in (
                     "Payload-Format-Indicator", "Message-Expiry-Interval",
                     "Content-Type", "Response-Topic", "Correlation-Data",
-                    "User-Property",
+                    "User-Property", "Subscription-Identifier",
                 )
             } if self.proto_ver == 5 else {},
         )

@@ -195,7 +195,7 @@ class Session:
 
         Returns 'ok' (new, broker must route it), 'dup' (already awaiting
         release — do NOT re-route), or 'full' (awaiting_rel overflow —
-        reply reason 0x9B quota exceeded)."""
+        reply reason 0x97 quota exceeded)."""
         if pid in self.awaiting_rel:
             return "dup"
         if len(self.awaiting_rel) >= self.max_awaiting_rel:

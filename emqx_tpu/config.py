@@ -1,0 +1,469 @@
+"""Typed, layered configuration — the ``emqx_config``/``emqx_schema``/
+``hocon`` analog.
+
+Behavioral reference (SURVEY.md §5.6): HOCON config files checked against
+a typed schema, layered **defaults → file → environment → runtime API**,
+with zone override sets and a change handler that validates before
+applying (hot update).  Environment overrides use the reference's naming:
+``EMQX_MQTT__MAX_PACKET_SIZE=2MB`` ⇒ ``mqtt.max_packet_size``.
+
+The file syntax is a HOCON subset (the part emqx.conf actually uses):
+``a.b = v`` and ``a { b = v }`` nesting, ``#``/``//`` comments, strings
+(quoted or bare), numbers, booleans, durations (``15s``, ``2m``, ``1h``),
+byte sizes (``1MB``, ``64KB``), and ``[a, b]`` arrays.
+
+Schema entries are :class:`Field` records (type, default, validator);
+unknown keys are rejected at load, exactly like the reference's
+schema-checked boot.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["Field", "Config", "SCHEMA", "parse_hocon", "duration", "bytesize"]
+
+
+# ---------------------------------------------------------------------------
+# value parsers
+
+_DUR = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+_SIZE = {"b": 1, "kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30}
+
+
+def duration(v: Any) -> float:
+    """'15s' → 15.0 (seconds). Numbers pass through as seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = re.fullmatch(r"\s*([\d.]+)\s*(ms|s|m|h|d)\s*", str(v))
+    if not m:
+        raise ValueError(f"bad duration {v!r}")
+    return float(m.group(1)) * _DUR[m.group(2)]
+
+
+def bytesize(v: Any) -> int:
+    """'1MB' → 1048576. Numbers pass through as bytes."""
+    if isinstance(v, (int, float)):
+        return int(v)
+    m = re.fullmatch(r"\s*([\d.]+)\s*(b|kb|mb|gb)?\s*", str(v), re.I)
+    if not m:
+        raise ValueError(f"bad size {v!r}")
+    return int(float(m.group(1)) * _SIZE[(m.group(2) or "b").lower()])
+
+
+def _bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).lower()
+    if s in ("true", "1", "on", "yes"):
+        return True
+    if s in ("false", "0", "off", "no"):
+        return False
+    raise ValueError(f"bad bool {v!r}")
+
+
+# ---------------------------------------------------------------------------
+# schema
+
+@dataclass(frozen=True)
+class Field:
+    """One schema leaf: parse/validate + default."""
+
+    default: Any
+    parse: Callable[[Any], Any] = lambda v: v
+    check: Optional[Callable[[Any], bool]] = None
+    doc: str = ""
+
+    def coerce(self, path: str, v: Any) -> Any:
+        try:
+            out = self.parse(v)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"{path}: {e}") from None
+        if self.check is not None and not self.check(out):
+            raise ValueError(f"{path}: value {out!r} out of range")
+        return out
+
+
+def _enum(*allowed: str) -> Callable[[Any], Any]:
+    def parse(v):
+        if v not in allowed:
+            raise ValueError(f"must be one of {allowed}, got {v!r}")
+        return v
+    return parse
+
+
+def _strlist(v: Any) -> List[str]:
+    if isinstance(v, str):
+        return [s.strip() for s in v.split(",") if s.strip()]
+    return [str(x) for x in v]
+
+
+# The schema tree: dotted path -> Field.  Zone-overridable keys live under
+# "mqtt."/"force_shutdown." like the reference's zone mechanism.
+SCHEMA: Dict[str, Field] = {
+    "node.name": Field("emqx_tpu@127.0.0.1", str),
+    "node.cookie": Field("emqxsecretcookie", str),
+    "node.data_dir": Field("data", str),
+
+    "mqtt.max_packet_size": Field(1 << 20, bytesize, lambda v: v > 0),
+    "mqtt.max_clientid_len": Field(65535, int, lambda v: v >= 23),
+    "mqtt.max_topic_levels": Field(128, int, lambda v: 1 <= v <= 128),
+    "mqtt.max_topic_alias": Field(65535, int, lambda v: 0 <= v <= 65535),
+    "mqtt.max_qos_allowed": Field(2, int, lambda v: v in (0, 1, 2)),
+    "mqtt.retain_available": Field(True, _bool),
+    "mqtt.wildcard_subscription": Field(True, _bool),
+    "mqtt.shared_subscription": Field(True, _bool),
+    "mqtt.ignore_loop_deliver": Field(False, _bool),
+    "mqtt.session_expiry_interval": Field(7200.0, duration),
+    "mqtt.max_inflight": Field(32, int, lambda v: 1 <= v <= 65535),
+    "mqtt.max_mqueue_len": Field(1000, int, lambda v: v >= 0),
+    "mqtt.mqueue_priorities": Field("disabled", str),
+    "mqtt.mqueue_default_priority": Field("lowest", _enum("lowest", "highest")),
+    "mqtt.mqueue_store_qos0": Field(True, _bool),
+    "mqtt.max_awaiting_rel": Field(100, int),
+    "mqtt.await_rel_timeout": Field(300.0, duration),
+    "mqtt.keepalive_backoff": Field(0.75, float, lambda v: 0.5 <= v <= 1.0),
+    "mqtt.upgrade_qos": Field(False, _bool),
+    "mqtt.server_keepalive": Field(0, int),
+
+    "broker.shared_subscription_strategy": Field(
+        "random",
+        _enum("random", "round_robin", "sticky", "hash_clientid",
+              "hash_topic", "local"),
+    ),
+    "broker.shared_dispatch_ack_enabled": Field(False, _bool),
+    "broker.sys_msg_interval": Field(60.0, duration),
+    "broker.sys_heartbeat_interval": Field(30.0, duration),
+    "broker.enable_session_registry": Field(True, _bool),
+
+    "retainer.enable": Field(True, _bool),
+    "retainer.msg_expiry_interval": Field(0.0, duration),
+    "retainer.max_payload_size": Field(1 << 20, bytesize),
+    "retainer.max_retained_messages": Field(0, int),  # 0 = unlimited
+    "retainer.use_device_match": Field(True, _bool),
+
+    "delayed.enable": Field(True, _bool),
+    "delayed.max_delayed_messages": Field(0, int),
+
+    "flapping_detect.enable": Field(False, _bool),
+    "flapping_detect.max_count": Field(15, int),
+    "flapping_detect.window_time": Field(60.0, duration),
+    "flapping_detect.ban_time": Field(300.0, duration),
+
+    "force_shutdown.max_mailbox_size": Field(1000, int),
+    "force_shutdown.max_heap_size": Field(32 << 20, bytesize),
+
+    "limiter.max_conn_rate": Field(0.0, float),      # 0 = unlimited
+    "limiter.max_messages_rate": Field(0.0, float),
+    "limiter.max_bytes_rate": Field(0.0, float),
+
+    "authn.enable": Field(True, _bool),
+    "authn.allow_anonymous": Field(True, _bool),
+    "authz.no_match": Field("allow", _enum("allow", "deny")),
+    "authz.deny_action": Field("ignore", _enum("ignore", "disconnect")),
+    "authz.cache.enable": Field(True, _bool),
+    "authz.cache.max_size": Field(32, int),
+    "authz.cache.ttl": Field(60.0, duration),
+
+    "listeners.tcp.default.bind": Field("0.0.0.0:1883", str),
+    "listeners.tcp.default.max_connections": Field(1 << 20, int),
+    "listeners.tcp.default.enable": Field(True, _bool),
+    "listeners.ws.default.bind": Field("0.0.0.0:8083", str),
+    "listeners.ws.default.enable": Field(False, _bool),
+
+    "sysmon.os.cpu_high_watermark": Field(0.80, float),
+    "sysmon.os.cpu_low_watermark": Field(0.60, float),
+    "sysmon.os.mem_high_watermark": Field(0.70, float),
+
+    # -- TPU data plane (ours) --------------------------------------------
+    "tpu.enable": Field(True, _bool),
+    "tpu.max_levels": Field(16, int, lambda v: 1 <= v <= 64),
+    "tpu.batch_size": Field(4096, int, lambda v: v >= 1),
+    "tpu.batch_deadline": Field(0.0002, duration),
+    "tpu.active_slots": Field(16, int),
+    "tpu.max_matches": Field(32, int),
+    "tpu.mirror_refresh_interval": Field(0.05, duration),
+    "tpu.mesh_shape": Field("dp=1,tp=1", str),
+    "tpu.fail_open": Field(True, _bool),
+}
+
+
+# ---------------------------------------------------------------------------
+# HOCON-subset parser
+
+_TOKEN = re.compile(
+    r"""
+    (?P<ws>[ \t\r,]+)
+  | (?P<comment>(\#|//)[^\n]*)
+  | (?P<nl>\n)
+  | (?P<lbrace>\{) | (?P<rbrace>\})
+  | (?P<lbrack>\[) | (?P<rbrack>\])
+  | (?P<eq>=|:)
+  | (?P<str>"(?:[^"\\]|\\.)*")
+  | (?P<bare>[^\s=:{}\[\],\#]+)
+    """,
+    re.X,
+)
+
+
+def _tokens(text: str):
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ValueError(f"hocon: bad char at offset {pos}: {text[pos:pos+20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind in ("ws", "comment"):
+            continue
+        yield kind, m.group()
+    yield "eof", ""
+
+
+def _scalar(tok: str) -> Any:
+    if tok.startswith('"'):
+        return tok[1:-1].encode().decode("unicode_escape")
+    low = tok.lower()
+    if low in ("true", "on"):
+        return True
+    if low in ("false", "off"):
+        return False
+    if low in ("null", "undefined"):
+        return None
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        pass
+    return tok  # bare string (incl. durations/sizes — coerced by schema)
+
+
+def parse_hocon(text: str) -> Dict[str, Any]:
+    """Parse the HOCON subset into a nested dict."""
+    toks = list(_tokens(text))
+    i = 0
+
+    def peek():
+        return toks[i]
+
+    def take(kind=None):
+        nonlocal i
+        k, v = toks[i]
+        if kind is not None and k != kind:
+            raise ValueError(f"hocon: expected {kind}, got {k} {v!r}")
+        i += 1
+        return v
+
+    def skip_nl():
+        nonlocal i
+        while toks[i][0] == "nl":
+            i += 1
+
+    def parse_value():
+        skip_nl()
+        k, v = peek()
+        if k == "lbrace":
+            return parse_obj(braced=True)
+        if k == "lbrack":
+            take("lbrack")
+            items = []
+            while True:
+                skip_nl()
+                if peek()[0] == "rbrack":
+                    take("rbrack")
+                    return items
+                items.append(parse_value())
+        if k in ("str", "bare"):
+            return _scalar(take())
+        raise ValueError(f"hocon: unexpected {k} {v!r}")
+
+    def parse_obj(braced: bool) -> Dict[str, Any]:
+        if braced:
+            take("lbrace")
+        out: Dict[str, Any] = {}
+        while True:
+            skip_nl()
+            k, v = peek()
+            if braced and k == "rbrace":
+                take("rbrace")
+                return out
+            if k == "eof":
+                if braced:
+                    raise ValueError("hocon: unclosed '{'")
+                return out
+            if k not in ("str", "bare"):
+                raise ValueError(f"hocon: expected key, got {k} {v!r}")
+            key = take()
+            if key.startswith('"'):
+                key = key[1:-1]
+            skip_nl() if peek()[0] == "nl" else None
+            if peek()[0] == "eq":
+                take("eq")
+                val = parse_value()
+            elif peek()[0] == "lbrace":
+                val = parse_obj(braced=True)
+            else:
+                raise ValueError(f"hocon: key {key!r} missing value")
+            # dotted keys nest; later keys deep-merge over earlier ones
+            node = out
+            parts = key.split(".")
+            for p in parts[:-1]:
+                nxt = node.get(p)
+                if not isinstance(nxt, dict):
+                    nxt = node[p] = {}
+                node = nxt
+            leaf = parts[-1]
+            if isinstance(val, dict) and isinstance(node.get(leaf), dict):
+                _deep_merge(node[leaf], val)
+            else:
+                node[leaf] = val
+
+    return parse_obj(braced=False)
+
+
+def _deep_merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in d.items():
+        p = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, p + "."))
+        else:
+            out[p] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the layered config store
+
+class Config:
+    """Layered typed config with zones and hot-update handlers.
+
+    Layers (low → high precedence): schema defaults, file, environment
+    (``EMQX_A__B__C``), runtime ``put`` calls.  ``zone(name)`` returns a
+    view where ``zones.<name>.<key>`` overrides the global ``<key>`` — the
+    reference's per-listener zone mechanism.
+    """
+
+    ENV_PREFIX = "EMQX_"
+
+    def __init__(
+        self,
+        file_text: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        schema: Optional[Dict[str, Field]] = None,
+        strict: bool = True,
+    ) -> None:
+        self.schema = schema if schema is not None else SCHEMA
+        self._values: Dict[str, Any] = {
+            p: copy.deepcopy(f.default) for p, f in self.schema.items()
+        }
+        self._zones: Dict[str, Dict[str, Any]] = {}
+        self._handlers: List[Tuple[str, Callable[[str, Any, Any], None]]] = []
+        if file_text:
+            self.load_dict(parse_hocon(file_text), strict=strict)
+        self.load_env(env if env is not None else dict(os.environ))
+
+    # -- loading -----------------------------------------------------------
+
+    def load_dict(self, data: Dict[str, Any], strict: bool = True) -> None:
+        for path, raw in _flatten(data).items():
+            if path.startswith("zones."):
+                _, zone, key = path.split(".", 2)
+                self._set_zone(zone, key, raw, strict)
+                continue
+            if path not in self.schema:
+                if strict:
+                    raise ValueError(f"unknown config key {path!r}")
+                continue
+            self._values[path] = self.schema[path].coerce(path, raw)
+
+    def load_env(self, env: Dict[str, str]) -> None:
+        for name, raw in env.items():
+            if not name.startswith(self.ENV_PREFIX):
+                continue
+            path = name[len(self.ENV_PREFIX):].lower().replace("__", ".")
+            if path in self.schema:
+                self._values[path] = self.schema[path].coerce(path, _scalar(raw))
+
+    def _set_zone(self, zone: str, key: str, raw: Any, strict: bool) -> None:
+        if key not in self.schema:
+            if strict:
+                raise ValueError(f"unknown zone key {key!r}")
+            return
+        self._zones.setdefault(zone, {})[key] = self.schema[key].coerce(
+            f"zones.{zone}.{key}", raw
+        )
+
+    # -- reads -------------------------------------------------------------
+
+    def get(self, path: str, default: Any = None) -> Any:
+        if path in self._values:
+            return self._values[path]
+        if default is not None or path not in self.schema:
+            return default
+        return self.schema[path].default
+
+    def __getitem__(self, path: str) -> Any:
+        return self._values[path]
+
+    def zone(self, name: Optional[str]) -> "ZoneView":
+        return ZoneView(self, self._zones.get(name or "", {}))
+
+    def all(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+    # -- hot update (emqx_config_handler analog) ---------------------------
+
+    def on_update(
+        self, prefix: str, fn: Callable[[str, Any, Any], None]
+    ) -> None:
+        """Register ``fn(path, old, new)`` for keys under ``prefix``."""
+        self._handlers.append((prefix, fn))
+
+    def put(self, path: str, raw: Any) -> Any:
+        """Validated runtime update; handlers run after the value lands.
+        A handler raising rolls the value back (two-phase, like the
+        reference's pre-config-update checks)."""
+        if path not in self.schema:
+            raise ValueError(f"unknown config key {path!r}")
+        new = self.schema[path].coerce(path, raw)
+        old = self._values[path]
+        self._values[path] = new
+        try:
+            for prefix, fn in self._handlers:
+                if path.startswith(prefix):
+                    fn(path, old, new)
+        except Exception:
+            self._values[path] = old
+            raise
+        return new
+
+
+class ZoneView:
+    """Read view with zone overrides applied (reference: zone config)."""
+
+    __slots__ = ("_cfg", "_over")
+
+    def __init__(self, cfg: Config, over: Dict[str, Any]) -> None:
+        self._cfg = cfg
+        self._over = over
+
+    def get(self, path: str, default: Any = None) -> Any:
+        if path in self._over:
+            return self._over[path]
+        return self._cfg.get(path, default)

@@ -249,7 +249,10 @@ class Parser:
         return out
 
     def _try_parse(self):
-        buf = bytes(self._buf)
+        # Header is decoded straight off the bytearray (no copy); the body
+        # is materialized once, only when the whole packet has arrived —
+        # keeps large-packet reception O(n), not O(n²) in bytes copied.
+        buf = self._buf
         if len(buf) < 2:
             return None, 0
         try:
@@ -261,7 +264,7 @@ class Parser:
             raise FrameError("packet too large", P.RC.PACKET_TOO_LARGE)
         if len(buf) < total:
             return None, 0
-        pkt = _parse_packet(buf[0], buf[hdr_end:total], self.proto_ver)
+        pkt = _parse_packet(buf[0], bytes(buf[hdr_end:total]), self.proto_ver)
         if isinstance(pkt, P.Connect):
             self.proto_ver = pkt.proto_ver
         return pkt, total

@@ -1,0 +1,154 @@
+"""Fixed counter set — the ``emqx_metrics`` analog.
+
+Behavioral reference: ``apps/emqx/src/emqx_metrics.erl`` [U] (SURVEY.md
+§5.5): a fixed, atomics-backed counter table created at boot; modules
+``inc/1`` by name; REST/Prometheus read the whole table.  We keep the
+reference's metric names verbatim (bytes/packets/messages/delivery/client/
+session/authorization groups) and extend with a ``tpu.*`` group for the
+device match path (batch sizes, kernel latency, mirror staleness) —
+additions, never renames, so dashboards diff cleanly.
+
+Python ints under a single writer (asyncio event loop / GIL) play the
+role of atomics; `inc` is a dict add, no locks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES"]
+
+# -- the reference's fixed counter names, grouped as in emqx_metrics.erl [U]
+METRIC_NAMES: List[str] = [
+    # bytes
+    "bytes.received", "bytes.sent",
+    # packets
+    "packets.received", "packets.sent",
+    "packets.connect.received", "packets.connack.sent",
+    "packets.publish.received", "packets.publish.sent",
+    "packets.publish.error", "packets.publish.auth_error",
+    "packets.publish.dropped",
+    "packets.puback.received", "packets.puback.sent",
+    "packets.puback.inuse", "packets.puback.missed",
+    "packets.pubrec.received", "packets.pubrec.sent",
+    "packets.pubrec.inuse", "packets.pubrec.missed",
+    "packets.pubrel.received", "packets.pubrel.sent",
+    "packets.pubrel.missed",
+    "packets.pubcomp.received", "packets.pubcomp.sent",
+    "packets.pubcomp.inuse", "packets.pubcomp.missed",
+    "packets.subscribe.received", "packets.suback.sent",
+    "packets.subscribe.error", "packets.subscribe.auth_error",
+    "packets.unsubscribe.received", "packets.unsuback.sent",
+    "packets.unsubscribe.error",
+    "packets.pingreq.received", "packets.pingresp.sent",
+    "packets.disconnect.received", "packets.disconnect.sent",
+    "packets.auth.received", "packets.auth.sent",
+    "packets.connack.error", "packets.connack.auth_error",
+    # messages
+    "messages.received", "messages.sent",
+    "messages.qos0.received", "messages.qos0.sent",
+    "messages.qos1.received", "messages.qos1.sent",
+    "messages.qos2.received", "messages.qos2.sent",
+    "messages.publish", "messages.dropped",
+    "messages.dropped.no_subscribers", "messages.dropped.await_pubrel_timeout",
+    "messages.dropped.receive_maximum", "messages.dropped.expired",
+    "messages.dropped.queue_full", "messages.dropped.too_large",
+    "messages.forward", "messages.delayed", "messages.delivered",
+    "messages.acked", "messages.retained",
+    # delivery
+    "delivery.dropped", "delivery.dropped.no_local",
+    "delivery.dropped.too_large", "delivery.dropped.qos0_msg",
+    "delivery.dropped.queue_full", "delivery.dropped.expired",
+    # client lifecycle
+    "client.connect", "client.connack", "client.connected",
+    "client.authenticate", "client.auth.anonymous", "client.authorize",
+    "client.subscribe", "client.unsubscribe", "client.disconnected",
+    # session lifecycle
+    "session.created", "session.resumed", "session.takenover",
+    "session.discarded", "session.terminated",
+    # authorization
+    "authorization.allow", "authorization.deny",
+    "authorization.cache_hit", "authorization.cache_miss",
+    "authorization.superuser", "authorization.nomatch",
+    # overload protection
+    "olp.delay.ok", "olp.delay.timeout", "olp.hbn", "olp.gc",
+    "olp.new_conn",
+]
+
+# -- TPU-native additions (SURVEY.md §5.5 "add match-kernel metrics")
+TPU_METRIC_NAMES: List[str] = [
+    "tpu.match.batches", "tpu.match.topics",
+    "tpu.match.active_overflow", "tpu.match.match_overflow",
+    "tpu.match.fallback_host", "tpu.mirror.refresh",
+    "tpu.mirror.delta_applied", "tpu.mirror.recompile",
+]
+
+
+class Metrics:
+    """A counter table with the reference's fixed name set.
+
+    ``inc``/``get``/``all``; unknown names raise (mirroring the
+    reference's fixed-at-boot table, which catches typos at call sites).
+    """
+
+    __slots__ = ("_c",)
+
+    def __init__(self, extra: Optional[Iterable[str]] = None) -> None:
+        self._c: Dict[str, int] = {n: 0 for n in METRIC_NAMES}
+        self._c.update({n: 0 for n in TPU_METRIC_NAMES})
+        if extra:
+            self._c.update({n: 0 for n in extra})
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self._c[name] += n
+
+    def dec(self, name: str, n: int = 1) -> None:
+        self._c[name] -= n
+
+    def get(self, name: str) -> int:
+        return self._c[name]
+
+    def all(self) -> Dict[str, int]:
+        return dict(self._c)
+
+    def reset(self) -> None:
+        for k in self._c:
+            self._c[k] = 0
+
+    # -- convenience aggregations used by the v3-compat REST shape --------
+    def received_msgs(self) -> int:
+        return self._c["messages.received"]
+
+    def sent_msgs(self) -> int:
+        return self._c["messages.sent"]
+
+    def inc_recv_packet(self, ptype: str, nbytes: int = 0) -> None:
+        """Bump the packets.<type>.received family (+ totals + bytes)."""
+        self._c["packets.received"] += 1
+        if nbytes:
+            self._c["bytes.received"] += nbytes
+        key = f"packets.{ptype}.received"
+        if key in self._c:
+            self._c[key] += 1
+
+    def inc_sent_packet(self, ptype: str, nbytes: int = 0) -> None:
+        self._c["packets.sent"] += 1
+        if nbytes:
+            self._c["bytes.sent"] += nbytes
+        key = f"packets.{ptype}.sent"
+        if key in self._c:
+            self._c[key] += 1
+
+    def inc_msg_received(self, qos: int) -> None:
+        self._c["messages.received"] += 1
+        self._c[f"messages.qos{min(qos, 2)}.received"] += 1
+
+    def inc_msg_sent(self, qos: int) -> None:
+        self._c["messages.sent"] += 1
+        self._c[f"messages.qos{min(qos, 2)}.sent"] += 1
+
+    def inc_msg_dropped(self, reason: str) -> None:
+        self._c["messages.dropped"] += 1
+        key = f"messages.dropped.{reason}"
+        if key in self._c:
+            self._c[key] += 1

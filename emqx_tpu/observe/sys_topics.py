@@ -1,0 +1,101 @@
+"""$SYS topic publisher — the ``emqx_sys`` analog.
+
+Behavioral reference: ``apps/emqx/src/emqx_sys.erl`` [U] (SURVEY.md
+§2.1): periodic broker info published under
+``$SYS/brokers/<node>/{version,uptime,datetime,sysdescr}``, stats under
+``$SYS/brokers/<node>/stats/<name>``, metrics under ``.../metrics/<name>``,
+plus client lifecycle events (``.../clients/<clientid>/{connected,
+disconnected}``) and alarm transitions.
+
+Driven by explicit ``tick(now)`` calls from the owner's event loop rather
+than an internal timer — deterministic under test, trivial to wire to
+asyncio (SURVEY.md §5.2's "versioned snapshot discipline" favors
+tick-style control everywhere).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .. import __version__
+from .alarm import Alarm
+
+__all__ = ["SysBroker"]
+
+
+class SysBroker:
+    def __init__(
+        self,
+        node: str,
+        publish: Callable[[str, bytes], Any],
+        interval: float = 60.0,
+        start_time: Optional[float] = None,
+    ) -> None:
+        self.node = node
+        self._publish = publish
+        self.interval = interval
+        self.start_time = start_time if start_time is not None else time.time()
+        self._last_tick = 0.0
+        self._stats_fn: Optional[Callable[[], Dict[str, int]]] = None
+        self._metrics_fn: Optional[Callable[[], Dict[str, int]]] = None
+
+    def prefix(self) -> str:
+        return f"$SYS/brokers/{self.node}"
+
+    def attach(
+        self,
+        stats: Optional[Callable[[], Dict[str, int]]] = None,
+        metrics: Optional[Callable[[], Dict[str, int]]] = None,
+    ) -> None:
+        self._stats_fn = stats
+        self._metrics_fn = metrics
+
+    # ------------------------------------------------------------------
+
+    def uptime(self, now: Optional[float] = None) -> float:
+        return (now if now is not None else time.time()) - self.start_time
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        """Publish the periodic $SYS set if the interval elapsed."""
+        now = now if now is not None else time.time()
+        if now - self._last_tick < self.interval:
+            return False
+        self._last_tick = now
+        p = self.prefix()
+        self._publish(f"{p}/version", __version__.encode())
+        self._publish(f"{p}/sysdescr", b"emqx_tpu broker")
+        self._publish(f"{p}/uptime", str(int(self.uptime(now))).encode())
+        self._publish(
+            f"{p}/datetime",
+            time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(now)).encode(),
+        )
+        if self._stats_fn:
+            for k, v in self._stats_fn().items():
+                self._publish(f"{p}/stats/{k}", str(v).encode())
+        if self._metrics_fn:
+            for k, v in self._metrics_fn().items():
+                self._publish(f"{p}/metrics/{k}", str(v).encode())
+        return True
+
+    # -- event publishes (called from connection/alarm paths) -------------
+
+    def client_connected(self, clientid: str, info: Dict[str, Any]) -> None:
+        self._publish(
+            f"{self.prefix()}/clients/{clientid}/connected",
+            json.dumps(info).encode(),
+        )
+
+    def client_disconnected(self, clientid: str, reason: str) -> None:
+        self._publish(
+            f"{self.prefix()}/clients/{clientid}/disconnected",
+            json.dumps({"clientid": clientid, "reason": reason}).encode(),
+        )
+
+    def alarm_changed(self, kind: str, alarm: Alarm) -> None:
+        """Wire as ``alarms.on_change = sys.alarm_changed``."""
+        self._publish(
+            f"{self.prefix()}/alarms/{kind}",
+            json.dumps(alarm.to_dict()).encode(),
+        )

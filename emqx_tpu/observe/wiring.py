@@ -1,0 +1,76 @@
+"""Hook-driven metric accounting: wires a Broker's hook bus to Metrics/
+Stats, the way the reference bumps counters inline at each layer.
+
+One call — ``observe(broker)`` — returns an :class:`Observed` bundle with
+the counter table fed by ``message.publish`` / ``message.delivered`` /
+``message.dropped`` / session lifecycle hooks, and stats providers pulled
+from the live broker tables.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..broker.broker import Broker
+from .alarm import Alarms
+from .metrics import Metrics
+from .stats import Stats
+from .sys_topics import SysBroker
+
+__all__ = ["Observed", "observe"]
+
+
+@dataclass
+class Observed:
+    metrics: Metrics
+    stats: Stats
+    alarms: Alarms
+    sys: SysBroker
+
+
+def observe(broker: Broker, sys_interval: float = 60.0) -> Observed:
+    m = Metrics()
+    s = Stats()
+    alarms = Alarms()
+
+    def sys_publish(topic: str, payload: bytes):
+        from ..broker.message import make_message
+        broker.publish(make_message(None, topic, payload, qos=0))
+
+    sysb = SysBroker(broker.node, sys_publish, interval=sys_interval)
+    sysb.attach(stats=s.all, metrics=m.all)
+    alarms.on_change = sysb.alarm_changed
+
+    hooks = broker.hooks
+    hooks.add("message.publish", lambda msg: m.inc_msg_received(msg.qos) if not msg.topic.startswith("$SYS") else None, name="metrics.publish")
+    hooks.add("message.delivered", lambda cid, msg: m.inc("messages.delivered"), name="metrics.delivered")
+    hooks.add("message.acked", lambda cid, msg: m.inc("messages.acked"), name="metrics.acked")
+
+    def on_dropped(msg, reason):
+        m.inc_msg_dropped(reason if reason != "shared_no_available" else "no_subscribers")
+
+    hooks.add("message.dropped", on_dropped, name="metrics.dropped")
+    for ev in ("created", "resumed", "takenover", "discarded", "terminated"):
+        hooks.add(
+            f"session.{ev}",
+            (lambda e: lambda *a: m.inc(f"session.{e}"))(ev),
+            name=f"metrics.session.{ev}",
+        )
+
+    s.provide("topics.count", broker.router.route_count)
+    s.provide("sessions.count", lambda: len(broker.sessions))
+    s.provide(
+        "subscriptions.count",
+        lambda: sum(len(x.subscriptions) for x in broker.sessions.values()),
+    )
+    s.provide(
+        "subscribers.count",
+        lambda: sum(len(v) for v in broker.subscribers.values()),
+    )
+    s.provide(
+        "subscriptions.shared.count",
+        lambda: sum(
+            len(broker.shared.members(g, t)) for g, t in broker.shared.groups()
+        ),
+    )
+    return Observed(metrics=m, stats=s, alarms=alarms, sys=sysb)

@@ -1,0 +1,113 @@
+"""Metrics/stats/alarms/$SYS — emqx_metrics/emqx_stats/emqx_alarm/emqx_sys
+parity surface (SURVEY.md §5.5)."""
+
+import pytest
+
+from emqx_tpu.broker.broker import Broker
+from emqx_tpu.broker.message import make_message
+from emqx_tpu.broker.session import SubOpts
+from emqx_tpu.observe import Alarms, Metrics, Stats, SysBroker
+from emqx_tpu.observe.metrics import METRIC_NAMES
+from emqx_tpu.observe.wiring import observe
+
+
+def test_metrics_fixed_names_and_inc():
+    m = Metrics()
+    assert "messages.received" in METRIC_NAMES
+    m.inc("messages.received")
+    m.inc("messages.received", 5)
+    assert m.get("messages.received") == 6
+    with pytest.raises(KeyError):
+        m.inc("not.a.metric")
+
+
+def test_metrics_packet_and_qos_families():
+    m = Metrics()
+    m.inc_recv_packet("connect", nbytes=12)
+    m.inc_sent_packet("connack", nbytes=4)
+    m.inc_msg_received(2)
+    m.inc_msg_dropped("queue_full")
+    assert m.get("packets.connect.received") == 1
+    assert m.get("packets.connack.sent") == 1
+    assert m.get("bytes.received") == 12 and m.get("bytes.sent") == 4
+    assert m.get("messages.qos2.received") == 1
+    assert m.get("messages.dropped") == 1
+    assert m.get("messages.dropped.queue_full") == 1
+
+
+def test_stats_watermarks():
+    s = Stats()
+    s.setstat("connections.count", 5)
+    s.setstat("connections.count", 3)
+    assert s.get("connections.count") == 3
+    assert s.get("connections.max") == 5
+
+
+def test_stats_pull_provider():
+    s = Stats()
+    n = {"v": 7}
+    s.provide("topics.count", lambda: n["v"])
+    assert s.get("topics.count") == 7
+    n["v"] = 9
+    assert s.all()["topics.count"] == 9
+
+
+def test_alarms_lifecycle_and_events():
+    events = []
+    a = Alarms(history_size=2)
+    a.on_change = lambda kind, alarm: events.append((kind, alarm.name))
+    assert a.activate("high_cpu", {"usage": 0.93})
+    assert not a.activate("high_cpu")  # idempotent
+    assert a.is_active("high_cpu")
+    assert a.deactivate("high_cpu")
+    assert not a.deactivate("high_cpu")
+    assert events == [("activate", "high_cpu"), ("deactivate", "high_cpu")]
+    for i in range(4):
+        a.activate(f"x{i}")
+        a.deactivate(f"x{i}")
+    assert len(a.history) == 2  # bounded
+
+
+def test_sys_broker_tick_publishes_under_prefix():
+    out = []
+    sys = SysBroker("node1", lambda t, p: out.append((t, p)), interval=60)
+    sys.attach(stats=lambda: {"connections.count": 2}, metrics=lambda: {"messages.received": 3})
+    assert sys.tick(now=sys.start_time + 61)
+    topics = [t for t, _ in out]
+    assert "$SYS/brokers/node1/uptime" in topics
+    assert "$SYS/brokers/node1/stats/connections.count" in topics
+    assert "$SYS/brokers/node1/metrics/messages.received" in topics
+    out.clear()
+    assert not sys.tick(now=sys.start_time + 90)  # within interval
+
+
+def test_observe_wires_broker_hooks():
+    b = Broker()
+    obs = observe(b)
+    b.open_session("sub1")
+    b.subscribe("sub1", "t/+")
+    res = b.publish(make_message("pub", "t/1", b"x", qos=1))
+    assert res.matched == 1
+    m = obs.metrics
+    assert m.get("messages.received") == 1
+    assert m.get("messages.qos1.received") == 1
+    assert m.get("messages.delivered") == 1
+    assert m.get("session.created") == 1
+    assert obs.stats.get("topics.count") == 1
+    assert obs.stats.get("sessions.count") == 1
+    assert obs.stats.get("subscriptions.count") == 1
+    # no-subscriber drop accounted
+    b.publish(make_message("pub", "none/here", b"x"))
+    assert m.get("messages.dropped.no_subscribers") == 1
+
+
+def test_sys_messages_do_not_count_as_received():
+    b = Broker()
+    obs = observe(b, sys_interval=0)
+    b.open_session("s")
+    b.subscribe("s", "$SYS/brokers/#", SubOpts())
+    obs.sys.tick()
+    assert obs.metrics.get("messages.received") == 0
+    # but the subscriber saw the $SYS publishes
+    sess = b.sessions["s"]
+    assert sess is not None
