@@ -1,0 +1,26 @@
+"""Authentication & authorization (SURVEY.md §2.3: ``apps/emqx_authn``,
+``apps/emqx_authz``, ``emqx_access_control.erl`` [U]).
+
+* :mod:`~emqx_tpu.auth.authn` — chainable authenticators (built-in db
+  with salted sha256/pbkdf2/bcrypt, JWT HS256, anonymous policy).
+* :mod:`~emqx_tpu.auth.authz` — ordered ACL sources (file rules,
+  built-in db) with ``%c``/``%u`` topic placeholders, result cache, and
+  an NFA-compiled batch path: static ACL patterns ride the same device
+  match kernel as routing (the north-star co-batching).
+* :func:`~emqx_tpu.auth.access_control.attach` — wires both onto a
+  Broker's ``client.authenticate`` / ``client.authorize`` hooks.
+"""
+
+from .authn import (
+    AuthChain, BuiltinDbAuthenticator, JwtAuthenticator, Credentials,
+    hash_password,
+)
+from .authz import AclRule, Authz, BuiltinDbSource, FileSource, compile_acl_batch
+from .access_control import attach_auth
+
+__all__ = [
+    "AuthChain", "BuiltinDbAuthenticator", "JwtAuthenticator",
+    "Credentials", "hash_password",
+    "AclRule", "Authz", "BuiltinDbSource", "FileSource",
+    "compile_acl_batch", "attach_auth",
+]

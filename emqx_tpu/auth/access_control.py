@@ -1,0 +1,78 @@
+"""Access-control wiring — the ``emqx_access_control`` analog.
+
+Behavioral reference: ``apps/emqx/src/emqx_access_control.erl`` [U]
+(SURVEY.md §2.1): ``authenticate/1`` runs the authn chain during
+CONNECT; ``authorize/3`` runs the authz pipeline per publish/subscribe.
+Here both ride the hook bus the channel already calls:
+
+* ``client.authenticate`` fold — maps the chain verdict onto the
+  accumulator the channel understands (True, or a CONNACK reason code);
+* ``client.authorize`` fold — True/False per (clientid, action, topic).
+
+Superuser status from authn is remembered per clientid for the
+authorize fast path, and dropped when the session terminates.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..broker.broker import Broker
+from ..broker.hooks import STOP
+from ..mqtt.packet import RC
+from .authn import AuthChain, Credentials
+from .authz import Authz
+
+__all__ = ["attach_auth", "AccessControl"]
+
+
+class AccessControl:
+    def __init__(self, chain: AuthChain, authz: Authz) -> None:
+        self.chain = chain
+        self.authz = authz
+        self._superusers: Dict[str, bool] = {}
+        self._usernames: Dict[str, Optional[str]] = {}
+        self._peerhosts: Dict[str, Optional[str]] = {}
+
+    # hook: client.authenticate (clientid, username, password, conninfo) acc
+    def on_authenticate(self, clientid, username, password, conninfo, acc):
+        if acc is not True:
+            return acc  # an earlier hook (banned) already decided
+        peer = conninfo.get("peerhost") if isinstance(conninfo, dict) else None
+        res = self.chain.authenticate(
+            Credentials(clientid, username, password, peer)
+        )
+        if res.outcome == "ok":
+            self._superusers[clientid] = res.is_superuser
+            self._usernames[clientid] = username
+            self._peerhosts[clientid] = peer
+            return True
+        return (STOP, RC.BAD_USER_NAME_OR_PASSWORD if password else RC.NOT_AUTHORIZED)
+
+    # hook: client.authorize (clientid, action, topic) acc
+    def on_authorize(self, clientid, action, topic, acc):
+        if acc is not True:
+            return acc
+        ok = self.authz.authorize(
+            clientid, action, topic,
+            username=self._usernames.get(clientid),
+            peerhost=self._peerhosts.get(clientid),
+            is_superuser=self._superusers.get(clientid, False),
+        )
+        return True if ok else (STOP, False)
+
+    def on_terminated(self, clientid):
+        self._superusers.pop(clientid, None)
+        self._usernames.pop(clientid, None)
+        self._peerhosts.pop(clientid, None)
+
+
+def attach_auth(broker: Broker, chain: AuthChain, authz: Authz) -> AccessControl:
+    ac = AccessControl(chain, authz)
+    broker.hooks.add("client.authenticate", ac.on_authenticate, priority=0,
+                     name="authn.chain")
+    broker.hooks.add("client.authorize", ac.on_authorize, priority=0,
+                     name="authz.sources")
+    broker.hooks.add("session.terminated", ac.on_terminated,
+                     name="authn.cleanup")
+    return ac

@@ -16,6 +16,10 @@ from .shared_sub import STRATEGIES, SharedSub
 from .broker import Broker, DeliverResult
 from .cm import ConnectionManager
 from .channel import Channel
+from .banned import Banned, BanEntry
+from .flapping import Flapping
+from .limiter import LimiterGroup, TokenBucket
+from .olp import Olp
 
 __all__ = [
     "FilterTrie", "TopicTrie", "Route", "RouteDelta", "Router",
@@ -24,4 +28,5 @@ __all__ = [
     "MAX_PACKET_ID", "Publish", "Session", "SubOpts",
     "STRATEGIES", "SharedSub", "Broker", "DeliverResult",
     "ConnectionManager", "Channel",
+    "Banned", "BanEntry", "Flapping", "LimiterGroup", "TokenBucket", "Olp",
 ]

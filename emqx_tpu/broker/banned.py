@@ -1,0 +1,100 @@
+"""Banned-clients table.
+
+Behavioral reference: ``apps/emqx/src/emqx_banned.erl`` [U] (SURVEY.md
+§2.1): bans keyed by clientid, username or peerhost with an `until`
+expiry; checked during CONNECT.  Attached as a high-priority
+``client.authenticate`` hook returning the BANNED reason code.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..mqtt.packet import RC
+from .broker import Broker
+from .hooks import STOP
+
+__all__ = ["Banned", "BanEntry"]
+
+WHO_KINDS = ("clientid", "username", "peerhost")
+
+
+@dataclass
+class BanEntry:
+    kind: str           # clientid | username | peerhost
+    who: str
+    by: str = "mgmt"
+    reason: str = ""
+    at: float = 0.0
+    until: Optional[float] = None   # None = permanent
+
+    def expired(self, now: float) -> bool:
+        return self.until is not None and now >= self.until
+
+
+class Banned:
+    def __init__(self) -> None:
+        self._tab: Dict[Tuple[str, str], BanEntry] = {}
+
+    def add(
+        self, kind: str, who: str, duration: Optional[float] = None,
+        by: str = "mgmt", reason: str = "",
+    ) -> BanEntry:
+        if kind not in WHO_KINDS:
+            raise ValueError(f"bad ban kind {kind!r}")
+        now = time.time()
+        e = BanEntry(
+            kind, who, by, reason, now,
+            None if duration is None else now + duration,
+        )
+        self._tab[(kind, who)] = e
+        return e
+
+    def delete(self, kind: str, who: str) -> bool:
+        return self._tab.pop((kind, who), None) is not None
+
+    def check(
+        self,
+        clientid: Optional[str] = None,
+        username: Optional[str] = None,
+        peerhost: Optional[str] = None,
+        now: Optional[float] = None,
+    ) -> bool:
+        """True if any identity dimension is banned (and not expired)."""
+        now = now if now is not None else time.time()
+        for kind, who in (
+            ("clientid", clientid), ("username", username), ("peerhost", peerhost)
+        ):
+            if who is None:
+                continue
+            e = self._tab.get((kind, who))
+            if e is not None:
+                if e.expired(now):
+                    del self._tab[(kind, who)]
+                else:
+                    return True
+        return False
+
+    def list(self) -> List[BanEntry]:
+        now = time.time()
+        return [e for e in self._tab.values() if not e.expired(now)]
+
+    def clean_expired(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.time()
+        stale = [k for k, e in self._tab.items() if e.expired(now)]
+        for k in stale:
+            del self._tab[k]
+        return len(stale)
+
+    def attach(self, broker: Broker) -> "Banned":
+        def on_auth(clientid, username, password, conninfo, acc):
+            peer = conninfo.get("peerhost") if isinstance(conninfo, dict) else None
+            if self.check(clientid, username, peer):
+                return (STOP, RC.BANNED)
+            return acc
+
+        broker.hooks.add("client.authenticate", on_auth, priority=1000,
+                         name="banned.check")
+        return self

@@ -69,6 +69,11 @@ class Broker:
         # filter -> {clientid -> SubOpts}; non-shared local subscribers
         self.subscribers: Dict[str, Dict[str, SubOpts]] = {}
         self.session_defaults = session_defaults or {}
+        # out-of-band deliveries (retained replay, delayed publish): the
+        # serving layer sets on_deliver to push straight to connections;
+        # otherwise they accumulate in outbox for take_outbox().
+        self.on_deliver = None  # Optional[Callable[[str, List[Publish]], None]]
+        self.outbox: Dict[str, List[Publish]] = {}
 
     # ------------------------------------------------------------------
     # session lifecycle (emqx_cm:open_session semantics, simplified here;
@@ -104,6 +109,7 @@ class Broker:
         if discard or sess.clean_start:
             self._drop_session_state(sess)
             del self.sessions[clientid]
+            self.outbox.pop(clientid, None)
             self.hooks.run("session.terminated", (clientid,))
 
     def _drop_session_state(self, sess: Session) -> None:
@@ -125,7 +131,7 @@ class Broker:
             opts = replace(opts, share=group)
         else:
             group, flt = None, raw_filter
-        sess.subscribe(raw_filter, opts)
+        is_new = sess.subscribe(raw_filter, opts)
         if group is not None:
             self.shared.subscribe(group, flt, clientid, self.node)
             self.router.add_route(flt, (group, self.node))
@@ -135,7 +141,7 @@ class Broker:
             subs[clientid] = opts
             if first:
                 self.router.add_route(flt, self.node)
-        self.hooks.run("session.subscribed", (clientid, raw_filter, opts))
+        self.hooks.run("session.subscribed", (clientid, raw_filter, opts, is_new))
         return True
 
     def unsubscribe(self, clientid: str, raw_filter: str) -> bool:
@@ -250,6 +256,35 @@ class Broker:
             res.dropped.append((clientid, d))
             self.hooks.run("message.dropped", (d, "queue_full"))
         return all(d.id != eff.id for d in dropped)
+
+    # ------------------------------------------------------------------
+    # out-of-band delivery (retained replay, delayed publish, ...)
+    # ------------------------------------------------------------------
+
+    def deliver_direct(self, clientid: str, opts: SubOpts, msgs: List[Message]) -> None:
+        """Deliver ``msgs`` to one session outside a publish fan-out and
+        emit the resulting sends to the connection layer."""
+        sess = self.sessions.get(clientid)
+        if sess is None:
+            return
+        sends, dropped = sess.deliver(
+            [m.with_qos(min(m.qos, opts.qos)) for m in msgs]
+        )
+        for d in dropped:
+            self.hooks.run("message.dropped", (d, "queue_full"))
+        if sends:
+            for pub in sends:   # only actually-sent messages, not queued
+                self.hooks.run("message.delivered", (clientid, pub.msg))
+            self.emit(clientid, sends)
+
+    def emit(self, clientid: str, pubs: List[Publish]) -> None:
+        if self.on_deliver is not None:
+            self.on_deliver(clientid, pubs)
+        else:
+            self.outbox.setdefault(clientid, []).extend(pubs)
+
+    def take_outbox(self, clientid: str) -> List[Publish]:
+        return self.outbox.pop(clientid, [])
 
     # ------------------------------------------------------------------
 

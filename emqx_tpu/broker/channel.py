@@ -281,6 +281,8 @@ class Channel:
         return [("send", P.Suback(packet_id=pkt.packet_id, reason_codes=rcs))]
 
     def _handle_unsubscribe(self, pkt: P.Unsubscribe) -> List[Action]:
+        # hooks may rewrite pkt.topic_filters in place (topic-rewrite rules)
+        self.broker.hooks.run("client.unsubscribe", (self.clientid, pkt))
         rcs = []
         for flt in pkt.topic_filters:
             ok = self.broker.unsubscribe(self.clientid, flt)

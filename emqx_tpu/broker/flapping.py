@@ -1,0 +1,62 @@
+"""Flapping detection → temporary ban.
+
+Behavioral reference: ``apps/emqx/src/emqx_flapping.erl`` [U] (SURVEY.md
+§2.1): count a client's disconnects inside a sliding window; crossing
+``max_count`` bans the clientid for ``ban_time`` via the banned table.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Deque, Dict, Optional
+
+from .banned import Banned
+from .broker import Broker
+
+__all__ = ["Flapping"]
+
+
+class Flapping:
+    def __init__(
+        self,
+        banned: Banned,
+        max_count: int = 15,
+        window_time: float = 60.0,
+        ban_time: float = 300.0,
+        enable: bool = True,
+    ) -> None:
+        self.banned = banned
+        self.max_count = max_count
+        self.window_time = window_time
+        self.ban_time = ban_time
+        self.enable = enable
+        self._events: Dict[str, Deque[float]] = {}
+        self.detected = 0
+
+    def record_disconnect(self, clientid: str, now: Optional[float] = None) -> bool:
+        """Returns True if this event tripped the detector (ban issued)."""
+        if not self.enable:
+            return False
+        now = now if now is not None else time.time()
+        q = self._events.setdefault(clientid, deque())
+        q.append(now)
+        while q and now - q[0] > self.window_time:
+            q.popleft()
+        if len(q) >= self.max_count:
+            self.banned.add(
+                "clientid", clientid, duration=self.ban_time,
+                by="flapping", reason="flapping detected",
+            )
+            self.detected += 1
+            del self._events[clientid]
+            return True
+        return False
+
+    def attach(self, broker: Broker) -> "Flapping":
+        broker.hooks.add(
+            "client.disconnected",
+            lambda clientid, reason: self.record_disconnect(clientid),
+            name="flapping.detect",
+        )
+        return self

@@ -1,0 +1,98 @@
+"""Token-bucket rate limiters.
+
+Behavioral reference: the esockd/``emqx_limiter`` token buckets [U]
+(SURVEY.md §2.1): per-listener connection rate, per-connection message
+and byte rates.  ``consume`` is non-blocking (returns whether the tokens
+were granted plus the wait needed) — the asyncio connection layer sleeps
+the returned interval, mirroring the reference's pause/resume of the
+receive loop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+__all__ = ["TokenBucket", "LimiterGroup"]
+
+
+class TokenBucket:
+    """rate tokens/second, bursting to ``burst`` (defaults to rate)."""
+
+    __slots__ = ("rate", "burst", "_tokens", "_last")
+
+    def __init__(self, rate: float, burst: Optional[float] = None) -> None:
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else max(rate, 1.0))
+        self._tokens = self.burst
+        self._last: Optional[float] = None
+
+    @property
+    def unlimited(self) -> bool:
+        return self.rate <= 0
+
+    def _refill(self, now: float) -> None:
+        if self._last is None:
+            self._last = now
+            return
+        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def consume(self, n: float = 1.0, now: Optional[float] = None) -> Tuple[bool, float]:
+        """Try to take ``n`` tokens.  Returns (granted, wait_seconds) —
+        wait_seconds > 0 tells the caller how long to pause before retry."""
+        if self.unlimited:
+            return True, 0.0
+        now = now if now is not None else time.time()
+        self._refill(now)
+        if self._tokens >= n:
+            self._tokens -= n
+            return True, 0.0
+        deficit = n - self._tokens
+        return False, deficit / self.rate
+
+    def tokens(self, now: Optional[float] = None) -> float:
+        if self.unlimited:
+            return float("inf")
+        self._refill(now if now is not None else time.time())
+        return self._tokens
+
+
+class LimiterGroup:
+    """The three reference limiter dimensions, from config keys
+    ``limiter.max_conn_rate`` / ``max_messages_rate`` / ``max_bytes_rate``
+    (0 = unlimited)."""
+
+    def __init__(
+        self,
+        max_conn_rate: float = 0.0,
+        max_messages_rate: float = 0.0,
+        max_bytes_rate: float = 0.0,
+    ) -> None:
+        self.conn = TokenBucket(max_conn_rate)
+        self._msg_rate = max_messages_rate
+        self._bytes_rate = max_bytes_rate
+        self._per_conn: Dict[str, Tuple[TokenBucket, TokenBucket]] = {}
+
+    def allow_connect(self, now: Optional[float] = None) -> Tuple[bool, float]:
+        return self.conn.consume(1.0, now)
+
+    def conn_buckets(self, connid: str) -> Tuple[TokenBucket, TokenBucket]:
+        """(messages, bytes) buckets for one connection."""
+        b = self._per_conn.get(connid)
+        if b is None:
+            b = self._per_conn[connid] = (
+                TokenBucket(self._msg_rate), TokenBucket(self._bytes_rate)
+            )
+        return b
+
+    def drop_conn(self, connid: str) -> None:
+        self._per_conn.pop(connid, None)
+
+    def allow_publish(
+        self, connid: str, nbytes: int, now: Optional[float] = None
+    ) -> Tuple[bool, float]:
+        msgs, byts = self.conn_buckets(connid)
+        ok1, w1 = msgs.consume(1.0, now)
+        ok2, w2 = byts.consume(float(nbytes), now)
+        return ok1 and ok2, max(w1, w2)

@@ -1,0 +1,68 @@
+"""Overload protection: shed load when the control plane runs hot.
+
+Behavioral reference: ``emqx_olp.erl`` / ``emqx_vm_mon`` / ``emqx_os_mon``
+[U] (SURVEY.md §2.1): scheduler-usage-based shedding of new connections
+and low-priority work, with alarms on sustained overload.  Our signals:
+event-loop lag (reported by the serving loop), pending publish-queue
+depth, and match-kernel backlog — pushed in via :meth:`report`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..observe.alarm import Alarms
+
+__all__ = ["Olp"]
+
+
+class Olp:
+    def __init__(
+        self,
+        alarms: Optional[Alarms] = None,
+        max_loop_lag: float = 0.5,
+        max_queue_depth: int = 100_000,
+        cooloff: float = 5.0,
+    ) -> None:
+        self.alarms = alarms
+        self.max_loop_lag = max_loop_lag
+        self.max_queue_depth = max_queue_depth
+        self.cooloff = cooloff
+        self._overloaded_at: Optional[float] = None
+        self.shed_count = 0
+
+    def report(
+        self, loop_lag: float = 0.0, queue_depth: int = 0,
+        now: Optional[float] = None,
+    ) -> None:
+        now = now if now is not None else time.time()
+        hot = loop_lag > self.max_loop_lag or queue_depth > self.max_queue_depth
+        if hot:
+            self._overloaded_at = now
+            if self.alarms is not None:
+                self.alarms.activate(
+                    "overload",
+                    {"loop_lag": loop_lag, "queue_depth": queue_depth},
+                    "control plane overloaded",
+                )
+        elif (
+            self._overloaded_at is not None
+            and now - self._overloaded_at > self.cooloff
+        ):
+            self._overloaded_at = None
+            if self.alarms is not None:
+                self.alarms.deactivate("overload")
+
+    def overloaded(self, now: Optional[float] = None) -> bool:
+        if self._overloaded_at is None:
+            return False
+        now = now if now is not None else time.time()
+        return now - self._overloaded_at <= self.cooloff
+
+    def should_shed_connect(self, now: Optional[float] = None) -> bool:
+        """New CONNECTs are the first thing shed under overload."""
+        if self.overloaded(now):
+            self.shed_count += 1
+            return True
+        return False

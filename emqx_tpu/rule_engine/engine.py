@@ -1,0 +1,315 @@
+"""Rule registry, event wiring and actions.
+
+Behavioral reference: ``emqx_rule_engine.erl`` + the ``$events/...``
+event topics of ``emqx_rule_events.erl`` [U] (SURVEY.md §2.3, §3.5):
+
+* rules are created from SQL + action list, compiled once, stored by id;
+* a plain topic filter in FROM selects ``message.publish`` events; the
+  ``$events/<name>`` pseudo-topics select lifecycle events;
+* on each event: for every enabled rule whose FROM matches, evaluate and
+  run actions per output row; per-rule metrics
+  (matched/passed/failed/no_result) mirror the reference's counters.
+
+Actions: ``republish`` (topic/payload/qos ``${...}`` templates through
+the normal broker pipeline, loop-guarded), ``console``, and any callable
+``fn(output_row, columns)`` (the bridge boundary — Kafka/HTTP sinks plug
+here).
+
+Device co-batching: :meth:`RuleEngine.compile_table` compiles every
+publish-rule FROM filter into one NFA table whose accepts map to rule
+ids, so the sidecar matches routing and rule selection in the same
+kernel batch (the north-star co-batch; BASELINE config #3).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .. import topic as T
+from ..broker.broker import Broker
+from ..broker.message import Message, make_message
+from .runtime import eval_rule, render_template
+from .sqlparser import Rule as ParsedSql, parse_sql
+
+__all__ = ["Rule", "RuleResult", "RuleEngine", "EVENT_TOPICS"]
+
+EVENT_TOPICS = {
+    "$events/client_connected": "client.connected",
+    "$events/client_disconnected": "client.disconnected",
+    "$events/session_subscribed": "session.subscribed",
+    "$events/session_unsubscribed": "session.unsubscribed",
+    "$events/message_delivered": "message.delivered",
+    "$events/message_acked": "message.acked",
+    "$events/message_dropped": "message.dropped",
+}
+
+
+@dataclass
+class Rule:
+    id: str
+    sql: str
+    parsed: ParsedSql
+    actions: List[Any]
+    enable: bool = True
+    description: str = ""
+    created_at: float = field(default_factory=time.time)
+    metrics: Dict[str, int] = field(default_factory=lambda: {
+        "matched": 0, "passed": 0, "failed": 0, "no_result": 0,
+        "actions.success": 0, "actions.failed": 0,
+    })
+
+    def publish_filters(self) -> List[str]:
+        return [f for f in self.parsed.froms if not f.startswith("$events/")]
+
+    def event_hooks(self) -> List[str]:
+        return [EVENT_TOPICS[f] for f in self.parsed.froms if f in EVENT_TOPICS]
+
+
+@dataclass
+class RuleResult:
+    rule_id: str
+    outputs: List[Dict[str, Any]]
+
+
+class RuleEngine:
+    def __init__(self, broker: Optional[Broker] = None) -> None:
+        self.rules: Dict[str, Rule] = {}
+        self.broker = broker
+        self._epoch = 0   # bumps on any rule change (device mirror key)
+        if broker is not None:
+            self._attach(broker)
+
+    # ------------------------------------------------------------------
+    # registry
+    # ------------------------------------------------------------------
+
+    def create_rule(
+        self,
+        rule_id: str,
+        sql: str,
+        actions: Optional[Sequence[Any]] = None,
+        description: str = "",
+        enable: bool = True,
+    ) -> Rule:
+        parsed = parse_sql(sql)
+        for f in parsed.froms:
+            if not f.startswith("$events/"):
+                T.validate(f, "filter")
+            elif f not in EVENT_TOPICS:
+                raise ValueError(f"unknown event topic {f!r}")
+        rule = Rule(rule_id, sql, parsed, list(actions or []), enable,
+                    description)
+        self.rules[rule_id] = rule
+        self._epoch += 1
+        return rule
+
+    def delete_rule(self, rule_id: str) -> bool:
+        ok = self.rules.pop(rule_id, None) is not None
+        if ok:
+            self._epoch += 1
+        return ok
+
+    def set_enable(self, rule_id: str, enable: bool) -> None:
+        self.rules[rule_id].enable = enable
+        self._epoch += 1
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+
+    def apply_event(
+        self, hook_or_topic: str, columns: Dict[str, Any],
+        is_event: bool = False,
+    ) -> List[RuleResult]:
+        """Run all matching enabled rules; returns per-rule outputs."""
+        results: List[RuleResult] = []
+        for rule in self.rules.values():
+            if not rule.enable:
+                continue
+            if is_event:
+                if hook_or_topic not in rule.event_hooks():
+                    continue
+            else:
+                if not any(
+                    T.match(hook_or_topic, f) for f in rule.publish_filters()
+                ):
+                    continue
+            rule.metrics["matched"] += 1
+            try:
+                outs = eval_rule(rule.parsed, columns)
+            except Exception:
+                rule.metrics["failed"] += 1
+                continue
+            if outs:
+                rule.metrics["passed"] += 1
+            else:
+                rule.metrics["no_result"] += 1
+                continue
+            results.append(RuleResult(rule.id, outs))
+            for out in outs:
+                self._run_actions(rule, out, columns)
+        return results
+
+    def _run_actions(
+        self, rule: Rule, output: Dict[str, Any], columns: Dict[str, Any]
+    ) -> None:
+        for action in rule.actions:
+            try:
+                if isinstance(action, dict) and action.get("function") == "republish":
+                    self._republish(action.get("args", {}), output, columns)
+                elif isinstance(action, dict) and action.get("function") == "console":
+                    print(f"[rule {rule.id}] {output}")
+                elif callable(action):
+                    action(output, columns)
+                else:
+                    raise ValueError(f"bad action {action!r}")
+                rule.metrics["actions.success"] += 1
+            except Exception:
+                rule.metrics["actions.failed"] += 1
+
+    def _republish(
+        self, args: Dict[str, Any], output: Dict[str, Any],
+        columns: Dict[str, Any],
+    ) -> None:
+        if self.broker is None:
+            raise RuntimeError("republish needs a broker")
+        topic = render_template(args.get("topic", "republish/${topic}"),
+                                output, columns)
+        payload_tpl = args.get("payload", "${payload}")
+        payload = render_template(payload_tpl, output, columns).encode()
+        qos_t = args.get("qos", 0)
+        qos = int(render_template(str(qos_t), output, columns) or 0) \
+            if isinstance(qos_t, str) else int(qos_t)
+        msg = make_message(None, topic, payload, qos=qos)
+        # loop guard: republished messages skip rule evaluation once
+        msg.headers["republish_by"] = args.get("rule_id", "rule")
+        self.broker.publish(msg)
+
+    # ------------------------------------------------------------------
+    # broker wiring
+    # ------------------------------------------------------------------
+
+    def _attach(self, broker: Broker) -> None:
+        def on_publish(acc: Message):
+            if acc is None or acc.topic.startswith("$SYS"):
+                return acc
+            if "republish_by" in acc.headers:
+                return acc  # loop guard
+            self.apply_event(acc.topic, message_columns(acc))
+            return acc
+
+        broker.hooks.add("message.publish", on_publish, priority=-50,
+                         name="rule_engine.publish")
+
+        def mk(hook: str, builder):
+            def cb(*args):
+                self.apply_event(hook, builder(*args), is_event=True)
+            return cb
+
+        broker.hooks.add(
+            "client.connected",
+            mk("client.connected", lambda cid, conninfo: {
+                "clientid": cid, "event": "client.connected",
+                "username": (conninfo or {}).get("username")
+                if isinstance(conninfo, dict) else None,
+                "timestamp": int(time.time() * 1000),
+            }),
+            priority=-50, name="rule_engine.connected",
+        )
+        broker.hooks.add(
+            "client.disconnected",
+            mk("client.disconnected", lambda cid, reason: {
+                "clientid": cid, "event": "client.disconnected",
+                "reason": reason, "timestamp": int(time.time() * 1000),
+            }),
+            priority=-50, name="rule_engine.disconnected",
+        )
+        broker.hooks.add(
+            "session.subscribed",
+            mk("session.subscribed", lambda cid, flt, opts, is_new: {
+                "clientid": cid, "event": "session.subscribed",
+                "topic": flt, "qos": opts.qos,
+                "timestamp": int(time.time() * 1000),
+            }),
+            priority=-50, name="rule_engine.subscribed",
+        )
+        broker.hooks.add(
+            "session.unsubscribed",
+            mk("session.unsubscribed", lambda cid, flt: {
+                "clientid": cid, "event": "session.unsubscribed",
+                "topic": flt, "timestamp": int(time.time() * 1000),
+            }),
+            priority=-50, name="rule_engine.unsubscribed",
+        )
+        broker.hooks.add(
+            "message.delivered",
+            mk("message.delivered", lambda cid, msg: {
+                **message_columns(msg), "event": "message.delivered",
+                "clientid": cid, "from_clientid": msg.sender,
+            }),
+            priority=-50, name="rule_engine.delivered",
+        )
+        broker.hooks.add(
+            "message.acked",
+            mk("message.acked", lambda cid, msg: {
+                **message_columns(msg), "event": "message.acked",
+                "clientid": cid, "from_clientid": msg.sender,
+            }),
+            priority=-50, name="rule_engine.acked",
+        )
+        broker.hooks.add(
+            "message.dropped",
+            mk("message.dropped", lambda msg, reason: {
+                **message_columns(msg), "event": "message.dropped",
+                "reason": reason,
+            }),
+            priority=-50, name="rule_engine.dropped",
+        )
+
+    # ------------------------------------------------------------------
+    # device co-batch (north star: BASELINE config #3)
+    # ------------------------------------------------------------------
+
+    def compile_table(self, depth: int = 16):
+        """Compile all enabled publish-rule FROM filters into one NFA
+        table.  Returns ``(table, {filter: [rule_id]})`` or ``(None, {})``.
+
+        The sidecar unions these filters with the route mirror's filter
+        set so ONE kernel batch answers both "which subscribers" and
+        "which rules" per topic."""
+        from ..ops import compile_filters
+
+        by_filter: Dict[str, List[str]] = {}
+        for rule in self.rules.values():
+            if not rule.enable:
+                continue
+            for f in rule.publish_filters():
+                by_filter.setdefault(f, []).append(rule.id)
+        if not by_filter:
+            return None, {}
+        return compile_filters(by_filter.keys(), depth=depth), by_filter
+
+
+def message_columns(msg: Message) -> Dict[str, Any]:
+    """The message.publish event column set (emqx_rule_events fields [U])."""
+    return {
+        "id": msg.id,
+        "clientid": msg.sender,
+        "username": msg.headers.get("username"),
+        "topic": msg.topic,
+        "qos": msg.qos,
+        "payload": msg.payload,
+        "retain": msg.retain,
+        "dup": msg.dup,
+        "flags": {"retain": msg.retain, "dup": msg.dup},
+        "pub_props": dict(msg.properties),
+        "timestamp": int(msg.timestamp * 1000),
+        "publish_received_at": int(msg.timestamp * 1000),
+        "node": "local",
+    }

@@ -1,0 +1,15 @@
+"""L7 services on the hook bus (SURVEY.md §2.3): retainer, delayed
+publish, topic rewrite, auto-subscribe — each the analog of one reference
+app (``apps/emqx_retainer``, ``apps/emqx_delayed``, ``apps/emqx_modules``,
+``apps/emqx_auto_subscribe`` [U]), attached to a Broker's hook bus.
+"""
+
+from .retainer import Retainer
+from .delayed import DelayedPublish
+from .rewrite import TopicRewrite, RewriteRule
+from .auto_subscribe import AutoSubscribe
+
+__all__ = [
+    "Retainer", "DelayedPublish", "TopicRewrite", "RewriteRule",
+    "AutoSubscribe",
+]

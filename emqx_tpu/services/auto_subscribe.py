@@ -1,0 +1,51 @@
+"""Server-side forced subscriptions on connect.
+
+Behavioral reference: ``apps/emqx_auto_subscribe`` [U] (SURVEY.md §2.3):
+a configured list of topic filters (with ``%c`` clientid / ``%u``
+username placeholders) every connecting client is subscribed to, with
+fixed SubOpts per entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..broker.broker import Broker
+from ..broker.session import SubOpts
+
+__all__ = ["AutoSubscribe", "AutoSubEntry"]
+
+
+@dataclass
+class AutoSubEntry:
+    topic: str                      # may contain %c / %u placeholders
+    opts: SubOpts = field(default_factory=SubOpts)
+
+
+class AutoSubscribe:
+    def __init__(self, entries: Optional[List[AutoSubEntry]] = None) -> None:
+        self.entries = list(entries or [])
+
+    def add(self, topic: str, opts: SubOpts = SubOpts()) -> None:
+        self.entries.append(AutoSubEntry(topic, opts))
+
+    def topics_for(self, clientid: str, username: Optional[str]) -> List[AutoSubEntry]:
+        out = []
+        for e in self.entries:
+            t = e.topic.replace("%c", clientid).replace("%u", username or "")
+            out.append(AutoSubEntry(t, e.opts))
+        return out
+
+    def attach(self, broker: Broker) -> "AutoSubscribe":
+        def on_connected(clientid, conninfo):
+            username = conninfo.get("username") if isinstance(conninfo, dict) else None
+            for e in self.topics_for(clientid, username):
+                try:
+                    broker.subscribe(clientid, e.topic, e.opts)
+                except (KeyError, ValueError):
+                    pass  # no session yet / bad template — skip like the ref
+
+        broker.hooks.add("client.connected", on_connected,
+                         name="auto_subscribe")
+        return self

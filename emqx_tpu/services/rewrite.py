@@ -1,0 +1,101 @@
+"""Topic rewrite rules (pub/sub), the ``emqx_modules`` rewrite analog.
+
+Behavioral reference: the topic-rewrite module of ``apps/emqx_modules``
+[U] (SURVEY.md §2.3): ordered rules ``{action pub|sub|all, source filter,
+regex, dest template}``.  A topic matching the source filter AND the
+regex is rewritten to the dest template with ``$N`` capture groups (and
+``%c``/``%u`` client placeholders); the LAST matching rule wins, exactly
+like the reference's fold over the rule list.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import List, Optional
+
+from .. import topic as T
+from ..broker.broker import Broker
+from ..broker.message import Message
+
+__all__ = ["RewriteRule", "TopicRewrite"]
+
+
+@dataclass
+class RewriteRule:
+    action: str          # 'pub' | 'sub' | 'all'
+    source: str          # topic filter selecting rewritable topics
+    re_pattern: str      # regex the topic must match
+    dest: str            # template with $1..$9, %c, %u
+
+    def __post_init__(self) -> None:
+        if self.action not in ("pub", "sub", "all"):
+            raise ValueError(f"bad action {self.action!r}")
+        T.validate(self.source, "filter")
+        self._re = re.compile(self.re_pattern)
+
+    def apply(
+        self, topic: str, clientid: Optional[str], username: Optional[str]
+    ) -> Optional[str]:
+        if not T.match(topic, self.source):
+            return None
+        m = self._re.match(topic)
+        if m is None:
+            return None
+        out = self.dest
+        for i, g in enumerate(m.groups() or (), start=1):
+            out = out.replace(f"${i}", g or "")
+        out = out.replace("%c", clientid or "").replace("%u", username or "")
+        return out
+
+
+class TopicRewrite:
+    def __init__(self, rules: Optional[List[RewriteRule]] = None) -> None:
+        self.rules: List[RewriteRule] = list(rules or [])
+
+    def add_rule(self, rule: RewriteRule) -> None:
+        self.rules.append(rule)
+
+    def rewrite(
+        self, topic: str, kind: str,
+        clientid: Optional[str] = None, username: Optional[str] = None,
+    ) -> str:
+        """kind 'pub' or 'sub'; last matching rule wins (reference fold)."""
+        out = topic
+        for rule in self.rules:
+            if rule.action != "all" and rule.action != kind:
+                continue
+            new = rule.apply(topic, clientid, username)
+            if new is not None:
+                out = new
+        return out
+
+    # ------------------------------------------------------------------
+
+    def attach(self, broker: Broker) -> "TopicRewrite":
+        def on_publish(acc: Message):
+            if acc is None or acc.topic.startswith("$SYS"):
+                return acc
+            new = self.rewrite(acc.topic, "pub", acc.sender)
+            return acc if new == acc.topic else acc.clone(topic=new)
+
+        def on_subscribe(clientid, pkt):
+            # mutate the SUBSCRIBE packet's filters in place (channel
+            # passes its live packet through the hook chain)
+            pkt.topic_filters = [
+                (self.rewrite(f, "sub", clientid), o)
+                for f, o in pkt.topic_filters
+            ]
+
+        def on_unsubscribe(clientid, pkt):
+            pkt.topic_filters = [
+                self.rewrite(f, "sub", clientid) for f in pkt.topic_filters
+            ]
+
+        broker.hooks.add("message.publish", on_publish, priority=50,
+                         name="rewrite.pub")
+        broker.hooks.add("client.subscribe", on_subscribe, priority=50,
+                         name="rewrite.sub")
+        broker.hooks.add("client.unsubscribe", on_unsubscribe, priority=50,
+                         name="rewrite.unsub")
+        return self

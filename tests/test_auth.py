@@ -1,0 +1,253 @@
+"""AuthN chain + AuthZ sources — emqx_authn/emqx_authz/emqx_access_control
+parity (SURVEY.md §2.3), incl. the NFA-compiled device ACL batch path."""
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+
+from emqx_tpu.auth import (
+    AclRule, AuthChain, Authz, BuiltinDbAuthenticator, BuiltinDbSource,
+    Credentials, FileSource, JwtAuthenticator, attach_auth,
+)
+from emqx_tpu.auth.authz import batch_authorize, compile_acl_batch
+from emqx_tpu.broker import Broker
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.cm import ConnectionManager
+from emqx_tpu.mqtt import packet as P
+
+
+# ---------------------------------------------------------------------------
+# authn
+
+
+def test_builtin_db_sha256_chain():
+    a = BuiltinDbAuthenticator(algo="sha256", salt_position="prefix")
+    a.add_user("alice", b"secret", is_superuser=True)
+    chain = AuthChain(allow_anonymous=False).add(a)
+    ok = chain.authenticate(Credentials("c1", "alice", b"secret"))
+    assert ok.outcome == "ok" and ok.is_superuser
+    assert chain.authenticate(Credentials("c1", "alice", b"wrong")).outcome == "deny"
+    # unknown user → ignore → anonymous policy (deny here)
+    assert chain.authenticate(Credentials("c1", "bob", b"x")).outcome == "deny"
+    assert AuthChain(allow_anonymous=True).authenticate(
+        Credentials("c1")).outcome == "ok"
+
+
+def test_builtin_db_pbkdf2_and_clientid_type():
+    a = BuiltinDbAuthenticator(user_id_type="clientid", algo="pbkdf2")
+    a.add_user("dev1", b"pw")
+    assert a.authenticate(Credentials("dev1", None, b"pw")).outcome == "ok"
+    assert a.authenticate(Credentials("dev2", None, b"pw")).outcome == "ignore"
+
+
+def _make_jwt(secret: bytes, claims: dict, alg="HS256") -> bytes:
+    def enc(d):
+        return base64.urlsafe_b64encode(json.dumps(d).encode()).rstrip(b"=")
+
+    h = enc({"alg": alg, "typ": "JWT"})
+    b = enc(claims)
+    digest = {"HS256": "sha256", "HS384": "sha384", "HS512": "sha512"}[alg]
+    sig = base64.urlsafe_b64encode(
+        hmac.new(secret, h + b"." + b, digest).digest()
+    ).rstrip(b"=")
+    return h + b"." + b + b"." + sig
+
+
+def test_jwt_authenticator():
+    j = JwtAuthenticator(b"topsecret", verify_claims={"sub": "%c"})
+    good = _make_jwt(b"topsecret", {"sub": "c1", "exp": time.time() + 60})
+    assert j.authenticate(Credentials("c1", password=good)).outcome == "ok"
+    # wrong clientid claim
+    assert j.authenticate(Credentials("c2", password=good)).outcome == "deny"
+    # expired
+    old = _make_jwt(b"topsecret", {"sub": "c1", "exp": time.time() - 1})
+    assert j.authenticate(Credentials("c1", password=old)).outcome == "deny"
+    # bad signature
+    forged = _make_jwt(b"wrong", {"sub": "c1"})
+    assert j.authenticate(Credentials("c1", password=forged)).outcome == "deny"
+    # not a JWT → ignore (next in chain)
+    assert j.authenticate(Credentials("c1", password=b"plain")).outcome == "ignore"
+    # superuser + acl claims carried through
+    su = _make_jwt(b"topsecret", {"sub": "c1", "is_superuser": True, "acl": ["t/#"]})
+    res = j.authenticate(Credentials("c1", password=su))
+    assert res.is_superuser and res.attrs["acl"] == ["t/#"]
+
+
+# ---------------------------------------------------------------------------
+# authz
+
+
+def _authz(rules, **kw):
+    return Authz([FileSource(rules)], **kw)
+
+
+def test_acl_first_match_wins_and_no_match_policy():
+    az = _authz([
+        AclRule("deny", "publish", ["forbidden/#"]),
+        AclRule("allow", "all", ["#"]),
+    ])
+    assert not az.authorize("c", "publish", "forbidden/x")
+    assert az.authorize("c", "publish", "ok/x")
+    az2 = _authz([AclRule("allow", "subscribe", ["a/b"])], no_match="deny")
+    assert not az2.authorize("c", "publish", "a/b")   # action mismatch → nomatch → deny
+    assert az2.authorize("c", "subscribe", "a/b")
+
+
+def test_acl_placeholders_and_eq():
+    az = _authz([
+        AclRule("allow", "all", ["own/%c/#"]),
+        AclRule("allow", "subscribe", ["eq priv/+/x"]),
+        AclRule("deny", "all", ["#"]),
+    ], cache_enable=False)
+    assert az.authorize("c1", "publish", "own/c1/data")
+    assert not az.authorize("c1", "publish", "own/c2/data")
+    # 'eq' is literal: only the verbatim topic with '+' matches
+    assert az.authorize("c1", "subscribe", "priv/+/x")
+    assert not az.authorize("c1", "subscribe", "priv/a/x")
+
+
+def test_acl_who_dimensions():
+    az = _authz([
+        AclRule("deny", "all", ["#"], who="user:mallory"),
+        AclRule("deny", "all", ["#"], who="ip:10.0.0.0/8"),
+        AclRule("allow", "all", ["#"]),
+    ], cache_enable=False)
+    assert not az.authorize("c", "publish", "t", username="mallory")
+    assert not az.authorize("c", "publish", "t", peerhost="10.1.2.3")
+    assert az.authorize("c", "publish", "t", username="alice", peerhost="192.168.0.1")
+
+
+def test_authz_cache_and_superuser():
+    az = _authz([AclRule("deny", "all", ["#"])], no_match="deny")
+    assert az.authorize("root", "publish", "t", is_superuser=True)
+    assert not az.authorize("c", "publish", "t", now=100.0)
+    assert not az.authorize("c", "publish", "t", now=101.0)
+    assert az.metrics["cache_hit"] == 1
+    # ttl expiry forces re-eval
+    assert not az.authorize("c", "publish", "t", now=1000.0)
+    assert az.metrics["cache_miss"] == 2
+
+
+def test_builtin_db_source_precedence():
+    src = BuiltinDbSource()
+    src.set_rules([AclRule("allow", "all", ["a/#"])], clientid="c1")
+    src.set_rules([AclRule("deny", "all", ["a/#"])], username="u1")
+    az = Authz([src], no_match="deny", cache_enable=False)
+    # client rules take precedence over user rules
+    assert az.authorize("c1", "publish", "a/x", username="u1")
+    assert not az.authorize("c2", "publish", "a/x", username="u1")
+
+
+def test_acl_device_batch_matches_host():
+    rules = [
+        AclRule("deny", "publish", ["secret/#"]),
+        AclRule("allow", "publish", ["s/+/temp", "pub/#"]),
+        AclRule("deny", "all", ["#"]),
+    ]
+    src = FileSource(rules)
+    table, idx = compile_acl_batch([src])
+    assert table is not None
+    topics = ["secret/a", "s/1/temp", "pub/x/y", "other/t", "s/1/hum"]
+    got = batch_authorize(table, idx, topics, "publish", no_match="allow")
+    az = Authz([src], cache_enable=False)
+    want = [az.authorize("cX", "publish", t) for t in topics]
+    assert got == want == [False, True, True, False, False]
+
+
+def test_acl_device_batch_refuses_non_static_rules():
+    # all-or-nothing: ANY rule the table can't express keeps authz on host
+    for bad in (
+        AclRule("allow", "all", ["own/%c/#"]),           # placeholder
+        AclRule("deny", "all", ["#"], who="user:m"),     # who-specific
+        AclRule("deny", "publish", ["t"], retain=True),  # retain constraint
+        AclRule("deny", "publish", ["t"], qos=[1, 2]),   # qos constraint
+    ):
+        table, idx = compile_acl_batch(
+            [FileSource([AclRule("allow", "all", ["ok/#"]), bad])]
+        )
+        assert table is None and idx == {}
+
+
+def test_acl_placeholder_wildcard_injection_blocked():
+    az = _authz([
+        AclRule("allow", "all", ["own/%c/#"]),
+        AclRule("deny", "all", ["#"]),
+    ], no_match="deny", cache_enable=False)
+    # a clientid of '+' must NOT become the pattern 'own/+/#'
+    assert not az.authorize("+", "publish", "own/alice/data")
+    assert not az.authorize("a/b", "publish", "own/a/b")  # '/' injection
+    assert az.authorize("alice", "publish", "own/alice/data")
+
+
+def test_ip_acl_enforced_through_channel_hook():
+    broker = Broker()
+    cm = ConnectionManager(broker)
+    attach_auth(
+        broker, AuthChain(allow_anonymous=True),
+        Authz([FileSource([
+            AclRule("deny", "all", ["#"], who="ip:10.0.0.0/8"),
+            AclRule("allow", "all", ["#"]),
+        ])]),
+    )
+    ch = Channel(broker, cm, conninfo={"peerhost": "10.1.2.3"})
+    ch.handle_in(P.Connect(proto_ver=5, clientid="c1"))
+    acts = ch.handle_in(P.Publish(qos=1, topic="t", packet_id=1, payload=b"x"))
+    assert acts[0][1].reason_code == P.RC.NOT_AUTHORIZED
+
+
+def test_unsubscribe_runs_rewrite_hook():
+    from emqx_tpu.services import RewriteRule, TopicRewrite
+
+    broker = Broker()
+    cm = ConnectionManager(broker)
+    TopicRewrite([RewriteRule("sub", "old/#", r"^old/(.+)$", "new/$1")]
+                 ).attach(broker)
+    ch = Channel(broker, cm)
+    ch.handle_in(P.Connect(proto_ver=5, clientid="c1"))
+    ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("old/a", {"qos": 0})]))
+    assert "new/a" in broker.sessions["c1"].subscriptions
+    acts = ch.handle_in(P.Unsubscribe(packet_id=2, topic_filters=["old/a"]))
+    assert acts[0][1].reason_codes == [P.RC.SUCCESS]
+    assert "new/a" not in broker.sessions["c1"].subscriptions
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the channel
+
+
+def test_connect_auth_and_publish_acl_through_channel():
+    broker = Broker()
+    cm = ConnectionManager(broker)
+    a = BuiltinDbAuthenticator()
+    a.add_user("alice", b"pw")
+    chain = AuthChain(allow_anonymous=False).add(a)
+    authz = Authz(
+        [FileSource([
+            AclRule("allow", "all", ["ok/#"]),
+            AclRule("deny", "all", ["#"]),
+        ])],
+        no_match="deny",
+    )
+    attach_auth(broker, chain, authz)
+
+    # bad credentials → CONNACK error
+    ch = Channel(broker, cm)
+    acts = ch.handle_in(P.Connect(proto_ver=5, clientid="c1",
+                                  username="alice", password=b"no"))
+    connack = [a[1] for a in acts if a[0] == "send"][0]
+    assert connack.reason_code == P.RC.BAD_USER_NAME_OR_PASSWORD
+
+    # good credentials → connected; ACL enforced on publish+subscribe
+    ch2 = Channel(broker, cm)
+    acts = ch2.handle_in(P.Connect(proto_ver=5, clientid="c1",
+                                   username="alice", password=b"pw"))
+    assert [a[1] for a in acts if a[0] == "send"][0].reason_code == P.RC.SUCCESS
+    acts = ch2.handle_in(P.Publish(qos=1, topic="denied/t", packet_id=1,
+                                   payload=b"x"))
+    assert acts[0][1].reason_code == P.RC.NOT_AUTHORIZED
+    acts = ch2.handle_in(P.Subscribe(packet_id=2,
+                                     topic_filters=[("ok/#", {"qos": 0}),
+                                                    ("denied/#", {"qos": 0})]))
+    assert acts[0][1].reason_codes == [0, P.RC.NOT_AUTHORIZED]

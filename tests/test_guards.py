@@ -1,0 +1,93 @@
+"""Banned / flapping / limiter / overload protection — emqx_banned,
+emqx_flapping, emqx_limiter, emqx_olp parity (SURVEY.md §2.1)."""
+
+from emqx_tpu.broker import Banned, Broker, Flapping, LimiterGroup, Olp, TokenBucket
+from emqx_tpu.broker.channel import Channel
+from emqx_tpu.broker.cm import ConnectionManager
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.observe import Alarms
+
+
+def test_banned_dimensions_and_expiry():
+    b = Banned()
+    b.add("clientid", "evil", duration=100)
+    b.add("username", "mallory")
+    b.add("peerhost", "10.0.0.1", duration=0.0)  # already expired
+    assert b.check(clientid="evil")
+    assert b.check(username="mallory")
+    assert not b.check(peerhost="10.0.0.1")
+    assert not b.check(clientid="good")
+    assert b.delete("clientid", "evil")
+    assert not b.check(clientid="evil")
+
+
+def test_banned_blocks_connect_with_banned_rc():
+    broker = Broker()
+    cm = ConnectionManager(broker)
+    banned = Banned().attach(broker)
+    banned.add("clientid", "evil")
+    ch = Channel(broker, cm)
+    acts = ch.handle_in(P.Connect(proto_ver=5, clientid="evil"))
+    connacks = [a[1] for a in acts if a[0] == "send" and a[1].type == P.CONNACK]
+    assert connacks[0].reason_code == P.RC.BANNED
+    assert any(a[0] == "close" for a in acts)
+
+
+def test_flapping_bans_after_threshold():
+    broker = Broker()
+    banned = Banned().attach(broker)
+    f = Flapping(banned, max_count=3, window_time=10, ban_time=60).attach(broker)
+    for _ in range(2):
+        broker.hooks.run("client.disconnected", ("c1", "x"))
+    assert not banned.check(clientid="c1")
+    broker.hooks.run("client.disconnected", ("c1", "x"))
+    assert banned.check(clientid="c1")
+    assert f.detected == 1
+
+
+def test_flapping_window_slides():
+    banned = Banned()
+    f = Flapping(banned, max_count=3, window_time=10)
+    f.record_disconnect("c", now=0)
+    f.record_disconnect("c", now=1)
+    f.record_disconnect("c", now=12)  # first two aged out
+    assert not banned.check(clientid="c")
+
+
+def test_token_bucket():
+    tb = TokenBucket(rate=10, burst=10)
+    ok, wait = tb.consume(10, now=0)
+    assert ok and wait == 0
+    ok, wait = tb.consume(5, now=0)
+    assert not ok and abs(wait - 0.5) < 1e-9
+    ok, wait = tb.consume(5, now=0.5)  # refilled 5
+    assert ok
+    assert TokenBucket(0).consume(1000)[0]  # unlimited
+
+
+def test_limiter_group_per_connection():
+    lg = LimiterGroup(max_conn_rate=1, max_messages_rate=2, max_bytes_rate=100)
+    assert lg.allow_connect(now=0)[0]
+    assert not lg.allow_connect(now=0)[0]
+    ok, _ = lg.allow_publish("c1", 50, now=0)
+    assert ok
+    ok, _ = lg.allow_publish("c1", 50, now=0)
+    assert ok
+    ok, wait = lg.allow_publish("c1", 1, now=0)  # msg tokens exhausted
+    assert not ok and wait > 0
+    lg.drop_conn("c1")
+    assert lg.allow_publish("c1", 1, now=10)[0]
+
+
+def test_olp_shedding_and_alarm():
+    alarms = Alarms()
+    olp = Olp(alarms, max_loop_lag=0.1, max_queue_depth=10, cooloff=5)
+    olp.report(loop_lag=0.05, queue_depth=1, now=0)
+    assert not olp.should_shed_connect(now=0)
+    olp.report(loop_lag=0.5, queue_depth=1, now=1)
+    assert olp.should_shed_connect(now=1)
+    assert alarms.is_active("overload")
+    # cools off after quiet period
+    olp.report(loop_lag=0.0, queue_depth=0, now=10)
+    assert not olp.should_shed_connect(now=10)
+    assert not alarms.is_active("overload")

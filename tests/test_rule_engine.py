@@ -1,0 +1,200 @@
+"""Rule engine — emqx_rule_engine parity (SURVEY.md §2.3, §3.5):
+parser, runtime, builtin functions, events, actions, device co-batch."""
+
+import json
+
+import pytest
+
+from emqx_tpu.broker import Broker
+from emqx_tpu.broker.message import make_message
+from emqx_tpu.rule_engine import (
+    RuleEngine, eval_rule, parse_sql, render_template, SqlError,
+)
+from emqx_tpu.rule_engine.engine import message_columns
+
+
+def _msg(topic, payload=b"{}", qos=0, **kw):
+    return make_message("c1", topic, payload, qos=qos, **kw)
+
+
+# ---------------------------------------------------------------------------
+# parser
+
+
+def test_parse_select_basics():
+    r = parse_sql('SELECT payload.x as x, topic FROM "t/#" WHERE qos > 0')
+    assert r.kind == "select"
+    assert r.froms == ["t/#"]
+    assert r.fields[0] == (("var", ["payload", "x"]), "x")
+    assert r.where == ("op", ">", ("var", ["qos"]), ("lit", 0))
+
+
+def test_parse_star_multi_from_and_errors():
+    r = parse_sql('SELECT * FROM "a/+", "$events/client_connected"')
+    assert r.fields == [("*", None)]
+    assert len(r.froms) == 2
+    with pytest.raises(SqlError):
+        parse_sql("DELETE FROM x")
+    with pytest.raises(SqlError):
+        parse_sql('SELECT * FROM "t" WHERE (1 + ')
+
+
+def test_parse_foreach():
+    r = parse_sql(
+        "FOREACH payload.sensors AS s DO s.name, s.temp "
+        'INCASE s.temp > 30 FROM "t"'
+    )
+    assert r.kind == "foreach"
+    assert r.foreach_alias == "s"
+    assert r.incase is not None
+    assert len(r.fields) == 2
+
+
+# ---------------------------------------------------------------------------
+# runtime
+
+
+def _run(sql, columns):
+    return eval_rule(parse_sql(sql), columns)
+
+
+def test_where_filtering_and_select_output():
+    cols = message_columns(_msg("t/1", b'{"temp": 31.5, "ok": true}', qos=1))
+    out = _run('SELECT payload.temp as temp, topic FROM "t/#" '
+               "WHERE payload.temp > 30 and qos = 1", cols)
+    assert out == [{"temp": 31.5, "topic": "t/1"}]
+    assert _run('SELECT * FROM "t/#" WHERE payload.temp > 100', cols) == []
+
+
+def test_arithmetic_string_case_in_like():
+    cols = {"a": 7, "b": 2, "s": "hello", "topic": "t/x"}
+    [out] = _run(
+        "SELECT a + b as add, a div b as d, a mod b as m, "
+        "upper(s) as up, concat(s, '!') as ex, "
+        "case when a > 5 then 'big' else 'small' end as sz, "
+        "a in (1, 7) as isin, s like 'he%' as lk "
+        'FROM "t/#"', cols)
+    assert out == {
+        "add": 9, "d": 3, "m": 1, "up": "HELLO", "ex": "hello!",
+        "sz": "big", "isin": True, "lk": True,
+    }
+
+
+def test_foreach_incase_fanout():
+    payload = json.dumps({"sensors": [
+        {"name": "a", "temp": 20}, {"name": "b", "temp": 35},
+        {"name": "c", "temp": 40},
+    ]}).encode()
+    cols = message_columns(_msg("t", payload))
+    outs = _run(
+        "FOREACH payload.sensors AS s DO s.name as name, s.temp as temp "
+        'INCASE s.temp > 30 FROM "t"', cols)
+    assert outs == [{"name": "b", "temp": 35}, {"name": "c", "temp": 40}]
+
+
+def test_builtin_funcs_sampler():
+    cols = {"payload": b'{"xs": [1, 2, 3], "m": {"k": "v"}}', "topic": "a/b/c"}
+    [out] = _run(
+        "SELECT nth(2, payload.xs) as n, length(payload.xs) as l, "
+        "map_get('k', payload.m) as mk, first(payload.xs) as f, "
+        "json_encode(payload.xs) as js, md5('abc') as h, "
+        "nth_topic_level(2, topic) as lvl, "
+        "topic_match(topic, 'a/#') as tm "
+        'FROM "a/#"', cols)
+    assert out["n"] == 2 and out["l"] == 3 and out["mk"] == "v"
+    assert out["f"] == 1 and out["js"] == "[1,2,3]"
+    assert out["h"] == "900150983cd24fb0d6963f7d28e17f72"
+    assert out["lvl"] == "b" and out["tm"] is True
+
+
+def test_render_template():
+    out = {"temp": 31.5, "nested": {"a": 1}}
+    cols = {"clientid": "c9", "topic": "t/1"}
+    assert render_template("alert/${clientid}/${temp}", out, cols) == "alert/c9/31.5"
+    assert render_template("${nested}", out, cols) == '{"a":1}'
+    assert render_template("${missing}", out, cols) == ""
+
+
+# ---------------------------------------------------------------------------
+# engine + broker wiring
+
+
+def test_engine_publish_event_and_republish_action():
+    b = Broker()
+    eng = RuleEngine(b)
+    b.open_session("listener")
+    b.subscribe("listener", "alert/#")
+    eng.create_rule(
+        "r1",
+        'SELECT payload.temp as temp, clientid FROM "sensors/+/temp" '
+        "WHERE payload.temp > 30",
+        actions=[{"function": "republish",
+                  "args": {"topic": "alert/${clientid}",
+                           "payload": "hot: ${temp}"}}],
+    )
+    b.publish(_msg("sensors/k/temp", b'{"temp": 35}'))
+    sess = b.sessions["listener"]
+    # republished message delivered (qos0 → direct send path drains to outbox
+    # via publish result of the inner publish; check metrics instead)
+    r = eng.rules["r1"]
+    assert r.metrics["matched"] == 1
+    assert r.metrics["passed"] == 1
+    assert r.metrics["actions.success"] == 1
+    # non-matching topic / failing WHERE
+    b.publish(_msg("sensors/k/hum", b'{"temp": 35}'))
+    b.publish(_msg("sensors/k/temp", b'{"temp": 5}'))
+    assert r.metrics["matched"] == 2
+    assert r.metrics["no_result"] == 1
+
+
+def test_engine_republish_loop_guard():
+    b = Broker()
+    eng = RuleEngine(b)
+    eng.create_rule(
+        "loop",
+        'SELECT * FROM "x/#"',
+        actions=[{"function": "republish", "args": {"topic": "x/again",
+                                                    "payload": "p"}}],
+    )
+    b.publish(_msg("x/start"))
+    # the republish matched x/# but was NOT re-evaluated (loop guard)
+    assert eng.rules["loop"].metrics["matched"] == 1
+
+
+def test_engine_lifecycle_events():
+    b = Broker()
+    eng = RuleEngine(b)
+    seen = []
+    eng.create_rule(
+        "ev",
+        'SELECT clientid, topic, qos FROM "$events/session_subscribed"',
+        actions=[lambda out, cols: seen.append(out)],
+    )
+    b.open_session("c2")
+    b.subscribe("c2", "a/b")
+    assert seen == [{"clientid": "c2", "topic": "a/b", "qos": 0}]
+
+
+def test_engine_compile_table_cobatch():
+    from emqx_tpu.ops import match_topics
+
+    eng = RuleEngine()
+    eng.create_rule("r1", 'SELECT * FROM "s/+/t"', actions=[])
+    eng.create_rule("r2", 'SELECT * FROM "s/#", "other/x"', actions=[])
+    eng.create_rule("off", 'SELECT * FROM "zzz/#"', actions=[])
+    eng.set_enable("off", False)
+    table, by_filter = eng.compile_table()
+    assert set(by_filter) == {"s/+/t", "s/#", "other/x"}
+    [m] = match_topics(table, ["s/1/t"])
+    rule_ids = sorted(rid for f in m for rid in by_filter[f])
+    assert rule_ids == ["r1", "r2"]
+
+
+def test_epoch_bumps_on_changes():
+    eng = RuleEngine()
+    e0 = eng.epoch
+    eng.create_rule("a", 'SELECT * FROM "t"', actions=[])
+    assert eng.epoch == e0 + 1
+    eng.set_enable("a", False)
+    eng.delete_rule("a")
+    assert eng.epoch == e0 + 3

@@ -1,0 +1,206 @@
+"""Retainer / delayed / rewrite / auto-subscribe — the emqx_retainer,
+emqx_delayed, emqx_modules, emqx_auto_subscribe parity surface
+(SURVEY.md §2.3)."""
+
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.broker import Broker, SubOpts
+from emqx_tpu.broker.message import make_message
+from emqx_tpu.services import (
+    AutoSubscribe, DelayedPublish, Retainer, RewriteRule, TopicRewrite,
+)
+
+
+def _msg(topic, payload=b"x", retain=False, qos=0, props=None, sender="p"):
+    return make_message(sender, topic, payload, qos=qos, retain=retain,
+                        properties=props or {})
+
+
+# ---------------------------------------------------------------------------
+# Retainer
+
+
+def test_retainer_store_and_empty_payload_delete():
+    r = Retainer()
+    r.insert(_msg("a/b", b"1", retain=True))
+    assert len(r) == 1
+    r.insert(_msg("a/b", b"", retain=True))  # MQTT §3.3.1.3 delete
+    assert len(r) == 0
+
+
+def test_retainer_wildcard_match_host():
+    r = Retainer()
+    for t in ("a/b", "a/c", "a/b/c", "x/y", "$SYS/broker/uptime"):
+        r.insert(_msg(t, b"1", retain=True))
+    assert {m.topic for m in r.match("a/+")} == {"a/b", "a/c"}
+    assert {m.topic for m in r.match("a/#")} == {"a/b", "a/c", "a/b/c"}
+    assert {m.topic for m in r.match("#")} == {"a/b", "a/c", "a/b/c", "x/y"}
+    assert {m.topic for m in r.match("+/y")} == {"x/y"}
+    # $-topics need an explicit prefix (MQTT §4.7.2)
+    assert {m.topic for m in r.match("$SYS/#")} == {"$SYS/broker/uptime"}
+    # parity with the oracle on every pair
+    for flt in ("a/+", "a/#", "#", "+/y", "$SYS/#", "+/+"):
+        got = {m.topic for m in r.match(flt)}
+        want = {t for t in r.topics() if T.match(t, flt)}
+        assert got == want, flt
+
+
+def test_retainer_expiry_and_limits():
+    r = Retainer(max_payload_size=4, max_retained_messages=2)
+    assert not r.insert(_msg("big", b"12345", retain=True))
+    assert r.insert(_msg("a", b"1", retain=True))
+    assert r.insert(_msg("b", b"1", retain=True))
+    assert not r.insert(_msg("c", b"1", retain=True))  # table full
+    assert r.insert(_msg("a", b"2", retain=True))      # replace ok
+    r2 = Retainer()
+    r2.insert(_msg("t", b"1", retain=True,
+                   props={"Message-Expiry-Interval": 0.0001}))
+    import time as _t
+    _t.sleep(0.001)
+    assert r2.match("t") == []
+    assert r2.clean_expired() == 1 and len(r2) == 0
+
+
+def test_retainer_replay_batch_device_matches_host():
+    r = Retainer()
+    topics = ["s/1/temp", "s/2/temp", "s/1/hum", "b/x", "deep/a/b/c/d"]
+    for t in topics:
+        r.insert(_msg(t, b"1", retain=True))
+    filters = ["s/+/temp", "s/#", "b/x", "nope/+", "#"]
+    out = r.replay_batch(filters)
+    for f in filters:
+        want = sorted(t for t in topics if T.match(t, f))
+        assert [m.topic for m in out[f]] == want, f
+
+
+def test_retainer_broker_replay_rh_semantics():
+    b = Broker()
+    Retainer().attach(b)
+    b.open_session("pub")
+    res = b.publish(_msg("news/1", b"old", retain=True, qos=1))
+    b.open_session("s1")
+    # rh=0: replay on subscribe
+    b.subscribe("s1", "news/+", SubOpts(qos=1, rh=0))
+    pubs = b.take_outbox("s1")
+    assert len(pubs) == 1 and pubs[0].msg.retain and pubs[0].msg.payload == b"old"
+    # rh=1: replay only if new — resubscribe is not new
+    b.subscribe("s1", "news/+", SubOpts(qos=1, rh=1))
+    assert b.take_outbox("s1") == []
+    # rh=2: never
+    b.open_session("s2")
+    b.subscribe("s2", "news/+", SubOpts(qos=1, rh=2))
+    assert b.take_outbox("s2") == []
+    # $share subscriptions get no retained replay
+    b.open_session("s3")
+    b.subscribe("s3", "$share/g/news/+", SubOpts(qos=1))
+    assert b.take_outbox("s3") == []
+
+
+def test_retained_flag_set_on_replay_but_cleared_on_route():
+    b = Broker()
+    Retainer().attach(b)
+    b.open_session("live")
+    b.subscribe("live", "t", SubOpts())  # rap=0
+    res = b.publish(_msg("t", b"v", retain=True))
+    [pub] = res.publishes["live"]
+    assert pub.msg.retain is False  # live route clears retain (RAP off)
+    b.open_session("late")
+    b.subscribe("late", "t", SubOpts())
+    [pub] = b.take_outbox("late")
+    assert pub.msg.retain is True   # replay keeps it
+
+
+# ---------------------------------------------------------------------------
+# Delayed
+
+
+def test_delayed_intercept_and_due():
+    d = DelayedPublish()
+    now = 1000.0
+    assert d.intercept(_msg("$delayed/5/a/b"), now=now) is None
+    assert d.intercept(_msg("$delayed/bogus/a"), now=now) is None  # dropped
+    assert d.stats["dropped_bad_topic"] == 1
+    kept = d.intercept(_msg("normal/topic"), now=now)
+    assert kept is not None
+    assert len(d) == 1
+    assert d.due(now=1004.9) == []
+    [m] = d.due(now=1005.1)
+    assert m.topic == "a/b"
+    assert len(d) == 0
+
+
+def test_delayed_through_broker_pipeline():
+    b = Broker()
+    d = DelayedPublish().attach(b)
+    b.open_session("s")
+    b.subscribe("s", "room/light")
+    res = b.publish(_msg("$delayed/1/room/light", b"on"))
+    assert res.no_subscribers  # swallowed now
+    assert len(d) == 1
+    import time as _t
+    assert d.tick(now=_t.time() + 2) == 1
+    # delivered through the normal pipeline to the live session outbox
+    sess = b.sessions["s"]
+    assert sess.info()["mqueue_len"] == 0  # qos0 sends immediately
+
+
+def test_delayed_max_table():
+    d = DelayedPublish(max_delayed_messages=1)
+    d.intercept(_msg("$delayed/1/a"), now=0)
+    d.intercept(_msg("$delayed/1/b"), now=0)
+    assert len(d) == 1 and d.stats["dropped_full"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Rewrite
+
+
+def test_rewrite_rules_last_match_wins():
+    rw = TopicRewrite([
+        RewriteRule("pub", "x/#", r"^x/y/(.+)$", "z/y/$1"),
+        RewriteRule("all", "x/y/1", r"^x/y/(.+)$", "b/y/$1"),
+    ])
+    assert rw.rewrite("x/y/1", "pub") == "b/y/1"   # later rule wins
+    assert rw.rewrite("x/y/2", "pub") == "z/y/2"
+    assert rw.rewrite("x/1/2", "pub") == "x/1/2"   # regex miss
+    assert rw.rewrite("other", "pub") == "other"
+
+
+def test_rewrite_placeholders_and_broker_hooks():
+    b = Broker()
+    TopicRewrite([
+        RewriteRule("pub", "u/#", r"^u/(.+)$", "user/%c/$1"),
+    ]).attach(b)
+    b.open_session("c1")
+    b.subscribe("c1", "user/c1/data")
+    res = b.publish(_msg("u/data", b"1", sender="c1"))
+    assert res.matched == 1  # rewritten to user/c1/data
+
+
+def test_rewrite_subscribe_packet():
+    from emqx_tpu.mqtt import packet as P
+
+    b = Broker()
+    TopicRewrite([
+        RewriteRule("sub", "old/#", r"^old/(.+)$", "new/$1"),
+    ]).attach(b)
+    pkt = P.Subscribe(packet_id=1, topic_filters=[("old/a", {"qos": 1})])
+    b.hooks.run("client.subscribe", ("c", pkt))
+    assert pkt.topic_filters == [("new/a", {"qos": 1})]
+
+
+# ---------------------------------------------------------------------------
+# Auto-subscribe
+
+
+def test_auto_subscribe_on_connected():
+    b = Broker()
+    a = AutoSubscribe()
+    a.add("inbox/%c", SubOpts(qos=1))
+    a.attach(b)
+    b.open_session("dev42")
+    b.hooks.run("client.connected", ("dev42", {"username": "u"}))
+    assert "inbox/dev42" in b.sessions["dev42"].subscriptions
+    res = b.publish(_msg("inbox/dev42", b"hello", qos=1))
+    assert res.matched == 1
