@@ -49,15 +49,22 @@ class AccessControl:
             return True
         return (STOP, RC.BAD_USER_NAME_OR_PASSWORD if password else RC.NOT_AUTHORIZED)
 
-    # hook: client.authorize (clientid, action, topic) acc
-    def on_authorize(self, clientid, action, topic, acc):
+    # hook: client.authorize (clientid, action, topic, ctx) acc
+    # ctx carries per-request conditions (qos, retain) for rules that
+    # constrain on them (emqx_authz rule qos/retain fields)
+    def on_authorize(self, clientid, action, topic, ctx=None, acc=None):
+        if acc is None:  # called with 4-arg legacy shape
+            ctx, acc = None, ctx
         if acc is not True:
             return acc
+        ctx = ctx or {}
         ok = self.authz.authorize(
             clientid, action, topic,
             username=self._usernames.get(clientid),
             peerhost=self._peerhosts.get(clientid),
             is_superuser=self._superusers.get(clientid, False),
+            qos=ctx.get("qos"),
+            retain=ctx.get("retain"),
         )
         return True if ok else (STOP, False)
 

@@ -201,6 +201,8 @@ class JwtAuthenticator:
             sig = _b64url_decode(sig_b64)
         except (ValueError, json.JSONDecodeError):
             return IGNORE
+        if not isinstance(header, dict) or not isinstance(claims, dict):
+            return IGNORE  # JWT spec requires JSON objects; don't crash
         digest = self._ALGOS.get(header.get("alg"))
         if digest is None:
             return IGNORE

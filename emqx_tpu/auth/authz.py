@@ -195,7 +195,10 @@ class Authz:
             self.metrics["superuser"] += 1
             return True
         now = now if now is not None else time.time()
-        key = (clientid, username, action, topic)
+        # key carries every input a source may condition on — a cached
+        # verdict must never bypass ip-/retain-/qos-based rules
+        key = (clientid, username, peerhost, action, topic,
+               kw.get("retain"), kw.get("qos"))
         if self.cache_enable:
             hit = self._cache.get(key)
             if hit is not None and now - hit[1] < self.cache_ttl:

@@ -68,6 +68,9 @@ class Broker:
         self.sessions: Dict[str, Session] = {}
         # filter -> {clientid -> SubOpts}; non-shared local subscribers
         self.subscribers: Dict[str, Dict[str, SubOpts]] = {}
+        # clientid -> username, maintained by the channel on CONNECT; lets
+        # services (topic rewrite %u, ACL templates) resolve usernames
+        self.usernames: Dict[str, Optional[str]] = {}
         self.session_defaults = session_defaults or {}
         # out-of-band deliveries (retained replay, delayed publish): the
         # serving layer sets on_deliver to push straight to connections;
@@ -110,6 +113,7 @@ class Broker:
             self._drop_session_state(sess)
             del self.sessions[clientid]
             self.outbox.pop(clientid, None)
+            self.usernames.pop(clientid, None)
             self.hooks.run("session.terminated", (clientid,))
 
     def _drop_session_state(self, sess: Session) -> None:
@@ -198,6 +202,10 @@ class Broker:
                 self._dispatch_shared(group, flt, msg, res)
             else:
                 self._dispatch(flt, msg, res)
+        # push the fan-out to the connection layer (or the outbox when no
+        # serving layer is attached — unit tests read res.publishes instead)
+        for clientid, pubs in res.publishes.items():
+            self.emit(clientid, pubs)
         return res
 
     def _dispatch(self, flt: str, msg: Message, res: DeliverResult) -> None:
@@ -277,11 +285,16 @@ class Broker:
                 self.hooks.run("message.delivered", (clientid, pub.msg))
             self.emit(clientid, sends)
 
+    OUTBOX_MAX = 1000  # per client; oldest dropped beyond this
+
     def emit(self, clientid: str, pubs: List[Publish]) -> None:
         if self.on_deliver is not None:
             self.on_deliver(clientid, pubs)
         else:
-            self.outbox.setdefault(clientid, []).extend(pubs)
+            box = self.outbox.setdefault(clientid, [])
+            box.extend(pubs)
+            if len(box) > self.OUTBOX_MAX:
+                del box[: len(box) - self.OUTBOX_MAX]
 
     def take_outbox(self, clientid: str) -> List[Publish]:
         return self.outbox.pop(clientid, [])

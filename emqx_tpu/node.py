@@ -1,0 +1,303 @@
+"""BrokerNode: the application assembly — config → running broker.
+
+Behavioral reference: ``emqx_app:start`` / ``emqx_sup`` boot order [U]
+(SURVEY.md §3.1): config load → cluster substrate → core workers
+(hooks/metrics/router/broker/cm/sys) → dependent services (auth, retainer,
+delayed, rewrite, rule engine) → listeners last, so no client connects to a
+half-booted node.  Stop order is the reverse.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from .auth import AuthChain, Authz, attach_auth
+from .broker import Broker
+from .broker.banned import Banned
+from .broker.channel import Channel
+from .broker.cm import ConnectionManager
+from .broker.flapping import Flapping
+from .broker.limiter import LimiterGroup
+from .config import Config
+from .observe.wiring import observe
+from .rule_engine import RuleEngine
+from .services.auto_subscribe import AutoSubscribe
+from .services.delayed import DelayedPublish
+from .services.retainer import Retainer
+from .services.rewrite import TopicRewrite
+from .transport.connection import ConnInfo, Connection
+from .transport.listener import Listener, Listeners
+
+log = logging.getLogger(__name__)
+
+__all__ = ["BrokerNode"]
+
+
+class BrokerNode:
+    """One broker node: all subsystems wired, listeners optional.
+
+    Synchronous parts (broker/session/services) work immediately after
+    construction; ``await start()`` brings up listeners and periodic jobs.
+    """
+
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        auth_chain: Optional[AuthChain] = None,
+        authz: Optional[Authz] = None,
+    ) -> None:
+        self.config = config if config is not None else Config()
+        cfg = self.config
+        self.node_name = cfg.get("node.name")
+        self.broker = Broker(
+            node=self.node_name,
+            shared_strategy=cfg.get("broker.shared_subscription_strategy"),
+            session_defaults={
+                "max_inflight": cfg.get("mqtt.max_inflight"),
+                "max_mqueue_len": cfg.get("mqtt.max_mqueue_len"),
+                "expiry_interval": cfg.get("mqtt.session_expiry_interval"),
+                "max_awaiting_rel": cfg.get("mqtt.max_awaiting_rel"),
+            },
+        )
+        self.cm = ConnectionManager(self.broker)
+        self.observed = observe(
+            self.broker, sys_interval=cfg.get("broker.sys_msg_interval")
+        )
+        self.banned = Banned().attach(self.broker)
+        self.flapping = Flapping(
+            self.banned,
+            max_count=cfg.get("flapping_detect.max_count"),
+            window_time=cfg.get("flapping_detect.window_time"),
+            ban_time=cfg.get("flapping_detect.ban_time"),
+            enable=cfg.get("flapping_detect.enable"),
+        ).attach(self.broker)
+        self.retainer = (
+            Retainer(
+                msg_expiry_interval=cfg.get("retainer.msg_expiry_interval"),
+                max_payload_size=cfg.get("retainer.max_payload_size"),
+                max_retained_messages=cfg.get("retainer.max_retained_messages"),
+            ).attach(self.broker)
+            if cfg.get("retainer.enable")
+            else None
+        )
+        self.delayed = (
+            DelayedPublish(
+                max_delayed_messages=cfg.get("delayed.max_delayed_messages")
+            ).attach(self.broker)
+            if cfg.get("delayed.enable")
+            else None
+        )
+        self.rewrite = TopicRewrite([]).attach(self.broker)
+        self.auto_subscribe = AutoSubscribe()
+        self.auto_subscribe.attach(self.broker)
+        self.rule_engine = RuleEngine(self.broker)
+        self.access_control = None
+        if auth_chain is not None or authz is not None:
+            self.access_control = attach_auth(
+                self.broker,
+                auth_chain if auth_chain is not None else AuthChain(),
+                authz if authz is not None else Authz(
+                    no_match=cfg.get("authz.no_match")
+                ),
+            )
+        self._attach_client_metrics()
+        # session expiry: clientid -> disconnect time, swept by housekeeping
+        self._disconnected_at: Dict[str, float] = {}
+
+        self.limiter = LimiterGroup(
+            max_conn_rate=cfg.get("limiter.max_conn_rate"),
+            max_messages_rate=cfg.get("limiter.max_messages_rate"),
+            max_bytes_rate=cfg.get("limiter.max_bytes_rate"),
+        )
+        self.listeners = Listeners()
+        self.connections: Dict[str, Connection] = {}  # clientid -> conn
+        self.broker.on_deliver = self._on_deliver
+        self._jobs: List[asyncio.Task] = []
+        self.started_at = time.time()
+        self._running = False
+        self._configure_listeners()
+
+    # ------------------------------------------------------------------
+
+    def _attach_client_metrics(self) -> None:
+        m = self.observed.metrics
+        hooks = self.broker.hooks
+        hooks.add("client.connect",
+                  lambda cid, pkt: m.inc("client.connect"),
+                  name="metrics.client.connect")
+        hooks.add("client.connected",
+                  lambda cid, info: (m.inc("client.connected"),
+                                     self._disconnected_at.pop(cid, None))[0],
+                  name="metrics.client.connected")
+        hooks.add("client.disconnected",
+                  lambda cid, reason: (m.inc("client.disconnected"),
+                                       self._mark_disconnected(cid))[0],
+                  name="metrics.client.disconnected")
+        hooks.add("client.subscribe",
+                  lambda cid, pkt: m.inc("client.subscribe"),
+                  name="metrics.client.subscribe")
+        hooks.add("client.unsubscribe",
+                  lambda cid, pkt: m.inc("client.unsubscribe"),
+                  name="metrics.client.unsubscribe")
+
+    def _mark_disconnected(self, clientid: str) -> None:
+        sess = self.broker.sessions.get(clientid)
+        if sess is not None:
+            self._disconnected_at[clientid] = time.time()
+
+    def _configure_listeners(self) -> None:
+        cfg = self.config
+        if cfg.get("listeners.tcp.default.enable"):
+            self.listeners.add(
+                Listener(
+                    "default",
+                    cfg.get("listeners.tcp.default.bind"),
+                    self.handle_stream,
+                    kind="tcp",
+                    max_connections=cfg.get(
+                        "listeners.tcp.default.max_connections"
+                    ),
+                    max_conn_rate=cfg.get("limiter.max_conn_rate"),
+                )
+            )
+        if cfg.get("listeners.ws.default.enable"):
+            self.listeners.add(
+                Listener(
+                    "default",
+                    cfg.get("listeners.ws.default.bind"),
+                    self.handle_stream,
+                    kind="ws",
+                )
+            )
+
+    # ------------------------------------------------------------------
+    # connection plumbing
+    # ------------------------------------------------------------------
+
+    def make_channel(self, conninfo: Optional[dict] = None) -> Channel:
+        cfg = self.config
+        return Channel(
+            self.broker,
+            self.cm,
+            conninfo=conninfo,
+            max_topic_alias=cfg.get("mqtt.max_topic_alias"),
+            max_inflight=cfg.get("mqtt.max_inflight"),
+            server_keepalive=(cfg.get("mqtt.server_keepalive") or None),
+        )
+
+    async def handle_stream(self, stream: Any, info: ConnInfo) -> None:
+        """Listener entry: run one client connection to completion."""
+        channel = self.make_channel(
+            conninfo={"peername": stream.peername(), "listener": info.listener}
+        )
+        conn = Connection(
+            stream,
+            channel,
+            conninfo=info,
+            max_packet_size=self.config.get("mqtt.max_packet_size"),
+            limiter=self.limiter,
+            on_closed=self._conn_closed,
+        )
+        channel.conn = conn  # takeover routing (connection.py)
+        # registration keyed by clientid happens lazily: channel learns its
+        # clientid from CONNECT; we poll-register on first delivery instead
+        # of adding a channel->node callback — cheap and race-free because
+        # everything runs on one loop.
+        prev_register = channel.handle_in
+
+        def handle_in_and_register(pkt):
+            acts = prev_register(pkt)
+            cid = channel.clientid
+            if cid is not None and self.connections.get(cid) is not conn:
+                if channel.state == "connected":
+                    self.connections[cid] = conn
+            return acts
+
+        channel.handle_in = handle_in_and_register
+        try:
+            await conn.run()
+        finally:
+            self.limiter.drop_conn(str(id(conn)))
+
+    def _conn_closed(self, conn: Connection) -> None:
+        cid = conn.channel.clientid
+        if cid is not None and self.connections.get(cid) is conn:
+            del self.connections[cid]
+
+    def _on_deliver(self, clientid: str, pubs: List[Any]) -> None:
+        conn = self.connections.get(clientid)
+        if conn is not None:
+            conn.deliver(pubs)
+        else:
+            self.broker.outbox.setdefault(clientid, []).extend(pubs)
+
+    def kick_client(self, clientid: str) -> bool:
+        """Management 'kick out client' (emqx_mgmt:kickout_client)."""
+        chan = self.cm.kick(clientid)
+        conn = self.connections.pop(clientid, None)
+        if conn is not None:
+            conn.kick("kicked by management")
+        return chan is not None or conn is not None
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.listeners.start_all()
+        self._running = True
+        self._jobs.append(asyncio.ensure_future(self._housekeeping()))
+
+    async def stop(self) -> None:
+        self._running = False
+        await self.listeners.stop_all()
+        for conn in list(self.connections.values()):
+            conn.kick("node shutdown")
+        # give connections a beat to flush their goodbyes
+        await asyncio.sleep(0)
+        for job in self._jobs:
+            job.cancel()
+        self._jobs.clear()
+
+    async def _housekeeping(self) -> None:
+        """Periodic jobs: delayed-publish firing, retained expiry, session
+        expiry, banned-table cleanup ($SYS heartbeat lives in observe)."""
+        interval = 1.0
+        while self._running:
+            await asyncio.sleep(interval)
+            try:
+                if self.delayed is not None:
+                    self.delayed.tick()
+                if self.retainer is not None:
+                    self.retainer.clean_expired()
+                self.banned.clean_expired()
+                self._expire_sessions()
+            except Exception:
+                log.exception("housekeeping job failed")
+
+    def _expire_sessions(self) -> None:
+        """MQTT session-expiry: drop sessions whose client stayed away past
+        Session-Expiry-Interval (emqx_cm session GC)."""
+        now = time.time()
+        for cid, t in list(self._disconnected_at.items()):
+            sess = self.broker.sessions.get(cid)
+            if sess is None or self.cm.lookup_channel(cid) is not None:
+                del self._disconnected_at[cid]
+                continue
+            if now - t >= sess.expiry_interval:
+                self.broker.close_session(cid, discard=True)
+                del self._disconnected_at[cid]
+
+    # ------------------------------------------------------------------
+
+    def info(self) -> dict:
+        return {
+            "node": self.node_name,
+            "uptime": time.time() - self.started_at,
+            "connections": len(self.connections),
+            "listeners": [l.info() for l in self.listeners.all()],
+            **self.broker.stats(),
+        }

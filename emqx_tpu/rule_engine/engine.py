@@ -126,11 +126,15 @@ class RuleEngine:
     def apply_event(
         self, hook_or_topic: str, columns: Dict[str, Any],
         is_event: bool = False,
+        skip_rule: Optional[str] = None,
     ) -> List[RuleResult]:
-        """Run all matching enabled rules; returns per-rule outputs."""
+        """Run all matching enabled rules; returns per-rule outputs.
+        ``skip_rule`` excludes one rule id (republish loop guard)."""
         results: List[RuleResult] = []
         for rule in self.rules.values():
             if not rule.enable:
+                continue
+            if skip_rule is not None and rule.id == skip_rule:
                 continue
             if is_event:
                 if hook_or_topic not in rule.event_hooks():
@@ -162,7 +166,9 @@ class RuleEngine:
         for action in rule.actions:
             try:
                 if isinstance(action, dict) and action.get("function") == "republish":
-                    self._republish(action.get("args", {}), output, columns)
+                    self._republish(
+                        action.get("args", {}), output, columns, rule.id
+                    )
                 elif isinstance(action, dict) and action.get("function") == "console":
                     print(f"[rule {rule.id}] {output}")
                 elif callable(action):
@@ -175,7 +181,7 @@ class RuleEngine:
 
     def _republish(
         self, args: Dict[str, Any], output: Dict[str, Any],
-        columns: Dict[str, Any],
+        columns: Dict[str, Any], rule_id: str = "rule",
     ) -> None:
         if self.broker is None:
             raise RuntimeError("republish needs a broker")
@@ -187,8 +193,8 @@ class RuleEngine:
         qos = int(render_template(str(qos_t), output, columns) or 0) \
             if isinstance(qos_t, str) else int(qos_t)
         msg = make_message(None, topic, payload, qos=qos)
-        # loop guard: republished messages skip rule evaluation once
-        msg.headers["republish_by"] = args.get("rule_id", "rule")
+        # loop guard: the originating rule won't see its own republish
+        msg.headers["republish_by"] = rule_id
         self.broker.publish(msg)
 
     # ------------------------------------------------------------------
@@ -199,9 +205,12 @@ class RuleEngine:
         def on_publish(acc: Message):
             if acc is None or acc.topic.startswith("$SYS"):
                 return acc
-            if "republish_by" in acc.headers:
-                return acc  # loop guard
-            self.apply_event(acc.topic, message_columns(acc))
+            # loop guard: only the originating rule is skipped, so rule
+            # chaining (A republishes into B's FROM filter) still works
+            self.apply_event(
+                acc.topic, message_columns(acc),
+                skip_rule=acc.headers.get("republish_by"),
+            )
             return acc
 
         broker.hooks.add("message.publish", on_publish, priority=-50,

@@ -76,20 +76,26 @@ class TopicRewrite:
         def on_publish(acc: Message):
             if acc is None or acc.topic.startswith("$SYS"):
                 return acc
-            new = self.rewrite(acc.topic, "pub", acc.sender)
+            new = self.rewrite(
+                acc.topic, "pub", acc.sender,
+                broker.usernames.get(acc.sender) if acc.sender else None,
+            )
             return acc if new == acc.topic else acc.clone(topic=new)
 
         def on_subscribe(clientid, pkt):
             # mutate the SUBSCRIBE packet's filters in place (channel
             # passes its live packet through the hook chain)
+            u = broker.usernames.get(clientid)
             pkt.topic_filters = [
-                (self.rewrite(f, "sub", clientid), o)
+                (self.rewrite(f, "sub", clientid, u), o)
                 for f, o in pkt.topic_filters
             ]
 
         def on_unsubscribe(clientid, pkt):
+            u = broker.usernames.get(clientid)
             pkt.topic_filters = [
-                self.rewrite(f, "sub", clientid) for f in pkt.topic_filters
+                self.rewrite(f, "sub", clientid, u)
+                for f in pkt.topic_filters
             ]
 
         broker.hooks.add("message.publish", on_publish, priority=50,

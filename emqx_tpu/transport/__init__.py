@@ -1,0 +1,13 @@
+"""Network transport: listeners + per-connection asyncio loops.
+
+Behavioral reference: ``esockd`` acceptor pools + ``emqx_connection.erl`` /
+``emqx_ws_connection.erl`` [U] (SURVEY.md §1 L2/L3).  The reference runs one
+Erlang process per socket; we run one asyncio task pair (reader + writer)
+per socket on a shared event loop — the idiomatic Python analog with the
+same isolation property (a crashing connection kills only itself).
+"""
+
+from .connection import Connection, ConnInfo
+from .listener import Listener, Listeners
+
+__all__ = ["Connection", "ConnInfo", "Listener", "Listeners"]

@@ -1,0 +1,240 @@
+"""Per-socket connection loop driving the IO-free Channel FSM.
+
+Behavioral reference: ``emqx_connection.erl`` [U] (SURVEY.md §2.1, §3.2):
+the socket-owner process — recv loop with activate-N-style bounded reads,
+incremental frame parsing, rate limiting, keepalive/retry timers, and
+serialized writes.  Here: one reader task + one writer task per socket; the
+Channel stays synchronous and IO-free, this module owns all awaiting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from ..broker.channel import Channel
+from ..broker.limiter import LimiterGroup
+from ..mqtt import frame as F
+from ..mqtt import packet as P
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Connection", "ConnInfo", "TcpStream"]
+
+
+@dataclass
+class ConnInfo:
+    peername: Any = None
+    sockname: Any = None
+    listener: str = "tcp:default"
+    ws: bool = False
+    tls: bool = False
+    connected_at: float = field(default_factory=time.time)
+
+
+class TcpStream:
+    """Thin adapter over asyncio streams, same surface as
+    :class:`~emqx_tpu.transport.ws.WsStream`."""
+
+    def __init__(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._r = reader
+        self._w = writer
+
+    async def read(self, n: int) -> bytes:
+        try:
+            return await self._r.read(n)
+        except ConnectionError:
+            return b""
+
+    def write(self, data: bytes) -> None:
+        self._w.write(data)
+
+    async def drain(self) -> None:
+        await self._w.drain()
+
+    def close(self) -> None:
+        try:
+            self._w.close()
+        except Exception:
+            pass
+
+    async def wait_closed(self) -> None:
+        try:
+            await self._w.wait_closed()
+        except Exception:
+            pass
+
+    def peername(self):
+        return self._w.get_extra_info("peername")
+
+
+class Connection:
+    """Owns one client socket: reads bytes → Parser → Channel.handle_in,
+    executes the returned actions, and flushes deliveries pushed by the
+    broker.  ``recv_buf`` bounds each read (the activate-N analog: the
+    connection never buffers more than one read's worth of unparsed
+    input plus one partial packet).
+    """
+
+    TICK_S = 1.0
+
+    def __init__(
+        self,
+        stream: Any,
+        channel: Channel,
+        conninfo: Optional[ConnInfo] = None,
+        recv_buf: int = 65536,
+        max_packet_size: int = F.MAX_REMAINING_LEN,
+        limiter: Optional[LimiterGroup] = None,
+        on_closed=None,
+    ) -> None:
+        self.stream = stream
+        self.channel = channel
+        self.conninfo = conninfo or ConnInfo()
+        self.recv_buf = recv_buf
+        self.parser = F.Parser(max_packet_size=max_packet_size)
+        self.limiter = limiter
+        self.on_closed = on_closed
+        self._outq: asyncio.Queue = asyncio.Queue()
+        self._closing = asyncio.Event()
+        self._close_reason = "closed"
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.pkts_in = 0
+        self.pkts_out = 0
+
+    # -- broker-facing -----------------------------------------------------
+
+    def deliver(self, pubs: List[Any]) -> None:
+        """Called (synchronously, on the loop) when routed messages land on
+        this client's session."""
+        self._run_actions(self.channel.handle_deliver(pubs))
+
+    def kick(self, reason: str = "kicked") -> None:
+        self._run_actions(self.channel.handle_takeover()
+                          if reason == "takeover" else [("close", reason)])
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def run(self) -> None:
+        """Serve until close; returns after the socket is torn down."""
+        writer = asyncio.ensure_future(self._writer_loop())
+        ticker = asyncio.ensure_future(self._tick_loop())
+        try:
+            await self._reader_loop()
+        except Exception:
+            log.exception("connection crashed (%s)", self.conninfo.peername)
+            self._close_reason = "internal error"
+        finally:
+            self._closing.set()
+            await self._outq.put(None)  # unblock writer for final flush
+            await writer
+            ticker.cancel()
+            self.channel.handle_close(self._close_reason)
+            self.stream.close()
+            await self.stream.wait_closed()
+            if self.on_closed is not None:
+                self.on_closed(self)
+
+    async def _reader_loop(self) -> None:
+        bucket = None
+        if self.limiter is not None:
+            bucket, _ = self.limiter.conn_buckets(str(id(self)))
+        while not self._closing.is_set():
+            data = await self.stream.read(self.recv_buf)
+            if not data:
+                self._close_reason = "peer closed"
+                return
+            self.bytes_in += len(data)
+            if bucket is not None and not bucket.unlimited:
+                ok, wait = bucket.consume(len(data))
+                if not ok:
+                    await asyncio.sleep(wait)  # flow control: pause reads
+            try:
+                pkts = self.parser.feed(data)
+            except F.FrameError as e:
+                self._frame_error(e)
+                return
+            for pkt in pkts:
+                self.pkts_in += 1
+                self._run_actions(self.channel.handle_in(pkt))
+                if self._closing.is_set():
+                    return
+
+    def _frame_error(self, e: F.FrameError) -> None:
+        # MQTT5 §4.13: respond DISCONNECT with the reason, then drop
+        if self.channel.proto_ver == 5 and self.channel.state == "connected":
+            self._send_pkt(P.Disconnect(reason_code=e.reason_code))
+        self._close_reason = f"frame error: {e}"
+
+    def _run_actions(self, actions: List[Any]) -> None:
+        for act, arg in actions:
+            if act == "send":
+                self._send_pkt(arg)
+            elif act == "close":
+                self._close_reason = str(arg)
+                self._closing.set()
+                self._outq.put_nowait(None)
+            elif act == "takeover":
+                # arg is the displaced channel; route its goodbye through
+                # the connection that owns it (emqx_cm takeover protocol)
+                old_conn = getattr(arg, "conn", None)
+                acts = arg.handle_takeover()
+                if old_conn is not None and old_conn is not self:
+                    old_conn._run_actions(acts)
+
+    def _send_pkt(self, pkt: Any) -> None:
+        self._outq.put_nowait(pkt)
+
+    async def _writer_loop(self) -> None:
+        """Single writer: serializes queue order, applies backpressure via
+        drain() so one slow client never blocks the event loop."""
+        while True:
+            pkt = await self._outq.get()
+            if pkt is None:
+                if self._closing.is_set() and self._outq.empty():
+                    # goodbye flushed: close the socket so a reader blocked
+                    # in read() unblocks (server-initiated close)
+                    try:
+                        await self.stream.drain()
+                    except ConnectionError:
+                        pass
+                    self.stream.close()
+                    return
+                continue
+            try:
+                data = F.serialize(pkt, ver=self.channel.proto_ver)
+                self.stream.write(data)
+                self.bytes_out += len(data)
+                self.pkts_out += 1
+                if self._outq.empty():
+                    await self.stream.drain()
+            except ConnectionError:
+                self._closing.set()
+                return
+
+    async def _tick_loop(self) -> None:
+        while not self._closing.is_set():
+            await asyncio.sleep(self.TICK_S)
+            self._run_actions(self.channel.check_keepalive())
+            self._run_actions(self.channel.retry_deliveries())
+
+    def info(self) -> dict:
+        ch = self.channel
+        return {
+            "clientid": ch.clientid,
+            "peername": self.conninfo.peername,
+            "listener": self.conninfo.listener,
+            "proto_ver": ch.proto_ver,
+            "connected_at": self.conninfo.connected_at,
+            "keepalive": ch.keepalive,
+            "recv_oct": self.bytes_in,
+            "send_oct": self.bytes_out,
+            "recv_pkt": self.pkts_in,
+            "send_pkt": self.pkts_out,
+        }

@@ -1,0 +1,144 @@
+"""Listeners: acceptor endpoints feeding connections into the broker.
+
+Behavioral reference: ``emqx_listeners.erl`` + esockd acceptor pools /
+cowboy WS [U] (SURVEY.md §3.1 boot).  asyncio's event-loop accept path
+replaces esockd's acceptor pool; per-listener connection caps and a
+connect-rate token bucket implement esockd's ``max_connections`` /
+``max_conn_rate``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import ssl as _ssl
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from ..broker.limiter import TokenBucket
+from .connection import ConnInfo, TcpStream
+from .ws import WsError, WsStream, server_handshake
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Listener", "Listeners"]
+
+# handler(stream, conninfo) -> runs the connection to completion
+Handler = Callable[[object, ConnInfo], Awaitable[None]]
+
+
+class Listener:
+    def __init__(
+        self,
+        name: str,
+        bind: str,
+        handler: Handler,
+        kind: str = "tcp",            # tcp | ws
+        ssl_context: Optional[_ssl.SSLContext] = None,
+        max_connections: int = 1 << 20,
+        max_conn_rate: float = 0.0,   # conns/s, 0 = unlimited
+        ws_path: str = "/mqtt",
+    ) -> None:
+        self.name = name
+        self.kind = kind
+        host, _, port = bind.rpartition(":")
+        self.host, self.port = host or "0.0.0.0", int(port)
+        self.handler = handler
+        self.ssl_context = ssl_context
+        self.max_connections = max_connections
+        self.ws_path = ws_path
+        self._conn_rate = TokenBucket(max_conn_rate)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.current_connections = 0
+        self.shed_count = 0
+
+    @property
+    def running(self) -> bool:
+        return self._server is not None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._accept, self.host, self.port, ssl=self.ssl_context
+        )
+        # resolve the real port for bind=":0" (tests)
+        socks = self._server.sockets or []
+        if socks and self.port == 0:
+            self.port = socks[0].getsockname()[1]
+        log.info("listener %s (%s) on %s:%d", self.name, self.kind,
+                 self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        ok, _ = self._conn_rate.consume(1.0)
+        if not ok or self.current_connections >= self.max_connections:
+            # esockd sheds by closing the socket before any protocol work
+            self.shed_count += 1
+            writer.close()
+            return
+        self.current_connections += 1
+        info = ConnInfo(
+            peername=writer.get_extra_info("peername"),
+            sockname=writer.get_extra_info("sockname"),
+            listener=f"{self.kind}:{self.name}",
+            ws=self.kind == "ws",
+            tls=self.ssl_context is not None,
+        )
+        try:
+            if self.kind == "ws":
+                try:
+                    await server_handshake(reader, writer, path=self.ws_path)
+                except (WsError, asyncio.IncompleteReadError, ConnectionError):
+                    writer.close()
+                    return
+                stream = WsStream(reader, writer)
+            else:
+                stream = TcpStream(reader, writer)
+            await self.handler(stream, info)
+        except Exception:
+            log.exception("listener %s: connection handler crashed", self.name)
+            writer.close()
+        finally:
+            self.current_connections -= 1
+
+    def info(self) -> dict:
+        return {
+            "id": f"{self.kind}:{self.name}",
+            "type": self.kind,
+            "bind": f"{self.host}:{self.port}",
+            "running": self.running,
+            "max_connections": self.max_connections,
+            "current_connections": self.current_connections,
+            "shed_count": self.shed_count,
+        }
+
+
+class Listeners:
+    """Registry of named listeners (start/stop all, REST surface)."""
+
+    def __init__(self) -> None:
+        self._by_id: Dict[str, Listener] = {}
+
+    def add(self, lst: Listener) -> Listener:
+        self._by_id[f"{lst.kind}:{lst.name}"] = lst
+        return lst
+
+    def get(self, lid: str) -> Optional[Listener]:
+        return self._by_id.get(lid)
+
+    def all(self) -> List[Listener]:
+        return list(self._by_id.values())
+
+    async def start_all(self) -> None:
+        for lst in self._by_id.values():
+            if not lst.running:
+                await lst.start()
+
+    async def stop_all(self) -> None:
+        for lst in self._by_id.values():
+            await lst.stop()

@@ -1,0 +1,210 @@
+"""Minimal RFC 6455 WebSocket server framing for MQTT-over-WS.
+
+Behavioral reference: ``emqx_ws_connection.erl`` over cowboy [U]
+(SURVEY.md §2.1).  The reference delegates WS framing to cowboy; we
+implement the server side of RFC 6455 directly over asyncio streams so the
+transport stack stays self-contained: HTTP/1.1 Upgrade handshake with the
+``mqtt`` subprotocol, masked client frames, fragmentation, ping/pong,
+close.  Each binary frame carries a chunk of the MQTT byte stream (packets
+may span frames; the MQTT parser reassembles).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+from typing import Optional, Tuple
+
+_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+OP_CONT, OP_TEXT, OP_BIN, OP_CLOSE, OP_PING, OP_PONG = 0x0, 0x1, 0x2, 0x8, 0x9, 0xA
+
+
+class WsError(Exception):
+    pass
+
+
+def accept_key(key: str) -> str:
+    return base64.b64encode(
+        hashlib.sha1((key + _GUID).encode()).digest()
+    ).decode()
+
+
+async def server_handshake(
+    reader: asyncio.StreamReader,
+    writer: asyncio.StreamWriter,
+    path: str = "/mqtt",
+    max_header: int = 16384,
+) -> dict:
+    """Read the HTTP Upgrade request, reply 101.  Returns parsed headers."""
+    raw = await reader.readuntil(b"\r\n\r\n")
+    if len(raw) > max_header:
+        raise WsError("oversized handshake")
+    lines = raw.decode("latin-1").split("\r\n")
+    try:
+        method, req_path, _ = lines[0].split(" ", 2)
+    except ValueError:
+        raise WsError(f"bad request line {lines[0]!r}")
+    headers = {}
+    for ln in lines[1:]:
+        if not ln:
+            continue
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    if method != "GET" or (path and req_path.split("?")[0] != path):
+        _reject(writer, 404, "not found")
+        raise WsError(f"bad path {req_path!r}")
+    if (
+        "websocket" not in headers.get("upgrade", "").lower()
+        or "sec-websocket-key" not in headers
+    ):
+        _reject(writer, 400, "not a websocket upgrade")
+        raise WsError("not a websocket upgrade")
+    protos = [
+        p.strip()
+        for p in headers.get("sec-websocket-protocol", "").split(",")
+        if p.strip()
+    ]
+    resp = [
+        "HTTP/1.1 101 Switching Protocols",
+        "Upgrade: websocket",
+        "Connection: Upgrade",
+        f"Sec-WebSocket-Accept: {accept_key(headers['sec-websocket-key'])}",
+    ]
+    # MQTT-over-WS requires the 'mqtt' subprotocol (MQTT spec §6)
+    if "mqtt" in protos:
+        resp.append("Sec-WebSocket-Protocol: mqtt")
+    writer.write(("\r\n".join(resp) + "\r\n\r\n").encode())
+    await writer.drain()
+    return headers
+
+
+def _reject(writer: asyncio.StreamWriter, code: int, msg: str) -> None:
+    body = msg.encode()
+    writer.write(
+        (
+            f"HTTP/1.1 {code} {msg}\r\nContent-Length: {len(body)}\r\n"
+            "Connection: close\r\n\r\n"
+        ).encode()
+        + body
+    )
+
+
+def encode_frame(opcode: int, payload: bytes, fin: bool = True) -> bytes:
+    head = bytearray([(0x80 if fin else 0) | opcode])
+    n = len(payload)
+    if n < 126:
+        head.append(n)
+    elif n < 1 << 16:
+        head.append(126)
+        head += n.to_bytes(2, "big")
+    else:
+        head.append(127)
+        head += n.to_bytes(8, "big")
+    return bytes(head) + payload
+
+
+async def read_frame(
+    reader: asyncio.StreamReader, max_size: int = 1 << 24
+) -> Tuple[int, bool, bytes]:
+    """Returns (opcode, fin, unmasked payload)."""
+    b = await reader.readexactly(2)
+    fin = bool(b[0] & 0x80)
+    if b[0] & 0x70:
+        raise WsError("RSV bits set without extension")
+    opcode = b[0] & 0x0F
+    masked = bool(b[1] & 0x80)
+    n = b[1] & 0x7F
+    if n == 126:
+        n = int.from_bytes(await reader.readexactly(2), "big")
+    elif n == 127:
+        n = int.from_bytes(await reader.readexactly(8), "big")
+    if n > max_size:
+        raise WsError(f"frame too large ({n} bytes)")
+    if not masked:
+        raise WsError("client frames must be masked")  # RFC 6455 §5.1
+    mask = await reader.readexactly(4)
+    data = bytearray(await reader.readexactly(n))
+    for i in range(n):
+        data[i] ^= mask[i & 3]
+    return opcode, fin, bytes(data)
+
+
+class WsStream:
+    """Byte-stream adapter over WS binary frames, mirroring the small
+    read/write surface :class:`~emqx_tpu.transport.connection.Connection`
+    needs, so MQTT code is transport-agnostic."""
+
+    def __init__(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._r = reader
+        self._w = writer
+        self._buf = bytearray()
+        self._frag: Optional[int] = None  # opcode of in-progress fragment
+        self.closed = False
+
+    async def read(self, n: int) -> bytes:
+        """Returns up to n bytes of MQTT stream, b'' on close."""
+        while not self._buf and not self.closed:
+            try:
+                op, fin, payload = await read_frame(self._r)
+            except (asyncio.IncompleteReadError, WsError, ConnectionError):
+                self.closed = True
+                break
+            if op == OP_PING:
+                self._w.write(encode_frame(OP_PONG, payload))
+                continue
+            if op == OP_PONG:
+                continue
+            if op == OP_CLOSE:
+                try:
+                    self._w.write(encode_frame(OP_CLOSE, payload[:2]))
+                    await self._w.drain()
+                except ConnectionError:
+                    pass
+                self.closed = True
+                break
+            if op in (OP_BIN, OP_TEXT):
+                if self._frag is not None:
+                    raise WsError("new data frame inside fragment")
+                if not fin:
+                    self._frag = op
+            elif op == OP_CONT:
+                if self._frag is None:
+                    raise WsError("continuation without fragment")
+                if fin:
+                    self._frag = None
+            else:
+                raise WsError(f"unknown opcode {op}")
+            self._buf += payload
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def write(self, data: bytes) -> None:
+        self._w.write(encode_frame(OP_BIN, data))
+
+    async def drain(self) -> None:
+        await self._w.drain()
+
+    def close(self) -> None:
+        if not self.closed:
+            try:
+                self._w.write(encode_frame(OP_CLOSE, (1000).to_bytes(2, "big")))
+            except ConnectionError:
+                pass
+        try:
+            self._w.close()
+        except Exception:
+            pass
+
+    async def wait_closed(self) -> None:
+        try:
+            await self._w.wait_closed()
+        except Exception:
+            pass
+
+    def peername(self):
+        return self._w.get_extra_info("peername")

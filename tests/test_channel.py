@@ -148,7 +148,9 @@ def test_authz_hook_denies_subscribe_and_publish():
     b, cm, ch = mk()
     b.hooks.add(
         "client.authorize",
-        lambda cid, action, topic, acc: False if topic.startswith("secret") else acc,
+        lambda cid, action, topic, ctx, acc: (
+            False if topic.startswith("secret") else acc
+        ),
     )
     connect(ch, "c")
     (suback,) = sends(
