@@ -1,0 +1,246 @@
+"""Load generator — the `emqtt_bench` analog (SURVEY.md §2.3: the
+reference's baseline driver, a separate repo driven from CI).
+
+Three scenarios, CLI-compatible in spirit with emqtt_bench:
+
+* ``conn`` — CONNECT storm: N clients at a target connect rate.
+* ``sub``  — N subscribers over a topic pattern (``%i`` = client index).
+* ``pub``  — N publishers at a per-client message rate / payload size;
+  reports throughput + end-to-end latency percentiles when a matching
+  ``sub`` group runs in-process.
+
+Programmatic API (used by perf tests): :func:`run_scenario` returns a
+stats dict; ``python -m emqx_tpu.bench_client pub -h HOST ...`` prints it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import struct
+import time
+from typing import Any, Dict, List, Optional
+
+from .client import Client
+
+__all__ = ["run_scenario", "BenchStats", "main"]
+
+
+class BenchStats:
+    def __init__(self) -> None:
+        self.connected = 0
+        self.connect_failures = 0
+        self.sent = 0
+        self.received = 0
+        self.latencies_us: List[float] = []
+        self.t0 = time.perf_counter()
+
+    def summary(self) -> Dict[str, Any]:
+        dt = max(time.perf_counter() - self.t0, 1e-9)
+        lat = sorted(self.latencies_us)
+
+        def pct(p: float) -> Optional[float]:
+            if not lat:
+                return None
+            return round(lat[min(int(len(lat) * p), len(lat) - 1)], 1)
+
+        return {
+            "duration_s": round(dt, 3),
+            "connected": self.connected,
+            "connect_failures": self.connect_failures,
+            "sent": self.sent,
+            "received": self.received,
+            "send_rate": round(self.sent / dt, 1),
+            "recv_rate": round(self.received / dt, 1),
+            "latency_us": {
+                "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
+                "max": lat[-1] if lat else None, "n": len(lat),
+            },
+        }
+
+
+def _topic_of(pattern: str, i: int) -> str:
+    return pattern.replace("%i", str(i))
+
+
+async def _connect_group(
+    n: int,
+    host: str,
+    port: int,
+    prefix: str,
+    rate: float,
+    stats: BenchStats,
+    **client_kw,
+) -> List[Client]:
+    """Connect n clients, pacing to `rate` conns/s (0 = unpaced)."""
+    clients: List[Client] = []
+    interval = 1.0 / rate if rate > 0 else 0.0
+    next_at = time.perf_counter()
+    for i in range(n):
+        if interval:
+            now = time.perf_counter()
+            if now < next_at:
+                await asyncio.sleep(next_at - now)
+            next_at += interval
+        c = Client(clientid=f"{prefix}{i}", host=host, port=port, **client_kw)
+        try:
+            await c.connect()
+            stats.connected += 1
+            clients.append(c)
+        except Exception:
+            stats.connect_failures += 1
+    return clients
+
+
+async def run_scenario(
+    scenario: str,
+    host: str = "127.0.0.1",
+    port: int = 1883,
+    count: int = 10,
+    rate: float = 0.0,          # conn: conns/s; pub: msgs/s per client
+    topic: str = "bench/%i",
+    qos: int = 0,
+    payload_size: int = 64,
+    duration: float = 5.0,      # pub/sub run length (s)
+    messages: int = 0,          # pub: fixed message count per client (0 = by duration)
+    subscribers: int = 0,       # pub: also start in-process subscribers for e2e latency
+    clean_start: bool = True,
+) -> Dict[str, Any]:
+    stats = BenchStats()
+
+    if scenario == "conn":
+        clients = await _connect_group(
+            count, host, port, "bench_conn_", rate, stats,
+            clean_start=clean_start, keepalive=300,
+        )
+        out = stats.summary()
+        await asyncio.gather(*(c.disconnect() for c in clients))
+        return out
+
+    if scenario == "sub":
+        clients = await _connect_group(
+            count, host, port, "bench_sub_", rate, stats, keepalive=300
+        )
+        await asyncio.gather(
+            *(c.subscribe(_topic_of(topic, i), qos=qos)
+              for i, c in enumerate(clients))
+        )
+        end = time.perf_counter() + duration
+
+        async def drain(c: Client):
+            while True:
+                left = end - time.perf_counter()
+                if left <= 0:
+                    return
+                try:
+                    m = await c.recv(timeout=left)
+                except (asyncio.TimeoutError, TimeoutError):
+                    return
+                stats.received += 1
+                if len(m.payload) >= 8:
+                    (t_send,) = struct.unpack_from("<d", m.payload)
+                    stats.latencies_us.append(
+                        (time.perf_counter() - t_send) * 1e6
+                    )
+
+        await asyncio.gather(*(drain(c) for c in clients))
+        out = stats.summary()
+        await asyncio.gather(*(c.disconnect() for c in clients))
+        return out
+
+    if scenario == "pub":
+        subs: List[Client] = []
+        if subscribers:
+            subs = await _connect_group(
+                subscribers, host, port, "bench_psub_", 0.0, stats,
+                keepalive=300,
+            )
+            await asyncio.gather(
+                *(c.subscribe(_topic_of(topic, i), qos=qos)
+                  for i, c in enumerate(subs))
+            )
+
+            async def drain(c: Client):
+                while True:
+                    try:
+                        m = await c.recv(timeout=duration + 5)
+                    except (asyncio.TimeoutError, TimeoutError):
+                        return
+                    stats.received += 1
+                    if len(m.payload) >= 8:
+                        (t_send,) = struct.unpack_from("<d", m.payload)
+                        stats.latencies_us.append(
+                            (time.perf_counter() - t_send) * 1e6
+                        )
+
+            drainers = [asyncio.ensure_future(drain(c)) for c in subs]
+
+        pubs = await _connect_group(
+            count, host, port, "bench_pub_", 0.0, stats, keepalive=300
+        )
+        pad = b"x" * max(payload_size - 8, 0)
+        end = time.perf_counter() + duration
+        interval = 1.0 / rate if rate > 0 else 0.0
+
+        async def publish_loop(i: int, c: Client):
+            sent = 0
+            next_at = time.perf_counter()
+            while (messages and sent < messages) or (
+                not messages and time.perf_counter() < end
+            ):
+                if interval:
+                    now = time.perf_counter()
+                    if now < next_at:
+                        await asyncio.sleep(next_at - now)
+                    next_at += interval
+                payload = struct.pack("<d", time.perf_counter()) + pad
+                await c.publish(_topic_of(topic, i), payload, qos=qos)
+                sent += 1
+                stats.sent += 1
+                if not interval:
+                    await asyncio.sleep(0)  # yield: unpaced fairness
+
+        await asyncio.gather(
+            *(publish_loop(i, c) for i, c in enumerate(pubs))
+        )
+        if subscribers:
+            # let the tail drain, then stop the drainers
+            await asyncio.sleep(0.2)
+            for d in drainers:
+                d.cancel()
+        out = stats.summary()
+        await asyncio.gather(*(c.disconnect() for c in pubs + subs))
+        return out
+
+    raise ValueError(f"unknown scenario {scenario!r}")
+
+
+def main(argv: Optional[List[str]] = None) -> Dict[str, Any]:
+    ap = argparse.ArgumentParser(prog="emqx_tpu.bench_client")
+    ap.add_argument("scenario", choices=["conn", "sub", "pub"])
+    ap.add_argument("-H", "--host", default="127.0.0.1")
+    ap.add_argument("-p", "--port", type=int, default=1883)
+    ap.add_argument("-c", "--count", type=int, default=10)
+    ap.add_argument("-R", "--rate", type=float, default=0.0)
+    ap.add_argument("-t", "--topic", default="bench/%i")
+    ap.add_argument("-q", "--qos", type=int, default=0)
+    ap.add_argument("-s", "--size", type=int, default=64)
+    ap.add_argument("-d", "--duration", type=float, default=5.0)
+    ap.add_argument("-n", "--messages", type=int, default=0)
+    ap.add_argument("--subscribers", type=int, default=0)
+    a = ap.parse_args(argv)
+    out = asyncio.run(
+        run_scenario(
+            a.scenario, host=a.host, port=a.port, count=a.count,
+            rate=a.rate, topic=a.topic, qos=a.qos, payload_size=a.size,
+            duration=a.duration, messages=a.messages,
+            subscribers=a.subscribers,
+        )
+    )
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,7 @@ class Broker:
                 old.inflight.max_size = kw["max_inflight"]
             if "expiry_interval" in kw:
                 old.expiry_interval = kw["expiry_interval"]
+            old.connected = True
             self.hooks.run("session.resumed", (clientid,))
             return old, True
         if old is not None:
@@ -115,6 +116,8 @@ class Broker:
             self.outbox.pop(clientid, None)
             self.usernames.pop(clientid, None)
             self.hooks.run("session.terminated", (clientid,))
+        else:
+            sess.connected = False  # deliveries queue until resume
 
     def _drop_session_state(self, sess: Session) -> None:
         for flt in list(sess.subscriptions):
@@ -291,10 +294,15 @@ class Broker:
         if self.on_deliver is not None:
             self.on_deliver(clientid, pubs)
         else:
-            box = self.outbox.setdefault(clientid, [])
-            box.extend(pubs)
-            if len(box) > self.OUTBOX_MAX:
-                del box[: len(box) - self.OUTBOX_MAX]
+            self.outbox_put(clientid, pubs)
+
+    def outbox_put(self, clientid: str, pubs: List[Publish]) -> None:
+        """Capped outbox append — the single fallback path for deliveries
+        with no live connection."""
+        box = self.outbox.setdefault(clientid, [])
+        box.extend(pubs)
+        if len(box) > self.OUTBOX_MAX:
+            del box[: len(box) - self.OUTBOX_MAX]
 
     def take_outbox(self, clientid: str) -> List[Publish]:
         return self.outbox.pop(clientid, [])

@@ -70,6 +70,7 @@ class Session:
     ) -> None:
         self.clientid = clientid
         self.clean_start = clean_start
+        self.connected = True  # False while the client is away (resumable)
         self.created_at = time.time()
         self.subscriptions: Dict[str, SubOpts] = {}
         self.inflight = Inflight(max_inflight)
@@ -124,6 +125,13 @@ class Session:
         out: List[Publish] = []
         dropped: List[Message] = []
         for msg in msgs:
+            if not self.connected:
+                # client away: everything queues (QoS0 subject to the
+                # mqueue's store_qos0 policy) and drains on resume
+                victim = self.mqueue.insert(msg)
+                if victim is not None:
+                    dropped.append(victim)
+                continue
             if msg.qos == 0:
                 out.append(Publish(None, msg))
                 continue

@@ -10,7 +10,6 @@ an asyncio queue (or a callback), with the full QoS2 receiver FSM.
 from __future__ import annotations
 
 import asyncio
-import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -66,7 +65,7 @@ class Client:
         self._parser = F.Parser(max_packet_size=max_packet_size)
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
-        self._pid = itertools.count(1)
+        self._pid_counter = 0
         self._pending: Dict[Tuple[int, int], asyncio.Future] = {}
         self._rel_pending: Dict[int, P.Publish] = {}  # QoS2 rx, awaiting REL
         self._tasks: List[asyncio.Task] = []
@@ -97,7 +96,11 @@ class Client:
                 properties=dict(self.conn_properties),
             )
         )
-        self.connack = await asyncio.wait_for(fut, timeout)
+        try:
+            self.connack = await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, TimeoutError, MqttError):
+            await self.close()  # no socket/task leak on a dead broker
+            raise
         rc = self.connack.reason_code
         if rc != 0:
             await self.close()
@@ -126,7 +129,7 @@ class Client:
             else (x[0], {"qos": x[1], **opts})
             for x in filters
         ]
-        pid = next(self._pid)
+        pid = self._next_pid()
         ack = await self._request(
             P.Subscribe(packet_id=pid, topic_filters=topics),
             (P.SUBACK, pid),
@@ -137,7 +140,7 @@ class Client:
     async def unsubscribe(self, filters, timeout: float = 10.0) -> List[int]:
         if isinstance(filters, str):
             filters = [filters]
-        pid = next(self._pid)
+        pid = self._next_pid()
         ack = await self._request(
             P.Unsubscribe(packet_id=pid, topic_filters=list(filters)),
             (P.UNSUBACK, pid),
@@ -163,7 +166,7 @@ class Client:
         if qos == 0:
             self._send(pkt)
             return None
-        pid = pkt.packet_id = next(self._pid)
+        pid = pkt.packet_id = self._next_pid()
         if qos == 1:
             ack = await self._request(pkt, (P.PUBACK, pid), timeout)
             return getattr(ack, "reason_code", 0)
@@ -205,6 +208,16 @@ class Client:
         return await asyncio.wait_for(self.messages.get(), timeout)
 
     # ------------------------------------------------------------------
+
+    def _next_pid(self) -> int:
+        """1..65535 with wraparound, skipping ids still awaiting an ack
+        (MQTT §2.2.1 packet identifiers are 16-bit)."""
+        for _ in range(65535):
+            self._pid_counter = (self._pid_counter % 65535) + 1
+            pid = self._pid_counter
+            if not any(k[1] == pid for k in self._pending):
+                return pid
+        raise MqttError("no free packet id")
 
     def _send(self, pkt: Any) -> None:
         if self._writer is None:

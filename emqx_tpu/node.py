@@ -232,7 +232,7 @@ class BrokerNode:
         if conn is not None:
             conn.deliver(pubs)
         else:
-            self.broker.outbox.setdefault(clientid, []).extend(pubs)
+            self.broker.outbox_put(clientid, pubs)
 
     def kick_client(self, clientid: str) -> bool:
         """Management 'kick out client' (emqx_mgmt:kickout_client)."""

@@ -74,10 +74,14 @@ class RuleResult:
 
 
 class RuleEngine:
-    def __init__(self, broker: Optional[Broker] = None) -> None:
+    def __init__(
+        self, broker: Optional[Broker] = None, max_republish_depth: int = 4
+    ) -> None:
         self.rules: Dict[str, Rule] = {}
         self.broker = broker
         self._epoch = 0   # bumps on any rule change (device mirror key)
+        self.max_republish_depth = max_republish_depth
+        self._pub_depth = 0
         if broker is not None:
             self._attach(broker)
 
@@ -205,12 +209,19 @@ class RuleEngine:
         def on_publish(acc: Message):
             if acc is None or acc.topic.startswith("$SYS"):
                 return acc
-            # loop guard: only the originating rule is skipped, so rule
-            # chaining (A republishes into B's FROM filter) still works
-            self.apply_event(
-                acc.topic, message_columns(acc),
-                skip_rule=acc.headers.get("republish_by"),
-            )
+            # loop guards: the originating rule is skipped (so chaining
+            # A→B works), and chain depth is bounded so mutually
+            # republishing rules can't recurse unboundedly
+            if self._pub_depth >= self.max_republish_depth:
+                return acc
+            self._pub_depth += 1
+            try:
+                self.apply_event(
+                    acc.topic, message_columns(acc),
+                    skip_rule=acc.headers.get("republish_by"),
+                )
+            finally:
+                self._pub_depth -= 1
             return acc
 
         broker.hooks.add("message.publish", on_publish, priority=-50,

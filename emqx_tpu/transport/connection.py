@@ -142,17 +142,17 @@ class Connection:
                 self.on_closed(self)
 
     async def _reader_loop(self) -> None:
-        bucket = None
+        msg_bucket = byte_bucket = None
         if self.limiter is not None:
-            bucket, _ = self.limiter.conn_buckets(str(id(self)))
+            msg_bucket, byte_bucket = self.limiter.conn_buckets(str(id(self)))
         while not self._closing.is_set():
             data = await self.stream.read(self.recv_buf)
             if not data:
                 self._close_reason = "peer closed"
                 return
             self.bytes_in += len(data)
-            if bucket is not None and not bucket.unlimited:
-                ok, wait = bucket.consume(len(data))
+            if byte_bucket is not None and not byte_bucket.unlimited:
+                ok, wait = byte_bucket.consume(len(data))
                 if not ok:
                     await asyncio.sleep(wait)  # flow control: pause reads
             try:
@@ -162,6 +162,14 @@ class Connection:
                 return
             for pkt in pkts:
                 self.pkts_in += 1
+                if (
+                    msg_bucket is not None
+                    and not msg_bucket.unlimited
+                    and pkt.type == P.PUBLISH
+                ):
+                    ok, wait = msg_bucket.consume(1.0)
+                    if not ok:
+                        await asyncio.sleep(wait)  # msg-rate flow control
                 self._run_actions(self.channel.handle_in(pkt))
                 if self._closing.is_set():
                     return

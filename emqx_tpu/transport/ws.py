@@ -146,42 +146,60 @@ class WsStream:
         self.closed = False
 
     async def read(self, n: int) -> bytes:
-        """Returns up to n bytes of MQTT stream, b'' on close."""
+        """Returns up to n bytes of MQTT stream, b'' on close.  Protocol
+        violations close with status 1002 instead of raising (a client
+        error is a close, not a server crash)."""
         while not self._buf and not self.closed:
             try:
                 op, fin, payload = await read_frame(self._r)
             except (asyncio.IncompleteReadError, WsError, ConnectionError):
                 self.closed = True
                 break
-            if op == OP_PING:
-                self._w.write(encode_frame(OP_PONG, payload))
-                continue
-            if op == OP_PONG:
-                continue
-            if op == OP_CLOSE:
+            try:
+                if self._consume_frame(op, fin, payload):
+                    break
+            except WsError:
                 try:
-                    self._w.write(encode_frame(OP_CLOSE, payload[:2]))
-                    await self._w.drain()
+                    self._w.write(
+                        encode_frame(OP_CLOSE, (1002).to_bytes(2, "big"))
+                    )
                 except ConnectionError:
                     pass
                 self.closed = True
                 break
-            if op in (OP_BIN, OP_TEXT):
-                if self._frag is not None:
-                    raise WsError("new data frame inside fragment")
-                if not fin:
-                    self._frag = op
-            elif op == OP_CONT:
-                if self._frag is None:
-                    raise WsError("continuation without fragment")
-                if fin:
-                    self._frag = None
-            else:
-                raise WsError(f"unknown opcode {op}")
-            self._buf += payload
         out = bytes(self._buf[:n])
         del self._buf[:n]
         return out
+
+    def _consume_frame(self, op: int, fin: bool, payload: bytes) -> bool:
+        """Handle one frame; returns True when the stream is done.
+        Raises WsError on client protocol violations."""
+        if op == OP_PING:
+            self._w.write(encode_frame(OP_PONG, payload))
+            return False
+        if op == OP_PONG:
+            return False
+        if op == OP_CLOSE:
+            try:
+                self._w.write(encode_frame(OP_CLOSE, payload[:2]))
+            except ConnectionError:
+                pass
+            self.closed = True
+            return True
+        if op in (OP_BIN, OP_TEXT):
+            if self._frag is not None:
+                raise WsError("new data frame inside fragment")
+            if not fin:
+                self._frag = op
+        elif op == OP_CONT:
+            if self._frag is None:
+                raise WsError("continuation without fragment")
+            if fin:
+                self._frag = None
+        else:
+            raise WsError(f"unknown opcode {op}")
+        self._buf += payload
+        return False
 
     def write(self, data: bytes) -> None:
         self._w.write(encode_frame(OP_BIN, data))

@@ -1,0 +1,66 @@
+"""Load-generator smoke tests: the emqtt_bench analog driving a live
+in-process broker node end-to-end (SURVEY.md §2.3 / §6: emqtt_bench is the
+reference's baseline driver)."""
+
+import asyncio
+
+from emqx_tpu.bench_client import run_scenario
+from emqx_tpu.config import Config
+from emqx_tpu.node import BrokerNode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def with_node():
+    cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+    node = BrokerNode(cfg)
+    await node.start()
+    return node, node.listeners.all()[0].port
+
+
+def test_conn_storm():
+    async def main():
+        node, port = await with_node()
+        try:
+            out = await run_scenario("conn", port=port, count=25)
+            assert out["connected"] == 25
+            assert out["connect_failures"] == 0
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_pub_with_e2e_latency():
+    async def main():
+        node, port = await with_node()
+        try:
+            out = await run_scenario(
+                "pub", port=port, count=4, messages=20, qos=1,
+                subscribers=4, duration=3.0, payload_size=64,
+            )
+            assert out["sent"] == 80
+            assert out["received"] == 80  # each sub matches its own topic
+            assert out["latency_us"]["p99"] is not None
+            assert out["latency_us"]["p50"] > 0
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_paced_publish_rate():
+    async def main():
+        node, port = await with_node()
+        try:
+            out = await run_scenario(
+                "pub", port=port, count=2, rate=50.0, duration=1.0,
+            )
+            # 2 clients x 50 msg/s x 1 s, generous tolerance for CI noise
+            assert 60 <= out["sent"] <= 140
+        finally:
+            await node.stop()
+
+    run(main())
