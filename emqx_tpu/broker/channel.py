@@ -94,6 +94,35 @@ class Channel:
             return [("close", f"unexpected packet type {pkt.type}")]
         return handler(pkt)
 
+    def deny_in(self, pkt: Any, rc: int) -> List[Action]:
+        """Refuse an inbound packet with the protocol-correct response —
+        the surface an async advisory stage (exhook) uses to veto a
+        CONNECT / PUBLISH / SUBSCRIBE without entering normal handling."""
+        if pkt.type == P.CONNECT:
+            if self.state == "idle":  # duplicate CONNECT stays a close
+                self.proto_ver = pkt.proto_ver
+                return self._connack_error(rc)
+            return [("close", "protocol_error: duplicate CONNECT")]
+        if pkt.type == P.PUBLISH:
+            return self._puback_for(pkt, rc)
+        if pkt.type == P.SUBSCRIBE:
+            rcs = [self._sub_rc(rc)] * len(pkt.topic_filters)
+            return [("send", P.Suback(packet_id=pkt.packet_id, reason_codes=rcs))]
+        return [("close", f"denied 0x{rc:02x}")]
+
+    def _sub_rc(self, rc: int) -> int:
+        """SUBACK code for this protocol version: 3.1.1 only knows
+        granted-QoS 0/1/2 and 0x80 failure (spec §3.9.3)."""
+        return 0x80 if rc >= 0x80 and self.proto_ver < 5 else rc
+
+    def peek_topic(self, pkt: P.Publish) -> Optional[str]:
+        """Resolve the effective topic of an inbound PUBLISH without
+        mutating alias state — for advisory stages that run pre-handle_in."""
+        alias = pkt.properties.get("Topic-Alias")
+        if alias is not None and not pkt.topic:
+            return self._aliases.get(alias)
+        return pkt.topic or None
+
     # ------------------------------------------------------------------
     # CONNECT
     # ------------------------------------------------------------------
@@ -202,10 +231,16 @@ class Channel:
         )
         if allowed is not True:
             return self._puback_for(pkt, P.RC.NOT_AUTHORIZED)
+        # an advisory stage (exhook message.publish) may re-route without
+        # touching the wire topic / alias registration
+        route_topic = getattr(pkt, "route_topic", None) or topic
         msg = make_message(
-            self.clientid, topic, pkt.payload, qos=pkt.qos,
+            self.clientid, route_topic, pkt.payload, qos=pkt.qos,
             retain=pkt.retain, properties=dict(pkt.properties),
         )
+        if getattr(pkt, "allow_publish", True) is False:
+            # vetoed upstream (exhook advisory): ack normally, never route
+            msg = msg.clone(headers={**msg.headers, "allow_publish": False})
         if pkt.qos == 2:
             st = self.session.publish_qos2(pkt.packet_id, msg)
             if st == "full":
@@ -262,13 +297,17 @@ class Channel:
 
     def _handle_subscribe(self, pkt: P.Subscribe) -> List[Action]:
         if self.broker.hooks.run("client.subscribe", (self.clientid, pkt)) == "stop":
-            rcs = [P.RC.NOT_AUTHORIZED] * len(pkt.topic_filters)
+            rcs = [self._sub_rc(P.RC.NOT_AUTHORIZED)] * len(pkt.topic_filters)
             return [("send", P.Suback(packet_id=pkt.packet_id, reason_codes=rcs))]
         subid = pkt.properties.get("Subscription-Identifier")
+        denied = getattr(pkt, "denied_filters", ())
         rcs: List[int] = []
-        for flt, o in pkt.topic_filters:
+        for i, (flt, o) in enumerate(pkt.topic_filters):
+            if i in denied:  # vetoed upstream (exhook advisory)
+                rcs.append(self._sub_rc(P.RC.NOT_AUTHORIZED))
+                continue
             if not T.is_valid(flt, "filter"):
-                rcs.append(P.RC.TOPIC_FILTER_INVALID)
+                rcs.append(self._sub_rc(P.RC.TOPIC_FILTER_INVALID))
                 continue
             allowed = self.broker.hooks.run_fold(
                 "client.authorize",
@@ -276,7 +315,7 @@ class Channel:
                 True,
             )
             if allowed is not True:
-                rcs.append(P.RC.NOT_AUTHORIZED)
+                rcs.append(self._sub_rc(P.RC.NOT_AUTHORIZED))
                 continue
             opts = SubOpts(
                 qos=o.get("qos", 0), nl=bool(o.get("nl", 0)),

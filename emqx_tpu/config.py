@@ -179,6 +179,12 @@ SCHEMA: Dict[str, Field] = {
     "sysmon.os.cpu_low_watermark": Field(0.60, float),
     "sysmon.os.mem_high_watermark": Field(0.70, float),
 
+    # -- exhook (gRPC extension boundary, SURVEY.md §2.3) -----------------
+    # comma-separated "name=url" pairs, e.g. "default=127.0.0.1:9000"
+    "exhook.servers": Field("", str),
+    "exhook.request_timeout": Field(5.0, duration),
+    "exhook.failure_action": Field("ignore", _enum("ignore", "deny")),
+
     # -- TPU data plane (ours) --------------------------------------------
     "tpu.enable": Field(True, _bool),
     "tpu.max_levels": Field(16, int, lambda v: 1 <= v <= 64),

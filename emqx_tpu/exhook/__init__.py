@@ -1,0 +1,16 @@
+"""ExHook-compatible gRPC extension boundary (SURVEY.md §2.3, §3.6).
+
+* :mod:`~emqx_tpu.exhook.manager` — broker side: stream hook points to
+  external HookProvider servers (advisory + notification semantics,
+  per-server timeout and deny/ignore failure policy).
+* :mod:`~emqx_tpu.exhook.server` — the TPU match sidecar: a
+  HookProvider implementation keeping a device NFA mirror fresh from
+  the subscription delta feed and serving micro-batched topic matches.
+* :mod:`~emqx_tpu.exhook.rpc` — hand-written service glue over the
+  ``protoc``-generated messages (``grpc_tools`` absent here).
+"""
+
+from .manager import ExHookManager, ServerSpec
+from .rpc import pb
+
+__all__ = ["ExHookManager", "ServerSpec", "pb"]

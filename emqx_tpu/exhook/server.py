@@ -1,0 +1,435 @@
+"""The TPU match sidecar — a HookProvider gRPC server.
+
+The north-star deployment (SURVEY.md §0, §3.6): an external broker (a
+stock EMQX or this one) points its exhook at this server; the sidecar
+
+* negotiates the hook set at ``OnProviderLoaded`` — the session
+  subscribe/unsubscribe events are exactly the delta feed the device
+  NFA mirror needs (SURVEY.md §3.3 note);
+* maintains a refcounted filter table mirror, recompiled into the
+  flattened-NFA device table in the background with debounce (the mria
+  bootstrap-then-replay-rlog pattern, SURVEY.md §5.4 — bulk install via
+  ``MirrorSync.InstallSnapshot``, steady-state deltas via the hook feed
+  or ``MirrorSync.ApplyDeltas``);
+* serves ``OnMessagePublish`` through a deadline micro-batching loop
+  (SURVEY.md §7.5) so concurrent publishes ride one device kernel call;
+* serves ``MirrorSync.MatchBatch`` for bulk match queries (the bench /
+  broker-integration fast path — one RPC, one kernel call);
+* fails open: with no compiled table (cold start, rebuild in flight) it
+  falls back to the host trie match so answers stay correct.
+
+Run standalone: ``python -m emqx_tpu.exhook.server --port 9000``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..broker.trie import FilterTrie
+from .rpc import (
+    add_hook_provider_to_server,
+    add_mirror_sync_to_server,
+    pb,
+)
+
+log = logging.getLogger(__name__)
+
+__all__ = ["TpuMatchSidecar", "serve"]
+
+
+class _Engine:
+    """One compiled epoch: device table + jitted matcher, immutable.
+
+    ``deep`` filters (more levels than the device table depth) can't ride
+    the NFA; they are matched host-side per batch and merged in, so the
+    combined answer stays exactly the oracle's.  Their ids follow the
+    device filters: ``filter_table = filters + deep``.
+    """
+
+    def __init__(
+        self, filters: List[str], deep: List[str], depth: int, version: int
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import build_matcher, compile_filters
+
+        self.filters = filters  # id -> filter string (table_version scope)
+        self.deep = deep
+        self.version = version
+        self.table = compile_filters(filters, depth=depth)
+        self.args = [jnp.asarray(a) for a in self.table.device_arrays()]
+        self._fn = jax.jit(build_matcher())
+        self._jnp = jnp
+        # accept-id -> our filter id (compile_filters dedups+sorts)
+        fid = {f: i for i, f in enumerate(filters)}
+        self._accept_to_id = np.asarray(
+            [fid[f] for f in self.table.accept_filters], np.int32
+        )
+        self._deep_trie = FilterTrie()
+        self._deep_id = {}
+        for i, f in enumerate(deep):
+            self._deep_trie.insert(f)
+            self._deep_id[f] = len(filters) + i
+
+    def filter_table(self) -> List[str]:
+        return self.filters + self.deep
+
+    def match(self, topics: List[str], batch: int) -> List[List[int]]:
+        from ..ops import encode_topics
+
+        words, lens, is_sys = encode_topics(self.table, topics, batch=batch)
+        jnp = self._jnp
+        res = self._fn(
+            jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+            *self.args,
+        )
+        matches = np.asarray(res.matches)
+        counts = np.asarray(res.n_matches)
+        out: List[List[int]] = []
+        for r, topic in enumerate(topics):
+            row = [int(self._accept_to_id[a]) for a in matches[r, : counts[r]]]
+            if self.deep:
+                row.extend(
+                    self._deep_id[f] for f in self._deep_trie.match(topic)
+                )
+            out.append(row)
+        return out
+
+
+def _bucket_batch(n: int, minimum: int = 64) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class TpuMatchSidecar:
+    """HookProvider + MirrorSync servicer (grpc.aio, async methods)."""
+
+    def __init__(
+        self,
+        depth: int = 8,
+        batch_window_ms: float = 0.2,
+        max_batch: int = 4096,
+        rebuild_debounce_s: float = 0.1,
+        annotate: bool = False,
+        node: str = "tpu-sidecar",
+    ) -> None:
+        self.depth = depth
+        self.batch_window_s = batch_window_ms / 1000.0
+        self.max_batch = max_batch
+        self.rebuild_debounce_s = rebuild_debounce_s
+        self.annotate = annotate
+        self.node = node
+
+        self._ref: Dict[str, int] = {}       # filter -> refcount
+        self._trie = FilterTrie()             # host fallback (fail-open)
+        self._epoch = 0
+        self._table_version = 0
+        self._engine: Optional[_Engine] = None
+        self._dirty = asyncio.Event()
+        self._pending: List[Tuple[str, asyncio.Future]] = []
+        self._batch_wake = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self._running = False
+        # stats
+        self.batches = 0
+        self.topics_matched = 0
+        self._lat_ms: List[float] = []   # rolling batch latency samples
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._running = True
+        self._tasks = [
+            asyncio.ensure_future(self._rebuild_loop()),
+            asyncio.ensure_future(self._batch_loop()),
+        ]
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        self._tasks = []
+
+    # ------------------------------------------------------------------
+    # mirror mutation
+    # ------------------------------------------------------------------
+
+    def _add_filter(self, flt: str) -> None:
+        n = self._ref.get(flt, 0)
+        self._ref[flt] = n + 1
+        if n == 0:
+            self._trie.insert(flt)
+            self._epoch += 1
+            self._dirty.set()
+
+    def _del_filter(self, flt: str) -> None:
+        n = self._ref.get(flt, 0)
+        if n <= 1:
+            if n == 1:
+                del self._ref[flt]
+                self._trie.delete(flt)
+                self._epoch += 1
+                self._dirty.set()
+        else:
+            self._ref[flt] = n - 1
+
+    async def _rebuild_loop(self) -> None:
+        while True:
+            await self._dirty.wait()
+            await asyncio.sleep(self.rebuild_debounce_s)  # debounce bursts
+            self._dirty.clear()
+            from .. import topic as T
+
+            filters, deep = [], []
+            for f in sorted(self._ref):
+                (filters if len(T.words(f)) <= self.depth else deep).append(f)
+            version = self._table_version + 1
+            t0 = time.perf_counter()
+            try:
+                if filters:
+                    # build + jit-warm off the event loop: XLA compilation
+                    # takes hundreds of ms and would stall every hook RPC
+                    # (deny-policy brokers would veto traffic per rebuild)
+                    def build():
+                        engine = _Engine(filters, deep, self.depth, version)
+                        engine.match(["warm/up"], batch=64)  # warm the jit
+                        return engine
+
+                    engine = await asyncio.to_thread(build)
+                else:
+                    engine = None
+                self._engine = engine
+                self._table_version = version
+                log.info(
+                    "mirror rebuilt: %d filters (+%d host-side deep), "
+                    "version %d, %.1f ms",
+                    len(filters), len(deep), version,
+                    (time.perf_counter() - t0) * 1e3,
+                )
+            except Exception:
+                log.exception("mirror rebuild failed; host fallback serves")
+
+    # ------------------------------------------------------------------
+    # match paths
+    # ------------------------------------------------------------------
+
+    def _host_match(self, topic: str) -> List[str]:
+        return self._trie.match(topic)
+
+    async def _queue_match(self, topic: str) -> List[str]:
+        """Micro-batched single-topic match; returns filter strings."""
+        if self._engine is None:
+            return self._host_match(topic)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending.append((topic, fut))
+        self._batch_wake.set()
+        return await fut
+
+    async def _batch_loop(self) -> None:
+        while True:
+            await self._batch_wake.wait()
+            self._batch_wake.clear()
+            if not self._pending:
+                continue
+            # deadline micro-batching: let concurrent arrivals pile up
+            await asyncio.sleep(self.batch_window_s)
+            pending, self._pending = self._pending[: self.max_batch], \
+                self._pending[self.max_batch:]
+            if self._pending:
+                self._batch_wake.set()
+            engine = self._engine
+            topics = [t for t, _ in pending]
+            t0 = time.perf_counter()
+            try:
+                if engine is None:
+                    results = [self._host_match(t) for t in topics]
+                else:
+                    table = engine.filter_table()
+                    ids = engine.match(topics, _bucket_batch(len(topics)))
+                    results = [
+                        [table[i] for i in row] for row in ids
+                    ]
+            except Exception:
+                log.exception("batch match failed; host fallback")
+                results = [self._host_match(t) for t in topics]
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            self.batches += 1
+            self.topics_matched += len(topics)
+            self._lat_ms.append(dt_ms)
+            if len(self._lat_ms) > 1024:
+                del self._lat_ms[:512]
+            for (_, fut), res in zip(pending, results):
+                if not fut.done():
+                    fut.set_result(res)
+
+    # ------------------------------------------------------------------
+    # HookProvider service (async grpc.aio handlers)
+    # ------------------------------------------------------------------
+
+    async def OnProviderLoaded(self, request, context):
+        log.info("provider loaded by node %s", request.meta.node)
+        wanted = [
+            "session.subscribed", "session.unsubscribed",
+            "message.publish",
+        ]
+        return pb.LoadedResponse(
+            hooks=[pb.HookSpec(name=h) for h in wanted]
+        )
+
+    async def OnProviderUnloaded(self, request, context):
+        return pb.EmptySuccess()
+
+    async def OnSessionSubscribed(self, request, context):
+        # the mirror tracks routing filters; $share group load-balancing
+        # stays broker-side, so the broker sends the stripped filter here
+        self._add_filter(request.topic)
+        return pb.EmptySuccess()
+
+    async def OnSessionUnsubscribed(self, request, context):
+        self._del_filter(request.topic)
+        return pb.EmptySuccess()
+
+    async def OnMessagePublish(self, request, context):
+        matched = await self._queue_match(request.message.topic)
+        if not self.annotate:
+            return pb.ValuedResponse(type=pb.ValuedResponse.CONTINUE)
+        msg = pb.Message()
+        msg.CopyFrom(request.message)
+        msg.headers["matched_filters"] = str(len(matched))
+        return pb.ValuedResponse(
+            type=pb.ValuedResponse.STOP_AND_RETURN, message=msg
+        )
+
+    # ------------------------------------------------------------------
+    # MirrorSync service
+    # ------------------------------------------------------------------
+
+    async def InstallSnapshot(self, request_iterator, context):
+        ref: Dict[str, int] = {}
+        epoch = 0
+        async for chunk in request_iterator:
+            epoch = max(epoch, chunk.epoch)
+            counts = list(chunk.refcounts)
+            for i, flt in enumerate(chunk.filters):
+                ref[flt] = counts[i] if i < len(counts) else 1
+        self._ref = ref
+        trie = FilterTrie()
+        for flt in ref:
+            trie.insert(flt)
+        self._trie = trie
+        self._epoch = epoch
+        self._dirty.set()
+        return pb.SnapshotAck(
+            epoch=epoch, n_filters=len(ref), rebuilt=False
+        )
+
+    async def ApplyDeltas(self, request, context):
+        for d in request.deltas:
+            if d.op == pb.DeltaBatch.Delta.ADD:
+                self._add_filter(d.filter)
+            else:
+                self._del_filter(d.filter)
+        self._epoch = max(self._epoch, request.to_epoch)
+        return pb.SnapshotAck(
+            epoch=self._epoch, n_filters=len(self._ref), rebuilt=False
+        )
+
+    async def MatchBatch(self, request, context):
+        topics = list(request.topics)
+        engine = self._engine
+        resp = pb.MatchBatchResponse(
+            epoch=self._epoch, table_version=self._table_version
+        )
+        t0 = time.perf_counter()
+        if engine is None:
+            # host fallback: ids are indexes into a sorted filter list
+            filters = sorted(self._ref)
+            index = {f: i for i, f in enumerate(filters)}
+            for t in topics:
+                resp.results.add(
+                    filter_ids=[index[f] for f in self._host_match(t)
+                                if f in index]
+                )
+        else:
+            for row in engine.match(topics, _bucket_batch(len(topics) or 1)):
+                resp.results.add(filter_ids=row)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self.batches += 1
+        self.topics_matched += len(topics)
+        self._lat_ms.append(dt_ms)
+        return resp
+
+    async def Stats(self, request, context):
+        lat = sorted(self._lat_ms) or [0.0]
+        engine = self._engine
+        return pb.StatsResponse(
+            epoch=self._epoch,
+            n_filters=len(self._ref),
+            n_states=engine.table.n_states if engine is not None else 0,
+            batches=self.batches,
+            topics_matched=self.topics_matched,
+            p50_batch_ms=lat[len(lat) // 2],
+            p99_batch_ms=lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+            pending_deltas=int(self._dirty.is_set()),
+            extra={"table_version": str(self._table_version)},
+        )
+
+    # ------------------------------------------------------------------
+
+    def filter_table(self) -> List[str]:
+        """id -> filter for the current table_version (MatchBatch ids)."""
+        engine = self._engine
+        return engine.filter_table() if engine is not None else sorted(self._ref)
+
+
+async def serve(
+    port: int = 9000,
+    host: str = "127.0.0.1",
+    sidecar: Optional[TpuMatchSidecar] = None,
+) -> Tuple[Any, TpuMatchSidecar]:
+    """Start a grpc.aio server hosting the sidecar; returns (server, sidecar)."""
+    import grpc.aio
+
+    sidecar = sidecar if sidecar is not None else TpuMatchSidecar()
+    server = grpc.aio.server()
+    add_hook_provider_to_server(sidecar, server)
+    add_mirror_sync_to_server(sidecar, server)
+    server.add_insecure_port(f"{host}:{port}")
+    await sidecar.start()
+    await server.start()
+    return server, sidecar
+
+
+def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="TPU match sidecar")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--depth", type=int, default=8)
+    ap.add_argument("--annotate", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    async def run():
+        server, _ = await serve(
+            port=args.port, host=args.host,
+            sidecar=TpuMatchSidecar(depth=args.depth, annotate=args.annotate),
+        )
+        await server.wait_for_termination()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

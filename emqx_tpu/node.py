@@ -107,6 +107,7 @@ class BrokerNode:
         # session expiry: clientid -> disconnect time, swept by housekeeping
         self._disconnected_at: Dict[str, float] = {}
 
+        self.exhook = None  # built lazily in start() (needs a loop + grpc)
         self.limiter = LimiterGroup(
             max_conn_rate=cfg.get("limiter.max_conn_rate"),
             max_messages_rate=cfg.get("limiter.max_messages_rate"),
@@ -114,6 +115,9 @@ class BrokerNode:
         )
         self.listeners = Listeners()
         self.connections: Dict[str, Connection] = {}  # clientid -> conn
+        # every accepted connection, incl. pre-CONNECT ones — stop() must
+        # be able to close sockets that never completed a handshake
+        self._all_conns: set = set()
         self.broker.on_deliver = self._on_deliver
         self._jobs: List[asyncio.Task] = []
         self.started_at = time.time()
@@ -217,9 +221,13 @@ class BrokerNode:
             return acts
 
         channel.handle_in = handle_in_and_register
+        if self.exhook is not None:
+            conn.intercept = self.exhook.intercept
+        self._all_conns.add(conn)
         try:
             await conn.run()
         finally:
+            self._all_conns.discard(conn)
             self.limiter.drop_conn(str(id(conn)))
 
     def _conn_closed(self, conn: Connection) -> None:
@@ -247,17 +255,51 @@ class BrokerNode:
     # ------------------------------------------------------------------
 
     async def start(self) -> None:
+        await self._start_exhook()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(asyncio.ensure_future(self._housekeeping()))
 
+    async def _start_exhook(self) -> None:
+        spec = (self.config.get("exhook.servers") or "").strip()
+        if not spec:
+            return
+        from .exhook import ExHookManager, ServerSpec
+
+        servers = []
+        for part in spec.split(","):
+            name, _, url = part.strip().partition("=")
+            if not url:
+                log.warning(
+                    "exhook.servers entry %r ignored (expected name=host:port)",
+                    part.strip(),
+                )
+                continue
+            servers.append(
+                ServerSpec(
+                    name=name, url=url,
+                    timeout=self.config.get("exhook.request_timeout"),
+                    failure_action=self.config.get("exhook.failure_action"),
+                )
+            )
+        if servers:
+            self.exhook = ExHookManager(self, servers)
+            await self.exhook.start()
+
     async def stop(self) -> None:
         self._running = False
-        await self.listeners.stop_all()
-        for conn in list(self.connections.values()):
+        if self.exhook is not None:
+            await self.exhook.stop()
+            self.exhook = None
+        # kick live connections BEFORE awaiting listener close: 3.12's
+        # Server.wait_closed() blocks until every connection handler
+        # returns, so the order matters.  _all_conns covers sockets that
+        # never completed CONNECT (absent from self.connections).
+        for conn in list(self._all_conns):
             conn.kick("node shutdown")
         # give connections a beat to flush their goodbyes
         await asyncio.sleep(0)
+        await self.listeners.stop_all()
         for job in self._jobs:
             job.cancel()
         self._jobs.clear()

@@ -100,6 +100,9 @@ class Connection:
         self.parser = F.Parser(max_packet_size=max_packet_size)
         self.limiter = limiter
         self.on_closed = on_closed
+        # optional async advisory stage (exhook): awaited per packet before
+        # handle_in; may mutate/tag the packet or return replacement actions
+        self.intercept = None
         self._outq: asyncio.Queue = asyncio.Queue()
         self._closing = asyncio.Event()
         self._close_reason = "closed"
@@ -170,6 +173,25 @@ class Connection:
                     ok, wait = msg_bucket.consume(1.0)
                     if not ok:
                         await asyncio.sleep(wait)  # msg-rate flow control
+                if self.intercept is not None and pkt.type in (
+                    P.CONNECT, P.PUBLISH, P.SUBSCRIBE, P.UNSUBSCRIBE
+                ):
+                    actions = await self.intercept(self.channel, pkt)
+                    # the await may span a takeover/kick: never hand the
+                    # packet to a channel that died mid-round-trip
+                    if (
+                        self._closing.is_set()
+                        or self.channel.state == "disconnected"
+                    ):
+                        return
+                    if actions is not None:  # advisory deny replaces handling
+                        # a denied packet still counts for keepalive
+                        # (MQTT §3.1.2.10: any control packet received)
+                        self.channel.last_rx = time.time()
+                        self._run_actions(actions)
+                        if self._closing.is_set():
+                            return
+                        continue
                 self._run_actions(self.channel.handle_in(pkt))
                 if self._closing.is_set():
                     return

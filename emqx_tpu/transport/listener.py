@@ -69,7 +69,15 @@ class Listener:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
-            await self._server.wait_closed()
+            try:
+                # 3.12: wait_closed() blocks until every connection handler
+                # returns; a socket that never spoke MQTT (so was never
+                # kicked by the node) would hang us here forever
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except asyncio.TimeoutError:
+                log.warning(
+                    "listener %s: connections still open at stop", self.name
+                )
             self._server = None
 
     async def _accept(

@@ -152,23 +152,46 @@ def test_authz_hook_denies_subscribe_and_publish():
             False if topic.startswith("secret") else acc
         ),
     )
+    # 3.1.1 SUBACK only carries granted-QoS or 0x80 (spec §3.9.3)
     connect(ch, "c")
     (suback,) = sends(
         ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[
             ("secret/x", {"qos": 0}), ("open/x", {"qos": 0})]))
     )
-    assert suback.reason_codes == [P.RC.NOT_AUTHORIZED, 0]
+    assert suback.reason_codes == [0x80, 0]
     (puback,) = sends(
         ch.handle_in(P.Publish(topic="secret/t", qos=1, packet_id=3))
     )
     assert puback.reason_code == P.RC.NOT_AUTHORIZED
 
 
+def test_authz_deny_subscribe_v5_code():
+    b, cm, ch = mk()
+    b.hooks.add(
+        "client.authorize",
+        lambda cid, action, topic, ctx, acc: (
+            False if topic.startswith("secret") else acc
+        ),
+    )
+    connect(ch, "c", ver=5)
+    (suback,) = sends(
+        ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[
+            ("secret/x", {"qos": 0}), ("open/x", {"qos": 0})]))
+    )
+    assert suback.reason_codes == [P.RC.NOT_AUTHORIZED, 0]
+
+
 def test_invalid_topic_filter_in_subscribe():
     b, cm, ch = mk()
-    connect(ch, "c")
+    connect(ch, "c")  # 3.1.1: failure is 0x80
     (suback,) = sends(
         ch.handle_in(P.Subscribe(packet_id=1, topic_filters=[("a/#/b", {"qos": 0})]))
+    )
+    assert suback.reason_codes == [0x80]
+    b2, cm2, ch2 = mk()
+    connect(ch2, "c", ver=5)
+    (suback,) = sends(
+        ch2.handle_in(P.Subscribe(packet_id=1, topic_filters=[("a/#/b", {"qos": 0})]))
     )
     assert suback.reason_codes == [P.RC.TOPIC_FILTER_INVALID]
 
