@@ -77,6 +77,10 @@ class Broker:
         # otherwise they accumulate in outbox for take_outbox().
         self.on_deliver = None  # Optional[Callable[[str, List[Publish]], None]]
         self.outbox: Dict[str, List[Publish]] = {}
+        # cluster forwarding seams (emqx_broker_proto_v1:forward analog):
+        # set by emqx_tpu.cluster when this node joins a cluster
+        self.on_forward = None         # (node, flt, msg) -> None
+        self.on_forward_shared = None  # (node, group, flt, msg) -> None
 
     # ------------------------------------------------------------------
     # session lifecycle (emqx_cm:open_session semantics, simplified here;
@@ -203,8 +207,12 @@ class Broker:
                     continue
                 seen_shared.add((group, flt))
                 self._dispatch_shared(group, flt, msg, res)
-            else:
+            elif dest == self.node:
                 self._dispatch(flt, msg, res)
+            elif self.on_forward is not None:
+                # remote node owns subscribers of flt: ship the delivery
+                if self.on_forward(dest, flt, msg):
+                    res.matched += 1
         # push the fan-out to the connection layer (or the outbox when no
         # serving layer is attached — unit tests read res.publishes instead)
         for clientid, pubs in res.publishes.items():
@@ -223,7 +231,17 @@ class Broker:
         def try_deliver(member: Tuple[str, str]) -> bool:
             clientid, node = member
             if node != self.node:
-                return False  # cross-node forwarding: cluster layer
+                if self.on_forward_shared is not None:
+                    # remote candidate: that node's shared table picks the
+                    # concrete member (two-level cluster dispatch).  A
+                    # False return (peer down) lets dispatch_with_ack try
+                    # the next member; remote acceptance after a
+                    # successful send is optimistic (async cast, like the
+                    # reference's gen_rpc async dispatch).
+                    if self.on_forward_shared(node, group, flt, msg):
+                        res.matched += 1
+                        return True
+                return False
             sess = self.sessions.get(clientid)
             if sess is None:
                 return False
@@ -235,8 +253,17 @@ class Broker:
                 return False
             return self._deliver_to(clientid, opts, msg, res)
 
+        extra = []
+        if self.on_forward_shared is not None:
+            # remote nodes holding members of this group, from the route
+            # table's (group, node) dests — ("", node) candidate markers
+            extra = [
+                ("", d[1]) for d in self.router.routes_of(flt)
+                if isinstance(d, tuple) and d[0] == group and d[1] != self.node
+            ]
         member = self.shared.dispatch_with_ack(
-            group, flt, msg.topic, try_deliver, msg.sender, self.node
+            group, flt, msg.topic, try_deliver, msg.sender, self.node,
+            extra=extra,
         )
         if member is None:
             self.hooks.run("message.dropped", (msg, "shared_no_available"))
@@ -267,6 +294,48 @@ class Broker:
             res.dropped.append((clientid, d))
             self.hooks.run("message.dropped", (d, "queue_full"))
         return all(d.id != eff.id for d in dropped)
+
+    # ------------------------------------------------------------------
+    # cluster ingress (receiving side of on_forward / on_forward_shared)
+    # ------------------------------------------------------------------
+
+    def dispatch_remote(self, flt: str, msg: Message) -> int:
+        """Dispatch a delivery forwarded from another node to local
+        subscribers of ``flt`` (emqx_broker:dispatch on the receiving
+        node).  Returns the number of sessions that accepted."""
+        res = DeliverResult()
+        self._dispatch(flt, msg, res)
+        for clientid, pubs in res.publishes.items():
+            self.emit(clientid, pubs)
+        return res.matched
+
+    def dispatch_shared_remote(self, group: str, flt: str, msg: Message) -> bool:
+        """Second level of cross-node shared dispatch: pick among LOCAL
+        members only (the sender already chose this node)."""
+        res = DeliverResult()
+
+        def try_deliver(member: Tuple[str, str]) -> bool:
+            clientid, node = member
+            if node != self.node:
+                return False
+            sess = self.sessions.get(clientid)
+            if sess is None:
+                return False
+            opts = sess.subscriptions.get(T.make_share(group, flt))
+            if opts is None and group == T.QUEUE_PREFIX:
+                opts = sess.subscriptions.get(f"{T.QUEUE_PREFIX}/{flt}")
+            if opts is None:
+                return False
+            return self._deliver_to(clientid, opts, msg, res)
+
+        member = self.shared.dispatch_with_ack(
+            group, flt, msg.topic, try_deliver, msg.sender, self.node
+        )
+        for clientid, pubs in res.publishes.items():
+            self.emit(clientid, pubs)
+        if member is None:
+            self.hooks.run("message.dropped", (msg, "shared_no_available"))
+        return member is not None
 
     # ------------------------------------------------------------------
     # out-of-band delivery (retained replay, delayed publish, ...)

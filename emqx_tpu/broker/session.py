@@ -236,6 +236,17 @@ class Session:
     # takeover / resume (emqx_cm protocol, SURVEY.md §3.2)
     # ------------------------------------------------------------------
 
+    def pending_messages(self) -> List[Message]:
+        """Undelivered state for migration (cross-node takeover): unacked
+        inflight publishes first (insertion order — pid order breaks when
+        the counter wraps), then the queued backlog."""
+        out: List[Message] = []
+        for _pid, _ts, (kind, val) in self.inflight.items():
+            if kind == "publish" and val is not None:
+                out.append(val)
+        out.extend(self.mqueue.to_list())
+        return out
+
     def pending_count(self) -> int:
         return len(self.mqueue) + len(self.inflight)
 

@@ -80,13 +80,19 @@ class SharedSub:
         sender: Optional[str] = None,
         local_node: str = "local",
         exclude: Sequence[Tuple[str, str]] = (),
+        extra: Sequence[Tuple[str, str]] = (),
     ) -> Optional[Tuple[str, str]]:
         """Choose the member to receive a message on ``topic``.
 
         ``exclude`` supports ack-aware redispatch: members that already
-        nacked this delivery."""
+        nacked this delivery.  ``extra`` adds candidates not in the local
+        member table — the cluster layer passes remote nodes holding
+        members of this group as ``("", node)`` markers, so strategies
+        balance across the whole cluster (two-level pick: the remote
+        node's own shared table chooses the concrete client there)."""
         key = (group, flt)
         members = [m for m in self._members.get(key, ()) if m not in exclude]
+        members += [m for m in extra if m not in exclude and m not in members]
         if not members:
             return None
         s = self.strategy
@@ -123,6 +129,7 @@ class SharedSub:
         try_deliver,
         sender: Optional[str] = None,
         local_node: str = "local",
+        extra: Sequence[Tuple[str, str]] = (),
     ) -> Optional[Tuple[str, str]]:
         """Pick members until ``try_deliver(member) -> bool`` accepts.
 
@@ -130,7 +137,8 @@ class SharedSub:
         member that accepted, or None if every member nacked."""
         tried: List[Tuple[str, str]] = []
         while True:
-            m = self.pick(group, flt, topic, sender, local_node, exclude=tried)
+            m = self.pick(group, flt, topic, sender, local_node,
+                          exclude=tried, extra=extra)
             if m is None:
                 return None
             if try_deliver(m):

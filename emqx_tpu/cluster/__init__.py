@@ -1,0 +1,8 @@
+"""Cluster substrate (SURVEY.md §2.2): membership, route replication,
+inter-node forwarding, session registry/takeover — the ekka + mria +
+gen_rpc layer of the reference, rebuilt on asyncio + protobuf streams."""
+
+from .cluster import Cluster, ClusterError
+from .transport import PeerConn, PeerServer
+
+__all__ = ["Cluster", "ClusterError", "PeerConn", "PeerServer"]

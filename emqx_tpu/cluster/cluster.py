@@ -1,0 +1,654 @@
+"""Cluster manager: membership + route replication + forwarding.
+
+The ekka/mria/gen_rpc layer rebuilt for this broker (SURVEY.md §2.2,
+§5.3, §5.8), one asyncio control plane per node:
+
+* **membership** (ekka): static seed discovery, Hello handshake with an
+  incarnation counter, peer gossip in the HelloAck, heartbeats, and a
+  reconnect loop (autoheal: a returning node re-bootstraps state, the
+  mria replicant pattern);
+* **route replication** (mria rlog): each node broadcasts its own-origin
+  route deltas in batches (the 5.x ``emqx_router_syncer`` behavior);
+  receivers detect epoch gaps and re-bootstrap with a full snapshot —
+  the same snapshot-then-replay discipline the device NFA mirror uses;
+* **forwarding** (gen_rpc): publishes matching a remote node's routes
+  ship as cast frames on the peer stream; shared groups dispatch in two
+  levels (sender picks the node, receiver's shared table picks the
+  member);
+* **session registry + takeover** (emqx_cm_registry): clientid → node
+  broadcast; a resuming CONNECT on the wrong node pulls the session
+  state over (subscriptions + pending messages) and the old node
+  discards, exactly the SURVEY.md §3.2 takeover flow;
+* **nodedown** (emqx_router_helper): a peer missing heartbeats past the
+  timeout has its routes, shared members, and registry entries purged.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import topic as T
+from ..broker.message import Message
+from ..broker.session import Session, SubOpts
+from . import cluster_pb2 as pb
+from .transport import PeerConn, PeerServer, dial
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Cluster", "ClusterError"]
+
+
+class ClusterError(Exception):
+    pass
+
+
+@dataclass
+class Peer:
+    name: str
+    host: str = ""
+    port: int = 0
+    conn: Optional[PeerConn] = None
+    incarnation: int = 0
+    last_seen: float = field(default_factory=time.monotonic)
+    # replication state: the origin numbers its broadcast batches with its
+    # own sequence counter (NOT router epochs — those advance for remote
+    # deltas too and are a different clock on every node)
+    route_seq: int = 0          # last applied origin batch seq
+    bootstrapped: bool = False
+    bootstrapping: bool = False
+    pending_deltas: List[Any] = field(default_factory=list)
+
+    @property
+    def up(self) -> bool:
+        return self.conn is not None and not self.conn.closed
+
+
+def _wire_msg(msg: Message) -> pb.WireMessage:
+    return pb.WireMessage(
+        id=str(msg.id), sender=msg.sender or "", topic=msg.topic,
+        payload=bytes(msg.payload or b""), qos=msg.qos, retain=msg.retain,
+        timestamp=float(getattr(msg, "timestamp", 0.0) or 0.0),
+        properties_json=json.dumps(msg.properties) if msg.properties else "",
+    )
+
+
+def _from_wire(w: pb.WireMessage) -> Message:
+    return Message(
+        id=int(w.id) if w.id.isdigit() else 0,
+        sender=w.sender or None, topic=w.topic, payload=w.payload,
+        qos=w.qos, retain=w.retain, timestamp=w.timestamp or time.time(),
+        properties=json.loads(w.properties_json) if w.properties_json else {},
+    )
+
+
+class Cluster:
+    HEARTBEAT_INTERVAL = 1.0
+    NODE_TIMEOUT = 5.0
+    SYNC_INTERVAL = 0.05
+    RECONNECT_INTERVAL = 2.0
+
+    def __init__(
+        self,
+        node: Any,                      # BrokerNode
+        listen: str = "127.0.0.1:0",
+        seeds: str = "",
+        cluster_name: str = "emqx_tpu",
+    ) -> None:
+        self.node = node
+        self.broker = node.broker
+        self.name = self.broker.node
+        self.cluster_name = cluster_name
+        host, _, port = listen.rpartition(":")
+        self.listen_host, self.listen_port = host or "127.0.0.1", int(port)
+        self.seeds: List[Tuple[str, int]] = []
+        for part in (seeds or "").split(","):
+            if part.strip():
+                h, _, p = part.strip().rpartition(":")
+                self.seeds.append((h, int(p)))
+        self.incarnation = int(time.time() * 1000) & 0x7FFFFFFF
+        self.peers: Dict[str, Peer] = {}
+        self._server: Optional[PeerServer] = None
+        self._tasks: List[asyncio.Task] = []
+        self._synced_epoch = 0   # local router epoch already drained
+        self._sync_seq = 0       # own broadcast batch counter
+        self._registry: Dict[str, str] = {}   # clientid -> remote node
+        self._running = False
+        self.forwards_out = 0
+        self.forwards_in = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        self._running = True
+        self._attach_broker()
+        self._server = PeerServer(
+            self.listen_host, self.listen_port, self._handle,
+            on_closed=self._conn_closed,
+        )
+        await self._server.start()
+        self.listen_port = self._server.port
+        for h, p in self.seeds:
+            if (h, p) != (self.listen_host, self.listen_port):
+                await self._join(h, p)
+        self._tasks = [
+            asyncio.ensure_future(self._heartbeat_loop()),
+            asyncio.ensure_future(self._sync_loop()),
+            asyncio.ensure_future(self._reconnect_loop()),
+        ]
+
+    async def stop(self) -> None:
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        self._tasks = []
+        for peer in self.peers.values():
+            if peer.conn is not None:
+                peer.conn.cast(
+                    pb.ClusterFrame(leave=pb.Leave(node=self.name))
+                )
+                peer.conn.close()
+        self.peers.clear()
+        if self._server is not None:
+            await self._server.stop()
+            self._server = None
+        self._detach_broker()
+
+    def _attach_broker(self) -> None:
+        self.broker.on_forward = self._forward
+        self.broker.on_forward_shared = self._forward_shared
+        hooks = self.broker.hooks
+        hooks.add("session.created",
+                  lambda cid: self._broadcast_session_op(cid, pb.SessionOp.ADD),
+                  name="cluster.session.created")
+        hooks.add("session.terminated",
+                  lambda cid: self._broadcast_session_op(cid, pb.SessionOp.DEL),
+                  name="cluster.session.terminated")
+
+    def _detach_broker(self) -> None:
+        self.broker.on_forward = None
+        self.broker.on_forward_shared = None
+        self.broker.hooks.delete("session.created", "cluster.session.created")
+        self.broker.hooks.delete(
+            "session.terminated", "cluster.session.terminated"
+        )
+
+    # ------------------------------------------------------------------
+    # membership
+    # ------------------------------------------------------------------
+
+    async def _join(self, host: str, port: int) -> Optional[Peer]:
+        try:
+            conn = await dial(host, port, self._handle, self._conn_closed)
+            resp = await conn.call(
+                pb.ClusterFrame(hello=self._hello()), timeout=5.0
+            )
+            ack = resp.hello_ack
+            if not ack.accepted:
+                log.warning("join %s:%d rejected: %s", host, port, ack.reason)
+                conn.close()
+                return None
+            peer = self._peer_up(ack.node, host, port, conn, ack.incarnation)
+            # gossip: learn the acceptor's view (static-discovery helper)
+            for info in ack.peers:
+                if info.node != self.name and info.node not in self.peers:
+                    await self._join(info.host, info.port)
+            return peer
+        except Exception as e:
+            log.debug("join %s:%d failed: %s", host, port, e)
+            return None
+
+    def _hello(self) -> pb.Hello:
+        return pb.Hello(
+            node=self.name, listen_host=self.listen_host,
+            listen_port=self.listen_port, incarnation=self.incarnation,
+            cluster_name=self.cluster_name,
+        )
+
+    def _peer_up(
+        self, name: str, host: str, port: int, conn: PeerConn, incarnation: int
+    ) -> Peer:
+        peer = self.peers.get(name)
+        if peer is None:
+            peer = self.peers[name] = Peer(name=name)
+        if incarnation > peer.incarnation:
+            # a restarted node: everything we learned from its past life
+            # is stale
+            self._purge_node_state(name)
+            peer.route_seq = 0
+            peer.bootstrapped = False
+            peer.pending_deltas.clear()
+        peer.host, peer.port = host, port
+        peer.incarnation = incarnation
+        if peer.conn is not None and peer.conn is not conn:
+            peer.conn.close()
+        peer.conn = conn
+        conn.node = name
+        conn.incarnation = incarnation
+        peer.last_seen = time.monotonic()
+        if not peer.bootstrapped:
+            asyncio.ensure_future(self._bootstrap_from(peer))
+        log.info("%s: peer %s up (%s:%d)", self.name, name, host, port)
+        return peer
+
+    async def _bootstrap_from(self, peer: Peer) -> None:
+        """Pull the peer's own-origin state (mria bootstrap).  Deltas that
+        arrive mid-bootstrap are buffered and replayed after the snapshot
+        installs (mria's bootstrap-then-replay-rlog ordering)."""
+        if peer.conn is None or peer.bootstrapping:
+            return
+        peer.bootstrapping = True
+        try:
+            resp = await peer.conn.call(
+                pb.ClusterFrame(
+                    snapshot_request=pb.SnapshotRequest(requester=self.name)
+                ),
+                timeout=10.0,
+            )
+            self._apply_snapshot(resp.snapshot)
+            for rd in peer.pending_deltas:
+                if rd.to_epoch > peer.route_seq:
+                    self._apply_delta_ops(rd)
+                    peer.route_seq = rd.to_epoch
+            peer.pending_deltas.clear()
+            peer.bootstrapped = True
+        except Exception as e:
+            log.warning("bootstrap from %s failed: %s", peer.name, e)
+        finally:
+            peer.bootstrapping = False
+
+    async def _heartbeat_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.HEARTBEAT_INTERVAL)
+            now = time.monotonic()
+            for peer in list(self.peers.values()):
+                if peer.up:
+                    peer.conn.cast(pb.ClusterFrame(
+                        ping=pb.Ping(epoch=self.broker.router.epoch)
+                    ))
+                    await peer.conn.drain()
+                if now - peer.last_seen > self.NODE_TIMEOUT:
+                    self._node_down(peer.name, "heartbeat timeout")
+
+    async def _reconnect_loop(self) -> None:
+        while self._running:
+            await asyncio.sleep(self.RECONNECT_INTERVAL)
+            # re-dial lost peers and unjoined seeds (autoheal)
+            for peer in list(self.peers.values()):
+                if not peer.up and peer.host:
+                    await self._join(peer.host, peer.port)
+            known = {(p.host, p.port) for p in self.peers.values()}
+            for h, p in self.seeds:
+                if (h, p) not in known and (h, p) != (
+                    self.listen_host, self.listen_port
+                ):
+                    await self._join(h, p)
+
+    def _conn_closed(self, conn: PeerConn) -> None:
+        if conn.node is None:
+            return
+        peer = self.peers.get(conn.node)
+        if peer is not None and peer.conn is conn:
+            peer.conn = None
+
+    def _node_down(self, name: str, reason: str) -> None:
+        peer = self.peers.pop(name, None)
+        if peer is None:
+            return
+        if peer.conn is not None:
+            peer.conn.close()
+        self._purge_node_state(name)
+        log.warning("%s: peer %s down (%s): state purged", self.name, name,
+                    reason)
+
+    def _purge_node_state(self, name: str) -> None:
+        """emqx_router_helper nodedown cleanup: routes, shared members,
+        session registry entries owned by the dead node."""
+        router = self.broker.router
+        router.cleanup_routes(name)
+        for flt in list(router.topics()):
+            for dest in list(router.routes_of(flt)):
+                if isinstance(dest, tuple) and dest[1] == name:
+                    router.delete_route(flt, dest)
+        shared = self.broker.shared
+        for group, flt in list(shared.groups()):
+            for clientid, mnode in list(shared.members(group, flt)):
+                if mnode == name:
+                    shared.unsubscribe(group, flt, clientid, mnode)
+        for cid in [c for c, n in self._registry.items() if n == name]:
+            del self._registry[cid]
+
+    # ------------------------------------------------------------------
+    # route replication
+    # ------------------------------------------------------------------
+
+    def _own_origin(self, dest: Any) -> bool:
+        return dest == self.name or (
+            isinstance(dest, tuple) and dest[1] == self.name
+        )
+
+    def _entry(self, flt: str, dest: Any) -> pb.RouteEntry:
+        if isinstance(dest, tuple):
+            return pb.RouteEntry(
+                filter=flt, dest=pb.Dest(node=dest[1], share_group=dest[0])
+            )
+        return pb.RouteEntry(filter=flt, dest=pb.Dest(node=str(dest)))
+
+    @staticmethod
+    def _dest_of(entry: pb.RouteEntry) -> Any:
+        if entry.dest.share_group:
+            return (entry.dest.share_group, entry.dest.node)
+        return entry.dest.node
+
+    async def _sync_loop(self) -> None:
+        """Broadcast own-origin route deltas (emqx_router_syncer batching).
+
+        Batches carry this node's own sequence counter in
+        ``from_epoch``/``to_epoch``; receivers detect missed batches by
+        sequence gap (router epochs are per-node clocks and never cross
+        the wire)."""
+        while self._running:
+            await asyncio.sleep(self.SYNC_INTERVAL)
+            router = self.broker.router
+            if router.epoch == self._synced_epoch:
+                continue
+            deltas = router.deltas_since(self._synced_epoch)
+            frame = pb.ClusterFrame()
+            frame.route_deltas.origin = self.name
+            if deltas is None:
+                # local delta log overflowed: force a gap so peers
+                # re-bootstrap (skip a seq number)
+                self._sync_seq += 1
+                frame.route_deltas.from_epoch = self._sync_seq
+                self._sync_seq += 1
+                frame.route_deltas.to_epoch = self._sync_seq
+            else:
+                own = [d for d in deltas if self._own_origin(d.dest)]
+                frame.route_deltas.from_epoch = self._sync_seq
+                self._sync_seq += 1
+                frame.route_deltas.to_epoch = self._sync_seq
+                for d in own:
+                    fd = frame.route_deltas.deltas.add()
+                    fd.op = (
+                        pb.RouteDeltas.Delta.ADD if d.op == "add"
+                        else pb.RouteDeltas.Delta.DEL
+                    )
+                    fd.entry.CopyFrom(self._entry(d.filter, d.dest))
+            self._synced_epoch = router.epoch
+            for peer in self.peers.values():
+                if peer.up:
+                    peer.conn.cast(frame)
+
+    def _apply_delta_ops(self, rd: pb.RouteDeltas) -> None:
+        router = self.broker.router
+        for d in rd.deltas:
+            dest = self._dest_of(d.entry)
+            if d.op == pb.RouteDeltas.Delta.ADD:
+                router.add_route(d.entry.filter, dest)
+            else:
+                router.delete_route(d.entry.filter, dest)
+
+    def _apply_route_deltas(self, conn: PeerConn, rd: pb.RouteDeltas) -> None:
+        peer = self.peers.get(rd.origin)
+        if peer is None:
+            return
+        if peer.bootstrapping:
+            # snapshot install in flight: buffer, replay after (in order)
+            peer.pending_deltas.append(rd)
+            return
+        if rd.from_epoch > peer.route_seq:
+            # gap (missed batch / origin log overflow): re-bootstrap
+            peer.bootstrapped = False
+            asyncio.ensure_future(self._bootstrap_from(peer))
+            peer.pending_deltas.append(rd)
+            return
+        if rd.to_epoch <= peer.route_seq:
+            return  # duplicate/old batch
+        self._apply_delta_ops(rd)
+        peer.route_seq = rd.to_epoch
+
+    def _snapshot(self) -> pb.Snapshot:
+        # epoch carries our broadcast seq: the table may already contain
+        # not-yet-broadcast mutations, whose upcoming batch (from == this
+        # seq) then re-applies idempotently on the receiver
+        router = self.broker.router
+        snap = pb.Snapshot(origin=self.name, epoch=self._sync_seq)
+        for flt in router.topics():
+            for dest in router.routes_of(flt):
+                if self._own_origin(dest):
+                    snap.routes.append(self._entry(flt, dest))
+        for cid in self.broker.sessions:
+            snap.session_clientids.append(cid)
+        return snap
+
+    def _apply_snapshot(self, snap: pb.Snapshot) -> None:
+        origin = snap.origin
+        router = self.broker.router
+        # drop everything previously learned from origin, then install
+        router.cleanup_routes(origin)
+        for flt in list(router.topics()):
+            for dest in list(router.routes_of(flt)):
+                if isinstance(dest, tuple) and dest[1] == origin:
+                    router.delete_route(flt, dest)
+        for entry in snap.routes:
+            router.add_route(entry.filter, self._dest_of(entry))
+        for cid in [c for c, n in self._registry.items() if n == origin]:
+            del self._registry[cid]
+        for cid in snap.session_clientids:
+            self._registry[cid] = origin
+        peer = self.peers.get(origin)
+        if peer is not None:
+            peer.route_seq = snap.epoch
+
+    # ------------------------------------------------------------------
+    # forwarding (broker seams)
+    # ------------------------------------------------------------------
+
+    def _forward(self, node: str, flt: str, msg: Message) -> bool:
+        peer = self.peers.get(node)
+        if peer is None or not peer.up:
+            self.broker.hooks.run("message.dropped", (msg, "forward_no_peer"))
+            return False
+        peer.conn.cast(pb.ClusterFrame(forward=pb.Forward(
+            origin=self.name, filter=flt, message=_wire_msg(msg),
+        )))
+        self.forwards_out += 1
+        return True
+
+    def _forward_shared(
+        self, node: str, group: str, flt: str, msg: Message
+    ) -> bool:
+        """Returns False when the peer is unreachable so the broker's
+        shared dispatch can try another group member instead of silently
+        losing the message."""
+        peer = self.peers.get(node)
+        if peer is None or not peer.up:
+            return False
+        peer.conn.cast(pb.ClusterFrame(shared_forward=pb.SharedForward(
+            origin=self.name, group=group, filter=flt,
+            message=_wire_msg(msg),
+        )))
+        self.forwards_out += 1
+        return True
+
+    # ------------------------------------------------------------------
+    # session registry + takeover
+    # ------------------------------------------------------------------
+
+    def _broadcast_session_op(self, clientid: str, op) -> None:
+        frame = pb.ClusterFrame(session_op=pb.SessionOp(
+            origin=self.name, op=op, clientid=clientid,
+        ))
+        for peer in self.peers.values():
+            if peer.up:
+                peer.conn.cast(frame)
+
+    def owner_of(self, clientid: str) -> Optional[str]:
+        """Which remote node (if any) currently owns this clientid."""
+        return self._registry.get(clientid)
+
+    async def prepare_connect(self, pkt: Any) -> None:
+        """Pre-CONNECT stage: if the clientid's session lives on another
+        node, pull it over (resume) or have it discarded (clean start) —
+        the cross-node half of emqx_cm:open_session (SURVEY.md §3.2)."""
+        cid = pkt.clientid
+        if not cid or cid in self.broker.sessions:
+            return
+        owner = self._registry.get(cid)
+        if owner is None:
+            return
+        peer = self.peers.get(owner)
+        if peer is None or not peer.up:
+            self._registry.pop(cid, None)
+            return
+        try:
+            resp = await peer.conn.call(
+                pb.ClusterFrame(takeover_request=pb.TakeoverRequest(
+                    requester=self.name, clientid=cid,
+                )),
+                timeout=5.0,
+            )
+        except Exception as e:
+            log.warning("takeover of %s from %s failed: %s", cid, owner, e)
+            return
+        self._registry.pop(cid, None)
+        reply = resp.takeover_reply
+        if not reply.present or pkt.clean_start:
+            return
+        # install the migrated session; the channel's CONNECT handling
+        # then resumes it (session_present=True)
+        sess, _ = self.broker.open_session(
+            cid, clean_start=False,
+            expiry_interval=reply.expiry_interval,
+        )
+        sess.connected = False
+        for s in reply.subscriptions:
+            opts = SubOpts(
+                qos=s.qos, nl=s.nl, rap=s.rap, rh=s.rh,
+                subid=s.subid if s.subid >= 0 else None,
+            )
+            try:
+                self.broker.subscribe(cid, s.filter, opts)
+            except Exception:
+                log.exception("takeover: resubscribe %r failed", s.filter)
+        if reply.pending:
+            sess.deliver([_from_wire(w) for w in reply.pending])
+
+    def _handle_takeover(self, req: pb.TakeoverRequest) -> pb.TakeoverReply:
+        cid = req.clientid
+        sess = self.broker.sessions.get(cid)
+        if sess is None:
+            return pb.TakeoverReply(present=False)
+        reply = pb.TakeoverReply(
+            present=True, expiry_interval=sess.expiry_interval
+        )
+        for flt, opts in sess.subscriptions.items():
+            reply.subscriptions.append(pb.SessionSub(
+                filter=flt, qos=opts.qos, nl=opts.nl, rap=opts.rap,
+                rh=opts.rh, subid=opts.subid if opts.subid is not None else -1,
+            ))
+        for msg in sess.pending_messages():
+            reply.pending.append(_wire_msg(msg))
+        self.broker.hooks.run("session.takenover", (cid,))
+        # displace the live connection (if any), then discard local state —
+        # unsubscribes fire route deltas so peers drop our routes
+        conn = self.node.connections.get(cid)
+        if conn is not None:
+            conn.kick("takeover")
+        self.broker.close_session(cid, discard=True)
+        return reply
+
+    # ------------------------------------------------------------------
+    # frame dispatch
+    # ------------------------------------------------------------------
+
+    async def _handle(
+        self, conn: PeerConn, frame: pb.ClusterFrame
+    ) -> Optional[pb.ClusterFrame]:
+        kind = frame.WhichOneof("msg")
+        if conn.node is not None:
+            peer = self.peers.get(conn.node)
+            if peer is not None:
+                peer.last_seen = time.monotonic()
+        if kind == "hello":
+            h = frame.hello
+            if h.node == self.name or h.cluster_name != self.cluster_name:
+                return pb.ClusterFrame(hello_ack=pb.HelloAck(
+                    node=self.name, incarnation=self.incarnation,
+                    accepted=False, reason="name conflict or wrong cluster",
+                ))
+            ack = pb.ClusterFrame(hello_ack=pb.HelloAck(
+                node=self.name, incarnation=self.incarnation, accepted=True,
+            ))
+            for p in self.peers.values():
+                if p.name != h.node and p.host:
+                    ack.hello_ack.peers.append(pb.PeerInfo(
+                        node=p.name, host=p.host, port=p.port,
+                    ))
+            self._peer_up(
+                h.node, h.listen_host, h.listen_port, conn, h.incarnation
+            )
+            return ack
+        if kind == "ping":
+            return None  # last_seen refreshed above; no pong needed (TCP)
+        if kind == "leave":
+            self._node_down(frame.leave.node, "leave")
+            return None
+        if kind == "route_deltas":
+            self._apply_route_deltas(conn, frame.route_deltas)
+            return None
+        if kind == "snapshot_request":
+            return pb.ClusterFrame(snapshot=self._snapshot())
+        if kind == "forward":
+            f = frame.forward
+            n = self.broker.dispatch_remote(f.filter, _from_wire(f.message))
+            self.forwards_in += 1
+            if f.want_ack:
+                return pb.ClusterFrame(forward_ack=pb.ForwardAck(dispatched=n))
+            return None
+        if kind == "shared_forward":
+            f = frame.shared_forward
+            self.broker.dispatch_shared_remote(
+                f.group, f.filter, _from_wire(f.message)
+            )
+            self.forwards_in += 1
+            return None
+        if kind == "session_op":
+            op = frame.session_op
+            if op.op == pb.SessionOp.ADD:
+                self._registry[op.clientid] = op.origin
+            elif self._registry.get(op.clientid) == op.origin:
+                del self._registry[op.clientid]
+            return None
+        if kind == "takeover_request":
+            return pb.ClusterFrame(
+                takeover_reply=self._handle_takeover(frame.takeover_request)
+            )
+        log.debug("unhandled cluster frame kind %r", kind)
+        return None
+
+    # ------------------------------------------------------------------
+
+    def info(self) -> dict:
+        return {
+            "name": self.name,
+            "listen": f"{self.listen_host}:{self.listen_port}",
+            "incarnation": self.incarnation,
+            "peers": {
+                p.name: {
+                    "up": p.up, "host": p.host, "port": p.port,
+                    "route_seq": p.route_seq,
+                    "bootstrapped": p.bootstrapped,
+                }
+                for p in self.peers.values()
+            },
+            "registry_size": len(self._registry),
+            "forwards_out": self.forwards_out,
+            "forwards_in": self.forwards_in,
+        }

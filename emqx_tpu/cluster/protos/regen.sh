@@ -1,0 +1,5 @@
+#!/bin/sh
+# Regenerate cluster_pb2.py from cluster.proto (plain protoc).
+cd "$(dirname "$0")/../../.." || exit 1
+exec protoc --python_out=emqx_tpu/cluster -Iemqx_tpu/cluster/protos \
+    emqx_tpu/cluster/protos/cluster.proto

@@ -1,0 +1,208 @@
+"""Peer transport: length-prefixed protobuf frames over asyncio TCP.
+
+The gen_rpc analog (SURVEY.md §2.2): every node pair gets a dedicated
+stream (dial side reuses one connection), so bulk message forwarding
+never head-of-line-blocks the control traffic the way a single Erlang
+dist channel would.  ``call`` correlates a reply via the frame ``seq`` /
+``reply_to`` pair; ``cast`` is fire-and-forget (the QoS0 forwarding
+path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import struct
+from typing import Awaitable, Callable, Dict, Optional
+
+from . import cluster_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+__all__ = ["PeerConn", "PeerServer", "pb"]
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 << 20
+
+# handler(conn, frame) -> Optional[reply frame]
+Handler = Callable[["PeerConn", pb.ClusterFrame], Awaitable[Optional[pb.ClusterFrame]]]
+
+
+class PeerConn:
+    """One framed stream to a peer; owned by whichever side dialled or
+    accepted it.  ``node`` is filled in after the Hello handshake."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Handler,
+        on_closed: Optional[Callable[["PeerConn"], None]] = None,
+    ) -> None:
+        self._r = reader
+        self._w = writer
+        self._handler = handler
+        self._on_closed = on_closed
+        self.node: Optional[str] = None   # peer's node name (post-Hello)
+        self.incarnation: int = 0
+        self._seq = itertools.count(1)
+        self._waiting: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._recv_loop())
+
+    # ------------------------------------------------------------------
+
+    def cast(self, frame: pb.ClusterFrame) -> None:
+        """Fire-and-forget send."""
+        if self._closed:
+            return
+        try:
+            data = frame.SerializeToString()
+            self._w.write(_LEN.pack(len(data)) + data)
+        except Exception:
+            self.close()
+
+    async def call(
+        self, frame: pb.ClusterFrame, timeout: float = 5.0
+    ) -> pb.ClusterFrame:
+        """Request/response: assigns a seq, awaits the matching reply."""
+        if self._closed:
+            raise ConnectionError("peer connection closed")
+        seq = next(self._seq)
+        frame.seq = seq
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiting[seq] = fut
+        try:
+            self.cast(frame)
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._waiting.pop(seq, None)
+
+    def reply(self, req: pb.ClusterFrame, resp: pb.ClusterFrame) -> None:
+        resp.reply_to = req.seq
+        self.cast(resp)
+
+    async def drain(self) -> None:
+        try:
+            await self._w.drain()
+        except ConnectionError:
+            self.close()
+
+    # ------------------------------------------------------------------
+
+    async def _recv_loop(self) -> None:
+        try:
+            while not self._closed:
+                hdr = await self._r.readexactly(_LEN.size)
+                (n,) = _LEN.unpack(hdr)
+                if n > MAX_FRAME:
+                    raise ConnectionError(f"frame too large: {n}")
+                data = await self._r.readexactly(n)
+                frame = pb.ClusterFrame.FromString(data)
+                if frame.reply_to:
+                    fut = self._waiting.pop(frame.reply_to, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(frame)
+                    continue
+                try:
+                    resp = await self._handler(self, frame)
+                except Exception:
+                    log.exception("peer frame handler failed (%s)", self.node)
+                    continue
+                if resp is not None and frame.seq:
+                    self.reply(frame, resp)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            log.exception("peer recv loop crashed (%s)", self.node)
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._waiting.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("peer connection closed"))
+        self._waiting.clear()
+        try:
+            self._w.close()
+        except Exception:
+            pass
+        if self._on_closed is not None:
+            self._on_closed(self)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def peername(self):
+        return self._w.get_extra_info("peername")
+
+
+class PeerServer:
+    """Accepts inbound peer streams."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        handler: Handler,
+        on_closed: Optional[Callable[[PeerConn], None]] = None,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self._handler = handler
+        self._on_closed = on_closed
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.conns: list = []
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._accept, self.host, self.port
+        )
+        socks = self._server.sockets or []
+        if socks and self.port == 0:
+            self.port = socks[0].getsockname()[1]
+
+    async def _accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = PeerConn(reader, writer, self._handler, self._on_closed)
+        self.conns.append(conn)
+        conn.start()
+        await conn._task  # keep the accept handler alive for wait_closed
+
+    async def stop(self) -> None:
+        for conn in list(self.conns):
+            conn.close()
+        self.conns.clear()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+            self._server = None
+
+
+async def dial(
+    host: str,
+    port: int,
+    handler: Handler,
+    on_closed: Optional[Callable[[PeerConn], None]] = None,
+    timeout: float = 5.0,
+) -> PeerConn:
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout
+    )
+    conn = PeerConn(reader, writer, handler, on_closed)
+    conn.start()
+    return conn

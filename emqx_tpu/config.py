@@ -179,6 +179,15 @@ SCHEMA: Dict[str, Field] = {
     "sysmon.os.cpu_low_watermark": Field(0.60, float),
     "sysmon.os.mem_high_watermark": Field(0.70, float),
 
+    # -- cluster substrate (SURVEY.md §2.2: ekka/mria/gen_rpc layer) ------
+    "cluster.enable": Field(False, _bool),
+    "cluster.name": Field("emqx_tpu", str),
+    "cluster.listen": Field("127.0.0.1:4370", str),
+    # static discovery: comma-separated host:port seed list
+    "cluster.seeds": Field("", str),
+    "cluster.heartbeat_interval": Field(1.0, duration),
+    "cluster.node_timeout": Field(5.0, duration),
+
     # -- exhook (gRPC extension boundary, SURVEY.md §2.3) -----------------
     # comma-separated "name=url" pairs, e.g. "default=127.0.0.1:9000"
     "exhook.servers": Field("", str),

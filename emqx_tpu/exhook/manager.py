@@ -325,9 +325,11 @@ class ExHookManager:
                      pb.ClientConnectRequest(conninfo=conninfo,
                                              meta=self._meta()),
                      "client.connect")
+        # fail-closed check first: a later deny-policy server that never
+        # loaded must veto even if an earlier server would allow
+        if any(self._down_deny(st) for st in self.servers):
+            return channel.deny_in(pkt, P.RC.SERVER_UNAVAILABLE)
         for st in self.servers:
-            if self._down_deny(st):
-                return channel.deny_in(pkt, P.RC.SERVER_UNAVAILABLE)
             if st.stub is None or not st.wants("client.authenticate"):
                 continue
             req = pb.ClientAuthenticateRequest(
@@ -354,9 +356,11 @@ class ExHookManager:
         topic = channel.peek_topic(pkt)
         if topic is None:
             return None
+        # fail-closed check covers BOTH advisory loops below (authorize and
+        # message.publish), before any server's allow can short-circuit
+        if any(self._down_deny(st) for st in self.servers):
+            return channel.deny_in(pkt, P.RC.NOT_AUTHORIZED)
         for st in self.servers:
-            if self._down_deny(st):
-                return channel.deny_in(pkt, P.RC.NOT_AUTHORIZED)
             if st.stub is None or not st.wants("client.authorize"):
                 continue
             req = pb.ClientAuthorizeRequest(

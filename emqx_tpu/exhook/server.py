@@ -369,6 +369,12 @@ class TpuMatchSidecar:
         self._lat_ms.append(dt_ms)
         return resp
 
+    async def FilterTable(self, request, context):
+        return pb.FilterTableResponse(
+            table_version=self._table_version,
+            filters=self.filter_table(),
+        )
+
     async def Stats(self, request, context):
         lat = sorted(self._lat_ms) or [0.0]
         engine = self._engine

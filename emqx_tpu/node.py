@@ -108,6 +108,7 @@ class BrokerNode:
         self._disconnected_at: Dict[str, float] = {}
 
         self.exhook = None  # built lazily in start() (needs a loop + grpc)
+        self.cluster = None  # built lazily in start() (needs a loop)
         self.limiter = LimiterGroup(
             max_conn_rate=cfg.get("limiter.max_conn_rate"),
             max_messages_rate=cfg.get("limiter.max_messages_rate"),
@@ -221,8 +222,8 @@ class BrokerNode:
             return acts
 
         channel.handle_in = handle_in_and_register
-        if self.exhook is not None:
-            conn.intercept = self.exhook.intercept
+        if self.exhook is not None or self.cluster is not None:
+            conn.intercept = self._intercept
         self._all_conns.add(conn)
         try:
             await conn.run()
@@ -254,11 +255,48 @@ class BrokerNode:
     # lifecycle
     # ------------------------------------------------------------------
 
+    async def _intercept(self, channel, pkt):
+        """Composite async pre-handle_in stage: cluster session migration
+        first (a takeover must land before CONNECT resumes the session),
+        then the exhook advisory round trips."""
+        from .mqtt import packet as P
+
+        if (
+            self.cluster is not None
+            and pkt.type == P.CONNECT
+            and channel.state == "idle"
+        ):
+            try:
+                await self.cluster.prepare_connect(pkt)
+            except Exception:
+                log.exception("cluster takeover stage failed")
+        if self.exhook is not None:
+            return await self.exhook.intercept(channel, pkt)
+        return None
+
     async def start(self) -> None:
+        await self._start_cluster()
         await self._start_exhook()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(asyncio.ensure_future(self._housekeeping()))
+
+    async def _start_cluster(self) -> None:
+        if not self.config.get("cluster.enable"):
+            return
+        from .cluster import Cluster
+
+        self.cluster = Cluster(
+            self,
+            listen=self.config.get("cluster.listen"),
+            seeds=self.config.get("cluster.seeds"),
+            cluster_name=self.config.get("cluster.name"),
+        )
+        self.cluster.HEARTBEAT_INTERVAL = self.config.get(
+            "cluster.heartbeat_interval"
+        )
+        self.cluster.NODE_TIMEOUT = self.config.get("cluster.node_timeout")
+        await self.cluster.start()
 
     async def _start_exhook(self) -> None:
         spec = (self.config.get("exhook.servers") or "").strip()
@@ -291,6 +329,9 @@ class BrokerNode:
         if self.exhook is not None:
             await self.exhook.stop()
             self.exhook = None
+        if self.cluster is not None:
+            await self.cluster.stop()
+            self.cluster = None
         # kick live connections BEFORE awaiting listener close: 3.12's
         # Server.wait_closed() blocks until every connection handler
         # returns, so the order matters.  _all_conns covers sockets that

@@ -1,0 +1,273 @@
+"""Multi-node cluster tests on one host — the reference's CT
+slave/peer-node pattern (SURVEY.md §4): several broker nodes over
+loopback with real route replication, forwarding, takeover, and
+nodedown handling."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.client import Client
+from emqx_tpu.config import Config
+from emqx_tpu.node import BrokerNode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_cluster_node(name, seeds="", **over):
+    cfg = Config(
+        file_text=(
+            f'node.name = "{name}"\n'
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'cluster.enable = true\n'
+            'cluster.listen = "127.0.0.1:0"\n'
+            f'cluster.seeds = "{seeds}"\n'
+            'cluster.heartbeat_interval = 200ms\n'
+            'cluster.node_timeout = 1500ms\n'
+        )
+    )
+    node = BrokerNode(cfg)
+    await node.start()
+    # speed the delta sync for tests
+    node.cluster.SYNC_INTERVAL = 0.02
+    node.cluster.RECONNECT_INTERVAL = 0.3
+    return node
+
+
+def mqtt_port(node):
+    return node.listeners.all()[0].port
+
+
+def cluster_addr(node):
+    return f"127.0.0.1:{node.cluster.listen_port}"
+
+
+async def settle(pred, timeout=5.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return pred()
+
+
+async def peered(a, b):
+    return await settle(
+        lambda: b.cluster.name in a.cluster.peers
+        and a.cluster.peers[b.cluster.name].up
+        and a.cluster.name in b.cluster.peers
+        and b.cluster.peers[a.cluster.name].up
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_two_node_route_replication_and_forwarding():
+    async def main():
+        n1 = await start_cluster_node("n1@test")
+        n2 = await start_cluster_node("n2@test", seeds=cluster_addr(n1))
+        try:
+            assert await peered(n1, n2)
+
+            sub = Client(clientid="s1", port=mqtt_port(n1))
+            await sub.connect()
+            await sub.subscribe("t/+/x", qos=1)
+            # the wildcard route must replicate to n2
+            assert await settle(
+                lambda: n2.broker.router.has_route("t/+/x", "n1@test")
+            )
+
+            pub = Client(clientid="p1", port=mqtt_port(n2))
+            await pub.connect()
+            await pub.publish("t/a/x", b"cross", qos=1)
+            msg = await sub.recv()
+            assert (msg.topic, msg.payload) == ("t/a/x", b"cross")
+
+            # unsubscribe removes the replicated route
+            await sub.unsubscribe("t/+/x")
+            assert await settle(
+                lambda: not n2.broker.router.has_route("t/+/x", "n1@test")
+            )
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_late_join_bootstraps_routes():
+    async def main():
+        n1 = await start_cluster_node("n1@test")
+        sub = Client(clientid="s1", port=mqtt_port(n1))
+        await sub.connect()
+        await sub.subscribe("pre/existing/#", qos=0)
+        # n2 joins AFTER the subscription exists: snapshot bootstrap
+        n2 = await start_cluster_node("n2@test", seeds=cluster_addr(n1))
+        try:
+            assert await peered(n1, n2)
+            assert await settle(
+                lambda: n2.broker.router.has_route("pre/existing/#", "n1@test")
+            )
+            pub = Client(clientid="p1", port=mqtt_port(n2))
+            await pub.connect()
+            await pub.publish("pre/existing/topic", b"boot")
+            msg = await sub.recv()
+            assert msg.payload == b"boot"
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_shared_subscription_across_nodes():
+    async def main():
+        n1 = await start_cluster_node("n1@test")
+        n2 = await start_cluster_node("n2@test", seeds=cluster_addr(n1))
+        try:
+            assert await peered(n1, n2)
+            a = Client(clientid="a", port=mqtt_port(n1))
+            b = Client(clientid="b", port=mqtt_port(n2))
+            await a.connect()
+            await b.connect()
+            await a.subscribe("$share/g/load/t", qos=0)
+            await b.subscribe("$share/g/load/t", qos=0)
+            assert await settle(
+                lambda: n1.broker.router.has_route("load/t", ("g", "n2@test"))
+                and n2.broker.router.has_route("load/t", ("g", "n1@test"))
+            )
+            pub = Client(clientid="p", port=mqtt_port(n1))
+            await pub.connect()
+            n = 20
+            for i in range(n):
+                await pub.publish("load/t", f"m{i}".encode())
+            # every message delivered exactly once across the group
+            got = []
+
+            async def drain(c):
+                try:
+                    while True:
+                        got.append((await c.recv(timeout=0.5)).payload)
+                except asyncio.TimeoutError:
+                    pass
+
+            await drain(a)
+            await drain(b)
+            assert sorted(got) == sorted(f"m{i}".encode() for i in range(n))
+            await pub.disconnect()
+            await a.disconnect()
+            await b.disconnect()
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_session_takeover_across_nodes():
+    async def main():
+        n1 = await start_cluster_node("n1@test")
+        n2 = await start_cluster_node("n2@test", seeds=cluster_addr(n1))
+        try:
+            assert await peered(n1, n2)
+            c1 = Client(clientid="roam", port=mqtt_port(n1), proto_ver=5,
+                        clean_start=False,
+                        properties={"Session-Expiry-Interval": 300})
+            await c1.connect()
+            await c1.subscribe("offline/q", qos=1)
+            await c1.disconnect()
+            # registry replicated: n2 knows n1 owns 'roam'
+            assert await settle(
+                lambda: n2.cluster.owner_of("roam") == "n1@test"
+            )
+            # a message lands while the client is away → queued on n1
+            pub = Client(clientid="p", port=mqtt_port(n1))
+            await pub.connect()
+            await pub.publish("offline/q", b"while-away", qos=1)
+            await pub.disconnect()
+
+            # reconnect on the OTHER node with clean_start=False
+            c2 = Client(clientid="roam", port=mqtt_port(n2), proto_ver=5,
+                        clean_start=False)
+            ack = await c2.connect()
+            assert ack.session_present
+            msg = await c2.recv()
+            assert msg.payload == b"while-away"
+            # session now lives on n2; old node dropped it
+            assert await settle(lambda: "roam" not in n1.broker.sessions)
+            assert "roam" in n2.broker.sessions
+            # replication is eventually consistent: wait for n1 to learn
+            # the migrated route before publishing through it
+            assert await settle(
+                lambda: n1.broker.router.has_route("offline/q", "n2@test")
+            )
+            pub2 = Client(clientid="p2", port=mqtt_port(n1))
+            await pub2.connect()
+            await pub2.publish("offline/q", b"after-move", qos=1)
+            msg = await c2.recv()
+            assert msg.payload == b"after-move"
+            await pub2.disconnect()
+            await c2.disconnect()
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_nodedown_purges_routes():
+    async def main():
+        n1 = await start_cluster_node("n1@test")
+        n2 = await start_cluster_node("n2@test", seeds=cluster_addr(n1))
+        try:
+            assert await peered(n1, n2)
+            sub = Client(clientid="s1", port=mqtt_port(n2))
+            await sub.connect()
+            await sub.subscribe("dying/#", qos=0)
+            assert await settle(
+                lambda: n1.broker.router.has_route("dying/#", "n2@test")
+            )
+            # hard-stop n2 (no Leave: simulates a crash) → n1 times it out
+            n2.cluster._running = False
+            for t in n2.cluster._tasks:
+                t.cancel()
+            for peer in n2.cluster.peers.values():
+                if peer.conn is not None:
+                    peer.conn.close()
+            await n2.cluster._server.stop()
+            assert await settle(
+                lambda: not n1.broker.router.has_route("dying/#", "n2@test"),
+                timeout=8.0,
+            )
+            # publishing on n1 must not crash with the peer gone
+            pub = Client(clientid="p", port=mqtt_port(n1))
+            await pub.connect()
+            await pub.publish("dying/t", b"x")
+            await pub.disconnect()
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_hello_rejected_on_name_conflict():
+    async def main():
+        n1 = await start_cluster_node("same@test")
+        n2 = await start_cluster_node("same@test", seeds=cluster_addr(n1))
+        try:
+            await asyncio.sleep(0.5)
+            assert "same@test" not in n1.cluster.peers
+            assert not any(p.up for p in n2.cluster.peers.values())
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())

@@ -377,7 +377,11 @@ def test_sidecar_delta_feed_and_match_batch():
             resp = await mirror.MatchBatch(
                 pb.MatchBatchRequest(topics=TOPICS)
             )
-            table = sidecar.filter_table()
+            # id resolution over the wire, as an external broker would
+            ft = await mirror.FilterTable(pb.FilterTableRequest())
+            assert ft.table_version == resp.table_version
+            assert list(ft.filters) == sidecar.filter_table()
+            table = list(ft.filters)
             for topic, row in zip(TOPICS, resp.results):
                 got = sorted(table[i] for i in row.filter_ids)
                 want = sorted(f for f in FILTERS if T.match(topic, f))
