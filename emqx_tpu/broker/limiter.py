@@ -74,6 +74,21 @@ class LimiterGroup:
         self._bytes_rate = max_bytes_rate
         self._per_conn: Dict[str, Tuple[TokenBucket, TokenBucket]] = {}
 
+    def reconfigure(
+        self,
+        max_conn_rate: Optional[float] = None,
+        max_messages_rate: Optional[float] = None,
+        max_bytes_rate: Optional[float] = None,
+    ) -> None:
+        """Hot update (emqx_config_handler): new connections pick up the
+        new per-conn rates; the shared connect-rate bucket swaps now."""
+        if max_conn_rate is not None:
+            self.conn = TokenBucket(max_conn_rate)
+        if max_messages_rate is not None:
+            self._msg_rate = max_messages_rate
+        if max_bytes_rate is not None:
+            self._bytes_rate = max_bytes_rate
+
     def allow_connect(self, now: Optional[float] = None) -> Tuple[bool, float]:
         return self.conn.consume(1.0, now)
 

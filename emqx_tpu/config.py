@@ -179,6 +179,17 @@ SCHEMA: Dict[str, Field] = {
     "sysmon.os.cpu_low_watermark": Field(0.60, float),
     "sysmon.os.mem_high_watermark": Field(0.70, float),
 
+    # -- management API (SURVEY.md §2.3: emqx_management/minirest) --------
+    # off by default: embedded/multi-node-on-one-host uses must opt in
+    # (the reference's standalone release enables it in its dist config)
+    "dashboard.enable": Field(False, _bool),
+    # loopback by default: binding wider without api_key.enable would
+    # expose kick/publish/config mutation to the network
+    "dashboard.listen": Field("127.0.0.1:18083", str),
+    "api_key.enable": Field(False, _bool),
+    "api_key.key": Field("admin", str),
+    "api_key.secret": Field("public", str),
+
     # -- cluster substrate (SURVEY.md §2.2: ekka/mria/gen_rpc layer) ------
     "cluster.enable": Field(False, _bool),
     "cluster.name": Field("emqx_tpu", str),

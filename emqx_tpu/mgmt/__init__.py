@@ -1,0 +1,8 @@
+"""Management surface (SURVEY.md §2.3): the ``/api/v5`` REST API
+(``emqx_management``/``minirest`` analog) and the ``emqx ctl``-style
+CLI riding it."""
+
+from .api import MgmtApi
+from .http import HttpServer, basic_auth_checker
+
+__all__ = ["MgmtApi", "HttpServer", "basic_auth_checker"]

@@ -1,0 +1,471 @@
+"""Management REST API — the ``/api/v5`` surface.
+
+Behavioral reference: ``apps/emqx_management/src/emqx_mgmt_api_*.erl``
+[U] (SURVEY.md §2.3): clients, subscriptions, topics (routes), publish,
+retainer, banned, listeners, metrics/stats, alarms, rules, cluster,
+configs — same paths and response shapes (``{"data": [...], "meta":
+{page, limit, count}}`` pagination) so existing tooling maps over.
+
+Auth: HTTP basic with the configured API key/secret
+(``api_key.enable``), exempting ``/api/v5/status`` like the reference's
+public status probe.
+"""
+
+from __future__ import annotations
+
+import base64
+import time
+from typing import Any, Dict, List, Optional
+
+from ..broker.message import make_message
+from .http import HttpServer, Request, Response, json_response
+
+__all__ = ["MgmtApi"]
+
+
+def _paginate(req: Request, items: List[Any]) -> Dict[str, Any]:
+    page = max(1, req.qint("page", 1))
+    limit = max(1, min(10000, req.qint("limit", 100)))
+    start = (page - 1) * limit
+    return {
+        "data": items[start:start + limit],
+        "meta": {"page": page, "limit": limit, "count": len(items)},
+    }
+
+
+def _page_of(req: Request, keys: List[Any]) -> tuple:
+    """Slice BEFORE building row dicts — a 100k-session node must not
+    materialize 100k rows to serve one page.  Returns (page_keys, meta)."""
+    page = max(1, req.qint("page", 1))
+    limit = max(1, min(10000, req.qint("limit", 100)))
+    start = (page - 1) * limit
+    return keys[start:start + limit], {
+        "page": page, "limit": limit, "count": len(keys),
+    }
+
+
+class MgmtApi:
+    """Binds a BrokerNode to an HttpServer route table."""
+
+    def __init__(self, node: Any, server: HttpServer) -> None:
+        self.node = node
+        self.broker = node.broker
+        self.server = server
+        r = server.route
+        v = "/api/v5"
+        r("GET", f"{v}/status", self.status)
+        r("GET", f"{v}/nodes", self.nodes)
+        r("GET", f"{v}/stats", self.stats)
+        r("GET", f"{v}/metrics", self.metrics)
+        r("GET", f"{v}/prometheus/stats", self.prometheus)
+        r("GET", f"{v}/clients", self.clients)
+        r("GET", f"{v}/clients/{{clientid}}", self.client_one)
+        r("DELETE", f"{v}/clients/{{clientid}}", self.client_kick)
+        r("GET", f"{v}/clients/{{clientid}}/subscriptions",
+          self.client_subs)
+        r("POST", f"{v}/clients/{{clientid}}/subscribe", self.client_subscribe)
+        r("POST", f"{v}/clients/{{clientid}}/unsubscribe",
+          self.client_unsubscribe)
+        r("GET", f"{v}/subscriptions", self.subscriptions)
+        r("GET", f"{v}/topics", self.topics)
+        r("POST", f"{v}/publish", self.publish)
+        r("POST", f"{v}/publish/bulk", self.publish_bulk)
+        r("GET", f"{v}/retainer/messages", self.retained_list)
+        r("GET", f"{v}/retainer/message/{{topic+}}", self.retained_one)
+        r("DELETE", f"{v}/retainer/message/{{topic+}}", self.retained_delete)
+        r("GET", f"{v}/banned", self.banned_list)
+        r("POST", f"{v}/banned", self.banned_add)
+        r("DELETE", f"{v}/banned/{{kind}}/{{who}}", self.banned_delete)
+        r("GET", f"{v}/listeners", self.listeners)
+        r("GET", f"{v}/alarms", self.alarms)
+        r("GET", f"{v}/rules", self.rules_list)
+        r("POST", f"{v}/rules", self.rules_create)
+        r("GET", f"{v}/rules/{{rule_id}}", self.rules_one)
+        r("PUT", f"{v}/rules/{{rule_id}}", self.rules_update)
+        r("DELETE", f"{v}/rules/{{rule_id}}", self.rules_delete)
+        r("GET", f"{v}/cluster", self.cluster)
+        r("GET", f"{v}/exhooks", self.exhooks)
+        r("GET", f"{v}/configs", self.configs_get)
+        r("PUT", f"{v}/configs", self.configs_put)
+
+    # ------------------------------------------------------------------
+    # node / observability
+    # ------------------------------------------------------------------
+
+    async def status(self, req: Request) -> Response:
+        return Response(
+            200,
+            b"Node is running\nemqx_tpu is started\n",
+            content_type="text/plain",
+        )
+
+    async def nodes(self, req: Request) -> Response:
+        return json_response([self.node.info()])
+
+    async def stats(self, req: Request) -> Response:
+        return json_response(self.node.observed.stats.all())
+
+    async def metrics(self, req: Request) -> Response:
+        return json_response(self.node.observed.metrics.all())
+
+    async def prometheus(self, req: Request) -> Response:
+        """Prometheus text exposition of metrics + stats
+        (``emqx_prometheus`` analog)."""
+        lines: List[str] = []
+
+        def emit(prefix: str, kv: Dict[str, int], kind: str) -> None:
+            for name, val in sorted(kv.items()):
+                metric = prefix + name.replace(".", "_").replace("-", "_")
+                lines.append(f"# TYPE {metric} {kind}")
+                lines.append(f"{metric} {val}")
+
+        emit("emqx_", self.node.observed.metrics.all(), "counter")
+        emit("emqx_stats_", self.node.observed.stats.all(), "gauge")
+        return Response(
+            200, ("\n".join(lines) + "\n").encode(),
+            content_type="text/plain; version=0.0.4",
+        )
+
+    async def alarms(self, req: Request) -> Response:
+        activated = req.q("activated")
+        flt = None if activated is None else activated == "true"
+        return json_response(_paginate(req, [
+            a.to_dict() for a in self.node.observed.alarms.list(flt)
+        ]))
+
+    # ------------------------------------------------------------------
+    # clients / subscriptions / topics
+    # ------------------------------------------------------------------
+
+    def _client_row(self, clientid: str) -> Dict[str, Any]:
+        sess = self.broker.sessions.get(clientid)
+        conn = self.node.connections.get(clientid)
+        row: Dict[str, Any] = {
+            "clientid": clientid,
+            "username": self.broker.usernames.get(clientid),
+            "connected": conn is not None,
+            "node": self.broker.node,
+        }
+        if sess is not None:
+            row.update(
+                subscriptions_cnt=len(sess.subscriptions),
+                inflight_cnt=len(sess.inflight),
+                mqueue_len=len(sess.mqueue),
+                created_at=sess.created_at,
+                clean_start=sess.clean_start,
+                expiry_interval=sess.expiry_interval,
+            )
+        if conn is not None:
+            row.update(conn.info())
+        return row
+
+    async def clients(self, req: Request) -> Response:
+        ids = sorted(self.broker.sessions)
+        like = req.q("like_clientid")
+        if like:
+            ids = [c for c in ids if like in c]
+        username = req.q("username")
+        if username:
+            ids = [
+                c for c in ids if self.broker.usernames.get(c) == username
+            ]
+        if req.q("conn_state") == "connected":
+            ids = [c for c in ids if c in self.node.connections]
+        page_ids, meta = _page_of(req, ids)
+        return json_response({
+            "data": [self._client_row(c) for c in page_ids],
+            "meta": meta,
+        })
+
+    async def client_one(self, req: Request) -> Response:
+        cid = req.params["clientid"]
+        if cid not in self.broker.sessions and \
+                cid not in self.node.connections:
+            raise KeyError(cid)
+        return json_response(self._client_row(cid))
+
+    async def client_kick(self, req: Request) -> Response:
+        if not self.node.kick_client(req.params["clientid"]):
+            raise KeyError(req.params["clientid"])
+        return Response(204)
+
+    async def client_subs(self, req: Request) -> Response:
+        sess = self.broker.sessions.get(req.params["clientid"])
+        if sess is None:
+            raise KeyError(req.params["clientid"])
+        return json_response([
+            {"topic": flt, "qos": o.qos, "nl": int(o.nl),
+             "rap": int(o.rap), "rh": o.rh}
+            for flt, o in sess.subscriptions.items()
+        ])
+
+    async def client_subscribe(self, req: Request) -> Response:
+        """Server-side subscribe (emqx_mgmt_api_subscriptions POST)."""
+        from ..broker.session import SubOpts
+
+        cid = req.params["clientid"]
+        if cid not in self.broker.sessions:
+            raise KeyError(cid)
+        body = req.json() or {}
+        topic = body.get("topic")
+        if not topic:
+            raise ValueError("topic required")
+        self.broker.subscribe(
+            cid, topic, SubOpts(qos=int(body.get("qos", 0)))
+        )
+        return json_response({"clientid": cid, "topic": topic}, 201)
+
+    async def client_unsubscribe(self, req: Request) -> Response:
+        cid = req.params["clientid"]
+        body = req.json() or {}
+        topic = body.get("topic")
+        if not topic:
+            raise ValueError("topic required")
+        self.broker.unsubscribe(cid, topic)
+        return Response(204)
+
+    async def subscriptions(self, req: Request) -> Response:
+        match_topic = req.q("match_topic")
+        keys = [
+            (cid, flt, o.qos)
+            for cid, sess in self.broker.sessions.items()
+            for flt, o in sess.subscriptions.items()
+            if not match_topic or flt == match_topic
+        ]
+        page_keys, meta = _page_of(req, keys)
+        return json_response({
+            "data": [
+                {"clientid": cid, "topic": flt, "qos": qos,
+                 "node": self.broker.node}
+                for cid, flt, qos in page_keys
+            ],
+            "meta": meta,
+        })
+
+    async def topics(self, req: Request) -> Response:
+        router = self.broker.router
+        keys = [
+            (flt, dest)
+            for flt in sorted(router.topics())
+            for dest in router.routes_of(flt)
+        ]
+        page_keys, meta = _page_of(req, keys)
+        return json_response({
+            "data": [
+                {"topic": flt,
+                 "node": str(dest[1] if isinstance(dest, tuple) else dest)}
+                for flt, dest in page_keys
+            ],
+            "meta": meta,
+        })
+
+    # ------------------------------------------------------------------
+    # publish
+    # ------------------------------------------------------------------
+
+    def _do_publish(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        topic = body.get("topic")
+        if not topic:
+            raise ValueError("topic required")
+        payload = body.get("payload", "")
+        if body.get("payload_encoding") == "base64":
+            data = base64.b64decode(payload)
+        else:
+            data = str(payload).encode("utf-8")
+        msg = make_message(
+            body.get("clientid"), topic, data,
+            qos=int(body.get("qos", 0)),
+            retain=bool(body.get("retain", False)),
+            properties=body.get("properties") or {},
+        )
+        res = self.broker.publish(msg)
+        return {"id": str(msg.id), "matched": res.matched}
+
+    async def publish(self, req: Request) -> Response:
+        return json_response(self._do_publish(req.json() or {}))
+
+    async def publish_bulk(self, req: Request) -> Response:
+        body = req.json()
+        if not isinstance(body, list):
+            raise ValueError("expected a json array")
+        return json_response([self._do_publish(b) for b in body])
+
+    # ------------------------------------------------------------------
+    # retainer / banned
+    # ------------------------------------------------------------------
+
+    def _retainer(self):
+        if self.node.retainer is None:
+            raise ValueError("retainer disabled")
+        return self.node.retainer
+
+    async def retained_list(self, req: Request) -> Response:
+        ret = self._retainer()
+        page_topics, meta = _page_of(req, sorted(ret.topics()))
+        rows = []
+        for t in page_topics:
+            for m in ret.match(t):
+                rows.append({
+                    "topic": m.topic, "qos": m.qos,
+                    "payload_size": len(m.payload),
+                    "from_clientid": m.sender,
+                    "publish_at": m.timestamp,
+                })
+        return json_response({"data": rows, "meta": meta})
+
+    async def retained_one(self, req: Request) -> Response:
+        msgs = self._retainer().match(req.params["topic"])
+        if not msgs:
+            raise KeyError(req.params["topic"])
+        m = msgs[0]
+        return json_response({
+            "topic": m.topic, "qos": m.qos,
+            "payload": base64.b64encode(m.payload).decode(),
+            "from_clientid": m.sender, "publish_at": m.timestamp,
+        })
+
+    async def retained_delete(self, req: Request) -> Response:
+        if not self._retainer().delete(req.params["topic"]):
+            raise KeyError(req.params["topic"])
+        return Response(204)
+
+    async def banned_list(self, req: Request) -> Response:
+        return json_response(_paginate(req, [
+            {"as": e.kind, "who": e.who, "by": e.by, "reason": e.reason,
+             "at": e.at, "until": e.until}
+            for e in self.node.banned.list()
+        ]))
+
+    async def banned_add(self, req: Request) -> Response:
+        body = req.json() or {}
+        kind, who = body.get("as"), body.get("who")
+        if kind not in ("clientid", "username", "peerhost") or not who:
+            raise ValueError("need as=clientid|username|peerhost and who")
+        dur = body.get("duration")
+        self.node.banned.add(
+            kind, who,
+            duration=float(dur) if dur is not None else None,
+            by=body.get("by", "mgmt"), reason=body.get("reason", ""),
+        )
+        return json_response({"as": kind, "who": who}, 201)
+
+    async def banned_delete(self, req: Request) -> Response:
+        if not self.node.banned.delete(req.params["kind"], req.params["who"]):
+            raise KeyError(req.params["who"])
+        return Response(204)
+
+    # ------------------------------------------------------------------
+    # listeners / cluster / exhook
+    # ------------------------------------------------------------------
+
+    async def listeners(self, req: Request) -> Response:
+        return json_response([l.info() for l in self.node.listeners.all()])
+
+    async def cluster(self, req: Request) -> Response:
+        if self.node.cluster is None:
+            return json_response({"enabled": False, "nodes": [
+                {"node": self.broker.node, "status": "running"}
+            ]})
+        info = self.node.cluster.info()
+        info["enabled"] = True
+        return json_response(info)
+
+    async def exhooks(self, req: Request) -> Response:
+        if self.node.exhook is None:
+            return json_response([])
+        return json_response(self.node.exhook.stats())
+
+    # ------------------------------------------------------------------
+    # rules
+    # ------------------------------------------------------------------
+
+    def _rule_row(self, rule) -> Dict[str, Any]:
+        return {
+            "id": rule.id, "sql": rule.sql, "enable": rule.enable,
+            "description": rule.description, "created_at": rule.created_at,
+            "actions": [a for a in rule.actions if isinstance(a, dict)],
+            "metrics": dict(rule.metrics),
+        }
+
+    async def rules_list(self, req: Request) -> Response:
+        return json_response(_paginate(req, [
+            self._rule_row(r)
+            for r in self.node.rule_engine.rules.values()
+        ]))
+
+    async def rules_create(self, req: Request) -> Response:
+        body = req.json() or {}
+        rule_id = body.get("id") or f"rule_{int(time.time()*1000):x}"
+        if rule_id in self.node.rule_engine.rules:
+            return json_response(
+                {"code": "ALREADY_EXISTS", "message": rule_id}, 409
+            )
+        sql = body.get("sql")
+        if not sql:
+            raise ValueError("sql required")
+        rule = self.node.rule_engine.create_rule(
+            rule_id, sql, actions=body.get("actions"),
+            description=body.get("description", ""),
+            enable=bool(body.get("enable", True)),
+        )
+        return json_response(self._rule_row(rule), 201)
+
+    async def rules_one(self, req: Request) -> Response:
+        rule = self.node.rule_engine.rules.get(req.params["rule_id"])
+        if rule is None:
+            raise KeyError(req.params["rule_id"])
+        return json_response(self._rule_row(rule))
+
+    async def rules_update(self, req: Request) -> Response:
+        rid = req.params["rule_id"]
+        eng = self.node.rule_engine
+        old = eng.rules.get(rid)
+        if old is None:
+            raise KeyError(rid)
+        body = req.json() or {}
+        eng.delete_rule(rid)
+        try:
+            rule = eng.create_rule(
+                rid, body.get("sql", old.sql),
+                actions=body.get("actions", old.actions),
+                description=body.get("description", old.description),
+                enable=bool(body.get("enable", old.enable)),
+            )
+        except Exception:
+            eng.rules[rid] = old  # restore on bad update
+            raise
+        return json_response(self._rule_row(rule))
+
+    async def rules_delete(self, req: Request) -> Response:
+        if not self.node.rule_engine.delete_rule(req.params["rule_id"]):
+            raise KeyError(req.params["rule_id"])
+        return Response(204)
+
+    # ------------------------------------------------------------------
+    # configs
+    # ------------------------------------------------------------------
+
+    #: keys exposed for runtime read/update (hot-reloadable subset)
+    MUTABLE_KEYS = (
+        "mqtt.max_inflight", "mqtt.max_mqueue_len", "mqtt.max_packet_size",
+        "limiter.max_conn_rate", "limiter.max_messages_rate",
+        "limiter.max_bytes_rate", "retainer.msg_expiry_interval",
+        "delayed.max_delayed_messages", "authz.no_match",
+        "broker.shared_subscription_strategy",
+    )
+
+    async def configs_get(self, req: Request) -> Response:
+        return json_response({
+            k: self.node.config.get(k) for k in self.MUTABLE_KEYS
+        })
+
+    async def configs_put(self, req: Request) -> Response:
+        body = req.json() or {}
+        for k in body:
+            if k not in self.MUTABLE_KEYS:
+                raise ValueError(f"key {k!r} not runtime-mutable")
+        for k, val in body.items():
+            self.node.config.put(k, val)
+        return json_response({
+            k: self.node.config.get(k) for k in body
+        })

@@ -1,0 +1,182 @@
+"""``emqx ctl``-style CLI — drives a running node over the mgmt API.
+
+Behavioral reference: the ``emqx_ctl`` command registry + per-app
+``*_cli.erl`` modules [U] (SURVEY.md §2.3).  The reference attaches to
+the running BEAM node; here the transport is the management REST API,
+so the same commands work against any reachable node::
+
+    python -m emqx_tpu.mgmt.cli status
+    python -m emqx_tpu.mgmt.cli clients list
+    python -m emqx_tpu.mgmt.cli clients kick <clientid>
+    python -m emqx_tpu.mgmt.cli topics
+    python -m emqx_tpu.mgmt.cli publish -t a/b -m hello -q 1
+    python -m emqx_tpu.mgmt.cli rules list
+    python -m emqx_tpu.mgmt.cli cluster status
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+__all__ = ["main", "CtlClient"]
+
+
+class CtlClient:
+    def __init__(
+        self,
+        base: str = "http://127.0.0.1:18083",
+        key: Optional[str] = None,
+        secret: Optional[str] = None,
+    ) -> None:
+        self.base = base.rstrip("/")
+        self.auth = None
+        if key:
+            self.auth = base64.b64encode(
+                f"{key}:{secret or ''}".encode()
+            ).decode()
+
+    def call(self, method: str, path: str, body: Any = None) -> Any:
+        req = urllib.request.Request(
+            self.base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        if self.auth:
+            req.add_header("Authorization", f"Basic {self.auth}")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            data = e.read()
+            print(f"error {e.code}: {data.decode(errors='replace')}",
+                  file=sys.stderr)
+            raise SystemExit(1)
+        if not data:
+            return None
+        try:
+            return json.loads(data)
+        except json.JSONDecodeError:
+            return data.decode(errors="replace")
+
+
+def _print(data: Any) -> None:
+    if isinstance(data, str):
+        print(data, end="" if data.endswith("\n") else "\n")
+    else:
+        print(json.dumps(data, indent=2, default=str))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="emqx_tpu ctl")
+    ap.add_argument("--url", default="http://127.0.0.1:18083")
+    ap.add_argument("--key", default=None)
+    ap.add_argument("--secret", default=None)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status")
+    sub.add_parser("broker")
+    sub.add_parser("metrics")
+    sub.add_parser("stats")
+    sub.add_parser("listeners")
+    sub.add_parser("topics")
+    sub.add_parser("subscriptions")
+    sub.add_parser("alarms")
+
+    p = sub.add_parser("clients")
+    p.add_argument("action", choices=["list", "show", "kick"])
+    p.add_argument("clientid", nargs="?")
+
+    p = sub.add_parser("publish")
+    p.add_argument("-t", "--topic", required=True)
+    p.add_argument("-m", "--message", default="")
+    p.add_argument("-q", "--qos", type=int, default=0)
+    p.add_argument("-r", "--retain", action="store_true")
+
+    p = sub.add_parser("rules")
+    p.add_argument("action", choices=["list", "show", "delete", "create"])
+    p.add_argument("rule_id", nargs="?")
+    p.add_argument("--sql", default=None)
+
+    p = sub.add_parser("cluster")
+    p.add_argument("action", choices=["status"], nargs="?",
+                   default="status")
+
+    p = sub.add_parser("banned")
+    p.add_argument("action", choices=["list", "add", "delete"])
+    p.add_argument("--as", dest="kind", default="clientid")
+    p.add_argument("--who", default=None)
+
+    p = sub.add_parser("retainer")
+    p.add_argument("action", choices=["list", "show", "delete"])
+    p.add_argument("topic", nargs="?")
+
+    args = ap.parse_args(argv)
+    ctl = CtlClient(args.url, args.key, args.secret)
+    v = "/api/v5"
+
+    if args.cmd == "status":
+        _print(ctl.call("GET", f"{v}/status"))
+    elif args.cmd == "broker":
+        _print(ctl.call("GET", f"{v}/nodes"))
+    elif args.cmd in ("metrics", "stats", "listeners", "topics",
+                      "subscriptions", "alarms"):
+        _print(ctl.call("GET", f"{v}/{args.cmd}"))
+    elif args.cmd == "clients":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/clients"))
+        elif args.action == "show":
+            _print(ctl.call("GET", f"{v}/clients/{args.clientid}"))
+        else:
+            ctl.call("DELETE", f"{v}/clients/{args.clientid}")
+            print(f"kicked {args.clientid}")
+    elif args.cmd == "publish":
+        _print(ctl.call("POST", f"{v}/publish", {
+            "topic": args.topic, "payload": args.message,
+            "qos": args.qos, "retain": args.retain,
+        }))
+    elif args.cmd == "rules":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/rules"))
+        elif args.action == "show":
+            _print(ctl.call("GET", f"{v}/rules/{args.rule_id}"))
+        elif args.action == "delete":
+            ctl.call("DELETE", f"{v}/rules/{args.rule_id}")
+            print(f"deleted {args.rule_id}")
+        else:
+            if not args.sql:
+                print("--sql required", file=sys.stderr)
+                return 1
+            _print(ctl.call("POST", f"{v}/rules", {
+                "id": args.rule_id, "sql": args.sql,
+            }))
+    elif args.cmd == "cluster":
+        _print(ctl.call("GET", f"{v}/cluster"))
+    elif args.cmd == "banned":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/banned"))
+        elif args.action == "add":
+            _print(ctl.call("POST", f"{v}/banned", {
+                "as": args.kind, "who": args.who,
+            }))
+        else:
+            ctl.call("DELETE", f"{v}/banned/{args.kind}/{args.who}")
+            print(f"unbanned {args.who}")
+    elif args.cmd == "retainer":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/retainer/messages"))
+        elif args.action == "show":
+            _print(ctl.call("GET", f"{v}/retainer/message/{args.topic}"))
+        else:
+            ctl.call("DELETE", f"{v}/retainer/message/{args.topic}")
+            print(f"deleted retained {args.topic}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

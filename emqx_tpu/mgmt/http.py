@@ -1,0 +1,267 @@
+"""Minimal asyncio HTTP/1.1 server — the ``minirest`` analog.
+
+Behavioral reference: the reference serves its management REST API via
+``minirest`` on cowboy (SURVEY.md §2.3, ``apps/emqx_management``).  No
+HTTP framework is available here, so this implements the slice REST
+needs: request-line + header parsing, bounded bodies, path templates
+(``/clients/{clientid}``), query strings, JSON in/out, basic auth, and
+keep-alive.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import re
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlsplit
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Request", "Response", "HttpServer", "json_response"]
+
+MAX_HEADER = 32 << 10
+MAX_BODY = 8 << 20
+
+_STATUS = {
+    200: "OK", 201: "Created", 204: "No Content", 400: "Bad Request",
+    401: "Unauthorized", 404: "Not Found", 405: "Method Not Allowed",
+    409: "Conflict", 413: "Payload Too Large", 500: "Internal Server Error",
+    503: "Service Unavailable",
+}
+
+
+class Request:
+    def __init__(
+        self, method: str, path: str, query: Dict[str, List[str]],
+        headers: Dict[str, str], body: bytes,
+    ) -> None:
+        self.method = method
+        self.path = path
+        self.query = query
+        self.headers = headers
+        self.body = body
+        self.params: Dict[str, str] = {}  # path template captures
+
+    def q(self, name: str, default: Optional[str] = None) -> Optional[str]:
+        vals = self.query.get(name)
+        return vals[0] if vals else default
+
+    def qint(self, name: str, default: int) -> int:
+        try:
+            return int(self.q(name, str(default)))
+        except (TypeError, ValueError):
+            return default
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body.decode("utf-8"))
+
+
+class Response:
+    def __init__(
+        self, status: int = 200, body: bytes = b"",
+        content_type: str = "application/json",
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.status = status
+        self.body = body
+        self.content_type = content_type
+        self.headers = headers or {}
+
+
+def json_response(data: Any, status: int = 200) -> Response:
+    return Response(
+        status=status,
+        body=json.dumps(data, default=str).encode("utf-8"),
+    )
+
+
+Handler = Callable[[Request], Awaitable[Response]]
+
+
+class HttpServer:
+    """Route table + acceptor.  Routes are ``(METHOD, template)`` where a
+    template segment ``{name}`` captures into ``req.params``."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 18083,
+        auth: Optional[Callable[[Request], bool]] = None,
+        auth_exempt: Tuple[str, ...] = (),
+    ) -> None:
+        self.host, self.port = host, port
+        self._routes: List[Tuple[str, re.Pattern, Handler]] = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._auth = auth
+        self._auth_exempt = auth_exempt
+        self._writers: set = set()  # open keep-alive conns, closed on stop
+
+    def route(self, method: str, template: str, handler: Handler) -> None:
+        # {name} captures one segment; {name+} captures the rest of the
+        # path (topics contain slashes)
+        pat = re.sub(r"\{(\w+)\+\}", r"(?P<\1>.+)", template)
+        pat = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pat)
+        self._routes.append(
+            (method.upper(), re.compile("^" + pat + "/?$"), handler)
+        )
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._client, self.host, self.port
+        )
+        socks = self._server.sockets or []
+        if socks and self.port == 0:
+            self.port = socks[0].getsockname()[1]
+        log.info("mgmt http listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # close parked keep-alive conns FIRST: 3.12 wait_closed()
+            # blocks until every connection handler returns
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5.0)
+            except asyncio.TimeoutError:
+                pass
+            self._server = None
+
+    # ------------------------------------------------------------------
+
+    async def _client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self._writers.add(writer)
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    return
+                resp = await self._dispatch(req)
+                keep = req.headers.get("connection", "keep-alive") != "close"
+                data = self._serialize(resp, keep)
+                writer.write(data)
+                await writer.drain()
+                if not keep:
+                    return
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            log.exception("mgmt http connection crashed")
+        finally:
+            self._writers.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _read_request(
+        self, reader: asyncio.StreamReader
+    ) -> Optional[Request]:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError):
+            return None
+        if len(head) > MAX_HEADER:
+            return None
+        lines = head.decode("latin-1").split("\r\n")
+        parts = lines[0].split(" ")
+        if len(parts) != 3:
+            return None
+        method, target, _ver = parts
+        headers: Dict[str, str] = {}
+        for ln in lines[1:]:
+            if ":" in ln:
+                k, _, v = ln.partition(":")
+                headers[k.strip().lower()] = v.strip()
+        n = int(headers.get("content-length", "0") or 0)
+        if n > MAX_BODY:
+            return None
+        body = await reader.readexactly(n) if n else b""
+        u = urlsplit(target)
+        # keep the path RAW for route matching: an encoded '/' inside a
+        # clientid/topic must not become a path separator; only captured
+        # params are unquoted (once, in _dispatch)
+        return Request(
+            method.upper(), u.path, parse_qs(u.query), headers, body
+        )
+
+    async def _dispatch(self, req: Request) -> Response:
+        if self._auth is not None and req.path not in self._auth_exempt:
+            if not self._auth(req):
+                return Response(
+                    401,
+                    b'{"code":"UNAUTHORIZED","message":"bad api key"}',
+                    headers={"WWW-Authenticate": 'Basic realm="emqx_tpu"'},
+                )
+        allowed: List[str] = []
+        for method, pat, handler in self._routes:
+            m = pat.match(req.path)
+            if m is None:
+                continue
+            if method != req.method:
+                allowed.append(method)
+                continue
+            req.params = {
+                k: unquote(v) for k, v in m.groupdict().items()
+            }
+            try:
+                return await handler(req)
+            except json.JSONDecodeError:
+                return json_response(
+                    {"code": "BAD_REQUEST", "message": "invalid json"}, 400
+                )
+            except KeyError as e:
+                return json_response(
+                    {"code": "NOT_FOUND", "message": str(e)}, 404
+                )
+            except ValueError as e:
+                return json_response(
+                    {"code": "BAD_REQUEST", "message": str(e)}, 400
+                )
+            except Exception:
+                log.exception("handler failed: %s %s", req.method, req.path)
+                return json_response(
+                    {"code": "INTERNAL_ERROR", "message": "internal error"},
+                    500,
+                )
+        if allowed:
+            return json_response(
+                {"code": "METHOD_NOT_ALLOWED", "message": "/".join(allowed)},
+                405,
+            )
+        return json_response(
+            {"code": "NOT_FOUND", "message": req.path}, 404
+        )
+
+    def _serialize(self, resp: Response, keep: bool) -> bytes:
+        reason = _STATUS.get(resp.status, "Unknown")
+        hdrs = [
+            f"HTTP/1.1 {resp.status} {reason}",
+            f"Content-Type: {resp.content_type}",
+            f"Content-Length: {len(resp.body)}",
+            f"Connection: {'keep-alive' if keep else 'close'}",
+        ]
+        hdrs += [f"{k}: {v}" for k, v in resp.headers.items()]
+        return ("\r\n".join(hdrs) + "\r\n\r\n").encode("latin-1") + resp.body
+
+
+def basic_auth_checker(key: str, secret: str) -> Callable[[Request], bool]:
+    import hmac
+
+    want = f"Basic {base64.b64encode(f'{key}:{secret}'.encode()).decode()}"
+
+    def check(req: Request) -> bool:
+        auth = req.headers.get("authorization", "")
+        return hmac.compare_digest(auth, want)  # constant-time
+
+    return check

@@ -104,11 +104,14 @@ class BrokerNode:
                 ),
             )
         self._attach_client_metrics()
+        self._register_config_handlers()
         # session expiry: clientid -> disconnect time, swept by housekeeping
         self._disconnected_at: Dict[str, float] = {}
 
         self.exhook = None  # built lazily in start() (needs a loop + grpc)
         self.cluster = None  # built lazily in start() (needs a loop)
+        self.mgmt = None
+        self.mgmt_server = None
         self.limiter = LimiterGroup(
             max_conn_rate=cfg.get("limiter.max_conn_rate"),
             max_messages_rate=cfg.get("limiter.max_messages_rate"),
@@ -147,6 +150,61 @@ class BrokerNode:
         hooks.add("client.unsubscribe",
                   lambda cid, pkt: m.inc("client.unsubscribe"),
                   name="metrics.client.unsubscribe")
+
+    def _register_config_handlers(self) -> None:
+        """Hot-update plumbing (emqx_config_handler analog): push runtime
+        config changes into the live components, so PUT /api/v5/configs
+        actually takes effect (SURVEY.md §5.6)."""
+        cfg = self.config
+        cfg.on_update(
+            "limiter.max_conn_rate",
+            lambda p, o, n: self.limiter.reconfigure(max_conn_rate=n),
+        )
+        cfg.on_update(
+            "limiter.max_messages_rate",
+            lambda p, o, n: self.limiter.reconfigure(max_messages_rate=n),
+        )
+        cfg.on_update(
+            "limiter.max_bytes_rate",
+            lambda p, o, n: self.limiter.reconfigure(max_bytes_rate=n),
+        )
+        cfg.on_update(
+            "mqtt.max_inflight",
+            lambda p, o, n: self.broker.session_defaults.__setitem__(
+                "max_inflight", n
+            ),
+        )
+        cfg.on_update(
+            "mqtt.max_mqueue_len",
+            lambda p, o, n: self.broker.session_defaults.__setitem__(
+                "max_mqueue_len", n
+            ),
+        )
+        cfg.on_update(
+            "broker.shared_subscription_strategy",
+            lambda p, o, n: setattr(self.broker.shared, "strategy", n),
+        )
+        if self.retainer is not None:
+            cfg.on_update(
+                "retainer.msg_expiry_interval",
+                lambda p, o, n: setattr(
+                    self.retainer, "msg_expiry_interval", n
+                ),
+            )
+        if self.delayed is not None:
+            cfg.on_update(
+                "delayed.max_delayed_messages",
+                lambda p, o, n: setattr(
+                    self.delayed, "max_delayed_messages", n
+                ),
+            )
+        if self.access_control is not None:
+            cfg.on_update(
+                "authz.no_match",
+                lambda p, o, n: setattr(
+                    self.access_control.authz, "no_match", n
+                ),
+            )
 
     def _mark_disconnected(self, clientid: str) -> None:
         sess = self.broker.sessions.get(clientid)
@@ -277,9 +335,35 @@ class BrokerNode:
     async def start(self) -> None:
         await self._start_cluster()
         await self._start_exhook()
+        await self._start_mgmt()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(asyncio.ensure_future(self._housekeeping()))
+
+    async def _start_mgmt(self) -> None:
+        if not self.config.get("dashboard.enable"):
+            return
+        from .mgmt import HttpServer, MgmtApi, basic_auth_checker
+
+        bind = self.config.get("dashboard.listen")
+        host, _, port = bind.rpartition(":")
+        auth = None
+        if self.config.get("api_key.enable"):
+            auth = basic_auth_checker(
+                self.config.get("api_key.key"),
+                self.config.get("api_key.secret"),
+            )
+        elif (host or "0.0.0.0") not in ("127.0.0.1", "localhost", "::1"):
+            log.warning(
+                "management API on %s without api_key.enable: any network "
+                "peer can kick clients, publish, and mutate config", bind
+            )
+        self.mgmt_server = HttpServer(
+            host or "0.0.0.0", int(port), auth=auth,
+            auth_exempt=("/api/v5/status",),
+        )
+        self.mgmt = MgmtApi(self, self.mgmt_server)
+        await self.mgmt_server.start()
 
     async def _start_cluster(self) -> None:
         if not self.config.get("cluster.enable"):
@@ -332,6 +416,10 @@ class BrokerNode:
         if self.cluster is not None:
             await self.cluster.stop()
             self.cluster = None
+        if self.mgmt_server is not None:
+            await self.mgmt_server.stop()
+            self.mgmt_server = None
+            self.mgmt = None
         # kick live connections BEFORE awaiting listener close: 3.12's
         # Server.wait_closed() blocks until every connection handler
         # returns, so the order matters.  _all_conns covers sockets that
