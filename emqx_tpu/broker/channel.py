@@ -162,11 +162,17 @@ class Channel:
         recv_max = pkt.properties.get("Receive-Maximum", self.max_inflight)
         if recv_max == 0:  # MQTT5 §3.1.2.11: value 0 is a protocol error
             return self._connack_error(P.RC.PROTOCOL_ERROR)
-        expiry = pkt.properties.get("Session-Expiry-Interval", 0)
+        expiry = pkt.properties.get("Session-Expiry-Interval")
+        kw = {"max_inflight": min(recv_max, self.max_inflight)}
+        if expiry is not None:
+            kw["expiry_interval"] = float(expiry)
+        elif pkt.proto_ver == 5 or pkt.clean_start:
+            # v5 default: session ends at disconnect (§3.1.2.11)
+            kw["expiry_interval"] = 0.0
+        # else: 3.1.1 clean_session=0 has no expiry on the wire — the
+        # configured mqtt.session_expiry_interval default applies
         sess, present, old_chan = self.cm.open_session(
-            clientid, pkt.clean_start, self,
-            max_inflight=min(recv_max, self.max_inflight),
-            expiry_interval=float(expiry),
+            clientid, pkt.clean_start, self, **kw
         )
         self.session = sess
         self.state = "connected"

@@ -179,6 +179,11 @@ SCHEMA: Dict[str, Field] = {
     "sysmon.os.cpu_low_watermark": Field(0.60, float),
     "sysmon.os.mem_high_watermark": Field(0.70, float),
 
+    # -- durable storage (SURVEY.md §5.4: emqx_ds / mnesia disc) ----------
+    # empty = in-memory only (no persistence)
+    "node.data_dir": Field("", str),
+    "durable_storage.sync_interval": Field(5.0, duration),
+
     # -- management API (SURVEY.md §2.3: emqx_management/minirest) --------
     # off by default: embedded/multi-node-on-one-host uses must opt in
     # (the reference's standalone release enables it in its dist config)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -52,7 +53,8 @@ class _Engine:
     """
 
     def __init__(
-        self, filters: List[str], deep: List[str], depth: int, version: int
+        self, filters: List[str], deep: List[str], depth: int, version: int,
+        table=None,
     ) -> None:
         import jax
         import jax.numpy as jnp
@@ -62,7 +64,9 @@ class _Engine:
         self.filters = filters  # id -> filter string (table_version scope)
         self.deep = deep
         self.version = version
-        self.table = compile_filters(filters, depth=depth)
+        # a checkpointed table skips the compile (SURVEY.md §5.4)
+        self.table = table if table is not None \
+            else compile_filters(filters, depth=depth)
         self.args = [jnp.asarray(a) for a in self.table.device_arrays()]
         self._fn = jax.jit(build_matcher())
         self._jnp = jnp
@@ -120,6 +124,7 @@ class TpuMatchSidecar:
         rebuild_debounce_s: float = 0.1,
         annotate: bool = False,
         node: str = "tpu-sidecar",
+        checkpoint_path: str = "",
     ) -> None:
         self.depth = depth
         self.batch_window_s = batch_window_ms / 1000.0
@@ -127,6 +132,7 @@ class TpuMatchSidecar:
         self.rebuild_debounce_s = rebuild_debounce_s
         self.annotate = annotate
         self.node = node
+        self.checkpoint_path = checkpoint_path
 
         self._ref: Dict[str, int] = {}       # filter -> refcount
         self._trie = FilterTrie()             # host fallback (fail-open)
@@ -149,10 +155,40 @@ class TpuMatchSidecar:
 
     async def start(self) -> None:
         self._running = True
+        if self.checkpoint_path:
+            self._restore_checkpoint()
         self._tasks = [
             asyncio.ensure_future(self._rebuild_loop()),
             asyncio.ensure_future(self._batch_loop()),
         ]
+
+    def _restore_checkpoint(self) -> None:
+        """Serve the checkpointed table immediately; the subscription feed
+        (hooks / InstallSnapshot) reconciles the mirror afterwards."""
+        try:
+            from ..storage.checkpoint import load_table
+
+            table = load_table(self.checkpoint_path)
+            if table is None:
+                return
+            filters = sorted(table.accept_filters)
+            self._table_version += 1
+            engine = _Engine(
+                filters, [], self.depth, self._table_version, table=table
+            )
+            engine.match(["warm/up"], batch=64)
+            self._engine = engine
+            # deliberately do NOT seed _ref/_trie from the checkpoint:
+            # the live feed (hooks / InstallSnapshot) is authoritative,
+            # and ghost refcounts would pin filters whose subscribers
+            # vanished while we were down.  The checkpointed engine
+            # serves (possibly stale) answers until the first rebuild.
+            log.info(
+                "checkpoint restored: %d filters, %d states (stale until "
+                "first sync)", len(filters), table.n_states,
+            )
+        except Exception:
+            log.exception("checkpoint restore failed; cold start")
 
     async def stop(self) -> None:
         self._running = False
@@ -216,6 +252,18 @@ class TpuMatchSidecar:
                     len(filters), len(deep), version,
                     (time.perf_counter() - t0) * 1e3,
                 )
+                if self.checkpoint_path:
+                    try:
+                        from ..storage.checkpoint import save_table
+
+                        if engine is not None:
+                            save_table(engine.table, self.checkpoint_path)
+                        elif os.path.exists(self.checkpoint_path):
+                            # an emptied mirror must not resurrect the
+                            # old table on the next restart
+                            os.remove(self.checkpoint_path)
+                    except Exception:
+                        log.exception("checkpoint save failed")
             except Exception:
                 log.exception("mirror rebuild failed; host fallback serves")
 
@@ -424,13 +472,16 @@ def main() -> None:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--depth", type=int, default=8)
     ap.add_argument("--annotate", action="store_true")
+    ap.add_argument("--checkpoint", default="",
+                    help="path for the compiled-table checkpoint")
     args = ap.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     async def run():
         server, _ = await serve(
             port=args.port, host=args.host,
-            sidecar=TpuMatchSidecar(depth=args.depth, annotate=args.annotate),
+            sidecar=TpuMatchSidecar(depth=args.depth, annotate=args.annotate,
+                                    checkpoint_path=args.checkpoint),
         )
         await server.wait_for_termination()
 

@@ -87,6 +87,8 @@ class MgmtApi:
         r("GET", f"{v}/exhooks", self.exhooks)
         r("GET", f"{v}/configs", self.configs_get)
         r("PUT", f"{v}/configs", self.configs_put)
+        r("POST", f"{v}/data/export", self.data_export)
+        r("POST", f"{v}/data/import", self.data_import)
 
     # ------------------------------------------------------------------
     # node / observability
@@ -442,6 +444,27 @@ class MgmtApi:
         return Response(204)
 
     # ------------------------------------------------------------------
+    # data backup (emqx_mgmt_data_backup analog)
+    # ------------------------------------------------------------------
+
+    async def data_export(self, req: Request) -> Response:
+        from ..storage import export_data
+
+        return Response(
+            200, export_data(self.node),
+            content_type="application/gzip",
+            headers={"Content-Disposition":
+                     'attachment; filename="emqx-tpu-export.tar.gz"'},
+        )
+
+    async def data_import(self, req: Request) -> Response:
+        from ..storage import import_data
+
+        if not req.body:
+            raise ValueError("archive body required")
+        return json_response(import_data(self.node, req.body))
+
+    # ------------------------------------------------------------------
     # configs
     # ------------------------------------------------------------------
 
@@ -461,9 +484,14 @@ class MgmtApi:
 
     async def configs_put(self, req: Request) -> Response:
         body = req.json() or {}
-        for k in body:
+        schema = self.node.config.schema
+        # validate EVERY key and value before applying ANY (atomic from
+        # the caller's view; a partial apply on a mid-loop coercion error
+        # would silently leave earlier keys live)
+        for k, val in body.items():
             if k not in self.MUTABLE_KEYS:
                 raise ValueError(f"key {k!r} not runtime-mutable")
+            schema[k].coerce(k, val)
         for k, val in body.items():
             self.node.config.put(k, val)
         return json_response({

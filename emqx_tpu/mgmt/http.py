@@ -183,8 +183,11 @@ class HttpServer:
             if ":" in ln:
                 k, _, v = ln.partition(":")
                 headers[k.strip().lower()] = v.strip()
-        n = int(headers.get("content-length", "0") or 0)
-        if n > MAX_BODY:
+        try:
+            n = int(headers.get("content-length", "0") or 0)
+        except ValueError:
+            return None  # malformed length: drop quietly, no stack trace
+        if n < 0 or n > MAX_BODY:
             return None
         body = await reader.readexactly(n) if n else b""
         u = urlsplit(target)

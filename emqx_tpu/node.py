@@ -105,8 +105,17 @@ class BrokerNode:
             )
         self._attach_client_metrics()
         self._register_config_handlers()
-        # session expiry: clientid -> disconnect time, swept by housekeeping
+        # session expiry: clientid -> disconnect time, swept by
+        # housekeeping; must exist before restore so restored disconnected
+        # sessions enter the expiry sweep immediately
         self._disconnected_at: Dict[str, float] = {}
+        self.persistence = None
+        data_dir = (cfg.get("node.data_dir") or "").strip()
+        if data_dir:
+            from .storage import Persistence
+
+            self.persistence = Persistence(self, data_dir)
+            self.persistence.restore()
 
         self.exhook = None  # built lazily in start() (needs a loop + grpc)
         self.cluster = None  # built lazily in start() (needs a loop)
@@ -302,12 +311,15 @@ class BrokerNode:
             self.broker.outbox_put(clientid, pubs)
 
     def kick_client(self, clientid: str) -> bool:
-        """Management 'kick out client' (emqx_mgmt:kickout_client)."""
-        chan = self.cm.kick(clientid)
+        """Management 'kick out client' (emqx_mgmt:kickout_client).
+        Also evicts an offline durable session (no live channel)."""
+        had_session = clientid in self.broker.sessions
+        chan = self.cm.kick(clientid)  # discards the broker session too
         conn = self.connections.pop(clientid, None)
         if conn is not None:
             conn.kick("kicked by management")
-        return chan is not None or conn is not None
+        self._disconnected_at.pop(clientid, None)
+        return chan is not None or conn is not None or had_session
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -420,6 +432,8 @@ class BrokerNode:
             await self.mgmt_server.stop()
             self.mgmt_server = None
             self.mgmt = None
+        if self.persistence is not None:
+            self.persistence.close()
         # kick live connections BEFORE awaiting listener close: 3.12's
         # Server.wait_closed() blocks until every connection handler
         # returns, so the order matters.  _all_conns covers sockets that
@@ -446,6 +460,12 @@ class BrokerNode:
                     self.retainer.clean_expired()
                 self.banned.clean_expired()
                 self._expire_sessions()
+                if self.persistence is not None:
+                    sync_iv = self.config.get(
+                        "durable_storage.sync_interval"
+                    )
+                    if time.time() - self.persistence.last_sync >= sync_iv:
+                        await self.persistence.sync_async()
             except Exception:
                 log.exception("housekeeping job failed")
 

@@ -69,6 +69,14 @@ class DelayedPublish:
         self.stats["accepted"] += 1
         return None
 
+    def schedule(
+        self, msg: Message, delay: float, now: Optional[float] = None
+    ) -> None:
+        """Direct enqueue of an already-stripped message (persistence
+        restore path — bypasses the $delayed/ topic parsing)."""
+        now = now if now is not None else time.time()
+        heapq.heappush(self._heap, (now + delay, next(self._seq), msg))
+
     def due(self, now: Optional[float] = None) -> List[Message]:
         """Pop every message whose delay has elapsed."""
         now = now if now is not None else time.time()
@@ -83,6 +91,10 @@ class DelayedPublish:
 
     def to_list(self) -> List[Tuple[float, Message]]:
         return [(at, m) for at, _, m in sorted(self._heap)]
+
+    def entries(self) -> List[Tuple[float, int, Message]]:
+        """(fire_at, seq, msg) rows — stable keys for persistence."""
+        return sorted(self._heap)
 
     # ------------------------------------------------------------------
 

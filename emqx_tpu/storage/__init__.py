@@ -1,0 +1,14 @@
+"""Durable storage (SURVEY.md §5.4): log-structured store (emqx_ds
+analog), session/retained/delayed/banned persistence, NFA table
+checkpoints, and data import/export."""
+
+from .backup import export_data, import_data
+from .checkpoint import load_table, save_table
+from .persistence import Persistence
+from .store import Store, Table
+
+__all__ = [
+    "Store", "Table", "Persistence",
+    "save_table", "load_table",
+    "export_data", "import_data",
+]

@@ -1,0 +1,127 @@
+"""Data import/export — the ``emqx_mgmt_data_backup`` analog.
+
+Behavioral reference (SURVEY.md §5.4): the reference exports a tar of
+tables + config (``emqx export``) and re-imports it on any node.  Here
+the archive is a tar.gz holding one JSON document per concern (retained,
+sessions, banned, delayed, rules, config overrides) plus a manifest;
+import merges into the running node.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+import time
+from typing import Any, Dict
+
+from .codec import (
+    ban_to_dict,
+    msg_from_dict,
+    msg_to_dict,
+    session_restore,
+    session_to_dict,
+)
+
+__all__ = ["export_data", "import_data"]
+
+_VERSION = 1
+
+
+def _collect(node: Any) -> Dict[str, Any]:
+    broker = node.broker
+    docs: Dict[str, Any] = {
+        "manifest": {
+            "version": _VERSION,
+            "node": broker.node,
+            "exported_at": time.time(),
+        },
+        "sessions": [
+            session_to_dict(s)
+            for s in broker.sessions.values()
+            if not s.clean_start or s.expiry_interval > 0
+        ],
+        "banned": [ban_to_dict(e) for e in node.banned.list()],
+        "rules": [
+            {"id": r.id, "sql": r.sql, "enable": r.enable,
+             "description": r.description,
+             "actions": [a for a in r.actions if isinstance(a, dict)]}
+            for r in node.rule_engine.rules.values()
+        ],
+    }
+    if node.retainer is not None:
+        docs["retained"] = [
+            msg_to_dict(m)
+            for t in node.retainer.topics()
+            for m in node.retainer.match(t)
+        ]
+    if node.delayed is not None:
+        docs["delayed"] = [
+            {"fire_at": at, "msg": msg_to_dict(m)}
+            for at, m in node.delayed.to_list()
+        ]
+    return docs
+
+
+def export_data(node: Any) -> bytes:
+    """Returns a tar.gz archive of the node's durable state."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for name, doc in _collect(node).items():
+            data = json.dumps(doc, indent=1, default=str).encode()
+            info = tarfile.TarInfo(name=f"{name}.json")
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def import_data(node: Any, archive: bytes) -> Dict[str, int]:
+    """Merge an exported archive into the running node."""
+    counts = {"sessions": 0, "retained": 0, "banned": 0, "rules": 0,
+              "delayed": 0}
+    docs: Dict[str, Any] = {}
+    with tarfile.open(fileobj=io.BytesIO(archive), mode="r:gz") as tar:
+        for member in tar.getmembers():
+            f = tar.extractfile(member)
+            if f is None:
+                continue
+            docs[member.name.removesuffix(".json")] = json.load(f)
+    manifest = docs.get("manifest", {})
+    if manifest.get("version") not in (None, _VERSION):
+        raise ValueError(
+            f"unsupported backup version {manifest.get('version')!r}"
+        )
+    for d in docs.get("sessions", []):
+        if d.get("clientid") not in node.broker.sessions:
+            session_restore(node.broker, d)
+            counts["sessions"] += 1
+    if node.retainer is not None:
+        for md in docs.get("retained", []):
+            node.retainer.insert(msg_from_dict(md))
+            counts["retained"] += 1
+    for bd in docs.get("banned", []):
+        until = bd.get("until")
+        node.banned.add(
+            bd["kind"], bd["who"],
+            duration=(until - time.time()) if until else None,
+            by=bd.get("by", "import"), reason=bd.get("reason", ""),
+        )
+        counts["banned"] += 1
+    if node.delayed is not None:
+        now = time.time()
+        for dd in docs.get("delayed", []):
+            node.delayed.schedule(
+                msg_from_dict(dd["msg"]),
+                max(0.0, float(dd["fire_at"]) - now),
+            )
+            counts["delayed"] += 1
+    for rd in docs.get("rules", []):
+        if rd["id"] not in node.rule_engine.rules:
+            node.rule_engine.create_rule(
+                rd["id"], rd["sql"], actions=rd.get("actions"),
+                description=rd.get("description", ""),
+                enable=bool(rd.get("enable", True)),
+            )
+            counts["rules"] += 1
+    return counts

@@ -1,0 +1,60 @@
+"""NFA table checkpoint — skip the cold compile on restart.
+
+SURVEY.md §5.4: the device mirror needs versioned snapshots; beyond the
+in-memory epoch/delta discipline, a compiled :class:`NfaTable` can be
+checkpointed to disk (arrays as ``.npz``, metadata as JSON inside it)
+and restored directly, the way orbax checkpoints compiled train state —
+a restart then serves from the checkpoint while the background rebuild
+catches up with any missed deltas.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..ops.compiler import NfaTable
+
+__all__ = ["save_table", "load_table"]
+
+
+def save_table(table: NfaTable, path: str) -> None:
+    tmp = path + ".tmp"
+    meta = {
+        "n_states": table.n_states,
+        "depth": table.depth,
+        "epoch": table.epoch,
+        "vocab": table.vocab,
+        "accept_filters": table.accept_filters,
+    }
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            node_tab=table.node_tab,
+            edge_tab=table.edge_tab,
+            seeds=table.seeds,
+            meta=np.frombuffer(
+                json.dumps(meta).encode("utf-8"), dtype=np.uint8
+            ),
+        )
+    os.replace(tmp, path)
+
+
+def load_table(path: str) -> Optional[NfaTable]:
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+        return NfaTable(
+            node_tab=z["node_tab"],
+            edge_tab=z["edge_tab"],
+            seeds=z["seeds"],
+            n_states=int(meta["n_states"]),
+            depth=int(meta["depth"]),
+            vocab=dict(meta["vocab"]),
+            accept_filters=list(meta["accept_filters"]),
+            epoch=int(meta["epoch"]),
+        )

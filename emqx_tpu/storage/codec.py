@@ -1,0 +1,97 @@
+"""JSON-safe serialization of broker records (messages, subscriptions,
+sessions, bans) for the durable store and the data export archive."""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, Optional
+
+from ..broker.message import Message
+from ..broker.session import Session, SubOpts
+
+__all__ = [
+    "msg_to_dict", "msg_from_dict",
+    "subopts_to_dict", "subopts_from_dict",
+    "session_to_dict", "session_restore",
+    "ban_to_dict",
+]
+
+
+def msg_to_dict(m: Message) -> Dict[str, Any]:
+    return {
+        "id": m.id, "qos": m.qos, "from": m.sender, "topic": m.topic,
+        "payload": base64.b64encode(m.payload or b"").decode(),
+        "retain": m.retain, "ts": m.timestamp,
+        "props": m.properties or None,
+        "headers": {k: v for k, v in (m.headers or {}).items()
+                    if isinstance(v, (str, int, float, bool))} or None,
+    }
+
+
+def msg_from_dict(d: Dict[str, Any]) -> Message:
+    return Message(
+        id=int(d.get("id", 0)), qos=int(d.get("qos", 0)),
+        sender=d.get("from"), topic=d["topic"],
+        payload=base64.b64decode(d.get("payload", "")),
+        retain=bool(d.get("retain", False)),
+        timestamp=float(d.get("ts", 0.0)),
+        properties=d.get("props") or {},
+        headers=d.get("headers") or {},
+    )
+
+
+def subopts_to_dict(o: SubOpts) -> Dict[str, Any]:
+    return {
+        "qos": o.qos, "nl": int(o.nl), "rap": int(o.rap), "rh": o.rh,
+        "share": o.share, "subid": o.subid,
+    }
+
+
+def subopts_from_dict(d: Dict[str, Any]) -> SubOpts:
+    return SubOpts(
+        qos=int(d.get("qos", 0)), nl=bool(d.get("nl", 0)),
+        rap=bool(d.get("rap", 0)), rh=int(d.get("rh", 0)),
+        share=d.get("share"), subid=d.get("subid"),
+    )
+
+
+def session_to_dict(sess: Session) -> Dict[str, Any]:
+    return {
+        "clientid": sess.clientid,
+        "clean_start": sess.clean_start,
+        "created_at": sess.created_at,
+        "expiry_interval": sess.expiry_interval,
+        "subscriptions": {
+            flt: subopts_to_dict(o)
+            for flt, o in sess.subscriptions.items()
+        },
+        "pending": [msg_to_dict(m) for m in sess.pending_messages()],
+    }
+
+
+def session_restore(broker: Any, d: Dict[str, Any]) -> Optional[Session]:
+    """Recreate a persisted session in the broker (resubscribing restores
+    routes, and thus the route replication + device mirror feeds)."""
+    cid = d["clientid"]
+    sess, _present = broker.open_session(
+        cid, clean_start=False,
+        expiry_interval=float(d.get("expiry_interval", 0.0)),
+    )
+    sess.created_at = float(d.get("created_at", sess.created_at))
+    sess.connected = False
+    for flt, od in (d.get("subscriptions") or {}).items():
+        try:
+            broker.subscribe(cid, flt, subopts_from_dict(od))
+        except Exception:
+            continue
+    pending = [msg_from_dict(md) for md in d.get("pending") or []]
+    if pending:
+        sess.deliver(pending)
+    return sess
+
+
+def ban_to_dict(e: Any) -> Dict[str, Any]:
+    return {
+        "kind": e.kind, "who": e.who, "by": e.by, "reason": e.reason,
+        "at": e.at, "until": e.until,
+    }

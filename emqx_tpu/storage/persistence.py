@@ -1,0 +1,156 @@
+"""Node persistence: durable sessions, retained, delayed, banned.
+
+Behavioral reference (SURVEY.md §5.4): the reference persists retained
+messages, persistent sessions (clean_start=false / expiry>0), the
+banned table and delayed messages across restarts (mnesia disc_copies /
+``emqx_ds``).  Here a :class:`~emqx_tpu.storage.store.Store` holds one
+table per concern; restore happens at node construction (before
+listeners accept), and a periodic sync flushes changes (plus a final
+sync on stop) — the flush interval bounds data loss on crash the same
+way mnesia's dump_log interval does.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List
+
+from .codec import (
+    ban_to_dict,
+    msg_from_dict,
+    msg_to_dict,
+    session_restore,
+    session_to_dict,
+)
+from .store import Store, Table
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Persistence"]
+
+
+class Persistence:
+    def __init__(self, node: Any, data_dir: str) -> None:
+        self.node = node
+        self.broker = node.broker
+        self.store = Store(data_dir)
+        self.t_sessions = self.store.table("sessions")
+        self.t_retained = self.store.table("retained")
+        self.t_delayed = self.store.table("delayed")
+        self.t_banned = self.store.table("banned")
+        self.last_sync = 0.0
+
+    # ------------------------------------------------------------------
+    # restore (at node construction)
+    # ------------------------------------------------------------------
+
+    def restore(self) -> Dict[str, int]:
+        counts = {"sessions": 0, "retained": 0, "delayed": 0, "banned": 0}
+        for _cid, d in list(self.t_sessions.items()):
+            try:
+                sess = session_restore(self.broker, d)
+                # restored sessions are disconnected: enter the expiry
+                # sweep now so they don't outlive their expiry interval
+                if sess is not None:
+                    self.node._disconnected_at.setdefault(
+                        sess.clientid, time.time()
+                    )
+                counts["sessions"] += 1
+            except Exception:
+                log.exception("restore session %r failed", _cid)
+        if self.node.retainer is not None:
+            for _topic, d in list(self.t_retained.items()):
+                try:
+                    self.node.retainer.insert(msg_from_dict(d))
+                    counts["retained"] += 1
+                except Exception:
+                    log.exception("restore retained %r failed", _topic)
+        if self.node.delayed is not None:
+            now = time.time()
+            for key, d in list(self.t_delayed.items()):
+                try:
+                    fire_at = float(d["fire_at"])
+                    msg = msg_from_dict(d["msg"])
+                    delay = max(0.0, fire_at - now)
+                    self.node.delayed.schedule(msg, delay, now=now)
+                    counts["delayed"] += 1
+                except Exception:
+                    log.exception("restore delayed %r failed", key)
+        for _key, d in list(self.t_banned.items()):
+            try:
+                until = d.get("until")
+                self.node.banned.add(
+                    d["kind"], d["who"],
+                    duration=(until - time.time()) if until else None,
+                    by=d.get("by", "restore"), reason=d.get("reason", ""),
+                )
+                counts["banned"] += 1
+            except Exception:
+                log.exception("restore ban %r failed", _key)
+        log.info("persistence restored: %s", counts)
+        return counts
+
+    # ------------------------------------------------------------------
+    # sync (periodic from housekeeping + on stop)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _sync_table(table: Table, want: Dict[str, Any]) -> None:
+        """Reconcile the persistent table with the live dict (puts ride
+        the wal; removals too; unchanged keys are skipped)."""
+        live = dict(table.items())
+        for k, v in want.items():
+            if live.get(k) != v:
+                table.put(k, v)
+        for k in live:
+            if k not in want:
+                table.delete(k)
+
+    def _collect(self) -> List[tuple]:
+        """Serialize live state to JSON-safe dicts ON the event loop (the
+        state may not be read from another thread); returns the
+        (table, want) work list for :meth:`_write`."""
+        want_sessions: Dict[str, Any] = {}
+        for cid, sess in self.broker.sessions.items():
+            # durable sessions: resumable (clean_start False or expiry>0)
+            if not sess.clean_start or sess.expiry_interval > 0:
+                want_sessions[cid] = session_to_dict(sess)
+        work = [(self.t_sessions, want_sessions)]
+        if self.node.retainer is not None:
+            ret = self.node.retainer
+            want = {}
+            for t in ret.topics():
+                for m in ret.match(t):
+                    want[m.topic] = msg_to_dict(m)
+            work.append((self.t_retained, want))
+        if self.node.delayed is not None:
+            work.append((self.t_delayed, {
+                f"{seq}": {"fire_at": fire_at, "msg": msg_to_dict(msg)}
+                for fire_at, seq, msg in self.node.delayed.entries()
+            }))
+        work.append((self.t_banned, {
+            f"{e.kind}:{e.who}": ban_to_dict(e)
+            for e in self.node.banned.list()
+        }))
+        return work
+
+    def _write(self, work: List[tuple]) -> None:
+        for table, want in work:
+            self._sync_table(table, want)
+
+    def sync(self) -> None:
+        self.last_sync = time.time()
+        self._write(self._collect())
+
+    async def sync_async(self) -> None:
+        """Housekeeping entry: collect on the loop, write in a thread so
+        disk flushes never stall connections."""
+        self.last_sync = time.time()
+        work = self._collect()
+        await asyncio.to_thread(self._write, work)
+
+    def close(self) -> None:
+        self.sync()
+        self.store.close()

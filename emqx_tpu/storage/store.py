@@ -1,0 +1,162 @@
+"""Durable storage engine — the ``emqx_ds`` / mnesia-disc analog.
+
+Behavioral reference (SURVEY.md §2.1 persistent session, §5.4): the
+reference persists retained messages, sessions, banned and delayed
+tables in mnesia ``disc_copies`` (4.x) or RocksDB via ``emqx_ds``
+(5.4+), with *generations* — immutable snapshot + append log — per
+shard.  This is the same log-structured shape in plain files:
+
+* one directory per table;
+* ``snapshot.jsonl`` — the compacted key/value state (one record per
+  line, crash-tolerant: a torn tail line is dropped on load);
+* ``wal.jsonl`` — puts/deletes appended since the snapshot, replayed
+  over it on open (bootstrap-then-replay, the same discipline as the
+  mria rlog and the device NFA mirror);
+* compaction rewrites the snapshot atomically (tmp + rename) and
+  truncates the wal once it outgrows the snapshot.
+
+Values are JSON-safe dicts; binary fields ride base64 via the codec
+helpers in :mod:`emqx_tpu.storage.codec`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Store", "Table"]
+
+
+class Table:
+    """One persistent key→value table (snapshot + wal)."""
+
+    def __init__(self, path: str, compact_ratio: float = 2.0) -> None:
+        self.path = path
+        self.compact_ratio = compact_ratio
+        os.makedirs(path, exist_ok=True)
+        self._snap_path = os.path.join(path, "snapshot.jsonl")
+        self._wal_path = os.path.join(path, "wal.jsonl")
+        self._data: Dict[str, Any] = {}
+        self._wal_records = 0
+        self._wal = None
+        self._load()
+
+    # -- open / replay -------------------------------------------------
+
+    def _read_lines(self, path: str) -> Iterator[Tuple[str, Any]]:
+        if not os.path.exists(path):
+            return
+        with open(path, "r", encoding="utf-8") as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln:
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    # torn tail write from a crash: drop the remainder
+                    log.warning("%s: dropping torn record", path)
+                    return
+                yield rec.get("op", "put"), rec
+
+    def _load(self) -> None:
+        for _op, rec in self._read_lines(self._snap_path):
+            self._data[rec["k"]] = rec["v"]
+        for op, rec in self._read_lines(self._wal_path):
+            if op == "put":
+                self._data[rec["k"]] = rec["v"]
+            else:
+                self._data.pop(rec["k"], None)
+            self._wal_records += 1
+        self._wal = open(self._wal_path, "a", encoding="utf-8")
+
+    # -- mutation ------------------------------------------------------
+
+    def put(self, key: str, value: Any) -> None:
+        self._data[key] = value
+        self._append({"op": "put", "k": key, "v": value})
+
+    def delete(self, key: str) -> bool:
+        existed = self._data.pop(key, None) is not None
+        if existed:
+            self._append({"op": "del", "k": key})
+        return existed
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        self._wal.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self._wal.flush()
+        self._wal_records += 1
+        if self._wal_records > max(64, self.compact_ratio * len(self._data)):
+            self.compact()
+
+    # -- read ----------------------------------------------------------
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._data.get(key, default)
+
+    def items(self):
+        return self._data.items()
+
+    def keys(self):
+        return list(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._data
+
+    # -- maintenance ---------------------------------------------------
+
+    def compact(self) -> None:
+        """Rewrite the snapshot atomically; reset the wal."""
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for k, v in self._data.items():
+                f.write(json.dumps({"k": k, "v": v},
+                                   separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path)
+        self._wal.close()
+        self._wal = open(self._wal_path, "w", encoding="utf-8")
+        self._wal_records = 0
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self.compact()
+            self._wal.close()
+            self._wal = None
+
+    def clear(self) -> None:
+        self._data.clear()
+        self.compact()
+
+
+class Store:
+    """Directory of named tables under the node's data dir."""
+
+    def __init__(self, data_dir: str) -> None:
+        self.data_dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self._tables: Dict[str, Table] = {}
+
+    def table(self, name: str) -> Table:
+        t = self._tables.get(name)
+        if t is None:
+            t = self._tables[name] = Table(
+                os.path.join(self.data_dir, name)
+            )
+        return t
+
+    def close(self) -> None:
+        for t in self._tables.values():
+            t.close()
+        self._tables.clear()
+
+    def table_names(self):
+        return list(self._tables)
