@@ -269,10 +269,14 @@ class Cluster:
             now = time.monotonic()
             for peer in list(self.peers.values()):
                 if peer.up:
-                    peer.conn.cast(pb.ClusterFrame(
+                    conn = peer.conn
+                    conn.cast(pb.ClusterFrame(
                         ping=pb.Ping(epoch=self.broker.router.epoch)
                     ))
-                    await peer.conn.drain()
+                    # cast() may have closed the conn (write-buffer
+                    # overflow), nulling peer.conn via _conn_closed
+                    if not conn.closed:
+                        await conn.drain()
                 if now - peer.last_seen > self.NODE_TIMEOUT:
                     self._node_down(peer.name, "heartbeat timeout")
 
@@ -645,6 +649,9 @@ class Cluster:
                     "up": p.up, "host": p.host, "port": p.port,
                     "route_seq": p.route_seq,
                     "bootstrapped": p.bootstrapped,
+                    "overflow_closes": (
+                        p.conn.overflow_closes if p.conn is not None else 0
+                    ),
                 }
                 for p in self.peers.values()
             },

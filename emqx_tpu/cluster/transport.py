@@ -24,6 +24,11 @@ __all__ = ["PeerConn", "PeerServer", "pb"]
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 << 20
+# cast() backpressure bound: a stalled peer must not grow the transport
+# write buffer without limit.  Exceeding it closes the conn — the
+# reconnect loop re-bootstraps state, which is strictly safer than
+# silently dropping individual route-sync / forward frames.
+MAX_WRITE_BUFFER = 8 << 20
 
 # handler(conn, frame) -> Optional[reply frame]
 Handler = Callable[["PeerConn", pb.ClusterFrame], Awaitable[Optional[pb.ClusterFrame]]]
@@ -50,6 +55,7 @@ class PeerConn:
         self._waiting: Dict[int, asyncio.Future] = {}
         self._closed = False
         self._task: Optional[asyncio.Task] = None
+        self.overflow_closes = 0  # times cast() hit MAX_WRITE_BUFFER
 
     def start(self) -> None:
         self._task = asyncio.ensure_future(self._recv_loop())
@@ -57,10 +63,24 @@ class PeerConn:
     # ------------------------------------------------------------------
 
     def cast(self, frame: pb.ClusterFrame) -> None:
-        """Fire-and-forget send."""
+        """Fire-and-forget send, bounded: if the peer stalls past
+        MAX_WRITE_BUFFER of queued bytes the conn is closed (and the
+        owner's reconnect loop re-bootstraps), never buffered unbounded."""
         if self._closed:
             return
         try:
+            transport = self._w.transport
+            if (
+                transport is not None
+                and transport.get_write_buffer_size() > MAX_WRITE_BUFFER
+            ):
+                self.overflow_closes += 1
+                log.warning(
+                    "peer %s write buffer over %d bytes; closing",
+                    self.node, MAX_WRITE_BUFFER,
+                )
+                self.close()
+                return
             data = frame.SerializeToString()
             self._w.write(_LEN.pack(len(data)) + data)
         except Exception:
@@ -178,7 +198,15 @@ class PeerServer:
         conn = PeerConn(reader, writer, self._handler, self._on_closed)
         self.conns.append(conn)
         conn.start()
-        await conn._task  # keep the accept handler alive for wait_closed
+        try:
+            await conn._task  # keep the accept handler alive for wait_closed
+        finally:
+            # reconnect churn must not leak closed conns for the life of
+            # the server
+            try:
+                self.conns.remove(conn)
+            except ValueError:
+                pass
 
     async def stop(self) -> None:
         for conn in list(self.conns):

@@ -432,6 +432,14 @@ class BrokerNode:
             await self.mgmt_server.stop()
             self.mgmt_server = None
             self.mgmt = None
+        # housekeeping must be gone BEFORE persistence.close(): a
+        # sync_async still running _write in a worker thread would race
+        # close()'s final sync/compact on the same WAL handle
+        for job in self._jobs:
+            job.cancel()
+        if self._jobs:
+            await asyncio.gather(*self._jobs, return_exceptions=True)
+        self._jobs.clear()
         if self.persistence is not None:
             self.persistence.close()
         # kick live connections BEFORE awaiting listener close: 3.12's
@@ -443,9 +451,6 @@ class BrokerNode:
         # give connections a beat to flush their goodbyes
         await asyncio.sleep(0)
         await self.listeners.stop_all()
-        for job in self._jobs:
-            job.cancel()
-        self._jobs.clear()
 
     async def _housekeeping(self) -> None:
         """Periodic jobs: delayed-publish firing, retained expiry, session

@@ -94,7 +94,11 @@ def import_data(node: Any, archive: bytes) -> Dict[str, int]:
         )
     for d in docs.get("sessions", []):
         if d.get("clientid") not in node.broker.sessions:
-            session_restore(node.broker, d)
+            sess = session_restore(node.broker, d)
+            # imported offline durable sessions must enter the expiry
+            # sweep (same as Persistence.restore) or they live forever
+            if sess is not None:
+                node._disconnected_at.setdefault(sess.clientid, time.time())
             counts["sessions"] += 1
     if node.retainer is not None:
         for md in docs.get("retained", []):

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from typing import Any, Dict, List
 
@@ -41,6 +42,12 @@ class Persistence:
         self.t_delayed = self.store.table("delayed")
         self.t_banned = self.store.table("banned")
         self.last_sync = 0.0
+        # serializes threaded sync_async writes against close(): a
+        # cancelled housekeeping task does NOT stop its to_thread worker,
+        # so close() must wait for any in-flight _write before the final
+        # sync/compact touches the same WAL handle
+        self._write_lock = threading.RLock()
+        self._closed = False
 
     # ------------------------------------------------------------------
     # restore (at node construction)
@@ -137,8 +144,11 @@ class Persistence:
         return work
 
     def _write(self, work: List[tuple]) -> None:
-        for table, want in work:
-            self._sync_table(table, want)
+        with self._write_lock:
+            if self._closed:
+                return
+            for table, want in work:
+                self._sync_table(table, want)
 
     def sync(self) -> None:
         self.last_sync = time.time()
@@ -152,5 +162,7 @@ class Persistence:
         await asyncio.to_thread(self._write, work)
 
     def close(self) -> None:
-        self.sync()
-        self.store.close()
+        with self._write_lock:
+            self.sync()
+            self._closed = True
+            self.store.close()
