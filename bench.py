@@ -143,7 +143,7 @@ def bench_tpu(filters, topics, batch: int, iters: int, depth: int = 8):
     # overflow audit over EVERY distinct batch (outside the timed loops —
     # overflow means truncated matches, which would invalidate the number)
     overflow = sum(
-        int(nfa_match(*b, *arrs).active_overflow) for b in dev_batches
+        int(np.sum(nfa_match(*b, *arrs).active_overflow)) for b in dev_batches
     )
 
     # --- sync latency distribution (post-queue; includes relay RTT) -----

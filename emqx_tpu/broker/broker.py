@@ -81,6 +81,10 @@ class Broker:
         # set by emqx_tpu.cluster when this node joins a cluster
         self.on_forward = None         # (node, flt, msg) -> None
         self.on_forward_shared = None  # (node, group, flt, msg) -> None
+        # device match seam: set by the node's MatchService — returns a
+        # precomputed routes list for a topic when a fresh (same-epoch)
+        # device answer exists, None otherwise (host trie then serves)
+        self.device_match = None       # (topic) -> Optional[List[Route]]
 
     # ------------------------------------------------------------------
     # session lifecycle (emqx_cm:open_session semantics, simplified here;
@@ -194,7 +198,14 @@ class Broker:
         if msg is None or msg.headers.get("allow_publish") is False:
             res.no_subscribers = True
             return res
-        routes = self.router.match_routes(msg.topic)
+        # the TPU hot path (SURVEY.md §3.4): a fresh micro-batched device
+        # answer replaces the per-publish host trie walk; stale/absent
+        # hints fall back so correctness never depends on the device
+        routes = None
+        if self.device_match is not None:
+            routes = self.device_match(msg.topic)
+        if routes is None:
+            routes = self.router.match_routes(msg.topic)
         if not routes:
             res.no_subscribers = True
             self.hooks.run("message.dropped", (msg, "no_subscribers"))

@@ -54,6 +54,9 @@ class Router:
         self._dest_filters: Dict[Hashable, Set[str]] = {}  # reverse index
         self.epoch: int = 0
         self._deltas: Deque[RouteDelta] = deque(maxlen=delta_log_cap)
+        # mutation listeners (device-mirror wake-ups); called synchronously
+        # after every epoch bump with the new epoch
+        self.listeners: List = []
 
     # ------------------------------------------------------------------
     # mutation (emqx_router:do_add_route / do_delete_route)
@@ -104,6 +107,8 @@ class Router:
     def _bump(self, op: str, flt: str, dest: Hashable) -> None:
         self.epoch += 1
         self._deltas.append(RouteDelta(self.epoch, op, flt, dest))
+        for fn in self.listeners:
+            fn(self.epoch)
 
     # ------------------------------------------------------------------
     # lookup (emqx_router:match_routes — THE hot path)
@@ -118,6 +123,21 @@ class Router:
             out.extend(Route(name, d) for d in dests)
         for flt in self._trie.match(name):
             for d in self._wild[flt]:
+                out.append(Route(flt, d))
+        return out
+
+    def routes_with_wild(
+        self, name: str, wild_filters: Iterable[str]
+    ) -> List[Route]:
+        """Assemble routes from the exact map plus an externally-computed
+        wildcard filter list (the device matcher's answer) — the consume
+        side of the TPU publish hint (SURVEY.md §3.4 hot path)."""
+        out: List[Route] = []
+        dests = self._exact.get(name)
+        if dests:
+            out.extend(Route(name, d) for d in dests)
+        for flt in wild_filters:
+            for d in self._wild.get(flt, ()):
                 out.append(Route(flt, d))
         return out
 

@@ -36,7 +36,7 @@ import grpc
 import grpc.aio
 
 from ..mqtt import packet as P
-from .rpc import HookProviderStub, pb
+from .rpc import HookProviderStub, MirrorSyncStub, pb
 
 log = logging.getLogger(__name__)
 
@@ -168,11 +168,56 @@ class ExHookManager:
             if st.sender is None:
                 st.sender = asyncio.ensure_future(self._sender_loop(st))
             log.info("exhook server %s loaded hooks=%s", st.spec.name, st.hooks)
+            await self._push_mirror_snapshot(st)
         except Exception as e:
             log.warning("exhook server %s load failed: %s", st.spec.name, e)
             st.hooks = []
             if channel is not None:
                 await channel.close()
+
+    async def _push_mirror_snapshot(self, st: _ServerState) -> None:
+        """Reconcile a subscription-mirroring server (our TPU sidecar)
+        with the broker's CURRENT filter set at (re)connect: hook events
+        only stream changes, so without this a restarted sidecar keeps
+        checkpoint ghosts and misses pre-existing subscriptions.  Stock
+        HookProvider servers don't implement MirrorSync — UNIMPLEMENTED
+        is expected and ignored."""
+        if "session.subscribed" not in st.hooks:
+            return
+        ref: Dict[str, int] = {}
+        for sess in self.broker.sessions.values():
+            for flt in sess.subscriptions:
+                f = self._strip_share(flt)
+                ref[f] = ref.get(f, 0) + 1
+        try:
+            mirror = MirrorSyncStub(st.channel)
+            items = sorted(ref.items())
+            epoch = self.broker.router.epoch
+
+            async def chunks():
+                if not items:
+                    yield pb.SnapshotChunk(epoch=epoch, last=True)
+                for i in range(0, len(items), 1024):
+                    part = items[i:i + 1024]
+                    yield pb.SnapshotChunk(
+                        epoch=epoch,
+                        filters=[f for f, _ in part],
+                        refcounts=[c for _, c in part],
+                        last=i + 1024 >= len(items),
+                    )
+
+            ack = await asyncio.wait_for(
+                mirror.InstallSnapshot(chunks()), timeout=st.spec.timeout * 4
+            )
+            log.info(
+                "exhook server %s mirror snapshot: %d filters acked",
+                st.spec.name, ack.n_filters,
+            )
+        except Exception as e:
+            log.debug(
+                "exhook server %s has no MirrorSync (%s) — hook-only feed",
+                st.spec.name, e,
+            )
 
     def _meta(self) -> pb.RequestMeta:
         return pb.RequestMeta(

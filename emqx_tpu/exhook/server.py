@@ -6,17 +6,21 @@ stock EMQX or this one) points its exhook at this server; the sidecar
 * negotiates the hook set at ``OnProviderLoaded`` — the session
   subscribe/unsubscribe events are exactly the delta feed the device
   NFA mirror needs (SURVEY.md §3.3 note);
-* maintains a refcounted filter table mirror, recompiled into the
-  flattened-NFA device table in the background with debounce (the mria
-  bootstrap-then-replay-rlog pattern, SURVEY.md §5.4 — bulk install via
-  ``MirrorSync.InstallSnapshot``, steady-state deltas via the hook feed
-  or ``MirrorSync.ApplyDeltas``);
+* maintains the mirror **incrementally**: every filter add/remove is an
+  O(filter) mutation of the live :class:`IncrementalNfa` (the
+  ``emqx_trie:insert/delete`` analog [U]), drained to the device as
+  bounded scatter deltas by a debounced sync loop — NO full recompiles
+  on the steady-state path (VERDICT.md round-1 item 1);
 * serves ``OnMessagePublish`` through a deadline micro-batching loop
   (SURVEY.md §7.5) so concurrent publishes ride one device kernel call;
 * serves ``MirrorSync.MatchBatch`` for bulk match queries (the bench /
   broker-integration fast path — one RPC, one kernel call);
-* fails open: with no compiled table (cold start, rebuild in flight) it
-  falls back to the host trie match so answers stay correct.
+* **fails open per row**: rows whose device answer spilled (active-set
+  or match-count overflow) are re-run on the authoritative host trie,
+  so answers are exact even when the kernel truncates (SURVEY.md §5.3;
+  VERDICT.md weak item 1) — counted in ``Stats``;
+* filters deeper than the device table ride host-side under *alias*
+  ids in the same accept-id space, merged into device rows.
 
 Run standalone: ``python -m emqx_tpu.exhook.server --port 9000``.
 """
@@ -43,74 +47,70 @@ log = logging.getLogger(__name__)
 __all__ = ["TpuMatchSidecar", "serve"]
 
 
-class _Engine:
-    """One compiled epoch: device table + jitted matcher, immutable.
-
-    ``deep`` filters (more levels than the device table depth) can't ride
-    the NFA; they are matched host-side per batch and merged in, so the
-    combined answer stays exactly the oracle's.  Their ids follow the
-    device filters: ``filter_table = filters + deep``.
-    """
-
-    def __init__(
-        self, filters: List[str], deep: List[str], depth: int, version: int,
-        table=None,
-    ) -> None:
-        import jax
-        import jax.numpy as jnp
-
-        from ..ops import build_matcher, compile_filters
-
-        self.filters = filters  # id -> filter string (table_version scope)
-        self.deep = deep
-        self.version = version
-        # a checkpointed table skips the compile (SURVEY.md §5.4)
-        self.table = table if table is not None \
-            else compile_filters(filters, depth=depth)
-        self.args = [jnp.asarray(a) for a in self.table.device_arrays()]
-        self._fn = jax.jit(build_matcher())
-        self._jnp = jnp
-        # accept-id -> our filter id (compile_filters dedups+sorts)
-        fid = {f: i for i, f in enumerate(filters)}
-        self._accept_to_id = np.asarray(
-            [fid[f] for f in self.table.accept_filters], np.int32
-        )
-        self._deep_trie = FilterTrie()
-        self._deep_id = {}
-        for i, f in enumerate(deep):
-            self._deep_trie.insert(f)
-            self._deep_id[f] = len(filters) + i
-
-    def filter_table(self) -> List[str]:
-        return self.filters + self.deep
-
-    def match(self, topics: List[str], batch: int) -> List[List[int]]:
-        from ..ops import encode_topics
-
-        words, lens, is_sys = encode_topics(self.table, topics, batch=batch)
-        jnp = self._jnp
-        res = self._fn(
-            jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
-            *self.args,
-        )
-        matches = np.asarray(res.matches)
-        counts = np.asarray(res.n_matches)
-        out: List[List[int]] = []
-        for r, topic in enumerate(topics):
-            row = [int(self._accept_to_id[a]) for a in matches[r, : counts[r]]]
-            if self.deep:
-                row.extend(
-                    self._deep_id[f] for f in self._deep_trie.match(topic)
-                )
-            out.append(row)
-        return out
-
-
 def _bucket_batch(n: int, minimum: int = 64) -> int:
     b = minimum
     while b < n:
         b *= 2
     return b
+
+
+class _IncEngine:
+    """The serving engine: host-authoritative incremental NFA + device
+    mirror + deep-filter (alias) host path.
+
+    Threading: all mutations and encodes happen on the event loop; the
+    device mirror's apply/match dispatch may run on worker threads
+    (DeviceNfa serializes device ops internally)."""
+
+    def __init__(
+        self, depth: int, active_slots: int = 16, max_matches: int = 32
+    ) -> None:
+        from ..ops import IncrementalNfa
+        from ..ops.device_table import DeviceNfa
+
+        self.depth = depth
+        self.inc = IncrementalNfa(depth=depth)
+        self.dev = DeviceNfa(
+            self.inc, active_slots=active_slots, max_matches=max_matches,
+            lazy=True,
+        )
+        self.deep_aid: Dict[str, int] = {}   # deep filter -> alias aid
+        self.deep_trie = FilterTrie()
+
+    # -- mutation (event loop) --------------------------------------------
+
+    def add(self, flt: str) -> None:
+        try:
+            self.inc.add(flt)
+        except ValueError:
+            if flt not in self.deep_aid:
+                self.deep_aid[flt] = self.inc.alloc_alias(flt)
+                self.deep_trie.insert(flt)
+
+    def remove(self, flt: str) -> None:
+        aid = self.deep_aid.pop(flt, None)
+        if aid is not None:
+            self.inc.free_alias(aid)
+            self.deep_trie.delete(flt)
+        else:
+            self.inc.remove(flt)
+
+    def live_filters(self) -> List[str]:
+        return self.inc.filters() + sorted(self.deep_aid)
+
+    def aid_of(self, flt: str) -> int:
+        aid = self.deep_aid.get(flt)
+        return aid if aid is not None else self.inc.aid_of(flt)
+
+    def encode(self, topics: List[str], batch: int):
+        from ..ops import encode_batch
+
+        return encode_batch(self.inc, topics, batch=batch)
+
+    def deep_matches(self, topic: str) -> List[int]:
+        if not self.deep_aid:
+            return []
+        return [self.deep_aid[f] for f in self.deep_trie.match(topic)]
 
 
 class TpuMatchSidecar:
@@ -125,6 +125,8 @@ class TpuMatchSidecar:
         annotate: bool = False,
         node: str = "tpu-sidecar",
         checkpoint_path: str = "",
+        active_slots: int = 16,
+        max_matches: int = 32,
     ) -> None:
         self.depth = depth
         self.batch_window_s = batch_window_ms / 1000.0
@@ -134,11 +136,12 @@ class TpuMatchSidecar:
         self.node = node
         self.checkpoint_path = checkpoint_path
 
-        self._ref: Dict[str, int] = {}       # filter -> refcount
-        self._trie = FilterTrie()             # host fallback (fail-open)
+        self._ref: Dict[str, int] = {}        # filter -> refcount
         self._epoch = 0
-        self._table_version = 0
-        self._engine: Optional[_Engine] = None
+        self._eng = _IncEngine(
+            depth, active_slots=active_slots, max_matches=max_matches
+        )
+        self._eng_ready = False               # device mirror serveable
         self._dirty = asyncio.Event()
         self._pending: List[Tuple[str, asyncio.Future]] = []
         self._batch_wake = asyncio.Event()
@@ -147,7 +150,19 @@ class TpuMatchSidecar:
         # stats
         self.batches = 0
         self.topics_matched = 0
+        self.spill_fallbacks = 0   # rows re-run on the host trie
+        self.syncs = 0
         self._lat_ms: List[float] = []   # rolling batch latency samples
+
+    # engine visible only once the device mirror can serve (tests and the
+    # bench gate on `sidecar._engine is not None`)
+    @property
+    def _engine(self) -> Optional[_IncEngine]:
+        return self._eng if self._eng_ready else None
+
+    @property
+    def _table_version(self) -> int:
+        return self._eng.inc.epoch
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -158,34 +173,34 @@ class TpuMatchSidecar:
         if self.checkpoint_path:
             self._restore_checkpoint()
         self._tasks = [
-            asyncio.ensure_future(self._rebuild_loop()),
+            asyncio.ensure_future(self._sync_loop()),
             asyncio.ensure_future(self._batch_loop()),
         ]
 
     def _restore_checkpoint(self) -> None:
-        """Serve the checkpointed table immediately; the subscription feed
-        (hooks / InstallSnapshot) reconciles the mirror afterwards."""
+        """Re-adopt the checkpointed filter set so the mirror serves
+        immediately; the live feed (hooks / InstallSnapshot) reconciles
+        afterwards (InstallSnapshot diffs against engine contents, which
+        drops filters whose subscribers vanished while we were down)."""
         try:
             from ..storage.checkpoint import load_table
 
             table = load_table(self.checkpoint_path)
             if table is None:
                 return
-            filters = sorted(table.accept_filters)
-            self._table_version += 1
-            engine = _Engine(
-                filters, [], self.depth, self._table_version, table=table
-            )
-            engine.match(["warm/up"], batch=64)
-            self._engine = engine
-            # deliberately do NOT seed _ref/_trie from the checkpoint:
-            # the live feed (hooks / InstallSnapshot) is authoritative,
-            # and ghost refcounts would pin filters whose subscribers
-            # vanished while we were down.  The checkpointed engine
-            # serves (possibly stale) answers until the first rebuild.
+            t0 = time.perf_counter()
+            for flt in table.accept_filters:
+                if flt is not None:
+                    self._eng.add(flt)
+            self._eng.dev.sync(full=True)
+            self._warm(self._eng)
+            self._eng_ready = True
             log.info(
-                "checkpoint restored: %d filters, %d states (stale until "
-                "first sync)", len(filters), table.n_states,
+                "checkpoint restored: %d filters, %d states, %.1f ms "
+                "(stale until first sync)",
+                self._eng.inc.n_filters + len(self._eng.deep_aid),
+                self._eng.inc.n_states,
+                (time.perf_counter() - t0) * 1e3,
             )
         except Exception:
             log.exception("checkpoint restore failed; cold start")
@@ -197,14 +212,14 @@ class TpuMatchSidecar:
         self._tasks = []
 
     # ------------------------------------------------------------------
-    # mirror mutation
+    # mirror mutation (event loop only)
     # ------------------------------------------------------------------
 
     def _add_filter(self, flt: str) -> None:
         n = self._ref.get(flt, 0)
         self._ref[flt] = n + 1
         if n == 0:
-            self._trie.insert(flt)
+            self._eng.add(flt)
             self._epoch += 1
             self._dirty.set()
 
@@ -213,71 +228,139 @@ class TpuMatchSidecar:
         if n <= 1:
             if n == 1:
                 del self._ref[flt]
-                self._trie.delete(flt)
+                self._eng.remove(flt)
                 self._epoch += 1
                 self._dirty.set()
         else:
             self._ref[flt] = n - 1
 
-    async def _rebuild_loop(self) -> None:
+    async def _sync_loop(self) -> None:
+        """Debounced host→device delta shipping (the mria rlog-replay
+        analog).  Steady state is O(delta): scatter a few rows, no XLA
+        recompile, no table rebuild."""
         while True:
             await self._dirty.wait()
             await asyncio.sleep(self.rebuild_debounce_s)  # debounce bursts
             self._dirty.clear()
-            from .. import topic as T
-
-            filters, deep = [], []
-            for f in sorted(self._ref):
-                (filters if len(T.words(f)) <= self.depth else deep).append(f)
-            version = self._table_version + 1
+            eng = self._eng
             t0 = time.perf_counter()
             try:
-                if filters:
-                    # build + jit-warm off the event loop: XLA compilation
-                    # takes hundreds of ms and would stall every hook RPC
-                    # (deny-policy brokers would veto traffic per rebuild)
-                    def build():
-                        engine = _Engine(filters, deep, self.depth, version)
-                        engine.match(["warm/up"], batch=64)  # warm the jit
-                        return engine
-
-                    engine = await asyncio.to_thread(build)
-                else:
-                    engine = None
-                self._engine = engine
-                self._table_version = version
+                first = not self._eng_ready
+                pending = eng.dev.drain(full=first)  # loop-side: O(delta)
+                # device work off the loop: a growth re-upload or a jit
+                # warm takes long enough to stall hook RPCs otherwise
+                await asyncio.to_thread(eng.dev.apply_pending, pending)
+                self._eng_ready = True
+                if first or pending.full is not None:
+                    # warm the match jit AFTER going ready — the first
+                    # real match would pay the compile anyway; readiness
+                    # must not wait on it
+                    await asyncio.to_thread(self._warm, eng)
+                self.syncs += 1
+                dt = (time.perf_counter() - t0) * 1e3
                 log.info(
-                    "mirror rebuilt: %d filters (+%d host-side deep), "
-                    "version %d, %.1f ms",
-                    len(filters), len(deep), version,
-                    (time.perf_counter() - t0) * 1e3,
+                    "mirror sync: epoch %d (%s), %.1f ms",
+                    pending.epoch,
+                    "full upload" if pending.full is not None else
+                    f"{len(pending.delta.state_idx)}+"
+                    f"{len(pending.delta.bucket_idx)} rows",
+                    dt,
                 )
                 if self.checkpoint_path:
-                    try:
-                        from ..storage.checkpoint import save_table
-
-                        if engine is not None:
-                            save_table(engine.table, self.checkpoint_path)
-                        elif os.path.exists(self.checkpoint_path):
-                            # an emptied mirror must not resurrect the
-                            # old table on the next restart
-                            os.remove(self.checkpoint_path)
-                    except Exception:
-                        log.exception("checkpoint save failed")
+                    await asyncio.to_thread(self._save_checkpoint)
             except Exception:
-                log.exception("mirror rebuild failed; host fallback serves")
+                # the drained delta is lost and the device mirror may be
+                # poisoned (DeviceNfa dropped its arrays): re-mark dirty
+                # so the next pass re-uploads in full, after a breather
+                log.exception(
+                    "mirror sync failed; host fallback serves, full "
+                    "re-upload scheduled"
+                )
+                await asyncio.sleep(1.0)
+                self._dirty.set()
+
+    def _warm(self, eng: _IncEngine) -> None:
+        """Warm the match jit for the smallest batch bucket (larger
+        buckets compile on first use).  Uses pre-encoded inert rows so no
+        live host state is read off-loop."""
+        words, lens, is_sys = eng.encode([], 64)  # inert padding rows
+        eng.dev.match(words, lens, is_sys)
+
+    def _save_checkpoint(self) -> None:
+        try:
+            from ..storage.checkpoint import save_table
+
+            if self._eng.inc.n_filters or self._eng.deep_aid:
+                save_table(self._eng.inc.snapshot(), self.checkpoint_path)
+            elif os.path.exists(self.checkpoint_path):
+                # an emptied mirror must not resurrect the old table on
+                # the next restart
+                os.remove(self.checkpoint_path)
+        except Exception:
+            log.exception("checkpoint save failed")
 
     # ------------------------------------------------------------------
     # match paths
     # ------------------------------------------------------------------
 
-    def _host_match(self, topic: str) -> List[str]:
-        return self._trie.match(topic)
+    def _host_row(self, topic: str) -> List[int]:
+        """Authoritative host answer as accept/alias ids — walks the
+        live incremental trie directly (the single source of truth, so
+        fail-open answers are exact even mid-restore)."""
+        eng = self._eng
+        row = eng.inc.match_host(topic)
+        row.extend(eng.deep_matches(topic))
+        return row
+
+    def _device_rows(self, eng: _IncEngine, enc, n: int):
+        """WORKER THREAD: kernel dispatch + readback.  Returns (rows,
+        spilled_row_indexes).  ONE bundled device→host fetch: on a
+        remote-attached device every separate fetch pays a relay RTT."""
+        import jax
+
+        res = eng.dev.match(*enc)
+        matches, counts, sp = jax.device_get(
+            (res.matches, res.n_matches, res.spilled_rows())
+        )
+        rows = [matches[r, : counts[r]].tolist() for r in range(n)]
+        return rows, np.flatnonzero(sp[:n]).tolist()
+
+    async def _match_rows(self, topics: List[str]) -> List[List[int]]:
+        """Match a batch to accept-id rows: device kernel + per-row
+        fail-open + deep merge.  Encode and all host-trie reads stay on
+        the loop; only device dispatch/readback runs in a thread."""
+        eng = self._eng
+        if not self._eng_ready or not topics:
+            return [self._host_row(t) for t in topics]
+        B = _bucket_batch(min(len(topics), self.max_batch))
+        enc = eng.encode(topics, B)
+        try:
+            rows, spilled = await asyncio.to_thread(
+                self._device_rows, eng, enc, len(topics)
+            )
+        except Exception:
+            log.exception("device match failed; host fallback")
+            return [self._host_row(t) for t in topics]
+        if spilled:
+            self.spill_fallbacks += len(spilled)
+            for r in spilled:
+                rows[r] = self._host_row(topics[r])
+        if eng.deep_aid:
+            spset = set(spilled)
+            for r, t in enumerate(topics):
+                if r not in spset:
+                    rows[r].extend(eng.deep_matches(t))
+        return rows
+
+    def _ids_to_filters(self, rows: List[List[int]]) -> List[List[str]]:
+        table = self._eng.inc.accept_filters
+        return [[table[a] for a in row if table[a] is not None]
+                for row in rows]
 
     async def _queue_match(self, topic: str) -> List[str]:
         """Micro-batched single-topic match; returns filter strings."""
-        if self._engine is None:
-            return self._host_match(topic)
+        if not self._eng_ready:
+            return self._ids_to_filters([self._host_row(topic)])[0]
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((topic, fut))
         self._batch_wake.set()
@@ -295,21 +378,15 @@ class TpuMatchSidecar:
                 self._pending[self.max_batch:]
             if self._pending:
                 self._batch_wake.set()
-            engine = self._engine
             topics = [t for t, _ in pending]
             t0 = time.perf_counter()
             try:
-                if engine is None:
-                    results = [self._host_match(t) for t in topics]
-                else:
-                    table = engine.filter_table()
-                    ids = engine.match(topics, _bucket_batch(len(topics)))
-                    results = [
-                        [table[i] for i in row] for row in ids
-                    ]
+                results = self._ids_to_filters(await self._match_rows(topics))
             except Exception:
                 log.exception("batch match failed; host fallback")
-                results = [self._host_match(t) for t in topics]
+                results = self._ids_to_filters(
+                    [self._host_row(t) for t in topics]
+                )
             dt_ms = (time.perf_counter() - t0) * 1e3
             self.batches += 1
             self.topics_matched += len(topics)
@@ -363,6 +440,9 @@ class TpuMatchSidecar:
     # ------------------------------------------------------------------
 
     async def InstallSnapshot(self, request_iterator, context):
+        """Bulk bootstrap: reconcile the mirror to exactly the streamed
+        filter set (diff-apply through the same incremental machinery —
+        also drops stale checkpoint-restored filters)."""
         ref: Dict[str, int] = {}
         epoch = 0
         async for chunk in request_iterator:
@@ -370,11 +450,12 @@ class TpuMatchSidecar:
             counts = list(chunk.refcounts)
             for i, flt in enumerate(chunk.filters):
                 ref[flt] = counts[i] if i < len(counts) else 1
+        current = set(self._eng.live_filters())
+        for flt in current - set(ref):
+            self._eng.remove(flt)
+        for flt in set(ref) - current:
+            self._eng.add(flt)
         self._ref = ref
-        trie = FilterTrie()
-        for flt in ref:
-            trie.insert(flt)
-        self._trie = trie
         self._epoch = epoch
         self._dirty.set()
         return pb.SnapshotAck(
@@ -394,23 +475,13 @@ class TpuMatchSidecar:
 
     async def MatchBatch(self, request, context):
         topics = list(request.topics)
-        engine = self._engine
+        t0 = time.perf_counter()
         resp = pb.MatchBatchResponse(
             epoch=self._epoch, table_version=self._table_version
         )
-        t0 = time.perf_counter()
-        if engine is None:
-            # host fallback: ids are indexes into a sorted filter list
-            filters = sorted(self._ref)
-            index = {f: i for i, f in enumerate(filters)}
-            for t in topics:
-                resp.results.add(
-                    filter_ids=[index[f] for f in self._host_match(t)
-                                if f in index]
-                )
-        else:
-            for row in engine.match(topics, _bucket_batch(len(topics) or 1)):
-                resp.results.add(filter_ids=row)
+        rows = await self._match_rows(topics)
+        for row in rows:
+            resp.results.add(filter_ids=row)
         dt_ms = (time.perf_counter() - t0) * 1e3
         self.batches += 1
         self.topics_matched += len(topics)
@@ -425,25 +496,30 @@ class TpuMatchSidecar:
 
     async def Stats(self, request, context):
         lat = sorted(self._lat_ms) or [0.0]
-        engine = self._engine
+        eng = self._eng
         return pb.StatsResponse(
             epoch=self._epoch,
             n_filters=len(self._ref),
-            n_states=engine.table.n_states if engine is not None else 0,
+            n_states=eng.inc.n_states if self._eng_ready else 0,
             batches=self.batches,
             topics_matched=self.topics_matched,
             p50_batch_ms=lat[len(lat) // 2],
             p99_batch_ms=lat[min(len(lat) - 1, int(len(lat) * 0.99))],
             pending_deltas=int(self._dirty.is_set()),
-            extra={"table_version": str(self._table_version)},
+            extra={
+                "table_version": str(self._table_version),
+                "spill_fallbacks": str(self.spill_fallbacks),
+                "device_uploads": str(eng.dev.uploads),
+                "device_delta_applies": str(eng.dev.delta_applies),
+                "syncs": str(self.syncs),
+            },
         )
 
     # ------------------------------------------------------------------
 
     def filter_table(self) -> List[str]:
-        """id -> filter for the current table_version (MatchBatch ids)."""
-        engine = self._engine
-        return engine.filter_table() if engine is not None else sorted(self._ref)
+        """id -> filter for MatchBatch results; freed ids resolve to ""."""
+        return [f or "" for f in self._eng.inc.accept_filters]
 
 
 async def serve(

@@ -1,0 +1,12 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference leans on BEAM NIFs for its hot host-side loops (jiffy,
+bcrypt, quicer — SURVEY.md §2.4); our equivalents live here, compiled
+lazily with the in-image g++ on first use and cached next to the
+source.  Every native entry point has a pure-Python fallback so the
+package works (slower) without a toolchain.
+"""
+
+from .build import load_library
+
+__all__ = ["load_library"]

@@ -1,0 +1,77 @@
+"""Lazy g++ build + ctypes load for the native components.
+
+The .so is cached beside the source keyed by a hash of the source text,
+so editing the .cpp triggers a rebuild and stale caches are never
+loaded.  Build failures degrade to the Python fallbacks (callers treat
+``load_library() is None`` as "no native path").
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_cache: dict = {}
+
+
+def load_library(name: str = "encoder") -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and load ``<name>.cpp`` from this directory."""
+    if name in _cache:
+        return _cache[name]
+    src = os.path.join(_DIR, f"{name}.cpp")
+    try:
+        with open(src, "rb") as f:
+            text = f.read()
+    except OSError:
+        _cache[name] = None
+        return None
+    tag = hashlib.sha256(text).hexdigest()[:16]
+    sopath = os.path.join(_DIR, f"_{name}-{tag}.so")
+    if not os.path.exists(sopath):
+        # drop caches of older source revisions before building the new one
+        for stale in os.listdir(_DIR):
+            if stale.startswith(f"_{name}-") and stale.endswith(".so"):
+                try:
+                    os.unlink(os.path.join(_DIR, stale))
+                except OSError:
+                    pass
+        lib = _compile(src, sopath)
+    else:
+        lib = None
+    if lib is None:
+        try:
+            lib = ctypes.CDLL(sopath)
+        except OSError as e:
+            log.warning("native %s unavailable: %s", name, e)
+            lib = None
+    _cache[name] = lib
+    return lib
+
+
+def _compile(src: str, sopath: str) -> None:
+    """g++ → temp file → atomic rename (concurrent imports race safely)."""
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+    os.close(fd)
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++20", src, "-o", tmp],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, sopath)
+    except (subprocess.SubprocessError, OSError) as e:
+        err = getattr(e, "stderr", b"") or b""
+        log.warning("native build of %s failed: %s %s", src, e,
+                    err.decode(errors="replace")[:500])
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return None

@@ -119,6 +119,7 @@ class BrokerNode:
 
         self.exhook = None  # built lazily in start() (needs a loop + grpc)
         self.cluster = None  # built lazily in start() (needs a loop)
+        self.match_service = None  # in-process TPU matcher (start())
         self.mgmt = None
         self.mgmt_server = None
         self.limiter = LimiterGroup(
@@ -289,7 +290,11 @@ class BrokerNode:
             return acts
 
         channel.handle_in = handle_in_and_register
-        if self.exhook is not None or self.cluster is not None:
+        if (
+            self.exhook is not None
+            or self.cluster is not None
+            or self.match_service is not None
+        ):
             conn.intercept = self._intercept
         self._all_conns.add(conn)
         try:
@@ -328,7 +333,9 @@ class BrokerNode:
     async def _intercept(self, channel, pkt):
         """Composite async pre-handle_in stage: cluster session migration
         first (a takeover must land before CONNECT resumes the session),
-        then the exhook advisory round trips."""
+        then the TPU match prefetch (micro-batches concurrent publishes
+        into one kernel call; Broker.publish consumes the hint), then the
+        exhook advisory round trips."""
         from .mqtt import packet as P
 
         if (
@@ -340,17 +347,47 @@ class BrokerNode:
                 await self.cluster.prepare_connect(pkt)
             except Exception:
                 log.exception("cluster takeover stage failed")
+        if self.match_service is not None and pkt.type == P.PUBLISH:
+            try:
+                await self.match_service.prefetch(pkt.topic)
+            except Exception:
+                log.exception("match prefetch failed (host path serves)")
         if self.exhook is not None:
             return await self.exhook.intercept(channel, pkt)
         return None
 
     async def start(self) -> None:
+        await self._start_match_service()
         await self._start_cluster()
         await self._start_exhook()
         await self._start_mgmt()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(asyncio.ensure_future(self._housekeeping()))
+
+    async def _start_match_service(self) -> None:
+        if not self.config.get("tpu.enable"):
+            return
+        from .broker.match_service import MatchService
+
+        cfg = self.config
+        try:
+            self.match_service = MatchService(
+                self.broker,
+                metrics=self.observed.metrics,
+                depth=min(cfg.get("tpu.max_levels"), 16),
+                batch_window_s=cfg.get("tpu.batch_deadline"),
+                max_batch=cfg.get("tpu.batch_size"),
+                debounce_s=cfg.get("tpu.mirror_refresh_interval"),
+                active_slots=cfg.get("tpu.active_slots"),
+                max_matches=cfg.get("tpu.max_matches"),
+            )
+            await self.match_service.start()
+            self.broker.device_match = self.match_service.hint_routes
+            self.rule_engine.attach_match_service(self.match_service)
+        except Exception:
+            log.exception("TPU match service unavailable; host trie serves")
+            self.match_service = None
 
     async def _start_mgmt(self) -> None:
         if not self.config.get("dashboard.enable"):
@@ -422,6 +459,10 @@ class BrokerNode:
 
     async def stop(self) -> None:
         self._running = False
+        if self.match_service is not None:
+            await self.match_service.stop()
+            self.broker.device_match = None
+            self.match_service = None
         if self.exhook is not None:
             await self.exhook.stop()
             self.exhook = None

@@ -1,6 +1,9 @@
 """Device data plane: NFA compiler + batched match kernels."""
 
 from .compiler import BUCKET_SLOTS, NfaTable, compile_filters, encode_topics
+from .device_table import DeviceNfa
+from .encode import TopicEncoder, encode_batch
+from .incremental import IncrementalNfa, NfaDelta
 from .match_kernel import MatchResult, build_matcher, match_topics, nfa_match
 
 __all__ = [
@@ -8,6 +11,11 @@ __all__ = [
     "NfaTable",
     "compile_filters",
     "encode_topics",
+    "DeviceNfa",
+    "TopicEncoder",
+    "encode_batch",
+    "IncrementalNfa",
+    "NfaDelta",
     "MatchResult",
     "build_matcher",
     "match_topics",

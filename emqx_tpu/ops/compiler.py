@@ -285,18 +285,6 @@ def encode_topics(
     ``is_sys`` True (suppresses root ``+``/``#`` at step 0) and all-UNKNOWN
     words (no literal edge exists for word id 0), so they match nothing.
     """
-    D = table.depth
-    B = batch if batch is not None else len(names)
-    if len(names) > B:
-        raise ValueError(f"{len(names)} topics > batch {B}")
-    words = np.zeros((B, D), np.int32)
-    lens = np.full(B, D + 2, np.int32)
-    is_sys = np.ones(B, bool)
-    vocab = table.vocab
-    for r, name in enumerate(names):
-        ws = T.words(name)
-        lens[r] = min(len(ws), D + 1)
-        is_sys[r] = name.startswith("$")
-        for i, w in enumerate(ws[:D]):
-            words[r, i] = vocab.get(w, 0)
-    return words, lens, is_sys
+    from .encode import encode_batch
+
+    return encode_batch(table, names, batch=batch)

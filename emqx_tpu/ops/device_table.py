@@ -1,0 +1,241 @@
+"""Device twin of :class:`~emqx_tpu.ops.incremental.IncrementalNfa`.
+
+The mria-replicant side of the mirror (SURVEY.md §2.2, §5.4): the host
+table is authoritative; this class keeps the device copy fresh by
+scatter-applying drained :class:`NfaDelta` batches **in place** (buffer
+donation ⇒ no reallocation, no host↔device reshipping of the table) and
+re-uploads only when shapes changed (table growth — rare, amortized).
+
+Every delta ships as fixed-size scatter chunks so steady-state serving
+reuses ONE compiled scatter per table shape (pre-warmed at upload) —
+XLA recompiles are the p99 killer (SURVEY.md §7).
+
+Threading model (for the asyncio serving path): host mutations and
+``drain()`` happen on the owner (event-loop) thread; ``apply_pending``
+and ``match`` may run on worker threads.  A lock serializes device-op
+*dispatch* (donation invalidates the old buffers, so an unserialized
+late dispatch could touch a deleted array); result readback happens
+outside the lock.  ``arrays()`` returns one atomically-read tuple so a
+reader never sees a half-applied (node, edge) pair.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .incremental import IncrementalNfa, NfaDelta
+from .match_kernel import MatchResult, nfa_match
+
+__all__ = ["DeviceNfa", "PendingSync", "SCATTER_CHUNK"]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_rows(tab, idx, rows):
+    """tab[idx] = rows, in place (donated)."""
+    return tab.at[idx].set(rows, mode="drop", unique_indices=False)
+
+
+# fixed scatter chunk: every delta ships as ceil(n/CHUNK) scatters of
+# exactly CHUNK rows (padding repeats row 0 — same index, same contents,
+# an idempotent no-op scatter).
+SCATTER_CHUNK = 1024
+
+
+def _chunks(idx: np.ndarray, rows: np.ndarray):
+    n = len(idx)
+    for lo in range(0, n, SCATTER_CHUNK):
+        ci = idx[lo:lo + SCATTER_CHUNK]
+        cr = rows[lo:lo + SCATTER_CHUNK]
+        if len(ci) < SCATTER_CHUNK:
+            pad = SCATTER_CHUNK - len(ci)
+            ci = np.concatenate([ci, np.full(pad, ci[0], ci.dtype)])
+            cr = np.concatenate([cr, np.tile(cr[0], (pad, 1))])
+        yield ci, cr
+
+
+class PendingSync(NamedTuple):
+    """Drained host state, safe to apply from any thread: the arrays are
+    stable copies, never aliases of the live mutable table."""
+
+    delta: Optional[NfaDelta]          # in-place scatter path
+    full: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]  # re-upload
+    shape_key: Tuple[int, int, int]
+    epoch: int
+
+    @property
+    def empty(self) -> bool:
+        return self.full is None and (self.delta is None or self.delta.empty)
+
+
+class DeviceNfa:
+    """Live device mirror: ``sync()`` after host mutations, ``match()``
+    to evaluate a batch.  Single-chip twin; the sharded path wraps the
+    same arrays via ``parallel.sharded_match``."""
+
+    def __init__(
+        self,
+        inc: IncrementalNfa,
+        active_slots: int = 16,
+        max_matches: int = 32,
+        device: Optional[jax.Device] = None,
+        lazy: bool = False,
+    ) -> None:
+        self.inc = inc
+        self.active_slots = active_slots
+        self.max_matches = max_matches
+        self.device = device
+        self.epoch = -1
+        self.uploads = 0        # full table uploads (growth / first sync)
+        self.delta_applies = 0  # in-place scatter batches
+        self._shape_key = None
+        self._arrs: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+        self._lock = threading.Lock()
+        # activate deferred accept-id reuse: freed aids stay tombstoned
+        # until we ack the epoch that cleared their device rows
+        inc.device_epoch = -1
+        if not lazy:
+            self.sync(full=True)
+
+    # -- mirror maintenance ------------------------------------------------
+
+    def _put(self, arr: np.ndarray) -> jax.Array:
+        return (
+            jax.device_put(arr, self.device)
+            if self.device is not None
+            else jnp.asarray(arr)
+        )
+
+    def arrays(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """(node_tab, edge_tab, seeds) — one consistent epoch's view."""
+        arrs = self._arrs
+        if arrs is None:
+            raise RuntimeError("DeviceNfa not synced yet (lazy init)")
+        return arrs
+
+    # expose the individual arrays for introspection / graft entry
+    @property
+    def node_tab(self):
+        return self.arrays()[0]
+
+    @property
+    def edge_tab(self):
+        return self.arrays()[1]
+
+    @property
+    def seeds(self):
+        return self.arrays()[2]
+
+    def drain(self, full: bool = False) -> PendingSync:
+        """OWNER-THREAD step: flush host dirty state into a stable,
+        thread-safe :class:`PendingSync`.  O(delta) except when a full
+        upload is needed (first sync / growth), which copies the table."""
+        delta = self.inc.flush()
+        if full or delta.resized or self._shape_key != self.inc.shape_key():
+            return PendingSync(
+                delta=None,
+                full=(
+                    self.inc.node_tab.copy(),
+                    self.inc.edge_tab.copy(),
+                    self.inc.seeds.copy(),
+                ),
+                shape_key=self.inc.shape_key(),
+                epoch=self.inc.epoch,
+            )
+        return PendingSync(
+            delta=delta, full=None,
+            shape_key=self.inc.shape_key(), epoch=delta.epoch,
+        )
+
+    def apply_pending(self, p: PendingSync) -> bool:
+        """ANY-THREAD step: ship a drained sync to the device.
+
+        On ANY failure the mirror is poisoned (``_arrs`` dropped,
+        shape key cleared): a partial apply may have donated-away live
+        buffers, and the drained delta is already lost from the host
+        dirty sets — the next ``drain()`` therefore returns a full
+        re-upload, and matches until then fail fast to the host path."""
+        with self._lock:
+            try:
+                return self._apply_locked(p)
+            except Exception:
+                self._arrs = None
+                self._shape_key = None  # force full re-upload next drain
+                raise
+
+    def _apply_locked(self, p: PendingSync) -> bool:
+        if p.full is not None:
+            node = self._put(p.full[0])
+            edge = self._put(p.full[1])
+            seeds = self._put(p.full[2])
+            self._shape_key = p.shape_key
+            self.uploads += 1
+            node, edge = self._warm_scatter(node, edge, p.full)
+            self._arrs = (node, edge, seeds)
+            self.epoch = p.epoch
+            self.inc.device_epoch = p.epoch
+            return True
+        if p.delta is None or p.delta.empty:
+            self.epoch = max(self.epoch, p.epoch)
+            self.inc.device_epoch = max(
+                self.inc.device_epoch or -1, p.epoch
+            )
+            return False
+        node, edge, seeds = self._arrs
+        for idx, rows in _chunks(p.delta.state_idx, p.delta.state_rows):
+            node = _scatter_rows(node, self._put(idx), self._put(rows))
+        for idx, rows in _chunks(p.delta.bucket_idx, p.delta.bucket_rows):
+            edge = _scatter_rows(edge, self._put(idx), self._put(rows))
+        self._arrs = (node, edge, seeds)
+        self.epoch = p.delta.epoch
+        self.inc.device_epoch = p.delta.epoch
+        self.delta_applies += 1
+        return True
+
+    def sync(self, full: bool = False) -> bool:
+        """Single-threaded convenience: drain + apply in one call."""
+        return self.apply_pending(self.drain(full=full))
+
+    def _warm_scatter(self, node, edge, full):
+        """Pre-pay the scatter compiles for the current shapes so the
+        first real delta lands at steady-state latency.  The warm writes
+        are idempotent (row 0 rewritten with its own contents)."""
+        z = np.zeros(SCATTER_CHUNK, np.int32)
+        node = _scatter_rows(
+            node, self._put(z),
+            self._put(np.tile(full[0][0], (SCATTER_CHUNK, 1))),
+        )
+        edge = _scatter_rows(
+            edge, self._put(z),
+            self._put(np.tile(full[1][0], (SCATTER_CHUNK, 1))),
+        )
+        return node, edge
+
+    # -- serving -----------------------------------------------------------
+
+    def match(self, words, lens, is_sys) -> MatchResult:
+        """Run the kernel on already-encoded operands.  Dispatch happens
+        under the device lock; the returned arrays are futures — callers
+        block (np.asarray) outside any lock."""
+        with self._lock:
+            node, edge, seeds = self.arrays()
+            return nfa_match(
+                words, lens, is_sys, node, edge, seeds,
+                active_slots=self.active_slots,
+                max_matches=self.max_matches,
+            )
+
+    def match_names(self, names: Sequence[str], batch: Optional[int] = None):
+        """Encode + match a batch of topic names (encode must run on the
+        owner thread — it reads the live vocab)."""
+        from .encode import encode_batch
+
+        words, lens, is_sys = encode_batch(self.inc, names, batch=batch)
+        return self.match(
+            self._put(words), self._put(lens), self._put(is_sys)
+        )

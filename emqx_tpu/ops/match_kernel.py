@@ -24,10 +24,11 @@ steps, and no dynamic loop means no per-iteration host round trips on
 remote-attached backends.
 
 Outputs per topic: up to K matched accept ids (sorted descending, -1
-padded), the exact match count, plus overflow counters (active-set spill
-beyond A, match spill beyond K) for SLO monitoring — spills mean the host
-must re-run those topics on the authoritative trie (fail-open, SURVEY.md
-§5.3).
+padded), the exact match count, plus PER-ROW overflow counters
+(active-set spill beyond A, match spill beyond K): a spilled row's
+answer is possibly truncated and the host re-runs exactly those rows on
+the authoritative trie (fail-open, SURVEY.md §5.3 — implemented in the
+serving engines, VERDICT.md weak item 1).
 
 Everything is int32, static shapes, no data-dependent control flow — one
 XLA compilation per (D, A, K, B, S, Hb) bucket.
@@ -50,8 +51,12 @@ __all__ = ["MatchResult", "build_matcher", "match_topics", "nfa_match"]
 class MatchResult(NamedTuple):
     matches: jax.Array     # (B, K) int32 accept ids, descending, -1 pad
     n_matches: jax.Array   # (B,) int32 exact count (may exceed K)
-    active_overflow: jax.Array  # () int32 — active-set spills (correctness!)
-    match_overflow: jax.Array   # () int32 — rows with count > K
+    active_overflow: jax.Array  # (B,) int32 — per-row active-set spills
+    match_overflow: jax.Array   # (B,) int32 — 1 where count > K
+
+    def spilled_rows(self):
+        """Bool (B,) — rows whose answer may be truncated (fail-open set)."""
+        return (self.active_overflow > 0) | (self.match_overflow > 0)
 
 
 def _bucket_hash(state: jax.Array, word: jax.Array, seed: jax.Array, mask: int):
@@ -136,9 +141,9 @@ def nfa_match(
         cand = jnp.concatenate([lit, plus], axis=1)        # (B, 2A)
         cand = jnp.where((t < lens)[:, None], cand, -1)
         active, _ = jax.lax.top_k(cand, A)                 # valids first
-        n_cand = jnp.sum((cand >= 0).astype(jnp.int32))
-        n_kept = jnp.sum((active >= 0).astype(jnp.int32))
-        spills.append(n_cand - n_kept)
+        n_cand = jnp.sum((cand >= 0).astype(jnp.int32), axis=1)
+        n_kept = jnp.sum((active >= 0).astype(jnp.int32), axis=1)
+        spills.append(n_cand - n_kept)                     # (B,) per row
 
     flat = jnp.concatenate(accept_cols, axis=1)            # (B, (D+1)·2A)
     n = jnp.sum((flat >= 0).astype(jnp.int32), axis=1)
@@ -146,8 +151,8 @@ def nfa_match(
     return MatchResult(
         matches=topk,
         n_matches=n,
-        active_overflow=jnp.sum(jnp.stack(spills)),
-        match_overflow=jnp.sum((n > K).astype(jnp.int32)),
+        active_overflow=jnp.sum(jnp.stack(spills), axis=0),
+        match_overflow=(n > K).astype(jnp.int32),
     )
 
 
@@ -180,10 +185,10 @@ def match_topics(
         *[jnp.asarray(a) for a in table.device_arrays()],
         active_slots=active_slots, max_matches=max_matches,
     )
-    if int(res.active_overflow) or int(res.match_overflow):
+    if int(jnp.sum(res.active_overflow)) or int(jnp.sum(res.match_overflow)):
         raise OverflowError(
-            f"match overflow: active={int(res.active_overflow)} "
-            f"rows>{max_matches}={int(res.match_overflow)}"
+            f"match overflow: active={int(jnp.sum(res.active_overflow))} "
+            f"rows>{max_matches}={int(jnp.sum(res.match_overflow))}"
         )
     matches = np.asarray(res.matches)
     counts = np.asarray(res.n_matches)

@@ -39,8 +39,8 @@ class FanoutResult(NamedTuple):
     bitmap: jax.Array       # (B, W) uint32 — per-topic subscriber bitmap
     n_subscribers: jax.Array  # (B,) int32 — popcount over the full row
     n_matches: jax.Array    # (B,) int32 — matched filter count
-    active_overflow: jax.Array  # () int32
-    match_overflow: jax.Array   # () int32
+    active_overflow: jax.Array  # (B,) int32 per-row spills (fail-open set)
+    match_overflow: jax.Array   # (B,) int32 per-row 1 where count > K
 
 
 def make_accept_bitmap(
@@ -100,8 +100,8 @@ def build_sharded_matcher(
             bitmap=P("dp", "tp"),
             n_subscribers=P("dp"),
             n_matches=P("dp"),
-            active_overflow=P(),
-            match_overflow=P(),
+            active_overflow=P("dp"),
+            match_overflow=P("dp"),
         ),
         check_vma=False,
     )
@@ -119,15 +119,14 @@ def build_sharded_matcher(
             jax.lax.population_count(bitmap).astype(jnp.int32), axis=1
         )
         total = jax.lax.psum(local, "tp")
-        # overflow counters: sum over the dp axis so the host sees globals
-        aov = jax.lax.psum(res.active_overflow, "dp")
-        mov = jax.lax.psum(res.match_overflow, "dp")
+        # per-row overflow rides the dp sharding like the other outputs —
+        # the host re-runs exactly the spilled rows on the trie
         return FanoutResult(
             bitmap=bitmap,
             n_subscribers=total,
             n_matches=res.n_matches,
-            active_overflow=aov,
-            match_overflow=mov,
+            active_overflow=res.active_overflow,
+            match_overflow=res.match_overflow,
         )
 
     return jax.jit(step)

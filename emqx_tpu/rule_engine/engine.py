@@ -82,8 +82,35 @@ class RuleEngine:
         self._epoch = 0   # bumps on any rule change (device mirror key)
         self.max_republish_depth = max_republish_depth
         self._pub_depth = 0
+        self._match_service = None  # device co-batching (attach below)
         if broker is not None:
             self._attach(broker)
+
+    # -- device co-batching (BASELINE config 3) -------------------------
+
+    def attach_match_service(self, ms: Any) -> None:
+        """Co-batch every enabled rule's FROM filters into the node's
+        device match table: rule selection then rides the same kernel
+        call as routing (``MatchService.hint_rules``)."""
+        self._match_service = ms
+        for rule in self.rules.values():
+            self._sync_rule_filters(rule)
+
+    def _sync_rule_filters(self, rule: "Rule") -> None:
+        ms = self._match_service
+        if ms is None:
+            return
+        try:
+            if rule.enable and rule.publish_filters():
+                ms.register_rule(rule.id, rule.publish_filters())
+            else:
+                ms.unregister_rule(rule.id)
+        except Exception:
+            # co-batching is an optimization; host matching still works
+            import logging
+            logging.getLogger(__name__).exception(
+                "rule %s device co-batch failed", rule.id
+            )
 
     # ------------------------------------------------------------------
     # registry
@@ -107,17 +134,22 @@ class RuleEngine:
                     description)
         self.rules[rule_id] = rule
         self._epoch += 1
+        self._sync_rule_filters(rule)
         return rule
 
     def delete_rule(self, rule_id: str) -> bool:
         ok = self.rules.pop(rule_id, None) is not None
         if ok:
             self._epoch += 1
+            if self._match_service is not None:
+                self._match_service.unregister_rule(rule_id)
         return ok
 
     def set_enable(self, rule_id: str, enable: bool) -> None:
-        self.rules[rule_id].enable = enable
+        rule = self.rules[rule_id]
+        rule.enable = enable
         self._epoch += 1
+        self._sync_rule_filters(rule)
 
     @property
     def epoch(self) -> int:
@@ -135,6 +167,14 @@ class RuleEngine:
         """Run all matching enabled rules; returns per-rule outputs.
         ``skip_rule`` excludes one rule id (republish loop guard)."""
         results: List[RuleResult] = []
+        # device co-batch fast path: a fresh hint names the matching rule
+        # ids, replacing the per-rule host filter walk (None ⇒ stale or
+        # no device — fall back per rule)
+        hinted: Optional[set] = None
+        if not is_event and self._match_service is not None:
+            ids = self._match_service.hint_rules(hook_or_topic)
+            if ids is not None:
+                hinted = set(ids)
         for rule in self.rules.values():
             if not rule.enable:
                 continue
@@ -142,6 +182,9 @@ class RuleEngine:
                 continue
             if is_event:
                 if hook_or_topic not in rule.event_hooks():
+                    continue
+            elif hinted is not None:
+                if rule.id not in hinted:
                     continue
             else:
                 if not any(

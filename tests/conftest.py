@@ -24,6 +24,11 @@ os.environ["XLA_FLAGS"] = (
 ).strip()
 os.environ["JAX_ENABLE_X64"] = "0"
 
+# The in-process TPU match service defaults ON for production nodes; in
+# the unit suite it would add a kernel jit compile to every node start.
+# Tests that exercise it opt in with an explicit `tpu.enable = true`.
+os.environ.setdefault("EMQX_TPU__ENABLE", "false")
+
 # This box's sitecustomize force-registers the TPU PJRT plugin and rewrites
 # jax_platforms to "axon,cpu" for every interpreter; env vars alone don't
 # win.  Re-pin to CPU before any backend is initialized.

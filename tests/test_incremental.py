@@ -1,0 +1,254 @@
+"""Incremental NFA: O(delta) add/remove parity vs from-scratch compile.
+
+Mirrors the reference's trie mutation coverage (``emqx_trie_SUITE``-style
+insert/delete/match [U], SURVEY.md §4) plus the mirror-specific delta
+machinery the reference doesn't need (device scatter sync).
+"""
+
+import random
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from emqx_tpu import topic as T
+from emqx_tpu.ops import (
+    DeviceNfa,
+    IncrementalNfa,
+    compile_filters,
+    encode_topics,
+    nfa_match,
+)
+
+WORDS = ["a", "b", "c", "d", "sensor", "t1"]
+
+
+@st.composite
+def filter_strategy(draw):
+    ws = draw(st.lists(st.sampled_from(WORDS + ["+"]), max_size=6))
+    if draw(st.booleans()) or not ws:
+        ws.append("#")
+    return "/".join(ws)
+
+
+def topic_strategy():
+    return st.lists(
+        st.sampled_from(WORDS + ["zz"]), min_size=1, max_size=7
+    ).map("/".join)
+
+
+def kernel_filter_sets(table, names, active_slots=32, max_matches=64):
+    """Match via the kernel, return sorted filter-string lists per topic."""
+    import jax.numpy as jnp
+
+    w, l, s = encode_topics(table, names)
+    res = nfa_match(
+        jnp.asarray(w), jnp.asarray(l), jnp.asarray(s),
+        *[jnp.asarray(a) for a in table.device_arrays()],
+        active_slots=active_slots, max_matches=max_matches,
+    )
+    assert int(np.asarray(res.active_overflow).sum()) == 0
+    m = np.asarray(res.matches)
+    c = np.asarray(res.n_matches)
+    return [
+        sorted(table.accept_filters[a] for a in m[r, : c[r]])
+        for r in range(len(names))
+    ]
+
+
+def test_add_remove_roundtrip():
+    inc = IncrementalNfa(depth=8)
+    assert inc.add("a/+/c")
+    assert not inc.add("a/+/c")
+    assert inc.add("a/#")
+    assert inc.n_filters == 2
+    assert inc.remove("a/+/c")
+    assert not inc.remove("a/+/c")
+    assert inc.remove("a/#")
+    assert inc.n_filters == 0
+    # everything pruned back to the root
+    assert inc.n_states == 1
+    assert inc.n_edges == 0
+
+
+def test_prune_keeps_shared_prefix():
+    inc = IncrementalNfa(depth=8)
+    inc.add("a/b/c")
+    inc.add("a/b")
+    inc.remove("a/b/c")
+    assert inc.filters() == ["a/b"]
+    assert inc.n_states == 3  # root, a, b
+
+
+def test_deep_filter_rejected():
+    inc = IncrementalNfa(depth=4)
+    with pytest.raises(ValueError):
+        inc.add("a/b/c/d/e")
+    assert not inc.remove("a/b/c/d/e")
+
+
+def test_hash_only_filter():
+    inc = IncrementalNfa(depth=4)
+    inc.add("#")
+    snap = inc.snapshot()
+    assert kernel_filter_sets(snap, ["x/y", "$SYS/x"]) == [["#"], []]
+    inc.remove("#")
+    assert inc.n_filters == 0
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    st.lists(
+        st.tuples(st.booleans(), filter_strategy()),
+        min_size=1, max_size=60,
+    ),
+    st.lists(topic_strategy(), min_size=1, max_size=20),
+)
+def test_incremental_matches_scratch_compile(ops, topics):
+    """After any interleaving of adds/removes the snapshot matches a
+    from-scratch compile AND the pure-Python oracle."""
+    inc = IncrementalNfa(depth=8, state_bucket=8, edge_bucket=8)
+    live = set()
+    for is_remove, flt in ops:
+        if is_remove and live:
+            victim = sorted(live)[len(live) // 2]
+            assert inc.remove(victim)
+            live.discard(victim)
+        else:
+            assert inc.add(flt) == (flt not in live)
+            live.add(flt)
+    assert sorted(inc.filters()) == sorted(live)
+
+    got = kernel_filter_sets(inc.snapshot(), topics)
+    oracle = [
+        sorted(f for f in live if T.match(t, f)) for t in topics
+    ]
+    assert got == oracle
+    if live:
+        ref = kernel_filter_sets(compile_filters(sorted(live), depth=8), topics)
+        assert got == ref
+
+
+def test_state_and_edge_growth():
+    """Exceed the initial buckets; shapes double and parity holds."""
+    inc = IncrementalNfa(depth=8, state_bucket=8, edge_bucket=8)
+    fs = [f"lvl{i}/x{i % 7}/y{i % 13}" for i in range(300)]
+    for f in fs:
+        inc.add(f)
+    assert inc.S > 8 and inc.Hb > 2
+    got = kernel_filter_sets(inc.snapshot(), ["lvl5/x5/y5", "none/a/b"])
+    assert got == [["lvl5/x5/y5"], []]
+    # free-list reuse after mass delete
+    for f in fs[:250]:
+        inc.remove(f)
+    for f in fs[:250]:
+        inc.add(f)
+    got = kernel_filter_sets(inc.snapshot(), ["lvl5/x5/y5"])
+    assert got == [["lvl5/x5/y5"]]
+
+
+def test_device_nfa_delta_sync():
+    """Deltas scatter in place: no full re-upload while shapes hold."""
+    rng = random.Random(5)
+    inc = IncrementalNfa(depth=8, state_bucket=1024, edge_bucket=256)
+    live = set()
+    for i in range(400):
+        f = f"root{i % 40}/{'+' if i % 5 == 0 else f'w{i % 17}'}/t{i % 3}"
+        if inc.add(f):
+            live.add(f)
+    dev = DeviceNfa(inc)
+    assert dev.uploads == 1
+
+    topics = [f"root{i % 40}/w{i % 17}/t{i % 3}" for i in range(64)]
+
+    def check():
+        res = dev.match_names(topics)
+        m = np.asarray(res.matches)
+        c = np.asarray(res.n_matches)
+        sp = np.asarray(res.spilled_rows())
+        for r, t in enumerate(topics):
+            if sp[r]:
+                continue
+            got = sorted(inc.accept_filters[a] for a in m[r, : c[r]])
+            want = sorted(f for f in live if T.match(t, f))
+            assert got == want
+
+    check()
+    for _ in range(3):
+        for _ in range(50):
+            if live and rng.random() < 0.5:
+                f = rng.choice(sorted(live))
+                live.discard(f)
+                inc.remove(f)
+            else:
+                f = f"n{rng.randint(0, 500)}/{rng.randint(0, 9)}"
+                if inc.add(f):
+                    live.add(f)
+        dev.sync()
+        check()
+    assert dev.uploads == 1, "churn within capacity must not re-upload"
+    assert dev.delta_applies >= 3
+
+
+def test_device_nfa_resync_after_growth():
+    inc = IncrementalNfa(depth=8, state_bucket=8, edge_bucket=8)
+    inc.add("a/b")
+    dev = DeviceNfa(inc)
+    for i in range(200):
+        inc.add(f"grow{i}/x")
+    dev.sync()
+    assert dev.uploads >= 2  # growth forced a full re-upload
+    res = dev.match_names(["grow7/x", "a/b"])
+    m = np.asarray(res.matches)
+    c = np.asarray(res.n_matches)
+    assert [inc.accept_filters[a] for a in m[0, : c[0]]] == ["grow7/x"]
+
+
+def test_compact_resets_garbage():
+    inc = IncrementalNfa(depth=8)
+    for i in range(100):
+        inc.add(f"tmp{i}/x")
+    for i in range(100):
+        inc.remove(f"tmp{i}/x")
+    inc.add("keep/+")
+    assert len(inc.vocab) > 2
+    inc.compact()
+    assert inc.filters() == ["keep/+"]
+    assert len(inc.vocab) == 1  # only 'keep' (+/# are not vocab words)
+    assert kernel_filter_sets(inc.snapshot(), ["keep/x"]) == [["keep/+"]]
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    st.lists(filter_strategy(), min_size=0, max_size=40),
+    st.lists(topic_strategy(), min_size=1, max_size=15),
+)
+def test_match_host_is_oracle(filters, topics):
+    """The host-side walk (the fail-open authority) ≡ the pure oracle."""
+    inc = IncrementalNfa(depth=8, state_bucket=8, edge_bucket=8)
+    live = set()
+    for f in filters:
+        inc.add(f)
+        live.add(f)
+    for t in topics + ["$SYS/x", "$share"]:
+        got = sorted(
+            inc.accept_filters[a] for a in inc.match_host(t)
+        )
+        want = sorted(f for f in live if T.match(t, f))
+        assert got == want, (t, got, want)
+
+
+def test_aid_reuse_deferred_until_device_ack():
+    """A freed accept id must not be handed out while the device mirror
+    still serves the epoch that could fire it (review finding)."""
+    inc = IncrementalNfa(depth=8)
+    inc.device_epoch = -1  # device consumer attached, nothing acked
+    inc.add("a/b")
+    aid = inc.aid_of("a/b")
+    inc.remove("a/b")
+    inc.add("x/y")
+    assert inc.aid_of("x/y") != aid, "aid reused before device ack"
+    # ack the removal epoch: now the id is reusable
+    inc.device_epoch = inc.epoch
+    inc.add("z/q")
+    assert inc.aid_of("z/q") == aid

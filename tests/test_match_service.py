@@ -1,0 +1,205 @@
+"""In-process TPU match service: the broker's own publish path rides the
+device kernel (VERDICT.md round-1 weak item 4 / next-round item 5).
+
+Covers: router-delta mirror sync, hint production/consumption, fail-open
+on staleness, rule co-batching, and an e2e TCP publish storm where
+dispatch demonstrably used the kernel (tpu.* metrics) with parity.
+"""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.client import Client
+from emqx_tpu.config import Config
+from emqx_tpu.node import BrokerNode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def settle(pred, timeout=8.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return pred()
+
+
+def make_node(**extra):
+    cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+    cfg.put("tpu.enable", True)  # env layer disables it for other tests
+    cfg.put("tpu.mirror_refresh_interval", 0.01)
+    for k, v in extra.items():
+        cfg.put(k, v)
+    return BrokerNode(cfg)
+
+
+def sub(b, cid, flt):
+    if cid not in b.sessions:
+        b.open_session(cid)
+    b.subscribe(cid, flt)
+
+
+def ms_synced(node):
+    ms = node.match_service
+    return (
+        ms is not None and ms.ready
+        and ms._seen_epoch == node.broker.router.epoch
+        and ms.dev.epoch == ms.inc.epoch
+    )
+
+
+def test_publish_storm_uses_kernel_with_parity():
+    async def main():
+        node = make_node()
+        await node.start()
+        port = node.listeners.all()[0].port
+        try:
+            subs = []
+            filters = []
+            for i in range(6):
+                c = Client(clientid=f"s{i}", port=port)
+                await c.connect()
+                flt = f"room/+/kind{i % 3}"
+                await c.subscribe(flt, qos=0)
+                subs.append(c)
+                filters.append(flt)
+            assert await settle(lambda: ms_synced(node))
+
+            pub = Client(clientid="p", port=port)
+            await pub.connect()
+            topics = [f"room/{i}/kind{i % 3}" for i in range(30)]
+            for t in topics:
+                await pub.publish(t, b"x", qos=0)
+
+            # every subscriber with a matching filter got every message
+            async def got_all():
+                want = sum(
+                    1 for t in topics for f in filters if T.match(t, f)
+                )
+                have = sum(s.messages.qsize() for s in subs)
+                return have >= want
+
+            ok = False
+            for _ in range(100):
+                if await got_all():
+                    ok = True
+                    break
+                await asyncio.sleep(0.05)
+            assert ok, "deliveries missing"
+
+            m = node.observed.metrics
+            assert m.get("tpu.match.batches") >= 1
+            assert m.get("tpu.match.topics") >= len(topics)
+            assert m.get("tpu.mirror.refresh") >= 1
+            for s in subs:
+                await s.disconnect()
+            await pub.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_stale_hint_falls_back_to_host():
+    """A hint minted before a router mutation must not be consumed."""
+
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            sub(b, "c1", "a/+")
+            assert await settle(lambda: ms_synced(node))
+            await ms.prefetch("a/x")
+            assert ms.hint_routes("a/x") is not None
+            # mutate the router: the hint is now poison and must die
+            sub(b, "c2", "a/x")
+            assert ms.hint_routes("a/x") is None
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_hint_routes_match_host_routes():
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            flts = ["s/+/t", "s/#", "exact/topic", "+/b", "deep/a/b/c/d/e/f/+/x"]
+            for i, f in enumerate(flts):
+                sub(b, f"c{i}", f)
+            assert await settle(lambda: ms_synced(node))
+            for topic in ["s/1/t", "s/9", "exact/topic", "q/b", "none",
+                          "deep/a/b/c/d/e/f/q/x"]:
+                await ms.prefetch(topic)
+                hint = ms.hint_routes(topic)
+                assert hint is not None, topic
+                want = b.router.match_routes(topic)
+                assert sorted(map(tuple, hint)) == sorted(map(tuple, want)), topic
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_rule_cobatch_selected_by_hint():
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            hits = []
+            node.rule_engine.create_rule(
+                "r1", 'SELECT topic FROM "evt/+/fire"',
+                actions=[lambda out, cols: hits.append(out["topic"])],
+            )
+            node.rule_engine.create_rule(
+                "r2", 'SELECT topic FROM "other/#"', actions=[],
+            )
+            sub(b, "c1", "evt/#")
+            assert await settle(lambda: ms_synced(node))
+            await ms.prefetch("evt/z1/fire")
+            assert ms.hint_rules("evt/z1/fire") == ["r1"]
+            from emqx_tpu.broker.message import make_message
+
+            b.publish(make_message("c9", "evt/z1/fire", b"!"))
+            assert hits == ["evt/z1/fire"]
+            # unregister drops it from the co-batch
+            node.rule_engine.delete_rule("r1")
+            assert await settle(lambda: ms_synced(node))
+            await ms.prefetch("evt/z1/fire")
+            assert ms.hint_rules("evt/z1/fire") == []
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_unsubscribe_prunes_mirror():
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            sub(b, "c1", "x/+")
+            assert await settle(lambda: ms_synced(node))
+            assert ms.inc.n_filters == 1
+            b.unsubscribe("c1", "x/+")
+            assert await settle(
+                lambda: ms_synced(node) and ms.inc.n_filters == 0
+            )
+        finally:
+            await node.stop()
+
+    run(main())

@@ -97,14 +97,16 @@ def test_match_overflow_reported():
         max_matches=2,
     )
     # each topic matches a/<i>/# and a/+/+ = 2 matches → no overflow at K=2
-    assert int(res.match_overflow) == 0
+    assert int(np.sum(res.match_overflow)) == 0
     res2 = nfa_match(
         jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
         *[jnp.asarray(a) for a in t.device_arrays()],
         max_matches=1,
     )
-    assert int(res2.match_overflow) == 8
-    assert (np.asarray(res2.n_matches) == 2).all()  # count is exact beyond K
+    # per-row overflow: every one of the 8 rows spilled, flagged exactly
+    assert np.asarray(res2.match_overflow)[:8].tolist() == [1] * 8
+    assert np.asarray(res2.spilled_rows())[:8].all()
+    assert (np.asarray(res2.n_matches)[:8] == 2).all()  # exact beyond K
 
 
 def test_active_overflow_reported():
@@ -122,7 +124,9 @@ def test_active_overflow_reported():
         *[jnp.asarray(a) for a in t.device_arrays()],
         active_slots=4,
     )
-    assert int(res.active_overflow) > 0
+    # the overloaded row is flagged; per-row so the host can fail open
+    assert int(np.asarray(res.active_overflow)[0]) > 0
+    assert bool(np.asarray(res.spilled_rows())[0])
     with pytest.raises(OverflowError):
         match_topics(t, ["w/w/w/w/w/w"], active_slots=4)
 

@@ -72,7 +72,7 @@ def test_sharded_matches_unsharded():
     popc = np.array([bin(int.from_bytes(row.tobytes(), "little")).count("1") for row in ref_bm])
     np.testing.assert_array_equal(np.asarray(res.n_subscribers), popc)
     np.testing.assert_array_equal(np.asarray(res.n_matches), np.asarray(ref.n_matches))
-    assert int(res.active_overflow) == 0
+    assert int(np.sum(np.asarray(res.active_overflow))) == 0
 
 
 def test_sharded_trie_parity():
