@@ -14,11 +14,28 @@ sidecar; the broker's own ``Broker.publish`` always walked the host trie
   batching loop into ONE kernel call, and parks the answer in an
   epoch-validated hint cache;
 * the synchronous ``Broker.publish`` then consumes the hint via
-  :meth:`hint_routes` (``Broker.device_match``) — if the hint is stale
-  (router mutated since) or absent, publish falls back to the host trie
+  :meth:`hint_routes` (``Broker.device_match``) — if the hint can't be
+  proven fresh or is absent, publish falls back to the host trie
   unchanged, so correctness never depends on the device;
 * per-row kernel spills fail open to the router's own trie
   (SURVEY.md §5.3), counted in ``tpu.match.fallback_host``.
+
+**Churn-resilient serving** (round-3 rework, VERDICT.md item 3): hints
+are no longer wholesale-invalidated by router mutations.  A hint is
+stamped with the router epoch its table reflected; at consume time the
+router's delta log since that epoch is checked and the hint stays valid
+unless a *newly added wildcard filter* matches the topic.  Deletions are
+inherently safe — :meth:`Router.routes_with_wild` resolves destinations
+live, so removed filters/destinations drop out of the answer without
+invalidation.  The same scheme covers rule co-batching via a rule
+mutation log.  Under continuous subscribe/unsubscribe churn the device
+path therefore keeps serving (duty cycle asserted in
+tests/test_match_service.py) instead of collapsing to the host trie.
+
+At low publish concurrency the batching window costs more than the host
+trie answers (~12 µs); an **adaptive bypass** skips the device when the
+recent arrival rate is below ``bypass_rate`` so single-client latency
+stays at host-path levels.
 
 Also co-batches the **rule engine**'s FROM filters (BASELINE config 3):
 rules register their topic filters here under a separate id namespace,
@@ -30,7 +47,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
-from typing import Any, Dict, List, Optional, Set, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -63,6 +81,9 @@ class MatchService:
         active_slots: int = 16,
         max_matches: int = 32,
         hint_cap: int = 65536,
+        max_stale_deltas: int = 256,
+        bypass_rate: float = 0.0,
+        prefetch_timeout_s: float = 0.5,
     ) -> None:
         from ..ops import IncrementalNfa
         from ..ops.device_table import DeviceNfa
@@ -75,6 +96,13 @@ class MatchService:
         self.max_batch = max_batch
         self.debounce_s = debounce_s
         self.hint_cap = hint_cap
+        # serving tolerates up to this many un-synced router deltas; the
+        # per-topic freshness proof scans at most this many log entries
+        self.max_stale_deltas = max_stale_deltas
+        # publishes/s below which prefetch skips the device entirely
+        # (0 disables bypassing — tests pin the device path on)
+        self.bypass_rate = bypass_rate
+        self.prefetch_timeout_s = prefetch_timeout_s
 
         self.inc = IncrementalNfa(depth=depth)
         self.dev = DeviceNfa(
@@ -84,21 +112,33 @@ class MatchService:
         self._ref: Dict[str, int] = {}     # wildcard filter -> route count
         self._deep: Dict[str, int] = {}    # too-deep filter -> alias aid
         self._deep_trie = FilterTrie()     # host match for too-deep filters
-        self._rule_aid: Dict[str, int] = {}   # rule FROM filter -> alias? no:
         # rule filters compile as REAL NFA filters tagged by aid; a filter
         # used by both routing and rules shares one aid.  Maps aid->sets:
         self._aid_rules: Dict[int, Set[str]] = {}   # aid -> rule ids
         self._rule_refs: Dict[str, Dict[str, int]] = {}  # rule_id -> {flt: 1}
         self._routing_aids: Set[int] = set()
 
+        # rule mutation log: (gen, filters-added) — unregisters append an
+        # empty entry so gen coverage stays contiguous (deleted rules are
+        # harmless in stale hints: the engine skips unknown ids)
+        self._rule_gen = 0
+        self._rule_log: Deque[Tuple[int, Tuple[str, ...]]] = deque(maxlen=512)
+
         self.ready = False
-        self._seen_epoch = 0               # router delta-log position
+        self._seen_epoch = 0          # router delta-log position (drained)
+        self._synced_epoch = 0        # router epoch the DEVICE table reflects
+        self._synced_rule_gen = 0     # rule gen the device table reflects
         self._dirty = asyncio.Event()
         self._pending: List[Tuple[str, asyncio.Future]] = []
         self._batch_wake = asyncio.Event()
-        self._hints: Dict[str, Tuple[int, List[str], List[str]]] = {}
+        # topic -> (router_epoch, rule_gen, wild filters, rule ids)
+        self._hints: Dict[str, Tuple[int, int, List[str], List[str]]] = {}
         self._tasks: List[asyncio.Task] = []
         self._running = False
+        # arrival-rate window for the adaptive bypass
+        self._win_start = time.monotonic()
+        self._win_count = 0
+        self._last_rate = 0.0
 
         self.router.listeners.append(self._on_router_mutation)
 
@@ -130,7 +170,8 @@ class MatchService:
     # ------------------------------------------------------------------
 
     def _on_router_mutation(self, epoch: int) -> None:
-        self._hints.clear()  # any cached answer may now be wrong
+        # NO hint invalidation here: freshness is proven per-topic at
+        # consume time against the delta log (see _hint_fresh)
         self._dirty.set()
 
     def _add(self, flt: str) -> None:
@@ -180,10 +221,13 @@ class MatchService:
             self.inc.remove(flt)
 
     def _bootstrap(self) -> None:
-        """Full resnapshot from the router (cold start / delta-log gap)."""
+        """Full resnapshot from the router (cold start / delta-log gap).
+        Refcounts seed from the router's live destination count — a
+        filter restored with multiple routes must survive the deletion
+        of all but one of them (ADVICE.md round-2 high item 1)."""
         self._ref = {}
         for flt in self.router.wildcard_filters():
-            self._ref[flt] = 1
+            self._ref[flt] = max(1, len(self.router.routes_of(flt)))
             if self.inc.aid_of(flt) < 0 and flt not in self._deep:
                 self._table_add(flt, routing=True)
             else:
@@ -218,17 +262,28 @@ class MatchService:
             try:
                 first = not self.ready
                 self._drain_router()
+                # epochs the device table will reflect once this sync lands
+                router_epoch = self._seen_epoch
+                rule_gen = self._rule_gen
                 pending = self.dev.drain(full=first)
+                if pending.full is not None:
+                    # a full re-upload changes table shapes ⇒ the match
+                    # jit recompiles; drop readiness so publishes take the
+                    # host path instead of stalling on the compile
+                    # (ADVICE.md round-2 high item 2)
+                    self.ready = False
                 await asyncio.to_thread(self.dev.apply_pending, pending)
+                if first or pending.full is not None:
+                    await asyncio.to_thread(self._warm)
                 self.ready = True
+                self._synced_epoch = router_epoch
+                self._synced_rule_gen = rule_gen
                 if self.metrics is not None:
                     self.metrics.inc("tpu.mirror.refresh")
                     if pending.full is not None:
                         self.metrics.inc("tpu.mirror.recompile")
                     elif pending.delta is not None and not pending.delta.empty:
                         self.metrics.inc("tpu.mirror.delta_applied")
-                if first or pending.full is not None:
-                    await asyncio.to_thread(self._warm)
             except Exception:
                 log.exception("match-service sync failed; host path serves")
                 await asyncio.sleep(1.0)
@@ -254,7 +309,8 @@ class MatchService:
             aid = self._deep.get(flt, self.inc.aid_of(flt))
             self._aid_rules.setdefault(aid, set()).add(rule_id)
         self._rule_refs[rule_id] = refs
-        self._hints.clear()
+        self._rule_gen += 1
+        self._rule_log.append((self._rule_gen, tuple(from_filters)))
         self._dirty.set()
 
     def unregister_rule(self, rule_id: str) -> None:
@@ -276,7 +332,10 @@ class MatchService:
                     self.inc.free_alias(aid)
                 else:
                     self.inc.remove(flt)
-        self._hints.clear()
+        # removal-only entry: stale hints that still name the rule are
+        # harmless (the engine skips ids not in its live rule map)
+        self._rule_gen += 1
+        self._rule_log.append((self._rule_gen, ()))
         self._dirty.set()
 
     # ------------------------------------------------------------------
@@ -286,37 +345,107 @@ class MatchService:
     def _usable(self) -> bool:
         return (
             self.ready
-            and self._seen_epoch == self.router.epoch
-            and self.dev.epoch == self.inc.epoch
+            and self.router.epoch - self._synced_epoch <= self.max_stale_deltas
         )
+
+    def _hint_fresh(self, topic: str, hint_epoch: int) -> bool:
+        """Prove a hint still answers correctly for ``topic``.
+
+        Deletions never need invalidation (destinations resolve live in
+        ``routes_with_wild``); only a wildcard filter ADDED after the
+        hint's table epoch can make the answer incomplete."""
+        if hint_epoch == self.router.epoch:
+            return True
+        if self.router.epoch - hint_epoch > self.max_stale_deltas:
+            return False  # bound the proof before materializing deltas
+        deltas = self.router.deltas_since(hint_epoch)
+        if deltas is None:
+            return False
+        for d in deltas:
+            if d.op == "add" and T.wildcard(d.filter) \
+                    and T.match(topic, d.filter):
+                return False
+        return True
+
+    def _rules_fresh(self, topic: str, hint_gen: int) -> bool:
+        """Rule-side freshness: a rule registered after the hint whose
+        FROM filter matches the topic invalidates it (ADVICE.md round-2
+        medium item: rule changes don't bump the router epoch)."""
+        if hint_gen == self._rule_gen:
+            return True
+        if self._rule_log and self._rule_log[0][0] > hint_gen + 1:
+            return False  # log trimmed past the hint's gen
+        for gen, filters in self._rule_log:
+            if gen > hint_gen and any(T.match(topic, f) for f in filters):
+                return False
+        return True
+
+    def _note_arrival(self) -> None:
+        now = time.monotonic()
+        dt = now - self._win_start
+        if dt >= 0.05:
+            self._last_rate = self._win_count / dt
+            self._win_start = now
+            self._win_count = 0
+        self._win_count += 1
+
+    def _should_bypass(self) -> bool:
+        if self.bypass_rate <= 0:
+            return False
+        return not self._pending and self._last_rate < self.bypass_rate
 
     async def prefetch(self, topic: str) -> None:
         """Async stage (connection intercept): micro-batch this topic
-        through the kernel and park the answer in the hint cache."""
-        if not self._usable() or topic in self._hints:
+        through the kernel and park the answer in the hint cache.
+        Bounded by ``prefetch_timeout_s`` — a stalled device (compile,
+        growth re-upload) degrades to the host path, never blocks
+        publishes indefinitely."""
+        self._note_arrival()
+        if not self._usable():
+            return
+        hint = self._hints.get(topic)
+        if hint is not None and self._hint_fresh(topic, hint[0]) \
+                and self._rules_fresh(topic, hint[1]):
+            return
+        if self._should_bypass():
+            if self.metrics is not None:
+                self.metrics.inc("tpu.match.bypass")
             return
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((topic, fut))
         self._batch_wake.set()
         try:
-            await fut
+            await asyncio.wait_for(fut, self.prefetch_timeout_s)
         except Exception:
-            pass  # publish falls back to the host path
+            pass  # timeout/cancel: publish falls back to the host path
 
     def hint_routes(self, topic: str):
-        """Sync stage (Broker.publish): fresh hint → routes, else None."""
+        """Sync stage (Broker.publish): provably-fresh hint → routes,
+        else None (host trie serves)."""
         hint = self._hints.get(topic)
-        if hint is None or hint[0] != self.router.epoch:
+        if hint is None:
             return None
-        return self.router.routes_with_wild(topic, hint[1])
+        if not self._hint_fresh(topic, hint[0]):
+            self._hints.pop(topic, None)
+            if self.metrics is not None:
+                self.metrics.inc("tpu.match.hint_stale")
+            return None
+        if self.metrics is not None:
+            self.metrics.inc("tpu.match.hint_served")
+        return self.router.routes_with_wild(topic, hint[2])
 
     def hint_rules(self, topic: str) -> Optional[List[str]]:
         """Matched rule ids for a fresh hint, else None (rule engine then
         falls back to its per-rule host matching)."""
         hint = self._hints.get(topic)
-        if hint is None or hint[0] != self.router.epoch:
+        if hint is None:
             return None
-        return hint[2]
+        if not self._rules_fresh(topic, hint[1]):
+            self._hints.pop(topic, None)
+            if self.metrics is not None:
+                self.metrics.inc("tpu.match.hint_stale")
+            return None
+        return hint[3]
 
     def _deep_ids(self, topic: str) -> List[int]:
         if not self._deep:
@@ -365,16 +494,27 @@ class MatchService:
             if self._pending:
                 self._batch_wake.set()
             topics = [t for t, _ in pending]
-            epoch = self.router.epoch
+            # the hint's provenance is the epoch the DEVICE table
+            # reflects (not the live router epoch — the table may lag;
+            # freshness is then proven forward from here at consume time)
+            epoch = self._synced_epoch
+            rule_gen = self._synced_rule_gen
             try:
                 if not self._usable():
                     raise RuntimeError("mirror stale")
                 enc = encode_batch(
                     self.inc, topics, batch=_bucket(len(topics))
                 )
+                # aid-reuse guard: if a freed accept id is handed out
+                # again while this batch is in flight, the device rows
+                # may name it under its OLD filter — translating through
+                # the live accept_filters would be wrong at any epoch
+                reuses0 = self.inc.aid_reuses
                 rows, spilled = await asyncio.to_thread(
                     self._device_rows, enc, len(topics)
                 )
+                if self.inc.aid_reuses != reuses0:
+                    raise RuntimeError("aid reused mid-flight")
                 spset = set(spilled)
                 for r in spilled:
                     rows[r] = self._host_ids(topics[r])
@@ -395,7 +535,8 @@ class MatchService:
                 if len(self._hints) + len(topics) > self.hint_cap:
                     self._hints.clear()
                 for (topic, fut), row in zip(pending, rows):
-                    self._hints[topic] = (epoch, *self._split_row(row))
+                    self._hints[topic] = (epoch, rule_gen,
+                                          *self._split_row(row))
                     if not fut.done():
                         fut.set_result(None)
             except Exception:
@@ -413,6 +554,7 @@ class MatchService:
             "rules": len(self._rule_refs),
             "device_epoch": self.dev.epoch,
             "router_epoch": self.router.epoch,
+            "synced_epoch": self._synced_epoch,
             "uploads": self.dev.uploads,
             "delta_applies": self.dev.delta_applies,
         }

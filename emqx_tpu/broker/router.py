@@ -176,10 +176,20 @@ class Router:
     def deltas_since(self, epoch: int) -> Optional[List[RouteDelta]]:
         """Deltas after ``epoch``, or None if the log no longer reaches back
         that far (caller must full-resnapshot — the mria
-        bootstrap-then-replay-rlog pattern, SURVEY.md §5.4)."""
-        if not self._deltas:
-            return [] if epoch == self.epoch else None
-        oldest = self._deltas[0].epoch
-        if epoch + 1 < oldest:
+        bootstrap-then-replay-rlog pattern, SURVEY.md §5.4).
+
+        O(requested span), not O(log): epochs are contiguous (every
+        ``_bump`` appends exactly one delta), so the tail is located by
+        index — the per-publish freshness proof must never walk the
+        whole 65k-cap deque."""
+        n = self.epoch - epoch
+        if n <= 0:
+            return []
+        ln = len(self._deltas)
+        if n > ln:
             return None
-        return [d for d in self._deltas if d.epoch > epoch]
+        if n == ln:
+            return list(self._deltas)
+        import itertools
+
+        return list(itertools.islice(self._deltas, ln - n, ln))

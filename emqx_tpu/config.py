@@ -183,6 +183,9 @@ SCHEMA: Dict[str, Field] = {
     # empty = in-memory only (no persistence)
     "node.data_dir": Field("", str),
     "durable_storage.sync_interval": Field(5.0, duration),
+    # 0 = fsync every WAL append (lose at most a torn tail line);
+    # t > 0 = fsync at most once per t seconds (bounded loss window)
+    "durable_storage.fsync_interval": Field(0.0, duration),
 
     # -- management API (SURVEY.md §2.3: emqx_management/minirest) --------
     # off by default: embedded/multi-node-on-one-host uses must opt in
@@ -220,6 +223,13 @@ SCHEMA: Dict[str, Field] = {
     "tpu.mirror_refresh_interval": Field(0.05, duration),
     "tpu.mesh_shape": Field("dp=1,tp=1", str),
     "tpu.fail_open": Field(True, _bool),
+    # serving tolerates up to this many un-synced router deltas before
+    # prefetch skips the device (hints prove freshness per-topic)
+    "tpu.max_stale_deltas": Field(256, int, lambda v: v >= 0),
+    # publishes/s below which prefetch bypasses the device batching
+    # window (host trie is faster at low concurrency); 0 disables
+    "tpu.bypass_rate": Field(500.0, float, lambda v: v >= 0),
+    "tpu.prefetch_timeout": Field(0.5, duration),
 }
 
 

@@ -247,15 +247,18 @@ class TpuMatchSidecar:
             try:
                 first = not self._eng_ready
                 pending = eng.dev.drain(full=first)  # loop-side: O(delta)
+                if pending.full is not None:
+                    # a full upload changes table shapes ⇒ match jit
+                    # recompiles; serve from the host until re-warmed so
+                    # queued matches never stall behind the compile
+                    # (ADVICE.md round-2 high item 2)
+                    self._eng_ready = False
                 # device work off the loop: a growth re-upload or a jit
                 # warm takes long enough to stall hook RPCs otherwise
                 await asyncio.to_thread(eng.dev.apply_pending, pending)
-                self._eng_ready = True
                 if first or pending.full is not None:
-                    # warm the match jit AFTER going ready — the first
-                    # real match would pay the compile anyway; readiness
-                    # must not wait on it
                     await asyncio.to_thread(self._warm, eng)
+                self._eng_ready = True
                 self.syncs += 1
                 dt = (time.perf_counter() - t0) * 1e3
                 log.info(
@@ -334,10 +337,16 @@ class TpuMatchSidecar:
             return [self._host_row(t) for t in topics]
         B = _bucket_batch(min(len(topics), self.max_batch))
         enc = eng.encode(topics, B)
+        # aid-reuse guard: device rows decoded through a mutated
+        # accept_filters after an id was recycled would name the wrong
+        # filter — discard the batch and answer from the host trie
+        reuses0 = eng.inc.aid_reuses
         try:
             rows, spilled = await asyncio.to_thread(
                 self._device_rows, eng, enc, len(topics)
             )
+            if eng.inc.aid_reuses != reuses0:
+                raise RuntimeError("aid reused mid-flight")
         except Exception:
             log.exception("device match failed; host fallback")
             return [self._host_row(t) for t in topics]
@@ -364,7 +373,13 @@ class TpuMatchSidecar:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((topic, fut))
         self._batch_wake.set()
-        return await fut
+        try:
+            # bounded wait: a stalled device (growth re-upload compile)
+            # degrades to the authoritative host answer, never blocks
+            # the hook RPC past its deadline
+            return await asyncio.wait_for(fut, 2.0)
+        except asyncio.TimeoutError:
+            return self._ids_to_filters([self._host_row(topic)])[0]
 
     async def _batch_loop(self) -> None:
         while True:

@@ -381,6 +381,9 @@ class BrokerNode:
                 debounce_s=cfg.get("tpu.mirror_refresh_interval"),
                 active_slots=cfg.get("tpu.active_slots"),
                 max_matches=cfg.get("tpu.max_matches"),
+                max_stale_deltas=cfg.get("tpu.max_stale_deltas"),
+                bypass_rate=cfg.get("tpu.bypass_rate"),
+                prefetch_timeout_s=cfg.get("tpu.prefetch_timeout"),
             )
             await self.match_service.start()
             self.broker.device_match = self.match_service.hint_routes

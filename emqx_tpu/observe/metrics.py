@@ -81,6 +81,7 @@ TPU_METRIC_NAMES: List[str] = [
     "tpu.match.active_overflow", "tpu.match.match_overflow",
     "tpu.match.fallback_host", "tpu.mirror.refresh",
     "tpu.mirror.delta_applied", "tpu.mirror.recompile",
+    "tpu.match.hint_served", "tpu.match.hint_stale", "tpu.match.bypass",
 ]
 
 

@@ -126,6 +126,7 @@ class IncrementalNfa:
         # any epoch)
         self._free_aids: "deque[Tuple[int, int]]" = deque()  # (epoch, aid)
         self.device_epoch: Optional[int] = None  # None ⇒ no device consumer
+        self.aid_reuses = 0   # times a freed aid was handed out again
         self._alias_aids: set = set()
         self._dirty_states = {0}
         self._dirty_buckets: set = set()
@@ -163,6 +164,11 @@ class IncrementalNfa:
             if self.device_epoch is None or freed_epoch <= self.device_epoch:
                 self._free_aids.popleft()
                 self.accept_filters[aid] = flt
+                # monotone reuse counter: decoders that translated device
+                # rows through accept_filters while a match was in flight
+                # check it moved and discard the batch (the in-flight rows
+                # may name this aid under its OLD filter)
+                self.aid_reuses += 1
                 return aid
         self.accept_filters.append(flt)
         return len(self.accept_filters) - 1
@@ -500,9 +506,18 @@ class IncrementalNfa:
         run it in the background the way the reference recompacts mnesia
         tables — correctness never requires it.  Alias ids are
         REASSIGNED: callers holding alias maps must rebuild them from
-        :meth:`aliases` afterwards."""
+        :meth:`aliases` afterwards.
+
+        Epoch monotonicity and the device ack position survive the
+        rebuild (ADVICE.md round-2 low item): the new table presents as
+        one more epoch, flagged resized, so an attached consumer's next
+        ``drain()`` is a full re-upload — consumers must drain+apply
+        before serving resumes (an attached DeviceNfa's rows translated
+        through the new ``accept_filters`` are wrong until then)."""
         live = self.filters()
         alias_filters = sorted(self.aliases())
+        old_epoch = self.epoch
+        old_device_epoch = self.device_epoch
         fresh = IncrementalNfa(
             depth=self.depth,
             state_bucket=_bucket(max(2 * len(live), 8), 1024),
@@ -512,6 +527,10 @@ class IncrementalNfa:
             fresh.add(f)
         for f in alias_filters:
             fresh.alloc_alias(f)
+        old_reuses = self.aid_reuses
         self.__dict__.update(fresh.__dict__)
-        self.epoch += 1
+        self.epoch = old_epoch + 1
+        self.device_epoch = old_device_epoch
+        # every aid was reassigned: force in-flight decoders to discard
+        self.aid_reuses = old_reuses + 1
         self._resized = True

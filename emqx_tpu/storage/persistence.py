@@ -36,7 +36,11 @@ class Persistence:
     def __init__(self, node: Any, data_dir: str) -> None:
         self.node = node
         self.broker = node.broker
-        self.store = Store(data_dir)
+        try:
+            fsync_s = node.config.get("durable_storage.fsync_interval")
+        except Exception:
+            fsync_s = 0.0
+        self.store = Store(data_dir, fsync_interval_s=fsync_s)
         self.t_sessions = self.store.table("sessions")
         self.t_retained = self.store.table("retained")
         self.t_delayed = self.store.table("delayed")
@@ -106,14 +110,13 @@ class Persistence:
     @staticmethod
     def _sync_table(table: Table, want: Dict[str, Any]) -> None:
         """Reconcile the persistent table with the live dict (puts ride
-        the wal; removals too; unchanged keys are skipped)."""
+        the wal; removals too; unchanged keys are skipped).  One fsync
+        per pass, not per key — nothing is acked mid-pass."""
         live = dict(table.items())
-        for k, v in want.items():
-            if live.get(k) != v:
-                table.put(k, v)
-        for k in live:
-            if k not in want:
-                table.delete(k)
+        puts = {k: v for k, v in want.items() if live.get(k) != v}
+        dels = [k for k in live if k not in want]
+        if puts or dels:
+            table.write_batch(puts, dels)
 
     def _collect(self) -> List[tuple]:
         """Serialize live state to JSON-safe dicts ON the event loop (the

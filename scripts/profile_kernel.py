@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Kernel component profiler: where do the 16 ms/batch go?
+
+Times the full nfa_match against ablated variants (no top_k compaction,
+no edge gather, no final top_k) at the round-2 bench shape, and sweeps
+active_slots / batch.  Methodology mirrors bench.py: enqueue N calls,
+force once, divide.
+"""
+import argparse
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, args, iters=20):
+    r = fn(*args)
+    jax.block_until_ready(r)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        rs = [fn(*args) for _ in range(iters)]
+        jax.block_until_ready(rs[-1])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filters", type=int, default=200_000)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--depth", type=int, default=8)
+    args = ap.parse_args()
+
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import build_workload
+    from emqx_tpu.ops import compile_filters, encode_topics
+    from emqx_tpu.ops.compiler import BUCKET_SLOTS
+    from emqx_tpu.ops.match_kernel import _bucket_hash, nfa_match
+
+    rng = np.random.default_rng(42)
+    filters, topics = build_workload(rng, args.filters, args.batch, args.depth)
+    t0 = time.perf_counter()
+    table = compile_filters(filters, depth=args.depth)
+    print(f"compile {time.perf_counter()-t0:.1f}s states={table.n_states} "
+          f"S={table.node_tab.shape[0]} Hb={table.edge_tab.shape[0]}")
+    words, lens, is_sys = encode_topics(table, topics[: args.batch],
+                                        batch=args.batch)
+    dev_args = (jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+                *[jnp.asarray(a) for a in table.device_arrays()])
+
+    # full kernel at various active_slots
+    for A in (8, 16, 32):
+        ms = timeit(partial(nfa_match, active_slots=A, max_matches=32),
+                    dev_args)
+        print(f"full A={A:3d}: {ms:7.2f} ms  {args.batch/ms*1e3/1e6:.2f}M t/s")
+
+    # ablations at A=16
+    node_tab, edge_tab, seeds = dev_args[3:]
+    B, D = words.shape
+    A = 16
+
+    @jax.jit
+    def no_edges(words, lens, is_sys, node_tab, edge_tab, seeds):
+        active = jnp.full((B, A), -1, jnp.int32).at[:, 0].set(0)
+        accept_cols = []
+        for t in range(D + 1):
+            valid = active >= 0
+            sa = jnp.maximum(active, 0)
+            node = node_tab[sa]
+            hacc = jnp.where(valid, node[..., 1], -1)
+            at_end = (t == lens)[:, None]
+            eacc = jnp.where(valid & at_end, node[..., 2], -1)
+            accept_cols.append(jnp.concatenate([hacc, eacc], axis=1))
+            if t == D:
+                break
+            lit = jnp.where(valid, node[..., 0], -1)  # fake: reuse plus
+            plus = jnp.where(valid, node[..., 0], -1)
+            cand = jnp.concatenate([lit, plus], axis=1)
+            cand = jnp.where((t < lens)[:, None], cand, -1)
+            active, _ = jax.lax.top_k(cand, A)
+        flat = jnp.concatenate(accept_cols, axis=1)
+        n = jnp.sum((flat >= 0).astype(jnp.int32), axis=1)
+        topk, _ = jax.lax.top_k(flat, 32)
+        return topk, n
+
+    print(f"no-edge-gather: {timeit(no_edges, dev_args):7.2f} ms")
+
+    @jax.jit
+    def no_topk(words, lens, is_sys, node_tab, edge_tab, seeds):
+        active = jnp.full((B, A), -1, jnp.int32).at[:, 0].set(0)
+        accept_cols = []
+        for t in range(D + 1):
+            valid = active >= 0
+            sa = jnp.maximum(active, 0)
+            node = node_tab[sa]
+            hacc = jnp.where(valid, node[..., 1], -1)
+            at_end = (t == lens)[:, None]
+            eacc = jnp.where(valid & at_end, node[..., 2], -1)
+            accept_cols.append(jnp.concatenate([hacc, eacc], axis=1))
+            if t == D:
+                break
+            w = jnp.broadcast_to(words[:, t][:, None], (B, A))
+            Hb = edge_tab.shape[0]
+            mask = Hb - 1
+            hits = []
+            for k in range(2):
+                b = _bucket_hash(active, w, seeds[k], mask)
+                rows = edge_tab[b].reshape(B, A, BUCKET_SLOTS, 4)
+                hit = (rows[..., 0] == active[..., None]) & (
+                    rows[..., 1] == w[..., None])
+                hits.append(jnp.max(jnp.where(hit, rows[..., 2], -1), axis=-1))
+            lit = jnp.maximum(hits[0], hits[1])
+            plus = jnp.where(valid, node[..., 0], -1)
+            # NO top_k: just interleave lit/plus into A slots (wrong
+            # semantics past A/2 actives, fine for timing)
+            active = jnp.concatenate([lit[:, : A // 2], plus[:, : A // 2]],
+                                     axis=1)
+        flat = jnp.concatenate(accept_cols, axis=1)
+        n = jnp.sum((flat >= 0).astype(jnp.int32), axis=1)
+        return flat, n
+
+    print(f"no-topk (sum only): {timeit(no_topk, dev_args):7.2f} ms")
+
+    # batch sweep at A=16
+    for B2 in (2048, 4096, 8192, 16384, 32768):
+        w2, l2, s2 = encode_topics(
+            table, (topics * ((B2 // len(topics)) + 1))[:B2], batch=B2)
+        a2 = (jnp.asarray(w2), jnp.asarray(l2), jnp.asarray(s2),
+              node_tab, edge_tab, seeds)
+        ms = timeit(partial(nfa_match, active_slots=16, max_matches=32), a2)
+        print(f"batch={B2:6d}: {ms:7.2f} ms  {B2/ms*1e3/1e6:.2f}M t/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,36 @@ def test_table_survives_torn_tail_write(tmp_path):
     s2.close()
 
 
+def test_wal_kill9_recovers_acked_writes(tmp_path):
+    """Durability bound (VERDICT r2 weak 6): with per-append fsync, every
+    write acknowledged before a SIGKILL must survive recovery."""
+    import subprocess
+    import sys
+
+    prog = (
+        "import os, sys\n"
+        "from emqx_tpu.storage.store import Table\n"
+        "t = Table(sys.argv[1])\n"
+        "for i in range(50):\n"
+        "    t.put(f'k{i}', i)\n"
+        "    print(f'k{i}', flush=True)\n"
+        "    if i == 37:\n"
+        "        os.kill(os.getpid(), 9)\n"
+    )
+    p = subprocess.run(
+        [sys.executable, "-c", prog, str(tmp_path / "tbl")],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    acked = [ln for ln in p.stdout.split() if ln]
+    assert p.returncode != 0 and len(acked) >= 1  # died by SIGKILL
+    from emqx_tpu.storage.store import Table
+
+    t2 = Table(str(tmp_path / "tbl"))
+    for k in acked:
+        assert k in t2, f"acked write {k} lost after kill -9"
+
+
 def test_table_compaction(tmp_path):
     s = Store(str(tmp_path))
     t = s.table("t1")
