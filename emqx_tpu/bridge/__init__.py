@@ -1,0 +1,14 @@
+"""Data bridges: buffered egress/ingress to external systems.
+
+The emqx bridge/connector/resource family (SURVEY.md §2.3) rebuilt on
+asyncio: :mod:`resource` is the buffered-worker backbone,
+:mod:`mqtt_bridge` and :mod:`webhook` are the first two connectors,
+:mod:`manager` wires bridges into rules and REST.
+"""
+
+from .manager import Bridge, BridgeManager
+from .resource import BufferedWorker, Connector, SendError
+
+__all__ = [
+    "Bridge", "BridgeManager", "BufferedWorker", "Connector", "SendError",
+]

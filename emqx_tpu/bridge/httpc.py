@@ -1,0 +1,153 @@
+"""Minimal asyncio HTTP/1.1 client for egress boundaries.
+
+Used by the webhook bridge and the HTTP authn/authz backends.  The
+environment pins the dependency set (no aiohttp/httpx), and the broker
+needs only simple request/response semantics: one request per call,
+`Content-Length` or close-delimited bodies, no TLS verification knobs
+beyond an optional ssl context.
+
+Behavioral reference: the reference reaches HTTP services through its
+pooled ehttpc client (`apps/emqx_connector/src/emqx_connector_http.erl`
+[U]); pooling here is a per-call connection — webhook/auth throughput on
+the broker control path does not justify a pool manager, and the
+buffered bridge worker batches above this layer anyway.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl as ssl_mod
+from typing import Dict, Optional, Tuple
+from urllib.parse import urlsplit
+
+__all__ = ["HttpResponse", "request", "HttpError"]
+
+_MAX_BODY = 8 * 1024 * 1024
+_MAX_HEADER = 64 * 1024
+
+
+class HttpError(Exception):
+    pass
+
+
+class HttpResponse:
+    def __init__(self, status: int, headers: Dict[str, str], body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<HttpResponse {self.status} {len(self.body)}B>"
+
+
+def _parse_url(url: str) -> Tuple[str, str, int, str, bool]:
+    u = urlsplit(url)
+    if u.scheme not in ("http", "https"):
+        raise HttpError(f"unsupported scheme {u.scheme!r}")
+    tls = u.scheme == "https"
+    host = u.hostname or "localhost"
+    port = u.port or (443 if tls else 80)
+    path = u.path or "/"
+    if u.query:
+        path += "?" + u.query
+    return u.scheme, host, port, path, tls
+
+
+def _clean(s: str) -> str:
+    """Strip CR/LF/NUL from header material: values are routinely rendered
+    from message-derived templates (topic/payload may legally contain
+    control bytes), and raw interpolation would be header injection."""
+    return s.replace("\r", "").replace("\n", "").replace("\x00", "")
+
+
+async def request(
+    method: str,
+    url: str,
+    *,
+    headers: Optional[Dict[str, str]] = None,
+    body: bytes = b"",
+    timeout: float = 5.0,
+    ssl: Optional[ssl_mod.SSLContext] = None,
+    verify: bool = True,
+) -> HttpResponse:
+    """One HTTP/1.1 request.  Raises HttpError on malformed responses,
+    asyncio.TimeoutError past the deadline, OSError on connect failure.
+    HTTPS verifies certificates by default; ``verify=False`` (or a custom
+    ``ssl`` context) opts out for self-signed test endpoints."""
+    _, host, port, path, tls = _parse_url(url)
+    if tls and ssl is None:
+        ssl = ssl_mod.create_default_context()
+        if not verify:
+            ssl.check_hostname = False
+            ssl.verify_mode = ssl_mod.CERT_NONE
+
+    async def _go() -> HttpResponse:
+        reader, writer = await asyncio.open_connection(
+            host, port, ssl=ssl if tls else None
+        )
+        try:
+            hdrs = {
+                "host": f"{host}:{port}",
+                "connection": "close",
+                "content-length": str(len(body)),
+            }
+            for k, v in (headers or {}).items():
+                hdrs[_clean(k.lower())] = _clean(v)
+            head = f"{_clean(method.upper())} {_clean(path)} HTTP/1.1\r\n" + "".join(
+                f"{k}: {v}\r\n" for k, v in hdrs.items()
+            )
+            writer.write(head.encode("latin-1") + b"\r\n" + body)
+            await writer.drain()
+
+            status_line = await reader.readline()
+            parts = status_line.decode("latin-1", "replace").split(None, 2)
+            if len(parts) < 2 or not parts[1].isdigit():
+                raise HttpError(f"bad status line {status_line!r}")
+            status = int(parts[1])
+            resp_headers: Dict[str, str] = {}
+            total = 0
+            while True:
+                line = await reader.readline()
+                total += len(line)
+                if total > _MAX_HEADER:
+                    raise HttpError("header block too large")
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode("latin-1", "replace").partition(":")
+                resp_headers[k.strip().lower()] = v.strip()
+
+            te = resp_headers.get("transfer-encoding", "").lower()
+            if "chunked" in te:
+                chunks = []
+                got = 0
+                while True:
+                    size_line = await reader.readline()
+                    try:
+                        size = int(size_line.strip().split(b";")[0], 16)
+                    except ValueError:
+                        raise HttpError(f"bad chunk size {size_line!r}")
+                    if size == 0:
+                        await reader.readline()  # trailing CRLF
+                        break
+                    got += size
+                    if got > _MAX_BODY:
+                        raise HttpError("body too large")
+                    chunks.append(await reader.readexactly(size))
+                    await reader.readexactly(2)  # CRLF
+                data = b"".join(chunks)
+            elif "content-length" in resp_headers:
+                n = int(resp_headers["content-length"])
+                if n > _MAX_BODY:
+                    raise HttpError("body too large")
+                data = await reader.readexactly(n)
+            else:
+                data = await reader.read(_MAX_BODY)
+            return HttpResponse(status, resp_headers, data)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    return await asyncio.wait_for(_go(), timeout)

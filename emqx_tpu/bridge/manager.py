@@ -1,0 +1,202 @@
+"""Bridge registry: CRUD, lifecycle, rule-action resolution.
+
+Behavioral reference: ``apps/emqx_bridge`` [U] (SURVEY.md §2.3) —
+bridges are named ``<type>:<name>`` resources; rules reference them as
+action strings; each bridge owns a buffered worker (emqx_resource
+analog) and exposes status + metrics over REST.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .mqtt_bridge import MqttConnector, render_egress
+from .resource import BufferedWorker, Connector
+from .webhook import WebhookConnector, render_webhook
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Bridge", "BridgeManager"]
+
+_SECRET_KEYS = ("password", "authorization", "secret", "token", "api_key")
+
+
+def _redact(conf: Any) -> Any:
+    """Deep-copy ``conf`` with credential-bearing values masked — the
+    reference redacts sensitive bridge fields in every REST response."""
+    if isinstance(conf, dict):
+        return {
+            k: ("******" if any(s in k.lower() for s in _SECRET_KEYS)
+                else _redact(v))
+            for k, v in conf.items()
+        }
+    if isinstance(conf, list):
+        return [_redact(v) for v in conf]
+    return conf
+
+
+class Bridge:
+    """One configured bridge: connector + buffered worker + renderer."""
+
+    def __init__(
+        self,
+        btype: str,
+        name: str,
+        conf: Dict[str, Any],
+        connector: Connector,
+        renderer: Callable[[Dict, Dict, Dict], Dict[str, Any]],
+    ) -> None:
+        self.type = btype
+        self.name = name
+        self.conf = conf
+        self.enable = bool(conf.get("enable", True))
+        self.connector = connector
+        self.renderer = renderer
+        rconf = conf.get("resource_opts") or {}
+        self.worker = BufferedWorker(
+            connector,
+            name=f"{btype}:{name}",
+            max_queue=int(rconf.get("max_queue", 10_000)),
+            batch_size=int(rconf.get("batch_size", 32)),
+            ttl=rconf.get("ttl"),
+            retry_base=float(rconf.get("retry_base", 0.05)),
+            retry_max=float(rconf.get("retry_max", 5.0)),
+            max_retries=rconf.get("max_retries"),
+            health_interval=float(rconf.get("health_interval", 5.0)),
+        )
+
+    @property
+    def id(self) -> str:
+        return f"{self.type}:{self.name}"
+
+    def forward(self, output: Dict[str, Any], columns: Dict[str, Any]) -> None:
+        """Rule-action entry: render one egress item and buffer it."""
+        if not self.enable:
+            return
+        self.worker.enqueue(self.renderer(self.conf, output, columns))
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "type": self.type,
+            "name": self.name,
+            "enable": self.enable,
+            "status": self.worker.status,
+            "queuing": self.worker.queuing,
+            "metrics": dict(self.worker.metrics),
+            **_redact(self.conf),
+        }
+
+
+class BridgeManager:
+    """All bridges of a node; resolves rule actions ``"<type>:<name>"``."""
+
+    TYPES = ("mqtt", "webhook")
+
+    def __init__(self, node: Any = None) -> None:
+        self.node = node
+        self.bridges: Dict[str, Bridge] = {}
+        if node is not None and getattr(node, "rule_engine", None) is not None:
+            node.rule_engine.bridge_resolver = self.resolve_action
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self, btype: str, name: str, conf: Dict[str, Any]) -> Bridge:
+        if btype == "mqtt":
+            local_publish = None
+            if self.node is not None:
+                def local_publish(topic, payload, qos=0, retain=False):
+                    from ..broker.message import make_message
+
+                    self.node.broker.publish(make_message(
+                        f"bridge:{name}", topic, payload,
+                        qos=qos, retain=retain,
+                    ))
+            conn = MqttConnector(conf, local_publish=local_publish, name=name)
+            return Bridge(btype, name, conf, conn, render_egress)
+        if btype == "webhook":
+            return Bridge(btype, name, conf, WebhookConnector(conf, name),
+                          render_webhook)
+        raise ValueError(f"unknown bridge type {btype!r}")
+
+    # -- CRUD --------------------------------------------------------------
+
+    def register(self, btype: str, name: str, conf: Dict[str, Any]) -> Bridge:
+        """Synchronous create without starting the worker: enqueue works
+        immediately (the buffer is plain host state); the caller starts
+        the worker when a loop is available.  Used by data import."""
+        bid = f"{btype}:{name}"
+        if bid in self.bridges:
+            raise ValueError(f"bridge {bid} exists")
+        br = self._build(btype, name, conf)
+        self.bridges[bid] = br
+        return br
+
+    async def create(self, btype: str, name: str, conf: Dict[str, Any]) -> Bridge:
+        br = self.register(btype, name, conf)
+        if br.enable:
+            await br.worker.start()
+        return br
+
+    async def update(self, bid: str, conf: Dict[str, Any]) -> Bridge:
+        old = self.bridges[bid]
+        btype, _, name = bid.partition(":")
+        # build (and thereby validate) the replacement BEFORE touching the
+        # running bridge: a bad conf leaves the old bridge untouched
+        br = self._build(btype, name, conf)
+        await old.worker.stop()
+        # migrate the buffered backlog (original enqueue stamps) so an
+        # update while the remote is down doesn't drop the window
+        br.worker._q.extend(old.worker._q)
+        old.worker._q.clear()
+        self.bridges[bid] = br
+        if br.enable:
+            await br.worker.start()
+        return br
+
+    async def delete(self, bid: str) -> bool:
+        br = self.bridges.pop(bid, None)
+        if br is None:
+            return False
+        await br.worker.stop()
+        return True
+
+    async def set_enable(self, bid: str, enable: bool) -> None:
+        br = self.bridges[bid]
+        br.enable = enable
+        br.conf["enable"] = enable
+        if enable and br.worker.status == "stopped":
+            await br.worker.start()
+        elif not enable:
+            await br.worker.stop()
+
+    def get(self, bid: str) -> Optional[Bridge]:
+        return self.bridges.get(bid)
+
+    def list(self) -> List[Bridge]:
+        return list(self.bridges.values())
+
+    async def stop_all(self) -> None:
+        for br in self.bridges.values():
+            await br.worker.stop()
+
+    # -- rule-engine boundary ----------------------------------------------
+
+    def resolve_action(self, action: str) -> Optional[Callable]:
+        """Map a rule action string ``"<type>:<name>"`` to a forwarder."""
+        br = self.bridges.get(action)
+        if br is None:
+            return None
+        return br.forward
+
+    # -- persistence (data export/import) ----------------------------------
+
+    def export_config(self) -> List[Dict[str, Any]]:
+        """Serializable bridge set; the restore side lives in
+        ``storage/backup.py`` (register-or-skip with deferred worker
+        start — one restore path, not two)."""
+        return [
+            {"type": b.type, "name": b.name, "conf": dict(b.conf)}
+            for b in self.bridges.values()
+        ]

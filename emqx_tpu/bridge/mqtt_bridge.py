@@ -1,0 +1,158 @@
+"""MQTT data bridge: egress (local → remote broker) and ingress
+(remote → local), over the repo's own MQTT client.
+
+Behavioral reference: ``apps/emqx_bridge_mqtt`` [U] (SURVEY.md §2.3) —
+a bridge holds one outbound MQTT connection; egress renders
+topic/payload/qos templates per forwarded message; ingress subscribes on
+the remote and republishes into the local broker with a topic mapping.
+Reconnect/backoff/buffering live in the BufferedWorker around this
+connector.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..client import Client, InboundMessage, MqttError
+from ..rule_engine.runtime import render_template
+from .resource import Connector, SendError
+
+log = logging.getLogger(__name__)
+
+__all__ = ["MqttConnector", "render_egress"]
+
+
+def render_egress(
+    conf: Dict[str, Any], output: Dict[str, Any], columns: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Render one egress publish from rule output + event columns."""
+    topic = render_template(conf.get("remote_topic", "${topic}"),
+                            output, columns)
+    payload = render_template(conf.get("payload", "${payload}"),
+                              output, columns)
+    return {
+        "topic": topic,
+        "payload": payload.encode() if isinstance(payload, str) else payload,
+        "qos": int(conf.get("remote_qos", 0)),
+        "retain": bool(conf.get("retain", False)),
+    }
+
+
+class MqttConnector(Connector):
+    """One remote-broker MQTT connection shared by egress and ingress.
+
+    ``conf`` keys: server ("host:port"), clientid, username, password,
+    proto_ver, keepalive, ingress: {remote_topic, remote_qos, local_topic,
+    local_qos, payload} — ingress messages republish into ``local_publish``.
+    """
+
+    def __init__(
+        self,
+        conf: Dict[str, Any],
+        local_publish: Optional[Any] = None,
+        name: str = "mqtt",
+    ) -> None:
+        self.conf = conf
+        self.name = name
+        self.local_publish = local_publish
+        self.client: Optional[Client] = None
+        self._lock = asyncio.Lock()
+
+    def _make_client(self) -> Client:
+        server = self.conf.get("server", "127.0.0.1:1883")
+        host, _, port = server.partition(":")
+        ingress = self.conf.get("ingress")
+        on_message = self._on_ingress if ingress else None
+        return Client(
+            clientid=self.conf.get("clientid", f"bridge-{self.name}"),
+            host=host or "127.0.0.1",
+            port=int(port or 1883),
+            proto_ver=int(self.conf.get("proto_ver", 4)),
+            username=self.conf.get("username"),
+            password=(
+                self.conf["password"].encode()
+                if isinstance(self.conf.get("password"), str)
+                else self.conf.get("password")
+            ),
+            keepalive=int(self.conf.get("keepalive", 60)),
+            clean_start=bool(self.conf.get("clean_start", True)),
+            on_message=on_message,
+        )
+
+    async def start(self) -> None:
+        async with self._lock:
+            if self.client is not None and self.client.connected:
+                return
+            if self.client is not None:
+                await self.client.close()
+            self.client = self._make_client()
+            await self.client.connect(timeout=float(
+                self.conf.get("connect_timeout", 5.0)))
+            ingress = self.conf.get("ingress")
+            if ingress:
+                await self.client.subscribe(
+                    ingress.get("remote_topic", "#"),
+                    qos=int(ingress.get("remote_qos", 0)),
+                )
+
+    async def stop(self) -> None:
+        async with self._lock:
+            if self.client is not None:
+                await self.client.close()
+                self.client = None
+
+    async def health(self) -> bool:
+        return self.client is not None and self.client.connected
+
+    async def send(self, items: List[Dict[str, Any]]) -> None:
+        cl = self.client
+        if cl is None or not cl.connected:
+            # the worker retries after start() succeeds via health loop —
+            # but try an inline reconnect first so a bounced remote heals
+            # on the next batch, not the next health tick
+            try:
+                await self.start()
+                cl = self.client
+            except Exception as e:
+                raise SendError(f"mqtt bridge not connected: {e}") from e
+        assert cl is not None
+        for i, it in enumerate(items):
+            try:
+                await cl.publish(
+                    it["topic"], it["payload"],
+                    qos=it.get("qos", 0), retain=it.get("retain", False),
+                )
+            except (MqttError, OSError, asyncio.TimeoutError) as e:
+                # delivered prefix stays delivered; worker resumes at i
+                raise SendError(f"mqtt publish failed: {e}", done=i) from e
+
+    # -- ingress -----------------------------------------------------------
+
+    def _on_ingress(self, msg: InboundMessage) -> None:
+        if self.local_publish is None:
+            return
+        ingress = self.conf.get("ingress") or {}
+        cols = {
+            "topic": msg.topic,
+            "payload": msg.payload,
+            "qos": msg.qos,
+            "retain": msg.retain,
+        }
+        topic = render_template(
+            ingress.get("local_topic", "${topic}"), cols, cols
+        )
+        payload_t = ingress.get("payload")
+        payload = (
+            render_template(payload_t, cols, cols).encode()
+            if payload_t else msg.payload
+        )
+        try:
+            self.local_publish(
+                topic, payload,
+                qos=int(ingress.get("local_qos", msg.qos)),
+                retain=bool(ingress.get("retain", msg.retain)),
+            )
+        except Exception:
+            log.exception("bridge %s ingress publish failed", self.name)

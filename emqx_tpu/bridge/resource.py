@@ -1,0 +1,244 @@
+"""Buffered resource workers: the egress backbone of every data bridge.
+
+Behavioral reference: ``apps/emqx_resource`` [U] (SURVEY.md §2.3) — each
+bridge owns a buffer worker that absorbs bursts, batches egress, retries
+with backoff while the remote is down, and exposes health + metrics.
+The reference runs a pool of buffer workers per resource; here one
+asyncio worker per resource suffices (no scheduler contention to spread;
+the event loop interleaves).
+
+Delivery semantics: at-least-once into the remote while the buffer
+holds; oldest messages drop first on overflow (``max_queue``), and
+expired messages (``ttl``) drop at dequeue — both counted, mirroring the
+reference's ``dropped.queue_full`` / ``dropped.expired`` metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Connector", "SendError", "BufferedWorker"]
+
+
+class SendError(Exception):
+    """Raised by a connector when a send fails.  ``retryable=False``
+    drops the remaining batch (counted failed) instead of retrying it.
+    ``done`` reports how many leading items WERE delivered before the
+    failure, so the worker neither re-sends them (duplicates) nor counts
+    them failed."""
+
+    def __init__(self, msg: str, retryable: bool = True, done: int = 0):
+        super().__init__(msg)
+        self.retryable = retryable
+        self.done = done
+
+
+class Connector:
+    """Connector contract: owns the remote connection.
+
+    Lifecycle: ``start`` → (``send`` | ``health``)* → ``stop``.  ``send``
+    raises :class:`SendError` (or any exception, treated retryable) on
+    failure; the worker handles backoff and re-delivery.
+    """
+
+    async def start(self) -> None:  # pragma: no cover - interface
+        pass
+
+    async def stop(self) -> None:  # pragma: no cover - interface
+        pass
+
+    async def health(self) -> bool:
+        return True
+
+    async def send(self, items: List[Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class BufferedWorker:
+    """One buffering/retry/health loop wrapped around a Connector."""
+
+    def __init__(
+        self,
+        connector: Connector,
+        *,
+        name: str = "resource",
+        max_queue: int = 10_000,
+        batch_size: int = 32,
+        ttl: Optional[float] = None,
+        retry_base: float = 0.05,
+        retry_max: float = 5.0,
+        max_retries: Optional[int] = None,
+        health_interval: float = 5.0,
+    ) -> None:
+        self.connector = connector
+        self.name = name
+        self.max_queue = max_queue
+        self.batch_size = batch_size
+        self.ttl = ttl
+        self.retry_base = retry_base
+        self.retry_max = retry_max
+        self.max_retries = max_retries
+        self.health_interval = health_interval
+
+        self.status = "stopped"  # stopped|connecting|connected|disconnected
+        self.metrics: Dict[str, int] = {
+            "matched": 0, "success": 0, "failed": 0, "retried": 0,
+            "dropped": 0, "dropped.queue_full": 0, "dropped.expired": 0,
+        }
+        self._q: Deque[Tuple[float, Any]] = deque()
+        self._wakeup = asyncio.Event()
+        self._tasks: List[asyncio.Task] = []
+        self._stopping = False
+
+    # -- producer side -----------------------------------------------------
+
+    def enqueue(self, item: Any) -> bool:
+        """Queue one item for egress; drops the OLDEST on overflow so the
+        buffer always holds the freshest window (reference drop policy)."""
+        self.metrics["matched"] += 1
+        if len(self._q) >= self.max_queue:
+            self._q.popleft()
+            self.metrics["dropped"] += 1
+            self.metrics["dropped.queue_full"] += 1
+        self._q.append((time.monotonic(), item))
+        self._wakeup.set()
+        return True
+
+    @property
+    def queuing(self) -> int:
+        return len(self._q)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._tasks:
+            return
+        self._stopping = False
+        self.status = "connecting"
+        try:
+            await self.connector.start()
+            self.status = "connected"
+        except Exception as e:
+            log.warning("resource %s connect failed: %s", self.name, e)
+            self.status = "disconnected"
+        self._tasks = [
+            asyncio.create_task(self._run(), name=f"bridge-{self.name}"),
+            asyncio.create_task(
+                self._health_loop(), name=f"bridge-{self.name}-health"
+            ),
+        ]
+
+    async def stop(self) -> None:
+        self._stopping = True
+        self._wakeup.set()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks = []
+        try:
+            await self.connector.stop()
+        except Exception:
+            pass
+        self.status = "stopped"
+
+    # -- worker loop -------------------------------------------------------
+
+    def _take_batch(self) -> List[Tuple[float, Any]]:
+        now = time.monotonic()
+        batch: List[Tuple[float, Any]] = []
+        while self._q and len(batch) < self.batch_size:
+            ts, item = self._q[0]
+            if self.ttl is not None and now - ts > self.ttl:
+                self._q.popleft()
+                self.metrics["dropped"] += 1
+                self.metrics["dropped.expired"] += 1
+                continue
+            self._q.popleft()
+            batch.append((ts, item))
+        return batch
+
+    def _requeue(self, batch: List[Tuple[float, Any]]) -> None:
+        # failed batch returns to the FRONT (order-preserving redelivery)
+        # with ORIGINAL enqueue stamps, so the ttl clock keeps running
+        # across retries and old messages still expire while the remote
+        # is down
+        for entry in reversed(batch):
+            self._q.appendleft(entry)
+
+    async def _run(self) -> None:
+        backoff = self.retry_base
+        retries = 0
+        while not self._stopping:
+            if not self._q:
+                self._wakeup.clear()
+                await self._wakeup.wait()
+                continue
+            batch = self._take_batch()
+            if not batch:
+                continue
+            try:
+                await self.connector.send([item for _, item in batch])
+                self.metrics["success"] += len(batch)
+                backoff = self.retry_base
+                retries = 0
+                if self.status != "connected":
+                    self.status = "connected"
+            except Exception as e:
+                retryable = getattr(e, "retryable", True)
+                done = min(getattr(e, "done", 0), len(batch))
+                if done:
+                    self.metrics["success"] += done
+                    batch = batch[done:]
+                if retryable and (
+                    self.max_retries is None or retries < self.max_retries
+                ):
+                    self._requeue(batch)
+                    self.metrics["retried"] += len(batch)
+                    retries += 1
+                    self.status = "disconnected"
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, self.retry_max)
+                else:
+                    self.metrics["failed"] += len(batch)
+                    retries = 0
+                    log.warning(
+                        "resource %s dropped batch of %d: %s",
+                        self.name, len(batch), e,
+                    )
+
+    async def _health_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(self.health_interval)
+            try:
+                ok = await self.connector.health()
+            except Exception:
+                ok = False
+            if ok:
+                if self.status == "disconnected":
+                    self.status = "connected"
+            else:
+                if self.status == "connected":
+                    self.status = "disconnected"
+                # nudge a reconnect; connectors make start() idempotent
+                try:
+                    await self.connector.start()
+                except Exception:
+                    pass
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "status": self.status,
+            "queuing": self.queuing,
+            "metrics": dict(self.metrics),
+        }

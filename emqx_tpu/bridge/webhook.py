@@ -1,0 +1,93 @@
+"""HTTP webhook bridge: rule/event egress to an HTTP endpoint.
+
+Behavioral reference: ``apps/emqx_bridge_http`` [U] (SURVEY.md §2.3) —
+each forwarded event renders url/headers/body templates and issues one
+HTTP request; 2xx is success, 429/5xx and transport errors are
+retryable, other 4xx drop the item (the request is wrong, retrying
+can't fix it).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Dict, List
+
+from ..rule_engine.runtime import render_template
+from . import httpc
+from .resource import Connector, SendError
+
+log = logging.getLogger(__name__)
+
+__all__ = ["WebhookConnector", "render_webhook"]
+
+
+def render_webhook(
+    conf: Dict[str, Any], output: Dict[str, Any], columns: Dict[str, Any]
+) -> Dict[str, Any]:
+    """Render one webhook request from rule output + event columns."""
+    body_tpl = conf.get("body")
+    if body_tpl:
+        body = render_template(body_tpl, output, columns).encode()
+    else:
+        def enc(v: Any) -> Any:
+            if isinstance(v, bytes):
+                return v.decode("utf-8", "replace")
+            return v
+        body = json.dumps(
+            {k: enc(v) for k, v in output.items()}, default=str
+        ).encode()
+    headers = {
+        k: render_template(str(v), output, columns)
+        for k, v in (conf.get("headers") or {}).items()
+    }
+    headers.setdefault("content-type", "application/json")
+    return {
+        "url": render_template(conf.get("url", ""), output, columns),
+        "method": conf.get("method", "POST"),
+        "headers": headers,
+        "body": body,
+    }
+
+
+class WebhookConnector(Connector):
+    def __init__(self, conf: Dict[str, Any], name: str = "webhook") -> None:
+        self.conf = conf
+        self.name = name
+
+    async def health(self) -> bool:
+        # a webhook has no session to probe; health is per-request
+        return True
+
+    async def send(self, items: List[Dict[str, Any]]) -> None:
+        """Per-item delivery.  Transport errors and 5xx/429 raise
+        retryable with ``done`` set so the worker resumes from the failed
+        item; other 4xx reject only THAT item (the request itself is
+        wrong — retrying can't fix it) and the rest of the batch is still
+        attempted, with the reject count raised non-retryably at the end
+        for the worker's failed metric."""
+        timeout = float(self.conf.get("request_timeout", 5.0))
+        verify = bool(self.conf.get("ssl_verify", True))
+        rejected = 0
+        for i, it in enumerate(items):
+            try:
+                resp = await httpc.request(
+                    it.get("method", "POST"),
+                    it["url"],
+                    headers=it.get("headers"),
+                    body=it.get("body", b""),
+                    timeout=timeout,
+                    verify=verify,
+                )
+            except (OSError, httpc.HttpError, TimeoutError) as e:
+                raise SendError(f"webhook request failed: {e}",
+                                done=i) from e
+            if resp.status >= 500 or resp.status == 429:
+                raise SendError(f"webhook HTTP {resp.status}", done=i)
+            if resp.status >= 300:
+                log.warning("webhook %s rejected item: HTTP %d",
+                            self.name, resp.status)
+                rejected += 1
+        if rejected:
+            raise SendError(f"webhook rejected {rejected} items",
+                            retryable=False, done=len(items) - rejected)

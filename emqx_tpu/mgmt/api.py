@@ -83,6 +83,13 @@ class MgmtApi:
         r("GET", f"{v}/rules/{{rule_id}}", self.rules_one)
         r("PUT", f"{v}/rules/{{rule_id}}", self.rules_update)
         r("DELETE", f"{v}/rules/{{rule_id}}", self.rules_delete)
+        r("GET", f"{v}/bridges", self.bridges_list)
+        r("POST", f"{v}/bridges", self.bridges_create)
+        r("GET", f"{v}/bridges/{{bridge_id}}", self.bridges_one)
+        r("PUT", f"{v}/bridges/{{bridge_id}}", self.bridges_update)
+        r("DELETE", f"{v}/bridges/{{bridge_id}}", self.bridges_delete)
+        r("POST", f"{v}/bridges/{{bridge_id}}/enable/{{enable}}",
+          self.bridges_enable)
         r("GET", f"{v}/cluster", self.cluster)
         r("GET", f"{v}/exhooks", self.exhooks)
         r("GET", f"{v}/configs", self.configs_get)
@@ -441,6 +448,60 @@ class MgmtApi:
     async def rules_delete(self, req: Request) -> Response:
         if not self.node.rule_engine.delete_rule(req.params["rule_id"]):
             raise KeyError(req.params["rule_id"])
+        return Response(204)
+
+    # ------------------------------------------------------------------
+    # bridges (emqx_bridge REST analog)
+    # ------------------------------------------------------------------
+
+    async def bridges_list(self, req: Request) -> Response:
+        return json_response(_paginate(
+            req, [b.info() for b in self.node.bridges.list()]
+        ))
+
+    async def bridges_create(self, req: Request) -> Response:
+        body = req.json() or {}
+        btype, name = body.get("type"), body.get("name")
+        if not btype or not name:
+            raise ValueError("type and name required")
+        try:
+            br = await self.node.bridges.create(
+                btype, name, body.get("conf") or body
+            )
+        except ValueError as e:
+            if "exists" in str(e):
+                return json_response(
+                    {"code": "ALREADY_EXISTS", "message": str(e)}, 409
+                )
+            raise
+        return json_response(br.info(), 201)
+
+    async def bridges_one(self, req: Request) -> Response:
+        br = self.node.bridges.get(req.params["bridge_id"])
+        if br is None:
+            raise KeyError(req.params["bridge_id"])
+        return json_response(br.info())
+
+    async def bridges_update(self, req: Request) -> Response:
+        bid = req.params["bridge_id"]
+        if self.node.bridges.get(bid) is None:
+            raise KeyError(bid)
+        body = req.json() or {}
+        br = await self.node.bridges.update(bid, body.get("conf") or body)
+        return json_response(br.info())
+
+    async def bridges_delete(self, req: Request) -> Response:
+        if not await self.node.bridges.delete(req.params["bridge_id"]):
+            raise KeyError(req.params["bridge_id"])
+        return Response(204)
+
+    async def bridges_enable(self, req: Request) -> Response:
+        bid = req.params["bridge_id"]
+        if self.node.bridges.get(bid) is None:
+            raise KeyError(bid)
+        await self.node.bridges.set_enable(
+            bid, req.params["enable"] in ("true", "1")
+        )
         return Response(204)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,239 @@
+"""ctypes wrapper for the native incremental NFA (``nfa.cpp``).
+
+``NativeNfa`` mirrors the mutation/drain surface of
+:class:`emqx_tpu.ops.incremental.IncrementalNfa` (the semantics oracle;
+property-tested equivalent in tests/test_native_nfa.py) at 10M-filter
+scale: bulk build in seconds, O(filter) add/remove, dirty-row delta
+drain for the device twin, host-side authoritative match for fail-open.
+
+Falls back to ``None`` when the toolchain can't build the .so — callers
+use the Python IncrementalNfa below ~1M filters.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .build import load_library
+
+__all__ = ["NativeNfa", "available"]
+
+_lib = None
+_checked = False
+
+
+def _load():
+    global _lib, _checked
+    if _checked:
+        return _lib
+    _checked = True
+    lib = load_library("nfa")
+    if lib is None:
+        return None
+    lib.nfa_new.restype = ctypes.c_void_p
+    lib.nfa_new.argtypes = [ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                            ctypes.c_uint64]
+    lib.nfa_free.argtypes = [ctypes.c_void_p]
+    lib.nfa_add.restype = ctypes.c_int32
+    lib.nfa_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+    lib.nfa_remove.restype = ctypes.c_int32
+    lib.nfa_remove.argtypes = lib.nfa_add.argtypes
+    lib.nfa_bulk_add.restype = ctypes.c_int64
+    lib.nfa_bulk_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int64]
+    lib.nfa_aid_of.restype = ctypes.c_int32
+    lib.nfa_aid_of.argtypes = lib.nfa_add.argtypes
+    lib.nfa_match_topic.restype = ctypes.c_int32
+    lib.nfa_match_topic.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    lib.nfa_sizes.argtypes = [ctypes.c_void_p,
+                              ctypes.POINTER(ctypes.c_int64)]
+    lib.nfa_fill_tables.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.nfa_vocab_fill.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.nfa_accept_get.restype = ctypes.c_int32
+    lib.nfa_accept_get.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                   ctypes.c_char_p, ctypes.c_int32]
+    lib.nfa_set_device_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.nfa_delta_sizes.argtypes = lib.nfa_sizes.argtypes
+    lib.nfa_delta_fill.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeNfa:
+    """Handle-owning wrapper; see module docstring."""
+
+    def __init__(self, depth: int = 8, state_bucket: int = 1024,
+                 edge_bucket: int = 64, seed: int = 0xE709) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native nfa library unavailable")
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.nfa_new(depth, state_bucket,
+                                              edge_bucket, seed))
+        self.depth = depth
+        # live vocab view: same dict OBJECT updated in place (append-only,
+        # id order) so encode_batch's per-table encoder cache and its
+        # push-incremental interning both work unchanged
+        self._vocab: Dict[str, int] = {}
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.nfa_free(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- mutation ----------------------------------------------------------
+
+    def add(self, flt: str) -> bool:
+        b = flt.encode()
+        r = self._lib.nfa_add(self._h, b, len(b))
+        if r < 0:
+            raise ValueError(
+                f"filter {flt!r} invalid (deeper than table depth, or "
+                "'#' not in final position)"
+            )
+        return bool(r)
+
+    def remove(self, flt: str) -> bool:
+        b = flt.encode()
+        return bool(self._lib.nfa_remove(self._h, b, len(b)))
+
+    def bulk_add(self, filters: Sequence[str]) -> int:
+        """Add many filters in one native call (the 10M-scale build path)."""
+        blob = "\n".join(filters).encode()
+        return int(self._lib.nfa_bulk_add(self._h, blob, len(blob)))
+
+    # -- introspection -----------------------------------------------------
+
+    def _sizes(self) -> np.ndarray:
+        out = np.zeros(11, np.int64)
+        self._lib.nfa_sizes(self._h, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)))
+        return out
+
+    @property
+    def n_filters(self) -> int:
+        return int(self._sizes()[5])
+
+    @property
+    def n_states(self) -> int:
+        return int(self._sizes()[2])
+
+    @property
+    def epoch(self) -> int:
+        return int(self._sizes()[8])
+
+    @property
+    def aid_reuses(self) -> int:
+        return int(self._sizes()[10])
+
+    def shape_key(self) -> Tuple[int, int, int]:
+        s = self._sizes()
+        return (int(s[0]), int(s[1]), self.depth)
+
+    def memory_bytes(self) -> Dict[str, int]:
+        """Device-array footprint (the HBM math for BASELINE.md)."""
+        s = self._sizes()
+        return {
+            "node_tab": int(s[0]) * 4 * 4,
+            "edge_tab": int(s[1]) * 16 * 4,
+            "n_states": int(s[2]),
+            "n_edges": int(s[3]),
+        }
+
+    # -- table export ------------------------------------------------------
+
+    def tables(self):
+        """Current arrays in kernel order: (node_tab, edge_tab, seeds)."""
+        s = self._sizes()
+        node_tab = np.empty((int(s[0]), 4), np.int32)
+        edge_tab = np.empty((int(s[1]), 16), np.int32)
+        seeds = np.empty(2, np.int32)
+        self._lib.nfa_fill_tables(self._h, _i32p(node_tab), _i32p(edge_tab),
+                                  _i32p(seeds))
+        return node_tab, edge_tab, seeds
+
+    @property
+    def vocab(self) -> Dict[str, int]:
+        """Word → id map (id 0 reserved UNKNOWN).  The native vocab is
+        append-only; this refreshes the SAME dict in place when it grew."""
+        s = self._sizes()
+        n = int(s[6])
+        if len(self._vocab) != n:
+            buf = ctypes.create_string_buffer(int(s[7]) + 1)
+            self._lib.nfa_vocab_fill(self._h, buf)
+            words = buf.raw[: max(0, int(s[7]) - 1)].decode().split("\n")
+            for i in range(len(self._vocab), n):
+                self._vocab[words[i]] = i + 1
+        return self._vocab
+
+    def accept_get(self, aid: int) -> Optional[str]:
+        buf = ctypes.create_string_buffer(1024)
+        n = self._lib.nfa_accept_get(self._h, aid, buf, 1024)
+        return buf.raw[:n].decode() if n >= 0 else None
+
+    def aid_of(self, flt: str) -> int:
+        b = flt.encode()
+        return int(self._lib.nfa_aid_of(self._h, b, len(b)))
+
+    def match_host(self, topic: str, cap: int = 4096) -> List[int]:
+        b = topic.encode()
+        out = np.empty(cap, np.int32)
+        n = self._lib.nfa_match_topic(self._h, b, len(b), _i32p(out), cap)
+        if n > cap:  # extremely wide match: retry with exact size
+            out = np.empty(n, np.int32)
+            n = self._lib.nfa_match_topic(self._h, b, len(b), _i32p(out), n)
+        return out[:n].tolist()
+
+    # -- device delta feed -------------------------------------------------
+
+    def set_device_epoch(self, epoch: int) -> None:
+        self._lib.nfa_set_device_epoch(self._h, epoch)
+
+    def flush(self):
+        """Drain dirty rows as an ``NfaDelta`` (same contract as the
+        Python IncrementalNfa.flush: after a resize the consumer must
+        re-upload full tables)."""
+        from ..ops.incremental import NfaDelta
+
+        hdr = np.zeros(4, np.int64)
+        self._lib.nfa_delta_sizes(self._h, hdr.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)))
+        ns, nb, resized, epoch = (int(x) for x in hdr)
+        state_idx = np.empty(ns, np.int32)
+        state_rows = np.empty((ns, 4), np.int32)
+        bucket_idx = np.empty(nb, np.int32)
+        bucket_rows = np.empty((nb, 16), np.int32)
+        self._lib.nfa_delta_fill(self._h, _i32p(state_idx), _i32p(state_rows),
+                                 _i32p(bucket_idx), _i32p(bucket_rows))
+        return NfaDelta(
+            epoch=epoch, resized=bool(resized),
+            state_idx=state_idx, state_rows=state_rows,
+            bucket_idx=bucket_idx, bucket_rows=bucket_rows,
+        )

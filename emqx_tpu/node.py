@@ -94,6 +94,9 @@ class BrokerNode:
         self.auto_subscribe = AutoSubscribe()
         self.auto_subscribe.attach(self.broker)
         self.rule_engine = RuleEngine(self.broker)
+        from .bridge import BridgeManager
+
+        self.bridges = BridgeManager(self)
         self.access_control = None
         if auth_chain is not None or authz is not None:
             self.access_control = attach_auth(
@@ -462,6 +465,7 @@ class BrokerNode:
 
     async def stop(self) -> None:
         self._running = False
+        await self.bridges.stop_all()
         if self.match_service is not None:
             await self.match_service.stop()
             self.broker.device_match = None

@@ -80,6 +80,9 @@ class RuleEngine:
         self.rules: Dict[str, Rule] = {}
         self.broker = broker
         self._epoch = 0   # bumps on any rule change (device mirror key)
+        # "<type>:<name>" action strings resolve through this (set by
+        # BridgeManager); unresolved strings count as failed actions
+        self.bridge_resolver: Optional[Callable[[str], Optional[Callable]]] = None
         self.max_republish_depth = max_republish_depth
         self._pub_depth = 0
         self._match_service = None  # device co-batching (attach below)
@@ -218,6 +221,14 @@ class RuleEngine:
                     )
                 elif isinstance(action, dict) and action.get("function") == "console":
                     print(f"[rule {rule.id}] {output}")
+                elif isinstance(action, str):
+                    fn = (
+                        self.bridge_resolver(action)
+                        if self.bridge_resolver is not None else None
+                    )
+                    if fn is None:
+                        raise ValueError(f"unknown bridge action {action!r}")
+                    fn(output, columns)
                 elif callable(action):
                     action(output, columns)
                 else:

@@ -9,6 +9,7 @@ import merges into the running node.
 
 from __future__ import annotations
 
+import asyncio
 import io
 import json
 import tarfile
@@ -45,9 +46,14 @@ def _collect(node: Any) -> Dict[str, Any]:
         "rules": [
             {"id": r.id, "sql": r.sql, "enable": r.enable,
              "description": r.description,
-             "actions": [a for a in r.actions if isinstance(a, dict)]}
+             # dict actions (republish/console) and string bridge refs
+             # both round-trip; only bare callables are non-serializable
+             "actions": [a for a in r.actions
+                         if isinstance(a, (dict, str))]}
             for r in node.rule_engine.rules.values()
         ],
+        "bridges": node.bridges.export_config()
+        if getattr(node, "bridges", None) is not None else [],
     }
     if node.retainer is not None:
         docs["retained"] = [
@@ -120,6 +126,21 @@ def import_data(node: Any, archive: bytes) -> Dict[str, int]:
                 max(0.0, float(dd["fire_at"]) - now),
             )
             counts["delayed"] += 1
+    # bridges restore BEFORE rules so restored rule actions resolve; the
+    # workers start asynchronously (enqueue buffers until then)
+    if getattr(node, "bridges", None) is not None:
+        counts["bridges"] = 0
+        for it in docs.get("bridges", []):
+            bid = f"{it['type']}:{it['name']}"
+            if node.bridges.get(bid) is None:
+                br = node.bridges.register(it["type"], it["name"], it["conf"])
+                if br.enable:
+                    try:
+                        asyncio.get_running_loop()
+                        asyncio.ensure_future(br.worker.start())
+                    except RuntimeError:
+                        pass  # no loop (sync restore path); started later
+                counts["bridges"] += 1
     for rd in docs.get("rules", []):
         if rd["id"] not in node.rule_engine.rules:
             node.rule_engine.create_rule(

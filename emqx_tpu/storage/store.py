@@ -112,7 +112,10 @@ class Table:
                            separators=(",", ":")) + "\n")
             self._wal_records += 1
         for k in dels or ():
-            if self._data.pop(k, None) is not None:
+            # key-membership, not value truthiness: a stored None value
+            # must still produce a del record or it resurrects on replay
+            if k in self._data:
+                del self._data[k]
                 self._wal.write(
                     json.dumps({"op": "del", "k": k},
                                separators=(",", ":")) + "\n")

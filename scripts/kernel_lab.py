@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Kernel layout lab: candidate nfa_match v2 designs, timed on the real
+chip against the shipping kernel at the bench shape.
+
+Variants (cumulative where it makes sense):
+  base      — shipping nfa_match (2-choice cuckoo, per-step top_k)
+  sh        — single-hash wide-bucket edge table (1 gather/step, 16 or 32
+              slots/bucket, 0.5 load target)
+  cc        — cumsum-compaction of the active set instead of top_k
+  fc        — cumsum-compaction of the final accept list instead of top_k
+  all       — sh + cc + fc
+Sweeps A ∈ {8, 16} for the winners.
+"""
+import argparse
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def force_time(fn, args, iters=20):
+    r = fn(*args)
+    jax.tree_util.tree_map(np.asarray, r)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        rs = [fn(*args) for _ in range(iters)]
+        np.asarray(jax.tree_util.tree_leaves(rs[-1])[0])
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best * 1e3
+
+
+# --- single-hash wide-bucket edge table --------------------------------------
+
+def build_single_hash(edges, slots_per_bucket=16, seed=7, target_load=0.5):
+    """Place (state, word, next) into a 1-choice table of wide buckets.
+    Returns (tab (Hb, slots*4) int32, seed int32). Grows until no bucket
+    overflows."""
+    from emqx_tpu.ops.compiler import _bucket
+
+    n = len(edges)
+    Hb = _bucket(max(1, int(n / (slots_per_bucket * target_load))), 8)
+    rng = np.random.default_rng(seed)
+    arr = np.asarray(edges, np.int64)
+    while True:
+        sd = np.uint32(rng.integers(1, 2**31 - 1))
+        mask = np.uint32(Hb - 1)
+        with np.errstate(over="ignore"):
+            h = (
+                arr[:, 0].astype(np.uint32) * np.uint32(2654435761)
+                + arr[:, 1].astype(np.uint32) * np.uint32(2246822519)
+                + sd
+            )
+            h ^= h >> np.uint32(16)
+            h *= np.uint32(3266489917)
+            h ^= h >> np.uint32(13)
+            b = (h & mask).astype(np.int64)
+        order = np.argsort(b, kind="stable")
+        bs = b[order]
+        # rank within bucket
+        uniq, start, counts = np.unique(bs, return_index=True, return_counts=True)
+        if counts.max() > slots_per_bucket:
+            Hb <<= 1
+            continue
+        rank = np.arange(len(bs)) - np.repeat(start, counts)
+        tab = np.full((Hb, slots_per_bucket, 4), -1, np.int32)
+        e = arr[order]
+        tab[bs, rank, 0] = e[:, 0]
+        tab[bs, rank, 1] = e[:, 1]
+        tab[bs, rank, 2] = e[:, 2]
+        return tab.reshape(Hb, slots_per_bucket * 4), np.int32(sd)
+
+
+def sh_lookup(state, word, tab, seed, slots):
+    Hb = tab.shape[0]
+    mask = Hb - 1
+    B, A = state.shape
+    h = (
+        state.astype(jnp.uint32) * jnp.uint32(2654435761)
+        + word.astype(jnp.uint32) * jnp.uint32(2246822519)
+        + seed.astype(jnp.uint32)
+    )
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(3266489917)
+    h = h ^ (h >> jnp.uint32(13))
+    b = (h & jnp.uint32(mask)).astype(jnp.int32)
+    rows = tab[b].reshape(B, A, slots, 4)
+    hit = (rows[..., 0] == state[..., None]) & (rows[..., 1] == word[..., None])
+    return jnp.max(jnp.where(hit, rows[..., 2], -1), axis=-1)
+
+
+def compact_cc(cand, A):
+    """Valids-first compaction via cumsum + compare-scatter (no sort)."""
+    valid = cand >= 0
+    pos = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    pos = jnp.where(valid, pos, A)
+    onehot = pos[..., None] == jnp.arange(A)[None, None, :]
+    return jnp.max(jnp.where(onehot, cand[..., None], -1), axis=1)
+
+
+def make_variant(D, use_sh, use_cc, use_fc, A, K, slots):
+    from emqx_tpu.ops.match_kernel import _edge_lookup
+
+    @jax.jit
+    def run(words, lens, is_sys, node_tab, edge_tab, seeds):
+        B = words.shape[0]
+        active = jnp.full((B, A), -1, jnp.int32).at[:, 0].set(0)
+        accept_cols = []
+        for t in range(D + 1):
+            valid = active >= 0
+            sa = jnp.maximum(active, 0)
+            node = node_tab[sa]
+            hacc = jnp.where(valid, node[..., 1], -1)
+            if t == 0:
+                hacc = jnp.where(is_sys[:, None], -1, hacc)
+            at_end = (t == lens)[:, None]
+            eacc = jnp.where(valid & at_end, node[..., 2], -1)
+            accept_cols.append(jnp.concatenate([hacc, eacc], axis=1))
+            if t == D:
+                break
+            w = jnp.broadcast_to(words[:, t][:, None], active.shape)
+            if use_sh:
+                lit = sh_lookup(active, w, edge_tab, seeds, slots)
+            else:
+                lit = _edge_lookup(active, w, edge_tab, seeds)
+            lit = jnp.where(valid, lit, -1)
+            plus = jnp.where(valid, node[..., 0], -1)
+            if t == 0:
+                plus = jnp.where(is_sys[:, None], -1, plus)
+            cand = jnp.concatenate([lit, plus], axis=1)
+            cand = jnp.where((t < lens)[:, None], cand, -1)
+            if use_cc:
+                active = compact_cc(cand, A)
+            else:
+                active, _ = jax.lax.top_k(cand, A)
+        flat = jnp.concatenate(accept_cols, axis=1)
+        n = jnp.sum((flat >= 0).astype(jnp.int32), axis=1)
+        if use_fc:
+            topk = compact_cc(flat, K)
+        else:
+            topk, _ = jax.lax.top_k(flat, K)
+        return topk, n
+
+    return run
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filters", type=int, default=200_000)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--depth", type=int, default=8)
+    args = ap.parse_args()
+
+    from bench import build_workload
+    from emqx_tpu.ops import compile_filters, encode_topics
+    from emqx_tpu.ops.match_kernel import nfa_match
+
+    rng = np.random.default_rng(42)
+    filters, topics = build_workload(rng, args.filters, args.batch, args.depth)
+    t0 = time.perf_counter()
+    table = compile_filters(filters, depth=args.depth)
+    print(f"compile {time.perf_counter()-t0:.1f}s states={table.n_states} "
+          f"S={table.node_tab.shape[0]} Hb={table.edge_tab.shape[0]}")
+    words, lens, is_sys = encode_topics(table, topics[: args.batch],
+                                        batch=args.batch)
+    wla = (jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys))
+    arrs = [jnp.asarray(a) for a in table.device_arrays()]
+
+    # reference answer for parity
+    ref = nfa_match(*wla, *arrs, active_slots=16, max_matches=32)
+    ref_n = np.asarray(ref.n_matches)
+    ref_sets = [set(r[r >= 0].tolist()) for r in np.asarray(ref.matches)]
+
+    B = args.batch
+    ms = force_time(
+        lambda *a: nfa_match(*a, active_slots=16, max_matches=32).matches,
+        (*wla, *arrs))
+    print(f"base A=16           : {ms:7.2f} ms  {B/ms*1e3/1e6:.2f}M t/s")
+
+    # single-hash tables
+    from emqx_tpu.ops.compiler import BUCKET_SLOTS
+    et = np.asarray(table.edge_tab).reshape(-1, 4)
+    edges = [(int(a), int(b), int(c)) for a, b, c, _ in et[et[:, 0] >= 0]]
+    sh_tabs = {}
+    for slots in (8, 16, 32):
+        t0 = time.perf_counter()
+        tab, sd = build_single_hash(edges, slots)
+        sh_tabs[slots] = (jnp.asarray(tab), jnp.asarray(sd))
+        print(f"  sh build slots={slots}: Hb={tab.shape[0]} "
+              f"load={len(edges)/(tab.shape[0]*slots):.2f} "
+              f"{time.perf_counter()-t0:.1f}s")
+
+    def check(out, name):
+        topk, n = out
+        n = np.asarray(n)
+        m = np.asarray(topk)
+        assert (n == ref_n).all(), f"{name}: n mismatch"
+        for r in range(0, B, 97):
+            got = set(m[r][m[r] >= 0].tolist())
+            assert got == ref_sets[r], f"{name}: row {r} mismatch"
+
+    for name, (use_sh, use_cc, use_fc, A, slots) in {
+        "cc A=16"           : (False, True, False, 16, 0),
+        "fc A=16"           : (False, False, True, 16, 0),
+        "cc+fc A=16"        : (False, True, True, 16, 0),
+        "sh16 A=16"         : (True, False, False, 16, 16),
+        "sh16+cc+fc A=16"   : (True, True, True, 16, 16),
+        "sh8+cc+fc A=16"    : (True, True, True, 16, 8),
+        "sh32+cc+fc A=16"   : (True, True, True, 16, 32),
+        "cc+fc A=8"         : (False, True, True, 8, 0),
+        "sh16+cc+fc A=8"    : (True, True, True, 8, 16),
+        "sh32+cc+fc A=8"    : (True, True, True, 8, 32),
+    }.items():
+        fn = make_variant(args.depth, use_sh, use_cc, use_fc, A, 32, slots)
+        a = (*wla, arrs[0], *(sh_tabs[slots] if use_sh else (arrs[1], arrs[2])))
+        out = fn(*a)
+        if A >= 16:
+            check(out, name)
+        ms = force_time(fn, a)
+        print(f"{name:20s}: {ms:7.2f} ms  {B/ms*1e3/1e6:.2f}M t/s")
+
+
+if __name__ == "__main__":
+    main()

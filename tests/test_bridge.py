@@ -1,0 +1,486 @@
+"""Bridges/connectors: buffered worker semantics, MQTT bridge (two live
+in-process nodes), webhook bridge against an in-test HTTP server, rule
+wiring, REST CRUD.  Mirrors the reference's bridge suites
+(`apps/emqx_bridge*/test` [U]): real connections, no protocol mocks."""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.bridge import BridgeManager, BufferedWorker, Connector, SendError
+from emqx_tpu.bridge import httpc
+from emqx_tpu.client import Client
+from emqx_tpu.config import Config
+from emqx_tpu.node import BrokerNode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_node(extra_cfg: str = "", **node_kw):
+    cfg = Config(
+        file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n' + extra_cfg
+    )
+    node = BrokerNode(cfg, **node_kw)
+    await node.start()
+    return node
+
+
+def port_of(node):
+    return node.listeners.all()[0].port
+
+
+# ---------------------------------------------------------------------------
+# BufferedWorker semantics
+# ---------------------------------------------------------------------------
+
+class FlakyConnector(Connector):
+    """Fails the first `fail_n` send calls, then succeeds."""
+
+    def __init__(self, fail_n=0, retryable=True):
+        self.fail_n = fail_n
+        self.retryable = retryable
+        self.sent = []
+        self.calls = 0
+
+    async def send(self, items):
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise SendError("boom", retryable=self.retryable)
+        self.sent.extend(items)
+
+
+def test_worker_delivers_and_batches():
+    async def main():
+        conn = FlakyConnector()
+        w = BufferedWorker(conn, batch_size=8)
+        await w.start()
+        for i in range(20):
+            w.enqueue(i)
+        for _ in range(100):
+            if len(conn.sent) == 20:
+                break
+            await asyncio.sleep(0.01)
+        assert conn.sent == list(range(20))  # order preserved
+        assert w.metrics["success"] == 20
+        assert w.status == "connected"
+        await w.stop()
+
+    run(main())
+
+
+def test_worker_retries_with_backoff_until_success():
+    async def main():
+        conn = FlakyConnector(fail_n=3)
+        w = BufferedWorker(conn, batch_size=4, retry_base=0.01)
+        await w.start()
+        for i in range(4):
+            w.enqueue(i)
+        for _ in range(200):
+            if len(conn.sent) == 4:
+                break
+            await asyncio.sleep(0.01)
+        assert conn.sent == [0, 1, 2, 3]
+        assert w.metrics["retried"] >= 4 * 3  # 3 failed attempts requeued
+        assert w.metrics["success"] == 4
+        await w.stop()
+
+    run(main())
+
+
+def test_worker_nonretryable_drops_batch():
+    async def main():
+        conn = FlakyConnector(fail_n=1, retryable=False)
+        w = BufferedWorker(conn, batch_size=2, retry_base=0.01)
+        await w.start()
+        w.enqueue("a")
+        w.enqueue("b")
+        w.enqueue("c")
+        for _ in range(100):
+            if conn.sent:
+                break
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.05)
+        # first batch (a, b) dropped as failed; c delivered
+        assert conn.sent == ["c"]
+        assert w.metrics["failed"] == 2
+        await w.stop()
+
+    run(main())
+
+
+def test_worker_overflow_drops_oldest():
+    async def main():
+        conn = FlakyConnector(fail_n=10**9)  # never succeeds
+        w = BufferedWorker(conn, max_queue=5, batch_size=2, retry_base=5.0)
+        await w.start()
+        await asyncio.sleep(0)
+        for i in range(12):
+            w.enqueue(i)
+        assert w.queuing <= 5 + 2  # queue cap (+ a possibly inflight batch)
+        assert w.metrics["dropped.queue_full"] >= 5
+        await w.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# MQTT bridge: egress + ingress between two live nodes
+# ---------------------------------------------------------------------------
+
+def test_mqtt_bridge_egress_via_rule():
+    async def main():
+        remote = await start_node()
+        local = await start_node()
+        try:
+            watcher = Client(clientid="w", port=port_of(remote))
+            await watcher.connect()
+            await watcher.subscribe("remote/#", qos=0)
+
+            await local.bridges.create("mqtt", "r1", {
+                "server": f"127.0.0.1:{port_of(remote)}",
+                "remote_topic": "remote/${topic}",
+                "payload": "${payload}",
+                "resource_opts": {"retry_base": 0.01},
+            })
+            local.rule_engine.create_rule(
+                "fwd", 'SELECT * FROM "up/#"', actions=["mqtt:r1"]
+            )
+
+            pub = Client(clientid="p", port=port_of(local))
+            await pub.connect()
+            await pub.publish("up/x", b"data1")
+            msg = await watcher.recv(timeout=5)
+            assert msg.topic == "remote/up/x"
+            assert msg.payload == b"data1"
+
+            br = local.bridges.get("mqtt:r1")
+            assert br.worker.metrics["success"] == 1
+            await pub.disconnect()
+            await watcher.disconnect()
+        finally:
+            await local.stop()
+            await remote.stop()
+
+    run(main())
+
+
+def test_mqtt_bridge_buffers_while_remote_down_then_flushes():
+    async def main():
+        remote = await start_node()
+        rport = port_of(remote)
+        local = await start_node()
+        try:
+            await local.bridges.create("mqtt", "r1", {
+                "server": f"127.0.0.1:{rport}",
+                "remote_topic": "remote/${topic}",
+                "resource_opts": {"retry_base": 0.02, "health_interval": 0.1},
+            })
+            local.rule_engine.create_rule(
+                "fwd", 'SELECT * FROM "up/#"', actions=["mqtt:r1"]
+            )
+            await remote.stop()  # remote goes down
+
+            pub = Client(clientid="p", port=port_of(local))
+            await pub.connect()
+            for i in range(5):
+                await pub.publish("up/x", f"m{i}".encode())
+            await asyncio.sleep(0.1)
+            br = local.bridges.get("mqtt:r1")
+            assert br.worker.queuing >= 1  # buffering, not dropping
+
+            # remote comes back on the same port
+            remote2 = BrokerNode(Config(
+                file_text=f'listeners.tcp.default.bind = "127.0.0.1:{rport}"'
+            ))
+            await remote2.start()
+            watcher = Client(clientid="w", port=rport)
+            await watcher.connect()
+            await watcher.subscribe("remote/#", qos=1)
+            got = set()
+            # bridge redelivers the buffered window after reconnect
+            for _ in range(5):
+                m = await watcher.recv(timeout=10)
+                got.add(m.payload)
+            assert got == {b"m0", b"m1", b"m2", b"m3", b"m4"}
+            await watcher.disconnect()
+            await pub.disconnect()
+            await remote2.stop()
+        finally:
+            await local.stop()
+
+    run(main())
+
+
+def test_mqtt_bridge_ingress_republishes_locally():
+    async def main():
+        remote = await start_node()
+        local = await start_node()
+        try:
+            sub = Client(clientid="s", port=port_of(local))
+            await sub.connect()
+            await sub.subscribe("from_remote/#", qos=0)
+
+            await local.bridges.create("mqtt", "in1", {
+                "server": f"127.0.0.1:{port_of(remote)}",
+                "ingress": {
+                    "remote_topic": "cloud/#",
+                    "local_topic": "from_remote/${topic}",
+                },
+            })
+            pub = Client(clientid="p", port=port_of(remote))
+            await pub.connect()
+            await pub.publish("cloud/t1", b"down")
+            msg = await sub.recv(timeout=5)
+            assert msg.topic == "from_remote/cloud/t1"
+            assert msg.payload == b"down"
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await local.stop()
+            await remote.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# webhook bridge against an in-test HTTP server
+# ---------------------------------------------------------------------------
+
+class TinyHttp:
+    """Captures requests; scripted status codes per call."""
+
+    def __init__(self, statuses=None):
+        self.requests = []
+        self.statuses = list(statuses or [])
+        self.server = None
+        self.port = 0
+
+    async def start(self):
+        async def handle(reader, writer):
+            try:
+                head = await reader.readuntil(b"\r\n\r\n")
+                lines = head.decode("latin-1").split("\r\n")
+                method, path, _ = lines[0].split(" ", 2)
+                headers = {}
+                for ln in lines[1:]:
+                    if ":" in ln:
+                        k, _, v = ln.partition(":")
+                        headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", "0"))
+                if n:
+                    body = await reader.readexactly(n)
+                status = self.statuses.pop(0) if self.statuses else 200
+                self.requests.append((method, path, headers, body))
+                payload = b'{"ok":true}'
+                writer.write(
+                    b"HTTP/1.1 %d X\r\ncontent-length: %d\r\n"
+                    b"content-type: application/json\r\n\r\n%s"
+                    % (status, len(payload), payload)
+                )
+                await writer.drain()
+            except Exception:
+                pass
+            finally:
+                writer.close()
+
+        self.server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+
+def test_httpc_roundtrip_and_chunked():
+    async def main():
+        srv = TinyHttp()
+        await srv.start()
+        resp = await httpc.request(
+            "POST", f"http://127.0.0.1:{srv.port}/hook",
+            headers={"x-k": "v"}, body=b"hello",
+        )
+        assert resp.status == 200
+        assert json.loads(resp.body) == {"ok": True}
+        method, path, headers, body = srv.requests[0]
+        assert (method, path, body) == ("POST", "/hook", b"hello")
+        assert headers["x-k"] == "v"
+        await srv.stop()
+
+    run(main())
+
+
+def test_webhook_bridge_posts_rule_output_and_retries_5xx():
+    async def main():
+        srv = TinyHttp(statuses=[500, 200])  # first attempt fails
+        await srv.start()
+        node = await start_node()
+        try:
+            await node.bridges.create("webhook", "wh", {
+                "url": f"http://127.0.0.1:{srv.port}/hook",
+                "headers": {"x-rule": "t"},
+                "resource_opts": {"retry_base": 0.01, "batch_size": 1},
+            })
+            node.rule_engine.create_rule(
+                "wh", 'SELECT topic, payload FROM "ev/#"',
+                actions=["webhook:wh"],
+            )
+            pub = Client(clientid="p", port=port_of(node))
+            await pub.connect()
+            await pub.publish("ev/1", b"x42")
+            for _ in range(300):
+                if len(srv.requests) >= 2:
+                    break
+                await asyncio.sleep(0.01)
+            assert len(srv.requests) == 2  # retried after 500
+            body = json.loads(srv.requests[-1][3])
+            assert body["topic"] == "ev/1"
+            assert body["payload"] == "x42"
+            br = node.bridges.get("webhook:wh")
+            assert br.worker.metrics["success"] == 1
+            assert br.worker.metrics["retried"] == 1
+            await pub.disconnect()
+        finally:
+            await node.stop()
+            await srv.stop()
+
+    run(main())
+
+
+def test_webhook_4xx_drops_without_retry():
+    async def main():
+        srv = TinyHttp(statuses=[404])
+        await srv.start()
+        node = await start_node()
+        try:
+            await node.bridges.create("webhook", "wh", {
+                "url": f"http://127.0.0.1:{srv.port}/nope",
+                "resource_opts": {"retry_base": 0.01, "batch_size": 1},
+            })
+            node.rule_engine.create_rule(
+                "wh", 'SELECT * FROM "ev/#"', actions=["webhook:wh"]
+            )
+            pub = Client(clientid="p", port=port_of(node))
+            await pub.connect()
+            await pub.publish("ev/1", b"x")
+            br = node.bridges.get("webhook:wh")
+            for _ in range(100):
+                if br.worker.metrics["failed"]:
+                    break
+                await asyncio.sleep(0.01)
+            assert br.worker.metrics["failed"] == 1
+            assert len(srv.requests) == 1  # no retry on 404
+            await pub.disconnect()
+        finally:
+            await node.stop()
+            await srv.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# REST CRUD
+# ---------------------------------------------------------------------------
+
+def test_bridge_rest_crud():
+    async def main():
+        node = await start_node('dashboard.enable = true\n'
+                                'dashboard.listen = "127.0.0.1:0"\n')
+        try:
+            mport = node.mgmt_server.port
+            base = f"http://127.0.0.1:{mport}/api/v5"
+
+            r = await httpc.request("POST", f"{base}/bridges", body=json.dumps({
+                "type": "webhook", "name": "wh1",
+                "conf": {"url": "http://127.0.0.1:1/x", "enable": False},
+            }).encode())
+            assert r.status == 201
+
+            r = await httpc.request("GET", f"{base}/bridges")
+            data = json.loads(r.body)["data"]
+            assert data[0]["name"] == "wh1"
+            assert data[0]["status"] == "stopped"
+
+            r = await httpc.request("GET", f"{base}/bridges/webhook:wh1")
+            assert r.status == 200
+
+            r = await httpc.request(
+                "POST", f"{base}/bridges/webhook:wh1/enable/true", body=b"")
+            assert r.status == 204
+            assert node.bridges.get("webhook:wh1").worker.status != "stopped"
+
+            r = await httpc.request("DELETE", f"{base}/bridges/webhook:wh1")
+            assert r.status == 204
+            assert node.bridges.list() == []
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# backup round-trip: bridges + string actions survive export/import
+# ---------------------------------------------------------------------------
+
+def test_backup_roundtrip_restores_bridges_and_string_actions():
+    async def main():
+        from emqx_tpu.storage import export_data, import_data
+
+        node = await start_node()
+        try:
+            await node.bridges.create("webhook", "wh", {
+                "url": "http://127.0.0.1:1/x", "enable": False,
+            })
+            node.rule_engine.create_rule(
+                "r1", 'SELECT * FROM "t/#"', actions=["webhook:wh"]
+            )
+            blob = export_data(node)
+        finally:
+            await node.stop()
+
+        node2 = await start_node()
+        try:
+            counts = import_data(node2, blob)
+            assert counts["bridges"] == 1
+            assert counts["rules"] == 1
+            assert node2.bridges.get("webhook:wh") is not None
+            assert node2.rule_engine.rules["r1"].actions == ["webhook:wh"]
+            # restored action resolves (no 'unknown bridge action')
+            assert node2.rule_engine.bridge_resolver("webhook:wh") is not None
+        finally:
+            await node2.stop()
+
+    run(main())
+
+
+def test_webhook_mid_batch_resume_and_per_item_reject():
+    """SendError.done: a 5xx mid-batch resumes from the failed item
+    (delivered prefix not re-sent); a 4xx rejects only that item."""
+    async def main():
+        from emqx_tpu.bridge.webhook import WebhookConnector
+        from emqx_tpu.bridge.resource import BufferedWorker
+
+        srv = TinyHttp(statuses=[200, 500, 200, 404, 200])
+        await srv.start()
+        conn = WebhookConnector({"url": ""}, "wh")
+        w = BufferedWorker(conn, batch_size=4, retry_base=0.01)
+        await w.start()
+        for i in range(4):
+            w.enqueue({"url": f"http://127.0.0.1:{srv.port}/i{i}",
+                       "method": "POST", "body": b""})
+        for _ in range(300):
+            if w.metrics["success"] + w.metrics["failed"] >= 4:
+                break
+            await asyncio.sleep(0.01)
+        paths = [p for _, p, _, _ in srv.requests]
+        # i0 ok; i1 500 then retried; i2 ok; i3 404 (once, rejected)
+        assert paths == ["/i0", "/i1", "/i1", "/i2", "/i3"]
+        assert w.metrics["success"] == 3
+        assert w.metrics["failed"] == 1
+        await w.stop()
+        await srv.stop()
+
+    run(main())

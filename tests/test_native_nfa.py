@@ -1,0 +1,201 @@
+"""Native incremental NFA (nfa.cpp) vs the Python oracle
+(IncrementalNfa): same mutation surface, kernel-compatible tables,
+matching host answers, delta contract.  Skipped when the toolchain can't
+build the .so (callers fall back to the Python path)."""
+
+import numpy as np
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.ops.incremental import IncrementalNfa
+
+native = pytest.importorskip("emqx_tpu.native.nfa")
+if not native.available():  # pragma: no cover
+    pytest.skip("native nfa unavailable", allow_module_level=True)
+
+from emqx_tpu.native.nfa import NativeNfa
+
+
+def rand_filters(rng, n, words=24, depth=6):
+    vocab = [f"w{i}" for i in range(words)]
+    out = set()
+    while len(out) < n:
+        k = rng.integers(1, depth)
+        ws = [("+" if rng.random() < 0.25 else vocab[rng.integers(words)])
+              for _ in range(k)]
+        if rng.random() < 0.3:
+            ws.append("#")
+        out.add("/".join(ws))
+    return sorted(out)
+
+
+def rand_topics(rng, n, words=24, depth=7):
+    vocab = [f"w{i}" for i in range(words)]
+    tops = ["/".join(vocab[rng.integers(words)]
+                     for _ in range(rng.integers(1, depth)))
+            for _ in range(n)]
+    tops += ["$SYS/broker/x", "$share"]
+    return tops
+
+
+def filters_of(nfa, n_accepts_hint=100000):
+    out = {}
+    aid = 0
+    misses = 0
+    while misses < 64 and aid < n_accepts_hint:
+        f = nfa.accept_get(aid)
+        if f is None:
+            misses += 1
+        else:
+            misses = 0
+            out[aid] = f
+        aid += 1
+    return out
+
+
+def test_add_remove_matches_oracle():
+    rng = np.random.default_rng(11)
+    filters = rand_filters(rng, 400)
+    py = IncrementalNfa(depth=8)
+    nt = NativeNfa(depth=8)
+    for f in filters:
+        assert py.add(f) == nt.add(f)
+        assert not nt.add(f)  # dup detected
+    assert nt.n_filters == py.n_filters == len(filters)
+    assert nt.n_states == py.n_states
+
+    topics = rand_topics(rng, 300)
+    for t in topics:
+        py_names = sorted(py.accept_filters[a] for a in py.match_host(t))
+        nt_names = sorted(nt.accept_get(a) for a in nt.match_host(t))
+        assert py_names == nt_names, t
+
+    # remove half, re-check parity and pruning
+    drop = filters[::2]
+    for f in drop:
+        assert py.remove(f) == nt.remove(f)
+        assert not nt.remove(f)
+    assert nt.n_filters == py.n_filters
+    assert nt.n_states == py.n_states  # pruning agrees
+    for t in topics:
+        py_names = sorted(py.accept_filters[a] for a in py.match_host(t))
+        nt_names = sorted(nt.accept_get(a) for a in nt.match_host(t))
+        assert py_names == nt_names, t
+    nt.close()
+
+
+def test_tables_drive_the_kernel():
+    """Kernel consumes native tables unchanged and answers match the
+    topic oracle."""
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops import encode_batch
+    from emqx_tpu.ops.match_kernel import nfa_match
+
+    rng = np.random.default_rng(5)
+    filters = rand_filters(rng, 300)
+    nt = NativeNfa(depth=8)
+    assert nt.bulk_add(filters) == len(filters)
+    node_tab, edge_tab, seeds = nt.tables()
+
+    topics = rand_topics(rng, 200)
+    w, l, s = encode_batch(nt, topics, batch=256)
+    res = nfa_match(jnp.asarray(w), jnp.asarray(l), jnp.asarray(s),
+                    jnp.asarray(node_tab), jnp.asarray(edge_tab),
+                    jnp.asarray(seeds), active_slots=16, max_matches=64)
+    m = np.asarray(res.matches)
+    n = np.asarray(res.n_matches)
+    for i, t in enumerate(topics):
+        want = {f for f in filters if T.match(t, f)}
+        got = {nt.accept_get(a) for a in m[i][: n[i]]}
+        assert got == want, t
+    nt.close()
+
+
+def test_delta_contract_and_epoch_gating():
+    nt = NativeNfa(depth=8, state_bucket=1024, edge_bucket=64)
+    nt.bulk_add(["a/b", "a/+", "c/#"])
+    d = nt.flush()
+    assert d.epoch == nt.epoch
+    # apply to shadow arrays == full tables
+    node_tab, edge_tab, seeds = nt.tables()
+    shadow_n = np.full_like(node_tab, -1)
+    shadow_n[:, 3] = 0
+    shadow_e = np.full_like(edge_tab, -1)
+    if not d.resized:
+        shadow_n[d.state_idx] = d.state_rows
+        shadow_e[d.bucket_idx] = d.bucket_rows
+        # dirty covers every live row after a fresh build
+        assert (shadow_n == node_tab).all()
+        assert (shadow_e == edge_tab).all()
+
+    # incremental delta covers exactly the touched rows
+    nt.add("a/x")
+    d2 = nt.flush()
+    assert not d2.resized and len(d2.state_idx) >= 1
+    shadow_n[d2.state_idx] = d2.state_rows
+    shadow_e[d2.bucket_idx] = d2.bucket_rows
+    n2, e2, _ = nt.tables()
+    assert (shadow_n == n2).all()
+    assert (shadow_e == e2).all()
+
+    # device-epoch gating: freed aid not reused until device acks
+    nt.set_device_epoch(nt.epoch)
+    aid = nt.aid_of("a/b")
+    nt.remove("a/b")
+    nt.add("z/z")                      # device hasn't acked the removal
+    assert nt.aid_of("z/z") != aid
+    nt.set_device_epoch(nt.epoch)
+    nt.remove("z/z")
+    freed_epoch_acked = nt.epoch
+    nt.set_device_epoch(freed_epoch_acked + 1)
+    nt.add("q/q")                      # now reuse is allowed
+    reuses = nt.aid_reuses
+    assert reuses >= 1
+    nt.close()
+
+
+def test_growth_resize_signals_reupload():
+    nt = NativeNfa(depth=8, state_bucket=1024, edge_bucket=8)
+    nt.flush()
+    # enough distinct literal edges to force edge-table growth
+    fl = [f"g{i}/h{i}" for i in range(400)]
+    nt.bulk_add(fl)
+    d = nt.flush()
+    assert d.resized  # consumer must re-upload
+    # tables still correct after growth
+    assert sorted(nt.match_host("g7/h7")) == [nt.aid_of("g7/h7")]
+    nt.close()
+
+
+def test_bulk_matches_python_compiler_semantics():
+    """Same filter set through compile_filters and NativeNfa gives the
+    same answers (layouts may differ; behavior must not)."""
+    from emqx_tpu.ops import compile_filters
+
+    rng = np.random.default_rng(3)
+    filters = rand_filters(rng, 250)
+    table = compile_filters(filters, depth=8)
+    nt = NativeNfa(depth=8)
+    nt.bulk_add(filters)
+    for t in rand_topics(rng, 150):
+        want = {f for f in filters if T.match(t, f)}
+        got = {nt.accept_get(a) for a in nt.match_host(t)}
+        assert got == want
+        # spot: aid_of round-trips
+    for f in filters[:50]:
+        assert nt.accept_get(nt.aid_of(f)) == f
+    nt.close()
+
+
+def test_invalid_filters_rejected_symmetrically():
+    nt = NativeNfa(depth=4)
+    with pytest.raises(ValueError):
+        nt.add("a/#/b")          # '#' must be final
+    with pytest.raises(ValueError):
+        nt.add("a/b/c/d/e")      # deeper than table
+    assert nt.n_filters == 0
+    # bulk path skips invalid lines instead of truncate-inserting
+    assert nt.bulk_add(["x/#/y", "ok/f"]) == 1
+    assert nt.match_host("x/anything") == []
+    nt.close()
