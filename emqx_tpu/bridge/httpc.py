@@ -96,7 +96,10 @@ async def request(
             head = f"{_clean(method.upper())} {_clean(path)} HTTP/1.1\r\n" + "".join(
                 f"{k}: {v}\r\n" for k, v in hdrs.items()
             )
-            writer.write(head.encode("latin-1") + b"\r\n" + body)
+            # utf-8, not latin-1: header values are rendered from
+            # message-derived templates and may carry any code point; a
+            # codec error here would poison the bridge's retry loop
+            writer.write(head.encode("utf-8") + b"\r\n" + body)
             await writer.drain()
 
             status_line = await reader.readline()
@@ -141,7 +144,17 @@ async def request(
                     raise HttpError("body too large")
                 data = await reader.readexactly(n)
             else:
-                data = await reader.read(_MAX_BODY)
+                # close-delimited body: read() returns per-segment, so
+                # loop to EOF (or the size cap) to avoid truncation
+                chunks = []
+                got = 0
+                while got < _MAX_BODY:
+                    part = await reader.read(_MAX_BODY - got)
+                    if not part:
+                        break
+                    chunks.append(part)
+                    got += len(part)
+                data = b"".join(chunks)
             return HttpResponse(status, resp_headers, data)
         finally:
             writer.close()

@@ -27,16 +27,20 @@ __all__ = ["Connector", "SendError", "BufferedWorker"]
 
 
 class SendError(Exception):
-    """Raised by a connector when a send fails.  ``retryable=False``
-    drops the remaining batch (counted failed) instead of retrying it.
-    ``done`` reports how many leading items WERE delivered before the
-    failure, so the worker neither re-sends them (duplicates) nor counts
-    them failed."""
+    """Raised by a connector when a send fails mid-batch.
 
-    def __init__(self, msg: str, retryable: bool = True, done: int = 0):
+    ``done`` = leading items fully PROCESSED (delivered or permanently
+    rejected) — the worker never re-sends them; ``rejected`` = how many
+    of those processed items were permanent rejects (counted failed, the
+    rest success).  ``retryable=True`` requeues ``batch[done:]`` for
+    redelivery; ``False`` drops it (counted failed)."""
+
+    def __init__(self, msg: str, retryable: bool = True, done: int = 0,
+                 rejected: int = 0):
         super().__init__(msg)
         self.retryable = retryable
         self.done = done
+        self.rejected = rejected
 
 
 class Connector:
@@ -56,7 +60,10 @@ class Connector:
     async def health(self) -> bool:
         return True
 
-    async def send(self, items: List[Any]) -> None:  # pragma: no cover
+    async def send(self, items: List[Any]) -> Optional[int]:  # pragma: no cover
+        """Deliver ``items`` in order.  Return the count of permanently-
+        rejected items (None/0 = all delivered); raise :class:`SendError`
+        on an interrupting failure."""
         raise NotImplementedError
 
 
@@ -187,17 +194,30 @@ class BufferedWorker:
             if not batch:
                 continue
             try:
-                await self.connector.send([item for _, item in batch])
-                self.metrics["success"] += len(batch)
+                try:
+                    rejected = await self.connector.send(
+                        [item for _, item in batch]
+                    ) or 0
+                except asyncio.CancelledError:
+                    # shutdown/update mid-send: the in-flight batch goes
+                    # back to the buffer so a queue migration sees it
+                    self._requeue(batch)
+                    raise
+                self.metrics["success"] += len(batch) - rejected
+                self.metrics["failed"] += rejected
                 backoff = self.retry_base
                 retries = 0
                 if self.status != "connected":
                     self.status = "connected"
+            except asyncio.CancelledError:
+                raise
             except Exception as e:
                 retryable = getattr(e, "retryable", True)
                 done = min(getattr(e, "done", 0), len(batch))
+                rej = min(getattr(e, "rejected", 0), done)
                 if done:
-                    self.metrics["success"] += done
+                    self.metrics["success"] += done - rej
+                    self.metrics["failed"] += rej
                     batch = batch[done:]
                 if retryable and (
                     self.max_retries is None or retries < self.max_retries

@@ -59,13 +59,14 @@ class WebhookConnector(Connector):
         # a webhook has no session to probe; health is per-request
         return True
 
-    async def send(self, items: List[Dict[str, Any]]) -> None:
+    async def send(self, items: List[Dict[str, Any]]) -> int:
         """Per-item delivery.  Transport errors and 5xx/429 raise
-        retryable with ``done`` set so the worker resumes from the failed
-        item; other 4xx reject only THAT item (the request itself is
-        wrong — retrying can't fix it) and the rest of the batch is still
-        attempted, with the reject count raised non-retryably at the end
-        for the worker's failed metric."""
+        retryable SendError with exact positional accounting (``done`` =
+        items processed, ``rejected`` = permanent rejects among them) so
+        the worker resumes from the failed item; other 4xx reject only
+        THAT item (the request itself is wrong — retrying can't fix it)
+        and the rest of the batch is still attempted.  Returns the total
+        reject count when the batch completes."""
         timeout = float(self.conf.get("request_timeout", 5.0))
         verify = bool(self.conf.get("ssl_verify", True))
         rejected = 0
@@ -81,13 +82,12 @@ class WebhookConnector(Connector):
                 )
             except (OSError, httpc.HttpError, TimeoutError) as e:
                 raise SendError(f"webhook request failed: {e}",
-                                done=i) from e
+                                done=i, rejected=rejected) from e
             if resp.status >= 500 or resp.status == 429:
-                raise SendError(f"webhook HTTP {resp.status}", done=i)
+                raise SendError(f"webhook HTTP {resp.status}",
+                                done=i, rejected=rejected)
             if resp.status >= 300:
                 log.warning("webhook %s rejected item: HTTP %d",
                             self.name, resp.status)
                 rejected += 1
-        if rejected:
-            raise SendError(f"webhook rejected {rejected} items",
-                            retryable=False, done=len(items) - rejected)
+        return rejected

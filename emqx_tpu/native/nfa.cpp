@@ -55,7 +55,10 @@ struct Node {
 struct Nfa {
   int32_t depth;
   uint64_t epoch = 0;
-  int64_t device_epoch = -1;  // -1 = no device consumer
+  // -2 = no device consumer (freed aids reusable immediately);
+  // -1 = consumer attached, nothing acked yet (no reuse);
+  // >=0 = highest epoch the device has applied
+  int64_t device_epoch = -2;
   uint64_t aid_reuses = 0;
   int32_t n_states = 1;
   int64_t n_edges = 0;
@@ -65,7 +68,21 @@ struct Nfa {
   std::vector<int32_t> free_sids;
   std::unordered_map<uint64_t, int32_t> children;  // (sid,wid) -> child
 
-  std::unordered_map<std::string, int32_t> vocab;  // word -> id (0 reserved)
+  // heterogeneous lookup: find(string_view) without a temp std::string —
+  // the build path does tens of millions of interning probes
+  struct SvHash {
+    using is_transparent = void;
+    size_t operator()(std::string_view s) const noexcept {
+      return std::hash<std::string_view>{}(s);
+    }
+  };
+  struct SvEq {
+    using is_transparent = void;
+    bool operator()(std::string_view a, std::string_view b) const noexcept {
+      return a == b;
+    }
+  };
+  std::unordered_map<std::string, int32_t, SvHash, SvEq> vocab;
   std::vector<std::string> vocab_list;             // id-1 -> word
 
   std::vector<std::string> accepts;  // aid -> filter ("" = hole)
@@ -121,7 +138,8 @@ struct Nfa {
   int32_t alloc_aid(std::string_view flt) {
     if (!free_aids.empty()) {
       auto [fe, aid] = free_aids.front();
-      if (device_epoch < 0 || fe <= uint64_t(device_epoch)) {
+      if (device_epoch == -2 ||
+          (device_epoch >= 0 && fe <= uint64_t(device_epoch))) {
         free_aids.pop_front();
         accepts[aid].assign(flt);
         accept_live[aid] = 1;
@@ -141,7 +159,7 @@ struct Nfa {
   }
 
   int32_t intern(std::string_view w) {
-    auto it = vocab.find(std::string(w));
+    auto it = vocab.find(w);
     if (it != vocab.end()) return it->second;
     int32_t id = int32_t(vocab.size()) + 1;  // 0 reserved UNKNOWN
     vocab.emplace(std::string(w), id);
@@ -150,7 +168,7 @@ struct Nfa {
   }
 
   int32_t vocab_get(std::string_view w) const {
-    auto it = vocab.find(std::string(w));
+    auto it = vocab.find(w);
     return it == vocab.end() ? 0 : it->second;
   }
 
@@ -506,6 +524,16 @@ int32_t nfa_remove(void* h, const char* s, int32_t n) {
 // newline-separated filters; returns count of newly-added filters
 int64_t nfa_bulk_add(void* h, const char* buf, int64_t len) {
   Nfa* nfa = static_cast<Nfa*>(h);
+  // pre-size the hot hash maps: filters average ~2 trie edges each, and
+  // reserving 2x headroom up front (a) kills rehash stalls inside the
+  // bulk loop and (b) keeps the FIRST post-bulk incremental adds from
+  // paying a multi-hundred-ms one-off rehash of a multi-million-entry
+  // map (measured 200 ms at 2M filters), which would blow the <50 ms
+  // delta-latency bound on whichever unlucky subscribe lands on it
+  int64_t approx = 0;
+  for (int64_t i = 0; i < len; ++i) approx += buf[i] == '\n';
+  nfa->children.reserve(nfa->children.size() + size_t(approx) * 4);
+  nfa->vocab.reserve(nfa->vocab.size() + size_t(approx));
   int64_t added = 0;
   int64_t start = 0;
   for (int64_t i = 0; i <= len; ++i) {
@@ -558,14 +586,16 @@ void nfa_fill_tables(void* h, int32_t* node_tab, int32_t* edge_tab,
   seeds[1] = int32_t(n->seeds[1]);
 }
 
-// vocab words '\n'-joined in id order (id 1 first); buf sized vocab_bytes
+// vocab words NUL-joined in id order (id 1 first); buf sized vocab_bytes.
+// NUL is the one byte MQTT forbids in topic names (MQTT-1.5.4-2), so it
+// cannot appear inside a word; '\n' CAN, which is why it is not used.
 void nfa_vocab_fill(void* h, char* buf) {
   Nfa* n = static_cast<Nfa*>(h);
   char* p = buf;
   for (auto& w : n->vocab_list) {
     std::memcpy(p, w.data(), w.size());
     p += w.size();
-    *p++ = '\n';
+    *p++ = '\0';
   }
 }
 
@@ -582,6 +612,10 @@ int32_t nfa_accept_get(void* h, int32_t aid, char* buf, int32_t cap) {
 void nfa_set_device_epoch(void* h, int64_t e) {
   static_cast<Nfa*>(h)->device_epoch = e;
 }
+
+// force the next delta to present as a full re-upload (used after a
+// bulk load whose delta was deliberately drained host-side)
+void nfa_mark_resized(void* h) { static_cast<Nfa*>(h)->resized = true; }
 
 // out[0]=n_dirty_states out[1]=n_dirty_buckets out[2]=resized out[3]=epoch
 void nfa_delta_sizes(void* h, int64_t* out) {

@@ -62,6 +62,7 @@ def _load():
     lib.nfa_accept_get.argtypes = [ctypes.c_void_p, ctypes.c_int32,
                                    ctypes.c_char_p, ctypes.c_int32]
     lib.nfa_set_device_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.nfa_mark_resized.argtypes = [ctypes.c_void_p]
     lib.nfa_delta_sizes.argtypes = lib.nfa_sizes.argtypes
     lib.nfa_delta_fill.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
@@ -125,9 +126,40 @@ class NativeNfa:
         return bool(self._lib.nfa_remove(self._h, b, len(b)))
 
     def bulk_add(self, filters: Sequence[str]) -> int:
-        """Add many filters in one native call (the 10M-scale build path)."""
-        blob = "\n".join(filters).encode()
-        return int(self._lib.nfa_bulk_add(self._h, blob, len(blob)))
+        """Add many filters in one native call (the 10M-scale build path).
+        Invalid lines ('#' not final / too deep) are skipped, not
+        truncate-inserted; the return counts filters actually added.
+        Filters containing '\\n' (legal in MQTT) can't ride the
+        newline-framed bulk path and fall back to individual adds."""
+        plain = [f for f in filters if "\n" not in f]
+        odd = [f for f in filters if "\n" in f]
+        blob = "\n".join(plain).encode()
+        n = int(self._lib.nfa_bulk_add(self._h, blob, len(blob)))
+        for f in odd:
+            try:
+                n += 1 if self.add(f) else 0
+            except ValueError:
+                pass
+        # warm probe: the first few mutations after a large bulk absorb a
+        # one-off allocator consolidation stall (measured ~200 ms at 2M
+        # filters); pay it here, not on a live subscribe
+        for i in range(4):
+            probe = f"\x01warm/{i}".encode()
+            self._lib.nfa_add(self._h, probe, len(probe))
+            self._lib.nfa_remove(self._h, probe, len(probe))
+        if n > 100_000:
+            # absorb the one-off post-bulk allocator stall (~200 ms of
+            # glibc consolidation measured at 2M filters) here rather
+            # than on the first live delta: exercise the flush path AND
+            # a few heap allocations of delta-buffer size, then re-flag
+            # resized so any attached consumer still performs the full
+            # upload the bulk requires
+            self.flush()
+            for _ in range(4):
+                np.empty((4096, 16), np.int32)
+                np.empty((4096, 4), np.int32)
+            self._lib.nfa_mark_resized(self._h)
+        return n
 
     # -- introspection -----------------------------------------------------
 
@@ -188,7 +220,8 @@ class NativeNfa:
         if len(self._vocab) != n:
             buf = ctypes.create_string_buffer(int(s[7]) + 1)
             self._lib.nfa_vocab_fill(self._h, buf)
-            words = buf.raw[: max(0, int(s[7]) - 1)].decode().split("\n")
+            # NUL-separated: words may legally contain '\n' but never NUL
+            words = buf.raw[: max(0, int(s[7]) - 1)].decode().split("\x00")
             for i in range(len(self._vocab), n):
                 self._vocab[words[i]] = i + 1
         return self._vocab
@@ -215,6 +248,18 @@ class NativeNfa:
 
     def set_device_epoch(self, epoch: int) -> None:
         self._lib.nfa_set_device_epoch(self._h, epoch)
+        self._device_epoch = epoch
+
+    # attribute-style twin of IncrementalNfa.device_epoch so DeviceNfa
+    # drives either table implementation unchanged
+    @property
+    def device_epoch(self) -> Optional[int]:
+        return getattr(self, "_device_epoch", None)
+
+    @device_epoch.setter
+    def device_epoch(self, epoch: Optional[int]) -> None:
+        # None = no consumer (-2); -1 = attached, nothing acked yet
+        self.set_device_epoch(-2 if epoch is None else int(epoch))
 
     def flush(self):
         """Drain dirty rows as an ``NfaDelta`` (same contract as the

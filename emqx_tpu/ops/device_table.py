@@ -80,15 +80,20 @@ class DeviceNfa:
 
     def __init__(
         self,
-        inc: IncrementalNfa,
+        inc: "IncrementalNfa",
         active_slots: int = 16,
         max_matches: int = 32,
         device: Optional[jax.Device] = None,
         lazy: bool = False,
+        compact_output: bool = True,
     ) -> None:
+        # `inc` is any host table with the IncrementalNfa mutation/drain
+        # surface — the Python IncrementalNfa or the native C++ NativeNfa
+        # (emqx_tpu.native.nfa; exposes tables() instead of raw arrays)
         self.inc = inc
         self.active_slots = active_slots
         self.max_matches = max_matches
+        self.compact_output = compact_output
         self.device = device
         self.epoch = -1
         self.uploads = 0        # full table uploads (growth / first sync)
@@ -137,13 +142,17 @@ class DeviceNfa:
         upload is needed (first sync / growth), which copies the table."""
         delta = self.inc.flush()
         if full or delta.resized or self._shape_key != self.inc.shape_key():
-            return PendingSync(
-                delta=None,
-                full=(
+            if hasattr(self.inc, "tables"):  # native table: one export
+                tabs = self.inc.tables()
+            else:
+                tabs = (
                     self.inc.node_tab.copy(),
                     self.inc.edge_tab.copy(),
                     self.inc.seeds.copy(),
-                ),
+                )
+            return PendingSync(
+                delta=None,
+                full=tabs,
                 shape_key=self.inc.shape_key(),
                 epoch=self.inc.epoch,
             )
@@ -228,6 +237,7 @@ class DeviceNfa:
                 words, lens, is_sys, node, edge, seeds,
                 active_slots=self.active_slots,
                 max_matches=self.max_matches,
+                compact_output=self.compact_output,
             )
 
     def match_names(self, names: Sequence[str], batch: Optional[int] = None):

@@ -103,7 +103,8 @@ def _compact(cand: jax.Array, width: int) -> jax.Array:
     return jnp.max(jnp.where(onehot, cand[..., None], -1), axis=1)
 
 
-@partial(jax.jit, static_argnames=("active_slots", "max_matches"))
+@partial(jax.jit,
+         static_argnames=("active_slots", "max_matches", "compact_output"))
 def nfa_match(
     words,        # (B, D) int32
     lens,         # (B,) int32
@@ -114,6 +115,7 @@ def nfa_match(
     *,
     active_slots: int = 16,
     max_matches: int = 32,
+    compact_output: bool = True,
 ) -> MatchResult:
     B, D = words.shape
     A = active_slots
@@ -168,15 +170,26 @@ def nfa_match(
 
     flat = jnp.concatenate(accept_cols, axis=1)            # (B, Σ 2·w_t)
     n = jnp.sum((flat >= 0).astype(jnp.int32), axis=1)
-    matches = _compact(flat, K)                            # valids first
+    aover = (
+        jnp.sum(jnp.stack(spills), axis=0) if spills
+        else jnp.zeros((B,), jnp.int32)
+    )
+    if compact_output:
+        matches = _compact(flat, K)                        # valids first
+        mover = (n > K).astype(jnp.int32)
+    else:
+        # raw mode: all Σ2·w_t accept slots, valids scattered (-1 holes).
+        # Structurally nothing truncates (the walk cannot fire more
+        # accepts than it has slots), so only active-set spill remains a
+        # fail-open cause — the right mode for high-fan-out tables where
+        # a fixed K would overflow (hosts mask row >= 0 to decode).
+        matches = flat
+        mover = jnp.zeros((B,), jnp.int32)
     return MatchResult(
         matches=matches,
         n_matches=n,
-        active_overflow=(
-            jnp.sum(jnp.stack(spills), axis=0) if spills
-            else jnp.zeros((B,), jnp.int32)
-        ),
-        match_overflow=(n > K).astype(jnp.int32),
+        active_overflow=aover,
+        match_overflow=mover,
     )
 
 

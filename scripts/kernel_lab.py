@@ -97,12 +97,10 @@ def sh_lookup(state, word, tab, seed, slots):
 
 
 def compact_cc(cand, A):
-    """Valids-first compaction via cumsum + compare-scatter (no sort)."""
-    valid = cand >= 0
-    pos = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
-    pos = jnp.where(valid, pos, A)
-    onehot = pos[..., None] == jnp.arange(A)[None, None, :]
-    return jnp.max(jnp.where(onehot, cand[..., None], -1), axis=1)
+    """Valids-first compaction — the shipping kernel's implementation."""
+    from emqx_tpu.ops.match_kernel import _compact
+
+    return _compact(cand, A)
 
 
 def make_variant(D, use_sh, use_cc, use_fc, A, K, slots):
