@@ -207,6 +207,13 @@ SCHEMA: Dict[str, Field] = {
     "cluster.heartbeat_interval": Field(1.0, duration),
     "cluster.node_timeout": Field(5.0, duration),
 
+    # -- gateways (emqx_gateway analog, SURVEY.md §2.3) -------------------
+    "gateway.stomp.enable": Field(False, _bool),
+    "gateway.stomp.bind": Field("127.0.0.1:61613", str),
+    "gateway.mqttsn.enable": Field(False, _bool),
+    "gateway.mqttsn.bind": Field("127.0.0.1:1884", str),
+    "gateway.mqttsn.gateway_id": Field(1, int),
+
     # -- exhook (gRPC extension boundary, SURVEY.md §2.3) -----------------
     # comma-separated "name=url" pairs, e.g. "default=127.0.0.1:9000"
     "exhook.servers": Field("", str),

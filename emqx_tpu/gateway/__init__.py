@@ -1,0 +1,6 @@
+"""Multi-protocol gateways (STOMP, MQTT-SN) — the ``emqx_gateway``
+family (SURVEY.md §2.3) normalized into the broker's session layer."""
+
+from .base import Gateway, GatewayConn, GatewayManager
+
+__all__ = ["Gateway", "GatewayConn", "GatewayManager"]

@@ -1,0 +1,190 @@
+"""Gateway framework: non-MQTT protocols normalized into broker sessions.
+
+Behavioral reference: ``apps/emqx_gateway`` [U] (SURVEY.md §2.3) — each
+gateway listens on its own ports, authenticates through the node's
+normal access-control chain, opens a REAL broker session (so routing,
+shared subs, retained replay, rule engine and the device match path all
+apply unchanged), and translates deliveries back into its wire protocol.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from ..broker.message import Message, make_message
+from ..broker.session import Publish, SubOpts
+
+log = logging.getLogger(__name__)
+
+__all__ = ["GatewayConn", "Gateway", "GatewayManager"]
+
+
+class GatewayConn:
+    """One gateway client bound to a broker session.
+
+    Registers in ``node.connections`` so ``BrokerNode._on_deliver``
+    routes session deliveries here; subclasses implement
+    ``send_deliveries`` (protocol encode) and ``close_transport``.
+    """
+
+    def __init__(self, node: Any, gateway: str) -> None:
+        self.node = node
+        self.gateway = gateway
+        self.clientid: Optional[str] = None
+        self.closed = False
+
+    # -- session lifecycle -------------------------------------------------
+
+    def attach_session(self, clientid: str, clean_start: bool = True,
+                       **kw) -> bool:
+        """Open the broker session + register for deliveries.  Returns
+        session_present."""
+        self.clientid = clientid
+        old = self.node.connections.get(clientid)
+        if old is not None and old is not self:
+            try:
+                old.kick("takeover by new gateway connection")
+            except Exception:
+                pass
+        sess, present = self.node.broker.open_session(
+            clientid, clean_start=clean_start, **kw
+        )
+        self.node.connections[clientid] = self
+        self.node.broker.hooks.run(
+            "client.connected", (clientid, {"gateway": self.gateway})
+        )
+        return present
+
+    def detach_session(self, discard: bool = True,
+                       reason: str = "normal") -> None:
+        if self.clientid is None:
+            return
+        if self.node.connections.get(self.clientid) is self:
+            del self.node.connections[self.clientid]
+        self.node.broker.close_session(self.clientid, discard=discard)
+        self.node.broker.hooks.run(
+            "client.disconnected", (self.clientid, reason)
+        )
+        self.clientid = None
+
+    # -- broker-side operations --------------------------------------------
+
+    def authenticate(self, username: Optional[str],
+                     password: Optional[bytes],
+                     conninfo: Optional[Dict] = None) -> bool:
+        """Same authn hook fold the MQTT channel runs (banned + chain)."""
+        acc = self.node.broker.hooks.run_fold(
+            "client.authenticate",
+            (self.clientid, username, password,
+             {"gateway": self.gateway, **(conninfo or {})}),
+            True,
+        )
+        return acc is True
+
+    def authorize(self, action: str, topic: str, qos: int = 0) -> bool:
+        acc = self.node.broker.hooks.run_fold(
+            "client.authorize",
+            (self.clientid, action, topic, {"qos": qos}),
+            True,
+        )
+        return acc is True
+
+    def publish(self, topic: str, payload: bytes, qos: int = 0,
+                retain: bool = False,
+                properties: Optional[Dict] = None) -> None:
+        msg = make_message(self.clientid, topic, payload, qos=qos,
+                           retain=retain, properties=properties or {})
+        self.node.broker.publish(msg)
+
+    def subscribe(self, flt: str, qos: int = 0) -> None:
+        self.node.broker.subscribe(self.clientid, flt, SubOpts(qos=qos))
+
+    def unsubscribe(self, flt: str) -> bool:
+        return self.node.broker.unsubscribe(self.clientid, flt)
+
+    # -- node.connections contract ----------------------------------------
+
+    def deliver(self, pubs: List[Publish]) -> None:
+        try:
+            self.send_deliveries(pubs)
+        except Exception:
+            log.exception("%s gateway delivery to %s failed",
+                          self.gateway, self.clientid)
+
+    def kick(self, reason: str = "kicked") -> None:
+        self.closed = True
+        try:
+            self.close_transport(reason)
+        except Exception:
+            pass
+
+    # -- subclass surface ---------------------------------------------------
+
+    def send_deliveries(self, pubs: List[Publish]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def close_transport(self, reason: str) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class Gateway:
+    """One protocol gateway (named listener set)."""
+
+    name = "base"
+
+    def __init__(self, node: Any, conf: Dict[str, Any]) -> None:
+        self.node = node
+        self.conf = conf
+        self.clients: Dict[str, GatewayConn] = {}
+
+    async def start(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    async def stop(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "clients": len(self.clients),
+            **{k: v for k, v in self.conf.items()},
+        }
+
+
+class GatewayManager:
+    """Registry + lifecycle for a node's gateways (gateway REST/CLI
+    surface reads through here)."""
+
+    def __init__(self, node: Any) -> None:
+        self.node = node
+        self.gateways: Dict[str, Gateway] = {}
+
+    async def load(self, name: str, conf: Dict[str, Any]) -> Gateway:
+        from .mqttsn import MqttSnGateway
+        from .stomp import StompGateway
+
+        kinds = {"stomp": StompGateway, "mqttsn": MqttSnGateway}
+        if name in self.gateways:
+            raise ValueError(f"gateway {name} already loaded")
+        if name not in kinds:
+            raise ValueError(f"unknown gateway {name!r}")
+        gw = kinds[name](self.node, conf)
+        await gw.start()
+        self.gateways[name] = gw
+        return gw
+
+    async def unload(self, name: str) -> bool:
+        gw = self.gateways.pop(name, None)
+        if gw is None:
+            return False
+        await gw.stop()
+        return True
+
+    async def stop_all(self) -> None:
+        for name in list(self.gateways):
+            await self.unload(name)
+
+    def list(self) -> List[Dict[str, Any]]:
+        return [g.info() for g in self.gateways.values()]

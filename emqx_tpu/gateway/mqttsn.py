@@ -1,0 +1,390 @@
+"""MQTT-SN (v1.2) gateway over UDP, normalized into broker sessions.
+
+Behavioral reference: ``apps/emqx_gateway/src/mqttsn`` [U] (SURVEY.md
+§2.3).  Implements the aggregating-gateway subset that covers the
+protocol's sensor-network core: SEARCHGW/GWINFO discovery, CONNECT
+(clean + keepalive), topic REGISTER/REGACK in both directions, PUBLISH
+QoS 0/1 with normal/predefined/short topic-id types, SUBSCRIBE/
+UNSUBSCRIBE by name or id, PINGREQ/PINGRESP, DISCONNECT, and keepalive
+expiry.  QoS2 and the sleeping-client state machine are not implemented
+(PUBREC et al. answered as protocol error).
+
+Wire format: [len:1 | 0x01 len:2] msgtype:1 body; 16-bit ints big-endian.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..broker.session import Publish
+from .base import Gateway, GatewayConn
+
+log = logging.getLogger(__name__)
+
+__all__ = ["MqttSnGateway"]
+
+# message types
+ADVERTISE = 0x00
+SEARCHGW = 0x01
+GWINFO = 0x02
+CONNECT = 0x04
+CONNACK = 0x05
+REGISTER = 0x0A
+REGACK = 0x0B
+PUBLISH = 0x0C
+PUBACK = 0x0D
+SUBSCRIBE = 0x12
+SUBACK = 0x13
+UNSUBSCRIBE = 0x14
+UNSUBACK = 0x15
+PINGREQ = 0x16
+PINGRESP = 0x17
+DISCONNECT = 0x18
+
+RC_ACCEPTED = 0x00
+RC_CONGESTION = 0x01
+RC_INVALID_TOPIC_ID = 0x02
+RC_NOT_SUPPORTED = 0x03
+
+FLAG_DUP = 0x80
+FLAG_QOS_MASK = 0x60
+FLAG_RETAIN = 0x10
+FLAG_WILL = 0x08
+FLAG_CLEAN = 0x04
+TOPIC_NORMAL = 0x00
+TOPIC_PREDEFINED = 0x01
+TOPIC_SHORT = 0x02
+
+
+def _pack(msgtype: int, body: bytes) -> bytes:
+    n = len(body) + 2
+    if n + 1 <= 255:
+        return bytes([n + 1, msgtype]) + body
+    return b"\x01" + struct.pack(">H", n + 3)[0:2] + bytes([msgtype]) + body
+
+
+def _unpack(data: bytes) -> Optional[Tuple[int, bytes]]:
+    if not data:
+        return None
+    if data[0] == 0x01:
+        if len(data) < 4:
+            return None
+        n = struct.unpack(">H", data[1:3])[0]
+        if len(data) < n:
+            return None
+        return data[3], data[4:n]
+    n = data[0]
+    if len(data) < n or n < 2:
+        return None
+    return data[1], data[2:n]
+
+
+def _qos(flags: int) -> int:
+    q = (flags & FLAG_QOS_MASK) >> 5
+    return 1 if q == 1 else (2 if q == 2 else 0)  # 0b11 = QoS -1 → treat 0
+
+
+class SnClient(GatewayConn):
+    """One MQTT-SN client (keyed by UDP address)."""
+
+    def __init__(self, gw: "MqttSnGateway", addr) -> None:
+        super().__init__(gw.node, "mqttsn")
+        self.gw = gw
+        self.addr = addr
+        self.keepalive = 0
+        self.last_seen = time.monotonic()
+        self.topic_ids: Dict[str, int] = {}   # topic -> id (both directions)
+        self.id_topics: Dict[int, str] = {}
+        self._next_tid = 1
+        self._next_mid = 1
+        # deliveries held until the client REGACKs the topic id
+        self._awaiting_reg: Dict[int, List[Publish]] = {}
+
+    # -- registry ----------------------------------------------------------
+
+    def tid_of(self, topic: str) -> int:
+        tid = self.topic_ids.get(topic)
+        if tid is None:
+            tid = self._next_tid
+            self._next_tid += 1
+            self.topic_ids[topic] = tid
+            self.id_topics[tid] = topic
+        return tid
+
+    def _mid(self) -> int:
+        m = self._next_mid
+        self._next_mid = (self._next_mid % 0xFFFF) + 1
+        return m
+
+    # -- inbound -----------------------------------------------------------
+
+    def handle(self, msgtype: int, body: bytes) -> None:
+        self.last_seen = time.monotonic()
+        if msgtype == CONNECT:
+            self.on_connect(body)
+        elif msgtype == REGISTER:
+            self.on_register(body)
+        elif msgtype == PUBLISH:
+            self.on_publish(body)
+        elif msgtype == SUBSCRIBE:
+            self.on_subscribe(body)
+        elif msgtype == UNSUBSCRIBE:
+            self.on_unsubscribe(body)
+        elif msgtype == PINGREQ:
+            self.send(PINGRESP, b"")
+        elif msgtype == DISCONNECT:
+            self.detach_session(discard=True, reason="client disconnect")
+            self.send(DISCONNECT, b"")
+            self.gw.drop(self.addr)
+        elif msgtype == PUBACK:
+            self.on_puback(body)
+        elif msgtype == REGACK:
+            self.on_regack(body)
+        else:
+            log.debug("mqttsn: unhandled msgtype 0x%02x", msgtype)
+
+    def on_connect(self, body: bytes) -> None:
+        if len(body) < 4:
+            return
+        flags, _proto = body[0], body[1]
+        self.keepalive = struct.unpack(">H", body[2:4])[0]
+        cid = body[4:].decode("utf-8", "replace") or \
+            f"sn-{self.addr[0]}-{self.addr[1]}"
+        self.clientid = cid
+        if not self.authenticate(None, None,
+                                 {"peerhost": self.addr[0]}):
+            return self.send(CONNACK, bytes([RC_NOT_SUPPORTED]))
+        clean = bool(flags & FLAG_CLEAN)
+        self.attach_session(cid, clean_start=clean)
+        self.send(CONNACK, bytes([RC_ACCEPTED]))
+
+    def on_register(self, body: bytes) -> None:
+        # client → gateway: topicid(2) msgid(2) topicname
+        if len(body) < 4:
+            return
+        mid = struct.unpack(">H", body[2:4])[0]
+        topic = body[4:].decode("utf-8", "replace")
+        tid = self.tid_of(topic)
+        self.send(REGACK, struct.pack(">HH", tid, mid) + bytes([RC_ACCEPTED]))
+
+    def on_regack(self, body: bytes) -> None:
+        if len(body) < 5:
+            return
+        tid = struct.unpack(">H", body[0:2])[0]
+        rc = body[4]
+        held = self._awaiting_reg.pop(tid, None)
+        if rc == RC_ACCEPTED and held:
+            self.send_deliveries(held)
+
+    def on_publish(self, body: bytes) -> None:
+        if len(body) < 5 or self.clientid is None:
+            return
+        flags = body[0]
+        tid_type = flags & 0x03
+        mid = struct.unpack(">H", body[3:5])[0]
+        payload = body[5:]
+        qos = _qos(flags)
+        retain = bool(flags & FLAG_RETAIN)
+        if tid_type == TOPIC_SHORT:
+            topic = body[1:3].decode("utf-8", "replace")
+        elif tid_type == TOPIC_PREDEFINED:
+            tid = struct.unpack(">H", body[1:3])[0]
+            topic = self.gw.predefined.get(tid)
+        else:
+            tid = struct.unpack(">H", body[1:3])[0]
+            topic = self.id_topics.get(tid)
+        if not topic:
+            if qos:
+                self.send(PUBACK, body[1:3] + struct.pack(">H", mid)
+                          + bytes([RC_INVALID_TOPIC_ID]))
+            return
+        if not self.authorize("publish", topic, qos=qos):
+            if qos:
+                self.send(PUBACK, body[1:3] + struct.pack(">H", mid)
+                          + bytes([RC_NOT_SUPPORTED]))
+            return
+        self.publish(topic, payload, qos=min(qos, 1), retain=retain)
+        if qos:
+            self.send(PUBACK, body[1:3] + struct.pack(">H", mid)
+                      + bytes([RC_ACCEPTED]))
+
+    def on_subscribe(self, body: bytes) -> None:
+        if len(body) < 3 or self.clientid is None:
+            return
+        flags = body[0]
+        mid = struct.unpack(">H", body[1:3])[0]
+        tid_type = flags & 0x03
+        qos = min(_qos(flags), 1)
+        tid = 0
+        if tid_type == TOPIC_SHORT:
+            topic = body[3:5].decode("utf-8", "replace")
+        elif tid_type == TOPIC_PREDEFINED:
+            tid = struct.unpack(">H", body[3:5])[0]
+            topic = self.gw.predefined.get(tid)
+        else:
+            topic = body[3:].decode("utf-8", "replace")
+        if not topic or not self.authorize("subscribe", topic, qos=qos):
+            return self.send(
+                SUBACK, bytes([flags]) + struct.pack(">HH", 0, mid)
+                + bytes([RC_NOT_SUPPORTED]))
+        try:
+            self.subscribe(topic, qos=qos)
+        except ValueError:
+            return self.send(
+                SUBACK, bytes([flags]) + struct.pack(">HH", 0, mid)
+                + bytes([RC_INVALID_TOPIC_ID]))
+        # wildcard filters get tid 0; concrete names get a registered id
+        if tid_type == TOPIC_NORMAL and not any(c in topic for c in "+#"):
+            tid = self.tid_of(topic)
+        self.send(SUBACK, bytes([flags & FLAG_QOS_MASK])
+                  + struct.pack(">HH", tid, mid) + bytes([RC_ACCEPTED]))
+
+    def on_unsubscribe(self, body: bytes) -> None:
+        if len(body) < 3:
+            return
+        flags = body[0]
+        mid = struct.unpack(">H", body[1:3])[0]
+        tid_type = flags & 0x03
+        if tid_type == TOPIC_SHORT:
+            topic = body[3:5].decode("utf-8", "replace")
+        elif tid_type == TOPIC_PREDEFINED:
+            topic = self.gw.predefined.get(struct.unpack(">H", body[3:5])[0])
+        else:
+            topic = body[3:].decode("utf-8", "replace")
+        if topic:
+            self.unsubscribe(topic)
+        self.send(UNSUBACK, struct.pack(">H", mid))
+
+    def on_puback(self, body: bytes) -> None:
+        if len(body) < 5 or self.clientid is None:
+            return
+        mid = struct.unpack(">H", body[2:4])[0]
+        sess = self.node.broker.sessions.get(self.clientid)
+        if sess is not None:
+            _, more = sess.puback(mid)
+            if more:
+                self.send_deliveries(more)
+
+    # -- outbound ----------------------------------------------------------
+
+    def send(self, msgtype: int, body: bytes) -> None:
+        self.gw.transport.sendto(_pack(msgtype, body), self.addr)
+
+    def send_deliveries(self, pubs: List[Publish]) -> None:
+        for pub in pubs:
+            topic = pub.msg.topic
+            if len(topic) == 2 and not any(c in topic for c in "+#"):
+                tid_bytes = topic.encode()
+                tid_type = TOPIC_SHORT
+            else:
+                tid = self.topic_ids.get(topic)
+                if tid is None:
+                    # register first, hold the delivery until REGACK
+                    tid = self.tid_of(topic)
+                    self._awaiting_reg.setdefault(tid, []).append(pub)
+                    self.send(REGISTER, struct.pack(">HH", tid, self._mid())
+                              + topic.encode())
+                    continue
+                tid_bytes = struct.pack(">H", tid)
+                tid_type = TOPIC_NORMAL
+            qos = 1 if pub.pid is not None else 0
+            flags = tid_type | (0x20 if qos else 0) | (
+                FLAG_RETAIN if pub.msg.retain else 0)
+            mid = pub.pid if pub.pid is not None else 0
+            self.send(PUBLISH, bytes([flags]) + tid_bytes
+                      + struct.pack(">H", mid) + pub.msg.payload)
+
+    def close_transport(self, reason: str) -> None:
+        try:
+            self.send(DISCONNECT, b"")
+        except Exception:
+            pass
+        self.gw.drop(self.addr)
+
+
+class _Proto(asyncio.DatagramProtocol):
+    def __init__(self, gw: "MqttSnGateway") -> None:
+        self.gw = gw
+
+    def connection_made(self, transport) -> None:
+        self.gw.transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.gw.on_datagram(data, addr)
+
+
+class MqttSnGateway(Gateway):
+    name = "mqttsn"
+
+    def __init__(self, node: Any, conf: Dict[str, Any]) -> None:
+        super().__init__(node, conf)
+        self.transport = None
+        self.port = 0
+        self.gw_id = int(conf.get("gateway_id", 1))
+        # predefined topic ids (conf: {"predefined": {"1": "sensors/x"}})
+        self.predefined: Dict[int, str] = {
+            int(k): v for k, v in (conf.get("predefined") or {}).items()
+        }
+        self.by_addr: Dict[Any, SnClient] = {}
+        self._sweeper: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        bind = self.conf.get("bind", "127.0.0.1:1884")
+        host, _, port = bind.rpartition(":")
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Proto(self), local_addr=(host or "0.0.0.0", int(port))
+        )
+        self.port = self.transport.get_extra_info("sockname")[1]
+        self._sweeper = asyncio.ensure_future(self._sweep())
+        log.info("mqttsn gateway on udp %s:%d", host, self.port)
+
+    async def stop(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+        for c in list(self.by_addr.values()):
+            c.detach_session(discard=True, reason="gateway stopped")
+        self.by_addr.clear()
+        if self.transport is not None:
+            self.transport.close()
+
+    def drop(self, addr) -> None:
+        self.by_addr.pop(addr, None)
+
+    def on_datagram(self, data: bytes, addr) -> None:
+        parsed = _unpack(data)
+        if parsed is None:
+            return
+        msgtype, body = parsed
+        if msgtype == SEARCHGW:
+            self.transport.sendto(
+                _pack(GWINFO, bytes([self.gw_id])), addr)
+            return
+        client = self.by_addr.get(addr)
+        if client is None:
+            if msgtype != CONNECT:
+                return  # unknown peer must CONNECT first
+            client = SnClient(self, addr)
+            self.by_addr[addr] = client
+            self.clients[str(addr)] = client
+        try:
+            client.handle(msgtype, body)
+        except Exception:
+            log.exception("mqttsn: error handling 0x%02x from %s",
+                          msgtype, addr)
+
+    async def _sweep(self) -> None:
+        while True:
+            await asyncio.sleep(5.0)
+            now = time.monotonic()
+            for addr, c in list(self.by_addr.items()):
+                if c.keepalive and now - c.last_seen > c.keepalive * 1.5:
+                    c.detach_session(discard=False, reason="keepalive timeout")
+                    self.drop(addr)
+
+    def info(self) -> Dict[str, Any]:
+        return {**super().info(), "port": self.port, "transport": "udp"}

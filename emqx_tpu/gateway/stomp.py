@@ -1,0 +1,391 @@
+"""STOMP 1.2 gateway: STOMP frames over TCP, normalized into broker
+sessions (destination == topic, verbatim — the reference's mapping).
+
+Behavioral reference: ``apps/emqx_gateway/src/stomp`` [U] (SURVEY.md
+§2.3): CONNECT/STOMP negotiates version + heart-beats and runs authn;
+SEND publishes; SUBSCRIBE (per-connection ``id``) maps ``ack:auto`` to
+QoS0 and ``ack:client``/``client-individual`` to QoS1 with ACK/NACK
+driving the session inflight; RECEIPT echoes ``receipt`` headers; ERROR
+closes the connection per spec.
+
+Frame wire format (STOMP 1.2): ``COMMAND\\n`` headers ``\\n\\n`` body
+``\\x00``; header octets escape ``\\r\\n:\\\\`` as ``\\r \\n \\c \\\\``;
+CONNECT/CONNECTED headers are NOT unescaped (spec §"Value Encoding").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..broker.session import Publish
+from .base import Gateway, GatewayConn
+
+log = logging.getLogger(__name__)
+
+__all__ = ["StompGateway", "StompFrame", "parse_frames", "serialize_frame"]
+
+MAX_FRAME = 1 << 20
+_ESC = {"\\r": "\r", "\\n": "\n", "\\c": ":", "\\\\": "\\"}
+
+
+class StompFrame:
+    __slots__ = ("command", "headers", "body")
+
+    def __init__(self, command: str, headers: Dict[str, str],
+                 body: bytes = b""):
+        self.command = command
+        self.headers = headers
+        self.body = body
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<STOMP {self.command} {self.headers} {len(self.body)}B>"
+
+
+def _unescape(s: str) -> str:
+    out = []
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            pair = s[i:i + 2]
+            if pair not in _ESC:
+                raise ValueError(f"bad escape {pair!r}")
+            out.append(_ESC[pair])
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def _escape(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace("\r", "\\r")
+            .replace("\n", "\\n").replace(":", "\\c"))
+
+
+def parse_frames(buf: bytearray, escaped: bool = True):
+    """Incremental parse: yields StompFrame, consuming ``buf`` in place.
+    Bare EOL between frames (heart-beats) are skipped."""
+    while True:
+        while buf[:1] in (b"\n", b"\r"):
+            del buf[:1]
+        if not buf:
+            return
+        head_end = buf.find(b"\n\n")
+        crlf = buf.find(b"\r\n\r\n")
+        if crlf != -1 and (head_end == -1 or crlf < head_end):
+            head_end, sep = crlf, 4
+        elif head_end != -1:
+            sep = 2
+        else:
+            if len(buf) > MAX_FRAME:
+                raise ValueError("frame header too large")
+            return
+        head = bytes(buf[:head_end]).decode("utf-8")
+        lines = head.replace("\r\n", "\n").split("\n")
+        command = lines[0].strip()
+        headers: Dict[str, str] = {}
+        for ln in lines[1:]:
+            if not ln:
+                continue
+            k, colon, v = ln.partition(":")
+            if not colon:
+                raise ValueError(f"bad header line {ln!r}")
+            if escaped and command not in ("CONNECT", "CONNECTED"):
+                k, v = _unescape(k), _unescape(v)
+            headers.setdefault(k, v)  # first wins per spec
+        body_start = head_end + sep
+        if "content-length" in headers:
+            n = int(headers["content-length"])
+            if len(buf) < body_start + n + 1:
+                return
+            body = bytes(buf[body_start:body_start + n])
+            if buf[body_start + n:body_start + n + 1] != b"\x00":
+                raise ValueError("content-length does not reach NUL")
+            del buf[:body_start + n + 1]
+        else:
+            nul = buf.find(b"\x00", body_start)
+            if nul == -1:
+                if len(buf) > MAX_FRAME:
+                    raise ValueError("frame too large")
+                return
+            body = bytes(buf[body_start:nul])
+            del buf[:nul + 1]
+        yield StompFrame(command, headers, body)
+
+
+def serialize_frame(f: StompFrame) -> bytes:
+    esc = f.command not in ("CONNECT", "CONNECTED")
+    lines = [f.command]
+    for k, v in f.headers.items():
+        if esc:
+            k, v = _escape(str(k)), _escape(str(v))
+        lines.append(f"{k}:{v}")
+    if f.body and "content-length" not in f.headers:
+        lines.append(f"content-length:{len(f.body)}")
+    return ("\n".join(lines) + "\n\n").encode("utf-8") + f.body + b"\x00"
+
+
+class StompConn(GatewayConn):
+    """One STOMP client connection."""
+
+    def __init__(self, gw: "StompGateway", reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter) -> None:
+        super().__init__(gw.node, "stomp")
+        self.gw = gw
+        self.reader = reader
+        self.writer = writer
+        self.buf = bytearray()
+        self.connected = False
+        self.subs: Dict[str, Tuple[str, str]] = {}  # sub id -> (dest, ack)
+        self.pending_acks: Dict[str, int] = {}      # message-id -> pid
+        self._msg_seq = 0
+        self._hb_send = 0.0      # we -> client interval (s)
+        self._hb_recv = 0.0      # expected client -> us interval (s)
+        self._last_recv = time.monotonic()
+        self._tasks: List[asyncio.Task] = []
+
+    # -- inbound -----------------------------------------------------------
+
+    async def run(self) -> None:
+        try:
+            while not self.closed:
+                data = await self.reader.read(65536)
+                if not data:
+                    break
+                self._last_recv = time.monotonic()
+                self.buf.extend(data)
+                for frame in parse_frames(self.buf):
+                    self.handle_frame(frame)
+        except (ValueError, ConnectionError) as e:
+            self.send_error(str(e))
+        except asyncio.CancelledError:
+            pass
+        finally:
+            for t in self._tasks:
+                t.cancel()
+            self.detach_session(discard=True, reason="connection closed")
+            self.writer.close()
+            self.gw.clients.pop(id(self), None)
+
+    def handle_frame(self, f: StompFrame) -> None:
+        if f.command in ("CONNECT", "STOMP"):
+            return self.on_connect(f)
+        if not self.connected:
+            return self.send_error("not connected")
+        handler = {
+            "SEND": self.on_send,
+            "SUBSCRIBE": self.on_subscribe,
+            "UNSUBSCRIBE": self.on_unsubscribe,
+            "ACK": self.on_ack,
+            "NACK": self.on_nack,
+            "DISCONNECT": self.on_disconnect,
+            "BEGIN": self.on_unsupported_tx,
+            "COMMIT": self.on_unsupported_tx,
+            "ABORT": self.on_unsupported_tx,
+        }.get(f.command)
+        if handler is None:
+            return self.send_error(f"unknown command {f.command!r}")
+        handler(f)
+
+    def on_connect(self, f: StompFrame) -> None:
+        if self.connected:
+            return self.send_error("already connected")
+        versions = f.headers.get("accept-version", "1.0").split(",")
+        if "1.2" not in versions and "1.1" not in versions:
+            self.send_error("unsupported version")
+            return self.kick("version")
+        login = f.headers.get("login")
+        passcode = f.headers.get("passcode")
+        cid = f.headers.get("client-id") or f"stomp-{id(self) & 0xFFFFFF:x}"
+        self.clientid = cid
+        if not self.authenticate(login,
+                                 passcode.encode() if passcode else None):
+            self.send_error("authentication failed")
+            return self.kick("auth")
+        try:
+            cx, cy = (int(x) for x in
+                      f.headers.get("heart-beat", "0,0").split(","))
+        except ValueError:
+            cx, cy = 0, 0
+        sx, sy = 10_000, 10_000  # we can send/receive every 10 s
+        self._hb_send = max(sx, cy) / 1e3 if cy else 0.0
+        self._hb_recv = max(sy, cx) / 1e3 * 2 if cx else 0.0
+        self.attach_session(cid, clean_start=True)
+        self.connected = True
+        self._reply(StompFrame("CONNECTED", {
+            "version": "1.2" if "1.2" in versions else "1.1",
+            "server": "emqx-tpu-stomp",
+            "heart-beat": f"{sx},{sy}",
+            "session": cid,
+        }), receipt=f)
+        if self._hb_send or self._hb_recv:
+            self._tasks.append(asyncio.ensure_future(self._heartbeat()))
+
+    def on_send(self, f: StompFrame) -> None:
+        dest = f.headers.get("destination")
+        if not dest:
+            return self.send_error("SEND needs destination")
+        if not self.authorize("publish", dest):
+            return self.send_error(f"publish to {dest!r} denied")
+        props = {}
+        if "content-type" in f.headers:
+            props["Content-Type"] = f.headers["content-type"]
+        self.publish(dest, f.body, qos=0, properties=props)
+        self._receipt(f)
+
+    def on_subscribe(self, f: StompFrame) -> None:
+        sid = f.headers.get("id")
+        dest = f.headers.get("destination")
+        if not sid or not dest:
+            return self.send_error("SUBSCRIBE needs id and destination")
+        if not self.authorize("subscribe", dest):
+            return self.send_error(f"subscribe to {dest!r} denied")
+        ack = f.headers.get("ack", "auto")
+        qos = 0 if ack == "auto" else 1
+        # register the sub id BEFORE broker.subscribe: retained replay
+        # fires synchronously inside it and must find the mapping
+        self.subs[sid] = (dest, ack)
+        try:
+            self.subscribe(dest, qos=qos)
+        except ValueError as e:
+            del self.subs[sid]
+            return self.send_error(f"bad destination: {e}")
+        self._receipt(f)
+
+    def on_unsubscribe(self, f: StompFrame) -> None:
+        sid = f.headers.get("id")
+        entry = self.subs.pop(sid, None)
+        if entry is not None:
+            self.unsubscribe(entry[0])
+        self._receipt(f)
+
+    def on_ack(self, f: StompFrame) -> None:
+        mid = f.headers.get("id") or f.headers.get("message-id")
+        pid = self.pending_acks.pop(mid, None)
+        if pid is not None:
+            sess = self.node.broker.sessions.get(self.clientid)
+            if sess is not None:
+                _, more = sess.puback(pid)
+                if more:
+                    self.send_deliveries(more)
+        self._receipt(f)
+
+    def on_nack(self, f: StompFrame) -> None:
+        # message stays unacked; the session retry loop will redeliver
+        mid = f.headers.get("id") or f.headers.get("message-id")
+        self.pending_acks.pop(mid, None)
+        self._receipt(f)
+
+    def on_disconnect(self, f: StompFrame) -> None:
+        self._receipt(f)
+        self.detach_session(discard=True, reason="client disconnect")
+        self.kick("disconnect")
+
+    def on_unsupported_tx(self, f: StompFrame) -> None:
+        self.send_error("transactions not supported")
+
+    # -- outbound ----------------------------------------------------------
+
+    def send_deliveries(self, pubs: List[Publish]) -> None:
+        for pub in pubs:
+            # find the subscription(s) this topic matched
+            from .. import topic as T
+
+            matched = [
+                (sid, dest, ack) for sid, (dest, ack) in self.subs.items()
+                if T.match(pub.msg.topic, dest)
+            ]
+            if not matched:
+                continue
+            for sid, dest, ack in matched:
+                self._msg_seq += 1
+                mid = f"m{self._msg_seq}"
+                headers = {
+                    "subscription": sid,
+                    "message-id": mid,
+                    "destination": pub.msg.topic,
+                }
+                if ack != "auto":
+                    headers["ack"] = mid
+                ct = pub.msg.properties.get("Content-Type")
+                if ct:
+                    headers["content-type"] = ct
+                self._reply(StompFrame("MESSAGE", headers, pub.msg.payload))
+                if pub.pid is not None:
+                    if ack == "auto":
+                        sess = self.node.broker.sessions.get(self.clientid)
+                        if sess is not None:
+                            sess.puback(pub.pid)
+                    else:
+                        self.pending_acks[mid] = pub.pid
+
+    def send_error(self, msg: str) -> None:
+        try:
+            self._reply(StompFrame("ERROR", {"message": msg}))
+        except Exception:
+            pass
+
+    def _receipt(self, f: StompFrame) -> None:
+        rid = f.headers.get("receipt")
+        if rid:
+            self._reply(StompFrame("RECEIPT", {"receipt-id": rid}))
+
+    def _reply(self, frame: StompFrame, receipt: Optional[StompFrame] = None
+               ) -> None:
+        self.writer.write(serialize_frame(frame))
+        if receipt is not None:
+            self._receipt(receipt)
+
+    async def _heartbeat(self) -> None:
+        period = min(x for x in (self._hb_send, self._hb_recv) if x) / 2 \
+            if (self._hb_send or self._hb_recv) else 5.0
+        while not self.closed:
+            await asyncio.sleep(period)
+            if self._hb_recv and (
+                time.monotonic() - self._last_recv > self._hb_recv
+            ):
+                self.kick("heart-beat timeout")
+                return
+            if self._hb_send:
+                self.writer.write(b"\n")
+
+    def close_transport(self, reason: str) -> None:
+        self.writer.close()
+
+
+class StompGateway(Gateway):
+    name = "stomp"
+
+    def __init__(self, node: Any, conf: Dict[str, Any]) -> None:
+        super().__init__(node, conf)
+        self.server: Optional[asyncio.AbstractServer] = None
+        self.port = 0
+
+    async def start(self) -> None:
+        bind = self.conf.get("bind", "127.0.0.1:61613")
+        host, _, port = bind.rpartition(":")
+
+        async def handle(reader, writer):
+            conn = StompConn(self, reader, writer)
+            self.clients[id(conn)] = conn
+            await conn.run()
+
+        self.server = await asyncio.start_server(
+            handle, host or "0.0.0.0", int(port)
+        )
+        self.port = self.server.sockets[0].getsockname()[1]
+        log.info("stomp gateway listening on %s:%d", host, self.port)
+
+    async def stop(self) -> None:
+        for conn in list(self.clients.values()):
+            conn.kick("gateway stopped")
+        if self.server is not None:
+            self.server.close()
+            await self.server.wait_closed()
+        self.clients.clear()
+
+    def info(self) -> Dict[str, Any]:
+        return {**super().info(), "port": self.port}

@@ -90,6 +90,7 @@ class MgmtApi:
         r("DELETE", f"{v}/bridges/{{bridge_id}}", self.bridges_delete)
         r("POST", f"{v}/bridges/{{bridge_id}}/enable/{{enable}}",
           self.bridges_enable)
+        r("GET", f"{v}/gateways", self.gateways_list)
         r("GET", f"{v}/cluster", self.cluster)
         r("GET", f"{v}/exhooks", self.exhooks)
         r("GET", f"{v}/configs", self.configs_get)
@@ -449,6 +450,10 @@ class MgmtApi:
         if not self.node.rule_engine.delete_rule(req.params["rule_id"]):
             raise KeyError(req.params["rule_id"])
         return Response(204)
+
+    async def gateways_list(self, req: Request) -> Response:
+        gws = getattr(self.node, "gateways", None)
+        return json_response(gws.list() if gws is not None else [])
 
     # ------------------------------------------------------------------
     # bridges (emqx_bridge REST analog)

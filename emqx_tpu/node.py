@@ -125,6 +125,7 @@ class BrokerNode:
         self.match_service = None  # in-process TPU matcher (start())
         self.mgmt = None
         self.mgmt_server = None
+        self.gateways = None  # GatewayManager, built in start()
         self.limiter = LimiterGroup(
             max_conn_rate=cfg.get("limiter.max_conn_rate"),
             max_messages_rate=cfg.get("limiter.max_messages_rate"),
@@ -364,9 +365,26 @@ class BrokerNode:
         await self._start_cluster()
         await self._start_exhook()
         await self._start_mgmt()
+        await self._start_gateways()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(asyncio.ensure_future(self._housekeeping()))
+
+    async def _start_gateways(self) -> None:
+        from .gateway import GatewayManager
+
+        self.gateways = GatewayManager(self)
+        for name in ("stomp", "mqttsn"):
+            if not self.config.get(f"gateway.{name}.enable"):
+                continue
+            conf = {"bind": self.config.get(f"gateway.{name}.bind")}
+            if name == "mqttsn":
+                conf["gateway_id"] = self.config.get(
+                    "gateway.mqttsn.gateway_id")
+            try:
+                await self.gateways.load(name, conf)
+            except Exception:
+                log.exception("gateway %s failed to start", name)
 
     async def _start_match_service(self) -> None:
         if not self.config.get("tpu.enable"):
@@ -465,6 +483,8 @@ class BrokerNode:
 
     async def stop(self) -> None:
         self._running = False
+        if getattr(self, "gateways", None) is not None:
+            await self.gateways.stop_all()
         await self.bridges.stop_all()
         if self.match_service is not None:
             await self.match_service.stop()
