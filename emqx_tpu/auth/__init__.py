@@ -17,10 +17,12 @@ from .authn import (
 )
 from .authz import AclRule, Authz, BuiltinDbSource, FileSource, compile_acl_batch
 from .access_control import attach_auth
+from .external import HttpAuthenticator, HttpAuthzSource, JwksJwtAuthenticator
 
 __all__ = [
     "AuthChain", "BuiltinDbAuthenticator", "JwtAuthenticator",
     "Credentials", "hash_password",
     "AclRule", "Authz", "BuiltinDbSource", "FileSource",
     "compile_acl_batch", "attach_auth",
+    "HttpAuthenticator", "HttpAuthzSource", "JwksJwtAuthenticator",
 ]

@@ -73,6 +73,51 @@ class AccessControl:
         self._usernames.pop(clientid, None)
         self._peerhosts.pop(clientid, None)
 
+    # -- async pre-resolution (external HTTP/JWKS backends) ----------------
+    #
+    # The hook folds above are synchronous; network-backed authn/authz
+    # resolve here first (node packet intercept, async per-connection)
+    # and park their verdicts for the fold to consume.
+
+    def needs_async(self) -> bool:
+        return any(
+            hasattr(a, "authenticate_async") for a in self.chain._chain
+        ) or any(
+            hasattr(s, "prefetch_async") for s in self.authz.sources
+        )
+
+    async def preauthenticate(self, channel, pkt) -> None:
+        creds = Credentials(
+            pkt.clientid, pkt.username, pkt.password,
+            (channel.conninfo or {}).get("peerhost")
+            if isinstance(getattr(channel, "conninfo", None), dict) else None,
+        )
+        for a in self.chain._chain:
+            if hasattr(a, "authenticate_async"):
+                res = await a.authenticate_async(creds)
+            else:
+                res = a.authenticate(creds)
+            if res.outcome != "ignore":
+                return  # the sync walk stops here too
+
+    async def preauthorize(self, clientid, action, topic, qos=0) -> None:
+        if clientid is None or self._superusers.get(clientid, False):
+            return
+        username = self._usernames.get(clientid)
+        peerhost = self._peerhosts.get(clientid)
+        for src in self.authz.sources:
+            if hasattr(src, "prefetch_async"):
+                v = await src.prefetch_async(
+                    clientid, username, peerhost, action, topic)
+            else:
+                try:
+                    v = src.authorize(clientid, username, peerhost, action,
+                                      topic, qos=qos)
+                except Exception:
+                    v = "nomatch"
+            if v != "nomatch":
+                return
+
 
 def attach_auth(broker: Broker, chain: AuthChain, authz: Authz) -> AccessControl:
     ac = AccessControl(chain, authz)

@@ -1,0 +1,418 @@
+"""External authn/authz backends: HTTP authenticator, JWKS (RS256) JWT,
+HTTP authz source.
+
+Behavioral reference: ``apps/emqx_authn/.../http``, ``jwks`` and
+``apps/emqx_authz/.../http`` [U] (SURVEY.md §2.3).
+
+Async discipline: the broker's auth hook folds are synchronous (they run
+inside the channel FSM), so network backends resolve in TWO stages —
+the node's packet intercept (async, per-connection) calls
+``*_async`` first and parks the verdict; the sync fold then consumes it
+without touching the event loop.  When no intercept ran (direct library
+use, tests), the sync path falls back to a short-timeout blocking
+request so behavior is still correct, just serialized.
+
+Response contract (the reference's HTTP authn/authz):
+* authn — 200 with JSON ``{"result": "allow"|"deny"|"ignore",
+  "is_superuser": bool}``; 204 = allow; 4xx/5xx or timeout = ignore.
+* authz — 200 with JSON ``{"result": "allow"|"deny"|"ignore"}``;
+  204 = allow; anything else / error = nomatch (next source).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+from .authn import (
+    IGNORE, AuthResult, Credentials, _b64url_decode,
+)
+from .authz import NOMATCH
+
+log = logging.getLogger(__name__)
+
+__all__ = ["HttpAuthenticator", "JwksJwtAuthenticator", "HttpAuthzSource"]
+
+
+def _render(template: Any, ctx: Dict[str, Any]) -> Any:
+    """``${var}`` substitution through nested dict/str templates."""
+    if isinstance(template, str):
+        out = template
+        for k, v in ctx.items():
+            out = out.replace("${" + k + "}", "" if v is None else str(v))
+        return out
+    if isinstance(template, dict):
+        return {k: _render(v, ctx) for k, v in template.items()}
+    return template
+
+
+def _in_event_loop() -> bool:
+    import asyncio
+
+    try:
+        asyncio.get_running_loop()
+        return True
+    except RuntimeError:
+        return False
+
+
+def _blocking_json_request(method: str, url: str, headers: Dict[str, str],
+                           body: Optional[bytes], timeout: float):
+    """Short-timeout stdlib fallback for non-intercepted (sync) calls.
+    NEVER used from inside a running event loop — callers check
+    ``_in_event_loop()`` and fail soft (ignore/nomatch) instead: one slow
+    backend must not stall every connection on the loop."""
+    req = urllib.request.Request(url, data=body, method=method.upper())
+    for k, v in headers.items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:  # noqa: S310
+        return resp.status, resp.read()
+
+
+class _HttpBackend:
+    """Shared request/render/parse logic for authn + authz over HTTP."""
+
+    def __init__(self, url: str, method: str = "post",
+                 headers: Optional[Dict[str, str]] = None,
+                 body: Optional[Dict[str, Any]] = None,
+                 timeout: float = 5.0) -> None:
+        self.url = url
+        self.method = method.lower()
+        self.headers = {"content-type": "application/json",
+                        **(headers or {})}
+        self.body = body or {}
+        self.timeout = timeout
+
+    def _prepare(self, ctx: Dict[str, Any]):
+        url = _render(self.url, ctx)
+        rendered = _render(self.body, ctx)
+        if self.method == "get":
+            from urllib.parse import urlencode
+
+            qs = urlencode(rendered)
+            sep = "&" if "?" in url else "?"
+            return "GET", (url + sep + qs if qs else url), None
+        return "POST", url, json.dumps(rendered).encode()
+
+    async def request_async(self, ctx: Dict[str, Any]):
+        from ..bridge import httpc
+
+        method, url, body = self._prepare(ctx)
+        resp = await httpc.request(
+            method, url, headers=self.headers, body=body or b"",
+            timeout=self.timeout,
+        )
+        return resp.status, resp.body
+
+    def request_blocking(self, ctx: Dict[str, Any]):
+        method, url, body = self._prepare(ctx)
+        return _blocking_json_request(method, url, self.headers, body,
+                                      self.timeout)
+
+    @staticmethod
+    def parse(status: int, body: bytes) -> Tuple[str, Dict[str, Any]]:
+        if status == 204:
+            return "allow", {}
+        if status != 200:
+            return "ignore", {}
+        try:
+            doc = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            return "ignore", {}
+        if not isinstance(doc, dict):
+            return "ignore", {}
+        return str(doc.get("result", "ignore")), doc
+
+
+class HttpAuthenticator:
+    """HTTP authn backend with async pre-resolution."""
+
+    def __init__(self, url: str, method: str = "post",
+                 headers: Optional[Dict[str, str]] = None,
+                 body: Optional[Dict[str, Any]] = None,
+                 timeout: float = 5.0) -> None:
+        self.backend = _HttpBackend(url, method, headers, body or {
+            "clientid": "${clientid}",
+            "username": "${username}",
+            "password": "${password}",
+        }, timeout)
+        self._parked: Dict[Tuple, AuthResult] = {}
+
+    @staticmethod
+    def _ctx(creds: Credentials) -> Dict[str, Any]:
+        return {
+            "clientid": creds.clientid,
+            "username": creds.username,
+            "password": (creds.password or b"").decode("utf-8",
+                                                       "surrogateescape"),
+            "peerhost": creds.peerhost,
+        }
+
+    @staticmethod
+    def _key(creds: Credentials) -> Tuple:
+        return (creds.clientid, creds.username, creds.password)
+
+    @staticmethod
+    def _to_result(verdict: str, doc: Dict[str, Any]) -> AuthResult:
+        if verdict == "allow":
+            attrs = {}
+            if "acl" in doc:
+                attrs["acl"] = doc["acl"]
+            return AuthResult("ok", is_superuser=bool(doc.get("is_superuser")),
+                              attrs=attrs)
+        if verdict == "deny":
+            return AuthResult("deny")
+        return IGNORE
+
+    async def authenticate_async(self, creds: Credentials) -> AuthResult:
+        """Intercept stage: resolve + park for the sync fold."""
+        try:
+            status, body = await self.backend.request_async(self._ctx(creds))
+            res = self._to_result(*self.backend.parse(status, body))
+        except Exception as e:
+            log.warning("http authn %s unreachable: %s", self.backend.url, e)
+            res = IGNORE   # unreachable backend never locks users out
+        # bound the parked set: verdicts that are never consumed (client
+        # vanished between intercept and CONNECT processing, banned
+        # earlier in the fold) must not accumulate
+        while len(self._parked) >= 512:
+            self._parked.pop(next(iter(self._parked)))
+        self._parked[self._key(creds)] = res
+        return res
+
+    def authenticate(self, creds: Credentials) -> AuthResult:
+        parked = self._parked.pop(self._key(creds), None)
+        if parked is None and creds.clientid:
+            # empty-clientid CONNECTs park under "" before the channel
+            # assigns the server-generated id the fold sees
+            parked = self._parked.pop(
+                ("", creds.username, creds.password), None)
+        if parked is not None:
+            return parked
+        if _in_event_loop():
+            # no parked verdict and we're ON the loop: never block it —
+            # unresolved network authn degrades to ignore
+            log.warning("http authn %s: no pre-resolved verdict; ignoring",
+                        self.backend.url)
+            return IGNORE
+        try:
+            status, body = self.backend.request_blocking(self._ctx(creds))
+            return self._to_result(*self.backend.parse(status, body))
+        except Exception as e:
+            log.warning("http authn %s unreachable: %s", self.backend.url, e)
+            return IGNORE
+
+
+# ---------------------------------------------------------------------------
+# JWKS (RS256) — dependency-free RSASSA-PKCS1-v1_5 verification
+# ---------------------------------------------------------------------------
+
+_SHA256_PREFIX = bytes.fromhex("3031300d060960864801650304020105000420")
+
+
+def _rsa_verify_sha256(n: int, e: int, message: bytes, sig: bytes) -> bool:
+    k = (n.bit_length() + 7) // 8
+    if len(sig) != k:
+        return False
+    m = pow(int.from_bytes(sig, "big"), e, n)
+    em = m.to_bytes(k, "big")
+    # EMSA-PKCS1-v1_5: 0x00 0x01 PS(0xff..) 0x00 DigestInfo
+    digest = hashlib.sha256(message).digest()
+    t = _SHA256_PREFIX + digest
+    ps_len = k - len(t) - 3
+    if ps_len < 8:
+        return False
+    expected = b"\x00\x01" + b"\xff" * ps_len + b"\x00" + t
+    return expected == em
+
+
+class JwksJwtAuthenticator:
+    """RS256 JWT verified against a JWKS endpoint.
+
+    Keys refresh asynchronously (intercept stage / background); the sync
+    path verifies with the cached key set only, returning ignore when a
+    token's kid is unknown AND no refresh could run."""
+
+    def __init__(self, jwks_url: str, *,
+                 verify_claims: Optional[Dict[str, str]] = None,
+                 refresh_interval: float = 300.0,
+                 timeout: float = 5.0) -> None:
+        self.jwks_url = jwks_url
+        self.verify_claims = verify_claims or {}
+        self.refresh_interval = refresh_interval
+        self.timeout = timeout
+        self._keys: Dict[str, Tuple[int, int]] = {}   # kid -> (n, e)
+        self._fetched_at = 0.0
+
+    # -- key management ----------------------------------------------------
+
+    def _load_jwks(self, doc: Dict[str, Any]) -> None:
+        keys = {}
+        for k in doc.get("keys", []):
+            if k.get("kty") != "RSA":
+                continue
+            try:
+                n = int.from_bytes(_b64url_decode(k["n"]), "big")
+                e = int.from_bytes(_b64url_decode(k["e"]), "big")
+            except (KeyError, ValueError):
+                continue
+            keys[k.get("kid", "")] = (n, e)
+        if keys:
+            self._keys = keys
+            self._fetched_at = time.time()
+
+    async def refresh_async(self, force: bool = False) -> None:
+        if not force and time.time() - self._fetched_at < self.refresh_interval:
+            return
+        from ..bridge import httpc
+
+        try:
+            resp = await httpc.request("GET", self.jwks_url,
+                                       timeout=self.timeout)
+            if resp.status == 200:
+                self._load_jwks(json.loads(resp.body))
+        except Exception as e:
+            log.warning("jwks fetch %s failed: %s", self.jwks_url, e)
+
+    def refresh_blocking(self) -> None:
+        try:
+            status, body = _blocking_json_request(
+                "GET", self.jwks_url, {}, None, self.timeout)
+            if status == 200:
+                self._load_jwks(json.loads(body))
+        except Exception as e:
+            log.warning("jwks fetch %s failed: %s", self.jwks_url, e)
+
+    # -- verification ------------------------------------------------------
+
+    def _verify(self, creds: Credentials) -> AuthResult:
+        token = (creds.password or b"").decode("ascii", "ignore")
+        if token.count(".") != 2:
+            return IGNORE
+        h64, b64, s64 = token.split(".")
+        try:
+            header = json.loads(_b64url_decode(h64))
+            claims = json.loads(_b64url_decode(b64))
+            sig = _b64url_decode(s64)
+        except (ValueError, json.JSONDecodeError):
+            return IGNORE
+        if not isinstance(header, dict) or not isinstance(claims, dict):
+            return IGNORE
+        if header.get("alg") != "RS256":
+            return IGNORE
+        kid = header.get("kid", "")
+        key = self._keys.get(kid)
+        if key is None and len(self._keys) == 1 and kid == "":
+            key = next(iter(self._keys.values()))
+        if key is None:
+            return IGNORE
+        if not _rsa_verify_sha256(key[0], key[1],
+                                  f"{h64}.{b64}".encode(), sig):
+            return AuthResult("deny")
+        now = time.time()
+        if "exp" in claims and now >= float(claims["exp"]):
+            return AuthResult("deny")
+        if "nbf" in claims and now < float(claims["nbf"]):
+            return AuthResult("deny")
+        for claim, expect in self.verify_claims.items():
+            expect = expect.replace("%c", creds.clientid).replace(
+                "%u", creds.username or "")
+            if str(claims.get(claim)) != expect:
+                return AuthResult("deny")
+        return AuthResult("ok",
+                          is_superuser=bool(claims.get("is_superuser")))
+
+    async def authenticate_async(self, creds: Credentials) -> AuthResult:
+        await self.refresh_async()
+        res = self._verify(creds)
+        if res.outcome == "ignore" and (creds.password or b"").count(b".") == 2:
+            # unknown kid: force one refresh then retry (key rotation)
+            await self.refresh_async(force=True)
+            res = self._verify(creds)
+        return res
+
+    def authenticate(self, creds: Credentials) -> AuthResult:
+        if not self._keys and not _in_event_loop():
+            self.refresh_blocking()
+        return self._verify(creds)
+
+
+# ---------------------------------------------------------------------------
+# HTTP authz source
+# ---------------------------------------------------------------------------
+
+class HttpAuthzSource:
+    """HTTP authz with async pre-resolution + short TTL verdict cache
+    (its own cache is per-request-key; the Authz pipeline's LRU caches
+    the final verdict on top)."""
+
+    def __init__(self, url: str, method: str = "post",
+                 headers: Optional[Dict[str, str]] = None,
+                 body: Optional[Dict[str, Any]] = None,
+                 timeout: float = 5.0, cache_ttl: float = 10.0) -> None:
+        self.backend = _HttpBackend(url, method, headers, body or {
+            "clientid": "${clientid}",
+            "username": "${username}",
+            "topic": "${topic}",
+            "action": "${action}",
+        }, timeout)
+        self.cache_ttl = cache_ttl
+        self._cache: Dict[Tuple, Tuple[str, float]] = {}
+
+    @staticmethod
+    def _ctx(clientid, username, peerhost, action, topic) -> Dict[str, Any]:
+        return {"clientid": clientid, "username": username,
+                "peerhost": peerhost, "action": action, "topic": topic}
+
+    @staticmethod
+    def _verdict(v: str) -> str:
+        return v if v in ("allow", "deny") else NOMATCH
+
+    async def prefetch_async(self, clientid, username, peerhost, action,
+                             topic) -> str:
+        key = (clientid, username, action, topic)
+        hit = self._cache.get(key)
+        now = time.time()
+        if hit is not None and now - hit[1] < self.cache_ttl:
+            return hit[0]
+        try:
+            status, body = await self.backend.request_async(
+                self._ctx(clientid, username, peerhost, action, topic))
+            verdict = self._verdict(self.backend.parse(status, body)[0])
+        except Exception as e:
+            log.warning("http authz %s unreachable: %s", self.backend.url, e)
+            verdict = NOMATCH
+        self._cache[key] = (verdict, now)
+        if len(self._cache) > 4096:
+            cutoff = now - self.cache_ttl
+            self._cache = {k: v for k, v in self._cache.items()
+                           if v[1] >= cutoff}
+        return verdict
+
+    def authorize(self, clientid, username, peerhost, action, topic,
+                  **kw) -> str:
+        key = (clientid, username, action, topic)
+        hit = self._cache.get(key)
+        if hit is not None and time.time() - hit[1] < self.cache_ttl:
+            return hit[0]
+        if _in_event_loop():
+            # cache miss ON the loop (prefetch didn't run or covered a
+            # different topic): never block the loop — nomatch lets the
+            # next source / no_match policy decide this one request
+            log.warning("http authz %s: un-prefetched key; nomatch",
+                        self.backend.url)
+            return NOMATCH
+        try:
+            status, body = self.backend.request_blocking(
+                self._ctx(clientid, username, peerhost, action, topic))
+            verdict = self._verdict(self.backend.parse(status, body)[0])
+        except Exception as e:
+            log.warning("http authz %s unreachable: %s", self.backend.url, e)
+            verdict = NOMATCH
+        self._cache[key] = (verdict, time.time())
+        return verdict

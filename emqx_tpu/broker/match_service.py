@@ -419,6 +419,13 @@ class MatchService:
         except Exception:
             pass  # timeout/cancel: publish falls back to the host path
 
+    def hint_available(self, topic: str) -> bool:
+        """Non-consuming freshness peek (observability/tracing): True iff
+        a device hint would serve this topic right now.  No metrics, no
+        cache mutation — safe to call from taps."""
+        hint = self._hints.get(topic)
+        return hint is not None and self._hint_fresh(topic, hint[0])
+
     def hint_routes(self, topic: str):
         """Sync stage (Broker.publish): provably-fresh hint → routes,
         else None (host trie serves)."""

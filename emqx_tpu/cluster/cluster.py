@@ -120,6 +120,13 @@ class Cluster:
         self._running = False
         self.forwards_out = 0
         self.forwards_in = 0
+        # cluster config sync (emqx_conf analog).  The txn counter seeds
+        # from the wall clock so a RESTARTED node's updates still sort
+        # after its previous life's (peers keep per-origin high-water
+        # marks; a reset-to-zero counter would be silently discarded)
+        self._config_txn = int(time.time() * 1000)
+        self._config_seen: Dict[str, int] = {}  # origin -> last txn applied
+        self._applying_remote_config = False
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -170,6 +177,43 @@ class Cluster:
         hooks.add("session.terminated",
                   lambda cid: self._broadcast_session_op(cid, pb.SessionOp.DEL),
                   name="cluster.session.terminated")
+        # cluster-wide config sync: every locally-validated put (REST,
+        # CLI, library) broadcasts AFTER its handlers ran clean — the
+        # reference's check-then-broadcast two-phase (emqx_conf [U])
+        self.node.config.on_update("", self._on_local_config_update)
+
+    def _on_local_config_update(self, path: str, old: Any, new: Any) -> None:
+        if self._applying_remote_config or not self._running:
+            return
+        import json as _json
+
+        self._config_txn += 1
+        frame = pb.ClusterFrame(config_update=pb.ConfigUpdate(
+            origin=self.name, txn=self._config_txn, path=path,
+            value_json=_json.dumps(new, default=str),
+        ))
+        for peer in self.peers.values():
+            if peer.conn is not None:
+                peer.conn.cast(frame)
+
+    def _apply_config_update(self, cu: "pb.ConfigUpdate") -> None:
+        if cu.origin == self.name:
+            return
+        if self._config_seen.get(cu.origin, 0) >= cu.txn:
+            return  # replay/reorder: already applied
+        self._config_seen[cu.origin] = cu.txn
+        import json as _json
+
+        self._applying_remote_config = True
+        try:
+            self.node.config.put(cu.path, _json.loads(cu.value_json))
+        except Exception:
+            # a node that can't apply keeps serving with its old value —
+            # same degradation the reference accepts on apply failure
+            log.exception("remote config update %s=%s failed",
+                          cu.path, cu.value_json)
+        finally:
+            self._applying_remote_config = False
 
     def _detach_broker(self) -> None:
         self.broker.on_forward = None
@@ -178,6 +222,7 @@ class Cluster:
         self.broker.hooks.delete(
             "session.terminated", "cluster.session.terminated"
         )
+        self.node.config.remove_handler(self._on_local_config_update)
 
     # ------------------------------------------------------------------
     # membership
@@ -429,6 +474,12 @@ class Cluster:
                     snap.routes.append(self._entry(flt, dest))
         for cid in self.broker.sessions:
             snap.session_clientids.append(cid)
+        import json as _json
+
+        for path, value in self.node.config.runtime_overrides().items():
+            snap.config.append(pb.Snapshot.ConfigEntry(
+                path=path, value_json=_json.dumps(value, default=str),
+            ))
         return snap
 
     def _apply_snapshot(self, snap: pb.Snapshot) -> None:
@@ -449,6 +500,18 @@ class Cluster:
         peer = self.peers.get(origin)
         if peer is not None:
             peer.route_seq = snap.epoch
+        # adopt the cluster's hot config state (joiner side of emqx_conf)
+        import json as _json
+
+        for entry in snap.config:
+            self._applying_remote_config = True
+            try:
+                self.node.config.put(entry.path,
+                                     _json.loads(entry.value_json))
+            except Exception:
+                log.exception("snapshot config %s apply failed", entry.path)
+            finally:
+                self._applying_remote_config = False
 
     # ------------------------------------------------------------------
     # forwarding (broker seams)
@@ -629,6 +692,9 @@ class Cluster:
                 self._registry[op.clientid] = op.origin
             elif self._registry.get(op.clientid) == op.origin:
                 del self._registry[op.clientid]
+            return None
+        if kind == "config_update":
+            self._apply_config_update(frame.config_update)
             return None
         if kind == "takeover_request":
             return pb.ClusterFrame(

@@ -228,6 +228,10 @@ SCHEMA: Dict[str, Field] = {
     "tpu.active_slots": Field(16, int),
     "tpu.max_matches": Field(32, int),
     "tpu.mirror_refresh_interval": Field(0.05, duration),
+    # bound on device bring-up (first XLA compile is ~20-40s; a WEDGED
+    # device tunnel would otherwise hang node start forever — on timeout
+    # the node serves from the host trie)
+    "tpu.start_timeout": Field(180.0, duration),
     "tpu.mesh_shape": Field("dp=1,tp=1", str),
     "tpu.fail_open": Field(True, _bool),
     # serving tolerates up to this many un-synced router deltas before
@@ -422,6 +426,9 @@ class Config:
         }
         self._zones: Dict[str, Dict[str, Any]] = {}
         self._handlers: List[Tuple[str, Callable[[str, Any, Any], None]]] = []
+        # runtime (hot-update) layer: what `put` changed since boot — the
+        # part of config that cluster sync replicates and joiners adopt
+        self._runtime: Dict[str, Any] = {}
         if file_text:
             self.load_dict(parse_hocon(file_text), strict=strict)
         self.load_env(env if env is not None else dict(os.environ))
@@ -483,6 +490,12 @@ class Config:
         """Register ``fn(path, old, new)`` for keys under ``prefix``."""
         self._handlers.append((prefix, fn))
 
+    def remove_handler(self, fn: Callable[[str, Any, Any], None]) -> bool:
+        """Unregister a hot-update handler by identity (all prefixes)."""
+        before = len(self._handlers)
+        self._handlers = [(p, f) for p, f in self._handlers if f is not fn]
+        return len(self._handlers) != before
+
     def put(self, path: str, raw: Any) -> Any:
         """Validated runtime update; handlers run after the value lands.
         A handler raising rolls the value back (two-phase, like the
@@ -499,7 +512,12 @@ class Config:
         except Exception:
             self._values[path] = old
             raise
+        self._runtime[path] = new
         return new
+
+    def runtime_overrides(self) -> Dict[str, Any]:
+        """Hot-updated keys and their current values (cluster sync)."""
+        return dict(self._runtime)
 
 
 class ZoneView:

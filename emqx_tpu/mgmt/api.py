@@ -91,6 +91,11 @@ class MgmtApi:
         r("POST", f"{v}/bridges/{{bridge_id}}/enable/{{enable}}",
           self.bridges_enable)
         r("GET", f"{v}/gateways", self.gateways_list)
+        r("GET", f"{v}/trace", self.trace_list)
+        r("POST", f"{v}/trace", self.trace_create)
+        r("DELETE", f"{v}/trace/{{name}}", self.trace_delete)
+        r("PUT", f"{v}/trace/{{name}}/stop", self.trace_stop)
+        r("GET", f"{v}/trace/{{name}}/download", self.trace_download)
         r("GET", f"{v}/cluster", self.cluster)
         r("GET", f"{v}/exhooks", self.exhooks)
         r("GET", f"{v}/configs", self.configs_get)
@@ -454,6 +459,54 @@ class MgmtApi:
     async def gateways_list(self, req: Request) -> Response:
         gws = getattr(self.node, "gateways", None)
         return json_response(gws.list() if gws is not None else [])
+
+    # ------------------------------------------------------------------
+    # tracing (emqx_trace REST analog)
+    # ------------------------------------------------------------------
+
+    async def trace_list(self, req: Request) -> Response:
+        return json_response(self.node.tracing.list())
+
+    async def trace_create(self, req: Request) -> Response:
+        body = req.json() or {}
+        type_ = body.get("type")
+        value = body.get(type_) if type_ else None
+        if value is None:
+            value = body.get("value")
+        if not body.get("name") or not type_ or value is None:
+            raise ValueError("name, type and the filter value are required")
+        try:
+            tr = self.node.tracing.create(
+                body["name"], type_, value,
+                duration_s=float(body.get("duration", 600)),
+                start_at=body.get("start_at"),
+                end_at=body.get("end_at"),
+            )
+        except ValueError as e:
+            if "exists" in str(e):
+                return json_response(
+                    {"code": "ALREADY_EXISTS", "message": str(e)}, 409)
+            raise
+        return json_response(tr.info(), 201)
+
+    async def trace_delete(self, req: Request) -> Response:
+        if not self.node.tracing.delete(req.params["name"]):
+            raise KeyError(req.params["name"])
+        return Response(204)
+
+    async def trace_stop(self, req: Request) -> Response:
+        if not self.node.tracing.stop(req.params["name"]):
+            raise KeyError(req.params["name"])
+        return json_response(
+            self.node.tracing.traces[req.params["name"]].info())
+
+    async def trace_download(self, req: Request) -> Response:
+        data = self.node.tracing.read(req.params["name"])
+        return Response(
+            200, data, content_type="application/octet-stream",
+            headers={"Content-Disposition":
+                     f'attachment; filename="{req.params["name"]}.jsonl"'},
+        )
 
     # ------------------------------------------------------------------
     # bridges (emqx_bridge REST analog)

@@ -106,6 +106,9 @@ class BrokerNode:
                     no_match=cfg.get("authz.no_match")
                 ),
             )
+        from .observe.trace import TraceManager
+
+        self.tracing = TraceManager(self)
         self._attach_client_metrics()
         self._register_config_handlers()
         # session expiry: clientid -> disconnect time, swept by
@@ -298,6 +301,8 @@ class BrokerNode:
             self.exhook is not None
             or self.cluster is not None
             or self.match_service is not None
+            or (self.access_control is not None
+                and self.access_control.needs_async())
         ):
             conn.intercept = self._intercept
         self._all_conns.add(conn)
@@ -356,6 +361,28 @@ class BrokerNode:
                 await self.match_service.prefetch(pkt.topic)
             except Exception:
                 log.exception("match prefetch failed (host path serves)")
+        ac = self.access_control
+        if ac is not None and ac.needs_async():
+            # resolve network auth backends OFF the sync hook fold: the
+            # verdicts park in the backends and the fold consumes them
+            try:
+                if pkt.type == P.CONNECT:
+                    await ac.preauthenticate(channel, pkt)
+                elif pkt.type == P.PUBLISH:
+                    # MQTT5 topic-alias publishes carry an empty topic;
+                    # resolve through the channel's alias map so the
+                    # prefetch covers the EFFECTIVE topic
+                    topic = channel.peek_topic(pkt)
+                    if topic:
+                        await ac.preauthorize(
+                            channel.clientid, "publish", topic, pkt.qos)
+                elif pkt.type == P.SUBSCRIBE:
+                    for flt, opts in pkt.topic_filters:
+                        await ac.preauthorize(
+                            channel.clientid, "subscribe", flt,
+                            opts.get("qos", 0))
+            except Exception:
+                log.exception("async auth pre-resolution failed")
         if self.exhook is not None:
             return await self.exhook.intercept(channel, pkt)
         return None
@@ -406,10 +433,13 @@ class BrokerNode:
                 bypass_rate=cfg.get("tpu.bypass_rate"),
                 prefetch_timeout_s=cfg.get("tpu.prefetch_timeout"),
             )
-            await self.match_service.start()
+            await asyncio.wait_for(
+                self.match_service.start(),
+                timeout=cfg.get("tpu.start_timeout"),
+            )
             self.broker.device_match = self.match_service.hint_routes
             self.rule_engine.attach_match_service(self.match_service)
-        except Exception:
+        except (Exception, asyncio.TimeoutError):
             log.exception("TPU match service unavailable; host trie serves")
             self.match_service = None
 

@@ -271,3 +271,74 @@ def test_hello_rejected_on_name_conflict():
             await n1.stop()
 
     run(main())
+
+
+def test_cluster_config_sync_two_phase():
+    """emqx_conf analog: a validated config put on node A applies on
+    node B; a joiner adopts runtime overrides from the snapshot; local
+    validation failure broadcasts nothing."""
+    async def main():
+        n1 = await start_cluster_node("cs1@test")
+        n2 = await start_cluster_node("cs2@test", seeds=cluster_addr(n1))
+        try:
+            assert await peered(n1, n2)
+
+            n1.config.put("mqtt.max_inflight", 7)
+            assert await settle(
+                lambda: n2.config.get("mqtt.max_inflight") == 7)
+
+            # B -> A direction too
+            n2.config.put("flapping_detect.max_count", 42)
+            assert await settle(
+                lambda: n1.config.get("flapping_detect.max_count") == 42)
+
+            # invalid value: rejected locally, nothing broadcast
+            with pytest.raises(Exception):
+                n1.config.put("mqtt.max_inflight", "not-a-number")
+            await asyncio.sleep(0.1)
+            assert n2.config.get("mqtt.max_inflight") == 7
+
+            # a NEW joiner adopts the overrides via snapshot bootstrap
+            n3 = await start_cluster_node("cs3@test",
+                                          seeds=cluster_addr(n1))
+            try:
+                assert await settle(
+                    lambda: n3.config.get("mqtt.max_inflight") == 7
+                    and n3.config.get("flapping_detect.max_count") == 42)
+            finally:
+                await n3.stop()
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())
+
+
+def test_config_sync_survives_origin_restart():
+    """A restarted node's config updates must not be discarded by peers
+    holding the previous life's txn high-water mark."""
+    async def main():
+        n1 = await start_cluster_node("cr1@test")
+        n2 = await start_cluster_node("cr2@test", seeds=cluster_addr(n1))
+        try:
+            assert await peered(n1, n2)
+            for i in range(3):
+                n1.config.put("mqtt.max_inflight", 10 + i)
+            assert await settle(
+                lambda: n2.config.get("mqtt.max_inflight") == 12)
+
+            name = "cr1@test"
+            await n1.stop()
+            # same node name rejoins with a fresh Cluster instance
+            n1b = await start_cluster_node(name, seeds=cluster_addr(n2))
+            try:
+                assert await peered(n1b, n2)
+                n1b.config.put("mqtt.max_inflight", 99)
+                assert await settle(
+                    lambda: n2.config.get("mqtt.max_inflight") == 99)
+            finally:
+                await n1b.stop()
+        finally:
+            await n2.stop()
+
+    run(main())
