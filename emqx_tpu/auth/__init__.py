@@ -18,6 +18,7 @@ from .authn import (
 from .authz import AclRule, Authz, BuiltinDbSource, FileSource, compile_acl_batch
 from .access_control import attach_auth
 from .external import HttpAuthenticator, HttpAuthzSource, JwksJwtAuthenticator
+from .redis import RedisAuthenticator, RedisAuthzSource
 
 __all__ = [
     "AuthChain", "BuiltinDbAuthenticator", "JwtAuthenticator",
@@ -25,4 +26,5 @@ __all__ = [
     "AclRule", "Authz", "BuiltinDbSource", "FileSource",
     "compile_acl_batch", "attach_auth",
     "HttpAuthenticator", "HttpAuthzSource", "JwksJwtAuthenticator",
+    "RedisAuthenticator", "RedisAuthzSource",
 ]

@@ -80,11 +80,20 @@ class AccessControl:
     # and park their verdicts for the fold to consume.
 
     def needs_async(self) -> bool:
-        return any(
-            hasattr(a, "authenticate_async") for a in self.chain._chain
-        ) or any(
-            hasattr(s, "prefetch_async") for s in self.authz.sources
-        )
+        """Cached: the chain/source set is fixed after wiring (runtime
+        mutations must call :meth:`invalidate_async_cache`), and this
+        runs per packet on the intercept path."""
+        cached = getattr(self, "_needs_async", None)
+        if cached is None:
+            cached = self._needs_async = any(
+                hasattr(a, "authenticate_async") for a in self.chain._chain
+            ) or any(
+                hasattr(s, "prefetch_async") for s in self.authz.sources
+            )
+        return cached
+
+    def invalidate_async_cache(self) -> None:
+        self._needs_async = None
 
     async def preauthenticate(self, channel, pkt) -> None:
         creds = Credentials(

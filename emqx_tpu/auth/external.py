@@ -247,7 +247,8 @@ class JwksJwtAuthenticator:
         self.refresh_interval = refresh_interval
         self.timeout = timeout
         self._keys: Dict[str, Tuple[int, int]] = {}   # kid -> (n, e)
-        self._fetched_at = 0.0
+        self._fetched_at = 0.0      # last SUCCESSFUL load
+        self._last_attempt = 0.0    # last fetch attempt (rate limiting)
 
     # -- key management ----------------------------------------------------
 
@@ -267,10 +268,18 @@ class JwksJwtAuthenticator:
             self._fetched_at = time.time()
 
     async def refresh_async(self, force: bool = False) -> None:
-        if not force and time.time() - self._fetched_at < self.refresh_interval:
+        now = time.time()
+        if not force and (
+            now - self._fetched_at < self.refresh_interval
+            # a DOWN endpoint must not be re-fetched per CONNECT: gate
+            # on the last ATTEMPT too (reconnect storms after an IdP
+            # outage are exactly when amplification hurts most)
+            or now - self._last_attempt < self._FORCE_REFRESH_MIN_INTERVAL
+        ):
             return
         from ..bridge import httpc
 
+        self._last_attempt = time.time()
         try:
             resp = await httpc.request("GET", self.jwks_url,
                                        timeout=self.timeout)
@@ -280,6 +289,7 @@ class JwksJwtAuthenticator:
             log.warning("jwks fetch %s failed: %s", self.jwks_url, e)
 
     def refresh_blocking(self) -> None:
+        self._last_attempt = time.time()
         try:
             status, body = _blocking_json_request(
                 "GET", self.jwks_url, {}, None, self.timeout)
@@ -327,13 +337,35 @@ class JwksJwtAuthenticator:
         return AuthResult("ok",
                           is_superuser=bool(claims.get("is_superuser")))
 
+    def _unknown_kid(self, creds: Credentials) -> bool:
+        """True only for a well-formed RS256 token whose kid we lack —
+        the one case where a forced JWKS refetch can help (rotation)."""
+        token = (creds.password or b"").decode("ascii", "ignore")
+        if token.count(".") != 2:
+            return False
+        try:
+            header = json.loads(_b64url_decode(token.split(".")[0]))
+        except (ValueError, json.JSONDecodeError):
+            return False
+        return (
+            isinstance(header, dict)
+            and header.get("alg") == "RS256"
+            and header.get("kid", "") not in self._keys
+        )
+
+    _FORCE_REFRESH_MIN_INTERVAL = 30.0
+
     async def authenticate_async(self, creds: Credentials) -> AuthResult:
         await self.refresh_async()
         res = self._verify(creds)
-        if res.outcome == "ignore" and (creds.password or b"").count(b".") == 2:
-            # unknown kid: force one refresh then retry (key rotation)
-            await self.refresh_async(force=True)
-            res = self._verify(creds)
+        if res.outcome == "ignore" and self._unknown_kid(creds):
+            # key rotation: ONE rate-limited forced refetch — garbage
+            # three-segment passwords must not drive per-CONNECT fetches
+            # against the identity provider (request amplification)
+            now = time.time()
+            if now - self._last_attempt >= self._FORCE_REFRESH_MIN_INTERVAL:
+                await self.refresh_async(force=True)
+                res = self._verify(creds)
         return res
 
     def authenticate(self, creds: Credentials) -> AuthResult:

@@ -1,0 +1,73 @@
+"""TLS-PSK identity store — the ``emqx_psk`` analog.
+
+Behavioral reference: ``apps/emqx_psk`` [U] (SURVEY.md §2.3): a store of
+``identity:hex-psk`` entries (bootstrap file + runtime CRUD) consulted
+by the TLS handshake's PSK callback.
+
+Python's ``ssl`` grew server-side PSK callbacks in 3.13
+(``SSLContext.set_psk_server_callback``); on older runtimes the store
+still works (REST/CLI CRUD, file load) and ``wire_into`` reports
+unsupported instead of failing the listener — the same gated-native
+posture as bcrypt (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import logging
+import ssl
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["PskStore"]
+
+
+class PskStore:
+    def __init__(self, file_text: str = "") -> None:
+        self._psks: Dict[str, bytes] = {}
+        if file_text:
+            self.load(file_text)
+
+    def load(self, text: str) -> int:
+        """``identity:hex`` per line; '#' comments.  Returns entry count."""
+        n = 0
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            ident, _, hexpsk = ln.partition(":")
+            if not hexpsk:
+                raise ValueError(f"bad psk line {ln!r}")
+            self._psks[ident.strip()] = bytes.fromhex(hexpsk.strip())
+            n += 1
+        return n
+
+    def put(self, identity: str, psk: bytes) -> None:
+        self._psks[identity] = psk
+
+    def get(self, identity: str) -> Optional[bytes]:
+        return self._psks.get(identity)
+
+    def delete(self, identity: str) -> bool:
+        return self._psks.pop(identity, None) is not None
+
+    def identities(self) -> List[str]:
+        return list(self._psks)
+
+    def wire_into(self, ctx: ssl.SSLContext,
+                  hint: str = "emqx_tpu") -> bool:
+        """Attach the store to a server-side SSL context.  Returns False
+        (logged) when this Python lacks PSK support."""
+        if not hasattr(ctx, "set_psk_server_callback"):
+            log.warning(
+                "TLS-PSK needs Python >= 3.13 ssl; store active for "
+                "management only"
+            )
+            return False
+
+        def cb(identity: Optional[str]) -> bytes:
+            return self._psks.get(identity or "", b"")
+
+        ctx.set_psk_server_callback(cb, identity_hint=hint)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        return True

@@ -1,0 +1,327 @@
+"""Redis authn/authz backends over a minimal RESP2 client.
+
+Behavioral reference: ``apps/emqx_authn/.../redis`` and
+``apps/emqx_authz/.../redis`` [U] (SURVEY.md §2.3):
+
+* authn — ``HMGET <key> password_hash salt is_superuser`` against a
+  templated key (``mqtt_user:${username}``), verified with the built-in
+  password hash schemes;
+* authz — ``HGETALL <key>`` (``mqtt_acl:${username}``) where fields are
+  topic filters and values are ``publish`` | ``subscribe`` | ``all``
+  (the reference's acl hash layout); matching rules ALLOW (deny-by-
+  default rides the pipeline's ``no_match``).
+
+Same async-first discipline as the HTTP backends: the packet intercept
+resolves over the event loop; sync fallbacks never block a running loop.
+The RESP client is dependency-free (the environment pins the package
+set) and covers exactly what these backends need: AUTH/SELECT on
+connect, HMGET/HGETALL, RESP2 parsing, reconnect-on-error.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import topic as T
+from .authn import AuthResult, Credentials, IGNORE, _verify_password
+from .authz import NOMATCH
+from .external import _in_event_loop
+
+log = logging.getLogger(__name__)
+
+__all__ = ["RespClient", "RedisAuthenticator", "RedisAuthzSource"]
+
+
+def _encode_cmd(*parts: bytes) -> bytes:
+    out = [b"*%d\r\n" % len(parts)]
+    for p in parts:
+        out.append(b"$%d\r\n%s\r\n" % (len(p), p))
+    return b"".join(out)
+
+
+class RespError(Exception):
+    pass
+
+
+async def _read_reply(reader) -> Any:
+    line = await reader.readline()
+    if not line.endswith(b"\r\n"):
+        raise RespError("truncated reply")
+    kind, rest = line[:1], line[1:-2]
+    if kind == b"+":
+        return rest.decode()
+    if kind == b"-":
+        raise RespError(rest.decode())
+    if kind == b":":
+        return int(rest)
+    if kind == b"$":
+        n = int(rest)
+        if n == -1:
+            return None
+        data = await reader.readexactly(n + 2)
+        return data[:-2]
+    if kind == b"*":
+        n = int(rest)
+        if n == -1:
+            return None
+        return [await _read_reply(reader) for _ in range(n)]
+    raise RespError(f"bad RESP type {kind!r}")
+
+
+class RespClient:
+    """One async Redis connection; reconnects lazily on error."""
+
+    def __init__(self, server: str = "127.0.0.1:6379",
+                 password: Optional[str] = None, database: int = 0,
+                 timeout: float = 5.0) -> None:
+        host, _, port = server.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port or 6379)
+        self.password = password
+        self.database = database
+        self.timeout = timeout
+        self._reader = None
+        self._writer = None
+        self._lock = asyncio.Lock()
+
+    async def _connect(self) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout)
+        if self.password:
+            await self._cmd_locked(b"AUTH", self.password.encode())
+        if self.database:
+            await self._cmd_locked(b"SELECT", str(self.database).encode())
+
+    async def _cmd_locked(self, *parts: bytes) -> Any:
+        self._writer.write(_encode_cmd(*parts))
+        await self._writer.drain()
+        return await asyncio.wait_for(_read_reply(self._reader),
+                                      self.timeout)
+
+    async def cmd(self, *parts) -> Any:
+        bparts = tuple(
+            p.encode() if isinstance(p, str) else p for p in parts
+        )
+        async with self._lock:
+            try:
+                if self._writer is None:
+                    await self._connect()
+                return await self._cmd_locked(*bparts)
+            except (OSError, asyncio.TimeoutError, RespError,
+                    asyncio.IncompleteReadError):
+                await self.aclose()
+                raise
+
+    async def aclose(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._reader = self._writer = None
+
+    # -- sync twin (non-loop contexts only) --------------------------------
+
+    def cmd_blocking(self, *parts) -> Any:
+        bparts = [p.encode() if isinstance(p, str) else p for p in parts]
+        with socket.create_connection((self.host, self.port),
+                                      timeout=self.timeout) as s:
+            f = s.makefile("rwb")
+
+            def roundtrip(*ps):
+                f.write(_encode_cmd(*ps))
+                f.flush()
+                return _read_reply_sync(f)
+
+            if self.password:
+                roundtrip(b"AUTH", self.password.encode())
+            if self.database:
+                roundtrip(b"SELECT", str(self.database).encode())
+            return roundtrip(*bparts)
+
+
+def _read_reply_sync(f) -> Any:
+    line = f.readline()
+    if not line.endswith(b"\r\n"):
+        raise RespError("truncated reply")
+    kind, rest = line[:1], line[1:-2]
+    if kind == b"+":
+        return rest.decode()
+    if kind == b"-":
+        raise RespError(rest.decode())
+    if kind == b":":
+        return int(rest)
+    if kind == b"$":
+        n = int(rest)
+        if n == -1:
+            return None
+        return f.read(n + 2)[:-2]
+    if kind == b"*":
+        n = int(rest)
+        if n == -1:
+            return None
+        return [_read_reply_sync(f) for _ in range(n)]
+    raise RespError(f"bad RESP type {kind!r}")
+
+
+def _render_key(template: str, creds_like: Dict[str, Any]) -> str:
+    from .external import _render
+
+    return _render(template, creds_like)
+
+
+class RedisAuthenticator:
+    """``HMGET <key> password_hash salt is_superuser`` authn backend."""
+
+    def __init__(self, server: str = "127.0.0.1:6379", *,
+                 key_template: str = "mqtt_user:${username}",
+                 algo: str = "sha256", salt_position: str = "prefix",
+                 iterations: int = 4096,
+                 password: Optional[str] = None, database: int = 0,
+                 timeout: float = 5.0) -> None:
+        self.client = RespClient(server, password, database, timeout)
+        self.key_template = key_template
+        self.algo = algo
+        self.salt_position = salt_position
+        self.iterations = iterations
+        self._parked: Dict[Tuple, AuthResult] = {}
+
+    @staticmethod
+    def _key(creds: Credentials) -> Tuple:
+        return (creds.clientid, creds.username, creds.password)
+
+    def _ctx(self, creds: Credentials) -> Dict[str, Any]:
+        return {"username": creds.username, "clientid": creds.clientid}
+
+    def _evaluate(self, row, creds: Credentials) -> AuthResult:
+        if row is None or not isinstance(row, list) or row[0] is None:
+            return IGNORE   # no such user — next in chain
+        if creds.password is None:
+            return AuthResult("deny")
+        stored = row[0].decode() if isinstance(row[0], bytes) else str(row[0])
+        salt = row[1] if len(row) > 1 and row[1] is not None else b""
+        is_super = bool(
+            len(row) > 2 and row[2] in (b"1", b"true", 1, "1", "true")
+        )
+        if _verify_password(stored, creds.password, self.algo, salt,
+                            self.salt_position, self.iterations):
+            return AuthResult("ok", is_superuser=is_super)
+        return AuthResult("deny")
+
+    async def authenticate_async(self, creds: Credentials) -> AuthResult:
+        key = _render_key(self.key_template, self._ctx(creds))
+        try:
+            row = await self.client.cmd(
+                "HMGET", key, "password_hash", "salt", "is_superuser")
+            res = self._evaluate(row, creds)
+        except Exception as e:
+            log.warning("redis authn unreachable: %s", e)
+            res = IGNORE
+        while len(self._parked) >= 512:
+            self._parked.pop(next(iter(self._parked)))
+        self._parked[self._key(creds)] = res
+        return res
+
+    def authenticate(self, creds: Credentials) -> AuthResult:
+        parked = self._parked.pop(self._key(creds), None)
+        if parked is None and creds.clientid:
+            parked = self._parked.pop(
+                ("", creds.username, creds.password), None)
+        if parked is not None:
+            return parked
+        if _in_event_loop():
+            log.warning("redis authn: no pre-resolved verdict; ignoring")
+            return IGNORE
+        try:
+            row = self.client.cmd_blocking(
+                "HMGET", _render_key(self.key_template, self._ctx(creds)),
+                "password_hash", "salt", "is_superuser")
+            return self._evaluate(row, creds)
+        except Exception as e:
+            log.warning("redis authn unreachable: %s", e)
+            return IGNORE
+
+
+class RedisAuthzSource:
+    """``HGETALL <key>`` acl source: field=topic filter, value=action."""
+
+    def __init__(self, server: str = "127.0.0.1:6379", *,
+                 key_template: str = "mqtt_acl:${username}",
+                 password: Optional[str] = None, database: int = 0,
+                 timeout: float = 5.0, cache_ttl: float = 10.0) -> None:
+        self.client = RespClient(server, password, database, timeout)
+        self.key_template = key_template
+        self.cache_ttl = cache_ttl
+        self._cache: Dict[Tuple, Tuple[Dict[str, str], float]] = {}
+
+    @staticmethod
+    def _match(rules: Dict[str, str], action: str, topic: str,
+               clientid: str, username: Optional[str]) -> str:
+        for flt, allowed in rules.items():
+            flt = flt.replace("%c", clientid).replace("%u", username or "")
+            if allowed not in ("publish", "subscribe", "all"):
+                continue
+            if allowed != "all" and allowed != action:
+                continue
+            try:
+                if T.match(topic, flt):
+                    return "allow"
+            except ValueError:
+                continue
+        return NOMATCH
+
+    @staticmethod
+    def _rules_of(flat) -> Dict[str, str]:
+        if not isinstance(flat, list):
+            return {}
+        it = iter(flat)
+        out = {}
+        for k, v in zip(it, it):
+            out[(k or b"").decode()] = (v or b"").decode()
+        return out
+
+    async def prefetch_async(self, clientid, username, peerhost, action,
+                             topic) -> str:
+        key = (clientid, username)
+        now = time.time()
+        hit = self._cache.get(key)
+        if hit is None or now - hit[1] >= self.cache_ttl:
+            try:
+                flat = await self.client.cmd(
+                    "HGETALL",
+                    _render_key(self.key_template,
+                                {"username": username, "clientid": clientid}))
+                self._cache[key] = (self._rules_of(flat), now)
+            except Exception as e:
+                log.warning("redis authz unreachable: %s", e)
+                self._cache[key] = ({}, now)
+            if len(self._cache) > 4096:
+                cutoff = now - self.cache_ttl
+                self._cache = {k: v for k, v in self._cache.items()
+                               if v[1] >= cutoff}
+        return self._match(self._cache[key][0], action, topic,
+                           clientid, username)
+
+    def authorize(self, clientid, username, peerhost, action, topic,
+                  **kw) -> str:
+        key = (clientid, username)
+        hit = self._cache.get(key)
+        if hit is not None and time.time() - hit[1] < self.cache_ttl:
+            return self._match(hit[0], action, topic, clientid, username)
+        if _in_event_loop():
+            log.warning("redis authz: un-prefetched key; nomatch")
+            return NOMATCH
+        try:
+            flat = self.client.cmd_blocking(
+                "HGETALL",
+                _render_key(self.key_template,
+                            {"username": username, "clientid": clientid}))
+            rules = self._rules_of(flat)
+            self._cache[key] = (rules, time.time())
+            return self._match(rules, action, topic, clientid, username)
+        except Exception as e:
+            log.warning("redis authz unreachable: %s", e)
+            return NOMATCH

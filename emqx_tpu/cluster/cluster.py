@@ -126,6 +126,10 @@ class Cluster:
         # marks; a reset-to-zero counter would be silently discarded)
         self._config_txn = int(time.time() * 1000)
         self._config_seen: Dict[str, int] = {}  # origin -> last txn applied
+        # per-path version: (txn, origin) of the last applied update —
+        # snapshot adoption is last-writer-wins against this, so a
+        # re-bootstrap can never roll back a newer local change
+        self._config_versions: Dict[str, Tuple[int, str]] = {}
         self._applying_remote_config = False
 
     # ------------------------------------------------------------------
@@ -188,6 +192,7 @@ class Cluster:
         import json as _json
 
         self._config_txn += 1
+        self._config_versions[path] = (self._config_txn, self.name)
         frame = pb.ClusterFrame(config_update=pb.ConfigUpdate(
             origin=self.name, txn=self._config_txn, path=path,
             value_json=_json.dumps(new, default=str),
@@ -204,6 +209,7 @@ class Cluster:
         self._config_seen[cu.origin] = cu.txn
         import json as _json
 
+        self._config_versions[cu.path] = (cu.txn, cu.origin)
         self._applying_remote_config = True
         try:
             self.node.config.put(cu.path, _json.loads(cu.value_json))
@@ -477,8 +483,10 @@ class Cluster:
         import json as _json
 
         for path, value in self.node.config.runtime_overrides().items():
+            txn, origin = self._config_versions.get(path, (0, self.name))
             snap.config.append(pb.Snapshot.ConfigEntry(
                 path=path, value_json=_json.dumps(value, default=str),
+                origin=origin, txn=txn,
             ))
         return snap
 
@@ -504,6 +512,10 @@ class Cluster:
         import json as _json
 
         for entry in snap.config:
+            known = self._config_versions.get(entry.path, (0, ""))
+            if (entry.txn, entry.origin) <= known:
+                continue  # we already hold this or a NEWER value
+            self._config_versions[entry.path] = (entry.txn, entry.origin)
             self._applying_remote_config = True
             try:
                 self.node.config.put(entry.path,

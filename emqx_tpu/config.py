@@ -172,6 +172,14 @@ SCHEMA: Dict[str, Field] = {
     "listeners.tcp.default.bind": Field("0.0.0.0:1883", str),
     "listeners.tcp.default.max_connections": Field(1 << 20, int),
     "listeners.tcp.default.enable": Field(True, _bool),
+    # TLS listener (certfile/keyfile PEM paths; psk.enable attaches the
+    # PSK store to the handshake where the runtime supports it)
+    "listeners.ssl.default.enable": Field(False, _bool),
+    "listeners.ssl.default.bind": Field("0.0.0.0:8883", str),
+    "listeners.ssl.default.certfile": Field("", str),
+    "listeners.ssl.default.keyfile": Field("", str),
+    "listeners.ssl.default.cacertfile": Field("", str),
+    "listeners.ssl.default.verify": Field(False, _bool),
     "listeners.ws.default.bind": Field("0.0.0.0:8083", str),
     "listeners.ws.default.enable": Field(False, _bool),
 
@@ -191,9 +199,12 @@ SCHEMA: Dict[str, Field] = {
     # off by default: embedded/multi-node-on-one-host uses must opt in
     # (the reference's standalone release enables it in its dist config)
     "dashboard.enable": Field(False, _bool),
-    # loopback by default: binding wider without api_key.enable would
-    # expose kick/publish/config mutation to the network
+    # loopback by default: binding wider without auth would expose
+    # kick/publish/config mutation to the network
     "dashboard.listen": Field("127.0.0.1:18083", str),
+    # bearer-token (login) auth for every endpoint except /status and
+    # /login; disable only for loopback tooling/tests
+    "dashboard.auth": Field(True, _bool),
     "api_key.enable": Field(False, _bool),
     "api_key.key": Field("admin", str),
     "api_key.secret": Field("public", str),
@@ -206,6 +217,23 @@ SCHEMA: Dict[str, Field] = {
     "cluster.seeds": Field("", str),
     "cluster.heartbeat_interval": Field(1.0, duration),
     "cluster.node_timeout": Field(5.0, duration),
+
+    # -- observability extras (emqx_slow_subs / statsd / telemetry) -------
+    "slow_subs.enable": Field(False, _bool),
+    "slow_subs.threshold": Field(0.5, duration),
+    "slow_subs.top_k": Field(10, int, lambda v: 1 <= v <= 1000),
+    "slow_subs.window_time": Field(300.0, duration),
+    "statsd.enable": Field(False, _bool),
+    "statsd.server": Field("127.0.0.1:8125", str),
+    "statsd.flush_interval": Field(30.0, duration),
+    "telemetry.enable": Field(False, _bool),
+    "telemetry.url": Field("", str),
+    "telemetry.interval": Field(604800.0, duration),
+
+    # -- TLS-PSK identity store (emqx_psk analog) -------------------------
+    "psk.enable": Field(False, _bool),
+    # inline "identity:hexpsk" entries, comma-separated (file-free envs)
+    "psk.entries": Field("", str),
 
     # -- gateways (emqx_gateway analog, SURVEY.md §2.3) -------------------
     "gateway.stomp.enable": Field(False, _bool),
@@ -491,9 +519,11 @@ class Config:
         self._handlers.append((prefix, fn))
 
     def remove_handler(self, fn: Callable[[str, Any, Any], None]) -> bool:
-        """Unregister a hot-update handler by identity (all prefixes)."""
+        """Unregister a hot-update handler (all prefixes).  Equality, not
+        identity: bound methods are fresh objects per attribute access,
+        and ``==`` compares (__self__, __func__)."""
         before = len(self._handlers)
-        self._handlers = [(p, f) for p, f in self._handlers if f is not fn]
+        self._handlers = [(p, f) for p, f in self._handlers if f != fn]
         return len(self._handlers) != before
 
     def put(self, path: str, raw: Any) -> Any:

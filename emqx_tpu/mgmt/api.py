@@ -90,7 +90,20 @@ class MgmtApi:
         r("DELETE", f"{v}/bridges/{{bridge_id}}", self.bridges_delete)
         r("POST", f"{v}/bridges/{{bridge_id}}/enable/{{enable}}",
           self.bridges_enable)
+        r("POST", f"{v}/login", self.dash_login)
+        r("POST", f"{v}/logout", self.dash_logout)
+        r("GET", f"{v}/users", self.dash_users)
+        r("POST", f"{v}/users", self.dash_user_create)
+        r("DELETE", f"{v}/users/{{username}}", self.dash_user_delete)
+        r("PUT", f"{v}/users/{{username}}/change_pwd", self.dash_change_pwd)
         r("GET", f"{v}/gateways", self.gateways_list)
+        r("GET", f"{v}/slow_subscriptions", self.slow_subs_list)
+        r("DELETE", f"{v}/slow_subscriptions", self.slow_subs_clear)
+        r("GET", f"{v}/plugins", self.plugins_list)
+        r("PUT", f"{v}/plugins/{{name}}/{{action}}", self.plugins_action)
+        r("GET", f"{v}/psk", self.psk_list)
+        r("POST", f"{v}/psk", self.psk_add)
+        r("DELETE", f"{v}/psk/{{identity}}", self.psk_delete)
         r("GET", f"{v}/trace", self.trace_list)
         r("POST", f"{v}/trace", self.trace_create)
         r("DELETE", f"{v}/trace/{{name}}", self.trace_delete)
@@ -459,6 +472,110 @@ class MgmtApi:
     async def gateways_list(self, req: Request) -> Response:
         gws = getattr(self.node, "gateways", None)
         return json_response(gws.list() if gws is not None else [])
+
+    # ------------------------------------------------------------------
+    # dashboard backend (emqx_dashboard analog: RBAC users + login)
+    # ------------------------------------------------------------------
+
+    @property
+    def _dash(self):
+        d = getattr(self.node, "dashboard_users", None)
+        if d is None:
+            raise KeyError("dashboard users not enabled")
+        return d
+
+    async def dash_login(self, req: Request) -> Response:
+        body = req.json() or {}
+        res = self._dash.login(str(body.get("username", "")),
+                               str(body.get("password", "")))
+        if res is None:
+            return json_response(
+                {"code": "BAD_USERNAME_OR_PWD",
+                 "message": "incorrect username or password"}, 401)
+        return json_response(res)
+
+    async def dash_logout(self, req: Request) -> Response:
+        tok = req.headers.get("authorization", "")
+        self._dash.logout(tok.removeprefix("Bearer ").strip())
+        return Response(204)
+
+    async def dash_users(self, req: Request) -> Response:
+        return json_response(self._dash.list_users())
+
+    async def dash_user_create(self, req: Request) -> Response:
+        body = req.json() or {}
+        self._dash.add_user(
+            str(body.get("username", "")), str(body.get("password", "")),
+            role=body.get("role", "viewer"),
+            description=body.get("description", ""),
+        )
+        return json_response(
+            {"username": body.get("username"),
+             "role": body.get("role", "viewer")}, 201)
+
+    async def dash_user_delete(self, req: Request) -> Response:
+        if not self._dash.delete_user(req.params["username"]):
+            raise KeyError(req.params["username"])
+        return Response(204)
+
+    async def dash_change_pwd(self, req: Request) -> Response:
+        body = req.json() or {}
+        ok = self._dash.change_password(
+            req.params["username"], str(body.get("old_pwd", "")),
+            str(body.get("new_pwd", "")),
+        )
+        if not ok:
+            return json_response(
+                {"code": "BAD_USERNAME_OR_PWD",
+                 "message": "incorrect old password"}, 401)
+        return Response(204)
+
+    async def slow_subs_list(self, req: Request) -> Response:
+        ss = getattr(self.node, "slow_subs", None)
+        return json_response(ss.ranking() if ss is not None else [])
+
+    async def slow_subs_clear(self, req: Request) -> Response:
+        ss = getattr(self.node, "slow_subs", None)
+        if ss is not None:
+            ss.clear()
+        return Response(204)
+
+    async def plugins_list(self, req: Request) -> Response:
+        return json_response(self.node.plugins.list())
+
+    async def plugins_action(self, req: Request) -> Response:
+        name, action = req.params["name"], req.params["action"]
+        if name not in self.node.plugins.plugins:
+            raise KeyError(name)
+        if action == "start":
+            self.node.plugins.start(name)
+        elif action == "stop":
+            self.node.plugins.stop(name)
+        else:
+            raise ValueError(f"bad action {action!r}")
+        return Response(204)
+
+    async def psk_list(self, req: Request) -> Response:
+        psk = getattr(self.node, "psk", None)
+        if psk is None:
+            raise KeyError("psk disabled")
+        return json_response({"identities": psk.identities()})
+
+    async def psk_add(self, req: Request) -> Response:
+        psk = getattr(self.node, "psk", None)
+        if psk is None:
+            raise KeyError("psk disabled")
+        body = req.json() or {}
+        if not body.get("identity") or not body.get("psk"):
+            raise ValueError("identity and psk (hex) required")
+        psk.put(body["identity"], bytes.fromhex(body["psk"]))
+        return Response(201)
+
+    async def psk_delete(self, req: Request) -> Response:
+        psk = getattr(self.node, "psk", None)
+        if psk is None or not psk.delete(req.params["identity"]):
+            raise KeyError(req.params.get("identity", "psk"))
+        return Response(204)
 
     # ------------------------------------------------------------------
     # tracing (emqx_trace REST analog)

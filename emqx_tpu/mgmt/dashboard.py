@@ -1,0 +1,170 @@
+"""Dashboard backend: RBAC users, login tokens, the HTTP surface the
+web UI consumes.
+
+Behavioral reference: ``apps/emqx_dashboard`` [U] (SURVEY.md §2.3) —
+username/password users with roles (``administrator`` mutates,
+``viewer`` reads), login issuing a bearer token with idle expiry,
+change-password, default ``admin`` user flagged until its password
+changes.  The web asset bundle itself is not reproduced (the reference
+ships a prebuilt JS app); this is the complete backend contract.
+
+Passwords hash with salted sha256 (the built-in-db scheme); tokens are
+128-bit urandom handles with server-side expiry — no signed-state
+(mirrors the reference's minirest token table).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import secrets
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["DashboardUsers"]
+
+TOKEN_TTL = 3600.0  # idle expiry, refreshed per authenticated request
+
+
+class DashboardUsers:
+    def __init__(self, store_path: Optional[str] = None) -> None:
+        self.store_path = store_path
+        self._users: Dict[str, Dict[str, Any]] = {}
+        self._tokens: Dict[str, Dict[str, Any]] = {}
+        self._load()
+        if not self._users:
+            # bootstrap admin; flagged until the password changes
+            self.add_user("admin", "public", role="administrator")
+            self._users["admin"]["default_password"] = True
+            self._save()
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self) -> None:
+        if not self.store_path:
+            return
+        try:
+            with open(self.store_path, encoding="utf-8") as f:
+                self._users = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            self._users = {}
+
+    def _save(self) -> None:
+        if not self.store_path:
+            return
+        tmp = self.store_path + ".tmp"
+        os.makedirs(os.path.dirname(self.store_path) or ".", exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self._users, f)
+        os.replace(tmp, self.store_path)
+
+    # -- users -------------------------------------------------------------
+
+    @staticmethod
+    def _hash(password: str, salt: str) -> str:
+        return hashlib.sha256((salt + password).encode()).hexdigest()
+
+    def add_user(self, username: str, password: str,
+                 role: str = "viewer", description: str = "") -> None:
+        if role not in ("administrator", "viewer"):
+            raise ValueError(f"bad role {role!r}")
+        if not username or not all(c.isalnum() or c in "-_." for c in username):
+            raise ValueError("bad username")
+        if username in self._users:
+            raise ValueError(f"user {username!r} exists")
+        if len(password) < 6:
+            raise ValueError("password too short (min 6)")
+        salt = secrets.token_hex(8)
+        self._users[username] = {
+            "salt": salt,
+            "hash": self._hash(password, salt),
+            "role": role,
+            "description": description,
+            "default_password": False,
+        }
+        self._save()
+
+    def delete_user(self, username: str) -> bool:
+        if username not in self._users:
+            return False
+        admins = [u for u, r in self._users.items()
+                  if r["role"] == "administrator"]
+        if self._users[username]["role"] == "administrator" and \
+                admins == [username]:
+            raise ValueError("cannot delete the last administrator")
+        del self._users[username]
+        self._tokens = {t: v for t, v in self._tokens.items()
+                        if v["username"] != username}
+        self._save()
+        return True
+
+    def change_password(self, username: str, old: str, new: str) -> bool:
+        rec = self._users.get(username)
+        if rec is None or not self._check(rec, old):
+            return False
+        if len(new) < 6:
+            raise ValueError("password too short (min 6)")
+        rec["salt"] = secrets.token_hex(8)
+        rec["hash"] = self._hash(new, rec["salt"])
+        rec["default_password"] = False
+        self._save()
+        return True
+
+    def _check(self, rec: Dict[str, Any], password: str) -> bool:
+        return hmac.compare_digest(
+            self._hash(password, rec["salt"]), rec["hash"]
+        )
+
+    def list_users(self) -> List[Dict[str, Any]]:
+        return [
+            {"username": u, "role": r["role"],
+             "description": r.get("description", "")}
+            for u, r in self._users.items()
+        ]
+
+    # -- login / tokens ----------------------------------------------------
+
+    def login(self, username: str, password: str) -> Optional[Dict[str, Any]]:
+        rec = self._users.get(username)
+        if rec is None or not self._check(rec, password):
+            return None
+        # sweep expired tokens here (login is the only growth point, so
+        # per-poll login scripts can't grow _tokens without bound)
+        now = time.time()
+        self._tokens = {t: v for t, v in self._tokens.items()
+                        if v["expires"] > now}
+        token = secrets.token_urlsafe(24)
+        self._tokens[token] = {
+            "username": username,
+            "role": rec["role"],
+            "expires": time.time() + TOKEN_TTL,
+        }
+        return {
+            "token": token,
+            "role": rec["role"],
+            "version": "5",
+            "license": {"edition": "opensource"},
+            "default_password": bool(rec.get("default_password")),
+        }
+
+    def logout(self, token: str) -> bool:
+        return self._tokens.pop(token, None) is not None
+
+    def check_token(self, token: str, write: bool = False) -> bool:
+        rec = self._tokens.get(token)
+        if rec is None:
+            return False
+        now = time.time()
+        if now >= rec["expires"]:
+            del self._tokens[token]
+            return False
+        if write and rec["role"] != "administrator":
+            return False
+        rec["expires"] = now + TOKEN_TTL  # idle-expiry refresh
+        return True
+
+    def token_user(self, token: str) -> Optional[str]:
+        rec = self._tokens.get(token)
+        return rec["username"] if rec else None

@@ -88,6 +88,10 @@ struct Nfa {
   std::vector<std::string> accepts;  // aid -> filter ("" = hole)
   std::vector<uint8_t> accept_live;
   std::deque<std::pair<uint64_t, int32_t>> free_aids;  // (freed_epoch, aid)
+  // alias aids: ids in the same accept space with NO trie states —
+  // filters deeper than the table that still need one id→filter map
+  // (mirrors IncrementalNfa.alloc_alias/free_alias)
+  std::unordered_set<int32_t> alias_aids;
 
   std::vector<int32_t> edge_tab;  // Hb * 16
   uint32_t Hb;
@@ -548,6 +552,22 @@ int64_t nfa_bulk_add(void* h, const char* buf, int64_t len) {
 
 int32_t nfa_aid_of(void* h, const char* s, int32_t n) {
   return static_cast<Nfa*>(h)->aid_of(std::string_view(s, size_t(n)));
+}
+
+int32_t nfa_alloc_alias(void* h, const char* s, int32_t n) {
+  Nfa* nfa = static_cast<Nfa*>(h);
+  int32_t aid = nfa->alloc_aid(std::string_view(s, size_t(n)));
+  nfa->alias_aids.insert(aid);
+  ++nfa->epoch;
+  return aid;
+}
+
+int32_t nfa_free_alias(void* h, int32_t aid) {
+  Nfa* nfa = static_cast<Nfa*>(h);
+  if (!nfa->alias_aids.erase(aid)) return 0;
+  nfa->free_aid(aid);
+  ++nfa->epoch;
+  return 1;
 }
 
 int32_t nfa_match_topic(void* h, const char* s, int32_t n, int32_t* out,

@@ -46,6 +46,10 @@ def _load():
                                  ctypes.c_int64]
     lib.nfa_aid_of.restype = ctypes.c_int32
     lib.nfa_aid_of.argtypes = lib.nfa_add.argtypes
+    lib.nfa_alloc_alias.restype = ctypes.c_int32
+    lib.nfa_alloc_alias.argtypes = lib.nfa_add.argtypes
+    lib.nfa_free_alias.restype = ctypes.c_int32
+    lib.nfa_free_alias.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.nfa_match_topic.restype = ctypes.c_int32
     lib.nfa_match_topic.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
@@ -79,6 +83,23 @@ def available() -> bool:
 
 def _i32p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class _AcceptView:
+    """aid→filter sequence view over the native accepts vector."""
+
+    __slots__ = ("_nfa",)
+
+    def __init__(self, nfa: "NativeNfa") -> None:
+        self._nfa = nfa
+
+    def __getitem__(self, aid: int) -> Optional[str]:
+        if aid < 0:
+            raise IndexError(aid)
+        return self._nfa.accept_get(aid)
+
+    def __len__(self) -> int:
+        return int(self._nfa._sizes()[4])
 
 
 class NativeNfa:
@@ -234,6 +255,21 @@ class NativeNfa:
     def aid_of(self, flt: str) -> int:
         b = flt.encode()
         return int(self._lib.nfa_aid_of(self._h, b, len(b)))
+
+    def alloc_alias(self, flt: str) -> int:
+        """Accept id with no trie states (too-deep filters) — same
+        contract as IncrementalNfa.alloc_alias."""
+        b = flt.encode()
+        return int(self._lib.nfa_alloc_alias(self._h, b, len(b)))
+
+    def free_alias(self, aid: int) -> None:
+        self._lib.nfa_free_alias(self._h, aid)
+
+    @property
+    def accept_filters(self) -> "_AcceptView":
+        """Read-only aid→filter view (len/indexing); backed by the
+        native accepts vector, so no 10M-string Python list."""
+        return _AcceptView(self)
 
     def match_host(self, topic: str, cap: int = 4096) -> List[int]:
         b = topic.encode()

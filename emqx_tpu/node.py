@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -109,6 +110,27 @@ class BrokerNode:
         from .observe.trace import TraceManager
 
         self.tracing = TraceManager(self)
+        from .observe.slow_subs import SlowSubs
+        from .plugins import PluginManager
+
+        self.slow_subs = (
+            SlowSubs(
+                threshold_ms=cfg.get("slow_subs.threshold") * 1e3,
+                top_k=cfg.get("slow_subs.top_k"),
+                window_s=cfg.get("slow_subs.window_time"),
+            ).attach(self.broker)
+            if cfg.get("slow_subs.enable") else None
+        )
+        self.plugins = PluginManager(self)
+        self.psk = None
+        if cfg.get("psk.enable"):
+            from .auth.psk import PskStore
+
+            self.psk = PskStore(
+                (cfg.get("psk.entries") or "").replace(",", "\n")
+            )
+        self.statsd = None
+        self.telemetry = None
         self._attach_client_metrics()
         self._register_config_handlers()
         # session expiry: clientid -> disconnect time, swept by
@@ -129,6 +151,7 @@ class BrokerNode:
         self.mgmt = None
         self.mgmt_server = None
         self.gateways = None  # GatewayManager, built in start()
+        self.dashboard_users = None  # DashboardUsers, built in _start_mgmt
         self.limiter = LimiterGroup(
             max_conn_rate=cfg.get("limiter.max_conn_rate"),
             max_messages_rate=cfg.get("limiter.max_messages_rate"),
@@ -243,6 +266,18 @@ class BrokerNode:
                     max_conn_rate=cfg.get("limiter.max_conn_rate"),
                 )
             )
+        if cfg.get("listeners.ssl.default.enable"):
+            ctx = self._build_ssl_context()
+            if ctx is not None:
+                self.listeners.add(
+                    Listener(
+                        "ssl-default",
+                        cfg.get("listeners.ssl.default.bind"),
+                        self.handle_stream,
+                        kind="tcp",
+                        ssl_context=ctx,
+                    )
+                )
         if cfg.get("listeners.ws.default.enable"):
             self.listeners.add(
                 Listener(
@@ -252,6 +287,34 @@ class BrokerNode:
                     kind="ws",
                 )
             )
+
+    def _build_ssl_context(self):
+        """Server TLS context for the ssl listener: certfile/keyfile,
+        optional client-cert verification, optional PSK identities
+        (gated on runtime support — SURVEY.md §2.4 posture)."""
+        import ssl as _ssl
+
+        cfg = self.config
+        cert = (cfg.get("listeners.ssl.default.certfile") or "").strip()
+        key = (cfg.get("listeners.ssl.default.keyfile") or "").strip()
+        ctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+        try:
+            if cert:
+                ctx.load_cert_chain(cert, key or None)
+            elif self.psk is None:
+                log.error("ssl listener enabled without certfile or psk")
+                return None
+            ca = (cfg.get("listeners.ssl.default.cacertfile") or "").strip()
+            if ca:
+                ctx.load_verify_locations(ca)
+            if cfg.get("listeners.ssl.default.verify"):
+                ctx.verify_mode = _ssl.CERT_REQUIRED
+            if self.psk is not None:
+                self.psk.wire_into(ctx)
+        except (OSError, _ssl.SSLError):
+            log.exception("ssl listener context build failed; disabled")
+            return None
+        return ctx
 
     # ------------------------------------------------------------------
     # connection plumbing
@@ -371,13 +434,20 @@ class BrokerNode:
                 elif pkt.type == P.PUBLISH:
                     # MQTT5 topic-alias publishes carry an empty topic;
                     # resolve through the channel's alias map so the
-                    # prefetch covers the EFFECTIVE topic
+                    # prefetch covers the topic the sync fold authorizes
+                    # (publish rewrite runs LATER, in broker.publish —
+                    # the channel authorizes the original topic)
                     topic = channel.peek_topic(pkt)
                     if topic:
                         await ac.preauthorize(
                             channel.clientid, "publish", topic, pkt.qos)
                 elif pkt.type == P.SUBSCRIBE:
+                    # the subscribe rewrite hook (client.subscribe, prio
+                    # 50) mutates the filters BEFORE the channel's
+                    # authorize fold — prefetch the rewritten form
                     for flt, opts in pkt.topic_filters:
+                        flt = self.rewrite.rewrite(
+                            flt, "sub", channel.clientid)
                         await ac.preauthorize(
                             channel.clientid, "subscribe", flt,
                             opts.get("qos", 0))
@@ -393,6 +463,23 @@ class BrokerNode:
         await self._start_exhook()
         await self._start_mgmt()
         await self._start_gateways()
+        if self.config.get("statsd.enable"):
+            from .observe.statsd import StatsdPusher
+
+            self.statsd = StatsdPusher(
+                self.observed,
+                server=self.config.get("statsd.server"),
+                interval=self.config.get("statsd.flush_interval"),
+            )
+            await self.statsd.start()
+        if self.config.get("telemetry.enable"):
+            from .observe.telemetry import Telemetry
+
+            self.telemetry = Telemetry(
+                self, url=self.config.get("telemetry.url"),
+                interval=self.config.get("telemetry.interval"),
+            )
+            await self.telemetry.start()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(asyncio.ensure_future(self._housekeeping()))
@@ -447,23 +534,55 @@ class BrokerNode:
         if not self.config.get("dashboard.enable"):
             return
         from .mgmt import HttpServer, MgmtApi, basic_auth_checker
+        from .mgmt.dashboard import DashboardUsers
+
+        data_dir = (self.config.get("node.data_dir") or "").strip()
+        self.dashboard_users = DashboardUsers(
+            os.path.join(data_dir, "dashboard_users.json")
+            if data_dir else None
+        )
 
         bind = self.config.get("dashboard.listen")
         host, _, port = bind.rpartition(":")
         auth = None
-        if self.config.get("api_key.enable"):
-            auth = basic_auth_checker(
-                self.config.get("api_key.key"),
-                self.config.get("api_key.secret"),
+        if self.config.get("dashboard.auth") or self.config.get(
+            "api_key.enable"
+        ):
+            basic = (
+                basic_auth_checker(
+                    self.config.get("api_key.key"),
+                    self.config.get("api_key.secret"),
+                )
+                if self.config.get("api_key.enable") else None
             )
+            dash = self.dashboard_users
+
+            def auth(req):
+                # dashboard bearer token (role gates writes: viewer is
+                # read-only, except self-service logout / own-password
+                # change) OR api-key basic auth when enabled
+                hdr = req.headers.get("authorization", "")
+                if hdr.startswith("Bearer "):
+                    tok = hdr.removeprefix("Bearer ").strip()
+                    write = req.method not in ("GET", "HEAD")
+                    if req.path == "/api/v5/logout":
+                        write = False
+                    elif (req.path.startswith("/api/v5/users/")
+                          and req.path.endswith("/change_pwd")):
+                        who = req.path.removeprefix(
+                            "/api/v5/users/").removesuffix("/change_pwd")
+                        if dash.token_user(tok) == who:
+                            write = False
+                    return dash.check_token(tok, write=write)
+                return basic(req) if basic is not None else False
         elif (host or "0.0.0.0") not in ("127.0.0.1", "localhost", "::1"):
             log.warning(
-                "management API on %s without api_key.enable: any network "
-                "peer can kick clients, publish, and mutate config", bind
+                "management API on %s without auth: any network peer can "
+                "kick clients, publish, and mutate config", bind
             )
         self.mgmt_server = HttpServer(
             host or "0.0.0.0", int(port), auth=auth,
-            auth_exempt=("/api/v5/status",),
+            auth_exempt=("/api/v5/status", "/api/v5/login"),
         )
         self.mgmt = MgmtApi(self, self.mgmt_server)
         await self.mgmt_server.start()
@@ -513,6 +632,13 @@ class BrokerNode:
 
     async def stop(self) -> None:
         self._running = False
+        self.plugins.stop_all()
+        if self.statsd is not None:
+            await self.statsd.stop()
+            self.statsd = None
+        if self.telemetry is not None:
+            await self.telemetry.stop()
+            self.telemetry = None
         if getattr(self, "gateways", None) is not None:
             await self.gateways.stop_all()
         await self.bridges.stop_all()

@@ -1,0 +1,61 @@
+"""Slow-subscriber tracking — the ``emqx_slow_subs`` analog.
+
+Behavioral reference: ``apps/emqx_slow_subs`` [U] (SURVEY.md §2.3):
+measure per-delivery latency (publish timestamp → delivery to the
+subscriber), keep a bounded top-N ranking of the slowest
+(clientid, topic) pairs over a moving window, expire stale entries,
+expose + clear over REST.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Tuple
+
+__all__ = ["SlowSubs"]
+
+
+class SlowSubs:
+    def __init__(self, *, threshold_ms: float = 500.0, top_k: int = 10,
+                 window_s: float = 300.0) -> None:
+        self.threshold_ms = threshold_ms
+        self.top_k = top_k
+        self.window_s = window_s
+        # (clientid, topic) -> (latency_ms, last_update)
+        self._table: Dict[Tuple[str, str], Tuple[float, float]] = {}
+
+    def attach(self, broker: Any) -> "SlowSubs":
+        broker.hooks.add("message.delivered", self._on_delivered,
+                         priority=-98, name="slow_subs.delivered")
+        return self
+
+    def _on_delivered(self, clientid: str, msg: Any) -> None:
+        lat_ms = (time.time() - msg.timestamp) * 1e3
+        if lat_ms < self.threshold_ms:
+            return
+        now = time.time()
+        key = (clientid, msg.topic)
+        prev = self._table.get(key)
+        if prev is None or lat_ms > prev[0]:
+            self._table[key] = (lat_ms, now)
+        else:
+            self._table[key] = (prev[0], now)
+        if len(self._table) > self.top_k * 8:
+            self._expire(now)
+
+    def _expire(self, now: float) -> None:
+        cutoff = now - self.window_s
+        self._table = {k: v for k, v in self._table.items()
+                       if v[1] >= cutoff}
+
+    def ranking(self) -> List[Dict[str, Any]]:
+        self._expire(time.time())
+        rows = sorted(self._table.items(), key=lambda kv: -kv[1][0])
+        return [
+            {"clientid": cid, "topic": topic,
+             "timespan_ms": round(lat, 1), "last_update_time": ts}
+            for (cid, topic), (lat, ts) in rows[: self.top_k]
+        ]
+
+    def clear(self) -> None:
+        self._table.clear()

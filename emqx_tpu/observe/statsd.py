@@ -1,0 +1,80 @@
+"""StatsD exporter — the ``emqx_statsd`` analog.
+
+Behavioral reference: ``apps/emqx_statsd`` [U] (SURVEY.md §2.3):
+periodic UDP push of the metric counters and stat gauges in statsd
+line protocol (``<name>:<value>|c`` for counters, ``|g`` for gauges),
+names dot-separated as the reference emits them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["StatsdPusher"]
+
+
+class StatsdPusher:
+    def __init__(self, observed: Any, server: str = "127.0.0.1:8125",
+                 interval: float = 30.0, prefix: str = "emqx") -> None:
+        host, _, port = server.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port or 8125))
+        self.observed = observed
+        self.interval = interval
+        self.prefix = prefix
+        self._sock: Optional[socket.socket] = None
+        self._task: Optional[asyncio.Task] = None
+        self.pushes = 0
+
+    def render(self) -> bytes:
+        """One datagram per flush: counters then gauges."""
+        lines = []
+        for name, value in self.observed.metrics.all().items():
+            lines.append(f"{self.prefix}.{name}:{value}|c")
+        for name, value in self.observed.stats.all().items():
+            lines.append(f"{self.prefix}.{name}:{value}|g")
+        return "\n".join(lines).encode()
+
+    def push(self) -> None:
+        if self._sock is None:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        payload = self.render()
+        # UDP datagrams cap out; chunk on line boundaries under ~8KB
+        start = 0
+        while start < len(payload):
+            end = min(start + 8000, len(payload))
+            if end < len(payload):
+                nl = payload.rfind(b"\n", start, end)
+                if nl > start:
+                    end = nl
+            try:
+                self._sock.sendto(payload[start:end], self.addr)
+            except OSError as e:
+                log.warning("statsd push to %s failed: %s", self.addr, e)
+                return
+            start = end + 1
+        self.pushes += 1
+
+    async def start(self) -> None:
+        async def loop():
+            while True:
+                await asyncio.sleep(self.interval)
+                self.push()
+
+        self._task = asyncio.ensure_future(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

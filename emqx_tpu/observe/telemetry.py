@@ -1,0 +1,103 @@
+"""Usage telemetry — the ``emqx_modules`` telemetry analog.
+
+Behavioral reference: the reference's opt-in telemetry reporter
+(``emqx_telemetry`` in ``apps/emqx_modules`` [U], SURVEY.md §2.3):
+builds an anonymous usage report (version, uptime, node counts, enabled
+features, message totals — never payloads or identities) and POSTs it
+to a configurable endpoint on a long interval.  Disabled by default
+here (the reference enables by default; an offline-first build must
+not phone home unprompted)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Telemetry"]
+
+
+class Telemetry:
+    def __init__(self, node: Any, url: str = "",
+                 interval: float = 7 * 24 * 3600.0) -> None:
+        self.node = node
+        self.url = url
+        self.interval = interval
+        self.started_at = time.time()
+        self.uuid = str(uuid.uuid4())   # random per boot; no identity
+        self._task: Optional[asyncio.Task] = None
+        self.reports_sent = 0
+
+    def report(self) -> Dict[str, Any]:
+        from .. import __version__
+
+        broker = self.node.broker
+        cfg = self.node.config
+        return {
+            "emqx_version": __version__,
+            "uuid": self.uuid,
+            "uptime_s": int(time.time() - self.started_at),
+            "nodes_in_cluster": 1 + len(
+                getattr(self.node.cluster, "peers", {}) or {}
+            ) if self.node.cluster is not None else 1,
+            "connections": len(self.node.connections),
+            "sessions": len(broker.sessions),
+            "subscriptions": sum(
+                len(s.subscriptions) for s in broker.sessions.values()
+            ),
+            "messages_received": self.node.observed.metrics.all().get(
+                "messages.received", 0),
+            "messages_sent": self.node.observed.metrics.all().get(
+                "messages.sent", 0),
+            "features": {
+                "tpu_match": self.node.match_service is not None,
+                "cluster": self.node.cluster is not None,
+                "bridges": len(self.node.bridges.list()),
+                "rules": len(self.node.rule_engine.rules),
+                "gateways": [g["name"] for g in self.node.gateways.list()]
+                if self.node.gateways is not None else [],
+                "retainer": self.node.retainer is not None,
+            },
+        }
+
+    async def send_once(self) -> bool:
+        if not self.url:
+            return False
+        from ..bridge import httpc
+
+        try:
+            resp = await httpc.request(
+                "POST", self.url,
+                headers={"content-type": "application/json"},
+                body=json.dumps(self.report()).encode(),
+                timeout=10.0,
+            )
+            ok = 200 <= resp.status < 300
+        except Exception as e:
+            log.debug("telemetry post failed: %s", e)
+            ok = False
+        if ok:
+            self.reports_sent += 1
+        return ok
+
+    async def start(self) -> None:
+        async def loop():
+            while True:
+                await self.send_once()
+                await asyncio.sleep(self.interval)
+
+        self._task = asyncio.ensure_future(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._task = None

@@ -206,7 +206,8 @@ class TraceManager:
             priority=-99, name="trace.unsubscribed")
 
         def on_publish(msg):
-            if msg is None:
+            # hot path: zero work unless a trace exists
+            if msg is None or not self.traces:
                 return msg
             fields = {
                 "clientid": msg.sender,

@@ -1,6 +1,8 @@
 """Multi-chip parallelism: mesh construction and sharded match/fan-out."""
 
 from .mesh import make_mesh, pick_shape
+from .ring_fanout import build_ring_fanout, shard_bitmap_rows
+from .shared_group import build_shared_selector, host_pick, make_group_masks
 from .sharded_match import (
     FanoutResult,
     build_sharded_matcher,
@@ -13,4 +15,9 @@ __all__ = [
     "FanoutResult",
     "build_sharded_matcher",
     "make_accept_bitmap",
+    "build_shared_selector",
+    "make_group_masks",
+    "host_pick",
+    "build_ring_fanout",
+    "shard_bitmap_rows",
 ]

@@ -1,0 +1,99 @@
+"""Config-5 device stage: ring-tiled accept-bitmap OR-reduction.
+
+When the accept→subscriber bitmap outgrows one chip's HBM (BASELINE
+config 5: 100k retained × 1M wildcard subs ⇒ multi-GB of bitmap rows),
+its ROWS (accept ids) shard over a ``ring`` mesh axis.  Every shard OR-
+assembles the contribution of the accept ids it owns, then partial
+per-topic bitmaps rotate around the ring with ``ppermute`` accumulating
+bitwise-OR — the ring-attention blockwise schedule with OR in place of
+softmax-weighted sums (SURVEY.md §2.5 "Ring/blockwise bitmap tiles",
+§5.7).  After ``ring-1`` hops every shard holds the full reduction, so
+the result leaves the mesh dp-sharded and ring-replicated with no
+all-gather.
+
+Comms cost per batch: (ring-1) hops × (B/dp × W) words over ICI —
+bandwidth-optimal for a reduction whose operand never fits one chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["build_ring_fanout", "shard_bitmap_rows"]
+
+
+def shard_bitmap_rows(bitmap: np.ndarray, ring: int) -> np.ndarray:
+    """Pad the (F+1, W) accept bitmap so ``ring`` divides the row count
+    (pad rows are all-zero ⇒ OR-inert).  The LAST row must stay the
+    all-zero invalid-slot row within its shard — instead of relying on
+    position we simply require callers to index invalid slots to the
+    global padded last row, which is zero by construction."""
+    rows, w = bitmap.shape
+    pad = (-rows) % ring
+    if pad:
+        bitmap = np.concatenate(
+            [bitmap, np.zeros((pad, w), bitmap.dtype)], axis=0
+        )
+    return bitmap
+
+
+def build_ring_fanout(mesh: Mesh, active_slots: int = 16,
+                      max_matches: int = 32):
+    """Returns jitted ``step(words, lens, is_sys, node, edge, seeds,
+    bitmap_rows) -> (B, W) uint32`` with:
+
+    * batch arrays sharded ``(dp,)`` and replicated over ``ring``;
+    * NFA arrays replicated (the match runs identically on every ring
+      shard — cheaper than broadcasting matches, and the tables are the
+      small operand in config 5);
+    * ``bitmap_rows`` (F_pad, W) sharded ``(ring, None)`` — the operand
+      that doesn't fit one chip.
+    """
+    from ..ops.match_kernel import nfa_match
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("dp", None), P("dp"), P("dp"),
+            P(), P(), P(),
+            P("ring", None),
+        ),
+        out_specs=P("dp", None),
+        check_vma=False,
+    )
+    def step(words, lens, is_sys, node_tab, edge_tab, seeds, rows_local):
+        res = nfa_match(
+            words, lens, is_sys, node_tab, edge_tab, seeds,
+            active_slots=active_slots, max_matches=max_matches,
+        )
+        ring_idx = jax.lax.axis_index("ring")
+        f_local = rows_local.shape[0]
+        lo = ring_idx * f_local
+        m = res.matches                                    # (Bl, K) global aids
+        local = m - lo
+        valid = (m >= 0) & (local >= 0) & (local < f_local)
+        safe = jnp.where(valid, local, 0)
+        gathered = rows_local[safe]                        # (Bl, K, W)
+        gathered = jnp.where(valid[:, :, None], gathered, jnp.uint32(0))
+        partial_or = jax.lax.reduce(
+            gathered, np.uint32(0), jax.lax.bitwise_or, (1,)
+        )                                                  # (Bl, W)
+
+        # ring accumulate: rotate partials, OR as they come around
+        nring = mesh.shape["ring"]
+        perm = [(j, (j + 1) % nring) for j in range(nring)]
+        acc = partial_or
+        chunk = partial_or
+        for _ in range(nring - 1):
+            chunk = jax.lax.ppermute(chunk, "ring", perm)
+            acc = acc | chunk
+        return acc
+
+    return jax.jit(step)

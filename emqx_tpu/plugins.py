@@ -1,0 +1,127 @@
+"""Runtime-loadable plugins — the ``emqx_plugins`` analog.
+
+Behavioral reference: ``apps/emqx_plugins`` [U] (SURVEY.md §2.3): a
+plugin is an installable package with a manifest and code the node
+loads at runtime; loaded plugins hook the broker like any built-in
+service and can be started/stopped/uninstalled without a restart.
+
+Format here: a directory containing ``plugin.json``::
+
+    {"name": "my_plugin", "version": "1.0.0",
+     "module": "my_plugin", "description": "..."}
+
+and ``<module>.py`` defining ``start(node) -> Any`` and
+``stop(node, handle) -> None``.  ``start``'s return value is kept and
+passed back to ``stop`` (hook registrations, tasks, ...).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import logging
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Plugin", "PluginManager"]
+
+
+class Plugin:
+    def __init__(self, name: str, version: str, path: str, module: Any,
+                 description: str = "") -> None:
+        self.name = name
+        self.version = version
+        self.path = path
+        self.module = module
+        self.description = description
+        self.status = "stopped"     # stopped | running | error
+        self.handle: Any = None
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "rel_vsn": self.version,
+            "description": self.description,
+            "status": self.status,
+        }
+
+
+class PluginManager:
+    def __init__(self, node: Any) -> None:
+        self.node = node
+        self.plugins: Dict[str, Plugin] = {}
+
+    # -- install / load ----------------------------------------------------
+
+    def install(self, path: str) -> Plugin:
+        """Load a plugin directory (manifest + module).  Does not start."""
+        manifest_path = os.path.join(path, "plugin.json")
+        with open(manifest_path, encoding="utf-8") as f:
+            manifest = json.load(f)
+        name = manifest["name"]
+        if name in self.plugins:
+            raise ValueError(f"plugin {name!r} already installed")
+        modname = manifest.get("module", name)
+        modfile = os.path.join(path, f"{modname}.py")
+        spec = importlib.util.spec_from_file_location(
+            f"emqx_tpu_plugin_{name}", modfile
+        )
+        if spec is None or spec.loader is None:
+            raise ValueError(f"plugin module {modfile!r} not loadable")
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = module
+        spec.loader.exec_module(module)
+        for fn in ("start", "stop"):
+            if not callable(getattr(module, fn, None)):
+                raise ValueError(f"plugin {name!r} missing {fn}(node)")
+        pl = Plugin(name, manifest.get("version", "0.0.0"), path, module,
+                    manifest.get("description", ""))
+        self.plugins[name] = pl
+        return pl
+
+    def uninstall(self, name: str) -> bool:
+        pl = self.plugins.get(name)
+        if pl is None:
+            return False
+        if pl.status == "running":
+            self.stop(name)
+        del self.plugins[name]
+        sys.modules.pop(f"emqx_tpu_plugin_{name}", None)
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, name: str) -> None:
+        pl = self.plugins[name]
+        if pl.status == "running":
+            return
+        try:
+            pl.handle = pl.module.start(self.node)
+            pl.status = "running"
+        except Exception:
+            pl.status = "error"
+            raise
+
+    def stop(self, name: str) -> None:
+        pl = self.plugins[name]
+        if pl.status != "running":
+            return
+        try:
+            pl.module.stop(self.node, pl.handle)
+        finally:
+            pl.handle = None
+            pl.status = "stopped"
+
+    def stop_all(self) -> None:
+        for name, pl in self.plugins.items():
+            if pl.status == "running":
+                try:
+                    self.stop(name)
+                except Exception:
+                    log.exception("plugin %s stop failed", name)
+
+    def list(self) -> List[Dict[str, Any]]:
+        return [p.info() for p in self.plugins.values()]

@@ -388,6 +388,7 @@ def test_webhook_4xx_drops_without_retry():
 def test_bridge_rest_crud():
     async def main():
         node = await start_node('dashboard.enable = true\n'
+                                'dashboard.auth = false\n'
                                 'dashboard.listen = "127.0.0.1:0"\n')
         try:
             mport = node.mgmt_server.port

@@ -1,0 +1,155 @@
+"""Dashboard backend: RBAC users, login tokens, role-gated writes
+(emqx_dashboard analog)."""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.bridge import httpc
+from emqx_tpu.config import Config
+from emqx_tpu.mgmt.dashboard import DashboardUsers
+from emqx_tpu.node import BrokerNode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_users_roles_and_tokens(tmp_path):
+    d = DashboardUsers(str(tmp_path / "users.json"))
+    # bootstrap admin with default password flag
+    res = d.login("admin", "public")
+    assert res is not None and res["default_password"]
+    assert d.check_token(res["token"], write=True)
+
+    assert d.change_password("admin", "public", "newpass1")
+    assert d.login("admin", "public") is None
+    res2 = d.login("admin", "newpass1")
+    assert not res2["default_password"]
+
+    d.add_user("bob", "readonly1", role="viewer")
+    t = d.login("bob", "readonly1")["token"]
+    assert d.check_token(t, write=False)
+    assert not d.check_token(t, write=True)  # viewer can't mutate
+
+    with pytest.raises(ValueError):
+        d.add_user("x", "short", role="viewer")   # weak password
+    with pytest.raises(ValueError):
+        d.add_user("evil\r\nname", "longenough")  # bad charset
+    with pytest.raises(ValueError):
+        d.delete_user("admin")  # last administrator
+
+    # persistence reload
+    d2 = DashboardUsers(str(tmp_path / "users.json"))
+    assert d2.login("bob", "readonly1") is not None
+    assert d2.login("admin", "newpass1") is not None
+
+    assert d.logout(t)
+    assert not d.check_token(t)
+
+
+def test_dashboard_rest_login_flow():
+    async def main():
+        node = BrokerNode(Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'dashboard.enable = true\n'
+            'dashboard.listen = "127.0.0.1:0"\n'
+            'api_key.enable = true\n'
+            'api_key.key = "k"\napi_key.secret = "s"\n')))
+        await node.start()
+        try:
+            base = f"http://127.0.0.1:{node.mgmt_server.port}/api/v5"
+            # unauthenticated: only /login and /status pass
+            r = await httpc.request("GET", f"{base}/stats")
+            assert r.status == 401
+            r = await httpc.request("POST", f"{base}/login", body=json.dumps(
+                {"username": "admin", "password": "public"}).encode())
+            assert r.status == 200
+            tok = json.loads(r.body)["token"]
+
+            hdr = {"authorization": f"Bearer {tok}"}
+            r = await httpc.request("GET", f"{base}/stats", headers=hdr)
+            assert r.status == 200
+
+            # admin creates a viewer; viewer token cannot mutate
+            r = await httpc.request("POST", f"{base}/users", headers=hdr,
+                                    body=json.dumps({
+                                        "username": "eve",
+                                        "password": "watch1",
+                                        "role": "viewer"}).encode())
+            assert r.status == 201
+            r = await httpc.request("POST", f"{base}/login", body=json.dumps(
+                {"username": "eve", "password": "watch1"}).encode())
+            vtok = json.loads(r.body)["token"]
+            vh = {"authorization": f"Bearer {vtok}"}
+            r = await httpc.request("GET", f"{base}/metrics", headers=vh)
+            assert r.status == 200
+            r = await httpc.request("POST", f"{base}/publish", headers=vh,
+                                    body=json.dumps({
+                                        "topic": "a", "payload": "x"
+                                    }).encode())
+            assert r.status == 401  # viewer write denied
+
+            # bad login
+            r = await httpc.request("POST", f"{base}/login", body=json.dumps(
+                {"username": "admin", "password": "wrong"}).encode())
+            assert r.status == 401
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_dashboard_auth_enforced_by_default_and_self_service():
+    """dashboard.enable alone (no api key) still gates every endpoint
+    behind login; viewers can logout and rotate their own password."""
+    async def main():
+        node = BrokerNode(Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'dashboard.enable = true\n'
+            'dashboard.listen = "127.0.0.1:0"\n')))
+        await node.start()
+        try:
+            base = f"http://127.0.0.1:{node.mgmt_server.port}/api/v5"
+            r = await httpc.request("GET", f"{base}/stats")
+            assert r.status == 401  # no api key needed for enforcement
+            r = await httpc.request("POST", f"{base}/users", body=json.dumps(
+                {"username": "h4x", "password": "longenough"}).encode())
+            assert r.status == 401  # user CRUD gated too
+
+            r = await httpc.request("POST", f"{base}/login", body=json.dumps(
+                {"username": "admin", "password": "public"}).encode())
+            tok = json.loads(r.body)["token"]
+            ah = {"authorization": f"Bearer {tok}"}
+            r = await httpc.request("POST", f"{base}/users", headers=ah,
+                                    body=json.dumps({
+                                        "username": "v", "password": "viewpw1",
+                                        "role": "viewer"}).encode())
+            assert r.status == 201
+
+            r = await httpc.request("POST", f"{base}/login", body=json.dumps(
+                {"username": "v", "password": "viewpw1"}).encode())
+            vtok = json.loads(r.body)["token"]
+            vh = {"authorization": f"Bearer {vtok}"}
+            # viewer self-service: own password change + logout allowed
+            r = await httpc.request(
+                "PUT", f"{base}/users/v/change_pwd", headers=vh,
+                body=json.dumps({"old_pwd": "viewpw1",
+                                 "new_pwd": "viewpw2"}).encode())
+            assert r.status == 204
+            # ...but not someone else's
+            r = await httpc.request(
+                "PUT", f"{base}/users/admin/change_pwd", headers=vh,
+                body=json.dumps({"old_pwd": "public",
+                                 "new_pwd": "hacked1"}).encode())
+            assert r.status == 401
+            r = await httpc.request("POST", f"{base}/logout", headers=vh,
+                                    body=b"")
+            assert r.status == 204
+            r = await httpc.request("GET", f"{base}/stats", headers=vh)
+            assert r.status == 401  # token revoked
+        finally:
+            await node.stop()
+
+    run(main())

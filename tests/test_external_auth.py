@@ -307,3 +307,155 @@ def test_rsa_verify_unit():
     assert _rsa_verify_sha256(n, e, msg, sig)
     assert not _rsa_verify_sha256(n, e, b"other", sig)
     assert not _rsa_verify_sha256(n, e, msg, b"\x00" * k)
+
+
+# ---------------------------------------------------------------------------
+# Redis authn/authz against an in-test RESP server
+# ---------------------------------------------------------------------------
+
+class MockRedis:
+    """Tiny RESP2 server: serves HMGET/HGETALL from a dict-of-dicts."""
+
+    def __init__(self, data, password=None):
+        self.data = data
+        self.password = password
+        self.commands = []
+        self.port = 0
+        self._conns = set()
+
+    async def start(self):
+        async def handle(reader, writer):
+            authed = self.password is None
+            self._conns.add(writer)
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        return
+                    assert line[:1] == b"*"
+                    n = int(line[1:-2])
+                    parts = []
+                    for _ in range(n):
+                        hdr = await reader.readline()
+                        assert hdr[:1] == b"$"
+                        ln = int(hdr[1:-2])
+                        parts.append((await reader.readexactly(ln + 2))[:-2])
+                    cmd = parts[0].upper().decode()
+                    self.commands.append((cmd, *[p.decode() for p in parts[1:]]))
+                    if cmd == "AUTH":
+                        authed = parts[1].decode() == self.password
+                        writer.write(b"+OK\r\n" if authed else b"-ERR auth\r\n")
+                    elif not authed:
+                        writer.write(b"-NOAUTH\r\n")
+                    elif cmd == "HMGET":
+                        h = self.data.get(parts[1].decode(), {})
+                        out = [b"*%d\r\n" % (len(parts) - 2)]
+                        for f in parts[2:]:
+                            v = h.get(f.decode())
+                            out.append(b"$-1\r\n" if v is None else
+                                       b"$%d\r\n%s\r\n" % (len(v), v.encode()))
+                        writer.write(b"".join(out))
+                    elif cmd == "HGETALL":
+                        h = self.data.get(parts[1].decode(), {})
+                        out = [b"*%d\r\n" % (len(h) * 2)]
+                        for k, v in h.items():
+                            out.append(b"$%d\r\n%s\r\n" % (len(k), k.encode()))
+                            out.append(b"$%d\r\n%s\r\n" % (len(v), v.encode()))
+                        writer.write(b"".join(out))
+                    else:
+                        writer.write(b"-ERR unknown\r\n")
+                    await writer.drain()
+            except Exception:
+                pass
+            finally:
+                self._conns.discard(writer)
+                writer.close()
+
+        self.server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        # clients (the node's auth backends) hold persistent conns;
+        # wait_closed() would block on them forever
+        for w in list(self._conns):
+            w.close()
+        self.server.close()
+        await self.server.wait_closed()
+
+
+def test_redis_authn_and_authz_roundtrip():
+    async def main():
+        from emqx_tpu.auth.authn import hash_password
+        from emqx_tpu.auth.redis import RedisAuthenticator, RedisAuthzSource
+
+        salt = "abcd1234"
+        redis = await MockRedis({
+            "mqtt_user:rita": {
+                "password_hash": hash_password(b"rpw", "sha256",
+                                               salt.encode()),
+                "salt": salt,
+                "is_superuser": "0",
+            },
+            "mqtt_acl:rita": {"open/#": "all", "wr/%u/own": "publish"},
+        }).start()
+
+        chain = AuthChain(allow_anonymous=False).add(
+            RedisAuthenticator(f"127.0.0.1:{redis.port}"))
+        authz = Authz(
+            sources=[RedisAuthzSource(f"127.0.0.1:{redis.port}")],
+            no_match="deny", cache_enable=False,
+        )
+        node = await start_node(auth_chain=chain, authz=authz)
+        try:
+            ok = Client(clientid="c1", port=port_of(node),
+                        username="rita", password=b"rpw")
+            await ok.connect()
+            assert await ok.subscribe("open/news") == [0]
+            assert (await ok.subscribe("secret/x"))[0] >= 0x80
+            # %u placeholder rule: publish-only on wr/rita/own
+            assert (await ok.subscribe("wr/rita/own"))[0] >= 0x80
+            await ok.disconnect()
+
+            bad = Client(clientid="c2", port=port_of(node),
+                         username="rita", password=b"wrong")
+            with pytest.raises(MqttError):
+                await bad.connect()
+            # unknown user -> ignore -> anonymous policy (deny)
+            unk = Client(clientid="c3", port=port_of(node),
+                         username="ghost", password=b"x")
+            with pytest.raises(MqttError):
+                await unk.connect()
+        finally:
+            await node.stop()
+            await redis.stop()
+
+    run(main())
+
+
+def test_redis_auth_with_password_and_down_server():
+    async def main():
+        from emqx_tpu.auth.authn import hash_password
+        from emqx_tpu.auth.redis import RedisAuthenticator
+        from emqx_tpu.auth.authn import Credentials
+
+        redis = await MockRedis({
+            "mqtt_user:u1": {
+                "password_hash": hash_password(b"p", "sha256", b"s"),
+                "salt": "s",
+            },
+        }, password="redispass").start()
+        a = RedisAuthenticator(f"127.0.0.1:{redis.port}",
+                               password="redispass")
+        res = await a.authenticate_async(
+            Credentials("c", "u1", b"p"))
+        assert res.outcome == "ok"
+        assert ("AUTH", "redispass") in redis.commands
+        await redis.stop()
+
+        # server down => ignore (never deny on infra failure)
+        dead = RedisAuthenticator("127.0.0.1:1", timeout=0.3)
+        res = await dead.authenticate_async(Credentials("c", "u1", b"p"))
+        assert res.outcome == "ignore"
+
+    run(main())

@@ -304,6 +304,7 @@ def test_gateway_rest_listing():
         from emqx_tpu.bridge import httpc
 
         node = await start_node('dashboard.enable = true\n'
+                                'dashboard.auth = false\n'
                                 'dashboard.listen = "127.0.0.1:0"\n')
         try:
             base = f"http://127.0.0.1:{node.mgmt_server.port}/api/v5"

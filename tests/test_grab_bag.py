@@ -1,0 +1,316 @@
+"""Grab-bag services: slow subs, statsd, telemetry, PSK store, plugins,
+jq subset — the remaining §2.3 inventory rows."""
+
+import asyncio
+import json
+import socket
+
+import pytest
+
+from emqx_tpu.client import Client
+from emqx_tpu.config import Config
+from emqx_tpu.node import BrokerNode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_node(extra=""):
+    cfg = Config(file_text=(
+        'listeners.tcp.default.bind = "127.0.0.1:0"\n' + extra))
+    node = BrokerNode(cfg)
+    await node.start()
+    return node
+
+
+def port_of(node):
+    return node.listeners.all()[0].port
+
+
+# ---------------------------------------------------------------------------
+# slow subs
+# ---------------------------------------------------------------------------
+
+def test_slow_subs_ranks_late_deliveries():
+    async def main():
+        node = await start_node("slow_subs.enable = true\n"
+                                "slow_subs.threshold = 50ms\n")
+        try:
+            c = Client(clientid="slowpoke", port=port_of(node))
+            await c.connect()
+            await c.subscribe("lag/#")
+            # a message whose publish timestamp is in the past simulates
+            # queueing delay (the tracked latency is publish->deliver)
+            from emqx_tpu.broker.message import make_message
+            import time
+
+            msg = make_message("p", "lag/x", b"old")
+            msg.timestamp = time.time() - 0.4
+            node.broker.publish(msg)
+            await c.recv()
+            rank = node.slow_subs.ranking()
+            assert rank and rank[0]["clientid"] == "slowpoke"
+            assert rank[0]["topic"] == "lag/x"
+            assert rank[0]["timespan_ms"] >= 300
+            node.slow_subs.clear()
+            assert node.slow_subs.ranking() == []
+            await c.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# statsd
+# ---------------------------------------------------------------------------
+
+def test_statsd_pushes_counters_and_gauges():
+    async def main():
+        sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sink.bind(("127.0.0.1", 0))
+        sink.settimeout(5.0)
+        sport = sink.getsockname()[1]
+        node = await start_node(
+            "statsd.enable = true\n"
+            f'statsd.server = "127.0.0.1:{sport}"\n'
+            "statsd.flush_interval = 600s\n")
+        try:
+            c = Client(clientid="s1", port=port_of(node))
+            await c.connect()
+            await c.publish("a/b", b"x")
+            await c.disconnect()
+            node.statsd.push()  # deterministic flush for the test
+            data = await asyncio.to_thread(sink.recvfrom, 65535)
+            lines = data[0].decode().splitlines()
+            kinds = {ln.rsplit("|", 1)[1] for ln in lines}
+            assert kinds == {"c", "g"}
+            names = {ln.split(":", 1)[0] for ln in lines}
+            assert "emqx.messages.received" in names
+            assert "emqx.connections.count" in names
+        finally:
+            await node.stop()
+            sink.close()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# telemetry
+# ---------------------------------------------------------------------------
+
+def test_telemetry_report_and_post():
+    async def main():
+        hits = []
+
+        async def handle(reader, writer):
+            head = await reader.readuntil(b"\r\n\r\n")
+            n = int(next((ln.split(":")[1] for ln in
+                          head.decode().split("\r\n")
+                          if ln.lower().startswith("content-length")), "0"))
+            hits.append(json.loads(await reader.readexactly(n)))
+            writer.write(b"HTTP/1.1 200 OK\r\ncontent-length: 0\r\n\r\n")
+            await writer.drain()
+            writer.close()
+
+        srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+        tport = srv.sockets[0].getsockname()[1]
+        node = await start_node(
+            "telemetry.enable = true\n"
+            f'telemetry.url = "http://127.0.0.1:{tport}/t"\n'
+            "telemetry.interval = 600s\n")
+        try:
+            for _ in range(100):
+                if hits:
+                    break
+                await asyncio.sleep(0.02)
+            assert hits, "no telemetry report arrived"
+            rep = hits[0]
+            assert rep["emqx_version"]
+            assert rep["features"]["retainer"] is True
+            assert "payload" not in json.dumps(rep)  # no message data
+        finally:
+            await node.stop()
+            srv.close()
+            await srv.wait_closed()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# PSK store
+# ---------------------------------------------------------------------------
+
+def test_psk_store_load_and_crud():
+    from emqx_tpu.auth.psk import PskStore
+
+    s = PskStore("dev1:aabbcc\n# comment\ndev2:00ff\n")
+    assert s.get("dev1") == bytes.fromhex("aabbcc")
+    assert s.get("dev2") == b"\x00\xff"
+    assert s.get("nope") is None
+    s.put("dev3", b"\x01\x02")
+    assert sorted(s.identities()) == ["dev1", "dev2", "dev3"]
+    assert s.delete("dev1") and not s.delete("dev1")
+    with pytest.raises(ValueError):
+        PskStore("malformed-line\n")
+
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    wired = s.wire_into(ctx)
+    # 3.13+ wires for real; older Pythons degrade with a warning
+    assert wired is hasattr(ctx, "set_psk_server_callback")
+
+
+def test_psk_rest_crud():
+    async def main():
+        from emqx_tpu.bridge import httpc
+
+        node = await start_node(
+            "psk.enable = true\n"
+            'psk.entries = "a:0a0b,b:0c0d"\n'
+            "dashboard.enable = true\n"
+            "dashboard.auth = false\n"
+            'dashboard.listen = "127.0.0.1:0"\n')
+        try:
+            base = f"http://127.0.0.1:{node.mgmt_server.port}/api/v5"
+            r = await httpc.request("GET", f"{base}/psk")
+            assert sorted(json.loads(r.body)["identities"]) == ["a", "b"]
+            r = await httpc.request("POST", f"{base}/psk", body=json.dumps(
+                {"identity": "c", "psk": "ff"}).encode())
+            assert r.status == 201
+            assert node.psk.get("c") == b"\xff"
+            r = await httpc.request("DELETE", f"{base}/psk/a")
+            assert r.status == 204
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# plugins
+# ---------------------------------------------------------------------------
+
+def test_plugin_install_start_stop(tmp_path):
+    async def main():
+        pdir = tmp_path / "audit_plugin"
+        pdir.mkdir()
+        (pdir / "plugin.json").write_text(json.dumps({
+            "name": "audit", "version": "1.2.3", "module": "audit",
+            "description": "counts publishes",
+        }))
+        (pdir / "audit.py").write_text(
+            "def start(node):\n"
+            "    seen = []\n"
+            "    def tap(msg):\n"
+            "        seen.append(msg.topic)\n"
+            "        return msg\n"
+            "    node.broker.hooks.add('message.publish', tap,\n"
+            "                          priority=-10, name='audit.tap')\n"
+            "    return seen\n"
+            "def stop(node, handle):\n"
+            "    node.broker.hooks.delete('message.publish', 'audit.tap')\n"
+        )
+
+        node = await start_node()
+        try:
+            pl = node.plugins.install(str(pdir))
+            assert pl.info()["rel_vsn"] == "1.2.3"
+            node.plugins.start("audit")
+            c = Client(clientid="p", port=port_of(node))
+            await c.connect()
+            await c.publish("seen/1", b"x")
+            await asyncio.sleep(0.05)
+            assert pl.handle == ["seen/1"]
+            node.plugins.stop("audit")
+            await c.publish("seen/2", b"x")
+            await asyncio.sleep(0.05)
+            assert pl.handle is None
+            assert node.plugins.uninstall("audit")
+            await c.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# jq subset
+# ---------------------------------------------------------------------------
+
+def test_jq_subset():
+    from emqx_tpu.rule_engine.funcs import call_func
+
+    doc = {"a": {"b": [{"c": 1}, {"c": 2}]}, "k.x": 5}
+    assert call_func("jq", [".", doc]) == [doc]
+    assert call_func("jq", [".a.b[0].c", doc]) == [1]
+    assert call_func("jq", [".a.b[-1].c", doc]) == [2]
+    assert call_func("jq", [".a.b[].c", doc]) == [1, 2]
+    assert call_func("jq", ['.["k.x"]', doc]) == [5]
+    assert call_func("jq", [".a | .b | .[0]", doc]) == [{"c": 1}]
+    assert call_func("jq", [".a.b[0].c, .a.b[1].c", doc]) == [1, 2]
+    assert call_func("jq", [".missing.deep", doc]) == [None]
+    # string input parses as JSON (reference jq/2 takes JSON strings)
+    assert call_func("jq", [".x", '{"x": 42}']) == [42]
+    with pytest.raises(ValueError):
+        call_func("jq", ["garbage(", doc])
+    with pytest.raises(ValueError):
+        call_func("jq", [".[]", 42])
+
+
+def test_jq_quoted_keys_with_separator_chars():
+    from emqx_tpu.rule_engine.funcs import call_func
+
+    doc = {"a|b": 1, "x,y": {"z": 2}}
+    assert call_func("jq", ['.["a|b"]', doc]) == [1]
+    assert call_func("jq", ['.["x,y"].z', doc]) == [2]
+    assert call_func("jq", ['.["a|b"], .["x,y"].z', doc]) == [1, 2]
+
+
+def test_ssl_listener_tls_roundtrip(tmp_path):
+    """Real TLS handshake against the ssl listener (cert generated with
+    the system openssl; skipped where unavailable)."""
+    import shutil
+    import ssl
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("no openssl binary")
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1"],
+        check=True, capture_output=True)
+
+    async def main():
+        node = await start_node(
+            "listeners.ssl.default.enable = true\n"
+            'listeners.ssl.default.bind = "127.0.0.1:0"\n'
+            f'listeners.ssl.default.certfile = "{cert}"\n'
+            f'listeners.ssl.default.keyfile = "{key}"\n')
+        try:
+            ssl_l = [l for l in node.listeners.all() if l.name == "ssl-default"]
+            assert ssl_l, "ssl listener missing"
+            sport = ssl_l[0].port
+            cctx = ssl.create_default_context()
+            cctx.check_hostname = False
+            cctx.verify_mode = ssl.CERT_NONE
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", sport, ssl=cctx)
+            # minimal MQTT CONNECT over TLS -> CONNACK
+            from emqx_tpu.mqtt import frame as F, packet as P
+
+            writer.write(F.serialize(P.Connect(proto_ver=4, clientid="tlsc")))
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(64), 5)
+            assert data[0] >> 4 == 2  # CONNACK
+            assert data[3] == 0       # rc accepted
+            writer.close()
+        finally:
+            await node.stop()
+
+    run(main())

@@ -21,6 +21,7 @@ async def start_node(extra=""):
         file_text=(
             'listeners.tcp.default.bind = "127.0.0.1:0"\n'
             'dashboard.enable = true\n'
+                                'dashboard.auth = false\n'
             'dashboard.listen = "127.0.0.1:0"\n'
             + extra
         )

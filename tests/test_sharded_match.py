@@ -100,3 +100,87 @@ def test_accept_bitmap_padding():
     assert bm[0, 0] == (1 | (1 << 31))
     assert bm[0, 1] == 1
     assert bm[-1].sum() == 0  # invalid row is zeros
+
+
+# ---------------------------------------------------------------------------
+# config 4: on-device $share group selection (tp-sharded candidates)
+# ---------------------------------------------------------------------------
+
+def test_shared_group_selection_parity():
+    import jax.numpy as jnp
+
+    from emqx_tpu.parallel import (
+        build_shared_selector, host_pick, make_group_masks, make_mesh,
+    )
+
+    rng = np.random.default_rng(9)
+    n_subs, W, B, G = 4096, 128, 64, 8
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    bitmap = rng.integers(0, 2**32, (B, W), dtype=np.uint32)
+    groups = [rng.choice(n_subs, size=rng.integers(1, 200), replace=False)
+              for _ in range(G - 1)]
+    groups.append([])  # empty group -> -1
+    masks = make_group_masks(groups, n_subs, W)
+    sel_hash = rng.integers(0, 2**31 - 1, B).astype(np.int32)
+
+    select = build_shared_selector(mesh)
+    out = np.asarray(select(jnp.asarray(bitmap), jnp.asarray(masks),
+                            jnp.asarray(sel_hash)))
+    assert out.shape == (B, G)
+    for b in range(B):
+        for g in range(G):
+            want = host_pick(bitmap[b], masks[g], int(sel_hash[b]))
+            assert out[b, g] == want, (b, g, out[b, g], want)
+    # empty group column is all -1
+    assert (out[:, G - 1] == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# config 5: ring-tiled accept-bitmap OR-reduction
+# ---------------------------------------------------------------------------
+
+def test_ring_fanout_parity():
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops import compile_filters, encode_topics, nfa_match
+    from emqx_tpu.parallel import (
+        build_ring_fanout, make_accept_bitmap, make_mesh, shard_bitmap_rows,
+    )
+
+    rng = np.random.default_rng(4)
+    words = [f"w{i}" for i in range(20)]
+    filters = sorted({
+        "/".join(
+            ("+" if rng.random() < 0.25 else words[rng.integers(20)])
+            for _ in range(rng.integers(1, 5))
+        ) + ("/#" if rng.random() < 0.3 else "")
+        for _ in range(300)
+    })
+    table = compile_filters(filters, depth=8)
+    n_subs = 2048
+    bitmap = make_accept_bitmap(
+        table,
+        lambda f: [(hash(f) + k * 13) % n_subs
+                   for k in range(1 + hash(f) % 5)],
+        n_subs,
+    )
+    topics = ["/".join(words[rng.integers(20)]
+                       for _ in range(rng.integers(1, 6)))
+              for _ in range(64)]
+    w, l, s = encode_topics(table, topics, batch=64)
+    args = (jnp.asarray(w), jnp.asarray(l), jnp.asarray(s),
+            *[jnp.asarray(a) for a in table.device_arrays()])
+
+    mesh = make_mesh({"dp": 2, "ring": 4})
+    rows = shard_bitmap_rows(bitmap, 4)
+    step = build_ring_fanout(mesh)
+    got = np.asarray(step(*args, jnp.asarray(rows)))
+
+    # dense single-device reference
+    ref = nfa_match(*args)
+    m = np.asarray(ref.matches)
+    want = np.zeros((64, bitmap.shape[1]), np.uint32)
+    for r in range(64):
+        for aid in m[r][m[r] >= 0]:
+            want[r] |= bitmap[aid]
+    np.testing.assert_array_equal(got, want)

@@ -278,7 +278,8 @@ def test_export_via_rest(tmp_path):
     async def main():
         node = await start_node(
             tmp_path,
-            'dashboard.enable = true\ndashboard.listen = "127.0.0.1:0"\n',
+            'dashboard.enable = true\ndashboard.auth = false\n'
+            'dashboard.listen = "127.0.0.1:0"\n',
         )
         try:
             pub = Client(clientid="p", port=mqtt_port(node))

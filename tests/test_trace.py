@@ -117,7 +117,8 @@ def test_trace_rest_lifecycle(tmp_path):
 
         node = await start_node(
             tmp_path,
-            'dashboard.enable = true\ndashboard.listen = "127.0.0.1:0"\n')
+            'dashboard.enable = true\ndashboard.auth = false\n'
+            'dashboard.listen = "127.0.0.1:0"\n')
         try:
             base = f"http://127.0.0.1:{node.mgmt_server.port}/api/v5"
             r = await httpc.request("POST", f"{base}/trace", body=json.dumps(
