@@ -84,6 +84,7 @@ class MatchService:
         max_stale_deltas: int = 256,
         bypass_rate: float = 0.0,
         prefetch_timeout_s: float = 0.5,
+        table: str = "auto",   # auto | native | python
     ) -> None:
         from ..ops import IncrementalNfa
         from ..ops.device_table import DeviceNfa
@@ -104,7 +105,26 @@ class MatchService:
         self.bypass_rate = bypass_rate
         self.prefetch_timeout_s = prefetch_timeout_s
 
-        self.inc = IncrementalNfa(depth=depth)
+        # host table: the C++ incremental NFA when available (seconds at
+        # 10M filters, Python-object-free), else the Python twin —
+        # identical mutation/drain surface, property-tested equivalent
+        self.inc = None
+        self.table_kind = "python"
+        if table in ("auto", "native"):
+            try:
+                from ..native.nfa import NativeNfa
+
+                self.inc = NativeNfa(depth=depth)
+                self.table_kind = "native"
+            except Exception:
+                if table == "native":
+                    raise
+                log.warning(
+                    "native NFA table unavailable; python table serves "
+                    "(fine below ~1M filters)", exc_info=True,
+                )
+        if self.inc is None:
+            self.inc = IncrementalNfa(depth=depth)
         self.dev = DeviceNfa(
             self.inc, active_slots=active_slots, max_matches=max_matches,
             lazy=True,

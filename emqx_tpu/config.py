@@ -260,6 +260,9 @@ SCHEMA: Dict[str, Field] = {
     # device tunnel would otherwise hang node start forever — on timeout
     # the node serves from the host trie)
     "tpu.start_timeout": Field(180.0, duration),
+    # host-table implementation behind the device mirror: the C++
+    # incremental NFA scales to 10M filters; python is the debug twin
+    "tpu.table": Field("auto", _enum("auto", "native", "python")),
     "tpu.mesh_shape": Field("dp=1,tp=1", str),
     "tpu.fail_open": Field(True, _bool),
     # serving tolerates up to this many un-synced router deltas before

@@ -94,7 +94,9 @@ class _AcceptView:
         self._nfa = nfa
 
     def __getitem__(self, aid: int) -> Optional[str]:
-        if aid < 0:
+        if aid < 0 or aid >= len(self):
+            # a real IndexError: sequence semantics (including the
+            # legacy iteration protocol) must terminate
             raise IndexError(aid)
         return self._nfa.accept_get(aid)
 

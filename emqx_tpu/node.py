@@ -447,7 +447,8 @@ class BrokerNode:
                     # authorize fold — prefetch the rewritten form
                     for flt, opts in pkt.topic_filters:
                         flt = self.rewrite.rewrite(
-                            flt, "sub", channel.clientid)
+                            flt, "sub", channel.clientid,
+                            self.broker.usernames.get(channel.clientid))
                         await ac.preauthorize(
                             channel.clientid, "subscribe", flt,
                             opts.get("qos", 0))
@@ -519,6 +520,7 @@ class BrokerNode:
                 max_stale_deltas=cfg.get("tpu.max_stale_deltas"),
                 bypass_rate=cfg.get("tpu.bypass_rate"),
                 prefetch_timeout_s=cfg.get("tpu.prefetch_timeout"),
+                table=cfg.get("tpu.table"),
             )
             await asyncio.wait_for(
                 self.match_service.start(),
@@ -556,13 +558,17 @@ class BrokerNode:
                 if self.config.get("api_key.enable") else None
             )
             dash = self.dashboard_users
+            # dashboard.auth=false + api_key.enable=true means the
+            # operator chose api-key-ONLY auth: login tokens must not
+            # reopen the write surface
+            bearer_ok = bool(self.config.get("dashboard.auth"))
 
             def auth(req):
                 # dashboard bearer token (role gates writes: viewer is
                 # read-only, except self-service logout / own-password
                 # change) OR api-key basic auth when enabled
                 hdr = req.headers.get("authorization", "")
-                if hdr.startswith("Bearer "):
+                if hdr.startswith("Bearer ") and bearer_ok:
                     tok = hdr.removeprefix("Bearer ").strip()
                     write = req.method not in ("GET", "HEAD")
                     if req.path == "/api/v5/logout":

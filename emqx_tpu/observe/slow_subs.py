@@ -17,10 +17,15 @@ __all__ = ["SlowSubs"]
 
 class SlowSubs:
     def __init__(self, *, threshold_ms: float = 500.0, top_k: int = 10,
-                 window_s: float = 300.0) -> None:
+                 window_s: float = 300.0, max_ms: float = 10_000.0) -> None:
         self.threshold_ms = threshold_ms
         self.top_k = top_k
         self.window_s = window_s
+        # latencies past this ceiling are BY-DESIGN delays, not slow
+        # consumers: retained replay delivers messages whose publish
+        # timestamp may be hours old, $delayed publishes are scheduled
+        # minutes out — counting them would swamp the ranking
+        self.max_ms = max_ms
         # (clientid, topic) -> (latency_ms, last_update)
         self._table: Dict[Tuple[str, str], Tuple[float, float]] = {}
 
@@ -31,7 +36,7 @@ class SlowSubs:
 
     def _on_delivered(self, clientid: str, msg: Any) -> None:
         lat_ms = (time.time() - msg.timestamp) * 1e3
-        if lat_ms < self.threshold_ms:
+        if lat_ms < self.threshold_ms or lat_ms > self.max_ms:
             return
         now = time.time()
         key = (clientid, msg.topic)

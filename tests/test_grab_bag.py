@@ -314,3 +314,30 @@ def test_ssl_listener_tls_roundtrip(tmp_path):
             await node.stop()
 
     run(main())
+
+
+def test_slow_subs_ignores_by_design_delays():
+    """Retained replay / delayed publishes carry old publish timestamps
+    by design — they must not register as slow consumers."""
+    async def main():
+        node = await start_node("slow_subs.enable = true\n"
+                                "slow_subs.threshold = 50ms\n")
+        try:
+            c = Client(clientid="fresh", port=port_of(node))
+            await c.connect()
+            # retained message published "an hour ago"
+            from emqx_tpu.broker.message import make_message
+            import time
+
+            old = make_message("p", "old/news", b"r", retain=True)
+            old.timestamp = time.time() - 3600
+            node.broker.publish(old)
+            await c.subscribe("old/#")
+            msg = await c.recv()
+            assert msg.payload == b"r"
+            assert node.slow_subs.ranking() == []  # not a slow consumer
+            await c.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())

@@ -369,3 +369,40 @@ def test_unsubscribe_prunes_mirror():
             await node.stop()
 
     run(main())
+
+
+def test_table_kind_selection_and_python_parity():
+    """tpu.table=auto picks the native C++ table when buildable; the
+    python twin passes the same storm (both serve identical hints)."""
+    async def main():
+        node_native = make_node()
+        await node_native.start()
+        try:
+            ms = node_native.match_service
+            assert ms is not None
+            # this environment has the toolchain: auto => native
+            assert ms.table_kind == "native"
+        finally:
+            await node_native.stop()
+
+        node_py = make_node(**{"tpu.table": "python"})
+        await node_py.start()
+        try:
+            ms = node_py.match_service
+            assert ms is not None and ms.table_kind == "python"
+            port = node_py.listeners.all()[0].port
+            sub = Client(clientid="s", port=port)
+            await sub.connect()
+            await sub.subscribe("k/+/x")
+            await settle(lambda: ms.dev.epoch == ms.inc.epoch)
+            pub = Client(clientid="p", port=port)
+            await pub.connect()
+            await pub.publish("k/1/x", b"v")
+            got = await sub.recv(timeout=5)
+            assert (got.topic, got.payload) == ("k/1/x", b"v")
+            await pub.disconnect()
+            await sub.disconnect()
+        finally:
+            await node_py.stop()
+
+    run(main())
