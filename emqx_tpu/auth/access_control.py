@@ -68,6 +68,15 @@ class AccessControl:
         )
         return True if ok else (STOP, False)
 
+    # hook: client.enhanced_authenticated (clientid, username, superuser)
+    # — enhanced auth (SCRAM) bypasses the authn chain, but the authorize
+    # fast path still needs the superuser/username record
+    def on_enhanced(self, clientid, username, is_superuser,
+                    peerhost=None):
+        self._superusers[clientid] = bool(is_superuser)
+        self._usernames[clientid] = username
+        self._peerhosts[clientid] = peerhost
+
     def on_terminated(self, clientid):
         self._superusers.pop(clientid, None)
         self._usernames.pop(clientid, None)
@@ -132,6 +141,8 @@ def attach_auth(broker: Broker, chain: AuthChain, authz: Authz) -> AccessControl
     ac = AccessControl(chain, authz)
     broker.hooks.add("client.authenticate", ac.on_authenticate, priority=0,
                      name="authn.chain")
+    broker.hooks.add("client.enhanced_authenticated", ac.on_enhanced,
+                     priority=0, name="authn.enhanced")
     broker.hooks.add("client.authorize", ac.on_authorize, priority=0,
                      name="authz.sources")
     broker.hooks.add("session.terminated", ac.on_terminated,

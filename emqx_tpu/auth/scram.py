@@ -1,0 +1,163 @@
+"""SCRAM-SHA-256 enhanced authentication (MQTT 5 AUTH exchange).
+
+Behavioral reference: the reference's SCRAM authenticator
+(``apps/emqx_authn/.../scram`` [U], SURVEY.md §2.3) rides MQTT 5
+enhanced auth: CONNECT carries ``Authentication-Method =
+"SCRAM-SHA-256"`` + the RFC 5802 client-first message, the server
+challenges with AUTH (0x18 Continue) carrying server-first, the client
+answers with client-final, and CONNACK carries server-final (the server
+signature, so the CLIENT authenticates the server too).
+
+Wire messages are RFC 5802/7677; the user store keeps only
+``(salt, StoredKey, ServerKey, iterations)`` — never the password.
+Channel binding is ``n`` (none) — MQTT's TLS layer is independent.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import secrets
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["ScramAuthenticator", "scram_client_first", "scram_client_final"]
+
+
+def _hi(password: bytes, salt: bytes, iterations: int) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password, salt, iterations)
+
+
+def _hmac(key: bytes, msg: bytes) -> bytes:
+    return hmac.new(key, msg, "sha256").digest()
+
+
+def _h(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _parse_attrs(msg: str) -> Dict[str, str]:
+    out = {}
+    for part in msg.split(","):
+        if len(part) >= 2 and part[1] == "=":
+            out[part[0]] = part[2:]
+    return out
+
+
+class ScramAuthenticator:
+    """Server side; registers as an enhanced-auth provider under
+    ``method`` ("SCRAM-SHA-256")."""
+
+    method = "SCRAM-SHA-256"
+
+    def __init__(self, iterations: int = 4096) -> None:
+        self.iterations = iterations
+        # username -> (salt, stored_key, server_key, iterations, superuser)
+        self._users: Dict[str, Tuple[bytes, bytes, bytes, int, bool]] = {}
+
+    def add_user(self, username: str, password: bytes,
+                 is_superuser: bool = False,
+                 iterations: Optional[int] = None) -> None:
+        it = iterations or self.iterations
+        salt = os.urandom(16)
+        salted = _hi(password, salt, it)
+        client_key = _hmac(salted, b"Client Key")
+        stored_key = _h(client_key)
+        server_key = _hmac(salted, b"Server Key")
+        self._users[username] = (salt, stored_key, server_key, it,
+                                 is_superuser)
+
+    def delete_user(self, username: str) -> bool:
+        return self._users.pop(username, None) is not None
+
+    # -- enhanced-auth provider contract -----------------------------------
+    #
+    # start(clientid, username, data)        -> ("continue", bytes, state)
+    #                                         | ("deny", reason)
+    # continue_auth(state, data) -> ("ok", username, is_superuser, bytes)
+    #                             | ("deny", reason)
+
+    def start(self, clientid: str, username: Optional[str],
+              data: bytes) -> Tuple:
+        try:
+            first = data.decode("utf-8")
+            gs2, _, bare = first.partition(",,")
+            if gs2 not in ("n", "y"):       # no channel binding
+                return ("deny", "channel binding unsupported")
+            attrs = _parse_attrs(bare)
+            user = attrs.get("n") or username
+            cnonce = attrs["r"]
+        except (UnicodeDecodeError, KeyError, ValueError):
+            return ("deny", "malformed client-first")
+        rec = self._users.get(user or "")
+        if rec is None:
+            return ("deny", "unknown user")
+        salt, stored_key, server_key, it, superuser = rec
+        snonce = cnonce + secrets.token_urlsafe(18)
+        server_first = (
+            f"r={snonce},s={base64.b64encode(salt).decode()},i={it}"
+        )
+        state = {
+            "user": user,
+            "nonce": snonce,
+            "auth_base": f"{bare},{server_first}",
+            "stored_key": stored_key,
+            "server_key": server_key,
+            "superuser": superuser,
+        }
+        return ("continue", server_first.encode(), state)
+
+    def continue_auth(self, state: Dict[str, Any], data: bytes) -> Tuple:
+        try:
+            final = data.decode("utf-8")
+            attrs = _parse_attrs(final)
+            if attrs["r"] != state["nonce"]:
+                return ("deny", "nonce mismatch")
+            proof = base64.b64decode(attrs["p"])
+            without_proof = final.rsplit(",p=", 1)[0]
+        except (UnicodeDecodeError, KeyError, ValueError):
+            return ("deny", "malformed client-final")
+        auth_message = f"{state['auth_base']},{without_proof}".encode()
+        client_signature = _hmac(state["stored_key"], auth_message)
+        client_key = bytes(a ^ b for a, b in zip(proof, client_signature))
+        if not hmac.compare_digest(_h(client_key), state["stored_key"]):
+            return ("deny", "bad proof")
+        server_sig = _hmac(state["server_key"], auth_message)
+        server_final = b"v=" + base64.b64encode(server_sig)
+        return ("ok", state["user"], state["superuser"], server_final)
+
+
+# ---------------------------------------------------------------------------
+# client-side helpers (the in-repo MQTT client + tests use these)
+# ---------------------------------------------------------------------------
+
+def scram_client_first(username: str,
+                       cnonce: Optional[str] = None) -> Tuple[bytes, Dict]:
+    cnonce = cnonce or secrets.token_urlsafe(18)
+    bare = f"n={username},r={cnonce}"
+    return f"n,,{bare}".encode(), {"bare": bare, "cnonce": cnonce,
+                                   "username": username}
+
+
+def scram_client_final(ctx: Dict, password: bytes,
+                       server_first: bytes) -> Tuple[bytes, Dict]:
+    """Returns (client-final bytes, ctx') — ctx' carries the expected
+    server signature for CONNACK verification."""
+    sf = server_first.decode("utf-8")
+    attrs = _parse_attrs(sf)
+    snonce, salt_b64, it = attrs["r"], attrs["s"], int(attrs["i"])
+    if not snonce.startswith(ctx["cnonce"]):
+        raise ValueError("server nonce does not extend client nonce")
+    salt = base64.b64decode(salt_b64)
+    salted = _hi(password, salt, it)
+    client_key = _hmac(salted, b"Client Key")
+    stored_key = _h(client_key)
+    without_proof = f"c={base64.b64encode(b'n,,').decode()},r={snonce}"
+    auth_message = f"{ctx['bare']},{sf},{without_proof}".encode()
+    client_sig = _hmac(stored_key, auth_message)
+    proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+    final = f"{without_proof},p={base64.b64encode(proof).decode()}"
+    server_key = _hmac(salted, b"Server Key")
+    expect = b"v=" + base64.b64encode(_hmac(server_key, auth_message))
+    return final.encode(), {**ctx, "expect_server_final": expect}

@@ -28,7 +28,7 @@ across nodes using the same tables.  The device NFA mirror subscribes to
 from __future__ import annotations
 
 from dataclasses import replace
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import topic as T
 from .hooks import Hooks, HOOK_POINTS, OK, STOP
@@ -63,6 +63,9 @@ class Broker:
     ) -> None:
         self.node = node
         self.hooks = hooks if hooks is not None else Hooks()
+        # MQTT 5 enhanced auth providers: method name -> provider
+        # (start/continue_auth contract — see auth/scram.py)
+        self.enhanced_auth: Dict[str, Any] = {}
         self.router = Router()
         self.shared = SharedSub(shared_strategy)
         self.sessions: Dict[str, Session] = {}

@@ -78,6 +78,9 @@ class Channel:
             return self._handle_connect(pkt)
         if pkt.type == P.CONNECT:
             return [("close", "protocol_error: duplicate CONNECT")]
+        if self.state == "authenticating" and pkt.type != P.AUTH:
+            # nothing but the AUTH exchange is legal mid-handshake
+            return [("close", "protocol_error: packet during auth")]
         handler = {
             P.PUBLISH: self._handle_publish,
             P.PUBACK: self._handle_puback,
@@ -88,7 +91,7 @@ class Channel:
             P.UNSUBSCRIBE: self._handle_unsubscribe,
             P.PINGREQ: lambda _: [("send", P.PingResp())],
             P.DISCONNECT: self._handle_disconnect,
-            P.AUTH: lambda _: [],
+            P.AUTH: self._handle_auth,
         }.get(pkt.type)
         if handler is None:
             return [("close", f"unexpected packet type {pkt.type}")]
@@ -142,6 +145,36 @@ class Channel:
         if self.broker.hooks.run("client.connect", (clientid, pkt)) == "stop":
             return self._connack_error(P.RC.NOT_AUTHORIZED)
 
+        # MQTT 5 enhanced auth (§4.12): an Authentication-Method property
+        # swaps the password check for a challenge/response AUTH exchange
+        method = pkt.properties.get("Authentication-Method") \
+            if pkt.proto_ver == 5 else None
+        if method is not None:
+            provider = self.broker.enhanced_auth.get(method)
+            if provider is None:
+                return self._connack_error(P.RC.BAD_AUTH_METHOD)
+            verdict = provider.start(
+                clientid, pkt.username,
+                pkt.properties.get("Authentication-Data", b""),
+            )
+            if verdict[0] == "continue":
+                self._auth_pending = (pkt, props, clientid, method,
+                                      provider, verdict[2])
+                self.state = "authenticating"
+                return [("send", P.Auth(
+                    reason_code=P.RC.CONTINUE_AUTHENTICATION,
+                    properties={"Authentication-Method": method,
+                                "Authentication-Data": verdict[1]},
+                ))]
+            if verdict[0] == "ok":
+                props["Authentication-Method"] = method
+                if verdict[3]:
+                    props["Authentication-Data"] = verdict[3]
+                self._record_enhanced(clientid, method, verdict)
+                return self._complete_connect(pkt, props, clientid,
+                                              username=verdict[1])
+            return self._connack_error(P.RC.NOT_AUTHORIZED)
+
         ok = self.broker.hooks.run_fold(
             "client.authenticate",
             (clientid, pkt.username, pkt.password, self.conninfo),
@@ -150,9 +183,98 @@ class Channel:
         if ok is not True:
             rc = ok if isinstance(ok, int) else P.RC.NOT_AUTHORIZED
             return self._connack_error(rc)
+        return self._complete_connect(pkt, props, clientid)
 
+    def _record_enhanced(self, clientid: str, method: str,
+                         verdict: Tuple) -> None:
+        """Both completion paths (single- and multi-round) record the
+        authenticated identity, incl. peerhost for ip-scoped authz."""
+        self._auth_method = method
+        self.broker.hooks.run(
+            "client.enhanced_authenticated",
+            (clientid, verdict[1], bool(verdict[2]),
+             self.conninfo.get("peerhost")),
+        )
+
+    def _handle_auth(self, pkt: P.Auth) -> List[Action]:
+        """AUTH from the client: the response/re-auth legs of enhanced
+        auth (MQTT 5 §4.12; re-authentication §4.12.1)."""
+        if (self.state == "connected"
+                and pkt.reason_code == P.RC.REAUTHENTICATE):
+            method = getattr(self, "_auth_method", None)
+            if method is None or pkt.properties.get(
+                "Authentication-Method", method
+            ) != method:
+                return [("send", P.Disconnect(
+                    reason_code=P.RC.PROTOCOL_ERROR)),
+                    ("close", "re-auth method mismatch")]
+            provider = self.broker.enhanced_auth.get(method)
+            verdict = provider.start(
+                self.clientid, self.username,
+                pkt.properties.get("Authentication-Data", b""),
+            )
+            if verdict[0] == "continue":
+                self._auth_pending = (None, {}, self.clientid, method,
+                                      provider, verdict[2])
+                return [("send", P.Auth(
+                    reason_code=P.RC.CONTINUE_AUTHENTICATION,
+                    properties={"Authentication-Method": method,
+                                "Authentication-Data": verdict[1]},
+                ))]
+            if verdict[0] == "ok":
+                self._record_enhanced(self.clientid, method, verdict)
+                return [("send", P.Auth(
+                    reason_code=P.RC.SUCCESS,
+                    properties={"Authentication-Method": method,
+                                "Authentication-Data": verdict[3] or b""},
+                ))]
+            return [("send", P.Disconnect(
+                reason_code=P.RC.NOT_AUTHORIZED)),
+                ("close", "re-auth denied")]
+        pending = getattr(self, "_auth_pending", None)
+        if pending is None or self.state not in ("authenticating",
+                                                 "connected"):
+            return [("close", "unexpected AUTH")]
+        cpkt, props, clientid, method, provider, state = pending
+        if pkt.properties.get("Authentication-Method", method) != method:
+            return self._connack_error(P.RC.BAD_AUTH_METHOD)
+        verdict = provider.continue_auth(
+            state, pkt.properties.get("Authentication-Data", b""))
+        if verdict[0] == "continue":  # multi-round methods
+            self._auth_pending = (cpkt, props, clientid, method, provider,
+                                  verdict[2])
+            return [("send", P.Auth(
+                reason_code=P.RC.CONTINUE_AUTHENTICATION,
+                properties={"Authentication-Method": method,
+                            "Authentication-Data": verdict[1]},
+            ))]
+        self._auth_pending = None
+        if verdict[0] != "ok":
+            if self.state == "connected":   # re-auth continue leg failed
+                return [("send", P.Disconnect(
+                    reason_code=P.RC.NOT_AUTHORIZED)),
+                    ("close", "re-auth denied")]
+            return self._connack_error(P.RC.NOT_AUTHORIZED)
+        self._record_enhanced(clientid, method, verdict)
+        if self.state == "connected":       # re-auth continue leg done
+            return [("send", P.Auth(
+                reason_code=P.RC.SUCCESS,
+                properties={"Authentication-Method": method,
+                            "Authentication-Data": verdict[3] or b""},
+            ))]
+        props["Authentication-Method"] = method
+        if verdict[3]:
+            props["Authentication-Data"] = verdict[3]
+        return self._complete_connect(cpkt, props, clientid,
+                                      username=verdict[1])
+
+    def _complete_connect(self, pkt: P.Connect, props: Dict[str, Any],
+                          clientid: str,
+                          username: Optional[str] = None) -> List[Action]:
         self.clientid = clientid
-        self.username = pkt.username
+        # enhanced auth carries the identity in the SASL exchange, not
+        # the CONNECT username field
+        self.username = username if username is not None else pkt.username
         self.will = pkt.will
         self.keepalive = pkt.keepalive
         if self.server_keepalive is not None and pkt.proto_ver == 5:
@@ -194,7 +316,7 @@ class Channel:
                 ),
             )
         )
-        self.broker.usernames[clientid] = pkt.username
+        self.broker.usernames[clientid] = self.username
         self.broker.hooks.run("client.connected", (clientid, self.conninfo))
         if present:
             for pub in sess.resume_publishes():

@@ -49,6 +49,7 @@ class Client:
         properties: Optional[Dict[str, Any]] = None,
         on_message: Optional[Callable[[InboundMessage], None]] = None,
         max_packet_size: int = F.MAX_REMAINING_LEN,
+        on_auth: Optional[Callable[[bytes], bytes]] = None,
     ) -> None:
         self.clientid = clientid
         self.host, self.port = host, port
@@ -59,6 +60,7 @@ class Client:
         self.will = will
         self.conn_properties = properties or {}
         self.on_message = on_message
+        self.on_auth = on_auth  # enhanced auth: challenge bytes -> response
         self.messages: "asyncio.Queue[InboundMessage]" = asyncio.Queue()
         self.connack: Optional[P.Connack] = None
         self.connected = False
@@ -273,7 +275,27 @@ class Client:
             self._send(P.PubAck(P.PUBCOMP, pkt.packet_id))
         elif t == P.DISCONNECT:
             self.disconnect_reason = getattr(pkt, "reason_code", 0)
-        # PINGRESP / AUTH: nothing to do
+        elif t == P.AUTH and self.on_auth is None:
+            # fail fast instead of hanging until the connect timeout
+            self._resolve((P.CONNACK, 0), MqttError(
+                "AUTH challenge received but no on_auth handler"))
+        elif t == P.AUTH:
+            # enhanced-auth challenge: compute + send the response leg
+            try:
+                data = self.on_auth(
+                    pkt.properties.get("Authentication-Data", b""))
+                self._send(P.Auth(
+                    reason_code=P.RC.CONTINUE_AUTHENTICATION,
+                    properties={
+                        "Authentication-Method":
+                            self.conn_properties.get(
+                                "Authentication-Method", ""),
+                        "Authentication-Data": data,
+                    },
+                ))
+            except Exception as e:
+                self._resolve((P.CONNACK, 0), MqttError(f"auth failed: {e}"))
+        # PINGRESP: nothing to do
 
     def _handle_publish(self, pkt: P.Publish) -> None:
         if pkt.qos == 0:
@@ -299,4 +321,7 @@ class Client:
     def _resolve(self, key: Tuple[int, int], pkt: Any) -> None:
         fut = self._pending.get(key)
         if fut is not None and not fut.done():
-            fut.set_result(pkt)
+            if isinstance(pkt, Exception):
+                fut.set_exception(pkt)
+            else:
+                fut.set_result(pkt)

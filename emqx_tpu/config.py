@@ -223,6 +223,7 @@ SCHEMA: Dict[str, Field] = {
     "slow_subs.threshold": Field(0.5, duration),
     "slow_subs.top_k": Field(10, int, lambda v: 1 <= v <= 1000),
     "slow_subs.window_time": Field(300.0, duration),
+    "slow_subs.latency_ceiling": Field(10.0, duration),
     "statsd.enable": Field(False, _bool),
     "statsd.server": Field("127.0.0.1:8125", str),
     "statsd.flush_interval": Field(30.0, duration),

@@ -43,6 +43,9 @@ class RC:
     MALFORMED_PACKET = 0x81
     PROTOCOL_ERROR = 0x82
     NOT_AUTHORIZED = 0x87
+    CONTINUE_AUTHENTICATION = 0x18
+    REAUTHENTICATE = 0x19
+    BAD_AUTH_METHOD = 0x8C
     BAD_USER_NAME_OR_PASSWORD = 0x86
     SERVER_UNAVAILABLE = 0x88
     SERVER_BUSY = 0x89

@@ -118,6 +118,7 @@ class BrokerNode:
                 threshold_ms=cfg.get("slow_subs.threshold") * 1e3,
                 top_k=cfg.get("slow_subs.top_k"),
                 window_s=cfg.get("slow_subs.window_time"),
+                max_ms=cfg.get("slow_subs.latency_ceiling") * 1e3,
             ).attach(self.broker)
             if cfg.get("slow_subs.enable") else None
         )
@@ -430,7 +431,11 @@ class BrokerNode:
             # verdicts park in the backends and the fold consumes them
             try:
                 if pkt.type == P.CONNECT:
-                    await ac.preauthenticate(channel, pkt)
+                    # enhanced-auth CONNECTs never run the authn chain —
+                    # pre-resolving would query backends for nothing
+                    if not (pkt.proto_ver == 5 and pkt.properties.get(
+                            "Authentication-Method")):
+                        await ac.preauthenticate(channel, pkt)
                 elif pkt.type == P.PUBLISH:
                     # MQTT5 topic-alias publishes carry an empty topic;
                     # resolve through the channel's alias map so the

@@ -459,3 +459,153 @@ def test_redis_auth_with_password_and_down_server():
         assert res.outcome == "ignore"
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# SCRAM-SHA-256 over MQTT 5 enhanced auth (AUTH exchange)
+# ---------------------------------------------------------------------------
+
+def test_scram_unit_roundtrip_and_tamper():
+    from emqx_tpu.auth.scram import (
+        ScramAuthenticator, scram_client_final, scram_client_first,
+    )
+
+    a = ScramAuthenticator()
+    a.add_user("sue", b"pw-sue", is_superuser=True)
+
+    first, ctx = scram_client_first("sue")
+    verdict = a.start("c1", "sue", first)
+    assert verdict[0] == "continue"
+    final, ctx = scram_client_final(ctx, b"pw-sue", verdict[1])
+    ok = a.continue_auth(verdict[2], final)
+    assert ok[0] == "ok" and ok[1] == "sue" and ok[2] is True
+    # mutual auth: the client can verify the server signature
+    assert ok[3] == ctx["expect_server_final"]
+
+    # wrong password -> bad proof
+    first, ctx = scram_client_first("sue")
+    verdict = a.start("c1", "sue", first)
+    final, _ = scram_client_final(ctx, b"WRONG", verdict[1])
+    assert a.continue_auth(verdict[2], final)[0] == "deny"
+
+    # unknown user / malformed first
+    assert a.start("c1", "ghost", scram_client_first("ghost")[0])[0] == "deny"
+    assert a.start("c1", "sue", b"\xff\xfe")[0] == "deny"
+
+
+def test_scram_mqtt5_auth_exchange_end_to_end():
+    async def main():
+        from emqx_tpu.auth.scram import (
+            ScramAuthenticator, scram_client_final, scram_client_first,
+        )
+
+        scram = ScramAuthenticator()
+        scram.add_user("dev9", b"sekret9")
+        node = await start_node(auth_chain=AuthChain(allow_anonymous=False))
+        node.broker.enhanced_auth["SCRAM-SHA-256"] = scram
+        try:
+            first, ctx = scram_client_first("dev9")
+            holder = {"ctx": ctx}
+
+            def on_auth(server_first: bytes) -> bytes:
+                final, holder["ctx"] = scram_client_final(
+                    holder["ctx"], b"sekret9", server_first)
+                return final
+
+            c = Client(clientid="c9", port=port_of(node), proto_ver=5,
+                       properties={
+                           "Authentication-Method": "SCRAM-SHA-256",
+                           "Authentication-Data": first,
+                       }, on_auth=on_auth)
+            ack = await c.connect()
+            assert ack.reason_code == 0
+            # CONNACK carries server-final: mutual authentication
+            assert ack.properties.get("Authentication-Data") == \
+                holder["ctx"]["expect_server_final"]
+            await c.subscribe("sc/t")
+            await c.publish("sc/t", b"hello-scram", qos=1)
+            msg = await c.recv(timeout=5)
+            assert msg.payload == b"hello-scram"
+            await c.disconnect()
+
+            # wrong password: server denies at the proof step
+            first2, ctx2 = scram_client_first("dev9")
+            h2 = {"ctx": ctx2}
+
+            def on_auth_bad(server_first: bytes) -> bytes:
+                final, h2["ctx"] = scram_client_final(
+                    h2["ctx"], b"nope", server_first)
+                return final
+
+            bad = Client(clientid="c10", port=port_of(node), proto_ver=5,
+                         properties={
+                             "Authentication-Method": "SCRAM-SHA-256",
+                             "Authentication-Data": first2,
+                         }, on_auth=on_auth_bad)
+            with pytest.raises(MqttError):
+                await bad.connect()
+
+            # unknown method -> 0x8C
+            unk = Client(clientid="c11", port=port_of(node), proto_ver=5,
+                         properties={
+                             "Authentication-Method": "GSSAPI",
+                             "Authentication-Data": b"x",
+                         })
+            with pytest.raises(MqttError) as ei:
+                await unk.connect()
+            assert "8c" in str(ei.value).lower() or "140" in str(ei.value)
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_scram_reauthentication_mid_session():
+    """MQTT 5 §4.12.1: a connected enhanced-auth client re-authenticates
+    with AUTH rc 0x19 without dropping the connection."""
+    async def main():
+        from emqx_tpu.auth.scram import (
+            ScramAuthenticator, scram_client_final, scram_client_first,
+        )
+        from emqx_tpu.mqtt import packet as P
+
+        scram = ScramAuthenticator()
+        scram.add_user("ra", b"pw-ra")
+        node = await start_node(auth_chain=AuthChain(allow_anonymous=False))
+        node.broker.enhanced_auth["SCRAM-SHA-256"] = scram
+        try:
+            first, ctx = scram_client_first("ra")
+            holder = {"ctx": ctx}
+
+            def on_auth(server_first: bytes) -> bytes:
+                final, holder["ctx"] = scram_client_final(
+                    holder["ctx"], b"pw-ra", server_first)
+                return final
+
+            c = Client(clientid="cr", port=port_of(node), proto_ver=5,
+                       properties={
+                           "Authentication-Method": "SCRAM-SHA-256",
+                           "Authentication-Data": first,
+                       }, on_auth=on_auth)
+            await c.connect()
+            await c.subscribe("ra/t")
+
+            # re-auth: new client-first with rc 0x19
+            first2, ctx2 = scram_client_first("ra")
+            holder["ctx"] = ctx2
+            c._send(P.Auth(
+                reason_code=P.RC.REAUTHENTICATE,
+                properties={"Authentication-Method": "SCRAM-SHA-256",
+                            "Authentication-Data": first2}))
+            # on_auth answers the challenge; server finishes with AUTH 0x00
+            await asyncio.sleep(0.2)
+            assert c.connected
+            # session still works after re-auth
+            await c.publish("ra/t", b"post-reauth")
+            msg = await c.recv(timeout=5)
+            assert msg.payload == b"post-reauth"
+            await c.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
