@@ -97,4 +97,8 @@ class Banned:
 
         broker.hooks.add("client.authenticate", on_auth, priority=1000,
                          name="banned.check")
+        # enhanced-auth CONNECTs skip the authn-chain fold; the ban
+        # check must still run on their dedicated pre-auth fold
+        broker.hooks.add("client.enhanced_authenticate", on_auth,
+                         priority=1000, name="banned.check_enhanced")
         return self

@@ -153,6 +153,16 @@ class Channel:
             provider = self.broker.enhanced_auth.get(method)
             if provider is None:
                 return self._connack_error(P.RC.BAD_AUTH_METHOD)
+            # the ban/flapping checks ride this fold (the normal
+            # client.authenticate fold never runs on this path)
+            pre = self.broker.hooks.run_fold(
+                "client.enhanced_authenticate",
+                (clientid, pkt.username, None, self.conninfo),
+                True,
+            )
+            if pre is not True:
+                rc = pre if isinstance(pre, int) else P.RC.NOT_AUTHORIZED
+                return self._connack_error(rc)
             verdict = provider.start(
                 clientid, pkt.username,
                 pkt.properties.get("Authentication-Data", b""),
@@ -209,6 +219,10 @@ class Channel:
                     reason_code=P.RC.PROTOCOL_ERROR)),
                     ("close", "re-auth method mismatch")]
             provider = self.broker.enhanced_auth.get(method)
+            if provider is None:  # deregistered while connected
+                return [("send", P.Disconnect(
+                    reason_code=P.RC.BAD_AUTH_METHOD)),
+                    ("close", "auth method no longer available")]
             verdict = provider.start(
                 self.clientid, self.username,
                 pkt.properties.get("Authentication-Data", b""),

@@ -36,7 +36,7 @@ STOP = "stop"
 HOOK_POINTS = [
     "client.connect", "client.connack", "client.connected",
     "client.disconnected", "client.authenticate", "client.authorize",
-    "client.enhanced_authenticated",
+    "client.enhanced_authenticate", "client.enhanced_authenticated",
     "client.subscribe", "client.unsubscribe",
     "session.created", "session.subscribed", "session.unsubscribed",
     "session.resumed", "session.discarded", "session.takenover",

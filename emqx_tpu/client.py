@@ -73,6 +73,7 @@ class Client:
         self._tasks: List[asyncio.Task] = []
         self._closed = asyncio.Event()
         self.disconnect_reason: Optional[int] = None
+        self.reauth_result: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------------
 
@@ -275,6 +276,10 @@ class Client:
             self._send(P.PubAck(P.PUBCOMP, pkt.packet_id))
         elif t == P.DISCONNECT:
             self.disconnect_reason = getattr(pkt, "reason_code", 0)
+        elif t == P.AUTH and pkt.reason_code != P.RC.CONTINUE_AUTHENTICATION:
+            # AUTH rc=0x00: server-side completion of a re-auth — expose
+            # the final data (server signature) for caller verification
+            self.reauth_result = dict(pkt.properties)
         elif t == P.AUTH and self.on_auth is None:
             # fail fast instead of hanging until the connect timeout
             self._resolve((P.CONNACK, 0), MqttError(

@@ -242,6 +242,8 @@ SCHEMA: Dict[str, Field] = {
     "gateway.mqttsn.enable": Field(False, _bool),
     "gateway.mqttsn.bind": Field("127.0.0.1:1884", str),
     "gateway.mqttsn.gateway_id": Field(1, int),
+    "gateway.coap.enable": Field(False, _bool),
+    "gateway.coap.bind": Field("127.0.0.1:5683", str),
 
     # -- exhook (gRPC extension boundary, SURVEY.md §2.3) -----------------
     # comma-separated "name=url" pairs, e.g. "default=127.0.0.1:9000"

@@ -162,10 +162,12 @@ class GatewayManager:
         self.gateways: Dict[str, Gateway] = {}
 
     async def load(self, name: str, conf: Dict[str, Any]) -> Gateway:
+        from .coap import CoapGateway
         from .mqttsn import MqttSnGateway
         from .stomp import StompGateway
 
-        kinds = {"stomp": StompGateway, "mqttsn": MqttSnGateway}
+        kinds = {"stomp": StompGateway, "mqttsn": MqttSnGateway,
+                 "coap": CoapGateway}
         if name in self.gateways:
             raise ValueError(f"gateway {name} already loaded")
         if name not in kinds:

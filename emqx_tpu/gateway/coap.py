@@ -1,0 +1,362 @@
+"""CoAP gateway (RFC 7252 subset): publish/subscribe over CoAP, the
+``emqx_coap`` mapping.
+
+Behavioral reference: ``apps/emqx_gateway/src/coap`` [U] (SURVEY.md
+§2.3).  The reference's pubsub resource model:
+
+* ``PUT/POST coap://host/ps/{topic...}?c={clientid}&u=&p=`` — publish
+  the payload to ``topic`` (2.04 Changed);
+* ``GET .../ps/{topic}?c=...`` with ``Observe: 0`` — subscribe; server
+  pushes notifications as NON messages with a growing Observe sequence
+  (2.05 Content);
+* ``GET`` with ``Observe: 1`` — unsubscribe;
+* plain ``GET`` — read the retained message (2.05, or 4.04 Not Found).
+
+Message layer: CON requests are ACKed (piggybacked response); NON
+notifications are fire-and-forget (QoS0 semantics — the reference's
+default).  Token echoes per RFC; Uri-Path/Uri-Query/Observe/
+Content-Format options are parsed with standard option-delta encoding.
+Sessions ride the normal broker like every other gateway.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..broker.session import Publish
+from .base import Gateway, GatewayConn
+
+log = logging.getLogger(__name__)
+
+__all__ = ["CoapGateway"]
+
+# types
+CON, NON, ACK, RST = 0, 1, 2, 3
+# method/response codes
+GET, POST, PUT, DELETE = 1, 2, 3, 4
+
+
+def code(cls: int, detail: int) -> int:
+    return (cls << 5) | detail
+
+
+CONTENT = code(2, 5)         # 2.05
+CHANGED = code(2, 4)         # 2.04
+DELETED = code(2, 2)         # 2.02
+BAD_REQUEST = code(4, 0)
+UNAUTHORIZED = code(4, 1)
+FORBIDDEN = code(4, 3)
+NOT_FOUND = code(4, 4)
+NOT_ALLOWED = code(4, 5)
+
+OPT_OBSERVE = 6
+OPT_URI_PATH = 11
+OPT_CONTENT_FORMAT = 12
+OPT_URI_QUERY = 15
+
+
+class CoapMessage:
+    __slots__ = ("type", "code", "mid", "token", "options", "payload")
+
+    def __init__(self, type_: int, code_: int, mid: int, token: bytes = b"",
+                 options: Optional[List[Tuple[int, bytes]]] = None,
+                 payload: bytes = b""):
+        self.type = type_
+        self.code = code_
+        self.mid = mid
+        self.token = token
+        self.options = options or []
+        self.payload = payload
+
+    def opt_all(self, num: int) -> List[bytes]:
+        return [v for n, v in self.options if n == num]
+
+    def opt(self, num: int) -> Optional[bytes]:
+        vals = self.opt_all(num)
+        return vals[0] if vals else None
+
+
+def _ext(val: int) -> Tuple[int, bytes]:
+    """Option delta/length nibble + extended bytes."""
+    if val < 13:
+        return val, b""
+    if val < 269:
+        return 13, bytes([val - 13])
+    return 14, (val - 269).to_bytes(2, "big")
+
+
+def encode(msg: CoapMessage) -> bytes:
+    out = bytearray()
+    out.append(0x40 | (msg.type << 4) | len(msg.token))
+    out.append(msg.code)
+    out += msg.mid.to_bytes(2, "big")
+    out += msg.token
+    last = 0
+    for num, val in sorted(msg.options, key=lambda o: o[0]):
+        dn, dx = _ext(num - last)
+        ln, lx = _ext(len(val))
+        out.append((dn << 4) | ln)
+        out += dx + lx + val
+        last = num
+    if msg.payload:
+        out.append(0xFF)
+        out += msg.payload
+    return bytes(out)
+
+
+def decode(data: bytes) -> Optional[CoapMessage]:
+    if len(data) < 4 or (data[0] >> 6) != 1:
+        return None
+    tkl = data[0] & 0x0F
+    if tkl > 8 or len(data) < 4 + tkl:
+        return None
+    msg = CoapMessage(
+        (data[0] >> 4) & 0x3, data[1],
+        int.from_bytes(data[2:4], "big"), data[4:4 + tkl],
+    )
+    i = 4 + tkl
+    num = 0
+    while i < len(data):
+        if data[i] == 0xFF:
+            msg.payload = data[i + 1:]
+            break
+        dn, ln = data[i] >> 4, data[i] & 0x0F
+        i += 1
+
+        def ext(n, i):
+            if n == 13:
+                return data[i] + 13, i + 1
+            if n == 14:
+                return int.from_bytes(data[i:i + 2], "big") + 269, i + 2
+            if n == 15:
+                raise ValueError("reserved nibble")
+            return n, i
+
+        try:
+            delta, i = ext(dn, i)
+            length, i = ext(ln, i)
+        except (ValueError, IndexError):
+            return None
+        num += delta
+        msg.options.append((num, data[i:i + length]))
+        i += length
+    return msg
+
+
+class CoapClient(GatewayConn):
+    """One CoAP endpoint (keyed by UDP address)."""
+
+    def __init__(self, gw: "CoapGateway", addr) -> None:
+        super().__init__(gw.node, "coap")
+        self.gw = gw
+        self.addr = addr
+        self.last_seen = time.monotonic()
+        self.observes: Dict[str, Tuple[bytes, int]] = {}  # topic->(token,seq)
+        self._mid = 1
+        self._mid_cache: Dict[int, bytes] = {}   # CON dedup (RFC §4.2)
+        self._mid_order: "deque[int]" = deque()
+
+    def next_mid(self) -> int:
+        self._mid = (self._mid % 0xFFFF) + 1
+        return self._mid
+
+    # -- request handling --------------------------------------------------
+
+    def handle(self, req: CoapMessage) -> None:
+        self.last_seen = time.monotonic()
+        if req.type == RST:
+            return
+        if req.type == ACK:
+            return
+        # RFC 7252 §4.2 dedup: a retransmitted CON (lost ACK) must get
+        # the SAME response, not a second publish/subscribe
+        if req.type == CON:
+            cached = self._mid_cache.get(req.mid)
+            if cached is not None:
+                self.gw.transport.sendto(cached, self.addr)
+                return
+        path = [v.decode("utf-8", "replace") for v in
+                req.opt_all(OPT_URI_PATH)]
+        query = dict(
+            v.decode("utf-8", "replace").partition("=")[::2]
+            for v in req.opt_all(OPT_URI_QUERY)
+        )
+        if not path or path[0] != "ps":
+            return self.reply(req, NOT_FOUND)
+        topic = "/".join(path[1:])
+        if not topic:
+            return self.reply(req, BAD_REQUEST)
+
+        if self.clientid is None:
+            cid = query.get("c") or f"coap-{self.addr[0]}-{self.addr[1]}"
+            self.clientid = cid
+            if not self.authenticate(
+                query.get("u"),
+                query.get("p", "").encode() if "p" in query else None,
+                {"peerhost": self.addr[0]},
+            ):
+                self.clientid = None
+                return self.reply(req, UNAUTHORIZED)
+            self.attach_session(cid, clean_start=True)
+
+        method = req.code
+        if method in (PUT, POST):
+            if not self.authorize("publish", topic):
+                return self.reply(req, FORBIDDEN)
+            retain = query.get("retain", "").lower() in ("true", "1")
+            self.publish(topic, req.payload, qos=0, retain=retain)
+            return self.reply(req, CHANGED)
+        if method == GET:
+            obs = req.opt(OPT_OBSERVE)
+            obs_val = int.from_bytes(obs, "big") if obs is not None else None
+            if obs_val == 0:
+                if not self.authorize("subscribe", topic):
+                    return self.reply(req, FORBIDDEN)
+                # registration response carries Observe=1; the FIRST
+                # notification must be GREATER (RFC 7641 ordering) so
+                # the stored next-seq starts at 2
+                self.observes[topic] = (req.token, 2)
+                try:
+                    self.subscribe(topic, qos=0)
+                except ValueError:
+                    del self.observes[topic]
+                    return self.reply(req, BAD_REQUEST)
+                return self.reply(req, CONTENT,
+                                  options=[(OPT_OBSERVE, b"\x01")])
+            if obs_val == 1:
+                if self.observes.pop(topic, None) is not None:
+                    self.unsubscribe(topic)
+                return self.reply(req, CONTENT)
+            # plain GET: retained read of ONE concrete topic (the
+            # response carries a single payload; and authz must hold —
+            # reading retained data is subscribe-equivalent)
+            if "+" in topic or "#" in topic:
+                return self.reply(req, BAD_REQUEST)
+            if not self.authorize("subscribe", topic):
+                return self.reply(req, FORBIDDEN)
+            retainer = getattr(self.node, "retainer", None)
+            msgs = retainer.match(topic) if retainer is not None else []
+            if not msgs:
+                return self.reply(req, NOT_FOUND)
+            return self.reply(req, CONTENT, payload=msgs[0].payload)
+        return self.reply(req, NOT_ALLOWED)
+
+    def reply(self, req: CoapMessage, code_: int,
+              options: Optional[List[Tuple[int, bytes]]] = None,
+              payload: bytes = b"") -> None:
+        rtype = ACK if req.type == CON else NON
+        data = encode(CoapMessage(rtype, code_, req.mid, req.token,
+                                  options or [], payload))
+        if req.type == CON:
+            self._mid_cache[req.mid] = data
+            self._mid_order.append(req.mid)
+            while len(self._mid_order) > 16:
+                self._mid_cache.pop(self._mid_order.popleft(), None)
+        self.gw.transport.sendto(data, self.addr)
+
+    # -- deliveries --------------------------------------------------------
+
+    def send_deliveries(self, pubs: List[Publish]) -> None:
+        from .. import topic as T
+
+        for pub in pubs:
+            for flt, (token, seq) in list(self.observes.items()):
+                if not T.match(pub.msg.topic, flt):
+                    continue
+                self.observes[flt] = (token, (seq + 1) & 0xFFFFFF)
+                self.gw.transport.sendto(
+                    encode(CoapMessage(
+                        NON, CONTENT, self.next_mid(), token,
+                        [(OPT_OBSERVE, seq.to_bytes(3, "big").lstrip(b"\x00")
+                          or b"\x00")],
+                        pub.msg.payload,
+                    )),
+                    self.addr,
+                )
+            # QoS0 gateway: ack any QoS1 delivery immediately
+            if pub.pid is not None:
+                sess = self.node.broker.sessions.get(self.clientid)
+                if sess is not None:
+                    sess.puback(pub.pid)
+
+    def close_transport(self, reason: str) -> None:
+        self.gw.drop(self.addr)
+
+
+class _Proto(asyncio.DatagramProtocol):
+    def __init__(self, gw: "CoapGateway") -> None:
+        self.gw = gw
+
+    def connection_made(self, transport) -> None:
+        self.gw.transport = transport
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        self.gw.on_datagram(data, addr)
+
+
+class CoapGateway(Gateway):
+    name = "coap"
+
+    def __init__(self, node: Any, conf: Dict[str, Any]) -> None:
+        super().__init__(node, conf)
+        self.transport = None
+        self.port = 0
+        self.by_addr: Dict[Any, CoapClient] = {}
+        self._sweeper: Optional[asyncio.Task] = None
+        self.idle_timeout = float(conf.get("idle_timeout", 120.0))
+
+    async def start(self) -> None:
+        bind = self.conf.get("bind", "127.0.0.1:5683")
+        host, _, port = bind.rpartition(":")
+        loop = asyncio.get_running_loop()
+        self.transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Proto(self), local_addr=(host or "0.0.0.0", int(port))
+        )
+        self.port = self.transport.get_extra_info("sockname")[1]
+        self._sweeper = asyncio.ensure_future(self._sweep())
+        log.info("coap gateway on udp %s:%d", host, self.port)
+
+    async def stop(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+        for c in list(self.by_addr.values()):
+            c.detach_session(discard=True, reason="gateway stopped")
+        self.by_addr.clear()
+        if self.transport is not None:
+            self.transport.close()
+
+    def drop(self, addr) -> None:
+        self.by_addr.pop(addr, None)
+        self.clients.pop(str(addr), None)
+
+    def on_datagram(self, data: bytes, addr) -> None:
+        msg = decode(data)
+        if msg is None:
+            return
+        client = self.by_addr.get(addr)
+        if client is None:
+            if msg.type in (ACK, RST) or msg.code == 0:
+                return  # only actual requests allocate endpoint state
+            client = CoapClient(self, addr)
+            self.by_addr[addr] = client
+            self.clients[str(addr)] = client
+        try:
+            client.handle(msg)
+        except Exception:
+            log.exception("coap: error handling message from %s", addr)
+
+    async def _sweep(self) -> None:
+        while True:
+            await asyncio.sleep(10.0)
+            now = time.monotonic()
+            for addr, c in list(self.by_addr.items()):
+                if now - c.last_seen > self.idle_timeout:
+                    c.detach_session(discard=True, reason="idle timeout")
+                    self.drop(addr)
+
+    def info(self) -> Dict[str, Any]:
+        return {**super().info(), "port": self.port, "transport": "udp"}

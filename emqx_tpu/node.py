@@ -494,7 +494,7 @@ class BrokerNode:
         from .gateway import GatewayManager
 
         self.gateways = GatewayManager(self)
-        for name in ("stomp", "mqttsn"):
+        for name in ("stomp", "mqttsn", "coap"):
             if not self.config.get(f"gateway.{name}.enable"):
                 continue
             conf = {"bind": self.config.get(f"gateway.{name}.bind")}

@@ -609,3 +609,38 @@ def test_scram_reauthentication_mid_session():
             await node.stop()
 
     run(main())
+
+
+def test_scram_banned_client_rejected():
+    """The ban check must hold on the enhanced-auth path too (it rides a
+    dedicated pre-auth fold since the chain fold never runs there)."""
+    async def main():
+        from emqx_tpu.auth.scram import (
+            ScramAuthenticator, scram_client_final, scram_client_first,
+        )
+
+        scram = ScramAuthenticator()
+        scram.add_user("evil", b"pw")
+        node = await start_node(auth_chain=AuthChain(allow_anonymous=False))
+        node.broker.enhanced_auth["SCRAM-SHA-256"] = scram
+        node.banned.add("clientid", "banned-c")
+        try:
+            first, ctx = scram_client_first("evil")
+            h = {"ctx": ctx}
+
+            def on_auth(sf):
+                final, h["ctx"] = scram_client_final(h["ctx"], b"pw", sf)
+                return final
+
+            bad = Client(clientid="banned-c", port=port_of(node),
+                         proto_ver=5, properties={
+                             "Authentication-Method": "SCRAM-SHA-256",
+                             "Authentication-Data": first,
+                         }, on_auth=on_auth)
+            with pytest.raises(MqttError) as ei:
+                await bad.connect()
+            assert "138" in str(ei.value)  # 0x8A BANNED
+        finally:
+            await node.stop()
+
+    run(main())

@@ -315,3 +315,144 @@ def test_gateway_rest_listing():
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# CoAP over UDP
+# ---------------------------------------------------------------------------
+
+class CoapTestClient:
+    def __init__(self, port):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.settimeout(5.0)
+        self.addr = ("127.0.0.1", port)
+        self.mid = 0
+
+    def request(self, code, path, query=(), payload=b"", observe=None,
+                token=b"\x01", con=True):
+        from emqx_tpu.gateway import coap as C
+
+        self.mid += 1
+        opts = []
+        if observe is not None:
+            opts.append((C.OPT_OBSERVE,
+                         observe.to_bytes(1, "big") if observe else b""))
+        for seg in path.split("/"):
+            opts.append((C.OPT_URI_PATH, seg.encode()))
+        for q in query:
+            opts.append((C.OPT_URI_QUERY, q.encode()))
+        msg = C.CoapMessage(C.CON if con else C.NON, code, self.mid,
+                            token, opts, payload)
+        self.sock.sendto(C.encode(msg), self.addr)
+
+    def recv(self):
+        from emqx_tpu.gateway import coap as C
+
+        data, _ = self.sock.recvfrom(2048)
+        return C.decode(data)
+
+    def close(self):
+        self.sock.close()
+
+
+def coap_node_cfg():
+    return ('gateway.coap.enable = true\n'
+            'gateway.coap.bind = "127.0.0.1:0"\n')
+
+
+def test_coap_publish_observe_and_retained():
+    async def main():
+        from emqx_tpu.gateway import coap as C
+
+        node = await start_node(coap_node_cfg())
+        try:
+            cport = node.gateways.gateways["coap"].port
+            mq = Client(clientid="m1", port=mqtt_port(node))
+            await mq.connect()
+            await mq.subscribe("sensors/#")
+
+            c = CoapTestClient(cport)
+            # publish via PUT -> 2.04, reaches MQTT subscriber
+            def put_flow():
+                c.request(C.PUT, "ps/sensors/t1", ("c=coap1",), b"23.5")
+                r = c.recv()
+                assert r.code == C.CHANGED and r.type == C.ACK
+            await asyncio.to_thread(put_flow)
+            got = await mq.recv(timeout=5)
+            assert (got.topic, got.payload) == ("sensors/t1", b"23.5")
+
+            # observe (subscribe): MQTT publish pushes a notification
+            def obs_flow():
+                c.request(C.GET, "ps/alerts/a", ("c=coap1",), observe=0,
+                          token=b"\x77")
+                r = c.recv()
+                assert r.code == C.CONTENT
+            await asyncio.to_thread(obs_flow)
+            await mq.publish("alerts/a", b"fire!")
+
+            def notif_flow():
+                n = c.recv()
+                assert n.code == C.CONTENT and n.token == b"\x77"
+                assert n.payload == b"fire!"
+                obs = n.opt(C.OPT_OBSERVE)
+                assert obs is not None
+            await asyncio.to_thread(notif_flow)
+
+            # retained read via plain GET (qos1 so the store is settled)
+            await mq.publish("cfg/v", b"42", retain=True, qos=1)
+            for _ in range(100):
+                if node.retainer.match("cfg/v"):
+                    break
+                await asyncio.sleep(0.01)
+            def get_flow():
+                c.request(C.GET, "ps/cfg/v", ("c=coap1",))
+                r = c.recv()
+                assert r.code == C.CONTENT and r.payload == b"42"
+                c.request(C.GET, "ps/cfg/missing", ("c=coap1",))
+                assert c.recv().code == C.NOT_FOUND
+            await asyncio.to_thread(get_flow)
+
+            # unobserve stops notifications
+            def unobs_flow():
+                c.request(C.GET, "ps/alerts/a", ("c=coap1",), observe=1)
+                assert c.recv().code == C.CONTENT
+            await asyncio.to_thread(unobs_flow)
+            await mq.publish("alerts/a", b"again")
+            def silent_flow():
+                c.sock.settimeout(0.4)
+                try:
+                    c.recv()
+                    return False
+                except socket.timeout:
+                    return True
+            assert await asyncio.to_thread(silent_flow)
+            c.close()
+            await mq.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_coap_codec_roundtrip():
+    from emqx_tpu.gateway import coap as C
+
+    msg = C.CoapMessage(C.CON, C.PUT, 4242, b"\xab\xcd", [
+        (C.OPT_OBSERVE, b"\x00"),
+        (C.OPT_URI_PATH, b"ps"),
+        (C.OPT_URI_PATH, b"some-long-topic-segment-exceeding-12-bytes"),
+        (C.OPT_CONTENT_FORMAT, b"\x00"),
+        (C.OPT_URI_QUERY, b"c=client1"),
+    ], b"payload")
+    out = C.decode(C.encode(msg))
+    assert out is not None
+    assert (out.type, out.code, out.mid, out.token) == (
+        C.CON, C.PUT, 4242, b"\xab\xcd")
+    assert out.opt_all(C.OPT_URI_PATH) == [
+        b"ps", b"some-long-topic-segment-exceeding-12-bytes"]
+    assert out.opt_all(C.OPT_URI_QUERY) == [b"c=client1"]
+    assert out.payload == b"payload"
+    # malformed inputs don't crash
+    assert C.decode(b"") is None
+    assert C.decode(b"\x00\x00\x00") is None
+    assert C.decode(b"\xff\xff\xff\xff\xff") is None
