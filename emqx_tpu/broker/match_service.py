@@ -85,6 +85,8 @@ class MatchService:
         bypass_rate: float = 0.0,
         prefetch_timeout_s: float = 0.5,
         table: str = "auto",   # auto | native | python
+        short_depth: int = 4,
+        split_min: int = 256,
     ) -> None:
         from ..ops import IncrementalNfa
         from ..ops.device_table import DeviceNfa
@@ -104,6 +106,12 @@ class MatchService:
         # (0 disables bypassing — tests pin the device path on)
         self.bypass_rate = bypass_rate
         self.prefetch_timeout_s = prefetch_timeout_s
+        # depth bucketing: topics with <= short_depth levels ride a
+        # shallower kernel (~40% fewer gathers on Zipf traffic); the
+        # split only happens when BOTH groups clear split_min, because a
+        # second kernel dispatch has a fixed cost that must amortize
+        self.short_depth = short_depth
+        self.split_min = split_min
 
         # host table: the C++ incremental NFA when available (seconds at
         # 10M filters, Python-object-free), else the Python twin —
@@ -314,6 +322,12 @@ class MatchService:
 
         words, lens, is_sys = encode_batch(self.inc, [], batch=64)
         self.dev.match(words, lens, is_sys)
+        if self.short_depth and self.short_depth < self.depth:
+            # pre-pay the short-depth kernel shape too, or the first
+            # split batch stalls the serving loop on an XLA compile
+            w, l, sy = encode_batch(self.inc, [], batch=64,
+                                    depth=self.short_depth)
+            self.dev.match(w, l, sy)
 
     # ------------------------------------------------------------------
     # rule-engine co-batching (BASELINE config 3)
@@ -498,14 +512,42 @@ class MatchService:
         return filters, sorted(rules)
 
     def _device_rows(self, enc, n: int):
+        res = self.dev.match(*enc)
+        return self._readback_rows(res, n)
+
+    @staticmethod
+    def _readback_rows(res, n: int):
         import jax
 
-        res = self.dev.match(*enc)
         matches, counts, sp = jax.device_get(
             (res.matches, res.n_matches, res.spilled_rows())
         )
         rows = [matches[r, : counts[r]].tolist() for r in range(n)]
         return rows, np.flatnonzero(sp[:n]).tolist()
+
+    def _device_rows_grouped(self, encs):
+        """Dispatch EVERY group's kernel first (dispatch only holds the
+        device lock), then read back — group 2 executes on device while
+        group 1's results stream back, so a depth split costs one extra
+        dispatch, not a second full round trip."""
+        handles = [(self.dev.match(*enc), n) for enc, n in encs]
+        return [self._readback_rows(res, n) for res, n in handles]
+
+    def _depth_groups(self, topics: List[str]) -> List[Tuple[List[int], int]]:
+        """Partition batch indices into (indices, kernel_depth) groups.
+        Kernel depth bounds TOPIC length, not filter depth, so short
+        topics are exact through a shallow walk of the same table."""
+        sd = self.short_depth
+        everything = [(list(range(len(topics))), self.depth)]
+        if not sd or sd >= self.depth:
+            return everything
+        short = [i for i, t in enumerate(topics) if t.count("/") < sd]
+        if len(short) < self.split_min or \
+                len(topics) - len(short) < self.split_min:
+            return everything
+        sset = set(short)
+        long_ = [i for i in range(len(topics)) if i not in sset]
+        return [(short, sd), (long_, self.depth)]
 
     async def _batch_loop(self) -> None:
         from ..ops import encode_batch
@@ -529,19 +571,33 @@ class MatchService:
             try:
                 if not self._usable():
                     raise RuntimeError("mirror stale")
-                enc = encode_batch(
-                    self.inc, topics, batch=_bucket(len(topics))
-                )
                 # aid-reuse guard: if a freed accept id is handed out
                 # again while this batch is in flight, the device rows
                 # may name it under its OLD filter — translating through
                 # the live accept_filters would be wrong at any epoch
                 reuses0 = self.inc.aid_reuses
-                rows, spilled = await asyncio.to_thread(
-                    self._device_rows, enc, len(topics)
+                groups = self._depth_groups(topics)
+                encs = [
+                    (encode_batch(self.inc, [topics[i] for i in idx],
+                                  batch=_bucket(len(idx)), depth=d),
+                     len(idx))
+                    for idx, d in groups
+                ]
+                results = await asyncio.to_thread(
+                    self._device_rows_grouped, encs
                 )
+                rows: List[Any] = [None] * len(topics)
+                spilled: List[int] = []
+                for (idx, _d), (grows, gspill) in zip(groups, results):
+                    for j, i in enumerate(idx):
+                        rows[i] = grows[j]
+                    spilled.extend(idx[j] for j in gspill)
                 if self.inc.aid_reuses != reuses0:
                     raise RuntimeError("aid reused mid-flight")
+                if self.metrics is not None:
+                    # counted only once the whole batch is known good, so
+                    # batches/topics counters stay consistent
+                    self.metrics.inc("tpu.match.batches", len(groups))
                 spset = set(spilled)
                 for r in spilled:
                     rows[r] = self._host_ids(topics[r])
@@ -553,7 +609,6 @@ class MatchService:
                         if r not in spset:
                             rows[r].extend(self._deep_ids(t))
                 if self.metrics is not None:
-                    self.metrics.inc("tpu.match.batches")
                     self.metrics.inc("tpu.match.topics", len(topics))
                     if spilled:
                         self.metrics.inc(

@@ -266,6 +266,10 @@ SCHEMA: Dict[str, Field] = {
     # host-table implementation behind the device mirror: the C++
     # incremental NFA scales to 10M filters; python is the debug twin
     "tpu.table": Field("auto", _enum("auto", "native", "python")),
+    # depth bucketing: topics with <= this many levels ride a shallower
+    # kernel; 0 disables.  split_min gates the second dispatch
+    "tpu.short_depth": Field(4, int, lambda v: 0 <= v <= 64),
+    "tpu.split_min": Field(256, int, lambda v: v >= 1),
     "tpu.mesh_shape": Field("dp=1,tp=1", str),
     "tpu.fail_open": Field(True, _bool),
     # serving tolerates up to this many un-synced router deltas before

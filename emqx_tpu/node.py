@@ -526,6 +526,8 @@ class BrokerNode:
                 bypass_rate=cfg.get("tpu.bypass_rate"),
                 prefetch_timeout_s=cfg.get("tpu.prefetch_timeout"),
                 table=cfg.get("tpu.table"),
+                short_depth=cfg.get("tpu.short_depth"),
+                split_min=cfg.get("tpu.split_min"),
             )
             await asyncio.wait_for(
                 self.match_service.start(),

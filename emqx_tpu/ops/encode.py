@@ -137,7 +137,8 @@ class TopicEncoder:
 
 
 def encode_batch(
-    table, names: Sequence[str], batch: Optional[int] = None
+    table, names: Sequence[str], batch: Optional[int] = None,
+    depth: Optional[int] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Encode against any table-like with ``.vocab`` and ``.depth``
     (NfaTable, IncrementalNfa).  The encoder rides on the table object
@@ -150,4 +151,5 @@ def encode_batch(
             object.__setattr__(table, "_topic_encoder", enc)
         except (AttributeError, TypeError):
             pass  # slotted/frozen table: encoder lives for this call only
-    return enc.encode(names, table.depth, batch=batch)
+    return enc.encode(names, depth if depth is not None
+                      else table.depth, batch=batch)

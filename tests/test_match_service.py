@@ -406,3 +406,57 @@ def test_table_kind_selection_and_python_parity():
             await node_py.stop()
 
     run(main())
+
+
+def test_depth_bucketed_batch_parity():
+    """A mixed-depth batch split across the shallow and full kernels
+    produces the same hints as the host trie (split_min=1 pins the
+    split on)."""
+    async def main():
+        node = make_node(**{"tpu.split_min": 1, "tpu.batch_size": 512})
+        await node.start()
+        try:
+            ms = node.match_service
+            assert ms is not None
+            port = node.listeners.all()[0].port
+            sub = Client(clientid="s", port=port)
+            await sub.connect()
+            for flt in ("a/+", "a/+/c/+/e", "deep/+/x/+/z/+/q", "#"):
+                await sub.subscribe(flt)
+            await settle(lambda: ms.dev.epoch == ms.inc.epoch)
+
+            assert await settle(lambda: ms.ready, timeout=120)
+            topics = ["a/b", "a/b/c/d/e", "deep/1/x/2/z/3/q", "nah",
+                      "a/q", "deep/only"]
+            # push one batch through the device loop directly
+            futs = []
+            loop = asyncio.get_running_loop()
+            for t in topics:
+                f = loop.create_future()
+                futs.append(f)
+                ms._pending.append((t, f))
+            ms._batch_wake.set()
+            # first compiles of BOTH kernel shapes can take a while on CPU
+            assert await settle(
+                lambda: all(f.done() for f in futs), timeout=180)
+            from emqx_tpu import topic as T
+
+            missing = 0
+            for t in topics:
+                hint = ms._hints.get(t)
+                want = sorted(
+                    f for f in ("a/+", "a/+/c/+/e", "deep/+/x/+/z/+/q", "#")
+                    if T.match(t, f)
+                )
+                if hint is None:
+                    missing += 1
+                    continue
+                assert sorted(hint[2]) == want, (t, hint[2], want)
+            assert missing == 0, f"{missing} topics got no hint"
+            # the split actually happened: 2 kernel batches for 1 wake
+            assert node.observed.metrics.all().get(
+                "tpu.match.batches", 0) >= 2
+        finally:
+            await node.stop()
+
+    run(main())
