@@ -244,6 +244,11 @@ SCHEMA: Dict[str, Field] = {
     "gateway.mqttsn.gateway_id": Field(1, int),
     "gateway.coap.enable": Field(False, _bool),
     "gateway.coap.bind": Field("127.0.0.1:5683", str),
+    "gateway.exproto.enable": Field(False, _bool),
+    "gateway.exproto.bind": Field("127.0.0.1:7993", str),
+    # the user's ConnectionHandler gRPC endpoint
+    "gateway.exproto.handler": Field("", str),
+    "gateway.exproto.adapter_listen": Field("127.0.0.1:0", str),
 
     # -- exhook (gRPC extension boundary, SURVEY.md §2.3) -----------------
     # comma-separated "name=url" pairs, e.g. "default=127.0.0.1:9000"

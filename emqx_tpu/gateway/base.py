@@ -61,7 +61,13 @@ class GatewayConn:
                        reason: str = "normal") -> None:
         if self.clientid is None:
             return
-        if self.node.connections.get(self.clientid) is self:
+        owner = self.node.connections.get(self.clientid)
+        if owner is not None and owner is not self:
+            # another connection took this clientid over: ITS session is
+            # live — a late detach from the stale conn must not close it
+            self.clientid = None
+            return
+        if owner is self:
             del self.node.connections[self.clientid]
         self.node.broker.close_session(self.clientid, discard=discard)
         self.node.broker.hooks.run(
@@ -163,11 +169,12 @@ class GatewayManager:
 
     async def load(self, name: str, conf: Dict[str, Any]) -> Gateway:
         from .coap import CoapGateway
+        from .exproto import ExProtoGateway
         from .mqttsn import MqttSnGateway
         from .stomp import StompGateway
 
         kinds = {"stomp": StompGateway, "mqttsn": MqttSnGateway,
-                 "coap": CoapGateway}
+                 "coap": CoapGateway, "exproto": ExProtoGateway}
         if name in self.gateways:
             raise ValueError(f"gateway {name} already loaded")
         if name not in kinds:

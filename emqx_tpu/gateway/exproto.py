@@ -1,0 +1,301 @@
+"""ExProto gateway: bring-your-own-protocol over gRPC.
+
+Behavioral reference: ``apps/emqx_gateway/src/exproto`` [U] (SURVEY.md
+§2.3).  The gateway owns the TCP sockets; the PROTOCOL lives in an
+external gRPC server (the user's ``ConnectionHandler``): socket
+lifecycle, raw inbound bytes and subscribed-message deliveries stream
+out to it, and it drives the broker back through the hosted
+``ConnectionAdapter`` service (authenticate / pub / sub / send / close).
+
+Service stubs are hand-written against the plain-protoc messages, the
+same pattern as ``exhook/rpc.py`` (no grpc_tools in this environment);
+wire-compatible with normally-generated stubs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import grpc
+
+from ..broker.session import Publish
+from ..exhook.rpc import add_unary_service, bind_unary_stub
+from . import exproto_pb2 as pb
+from .base import Gateway, GatewayConn
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ExProtoGateway"]
+
+_PKG = "emqx_tpu.exproto.v1"
+
+_HANDLER_METHODS = {
+    "OnSocketCreated": (pb.SocketCreatedRequest, pb.EmptySuccess),
+    "OnSocketClosed": (pb.SocketClosedRequest, pb.EmptySuccess),
+    "OnReceivedBytes": (pb.ReceivedBytesRequest, pb.EmptySuccess),
+    "OnReceivedMessages": (pb.ReceivedMessagesRequest, pb.EmptySuccess),
+}
+
+_ADAPTER_METHODS = {
+    "Send": (pb.SendBytesRequest, pb.CodeResponse),
+    "Close": (pb.CloseSocketRequest, pb.CodeResponse),
+    "Authenticate": (pb.AuthenticateRequest, pb.CodeResponse),
+    "Publish": (pb.PublishRequest, pb.CodeResponse),
+    "Subscribe": (pb.SubscribeRequest, pb.CodeResponse),
+    "Unsubscribe": (pb.UnsubscribeRequest, pb.CodeResponse),
+}
+
+
+class ConnectionHandlerStub:
+    def __init__(self, channel) -> None:
+        bind_unary_stub(self, channel, _PKG, "ConnectionHandler",
+                        _HANDLER_METHODS)
+
+
+def add_connection_handler_to_server(servicer, server) -> None:
+    """For TESTS / user servers written in python: register a handler."""
+    add_unary_service(servicer, server, _PKG, "ConnectionHandler",
+                      _HANDLER_METHODS)
+
+
+class ConnectionAdapterStub:
+    def __init__(self, channel) -> None:
+        bind_unary_stub(self, channel, _PKG, "ConnectionAdapter",
+                        _ADAPTER_METHODS)
+
+
+def _add_adapter_to_server(servicer, server) -> None:
+    add_unary_service(servicer, server, _PKG, "ConnectionAdapter",
+                      _ADAPTER_METHODS)
+
+
+class ExProtoConn(GatewayConn):
+    """One raw TCP connection owned by the gateway, protocol outsourced."""
+
+    def __init__(self, gw: "ExProtoGateway", conn_id: str,
+                 writer: asyncio.StreamWriter) -> None:
+        super().__init__(gw.node, "exproto")
+        self.gw = gw
+        self.conn_id = conn_id
+        self.writer = writer
+        self.authenticated = False
+
+    def send_deliveries(self, pubs: List[Publish]) -> None:
+        # QoS>0 deliveries ack immediately: the external protocol owns
+        # reliability from here (the reference's exproto is QoS-0-ish).
+        # puback may dequeue FOLLOW-UP publishes from the mqueue into
+        # the inflight window — those must flow out too or the session
+        # wedges once the window fills
+        sess = self.node.broker.sessions.get(self.clientid)
+        queue = list(pubs)
+        msgs = []
+        while queue:
+            p = queue.pop(0)
+            msgs.append(pb.Message(topic=p.msg.topic, qos=p.msg.qos,
+                                   payload=p.msg.payload,
+                                   **{"from": p.msg.sender or ""}))
+            if p.pid is not None and sess is not None:
+                _, more = sess.puback(p.pid)
+                if more:
+                    queue.extend(more)
+        asyncio.ensure_future(self.gw.notify_messages(self.conn_id, msgs))
+
+    def close_transport(self, reason: str) -> None:
+        self.writer.close()
+
+
+class _AdapterServicer:
+    """ConnectionAdapter implementation (async grpc.aio handlers)."""
+
+    def __init__(self, gw: "ExProtoGateway") -> None:
+        self.gw = gw
+
+    def _conn(self, conn_id: str) -> Optional[ExProtoConn]:
+        return self.gw.conns.get(conn_id)
+
+    @staticmethod
+    def _ok() -> pb.CodeResponse:
+        return pb.CodeResponse(code=pb.SUCCESS)
+
+    @staticmethod
+    def _err(code, msg="") -> pb.CodeResponse:
+        return pb.CodeResponse(code=code, message=msg)
+
+    async def Send(self, req, ctx):
+        c = self._conn(req.conn)
+        if c is None:
+            return self._err(pb.CONN_PROCESS_NOT_ALIVE)
+        c.writer.write(req.bytes)
+        await c.writer.drain()
+        return self._ok()
+
+    async def Close(self, req, ctx):
+        c = self._conn(req.conn)
+        if c is None:
+            return self._err(pb.CONN_PROCESS_NOT_ALIVE)
+        c.kick("closed by handler")
+        return self._ok()
+
+    async def Authenticate(self, req, ctx):
+        c = self._conn(req.conn)
+        if c is None:
+            return self._err(pb.CONN_PROCESS_NOT_ALIVE)
+        if not req.clientinfo.clientid:
+            return self._err(pb.REQUIRED_PARAMS_MISSED, "clientid required")
+        if c.authenticated:
+            # one identity per socket (re-binding would orphan the first
+            # clientid's session + connections entry)
+            return self._err(pb.PARAMS_TYPE_ERROR, "already authenticated")
+        prev = c.clientid
+        c.clientid = req.clientinfo.clientid
+        ok = c.authenticate(
+            req.clientinfo.username or None,
+            req.password.encode() if req.password else None,
+            {"peerhost": c.writer.get_extra_info("peername",
+                                                 ("", 0))[0]},
+        )
+        if not ok:
+            c.clientid = prev
+            return self._err(pb.PERMISSION_DENY, "authentication failed")
+        c.attach_session(req.clientinfo.clientid, clean_start=True)
+        c.authenticated = True
+        return self._ok()
+
+    async def Publish(self, req, ctx):
+        c = self._conn(req.conn)
+        if c is None or not c.authenticated:
+            return self._err(pb.CONN_PROCESS_NOT_ALIVE)
+        if not c.authorize("publish", req.topic, qos=req.qos):
+            return self._err(pb.PERMISSION_DENY)
+        c.publish(req.topic, req.payload, qos=min(req.qos, 1),
+                  retain=req.retain)
+        return self._ok()
+
+    async def Subscribe(self, req, ctx):
+        c = self._conn(req.conn)
+        if c is None or not c.authenticated:
+            return self._err(pb.CONN_PROCESS_NOT_ALIVE)
+        if not c.authorize("subscribe", req.topic, qos=req.qos):
+            return self._err(pb.PERMISSION_DENY)
+        try:
+            c.subscribe(req.topic, qos=min(req.qos, 1))
+        except ValueError as e:
+            return self._err(pb.PARAMS_TYPE_ERROR, str(e))
+        return self._ok()
+
+    async def Unsubscribe(self, req, ctx):
+        c = self._conn(req.conn)
+        if c is None or not c.authenticated:
+            return self._err(pb.CONN_PROCESS_NOT_ALIVE)
+        c.unsubscribe(req.topic)
+        return self._ok()
+
+
+class ExProtoGateway(Gateway):
+    name = "exproto"
+
+    def __init__(self, node: Any, conf: Dict[str, Any]) -> None:
+        super().__init__(node, conf)
+        self.conns: Dict[str, ExProtoConn] = {}
+        self.server: Optional[asyncio.AbstractServer] = None
+        self.grpc_server = None
+        self.channel = None
+        self.handler: Optional[ConnectionHandlerStub] = None
+        self.port = 0
+        self.adapter_port = 0
+
+    async def start(self) -> None:
+        import grpc.aio
+
+        handler_url = self.conf.get("handler")
+        if not handler_url:
+            raise ValueError("exproto gateway needs conf['handler'] (url)")
+        self.channel = grpc.aio.insecure_channel(handler_url)
+        self.handler = ConnectionHandlerStub(self.channel)
+
+        self.grpc_server = grpc.aio.server()
+        _add_adapter_to_server(_AdapterServicer(self), self.grpc_server)
+        abind = self.conf.get("adapter_listen", "127.0.0.1:0")
+        ahost, _, aport = abind.rpartition(":")
+        self.adapter_port = self.grpc_server.add_insecure_port(
+            f"{ahost or '127.0.0.1'}:{aport}")
+        await self.grpc_server.start()
+
+        bind = self.conf.get("bind", "127.0.0.1:7993")
+        host, _, port = bind.rpartition(":")
+        try:
+            self.server = await asyncio.start_server(
+                self._serve_conn, host or "0.0.0.0", int(port))
+        except OSError:
+            # a failed gateway is never registered, so stop() would not
+            # run — tear the already-started gRPC pieces down here
+            await self.grpc_server.stop(grace=0)
+            await self.channel.close()
+            raise
+        self.port = self.server.sockets[0].getsockname()[1]
+        log.info("exproto gateway tcp on %s:%d, adapter grpc on %d",
+                 host, self.port, self.adapter_port)
+
+    async def stop(self) -> None:
+        for c in list(self.conns.values()):
+            c.detach_session(discard=True, reason="gateway stopped")
+            c.kick("gateway stopped")
+        self.conns.clear()
+        self.clients.clear()
+        if self.server is not None:
+            self.server.close()
+            await self.server.wait_closed()
+        if self.grpc_server is not None:
+            await self.grpc_server.stop(grace=0.2)
+        if self.channel is not None:
+            await self.channel.close()
+
+    # -- socket side -------------------------------------------------------
+
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        conn_id = uuid.uuid4().hex
+        conn = ExProtoConn(self, conn_id, writer)
+        self.conns[conn_id] = conn
+        self.clients[conn_id] = conn
+        peer = writer.get_extra_info("peername", ("", 0))
+        try:
+            await self.handler.OnSocketCreated(pb.SocketCreatedRequest(
+                conn=conn_id,
+                conninfo=pb.ConnInfo(host=peer[0], port=peer[1]),
+            ))
+            while not conn.closed:
+                data = await reader.read(65536)
+                if not data:
+                    break
+                await self.handler.OnReceivedBytes(pb.ReceivedBytesRequest(
+                    conn=conn_id, bytes=data))
+        except grpc.aio.AioRpcError as e:
+            log.warning("exproto handler unreachable: %s", e.code())
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.conns.pop(conn_id, None)
+            self.clients.pop(conn_id, None)
+            conn.detach_session(discard=True, reason="socket closed")
+            writer.close()
+            try:
+                await self.handler.OnSocketClosed(pb.SocketClosedRequest(
+                    conn=conn_id, reason="closed"))
+            except Exception:
+                pass
+
+    async def notify_messages(self, conn_id: str,
+                              msgs: List[pb.Message]) -> None:
+        try:
+            await self.handler.OnReceivedMessages(pb.ReceivedMessagesRequest(
+                conn=conn_id, messages=msgs))
+        except Exception:
+            log.warning("exproto OnReceivedMessages failed", exc_info=True)
+
+    def info(self) -> Dict[str, Any]:
+        return {**super().info(), "port": self.port,
+                "adapter_port": self.adapter_port}

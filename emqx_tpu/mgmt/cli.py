@@ -116,6 +116,40 @@ def main(argv=None) -> int:
     p.add_argument("action", choices=["list", "show", "delete"])
     p.add_argument("topic", nargs="?")
 
+    p = sub.add_parser("bridges")
+    p.add_argument("action", choices=["list", "show", "delete", "enable",
+                                      "disable"])
+    p.add_argument("bridge_id", nargs="?")
+
+    sub.add_parser("gateways")
+
+    p = sub.add_parser("trace")
+    p.add_argument("action", choices=["list", "start", "stop", "delete"])
+    p.add_argument("name", nargs="?")
+    p.add_argument("--type", dest="ttype", default="clientid",
+                   choices=["clientid", "topic", "ip_address"])
+    p.add_argument("--value", default=None)
+    p.add_argument("--duration", type=float, default=600)
+
+    p = sub.add_parser("plugins")
+    p.add_argument("action", choices=["list", "start", "stop"])
+    p.add_argument("name", nargs="?")
+
+    p = sub.add_parser("slow_subs")
+    p.add_argument("action", choices=["list", "clear"], nargs="?",
+                   default="list")
+
+    p = sub.add_parser("users")
+    p.add_argument("action", choices=["list", "add", "delete"])
+    p.add_argument("username", nargs="?")
+    p.add_argument("--password", default=None)
+    p.add_argument("--role", default="viewer")
+
+    p = sub.add_parser("psk")
+    p.add_argument("action", choices=["list", "add", "delete"])
+    p.add_argument("identity", nargs="?")
+    p.add_argument("--hex", dest="psk_hex", default=None)
+
     args = ap.parse_args(argv)
     ctl = CtlClient(args.url, args.key, args.secret)
     v = "/api/v5"
@@ -175,6 +209,67 @@ def main(argv=None) -> int:
         else:
             ctl.call("DELETE", f"{v}/retainer/message/{args.topic}")
             print(f"deleted retained {args.topic}")
+    elif args.cmd == "bridges":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/bridges"))
+        elif args.action == "show":
+            _print(ctl.call("GET", f"{v}/bridges/{args.bridge_id}"))
+        elif args.action == "delete":
+            ctl.call("DELETE", f"{v}/bridges/{args.bridge_id}")
+            print(f"deleted {args.bridge_id}")
+        else:
+            flag = "true" if args.action == "enable" else "false"
+            ctl.call("POST", f"{v}/bridges/{args.bridge_id}/enable/{flag}")
+            print(f"{args.action}d {args.bridge_id}")
+    elif args.cmd == "gateways":
+        _print(ctl.call("GET", f"{v}/gateways"))
+    elif args.cmd == "trace":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/trace"))
+        elif args.action == "start":
+            _print(ctl.call("POST", f"{v}/trace", {
+                "name": args.name, "type": args.ttype,
+                args.ttype: args.value, "duration": args.duration,
+            }))
+        elif args.action == "stop":
+            _print(ctl.call("PUT", f"{v}/trace/{args.name}/stop", {}))
+        else:
+            ctl.call("DELETE", f"{v}/trace/{args.name}")
+            print(f"deleted trace {args.name}")
+    elif args.cmd == "plugins":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/plugins"))
+        else:
+            ctl.call("PUT", f"{v}/plugins/{args.name}/{args.action}")
+            print(f"{args.action}ed {args.name}")
+    elif args.cmd == "slow_subs":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/slow_subscriptions"))
+        else:
+            ctl.call("DELETE", f"{v}/slow_subscriptions")
+            print("cleared")
+    elif args.cmd == "users":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/users"))
+        elif args.action == "add":
+            _print(ctl.call("POST", f"{v}/users", {
+                "username": args.username, "password": args.password,
+                "role": args.role,
+            }))
+        else:
+            ctl.call("DELETE", f"{v}/users/{args.username}")
+            print(f"deleted {args.username}")
+    elif args.cmd == "psk":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/psk"))
+        elif args.action == "add":
+            ctl.call("POST", f"{v}/psk", {
+                "identity": args.identity, "psk": args.psk_hex,
+            })
+            print(f"added {args.identity}")
+        else:
+            ctl.call("DELETE", f"{v}/psk/{args.identity}")
+            print(f"deleted {args.identity}")
     return 0
 
 

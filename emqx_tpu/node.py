@@ -494,13 +494,17 @@ class BrokerNode:
         from .gateway import GatewayManager
 
         self.gateways = GatewayManager(self)
-        for name in ("stomp", "mqttsn", "coap"):
+        for name in ("stomp", "mqttsn", "coap", "exproto"):
             if not self.config.get(f"gateway.{name}.enable"):
                 continue
             conf = {"bind": self.config.get(f"gateway.{name}.bind")}
             if name == "mqttsn":
                 conf["gateway_id"] = self.config.get(
                     "gateway.mqttsn.gateway_id")
+            elif name == "exproto":
+                conf["handler"] = self.config.get("gateway.exproto.handler")
+                conf["adapter_listen"] = self.config.get(
+                    "gateway.exproto.adapter_listen")
             try:
                 await self.gateways.load(name, conf)
             except Exception:

