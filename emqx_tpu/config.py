@@ -180,6 +180,9 @@ SCHEMA: Dict[str, Field] = {
     "listeners.ssl.default.keyfile": Field("", str),
     "listeners.ssl.default.cacertfile": Field("", str),
     "listeners.ssl.default.verify": Field(False, _bool),
+    # SNI: per-hostname cert chains, "host=cert.pem;key.pem" comma list
+    # (emqx_tls_lib SNI analog); unmatched names fall to the default cert
+    "listeners.ssl.default.sni": Field("", str),
     "listeners.ws.default.bind": Field("0.0.0.0:8083", str),
     "listeners.ws.default.enable": Field(False, _bool),
 

@@ -312,6 +312,31 @@ class BrokerNode:
                 ctx.verify_mode = _ssl.CERT_REQUIRED
             if self.psk is not None:
                 self.psk.wire_into(ctx)
+            sni = (cfg.get("listeners.ssl.default.sni") or "").strip()
+            if sni:
+                # per-hostname contexts: "host=cert.pem;key.pem" list
+                by_host = {}
+                for entry in sni.split(","):
+                    entry = entry.strip()
+                    if not entry:
+                        continue  # trailing comma etc.
+                    host_part, eq, files = entry.partition("=")
+                    c, _, k = files.partition(";")
+                    if not eq or not c.strip():
+                        log.warning("ignoring bad sni entry %r", entry)
+                        continue
+                    hctx = _ssl.SSLContext(_ssl.PROTOCOL_TLS_SERVER)
+                    hctx.load_cert_chain(c.strip(), k.strip() or None)
+                    by_host[host_part.strip().lower()] = hctx
+
+                def pick(sock, server_name, _ctx):
+                    if server_name:
+                        hctx = by_host.get(server_name.lower())
+                        if hctx is not None:
+                            sock.context = hctx
+                    return None  # unmatched names use the default chain
+
+                ctx.sni_callback = pick
         except (OSError, _ssl.SSLError):
             log.exception("ssl listener context build failed; disabled")
             return None

@@ -1,0 +1,28 @@
+#!/bin/bash
+# One-shot runbook for when the TPU tunnel recovers (it has been down
+# since 2026-07-29 ~20:45Z).  Probes first; on success runs the full
+# measurement ladder and drops artifacts in /tmp/tpu_run/.
+set -u
+OUT=/tmp/tpu_run
+mkdir -p "$OUT"
+
+echo "== probe =="
+if ! timeout 60 python -c "import jax, jax.numpy as jnp; print('TPU OK', jax.jit(lambda x: x+1)(jnp.ones((8,128))).sum())"; then
+  echo "tunnel still down"; exit 1
+fi
+
+echo "== kernel lab (v2 kernel, 200k filters) =="
+timeout 600 python scripts/kernel_scan_ablate.py > "$OUT/ablate.txt" 2>&1
+tail -5 "$OUT/ablate.txt"
+
+echo "== bench 1M (config 2) =="
+timeout 1200 python bench.py --filters 1000000 --serve-seconds 8 \
+  > "$OUT/bench_1m.json" 2> "$OUT/bench_1m.err"
+tail -2 "$OUT/bench_1m.err"; head -c 400 "$OUT/bench_1m.json"; echo
+
+echo "== bench 10M (config 3, north star) =="
+timeout 2400 python bench.py \
+  > "$OUT/bench_10m.json" 2> "$OUT/bench_10m.err"
+tail -3 "$OUT/bench_10m.err"; head -c 400 "$OUT/bench_10m.json"; echo
+
+echo "== done; update BASELINE.md rows with $OUT/bench_*.json =="

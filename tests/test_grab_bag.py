@@ -341,3 +341,55 @@ def test_slow_subs_ignores_by_design_delays():
             await node.stop()
 
     run(main())
+
+
+def test_ssl_sni_selects_per_hostname_cert(tmp_path):
+    """SNI: the served chain depends on the requested server name; the
+    client proves it by pinning the matching self-signed cert as CA."""
+    import shutil
+    import ssl
+    import subprocess
+
+    if shutil.which("openssl") is None:
+        pytest.skip("no openssl binary")
+
+    def gen(cn):
+        cert, key = tmp_path / f"{cn}.pem", tmp_path / f"{cn}.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(key), "-out", str(cert), "-days", "1",
+             "-subj", f"/CN={cn}", "-addext", f"subjectAltName=DNS:{cn}"],
+            check=True, capture_output=True)
+        return cert, key
+
+    dflt_c, dflt_k = gen("default.example")
+    a_c, a_k = gen("a.example")
+
+    async def main():
+        node = await start_node(
+            "listeners.ssl.default.enable = true\n"
+            'listeners.ssl.default.bind = "127.0.0.1:0"\n'
+            f'listeners.ssl.default.certfile = "{dflt_c}"\n'
+            f'listeners.ssl.default.keyfile = "{dflt_k}"\n'
+            f'listeners.ssl.default.sni = "a.example={a_c};{a_k}"\n')
+        try:
+            sport = [l for l in node.listeners.all()
+                     if l.name == "ssl-default"][0].port
+
+            async def connect_with(expect_cert, server_name):
+                cctx = ssl.create_default_context(cafile=str(expect_cert))
+                cctx.check_hostname = True
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", sport, ssl=cctx,
+                    server_hostname=server_name)
+                writer.close()
+
+            await connect_with(a_c, "a.example")          # SNI match
+            await connect_with(dflt_c, "default.example")  # fallback chain
+            with pytest.raises(ssl.SSLError):
+                # wrong pin proves different chains were served
+                await connect_with(dflt_c, "a.example")
+        finally:
+            await node.stop()
+
+    run(main())
