@@ -1,6 +1,7 @@
 """Multi-chip parallelism: mesh construction and sharded match/fan-out."""
 
 from .mesh import make_mesh, pick_shape
+from .prefix_ep import EpTables, build_ep_matcher, build_partitions, owner_of
 from .ring_fanout import build_ring_fanout, shard_bitmap_rows
 from .shared_group import build_shared_selector, host_pick, make_group_masks
 from .sharded_match import (
@@ -20,4 +21,8 @@ __all__ = [
     "host_pick",
     "build_ring_fanout",
     "shard_bitmap_rows",
+    "EpTables",
+    "build_partitions",
+    "build_ep_matcher",
+    "owner_of",
 ]

@@ -184,3 +184,88 @@ def test_ring_fanout_parity():
         for aid in m[r][m[r] >= 0]:
             want[r] |= bitmap[aid]
     np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# EP: prefix-partitioned tables + all-to-all routing (SURVEY §2.5)
+# ---------------------------------------------------------------------------
+
+def test_prefix_ep_all_to_all_parity():
+    import jax.numpy as jnp
+
+    from emqx_tpu import topic as T
+    from emqx_tpu.parallel import (
+        build_ep_matcher, build_partitions, make_mesh, owner_of,
+    )
+    from emqx_tpu.ops.encode import TopicEncoder
+
+    rng = np.random.default_rng(17)
+    words = [f"r{i}" for i in range(24)]
+    filters = sorted({
+        "/".join(
+            (words[rng.integers(24)] if lvl > 0 or rng.random() > 0.15
+             else "+")
+            for lvl, _ in enumerate(range(rng.integers(1, 5)))
+        ) + ("/#" if rng.random() < 0.3 else "")
+        for _ in range(400)
+    } | {"+/status", "#"})
+    E = 4
+    tabs = build_partitions(filters, E, depth=8)
+
+    B = 64
+    topics = ["/".join(words[rng.integers(24)]
+                       for _ in range(rng.integers(1, 6)))
+              for _ in range(B)]
+    enc = TopicEncoder(tabs.vocab)
+    w, l, s = enc.encode(topics, 8, batch=B)
+
+    mesh = make_mesh({"ep": E})
+    step = build_ep_matcher(mesh, capacity=B)  # ample: no overflow
+    res = step(jnp.asarray(w), jnp.asarray(l), jnp.asarray(s),
+               jnp.asarray(tabs.node_tabs), jnp.asarray(tabs.edge_tabs),
+               jnp.asarray(tabs.seeds))
+    m = np.asarray(res.matches)
+    owners = np.asarray(res.owners)
+    n = np.asarray(res.n_matches)
+    assert (np.asarray(res.overflow) == 0).all()
+
+    for i, t in enumerate(topics):
+        own = owner_of(t, tabs.vocab, E)
+        assert owners[i] == own
+        got = {tabs.accept_filters[own][a] for a in m[i][: n[i]]}
+        want = {f for f in filters if T.match(t, f)}
+        assert got == want, (t, got ^ want)
+
+
+def test_prefix_ep_overflow_flags_host_rerun():
+    import jax.numpy as jnp
+
+    from emqx_tpu.parallel import (
+        build_ep_matcher, build_partitions, make_mesh,
+    )
+    from emqx_tpu.ops.encode import TopicEncoder
+
+    filters = ["hot/a", "hot/+", "cold/b"]
+    E = 2
+    tabs = build_partitions(filters, E, depth=4)
+    # every topic shares one root -> one owner bucket; capacity 2 with
+    # 8 same-owner topics per source shard must overflow
+    topics = ["hot/a"] * 16
+    enc = TopicEncoder(tabs.vocab)
+    w, l, s = enc.encode(topics, 4, batch=16)
+    mesh = make_mesh({"ep": E})
+    step = build_ep_matcher(mesh, capacity=2)
+    res = step(jnp.asarray(w), jnp.asarray(l), jnp.asarray(s),
+               jnp.asarray(tabs.node_tabs), jnp.asarray(tabs.edge_tabs),
+               jnp.asarray(tabs.seeds))
+    over = np.asarray(res.overflow)
+    assert over.sum() == 16 - 2 * E  # C slots per (source, owner) pair
+    # non-overflowed rows still answered correctly
+    m = np.asarray(res.matches)
+    n = np.asarray(res.n_matches)
+    for i in range(16):
+        if over[i]:
+            continue
+        own = int(np.asarray(res.owners)[i])
+        got = {tabs.accept_filters[own][a] for a in m[i][: n[i]]}
+        assert got == {"hot/a", "hot/+"}
