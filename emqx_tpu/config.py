@@ -252,6 +252,8 @@ SCHEMA: Dict[str, Field] = {
     # the user's ConnectionHandler gRPC endpoint
     "gateway.exproto.handler": Field("", str),
     "gateway.exproto.adapter_listen": Field("127.0.0.1:0", str),
+    "gateway.lwm2m.enable": Field(False, _bool),
+    "gateway.lwm2m.bind": Field("127.0.0.1:5783", str),
 
     # -- exhook (gRPC extension boundary, SURVEY.md §2.3) -----------------
     # comma-separated "name=url" pairs, e.g. "default=127.0.0.1:9000"

@@ -170,11 +170,13 @@ class GatewayManager:
     async def load(self, name: str, conf: Dict[str, Any]) -> Gateway:
         from .coap import CoapGateway
         from .exproto import ExProtoGateway
+        from .lwm2m import Lwm2mGateway
         from .mqttsn import MqttSnGateway
         from .stomp import StompGateway
 
         kinds = {"stomp": StompGateway, "mqttsn": MqttSnGateway,
-                 "coap": CoapGateway, "exproto": ExProtoGateway}
+                 "coap": CoapGateway, "exproto": ExProtoGateway,
+                 "lwm2m": Lwm2mGateway}
         if name in self.gateways:
             raise ValueError(f"gateway {name} already loaded")
         if name not in kinds:

@@ -1,0 +1,366 @@
+"""LwM2M gateway: device management over CoAP, bridged to pub/sub.
+
+Behavioral reference: ``apps/emqx_gateway/src/lwm2m`` [U] (SURVEY.md
+§2.3).  The reference's topic contract (simplified but shape-compatible):
+
+* device → server (uplink), published by the gateway:
+  - ``lwm2m/{ep}/up/register``   registration / update / deregister
+    events (JSON: op, lifetime, objects);
+  - ``lwm2m/{ep}/up/resp``       responses to downlink commands (JSON:
+    reqid, path, code, value);
+  - ``lwm2m/{ep}/up/notify``     observe notifications;
+* server → device (downlink), the gateway SUBSCRIBES to
+  ``lwm2m/{ep}/dn/#`` per registered endpoint; messages are JSON
+  commands ``{"reqid": .., "op": "read"|"write"|"execute"|"observe"|
+  "cancel-observe", "path": "/3/0/0", "value"?: ..}`` and turn into
+  CoAP requests ON the device's registered UDP address.
+
+Implements the client-registration interface (POST /rd, update,
+deregister, lifetime expiry) and the device-management ops above over
+the RFC 7252 codec in :mod:`.coap`.  DTLS is out of scope (same posture
+as TLS-PSK: gated on runtime support).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..broker.session import Publish
+from . import coap as C
+from .base import Gateway, GatewayConn
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Lwm2mGateway"]
+
+
+class Lwm2mClient(GatewayConn):
+    """One registered LwM2M endpoint."""
+
+    def __init__(self, gw: "Lwm2mGateway", ep: str, addr,
+                 lifetime: int) -> None:
+        super().__init__(gw.node, "lwm2m")
+        self.gw = gw
+        self.ep = ep
+        self.addr = addr
+        self.lifetime = lifetime
+        self.last_seen = time.monotonic()
+        self.location = uuid.uuid4().hex[:8]
+        self.objects: List[str] = []
+        self._mid = 1
+        # outstanding downlink requests:
+        # token -> (reqid, op, path, deadline)
+        self.pending: Dict[bytes, Tuple[str, str, str, float]] = {}
+        # observe tokens: path -> token
+        self.observed: Dict[str, bytes] = {}
+
+    def next_mid(self) -> int:
+        self._mid = (self._mid % 0xFFFF) + 1
+        return self._mid
+
+    # -- uplink publishing -------------------------------------------------
+
+    def publish_up(self, kind: str, doc: Dict[str, Any]) -> None:
+        topic = f"lwm2m/{self.ep}/up/{kind}"
+        if not self.authorize("publish", topic):
+            log.warning("lwm2m %s: publish to %s denied by acl",
+                        self.ep, topic)
+            return
+        self.publish(topic, json.dumps(doc).encode(), qos=0)
+
+    # -- downlink commands -------------------------------------------------
+
+    def send_deliveries(self, pubs: List[Publish]) -> None:
+        sess = self.node.broker.sessions.get(self.clientid)
+        for pub in pubs:
+            if pub.pid is not None and sess is not None:
+                sess.puback(pub.pid)
+            try:
+                cmd = json.loads(pub.msg.payload)
+            except (ValueError, UnicodeDecodeError):
+                log.warning("lwm2m %s: non-JSON downlink on %s",
+                            self.ep, pub.msg.topic)
+                continue
+            try:
+                self.dispatch_command(cmd)
+            except Exception:
+                log.exception("lwm2m %s: downlink %r failed", self.ep, cmd)
+
+    def dispatch_command(self, cmd: Dict[str, Any]) -> None:
+        op = cmd.get("op")
+        path = str(cmd.get("path", ""))
+        reqid = str(cmd.get("reqid", ""))
+        segs = [s for s in path.split("/") if s]
+        token = uuid.uuid4().bytes[:8]
+        opts = [(C.OPT_URI_PATH, s.encode()) for s in segs]
+        if op == "read":
+            msg = C.CoapMessage(C.CON, C.GET, self.next_mid(), token, opts)
+        elif op == "observe":
+            msg = C.CoapMessage(C.CON, C.GET, self.next_mid(), token,
+                                [(C.OPT_OBSERVE, b"")] + opts)
+            self.observed[path] = token
+        elif op == "cancel-observe":
+            tok = self.observed.pop(path, None)
+            if tok is None:
+                return self.publish_up("resp", {
+                    "reqid": reqid, "path": path, "code": "4.04",
+                    "error": "not observed"})
+            msg = C.CoapMessage(C.CON, C.GET, self.next_mid(), tok,
+                                [(C.OPT_OBSERVE, b"\x01")] + opts)
+            token = tok
+        elif op == "write":
+            value = cmd.get("value", "")
+            payload = (value if isinstance(value, str)
+                       else json.dumps(value)).encode()
+            msg = C.CoapMessage(C.CON, C.PUT, self.next_mid(), token,
+                                opts, payload)
+        elif op == "execute":
+            arg = str(cmd.get("args", "")).encode()
+            msg = C.CoapMessage(C.CON, C.POST, self.next_mid(), token,
+                                opts, arg)
+        else:
+            return self.publish_up("resp", {
+                "reqid": reqid, "path": path, "code": "4.00",
+                "error": f"unknown op {op!r}"})
+        self.pending[token] = (reqid, op or "", path,
+                               time.monotonic() + self.gw.request_timeout)
+        self.gw.transport.sendto(C.encode(msg), self.addr)
+
+    # -- device → gateway responses ----------------------------------------
+
+    def on_response(self, msg: C.CoapMessage) -> None:
+        entry = self.pending.get(msg.token)
+        is_notify = (msg.token in self.observed.values()
+                     and msg.opt(C.OPT_OBSERVE) is not None)
+        code_str = f"{msg.code >> 5}.{msg.code & 0x1F:02d}"
+        payload = msg.payload.decode("utf-8", "replace")
+        if is_notify and entry is None:
+            path = next((p for p, t in self.observed.items()
+                         if t == msg.token), "")
+            self.publish_up("notify", {
+                "path": path, "code": code_str, "value": payload,
+                "seq": int.from_bytes(msg.opt(C.OPT_OBSERVE) or b"\x00",
+                                      "big"),
+            })
+            return
+        if entry is None:
+            return
+        reqid, op, path, _deadline = self.pending.pop(msg.token)
+        if op == "observe" and msg.code == C.CONTENT:
+            pass  # keep token registered for notifications
+        self.publish_up("resp", {
+            "reqid": reqid, "op": op, "path": path,
+            "code": code_str, "value": payload,
+        })
+
+    def expire_pending(self, now: float) -> None:
+        """Unanswered downlink commands time out with an explicit error
+        response (and their memory) instead of leaking forever."""
+        for tok, (reqid, op, path, deadline) in list(self.pending.items()):
+            if now >= deadline:
+                del self.pending[tok]
+                self.publish_up("resp", {
+                    "reqid": reqid, "op": op, "path": path,
+                    "code": "5.04", "error": "device timeout",
+                })
+
+    def close_transport(self, reason: str) -> None:
+        self.gw.drop(self)
+
+
+class Lwm2mGateway(Gateway):
+    name = "lwm2m"
+
+    def __init__(self, node: Any, conf: Dict[str, Any]) -> None:
+        super().__init__(node, conf)
+        self.transport = None
+        self.port = 0
+        self.by_ep: Dict[str, Lwm2mClient] = {}
+        self.by_addr: Dict[Any, Lwm2mClient] = {}
+        self.by_location: Dict[str, Lwm2mClient] = {}
+        self._sweeper: Optional[asyncio.Task] = None
+        self.request_timeout = float(conf.get("request_timeout", 30.0))
+        # RFC 7252 §4.2: retransmitted CON requests get the cached reply
+        self._mid_cache: Dict[Tuple[Any, int], bytes] = {}
+        self._mid_order: List[Tuple[Any, int]] = []
+
+    async def start(self) -> None:
+        bind = self.conf.get("bind", "127.0.0.1:5783")
+        host, _, port = bind.rpartition(":")
+        loop = asyncio.get_running_loop()
+
+        class _Proto(asyncio.DatagramProtocol):
+            def __init__(p) -> None:  # noqa: N805
+                pass
+
+            def connection_made(p, transport) -> None:  # noqa: N805
+                self.transport = transport
+
+            def datagram_received(p, data, addr) -> None:  # noqa: N805
+                self.on_datagram(data, addr)
+
+        self.transport, _ = await loop.create_datagram_endpoint(
+            _Proto, local_addr=(host or "0.0.0.0", int(port))
+        )
+        self.port = self.transport.get_extra_info("sockname")[1]
+        self._sweeper = asyncio.ensure_future(self._sweep())
+        log.info("lwm2m gateway on udp %s:%d", host, self.port)
+
+    async def stop(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+        for c in list(self.by_ep.values()):
+            c.detach_session(discard=True, reason="gateway stopped")
+        self.by_ep.clear()
+        self.by_addr.clear()
+        self.by_location.clear()
+        self.clients.clear()
+        if self.transport is not None:
+            self.transport.close()
+
+    def drop(self, client: Lwm2mClient) -> None:
+        self.by_ep.pop(client.ep, None)
+        self.by_addr.pop(client.addr, None)
+        self.by_location.pop(client.location, None)
+        self.clients.pop(client.ep, None)
+
+    # -- datagram dispatch -------------------------------------------------
+
+    def on_datagram(self, data: bytes, addr) -> None:
+        msg = C.decode(data)
+        if msg is None:
+            return
+        try:
+            known = self.by_addr.get(addr)
+            if known is not None:
+                known.last_seen = time.monotonic()
+            # responses/notifications from a registered device
+            if msg.code == 0 or (msg.code >> 5) in (2, 4, 5):
+                if known is not None and msg.type in (C.ACK, C.NON, C.CON):
+                    known.on_response(msg)
+                    if msg.type == C.CON:  # ack a CON notify
+                        self.transport.sendto(C.encode(C.CoapMessage(
+                            C.ACK, 0, msg.mid, b"")), addr)
+                return
+            self.handle_request(msg, addr)
+        except Exception:
+            log.exception("lwm2m: error handling datagram from %s", addr)
+
+    OPT_LOCATION_PATH = 8
+
+    def handle_request(self, msg: C.CoapMessage, addr) -> None:
+        path = [v.decode("utf-8", "replace")
+                for v in msg.opt_all(C.OPT_URI_PATH)]
+        query = dict(v.decode("utf-8", "replace").partition("=")[::2]
+                     for v in msg.opt_all(C.OPT_URI_QUERY))
+
+        if msg.type == C.CON:
+            cached = self._mid_cache.get((addr, msg.mid))
+            if cached is not None:  # retransmission: same reply, no redo
+                self.transport.sendto(cached, addr)
+                return
+
+        def reply(code, extra_opts=None):
+            data = C.encode(C.CoapMessage(
+                C.ACK if msg.type == C.CON else C.NON, code, msg.mid,
+                msg.token, extra_opts or []))
+            if msg.type == C.CON:
+                self._mid_cache[(addr, msg.mid)] = data
+                self._mid_order.append((addr, msg.mid))
+                while len(self._mid_order) > 64:
+                    self._mid_cache.pop(self._mid_order.pop(0), None)
+            self.transport.sendto(data, addr)
+
+        if not path or path[0] != "rd":
+            return reply(C.NOT_FOUND)
+
+        if msg.code == C.POST and len(path) == 1:
+            # -- register: POST /rd?ep=..&lt=.. -------------------------
+            ep = query.get("ep", "")
+            # the endpoint lands inside topic names: wildcards/levels in
+            # it would subscribe to OTHER devices' downlinks
+            if not ep or any(c in ep for c in "/+#\x00"):
+                return reply(C.BAD_REQUEST)
+            try:
+                lifetime = int(query.get("lt", "86400") or 86400)
+            except ValueError:
+                return reply(C.BAD_REQUEST)
+            client = Lwm2mClient(self, ep, addr, lifetime)
+            client.clientid = f"lwm2m-{ep}"
+            if not client.authenticate(
+                query.get("u"), query.get("p", "").encode()
+                if "p" in query else None, {"peerhost": addr[0]},
+            ):
+                # the failed attempt must NOT evict a live registration
+                return reply(C.UNAUTHORIZED)
+            if not client.authorize("subscribe", f"lwm2m/{ep}/dn/#"):
+                return reply(C.FORBIDDEN)
+            old = self.by_ep.get(ep)
+            if old is not None:
+                self.drop(old)
+            client.attach_session(f"lwm2m-{ep}", clean_start=True)
+            client.objects = [
+                seg.strip() for seg in
+                msg.payload.decode("utf-8", "replace").split(",")
+                if seg.strip()
+            ]
+            self.by_ep[ep] = client
+            self.by_addr[addr] = client
+            self.by_location[client.location] = client
+            self.clients[ep] = client
+            client.subscribe(f"lwm2m/{ep}/dn/#", qos=0)
+            client.publish_up("register", {
+                "op": "register", "lifetime": lifetime,
+                "objects": client.objects,
+            })
+            return reply(C.code(2, 1),  # 2.01 Created + Location-Path
+                         [(self.OPT_LOCATION_PATH, b"rd"),
+                          (self.OPT_LOCATION_PATH,
+                           client.location.encode())])
+
+        if len(path) == 2 and path[1] in self.by_location:
+            client = self.by_location[path[1]]
+            if msg.code == C.POST:
+                # -- update (refreshes the source address: NAT rebinds) -
+                client.last_seen = time.monotonic()
+                if addr != client.addr:
+                    self.by_addr.pop(client.addr, None)
+                    client.addr = addr
+                    self.by_addr[addr] = client
+                if "lt" in query:
+                    try:
+                        client.lifetime = int(
+                            query["lt"] or client.lifetime)
+                    except ValueError:
+                        return reply(C.BAD_REQUEST)
+                client.publish_up("register", {
+                    "op": "update", "lifetime": client.lifetime,
+                })
+                return reply(C.code(2, 4))       # 2.04 Changed
+            if msg.code == C.DELETE:
+                # -- deregister -----------------------------------------
+                client.publish_up("register", {"op": "deregister"})
+                client.detach_session(discard=True, reason="deregister")
+                self.drop(client)
+                return reply(C.DELETED)
+        return reply(C.NOT_FOUND)
+
+    async def _sweep(self) -> None:
+        while True:
+            await asyncio.sleep(5.0)
+            now = time.monotonic()
+            for c in list(self.by_ep.values()):
+                c.expire_pending(now)
+                if now - c.last_seen > c.lifetime * 1.2:
+                    c.publish_up("register", {"op": "expired"})
+                    c.detach_session(discard=True, reason="lifetime expired")
+                    self.drop(c)
+
+    def info(self) -> Dict[str, Any]:
+        return {**super().info(), "port": self.port, "transport": "udp",
+                "endpoints": sorted(self.by_ep)}

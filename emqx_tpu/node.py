@@ -519,7 +519,7 @@ class BrokerNode:
         from .gateway import GatewayManager
 
         self.gateways = GatewayManager(self)
-        for name in ("stomp", "mqttsn", "coap", "exproto"):
+        for name in ("stomp", "mqttsn", "coap", "exproto", "lwm2m"):
             if not self.config.get(f"gateway.{name}.enable"):
                 continue
             conf = {"bind": self.config.get(f"gateway.{name}.bind")}

@@ -4,6 +4,7 @@ gateway sessions ride the normal broker (routing, retained, MQTT
 interop, auth)."""
 
 import asyncio
+import json
 import socket
 import struct
 
@@ -456,3 +457,146 @@ def test_coap_codec_roundtrip():
     assert C.decode(b"") is None
     assert C.decode(b"\x00\x00\x00") is None
     assert C.decode(b"\xff\xff\xff\xff\xff") is None
+
+
+# ---------------------------------------------------------------------------
+# LwM2M over UDP (register + device management ops)
+# ---------------------------------------------------------------------------
+
+class FakeLwm2mDevice:
+    """A device: registers, answers Read/Write, emits Observe notifies."""
+
+    def __init__(self, port):
+        from emqx_tpu.gateway import coap as C
+
+        self.C = C
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.settimeout(5.0)
+        self.addr = ("127.0.0.1", port)
+        self.resources = {"/3/0/0": "emqx-tpu-dev"}
+        self.location = None
+        self.observe_tokens = {}
+
+    def register(self, ep, lifetime=120):
+        C = self.C
+        opts = [(C.OPT_URI_PATH, b"rd"),
+                (C.OPT_URI_QUERY, f"ep={ep}".encode()),
+                (C.OPT_URI_QUERY, f"lt={lifetime}".encode())]
+        msg = C.CoapMessage(C.CON, C.POST, 77, b"\x09", opts,
+                            b"</3/0>,</4/0>")
+        self.sock.sendto(C.encode(msg), self.addr)
+        r = self.recv()
+        assert r.code == C.code(2, 1), r.code
+        segs = r.opt_all(8)  # Location-Path (RFC 7252 option 8)
+        assert segs[0] == b"rd"
+        self.location = segs[1].decode()
+
+    def recv(self):
+        data, _ = self.sock.recvfrom(2048)
+        return self.C.decode(data)
+
+    def serve_one(self):
+        """Answer ONE incoming management request."""
+        C = self.C
+        req = self.recv()
+        path = "/" + "/".join(v.decode() for v in req.opt_all(C.OPT_URI_PATH))
+        obs = req.opt(C.OPT_OBSERVE)
+        if req.code == C.GET and obs is not None and obs == b"":
+            self.observe_tokens[path] = req.token
+            val = self.resources.get(path, "")
+            resp = C.CoapMessage(C.ACK, C.CONTENT, req.mid, req.token,
+                                 [(C.OPT_OBSERVE, b"\x01")], val.encode())
+        elif req.code == C.GET:
+            val = self.resources.get(path)
+            if val is None:
+                resp = C.CoapMessage(C.ACK, C.NOT_FOUND, req.mid, req.token)
+            else:
+                resp = C.CoapMessage(C.ACK, C.CONTENT, req.mid, req.token,
+                                     [], val.encode())
+        elif req.code == C.PUT:
+            self.resources[path] = req.payload.decode()
+            resp = C.CoapMessage(C.ACK, C.code(2, 4), req.mid, req.token)
+        else:
+            resp = C.CoapMessage(C.ACK, C.code(4, 5), req.mid, req.token)
+        self.sock.sendto(C.encode(resp), self.addr)
+
+    def notify(self, path, value, seq=5):
+        C = self.C
+        tok = self.observe_tokens[path]
+        self.sock.sendto(C.encode(C.CoapMessage(
+            C.NON, C.CONTENT, 99, tok,
+            [(C.OPT_OBSERVE, bytes([seq]))], value.encode())), self.addr)
+
+    def close(self):
+        self.sock.close()
+
+
+def test_lwm2m_register_read_write_observe():
+    async def main():
+        node = await start_node('gateway.lwm2m.enable = true\n'
+                                'gateway.lwm2m.bind = "127.0.0.1:0"\n')
+        try:
+            lport = node.gateways.gateways["lwm2m"].port
+            mq = Client(clientid="m1", port=mqtt_port(node))
+            await mq.connect()
+            await mq.subscribe("lwm2m/dev7/up/#")
+
+            dev = FakeLwm2mDevice(lport)
+            await asyncio.to_thread(dev.register, "dev7")
+
+            reg = await mq.recv(timeout=5)
+            assert reg.topic == "lwm2m/dev7/up/register"
+            doc = json.loads(reg.payload)
+            assert doc["op"] == "register" and "</3/0>" in \
+                ",".join(doc["objects"]) or doc["objects"]
+
+            # downlink READ -> device answers -> uplink resp
+            await mq.publish("lwm2m/dev7/dn/cmd", json.dumps({
+                "reqid": "r1", "op": "read", "path": "/3/0/0"}).encode())
+            await asyncio.to_thread(dev.serve_one)
+            resp = await mq.recv(timeout=5)
+            assert resp.topic == "lwm2m/dev7/up/resp"
+            rdoc = json.loads(resp.payload)
+            assert (rdoc["reqid"], rdoc["code"], rdoc["value"]) == \
+                ("r1", "2.05", "emqx-tpu-dev")
+
+            # downlink WRITE
+            await mq.publish("lwm2m/dev7/dn/cmd", json.dumps({
+                "reqid": "r2", "op": "write", "path": "/3/0/14",
+                "value": "+02:00"}).encode())
+            await asyncio.to_thread(dev.serve_one)
+            rdoc = json.loads((await mq.recv(timeout=5)).payload)
+            assert (rdoc["reqid"], rdoc["code"]) == ("r2", "2.04")
+            assert dev.resources["/3/0/14"] == "+02:00"
+
+            # OBSERVE + device notification
+            await mq.publish("lwm2m/dev7/dn/cmd", json.dumps({
+                "reqid": "r3", "op": "observe", "path": "/3/0/0"}).encode())
+            await asyncio.to_thread(dev.serve_one)
+            rdoc = json.loads((await mq.recv(timeout=5)).payload)
+            assert rdoc["reqid"] == "r3" and rdoc["code"] == "2.05"
+            await asyncio.to_thread(dev.notify, "/3/0/0", "changed!")
+            note = await mq.recv(timeout=5)
+            assert note.topic == "lwm2m/dev7/up/notify"
+            ndoc = json.loads(note.payload)
+            assert ndoc["value"] == "changed!" and ndoc["path"] == "/3/0/0"
+
+            # deregister
+            def dereg():
+                C = dev.C
+                msg = C.CoapMessage(C.CON, C.DELETE, 88, b"\x0a",
+                                    [(C.OPT_URI_PATH, b"rd"),
+                                     (C.OPT_URI_PATH,
+                                      dev.location.encode())])
+                dev.sock.sendto(C.encode(msg), dev.addr)
+                assert dev.recv().code == C.DELETED
+            await asyncio.to_thread(dereg)
+            rdoc = json.loads((await mq.recv(timeout=5)).payload)
+            assert rdoc["op"] == "deregister"
+            assert "dev7" not in node.gateways.gateways["lwm2m"].by_ep
+            dev.close()
+            await mq.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
