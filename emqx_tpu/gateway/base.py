@@ -161,13 +161,46 @@ class Gateway:
 
 class GatewayManager:
     """Registry + lifecycle for a node's gateways (gateway REST/CLI
-    surface reads through here)."""
+    surface reads through here).  Also drives QoS1 redelivery for
+    gateway sessions: MQTT connections get retries from their channel
+    timer, gateway protocols have no channel — without this loop an
+    unacked STOMP/SN delivery would sit in the inflight window forever."""
+
+    RETRY_INTERVAL = 5.0
 
     def __init__(self, node: Any) -> None:
         self.node = node
         self.gateways: Dict[str, Gateway] = {}
+        self._retry_task = None
+
+    async def _retry_loop(self) -> None:
+        import time as _time
+
+        while True:
+            await asyncio.sleep(self.RETRY_INTERVAL)
+            now = _time.time()
+            for gw in self.gateways.values():
+                for conn in list(gw.clients.values()):
+                    cid = conn.clientid
+                    if cid is None:
+                        continue
+                    sess = self.node.broker.sessions.get(cid)
+                    if sess is None:
+                        continue
+                    try:
+                        pubs = [
+                            Publish(pid, msg)
+                            for pid, kind, msg in sess.retry(now)
+                            if kind == "publish" and msg is not None
+                        ]
+                        if pubs:
+                            conn.deliver(pubs)
+                    except Exception:
+                        log.exception("gateway retry for %s failed", cid)
 
     async def load(self, name: str, conf: Dict[str, Any]) -> Gateway:
+        if self._retry_task is None:
+            self._retry_task = asyncio.ensure_future(self._retry_loop())
         from .coap import CoapGateway
         from .exproto import ExProtoGateway
         from .lwm2m import Lwm2mGateway
@@ -194,6 +227,13 @@ class GatewayManager:
         return True
 
     async def stop_all(self) -> None:
+        if self._retry_task is not None:
+            self._retry_task.cancel()
+            try:
+                await self._retry_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._retry_task = None
         for name in list(self.gateways):
             await self.unload(name)
 

@@ -320,6 +320,13 @@ class StompConn(GatewayConn):
                         if sess is not None:
                             sess.puback(pub.pid)
                     else:
+                        # a redelivery supersedes earlier message-ids for
+                        # the same pid (the gateway retry loop re-sends
+                        # unacked QoS1 deliveries)
+                        for old_mid, old_pid in list(
+                                self.pending_acks.items()):
+                            if old_pid == pub.pid:
+                                del self.pending_acks[old_mid]
                         self.pending_acks[mid] = pub.pid
 
     def send_error(self, msg: str) -> None:

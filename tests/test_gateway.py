@@ -600,3 +600,50 @@ def test_lwm2m_register_read_write_observe():
             await node.stop()
 
     run(main())
+
+
+def test_gateway_qos1_retry_redelivers_unacked():
+    """An unacked client-ack STOMP delivery is re-sent by the gateway
+    retry loop (gateway sessions have no MQTT channel timer)."""
+    async def main():
+        node = await start_node()
+        try:
+            gwm = node.gateways
+            gwm.RETRY_INTERVAL = 0.2
+            # restart the retry loop at test cadence
+            if gwm._retry_task is not None:
+                gwm._retry_task.cancel()
+                gwm._retry_task = asyncio.ensure_future(gwm._retry_loop())
+            sport = gwm.gateways["stomp"].port
+            c = StompClient()
+            await c.connect(sport)
+            await c.send("SUBSCRIBE", {"id": "1", "destination": "rt/1",
+                                       "ack": "client"})
+            sess_cid = list(gwm.gateways["stomp"].clients.values())[0] \
+                .clientid
+            sess = node.broker.sessions[sess_cid]
+            sess.retry_interval = 0.2
+
+            mq = Client(clientid="m1", port=mqtt_port(node))
+            await mq.connect()
+            await mq.publish("rt/1", b"persist-me", qos=1)
+
+            m1 = await c.recv()
+            assert m1.body == b"persist-me"
+            # do NOT ack: the retry loop must re-send it
+            m2 = await c.recv(timeout=5)
+            assert m2.body == b"persist-me"
+            assert m2.headers["ack"] != m1.headers["ack"]
+            # ack the redelivery clears the inflight window
+            await c.send("ACK", {"id": m2.headers["ack"]})
+            for _ in range(100):
+                if len(sess.inflight) == 0:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(sess.inflight) == 0
+            await c.close()
+            await mq.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
