@@ -365,6 +365,20 @@ class MqttSnGateway(Gateway):
                 _pack(GWINFO, bytes([self.gw_id])), addr)
             return
         client = self.by_addr.get(addr)
+        if client is None and msgtype == PUBLISH and len(data) >= 7:
+            body = parsed[1]
+            flags = body[0]
+            if (flags & FLAG_QOS_MASK) == FLAG_QOS_MASK and \
+                    (flags & 0x03) == TOPIC_PREDEFINED:
+                # QoS -1: connectionless publish on a predefined topic
+                tid = struct.unpack(">H", body[1:3])[0]
+                topic = self.predefined.get(tid)
+                if topic:
+                    from ..broker.message import make_message
+
+                    self.node.broker.publish(make_message(
+                        f"sn-anon-{addr[0]}", topic, body[5:], qos=0))
+                return
         if client is None:
             if msgtype != CONNECT:
                 return  # unknown peer must CONNECT first

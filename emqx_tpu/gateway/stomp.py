@@ -141,6 +141,9 @@ class StompConn(GatewayConn):
         self.connected = False
         self.subs: Dict[str, Tuple[str, str]] = {}  # sub id -> (dest, ack)
         self.pending_acks: Dict[str, int] = {}      # message-id -> pid
+        # STOMP transactions: tx id -> buffered (frame) list; SEND/ACK/
+        # NACK carrying a `transaction` header apply atomically on COMMIT
+        self.transactions: Dict[str, List[StompFrame]] = {}
         self._msg_seq = 0
         self._hb_send = 0.0      # we -> client interval (s)
         self._hb_recv = 0.0      # expected client -> us interval (s)
@@ -182,12 +185,20 @@ class StompConn(GatewayConn):
             "ACK": self.on_ack,
             "NACK": self.on_nack,
             "DISCONNECT": self.on_disconnect,
-            "BEGIN": self.on_unsupported_tx,
-            "COMMIT": self.on_unsupported_tx,
-            "ABORT": self.on_unsupported_tx,
+            "BEGIN": self.on_begin,
+            "COMMIT": self.on_commit,
+            "ABORT": self.on_abort,
         }.get(f.command)
         if handler is None:
             return self.send_error(f"unknown command {f.command!r}")
+        # SEND/ACK/NACK inside a transaction buffer until COMMIT
+        if f.command in ("SEND", "ACK", "NACK"):
+            tx = f.headers.get("transaction")
+            if tx is not None:
+                if tx not in self.transactions:
+                    return self.send_error(f"unknown transaction {tx!r}")
+                self.transactions[tx].append(f)
+                return self._receipt(f)
         handler(f)
 
     def on_connect(self, f: StompFrame) -> None:
@@ -284,8 +295,35 @@ class StompConn(GatewayConn):
         self.detach_session(discard=True, reason="client disconnect")
         self.kick("disconnect")
 
-    def on_unsupported_tx(self, f: StompFrame) -> None:
-        self.send_error("transactions not supported")
+    def on_begin(self, f: StompFrame) -> None:
+        tx = f.headers.get("transaction")
+        if not tx:
+            return self.send_error("BEGIN needs transaction")
+        if tx in self.transactions:
+            return self.send_error(f"transaction {tx!r} already begun")
+        if len(self.transactions) >= 64:
+            return self.send_error("too many open transactions")
+        self.transactions[tx] = []
+        self._receipt(f)
+
+    def on_commit(self, f: StompFrame) -> None:
+        tx = f.headers.get("transaction")
+        frames = self.transactions.pop(tx or "", None)
+        if frames is None:
+            return self.send_error(f"unknown transaction {tx!r}")
+        for buffered in frames:
+            # strip the tx header so the normal handlers run
+            buffered.headers.pop("transaction", None)
+            buffered.headers.pop("receipt", None)  # receipted at buffer time
+            {"SEND": self.on_send, "ACK": self.on_ack,
+             "NACK": self.on_nack}[buffered.command](buffered)
+        self._receipt(f)
+
+    def on_abort(self, f: StompFrame) -> None:
+        tx = f.headers.get("transaction")
+        if self.transactions.pop(tx or "", None) is None:
+            return self.send_error(f"unknown transaction {tx!r}")
+        self._receipt(f)
 
     # -- outbound ----------------------------------------------------------
 

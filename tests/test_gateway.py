@@ -647,3 +647,87 @@ def test_gateway_qos1_retry_redelivers_unacked():
             await node.stop()
 
     run(main())
+
+
+def test_stomp_transactions_commit_and_abort():
+    async def main():
+        node = await start_node()
+        try:
+            sport = node.gateways.gateways["stomp"].port
+            mq = Client(clientid="m1", port=mqtt_port(node))
+            await mq.connect()
+            await mq.subscribe("txt/#")
+
+            c = StompClient()
+            await c.connect(sport)
+            await c.send("BEGIN", {"transaction": "t1", "receipt": "b1"})
+            assert (await c.recv()).headers["receipt-id"] == "b1"
+            await c.send("SEND", {"destination": "txt/a",
+                                  "transaction": "t1"}, b"one")
+            await c.send("SEND", {"destination": "txt/b",
+                                  "transaction": "t1"}, b"two")
+            # nothing delivered before COMMIT
+            with pytest.raises(asyncio.TimeoutError):
+                await mq.recv(timeout=0.3)
+            await c.send("COMMIT", {"transaction": "t1", "receipt": "c1"})
+            got = {(await mq.recv(timeout=5)).payload for _ in range(2)}
+            assert got == {b"one", b"two"}
+
+            # aborted tx delivers nothing
+            await c.send("BEGIN", {"transaction": "t2"})
+            await c.send("SEND", {"destination": "txt/c",
+                                  "transaction": "t2"}, b"nope")
+            await c.send("ABORT", {"transaction": "t2"})
+            with pytest.raises(asyncio.TimeoutError):
+                await mq.recv(timeout=0.3)
+
+            # unknown tx errors
+            await c.send("SEND", {"destination": "txt/d",
+                                  "transaction": "ghost"}, b"x")
+            # drain frames until the ERROR arrives (receipts may precede)
+            for _ in range(5):
+                fr = await c.recv()
+                if fr.command == "ERROR":
+                    break
+            assert fr.command == "ERROR"
+            await c.close()
+            await mq.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_mqttsn_qos_minus1_connectionless_publish():
+    async def main():
+        node = await start_node(
+            'gateway.mqttsn.enable = true\n')  # predefined via manager conf
+        try:
+            gw = node.gateways.gateways["mqttsn"]
+            gw.predefined[7] = "sn/minus1"
+            mq = Client(clientid="m1", port=mqtt_port(node))
+            await mq.connect()
+            await mq.subscribe("sn/minus1")
+
+            def fire():
+                import struct as _s
+                s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                # PUBLISH, flags qos=0b11 + predefined, tid=7, mid=0
+                body = bytes([0x61]) + _s.pack(">H", 7) + _s.pack(">H", 0) \
+                    + b"fire-and-forget"
+                s.sendto(bytes([len(body) + 2, 0x0C]) + body,
+                         ("127.0.0.1", gw.port))
+                s.close()
+
+            await asyncio.to_thread(fire)
+            got = await mq.recv(timeout=5)
+            assert (got.topic, got.payload) == ("sn/minus1",
+                                                b"fire-and-forget")
+            # no session/connection was created for the anonymous peer
+            assert not any(cid.startswith("sn-anon")
+                           for cid in node.broker.sessions)
+            await mq.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
