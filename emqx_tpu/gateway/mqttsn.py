@@ -6,8 +6,11 @@ protocol's sensor-network core: SEARCHGW/GWINFO discovery, CONNECT
 (clean + keepalive), topic REGISTER/REGACK in both directions, PUBLISH
 QoS 0/1 with normal/predefined/short topic-id types, SUBSCRIBE/
 UNSUBSCRIBE by name or id, PINGREQ/PINGRESP, DISCONNECT, and keepalive
-expiry.  QoS2 and the sleeping-client state machine are not implemented
-(PUBREC et al. answered as protocol error).
+expiry.  QoS2 is not implemented (PUBREC et al. answered as protocol error);
+the sleeping-client state machine IS: DISCONNECT with a duration enters
+ASLEEP (the session survives, deliveries buffer in the broker outbox),
+PINGREQ with the clientid flushes buffered messages and re-arms the
+sleep window, CONNECT wakes.
 
 Wire format: [len:1 | 0x01 len:2] msgtype:1 body; 16-bit ints big-endian.
 """
@@ -101,6 +104,9 @@ class SnClient(GatewayConn):
         self.id_topics: Dict[int, str] = {}
         self._next_tid = 1
         self._next_mid = 1
+        self.asleep = False
+        self.sleep_until = 0.0
+        self.sleep_window = 0.0
         # deliveries held until the client REGACKs the topic id
         self._awaiting_reg: Dict[int, List[Publish]] = {}
 
@@ -135,8 +141,37 @@ class SnClient(GatewayConn):
         elif msgtype == UNSUBSCRIBE:
             self.on_unsubscribe(body)
         elif msgtype == PINGREQ:
+            ping_cid = body.decode("utf-8", "replace") if body else ""
+            if self.asleep and self.clientid is not None and \
+                    ping_cid == self.clientid:
+                # wake window (spec §6.14): only a PINGREQ carrying the
+                # sleeping client's OWN id flushes buffered messages;
+                # PINGRESP then ends the listen period
+                self.node.connections[self.clientid] = self
+                buffered = self.node.broker.take_outbox(self.clientid)
+                sess = self.node.broker.sessions.get(self.clientid)
+                if sess is not None:
+                    buffered = list(buffered) + sess.resume_publishes()
+                if buffered:
+                    self.send_deliveries(buffered)
+                if self.node.connections.get(self.clientid) is self:
+                    del self.node.connections[self.clientid]
+                # re-arm: same duration window from now
+                self.sleep_until = time.monotonic() + self.sleep_window
             self.send(PINGRESP, b"")
         elif msgtype == DISCONNECT:
+            duration = (struct.unpack(">H", body[0:2])[0]
+                        if len(body) >= 2 else 0)
+            if duration > 0 and self.clientid is not None:
+                # sleep: keep the session, buffer deliveries (spec §6.14);
+                # duration 0 is a NORMAL disconnect per the spec
+                self.sleep_window = duration * 1.5
+                self.sleep_until = time.monotonic() + self.sleep_window
+                self.asleep = True
+                if self.node.connections.get(self.clientid) is self:
+                    del self.node.connections[self.clientid]
+                self.send(DISCONNECT, b"")
+                return
             self.detach_session(discard=True, reason="client disconnect")
             self.send(DISCONNECT, b"")
             self.gw.drop(self.addr)
@@ -150,6 +185,8 @@ class SnClient(GatewayConn):
     def on_connect(self, body: bytes) -> None:
         if len(body) < 4:
             return
+        self.asleep = False   # CONNECT wakes a sleeping client
+        self.sleep_until = 0.0
         flags, _proto = body[0], body[1]
         self.keepalive = struct.unpack(">H", body[2:4])[0]
         cid = body[4:].decode("utf-8", "replace") or \
@@ -396,7 +433,12 @@ class MqttSnGateway(Gateway):
             await asyncio.sleep(5.0)
             now = time.monotonic()
             for addr, c in list(self.by_addr.items()):
-                if c.keepalive and now - c.last_seen > c.keepalive * 1.5:
+                if c.asleep:
+                    if c.sleep_until and now > c.sleep_until:
+                        c.detach_session(discard=False,
+                                         reason="sleep expired")
+                        self.drop(addr)
+                elif c.keepalive and now - c.last_seen > c.keepalive * 1.5:
                     c.detach_session(discard=False, reason="keepalive timeout")
                     self.drop(addr)
 

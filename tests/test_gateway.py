@@ -731,3 +731,57 @@ def test_mqttsn_qos_minus1_connectionless_publish():
             await node.stop()
 
     run(main())
+
+
+def test_mqttsn_sleeping_client_buffers_and_flushes():
+    """DISCONNECT(duration) -> ASLEEP: deliveries buffer; PINGREQ
+    flushes them; CONNECT wakes (MQTT-SN §6.14)."""
+    async def main():
+        node = await start_node()
+        try:
+            port = node.gateways.gateways["mqttsn"].port
+            mq = Client(clientid="m1", port=mqtt_port(node))
+            await mq.connect()
+
+            def setup():
+                sn = SnClient(port)
+                sn.connect("sleepy", clean=True)
+                sn.send(0x12, bytes([0x00]) + struct.pack(">H", 2)
+                        + b"zzz/t")
+                t, body = sn.recv()
+                assert t == 0x13 and body[-1] == 0
+                # DISCONNECT with duration -> ASLEEP ack'd by DISCONNECT
+                sn.send(0x18, struct.pack(">H", 60))
+                t, _ = sn.recv()
+                assert t == 0x18
+                return sn
+
+            sn = await asyncio.to_thread(setup)
+            # published while asleep: buffered, not lost, not delivered
+            await mq.publish("zzz/t", b"while-asleep", qos=1)
+            await asyncio.sleep(0.1)
+
+            def wake_and_collect():
+                sn.send(0x16, b"sleepy")  # PINGREQ with clientid
+                frames = []
+                for _ in range(3):
+                    t, body = sn.recv()
+                    frames.append((t, body))
+                    if t == 0x17:   # PINGRESP ends the listen window
+                        break
+                return frames
+
+            frames = await asyncio.to_thread(wake_and_collect)
+            types = [t for t, _ in frames]
+            assert 0x17 in types
+            pubs = [b for t, b in frames if t == 0x0C]
+            regs = [b for t, b in frames if t == 0x0A]
+            # the topic was registered pre-sleep (concrete sub) so the
+            # buffered message arrives as a direct PUBLISH
+            assert pubs and pubs[0][5:] == b"while-asleep", (pubs, regs)
+            sn.close()
+            await mq.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
