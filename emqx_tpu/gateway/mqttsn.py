@@ -44,6 +44,10 @@ SUBSCRIBE = 0x12
 SUBACK = 0x13
 UNSUBSCRIBE = 0x14
 UNSUBACK = 0x15
+WILLTOPICREQ = 0x06
+WILLTOPIC = 0x07
+WILLMSGREQ = 0x08
+WILLMSG = 0x09
 PINGREQ = 0x16
 PINGRESP = 0x17
 DISCONNECT = 0x18
@@ -107,6 +111,13 @@ class SnClient(GatewayConn):
         self.asleep = False
         self.sleep_until = 0.0
         self.sleep_window = 0.0
+        # will setup (CONNECT will flag -> WILLTOPICREQ/WILLMSGREQ);
+        # fires on ABRUPT loss, cleared by clean DISCONNECT
+        self._will_pending: Optional[bytes] = None  # deferred CONNACK
+        self.will_topic: Optional[str] = None
+        self.will_msg: bytes = b""
+        self.will_qos = 0
+        self.will_retain = False
         # deliveries held until the client REGACKs the topic id
         self._awaiting_reg: Dict[int, List[Publish]] = {}
 
@@ -172,6 +183,7 @@ class SnClient(GatewayConn):
                     del self.node.connections[self.clientid]
                 self.send(DISCONNECT, b"")
                 return
+            self.will_topic = None  # clean disconnect: will never fires
             self.detach_session(discard=True, reason="client disconnect")
             self.send(DISCONNECT, b"")
             self.gw.drop(self.addr)
@@ -179,6 +191,10 @@ class SnClient(GatewayConn):
             self.on_puback(body)
         elif msgtype == REGACK:
             self.on_regack(body)
+        elif msgtype == WILLTOPIC:
+            self.on_willtopic(body)
+        elif msgtype == WILLMSG:
+            self.on_willmsg(body)
         else:
             log.debug("mqttsn: unhandled msgtype 0x%02x", msgtype)
 
@@ -197,7 +213,37 @@ class SnClient(GatewayConn):
             return self.send(CONNACK, bytes([RC_NOT_SUPPORTED]))
         clean = bool(flags & FLAG_CLEAN)
         self.attach_session(cid, clean_start=clean)
-        self.send(CONNACK, bytes([RC_ACCEPTED]))
+        if flags & FLAG_WILL:
+            # will setup exchange defers the CONNACK (spec §6.3)
+            self._will_pending = bytes([RC_ACCEPTED])
+            self.send(WILLTOPICREQ, b"")
+        else:
+            self.send(CONNACK, bytes([RC_ACCEPTED]))
+
+    def on_willtopic(self, body: bytes) -> None:
+        if len(body) < 1:
+            return
+        flags = body[0]
+        self.will_topic = body[1:].decode("utf-8", "replace")
+        self.will_qos = min(_qos(flags), 1)
+        self.will_retain = bool(flags & FLAG_RETAIN)
+        self.send(WILLMSGREQ, b"")
+
+    def on_willmsg(self, body: bytes) -> None:
+        self.will_msg = bytes(body)
+        if self._will_pending is not None:
+            self.send(CONNACK, self._will_pending)
+            self._will_pending = None
+
+    def fire_will(self) -> None:
+        """Publish the will on abrupt loss (keepalive/sleep expiry)."""
+        if self.will_topic and self.clientid is not None:
+            try:
+                self.publish(self.will_topic, self.will_msg,
+                             qos=self.will_qos, retain=self.will_retain)
+            except Exception:
+                log.exception("mqttsn will publish failed")
+        self.will_topic = None
 
     def on_register(self, body: bytes) -> None:
         # client → gateway: topicid(2) msgid(2) topicname
@@ -435,10 +481,12 @@ class MqttSnGateway(Gateway):
             for addr, c in list(self.by_addr.items()):
                 if c.asleep:
                     if c.sleep_until and now > c.sleep_until:
+                        c.fire_will()
                         c.detach_session(discard=False,
                                          reason="sleep expired")
                         self.drop(addr)
                 elif c.keepalive and now - c.last_seen > c.keepalive * 1.5:
+                    c.fire_will()
                     c.detach_session(discard=False, reason="keepalive timeout")
                     self.drop(addr)
 

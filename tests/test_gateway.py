@@ -785,3 +785,53 @@ def test_mqttsn_sleeping_client_buffers_and_flushes():
             await node.stop()
 
     run(main())
+
+
+def test_mqttsn_will_fires_on_keepalive_loss_not_clean_disconnect():
+    async def main():
+        node = await start_node()
+        try:
+            gw = node.gateways.gateways["mqttsn"]
+            port = gw.port
+            mq = Client(clientid="m1", port=mqtt_port(node))
+            await mq.connect()
+            await mq.subscribe("wills/#")
+
+            def connect_with_will(cid, keepalive):
+                sn = SnClient(port)
+                flags = 0x04 | 0x08  # clean + will
+                sn.send(0x04, bytes([flags, 0x01])
+                        + struct.pack(">H", keepalive) + cid.encode())
+                t, _ = sn.recv()
+                assert t == 0x06  # WILLTOPICREQ
+                sn.send(0x07, bytes([0x00]) + f"wills/{cid}".encode())
+                t, _ = sn.recv()
+                assert t == 0x08  # WILLMSGREQ
+                sn.send(0x09, b"gone!")
+                t, body = sn.recv()
+                assert t == 0x05 and body[0] == 0  # CONNACK
+                return sn
+
+            # clean disconnect: will must NOT fire
+            sn1 = await asyncio.to_thread(connect_with_will, "w1", 60)
+            def clean_dc():
+                sn1.send(0x18)
+                assert sn1.recv()[0] == 0x18
+            await asyncio.to_thread(clean_dc)
+            with pytest.raises(asyncio.TimeoutError):
+                await mq.recv(timeout=0.3)
+            sn1.close()
+
+            # keepalive loss: will fires
+            sn2 = await asyncio.to_thread(connect_with_will, "w2", 1)
+            client = next(c for c in gw.by_addr.values()
+                          if c.clientid == "w2")
+            client.last_seen -= 10  # simulate silence past 1.5x keepalive
+            got = await mq.recv(timeout=10)
+            assert (got.topic, got.payload) == ("wills/w2", b"gone!")
+            sn2.close()
+            await mq.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
