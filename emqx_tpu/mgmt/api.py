@@ -97,6 +97,8 @@ class MgmtApi:
         r("DELETE", f"{v}/users/{{username}}", self.dash_user_delete)
         r("PUT", f"{v}/users/{{username}}/change_pwd", self.dash_change_pwd)
         r("GET", f"{v}/gateways", self.gateways_list)
+        r("PUT", f"{v}/gateways/{{name}}/enable/{{enable}}",
+          self.gateways_enable)
         r("GET", f"{v}/slow_subscriptions", self.slow_subs_list)
         r("DELETE", f"{v}/slow_subscriptions", self.slow_subs_clear)
         r("GET", f"{v}/plugins", self.plugins_list)
@@ -472,6 +474,30 @@ class MgmtApi:
     async def gateways_list(self, req: Request) -> Response:
         gws = getattr(self.node, "gateways", None)
         return json_response(gws.list() if gws is not None else [])
+
+    async def gateways_enable(self, req: Request) -> Response:
+        gws = self.node.gateways
+        if gws is None:
+            raise KeyError("gateways not started")
+        name = req.params["name"]
+        enable = req.params["enable"] in ("true", "1")
+        cfg = self.node.config
+        if enable:
+            if name in gws.gateways:
+                return json_response(
+                    {"code": "ALREADY_EXISTS", "message": name}, 409)
+            conf = {"bind": cfg.get(f"gateway.{name}.bind")}
+            if name == "mqttsn":
+                conf["gateway_id"] = cfg.get("gateway.mqttsn.gateway_id")
+            elif name == "exproto":
+                conf["handler"] = cfg.get("gateway.exproto.handler")
+                conf["adapter_listen"] = cfg.get(
+                    "gateway.exproto.adapter_listen")
+            gw = await gws.load(name, conf)
+            return json_response(gw.info(), 201)
+        if not await gws.unload(name):
+            raise KeyError(name)
+        return Response(204)
 
     # ------------------------------------------------------------------
     # dashboard backend (emqx_dashboard analog: RBAC users + login)

@@ -835,3 +835,32 @@ def test_mqttsn_will_fires_on_keepalive_loss_not_clean_disconnect():
             await node.stop()
 
     run(main())
+
+
+def test_gateway_runtime_load_unload_via_rest():
+    async def main():
+        from emqx_tpu.bridge import httpc
+
+        node = await start_node(
+            'dashboard.enable = true\n'
+            'dashboard.auth = false\n'
+            'dashboard.listen = "127.0.0.1:0"\n'
+            'gateway.coap.bind = "127.0.0.1:0"\n')
+        try:
+            base = f"http://127.0.0.1:{node.mgmt_server.port}/api/v5"
+            assert "coap" not in node.gateways.gateways
+            r = await httpc.request(
+                "PUT", f"{base}/gateways/coap/enable/true", body=b"")
+            assert r.status == 201
+            assert "coap" in node.gateways.gateways
+            r = await httpc.request(
+                "PUT", f"{base}/gateways/coap/enable/false", body=b"")
+            assert r.status == 204
+            assert "coap" not in node.gateways.gateways
+            r = await httpc.request(
+                "PUT", f"{base}/gateways/nope/enable/true", body=b"")
+            assert r.status == 400  # unknown gateway kind -> ValueError
+        finally:
+            await node.stop()
+
+    run(main())
