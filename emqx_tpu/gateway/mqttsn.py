@@ -68,10 +68,12 @@ TOPIC_SHORT = 0x02
 
 
 def _pack(msgtype: int, body: bytes) -> bytes:
-    n = len(body) + 2
-    if n + 1 <= 255:
-        return bytes([n + 1, msgtype]) + body
-    return b"\x01" + struct.pack(">H", n + 3)[0:2] + bytes([msgtype]) + body
+    short_len = len(body) + 2            # len octet + msgtype + body
+    if short_len <= 255:
+        return bytes([short_len, msgtype]) + body
+    # 3-octet length form: 0x01 + 2-byte TOTAL frame length + msgtype
+    total = len(body) + 4
+    return b"\x01" + struct.pack(">H", total) + bytes([msgtype]) + body
 
 
 def _unpack(data: bytes) -> Optional[Tuple[int, bytes]]:

@@ -864,3 +864,84 @@ def test_gateway_runtime_load_unload_via_rest():
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# codec round-trip fuzz (property-style, seeded)
+# ---------------------------------------------------------------------------
+
+def test_stomp_frame_codec_fuzz_roundtrip():
+    import random as _r
+
+    rng = _r.Random(99)
+    specials = ["plain", "with:colon", "with\nnewline", "with\\back",
+                "with\rcr", "", "unicode-é中"]
+    for _ in range(200):
+        cmd = rng.choice(["SEND", "MESSAGE", "SUBSCRIBE", "RECEIPT"])
+        headers = {}
+        for _ in range(rng.randint(0, 5)):
+            headers.setdefault(rng.choice(specials) or "k",
+                               rng.choice(specials))
+        body = bytes(rng.randrange(256) for _ in range(rng.randint(0, 64)))
+        buf = bytearray(serialize_frame(StompFrame(cmd, headers, body)))
+        out = next(parse_frames(buf))
+        assert out.command == cmd
+        assert out.body == body
+        for k, v in headers.items():
+            assert out.headers[k] == v
+    # incremental parse across arbitrary chunk boundaries
+    frames = [StompFrame("SEND", {"destination": f"d/{i}"},
+                         f"b{i}".encode()) for i in range(10)]
+    stream = b"".join(serialize_frame(f) for f in frames)
+    buf = bytearray()
+    got = []
+    for i in range(0, len(stream), 7):
+        buf.extend(stream[i:i + 7])
+        got.extend(parse_frames(buf))
+    assert [f.body for f in got] == [f.body for f in frames]
+
+
+def test_coap_codec_fuzz_roundtrip_and_garbage():
+    import random as _r
+
+    from emqx_tpu.gateway import coap as Cc
+
+    rng = _r.Random(7)
+    for _ in range(200):
+        opts = []
+        nums = sorted(rng.sample([1, 3, 6, 8, 11, 12, 15, 17, 35, 300,
+                                  2000], rng.randint(0, 5)))
+        for n in nums:
+            opts.append((n, bytes(rng.randrange(256)
+                                  for _ in range(rng.randint(0, 20)))))
+        msg = Cc.CoapMessage(
+            rng.randrange(4), rng.randrange(1, 256), rng.randrange(65536),
+            bytes(rng.randrange(256) for _ in range(rng.randint(0, 8))),
+            opts, bytes(rng.randrange(256)
+                        for _ in range(rng.randint(0, 32))))
+        out = Cc.decode(Cc.encode(msg))
+        assert out is not None
+        assert (out.type, out.code, out.mid, out.token) == \
+            (msg.type, msg.code, msg.mid, msg.token)
+        assert sorted(out.options) == sorted(msg.options)
+        assert out.payload == msg.payload
+    # random garbage never crashes the decoder
+    for _ in range(500):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randint(0, 40)))
+        Cc.decode(blob)  # may return None or a message; must not raise
+
+
+def test_mqttsn_unpack_garbage_never_crashes():
+    import random as _r
+
+    from emqx_tpu.gateway.mqttsn import _pack, _unpack
+
+    rng = _r.Random(3)
+    for _ in range(500):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randint(0, 40)))
+        _unpack(blob)  # None or (type, body); must not raise
+    for _ in range(100):
+        t = rng.randrange(256)
+        body = bytes(rng.randrange(256) for _ in range(rng.randint(0, 300)))
+        out = _unpack(_pack(t, body))
+        assert out == (t, body)
