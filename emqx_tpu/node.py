@@ -756,10 +756,22 @@ class BrokerNode:
     # ------------------------------------------------------------------
 
     def info(self) -> dict:
+        from . import __version__
+
         return {
             "node": self.node_name,
+            "version": __version__,
             "uptime": time.time() - self.started_at,
             "connections": len(self.connections),
             "listeners": [l.info() for l in self.listeners.all()],
+            "gateways": (self.gateways.list()
+                         if self.gateways is not None else []),
+            "bridges": len(self.bridges.list()),
+            "rules": len(self.rule_engine.rules),
+            "plugins": self.plugins.list(),
+            "cluster_peers": sorted(self.cluster.peers)
+            if self.cluster is not None else [],
+            "tpu_match": (self.match_service.info()
+                          if self.match_service is not None else None),
             **self.broker.stats(),
         }
