@@ -19,6 +19,9 @@ from .authz import AclRule, Authz, BuiltinDbSource, FileSource, compile_acl_batc
 from .access_control import attach_auth
 from .external import HttpAuthenticator, HttpAuthzSource, JwksJwtAuthenticator
 from .redis import RedisAuthenticator, RedisAuthzSource
+from .postgres import PostgresAuthenticator, PostgresAuthzSource
+from .mongo import MongoAuthenticator, MongoAuthzSource
+from .ldap import LdapAuthenticator
 
 __all__ = [
     "AuthChain", "BuiltinDbAuthenticator", "JwtAuthenticator",
@@ -27,4 +30,6 @@ __all__ = [
     "compile_acl_batch", "attach_auth",
     "HttpAuthenticator", "HttpAuthzSource", "JwksJwtAuthenticator",
     "RedisAuthenticator", "RedisAuthzSource",
+    "PostgresAuthenticator", "PostgresAuthzSource",
+    "MongoAuthenticator", "MongoAuthzSource", "LdapAuthenticator",
 ]

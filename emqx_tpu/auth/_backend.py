@@ -1,0 +1,97 @@
+"""Shared plumbing for network auth backends (Redis/Postgres/Mongo/LDAP).
+
+Every external backend follows the same two-stage discipline (see
+``auth/external.py``): the async packet intercept resolves a verdict
+over the event loop and *parks* it; the synchronous hook fold consumes
+the parked verdict without touching the loop.  This module centralizes
+that pattern so eviction/fallback-key fixes land once.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from .. import topic as T
+from .authn import AuthResult, Credentials
+from .authz import _unsafe_placeholder
+
+log = logging.getLogger(__name__)
+
+__all__ = ["ParkedVerdicts", "TtlCache", "acl_filter_matches"]
+
+
+def acl_filter_matches(flt: Any, topic: str, clientid: str,
+                       username: Optional[str]) -> bool:
+    """One backend rule filter against a topic — the SAME algebra as
+    :meth:`authz.AclRule.topic_matches`: ``eq `` prefix for literal
+    match, ``%c``/``%u`` substitution with the wildcard-injection guard
+    (a clientid/username of ``+``/``#`` or containing ``/`` must never
+    widen the pattern).  Non-string filters never match."""
+    if not isinstance(flt, str):
+        return False
+    literal = flt.startswith("eq ")
+    if literal:
+        flt = flt[3:]
+    if "%c" in flt or "%u" in flt:
+        if ("%c" in flt and _unsafe_placeholder(clientid)) or (
+                "%u" in flt and _unsafe_placeholder(username)):
+            return False
+        flt = flt.replace("%c", clientid).replace("%u", username or "")
+    if literal:
+        return topic == flt
+    try:
+        return T.match(topic, flt)
+    except ValueError:
+        return False
+
+
+class ParkedVerdicts:
+    """Bounded (clientid, username, password) -> AuthResult store."""
+
+    def __init__(self, cap: int = 512) -> None:
+        self.cap = cap
+        self._store: Dict[Tuple, AuthResult] = {}
+
+    @staticmethod
+    def key(creds: Credentials) -> Tuple:
+        return (creds.clientid, creds.username, creds.password)
+
+    def park(self, creds: Credentials, res: AuthResult) -> AuthResult:
+        while len(self._store) >= self.cap:
+            self._store.pop(next(iter(self._store)))
+        self._store[self.key(creds)] = res
+        return res
+
+    def take(self, creds: Credentials) -> Optional[AuthResult]:
+        parked = self._store.pop(self.key(creds), None)
+        if parked is None and creds.clientid:
+            # intercepts that ran before the clientid was known park
+            # under an empty clientid
+            parked = self._store.pop(
+                ("", creds.username, creds.password), None)
+        return parked
+
+
+class TtlCache:
+    """(clientid, username) -> rules cache with TTL + size pruning."""
+
+    def __init__(self, ttl: float, cap: int = 4096) -> None:
+        self.ttl = ttl
+        self.cap = cap
+        self._store: Dict[Tuple, Tuple[Any, float]] = {}
+
+    def fresh(self, key: Tuple) -> Optional[Any]:
+        hit = self._store.get(key)
+        if hit is not None and time.time() - hit[1] < self.ttl:
+            return hit[0]
+        return None
+
+    def put(self, key: Tuple, rules: Any) -> None:
+        now = time.time()
+        self._store[key] = (rules, now)
+        if len(self._store) > self.cap:
+            cutoff = now - self.ttl
+            self._store = {k: v for k, v in self._store.items()
+                           if v[1] >= cutoff}

@@ -1,0 +1,357 @@
+"""LDAP authentication backend over a minimal LDAPv3 client.
+
+Behavioral reference: ``apps/emqx_authn/.../ldap`` [U] (SURVEY.md §2.3).
+Two modes, matching the reference's:
+
+* ``method="bind"`` (default) — construct the user DN from a template
+  (``uid=${username},ou=users,dc=example,dc=com``) and issue a simple
+  BindRequest with the client's password; bind success = allow.
+* ``method="search_bind"`` — first bind as a service account, search
+  ``base_dn`` with an equality filter (default ``uid=${username}``) to
+  resolve the entry DN, then re-bind as that DN with the client's
+  password.  Attributes ``is_superuser`` is read from the entry when
+  present.
+
+The wire client hand-rolls exactly the BER/DER subset LDAP bind+search
+need (definite lengths; SEQUENCE, OCTET STRING, INTEGER, ENUMERATED,
+context tags) — dependency-free like the other external backends, same
+async-first parked-verdict discipline as ``auth/external.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from ._backend import ParkedVerdicts
+from .authn import AuthResult, Credentials, IGNORE
+from .external import _in_event_loop
+
+log = logging.getLogger(__name__)
+
+__all__ = ["LdapClient", "LdapError", "LdapAuthenticator",
+           "ber", "ber_parse"]
+
+RES_SUCCESS = 0
+RES_INVALID_CREDENTIALS = 49
+
+
+class LdapError(Exception):
+    pass
+
+
+# -- BER (definite-length DER subset) ---------------------------------------
+
+def _ber_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def ber(tag: int, payload: bytes) -> bytes:
+    return bytes([tag]) + _ber_len(len(payload)) + payload
+
+
+def _ber_int(v: int) -> bytes:
+    if v == 0:
+        return ber(0x02, b"\x00")
+    body = v.to_bytes((v.bit_length() // 8) + 1, "big")
+    return ber(0x02, body)
+
+
+def _ber_str(s: str) -> bytes:
+    return ber(0x04, s.encode())
+
+
+def ber_parse(data: bytes, off: int = 0) -> Tuple[int, bytes, int]:
+    """-> (tag, payload, next_offset)."""
+    tag = data[off]
+    ln = data[off + 1]
+    off += 2
+    if ln & 0x80:
+        nlen = ln & 0x7F
+        ln = int.from_bytes(data[off:off + nlen], "big")
+        off += nlen
+    return tag, data[off:off + ln], off + ln
+
+
+def _parse_children(payload: bytes) -> List[Tuple[int, bytes]]:
+    out = []
+    off = 0
+    while off < len(payload):
+        tag, body, off = ber_parse(payload, off)
+        out.append((tag, body))
+    return out
+
+
+class LdapClient:
+    """One async LDAP connection: simple bind + equality search."""
+
+    def __init__(self, server: str = "127.0.0.1:389",
+                 timeout: float = 5.0) -> None:
+        host, _, port = server.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port or 389)
+        self.timeout = timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._msgid = 0
+        self._lock = asyncio.Lock()
+
+    async def _send(self, op: bytes) -> bytes:
+        if self._writer is None:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+        self._msgid += 1
+        self._writer.write(ber(0x30, _ber_int(self._msgid) + op))
+        await self._writer.drain()
+        return await self._read_message()
+
+    async def _read_message(self) -> bytes:
+        head = await self._reader.readexactly(2)
+        ln = head[1]
+        if ln & 0x80:
+            more = await self._reader.readexactly(ln & 0x7F)
+            ln = int.from_bytes(more, "big")
+            head += more
+        return head + await self._reader.readexactly(ln)
+
+    def _drop(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._reader = self._writer = None
+
+    async def close(self) -> None:
+        async with self._lock:
+            self._drop()
+
+    async def bind(self, dn: str, password: bytes) -> int:
+        """Simple bind; returns the LDAP resultCode."""
+        async with self._lock:
+            try:
+                return await asyncio.wait_for(
+                    self._bind(dn, password), self.timeout)
+            except Exception:
+                self._drop()
+                raise
+
+    async def _bind(self, dn: str, password: bytes) -> int:
+        op = ber(0x60, _ber_int(3) + _ber_str(dn)
+                 + ber(0x80, password))          # context-0: simple auth
+        msg = await self._send(op)
+        _, payload, _ = ber_parse(msg)
+        children = _parse_children(payload)
+        for tag, body in children:
+            if tag == 0x61:                      # BindResponse
+                rtag, rbody = _parse_children(body)[0]
+                if rtag != 0x0A:
+                    raise LdapError("malformed BindResponse")
+                return int.from_bytes(rbody, "big")
+        raise LdapError("no BindResponse in reply")
+
+    async def search_one(self, base_dn: str, attr: str, value: str,
+                         want_attrs: Tuple[str, ...] = ()) -> Optional[
+                             Tuple[str, Dict[str, str]]]:
+        """Equality search, first entry only -> (dn, attrs) or None."""
+        async with self._lock:
+            try:
+                return await asyncio.wait_for(
+                    self._search_one(base_dn, attr, value, want_attrs),
+                    self.timeout)
+            except Exception:
+                self._drop()
+                raise
+
+    async def search_bind(self, service_dn: Optional[str],
+                          service_password: bytes, base_dn: str,
+                          attr: str, value: str, user_password: bytes,
+                          want_attrs: Tuple[str, ...] = ()) -> Tuple[
+                              Optional[int], Optional[Dict[str, str]]]:
+        """service-bind -> search -> user-bind as ONE locked sequence
+        (concurrent resolves must not interleave: the connection's bind
+        state is per-connection, and a search issued while bound as
+        another client's user DN could be denied).
+
+        Returns (bind_result_code, entry_attrs); (None, None) when the
+        search found no entry, raises on service-bind failure.
+        """
+        async with self._lock:
+            try:
+                return await asyncio.wait_for(
+                    self._search_bind(service_dn, service_password,
+                                      base_dn, attr, value,
+                                      user_password, want_attrs),
+                    self.timeout)
+            except Exception:
+                self._drop()
+                raise
+
+    async def _search_bind(self, service_dn, service_password, base_dn,
+                           attr, value, user_password, want_attrs):
+        # the connection's bind state persists from the previous resolve
+        # (it ends bound as that client's user DN) — rebind as the
+        # service account, or anonymously, before every search
+        if service_dn is not None:
+            code = await self._bind(service_dn, service_password)
+            if code != RES_SUCCESS:
+                raise LdapError(f"service bind failed (code {code})")
+        else:
+            code = await self._bind("", b"")
+            if code != RES_SUCCESS:
+                raise LdapError(f"anonymous bind refused (code {code})")
+        hit = await self._search_one(base_dn, attr, value, want_attrs)
+        if hit is None:
+            return None, None
+        dn, attrs = hit
+        return await self._bind(dn, user_password), attrs
+
+    async def _search_one(self, base_dn, attr, value, want_attrs):
+        filt = ber(0xA3, _ber_str(attr) + _ber_str(value))  # equalityMatch
+        attrs = ber(0x30, b"".join(_ber_str(a) for a in want_attrs))
+        op = ber(0x63, _ber_str(base_dn)
+                 + ber(0x0A, b"\x02")            # scope: wholeSubtree
+                 + ber(0x0A, b"\x03")            # derefAlways
+                 + _ber_int(1)                   # sizeLimit
+                 + _ber_int(0)                   # timeLimit
+                 + ber(0x01, b"\x00")            # typesOnly: false
+                 + filt + attrs)
+        entry: Optional[Tuple[str, Dict[str, str]]] = None
+        msg = await self._send(op)
+        while True:
+            _, payload, _ = ber_parse(msg)
+            children = _parse_children(payload)
+            done = False
+            for tag, body in children:
+                if tag == 0x64 and entry is None:    # SearchResultEntry
+                    parts = _parse_children(body)
+                    dn = parts[0][1].decode()
+                    got: Dict[str, str] = {}
+                    if len(parts) > 1:
+                        for _, attr_seq in _parse_children(parts[1][1]):
+                            aparts = _parse_children(attr_seq)
+                            name = aparts[0][1].decode()
+                            vals = _parse_children(aparts[1][1])
+                            if vals:
+                                got[name] = vals[0][1].decode()
+                    entry = (dn, got)
+                elif tag == 0x65:                    # SearchResultDone
+                    done = True
+            if done:
+                return entry
+            msg = await self._read_message()
+
+    def bind_blocking(self, dn: str, password: bytes) -> int:
+        client = LdapClient(f"{self.host}:{self.port}", self.timeout)
+
+        async def run():
+            try:
+                return await client.bind(dn, password)
+            finally:
+                await client.close()
+
+        return asyncio.run(run())
+
+
+class LdapAuthenticator:
+    """Bind (or search-then-bind) authn backend."""
+
+    def __init__(self, server: str = "127.0.0.1:389", *,
+                 method: str = "bind",
+                 bind_dn_template: str =
+                 "uid=${username},ou=users,dc=example,dc=com",
+                 base_dn: str = "dc=example,dc=com",
+                 search_attr: str = "uid",
+                 service_dn: Optional[str] = None,
+                 service_password: bytes = b"",
+                 timeout: float = 5.0) -> None:
+        if method not in ("bind", "search_bind"):
+            raise ValueError(f"unknown ldap method {method!r}")
+        self.server = server
+        self.method = method
+        self.bind_dn_template = bind_dn_template
+        self.base_dn = base_dn
+        self.search_attr = search_attr
+        self.service_dn = service_dn
+        self.service_password = service_password
+        self.timeout = timeout
+        self.client = LdapClient(server, timeout)
+        self._parked = ParkedVerdicts()
+
+    def _dn(self, creds: Credentials) -> str:
+        return (self.bind_dn_template
+                .replace("${username}", creds.username or "")
+                .replace("${clientid}", creds.clientid or ""))
+
+    async def _resolve(self, creds: Credentials) -> AuthResult:
+        if not creds.username or creds.password is None:
+            return IGNORE
+        # LDAP treats an empty password as an anonymous bind, which
+        # "succeeds" — never allow that to authenticate a user.
+        if creds.password == b"":
+            return AuthResult("deny")
+        if self.method == "bind":
+            code = await self.client.bind(self._dn(creds), creds.password)
+            if code == RES_SUCCESS:
+                return AuthResult("ok")
+            if code == RES_INVALID_CREDENTIALS:
+                return AuthResult("deny")
+            return IGNORE
+        # search_bind — one locked sequence on the connection
+        try:
+            code, attrs = await self.client.search_bind(
+                self.service_dn, self.service_password, self.base_dn,
+                self.search_attr, creds.username, creds.password,
+                ("isSuperuser",))
+        except LdapError as e:
+            log.warning("ldap search_bind: %s", e)
+            return IGNORE
+        if code is None:
+            return IGNORE                  # unknown user — next in chain
+        if code == RES_SUCCESS:
+            return AuthResult(
+                "ok",
+                is_superuser=str(attrs.get("isSuperuser", "")
+                                 ).lower() in ("true", "1"))
+        if code == RES_INVALID_CREDENTIALS:
+            return AuthResult("deny")
+        return IGNORE
+
+    async def authenticate_async(self, creds: Credentials) -> AuthResult:
+        try:
+            res = await self._resolve(creds)
+        except Exception as e:
+            log.warning("ldap authn unreachable: %s", e)
+            res = IGNORE
+        return self._parked.park(creds, res)
+
+    def authenticate(self, creds: Credentials) -> AuthResult:
+        parked = self._parked.take(creds)
+        if parked is not None:
+            return parked
+        if _in_event_loop():
+            log.warning("ldap authn: no pre-resolved verdict; ignoring")
+            return IGNORE
+        # mirror _resolve exactly: missing username/password -> ignore,
+        # empty password -> deny (anonymous-bind loophole)
+        if not creds.username or creds.password is None:
+            return IGNORE
+        if creds.password == b"":
+            return AuthResult("deny")
+        if self.method != "bind":
+            log.warning("ldap search_bind needs the async path; ignoring")
+            return IGNORE
+        try:
+            code = self.client.bind_blocking(self._dn(creds),
+                                             creds.password)
+            if code == RES_SUCCESS:
+                return AuthResult("ok")
+            if code == RES_INVALID_CREDENTIALS:
+                return AuthResult("deny")
+            return IGNORE
+        except Exception as e:
+            log.warning("ldap authn unreachable: %s", e)
+            return IGNORE

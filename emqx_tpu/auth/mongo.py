@@ -1,0 +1,360 @@
+"""MongoDB authn/authz backends over a minimal OP_MSG client.
+
+Behavioral reference: ``apps/emqx_authn/.../mongodb`` and
+``apps/emqx_authz/.../mongodb`` [U] (SURVEY.md §2.3):
+
+* authn — ``find`` one document in a collection (default ``mqtt_user``)
+  by a templated filter (``{"username": "${username}"}``); fields
+  ``password_hash`` / ``salt`` / ``is_superuser`` verified with the
+  built-in hash schemes;
+* authz — ``find`` rule documents (default ``mqtt_acl``): each carries
+  ``permission`` (allow|deny), ``action`` (publish|subscribe|all) and
+  ``topics`` (string or list, ``%c``/``%u`` placeholders + ``eq ``
+  prefix) — the reference's acl document layout.
+
+The wire client is dependency-free and speaks exactly what these
+backends need: OP_MSG (kind-0 body section) ``find`` commands against a
+hand-rolled BSON subset (double, string, document, array, bool, int32,
+int64, null).  No SCRAM handshake is attempted — deployments that need
+server auth front Mongo with localhost/VPC trust, matching the minimal
+posture of the other offline backends.  Same async-first discipline as
+``auth/external.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from ._backend import ParkedVerdicts, TtlCache, acl_filter_matches
+from .authn import AuthResult, Credentials, IGNORE, _verify_password
+from .authz import ALLOW, DENY, NOMATCH
+from .external import _in_event_loop, _render
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "bson_encode", "bson_decode", "MongoClient", "MongoError",
+    "MongoAuthenticator", "MongoAuthzSource",
+]
+
+OP_MSG = 2013
+
+
+class MongoError(Exception):
+    pass
+
+
+class Int64(int):
+    """Force int64 BSON encoding (mongod requires it for cursor ids)."""
+
+
+# -- BSON subset -------------------------------------------------------------
+
+def _enc_elem(name: str, v: Any) -> bytes:
+    key = name.encode() + b"\x00"
+    if isinstance(v, bool):          # before int — bool is an int subclass
+        return b"\x08" + key + (b"\x01" if v else b"\x00")
+    if isinstance(v, Int64):
+        return b"\x12" + key + struct.pack("<q", v)
+    if isinstance(v, float):
+        return b"\x01" + key + struct.pack("<d", v)
+    if isinstance(v, str):
+        b = v.encode()
+        return b"\x02" + key + struct.pack("<i", len(b) + 1) + b + b"\x00"
+    if isinstance(v, dict):
+        return b"\x03" + key + bson_encode(v)
+    if isinstance(v, (list, tuple)):
+        doc = {str(i): x for i, x in enumerate(v)}
+        return b"\x04" + key + bson_encode(doc)
+    if v is None:
+        return b"\x0a" + key
+    if isinstance(v, int):
+        if -(2 ** 31) <= v < 2 ** 31:
+            return b"\x10" + key + struct.pack("<i", v)
+        return b"\x12" + key + struct.pack("<q", v)
+    raise MongoError(f"unsupported BSON type {type(v)!r}")
+
+
+def bson_encode(doc: Dict[str, Any]) -> bytes:
+    body = b"".join(_enc_elem(k, v) for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\x00"
+
+
+def bson_decode(data: bytes) -> Dict[str, Any]:
+    doc, off = _dec_doc(data, 0)
+    return doc
+
+
+def _dec_doc(data: bytes, off: int) -> Tuple[Dict[str, Any], int]:
+    (ln,) = struct.unpack_from("<i", data, off)
+    end = off + ln - 1                # position of the trailing NUL
+    off += 4
+    out: Dict[str, Any] = {}
+    while off < end:
+        t = data[off]
+        off += 1
+        nul = data.index(b"\x00", off)
+        name = data[off:nul].decode()
+        off = nul + 1
+        if t == 0x01:
+            (out[name],) = struct.unpack_from("<d", data, off)
+            off += 8
+        elif t == 0x02:
+            (sl,) = struct.unpack_from("<i", data, off)
+            out[name] = data[off + 4:off + 4 + sl - 1].decode()
+            off += 4 + sl
+        elif t in (0x03, 0x04):
+            sub, off = _dec_doc(data, off)
+            out[name] = (list(sub.values()) if t == 0x04 else sub)
+        elif t == 0x08:
+            out[name] = data[off] != 0
+            off += 1
+        elif t == 0x0A:
+            out[name] = None
+        elif t == 0x10:
+            (out[name],) = struct.unpack_from("<i", data, off)
+            off += 4
+        elif t == 0x12:
+            (out[name],) = struct.unpack_from("<q", data, off)
+            off += 8
+        else:
+            raise MongoError(f"unsupported BSON element type 0x{t:02x}")
+    return out, end + 1
+
+
+class MongoClient:
+    """One async connection speaking OP_MSG ``find``; lazy reconnect."""
+
+    def __init__(self, server: str = "127.0.0.1:27017", *,
+                 database: str = "mqtt", timeout: float = 5.0) -> None:
+        host, _, port = server.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port or 27017)
+        self.database = database
+        self.timeout = timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._req = 0
+        self._lock = asyncio.Lock()
+
+    async def command(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        async with self._lock:
+            try:
+                return await asyncio.wait_for(
+                    self._command(doc), self.timeout)
+            except Exception:
+                self._drop()
+                raise
+
+    async def _command(self, doc):
+        if self._writer is None:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+        self._req += 1
+        doc = {**doc, "$db": self.database}
+        body = struct.pack("<i", 0) + b"\x00" + bson_encode(doc)
+        head = struct.pack("<iiii", 16 + len(body), self._req, 0, OP_MSG)
+        self._writer.write(head + body)
+        await self._writer.drain()
+        raw = await self._reader.readexactly(16)
+        ln, _, _, opcode = struct.unpack("<iiii", raw)
+        payload = await self._reader.readexactly(ln - 16)
+        if opcode != OP_MSG:
+            raise MongoError(f"unexpected opcode {opcode}")
+        if payload[4] != 0:
+            raise MongoError("only kind-0 reply sections supported")
+        reply = bson_decode(payload[5:])
+        if reply.get("ok") != 1 and reply.get("ok") != 1.0:
+            raise MongoError(str(reply.get("errmsg", "command failed")))
+        return reply
+
+    async def find(self, collection: str, filter_: Dict[str, Any],
+                   limit: int = 0) -> List[Dict[str, Any]]:
+        # _id is projected away: a real mongod's auto ObjectId is outside
+        # the BSON subset this client decodes, and no consumer needs it.
+        doc: Dict[str, Any] = {"find": collection, "filter": filter_,
+                               "projection": {"_id": 0}}
+        if limit:
+            doc["limit"] = limit
+        reply = await self.command(doc)
+        cursor = reply.get("cursor", {})
+        docs = list(cursor.get("firstBatch", []))
+        # follow the cursor — ACL rule sets can exceed the server's
+        # default first batch (101 docs)
+        while cursor.get("id"):
+            reply = await self.command(
+                {"getMore": Int64(cursor["id"]),
+                 "collection": collection})
+            cursor = reply.get("cursor", {})
+            docs.extend(cursor.get("nextBatch", []))
+        return [d for d in docs if isinstance(d, dict)]
+
+    def _drop(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._reader = self._writer = None
+
+    async def close(self) -> None:
+        async with self._lock:
+            self._drop()
+
+    def find_blocking(self, collection, filter_, limit=0):
+        client = MongoClient(f"{self.host}:{self.port}",
+                             database=self.database, timeout=self.timeout)
+
+        async def run():
+            try:
+                return await client.find(collection, filter_, limit)
+            finally:
+                await client.close()
+
+        return asyncio.run(run())
+
+
+def _ctx(creds_like: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: ("" if v is None else v) for k, v in creds_like.items()}
+
+
+class MongoAuthenticator:
+    """``find`` one user document; verify with built-in hash schemes."""
+
+    def __init__(self, server: str = "127.0.0.1:27017", *,
+                 database: str = "mqtt", collection: str = "mqtt_user",
+                 filter_template: Optional[Dict[str, Any]] = None,
+                 algo: str = "sha256", salt_position: str = "prefix",
+                 iterations: int = 4096, timeout: float = 5.0) -> None:
+        self.client = MongoClient(server, database=database,
+                                  timeout=timeout)
+        self.collection = collection
+        self.filter_template = filter_template or {
+            "username": "${username}"}
+        self.algo = algo
+        self.salt_position = salt_position
+        self.iterations = iterations
+        self._parked = ParkedVerdicts()
+
+    def _filter(self, creds: Credentials) -> Dict[str, Any]:
+        return _render(self.filter_template,
+                       _ctx({"username": creds.username,
+                             "clientid": creds.clientid}))
+
+    def _evaluate(self, docs: List[Dict[str, Any]],
+                  creds: Credentials) -> AuthResult:
+        if not docs:
+            return IGNORE
+        if creds.password is None:
+            return AuthResult("deny")
+        doc = docs[0]
+        stored = doc.get("password_hash")
+        if not isinstance(stored, str):
+            return IGNORE
+        salt = str(doc.get("salt") or "").encode()
+        is_super = bool(doc.get("is_superuser"))
+        if _verify_password(stored, creds.password, self.algo, salt,
+                            self.salt_position, self.iterations):
+            return AuthResult("ok", is_superuser=is_super)
+        return AuthResult("deny")
+
+    async def authenticate_async(self, creds: Credentials) -> AuthResult:
+        try:
+            docs = await self.client.find(
+                self.collection, self._filter(creds), limit=1)
+            res = self._evaluate(docs, creds)
+        except Exception as e:
+            log.warning("mongo authn unreachable: %s", e)
+            res = IGNORE
+        return self._parked.park(creds, res)
+
+    def authenticate(self, creds: Credentials) -> AuthResult:
+        parked = self._parked.take(creds)
+        if parked is not None:
+            return parked
+        if _in_event_loop():
+            log.warning("mongo authn: no pre-resolved verdict; ignoring")
+            return IGNORE
+        try:
+            docs = self.client.find_blocking(
+                self.collection, self._filter(creds), limit=1)
+            return self._evaluate(docs, creds)
+        except Exception as e:
+            log.warning("mongo authn unreachable: %s", e)
+            return IGNORE
+
+
+class MongoAuthzSource:
+    """Rule documents: permission / action / topics (str or list)."""
+
+    def __init__(self, server: str = "127.0.0.1:27017", *,
+                 database: str = "mqtt", collection: str = "mqtt_acl",
+                 filter_template: Optional[Dict[str, Any]] = None,
+                 timeout: float = 5.0, cache_ttl: float = 10.0) -> None:
+        self.client = MongoClient(server, database=database,
+                                  timeout=timeout)
+        self.collection = collection
+        self.filter_template = filter_template or {
+            "username": "${username}"}
+        self._cache = TtlCache(cache_ttl)
+
+    @staticmethod
+    def _match(docs: List[Dict[str, Any]], action: str, topic: str,
+               clientid: str, username: Optional[str]) -> str:
+        for doc in docs:
+            perm = str(doc.get("permission") or "").lower()
+            act = str(doc.get("action") or "").lower()
+            if perm not in (ALLOW, DENY):
+                continue
+            if act not in ("publish", "subscribe", "all"):
+                continue
+            if act != "all" and act != action:
+                continue
+            topics = doc.get("topics", doc.get("topic", []))
+            if isinstance(topics, str):
+                topics = [topics]
+            if not isinstance(topics, (list, tuple)):
+                continue               # null / malformed -> never matches
+            for flt in topics:
+                if acl_filter_matches(flt, topic, clientid, username):
+                    return perm
+        return NOMATCH
+
+    async def prefetch_async(self, clientid, username, peerhost, action,
+                             topic) -> str:
+        key = (clientid, username)
+        docs = self._cache.fresh(key)
+        if docs is None:
+            try:
+                docs = await self.client.find(
+                    self.collection,
+                    _render(self.filter_template,
+                            _ctx({"username": username,
+                                  "clientid": clientid})))
+            except Exception as e:
+                log.warning("mongo authz unreachable: %s", e)
+                docs = []
+            self._cache.put(key, docs)
+        return self._match(docs, action, topic, clientid, username)
+
+    def authorize(self, clientid, username, peerhost, action, topic,
+                  **kw) -> str:
+        key = (clientid, username)
+        docs = self._cache.fresh(key)
+        if docs is not None:
+            return self._match(docs, action, topic, clientid, username)
+        if _in_event_loop():
+            log.warning("mongo authz: un-prefetched key; nomatch")
+            return NOMATCH
+        try:
+            docs = self.client.find_blocking(
+                self.collection,
+                _render(self.filter_template,
+                        _ctx({"username": username, "clientid": clientid})))
+            self._cache.put(key, docs)
+            return self._match(docs, action, topic, clientid, username)
+        except Exception as e:
+            log.warning("mongo authz unreachable: %s", e)
+            return NOMATCH

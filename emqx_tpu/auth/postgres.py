@@ -1,0 +1,428 @@
+"""PostgreSQL authn/authz backends over a minimal v3-protocol client.
+
+Behavioral reference: ``apps/emqx_authn/.../postgresql`` and
+``apps/emqx_authz/.../postgresql`` [U] (SURVEY.md §2.3):
+
+* authn — a templated ``SELECT password_hash, salt, is_superuser FROM
+  mqtt_user WHERE username = ${username}`` whose single row is verified
+  with the built-in password hash schemes;
+* authz — ``SELECT permission, action, topic FROM mqtt_acl WHERE
+  username = ${username}``: ordered allow/deny rules with ``%c``/``%u``
+  placeholders and the ``eq `` literal-match prefix (same rule algebra
+  as the file/built-in sources).
+
+``${var}`` placeholders are compiled to ``$1..$n`` **bind parameters**
+and shipped through the extended-query protocol (Parse/Bind/Execute) —
+never string-spliced, so templated credentials cannot inject SQL.  The
+wire client is dependency-free (the environment pins the package set)
+and speaks exactly what these backends need: startup, cleartext/MD5/
+SCRAM-SHA-256 authentication, extended query with text-format results,
+and lazy reconnect-on-error.  Same async-first discipline as the other
+external backends (``auth/external.py``): the node's packet intercept
+resolves verdicts over the event loop; sync fallbacks never block a
+running loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from ._backend import ParkedVerdicts, TtlCache, acl_filter_matches
+from .authn import AuthResult, Credentials, IGNORE, _verify_password
+from .authz import ALLOW, DENY, NOMATCH
+from .external import _in_event_loop
+from .scram import scram_client_final, scram_client_first
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "PgClient", "PgError", "PostgresAuthenticator", "PostgresAuthzSource",
+    "compile_template",
+]
+
+PROTOCOL_V3 = 196608  # (3 << 16)
+
+
+class PgError(Exception):
+    pass
+
+
+def compile_template(sql: str) -> Tuple[str, List[str]]:
+    """``... WHERE u = ${username}`` -> (``... WHERE u = $1``, ["username"]).
+
+    Repeated placeholders reuse the same parameter number, mirroring the
+    reference's placeholder→prepared-statement conversion.
+    """
+    out: List[str] = []
+    vars_: List[str] = []
+    i = 0
+    while i < len(sql):
+        j = sql.find("${", i)
+        if j < 0:
+            out.append(sql[i:])
+            break
+        k = sql.find("}", j)
+        if k < 0:
+            out.append(sql[i:])
+            break
+        out.append(sql[i:j])
+        name = sql[j + 2:k]
+        if name not in vars_:
+            vars_.append(name)
+        out.append(f"${vars_.index(name) + 1}")
+        i = k + 1
+    return "".join(out), vars_
+
+
+def _msg(kind: bytes, payload: bytes = b"") -> bytes:
+    return kind + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class PgClient:
+    """One async PostgreSQL connection; reconnects lazily on error."""
+
+    def __init__(self, server: str = "127.0.0.1:5432", *,
+                 user: str = "postgres", password: Optional[str] = None,
+                 database: str = "postgres", timeout: float = 5.0) -> None:
+        host, _, port = server.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port or 5432)
+        self.user = user
+        self.password = password
+        self.database = database
+        self.timeout = timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    # -- wire ---------------------------------------------------------------
+
+    async def _read_msg(self) -> Tuple[bytes, bytes]:
+        head = await self._reader.readexactly(5)
+        kind, ln = head[:1], struct.unpack("!I", head[1:])[0]
+        payload = await self._reader.readexactly(ln - 4)
+        return kind, payload
+
+    @staticmethod
+    def _error_text(payload: bytes) -> str:
+        fields = {}
+        for part in payload.split(b"\x00"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode("utf-8", "replace")
+        return fields.get("M", "unknown error")
+
+    async def _auth(self) -> None:
+        scram_ctx: Optional[Dict] = None
+        while True:
+            kind, payload = await self._read_msg()
+            if kind == b"E":
+                raise PgError(self._error_text(payload))
+            if kind != b"R":
+                raise PgError(f"unexpected message {kind!r} during auth")
+            code = struct.unpack("!I", payload[:4])[0]
+            if code == 0:                       # AuthenticationOk
+                return
+            if code == 3:                       # cleartext
+                if self.password is None:
+                    raise PgError("server wants a password; none configured")
+                self._writer.write(_msg(b"p", _cstr(self.password)))
+            elif code == 5:                     # md5
+                if self.password is None:
+                    raise PgError("server wants a password; none configured")
+                salt = payload[4:8]
+                inner = hashlib.md5(
+                    self.password.encode() + self.user.encode()).hexdigest()
+                outer = hashlib.md5(inner.encode() + salt).hexdigest()
+                self._writer.write(_msg(b"p", _cstr("md5" + outer)))
+            elif code == 10:                    # SASL mechanism list
+                mechs = [m.decode() for m in payload[4:].split(b"\x00") if m]
+                if "SCRAM-SHA-256" not in mechs:
+                    raise PgError(f"no common SASL mechanism in {mechs}")
+                first, scram_ctx = scram_client_first(self.user)
+                self._writer.write(_msg(
+                    b"p", _cstr("SCRAM-SHA-256")
+                    + struct.pack("!I", len(first)) + first))
+            elif code == 11:                    # SASL continue
+                if scram_ctx is None:
+                    raise PgError("SASL continue before initial response")
+                final, scram_ctx = scram_client_final(
+                    scram_ctx, (self.password or "").encode(), payload[4:])
+                self._writer.write(_msg(b"p", final))
+            elif code == 12:                    # SASL final
+                if scram_ctx is None or payload[4:] != \
+                        scram_ctx["expect_server_final"]:
+                    raise PgError("server signature mismatch")
+            else:
+                raise PgError(f"unsupported auth request {code}")
+            await self._writer.drain()
+
+    async def _connect(self) -> None:
+        self._reader, self._writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout)
+        params = (_cstr("user") + _cstr(self.user)
+                  + _cstr("database") + _cstr(self.database) + b"\x00")
+        self._writer.write(
+            struct.pack("!II", len(params) + 8, PROTOCOL_V3) + params)
+        await self._writer.drain()
+        await asyncio.wait_for(self._auth(), self.timeout)
+        # drain ParameterStatus/BackendKeyData up to ReadyForQuery
+        while True:
+            kind, payload = await asyncio.wait_for(
+                self._read_msg(), self.timeout)
+            if kind == b"Z":
+                return
+            if kind == b"E":
+                raise PgError(self._error_text(payload))
+
+    def _drop(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._reader = self._writer = None
+
+    async def close(self) -> None:
+        async with self._lock:
+            self._drop()
+
+    # -- extended query ------------------------------------------------------
+
+    async def query(self, sql: str,
+                    params: Tuple[Optional[str], ...] = ()) -> Tuple[
+                        List[str], List[List[Optional[str]]]]:
+        """Parse/Bind/Describe/Execute/Sync; text-format results only."""
+        async with self._lock:
+            try:
+                return await asyncio.wait_for(
+                    self._query(sql, params), self.timeout)
+            except Exception:
+                self._drop()
+                raise
+
+    async def _query(self, sql, params):
+        if self._writer is None:
+            await self._connect()
+        bind = [struct.pack("!H", 0), struct.pack("!H", len(params))]
+        for p in params:
+            if p is None:
+                bind.append(struct.pack("!i", -1))
+            else:
+                b = p.encode()
+                bind.append(struct.pack("!I", len(b)) + b)
+        bind.append(struct.pack("!H", 0))
+        self._writer.write(
+            _msg(b"P", _cstr("") + _cstr(sql) + struct.pack("!H", 0))
+            + _msg(b"B", _cstr("") + _cstr("") + b"".join(bind))
+            + _msg(b"D", b"P" + _cstr(""))
+            + _msg(b"E", _cstr("") + struct.pack("!I", 0))
+            + _msg(b"S"))
+        await self._writer.drain()
+        cols: List[str] = []
+        rows: List[List[Optional[str]]] = []
+        err: Optional[str] = None
+        while True:
+            kind, payload = await self._read_msg()
+            if kind == b"T":
+                ncols = struct.unpack("!H", payload[:2])[0]
+                off = 2
+                cols = []
+                for _ in range(ncols):
+                    end = payload.index(b"\x00", off)
+                    cols.append(payload[off:end].decode())
+                    off = end + 1 + 18  # fixed per-column trailer
+            elif kind == b"D":
+                ncols = struct.unpack("!H", payload[:2])[0]
+                off = 2
+                row: List[Optional[str]] = []
+                for _ in range(ncols):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(row)
+            elif kind == b"E":
+                err = self._error_text(payload)
+            elif kind == b"Z":
+                if err is not None:
+                    raise PgError(err)
+                return cols, rows
+            # '1','2','C','S','n','N' — advance
+
+    def query_blocking(self, sql, params=()):
+        """Sync fallback for non-loop callers: fresh one-shot connection."""
+        client = PgClient(f"{self.host}:{self.port}", user=self.user,
+                          password=self.password, database=self.database,
+                          timeout=self.timeout)
+
+        async def run():
+            try:
+                return await client.query(sql, params)
+            finally:
+                await client.close()
+
+        return asyncio.run(run())
+
+
+def _ctx_of(clientid: str, username: Optional[str],
+            peerhost: Optional[str] = None) -> Dict[str, Any]:
+    return {"username": username or "", "clientid": clientid or "",
+            "peerhost": peerhost or ""}
+
+
+class PostgresAuthenticator:
+    """Single-row SELECT authn backend with bind-parameter templating."""
+
+    DEFAULT_QUERY = ("SELECT password_hash, salt, is_superuser "
+                     "FROM mqtt_user WHERE username = ${username} LIMIT 1")
+
+    def __init__(self, server: str = "127.0.0.1:5432", *,
+                 user: str = "postgres", password: Optional[str] = None,
+                 database: str = "postgres",
+                 query: Optional[str] = None,
+                 algo: str = "sha256", salt_position: str = "prefix",
+                 iterations: int = 4096, timeout: float = 5.0) -> None:
+        self.client = PgClient(server, user=user, password=password,
+                               database=database, timeout=timeout)
+        self.sql, self.vars = compile_template(query or self.DEFAULT_QUERY)
+        self.algo = algo
+        self.salt_position = salt_position
+        self.iterations = iterations
+        self._parked = ParkedVerdicts()
+
+    def _params(self, creds: Credentials) -> Tuple[Optional[str], ...]:
+        ctx = _ctx_of(creds.clientid, creds.username)
+        return tuple(str(ctx.get(v, "")) for v in self.vars)
+
+    def _evaluate(self, cols: List[str],
+                  rows: List[List[Optional[str]]],
+                  creds: Credentials) -> AuthResult:
+        if not rows:
+            return IGNORE           # no such user — next in chain
+        if creds.password is None:
+            return AuthResult("deny")
+        row = dict(zip(cols, rows[0]))
+        stored = row.get("password_hash")
+        if stored is None:
+            return IGNORE
+        salt = (row.get("salt") or "").encode()
+        is_super = str(row.get("is_superuser", "")).lower() in (
+            "t", "true", "1")
+        if _verify_password(stored, creds.password, self.algo, salt,
+                            self.salt_position, self.iterations):
+            return AuthResult("ok", is_superuser=is_super)
+        return AuthResult("deny")
+
+    async def authenticate_async(self, creds: Credentials) -> AuthResult:
+        try:
+            cols, rows = await self.client.query(
+                self.sql, self._params(creds))
+            res = self._evaluate(cols, rows, creds)
+        except Exception as e:
+            log.warning("postgres authn unreachable: %s", e)
+            res = IGNORE
+        return self._parked.park(creds, res)
+
+    def authenticate(self, creds: Credentials) -> AuthResult:
+        parked = self._parked.take(creds)
+        if parked is not None:
+            return parked
+        if _in_event_loop():
+            log.warning("postgres authn: no pre-resolved verdict; ignoring")
+            return IGNORE
+        try:
+            cols, rows = self.client.query_blocking(
+                self.sql, self._params(creds))
+            return self._evaluate(cols, rows, creds)
+        except Exception as e:
+            log.warning("postgres authn unreachable: %s", e)
+            return IGNORE
+
+
+class PostgresAuthzSource:
+    """Ordered permission/action/topic rule rows per client."""
+
+    DEFAULT_QUERY = ("SELECT permission, action, topic "
+                     "FROM mqtt_acl WHERE username = ${username}")
+
+    def __init__(self, server: str = "127.0.0.1:5432", *,
+                 user: str = "postgres", password: Optional[str] = None,
+                 database: str = "postgres",
+                 query: Optional[str] = None,
+                 timeout: float = 5.0, cache_ttl: float = 10.0) -> None:
+        self.client = PgClient(server, user=user, password=password,
+                               database=database, timeout=timeout)
+        self.sql, self.vars = compile_template(query or self.DEFAULT_QUERY)
+        self._cache = TtlCache(cache_ttl)
+
+    @staticmethod
+    def _match(rules: List[Tuple[str, str, str]], action: str, topic: str,
+               clientid: str, username: Optional[str]) -> str:
+        for perm, act, flt in rules:
+            perm = (perm or "").lower()
+            act = (act or "").lower()
+            if perm not in (ALLOW, DENY):
+                continue
+            if act not in ("publish", "subscribe", "all"):
+                continue
+            if act != "all" and act != action:
+                continue
+            if acl_filter_matches(flt, topic, clientid, username):
+                return perm
+        return NOMATCH
+
+    def _rules_of(self, cols, rows) -> List[Tuple[str, str, str]]:
+        out = []
+        for r in rows:
+            row = dict(zip(cols, r))
+            out.append((row.get("permission") or "",
+                        row.get("action") or "",
+                        row.get("topic") or ""))
+        return out
+
+    async def prefetch_async(self, clientid, username, peerhost, action,
+                             topic) -> str:
+        key = (clientid, username)
+        rules = self._cache.fresh(key)
+        if rules is None:
+            ctx = _ctx_of(clientid, username, peerhost)
+            try:
+                cols, rows = await self.client.query(
+                    self.sql,
+                    tuple(str(ctx.get(v, "")) for v in self.vars))
+                rules = self._rules_of(cols, rows)
+            except Exception as e:
+                log.warning("postgres authz unreachable: %s", e)
+                rules = []
+            self._cache.put(key, rules)
+        return self._match(rules, action, topic, clientid, username)
+
+    def authorize(self, clientid, username, peerhost, action, topic,
+                  **kw) -> str:
+        key = (clientid, username)
+        rules = self._cache.fresh(key)
+        if rules is not None:
+            return self._match(rules, action, topic, clientid, username)
+        if _in_event_loop():
+            log.warning("postgres authz: un-prefetched key; nomatch")
+            return NOMATCH
+        ctx = _ctx_of(clientid, username, peerhost)
+        try:
+            cols, rows = self.client.query_blocking(
+                self.sql, tuple(str(ctx.get(v, "")) for v in self.vars))
+            rules = self._rules_of(cols, rows)
+            self._cache.put(key, rules)
+            return self._match(rules, action, topic, clientid, username)
+        except Exception as e:
+            log.warning("postgres authz unreachable: %s", e)
+            return NOMATCH

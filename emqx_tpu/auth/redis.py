@@ -23,10 +23,9 @@ from __future__ import annotations
 import asyncio
 import logging
 import socket
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .. import topic as T
+from ._backend import ParkedVerdicts, TtlCache, acl_filter_matches
 from .authn import AuthResult, Credentials, IGNORE, _verify_password
 from .authz import NOMATCH
 from .external import _in_event_loop
@@ -187,11 +186,7 @@ class RedisAuthenticator:
         self.algo = algo
         self.salt_position = salt_position
         self.iterations = iterations
-        self._parked: Dict[Tuple, AuthResult] = {}
-
-    @staticmethod
-    def _key(creds: Credentials) -> Tuple:
-        return (creds.clientid, creds.username, creds.password)
+        self._parked = ParkedVerdicts()
 
     def _ctx(self, creds: Credentials) -> Dict[str, Any]:
         return {"username": creds.username, "clientid": creds.clientid}
@@ -220,16 +215,10 @@ class RedisAuthenticator:
         except Exception as e:
             log.warning("redis authn unreachable: %s", e)
             res = IGNORE
-        while len(self._parked) >= 512:
-            self._parked.pop(next(iter(self._parked)))
-        self._parked[self._key(creds)] = res
-        return res
+        return self._parked.park(creds, res)
 
     def authenticate(self, creds: Credentials) -> AuthResult:
-        parked = self._parked.pop(self._key(creds), None)
-        if parked is None and creds.clientid:
-            parked = self._parked.pop(
-                ("", creds.username, creds.password), None)
+        parked = self._parked.take(creds)
         if parked is not None:
             return parked
         if _in_event_loop():
@@ -254,23 +243,18 @@ class RedisAuthzSource:
                  timeout: float = 5.0, cache_ttl: float = 10.0) -> None:
         self.client = RespClient(server, password, database, timeout)
         self.key_template = key_template
-        self.cache_ttl = cache_ttl
-        self._cache: Dict[Tuple, Tuple[Dict[str, str], float]] = {}
+        self._cache = TtlCache(cache_ttl)
 
     @staticmethod
     def _match(rules: Dict[str, str], action: str, topic: str,
                clientid: str, username: Optional[str]) -> str:
         for flt, allowed in rules.items():
-            flt = flt.replace("%c", clientid).replace("%u", username or "")
             if allowed not in ("publish", "subscribe", "all"):
                 continue
             if allowed != "all" and allowed != action:
                 continue
-            try:
-                if T.match(topic, flt):
-                    return "allow"
-            except ValueError:
-                continue
+            if acl_filter_matches(flt, topic, clientid, username):
+                return "allow"
         return NOMATCH
 
     @staticmethod
@@ -286,31 +270,26 @@ class RedisAuthzSource:
     async def prefetch_async(self, clientid, username, peerhost, action,
                              topic) -> str:
         key = (clientid, username)
-        now = time.time()
-        hit = self._cache.get(key)
-        if hit is None or now - hit[1] >= self.cache_ttl:
+        rules = self._cache.fresh(key)
+        if rules is None:
             try:
                 flat = await self.client.cmd(
                     "HGETALL",
                     _render_key(self.key_template,
                                 {"username": username, "clientid": clientid}))
-                self._cache[key] = (self._rules_of(flat), now)
+                rules = self._rules_of(flat)
             except Exception as e:
                 log.warning("redis authz unreachable: %s", e)
-                self._cache[key] = ({}, now)
-            if len(self._cache) > 4096:
-                cutoff = now - self.cache_ttl
-                self._cache = {k: v for k, v in self._cache.items()
-                               if v[1] >= cutoff}
-        return self._match(self._cache[key][0], action, topic,
-                           clientid, username)
+                rules = {}
+            self._cache.put(key, rules)
+        return self._match(rules, action, topic, clientid, username)
 
     def authorize(self, clientid, username, peerhost, action, topic,
                   **kw) -> str:
         key = (clientid, username)
-        hit = self._cache.get(key)
-        if hit is not None and time.time() - hit[1] < self.cache_ttl:
-            return self._match(hit[0], action, topic, clientid, username)
+        rules = self._cache.fresh(key)
+        if rules is not None:
+            return self._match(rules, action, topic, clientid, username)
         if _in_event_loop():
             log.warning("redis authz: un-prefetched key; nomatch")
             return NOMATCH
@@ -320,7 +299,7 @@ class RedisAuthzSource:
                 _render_key(self.key_template,
                             {"username": username, "clientid": clientid}))
             rules = self._rules_of(flat)
-            self._cache[key] = (rules, time.time())
+            self._cache.put(key, rules)
             return self._match(rules, action, topic, clientid, username)
         except Exception as e:
             log.warning("redis authz unreachable: %s", e)

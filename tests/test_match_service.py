@@ -20,7 +20,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def settle(pred, timeout=8.0, interval=0.02):
+async def settle(pred, timeout=30.0, interval=0.02):
     deadline = asyncio.get_running_loop().time() + timeout
     while asyncio.get_running_loop().time() < deadline:
         if pred():
