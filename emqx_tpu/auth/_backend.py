@@ -13,38 +13,12 @@ import logging
 import time
 from typing import Any, Dict, Optional, Tuple
 
-from .. import topic as T
 from .authn import AuthResult, Credentials
-from .authz import _unsafe_placeholder
+from .authz import acl_filter_matches  # noqa: F401 — shared re-export
 
 log = logging.getLogger(__name__)
 
 __all__ = ["ParkedVerdicts", "TtlCache", "acl_filter_matches"]
-
-
-def acl_filter_matches(flt: Any, topic: str, clientid: str,
-                       username: Optional[str]) -> bool:
-    """One backend rule filter against a topic — the SAME algebra as
-    :meth:`authz.AclRule.topic_matches`: ``eq `` prefix for literal
-    match, ``%c``/``%u`` substitution with the wildcard-injection guard
-    (a clientid/username of ``+``/``#`` or containing ``/`` must never
-    widen the pattern).  Non-string filters never match."""
-    if not isinstance(flt, str):
-        return False
-    literal = flt.startswith("eq ")
-    if literal:
-        flt = flt[3:]
-    if "%c" in flt or "%u" in flt:
-        if ("%c" in flt and _unsafe_placeholder(clientid)) or (
-                "%u" in flt and _unsafe_placeholder(username)):
-            return False
-        flt = flt.replace("%c", clientid).replace("%u", username or "")
-    if literal:
-        return topic == flt
-    try:
-        return T.match(topic, flt)
-    except ValueError:
-        return False
 
 
 class ParkedVerdicts:

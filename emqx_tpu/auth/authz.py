@@ -36,6 +36,33 @@ def _unsafe_placeholder(value: Optional[str]) -> bool:
     return not value or any(c in value for c in "+#/")
 
 
+def acl_filter_matches(flt: Any, topic: str, clientid: str,
+                       username: Optional[str]) -> bool:
+    """One ACL rule filter against a topic — the single implementation
+    of the rule algebra shared by the file/built-in sources AND the
+    network backends (Redis/Postgres/Mongo via auth/_backend.py):
+    ``eq `` prefix for literal match, ``%c``/``%u`` substitution with
+    the wildcard-injection guard (a clientid/username of ``+``/``#`` or
+    containing ``/`` must never widen the pattern).  Non-string filters
+    never match."""
+    if not isinstance(flt, str):
+        return False
+    literal = flt.startswith("eq ")
+    if literal:
+        flt = flt[3:]
+    if "%c" in flt or "%u" in flt:
+        if ("%c" in flt and _unsafe_placeholder(clientid)) or (
+                "%u" in flt and _unsafe_placeholder(username)):
+            return False
+        flt = flt.replace("%c", clientid).replace("%u", username or "")
+    if literal:
+        return topic == flt
+    try:
+        return T.match(topic, flt)
+    except ValueError:
+        return False
+
+
 @dataclass
 class AclRule:
     """One ACL rule (the acl.conf tuple analog)."""
@@ -75,24 +102,10 @@ class AclRule:
     def topic_matches(
         self, topic: str, clientid: str, username: Optional[str]
     ) -> bool:
-        for pat in self.topics:
-            literal = pat.startswith("eq ")
-            if literal:
-                pat = pat[3:]
-            if "%c" in pat or "%u" in pat:
-                # wildcard-injection guard: a clientid/username of '+', '#'
-                # or containing '/' must never widen the pattern
-                if ("%c" in pat and _unsafe_placeholder(clientid)) or (
-                    "%u" in pat and _unsafe_placeholder(username)
-                ):
-                    continue
-                pat = pat.replace("%c", clientid).replace("%u", username or "")
-            if literal:
-                if topic == pat:
-                    return True
-            elif T.match(topic, pat):
-                return True
-        return False
+        return any(
+            acl_filter_matches(pat, topic, clientid, username)
+            for pat in self.topics
+        )
 
     def check(
         self, clientid: str, username: Optional[str], peerhost: Optional[str],

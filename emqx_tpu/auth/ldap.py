@@ -281,10 +281,25 @@ class LdapAuthenticator:
         self.client = LdapClient(server, timeout)
         self._parked = ParkedVerdicts()
 
+    @staticmethod
+    def _dn_escape(value: str) -> str:
+        """RFC 4514 attribute-value escaping — a username of
+        ``svc,ou=services`` must not restructure the bind DN."""
+        out = []
+        for i, c in enumerate(value):
+            if c in ',+"\\<>;=' or (c == "#" and i == 0) or (
+                    c == " " and i in (0, len(value) - 1)):
+                out.append("\\" + c)
+            elif c == "\x00":
+                out.append("\\00")
+            else:
+                out.append(c)
+        return "".join(out)
+
     def _dn(self, creds: Credentials) -> str:
         return (self.bind_dn_template
-                .replace("${username}", creds.username or "")
-                .replace("${clientid}", creds.clientid or ""))
+                .replace("${username}", self._dn_escape(creds.username or ""))
+                .replace("${clientid}", self._dn_escape(creds.clientid or "")))
 
     async def _resolve(self, creds: Credentials) -> AuthResult:
         if not creds.username or creds.password is None:

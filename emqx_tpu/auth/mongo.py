@@ -241,7 +241,8 @@ class MongoAuthenticator:
     def _filter(self, creds: Credentials) -> Dict[str, Any]:
         return _render(self.filter_template,
                        _ctx({"username": creds.username,
-                             "clientid": creds.clientid}))
+                             "clientid": creds.clientid,
+                             "peerhost": creds.peerhost}))
 
     def _evaluate(self, docs: List[Dict[str, Any]],
                   creds: Credentials) -> AuthResult:
@@ -332,7 +333,8 @@ class MongoAuthzSource:
                     self.collection,
                     _render(self.filter_template,
                             _ctx({"username": username,
-                                  "clientid": clientid})))
+                                  "clientid": clientid,
+                                  "peerhost": peerhost})))
             except Exception as e:
                 log.warning("mongo authz unreachable: %s", e)
                 docs = []
@@ -352,7 +354,8 @@ class MongoAuthzSource:
             docs = self.client.find_blocking(
                 self.collection,
                 _render(self.filter_template,
-                        _ctx({"username": username, "clientid": clientid})))
+                        _ctx({"username": username, "clientid": clientid,
+                              "peerhost": peerhost})))
             self._cache.put(key, docs)
             return self._match(docs, action, topic, clientid, username)
         except Exception as e:

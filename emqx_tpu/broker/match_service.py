@@ -519,9 +519,14 @@ class MatchService:
     def _readback_rows(res, n: int):
         import jax
 
-        matches, counts, sp = jax.device_get(
-            (res.matches, res.n_matches, res.spilled_rows())
+        # fetch the kernel's own outputs and OR the spill flags on host:
+        # res.spilled_rows() would build NEW lazy device ops here, i.e.
+        # an extra dispatch round trip per batch on the readback path
+        matches, counts, aover, mover = jax.device_get(
+            (res.matches, res.n_matches, res.active_overflow,
+             res.match_overflow)
         )
+        sp = (aover > 0) | (mover > 0)
         rows = [matches[r, : counts[r]].tolist() for r in range(n)]
         return rows, np.flatnonzero(sp[:n]).tolist()
 

@@ -322,9 +322,13 @@ class TpuMatchSidecar:
         import jax
 
         res = eng.dev.match(*enc)
-        matches, counts, sp = jax.device_get(
-            (res.matches, res.n_matches, res.spilled_rows())
+        # OR the spill flags on host — res.spilled_rows() would build new
+        # lazy device ops, adding a dispatch round trip to every readback
+        matches, counts, aover, mover = jax.device_get(
+            (res.matches, res.n_matches, res.active_overflow,
+             res.match_overflow)
         )
+        sp = (aover > 0) | (mover > 0)
         rows = [matches[r, : counts[r]].tolist() for r in range(n)]
         return rows, np.flatnonzero(sp[:n]).tolist()
 

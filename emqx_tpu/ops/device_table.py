@@ -227,10 +227,13 @@ class DeviceNfa:
 
     # -- serving -----------------------------------------------------------
 
-    def match(self, words, lens, is_sys) -> MatchResult:
+    def match(self, words, lens, is_sys, *,
+              flat_cap: int = 0) -> MatchResult:
         """Run the kernel on already-encoded operands.  Dispatch happens
         under the device lock; the returned arrays are futures — callers
-        block (np.asarray) outside any lock."""
+        block (np.asarray) outside any lock.  ``flat_cap`` > 0 selects
+        the flat compacted output (minimal-readback serving mode; see
+        match_kernel.decode_flat)."""
         with self._lock:
             node, edge, seeds = self.arrays()
             return nfa_match(
@@ -238,6 +241,7 @@ class DeviceNfa:
                 active_slots=self.active_slots,
                 max_matches=self.max_matches,
                 compact_output=self.compact_output,
+                flat_cap=flat_cap,
             )
 
     def match_names(self, names: Sequence[str], batch: Optional[int] = None):

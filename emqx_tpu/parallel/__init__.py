@@ -1,6 +1,7 @@
 """Multi-chip parallelism: mesh construction and sharded match/fan-out."""
 
 from .mesh import make_mesh, pick_shape
+from .multihost import MultihostRuntime, dcn_env, hybrid_mesh_from
 from .prefix_ep import EpTables, build_ep_matcher, build_partitions, owner_of
 from .ring_fanout import build_ring_fanout, shard_bitmap_rows
 from .shared_group import build_shared_selector, host_pick, make_group_masks
@@ -13,6 +14,9 @@ from .sharded_match import (
 __all__ = [
     "make_mesh",
     "pick_shape",
+    "MultihostRuntime",
+    "dcn_env",
+    "hybrid_mesh_from",
     "FanoutResult",
     "build_sharded_matcher",
     "make_accept_bitmap",

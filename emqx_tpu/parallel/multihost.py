@@ -1,0 +1,144 @@
+"""Multi-host (DCN) distributed runtime — the gen_rpc/NCCL-backend
+analog for scaling past one TPU slice.
+
+Behavioral reference: the reference clusters brokers over ekka/gen_rpc
+(SURVEY.md §2.2, §2.5 "collective backend"); its compute frameworks use
+NCCL/MPI process groups.  The TPU-native counterpart is
+``jax.distributed`` + a HYBRID mesh: inner axes map to ICI (fast
+intra-slice interconnect), the outermost axis maps to DCN (the
+data-center network between hosts/slices).  XLA then routes each
+collective over the right fabric — ``psum`` over a ``dp``-outer axis
+becomes a hierarchical reduce (ICI first, one DCN hop per slice), which
+is exactly the layout the scaling playbook prescribes (data-parallel
+between slices, model/bitmap-parallel inside).
+
+Single-process usage is a no-op passthrough, so the same node code runs
+a laptop test, a one-host TPU, and a multi-host fleet:
+
+    rt = MultihostRuntime.from_env()      # env/flags → initialize()
+    mesh = rt.hybrid_mesh({"tp": 4}, dcn_axis="dp")
+    ... pjit over mesh as usual ...
+
+The matching broker-side responsibility split (who owns which router
+shard, takeover on host loss) stays in ``cluster/`` — this module only
+owns process bootstrap + mesh construction + the collective fabric.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+log = logging.getLogger(__name__)
+
+__all__ = ["MultihostRuntime", "hybrid_mesh_from", "dcn_env"]
+
+
+def dcn_env() -> Dict[str, Optional[str]]:
+    """The bootstrap triplet, from the environment (the same contract as
+    torchrun/MPI launchers: every process gets coordinator + rank +
+    world size)."""
+    return {
+        "coordinator": os.environ.get("EMQX_TPU_COORDINATOR"),
+        "process_id": os.environ.get("EMQX_TPU_PROCESS_ID"),
+        "num_processes": os.environ.get("EMQX_TPU_NUM_PROCESSES"),
+    }
+
+
+@dataclass
+class MultihostRuntime:
+    """Process-level distributed state (one per Python process)."""
+
+    num_processes: int = 1
+    process_id: Optional[int] = 0
+    initialized: bool = False
+
+    @classmethod
+    def from_env(cls, coordinator: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None) -> "MultihostRuntime":
+        """Initialize ``jax.distributed`` when a coordinator is
+        configured; single-process passthrough otherwise."""
+        env = dcn_env()
+        coordinator = coordinator or env["coordinator"]
+        if num_processes is None and env["num_processes"]:
+            num_processes = int(env["num_processes"])
+        if process_id is None and env["process_id"]:
+            process_id = int(env["process_id"])
+        if not coordinator or not num_processes or num_processes <= 1:
+            return cls()
+        # process_id None passes through: JAX auto-detects rank on
+        # TPU/GKE launchers — coercing to 0 would make every host claim
+        # rank 0 and hang the bootstrap barrier
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        pid = process_id if process_id is not None \
+            else getattr(jax, "process_index", lambda: 0)()
+        rt = cls(num_processes=num_processes,
+                 process_id=pid, initialized=True)
+        log.info("jax.distributed up: process %s/%d via %s",
+                 rt.process_id, num_processes, coordinator)
+        return rt
+
+    # -- mesh construction --------------------------------------------------
+
+    def hybrid_mesh(self, ici_shape: Dict[str, int],
+                    dcn_axis: str = "dp",
+                    devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        return hybrid_mesh_from(ici_shape, dcn_axis, devices,
+                                num_hosts=max(1, self.num_processes))
+
+    def local_devices(self):
+        return jax.local_devices()
+
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def hybrid_mesh_from(ici_shape: Dict[str, int], dcn_axis: str = "dp",
+                     devices: Optional[Sequence[jax.Device]] = None,
+                     num_hosts: Optional[int] = None) -> Mesh:
+    """Build a mesh whose OUTERMOST axis spans hosts (DCN) and whose
+    inner axes tile each host's devices (ICI).
+
+    ``ici_shape`` maps inner axis names to sizes and must factor each
+    host's device count; ``dcn_axis`` names the cross-host axis.  Device
+    order groups each host's devices contiguously (``jax.devices()``
+    orders by process), so XLA sees the outer axis as the slow fabric —
+    collectives over inner axes never cross DCN.
+
+    On one host this degenerates to an ordinary mesh with a size-1 (or
+    host-count-free) outer axis — shardings and pjit code are unchanged
+    between the laptop test and the fleet.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if num_hosts is None:
+        num_hosts = max(1, getattr(jax, "process_count", lambda: 1)())
+    if len(devs) % num_hosts:
+        raise ValueError(
+            f"{len(devs)} devices do not split over {num_hosts} hosts")
+    per_host = len(devs) // num_hosts
+    inner = int(np.prod(list(ici_shape.values()))) if ici_shape else 1
+    if per_host % inner:
+        raise ValueError(
+            f"ici shape {ici_shape} ({inner}) does not divide the "
+            f"per-host device count {per_host}")
+    ici_shape = dict(ici_shape)
+    leftover = per_host // inner
+    # fold any per-host leftover into the dcn axis rows so the full
+    # device count is used: outer axis = hosts × leftover
+    outer = num_hosts * leftover
+    if dcn_axis in ici_shape:
+        raise ValueError(f"dcn axis {dcn_axis!r} also in ici_shape")
+    shape = {dcn_axis: outer, **ici_shape}
+    arr = np.array(devs).reshape(list(shape.values()))
+    return Mesh(arr, tuple(shape.keys()))

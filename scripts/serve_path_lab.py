@@ -1,0 +1,124 @@
+"""Where does the serving path lose throughput vs the raw pipelined
+loop?  Measures, at --filters scale on the real chip:
+
+  a) raw pipelined loop, pre-uploaded arrays (the bench 'tpu' number)
+  b) encode+upload per iter, readback every iter, inflight=K
+  c) like (b) but with encode in a worker thread (overlap host/device)
+
+Run: python scripts/serve_path_lab.py [--filters 200000 --batch 8192]
+"""
+
+import argparse
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, ".")
+from bench import _encode, build_table, build_workload  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--filters", type=int, default=200_000)
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--depth", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops.device_table import DeviceNfa
+
+    rng = np.random.default_rng(7)
+    filters, topics = build_workload(rng, args.filters, args.batch * 4,
+                                     args.depth)
+    table, kind, build_s = build_table(filters, args.depth)
+    print(f"table {kind} build {build_s:.1f}s", flush=True)
+    dev = DeviceNfa(table, active_slots=8, compact_output=True)
+    names = topics[:args.batch]
+
+    def enc():
+        return _encode(table, names, args.depth, args.batch)
+
+    w, l, s = enc()
+    arrs = tuple(map(jnp.asarray, (w, l, s)))
+    r = dev.match(*arrs)
+    np.asarray(r.matches)  # warm
+
+    # (a) raw pipelined, pre-uploaded
+    t0 = time.perf_counter()
+    rs = [dev.match(*arrs) for _ in range(args.iters)]
+    np.asarray(rs[-1].matches)
+    a = (time.perf_counter() - t0) / args.iters
+    print(f"a) raw pipelined pre-uploaded : {a*1e3:7.2f} ms/batch "
+          f"{args.batch/a:,.0f} t/s", flush=True)
+
+    # (a2) same but read back EVERY iter (still enqueued ahead? no — sync)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        np.asarray(dev.match(*arrs).matches)
+    a2 = (time.perf_counter() - t0) / args.iters
+    print(f"a2) sync readback every iter  : {a2*1e3:7.2f} ms/batch "
+          f"{args.batch/a2:,.0f} t/s", flush=True)
+
+    # (b) encode+upload per iter, inflight K
+    for k in (1, 3, 6):
+        t0 = time.perf_counter()
+        inflight = []
+        for _ in range(args.iters):
+            w, l, s = enc()
+            inflight.append(dev.match(jnp.asarray(w), jnp.asarray(l),
+                                      jnp.asarray(s)))
+            if len(inflight) >= k:
+                np.asarray(inflight.pop(0).matches)
+        for r in inflight:
+            np.asarray(r.matches)
+        b = (time.perf_counter() - t0) / args.iters
+        print(f"b) enc+upload, inflight={k}    : {b*1e3:7.2f} ms/batch "
+              f"{args.batch/b:,.0f} t/s", flush=True)
+
+    # (c) encode in a thread, double-buffered, inflight 3
+    pool = ThreadPoolExecutor(2)
+    t0 = time.perf_counter()
+    inflight = []
+    fut = pool.submit(enc)
+    for _ in range(args.iters):
+        w, l, s = fut.result()
+        fut = pool.submit(enc)
+        inflight.append(dev.match(jnp.asarray(w), jnp.asarray(l),
+                                  jnp.asarray(s)))
+        if len(inflight) >= 3:
+            np.asarray(inflight.pop(0).matches)
+    for r in inflight:
+        np.asarray(r.matches)
+    c = (time.perf_counter() - t0) / args.iters
+    print(f"c) threaded encode, inflight=3: {c*1e3:7.2f} ms/batch "
+          f"{args.batch/c:,.0f} t/s", flush=True)
+
+    # component timings
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        enc()
+    print(f"   encode alone              : "
+          f"{(time.perf_counter()-t0)/args.iters*1e3:7.2f} ms", flush=True)
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        jnp.asarray(w).block_until_ready()
+        jnp.asarray(l).block_until_ready()
+        jnp.asarray(s).block_until_ready()
+    print(f"   upload alone              : "
+          f"{(time.perf_counter()-t0)/args.iters*1e3:7.2f} ms", flush=True)
+    m = np.asarray(rs[-1].matches)
+    t0 = time.perf_counter()
+    for r in [dev.match(*arrs) for _ in range(args.iters)]:
+        pass
+    t_enq = (time.perf_counter() - t0) / args.iters
+    print(f"   enqueue alone             : {t_enq*1e3:7.2f} ms", flush=True)
+    print(f"   matches bytes             : {m.nbytes}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -391,3 +391,19 @@ def test_wildcard_injection_guard_in_backend_acls():
     assert MongoAuthzSource._match(
         [{"permission": "allow", "action": "all", "topics": None}],
         "publish", "t", "c", "u") == "nomatch"
+
+
+def test_ldap_dn_escaping_blocks_injection():
+    from emqx_tpu.auth.ldap import LdapAuthenticator
+
+    a = LdapAuthenticator()
+    from emqx_tpu.auth.authn import Credentials
+
+    dn = a._dn(Credentials("c", "svc,ou=services"))
+    # the comma (and '=', conservatively) must be escaped so the DN
+    # stays inside ou=users
+    assert dn == "uid=svc\\,ou\\=services,ou=users,dc=example,dc=com"
+    assert a._dn_escape(" lead") == "\\ lead"
+    assert a._dn_escape("trail ") == "trail\\ "
+    assert a._dn_escape("#tag") == "\\#tag"
+    assert a._dn_escape("a=b+c") == "a\\=b\\+c"

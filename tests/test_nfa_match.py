@@ -154,3 +154,51 @@ def test_kernel_equals_oracle_random(filters, names):
     got = match_topics(t, names, active_slots=64, max_matches=64)
     for name, matched in zip(names, got):
         assert set(matched) == oracle(name, set(filters)), (name, filters)
+
+
+def test_flat_output_parity_and_truncation():
+    """Flat mode: globally compacted ids decode to the same per-row sets
+    as compact mode; rows truncated by K or the global cap are flagged."""
+    from emqx_tpu.ops.match_kernel import decode_flat
+
+    t = compile_filters(FILTERS, depth=16, state_bucket=8)
+    words, lens, is_sys = encode_topics(t, TOPICS)
+    K = 8
+    cap = 128
+    r = nfa_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in t.device_arrays()],
+        active_slots=16, max_matches=K, flat_cap=cap,
+    )
+    flat = np.asarray(r.matches)
+    assert flat.shape == (cap,)
+    n = np.asarray(r.n_matches)
+    spilled = np.asarray(r.spilled_rows())
+    rows = decode_flat(flat, n, K)
+    for i, name in enumerate(TOPICS):
+        want = oracle(name, FILTERS)
+        got = {t.accept_filters[a] for a in rows[i]}
+        if not spilled[i]:
+            assert got == want, (name, got, want)
+        else:
+            assert got <= want
+
+    # tiny global cap: every row past the cap must be flagged
+    r2 = nfa_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in t.device_arrays()],
+        active_slots=16, max_matches=K, flat_cap=4,
+    )
+    n2 = np.asarray(r2.n_matches)
+    sp2 = np.asarray(r2.spilled_rows())
+    nk = np.minimum(n2, K)
+    offs = np.cumsum(nk) - nk
+    for i in range(len(TOPICS)):
+        if offs[i] + nk[i] > 4:
+            assert sp2[i], i
+    # un-truncated prefix rows still decode correctly
+    rows2 = decode_flat(np.asarray(r2.matches), n2, K)
+    for i in range(len(TOPICS)):
+        if not sp2[i]:
+            got = {t.accept_filters[a] for a in rows2[i]}
+            assert got == oracle(TOPICS[i], FILTERS)
