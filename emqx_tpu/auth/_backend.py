@@ -69,3 +69,8 @@ class TtlCache:
             cutoff = now - self.ttl
             self._store = {k: v for k, v in self._store.items()
                            if v[1] >= cutoff}
+            # cap is a HARD bound: >cap distinct keys inside one TTL
+            # window (connection churn, or an attacker cycling client
+            # ids) must not grow the dict without limit
+            while len(self._store) > self.cap:
+                self._store.pop(next(iter(self._store)))

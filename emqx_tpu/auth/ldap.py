@@ -24,6 +24,7 @@ import asyncio
 import logging
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..wire import LazyTcpClient
 from ._backend import ParkedVerdicts
 from .authn import AuthResult, Credentials, IGNORE
 from .external import _in_event_loop
@@ -86,23 +87,15 @@ def _parse_children(payload: bytes) -> List[Tuple[int, bytes]]:
     return out
 
 
-class LdapClient:
+class LdapClient(LazyTcpClient):
     """One async LDAP connection: simple bind + equality search."""
 
     def __init__(self, server: str = "127.0.0.1:389",
                  timeout: float = 5.0) -> None:
-        host, _, port = server.rpartition(":")
-        self.host, self.port = host or "127.0.0.1", int(port or 389)
-        self.timeout = timeout
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
+        super().__init__(server, 389, timeout)
         self._msgid = 0
-        self._lock = asyncio.Lock()
 
     async def _send(self, op: bytes) -> bytes:
-        if self._writer is None:
-            self._reader, self._writer = await asyncio.open_connection(
-                self.host, self.port)
         self._msgid += 1
         self._writer.write(ber(0x30, _ber_int(self._msgid) + op))
         await self._writer.drain()
@@ -117,27 +110,9 @@ class LdapClient:
             head += more
         return head + await self._reader.readexactly(ln)
 
-    def _drop(self) -> None:
-        if self._writer is not None:
-            try:
-                self._writer.close()
-            except Exception:
-                pass
-        self._reader = self._writer = None
-
-    async def close(self) -> None:
-        async with self._lock:
-            self._drop()
-
     async def bind(self, dn: str, password: bytes) -> int:
         """Simple bind; returns the LDAP resultCode."""
-        async with self._lock:
-            try:
-                return await asyncio.wait_for(
-                    self._bind(dn, password), self.timeout)
-            except Exception:
-                self._drop()
-                raise
+        return await self._guarded(lambda: self._bind(dn, password))
 
     async def _bind(self, dn: str, password: bytes) -> int:
         op = ber(0x60, _ber_int(3) + _ber_str(dn)
@@ -157,14 +132,8 @@ class LdapClient:
                          want_attrs: Tuple[str, ...] = ()) -> Optional[
                              Tuple[str, Dict[str, str]]]:
         """Equality search, first entry only -> (dn, attrs) or None."""
-        async with self._lock:
-            try:
-                return await asyncio.wait_for(
-                    self._search_one(base_dn, attr, value, want_attrs),
-                    self.timeout)
-            except Exception:
-                self._drop()
-                raise
+        return await self._guarded(
+            lambda: self._search_one(base_dn, attr, value, want_attrs))
 
     async def search_bind(self, service_dn: Optional[str],
                           service_password: bytes, base_dn: str,
@@ -179,16 +148,10 @@ class LdapClient:
         Returns (bind_result_code, entry_attrs); (None, None) when the
         search found no entry, raises on service-bind failure.
         """
-        async with self._lock:
-            try:
-                return await asyncio.wait_for(
-                    self._search_bind(service_dn, service_password,
+        return await self._guarded(
+            lambda: self._search_bind(service_dn, service_password,
                                       base_dn, attr, value,
-                                      user_password, want_attrs),
-                    self.timeout)
-            except Exception:
-                self._drop()
-                raise
+                                      user_password, want_attrs))
 
     async def _search_bind(self, service_dn, service_password, base_dn,
                            attr, value, user_password, want_attrs):

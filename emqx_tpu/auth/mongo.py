@@ -28,6 +28,7 @@ import logging
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..wire import LazyTcpClient
 from ._backend import ParkedVerdicts, TtlCache, acl_filter_matches
 from .authn import AuthResult, Credentials, IGNORE, _verify_password
 from .authz import ALLOW, DENY, NOMATCH
@@ -125,33 +126,19 @@ def _dec_doc(data: bytes, off: int) -> Tuple[Dict[str, Any], int]:
     return out, end + 1
 
 
-class MongoClient:
+class MongoClient(LazyTcpClient):
     """One async connection speaking OP_MSG ``find``; lazy reconnect."""
 
     def __init__(self, server: str = "127.0.0.1:27017", *,
                  database: str = "mqtt", timeout: float = 5.0) -> None:
-        host, _, port = server.rpartition(":")
-        self.host, self.port = host or "127.0.0.1", int(port or 27017)
+        super().__init__(server, 27017, timeout)
         self.database = database
-        self.timeout = timeout
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
         self._req = 0
-        self._lock = asyncio.Lock()
 
     async def command(self, doc: Dict[str, Any]) -> Dict[str, Any]:
-        async with self._lock:
-            try:
-                return await asyncio.wait_for(
-                    self._command(doc), self.timeout)
-            except Exception:
-                self._drop()
-                raise
+        return await self._guarded(lambda: self._command(doc))
 
     async def _command(self, doc):
-        if self._writer is None:
-            self._reader, self._writer = await asyncio.open_connection(
-                self.host, self.port)
         self._req += 1
         doc = {**doc, "$db": self.database}
         body = struct.pack("<i", 0) + b"\x00" + bson_encode(doc)
@@ -190,18 +177,6 @@ class MongoClient:
             cursor = reply.get("cursor", {})
             docs.extend(cursor.get("nextBatch", []))
         return [d for d in docs if isinstance(d, dict)]
-
-    def _drop(self) -> None:
-        if self._writer is not None:
-            try:
-                self._writer.close()
-            except Exception:
-                pass
-        self._reader = self._writer = None
-
-    async def close(self) -> None:
-        async with self._lock:
-            self._drop()
 
     def find_blocking(self, collection, filter_, limit=0):
         client = MongoClient(f"{self.host}:{self.port}",

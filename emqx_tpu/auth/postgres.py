@@ -31,6 +31,7 @@ import logging
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..wire import LazyTcpClient
 from ._backend import ParkedVerdicts, TtlCache, acl_filter_matches
 from .authn import AuthResult, Credentials, IGNORE, _verify_password
 from .authz import ALLOW, DENY, NOMATCH
@@ -86,21 +87,16 @@ def _cstr(s: str) -> bytes:
     return s.encode() + b"\x00"
 
 
-class PgClient:
+class PgClient(LazyTcpClient):
     """One async PostgreSQL connection; reconnects lazily on error."""
 
     def __init__(self, server: str = "127.0.0.1:5432", *,
                  user: str = "postgres", password: Optional[str] = None,
                  database: str = "postgres", timeout: float = 5.0) -> None:
-        host, _, port = server.rpartition(":")
-        self.host, self.port = host or "127.0.0.1", int(port or 5432)
+        super().__init__(server, 5432, timeout)
         self.user = user
         self.password = password
         self.database = database
-        self.timeout = timeout
-        self._reader: Optional[asyncio.StreamReader] = None
-        self._writer: Optional[asyncio.StreamWriter] = None
-        self._lock = asyncio.Lock()
 
     # -- wire ---------------------------------------------------------------
 
@@ -163,35 +159,20 @@ class PgClient:
                 raise PgError(f"unsupported auth request {code}")
             await self._writer.drain()
 
-    async def _connect(self) -> None:
-        self._reader, self._writer = await asyncio.wait_for(
-            asyncio.open_connection(self.host, self.port), self.timeout)
+    async def _on_connect(self) -> None:
         params = (_cstr("user") + _cstr(self.user)
                   + _cstr("database") + _cstr(self.database) + b"\x00")
         self._writer.write(
             struct.pack("!II", len(params) + 8, PROTOCOL_V3) + params)
         await self._writer.drain()
-        await asyncio.wait_for(self._auth(), self.timeout)
+        await self._auth()
         # drain ParameterStatus/BackendKeyData up to ReadyForQuery
         while True:
-            kind, payload = await asyncio.wait_for(
-                self._read_msg(), self.timeout)
+            kind, payload = await self._read_msg()
             if kind == b"Z":
                 return
             if kind == b"E":
                 raise PgError(self._error_text(payload))
-
-    def _drop(self) -> None:
-        if self._writer is not None:
-            try:
-                self._writer.close()
-            except Exception:
-                pass
-        self._reader = self._writer = None
-
-    async def close(self) -> None:
-        async with self._lock:
-            self._drop()
 
     # -- extended query ------------------------------------------------------
 
@@ -199,17 +180,9 @@ class PgClient:
                     params: Tuple[Optional[str], ...] = ()) -> Tuple[
                         List[str], List[List[Optional[str]]]]:
         """Parse/Bind/Describe/Execute/Sync; text-format results only."""
-        async with self._lock:
-            try:
-                return await asyncio.wait_for(
-                    self._query(sql, params), self.timeout)
-            except Exception:
-                self._drop()
-                raise
+        return await self._guarded(lambda: self._query(sql, params))
 
     async def _query(self, sql, params):
-        if self._writer is None:
-            await self._connect()
         bind = [struct.pack("!H", 0), struct.pack("!H", len(params))]
         for p in params:
             if p is None:
@@ -301,7 +274,7 @@ class PostgresAuthenticator:
         self._parked = ParkedVerdicts()
 
     def _params(self, creds: Credentials) -> Tuple[Optional[str], ...]:
-        ctx = _ctx_of(creds.clientid, creds.username)
+        ctx = _ctx_of(creds.clientid, creds.username, creds.peerhost)
         return tuple(str(ctx.get(v, "")) for v in self.vars)
 
     def _evaluate(self, cols: List[str],

@@ -2,13 +2,16 @@
 
 The emqx bridge/connector/resource family (SURVEY.md §2.3) rebuilt on
 asyncio: :mod:`resource` is the buffered-worker backbone,
-:mod:`mqtt_bridge` and :mod:`webhook` are the first two connectors,
-:mod:`manager` wires bridges into rules and REST.
+:mod:`mqtt_bridge`, :mod:`webhook` and :mod:`kafka` (wire-protocol
+producer) are the connectors, :mod:`manager` wires bridges into rules
+and REST.
 """
 
+from .kafka import KafkaConnector, crc32c, render_kafka
 from .manager import Bridge, BridgeManager
 from .resource import BufferedWorker, Connector, SendError
 
 __all__ = [
     "Bridge", "BridgeManager", "BufferedWorker", "Connector", "SendError",
+    "KafkaConnector", "crc32c", "render_kafka",
 ]

@@ -1,0 +1,375 @@
+"""Kafka producer bridge over a minimal wire-protocol client.
+
+Behavioral reference: ``apps/emqx_bridge_kafka`` [U] (SURVEY.md §2.3) —
+the reference's flagship data bridge: rule-engine output → buffered
+worker → Kafka topic, with per-message key/value templates and
+partition dispatch.
+
+The wire client is dependency-free and speaks exactly what a producer
+needs, pinned to broker-era-stable versions:
+
+* ``Metadata`` v1 (api 3) — partition leaders for the target topic;
+* ``Produce`` v3 (api 0) — record batches v2 (magic 2): zigzag-varint
+  records, CRC-32C (Castagnoli, software table — no snappy/crc32c
+  package in this environment, SURVEY §2.4), acks=1.
+
+Compression is not attempted (attributes=0): snappy/lz4 are not in the
+environment's package set, and Kafka accepts uncompressed batches from
+any producer.  Partitioning is murmur-free: explicit ``partition`` in
+the rendered item, else key-hash (crc32c of the key) mod partitions,
+else round-robin — deployments needing Java-client-compatible
+murmur2 placement set explicit partitions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..wire import LazyTcpClient
+from .resource import Connector, SendError
+
+log = logging.getLogger(__name__)
+
+__all__ = ["crc32c", "KafkaConnector", "render_kafka", "KafkaError"]
+
+
+class KafkaError(Exception):
+    pass
+
+
+# -- CRC-32C (Castagnoli), software table ------------------------------------
+
+_CRC32C_TABLE: List[int] = []
+
+
+def _crc_table() -> List[int]:
+    if not _CRC32C_TABLE:
+        poly = 0x82F63B78
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC32C_TABLE.append(c)
+    return _CRC32C_TABLE
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    tab = _crc_table()
+    c = crc ^ 0xFFFFFFFF
+    for b in data:
+        c = tab[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+# -- wire primitives ---------------------------------------------------------
+
+def _str(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack("!h", -1)
+    b = s.encode()
+    return struct.pack("!h", len(b)) + b
+
+
+def _bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack("!i", -1)
+    return struct.pack("!i", len(b)) + b
+
+
+def _varint(v: int) -> bytes:
+    """Zigzag varint (Kafka record fields)."""
+    z = (v << 1) ^ (v >> 63)
+    out = bytearray()
+    while (z & ~0x7F) != 0:
+        out.append((z & 0x7F) | 0x80)
+        z >>= 7
+    out.append(z & 0x7F)
+    return bytes(out)
+
+
+def read_varint(data: bytes, off: int) -> Tuple[int, int]:
+    shift = z = 0
+    while True:
+        b = data[off]
+        off += 1
+        z |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (z >> 1) ^ -(z & 1), off
+
+
+def _record(offset_delta: int, ts_delta: int, key: Optional[bytes],
+            value: bytes) -> bytes:
+    body = (b"\x00"                                    # attributes
+            + _varint(ts_delta) + _varint(offset_delta)
+            + (_varint(-1) if key is None
+               else _varint(len(key)) + key)
+            + _varint(len(value)) + value
+            + _varint(0))                              # no headers
+    return _varint(len(body)) + body
+
+
+def record_batch(records: List[Tuple[Optional[bytes], bytes]],
+                 base_ts_ms: Optional[int] = None) -> bytes:
+    """Record batch v2 (magic 2), uncompressed, producer-id-less."""
+    ts = int(base_ts_ms if base_ts_ms is not None else time.time() * 1e3)
+    recs = b"".join(
+        _record(i, 0, k, v) for i, (k, v) in enumerate(records))
+    n = len(records)
+    after_crc = (
+        struct.pack("!hiqqqhii", 0, n - 1, ts, ts, -1, -1, -1, n) + recs
+    )
+    crc = crc32c(after_crc)
+    head = struct.pack("!iBI", -1, 2, crc)             # epoch, magic, crc
+    body = head + after_crc
+    return struct.pack("!qi", 0, len(body)) + body     # baseOffset, len
+
+
+def parse_record_batch(data: bytes) -> List[Tuple[Optional[bytes], bytes]]:
+    """Decode one batch (test servers + loopback verification); checks
+    the CRC."""
+    base_off, blen = struct.unpack_from("!qi", data, 0)
+    epoch, magic, crc = struct.unpack_from("!iBI", data, 12)
+    if magic != 2:
+        raise KafkaError(f"unsupported magic {magic}")
+    after = data[21:12 + blen]
+    if crc32c(after) != crc:
+        raise KafkaError("record batch crc mismatch")
+    (attrs, last_delta, t0, t1, pid, peph, seq,
+     n) = struct.unpack_from("!hiqqqhii", after, 0)
+    off = struct.calcsize("!hiqqqhii")
+    out = []
+    for _ in range(n):
+        _, off = read_varint(after, off)               # record length
+        off += 1                                       # attributes
+        _, off = read_varint(after, off)               # ts delta
+        _, off = read_varint(after, off)               # offset delta
+        klen, off = read_varint(after, off)
+        key = None
+        if klen >= 0:
+            key = after[off:off + klen]
+            off += klen
+        vlen, off = read_varint(after, off)
+        val = after[off:off + vlen]
+        off += vlen
+        nh, off = read_varint(after, off)
+        for _ in range(nh):                            # skip headers
+            hk, off = read_varint(after, off)
+            off += hk
+            hv, off = read_varint(after, off)
+            off += max(0, hv)
+        out.append((key, val))
+    return out
+
+
+RETRIABLE_ERRORS = {5, 6, 7, 9, 19}  # leader/broker transitions, timeouts
+
+
+class KafkaClient(LazyTcpClient):
+    """One async connection to a bootstrap broker: Metadata + Produce."""
+
+    def __init__(self, server: str = "127.0.0.1:9092", *,
+                 client_id: str = "emqx_tpu", timeout: float = 5.0) -> None:
+        super().__init__(server, 9092, timeout)
+        self.client_id = client_id
+        self._corr = 0
+
+    async def _request(self, api_key: int, api_version: int,
+                       body: bytes, expect_response: bool = True) -> bytes:
+        return await self._guarded(
+            lambda: self._request_locked(api_key, api_version, body,
+                                         expect_response))
+
+    async def _request_locked(self, api_key, api_version, body,
+                              expect_response=True):
+        self._corr += 1
+        head = (struct.pack("!hhi", api_key, api_version, self._corr)
+                + _str(self.client_id))
+        msg = head + body
+        self._writer.write(struct.pack("!i", len(msg)) + msg)
+        await self._writer.drain()
+        if not expect_response:     # acks=0: Kafka sends NO response
+            return b""
+        (ln,) = struct.unpack("!i", await self._reader.readexactly(4))
+        payload = await self._reader.readexactly(ln)
+        (corr,) = struct.unpack_from("!i", payload, 0)
+        if corr != self._corr:
+            raise KafkaError(f"correlation mismatch {corr}!={self._corr}")
+        return payload[4:]
+
+    # -- Metadata v1 --------------------------------------------------------
+
+    async def partitions(self, topic: str) -> int:
+        body = struct.pack("!i", 1) + _str(topic)
+        p = await self._request(3, 1, body)
+        off = 0
+        (nb,) = struct.unpack_from("!i", p, off)
+        off += 4
+        for _ in range(nb):                            # brokers
+            off += 4                                   # node_id
+            (sl,) = struct.unpack_from("!h", p, off)
+            off += 2 + sl + 4                          # host, port
+            (rl,) = struct.unpack_from("!h", p, off)   # rack
+            off += 2 + max(0, rl)
+        off += 4                                       # controller id
+        (nt,) = struct.unpack_from("!i", p, off)
+        off += 4
+        for _ in range(nt):
+            (err,) = struct.unpack_from("!h", p, off)
+            off += 2
+            (sl,) = struct.unpack_from("!h", p, off)
+            off += 2
+            name = p[off:off + sl].decode()
+            off += sl
+            off += 1                                   # is_internal
+            (np_,) = struct.unpack_from("!i", p, off)
+            off += 4
+            if name == topic:
+                if err not in (0, 5):                  # 5: leader election
+                    raise KafkaError(f"metadata error {err} for {topic}")
+                return max(1, np_)
+            for _ in range(np_):                       # skip partitions
+                off += 2 + 4 + 4                       # err, id, leader
+                (nr,) = struct.unpack_from("!i", p, off)
+                off += 4 + 4 * nr
+                (ni,) = struct.unpack_from("!i", p, off)
+                off += 4 + 4 * ni
+        raise KafkaError(f"topic {topic} not in metadata")
+
+    # -- Produce v3 ---------------------------------------------------------
+
+    async def produce(self, topic: str, partition: int,
+                      records: List[Tuple[Optional[bytes], bytes]],
+                      acks: int = 1) -> int:
+        """Send one batch; returns the base offset assigned (-1 for
+        acks=0, which Kafka leaves unanswered on the wire)."""
+        if sum(len(v) + len(k or b"") for k, v in records) > 65536:
+            # the software CRC-32C is a per-byte Python loop; keep big
+            # batches off the event loop (broker keepalives run there)
+            batch = await asyncio.to_thread(record_batch, records)
+        else:
+            batch = record_batch(records)
+        body = (_str(None)                             # transactional_id
+                + struct.pack("!hi", acks, int(self.timeout * 1e3))
+                + struct.pack("!i", 1) + _str(topic)
+                + struct.pack("!i", 1)
+                + struct.pack("!i", partition) + _bytes(batch))
+        p = await self._request(0, 3, body, expect_response=acks != 0)
+        if acks == 0:
+            return -1
+        off = 0
+        (nt,) = struct.unpack_from("!i", p, off)
+        off += 4
+        for _ in range(nt):
+            (sl,) = struct.unpack_from("!h", p, off)
+            off += 2 + sl
+            (np_,) = struct.unpack_from("!i", p, off)
+            off += 4
+            for _ in range(np_):
+                pid, err, base = struct.unpack_from("!ihq", p, off)
+                off += 4 + 2 + 8 + 8                   # + log_append_time
+                if err:
+                    raise SendError(
+                        f"kafka produce error {err} on {topic}/{pid}",
+                        retryable=err in RETRIABLE_ERRORS)
+                return base
+        raise KafkaError("empty produce response")
+
+
+def _render_template(tpl: str, output: Dict[str, Any],
+                     columns: Dict[str, Any]) -> str:
+    out = tpl
+    for src in (output, columns):
+        for k, v in src.items():
+            out = out.replace("${" + k + "}", "" if v is None else (
+                v.decode("utf-8", "replace") if isinstance(v, bytes)
+                else str(v)))
+    return out
+
+
+def render_kafka(conf: Dict[str, Any], output: Dict[str, Any],
+                 columns: Dict[str, Any]) -> Dict[str, Any]:
+    """Rule output -> one Kafka item: templated key/value, optional
+    explicit partition."""
+    key_tpl = conf.get("key_template", "${clientid}")
+    val_tpl = conf.get("value_template")
+    if val_tpl:
+        value = _render_template(val_tpl, output, columns).encode()
+    else:
+        payload = output.get("payload", columns.get("payload", b""))
+        value = payload if isinstance(payload, bytes) else \
+            str(payload).encode()
+    key = _render_template(key_tpl, output, columns).encode() or None
+    item = {"key": key, "value": value}
+    if "partition" in conf:
+        item["partition"] = int(conf["partition"])
+    return item
+
+
+class KafkaConnector(Connector):
+    """Buffered-worker connector: batches items into record batches."""
+
+    def __init__(self, conf: Dict[str, Any], name: str = "") -> None:
+        self.conf = conf
+        self.name = name
+        self.topic = conf.get("topic", "emqx")
+        self.acks = int(conf.get("acks", 1))
+        self.client = KafkaClient(
+            conf.get("server", "127.0.0.1:9092"),
+            client_id=conf.get("client_id", f"emqx_tpu:{name}"),
+            timeout=float(conf.get("timeout", 5.0)))
+        self.n_partitions = 1
+        self._rr = 0
+
+    async def start(self) -> None:
+        self.n_partitions = await self.client.partitions(self.topic)
+
+    async def stop(self) -> None:
+        await self.client.close()
+
+    async def health(self) -> bool:
+        try:
+            self.n_partitions = await self.client.partitions(self.topic)
+            return True
+        except Exception:
+            return False
+
+    def _partition_of(self, item: Dict[str, Any]) -> int:
+        if "partition" in item:
+            return int(item["partition"]) % self.n_partitions
+        key = item.get("key")
+        if key:
+            return crc32c(key) % self.n_partitions
+        self._rr += 1
+        return self._rr % self.n_partitions
+
+    async def send(self, items: List[Dict[str, Any]]) -> Optional[int]:
+        """Returns the REJECTED count per the Connector contract (0 —
+        Kafka acks a batch wholesale; errors raise SendError carrying
+        the undelivered items, so partitions acked before a failure are
+        never re-produced)."""
+        by_part: Dict[int, List[Dict[str, Any]]] = {}
+        for it in items:
+            by_part.setdefault(self._partition_of(it), []).append(it)
+        pending = dict(by_part)
+        for part, group in by_part.items():
+            try:
+                await self.client.produce(
+                    self.topic, part,
+                    [(it.get("key"), it["value"]) for it in group],
+                    acks=self.acks)
+            except SendError as e:
+                remaining = [it for g in pending.values() for it in g]
+                raise SendError(str(e), retryable=e.retryable,
+                                remaining=remaining) from e
+            except Exception as e:
+                remaining = [it for g in pending.values() for it in g]
+                raise SendError(str(e), retryable=True,
+                                remaining=remaining) from e
+            del pending[part]
+        return 0

@@ -12,6 +12,7 @@ import asyncio
 import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .kafka import KafkaConnector, render_kafka
 from .mqtt_bridge import MqttConnector, render_egress
 from .resource import BufferedWorker, Connector
 from .webhook import WebhookConnector, render_webhook
@@ -118,6 +119,9 @@ class BridgeManager:
         if btype == "webhook":
             return Bridge(btype, name, conf, WebhookConnector(conf, name),
                           render_webhook)
+        if btype == "kafka":
+            return Bridge(btype, name, conf, KafkaConnector(conf, name),
+                          render_kafka)
         raise ValueError(f"unknown bridge type {btype!r}")
 
     # -- CRUD --------------------------------------------------------------

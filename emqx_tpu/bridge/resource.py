@@ -33,14 +33,22 @@ class SendError(Exception):
     rejected) — the worker never re-sends them; ``rejected`` = how many
     of those processed items were permanent rejects (counted failed, the
     rest success).  ``retryable=True`` requeues ``batch[done:]`` for
-    redelivery; ``False`` drops it (counted failed)."""
+    redelivery; ``False`` drops it (counted failed).
+
+    ``remaining`` (optional) replaces the ``done`` prefix with an
+    EXPLICIT undelivered-item list (identity-matched) for connectors
+    that process a batch out of order — e.g. Kafka's per-partition
+    regrouping, where a later partition can fail after an earlier one
+    was acked and a prefix count would requeue already-delivered
+    records."""
 
     def __init__(self, msg: str, retryable: bool = True, done: int = 0,
-                 rejected: int = 0):
+                 rejected: int = 0, remaining: Optional[List[Any]] = None):
         super().__init__(msg)
         self.retryable = retryable
         self.done = done
         self.rejected = rejected
+        self.remaining = remaining
 
 
 class Connector:
@@ -213,12 +221,21 @@ class BufferedWorker:
                 raise
             except Exception as e:
                 retryable = getattr(e, "retryable", True)
-                done = min(getattr(e, "done", 0), len(batch))
-                rej = min(getattr(e, "rejected", 0), done)
-                if done:
-                    self.metrics["success"] += done - rej
+                remaining = getattr(e, "remaining", None)
+                if remaining is not None:
+                    keep = {id(it) for it in remaining}
+                    delivered = len(batch) - len(keep)
+                    rej = min(getattr(e, "rejected", 0), delivered)
+                    self.metrics["success"] += delivered - rej
                     self.metrics["failed"] += rej
-                    batch = batch[done:]
+                    batch = [bi for bi in batch if id(bi[1]) in keep]
+                else:
+                    done = min(getattr(e, "done", 0), len(batch))
+                    rej = min(getattr(e, "rejected", 0), done)
+                    if done:
+                        self.metrics["success"] += done - rej
+                        self.metrics["failed"] += rej
+                        batch = batch[done:]
                 if retryable and (
                     self.max_retries is None or retries < self.max_retries
                 ):

@@ -1,0 +1,75 @@
+"""Shared lazy-TCP wire-client base for the minimal protocol clients
+(PostgreSQL, MongoDB, LDAP auth backends; Kafka bridge).
+
+Each client speaks its own protocol but shares the connection
+discipline: parse ``host:port``, connect lazily on first use, serialize
+request/response exchanges under an asyncio lock with a deadline, and
+drop the connection on ANY error so the next call reconnects cleanly
+(half-read protocol streams are never resumable).  Centralized here so
+reconnect/timeout fixes land once (same motivation as auth/_backend.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional, TypeVar
+
+__all__ = ["LazyTcpClient"]
+
+T = TypeVar("T")
+
+
+class LazyTcpClient:
+    """One async connection; guarded exchanges; lazy reconnect."""
+
+    def __init__(self, server: str, default_port: int,
+                 timeout: float = 5.0) -> None:
+        host, _, port = server.rpartition(":")
+        self.host = host or "127.0.0.1"
+        self.port = int(port or default_port)
+        self.timeout = timeout
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._lock = asyncio.Lock()
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None
+
+    async def _ensure(self) -> None:
+        """Open the transport + run the protocol handshake if needed.
+        Subclasses with a handshake override :meth:`_on_connect`."""
+        if self._writer is None:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+            await self._on_connect()
+
+    async def _on_connect(self) -> None:
+        pass
+
+    async def _guarded(self, op: Callable[[], Awaitable[T]]) -> T:
+        """Serialize one exchange: lock, lazy connect, deadline, and
+        drop-on-error (the stream is mid-message after a failure)."""
+        async with self._lock:
+            try:
+                return await asyncio.wait_for(self._with_conn(op),
+                                              self.timeout)
+            except Exception:
+                self._drop()
+                raise
+
+    async def _with_conn(self, op):
+        await self._ensure()
+        return await op()
+
+    def _drop(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._reader = self._writer = None
+
+    async def close(self) -> None:
+        async with self._lock:
+            self._drop()
