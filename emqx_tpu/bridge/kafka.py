@@ -43,22 +43,24 @@ class KafkaError(Exception):
 
 # -- CRC-32C (Castagnoli), software table ------------------------------------
 
-_CRC32C_TABLE: List[int] = []
-
-
 def _crc_table() -> List[int]:
-    if not _CRC32C_TABLE:
-        poly = 0x82F63B78
-        for n in range(256):
-            c = n
-            for _ in range(8):
-                c = (c >> 1) ^ poly if c & 1 else c >> 1
-            _CRC32C_TABLE.append(c)
-    return _CRC32C_TABLE
+    poly = 0x82F63B78
+    tab = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        tab.append(c)
+    return tab
+
+
+# built once at import: lazy init would race between the event loop and
+# asyncio.to_thread (record_batch of big batches runs in a worker)
+_CRC32C_TABLE: List[int] = _crc_table()
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:
-    tab = _crc_table()
+    tab = _CRC32C_TABLE
     c = crc ^ 0xFFFFFFFF
     for b in data:
         c = tab[(c ^ b) & 0xFF] ^ (c >> 8)
@@ -281,30 +283,24 @@ class KafkaClient(LazyTcpClient):
         raise KafkaError("empty produce response")
 
 
-def _render_template(tpl: str, output: Dict[str, Any],
-                     columns: Dict[str, Any]) -> str:
-    out = tpl
-    for src in (output, columns):
-        for k, v in src.items():
-            out = out.replace("${" + k + "}", "" if v is None else (
-                v.decode("utf-8", "replace") if isinstance(v, bytes)
-                else str(v)))
-    return out
-
-
 def render_kafka(conf: Dict[str, Any], output: Dict[str, Any],
                  columns: Dict[str, Any]) -> Dict[str, Any]:
     """Rule output -> one Kafka item: templated key/value, optional
-    explicit partition."""
+    explicit partition.  Templates go through the rule engine's shared
+    ``render_template`` (single-scan, missing fields render empty,
+    dotted paths) — a hand-rolled replace loop would re-scan substituted
+    payload bytes and let clients inject other fields' placeholders."""
+    from ..rule_engine.runtime import render_template
+
     key_tpl = conf.get("key_template", "${clientid}")
     val_tpl = conf.get("value_template")
     if val_tpl:
-        value = _render_template(val_tpl, output, columns).encode()
+        value = render_template(val_tpl, output, columns).encode()
     else:
         payload = output.get("payload", columns.get("payload", b""))
         value = payload if isinstance(payload, bytes) else \
             str(payload).encode()
-    key = _render_template(key_tpl, output, columns).encode() or None
+    key = render_template(key_tpl, output, columns).encode() or None
     item = {"key": key, "value": value}
     if "partition" in conf:
         item["partition"] = int(conf["partition"])

@@ -183,6 +183,9 @@ SCHEMA: Dict[str, Field] = {
     # SNI: per-hostname cert chains, "host=cert.pem;key.pem" comma list
     # (emqx_tls_lib SNI analog); unmatched names fall to the default cert
     "listeners.ssl.default.sni": Field("", str),
+    # revocation: CRL PEM path + check scope ("leaf" | "chain")
+    "listeners.ssl.default.crlfile": Field("", str),
+    "listeners.ssl.default.crl_check": Field("leaf", str),
     "listeners.ws.default.bind": Field("0.0.0.0:8083", str),
     "listeners.ws.default.enable": Field(False, _bool),
 

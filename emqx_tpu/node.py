@@ -310,6 +310,25 @@ class BrokerNode:
                 ctx.load_verify_locations(ca)
             if cfg.get("listeners.ssl.default.verify"):
                 ctx.verify_mode = _ssl.CERT_REQUIRED
+            crl = (cfg.get("listeners.ssl.default.crlfile") or "").strip()
+            if crl:
+                # revocation: load_verify_locations accepts CRL PEMs;
+                # the flag decides leaf-only vs whole-chain checking.
+                # A CRL without client-cert verification would be
+                # silently inert (no cert is ever requested) — fail
+                # closed by implying CERT_REQUIRED.
+                ctx.load_verify_locations(cafile=crl)
+                check = (cfg.get("listeners.ssl.default.crl_check")
+                         or "leaf").strip().lower()
+                ctx.verify_flags |= (
+                    _ssl.VERIFY_CRL_CHECK_CHAIN if check == "chain"
+                    else _ssl.VERIFY_CRL_CHECK_LEAF)
+                if ctx.verify_mode != _ssl.CERT_REQUIRED:
+                    log.warning(
+                        "crlfile set without verify=true; enabling "
+                        "client-cert verification (CRL would otherwise "
+                        "never be consulted)")
+                    ctx.verify_mode = _ssl.CERT_REQUIRED
             if self.psk is not None:
                 self.psk.wire_into(ctx)
             sni = (cfg.get("listeners.ssl.default.sni") or "").strip()

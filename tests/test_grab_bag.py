@@ -393,3 +393,136 @@ def test_ssl_sni_selects_per_hostname_cert(tmp_path):
             await node.stop()
 
     run(main())
+
+
+def test_ssl_listener_crl_rejects_revoked_client(tmp_path):
+    """Client-cert verification with a CRL: a revoked client cert fails
+    the handshake, a valid one connects (emqx_tls_lib CRL-check analog).
+    Certs/CRL built with the cryptography package."""
+    import datetime
+    import ssl
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    now = datetime.datetime.now(datetime.timezone.utc)
+    day = datetime.timedelta(days=1)
+
+    def keypair():
+        return rsa.generate_private_key(public_exponent=65537,
+                                        key_size=2048)
+
+    def name(cn):
+        return x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+
+    ca_key = keypair()
+    ca_cert = (x509.CertificateBuilder()
+               .subject_name(name("test-ca")).issuer_name(name("test-ca"))
+               .public_key(ca_key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now - day).not_valid_after(now + day)
+               .add_extension(x509.BasicConstraints(ca=True,
+                                                    path_length=None),
+                              critical=True)
+               .sign(ca_key, hashes.SHA256()))
+
+    def issue(cn):
+        k = keypair()
+        c = (x509.CertificateBuilder()
+             .subject_name(name(cn)).issuer_name(name("test-ca"))
+             .public_key(k.public_key())
+             .serial_number(x509.random_serial_number())
+             .not_valid_before(now - day).not_valid_after(now + day)
+             .sign(ca_key, hashes.SHA256()))
+        return k, c
+
+    srv_key, srv_cert = issue("127.0.0.1")
+    ok_key, ok_cert = issue("good-client")
+    bad_key, bad_cert = issue("revoked-client")
+
+    crl = (x509.CertificateRevocationListBuilder()
+           .issuer_name(name("test-ca"))
+           .last_update(now - day).next_update(now + day)
+           .add_revoked_certificate(
+               x509.RevokedCertificateBuilder()
+               .serial_number(bad_cert.serial_number)
+               .revocation_date(now - day).build())
+           .sign(ca_key, hashes.SHA256()))
+
+    def pem(path, *objs):
+        data = b""
+        for o in objs:
+            if hasattr(o, "private_bytes"):
+                data += o.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.TraditionalOpenSSL,
+                    serialization.NoEncryption())
+            else:
+                data += o.public_bytes(serialization.Encoding.PEM)
+        p = tmp_path / path
+        p.write_bytes(data)
+        return p
+
+    ca_pem = pem("ca.pem", ca_cert)
+    crl_pem = pem("crl.pem", crl)
+    srv_c, srv_k = pem("srv.pem", srv_cert), pem("srv.key", srv_key)
+    ok_c, ok_k = pem("ok.pem", ok_cert), pem("ok.key", ok_key)
+    bad_c, bad_k = pem("bad.pem", bad_cert), pem("bad.key", bad_key)
+
+    async def main():
+        node = await start_node(
+            "listeners.ssl.default.enable = true\n"
+            'listeners.ssl.default.bind = "127.0.0.1:0"\n'
+            f'listeners.ssl.default.certfile = "{srv_c}"\n'
+            f'listeners.ssl.default.keyfile = "{srv_k}"\n'
+            f'listeners.ssl.default.cacertfile = "{ca_pem}"\n'
+            "listeners.ssl.default.verify = true\n"
+            f'listeners.ssl.default.crlfile = "{crl_pem}"\n')
+        try:
+            sport = [l for l in node.listeners.all()
+                     if l.name == "ssl-default"][0].port
+
+            def cctx(certfile, keyfile):
+                c = ssl.create_default_context()
+                c.check_hostname = False
+                c.verify_mode = ssl.CERT_NONE
+                c.load_cert_chain(certfile, keyfile)
+                return c
+
+            # valid client: full MQTT CONNECT/CONNACK over TLS
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", sport, ssl=cctx(ok_c, ok_k))
+            from emqx_tpu.mqtt import frame as F, packet as P
+
+            writer.write(F.serialize(P.Connect(proto_ver=4,
+                                               clientid="crl-ok")))
+            await writer.drain()
+            data = await asyncio.wait_for(reader.read(64), 5)
+            assert data[0] >> 4 == 2 and data[3] == 0
+            writer.close()
+
+            # revoked client: rejected at (or right after) the
+            # handshake — under TLS 1.3 the client "finishes" before
+            # the server's cert verdict, so the alert may surface as an
+            # error/EOF on the first read instead of in open_connection
+            try:
+                r2, w2 = await asyncio.wait_for(asyncio.open_connection(
+                    "127.0.0.1", sport, ssl=cctx(bad_c, bad_k)), 5)
+            except (ssl.SSLError, ConnectionError, OSError):
+                pass
+            else:
+                w2.write(F.serialize(P.Connect(proto_ver=4,
+                                               clientid="crl-bad")))
+                with pytest.raises((ssl.SSLError, ConnectionError,
+                                    OSError, asyncio.IncompleteReadError)):
+                    await w2.drain()
+                    got = await asyncio.wait_for(r2.read(64), 5)
+                    assert got == b"", got  # server alert -> EOF
+                    raise ConnectionResetError("rejected via EOF")
+                w2.close()
+        finally:
+            await node.stop()
+
+    run(main())
