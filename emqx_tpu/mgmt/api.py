@@ -53,6 +53,8 @@ class MgmtApi:
         self.server = server
         r = server.route
         v = "/api/v5"
+        r("GET", "/", self.dashboard_page)
+        r("GET", "/dashboard", self.dashboard_page)
         r("GET", f"{v}/status", self.status)
         r("GET", f"{v}/nodes", self.nodes)
         r("GET", f"{v}/stats", self.stats)
@@ -121,6 +123,14 @@ class MgmtApi:
     # ------------------------------------------------------------------
     # node / observability
     # ------------------------------------------------------------------
+
+    async def dashboard_page(self, req: Request) -> Response:
+        """The dashboard SPA (emqx_dashboard UI analog) — static HTML;
+        all data flows through the authenticated REST endpoints."""
+        from .ui import DASHBOARD_HTML
+
+        return Response(200, DASHBOARD_HTML.encode(),
+                        content_type="text/html; charset=utf-8")
 
     async def status(self, req: Request) -> Response:
         return Response(

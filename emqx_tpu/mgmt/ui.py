@@ -1,0 +1,195 @@
+"""Single-page dashboard UI served by the management listener.
+
+Behavioral reference: ``apps/emqx_dashboard`` [U] (SURVEY.md §2.3)
+serves a web UI over the same HTTP listener as the management API; the
+backend (RBAC users, login tokens, the REST surface) lives in
+``mgmt/dashboard.py`` + ``mgmt/api.py`` — this module is the
+presentation layer: one dependency-free HTML page that logs in through
+``POST /api/v5/login`` and renders the node's live state (overview
+counters, clients, subscriptions, rules, bridges, gateways, alarms)
+with Bearer-token fetches and a periodic refresh.
+"""
+
+DASHBOARD_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>emqx_tpu dashboard</title>
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<style>
+ :root { color-scheme: dark; }
+ body { font: 14px/1.45 system-ui, sans-serif; margin: 0;
+        background: #10151c; color: #d8dee6; }
+ header { display: flex; align-items: baseline; gap: 1rem;
+          padding: .7rem 1.2rem; background: #171f29;
+          border-bottom: 1px solid #263041; }
+ header h1 { font-size: 1.05rem; margin: 0; color: #7fd1b9; }
+ header .sub { color: #6b7687; font-size: .8rem; }
+ #login { max-width: 21rem; margin: 14vh auto; padding: 1.4rem;
+          background: #171f29; border: 1px solid #263041;
+          border-radius: .5rem; }
+ #login input { width: 100%; box-sizing: border-box; margin: .25rem 0;
+   padding: .5rem; background: #10151c; color: inherit;
+   border: 1px solid #33405a; border-radius: .3rem; }
+ #login button, header button { padding: .45rem .9rem; border: 0;
+   border-radius: .3rem; background: #2f6f5f; color: #fff;
+   cursor: pointer; }
+ #err { color: #e0707c; min-height: 1.2em; font-size: .85rem; }
+ main { display: none; padding: 1rem 1.2rem; }
+ .tiles { display: grid; gap: .7rem;
+          grid-template-columns: repeat(auto-fill, minmax(10rem, 1fr)); }
+ .tile { background: #171f29; border: 1px solid #263041;
+         border-radius: .5rem; padding: .7rem .9rem; }
+ .tile .v { font-size: 1.5rem; color: #7fd1b9; font-variant-numeric:
+            tabular-nums; }
+ .tile .k { color: #6b7687; font-size: .78rem; }
+ section { margin-top: 1.3rem; }
+ section h2 { font-size: .9rem; color: #9aa7b8; margin: 0 0 .4rem; }
+ table { width: 100%; border-collapse: collapse; background: #171f29;
+         border: 1px solid #263041; border-radius: .5rem; }
+ th, td { text-align: left; padding: .35rem .6rem; font-size: .82rem;
+          border-bottom: 1px solid #222b39; }
+ th { color: #6b7687; font-weight: 500; }
+ .ok { color: #7fd1b9; } .bad { color: #e0707c; }
+</style>
+</head>
+<body>
+<header>
+ <h1>emqx_tpu</h1><span class="sub" id="nodeinfo"></span>
+ <span style="flex:1"></span>
+ <button id="logout" style="display:none">log out</button>
+</header>
+<div id="login">
+ <h2 style="margin-top:0">Dashboard login</h2>
+ <input id="u" placeholder="username" value="admin" autocomplete="username">
+ <input id="p" placeholder="password" type="password"
+        autocomplete="current-password">
+ <div id="err"></div>
+ <button id="go">Log in</button>
+</div>
+<main>
+ <div class="tiles" id="tiles"></div>
+ <section><h2>Clients</h2><table id="clients"></table></section>
+ <section><h2>Subscriptions</h2><table id="subs"></table></section>
+ <section><h2>Rules</h2><table id="rules"></table></section>
+ <section><h2>Bridges</h2><table id="bridges"></table></section>
+ <section><h2>Gateways</h2><table id="gateways"></table></section>
+ <section><h2>Alarms</h2><table id="alarms"></table></section>
+</main>
+<script>
+"use strict";
+let token = sessionStorage.getItem("emqx_tpu_token") || null;
+let timer = null;
+const $ = id => document.getElementById(id);
+
+async function api(path) {
+  const r = await fetch("/api/v5" + path,
+    { headers: token ? { authorization: "Bearer " + token } : {} });
+  if (r.status === 401) { logout(); throw new Error("unauthorized"); }
+  return r.json();
+}
+
+// every API value is attacker-influenced (clientids, usernames, topics,
+// rule SQL, alarm text) — escape ALL of it before it reaches innerHTML;
+// trusted markup must be wrapped explicitly in {__html: ...}
+const esc = x => String(x).replace(/[&<>"']/g,
+  c => ({ "&": "&amp;", "<": "&lt;", ">": "&gt;",
+          '"': "&quot;", "'": "&#39;" }[c]));
+const cell = x => (x && x.__html !== undefined) ? x.__html : esc(x);
+
+function rows(tbl, head, data, cols) {
+  let h = "<tr>" + head.map(x => `<th>${esc(x)}</th>`).join("") + "</tr>";
+  for (const d of data)
+    h += "<tr>" + cols(d).map(x => `<td>${cell(x)}</td>`).join("") +
+         "</tr>";
+  $(tbl).innerHTML = h;
+}
+
+function tile(k, v) {
+  return `<div class="tile"><div class="v">${esc(v)}</div>` +
+         `<div class="k">${esc(k)}</div></div>`;
+}
+
+async function refresh() {
+  const [nodes, stats, clients, subs, rules, bridges, gws, alarms] =
+    await Promise.all([
+      api("/nodes"), api("/stats"), api("/clients?limit=20"),
+      api("/subscriptions?limit=20"), api("/rules"), api("/bridges"),
+      api("/gateways").catch(() => ({ data: [] })),
+      api("/alarms").catch(() => ({ data: [] })),
+    ]);
+  const n0 = (Array.isArray(nodes) ? nodes[0] : nodes) || {};
+  $("nodeinfo").textContent =
+    `${n0.node || ""} · v${n0.version || ""} · up ` +
+    `${Math.round(n0.uptime || 0)}s`;
+  const s = stats;
+  $("tiles").innerHTML =
+    tile("connections", s["connections.count"] ?? 0) +
+    tile("sessions", s["sessions.count"] ?? 0) +
+    tile("subscriptions", s["subscriptions.count"] ?? 0) +
+    tile("topics", s["topics.count"] ?? 0) +
+    tile("retained", s["retained.count"] ?? 0) +
+    tile("rules", (rules.data || rules || []).length) +
+    tile("bridges", (bridges.data || bridges || []).length);
+  rows("clients", ["clientid", "username", "peer", "clean", "proto"],
+       clients.data || [],
+       c => [c.clientid, c.username ?? "", c.peerhost ?? "",
+             c.clean_start ?? "", c.proto_ver ?? ""]);
+  rows("subs", ["clientid", "topic", "qos"], subs.data || [],
+       x => [x.clientid, x.topic, x.qos]);
+  rows("rules", ["id", "sql", "actions", "enabled"],
+       rules.data || rules || [],
+       r => [r.id, r.sql ?? r.rawsql ?? "", (r.actions || []).join(", "),
+             r.enable ?? true]);
+  rows("bridges", ["id", "status", "queuing", "success", "failed"],
+       bridges.data || bridges || [],
+       b => [`${b.type}:${b.name}`,
+             { __html:
+               `<span class="${b.status === "connected" ? "ok" : "bad"}">`
+               + `${esc(b.status)}</span>` }, b.queuing ?? 0,
+             (b.metrics || {}).success ?? 0,
+             (b.metrics || {}).failed ?? 0]);
+  rows("gateways", ["name", "status", "clients"], gws.data || gws || [],
+       g => [g.name, g.status ?? "", g.current_connections ?? 0]);
+  rows("alarms", ["name", "message", "time"], alarms.data || alarms || [],
+       a => [a.name, a.message ?? "", a.activate_at ?? a.time ?? ""]);
+}
+
+function show(loggedIn) {
+  $("login").style.display = loggedIn ? "none" : "block";
+  document.querySelector("main").style.display = loggedIn ? "block" : "none";
+  $("logout").style.display = loggedIn ? "inline-block" : "none";
+}
+
+function logout() {
+  if (token) fetch("/api/v5/logout",
+    { method: "POST", headers: { authorization: "Bearer " + token } });
+  token = null; sessionStorage.removeItem("emqx_tpu_token");
+  clearInterval(timer); show(false);
+}
+
+async function boot() {
+  show(true);
+  try { await refresh(); } catch (e) { return; }
+  timer = setInterval(() => refresh().catch(() => {}), 5000);
+}
+
+$("go").onclick = async () => {
+  $("err").textContent = "";
+  const r = await fetch("/api/v5/login", {
+    method: "POST", headers: { "content-type": "application/json" },
+    body: JSON.stringify({ username: $("u").value, password: $("p").value }),
+  });
+  if (!r.ok) { $("err").textContent = "login failed"; return; }
+  token = (await r.json()).token;
+  sessionStorage.setItem("emqx_tpu_token", token);
+  boot();
+};
+$("p").addEventListener("keydown", e => {
+  if (e.key === "Enter") $("go").click(); });
+$("logout").onclick = logout;
+if (token) boot(); else show(false);
+</script>
+</body>
+</html>
+"""

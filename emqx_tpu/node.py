@@ -67,6 +67,12 @@ class BrokerNode:
         self.observed = observe(
             self.broker, sys_interval=cfg.get("broker.sys_msg_interval")
         )
+        # connection gauges come from the CM (a node-level table), so
+        # they wire here rather than in observe(broker)
+        self.observed.stats.provide(
+            "connections.count", self.cm.connection_count)
+        self.observed.stats.provide(
+            "live_connections.count", self.cm.connection_count)
         self.banned = Banned().attach(self.broker)
         self.flapping = Flapping(
             self.banned,
@@ -320,6 +326,12 @@ class BrokerNode:
                 ctx.load_verify_locations(cafile=crl)
                 check = (cfg.get("listeners.ssl.default.crl_check")
                          or "leaf").strip().lower()
+                if check not in ("leaf", "chain"):
+                    # unknown value fails CLOSED (the stricter scope) —
+                    # a typo must not silently weaken revocation
+                    log.warning("unknown crl_check %r; using 'chain'",
+                                check)
+                    check = "chain"
                 ctx.verify_flags |= (
                     _ssl.VERIFY_CRL_CHECK_CHAIN if check == "chain"
                     else _ssl.VERIFY_CRL_CHECK_LEAF)
@@ -643,7 +655,8 @@ class BrokerNode:
             )
         self.mgmt_server = HttpServer(
             host or "0.0.0.0", int(port), auth=auth,
-            auth_exempt=("/api/v5/status", "/api/v5/login"),
+            auth_exempt=("/api/v5/status", "/api/v5/login",
+                         "/", "/dashboard"),
         )
         self.mgmt = MgmtApi(self, self.mgmt_server)
         await self.mgmt_server.start()

@@ -153,3 +153,33 @@ def test_dashboard_auth_enforced_by_default_and_self_service():
             await node.stop()
 
     run(main())
+
+
+def test_dashboard_page_served_unauthenticated():
+    """GET / and /dashboard return the SPA without credentials; the data
+    endpoints stay behind auth."""
+    async def main():
+        node = BrokerNode(Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'dashboard.enable = true\n'
+            'dashboard.listen = "127.0.0.1:0"\n'
+            'api_key.enable = true\n'
+            'api_key.key = "k"\napi_key.secret = "s"\n')))
+        await node.start()
+        try:
+            base = f"http://127.0.0.1:{node.mgmt_server.port}"
+            for path in ("/", "/dashboard"):
+                r = await httpc.request("GET", base + path)
+                assert r.status == 200
+                body = r.body.decode()
+                assert r.headers.get("content-type",
+                                     "").startswith("text/html")
+                assert "/api/v5/login" in body
+                assert "emqx_tpu" in body
+            # data endpoint still requires auth
+            r = await httpc.request("GET", base + "/api/v5/stats")
+            assert r.status == 401
+        finally:
+            await node.stop()
+
+    run(main())

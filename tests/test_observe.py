@@ -111,3 +111,39 @@ def test_sys_messages_do_not_count_as_received():
     # but the subscriber saw the $SYS publishes
     sess = b.sessions["s"]
     assert sess is not None
+
+
+def test_connections_count_tracks_live_channels():
+    import asyncio
+
+    """connections.count / live_connections.count come from the CM —
+    regression: they were never wired and stayed 0 (found driving the
+    dashboard against a live node)."""
+    async def main():
+        from emqx_tpu.client import Client
+        from emqx_tpu.config import Config
+        from emqx_tpu.node import BrokerNode
+
+        node = BrokerNode(Config(
+            file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n'))
+        await node.start()
+        try:
+            port = node.listeners.all()[0].port
+            cs = []
+            for i in range(3):
+                c = Client(clientid=f"cc{i}", port=port)
+                await c.connect()
+                cs.append(c)
+            stats = node.observed.stats.all()
+            assert stats["connections.count"] == 3
+            assert stats["live_connections.count"] == 3
+            assert stats["connections.max"] >= 3
+            await cs[0].disconnect()
+            await asyncio.sleep(0.05)
+            assert node.observed.stats.all()["connections.count"] == 2
+            for c in cs[1:]:
+                await c.disconnect()
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
