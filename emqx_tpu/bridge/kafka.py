@@ -117,7 +117,8 @@ def _record(offset_delta: int, ts_delta: int, key: Optional[bytes],
 
 
 def record_batch(records: List[Tuple[Optional[bytes], bytes]],
-                 base_ts_ms: Optional[int] = None) -> bytes:
+                 base_ts_ms: Optional[int] = None,
+                 base_offset: int = 0) -> bytes:
     """Record batch v2 (magic 2), uncompressed, producer-id-less."""
     ts = int(base_ts_ms if base_ts_ms is not None else time.time() * 1e3)
     recs = b"".join(
@@ -129,12 +130,42 @@ def record_batch(records: List[Tuple[Optional[bytes], bytes]],
     crc = crc32c(after_crc)
     head = struct.pack("!iBI", -1, 2, crc)             # epoch, magic, crc
     body = head + after_crc
-    return struct.pack("!qi", 0, len(body)) + body     # baseOffset, len
+    return struct.pack("!qi", base_offset, len(body)) + body
 
 
-def parse_record_batch(data: bytes) -> List[Tuple[Optional[bytes], bytes]]:
-    """Decode one batch (test servers + loopback verification); checks
-    the CRC."""
+def parse_batches(data: bytes) -> Tuple[
+        List[Tuple[int, Optional[bytes], bytes]], int, int]:
+    """Decode a CONCATENATED batch stream (a Fetch response's records
+    field) -> ([(offset, key, value)], next_fetch_offset, n_skipped).
+    Truncated trailing bytes (partial batch at max_bytes) are ignored,
+    as consumers must.  Compressed batches (no codecs in this
+    environment) and control batches are SKIPPED but still advance the
+    fetch offset via the header's lastOffsetDelta — a skip must never
+    stall the consumer; ``n_skipped`` lets callers log the gap."""
+    out: List[Tuple[int, Optional[bytes], bytes]] = []
+    next_off = 0
+    skipped = 0
+    pos = 0
+    while pos + 12 <= len(data):
+        base, blen = struct.unpack_from("!qi", data, pos)
+        if pos + 12 + blen > len(data):
+            break                                      # partial batch
+        last_delta, recs = _parse_batch_full(data[pos:pos + 12 + blen])
+        if recs is None:
+            skipped += 1
+        else:
+            out.extend((base + d, k, v) for d, k, v in recs)
+        next_off = base + last_delta + 1
+        pos += 12 + blen
+    return out, next_off, skipped
+
+
+def _parse_batch_full(data: bytes) -> Tuple[
+        int, Optional[List[Tuple[int, Optional[bytes], bytes]]]]:
+    """One batch -> (lastOffsetDelta, records|None).  Records carry
+    their own offset DELTAS (compacted topics have sparse deltas — a
+    dense enumerate() would re-fetch the same batch forever).  None
+    records = compressed/control batch (undecodable/marker)."""
     base_off, blen = struct.unpack_from("!qi", data, 0)
     epoch, magic, crc = struct.unpack_from("!iBI", data, 12)
     if magic != 2:
@@ -144,13 +175,15 @@ def parse_record_batch(data: bytes) -> List[Tuple[Optional[bytes], bytes]]:
         raise KafkaError("record batch crc mismatch")
     (attrs, last_delta, t0, t1, pid, peph, seq,
      n) = struct.unpack_from("!hiqqqhii", after, 0)
+    if attrs & 0x07 or attrs & 0x20:   # compression codec / control bit
+        return last_delta, None
     off = struct.calcsize("!hiqqqhii")
-    out = []
+    out: List[Tuple[int, Optional[bytes], bytes]] = []
     for _ in range(n):
         _, off = read_varint(after, off)               # record length
         off += 1                                       # attributes
         _, off = read_varint(after, off)               # ts delta
-        _, off = read_varint(after, off)               # offset delta
+        delta, off = read_varint(after, off)           # offset delta
         klen, off = read_varint(after, off)
         key = None
         if klen >= 0:
@@ -165,8 +198,17 @@ def parse_record_batch(data: bytes) -> List[Tuple[Optional[bytes], bytes]]:
             off += hk
             hv, off = read_varint(after, off)
             off += max(0, hv)
-        out.append((key, val))
-    return out
+        out.append((delta, key, val))
+    return last_delta, out
+
+
+def parse_record_batch(data: bytes) -> List[Tuple[Optional[bytes], bytes]]:
+    """Decode one batch (test servers + loopback verification); checks
+    the CRC."""
+    _, recs = _parse_batch_full(data)
+    if recs is None:
+        raise KafkaError("compressed/control batch")
+    return [(k, v) for _, k, v in recs]
 
 
 RETRIABLE_ERRORS = {5, 6, 7, 9, 19}  # leader/broker transitions, timeouts
@@ -282,6 +324,62 @@ class KafkaClient(LazyTcpClient):
                 return base
         raise KafkaError("empty produce response")
 
+    # -- ListOffsets v1 -----------------------------------------------------
+
+    async def list_offset(self, topic: str, partition: int,
+                          at: int = -1) -> int:
+        """-1 = latest, -2 = earliest (the Kafka sentinel timestamps)."""
+        body = (struct.pack("!i", -1)                  # replica_id
+                + struct.pack("!i", 1) + _str(topic)
+                + struct.pack("!i", 1)
+                + struct.pack("!iq", partition, at))
+        p = await self._request(2, 1, body)
+        off = 4                                        # topic array len
+        (sl,) = struct.unpack_from("!h", p, off)
+        off += 2 + sl + 4                              # name + part count
+        pid, err, ts, offset = struct.unpack_from("!ihqq", p, off)
+        if err:
+            raise KafkaError(f"list_offsets error {err}")
+        return offset
+
+    # -- Fetch v4 -----------------------------------------------------------
+
+    async def fetch(self, topic: str, partition: int, offset: int,
+                    max_wait_ms: int = 500, max_bytes: int = 1 << 20
+                    ) -> Tuple[List[Tuple[int, Optional[bytes], bytes]],
+                               int]:
+        """-> ([(offset, key, value)], next_offset)."""
+        body = (struct.pack("!iiiiB", -1, max_wait_ms, 1, max_bytes, 0)
+                + struct.pack("!i", 1) + _str(topic)
+                + struct.pack("!i", 1)
+                + struct.pack("!iqi", partition, offset, max_bytes))
+        p = await self._request(1, 4, body)
+        off = 4                                        # throttle
+        off += 4                                       # topic array len
+        (sl,) = struct.unpack_from("!h", p, off)
+        off += 2 + sl + 4                              # name + part count
+        pid, err, hwm, lso = struct.unpack_from("!ihqq", p, off)
+        off += 4 + 2 + 8 + 8
+        (n_aborted,) = struct.unpack_from("!i", p, off)
+        off += 4 + max(0, n_aborted) * 16
+        (rlen,) = struct.unpack_from("!i", p, off)
+        off += 4
+        if err:
+            e = KafkaError(f"fetch error {err} on {topic}/{pid}")
+            e.code = err
+            raise e
+        if rlen <= 0:
+            return [], offset
+        records, next_off, skipped = parse_batches(p[off:off + rlen])
+        if skipped:
+            log.warning("fetch %s/%d: skipped %d compressed/control "
+                        "batch(es) (no codecs in this environment)",
+                        topic, pid, skipped)
+        # batches can start before the requested offset (compaction);
+        # drop the leading overlap
+        records = [(o, k, v) for o, k, v in records if o >= offset]
+        return records, max(next_off, offset)
+
 
 def render_kafka(conf: Dict[str, Any], output: Dict[str, Any],
                  columns: Dict[str, Any]) -> Dict[str, Any]:
@@ -308,11 +406,20 @@ def render_kafka(conf: Dict[str, Any], output: Dict[str, Any],
 
 
 class KafkaConnector(Connector):
-    """Buffered-worker connector: batches items into record batches."""
+    """Buffered-worker connector: batches items into record batches.
 
-    def __init__(self, conf: Dict[str, Any], name: str = "") -> None:
+    ``conf["ingress"]`` turns on the consumer side (the
+    emqx_bridge_kafka_consumer analog): ``{topic?, partitions?: [..],
+    start: "latest"|"earliest", local_topic, payload?, local_qos?,
+    poll_interval?}`` — fetched records republish through
+    ``local_publish``.  Plain Fetch (no consumer-group coordination: one
+    broker node owns the bridge; cluster takeover restarts it)."""
+
+    def __init__(self, conf: Dict[str, Any], name: str = "",
+                 local_publish: Optional[Any] = None) -> None:
         self.conf = conf
         self.name = name
+        self.local_publish = local_publish
         self.topic = conf.get("topic", "emqx")
         self.acks = int(conf.get("acks", 1))
         self.client = KafkaClient(
@@ -321,12 +428,125 @@ class KafkaConnector(Connector):
             timeout=float(conf.get("timeout", 5.0)))
         self.n_partitions = 1
         self._rr = 0
+        self._poll_task: Optional[asyncio.Task] = None
+        self.consumed = 0
+        self.offsets: Dict[int, int] = {}
 
     async def start(self) -> None:
         self.n_partitions = await self.client.partitions(self.topic)
+        ing = self.conf.get("ingress")
+        if ing and self.local_publish is not None \
+                and self._poll_task is None:
+            self._poll_task = asyncio.create_task(self._poll_forever(ing))
 
     async def stop(self) -> None:
+        if self._poll_task is not None:
+            self._poll_task.cancel()
+            try:
+                await self._poll_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._poll_task = None
         await self.client.close()
+
+    # -- consumer side ------------------------------------------------------
+
+    async def _poll_forever(self, ing: Dict[str, Any]) -> None:
+        """Supervisor: the poll loop must survive broker restarts,
+        half-closed sockets (IncompleteReadError) and startup races —
+        any death restarts it with backoff.  (The producer-side
+        health() does not cover this task.)"""
+        backoff = 0.5
+        while True:
+            try:
+                await self._poll_loop(ing)
+                return                       # only via CancelledError
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001
+                log.warning("kafka ingress %s loop died (%s: %s); "
+                            "restarting in %.1fs", self.name,
+                            type(e).__name__, e, backoff)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+
+    async def _poll_loop(self, ing: Dict[str, Any]) -> None:
+        from ..rule_engine.runtime import render_template
+
+        # a dedicated connection: fetch long-polls (max_wait) must not
+        # block the producer's requests behind the per-client lock
+        consumer = KafkaClient(
+            self.conf.get("server", "127.0.0.1:9092"),
+            client_id=f"emqx_tpu:{self.name}:consumer",
+            timeout=float(self.conf.get("timeout", 5.0)))
+        topic = ing.get("topic", self.topic)
+        interval = float(ing.get("poll_interval", 0.2))
+        start = str(ing.get("start", "latest"))
+        at = -2 if start == "earliest" else -1
+        try:
+            nparts = await consumer.partitions(topic)
+            parts = [int(p) for p in ing.get(
+                "partitions", range(nparts))]
+            for p in parts:
+                if p not in self.offsets:
+                    self.offsets[p] = await consumer.list_offset(
+                        topic, p, at)
+            while True:
+                got = 0
+                for p in parts:
+                    try:
+                        records, nxt = await consumer.fetch(
+                            topic, p, self.offsets[p])
+                    except KafkaError as e:
+                        if getattr(e, "code", None) == 1:
+                            # OFFSET_OUT_OF_RANGE: retention deleted our
+                            # position — re-seek (auto.offset.reset)
+                            self.offsets[p] = await consumer.list_offset(
+                                topic, p, at)
+                            log.warning(
+                                "kafka ingress %s: offset out of range "
+                                "on %s/%d; reset to %d", self.name,
+                                topic, p, self.offsets[p])
+                            continue
+                        log.warning("kafka ingress %s fetch: %s",
+                                    self.name, e)
+                        await asyncio.sleep(interval)
+                        continue
+                    except (OSError, EOFError,
+                            asyncio.TimeoutError) as e:
+                        log.warning("kafka ingress %s fetch: %s",
+                                    self.name, e)
+                        await asyncio.sleep(interval)
+                        continue
+                    for o, k, v in records:
+                        cols = {"topic": topic, "partition": p,
+                                "offset": o,
+                                "key": (k or b"").decode("utf-8",
+                                                         "replace"),
+                                "value": v}
+                        ltopic = render_template(
+                            ing.get("local_topic",
+                                    "kafka/${topic}/${partition}"),
+                            cols, cols)
+                        payload_t = ing.get("payload")
+                        payload = (render_template(
+                            payload_t, cols, cols).encode()
+                            if payload_t else v)
+                        try:
+                            self.local_publish(
+                                ltopic, payload,
+                                qos=int(ing.get("local_qos", 0)))
+                        except Exception:
+                            log.exception("kafka ingress %s publish",
+                                          self.name)
+                        self.consumed += 1
+                    got += len(records)
+                    self.offsets[p] = nxt
+                if not got:
+                    await asyncio.sleep(interval)
+        finally:
+            # errors propagate to _poll_forever, which restarts us
+            await consumer.close()
 
     async def health(self) -> bool:
         try:

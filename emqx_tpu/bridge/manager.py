@@ -104,23 +104,25 @@ class BridgeManager:
     # -- construction ------------------------------------------------------
 
     def _build(self, btype: str, name: str, conf: Dict[str, Any]) -> Bridge:
-        if btype == "mqtt":
-            local_publish = None
-            if self.node is not None:
-                def local_publish(topic, payload, qos=0, retain=False):
-                    from ..broker.message import make_message
+        local_publish = None
+        if self.node is not None:
+            def local_publish(topic, payload, qos=0, retain=False):
+                from ..broker.message import make_message
 
-                    self.node.broker.publish(make_message(
-                        f"bridge:{name}", topic, payload,
-                        qos=qos, retain=retain,
-                    ))
+                self.node.broker.publish(make_message(
+                    f"bridge:{name}", topic, payload,
+                    qos=qos, retain=retain,
+                ))
+        if btype == "mqtt":
             conn = MqttConnector(conf, local_publish=local_publish, name=name)
             return Bridge(btype, name, conf, conn, render_egress)
         if btype == "webhook":
             return Bridge(btype, name, conf, WebhookConnector(conf, name),
                           render_webhook)
         if btype == "kafka":
-            return Bridge(btype, name, conf, KafkaConnector(conf, name),
+            return Bridge(btype, name, conf,
+                          KafkaConnector(conf, name,
+                                         local_publish=local_publish),
                           render_kafka)
         raise ValueError(f"unknown bridge type {btype!r}")
 

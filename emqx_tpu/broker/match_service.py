@@ -320,14 +320,18 @@ class MatchService:
     def _warm(self) -> None:
         from ..ops import encode_batch
 
+        # flat_cap is a jit STATIC arg — warming without it would
+        # compile the wrong variant and the first live batch would still
+        # stall on an XLA compile
         words, lens, is_sys = encode_batch(self.inc, [], batch=64)
-        self.dev.match(words, lens, is_sys)
+        self.dev.match(words, lens, is_sys,
+                       flat_cap=self.FLAT_MULT * 64)
         if self.short_depth and self.short_depth < self.depth:
             # pre-pay the short-depth kernel shape too, or the first
             # split batch stalls the serving loop on an XLA compile
             w, l, sy = encode_batch(self.inc, [], batch=64,
                                     depth=self.short_depth)
-            self.dev.match(w, l, sy)
+            self.dev.match(w, l, sy, flat_cap=self.FLAT_MULT * 64)
 
     # ------------------------------------------------------------------
     # rule-engine co-batching (BASELINE config 3)
@@ -511,13 +515,21 @@ class MatchService:
                 rules.update(r)
         return filters, sorted(rules)
 
+    # flat-output capacity per padded batch row: readback is the serving
+    # bottleneck on remote-attached devices (BASELINE.md tunnel table),
+    # and ~6 ids/topic covers the workload's fan-out tail
+    from ..ops.match_kernel import SERVE_FLAT_MULT as FLAT_MULT
+
     def _device_rows(self, enc, n: int):
-        res = self.dev.match(*enc)
-        return self._readback_rows(res, n)
+        B = enc[0].shape[0]
+        res = self.dev.match(*enc, flat_cap=self.FLAT_MULT * B)
+        return self._readback_rows(res, n, self.dev.max_matches)
 
     @staticmethod
-    def _readback_rows(res, n: int):
+    def _readback_rows(res, n: int, k: int):
         import jax
+
+        from ..ops.match_kernel import decode_flat
 
         # fetch the kernel's own outputs and OR the spill flags on host:
         # res.spilled_rows() would build NEW lazy device ops here, i.e.
@@ -527,7 +539,8 @@ class MatchService:
              res.match_overflow)
         )
         sp = (aover > 0) | (mover > 0)
-        rows = [matches[r, : counts[r]].tolist() for r in range(n)]
+        rows = [seg.tolist()
+                for seg in decode_flat(matches, counts, k)[:n]]
         return rows, np.flatnonzero(sp[:n]).tolist()
 
     def _device_rows_grouped(self, encs):
@@ -535,8 +548,13 @@ class MatchService:
         device lock), then read back — group 2 executes on device while
         group 1's results stream back, so a depth split costs one extra
         dispatch, not a second full round trip."""
-        handles = [(self.dev.match(*enc), n) for enc, n in encs]
-        return [self._readback_rows(res, n) for res, n in handles]
+        handles = [
+            (self.dev.match(
+                *enc, flat_cap=self.FLAT_MULT * enc[0].shape[0]), n)
+            for enc, n in encs
+        ]
+        return [self._readback_rows(res, n, self.dev.max_matches)
+                for res, n in handles]
 
     def _depth_groups(self, topics: List[str]) -> List[Tuple[List[int], int]]:
         """Partition batch indices into (indices, kernel_depth) groups.

@@ -286,8 +286,11 @@ class TpuMatchSidecar:
         """Warm the match jit for the smallest batch bucket (larger
         buckets compile on first use).  Uses pre-encoded inert rows so no
         live host state is read off-loop."""
+        from ..ops.match_kernel import SERVE_FLAT_MULT
+
         words, lens, is_sys = eng.encode([], 64)  # inert padding rows
-        eng.dev.match(words, lens, is_sys)
+        # flat_cap is jit-static: warm the SAME variant serving uses
+        eng.dev.match(words, lens, is_sys, flat_cap=SERVE_FLAT_MULT * 64)
 
     def _save_checkpoint(self) -> None:
         try:
@@ -317,11 +320,18 @@ class TpuMatchSidecar:
 
     def _device_rows(self, eng: _IncEngine, enc, n: int):
         """WORKER THREAD: kernel dispatch + readback.  Returns (rows,
-        spilled_row_indexes).  ONE bundled device→host fetch: on a
-        remote-attached device every separate fetch pays a relay RTT."""
+        spilled_row_indexes).  ONE bundled device→host fetch of the
+        FLAT-compacted output (~fan-out·4 bytes/topic instead of K·4):
+        on a remote-attached device readback bytes are the serving
+        bottleneck (BASELINE.md tunnel table)."""
         import jax
 
-        res = eng.dev.match(*enc)
+        from ..ops.match_kernel import decode_flat
+
+        from ..ops.match_kernel import SERVE_FLAT_MULT
+
+        B = enc[0].shape[0]
+        res = eng.dev.match(*enc, flat_cap=SERVE_FLAT_MULT * B)
         # OR the spill flags on host — res.spilled_rows() would build new
         # lazy device ops, adding a dispatch round trip to every readback
         matches, counts, aover, mover = jax.device_get(
@@ -329,7 +339,9 @@ class TpuMatchSidecar:
              res.match_overflow)
         )
         sp = (aover > 0) | (mover > 0)
-        rows = [matches[r, : counts[r]].tolist() for r in range(n)]
+        rows = [seg.tolist()
+                for seg in decode_flat(matches, counts,
+                                       eng.dev.max_matches)[:n]]
         return rows, np.flatnonzero(sp[:n]).tolist()
 
     async def _match_rows(self, topics: List[str]) -> List[List[int]]:

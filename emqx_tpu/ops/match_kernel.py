@@ -45,8 +45,13 @@ import numpy as np
 
 from .compiler import BUCKET_SLOTS, NfaTable, encode_topics
 
-__all__ = ["MatchResult", "build_matcher", "decode_flat", "match_topics",
-           "nfa_match"]
+__all__ = ["MatchResult", "SERVE_FLAT_MULT", "build_matcher",
+           "decode_flat", "match_topics", "nfa_match"]
+
+# serving flat-output capacity per padded batch row (ids/topic): shared
+# by every serving engine so the fan-out tuning cannot drift between
+# the in-process MatchService, the exhook sidecar, and bench.py
+SERVE_FLAT_MULT = 6
 
 
 class MatchResult(NamedTuple):

@@ -9,8 +9,8 @@ import struct
 import pytest
 
 from emqx_tpu.bridge.kafka import (
-    KafkaClient, KafkaConnector, crc32c, parse_record_batch, record_batch,
-    render_kafka,
+    KafkaClient, KafkaConnector, crc32c, parse_batches,
+    parse_record_batch, record_batch, render_kafka,
 )
 from emqx_tpu.client import Client
 from emqx_tpu.config import Config
@@ -84,6 +84,38 @@ class MockKafka:
                                         struct.pack("!ii", 1, 0),
                                         struct.pack("!ii", 1, 0)]
                         payload = b"".join(out)
+                    elif api == 2:                  # ListOffsets v1
+                        off = 4                     # replica_id
+                        off += 4                    # topic count (1)
+                        (sl,) = struct.unpack_from("!h", body, off)
+                        off += 2
+                        topic = body[off:off + sl].decode()
+                        off += sl + 4               # partition count (1)
+                        part, ts = struct.unpack_from("!iq", body, off)
+                        n = len(self.records.get((topic, part), []))
+                        o = 0 if ts == -2 else n
+                        payload = (struct.pack("!i", 1) + _str(topic)
+                                   + struct.pack("!i", 1)
+                                   + struct.pack("!ihqq", part, 0, -1, o))
+                    elif api == 1:                  # Fetch v4
+                        off = 4 + 4 + 4 + 4 + 1     # replica..isolation
+                        off += 4                    # topic count (1)
+                        (sl,) = struct.unpack_from("!h", body, off)
+                        off += 2
+                        topic = body[off:off + sl].decode()
+                        off += sl + 4               # partition count (1)
+                        part, fo, mb = struct.unpack_from("!iqi", body, off)
+                        recs = self.records.get((topic, part), [])
+                        chunk = recs[fo:fo + 50]
+                        blob = (record_batch(chunk, base_offset=fo)
+                                if chunk else b"")
+                        payload = (struct.pack("!i", 0)      # throttle
+                                   + struct.pack("!i", 1) + _str(topic)
+                                   + struct.pack("!i", 1)
+                                   + struct.pack("!ihqq", part, 0,
+                                                 len(recs), len(recs))
+                                   + struct.pack("!i", 0)    # aborted
+                                   + struct.pack("!i", len(blob)) + blob)
                     elif api == 0:                  # Produce v3
                         off = 0
                         (tl,) = struct.unpack_from("!h", body, off)
@@ -302,5 +334,109 @@ def test_acks_zero_fire_and_forget():
         assert [v for _, v in mk.records[("emqx", 0)]] == [b"f0", b"f1"]
         await c.close()
         await mk.stop()
+
+    run(main())
+
+
+def test_parse_batches_concatenated_and_partial():
+    b1 = record_batch([(b"k0", b"v0"), (None, b"v1")], base_offset=10)
+    b2 = record_batch([(b"k2", b"v2")], base_offset=12)
+    recs, nxt, skipped = parse_batches(b1 + b2)
+    assert recs == [(10, b"k0", b"v0"), (11, None, b"v1"),
+                    (12, b"k2", b"v2")]
+    assert nxt == 13 and skipped == 0
+    # truncated tail batch is ignored
+    recs, nxt, _ = parse_batches(b1 + b2[: len(b2) // 2])
+    assert [o for o, _, _ in recs] == [10, 11] and nxt == 12
+
+
+def test_parse_batches_skips_compressed_and_control():
+    import struct as S
+
+    b1 = record_batch([(None, b"plain")], base_offset=0)
+    # forge a gzip-flagged batch: flip the attrs bits and re-CRC
+    comp = bytearray(record_batch([(None, b"zzz")], base_offset=1))
+    after = bytearray(comp[21:])
+    S.pack_into("!h", after, 0, 1)                 # attrs: gzip codec
+    S.pack_into("!I", comp, 17, crc32c(bytes(after)))
+    comp[21:] = after
+    recs, nxt, skipped = parse_batches(b1 + bytes(comp))
+    assert [v for _, _, v in recs] == [b"plain"]
+    assert nxt == 2 and skipped == 1               # advanced PAST the skip
+
+
+def test_parse_batches_sparse_compaction_deltas():
+    """Compacted batches keep per-record offset deltas; a dense
+    enumerate() would loop forever re-fetching the same batch."""
+    import struct as S
+
+    # build a batch with record deltas [0, 5] and lastOffsetDelta 5
+    raw = bytearray(record_batch([(None, b"a"), (None, b"b")],
+                                 base_offset=100))
+    from emqx_tpu.bridge.kafka import _record
+    recs = _record(0, 0, None, b"a") + _record(5, 0, None, b"b")
+    head = bytes(raw[21:21 + S.calcsize('!hiqqqhii')])
+    after2 = bytearray(head + recs)
+    S.pack_into("!i", after2, 2, 5)
+    crc = crc32c(bytes(after2))
+    body = S.pack("!iBI", -1, 2, crc) + bytes(after2)
+    batch = S.pack("!qi", 100, len(body)) + body
+    out, nxt, skipped = parse_batches(batch)
+    assert [o for o, _, _ in out] == [100, 105]
+    assert nxt == 106 and skipped == 0
+
+
+def test_client_fetch_and_list_offsets():
+    async def main():
+        mk = await MockKafka().start()
+        c = KafkaClient(f"127.0.0.1:{mk.port}")
+        await c.produce("emqx", 0, [(None, b"a"), (None, b"b")])
+        await c.produce("emqx", 0, [(None, b"c")])
+        assert await c.list_offset("emqx", 0, -2) == 0   # earliest
+        assert await c.list_offset("emqx", 0, -1) == 3   # latest
+        recs, nxt = await c.fetch("emqx", 0, 1)
+        assert [(o, v) for o, _, v in recs] == [(1, b"b"), (2, b"c")]
+        assert nxt == 3
+        recs, nxt = await c.fetch("emqx", 0, 3)
+        assert recs == [] and nxt == 3
+        await c.close()
+        await mk.stop()
+
+    run(main())
+
+
+def test_kafka_ingress_republishes_into_broker():
+    async def main():
+        mk = await MockKafka(topics={"cmds": 1}).start()
+        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            await node.bridges.create("kafka", "in", {
+                "server": f"127.0.0.1:{mk.port}",
+                "topic": "cmds",
+                "ingress": {
+                    "start": "earliest",
+                    "local_topic": "from-kafka/${topic}/${partition}",
+                    "poll_interval": 0.05,
+                },
+            })
+            sub = Client(clientid="s", port=node.listeners.all()[0].port)
+            await sub.connect()
+            await sub.subscribe("from-kafka/#", qos=0)
+            # a remote producer writes into Kafka
+            prod = KafkaClient(f"127.0.0.1:{mk.port}")
+            await prod.produce("cmds", 0, [(b"dev1", b"reboot")])
+            msg = await asyncio.wait_for(sub.messages.get(), 10)
+            assert msg.topic == "from-kafka/cmds/0"
+            assert msg.payload == b"reboot"
+            br = node.bridges.get("kafka:in")
+            assert br.connector.consumed == 1
+            assert br.connector.offsets == {0: 1}
+            await prod.close()
+            await sub.disconnect()
+        finally:
+            await node.stop()
+            await mk.stop()
 
     run(main())
