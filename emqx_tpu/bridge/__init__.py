@@ -7,6 +7,10 @@ producer) are the connectors, :mod:`manager` wires bridges into rules
 and REST.
 """
 
+from .db import (
+    InfluxBridgeConnector, MongoBridgeConnector, PostgresBridgeConnector,
+    RedisBridgeConnector,
+)
 from .kafka import KafkaConnector, crc32c, render_kafka
 from .manager import Bridge, BridgeManager
 from .resource import BufferedWorker, Connector, SendError
@@ -14,4 +18,6 @@ from .resource import BufferedWorker, Connector, SendError
 __all__ = [
     "Bridge", "BridgeManager", "BufferedWorker", "Connector", "SendError",
     "KafkaConnector", "crc32c", "render_kafka",
+    "RedisBridgeConnector", "PostgresBridgeConnector",
+    "MongoBridgeConnector", "InfluxBridgeConnector",
 ]

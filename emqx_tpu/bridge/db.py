@@ -1,0 +1,272 @@
+"""Database egress bridges: Redis, PostgreSQL, MongoDB, InfluxDB.
+
+Behavioral reference: ``apps/emqx_bridge_redis``, ``emqx_bridge_pgsql``,
+``emqx_bridge_mongodb``, ``emqx_bridge_influxdb`` [U] (SURVEY.md §2.3) —
+rule output → buffered worker → templated write into the store.  Each
+connector reuses the corresponding minimal wire client that the auth
+backends / http layer already ship (RESP2, PG v3 extended query with
+bind parameters, OP_MSG/BSON, HTTP line protocol) — one protocol
+implementation per store, shared between auth and bridges.
+
+Templating: ``${field}`` through the rule engine's shared
+``render_template`` (single scan, dotted paths).  The PostgreSQL bridge
+templates VALUES through **bind parameters**, never SQL splicing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+from typing import Any, Dict, List, Optional
+
+from .resource import Connector, SendError
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "RedisBridgeConnector", "render_redis",
+    "PostgresBridgeConnector", "render_pg",
+    "MongoBridgeConnector", "render_mongo",
+    "InfluxBridgeConnector", "render_influx",
+]
+
+
+def _render(tpl: str, output: Dict[str, Any], columns: Dict[str, Any]):
+    from ..rule_engine.runtime import render_template
+
+    return render_template(tpl, output, columns)
+
+
+# ---------------------------------------------------------------------------
+# Redis: templated command, e.g. ["LPUSH", "q:${topic}", "${payload}"]
+# ---------------------------------------------------------------------------
+
+def render_redis(conf: Dict[str, Any], output: Dict[str, Any],
+                 columns: Dict[str, Any]) -> Dict[str, Any]:
+    cmd_tpl = conf.get("command", ["LPUSH", "emqx:${topic}", "${payload}"])
+    return {"cmd": [_render(str(part), output, columns)
+                    for part in cmd_tpl]}
+
+
+class RedisBridgeConnector(Connector):
+    def __init__(self, conf: Dict[str, Any], name: str = "") -> None:
+        from ..auth.redis import RespClient
+
+        self.client = RespClient(
+            conf.get("server", "127.0.0.1:6379"),
+            password=conf.get("password"),
+            database=int(conf.get("database", 0)),
+            timeout=float(conf.get("timeout", 5.0)))
+
+    async def start(self) -> None:
+        await self.client.cmd("PING")
+
+    async def stop(self) -> None:
+        await self.client.aclose()
+
+    async def health(self) -> bool:
+        try:
+            return (await self.client.cmd("PING")) in ("PONG", b"PONG")
+        except Exception:
+            return False
+
+    async def send(self, items: List[Dict[str, Any]]) -> Optional[int]:
+        for i, it in enumerate(items):
+            try:
+                await self.client.cmd(*it["cmd"])
+            except Exception as e:
+                raise SendError(f"redis bridge: {e}", done=i) from e
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# PostgreSQL: INSERT with bind parameters
+# ---------------------------------------------------------------------------
+
+def render_pg(conf: Dict[str, Any], output: Dict[str, Any],
+              columns: Dict[str, Any]) -> Dict[str, Any]:
+    """Each parameter template renders per message; the SQL itself is
+    static (compiled once with $1..$n placeholders)."""
+    params = [
+        _render(str(p), output, columns)
+        for p in conf.get("parameters",
+                          ["${clientid}", "${topic}", "${payload}"])
+    ]
+    return {"params": params}
+
+
+class PostgresBridgeConnector(Connector):
+    DEFAULT_SQL = ("INSERT INTO mqtt_messages (clientid, topic, payload) "
+                   "VALUES (${1}, ${2}, ${3})")
+
+    def __init__(self, conf: Dict[str, Any], name: str = "") -> None:
+        from ..auth.postgres import PgClient
+
+        self.client = PgClient(
+            conf.get("server", "127.0.0.1:5432"),
+            user=conf.get("user", "postgres"),
+            password=conf.get("password"),
+            database=conf.get("database", "postgres"),
+            timeout=float(conf.get("timeout", 5.0)))
+        # accept both ${n} placeholders and native $n
+        self.sql = re.sub(r"\$\{(\d+)\}", r"$\1",
+                          conf.get("sql", self.DEFAULT_SQL))
+
+    async def start(self) -> None:
+        await self.client.query("SELECT 1")
+
+    async def stop(self) -> None:
+        await self.client.close()
+
+    async def health(self) -> bool:
+        try:
+            await self.client.query("SELECT 1")
+            return True
+        except Exception:
+            return False
+
+    async def send(self, items: List[Dict[str, Any]]) -> Optional[int]:
+        for i, it in enumerate(items):
+            try:
+                await self.client.query(self.sql, tuple(it["params"]))
+            except Exception as e:
+                raise SendError(f"pg bridge: {e}", done=i) from e
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# MongoDB: insert documents
+# ---------------------------------------------------------------------------
+
+def render_mongo(conf: Dict[str, Any], output: Dict[str, Any],
+                 columns: Dict[str, Any]) -> Dict[str, Any]:
+    tpl = conf.get("payload_template")
+    if tpl:
+        doc = {k: _render(str(v), output, columns)
+               for k, v in tpl.items()}
+    else:
+        doc = {}
+        for k, v in {**columns, **output}.items():
+            if isinstance(v, bytes):
+                v = v.decode("utf-8", "replace")
+            if isinstance(v, (str, int, float, bool, type(None))):
+                doc[k] = v
+            else:
+                doc[k] = json.dumps(v, default=str)
+    return {"doc": doc}
+
+
+class MongoBridgeConnector(Connector):
+    def __init__(self, conf: Dict[str, Any], name: str = "") -> None:
+        from ..auth.mongo import MongoClient
+
+        self.client = MongoClient(
+            conf.get("server", "127.0.0.1:27017"),
+            database=conf.get("database", "mqtt"),
+            timeout=float(conf.get("timeout", 5.0)))
+        self.collection = conf.get("collection", "mqtt_messages")
+
+    async def start(self) -> None:
+        await self.client.command({"ping": 1})
+
+    async def stop(self) -> None:
+        await self.client.close()
+
+    async def health(self) -> bool:
+        try:
+            await self.client.command({"ping": 1})
+            return True
+        except Exception:
+            return False
+
+    async def send(self, items: List[Dict[str, Any]]) -> Optional[int]:
+        docs = [it["doc"] for it in items]
+        try:
+            reply = await self.client.command(
+                {"insert": self.collection, "documents": docs})
+        except Exception as e:
+            raise SendError(f"mongo bridge: {e}") from e
+        n = int(reply.get("n", 0))
+        if n < len(docs):
+            # partially applied server-side: the leading n are stored
+            raise SendError(f"mongo insert applied {n}/{len(docs)}",
+                            done=n)
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# InfluxDB: v2 write API, line protocol
+# ---------------------------------------------------------------------------
+
+def _lp_escape(s: str, *, field_key: bool = False) -> str:
+    out = s.replace("\\", "\\\\").replace(",", "\\,").replace(" ", "\\ ")
+    if field_key:
+        out = out.replace("=", "\\=")
+    return out
+
+
+def render_influx(conf: Dict[str, Any], output: Dict[str, Any],
+                  columns: Dict[str, Any]) -> Dict[str, Any]:
+    """One line-protocol line: measurement,tags fields [timestamp]."""
+    measurement = _render(conf.get("measurement", "mqtt"), output, columns)
+    tags = "".join(
+        f",{_lp_escape(k, field_key=True)}="
+        f"{_lp_escape(_render(str(v), output, columns), field_key=True)}"
+        for k, v in (conf.get("tags") or {"topic": "${topic}"}).items())
+    fields = []
+    for k, v in (conf.get("fields") or {"payload": "${payload}"}).items():
+        rv = _render(str(v), output, columns)
+        # strict numeric literal only: Python float() also accepts
+        # "nan"/"inf"/"1_2", which InfluxDB rejects with a 400 that
+        # would permanently drop the whole batch
+        if re.fullmatch(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?", rv):
+            fields.append(f"{_lp_escape(k, field_key=True)}={rv}")
+        else:
+            quoted = rv.replace("\\", "\\\\").replace('"', '\\"')
+            fields.append(f'{_lp_escape(k, field_key=True)}="{quoted}"')
+    line = f"{_lp_escape(measurement)}{tags} {','.join(fields)}"
+    return {"line": line}
+
+
+class InfluxBridgeConnector(Connector):
+    def __init__(self, conf: Dict[str, Any], name: str = "") -> None:
+        base = conf.get("server", "http://127.0.0.1:8086")
+        bucket = conf.get("bucket", "mqtt")
+        org = conf.get("org", "emqx")
+        self.url = (f"{base}/api/v2/write?bucket={bucket}&org={org}"
+                    f"&precision=ms")
+        self.headers = {"content-type": "text/plain; charset=utf-8"}
+        tok = conf.get("token")
+        if tok:
+            self.headers["authorization"] = f"Token {tok}"
+        self.timeout = float(conf.get("timeout", 5.0))
+
+    async def health(self) -> bool:
+        from . import httpc
+
+        try:
+            r = await httpc.request(
+                "POST", self.url, headers=self.headers, body=b"",
+                timeout=self.timeout)
+            return r.status < 500
+        except Exception:
+            return False
+
+    async def send(self, items: List[Dict[str, Any]]) -> Optional[int]:
+        from . import httpc
+
+        body = "\n".join(it["line"] for it in items).encode()
+        try:
+            r = await httpc.request("POST", self.url,
+                                    headers=self.headers, body=body,
+                                    timeout=self.timeout)
+        except Exception as e:
+            raise SendError(f"influx bridge: {e}") from e
+        if r.status >= 500:
+            raise SendError(f"influx write {r.status}")
+        if r.status >= 400:
+            # bad line protocol: permanent — reject the whole batch
+            raise SendError(f"influx write {r.status}", retryable=False,
+                            done=len(items), rejected=len(items))
+        return 0

@@ -190,8 +190,13 @@ def _parse_batch_full(data: bytes) -> Tuple[
             key = after[off:off + klen]
             off += klen
         vlen, off = read_varint(after, off)
-        val = after[off:off + vlen]
-        off += vlen
+        if vlen >= 0:
+            val = after[off:off + vlen]
+            off += vlen
+        else:
+            val = b""              # tombstone (null value) — a negative
+                                   # slice would rewind the cursor and
+                                   # corrupt every following record
         nh, off = read_varint(after, off)
         for _ in range(nh):                            # skip headers
             hk, off = read_varint(after, off)
@@ -433,8 +438,17 @@ class KafkaConnector(Connector):
         self.offsets: Dict[int, int] = {}
 
     async def start(self) -> None:
-        self.n_partitions = await self.client.partitions(self.topic)
         ing = self.conf.get("ingress")
+        try:
+            self.n_partitions = await self.client.partitions(self.topic)
+        except KafkaError:
+            # ingress-only bridges may not have (or need) the egress
+            # topic; the consumer must still start
+            if not ing:
+                raise
+            log.warning("kafka bridge %s: egress topic %r has no "
+                        "metadata (ingress continues)", self.name,
+                        self.topic)
         if ing and self.local_publish is not None \
                 and self._poll_task is None:
             self._poll_task = asyncio.create_task(self._poll_forever(ing))
@@ -536,10 +550,10 @@ class KafkaConnector(Connector):
                             self.local_publish(
                                 ltopic, payload,
                                 qos=int(ing.get("local_qos", 0)))
+                            self.consumed += 1
                         except Exception:
                             log.exception("kafka ingress %s publish",
                                           self.name)
-                        self.consumed += 1
                     got += len(records)
                     self.offsets[p] = nxt
                 if not got:

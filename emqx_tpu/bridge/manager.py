@@ -12,6 +12,11 @@ import asyncio
 import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .db import (
+    InfluxBridgeConnector, MongoBridgeConnector, PostgresBridgeConnector,
+    RedisBridgeConnector, render_influx, render_mongo, render_pg,
+    render_redis,
+)
 from .kafka import KafkaConnector, render_kafka
 from .mqtt_bridge import MqttConnector, render_egress
 from .resource import BufferedWorker, Connector
@@ -93,7 +98,8 @@ class Bridge:
 class BridgeManager:
     """All bridges of a node; resolves rule actions ``"<type>:<name>"``."""
 
-    TYPES = ("mqtt", "webhook")
+    TYPES = ("mqtt", "webhook", "kafka", "redis", "pgsql",
+             "mongodb", "influxdb")
 
     def __init__(self, node: Any = None) -> None:
         self.node = node
@@ -124,6 +130,18 @@ class BridgeManager:
                           KafkaConnector(conf, name,
                                          local_publish=local_publish),
                           render_kafka)
+        if btype == "redis":
+            return Bridge(btype, name, conf,
+                          RedisBridgeConnector(conf, name), render_redis)
+        if btype == "pgsql":
+            return Bridge(btype, name, conf,
+                          PostgresBridgeConnector(conf, name), render_pg)
+        if btype == "mongodb":
+            return Bridge(btype, name, conf,
+                          MongoBridgeConnector(conf, name), render_mongo)
+        if btype == "influxdb":
+            return Bridge(btype, name, conf,
+                          InfluxBridgeConnector(conf, name), render_influx)
         raise ValueError(f"unknown bridge type {btype!r}")
 
     # -- CRUD --------------------------------------------------------------

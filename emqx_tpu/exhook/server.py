@@ -326,9 +326,7 @@ class TpuMatchSidecar:
         bottleneck (BASELINE.md tunnel table)."""
         import jax
 
-        from ..ops.match_kernel import decode_flat
-
-        from ..ops.match_kernel import SERVE_FLAT_MULT
+        from ..ops.match_kernel import SERVE_FLAT_MULT, decode_flat
 
         B = enc[0].shape[0]
         res = eng.dev.match(*enc, flat_cap=SERVE_FLAT_MULT * B)

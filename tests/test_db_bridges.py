@@ -1,0 +1,248 @@
+"""Database egress bridges (Redis / PostgreSQL / MongoDB / InfluxDB)
+against the SAME in-test wire-protocol mocks the auth backends use —
+rule → bridge delivery through live nodes (emqx_bridge_redis/pgsql/
+mongodb/influxdb analogs)."""
+
+import asyncio
+import json
+
+import pytest
+
+from emqx_tpu.client import Client
+from emqx_tpu.config import Config
+from emqx_tpu.node import BrokerNode
+
+from test_mongo_ldap_auth import MockMongo
+from test_sql_auth import MockPg
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_node():
+    node = BrokerNode(Config(
+        file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n'))
+    await node.start()
+    return node
+
+
+async def settle_success(br, want=1, tries=600):
+    for _ in range(tries):
+        if br.worker.metrics["success"] >= want:
+            return True
+        await asyncio.sleep(0.01)
+    return False
+
+
+class MockRedisStore:
+    """RESP2 server recording LPUSH/PING (bridge-side command subset)."""
+
+    def __init__(self):
+        self.lists = {}
+        self.port = 0
+        self._conns = set()
+
+    async def start(self):
+        async def handle(reader, writer):
+            self._conns.add(writer)
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line.startswith(b"*"):
+                        return
+                    n = int(line[1:-2])
+                    parts = []
+                    for _ in range(n):
+                        ln = int((await reader.readline())[1:-2])
+                        parts.append(await reader.readexactly(ln + 2))
+                    cmd = parts[0][:-2].decode().upper()
+                    if cmd == "PING":
+                        writer.write(b"+PONG\r\n")
+                    elif cmd == "LPUSH":
+                        key = parts[1][:-2].decode()
+                        self.lists.setdefault(key, []).insert(
+                            0, parts[2][:-2])
+                        writer.write(b":%d\r\n" % len(self.lists[key]))
+                    else:
+                        writer.write(b"-ERR unknown\r\n")
+                    await writer.drain()
+            except Exception:
+                pass
+            finally:
+                self._conns.discard(writer)
+                writer.close()
+
+        self.server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    async def stop(self):
+        for w in list(self._conns):
+            w.close()
+        self.server.close()
+        await self.server.wait_closed()
+
+
+def test_redis_bridge_lpush_via_rule():
+    async def main():
+        rs = await MockRedisStore().start()
+        node = await start_node()
+        try:
+            await node.bridges.create("redis", "rq", {
+                "server": f"127.0.0.1:{rs.port}",
+                "command": ["LPUSH", "q:${topic}", "${payload}"],
+                "resource_opts": {"batch_size": 4, "retry_base": 0.01},
+            })
+            node.rule_engine.create_rule(
+                "rr", 'SELECT topic, payload FROM "ev/#"',
+                actions=["redis:rq"])
+            pub = Client(clientid="p", port=node.listeners.all()[0].port)
+            await pub.connect()
+            await pub.publish("ev/1", b"r-payload")
+            br = node.bridges.get("redis:rq")
+            assert await settle_success(br)
+            assert rs.lists["q:ev/1"] == [b"r-payload"]
+            await pub.disconnect()
+        finally:
+            await node.stop()
+            await rs.stop()
+
+    run(main())
+
+
+def test_pgsql_bridge_insert_with_bind_params():
+    async def main():
+        inserts = []
+
+        def insert_log(params):
+            inserts.append(tuple(params))
+            return [], []
+
+        pg = await MockPg({"mqtt_messages": insert_log}).start()
+        node = await start_node()
+        try:
+            await node.bridges.create("pgsql", "pgb", {
+                "server": f"127.0.0.1:{pg.port}",
+                "user": "broker", "password": "dbpw",
+                "sql": "INSERT INTO mqtt_messages (c, t, p) "
+                       "VALUES (${1}, ${2}, ${3})",
+                "parameters": ["${clientid}", "${topic}", "${payload}"],
+                "resource_opts": {"batch_size": 4, "retry_base": 0.01},
+            })
+            node.rule_engine.create_rule(
+                "rp", 'SELECT clientid, topic, payload FROM "ev/#"',
+                actions=["pgsql:pgb"])
+            pub = Client(clientid="pgpub",
+                         port=node.listeners.all()[0].port)
+            await pub.connect()
+            # payload with SQL metacharacters must ride bind params
+            await pub.publish("ev/2", b"x'); DROP TABLE users;--")
+            br = node.bridges.get("pgsql:pgb")
+            assert await settle_success(br)
+            assert inserts == [("pgpub", "ev/2",
+                                "x'); DROP TABLE users;--")]
+            # the SQL text itself never contained the payload
+            assert all("DROP TABLE" not in q for q, _ in pg.queries)
+        finally:
+            await node.stop()
+            await pg.stop()
+
+    run(main())
+
+
+def test_mongodb_bridge_insert_documents():
+    async def main():
+        mongo = await MockMongo({}).start()
+        node = await start_node()
+        try:
+            await node.bridges.create("mongodb", "mgb", {
+                "server": f"127.0.0.1:{mongo.port}",
+                "collection": "mqtt_messages",
+                "payload_template": {"client": "${clientid}",
+                                     "t": "${topic}",
+                                     "body": "${payload}"},
+                "resource_opts": {"batch_size": 4, "retry_base": 0.01},
+            })
+            node.rule_engine.create_rule(
+                "rm", 'SELECT clientid, topic, payload FROM "ev/#"',
+                actions=["mongodb:mgb"])
+            pub = Client(clientid="mgpub",
+                         port=node.listeners.all()[0].port)
+            await pub.connect()
+            await pub.publish("ev/3", b"doc-body")
+            br = node.bridges.get("mongodb:mgb")
+            assert await settle_success(br)
+            assert mongo.collections["mqtt_messages"] == [
+                {"client": "mgpub", "t": "ev/3", "body": "doc-body"}]
+        finally:
+            await node.stop()
+            await mongo.stop()
+
+    run(main())
+
+
+def test_influxdb_bridge_line_protocol():
+    async def main():
+        writes = []
+
+        async def handle(reader, writer):
+            try:
+                head = await reader.readuntil(b"\r\n\r\n")
+                lines = head.decode().split("\r\n")
+                n = 0
+                for ln in lines:
+                    if ln.lower().startswith("content-length:"):
+                        n = int(ln.split(":")[1])
+                body = await reader.readexactly(n) if n else b""
+                writes.append((lines[0], body))
+                writer.write(b"HTTP/1.1 204 No Content\r\n"
+                             b"content-length: 0\r\n"
+                             b"connection: close\r\n\r\n")
+                await writer.drain()
+            except Exception:
+                pass
+            finally:
+                writer.close()
+
+        srv = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        node = await start_node()
+        try:
+            await node.bridges.create("influxdb", "ifx", {
+                "server": f"http://127.0.0.1:{port}",
+                "bucket": "iot", "org": "acme", "token": "tkn",
+                "measurement": "mqtt",
+                "tags": {"topic": "${topic}"},
+                "fields": {"val": "${payload}", "who": "${clientid}"},
+                "resource_opts": {"batch_size": 4, "retry_base": 0.01},
+            })
+            node.rule_engine.create_rule(
+                "ri", 'SELECT clientid, topic, payload FROM "ev/#"',
+                actions=["influxdb:ifx"])
+            pub = Client(clientid="ipub",
+                         port=node.listeners.all()[0].port)
+            await pub.connect()
+            await pub.publish("ev/t 1", b"42.5")  # space needs escaping
+            br = node.bridges.get("influxdb:ifx")
+            assert await settle_success(br)
+            reqline, body = writes[0]
+            assert "bucket=iot" in reqline and "org=acme" in reqline
+            assert body == b'mqtt,topic=ev/t\\ 1 val=42.5,who="ipub"'
+        finally:
+            await node.stop()
+            await pub.disconnect()
+            srv.close()
+
+    run(main())
+
+
+def test_render_influx_field_typing_and_escaping():
+    from emqx_tpu.bridge.db import render_influx
+
+    out = {"payload": b"not-a-number", "topic": "a,b c", "clientid": "q\"x"}
+    item = render_influx({"fields": {"v": "${payload}"},
+                          "tags": {"t": "${topic}"}}, out, out)
+    assert item["line"] == 'mqtt,t=a\\,b\\ c v="not-a-number"'
+    item = render_influx({"fields": {"v": "3.5"}}, out, out)
+    assert item["line"].endswith(" v=3.5")

@@ -440,3 +440,29 @@ def test_kafka_ingress_republishes_into_broker():
             await mk.stop()
 
     run(main())
+
+
+def test_parse_batch_with_tombstone():
+    """Null-value records (tombstones) must not corrupt the records
+    that follow them in the same batch."""
+    import struct as S
+
+    # build manually: record with vlen=-1 then a normal record
+    def raw_record(delta, key, value):
+        from emqx_tpu.bridge.kafka import _varint
+        body = (b"\x00" + _varint(0) + _varint(delta)
+                + (_varint(-1) if key is None
+                   else _varint(len(key)) + key)
+                + (_varint(-1) if value is None
+                   else _varint(len(value)) + value)
+                + _varint(0))
+        return _varint(len(body)) + body
+
+    body_recs = raw_record(0, b"gone", None) + raw_record(1, b"k", b"v")
+    head = S.pack("!hiqqqhii", 0, 1, 0, 0, -1, -1, -1, 2)
+    after = head + body_recs
+    batch = (S.pack("!qi", 5, 9 + len(after))
+             + S.pack("!iBI", -1, 2, crc32c(after)) + after)
+    out, nxt, skipped = parse_batches(batch)
+    assert out == [(5, b"gone", b""), (6, b"k", b"v")]
+    assert nxt == 7 and skipped == 0

@@ -60,6 +60,26 @@ class MockMongo:
                     payload = await reader.readexactly(ln - 16)
                     assert opcode == 2013 and payload[4] == 0
                     cmd = bson_decode(payload[5:])
+                    if "insert" in cmd:
+                        coll = cmd["insert"]
+                        docs = cmd.get("documents", [])
+                        self.collections.setdefault(coll, []).extend(docs)
+                        reply = {"n": len(docs), "ok": 1.0}
+                        body = struct.pack("<i", 0) + b"\x00" \
+                            + bson_encode(reply)
+                        writer.write(struct.pack(
+                            "<iiii", 16 + len(body), 1, reqid, 2013)
+                            + body)
+                        await writer.drain()
+                        continue
+                    if "ping" in cmd:
+                        body = struct.pack("<i", 0) + b"\x00" \
+                            + bson_encode({"ok": 1.0})
+                        writer.write(struct.pack(
+                            "<iiii", 16 + len(body), 1, reqid, 2013)
+                            + body)
+                        await writer.drain()
+                        continue
                     if "getMore" in cmd:
                         rest = self._cursors.pop(cmd["getMore"], [])
                         reply = {"cursor": {"nextBatch": rest, "id": 0,
