@@ -1,0 +1,127 @@
+"""Config-driven construction of authenticators / authz sources — the
+``emqx_authn``/``emqx_authz`` config-schema analog [U] (SURVEY.md §2.3):
+the reference manages both as ordered lists of typed JSON configs over
+REST; this factory maps those configs onto the library classes so the
+management API (and data import) can create backends at runtime.
+
+Construction is signature-driven: conf keys that match the backend's
+constructor parameters pass through; unknown keys error (typos must not
+silently produce a default-configured authenticator).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, List, Tuple
+
+from .authn import BuiltinDbAuthenticator, JwtAuthenticator
+from .authz import AclRule, BuiltinDbSource, FileSource
+from .external import HttpAuthenticator, HttpAuthzSource, JwksJwtAuthenticator
+from .ldap import LdapAuthenticator
+from .mongo import MongoAuthenticator, MongoAuthzSource
+from .postgres import PostgresAuthenticator, PostgresAuthzSource
+from .redis import RedisAuthenticator, RedisAuthzSource
+from .scram import ScramAuthenticator
+
+__all__ = ["make_authenticator", "make_authz_source", "describe",
+           "AUTHN_TYPES", "AUTHZ_TYPES"]
+
+AUTHN_TYPES: Dict[str, Any] = {
+    "built_in_database": BuiltinDbAuthenticator,
+    "jwt": JwtAuthenticator,
+    "jwks": JwksJwtAuthenticator,
+    "http": HttpAuthenticator,
+    "redis": RedisAuthenticator,
+    "postgresql": PostgresAuthenticator,
+    "mongodb": MongoAuthenticator,
+    "ldap": LdapAuthenticator,
+    "scram": ScramAuthenticator,
+}
+
+AUTHZ_TYPES: Dict[str, Any] = {
+    "built_in_database": BuiltinDbSource,
+    "file": FileSource,
+    "http": HttpAuthzSource,
+    "redis": RedisAuthzSource,
+    "postgresql": PostgresAuthzSource,
+    "mongodb": MongoAuthzSource,
+}
+
+_SECRET_KEYS = ("password", "secret", "token")
+
+
+def _build(cls: Any, conf: Dict[str, Any]) -> Any:
+    sig = inspect.signature(cls.__init__)
+    params = {p for p in sig.parameters if p not in ("self",)}
+    kwargs = {}
+    unknown = []
+    for k, v in conf.items():
+        if k in ("type", "backend", "mechanism", "enable", "users",
+                 "rules", "allow_anonymous"):
+            continue   # factory/chain-level keys, not constructor args
+        if k not in params:
+            unknown.append(k)
+            continue
+        if k in ("secret", "password", "service_password") and \
+                isinstance(v, str) and \
+                "bytes" in str(sig.parameters[k].annotation):
+            v = v.encode()
+        kwargs[k] = v
+    if unknown:
+        raise ValueError(
+            f"unknown {cls.__name__} config keys: {sorted(unknown)} "
+            f"(accepted: {sorted(params)})")
+    return cls(**kwargs)
+
+
+def make_authenticator(conf: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
+    """conf {"type"|"backend": <name>, ...} -> (authenticator, conf)."""
+    t = conf.get("type") or conf.get("backend") or ""
+    cls = AUTHN_TYPES.get(t)
+    if cls is None:
+        raise ValueError(
+            f"unknown authenticator type {t!r} "
+            f"(one of {sorted(AUTHN_TYPES)})")
+    auth = _build(cls, conf)
+    # seed users for the user-store types
+    for u in conf.get("users", []) if t in ("built_in_database",
+                                            "scram") else []:
+        auth.add_user(
+            u.get("user_id") or u.get("username"),
+            u["password"].encode() if isinstance(u.get("password"), str)
+            else u.get("password", b""),
+            is_superuser=bool(u.get("is_superuser")))
+    return auth, conf
+
+
+def make_authz_source(conf: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
+    t = conf.get("type") or ""
+    cls = AUTHZ_TYPES.get(t)
+    if cls is None:
+        raise ValueError(
+            f"unknown authz source type {t!r} "
+            f"(one of {sorted(AUTHZ_TYPES)})")
+    if cls is FileSource:
+        rules = [
+            AclRule(permission=r["permission"],
+                    action=r.get("action", "all"),
+                    topics=r.get("topics", ()),
+                    who=r.get("who", "all"))
+            for r in conf.get("rules", [])
+        ]
+        return FileSource(rules), conf
+    src = _build(cls, {k: v for k, v in conf.items() if k != "rules"})
+    return src, conf
+
+
+def describe(conf: Dict[str, Any]) -> Dict[str, Any]:
+    """Redacted config for REST responses."""
+    out = {}
+    for k, v in conf.items():
+        if any(s in k.lower() for s in _SECRET_KEYS):
+            out[k] = "******"
+        elif k == "users":
+            out[k] = [{**u, "password": "******"} for u in v]
+        else:
+            out[k] = v
+    return out

@@ -98,6 +98,14 @@ class MgmtApi:
         r("POST", f"{v}/users", self.dash_user_create)
         r("DELETE", f"{v}/users/{{username}}", self.dash_user_delete)
         r("PUT", f"{v}/users/{{username}}/change_pwd", self.dash_change_pwd)
+        r("GET", f"{v}/authentication", self.authn_list)
+        r("POST", f"{v}/authentication", self.authn_create)
+        r("DELETE", f"{v}/authentication/{{idx}}", self.authn_delete)
+        r("POST", f"{v}/authentication/{{idx}}/users", self.authn_add_user)
+        r("GET", f"{v}/authorization/sources", self.authz_list)
+        r("POST", f"{v}/authorization/sources", self.authz_create)
+        r("DELETE", f"{v}/authorization/sources/{{idx}}",
+          self.authz_delete)
         r("GET", f"{v}/gateways", self.gateways_list)
         r("PUT", f"{v}/gateways/{{name}}/enable/{{enable}}",
           self.gateways_enable)
@@ -123,6 +131,115 @@ class MgmtApi:
     # ------------------------------------------------------------------
     # node / observability
     # ------------------------------------------------------------------
+
+    # ------------------------------------------------------------------
+    # authn chain / authz sources (runtime-managed, emqx_authn/authz
+    # REST analog — ordered typed configs -> factory-built backends)
+    # ------------------------------------------------------------------
+
+    async def authn_list(self, req: Request) -> Response:
+        from ..auth.factory import describe
+
+        return json_response({"data": [
+            {"index": i, **describe(conf)}
+            for i, (conf, _) in enumerate(self.node._auth_confs)
+        ]})
+
+    async def authn_create(self, req: Request) -> Response:
+        from ..auth.factory import describe, make_authenticator
+
+        try:
+            conf = req.json() or {}
+            auth, conf = make_authenticator(conf)
+        except (ValueError, KeyError, TypeError) as e:
+            return json_response({"message": str(e)}, 400)
+        ac = self.node.ensure_access_control()
+        ac.chain.add(auth)
+        ac.invalidate_async_cache()   # a network backend may need the
+                                      # async intercept path
+        if "allow_anonymous" in conf:
+            ac.chain.allow_anonymous = bool(conf["allow_anonymous"])
+        self.node._auth_confs.append((conf, auth))
+        return json_response(
+            {"index": len(self.node._auth_confs) - 1, **describe(conf)},
+            201)
+
+    async def authn_delete(self, req: Request) -> Response:
+        try:
+            idx = int(req.params["idx"])
+            if idx < 0:            # -1 would silently pop the newest
+                raise IndexError(idx)
+            conf, auth = self.node._auth_confs.pop(idx)
+        except (ValueError, IndexError):
+            return json_response({"message": "no such authenticator"}, 404)
+        self.node.access_control.chain.remove(auth)
+        self.node.access_control.invalidate_async_cache()
+        return Response(204)
+
+    async def authn_add_user(self, req: Request) -> Response:
+        try:
+            idx = int(req.params["idx"])
+            if idx < 0:
+                raise IndexError(idx)
+            conf, auth = self.node._auth_confs[idx]
+        except (ValueError, IndexError):
+            return json_response({"message": "no such authenticator"}, 404)
+        if not hasattr(auth, "add_user"):
+            return json_response(
+                {"message": f"{conf.get('type')} has no user store"}, 400)
+        body = req.json() or {}
+        uid = body.get("user_id") or body.get("username")
+        pw = body.get("password", "")
+        if not uid or not pw:
+            return json_response({"message": "user_id+password required"},
+                                 400)
+        try:
+            auth.add_user(uid, pw.encode() if isinstance(pw, str) else pw,
+                          is_superuser=bool(body.get("is_superuser")))
+        except ValueError as e:
+            return json_response({"message": str(e)}, 409)
+        return json_response({"user_id": uid}, 201)
+
+    async def authz_list(self, req: Request) -> Response:
+        from ..auth.factory import describe
+
+        return json_response({"data": [
+            {"index": i, **describe(conf)}
+            for i, (conf, _) in enumerate(self.node._authz_confs)
+        ]})
+
+    async def authz_create(self, req: Request) -> Response:
+        from ..auth.factory import describe, make_authz_source
+
+        try:
+            conf = req.json() or {}
+            src, conf = make_authz_source(conf)
+        except (ValueError, KeyError, TypeError) as e:
+            return json_response({"message": str(e)}, 400)
+        ac = self.node.ensure_access_control()
+        ac.authz.sources.append(src)
+        ac.authz._cache.clear()       # stale verdicts must not survive
+        ac.invalidate_async_cache()
+        self.node._authz_confs.append((conf, src))
+        return json_response(
+            {"index": len(self.node._authz_confs) - 1, **describe(conf)},
+            201)
+
+    async def authz_delete(self, req: Request) -> Response:
+        try:
+            idx = int(req.params["idx"])
+            if idx < 0:
+                raise IndexError(idx)
+            conf, src = self.node._authz_confs.pop(idx)
+        except (ValueError, IndexError):
+            return json_response({"message": "no such source"}, 404)
+        try:
+            self.node.access_control.authz.sources.remove(src)
+            self.node.access_control.authz._cache.clear()
+        except ValueError:
+            pass
+        self.node.access_control.invalidate_async_cache()
+        return Response(204)
 
     async def dashboard_page(self, req: Request) -> Response:
         """The dashboard SPA (emqx_dashboard UI analog) — static HTML;

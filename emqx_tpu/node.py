@@ -105,6 +105,8 @@ class BrokerNode:
 
         self.bridges = BridgeManager(self)
         self.access_control = None
+        self._auth_confs: list = []    # REST-created authenticator confs
+        self._authz_confs: list = []   # REST-created source confs
         if auth_chain is not None or authz is not None:
             self.access_control = attach_auth(
                 self.broker,
@@ -113,6 +115,7 @@ class BrokerNode:
                     no_match=cfg.get("authz.no_match")
                 ),
             )
+
         from .observe.trace import TraceManager
 
         self.tracing = TraceManager(self)
@@ -376,6 +379,17 @@ class BrokerNode:
     # ------------------------------------------------------------------
     # connection plumbing
     # ------------------------------------------------------------------
+
+    def ensure_access_control(self):
+        """REST-driven auth management attaches lazily: a node that
+        booted with no auth gets a live chain on the first authenticator
+        create (reference: authn/authz are runtime-configured)."""
+        if self.access_control is None:
+            self.access_control = attach_auth(
+                self.broker, AuthChain(),
+                Authz(no_match=self.config.get("authz.no_match")),
+            )
+        return self.access_control
 
     def make_channel(self, conninfo: Optional[dict] = None) -> Channel:
         cfg = self.config

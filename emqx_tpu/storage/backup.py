@@ -54,6 +54,16 @@ def _collect(node: Any) -> Dict[str, Any]:
         ],
         "bridges": node.bridges.export_config()
         if getattr(node, "bridges", None) is not None else [],
+        # runtime-managed auth: the FACTORY CONFIGS round-trip (secrets
+        # included — same posture as the reference's config export).
+        # KNOWN GAP: built-in-db users added AFTER create (via
+        # /authentication/{idx}/users) are not exported — only the
+        # creation-time "users" seeds rebuild
+        "auth": {
+            "authenticators": [c for c, _ in
+                               getattr(node, "_auth_confs", [])],
+            "sources": [c for c, _ in getattr(node, "_authz_confs", [])],
+        },
     }
     if node.retainer is not None:
         docs["retained"] = [
@@ -85,7 +95,7 @@ def export_data(node: Any) -> bytes:
 def import_data(node: Any, archive: bytes) -> Dict[str, int]:
     """Merge an exported archive into the running node."""
     counts = {"sessions": 0, "retained": 0, "banned": 0, "rules": 0,
-              "delayed": 0}
+              "delayed": 0, "auth": 0}
     docs: Dict[str, Any] = {}
     with tarfile.open(fileobj=io.BytesIO(archive), mode="r:gz") as tar:
         for member in tar.getmembers():
@@ -149,4 +159,30 @@ def import_data(node: Any, archive: bytes) -> Dict[str, int]:
                 enable=bool(rd.get("enable", True)),
             )
             counts["rules"] += 1
+    # runtime-managed auth configs rebuild through the factory
+    auth_doc = docs.get("auth") or {}
+    if auth_doc.get("authenticators") or auth_doc.get("sources"):
+        from ..auth.factory import make_authenticator, make_authz_source
+
+        ac = node.ensure_access_control()
+        for conf in auth_doc.get("authenticators", []):
+            try:
+                auth, conf = make_authenticator(conf)
+            except (ValueError, KeyError, TypeError):
+                continue    # a bad conf must not abort the import
+            ac.chain.add(auth)
+            if "allow_anonymous" in conf:
+                ac.chain.allow_anonymous = bool(conf["allow_anonymous"])
+            node._auth_confs.append((conf, auth))
+            counts["auth"] += 1
+        for conf in auth_doc.get("sources", []):
+            try:
+                src, conf = make_authz_source(conf)
+            except (ValueError, KeyError, TypeError):
+                continue
+            ac.authz.sources.append(src)
+            node._authz_confs.append((conf, src))
+            counts["auth"] += 1
+        ac.authz._cache.clear()
+        ac.invalidate_async_cache()
     return counts
