@@ -76,7 +76,10 @@ def _build(cls: Any, conf: Dict[str, Any]) -> Any:
 
 def make_authenticator(conf: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
     """conf {"type"|"backend": <name>, ...} -> (authenticator, conf)."""
-    t = conf.get("type") or conf.get("backend") or ""
+    # reference-shaped SCRAM configs arrive as {mechanism: "scram",
+    # backend: "built_in_database"} — mechanism wins over backend
+    t = (conf.get("mechanism") if conf.get("mechanism") == "scram"
+         else None) or conf.get("type") or conf.get("backend") or ""
     cls = AUTHN_TYPES.get(t)
     if cls is None:
         raise ValueError(
@@ -102,13 +105,25 @@ def make_authz_source(conf: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
             f"unknown authz source type {t!r} "
             f"(one of {sorted(AUTHZ_TYPES)})")
     if cls is FileSource:
-        rules = [
-            AclRule(permission=r["permission"],
-                    action=r.get("action", "all"),
-                    topics=r.get("topics", ()),
-                    who=r.get("who", "all"))
-            for r in conf.get("rules", [])
-        ]
+        # same typo discipline as _build: unknown keys must error, not
+        # silently install an empty (never-matching) rule source
+        bad = [k for k in conf if k not in ("type", "rules", "enable")]
+        if bad:
+            raise ValueError(
+                f"unknown file-source config keys: {sorted(bad)} "
+                "(accepted: ['rules'])")
+        rules = []
+        for r in conf.get("rules", []):
+            bad = [k for k in r if k not in
+                   ("permission", "action", "topics", "who", "retain",
+                    "qos")]
+            if bad:
+                raise ValueError(f"unknown rule keys: {sorted(bad)}")
+            rules.append(AclRule(
+                permission=r["permission"],
+                action=r.get("action", "all"),
+                topics=r.get("topics", ()),
+                who=r.get("who", "all")))
         return FileSource(rules), conf
     src = _build(cls, {k: v for k, v in conf.items() if k != "rules"})
     return src, conf

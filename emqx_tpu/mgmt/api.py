@@ -150,8 +150,10 @@ class MgmtApi:
 
         try:
             conf = req.json() or {}
+            if not isinstance(conf, dict):
+                raise ValueError("config must be a JSON object")
             auth, conf = make_authenticator(conf)
-        except (ValueError, KeyError, TypeError) as e:
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
             return json_response({"message": str(e)}, 400)
         ac = self.node.ensure_access_control()
         ac.chain.add(auth)
@@ -193,11 +195,20 @@ class MgmtApi:
         if not uid or not pw:
             return json_response({"message": "user_id+password required"},
                                  400)
+        if uid in getattr(auth, "_users", {}):
+            # add_user overwrites silently; the API must 409 like the
+            # reference instead of rotating the password behind a 201
+            return json_response({"message": f"user {uid!r} exists"}, 409)
         try:
             auth.add_user(uid, pw.encode() if isinstance(pw, str) else pw,
                           is_superuser=bool(body.get("is_superuser")))
         except ValueError as e:
             return json_response({"message": str(e)}, 409)
+        # keep the stored conf authoritative: GET /authentication and
+        # data export must see REST-added users, not just creation seeds
+        conf.setdefault("users", []).append(
+            {"user_id": uid, "password": pw,
+             "is_superuser": bool(body.get("is_superuser"))})
         return json_response({"user_id": uid}, 201)
 
     async def authz_list(self, req: Request) -> Response:
@@ -213,12 +224,14 @@ class MgmtApi:
 
         try:
             conf = req.json() or {}
+            if not isinstance(conf, dict):
+                raise ValueError("config must be a JSON object")
             src, conf = make_authz_source(conf)
-        except (ValueError, KeyError, TypeError) as e:
+        except (ValueError, KeyError, TypeError, AttributeError) as e:
             return json_response({"message": str(e)}, 400)
         ac = self.node.ensure_access_control()
         ac.authz.sources.append(src)
-        ac.authz._cache.clear()       # stale verdicts must not survive
+        ac.authz.clear_cache()        # stale verdicts must not survive
         ac.invalidate_async_cache()
         self.node._authz_confs.append((conf, src))
         return json_response(
@@ -235,7 +248,7 @@ class MgmtApi:
             return json_response({"message": "no such source"}, 404)
         try:
             self.node.access_control.authz.sources.remove(src)
-            self.node.access_control.authz._cache.clear()
+            self.node.access_control.authz.clear_cache()
         except ValueError:
             pass
         self.node.access_control.invalidate_async_cache()

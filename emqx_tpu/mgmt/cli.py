@@ -123,6 +123,21 @@ def main(argv=None) -> int:
 
     sub.add_parser("gateways")
 
+    p = sub.add_parser("authn")
+    p.add_argument("action", choices=["list", "create", "delete",
+                                      "add-user"])
+    p.add_argument("idx", nargs="?")
+    p.add_argument("--conf", default=None,
+                   help="JSON authenticator config (create)")
+    p.add_argument("--user", default=None, help="user_id (add-user)")
+    p.add_argument("--password", default=None)
+
+    p = sub.add_parser("authz")
+    p.add_argument("action", choices=["list", "create", "delete"])
+    p.add_argument("idx", nargs="?")
+    p.add_argument("--conf", default=None,
+                   help="JSON source config (create)")
+
     p = sub.add_parser("trace")
     p.add_argument("action", choices=["list", "start", "stop", "delete"])
     p.add_argument("name", nargs="?")
@@ -223,6 +238,28 @@ def main(argv=None) -> int:
             print(f"{args.action}d {args.bridge_id}")
     elif args.cmd == "gateways":
         _print(ctl.call("GET", f"{v}/gateways"))
+    elif args.cmd == "authn":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/authentication"))
+        elif args.action == "create":
+            _print(ctl.call("POST", f"{v}/authentication",
+                            json.loads(args.conf or "{}")))
+        elif args.action == "delete":
+            ctl.call("DELETE", f"{v}/authentication/{args.idx}")
+            print(f"deleted authenticator {args.idx}")
+        else:  # add-user
+            _print(ctl.call(
+                "POST", f"{v}/authentication/{args.idx}/users",
+                {"user_id": args.user, "password": args.password}))
+    elif args.cmd == "authz":
+        if args.action == "list":
+            _print(ctl.call("GET", f"{v}/authorization/sources"))
+        elif args.action == "create":
+            _print(ctl.call("POST", f"{v}/authorization/sources",
+                            json.loads(args.conf or "{}")))
+        else:
+            ctl.call("DELETE", f"{v}/authorization/sources/{args.idx}")
+            print(f"deleted source {args.idx}")
     elif args.cmd == "trace":
         if args.action == "list":
             _print(ctl.call("GET", f"{v}/trace"))

@@ -1,0 +1,220 @@
+"""Pallas fast path for SMALL (VMEM-resident) match tables — the
+SURVEY.md §7.4 "pallas kernel for the hot op" experiment, with the
+honest applicability analysis.
+
+**Where pallas can win here.**  The shipping ``nfa_match`` is
+HBM-random-gather bound at scale (BASELINE.md ablation: edge+node
+gathers are ~65% of kernel time at 200k filters; the table has ~1.0
+literal edges per state, so the 2-choice×4-slot cuckoo probe is already
+byte-minimal).  XLA's native gather is the right tool for those
+HBM-scale lookups: a pallas kernel would have to issue one DMA per
+probed bucket (B·A·2 small DMAs per step — DMA issue overhead alone
+exceeds the gather cost), so pallas is NOT attempted for the 1M–10M
+filter regime; the measured reasoning lives in BASELINE.md.
+
+For tables that FIT IN VMEM (≲100k edges ≈ 6.4 MB edge table + node
+table), the calculus inverts: the whole 8-step walk can run in ONE
+kernel with every probe hitting VMEM — no per-step HBM round trips, no
+intermediate materialization.  That is this module: a fused
+walk-and-match kernel for the small/medium broker (≤~50k wildcard
+filters), grid over batch tiles, tables broadcast to every tile.
+
+**Status.**  Parity-tested against ``nfa_match`` in interpret mode (the
+CPU-mesh suite).  Mosaic lowering exercised via ``bench_pallas_small``
+on real TPU hardware — run it when a chip is attached; if Mosaic
+rejects the vectorized VMEM gathers on some TPU generation, the caller
+falls back to ``nfa_match`` (both paths share the table layout, so the
+fallback is a function swap).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .compiler import BUCKET_SLOTS
+
+__all__ = ["pallas_small_match", "supports_table", "bench_pallas_small"]
+
+VMEM_BUDGET_BYTES = 8 << 20   # tables beyond this stay on nfa_match
+TILE_B = 256                  # batch rows per grid step
+
+
+def supports_table(node_tab: np.ndarray, edge_tab: np.ndarray) -> bool:
+    return (node_tab.nbytes + edge_tab.nbytes) <= VMEM_BUDGET_BYTES
+
+
+def _hash(state, word, seed, mask):
+    h = (state.astype(jnp.uint32) * jnp.uint32(2654435761)
+         + word.astype(jnp.uint32) * jnp.uint32(2246822519)
+         + seed.astype(jnp.uint32))
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(3266489917)
+    h = h ^ (h >> jnp.uint32(13))
+    return (h & jnp.uint32(mask)).astype(jnp.int32)
+
+
+def _kernel(words_ref, lens_ref, issys_ref, node_ref, edge_ref, seeds_ref,
+            acc_ref, aover_ref, *, depth: int, active_slots: int):
+    """One batch tile: the full D-step walk with VMEM-resident tables.
+
+    Mirrors ``nfa_match`` exactly (same per-step widths, same accept
+    slot layout) so parity is bit-for-bit and callers can decode with
+    the same host code."""
+    words = words_ref[...]
+    lens = lens_ref[...]
+    is_sys = issys_ref[...]
+    node_tab = node_ref[...]
+    edge_tab = edge_ref[...]
+    seeds = seeds_ref[...]
+    Hb = edge_tab.shape[0]
+    mask = Hb - 1
+    B = words.shape[0]
+    A = active_slots
+
+    active = jnp.zeros((B, 1), jnp.int32)
+    aover = jnp.zeros((B,), jnp.int32)
+    col = 0
+    for t in range(depth + 1):
+        valid = active >= 0
+        sa = jnp.maximum(active, 0)
+        node = node_tab[sa]                  # (B, w, 4) VMEM gather
+        hacc = jnp.where(valid, node[..., 1], -1)
+        if t == 0:
+            hacc = jnp.where(is_sys[:, None], -1, hacc)
+        eacc = jnp.where(valid & (t == lens)[:, None], node[..., 2], -1)
+        w_cols = hacc.shape[1]
+        acc_ref[:, col:col + w_cols] = hacc
+        acc_ref[:, col + w_cols:col + 2 * w_cols] = eacc
+        col += 2 * w_cols
+        if t == depth:
+            break
+        w = jnp.broadcast_to(words[:, t][:, None], active.shape)
+        hits = []
+        for k in range(2):
+            b = _hash(active, w, seeds[k], mask)
+            rows = edge_tab[b].reshape(B, active.shape[1],
+                                       BUCKET_SLOTS, 4)
+            hit = (rows[..., 0] == active[..., None]) & (
+                rows[..., 1] == w[..., None])
+            hits.append(jnp.max(jnp.where(hit, rows[..., 2], -1),
+                                axis=-1))
+        lit = jnp.where(valid, jnp.maximum(hits[0], hits[1]), -1)
+        plus = jnp.where(valid, node[..., 0], -1)
+        if t == 0:
+            plus = jnp.where(is_sys[:, None], -1, plus)
+        cand = jnp.concatenate([lit, plus], axis=1)
+        cand = jnp.where((t < lens)[:, None], cand, -1)
+        if cand.shape[1] <= A:
+            active = cand
+        else:
+            active, _ = jax.lax.top_k(cand, A)
+            n_cand = jnp.sum((cand >= 0).astype(jnp.int32), axis=1)
+            n_kept = jnp.sum((active >= 0).astype(jnp.int32), axis=1)
+            aover = aover + (n_cand - n_kept)
+    aover_ref[...] = aover
+
+
+def _accept_cols(depth: int, active_slots: int) -> int:
+    cols = 0
+    w = 1
+    for t in range(depth + 1):
+        cols += 2 * w
+        w = min(2 * w, active_slots)
+    return cols
+
+
+@partial(jax.jit, static_argnames=("depth", "active_slots", "interpret"))
+def pallas_small_match(words, lens, is_sys, node_tab, edge_tab, seeds,
+                       *, depth: int, active_slots: int = 8,
+                       interpret: bool = False) -> Tuple[jax.Array,
+                                                         jax.Array]:
+    """-> (raw accept slots (B, C), active_overflow (B,)) — the same
+    raw-mode layout as ``nfa_match(compact_output=False)``; reuse its
+    host decode / XLA compaction."""
+    from jax.experimental import pallas as pl
+
+    B, D = words.shape
+    assert D == depth, (D, depth)
+    if B % TILE_B:
+        raise ValueError(f"batch {B} must be a multiple of {TILE_B}")
+    C = _accept_cols(depth, active_slots)
+    kernel = partial(_kernel, depth=depth, active_slots=active_slots)
+    grid = (B // TILE_B,)
+    acc, aover = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, C), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((TILE_B, D), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_B,), lambda i: (i,)),
+            pl.BlockSpec((TILE_B,), lambda i: (i,)),
+            pl.BlockSpec(node_tab.shape, lambda i: (0, 0)),
+            pl.BlockSpec(edge_tab.shape, lambda i: (0, 0)),
+            pl.BlockSpec(seeds.shape, lambda i: (0,)),
+        ],
+        out_specs=(
+            pl.BlockSpec((TILE_B, C), lambda i: (i, 0)),
+            pl.BlockSpec((TILE_B,), lambda i: (i,)),
+        ),
+        interpret=interpret,
+    )(words, lens, is_sys, node_tab, edge_tab, seeds)
+    return acc, aover
+
+
+def bench_pallas_small(n_filters: int = 50_000, batch: int = 8192,
+                       iters: int = 20, depth: int = 8) -> dict:
+    """Real-chip A/B: fused pallas walk vs nfa_match on a VMEM-sized
+    table.  Run manually when a TPU is attached (the tunnel was down
+    when this landed); falls back with the Mosaic error recorded if
+    lowering is rejected."""
+    import time
+
+    from .compiler import compile_filters, encode_topics
+    from .match_kernel import nfa_match
+
+    rng = np.random.default_rng(3)
+    filters = [f"s/{rng.integers(1000)}/+/d{i % 97}/#"[: 64]
+               for i in range(n_filters)]
+    table = compile_filters(sorted(set(filters)), depth=depth)
+    topics = [f"s/{rng.integers(1000)}/x/d{i % 97}/leaf"
+              for i in range(batch)]
+    words, lens, is_sys = encode_topics(table, topics, batch=batch)
+    args = (jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+            *[jnp.asarray(a) for a in table.device_arrays()])
+    out = {"n_states": table.n_states,
+           "table_bytes": int(sum(a.nbytes for a in
+                                  table.device_arrays()[:2]))}
+    r = nfa_match(*args, active_slots=8, compact_output=False)
+    np.asarray(r.matches)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = nfa_match(*args, active_slots=8, compact_output=False)
+    np.asarray(r.matches)
+    out["xla_ms_per_batch"] = round(
+        (time.perf_counter() - t0) / iters * 1e3, 2)
+    try:
+        acc, aover = pallas_small_match(
+            *args, depth=depth, active_slots=8)
+        np.asarray(acc)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            acc, aover = pallas_small_match(
+                *args, depth=depth, active_slots=8)
+        np.asarray(acc)
+        out["pallas_ms_per_batch"] = round(
+            (time.perf_counter() - t0) / iters * 1e3, 2)
+    except Exception as e:  # noqa: BLE001 — record the lowering verdict
+        out["pallas_error"] = f"{type(e).__name__}: {e}"[:500]
+    return out
+
+
+if __name__ == "__main__":
+    print(bench_pallas_small())

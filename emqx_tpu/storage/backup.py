@@ -15,6 +15,9 @@ import json
 import tarfile
 import time
 from typing import Any, Dict
+import logging
+
+log = logging.getLogger(__name__)
 
 from .codec import (
     ban_to_dict,
@@ -168,8 +171,12 @@ def import_data(node: Any, archive: bytes) -> Dict[str, int]:
         for conf in auth_doc.get("authenticators", []):
             try:
                 auth, conf = make_authenticator(conf)
-            except (ValueError, KeyError, TypeError):
-                continue    # a bad conf must not abort the import
+            except (ValueError, KeyError, TypeError) as e:
+                # a bad conf must not abort the import — but dropping a
+                # SECURITY config silently would be worse than noisy
+                log.error("import: dropping authenticator conf "
+                          "(type=%r): %s", conf.get("type"), e)
+                continue
             ac.chain.add(auth)
             if "allow_anonymous" in conf:
                 ac.chain.allow_anonymous = bool(conf["allow_anonymous"])
@@ -178,11 +185,13 @@ def import_data(node: Any, archive: bytes) -> Dict[str, int]:
         for conf in auth_doc.get("sources", []):
             try:
                 src, conf = make_authz_source(conf)
-            except (ValueError, KeyError, TypeError):
+            except (ValueError, KeyError, TypeError) as e:
+                log.error("import: dropping authz source conf "
+                          "(type=%r): %s", conf.get("type"), e)
                 continue
             ac.authz.sources.append(src)
             node._authz_confs.append((conf, src))
             counts["auth"] += 1
-        ac.authz._cache.clear()
+        ac.authz.clear_cache()
         ac.invalidate_async_cache()
     return counts

@@ -273,3 +273,57 @@ def test_rest_created_async_backend_is_consulted():
             await srv.stop()
 
     run(main())
+
+
+def test_rest_added_users_export_and_duplicates_409():
+    async def main():
+        from emqx_tpu.storage import export_data, import_data
+
+        node = await start_node()
+        try:
+            tok = await login(node)
+            st, doc = await api(node, "POST", "/authentication", {
+                "type": "built_in_database", "allow_anonymous": False,
+            }, tok)
+            idx = doc["index"]
+            st, _ = await api(node, "POST",
+                              f"/authentication/{idx}/users",
+                              {"user_id": "dana", "password": "dpw9999"},
+                              tok)
+            assert st == 201
+            # duplicate -> 409, password NOT rotated
+            st, _ = await api(node, "POST",
+                              f"/authentication/{idx}/users",
+                              {"user_id": "dana", "password": "other99"},
+                              tok)
+            assert st == 409
+            blob = export_data(node)
+        finally:
+            await node.stop()
+
+        node2 = await start_node()
+        try:
+            import_data(node2, blob)
+            port = node2.listeners.all()[0].port
+            ok = Client(clientid="d1", port=port, username="dana",
+                        password=b"dpw9999")
+            await ok.connect()      # REST-added user survives restore
+            await ok.disconnect()
+        finally:
+            await node2.stop()
+
+    run(main())
+
+
+def test_factory_validation_hardening():
+    # typo'd file-source key must error, not install an empty source
+    with pytest.raises(ValueError):
+        make_authz_source({"type": "file", "rule": []})
+    with pytest.raises(ValueError):
+        make_authz_source({"type": "file",
+                           "rules": [{"permision": "deny"}]})
+    # reference-shaped scram config resolves to ScramAuthenticator
+    a, _ = make_authenticator({"mechanism": "scram",
+                               "backend": "built_in_database"})
+    from emqx_tpu.auth.scram import ScramAuthenticator
+    assert isinstance(a, ScramAuthenticator)

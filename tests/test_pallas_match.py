@@ -1,0 +1,52 @@
+"""Pallas small-table fast path: parity vs nfa_match in interpret mode
+(SURVEY.md §7.4 experiment; Mosaic lowering A/B'd on real hardware via
+ops.pallas_match.bench_pallas_small)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.ops import compile_filters, encode_topics, nfa_match
+from emqx_tpu.ops.pallas_match import (
+    TILE_B, pallas_small_match, supports_table,
+)
+
+FILTERS = ["a/b/c", "a/+/c", "a/#", "#", "+", "+/b", "a/b", "b",
+           "$SYS/#", "x//y", "+/+/+", "deep/1/2/3/4/5/6/#"]
+TOPICS = (["a/b/c", "a/b", "a", "b", "x//y", "$SYS/broker",
+           "deep/1/2/3/4/5/6/7", "nomatch/z", "a/q/c", "/"] * 26)[:256]
+
+
+def test_pallas_parity_interpret():
+    t = compile_filters(FILTERS, depth=8, state_bucket=8)
+    assert supports_table(*t.device_arrays()[:2])
+    words, lens, is_sys = encode_topics(t, TOPICS, batch=256)
+    args = (jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+            *[jnp.asarray(a) for a in t.device_arrays()])
+    ref = nfa_match(*args, active_slots=8, compact_output=False)
+    acc, aover = pallas_small_match(*args, depth=8, active_slots=8,
+                                    interpret=True)
+    ra, pa = np.asarray(ref.matches), np.asarray(acc)
+    assert ra.shape == pa.shape
+    # same accept-id multiset per row (slot layout is shared)
+    assert (np.sort(np.where(ra < 0, -1, ra), axis=1)
+            == np.sort(np.where(pa < 0, -1, pa), axis=1)).all()
+    assert (np.asarray(ref.active_overflow) == np.asarray(aover)).all()
+    # spot-check against the oracle too
+    counts = np.asarray(ref.n_matches)
+    for i, name in enumerate(TOPICS[:32]):
+        want = {f for f in FILTERS if T.match(name, f)}
+        got = {t.accept_filters[a] for a in pa[i] if a >= 0}
+        assert got == want or counts[i] > len(got)
+
+
+def test_pallas_rejects_ragged_batch():
+    t = compile_filters(FILTERS, depth=8, state_bucket=8)
+    words, lens, is_sys = encode_topics(t, TOPICS[:100], batch=100)
+    with pytest.raises(ValueError):
+        pallas_small_match(
+            jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+            *[jnp.asarray(a) for a in t.device_arrays()],
+            depth=8, interpret=True)
+    assert TILE_B == 256
