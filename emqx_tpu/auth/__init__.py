@@ -22,6 +22,7 @@ from .redis import RedisAuthenticator, RedisAuthzSource
 from .postgres import PostgresAuthenticator, PostgresAuthzSource
 from .mongo import MongoAuthenticator, MongoAuthzSource
 from .ldap import LdapAuthenticator
+from .mysql import MysqlAuthenticator, MysqlAuthzSource
 
 __all__ = [
     "AuthChain", "BuiltinDbAuthenticator", "JwtAuthenticator",
@@ -32,4 +33,5 @@ __all__ = [
     "RedisAuthenticator", "RedisAuthzSource",
     "PostgresAuthenticator", "PostgresAuthzSource",
     "MongoAuthenticator", "MongoAuthzSource", "LdapAuthenticator",
+    "MysqlAuthenticator", "MysqlAuthzSource",
 ]

@@ -144,6 +144,24 @@ class BuiltinDbAuthenticator:
             salt, is_superuser,
         )
 
+    def add_user_hashed(self, user_id: str, password_hash: str,
+                        salt: str = "", is_superuser: bool = False) -> None:
+        """Restore a user from its stored (hash, salt) — backup/import
+        round-trips records without ever persisting the plaintext.
+        Salt strings use latin-1 (the byte-transparent codec
+        export_user encodes with — UTF-8 would mangle bytes >= 0x80)."""
+        s = salt.encode("latin-1") if isinstance(salt, str) else (salt
+                                                                  or b"")
+        self._users[user_id] = _UserRecord(password_hash, s, is_superuser)
+
+    def export_user(self, user_id: str) -> Optional[Dict[str, Any]]:
+        rec = self._users.get(user_id)
+        if rec is None:
+            return None
+        return {"user_id": user_id, "password_hash": rec.password_hash,
+                "salt": rec.salt.decode("latin-1"),
+                "is_superuser": rec.is_superuser}
+
     def delete_user(self, user_id: str) -> bool:
         return self._users.pop(user_id, None) is not None
 

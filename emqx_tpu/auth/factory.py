@@ -19,6 +19,7 @@ from .authz import AclRule, BuiltinDbSource, FileSource
 from .external import HttpAuthenticator, HttpAuthzSource, JwksJwtAuthenticator
 from .ldap import LdapAuthenticator
 from .mongo import MongoAuthenticator, MongoAuthzSource
+from .mysql import MysqlAuthenticator, MysqlAuthzSource
 from .postgres import PostgresAuthenticator, PostgresAuthzSource
 from .redis import RedisAuthenticator, RedisAuthzSource
 from .scram import ScramAuthenticator
@@ -33,6 +34,7 @@ AUTHN_TYPES: Dict[str, Any] = {
     "http": HttpAuthenticator,
     "redis": RedisAuthenticator,
     "postgresql": PostgresAuthenticator,
+    "mysql": MysqlAuthenticator,
     "mongodb": MongoAuthenticator,
     "ldap": LdapAuthenticator,
     "scram": ScramAuthenticator,
@@ -44,6 +46,7 @@ AUTHZ_TYPES: Dict[str, Any] = {
     "http": HttpAuthzSource,
     "redis": RedisAuthzSource,
     "postgresql": PostgresAuthzSource,
+    "mysql": MysqlAuthzSource,
     "mongodb": MongoAuthzSource,
 }
 
@@ -86,14 +89,23 @@ def make_authenticator(conf: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
             f"unknown authenticator type {t!r} "
             f"(one of {sorted(AUTHN_TYPES)})")
     auth = _build(cls, conf)
-    # seed users for the user-store types
+    # seed users for the user-store types; hashed records (the form
+    # the REST add-user path persists for built-in db) restore without
+    # ever having stored the plaintext
     for u in conf.get("users", []) if t in ("built_in_database",
                                             "scram") else []:
-        auth.add_user(
-            u.get("user_id") or u.get("username"),
-            u["password"].encode() if isinstance(u.get("password"), str)
-            else u.get("password", b""),
-            is_superuser=bool(u.get("is_superuser")))
+        uid = u.get("user_id") or u.get("username")
+        if "password_hash" in u and hasattr(auth, "add_user_hashed"):
+            auth.add_user_hashed(
+                uid, u["password_hash"], u.get("salt", ""),
+                is_superuser=bool(u.get("is_superuser")))
+        else:
+            auth.add_user(
+                uid,
+                u["password"].encode()
+                if isinstance(u.get("password"), str)
+                else u.get("password", b""),
+                is_superuser=bool(u.get("is_superuser")))
     return auth, conf
 
 
@@ -123,7 +135,9 @@ def make_authz_source(conf: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
                 permission=r["permission"],
                 action=r.get("action", "all"),
                 topics=r.get("topics", ()),
-                who=r.get("who", "all")))
+                who=r.get("who", "all"),
+                retain=r.get("retain"),
+                qos=r.get("qos")))
         return FileSource(rules), conf
     src = _build(cls, {k: v for k, v in conf.items() if k != "rules"})
     return src, conf

@@ -1,0 +1,367 @@
+"""MySQL authn/authz backends over a minimal protocol-41 client.
+
+Behavioral reference: ``apps/emqx_authn/.../mysql`` and
+``apps/emqx_authz/.../mysql`` [U] (SURVEY.md §2.3) — same row contracts
+as the PostgreSQL backends (``password_hash``/``salt``/``is_superuser``;
+``permission``/``action``/``topic``).
+
+Wire client scope (dependency-free, like the other backends): handshake
+v10 + ``mysql_native_password`` (SHA1 scramble), COM_QUERY with the
+TEXT resultset protocol (lenenc-string rows).  The binary prepared-
+statement protocol is NOT implemented — template values are spliced
+in a SINGLE pass as quoted literals with mode-independent escaping
+(quotes doubled, backslashes doubled — safe under both the default
+sql_mode and NO_BACKSLASH_ESCAPES), which closes the injection channel
+for the credential-shaped inputs these queries take; deployments wanting
+server-side prepare use the PostgreSQL backend (true bind parameters)
+as the template.  ``caching_sha2_password`` servers must create the
+broker's DB user with ``mysql_native_password``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import re
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..wire import LazyTcpClient
+from ._backend import ParkedVerdicts, TtlCache, acl_filter_matches
+from .authn import AuthResult, Credentials, IGNORE, _verify_password
+from .authz import ALLOW, DENY, NOMATCH
+from .external import _in_event_loop
+
+log = logging.getLogger(__name__)
+
+__all__ = ["MysqlClient", "MysqlError", "MysqlAuthenticator",
+           "MysqlAuthzSource", "escape_literal"]
+
+CLIENT_PROTOCOL_41 = 0x0200
+CLIENT_SECURE_CONNECTION = 0x8000
+CLIENT_PLUGIN_AUTH = 0x00080000
+CLIENT_CONNECT_WITH_DB = 0x0008
+
+
+class MysqlError(Exception):
+    pass
+
+
+def escape_literal(v: str) -> str:
+    """MySQL string-literal escaping, safe under BOTH the default
+    sql_mode and NO_BACKSLASH_ESCAPES: single quotes are DOUBLED (the
+    one escape valid in every mode — backslash-quoting is inert under
+    NO_BACKSLASH_ESCAPES and would let ' terminate the literal), and
+    backslashes are doubled so a trailing backslash cannot eat the
+    closing quote in default mode.  Control characters ride through as
+    data.  The result is always used INSIDE single quotes."""
+    return v.replace("\\", "\\\\").replace("'", "''")
+
+
+_PLACEHOLDER = re.compile(r"\$\{(\w+)\}")
+
+
+def render_query(template: str, ctx: Dict[str, Any]) -> str:
+    """``${var}`` -> quoted, escaped literal.  SINGLE-PASS substitution:
+    sequential str.replace would re-scan spliced values, letting a
+    credential containing ``${other}`` smuggle a second field inside
+    its quoted literal (injection despite escaping)."""
+    def sub(m):
+        v = ctx.get(m.group(1))
+        return "'" + escape_literal("" if v is None else str(v)) + "'"
+
+    return _PLACEHOLDER.sub(sub, template)
+
+
+def _native_password(password: str, scramble: bytes) -> bytes:
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(scramble + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def _lenenc(data: bytes, off: int) -> Tuple[Optional[int], int]:
+    b = data[off]
+    if b < 0xFB:
+        return b, off + 1
+    if b == 0xFB:                   # NULL
+        return None, off + 1
+    if b == 0xFC:
+        return struct.unpack_from("<H", data, off + 1)[0], off + 3
+    if b == 0xFD:
+        return int.from_bytes(data[off + 1:off + 4], "little"), off + 4
+    return struct.unpack_from("<Q", data, off + 1)[0], off + 9
+
+
+class MysqlClient(LazyTcpClient):
+    """One async MySQL connection: handshake + COM_QUERY text protocol."""
+
+    def __init__(self, server: str = "127.0.0.1:3306", *,
+                 user: str = "root", password: str = "",
+                 database: str = "mqtt", timeout: float = 5.0) -> None:
+        super().__init__(server, 3306, timeout)
+        self.user = user
+        self.password = password
+        self.database = database
+        self._seq = 0
+
+    # -- packet framing -----------------------------------------------------
+
+    async def _read_packet(self) -> bytes:
+        head = await self._reader.readexactly(4)
+        ln = int.from_bytes(head[:3], "little")
+        self._seq = (head[3] + 1) & 0xFF
+        return await self._reader.readexactly(ln)
+
+    def _write_packet(self, payload: bytes) -> None:
+        self._writer.write(len(payload).to_bytes(3, "little")
+                           + bytes([self._seq]) + payload)
+        self._seq = (self._seq + 1) & 0xFF
+
+    @staticmethod
+    def _err_text(p: bytes) -> str:
+        # 0xFF code:2 '#' sqlstate:5 message
+        msg = p[3:]
+        if msg[:1] == b"#":
+            msg = msg[6:]
+        return msg.decode("utf-8", "replace")
+
+    # -- handshake ----------------------------------------------------------
+
+    async def _on_connect(self) -> None:
+        greeting = await self._read_packet()
+        if greeting[:1] == b"\xff":
+            raise MysqlError(self._err_text(greeting))
+        off = 1
+        end = greeting.index(b"\x00", off)      # server version
+        off = end + 1 + 4                        # thread id
+        scramble = greeting[off:off + 8]
+        off += 8 + 1                             # filler
+        off += 2 + 1 + 2 + 2                     # caps, charset, status, caps
+        (plugin_len,) = struct.unpack_from("B", greeting, off)
+        off += 1 + 10
+        scramble += greeting[off:off + max(12, plugin_len - 9)][:12]
+        caps = (CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
+                | CLIENT_PLUGIN_AUTH | CLIENT_CONNECT_WITH_DB)
+        auth = _native_password(self.password, scramble)
+        resp = (struct.pack("<IIB", caps, 1 << 24, 33) + b"\x00" * 23
+                + self.user.encode() + b"\x00"
+                + bytes([len(auth)]) + auth
+                + self.database.encode() + b"\x00"
+                + b"mysql_native_password\x00")
+        self._write_packet(resp)
+        await self._writer.drain()
+        ok = await self._read_packet()
+        if ok[:1] == b"\xff":
+            raise MysqlError(self._err_text(ok))
+        if ok[:1] == b"\xfe":
+            raise MysqlError(
+                "server requires an unsupported auth plugin "
+                "(create the broker user WITH mysql_native_password)")
+
+    # -- COM_QUERY text protocol --------------------------------------------
+
+    async def query(self, sql: str) -> Tuple[List[str],
+                                             List[List[Optional[str]]]]:
+        return await self._guarded(lambda: self._query(sql))
+
+    async def _query(self, sql):
+        self._seq = 0
+        self._write_packet(b"\x03" + sql.encode())
+        await self._writer.drain()
+        first = await self._read_packet()
+        if first[:1] == b"\xff":
+            raise MysqlError(self._err_text(first))
+        if first[:1] == b"\x00":                 # OK (no resultset)
+            return [], []
+        ncols, _ = _lenenc(first, 0)
+        cols: List[str] = []
+        for _ in range(ncols):
+            p = await self._read_packet()
+            # column def 320: catalog,schema,table,org_table,name,...
+            off = 0
+            name = b""
+            for field_i in range(5):
+                ln, off = _lenenc(p, off)
+                if field_i == 4:
+                    name = p[off:off + (ln or 0)]
+                off += ln or 0
+            cols.append(name.decode())
+        p = await self._read_packet()            # EOF (assumed; no
+        if p[:1] not in (b"\xfe",):              # DEPRECATE_EOF requested)
+            raise MysqlError("expected EOF after column defs")
+        rows: List[List[Optional[str]]] = []
+        while True:
+            p = await self._read_packet()
+            if p[:1] == b"\xfe" and len(p) < 9:  # EOF
+                return cols, rows
+            if p[:1] == b"\xff":
+                raise MysqlError(self._err_text(p))
+            off = 0
+            row: List[Optional[str]] = []
+            for _ in range(ncols):
+                ln, off = _lenenc(p, off)
+                if ln is None:
+                    row.append(None)
+                else:
+                    row.append(p[off:off + ln].decode())
+                    off += ln
+            rows.append(row)
+
+    def query_blocking(self, sql):
+        import asyncio
+
+        client = MysqlClient(f"{self.host}:{self.port}", user=self.user,
+                             password=self.password,
+                             database=self.database, timeout=self.timeout)
+
+        async def run():
+            try:
+                return await client.query(sql)
+            finally:
+                await client.close()
+
+        return asyncio.run(run())
+
+
+def _ctx(clientid, username, peerhost=None):
+    return {"username": username or "", "clientid": clientid or "",
+            "peerhost": peerhost or ""}
+
+
+class MysqlAuthenticator:
+    DEFAULT_QUERY = ("SELECT password_hash, salt, is_superuser "
+                     "FROM mqtt_user WHERE username = ${username} LIMIT 1")
+
+    def __init__(self, server: str = "127.0.0.1:3306", *,
+                 user: str = "root", password: str = "",
+                 database: str = "mqtt", query: Optional[str] = None,
+                 algo: str = "sha256", salt_position: str = "prefix",
+                 iterations: int = 4096, timeout: float = 5.0) -> None:
+        self.client = MysqlClient(server, user=user, password=password,
+                                  database=database, timeout=timeout)
+        self.query_template = query or self.DEFAULT_QUERY
+        self.algo = algo
+        self.salt_position = salt_position
+        self.iterations = iterations
+        self._parked = ParkedVerdicts()
+
+    def _sql(self, creds: Credentials) -> str:
+        return render_query(self.query_template,
+                            _ctx(creds.clientid, creds.username,
+                                 creds.peerhost))
+
+    def _evaluate(self, cols, rows, creds: Credentials) -> AuthResult:
+        if not rows:
+            return IGNORE
+        if creds.password is None:
+            return AuthResult("deny")
+        row = dict(zip(cols, rows[0]))
+        stored = row.get("password_hash")
+        if stored is None:
+            return IGNORE
+        salt = (row.get("salt") or "").encode()
+        is_super = str(row.get("is_superuser", "")).lower() in ("1", "true")
+        if _verify_password(stored, creds.password, self.algo, salt,
+                            self.salt_position, self.iterations):
+            return AuthResult("ok", is_superuser=is_super)
+        return AuthResult("deny")
+
+    async def authenticate_async(self, creds: Credentials) -> AuthResult:
+        try:
+            cols, rows = await self.client.query(self._sql(creds))
+            res = self._evaluate(cols, rows, creds)
+        except Exception as e:
+            log.warning("mysql authn unreachable: %s", e)
+            res = IGNORE
+        return self._parked.park(creds, res)
+
+    def authenticate(self, creds: Credentials) -> AuthResult:
+        parked = self._parked.take(creds)
+        if parked is not None:
+            return parked
+        if _in_event_loop():
+            log.warning("mysql authn: no pre-resolved verdict; ignoring")
+            return IGNORE
+        try:
+            cols, rows = self.client.query_blocking(self._sql(creds))
+            return self._evaluate(cols, rows, creds)
+        except Exception as e:
+            log.warning("mysql authn unreachable: %s", e)
+            return IGNORE
+
+
+class MysqlAuthzSource:
+    DEFAULT_QUERY = ("SELECT permission, action, topic "
+                     "FROM mqtt_acl WHERE username = ${username}")
+
+    def __init__(self, server: str = "127.0.0.1:3306", *,
+                 user: str = "root", password: str = "",
+                 database: str = "mqtt", query: Optional[str] = None,
+                 timeout: float = 5.0, cache_ttl: float = 10.0) -> None:
+        self.client = MysqlClient(server, user=user, password=password,
+                                  database=database, timeout=timeout)
+        self.query_template = query or self.DEFAULT_QUERY
+        self._cache = TtlCache(cache_ttl)
+
+    @staticmethod
+    def _match(rules, action, topic, clientid, username) -> str:
+        for perm, act, flt in rules:
+            perm = (perm or "").lower()
+            act = (act or "").lower()
+            if perm not in (ALLOW, DENY):
+                continue
+            if act not in ("publish", "subscribe", "all"):
+                continue
+            if act != "all" and act != action:
+                continue
+            if acl_filter_matches(flt, topic, clientid, username):
+                return perm
+        return NOMATCH
+
+    @staticmethod
+    def _rules_of(cols, rows):
+        out = []
+        for r in rows:
+            row = dict(zip(cols, r))
+            out.append((row.get("permission") or "",
+                        row.get("action") or "",
+                        row.get("topic") or ""))
+        return out
+
+    async def prefetch_async(self, clientid, username, peerhost, action,
+                             topic) -> str:
+        key = (clientid, username)
+        rules = self._cache.fresh(key)
+        if rules is None:
+            try:
+                cols, rows = await self.client.query(render_query(
+                    self.query_template,
+                    _ctx(clientid, username, peerhost)))
+                rules = self._rules_of(cols, rows)
+            except Exception as e:
+                log.warning("mysql authz unreachable: %s", e)
+                rules = []
+            self._cache.put(key, rules)
+        return self._match(rules, action, topic, clientid, username)
+
+    def authorize(self, clientid, username, peerhost, action, topic,
+                  **kw) -> str:
+        key = (clientid, username)
+        rules = self._cache.fresh(key)
+        if rules is not None:
+            return self._match(rules, action, topic, clientid, username)
+        if _in_event_loop():
+            log.warning("mysql authz: un-prefetched key; nomatch")
+            return NOMATCH
+        try:
+            cols, rows = self.client.query_blocking(render_query(
+                self.query_template, _ctx(clientid, username, peerhost)))
+            rules = self._rules_of(cols, rows)
+            self._cache.put(key, rules)
+            return self._match(rules, action, topic, clientid, username)
+        except Exception as e:
+            log.warning("mysql authz unreachable: %s", e)
+            return NOMATCH

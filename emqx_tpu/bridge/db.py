@@ -27,6 +27,7 @@ log = logging.getLogger(__name__)
 __all__ = [
     "RedisBridgeConnector", "render_redis",
     "PostgresBridgeConnector", "render_pg",
+    "MysqlBridgeConnector", "render_mysql",
     "MongoBridgeConnector", "render_mongo",
     "InfluxBridgeConnector", "render_influx",
 ]
@@ -132,6 +133,73 @@ class PostgresBridgeConnector(Connector):
                 await self.client.query(self.sql, tuple(it["params"]))
             except Exception as e:
                 raise SendError(f"pg bridge: {e}", done=i) from e
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# MySQL: INSERT via COM_QUERY with escaped literals
+# ---------------------------------------------------------------------------
+
+def render_mysql(conf: Dict[str, Any], output: Dict[str, Any],
+                 columns: Dict[str, Any]) -> Dict[str, Any]:
+    """Values render per message and are spliced as ESCAPED QUOTED
+    literals (auth/mysql.escape_literal — injection-tested); the SQL
+    template uses ${1}..${n} positions."""
+    params = [
+        _render(str(p), output, columns)
+        for p in conf.get("parameters",
+                          ["${clientid}", "${topic}", "${payload}"])
+    ]
+    return {"params": params}
+
+
+class MysqlBridgeConnector(Connector):
+    DEFAULT_SQL = ("INSERT INTO mqtt_messages (clientid, topic, payload) "
+                   "VALUES (${1}, ${2}, ${3})")
+
+    def __init__(self, conf: Dict[str, Any], name: str = "") -> None:
+        from ..auth.mysql import MysqlClient
+
+        self.client = MysqlClient(
+            conf.get("server", "127.0.0.1:3306"),
+            user=conf.get("user", "root"),
+            password=conf.get("password", ""),
+            database=conf.get("database", "mqtt"),
+            timeout=float(conf.get("timeout", 5.0)))
+        self.sql = conf.get("sql", self.DEFAULT_SQL)
+
+    def _statement(self, params: List[str]) -> str:
+        # single-pass: sequential replace would re-scan spliced values,
+        # letting a payload containing ${n} smuggle another field
+        from ..auth.mysql import escape_literal
+
+        def sub(m):
+            i = int(m.group(1)) - 1
+            if not 0 <= i < len(params):
+                return m.group(0)
+            return "'" + escape_literal(params[i]) + "'"
+
+        return re.sub(r"\$\{(\d+)\}", sub, self.sql)
+
+    async def start(self) -> None:
+        await self.client.query("SELECT 1")
+
+    async def stop(self) -> None:
+        await self.client.close()
+
+    async def health(self) -> bool:
+        try:
+            await self.client.query("SELECT 1")
+            return True
+        except Exception:
+            return False
+
+    async def send(self, items: List[Dict[str, Any]]) -> Optional[int]:
+        for i, it in enumerate(items):
+            try:
+                await self.client.query(self._statement(it["params"]))
+            except Exception as e:
+                raise SendError(f"mysql bridge: {e}", done=i) from e
         return 0
 
 

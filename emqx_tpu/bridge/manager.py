@@ -13,9 +13,9 @@ import logging
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .db import (
-    InfluxBridgeConnector, MongoBridgeConnector, PostgresBridgeConnector,
-    RedisBridgeConnector, render_influx, render_mongo, render_pg,
-    render_redis,
+    InfluxBridgeConnector, MongoBridgeConnector, MysqlBridgeConnector,
+    PostgresBridgeConnector, RedisBridgeConnector, render_influx,
+    render_mongo, render_mysql, render_pg, render_redis,
 )
 from .kafka import KafkaConnector, render_kafka
 from .mqtt_bridge import MqttConnector, render_egress
@@ -98,7 +98,7 @@ class Bridge:
 class BridgeManager:
     """All bridges of a node; resolves rule actions ``"<type>:<name>"``."""
 
-    TYPES = ("mqtt", "webhook", "kafka", "redis", "pgsql",
+    TYPES = ("mqtt", "webhook", "kafka", "redis", "pgsql", "mysql",
              "mongodb", "influxdb")
 
     def __init__(self, node: Any = None) -> None:
@@ -136,6 +136,9 @@ class BridgeManager:
         if btype == "pgsql":
             return Bridge(btype, name, conf,
                           PostgresBridgeConnector(conf, name), render_pg)
+        if btype == "mysql":
+            return Bridge(btype, name, conf,
+                          MysqlBridgeConnector(conf, name), render_mysql)
         if btype == "mongodb":
             return Bridge(btype, name, conf,
                           MongoBridgeConnector(conf, name), render_mongo)

@@ -205,10 +205,14 @@ class MgmtApi:
         except ValueError as e:
             return json_response({"message": str(e)}, 409)
         # keep the stored conf authoritative: GET /authentication and
-        # data export must see REST-added users, not just creation seeds
-        conf.setdefault("users", []).append(
-            {"user_id": uid, "password": pw,
-             "is_superuser": bool(body.get("is_superuser"))})
+        # data export must see REST-added users, not just creation
+        # seeds.  Stored as (hash, salt) where the store supports it so
+        # export archives never carry the plaintext.
+        entry = (auth.export_user(uid)
+                 if hasattr(auth, "export_user") else None) or {
+            "user_id": uid, "password": pw,
+            "is_superuser": bool(body.get("is_superuser"))}
+        conf.setdefault("users", []).append(entry)
         return json_response({"user_id": uid}, 201)
 
     async def authz_list(self, req: Request) -> Response:

@@ -171,11 +171,14 @@ def import_data(node: Any, archive: bytes) -> Dict[str, int]:
         for conf in auth_doc.get("authenticators", []):
             try:
                 auth, conf = make_authenticator(conf)
-            except (ValueError, KeyError, TypeError) as e:
+            except (ValueError, KeyError, TypeError,
+                    AttributeError) as e:
                 # a bad conf must not abort the import — but dropping a
                 # SECURITY config silently would be worse than noisy
                 log.error("import: dropping authenticator conf "
-                          "(type=%r): %s", conf.get("type"), e)
+                          "(type=%r): %s",
+                          conf.get("type") if isinstance(conf, dict)
+                          else type(conf).__name__, e)
                 continue
             ac.chain.add(auth)
             if "allow_anonymous" in conf:
@@ -185,9 +188,12 @@ def import_data(node: Any, archive: bytes) -> Dict[str, int]:
         for conf in auth_doc.get("sources", []):
             try:
                 src, conf = make_authz_source(conf)
-            except (ValueError, KeyError, TypeError) as e:
+            except (ValueError, KeyError, TypeError,
+                    AttributeError) as e:
                 log.error("import: dropping authz source conf "
-                          "(type=%r): %s", conf.get("type"), e)
+                          "(type=%r): %s",
+                          conf.get("type") if isinstance(conf, dict)
+                          else type(conf).__name__, e)
                 continue
             ac.authz.sources.append(src)
             node._authz_confs.append((conf, src))

@@ -1,7 +1,8 @@
 #!/bin/bash
-# One-shot runbook for when the TPU tunnel recovers (it has been down
-# since 2026-07-29 ~20:45Z).  Probes first; on success runs the full
-# measurement ladder and drops artifacts in /tmp/tpu_run/.
+# One-shot runbook for when the TPU tunnel recovers.  Probes first; on
+# success runs the full measurement ladder and drops artifacts in
+# /tmp/tpu_run/.  Round-3 ladder: kernel ablate, pallas A/B, 1M bench,
+# 10M bench (all through the flat-output pipelined serving path).
 set -u
 OUT=/tmp/tpu_run
 mkdir -p "$OUT"
@@ -11,18 +12,22 @@ if ! timeout 60 python -c "import jax, jax.numpy as jnp; print('TPU OK', jax.jit
   echo "tunnel still down"; exit 1
 fi
 
-echo "== kernel lab (v2 kernel, 200k filters) =="
+echo "== pallas small-table A/B (50k filters, VMEM-resident) =="
+timeout 900 python -m emqx_tpu.ops.pallas_match > "$OUT/pallas_ab.txt" 2>&1
+tail -2 "$OUT/pallas_ab.txt"
+
+echo "== kernel ablate (200k filters) =="
 timeout 600 python scripts/kernel_scan_ablate.py > "$OUT/ablate.txt" 2>&1
 tail -5 "$OUT/ablate.txt"
 
 echo "== bench 1M (config 2) =="
-timeout 1200 python bench.py --filters 1000000 --serve-seconds 8 \
+timeout 1800 python bench.py --filters 1000000 --serve-seconds 8 \
   > "$OUT/bench_1m.json" 2> "$OUT/bench_1m.err"
 tail -2 "$OUT/bench_1m.err"; head -c 400 "$OUT/bench_1m.json"; echo
 
 echo "== bench 10M (config 3, north star) =="
-timeout 2400 python bench.py \
+timeout 3000 python bench.py \
   > "$OUT/bench_10m.json" 2> "$OUT/bench_10m.err"
 tail -3 "$OUT/bench_10m.err"; head -c 400 "$OUT/bench_10m.json"; echo
 
-echo "== done; update BASELINE.md rows with $OUT/bench_*.json =="
+echo "== done; update BASELINE.md + scripts/measured_bench_10m_*.json =="
