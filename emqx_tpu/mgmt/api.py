@@ -109,6 +109,10 @@ class MgmtApi:
         r("GET", f"{v}/gateways", self.gateways_list)
         r("PUT", f"{v}/gateways/{{name}}/enable/{{enable}}",
           self.gateways_enable)
+        r("GET", f"{v}/mqtt/topic_metrics", self.topic_metrics_list)
+        r("POST", f"{v}/mqtt/topic_metrics", self.topic_metrics_add)
+        r("DELETE", f"{v}/mqtt/topic_metrics/{{topic+}}",
+          self.topic_metrics_delete)
         r("GET", f"{v}/slow_subscriptions", self.slow_subs_list)
         r("DELETE", f"{v}/slow_subscriptions", self.slow_subs_clear)
         r("GET", f"{v}/plugins", self.plugins_list)
@@ -698,6 +702,27 @@ class MgmtApi:
             return json_response(
                 {"code": "BAD_USERNAME_OR_PWD",
                  "message": "incorrect old password"}, 401)
+        return Response(204)
+
+    async def topic_metrics_list(self, req: Request) -> Response:
+        return json_response({"data": self.node.topic_metrics.all()})
+
+    async def topic_metrics_add(self, req: Request) -> Response:
+        body = req.json() or {}
+        topic = body.get("topic")
+        if not topic:
+            return json_response({"message": "topic required"}, 400)
+        try:
+            return json_response(
+                self.node.topic_metrics.register(topic), 201)
+        except KeyError:
+            return json_response({"message": "already registered"}, 409)
+        except (ValueError, OverflowError) as e:
+            return json_response({"message": str(e)}, 400)
+
+    async def topic_metrics_delete(self, req: Request) -> Response:
+        if not self.node.topic_metrics.deregister(req.params["topic"]):
+            return json_response({"message": "not registered"}, 404)
         return Response(204)
 
     async def slow_subs_list(self, req: Request) -> Response:

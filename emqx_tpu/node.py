@@ -131,6 +131,11 @@ class BrokerNode:
             ).attach(self.broker)
             if cfg.get("slow_subs.enable") else None
         )
+        from .observe.topic_metrics import TopicMetrics
+
+        self.topic_metrics = TopicMetrics(
+            max_topics=cfg.get("topic_metrics.max_topics")
+        ).attach(self.broker)
         self.plugins = PluginManager(self)
         self.psk = None
         if cfg.get("psk.enable"):

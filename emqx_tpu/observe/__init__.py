@@ -10,9 +10,11 @@ the ``tpu.*`` namespace.
 from .metrics import Metrics, METRIC_NAMES
 from .stats import Stats, STAT_NAMES
 from .alarm import Alarms, Alarm
+from .topic_metrics import TopicMetrics
 from .sys_topics import SysBroker
 
 __all__ = [
+    "TopicMetrics",
     "Metrics", "METRIC_NAMES", "Stats", "STAT_NAMES",
     "Alarms", "Alarm", "SysBroker",
 ]

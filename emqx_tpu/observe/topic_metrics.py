@@ -1,0 +1,106 @@
+"""Per-topic message counters — the ``emqx_topic_metrics`` analog
+(``apps/emqx_modules`` [U], SURVEY.md §2.3).
+
+Operators register EXACT topic names (the reference rejects wildcards
+here — counting rides the publish path and must stay O(1)); each
+registered topic accumulates ``messages.in`` / ``messages.out`` /
+``messages.qos<n>.in`` and a rolling in-rate.  Capped at ``max_topics``
+(reference default 512).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import topic as T
+
+__all__ = ["TopicMetrics"]
+
+
+class TopicMetrics:
+    MAX_TOPICS = 512
+
+    def __init__(self, max_topics: int = MAX_TOPICS) -> None:
+        self.max_topics = max_topics
+        self._m: Dict[str, Dict[str, Any]] = {}
+
+    # -- registry -----------------------------------------------------------
+
+    def register(self, topic: str) -> Dict[str, Any]:
+        if T.wildcard(topic):
+            raise ValueError("topic_metrics takes exact topics, "
+                             "not filters")
+        if topic in self._m:
+            raise KeyError(f"{topic!r} already registered")
+        if len(self._m) >= self.max_topics:
+            raise OverflowError(
+                f"topic_metrics full ({self.max_topics})")
+        self._m[topic] = {
+            "create_time": time.time(),
+            "messages.in": 0, "messages.out": 0,
+            "messages.qos0.in": 0, "messages.qos1.in": 0,
+            "messages.qos2.in": 0, "messages.dropped": 0,
+            "_win_start": time.time(), "_win_in": 0, "rate.in": 0.0,
+        }
+        return self.info(topic)
+
+    def deregister(self, topic: str) -> bool:
+        return self._m.pop(topic, None) is not None
+
+    def reset(self, topic: Optional[str] = None) -> None:
+        for t, rec in self._m.items():
+            if topic is None or t == topic:
+                for k in list(rec):
+                    if k.startswith("messages."):
+                        rec[k] = 0
+                rec["_win_in"] = 0
+
+    def topics(self) -> List[str]:
+        return sorted(self._m)
+
+    # -- hot-path accounting (exact-match dict hits only) -------------------
+
+    def on_publish(self, msg: Any) -> None:
+        rec = self._m.get(msg.topic)
+        if rec is None:
+            return
+        rec["messages.in"] += 1
+        rec[f"messages.qos{min(msg.qos, 2)}.in"] += 1
+        rec["_win_in"] += 1
+        now = time.time()
+        dt = now - rec["_win_start"]
+        if dt >= 5.0:
+            rec["rate.in"] = round(rec["_win_in"] / dt, 3)
+            rec["_win_start"] = now
+            rec["_win_in"] = 0
+
+    def on_delivered(self, clientid: str, msg: Any) -> None:
+        rec = self._m.get(msg.topic)
+        if rec is not None:
+            rec["messages.out"] += 1
+
+    def on_dropped(self, msg: Any, reason: str) -> None:
+        rec = self._m.get(msg.topic)
+        if rec is not None:
+            rec["messages.dropped"] += 1
+
+    # -- views --------------------------------------------------------------
+
+    def info(self, topic: str) -> Dict[str, Any]:
+        rec = self._m[topic]
+        return {"topic": topic,
+                **{k: v for k, v in rec.items()
+                   if not k.startswith("_")}}
+
+    def all(self) -> List[Dict[str, Any]]:
+        return [self.info(t) for t in self.topics()]
+
+    def attach(self, broker: Any) -> "TopicMetrics":
+        broker.hooks.add("message.publish", self.on_publish,
+                         name="topic_metrics.in")
+        broker.hooks.add("message.delivered", self.on_delivered,
+                         name="topic_metrics.out")
+        broker.hooks.add("message.dropped", self.on_dropped,
+                         name="topic_metrics.dropped")
+        return self

@@ -147,3 +147,75 @@ def test_connections_count_tracks_live_channels():
             await node.stop()
 
     asyncio.run(main())
+
+
+def test_topic_metrics_counts_and_rest():
+    """emqx_topic_metrics analog: exact-topic counters over the publish
+    path + REST lifecycle."""
+    import asyncio
+
+    async def main():
+        import json as _json
+
+        from emqx_tpu.bridge import httpc
+        from emqx_tpu.client import Client
+        from emqx_tpu.config import Config
+        from emqx_tpu.node import BrokerNode
+
+        node = BrokerNode(Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'dashboard.enable = true\ndashboard.listen = "127.0.0.1:0"\n'
+            'api_key.enable = true\napi_key.key = "k"\n'
+            'api_key.secret = "s"\n')))
+        await node.start()
+        try:
+            base = f"http://127.0.0.1:{node.mgmt_server.port}/api/v5"
+            r = await httpc.request("POST", f"{base}/login", body=_json.dumps(
+                {"username": "admin", "password": "public"}).encode())
+            tok = _json.loads(r.body)["token"]
+            hdr = {"authorization": f"Bearer {tok}"}
+
+            r = await httpc.request("POST", f"{base}/mqtt/topic_metrics",
+                                    headers=hdr,
+                                    body=b'{"topic": "m/1"}')
+            assert r.status == 201
+            # wildcards rejected; duplicates 409
+            r = await httpc.request("POST", f"{base}/mqtt/topic_metrics",
+                                    headers=hdr,
+                                    body=b'{"topic": "m/+"}')
+            assert r.status == 400
+            r = await httpc.request("POST", f"{base}/mqtt/topic_metrics",
+                                    headers=hdr,
+                                    body=b'{"topic": "m/1"}')
+            assert r.status == 409
+
+            port = node.listeners.all()[0].port
+            sub = Client(clientid="tm-s", port=port)
+            await sub.connect()
+            await sub.subscribe("m/1")
+            pub = Client(clientid="tm-p", port=port)
+            await pub.connect()
+            for i in range(3):
+                await pub.publish("m/1", b"x", qos=1)
+            await pub.publish("m/other", b"x")  # unregistered: no count
+            await asyncio.wait_for(sub.messages.get(), 5)
+
+            r = await httpc.request("GET", f"{base}/mqtt/topic_metrics",
+                                    headers=hdr)
+            data = _json.loads(r.body)["data"]
+            assert len(data) == 1
+            rec = data[0]
+            assert rec["topic"] == "m/1"
+            assert rec["messages.in"] == 3
+            assert rec["messages.qos1.in"] == 3
+            assert rec["messages.out"] >= 1
+
+            r = await httpc.request(
+                "DELETE", f"{base}/mqtt/topic_metrics/m/1", headers=hdr)
+            assert r.status == 204
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
