@@ -225,7 +225,8 @@ SCHEMA: Dict[str, Field] = {
     "cluster.node_timeout": Field(5.0, duration),
 
     # -- observability extras (emqx_slow_subs / statsd / telemetry) -------
-    "topic_metrics.max_topics": Field(512, int),
+    "topic_metrics.max_topics": Field(512, int,
+                                      lambda v: 1 <= v <= 65536),
     "slow_subs.enable": Field(False, _bool),
     "slow_subs.threshold": Field(0.5, duration),
     "slow_subs.top_k": Field(10, int, lambda v: 1 <= v <= 1000),

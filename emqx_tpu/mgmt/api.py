@@ -113,6 +113,8 @@ class MgmtApi:
         r("POST", f"{v}/mqtt/topic_metrics", self.topic_metrics_add)
         r("DELETE", f"{v}/mqtt/topic_metrics/{{topic+}}",
           self.topic_metrics_delete)
+        r("PUT", f"{v}/mqtt/topic_metrics/{{topic+}}/reset",
+          self.topic_metrics_reset)
         r("GET", f"{v}/slow_subscriptions", self.slow_subs_list)
         r("DELETE", f"{v}/slow_subscriptions", self.slow_subs_clear)
         r("GET", f"{v}/plugins", self.plugins_list)
@@ -717,12 +719,20 @@ class MgmtApi:
                 self.node.topic_metrics.register(topic), 201)
         except KeyError:
             return json_response({"message": "already registered"}, 409)
-        except (ValueError, OverflowError) as e:
+        except OverflowError as e:
             return json_response({"message": str(e)}, 400)
+        # ValueError (bad topic) rides the dispatcher's 400 mapping
 
     async def topic_metrics_delete(self, req: Request) -> Response:
         if not self.node.topic_metrics.deregister(req.params["topic"]):
             return json_response({"message": "not registered"}, 404)
+        return Response(204)
+
+    async def topic_metrics_reset(self, req: Request) -> Response:
+        t = req.params["topic"]
+        if t not in self.node.topic_metrics.topics():
+            return json_response({"message": "not registered"}, 404)
+        self.node.topic_metrics.reset(t)
         return Response(204)
 
     async def slow_subs_list(self, req: Request) -> Response:

@@ -28,9 +28,11 @@ class TopicMetrics:
     # -- registry -----------------------------------------------------------
 
     def register(self, topic: str) -> Dict[str, Any]:
-        if T.wildcard(topic):
-            raise ValueError("topic_metrics takes exact topics, "
-                             "not filters")
+        if not isinstance(topic, str):
+            raise ValueError("topic must be a string")
+        # full name validation: embedded +/# (invalid per MQTT) would
+        # silently consume a slot no publish can ever hit
+        T.validate(topic, kind="name")
         if topic in self._m:
             raise KeyError(f"{topic!r} already registered")
         if len(self._m) >= self.max_topics:
@@ -55,6 +57,8 @@ class TopicMetrics:
                     if k.startswith("messages."):
                         rec[k] = 0
                 rec["_win_in"] = 0
+                rec["_win_start"] = time.time()
+                rec["rate.in"] = 0.0
 
     def topics(self) -> List[str]:
         return sorted(self._m)
@@ -68,12 +72,6 @@ class TopicMetrics:
         rec["messages.in"] += 1
         rec[f"messages.qos{min(msg.qos, 2)}.in"] += 1
         rec["_win_in"] += 1
-        now = time.time()
-        dt = now - rec["_win_start"]
-        if dt >= 5.0:
-            rec["rate.in"] = round(rec["_win_in"] / dt, 3)
-            rec["_win_start"] = now
-            rec["_win_in"] = 0
 
     def on_delivered(self, clientid: str, msg: Any) -> None:
         rec = self._m.get(msg.topic)
@@ -88,7 +86,18 @@ class TopicMetrics:
     # -- views --------------------------------------------------------------
 
     def info(self, topic: str) -> Dict[str, Any]:
+        # rate computed at READ time over the current window, so it
+        # decays to 0 when publishing stops instead of freezing at the
+        # last in-publish value
         rec = self._m[topic]
+        now = time.time()
+        dt = now - rec["_win_start"]
+        if dt >= 5.0:
+            rec["rate.in"] = round(rec["_win_in"] / dt, 3)
+            rec["_win_start"] = now
+            rec["_win_in"] = 0
+        elif dt > 0 and rec["_win_in"]:
+            rec["rate.in"] = round(rec["_win_in"] / max(dt, 1.0), 3)
         return {"topic": topic,
                 **{k: v for k, v in rec.items()
                    if not k.startswith("_")}}

@@ -210,6 +210,23 @@ def test_topic_metrics_counts_and_rest():
             assert rec["messages.qos1.in"] == 3
             assert rec["messages.out"] >= 1
 
+            # reset zeroes counters and rate
+            r = await httpc.request(
+                "PUT", f"{base}/mqtt/topic_metrics/m/1/reset",
+                headers=hdr)
+            assert r.status == 204
+            r = await httpc.request("GET", f"{base}/mqtt/topic_metrics",
+                                    headers=hdr)
+            rec = _json.loads(r.body)["data"][0]
+            assert rec["messages.in"] == 0 and rec["rate.in"] == 0.0
+            # invalid names: embedded wildcard chars and non-strings
+            r = await httpc.request("POST", f"{base}/mqtt/topic_metrics",
+                                    headers=hdr,
+                                    body=b'{"topic": "a/x+y"}')
+            assert r.status == 400
+            r = await httpc.request("POST", f"{base}/mqtt/topic_metrics",
+                                    headers=hdr, body=b'{"topic": 123}')
+            assert r.status == 400
             r = await httpc.request(
                 "DELETE", f"{base}/mqtt/topic_metrics/m/1", headers=hdr)
             assert r.status == 204
