@@ -39,6 +39,12 @@ log = logging.getLogger(__name__)
 __all__ = ["Lwm2mGateway"]
 
 
+def _valid_ep(ep: str) -> bool:
+    """Endpoint names land inside topic names: wildcards or level
+    separators would cross into OTHER devices' topic spaces."""
+    return bool(ep) and not any(c in ep for c in "/+#\x00")
+
+
 class Lwm2mClient(GatewayConn):
     """One registered LwM2M endpoint."""
 
@@ -253,6 +259,58 @@ class Lwm2mGateway(Gateway):
 
     OPT_LOCATION_PATH = 8
 
+    def _run_bootstrap(self, ep: str, addr) -> None:
+        """Push the configured writes + Bootstrap-Finish to the device.
+
+        ``conf["bootstrap"]`` = {"writes": [{"path": "/0/0/0",
+        "value": "coap://host:5783"}, ...]}, optionally overridden per
+        endpoint under conf["bootstrap"]["endpoints"][ep].  Writes are
+        CON PUTs (fire-and-forget: a lost write surfaces as a failed
+        registration, which re-triggers bootstrap — the reference's
+        posture)."""
+        from ..broker.message import make_message
+
+        bs = self.conf.get("bootstrap") or {}
+        per_ep = (bs.get("endpoints") or {}).get(ep)
+        # an explicit (even empty) per-endpoint writes list OVERRIDES
+        # the global one — `or` would silently resurrect the global
+        # writes for endpoints configured to get only Bootstrap-Finish
+        if per_ep is not None and "writes" in per_ep:
+            writes = per_ep["writes"]
+        else:
+            writes = bs.get("writes") or []
+        for w in writes:
+            segs = [s for s in str(w.get("path", "")).split("/") if s]
+            opts = [(C.OPT_URI_PATH, s.encode()) for s in segs]
+            payload = str(w.get("value", "")).encode()
+            self.transport.sendto(C.encode(C.CoapMessage(
+                C.CON, C.PUT, self._bs_mid(), b"", opts, payload)), addr)
+        # Bootstrap-Finish: POST /bs on the DEVICE
+        self.transport.sendto(C.encode(C.CoapMessage(
+            C.CON, C.POST, self._bs_mid(), b"",
+            [(C.OPT_URI_PATH, b"bs")])), addr)
+        # the uplink event rides the SAME ACL gate as every other
+        # lwm2m publish (a direct broker.publish would bypass deny
+        # rules on lwm2m/#)
+        topic = f"lwm2m/{ep}/up/bootstrap"
+        acc = self.node.broker.hooks.run_fold(
+            "client.authorize",
+            (f"lwm2m-{ep}", "publish", topic, {"qos": 0}), True)
+        if acc is not True:
+            log.warning("lwm2m bootstrap uplink denied for %s", ep)
+            return
+        self.node.broker.publish(make_message(
+            f"lwm2m-{ep}", topic,
+            json.dumps({"op": "bootstrap", "writes": len(writes)},
+                       separators=(",", ":")).encode()))
+
+    _bs_mid_counter = 0x4000
+
+    def _bs_mid(self) -> int:
+        Lwm2mGateway._bs_mid_counter = (
+            (Lwm2mGateway._bs_mid_counter + 1) & 0xFFFF) or 1
+        return Lwm2mGateway._bs_mid_counter
+
     def handle_request(self, msg: C.CoapMessage, addr) -> None:
         path = [v.decode("utf-8", "replace")
                 for v in msg.opt_all(C.OPT_URI_PATH)]
@@ -276,15 +334,25 @@ class Lwm2mGateway(Gateway):
                     self._mid_cache.pop(self._mid_order.pop(0), None)
             self.transport.sendto(data, addr)
 
+        if path and path[0] == "bs" and msg.code == C.POST:
+            # -- bootstrap interface: POST /bs?ep=.. --------------------
+            # (LwM2M 1.0 §5.2: device requests bootstrap; the server
+            # pushes Write(s) for the configured security/server
+            # objects, then Bootstrap-Finish)
+            ep = query.get("ep", "")
+            if not _valid_ep(ep):
+                return reply(C.BAD_REQUEST)
+            reply(C.code(2, 4))                    # 2.04 Changed
+            self._run_bootstrap(ep, addr)
+            return
+
         if not path or path[0] != "rd":
             return reply(C.NOT_FOUND)
 
         if msg.code == C.POST and len(path) == 1:
             # -- register: POST /rd?ep=..&lt=.. -------------------------
             ep = query.get("ep", "")
-            # the endpoint lands inside topic names: wildcards/levels in
-            # it would subscribe to OTHER devices' downlinks
-            if not ep or any(c in ep for c in "/+#\x00"):
+            if not _valid_ep(ep):
                 return reply(C.BAD_REQUEST)
             try:
                 lifetime = int(query.get("lt", "86400") or 86400)

@@ -729,10 +729,8 @@ class MgmtApi:
         return Response(204)
 
     async def topic_metrics_reset(self, req: Request) -> Response:
-        t = req.params["topic"]
-        if t not in self.node.topic_metrics.topics():
+        if not self.node.topic_metrics.reset(req.params["topic"]):
             return json_response({"message": "not registered"}, 404)
-        self.node.topic_metrics.reset(t)
         return Response(204)
 
     async def slow_subs_list(self, req: Request) -> Response:

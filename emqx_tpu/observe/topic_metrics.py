@@ -50,15 +50,22 @@ class TopicMetrics:
     def deregister(self, topic: str) -> bool:
         return self._m.pop(topic, None) is not None
 
-    def reset(self, topic: Optional[str] = None) -> None:
-        for t, rec in self._m.items():
-            if topic is None or t == topic:
-                for k in list(rec):
-                    if k.startswith("messages."):
-                        rec[k] = 0
-                rec["_win_in"] = 0
-                rec["_win_start"] = time.time()
-                rec["rate.in"] = 0.0
+    def reset(self, topic: Optional[str] = None) -> bool:
+        """Zero one topic's counters (or all when topic is None);
+        returns whether anything matched."""
+        if topic is not None:
+            rec = self._m.get(topic)
+            recs = [rec] if rec is not None else []
+        else:
+            recs = list(self._m.values())
+        for rec in recs:
+            for k in list(rec):
+                if k.startswith("messages."):
+                    rec[k] = 0
+            rec["_win_in"] = 0
+            rec["_win_start"] = time.time()
+            rec["rate.in"] = 0.0
+        return bool(recs)
 
     def topics(self) -> List[str]:
         return sorted(self._m)

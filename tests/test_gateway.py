@@ -945,3 +945,70 @@ def test_mqttsn_unpack_garbage_never_crashes():
         body = bytes(rng.randrange(256) for _ in range(rng.randint(0, 300)))
         out = _unpack(_pack(t, body))
         assert out == (t, body)
+
+
+def test_lwm2m_bootstrap_interface():
+    """LwM2M 1.0 §5.2 bootstrap: POST /bs?ep= -> 2.04, then the server
+    pushes the configured Writes and Bootstrap-Finish."""
+    async def main():
+        node = await start_node('gateway.lwm2m.enable = true\n'
+                                'gateway.lwm2m.bind = "127.0.0.1:0"\n')
+        try:
+            gw = node.gateways.gateways["lwm2m"]
+            gw.conf["bootstrap"] = {"writes": [
+                {"path": "/0/0/0", "value": "coap://srv:5783"},
+                {"path": "/1/0/1", "value": "300"},
+            ]}
+            lport = gw.port
+            mq = Client(clientid="mb", port=mqtt_port(node))
+            await mq.connect()
+            await mq.subscribe("lwm2m/bdev/up/#")
+
+            dev = FakeLwm2mDevice(lport)
+
+            def run_bootstrap():
+                C = dev.C
+                dev.sock.sendto(C.encode(C.CoapMessage(
+                    C.CON, C.POST, 901, b"\x0b",
+                    [(C.OPT_URI_PATH, b"bs"),
+                     (C.OPT_URI_QUERY, b"ep=bdev")])), dev.addr)
+                ack = dev.recv()
+                assert ack.code == C.code(2, 4), ack.code
+                finish = False
+                for _ in range(3):        # 2 writes + finish
+                    req = dev.recv()
+                    path = "/" + "/".join(
+                        v.decode() for v in req.opt_all(C.OPT_URI_PATH))
+                    if req.code == C.PUT:
+                        dev.resources[path] = req.payload.decode()
+                    elif req.code == C.POST and path == "/bs":
+                        finish = True
+                    dev.sock.sendto(C.encode(C.CoapMessage(
+                        C.ACK, C.code(2, 4), req.mid, req.token)),
+                        dev.addr)
+                return finish
+
+            finish = await asyncio.to_thread(run_bootstrap)
+            assert finish, "no Bootstrap-Finish"
+            assert dev.resources["/0/0/0"] == "coap://srv:5783"
+            assert dev.resources["/1/0/1"] == "300"
+
+            ev = await mq.recv(timeout=5)
+            assert ev.topic == "lwm2m/bdev/up/bootstrap"
+            assert json.loads(ev.payload)["writes"] == 2
+
+            # bad endpoint names are rejected
+            def bad_ep():
+                C = dev.C
+                dev.sock.sendto(C.encode(C.CoapMessage(
+                    C.CON, C.POST, 902, b"\x0c",
+                    [(C.OPT_URI_PATH, b"bs"),
+                     (C.OPT_URI_QUERY, b"ep=a/b")])), dev.addr)
+                return dev.recv().code
+
+            assert await asyncio.to_thread(bad_ep) == dev.C.BAD_REQUEST
+            dev.close()
+        finally:
+            await node.stop()
+
+    run(main())
