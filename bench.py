@@ -263,6 +263,46 @@ def bench_device(table, topics, batch, iters, depth, active_slots):
     return dev, out
 
 
+def bench_config1(n_clients: int = 100, rate_per_client: float = 20.0,
+                  duration: float = 6.0) -> dict:
+    """BASELINE config 1: emqtt_bench-style broker e2e — N exact-topic
+    subscriber/publisher pairs through a LIVE in-process node over real
+    TCP, measuring delivered msg/s and end-to-end p50/p99 (host path;
+    single core)."""
+    import asyncio as aio
+
+    from emqx_tpu.bench_client import run_scenario
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    async def run():
+        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", False)   # host-path e2e: no device drag
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            out = await run_scenario(
+                "pub", port=node.listeners.all()[0].port,
+                count=n_clients, rate=rate_per_client,
+                subscribers=n_clients, topic="bench/%i",
+                qos=1, payload_size=64, duration=duration)
+        finally:
+            await node.stop()
+        return out
+
+    s = aio.run(run())
+    lat = s.get("latency_us") or {}
+    return {
+        "clients": n_clients,
+        "offered_msgs_per_s": int(n_clients * rate_per_client),
+        "sent": s.get("sent"),
+        "received": s.get("received"),
+        "msgs_per_s": round(s.get("received", 0) / duration, 1),
+        "e2e_p50_us": lat.get("p50"),
+        "e2e_p99_us": lat.get("p99"),
+    }
+
+
 SERVE_INFLIGHT = 8   # batches in flight: d2h of i overlaps compute of i+1..
 FLAT_CAP_MULT = 6    # flat-output capacity = 6·batch ids (avg fan-out ~4)
 
@@ -519,6 +559,8 @@ def main():
                                          8192, args.depth)
         table, kind, build_s = build_table(filters, args.depth)
         cpu = bench_cpu_native(table, topics, args.cpu_budget_s)
+        c1 = bench_config1(n_clients=10 if args.smoke else 100,
+                           duration=2.0 if args.smoke else 6.0)
         # the most recent full on-chip run is checked into the repo so a
         # tunnel outage at bench time (recurring: 2026-07-29, -30) does
         # not erase the measured result — clearly labeled as such
@@ -552,6 +594,7 @@ def main():
             "table": {"kind": kind, "build_s": round(build_s, 1)},
             "cpu_native": {k: round(v, 3) if isinstance(v, float) else v
                            for k, v in cpu.items()},
+            "config1_broker_e2e": c1,
         }))
         return
 
@@ -569,6 +612,11 @@ def main():
         filters, topics, args.cpu_budget_s,
         max_filters=200_000 if not args.smoke else 2000)
     note(f"cpu baselines done (native {cpu['topics_per_s']:.0f}/s)")
+    c1 = bench_config1(
+        n_clients=10 if args.smoke else 100,
+        duration=2.0 if args.smoke else 6.0)
+    note(f"config1 broker e2e done: {c1['msgs_per_s']}/s "
+         f"p99={c1['e2e_p99_us']}us")
 
     dev, tpu = bench_device(table, topics, args.batch, args.iters,
                             args.depth, args.active_slots)
@@ -647,6 +695,7 @@ def main():
         "serve_device": serve_dev,
         "serve_device_half_batch": serve_dev2,
         "serve_cpu_iso": serve_cpu,
+        "config1_broker_e2e": c1,
         "delta": deltas,
     }
     print(json.dumps(result))
