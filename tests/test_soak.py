@@ -1,0 +1,146 @@
+"""Full-subsystem soak: one node with gateways, bridges, rules,
+retainer, delayed, tracing, slow-subs, topic-metrics and the dashboard
+ALL enabled, under a mixed workload — cross-subsystem integration
+invariants (no lost deliveries, no errored hooks, consistent counters).
+The reference's CT suites soak similar all-app nodes (SURVEY.md §4)."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from emqx_tpu.client import Client
+from emqx_tpu.config import Config
+from emqx_tpu.node import BrokerNode
+
+from test_kafka_bridge import MockKafka
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_all_subsystems_soak(caplog, tmp_path):
+    async def main():
+        mk = await MockKafka(topics={"soak": 1}).start()
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'listeners.ws.default.enable = true\n'
+            'listeners.ws.default.bind = "127.0.0.1:0"\n'
+            'gateway.stomp.enable = true\n'
+            'gateway.stomp.bind = "127.0.0.1:0"\n'
+            'gateway.mqttsn.enable = true\n'
+            'gateway.mqttsn.bind = "127.0.0.1:0"\n'
+            'gateway.coap.enable = true\n'
+            'gateway.coap.bind = "127.0.0.1:0"\n'
+            'dashboard.enable = true\n'
+            'dashboard.listen = "127.0.0.1:0"\n'
+            'api_key.enable = true\n'
+            'api_key.key = "k"\napi_key.secret = "s"\n'
+            'slow_subs.enable = true\n'
+            'flapping_detect.enable = true\n'
+            'delayed.enable = true\n'
+            'retainer.enable = true\n'))
+        node = BrokerNode(cfg)
+        node.tracing.dir = str(tmp_path)   # keep trace files out of cwd
+        await node.start()
+        try:
+            port = node.listeners.all()[0].port
+            node.topic_metrics.register("soak/hot")
+            await node.bridges.create("kafka", "sk", {
+                "server": f"127.0.0.1:{mk.port}", "topic": "soak",
+                "resource_opts": {"batch_size": 16, "retry_base": 0.01},
+            })
+            node.rule_engine.create_rule(
+                "rsk", 'SELECT topic, payload, clientid FROM "soak/#"',
+                actions=["kafka:sk"])
+            node.tracing.create("t1", "topic", "soak/#")
+
+            subs = []
+            for i in range(8):
+                c = Client(clientid=f"soak-s{i}", port=port)
+                await c.connect()
+                await c.subscribe("soak/#", qos=1)
+                subs.append(c)
+
+            pubs = []
+            for i in range(4):
+                c = Client(clientid=f"soak-p{i}", port=port)
+                await c.connect()
+                pubs.append(c)
+
+            N = 40
+            for n in range(N):
+                p = pubs[n % len(pubs)]
+                await p.publish("soak/hot", b"m%d" % n, qos=1,
+                                retain=(n % 10 == 0))
+                if n % 7 == 0:
+                    await p.publish("$delayed/1/soak/later", b"d%d" % n,
+                                    qos=0)
+
+            # every subscriber gets every soak/hot message; count ONLY
+            # soak/hot (the delayed soak/later fan-out also lands in
+            # these queues and must not satisfy the wait early)
+            want = N * len(subs)
+            hot_seen = 0
+
+            async def got():
+                nonlocal hot_seen
+                for s in subs:
+                    while not s.messages.empty():
+                        if s.messages.get_nowait().topic == "soak/hot":
+                            hot_seen += 1
+                return hot_seen >= want
+
+            for _ in range(400):
+                if await got():
+                    break
+                await asyncio.sleep(0.02)
+            assert await got(), (hot_seen, want)
+
+            # delayed publishes fire
+            late = Client(clientid="soak-late", port=port)
+            await late.connect()
+            await late.subscribe("soak/later", qos=0)
+            m = await asyncio.wait_for(late.messages.get(), 10)
+            assert m.topic == "soak/later"
+
+            # retained replay for a late subscriber
+            r = Client(clientid="soak-ret", port=port)
+            await r.connect()
+            await r.subscribe("soak/hot", qos=0)
+            m = await asyncio.wait_for(r.messages.get(), 5)
+            assert m.retain
+
+            # bridge egressed everything
+            br = node.bridges.get("kafka:sk")
+            for _ in range(400):
+                if br.worker.metrics["success"] >= N:
+                    break
+                await asyncio.sleep(0.02)
+            assert br.worker.metrics["success"] >= N
+            assert len(mk.all_records("soak")) >= N
+
+            # counters consistent
+            tm = node.topic_metrics.info("soak/hot")
+            assert tm["messages.in"] == N
+            assert tm["messages.out"] >= want
+            stats = node.observed.stats.all()
+            assert stats["connections.count"] == len(subs) + len(pubs) + 2
+            # trace captured publish events
+            node.tracing.stop("t1")
+            data = node.tracing.read("t1")
+            assert b"soak/hot" in data
+
+            for c in subs + pubs + [late, r]:
+                await c.disconnect()
+        finally:
+            await node.stop()
+            await mk.stop()
+
+    # no ERROR-level records from any subsystem during the soak
+    with caplog.at_level(logging.ERROR):
+        run(main())
+    errors = [r for r in caplog.records if r.levelno >= logging.ERROR]
+    assert not errors, [r.getMessage() for r in errors]
