@@ -91,10 +91,13 @@ def bson_decode(data: bytes) -> Dict[str, Any]:
 
 def _dec_doc(data: bytes, off: int) -> Tuple[Dict[str, Any], int]:
     (ln,) = struct.unpack_from("<i", data, off)
+    if ln < 5:                        # doc = int32 len + terminator NUL
+        raise MongoError(f"bad document length {ln}")
     end = off + ln - 1                # position of the trailing NUL
     off += 4
     out: Dict[str, Any] = {}
     while off < end:
+        start = off
         t = data[off]
         off += 1
         nul = data.index(b"\x00", off)
@@ -105,6 +108,10 @@ def _dec_doc(data: bytes, off: int) -> Tuple[Dict[str, Any], int]:
             off += 8
         elif t == 0x02:
             (sl,) = struct.unpack_from("<i", data, off)
+            if sl < 1:                # length includes the NUL: >= 1.
+                # A NEGATIVE sl would move the cursor BACKWARD and spin
+                # this loop forever — a hostile server's one-packet DoS
+                raise MongoError(f"bad string length {sl}")
             out[name] = data[off + 4:off + 4 + sl - 1].decode()
             off += 4 + sl
         elif t in (0x03, 0x04):
@@ -123,6 +130,8 @@ def _dec_doc(data: bytes, off: int) -> Tuple[Dict[str, Any], int]:
             off += 8
         else:
             raise MongoError(f"unsupported BSON element type 0x{t:02x}")
+        if off <= start:              # belt-and-braces: must ADVANCE
+            raise MongoError("element did not advance")
     return out, end + 1
 
 

@@ -70,6 +70,12 @@ def test_all_subsystems_soak(caplog, tmp_path):
                 await c.connect()
                 pubs.append(c)
 
+            # subscribed BEFORE the $delayed publishes: their 1s fuse
+            # can burn down during the hot-drain loop on a loaded box
+            late = Client(clientid="soak-late", port=port)
+            await late.connect()
+            await late.subscribe("soak/later", qos=0)
+
             N = 40
             for n in range(N):
                 p = pubs[n % len(pubs)]
@@ -99,10 +105,9 @@ def test_all_subsystems_soak(caplog, tmp_path):
                 await asyncio.sleep(0.02)
             assert await got(), (hot_seen, want)
 
+
+
             # delayed publishes fire
-            late = Client(clientid="soak-late", port=port)
-            await late.connect()
-            await late.subscribe("soak/later", qos=0)
             m = await asyncio.wait_for(late.messages.get(), 10)
             assert m.topic == "soak/later"
 

@@ -1,0 +1,146 @@
+"""Malformed-input robustness for the hand-rolled wire parsers (Kafka
+record batches, BSON, BER/LDAP, MySQL lenenc) — a buggy or hostile
+server must produce a clean Python exception, never a hang, wrong-type
+crash deep in a loop, or silent corruption.  Mirrors the gateway codec
+fuzz suite's posture."""
+
+import random
+import struct
+
+import pytest
+
+from emqx_tpu.auth.ldap import ber, ber_parse
+from emqx_tpu.auth.mongo import bson_decode, bson_encode
+from emqx_tpu.auth.mysql import _lenenc
+from emqx_tpu.bridge.kafka import (
+    parse_batches, parse_record_batch, record_batch,
+)
+
+# NOTE: MemoryError is deliberately NOT acceptable — a parser trusting
+# an attacker-controlled length into a giant allocation is exactly the
+# DoS this suite exists to reject
+OK_ERRORS = (ValueError, KeyError, IndexError, struct.error,
+             UnicodeDecodeError, OverflowError)
+
+
+def _mutations(blob: bytes, rng: random.Random, n: int = 200):
+    for _ in range(n):
+        b = bytearray(blob)
+        op = rng.randrange(3)
+        if op == 0 and b:                      # flip a byte
+            i = rng.randrange(len(b))
+            b[i] ^= rng.randrange(1, 256)
+        elif op == 1:                          # truncate
+            b = b[: rng.randrange(len(b) + 1)]
+        else:                                  # splice garbage
+            i = rng.randrange(len(b) + 1)
+            b[i:i] = bytes(rng.randrange(256)
+                           for _ in range(rng.randrange(1, 9)))
+        yield bytes(b)
+
+
+def test_kafka_batch_parser_survives_mutation():
+    from emqx_tpu.bridge.kafka import KafkaError
+
+    rng = random.Random(7)
+    base = record_batch([(b"k", b"v1"), (None, b"v2"), (b"", b"")],
+                        base_offset=5)
+    for blob in _mutations(base, rng, 300):
+        try:
+            parse_batches(blob)
+        except (KafkaError, *OK_ERRORS):
+            pass
+        try:
+            parse_record_batch(blob)
+        except (KafkaError, *OK_ERRORS):
+            pass
+
+
+def test_bson_decoder_survives_mutation():
+    from emqx_tpu.auth.mongo import MongoError
+
+    rng = random.Random(11)
+    base = bson_encode({"a": 1, "s": "xx", "n": None, "d": {"k": True},
+                        "arr": [1, "two", 3.5], "big": 2 ** 40})
+    for blob in _mutations(base, rng, 300):
+        try:
+            bson_decode(blob)
+        except (MongoError, *OK_ERRORS):
+            pass
+
+
+def test_ber_parser_survives_mutation():
+    rng = random.Random(13)
+    base = ber(0x30, ber(0x02, b"\x01") + ber(0x04, b"hello")
+               + ber(0x61, ber(0x0A, b"\x00")))
+    for blob in _mutations(base, rng, 300):
+        try:
+            tag, payload, off = ber_parse(blob)
+            # walk children like the LDAP client does
+            o = 0
+            while o < len(payload):
+                _, _, o2 = ber_parse(payload, o)
+                if o2 <= o:          # must always advance
+                    break
+                o = o2
+        except OK_ERRORS:
+            pass
+
+
+def test_bson_negative_length_rejected_not_looped():
+    """Regression: a negative string length moved the cursor BACKWARD,
+    spinning _dec_doc forever (hostile-server one-packet DoS)."""
+    from emqx_tpu.auth.mongo import MongoError
+
+    doc = bytearray(bson_encode({"a": "x"}))
+    # element 'a' (0x02): overwrite its int32 length with -7
+    i = doc.index(b"\x02a\x00") + 3
+    doc[i:i + 4] = (-7).to_bytes(4, "little", signed=True)
+    with pytest.raises(MongoError):
+        bson_decode(bytes(doc))
+    with pytest.raises(MongoError):
+        bson_decode(b"\x00\x00\x00\x00")   # doc length < 5
+
+
+def test_mysql_lenenc_survives_mutation():
+    rng = random.Random(17)
+    for blob in _mutations(bytes([0xFC, 0x10, 0x00]) + b"x" * 16,
+                           rng, 200):
+        if not blob:
+            continue
+        try:
+            v, off = _lenenc(blob, 0)
+            assert off > 0
+        except OK_ERRORS:
+            pass
+
+
+def test_ber_zero_length_and_giant_lengths():
+    # zero-length element
+    tag, payload, off = ber_parse(bytes([0x04, 0x00]))
+    assert (tag, payload, off) == (0x04, b"", 2)
+    # declared length far past the buffer: the slice clamps to the
+    # actual remaining byte — concrete expectations, not a tautology
+    blob = bytes([0x30, 0x84, 0x7F, 0xFF, 0xFF, 0xFF]) + b"x"
+    tag, payload, off = ber_parse(blob)
+    assert tag == 0x30 and payload == b"x"
+    assert off == 6 + 0x7FFFFFFF      # callers bound reads themselves
+
+
+def test_kafka_batch_crc_guard_catches_flips():
+    from emqx_tpu.bridge.kafka import KafkaError
+
+    base = bytearray(record_batch([(b"k", b"payload")] * 3))
+    flipped = 0
+    rng = random.Random(23)
+    for _ in range(50):
+        b = bytearray(base)
+        i = rng.randrange(21, len(b))   # flip inside the CRC'd region
+        b[i] ^= 0x01
+        try:
+            parse_record_batch(bytes(b))
+        except KafkaError:
+            flipped += 1
+        except OK_ERRORS:
+            flipped += 1
+    assert flipped == 50                # every corruption detected
