@@ -820,6 +820,10 @@ class BrokerNode:
             "bridges": len(self.bridges.list()),
             "rules": len(self.rule_engine.rules),
             "plugins": self.plugins.list(),
+            "auth": {"authenticators": len(self._auth_confs),
+                     "sources": len(self._authz_confs),
+                     "attached": self.access_control is not None},
+            "topic_metrics": len(self.topic_metrics.topics()),
             "cluster_peers": sorted(self.cluster.peers)
             if self.cluster is not None else [],
             "tpu_match": (self.match_service.info()
