@@ -127,22 +127,47 @@ def build_table(filters, depth):
 
 def bench_cpu_native(table, topics, budget_s: float = 10.0):
     """Per-match latency of the C++ host trie (conservative denominator:
-    it is faster than the reference's BEAM trie walk)."""
-    lat = []
-    deadline = time.perf_counter() + budget_s
+    it is faster than the reference's BEAM trie walk).
+
+    A WARM pass: each topic is matched once untimed before measurement,
+    so the number is steady-state match cost, not first-touch page
+    faults on a cold multi-GB table.  Round-3 review found the cold
+    mean sat 4.6x below the same calls made warm (`serve_cpu_iso`),
+    making every ratio built on it suspect — the warm rate is the
+    honest denominator, and `topics_per_s_cold` preserves the old
+    number for continuity."""
+    # cold pass (timed) doubles as the warmup for the warm pass
+    cold = []
+    deadline = time.perf_counter() + budget_s / 2
     i = 0
     while time.perf_counter() < deadline and i < len(topics):
         t0 = time.perf_counter()
         table.match_host(topics[i])
-        lat.append(time.perf_counter() - t0)
+        cold.append(time.perf_counter() - t0)
         i += 1
-    lat = np.array(lat)
-    return {
+    n_warmed = i
+    lat = []
+    deadline = time.perf_counter() + budget_s / 2
+    j = 0
+    while time.perf_counter() < deadline and j < n_warmed:
+        t0 = time.perf_counter()
+        table.match_host(topics[j])
+        lat.append(time.perf_counter() - t0)
+        j += 1
+    warm_fallback = not lat  # no warm sample landed; cold data reported
+    lat = np.array(lat if lat else cold)
+    cold = np.array(cold)
+    out = {
         "topics_per_s": 1.0 / lat.mean(),
+        "topics_per_s_cold": 1.0 / cold.mean(),
         "p50_us": float(np.percentile(lat, 50) * 1e6),
         "p99_us": float(np.percentile(lat, 99) * 1e6),
-        "measured": int(i),
+        "cold_p99_us": float(np.percentile(cold, 99) * 1e6),
+        "measured": int(j or i),
     }
+    if warm_fallback:
+        out["warm_pass_missing"] = True  # headline keys hold COLD data
+    return out
 
 
 def bench_cpu_python(filters, topics, budget_s: float = 10.0,
@@ -585,28 +610,32 @@ def main():
                 measured = json.load(fh)
         except Exception as e:  # noqa: BLE001
             note(f"no checked-in measured run available: {e}")
-        if measured:
-            msg = ("TPU tunnel down at bench time (jax.devices() hangs); "
-                   "value/vs_baseline are the LAST FULL on-chip "
-                   "10M-filter run (2026-07-30, checked in as "
-                   "scripts/measured_bench_10m_20260730.json); "
-                   "cpu_native below is measured now")
-        else:
-            msg = ("TPU tunnel down at bench time AND no checked-in "
-                   "measured run could be loaded; value/vs_baseline are "
-                   "0.0 (no device measurement)")
+        # value/vs_baseline stay 0.0 in this branch: an archived run is
+        # not THIS run's measurement, and automated consumers must not
+        # mistake it for one (ADVICE r3 #2).  The archive rides along
+        # under measured_run, clearly labeled with its own date.
+        msg = ("TPU tunnel down at bench time (jax.devices() hangs); "
+               "value/vs_baseline are 0.0 — no device measurement was "
+               "possible.  measured_run holds the last full on-chip run "
+               "for context only; cpu_fallback below is measured now at "
+               "ITS OWN stated filter count (NOT the full target scale).")
         print(json.dumps({
             "metric": "wildcard_match_throughput",
-            "value": measured.get("value", 0.0),
+            "value": 0.0,
             "unit": "topics/s/chip",
-            "vs_baseline": measured.get("vs_baseline", 0.0),
+            "vs_baseline": 0.0,
             "device_unreachable": True,
             "note": msg,
             "measured_run": measured,
-            "n_filters": measured.get("n_filters", len(filters)),
-            "table": {"kind": kind, "build_s": round(build_s, 1)},
-            "cpu_native": {k: round(v, 3) if isinstance(v, float) else v
-                           for k, v in cpu.items()},
+            "n_filters_target": args.filters,
+            # fallback-mode numbers carry their own scale so a 200k-run
+            # CPU rate can't be read as the 10M figure (VERDICT r3 weak 7)
+            "cpu_fallback": {
+                "n_filters": len(filters),
+                "table": {"kind": kind, "build_s": round(build_s, 1)},
+                **{k: round(v, 3) if isinstance(v, float) else v
+                   for k, v in cpu.items()},
+            },
             "config1_broker_e2e": c1,
         }))
         return
@@ -670,25 +699,39 @@ def main():
     note("deltas done")
 
     mem = (table.memory_bytes() if hasattr(table, "memory_bytes") else {})
+    # equal-or-higher-load gate: the device only earns a p99 ratio from
+    # runs whose offered load met or beat the CPU harness's offered load
+    eligible = [s for s in (serve_dev, serve_dev2)
+                if s and serve_cpu
+                and s["offered_rate"] >= serve_cpu["offered_rate"]]
+    p99_speedup = (round(serve_cpu["p99_ms"]
+                         / min(s["p99_ms"] for s in eligible), 2)
+                   if eligible else None)
     result = {
         "metric": "wildcard_match_throughput",
         "value": tpu["topics_per_s"],
         "unit": "topics/s/chip",
+        # BOTH denominators, side by side (round-3 review: the warm
+        # per-match rate and the serve-capacity rate must corroborate;
+        # the weakest-denominator 9.46x claim is dead).  vs_baseline is
+        # raw kernel throughput over the WARM per-match CPU rate;
+        # vs_baseline_serve is end-to-end serving capacity over the CPU
+        # serving capacity through the same harness.
         "vs_baseline": round(tpu["topics_per_s"] / cpu["topics_per_s"], 2),
+        "vs_baseline_serve": (
+            round(max(s["serve_capacity"] for s in (serve_dev, serve_dev2)
+                      if s)
+                  / max(1, serve_cpu["serve_capacity"]), 2)
+            if serve_cpu and (serve_dev or serve_dev2) else None
+        ),
         # measured serving p99 — NOT an amortized estimate (VERDICT r2
         # weak 1).  The device side is the best p99 among device harness
         # runs whose offered load is >= the CPU's offered load, so the
         # ratio never credits the device for serving less traffic.
-        "p99_speedup": (
-            round(serve_cpu["p99_ms"] / min(
-                s["p99_ms"] for s in (serve_dev, serve_dev2)
-                if s and s["offered_rate"] >= serve_cpu["offered_rate"]
-            ), 2)
-            if serve_cpu and any(
-                s and s["offered_rate"] >= serve_cpu["offered_rate"]
-                for s in (serve_dev, serve_dev2))
-            else None
-        ),
+        "p99_speedup": p99_speedup,
+        # the round-2 north star, answered explicitly every run
+        "north_star_p99_10x": (None if p99_speedup is None
+                               else bool(p99_speedup >= 10.0)),
         "throughput_speedup": (
             round(serve_dev["serve_capacity"]
                   / max(1, serve_cpu["serve_capacity"]), 2)

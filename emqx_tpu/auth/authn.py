@@ -252,7 +252,21 @@ class JwtAuthenticator:
 # the chain
 
 class AuthChain:
-    def __init__(self, allow_anonymous: bool = True) -> None:
+    """Ordered authenticator chain.
+
+    ``allow_anonymous=None`` (the default) is *auto*: an empty chain
+    admits everyone (an unconfigured broker is open, matching the
+    reference's out-of-the-box behavior), but the moment the chain has
+    at least one authenticator, exhausting it without a verdict DENIES.
+    The reference rejects a client when a configured chain yields no
+    verdict; admitting unknown users — and everyone during a backend
+    outage, since network authenticators return *ignore* on outage —
+    would silently void the operator's auth config.  An explicit
+    ``allow_anonymous=True`` (conf key ``authn.allow_anonymous``)
+    remains the opt-out.
+    """
+
+    def __init__(self, allow_anonymous: Optional[bool] = None) -> None:
         self.allow_anonymous = allow_anonymous
         self._chain: List[Any] = []
 
@@ -272,6 +286,9 @@ class AuthChain:
             res = a.authenticate(creds)
             if res.outcome != "ignore":
                 return res
-        if self.allow_anonymous:
+        allow = self.allow_anonymous
+        if allow is None:  # auto: open only while no authenticator exists
+            allow = not self._chain
+        if allow:
             return AuthResult("ok", attrs={"anonymous": not self._chain})
         return DENY

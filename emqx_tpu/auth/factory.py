@@ -150,7 +150,15 @@ def describe(conf: Dict[str, Any]) -> Dict[str, Any]:
         if any(s in k.lower() for s in _SECRET_KEYS):
             out[k] = "******"
         elif k == "users":
-            out[k] = [{**u, "password": "******"} for u in v]
+            # REST-added users are stored as password_hash+salt via
+            # export_user(); those are secrets too — only the backup
+            # archive path (which must round-trip them) keeps them.
+            out[k] = [
+                {uk: ("******" if uk in ("password", "password_hash",
+                                         "salt") else uv)
+                 for uk, uv in u.items()}
+                for u in v
+            ]
         else:
             out[k] = v
     return out

@@ -47,28 +47,37 @@ class MysqlError(Exception):
     pass
 
 
-def escape_literal(v: str) -> str:
-    """MySQL string-literal escaping, safe under BOTH the default
-    sql_mode and NO_BACKSLASH_ESCAPES: single quotes are DOUBLED (the
-    one escape valid in every mode — backslash-quoting is inert under
-    NO_BACKSLASH_ESCAPES and would let ' terminate the literal), and
-    backslashes are doubled so a trailing backslash cannot eat the
-    closing quote in default mode.  Control characters ride through as
-    data.  The result is always used INSIDE single quotes."""
-    return v.replace("\\", "\\\\").replace("'", "''")
+def escape_literal(v: str, *, no_backslash_escapes: bool = False) -> str:
+    """MySQL string-literal escaping.  Single quotes are DOUBLED (the
+    one escape valid in every sql_mode — backslash-quoting is inert
+    under NO_BACKSLASH_ESCAPES and would let ' terminate the literal).
+    Backslash handling is MODE-DEPENDENT: under the default mode a
+    backslash is an escape character, so it is doubled (a trailing one
+    would otherwise eat the closing quote); under NO_BACKSLASH_ESCAPES
+    a backslash is literal data and doubling it would corrupt the value
+    (``a\\b`` would silently look up ``a\\\\b`` and fail closed).  The
+    client probes ``@@sql_mode`` at handshake and passes the right
+    flag.  Control characters ride through as data.  The result is
+    always used INSIDE single quotes."""
+    if not no_backslash_escapes:
+        v = v.replace("\\", "\\\\")
+    return v.replace("'", "''")
 
 
 _PLACEHOLDER = re.compile(r"\$\{(\w+)\}")
 
 
-def render_query(template: str, ctx: Dict[str, Any]) -> str:
+def render_query(template: str, ctx: Dict[str, Any], *,
+                 no_backslash_escapes: bool = False) -> str:
     """``${var}`` -> quoted, escaped literal.  SINGLE-PASS substitution:
     sequential str.replace would re-scan spliced values, letting a
     credential containing ``${other}`` smuggle a second field inside
     its quoted literal (injection despite escaping)."""
     def sub(m):
         v = ctx.get(m.group(1))
-        return "'" + escape_literal("" if v is None else str(v)) + "'"
+        return "'" + escape_literal(
+            "" if v is None else str(v),
+            no_backslash_escapes=no_backslash_escapes) + "'"
 
     return _PLACEHOLDER.sub(sub, template)
 
@@ -106,6 +115,9 @@ class MysqlClient(LazyTcpClient):
         self.password = password
         self.database = database
         self._seq = 0
+        # set from @@sql_mode at handshake; False (escape backslashes)
+        # is the safe default when the probe yields nothing
+        self.no_backslash_escapes = False
 
     # -- packet framing -----------------------------------------------------
 
@@ -160,12 +172,34 @@ class MysqlClient(LazyTcpClient):
             raise MysqlError(
                 "server requires an unsupported auth plugin "
                 "(create the broker user WITH mysql_native_password)")
+        # probe the session sql_mode so literal escaping can honor
+        # NO_BACKSLASH_ESCAPES (backslash = data there, not an escape)
+        try:
+            _, rows = await self._query("SELECT @@sql_mode")
+            if rows and rows[0] and rows[0][0] is not None:
+                self.no_backslash_escapes = (
+                    "NO_BACKSLASH_ESCAPES" in rows[0][0])
+        except Exception:  # noqa: BLE001 — a malformed probe resultset
+            # (proxy quirk) must not abort the connection; default-mode
+            # escaping is the safe fallback, and a genuinely dead socket
+            # will surface on the next real query via _guarded
+            self.no_backslash_escapes = False
 
     # -- COM_QUERY text protocol --------------------------------------------
 
     async def query(self, sql: str) -> Tuple[List[str],
                                              List[List[Optional[str]]]]:
         return await self._guarded(lambda: self._query(sql))
+
+    async def query_tpl(self, template: str, ctx: Dict[str, Any]):
+        """Render ``${var}`` placeholders AFTER the connection (and its
+        ``@@sql_mode`` probe) is up, so escaping matches the server."""
+        async def op():
+            return await self._query(render_query(
+                template, ctx,
+                no_backslash_escapes=self.no_backslash_escapes))
+
+        return await self._guarded(op)
 
     async def _query(self, sql):
         self._seq = 0
@@ -210,7 +244,7 @@ class MysqlClient(LazyTcpClient):
                     off += ln
             rows.append(row)
 
-    def query_blocking(self, sql):
+    def query_blocking(self, sql=None, *, template=None, ctx=None):
         import asyncio
 
         client = MysqlClient(f"{self.host}:{self.port}", user=self.user,
@@ -219,6 +253,8 @@ class MysqlClient(LazyTcpClient):
 
         async def run():
             try:
+                if template is not None:
+                    return await client.query_tpl(template, ctx or {})
                 return await client.query(sql)
             finally:
                 await client.close()
@@ -248,10 +284,8 @@ class MysqlAuthenticator:
         self.iterations = iterations
         self._parked = ParkedVerdicts()
 
-    def _sql(self, creds: Credentials) -> str:
-        return render_query(self.query_template,
-                            _ctx(creds.clientid, creds.username,
-                                 creds.peerhost))
+    def _tpl_ctx(self, creds: Credentials) -> Dict[str, Any]:
+        return _ctx(creds.clientid, creds.username, creds.peerhost)
 
     def _evaluate(self, cols, rows, creds: Credentials) -> AuthResult:
         if not rows:
@@ -271,7 +305,8 @@ class MysqlAuthenticator:
 
     async def authenticate_async(self, creds: Credentials) -> AuthResult:
         try:
-            cols, rows = await self.client.query(self._sql(creds))
+            cols, rows = await self.client.query_tpl(
+                self.query_template, self._tpl_ctx(creds))
             res = self._evaluate(cols, rows, creds)
         except Exception as e:
             log.warning("mysql authn unreachable: %s", e)
@@ -286,7 +321,8 @@ class MysqlAuthenticator:
             log.warning("mysql authn: no pre-resolved verdict; ignoring")
             return IGNORE
         try:
-            cols, rows = self.client.query_blocking(self._sql(creds))
+            cols, rows = self.client.query_blocking(
+                template=self.query_template, ctx=self._tpl_ctx(creds))
             return self._evaluate(cols, rows, creds)
         except Exception as e:
             log.warning("mysql authn unreachable: %s", e)
@@ -337,9 +373,9 @@ class MysqlAuthzSource:
         rules = self._cache.fresh(key)
         if rules is None:
             try:
-                cols, rows = await self.client.query(render_query(
+                cols, rows = await self.client.query_tpl(
                     self.query_template,
-                    _ctx(clientid, username, peerhost)))
+                    _ctx(clientid, username, peerhost))
                 rules = self._rules_of(cols, rows)
             except Exception as e:
                 log.warning("mysql authz unreachable: %s", e)
@@ -357,8 +393,9 @@ class MysqlAuthzSource:
             log.warning("mysql authz: un-prefetched key; nomatch")
             return NOMATCH
         try:
-            cols, rows = self.client.query_blocking(render_query(
-                self.query_template, _ctx(clientid, username, peerhost)))
+            cols, rows = self.client.query_blocking(
+                template=self.query_template,
+                ctx=_ctx(clientid, username, peerhost))
             rules = self._rules_of(cols, rows)
             self._cache.put(key, rules)
             return self._match(rules, action, topic, clientid, username)

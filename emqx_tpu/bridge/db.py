@@ -170,14 +170,21 @@ class MysqlBridgeConnector(Connector):
 
     def _statement(self, params: List[str]) -> str:
         # single-pass: sequential replace would re-scan spliced values,
-        # letting a payload containing ${n} smuggle another field
+        # letting a payload containing ${n} smuggle another field.
+        # Escaping honors the connection's probed @@sql_mode — under
+        # NO_BACKSLASH_ESCAPES a doubled backslash would be stored as
+        # corrupted payload data.  start()/health() connect (and probe)
+        # before the first send renders a statement.
         from ..auth.mysql import escape_literal
+
+        nbe = self.client.no_backslash_escapes
 
         def sub(m):
             i = int(m.group(1)) - 1
             if not 0 <= i < len(params):
                 return m.group(0)
-            return "'" + escape_literal(params[i]) + "'"
+            return "'" + escape_literal(params[i],
+                                        no_backslash_escapes=nbe) + "'"
 
         return re.sub(r"\$\{(\d+)\}", sub, self.sql)
 

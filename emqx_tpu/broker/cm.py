@@ -61,7 +61,17 @@ class ConnectionManager:
         return chan
 
     def connection_count(self) -> int:
+        """Live (currently connected) channels only."""
         return len(self._channels)
+
+    def total_connection_count(self) -> int:
+        """Live channels plus disconnected persistent sessions — the
+        reference's ``connections.count`` includes sessions whose
+        transport dropped but whose state is retained, while
+        ``live_connections.count`` is connected-only."""
+        ids = set(self._channels)
+        ids.update(self.broker.sessions)
+        return len(ids)
 
     def all_clientids(self):
         return list(self._channels)

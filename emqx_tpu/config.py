@@ -162,7 +162,11 @@ SCHEMA: Dict[str, Field] = {
     "limiter.max_bytes_rate": Field(0.0, float),
 
     "authn.enable": Field(True, _bool),
-    "authn.allow_anonymous": Field(True, _bool),
+    # tri-state: unset (None) = auto — open while the chain is empty,
+    # deny-on-exhaustion once any authenticator exists; an explicit
+    # true/false overrides (wired into AuthChain at node build)
+    "authn.allow_anonymous": Field(
+        None, lambda v: None if v is None else _bool(v)),
     "authz.no_match": Field("allow", _enum("allow", "deny")),
     "authz.deny_action": Field("ignore", _enum("ignore", "disconnect")),
     "authz.cache.enable": Field(True, _bool),

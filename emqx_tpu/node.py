@@ -70,7 +70,7 @@ class BrokerNode:
         # connection gauges come from the CM (a node-level table), so
         # they wire here rather than in observe(broker)
         self.observed.stats.provide(
-            "connections.count", self.cm.connection_count)
+            "connections.count", self.cm.total_connection_count)
         self.observed.stats.provide(
             "live_connections.count", self.cm.connection_count)
         self.banned = Banned().attach(self.broker)
@@ -110,7 +110,8 @@ class BrokerNode:
         if auth_chain is not None or authz is not None:
             self.access_control = attach_auth(
                 self.broker,
-                auth_chain if auth_chain is not None else AuthChain(),
+                auth_chain if auth_chain is not None else AuthChain(
+                    allow_anonymous=cfg.get("authn.allow_anonymous")),
                 authz if authz is not None else Authz(
                     no_match=cfg.get("authz.no_match")
                 ),
@@ -391,7 +392,9 @@ class BrokerNode:
         create (reference: authn/authz are runtime-configured)."""
         if self.access_control is None:
             self.access_control = attach_auth(
-                self.broker, AuthChain(),
+                self.broker,
+                AuthChain(allow_anonymous=self.config.get(
+                    "authn.allow_anonymous")),
                 Authz(no_match=self.config.get("authz.no_match")),
             )
         return self.access_control

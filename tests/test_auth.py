@@ -251,3 +251,57 @@ def test_connect_auth_and_publish_acl_through_channel():
                                      topic_filters=[("ok/#", {"qos": 0}),
                                                     ("denied/#", {"qos": 0})]))
     assert acts[0][1].reason_codes == [0, P.RC.NOT_AUTHORIZED]
+
+
+# ---------------------------------------------------------------------------
+# round-4: auto allow_anonymous + secret redaction
+
+
+def test_auto_anonymous_denies_once_chain_populated():
+    """ADVICE r3 #1: a REST-created chain (no explicit allow_anonymous)
+    must NOT admit unknown users or everyone during a backend outage.
+    Unset policy = open while empty, deny-on-exhaustion once populated."""
+    from emqx_tpu.auth.authn import Credentials
+
+    chain = AuthChain()  # policy unset -> auto
+    assert chain.authenticate(Credentials(clientid="c")).outcome == "ok"
+
+    class IgnoringBackend:  # e.g. network authn during an outage
+        def authenticate(self, creds):
+            from emqx_tpu.auth.authn import IGNORE
+            return IGNORE
+
+    chain.add(IgnoringBackend())
+    assert chain.authenticate(Credentials(clientid="c")).outcome == "deny"
+    # explicit opt-out still honored
+    chain.allow_anonymous = True
+    assert chain.authenticate(Credentials(clientid="c")).outcome == "ok"
+
+
+def test_describe_redacts_password_hash_and_salt():
+    """ADVICE r3 #3: REST-stored users carry password_hash+salt; GET
+    /authentication must not leak them to dashboard users."""
+    from emqx_tpu.auth.factory import describe
+
+    out = describe({
+        "type": "built_in_database",
+        "users": [{"username": "u", "password_hash": "deadbeef",
+                   "salt": "s3cr3t", "is_superuser": False}],
+    })
+    u = out["users"][0]
+    assert u["password_hash"] == "******"
+    assert u["salt"] == "******"
+    assert u["username"] == "u"
+    assert u["is_superuser"] is False
+
+
+def test_cm_total_vs_live_connection_count():
+    """ADVICE r3 #4: connections.count includes disconnected persistent
+    sessions; live_connections.count is connected-only."""
+    broker = Broker()
+    cm = ConnectionManager(broker)
+    broker.open_session("gone", clean_start=False, expiry_interval=3600)
+    cm.register_channel("here", object())
+    broker.open_session("here", clean_start=True)
+    assert cm.connection_count() == 1
+    assert cm.total_connection_count() == 2

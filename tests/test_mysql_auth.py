@@ -300,3 +300,31 @@ def test_mysql_bridge_insert_via_rule():
             await my.stop()
 
     run(main())
+
+
+def test_sql_mode_probe_no_backslash_escapes():
+    """ADVICE r3 #5: under NO_BACKSLASH_ESCAPES a backslash is literal
+    data; the client probes @@sql_mode at handshake and stops doubling
+    backslashes, so a username like 'dom\\user' matches its row."""
+    # unit: escaping is mode-dependent
+    assert escape_literal("a\\b") == "a\\\\b"
+    assert escape_literal("a\\b", no_backslash_escapes=True) == "a\\b"
+    assert escape_literal("a'b", no_backslash_escapes=True) == "a''b"
+
+    async def main():
+        def sql_mode(_sql):
+            return ["@@sql_mode"], [["ANSI_QUOTES,NO_BACKSLASH_ESCAPES"]]
+
+        my = await MockMysql({"@@sql_mode": sql_mode,
+                              "mqtt_user": user_table}).start()
+        auth = MysqlAuthenticator(f"127.0.0.1:{my.port}", user="broker",
+                                  password="dbpw")
+        await auth.authenticate_async(
+            Credentials("c", "dom\\user", b"pw"))
+        lookup = [q for q in my.queries if "mqtt_user" in q]
+        assert lookup and "'dom\\user'" in lookup[0]  # NOT doubled
+        assert auth.client.no_backslash_escapes is True
+        await auth.client.close()
+        await my.stop()
+
+    run(main())
