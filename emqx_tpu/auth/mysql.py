@@ -47,6 +47,13 @@ class MysqlError(Exception):
     pass
 
 
+class MysqlServerError(MysqlError):
+    """A well-formed ``0xFF`` error packet from the server.  The wire
+    stream is fully consumed at raise time — unlike a mid-resultset
+    parse failure, after which buffered packets would desynchronize the
+    next query on the same connection."""
+
+
 def escape_literal(v: str, *, no_backslash_escapes: bool = False) -> str:
     """MySQL string-literal escaping.  Single quotes are DOUBLED (the
     one escape valid in every sql_mode — backslash-quoting is inert
@@ -179,11 +186,17 @@ class MysqlClient(LazyTcpClient):
             if rows and rows[0] and rows[0][0] is not None:
                 self.no_backslash_escapes = (
                     "NO_BACKSLASH_ESCAPES" in rows[0][0])
-        except Exception:  # noqa: BLE001 — a malformed probe resultset
-            # (proxy quirk) must not abort the connection; default-mode
-            # escaping is the safe fallback, and a genuinely dead socket
-            # will surface on the next real query via _guarded
+        except MysqlServerError:
+            # clean refusal (strict proxy): the error packet was fully
+            # consumed, the stream is aligned — default-mode escaping
+            # is the safe fallback
             self.no_backslash_escapes = False
+        except Exception:
+            # mid-resultset parse failure: unread probe packets would
+            # desynchronize the NEXT query's protocol stream — this
+            # connection must not survive
+            self._drop()
+            raise
 
     # -- COM_QUERY text protocol --------------------------------------------
 
@@ -201,13 +214,25 @@ class MysqlClient(LazyTcpClient):
 
         return await self._guarded(op)
 
+    async def query_with_mode(self, render) -> Tuple[
+            List[str], List[List[Optional[str]]]]:
+        """Run ``render(no_backslash_escapes) -> sql`` inside the
+        connection guard: the statement is built only once the probe
+        has resolved the server's actual escaping mode (a render-then-
+        connect ordering would escape the first statement after every
+        reconnect with a stale flag)."""
+        async def op():
+            return await self._query(render(self.no_backslash_escapes))
+
+        return await self._guarded(op)
+
     async def _query(self, sql):
         self._seq = 0
         self._write_packet(b"\x03" + sql.encode())
         await self._writer.drain()
         first = await self._read_packet()
         if first[:1] == b"\xff":
-            raise MysqlError(self._err_text(first))
+            raise MysqlServerError(self._err_text(first))
         if first[:1] == b"\x00":                 # OK (no resultset)
             return [], []
         ncols, _ = _lenenc(first, 0)
@@ -232,7 +257,8 @@ class MysqlClient(LazyTcpClient):
             if p[:1] == b"\xfe" and len(p) < 9:  # EOF
                 return cols, rows
             if p[:1] == b"\xff":
-                raise MysqlError(self._err_text(p))
+                # an ERR packet terminates the resultset: stream clean
+                raise MysqlServerError(self._err_text(p))
             off = 0
             row: List[Optional[str]] = []
             for _ in range(ncols):

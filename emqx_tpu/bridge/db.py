@@ -168,23 +168,23 @@ class MysqlBridgeConnector(Connector):
             timeout=float(conf.get("timeout", 5.0)))
         self.sql = conf.get("sql", self.DEFAULT_SQL)
 
-    def _statement(self, params: List[str]) -> str:
+    def _statement(self, params: List[str],
+                   no_backslash_escapes: bool = False) -> str:
         # single-pass: sequential replace would re-scan spliced values,
         # letting a payload containing ${n} smuggle another field.
         # Escaping honors the connection's probed @@sql_mode — under
         # NO_BACKSLASH_ESCAPES a doubled backslash would be stored as
-        # corrupted payload data.  start()/health() connect (and probe)
-        # before the first send renders a statement.
+        # corrupted payload data.  send() renders via query_with_mode,
+        # i.e. only after the (re)connected session's probe resolved.
         from ..auth.mysql import escape_literal
-
-        nbe = self.client.no_backslash_escapes
 
         def sub(m):
             i = int(m.group(1)) - 1
             if not 0 <= i < len(params):
                 return m.group(0)
-            return "'" + escape_literal(params[i],
-                                        no_backslash_escapes=nbe) + "'"
+            return "'" + escape_literal(
+                params[i],
+                no_backslash_escapes=no_backslash_escapes) + "'"
 
         return re.sub(r"\$\{(\d+)\}", sub, self.sql)
 
@@ -204,7 +204,9 @@ class MysqlBridgeConnector(Connector):
     async def send(self, items: List[Dict[str, Any]]) -> Optional[int]:
         for i, it in enumerate(items):
             try:
-                await self.client.query(self._statement(it["params"]))
+                params = it["params"]
+                await self.client.query_with_mode(
+                    lambda nbe, p=params: self._statement(p, nbe))
             except Exception as e:
                 raise SendError(f"mysql bridge: {e}", done=i) from e
         return 0

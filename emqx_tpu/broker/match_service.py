@@ -477,6 +477,9 @@ class MatchService:
             return None
         if self.metrics is not None:
             self.metrics.inc("tpu.match.hint_served")
+        # move-to-end: a served hint is recent; eviction takes from the
+        # other end of the dict (insertion order doubles as LRU order)
+        self._hints[topic] = self._hints.pop(topic)
         return self.router.routes_with_wild(topic, hint[2])
 
     def hint_rules(self, topic: str) -> Optional[List[str]]:
@@ -637,13 +640,28 @@ class MatchService:
                         self.metrics.inc(
                             "tpu.match.active_overflow", len(spilled)
                         )
-                if len(self._hints) + len(topics) > self.hint_cap:
-                    self._hints.clear()
                 for (topic, fut), row in zip(pending, rows):
                     self._hints[topic] = (epoch, rule_gen,
                                           *self._split_row(row))
                     if not fut.done():
                         fut.set_result(None)
+                # evict AFTER insert, least-recently-SERVED first (dict
+                # order is recency: hint_routes re-appends on a hit).
+                # Post-insert pruning makes the cap a true invariant
+                # even when a single batch exceeds it (the batch's own
+                # oldest entries go too), counts refreshed-in-place
+                # topics as the no-ops they are, and the metric is the
+                # exact deletion count.  The old full-clear thrashed
+                # working sets just over hint_cap between full-cache
+                # and cold-cache — the hot head of a Zipf working set
+                # must survive the arrival of its own cold tail.
+                excess = len(self._hints) - self.hint_cap
+                if excess > 0:
+                    it = iter(self._hints)
+                    for k in [next(it) for _ in range(excess)]:
+                        del self._hints[k]
+                    if self.metrics is not None:
+                        self.metrics.inc("tpu.match.hint_evicted", excess)
             except Exception:
                 log.debug("device batch failed; publishes fall back",
                           exc_info=True)

@@ -82,6 +82,7 @@ TPU_METRIC_NAMES: List[str] = [
     "tpu.match.fallback_host", "tpu.mirror.refresh",
     "tpu.mirror.delta_applied", "tpu.mirror.recompile",
     "tpu.match.hint_served", "tpu.match.hint_stale", "tpu.match.bypass",
+    "tpu.match.hint_evicted",
 ]
 
 
