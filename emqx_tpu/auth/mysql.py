@@ -186,10 +186,16 @@ class MysqlClient(LazyTcpClient):
             if rows and rows[0] and rows[0][0] is not None:
                 self.no_backslash_escapes = (
                     "NO_BACKSLASH_ESCAPES" in rows[0][0])
-        except MysqlServerError:
+        except MysqlServerError as e:
             # clean refusal (strict proxy): the error packet was fully
             # consumed, the stream is aligned — default-mode escaping
-            # is the safe fallback
+            # is the fail-closed fallback.  Warn: if the server actually
+            # runs NO_BACKSLASH_ESCAPES, credentials containing
+            # backslashes (e.g. 'dom\\user') will fail lookup silently.
+            log.warning(
+                "mysql @@sql_mode probe refused (%s); assuming default "
+                "escaping — backslash-containing credentials will not "
+                "match if the server runs NO_BACKSLASH_ESCAPES", e)
             self.no_backslash_escapes = False
         except Exception:
             # mid-resultset parse failure: unread probe packets would

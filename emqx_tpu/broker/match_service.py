@@ -493,6 +493,9 @@ class MatchService:
             if self.metrics is not None:
                 self.metrics.inc("tpu.match.hint_stale")
             return None
+        # a rules-only working set is just as hot as a routing one:
+        # refresh LRU recency so it survives eviction (see hint_routes)
+        self._hints[topic] = self._hints.pop(topic)
         return hint[3]
 
     def _deep_ids(self, topic: str) -> List[int]:
@@ -641,6 +644,11 @@ class MatchService:
                             "tpu.match.active_overflow", len(spilled)
                         )
                 for (topic, fut), row in zip(pending, rows):
+                    # pop-then-insert: a refreshed hint is ACTIVE — plain
+                    # assignment would keep its stale dict position and
+                    # let the post-insert prune evict it ahead of colder
+                    # entries, wasting the device work just spent on it
+                    self._hints.pop(topic, None)
                     self._hints[topic] = (epoch, rule_gen,
                                           *self._split_row(row))
                     if not fut.done():

@@ -10,6 +10,12 @@ from .sharded_match import (
     build_sharded_matcher,
     make_accept_bitmap,
 )
+from .ulysses import (
+    UlyssesResult,
+    build_reshard,
+    build_ulysses_step,
+    build_unreshard,
+)
 
 __all__ = [
     "make_mesh",
@@ -29,4 +35,8 @@ __all__ = [
     "build_partitions",
     "build_ep_matcher",
     "owner_of",
+    "UlyssesResult",
+    "build_reshard",
+    "build_unreshard",
+    "build_ulysses_step",
 ]

@@ -32,7 +32,8 @@ from jax import shard_map
 from ..ops.compiler import NfaTable
 from ..ops.match_kernel import nfa_match
 
-__all__ = ["FanoutResult", "build_sharded_matcher", "make_accept_bitmap"]
+__all__ = ["FanoutResult", "build_sharded_matcher", "make_accept_bitmap",
+           "or_accept_rows"]
 
 
 class FanoutResult(NamedTuple):
@@ -65,8 +66,13 @@ def make_accept_bitmap(
     return bm
 
 
-def _or_reduce_rows(rows: jax.Array) -> jax.Array:
-    """(B, K, W) uint32 → (B, W) bitwise-OR over K."""
+def or_accept_rows(accept_bitmap: jax.Array, matches: jax.Array) -> jax.Array:
+    """(F+1, W) accept bitmap × (B, K) match ids → (B, W) OR-assembled
+    subscriber rows.  Invalid slots (-1) index the all-zero sentinel
+    row F.  Shared by every fan-out layout (TP, ring, Ulysses)."""
+    F = accept_bitmap.shape[0] - 1
+    idx = jnp.where(matches >= 0, matches, F)        # (B, K)
+    rows = accept_bitmap[idx]                        # (B, K, W)
     return jax.lax.reduce(
         rows, np.uint32(0), jax.lax.bitwise_or, (1,)
     )
@@ -110,10 +116,7 @@ def build_sharded_matcher(
             words, lens, is_sys, node_tab, edge_tab, seeds,
             active_slots=active_slots, max_matches=max_matches,
         )
-        F = accept_bitmap.shape[0] - 1
-        idx = jnp.where(res.matches >= 0, res.matches, F)   # (Bl, K)
-        rows = accept_bitmap[idx]                            # (Bl, K, Wl)
-        bitmap = _or_reduce_rows(rows)                       # (Bl, Wl)
+        bitmap = or_accept_rows(accept_bitmap, res.matches)  # (Bl, Wl)
         # per-topic total subscribers: popcount local slice, psum over tp
         local = jnp.sum(
             jax.lax.population_count(bitmap).astype(jnp.int32), axis=1

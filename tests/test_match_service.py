@@ -511,3 +511,50 @@ def test_hint_cache_lru_eviction_no_thrash():
             await node.stop()
 
     run(main())
+
+def test_rules_only_hot_set_survives_lru_eviction():
+    """VERDICT r4 weak 8: `hint_rules` hits must refresh LRU recency
+    exactly like `hint_routes` does — a rules-only working set (topics
+    matched by rule FROM-filters but with no subscribers) is hot, and
+    must not age out of the cache under a cold tail."""
+
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            ms.hint_cap = 24
+            node.rule_engine.create_rule(
+                "r1", 'SELECT topic FROM "room/+/k"', actions=[],
+            )
+            # a subscription on an unrelated branch keeps the table
+            # non-empty without routing the hot topics
+            sub(b, "s", "other/+")
+            assert await settle(lambda: ms_synced(node))
+
+            hot = [f"room/h{i}/k" for i in range(8)]
+            for t in hot:
+                await ms.prefetch(t)
+            for t in hot:
+                assert ms.hint_rules(t) == ["r1"]
+
+            served_hot = 0
+            total_hot = 0
+            for round_ in range(6):
+                cold = [f"room/c{round_}_{i}/k" for i in range(20)]
+                for ci, t in enumerate(cold):
+                    await ms.prefetch(t)
+                    if ci % 4 == 3:
+                        for h in hot:
+                            total_hot += 1
+                            if ms.hint_rules(h) is not None:
+                                served_hot += 1
+                assert len(ms._hints) <= ms.hint_cap
+            duty = served_hot / total_hot
+            assert duty > 0.9, \
+                f"rules-only hot set duty cycle {duty:.2f} thrashed"
+        finally:
+            await node.stop()
+
+    run(main())
