@@ -59,9 +59,11 @@ class Peer:
     # own sequence counter (NOT router epochs — those advance for remote
     # deltas too and are a different clock on every node)
     route_seq: int = 0          # last applied origin batch seq
+    durable_seq: int = 0        # last applied origin durable batch seq
     bootstrapped: bool = False
     bootstrapping: bool = False
     pending_deltas: List[Any] = field(default_factory=list)
+    pending_durable: List[Any] = field(default_factory=list)
 
     @property
     def up(self) -> bool:
@@ -131,6 +133,16 @@ class Cluster:
         # re-bootstrap can never roll back a newer local change
         self._config_versions: Dict[str, Tuple[int, str]] = {}
         self._applying_remote_config = False
+        # durable-state replication (retained + persistent sessions);
+        # replicas persisted by Persistence are restored through the
+        # node attribute before the cluster comes up
+        from .durable import DurableReplicator
+
+        self.durable = DurableReplicator(
+            self,
+            restored_replicas=getattr(
+                node, "_restored_session_replicas", None),
+        )
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -152,10 +164,16 @@ class Cluster:
             asyncio.ensure_future(self._heartbeat_loop()),
             asyncio.ensure_future(self._sync_loop()),
             asyncio.ensure_future(self._reconnect_loop()),
+            asyncio.ensure_future(self.durable.loop()),
         ]
 
     async def stop(self) -> None:
         self._running = False
+        # stash replicas where Persistence's FINAL sync (which runs
+        # after the cluster is gone) and the next life's Cluster both
+        # find them
+        self.node._restored_session_replicas = dict(
+            self.durable.session_replicas)
         for t in self._tasks:
             t.cancel()
         self._tasks = []
@@ -185,6 +203,7 @@ class Cluster:
         # CLI, library) broadcasts AFTER its handlers ran clean — the
         # reference's check-then-broadcast two-phase (emqx_conf [U])
         self.node.config.on_update("", self._on_local_config_update)
+        self.durable.attach()
 
     def _on_local_config_update(self, path: str, old: Any, new: Any) -> None:
         if self._applying_remote_config or not self._running:
@@ -229,6 +248,7 @@ class Cluster:
             "session.terminated", "cluster.session.terminated"
         )
         self.node.config.remove_handler(self._on_local_config_update)
+        self.durable.detach()
 
     # ------------------------------------------------------------------
     # membership
@@ -273,8 +293,10 @@ class Cluster:
             # is stale
             self._purge_node_state(name)
             peer.route_seq = 0
+            peer.durable_seq = 0
             peer.bootstrapped = False
             peer.pending_deltas.clear()
+            peer.pending_durable.clear()
         peer.host, peer.port = host, port
         peer.incarnation = incarnation
         if peer.conn is not None and peer.conn is not conn:
@@ -308,6 +330,7 @@ class Cluster:
                     self._apply_delta_ops(rd)
                     peer.route_seq = rd.to_epoch
             peer.pending_deltas.clear()
+            self.durable.replay_pending(peer)
             peer.bootstrapped = True
         except Exception as e:
             log.warning("bootstrap from %s failed: %s", peer.name, e)
@@ -488,6 +511,8 @@ class Cluster:
                 path=path, value_json=_json.dumps(value, default=str),
                 origin=origin, txn=txn,
             ))
+        snap.durable_seq = self.durable._seq
+        self.durable.fill_snapshot(snap)
         return snap
 
     def _apply_snapshot(self, snap: pb.Snapshot) -> None:
@@ -508,6 +533,8 @@ class Cluster:
         peer = self.peers.get(origin)
         if peer is not None:
             peer.route_seq = snap.epoch
+            peer.durable_seq = snap.durable_seq
+        self.durable.apply_snapshot(snap)
         # adopt the cluster's hot config state (joiner side of emqx_conf)
         import json as _json
 
@@ -581,10 +608,14 @@ class Cluster:
             return
         owner = self._registry.get(cid)
         if owner is None:
+            # no live owner on record: a dead node's durable replica may
+            # still hold the session — promote it here (emqx_ds failover)
+            self.durable.maybe_promote(cid, pkt.clean_start)
             return
         peer = self.peers.get(owner)
         if peer is None or not peer.up:
             self._registry.pop(cid, None)
+            self.durable.maybe_promote(cid, pkt.clean_start)
             return
         try:
             resp = await peer.conn.call(
@@ -708,6 +739,9 @@ class Cluster:
         if kind == "config_update":
             self._apply_config_update(frame.config_update)
             return None
+        if kind == "durable_deltas":
+            self.durable.apply_deltas(frame.durable_deltas)
+            return None
         if kind == "takeover_request":
             return pb.ClusterFrame(
                 takeover_reply=self._handle_takeover(frame.takeover_request)
@@ -736,4 +770,5 @@ class Cluster:
             "registry_size": len(self._registry),
             "forwards_out": self.forwards_out,
             "forwards_in": self.forwards_in,
+            "durable": self.durable.info(),
         }

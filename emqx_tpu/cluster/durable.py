@@ -1,0 +1,393 @@
+"""Durable-state replication: retained messages + persistent sessions
+survive node loss.
+
+The ``emqx_ds`` generations/replication analog (SURVEY.md §5.4; 5.x
+``emqx_persistent_session_ds`` + ``emqx_retainer_mnesia`` replicated
+tables [U]) rebuilt on the existing cluster delta channel:
+
+* **retained messages** become a fully replicated table: every local
+  store/delete broadcasts a ``DurableOp`` on the peer stream; receivers
+  apply it into their OWN retainer (last-writer-wins by message
+  timestamp, deletions remembered as TTL'd tombstones so a lagging put
+  cannot resurrect a deleted topic).  Every node then serves
+  subscribe-replay locally — exactly the mnesia table semantics —
+  and the existing per-node :class:`~emqx_tpu.storage.persistence.
+  Persistence` makes the replica durable on each node's disk.
+* **persistent sessions** (clean_start=false or expiry>0) ship as
+  passive replicas: the owning node diffs+broadcasts its durable
+  sessions' serialized state (``session_to_dict``) every
+  ``SYNC_INTERVAL``; peers hold ``{clientid: (ts, state)}``.  When the
+  owner is GONE (nodedown/partition) and the client reconnects
+  elsewhere, the receiving node PROMOTES its replica — resubscribing
+  (which re-feeds routes and the device mirror) and redelivering
+  pending messages.  While the owner is alive, the ordinary takeover
+  protocol runs instead; promotion during a partition can briefly
+  double-own a session, resolved by the same last-writer-wins shipping
+  once the partition heals (the autoheal trade the reference makes).
+
+Sequencing and bootstrap reuse the route-replication discipline: own
+sequence counter per origin, gap ⇒ re-bootstrap via the ordinary
+Snapshot (which carries retained + durable sessions + tombstones).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..storage.codec import session_restore, session_to_dict
+from . import cluster_pb2 as pb
+
+log = logging.getLogger(__name__)
+
+__all__ = ["DurableReplicator"]
+
+
+class DurableReplicator:
+    SYNC_INTERVAL = 0.5
+    TOMBSTONE_TTL = 3600.0
+
+    def __init__(self, cluster: Any,
+                 restored_replicas: Optional[Dict[str, Tuple[float, dict]]]
+                 = None) -> None:
+        self.cluster = cluster
+        self.node = cluster.node
+        self.broker = cluster.broker
+        self._seq = 0
+        self._pending: List[pb.DurableOp] = []
+        # clientid -> (lww_ts, session_to_dict state) for sessions OWNED
+        # BY PEERS; promoted on reconnect when the owner is gone
+        self.session_replicas: Dict[str, Tuple[float, dict]] = dict(
+            restored_replicas or {})
+        # deletion tombstones, SEPARATE per namespace: a terminated
+        # session's clientid must never shadow a retained topic of the
+        # same name (and vice versa)
+        self._retain_tombstones: Dict[str, float] = {}
+        self._session_tombstones: Dict[str, float] = {}
+        self._shipped: Dict[str, str] = {}        # cid -> last shipped json
+        # sessions whose state changed since the last flush (fed by the
+        # broker hooks); bounds the per-flush serialization work to what
+        # actually changed instead of O(all session state) every 0.5 s
+        self._dirty: set = set()
+        self._flushes = 0
+        self.FULL_RESCAN_EVERY = 20   # safety-net sweep for missed signals
+        self._applying_remote = False
+        self.promotions = 0
+
+    # ------------------------------------------------------------------
+    # wiring
+    # ------------------------------------------------------------------
+
+    _DIRTY_HOOKS = ("session.created", "session.resumed",
+                    "session.subscribed", "session.unsubscribed",
+                    "message.delivered", "message.acked")
+
+    def attach(self) -> None:
+        if self.node.retainer is not None:
+            self.node.retainer.on_change = self._on_retained_change
+        self.broker.hooks.add(
+            "session.terminated", self._on_session_terminated,
+            name="cluster.durable.terminated")
+        for point in self._DIRTY_HOOKS:
+            self.broker.hooks.add(
+                point, self._mark_dirty, name=f"cluster.durable.{point}")
+
+    def _mark_dirty(self, clientid, *_a) -> None:
+        self._dirty.add(clientid)
+
+    def detach(self) -> None:
+        if self.node.retainer is not None \
+                and self.node.retainer.on_change == self._on_retained_change:
+            self.node.retainer.on_change = None
+        self.broker.hooks.delete(
+            "session.terminated", "cluster.durable.terminated")
+        for point in self._DIRTY_HOOKS:
+            self.broker.hooks.delete(point, f"cluster.durable.{point}")
+
+    # ------------------------------------------------------------------
+    # local mutations -> queued ops
+    # ------------------------------------------------------------------
+
+    def _on_retained_change(self, topic: str, msg) -> None:
+        if self._applying_remote:
+            return
+        now = time.time()
+        if msg is None:
+            self._retain_tombstones[topic] = now
+            self._pending.append(pb.DurableOp(
+                kind=pb.DurableOp.RETAIN_DEL, key=topic, ts=now))
+        else:
+            self._retain_tombstones.pop(topic, None)
+            from .cluster import _wire_msg
+
+            self._pending.append(pb.DurableOp(
+                kind=pb.DurableOp.RETAIN_PUT, key=topic,
+                message=_wire_msg(msg),
+                ts=float(getattr(msg, "timestamp", 0.0) or now)))
+
+    def _on_session_terminated(self, clientid: str) -> None:
+        if self._applying_remote or clientid not in self._shipped:
+            return
+        self._shipped.pop(clientid, None)
+        self._dirty.discard(clientid)
+        now = time.time()
+        self._session_tombstones[clientid] = now
+        self._pending.append(pb.DurableOp(
+            kind=pb.DurableOp.SESSION_DEL, key=clientid, ts=now))
+
+    def _durable_sessions(self):
+        for cid, sess in self.broker.sessions.items():
+            if not sess.clean_start or sess.expiry_interval > 0:
+                yield cid, sess
+
+    def _collect_session_changes(self) -> None:
+        now = time.time()
+        self._flushes += 1
+        full = self._flushes % self.FULL_RESCAN_EVERY == 0
+        dirty, self._dirty = self._dirty, set()
+        for cid, sess in list(self._durable_sessions()):
+            # serialize only never-shipped, hook-flagged, or (on the
+            # periodic safety-net sweep) every durable session
+            if not full and cid in self._shipped and cid not in dirty:
+                continue
+            try:
+                j = json.dumps(session_to_dict(sess), sort_keys=True,
+                               default=str)
+            except Exception:
+                log.exception("serialize session %r failed", cid)
+                continue
+            if self._shipped.get(cid) != j:
+                self._shipped[cid] = j
+                self._session_tombstones.pop(cid, None)
+                self._pending.append(pb.DurableOp(
+                    kind=pb.DurableOp.SESSION_PUT, key=cid,
+                    session_json=j, ts=now))
+
+    # ------------------------------------------------------------------
+    # broadcast loop
+    # ------------------------------------------------------------------
+
+    async def loop(self) -> None:
+        while self.cluster._running:
+            await asyncio.sleep(self.SYNC_INTERVAL)
+            try:
+                self.flush()
+            except Exception:
+                log.exception("durable flush failed")
+
+    def flush(self) -> None:
+        """Diff durable sessions, then broadcast every queued op as one
+        sequenced batch (no-op when nothing changed)."""
+        self._collect_session_changes()
+        self._prune_tombstones()
+        if not self._pending:
+            return
+        ops, self._pending = self._pending, []
+        frame = pb.ClusterFrame()
+        frame.durable_deltas.origin = self.cluster.name
+        frame.durable_deltas.from_seq = self._seq
+        self._seq += 1
+        frame.durable_deltas.to_seq = self._seq
+        for op in ops:
+            frame.durable_deltas.ops.add().CopyFrom(op)
+        for peer in self.cluster.peers.values():
+            if peer.up:
+                peer.conn.cast(frame)
+
+    def _prune_tombstones(self) -> None:
+        cut = time.time() - self.TOMBSTONE_TTL
+        for tombs in (self._retain_tombstones, self._session_tombstones):
+            for k in [k for k, ts in tombs.items() if ts < cut]:
+                del tombs[k]
+
+    # ------------------------------------------------------------------
+    # receive path
+    # ------------------------------------------------------------------
+
+    def apply_deltas(self, dd: pb.DurableDeltas) -> None:
+        """Same gap discipline as route deltas: buffer during bootstrap,
+        re-bootstrap on a sequence gap, drop duplicates."""
+        peer = self.cluster.peers.get(dd.origin)
+        if peer is None:
+            return
+        if peer.bootstrapping:
+            peer.pending_durable.append(dd)
+            return
+        if dd.from_seq > peer.durable_seq:
+            peer.bootstrapped = False
+            peer.pending_durable.append(dd)
+            asyncio.ensure_future(self.cluster._bootstrap_from(peer))
+            return
+        if dd.to_seq <= peer.durable_seq:
+            return
+        for op in dd.ops:
+            self._apply_op(op)
+        peer.durable_seq = dd.to_seq
+
+    def replay_pending(self, peer) -> None:
+        """Post-bootstrap replay (called by Cluster._bootstrap_from)."""
+        for dd in peer.pending_durable:
+            if dd.to_seq > peer.durable_seq:
+                for op in dd.ops:
+                    self._apply_op(op)
+                peer.durable_seq = dd.to_seq
+        peer.pending_durable.clear()
+
+    def _apply_op(self, op: pb.DurableOp) -> None:
+        key, ts = op.key, op.ts
+        if op.kind == pb.DurableOp.RETAIN_PUT:
+            self._apply_retain_put(key, ts, wire=op.message)
+        elif op.kind == pb.DurableOp.RETAIN_DEL:
+            self._apply_retain_del(key, ts)
+        elif op.kind == pb.DurableOp.SESSION_PUT:
+            try:
+                state = json.loads(op.session_json)
+            except Exception:
+                return
+            self._apply_session_put(key, ts, state)
+        elif op.kind == pb.DurableOp.SESSION_DEL:
+            cur = self.session_replicas.get(key)
+            if cur is None or cur[0] <= ts:
+                self.session_replicas.pop(key, None)
+            self._session_tombstones[key] = max(
+                self._session_tombstones.get(key, 0.0), ts)
+
+    def _apply_retain_put(self, topic: str, ts: float, wire) -> None:
+        ret = self.node.retainer
+        if ret is None:
+            return
+        if self._retain_tombstones.get(topic, -1.0) >= ts:
+            return                        # deleted later than this put
+        cur = ret.get(topic)
+        if cur is not None and (cur.timestamp or 0.0) > ts:
+            return                        # local copy is newer (LWW)
+        from .cluster import _from_wire
+
+        msg = _from_wire(wire)
+        self._applying_remote = True
+        try:
+            ret.insert(msg.clone(retain=True))
+        finally:
+            self._applying_remote = False
+
+    def _apply_retain_del(self, topic: str, ts: float) -> None:
+        ret = self.node.retainer
+        if ret is None:
+            return
+        cur = ret.get(topic)
+        if cur is not None and (cur.timestamp or 0.0) > ts:
+            return                        # a newer put wins over this del
+        self._retain_tombstones[topic] = max(
+            self._retain_tombstones.get(topic, 0.0), ts)
+        self._applying_remote = True
+        try:
+            ret.delete(topic)
+        finally:
+            self._applying_remote = False
+
+    def _apply_session_put(self, cid: str, ts: float, state: dict) -> None:
+        if cid in self.broker.sessions:
+            return                        # we own the live session
+        if self._session_tombstones.get(cid, -1.0) >= ts:
+            return
+        cur = self.session_replicas.get(cid)
+        if cur is not None and cur[0] >= ts:
+            return
+        self.session_replicas[cid] = (ts, state)
+
+    # ------------------------------------------------------------------
+    # snapshot integration
+    # ------------------------------------------------------------------
+
+    def fill_snapshot(self, snap: pb.Snapshot) -> None:
+        from .cluster import _wire_msg
+
+        ret = self.node.retainer
+        if ret is not None:
+            for topic in ret.topics():
+                m = ret.get(topic)
+                if m is not None:
+                    snap.retained.append(pb.Snapshot.RetainedEntry(
+                        message=_wire_msg(m),
+                        ts=float(m.timestamp or 0.0)))
+        now = time.time()
+        for cid, sess in self._durable_sessions():
+            try:
+                snap.durable_sessions.append(pb.Snapshot.DurableSession(
+                    clientid=cid,
+                    session_json=json.dumps(session_to_dict(sess),
+                                            default=str),
+                    ts=now))
+            except Exception:
+                log.exception("snapshot session %r failed", cid)
+        for key, ts in self._retain_tombstones.items():
+            snap.durable_tombstones.append(pb.Snapshot.Tombstone(
+                key=key, ts=ts, kind=pb.DurableOp.RETAIN_DEL))
+        for key, ts in self._session_tombstones.items():
+            snap.durable_tombstones.append(pb.Snapshot.Tombstone(
+                key=key, ts=ts, kind=pb.DurableOp.SESSION_DEL))
+
+    def apply_snapshot(self, snap: pb.Snapshot) -> None:
+        for t in snap.durable_tombstones:
+            if t.kind == pb.DurableOp.SESSION_DEL:
+                if self._session_tombstones.get(t.key, 0.0) < t.ts:
+                    cur = self.session_replicas.get(t.key)
+                    if cur is not None and cur[0] <= t.ts:
+                        del self.session_replicas[t.key]
+                    self._session_tombstones[t.key] = t.ts
+            elif self._retain_tombstones.get(t.key, 0.0) < t.ts:
+                self._apply_retain_del(t.key, t.ts)
+        for entry in snap.retained:
+            self._apply_retain_put(entry.message.topic, entry.ts,
+                                   wire=entry.message)
+        for ds in snap.durable_sessions:
+            try:
+                state = json.loads(ds.session_json)
+            except Exception:
+                continue
+            self._apply_session_put(ds.clientid, ds.ts, state)
+
+    # ------------------------------------------------------------------
+    # promotion (owner gone, client reconnected here)
+    # ------------------------------------------------------------------
+
+    def maybe_promote(self, clientid: str, clean_start: bool) -> bool:
+        """Restore the replica of a dead owner's durable session into
+        THIS broker (resubscribe + redeliver pending).  For clean-start
+        connects the replica is discarded cluster-wide instead."""
+        rep = self.session_replicas.get(clientid)
+        if rep is None:
+            return False
+        now = time.time()
+        if clean_start:
+            del self.session_replicas[clientid]
+            self._session_tombstones[clientid] = now
+            self._pending.append(pb.DurableOp(
+                kind=pb.DurableOp.SESSION_DEL, key=clientid, ts=now))
+            return False
+        try:
+            sess = session_restore(self.broker, rep[1])
+        except Exception:
+            # keep the replica: a transient restore failure must not
+            # destroy the only surviving copy of the session
+            log.exception("promote session %r failed", clientid)
+            return False
+        self.session_replicas.pop(clientid, None)
+        if sess is not None:
+            sess.connected = False
+        self.promotions += 1
+        log.info("%s: promoted durable session %r from replica",
+                 self.cluster.name, clientid)
+        return True
+
+    def info(self) -> dict:
+        return {
+            "session_replicas": len(self.session_replicas),
+            "tombstones": len(self._retain_tombstones)
+            + len(self._session_tombstones),
+            "promotions": self.promotions,
+            "seq": self._seq,
+        }

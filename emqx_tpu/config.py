@@ -256,6 +256,9 @@ SCHEMA: Dict[str, Field] = {
     "gateway.mqttsn.gateway_id": Field(1, int),
     "gateway.coap.enable": Field(False, _bool),
     "gateway.coap.bind": Field("127.0.0.1:5683", str),
+    "gateway.coap.dtls.enable": Field(False, _bool),
+    # comma list of identity:hexkey PSK entries (emqx_psk table analog)
+    "gateway.coap.dtls.psk": Field("", str),
     "gateway.exproto.enable": Field(False, _bool),
     "gateway.exproto.bind": Field("127.0.0.1:7993", str),
     # the user's ConnectionHandler gRPC endpoint
@@ -263,6 +266,8 @@ SCHEMA: Dict[str, Field] = {
     "gateway.exproto.adapter_listen": Field("127.0.0.1:0", str),
     "gateway.lwm2m.enable": Field(False, _bool),
     "gateway.lwm2m.bind": Field("127.0.0.1:5783", str),
+    "gateway.lwm2m.dtls.enable": Field(False, _bool),
+    "gateway.lwm2m.dtls.psk": Field("", str),
 
     # -- exhook (gRPC extension boundary, SURVEY.md §2.3) -----------------
     # comma-separated "name=url" pairs, e.g. "default=127.0.0.1:9000"

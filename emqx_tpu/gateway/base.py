@@ -18,7 +18,8 @@ from ..broker.session import Publish, SubOpts
 
 log = logging.getLogger(__name__)
 
-__all__ = ["GatewayConn", "Gateway", "GatewayManager"]
+__all__ = ["GatewayConn", "Gateway", "GatewayManager",
+           "wrap_dtls_transport"]
 
 
 class GatewayConn:
@@ -239,3 +240,31 @@ class GatewayManager:
 
     def list(self) -> List[Dict[str, Any]]:
         return [g.info() for g in self.gateways.values()]
+
+
+def wrap_dtls_transport(gw) -> None:
+    """Interpose a DTLS 1.2 PSK endpoint between a UDP gateway and its
+    datagram transport when ``conf["dtls"]["enable"]`` is set (the
+    reference's esockd DTLS listeners for CoAP/LwM2M [U]).
+
+    Sets ``gw.ingress`` — what the gateway's DatagramProtocol must feed
+    raw datagrams to — and swaps ``gw.transport`` for the endpoint so
+    every existing ``transport.sendto(plaintext, addr)`` call sends
+    protected records transparently."""
+    dconf = gw.conf.get("dtls") or {}
+    if not dconf.get("enable"):
+        gw.dtls = None
+        gw.ingress = gw.on_datagram
+        return
+    from ..transport.dtls import DtlsEndpoint, PskStore
+
+    entries = {}
+    for ident, key in (dconf.get("psk") or {}).items():
+        entries[ident] = bytes.fromhex(key) if isinstance(key, str) else key
+    ep = DtlsEndpoint(
+        gw.transport, gw.on_datagram, PskStore(entries),
+        idle_timeout=float(getattr(gw, "idle_timeout", 120.0)),
+    )
+    gw.transport = ep
+    gw.dtls = ep
+    gw.ingress = ep.datagram_received

@@ -28,7 +28,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..broker.session import Publish
-from .base import Gateway, GatewayConn
+from .base import Gateway, GatewayConn, wrap_dtls_transport
 
 log = logging.getLogger(__name__)
 
@@ -295,7 +295,7 @@ class _Proto(asyncio.DatagramProtocol):
         self.gw.transport = transport
 
     def datagram_received(self, data: bytes, addr) -> None:
-        self.gw.on_datagram(data, addr)
+        self.gw.ingress(data, addr)
 
 
 class CoapGateway(Gateway):
@@ -317,8 +317,10 @@ class CoapGateway(Gateway):
             lambda: _Proto(self), local_addr=(host or "0.0.0.0", int(port))
         )
         self.port = self.transport.get_extra_info("sockname")[1]
+        wrap_dtls_transport(self)
         self._sweeper = asyncio.ensure_future(self._sweep())
-        log.info("coap gateway on udp %s:%d", host, self.port)
+        log.info("coap gateway on udp%s %s:%d",
+                 "+dtls" if self.dtls else "", host, self.port)
 
     async def stop(self) -> None:
         if self._sweeper is not None:
@@ -357,6 +359,9 @@ class CoapGateway(Gateway):
                 if now - c.last_seen > self.idle_timeout:
                     c.detach_session(discard=True, reason="idle timeout")
                     self.drop(addr)
+            if self.dtls is not None:
+                self.dtls.sweep(now)
 
     def info(self) -> Dict[str, Any]:
-        return {**super().info(), "port": self.port, "transport": "udp"}
+        return {**super().info(), "port": self.port,
+                "transport": "udp+dtls" if self.dtls else "udp"}

@@ -17,8 +17,9 @@ Behavioral reference: ``apps/emqx_gateway/src/lwm2m`` [U] (SURVEY.md
 
 Implements the client-registration interface (POST /rd, update,
 deregister, lifetime expiry) and the device-management ops above over
-the RFC 7252 codec in :mod:`.coap`.  DTLS is out of scope (same posture
-as TLS-PSK: gated on runtime support).
+the RFC 7252 codec in :mod:`.coap`.  With ``dtls.enable`` the whole
+exchange runs over DTLS 1.2 PSK (:mod:`emqx_tpu.transport.dtls`), the
+reference's esockd DTLS listener posture [U].
 """
 
 from __future__ import annotations
@@ -32,7 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..broker.session import Publish
 from . import coap as C
-from .base import Gateway, GatewayConn
+from .base import Gateway, GatewayConn, wrap_dtls_transport
 
 log = logging.getLogger(__name__)
 
@@ -208,14 +209,16 @@ class Lwm2mGateway(Gateway):
                 self.transport = transport
 
             def datagram_received(p, data, addr) -> None:  # noqa: N805
-                self.on_datagram(data, addr)
+                self.ingress(data, addr)
 
         self.transport, _ = await loop.create_datagram_endpoint(
             _Proto, local_addr=(host or "0.0.0.0", int(port))
         )
         self.port = self.transport.get_extra_info("sockname")[1]
+        wrap_dtls_transport(self)
         self._sweeper = asyncio.ensure_future(self._sweep())
-        log.info("lwm2m gateway on udp %s:%d", host, self.port)
+        log.info("lwm2m gateway on udp%s %s:%d",
+                 "+dtls" if self.dtls else "", host, self.port)
 
     async def stop(self) -> None:
         if self._sweeper is not None:
@@ -428,7 +431,10 @@ class Lwm2mGateway(Gateway):
                     c.publish_up("register", {"op": "expired"})
                     c.detach_session(discard=True, reason="lifetime expired")
                     self.drop(c)
+            if self.dtls is not None:
+                self.dtls.sweep(now)
 
     def info(self) -> Dict[str, Any]:
-        return {**super().info(), "port": self.port, "transport": "udp",
+        return {**super().info(), "port": self.port,
+                "transport": "udp+dtls" if self.dtls else "udp",
                 "endpoints": sorted(self.by_ep)}

@@ -583,6 +583,27 @@ class BrokerNode:
                 conf["handler"] = self.config.get("gateway.exproto.handler")
                 conf["adapter_listen"] = self.config.get(
                     "gateway.exproto.adapter_listen")
+            if name in ("coap", "lwm2m"):
+                psk_raw = self.config.get(f"gateway.{name}.dtls.psk")
+                psk = {}
+                for p in psk_raw.split(","):
+                    p = p.strip()
+                    if ":" not in p:
+                        continue
+                    ident, hexkey = p.split(":", 1)
+                    try:
+                        psk[ident.strip()] = bytes.fromhex(hexkey.strip())
+                    except ValueError:
+                        # one bad entry disables one identity, not the
+                        # whole gateway
+                        log.warning("gateway.%s.dtls.psk: bad hex key for "
+                                    "identity %r; entry skipped",
+                                    name, ident.strip())
+                conf["dtls"] = {
+                    "enable": self.config.get(
+                        f"gateway.{name}.dtls.enable"),
+                    "psk": psk,
+                }
             try:
                 await self.gateways.load(name, conf)
             except Exception:

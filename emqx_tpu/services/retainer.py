@@ -58,6 +58,9 @@ class Retainer:
         self._store: Dict[str, Message] = {}
         self._root = _TopicNode()
         self.stats = {"dropped_oversize": 0, "dropped_table_full": 0}
+        # change observer (cluster durable replication): called with
+        # (topic, message) after a store, (topic, None) after a delete
+        self.on_change = None
 
     # ------------------------------------------------------------------
     # store mutation
@@ -68,6 +71,10 @@ class Retainer:
 
     def topics(self) -> List[str]:
         return list(self._store)
+
+    def get(self, topic: str) -> Optional[Message]:
+        """The stored retained message for an exact topic, if any."""
+        return self._store.get(topic)
 
     def insert(self, msg: Message) -> bool:
         """Store (or delete, for empty payloads) a retained message."""
@@ -98,6 +105,8 @@ class Retainer:
         for w in T.words(msg.topic):
             node = node.children.setdefault(w, _TopicNode())
         node.topic = msg.topic
+        if self.on_change is not None:
+            self.on_change(msg.topic, self._store[msg.topic])
         return True
 
     def delete(self, topic: str) -> bool:
@@ -116,6 +125,8 @@ class Retainer:
                 del parent.children[w]
             else:
                 break
+        if self.on_change is not None:
+            self.on_change(topic, None)
         return True
 
     def clean_expired(self, now: Optional[float] = None) -> int:

@@ -45,6 +45,11 @@ class Persistence:
         self.t_retained = self.store.table("retained")
         self.t_delayed = self.store.table("delayed")
         self.t_banned = self.store.table("banned")
+        # replicas of OTHER nodes' durable sessions (cluster durable
+        # replication): persisted so a full-cluster restart still allows
+        # promotion; restored via node._restored_session_replicas and
+        # NEVER re-opened as local sessions
+        self.t_session_replicas = self.store.table("session_replicas")
         self.last_sync = 0.0
         # serializes threaded sync_async writes against close(): a
         # cancelled housekeeping task does NOT stop its to_thread worker,
@@ -58,7 +63,16 @@ class Persistence:
     # ------------------------------------------------------------------
 
     def restore(self) -> Dict[str, int]:
-        counts = {"sessions": 0, "retained": 0, "delayed": 0, "banned": 0}
+        counts = {"sessions": 0, "retained": 0, "delayed": 0, "banned": 0,
+                  "session_replicas": 0}
+        replicas = {}
+        for cid, d in list(self.t_session_replicas.items()):
+            try:
+                replicas[cid] = (float(d["ts"]), d["session"])
+                counts["session_replicas"] += 1
+            except Exception:
+                log.exception("restore session replica %r failed", cid)
+        self.node._restored_session_replicas = replicas
         for _cid, d in list(self.t_sessions.items()):
             try:
                 sess = session_restore(self.broker, d)
@@ -144,6 +158,18 @@ class Persistence:
             f"{e.kind}:{e.who}": ban_to_dict(e)
             for e in self.node.banned.list()
         }))
+        cluster = getattr(self.node, "cluster", None)
+        if cluster is not None:
+            replicas = cluster.durable.session_replicas
+        else:
+            # after cluster teardown the final stash (or the restored
+            # set, if clustering never came up) is still authoritative
+            replicas = getattr(self.node, "_restored_session_replicas", None)
+        if replicas is not None:
+            work.append((self.t_session_replicas, {
+                cid: {"ts": ts, "session": state}
+                for cid, (ts, state) in replicas.items()
+            }))
         return work
 
     def _write(self, work: List[tuple]) -> None:
