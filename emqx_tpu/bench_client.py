@@ -106,6 +106,7 @@ async def run_scenario(
     messages: int = 0,          # pub: fixed message count per client (0 = by duration)
     subscribers: int = 0,       # pub: also start in-process subscribers for e2e latency
     clean_start: bool = True,
+    inflight: int = 0,          # pub qos1: pipelined-ack window (0 = serial)
 ) -> Dict[str, Any]:
     stats = BenchStats()
 
@@ -185,7 +186,17 @@ async def run_scenario(
 
         async def publish_loop(i: int, c: Client):
             sent = 0
-            next_at = time.perf_counter()
+            # stagger client phases across one interval: N aligned
+            # clients would otherwise fire N-message bursts every
+            # interval and the queueing delay would read as broker
+            # latency (emqtt_bench staggers the same way)
+            next_at = time.perf_counter() + (
+                interval * i / max(1, count) if interval else 0.0)
+            # pipelined QoS1 (emqtt_bench async-pub mode): the offered
+            # rate stays on schedule while up to `inflight` PUBACKs ride
+            # the wire, instead of serializing one RTT per message
+            window: list = []
+            pipelined = inflight > 0 and qos == 1
             while (messages and sent < messages) or (
                 not messages and time.perf_counter() < end
             ):
@@ -195,11 +206,22 @@ async def run_scenario(
                         await asyncio.sleep(next_at - now)
                     next_at += interval
                 payload = struct.pack("<d", time.perf_counter()) + pad
-                await c.publish(_topic_of(topic, i), payload, qos=qos)
+                if pipelined:
+                    window.append(
+                        c.publish_start(_topic_of(topic, i), payload))
+                    if len(window) >= inflight:
+                        await window.pop(0)
+                else:
+                    await c.publish(_topic_of(topic, i), payload, qos=qos)
                 sent += 1
                 stats.sent += 1
                 if not interval:
                     await asyncio.sleep(0)  # yield: unpaced fairness
+            for fut in window:
+                try:
+                    await fut
+                except Exception:
+                    pass
 
         await asyncio.gather(
             *(publish_loop(i, c) for i, c in enumerate(pubs))
@@ -229,13 +251,14 @@ def main(argv: Optional[List[str]] = None) -> Dict[str, Any]:
     ap.add_argument("-d", "--duration", type=float, default=5.0)
     ap.add_argument("-n", "--messages", type=int, default=0)
     ap.add_argument("--subscribers", type=int, default=0)
+    ap.add_argument("--inflight", type=int, default=0)
     a = ap.parse_args(argv)
     out = asyncio.run(
         run_scenario(
             a.scenario, host=a.host, port=a.port, count=a.count,
             rate=a.rate, topic=a.topic, qos=a.qos, payload_size=a.size,
             duration=a.duration, messages=a.messages,
-            subscribers=a.subscribers,
+            subscribers=a.subscribers, inflight=a.inflight,
         )
     )
     print(json.dumps(out))

@@ -93,8 +93,13 @@ class Hooks:
 
     def run(self, point: str, args: Tuple = ()) -> str:
         """Run the chain; returns OK or STOP (whichever ended it)."""
-        for cb in list(self._points.get(point, [])):
+        cbs = self._points.get(point)
+        if not cbs:
+            return OK          # empty chains are the hot-path common case
+        for cb in list(cbs):   # copy: callbacks may mutate the chain
             res = cb.fn(*args)
+            if res is None:
+                continue
             verdict, _ = _normalize(res, None)
             if verdict == STOP:
                 return STOP
@@ -102,8 +107,13 @@ class Hooks:
 
     def run_fold(self, point: str, args: Tuple, acc: Any) -> Any:
         """Run the chain threading ``acc``; returns the final accumulator."""
-        for cb in list(self._points.get(point, [])):
+        cbs = self._points.get(point)
+        if not cbs:
+            return acc
+        for cb in list(cbs):
             res = cb.fn(*args, acc)
+            if res is None:
+                continue
             verdict, acc = _normalize(res, acc)
             if verdict == STOP:
                 break

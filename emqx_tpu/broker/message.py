@@ -46,7 +46,8 @@ class Message:
         return (now if now is not None else time.time()) > self.timestamp + exp
 
     def with_qos(self, qos: int) -> "Message":
-        return replace(self, qos=qos)
+        # hot path: QoS already effective for most deliveries — no copy
+        return self if qos == self.qos else replace(self, qos=qos)
 
     def clone(self, **kw) -> "Message":
         return replace(self, **kw)

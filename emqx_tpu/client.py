@@ -81,6 +81,9 @@ class Client:
         self._reader, self._writer = await asyncio.open_connection(
             self.host, self.port
         )
+        from .transport.connection import set_nodelay
+
+        set_nodelay(self._writer.get_extra_info("socket"))
         # inbound packets parse with the version we offer (the server's
         # parser learns it from our CONNECT; ours must be pre-pinned)
         self._parser.proto_ver = self.proto_ver
@@ -173,6 +176,31 @@ class Client:
         if qos == 1:
             ack = await self._request(pkt, (P.PUBACK, pid), timeout)
             return getattr(ack, "reason_code", 0)
+        return await self._publish_qos2(pkt, pid, timeout)
+
+    def publish_start(
+        self,
+        topic: str,
+        payload: bytes = b"",
+        retain: bool = False,
+        properties: Optional[Dict[str, Any]] = None,
+    ):
+        """Pipelined QoS1 publish: send now, return the PUBACK future —
+        the emqtt_bench async-publish mode.  The caller bounds its own
+        inflight window by awaiting futures."""
+        pkt = P.Publish(
+            qos=1, retain=retain, topic=topic, payload=payload,
+            properties=properties or {},
+        )
+        pid = pkt.packet_id = self._next_pid()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        key = (P.PUBACK, pid)
+        self._pending[key] = fut
+        fut.add_done_callback(lambda _f: self._pending.pop(key, None))
+        self._send(pkt)
+        return fut
+
+    async def _publish_qos2(self, pkt, pid: int, timeout: float):
         rec = await self._request(pkt, (P.PUBREC, pid), timeout)
         rc = getattr(rec, "reason_code", 0)
         if rc >= 0x80:
@@ -181,6 +209,12 @@ class Client:
             P.PubAck(P.PUBREL, pid), (P.PUBCOMP, pid), timeout
         )
         return getattr(comp, "reason_code", 0)
+
+    async def recv(self, timeout: float = 10.0) -> "InboundMessage":
+        if not self.messages.empty():
+            # fast path: no timer arm/disarm per already-queued message
+            return self.messages.get_nowait()
+        return await asyncio.wait_for(self.messages.get(), timeout)
 
     async def disconnect(self, reason_code: int = 0) -> None:
         if self._writer is not None and not self._writer.is_closing():
@@ -207,8 +241,6 @@ class Client:
     async def wait_closed(self) -> None:
         await self._closed.wait()
 
-    async def recv(self, timeout: float = 10.0) -> InboundMessage:
-        return await asyncio.wait_for(self.messages.get(), timeout)
 
     # ------------------------------------------------------------------
 

@@ -176,6 +176,12 @@ SCHEMA: Dict[str, Field] = {
     "listeners.tcp.default.bind": Field("0.0.0.0:1883", str),
     "listeners.tcp.default.max_connections": Field(1 << 20, int),
     "listeners.tcp.default.enable": Field(True, _bool),
+    # protocol-mode datapath (no per-connection tasks); stream path
+    # remains for ws/ssl and as a fallback switch
+    "listeners.tcp.default.fast_path": Field(True, _bool),
+    # bind with SO_REUSEPORT so several broker processes share the port
+    # (kernel-balanced multi-acceptor scale-out; cluster them as usual)
+    "listeners.tcp.default.reuse_port": Field(False, _bool),
     # TLS listener (certfile/keyfile PEM paths; psk.enable attaches the
     # PSK store to the handshake where the runtime supports it)
     "listeners.ssl.default.enable": Field(False, _bool),
@@ -187,6 +193,12 @@ SCHEMA: Dict[str, Field] = {
     # SNI: per-hostname cert chains, "host=cert.pem;key.pem" comma list
     # (emqx_tls_lib SNI analog); unmatched names fall to the default cert
     "listeners.ssl.default.sni": Field("", str),
+    # OCSP stapling cache (emqx_ocsp_cache analog); responder_url
+    # overrides the certificate's AIA entry
+    "listeners.ssl.default.ocsp.enable": Field(False, _bool),
+    "listeners.ssl.default.ocsp.responder_url": Field("", str),
+    "listeners.ssl.default.ocsp.refresh_interval": Field(3600.0, duration),
+    "listeners.ssl.default.ocsp.refresh_http_timeout": Field(10.0, duration),
     # revocation: CRL PEM path + check scope ("leaf" | "chain")
     "listeners.ssl.default.crlfile": Field("", str),
     "listeners.ssl.default.crl_check": Field("leaf", str),

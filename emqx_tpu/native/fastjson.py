@@ -1,0 +1,79 @@
+"""ctypes wrapper for the C++ single-field JSON extractor (jiffy
+analog — see fastjson.cpp for the measurement that justifies it).
+
+``get_path(payload, ("a", "b")) -> (found, value)``: found=False means
+"use json.loads" — missing key, escaped string, non-scalar result,
+bignum, or no native toolchain all land there, so the fast path can
+never change semantics, only skip work.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Sequence, Tuple
+
+from .build import load_library
+
+__all__ = ["get_path", "available"]
+
+_lib = None
+_loaded = False
+
+
+def _load():
+    global _lib, _loaded
+    if not _loaded:
+        _loaded = True
+        lib = load_library("fastjson")
+        if lib is not None:
+            lib.fj_get.restype = ctypes.c_int
+            lib.fj_get.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_size_t),
+                ctypes.POINTER(ctypes.c_double),
+                ctypes.POINTER(ctypes.c_longlong),
+            ]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def get_path(payload: bytes, path: Sequence[str]) -> Tuple[bool, Any]:
+    lib = _load()
+    if lib is None or not path:
+        return False, None
+    try:
+        p = "\x1f".join(path).encode("utf-8")
+    except UnicodeEncodeError:
+        return False, None
+    sptr = ctypes.c_char_p()
+    slen = ctypes.c_size_t()
+    dval = ctypes.c_double()
+    ival = ctypes.c_longlong()
+    rc = lib.fj_get(payload, len(payload), p, len(p),
+                    ctypes.byref(sptr), ctypes.byref(slen),
+                    ctypes.byref(dval), ctypes.byref(ival))
+    if rc == 0:
+        return False, None
+    if rc == 1:
+        raw = ctypes.string_at(sptr, slen.value)
+        try:
+            return True, raw.decode("utf-8")
+        except UnicodeDecodeError:
+            return False, None
+    if rc == 2:
+        return True, int(ival.value)
+    if rc == 3:
+        return True, float(dval.value)
+    if rc == 4:
+        return True, True
+    if rc == 5:
+        return True, False
+    if rc == 6:
+        return True, None
+    return False, None

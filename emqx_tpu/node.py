@@ -162,6 +162,7 @@ class BrokerNode:
             self.persistence.restore()
 
         self.exhook = None  # built lazily in start() (needs a loop + grpc)
+        self.ocsp_cache = None  # OCSP stapling cache (ssl listener)
         self.cluster = None  # built lazily in start() (needs a loop)
         self.match_service = None  # in-process TPU matcher (start())
         self.mgmt = None
@@ -280,6 +281,12 @@ class BrokerNode:
                         "listeners.tcp.default.max_connections"
                     ),
                     max_conn_rate=cfg.get("limiter.max_conn_rate"),
+                    reuse_port=cfg.get("listeners.tcp.default.reuse_port"),
+                    proto_factory=(
+                        self.make_protocol
+                        if cfg.get("listeners.tcp.default.fast_path")
+                        else None
+                    ),
                 )
             )
         if cfg.get("listeners.ssl.default.enable"):
@@ -410,6 +417,52 @@ class BrokerNode:
             server_keepalive=(cfg.get("mqtt.server_keepalive") or None),
         )
 
+    def _wants_intercept(self) -> bool:
+        return (
+            self.exhook is not None
+            or self.cluster is not None
+            or self.match_service is not None
+            or (self.access_control is not None
+                and self.access_control.needs_async())
+        )
+
+    def _register_on_connect(self, channel, conn) -> None:
+        """Wrap handle_in so the clientid→connection registry fills the
+        moment CONNECT lands (cheap and race-free on one loop)."""
+        prev = channel.handle_in
+
+        def handle_in_and_register(pkt):
+            acts = prev(pkt)
+            cid = channel.clientid
+            if cid is not None and self.connections.get(cid) is not conn:
+                if channel.state == "connected":
+                    self.connections[cid] = conn
+            return acts
+
+        channel.handle_in = handle_in_and_register
+
+    def make_protocol(self, info: ConnInfo):
+        """Listener factory for the protocol-mode TCP datapath."""
+        from .transport.proto_conn import MqttProtocol
+
+        channel = self.make_channel(conninfo={"listener": info.listener})
+        proto = MqttProtocol(
+            channel,
+            conninfo=info,
+            max_packet_size=self.config.get("mqtt.max_packet_size"),
+            limiter=self.limiter,
+            on_closed=self._proto_closed,
+            intercept=self._intercept if self._wants_intercept() else None,
+        )
+        channel.conn = proto
+        self._register_on_connect(channel, proto)
+        self._all_conns.add(proto)
+        return proto
+
+    def _proto_closed(self, proto) -> None:
+        self._all_conns.discard(proto)
+        self._conn_closed(proto)
+
     async def handle_stream(self, stream: Any, info: ConnInfo) -> None:
         """Listener entry: run one client connection to completion."""
         channel = self.make_channel(
@@ -424,28 +477,8 @@ class BrokerNode:
             on_closed=self._conn_closed,
         )
         channel.conn = conn  # takeover routing (connection.py)
-        # registration keyed by clientid happens lazily: channel learns its
-        # clientid from CONNECT; we poll-register on first delivery instead
-        # of adding a channel->node callback — cheap and race-free because
-        # everything runs on one loop.
-        prev_register = channel.handle_in
-
-        def handle_in_and_register(pkt):
-            acts = prev_register(pkt)
-            cid = channel.clientid
-            if cid is not None and self.connections.get(cid) is not conn:
-                if channel.state == "connected":
-                    self.connections[cid] = conn
-            return acts
-
-        channel.handle_in = handle_in_and_register
-        if (
-            self.exhook is not None
-            or self.cluster is not None
-            or self.match_service is not None
-            or (self.access_control is not None
-                and self.access_control.needs_async())
-        ):
+        self._register_on_connect(channel, conn)
+        if self._wants_intercept():
             conn.intercept = self._intercept
         self._all_conns.add(conn)
         try:
@@ -564,9 +597,44 @@ class BrokerNode:
                 interval=self.config.get("telemetry.interval"),
             )
             await self.telemetry.start()
+        self._start_ocsp()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(asyncio.ensure_future(self._housekeeping()))
+
+    def _start_ocsp(self) -> None:
+        """OCSP stapling cache for the TLS listener (emqx_ocsp_cache
+        analog); the staple hand-off itself is gated on runtime ssl
+        support — the cache keeps a fresh validated response either
+        way (`node.ocsp_cache.info()` on the health surface)."""
+        cfg = self.config
+        if not cfg.get("listeners.ssl.default.ocsp.enable") \
+                or not cfg.get("listeners.ssl.default.enable"):
+            return  # no TLS listener ⇒ nothing to staple for
+        cert = (cfg.get("listeners.ssl.default.certfile") or "").strip()
+        issuer = (cfg.get("listeners.ssl.default.cacertfile") or "").strip()
+        if not cert or not issuer:
+            log.warning("ocsp enabled but certfile/cacertfile missing")
+            return
+        try:
+            from .transport.ocsp import OcspCache
+
+            with open(cert, "rb") as f:
+                cert_pem = f.read()
+            with open(issuer, "rb") as f:
+                issuer_pem = f.read()
+            self.ocsp_cache = OcspCache(
+                cert_pem, issuer_pem,
+                responder_url=(cfg.get(
+                    "listeners.ssl.default.ocsp.responder_url") or None),
+                refresh_interval_s=cfg.get(
+                    "listeners.ssl.default.ocsp.refresh_interval"),
+                refresh_http_timeout_s=cfg.get(
+                    "listeners.ssl.default.ocsp.refresh_http_timeout"),
+            )
+            self.ocsp_cache.start()
+        except Exception:
+            log.exception("ocsp cache failed to start")
 
     async def _start_gateways(self) -> None:
         from .gateway import GatewayManager
@@ -758,6 +826,9 @@ class BrokerNode:
             self.telemetry = None
         if getattr(self, "gateways", None) is not None:
             await self.gateways.stop_all()
+        if self.ocsp_cache is not None:
+            self.ocsp_cache.stop()
+            self.ocsp_cache = None
         await self.bridges.stop_all()
         if self.match_service is not None:
             await self.match_service.stop()

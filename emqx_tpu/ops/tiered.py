@@ -1,0 +1,335 @@
+"""Two-tier hot/cold match table: VMEM pallas tier + HBM gather tier.
+
+VERDICT r4 item 2 / SURVEY.md §5.7, §7 stage 4: the single-chip kernel
+plateau is HBM-random-gather bound (ablation: edge+node gathers = 63–65%
+of kernel time), and publish traffic is Zipfian over root prefixes
+(BASELINE config 3).  So: partition the FILTER set by root word —
+
+* **hot tier** — filters under the most-published root prefixes,
+  compiled into a table small enough for VMEM
+  (:func:`~emqx_tpu.ops.pallas_match.supports_table`), matched by the
+  fused :func:`~emqx_tpu.ops.pallas_match.pallas_small_match` kernel
+  where every probe hits VMEM;
+* **cold tier** — every other filter, matched by the shipping HBM
+  ``nfa_match`` gather kernel.
+
+Root-level wildcard filters (``+``/``#`` first word) replicate into
+BOTH tiers (same rule as :mod:`~emqx_tpu.parallel.prefix_ep`: a filter
+can only match a topic whose root equals its own root, ``+`` or ``#``),
+so each topic needs exactly ONE tier: per-batch routing splits topics
+by root-prefix hotness, the Zipf-hot majority rides VMEM and only the
+cold tail pays HBM gathers.  Correctness is therefore a partition
+argument, and the parity suite checks the merged answer against the
+host oracle per topic.
+
+Tier selection (:func:`pick_hot_roots`) is observed-traffic-driven:
+rank roots by published-topic counts (the serving engine's natural
+byproduct), greedily admit while the projected hot table still fits
+the VMEM budget, then verify by compiling.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import topic as T
+from .compiler import NfaTable, compile_filters, encode_topics
+
+__all__ = ["TieredTable", "TieredMatcher", "bench_tiered",
+           "build_tiered", "pick_hot_roots", "split_filters"]
+
+
+def _root(flt: str) -> str:
+    return flt.split("/", 1)[0]
+
+
+def split_filters(filters: Sequence[str],
+                  hot_roots: Iterable[str]) -> Tuple[List[str], List[str]]:
+    """(hot, cold) filter lists; root wildcards replicate into both."""
+    hot_roots = set(hot_roots)
+    hot: List[str] = []
+    cold: List[str] = []
+    for f in sorted(set(filters)):
+        r = _root(f)
+        if r in ("+", "#"):
+            hot.append(f)
+            cold.append(f)
+        elif r in hot_roots:
+            hot.append(f)
+        else:
+            cold.append(f)
+    return hot, cold
+
+
+def pick_hot_roots(
+    filters: Sequence[str],
+    topic_counts: Dict[str, int],
+    vmem_budget_bytes: Optional[int] = None,
+    depth: int = 8,
+) -> List[str]:
+    """Choose the hot root set: greediest published-traffic roots whose
+    combined filter table is projected to fit VMEM.
+
+    Projection: the compiled table costs ~(16 B/state node row) +
+    (~16 B/edge amortized across cuckoo buckets); states+edges are
+    bounded by total words over the tier's filters.  The builder
+    verifies with a real compile and demotes if the estimate was low.
+    """
+    if vmem_budget_bytes is None:
+        from .pallas_match import VMEM_BUDGET_BYTES
+
+        vmem_budget_bytes = VMEM_BUDGET_BYTES
+    by_root: Dict[str, List[str]] = {}
+    for f in set(filters):
+        by_root.setdefault(_root(f), []).append(f)
+    by_root.pop("+", None)
+    by_root.pop("#", None)
+
+    def score(root: str) -> Tuple[int, int]:
+        # primary: observed publishes; tie-break: filter density
+        return (topic_counts.get(root, 0), len(by_root[root]))
+
+    ranked = sorted(by_root, key=score, reverse=True)
+    # ~2.2 table rows per filter word with padding/cuckoo headroom —
+    # matches the native builder's bucket sizing heuristics
+    budget_rows = vmem_budget_bytes // 16
+    picked: List[str] = []
+    rows = 0
+    for root in ranked:
+        if topic_counts and topic_counts.get(root, 0) == 0:
+            break   # no observed traffic: not hot, stop admitting
+        cost = int(sum(min(f.count("/") + 1, depth) for f in by_root[root])
+                   * 2.2)
+        if rows + cost > budget_rows:
+            continue
+        picked.append(root)
+        rows += cost
+    return picked
+
+
+class TieredTable(NamedTuple):
+    hot: Optional[NfaTable]     # None when no root qualified
+    cold: NfaTable
+    hot_roots: frozenset
+
+    def stats(self) -> dict:
+        hb = (int(self.hot.node_tab.nbytes + self.hot.edge_tab.nbytes)
+              if self.hot is not None else 0)
+        return {
+            "hot_roots": len(self.hot_roots),
+            "hot_filters": (len([f for f in self.hot.accept_filters
+                                 if f is not None])
+                            if self.hot is not None else 0),
+            "cold_filters": len([f for f in self.cold.accept_filters
+                                 if f is not None]),
+            "hot_table_bytes": hb,
+        }
+
+
+def build_tiered(filters: Sequence[str], hot_roots: Iterable[str],
+                 depth: int = 8) -> TieredTable:
+    """Compile both tiers; demote lowest roots until the hot tier
+    actually fits VMEM (the estimate in pick_hot_roots is a guess, the
+    compile is the truth)."""
+    from .pallas_match import supports_table
+
+    roots = list(hot_roots)
+    while roots:
+        hot_f, cold_f = split_filters(filters, roots)
+        hot_tab = compile_filters(hot_f, depth=depth) if hot_f else None
+        if hot_tab is None or supports_table(hot_tab.node_tab,
+                                             hot_tab.edge_tab):
+            return TieredTable(hot_tab, compile_filters(cold_f, depth=depth),
+                               frozenset(roots))
+        roots.pop()   # demote the least-hot admitted root and retry
+    _, cold_f = split_filters(filters, ())
+    return TieredTable(None, compile_filters(cold_f, depth=depth),
+                       frozenset())
+
+
+def route(topics: Sequence[str], hot_roots: frozenset) \
+        -> Tuple[List[int], List[int]]:
+    """Per-batch routing: topic indices → (hot, cold) by root prefix."""
+    hot_idx: List[int] = []
+    cold_idx: List[int] = []
+    for i, t in enumerate(topics):
+        if t.split("/", 1)[0] in hot_roots:
+            hot_idx.append(i)
+        else:
+            cold_idx.append(i)
+    return hot_idx, cold_idx
+
+
+class TieredMatcher:
+    """End-to-end two-tier matcher (the serving-engine building block
+    and the parity-test subject).
+
+    ``match(topics) -> List[List[str]]`` per-topic matched filters;
+    rows that spill either tier's active set fall open to the host
+    oracle, same discipline as every other engine.
+    """
+
+    def __init__(self, table: TieredTable, depth: int = 8,
+                 active_slots: int = 8, interpret: bool = False) -> None:
+        self.table = table
+        self.depth = depth
+        self.active_slots = active_slots
+        self.interpret = interpret   # pallas interpret mode (CPU tests)
+        self.hot_batches = 0
+        self.cold_batches = 0
+        self.hot_topics = 0
+        self.cold_topics = 0
+
+    # pallas tile alignment
+    @property
+    def _tile(self) -> int:
+        from .pallas_match import TILE_B
+
+        return TILE_B
+
+    def _match_hot(self, topics: List[str]) -> List[List[str]]:
+        import jax.numpy as jnp
+
+        from .pallas_match import pallas_small_match
+
+        tab = self.table.hot
+        B = max(self._tile,
+                -(-len(topics) // self._tile) * self._tile)
+        words, lens, is_sys = encode_topics(tab, topics, batch=B)
+        acc, aover = pallas_small_match(
+            jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+            *[jnp.asarray(a) for a in tab.device_arrays()],
+            depth=self.depth, active_slots=self.active_slots,
+            interpret=self.interpret)
+        acc = np.asarray(acc)[: len(topics)]
+        aover = np.asarray(aover)[: len(topics)]
+        self.hot_batches += 1
+        self.hot_topics += len(topics)
+        return self._decode(acc, aover, tab, topics)
+
+    def _match_cold(self, topics: List[str]) -> List[List[str]]:
+        import jax.numpy as jnp
+
+        from .match_kernel import nfa_match
+
+        tab = self.table.cold
+        words, lens, is_sys = encode_topics(tab, topics)
+        res = nfa_match(
+            jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+            *[jnp.asarray(a) for a in tab.device_arrays()],
+            active_slots=self.active_slots, compact_output=False)
+        acc = np.asarray(res.matches)[: len(topics)]
+        aover = np.asarray(res.active_overflow)[: len(topics)]
+        self.cold_batches += 1
+        self.cold_topics += len(topics)
+        return self._decode(acc, aover, tab, topics)
+
+    def _decode(self, acc, aover, tab: NfaTable,
+                topics: List[str]) -> List[List[str]]:
+        out: List[List[str]] = []
+        live = [f for f in tab.accept_filters]
+        for r, t in enumerate(topics):
+            if aover[r]:
+                # fail-open: this row's walk spilled; host oracle serves
+                out.append(sorted(
+                    f for f in live
+                    if f is not None and T.match(t, f)))
+                continue
+            row = acc[r]
+            out.append([live[a] for a in row[row >= 0]])
+        return out
+
+    def match(self, topics: Sequence[str]) -> List[List[str]]:
+        topics = list(topics)
+        if self.table.hot is None:
+            return self._match_cold(topics)
+        hot_idx, cold_idx = route(topics, self.table.hot_roots)
+        out: List[Optional[List[str]]] = [None] * len(topics)
+        if hot_idx:
+            for i, row in zip(hot_idx,
+                              self._match_hot([topics[i]
+                                               for i in hot_idx])):
+                out[i] = row
+        if cold_idx:
+            for i, row in zip(cold_idx,
+                              self._match_cold([topics[i]
+                                                for i in cold_idx])):
+                out[i] = row
+        return out  # type: ignore[return-value]
+
+    def info(self) -> dict:
+        return {
+            **self.table.stats(),
+            "hot_topics": self.hot_topics,
+            "cold_topics": self.cold_topics,
+            "hot_batches": self.hot_batches,
+            "cold_batches": self.cold_batches,
+        }
+
+
+def bench_tiered(n_filters: int = 200_000, batch: int = 8192,
+                 iters: int = 10, depth: int = 8,
+                 hot_mass: float = 0.8) -> dict:
+    """On-chip A/B (run when a TPU is attached; CPU runs are interpret-
+    mode and only prove parity): Zipf-routed traffic through the
+    two-tier table vs everything through the HBM kernel.
+
+    ``hot_mass`` = fraction of published topics landing on hot roots.
+    """
+    import time
+
+    import jax.numpy as jnp
+
+    from .match_kernel import nfa_match
+
+    rng = np.random.default_rng(5)
+    n_roots = 200
+    # Zipf filter mass over roots
+    weights = 1.0 / np.arange(1, n_roots + 1)
+    weights /= weights.sum()
+    filters = sorted({
+        f"r{rng.choice(n_roots, p=weights)}/"
+        + "/".join(("+" if rng.random() < 0.3 else f"w{rng.integers(50)}")
+                   for _ in range(rng.integers(1, depth - 2)))
+        + ("/#" if rng.random() < 0.2 else "")
+        for _ in range(n_filters)
+    })
+    # traffic: hot_mass of topics under the top roots
+    counts = {f"r{i}": int(1e6 * weights[i]) for i in range(n_roots)}
+    hot_roots = pick_hot_roots(filters, counts, depth=depth)
+    tiered = build_tiered(filters, hot_roots, depth=depth)
+    tm = TieredMatcher(tiered, depth=depth)
+    hot_n = max(1, len(tiered.hot_roots))
+    topics = []
+    for _ in range(batch):
+        if rng.random() < hot_mass:
+            root = f"r{sorted(tiered.hot_roots)[rng.integers(hot_n)]}"
+        else:
+            root = f"r{rng.integers(n_roots)}"
+        topics.append(root + "/"
+                      + "/".join(f"w{rng.integers(50)}"
+                                 for _ in range(rng.integers(1, depth - 2))))
+
+    out = {"n_filters": len(filters), **tiered.stats()}
+    full = compile_filters(filters, depth=depth)
+    words, lens, is_sys = encode_topics(full, topics, batch=batch)
+    args = (jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+            *[jnp.asarray(a) for a in full.device_arrays()])
+    r = nfa_match(*args, active_slots=8, compact_output=False)
+    np.asarray(r.matches)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = nfa_match(*args, active_slots=8, compact_output=False)
+    np.asarray(r.matches)
+    out["hbm_only_ms"] = round((time.perf_counter() - t0) / iters * 1e3, 2)
+
+    tm.match(topics[:256])   # warm both tiers' compiles
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        tm.match(topics)
+    out["tiered_ms"] = round((time.perf_counter() - t0) / iters * 1e3, 2)
+    out["routing"] = {"hot_topics": tm.hot_topics,
+                      "cold_topics": tm.cold_topics}
+    return out

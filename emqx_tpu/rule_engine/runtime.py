@@ -50,6 +50,19 @@ class EvalContext:
         if head in self.columns:
             val = self.columns[head]
             if head == "payload" and rest:
+                # native fast path (jiffy analog): extract ONE scalar
+                # without materializing the whole document; any shape it
+                # can't represent exactly bails to the memoized decode
+                if not self._decode_tried:
+                    raw = val
+                    if isinstance(raw, str):
+                        raw = raw.encode("utf-8", "surrogatepass")
+                    if isinstance(raw, bytes):
+                        from ..native import fastjson
+
+                        found, fv = fastjson.get_path(raw, rest)
+                        if found:
+                            return fv
                 val = self.decoded_payload()
         elif self._decode_tried and isinstance(self._decoded, dict) and head in self._decoded:
             val = self._decoded[head]  # aliases bound by FOREACH etc.

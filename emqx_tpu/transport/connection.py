@@ -22,7 +22,20 @@ from ..mqtt import packet as P
 
 log = logging.getLogger(__name__)
 
-__all__ = ["Connection", "ConnInfo", "TcpStream"]
+__all__ = ["Connection", "ConnInfo", "TcpStream", "set_nodelay"]
+
+
+def set_nodelay(sock) -> None:
+    """TCP_NODELAY on an accepted/dialed socket (shared by the stream,
+    protocol, and client paths)."""
+    if sock is None:
+        return
+    try:
+        import socket as _socket
+
+        sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+    except OSError:
+        pass
 
 
 @dataclass

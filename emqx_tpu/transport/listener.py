@@ -15,7 +15,7 @@ import ssl as _ssl
 from typing import Awaitable, Callable, Dict, List, Optional
 
 from ..broker.limiter import TokenBucket
-from .connection import ConnInfo, TcpStream
+from .connection import ConnInfo, TcpStream, set_nodelay
 from .ws import WsError, WsStream, server_handshake
 
 log = logging.getLogger(__name__)
@@ -24,6 +24,13 @@ __all__ = ["Listener", "Listeners"]
 
 # handler(stream, conninfo) -> runs the connection to completion
 Handler = Callable[[object, ConnInfo], Awaitable[None]]
+
+
+class _ShedProtocol(asyncio.Protocol):
+    """Accept-and-close: the overload answer when the cap/rate trips."""
+
+    def connection_made(self, transport) -> None:
+        transport.close()
 
 
 class Listener:
@@ -37,6 +44,8 @@ class Listener:
         max_connections: int = 1 << 20,
         max_conn_rate: float = 0.0,   # conns/s, 0 = unlimited
         ws_path: str = "/mqtt",
+        reuse_port: bool = False,
+        proto_factory: Optional[Callable[[ConnInfo], object]] = None,
     ) -> None:
         self.name = name
         self.kind = kind
@@ -46,6 +55,15 @@ class Listener:
         self.ssl_context = ssl_context
         self.max_connections = max_connections
         self.ws_path = ws_path
+        # SO_REUSEPORT: several broker PROCESSES bind the same port and
+        # the kernel load-balances accepted connections across them —
+        # the esockd-multi-acceptor analog for scaling the connection
+        # plane past one core (peers cluster as usual; routes replicate)
+        self.reuse_port = reuse_port
+        # protocol-mode datapath (transport/proto_conn.py): zero
+        # per-connection tasks; used for plain TCP when the node
+        # provides a factory
+        self.proto_factory = proto_factory
         self._conn_rate = TokenBucket(max_conn_rate)
         self._server: Optional[asyncio.AbstractServer] = None
         self.current_connections = 0
@@ -56,9 +74,18 @@ class Listener:
         return self._server is not None
 
     async def start(self) -> None:
-        self._server = await asyncio.start_server(
-            self._accept, self.host, self.port, ssl=self.ssl_context
-        )
+        if self.proto_factory is not None and self.kind == "tcp" \
+                and self.ssl_context is None:
+            loop = asyncio.get_running_loop()
+            self._server = await loop.create_server(
+                self._make_protocol, self.host, self.port,
+                reuse_port=self.reuse_port or None,
+            )
+        else:
+            self._server = await asyncio.start_server(
+                self._accept, self.host, self.port, ssl=self.ssl_context,
+                reuse_port=self.reuse_port or None,
+            )
         # resolve the real port for bind=":0" (tests)
         socks = self._server.sockets or []
         if socks and self.port == 0:
@@ -80,6 +107,34 @@ class Listener:
                 )
             self._server = None
 
+    def _make_protocol(self):
+        """Protocol-mode accept with esockd-style shedding BEFORE any
+        protocol work: past the cap/rate, not even a Channel is built —
+        a trivial closing protocol answers the flood."""
+        ok, _ = self._conn_rate.consume(1.0)
+        if not ok or self.current_connections >= self.max_connections:
+            self.shed_count += 1
+            return _ShedProtocol()
+        info = ConnInfo(listener=f"{self.kind}:{self.name}",
+                        tls=self.ssl_context is not None)
+        proto = self.proto_factory(info)
+        orig_made = proto.connection_made
+        orig_lost = proto.connection_lost
+
+        def made(transport):
+            self.current_connections += 1
+            proto._listener_counted = True
+            orig_made(transport)
+
+        def lost(exc):
+            if getattr(proto, "_listener_counted", False):
+                self.current_connections -= 1
+            orig_lost(exc)
+
+        proto.connection_made = made
+        proto.connection_lost = lost
+        return proto
+
     async def _accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
@@ -90,6 +145,7 @@ class Listener:
             writer.close()
             return
         self.current_connections += 1
+        set_nodelay(writer.get_extra_info("socket"))
         info = ConnInfo(
             peername=writer.get_extra_info("peername"),
             sockname=writer.get_extra_info("sockname"),

@@ -1,0 +1,206 @@
+"""OCSP stapling cache for the TLS listener.
+
+Behavioral reference: ``emqx_ocsp_cache.erl`` [U] (SURVEY.md §2.1 TLS
+utils): the broker — not each client — asks the CA's OCSP responder
+whether its OWN server certificate is still good, caches the DER
+response, refreshes it ahead of expiry, and staples it into TLS
+handshakes so clients get revocation proof without contacting the CA.
+
+Scope note, recorded honestly: CPython's ``ssl`` module exposes no
+server-side ``SSL_set_tlsext_status`` equivalent, so the final staple
+hand-off is gated on runtime support (the same posture as TLS-PSK,
+``node._build_ssl_context``).  Everything the reference's cache does is
+here and tested against a mocked responder: request construction
+(RFC 6960 via ``cryptography.x509.ocsp``), POST to the responder URL
+from the certificate's AIA extension (or an override), response
+validation (status, this/next update window), TTL'd caching with
+stale-while-refresh semantics, periodic refresh, and fail-open vs
+fail-closed reporting for the health surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+__all__ = ["OcspCache", "OcspError"]
+
+
+class OcspError(Exception):
+    pass
+
+
+class OcspCache:
+    """Fetch + cache the stapled OCSP response for one server cert.
+
+    ``fetch(url, der_request) -> der_response`` is injectable (tests use
+    a mocked responder); the default POSTs over the in-repo HTTP client.
+    """
+
+    def __init__(
+        self,
+        cert_pem: bytes,
+        issuer_pem: bytes,
+        responder_url: Optional[str] = None,
+        refresh_interval_s: float = 3600.0,
+        refresh_http_timeout_s: float = 10.0,
+        fetch: Optional[Callable] = None,
+    ) -> None:
+        from cryptography import x509
+
+        self.cert = x509.load_pem_x509_certificate(cert_pem)
+        self.issuer = x509.load_pem_x509_certificate(issuer_pem)
+        self.responder_url = responder_url or self._aia_url()
+        self.refresh_interval_s = refresh_interval_s
+        self.refresh_http_timeout_s = refresh_http_timeout_s
+        self._fetch = fetch or self._default_fetch
+        self._response_der: Optional[bytes] = None
+        self._status: Optional[str] = None
+        self._next_update: Optional[float] = None
+        self._fetched_at: Optional[float] = None
+        self._task: Optional[asyncio.Task] = None
+        self.refreshes = 0
+        self.failures = 0
+
+    # -- request construction ------------------------------------------
+
+    def _aia_url(self) -> Optional[str]:
+        from cryptography import x509
+        from cryptography.x509.oid import (
+            AuthorityInformationAccessOID, ExtensionOID,
+        )
+
+        try:
+            aia = self.cert.extensions.get_extension_for_oid(
+                ExtensionOID.AUTHORITY_INFORMATION_ACCESS).value
+        except x509.ExtensionNotFound:
+            return None
+        for desc in aia:
+            if desc.access_method == AuthorityInformationAccessOID.OCSP:
+                return desc.access_location.value
+        return None
+
+    def build_request(self) -> bytes:
+        """DER OCSP request for (cert, issuer) — RFC 6960 §4.1."""
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.x509 import ocsp
+
+        builder = ocsp.OCSPRequestBuilder().add_certificate(
+            self.cert, self.issuer, hashes.SHA256())
+        from cryptography.hazmat.primitives.serialization import Encoding
+
+        return builder.build().public_bytes(Encoding.DER)
+
+    async def _default_fetch(self, url: str, der: bytes) -> bytes:
+        from ..bridge import httpc
+
+        resp = await httpc.request(
+            "POST", url, body=der,
+            headers={"Content-Type": "application/ocsp-request"},
+            timeout=self.refresh_http_timeout_s,
+        )
+        if resp.status != 200:
+            raise OcspError(f"responder returned HTTP {resp.status}")
+        return resp.body
+
+    # -- refresh -------------------------------------------------------
+
+    async def refresh(self) -> str:
+        """One fetch+validate+install cycle; returns the cert status.
+        On failure the previous response stays served until ITS
+        next_update passes (stale-while-refresh, like the reference's
+        cache keeping the last good staple)."""
+        try:
+            return await self._refresh()
+        except Exception:
+            # single counting point: transport errors, bad responder
+            # status, and validation failures all tally once here
+            self.failures += 1
+            raise
+
+    async def _refresh(self) -> str:
+        if self.responder_url is None:
+            raise OcspError("no responder URL (cert has no AIA OCSP entry)")
+        from cryptography.x509 import ocsp
+
+        der = await self._fetch(self.responder_url, self.build_request())
+        resp = ocsp.load_der_ocsp_response(der)
+        if resp.response_status != ocsp.OCSPResponseStatus.SUCCESSFUL:
+            raise OcspError(f"responder status {resp.response_status}")
+        status = resp.certificate_status
+        now = time.time()
+        nu = resp.next_update_utc
+        this_update = resp.this_update_utc
+        if this_update is not None and this_update.timestamp() > now + 300:
+            raise OcspError("response from the future (clock skew > 5m)")
+        if nu is not None and nu.timestamp() <= now:
+            raise OcspError("responder served an already-expired response")
+        self._response_der = der
+        self._status = status.name.lower()   # good | revoked | unknown
+        self._next_update = nu.timestamp() if nu is not None else None
+        self._fetched_at = now
+        self.refreshes += 1
+        if self._status != "good":
+            log.warning("ocsp: server certificate status is %r", self._status)
+        return self._status
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                await self.refresh()
+            except Exception as e:
+                log.warning("ocsp refresh failed: %s", e)
+            await asyncio.sleep(self._next_sleep())
+
+    # refresh margin before the staple expires; floor against a
+    # responder issuing pathologically short windows
+    EXPIRY_MARGIN_S = 60.0
+    MIN_SLEEP_S = 30.0
+
+    def _next_sleep(self) -> float:
+        """Refresh AHEAD of the response's own expiry: a responder
+        issuing 10-minute windows must not leave the listener unstapled
+        for the rest of a 1-hour interval."""
+        sleep = self.refresh_interval_s
+        if self._next_update is not None:
+            sleep = min(sleep,
+                        self._next_update - time.time()
+                        - self.EXPIRY_MARGIN_S)
+        return max(self.MIN_SLEEP_S, sleep)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    # -- staple surface ------------------------------------------------
+
+    def current(self) -> Optional[bytes]:
+        """The DER response to staple, or None when absent/expired —
+        the TLS accept path calls this per handshake (and, on None,
+        proceeds unstapled: fail-open, clients fall back to their own
+        revocation checking)."""
+        if self._response_der is None:
+            return None
+        if self._next_update is not None and time.time() >= self._next_update:
+            return None   # expired staple is worse than none
+        return self._response_der
+
+    def info(self) -> dict:
+        return {
+            "responder_url": self.responder_url,
+            "status": self._status,
+            "stapled": self.current() is not None,
+            "fetched_at": self._fetched_at,
+            "next_update": self._next_update,
+            "refreshes": self.refreshes,
+            "failures": self.failures,
+        }

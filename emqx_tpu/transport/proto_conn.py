@@ -1,0 +1,330 @@
+"""Protocol-mode TCP connection: the low-overhead datapath.
+
+Behavioral reference: ``emqx_connection.erl`` [U] — same duties as
+:class:`~emqx_tpu.transport.connection.Connection` (SURVEY.md §2.1/§3.2:
+recv loop, incremental parse, rate limiting, keepalive/retry timers,
+serialized writes), rebuilt on ``asyncio.Protocol`` instead of streams.
+
+Why this exists: the stream path costs ~6 event-loop callback hops per
+message (reader-task wakeup, StreamReader buffering, out-queue put,
+writer-task wakeup, drain) — measured as the dominant cost of BASELINE
+config 1 on one core.  A Protocol collapses the whole per-packet path
+into ONE synchronous call chain: ``data_received → Parser.feed →
+Channel.handle_in → transport.write``.  No per-connection tasks at all;
+timers ride ``loop.call_later``.
+
+The async advisory stage (exhook / cluster takeover / TPU prefetch /
+network authn) can't run synchronously — when a node installs
+``intercept``, packets route through an ordered queue consumed by one
+worker task, which is exactly the stream path's cost model.  Plain
+nodes (no interceptors) stay on the zero-task fast path; the decision
+is per-connection at accept time.
+
+Backpressure: ``pause_writing`` buffers outgoing packets and pauses
+reading (a slow consumer throttles its own socket, the activate-N
+discipline); byte/message token buckets pause reading on overdraft and
+resume via ``call_later``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, List, Optional
+
+from ..broker.channel import Channel
+from ..broker.limiter import LimiterGroup
+from ..mqtt import frame as F
+from ..mqtt import packet as P
+from .connection import ConnInfo, set_nodelay
+
+log = logging.getLogger(__name__)
+
+__all__ = ["MqttProtocol"]
+
+
+class MqttProtocol(asyncio.Protocol):
+    TICK_S = 1.0
+    # intercept-mode queue watermarks (packets): reading pauses past
+    # HIGH and resumes once the worker drains below LOW
+    QUEUE_HIGH_WATER = 256
+    QUEUE_LOW_WATER = 64
+
+    def __init__(
+        self,
+        channel: Channel,
+        conninfo: Optional[ConnInfo] = None,
+        max_packet_size: int = F.MAX_REMAINING_LEN,
+        limiter: Optional[LimiterGroup] = None,
+        on_closed=None,
+        intercept=None,
+    ) -> None:
+        self.channel = channel
+        self.conninfo = conninfo or ConnInfo()
+        self.parser = F.Parser(max_packet_size=max_packet_size)
+        self.limiter = limiter
+        self.on_closed = on_closed
+        self.intercept = intercept
+        self.transport: Optional[asyncio.Transport] = None
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.pkts_in = 0
+        self.pkts_out = 0
+        self._closed = False
+        self._close_reason = "closed"
+        self._paused_write = False
+        self._pending_out: List[bytes] = []
+        self._tick_handle = None
+        self._msg_bucket = None
+        self._byte_bucket = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._worker: Optional[asyncio.Task] = None
+        self._paused_read_queue = False
+
+    # -- asyncio.Protocol ----------------------------------------------
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        set_nodelay(transport.get_extra_info("socket"))
+        self.conninfo.peername = transport.get_extra_info("peername")
+        self.conninfo.sockname = transport.get_extra_info("sockname")
+        # the channel's auth/flapping context sees the real peer address
+        self.channel.conninfo["peername"] = self.conninfo.peername
+        if self.limiter is not None:
+            self._msg_bucket, self._byte_bucket = \
+                self.limiter.conn_buckets(str(id(self)))
+        if self.intercept is not None:
+            # advisory stage present: packets take the ordered-queue
+            # path so async round trips can't reorder handling
+            self._queue = asyncio.Queue()
+            self._worker = asyncio.ensure_future(self._worker_loop())
+        # jitter the first tick: connections accepted in one storm
+        # would otherwise fire thousands of keepalive timers in the
+        # same millisecond every second — a recurring latency spike
+        self._tick_handle = asyncio.get_running_loop().call_later(
+            self.TICK_S * (0.5 + (id(self) % 1024) / 1024.0), self._tick)
+
+    def data_received(self, data: bytes) -> None:
+        self.bytes_in += len(data)
+        if self._byte_bucket is not None and not self._byte_bucket.unlimited:
+            ok, wait = self._byte_bucket.consume(len(data))
+            if not ok:
+                self._pause_read_for(wait)
+        try:
+            pkts = self.parser.feed(data)
+        except F.FrameError as e:
+            self._frame_error(e)
+            return
+        if self._queue is not None:
+            for pkt in pkts:
+                self._queue.put_nowait(pkt)
+            # backpressure the SOCKET, not just the worker: while the
+            # async advisory stage is slow, unread bytes must park in
+            # the kernel buffer (and the sender's window), not in an
+            # unbounded parsed-packet queue — the stream path had this
+            # implicitly by awaiting each packet's handling
+            if self._queue.qsize() >= self.QUEUE_HIGH_WATER \
+                    and not self._paused_read_queue:
+                self._paused_read_queue = True
+                try:
+                    self.transport.pause_reading()
+                except RuntimeError:
+                    self._paused_read_queue = False
+            return
+        for pkt in pkts:
+            self.pkts_in += 1
+            if (
+                self._msg_bucket is not None
+                and not self._msg_bucket.unlimited
+                and pkt.type == P.PUBLISH
+            ):
+                ok, wait = self._msg_bucket.consume(1.0)
+                if not ok:
+                    self._pause_read_for(wait)
+            self._run_actions(self.channel.handle_in(pkt))
+            if self._closed:
+                return
+
+    def connection_lost(self, exc) -> None:
+        if self._tick_handle is not None:
+            self._tick_handle.cancel()
+        if self._worker is not None:
+            self._worker.cancel()
+        if not self._closed:
+            self._closed = True
+            self._close_reason = "peer closed"
+        self.channel.handle_close(self._close_reason)
+        if self.on_closed is not None:
+            self.on_closed(self)
+        if self.limiter is not None:
+            self.limiter.drop_conn(str(id(self)))
+
+    def pause_writing(self) -> None:
+        self._paused_write = True
+        # a consumer that can't drain its socket must not keep feeding
+        # the broker either
+        if self.transport is not None:
+            try:
+                self.transport.pause_reading()
+            except RuntimeError:
+                pass
+
+    def resume_writing(self) -> None:
+        self._paused_write = False
+        if self._pending_out:
+            pending, self._pending_out = self._pending_out, []
+            for data in pending:
+                self.transport.write(data)
+        if self.transport is not None and not self._closed \
+                and not self._paused_read_queue:
+            try:
+                self.transport.resume_reading()
+            except RuntimeError:
+                pass
+
+    # -- async advisory path -------------------------------------------
+
+    async def _worker_loop(self) -> None:
+        while not self._closed:
+            pkt = await self._queue.get()
+            if self._paused_read_queue \
+                    and self._queue.qsize() <= self.QUEUE_LOW_WATER:
+                self._paused_read_queue = False
+                if not self._closed and not self._paused_write:
+                    try:
+                        self.transport.resume_reading()
+                    except RuntimeError:
+                        pass
+            self.pkts_in += 1
+            try:
+                if (
+                    self._msg_bucket is not None
+                    and not self._msg_bucket.unlimited
+                    and pkt.type == P.PUBLISH
+                ):
+                    ok, wait = self._msg_bucket.consume(1.0)
+                    if not ok:
+                        await asyncio.sleep(wait)
+                if self.intercept is not None and pkt.type in (
+                    P.CONNECT, P.PUBLISH, P.SUBSCRIBE, P.UNSUBSCRIBE
+                ):
+                    actions = await self.intercept(self.channel, pkt)
+                    if self._closed or self.channel.state == "disconnected":
+                        return
+                    if actions is not None:
+                        self.channel.last_rx = time.time()
+                        self._run_actions(actions)
+                        continue
+                self._run_actions(self.channel.handle_in(pkt))
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                log.exception("protocol worker crashed (%s)",
+                              self.conninfo.peername)
+                self._do_close("internal error")
+                return
+
+    # -- broker-facing surface (same contract as Connection) -----------
+
+    def deliver(self, pubs: List[Any]) -> None:
+        self._run_actions(self.channel.handle_deliver(pubs))
+
+    def kick(self, reason: str = "kicked") -> None:
+        self._run_actions(self.channel.handle_takeover()
+                          if reason == "takeover" else [("close", reason)])
+
+    def _run_actions(self, actions: List[Any]) -> None:
+        for act, arg in actions:
+            if act == "send":
+                self._send_pkt(arg)
+            elif act == "close":
+                self._do_close(str(arg))
+            elif act == "takeover":
+                old_conn = getattr(arg, "conn", None)
+                acts = arg.handle_takeover()
+                if old_conn is not None and old_conn is not self:
+                    old_conn._run_actions(acts)
+
+    def _send_pkt(self, pkt: Any) -> None:
+        if self._closed or self.transport is None:
+            return
+        try:
+            data = F.serialize(pkt, ver=self.channel.proto_ver)
+        except Exception:
+            log.exception("serialize failed (%s)", self.conninfo.peername)
+            return
+        self.bytes_out += len(data)
+        self.pkts_out += 1
+        if self._paused_write:
+            self._pending_out.append(data)
+        else:
+            self.transport.write(data)
+
+    def _do_close(self, reason: str) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._close_reason = reason
+        if self.transport is not None:
+            # flush the goodbye even under write pressure —
+            # transport.write() only buffers while paused, and close()
+            # tears down after the send buffer drains; dropping it
+            # would turn a takeover DISCONNECT into a bare TCP reset
+            for data in self._pending_out:
+                self.transport.write(data)
+            self._pending_out.clear()
+            self.transport.close()
+
+    def _frame_error(self, e: F.FrameError) -> None:
+        if self.channel.proto_ver == 5 and self.channel.state == "connected":
+            self._send_pkt(P.Disconnect(reason_code=e.reason_code))
+        self._do_close(f"frame error: {e}")
+
+    def _pause_read_for(self, wait: float) -> None:
+        if self.transport is None or self._closed:
+            return
+        try:
+            self.transport.pause_reading()
+        except RuntimeError:
+            return
+
+        def _resume():
+            # a limiter resume must not undo queue/write backpressure —
+            # those resume themselves when their own condition clears
+            if self.transport is not None and not self._closed \
+                    and not self._paused_write \
+                    and not self._paused_read_queue:
+                try:
+                    self.transport.resume_reading()
+                except RuntimeError:
+                    pass
+
+        asyncio.get_running_loop().call_later(max(wait, 0.001), _resume)
+
+    def _tick(self) -> None:
+        if self._closed:
+            return
+        try:
+            self._run_actions(self.channel.check_keepalive())
+            self._run_actions(self.channel.retry_deliveries())
+        except Exception:
+            log.exception("tick failed (%s)", self.conninfo.peername)
+        if not self._closed:
+            self._tick_handle = asyncio.get_running_loop().call_later(
+                self.TICK_S, self._tick)
+
+    def info(self) -> dict:
+        ch = self.channel
+        return {
+            "clientid": ch.clientid,
+            "peername": self.conninfo.peername,
+            "listener": self.conninfo.listener,
+            "proto_ver": ch.proto_ver,
+            "connected_at": self.conninfo.connected_at,
+            "keepalive": ch.keepalive,
+            "recv_oct": self.bytes_in,
+            "send_oct": self.bytes_out,
+            "recv_pkt": self.pkts_in,
+            "send_pkt": self.pkts_out,
+        }

@@ -16,6 +16,11 @@ echo "== pallas small-table A/B (50k filters, VMEM-resident) =="
 timeout 900 python -m emqx_tpu.ops.pallas_match > "$OUT/pallas_ab.txt" 2>&1
 tail -2 "$OUT/pallas_ab.txt"
 
+echo "== two-tier hot/cold A/B (200k filters, Zipf traffic) =="
+timeout 1200 python -c "from emqx_tpu.ops.tiered import bench_tiered; print(bench_tiered())" \
+  > "$OUT/tiered_ab.txt" 2>&1
+tail -2 "$OUT/tiered_ab.txt"
+
 echo "== kernel ablate (200k filters) =="
 timeout 600 python scripts/kernel_scan_ablate.py > "$OUT/ablate.txt" 2>&1
 tail -5 "$OUT/ablate.txt"

@@ -545,3 +545,54 @@ def test_replica_promotion_survives_full_restart(tmp_path):
                 pass
 
     run(main())
+
+
+def test_reuseport_shared_port_across_cluster_nodes():
+    """SO_REUSEPORT connection-plane scale-out (VERDICT r4 item 3): two
+    clustered broker nodes bind the SAME MQTT port; the kernel spreads
+    accepted connections across them and cross-node routing makes
+    placement transparent to clients."""
+
+    async def main():
+        extra = 'listeners.tcp.default.reuse_port = true\n'
+        n1 = await start_cluster_node("n1@test", extra=extra)
+        port = mqtt_port(n1)
+        n2 = await start_cluster_node(
+            "n2@test", seeds=cluster_addr(n1),
+            extra=extra + f'listeners.tcp.default.bind = "127.0.0.1:{port}"\n')
+        try:
+            assert await peered(n1, n2)
+            assert mqtt_port(n2) == port
+            # enough clients that the kernel hash lands on both sockets
+            clients = []
+            for i in range(24):
+                c = Client(clientid=f"rp{i}", port=port)
+                await c.connect()
+                clients.append(c)
+            placed1 = len(n1.connections)
+            placed2 = len(n2.connections)
+            assert placed1 + placed2 == 24
+            assert placed1 > 0 and placed2 > 0, (
+                f"kernel placed all connections on one node "
+                f"({placed1}/{placed2}); reuse_port not balancing")
+            # pub/sub across whatever placement happened: wait until the
+            # NON-owning node learns the route toward rp0's actual home
+            owner = "n1@test" if "rp0" in n1.connections else "n2@test"
+            other = n2 if owner == "n1@test" else n1
+            await clients[0].subscribe("rp/t", qos=1)
+            assert await settle(
+                lambda: other.broker.router.has_route("rp/t", owner))
+            # publish from every other client: all must arrive
+            for i in range(1, 24):
+                await clients[i].publish("rp/t", f"m{i}".encode(), qos=1)
+            got = set()
+            for _ in range(23):
+                got.add((await clients[0].recv(timeout=5)).payload)
+            assert got == {f"m{i}".encode() for i in range(1, 24)}
+            for c in clients:
+                await c.disconnect()
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    run(main())

@@ -1,0 +1,170 @@
+"""Native single-field JSON extractor: exact parity with json.loads on
+everything it claims to handle, and bail-to-fallback on everything else
+(the jiffy-analog, SURVEY.md §2.4)."""
+
+import json
+
+import pytest
+
+from emqx_tpu.native import fastjson
+
+pytestmark = pytest.mark.skipif(
+    not fastjson.available(), reason="native toolchain unavailable")
+
+
+def oracle(doc: bytes, path):
+    """What the fallback would produce, or BAIL-equivalent None info."""
+    try:
+        val = json.loads(doc)
+    except ValueError:
+        return None
+    for p in path:
+        if not isinstance(val, dict) or p not in val:
+            return None
+        val = val[p]
+    return val
+
+
+CASES = [
+    (b'{"a": 1}', ("a",), True, 1),
+    (b'{"a": -17}', ("a",), True, -17),
+    (b'{"a": 1.5e3}', ("a",), True, 1500.0),
+    (b'{"a": "x y z"}', ("a",), True, "x y z"),
+    (b'{"a": true, "b": false, "c": null}', ("b",), True, False),
+    (b'{"a": true, "b": false, "c": null}', ("c",), True, None),
+    (b'{"a": {"b": {"c": 42}}}', ("a", "b", "c"), True, 42),
+    (b'  {  "a" :\t{"b": 7}\n}  ', ("a", "b"), True, 7),
+    # skipping siblings of every type
+    (b'{"x": [1, {"y": "]"}, "}"], "a": {"n": [""]}, "t": 9}',
+     ("t",), True, 9),
+    # duplicate keys: json.loads keeps the LAST one
+    (b'{"a": 1, "a": 2}', ("a",), True, 2),
+    (b'{"a": {"k": 1}, "a": {"k": 9}}', ("a", "k"), True, 9),
+    # unicode (no escapes) round-trips
+    ('{"ключ": "значение"}'.encode(), ("ключ",), True, "значение"),
+    # bails: escaped string value
+    (b'{"a": "x\\ny"}', ("a",), False, None),
+    # bails: escaped key anywhere in the object
+    (b'{"\\u0061": 1}', ("a",), False, None),
+    # bails: result is a container
+    (b'{"a": {"b": 1}}', ("a",), False, None),
+    (b'{"a": [1, 2]}', ("a",), False, None),
+    # bails: int beyond long long
+    (b'{"a": 99999999999999999999999999}', ("a",), False, None),
+    # bails: missing key / wrong shape / malformed
+    (b'{"a": 1}', ("zz",), False, None),
+    (b'{"a": "str"}', ("a", "deeper"), False, None),
+    (b'[1, 2, 3]', ("a",), False, None),
+    (b'not json at all', ("a",), False, None),
+    (b'{"a": ', ("a",), False, None),
+    # strictness: everything json.loads rejects must BAIL even when the
+    # requested key parsed fine (the whole document is invalid)
+    (b'{"a": 25}garbage', ("a",), False, None),
+    (b'{"a": 25,}', ("a",), False, None),
+    (b'{"a": 025}', ("a",), False, None),
+    (b'{"a": +5}', ("a",), False, None),
+    (b'{"a": .5}', ("a",), False, None),
+    (b'{"a": 5.}', ("a",), False, None),
+    (b'{"a": 1, "b": tru}', ("a",), False, None),
+    (b'{"a": 1, "b": "unterminated}', ("a",), False, None),
+    (b'{"a": 1, "b": "ctrl\nchar"}', ("a",), False, None),
+    (b'{"a": 1, "b": "bad \\x esc"}', ("a",), False, None),
+    (b'{"a": 1, "b": "\xff"}', ("a",), False, None),     # invalid utf-8
+    (b'{"a": 1, "b": [1, 2,]}', ("a",), False, None),
+    (b'{"a": 1 "b": 2}', ("a",), False, None),
+    (b'{"a": 1, 5: 2}', ("a",), False, None),
+    (b'{"a": NaN, "b": 2}', ("b",), False, None),  # loads accepts; we bail
+]
+
+
+@pytest.mark.parametrize("doc,path,want_found,want", CASES)
+def test_cases(doc, path, want_found, want):
+    found, val = fastjson.get_path(doc, path)
+    assert found == want_found, (doc, path, found, val)
+    if want_found:
+        assert val == want and type(val) is type(want)
+        assert val == oracle(doc, path)
+
+
+def test_randomized_parity():
+    """Fuzz parity: whenever the native path claims found, the value
+    must equal the json.loads walk byte-for-byte."""
+    import random
+
+    rng = random.Random(7)
+    scalars = [1, -5, 0, 2.5, -0.125, True, False, None, "s", "longer str",
+               "unié", 10**12]
+
+    def gen(depth=0):
+        r = rng.random()
+        if depth >= 3 or r < 0.5:
+            return rng.choice(scalars)
+        if r < 0.8:
+            return {f"k{rng.randrange(6)}": gen(depth + 1)
+                    for _ in range(rng.randrange(1, 5))}
+        return [gen(depth + 1) for _ in range(rng.randrange(3))]
+
+    checked_found = 0
+    for _ in range(400):
+        doc_obj = {f"k{i}": gen() for i in range(rng.randrange(1, 6))}
+        doc = json.dumps(doc_obj).encode()
+        path = tuple(f"k{rng.randrange(6)}"
+                     for _ in range(rng.randrange(1, 4)))
+        found, val = fastjson.get_path(doc, path)
+        if found:
+            checked_found += 1
+            want = oracle(doc, path)
+            assert val == want and type(val) is type(want), (doc, path)
+    assert checked_found > 20   # the fast path actually fires
+
+
+def test_mutation_fuzz_never_diverges():
+    """Corrupt valid documents byte-by-byte: wherever the native path
+    still claims found, json.loads must agree (parse AND value)."""
+    import random
+
+    rng = random.Random(11)
+    base = json.dumps({"temp": 25, "tag": "ok", "m": {"x": 1.5, "y": None},
+                       "arr": [1, "two", {"z": True}]}).encode()
+    paths = [("temp",), ("tag",), ("m", "x"), ("m", "y"), ("nope",)]
+    for _ in range(3000):
+        doc = bytearray(base)
+        for _ in range(rng.randrange(1, 3)):
+            doc[rng.randrange(len(doc))] = rng.randrange(256)
+        doc = bytes(doc)
+        for path in paths:
+            found, val = fastjson.get_path(doc, path)
+            if found:
+                want = oracle(doc, path)  # None if loads rejects the doc
+                assert val == want and type(val) is type(want), (doc, path)
+
+
+def test_rule_engine_uses_fast_path_with_identical_results():
+    """End-to-end: rules over JSON payloads produce identical outputs
+    with the native extractor available (it is, in this env) — and the
+    memoized-decode fallback still serves multi-field/odd shapes."""
+    from emqx_tpu.broker.broker import Broker
+    from emqx_tpu.broker.message import make_message
+    from emqx_tpu.rule_engine.engine import RuleEngine
+
+    broker = Broker(node="n@test")
+    engine = RuleEngine(broker)
+    out = []
+    engine.create_rule(
+        "r1", 'SELECT payload.temp as t, payload.meta.site as s, clientid '
+              'FROM "sens/+" WHERE payload.temp > 20',
+        actions=[lambda o, c: out.append(o)])
+    broker.publish(make_message(
+        "c1", "sens/a",
+        json.dumps({"temp": 25, "meta": {"site": "x"}}).encode()))
+    broker.publish(make_message(
+        "c1", "sens/b",
+        json.dumps({"temp": 5, "meta": {"site": "y"}}).encode()))
+    # escaped content forces the fallback mid-stream: same answers
+    broker.publish(make_message(
+        "c1", "sens/c",
+        json.dumps({"temp": 30, "meta": {"site": "a\"b"}}).encode()))
+    assert out == [
+        {"t": 25, "s": "x", "clientid": "c1"},
+        {"t": 30, "s": 'a"b', "clientid": "c1"},
+    ]
