@@ -195,6 +195,13 @@ SCHEMA: Dict[str, Field] = {
     "listeners.ssl.default.sni": Field("", str),
     # OCSP stapling cache (emqx_ocsp_cache analog); responder_url
     # overrides the certificate's AIA entry
+    # MQTT-over-QUIC listener (quicer analog; in-repo RFC 9000/9001
+    # stack).  Reuses the ssl listener's cert pair when its own are
+    # blank.
+    "listeners.quic.default.enable": Field(False, _bool),
+    "listeners.quic.default.bind": Field("0.0.0.0:14567", str),
+    "listeners.quic.default.certfile": Field("", str),
+    "listeners.quic.default.keyfile": Field("", str),
     "listeners.ssl.default.ocsp.enable": Field(False, _bool),
     "listeners.ssl.default.ocsp.responder_url": Field("", str),
     "listeners.ssl.default.ocsp.refresh_interval": Field(3600.0, duration),

@@ -45,7 +45,9 @@ def available() -> bool:
 
 def get_path(payload: bytes, path: Sequence[str]) -> Tuple[bool, Any]:
     lib = _load()
-    if lib is None or not path:
+    if lib is None or not path or any(p == "" for p in path):
+        # empty segments would collapse in the \x1f join and skip both
+        # the lookup and the trailing-garbage check — fall back
         return False, None
     try:
         p = "\x1f".join(path).encode("utf-8")

@@ -163,6 +163,8 @@ class BrokerNode:
 
         self.exhook = None  # built lazily in start() (needs a loop + grpc)
         self.ocsp_cache = None  # OCSP stapling cache (ssl listener)
+        self.quic = None        # QUIC endpoint (quic listener)
+        self.quic_port = 0
         self.cluster = None  # built lazily in start() (needs a loop)
         self.match_service = None  # in-process TPU matcher (start())
         self.mgmt = None
@@ -598,9 +600,63 @@ class BrokerNode:
             )
             await self.telemetry.start()
         self._start_ocsp()
+        await self._start_quic()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(asyncio.ensure_future(self._housekeeping()))
+
+    async def _start_quic(self) -> None:
+        """MQTT-over-QUIC listener (quicer analog): the in-repo
+        RFC 9000/9001 stack feeding stream 0 into handle_stream."""
+        cfg = self.config
+        if not cfg.get("listeners.quic.default.enable"):
+            return
+        cert = (cfg.get("listeners.quic.default.certfile")
+                or cfg.get("listeners.ssl.default.certfile") or "").strip()
+        key = (cfg.get("listeners.quic.default.keyfile")
+               or cfg.get("listeners.ssl.default.keyfile") or "").strip()
+        if not cert or not key:
+            log.warning("quic listener enabled without a cert pair")
+            return
+        try:
+            with open(cert, "rb") as f:
+                cert_pem = f.read()
+            with open(key, "rb") as f:
+                key_pem = f.read()
+            from .transport.connection import ConnInfo
+            from .transport.quic import QuicEndpoint
+
+            bind = cfg.get("listeners.quic.default.bind")
+            host, _, port = bind.rpartition(":")
+            loop = asyncio.get_running_loop()
+
+            class _Proto(asyncio.DatagramProtocol):
+                def __init__(p) -> None:  # noqa: N805
+                    pass
+
+                def connection_made(p, transport) -> None:  # noqa: N805
+                    self._quic_transport = transport
+
+                def datagram_received(p, data, addr) -> None:  # noqa: N805
+                    if self.quic is not None:
+                        self.quic.datagram_received(data, addr)
+
+            self._quic_transport, _ = await loop.create_datagram_endpoint(
+                _Proto, local_addr=(host or "0.0.0.0", int(port)))
+            self.quic_port = \
+                self._quic_transport.get_extra_info("sockname")[1]
+
+            async def on_connection(stream, info):
+                await self.handle_stream(stream, ConnInfo(
+                    peername=info.get("peername"),
+                    listener="quic:default",
+                ))
+
+            self.quic = QuicEndpoint(
+                self._quic_transport, cert_pem, key_pem, on_connection)
+            log.info("quic listener on udp %s:%d", host, self.quic_port)
+        except Exception:
+            log.exception("quic listener failed to start")
 
     def _start_ocsp(self) -> None:
         """OCSP stapling cache for the TLS listener (emqx_ocsp_cache
@@ -829,6 +885,9 @@ class BrokerNode:
         if self.ocsp_cache is not None:
             self.ocsp_cache.stop()
             self.ocsp_cache = None
+        if self.quic is not None:
+            self.quic.close()
+            self.quic = None
         await self.bridges.stop_all()
         if self.match_service is not None:
             await self.match_service.stop()
@@ -877,6 +936,8 @@ class BrokerNode:
                     self.retainer.clean_expired()
                 self.banned.clean_expired()
                 self._expire_sessions()
+                if self.quic is not None:
+                    self.quic.sweep()
                 if self.persistence is not None:
                     sync_iv = self.config.get(
                         "durable_storage.sync_interval"
@@ -909,7 +970,12 @@ class BrokerNode:
             "version": __version__,
             "uptime": time.time() - self.started_at,
             "connections": len(self.connections),
-            "listeners": [l.info() for l in self.listeners.all()],
+            "listeners": [l.info() for l in self.listeners.all()] + ([{
+                "id": "quic:default", "type": "quic",
+                "bind": f"udp:{self.quic_port}", "running": True,
+                "current_connections": len(self.quic.streams),
+                "handshakes": self.quic.handshakes,
+            }] if self.quic is not None else []),
             "gateways": (self.gateways.list()
                          if self.gateways is not None else []),
             "bridges": len(self.bridges.list()),

@@ -300,12 +300,18 @@ def bench_tiered(n_filters: int = 200_000, batch: int = 8192,
     counts = {f"r{i}": int(1e6 * weights[i]) for i in range(n_roots)}
     hot_roots = pick_hot_roots(filters, counts, depth=depth)
     tiered = build_tiered(filters, hot_roots, depth=depth)
-    tm = TieredMatcher(tiered, depth=depth)
-    hot_n = max(1, len(tiered.hot_roots))
+    import jax
+
+    # pallas needs interpret mode off-TPU; the honest A/B number is the
+    # on-chip one (CPU runs only prove plumbing)
+    tm = TieredMatcher(tiered, depth=depth,
+                       interpret=jax.devices()[0].platform == "cpu")
+    hot_list = sorted(tiered.hot_roots)   # entries are full roots ("r7")
+    assert hot_list, "A/B needs a non-empty hot tier; check the workload"
     topics = []
     for _ in range(batch):
         if rng.random() < hot_mass:
-            root = f"r{sorted(tiered.hot_roots)[rng.integers(hot_n)]}"
+            root = hot_list[rng.integers(len(hot_list))]
         else:
             root = f"r{rng.integers(n_roots)}"
         topics.append(root + "/"

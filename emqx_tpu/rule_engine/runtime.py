@@ -31,6 +31,7 @@ class EvalContext:
         self.columns = columns
         self._decoded: Optional[Any] = None
         self._decode_tried = False
+        self._fast_hits = 0   # payload.x answered natively (no decode)
 
     def decoded_payload(self) -> Any:
         if not self._decode_tried:
@@ -62,10 +63,16 @@ class EvalContext:
 
                         found, fv = fastjson.get_path(raw, rest)
                         if found:
+                            self._fast_hits += 1
                             return fv
                 val = self.decoded_payload()
-        elif self._decode_tried and isinstance(self._decoded, dict) and head in self._decoded:
-            val = self._decoded[head]  # aliases bound by FOREACH etc.
+        elif (self._decode_tried or self._fast_hits) \
+                and isinstance(self.decoded_payload(), dict) \
+                and head in self._decoded:
+            # aliases bound by FOREACH etc.  A native fast-path hit
+            # counts as "payload was accessed": decode lazily HERE so
+            # bare-key lookups see exactly the pre-fastjson behavior
+            val = self._decoded[head]
         else:
             return None
         for p in rest:

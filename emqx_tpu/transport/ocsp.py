@@ -131,6 +131,14 @@ class OcspCache:
         resp = ocsp.load_der_ocsp_response(der)
         if resp.response_status != ocsp.OCSPResponseStatus.SUCCESSFUL:
             raise OcspError(f"responder status {resp.response_status}")
+        # OCSP rides plain HTTP: the response itself must prove (a) it
+        # answers for OUR certificate and (b) the ISSUER signed it — a
+        # MITM'd 'good' must not reach the health surface or the staple
+        if resp.serial_number != self.cert.serial_number:
+            raise OcspError(
+                f"response is for serial {resp.serial_number:#x}, "
+                f"not ours ({self.cert.serial_number:#x})")
+        self._verify_signature(resp)
         status = resp.certificate_status
         now = time.time()
         nu = resp.next_update_utc
@@ -147,6 +155,25 @@ class OcspCache:
         if self._status != "good":
             log.warning("ocsp: server certificate status is %r", self._status)
         return self._status
+
+    def _verify_signature(self, resp) -> None:
+        """Responder signature check against the issuer key (delegated
+        responder certificates are out of scope — a response our CA did
+        not sign directly is rejected, fail-closed)."""
+        from cryptography.hazmat.primitives.asymmetric import ec, padding
+
+        pub = self.issuer.public_key()
+        try:
+            if hasattr(pub, "curve"):
+                pub.verify(resp.signature, resp.tbs_response_bytes,
+                           ec.ECDSA(resp.signature_hash_algorithm))
+            else:
+                pub.verify(resp.signature, resp.tbs_response_bytes,
+                           padding.PKCS1v15(),
+                           resp.signature_hash_algorithm)
+        except Exception as e:
+            raise OcspError(
+                f"responder signature not verifiable by the issuer: {e}")
 
     async def _loop(self) -> None:
         while True:

@@ -1,0 +1,33 @@
+"""MQTT-over-QUIC transport (RFC 9000/9001, v1).
+
+Behavioral reference: ``emqx_quic_connection.erl`` + the ``quicer``
+MsQuic NIF [U] (SURVEY.md §2.1 QUIC connection, §2.4).  No QUIC stack
+exists in this environment (no MsQuic, and CPython's ``ssl`` exposes
+neither DTLS nor the TLS-1.3-secrets API QUIC needs), so — the same
+posture as the hand-rolled DTLS/Kafka/MySQL wire layers — the protocol
+is implemented directly:
+
+* :mod:`.crypto`  — packet protection: initial-secret derivation,
+  per-level AEAD (AES-128-GCM) + header protection (AES-ECB mask),
+  validated against the RFC 9001 Appendix A test vectors;
+* :mod:`.tls13`   — the embedded TLS 1.3 handshake (x25519,
+  TLS_AES_128_GCM_SHA256, rsa_pss_rsae_sha256 certificates,
+  quic_transport_parameters extension), both roles;
+* :mod:`.packet`  — long/short headers, varints, packet numbers;
+* :mod:`.frames`  — CRYPTO/ACK/STREAM/HANDSHAKE_DONE/CONNECTION_CLOSE;
+* :mod:`.connection` — sans-IO connection state machines + the
+  :class:`~emqx_tpu.transport.quic.connection.QuicEndpoint` UDP
+  demultiplexer that feeds MQTT bytes from stream 0 into the broker's
+  ordinary channel machinery.
+
+Deliberate scope cuts, recorded: no loss-recovery timers or
+retransmission (flights fit loopback datagrams; a lost flight restarts
+the connection), no connection migration, no 0-RTT, no flow-control
+enforcement beyond generous static limits, single client-initiated
+bidirectional stream (the MQTT byte stream — exactly how the reference
+maps MQTT onto quicer streams).
+"""
+
+from .connection import QuicClient, QuicEndpoint, QuicServerConnection
+
+__all__ = ["QuicClient", "QuicEndpoint", "QuicServerConnection"]

@@ -1,0 +1,471 @@
+"""QUIC v1 sans-IO connections + the UDP endpoint/MQTT bridge.
+
+One client-initiated bidirectional stream (id 0) carries the MQTT byte
+stream — the same mapping the reference runs over quicer streams
+(``emqx_quic_stream.erl`` [U]).  The endpoint hands each accepted
+connection's stream to the node's ordinary ``handle_stream`` via a
+stream adapter, so the full Channel/session machinery is shared with
+TCP/WS/TLS listeners.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import frames as FR
+from .crypto import LevelKeys, initial_keys, traffic_keys
+from .packet import (
+    PKT_1RTT, PKT_HANDSHAKE, PKT_INITIAL, PlainPacket, protect, unprotect,
+)
+from .tls13 import LEVEL_APP, LEVEL_HANDSHAKE, LEVEL_INITIAL, Tls13
+
+log = logging.getLogger(__name__)
+
+__all__ = ["QuicClient", "QuicEndpoint", "QuicServerConnection",
+           "QuicStream"]
+
+_LEVEL_OF_PKT = {PKT_INITIAL: LEVEL_INITIAL, PKT_HANDSHAKE: LEVEL_HANDSHAKE,
+                 PKT_1RTT: LEVEL_APP}
+_PKT_OF_LEVEL = {v: k for k, v in _LEVEL_OF_PKT.items()}
+
+# generous static transport parameters (flow control is not enforced
+# beyond these; see package docstring scope cuts)
+_TP_DEFAULTS = (
+    (0x04, 1 << 24),   # initial_max_data
+    (0x05, 1 << 22),   # initial_max_stream_data_bidi_local
+    (0x06, 1 << 22),   # ..bidi_remote
+    (0x07, 1 << 22),   # ..uni
+    (0x08, 64),        # initial_max_streams_bidi
+    (0x09, 64),        # ..uni
+)
+
+
+def _encode_tp(scid: bytes, odcid: Optional[bytes]) -> bytes:
+    from .packet import encode_varint as ev
+
+    out = bytearray()
+    for pid, val in _TP_DEFAULTS:
+        body = ev(val)
+        out += ev(pid) + ev(len(body)) + body
+    out += ev(0x0F) + ev(len(scid)) + scid          # initial_scid
+    if odcid is not None:
+        out += ev(0x00) + ev(len(odcid)) + odcid    # original_dcid
+    return bytes(out)
+
+
+class _Assembler:
+    """Offset-based byte-stream reassembly (CRYPTO and stream 0)."""
+
+    def __init__(self) -> None:
+        self.pos = 0
+        self.segs: Dict[int, bytes] = {}
+
+    def add(self, offset: int, data: bytes) -> bytes:
+        if data:
+            self.segs[offset] = max(self.segs.get(offset, b""), data,
+                                    key=len)
+        out = bytearray()
+        while True:
+            for off, seg in list(self.segs.items()):
+                if off <= self.pos < off + len(seg) or off == self.pos:
+                    out += seg[self.pos - off:]
+                    self.pos = off + len(seg)
+                    del self.segs[off]
+                    break
+                if off + len(seg) <= self.pos:
+                    del self.segs[off]
+                    break
+            else:
+                break
+        return bytes(out)
+
+
+class _Conn:
+    """Shared machinery for both roles."""
+
+    def __init__(self, role: str, tls: Tls13, scid: bytes,
+                 initial: LevelKeys) -> None:
+        self.role = role
+        self.tls = tls
+        self.scid = scid
+        self.remote_cid = b""
+        self._keys: Dict[str, LevelKeys] = {LEVEL_INITIAL: initial}
+        self._next_pn: Dict[str, int] = {
+            LEVEL_INITIAL: 0, LEVEL_HANDSHAKE: 0, LEVEL_APP: 0}
+        self._largest: Dict[str, int] = {
+            LEVEL_INITIAL: -1, LEVEL_HANDSHAKE: -1, LEVEL_APP: -1}
+        self._recv_pns: Dict[str, List[int]] = {
+            LEVEL_INITIAL: [], LEVEL_HANDSHAKE: [], LEVEL_APP: []}
+        self._ack_due: Dict[str, bool] = {
+            LEVEL_INITIAL: False, LEVEL_HANDSHAKE: False, LEVEL_APP: False}
+        self._crypto_rx = {lv: _Assembler()
+                           for lv in (LEVEL_INITIAL, LEVEL_HANDSHAKE,
+                                      LEVEL_APP)}
+        self._crypto_tx_off: Dict[str, int] = {
+            LEVEL_INITIAL: 0, LEVEL_HANDSHAKE: 0, LEVEL_APP: 0}
+        self.stream_rx = _Assembler()
+        self._stream_tx_off = 0
+        self._stream_in = bytearray()
+        self.stream_fin = False
+        self.handshake_done = False
+        self.closed = False
+        self.close_reason = ""
+        self._out_datagrams: List[bytes] = []
+        self._pending_frames: Dict[str, List[bytes]] = {
+            LEVEL_INITIAL: [], LEVEL_HANDSHAKE: [], LEVEL_APP: []}
+        self.last_seen = time.monotonic()
+
+    # -- key plumbing --------------------------------------------------
+
+    def _maybe_derive_keys(self) -> None:
+        if LEVEL_HANDSHAKE not in self._keys and self.tls.hs_secrets:
+            c, s = self.tls.hs_secrets
+            self._keys[LEVEL_HANDSHAKE] = LevelKeys(
+                client=traffic_keys(c), server=traffic_keys(s))
+        if LEVEL_APP not in self._keys and self.tls.app_secrets:
+            c, s = self.tls.app_secrets
+            self._keys[LEVEL_APP] = LevelKeys(
+                client=traffic_keys(c), server=traffic_keys(s))
+
+    def _send_keys(self, level: str):
+        ks = self._keys.get(level)
+        if ks is None:
+            return None
+        return ks.server if self.role == "server" else ks.client
+
+    def _recv_keys(self, level: str):
+        ks = self._keys.get(level)
+        if ks is None:
+            return None
+        return ks.client if self.role == "server" else ks.server
+
+    # -- receive -------------------------------------------------------
+
+    def receive(self, datagram: bytes) -> None:
+        if self.closed:
+            return
+        self.last_seen = time.monotonic()
+        off = 0
+        while off < len(datagram):
+            pkt, off = unprotect(
+                datagram, off,
+                lambda kind: self._recv_keys(_LEVEL_OF_PKT[kind]),
+                lambda kind: self._largest[_LEVEL_OF_PKT[kind]],
+                local_cid_len=len(self.scid),
+            )
+            if pkt is None:
+                continue
+            self._on_packet(pkt)
+        self._service()
+
+    def _on_packet(self, pkt: PlainPacket) -> None:
+        level = _LEVEL_OF_PKT[pkt.kind]
+        self._largest[level] = max(self._largest[level], pkt.pn)
+        self._recv_pns[level].append(pkt.pn)
+        self._recv_pns[level] = self._recv_pns[level][-64:]
+        if pkt.kind != PKT_1RTT and pkt.scid:
+            self.remote_cid = pkt.scid
+        for fr in FR.parse_frames(pkt.payload):
+            if isinstance(fr, FR.CryptoFrame):
+                self._ack_due[level] = True
+                data = self._crypto_rx[level].add(fr.offset, fr.data)
+                if data:
+                    self.tls.feed(level, data)
+                    self._maybe_derive_keys()
+            elif isinstance(fr, FR.StreamFrame):
+                self._ack_due[level] = True
+                if fr.stream_id == 0:
+                    got = self.stream_rx.add(fr.offset, fr.data)
+                    if got:
+                        self._stream_in += got
+                    if fr.fin:
+                        self.stream_fin = True
+                # non-zero streams: accepted and ignored (scope cut)
+            elif fr is FR.HANDSHAKE_DONE:
+                self._ack_due[level] = True
+                self.handshake_done = True
+            elif isinstance(fr, FR.CloseFrame):
+                self.closed = True
+                self.close_reason = fr.reason
+            elif isinstance(fr, FR.AckFrame):
+                pass   # no retransmission state to clear (scope cut)
+
+    # -- send ----------------------------------------------------------
+
+    def _flush_level(self, level: str, pad_to: int = 0) -> Optional[bytes]:
+        frames = self._pending_frames[level]
+        if self._ack_due[level] and self._recv_pns[level]:
+            frames.insert(0, FR.encode_ack(self._recv_pns[level]))
+            self._ack_due[level] = False
+        if not frames:
+            return None
+        payload = b"".join(frames)
+        self._pending_frames[level] = []
+        keys = self._send_keys(level)
+        if keys is None:
+            return None
+        if pad_to:
+            payload = payload + b"\x00" * max(0, pad_to - len(payload))
+        pn = self._next_pn[level]
+        self._next_pn[level] += 1
+        kind = _PKT_OF_LEVEL[level]
+        return protect(kind, keys, pn, payload,
+                       dcid=self.remote_cid, scid=self.scid)
+
+    def _service(self) -> None:
+        """Drain TLS output + pending frames into coalesced datagrams."""
+        for level, msg in self.tls.take_outgoing():
+            off = self._crypto_tx_off[level]
+            self._pending_frames[level].append(FR.encode_crypto(off, msg))
+            self._crypto_tx_off[level] = off + len(msg)
+        self._maybe_derive_keys()
+        if self.role == "server" and self.tls.complete \
+                and not self.handshake_done:
+            self._pending_frames[LEVEL_APP].append(
+                bytes([FR.HANDSHAKE_DONE]))
+            self.handshake_done = True
+        parts: List[bytes] = []
+        has_initial = bool(self._pending_frames[LEVEL_INITIAL]) \
+            or self._ack_due[LEVEL_INITIAL]
+        for level in (LEVEL_INITIAL, LEVEL_HANDSHAKE, LEVEL_APP):
+            pkt = self._flush_level(level)
+            if pkt is not None:
+                parts.append(pkt)
+        if not parts:
+            return
+        dgram = b"".join(parts)
+        if has_initial and len(dgram) < 1200:
+            # RFC 9000 §14.1: datagrams carrying Initial packets expand
+            # to 1200 (client anti-amplification / server validation)
+            pad = self._make_padding(1200 - len(dgram))
+            dgram = dgram + pad if pad else dgram
+        self._out_datagrams.append(dgram)
+
+    def _make_padding(self, n: int) -> bytes:
+        """A trailing PADDING-only packet bringing the datagram to the
+        1200-byte floor (raw zero bytes after a packet are illegal —
+        padding must live INSIDE a protected packet)."""
+        for level in (LEVEL_APP, LEVEL_HANDSHAKE, LEVEL_INITIAL):
+            keys = self._send_keys(level)
+            if keys is None:
+                continue
+            pn = self._next_pn[level]
+            kind = _PKT_OF_LEVEL[level]
+            # probe: exact per-level overhead (header + AEAD tag) so the
+            # pad lands exactly on the floor, never under it
+            overhead = len(protect(kind, keys, pn, b"\x00",
+                                   dcid=self.remote_cid,
+                                   scid=self.scid)) - 1
+            self._next_pn[level] += 1
+            payload = b"\x00" * max(1, n - overhead)
+            return protect(kind, keys, pn, payload,
+                           dcid=self.remote_cid, scid=self.scid)
+        return b""
+
+    def take_outgoing(self) -> List[bytes]:
+        out, self._out_datagrams = self._out_datagrams, []
+        return out
+
+    # -- app surface ---------------------------------------------------
+
+    def send_stream(self, data: bytes, fin: bool = False) -> None:
+        self._pending_frames[LEVEL_APP].append(
+            FR.encode_stream(0, self._stream_tx_off, data, fin=fin))
+        self._stream_tx_off += len(data)
+        self._service()
+
+    def pop_stream_data(self) -> bytes:
+        out = bytes(self._stream_in)
+        self._stream_in.clear()
+        return out
+
+    def close(self, code: int = 0, reason: str = "") -> None:
+        if self.closed:
+            return
+        level = LEVEL_APP if self._send_keys(LEVEL_APP) is not None \
+            else LEVEL_INITIAL
+        self._pending_frames[level].append(FR.encode_close(code, reason))
+        self._service()
+        self.closed = True
+        self.close_reason = reason
+
+
+class QuicServerConnection(_Conn):
+    def __init__(self, first_dcid: bytes, cert_pem: bytes, key_pem: bytes,
+                 alpn: str = "mqtt") -> None:
+        scid = os.urandom(8)
+        tls = Tls13("server", cert_pem=cert_pem, key_pem=key_pem,
+                    alpn=alpn, tp=_encode_tp(scid, first_dcid))
+        super().__init__("server", tls, scid, initial_keys(first_dcid))
+
+    @property
+    def established(self) -> bool:
+        return self.tls.complete
+
+
+class QuicClient(_Conn):
+    def __init__(self, alpn: str = "mqtt", server_name: str = "",
+                 verify_cert: bool = False,
+                 ca_pem: Optional[bytes] = None) -> None:
+        odcid = os.urandom(8)
+        scid = os.urandom(8)
+        tls = Tls13("client", alpn=alpn, server_name=server_name,
+                    verify_cert=verify_cert, ca_pem=ca_pem,
+                    tp=_encode_tp(scid, None))
+        super().__init__("client", tls, scid, initial_keys(odcid))
+        self.remote_cid = odcid
+        self._service()     # first flight: Initial(CRYPTO(ClientHello))
+
+    @property
+    def established(self) -> bool:
+        return self.tls.complete and self.handshake_done
+
+
+class QuicStream:
+    """asyncio adapter with the TcpStream surface, so QUIC connections
+    ride the node's ordinary ``handle_stream`` path."""
+
+    def __init__(self, conn: _Conn, flush: Callable[[], None]) -> None:
+        self.conn = conn
+        self._flush = flush
+        self._rx: asyncio.Queue = asyncio.Queue()
+        self._buf = bytearray()
+        self._eof = False
+
+    def feed(self, data: bytes) -> None:
+        if data:
+            self._rx.put_nowait(data)
+
+    def feed_eof(self) -> None:
+        self._eof = True
+        self._rx.put_nowait(b"")
+
+    async def read(self, n: int) -> bytes:
+        if not self._buf:
+            if self._eof and self._rx.empty():
+                return b""
+            chunk = await self._rx.get()
+            if not chunk:
+                return b""
+            self._buf += chunk
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def write(self, data: bytes) -> None:
+        self.conn.send_stream(data)
+        self._flush()
+
+    async def drain(self) -> None:
+        return None
+
+    def close(self) -> None:
+        if not self.conn.closed:
+            self.conn.close(0, "closed")
+            self._flush()
+        self.feed_eof()
+
+    async def wait_closed(self) -> None:
+        return None
+
+    def peername(self):
+        return getattr(self.conn, "peer_addr", None)
+
+
+class QuicEndpoint:
+    """Server-side UDP demultiplexer (the quicer listener analog).
+
+    ``on_connection(stream, conninfo_dict)`` is scheduled once per new
+    connection as soon as the handshake completes — the node passes its
+    ``handle_stream``."""
+
+    def __init__(self, transport, cert_pem: bytes, key_pem: bytes,
+                 on_connection, alpn: str = "mqtt",
+                 idle_timeout: float = 120.0) -> None:
+        self.transport = transport
+        self.cert_pem = cert_pem
+        self.key_pem = key_pem
+        self.on_connection = on_connection
+        self.alpn = alpn
+        self.idle_timeout = idle_timeout
+        self.by_cid: Dict[bytes, QuicServerConnection] = {}
+        self.streams: Dict[QuicServerConnection, QuicStream] = {}
+        self.handshakes = 0
+
+    def datagram_received(self, data: bytes, addr) -> None:
+        if len(data) < 7:
+            return
+        conn = self._route(data)
+        if conn is None:
+            if not (data[0] & 0x80):
+                return                      # short header for unknown cid
+            # new connection: first Initial carries the client's dcid
+            p = 5
+            dcil = data[p]; p += 1
+            dcid = data[p:p + dcil]
+            conn = QuicServerConnection(dcid, self.cert_pem, self.key_pem,
+                                        alpn=self.alpn)
+            conn.peer_addr = addr
+            self.by_cid[dcid] = conn
+            self.by_cid[conn.scid] = conn
+        conn.peer_addr = addr
+        was_up = conn.established
+        try:
+            conn.receive(data)
+        except Exception:
+            log.debug("quic: dropping connection", exc_info=True)
+            self._drop(conn)
+            return
+        self._flush(conn)
+        if conn.established and not was_up:
+            self.handshakes += 1
+            stream = QuicStream(conn, lambda c=conn: self._flush(c))
+            self.streams[conn] = stream
+            info = {"listener": "quic:default", "peername": addr}
+            asyncio.ensure_future(self.on_connection(stream, info))
+        s = self.streams.get(conn)
+        if s is not None:
+            s.feed(conn.pop_stream_data())
+            if conn.stream_fin or conn.closed:
+                s.feed_eof()
+        if conn.closed:
+            self._drop(conn)
+
+    def _route(self, data: bytes) -> Optional[QuicServerConnection]:
+        if data[0] & 0x80:
+            dcil = data[5]
+            return self.by_cid.get(data[6:6 + dcil])
+        return self.by_cid.get(data[1:9])
+
+    def _flush(self, conn: _Conn) -> None:
+        for dg in conn.take_outgoing():
+            self.transport.sendto(dg, conn.peer_addr)
+
+    def _drop(self, conn: QuicServerConnection) -> None:
+        s = self.streams.pop(conn, None)
+        if s is not None:
+            s.feed_eof()
+        for cid in [c for c, v in self.by_cid.items() if v is conn]:
+            del self.by_cid[cid]
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        now = now if now is not None else time.monotonic()
+        stale = {id(c): c for c in self.by_cid.values()
+                 if now - c.last_seen > self.idle_timeout}
+        for c in stale.values():
+            self._drop(c)
+        return len(stale)
+
+    def close(self) -> None:
+        for conn in {id(c): c for c in self.by_cid.values()}.values():
+            conn.close(0, "server shutdown")
+            self._flush(conn)
+            s = self.streams.pop(conn, None)
+            if s is not None:
+                s.feed_eof()
+        self.by_cid.clear()
+        self.transport.close()

@@ -1,0 +1,82 @@
+"""QUIC v1 packet protection (RFC 9001) — keys, AEAD, header masks.
+
+Validated against RFC 9001 Appendix A: the initial-secret derivation,
+client-initial encryption, and header-protection mask tests live in
+``tests/test_quic.py`` and pin this module to the published vectors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import NamedTuple
+
+from cryptography.hazmat.primitives.ciphers import (
+    Cipher, algorithms, modes,
+)
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+__all__ = ["DirectionKeys", "LevelKeys", "initial_keys", "hkdf_expand_label",
+           "traffic_keys", "INITIAL_SALT_V1"]
+
+INITIAL_SALT_V1 = bytes.fromhex("38762cf7f55934b34d179ae6a4c80cadccbb7f0a")
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def hkdf_expand_label(secret: bytes, label: bytes, context: bytes,
+                      length: int) -> bytes:
+    """TLS 1.3 HKDF-Expand-Label (RFC 8446 §7.1)."""
+    full = b"tls13 " + label
+    info = (length.to_bytes(2, "big") + bytes([len(full)]) + full
+            + bytes([len(context)]) + context)
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = hmac.new(secret, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+class DirectionKeys(NamedTuple):
+    key: bytes   # 16 B AEAD key
+    iv: bytes    # 12 B
+    hp: bytes    # 16 B header-protection key
+
+    def seal(self, pn: int, header: bytes, payload: bytes) -> bytes:
+        nonce = (int.from_bytes(self.iv, "big") ^ pn).to_bytes(12, "big")
+        return AESGCM(self.key).encrypt(nonce, payload, header)
+
+    def open(self, pn: int, header: bytes, payload: bytes) -> bytes:
+        nonce = (int.from_bytes(self.iv, "big") ^ pn).to_bytes(12, "big")
+        return AESGCM(self.key).decrypt(nonce, payload, header)
+
+    def hp_mask(self, sample: bytes) -> bytes:
+        """AES-ECB(hp_key, sample)[:5] (RFC 9001 §5.4.3)."""
+        enc = Cipher(algorithms.AES(self.hp), modes.ECB()).encryptor()
+        return (enc.update(sample) + enc.finalize())[:5]
+
+
+def traffic_keys(secret: bytes) -> DirectionKeys:
+    return DirectionKeys(
+        key=hkdf_expand_label(secret, b"quic key", b"", 16),
+        iv=hkdf_expand_label(secret, b"quic iv", b"", 12),
+        hp=hkdf_expand_label(secret, b"quic hp", b"", 16),
+    )
+
+
+class LevelKeys(NamedTuple):
+    client: DirectionKeys
+    server: DirectionKeys
+
+
+def initial_keys(dcid: bytes) -> LevelKeys:
+    """Initial-level keys from the client's first DCID (RFC 9001 §5.2)."""
+    initial = _hkdf_extract(INITIAL_SALT_V1, dcid)
+    cs = hkdf_expand_label(initial, b"client in", b"", 32)
+    ss = hkdf_expand_label(initial, b"server in", b"", 32)
+    return LevelKeys(client=traffic_keys(cs), server=traffic_keys(ss))

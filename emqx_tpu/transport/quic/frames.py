@@ -1,0 +1,194 @@
+"""QUIC v1 frame encode/parse (RFC 9000 §19) — the subset the
+handshake + a single MQTT byte stream need."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, NamedTuple, Tuple
+
+from .packet import decode_varint, encode_varint
+
+__all__ = [
+    "AckFrame", "CloseFrame", "CryptoFrame", "StreamFrame",
+    "encode_ack", "encode_crypto", "encode_stream", "encode_close",
+    "parse_frames", "HANDSHAKE_DONE", "PING",
+]
+
+PADDING = 0x00
+PING = 0x01
+ACK = 0x02
+CRYPTO = 0x06
+NEW_TOKEN = 0x07
+STREAM_BASE = 0x08       # 0x08..0x0f: OFF=0x04 LEN=0x02 FIN=0x01
+MAX_DATA = 0x10
+MAX_STREAM_DATA = 0x11
+MAX_STREAMS_BIDI = 0x12
+MAX_STREAMS_UNI = 0x13
+DATA_BLOCKED = 0x14
+STREAM_DATA_BLOCKED = 0x15
+STREAMS_BLOCKED_BIDI = 0x16
+STREAMS_BLOCKED_UNI = 0x17
+NEW_CONNECTION_ID = 0x18
+RETIRE_CONNECTION_ID = 0x19
+CONNECTION_CLOSE_QUIC = 0x1C
+CONNECTION_CLOSE_APP = 0x1D
+HANDSHAKE_DONE = 0x1E
+
+
+class CryptoFrame(NamedTuple):
+    offset: int
+    data: bytes
+
+
+class StreamFrame(NamedTuple):
+    stream_id: int
+    offset: int
+    data: bytes
+    fin: bool
+
+
+class AckFrame(NamedTuple):
+    largest: int
+    ranges: List[Tuple[int, int]]   # [(lo, hi)] descending
+
+
+class CloseFrame(NamedTuple):
+    error_code: int
+    reason: str
+    app: bool
+
+
+def encode_crypto(offset: int, data: bytes) -> bytes:
+    return (bytes([CRYPTO]) + encode_varint(offset)
+            + encode_varint(len(data)) + data)
+
+
+def encode_stream(stream_id: int, offset: int, data: bytes,
+                  fin: bool = False) -> bytes:
+    t = STREAM_BASE | 0x04 | 0x02 | (0x01 if fin else 0)
+    return (bytes([t]) + encode_varint(stream_id) + encode_varint(offset)
+            + encode_varint(len(data)) + data)
+
+
+def encode_ack(pns: List[int]) -> bytes:
+    """ACK frame over a received-pn list (collapsed into ranges)."""
+    s = sorted(set(pns), reverse=True)
+    ranges: List[Tuple[int, int]] = []
+    hi = lo = s[0]
+    for pn in s[1:]:
+        if pn == lo - 1:
+            lo = pn
+        else:
+            ranges.append((lo, hi))
+            hi = lo = pn
+    ranges.append((lo, hi))
+    out = bytearray([ACK])
+    out += encode_varint(ranges[0][1])            # largest acked
+    out += encode_varint(0)                       # ack delay
+    out += encode_varint(len(ranges) - 1)
+    out += encode_varint(ranges[0][1] - ranges[0][0])
+    prev_lo = ranges[0][0]
+    for lo, hi in ranges[1:]:
+        out += encode_varint(prev_lo - hi - 2)    # gap
+        out += encode_varint(hi - lo)             # range length
+        prev_lo = lo
+    return bytes(out)
+
+
+def encode_close(error_code: int, reason: str = "",
+                 app: bool = True) -> bytes:
+    r = reason.encode()
+    t = CONNECTION_CLOSE_APP if app else CONNECTION_CLOSE_QUIC
+    out = bytes([t]) + encode_varint(error_code)
+    if not app:
+        out += encode_varint(0)                   # offending frame type
+    return out + encode_varint(len(r)) + r
+
+
+def parse_frames(payload: bytes) -> Iterator[object]:
+    """Yield parsed frames; unknown-but-skippable frames are consumed
+    silently, unskippable ones raise."""
+    off = 0
+    n = len(payload)
+    while off < n:
+        t = payload[off]
+        if t == PADDING or t == PING:
+            off += 1
+            continue
+        if t in (ACK, ACK + 1):
+            off += 1
+            largest, off = decode_varint(payload, off)
+            _delay, off = decode_varint(payload, off)
+            count, off = decode_varint(payload, off)
+            first, off = decode_varint(payload, off)
+            ranges = [(largest - first, largest)]
+            lo = largest - first
+            for _ in range(count):
+                gap, off = decode_varint(payload, off)
+                rlen, off = decode_varint(payload, off)
+                hi = lo - gap - 2
+                lo = hi - rlen
+                ranges.append((lo, hi))
+            if t == ACK + 1:                      # ECN counts
+                for _ in range(3):
+                    _, off = decode_varint(payload, off)
+            yield AckFrame(largest, ranges)
+            continue
+        if t == CRYPTO:
+            off += 1
+            o, off = decode_varint(payload, off)
+            ln, off = decode_varint(payload, off)
+            yield CryptoFrame(o, payload[off:off + ln])
+            off += ln
+            continue
+        if STREAM_BASE <= t <= STREAM_BASE + 0x07:
+            off += 1
+            sid, off = decode_varint(payload, off)
+            o = 0
+            if t & 0x04:
+                o, off = decode_varint(payload, off)
+            if t & 0x02:
+                ln, off = decode_varint(payload, off)
+            else:
+                ln = n - off
+            yield StreamFrame(sid, o, payload[off:off + ln],
+                              bool(t & 0x01))
+            off += ln
+            continue
+        if t in (CONNECTION_CLOSE_QUIC, CONNECTION_CLOSE_APP):
+            off += 1
+            code, off = decode_varint(payload, off)
+            if t == CONNECTION_CLOSE_QUIC:
+                _ft, off = decode_varint(payload, off)
+            rlen, off = decode_varint(payload, off)
+            yield CloseFrame(code, payload[off:off + rlen].decode(
+                "utf-8", "replace"), t == CONNECTION_CLOSE_APP)
+            off += rlen
+            continue
+        if t == HANDSHAKE_DONE:
+            off += 1
+            yield HANDSHAKE_DONE
+            continue
+        if t == NEW_TOKEN:
+            off += 1
+            ln, off = decode_varint(payload, off)
+            off += ln
+            continue
+        if t in (MAX_DATA, MAX_STREAMS_BIDI, MAX_STREAMS_UNI,
+                 DATA_BLOCKED, STREAMS_BLOCKED_BIDI, STREAMS_BLOCKED_UNI,
+                 RETIRE_CONNECTION_ID):
+            off += 1
+            _, off = decode_varint(payload, off)
+            continue
+        if t in (MAX_STREAM_DATA, STREAM_DATA_BLOCKED):
+            off += 1
+            _, off = decode_varint(payload, off)
+            _, off = decode_varint(payload, off)
+            continue
+        if t == NEW_CONNECTION_ID:
+            off += 1
+            _seq, off = decode_varint(payload, off)
+            _ret, off = decode_varint(payload, off)
+            cl = payload[off]
+            off += 1 + cl + 16                    # cid + reset token
+            continue
+        raise ValueError(f"unhandled frame type {t:#x}")

@@ -1,0 +1,162 @@
+"""QUIC v1 wire format: varints, long/short headers, packet numbers,
+and the protect/unprotect pipeline (RFC 9000 §16–17, RFC 9001 §5.4).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+from .crypto import DirectionKeys
+
+__all__ = [
+    "PKT_INITIAL", "PKT_HANDSHAKE", "PKT_1RTT",
+    "PlainPacket", "decode_varint", "encode_varint",
+    "protect", "unprotect", "decode_pn",
+]
+
+QUIC_V1 = 1
+
+PKT_INITIAL = "initial"
+PKT_HANDSHAKE = "handshake"
+PKT_1RTT = "1rtt"
+
+_LONG_TYPE = {0: PKT_INITIAL, 2: PKT_HANDSHAKE}   # 1=0RTT, 3=Retry unused
+_TYPE_BITS = {PKT_INITIAL: 0, PKT_HANDSHAKE: 2}
+
+
+def encode_varint(v: int) -> bytes:
+    if v < 0x40:
+        return bytes([v])
+    if v < 0x4000:
+        return (v | 0x4000).to_bytes(2, "big")
+    if v < 0x4000_0000:
+        return (v | 0x8000_0000).to_bytes(4, "big")
+    return (v | 0xC000_0000_0000_0000).to_bytes(8, "big")
+
+
+def decode_varint(buf: bytes, off: int) -> Tuple[int, int]:
+    """-> (value, new_offset)."""
+    first = buf[off]
+    ln = 1 << (first >> 6)
+    v = int.from_bytes(buf[off:off + ln], "big") & ((1 << (8 * ln - 2)) - 1)
+    return v, off + ln
+
+
+def decode_pn(truncated: int, pn_len: int, largest: int) -> int:
+    """Reconstruct a full packet number (RFC 9000 §A.3)."""
+    expected = largest + 1
+    win = 1 << (8 * pn_len)
+    half = win // 2
+    cand = (expected & ~(win - 1)) | truncated
+    if cand <= expected - half and cand < (1 << 62) - win:
+        return cand + win
+    if cand > expected + half and cand >= win:
+        return cand - win
+    return cand
+
+
+class PlainPacket(NamedTuple):
+    kind: str          # initial | handshake | 1rtt
+    dcid: bytes
+    scid: bytes        # b"" for 1rtt
+    pn: int
+    payload: bytes     # decrypted frames
+    token: bytes = b""
+
+
+def protect(kind: str, keys: DirectionKeys, pn: int, payload: bytes,
+            dcid: bytes, scid: bytes = b"", token: bytes = b"",
+            pn_len: int = 4) -> bytes:
+    """Build + encrypt one packet (AEAD then header protection)."""
+    pn_bytes = pn.to_bytes(pn_len, "big")[-pn_len:]
+    if kind == PKT_1RTT:
+        first = 0x40 | (pn_len - 1)            # fixed bit, key phase 0
+        header = bytes([first]) + dcid + pn_bytes
+        pn_off = 1 + len(dcid)
+    else:
+        first = 0xC0 | (_TYPE_BITS[kind] << 4) | (pn_len - 1)
+        hdr = bytearray([first])
+        hdr += QUIC_V1.to_bytes(4, "big")
+        hdr += bytes([len(dcid)]) + dcid
+        hdr += bytes([len(scid)]) + scid
+        if kind == PKT_INITIAL:
+            hdr += encode_varint(len(token)) + token
+        length = pn_len + len(payload) + 16    # + AEAD tag
+        hdr += encode_varint(length)
+        pn_off = len(hdr)
+        hdr += pn_bytes
+        header = bytes(hdr)
+    sealed = keys.seal(pn, header, payload)
+    pkt = bytearray(header + sealed)
+    # header protection: sample starts 4 bytes after the pn offset
+    sample = bytes(pkt[pn_off + 4:pn_off + 20])
+    mask = keys.hp_mask(sample)
+    if kind == PKT_1RTT:
+        pkt[0] ^= mask[0] & 0x1F
+    else:
+        pkt[0] ^= mask[0] & 0x0F
+    for i in range(pn_len):
+        pkt[pn_off + i] ^= mask[1 + i]
+    return bytes(pkt)
+
+
+def unprotect(datagram: bytes, off: int, keys_for, largest_pn,
+              local_cid_len: int = 8) -> Tuple[Optional[PlainPacket], int]:
+    """Unprotect ONE packet starting at ``off``; -> (packet|None, next_off).
+
+    ``keys_for(kind) -> DirectionKeys|None`` supplies the peer's send
+    keys per level (None ⇒ skip: not yet available).  ``largest_pn(kind)``
+    supplies the largest received pn for reconstruction.  Undecryptable
+    or unknown packets skip to the end of the datagram (coalescing only
+    matters for long-header packets, which carry explicit lengths).
+    """
+    first = datagram[off]
+    if first & 0x80:                            # long header
+        ver = int.from_bytes(datagram[off + 1:off + 5], "big")
+        p = off + 5
+        dcil = datagram[p]; p += 1
+        dcid = datagram[p:p + dcil]; p += dcil
+        scil = datagram[p]; p += 1
+        scid = datagram[p:p + scil]; p += scil
+        if ver != QUIC_V1:
+            return None, len(datagram)
+        kind = _LONG_TYPE.get((first >> 4) & 0x3)
+        token = b""
+        if kind == PKT_INITIAL:
+            tlen, p = decode_varint(datagram, p)
+            token = datagram[p:p + tlen]; p += tlen
+        elif kind is None:
+            return None, len(datagram)
+        length, p = decode_varint(datagram, p)
+        end = p + length
+        pn_off = p
+    else:                                       # short header (1-RTT)
+        kind = PKT_1RTT
+        dcid = datagram[off + 1:off + 1 + local_cid_len]
+        scid = b""
+        token = b""
+        pn_off = off + 1 + local_cid_len
+        end = len(datagram)
+    keys = keys_for(kind)
+    if keys is None or pn_off + 20 > len(datagram):
+        return None, end
+    sample = datagram[pn_off + 4:pn_off + 20]
+    mask = keys.hp_mask(sample)
+    buf = bytearray(datagram[off:end])
+    rel_pn = pn_off - off
+    if kind == PKT_1RTT:
+        buf[0] ^= mask[0] & 0x1F
+    else:
+        buf[0] ^= mask[0] & 0x0F
+    pn_len = (buf[0] & 0x03) + 1
+    for i in range(pn_len):
+        buf[rel_pn + i] ^= mask[1 + i]
+    trunc = int.from_bytes(buf[rel_pn:rel_pn + pn_len], "big")
+    pn = decode_pn(trunc, pn_len, largest_pn(kind))
+    header = bytes(buf[:rel_pn + pn_len])
+    body = bytes(buf[rel_pn + pn_len:])
+    try:
+        payload = keys.open(pn, header, body)
+    except Exception:
+        return None, end
+    return PlainPacket(kind, dcid, scid, pn, payload, token), end

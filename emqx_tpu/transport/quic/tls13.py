@@ -1,0 +1,396 @@
+"""Minimal TLS 1.3 handshake embedded in QUIC CRYPTO streams.
+
+RFC 8446 restricted to what QUIC v1 needs and one ciphersuite:
+``TLS_AES_128_GCM_SHA256`` + x25519 + ``rsa_pss_rsae_sha256``
+certificates, ALPN, and the ``quic_transport_parameters`` extension
+(RFC 9001 §8.2).  Both roles, sans-IO:
+
+    tls = Tls13(role="server", cert_pem=..., key_pem=..., tp=params)
+    tls.feed(LEVEL_INITIAL, crypto_bytes)   # reassembled CRYPTO data
+    for level, msg in tls.take_outgoing(): ...
+    tls.hs_secrets / tls.app_secrets        # -> (client, server) or None
+
+The QUIC packet layer derives its per-level keys from the secrets via
+:func:`~emqx_tpu.transport.quic.crypto.traffic_keys`.
+
+Scope cuts, recorded: no PSK/resumption/0-RTT, no HelloRetryRequest
+(x25519 is mandatory for our own client), no client certificates, no
+KeyUpdate.  NewSessionTicket from a peer is parsed and ignored.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from typing import Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey, X25519PublicKey,
+)
+
+from .crypto import hkdf_expand_label
+
+__all__ = ["Tls13", "TlsError", "LEVEL_INITIAL", "LEVEL_HANDSHAKE",
+           "LEVEL_APP"]
+
+LEVEL_INITIAL = "initial"
+LEVEL_HANDSHAKE = "handshake"
+LEVEL_APP = "1rtt"
+
+HT_CLIENT_HELLO = 1
+HT_SERVER_HELLO = 2
+HT_NEW_SESSION_TICKET = 4
+HT_ENCRYPTED_EXTENSIONS = 8
+HT_CERTIFICATE = 11
+HT_CERTIFICATE_VERIFY = 15
+HT_FINISHED = 20
+
+SUITE_AES128_GCM_SHA256 = 0x1301
+GROUP_X25519 = 0x001D
+SIG_RSA_PSS_SHA256 = 0x0804
+
+EXT_SERVER_NAME = 0
+EXT_SUPPORTED_GROUPS = 10
+EXT_SIG_ALGS = 13
+EXT_ALPN = 16
+EXT_SUPPORTED_VERSIONS = 43
+EXT_KEY_SHARE = 51
+EXT_QUIC_TP = 0x39
+
+
+class TlsError(Exception):
+    pass
+
+
+def _u8(b: bytes) -> bytes:
+    return bytes([len(b)]) + b
+
+
+def _u16(b: bytes) -> bytes:
+    return len(b).to_bytes(2, "big") + b
+
+
+def _u24(b: bytes) -> bytes:
+    return len(b).to_bytes(3, "big") + b
+
+
+def _ext(t: int, body: bytes) -> bytes:
+    return t.to_bytes(2, "big") + _u16(body)
+
+
+def _hs_msg(t: int, body: bytes) -> bytes:
+    return bytes([t]) + _u24(body)
+
+
+def _parse_exts(buf: bytes) -> Dict[int, bytes]:
+    out: Dict[int, bytes] = {}
+    off = 0
+    while off + 4 <= len(buf):
+        t = int.from_bytes(buf[off:off + 2], "big")
+        ln = int.from_bytes(buf[off + 2:off + 4], "big")
+        out[t] = buf[off + 4:off + 4 + ln]
+        off += 4 + ln
+    return out
+
+
+def _derive_secret(secret: bytes, label: bytes, transcript: bytes) -> bytes:
+    return hkdf_expand_label(secret, label, transcript, 32)
+
+
+_CV_CONTEXT = {
+    "server": b"\x20" * 64 + b"TLS 1.3, server CertificateVerify\x00",
+    "client": b"\x20" * 64 + b"TLS 1.3, client CertificateVerify\x00",
+}
+
+
+class Tls13:
+    def __init__(self, role: str, *, tp: bytes,
+                 cert_pem: Optional[bytes] = None,
+                 key_pem: Optional[bytes] = None,
+                 alpn: str = "mqtt",
+                 server_name: str = "",
+                 verify_cert: bool = False,
+                 ca_pem: Optional[bytes] = None) -> None:
+        assert role in ("client", "server")
+        self.role = role
+        self.alpn = alpn
+        self.tp = tp                      # local quic_transport_parameters
+        self.peer_tp: Optional[bytes] = None
+        self.server_name = server_name
+        self.verify_cert = verify_cert
+        self.ca_pem = ca_pem
+        self.cert_pem = cert_pem
+        self.key_pem = key_pem
+        self.complete = False
+        self.peer_cert_der: Optional[bytes] = None
+        self.hs_secrets: Optional[Tuple[bytes, bytes]] = None  # (c, s)
+        self.app_secrets: Optional[Tuple[bytes, bytes]] = None
+        self._ecdh = X25519PrivateKey.generate()
+        self._transcript = hashlib.sha256()
+        self._out: List[Tuple[str, bytes]] = []
+        self._bufs: Dict[str, bytearray] = {
+            LEVEL_INITIAL: bytearray(), LEVEL_HANDSHAKE: bytearray(),
+            LEVEL_APP: bytearray(),
+        }
+        self._hs_secret = b""
+        self._master = b""
+        self._server_hs_transcript = b""
+        if role == "client":
+            self._send_client_hello()
+
+    # -- transcript helpers --------------------------------------------
+
+    def _absorb(self, msg: bytes) -> None:
+        self._transcript.update(msg)
+
+    def _th(self) -> bytes:
+        return self._transcript.copy().digest()
+
+    def take_outgoing(self) -> List[Tuple[str, bytes]]:
+        out, self._out = self._out, []
+        return out
+
+    # -- key schedule --------------------------------------------------
+
+    def _derive_handshake(self, shared: bytes) -> None:
+        early = hmac.new(b"\x00" * 32, b"\x00" * 32, hashlib.sha256).digest()
+        derived = _derive_secret(early, b"derived",
+                                 hashlib.sha256(b"").digest())
+        self._hs_secret = hmac.new(derived, shared, hashlib.sha256).digest()
+        th = self._th()     # CH..SH
+        self.hs_secrets = (
+            _derive_secret(self._hs_secret, b"c hs traffic", th),
+            _derive_secret(self._hs_secret, b"s hs traffic", th),
+        )
+        derived2 = _derive_secret(self._hs_secret, b"derived",
+                                  hashlib.sha256(b"").digest())
+        self._master = hmac.new(derived2, b"\x00" * 32,
+                                hashlib.sha256).digest()
+
+    def _derive_app(self, th: bytes) -> None:
+        self.app_secrets = (
+            _derive_secret(self._master, b"c ap traffic", th),
+            _derive_secret(self._master, b"s ap traffic", th),
+        )
+
+    @staticmethod
+    def _finished(secret: bytes, th: bytes) -> bytes:
+        fk = hkdf_expand_label(secret, b"finished", b"", 32)
+        return hmac.new(fk, th, hashlib.sha256).digest()
+
+    # -- message construction ------------------------------------------
+
+    def _send_client_hello(self) -> None:
+        pub = self._ecdh.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        exts = b"".join([
+            _ext(EXT_SUPPORTED_VERSIONS, b"\x02\x03\x04"),
+            _ext(EXT_SUPPORTED_GROUPS, _u16(GROUP_X25519.to_bytes(2, "big"))),
+            _ext(EXT_SIG_ALGS, _u16(SIG_RSA_PSS_SHA256.to_bytes(2, "big"))),
+            _ext(EXT_KEY_SHARE, _u16(
+                GROUP_X25519.to_bytes(2, "big") + _u16(pub))),
+            _ext(EXT_ALPN, _u16(_u8(self.alpn.encode()))),
+            _ext(EXT_QUIC_TP, self.tp),
+        ] + ([_ext(EXT_SERVER_NAME, _u16(
+            b"\x00" + _u16(self.server_name.encode())))]
+            if self.server_name else []))
+        body = (b"\x03\x03" + os.urandom(32) + _u8(b"")
+                + _u16(SUITE_AES128_GCM_SHA256.to_bytes(2, "big"))
+                + _u8(b"\x00") + _u16(exts))
+        msg = _hs_msg(HT_CLIENT_HELLO, body)
+        self._absorb(msg)
+        self._out.append((LEVEL_INITIAL, msg))
+
+    def _server_flight(self, client_pub: bytes) -> None:
+        # ServerHello
+        pub = self._ecdh.public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+        sh_exts = b"".join([
+            _ext(EXT_SUPPORTED_VERSIONS, b"\x03\x04"),
+            _ext(EXT_KEY_SHARE, GROUP_X25519.to_bytes(2, "big") + _u16(pub)),
+        ])
+        sh = _hs_msg(HT_SERVER_HELLO,
+                     b"\x03\x03" + os.urandom(32) + _u8(b"")
+                     + SUITE_AES128_GCM_SHA256.to_bytes(2, "big") + b"\x00"
+                     + _u16(sh_exts))
+        self._absorb(sh)
+        self._out.append((LEVEL_INITIAL, sh))
+        shared = self._ecdh.exchange(
+            X25519PublicKey.from_public_bytes(client_pub))
+        self._derive_handshake(shared)
+
+        # EncryptedExtensions
+        ee = _hs_msg(HT_ENCRYPTED_EXTENSIONS, _u16(b"".join([
+            _ext(EXT_ALPN, _u16(_u8(self.alpn.encode()))),
+            _ext(EXT_QUIC_TP, self.tp),
+        ])))
+        self._absorb(ee)
+        # Certificate
+        from cryptography import x509
+
+        cert = x509.load_pem_x509_certificate(self.cert_pem)
+        der = cert.public_bytes(serialization.Encoding.DER)
+        cert_msg = _hs_msg(HT_CERTIFICATE,
+                           _u8(b"") + _u24(_u24(der) + _u16(b"")))
+        self._absorb(cert_msg)
+        # CertificateVerify over the transcript so far
+        key = serialization.load_pem_private_key(self.key_pem, None)
+        sig = key.sign(
+            _CV_CONTEXT["server"] + self._th(),
+            padding.PSS(mgf=padding.MGF1(hashes.SHA256()),
+                        salt_length=hashes.SHA256().digest_size),
+            hashes.SHA256())
+        cv = _hs_msg(HT_CERTIFICATE_VERIFY,
+                     SIG_RSA_PSS_SHA256.to_bytes(2, "big") + _u16(sig))
+        self._absorb(cv)
+        # server Finished
+        fin = _hs_msg(HT_FINISHED,
+                      self._finished(self.hs_secrets[1], self._th()))
+        self._absorb(fin)
+        self._server_hs_transcript = self._th()   # CH..server Finished
+        self._derive_app(self._server_hs_transcript)
+        for m in (ee, cert_msg, cv, fin):
+            self._out.append((LEVEL_HANDSHAKE, m))
+
+    # -- incoming ------------------------------------------------------
+
+    def feed(self, level: str, data: bytes) -> None:
+        buf = self._bufs[level]
+        buf.extend(data)
+        while len(buf) >= 4:
+            ln = int.from_bytes(buf[1:4], "big")
+            if len(buf) < 4 + ln:
+                return
+            msg = bytes(buf[:4 + ln])
+            del buf[:4 + ln]
+            self._handle(level, msg[0], msg[4:], msg)
+
+    def _handle(self, level: str, ht: int, body: bytes, raw: bytes) -> None:
+        if self.role == "server":
+            self._server_handle(level, ht, body, raw)
+        else:
+            self._client_handle(level, ht, body, raw)
+
+    # .. server side ...................................................
+
+    def _server_handle(self, level, ht, body, raw) -> None:
+        if ht == HT_CLIENT_HELLO and level == LEVEL_INITIAL:
+            if self.hs_secrets is not None:
+                return                       # retransmit
+            off = 2 + 32
+            sid = body[off]
+            off += 1 + sid
+            n = int.from_bytes(body[off:off + 2], "big")
+            suites = [int.from_bytes(body[off + 2 + i:off + 4 + i], "big")
+                      for i in range(0, n, 2)]
+            off += 2 + n
+            comp = body[off]
+            off += 1 + comp
+            elen = int.from_bytes(body[off:off + 2], "big")
+            exts = _parse_exts(body[off + 2:off + 2 + elen])
+            if SUITE_AES128_GCM_SHA256 not in suites:
+                raise TlsError("no shared cipher suite")
+            ks = exts.get(EXT_KEY_SHARE)
+            client_pub = None
+            if ks is not None:
+                p = 2
+                while p + 4 <= len(ks):
+                    grp = int.from_bytes(ks[p:p + 2], "big")
+                    kl = int.from_bytes(ks[p + 2:p + 4], "big")
+                    if grp == GROUP_X25519:
+                        client_pub = ks[p + 4:p + 4 + kl]
+                    p += 4 + kl
+            if client_pub is None:
+                raise TlsError("no x25519 key share (no HRR support)")
+            if EXT_QUIC_TP in exts:
+                self.peer_tp = exts[EXT_QUIC_TP]
+            self._absorb(raw)
+            self._server_flight(client_pub)
+            return
+        if ht == HT_FINISHED and level == LEVEL_HANDSHAKE:
+            want = self._finished(self.hs_secrets[0], self._th())
+            if not hmac.compare_digest(body, want):
+                raise TlsError("bad client Finished")
+            self._absorb(raw)
+            self.complete = True
+            return
+        raise TlsError(f"unexpected handshake {ht} at {level} (server)")
+
+    # .. client side ...................................................
+
+    def _client_handle(self, level, ht, body, raw) -> None:
+        if ht == HT_SERVER_HELLO and level == LEVEL_INITIAL:
+            off = 2 + 32
+            sid = body[off]
+            off += 1 + sid
+            suite = int.from_bytes(body[off:off + 2], "big")
+            off += 3                        # suite + compression
+            elen = int.from_bytes(body[off:off + 2], "big")
+            exts = _parse_exts(body[off + 2:off + 2 + elen])
+            if suite != SUITE_AES128_GCM_SHA256:
+                raise TlsError(f"server chose {suite:#x}")
+            ks = exts.get(EXT_KEY_SHARE)
+            if ks is None or int.from_bytes(ks[:2], "big") != GROUP_X25519:
+                raise TlsError("missing x25519 key share")
+            kl = int.from_bytes(ks[2:4], "big")
+            server_pub = ks[4:4 + kl]
+            self._absorb(raw)
+            shared = self._ecdh.exchange(
+                X25519PublicKey.from_public_bytes(server_pub))
+            self._derive_handshake(shared)
+            return
+        if level == LEVEL_HANDSHAKE and ht == HT_ENCRYPTED_EXTENSIONS:
+            exts = _parse_exts(body[2:2 + int.from_bytes(body[:2], "big")])
+            if EXT_QUIC_TP in exts:
+                self.peer_tp = exts[EXT_QUIC_TP]
+            self._absorb(raw)
+            return
+        if level == LEVEL_HANDSHAKE and ht == HT_CERTIFICATE:
+            off = 1 + body[0]               # context
+            total = int.from_bytes(body[off:off + 3], "big")
+            p = off + 3
+            if total:
+                dl = int.from_bytes(body[p:p + 3], "big")
+                self.peer_cert_der = body[p + 3:p + 3 + dl]
+            self._absorb(raw)
+            return
+        if level == LEVEL_HANDSHAKE and ht == HT_CERTIFICATE_VERIFY:
+            alg = int.from_bytes(body[:2], "big")
+            sl = int.from_bytes(body[2:4], "big")
+            sig = body[4:4 + sl]
+            if self.verify_cert:
+                if alg != SIG_RSA_PSS_SHA256 or self.peer_cert_der is None:
+                    raise TlsError("unsupported certificate verify")
+                from cryptography import x509
+
+                cert = x509.load_der_x509_certificate(self.peer_cert_der)
+                cert.public_key().verify(
+                    sig, _CV_CONTEXT["server"] + self._th(),
+                    padding.PSS(mgf=padding.MGF1(hashes.SHA256()),
+                                salt_length=hashes.SHA256().digest_size),
+                    hashes.SHA256())
+                if self.ca_pem is not None:
+                    from cryptography import x509 as _x
+
+                    ca = _x.load_pem_x509_certificate(self.ca_pem)
+                    cert.verify_directly_issued_by(ca)
+            self._absorb(raw)
+            return
+        if level == LEVEL_HANDSHAKE and ht == HT_FINISHED:
+            want = self._finished(self.hs_secrets[1], self._th())
+            if not hmac.compare_digest(body, want):
+                raise TlsError("bad server Finished")
+            self._absorb(raw)
+            self._derive_app(self._th())
+            fin = _hs_msg(HT_FINISHED,
+                          self._finished(self.hs_secrets[0], self._th()))
+            # client Finished does NOT enter the app-secret transcript
+            self._out.append((LEVEL_HANDSHAKE, fin))
+            self.complete = True
+            return
+        if ht == HT_NEW_SESSION_TICKET:
+            return                          # parsed-and-ignored
+        raise TlsError(f"unexpected handshake {ht} at {level} (client)")

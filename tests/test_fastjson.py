@@ -168,3 +168,23 @@ def test_rule_engine_uses_fast_path_with_identical_results():
         {"t": 25, "s": "x", "clientid": "c1"},
         {"t": 30, "s": 'a"b', "clientid": "c1"},
     ]
+
+
+def test_mixed_payload_and_bare_key_access():
+    """Review finding: a native payload.x hit must not starve LATER
+    bare-key lookups that rely on the decoded payload."""
+    import json as _json
+
+    from emqx_tpu.rule_engine.runtime import EvalContext
+
+    ctx = EvalContext({"payload": _json.dumps(
+        {"temp": 25, "humidity": 60}).encode(), "clientid": "c1"})
+    assert ctx.resolve(["payload", "temp"]) == 25       # fast path
+    assert ctx.resolve(["humidity"]) == 60              # bare key works
+    assert ctx.resolve(["clientid"]) == "c1"
+
+
+def test_empty_path_segment_bails():
+    assert fastjson.get_path(b'42 garbage', ("",)) == (False, None)
+    assert fastjson.get_path(b'{"a": 7}', ("a", "")) == (False, None)
+    assert fastjson.get_path(b'{"a": 7}', ()) == (False, None)

@@ -257,3 +257,39 @@ def test_node_wires_ocsp_cache(tmp_path):
             assert node.ocsp_cache is None
 
     run(main())
+
+
+def test_wrong_serial_rejected():
+    """A response for a DIFFERENT certificate must not install."""
+    ca, ca_key, srv, _k = make_pki()
+    _ca2, _k2, other, _ok = make_pki()
+    cache = OcspCache(*pems(ca, srv),
+                      fetch=make_responder(ca, ca_key, other))
+    with pytest.raises(Exception):
+        run(cache.refresh())
+    assert cache.current() is None
+
+
+def test_forged_signature_rejected():
+    """A response signed by someone other than the issuer must not
+    install (OCSP rides plain HTTP)."""
+    ca, ca_key, srv, _k = make_pki()
+    mitm_ca, mitm_key, _s, _mk = make_pki()
+
+    from cryptography.x509 import ocsp as _o
+
+    async def forged(url, der_request):
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = _o.OCSPResponseBuilder().add_response(
+            cert=srv, issuer=ca, algorithm=hashes.SHA256(),
+            cert_status=_o.OCSPCertStatus.GOOD,
+            this_update=now, next_update=now + datetime.timedelta(hours=1),
+            revocation_time=None, revocation_reason=None,
+        ).responder_id(_o.OCSPResponderEncoding.NAME, mitm_ca)
+        return builder.sign(mitm_key, hashes.SHA256()).public_bytes(
+            Encoding.DER)
+
+    cache = OcspCache(*pems(ca, srv), fetch=forged)
+    with pytest.raises(OcspError):
+        run(cache.refresh())
+    assert cache.current() is None and cache.failures == 1
