@@ -709,6 +709,24 @@ def main():
     if serve_cpu:
         serve_cpu["serve_capacity"] = int(cpu_cap)
     note(f"cpu serve done: {serve_cpu}")
+    # equal-load pass: the CPU engine driven at the DEVICE's offered
+    # rate through the same harness.  Above its capacity the CPU is an
+    # open-loop queue: latency grows ~linearly for the whole window, so
+    # the p99 here is window-bound, not an equilibrium — that IS the
+    # finding (the device sustains a load under which the CPU diverges);
+    # the window length is recorded with the number.
+    serve_cpu_eq = None
+    if serve_dev:
+        eq_s = min(args.serve_seconds, 6.0)
+        serve_cpu_eq = asyncio.run(serve_harness(
+            dev, table, topics, min(args.batch, 1024),
+            serve_dev["offered_rate"], eq_s, depth=args.depth,
+            engine="cpu"))
+        if serve_cpu_eq:
+            serve_cpu_eq["window_s"] = eq_s
+            serve_cpu_eq["backlog_at_end"] = int(
+                serve_dev["offered_rate"] * eq_s - serve_cpu_eq["served"])
+        note(f"cpu serve at device load done: {serve_cpu_eq}")
 
     deltas = bench_deltas(dev, table)
     note("deltas done")
@@ -722,6 +740,12 @@ def main():
     p99_speedup = (round(serve_cpu["p99_ms"]
                          / min(s["p99_ms"] for s in eligible), 2)
                    if eligible else None)
+    # both engines at the SAME offered rate (the device's): the
+    # capacity-gap p99 ratio.  Window-bound when the CPU is past
+    # capacity (see serve_cpu_equal_load.window_s) — reported alongside
+    # the iso-load ratio, never silently substituted for it.
+    p99_speedup_eq = (round(serve_cpu_eq["p99_ms"] / serve_dev["p99_ms"], 2)
+                      if serve_cpu_eq and serve_dev else None)
     result = {
         "metric": "wildcard_match_throughput",
         "value": tpu["topics_per_s"],
@@ -744,7 +768,13 @@ def main():
         # runs whose offered load is >= the CPU's offered load, so the
         # ratio never credits the device for serving less traffic.
         "p99_speedup": p99_speedup,
-        # the round-2 north star, answered explicitly every run
+        # informational ONLY: window-bound when the CPU is past
+        # capacity (its open-loop queue diverges, so this ratio grows
+        # with serve_seconds) — it demonstrates the capacity gap and is
+        # deliberately NOT an input to the north-star boolean below
+        "p99_speedup_equal_load": p99_speedup_eq,
+        # the round-2 north star, answered explicitly every run from
+        # the load-invariant iso/equal-eligible ratio alone
         "north_star_p99_10x": (None if p99_speedup is None
                                else bool(p99_speedup >= 10.0)),
         "throughput_speedup": (
@@ -764,6 +794,7 @@ def main():
         "serve_device": serve_dev,
         "serve_device_half_batch": serve_dev2,
         "serve_cpu_iso": serve_cpu,
+        "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,
         "delta": deltas,
     }

@@ -10,23 +10,28 @@ needs, pinned to broker-era-stable versions:
 
 * ``Metadata`` v1 (api 3) — partition leaders for the target topic;
 * ``Produce`` v3 (api 0) — record batches v2 (magic 2): zigzag-varint
-  records, CRC-32C (Castagnoli, software table — no snappy/crc32c
-  package in this environment, SURVEY §2.4), acks=1.
+  records, CRC-32C (Castagnoli — hardware SSE4.2 via
+  ``native/snappy.cpp`` when the toolchain is present, else the
+  software table below), acks=1.
 
-Compression is not attempted (attributes=0): snappy/lz4 are not in the
-environment's package set, and Kafka accepts uncompressed batches from
-any producer.  Partitioning is murmur-free: explicit ``partition`` in
-the rendered item, else key-hash (crc32c of the key) mod partitions,
-else round-robin — deployments needing Java-client-compatible
-murmur2 placement set explicit partitions.
+Compression: ``conf["compression"]`` = ``"snappy"`` (xerial-framed
+blocks via the in-repo ``native/snappy.cpp`` codec — the
+snappy-erlang-nif analog, SURVEY §2.4) or ``"gzip"`` (stdlib zlib).
+Fetch decodes both; lz4/zstd batches (no codec in this environment)
+are still skipped-with-offset-advance.  Partitioning is murmur-free:
+explicit ``partition`` in the rendered item, else key-hash (crc32c of
+the key) mod partitions, else round-robin — deployments needing
+Java-client-compatible murmur2 placement set explicit partitions.
 """
 
 from __future__ import annotations
 
 import asyncio
+import gzip
 import logging
 import struct
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..wire import LazyTcpClient
@@ -58,8 +63,16 @@ def _crc_table() -> List[int]:
 # asyncio.to_thread (record_batch of big batches runs in a worker)
 _CRC32C_TABLE: List[int] = _crc_table()
 
+# native codec probed at import for the same reason (forces the one-time
+# .so build/load before any worker threads exist)
+from ..native import snappy as _sz  # noqa: E402
+
+_NATIVE_CRC = _sz.available()
+
 
 def crc32c(data: bytes, crc: int = 0) -> int:
+    if _NATIVE_CRC:
+        return _sz.crc32c(data, crc)
     tab = _CRC32C_TABLE
     c = crc ^ 0xFFFFFFFF
     for b in data:
@@ -116,16 +129,27 @@ def _record(offset_delta: int, ts_delta: int, key: Optional[bytes],
     return _varint(len(body)) + body
 
 
+_CODEC_BITS = {None: 0, "none": 0, "gzip": 1, "snappy": 2}
+
+
 def record_batch(records: List[Tuple[Optional[bytes], bytes]],
                  base_ts_ms: Optional[int] = None,
-                 base_offset: int = 0) -> bytes:
-    """Record batch v2 (magic 2), uncompressed, producer-id-less."""
+                 base_offset: int = 0,
+                 compression: Optional[str] = None) -> bytes:
+    """Record batch v2 (magic 2), producer-id-less; optional snappy
+    (xerial framing, as the Java client emits) or gzip compression of
+    the records section."""
     ts = int(base_ts_ms if base_ts_ms is not None else time.time() * 1e3)
     recs = b"".join(
         _record(i, 0, k, v) for i, (k, v) in enumerate(records))
+    attrs = _CODEC_BITS[compression]
+    if attrs == 1:
+        recs = gzip.compress(recs)
+    elif attrs == 2:
+        recs = _sz.compress_xerial(recs)
     n = len(records)
     after_crc = (
-        struct.pack("!hiqqqhii", 0, n - 1, ts, ts, -1, -1, -1, n) + recs
+        struct.pack("!hiqqqhii", attrs, n - 1, ts, ts, -1, -1, -1, n) + recs
     )
     crc = crc32c(after_crc)
     head = struct.pack("!iBI", -1, 2, crc)             # epoch, magic, crc
@@ -175,9 +199,27 @@ def _parse_batch_full(data: bytes) -> Tuple[
         raise KafkaError("record batch crc mismatch")
     (attrs, last_delta, t0, t1, pid, peph, seq,
      n) = struct.unpack_from("!hiqqqhii", after, 0)
-    if attrs & 0x07 or attrs & 0x20:   # compression codec / control bit
-        return last_delta, None
+    codec = attrs & 0x07
     off = struct.calcsize("!hiqqqhii")
+    if attrs & 0x20:                   # control batch: NEVER surface its
+        return last_delta, None        # markers as data, any codec
+    if codec in (1, 2):
+        # gzip / snappy: the records section (everything after the fixed
+        # header) is one compressed blob; CRC above already covered the
+        # compressed form, so a decode failure here is a producer bug,
+        # not wire damage — surface it
+        try:
+            if codec == 1:
+                after = after[:off] + gzip.decompress(after[off:])
+            else:
+                after = after[:off] + _sz.decompress_xerial(after[off:])
+        except (ValueError, OSError, EOFError, zlib.error) as e:
+            # zlib.error/EOFError: corrupt/truncated deflate body — must
+            # land in KafkaError or the ingress poll loop misclassifies
+            # it and restarts into the same poisoned offset forever
+            raise KafkaError(f"batch decompress failed (codec {codec}): {e}")
+    elif codec:                        # lz4/zstd: no codec available
+        return last_delta, None
     out: List[Tuple[int, Optional[bytes], bytes]] = []
     for _ in range(n):
         _, off = read_varint(after, off)               # record length
@@ -294,15 +336,18 @@ class KafkaClient(LazyTcpClient):
 
     async def produce(self, topic: str, partition: int,
                       records: List[Tuple[Optional[bytes], bytes]],
-                      acks: int = 1) -> int:
+                      acks: int = 1,
+                      compression: Optional[str] = None) -> int:
         """Send one batch; returns the base offset assigned (-1 for
         acks=0, which Kafka leaves unanswered on the wire)."""
         if sum(len(v) + len(k or b"") for k, v in records) > 65536:
-            # the software CRC-32C is a per-byte Python loop; keep big
-            # batches off the event loop (broker keepalives run there)
-            batch = await asyncio.to_thread(record_batch, records)
+            # without the native codec the CRC-32C is a per-byte Python
+            # loop; keep big batches off the event loop either way
+            # (broker keepalives run there)
+            batch = await asyncio.to_thread(
+                record_batch, records, None, 0, compression)
         else:
-            batch = record_batch(records)
+            batch = record_batch(records, compression=compression)
         body = (_str(None)                             # transactional_id
                 + struct.pack("!hi", acks, int(self.timeout * 1e3))
                 + struct.pack("!i", 1) + _str(topic)
@@ -377,8 +422,8 @@ class KafkaClient(LazyTcpClient):
             return [], offset
         records, next_off, skipped = parse_batches(p[off:off + rlen])
         if skipped:
-            log.warning("fetch %s/%d: skipped %d compressed/control "
-                        "batch(es) (no codecs in this environment)",
+            log.warning("fetch %s/%d: skipped %d lz4/zstd/control "
+                        "batch(es) (codec not available)",
                         topic, pid, skipped)
         # batches can start before the requested offset (compaction);
         # drop the leading overlap
@@ -427,6 +472,11 @@ class KafkaConnector(Connector):
         self.local_publish = local_publish
         self.topic = conf.get("topic", "emqx")
         self.acks = int(conf.get("acks", 1))
+        self.compression = conf.get("compression") or None
+        if self.compression not in _CODEC_BITS:
+            raise ValueError(
+                f"kafka bridge {name}: unsupported compression "
+                f"{self.compression!r} (snappy/gzip/none)")
         self.client = KafkaClient(
             conf.get("server", "127.0.0.1:9092"),
             client_id=conf.get("client_id", f"emqx_tpu:{name}"),
@@ -592,7 +642,7 @@ class KafkaConnector(Connector):
                 await self.client.produce(
                     self.topic, part,
                     [(it.get("key"), it["value"]) for it in group],
-                    acks=self.acks)
+                    acks=self.acks, compression=self.compression)
             except SendError as e:
                 remaining = [it for g in pending.values() for it in g]
                 raise SendError(str(e), retryable=e.retryable,

@@ -202,6 +202,7 @@ SCHEMA: Dict[str, Field] = {
     "listeners.quic.default.bind": Field("0.0.0.0:14567", str),
     "listeners.quic.default.certfile": Field("", str),
     "listeners.quic.default.keyfile": Field("", str),
+    "listeners.quic.default.max_connections": Field(4096, int),
     "listeners.ssl.default.ocsp.enable": Field(False, _bool),
     "listeners.ssl.default.ocsp.responder_url": Field("", str),
     "listeners.ssl.default.ocsp.refresh_interval": Field(3600.0, duration),

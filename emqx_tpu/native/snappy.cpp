@@ -1,0 +1,306 @@
+// Snappy block-format codec + hardware CRC-32C.
+//
+// Behavioral reference: the reference broker links google/snappy via its
+// Kafka bridge (snappy-erlang / crc32cer deps, SURVEY.md §2.4) for
+// record-batch compression.  This is an independent implementation of
+// the PUBLIC Snappy format (format_description.txt semantics: varint
+// preamble + literal/copy tagged elements), written for this runtime —
+// greedy 4-byte-hash matcher, bounds-checked decompressor.  The xerial
+// stream framing Kafka wraps around raw blocks lives in snappy.py (it
+// is trivial byte plumbing; only the block codec is hot).
+//
+// CRC-32C (Castagnoli) is here too: the Kafka batch checksum was a
+// per-byte Python table loop (~10 MB/s); the SSE4.2 crc32 instruction
+// does 8 bytes/cycle.  Runtime-dispatched so the .so still works on
+// cpus without SSE4.2 (slice-by-8 software fallback).
+//
+// Exported (all extern "C", plain buffers, no allocation across the
+// boundary — caller supplies dst sized by sz_max_compressed_length /
+// the preamble):
+//   sz_max_compressed_length(n)              -> worst-case dst size
+//   sz_compress(src,n,dst,cap)               -> compressed size, -1 on cap
+//   sz_uncompressed_length(src,n)            -> preamble value, -1 bad
+//   sz_uncompress(src,n,dst,cap)             -> size, -1 on corrupt/cap
+//   sz_crc32c(buf,n,init)                    -> uint32
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+// ---- varint (LE 7-bit groups, unsigned) -----------------------------------
+
+inline size_t varint_put(uint8_t* dst, uint64_t v) {
+    size_t i = 0;
+    while (v >= 0x80) { dst[i++] = uint8_t(v) | 0x80; v >>= 7; }
+    dst[i++] = uint8_t(v);
+    return i;
+}
+
+// returns bytes consumed, 0 on truncation/overflow (>32 bits rejected:
+// snappy caps uncompressed length at 2^32-1)
+inline size_t varint_get(const uint8_t* p, size_t n, uint64_t* out) {
+    uint64_t v = 0;
+    for (size_t i = 0; i < n && i < 5; ++i) {
+        v |= uint64_t(p[i] & 0x7F) << (7 * i);
+        if (!(p[i] & 0x80)) {
+            if (i == 4 && (p[i] >> 4)) return 0;       // > 32 bits
+            *out = v;
+            return i + 1;
+        }
+    }
+    return 0;
+}
+
+// ---- compressor -----------------------------------------------------------
+
+constexpr int kHashBits = 14;                          // 16K-entry table
+constexpr size_t kTabSize = size_t(1) << kHashBits;
+
+inline uint32_t load32(const uint8_t* p) {
+    uint32_t v; std::memcpy(&v, p, 4); return v;
+}
+inline uint64_t load64(const uint8_t* p) {
+    uint64_t v; std::memcpy(&v, p, 8); return v;
+}
+
+inline uint32_t hash4(uint32_t v) {
+    return (v * 0x1E35A7BDu) >> (32 - kHashBits);
+}
+
+// emit one literal run [lit, lit+len)
+inline uint8_t* emit_literal(uint8_t* op, const uint8_t* lit, size_t len) {
+    if (len == 0) return op;
+    size_t n = len - 1;
+    if (n < 60) {
+        *op++ = uint8_t(n << 2);
+    } else if (n < (1u << 8)) {
+        *op++ = uint8_t(60 << 2); *op++ = uint8_t(n);
+    } else if (n < (1u << 16)) {
+        *op++ = uint8_t(61 << 2);
+        *op++ = uint8_t(n); *op++ = uint8_t(n >> 8);
+    } else if (n < (1u << 24)) {
+        *op++ = uint8_t(62 << 2);
+        *op++ = uint8_t(n); *op++ = uint8_t(n >> 8); *op++ = uint8_t(n >> 16);
+    } else {
+        *op++ = uint8_t(63 << 2);
+        *op++ = uint8_t(n); *op++ = uint8_t(n >> 8);
+        *op++ = uint8_t(n >> 16); *op++ = uint8_t(n >> 24);
+    }
+    std::memcpy(op, lit, len);
+    return op + len;
+}
+
+// emit copies covering len bytes at `offset` back; splits into <=64 chunks
+inline uint8_t* emit_copy(uint8_t* op, size_t offset, size_t len) {
+    while (len >= 68) {                                // 2-byte-offset, 64
+        *op++ = uint8_t((63 << 2) | 2);
+        *op++ = uint8_t(offset); *op++ = uint8_t(offset >> 8);
+        len -= 64;
+    }
+    if (len > 64) {                                    // leave >=4 for tail
+        *op++ = uint8_t((59 << 2) | 2);                // 60-byte copy
+        *op++ = uint8_t(offset); *op++ = uint8_t(offset >> 8);
+        len -= 60;
+    }
+    if (len >= 4 && len < 12 && offset < 2048) {       // 1-byte-offset form
+        *op++ = uint8_t(((offset >> 8) << 5) | ((len - 4) << 2) | 1);
+        *op++ = uint8_t(offset);
+    } else {
+        *op++ = uint8_t(((len - 1) << 2) | 2);
+        *op++ = uint8_t(offset); *op++ = uint8_t(offset >> 8);
+    }
+    return op;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t sz_max_compressed_length(int64_t n) {
+    // preamble (<=5) + worst case all-literals with chunk headers
+    return 32 + n + n / 6;
+}
+
+int64_t sz_compress(const uint8_t* src, int64_t srclen,
+                    uint8_t* dst, int64_t dstcap) {
+    if (srclen < 0 || dstcap < sz_max_compressed_length(srclen)) return -1;
+    uint8_t* op = dst;
+    op += varint_put(op, uint64_t(srclen));
+    if (srclen == 0) return op - dst;
+
+    const size_t n = size_t(srclen);
+    static thread_local uint16_t* table = nullptr;
+    if (!table) table = new uint16_t[kTabSize];
+    // positions are stored mod 64K against a sliding base so a 16-bit
+    // table covers arbitrarily long inputs (offsets >64K never match
+    // anyway: snappy copies reach back at most 64K-1 in 2-byte form and
+    // our emitter never uses the 4-byte-offset form)
+    size_t ip = 0, lit_start = 0;
+    if (n >= 15) {
+        std::memset(table, 0, kTabSize * sizeof(uint16_t));
+        size_t base = 0;                               // table entries are
+        const size_t limit = n - 4;                    // (pos - base) + 1
+        while (ip + 4 <= n) {
+            if (ip - base >= 60000) {                  // re-base the window
+                std::memset(table, 0, kTabSize * sizeof(uint16_t));
+                base = ip;
+            }
+            uint32_t h = hash4(load32(src + ip));
+            uint16_t prev = table[h];
+            table[h] = uint16_t(ip - base + 1);
+            if (prev == 0) { ++ip; continue; }
+            size_t cand = base + prev - 1;
+            size_t off = ip - cand;
+            if (off == 0 || off > 65535 ||
+                load32(src + cand) != load32(src + ip)) { ++ip; continue; }
+            // extend the match
+            size_t len = 4;
+            while (ip + len + 8 <= n &&
+                   load64(src + cand + len) == load64(src + ip + len))
+                len += 8;
+            while (ip + len < n && src[cand + len] == src[ip + len]) ++len;
+            op = emit_literal(op, src + lit_start, ip - lit_start);
+            op = emit_copy(op, off, len);
+            // seed the table inside the match so runs keep matching
+            size_t next = ip + len;
+            for (size_t p = ip + 1; p + 4 <= n && p < next &&
+                                    p - base < 65535; p += 13)
+                table[hash4(load32(src + p))] = uint16_t(p - base + 1);
+            ip = lit_start = next;
+            if (ip > limit) break;
+        }
+    }
+    op = emit_literal(op, src + lit_start, n - lit_start);
+    return op - dst;
+}
+
+int64_t sz_uncompressed_length(const uint8_t* src, int64_t srclen) {
+    if (srclen <= 0) return -1;
+    uint64_t v;
+    if (!varint_get(src, size_t(srclen), &v)) return -1;
+    return int64_t(v);
+}
+
+int64_t sz_uncompress(const uint8_t* src, int64_t srclen,
+                      uint8_t* dst, int64_t dstcap) {
+    if (srclen <= 0) return -1;
+    uint64_t want;
+    size_t ip = varint_get(src, size_t(srclen), &want);
+    if (!ip || int64_t(want) > dstcap) return -1;
+    const size_t n = size_t(srclen);
+    size_t op = 0;
+    while (ip < n) {
+        uint8_t tag = src[ip++];
+        size_t len, off;
+        switch (tag & 3) {
+        case 0: {                                      // literal
+            len = (tag >> 2) + 1;
+            if (len > 60) {
+                size_t nb = len - 60;                  // 1..4 length bytes
+                if (ip + nb > n) return -1;
+                len = 0;
+                for (size_t i = 0; i < nb; ++i)
+                    len |= size_t(src[ip + i]) << (8 * i);
+                len += 1;
+                ip += nb;
+            }
+            if (ip + len > n || op + len > want) return -1;
+            std::memcpy(dst + op, src + ip, len);
+            ip += len; op += len;
+            continue;
+        }
+        case 1:                                        // copy, 1-byte offset
+            if (ip >= n) return -1;
+            len = ((tag >> 2) & 7) + 4;
+            off = (size_t(tag >> 5) << 8) | src[ip++];
+            break;
+        case 2:                                        // copy, 2-byte offset
+            if (ip + 2 > n) return -1;
+            len = (tag >> 2) + 1;
+            off = size_t(src[ip]) | (size_t(src[ip + 1]) << 8);
+            ip += 2;
+            break;
+        default:                                       // copy, 4-byte offset
+            if (ip + 4 > n) return -1;
+            len = (tag >> 2) + 1;
+            off = size_t(src[ip]) | (size_t(src[ip + 1]) << 8) |
+                  (size_t(src[ip + 2]) << 16) | (size_t(src[ip + 3]) << 24);
+            ip += 4;
+            break;
+        }
+        if (off == 0 || off > op || op + len > want) return -1;
+        if (off >= len) {
+            std::memmove(dst + op, dst + op - off, len);
+            op += len;
+        } else {                                       // overlapping run
+            for (size_t i = 0; i < len; ++i, ++op)
+                dst[op] = dst[op - off];
+        }
+    }
+    return op == want ? int64_t(op) : -1;
+}
+
+// ---- CRC-32C --------------------------------------------------------------
+
+namespace {
+
+uint32_t crc_tab8[8][256];
+bool crc_tab_init_done = false;
+
+void crc_tab_init() {
+    constexpr uint32_t poly = 0x82F63B78u;
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k) c = (c >> 1) ^ (poly & (0u - (c & 1)));
+        crc_tab8[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+        for (int t = 1; t < 8; ++t)
+            crc_tab8[t][i] = (crc_tab8[t - 1][i] >> 8) ^
+                             crc_tab8[0][crc_tab8[t - 1][i] & 0xFF];
+    crc_tab_init_done = true;
+}
+
+uint32_t crc32c_sw(const uint8_t* p, size_t n, uint32_t c) {
+    if (!crc_tab_init_done) crc_tab_init();
+    while (n >= 8) {                                   // slice-by-8
+        c ^= uint32_t(p[0]) | (uint32_t(p[1]) << 8) |
+             (uint32_t(p[2]) << 16) | (uint32_t(p[3]) << 24);
+        c = crc_tab8[7][c & 0xFF] ^ crc_tab8[6][(c >> 8) & 0xFF] ^
+            crc_tab8[5][(c >> 16) & 0xFF] ^ crc_tab8[4][c >> 24] ^
+            crc_tab8[3][p[4]] ^ crc_tab8[2][p[5]] ^
+            crc_tab8[1][p[6]] ^ crc_tab8[0][p[7]];
+        p += 8; n -= 8;
+    }
+    while (n--) c = (c >> 8) ^ crc_tab8[0][(c ^ *p++) & 0xFF];
+    return c;
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+__attribute__((target("sse4.2")))
+uint32_t crc32c_hw(const uint8_t* p, size_t n, uint32_t c) {
+    uint64_t c64 = c;
+    while (n >= 8) {
+        uint64_t v; std::memcpy(&v, p, 8);
+        c64 = __builtin_ia32_crc32di(c64, v);
+        p += 8; n -= 8;
+    }
+    c = uint32_t(c64);
+    while (n--) c = __builtin_ia32_crc32qi(c, *p++);
+    return c;
+}
+#endif
+
+}  // namespace
+
+uint32_t sz_crc32c(const uint8_t* p, int64_t n, uint32_t init) {
+    uint32_t c = init ^ 0xFFFFFFFFu;
+#if defined(__x86_64__) || defined(__i386__)
+    if (__builtin_cpu_supports("sse4.2"))
+        return crc32c_hw(p, size_t(n), c) ^ 0xFFFFFFFFu;
+#endif
+    return crc32c_sw(p, size_t(n), c) ^ 0xFFFFFFFFu;
+}
+
+}  // extern "C"

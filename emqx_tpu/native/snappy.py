@@ -1,0 +1,235 @@
+"""Snappy codec + CRC-32C: ctypes front for snappy.cpp with pure-Python
+fallbacks, plus the xerial stream framing Kafka wraps around raw blocks.
+
+Reference analog: snappy-erlang-nif / crc32cer in the reference's Kafka
+bridge dep tree (SURVEY.md §2.4).  The native path is the fast one; the
+Python fallback keeps every feature working (compress emits the trivial
+all-literals encoding — valid snappy, zero ratio; decompress is a full
+bounds-checked format decoder) when no toolchain is present, so codec
+availability never changes behavior, only speed and ratio.
+
+Xerial framing (``compress_xerial``/``decompress_xerial``) is the
+``\\x82SNAPPY\\x00`` magic + version/compat ints + repeated
+[4-byte BE length | raw snappy block] stream the Java Kafka client's
+SnappyOutputStream produces; record batches flagged snappy on the wire
+carry this framing, not bare blocks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import List, Optional
+
+from .build import load_library
+
+__all__ = [
+    "available", "compress", "decompress", "crc32c",
+    "compress_xerial", "decompress_xerial",
+]
+
+_XERIAL_MAGIC = b"\x82SNAPPY\x00"
+_XERIAL_HEAD = _XERIAL_MAGIC + struct.pack("!ii", 1, 1)
+_XERIAL_BLOCK = 32 * 1024
+
+_lib = None
+_loaded = False
+
+
+def _load():
+    global _lib, _loaded
+    if not _loaded:
+        _loaded = True
+        lib = load_library("snappy")
+        if lib is not None:
+            lib.sz_max_compressed_length.restype = ctypes.c_int64
+            lib.sz_max_compressed_length.argtypes = [ctypes.c_int64]
+            lib.sz_compress.restype = ctypes.c_int64
+            lib.sz_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int64]
+            lib.sz_uncompressed_length.restype = ctypes.c_int64
+            lib.sz_uncompressed_length.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64]
+            lib.sz_uncompress.restype = ctypes.c_int64
+            lib.sz_uncompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int64]
+            lib.sz_crc32c.restype = ctypes.c_uint32
+            lib.sz_crc32c.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the native codec loaded (fast path + real compression)."""
+    return _load() is not None
+
+
+# ---- raw block codec --------------------------------------------------------
+
+def compress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        return _py_compress(data)
+    cap = lib.sz_max_compressed_length(len(data))
+    dst = ctypes.create_string_buffer(cap)
+    n = lib.sz_compress(data, len(data), dst, cap)
+    if n < 0:  # pragma: no cover - cap is computed from the same lib
+        return _py_compress(data)
+    return dst.raw[:n]
+
+
+def decompress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        return _py_decompress(data)
+    want = lib.sz_uncompressed_length(data, len(data))
+    if want < 0:
+        raise ValueError("snappy: bad preamble")
+    dst = ctypes.create_string_buffer(max(1, want))
+    n = lib.sz_uncompress(data, len(data), dst, want)
+    if n < 0:
+        raise ValueError("snappy: corrupt input")
+    return dst.raw[:n]
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        return _py_crc32c(data, crc)
+    return lib.sz_crc32c(data, len(data), crc & 0xFFFFFFFF)
+
+
+# ---- xerial framing ---------------------------------------------------------
+
+def compress_xerial(data: bytes) -> bytes:
+    out = [_XERIAL_HEAD]
+    for i in range(0, len(data), _XERIAL_BLOCK) or [0]:
+        blk = compress(data[i:i + _XERIAL_BLOCK])
+        out.append(struct.pack("!i", len(blk)) + blk)
+    return b"".join(out)
+
+
+def decompress_xerial(data: bytes) -> bytes:
+    """Decode xerial-framed input; bare raw blocks (some non-Java
+    producers skip the framing) are accepted too."""
+    if not data.startswith(_XERIAL_MAGIC):
+        return decompress(data)
+    pos = len(_XERIAL_HEAD)
+    out: List[bytes] = []
+    while pos < len(data):
+        if pos + 4 > len(data):
+            raise ValueError("snappy: truncated xerial block header")
+        (blen,) = struct.unpack_from("!i", data, pos)
+        pos += 4
+        if blen < 0 or pos + blen > len(data):
+            raise ValueError("snappy: truncated xerial block")
+        out.append(decompress(data[pos:pos + blen]))
+        pos += blen
+    return b"".join(out)
+
+
+# ---- pure-Python fallbacks --------------------------------------------------
+
+def _py_varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def _py_compress(data: bytes) -> bytes:
+    """All-literals encoding: valid snappy, no ratio (fallback only)."""
+    out = bytearray(_py_varint(len(data)))
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos:pos + (1 << 24)]
+        n = len(chunk) - 1
+        if n < 60:
+            out.append(n << 2)
+        elif n < (1 << 8):
+            out += bytes((60 << 2, n))
+        elif n < (1 << 16):
+            out += bytes((61 << 2,)) + n.to_bytes(2, "little")
+        else:
+            out += bytes((62 << 2,)) + n.to_bytes(3, "little")
+        out += chunk
+        pos += len(chunk)
+    return bytes(out)
+
+
+def _py_decompress(data: bytes) -> bytes:
+    if not data:
+        raise ValueError("snappy: empty input")
+    want = shift = pos = 0
+    while True:
+        if pos >= len(data) or shift > 28:
+            raise ValueError("snappy: bad preamble")
+        b = data[pos]
+        pos += 1
+        want |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:
+            ln = (tag >> 2) + 1
+            if ln > 60:
+                nb = ln - 60
+                ln = int.from_bytes(data[pos:pos + nb], "little") + 1
+                pos += nb
+            if pos + ln > len(data):
+                raise ValueError("snappy: truncated literal")
+            out += data[pos:pos + ln]
+            pos += ln
+            continue
+        if kind == 1:
+            ln = ((tag >> 2) & 7) + 4
+            off = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if off == 0 or off > len(out):
+            raise ValueError("snappy: bad copy offset")
+        if off >= ln:
+            out += out[-off:len(out) - off + ln]
+        else:
+            for _ in range(ln):
+                out.append(out[-off])
+    if len(out) != want:
+        raise ValueError("snappy: length mismatch")
+    return bytes(out)
+
+
+_PY_CRC_TAB: Optional[List[int]] = None
+
+
+def _py_crc32c(data: bytes, crc: int = 0) -> int:
+    global _PY_CRC_TAB
+    if _PY_CRC_TAB is None:
+        tab = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+            tab.append(c)
+        _PY_CRC_TAB = tab
+    tab = _PY_CRC_TAB
+    c = (crc & 0xFFFFFFFF) ^ 0xFFFFFFFF
+    for b in data:
+        c = tab[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF

@@ -653,7 +653,9 @@ class BrokerNode:
                 ))
 
             self.quic = QuicEndpoint(
-                self._quic_transport, cert_pem, key_pem, on_connection)
+                self._quic_transport, cert_pem, key_pem, on_connection,
+                max_connections=int(cfg.get(
+                    "listeners.quic.default.max_connections")))
             log.info("quic listener on udp %s:%d", host, self.quic_port)
         except Exception:
             log.exception("quic listener failed to start")

@@ -117,6 +117,10 @@ class _Conn:
         self._out_datagrams: List[bytes] = []
         self._pending_frames: Dict[str, List[bytes]] = {
             LEVEL_INITIAL: [], LEVEL_HANDSHAKE: [], LEVEL_APP: []}
+        # 1-RTT packets that arrived before app recv keys derived (a
+        # peer may coalesce its first stream data with its Finished);
+        # replayed after derivation — bounded, no retransmission exists
+        self._undecryptable: List[bytes] = []
         self.last_seen = time.monotonic()
 
     # -- key plumbing --------------------------------------------------
@@ -149,8 +153,24 @@ class _Conn:
         if self.closed:
             return
         self.last_seen = time.monotonic()
+        self._receive_segments(datagram)
+        if self._undecryptable and \
+                self._recv_keys(LEVEL_APP) is not None:
+            pend, self._undecryptable = self._undecryptable, []
+            for seg in pend:
+                self._receive_segments(seg)
+        self._service()
+
+    def _receive_segments(self, datagram: bytes) -> None:
         off = 0
         while off < len(datagram):
+            if not (datagram[off] & 0x80) \
+                    and self._recv_keys(LEVEL_APP) is None:
+                # short-header packet before app keys: park the tail
+                # (short headers run to the end of the datagram)
+                if len(self._undecryptable) < 32:
+                    self._undecryptable.append(datagram[off:])
+                return
             pkt, off = unprotect(
                 datagram, off,
                 lambda kind: self._recv_keys(_LEVEL_OF_PKT[kind]),
@@ -160,7 +180,6 @@ class _Conn:
             if pkt is None:
                 continue
             self._on_packet(pkt)
-        self._service()
 
     def _on_packet(self, pkt: PlainPacket) -> None:
         level = _LEVEL_OF_PKT[pkt.kind]
@@ -196,7 +215,14 @@ class _Conn:
 
     # -- send ----------------------------------------------------------
 
-    def _flush_level(self, level: str, pad_to: int = 0) -> Optional[bytes]:
+    def _flush_level(self, level: str) -> Optional[bytes]:
+        keys = self._send_keys(level)
+        if keys is None:
+            # keys not derived yet (e.g. app data queued mid-handshake):
+            # leave the frames AND the ack-due flag queued — they flush
+            # on the next _service() after key derivation, instead of
+            # being silently discarded
+            return None
         frames = self._pending_frames[level]
         if self._ack_due[level] and self._recv_pns[level]:
             frames.insert(0, FR.encode_ack(self._recv_pns[level]))
@@ -205,11 +231,6 @@ class _Conn:
             return None
         payload = b"".join(frames)
         self._pending_frames[level] = []
-        keys = self._send_keys(level)
-        if keys is None:
-            return None
-        if pad_to:
-            payload = payload + b"\x00" * max(0, pad_to - len(payload))
         pn = self._next_pn[level]
         self._next_pn[level] += 1
         kind = _PKT_OF_LEVEL[level]
@@ -229,27 +250,44 @@ class _Conn:
                 bytes([FR.HANDSHAKE_DONE]))
             self.handshake_done = True
         parts: List[bytes] = []
+        app_pkt: Optional[bytes] = None
         has_initial = bool(self._pending_frames[LEVEL_INITIAL]) \
             or self._ack_due[LEVEL_INITIAL]
         for level in (LEVEL_INITIAL, LEVEL_HANDSHAKE, LEVEL_APP):
             pkt = self._flush_level(level)
-            if pkt is not None:
+            if pkt is None:
+                continue
+            if level == LEVEL_APP:
+                app_pkt = pkt       # short header: MUST stay last (no
+            else:                   # length field — nothing may follow)
                 parts.append(pkt)
-        if not parts:
+        if not parts and app_pkt is None:
             return
-        dgram = b"".join(parts)
-        if has_initial and len(dgram) < 1200:
+        total = sum(map(len, parts)) + (len(app_pkt) if app_pkt else 0)
+        if has_initial and total < 1200:
             # RFC 9000 §14.1: datagrams carrying Initial packets expand
-            # to 1200 (client anti-amplification / server validation)
-            pad = self._make_padding(1200 - len(dgram))
-            dgram = dgram + pad if pad else dgram
-        self._out_datagrams.append(dgram)
+            # to 1200 (client anti-amplification / server validation).
+            # The pad packet goes BEFORE any short-header packet: a
+            # second short-header packet in one datagram would swallow
+            # it into the first one's AEAD body and break decryption.
+            pad = self._make_padding(1200 - total,
+                                     allow_short=app_pkt is None)
+            if pad:
+                parts.append(pad)
+        if app_pkt is not None:
+            parts.append(app_pkt)
+        self._out_datagrams.append(b"".join(parts))
 
-    def _make_padding(self, n: int) -> bytes:
-        """A trailing PADDING-only packet bringing the datagram to the
-        1200-byte floor (raw zero bytes after a packet are illegal —
-        padding must live INSIDE a protected packet)."""
-        for level in (LEVEL_APP, LEVEL_HANDSHAKE, LEVEL_INITIAL):
+    def _make_padding(self, n: int, allow_short: bool = True) -> bytes:
+        """A PADDING-only packet bringing the datagram to the 1200-byte
+        floor (raw zero bytes after a packet are illegal — padding must
+        live INSIDE a protected packet).  Long-header levels first:
+        their explicit length lets another packet follow; the 1-RTT
+        short-header form is only legal as the datagram's LAST packet
+        (``allow_short``)."""
+        levels = (LEVEL_HANDSHAKE, LEVEL_INITIAL) + (
+            (LEVEL_APP,) if allow_short else ())
+        for level in levels:
             keys = self._send_keys(level)
             if keys is None:
                 continue
@@ -272,11 +310,23 @@ class _Conn:
 
     # -- app surface ---------------------------------------------------
 
+    # RFC 9000 §14: never send datagrams above the 1200-byte minimum
+    # path MTU we can assume without probing.  STREAM payload per packet
+    # leaves room for the short header + AEAD tag + frame header.
+    _MTU_STREAM_CHUNK = 1130
+
     def send_stream(self, data: bytes, fin: bool = False) -> None:
-        self._pending_frames[LEVEL_APP].append(
-            FR.encode_stream(0, self._stream_tx_off, data, fin=fin))
-        self._stream_tx_off += len(data)
-        self._service()
+        # segment into MTU-sized packets: one oversized datagram would
+        # be IP-fragmented and silently dropped on frag-hostile paths
+        step = self._MTU_STREAM_CHUNK
+        chunks = [data[i:i + step]
+                  for i in range(0, len(data), step)] or [b""]
+        for j, chunk in enumerate(chunks):
+            self._pending_frames[LEVEL_APP].append(
+                FR.encode_stream(0, self._stream_tx_off, chunk,
+                                 fin=fin and j == len(chunks) - 1))
+            self._stream_tx_off += len(chunk)
+            self._service()
 
     def pop_stream_data(self) -> bytes:
         out = bytes(self._stream_in)
@@ -385,16 +435,25 @@ class QuicEndpoint:
 
     def __init__(self, transport, cert_pem: bytes, key_pem: bytes,
                  on_connection, alpn: str = "mqtt",
-                 idle_timeout: float = 120.0) -> None:
+                 idle_timeout: float = 120.0,
+                 max_connections: int = 4096) -> None:
         self.transport = transport
         self.cert_pem = cert_pem
         self.key_pem = key_pem
         self.on_connection = on_connection
         self.alpn = alpn
         self.idle_timeout = idle_timeout
+        # hard cap on live connection state: Initial keys derive from
+        # the public DCID, so well-formed Initials are spoofable and
+        # each costs an RSA server-flight sign — past the cap new
+        # Initials are DROPPED until the idle sweep frees slots (a
+        # retry-token round would authenticate source addresses; out of
+        # scope, and the cap bounds the damage either way)
+        self.max_connections = max_connections
         self.by_cid: Dict[bytes, QuicServerConnection] = {}
         self.streams: Dict[QuicServerConnection, QuicStream] = {}
         self.handshakes = 0
+        self.dropped_initials = 0
 
     def datagram_received(self, data: bytes, addr) -> None:
         if len(data) < 7:
@@ -403,10 +462,26 @@ class QuicEndpoint:
         if conn is None:
             if not (data[0] & 0x80):
                 return                      # short header for unknown cid
-            # new connection: first Initial carries the client's dcid
+            # new connection: accept ONLY a well-formed v1 Initial
+            # (long-header type 0) at the 1200-byte anti-amplification
+            # floor.  Anything else (stale Handshake retransmits after a
+            # sweep, scanners, garbage versions) must not allocate state:
+            # each spoofed-source datagram would otherwise grow by_cid
+            # until the idle sweep.
+            if (data[0] & 0x30) != 0x00:    # long-header type != Initial
+                return
+            if data[1:5] != b"\x00\x00\x00\x01":       # QUIC v1 only
+                return
+            if len(data) < 1200:            # RFC 9000 §14.1 client floor
+                return
             p = 5
             dcil = data[p]; p += 1
+            if dcil < 8 or p + dcil > len(data):
+                return                      # our clients send >=8-byte cids
             dcid = data[p:p + dcil]
+            if len(self.by_cid) >= 2 * self.max_connections:
+                self.dropped_initials += 1      # 2 cid entries per conn
+                return
             conn = QuicServerConnection(dcid, self.cert_pem, self.key_pem,
                                         alpn=self.alpn)
             conn.peer_addr = addr

@@ -1,0 +1,158 @@
+"""Dense matmul NFA engine (`ops/dense_match.py`) — parity against the
+host oracle and the gather kernel, plus its exactness guarantee (no
+active-set spill) on workloads that force the gather kernel to fail
+open.  Runs on the CPU mesh; the on-chip A/B is ``bench_dense``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from emqx_tpu import topic as T
+from emqx_tpu.ops.compiler import compile_filters, encode_topics
+from emqx_tpu.ops.dense_match import (
+    DENSE_STATE_CAP, build_dense, dense_match, supports_dense,
+)
+from emqx_tpu.ops.match_kernel import nfa_match
+
+
+def _run_dense(tab, dense, topics, max_matches=64):
+    words, lens, is_sys = encode_topics(tab, topics, batch=len(topics))
+    return dense_match(
+        jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+        *[jnp.asarray(a) for a in dense.device_arrays()],
+        max_matches=max_matches)
+
+
+def _decode(tab, res, i):
+    row = np.asarray(res.matches)[i]
+    return sorted(tab.accept_filters[a] for a in row[row >= 0])
+
+
+def test_dense_matches_oracle_randomized():
+    rng = np.random.default_rng(7)
+    filters = sorted({
+        "r%d/" % rng.integers(8)
+        + "/".join(("+" if rng.random() < 0.35 else "w%d" % rng.integers(10))
+                   for _ in range(rng.integers(1, 6)))
+        + ("/#" if rng.random() < 0.25 else "")
+        for _ in range(400)
+    } | {"#", "+/x", "$SYS/broker/+", "a/b/c"})
+    tab = compile_filters(filters, depth=8)
+    dense = build_dense(tab)
+    topics = ["r%d/" % rng.integers(8)
+              + "/".join("w%d" % rng.integers(10)
+                         for _ in range(rng.integers(1, 8)))
+              for _ in range(300)]
+    topics += ["$SYS/broker/uptime", "a/b/c", "r1", "none/of/these/words"]
+    res = _run_dense(tab, dense, topics, max_matches=128)
+    mo = np.asarray(res.match_overflow)
+    assert not np.any(np.asarray(res.active_overflow)), "dense cannot spill"
+    for i, t in enumerate(topics):
+        if mo[i]:
+            continue
+        assert _decode(tab, res, i) == sorted(
+            f for f in filters if T.match(t, f)), t
+
+
+def test_dense_exact_where_gather_spills():
+    # every literal/+ combination over 4 levels: topic a/b/c/d holds
+    # 2^4 = 16 trie nodes active at step 4, far past the gather
+    # kernel's A=4 cap; the dense walk has no cap and must stay exact
+    import itertools
+
+    filters = ["/".join(seg) + "/#" for seg in itertools.product(
+        *([w, "+"] for w in "abcd"))]
+    tab = compile_filters(filters, depth=8)
+    dense = build_dense(tab)
+    topics = ["a/b/c/d/tail", "x/y/l7/z"]
+    words, lens, is_sys = encode_topics(tab, topics, batch=2)
+    args = (jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys))
+    g = nfa_match(*args, *[jnp.asarray(a) for a in tab.device_arrays()],
+                  active_slots=4, compact_output=True, max_matches=64)
+    assert np.asarray(g.active_overflow).sum() > 0, \
+        "workload should overflow the gather kernel's active set"
+    d = _run_dense(tab, dense, topics)
+    assert not np.asarray(d.active_overflow).any()
+    assert _decode(tab, d, 0) == sorted(
+        f for f in filters if T.match("a/b/c/d/tail", f))
+
+
+def test_dense_sys_topic_root_suppression():
+    tab = compile_filters(["#", "+/status", "$SYS/+", "$SYS/#"], depth=8)
+    dense = build_dense(tab)
+    res = _run_dense(tab, dense, ["$SYS/status", "node/status"])
+    # $-topics must not match root-level `#`/`+` but do match $SYS/...
+    assert _decode(tab, res, 0) == ["$SYS/#", "$SYS/+"]
+    assert _decode(tab, res, 1) == ["#", "+/status"]
+
+
+def test_dense_match_overflow_flagged():
+    filters = [f"a/+/f{i}" for i in range(40)] + ["a/b/#"]
+    tab = compile_filters(filters, depth=8)
+    dense = build_dense(tab)
+    res = _run_dense(tab, dense, ["a/b/f1"], max_matches=1)
+    assert np.asarray(res.match_overflow)[0] == 1
+    assert np.asarray(res.n_matches)[0] == 2  # a/+/f1, a/b/#
+
+
+def test_supports_dense_cap():
+    tab = compile_filters(["a/b"], depth=8)
+    assert supports_dense(tab)
+    assert not supports_dense(tab, state_cap=1)
+    assert DENSE_STATE_CAP >= 256   # measured crossover, see dense_match.py
+
+
+def test_build_dense_structure():
+    tab = compile_filters(["a/b", "a/+", "c/#"], depth=8)
+    d = build_dense(tab)
+    # every literal edge: exactly one nonzero per column; labels set
+    cols = d.lmat.sum(axis=0)
+    assert set(np.unique(cols)) <= {0.0, 1.0}
+    lit_children = np.nonzero(cols)[0]
+    assert all(d.label[c] >= 0 for c in lit_children)
+    # plus edges come from node_tab column 0
+    n = tab.n_states
+    src = np.nonzero(tab.node_tab[:n, 0] >= 0)[0]
+    assert d.pmat.sum() == len(src)
+
+
+def test_tiered_dense_hot_engine_parity():
+    from emqx_tpu.ops.tiered import TieredMatcher, build_tiered
+
+    rng = np.random.default_rng(9)
+    filters = sorted({
+        "hot%d/%s" % (rng.integers(3), "/".join(
+            ("+" if rng.random() < 0.3 else "w%d" % rng.integers(6))
+            for _ in range(rng.integers(1, 4))))
+        for _ in range(60)
+    } | {"cold%d/+/#" % i for i in range(20)} | {"#"})
+    tiered = build_tiered(filters, ["hot0", "hot1", "hot2"], depth=8,
+                          fit=supports_dense)
+    tm = TieredMatcher(tiered, depth=8, hot_engine="dense")
+    topics = ["hot%d/w1/w2" % rng.integers(3) for _ in range(40)] \
+        + ["cold3/anything/x", "hot0/w0"]
+    got = tm.match(topics)
+    for t, rows in zip(topics, got):
+        assert sorted(rows) == sorted(
+            f for f in filters if T.match(t, f)), t
+    assert tm.hot_topics and tm.cold_topics
+    assert tm.info()["hot_engine"] == "dense"
+
+
+def test_tiered_demotes_on_engine_failure(monkeypatch):
+    from emqx_tpu.ops import tiered as tiered_mod
+    from emqx_tpu.ops.tiered import TieredMatcher, build_tiered
+
+    filters = ["hot0/a", "hot0/+", "cold/x"]
+    tiered = build_tiered(filters, ["hot0"], depth=8, fit=supports_dense)
+    tm = TieredMatcher(tiered, depth=8, hot_engine="pallas")
+
+    def boom(self, topics):
+        raise RuntimeError("Mosaic says no")
+
+    monkeypatch.setattr(TieredMatcher, "_match_hot_pallas", boom)
+    got = tm.match(["hot0/a", "cold/x"])
+    assert got[0] == ["hot0/+", "hot0/a"] or sorted(got[0]) == [
+        "hot0/+", "hot0/a"]
+    assert tm.hot_engine == "dense"   # demoted, traffic served

@@ -270,3 +270,82 @@ def test_mqtt_session_over_quic_listener(tmp_path):
             await node.stop()
 
     run(main())
+
+
+def test_stream_datagrams_respect_min_mtu():
+    """RFC 9000 §14: a 5 KB publish must be segmented, never emitted as
+    one IP-fragmenting datagram (review finding, round 5)."""
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    client.send_stream(b"y" * 5000)
+    for dg in client.take_outgoing():
+        assert len(dg) <= 1252, len(dg)
+        box[0].receive(dg)
+    assert box[0].pop_stream_data() == b"y" * 5000
+
+
+def test_send_before_keys_is_queued_not_dropped():
+    """App data written mid-handshake must flush after key derivation
+    instead of being silently discarded (review finding, round 5)."""
+    client = QuicClient()
+    client.send_stream(b"early CONNECT")   # no 1-RTT keys yet
+    box = [None]
+    pump(client, box)
+    assert client.established
+    assert box[0].pop_stream_data() == b"early CONNECT"
+
+
+def test_endpoint_ignores_garbage_long_headers():
+    """Unknown-DCID datagrams that are not well-formed v1 Initials must
+    not allocate connection state (review finding, round 5)."""
+    from emqx_tpu.transport.quic.connection import QuicEndpoint
+
+    sent = []
+
+    class FakeTransport:
+        def sendto(self, data, addr=None):
+            sent.append(data)
+
+    ep = QuicEndpoint(FakeTransport(), CERT_PEM, KEY_PEM,
+                      on_connection=lambda s, i: None)
+    addr = ("127.0.0.1", 12345)
+    # long header, wrong type (handshake=0x20), right version, padded
+    ep.datagram_received(
+        bytes([0xE0]) + b"\x00\x00\x00\x01" + b"\x08" + b"A" * 8
+        + b"\x00" * 1200, addr)
+    # right type, bogus version
+    ep.datagram_received(
+        bytes([0xC0]) + b"\xde\xad\xbe\xef" + b"\x08" + b"B" * 8
+        + b"\x00" * 1200, addr)
+    # right type+version but runt (below the 1200-byte Initial floor)
+    ep.datagram_received(
+        bytes([0xC0]) + b"\x00\x00\x00\x01" + b"\x08" + b"C" * 8, addr)
+    # short header for unknown cid
+    ep.datagram_received(b"\x40" + b"D" * 20, addr)
+    assert ep.by_cid == {}
+
+    # a REAL client initial still creates state
+    client = QuicClient()
+    for dg in client.take_outgoing():
+        ep.datagram_received(dg, addr)
+    assert len(ep.by_cid) == 2             # dcid + server scid
+
+
+def test_endpoint_caps_connection_state():
+    """Past max_connections, well-formed spoofed Initials are dropped
+    instead of allocating state + an RSA sign (review finding, r5)."""
+    from emqx_tpu.transport.quic.connection import QuicEndpoint
+
+    class FakeTransport:
+        def sendto(self, data, addr=None):
+            pass
+
+    ep = QuicEndpoint(FakeTransport(), CERT_PEM, KEY_PEM,
+                      on_connection=lambda s, i: None, max_connections=2)
+    for i in range(5):
+        client = QuicClient()
+        for dg in client.take_outgoing():
+            ep.datagram_received(dg, ("127.0.0.1", 40000 + i))
+    assert len(ep.by_cid) == 4                 # 2 conns x 2 cid entries
+    assert ep.dropped_initials >= 3

@@ -1,0 +1,141 @@
+"""Snappy codec + CRC-32C + Kafka batch compression.
+
+Reference analog: snappy/crc32cer NIFs in the reference's Kafka bridge
+dep tree (SURVEY.md §2.4).  The native and pure-Python paths must agree
+byte-for-byte on decode and produce mutually-decodable encodings.
+"""
+
+import os
+import random
+
+import pytest
+
+from emqx_tpu.native import snappy as sz
+from emqx_tpu.bridge.kafka import (
+    crc32c, parse_batches, parse_record_batch, record_batch,
+)
+
+
+def _cases():
+    random.seed(1234)
+    return [
+        b"",
+        b"x",
+        b"abc" * 1,
+        b"ab" * 5000,                       # highly compressible
+        os.urandom(4096),                   # incompressible
+        bytes(random.randrange(4) for _ in range(150000)),  # >64K window
+        ("the quick brown fox " * 997).encode(),
+        os.urandom(3) + b"\x00" * 70000 + os.urandom(3),    # long run
+    ]
+
+
+def test_roundtrip_native_and_python():
+    for d in _cases():
+        c = sz.compress(d)
+        assert sz.decompress(c) == d
+        assert sz._py_decompress(c) == d          # py decodes native
+        pc = sz._py_compress(d)
+        assert sz.decompress(pc) == d             # native decodes py
+        assert sz._py_decompress(pc) == d
+
+
+def test_compression_actually_compresses():
+    if not sz.available():
+        pytest.skip("no native toolchain")
+    d = b"ab" * 5000
+    assert len(sz.compress(d)) < len(d) // 10
+
+
+def test_xerial_roundtrip_and_bare_fallback():
+    for d in _cases():
+        assert sz.decompress_xerial(sz.compress_xerial(d)) == d
+    # a bare raw block (non-Java producers) is accepted too
+    assert sz.decompress_xerial(sz.compress(b"hello")) == b"hello"
+
+
+def test_xerial_multiblock():
+    d = os.urandom(100000)                        # > one 32K block
+    x = sz.compress_xerial(d)
+    assert x.startswith(b"\x82SNAPPY\x00")
+    assert sz.decompress_xerial(x) == d
+
+
+def test_corrupt_input_raises():
+    good = sz.compress(b"hello world, hello world, hello world")
+    for bad in (b"", b"\xff\xff\xff\xff\xff\xff",  # overlong preamble
+                good[:-2],                         # truncated
+                b"\x05\x09\x00\x01"):              # copy before start
+        with pytest.raises(ValueError):
+            sz.decompress(bad)
+        with pytest.raises(ValueError):
+            sz._py_decompress(bad)
+
+
+def test_crc32c_vectors():
+    # RFC 3720 test vectors
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"\xff" * 32) == 0x62A8AB43
+    # incremental == one-shot, native == python
+    a, b = os.urandom(1023), os.urandom(77)
+    assert crc32c(b, crc32c(a)) == crc32c(a + b)
+    assert sz._py_crc32c(a + b) == crc32c(a + b)
+
+
+@pytest.mark.parametrize("codec", ["snappy", "gzip"])
+def test_record_batch_compressed_roundtrip(codec):
+    recs = [(b"k%d" % i, os.urandom(50) + b"value" * i)
+            for i in range(20)] + [(None, b"no-key")]
+    batch = record_batch(recs, compression=codec)
+    assert parse_record_batch(batch) == recs
+    # and through the fetch-side concatenated-stream parser
+    out, nxt, skipped = parse_batches(batch)
+    assert skipped == 0
+    assert [(k, v) for _, k, v in out] == recs
+    assert nxt == len(recs)
+
+
+def test_record_batch_snappy_smaller_on_redundant_payloads():
+    if not sz.available():
+        pytest.skip("no native toolchain")
+    recs = [(None, b"sensor/temperature reading=21.5 unit=C " * 8)
+            for _ in range(64)]
+    assert len(record_batch(recs, compression="snappy")) \
+        < len(record_batch(recs)) // 4
+
+
+def test_lz4_batch_still_skipped_with_offset_advance():
+    batch = bytearray(record_batch([(b"k", b"v")]))
+    # flip the codec bits to lz4 (3) and re-CRC
+    import struct
+    attrs_off = 21
+    struct.pack_into("!h", batch, attrs_off, 3)
+    after = bytes(batch[attrs_off:])
+    struct.pack_into("!I", batch, 17, crc32c(after))
+    out, nxt, skipped = parse_batches(bytes(batch))
+    assert out == [] and skipped == 1 and nxt == 1
+
+
+def test_kafka_connector_rejects_unknown_codec():
+    from emqx_tpu.bridge.kafka import KafkaConnector
+    with pytest.raises(ValueError):
+        KafkaConnector({"compression": "zstd"})
+    KafkaConnector({"compression": "snappy"})     # accepted
+    KafkaConnector({"compression": "none"})
+    KafkaConnector({})
+
+
+def test_compressed_control_batch_still_skipped():
+    """attrs = snappy|control must be skipped like any control batch,
+    never surfaced as data (review finding, round 5)."""
+    import struct
+    batch = bytearray(record_batch([(b"k", b"v")], compression="snappy"))
+    attrs_off = 21
+    (attrs,) = struct.unpack_from("!h", batch, attrs_off)
+    struct.pack_into("!h", batch, attrs_off, attrs | 0x20)
+    after = bytes(batch[attrs_off:])
+    struct.pack_into("!I", batch, 17, crc32c(after))
+    out, nxt, skipped = parse_batches(bytes(batch))
+    assert out == [] and skipped == 1 and nxt == 1
