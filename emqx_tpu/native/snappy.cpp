@@ -246,9 +246,8 @@ int64_t sz_uncompress(const uint8_t* src, int64_t srclen,
 namespace {
 
 uint32_t crc_tab8[8][256];
-bool crc_tab_init_done = false;
 
-void crc_tab_init() {
+bool crc_tab_init() {
     constexpr uint32_t poly = 0x82F63B78u;
     for (uint32_t i = 0; i < 256; ++i) {
         uint32_t c = i;
@@ -259,11 +258,14 @@ void crc_tab_init() {
         for (int t = 1; t < 8; ++t)
             crc_tab8[t][i] = (crc_tab8[t - 1][i] >> 8) ^
                              crc_tab8[0][crc_tab8[t - 1][i] & 0xFF];
-    crc_tab_init_done = true;
+    return true;
 }
 
 uint32_t crc32c_sw(const uint8_t* p, size_t n, uint32_t c) {
-    if (!crc_tab_init_done) crc_tab_init();
+    // C++11 magic static: thread-safe one-time init (a plain bool flag
+    // races on weakly-ordered cpus — asyncio.to_thread workers call in)
+    static const bool inited = crc_tab_init();
+    (void)inited;
     while (n >= 8) {                                   // slice-by-8
         c ^= uint32_t(p[0]) | (uint32_t(p[1]) << 8) |
              (uint32_t(p[2]) << 16) | (uint32_t(p[3]) << 24);

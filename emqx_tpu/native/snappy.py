@@ -81,6 +81,22 @@ def compress(data: bytes) -> bytes:
     return dst.raw[:n]
 
 
+# preamble sanity cap before allocating: snappy's own format tops out
+# around 21x expansion (64-byte copies per 3-byte tag), so anything
+# claiming more is corrupt; the absolute ceiling stops a hostile
+# few-byte preamble from demanding a 4 GiB buffer per decode attempt
+_MAX_RATIO = 24
+_MAX_OUTPUT = 256 << 20
+
+
+def _checked_len(want: int, srclen: int) -> int:
+    if want < 0 or want > srclen * _MAX_RATIO + 4096 \
+            or want > _MAX_OUTPUT:
+        raise ValueError(f"snappy: implausible uncompressed length {want} "
+                         f"for {srclen} input bytes")
+    return want
+
+
 def decompress(data: bytes) -> bytes:
     lib = _load()
     if lib is None:
@@ -88,6 +104,7 @@ def decompress(data: bytes) -> bytes:
     want = lib.sz_uncompressed_length(data, len(data))
     if want < 0:
         raise ValueError("snappy: bad preamble")
+    want = _checked_len(want, len(data))
     dst = ctypes.create_string_buffer(max(1, want))
     n = lib.sz_uncompress(data, len(data), dst, want)
     if n < 0:
@@ -175,6 +192,7 @@ def _py_decompress(data: bytes) -> bytes:
         if not b & 0x80:
             break
         shift += 7
+    want = _checked_len(want, len(data))
     out = bytearray()
     while pos < len(data):
         tag = data[pos]

@@ -195,118 +195,25 @@ FUNCS.update({
     "proc_dict_get": lambda *a: None,
 })
 
-# -- jq (subset) ------------------------------------------------------------
+# -- jq ---------------------------------------------------------------------
 #
-# The reference binds libjq through a NIF (SURVEY.md §2.4); this is a
-# dependency-free subset covering the rule-engine's common transforms:
-# identity, field access (.a.b / .["k"]), array index (.[0], negatives),
-# iteration (.[] — fans out like jq, so the result is a LIST of outputs),
-# pipes (a | b), and comma for multiple outputs.  Always returns the
-# list of outputs, matching the reference's jq/2 contract.
-
-def _jq_split(s: str, sep: str):
-    """Split on ``sep`` outside double-quoted sections (keys may contain
-    '|' and ',')."""
-    out, cur, inq, esc = [], [], False, False
-    for ch in s:
-        if esc:
-            cur.append(ch)
-            esc = False
-        elif ch == "\\" and inq:
-            cur.append(ch)
-            esc = True
-        elif ch == '"':
-            cur.append(ch)
-            inq = not inq
-        elif ch == sep and not inq:
-            out.append("".join(cur))
-            cur = []
-        else:
-            cur.append(ch)
-    out.append("".join(cur))
-    return out
-
-
-def _jq_tokens(prog: str):
-    """Split a jq program into pipe stages of comma branches of step
-    lists; each step is ('field', name) | ('index', i) | ('iter',)."""
-    import re as _re
-
-    stages = []
-    for stage in _jq_split(prog, "|"):
-        branches = []
-        for branch in _jq_split(stage, ","):
-            branch = branch.strip()
-            if branch in (".", ""):
-                branches.append([])
-                continue
-            steps = []
-            pos = 0
-            if not branch.startswith("."):
-                raise ValueError(f"jq: bad expression {branch!r}")
-            for m in _re.finditer(
-                r"\.([A-Za-z_][A-Za-z0-9_]*)"      # .field
-                r"|\[\s*\"((?:[^\"\\]|\\.)*)\"\s*\]"  # ["key"]
-                r"|\[\s*(-?\d+)\s*\]"               # [idx]
-                r"|\[\s*\]"                         # [] iterate
-                r"|\.",                             # bare dot
-                branch,
-            ):
-                if m.start() != pos:
-                    raise ValueError(f"jq: bad expression {branch!r}")
-                pos = m.end()
-                if m.group(1) is not None:
-                    steps.append(("field", m.group(1)))
-                elif m.group(2) is not None:
-                    steps.append(("field", m.group(2)
-                                  .replace('\\"', '"').replace("\\\\", "\\")))
-                elif m.group(3) is not None:
-                    steps.append(("index", int(m.group(3))))
-                elif m.group(0).strip().startswith("["):
-                    steps.append(("iter",))
-            if pos != len(branch):
-                raise ValueError(f"jq: bad expression {branch!r}")
-            branches.append(steps)
-        stages.append(branches)
-    return stages
-
-
-def _jq_step(values: List[Any], step) -> List[Any]:
-    out: List[Any] = []
-    for v in values:
-        if step[0] == "field":
-            out.append(v.get(step[1]) if isinstance(v, dict) else None)
-        elif step[0] == "index":
-            try:
-                out.append(v[step[1]] if isinstance(v, list) else None)
-            except IndexError:
-                out.append(None)
-        else:  # iter
-            if isinstance(v, list):
-                out.extend(v)
-            elif isinstance(v, dict):
-                out.extend(v.values())
-            else:
-                raise ValueError("jq: cannot iterate non-collection")
-    return out
-
+# The reference binds libjq through a NIF (SURVEY.md §2.4); ours is the
+# in-repo evaluator (`rule_engine/jq.py`): jq generator semantics —
+# paths/slices/iteration with `?`, array/object construction, operators
+# (`|`, `,`, `//`, and/or, comparisons, arithmetic), if/then/elif/else,
+# and the common builtins.  Always returns the list of outputs,
+# matching the reference's jq/2 contract; string/bytes input is parsed
+# as JSON first (the rule-engine calling convention).
 
 def _jq(prog: Any, value: Any) -> List[Any]:
+    from .jq import jq_eval
+
     if isinstance(value, (bytes, str)):
         try:
             value = json.loads(_str(value))
         except json.JSONDecodeError:
             raise ValueError("jq: input is not JSON")
-    values = [value]
-    for branches in _jq_tokens(_str(prog)):
-        nxt: List[Any] = []
-        for steps in branches:
-            branch_vals = values
-            for step in steps:
-                branch_vals = _jq_step(branch_vals, step)
-            nxt.extend(branch_vals)
-        values = nxt
-    return values
+    return jq_eval(_str(prog), value)
 
 
 FUNCS.update({"jq": _jq})

@@ -1,0 +1,822 @@
+"""jq expression evaluator — the libjq-NIF analog (SURVEY.md §2.4).
+
+The reference embeds libjq for its rule-engine ``jq/2`` SQL function;
+this is an independent, dependency-free implementation of the jq
+language core with jq's GENERATOR semantics: every expression maps one
+input to a STREAM of outputs, ``|`` feeds each output of the left side
+through the right side, ``,`` concatenates streams, and constructions
+([], {}) take the cartesian product of their parts' streams — so
+``{a: .xs[]}`` fans out into one object per array element, exactly like
+real jq.
+
+Supported (the surface rule engines actually use):
+
+* paths: ``.a.b``, ``.["key"]``, ``.[0]``, negatives, slices
+  ``.[2:5]``, iteration ``.[]``, optional forms ``.a?``/``.[]?``,
+  postfix chains on any expression (``(.a)[0]``, ``.users[].name``);
+* literals (numbers, strings, ``true/false/null``), array construction
+  ``[...]``, object construction ``{a: expr, "k": expr, shorthand}``;
+* operators: ``|``, ``,``, ``//`` (alternative: truthy outputs of the
+  left, else the right; errors on the left also fall through),
+  ``and``/``or``, ``== != < <= > >=``, ``+ - * / %``, unary ``-``;
+* ``if COND then A elif B else C end`` (condition is a generator:
+  every output selects a branch, jq-style; ``else`` defaults to ``.``);
+* builtins: length, keys, values, type, add, floor, ceil, sqrt, abs,
+  tostring, tonumber, ascii_downcase, ascii_upcase, reverse, sort,
+  sort_by(f), unique, join(s), split(s), map(f), select(f), has(k),
+  contains(x), startswith(s), endswith(s), ltrimstr(s), rtrimstr(s),
+  test(re), first, last, min, max, empty, not, error, error(msg),
+  range(n), range(lo;hi), to_entries, from_entries.
+
+Out of scope (documented, erroring loudly rather than mis-evaluating):
+variable bindings (``as``), ``reduce``/``foreach``, ``def``,
+``try/catch`` (use ``?``), recursion (``..``), string interpolation,
+and regex capture builtins beyond ``test``.
+
+jq's comparison/sort total order (null < false < true < numbers <
+strings < arrays < objects) is implemented so ``sort``/``min``/``max``
+/``<`` agree with real jq on mixed types.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Any, List, Optional, Tuple
+
+__all__ = ["jq_eval", "JqError"]
+
+
+class JqError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
+  | (?P<str>"(?:[^"\\]|\\.)*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<punct>\.\.|//|==|!=|<=|>=|\||,|\.|\[|\]|\{|\}|\(|\)|:|;|\?|<|>|\+|-|\*|/|%)
+""", re.VERBOSE)
+
+_KEYWORDS = {"if", "then", "elif", "else", "end", "and", "or",
+             "true", "false", "null"}
+
+
+def _lex(src: str) -> List[Tuple[str, str]]:
+    toks: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if not m:
+            raise JqError(f"jq: bad character {src[pos]!r} at {pos}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        toks.append((kind, m.group()))
+    toks.append(("eof", ""))
+    return toks
+
+
+def _unquote(s: str) -> str:
+    try:
+        return json.loads(s)
+    except json.JSONDecodeError:
+        raise JqError(f"jq: bad string literal {s}")
+
+
+# ---------------------------------------------------------------------------
+# parser — precedence: | , // or and cmp add mul unary postfix primary
+# ---------------------------------------------------------------------------
+
+class _Parser:
+    def __init__(self, toks: List[Tuple[str, str]]) -> None:
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> Tuple[str, str]:
+        return self.toks[self.i]
+
+    def next(self) -> Tuple[str, str]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def eat(self, text: str) -> bool:
+        if self.toks[self.i][1] == text and self.toks[self.i][0] in (
+                "punct", "ident"):
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, text: str) -> None:
+        if not self.eat(text):
+            raise JqError(f"jq: expected {text!r}, got "
+                          f"{self.toks[self.i][1]!r}")
+
+    # precedence ladder ----------------------------------------------------
+
+    def parse_pipe(self):
+        left = self.parse_comma()
+        while self.eat("|"):
+            left = ("pipe", left, self.parse_comma())
+        return left
+
+    def parse_comma(self):
+        parts = [self.parse_alt()]
+        while self.eat(","):
+            parts.append(self.parse_alt())
+        return parts[0] if len(parts) == 1 else ("comma", parts)
+
+    def parse_alt(self):
+        left = self.parse_or()
+        while self.eat("//"):
+            left = ("alt", left, self.parse_or())
+        return left
+
+    def parse_or(self):
+        left = self.parse_and()
+        while self.peek() == ("ident", "or"):
+            self.next()
+            left = ("or", left, self.parse_and())
+        return left
+
+    def parse_and(self):
+        left = self.parse_cmp()
+        while self.peek() == ("ident", "and"):
+            self.next()
+            left = ("and", left, self.parse_cmp())
+        return left
+
+    def parse_cmp(self):
+        left = self.parse_add()
+        if self.peek()[1] in ("==", "!=", "<", "<=", ">", ">=") \
+                and self.peek()[0] == "punct":
+            op = self.next()[1]
+            return ("cmp", op, left, self.parse_add())
+        return left
+
+    def parse_add(self):
+        left = self.parse_mul()
+        while self.peek() in (("punct", "+"), ("punct", "-")):
+            op = self.next()[1]
+            left = ("arith", op, left, self.parse_mul())
+        return left
+
+    def parse_mul(self):
+        left = self.parse_unary()
+        while self.peek() in (("punct", "*"), ("punct", "/"),
+                              ("punct", "%")):
+            op = self.next()[1]
+            left = ("arith", op, left, self.parse_unary())
+        return left
+
+    def parse_unary(self):
+        if self.eat("-"):
+            return ("neg", self.parse_postfix())
+        return self.parse_postfix()
+
+    def parse_postfix(self):
+        node = self.parse_primary()
+        return self._postfix_chain(node)
+
+    def _postfix_chain(self, node):
+        while True:
+            kind, text = self.peek()
+            if text == "." and self.toks[self.i + 1][0] == "ident" \
+                    and self.toks[self.i + 1][1] not in _KEYWORDS:
+                self.next()
+                name = self.next()[1]
+                node = ("field", node, ("lit", name), self.eat("?"))
+            elif text == "." and self.toks[self.i + 1][1] == "[" \
+                    and self.toks[self.i + 1][0] == "punct":
+                self.next()     # jq accepts .a.["k"] / .a.[] / .a.[0]:
+                continue        # swallow the dot, bracket handled next
+            elif text == "[" and kind == "punct":
+                self.next()
+                if self.eat("]"):
+                    node = ("iter", node, self.eat("?"))
+                elif self.eat(":"):
+                    hi = self.parse_pipe()
+                    self.expect("]")
+                    node = ("slice", node, None, hi, self.eat("?"))
+                else:
+                    idx = self.parse_pipe()
+                    if self.eat(":"):
+                        hi = None if self.peek()[1] == "]" \
+                            else self.parse_pipe()
+                        self.expect("]")
+                        node = ("slice", node, idx, hi, self.eat("?"))
+                    else:
+                        self.expect("]")
+                        node = ("indexe", node, idx, self.eat("?"))
+            else:
+                return node
+
+    def parse_primary(self):
+        kind, text = self.peek()
+        if text == "." and kind == "punct":
+            nk, nt = self.toks[self.i + 1]
+            if nk == "ident" and nt not in _KEYWORDS:
+                return ("identity",)     # postfix chain consumes .field
+            self.next()                  # bare "." / ".[...]": consume
+            return ("dot",)              # the dot; postfix sees the "["
+        if text == ".." and kind == "punct":
+            raise JqError("jq: recursive descent (..) not supported")
+        if kind == "num":
+            self.next()
+            return ("lit", float(text) if "." in text or "e" in text
+                    or "E" in text else int(text))
+        if kind == "str":
+            self.next()
+            return ("lit", _unquote(text))
+        if kind == "ident":
+            if text == "true":
+                self.next(); return ("lit", True)
+            if text == "false":
+                self.next(); return ("lit", False)
+            if text == "null":
+                self.next(); return ("lit", None)
+            if text == "if":
+                return self.parse_if()
+            if text in ("then", "elif", "else", "end", "and", "or"):
+                raise JqError(f"jq: unexpected keyword {text!r}")
+            if text in ("as", "reduce", "foreach", "def", "try", "catch",
+                        "label", "import", "include"):
+                raise JqError(f"jq: {text!r} is not supported")
+            self.next()
+            if self.eat("("):
+                args = [self.parse_pipe()]
+                while self.eat(";"):
+                    args.append(self.parse_pipe())
+                self.expect(")")
+                return ("call", text, args)
+            return ("call", text, [])
+        if text == "(":
+            self.next()
+            node = self.parse_pipe()
+            self.expect(")")
+            return node
+        if text == "[":
+            self.next()
+            if self.eat("]"):
+                return ("array", None)
+            node = self.parse_pipe()
+            self.expect("]")
+            return ("array", node)
+        if text == "{":
+            self.next()
+            entries = []
+            if not self.eat("}"):
+                while True:
+                    entries.append(self.parse_obj_entry())
+                    if not self.eat(","):
+                        break
+                self.expect("}")
+            return ("object", entries)
+        raise JqError(f"jq: unexpected token {text!r}")
+
+    def parse_obj_entry(self):
+        kind, text = self.peek()
+        if kind == "ident" and text not in _KEYWORDS:
+            self.next()
+            if self.eat(":"):
+                return (("lit", text), self.parse_alt())
+            return (("lit", text), ("field", ("dot",), ("lit", text),
+                                    False))
+        if kind == "str":
+            self.next()
+            key = _unquote(text)
+            if self.eat(":"):
+                return (("lit", key), self.parse_alt())
+            return (("lit", key), ("field", ("dot",), ("lit", key), False))
+        if text == "(":
+            self.next()
+            keyexpr = self.parse_pipe()
+            self.expect(")")
+            self.expect(":")
+            return (keyexpr, self.parse_alt())
+        raise JqError(f"jq: bad object key {text!r}")
+
+    def parse_if(self):
+        self.expect("if")
+        cond = self.parse_pipe()
+        self.expect("then")
+        then = self.parse_pipe()
+        elifs = []
+        while self.eat("elif"):
+            c = self.parse_pipe()
+            self.expect("then")
+            elifs.append((c, self.parse_pipe()))
+        els = self.parse_pipe() if self.eat("else") else ("dot",)
+        self.expect("end")
+        # desugar elifs into nested ifs: eval handles one cond/then/else
+        for c, t in reversed(elifs):
+            els = ("if", c, t, els)
+        return ("if", cond, then, els)
+
+
+def _parse(src: str):
+    p = _Parser(_lex(src))
+    node = p.parse_pipe()
+    if p.peek()[0] != "eof":
+        raise JqError(f"jq: trailing input at {p.peek()[1]!r}")
+    return node
+
+
+# ---------------------------------------------------------------------------
+# evaluation — eval(node, input) -> list of outputs
+# ---------------------------------------------------------------------------
+
+def _truthy(v: Any) -> bool:
+    return v is not None and v is not False
+
+
+_TYPE_ORDER = {"null": 0, "boolean": 1, "number": 2, "string": 3,
+               "array": 4, "object": 5}
+
+
+def _jq_type(v: Any) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "boolean"
+    if isinstance(v, (int, float)):
+        return "number"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, list):
+        return "array"
+    if isinstance(v, dict):
+        return "object"
+    raise JqError(f"jq: unsupported value {type(v).__name__}")
+
+
+def _cmp(a: Any, b: Any) -> int:
+    """jq total order: null < false < true < numbers < strings < arrays
+    < objects."""
+    ta, tb = _jq_type(a), _jq_type(b)
+    if ta != tb:
+        return -1 if _TYPE_ORDER[ta] < _TYPE_ORDER[tb] else 1
+    if ta == "null":
+        return 0
+    if ta == "boolean":
+        return (a > b) - (a < b)
+    if ta in ("number", "string"):
+        return (a > b) - (a < b)
+    if ta == "array":
+        for x, y in zip(a, b):
+            c = _cmp(x, y)
+            if c:
+                return c
+        return (len(a) > len(b)) - (len(a) < len(b))
+    # object: compare sorted key arrays, then values in key order
+    ka, kb = sorted(a), sorted(b)
+    c = _cmp(ka, kb)
+    if c:
+        return c
+    for k in ka:
+        c = _cmp(a[k], b[k])
+        if c:
+            return c
+    return 0
+
+
+def _num(v: Any, op: str) -> float:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise JqError(f"jq: {_jq_type(v)} and number cannot be {op}")
+    return v
+
+
+def _arith(op: str, a: Any, b: Any) -> Any:
+    if op == "+":
+        if a is None:
+            return b
+        if b is None:
+            return a
+        if isinstance(a, str) and isinstance(b, str):
+            return a + b
+        if isinstance(a, list) and isinstance(b, list):
+            return a + b
+        if isinstance(a, dict) and isinstance(b, dict):
+            out = dict(a)
+            out.update(b)
+            return out
+        return _num(a, "added") + _num(b, "added")
+    if op == "-":
+        if isinstance(a, list) and isinstance(b, list):
+            return [x for x in a if not any(_cmp(x, y) == 0 for y in b)]
+        return _num(a, "subtracted") - _num(b, "subtracted")
+    if op == "*":
+        if isinstance(a, str) and isinstance(b, (int, float)) \
+                and not isinstance(b, bool):
+            return a * int(b) if b > 0 else None
+        return _num(a, "multiplied") * _num(b, "multiplied")
+    if op == "/":
+        if isinstance(a, str) and isinstance(b, str):
+            return a.split(b)
+        d = _num(b, "divided")
+        if d == 0:
+            raise JqError("jq: division by zero")
+        r = _num(a, "divided") / d
+        return int(r) if isinstance(a, int) and isinstance(b, int) \
+            and a % b == 0 else r
+    if op == "%":
+        d = int(_num(b, "divided"))
+        if d == 0:
+            raise JqError("jq: division by zero")
+        n = int(_num(a, "divided"))
+        r = abs(n) % abs(d)          # jq: sign follows the dividend
+        return -r if n < 0 else r
+    raise JqError(f"jq: unknown operator {op}")
+
+
+def _index(v: Any, idx: Any, opt: bool) -> List[Any]:
+    try:
+        if v is None:
+            return [None]
+        if isinstance(v, dict):
+            if not isinstance(idx, str):
+                raise JqError(
+                    f"jq: cannot index object with {_jq_type(idx)}")
+            return [v.get(idx)]
+        if isinstance(v, list):
+            if isinstance(idx, bool) or not isinstance(idx, (int, float)):
+                raise JqError(
+                    f"jq: cannot index array with {_jq_type(idx)}")
+            i = int(idx)
+            if -len(v) <= i < len(v):
+                return [v[i]]
+            return [None]
+        raise JqError(f"jq: cannot index {_jq_type(v)}")
+    except JqError:
+        if opt:
+            return []
+        raise
+
+
+def _slice(v: Any, lo: Any, hi: Any, opt: bool) -> List[Any]:
+    try:
+        if v is None:
+            return [None]
+        if not isinstance(v, (list, str)):
+            raise JqError(f"jq: cannot slice {_jq_type(v)}")
+        lo_i = None if lo is None else int(lo)
+        hi_i = None if hi is None else int(hi)
+        return [v[lo_i:hi_i]]
+    except JqError:
+        if opt:
+            return []
+        raise
+
+
+def _eval(node, v: Any) -> List[Any]:
+    tag = node[0]
+    if tag in ("dot", "identity"):
+        return [v]
+    if tag == "lit":
+        return [node[1]]
+    if tag == "pipe":
+        out: List[Any] = []
+        for x in _eval(node[1], v):
+            out.extend(_eval(node[2], x))
+        return out
+    if tag == "comma":
+        out = []
+        for part in node[1]:
+            out.extend(_eval(part, v))
+        return out
+    if tag == "alt":
+        try:
+            good = [x for x in _eval(node[1], v) if _truthy(x)]
+        except JqError:
+            good = []
+        return good if good else _eval(node[2], v)
+    if tag == "or":
+        out = []
+        for a in _eval(node[1], v):
+            if _truthy(a):
+                out.append(True)
+            else:
+                out.extend(_truthy(b) for b in _eval(node[2], v))
+        return out
+    if tag == "and":
+        out = []
+        for a in _eval(node[1], v):
+            if not _truthy(a):
+                out.append(False)
+            else:
+                out.extend(_truthy(b) for b in _eval(node[2], v))
+        return out
+    if tag == "cmp":
+        op = node[1]
+        out = []
+        for a in _eval(node[2], v):
+            for b in _eval(node[3], v):
+                c = _cmp(a, b)
+                out.append({"==": c == 0, "!=": c != 0, "<": c < 0,
+                            "<=": c <= 0, ">": c > 0, ">=": c >= 0}[op])
+        return out
+    if tag == "arith":
+        out = []
+        for a in _eval(node[2], v):
+            for b in _eval(node[3], v):
+                out.append(_arith(node[1], a, b))
+        return out
+    if tag == "neg":
+        return [-_num(x, "negated") for x in _eval(node[1], v)]
+    if tag == "field":
+        opt = node[3]
+        out = []
+        for base in _eval(node[1], v):
+            out.extend(_index(base, node[2][1], opt))
+        return out
+    if tag == "indexe":
+        opt = node[3]
+        out = []
+        for base in _eval(node[1], v):
+            for idx in _eval(node[2], v):
+                out.extend(_index(base, idx, opt))
+        return out
+    if tag == "slice":
+        _, base_n, lo_n, hi_n, opt = node
+        out = []
+        for base in _eval(base_n, v):
+            los = [None] if lo_n is None else _eval(lo_n, v)
+            his = [None] if hi_n is None else _eval(hi_n, v)
+            for lo in los:
+                for hi in his:
+                    out.extend(_slice(base, lo, hi, opt))
+        return out
+    if tag == "iter":
+        opt = node[2]
+        out = []
+        for base in _eval(node[1], v):
+            if isinstance(base, list):
+                out.extend(base)
+            elif isinstance(base, dict):
+                out.extend(base.values())
+            elif not opt:
+                raise JqError(
+                    f"jq: cannot iterate over {_jq_type(base)}")
+        return out
+    if tag == "array":
+        if node[1] is None:
+            return [[]]
+        return [list(_eval(node[1], v))]
+    if tag == "object":
+        results: List[dict] = [{}]
+        for keyexpr, valexpr in node[1]:
+            nxt = []
+            for partial in results:
+                for k in _eval(keyexpr, v):
+                    if not isinstance(k, str):
+                        raise JqError(
+                            f"jq: object key must be string, got "
+                            f"{_jq_type(k)}")
+                    for val in _eval(valexpr, v):
+                        d = dict(partial)
+                        d[k] = val
+                        nxt.append(d)
+            results = nxt
+        return results
+    if tag == "if":
+        _, cond, then, els = node
+        out = []
+        for c in _eval(cond, v):
+            out.extend(_eval(then if _truthy(c) else els, v))
+        return out
+    if tag == "call":
+        return _call(node[1], node[2], v)
+    raise JqError(f"jq: internal: unknown node {tag}")
+
+
+def _call(name: str, args: List[Any], v: Any) -> List[Any]:
+    n = len(args)
+
+    def one(i):
+        outs = _eval(args[i], v)
+        if len(outs) != 1:
+            raise JqError(f"jq: {name} argument must yield one value")
+        return outs[0]
+
+    if name == "empty" and n == 0:
+        return []
+    if name == "error":
+        raise JqError(f"jq: error: {one(0) if n else v}")
+    if name == "length" and n == 0:
+        if v is None:
+            return [0]
+        if isinstance(v, bool):
+            raise JqError("jq: boolean has no length")
+        if isinstance(v, (int, float)):
+            return [abs(v)]
+        return [len(v)]
+    if name == "keys" and n == 0:
+        if isinstance(v, dict):
+            return [sorted(v)]
+        if isinstance(v, list):
+            return [list(range(len(v)))]
+        raise JqError(f"jq: {_jq_type(v)} has no keys")
+    if name == "values" and n == 0:   # jq: values == select(. != null)
+        return [] if v is None else [v]
+    if name == "type" and n == 0:
+        return [_jq_type(v)]
+    if name == "add" and n == 0:
+        if not isinstance(v, list):
+            raise JqError("jq: add needs an array")
+        if not v:
+            return [None]
+        acc = v[0]
+        for x in v[1:]:
+            acc = _arith("+", acc, x)
+        return [acc]
+    if name in ("floor", "ceil", "sqrt", "abs") and n == 0:
+        x = _num(v, name)
+        return [{"floor": math.floor, "ceil": math.ceil,
+                 "sqrt": math.sqrt, "abs": abs}[name](x)]
+    if name == "tostring" and n == 0:
+        return [v if isinstance(v, str)
+                else json.dumps(v, separators=(",", ":"))]
+    if name == "tonumber" and n == 0:
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return [v]
+        if isinstance(v, str):
+            try:
+                f = float(v)
+                return [int(f) if f.is_integer() and "." not in v
+                        and "e" not in v.lower() else f]
+            except ValueError:
+                pass
+        raise JqError(f"jq: cannot parse {v!r} as number")
+    if name == "ascii_downcase" and n == 0:
+        return [str(v).lower() if isinstance(v, str) else _bad(name, v)]
+    if name == "ascii_upcase" and n == 0:
+        return [str(v).upper() if isinstance(v, str) else _bad(name, v)]
+    if name == "reverse" and n == 0:
+        if isinstance(v, (list, str)):
+            return [v[::-1]]
+        raise JqError(f"jq: cannot reverse {_jq_type(v)}")
+    if name == "sort" and n == 0:
+        if not isinstance(v, list):
+            raise JqError("jq: sort needs an array")
+        return [sorted(v, key=_SortKey)]
+    if name == "sort_by" and n == 1:
+        if not isinstance(v, list):
+            raise JqError("jq: sort_by needs an array")
+        return [sorted(v, key=lambda x: _SortKey(
+            _eval(args[0], x)[0] if _eval(args[0], x) else None))]
+    if name == "unique" and n == 0:
+        if not isinstance(v, list):
+            raise JqError("jq: unique needs an array")
+        out: List[Any] = []
+        for x in sorted(v, key=_SortKey):
+            if not out or _cmp(out[-1], x) != 0:
+                out.append(x)
+        return [out]
+    if name == "join" and n == 1:
+        sep = one(0)
+        if not isinstance(v, list):
+            raise JqError("jq: join needs an array")
+        return [str(sep).join(
+            "" if x is None else x if isinstance(x, str)
+            else json.dumps(x, separators=(",", ":")) for x in v)]
+    if name == "split" and n == 1:
+        if not isinstance(v, str):
+            raise JqError("jq: split needs a string")
+        return [v.split(one(0))]
+    if name == "map" and n == 1:
+        if not isinstance(v, list):
+            raise JqError("jq: map needs an array")
+        out = []
+        for x in v:
+            out.extend(_eval(args[0], x))
+        return [out]
+    if name == "select" and n == 1:
+        out = []
+        for c in _eval(args[0], v):
+            if _truthy(c):
+                out.append(v)
+        return out
+    if name == "has" and n == 1:
+        k = one(0)
+        if isinstance(v, dict):
+            return [k in v]
+        if isinstance(v, list):
+            return [isinstance(k, (int, float)) and 0 <= int(k) < len(v)]
+        raise JqError(f"jq: cannot check has() on {_jq_type(v)}")
+    if name == "contains" and n == 1:
+        return [_contains(v, one(0))]
+    if name in ("startswith", "endswith") and n == 1:
+        s = one(0)
+        if not isinstance(v, str) or not isinstance(s, str):
+            raise JqError(f"jq: {name} needs strings")
+        return [v.startswith(s) if name == "startswith"
+                else v.endswith(s)]
+    if name in ("ltrimstr", "rtrimstr") and n == 1:
+        s = one(0)
+        if not isinstance(v, str) or not isinstance(s, str):
+            return [v]
+        if name == "ltrimstr":
+            return [v[len(s):] if v.startswith(s) else v]
+        return [v[:len(v) - len(s)] if s and v.endswith(s) else v]
+    if name == "test" and n == 1:
+        if not isinstance(v, str):
+            raise JqError("jq: test needs a string input")
+        return [re.search(one(0), v) is not None]
+    if name == "first" and n == 0:
+        if not isinstance(v, list):
+            raise JqError("jq: first needs an array")
+        if not v:
+            raise JqError("jq: first on empty array")
+        return [v[0]]
+    if name == "last" and n == 0:
+        if not isinstance(v, list):
+            raise JqError("jq: last needs an array")
+        if not v:
+            raise JqError("jq: last on empty array")
+        return [v[-1]]
+    if name in ("min", "max") and n == 0:
+        if not isinstance(v, list):
+            raise JqError(f"jq: {name} needs an array")
+        if not v:
+            return [None]
+        pick = min if name == "min" else max
+        return [pick(v, key=_SortKey)]
+    if name == "not" and n == 0:
+        return [not _truthy(v)]
+    if name == "range":
+        if n == 1:
+            return list(_frange(0, one(0)))
+        if n == 2:
+            return list(_frange(one(0), one(1)))
+    if name == "to_entries" and n == 0:
+        if not isinstance(v, dict):
+            raise JqError("jq: to_entries needs an object")
+        return [[{"key": k, "value": val} for k, val in v.items()]]
+    if name == "from_entries" and n == 0:
+        if not isinstance(v, list):
+            raise JqError("jq: from_entries needs an array")
+        out_d = {}
+        for e in v:
+            if not isinstance(e, dict):
+                raise JqError("jq: from_entries entry must be object")
+            k = e.get("key", e.get("k", e.get("name")))
+            out_d[str(k)] = e.get("value", e.get("v"))
+        return [out_d]
+    raise JqError(f"jq: unknown function {name}/{n}")
+
+
+def _bad(name: str, v: Any):
+    raise JqError(f"jq: {name} needs a string, got {_jq_type(v)}")
+
+
+def _contains(a: Any, b: Any) -> bool:
+    if isinstance(a, str) and isinstance(b, str):
+        return b in a
+    if isinstance(a, list) and isinstance(b, list):
+        return all(any(_contains(x, y) for x in a) for y in b)
+    if isinstance(a, dict) and isinstance(b, dict):
+        return all(k in a and _contains(a[k], bv) for k, bv in b.items())
+    return _cmp(a, b) == 0
+
+
+def _frange(lo: Any, hi: Any):
+    x = _num(lo, "ranged")
+    hi = _num(hi, "ranged")
+    while x < hi:
+        yield int(x) if float(x).is_integer() else x
+        x += 1
+
+
+class _SortKey:
+    __slots__ = ("v",)
+
+    def __init__(self, v: Any) -> None:
+        self.v = v
+
+    def __lt__(self, other: "_SortKey") -> bool:
+        return _cmp(self.v, other.v) < 0
+
+
+_PARSE_CACHE: dict = {}
+
+
+def jq_eval(prog: str, value: Any,
+            max_cache: int = 256) -> List[Any]:
+    """Evaluate jq ``prog`` against ``value`` → list of outputs (jq's
+    output stream).  Programs are parse-cached (rules re-run the same
+    program per message)."""
+    node = _PARSE_CACHE.get(prog)
+    if node is None:
+        node = _parse(prog)
+        if len(_PARSE_CACHE) >= max_cache:
+            _PARSE_CACHE.clear()
+        _PARSE_CACHE[prog] = node
+    return _eval(node, value)

@@ -215,27 +215,40 @@ class _Conn:
 
     # -- send ----------------------------------------------------------
 
-    def _flush_level(self, level: str) -> Optional[bytes]:
+    def _flush_level(self, level: str) -> List[bytes]:
         keys = self._send_keys(level)
         if keys is None:
             # keys not derived yet (e.g. app data queued mid-handshake):
             # leave the frames AND the ack-due flag queued — they flush
             # on the next _service() after key derivation, instead of
             # being silently discarded
-            return None
+            return []
         frames = self._pending_frames[level]
         if self._ack_due[level] and self._recv_pns[level]:
             frames.insert(0, FR.encode_ack(self._recv_pns[level]))
             self._ack_due[level] = False
         if not frames:
-            return None
-        payload = b"".join(frames)
+            return []
         self._pending_frames[level] = []
-        pn = self._next_pn[level]
-        self._next_pn[level] += 1
+        # greedy frame grouping under the MTU payload budget: frames
+        # queued while keys were absent must NOT merge into one
+        # oversized packet (send_stream's segmentation would be undone)
+        groups: List[List[bytes]] = [[]]
+        size = 0
+        for fr in frames:
+            if groups[-1] and size + len(fr) > self._MTU_STREAM_CHUNK:
+                groups.append([])
+                size = 0
+            groups[-1].append(fr)
+            size += len(fr)
         kind = _PKT_OF_LEVEL[level]
-        return protect(kind, keys, pn, payload,
-                       dcid=self.remote_cid, scid=self.scid)
+        out = []
+        for group in groups:
+            pn = self._next_pn[level]
+            self._next_pn[level] += 1
+            out.append(protect(kind, keys, pn, b"".join(group),
+                               dcid=self.remote_cid, scid=self.scid))
+        return out
 
     def _service(self) -> None:
         """Drain TLS output + pending frames into coalesced datagrams."""
@@ -250,18 +263,21 @@ class _Conn:
                 bytes([FR.HANDSHAKE_DONE]))
             self.handshake_done = True
         parts: List[bytes] = []
+        extra_dgrams: List[bytes] = []
         app_pkt: Optional[bytes] = None
         has_initial = bool(self._pending_frames[LEVEL_INITIAL]) \
             or self._ack_due[LEVEL_INITIAL]
-        for level in (LEVEL_INITIAL, LEVEL_HANDSHAKE, LEVEL_APP):
-            pkt = self._flush_level(level)
-            if pkt is None:
-                continue
-            if level == LEVEL_APP:
-                app_pkt = pkt       # short header: MUST stay last (no
-            else:                   # length field — nothing may follow)
-                parts.append(pkt)
-        if not parts and app_pkt is None:
+        for level in (LEVEL_INITIAL, LEVEL_HANDSHAKE):
+            pkts = self._flush_level(level)
+            if pkts:
+                parts.append(pkts[0])
+                extra_dgrams.extend(pkts[1:])   # each under the MTU
+        app_pkts = self._flush_level(LEVEL_APP)
+        if app_pkts:
+            app_pkt = app_pkts[0]   # short header: MUST stay last in a
+            extra_dgrams.extend(app_pkts[1:])   # datagram (no length
+        if not parts and app_pkt is None:       # field) — spares ride
+            self._out_datagrams.extend(extra_dgrams)    # solo
             return
         total = sum(map(len, parts)) + (len(app_pkt) if app_pkt else 0)
         if has_initial and total < 1200:
@@ -277,6 +293,7 @@ class _Conn:
         if app_pkt is not None:
             parts.append(app_pkt)
         self._out_datagrams.append(b"".join(parts))
+        self._out_datagrams.extend(extra_dgrams)
 
     def _make_padding(self, n: int, allow_short: bool = True) -> bytes:
         """A PADDING-only packet bringing the datagram to the 1200-byte
@@ -293,15 +310,29 @@ class _Conn:
                 continue
             pn = self._next_pn[level]
             kind = _PKT_OF_LEVEL[level]
-            # probe: exact per-level overhead (header + AEAD tag) so the
-            # pad lands exactly on the floor, never under it
+            # probe: per-level overhead (header + AEAD tag) so the pad
+            # lands on the floor.  The probe's 1-byte payload encodes a
+            # 1-byte length varint; the real pad's length field can need
+            # 2 bytes (length > 63), overshooting by one — rebuild once
+            # with the measured delta so the datagram is EXACTLY 1200,
+            # never 1201 (the max-safe-MTU assumption).  Only the final
+            # ciphertext leaves the host, so reusing pn for the probes
+            # discloses nothing.
             overhead = len(protect(kind, keys, pn, b"\x00",
                                    dcid=self.remote_cid,
                                    scid=self.scid)) - 1
             self._next_pn[level] += 1
             payload = b"\x00" * max(1, n - overhead)
-            return protect(kind, keys, pn, payload,
-                           dcid=self.remote_cid, scid=self.scid)
+            pkt = protect(kind, keys, pn, payload,
+                          dcid=self.remote_cid, scid=self.scid)
+            for _ in range(3):      # varint-boundary convergence
+                delta = len(pkt) - n
+                if delta == 0 or len(payload) - delta < 1:
+                    break
+                payload = b"\x00" * (len(payload) - delta)
+                pkt = protect(kind, keys, pn, payload,
+                              dcid=self.remote_cid, scid=self.scid)
+            return pkt
         return b""
 
     def take_outgoing(self) -> List[bytes]:

@@ -1,0 +1,194 @@
+"""jq evaluator (`rule_engine/jq.py`) — expected outputs hand-checked
+against real jq 1.7 behavior (generator semantics, total order,
+operator table)."""
+
+import pytest
+
+from emqx_tpu.rule_engine.jq import JqError, jq_eval
+
+DOC = {
+    "user": {"name": "ada", "tags": ["ops", "dev"], "age": 36},
+    "xs": [1, 2, 3],
+    "pairs": [{"k": "a", "v": 1}, {"k": "b", "v": 2}],
+    "weird key": 7,
+    "n": None,
+}
+
+
+CASES = [
+    # paths
+    (".", DOC, [DOC]),
+    (".user.name", DOC, ["ada"]),
+    ('.["weird key"]', DOC, [7]),
+    (".xs[0]", DOC, [1]),
+    (".xs[-1]", DOC, [3]),
+    (".xs[7]", DOC, [None]),
+    (".missing", DOC, [None]),
+    (".missing.deeper", DOC, [None]),       # null propagates
+    (".xs[]", DOC, [1, 2, 3]),
+    (".user.tags[]", DOC, ["ops", "dev"]),
+    (".xs[1:]", DOC, [[2, 3]]),
+    (".xs[:2]", DOC, [[1, 2]]),
+    (".xs[1:2]", DOC, [[2]]),
+    # optional
+    (".user.name?", DOC, ["ada"]),
+    (".n[]?", DOC, []),
+    ('.user | .name', DOC, ["ada"]),
+    # comma + pipe
+    (".user.name, .xs[0]", DOC, ["ada", 1]),
+    (".xs[] | . + 10", DOC, [11, 12, 13]),
+    # literals + arithmetic
+    ("1 + 2", None, [3]),
+    ('"a" + "b"', None, ["ab"]),
+    ("[1,2] + [3]", None, [[1, 2, 3]]),
+    ('{"a":1} + {"b":2}', None, [{"a": 1, "b": 2}]),
+    ("null + 5", None, [5]),
+    ("10 - 3", None, [7]),
+    ("[1,2,3] - [2]", None, [[1, 3]]),
+    ("3 * 2.5", None, [7.5]),
+    ("10 / 4", None, [2.5]),
+    ("10 / 5", None, [2]),
+    ('"a,b,c" / ","', None, [["a", "b", "c"]]),
+    ("7 % 3", None, [1]),
+    ("-7 % 3", None, [-1]),                 # jq: sign of the dividend
+    ("- .xs[0]", DOC, [-1]),
+    # comparisons + jq total order
+    ("1 < 2", None, [True]),
+    ('"abc" == "abc"', None, [True]),
+    ("null < false", None, [True]),
+    ("[1,2] < [1,3]", None, [True]),
+    (".xs[0] != 2", DOC, [True]),
+    # and/or/not/alternative
+    ("true and false", None, [False]),
+    ("false or true", None, [True]),
+    ("null // 5", None, [5]),
+    ("false // 5", None, [5]),
+    (".user.name // 5", DOC, ["ada"]),
+    (".missing.x? // 0", DOC, [0]),
+    ("true | not", None, [False]),
+    ("null | not", None, [True]),
+    # constructions (cartesian fan-out)
+    ("[.xs[] * 2]", DOC, [[2, 4, 6]]),
+    ("[]", None, [[]]),
+    ('{"a": 1}', None, [{"a": 1}]),
+    ("{name: .user.name}", DOC, [{"name": "ada"}]),
+    ("{v: .xs[]}", DOC, [{"v": 1}, {"v": 2}, {"v": 3}]),
+    ('{(.user.name): 1}', DOC, [{"ada": 1}]),
+    ("{user} | .user.age", DOC, [36]),      # shorthand key
+    # if/elif/else (generator condition; else defaults to .)
+    ("if .xs[0] == 1 then \"one\" else \"other\" end", DOC, ["one"]),
+    ("if false then 1 elif true then 2 else 3 end", None, [2]),
+    ("if false then 1 elif false then 2 else 3 end", None, [3]),
+    ("5 | if . > 3 then . end", None, [5]),
+    # builtins
+    (".xs | length", DOC, [3]),
+    ('"abcd" | length', None, [4]),
+    ("null | length", None, [0]),
+    (".user | keys", DOC, [["age", "name", "tags"]]),
+    (".xs | keys", DOC, [[0, 1, 2]]),
+    (".n | values", DOC, []),
+    (".xs[0] | type", DOC, ["number"]),
+    (".user | type", DOC, ["object"]),
+    (".xs | add", DOC, [6]),
+    ("[] | add", None, [None]),
+    ('["a","b"] | add', None, ["ab"]),
+    ("3.7 | floor", None, [3]),
+    ("3.2 | ceil", None, [4]),
+    ("9 | sqrt", None, [3.0]),
+    ("-4 | abs", None, [4]),
+    ("42 | tostring", None, ["42"]),
+    ('[1,2] | tostring', None, ["[1,2]"]),
+    ('"42" | tonumber', None, [42]),
+    ('"4.5" | tonumber', None, [4.5]),
+    ('"AbC" | ascii_downcase', None, ["abc"]),
+    ('"AbC" | ascii_upcase', None, ["ABC"]),
+    (".xs | reverse", DOC, [[3, 2, 1]]),
+    ('"abc" | reverse', None, ["cba"]),
+    ("[3,1,2] | sort", None, [[1, 2, 3]]),
+    ('[2, "a", null, true] | sort', None, [[None, True, 2, "a"]]),
+    (".pairs | sort_by(.v) | .[0].k", DOC, ["a"]),
+    ("[3,1,3,2,1] | unique", None, [[1, 2, 3]]),
+    ('.user.tags | join("+")', DOC, ["ops+dev"]),
+    ('"a b c" | split(" ")', None, [["a", "b", "c"]]),
+    (".xs | map(. * 10)", DOC, [[10, 20, 30]]),
+    (".xs[] | select(. > 1)", DOC, [2, 3]),
+    (".pairs | map(select(.v == 2)) | .[0].k", DOC, ["b"]),
+    ('.user | has("name")', DOC, [True]),
+    ('.user | has("zz")', DOC, [False]),
+    (".xs | has(1)", DOC, [True]),
+    ('"hello" | contains("ell")', None, [True]),
+    ('["a","b"] | contains(["a"])', None, [True]),
+    ('"topic/x" | startswith("topic")', None, [True]),
+    ('"topic/x" | endswith("x")', None, [True]),
+    ('"pre-body" | ltrimstr("pre-")', None, ["body"]),
+    ('"body.json" | rtrimstr(".json")', None, ["body"]),
+    ('"dev42" | test("^dev[0-9]+$")', None, [True]),
+    (".xs | first", DOC, [1]),
+    (".xs | last", DOC, [3]),
+    (".xs | min", DOC, [1]),
+    (".xs | max", DOC, [3]),
+    ("[] | min", None, [None]),
+    ("range(3)", None, [0, 1, 2]),
+    ("range(1; 4)", None, [1, 2, 3]),
+    ("empty", None, []),
+    (".xs[] | empty", DOC, []),
+    ('{"a":1} | to_entries', None, [[{"key": "a", "value": 1}]]),
+    ('[{"key":"a","value":1}] | from_entries', None, [{"a": 1}]),
+    # nesting / precedence
+    ("(1 + 2) * 3", None, [9]),
+    (".pairs[] | {(.k): .v} ", DOC, [{"a": 1}, {"b": 2}]),
+    ("[.pairs[].v] | add", DOC, [3]),
+    (".xs[] + .xs[0]", DOC, [2, 3, 4]),     # cartesian over streams
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", CASES,
+                         ids=[c[0] for c in CASES])
+def test_jq_case(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_errors():
+    for prog, doc in [
+        (".xs | .[] | .[]", {"xs": [1]}),   # iterate a number
+        ("1 + \"a\"", None),                # number + string
+        ("1 / 0", None),
+        ("error(\"boom\")", None),
+        ("nosuchfn", None),
+        (".a as $x | $x", {"a": 1}),        # unsupported: variables
+        ("reduce .[] as $x (0; . + $x)", [1, 2]),
+        (". ..", None),
+        ("if true then 1", None),           # missing end
+        ('{"k" 1}', None),                  # bad object syntax
+    ]:
+        with pytest.raises(JqError):
+            jq_eval(prog, doc)
+
+
+def test_jq_error_suppression_forms():
+    assert jq_eval(".[]?", 42) == []
+    assert jq_eval('.["k"]?', 42) == []
+    assert jq_eval(".k? // \"d\"", 42) == ["d"]
+    # alternative swallows left-side errors too (jq semantics)
+    assert jq_eval(".[] // \"d\"", 42) == ["d"]
+
+
+def test_rule_engine_jq_func_still_parses_json_input():
+    from emqx_tpu.rule_engine.funcs import call_func
+
+    out = call_func("jq", ['.a[] | . * 2', '{"a": [1, 2]}'])
+    assert out == [2, 4]
+    out = call_func("jq", ['{sum: (.a | add)}', b'{"a": [3, 4]}'])
+    assert out == [{"sum": 7}]
+    with pytest.raises(ValueError):
+        call_func("jq", [".a", "{not json"])
+
+
+def test_jq_dot_bracket_forms():
+    """Real jq (and the replaced subset) accept a dot before brackets:
+    .a.["k"], .a.[], .a.[0] (review finding, round 5)."""
+    doc = {"a": {"k": 1, "xs": [5, 6]}}
+    assert jq_eval('.a.["k"]', doc) == [1]
+    assert jq_eval(".a.xs.[0]", doc) == [5]
+    assert jq_eval(".a.xs.[]", doc) == [5, 6]
+    assert jq_eval('.["a"].["xs"].[1]', doc) == [6]

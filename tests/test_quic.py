@@ -349,3 +349,41 @@ def test_endpoint_caps_connection_state():
             ep.datagram_received(dg, ("127.0.0.1", 40000 + i))
     assert len(ep.by_cid) == 4                 # 2 conns x 2 cid entries
     assert ep.dropped_initials >= 3
+
+
+def test_frames_queued_before_keys_stay_segmented():
+    """Chunks parked while app keys were absent must flush as multiple
+    MTU-sized packets, not one merged jumbo (review finding, r5)."""
+    client = QuicClient()
+    client.send_stream(b"z" * 5000)        # queued: no 1-RTT keys yet
+    box = [None]
+    pump(client, box)
+    assert client.established
+    assert box[0].pop_stream_data() == b"z" * 5000
+
+
+def test_initial_datagrams_exactly_at_or_above_floor_never_over_mtu():
+    """Padded Initial-bearing datagrams land exactly on 1200, never
+    1201 (varint-boundary probe fix, review finding, r5)."""
+    client = QuicClient()
+    box = [None]
+    for _ in range(12):
+        moved = False
+        for dg in client.take_outgoing():
+            moved = True
+            assert len(dg) <= 1252, len(dg)
+            has_initial = bool(dg[0] & 0x80) and (dg[0] & 0x30) == 0
+            if has_initial:
+                assert len(dg) == 1200, len(dg)
+            if box[0] is None:
+                from emqx_tpu.transport.quic import QuicServerConnection
+                box[0] = QuicServerConnection(dg[6:6 + dg[5]],
+                                              CERT_PEM, KEY_PEM)
+            box[0].receive(dg)
+        if box[0] is not None:
+            for dg in box[0].take_outgoing():
+                moved = True
+                client.receive(dg)
+        if not moved:
+            break
+    assert client.established

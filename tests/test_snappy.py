@@ -139,3 +139,22 @@ def test_compressed_control_batch_still_skipped():
     struct.pack_into("!I", batch, 17, crc32c(after))
     out, nxt, skipped = parse_batches(bytes(batch))
     assert out == [] and skipped == 1 and nxt == 1
+
+
+def test_hostile_preamble_rejected_before_allocation():
+    """A few-byte input claiming a ~4 GiB uncompressed size must be
+    rejected by the sanity cap, not allocated (review finding, r5)."""
+    import struct
+    # varint 0xFFFFFFFF (4 GiB - 1) + one tag byte
+    hostile = b"\xff\xff\xff\xff\x0f" + b"\x00"
+    for fn in (sz.decompress, sz._py_decompress):
+        with pytest.raises(ValueError):
+            fn(hostile)
+    # ...and via the Kafka fetch path (xerial framing)
+    framed = (b"\x82SNAPPY\x00" + struct.pack("!ii", 1, 1)
+              + struct.pack("!i", len(hostile)) + hostile)
+    with pytest.raises(ValueError):
+        sz.decompress_xerial(framed)
+    # legitimate high-ratio input still fine (well under the cap)
+    big = b"\x00" * 200000
+    assert sz.decompress(sz.compress(big)) == big
