@@ -111,7 +111,7 @@ def build_table(filters, depth):
             state_bucket=max(1024, 1 << int(np.ceil(np.log2(
                 max(2, len(filters)) * 2.2)))),
             edge_bucket=max(64, 1 << int(np.ceil(np.log2(
-                max(2, len(filters)) * 0.7)))),
+                max(2, len(filters)) * 1.4)))),  # ~2 slots/bucket
         )
         added = nt.bulk_add(filters)
         assert added == len(filters), (added, len(filters))

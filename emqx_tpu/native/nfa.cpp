@@ -2,7 +2,7 @@
 // 10M-filter scale, byte-compatible with the Python compiler's layout
 // (emqx_tpu/ops/compiler.py): node_tab (S,4) int32 rows
 // [plus_child, hash_accept, accept, 0] and a 2-choice 4-slot cuckoo
-// edge_tab (Hb,16) int32 of [state, word, next, 0] slots, with the SAME
+// edge_tab (Hb, BUCKET_SLOTS*4) int32 of [state, word, next, 0] slots, with the SAME
 // uint32 bucket-hash mixing, so the device kernel consumes either
 // producer's arrays unchanged.
 //
@@ -26,7 +26,9 @@
 
 namespace {
 
-constexpr int BUCKET_SLOTS = 4;
+constexpr int BUCKET_SLOTS = 2;   // 32 B rows gather 2.2x faster than
+                                  // 64 B on v5e (see compiler.py)
+constexpr int ROW = BUCKET_SLOTS * 4;   // int32s per bucket row
 constexpr int MAX_KICKS = 500;
 
 inline uint32_t bucket_hash(uint32_t state, uint32_t word, uint32_t seed,
@@ -93,7 +95,7 @@ struct Nfa {
   // (mirrors IncrementalNfa.alloc_alias/free_alias)
   std::unordered_set<int32_t> alias_aids;
 
-  std::vector<int32_t> edge_tab;  // Hb * 16
+  std::vector<int32_t> edge_tab;  // Hb * ROW
   uint32_t Hb;
   uint32_t seeds[2];
   std::mt19937 rng;
@@ -111,7 +113,7 @@ struct Nfa {
       free_sids.push_back(i);
     Hb = 8;
     while (Hb < edge_bucket) Hb <<= 1;
-    edge_tab.assign(size_t(Hb) * 16, -1);
+    edge_tab.assign(size_t(Hb) * ROW, -1);
     reseed();
     dirty_states.insert(0);
   }
@@ -188,7 +190,7 @@ struct Nfa {
       uint32_t b[2] = {bucket_hash(cs, cw, sd[0], mask),
                        bucket_hash(cs, cw, sd[1], mask)};
       for (int j = 0; j < 2; ++j) {
-        int32_t* row = &tab[size_t(b[j]) * 16];
+        int32_t* row = &tab[size_t(b[j]) * ROW];
         for (int i = 0; i < BUCKET_SLOTS; ++i) {
           if (row[i * 4] < 0) {
             row[i * 4] = cs;
@@ -201,7 +203,7 @@ struct Nfa {
       }
       uint32_t vb = b[coin(rng)];
       int vi = slot(rng) * 4;
-      int32_t* row = &tab[size_t(vb) * 16];
+      int32_t* row = &tab[size_t(vb) * ROW];
       int32_t vs = row[vi], vw = row[vi + 1], vn = row[vi + 2];
       row[vi] = cs;
       row[vi + 1] = cw;
@@ -237,7 +239,7 @@ struct Nfa {
     std::vector<std::pair<uint64_t, int32_t>> live;
     live.reserve(size_t(n_edges) + 1);
     for (size_t b = 0; b < Hb; ++b) {
-      const int32_t* row = &edge_tab[b * 16];
+      const int32_t* row = &edge_tab[b * ROW];
       for (int i = 0; i < BUCKET_SLOTS; ++i)
         if (row[i * 4] >= 0)
           live.emplace_back(ckey(row[i * 4], row[i * 4 + 1]), row[i * 4 + 2]);
@@ -254,7 +256,7 @@ struct Nfa {
         std::uniform_int_distribution<uint32_t> d(1, 0x7fffffffu);
         sd[0] = d(rng);
         sd[1] = d(rng);
-        std::vector<int32_t> tab(size_t(hb) * 16, -1);
+        std::vector<int32_t> tab(size_t(hb) * ROW, -1);
         bool ok = true;
         for (auto& [key, nxt] : live) {
           int32_t s = int32_t(key >> 32), w = int32_t(key & 0xffffffff);
@@ -281,7 +283,7 @@ struct Nfa {
     uint32_t mask = Hb - 1;
     for (int j = 0; j < 2; ++j) {
       uint32_t b = bucket_hash(s, wid, seeds[j], mask);
-      int32_t* row = &edge_tab[size_t(b) * 16];
+      int32_t* row = &edge_tab[size_t(b) * ROW];
       for (int i = 0; i < BUCKET_SLOTS; ++i) {
         if (row[i * 4] == s && row[i * 4 + 1] == wid) {
           row[i * 4] = row[i * 4 + 1] = row[i * 4 + 2] = -1;
@@ -669,8 +671,8 @@ void nfa_delta_fill(void* h, int32_t* state_idx, int32_t* state_rows,
     int64_t j = 0;
     for (int32_t b : n->dirty_buckets) {
       bucket_idx[j] = b;
-      std::memcpy(bucket_rows + j * 16, &n->edge_tab[size_t(b) * 16],
-                  16 * sizeof(int32_t));
+      std::memcpy(bucket_rows + j * ROW, &n->edge_tab[size_t(b) * ROW],
+                  ROW * sizeof(int32_t));
       ++j;
     }
   }

@@ -19,6 +19,22 @@ import numpy as np
 
 from .build import load_library
 
+# int32s per cuckoo bucket row — MUST match nfa.cpp's BUCKET_SLOTS*4
+# and ops/compiler.py's BUCKET_SLOTS; drift would size the fill buffers
+# wrong and let the C side write past them (verified at construction,
+# see NativeNfa.__init__)
+_ROW = 8
+
+
+def _check_row() -> None:
+    from ..ops.compiler import BUCKET_SLOTS
+
+    if _ROW != 4 * BUCKET_SLOTS:
+        raise RuntimeError(
+            f"native/nfa.py _ROW={_ROW} out of sync with "
+            f"compiler.BUCKET_SLOTS={BUCKET_SLOTS} (expected "
+            f"{4 * BUCKET_SLOTS}); update BOTH plus nfa.cpp")
+
 __all__ = ["NativeNfa", "available"]
 
 _lib = None
@@ -109,6 +125,7 @@ class NativeNfa:
 
     def __init__(self, depth: int = 8, state_bucket: int = 1024,
                  edge_bucket: int = 64, seed: int = 0xE709) -> None:
+        _check_row()
         lib = _load()
         if lib is None:
             raise RuntimeError("native nfa library unavailable")
@@ -179,7 +196,7 @@ class NativeNfa:
             # upload the bulk requires
             self.flush()
             for _ in range(4):
-                np.empty((4096, 16), np.int32)
+                np.empty((4096, _ROW), np.int32)
                 np.empty((4096, 4), np.int32)
             self._lib.nfa_mark_resized(self._h)
         return n
@@ -217,7 +234,7 @@ class NativeNfa:
         s = self._sizes()
         return {
             "node_tab": int(s[0]) * 4 * 4,
-            "edge_tab": int(s[1]) * 16 * 4,
+            "edge_tab": int(s[1]) * _ROW * 4,
             "n_states": int(s[2]),
             "n_edges": int(s[3]),
         }
@@ -228,7 +245,7 @@ class NativeNfa:
         """Current arrays in kernel order: (node_tab, edge_tab, seeds)."""
         s = self._sizes()
         node_tab = np.empty((int(s[0]), 4), np.int32)
-        edge_tab = np.empty((int(s[1]), 16), np.int32)
+        edge_tab = np.empty((int(s[1]), _ROW), np.int32)
         seeds = np.empty(2, np.int32)
         self._lib.nfa_fill_tables(self._h, _i32p(node_tab), _i32p(edge_tab),
                                   _i32p(seeds))
@@ -312,7 +329,7 @@ class NativeNfa:
         state_idx = np.empty(ns, np.int32)
         state_rows = np.empty((ns, 4), np.int32)
         bucket_idx = np.empty(nb, np.int32)
-        bucket_rows = np.empty((nb, 16), np.int32)
+        bucket_rows = np.empty((nb, _ROW), np.int32)
         self._lib.nfa_delta_fill(self._h, _i32p(state_idx), _i32p(state_rows),
                                  _i32p(bucket_idx), _i32p(bucket_rows))
         return NfaDelta(

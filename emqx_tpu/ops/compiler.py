@@ -13,13 +13,14 @@ unrolled NFA walk consumes (``emqx_tpu.ops.match_kernel``):
   they collapse into a per-state ``hash_accept`` id.
 * ``node_tab`` (S, 4) int32 — per-state ``[plus_child, hash_accept,
   accept, 0]``, fetched with ONE wide gather per step (-1 = absent).
-* ``edge_tab`` (Hb, 16) int32 — literal edges in a **4-way bucketed
-  cuckoo table**: each bucket row holds 4 slots of ``[state, word, next,
-  0]``.  A lookup is exactly TWO wide row-gathers (one per hash seed)
+* ``edge_tab`` (Hb, BUCKET_SLOTS·4) int32 — literal edges in a
+  **bucketed cuckoo table**: each bucket row holds BUCKET_SLOTS slots
+  of ``[state, word, next, 0]`` (2 slots = 32 B rows; see the
+  BUCKET_SLOTS note below for the measured reason).  A lookup is exactly TWO wide row-gathers (one per hash seed)
   plus vector compares — wide sequential slices are the access pattern
   TPU HBM likes; scattered narrow probes are ~10× slower (measured).
-  2-choice × 4-slot cuckoo sustains ~0.9 load factor, keeping the table
-  small and gather-friendly.
+  2-choice bucketed cuckoo keeps the table small and gather-friendly
+  (growth at 3/4 load, under the (2,2)-cuckoo ~0.89 threshold).
 * **vocab** — host dict interning literal edge words to int32 ids.
   Id 0 is reserved UNKNOWN: publish-topic words never seen in any filter
   map to 0, which has no edges by construction (they still match
@@ -46,7 +47,14 @@ from .. import topic as T
 
 __all__ = ["NfaTable", "compile_filters", "encode_topics", "BUCKET_SLOTS"]
 
-BUCKET_SLOTS = 4     # slots per cuckoo bucket (row = 4 slots × 4 int32)
+# Slots per cuckoo bucket.  Round-5 on-chip measurement: gathering 32 B
+# rows is 2.2x faster than 64 B rows on v5e (4.19 → 1.90 ms for the
+# same probe count at 10M-scale Hb), and edge gathers are ~65% of
+# kernel time — so 2 slots × 16 B beats 4 × 16 B despite the lower
+# per-bucket load threshold ((2,2)-cuckoo sustains ~0.89; growth
+# triggers at 3/4 either way).  Total table bytes are unchanged: half
+# the slots per bucket, twice the buckets after growth.
+BUCKET_SLOTS = 2     # slots per cuckoo bucket (row = 2 slots × 4 int32)
 _MAX_KICKS = 500     # cuckoo random-walk bound before growing the table
 
 
@@ -78,7 +86,7 @@ class NfaTable:
     """Flattened NFA snapshot (host numpy; ship with ``.device_arrays()``)."""
 
     node_tab: np.ndarray   # (S, 4) int32: [plus_child, hash_accept, accept, 0]
-    edge_tab: np.ndarray   # (Hb, 16) int32: 4 slots of [state, word, next, 0]
+    edge_tab: np.ndarray   # (Hb, BUCKET_SLOTS*4) int32 [state, word, next, 0] slots
     seeds: np.ndarray      # (2,) int32 — cuckoo bucket-hash seeds
     n_states: int          # live states (≤ S)
     depth: int             # max filter levels the table supports (D)
@@ -132,8 +140,8 @@ class _Node:
 def _build_cuckoo(
     edges: List[Tuple[int, int, int]], rng: np.random.Generator
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Place (state, word, next) edges into a 2-choice 4-slot cuckoo table.
-    Returns (edge_tab (Hb,16) int32, seeds (2,) int32)."""
+    """Place (state, word, next) edges into a 2-choice bucketed cuckoo table.
+    Returns (edge_tab (Hb, BUCKET_SLOTS*4) int32, seeds (2,) int32)."""
     Hb = _bucket(max(1, int(len(edges) / (BUCKET_SLOTS * 0.85))), 8)
     while True:
         seeds = rng.integers(1, 2**31 - 1, size=2, dtype=np.int32)

@@ -13,7 +13,7 @@ SURVEY.md §3.4) with ONE unrolled NFA evaluation over a whole topic batch:
   - ``#``-accepts fire for every active state (a ``#`` child matches the
     zero remaining levels too, which is why the walk runs D+1 steps);
   - end-accepts fire when t == topic length;
-  - transitions fetch the literal edge from the 4-way bucketed cuckoo
+  - transitions fetch the literal edge from the bucketed cuckoo
     table (TWO wide row-gathers — the TPU-friendly access pattern; see
     compiler docstring) plus the ``+`` edge from the packed per-state
     node table (ONE wide gather), masked for t ≥ length and for the
@@ -131,7 +131,7 @@ def nfa_match(
     lens,         # (B,) int32
     is_sys,       # (B,) bool
     node_tab,     # (S, 4) int32: [plus_child, hash_accept, accept, 0]
-    edge_tab,     # (Hb, 16) int32 cuckoo buckets
+    edge_tab,     # (Hb, BUCKET_SLOTS*4) int32 cuckoo buckets
     seeds,        # (2,) int32
     *,
     active_slots: int = 16,

@@ -670,8 +670,12 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
     if name == "sort_by" and n == 1:
         if not isinstance(v, list):
             raise JqError("jq: sort_by needs an array")
-        return [sorted(v, key=lambda x: _SortKey(
-            _eval(args[0], x)[0] if _eval(args[0], x) else None))]
+
+        def _key(x):
+            outs = _eval(args[0], x)
+            return _SortKey(outs[0] if outs else None)
+
+        return [sorted(v, key=_key)]
     if name == "unique" and n == 0:
         if not isinstance(v, list):
             raise JqError("jq: unique needs an array")
@@ -730,18 +734,14 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
         if not isinstance(v, str):
             raise JqError("jq: test needs a string input")
         return [re.search(one(0), v) is not None]
-    if name == "first" and n == 0:
-        if not isinstance(v, list):
+    if name == "first" and n == 0:      # jq defines first as .[0]:
+        if not isinstance(v, list):     # null on empty, not an error
             raise JqError("jq: first needs an array")
-        if not v:
-            raise JqError("jq: first on empty array")
-        return [v[0]]
-    if name == "last" and n == 0:
+        return [v[0] if v else None]
+    if name == "last" and n == 0:       # last == .[-1]
         if not isinstance(v, list):
             raise JqError("jq: last needs an array")
-        if not v:
-            raise JqError("jq: last on empty array")
-        return [v[-1]]
+        return [v[-1] if v else None]
     if name in ("min", "max") and n == 0:
         if not isinstance(v, list):
             raise JqError(f"jq: {name} needs an array")
@@ -787,9 +787,17 @@ def _contains(a: Any, b: Any) -> bool:
     return _cmp(a, b) == 0
 
 
+_RANGE_CAP = 1_000_000
+
+
 def _frange(lo: Any, hi: Any):
     x = _num(lo, "ranged")
     hi = _num(hi, "ranged")
+    if hi - x > _RANGE_CAP:
+        # the evaluator materializes streams; real jq streams range
+        # lazily — cap so one dashboard-authored rule cannot build a
+        # billion-element list in the dispatch path
+        raise JqError(f"jq: range span exceeds {_RANGE_CAP}")
     while x < hi:
         yield int(x) if float(x).is_integer() else x
         x += 1

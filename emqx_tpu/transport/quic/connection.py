@@ -271,7 +271,15 @@ class _Conn:
             pkts = self._flush_level(level)
             if pkts:
                 parts.append(pkts[0])
-                extra_dgrams.extend(pkts[1:])   # each under the MTU
+                for p in pkts[1:]:              # each under the MTU
+                    if level == LEVEL_INITIAL and len(p) < 1200:
+                        # RFC 9000 §14.1 applies to EVERY datagram
+                        # carrying an Initial — overflow Initials must
+                        # pad too or strict peers (incl. our own
+                        # endpoint) drop them
+                        p = p + self._make_padding(1200 - len(p),
+                                                   allow_short=False)
+                    extra_dgrams.append(p)
         app_pkts = self._flush_level(LEVEL_APP)
         if app_pkts:
             app_pkt = app_pkts[0]   # short header: MUST stay last in a
@@ -313,9 +321,11 @@ class _Conn:
             # probe: per-level overhead (header + AEAD tag) so the pad
             # lands on the floor.  The probe's 1-byte payload encodes a
             # 1-byte length varint; the real pad's length field can need
-            # 2 bytes (length > 63), overshooting by one — rebuild once
-            # with the measured delta so the datagram is EXACTLY 1200,
-            # never 1201 (the max-safe-MTU assumption).  Only the final
+            # 2 bytes (length > 63), overshooting by one — converge on
+            # the exact size below.  When the budget n is SMALLER than a
+            # minimal pad packet (~overhead bytes), the floor wins over
+            # exactness: the datagram lands a few bytes past 1200 but
+            # stays well under the ~1252 safe MTU.  Only the final
             # ciphertext leaves the host, so reusing pn for the probes
             # discloses nothing.
             overhead = len(protect(kind, keys, pn, b"\x00",

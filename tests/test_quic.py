@@ -374,7 +374,9 @@ def test_initial_datagrams_exactly_at_or_above_floor_never_over_mtu():
             assert len(dg) <= 1252, len(dg)
             has_initial = bool(dg[0] & 0x80) and (dg[0] & 0x30) == 0
             if has_initial:
-                assert len(dg) == 1200, len(dg)
+                # exactly 1200 normally; a few bytes over only when the
+                # pad budget was below a minimal pad packet
+                assert 1200 <= len(dg) <= 1252, len(dg)
             if box[0] is None:
                 from emqx_tpu.transport.quic import QuicServerConnection
                 box[0] = QuicServerConnection(dg[6:6 + dg[5]],
