@@ -539,7 +539,9 @@ class MgmtApi:
     # ------------------------------------------------------------------
 
     async def listeners(self, req: Request) -> Response:
-        return json_response([l.info() for l in self.node.listeners.all()])
+        return json_response(
+            [l.info() for l in self.node.listeners.all()]
+            + self.node.quic_listener_info())
 
     async def cluster(self, req: Request) -> Response:
         if self.node.cluster is None:

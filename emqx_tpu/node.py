@@ -964,6 +964,20 @@ class BrokerNode:
 
     # ------------------------------------------------------------------
 
+    def quic_listener_info(self) -> list:
+        """QUIC listener row(s) — ONE shape shared by node.info() and
+        GET /api/v5/listeners (drift between the two was a review
+        finding)."""
+        if self.quic is None:
+            return []
+        return [{
+            "id": "quic:default", "type": "quic",
+            "bind": f"udp:{self.quic_port}", "running": True,
+            "current_connections": len(self.quic.streams),
+            "handshakes": self.quic.handshakes,
+            "dropped_initials": self.quic.dropped_initials,
+        }]
+
     def info(self) -> dict:
         from . import __version__
 
@@ -972,12 +986,8 @@ class BrokerNode:
             "version": __version__,
             "uptime": time.time() - self.started_at,
             "connections": len(self.connections),
-            "listeners": [l.info() for l in self.listeners.all()] + ([{
-                "id": "quic:default", "type": "quic",
-                "bind": f"udp:{self.quic_port}", "running": True,
-                "current_connections": len(self.quic.streams),
-                "handshakes": self.quic.handshakes,
-            }] if self.quic is not None else []),
+            "listeners": ([l.info() for l in self.listeners.all()]
+                          + self.quic_listener_info()),
             "gateways": (self.gateways.list()
                          if self.gateways is not None else []),
             "bridges": len(self.bridges.list()),
