@@ -359,12 +359,12 @@ def _config1_size(smoke: bool) -> dict:
 
 
 SERVE_INFLIGHT = 8   # batches in flight: d2h of i overlaps compute of i+1..
-FLAT_CAP_MULT = 8    # flat-output capacity = 8·batch ids (avg fan-out ~4;
-                     # the 10M tail is fat — round-5 serving measured 11%
-                     # of topics spilling at K=32/mult=6, each spill a
-                     # ~60 us host re-run; K=128/mult=8 trades ~33% more
-                     # readback bytes for keeping the tail on device)
-SERVE_MAX_MATCHES = 128
+# the SHIPPED serving fan-out tuning (one source of truth in
+# match_kernel.py: mult 8 / K=128, round-5 10M measurement) — the bench
+# must measure the configuration the product serves with
+from emqx_tpu.ops.match_kernel import SERVE_FLAT_MULT as FLAT_CAP_MULT
+
+SERVE_MAX_MATCHES = 128   # mirrors config.py "tpu.max_matches" default
 
 
 def _serve_flat_cap(batch):

@@ -301,7 +301,9 @@ SCHEMA: Dict[str, Field] = {
     "tpu.batch_size": Field(4096, int, lambda v: v >= 1),
     "tpu.batch_deadline": Field(0.0002, duration),
     "tpu.active_slots": Field(16, int),
-    "tpu.max_matches": Field(32, int),
+    # 128 keeps the 10M fan-out tail on device (round-5 measurement in
+    # BASELINE.md: 32 spilled 11-12% of topics to host re-runs)
+    "tpu.max_matches": Field(128, int),
     "tpu.mirror_refresh_interval": Field(0.05, duration),
     # bound on device bring-up (first XLA compile is ~20-40s; a WEDGED
     # device tunnel would otherwise hang node start forever — on timeout

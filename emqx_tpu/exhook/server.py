@@ -63,7 +63,7 @@ class _IncEngine:
     (DeviceNfa serializes device ops internally)."""
 
     def __init__(
-        self, depth: int, active_slots: int = 16, max_matches: int = 32
+        self, depth: int, active_slots: int = 16, max_matches: int = 128
     ) -> None:
         from ..ops import IncrementalNfa
         from ..ops.device_table import DeviceNfa
@@ -126,7 +126,7 @@ class TpuMatchSidecar:
         node: str = "tpu-sidecar",
         checkpoint_path: str = "",
         active_slots: int = 16,
-        max_matches: int = 32,
+        max_matches: int = 128,
     ) -> None:
         self.depth = depth
         self.batch_window_s = batch_window_ms / 1000.0

@@ -50,8 +50,12 @@ __all__ = ["MatchResult", "SERVE_FLAT_MULT", "build_matcher",
 
 # serving flat-output capacity per padded batch row (ids/topic): shared
 # by every serving engine so the fan-out tuning cannot drift between
-# the in-process MatchService, the exhook sidecar, and bench.py
-SERVE_FLAT_MULT = 6
+# the in-process MatchService, the exhook sidecar, and bench.py.
+# Round-5 10M measurement: at mult 6 / K=32 the fan-out tail spilled
+# 11-12% of topics to ~60 us host re-runs; mult 8 / K=128 keeps the
+# tail on device (spills 186k -> 84 per window, serving p99 353 ->
+# 133 ms) for ~33% more readback bytes.
+SERVE_FLAT_MULT = 8
 
 
 class MatchResult(NamedTuple):
