@@ -240,7 +240,7 @@ def bench_device(table, topics, batch, iters, depth, active_slots):
     out = {}
     t0 = time.perf_counter()
     dev = DeviceNfa(table, active_slots=active_slots, compact_output=False,
-                    max_matches=SERVE_MAX_MATCHES)
+                    max_matches=_serve_max_matches())
     out["upload_s"] = round(time.perf_counter() - t0, 3)
     out["device"] = str(jax.devices()[0])
     out["active_slots"] = active_slots
@@ -359,16 +359,26 @@ def _config1_size(smoke: bool) -> dict:
 
 
 SERVE_INFLIGHT = 8   # batches in flight: d2h of i overlaps compute of i+1..
-# the SHIPPED serving fan-out tuning (one source of truth in
-# match_kernel.py: mult 8 / K=128, round-5 10M measurement) — the bench
-# must measure the configuration the product serves with
-from emqx_tpu.ops.match_kernel import SERVE_FLAT_MULT as FLAT_CAP_MULT
+# the SHIPPED serving fan-out tuning, read from the product's own
+# sources of truth (match_kernel.SERVE_FLAT_MULT, config.py
+# "tpu.max_matches" — mult 8 / K=128, round-5 10M measurement): the
+# bench must measure the configuration the product serves with.
+# Resolved lazily: bench.py imports stay function-local so --help
+# works without paying (or having) jax.
 
-SERVE_MAX_MATCHES = 128   # mirrors config.py "tpu.max_matches" default
+
+def _serve_flat_mult():
+    from emqx_tpu.ops.match_kernel import SERVE_FLAT_MULT
+    return SERVE_FLAT_MULT
+
+
+def _serve_max_matches():
+    from emqx_tpu.config import SCHEMA
+    return SCHEMA["tpu.max_matches"].default
 
 
 def _serve_flat_cap(batch):
-    return FLAT_CAP_MULT * batch
+    return _serve_flat_mult() * batch
 
 
 def _readback(r, k):

@@ -63,13 +63,20 @@ class _IncEngine:
     (DeviceNfa serializes device ops internally)."""
 
     def __init__(
-        self, depth: int, active_slots: int = 16, max_matches: int = 128
+        self, depth: int, active_slots: int = 16,
+        max_matches: Optional[int] = None
     ) -> None:
         from ..ops import IncrementalNfa
         from ..ops.device_table import DeviceNfa
 
         self.depth = depth
         self.inc = IncrementalNfa(depth=depth)
+        if max_matches is None:
+            # the shipped serving K (one source of truth in config.py;
+            # hand-copied literals drifted — review finding, round 5)
+            from ..config import SCHEMA
+
+            max_matches = SCHEMA["tpu.max_matches"].default
         self.dev = DeviceNfa(
             self.inc, active_slots=active_slots, max_matches=max_matches,
             lazy=True,
@@ -126,7 +133,7 @@ class TpuMatchSidecar:
         node: str = "tpu-sidecar",
         checkpoint_path: str = "",
         active_slots: int = 16,
-        max_matches: int = 128,
+        max_matches: Optional[int] = None,
     ) -> None:
         self.depth = depth
         self.batch_window_s = batch_window_ms / 1000.0

@@ -14,6 +14,7 @@ import asyncio
 import logging
 import os
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import frames as FR
@@ -31,6 +32,15 @@ __all__ = ["QuicClient", "QuicEndpoint", "QuicServerConnection",
 _LEVEL_OF_PKT = {PKT_INITIAL: LEVEL_INITIAL, PKT_HANDSHAKE: LEVEL_HANDSHAKE,
                  PKT_1RTT: LEVEL_APP}
 _PKT_OF_LEVEL = {v: k for k, v in _LEVEL_OF_PKT.items()}
+
+
+def _retransmittable(frame: bytes) -> bool:
+    """Frames worth re-sending on loss: CRYPTO, STREAM, HANDSHAKE_DONE,
+    PING.  ACKs regenerate from _recv_pns, PADDING/CLOSE never
+    retransmit."""
+    t = frame[0]
+    return (t == FR.CRYPTO or 0x08 <= t <= 0x0F
+            or t == FR.HANDSHAKE_DONE or t == FR.PING)
 
 # generous static transport parameters (flow control is not enforced
 # beyond these; see package docstring scope cuts)
@@ -119,8 +129,22 @@ class _Conn:
             LEVEL_INITIAL: [], LEVEL_HANDSHAKE: [], LEVEL_APP: []}
         # 1-RTT packets that arrived before app recv keys derived (a
         # peer may coalesce its first stream data with its Finished);
-        # replayed after derivation — bounded, no retransmission exists
+        # replayed after derivation — bounded
         self._undecryptable: List[bytes] = []
+        # loss recovery (RFC 9002, minimal PTO form): ack-eliciting
+        # frames of each sent packet, kept until acked; on_timer()
+        # re-queues anything older than the (backed-off) PTO
+        self._sent: Dict[str, Dict[int, Tuple[float, List[bytes]]]] = {
+            LEVEL_INITIAL: {}, LEVEL_HANDSHAKE: {}, LEVEL_APP: {}}
+        self._pto_base = 0.4
+        self._pto_count = 0
+        self.retransmits = 0
+        # send window: stream chunks wait here until in-flight packet
+        # count allows them (a multi-MB write must not blow past the
+        # _sent tracking cap — evicted entries would be retransmit
+        # holes); drained on ACK receipt and on the PTO timer
+        self._stream_txq: deque = deque()
+        self._tx_window = 512
         self.last_seen = time.monotonic()
 
     # -- key plumbing --------------------------------------------------
@@ -207,11 +231,25 @@ class _Conn:
             elif fr is FR.HANDSHAKE_DONE:
                 self._ack_due[level] = True
                 self.handshake_done = True
+                # RFC 9001 §4.9: Initial/Handshake PN spaces retire with
+                # the handshake — their in-flight state goes too
+                self._sent[LEVEL_INITIAL].clear()
+                self._sent[LEVEL_HANDSHAKE].clear()
             elif isinstance(fr, FR.CloseFrame):
                 self.closed = True
                 self.close_reason = fr.reason
             elif isinstance(fr, FR.AckFrame):
-                pass   # no retransmission state to clear (scope cut)
+                sent = self._sent[level]
+                if sent:
+                    # iterate OUR bounded in-flight set, not the peer's
+                    # ranges (a hostile ACK can claim 2^62-wide ranges)
+                    rngs = fr.ranges[:64]
+                    acked = [pn for pn in sent
+                             if any(lo <= pn <= hi for lo, hi in rngs)]
+                    for pn in acked:
+                        del sent[pn]
+                    if acked:
+                        self._pto_count = 0     # backoff resets on ack
 
     # -- send ----------------------------------------------------------
 
@@ -243,15 +281,23 @@ class _Conn:
             size += len(fr)
         kind = _PKT_OF_LEVEL[level]
         out = []
+        now = time.monotonic()
         for group in groups:
             pn = self._next_pn[level]
             self._next_pn[level] += 1
             out.append(protect(kind, keys, pn, b"".join(group),
                                dcid=self.remote_cid, scid=self.scid))
+            rtx = [fr for fr in group if _retransmittable(fr)]
+            if rtx:
+                sent = self._sent[level]
+                if len(sent) >= 1024:       # bounded: evict the oldest
+                    sent.pop(next(iter(sent)))
+                sent[pn] = (now, rtx)
         return out
 
     def _service(self) -> None:
         """Drain TLS output + pending frames into coalesced datagrams."""
+        self._drain_stream_txq()
         for level, msg in self.tls.take_outgoing():
             off = self._crypto_tx_off[level]
             self._pending_frames[level].append(FR.encode_crypto(off, msg))
@@ -349,6 +395,36 @@ class _Conn:
         out, self._out_datagrams = self._out_datagrams, []
         return out
 
+    # -- loss recovery (RFC 9002, PTO form) ----------------------------
+
+    def pto(self) -> float:
+        return min(8.0, self._pto_base * (1 << min(self._pto_count, 4)))
+
+    def on_timer(self, now: Optional[float] = None) -> bool:
+        """Re-queue ack-eliciting frames unacked past the PTO; returns
+        True when a retransmission was produced (caller flushes the
+        resulting datagrams).  CRYPTO/STREAM retransmission is
+        idempotent — frames carry offsets and the receive assemblers
+        drop duplicates."""
+        if self.closed:
+            return False
+        now = time.monotonic() if now is None else now
+        deadline = now - self.pto()
+        fired = False
+        for level, sent in self._sent.items():
+            late = [pn for pn, (t, _) in sent.items() if t <= deadline]
+            if not late:
+                continue
+            fired = True
+            for pn in sorted(late):     # original send order
+                _, frames = sent.pop(pn)
+                self._pending_frames[level].extend(frames)
+        if fired:
+            self.retransmits += 1
+            self._pto_count += 1        # exponential backoff
+            self._service()
+        return fired
+
     # -- app surface ---------------------------------------------------
 
     # RFC 9000 §14: never send datagrams above the 1200-byte minimum
@@ -363,11 +439,24 @@ class _Conn:
         chunks = [data[i:i + step]
                   for i in range(0, len(data), step)] or [b""]
         for j, chunk in enumerate(chunks):
+            self._stream_txq.append((chunk, fin and j == len(chunks) - 1))
+        self._service()
+
+    def _drain_stream_txq(self) -> None:
+        """Window-limited release of queued stream chunks into frames:
+        at most _tx_window packets in flight, so the _sent tracker
+        never overflows and every unacked chunk stays retransmittable.
+        More drains happen on ACK receipt and PTO (both call
+        _service)."""
+        room = (self._tx_window
+                - len(self._sent[LEVEL_APP])
+                - len(self._pending_frames[LEVEL_APP]))
+        while self._stream_txq and room > 0:
+            chunk, fin = self._stream_txq.popleft()
             self._pending_frames[LEVEL_APP].append(
-                FR.encode_stream(0, self._stream_tx_off, chunk,
-                                 fin=fin and j == len(chunks) - 1))
+                FR.encode_stream(0, self._stream_tx_off, chunk, fin=fin))
             self._stream_tx_off += len(chunk)
-            self._service()
+            room -= 1
 
     def pop_stream_data(self) -> bytes:
         out = bytes(self._stream_in)
@@ -495,6 +584,32 @@ class QuicEndpoint:
         self.streams: Dict[QuicServerConnection, QuicStream] = {}
         self.handshakes = 0
         self.dropped_initials = 0
+        self.retransmit_tick = 0.2
+        self._timer_task: Optional[asyncio.Task] = None
+
+    def _ensure_timer(self) -> None:
+        """Retransmission timer: one endpoint-wide ~200 ms tick driving
+        every connection's PTO (RFC 9002 analog; the 1 s node
+        housekeeping is too coarse for handshake recovery)."""
+        if self._timer_task is None or self._timer_task.done():
+            try:
+                self._timer_task = asyncio.get_running_loop().create_task(
+                    self._timer_loop())
+            except RuntimeError:    # sans-io use (tests): no loop
+                pass
+
+    async def _timer_loop(self) -> None:
+        while self.by_cid:
+            await asyncio.sleep(self.retransmit_tick)
+            now = time.monotonic()
+            for conn in {id(c): c for c in self.by_cid.values()}.values():
+                try:
+                    if conn.on_timer(now):
+                        self._flush(conn)
+                except Exception:
+                    log.debug("quic retransmit", exc_info=True)
+                    self._drop(conn)
+        self._timer_task = None
 
     def datagram_received(self, data: bytes, addr) -> None:
         if len(data) < 7:
@@ -528,6 +643,7 @@ class QuicEndpoint:
             conn.peer_addr = addr
             self.by_cid[dcid] = conn
             self.by_cid[conn.scid] = conn
+            self._ensure_timer()
         conn.peer_addr = addr
         was_up = conn.established
         try:
@@ -577,6 +693,9 @@ class QuicEndpoint:
         return len(stale)
 
     def close(self) -> None:
+        if self._timer_task is not None:
+            self._timer_task.cancel()
+            self._timer_task = None
         for conn in {id(c): c for c in self.by_cid.values()}.values():
             conn.close(0, "server shutdown")
             self._flush(conn)

@@ -389,3 +389,91 @@ def test_initial_datagrams_exactly_at_or_above_floor_never_over_mtu():
         if not moved:
             break
     assert client.established
+
+
+def test_lost_stream_datagram_retransmitted():
+    """RFC 9002 analog: a dropped datagram's STREAM frames re-send
+    after the PTO instead of stalling the stream forever."""
+    import time as _time
+
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    assert client.established
+    client.send_stream(b"will be lost")
+    lost = client.take_outgoing()
+    assert lost                              # dropped on the floor
+    assert box[0].pop_stream_data() == b""
+    # PTO fires -> frames re-queued -> new datagrams
+    fired = client.on_timer(_time.monotonic() + 10)
+    assert fired and client.retransmits == 1
+    for dg in client.take_outgoing():
+        box[0].receive(dg)
+    assert box[0].pop_stream_data() == b"will be lost"
+    # the server's ACK clears the client's in-flight state
+    for dg in box[0].take_outgoing():
+        client.receive(dg)
+    assert not any(client._sent.values())
+    assert client.on_timer(_time.monotonic() + 100) is False
+
+
+def test_acked_frames_not_retransmitted_and_backoff():
+    import time as _time
+
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    client.send_stream(b"delivered")
+    pump(client, box)                        # delivered + ACKed
+    assert box[0].pop_stream_data() == b"delivered"
+    assert client.on_timer(_time.monotonic() + 100) is False
+    # un-acked data: PTO backs off exponentially
+    client.send_stream(b"lost")
+    client.take_outgoing()
+    p0 = client.pto()
+    assert client.on_timer(_time.monotonic() + 10)
+    client.take_outgoing()
+    assert client.pto() > p0
+
+
+def test_handshake_crypto_retransmission():
+    """First flight lost entirely: the handshake still completes."""
+    import time as _time
+
+    client = QuicClient()
+    client.take_outgoing()                   # initial flight lost
+    box = [None]
+    assert client.on_timer(_time.monotonic() + 10)
+    pump(client, box)
+    assert client.established and box[0].established
+
+
+def test_large_write_survives_loss_of_early_datagram():
+    """A multi-MB write must stay fully retransmittable: the send
+    window keeps in-flight packets under the _sent tracking cap, so
+    losing an EARLY datagram cannot leave an un-retransmittable hole
+    (review finding, round 5)."""
+    import time as _time
+
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    payload = bytes(range(256)) * 6000       # ~1.5 MB, > window
+    client.send_stream(payload, fin=True)
+    first_burst = client.take_outgoing()
+    assert len(first_burst) <= client._tx_window + 4
+    assert len(client._sent["1rtt"]) <= client._tx_window
+    # drop the FIRST datagram, deliver the rest
+    for dg in first_burst[1:]:
+        box[0].receive(dg)
+    # drain: acks release the window; PTO recovers the lost datagram
+    for _ in range(200):
+        for dg in box[0].take_outgoing():
+            client.receive(dg)
+        client.on_timer(_time.monotonic() + 100)
+        for dg in client.take_outgoing():
+            box[0].receive(dg)
+        if bytes(box[0]._stream_in) == payload:
+            break
+    assert bytes(box[0]._stream_in) == payload
+    assert client.retransmits >= 1
