@@ -16,9 +16,11 @@ needs, pinned to broker-era-stable versions:
 
 Compression: ``conf["compression"]`` = ``"snappy"`` (xerial-framed
 blocks via the in-repo ``native/snappy.cpp`` codec — the
-snappy-erlang-nif analog, SURVEY §2.4) or ``"gzip"`` (stdlib zlib).
-Fetch decodes both; lz4/zstd batches (no codec in this environment)
-are still skipped-with-offset-advance.  Partitioning is murmur-free:
+snappy-erlang-nif analog, SURVEY §2.4), ``"lz4"`` (in-repo
+``native/lz4.cpp`` block codec + LZ4 frame format, interop-tested
+against system liblz4) or ``"gzip"`` (stdlib zlib).  Fetch decodes all
+three; zstd batches (no codec in this environment) are still
+skipped-with-offset-advance.  Partitioning is murmur-free:
 explicit ``partition`` in the rendered item, else key-hash (crc32c of
 the key) mod partitions, else round-robin — deployments needing
 Java-client-compatible murmur2 placement set explicit partitions.
@@ -66,8 +68,10 @@ _CRC32C_TABLE: List[int] = _crc_table()
 # native codec probed at import for the same reason (forces the one-time
 # .so build/load before any worker threads exist)
 from ..native import snappy as _sz  # noqa: E402
+from ..native import lz4 as _lz4  # noqa: E402
 
 _NATIVE_CRC = _sz.available()
+_lz4.available()    # same: force the one-time .so build/load up front
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:
@@ -129,7 +133,8 @@ def _record(offset_delta: int, ts_delta: int, key: Optional[bytes],
     return _varint(len(body)) + body
 
 
-_CODEC_BITS = {None: 0, "none": 0, "gzip": 1, "snappy": 2}
+_CODEC_BITS = {None: 0, "none": 0, "gzip": 1, "snappy": 2,
+               "lz4": 3}
 
 
 def record_batch(records: List[Tuple[Optional[bytes], bytes]],
@@ -147,6 +152,8 @@ def record_batch(records: List[Tuple[Optional[bytes], bytes]],
         recs = gzip.compress(recs)
     elif attrs == 2:
         recs = _sz.compress_xerial(recs)
+    elif attrs == 3:
+        recs = _lz4.compress_frame(recs)
     n = len(records)
     after_crc = (
         struct.pack("!hiqqqhii", attrs, n - 1, ts, ts, -1, -1, -1, n) + recs
@@ -203,22 +210,25 @@ def _parse_batch_full(data: bytes) -> Tuple[
     off = struct.calcsize("!hiqqqhii")
     if attrs & 0x20:                   # control batch: NEVER surface its
         return last_delta, None        # markers as data, any codec
-    if codec in (1, 2):
+    if codec in (1, 2, 3):
         # gzip / snappy: the records section (everything after the fixed
         # header) is one compressed blob; CRC above already covered the
         # compressed form, so a decode failure here is a producer bug,
         # not wire damage — surface it
         try:
             if codec == 1:
-                after = after[:off] + gzip.decompress(after[off:])
+                body = gzip.decompress(after[off:])
+            elif codec == 2:
+                body = _sz.decompress_xerial(after[off:])
             else:
-                after = after[:off] + _sz.decompress_xerial(after[off:])
+                body = _lz4.decompress_frame(after[off:])
+            after = after[:off] + body
         except (ValueError, OSError, EOFError, zlib.error) as e:
             # zlib.error/EOFError: corrupt/truncated deflate body — must
             # land in KafkaError or the ingress poll loop misclassifies
             # it and restarts into the same poisoned offset forever
             raise KafkaError(f"batch decompress failed (codec {codec}): {e}")
-    elif codec:                        # lz4/zstd: no codec available
+    elif codec:                        # zstd: no codec in this env
         return last_delta, None
     out: List[Tuple[int, Optional[bytes], bytes]] = []
     for _ in range(n):
@@ -422,7 +432,7 @@ class KafkaClient(LazyTcpClient):
             return [], offset
         records, next_off, skipped = parse_batches(p[off:off + rlen])
         if skipped:
-            log.warning("fetch %s/%d: skipped %d lz4/zstd/control "
+            log.warning("fetch %s/%d: skipped %d zstd/control "
                         "batch(es) (codec not available)",
                         topic, pid, skipped)
         # batches can start before the requested offset (compaction);
@@ -476,7 +486,7 @@ class KafkaConnector(Connector):
         if self.compression not in _CODEC_BITS:
             raise ValueError(
                 f"kafka bridge {name}: unsupported compression "
-                f"{self.compression!r} (snappy/gzip/none)")
+                f"{self.compression!r} (snappy/lz4/gzip/none)")
         self.client = KafkaClient(
             conf.get("server", "127.0.0.1:9092"),
             client_id=conf.get("client_id", f"emqx_tpu:{name}"),

@@ -1,0 +1,214 @@
+// LZ4 block codec + xxHash32 — the lz4-erlang/NIF analog for the Kafka
+// bridge's codec-3 record batches (SURVEY.md §2.4).
+//
+// Independent implementation of the PUBLIC LZ4 block format
+// (token = literal-length nibble | match-length nibble, 255-extension
+// bytes, 2-byte little-endian match offsets, min-match 4) and of
+// xxHash32 (the frame header/content checksum).  The LZ4 FRAME layout
+// (magic 0x184D2204, FLG/BD/HC, block stream, endmark) is byte
+// plumbing and lives in lz4.py; only the block codec and the hash are
+// hot.
+//
+// Exported (extern "C", caller-allocated buffers):
+//   lz4_max_compressed_length(n)          -> worst-case dst size
+//   lz4_compress(src,n,dst,cap)           -> compressed size, -1 on cap
+//   lz4_decompress(src,n,dst,cap)         -> decoded size (<=cap), -1
+//                                            on corrupt/overflow; exact-
+//                                            size checks live in Python
+//                                            (the frame format omits
+//                                            per-block sizes)
+//   lz4_xxh32(buf,n,seed)                 -> uint32
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint32_t load32(const uint8_t* p) {
+    uint32_t v; std::memcpy(&v, p, 4); return v;
+}
+
+constexpr int kHashBits = 14;
+constexpr size_t kTabSize = size_t(1) << kHashBits;
+
+inline uint32_t hash4(uint32_t v) {
+    return (v * 2654435761u) >> (32 - kHashBits);
+}
+
+inline uint8_t* put_len(uint8_t* op, size_t len) {   // 255-extensions
+    while (len >= 255) { *op++ = 255; len -= 255; }
+    *op++ = uint8_t(len);
+    return op;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t lz4_max_compressed_length(int64_t n) {
+    return n + n / 255 + 16;
+}
+
+int64_t lz4_compress(const uint8_t* src, int64_t srclen,
+                     uint8_t* dst, int64_t dstcap) {
+    if (srclen < 0 || dstcap < lz4_max_compressed_length(srclen))
+        return -1;
+    const size_t n = size_t(srclen);
+    uint8_t* op = dst;
+    size_t ip = 0, anchor = 0;
+    // format end rules: the last 5 bytes are literals; a match may not
+    // start within the last 12 bytes
+    if (n > 12) {
+        static thread_local uint32_t* table = nullptr;
+        if (!table) table = new uint32_t[kTabSize];
+        std::memset(table, 0, kTabSize * sizeof(uint32_t));
+        const size_t mflimit = n - 12;
+        ip = 1;
+        while (ip <= mflimit) {
+            uint32_t h = hash4(load32(src + ip));
+            size_t cand = table[h];          // stores pos+1 (0 = empty)
+            table[h] = uint32_t(ip + 1);
+            if (!cand || ip + 1 - cand > 65535 ||
+                load32(src + cand - 1) != load32(src + ip)) {
+                ++ip;
+                continue;
+            }
+            size_t ref = cand - 1;
+            // extend match forward (bounded by the 5-byte end rule)
+            size_t len = 4;
+            const size_t matchlimit = n - 5;
+            while (ip + len < matchlimit && src[ref + len] == src[ip + len])
+                ++len;
+            // emit [token][lit ext][literals][offset][match ext]
+            size_t lit = ip - anchor;
+            uint8_t* token = op++;
+            if (lit >= 15) {
+                *token = 0xF0;
+                op = put_len(op, lit - 15);
+            } else {
+                *token = uint8_t(lit << 4);
+            }
+            std::memcpy(op, src + anchor, lit);
+            op += lit;
+            uint16_t off = uint16_t(ip - ref);
+            *op++ = uint8_t(off);
+            *op++ = uint8_t(off >> 8);
+            size_t ml = len - 4;             // stored match len
+            if (ml >= 15) {
+                *token |= 0x0F;
+                op = put_len(op, ml - 15);
+            } else {
+                *token |= uint8_t(ml);
+            }
+            ip += len;
+            anchor = ip;
+        }
+    }
+    // trailing literals
+    size_t lit = n - anchor;
+    uint8_t* token = op++;
+    if (lit >= 15) {
+        *token = 0xF0;
+        op = put_len(op, lit - 15);
+    } else {
+        *token = uint8_t(lit << 4);
+    }
+    std::memcpy(op, src + anchor, lit);
+    op += lit;
+    return op - dst;
+}
+
+// `start` bytes of already-decoded history occupy dst[0:start] (the
+// LZ4 frame format's block-LINKED mode lets matches reach back into
+// the previous blocks); output begins at dst[start], return value is
+// the number of NEW bytes.  start=0 == plain block decode.
+int64_t lz4_decompress_hist(const uint8_t* src, int64_t srclen,
+                            uint8_t* dst, int64_t cap, int64_t start) {
+    if (srclen < 0 || cap < 0 || start < 0 || start > cap) return -1;
+    const size_t n = size_t(srclen), w = size_t(cap);
+    size_t ip = 0, op = size_t(start);
+    while (ip < n) {
+        uint8_t token = src[ip++];
+        size_t lit = token >> 4;
+        if (lit == 15) {
+            uint8_t b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                lit += b;
+            } while (b == 255);
+        }
+        if (ip + lit > n || op + lit > w) return -1;
+        std::memcpy(dst + op, src + ip, lit);
+        ip += lit;
+        op += lit;
+        if (ip >= n) break;                  // last sequence: literals only
+        if (ip + 2 > n) return -1;
+        size_t off = src[ip] | (size_t(src[ip + 1]) << 8);
+        ip += 2;
+        if (off == 0 || off > op) return -1;
+        size_t ml = (token & 0x0F);
+        if (ml == 15) {
+            uint8_t b;
+            do {
+                if (ip >= n) return -1;
+                b = src[ip++];
+                ml += b;
+            } while (b == 255);
+        }
+        ml += 4;
+        if (op + ml > w) return -1;
+        if (off >= ml) {
+            std::memmove(dst + op, dst + op - off, ml);
+            op += ml;
+        } else {
+            for (size_t i = 0; i < ml; ++i, ++op)
+                dst[op] = dst[op - off];
+        }
+    }
+    return int64_t(op) - start;  // caller checks exactness if it applies
+}
+
+int64_t lz4_decompress(const uint8_t* src, int64_t srclen,
+                       uint8_t* dst, int64_t cap) {
+    return lz4_decompress_hist(src, srclen, dst, cap, 0);
+}
+
+// ---- xxHash32 -------------------------------------------------------------
+
+uint32_t lz4_xxh32(const uint8_t* p, int64_t len, uint32_t seed) {
+    constexpr uint32_t P1 = 2654435761u, P2 = 2246822519u,
+                       P3 = 3266489917u, P4 = 668265263u, P5 = 374761393u;
+    auto rotl = [](uint32_t x, int r) {
+        return (x << r) | (x >> (32 - r));
+    };
+    const uint8_t* end = p + len;
+    uint32_t h;
+    if (len >= 16) {
+        uint32_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed,
+                 v4 = seed - P1;
+        const uint8_t* limit = end - 16;
+        do {
+            v1 = rotl(v1 + load32(p) * P2, 13) * P1; p += 4;
+            v2 = rotl(v2 + load32(p) * P2, 13) * P1; p += 4;
+            v3 = rotl(v3 + load32(p) * P2, 13) * P1; p += 4;
+            v4 = rotl(v4 + load32(p) * P2, 13) * P1; p += 4;
+        } while (p <= limit);
+        h = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18);
+    } else {
+        h = seed + P5;
+    }
+    h += uint32_t(len);
+    while (p + 4 <= end) {
+        h = rotl(h + load32(p) * P3, 17) * P4;
+        p += 4;
+    }
+    while (p < end) {
+        h = rotl(h + (*p++) * P5, 11) * P1;
+    }
+    h ^= h >> 15; h *= P2;
+    h ^= h >> 13; h *= P3;
+    h ^= h >> 16;
+    return h;
+}
+
+}  // extern "C"

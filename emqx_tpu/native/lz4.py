@@ -1,0 +1,312 @@
+"""LZ4: ctypes front for lz4.cpp (block codec + xxHash32) plus the LZ4
+FRAME format (magic 0x184D2204) that Kafka's codec-3 record batches
+carry.  Same posture as the snappy module: pure-Python fallbacks keep
+decode working without a toolchain (the fallback compressor emits
+uncompressed frame blocks — valid LZ4F, zero ratio), and a preamble
+sanity cap bounds allocations against hostile inputs.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import List
+
+from .build import load_library
+
+__all__ = ["available", "compress_frame", "decompress_frame", "xxh32",
+           "block_compress", "block_decompress"]
+
+_MAGIC = 0x184D2204
+_BLOCK_MAX = 64 * 1024          # BD byte 0x40 = 64 KB max block size
+_MAX_RATIO = 256                # lz4 tops out at ~255x (run compression)
+_MAX_OUTPUT = 256 << 20
+
+_lib = None
+_loaded = False
+
+
+def _load():
+    global _lib, _loaded
+    if not _loaded:
+        _loaded = True
+        lib = load_library("lz4")
+        if lib is not None:
+            lib.lz4_max_compressed_length.restype = ctypes.c_int64
+            lib.lz4_max_compressed_length.argtypes = [ctypes.c_int64]
+            lib.lz4_compress.restype = ctypes.c_int64
+            lib.lz4_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int64]
+            lib.lz4_decompress.restype = ctypes.c_int64
+            lib.lz4_decompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int64]
+            lib.lz4_decompress_hist.restype = ctypes.c_int64
+            lib.lz4_decompress_hist.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int64,
+                ctypes.c_int64]
+            lib.lz4_xxh32.restype = ctypes.c_uint32
+            lib.lz4_xxh32.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def xxh32(data: bytes, seed: int = 0) -> int:
+    lib = _load()
+    if lib is None:
+        return _py_xxh32(data, seed)
+    return lib.lz4_xxh32(data, len(data), seed & 0xFFFFFFFF)
+
+
+# ---- raw block codec --------------------------------------------------------
+
+def block_compress(data: bytes) -> bytes:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("lz4: no native toolchain (compress)")
+    cap = lib.lz4_max_compressed_length(len(data))
+    dst = ctypes.create_string_buffer(max(1, cap))
+    n = lib.lz4_compress(data, len(data), dst, cap)
+    if n < 0:  # pragma: no cover - cap computed by the same lib
+        raise ValueError("lz4: compress failed")
+    return dst.raw[:n]
+
+
+def block_decompress(data: bytes, want: int) -> bytes:
+    if want < 0 or want > _MAX_OUTPUT:
+        raise ValueError(f"lz4: implausible block size {want}")
+    lib = _load()
+    if lib is None:
+        return _py_block_decompress(data, want)
+    dst = ctypes.create_string_buffer(max(1, want))
+    n = lib.lz4_decompress(data, len(data), dst, want)
+    if n != want:                   # capacity decode + exact-size check
+        raise ValueError("lz4: corrupt block")
+    return dst.raw[:n]
+
+
+# ---- LZ4 frame format -------------------------------------------------------
+
+def compress_frame(data: bytes) -> bytes:
+    """One LZ4 frame: FLG = v01 | block-independent | content-size
+    absent, no checksums (Kafka's java client accepts this shape);
+    blocks of up to 64 KB, each stored compressed unless incompressible
+    (high bit of the block length = uncompressed)."""
+    flg = 0x60                               # version 01, blk indep
+    bd = 0x40                                # 64 KB max block
+    head = struct.pack("<I", _MAGIC) + bytes([flg, bd])
+    hc = (xxh32(bytes([flg, bd])) >> 8) & 0xFF
+    out: List[bytes] = [head, bytes([hc])]
+    native = available()
+    for i in range(0, len(data), _BLOCK_MAX):
+        blk = data[i:i + _BLOCK_MAX]
+        comp = block_compress(blk) if native else blk
+        if not native or len(comp) >= len(blk):
+            out.append(struct.pack("<I", len(blk) | 0x80000000) + blk)
+        else:
+            out.append(struct.pack("<I", len(comp)) + comp)
+    out.append(struct.pack("<I", 0))         # endmark
+    return b"".join(out)
+
+
+def decompress_frame(data: bytes) -> bytes:
+    if len(data) < 7 or struct.unpack_from("<I", data)[0] != _MAGIC:
+        raise ValueError("lz4: bad frame magic")
+    flg = data[4]
+    if (flg >> 6) != 0b01:
+        raise ValueError("lz4: unsupported frame version")
+    # frame descriptor = FLG + BD [+ 8B content size] [+ 4B dictID],
+    # ALL covered by the HC byte that follows (spec order — a frame
+    # from liblz4 with store_size=True was rejected before this fix)
+    dlen = 2 + (8 if flg & 0x08 else 0) + (4 if flg & 0x01 else 0)
+    if 4 + dlen + 1 > len(data):
+        raise ValueError("lz4: truncated frame descriptor")
+    if (xxh32(data[4:4 + dlen]) >> 8) & 0xFF != data[4 + dlen]:
+        raise ValueError("lz4: frame header checksum mismatch")
+    pos = 4 + dlen + 1
+    has_block_cksum = bool(flg & 0x10)
+    has_content_cksum = bool(flg & 0x04)
+    block_indep = bool(flg & 0x20)
+    # BD byte bounds every block's decoded size (ids 4..7 = 64 KB..4 MB)
+    # — sizing buffers from it instead of the worst-case ratio avoids
+    # ~4 MB zero-filled allocations per 64 KB block on the fetch path
+    bd_id = (data[5] >> 4) & 0x07
+    block_max = 1 << (8 + 2 * bd_id) if 4 <= bd_id <= 7 else _BLOCK_MAX * 64
+    hist = b""
+    out: List[bytes] = []
+    total = 0
+    while True:
+        if pos + 4 > len(data):
+            raise ValueError("lz4: truncated frame")
+        (bsz,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        if bsz == 0:
+            break                            # endmark
+        raw = bool(bsz & 0x80000000)
+        bsz &= 0x7FFFFFFF
+        if pos + bsz > len(data):
+            raise ValueError("lz4: truncated block")
+        blk = data[pos:pos + bsz]
+        pos += bsz
+        if has_block_cksum:
+            if pos + 4 > len(data):
+                raise ValueError("lz4: truncated block checksum")
+            (ck,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            if xxh32(blk) != ck:
+                raise ValueError("lz4: block checksum mismatch")
+        if raw:
+            dec = blk
+        else:
+            # the ratio bound additionally stops hostile tiny blocks
+            # claiming the full BD budget
+            want = min(block_max, len(blk) * _MAX_RATIO + 64)
+            dec = _block_sized(blk, want, hist)
+        out.append(dec)
+        total += len(dec)
+        if not block_indep:
+            hist = (hist + dec)[-_HIST_MAX:]
+        if total > _MAX_OUTPUT:
+            raise ValueError("lz4: output exceeds cap")
+    if has_content_cksum:
+        if pos + 4 > len(data):
+            raise ValueError("lz4: truncated content checksum")
+        (ck,) = struct.unpack_from("<I", data, pos)
+        body = b"".join(out)
+        if xxh32(body) != ck:
+            raise ValueError("lz4: content checksum mismatch")
+        return body
+    return b"".join(out)
+
+
+def _block_sized(blk: bytes, max_out: int, hist: bytes) -> bytes:
+    """Decompress one frame block of unknown exact size, with the
+    previous blocks' tail as match history (the frame format's LINKED
+    mode — liblz4's default — lets matches reach back up to 64 KB
+    across block boundaries).  Native capacity-mode decode when the
+    codec is loaded (the fetch hot path), python fallback otherwise."""
+    lib = _load()
+    if lib is None:
+        return _py_block_decompress(blk, max_out, exact=False,
+                                    prefix=hist)
+    cap = len(hist) + max_out
+    dst = ctypes.create_string_buffer(max(1, cap))
+    if hist:
+        dst[:len(hist)] = hist
+    n = lib.lz4_decompress_hist(blk, len(blk), dst, cap, len(hist))
+    if n < 0:
+        raise ValueError("lz4: corrupt block")
+    return dst.raw[len(hist):len(hist) + n]
+
+
+# ---- pure-Python fallbacks --------------------------------------------------
+
+_HIST_MAX = 64 * 1024
+
+
+def _py_block_decompress(data: bytes, want: int,
+                         exact: bool = True,
+                         prefix: bytes = b"") -> bytes:
+    out = bytearray(prefix)
+    want += len(prefix)
+    ip, n = 0, len(data)
+    while ip < n:
+        token = data[ip]
+        ip += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                if ip >= n:
+                    raise ValueError("lz4: truncated literal length")
+                b = data[ip]
+                ip += 1
+                lit += b
+                if b != 255:
+                    break
+        if ip + lit > n or len(out) + lit > want:
+            raise ValueError("lz4: truncated/oversize literals")
+        out += data[ip:ip + lit]
+        ip += lit
+        if ip >= n:
+            break
+        if ip + 2 > n:
+            raise ValueError("lz4: truncated offset")
+        off = data[ip] | (data[ip + 1] << 8)
+        ip += 2
+        if off == 0 or off > len(out):
+            raise ValueError("lz4: bad match offset")
+        ml = token & 0x0F
+        if ml == 15:
+            while True:
+                if ip >= n:
+                    raise ValueError("lz4: truncated match length")
+                b = data[ip]
+                ip += 1
+                ml += b
+                if b != 255:
+                    break
+        ml += 4
+        if len(out) + ml > want:
+            raise ValueError("lz4: oversize match")
+        if off >= ml:
+            out += out[-off:len(out) - off + ml]
+        else:
+            for _ in range(ml):
+                out.append(out[-off])
+    if exact and len(out) != want:
+        raise ValueError("lz4: length mismatch")
+    return bytes(out[len(prefix):])
+
+
+def _py_xxh32(data: bytes, seed: int = 0) -> int:
+    P1, P2, P3, P4, P5 = (2654435761, 2246822519, 3266489917,
+                          668265263, 374761393)
+    M = 0xFFFFFFFF
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (32 - r))) & M
+
+    n = len(data)
+    i = 0
+    if n >= 16:
+        v1 = (seed + P1 + P2) & M
+        v2 = (seed + P2) & M
+        v3 = seed & M
+        v4 = (seed - P1) & M
+        while i + 16 <= n:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                w = int.from_bytes(data[i + 4 * j:i + 4 * j + 4], "little")
+                v = rotl((v + w * P2) & M, 13) * P1 & M
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 16
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while i + 4 <= n:
+        h = rotl((h + int.from_bytes(data[i:i + 4], "little") * P3) & M,
+                 17) * P4 & M
+        i += 4
+    while i < n:
+        h = rotl((h + data[i] * P5) & M, 11) * P1 & M
+        i += 1
+    h ^= h >> 15
+    h = h * P2 & M
+    h ^= h >> 13
+    h = h * P3 & M
+    h ^= h >> 16
+    return h

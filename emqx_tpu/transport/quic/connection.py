@@ -308,6 +308,13 @@ class _Conn:
             self._pending_frames[LEVEL_APP].append(
                 bytes([FR.HANDSHAKE_DONE]))
             self.handshake_done = True
+            # mirror of the receive path: the peer discards Initial/
+            # Handshake keys now (RFC 9001 §4.9), so unacked CRYPTO in
+            # those PN spaces can never be acknowledged — dropping it
+            # stops futile ~1200-byte PTO retransmits for the lifetime
+            # of the connection
+            self._sent[LEVEL_INITIAL].clear()
+            self._sent[LEVEL_HANDSHAKE].clear()
         parts: List[bytes] = []
         extra_dgrams: List[bytes] = []
         app_pkt: Optional[bytes] = None

@@ -354,11 +354,11 @@ def test_parse_batches_skips_compressed_and_control():
     import struct as S
 
     b1 = record_batch([(None, b"plain")], base_offset=0)
-    # forge an lz4-flagged batch (gzip/snappy decode now; lz4 doesn't):
+    # forge a zstd-flagged batch (gzip/snappy/lz4 all decode now):
     # flip the attrs bits and re-CRC
     comp = bytearray(record_batch([(None, b"zzz")], base_offset=1))
     after = bytearray(comp[21:])
-    S.pack_into("!h", after, 0, 3)                 # attrs: lz4 codec
+    S.pack_into("!h", after, 0, 4)                 # attrs: zstd codec
     S.pack_into("!I", comp, 17, crc32c(bytes(after)))
     comp[21:] = after
     recs, nxt, skipped = parse_batches(b1 + bytes(comp))

@@ -1,0 +1,146 @@
+"""LZ4 block/frame codec + xxHash32 (`native/lz4.py`), including
+cross-validation against the SYSTEM liblz4 when present — our encoder
+must be decodable by the reference implementation and vice versa
+(Kafka interop depends on it)."""
+
+import ctypes
+import ctypes.util
+import os
+import random
+
+import pytest
+
+from emqx_tpu.native import lz4
+
+
+def _cases():
+    random.seed(77)
+    return [
+        b"",
+        b"z",
+        b"ab" * 30000,
+        os.urandom(5000),
+        bytes(random.randrange(6) for _ in range(120000)),
+        b"the quick brown fox " * 500,
+    ]
+
+
+def test_xxh32_vectors():
+    # reference xxhsum values
+    assert lz4.xxh32(b"") == 0x02CC5D05
+    assert lz4.xxh32(b"", seed=1) == 0x0B2CB792
+    for d in (b"a", b"Hello World", os.urandom(999), b"x" * 70000):
+        assert lz4.xxh32(d) == lz4._py_xxh32(d)
+        assert lz4.xxh32(d, 7) == lz4._py_xxh32(d, 7)
+
+
+def test_frame_roundtrip():
+    for d in _cases():
+        f = lz4.compress_frame(d)
+        assert lz4.decompress_frame(f) == d
+
+
+def test_block_roundtrip_native_and_python():
+    if not lz4.available():
+        pytest.skip("no native toolchain")
+    for d in _cases():
+        if not d:
+            continue
+        c = lz4.block_compress(d)
+        assert lz4.block_decompress(c, len(d)) == d
+        assert lz4._py_block_decompress(c, len(d)) == d
+
+
+def test_frame_rejects_corruption():
+    good = lz4.compress_frame(b"hello world hello world")
+    for bad in (b"", b"\x00" * 8,
+                good[:6] + bytes([good[6] ^ 0xFF]) + good[7:],  # bad HC
+                good[:-3]):                                     # truncated
+        with pytest.raises(ValueError):
+            lz4.decompress_frame(bad)
+
+
+def test_block_decompress_bounds():
+    with pytest.raises(ValueError):
+        lz4.block_decompress(b"\xf0" + b"\xff" * 8, 10)   # runaway length
+    with pytest.raises(ValueError):
+        lz4.block_decompress(b"\x10a\x05\x00\x00", 100)   # offset > out
+    with pytest.raises(ValueError):
+        lz4.block_decompress(b"x", 1 << 40)               # cap
+
+
+_SYS = None
+
+
+def _syslz4():
+    global _SYS
+    if _SYS is None:
+        path = ctypes.util.find_library("lz4") or "liblz4.so.1"
+        try:
+            lib = ctypes.CDLL(path)
+            lib.LZ4_compress_default.restype = ctypes.c_int
+            lib.LZ4_decompress_safe.restype = ctypes.c_int
+            _SYS = lib
+        except OSError:
+            _SYS = False
+    return _SYS or None
+
+
+def test_interop_with_system_liblz4():
+    sys_lz4 = _syslz4()
+    if sys_lz4 is None or not lz4.available():
+        pytest.skip("system liblz4 or toolchain unavailable")
+    for d in _cases():
+        if not d:
+            continue
+        # ours -> reference decoder
+        c = lz4.block_compress(d)
+        out = ctypes.create_string_buffer(len(d))
+        n = sys_lz4.LZ4_decompress_safe(c, out, len(c), len(d))
+        assert n == len(d) and out.raw[:n] == d, \
+            f"reference lz4 rejected our encoding ({len(d)} bytes)"
+        # reference encoder -> ours
+        cap = len(d) + len(d) // 250 + 64
+        enc = ctypes.create_string_buffer(cap)
+        m = sys_lz4.LZ4_compress_default(d, enc, len(d), cap)
+        assert m > 0
+        assert lz4.block_decompress(enc.raw[:m], len(d)) == d
+        assert lz4._py_block_decompress(enc.raw[:m], len(d)) == d
+
+
+def test_frame_interop_with_system_lz4f():
+    """Frames produced by the reference LZ4F compressor (which sets
+    header fields ours doesn't, e.g. content checksums) must decode —
+    and a content-size-bearing descriptor must pass the HC check
+    (review finding: HC covers FLG..dictID, not just FLG+BD)."""
+    path = ctypes.util.find_library("lz4") or "liblz4.so.1"
+    try:
+        lib = ctypes.CDLL(path)
+        lib.LZ4F_compressFrameBound.restype = ctypes.c_size_t
+        lib.LZ4F_compressFrame.restype = ctypes.c_size_t
+        lib.LZ4F_isError.restype = ctypes.c_uint
+    except (OSError, AttributeError):
+        pytest.skip("system liblz4 frame API unavailable")
+    for d in _cases():
+        cap = int(lib.LZ4F_compressFrameBound(len(d), None)) + 64
+        dst = ctypes.create_string_buffer(cap)
+        n = int(lib.LZ4F_compressFrame(dst, cap, d, len(d), None))
+        assert not lib.LZ4F_isError(n)
+        assert lz4.decompress_frame(dst.raw[:n]) == d, len(d)
+    # and the reverse: reference decoder accepts OUR frames
+    try:
+        ctx = ctypes.c_void_p()
+        lib.LZ4F_createDecompressionContext.restype = ctypes.c_size_t
+        assert not lib.LZ4F_isError(
+            lib.LZ4F_createDecompressionContext(ctypes.byref(ctx), 100))
+        for d in _cases():
+            frame = lz4.compress_frame(d)
+            out = ctypes.create_string_buffer(max(1, len(d)))
+            dst_sz = ctypes.c_size_t(len(d))
+            src_sz = ctypes.c_size_t(len(frame))
+            rc = lib.LZ4F_decompress(ctx, out, ctypes.byref(dst_sz),
+                                     frame, ctypes.byref(src_sz), None)
+            assert not lib.LZ4F_isError(rc), f"liblz4 rejected our frame"
+            assert out.raw[:dst_sz.value] == d, len(d)
+    finally:
+        lib.LZ4F_freeDecompressionContext(ctx)

@@ -106,12 +106,12 @@ def test_record_batch_snappy_smaller_on_redundant_payloads():
         < len(record_batch(recs)) // 4
 
 
-def test_lz4_batch_still_skipped_with_offset_advance():
+def test_zstd_batch_still_skipped_with_offset_advance():
     batch = bytearray(record_batch([(b"k", b"v")]))
-    # flip the codec bits to lz4 (3) and re-CRC
+    # flip the codec bits to zstd (4) and re-CRC
     import struct
     attrs_off = 21
-    struct.pack_into("!h", batch, attrs_off, 3)
+    struct.pack_into("!h", batch, attrs_off, 4)
     after = bytes(batch[attrs_off:])
     struct.pack_into("!I", batch, 17, crc32c(after))
     out, nxt, skipped = parse_batches(bytes(batch))
@@ -158,3 +158,12 @@ def test_hostile_preamble_rejected_before_allocation():
     # legitimate high-ratio input still fine (well under the cap)
     big = b"\x00" * 200000
     assert sz.decompress(sz.compress(big)) == big
+
+
+def test_record_batch_lz4_roundtrip():
+    recs = [(b"k%d" % i, os.urandom(40) + b"telemetry" * (i % 7))
+            for i in range(25)] + [(None, b"tail")]
+    batch = record_batch(recs, compression="lz4")
+    assert parse_record_batch(batch) == recs
+    out, nxt, skipped = parse_batches(batch)
+    assert skipped == 0 and [(k, v) for _, k, v in out] == recs
