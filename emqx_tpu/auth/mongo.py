@@ -48,6 +48,10 @@ class MongoError(Exception):
     pass
 
 
+class Binary(bytes):
+    """BSON binary (subtype 0) — SASL conversation payloads."""
+
+
 class Int64(int):
     """Force int64 BSON encoding (mongod requires it for cursor ids)."""
 
@@ -70,6 +74,8 @@ def _enc_elem(name: str, v: Any) -> bytes:
     if isinstance(v, (list, tuple)):
         doc = {str(i): x for i, x in enumerate(v)}
         return b"\x04" + key + bson_encode(doc)
+    if isinstance(v, (Binary, bytes)):
+        return b"\x05" + key + struct.pack("<i", len(v)) + b"\x00" + v
     if v is None:
         return b"\x0a" + key
     if isinstance(v, int):
@@ -117,6 +123,15 @@ def _dec_doc(data: bytes, off: int) -> Tuple[Dict[str, Any], int]:
         elif t in (0x03, 0x04):
             sub, off = _dec_doc(data, off)
             out[name] = (list(sub.values()) if t == 0x04 else sub)
+        elif t == 0x05:
+            (bl,) = struct.unpack_from("<i", data, off)
+            if bl < 0 or off + 5 + bl > end:
+                # an oversized length would silently swallow the rest
+                # of the document (and feed garbage to the SASL
+                # signature check) instead of erroring
+                raise MongoError(f"bad binary length {bl}")
+            out[name] = Binary(data[off + 5:off + 5 + bl])
+            off += 5 + bl
         elif t == 0x08:
             out[name] = data[off] != 0
             off += 1
@@ -139,17 +154,52 @@ class MongoClient(LazyTcpClient):
     """One async connection speaking OP_MSG ``find``; lazy reconnect."""
 
     def __init__(self, server: str = "127.0.0.1:27017", *,
-                 database: str = "mqtt", timeout: float = 5.0) -> None:
+                 database: str = "mqtt", timeout: float = 5.0,
+                 username: str = "", password: str = "",
+                 auth_source: str = "admin") -> None:
         super().__init__(server, 27017, timeout)
         self.database = database
+        self.username = username
+        self.password = password
+        self.auth_source = auth_source
         self._req = 0
+
+    async def _on_connect(self) -> None:
+        """SCRAM-SHA-256 SASL conversation (mongod's default mechanism)
+        right after connect, against ``auth_source``.  Reuses the RFC
+        5802 client core shared with the PostgreSQL backend; the server
+        signature is verified, so the broker authenticates mongod too.
+        (SASLprep is not applied — ASCII credentials assumed, as
+        everywhere else in this client.)"""
+        if not self.username:
+            return
+        from .scram import scram_client_final, scram_client_first
+
+        user = self.username.replace("=", "=3D").replace(",", "=2C")
+        first, ctx = scram_client_first(user)
+        reply = await self._command(
+            {"saslStart": 1, "mechanism": "SCRAM-SHA-256",
+             "payload": Binary(first), "autoAuthorize": 1},
+            db=self.auth_source)
+        conv = reply.get("conversationId", 1)
+        final, ctx = scram_client_final(
+            ctx, self.password.encode(), bytes(reply["payload"]))
+        reply = await self._command(
+            {"saslContinue": 1, "conversationId": conv,
+             "payload": Binary(final)}, db=self.auth_source)
+        if bytes(reply["payload"]) != ctx["expect_server_final"]:
+            raise MongoError("mongod server signature mismatch")
+        while not reply.get("done"):
+            reply = await self._command(
+                {"saslContinue": 1, "conversationId": conv,
+                 "payload": Binary(b"")}, db=self.auth_source)
 
     async def command(self, doc: Dict[str, Any]) -> Dict[str, Any]:
         return await self._guarded(lambda: self._command(doc))
 
-    async def _command(self, doc):
+    async def _command(self, doc, db: str = ""):
         self._req += 1
-        doc = {**doc, "$db": self.database}
+        doc = {**doc, "$db": db or self.database}
         body = struct.pack("<i", 0) + b"\x00" + bson_encode(doc)
         head = struct.pack("<iiii", 16 + len(body), self._req, 0, OP_MSG)
         self._writer.write(head + body)
@@ -189,7 +239,9 @@ class MongoClient(LazyTcpClient):
 
     def find_blocking(self, collection, filter_, limit=0):
         client = MongoClient(f"{self.host}:{self.port}",
-                             database=self.database, timeout=self.timeout)
+                             database=self.database, timeout=self.timeout,
+                             username=self.username, password=self.password,
+                             auth_source=self.auth_source)
 
         async def run():
             try:
@@ -211,9 +263,13 @@ class MongoAuthenticator:
                  database: str = "mqtt", collection: str = "mqtt_user",
                  filter_template: Optional[Dict[str, Any]] = None,
                  algo: str = "sha256", salt_position: str = "prefix",
-                 iterations: int = 4096, timeout: float = 5.0) -> None:
+                 iterations: int = 4096, timeout: float = 5.0,
+                 username: str = "", password: str = "",
+                 auth_source: str = "admin") -> None:
         self.client = MongoClient(server, database=database,
-                                  timeout=timeout)
+                                  timeout=timeout, username=username,
+                                  password=password,
+                                  auth_source=auth_source)
         self.collection = collection
         self.filter_template = filter_template or {
             "username": "${username}"}
@@ -277,9 +333,13 @@ class MongoAuthzSource:
     def __init__(self, server: str = "127.0.0.1:27017", *,
                  database: str = "mqtt", collection: str = "mqtt_acl",
                  filter_template: Optional[Dict[str, Any]] = None,
-                 timeout: float = 5.0, cache_ttl: float = 10.0) -> None:
+                 timeout: float = 5.0, cache_ttl: float = 10.0,
+                 username: str = "", password: str = "",
+                 auth_source: str = "admin") -> None:
         self.client = MongoClient(server, database=database,
-                                  timeout=timeout)
+                                  timeout=timeout, username=username,
+                                  password=password,
+                                  auth_source=auth_source)
         self.collection = collection
         self.filter_template = filter_template or {
             "username": "${username}"}

@@ -241,7 +241,10 @@ class MongoBridgeConnector(Connector):
         self.client = MongoClient(
             conf.get("server", "127.0.0.1:27017"),
             database=conf.get("database", "mqtt"),
-            timeout=float(conf.get("timeout", 5.0)))
+            timeout=float(conf.get("timeout", 5.0)),
+            username=conf.get("username", ""),
+            password=conf.get("password", ""),
+            auth_source=conf.get("auth_source", "admin"))
         self.collection = conf.get("collection", "mqtt_messages")
 
     async def start(self) -> None:

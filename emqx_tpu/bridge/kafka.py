@@ -142,8 +142,8 @@ def record_batch(records: List[Tuple[Optional[bytes], bytes]],
                  base_offset: int = 0,
                  compression: Optional[str] = None) -> bytes:
     """Record batch v2 (magic 2), producer-id-less; optional snappy
-    (xerial framing, as the Java client emits) or gzip compression of
-    the records section."""
+    (xerial framing, as the Java client emits), lz4 (frame format) or
+    gzip compression of the records section."""
     ts = int(base_ts_ms if base_ts_ms is not None else time.time() * 1e3)
     recs = b"".join(
         _record(i, 0, k, v) for i, (k, v) in enumerate(records))
@@ -169,10 +169,10 @@ def parse_batches(data: bytes) -> Tuple[
     """Decode a CONCATENATED batch stream (a Fetch response's records
     field) -> ([(offset, key, value)], next_fetch_offset, n_skipped).
     Truncated trailing bytes (partial batch at max_bytes) are ignored,
-    as consumers must.  Compressed batches (no codecs in this
-    environment) and control batches are SKIPPED but still advance the
-    fetch offset via the header's lastOffsetDelta — a skip must never
-    stall the consumer; ``n_skipped`` lets callers log the gap."""
+    as consumers must.  gzip/snappy/lz4 batches decode; zstd and
+    control batches are SKIPPED but still advance the fetch offset via
+    the header's lastOffsetDelta — a skip must never stall the
+    consumer; ``n_skipped`` lets callers log the gap."""
     out: List[Tuple[int, Optional[bytes], bytes]] = []
     next_off = 0
     skipped = 0

@@ -41,7 +41,7 @@ class MockMongo:
     ``first_batch_size`` forces cursor paging so the client's getMore
     follow-up is exercised."""
 
-    def __init__(self, collections, first_batch_size=0):
+    def __init__(self, collections, first_batch_size=0, auth_users=None):
         self.collections = collections
         self.first_batch_size = first_batch_size
         self.finds = []
@@ -49,10 +49,22 @@ class MockMongo:
         self._next_cursor = 7
         self._conns = set()
         self.port = 0
+        # SCRAM-SHA-256 mode: like a mongod with auth enabled — every
+        # command except the SASL conversation requires a login
+        self.scram = None
+        if auth_users:
+            from emqx_tpu.auth.scram import ScramAuthenticator
+
+            self.scram = ScramAuthenticator(iterations=512)
+            for u, p in auth_users.items():
+                self.scram.add_user(u, p.encode())
 
     async def start(self):
+        from emqx_tpu.auth.mongo import Binary
+
         async def handle(reader, writer):
             self._conns.add(writer)
+            sasl = {"state": None, "authed": False}
             try:
                 while True:
                     head = await reader.readexactly(16)
@@ -60,6 +72,52 @@ class MockMongo:
                     payload = await reader.readexactly(ln - 16)
                     assert opcode == 2013 and payload[4] == 0
                     cmd = bson_decode(payload[5:])
+
+                    def send(reply):
+                        body = struct.pack("<i", 0) + b"\x00" \
+                            + bson_encode(reply)
+                        writer.write(struct.pack(
+                            "<iiii", 16 + len(body), 1, reqid, 2013)
+                            + body)
+
+                    if self.scram is not None:
+                        if "saslStart" in cmd:
+                            assert cmd["mechanism"] == "SCRAM-SHA-256"
+                            assert cmd["$db"] == "admin"
+                            r = self.scram.start(
+                                "", None, bytes(cmd["payload"]))
+                            if r[0] != "continue":
+                                send({"ok": 0.0, "errmsg": r[1]})
+                            else:
+                                sasl["state"] = r[2]
+                                send({"conversationId": 1, "done": False,
+                                      "payload": Binary(r[1]),
+                                      "ok": 1.0})
+                            await writer.drain()
+                            continue
+                        if "saslContinue" in cmd:
+                            if sasl["authed"]:   # empty final round trip
+                                send({"conversationId": 1, "done": True,
+                                      "payload": Binary(b""), "ok": 1.0})
+                                await writer.drain()
+                                continue
+                            r = self.scram.continue_auth(
+                                sasl["state"], bytes(cmd["payload"]))
+                            if r[0] != "ok":
+                                send({"ok": 0.0, "errmsg": r[1]})
+                            else:
+                                sasl["authed"] = True
+                                send({"conversationId": 1, "done": False,
+                                      "payload": Binary(r[3]),
+                                      "ok": 1.0})
+                            await writer.drain()
+                            continue
+                        if not sasl["authed"]:
+                            send({"ok": 0.0, "code": 13,
+                                  "errmsg": "command requires "
+                                            "authentication"})
+                            await writer.drain()
+                            continue
                     if "insert" in cmd:
                         coll = cmd["insert"]
                         docs = cmd.get("documents", [])
@@ -427,3 +485,59 @@ def test_ldap_dn_escaping_blocks_injection():
     assert a._dn_escape("trail ") == "trail\\ "
     assert a._dn_escape("#tag") == "\\#tag"
     assert a._dn_escape("a=b+c") == "a\\=b\\+c"
+
+
+def test_mongo_scram_sha256_client_auth():
+    """mongod-with-auth analog: the broker's Mongo client performs the
+    SCRAM-SHA-256 SASL conversation (shared RFC 5802 core with the
+    PostgreSQL backend) and verifies the server signature; without
+    credentials every command is rejected (round-5: flips the 'Mongo
+    assumes localhost trust' limitation)."""
+    from emqx_tpu.auth.mongo import (
+        MongoAuthenticator, MongoClient, MongoError,
+    )
+    from emqx_tpu.auth.authn import Credentials
+
+    users = [{"username": "ada",
+              "password_hash": hash_password(b"pw", "sha256", b"s1",
+                                             "prefix"),
+              "salt": "s1", "is_superuser": True}]
+
+    async def scenario():
+        mock = MockMongo({"mqtt_user": users},
+                         auth_users={"broker": "sekret"})
+        await mock.start()
+        try:
+            # authenticated client: full authn round trip works
+            auth = MongoAuthenticator(
+                f"127.0.0.1:{mock.port}", username="broker",
+                password="sekret")
+            r = await auth.authenticate_async(
+                Credentials(clientid="c1", username="ada", password=b"pw"))
+            assert r.outcome == "ok" and r.is_superuser
+            await auth.client.close()
+
+            # wrong password: SASL fails loudly
+            bad = MongoClient(f"127.0.0.1:{mock.port}",
+                              username="broker", password="wrong")
+            try:
+                await bad.command({"ping": 1})
+                raise AssertionError("bad credentials accepted")
+            except MongoError:
+                pass
+            finally:
+                await bad.close()
+
+            # no credentials: commands are rejected by the server
+            anon = MongoClient(f"127.0.0.1:{mock.port}")
+            try:
+                await anon.command({"ping": 1})
+                raise AssertionError("unauthenticated command accepted")
+            except MongoError:
+                pass
+            finally:
+                await anon.close()
+        finally:
+            await mock.stop()
+
+    run(scenario())
