@@ -7,15 +7,18 @@ as the PostgreSQL backends (``password_hash``/``salt``/``is_superuser``;
 
 Wire client scope (dependency-free, like the other backends): handshake
 v10 + ``mysql_native_password`` (SHA1 scramble), COM_QUERY with the
-TEXT resultset protocol (lenenc-string rows).  The binary prepared-
-statement protocol is NOT implemented — template values are spliced
-in a SINGLE pass as quoted literals with mode-independent escaping
-(quotes doubled, backslashes doubled — safe under both the default
-sql_mode and NO_BACKSLASH_ESCAPES), which closes the injection channel
-for the credential-shaped inputs these queries take; deployments wanting
-server-side prepare use the PostgreSQL backend (true bind parameters)
-as the template.  ``caching_sha2_password`` servers must create the
-broker's DB user with ``mysql_native_password``.
+TEXT resultset protocol, AND the binary prepared-statement protocol
+(COM_STMT_PREPARE / COM_STMT_EXECUTE with bind parameters + binary
+resultset decoding — round 5).  Two query paths:
+
+* text (default): template values spliced in a SINGLE pass as quoted
+  literals with sql_mode-aware escaping (tested against injection);
+* ``prepared: true``: ``${var}`` becomes a ``?`` bind parameter —
+  values never enter SQL text at all, statements are prepared once per
+  connection and re-executed.
+
+``caching_sha2_password`` servers must create the broker's DB user
+with ``mysql_native_password``.
 """
 
 from __future__ import annotations
@@ -89,6 +92,21 @@ def render_query(template: str, ctx: Dict[str, Any], *,
     return _PLACEHOLDER.sub(sub, template)
 
 
+def render_prepared(template: str,
+                    ctx: Dict[str, Any]) -> Tuple[str, List[str]]:
+    """``${var}`` -> ``?`` placeholder + ordered param list — the TRUE
+    bind-parameter path: values never enter the SQL text, so no
+    escaping (and no sql_mode dependence) exists at all."""
+    params: List[str] = []
+
+    def sub(m):
+        v = ctx.get(m.group(1))
+        params.append("" if v is None else str(v))
+        return "?"
+
+    return _PLACEHOLDER.sub(sub, template), params
+
+
 def _native_password(password: str, scramble: bytes) -> bytes:
     if not password:
         return b""
@@ -125,6 +143,9 @@ class MysqlClient(LazyTcpClient):
         # set from @@sql_mode at handshake; False (escape backslashes)
         # is the safe default when the probe yields nothing
         self.no_backslash_escapes = False
+        # prepared-statement handles are per-CONNECTION (server side);
+        # reset on every (re)connect
+        self._stmts: Dict[str, Tuple[int, int]] = {}
 
     # -- packet framing -----------------------------------------------------
 
@@ -150,6 +171,7 @@ class MysqlClient(LazyTcpClient):
     # -- handshake ----------------------------------------------------------
 
     async def _on_connect(self) -> None:
+        self._stmts = {}
         greeting = await self._read_packet()
         if greeting[:1] == b"\xff":
             raise MysqlError(self._err_text(greeting))
@@ -276,7 +298,200 @@ class MysqlClient(LazyTcpClient):
                     off += ln
             rows.append(row)
 
-    def query_blocking(self, sql=None, *, template=None, ctx=None):
+    # -- COM_STMT_PREPARE / COM_STMT_EXECUTE binary protocol ----------------
+
+    async def query_prepared(self, sql: str, params: List[Optional[str]]
+                             ) -> Tuple[List[str],
+                                        List[List[Optional[str]]]]:
+        """Server-side prepared statement: values travel as BINARY bind
+        parameters (never inside SQL text).  Statement handles are
+        cached per connection; results come back through the binary
+        resultset decoder but keep the text protocol's string surface
+        so callers are interchangeable."""
+        return await self._guarded(
+            lambda: self._query_prepared(sql, params))
+
+    async def query_tpl_prepared(self, template: str,
+                                 ctx: Dict[str, Any]):
+        sql, params = render_prepared(template, ctx)
+        return await self.query_prepared(sql, params)
+
+    async def _query_prepared(self, sql, params):
+        stmt = self._stmts.get(sql)
+        if stmt is None:
+            stmt = self._stmts[sql] = await self._prepare(sql)
+        stmt_id, n_params = stmt
+        if n_params != len(params):
+            raise MysqlError(
+                f"statement wants {n_params} params, got {len(params)}")
+        return await self._execute(stmt_id, params)
+
+    async def _prepare(self, sql: str) -> Tuple[int, int]:
+        self._seq = 0
+        self._write_packet(b"\x16" + sql.encode())
+        await self._writer.drain()
+        first = await self._read_packet()
+        if first[:1] == b"\xff":
+            raise MysqlServerError(self._err_text(first))
+        stmt_id, n_cols, n_params = struct.unpack_from("<IHH", first, 1)
+        # param + column definition blocks, each EOF-terminated
+        for block in (n_params, n_cols):
+            if block:
+                for _ in range(block):
+                    await self._read_packet()
+                p = await self._read_packet()
+                if p[:1] != b"\xfe":
+                    raise MysqlError("expected EOF in prepare response")
+        return stmt_id, n_params
+
+    @staticmethod
+    def _lenenc_bytes(b: bytes) -> bytes:
+        n = len(b)
+        if n < 0xFB:
+            return bytes([n]) + b
+        if n < 1 << 16:
+            return b"\xfc" + struct.pack("<H", n) + b
+        return b"\xfd" + n.to_bytes(3, "little") + b
+
+    async def _execute(self, stmt_id: int, params):
+        self._seq = 0
+        pay = bytearray(b"\x17" + struct.pack("<IBI", stmt_id, 0, 1))
+        if params:
+            nullmap = bytearray((len(params) + 7) // 8)
+            types = bytearray()
+            values = bytearray()
+            for i, v in enumerate(params):
+                types += b"\xfd\x00"             # VAR_STRING, signed
+                if v is None:
+                    nullmap[i // 8] |= 1 << (i % 8)
+                else:
+                    values += self._lenenc_bytes(str(v).encode())
+            pay += bytes(nullmap) + b"\x01" + types + values
+        self._write_packet(bytes(pay))
+        await self._writer.drain()
+        first = await self._read_packet()
+        if first[:1] == b"\xff":
+            raise MysqlServerError(self._err_text(first))
+        if first[:1] == b"\x00":                 # OK, no resultset
+            return [], []
+        ncols, _ = _lenenc(first, 0)
+        defs = []                                # (name, type, flags)
+        for _ in range(ncols):
+            p = await self._read_packet()
+            off = 0
+            name = b""
+            for field_i in range(6):             # ..., name, org_name
+                ln, off = _lenenc(p, off)
+                if field_i == 4:
+                    name = p[off:off + (ln or 0)]
+                off += ln or 0
+            off += 1 + 2 + 4                     # filler 0x0c, charset, len
+            ctype = p[off]
+            (flags,) = struct.unpack_from("<H", p, off + 1)
+            defs.append((name.decode(), ctype, flags))
+        p = await self._read_packet()
+        if p[:1] != b"\xfe":
+            raise MysqlError("expected EOF after column defs")
+        rows: List[List[Optional[str]]] = []
+        while True:
+            p = await self._read_packet()
+            if p[:1] == b"\xfe" and len(p) < 9:
+                return [d[0] for d in defs], rows
+            if p[:1] == b"\xff":
+                raise MysqlServerError(self._err_text(p))
+            rows.append(self._decode_binary_row(p, defs))
+
+    @staticmethod
+    def _decode_binary_row(p: bytes, defs) -> List[Optional[str]]:
+        """Binary resultset row -> text-protocol-shaped strings."""
+        ncols = len(defs)
+        bitmap = p[1:1 + (ncols + 9) // 8]       # null bitmap, offset 2
+        off = 1 + (ncols + 9) // 8
+        row: List[Optional[str]] = []
+        for i, (_, ctype, flags) in enumerate(defs):
+            bit = i + 2
+            if bitmap[bit // 8] & (1 << (bit % 8)):
+                row.append(None)
+                continue
+            unsigned = bool(flags & 0x20)
+            if ctype in (0x01,):                 # TINY
+                v = p[off] if unsigned else \
+                    int.from_bytes(p[off:off + 1], "little", signed=True)
+                off += 1
+                row.append(str(v))
+            elif ctype in (0x02, 0x0D):          # SHORT / YEAR
+                v = int.from_bytes(p[off:off + 2], "little",
+                                   signed=not unsigned)
+                off += 2
+                row.append(str(v))
+            elif ctype in (0x03, 0x09):          # LONG / INT24
+                v = int.from_bytes(p[off:off + 4], "little",
+                                   signed=not unsigned)
+                off += 4
+                row.append(str(v))
+            elif ctype == 0x08:                  # LONGLONG
+                v = int.from_bytes(p[off:off + 8], "little",
+                                   signed=not unsigned)
+                off += 8
+                row.append(str(v))
+            elif ctype in (0x04, 0x05):          # FLOAT / DOUBLE
+                if ctype == 0x04:
+                    (f,) = struct.unpack_from("<f", p, off)
+                    off += 4
+                else:
+                    (f,) = struct.unpack_from("<d", p, off)
+                    off += 8
+                # text-protocol surface parity: integral floats print
+                # without the trailing .0 (is_superuser stored FLOAT 1
+                # must compare equal to the text path's "1")
+                row.append(str(int(f)) if f.is_integer() else repr(f))
+            elif ctype == 0x0B:                  # TIME
+                ln = p[off]
+                off += 1
+                neg = day = h = mi = s = us = 0
+                if ln >= 8:
+                    neg = p[off]
+                    (day,) = struct.unpack_from("<I", p, off + 1)
+                    h, mi, s = struct.unpack_from("<BBB", p, off + 5)
+                if ln >= 12:
+                    (us,) = struct.unpack_from("<I", p, off + 8)
+                off += ln
+                txt = f"{'-' if neg else ''}{day * 24 + h:02d}:" \
+                      f"{mi:02d}:{s:02d}"
+                if us:
+                    txt += f".{us:06d}"
+                row.append(txt)
+            elif ctype in (0x07, 0x0A, 0x0C):    # TIMESTAMP/DATE/DATETIME
+                ln = p[off]
+                off += 1
+                y = mo = d = h = mi = s = us = 0
+                if ln >= 4:
+                    y, mo, d = struct.unpack_from("<HBB", p, off)
+                if ln >= 7:
+                    h, mi, s = struct.unpack_from("<BBB", p, off + 4)
+                if ln >= 11:
+                    (us,) = struct.unpack_from("<I", p, off + 7)
+                off += ln
+                txt = f"{y:04d}-{mo:02d}-{d:02d}"
+                if ctype != 0x0A:
+                    txt += f" {h:02d}:{mi:02d}:{s:02d}"
+                    if us:
+                        txt += f".{us:06d}"
+                row.append(txt)
+            else:
+                # the remaining types the broker queries meet are
+                # length-encoded (DECIMAL/NEWDECIMAL, VARCHAR, STRING,
+                # VAR_STRING, BLOBs, JSON, BIT, ENUM/SET)
+                ln, off = _lenenc(p, off)
+                if ln is None:
+                    row.append(None)
+                else:
+                    row.append(p[off:off + ln].decode("utf-8", "replace"))
+                    off += ln
+        return row
+
+    def query_blocking(self, sql=None, *, template=None, ctx=None,
+                       prepared=False):
         import asyncio
 
         client = MysqlClient(f"{self.host}:{self.port}", user=self.user,
@@ -286,6 +501,9 @@ class MysqlClient(LazyTcpClient):
         async def run():
             try:
                 if template is not None:
+                    if prepared:      # honor the bind-params contract
+                        return await client.query_tpl_prepared(
+                            template, ctx or {})
                     return await client.query_tpl(template, ctx or {})
                 return await client.query(sql)
             finally:
@@ -307,10 +525,16 @@ class MysqlAuthenticator:
                  user: str = "root", password: str = "",
                  database: str = "mqtt", query: Optional[str] = None,
                  algo: str = "sha256", salt_position: str = "prefix",
-                 iterations: int = 4096, timeout: float = 5.0) -> None:
+                 iterations: int = 4096, timeout: float = 5.0,
+                 prepared: bool = False) -> None:
         self.client = MysqlClient(server, user=user, password=password,
                                   database=database, timeout=timeout)
         self.query_template = query or self.DEFAULT_QUERY
+        # prepared=True: server-side prepared statement, values as
+        # BINARY bind params (never in SQL text — no escaping exists)
+        self.prepared = prepared
+        self._run_tpl = (self.client.query_tpl_prepared if prepared
+                         else self.client.query_tpl)
         self.algo = algo
         self.salt_position = salt_position
         self.iterations = iterations
@@ -337,7 +561,7 @@ class MysqlAuthenticator:
 
     async def authenticate_async(self, creds: Credentials) -> AuthResult:
         try:
-            cols, rows = await self.client.query_tpl(
+            cols, rows = await self._run_tpl(
                 self.query_template, self._tpl_ctx(creds))
             res = self._evaluate(cols, rows, creds)
         except Exception as e:
@@ -354,7 +578,8 @@ class MysqlAuthenticator:
             return IGNORE
         try:
             cols, rows = self.client.query_blocking(
-                template=self.query_template, ctx=self._tpl_ctx(creds))
+                template=self.query_template, ctx=self._tpl_ctx(creds),
+                prepared=self.prepared)
             return self._evaluate(cols, rows, creds)
         except Exception as e:
             log.warning("mysql authn unreachable: %s", e)
@@ -368,10 +593,14 @@ class MysqlAuthzSource:
     def __init__(self, server: str = "127.0.0.1:3306", *,
                  user: str = "root", password: str = "",
                  database: str = "mqtt", query: Optional[str] = None,
-                 timeout: float = 5.0, cache_ttl: float = 10.0) -> None:
+                 timeout: float = 5.0, cache_ttl: float = 10.0,
+                 prepared: bool = False) -> None:
         self.client = MysqlClient(server, user=user, password=password,
                                   database=database, timeout=timeout)
         self.query_template = query or self.DEFAULT_QUERY
+        self.prepared = prepared
+        self._run_tpl = (self.client.query_tpl_prepared if prepared
+                         else self.client.query_tpl)
         self._cache = TtlCache(cache_ttl)
 
     @staticmethod
@@ -405,7 +634,7 @@ class MysqlAuthzSource:
         rules = self._cache.fresh(key)
         if rules is None:
             try:
-                cols, rows = await self.client.query_tpl(
+                cols, rows = await self._run_tpl(
                     self.query_template,
                     _ctx(clientid, username, peerhost))
                 rules = self._rules_of(cols, rows)
@@ -427,7 +656,8 @@ class MysqlAuthzSource:
         try:
             cols, rows = self.client.query_blocking(
                 template=self.query_template,
-                ctx=_ctx(clientid, username, peerhost))
+                ctx=_ctx(clientid, username, peerhost),
+                prepared=self.prepared)
             rules = self._rules_of(cols, rows)
             self._cache.put(key, rules)
             return self._match(rules, action, topic, clientid, username)

@@ -40,6 +40,8 @@ class MockMysql:
         self.user = user
         self.password = password
         self.queries = []
+        self.prepares = []          # COM_STMT_PREPARE sql texts
+        self.executes = []          # (stmt_id, params)
         self._conns = set()
         self.port = 0
 
@@ -83,8 +85,102 @@ class MockMysql:
                 wr_packet(writer, b"\x00\x00\x00" + struct.pack("<HH",
                                                                 2, 0), 2)
                 await writer.drain()
+                stmts = {}
+                next_stmt = [1]
+
+                def coldef(c, s, writer):
+                    cd = (_lenenc_str("def") + _lenenc_str("")
+                          + _lenenc_str("t") + _lenenc_str("t")
+                          + _lenenc_str(c) + _lenenc_str(c)
+                          + b"\x0c" + struct.pack("<HIBHB", 33, 256,
+                                                  0xFD, 0, 0)
+                          + b"\x00\x00")
+                    wr_packet(writer, cd, s)
+
                 while True:
                     p, seq = await rd_packet(reader)
+                    if p[:1] == b"\x16":        # COM_STMT_PREPARE
+                        sql = p[1:].decode()
+                        self.prepares.append(sql)
+                        sid = next_stmt[0]
+                        next_stmt[0] += 1
+                        stmts[sid] = sql
+                        np_ = sql.count("?")
+                        wr_packet(writer, b"\x00"
+                                  + struct.pack("<IHHBH", sid, 0, np_,
+                                                0, 0), 1)
+                        s = 2
+                        if np_:
+                            for i in range(np_):
+                                coldef(f"p{i}", s, writer)
+                                s += 1
+                            wr_packet(writer, b"\xfe"
+                                      + struct.pack("<HH", 0, 2), s)
+                        await writer.drain()
+                        continue
+                    if p[:1] == b"\x17":        # COM_STMT_EXECUTE
+                        (sid,) = struct.unpack_from("<I", p, 1)
+                        sql = stmts[sid]
+                        np_ = sql.count("?")
+                        params = []
+                        off = 10
+                        if np_:
+                            nullmap = p[off:off + (np_ + 7) // 8]
+                            off += (np_ + 7) // 8 + 1   # + rebound flag
+                            off += 2 * np_              # types
+                            from emqx_tpu.auth.mysql import _lenenc
+                            for i in range(np_):
+                                if nullmap[i // 8] & (1 << (i % 8)):
+                                    params.append(None)
+                                    continue
+                                ln, off = _lenenc(p, off)
+                                params.append(
+                                    p[off:off + ln].decode())
+                                off += ln
+                        self.executes.append((sid, params))
+                        # substitute (quoted) to reuse the substring-
+                        # dispatched fixtures
+                        final = sql
+                        for v in params:
+                            final = final.replace(
+                                "?", "'" + (v or "") + "'", 1)
+                        cols, rows = [], []
+                        for needle, fn in self.tables.items():
+                            if needle in final:
+                                cols, rows = fn(final)
+                                break
+                        s = 1
+                        if not cols:
+                            wr_packet(writer, b"\x00\x00\x00"
+                                      + struct.pack("<HH", 2, 0), s)
+                            await writer.drain()
+                            continue
+                        wr_packet(writer, bytes([len(cols)]), s)
+                        s += 1
+                        for c in cols:
+                            coldef(c, s, writer)
+                            s += 1
+                        wr_packet(writer, b"\xfe"
+                                  + struct.pack("<HH", 0, 2), s)
+                        s += 1
+                        for r in rows:
+                            nb = (len(cols) + 9) // 8
+                            bm = bytearray(nb)
+                            vals = bytearray()
+                            for i, v in enumerate(r):
+                                if v is None:
+                                    bit = i + 2
+                                    bm[bit // 8] |= 1 << (bit % 8)
+                                else:
+                                    vals += _lenenc_str(str(v))
+                            wr_packet(writer,
+                                      b"\x00" + bytes(bm) + bytes(vals),
+                                      s)
+                            s += 1
+                        wr_packet(writer, b"\xfe"
+                                  + struct.pack("<HH", 0, 2), s)
+                        await writer.drain()
+                        continue
                     if p[:1] != b"\x03":
                         return
                     sql = p[1:].decode()
@@ -328,3 +424,52 @@ def test_sql_mode_probe_no_backslash_escapes():
         await my.stop()
 
     run(main())
+
+
+def test_render_prepared_binds_instead_of_splicing():
+    from emqx_tpu.auth.mysql import render_prepared
+
+    sql, params = render_prepared(
+        "SELECT h FROM u WHERE username = ${username} "
+        "AND clientid = ${clientid}",
+        {"username": "eve'--", "clientid": "c${username}1"})
+    assert sql == ("SELECT h FROM u WHERE username = ? "
+                   "AND clientid = ?")
+    # hostile values stay DATA in the param list, never SQL text
+    assert params == ["eve'--", "c${username}1"]
+
+
+def test_mysql_prepared_statement_authn_roundtrip():
+    """prepared: true drives COM_STMT_PREPARE/EXECUTE with binary bind
+    params and the binary resultset decoder; the statement handle is
+    reused across executions (round 5: flips the 'no server-side
+    prepare' limitation)."""
+    from emqx_tpu.auth.mysql import MysqlAuthenticator
+
+    async def scenario():
+        mock = await MockMysql({"mqtt_user": user_table}).start()
+        try:
+            auth = MysqlAuthenticator(
+                f"127.0.0.1:{mock.port}", user="broker",
+                password="dbpw", prepared=True)
+            ok = await auth.authenticate_async(Credentials(
+                clientid="c1", username="manu", password=b"mpw"))
+            assert ok.outcome == "ok"
+            bad = await auth.authenticate_async(Credentials(
+                clientid="c1", username="manu", password=b"nope"))
+            assert bad.outcome == "deny"
+            missing = await auth.authenticate_async(Credentials(
+                clientid="c1", username="ghost", password=b"x"))
+            assert missing.outcome == "ignore"
+            # one PREPARE, three EXECUTEs (handle reuse), zero text
+            # queries carrying credentials
+            assert len(mock.prepares) == 1
+            assert "?" in mock.prepares[0]
+            assert len(mock.executes) == 3
+            assert mock.executes[0][1] == ["manu"]
+            assert not any("manu" in q for q in mock.queries)
+            await auth.client.close()
+        finally:
+            await mock.stop()
+
+    run(scenario())
