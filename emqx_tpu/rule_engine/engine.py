@@ -86,6 +86,10 @@ class RuleEngine:
         self.max_republish_depth = max_republish_depth
         self._pub_depth = 0
         self._match_service = None  # device co-batching (attach below)
+        # epoch-cached hook-listener state (rebuilt on rule churn)
+        self._listener_hooks: set = set()
+        self._any_enabled = False
+        self._listeners_epoch = -1
         if broker is not None:
             self._attach(broker)
 
@@ -157,6 +161,29 @@ class RuleEngine:
     @property
     def epoch(self) -> int:
         return self._epoch
+
+    def _refresh_listeners(self) -> None:
+        hooks = set()
+        any_enabled = False
+        for rule in self.rules.values():
+            if rule.enable:
+                any_enabled = True
+                hooks.update(rule.event_hooks())
+        self._listener_hooks = hooks
+        self._any_enabled = any_enabled
+        self._listeners_epoch = self._epoch
+
+    def _event_has_listeners(self, hook: str) -> bool:
+        """Epoch-cached set of event hooks any enabled rule listens on
+        (rebuilt only after rule create/delete/enable churn)."""
+        if self._listeners_epoch != self._epoch:
+            self._refresh_listeners()
+        return hook in self._listener_hooks
+
+    def _any_rules_enabled(self) -> bool:
+        if self._listeners_epoch != self._epoch:
+            self._refresh_listeners()
+        return self._any_enabled
 
     # ------------------------------------------------------------------
     # evaluation
@@ -268,6 +295,8 @@ class RuleEngine:
             # republishing rules can't recurse unboundedly
             if self._pub_depth >= self.max_republish_depth:
                 return acc
+            if not self._any_rules_enabled():
+                return acc      # no rules: skip the column-dict build
             self._pub_depth += 1
             try:
                 self.apply_event(
@@ -283,6 +312,13 @@ class RuleEngine:
 
         def mk(hook: str, builder):
             def cb(*args):
+                # build the (priceable) column dict ONLY when some
+                # enabled rule actually listens on this event — these
+                # hooks fire per delivered/acked message, and a broker
+                # with no rules was measurably paying message_columns()
+                # on every one (round-5 config-1 profile)
+                if not self._event_has_listeners(hook):
+                    return
                 self.apply_event(hook, builder(*args), is_event=True)
             return cb
 
