@@ -635,10 +635,23 @@ def main():
         # not erase the measured result — clearly labeled as such
         measured = {}
         try:
-            with open(os.path.join(
-                    os.path.dirname(os.path.abspath(__file__)), "scripts",
-                    "measured_bench_10m_20260730.json")) as fh:
+            import glob as _glob
+            import re as _re
+
+            def _round_key(path):
+                # numeric round tag first (r10 > r5d > r5 > untagged
+                # round-3), then name — plain lexicographic order
+                # breaks at r10 and would resurface stale artifacts
+                name = os.path.basename(path)
+                m = _re.search(r"_r(\d+)", name)
+                return (int(m.group(1)) if m else 0, name)
+
+            cands = sorted(_glob.glob(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "scripts",
+                "measured_bench_10m*.json")), key=_round_key)
+            with open(cands[-1]) as fh:
                 measured = json.load(fh)
+            measured["artifact"] = os.path.basename(cands[-1])
         except Exception as e:  # noqa: BLE001
             note(f"no checked-in measured run available: {e}")
         # value/vs_baseline stay 0.0 in this branch: an archived run is

@@ -88,7 +88,7 @@ class RuleEngine:
         self._match_service = None  # device co-batching (attach below)
         # epoch-cached hook-listener state (rebuilt on rule churn)
         self._listener_hooks: set = set()
-        self._any_enabled = False
+        self._any_publish_rules = False
         self._listeners_epoch = -1
         if broker is not None:
             self._attach(broker)
@@ -164,13 +164,14 @@ class RuleEngine:
 
     def _refresh_listeners(self) -> None:
         hooks = set()
-        any_enabled = False
+        any_pub = False
         for rule in self.rules.values():
             if rule.enable:
-                any_enabled = True
                 hooks.update(rule.event_hooks())
+                if rule.publish_filters():
+                    any_pub = True
         self._listener_hooks = hooks
-        self._any_enabled = any_enabled
+        self._any_publish_rules = any_pub
         self._listeners_epoch = self._epoch
 
     def _event_has_listeners(self, hook: str) -> bool:
@@ -180,10 +181,13 @@ class RuleEngine:
             self._refresh_listeners()
         return hook in self._listener_hooks
 
-    def _any_rules_enabled(self) -> bool:
+    def _any_publish_listeners(self) -> bool:
+        """True when some enabled rule has a publish FROM filter —
+        event-only rule sets must not re-impose the per-publish
+        column-build cost."""
         if self._listeners_epoch != self._epoch:
             self._refresh_listeners()
-        return self._any_enabled
+        return self._any_publish_rules
 
     # ------------------------------------------------------------------
     # evaluation
@@ -295,8 +299,8 @@ class RuleEngine:
             # republishing rules can't recurse unboundedly
             if self._pub_depth >= self.max_republish_depth:
                 return acc
-            if not self._any_rules_enabled():
-                return acc      # no rules: skip the column-dict build
+            if not self._any_publish_listeners():
+                return acc      # no publish rules: skip the column build
             self._pub_depth += 1
             try:
                 self.apply_event(
