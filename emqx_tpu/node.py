@@ -976,6 +976,7 @@ class BrokerNode:
             "current_connections": len(self.quic.streams),
             "handshakes": self.quic.handshakes,
             "dropped_initials": self.quic.dropped_initials,
+            "retransmits": self.quic.retransmits,
         }]
 
     def info(self) -> dict:

@@ -136,8 +136,12 @@ class _Conn:
         # re-queues anything older than the (backed-off) PTO
         self._sent: Dict[str, Dict[int, Tuple[float, List[bytes]]]] = {
             LEVEL_INITIAL: {}, LEVEL_HANDSHAKE: {}, LEVEL_APP: {}}
-        self._pto_base = 0.4
+        self._pto_base = 0.4      # pre-measurement default
         self._pto_count = 0
+        # RFC 6298-style smoothed RTT from ack round trips (our ACKs
+        # carry ack_delay 0, so the sample is the pure path RTT)
+        self._srtt: Optional[float] = None
+        self._rttvar = 0.0
         self.retransmits = 0
         # send window: stream chunks wait here until in-flight packet
         # count allows them (a multi-MB write must not blow past the
@@ -246,8 +250,11 @@ class _Conn:
                     rngs = fr.ranges[:64]
                     acked = [pn for pn in sent
                              if any(lo <= pn <= hi for lo, hi in rngs)]
+                    now = time.monotonic()
                     for pn in acked:
-                        del sent[pn]
+                        t_sent, _ = sent.pop(pn)
+                        if pn == fr.largest:    # RFC 9002 §5: sample on
+                            self._rtt_sample(now - t_sent)  # largest
                     if acked:
                         self._pto_count = 0     # backoff resets on ack
 
@@ -404,8 +411,24 @@ class _Conn:
 
     # -- loss recovery (RFC 9002, PTO form) ----------------------------
 
+    def _rtt_sample(self, rtt: float) -> None:
+        if rtt < 0:
+            return
+        if self._srtt is None:
+            self._srtt = rtt
+            self._rttvar = rtt / 2
+        else:
+            self._rttvar = 0.75 * self._rttvar + 0.25 * abs(
+                self._srtt - rtt)
+            self._srtt = 0.875 * self._srtt + 0.125 * rtt
+
     def pto(self) -> float:
-        return min(8.0, self._pto_base * (1 << min(self._pto_count, 4)))
+        # srtt + 4·rttvar once measured (20 ms minimum — LAN RTTs
+        # would otherwise set sub-millisecond timers), the conservative
+        # default before; exponential backoff on top
+        base = (self._pto_base if self._srtt is None
+                else max(0.02, self._srtt + 4 * self._rttvar))
+        return min(8.0, base * (1 << min(self._pto_count, 4)))
 
     def on_timer(self, now: Optional[float] = None) -> bool:
         """Re-queue ack-eliciting frames unacked past the PTO; returns
@@ -591,6 +614,7 @@ class QuicEndpoint:
         self.streams: Dict[QuicServerConnection, QuicStream] = {}
         self.handshakes = 0
         self.dropped_initials = 0
+        self.retransmits = 0        # endpoint-lifetime (survives drops)
         self.retransmit_tick = 0.2
         self._timer_task: Optional[asyncio.Task] = None
 
@@ -612,6 +636,7 @@ class QuicEndpoint:
             for conn in {id(c): c for c in self.by_cid.values()}.values():
                 try:
                     if conn.on_timer(now):
+                        self.retransmits += 1
                         self._flush(conn)
                 except Exception:
                     log.debug("quic retransmit", exc_info=True)

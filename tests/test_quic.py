@@ -477,3 +477,19 @@ def test_large_write_survives_loss_of_early_datagram():
             break
     assert bytes(box[0]._stream_in) == payload
     assert client.retransmits >= 1
+
+
+def test_rtt_estimation_tightens_pto():
+    """The PTO shifts from the 0.4 s default to srtt + 4*rttvar once
+    ack round trips are measured (RFC 6298/9002 analog)."""
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    assert client.established
+    default_pto = 0.4
+    client.send_stream(b"ping")
+    pump(client, box)                        # delivered + ACKed fast
+    assert client._srtt is not None
+    assert client._srtt < 0.1                # in-memory pump: ~instant
+    assert client.pto() < default_pto        # tighter than the default
+    assert client.pto() >= 0.02              # floor holds
