@@ -169,13 +169,17 @@ class MongoClient(LazyTcpClient):
         right after connect, against ``auth_source``.  Reuses the RFC
         5802 client core shared with the PostgreSQL backend; the server
         signature is verified, so the broker authenticates mongod too.
-        (SASLprep is not applied — ASCII credentials assumed, as
-        everywhere else in this client.)"""
+        RFC 4013 SASLprep runs BEFORE the SCRAM attribute escaping —
+        NFKC can materialize literal '='/',' (e.g. from fullwidth
+        forms) that must then be escaped, not the other way around."""
         if not self.username:
             return
-        from .scram import scram_client_final, scram_client_first
+        from .scram import (
+            saslprep_or_raw, scram_client_final, scram_client_first,
+        )
 
-        user = self.username.replace("=", "=3D").replace(",", "=2C")
+        user = saslprep_or_raw(self.username) \
+            .replace("=", "=3D").replace(",", "=2C")
         first, ctx = scram_client_first(user)
         reply = await self._command(
             {"saslStart": 1, "mechanism": "SCRAM-SHA-256",

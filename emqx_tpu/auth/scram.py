@@ -11,6 +11,9 @@ signature, so the CLIENT authenticates the server too).
 Wire messages are RFC 5802/7677; the user store keeps only
 ``(salt, StoredKey, ServerKey, iterations)`` — never the password.
 Channel binding is ``n`` (none) — MQTT's TLS layer is independent.
+Usernames and passwords go through RFC 4013 SASLprep (round 5) on
+both sides, so visually-identical Unicode credentials hash the same
+bytes everywhere (Mongo/PostgreSQL clients share these helpers).
 """
 
 from __future__ import annotations
@@ -22,7 +25,72 @@ import os
 import secrets
 from typing import Any, Dict, Optional, Tuple
 
-__all__ = ["ScramAuthenticator", "scram_client_first", "scram_client_final"]
+__all__ = ["ScramAuthenticator", "saslprep", "saslprep_bytes",
+           "saslprep_or_raw", "scram_client_first", "scram_client_final"]
+
+
+def saslprep(s: str) -> str:
+    """RFC 4013 SASLprep (stored-string profile of stringprep): the
+    normalization RFC 5802 requires for SCRAM usernames and passwords.
+    Pure stdlib (``stringprep`` tables + NFKC).  Raises ``ValueError``
+    on prohibited output — better a loud auth failure than two peers
+    silently hashing different bytes for the same visible string."""
+    import stringprep
+    import unicodedata
+
+    if not s:
+        return s
+    # 2.1 mapping: map-to-space for non-ASCII spaces, map-to-nothing
+    out = []
+    for ch in s:
+        if stringprep.in_table_c12(ch):
+            out.append(" ")
+        elif not stringprep.in_table_b1(ch):
+            out.append(ch)
+    s = unicodedata.normalize("NFKC", "".join(out))    # 2.2 NFKC
+    if not s:
+        return s
+    # 2.3 prohibited output + 2.5 unassigned code points (table A.1:
+    # a later Unicode version could give them NFKC mappings, silently
+    # changing stored lookup keys across upgrades)
+    for ch in s:
+        if (stringprep.in_table_c12(ch) or stringprep.in_table_c21_c22(ch)
+                or stringprep.in_table_c3(ch) or stringprep.in_table_c4(ch)
+                or stringprep.in_table_c5(ch) or stringprep.in_table_c6(ch)
+                or stringprep.in_table_c7(ch) or stringprep.in_table_c8(ch)
+                or stringprep.in_table_c9(ch)
+                or stringprep.in_table_a1(ch)):
+            raise ValueError(f"saslprep: prohibited character {ch!r}")
+    # 2.4 bidi: if any RandALCat, no LCat allowed and first+last RandAL
+    if any(stringprep.in_table_d1(ch) for ch in s):
+        if any(stringprep.in_table_d2(ch) for ch in s):
+            raise ValueError("saslprep: mixed RandAL and L characters")
+        if not (stringprep.in_table_d1(s[0])
+                and stringprep.in_table_d1(s[-1])):
+            raise ValueError("saslprep: RandAL string must start and "
+                             "end with RandAL characters")
+    return s
+
+
+def saslprep_or_raw(s: str) -> str:
+    """SASLprep with the libpq-style fallback: on prohibited output the
+    ORIGINAL string is used as opaque data (a pre-SASLprep deployment's
+    control-character credential keeps authenticating; a prepped peer
+    simply won't match it)."""
+    try:
+        return saslprep(s)
+    except ValueError:
+        return s
+
+
+def saslprep_bytes(b: bytes) -> bytes:
+    """SASLprep over UTF-8 bytes (password surfaces carry bytes);
+    non-UTF-8 input — and prohibited output, libpq-style — passes
+    through unchanged as an opaque octet string."""
+    try:
+        return saslprep_or_raw(b.decode("utf-8")).encode("utf-8")
+    except UnicodeDecodeError:
+        return b
 
 
 def _hi(password: bytes, salt: bytes, iterations: int) -> bytes:
@@ -61,7 +129,8 @@ class ScramAuthenticator:
                  iterations: Optional[int] = None) -> None:
         it = iterations or self.iterations
         salt = os.urandom(16)
-        salted = _hi(password, salt, it)
+        username = saslprep_or_raw(username)   # RFC 5802 §2.2
+        salted = _hi(saslprep_bytes(password), salt, it)
         client_key = _hmac(salted, b"Client Key")
         stored_key = _h(client_key)
         server_key = _hmac(salted, b"Server Key")
@@ -69,7 +138,10 @@ class ScramAuthenticator:
                                  is_superuser)
 
     def delete_user(self, username: str) -> bool:
-        return self._users.pop(username, None) is not None
+        # same normalization as add_user, or a user created under a
+        # non-NFKC form could never be deleted with the same string
+        return self._users.pop(saslprep_or_raw(username),
+                               None) is not None
 
     # -- enhanced-auth provider contract -----------------------------------
     #
@@ -90,7 +162,8 @@ class ScramAuthenticator:
             cnonce = attrs["r"]
         except (UnicodeDecodeError, KeyError, ValueError):
             return ("deny", "malformed client-first")
-        rec = self._users.get(user or "")
+        user = saslprep_or_raw(user or "")
+        rec = self._users.get(user)
         if rec is None:
             return ("deny", "unknown user")
         salt, stored_key, server_key, it, superuser = rec
@@ -135,6 +208,7 @@ class ScramAuthenticator:
 def scram_client_first(username: str,
                        cnonce: Optional[str] = None) -> Tuple[bytes, Dict]:
     cnonce = cnonce or secrets.token_urlsafe(18)
+    username = saslprep_or_raw(username)
     bare = f"n={username},r={cnonce}"
     return f"n,,{bare}".encode(), {"bare": bare, "cnonce": cnonce,
                                    "username": username}
@@ -150,7 +224,7 @@ def scram_client_final(ctx: Dict, password: bytes,
     if not snonce.startswith(ctx["cnonce"]):
         raise ValueError("server nonce does not extend client nonce")
     salt = base64.b64decode(salt_b64)
-    salted = _hi(password, salt, it)
+    salted = _hi(saslprep_bytes(password), salt, it)
     client_key = _hmac(salted, b"Client Key")
     stored_key = _h(client_key)
     without_proof = f"c={base64.b64encode(b'n,,').decode()},r={snonce}"

@@ -201,9 +201,13 @@ class MgmtApi:
         if not uid or not pw:
             return json_response({"message": "user_id+password required"},
                                  400)
-        if uid in getattr(auth, "_users", {}):
-            # add_user overwrites silently; the API must 409 like the
-            # reference instead of rotating the password behind a 201
+        from ..auth.scram import saslprep_or_raw
+
+        if saslprep_or_raw(uid) in getattr(auth, "_users", {}):
+            # add_user overwrites silently (and stores the SASLprep'd
+            # name); the duplicate check must compare the SAME
+            # normalized key or an NFKC-equivalent user_id would rotate
+            # the password behind a 201. 409 like the reference.
             return json_response({"message": f"user {uid!r} exists"}, 409)
         try:
             auth.add_user(uid, pw.encode() if isinstance(pw, str) else pw,

@@ -305,3 +305,37 @@ def test_cm_total_vs_live_connection_count():
     broker.open_session("here", clean_start=True)
     assert cm.connection_count() == 1
     assert cm.total_connection_count() == 2
+
+
+def test_saslprep_rfc4013_vectors():
+    """RFC 4013 §3 examples + prohibited/bidi rules."""
+    import pytest as _pytest
+
+    from emqx_tpu.auth.scram import saslprep
+
+    assert saslprep("I­X") == "IX"        # soft hyphen mapped away
+    assert saslprep("user") == "user"
+    assert saslprep("USER") == "USER"          # case preserved
+    assert saslprep("ª") == "a"           # NFKC
+    assert saslprep("Ⅸ") == "IX"
+    assert saslprep("a b") == "a b"       # nbsp -> space
+    for bad in ("\x07", "ا\x31"):         # control; broken bidi
+        with _pytest.raises(ValueError):
+            saslprep(bad)
+
+
+def test_scram_unicode_credentials_normalize_consistently():
+    """A password typed as a compatibility form must authenticate
+    against the same password stored in another form."""
+    from emqx_tpu.auth.scram import (
+        ScramAuthenticator, scram_client_first, scram_client_final,
+    )
+
+    auth = ScramAuthenticator(iterations=256)
+    auth.add_user("rené", "paⅨs".encode())   # roman numeral IX
+    first, ctx = scram_client_first("rené")      # combining accent
+    r = auth.start("c", None, first)
+    assert r[0] == "continue", r
+    final, ctx = scram_client_final(ctx, b"paIXs", r[1])
+    r2 = auth.continue_auth(r[2], final)
+    assert r2[0] == "ok", r2
