@@ -493,3 +493,67 @@ def test_rtt_estimation_tightens_pto():
     assert client._srtt < 0.1                # in-memory pump: ~instant
     assert client.pto() < default_pto        # tighter than the default
     assert client.pto() >= 0.02              # floor holds
+
+
+def test_quic_listener_recovers_from_datagram_loss(tmp_path):
+    """The ENDPOINT's retransmission timer (not just the sans-io core)
+    recovers a lost server->client datagram over real UDP: the client
+    drops the first PUBLISH-bearing datagram and only the server's PTO
+    retransmit delivers it."""
+    from emqx_tpu.config import Config
+    from emqx_tpu.mqtt import packet as P
+    from emqx_tpu.node import BrokerNode
+
+    (tmp_path / "c.pem").write_bytes(CERT_PEM)
+    (tmp_path / "k.pem").write_bytes(KEY_PEM)
+
+    async def main():
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'listeners.quic.default.enable = true\n'
+            'listeners.quic.default.bind = "127.0.0.1:0"\n'
+            f'listeners.quic.default.certfile = "{tmp_path}/c.pem"\n'
+            f'listeners.quic.default.keyfile = "{tmp_path}/k.pem"\n'
+        ))
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            q = await asyncio.to_thread(MqttOverQuic, node.quic_port)
+
+            def connect_and_sub():
+                q.send_pkt(P.Connect(proto_ver=4, clientid="lossy",
+                                     clean_start=True, keepalive=60))
+                assert q.recv_pkt().type == P.CONNACK
+                q.send_pkt(P.Subscribe(packet_id=1,
+                                       topic_filters=[("l/t", {"qos": 0})]))
+                assert q.recv_pkt().type == P.SUBACK
+            await asyncio.to_thread(connect_and_sub)
+
+            def publish_and_drop_then_recover():
+                import time as _t
+
+                q.send_pkt(P.Publish(qos=0, topic="l/t",
+                                     payload=b"will drop"))
+                # DROP every inbound datagram for 250 ms — whatever
+                # carried the delivery is gone
+                deadline = _t.monotonic() + 0.25
+                q.sock.settimeout(0.05)
+                dropped = 0
+                while _t.monotonic() < deadline:
+                    try:
+                        q.sock.recvfrom(65536)
+                        dropped += 1
+                    except socket.timeout:
+                        pass
+                assert dropped >= 1
+                # the endpoint's 200 ms PTO tick must retransmit it
+                q.sock.settimeout(5.0)
+                pkt = q.recv_pkt()
+                assert pkt.type == P.PUBLISH
+                assert pkt.payload == b"will drop"
+            await asyncio.to_thread(publish_and_drop_then_recover)
+            assert node.quic.retransmits >= 1
+        finally:
+            await node.stop()
+
+    run(main())
