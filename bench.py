@@ -19,7 +19,7 @@ What is measured (all numbers measured in-run, no estimates):
 * Serving p50/p99 — an asyncio micro-batching loop (batch window +
   fixed-shape pad + device dispatch via the DeviceNfa serving engine +
   host fail-open re-run of spilled rows), measured per-topic
-  enqueue→answer at 80% of measured max throughput, AND an iso-load
+  enqueue→answer at 70% of measured max throughput, AND an iso-load
   comparison where the SAME harness drives the CPU engine at the load it
   can sustain.
 * Delta apply — 1k subscribe/unsubscribe deltas drained and
@@ -707,7 +707,7 @@ def main():
     note(f"device throughput {tpu['topics_per_s']:.0f}/s "
          f"(spill {tpu['spill_rate']})")
 
-    # serving: device at 80% of its measured max; CPU at 50% of ITS max
+    # serving: device at 70% of its measured max; CPU at 70% of ITS max
     # through the same harness (iso-harness, each engine at its own
     # sustainable load) — the honest p99 comparison
     dev_cap = calibrate_serve(dev, table, topics, args.batch,

@@ -1,8 +1,11 @@
 #!/bin/bash
 # One-shot runbook for when the TPU tunnel recovers.  Probes first; on
 # success runs the full measurement ladder and drops artifacts in
-# /tmp/tpu_run/.  Round-3 ladder: kernel ablate, pallas A/B, 1M bench,
-# 10M bench (all through the flat-output pipelined serving path).
+# /tmp/tpu_run/.  Round-5 ladder (updated after the 2-slot-bucket and
+# K=128 retunes landed): dense-engine A/B, two-tier A/B, kernel
+# ablate, 1M bench, 10M bench.  The pallas Mosaic verdict is CLOSED
+# (BASELINE.md) - bench_pallas_small is only worth rerunning on a NEW
+# jax/Mosaic version to re-test the gather lowering.
 set -u
 OUT=/tmp/tpu_run
 mkdir -p "$OUT"
@@ -12,11 +15,14 @@ if ! timeout 60 python -c "import jax, jax.numpy as jnp; print('TPU OK', jax.jit
   echo "tunnel still down"; exit 1
 fi
 
-echo "== pallas small-table A/B (50k filters, VMEM-resident) =="
-timeout 900 python -m emqx_tpu.ops.pallas_match > "$OUT/pallas_ab.txt" 2>&1
-tail -2 "$OUT/pallas_ab.txt"
+echo "== dense matmul A/B (hot-tier engine decision; crossover sweep) =="
+timeout 900 python -c "
+from emqx_tpu.ops.dense_match import bench_dense
+for nf in (60, 130, 420):
+    print(bench_dense(n_filters=nf))" > "$OUT/dense_ab.txt" 2>&1
+tail -3 "$OUT/dense_ab.txt"
 
-echo "== two-tier hot/cold A/B (200k filters, Zipf traffic) =="
+echo "== two-tier hot/cold A/B (anti-correlated workload) =="
 timeout 1200 python -c "from emqx_tpu.ops.tiered import bench_tiered; print(bench_tiered())" \
   > "$OUT/tiered_ab.txt" 2>&1
 tail -2 "$OUT/tiered_ab.txt"
@@ -35,4 +41,5 @@ timeout 3000 python bench.py \
   > "$OUT/bench_10m.json" 2> "$OUT/bench_10m.err"
 tail -3 "$OUT/bench_10m.err"; head -c 400 "$OUT/bench_10m.json"; echo
 
-echo "== done; update BASELINE.md + scripts/measured_bench_10m_*.json =="
+echo "== done; archive to scripts/measured_bench_10m_r<N>_<date>.json"
+echo "   (the round tag drives bench.py's tunnel-outage fallback pick)"
