@@ -22,16 +22,19 @@ Supported (the surface rule engines actually use):
 * ``if COND then A elif B else C end`` (condition is a generator:
   every output selects a branch, jq-style; ``else`` defaults to ``.``);
 * builtins: length, keys, values, type, add, floor, ceil, sqrt, abs,
-  tostring, tonumber, ascii_downcase, ascii_upcase, reverse, sort,
-  sort_by(f), unique, join(s), split(s), map(f), select(f), has(k),
-  contains(x), startswith(s), endswith(s), ltrimstr(s), rtrimstr(s),
-  test(re), first, last, min, max, empty, not, error, error(msg),
-  range(n), range(lo;hi), to_entries, from_entries.
+  tostring, tonumber, tojson, fromjson, ascii_downcase, ascii_upcase,
+  reverse, sort, sort_by(f), unique, unique_by(f), group_by(f),
+  join(s), split(s), map(f), select(f), has(k), contains(x),
+  startswith(s), endswith(s), ltrimstr(s), rtrimstr(s), test(re),
+  first, last, min, max, min_by(f), max_by(f), any, all, any(f),
+  all(f), flatten, flatten(d), explode, implode, empty, not, error,
+  error(msg), range(n), range(lo;hi), to_entries, from_entries,
+  recurse (and ``..``).
 
 Out of scope (documented, erroring loudly rather than mis-evaluating):
 variable bindings (``as``), ``reduce``/``foreach``, ``def``,
-``try/catch`` (use ``?``), recursion (``..``), string interpolation,
-and regex capture builtins beyond ``test``.
+``try/catch`` (use ``?``), string interpolation, and regex capture
+builtins beyond ``test``.
 
 jq's comparison/sort total order (null < false < true < numbers <
 strings < arrays < objects) is implemented so ``sort``/``min``/``max``
@@ -228,7 +231,8 @@ class _Parser:
             self.next()                  # bare "." / ".[...]": consume
             return ("dot",)              # the dot; postfix sees the "["
         if text == ".." and kind == "punct":
-            raise JqError("jq: recursive descent (..) not supported")
+            self.next()
+            return ("call", "recurse", [])     # jq: .. == recurse
         if kind == "num":
             self.next()
             return ("lit", float(text) if "." in text or "e" in text
@@ -756,6 +760,118 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             return list(_frange(0, one(0)))
         if n == 2:
             return list(_frange(one(0), one(1)))
+    if name == "recurse" and n == 0:           # .. — every subvalue
+        # iterative preorder: no recursion limit beyond memory — any
+        # document json.loads produced must traverse (the sibling
+        # flatten is iterative-safe for the same reason via its own
+        # list recursion bounded by parse depth)
+        out = []
+        stack = [v]
+        while stack:
+            x = stack.pop()
+            out.append(x)
+            if isinstance(x, list):
+                stack.extend(reversed(x))
+            elif isinstance(x, dict):
+                stack.extend(reversed(list(x.values())))
+        return out
+    if name in ("any", "all") and n == 0:
+        if not isinstance(v, list):
+            raise JqError(f"jq: {name} needs an array")
+        pick = any if name == "any" else all
+        return [pick(_truthy(x) for x in v)]
+    if name in ("any", "all") and n == 1:
+        if not isinstance(v, list):
+            raise JqError(f"jq: {name} needs an array")
+        gen = (_truthy(c) for x in v for c in _eval(args[0], x))
+        return [any(gen) if name == "any" else all(gen)]
+    if name == "flatten" and n <= 1:
+        if not isinstance(v, list):
+            raise JqError("jq: flatten needs an array")
+        depth = one(0) if n else 1 << 30
+        if not isinstance(depth, int) or depth < 0:
+            raise JqError("jq: flatten depth must be a non-negative int")
+
+        def flat(xs, d):
+            out2 = []
+            for x in xs:
+                if isinstance(x, list) and d > 0:
+                    out2.extend(flat(x, d - 1))
+                else:
+                    out2.append(x)
+            return out2
+
+        return [flat(v, depth)]
+    if name == "group_by" and n == 1:
+        if not isinstance(v, list):
+            raise JqError("jq: group_by needs an array")
+
+        def gkey(x):
+            outs = _eval(args[0], x)
+            return outs[0] if outs else None
+
+        pairs = sorted(((gkey(x), x) for x in v),
+                       key=lambda p: _SortKey(p[0]))
+        groups: List[List[Any]] = []
+        last: Any = object()
+        for k, x in pairs:
+            if not groups or _cmp(k, last) != 0:
+                groups.append([])
+                last = k
+            groups[-1].append(x)
+        return [groups]
+    if name in ("min_by", "max_by") and n == 1:
+        if not isinstance(v, list):
+            raise JqError(f"jq: {name} needs an array")
+        if not v:
+            return [None]
+
+        def bkey(x):
+            outs = _eval(args[0], x)
+            return _SortKey(outs[0] if outs else None)
+
+        pick2 = min if name == "min_by" else max
+        return [pick2(v, key=bkey)]
+    if name == "unique_by" and n == 1:
+        if not isinstance(v, list):
+            raise JqError("jq: unique_by needs an array")
+
+        def ukey(x):
+            outs = _eval(args[0], x)
+            return outs[0] if outs else None
+
+        pairs = sorted(((ukey(x), x) for x in v),
+                       key=lambda p: _SortKey(p[0]))   # one eval/elem
+        out2: List[Any] = []
+        lastk: Any = object()
+        for k, x in pairs:
+            if not out2 or _cmp(k, lastk) != 0:
+                out2.append(x)
+                lastk = k
+        return [out2]
+    if name == "tojson" and n == 0:
+        return [json.dumps(v, separators=(",", ":"))]
+    if name == "fromjson" and n == 0:
+        if not isinstance(v, str):
+            raise JqError("jq: fromjson needs a string")
+        try:
+            return [json.loads(v)]
+        except json.JSONDecodeError as e:
+            raise JqError(f"jq: fromjson: {e}")
+    if name == "explode" and n == 0:
+        if not isinstance(v, str):
+            raise JqError("jq: explode needs a string")
+        return [[ord(c) for c in v]]
+    if name == "implode" and n == 0:
+        if not isinstance(v, list):
+            raise JqError("jq: implode needs an array")
+        for c in v:
+            if isinstance(c, bool) or not isinstance(c, int):
+                raise JqError("jq: implode: codepoints must be numbers")
+        try:
+            return ["".join(chr(c) for c in v)]
+        except (ValueError, OverflowError):
+            raise JqError("jq: implode: invalid codepoint")
     if name == "to_entries" and n == 0:
         if not isinstance(v, dict):
             raise JqError("jq: to_entries needs an object")

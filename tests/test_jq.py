@@ -192,3 +192,47 @@ def test_jq_dot_bracket_forms():
     assert jq_eval(".a.xs.[0]", doc) == [5]
     assert jq_eval(".a.xs.[]", doc) == [5, 6]
     assert jq_eval('.["a"].["xs"].[1]', doc) == [6]
+
+
+JQ2_CASES = [
+    ("[1,[2,[3]]] | flatten", None, [[1, 2, 3]]),
+    ("[1,[2,[3]]] | flatten(1)", None, [[1, 2, [3]]]),
+    ("[true, false] | any", None, [True]),
+    ("[true, false] | all", None, [False]),
+    ("[] | any", None, [False]),
+    ("[] | all", None, [True]),
+    ("[1,2,3] | any(. > 2)", None, [True]),
+    ("[1,2,3] | all(. > 0)", None, [True]),
+    ('[{"k":"a","v":1},{"k":"b","v":2},{"k":"a","v":3}] '
+     '| group_by(.k) | map(length)', None, [[2, 1]]),
+    ('[{"v":3},{"v":1},{"v":2}] | min_by(.v)', None, [{"v": 1}]),
+    ('[{"v":3},{"v":1},{"v":2}] | max_by(.v)', None, [{"v": 3}]),
+    ("[] | min_by(.v)", None, [None]),
+    ('[{"k":1,"x":"a"},{"k":1,"x":"b"},{"k":2,"x":"c"}] '
+     '| unique_by(.k) | length', None, [2]),
+    ('{"a":[1]} | tojson', None, ['{"a":[1]}']),
+    ('"[1,2]" | fromjson', None, [[1, 2]]),
+    ('"ab" | explode', None, [[97, 98]]),
+    ("[97,98] | implode", None, ["ab"]),
+    # recursive descent
+    ('{"a":{"b":1},"c":[2]} | [..]', None,
+     [[{"a": {"b": 1}, "c": [2]}, {"b": 1}, 1, [2], 2]]),
+    ("[..] | length", {"x": {"y": {"z": 0}}}, [4]),
+    ('{"a":1,"b":{"c":2}} | [.. | select(type == "number")]',
+     None, [[1, 2]]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", JQ2_CASES,
+                         ids=[c[0][:40] for c in JQ2_CASES])
+def test_jq_round5b_builtins(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_fromjson_and_implode_errors():
+    with pytest.raises(JqError):
+        jq_eval('"{bad" | fromjson', None)
+    with pytest.raises(JqError):
+        jq_eval("[-1] | implode", None)
+    with pytest.raises(JqError):
+        jq_eval('"x" | flatten', None)
