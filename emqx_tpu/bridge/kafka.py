@@ -18,9 +18,15 @@ Compression: ``conf["compression"]`` = ``"snappy"`` (xerial-framed
 blocks via the in-repo ``native/snappy.cpp`` codec — the
 snappy-erlang-nif analog, SURVEY §2.4), ``"lz4"`` (in-repo
 ``native/lz4.cpp`` block codec + LZ4 frame format, interop-tested
-against system liblz4) or ``"gzip"`` (stdlib zlib).  Fetch decodes all
-three; zstd batches (no codec in this environment) are still
-skipped-with-offset-advance.  Partitioning is murmur-free:
+against system liblz4), ``"gzip"`` (stdlib zlib) or ``"zstd"``
+(store-mode frames via the in-repo ``native/zstd.py`` writer — valid
+zstd at ratio 1.0; see that module for why encode stays store-mode).
+Fetch decodes all FOUR codecs — zstd through the full RFC 8878
+decoder in ``native/zstd.cpp`` (Huffman literals, FSE sequences,
+repeat offsets, xxh64 checksums), interop-tested against system
+libzstd — so Java-producer batches ingest whole; only when the native
+toolchain is absent do zstd batches fall back to the old
+skip-with-offset-advance.  Partitioning is murmur-free:
 explicit ``partition`` in the rendered item, else key-hash (crc32c of
 the key) mod partitions, else round-robin — deployments needing
 Java-client-compatible murmur2 placement set explicit partitions.
@@ -69,9 +75,11 @@ _CRC32C_TABLE: List[int] = _crc_table()
 # .so build/load before any worker threads exist)
 from ..native import snappy as _sz  # noqa: E402
 from ..native import lz4 as _lz4  # noqa: E402
+from ..native import zstd as _zs  # noqa: E402
 
 _NATIVE_CRC = _sz.available()
 _lz4.available()    # same: force the one-time .so build/load up front
+_zs.available()
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:
@@ -134,7 +142,7 @@ def _record(offset_delta: int, ts_delta: int, key: Optional[bytes],
 
 
 _CODEC_BITS = {None: 0, "none": 0, "gzip": 1, "snappy": 2,
-               "lz4": 3}
+               "lz4": 3, "zstd": 4}
 
 
 def record_batch(records: List[Tuple[Optional[bytes], bytes]],
@@ -154,6 +162,8 @@ def record_batch(records: List[Tuple[Optional[bytes], bytes]],
         recs = _sz.compress_xerial(recs)
     elif attrs == 3:
         recs = _lz4.compress_frame(recs)
+    elif attrs == 4:
+        recs = _zs.compress_frame(recs)
     n = len(records)
     after_crc = (
         struct.pack("!hiqqqhii", attrs, n - 1, ts, ts, -1, -1, -1, n) + recs
@@ -169,10 +179,11 @@ def parse_batches(data: bytes) -> Tuple[
     """Decode a CONCATENATED batch stream (a Fetch response's records
     field) -> ([(offset, key, value)], next_fetch_offset, n_skipped).
     Truncated trailing bytes (partial batch at max_bytes) are ignored,
-    as consumers must.  gzip/snappy/lz4 batches decode; zstd and
-    control batches are SKIPPED but still advance the fetch offset via
-    the header's lastOffsetDelta — a skip must never stall the
-    consumer; ``n_skipped`` lets callers log the gap."""
+    as consumers must.  gzip/snappy/lz4/zstd batches decode; control
+    batches (and zstd only when no native decoder could be built) are
+    SKIPPED but still advance the fetch offset via the header's
+    lastOffsetDelta — a skip must never stall the consumer;
+    ``n_skipped`` lets callers log the gap."""
     out: List[Tuple[int, Optional[bytes], bytes]] = []
     next_off = 0
     skipped = 0
@@ -210,25 +221,33 @@ def _parse_batch_full(data: bytes) -> Tuple[
     off = struct.calcsize("!hiqqqhii")
     if attrs & 0x20:                   # control batch: NEVER surface its
         return last_delta, None        # markers as data, any codec
-    if codec in (1, 2, 3):
-        # gzip / snappy: the records section (everything after the fixed
-        # header) is one compressed blob; CRC above already covered the
-        # compressed form, so a decode failure here is a producer bug,
-        # not wire damage — surface it
+    if codec in (1, 2, 3, 4):
+        # the records section (everything after the fixed header) is
+        # one compressed blob; CRC above already covered the compressed
+        # form, so a decode failure here is a producer bug, not wire
+        # damage — surface it
         try:
             if codec == 1:
                 body = gzip.decompress(after[off:])
             elif codec == 2:
                 body = _sz.decompress_xerial(after[off:])
-            else:
+            elif codec == 3:
                 body = _lz4.decompress_frame(after[off:])
+            else:
+                # native decoder, or the store-mode python fallback; an
+                # entropy-coded frame on a toolchain-less host raises
+                # RuntimeError -> legacy skip-with-offset-advance
+                try:
+                    body = _zs.decompress_frame(after[off:])
+                except RuntimeError:
+                    return last_delta, None
             after = after[:off] + body
         except (ValueError, OSError, EOFError, zlib.error) as e:
             # zlib.error/EOFError: corrupt/truncated deflate body — must
             # land in KafkaError or the ingress poll loop misclassifies
             # it and restarts into the same poisoned offset forever
             raise KafkaError(f"batch decompress failed (codec {codec}): {e}")
-    elif codec:                        # zstd: no codec in this env
+    elif codec:                        # codecs 5+: unknown/reserved
         return last_delta, None
     out: List[Tuple[int, Optional[bytes], bytes]] = []
     for _ in range(n):
@@ -432,9 +451,10 @@ class KafkaClient(LazyTcpClient):
             return [], offset
         records, next_off, skipped = parse_batches(p[off:off + rlen])
         if skipped:
-            log.warning("fetch %s/%d: skipped %d zstd/control "
-                        "batch(es) (codec not available)",
-                        topic, pid, skipped)
+            log.warning(
+                "fetch %s/%d: skipped %d batch(es) — control marker, "
+                "reserved codec, or zstd without the native decoder",
+                topic, pid, skipped)
         # batches can start before the requested offset (compaction);
         # drop the leading overlap
         records = [(o, k, v) for o, k, v in records if o >= offset]

@@ -1,0 +1,806 @@
+// Zstandard DECODER (RFC 8878) — the zstd-erlang/NIF analog for the
+// Kafka bridge's codec-4 record batches (SURVEY.md §2.4).
+//
+// Independent implementation of the PUBLIC zstd format, decode side
+// only: frame/block framing, raw/RLE/compressed blocks, Huffman
+// literals (direct + FSE-compressed weight descriptions, 1- and
+// 4-stream), FSE sequence tables (predefined / RLE / described /
+// repeat), the 3-slot repeat-offset history with the literals-
+// length-0 shift, backward bitstreams, and the xxHash64 content
+// checksum.  Dictionaries are NOT supported (Kafka batches never use
+// them); a frame naming a dictionary ID fails with "unsupported".
+// The produce side emits store-mode frames from Python (zstd.py) —
+// valid zstd any consumer decodes — so only the decoder is hot and
+// only the decoder lives here.  Interop is proven in
+// tests/test_zstd.py against system libzstd in both directions.
+//
+// Exported (extern "C", caller-allocated buffers):
+//   zstd_decompress(src,n,dst,cap) -> decoded size;
+//                                     -1 corrupt, -2 cap too small,
+//                                     -3 unsupported (dictionary)
+//   zstd_content_size(src,n)       -> the FIRST regular frame's
+//                                     declared content size (an
+//                                     allocation hint; Kafka batches
+//                                     are one frame), or -1 when not
+//                                     declared (caller sizes
+//                                     heuristically and grows on -2)
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int64_t ERR_CORRUPT = -1;
+constexpr int64_t ERR_DSTSIZE = -2;
+constexpr int64_t ERR_UNSUPPORTED = -3;
+
+constexpr uint32_t kMagic = 0xFD2FB528u;
+constexpr uint32_t kSkipMagicBase = 0x184D2A50u;  // ..0x184D2A5F
+constexpr int64_t kBlockMax = 1 << 17;            // 128 KB decoded/block
+constexpr int kMaxHufLog = 12;
+constexpr int kMaxLLLog = 9, kMaxOFLog = 8, kMaxMLLog = 9, kMaxWtLog = 6;
+
+inline int highbit(uint64_t v) {        // index of highest set bit
+    return 63 - __builtin_clzll(v);
+}
+
+inline uint32_t load32le(const uint8_t* p) {
+    uint32_t v; std::memcpy(&v, p, 4); return v;
+}
+
+// ---- xxHash64 (content checksum: low 32 bits) ------------------------------
+
+uint64_t xxh64(const uint8_t* p, size_t len, uint64_t seed) {
+    constexpr uint64_t P1 = 11400714785074694791ull,
+                       P2 = 14029467366897019727ull,
+                       P3 = 1609587929392839161ull,
+                       P4 = 9650029242287828579ull,
+                       P5 = 2870177450012600261ull;
+    auto rotl = [](uint64_t x, int r) { return (x << r) | (x >> (64 - r)); };
+    auto load64 = [](const uint8_t* q) {
+        uint64_t v; std::memcpy(&v, q, 8); return v;
+    };
+    auto round1 = [&](uint64_t acc, uint64_t input) {
+        return rotl(acc + input * P2, 31) * P1;
+    };
+    const uint8_t* end = p + len;
+    uint64_t h;
+    if (len >= 32) {
+        uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed,
+                 v4 = seed - P1;
+        const uint8_t* limit = end - 32;
+        do {
+            v1 = round1(v1, load64(p)); p += 8;
+            v2 = round1(v2, load64(p)); p += 8;
+            v3 = round1(v3, load64(p)); p += 8;
+            v4 = round1(v4, load64(p)); p += 8;
+        } while (p <= limit);
+        h = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18);
+        auto merge = [&](uint64_t acc, uint64_t v) {
+            return (acc ^ round1(0, v)) * P1 + P4;
+        };
+        h = merge(h, v1); h = merge(h, v2);
+        h = merge(h, v3); h = merge(h, v4);
+    } else {
+        h = seed + P5;
+    }
+    h += uint64_t(len);
+    while (p + 8 <= end) {
+        h = rotl(h ^ round1(0, load64(p)), 27) * P1 + P4;
+        p += 8;
+    }
+    if (p + 4 <= end) {
+        h = rotl(h ^ (uint64_t(load32le(p)) * P1), 23) * P2 + P3;
+        p += 4;
+    }
+    while (p < end) {
+        h = rotl(h ^ (*p++ * P5), 11) * P1;
+    }
+    h ^= h >> 33; h *= P2;
+    h ^= h >> 29; h *= P3;
+    h ^= h >> 32;
+    return h;
+}
+
+// ---- bit readers -----------------------------------------------------------
+
+// Forward LSB-first reader (FSE table descriptions).
+struct FwdBits {
+    const uint8_t* p;
+    int64_t nbits;
+    int64_t pos = 0;
+    uint64_t peek(int n) const {
+        uint64_t v = 0;
+        for (int i = 0; i < n; i++) {
+            int64_t b = pos + i;
+            if (b < nbits && ((p[b >> 3] >> (b & 7)) & 1))
+                v |= 1ull << i;
+        }
+        return v;
+    }
+    void consume(int n) { pos += n; }
+    uint64_t read(int n) { uint64_t v = peek(n); pos += n; return v; }
+    bool ok() const { return pos <= nbits; }
+    int64_t bytes_used() const { return (pos + 7) >> 3; }
+};
+
+// Backward reader: the stream is written LSB-first front-to-back, read
+// from the END.  Model: the whole stream is one little-endian integer;
+// read(n) returns its top n bits and drops them.  The final byte's
+// highest set bit is a sentinel (not data).
+struct BackBits {
+    const uint8_t* p = nullptr;
+    int64_t nbytes = 0;
+    int64_t bitpos = 0;     // bits remaining; 0 == fully consumed
+    bool bad = false;       // init failure or over-read
+    bool init(const uint8_t* src, int64_t n) {
+        if (n <= 0 || src[n - 1] == 0) { bad = true; return false; }
+        p = src; nbytes = n;
+        bitpos = (n - 1) * 8 + highbit(src[n - 1]);   // sentinel removed
+        return true;
+    }
+    // Bits [bitpos-n, bitpos) of the little-endian stream, zero-padded
+    // below position 0 (canonical decoders peek past the start near
+    // the end of a stream; only CONSUMING past it is an error).
+    uint64_t peek(int n) const {
+        if (n == 0) return 0;
+        uint64_t v = 0;
+        int64_t lo = bitpos - n;
+        for (int i = 0; i < n; i++) {
+            int64_t b = lo + i;
+            if (b >= 0 && ((p[b >> 3] >> (b & 7)) & 1))
+                v |= 1ull << i;
+        }
+        return v;
+    }
+    void consume(int n) {
+        bitpos -= n;
+        if (bitpos < 0) bad = true;
+    }
+    uint64_t read(int n) { uint64_t v = peek(n); consume(n); return v; }
+    bool done() const { return bitpos == 0; }
+};
+
+// ---- FSE -------------------------------------------------------------------
+
+struct FSETable {
+    std::vector<uint8_t> symbol;
+    std::vector<uint8_t> nbBits;
+    std::vector<uint16_t> newState;
+    int log = -1;           // -1 == unset
+    bool set() const { return log >= 0; }
+};
+
+void fse_rle(FSETable& T, uint8_t sym) {
+    T.log = 0;
+    T.symbol.assign(1, sym);
+    T.nbBits.assign(1, 0);
+    T.newState.assign(1, 0);
+}
+
+// Normalized counts -> decode table (RFC 8878 §4.1.1).
+bool fse_build(const int16_t* norm, int nsym, int log, FSETable& T) {
+    if (log < 0 || log > 12) return false;
+    const int size = 1 << log, mask = size - 1;
+    T.log = log;
+    T.symbol.assign(size, 0);
+    T.nbBits.assign(size, 0);
+    T.newState.assign(size, 0);
+    std::vector<uint16_t> next(nsym);
+    int high = size - 1;
+    for (int s = 0; s < nsym; s++) {
+        if (norm[s] == -1) {
+            if (high < 0) return false;
+            T.symbol[high--] = uint8_t(s);
+            next[s] = 1;
+        } else if (norm[s] > 0) {
+            next[s] = uint16_t(norm[s]);
+        }
+    }
+    const int step = (size >> 1) + (size >> 3) + 3;
+    int pos = 0;
+    for (int s = 0; s < nsym; s++) {
+        for (int i = 0; i < norm[s]; i++) {
+            T.symbol[pos] = uint8_t(s);
+            do { pos = (pos + step) & mask; } while (pos > high);
+        }
+    }
+    if (pos != 0) return false;          // table not exactly filled
+    for (int t = 0; t < size; t++) {
+        const uint16_t ns = next[T.symbol[t]]++;
+        // a symbol with norm k visits states k..2k-1, so ns legally
+        // reaches 2·size-1 (nbBits 0) for dominant symbols
+        if (ns == 0 || ns >= 2 * size) return false;
+        const int nb = log - highbit(ns);
+        T.nbBits[t] = uint8_t(nb);
+        T.newState[t] = uint16_t((uint32_t(ns) << nb) - size);
+    }
+    return true;
+}
+
+// Parse an FSE table description (forward bitstream).  Returns bytes
+// consumed, or -1 on corruption.  maxLog/maxSym bound the header.
+int64_t fse_parse(const uint8_t* src, int64_t n, int maxLog, int maxSym,
+                  FSETable& T) {
+    if (n < 1) return -1;
+    FwdBits bits{src, n * 8};
+    const int log = int(bits.read(4)) + 5;
+    if (log > maxLog) return -1;
+    const int size = 1 << log;
+    int remaining = size + 1;
+    int threshold = size;
+    int nbits = log + 1;
+    int16_t norm[256] = {0};
+    int sym = 0;
+    bool prev_zero = false;
+    while (remaining > 1 && sym <= maxSym) {
+        if (prev_zero) {                 // 2-bit runs of extra zeros
+            for (;;) {
+                const int rep = int(bits.read(2));
+                sym += rep;
+                if (sym > maxSym + 1 || !bits.ok()) return -1;
+                if (rep != 3) break;
+            }
+            prev_zero = false;
+            continue;
+        }
+        const int max = (2 * threshold - 1) - remaining;
+        int count;
+        if (int(bits.peek(nbits - 1)) < max) {
+            count = int(bits.read(nbits - 1));
+        } else {
+            count = int(bits.read(nbits));
+            if (count >= threshold) count -= max;
+        }
+        count--;                         // -1 encodes "less than 1"
+        if (!bits.ok()) return -1;
+        remaining -= count < 0 ? -count : count;
+        if (remaining < 1 || sym > maxSym) return -1;
+        norm[sym++] = int16_t(count);
+        prev_zero = (count == 0);
+        while (remaining < threshold) { nbits--; threshold >>= 1; }
+    }
+    if (remaining != 1 || !bits.ok()) return -1;
+    if (!fse_build(norm, sym, log, T)) return -1;
+    return bits.bytes_used();
+}
+
+// ---- predefined sequence tables (RFC 8878 §3.1.1.3.2.2) --------------------
+
+const int16_t kLLDefault[36] = {
+    4, 3, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 1, 1, 1,
+    2, 2, 2, 2, 2, 2, 2, 2, 2, 3, 2, 1, 1, 1, 1, 1,
+    -1, -1, -1, -1};
+const int16_t kMLDefault[53] = {
+    1, 4, 3, 2, 2, 2, 2, 2, 2, 1, 1, 1, 1, 1, 1, 1,
+    1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1,
+    1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, -1, -1,
+    -1, -1, -1, -1, -1};
+const int16_t kOFDefault[29] = {
+    1, 1, 1, 1, 1, 1, 2, 2, 2, 1, 1, 1, 1, 1, 1, 1,
+    1, 1, 1, 1, 1, 1, 1, 1, -1, -1, -1, -1, -1};
+
+// Code -> (baseline, extra bits) for literal lengths / match lengths.
+const uint32_t kLLBase[36] = {
+    0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15,
+    16, 18, 20, 22, 24, 28, 32, 40, 48, 64, 128, 256, 512, 1024,
+    2048, 4096, 8192, 16384, 32768, 65536};
+const uint8_t kLLBits[36] = {
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    1, 1, 1, 1, 2, 2, 3, 3, 4, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16};
+const uint32_t kMLBase[53] = {
+    3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18,
+    19, 20, 21, 22, 23, 24, 25, 26, 27, 28, 29, 30, 31, 32, 33, 34,
+    35, 37, 39, 41, 43, 47, 51, 59, 67, 83, 99, 131, 259, 515,
+    1027, 2051, 4099, 8195, 16387, 32771, 65539};
+const uint8_t kMLBits[53] = {
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    1, 1, 1, 1, 2, 2, 3, 3, 4, 4, 5, 7, 8, 9, 10, 11,
+    12, 13, 14, 15, 16};
+
+// ---- Huffman ---------------------------------------------------------------
+
+struct HufTable {
+    std::vector<uint8_t> symbol;
+    std::vector<uint8_t> nbBits;
+    int log = -1;
+    bool set() const { return log >= 0; }
+};
+
+// weights[0..n-1] (explicit + inferred last already appended) -> table.
+bool huf_build(const uint8_t* weights, int n, int maxBits, HufTable& H) {
+    if (maxBits <= 0 || maxBits > kMaxHufLog || n < 2 || n > 256)
+        return false;
+    const int size = 1 << maxBits;
+    H.log = maxBits;
+    H.symbol.assign(size, 0);
+    H.nbBits.assign(size, 0);
+    int pos = 0;
+    for (int w = 1; w <= maxBits; w++) {
+        for (int s = 0; s < n; s++) {
+            if (weights[s] != w) continue;
+            const int count = 1 << (w - 1);
+            const int nb = maxBits + 1 - w;
+            if (pos + count > size) return false;
+            for (int i = 0; i < count; i++) {
+                H.symbol[pos] = uint8_t(s);
+                H.nbBits[pos] = uint8_t(nb);
+                pos++;
+            }
+        }
+    }
+    return pos == size;
+}
+
+// Huffman tree description (RFC 8878 §4.2.1) -> table.  Returns header
+// bytes consumed, or -1.
+int64_t huf_parse(const uint8_t* src, int64_t n, HufTable& H) {
+    if (n < 1) return -1;
+    const int hbyte = src[0];
+    uint8_t weights[256];
+    int nw = 0;
+    int64_t used;
+    if (hbyte >= 128) {                  // direct: 4-bit packed weights
+        nw = hbyte - 127;
+        const int64_t bytes = (nw + 1) / 2;
+        if (1 + bytes > n) return -1;
+        for (int i = 0; i < nw; i++) {
+            const uint8_t b = src[1 + i / 2];
+            weights[i] = (i & 1) ? (b & 0x0F) : (b >> 4);
+        }
+        used = 1 + bytes;
+    } else {                             // FSE-compressed weights
+        if (hbyte == 0 || 1 + hbyte > n) return -1;
+        FSETable WT;
+        const int64_t hdr = fse_parse(src + 1, hbyte, kMaxWtLog, 255, WT);
+        if (hdr < 0 || hdr >= hbyte) return -1;
+        BackBits bits;
+        if (!bits.init(src + 1 + hdr, hbyte - hdr)) return -1;
+        uint32_t s1 = uint32_t(bits.read(WT.log));
+        uint32_t s2 = uint32_t(bits.read(WT.log));
+        if (bits.bad) return -1;
+        // two interleaved states; a state update that over-reads ends
+        // the stream — flush the OTHER state's symbol and stop
+        uint32_t* cur = &s1;
+        uint32_t* oth = &s2;
+        for (;;) {
+            if (nw >= 255) return -1;
+            weights[nw++] = WT.symbol[*cur];
+            const int nb = WT.nbBits[*cur];
+            const uint32_t ns = WT.newState[*cur] + uint32_t(bits.read(nb));
+            if (bits.bad) {
+                if (nw >= 255) return -1;
+                weights[nw++] = WT.symbol[*oth];
+                break;
+            }
+            *cur = ns;
+            uint32_t* t = cur; cur = oth; oth = t;
+        }
+        used = 1 + hbyte;
+    }
+    // infer the final weight: totals must complete a power of two
+    uint64_t sum = 0;
+    for (int i = 0; i < nw; i++) {
+        if (weights[i] > kMaxHufLog) return -1;
+        if (weights[i]) sum += 1ull << (weights[i] - 1);
+    }
+    if (sum == 0) return -1;
+    const int maxBits = highbit(sum) + 1;
+    if (maxBits > kMaxHufLog) return -1;
+    const uint64_t rest = (1ull << maxBits) - sum;
+    if (rest == 0 || (rest & (rest - 1)) != 0) return -1;  // must be 2^k
+    if (nw >= 256) return -1;
+    weights[nw++] = uint8_t(highbit(rest) + 1);
+    if (!huf_build(weights, nw, maxBits, H)) return -1;
+    return used;
+}
+
+// Decode exactly `count` symbols from one backward Huffman stream.
+bool huf_stream(const HufTable& H, const uint8_t* src, int64_t n,
+                uint8_t* dst, int64_t count) {
+    BackBits bits;
+    if (!bits.init(src, n)) return false;
+    for (int64_t i = 0; i < count; i++) {
+        const uint32_t idx = uint32_t(bits.peek(H.log));
+        dst[i] = H.symbol[idx];
+        bits.consume(H.nbBits[idx]);
+        if (bits.bad) return false;
+    }
+    return bits.done();                  // all bits must be consumed
+}
+
+// ---- frame decoding state --------------------------------------------------
+
+struct FrameState {                      // persists across blocks
+    HufTable huf;                        // for treeless literals
+    FSETable ll, of, ml;                 // for repeat mode
+    uint32_t rep[3] = {1, 4, 8};         // repeat offsets
+};
+
+// Decode the literals section.  Appends regenerated literals to `lits`
+// and returns bytes of the block consumed, or -1.
+int64_t decode_literals(const uint8_t* src, int64_t n, FrameState& fs,
+                        std::vector<uint8_t>& lits) {
+    if (n < 1) return -1;
+    const int type = src[0] & 3;
+    const int sf = (src[0] >> 2) & 3;
+    int64_t regen, comp = -1, hdr;
+    if (type <= 1) {                     // Raw / RLE
+        if (sf == 0 || sf == 2) { regen = src[0] >> 3; hdr = 1; }
+        else if (sf == 1) {
+            if (n < 2) return -1;
+            regen = (src[0] >> 4) | (int64_t(src[1]) << 4); hdr = 2;
+        } else {
+            if (n < 3) return -1;
+            regen = (src[0] >> 4) | (int64_t(src[1]) << 4)
+                  | (int64_t(src[2]) << 12);
+            hdr = 3;
+        }
+    } else {                             // Compressed / Treeless
+        if (sf <= 1) {
+            if (n < 3) return -1;
+            regen = (src[0] >> 4) | (int64_t(src[1] & 0x3F) << 4);
+            comp = (src[1] >> 6) | (int64_t(src[2]) << 2);
+            hdr = 3;
+        } else if (sf == 2) {
+            if (n < 4) return -1;
+            regen = (src[0] >> 4) | (int64_t(src[1]) << 4)
+                  | (int64_t(src[2] & 3) << 12);
+            comp = (src[2] >> 2) | (int64_t(src[3]) << 6);
+            hdr = 4;
+        } else {
+            if (n < 5) return -1;
+            regen = (src[0] >> 4) | (int64_t(src[1]) << 4)
+                  | (int64_t(src[2] & 0x3F) << 12);
+            comp = (src[2] >> 6) | (int64_t(src[3]) << 2)
+                 | (int64_t(src[4]) << 10);
+            hdr = 5;
+        }
+    }
+    if (regen > kBlockMax) return -1;
+    const size_t base = lits.size();
+    switch (type) {
+    case 0: {                            // Raw
+        if (hdr + regen > n) return -1;
+        lits.insert(lits.end(), src + hdr, src + hdr + regen);
+        return hdr + regen;
+    }
+    case 1: {                            // RLE
+        if (hdr + 1 > n) return -1;
+        lits.insert(lits.end(), size_t(regen), src[hdr]);
+        return hdr + 1;
+    }
+    default: {                           // Compressed (2) / Treeless (3)
+        if (hdr + comp > n) return -1;
+        const uint8_t* body = src + hdr;
+        int64_t left = comp;
+        if (type == 2) {
+            const int64_t used = huf_parse(body, left, fs.huf);
+            if (used < 0) return -1;
+            body += used;
+            left -= used;
+        } else if (!fs.huf.set()) {
+            return -1;                   // treeless before any tree
+        }
+        lits.resize(base + regen);
+        uint8_t* out = lits.data() + base;
+        if (sf == 0) {                   // single stream
+            if (!huf_stream(fs.huf, body, left, out, regen)) return -1;
+        } else {                         // 4 streams, 6-byte jump table
+            if (left < 6) return -1;
+            const int64_t s1 = body[0] | (int64_t(body[1]) << 8);
+            const int64_t s2 = body[2] | (int64_t(body[3]) << 8);
+            const int64_t s3 = body[4] | (int64_t(body[5]) << 8);
+            const int64_t s4 = left - 6 - s1 - s2 - s3;
+            if (s4 <= 0) return -1;
+            const int64_t per = (regen + 3) / 4;
+            const int64_t last = regen - 3 * per;
+            if (last < 0) return -1;
+            const uint8_t* q = body + 6;
+            if (!huf_stream(fs.huf, q, s1, out, per)) return -1;
+            if (!huf_stream(fs.huf, q + s1, s2, out + per, per)) return -1;
+            if (!huf_stream(fs.huf, q + s1 + s2, s3, out + 2 * per, per))
+                return -1;
+            if (!huf_stream(fs.huf, q + s1 + s2 + s3, s4, out + 3 * per,
+                            last))
+                return -1;
+        }
+        return hdr + comp;
+    }
+    }
+}
+
+// One sequence-table slot: predefined / RLE / FSE / repeat.  Every
+// mode stores into the frame-persistent slot, because Repeat reuses
+// whatever the PREVIOUS block used — including a predefined or RLE
+// table (libzstd keeps the last-used table of any kind).
+// Returns bytes consumed from the description area, or -1.
+int64_t seq_table(int mode, const uint8_t* src, int64_t n,
+                  const int16_t* dflt, int dfltN, int dfltLog,
+                  int maxLog, int maxSym, FSETable& persist) {
+    switch (mode) {
+    case 0:                              // predefined
+        if (!fse_build(dflt, dfltN, dfltLog, persist)) return -1;
+        return 0;
+    case 1:                              // RLE: one byte = the symbol
+        if (n < 1 || src[0] > maxSym) return -1;
+        fse_rle(persist, src[0]);
+        return 1;
+    case 2: {                            // FSE description
+        const int64_t used = fse_parse(src, n, maxLog, maxSym, persist);
+        if (used < 0) return -1;
+        return used;
+    }
+    default:                             // repeat
+        if (!persist.set()) return -1;
+        return 0;
+    }
+}
+
+// Decode one compressed block into `out`.  `frameBase` = out.size()
+// at the start of the frame — match offsets may not reach before it
+// (no dictionary, and never into a PREVIOUS concatenated frame).
+// Returns 0 or an error code.
+int64_t decode_block(const uint8_t* src, int64_t n, FrameState& fs,
+                     std::vector<uint8_t>& out, size_t frameBase) {
+    std::vector<uint8_t> lits;
+    const int64_t lused = decode_literals(src, n, fs, lits);
+    if (lused < 0) return ERR_CORRUPT;
+    src += lused;
+    n -= lused;
+    // sequences header
+    if (n < 1) return ERR_CORRUPT;
+    int64_t nseq;
+    int64_t hdr;
+    if (src[0] == 0) { nseq = 0; hdr = 1; }
+    else if (src[0] < 128) { nseq = src[0]; hdr = 1; }
+    else if (src[0] < 255) {
+        if (n < 2) return ERR_CORRUPT;
+        nseq = (int64_t(src[0] - 128) << 8) + src[1];
+        hdr = 2;
+    } else {
+        if (n < 3) return ERR_CORRUPT;
+        nseq = src[1] + (int64_t(src[2]) << 8) + 0x7F00;
+        hdr = 3;
+    }
+    src += hdr;
+    n -= hdr;
+    if (nseq == 0) {                     // literals only
+        out.insert(out.end(), lits.begin(), lits.end());
+        return n == 0 ? 0 : ERR_CORRUPT;
+    }
+    if (n < 1) return ERR_CORRUPT;
+    const int mode = src[0];
+    if (mode & 3) return ERR_CORRUPT;    // reserved bits
+    src += 1;
+    n -= 1;
+    int64_t used = seq_table((mode >> 6) & 3, src, n, kLLDefault, 36, 6,
+                             kMaxLLLog, 35, fs.ll);
+    if (used < 0) return ERR_CORRUPT;
+    src += used; n -= used;
+    used = seq_table((mode >> 4) & 3, src, n, kOFDefault, 29, 5,
+                     kMaxOFLog, 31, fs.of);
+    if (used < 0) return ERR_CORRUPT;
+    src += used; n -= used;
+    used = seq_table((mode >> 2) & 3, src, n, kMLDefault, 53, 6,
+                     kMaxMLLog, 52, fs.ml);
+    if (used < 0) return ERR_CORRUPT;
+    src += used; n -= used;
+    const FSETable *ll = &fs.ll, *of = &fs.of, *ml = &fs.ml;
+    // the rest of the block is the backward sequence bitstream
+    BackBits bits;
+    if (!bits.init(src, n)) return ERR_CORRUPT;
+    uint32_t llS = uint32_t(bits.read(ll->log));
+    uint32_t ofS = uint32_t(bits.read(of->log));
+    uint32_t mlS = uint32_t(bits.read(ml->log));
+    if (bits.bad) return ERR_CORRUPT;
+    size_t litPos = 0;
+    const size_t blockBase = out.size();
+    for (int64_t i = 0; i < nseq; i++) {
+        const int ofCode = of->symbol[ofS];
+        if (ofCode > 31) return ERR_CORRUPT;
+        const uint64_t ofVal = (1ull << ofCode) + bits.read(ofCode);
+        const int mlCode = ml->symbol[mlS];
+        const uint64_t mlen = kMLBase[mlCode] + bits.read(kMLBits[mlCode]);
+        const int llCode = ll->symbol[llS];
+        const uint64_t llen = kLLBase[llCode] + bits.read(kLLBits[llCode]);
+        if (bits.bad) return ERR_CORRUPT;
+        // repeat-offset resolution (RFC 8878 §3.1.1.5)
+        uint32_t offset;
+        if (ofVal > 3) {
+            offset = uint32_t(ofVal - 3);
+            fs.rep[2] = fs.rep[1];
+            fs.rep[1] = fs.rep[0];
+            fs.rep[0] = offset;
+        } else {
+            const uint64_t idx = ofVal - 1 + (llen == 0 ? 1 : 0);
+            if (idx == 0) {
+                offset = fs.rep[0];
+            } else if (idx == 1) {
+                offset = fs.rep[1];
+                fs.rep[1] = fs.rep[0];
+                fs.rep[0] = offset;
+            } else if (idx == 2) {
+                offset = fs.rep[2];
+                fs.rep[2] = fs.rep[1];
+                fs.rep[1] = fs.rep[0];
+                fs.rep[0] = offset;
+            } else {                     // idx == 3: rep[0] - 1
+                if (fs.rep[0] <= 1) return ERR_CORRUPT;
+                offset = fs.rep[0] - 1;
+                fs.rep[2] = fs.rep[1];
+                fs.rep[1] = fs.rep[0];
+                fs.rep[0] = offset;
+            }
+            if (offset == 0) return ERR_CORRUPT;
+        }
+        if (i + 1 < nseq) {              // update states: LL, ML, OF
+            llS = ll->newState[llS] + uint32_t(bits.read(ll->nbBits[llS]));
+            mlS = ml->newState[mlS] + uint32_t(bits.read(ml->nbBits[mlS]));
+            ofS = of->newState[ofS] + uint32_t(bits.read(of->nbBits[ofS]));
+            if (bits.bad) return ERR_CORRUPT;
+        }
+        // execute
+        if (litPos + llen > lits.size()) return ERR_CORRUPT;
+        out.insert(out.end(), lits.begin() + litPos,
+                   lits.begin() + litPos + llen);
+        litPos += llen;
+        if (offset > out.size() - frameBase) return ERR_CORRUPT;
+        if (out.size() - blockBase + mlen > size_t(kBlockMax) + lits.size())
+            return ERR_CORRUPT;          // runaway guard
+        size_t from = out.size() - offset;
+        for (uint64_t k = 0; k < mlen; k++)
+            out.push_back(out[from + k]);   // overlap-safe byte copy
+    }
+    if (!bits.done()) return ERR_CORRUPT;
+    out.insert(out.end(), lits.begin() + litPos, lits.end());
+    return 0;
+}
+
+// Decode one regular frame starting after its magic.  Advances *pos
+// past the frame.  Appends to `out`.
+int64_t decode_frame(const uint8_t* src, int64_t n, int64_t* pos,
+                     std::vector<uint8_t>& out, int64_t cap) {
+    int64_t p = *pos;
+    if (p >= n) return ERR_CORRUPT;
+    const uint8_t fhd = src[p++];
+    if (fhd & 0x08) return ERR_CORRUPT;  // reserved bit
+    const int fcsFlag = fhd >> 6;
+    const bool single = (fhd >> 5) & 1;
+    const bool checksum = (fhd >> 2) & 1;
+    const int dictFlag = fhd & 3;
+    if (!single) {
+        if (p >= n) return ERR_CORRUPT;
+        p++;                             // window descriptor (unused:
+    }                                    // we bound blocks by kBlockMax)
+    static const int kDictBytes[4] = {0, 1, 2, 4};
+    uint32_t dictId = 0;
+    for (int i = 0; i < kDictBytes[dictFlag]; i++) {
+        if (p >= n) return ERR_CORRUPT;
+        dictId |= uint32_t(src[p++]) << (8 * i);
+    }
+    if (dictId != 0) return ERR_UNSUPPORTED;
+    int fcsBytes = 0;
+    if (fcsFlag == 0) fcsBytes = single ? 1 : 0;
+    else if (fcsFlag == 1) fcsBytes = 2;
+    else if (fcsFlag == 2) fcsBytes = 4;
+    else fcsBytes = 8;
+    uint64_t fcs = 0;
+    for (int i = 0; i < fcsBytes; i++) {
+        if (p >= n) return ERR_CORRUPT;
+        fcs |= uint64_t(src[p++]) << (8 * i);
+    }
+    if (fcsBytes == 2) fcs += 256;
+    const size_t frameBase = out.size();
+    FrameState fs;
+    for (;;) {
+        if (p + 3 > n) return ERR_CORRUPT;
+        const uint32_t bh = src[p] | (uint32_t(src[p + 1]) << 8)
+                          | (uint32_t(src[p + 2]) << 16);
+        p += 3;
+        const bool last = bh & 1;
+        const int btype = (bh >> 1) & 3;
+        const int64_t bsize = bh >> 3;
+        if (btype == 3) return ERR_CORRUPT;
+        const size_t before = out.size();
+        if (btype == 0) {                // raw
+            if (p + bsize > n || bsize > kBlockMax) return ERR_CORRUPT;
+            if (int64_t(out.size()) + bsize > cap) return ERR_DSTSIZE;
+            out.insert(out.end(), src + p, src + p + bsize);
+            p += bsize;
+        } else if (btype == 1) {         // RLE: bsize = regenerated size
+            if (p + 1 > n) return ERR_CORRUPT;
+            if (bsize > kBlockMax) return ERR_CORRUPT;
+            if (int64_t(out.size()) + bsize > cap) return ERR_DSTSIZE;
+            out.insert(out.end(), size_t(bsize), src[p]);
+            p += 1;
+        } else {                         // compressed
+            if (p + bsize > n || bsize < 1) return ERR_CORRUPT;
+            if (int64_t(out.size()) + kBlockMax > cap) return ERR_DSTSIZE;
+            const int64_t rc = decode_block(src + p, bsize, fs, out,
+                                            frameBase);
+            if (rc != 0) return rc;
+            p += bsize;
+        }
+        if (out.size() - before > size_t(kBlockMax)) return ERR_CORRUPT;
+        if (last) break;
+    }
+    if (fcsBytes && out.size() - frameBase != fcs) return ERR_CORRUPT;
+    if (checksum) {
+        if (p + 4 > n) return ERR_CORRUPT;
+        const uint32_t want = load32le(src + p);
+        p += 4;
+        const uint32_t got = uint32_t(
+            xxh64(out.data() + frameBase, out.size() - frameBase, 0));
+        if (want != got) return ERR_CORRUPT;
+    }
+    *pos = p;
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t zstd_decompress(const uint8_t* src, int64_t n,
+                        uint8_t* dst, int64_t cap) {
+    if (n < 0 || cap < 0) return ERR_CORRUPT;
+    std::vector<uint8_t> out;
+    out.reserve(size_t(cap < (1 << 20) ? cap : (1 << 20)));
+    int64_t pos = 0;
+    while (pos < n) {
+        if (pos + 4 > n) return ERR_CORRUPT;
+        const uint32_t magic = load32le(src + pos);
+        if ((magic & 0xFFFFFFF0u) == kSkipMagicBase) {
+            if (pos + 8 > n) return ERR_CORRUPT;
+            const int64_t sz = load32le(src + pos + 4);
+            if (pos + 8 + sz > n) return ERR_CORRUPT;
+            pos += 8 + sz;
+            continue;
+        }
+        if (magic != kMagic) return ERR_CORRUPT;
+        pos += 4;
+        const int64_t rc = decode_frame(src, n, &pos, out, cap);
+        if (rc != 0) return rc;
+    }
+    if (int64_t(out.size()) > cap) return ERR_DSTSIZE;
+    std::memcpy(dst, out.data(), out.size());
+    return int64_t(out.size());
+}
+
+int64_t zstd_content_size(const uint8_t* src, int64_t n) {
+    int64_t pos = 0, total = 0;
+    while (pos < n) {
+        if (pos + 4 > n) return -1;
+        const uint32_t magic = load32le(src + pos);
+        if ((magic & 0xFFFFFFF0u) == kSkipMagicBase) {
+            if (pos + 8 > n) return -1;
+            pos += 8 + load32le(src + pos + 4);
+            continue;
+        }
+        if (magic != kMagic) return -1;
+        if (pos + 5 > n) return -1;
+        const uint8_t fhd = src[pos + 4];
+        const int fcsFlag = fhd >> 6;
+        const bool single = (fhd >> 5) & 1;
+        if (fcsFlag == 0 && !single) return -1;   // size not declared
+        int64_t p = pos + 5 + (single ? 0 : 1);
+        static const int kDictBytes[4] = {0, 1, 2, 4};
+        p += kDictBytes[fhd & 3];
+        const int fcsBytes = fcsFlag == 0 ? 1 : fcsFlag == 1 ? 2
+                           : fcsFlag == 2 ? 4 : 8;
+        if (p + fcsBytes > n) return -1;
+        uint64_t fcs = 0;
+        for (int i = 0; i < fcsBytes; i++)
+            fcs |= uint64_t(src[p + i]) << (8 * i);
+        if (fcsBytes == 2) fcs += 256;
+        total += int64_t(fcs);
+        // cheap skip: we cannot know the frame's end without walking
+        // blocks; callers only use this when ONE frame spans the input
+        return total;
+    }
+    return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,196 @@
+"""Zstandard: ctypes front for zstd.cpp (RFC 8878 decoder) plus a
+store-mode frame writer, wired as Kafka record-batch codec 4
+(SURVEY.md §2.4 — the zstd-erlang/NIF analog).
+
+Posture mirrors the snappy/lz4 modules, with one honest asymmetry:
+
+* **decode** is the full format (Huffman literals, FSE sequences,
+  repeat offsets, checksums) in ``zstd.cpp`` — the Kafka FETCH side,
+  where the broker must accept whatever a Java producer emitted;
+* **encode** emits store-mode frames (raw blocks, single-segment,
+  declared content size) from pure Python — valid zstd that ANY
+  consumer decodes, at ratio 1.0.  Hand-rolling the FSE/Huffman
+  *encoder* is not worth its surface for a producer option the
+  operator can simply set to ``snappy``/``lz4``/``gzip`` for real
+  ratio; the seam is ``compress_frame``.
+
+Interop against system libzstd (both directions) is proven in
+``tests/test_zstd.py``.  Without a toolchain ``available()`` is False
+and the Kafka fetch path keeps its previous skip-with-offset-advance
+behavior for zstd batches.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import List
+
+from .build import load_library
+
+__all__ = ["available", "compress_frame", "decompress_frame"]
+
+_MAGIC = 0xFD2FB528
+_BLOCK_MAX = 1 << 17            # spec Block_Maximum_Size ceiling
+_MAX_OUTPUT = 256 << 20         # same hostile-input cap as lz4/snappy
+
+_lib = None
+_loaded = False
+
+
+def _load():
+    global _lib, _loaded
+    if not _loaded:
+        _loaded = True
+        lib = load_library("zstd")
+        if lib is not None:
+            lib.zstd_decompress.restype = ctypes.c_int64
+            lib.zstd_decompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_char), ctypes.c_int64]
+            lib.zstd_content_size.restype = ctypes.c_int64
+            lib.zstd_content_size.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def decompress_frame(data: bytes) -> bytes:
+    """Decode a (possibly multi-)frame zstd stream.  Full decode needs
+    the native decoder; without a toolchain, a pure-Python fallback
+    still decodes STORE-MODE frames (raw/RLE blocks — everything
+    ``compress_frame`` emits), so a bridge's own production always
+    round-trips.  Raises RuntimeError for entropy-coded frames when no
+    native decoder exists (caller skips the batch), ValueError on
+    corrupt/unsupported input."""
+    lib = _load()
+    if lib is None:
+        return _py_store_decompress(data)
+    hint = lib.zstd_content_size(data, len(data))
+    if hint >= 0:
+        cap = min(_MAX_OUTPUT, hint + _BLOCK_MAX)
+    else:
+        cap = min(_MAX_OUTPUT, max(1 << 20, len(data) * 8))
+    while True:
+        dst = ctypes.create_string_buffer(max(1, cap))
+        n = lib.zstd_decompress(data, len(data), dst, cap)
+        if n >= 0:
+            return dst.raw[:n]
+        if n == -2 and cap < _MAX_OUTPUT:        # grow and retry
+            cap = min(_MAX_OUTPUT, cap * 4)
+            continue
+        if n == -3:
+            raise ValueError("zstd: dictionary frames unsupported")
+        raise ValueError("zstd: corrupt frame")
+
+
+def _py_store_decompress(data: bytes) -> bytes:
+    """Toolchain-less fallback: decode frames whose blocks are all
+    raw/RLE (store mode).  A compressed block means the frame needs
+    the native decoder -> RuntimeError, which the Kafka fetch path
+    maps to skip-with-offset-advance.  Content checksums are NOT
+    verified here (no xxh64 without the native module); frame sizes
+    still are."""
+    try:
+        return _py_store_walk(data)
+    except IndexError:
+        # short reads past the end must surface as the same corrupt-
+        # input error class the native path raises (the Kafka fetch
+        # loop classifies on it)
+        raise ValueError("zstd: truncated frame")
+
+
+def _py_store_walk(data: bytes) -> bytes:
+    out = bytearray()
+    pos = 0
+    n = len(data)
+    while pos < n:
+        if pos + 4 > n:
+            raise ValueError("zstd: truncated magic")
+        magic = int.from_bytes(data[pos:pos + 4], "little")
+        if (magic & 0xFFFFFFF0) == 0x184D2A50:       # skippable frame
+            if pos + 8 > n:
+                raise ValueError("zstd: truncated skippable frame")
+            pos += 8 + int.from_bytes(data[pos + 4:pos + 8], "little")
+            if pos > n:
+                raise ValueError("zstd: truncated skippable frame")
+            continue
+        if magic != _MAGIC:
+            raise ValueError("zstd: bad magic")
+        pos += 4
+        if pos >= n:
+            raise ValueError("zstd: truncated frame header")
+        fhd = data[pos]
+        pos += 1
+        if fhd & 0x08:
+            raise ValueError("zstd: reserved FHD bit")
+        single = (fhd >> 5) & 1
+        if not single:
+            pos += 1                                 # window descriptor
+        dict_bytes = (0, 1, 2, 4)[fhd & 3]
+        if dict_bytes and any(data[pos:pos + dict_bytes]):
+            raise ValueError("zstd: dictionary frames unsupported")
+        pos += dict_bytes
+        fcs_flag = fhd >> 6
+        fcs_bytes = (1 if single else 0, 2, 4, 8)[fcs_flag]
+        fcs = int.from_bytes(data[pos:pos + fcs_bytes], "little") \
+            + (256 if fcs_bytes == 2 else 0) if fcs_bytes else None
+        pos += fcs_bytes
+        frame_base = len(out)
+        while True:
+            if pos + 3 > n:
+                raise ValueError("zstd: truncated block header")
+            bh = int.from_bytes(data[pos:pos + 3], "little")
+            pos += 3
+            last, btype, bsize = bh & 1, (bh >> 1) & 3, bh >> 3
+            if btype == 0:                           # raw
+                if pos + bsize > n:
+                    raise ValueError("zstd: truncated raw block")
+                out += data[pos:pos + bsize]
+                pos += bsize
+            elif btype == 1:                         # RLE
+                if pos + 1 > n or bsize > _BLOCK_MAX:
+                    raise ValueError("zstd: bad RLE block")
+                out += data[pos:pos + 1] * bsize
+                pos += 1
+            else:
+                raise RuntimeError(
+                    "zstd: compressed frame needs the native decoder")
+            if len(out) > _MAX_OUTPUT:
+                raise ValueError("zstd: output exceeds cap")
+            if last:
+                break
+        if fhd & 0x04:                               # checksum present
+            pos += 4                                 # not verified here
+        if fcs is not None and len(out) - frame_base != fcs:
+            raise ValueError("zstd: content size mismatch")
+    return bytes(out)
+
+
+def compress_frame(data: bytes) -> bytes:
+    """One store-mode zstd frame: single-segment, declared content
+    size, raw blocks (ratio 1.0 — see module docstring)."""
+    n = len(data)
+    if n < 256:
+        fhd, fcs = 0x20, struct.pack("<B", n)
+    elif n < 65536 + 256:
+        fhd, fcs = 0x60, struct.pack("<H", n - 256)
+    elif n < 1 << 32:
+        fhd, fcs = 0xA0, struct.pack("<I", n)
+    else:
+        fhd, fcs = 0xE0, struct.pack("<Q", n)
+    out: List[bytes] = [struct.pack("<I", _MAGIC), bytes([fhd]), fcs]
+    if n == 0:
+        out.append(b"\x01\x00\x00")              # last empty raw block
+        return b"".join(out)
+    for i in range(0, n, _BLOCK_MAX):
+        blk = data[i:i + _BLOCK_MAX]
+        last = 1 if i + _BLOCK_MAX >= n else 0
+        bh = (len(blk) << 3) | last              # type 0 = raw
+        out.append(struct.pack("<I", bh)[:3])
+        out.append(blk)
+    return b"".join(out)

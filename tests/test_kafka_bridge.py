@@ -354,11 +354,11 @@ def test_parse_batches_skips_compressed_and_control():
     import struct as S
 
     b1 = record_batch([(None, b"plain")], base_offset=0)
-    # forge a zstd-flagged batch (gzip/snappy/lz4 all decode now):
-    # flip the attrs bits and re-CRC
+    # forge a reserved-codec batch (gzip/snappy/lz4/zstd all decode
+    # now): flip the attrs bits and re-CRC
     comp = bytearray(record_batch([(None, b"zzz")], base_offset=1))
     after = bytearray(comp[21:])
-    S.pack_into("!h", after, 0, 4)                 # attrs: zstd codec
+    S.pack_into("!h", after, 0, 6)                 # attrs: reserved codec
     S.pack_into("!I", comp, 17, crc32c(bytes(after)))
     comp[21:] = after
     recs, nxt, skipped = parse_batches(b1 + bytes(comp))

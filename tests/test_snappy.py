@@ -106,23 +106,43 @@ def test_record_batch_snappy_smaller_on_redundant_payloads():
         < len(record_batch(recs)) // 4
 
 
-def test_zstd_batch_still_skipped_with_offset_advance():
+def test_reserved_codec_batch_skipped_with_offset_advance():
+    """Codec bits 5-7 are reserved/unknown: skip, never stall (zstd
+    — codec 4 — now DECODES; see test_zstd.py)."""
     batch = bytearray(record_batch([(b"k", b"v")]))
-    # flip the codec bits to zstd (4) and re-CRC
     import struct
     attrs_off = 21
-    struct.pack_into("!h", batch, attrs_off, 4)
+    struct.pack_into("!h", batch, attrs_off, 6)
     after = bytes(batch[attrs_off:])
     struct.pack_into("!I", batch, 17, crc32c(after))
     out, nxt, skipped = parse_batches(bytes(batch))
     assert out == [] and skipped == 1 and nxt == 1
 
 
+def test_zstd_codec_bit_with_garbage_payload_is_an_error():
+    """A batch FLAGGED zstd whose records section is not a zstd frame
+    is a producer bug (CRC already passed) — surfaced as KafkaError,
+    not silently skipped."""
+    from emqx_tpu.bridge.kafka import KafkaError
+    from emqx_tpu.native import zstd as _zs
+    if not _zs.available():
+        pytest.skip("no native toolchain")
+    batch = bytearray(record_batch([(b"k", b"v")]))
+    import struct
+    attrs_off = 21
+    struct.pack_into("!h", batch, attrs_off, 4)
+    after = bytes(batch[attrs_off:])
+    struct.pack_into("!I", batch, 17, crc32c(after))
+    with pytest.raises(KafkaError):
+        parse_batches(bytes(batch))
+
+
 def test_kafka_connector_rejects_unknown_codec():
     from emqx_tpu.bridge.kafka import KafkaConnector
     with pytest.raises(ValueError):
-        KafkaConnector({"compression": "zstd"})
+        KafkaConnector({"compression": "brotli"})
     KafkaConnector({"compression": "snappy"})     # accepted
+    KafkaConnector({"compression": "zstd"})       # accepted (round 5)
     KafkaConnector({"compression": "none"})
     KafkaConnector({})
 

@@ -1,0 +1,249 @@
+"""Zstandard decoder (`native/zstd.cpp` via `native/zstd.py`) +
+store-mode frame writer, cross-validated against SYSTEM libzstd in
+both directions — the Kafka codec-4 fetch path must accept whatever a
+real (Java/librdkafka) producer emits, and real consumers must accept
+our store-mode frames."""
+
+import ctypes
+import ctypes.util
+import os
+import random
+import struct
+
+import pytest
+
+from emqx_tpu.native import zstd
+
+# ZSTD_CCtx_setParameter enums (public zstd.h ABI)
+_C_LEVEL = 100
+_C_WINDOWLOG = 101
+_C_CONTENTSIZE = 200
+_C_CHECKSUM = 201
+
+_SYS = None
+
+
+def _syszstd():
+    global _SYS
+    if _SYS is None:
+        path = ctypes.util.find_library("zstd") or "libzstd.so.1"
+        try:
+            lib = ctypes.CDLL(path)
+            lib.ZSTD_compress.restype = ctypes.c_size_t
+            lib.ZSTD_decompress.restype = ctypes.c_size_t
+            lib.ZSTD_compressBound.restype = ctypes.c_size_t
+            lib.ZSTD_isError.restype = ctypes.c_uint
+            lib.ZSTD_createCCtx.restype = ctypes.c_void_p
+            lib.ZSTD_freeCCtx.restype = ctypes.c_size_t
+            lib.ZSTD_freeCCtx.argtypes = [ctypes.c_void_p]
+            lib.ZSTD_CCtx_setParameter.restype = ctypes.c_size_t
+            lib.ZSTD_CCtx_setParameter.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+            lib.ZSTD_compress2.restype = ctypes.c_size_t
+            lib.ZSTD_compress2.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t]
+            _SYS = lib
+        except OSError:
+            _SYS = False
+    return _SYS or None
+
+
+def _ref_compress(data: bytes, level: int = 3,
+                  checksum: bool = False) -> bytes:
+    lib = _syszstd()
+    cap = lib.ZSTD_compressBound(len(data))
+    dst = ctypes.create_string_buffer(max(64, cap))
+    if checksum:
+        cctx = lib.ZSTD_createCCtx()
+        assert cctx
+        try:
+            lib.ZSTD_CCtx_setParameter(cctx, _C_LEVEL, level)
+            lib.ZSTD_CCtx_setParameter(cctx, _C_CHECKSUM, 1)
+            n = lib.ZSTD_compress2(cctx, dst, cap, data, len(data))
+        finally:
+            lib.ZSTD_freeCCtx(cctx)
+    else:
+        n = lib.ZSTD_compress(dst, cap, data, len(data), level)
+    assert not lib.ZSTD_isError(n)
+    return dst.raw[:n]
+
+
+def _ref_decompress(frame: bytes, want: int) -> bytes:
+    lib = _syszstd()
+    dst = ctypes.create_string_buffer(max(1, want))
+    n = lib.ZSTD_decompress(dst, want, frame, len(frame))
+    assert not lib.ZSTD_isError(n), "reference zstd rejected our frame"
+    return dst.raw[:n]
+
+
+def _cases():
+    random.seed(4013)
+    blob = os.urandom(256)
+    return [
+        b"",
+        b"q",
+        b"abc",
+        b"hello world " * 3,
+        b"\x00" * 300_000,                       # RLE blocks + rep offsets
+        b"ab" * 40_000,                          # tight matches
+        os.urandom(5000),                        # incompressible: raw lits
+        bytes(random.randrange(6) for _ in range(120_000)),
+        b"the quick brown fox jumps over the lazy dog " * 400,
+        b'{"topic":"t/1","qos":1,"payload":"' + blob.hex().encode()
+        + b'"}' * 100,
+        bytes(random.choice(blob) for _ in range(70_000)),
+        (b"x" * 131_072) + b"tail-after-block-boundary" + os.urandom(64),
+    ]
+
+
+def test_store_mode_roundtrip_own_decoder():
+    if not zstd.available():
+        pytest.skip("no native toolchain")
+    for d in _cases():
+        assert zstd.decompress_frame(zstd.compress_frame(d)) == d
+
+
+def test_store_mode_fcs_boundaries():
+    # the frame-content-size field changes width at these sizes
+    if not zstd.available():
+        pytest.skip("no native toolchain")
+    for n in (0, 1, 255, 256, 65791, 65792, 131072, 131073):
+        d = os.urandom(n)
+        f = zstd.compress_frame(d)
+        assert zstd.decompress_frame(f) == d
+
+
+def test_reference_encodings_decode():
+    """Every libzstd level exercises different block shapes: fast
+    levels lean on raw/RLE literals, high levels on 4-stream Huffman +
+    described FSE tables."""
+    if _syszstd() is None or not zstd.available():
+        pytest.skip("system libzstd or toolchain unavailable")
+    for level in (1, 3, 9, 19, 22):
+        for d in _cases():
+            frame = _ref_compress(d, level)
+            assert zstd.decompress_frame(frame) == d, \
+                f"level {level}, {len(d)} bytes"
+
+
+def test_reference_checksum_frames_verify():
+    if _syszstd() is None or not zstd.available():
+        pytest.skip("system libzstd or toolchain unavailable")
+    d = b"checksummed payload " * 2000
+    frame = _ref_compress(d, 3, checksum=True)
+    assert zstd.decompress_frame(frame) == d
+    # flip one payload bit: the xxh64 content checksum must catch it
+    # (pick a byte past the frame header)
+    bad = bytearray(frame)
+    bad[len(bad) // 2] ^= 0x01
+    with pytest.raises(ValueError):
+        zstd.decompress_frame(bytes(bad))
+
+
+def test_our_frames_decode_with_reference():
+    if _syszstd() is None:
+        pytest.skip("system libzstd unavailable")
+    for d in _cases():
+        frame = zstd.compress_frame(d)
+        assert _ref_decompress(frame, max(1, len(d))) == d, \
+            f"reference zstd rejected our store-mode frame ({len(d)}B)"
+
+
+def test_multi_frame_and_skippable():
+    if _syszstd() is None or not zstd.available():
+        pytest.skip("system libzstd or toolchain unavailable")
+    a, b = b"first frame " * 100, os.urandom(2000)
+    skippable = struct.pack("<II", 0x184D2A50, 5) + b"meta!"
+    stream = _ref_compress(a, 3) + skippable + _ref_compress(b, 19)
+    assert zstd.decompress_frame(stream) == a + b
+
+
+def test_corrupt_and_unsupported_frames():
+    if not zstd.available():
+        pytest.skip("no native toolchain")
+    good = _ref_compress(b"corruption target " * 500, 3) \
+        if _syszstd() else zstd.compress_frame(b"corruption target " * 500)
+    with pytest.raises(ValueError):
+        zstd.decompress_frame(b"\x00\x11\x22\x33garbage")
+    with pytest.raises(ValueError):
+        zstd.decompress_frame(good[:-4])             # truncated
+    # a frame declaring a dictionary ID is unsupported, not corrupt-
+    # crash: magic + FHD(dictFlag=1) + window + dictid + empty block
+    dict_frame = struct.pack("<I", 0xFD2FB528) + bytes([0x01, 0x38, 7]) \
+        + b"\x01\x00\x00"
+    with pytest.raises(ValueError, match="dict"):
+        zstd.decompress_frame(dict_frame)
+    # corrupt-bit sweep over a small frame must never crash or hang
+    frame = bytearray(good[:200] if len(good) > 200 else good)
+    for i in range(len(frame)):
+        bad = bytes(frame[:i]) + bytes([frame[i] ^ 0xA5]) \
+            + bytes(frame[i + 1:])
+        try:
+            zstd.decompress_frame(bad)
+        except ValueError:
+            pass
+
+
+def test_kafka_batch_zstd_roundtrip():
+    from emqx_tpu.bridge.kafka import parse_batches, record_batch
+    if not zstd.available():
+        pytest.skip("no native toolchain")
+    msgs = [(b"k%d" % i, b"payload-%d" % i * 20) for i in range(50)]
+    batch = record_batch(msgs, compression="zstd")
+    out, next_off, skipped = parse_batches(batch)
+    assert skipped == 0
+    assert [(k, v) for _, k, v in out] == msgs
+    assert next_off == 50
+
+
+def test_kafka_batch_java_producer_shape():
+    """A batch whose records section was compressed by REAL libzstd
+    (what a Java/librdkafka producer emits) must ingest whole."""
+    from emqx_tpu.bridge import kafka as kf
+    if _syszstd() is None or not zstd.available():
+        pytest.skip("system libzstd or toolchain unavailable")
+    msgs = [(None, b'{"n":%d}' % i) for i in range(200)]
+    recs = b"".join(
+        kf._record(i, 0, k, v) for i, (k, v) in enumerate(msgs))
+    comp = _ref_compress(recs, 3)
+    n = len(msgs)
+    after_crc = struct.pack("!hiqqqhii", 4, n - 1, 17, 17, -1, -1, -1,
+                            n) + comp
+    body = struct.pack("!iBI", -1, 2, kf.crc32c(after_crc)) + after_crc
+    batch = struct.pack("!qi", 0, len(body)) + body
+    out, next_off, skipped = kf.parse_batches(batch)
+    assert skipped == 0 and next_off == n
+    assert [(k, v) for _, k, v in out] == msgs
+
+
+def test_store_mode_fallback_without_native_decoder(monkeypatch):
+    """On a toolchain-less host the bridge's OWN zstd production must
+    still round-trip (pure-Python store-mode decode); entropy-coded
+    frames raise RuntimeError, which the fetch path maps to the legacy
+    skip-with-offset-advance."""
+    monkeypatch.setattr(zstd, "_lib", None)
+    monkeypatch.setattr(zstd, "_loaded", True)
+    assert not zstd.available()
+    for d in (b"", b"own production " * 999, os.urandom(200_000)):
+        assert zstd.decompress_frame(zstd.compress_frame(d)) == d
+    if _syszstd() is not None:
+        real = _ref_compress(b"entropy coded " * 500, 3)
+        with pytest.raises(RuntimeError):
+            zstd.decompress_frame(real)
+    # and the kafka fetch path skips, never stalls
+    from emqx_tpu.bridge.kafka import parse_batches, record_batch
+    batch = record_batch([(b"k", b"v" * 50)], compression="zstd")
+    out, nxt, skipped = parse_batches(batch)
+    assert skipped == 0 and [v for _, _, v in out] == [b"v" * 50]
+
+
+def test_fallback_truncated_header_is_valueerror(monkeypatch):
+    """A frame cut right after the magic must raise ValueError (the
+    class kafka.py maps to KafkaError), never IndexError."""
+    monkeypatch.setattr(zstd, "_lib", None)
+    monkeypatch.setattr(zstd, "_loaded", True)
+    for frag in (b"\x28\xb5\x2f\xfd", b"\x28\xb5\x2f\xfd\x20",
+                 b"\x50\x2a\x4d\x18\x05\x00"):
+        with pytest.raises(ValueError):
+            zstd.decompress_frame(frag)
