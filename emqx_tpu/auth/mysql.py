@@ -17,14 +17,21 @@ resultset decoding — round 5).  Two query paths:
   values never enter SQL text at all, statements are prepared once per
   connection and re-executed.
 
-``caching_sha2_password`` servers must create the broker's DB user
-with ``mysql_native_password``.
+Auth plugins (round 5): ``mysql_native_password`` (SHA1 scramble) AND
+``caching_sha2_password`` — MySQL 8's default — with the full flow:
+SHA256 fast-auth scramble, AuthSwitchRequest re-negotiation in either
+direction, and the full-authentication path over the server's RSA
+public key (request key → PEM → scramble-masked password encrypted
+RSA-OAEP-SHA1, the sha2_cache_cleaner-miss path; hand-rolled DER/OAEP
+like the repo's other wire crypto, no TLS required).
 """
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import logging
+import os
 import re
 import struct
 from typing import Any, Dict, List, Optional, Tuple
@@ -116,6 +123,87 @@ def _native_password(password: str, scramble: bytes) -> bytes:
     return bytes(a ^ b for a, b in zip(h1, h3))
 
 
+def _caching_sha2(password: str, nonce: bytes) -> bytes:
+    """caching_sha2_password fast-auth token:
+    XOR(SHA256(pwd), SHA256(SHA256(SHA256(pwd)) || nonce))."""
+    if not password:
+        return b""
+    h1 = hashlib.sha256(password.encode()).digest()
+    h2 = hashlib.sha256(h1).digest()
+    h3 = hashlib.sha256(h2 + nonce).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def _der_read(data: bytes, off: int) -> Tuple[int, bytes, int]:
+    """One DER TLV -> (tag, content, next_off)."""
+    tag = data[off]
+    ln = data[off + 1]
+    off += 2
+    if ln & 0x80:
+        nb = ln & 0x7F
+        if nb == 0 or nb > 8:
+            raise MysqlError("bad RSA key DER length")
+        ln = int.from_bytes(data[off:off + nb], "big")
+        off += nb
+    if off + ln > len(data):
+        raise MysqlError("truncated RSA key DER")
+    return tag, data[off:off + ln], off + ln
+
+
+def _parse_rsa_public_key(pem: bytes) -> Tuple[int, int]:
+    """PEM -> (n, e).  Accepts SubjectPublicKeyInfo ("BEGIN PUBLIC
+    KEY", what MySQL sends) and PKCS#1 ("BEGIN RSA PUBLIC KEY")."""
+    body = b"".join(ln.strip() for ln in pem.splitlines()
+                    if ln.strip() and not ln.strip().startswith(b"-"))
+    try:
+        der = base64.b64decode(body, validate=True)
+        tag, seq, _ = _der_read(der, 0)
+        if tag != 0x30:
+            raise MysqlError("RSA key: expected SEQUENCE")
+        t1, c1, o = _der_read(seq, 0)
+        if t1 == 0x30:                  # SubjectPublicKeyInfo: alg, BIT STRING
+            t2, c2, _ = _der_read(seq, o)
+            if t2 != 0x03 or not c2 or c2[0] != 0:
+                raise MysqlError("RSA key: expected BIT STRING")
+            _, seq, _ = _der_read(c2[1:], 0)
+            t1, c1, o = _der_read(seq, 0)
+        if t1 != 0x02:
+            raise MysqlError("RSA key: expected INTEGER modulus")
+        t2, c2, _ = _der_read(seq, o)
+        if t2 != 0x02:
+            raise MysqlError("RSA key: expected INTEGER exponent")
+    except (ValueError, IndexError) as e:
+        raise MysqlError(f"unparseable server RSA key: {e}")
+    n = int.from_bytes(c1, "big")
+    e_ = int.from_bytes(c2, "big")
+    if n < (1 << 500) or e_ < 3:
+        raise MysqlError("implausible server RSA key")
+    return n, e_
+
+
+def _rsa_oaep_encrypt(msg: bytes, n: int, e: int) -> bytes:
+    """RSAES-OAEP (SHA-1, empty label) — what libmysqlclient uses for
+    the caching_sha2/sha256_password full-auth key exchange."""
+    k = (n.bit_length() + 7) // 8
+    hlen = 20
+    if len(msg) > k - 2 * hlen - 2:
+        raise MysqlError("password too long for the server's RSA key")
+
+    def mgf1(seed: bytes, ln: int) -> bytes:
+        out = b""
+        for i in range((ln + hlen - 1) // hlen):
+            out += hashlib.sha1(seed + struct.pack(">I", i)).digest()
+        return out[:ln]
+
+    db = (hashlib.sha1(b"").digest()
+          + b"\x00" * (k - len(msg) - 2 * hlen - 2) + b"\x01" + msg)
+    seed = os.urandom(hlen)
+    masked_db = bytes(a ^ b for a, b in zip(db, mgf1(seed, k - hlen - 1)))
+    masked_seed = bytes(a ^ b for a, b in zip(seed, mgf1(masked_db, hlen)))
+    em = b"\x00" + masked_seed + masked_db
+    return pow(int.from_bytes(em, "big"), e, n).to_bytes(k, "big")
+
+
 def _lenenc(data: bytes, off: int) -> Tuple[Optional[int], int]:
     b = data[off]
     if b < 0xFB:
@@ -183,26 +271,100 @@ class MysqlClient(LazyTcpClient):
         off += 2 + 1 + 2 + 2                     # caps, charset, status, caps
         (plugin_len,) = struct.unpack_from("B", greeting, off)
         off += 1 + 10
-        scramble += greeting[off:off + max(12, plugin_len - 9)][:12]
+        part2 = greeting[off:off + max(13, plugin_len - 8)]
+        scramble += part2[:12]
+        # the server's preferred plugin name follows auth-data-part-2
+        plug_off = off + max(13, plugin_len - 8)
+        server_plugin = greeting[plug_off:].split(b"\x00", 1)[0].decode(
+            "ascii", "replace") or "mysql_native_password"
         caps = (CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
                 | CLIENT_PLUGIN_AUTH | CLIENT_CONNECT_WITH_DB)
-        auth = _native_password(self.password, scramble)
+        if server_plugin == "caching_sha2_password":
+            auth = _caching_sha2(self.password, scramble)
+        else:
+            # answer native; anything else is re-negotiated via the
+            # AuthSwitchRequest below
+            server_plugin = "mysql_native_password"
+            auth = _native_password(self.password, scramble)
         resp = (struct.pack("<IIB", caps, 1 << 24, 33) + b"\x00" * 23
                 + self.user.encode() + b"\x00"
                 + bytes([len(auth)]) + auth
                 + self.database.encode() + b"\x00"
-                + b"mysql_native_password\x00")
+                + server_plugin.encode() + b"\x00")
         self._write_packet(resp)
         await self._writer.drain()
-        ok = await self._read_packet()
-        if ok[:1] == b"\xff":
-            raise MysqlError(self._err_text(ok))
-        if ok[:1] == b"\xfe":
-            raise MysqlError(
-                "server requires an unsupported auth plugin "
-                "(create the broker user WITH mysql_native_password)")
+        await self._auth_exchange(scramble)
         # probe the session sql_mode so literal escaping can honor
         # NO_BACKSLASH_ESCAPES (backslash = data there, not an escape)
+        await self._post_auth_probe()
+
+    async def _auth_exchange(self, nonce: bytes) -> None:
+        """Drive the post-handshake authentication conversation to an
+        OK packet: AuthSwitchRequest (0xFE, either direction),
+        caching_sha2 AuthMoreData (0x01: fast-auth success / full-auth
+        request with the RSA public-key exchange), or immediate OK."""
+        for _ in range(8):              # bounded: no auth needs more
+            pkt = await self._read_packet()
+            first = pkt[:1]
+            if first == b"\xff":
+                raise MysqlError(self._err_text(pkt))
+            if first == b"\x00":
+                return                  # OK — authenticated
+            if first == b"\xfe":
+                if len(pkt) == 1:
+                    raise MysqlError("pre-4.1 old-password auth "
+                                     "unsupported")
+                try:
+                    end = pkt.index(b"\x00", 1)
+                except ValueError:
+                    raise MysqlError("malformed AuthSwitchRequest "
+                                     "(unterminated plugin name)")
+                plugin = pkt[1:end].decode("ascii", "replace")
+                nonce = pkt[end + 1:].rstrip(b"\x00")[:20]
+                if not nonce:
+                    raise MysqlError("malformed AuthSwitchRequest "
+                                     "(no auth nonce)")
+                if plugin == "mysql_native_password":
+                    data = _native_password(self.password, nonce)
+                elif plugin == "caching_sha2_password":
+                    data = _caching_sha2(self.password, nonce)
+                else:
+                    raise MysqlError(
+                        f"server requires unsupported auth plugin "
+                        f"{plugin!r} (supported: mysql_native_password, "
+                        f"caching_sha2_password)")
+                self._write_packet(data)
+                await self._writer.drain()
+                continue
+            if first == b"\x01":        # AuthMoreData (caching_sha2)
+                tag = pkt[1:2]
+                if tag == b"\x03":      # fast-auth success; OK follows
+                    continue
+                if tag == b"\x04":      # perform full authentication
+                    # plaintext over TLS is not an option (this client
+                    # is TCP); use the RSA public-key exchange, which
+                    # exists exactly for non-TLS full auth
+                    self._write_packet(b"\x02")     # request public key
+                    await self._writer.drain()
+                    keypkt = await self._read_packet()
+                    if keypkt[:1] == b"\xff":
+                        raise MysqlError(self._err_text(keypkt))
+                    if keypkt[:1] != b"\x01":
+                        raise MysqlError(
+                            "expected RSA public key during full auth")
+                    n, e = _parse_rsa_public_key(keypkt[1:])
+                    pwd = self.password.encode() + b"\x00"
+                    masked = bytes(c ^ nonce[i % len(nonce)]
+                                   for i, c in enumerate(pwd))
+                    self._write_packet(_rsa_oaep_encrypt(masked, n, e))
+                    await self._writer.drain()
+                    continue
+                raise MysqlError(
+                    f"unexpected auth-more-data tag {pkt[1:2]!r}")
+            raise MysqlError("unexpected packet during authentication")
+        raise MysqlError("authentication did not converge")
+
+    async def _post_auth_probe(self) -> None:
         try:
             _, rows = await self._query("SELECT @@sql_mode")
             if rows and rows[0] and rows[0][0] is not None:

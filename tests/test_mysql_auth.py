@@ -3,7 +3,9 @@
 with live CONNECT round trips (emqx_authn/mysql analogs)."""
 
 import asyncio
+import base64
 import hashlib
+import random
 import struct
 
 import pytest
@@ -12,7 +14,7 @@ from emqx_tpu.auth import AuthChain, Authz
 from emqx_tpu.auth.authn import Credentials, hash_password
 from emqx_tpu.auth.mysql import (
     MysqlAuthenticator, MysqlAuthzSource, MysqlClient, escape_literal,
-    render_query, _native_password,
+    render_query, _caching_sha2, _native_password,
 )
 from emqx_tpu.client import Client, MqttError
 from emqx_tpu.config import Config
@@ -29,21 +31,188 @@ def _lenenc_str(s):
     return bytes([len(b)]) + b
 
 
+# -- throwaway RSA keypair for the caching_sha2 full-auth mock ---------------
+
+def _probable_prime(n):
+    for p in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        if n % p == 0:
+            return n == p
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for a in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37):
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _gen_key():
+    rng = random.Random(20260731)
+
+    def prime():
+        while True:
+            c = rng.getrandbits(256) | (1 << 255) | 1
+            if _probable_prime(c):
+                return c
+
+    while True:
+        p, q = prime(), prime()
+        phi = (p - 1) * (q - 1)
+        if p != q and phi % 65537 != 0:
+            return p * q, 65537, pow(65537, -1, phi)
+
+
+_RSA_N, _RSA_E, _RSA_D = _gen_key()
+
+
+def _der_len(n):
+    if n < 128:
+        return bytes([n])
+    b = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(b)]) + b
+
+
+def _der_int(x):
+    b = x.to_bytes((x.bit_length() + 7) // 8 or 1, "big")
+    if b[0] & 0x80:
+        b = b"\x00" + b
+    return b"\x02" + _der_len(len(b)) + b
+
+
+_der_body = _der_int(_RSA_N) + _der_int(_RSA_E)
+_RSA_PEM = (b"-----BEGIN RSA PUBLIC KEY-----\n"
+            + base64.encodebytes(b"\x30" + _der_len(len(_der_body))
+                                 + _der_body)
+            + b"-----END RSA PUBLIC KEY-----\n")
+
+
+def _mgf1(seed, ln):
+    out = b""
+    for i in range((ln + 19) // 20):
+        out += hashlib.sha1(seed + struct.pack(">I", i)).digest()
+    return out[:ln]
+
+
+def _oaep_decrypt(ct):
+    k = (_RSA_N.bit_length() + 7) // 8
+    if len(ct) != k:
+        return None
+    em = pow(int.from_bytes(ct, "big"), _RSA_D, _RSA_N).to_bytes(k, "big")
+    if em[0] != 0:
+        return None
+    masked_seed, masked_db = em[1:21], em[21:]
+    seed = bytes(a ^ b for a, b in zip(masked_seed, _mgf1(masked_db, 20)))
+    db = bytes(a ^ b for a, b in zip(masked_db, _mgf1(seed, k - 21)))
+    if db[:20] != hashlib.sha1(b"").digest():
+        return None
+    try:
+        i = db.index(b"\x01", 20)
+    except ValueError:
+        return None
+    return db[i + 1:]
+
+
 class MockMysql:
-    """handshake + native-password verify + substring-dispatched
-    COM_QUERY over (cols, rows) handlers."""
+    """handshake + native-password/caching_sha2 verify + substring-
+    dispatched COM_QUERY over (cols, rows) handlers.
+
+    ``plugin`` selects the advertised auth plugin; for
+    ``caching_sha2_password``, ``auth_mode`` picks the server flow:
+    ``fast`` (scramble verified, 0x01 0x03 then OK — the cache-hit
+    path), ``full_rsa`` (0x01 0x04, serve the RSA public key, verify
+    the OAEP-encrypted scramble-masked password — the cache-miss
+    path), or ``switch_native`` (AuthSwitchRequest back to
+    mysql_native_password with a FRESH nonce)."""
 
     SCRAMBLE = b"abcdefgh12345678901j"  # 20 bytes
+    SCRAMBLE2 = b"ZYXWVUTSRQPONMLKJIH2"  # post-switch nonce
 
-    def __init__(self, tables, user="broker", password="dbpw"):
+    def __init__(self, tables, user="broker", password="dbpw",
+                 plugin="mysql_native_password", auth_mode="fast"):
         self.tables = tables
         self.user = user
         self.password = password
+        self.plugin = plugin
+        self.auth_mode = auth_mode
         self.queries = []
         self.prepares = []          # COM_STMT_PREPARE sql texts
         self.executes = []          # (stmt_id, params)
         self._conns = set()
         self.port = 0
+
+    async def _auth_server_side(self, reader, writer, rd_packet,
+                                wr_packet, user, auth, seq, deny):
+        ok_pkt = b"\x00\x00\x00" + struct.pack("<HH", 2, 0)
+        if user != self.user:
+            deny()
+            return False
+        if self.plugin == "mysql_native_password":
+            if auth != _native_password(self.password, self.SCRAMBLE):
+                deny()
+                return False
+            wr_packet(writer, ok_pkt, seq[0])
+            return True
+        assert self.plugin == "caching_sha2_password"
+        if self.auth_mode == "switch_broken":
+            # malformed AuthSwitchRequest: plugin name not terminated
+            wr_packet(writer, b"\xfemysql_native_password", seq[0])
+            return False
+        if self.auth_mode == "switch_nononce":
+            wr_packet(writer, b"\xfemysql_native_password\x00", seq[0])
+            return False
+        if self.auth_mode == "switch_native":
+            wr_packet(writer, b"\xfe" + b"mysql_native_password\x00"
+                      + self.SCRAMBLE2 + b"\x00", seq[0])
+            seq[0] += 1
+            await writer.drain()
+            resp, _ = await rd_packet(reader)
+            seq[0] += 1
+            if resp != _native_password(self.password, self.SCRAMBLE2):
+                deny()
+                return False
+            wr_packet(writer, ok_pkt, seq[0])
+            return True
+        if self.auth_mode == "fast":
+            if auth != _caching_sha2(self.password, self.SCRAMBLE):
+                deny()
+                return False
+            wr_packet(writer, b"\x01\x03", seq[0])
+            seq[0] += 1
+            wr_packet(writer, ok_pkt, seq[0])
+            return True
+        assert self.auth_mode == "full_rsa"
+        wr_packet(writer, b"\x01\x04", seq[0])
+        seq[0] += 1
+        await writer.drain()
+        req, _ = await rd_packet(reader)
+        seq[0] += 1
+        if req != b"\x02":          # client must request the public key
+            deny()
+            return False
+        wr_packet(writer, b"\x01" + _RSA_PEM, seq[0])
+        seq[0] += 1
+        await writer.drain()
+        blob, _ = await rd_packet(reader)
+        seq[0] += 1
+        msg = _oaep_decrypt(blob)
+        if msg is None:
+            deny()
+            return False
+        pwd = bytes(c ^ self.SCRAMBLE[i % len(self.SCRAMBLE)]
+                    for i, c in enumerate(msg))
+        if pwd != self.password.encode() + b"\x00":
+            deny()
+            return False
+        wr_packet(writer, ok_pkt, seq[0])
+        return True
 
     async def start(self):
         async def rd_packet(reader):
@@ -66,7 +235,7 @@ class MockMysql:
                             + struct.pack("<H", 0xC000)
                             + bytes([21]) + b"\x00" * 10
                             + self.SCRAMBLE[8:] + b"\x00"
-                            + b"mysql_native_password\x00")
+                            + self.plugin.encode() + b"\x00")
                 wr_packet(writer, greeting, 0)
                 await writer.drain()
                 resp, _ = await rd_packet(reader)
@@ -76,15 +245,18 @@ class MockMysql:
                 off = end + 1
                 alen = resp[off]
                 auth = resp[off + 1:off + 1 + alen]
-                want = _native_password(self.password, self.SCRAMBLE)
-                if user != self.user or auth != want:
+                seq = [2]
+
+                def deny():
                     wr_packet(writer, b"\xff" + struct.pack("<H", 1045)
-                              + b"#28000" + b"denied", 2)
-                    await writer.drain()
-                    return
-                wr_packet(writer, b"\x00\x00\x00" + struct.pack("<HH",
-                                                                2, 0), 2)
+                              + b"#28000" + b"denied", seq[0])
+
+                ok = await self._auth_server_side(
+                    reader, writer, rd_packet, wr_packet, user, auth,
+                    seq, deny)
                 await writer.drain()
+                if not ok:
+                    return
                 stmts = {}
                 next_stmt = [1]
 
@@ -473,3 +645,72 @@ def test_mysql_prepared_statement_authn_roundtrip():
             await mock.stop()
 
     run(scenario())
+
+
+def _sha2_connect(auth_mode, password="dbpw"):
+    """MysqlClient against a caching_sha2_password mock in the given
+    server flow; returns (mock, rows-from-a-real-query)."""
+    async def main():
+        my = await MockMysql({"mqtt_user": user_table},
+                             plugin="caching_sha2_password",
+                             auth_mode=auth_mode,
+                             password="dbpw").start()
+        cli = MysqlClient(f"127.0.0.1:{my.port}", user="broker",
+                          password=password, timeout=2.0)
+        try:
+            _, rows = await cli.query(
+                "SELECT password_hash FROM mqtt_user WHERE u = 'manu'")
+            return rows
+        finally:
+            await cli.close()
+            await my.stop()
+
+    return run(main())
+
+
+def test_caching_sha2_fast_auth():
+    rows = _sha2_connect("fast")
+    assert rows and rows[0]
+
+
+def test_caching_sha2_full_auth_over_rsa():
+    rows = _sha2_connect("full_rsa")
+    assert rows and rows[0]
+
+
+def test_caching_sha2_auth_switch_to_native():
+    rows = _sha2_connect("switch_native")
+    assert rows and rows[0]
+
+
+def test_caching_sha2_wrong_password_denied():
+    from emqx_tpu.auth.mysql import MysqlError
+    for mode in ("fast", "full_rsa"):
+        with pytest.raises(MysqlError, match="denied"):
+            _sha2_connect(mode, password="wrong")
+
+
+def test_rsa_key_parser_accepts_spki_and_pkcs1():
+    """MySQL sends SubjectPublicKeyInfo PEM; the PKCS#1 form must parse
+    too (some proxies re-wrap)."""
+    from emqx_tpu.auth.mysql import _parse_rsa_public_key
+    assert _parse_rsa_public_key(_RSA_PEM) == (_RSA_N, _RSA_E)
+    # wrap the PKCS#1 body in SPKI: SEQ{ SEQ{oid rsaEncryption, NULL},
+    # BIT STRING{ pkcs#1 } }
+    alg = bytes.fromhex("300d06092a864886f70d0101010500")
+    pkcs1 = b"\x30" + _der_len(len(_der_body)) + _der_body
+    bits = b"\x03" + _der_len(len(pkcs1) + 1) + b"\x00" + pkcs1
+    spki = b"\x30" + _der_len(len(alg) + len(bits)) + alg + bits
+    pem = (b"-----BEGIN PUBLIC KEY-----\n" + base64.encodebytes(spki)
+           + b"-----END PUBLIC KEY-----\n")
+    assert _parse_rsa_public_key(pem) == (_RSA_N, _RSA_E)
+
+
+def test_malformed_auth_switch_raises_mysql_error():
+    """Unterminated plugin name / missing nonce in an AuthSwitchRequest
+    must surface as MysqlError (the auth path's contract), never a
+    bare ValueError/ZeroDivisionError."""
+    from emqx_tpu.auth.mysql import MysqlError
+    for mode in ("switch_broken", "switch_nononce"):
+        with pytest.raises(MysqlError, match="malformed|denied|closed"):
+            _sha2_connect(mode)
