@@ -131,13 +131,30 @@ class _Conn:
         # peer may coalesce its first stream data with its Finished);
         # replayed after derivation — bounded
         self._undecryptable: List[bytes] = []
-        # loss recovery (RFC 9002, minimal PTO form): ack-eliciting
-        # frames of each sent packet, kept until acked; on_timer()
-        # re-queues anything older than the (backed-off) PTO
+        # loss recovery (RFC 9002): ack-eliciting frames of each sent
+        # packet, kept until acked; _detect_lost() re-queues on ack
+        # evidence (packet/time threshold), on_timer() re-queues
+        # anything older than the (backed-off) PTO as the backstop
         self._sent: Dict[str, Dict[int, Tuple[float, List[bytes]]]] = {
             LEVEL_INITIAL: {}, LEVEL_HANDSHAKE: {}, LEVEL_APP: {}}
         self._pto_base = 0.4      # pre-measurement default
         self._pto_count = 0
+        # RFC 9002 §6.1 ack-based loss detection state: packets more
+        # than kPacketThreshold (3) below the largest acked — or older
+        # than 9/8·srtt with a later ack present — are declared lost at
+        # ACK receipt and retransmitted immediately, no PTO wait
+        self._largest_acked: Dict[str, int] = {
+            LEVEL_INITIAL: -1, LEVEL_HANDSHAKE: -1, LEVEL_APP: -1}
+        # RFC 9002 §7 NewReno congestion controller in PACKET units
+        # (every STREAM packet is MTU-sized by construction, so packets
+        # ≈ bytes/1200): slow start to _ssthresh, then +1/cwnd per ack;
+        # halved once per round trip on a loss event; collapsed to the
+        # minimum window on persistent congestion (2 consecutive PTOs)
+        self._cwnd = 10.0
+        self._ssthresh = float("inf")
+        self._recovery_until: Dict[str, int] = {
+            LEVEL_INITIAL: -1, LEVEL_HANDSHAKE: -1, LEVEL_APP: -1}
+        self.fast_retransmits = 0
         # RFC 6298-style smoothed RTT from ack round trips (our ACKs
         # carry ack_delay 0, so the sample is the pure path RTT)
         self._srtt: Optional[float] = None
@@ -255,8 +272,16 @@ class _Conn:
                         t_sent, _ = sent.pop(pn)
                         if pn == fr.largest:    # RFC 9002 §5: sample on
                             self._rtt_sample(now - t_sent)  # largest
+                        # congestion window growth, per acked packet
+                        if self._cwnd < self._ssthresh:
+                            self._cwnd += 1.0           # slow start
+                        else:
+                            self._cwnd += 1.0 / self._cwnd
                     if acked:
                         self._pto_count = 0     # backoff resets on ack
+                        self._largest_acked[level] = max(
+                            self._largest_acked[level], max(acked))
+                        self._detect_lost(level, now)
 
     # -- send ----------------------------------------------------------
 
@@ -409,7 +434,35 @@ class _Conn:
         out, self._out_datagrams = self._out_datagrams, []
         return out
 
-    # -- loss recovery (RFC 9002, PTO form) ----------------------------
+    # -- loss recovery (RFC 9002) --------------------------------------
+
+    def _detect_lost(self, level: str, now: float) -> None:
+        """Ack-based loss detection (RFC 9002 §6.1): with a later ack
+        on record, unacked packets ≥ kPacketThreshold (3) below it, or
+        older than the 9/8·srtt time threshold, are lost — their
+        frames re-queue immediately (the caller's _service() flushes
+        them) and the congestion window halves once per round trip."""
+        sent = self._sent[level]
+        la = self._largest_acked[level]
+        time_limit = now - 9 / 8 * self._srtt if self._srtt else None
+        lost = [pn for pn, (t, _) in sent.items()
+                if pn <= la - 3
+                or (time_limit is not None and pn < la
+                    and t <= time_limit)]
+        if not lost:
+            return
+        for pn in sorted(lost):         # original send order
+            _, frames = sent.pop(pn)
+            self._pending_frames[level].extend(frames)
+        self.fast_retransmits += 1
+        if max(lost) >= self._recovery_until[level]:
+            # first loss of this round trip: one multiplicative
+            # decrease, then a recovery period until the current
+            # send edge is acked (further losses in the same flight
+            # must not halve again)
+            self._ssthresh = max(2.0, self._cwnd / 2)
+            self._cwnd = self._ssthresh
+            self._recovery_until[level] = self._next_pn[level]
 
     def _rtt_sample(self, rtt: float) -> None:
         if rtt < 0:
@@ -452,6 +505,15 @@ class _Conn:
         if fired:
             self.retransmits += 1
             self._pto_count += 1        # exponential backoff
+            if self._pto_count == 2:
+                # persistent congestion (RFC 9002 §7.6, PTO proxy):
+                # two consecutive timeouts with no ack in between —
+                # collapse to the minimum window and re-probe.  ONLY on
+                # the transition: later PTOs of the same outage must
+                # not clobber ssthresh down to the floor, or post-
+                # outage slow start has nothing to climb back toward
+                self._ssthresh = max(2.0, self._cwnd / 2)
+                self._cwnd = 2.0
             self._service()
         return fired
 
@@ -477,8 +539,10 @@ class _Conn:
         at most _tx_window packets in flight, so the _sent tracker
         never overflows and every unacked chunk stays retransmittable.
         More drains happen on ACK receipt and PTO (both call
-        _service)."""
-        room = (self._tx_window
+        _service).  The release rate is additionally governed by the
+        congestion window — min(tracker cap, cwnd) packets in
+        flight."""
+        room = (min(self._tx_window, max(2, int(self._cwnd)))
                 - len(self._sent[LEVEL_APP])
                 - len(self._pending_frames[LEVEL_APP]))
         while self._stream_txq and room > 0:

@@ -557,3 +557,85 @@ def test_quic_listener_recovers_from_datagram_loss(tmp_path):
             await node.stop()
 
     run(main())
+
+
+def test_fast_retransmit_on_ack_evidence_no_pto():
+    """RFC 9002 §6.1: a packet 3+ below the largest acked is declared
+    lost AT ACK RECEIPT and retransmits immediately — the stream heals
+    without any PTO timer firing."""
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    assert client.established
+    payload = bytes(range(256)) * 30         # ~7.7 KB -> 7 packets
+    cwnd_before = client._cwnd
+    client.send_stream(payload, fin=True)
+    burst = client.take_outgoing()
+    assert len(burst) >= 5
+    for dg in burst[1:]:                     # FIRST datagram dropped
+        box[0].receive(dg)
+    assert bytes(box[0]._stream_in) != payload
+    # the server's ACK (largest >> lost pn) triggers the fast path
+    for dg in box[0].take_outgoing():
+        client.receive(dg)
+    assert client.fast_retransmits == 1
+    assert client.retransmits == 0           # the PTO never fired
+    for dg in client.take_outgoing():
+        box[0].receive(dg)
+    assert bytes(box[0]._stream_in) == payload
+    # one multiplicative decrease for the loss event
+    assert client._cwnd < cwnd_before
+
+
+def test_cwnd_grows_on_acks_and_collapses_on_persistent_pto():
+    import time as _time
+
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    grown = client._cwnd
+    client.send_stream(b"z" * 5000)          # 5 packets
+    pump(client, box)
+    assert client._cwnd >= grown + 4         # slow start: +1 per ack
+    # persistent congestion: two consecutive PTOs with no ack between
+    client.send_stream(b"lost")
+    client.take_outgoing()
+    assert client.on_timer(_time.monotonic() + 10)
+    client.take_outgoing()
+    assert client.on_timer(_time.monotonic() + 100)
+    assert client._cwnd == 2.0
+
+
+def test_stream_release_respects_cwnd():
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    client._cwnd = 3.0                       # squeeze the window
+    client.send_stream(b"y" * 1130 * 20)
+    client.take_outgoing()
+    assert len(client._sent["1rtt"]) <= 3
+    assert client._stream_txq               # remainder queued, not lost
+
+
+def test_third_pto_does_not_clobber_ssthresh():
+    """The persistent-congestion collapse runs only on the TRANSITION
+    (2nd consecutive PTO); later PTOs of the same outage must leave
+    ssthresh intact so post-outage slow start can climb back."""
+    import time as _time
+
+    client = QuicClient()
+    box = [None]
+    pump(client, box)
+    client.send_stream(b"z" * 5000)
+    pump(client, box)                        # acks grow cwnd
+    client._cwnd = 100.0
+    client.send_stream(b"lost")
+    client.take_outgoing()
+    t = _time.monotonic()
+    assert client.on_timer(t + 10)
+    client.take_outgoing()
+    assert client.on_timer(t + 100)          # transition: collapse
+    client.take_outgoing()
+    assert client._cwnd == 2.0 and client._ssthresh == 50.0
+    assert client.on_timer(t + 1000)         # third PTO: no re-collapse
+    assert client._ssthresh == 50.0
