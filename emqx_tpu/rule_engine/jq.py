@@ -775,6 +775,24 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             elif isinstance(x, dict):
                 stack.extend(reversed(list(x.values())))
         return out
+    if name == "recurse" and n in (1, 2):
+        # builtin.jq: def recurse(f): def r: ., (f | r); r;
+        #             def recurse(f; cond): ... (f | select(cond) | r)
+        # Iterative preorder, capped: recurse(.) never terminates in
+        # jq either, but a rule must not wedge the broker loop.
+        out = []
+        stack = [v]
+        while stack:
+            x = stack.pop()
+            out.append(x)
+            if len(out) > 1_000_000:
+                raise JqError("jq: recurse output exceeds cap")
+            nxt = _eval(args[0], x)
+            if n == 2:
+                nxt = [w for w in nxt
+                       if any(_truthy(c) for c in _eval(args[1], w))]
+            stack.extend(reversed(nxt))
+        return out
     if name in ("any", "all") and n == 0:
         if not isinstance(v, list):
             raise JqError(f"jq: {name} needs an array")

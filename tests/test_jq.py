@@ -236,3 +236,26 @@ def test_jq_fromjson_and_implode_errors():
         jq_eval("[-1] | implode", None)
     with pytest.raises(JqError):
         jq_eval('"x" | flatten', None)
+
+
+JQ_RECURSE_CASES = [
+    # builtin.jq: def recurse(f): def r: ., (f | r); r;
+    ("[recurse(if . < 3 then . + 1 else empty end)]", 0, [[0, 1, 2, 3]]),
+    ("[recurse(.c?[]?)]", {"c": [{"c": [1]}, 2]},
+     [[{"c": [{"c": [1]}, 2]}, {"c": [1]}, 1, 2]]),
+    # recurse(f; cond): descend only while cond holds on f's output
+    ("[recurse(. * 2; . < 100)]", 1, [[1, 2, 4, 8, 16, 32, 64]]),
+    ("[recurse(.a; . != null)]", {"a": {"a": None}},
+     [[{"a": {"a": None}}, {"a": None}]]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", JQ_RECURSE_CASES,
+                         ids=[c[0][:40] for c in JQ_RECURSE_CASES])
+def test_jq_recurse_with_filter(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_recurse_runaway_capped():
+    with pytest.raises(JqError, match="cap"):
+        jq_eval("[recurse(.)]", 1)
