@@ -19,8 +19,8 @@ blocks via the in-repo ``native/snappy.cpp`` codec — the
 snappy-erlang-nif analog, SURVEY §2.4), ``"lz4"`` (in-repo
 ``native/lz4.cpp`` block codec + LZ4 frame format, interop-tested
 against system liblz4), ``"gzip"`` (stdlib zlib) or ``"zstd"``
-(store-mode frames via the in-repo ``native/zstd.py`` writer — valid
-zstd at ratio 1.0; see that module for why encode stays store-mode).
+(in-repo ``native/zstd.py``: greedy LZ77 + predefined-FSE sequence
+coding — real ratio, decodable by every zstd implementation).
 Fetch decodes all FOUR codecs — zstd through the full RFC 8878
 decoder in ``native/zstd.cpp`` (Huffman literals, FSE sequences,
 repeat offsets, xxh64 checksums), interop-tested against system
@@ -234,7 +234,7 @@ def _parse_batch_full(data: bytes) -> Tuple[
             elif codec == 3:
                 body = _lz4.decompress_frame(after[off:])
             else:
-                # native decoder, or the store-mode python fallback; an
+                # native decoder, or the subset python fallback; an
                 # entropy-coded frame on a toolchain-less host raises
                 # RuntimeError -> legacy skip-with-offset-advance
                 try:

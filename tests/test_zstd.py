@@ -1,8 +1,8 @@
 """Zstandard decoder (`native/zstd.cpp` via `native/zstd.py`) +
-store-mode frame writer, cross-validated against SYSTEM libzstd in
-both directions — the Kafka codec-4 fetch path must accept whatever a
-real (Java/librdkafka) producer emits, and real consumers must accept
-our store-mode frames."""
+pure-Python compressing encoder, cross-validated against SYSTEM
+libzstd in both directions — the Kafka codec-4 fetch path must accept
+whatever a real (Java/librdkafka) producer emits, and real consumers
+must accept our frames."""
 
 import ctypes
 import ctypes.util
@@ -219,8 +219,9 @@ def test_kafka_batch_java_producer_shape():
 
 def test_store_mode_fallback_without_native_decoder(monkeypatch):
     """On a toolchain-less host the bridge's OWN zstd production must
-    still round-trip (pure-Python store-mode decode); entropy-coded
-    frames raise RuntimeError, which the fetch path maps to the legacy
+    still round-trip (pure-Python subset decode); frames using
+    constructs outside the subset (Huffman literals) raise
+    RuntimeError, which the fetch path maps to the legacy
     skip-with-offset-advance."""
     monkeypatch.setattr(zstd, "_lib", None)
     monkeypatch.setattr(zstd, "_loaded", True)
@@ -228,7 +229,9 @@ def test_store_mode_fallback_without_native_decoder(monkeypatch):
     for d in (b"", b"own production " * 999, os.urandom(200_000)):
         assert zstd.decompress_frame(zstd.compress_frame(d)) == d
     if _syszstd() is not None:
-        real = _ref_compress(b"entropy coded " * 500, 3)
+        # hex text at level 19: char-level-compressible literals with
+        # few matches -> Huffman literal blocks, outside the subset
+        real = _ref_compress(os.urandom(30_000).hex().encode(), 19)
         with pytest.raises(RuntimeError):
             zstd.decompress_frame(real)
     # and the kafka fetch path skips, never stalls
@@ -247,3 +250,90 @@ def test_fallback_truncated_header_is_valueerror(monkeypatch):
                  b"\x50\x2a\x4d\x18\x05\x00"):
         with pytest.raises(ValueError):
             zstd.decompress_frame(frag)
+
+
+def test_compressing_encoder_tri_decoder_and_ratio():
+    """The predefined-FSE encoder's output must be accepted by all
+    three decoders (libzstd, our C++, the Python fallback) and
+    actually compress compressible payloads."""
+    if not zstd.available():
+        pytest.skip("no native toolchain")
+    json_like = b'{"topic":"t/%d","qos":1,"payload":"sensor"},' * 2000
+    frame = zstd.compress_frame(json_like)
+    assert len(frame) < len(json_like) // 10          # real ratio
+    assert zstd.decompress_frame(frame) == json_like  # our C++
+    if _syszstd() is not None:                        # reference
+        assert _ref_decompress(frame, len(json_like)) == json_like
+    assert zstd._py_store_decompress(frame) == json_like  # fallback
+
+
+def test_compressing_encoder_roundtrip_fuzz():
+    """Structured fuzz across sizes/alphabets: encoder output decodes
+    identically via the native decoder AND the Python fallback."""
+    if not zstd.available():
+        pytest.skip("no native toolchain")
+    random.seed(8878)
+    for trial in range(40):
+        size = random.choice((0, 1, 3, 17, 400, 5000, 140_000))
+        alpha = random.choice((1, 4, 64, 256))
+        d = bytes(random.randrange(alpha) for _ in range(size))
+        f = zstd.compress_frame(d)
+        assert zstd.decompress_frame(f) == d, (trial, size, alpha)
+        assert zstd._py_store_decompress(f) == d, (trial, size, alpha)
+
+
+def _craft_sequence_block(seqs, literals=b""):
+    """Hand-assemble a compressed block from hostile (ll, ml, off)
+    tuples using the encoder's own FSE machinery, bypassing its
+    legitimate-input invariants."""
+    ln = len(literals)
+    lhead = bytes([((ln & 0x0F) << 4) | 0x0C, (ln >> 4) & 0xFF, ln >> 12])
+    nseq = len(seqs)
+    shead = (bytes([nseq]) if nseq < 128
+             else bytes([128 + (nseq >> 8), nseq & 0xFF])) + b"\x00"
+    ll = zstd._FseEnc(zstd._LL_NORM, 6)
+    of = zstd._FseEnc(zstd._OF_NORM, 5)
+    ml = zstd._FseEnc(zstd._ML_NORM, 6)
+    w = zstd._BitWriter()
+    for i in range(nseq - 1, -1, -1):
+        ll_len, m_len, offset = seqs[i]
+        lc = zstd._ll_code(ll_len)
+        oc = (offset + 3).bit_length() - 1
+        mc = zstd._ml_code(m_len)
+        if i == nseq - 1:
+            ll.start(lc), of.start(oc), ml.start(mc)
+        else:
+            w.push(*of.prev(oc))
+            w.push(*ml.prev(mc))
+            w.push(*ll.prev(lc))
+        w.push(ll_len - zstd._LL_BASE[lc], zstd._LL_BITS[lc])
+        w.push(m_len - zstd._ML_BASE[mc], zstd._ML_BITS[mc])
+        w.push((offset + 3) - (1 << oc), oc)
+    w.push(ml.state, 6)
+    w.push(of.state, 5)
+    w.push(ll.state, 6)
+    body = lhead + literals + shead + w.finish()
+    bh = (len(body) << 3) | 0x04 | 1              # compressed, last
+    return (struct.pack("<I", 0xFD2FB528) + b"\x00\x38"
+            + struct.pack("<I", bh)[:3] + body)
+
+
+def test_fallback_rejects_decompression_bomb(monkeypatch):
+    """A crafted predefined-FSE frame regenerating ~128 KB per ~3
+    input bytes must be rejected INSIDE the decode loop (block-maximum
+    cap), not after gigabytes of output (review finding)."""
+    import time as _time
+    monkeypatch.setattr(zstd, "_lib", None)
+    monkeypatch.setattr(zstd, "_loaded", True)
+    bomb = _craft_sequence_block(
+        [(1, 100_000, 1)] * 400, literals=b"A" * 400)
+    t0 = _time.monotonic()
+    with pytest.raises(ValueError, match="maximum"):
+        zstd.decompress_frame(bomb)
+    assert _time.monotonic() - t0 < 1.0           # rejected early
+    # and the native decoder also bounds it
+    if zstd.load_library("zstd") is not None:
+        monkeypatch.setattr(zstd, "_loaded", False)
+        monkeypatch.setattr(zstd, "_lib", None)
+        with pytest.raises(ValueError):
+            zstd.decompress_frame(bomb)
