@@ -21,20 +21,33 @@ Supported (the surface rule engines actually use):
   ``and``/``or``, ``== != < <= > >=``, ``+ - * / %``, unary ``-``;
 * ``if COND then A elif B else C end`` (condition is a generator:
   every output selects a branch, jq-style; ``else`` defaults to ``.``);
+* variable bindings ``EXPR as $x | BODY`` (``.`` unchanged in BODY,
+  one binding per output — generator semantics), ``$x`` references
+  with postfix chains (``$x.field``);
+* ``reduce SRC as $x (INIT; UPDATE)`` (folds with the LAST output of
+  UPDATE; empty UPDATE kills the fold, like jq) and
+  ``foreach SRC as $x (INIT; UPDATE[; EXTRACT])``;
+* ``try EXPR [catch HANDLER]`` — errors feed HANDLER the message, or
+  vanish without one (``?`` still works as postfix try);
+* string interpolation ``"a \\(expr) b"`` incl. nested strings inside
+  the interpolation and multi-output fan-out;
 * builtins: length, keys, values, type, add, floor, ceil, sqrt, abs,
   tostring, tonumber, tojson, fromjson, ascii_downcase, ascii_upcase,
   reverse, sort, sort_by(f), unique, unique_by(f), group_by(f),
-  join(s), split(s), map(f), select(f), has(k), contains(x),
-  startswith(s), endswith(s), ltrimstr(s), rtrimstr(s), test(re),
-  first, last, min, max, min_by(f), max_by(f), any, all, any(f),
-  all(f), flatten, flatten(d), explode, implode, empty, not, error,
-  error(msg), range(n), range(lo;hi), to_entries, from_entries,
-  recurse (and ``..``).
+  join(s), split(s), splits(re), map(f), select(f), has(k),
+  contains(x), startswith(s), endswith(s), ltrimstr(s), rtrimstr(s),
+  test(re), first, last, first(f), last(f), nth(n;f), limit(n;f),
+  min, max, min_by(f), max_by(f), any, all, any(f), all(f), flatten,
+  flatten(d), explode, implode, empty, not, error, error(msg),
+  range(n), range(lo;hi), to_entries, from_entries, recurse,
+  recurse(f), recurse(f;cond) (and ``..``), until(c;u), while(c;u),
+  getpath(p), setpath(p;v), paths, leaf_paths, isnan, isinfinite,
+  infinite, nan, utf8bytelength.
 
 Out of scope (documented, erroring loudly rather than mis-evaluating):
-variable bindings (``as``), ``reduce``/``foreach``, ``def``,
-``try/catch`` (use ``?``), string interpolation, and regex capture
-builtins beyond ``test``.
+``def`` (user functions), ``label``/``break``, destructuring patterns
+in ``as``, path expressions for ``del``/``|=`` update-assign, regex
+capture builtins beyond ``test``/``splits``, and date builtins.
 
 jq's comparison/sort total order (null < false < true < numbers <
 strings < arrays < objects) is implemented so ``sort``/``min``/``max``
@@ -62,19 +75,83 @@ class JqError(ValueError):
 _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+)
   | (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
-  | (?P<str>"(?:[^"\\]|\\.)*")
+  | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
   | (?P<punct>\.\.|//|==|!=|<=|>=|\||,|\.|\[|\]|\{|\}|\(|\)|:|;|\?|<|>|\+|-|\*|/|%)
 """, re.VERBOSE)
 
+# reserved words — like jq's lexer, these never parse as `.field`
+# names or object-key shorthand (use .["as"] for such keys), so
+# `. as $x | ...` binds instead of reading a field called "as"
 _KEYWORDS = {"if", "then", "elif", "else", "end", "and", "or",
-             "true", "false", "null"}
+             "true", "false", "null", "as", "reduce", "foreach",
+             "try", "catch", "def", "label", "import", "include"}
+
+
+def _skip_string(src: str, start: int) -> int:
+    """`start` at an opening quote; returns the index AFTER the
+    closing quote (escape-aware; used to jump nested string literals
+    while bracket-matching an interpolation)."""
+    i = start + 1
+    while i < len(src):
+        if src[i] == "\\":
+            i += 2
+        elif src[i] == '"':
+            return i + 1
+        else:
+            i += 1
+    raise JqError("jq: unterminated string")
+
+
+def _lex_string(src: str, start: int):
+    """Scan one string literal, splitting out ``\\(...)``
+    interpolations.  Plain -> ("str", raw-with-quotes); interpolated
+    -> ("istr", [("lit", text) | ("expr", source), ...])."""
+    i = start + 1
+    parts: List[Tuple[str, str]] = []
+    buf: List[str] = []
+    while i < len(src):
+        c = src[i]
+        if c == "\\":
+            if src[i + 1:i + 2] == "(":
+                depth, j = 1, i + 2
+                while j < len(src) and depth:
+                    if src[j] == '"':
+                        j = _skip_string(src, j)
+                        continue
+                    if src[j] == "(":
+                        depth += 1
+                    elif src[j] == ")":
+                        depth -= 1
+                    j += 1
+                if depth:
+                    raise JqError("jq: unterminated \\( interpolation")
+                parts.append(("lit", "".join(buf)))
+                buf = []
+                parts.append(("expr", src[i + 2:j - 1]))
+                i = j
+                continue
+            buf.append(src[i:i + 2])
+            i += 2
+            continue
+        if c == '"':
+            if not parts:
+                return ("str", '"' + "".join(buf) + '"'), i + 1
+            parts.append(("lit", "".join(buf)))
+            return ("istr", parts), i + 1
+        buf.append(c)
+        i += 1
+    raise JqError("jq: unterminated string")
 
 
 def _lex(src: str) -> List[Tuple[str, str]]:
     toks: List[Tuple[str, str]] = []
     pos = 0
     while pos < len(src):
+        if src[pos] == '"':
+            tok, pos = _lex_string(src, pos)
+            toks.append(tok)
+            continue
         m = _TOKEN_RE.match(src, pos)
         if not m:
             raise JqError(f"jq: bad character {src[pos]!r} at {pos}")
@@ -125,10 +202,29 @@ class _Parser:
 
     # precedence ladder ----------------------------------------------------
 
+    def _expect_var(self) -> str:
+        kind, text = self.next()
+        if kind != "var":
+            raise JqError(f"jq: expected $variable, got {text!r}")
+        return text[1:]
+
     def parse_pipe(self):
         left = self.parse_comma()
+        if self.peek() == ("ident", "as"):
+            # EXPR as $x | BODY — `.` stays the original input in BODY
+            self.next()
+            name = self._expect_var()
+            self.expect("|")
+            return ("as", left, name, self.parse_pipe())
         while self.eat("|"):
-            left = ("pipe", left, self.parse_comma())
+            right = self.parse_comma()
+            if self.peek() == ("ident", "as"):
+                self.next()
+                name = self._expect_var()
+                self.expect("|")
+                return ("pipe", left,
+                        ("as", right, name, self.parse_pipe()))
+            left = ("pipe", left, right)
         return left
 
     def parse_comma(self):
@@ -240,6 +336,18 @@ class _Parser:
         if kind == "str":
             self.next()
             return ("lit", _unquote(text))
+        if kind == "istr":
+            self.next()
+            segs = []
+            for skind, s in text:       # text is the parts list here
+                if skind == "lit":
+                    segs.append(("lit", _unquote('"' + s + '"')))
+                else:
+                    segs.append(_parse(s))
+            return ("istr", segs)
+        if kind == "var":
+            self.next()
+            return ("var", text[1:])
         if kind == "ident":
             if text == "true":
                 self.next(); return ("lit", True)
@@ -251,9 +359,31 @@ class _Parser:
                 return self.parse_if()
             if text in ("then", "elif", "else", "end", "and", "or"):
                 raise JqError(f"jq: unexpected keyword {text!r}")
-            if text in ("as", "reduce", "foreach", "def", "try", "catch",
-                        "label", "import", "include"):
-                raise JqError(f"jq: {text!r} is not supported")
+            if text in ("reduce", "foreach"):
+                self.next()
+                src = self.parse_postfix()
+                self.expect("as")
+                name = self._expect_var()
+                self.expect("(")
+                init = self.parse_pipe()
+                self.expect(";")
+                update = self.parse_pipe()
+                extract = None
+                if text == "foreach" and self.eat(";"):
+                    extract = self.parse_pipe()
+                self.expect(")")
+                if text == "reduce":
+                    return ("reduce", src, name, init, update)
+                return ("foreach", src, name, init, update, extract)
+            if text == "try":
+                self.next()
+                body = self.parse_postfix()
+                handler = self.parse_postfix() if self.eat("catch") \
+                    else None
+                return ("try", body, handler)
+            if text in ("as", "catch", "def", "label", "import",
+                        "include"):
+                raise JqError(f"jq: {text!r} is not supported here")
             self.next()
             if self.eat("("):
                 args = [self.parse_pipe()]
@@ -480,7 +610,7 @@ def _slice(v: Any, lo: Any, hi: Any, opt: bool) -> List[Any]:
         raise
 
 
-def _eval(node, v: Any) -> List[Any]:
+def _eval(node, v: Any, env=None) -> List[Any]:
     tag = node[0]
     if tag in ("dot", "identity"):
         return [v]
@@ -488,72 +618,72 @@ def _eval(node, v: Any) -> List[Any]:
         return [node[1]]
     if tag == "pipe":
         out: List[Any] = []
-        for x in _eval(node[1], v):
-            out.extend(_eval(node[2], x))
+        for x in _eval(node[1], v, env):
+            out.extend(_eval(node[2], x, env))
         return out
     if tag == "comma":
         out = []
         for part in node[1]:
-            out.extend(_eval(part, v))
+            out.extend(_eval(part, v, env))
         return out
     if tag == "alt":
         try:
-            good = [x for x in _eval(node[1], v) if _truthy(x)]
+            good = [x for x in _eval(node[1], v, env) if _truthy(x)]
         except JqError:
             good = []
-        return good if good else _eval(node[2], v)
+        return good if good else _eval(node[2], v, env)
     if tag == "or":
         out = []
-        for a in _eval(node[1], v):
+        for a in _eval(node[1], v, env):
             if _truthy(a):
                 out.append(True)
             else:
-                out.extend(_truthy(b) for b in _eval(node[2], v))
+                out.extend(_truthy(b) for b in _eval(node[2], v, env))
         return out
     if tag == "and":
         out = []
-        for a in _eval(node[1], v):
+        for a in _eval(node[1], v, env):
             if not _truthy(a):
                 out.append(False)
             else:
-                out.extend(_truthy(b) for b in _eval(node[2], v))
+                out.extend(_truthy(b) for b in _eval(node[2], v, env))
         return out
     if tag == "cmp":
         op = node[1]
         out = []
-        for a in _eval(node[2], v):
-            for b in _eval(node[3], v):
+        for a in _eval(node[2], v, env):
+            for b in _eval(node[3], v, env):
                 c = _cmp(a, b)
                 out.append({"==": c == 0, "!=": c != 0, "<": c < 0,
                             "<=": c <= 0, ">": c > 0, ">=": c >= 0}[op])
         return out
     if tag == "arith":
         out = []
-        for a in _eval(node[2], v):
-            for b in _eval(node[3], v):
+        for a in _eval(node[2], v, env):
+            for b in _eval(node[3], v, env):
                 out.append(_arith(node[1], a, b))
         return out
     if tag == "neg":
-        return [-_num(x, "negated") for x in _eval(node[1], v)]
+        return [-_num(x, "negated") for x in _eval(node[1], v, env)]
     if tag == "field":
         opt = node[3]
         out = []
-        for base in _eval(node[1], v):
+        for base in _eval(node[1], v, env):
             out.extend(_index(base, node[2][1], opt))
         return out
     if tag == "indexe":
         opt = node[3]
         out = []
-        for base in _eval(node[1], v):
-            for idx in _eval(node[2], v):
+        for base in _eval(node[1], v, env):
+            for idx in _eval(node[2], v, env):
                 out.extend(_index(base, idx, opt))
         return out
     if tag == "slice":
         _, base_n, lo_n, hi_n, opt = node
         out = []
-        for base in _eval(base_n, v):
-            los = [None] if lo_n is None else _eval(lo_n, v)
-            his = [None] if hi_n is None else _eval(hi_n, v)
+        for base in _eval(base_n, v, env):
+            los = [None] if lo_n is None else _eval(lo_n, v, env)
+            his = [None] if hi_n is None else _eval(hi_n, v, env)
             for lo in los:
                 for hi in his:
                     out.extend(_slice(base, lo, hi, opt))
@@ -561,7 +691,7 @@ def _eval(node, v: Any) -> List[Any]:
     if tag == "iter":
         opt = node[2]
         out = []
-        for base in _eval(node[1], v):
+        for base in _eval(node[1], v, env):
             if isinstance(base, list):
                 out.extend(base)
             elif isinstance(base, dict):
@@ -573,18 +703,18 @@ def _eval(node, v: Any) -> List[Any]:
     if tag == "array":
         if node[1] is None:
             return [[]]
-        return [list(_eval(node[1], v))]
+        return [list(_eval(node[1], v, env))]
     if tag == "object":
         results: List[dict] = [{}]
         for keyexpr, valexpr in node[1]:
             nxt = []
             for partial in results:
-                for k in _eval(keyexpr, v):
+                for k in _eval(keyexpr, v, env):
                     if not isinstance(k, str):
                         raise JqError(
                             f"jq: object key must be string, got "
                             f"{_jq_type(k)}")
-                    for val in _eval(valexpr, v):
+                    for val in _eval(valexpr, v, env):
                         d = dict(partial)
                         d[k] = val
                         nxt.append(d)
@@ -593,19 +723,85 @@ def _eval(node, v: Any) -> List[Any]:
     if tag == "if":
         _, cond, then, els = node
         out = []
-        for c in _eval(cond, v):
-            out.extend(_eval(then if _truthy(c) else els, v))
+        for c in _eval(cond, v, env):
+            out.extend(_eval(then if _truthy(c) else els, v, env))
         return out
     if tag == "call":
-        return _call(node[1], node[2], v)
+        return _call(node[1], node[2], v, env)
+    if tag == "var":
+        if env and node[1] in env:
+            return [env[node[1]]]
+        raise JqError(f"jq: ${node[1]} is not defined")
+    if tag == "as":
+        out = []
+        for x in _eval(node[1], v, env):
+            e2 = dict(env) if env else {}
+            e2[node[2]] = x
+            out.extend(_eval(node[3], v, e2))
+        return out
+    if tag == "reduce":
+        _, srcn, name, initn, updn = node
+        xs = _eval(srcn, v, env)
+        out = []
+        for acc in _eval(initn, v, env):
+            alive = True
+            for x in xs:
+                e2 = dict(env) if env else {}
+                e2[name] = x
+                outs = _eval(updn, acc, e2)
+                if not outs:            # empty update kills this fold
+                    alive = False
+                    break
+                acc = outs[-1]          # jq folds with the LAST output
+            if alive:
+                out.append(acc)
+        return out
+    if tag == "foreach":
+        _, srcn, name, initn, updn, extn = node
+        xs = _eval(srcn, v, env)
+        out = []
+        for acc in _eval(initn, v, env):
+            for x in xs:
+                e2 = dict(env) if env else {}
+                e2[name] = x
+                outs = _eval(updn, acc, e2)
+                if not outs:
+                    break
+                for o in outs:          # every update output is emitted
+                    out.extend(_eval(extn, o, e2) if extn else [o])
+                acc = outs[-1]
+        return out
+    if tag == "try":
+        try:
+            return _eval(node[1], v, env)
+        except JqError as e:
+            if node[2] is None:
+                return []
+            msg = str(e)
+            for pre in ("jq: error: ", "jq: "):
+                if msg.startswith(pre):
+                    msg = msg[len(pre):]
+                    break
+            return _eval(node[2], msg, env)
+    if tag == "istr":
+        results = [""]
+        for seg in node[1]:
+            pieces = []
+            for o in _eval(seg, v, env):
+                pieces.append(o if isinstance(o, str)
+                              else json.dumps(o, separators=(",", ":")))
+            # cartesian: a multi-output interpolation fans the string out
+            results = [r + p for r in results for p in pieces]
+        return results
     raise JqError(f"jq: internal: unknown node {tag}")
 
 
-def _call(name: str, args: List[Any], v: Any) -> List[Any]:
+def _call(name: str, args: List[Any], v: Any,
+          env=None) -> List[Any]:
     n = len(args)
 
     def one(i):
-        outs = _eval(args[i], v)
+        outs = _eval(args[i], v, env)
         if len(outs) != 1:
             raise JqError(f"jq: {name} argument must yield one value")
         return outs[0]
@@ -676,7 +872,7 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             raise JqError("jq: sort_by needs an array")
 
         def _key(x):
-            outs = _eval(args[0], x)
+            outs = _eval(args[0], x, env)
             return _SortKey(outs[0] if outs else None)
 
         return [sorted(v, key=_key)]
@@ -704,11 +900,11 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             raise JqError("jq: map needs an array")
         out = []
         for x in v:
-            out.extend(_eval(args[0], x))
+            out.extend(_eval(args[0], x, env))
         return [out]
     if name == "select" and n == 1:
         out = []
-        for c in _eval(args[0], v):
+        for c in _eval(args[0], v, env):
             if _truthy(c):
                 out.append(v)
         return out
@@ -787,10 +983,10 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             out.append(x)
             if len(out) > 1_000_000:
                 raise JqError("jq: recurse output exceeds cap")
-            nxt = _eval(args[0], x)
+            nxt = _eval(args[0], x, env)
             if n == 2:
                 nxt = [w for w in nxt
-                       if any(_truthy(c) for c in _eval(args[1], w))]
+                       if any(_truthy(c) for c in _eval(args[1], w, env))]
             stack.extend(reversed(nxt))
         return out
     if name in ("any", "all") and n == 0:
@@ -801,7 +997,7 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
     if name in ("any", "all") and n == 1:
         if not isinstance(v, list):
             raise JqError(f"jq: {name} needs an array")
-        gen = (_truthy(c) for x in v for c in _eval(args[0], x))
+        gen = (_truthy(c) for x in v for c in _eval(args[0], x, env))
         return [any(gen) if name == "any" else all(gen)]
     if name == "flatten" and n <= 1:
         if not isinstance(v, list):
@@ -825,7 +1021,7 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             raise JqError("jq: group_by needs an array")
 
         def gkey(x):
-            outs = _eval(args[0], x)
+            outs = _eval(args[0], x, env)
             return outs[0] if outs else None
 
         pairs = sorted(((gkey(x), x) for x in v),
@@ -845,7 +1041,7 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             return [None]
 
         def bkey(x):
-            outs = _eval(args[0], x)
+            outs = _eval(args[0], x, env)
             return _SortKey(outs[0] if outs else None)
 
         pick2 = min if name == "min_by" else max
@@ -855,7 +1051,7 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             raise JqError("jq: unique_by needs an array")
 
         def ukey(x):
-            outs = _eval(args[0], x)
+            outs = _eval(args[0], x, env)
             return outs[0] if outs else None
 
         pairs = sorted(((ukey(x), x) for x in v),
@@ -904,7 +1100,131 @@ def _call(name: str, args: List[Any], v: Any) -> List[Any]:
             k = e.get("key", e.get("k", e.get("name")))
             out_d[str(k)] = e.get("value", e.get("v"))
         return [out_d]
+    if name == "limit" and n == 2:
+        k = one(0)
+        if not isinstance(k, (int, float)) or isinstance(k, bool):
+            raise JqError("jq: limit count must be a number")
+        k = int(k)
+        return _eval(args[1], v, env)[:max(0, k)]
+    if name == "first" and n == 1:
+        return _eval(args[0], v, env)[:1]
+    if name == "last" and n == 1:
+        return _eval(args[0], v, env)[-1:]
+    if name == "nth" and n == 2:
+        k = one(0)
+        if not isinstance(k, (int, float)) or isinstance(k, bool):
+            raise JqError("jq: nth count must be a number")
+        k = int(k)
+        if k < 0:
+            raise JqError("jq: nth doesn't support negative indices")
+        outs = _eval(args[1], v, env)
+        return outs[k:k + 1]
+    if name in ("until", "while") and n == 2:
+        # canonical defs, iterated with an explicit stack (cond is a
+        # generator: every output branches, like real jq) + a visit cap
+        # so a non-terminating rule cannot wedge the broker loop
+        out = []
+        stack = [v]
+        visited = 0
+        while stack:
+            x = stack.pop()
+            visited += 1
+            if visited > 1_000_000:
+                raise JqError(f"jq: {name} exceeds iteration cap")
+            for c in _eval(args[0], x, env):
+                if name == "until":
+                    if _truthy(c):
+                        out.append(x)
+                    else:
+                        stack.extend(reversed(_eval(args[1], x, env)))
+                else:                   # while: emit then continue
+                    if _truthy(c):
+                        out.append(x)
+                        stack.extend(reversed(_eval(args[1], x, env)))
+        return out
+    if name == "getpath" and n == 1:
+        path = one(0)
+        if not isinstance(path, list):
+            raise JqError("jq: getpath needs an array path")
+        x = v
+        for p in path:
+            if x is None:
+                continue
+            got = _index(x, p, opt=True)
+            x = got[0] if got else None
+        return [x]
+    if name == "setpath" and n == 2:
+        path, val = one(0), one(1)
+        if not isinstance(path, list):
+            raise JqError("jq: setpath needs an array path")
+        return [_setpath(v, path, val)]
+    if name in ("paths", "leaf_paths") and n == 0:
+        out = []
+        stack = [(v, [])]
+        while stack:
+            x, path = stack.pop()
+            if path:
+                if name == "paths" or not isinstance(x, (list, dict)):
+                    out.append(path)
+            if isinstance(x, list):
+                stack.extend((x[i], path + [i])
+                             for i in range(len(x) - 1, -1, -1))
+            elif isinstance(x, dict):
+                stack.extend((x[k], path + [k])
+                             for k in reversed(list(x)))
+        return out
+    if name == "splits" and n == 1:
+        if not isinstance(v, str):
+            _bad("splits", v)
+        return list(re.split(one(0), v))
+    if name == "isnan" and n == 0:
+        return [isinstance(v, float) and math.isnan(v)]
+    if name == "isinfinite" and n == 0:
+        return [isinstance(v, float) and math.isinf(v)]
+    if name == "infinite" and n == 0:
+        return [math.inf]
+    if name == "nan" and n == 0:
+        return [math.nan]
+    if name == "utf8bytelength" and n == 0:
+        if not isinstance(v, str):
+            _bad("utf8bytelength", v)
+        return [len(v.encode())]
     raise JqError(f"jq: unknown function {name}/{n}")
+
+
+def _setpath(v: Any, path: List[Any], val: Any) -> Any:
+    """Functional deep-set: containers copied along the path, created
+    (object for string keys, array for int) where missing."""
+    if not path:
+        return val
+    p = path[0]
+    if isinstance(p, str):
+        if v is None:
+            v = {}
+        if not isinstance(v, dict):
+            raise JqError(f"jq: cannot set field of {_jq_type(v)}")
+        out = dict(v)
+        out[p] = _setpath(v.get(p), path[1:], val)
+        return out
+    if isinstance(p, (int, float)) and not isinstance(p, bool):
+        i = int(p)
+        if v is None:
+            v = []
+        if not isinstance(v, list):
+            raise JqError(f"jq: cannot set index of {_jq_type(v)}")
+        if i < 0:
+            if -i > len(v):
+                raise JqError("jq: out of bounds negative array index")
+            i += len(v)
+        if i >= 1_000_000:
+            # same posture as the range/recurse caps: one dashboard-
+            # authored rule must not allocate a giant padded array in
+            # the dispatch path
+            raise JqError("jq: setpath index exceeds cap")
+        out = list(v) + [None] * (i + 1 - len(v))
+        out[i] = _setpath(out[i], path[1:], val)
+        return out
+    raise JqError(f"jq: invalid path component {_jq_type(p)}")
 
 
 def _bad(name: str, v: Any):
@@ -961,4 +1281,4 @@ def jq_eval(prog: str, value: Any,
         if len(_PARSE_CACHE) >= max_cache:
             _PARSE_CACHE.clear()
         _PARSE_CACHE[prog] = node
-    return _eval(node, value)
+    return _eval(node, value, {})

@@ -155,8 +155,8 @@ def test_jq_errors():
         ("1 / 0", None),
         ("error(\"boom\")", None),
         ("nosuchfn", None),
-        (".a as $x | $x", {"a": 1}),        # unsupported: variables
-        ("reduce .[] as $x (0; . + $x)", [1, 2]),
+        ("$undefined", None),               # unbound variable
+        ("def f: 1; f", None),              # unsupported: def
         (". ..", None),
         ("if true then 1", None),           # missing end
         ('{"k" 1}', None),                  # bad object syntax
@@ -259,3 +259,90 @@ def test_jq_recurse_with_filter(prog, doc, want):
 def test_jq_recurse_runaway_capped():
     with pytest.raises(JqError, match="cap"):
         jq_eval("[recurse(.)]", 1)
+
+
+JQ_LANG_CASES = [
+    # variable bindings: `.` stays the original input in BODY
+    (".a as $x | .b + $x", {"a": 1, "b": 10}, [11]),
+    # one binding per output of the source (generator semantics)
+    (".[] as $x | $x + 100", [1, 2, 3], [101, 102, 103]),
+    # $var with postfix chain
+    (".u as $u | $u.name", {"u": {"name": "ann"}}, ["ann"]),
+    # nested bindings shadow
+    ("1 as $x | 2 as $x | $x", None, [2]),
+    # reduce: classic sum
+    ("reduce .[] as $x (0; . + $x)", [1, 2, 3, 4], [10]),
+    # reduce folds with the LAST output of update:
+    # 0 -> last(1,100)=100 -> last(102,200)=200
+    ("reduce (1,2) as $x (0; . + $x, . + 100)", None, [200]),
+    # foreach: running sums
+    ("[foreach .[] as $x (0; . + $x)]", [1, 2, 3], [[1, 3, 6]]),
+    # foreach with extract
+    ("[foreach .[] as $x (0; . + $x; . * 10)]", [1, 2], [[10, 30]]),
+    # try/catch
+    ("try error(\"boom\") catch .", None, ["boom"]),
+    ("try (1/0) catch \"div\"", None, ["div"]),
+    ("[.[] | try tonumber]", ["1", "x", "3"], [[1, 3]]),
+    # string interpolation
+    ('"a=\\(.a), b=\\(.b)"', {"a": 1, "b": [2]}, ["a=1, b=[2]"]),
+    ('"\\(1,2)-\\(3)"', None, ["1-3", "2-3"]),
+    # interpolation containing a string literal with parens
+    ('"v=\\(.k // "(none)")"', {}, ["v=(none)"]),
+    # new builtins
+    ("[limit(2; .[])]", [1, 2, 3, 4], [[1, 2]]),
+    ("first(.[] | select(. > 1))", [1, 2, 3], [2]),
+    ("last(.[])", [1, 2, 3], [3]),
+    ("nth(1; .[])", [4, 5, 6], [5]),
+    ("[.[] | until(. >= 10; . * 2)]", [1, 3], [[16, 12]]),
+    ("[while(. < 20; . * 2)]", 1, [[1, 2, 4, 8, 16]]),
+    ("getpath([\"a\", \"b\"])", {"a": {"b": 7}}, [7]),
+    ("getpath([\"a\", \"x\"])", {"a": {"b": 7}}, [None]),
+    ("setpath([\"a\", \"b\"]; 9)", {"a": {"b": 7}, "c": 1},
+     [{"a": {"b": 9}, "c": 1}]),
+    ("setpath([\"n\", 1]; 5)", {}, [{"n": [None, 5]}]),
+    ("[paths]", {"a": {"b": 1}}, [[["a"], ["a", "b"]]]),
+    ("[leaf_paths]", {"a": {"b": 1}, "c": [2]},
+     [[["a", "b"], ["c", 0]]]),
+    ('[splits("[,;]")]', "a,b;c", [["a", "b", "c"]]),
+    ("1 | isnan", None, [False]),
+    ("infinite | isinfinite", None, [True]),
+    ("utf8bytelength", "héllo", [6]),
+    # reduce over an object stream via variables
+    ("reduce to_entries[] as $e ({}; . + {($e.value): $e.key})",
+     {"a": "x", "b": "y"}, [{"x": "a", "y": "b"}]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", JQ_LANG_CASES,
+                         ids=[c[0][:44] for c in JQ_LANG_CASES])
+def test_jq_language_features(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_until_runaway_capped():
+    with pytest.raises(JqError, match="cap"):
+        jq_eval("until(. < 0; . + 1)", 1)
+
+
+def test_jq_bare_dot_as_binding():
+    """`. as $x | BODY` — the canonical binding form; `as` is a
+    reserved word, never a `.as` field read (review finding)."""
+    assert jq_eval(". as $x | .b + $x.a", {"a": 5, "b": 2}) == [7]
+    assert jq_eval(".[] | . as $n | $n * 2", [1, 2]) == [2, 4]
+    assert jq_eval("reduce . as $x (10; . + $x)", 5) == [15]
+    # a field literally named "as" needs the quoted form, like jq
+    assert jq_eval('.["as"]', {"as": 9}) == [9]
+
+
+def test_jq_setpath_index_capped():
+    with pytest.raises(JqError, match="cap"):
+        jq_eval("setpath([200000000]; 1)", None)
+
+
+def test_jq_nth_bad_count_is_jqerror():
+    with pytest.raises(JqError):
+        jq_eval("nth(null; .[])", [1, 2, 3])
+    with pytest.raises(JqError):
+        jq_eval('nth("a"; .[])', [1, 2, 3])
+    with pytest.raises(JqError):
+        jq_eval('limit("a"; .[])', [1, 2, 3])
