@@ -284,9 +284,12 @@ class _FseEnc:
 class _BitWriter:
     """Forward LSB-first writer; the decoder reads it backwards, so
     items are pushed in REVERSE read order; finish() adds the sentinel
-    bit and pads to bytes."""
+    bit and pads to bytes.  Completed low bytes flush into a bytearray
+    so the accumulator stays a small int (a single growing int made
+    sequence-dense blocks quadratic)."""
 
     def __init__(self):
+        self.out = bytearray()
         self.acc = 0
         self.n = 0
 
@@ -294,11 +297,16 @@ class _BitWriter:
         if width:
             self.acc |= (value & ((1 << width) - 1)) << self.n
             self.n += width
+            while self.n >= 8:
+                self.out.append(self.acc & 0xFF)
+                self.acc >>= 8
+                self.n -= 8
 
     def finish(self) -> bytes:
         self.acc |= 1 << self.n         # sentinel
         self.n += 1
-        return self.acc.to_bytes((self.n + 7) // 8, "little")
+        return bytes(self.out) + self.acc.to_bytes((self.n + 7) // 8,
+                                                   "little")
 
 
 def _ll_code(v):
@@ -404,20 +412,26 @@ def _compress_block(block: bytes):
 
 class _BitReader:
     """Python twin of zstd.cpp's BackBits: the stream as one little-
-    endian integer, read from the top; the last byte's highest set bit
-    is the sentinel."""
+    endian bit sequence, read from the top; the last byte's highest
+    set bit is the sentinel.  Reads index the byte buffer directly —
+    shifting one whole-stream int per read is quadratic on long
+    streams."""
 
     def __init__(self, data: bytes):
         if not data or data[-1] == 0:
             raise ValueError("zstd: bad bitstream end")
-        self.v = int.from_bytes(data, "little")
+        self.data = data
         self.pos = (len(data) - 1) * 8 + data[-1].bit_length() - 1
 
     def read(self, width: int) -> int:
         self.pos -= width
         if self.pos < 0:
             raise ValueError("zstd: bitstream over-read")
-        return (self.v >> self.pos) & ((1 << width) - 1)
+        lo = self.pos
+        byte0 = lo >> 3
+        span = (width + (lo & 7) + 7) >> 3
+        acc = int.from_bytes(self.data[byte0:byte0 + span], "little")
+        return (acc >> (lo & 7)) & ((1 << width) - 1)
 
     def done(self) -> bool:
         return self.pos == 0
