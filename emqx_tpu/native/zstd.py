@@ -8,12 +8,18 @@ Posture mirrors the snappy/lz4 modules:
   repeat offsets, checksums) in ``zstd.cpp`` — the Kafka FETCH side,
   where the broker must accept whatever a Java producer emitted;
 * **encode** produces real compressed blocks from pure Python: greedy
-  LZ77 + raw literals + sequences coded with the spec's PREDEFINED
-  FSE distributions (so no Huffman/table-description machinery is
-  needed), raw-block fallback when compression doesn't pay.  Measured
-  ratios: ~1000x on repetitive text/JSON, ~1.4x on low-entropy
-  bytes, 1.0 floor on incompressible data.  The subset is chosen so
-  EVERY zstd implementation decodes it — proven against libzstd.
+  LZ77 + sequences coded with the spec's PREDEFINED FSE
+  distributions, and literals coded with the smallest of raw / RLE /
+  **Huffman** (package-merge length-limited canonical code, direct
+  4-bit weight description, 1- or 4-stream), raw-block fallback when
+  compression doesn't pay.  Measured ratios: ~1000x on repetitive
+  text/JSON, ~1.4x on low-entropy bytes, 1.0 floor on incompressible
+  data; Huffman literals add wins on literal-heavy payloads that LZ77
+  can't match.  The subset is chosen so EVERY zstd implementation
+  decodes it — proven against libzstd.  (Still not emitted:
+  FSE-compressed weight descriptions — literals whose max byte
+  exceeds 128 fall back to raw/RLE — and described/RLE sequence
+  tables.)
 
 Interop against system libzstd (both directions, levels 1-22) is
 proven in ``tests/test_zstd.py``.  Without a toolchain,
@@ -27,6 +33,7 @@ from __future__ import annotations
 
 import ctypes
 import struct
+from collections import Counter as _Counter
 from typing import List
 
 from .build import load_library
@@ -65,12 +72,13 @@ def available() -> bool:
 def decompress_frame(data: bytes) -> bytes:
     """Decode a (possibly multi-)frame zstd stream.  Full decode needs
     the native decoder; without a toolchain, a pure-Python fallback
-    still decodes raw/RLE blocks AND the predefined-FSE compressed
-    subset ``compress_frame`` emits, so a bridge's own production
+    still decodes raw/RLE blocks AND the compressed subset
+    ``compress_frame`` emits (predefined-FSE sequences +
+    raw/RLE/Huffman-direct literals), so a bridge's own production
     always round-trips.  Raises RuntimeError for constructs outside
-    that subset (Huffman literals, described tables, repeat offsets)
-    when no native decoder exists — the caller skips the batch — and
-    ValueError on corrupt/unsupported input."""
+    that subset (FSE-described tables, repeat offsets, treeless or
+    FSE-weight Huffman) when no native decoder exists — the caller
+    skips the batch — and ValueError on corrupt/unsupported input."""
     lib = _load()
     if lib is None:
         return _py_store_decompress(data)
@@ -94,7 +102,7 @@ def decompress_frame(data: bytes) -> bytes:
 
 def _py_store_decompress(data: bytes) -> bytes:
     """Toolchain-less fallback: decode raw/RLE blocks plus the
-    predefined-FSE compressed subset our own encoder emits (see
+    compressed subset our own encoder emits (see
     ``_py_block_decode``).  Richer constructs raise RuntimeError,
     which the Kafka fetch path maps to skip-with-offset-advance.
     Content checksums are NOT verified here (no xxh64 without the
@@ -327,6 +335,160 @@ def _ml_code(v):
     return i
 
 
+# ---- Huffman literal encoding ---------------------------------------------
+#
+# Canonical code per the decoder's table construction (zstd.cpp
+# huf_build): table ranges are assigned weight-ascending (longest
+# codes first), symbol-ascending within a weight, so a symbol's code
+# is its range start shifted down by 2^(weight-1).  Lengths come from
+# package-merge (optimal length-limited, Kraft-complete by
+# construction).  The tree ships as the DIRECT 4-bit weight
+# description (RFC 8878 §4.2.1.1), which caps the describable symbol
+# range at 128 — literals with higher bytes fall back to raw/RLE
+# rather than growing FSE-compressed-weights machinery.
+
+_HUF_MAX_BITS = 11
+
+
+def _package_merge(freqs: dict, limit: int) -> dict:
+    """Optimal length-limited prefix code: symbol -> code length
+    (1..limit), Kraft sum exactly 1.  Classic package-merge: L-1
+    rounds of pair-and-merge; a symbol's length = how many of the
+    first 2n-2 packages contain it."""
+    items = sorted((c, (s,)) for s, c in freqs.items())
+    packages = list(items)
+    for _ in range(limit - 1):
+        paired = [
+            (packages[i][0] + packages[i + 1][0],
+             packages[i][1] + packages[i + 1][1])
+            for i in range(0, len(packages) - 1, 2)
+        ]
+        packages = sorted(items + paired)
+    lengths: dict = {}
+    for _, syms in packages[: 2 * len(items) - 2]:
+        for s in syms:
+            lengths[s] = lengths.get(s, 0) + 1
+    return lengths
+
+
+def _huf_plan(literals: bytes):
+    """Code plan for Huffman-coding `literals`: (lengths, exact
+    stream bits, tree-description bytes), or None when Huffman can't
+    apply.  Cheap relative to encoding — Counter counts in C and
+    package-merge works on <=129 symbols — so it doubles as the
+    size ESTIMATE that gates whether a full encode is worth doing."""
+    n = len(literals)
+    if n < 32:
+        return None                     # header+tree overhead dominates
+    freqs = dict(_Counter(literals))
+    if len(freqs) < 2:
+        return None                     # caller's RLE path
+    max_sym = max(freqs)
+    if max_sym > 128:
+        return None                     # direct weights cap (see above)
+    lengths = _package_merge(freqs, _HUF_MAX_BITS)
+    bits = sum(freqs[s] * lengths[s] for s in freqs)
+    return lengths, bits, 1 + (max_sym + 1) // 2
+
+
+def _huf_estimate(literals: bytes):
+    """Estimated Huffman-section size in bytes (slight overcount:
+    per-stream sentinel/padding assumed worst-case), or None."""
+    plan = _huf_plan(literals)
+    if plan is None:
+        return None
+    _, bits, tree = plan
+    n = len(literals)
+    if n <= 1023:
+        return 3 + tree + (bits + 1 + 7) // 8
+    return 5 + tree + 6 + bits // 8 + 4
+
+
+def _huf_literals_section(literals: bytes):
+    """Compressed_Literals_Block (type 2) bytes — header + direct
+    weight description + backward Huffman stream(s) — or None when
+    Huffman can't be used or doesn't pay."""
+    n = len(literals)
+    plan = _huf_plan(literals)
+    if plan is None:
+        return None
+    lengths, _, _ = plan
+    max_sym = max(lengths)
+    maxbits = max(lengths.values())
+    codes = {}
+    pos = 0
+    for w in range(1, maxbits + 1):
+        ln = maxbits + 1 - w
+        for s in sorted(lengths):
+            if lengths[s] == ln:
+                codes[s] = (pos >> (w - 1), ln)
+                pos += 1 << (w - 1)
+    assert pos == 1 << maxbits          # Kraft-complete by construction
+    nw = max_sym                        # weights 0..max_sym-1; last inferred
+    weights = [maxbits + 1 - lengths[s] if s in lengths else 0
+               for s in range(nw)]
+    packed = bytearray([127 + nw])
+    for i in range(0, nw, 2):
+        packed.append((weights[i] << 4)
+                      | (weights[i + 1] if i + 1 < nw else 0))
+    tree = bytes(packed)
+
+    def enc_stream(chunk):
+        w = _BitWriter()
+        for b in reversed(chunk):
+            c, ln = codes[b]
+            w.push(c, ln)
+        return w.finish()
+
+    if n <= 1023:                       # 1 stream, 10-bit sizes
+        stream = enc_stream(literals)
+        comp = len(tree) + len(stream)
+        if comp >= n or comp > 1023:
+            return None
+        head = (2 | (n << 4) | (comp << 14)).to_bytes(3, "little")
+        return head + tree + stream
+    per = (n + 3) // 4                  # 4 streams + 6-byte jump table
+    chunks = [literals[0:per], literals[per:2 * per],
+              literals[2 * per:3 * per], literals[3 * per:]]
+    if not chunks[3]:
+        return None                     # stream 4 must be non-empty
+    streams = [enc_stream(c) for c in chunks]
+    if any(len(s) > 0xFFFF for s in streams[:3]):
+        return None
+    jump = struct.pack("<HHH", *(len(s) for s in streams[:3]))
+    comp = len(tree) + 6 + sum(len(s) for s in streams)
+    if comp >= n:
+        return None
+    if n <= 16383 and comp <= 16383:    # size_format 2: 14-bit sizes
+        head = (2 | (2 << 2) | (n << 4) | (comp << 18)).to_bytes(
+            4, "little")
+    else:                               # size_format 3: 18-bit sizes
+        head = (2 | (3 << 2) | (n << 4) | (comp << 22)).to_bytes(
+            5, "little")
+    return head + tree + jump + b"".join(streams)
+
+
+def _lit_section(literals: bytes) -> bytes:
+    """Smallest literals section: raw, RLE, or Huffman-compressed."""
+    ln = len(literals)
+    if ln and ln == literals.count(literals[:1]):   # single repeated byte
+        if ln < 32:
+            return bytes([0x01 | (ln << 3)]) + literals[:1]
+        if ln < 4096:
+            return (0x01 | 0x04 | (ln << 4)).to_bytes(2, "little") \
+                + literals[:1]
+        return (0x01 | 0x0C | (ln << 4)).to_bytes(3, "little") \
+            + literals[:1]
+    if ln < 32:
+        raw = bytes([ln << 3]) + literals
+    elif ln < 4096:
+        raw = (0x04 | (ln << 4)).to_bytes(2, "little") + literals
+    else:
+        raw = (0x0C | (ln << 4)).to_bytes(3, "little") + literals
+    huf = _huf_literals_section(literals)
+    return huf if huf is not None and len(huf) < len(raw) else raw
+
+
 def _find_sequences(block: bytes):
     """Greedy LZ77 over one block: 4-byte hash chains, matches stay
     inside the block.  Returns ([(lit_len, match_len, offset)],
@@ -356,21 +518,18 @@ def _find_sequences(block: bytes):
 
 def _compress_block(block: bytes):
     """One compressed block body (literals + sequences sections), or
-    None when sequences don't pay for themselves."""
+    None when neither sequences nor literal compression pay.  With no
+    sequences the block can still compress via its literals section
+    alone (Huffman/RLE + a zero sequence count)."""
     seqs, lits, tail = _find_sequences(block)
     nseq = len(seqs)
-    if not nseq or nseq >= 0x7F00:
+    if nseq >= 0x7F00:
         return None
     literals = lits + tail
-    # raw literals section header, smallest format that fits
-    ln = len(literals)
-    if ln < 32:
-        lhead = bytes([ln << 3])
-    elif ln < 4096:
-        lhead = bytes([((ln & 0x0F) << 4) | 0x04, ln >> 4])
-    else:
-        lhead = bytes([((ln & 0x0F) << 4) | 0x0C, (ln >> 4) & 0xFF,
-                       ln >> 12])
+    lhead = _lit_section(literals)
+    if not nseq:                        # literals ARE the whole block
+        body = lhead + b"\x00"
+        return body if len(body) < len(block) else None
     if nseq < 128:
         shead = bytes([nseq])
     else:
@@ -406,7 +565,18 @@ def _compress_block(block: bytes):
     w.push(ml.state, 6)
     w.push(of.state, 5)
     w.push(ll.state, 6)
-    body = lhead + literals + shead + w.finish()
+    body = lhead + shead + w.finish()
+    # on short-match-dense data (small alphabets) a greedy LZ77
+    # sequence costs more bits than Huffman-coding its bytes, so a
+    # literals-only block can beat the sequence-coded one.  The cheap
+    # exact-size estimate gates the second whole-block Huffman pass:
+    # the common LZ-compressible case (sequence body a tiny fraction
+    # of the block) never pays for it.
+    est = _huf_estimate(block)
+    if est is not None and est + 1 < len(body):
+        flat = _lit_section(block) + b"\x00"
+        if len(flat) < len(body):
+            body = flat
     return body if len(body) < len(block) else None
 
 
@@ -433,36 +603,162 @@ class _BitReader:
         acc = int.from_bytes(self.data[byte0:byte0 + span], "little")
         return (acc >> (lo & 7)) & ((1 << width) - 1)
 
+    def peek(self, width: int) -> int:
+        """Bits [pos-width, pos) zero-padded below position 0 —
+        Huffman decoding peeks maxBits even when fewer remain; only
+        CONSUMING past the start is an error (zstd.cpp BackBits)."""
+        lo = self.pos - width
+        start = max(0, lo)
+        byte0 = start >> 3
+        span = ((self.pos + 7) >> 3) - byte0
+        acc = int.from_bytes(self.data[byte0:byte0 + span], "little")
+        acc >>= start - (byte0 << 3)
+        if lo < 0:
+            acc <<= -lo
+        return acc & ((1 << width) - 1)
+
+    def consume(self, width: int) -> None:
+        self.pos -= width
+        if self.pos < 0:
+            raise ValueError("zstd: bitstream over-read")
+
     def done(self) -> bool:
         return self.pos == 0
 
 
+def _huf_parse_py(body: bytes):
+    """Direct-weights Huffman tree description -> (symbol, nbBits,
+    log, header bytes consumed); mirrors zstd.cpp huf_parse/huf_build
+    for the subset our encoder emits.  FSE-compressed weights ->
+    RuntimeError (native decoder territory)."""
+    if not body:
+        raise ValueError("zstd: empty tree description")
+    hbyte = body[0]
+    if hbyte < 128:
+        raise RuntimeError("zstd: FSE-compressed Huffman weights need "
+                           "the native decoder")
+    nw = hbyte - 127
+    used = 1 + (nw + 1) // 2
+    if used > len(body):
+        raise ValueError("zstd: truncated tree description")
+    weights = []
+    for i in range(nw):
+        b = body[1 + (i >> 1)]
+        weights.append(b & 0x0F if i & 1 else b >> 4)
+    total = sum(1 << (w - 1) for w in weights if w)
+    if total == 0:
+        raise ValueError("zstd: empty Huffman weights")
+    maxbits = total.bit_length()
+    rest = (1 << maxbits) - total
+    if maxbits > 12 or rest == 0 or rest & (rest - 1):
+        raise ValueError("zstd: bad Huffman weights")
+    weights.append(rest.bit_length())
+    size = 1 << maxbits
+    sym = bytearray(size)
+    nb = bytearray(size)
+    pos = 0
+    for w in range(1, maxbits + 1):
+        for s, ws in enumerate(weights):
+            if ws != w:
+                continue
+            cnt = 1 << (w - 1)
+            nbv = maxbits + 1 - w
+            for _ in range(cnt):
+                sym[pos] = s
+                nb[pos] = nbv
+                pos += 1
+    if pos != size:
+        raise ValueError("zstd: bad Huffman weights")
+    return sym, nb, maxbits, used
+
+
+def _huf_stream_py(sym, nb, log, data: bytes, count: int) -> bytes:
+    bits = _BitReader(data)
+    out = bytearray()
+    for _ in range(count):
+        idx = bits.peek(log)
+        out.append(sym[idx])
+        bits.consume(nb[idx])
+    if not bits.done():
+        raise ValueError("zstd: Huffman stream not consumed")
+    return bytes(out)
+
+
 def _py_block_decode(body: bytes) -> bytes:
     """Toolchain-less decode of the SUBSET ``_compress_block`` emits
-    (raw/RLE literals + all-predefined sequence tables, no repeat
-    offsets).  Anything richer -> RuntimeError, which the Kafka fetch
-    path maps to skip-with-offset-advance."""
+    (raw/RLE/Huffman-direct literals + all-predefined sequence
+    tables, no repeat offsets).  Anything richer -> RuntimeError,
+    which the Kafka fetch path maps to skip-with-offset-advance."""
     if not body:
         raise ValueError("zstd: empty block")
     ltype = body[0] & 3
     sf = (body[0] >> 2) & 3
-    if ltype > 1:
-        raise RuntimeError("zstd: Huffman literals need native decoder")
-    if sf in (0, 2):
-        regen, off = body[0] >> 3, 1
-    elif sf == 1:
-        regen, off = (body[0] >> 4) | (body[1] << 4), 2
+    if ltype == 3:
+        raise RuntimeError("zstd: treeless literals need the native "
+                           "decoder")
+    if ltype == 2:                      # Huffman-compressed literals
+        if sf <= 1:
+            if len(body) < 3:
+                raise ValueError("zstd: truncated literals header")
+            regen = (body[0] >> 4) | ((body[1] & 0x3F) << 4)
+            comp = (body[1] >> 6) | (body[2] << 2)
+            off = 3
+        elif sf == 2:
+            if len(body) < 4:
+                raise ValueError("zstd: truncated literals header")
+            regen = (body[0] >> 4) | (body[1] << 4) | ((body[2] & 3) << 12)
+            comp = (body[2] >> 2) | (body[3] << 6)
+            off = 4
+        else:
+            if len(body) < 5:
+                raise ValueError("zstd: truncated literals header")
+            regen = ((body[0] >> 4) | (body[1] << 4)
+                     | ((body[2] & 0x3F) << 12))
+            comp = (body[2] >> 6) | (body[3] << 2) | (body[4] << 10)
+            off = 5
+        if regen > _BLOCK_MAX or off + comp > len(body):
+            raise ValueError("zstd: bad literals section")
+        area = body[off:off + comp]
+        sym, nb, log, used = _huf_parse_py(area)
+        area = area[used:]
+        if sf == 0:                     # single stream
+            lits = _huf_stream_py(sym, nb, log, area, regen)
+        else:                           # 4 streams, 6-byte jump table
+            if len(area) < 6:
+                raise ValueError("zstd: truncated jump table")
+            s1 = area[0] | (area[1] << 8)
+            s2 = area[2] | (area[3] << 8)
+            s3 = area[4] | (area[5] << 8)
+            s4 = len(area) - 6 - s1 - s2 - s3
+            if s4 <= 0:
+                raise ValueError("zstd: bad jump table")
+            per = (regen + 3) // 4
+            last = regen - 3 * per
+            if last < 0:
+                raise ValueError("zstd: bad stream split")
+            q = area[6:]
+            lits = (_huf_stream_py(sym, nb, log, q[:s1], per)
+                    + _huf_stream_py(sym, nb, log, q[s1:s1 + s2], per)
+                    + _huf_stream_py(sym, nb, log,
+                                     q[s1 + s2:s1 + s2 + s3], per)
+                    + _huf_stream_py(sym, nb, log, q[s1 + s2 + s3:], last))
+        off += comp
     else:
-        regen = (body[0] >> 4) | (body[1] << 4) | (body[2] << 12)
-        off = 3
-    if regen > _BLOCK_MAX:
-        raise ValueError("zstd: literals exceed block maximum")
-    if ltype == 0:
-        lits = body[off:off + regen]
-        off += regen
-    else:                               # RLE
-        lits = body[off:off + 1] * regen
-        off += 1
+        if sf in (0, 2):
+            regen, off = body[0] >> 3, 1
+        elif sf == 1:
+            regen, off = (body[0] >> 4) | (body[1] << 4), 2
+        else:
+            regen = (body[0] >> 4) | (body[1] << 4) | (body[2] << 12)
+            off = 3
+        if regen > _BLOCK_MAX:
+            raise ValueError("zstd: literals exceed block maximum")
+        if ltype == 0:
+            lits = body[off:off + regen]
+            off += regen
+        else:                           # RLE
+            lits = body[off:off + 1] * regen
+            off += 1
     if len(lits) != regen:
         raise ValueError("zstd: truncated literals")
     b0 = body[off]
@@ -535,9 +831,10 @@ def _py_block_decode(body: bytes) -> bytes:
 
 def compress_frame(data: bytes) -> bytes:
     """One zstd frame: single-segment, declared content size; blocks
-    are compressed (greedy LZ77 + predefined-FSE sequences + raw
-    literals — decodable by every zstd implementation) with raw-block
-    fallback per 128 KB block when compression doesn't pay."""
+    are compressed (greedy LZ77 + predefined-FSE sequences +
+    raw/RLE/Huffman literal sections — decodable by every zstd
+    implementation) with raw-block fallback per 128 KB block when
+    compression doesn't pay."""
     n = len(data)
     if n < 256:
         fhd, fcs = 0x20, struct.pack("<B", n)

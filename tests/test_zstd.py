@@ -355,3 +355,85 @@ def test_sequence_dense_block_linear_time(monkeypatch):
     assert zstd.decompress_frame(frame) == data
     dec_s = _time.monotonic() - t0
     assert enc_s + dec_s < 1.5, (enc_s, dec_s)
+
+
+# ---- Huffman literal encoding (round-5 ratio work) -------------------------
+
+
+def _first_block_literal_type(frame: bytes) -> int:
+    """Literals-section type (0 raw / 1 RLE / 2 Huffman) of the first
+    block of a single-segment frame our encoder produced."""
+    fhd = frame[4]
+    assert fhd & 0x20                       # single-segment, our shape
+    off = 5 + (1 if fhd >> 6 == 0 else (2, 2, 4, 8)[fhd >> 6])
+    bh = int.from_bytes(frame[off:off + 3], "little")
+    assert (bh >> 1) & 3 == 2, "expected a compressed block"
+    return frame[off + 3] & 3
+
+
+def test_huffman_literals_tri_decoder():
+    """Literal-heavy payload with no LZ77 matches: before round 5 this
+    emitted a raw block (ratio 1.0); now the literals section itself
+    Huffman-compresses, and all three decoders accept it."""
+    random.seed(11)
+    data = bytes(random.choice(b"abcdefgh") for _ in range(6000))
+    frame = zstd.compress_frame(data)
+    assert len(frame) < len(data) // 2      # ~3 bits/symbol vs 8
+    assert _first_block_literal_type(frame) == 2
+    assert zstd._py_store_decompress(frame) == data
+    if zstd.available():
+        assert zstd.decompress_frame(frame) == data
+    if _syszstd() is not None:
+        assert _ref_decompress(frame, len(data)) == data
+
+
+def test_huffman_stream_layouts():
+    """Every header layout the encoder can emit: 1-stream (<=1023
+    literals), 4-stream 14-bit sizes, 4-stream 18-bit sizes."""
+    random.seed(12)
+    for size in (200, 1023, 1024, 8000, 16384, 120_000):
+        data = bytes(random.choice(b"ACGTacgt-") for _ in range(size))
+        frame = zstd.compress_frame(data)
+        assert len(frame) < len(data)
+        assert zstd._py_store_decompress(frame) == data, size
+        if _syszstd() is not None:
+            assert _ref_decompress(frame, len(data)) == data, size
+
+
+def test_huffman_high_bytes_fall_back():
+    """Bytes above 128 exceed the direct weight description's symbol
+    range; the encoder must fall back (raw literals) rather than emit
+    a tree it cannot describe."""
+    random.seed(13)
+    data = bytes(random.choice(b"\xf0\xf1\xf2\xf3") for _ in range(4096))
+    frame = zstd.compress_frame(data)       # compresses via LZ77 only
+    assert zstd._py_store_decompress(frame) == data
+    if _syszstd() is not None:
+        assert _ref_decompress(frame, len(data)) == data
+
+
+def test_rle_literal_section():
+    """A single repeated byte ships as an RLE literals section (2-18
+    bytes total), not 500 raw literal bytes."""
+    data = b"z" * 500
+    frame = zstd.compress_frame(data)
+    assert len(frame) < 32
+    assert zstd._py_store_decompress(frame) == data
+    if _syszstd() is not None:
+        assert _ref_decompress(frame, len(data)) == data
+
+
+def test_huffman_literals_with_sequences():
+    """Huffman literals and predefined-FSE sequences in the same
+    block: mixed compressible text with repeats."""
+    random.seed(14)
+    words = [bytes(random.choice(b"etaoin shrdlu") for _ in range(9))
+             for _ in range(40)]
+    data = b" ".join(random.choice(words) for _ in range(4000))
+    frame = zstd.compress_frame(data)
+    assert len(frame) < len(data) // 3
+    assert zstd._py_store_decompress(frame) == data
+    if zstd.available():
+        assert zstd.decompress_frame(frame) == data
+    if _syszstd() is not None:
+        assert _ref_decompress(frame, len(data)) == data
