@@ -391,25 +391,26 @@ def _huf_plan(literals: bytes):
     return lengths, bits, 1 + (max_sym + 1) // 2
 
 
-def _huf_estimate(literals: bytes):
-    """Estimated Huffman-section size in bytes (slight overcount:
-    per-stream sentinel/padding assumed worst-case), or None."""
-    plan = _huf_plan(literals)
+def _huf_estimate(plan, n: int):
+    """Estimated Huffman-section size in bytes for a plan over n
+    literals (slight overcount: per-stream sentinel/padding assumed
+    worst-case), or None."""
     if plan is None:
         return None
     _, bits, tree = plan
-    n = len(literals)
     if n <= 1023:
         return 3 + tree + (bits + 1 + 7) // 8
     return 5 + tree + 6 + bits // 8 + 4
 
 
-def _huf_literals_section(literals: bytes):
+def _huf_literals_section(literals: bytes, plan=None):
     """Compressed_Literals_Block (type 2) bytes — header + direct
     weight description + backward Huffman stream(s) — or None when
-    Huffman can't be used or doesn't pay."""
+    Huffman can't be used or doesn't pay.  Accepts a precomputed
+    ``_huf_plan`` so callers that already estimated don't re-count."""
     n = len(literals)
-    plan = _huf_plan(literals)
+    if plan is None:
+        plan = _huf_plan(literals)
     if plan is None:
         return None
     lengths, _, _ = plan
@@ -468,7 +469,7 @@ def _huf_literals_section(literals: bytes):
     return head + tree + jump + b"".join(streams)
 
 
-def _lit_section(literals: bytes) -> bytes:
+def _lit_section(literals: bytes, plan=None) -> bytes:
     """Smallest literals section: raw, RLE, or Huffman-compressed."""
     ln = len(literals)
     if ln and ln == literals.count(literals[:1]):   # single repeated byte
@@ -485,7 +486,7 @@ def _lit_section(literals: bytes) -> bytes:
         raw = (0x04 | (ln << 4)).to_bytes(2, "little") + literals
     else:
         raw = (0x0C | (ln << 4)).to_bytes(3, "little") + literals
-    huf = _huf_literals_section(literals)
+    huf = _huf_literals_section(literals, plan=plan)
     return huf if huf is not None and len(huf) < len(raw) else raw
 
 
@@ -572,9 +573,10 @@ def _compress_block(block: bytes):
     # exact-size estimate gates the second whole-block Huffman pass:
     # the common LZ-compressible case (sequence body a tiny fraction
     # of the block) never pays for it.
-    est = _huf_estimate(block)
+    plan = _huf_plan(block)
+    est = _huf_estimate(plan, len(block))
     if est is not None and est + 1 < len(body):
-        flat = _lit_section(block) + b"\x00"
+        flat = _lit_section(block, plan=plan) + b"\x00"
         if len(flat) < len(body):
             body = flat
     return body if len(body) < len(block) else None

@@ -645,6 +645,21 @@ class BrokerNode:
                 _Proto, local_addr=(host or "0.0.0.0", int(port)))
             self.quic_port = \
                 self._quic_transport.get_extra_info("sockname")[1]
+            try:
+                # DF on outgoing datagrams: DPLPMTUD probes must test
+                # the path, not be silently IP-fragmented en route
+                import socket as _socket
+                sock = self._quic_transport.get_extra_info("socket")
+                if sock.family == _socket.AF_INET6:
+                    sock.setsockopt(_socket.IPPROTO_IPV6,
+                                    _socket.IPV6_MTU_DISCOVER,
+                                    _socket.IPV6_PMTUDISC_DO)
+                else:
+                    sock.setsockopt(_socket.IPPROTO_IP,
+                                    _socket.IP_MTU_DISCOVER,
+                                    _socket.IP_PMTUDISC_DO)
+            except (OSError, AttributeError):
+                pass                    # non-Linux / wrapped transport
 
             async def on_connection(stream, info):
                 await self.handle_stream(stream, ConnInfo(

@@ -98,7 +98,7 @@ class _Conn:
     """Shared machinery for both roles."""
 
     def __init__(self, role: str, tls: Tls13, scid: bytes,
-                 initial: LevelKeys) -> None:
+                 initial: LevelKeys, mtu_discovery: bool = True) -> None:
         self.role = role
         self.tls = tls
         self.scid = scid
@@ -166,6 +166,26 @@ class _Conn:
         # holes); drained on ACK receipt and on the PTO timer
         self._stream_txq: deque = deque()
         self._tx_window = 512
+        # packet pacing (RFC 9002 §7.7): a token bucket bounds how many
+        # stream packets one _service() releases, refilled at
+        # 1.25 × cwnd/srtt.  On LAN RTTs the rate is effectively
+        # unbounded; on lossy WAN paths it stops a full-window burst
+        # from flooding a shallow queue and re-triggering loss.  Before
+        # an RTT sample exists the bucket refills to the burst cap.
+        self._pace_tokens = 16.0
+        self._pace_last = time.monotonic()
+        # DPLPMTUD (RFC 8899 / RFC 9000 §14.3): after the handshake,
+        # PING+PADDING probe datagrams walk the ladder; an acked probe
+        # raises the datagram budget, a lost one (after one retry)
+        # freezes it — probe loss is NOT congestion evidence.
+        self._mtu_chunk = self._MTU_STREAM_CHUNK
+        self._mtu_validated = 1252
+        self._mtu_probe: Optional[Tuple[int, int]] = None   # (pn, size)
+        self._mtu_ladder: List[int] = (
+            [1452, 4096, 9000, 16000, 32000, 63000] if mtu_discovery
+            else [])
+        self._mtu_fails: Dict[int, int] = {}
+        self.mtu_probes_sent = 0
         self.last_seen = time.monotonic()
 
     # -- key plumbing --------------------------------------------------
@@ -249,6 +269,8 @@ class _Conn:
                     if fr.fin:
                         self.stream_fin = True
                 # non-zero streams: accepted and ignored (scope cut)
+            elif fr is FR.PING:
+                self._ack_due[level] = True
             elif fr is FR.HANDSHAKE_DONE:
                 self._ack_due[level] = True
                 self.handshake_done = True
@@ -281,6 +303,10 @@ class _Conn:
                         self._pto_count = 0     # backoff resets on ack
                         self._largest_acked[level] = max(
                             self._largest_acked[level], max(acked))
+                        if (level == LEVEL_APP
+                                and self._mtu_probe is not None
+                                and self._mtu_probe[0] in acked):
+                            self._mtu_probe_result(True)
                         self._detect_lost(level, now)
 
     # -- send ----------------------------------------------------------
@@ -306,7 +332,7 @@ class _Conn:
         groups: List[List[bytes]] = [[]]
         size = 0
         for fr in frames:
-            if groups[-1] and size + len(fr) > self._MTU_STREAM_CHUNK:
+            if groups[-1] and size + len(fr) > self._mtu_chunk:
                 groups.append([])
                 size = 0
             groups[-1].append(fr)
@@ -371,6 +397,7 @@ class _Conn:
             extra_dgrams.extend(app_pkts[1:])   # datagram (no length
         if not parts and app_pkt is None:       # field) — spares ride
             self._out_datagrams.extend(extra_dgrams)    # solo
+            self._maybe_send_mtu_probe()
             return
         total = sum(map(len, parts)) + (len(app_pkt) if app_pkt else 0)
         if has_initial and total < 1200:
@@ -387,6 +414,7 @@ class _Conn:
             parts.append(app_pkt)
         self._out_datagrams.append(b"".join(parts))
         self._out_datagrams.extend(extra_dgrams)
+        self._maybe_send_mtu_probe()
 
     def _make_padding(self, n: int, allow_short: bool = True) -> bytes:
         """A PADDING-only packet bringing the datagram to the 1200-byte
@@ -449,6 +477,14 @@ class _Conn:
                 if pn <= la - 3
                 or (time_limit is not None and pn < la
                     and t <= time_limit)]
+        if (level == LEVEL_APP and self._mtu_probe is not None
+                and self._mtu_probe[0] in lost):
+            # a lost MTU probe means the path can't carry that size —
+            # expected during discovery, NOT congestion (RFC 8899 §3):
+            # no retransmit, no window halving for the probe itself
+            lost.remove(self._mtu_probe[0])
+            sent.pop(self._mtu_probe[0], None)
+            self._mtu_probe_result(False)
         if not lost:
             return
         for pn in sorted(lost):         # original send order
@@ -463,6 +499,75 @@ class _Conn:
             self._ssthresh = max(2.0, self._cwnd / 2)
             self._cwnd = self._ssthresh
             self._recovery_until[level] = self._next_pn[level]
+
+    # -- DPLPMTUD (RFC 8899 / RFC 9000 §14.3) --------------------------
+
+    def _maybe_send_mtu_probe(self) -> None:
+        """One PING+PADDING probe datagram at the next ladder size;
+        at most one in flight.  An acked probe raises the validated
+        datagram budget (and the stream chunk size with it); a lost
+        one retries once, then freezes the ladder at the last
+        validated size."""
+        if (self._mtu_probe is not None or not self._mtu_ladder
+                or not self.handshake_done or self.closed):
+            return
+        keys = self._send_keys(LEVEL_APP)
+        if keys is None:
+            return
+        size = self._mtu_ladder[0]
+        pn = self._next_pn[LEVEL_APP]
+        self._next_pn[LEVEL_APP] += 1
+        payload = b"\x01"                       # PING, ack-eliciting
+        pkt = protect(PKT_1RTT, keys, pn, payload,
+                      dcid=self.remote_cid, scid=self.scid)
+        payload = b"\x01" + b"\x00" * max(0, size - len(pkt))
+        pkt = protect(PKT_1RTT, keys, pn, payload,
+                      dcid=self.remote_cid, scid=self.scid)
+        for _ in range(3):                      # varint convergence
+            delta = len(pkt) - size
+            if delta == 0 or len(payload) - delta < 1:
+                break
+            payload = payload[:len(payload) - delta]
+            pkt = protect(PKT_1RTT, keys, pn, payload,
+                          dcid=self.remote_cid, scid=self.scid)
+        self._sent[LEVEL_APP][pn] = (time.monotonic(), [])
+        self._mtu_probe = (pn, len(pkt))
+        self.mtu_probes_sent += 1
+        self._out_datagrams.append(pkt)         # rides alone: probing
+                                                # THIS datagram size
+
+    def _mtu_probe_result(self, ok: bool) -> None:
+        pn, size = self._mtu_probe              # type: ignore[misc]
+        self._mtu_probe = None
+        if ok:
+            self._mtu_validated = size
+            # short header + AEAD tag + STREAM frame header margin
+            self._mtu_chunk = size - 70
+            self._mtu_ladder = [s for s in self._mtu_ladder if s > size]
+        else:
+            fails = self._mtu_fails.get(size, 0) + 1
+            self._mtu_fails[size] = fails
+            if fails >= 2:                      # one retry per size,
+                self._mtu_ladder = []           # then freeze
+
+    def _resegment_app_frames(self) -> None:
+        """Split pending STREAM frames built at a larger validated MTU
+        back into base-MTU chunks (offsets preserved, FIN kept on the
+        final piece) — without this the black-hole fallback would keep
+        re-sending the same undeliverable jumbo frames."""
+        out: List[bytes] = []
+        for fr in self._pending_frames[LEVEL_APP]:
+            if 0x08 <= fr[0] <= 0x0F and len(fr) > self._mtu_chunk:
+                sf = next(iter(FR.parse_frames(fr)))
+                step = self._mtu_chunk
+                for i in range(0, len(sf.data) or 1, step):
+                    piece = sf.data[i:i + step]
+                    out.append(FR.encode_stream(
+                        sf.stream_id, sf.offset + i, piece,
+                        fin=sf.fin and i + step >= len(sf.data)))
+            else:
+                out.append(fr)
+        self._pending_frames[LEVEL_APP] = out
 
     def _rtt_sample(self, rtt: float) -> None:
         if rtt < 0:
@@ -496,15 +601,45 @@ class _Conn:
         fired = False
         for level, sent in self._sent.items():
             late = [pn for pn, (t, _) in sent.items() if t <= deadline]
+            if (level == LEVEL_APP and self._mtu_probe is not None
+                    and self._mtu_probe[0] in late):
+                # probe timeout = discovery failure, not congestion:
+                # no backoff, no retransmit counter for the probe alone
+                late.remove(self._mtu_probe[0])
+                sent.pop(self._mtu_probe[0], None)
+                self._mtu_probe_result(False)
             if not late:
                 continue
             fired = True
             for pn in sorted(late):     # original send order
                 _, frames = sent.pop(pn)
                 self._pending_frames[level].extend(frames)
+        if not fired and (self._stream_txq or
+                          (self.handshake_done and self._mtu_ladder
+                           and self._mtu_probe is None)):
+            # nothing timed out, but pacing may have withheld stream
+            # chunks (tokens refill with elapsed time) or an MTU probe
+            # slot opened — release them on the timer tick.  Returns
+            # False: these are not retransmissions; callers flush
+            # take_outgoing() either way.
+            self._service()
+            return False
         if fired:
             self.retransmits += 1
             self._pto_count += 1        # exponential backoff
+            if self._pto_count == 2 and self._mtu_validated > 1252:
+                # black-hole detection (RFC 8899 §4.3): persistent
+                # loss of full-size packets after an MTU was validated
+                # usually means the path shrank (route change under a
+                # DF socket) — fall back to the base PLPMTU and
+                # re-segment anything queued at the old size.  The
+                # ladder stays retired: a shrinking path has proven
+                # itself unstable.
+                self._mtu_validated = 1252
+                self._mtu_chunk = self._MTU_STREAM_CHUNK
+                self._mtu_ladder = []
+                self._mtu_probe = None
+                self._resegment_app_frames()
             if self._pto_count == 2:
                 # persistent congestion (RFC 9002 §7.6, PTO proxy):
                 # two consecutive timeouts with no ack in between —
@@ -520,14 +655,16 @@ class _Conn:
     # -- app surface ---------------------------------------------------
 
     # RFC 9000 §14: never send datagrams above the 1200-byte minimum
-    # path MTU we can assume without probing.  STREAM payload per packet
-    # leaves room for the short header + AEAD tag + frame header.
+    # path MTU until probing validates more.  STREAM payload per packet
+    # leaves room for the short header + AEAD tag + frame header; the
+    # instance's _mtu_chunk grows as DPLPMTUD validates larger sizes.
     _MTU_STREAM_CHUNK = 1130
 
     def send_stream(self, data: bytes, fin: bool = False) -> None:
-        # segment into MTU-sized packets: one oversized datagram would
-        # be IP-fragmented and silently dropped on frag-hostile paths
-        step = self._MTU_STREAM_CHUNK
+        # segment into path-MTU-sized packets: one oversized datagram
+        # would be IP-fragmented and silently dropped on frag-hostile
+        # paths
+        step = self._mtu_chunk
         chunks = [data[i:i + step]
                   for i in range(0, len(data), step)] or [b""]
         for j, chunk in enumerate(chunks):
@@ -538,19 +675,31 @@ class _Conn:
         """Window-limited release of queued stream chunks into frames:
         at most _tx_window packets in flight, so the _sent tracker
         never overflows and every unacked chunk stays retransmittable.
-        More drains happen on ACK receipt and PTO (both call
-        _service).  The release rate is additionally governed by the
-        congestion window — min(tracker cap, cwnd) packets in
-        flight."""
+        More drains happen on ACK receipt and on the timer tick (both
+        call _service).  The release rate is governed by the
+        congestion window — min(tracker cap, cwnd) packets in flight —
+        AND by the pacing bucket: tokens refill at 1.25 × cwnd/srtt
+        with a max(16, cwnd/2) burst cap, so a full window never
+        leaves as one line-rate burst (RFC 9002 §7.7)."""
+        now = time.monotonic()
+        burst = max(16.0, self._cwnd / 2)
+        if self._srtt:
+            rate = 1.25 * self._cwnd / max(self._srtt, 1e-4)
+            self._pace_tokens = min(
+                burst, self._pace_tokens + (now - self._pace_last) * rate)
+        else:
+            self._pace_tokens = burst       # pre-measurement: no pacing
+        self._pace_last = now
         room = (min(self._tx_window, max(2, int(self._cwnd)))
                 - len(self._sent[LEVEL_APP])
                 - len(self._pending_frames[LEVEL_APP]))
-        while self._stream_txq and room > 0:
+        while self._stream_txq and room > 0 and self._pace_tokens >= 1.0:
             chunk, fin = self._stream_txq.popleft()
             self._pending_frames[LEVEL_APP].append(
                 FR.encode_stream(0, self._stream_tx_off, chunk, fin=fin))
             self._stream_tx_off += len(chunk)
             room -= 1
+            self._pace_tokens -= 1.0
 
     def pop_stream_data(self) -> bytes:
         out = bytes(self._stream_in)
@@ -570,11 +719,12 @@ class _Conn:
 
 class QuicServerConnection(_Conn):
     def __init__(self, first_dcid: bytes, cert_pem: bytes, key_pem: bytes,
-                 alpn: str = "mqtt") -> None:
+                 alpn: str = "mqtt", mtu_discovery: bool = True) -> None:
         scid = os.urandom(8)
         tls = Tls13("server", cert_pem=cert_pem, key_pem=key_pem,
                     alpn=alpn, tp=_encode_tp(scid, first_dcid))
-        super().__init__("server", tls, scid, initial_keys(first_dcid))
+        super().__init__("server", tls, scid, initial_keys(first_dcid),
+                         mtu_discovery=mtu_discovery)
 
     @property
     def established(self) -> bool:
@@ -584,13 +734,15 @@ class QuicServerConnection(_Conn):
 class QuicClient(_Conn):
     def __init__(self, alpn: str = "mqtt", server_name: str = "",
                  verify_cert: bool = False,
-                 ca_pem: Optional[bytes] = None) -> None:
+                 ca_pem: Optional[bytes] = None,
+                 mtu_discovery: bool = True) -> None:
         odcid = os.urandom(8)
         scid = os.urandom(8)
         tls = Tls13("client", alpn=alpn, server_name=server_name,
                     verify_cert=verify_cert, ca_pem=ca_pem,
                     tp=_encode_tp(scid, None))
-        super().__init__("client", tls, scid, initial_keys(odcid))
+        super().__init__("client", tls, scid, initial_keys(odcid),
+                         mtu_discovery=mtu_discovery)
         self.remote_cid = odcid
         self._service()     # first flight: Initial(CRYPTO(ClientHello))
 
@@ -660,7 +812,8 @@ class QuicEndpoint:
     def __init__(self, transport, cert_pem: bytes, key_pem: bytes,
                  on_connection, alpn: str = "mqtt",
                  idle_timeout: float = 120.0,
-                 max_connections: int = 4096) -> None:
+                 max_connections: int = 4096,
+                 mtu_discovery: bool = True) -> None:
         self.transport = transport
         self.cert_pem = cert_pem
         self.key_pem = key_pem
@@ -674,6 +827,7 @@ class QuicEndpoint:
         # retry-token round would authenticate source addresses; out of
         # scope, and the cap bounds the damage either way)
         self.max_connections = max_connections
+        self.mtu_discovery = mtu_discovery
         self.by_cid: Dict[bytes, QuicServerConnection] = {}
         self.streams: Dict[QuicServerConnection, QuicStream] = {}
         self.handshakes = 0
@@ -701,8 +855,8 @@ class QuicEndpoint:
                 try:
                     if conn.on_timer(now):
                         self.retransmits += 1
-                        self._flush(conn)
-                except Exception:
+                    self._flush(conn)   # retransmits AND paced/probe
+                except Exception:       # datagrams ride the same tick
                     log.debug("quic retransmit", exc_info=True)
                     self._drop(conn)
         self._timer_task = None
@@ -735,7 +889,8 @@ class QuicEndpoint:
                 self.dropped_initials += 1      # 2 cid entries per conn
                 return
             conn = QuicServerConnection(dcid, self.cert_pem, self.key_pem,
-                                        alpn=self.alpn)
+                                        alpn=self.alpn,
+                                        mtu_discovery=self.mtu_discovery)
             conn.peer_addr = addr
             self.by_cid[dcid] = conn
             self.by_cid[conn.scid] = conn

@@ -111,9 +111,14 @@ def parse_frames(payload: bytes) -> Iterator[object]:
     n = len(payload)
     while off < n:
         t = payload[off]
-        if t == PADDING or t == PING:
+        if t == PADDING:
             off += 1
             continue
+        if t == PING:
+            off += 1
+            yield PING          # ack-eliciting: receiver must ack (a
+            continue            # PING-only packet is how MTU probes
+                                # and keepalives get acknowledged)
         if t in (ACK, ACK + 1):
             off += 1
             largest, off = decode_varint(payload, off)

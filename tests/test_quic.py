@@ -275,7 +275,7 @@ def test_mqtt_session_over_quic_listener(tmp_path):
 def test_stream_datagrams_respect_min_mtu():
     """RFC 9000 §14: a 5 KB publish must be segmented, never emitted as
     one IP-fragmenting datagram (review finding, round 5)."""
-    client = QuicClient()
+    client = QuicClient(mtu_discovery=False)
     box = [None]
     pump(client, box)
     client.send_stream(b"y" * 5000)
@@ -365,7 +365,7 @@ def test_frames_queued_before_keys_stay_segmented():
 def test_initial_datagrams_exactly_at_or_above_floor_never_over_mtu():
     """Padded Initial-bearing datagrams land exactly on 1200, never
     1201 (varint-boundary probe fix, review finding, r5)."""
-    client = QuicClient()
+    client = QuicClient(mtu_discovery=False)
     box = [None]
     for _ in range(12):
         moved = False
@@ -396,7 +396,7 @@ def test_lost_stream_datagram_retransmitted():
     after the PTO instead of stalling the stream forever."""
     import time as _time
 
-    client = QuicClient()
+    client = QuicClient(mtu_discovery=False)
     box = [None]
     pump(client, box)
     assert client.established
@@ -563,7 +563,7 @@ def test_fast_retransmit_on_ack_evidence_no_pto():
     """RFC 9002 §6.1: a packet 3+ below the largest acked is declared
     lost AT ACK RECEIPT and retransmits immediately — the stream heals
     without any PTO timer firing."""
-    client = QuicClient()
+    client = QuicClient(mtu_discovery=False)
     box = [None]
     pump(client, box)
     assert client.established
@@ -590,7 +590,7 @@ def test_fast_retransmit_on_ack_evidence_no_pto():
 def test_cwnd_grows_on_acks_and_collapses_on_persistent_pto():
     import time as _time
 
-    client = QuicClient()
+    client = QuicClient(mtu_discovery=False)
     box = [None]
     pump(client, box)
     grown = client._cwnd
@@ -607,7 +607,7 @@ def test_cwnd_grows_on_acks_and_collapses_on_persistent_pto():
 
 
 def test_stream_release_respects_cwnd():
-    client = QuicClient()
+    client = QuicClient(mtu_discovery=False)
     box = [None]
     pump(client, box)
     client._cwnd = 3.0                       # squeeze the window
@@ -639,3 +639,141 @@ def test_third_pto_does_not_clobber_ssthresh():
     assert client._cwnd == 2.0 and client._ssthresh == 50.0
     assert client.on_timer(t + 1000)         # third PTO: no re-collapse
     assert client._ssthresh == 50.0
+
+
+# ---------------------------------------------------------------------------
+# DPLPMTUD + pacing (round-5 close-out of the stated QUIC cuts)
+# ---------------------------------------------------------------------------
+
+def test_pmtud_raises_datagram_budget_on_clean_path():
+    """RFC 8899 analog: PING+PADDING probes walk the ladder on a path
+    that carries them; each acked probe raises the validated size and
+    the stream chunk, so bulk writes use far fewer datagrams."""
+    client = QuicClient()
+    box = [None]
+    pump(client, box, limit=30)
+    assert client.established
+    assert client.mtu_probes_sent >= 1
+    assert client._mtu_validated == 63000       # ladder exhausted
+    assert client._mtu_chunk == 63000 - 70
+    assert not client._mtu_ladder
+    # a 100 KB write now rides in 2 datagrams, not ~90
+    client.send_stream(b"m" * 100_000)
+    dgs = client.take_outgoing()
+    assert len(dgs) <= 3
+    assert max(len(d) for d in dgs) > 1252
+    for dg in dgs:
+        box[0].receive(dg)
+    assert box[0].pop_stream_data() == b"m" * 100_000
+
+
+def test_pmtud_probe_loss_freezes_ladder_without_congestion_signal():
+    """A path capped at 1252 bytes drops every probe: after one retry
+    per size the ladder freezes at the floor — and probe loss must NOT
+    halve the congestion window or count as a retransmission."""
+    import time as _time
+
+    client = QuicClient()
+    box = [None]
+    # a 1252-byte path: probe datagrams never arrive
+    for _ in range(40):
+        moved = False
+        for dg in client.take_outgoing():
+            if len(dg) > 1252:
+                moved = True                     # dropped by the path
+                continue
+            moved = True
+            if box[0] is None:
+                box[0] = QuicServerConnection(dg[6:6 + dg[5]],
+                                              CERT_PEM, KEY_PEM,
+                                              mtu_discovery=False)
+            box[0].receive(dg)
+        if box[0] is not None:
+            for dg in box[0].take_outgoing():
+                moved = True
+                client.receive(dg)
+        # PTO tick declares the in-flight probe lost, sends the next
+        client.on_timer(_time.monotonic() + 10)
+        if box[0] is not None and box[0].established \
+                and not client._mtu_ladder and client._mtu_probe is None:
+            break
+        if not moved and box[0] is not None and not client._mtu_ladder:
+            break
+    assert client.established
+    assert not client._mtu_ladder                # gave up
+    assert client._mtu_validated == 1252         # floor kept
+    assert client._mtu_chunk == 1130
+    assert client.mtu_probes_sent >= 2           # one retry happened
+    assert client.fast_retransmits == 0          # loss != congestion
+    # stream traffic still flows at the floor
+    client.send_stream(b"still fine")
+    for dg in client.take_outgoing():
+        assert len(dg) <= 1252
+        box[0].receive(dg)
+    assert box[0].pop_stream_data() == b"still fine"
+
+
+def test_pacing_bounds_release_bursts():
+    """RFC 9002 §7.7 analog: with a measured (slow) RTT, one
+    _service() releases at most the burst cap, and tokens refill with
+    elapsed time rather than all at once."""
+    client = QuicClient(mtu_discovery=False)
+    box = [None]
+    pump(client, box)
+    assert client.established
+    client._srtt = 1.0                  # pretend a 1 s RTT path
+    client._rttvar = 0.0
+    client._cwnd = 400.0                # huge window: pacing must bind
+    client._pace_tokens = 0.0
+    client._pace_last = __import__("time").monotonic()
+    client.send_stream(b"q" * 1130 * 100)        # 100 chunks queued
+    released = len(client._sent["1rtt"]) + \
+        len(client._pending_frames["1rtt"])
+    burst = max(16, int(client._cwnd / 2))
+    assert released <= burst            # one call != the whole window
+    assert client._stream_txq           # remainder paced, not dropped
+    # simulate 100 ms passing: ~50 more packets (1.25*400/1.0*0.1)
+    client._pace_last -= 0.1
+    client.on_timer()                   # timer tick drains the queue
+    released2 = len(client._sent["1rtt"]) + \
+        len(client._pending_frames["1rtt"]) + \
+        sum(1 for _ in client.take_outgoing())
+    assert released2 > released         # refill released more
+
+
+def test_pmtud_black_hole_falls_back_to_base_mtu():
+    """RFC 8899 §4.3 analog: after a larger MTU is validated, a path
+    shrink (route change) makes every full-size packet vanish.  Two
+    consecutive PTOs must reset the budget to the base PLPMTU and
+    re-segment queued jumbo STREAM frames so the stream heals."""
+    import time as _time
+
+    client = QuicClient()
+    box = [None]
+    pump(client, box, limit=30)
+    assert client._mtu_validated == 63000        # clean path validated
+    payload = bytes(range(256)) * 2000           # 512 KB
+    client.send_stream(payload, fin=True)
+    # the path now drops anything over 1252 bytes
+    def shuttle():
+        for dg in client.take_outgoing():
+            if len(dg) <= 1252:
+                box[0].receive(dg)
+        for dg in box[0].take_outgoing():
+            client.receive(dg)
+    shuttle()                                    # jumbo frames all lost
+    assert bytes(box[0]._stream_in) != payload
+    t = _time.monotonic()
+    assert client.on_timer(t + 10)               # first PTO
+    shuttle()
+    assert client.on_timer(t + 100)              # second: fallback
+    assert client._mtu_validated == 1252
+    assert client._mtu_chunk == 1130
+    assert not client._mtu_ladder                # ladder stays retired
+    # drain to completion at the base MTU
+    for _ in range(600):
+        shuttle()
+        client.on_timer(_time.monotonic() + 100)
+        if bytes(box[0]._stream_in) == payload:
+            break
+    assert bytes(box[0]._stream_in) == payload
