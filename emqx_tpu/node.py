@@ -985,6 +985,7 @@ class BrokerNode:
         finding)."""
         if self.quic is None:
             return []
+        conns = self.quic.live_conns()
         return [{
             "id": "quic:default", "type": "quic",
             "bind": f"udp:{self.quic_port}", "running": True,
@@ -992,6 +993,15 @@ class BrokerNode:
             "handshakes": self.quic.handshakes,
             "dropped_initials": self.quic.dropped_initials,
             "retransmits": self.quic.retransmits,
+            # recovery/path state rolled up over live connections: the
+            # operator-facing view of RFC 9002 loss detection and
+            # DPLPMTUD (fast_retransmits = ack-evidence losses healed
+            # without a timer; mtu_validated_max = largest datagram
+            # budget any live path proved)
+            "fast_retransmits": sum(c.fast_retransmits for c in conns),
+            "mtu_probes_sent": sum(c.mtu_probes_sent for c in conns),
+            "mtu_validated_max": max(
+                (c.mtu_validated for c in conns), default=1252),
         }]
 
     def info(self) -> dict:

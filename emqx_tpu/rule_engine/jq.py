@@ -31,6 +31,18 @@ Supported (the surface rule engines actually use):
   vanish without one (``?`` still works as postfix try);
 * string interpolation ``"a \\(expr) b"`` incl. nested strings inside
   the interpolation and multi-output fan-out;
+* path expressions and the assignment family: ``path(f)``,
+  ``del(f)``, ``delpaths``, ``.a = v``, ``.a |= f`` (empty rhs
+  deletes, jq-1.7-style), ``+= -= *= /= %= //=`` — LHS paths support
+  fields, indices, iteration, pipes, comma, optional forms,
+  ``select``, ``first``/``last``, ``getpath``, ``if`` and ``try``;
+* regex (Python ``re`` over the common Oniguruma subset, named groups
+  auto-translated): ``test(re[;flags])``, ``match``, ``capture``,
+  ``sub``, ``gsub`` — replacement expressions see the named captures
+  as ``.``, flags ``g i x s m``;
+* dates (UTC, jq's gmtime family): ``now``, ``gmtime``, ``mktime``,
+  ``todate[iso8601]``, ``fromdate[iso8601]``, ``strftime``,
+  ``strptime``;
 * builtins: length, keys, values, type, add, floor, ceil, sqrt, abs,
   tostring, tonumber, tojson, fromjson, ascii_downcase, ascii_upcase,
   reverse, sort, sort_by(f), unique, unique_by(f), group_by(f),
@@ -46,8 +58,8 @@ Supported (the surface rule engines actually use):
 
 Out of scope (documented, erroring loudly rather than mis-evaluating):
 ``def`` (user functions), ``label``/``break``, destructuring patterns
-in ``as``, path expressions for ``del``/``|=`` update-assign, regex
-capture builtins beyond ``test``/``splits``, and date builtins.
+in ``as``, slice assignment (``.[:2] = ...``), ``limit``/``..`` as
+path expressions, and ``ltrimstr`` etc. in LHS paths.
 
 jq's comparison/sort total order (null < false < true < numbers <
 strings < arrays < objects) is implemented so ``sort``/``min``/``max``
@@ -77,7 +89,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
   | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<punct>\.\.|//|==|!=|<=|>=|\||,|\.|\[|\]|\{|\}|\(|\)|:|;|\?|<|>|\+|-|\*|/|%)
+  | (?P<punct>\.\.|//=|//|==|!=|<=|>=|\|=|\+=|-=|\*=|/=|%=|=|\||,|\.|\[|\]|\{|\}|\(|\)|:|;|\?|<|>|\+|-|\*|/|%)
 """, re.VERBOSE)
 
 # reserved words — like jq's lexer, these never parse as `.field`
@@ -234,9 +246,22 @@ class _Parser:
         return parts[0] if len(parts) == 1 else ("comma", parts)
 
     def parse_alt(self):
-        left = self.parse_or()
+        left = self.parse_assign()
         while self.eat("//"):
-            left = ("alt", left, self.parse_or())
+            left = ("alt", left, self.parse_assign())
+        return left
+
+    _ASSIGN_OPS = ("=", "|=", "+=", "-=", "*=", "/=", "%=", "//=")
+
+    def parse_assign(self):
+        # jq precedence: `//` is LOOSER than the `=` family, which is
+        # nonassoc over `or`-level operands (`.a = .b = 1` is an error,
+        # matching jq)
+        left = self.parse_or()
+        kind, text = self.peek()
+        if kind == "punct" and text in self._ASSIGN_OPS:
+            self.next()
+            return ("assign", text, left, self.parse_or())
         return left
 
     def parse_or(self):
@@ -793,7 +818,200 @@ def _eval(node, v: Any, env=None) -> List[Any]:
             # cartesian: a multi-output interpolation fans the string out
             results = [r + p for r in results for p in pieces]
         return results
+    if tag == "assign":
+        return _eval_assign(node[1], node[2], node[3], v, env)
     raise JqError(f"jq: internal: unknown node {tag}")
+
+
+# ---------------------------------------------------------------------------
+# path expressions — the machinery behind =, |=, op=, del(), path()
+# ---------------------------------------------------------------------------
+
+def _paths_of(node, v: Any, env) -> List[Tuple[List[Any], Any]]:
+    """Evaluate ``node`` as a jq PATH EXPRESSION against ``v``:
+    returns (path, value-at-path) pairs.  Non-path constructs raise,
+    like jq's "Invalid path expression".  Index expressions inside
+    brackets see the current input, jq-style."""
+    tag = node[0]
+    if tag in ("dot", "identity"):
+        return [([], v)]
+    if tag == "field":
+        name, opt = node[2][1], node[3]
+        out = []
+        for bp, bv in _paths_of(node[1], v, env):
+            if bv is None or isinstance(bv, dict):
+                out.append((bp + [name],
+                            None if bv is None else bv.get(name)))
+            elif not opt:
+                raise JqError(f"jq: cannot index {_jq_type(bv)} "
+                              f"with \"{name}\"")
+        return out
+    if tag == "indexe":
+        opt = node[3]
+        out = []
+        for bp, bv in _paths_of(node[1], v, env):
+            for idx in _eval(node[2], v, env):
+                if isinstance(idx, str):
+                    if bv is None or isinstance(bv, dict):
+                        out.append((bp + [idx],
+                                    None if bv is None else bv.get(idx)))
+                    elif not opt:
+                        raise JqError(
+                            f"jq: cannot index {_jq_type(bv)} with string")
+                elif isinstance(idx, (int, float)) \
+                        and not isinstance(idx, bool):
+                    if bv is None or isinstance(bv, list):
+                        got = [] if bv is None else _index(bv, idx, True)
+                        out.append((bp + [int(idx)],
+                                    got[0] if got else None))
+                    elif not opt:
+                        raise JqError(
+                            f"jq: cannot index {_jq_type(bv)} with number")
+                elif not opt:
+                    raise JqError(
+                        f"jq: invalid path index {_jq_type(idx)}")
+        return out
+    if tag == "iter":
+        opt = node[2]
+        out = []
+        for bp, bv in _paths_of(node[1], v, env):
+            if isinstance(bv, list):
+                out.extend((bp + [i], x) for i, x in enumerate(bv))
+            elif isinstance(bv, dict):
+                out.extend((bp + [k], x) for k, x in bv.items())
+            elif not opt:
+                raise JqError(f"jq: cannot iterate over {_jq_type(bv)}")
+        return out
+    if tag == "pipe":
+        out = []
+        for bp, bv in _paths_of(node[1], v, env):
+            out.extend((bp + sp, sv)
+                       for sp, sv in _paths_of(node[2], bv, env))
+        return out
+    if tag == "comma":
+        out = []
+        for part in node[1]:
+            out.extend(_paths_of(part, v, env))
+        return out
+    if tag == "if":
+        _, cond, then, els = node
+        out = []
+        for c in _eval(cond, v, env):
+            out.extend(_paths_of(then if _truthy(c) else els, v, env))
+        return out
+    if tag == "call" and node[1] == "select" and len(node[2]) == 1:
+        return [(p, x) for p, x in _paths_of(("dot",), v, env)
+                for c in _eval(node[2][0], x, env) if _truthy(c)]
+    if tag == "call" and node[1] == "empty":
+        return []
+    if tag == "call" and node[1] in ("first", "last") and not node[2]:
+        # jq defines first as .[0] and last as .[-1] — same in paths
+        idx = 0 if node[1] == "first" else -1
+        return _paths_of(("indexe", ("dot",), ("lit", idx), False),
+                         v, env)
+    if tag == "call" and node[1] == "getpath" and len(node[2]) == 1:
+        out = []
+        for p in _eval(node[2][0], v, env):
+            if not isinstance(p, list):
+                raise JqError("jq: getpath needs an array path")
+            x = v
+            for c in p:
+                got = _index(x, c, opt=True) if x is not None else []
+                x = got[0] if got else None
+            out.append((p, x))
+        return out
+    if tag == "try":
+        try:
+            return _paths_of(node[1], v, env)
+        except JqError:
+            return [] if node[2] is None else _paths_of(node[2], v, env)
+    raise JqError("jq: invalid path expression")
+
+
+def _delpath(v: Any, path: List[Any]) -> Any:
+    """Functional delete; missing segments are a no-op, like jq."""
+    if not path:
+        return None
+    p = path[0]
+    if isinstance(p, str):
+        if v is None or not isinstance(v, dict) or p not in v:
+            if v is not None and not isinstance(v, dict):
+                raise JqError(
+                    f"jq: cannot delete field of {_jq_type(v)}")
+            return v
+        out = dict(v)
+        if len(path) == 1:
+            del out[p]
+        else:
+            out[p] = _delpath(out[p], path[1:])
+        return out
+    if isinstance(p, (int, float)) and not isinstance(p, bool):
+        if v is None:
+            return v
+        if not isinstance(v, list):
+            raise JqError(f"jq: cannot delete index of {_jq_type(v)}")
+        i = int(p) + (len(v) if p < 0 else 0)
+        if not 0 <= i < len(v):
+            return v
+        out = list(v)
+        if len(path) == 1:
+            del out[i]
+        else:
+            out[i] = _delpath(out[i], path[1:])
+        return out
+    raise JqError(f"jq: invalid path component {_jq_type(p)}")
+
+
+def _delpaths(v: Any, paths: List[List[Any]]) -> Any:
+    # deepest/rightmost first so earlier deletions don't shift the
+    # indices later ones rely on (jq sorts the same way)
+    for p in sorted(paths, key=_SortKey, reverse=True):
+        if not isinstance(p, list):
+            raise JqError("jq: delpaths needs an array of paths")
+        v = _delpath(v, p)
+    return v
+
+
+def _eval_assign(op: str, lhs, rhs, v: Any, env) -> List[Any]:
+    paths = [p for p, _ in _paths_of(lhs, v, env)]
+    if op == "|=":
+        # update-assign: rhs sees the OLD value at each path; an empty
+        # rhs deletes the path (jq 1.7 semantics)
+        cur = v
+        dels = []
+        for p in paths:
+            old = _getpath_value(cur, p)
+            outs = _eval(rhs, old, env)
+            if outs:
+                cur = _setpath(cur, p, outs[0])
+            else:
+                dels.append(p)
+        return [_delpaths(cur, dels) if dels else cur]
+    out = []
+    for b in _eval(rhs, v, env):        # rhs sees the ORIGINAL input
+        cur = v
+        for p in paths:
+            if op == "=":
+                new = b
+            else:
+                old = _getpath_value(cur, p)
+                if op == "//=":
+                    new = old if _truthy(old) else b
+                else:
+                    new = _arith(op[0], old, b)
+            cur = _setpath(cur, p, new)
+        out.append(cur)
+    return out
+
+
+def _getpath_value(v: Any, path: List[Any]) -> Any:
+    x = v
+    for p in path:
+        if x is None:
+            continue
+        got = _index(x, p, opt=True)
+        x = got[0] if got else None
+    return x
 
 
 def _call(name: str, args: List[Any], v: Any,
@@ -930,10 +1148,45 @@ def _call(name: str, args: List[Any], v: Any,
         if name == "ltrimstr":
             return [v[len(s):] if v.startswith(s) else v]
         return [v[:len(v) - len(s)] if s and v.endswith(s) else v]
-    if name == "test" and n == 1:
+    if name == "test" and n in (1, 2):
         if not isinstance(v, str):
             raise JqError("jq: test needs a string input")
-        return [re.search(one(0), v) is not None]
+        rx = _jq_regex(one(0), one(1) if n == 2 else "")
+        return [rx.search(v) is not None]
+    if name == "match" and n in (1, 2):
+        if not isinstance(v, str):
+            raise JqError("jq: match needs a string input")
+        flags = one(1) if n == 2 else ""
+        rx = _jq_regex(one(0), flags)
+        ms = rx.finditer(v) if "g" in flags else \
+            ([m] if (m := rx.search(v)) else [])
+        return [_match_obj(m) for m in ms]
+    if name == "capture" and n in (1, 2):
+        if not isinstance(v, str):
+            raise JqError("jq: capture needs a string input")
+        flags = one(1) if n == 2 else ""
+        rx = _jq_regex(one(0), flags)
+        ms = rx.finditer(v) if "g" in flags else \
+            ([m] if (m := rx.search(v)) else [])
+        return [m.groupdict() for m in ms]
+    if name in ("sub", "gsub") and n in (2, 3):
+        if not isinstance(v, str):
+            raise JqError(f"jq: {name} needs a string input")
+        flags = one(2) if n == 3 else ""
+        rx = _jq_regex(one(0), flags)
+        count = 0 if name == "gsub" or "g" in flags else 1
+
+        def repl(m) -> str:
+            # jq evaluates the replacement EXPRESSION with the named
+            # captures as `.` (first output used when it fans out)
+            outs = _eval(args[1], m.groupdict(), env)
+            if not outs:
+                raise JqError(f"jq: {name} replacement produced no value")
+            r = outs[0]
+            if not isinstance(r, str):
+                raise JqError(f"jq: {name} replacement must be a string")
+            return r
+        return [rx.sub(repl, v, count=count)]
     if name == "first" and n == 0:      # jq defines first as .[0]:
         if not isinstance(v, list):     # null on empty, not an error
             raise JqError("jq: first needs an array")
@@ -1189,7 +1442,136 @@ def _call(name: str, args: List[Any], v: Any,
         if not isinstance(v, str):
             _bad("utf8bytelength", v)
         return [len(v.encode())]
+    if name == "path" and n == 1:
+        return [p for p, _ in _paths_of(args[0], v, env)]
+    if name == "del" and n == 1:
+        return [_delpaths(v, [p for p, _ in _paths_of(args[0], v, env)])]
+    if name == "delpaths" and n == 1:
+        ps = one(0)
+        if not isinstance(ps, list):
+            raise JqError("jq: delpaths needs an array of paths")
+        return [_delpaths(v, ps)]
+    # --- dates (C-locale, UTC — matching jq's gmtime family) --------------
+    if name == "now" and n == 0:
+        import time as _t
+        return [_t.time()]
+    if name == "gmtime" and n == 0:
+        return [_gmtime_arr(_num(v, "gmtime'd"))]
+    if name == "mktime" and n == 0:
+        return [_mktime_num(v)]
+    if name in ("todate", "todateiso8601") and n == 0:
+        import time as _t
+        try:
+            return [_t.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                _t.gmtime(_num(v, "dated")))]
+        except (OverflowError, OSError, ValueError):
+            raise JqError(f"jq: timestamp out of range: {v!r}")
+    if name in ("fromdate", "fromdateiso8601") and n == 0:
+        if not isinstance(v, str):
+            _bad(name, v)
+        import calendar
+        import time as _t
+        try:
+            return [calendar.timegm(
+                _t.strptime(v, "%Y-%m-%dT%H:%M:%SZ"))]
+        except ValueError:
+            raise JqError(f"jq: {v!r} is not an ISO-8601 datetime")
+    if name == "strftime" and n == 1:
+        import time as _t
+        fmt = one(0)
+        if not isinstance(fmt, str):
+            raise JqError("jq: strftime needs a format string")
+        secs = _num(v, "formatted") if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else _mktime_num(v)
+        try:
+            return [_t.strftime(fmt, _t.gmtime(secs))]
+        except (OverflowError, OSError, ValueError):
+            raise JqError(f"jq: timestamp out of range: {v!r}")
+    if name == "strptime" and n == 1:
+        import calendar
+        import time as _t
+        fmt = one(0)
+        if not isinstance(v, str) or not isinstance(fmt, str):
+            raise JqError("jq: strptime needs string input and format")
+        try:
+            st = _t.strptime(v, fmt)
+        except ValueError as e:
+            raise JqError(f"jq: strptime: {e}")
+        return [_gmtime_arr(calendar.timegm(st))]
     raise JqError(f"jq: unknown function {name}/{n}")
+
+
+def _jq_regex(pat: Any, flags: Any):
+    """Compile a jq (Oniguruma-style) regex with jq's flag letters.
+    Python's `re` covers the common subset; named groups translate
+    from ``(?<n>...)`` to ``(?P<n>...)``.  Divergences beyond that
+    (e.g. \\h, possessive quantifiers) surface as JqError."""
+    if not isinstance(pat, str):
+        raise JqError("jq: regex must be a string")
+    if not isinstance(flags, str):
+        raise JqError("jq: regex flags must be a string")
+    f = 0
+    for c in flags:
+        if c == "i":
+            f |= re.IGNORECASE
+        elif c == "x":
+            f |= re.VERBOSE
+        elif c == "s":
+            f |= re.DOTALL
+        elif c == "m":
+            f |= re.MULTILINE
+        elif c != "g":                  # g handled by the callers
+            raise JqError(f"jq: unsupported regex flag {c!r}")
+    pat = re.sub(r"\(\?<([A-Za-z_][A-Za-z0-9_]*)>", r"(?P<\1>", pat)
+    try:
+        return re.compile(pat, f)
+    except re.error as e:
+        raise JqError(f"jq: bad regex: {e}")
+
+
+def _match_obj(m) -> dict:
+    caps = []
+    gi = m.re.groupindex
+    names = {idx: nm for nm, idx in gi.items()}
+    for i in range(1, m.re.groups + 1):
+        s = m.group(i)
+        caps.append({
+            "offset": m.start(i) if s is not None else -1,
+            "length": len(s) if s is not None else 0,
+            "string": s,
+            "name": names.get(i),
+        })
+    return {"offset": m.start(), "length": len(m.group(0)),
+            "string": m.group(0), "captures": caps}
+
+
+def _gmtime_arr(secs: float) -> list:
+    """jq's broken-down UTC time: [year, month(0-based), mday, hour,
+    min, sec(+frac), wday(Sunday=0), yday(0-based)]."""
+    import time as _t
+    try:
+        g = _t.gmtime(int(secs))
+    except (OverflowError, OSError, ValueError):
+        # platform time_t limits must surface as jq errors (catchable
+        # by try/catch), not raw OverflowError (module error contract)
+        raise JqError(f"jq: timestamp out of range: {secs!r}")
+    frac = secs - int(secs)
+    return [g.tm_year, g.tm_mon - 1, g.tm_mday, g.tm_hour, g.tm_min,
+            g.tm_sec + frac if frac else g.tm_sec,
+            (g.tm_wday + 1) % 7, g.tm_yday - 1]
+
+
+def _mktime_num(v: Any) -> int:
+    import calendar
+    if not (isinstance(v, list) and len(v) >= 6
+            and all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                    for x in v[:6])):
+        raise JqError("jq: mktime needs a broken-down time array")
+    y, mon0, mday, hh, mm, ss = (int(x) for x in v[:6])
+    try:
+        return calendar.timegm((y, mon0 + 1, mday, hh, mm, ss, 0, 1, 0))
+    except (OverflowError, OSError, ValueError):
+        raise JqError(f"jq: broken-down time out of range: {v!r}")
 
 
 def _setpath(v: Any, path: List[Any], val: Any) -> Any:

@@ -178,8 +178,10 @@ class _Conn:
         # PING+PADDING probe datagrams walk the ladder; an acked probe
         # raises the datagram budget, a lost one (after one retry)
         # freezes it — probe loss is NOT congestion evidence.
+        # mtu_validated is the PUBLIC operator-facing view (listener
+        # stats); the rest is internal probe state.
         self._mtu_chunk = self._MTU_STREAM_CHUNK
-        self._mtu_validated = 1252
+        self.mtu_validated = 1252
         self._mtu_probe: Optional[Tuple[int, int]] = None   # (pn, size)
         self._mtu_ladder: List[int] = (
             [1452, 4096, 9000, 16000, 32000, 63000] if mtu_discovery
@@ -540,7 +542,7 @@ class _Conn:
         pn, size = self._mtu_probe              # type: ignore[misc]
         self._mtu_probe = None
         if ok:
-            self._mtu_validated = size
+            self.mtu_validated = size
             # short header + AEAD tag + STREAM frame header margin
             self._mtu_chunk = size - 70
             self._mtu_ladder = [s for s in self._mtu_ladder if s > size]
@@ -627,7 +629,7 @@ class _Conn:
         if fired:
             self.retransmits += 1
             self._pto_count += 1        # exponential backoff
-            if self._pto_count == 2 and self._mtu_validated > 1252:
+            if self._pto_count == 2 and self.mtu_validated > 1252:
                 # black-hole detection (RFC 8899 §4.3): persistent
                 # loss of full-size packets after an MTU was validated
                 # usually means the path shrank (route change under a
@@ -635,7 +637,7 @@ class _Conn:
                 # re-segment anything queued at the old size.  The
                 # ladder stays retired: a shrinking path has proven
                 # itself unstable.
-                self._mtu_validated = 1252
+                self.mtu_validated = 1252
                 self._mtu_chunk = self._MTU_STREAM_CHUNK
                 self._mtu_ladder = []
                 self._mtu_probe = None
@@ -836,6 +838,11 @@ class QuicEndpoint:
         self.retransmit_tick = 0.2
         self._timer_task: Optional[asyncio.Task] = None
 
+
+    def live_conns(self) -> list:
+        """Unique live connections (by_cid holds 2 entries per conn)."""
+        return list({id(c): c for c in self.by_cid.values()}.values())
+
     def _ensure_timer(self) -> None:
         """Retransmission timer: one endpoint-wide ~200 ms tick driving
         every connection's PTO (RFC 9002 analog; the 1 s node
@@ -851,7 +858,7 @@ class QuicEndpoint:
         while self.by_cid:
             await asyncio.sleep(self.retransmit_tick)
             now = time.monotonic()
-            for conn in {id(c): c for c in self.by_cid.values()}.values():
+            for conn in self.live_conns():
                 try:
                     if conn.on_timer(now):
                         self.retransmits += 1
@@ -937,9 +944,9 @@ class QuicEndpoint:
 
     def sweep(self, now: Optional[float] = None) -> int:
         now = now if now is not None else time.monotonic()
-        stale = {id(c): c for c in self.by_cid.values()
-                 if now - c.last_seen > self.idle_timeout}
-        for c in stale.values():
+        stale = [c for c in self.live_conns()
+                 if now - c.last_seen > self.idle_timeout]
+        for c in stale:
             self._drop(c)
         return len(stale)
 
@@ -947,7 +954,7 @@ class QuicEndpoint:
         if self._timer_task is not None:
             self._timer_task.cancel()
             self._timer_task = None
-        for conn in {id(c): c for c in self.by_cid.values()}.values():
+        for conn in self.live_conns():
             conn.close(0, "server shutdown")
             self._flush(conn)
             s = self.streams.pop(conn, None)

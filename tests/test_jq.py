@@ -346,3 +346,128 @@ def test_jq_nth_bad_count_is_jqerror():
         jq_eval('nth("a"; .[])', [1, 2, 3])
     with pytest.raises(JqError):
         jq_eval('limit("a"; .[])', [1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# round-5 close-out: paths/assignment, regex capture family, dates
+# ---------------------------------------------------------------------------
+
+ASSIGN_CASES = [
+    ('.a = 1', {"b": 2}, [{"b": 2, "a": 1}]),
+    ('.a.b = 5', {}, [{"a": {"b": 5}}]),
+    ('.a[0] = "x"', {"a": [1, 2]}, [{"a": ["x", 2]}]),
+    ('.[] = 0', [1, 2, 3], [[0, 0, 0]]),
+    ('.a |= . + 1', {"a": 4}, [{"a": 5}]),
+    ('.a += 2', {"a": 1}, [{"a": 3}]),
+    ('.a -= 2', {"a": 1}, [{"a": -1}]),
+    ('.a *= 3', {"a": 2}, [{"a": 6}]),
+    ('.a /= 2', {"a": 7}, [{"a": 3.5}]),
+    ('.a //= 9', {"a": None}, [{"a": 9}]),
+    ('.a //= 9', {"a": 5}, [{"a": 5}]),
+    ('(.a, .b) = 7', {}, [{"a": 7, "b": 7}]),
+    # rhs sees the ORIGINAL input, one output per rhs value
+    ('.a = (.b, .c)', {"b": 1, "c": 2},
+     [{"b": 1, "c": 2, "a": 1}, {"b": 1, "c": 2, "a": 2}]),
+    ('.users[].age += 1', {"users": [{"age": 1}, {"age": 2}]},
+     [{"users": [{"age": 2}, {"age": 3}]}]),
+    # select() narrows the path set, jq-style
+    ('(.a[] | select(. > 1)) = 0', {"a": [1, 2, 3]}, [{"a": [1, 0, 0]}]),
+    # |= with empty rhs deletes the path (jq 1.7 semantics)
+    ('.a |= empty', {"a": 1, "b": 2}, [{"b": 2}]),
+    ('del(.a)', {"a": 1, "b": 2}, [{"b": 2}]),
+    ('del(.a[1])', {"a": [1, 2, 3]}, [{"a": [1, 3]}]),
+    # multiple indices delete deepest-first: no index shifting
+    ('del(.a[0, 1])', {"a": [1, 2, 3]}, [{"a": [3]}]),
+    ('del(.missing)', {"b": 2}, [{"b": 2}]),
+    ('path(.a.b)', None, [["a", "b"]]),
+    ('path(.a[])', {"a": [1, 2]}, [["a", 0], ["a", 1]]),
+    ('delpaths([["a", "b"], ["c"]])',
+     {"a": {"b": 1, "z": 2}, "c": 3}, [{"a": {"z": 2}}]),
+    # assignment precedence: `//` is looser, `=` family over or-level
+    ('.a = 1 // 2', {}, [{"a": 1}]),
+    # optional path forms skip mistyped bases instead of erroring
+    ('.a.b? = 1', {"a": 5}, [{"a": 5}]),
+    ('(.xs[]? | .k) = 1', {"xs": 3}, [{"xs": 3}]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", ASSIGN_CASES,
+                         ids=[c[0] for c in ASSIGN_CASES])
+def test_jq_assignment_family(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+REGEX_CASES = [
+    ('match("a+")', "baaad",
+     [{"offset": 1, "length": 3, "string": "aaa", "captures": []}]),
+    ('[match("a"; "g") | .offset]', "banana", [[1, 3, 5]]),
+    ('capture("(?<x>[0-9]+)-(?<y>[a-z]+)")', "17-abc",
+     [{"x": "17", "y": "abc"}]),
+    ('sub("a"; "o")', "banana", ["bonana"]),
+    ('gsub("a"; "o")', "banana", ["bonono"]),
+    # the replacement expression sees named captures as `.`
+    ('gsub("(?<c>[aeiou])"; "<\\(.c)>")', "hid", ["h<i>d"]),
+    ('test("HI"; "i")', "hi there", [True]),
+    ('test("nope")', "hi there", [False]),
+    ('[splits("[, ]+")]', "a, b,c", [["a", "b", "c"]]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", REGEX_CASES,
+                         ids=[c[0] for c in REGEX_CASES])
+def test_jq_regex_family(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+DATE_CASES = [
+    # 1660000000 == 2022-08-08T23:06:40Z (a Monday; yday 0-based)
+    ('gmtime', 1660000000, [[2022, 7, 8, 23, 6, 40, 1, 219]]),
+    ('gmtime | mktime', 1660000000, [1660000000]),
+    ('todate', 1660000000, ["2022-08-08T23:06:40Z"]),
+    ('fromdate', "2022-08-08T23:06:40Z", [1660000000]),
+    ('strftime("%Y/%m/%d")', 1660000000, ["2022/08/08"]),
+    ('strptime("%Y-%m-%d") | mktime', "2022-08-08", [1659916800]),
+    ('fromdate | todate', "2000-01-01T00:00:00Z",
+     ["2000-01-01T00:00:00Z"]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", DATE_CASES,
+                         ids=[c[0] for c in DATE_CASES])
+def test_jq_date_family(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_now_is_wall_clock():
+    import time
+    (t,) = jq_eval("now", None)
+    assert abs(t - time.time()) < 5
+
+
+def test_jq_assignment_error_forms():
+    with pytest.raises(JqError, match="path"):
+        jq_eval("(1 + 2) = 3", {})            # not a path expression
+    with pytest.raises(JqError):
+        jq_eval('.a = .b = 1', {})            # nonassoc, like jq
+    with pytest.raises(JqError, match="regex"):
+        jq_eval('test("a"; "q")', "x")        # unknown flag
+    with pytest.raises(JqError):
+        jq_eval('gsub("(?<c>a)"; 42)', "a")   # non-string replacement
+
+
+def test_jq_date_errors_are_catchable():
+    """Platform time_t overflows must surface as JqError (catchable by
+    jq-level try/catch), not raw OverflowError (review finding)."""
+    assert jq_eval('try todate catch "bad"', 1e30) == ["bad"]
+    assert jq_eval('try gmtime catch "bad"', 1e30) == ["bad"]
+    assert jq_eval('try mktime catch "bad"',
+                   [10**15, 0, 1, 0, 0, 0]) == ["bad"]
+
+
+def test_jq_first_as_path_is_dot_zero():
+    """jq defines first as .[0]: as a path it must index position 0
+    (arrays/null), not 'first object key' (review finding)."""
+    assert jq_eval('(.a | first) = 5', {"a": []}) == [{"a": [5]}]
+    assert jq_eval('path(first)', [7, 8]) == [[0]]
+    with pytest.raises(JqError):
+        jq_eval('path(first)', {"b": 1})      # like jq: number index

@@ -265,6 +265,12 @@ def test_mqtt_session_over_quic_listener(tmp_path):
                                                     b"cross-transport")
             await asyncio.to_thread(recv_cross)
             await mq.disconnect()
+            # the listener row surfaces recovery/path state (RFC 9002
+            # fast retransmits, DPLPMTUD) for operators
+            row = node.quic_listener_info()[0]
+            assert row["mtu_probes_sent"] >= 1
+            assert row["mtu_validated_max"] > 1252   # loopback probes
+            assert row["fast_retransmits"] >= 0
             q.close()
         finally:
             await node.stop()
@@ -654,7 +660,7 @@ def test_pmtud_raises_datagram_budget_on_clean_path():
     pump(client, box, limit=30)
     assert client.established
     assert client.mtu_probes_sent >= 1
-    assert client._mtu_validated == 63000       # ladder exhausted
+    assert client.mtu_validated == 63000       # ladder exhausted
     assert client._mtu_chunk == 63000 - 70
     assert not client._mtu_ladder
     # a 100 KB write now rides in 2 datagrams, not ~90
@@ -701,7 +707,7 @@ def test_pmtud_probe_loss_freezes_ladder_without_congestion_signal():
             break
     assert client.established
     assert not client._mtu_ladder                # gave up
-    assert client._mtu_validated == 1252         # floor kept
+    assert client.mtu_validated == 1252         # floor kept
     assert client._mtu_chunk == 1130
     assert client.mtu_probes_sent >= 2           # one retry happened
     assert client.fast_retransmits == 0          # loss != congestion
@@ -751,7 +757,7 @@ def test_pmtud_black_hole_falls_back_to_base_mtu():
     client = QuicClient()
     box = [None]
     pump(client, box, limit=30)
-    assert client._mtu_validated == 63000        # clean path validated
+    assert client.mtu_validated == 63000        # clean path validated
     payload = bytes(range(256)) * 2000           # 512 KB
     client.send_stream(payload, fin=True)
     # the path now drops anything over 1252 bytes
@@ -767,7 +773,7 @@ def test_pmtud_black_hole_falls_back_to_base_mtu():
     assert client.on_timer(t + 10)               # first PTO
     shuttle()
     assert client.on_timer(t + 100)              # second: fallback
-    assert client._mtu_validated == 1252
+    assert client.mtu_validated == 1252
     assert client._mtu_chunk == 1130
     assert not client._mtu_ladder                # ladder stays retired
     # drain to completion at the base MTU
