@@ -8,18 +8,19 @@ Posture mirrors the snappy/lz4 modules:
   repeat offsets, checksums) in ``zstd.cpp`` — the Kafka FETCH side,
   where the broker must accept whatever a Java producer emitted;
 * **encode** produces real compressed blocks from pure Python: greedy
-  LZ77 + sequences coded with the spec's PREDEFINED FSE
-  distributions, and literals coded with the smallest of raw / RLE /
-  **Huffman** (package-merge length-limited canonical code, direct
-  4-bit weight description, 1- or 4-stream), raw-block fallback when
-  compression doesn't pay.  Measured ratios: ~1000x on repetitive
-  text/JSON, ~1.4x on low-entropy bytes, 1.0 floor on incompressible
-  data; Huffman literals add wins on literal-heavy payloads that LZ77
-  can't match.  The subset is chosen so EVERY zstd implementation
-  decodes it — proven against libzstd.  (Still not emitted:
-  FSE-compressed weight descriptions — literals whose max byte
-  exceeds 128 fall back to raw/RLE — and described/RLE sequence
-  tables.)
+  LZ77 with sequences coded per-table as the cheapest of the spec's
+  PREDEFINED FSE distributions, a 1-byte RLE table, or an
+  **FSE-described table** fitted to the block's code statistics
+  (RFC 8878 §4.1.1 serialization); literals coded as the smallest of
+  raw / RLE / **Huffman** (package-merge length-limited canonical
+  code; tree shipped as the direct 4-bit weight description or the
+  **FSE-compressed weight description** — which lifts the direct
+  form's 128-symbol cap, so high-byte binary payloads compress too;
+  1- or 4-stream), raw-block fallback when compression doesn't pay.
+  Measured ratios: ~1000x on repetitive text/JSON, ~2-2.6x on
+  skewed binary/small-alphabet data, 1.0 floor on incompressible
+  data.  Every mode is proven against libzstd.  (Still not emitted:
+  repeat-offset codes and Repeat_Mode table reuse across blocks.)
 
 Interop against system libzstd (both directions, levels 1-22) is
 proven in ``tests/test_zstd.py``.  Without a toolchain,
@@ -73,12 +74,13 @@ def decompress_frame(data: bytes) -> bytes:
     """Decode a (possibly multi-)frame zstd stream.  Full decode needs
     the native decoder; without a toolchain, a pure-Python fallback
     still decodes raw/RLE blocks AND the compressed subset
-    ``compress_frame`` emits (predefined-FSE sequences +
-    raw/RLE/Huffman-direct literals), so a bridge's own production
-    always round-trips.  Raises RuntimeError for constructs outside
-    that subset (FSE-described tables, repeat offsets, treeless or
-    FSE-weight Huffman) when no native decoder exists — the caller
-    skips the batch — and ValueError on corrupt/unsupported input."""
+    ``compress_frame`` emits (predefined/RLE/described-FSE sequence
+    tables + raw/RLE/Huffman literals with direct or FSE-compressed
+    weights), so a bridge's own production always round-trips.
+    Raises RuntimeError for the remaining foreign constructs (repeat
+    offsets, Repeat_Mode tables, treeless literals) when no native
+    decoder exists — the caller skips the batch — and ValueError on
+    corrupt/unsupported input."""
     lib = _load()
     if lib is None:
         return _py_store_decompress(data)
@@ -222,12 +224,13 @@ _ML_BITS = (0,) * 32 + (1, 1, 1, 1, 2, 2, 3, 3, 4, 4, 5, 7, 8, 9,
 _FSE_CACHE: dict = {}
 
 
-def _fse_decode_table(norm, log):
+def _fse_decode_table(norm, log, cache: bool = True):
     """Python twin of zstd.cpp's fse_build -> (symbol, nbBits,
     newState, by_symbol); the encoder walks it backwards, the
-    fallback decoder forwards.  Cached: the three predefined tables
-    are static."""
-    if norm in _FSE_CACHE:
+    fallback decoder forwards.  Cached only for the static predefined
+    tables — per-block described tables would grow the cache
+    unboundedly."""
+    if cache and norm in _FSE_CACHE:
         return _FSE_CACHE[norm]
     size = 1 << log
     symbol = [0] * size
@@ -261,17 +264,20 @@ def _fse_decode_table(norm, log):
     by_sym = {}
     for t in range(size):
         by_sym.setdefault(symbol[t], []).append(t)
-    _FSE_CACHE[norm] = (symbol, nb, new, by_sym)
-    return _FSE_CACHE[norm]
+    entry = (symbol, nb, new, by_sym)
+    if cache:
+        _FSE_CACHE[norm] = entry
+    return entry
 
 
 class _FseEnc:
     """One interleaved FSE stream's state, walked in reverse symbol
     order.  push(code, next bits...) returns the transition bits."""
 
-    def __init__(self, norm, log):
+    def __init__(self, norm, log, cache: bool = True):
         self.log = log
-        _, self.nb, self.new, self.by_sym = _fse_decode_table(norm, log)
+        _, self.nb, self.new, self.by_sym = _fse_decode_table(
+            norm, log, cache)
         self.state = None
 
     def start(self, code):              # last symbol: any matching entry
@@ -335,6 +341,177 @@ def _ml_code(v):
     return i
 
 
+# ---- FSE table descriptions (RFC 8878 §4.1.1) -----------------------------
+#
+# Described tables replace the predefined distributions with ones
+# fitted to the block's actual code statistics; the description is a
+# FORWARD bitstream (4-bit accuracy log, then variable-width
+# normalized counts with 2-bit zero-run repeats), mirrored off
+# zstd.cpp's fse_parse.
+
+
+class _FwdBitWriter(_BitWriter):
+    """Forward LSB-first writer (table descriptions are read forward,
+    unlike the backward sequence bitstreams): same accumulator as
+    _BitWriter, but finish() pads plainly — no sentinel bit."""
+
+    def finish(self) -> bytes:
+        if self.n:
+            self.out.append(self.acc & 0xFF)
+            self.acc = 0
+            self.n = 0
+        return bytes(self.out)
+
+
+def _fse_normalize(freqs: dict, log: int, nsyms: int):
+    """Normalize symbol counts to sum exactly 2**log, every present
+    symbol >= 1 — a valid (if not always optimal) zstd table."""
+    size = 1 << log
+    total = sum(freqs.values())
+    norm = [0] * nsyms
+    scaled = {}
+    for s, c in freqs.items():
+        scaled[s] = max(1, c * size // total)
+    excess = sum(scaled.values()) - size
+    if excess > 0:
+        # trim from the largest counts (keeps every present >= 1)
+        for s in sorted(scaled, key=lambda s: -scaled[s]):
+            if excess <= 0:
+                break
+            cut = min(excess, scaled[s] - 1)
+            scaled[s] -= cut
+            excess -= cut
+        if excess > 0:
+            return None                 # log too small for this set
+    elif excess < 0:
+        # give the deficit to the most frequent symbol
+        top = max(scaled, key=lambda s: (freqs[s], -s))
+        scaled[top] -= excess
+    for s, c in scaled.items():
+        norm[s] = c
+    return norm
+
+
+def _fse_write_desc(norm, log: int) -> bytes:
+    """Serialize a normalized table: the exact inverse of zstd.cpp's
+    fse_parse (libzstd FSE_writeNCount layout)."""
+    size = 1 << log
+    w = _FwdBitWriter()
+    w.push(log - 5, 4)
+    remaining = size + 1
+    threshold = size
+    nbits = log + 1
+    sym = 0
+    last = max(s for s, c in enumerate(norm) if c) \
+        if any(norm) else 0
+    while remaining > 1 and sym <= last:
+        count = norm[sym]
+        sym += 1
+        mx = (2 * threshold - 1) - remaining
+        remaining -= -count if count < 0 else count
+        value = count + 1               # -1 encodes "less than 1"
+        if value >= threshold:
+            value += mx
+        w.push(value, nbits - 1 if value < mx else nbits)
+        if count == 0:
+            # the decoder always reads one 2-bit zero-run field after
+            # a zero count (rep==3 chains further fields)
+            run = 0
+            while sym <= last and norm[sym] == 0:
+                run += 1
+                sym += 1
+            r = run
+            while True:
+                w.push(min(r, 3), 2)
+                if r < 3:
+                    break
+                r -= 3
+        while remaining < threshold:
+            nbits -= 1
+            threshold >>= 1
+    if remaining != 1:
+        return b""                      # invalid normalization
+    return w.finish()
+
+
+class _FwdBitReader:
+    """Forward LSB-first reader for table descriptions."""
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self.pos = 0
+        self.limit = len(data) * 8
+
+    def read(self, width: int) -> int:
+        if self.pos + width > self.limit:
+            raise ValueError("zstd: table description over-read")
+        lo = self.pos
+        self.pos += width
+        byte0 = lo >> 3
+        span = (width + (lo & 7) + 7) >> 3
+        acc = int.from_bytes(self.data[byte0:byte0 + span], "little")
+        return (acc >> (lo & 7)) & ((1 << width) - 1)
+
+    def peek(self, width: int) -> int:
+        save = self.pos
+        try:
+            v = self.read(width)
+        finally:
+            self.pos = save
+        return v
+
+    def bytes_used(self) -> int:
+        return (self.pos + 7) >> 3
+
+
+def _fse_parse_py(data: bytes, maxlog: int, maxsym: int):
+    """Python twin of zstd.cpp fse_parse: FSE table description ->
+    ((symbol, nb, new, by_sym), log, bytes consumed)."""
+    bits = _FwdBitReader(data)
+    log = bits.read(4) + 5
+    if log > maxlog:
+        raise ValueError("zstd: accuracy log too large")
+    size = 1 << log
+    remaining = size + 1
+    threshold = size
+    nbits = log + 1
+    norm = [0] * (maxsym + 1)
+    sym = 0
+    prev_zero = False
+    while remaining > 1 and sym <= maxsym:
+        if prev_zero:
+            while True:
+                rep = bits.read(2)
+                sym += rep
+                if sym > maxsym + 1:
+                    raise ValueError("zstd: zero-run past symbol cap")
+                if rep != 3:
+                    break
+            prev_zero = False
+            continue
+        mx = (2 * threshold - 1) - remaining
+        if bits.peek(nbits - 1) < mx:
+            count = bits.read(nbits - 1)
+        else:
+            count = bits.read(nbits)
+            if count >= threshold:
+                count -= mx
+        count -= 1
+        remaining -= -count if count < 0 else count
+        if remaining < 1 or sym > maxsym:
+            raise ValueError("zstd: bad table description")
+        norm[sym] = count
+        sym += 1
+        prev_zero = count == 0
+        while remaining < threshold:
+            nbits -= 1
+            threshold >>= 1
+    if remaining != 1:
+        raise ValueError("zstd: bad table description")
+    table = _fse_decode_table(tuple(norm[:sym]), log, cache=False)
+    return table, log, bits.bytes_used()
+
+
 # ---- Huffman literal encoding ---------------------------------------------
 #
 # Canonical code per the decoder's table construction (zstd.cpp
@@ -371,22 +548,105 @@ def _package_merge(freqs: dict, limit: int) -> dict:
     return lengths
 
 
+def _huf_fse_weights(weights: List[int]):
+    """FSE-compressed Huffman weight description (RFC 8878
+    §4.2.1.2): header byte (total compressed size < 128) + FSE table
+    description + backward two-state interleaved bitstream.  This is
+    what lifts the direct description's 128-symbol cap, so literals
+    with high bytes (binary payloads) still get Huffman.  Returns
+    None when it doesn't apply or doesn't beat alternatives; the
+    result is verified by decode simulation (stream termination by
+    over-read has edge cases when transition widths hit zero)."""
+    n = len(weights)
+    if n < 2:
+        return None
+    hist: dict = {}
+    for wt in weights:
+        hist[wt] = hist.get(wt, 0) + 1
+    log = max(5, min(6, (n - 1).bit_length() - 2 if n > 4 else 5))
+    if (1 << log) < len(hist):
+        log = 6
+    norm = _fse_normalize(hist, log, max(hist) + 1)
+    if norm is None:
+        return None
+    desc = _fse_write_desc(norm, log)
+    if not desc:
+        return None
+    enc1 = _FseEnc(tuple(norm), log, cache=False)
+    enc2 = _FseEnc(tuple(norm), log, cache=False)
+    c1 = weights[0::2]                  # stream 1: even positions
+    c2 = weights[1::2]                  # stream 2: odd positions
+    if not c2:
+        return None
+    enc1.start(c1[-1])
+    enc2.start(c2[-1])
+    bits1 = [enc1.prev(c) for c in reversed(c1[:-1])]
+    bits2 = [enc2.prev(c) for c in reversed(c2[:-1])]
+    w = _BitWriter()
+    i1 = i2 = 0
+    for j in range(n - 3, -1, -1):      # transitions, last written first
+        if j % 2 == 0:
+            w.push(*bits1[i1])
+            i1 += 1
+        else:
+            w.push(*bits2[i2])
+            i2 += 1
+    w.push(enc2.state, log)             # decoder reads s1 then s2
+    w.push(enc1.state, log)
+    stream = w.finish()
+    total = len(desc) + len(stream)
+    if total >= 128:
+        return None
+    blob = bytes([total]) + desc + stream
+    return blob if _huf_fse_weights_decode(blob) == weights else None
+
+
+def _huf_fse_weights_decode(blob: bytes):
+    """Decode-sim twin of zstd.cpp's FSE-weights branch of huf_parse;
+    also the fallback decoder's parse path.  Returns the weight list
+    or None on malformed input."""
+    try:
+        hbyte = blob[0]
+        area = blob[1:1 + hbyte]
+        if len(area) != hbyte:
+            return None
+        (sym, nb, new, _), log, used = _fse_parse_py(area, 6, 255)
+        bits = _BitReader(area[used:])
+        s1 = bits.read(log)
+        s2 = bits.read(log)
+    except (ValueError, IndexError):
+        return None
+    out: List[int] = []
+    cur, oth = s1, s2
+    while True:
+        if len(out) >= 255:
+            return None
+        out.append(sym[cur])
+        try:
+            ns = new[cur] + bits.read(nb[cur])
+        except ValueError:              # over-read ends the stream:
+            out.append(sym[oth])        # flush the OTHER state
+            return out
+        cur, oth = oth, ns              # update, then swap streams
+
+
 def _huf_plan(literals: bytes):
     """Code plan for Huffman-coding `literals`: (lengths, exact
     stream bits, tree-description bytes), or None when Huffman can't
     apply.  Cheap relative to encoding — Counter counts in C and
-    package-merge works on <=129 symbols — so it doubles as the
-    size ESTIMATE that gates whether a full encode is worth doing."""
+    package-merge works on <=256 symbols — so it doubles as the
+    size ESTIMATE that gates whether a full encode is worth doing.
+    The tree-size term uses the direct form; the FSE weight form
+    (chosen at encode time when smaller) only shrinks it."""
     n = len(literals)
     if n < 32:
         return None                     # header+tree overhead dominates
     freqs = dict(_Counter(literals))
     if len(freqs) < 2:
         return None                     # caller's RLE path
-    max_sym = max(freqs)
-    if max_sym > 128:
-        return None                     # direct weights cap (see above)
-    lengths = _package_merge(freqs, _HUF_MAX_BITS)
+    max_sym = max(freqs)                # tree-size estimate (direct
+    lengths = _package_merge(freqs, _HUF_MAX_BITS)  # form; FSE often
+                                                    # beats it)
     bits = sum(freqs[s] * lengths[s] for s in freqs)
     return lengths, bits, 1 + (max_sym + 1) // 2
 
@@ -428,11 +688,18 @@ def _huf_literals_section(literals: bytes, plan=None):
     nw = max_sym                        # weights 0..max_sym-1; last inferred
     weights = [maxbits + 1 - lengths[s] if s in lengths else 0
                for s in range(nw)]
-    packed = bytearray([127 + nw])
-    for i in range(0, nw, 2):
-        packed.append((weights[i] << 4)
-                      | (weights[i + 1] if i + 1 < nw else 0))
-    tree = bytes(packed)
+    tree = None
+    if nw <= 128:                       # direct 4-bit description
+        packed = bytearray([127 + nw])
+        for i in range(0, nw, 2):
+            packed.append((weights[i] << 4)
+                          | (weights[i + 1] if i + 1 < nw else 0))
+        tree = bytes(packed)
+    fse_tree = _huf_fse_weights(weights)
+    if fse_tree is not None and (tree is None or len(fse_tree) < len(tree)):
+        tree = fse_tree
+    if tree is None:
+        return None
 
     def enc_stream(chunk):
         w = _BitWriter()
@@ -490,6 +757,39 @@ def _lit_section(literals: bytes, plan=None) -> bytes:
     return huf if huf is not None and len(huf) < len(raw) else raw
 
 
+def _seq_table_choice(hist: dict, predef_norm, predef_log: int,
+                      maxlog: int, nsyms: int):
+    """Pick the cheapest coding for one sequence-code stream:
+    (mode, norm, log, desc) with mode 0 predefined / 1 RLE /
+    2 FSE-described.  Estimates bits as log - floor(log2(count))."""
+    if len(hist) == 1:
+        sym = next(iter(hist))
+        rle = [0] * (sym + 1)
+        rle[sym] = 1                    # log-0 single-entry table
+        return 1, tuple(rle), 0, bytes([sym])
+    bits_p = 0
+    for s, c in hist.items():
+        np_ = predef_norm[s] if s < len(predef_norm) else 0
+        np_ = 1 if np_ == -1 else np_
+        bits_p += c * (predef_log - (np_.bit_length() - 1))
+    total = sum(hist.values())
+    log = max((total - 1).bit_length() - 2,
+              (len(hist) - 1).bit_length())
+    log = max(5, min(maxlog, log))
+    while (1 << log) < len(hist) and log < maxlog:
+        log += 1
+    norm = _fse_normalize(hist, log, nsyms)
+    desc = _fse_write_desc(norm, log) if norm is not None else b""
+    if not desc:
+        return 0, predef_norm, predef_log, b""
+    bits_d = len(desc) * 8
+    for s, c in hist.items():
+        bits_d += c * (log - (norm[s].bit_length() - 1))
+    if bits_d < bits_p:
+        return 2, tuple(norm), log, desc
+    return 0, predef_norm, predef_log, b""
+
+
 def _find_sequences(block: bytes):
     """Greedy LZ77 over one block: 4-byte hash chains, matches stay
     inside the block.  Returns ([(lit_len, match_len, offset)],
@@ -535,15 +835,30 @@ def _compress_block(block: bytes):
         shead = bytes([nseq])
     else:
         shead = bytes([128 + (nseq >> 8), nseq & 0xFF])
-    shead += b"\x00"                    # modes: all predefined
-    ll = _FseEnc(_LL_NORM, 6)
-    of = _FseEnc(_OF_NORM, 5)
-    ml = _FseEnc(_ML_NORM, 6)
     codes = []
     for ll_len, m_len, offset in seqs:
         ofv = offset + 3                # never a repeat-offset code
         codes.append((_ll_code(ll_len), ofv.bit_length() - 1,
                       _ml_code(m_len)))
+    # per-table coding choice fitted to this block's statistics:
+    # predefined distributions, RLE (one distinct code), or a
+    # described table (RFC 8878 §4.1.1) when the fitted table +
+    # description beat the predefined bit cost
+    hists: List[dict] = [{}, {}, {}]
+    for triple in codes:
+        for t, c in enumerate(triple):
+            hists[t][c] = hists[t].get(c, 0) + 1
+    ll_m, ll_norm, ll_log, ll_desc = _seq_table_choice(
+        hists[0], _LL_NORM, 6, 9, 36)
+    of_m, of_norm, of_log, of_desc = _seq_table_choice(
+        hists[1], _OF_NORM, 5, 8, 32)
+    ml_m, ml_norm, ml_log, ml_desc = _seq_table_choice(
+        hists[2], _ML_NORM, 6, 9, 53)
+    shead += bytes([(ll_m << 6) | (of_m << 4) | (ml_m << 2)])
+    shead += ll_desc + of_desc + ml_desc        # LL, OF, ML order
+    ll = _FseEnc(ll_norm, ll_log, cache=ll_m == 0)
+    of = _FseEnc(of_norm, of_log, cache=of_m == 0)
+    ml = _FseEnc(ml_norm, ml_log, cache=ml_m == 0)
     w = _BitWriter()
     for i in range(nseq - 1, -1, -1):
         lc, oc, mc = codes[i]
@@ -563,9 +878,10 @@ def _compress_block(block: bytes):
         w.push(m_len - _ML_BASE[mc], _ML_BITS[mc])
         w.push((offset + 3) - (1 << oc), oc)
     # decoder reads init states LL,OF,ML; reversed: ML, OF, LL
-    w.push(ml.state, 6)
-    w.push(of.state, 5)
-    w.push(ll.state, 6)
+    # (an RLE table has log 0: its state reads zero bits)
+    w.push(ml.state, ml_log)
+    w.push(of.state, of_log)
+    w.push(ll.state, ll_log)
     body = lhead + shead + w.finish()
     # on short-match-dense data (small alphabets) a greedy LZ77
     # sequence costs more bits than Huffman-coding its bytes, so a
@@ -629,24 +945,29 @@ class _BitReader:
 
 
 def _huf_parse_py(body: bytes):
-    """Direct-weights Huffman tree description -> (symbol, nbBits,
-    log, header bytes consumed); mirrors zstd.cpp huf_parse/huf_build
-    for the subset our encoder emits.  FSE-compressed weights ->
-    RuntimeError (native decoder territory)."""
+    """Huffman tree description -> (symbol, nbBits, log, header bytes
+    consumed); mirrors zstd.cpp huf_parse/huf_build.  Handles BOTH
+    forms our encoder emits: direct 4-bit weights (hbyte >= 128) and
+    FSE-compressed weights (hbyte < 128)."""
     if not body:
         raise ValueError("zstd: empty tree description")
     hbyte = body[0]
-    if hbyte < 128:
-        raise RuntimeError("zstd: FSE-compressed Huffman weights need "
-                           "the native decoder")
-    nw = hbyte - 127
-    used = 1 + (nw + 1) // 2
-    if used > len(body):
-        raise ValueError("zstd: truncated tree description")
-    weights = []
-    for i in range(nw):
-        b = body[1 + (i >> 1)]
-        weights.append(b & 0x0F if i & 1 else b >> 4)
+    if hbyte < 128:                     # FSE-compressed weights
+        if hbyte == 0 or 1 + hbyte > len(body):
+            raise ValueError("zstd: truncated tree description")
+        weights = _huf_fse_weights_decode(body[:1 + hbyte])
+        if weights is None:
+            raise ValueError("zstd: bad FSE weight stream")
+        used = 1 + hbyte
+    else:                               # direct 4-bit weights
+        nw = hbyte - 127
+        used = 1 + (nw + 1) // 2
+        if used > len(body):
+            raise ValueError("zstd: truncated tree description")
+        weights = []
+        for i in range(nw):
+            b = body[1 + (i >> 1)]
+            weights.append(b & 0x0F if i & 1 else b >> 4)
     total = sum(1 << (w - 1) for w in weights if w)
     if total == 0:
         raise ValueError("zstd: empty Huffman weights")
@@ -688,9 +1009,11 @@ def _huf_stream_py(sym, nb, log, data: bytes, count: int) -> bytes:
 
 def _py_block_decode(body: bytes) -> bytes:
     """Toolchain-less decode of the SUBSET ``_compress_block`` emits
-    (raw/RLE/Huffman-direct literals + all-predefined sequence
-    tables, no repeat offsets).  Anything richer -> RuntimeError,
-    which the Kafka fetch path maps to skip-with-offset-advance."""
+    (raw/RLE/Huffman literals with direct or FSE-compressed weights;
+    predefined, RLE, or FSE-described sequence tables; no repeat
+    offsets).  Anything richer (Repeat_Mode tables, treeless
+    literals, repeat offsets) -> RuntimeError, which the Kafka fetch
+    path maps to skip-with-offset-advance."""
     if not body:
         raise ValueError("zstd: empty block")
     ltype = body[0] & 3
@@ -777,17 +1100,38 @@ def _py_block_decode(body: bytes) -> bytes:
     else:
         nseq = (body[off] | (body[off + 1] << 8)) + 0x7F00
         off += 2
-    if body[off] != 0:                  # anything but all-predefined
-        raise RuntimeError("zstd: described/RLE/repeat sequence "
-                           "tables need the native decoder")
+    modes = body[off]
     off += 1
-    ll_sym, ll_nb, ll_new, _ = _fse_decode_table(_LL_NORM, 6)
-    of_sym, of_nb, of_new, _ = _fse_decode_table(_OF_NORM, 5)
-    ml_sym, ml_nb, ml_new, _ = _fse_decode_table(_ML_NORM, 6)
+
+    def seq_table(mode, predef_norm, predef_log, maxlog, maxsym):
+        nonlocal off
+        if mode == 0:
+            return (*_fse_decode_table(predef_norm, predef_log)[:3],
+                    predef_log)
+        if mode == 1:                   # RLE: log-0 single-entry table
+            sym = body[off]
+            off += 1
+            if sym > maxsym:
+                raise ValueError("zstd: RLE symbol out of range")
+            return bytes([sym]), bytes([0]), [0], 0
+        if mode == 2:                   # FSE-described
+            (sym, nb, new, _), log, used = _fse_parse_py(
+                body[off:], maxlog, maxsym)
+            off += used
+            return sym, nb, new, log
+        raise RuntimeError("zstd: repeat sequence tables need the "
+                           "native decoder")
+
+    ll_sym, ll_nb, ll_new, ll_log = seq_table(
+        (modes >> 6) & 3, _LL_NORM, 6, 9, 35)
+    of_sym, of_nb, of_new, of_log = seq_table(
+        (modes >> 4) & 3, _OF_NORM, 5, 8, 31)
+    ml_sym, ml_nb, ml_new, ml_log = seq_table(
+        (modes >> 2) & 3, _ML_NORM, 6, 9, 52)
     bits = _BitReader(body[off:])
-    ll_s = bits.read(6)
-    of_s = bits.read(5)
-    ml_s = bits.read(6)
+    ll_s = bits.read(ll_log)
+    of_s = bits.read(of_log)
+    ml_s = bits.read(ml_log)
     out = bytearray()
     lit_pos = 0
     for i in range(nseq):
