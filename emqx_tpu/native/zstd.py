@@ -563,9 +563,9 @@ def _huf_fse_weights(weights: List[int]):
     hist: dict = {}
     for wt in weights:
         hist[wt] = hist.get(wt, 0) + 1
+    # weights are 0..12, so 13 distinct values at most — any log >= 5
+    # fits every present symbol
     log = max(5, min(6, (n - 1).bit_length() - 2 if n > 4 else 5))
-    if (1 << log) < len(hist):
-        log = 6
     norm = _fse_normalize(hist, log, max(hist) + 1)
     if norm is None:
         return None
@@ -695,9 +695,14 @@ def _huf_literals_section(literals: bytes, plan=None):
             packed.append((weights[i] << 4)
                           | (weights[i + 1] if i + 1 < nw else 0))
         tree = bytes(packed)
-    fse_tree = _huf_fse_weights(weights)
-    if fse_tree is not None and (tree is None or len(fse_tree) < len(tree)):
-        tree = fse_tree
+    if tree is None or len(tree) > 5:
+        # an FSE weight blob is never under ~5 bytes (header + table
+        # description + two init states), so tiny direct trees skip
+        # the encode + decode-simulation cost outright
+        fse_tree = _huf_fse_weights(weights)
+        if fse_tree is not None and (tree is None
+                                     or len(fse_tree) < len(tree)):
+            tree = fse_tree
     if tree is None:
         return None
 

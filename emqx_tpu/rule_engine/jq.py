@@ -56,10 +56,16 @@ Supported (the surface rule engines actually use):
   getpath(p), setpath(p;v), paths, leaf_paths, isnan, isinfinite,
   infinite, nan, utf8bytelength.
 
+* ``def`` user functions (``def f(g; $x): body; rest``): filter
+  params bind as closures over the call site, $-value params fan the
+  call out over their output streams, recursion works (depth-capped
+  into JqError), lexical scoping, user defs shadow same-name/arity
+  builtins — all jq semantics.
+
 Out of scope (documented, erroring loudly rather than mis-evaluating):
-``def`` (user functions), ``label``/``break``, destructuring patterns
-in ``as``, slice assignment (``.[:2] = ...``), ``limit``/``..`` as
-path expressions, and ``ltrimstr`` etc. in LHS paths.
+``label``/``break``, destructuring patterns in ``as``, slice
+assignment (``.[:2] = ...``), ``limit``/``..`` as path expressions,
+and ``ltrimstr`` etc. in LHS paths.
 
 jq's comparison/sort total order (null < false < true < numbers <
 strings < arrays < objects) is implemented so ``sort``/``min``/``max``
@@ -221,6 +227,8 @@ class _Parser:
         return text[1:]
 
     def parse_pipe(self):
+        if self.peek() == ("ident", "def"):
+            return self.parse_def()
         left = self.parse_comma()
         if self.peek() == ("ident", "as"):
             # EXPR as $x | BODY — `.` stays the original input in BODY
@@ -229,6 +237,8 @@ class _Parser:
             self.expect("|")
             return ("as", left, name, self.parse_pipe())
         while self.eat("|"):
+            if self.peek() == ("ident", "def"):
+                return ("pipe", left, self.parse_def())
             right = self.parse_comma()
             if self.peek() == ("ident", "as"):
                 self.next()
@@ -238,6 +248,33 @@ class _Parser:
                         ("as", right, name, self.parse_pipe()))
             left = ("pipe", left, right)
         return left
+
+    def parse_def(self):
+        """``def name(p1; $p2): body; rest`` — jq function definitions
+        prefix an expression; params are filter names (closures) or
+        $-value names."""
+        self.expect("def")
+        kind, name = self.next()
+        if kind != "ident" or name in _KEYWORDS:
+            raise JqError(f"jq: bad function name {name!r}")
+        params: List[str] = []
+        if self.eat("("):
+            while True:
+                pk, pt = self.next()
+                if pk == "var":
+                    params.append("$" + pt[1:])
+                elif pk == "ident" and pt not in _KEYWORDS:
+                    params.append(pt)
+                else:
+                    raise JqError(f"jq: bad parameter {pt!r}")
+                if not self.eat(";"):
+                    break
+            self.expect(")")
+        self.expect(":")
+        body = self.parse_pipe()
+        self.expect(";")
+        rest = self.parse_pipe()
+        return ("def", name, params, body, rest)
 
     def parse_comma(self):
         parts = [self.parse_alt()]
@@ -408,7 +445,9 @@ class _Parser:
                 return ("try", body, handler)
             if text in ("as", "catch", "def", "label", "import",
                         "include"):
-                raise JqError(f"jq: {text!r} is not supported here")
+                # "def" is supported at expression starts (parse_pipe/
+                # parse_def); reaching here means a malformed position
+                raise JqError(f"jq: {text!r} is not valid here")
             self.next()
             if self.eat("("):
                 args = [self.parse_pipe()]
@@ -751,7 +790,16 @@ def _eval(node, v: Any, env=None) -> List[Any]:
         for c in _eval(cond, v, env):
             out.extend(_eval(then if _truthy(c) else els, v, env))
         return out
+    if tag == "def":
+        _, name, params, body, rest = node
+        fenv = dict(env) if env else {}
+        # self-referencing entry so the function can recurse
+        fenv[("fn", name, len(params))] = (params, body, fenv)
+        return _eval(rest, v, fenv)
     if tag == "call":
+        fn = env.get(("fn", node[1], len(node[2]))) if env else None
+        if fn is not None:
+            return _call_user(fn, node[2], v, env)
         return _call(node[1], node[2], v, env)
     if tag == "var":
         if env and node[1] in env:
@@ -1012,6 +1060,37 @@ def _getpath_value(v: Any, path: List[Any]) -> Any:
         got = _index(x, p, opt=True)
         x = got[0] if got else None
     return x
+
+
+def _call_user(fn, args: List[Any], v: Any, env) -> List[Any]:
+    """Invoke a def'd function.  Filter params bind as CLOSURES over
+    the caller's environment (invoked as zero-arg calls inside the
+    body, jq-style); $-value params evaluate against the caller's
+    input NOW, fanning the call out over their output streams."""
+    if fn[0] == "closure":              # a filter param being invoked
+        _, ast, cenv = fn
+        return _eval(ast, v, cenv)
+    params, body, fenv = fn
+    envs = [dict(fenv)]
+    for p, ast in zip(params, args):
+        if p.startswith("$"):
+            # jq desugars def f($a): B to def f(a): a as $a | B —
+            # the bare name stays callable as a filter too
+            nxt = []
+            for e in envs:
+                for val in _eval(ast, v, env):
+                    e2 = dict(e)
+                    e2[p[1:]] = val
+                    e2[("fn", p[1:], 0)] = ("closure", ast, env)
+                    nxt.append(e2)
+            envs = nxt
+        else:
+            for e in envs:
+                e[("fn", p, 0)] = ("closure", ast, env)
+    out: List[Any] = []
+    for e in envs:
+        out.extend(_eval(body, v, e))
+    return out
 
 
 def _call(name: str, args: List[Any], v: Any,
@@ -1663,4 +1742,9 @@ def jq_eval(prog: str, value: Any,
         if len(_PARSE_CACHE) >= max_cache:
             _PARSE_CACHE.clear()
         _PARSE_CACHE[prog] = node
-    return _eval(node, value, {})
+    try:
+        return _eval(node, value, {})
+    except RecursionError:
+        # unbounded def-recursion must surface as a jq error (still a
+        # loud failure, but catchable and not a VM-level blowup)
+        raise JqError("jq: recursion depth exceeded")

@@ -156,7 +156,6 @@ def test_jq_errors():
         ("error(\"boom\")", None),
         ("nosuchfn", None),
         ("$undefined", None),               # unbound variable
-        ("def f: 1; f", None),              # unsupported: def
         (". ..", None),
         ("if true then 1", None),           # missing end
         ('{"k" 1}', None),                  # bad object syntax
@@ -471,3 +470,49 @@ def test_jq_first_as_path_is_dot_zero():
     assert jq_eval('path(first)', [7, 8]) == [[0]]
     with pytest.raises(JqError):
         jq_eval('path(first)', {"b": 1})      # like jq: number index
+
+
+DEF_CASES = [
+    ('def f: . + 1; f', 4, [5]),
+    ('def f: . * 2; f | f', 3, [12]),
+    ('def twice(g): g | g; twice(. + 3)', 0, [6]),
+    # $-value params fan the call out over their output stream
+    ('def f($x): $x * 10; f(1, 2)', None, [10, 20]),
+    # recursion
+    ('def fact: if . <= 1 then 1 else . * (. - 1 | fact) end; fact',
+     5, [120]),
+    # filter params are closures over the call site
+    ('def m(g): [.[] | g]; m(. + 1)', [1, 2], [[2, 3]]),
+    ('def f: 1; def g: f + 1; g', None, [2]),
+    # defs are legal mid-pipeline, jq-style
+    ('.a | def f: . + 1; f', {"a": 9}, [10]),
+    # a user def shadows the builtin of the same name/arity
+    ('def first: 99; first', [1, 2], [99]),
+    # lexical scoping: the body sees the def-site environment
+    ('5 as $n | def f: $n; f', None, [5]),
+    ('def f(g): def h: g; h; f(42)', None, [42]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", DEF_CASES,
+                         ids=[c[0] for c in DEF_CASES])
+def test_jq_def_functions(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_def_runaway_recursion_is_jqerror():
+    with pytest.raises(JqError, match="recursion"):
+        jq_eval("def f: f; f", None)
+
+
+def test_jq_def_parse_errors():
+    for prog in ("def : 1; .", "def f: 1", "def f(1): 2; f(3)"):
+        with pytest.raises(JqError):
+            jq_eval(prog, None)
+
+
+def test_jq_value_param_also_binds_filter_name():
+    """jq desugars def f($a): B to def f(a): a as $a | B, so the bare
+    name stays callable (review finding)."""
+    assert jq_eval('def f($x): x; f(7)', None) == [7]
+    assert jq_eval('def f($x): $x + x; f(3)', None) == [6]
