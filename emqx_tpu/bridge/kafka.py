@@ -19,14 +19,15 @@ blocks via the in-repo ``native/snappy.cpp`` codec — the
 snappy-erlang-nif analog, SURVEY §2.4), ``"lz4"`` (in-repo
 ``native/lz4.cpp`` block codec + LZ4 frame format, interop-tested
 against system liblz4), ``"gzip"`` (stdlib zlib) or ``"zstd"``
-(in-repo ``native/zstd.py``: greedy LZ77 + predefined-FSE sequence
-coding — real ratio, decodable by every zstd implementation).
-Fetch decodes all FOUR codecs — zstd through the full RFC 8878
-decoder in ``native/zstd.cpp`` (Huffman literals, FSE sequences,
-repeat offsets, xxh64 checksums), interop-tested against system
-libzstd — so Java-producer batches ingest whole; only when the native
-toolchain is absent do zstd batches fall back to the old
-skip-with-offset-advance.  Partitioning is murmur-free:
+(in-repo ``native/zstd.py``: greedy LZ77, fitted/predefined/RLE FSE
+sequence tables, Huffman literals, repeat offsets — real ratio,
+decodable by every zstd implementation).  Fetch decodes all FOUR
+codecs — zstd through the full RFC 8878 decoder in
+``native/zstd.cpp`` (Huffman literals, FSE sequences, repeat
+offsets, xxh64 checksums), interop-tested against system libzstd —
+so Java-producer batches ingest whole; a toolchain-less host decodes
+the same format through the pure-Python fallback (minus xxh64
+verification).  Partitioning is murmur-free:
 explicit ``partition`` in the rendered item, else key-hash (crc32c of
 the key) mod partitions, else round-robin — deployments needing
 Java-client-compatible murmur2 placement set explicit partitions.
@@ -234,9 +235,9 @@ def _parse_batch_full(data: bytes) -> Tuple[
             elif codec == 3:
                 body = _lz4.decompress_frame(after[off:])
             else:
-                # native decoder, or the subset python fallback; an
-                # entropy-coded frame on a toolchain-less host raises
-                # RuntimeError -> legacy skip-with-offset-advance
+                # native decoder, or the full-format python fallback;
+                # RuntimeError kept as a belt-and-braces skip for any
+                # future unsupported-construct signal
                 try:
                     body = _zs.decompress_frame(after[off:])
                 except RuntimeError:

@@ -6,7 +6,11 @@ Posture mirrors the snappy/lz4 modules:
 
 * **decode** is the full format (Huffman literals, FSE sequences,
   repeat offsets, checksums) in ``zstd.cpp`` — the Kafka FETCH side,
-  where the broker must accept whatever a Java producer emitted;
+  where the broker must accept whatever a Java producer emitted; the
+  pure-Python fallback ALSO covers the full non-dictionary format
+  since round 5 (treeless literals, Repeat_Mode tables, repeat
+  offsets, cross-block window matches — libzstd levels 1-22 proven),
+  so a toolchain-less host only loses xxh64 verification and speed;
 * **encode** produces real compressed blocks from pure Python: greedy
   LZ77 with sequences coded per-table as the cheapest of the spec's
   PREDEFINED FSE distributions, a 1-byte RLE table, or an
@@ -71,16 +75,14 @@ def available() -> bool:
 
 
 def decompress_frame(data: bytes) -> bytes:
-    """Decode a (possibly multi-)frame zstd stream.  Full decode needs
-    the native decoder; without a toolchain, a pure-Python fallback
-    still decodes raw/RLE blocks AND the compressed subset
-    ``compress_frame`` emits (predefined/RLE/described-FSE sequence
-    tables + raw/RLE/Huffman literals with direct or FSE-compressed
-    weights), so a bridge's own production always round-trips.
-    Raises RuntimeError for the remaining foreign constructs (repeat
-    offsets, Repeat_Mode tables, treeless literals) when no native
-    decoder exists — the caller skips the batch — and ValueError on
-    corrupt/unsupported input."""
+    """Decode a (possibly multi-)frame zstd stream.  The native
+    decoder is the fast path; without a toolchain a pure-Python
+    fallback decodes the full non-dictionary format too (Huffman
+    literals incl. treeless reuse, all four sequence-table modes,
+    repeat offsets, cross-block window matches) — foreign libzstd
+    frames at every level round-trip either way; the fallback skips
+    only xxh64 checksum verification.  ValueError on corrupt or
+    dictionary-keyed input."""
     lib = _load()
     if lib is None:
         return _py_store_decompress(data)
@@ -103,12 +105,10 @@ def decompress_frame(data: bytes) -> bytes:
 
 
 def _py_store_decompress(data: bytes) -> bytes:
-    """Toolchain-less fallback: decode raw/RLE blocks plus the
-    compressed subset our own encoder emits (see
-    ``_py_block_decode``).  Richer constructs raise RuntimeError,
-    which the Kafka fetch path maps to skip-with-offset-advance.
-    Content checksums are NOT verified here (no xxh64 without the
-    native module); declared frame sizes still are."""
+    """Toolchain-less fallback: full non-dictionary frame decode in
+    pure Python (see ``_py_block_decode``).  Content checksums are
+    NOT verified here (no xxh64 without the native module); declared
+    frame sizes still are."""
     try:
         return _py_store_walk(data)
     except IndexError:
@@ -155,6 +155,8 @@ def _py_store_walk(data: bytes) -> bytes:
             + (256 if fcs_bytes == 2 else 0) if fcs_bytes else None
         pos += fcs_bytes
         frame_base = len(out)
+        rep = [1, 4, 8]             # per-frame repeat-offset history
+        fstate: dict = {}           # frame-persistent huf/seq tables
         while True:
             if pos + 3 > n:
                 raise ValueError("zstd: truncated block header")
@@ -174,7 +176,9 @@ def _py_store_walk(data: bytes) -> bytes:
             else:                                # compressed block
                 if pos + bsize > n:
                     raise ValueError("zstd: truncated block")
-                out += _py_block_decode(data[pos:pos + bsize])
+                out += _py_block_decode(
+                    data[pos:pos + bsize], rep, fstate,
+                    window=out, wbase=frame_base)
                 pos += bsize
             if len(out) > _MAX_OUTPUT:
                 raise ValueError("zstd: output exceeds cap")
@@ -822,11 +826,16 @@ def _find_sequences(block: bytes):
     return seqs, bytes(lits), block[anchor:]
 
 
-def _compress_block(block: bytes):
+def _compress_block(block: bytes, rep=None):
     """One compressed block body (literals + sequences sections), or
     None when neither sequences nor literal compression pay.  With no
     sequences the block can still compress via its literals section
-    alone (Huffman/RLE + a zero sequence count)."""
+    alone (Huffman/RLE + a zero sequence count).
+
+    ``rep`` is the frame's 3-slot repeat-offset history (RFC 8878
+    §3.1.1.5, persists across the frame's blocks); it is mutated ONLY
+    when the sequence-coded body is actually returned — the
+    literals-only and raw fallbacks execute no sequences."""
     seqs, lits, tail = _find_sequences(block)
     nseq = len(seqs)
     if nseq >= 0x7F00:
@@ -840,9 +849,41 @@ def _compress_block(block: bytes):
         shead = bytes([nseq])
     else:
         shead = bytes([128 + (nseq >> 8), nseq & 0xFF])
+    nrep = list(rep) if rep is not None else [1, 4, 8]
     codes = []
+    ofvs = []
     for ll_len, m_len, offset in seqs:
-        ofv = offset + 3                # never a repeat-offset code
+        # repeat-offset codes: ofv 1-3 reference the history (shifted
+        # when ll == 0, where "same as last" is unreachable by design
+        # — the match would just have been longer)
+        if ll_len != 0:
+            if offset == nrep[0]:
+                ofv = 1
+            elif offset == nrep[1]:
+                ofv = 2
+            elif offset == nrep[2]:
+                ofv = 3
+            else:
+                ofv = offset + 3
+        else:
+            if offset == nrep[1]:
+                ofv = 1
+            elif offset == nrep[2]:
+                ofv = 2
+            elif offset == nrep[0] - 1 and offset >= 1:
+                ofv = 3
+            else:
+                ofv = offset + 3
+        # history update mirrors the decoder exactly
+        if ofv > 3:
+            nrep = [offset, nrep[0], nrep[1]]
+        else:
+            idx = ofv - 1 + (1 if ll_len == 0 else 0)
+            if idx == 1:
+                nrep = [offset, nrep[0], nrep[2]]
+            elif idx >= 2:
+                nrep = [offset, nrep[0], nrep[1]]
+        ofvs.append(ofv)
         codes.append((_ll_code(ll_len), ofv.bit_length() - 1,
                       _ml_code(m_len)))
     # per-table coding choice fitted to this block's statistics:
@@ -881,7 +922,7 @@ def _compress_block(block: bytes):
         # decoder reads extras OF,ML,LL; reversed: LL, ML, OF
         w.push(ll_len - _LL_BASE[lc], _LL_BITS[lc])
         w.push(m_len - _ML_BASE[mc], _ML_BITS[mc])
-        w.push((offset + 3) - (1 << oc), oc)
+        w.push(ofvs[i] - (1 << oc), oc)
     # decoder reads init states LL,OF,ML; reversed: ML, OF, LL
     # (an RLE table has log 0: its state reads zero bits)
     w.push(ml.state, ml_log)
@@ -899,8 +940,14 @@ def _compress_block(block: bytes):
     if est is not None and est + 1 < len(body):
         flat = _lit_section(block, plan=plan) + b"\x00"
         if len(flat) < len(body):
-            body = flat
-    return body if len(body) < len(block) else None
+            # literals-only block: no sequences execute, history
+            # stays untouched
+            return flat if len(flat) < len(block) else None
+    if len(body) < len(block):
+        if rep is not None:
+            rep[:] = nrep               # commit: this body ships
+        return body
+    return None
 
 
 class _BitReader:
@@ -1012,21 +1059,30 @@ def _huf_stream_py(sym, nb, log, data: bytes, count: int) -> bytes:
     return bytes(out)
 
 
-def _py_block_decode(body: bytes) -> bytes:
-    """Toolchain-less decode of the SUBSET ``_compress_block`` emits
-    (raw/RLE/Huffman literals with direct or FSE-compressed weights;
-    predefined, RLE, or FSE-described sequence tables; no repeat
-    offsets).  Anything richer (Repeat_Mode tables, treeless
-    literals, repeat offsets) -> RuntimeError, which the Kafka fetch
-    path maps to skip-with-offset-advance."""
+def _py_block_decode(body: bytes, rep=None, fstate=None,
+                     window=None, wbase: int = 0) -> bytes:
+    """Toolchain-less block decode — by round 5 this covers the FULL
+    non-dictionary format (Huffman literals with direct or FSE
+    weights, treeless reuse, all four sequence-table modes, repeat
+    offsets, cross-block matches), so foreign (libzstd/Java-producer)
+    frames decode without the native module too.  ``fstate`` carries
+    the frame-persistent Huffman table and last-used sequence tables;
+    ``rep`` the repeat-offset history; ``window`` is the CALLER's
+    whole-frame output buffer with the frame starting at ``wbase`` —
+    indexed in place for cross-block matches, never copied (a
+    per-block snapshot would make large-frame decode quadratic)."""
+    if rep is None:
+        rep = [1, 4, 8]                 # standalone-block decode
+    if fstate is None:
+        fstate = {}
+    # prior bytes this frame = window[wbase:len(window)]; len(window)
+    # is the absolute position where this block's output begins
+    prior_len = len(window) - wbase if window is not None else 0
     if not body:
         raise ValueError("zstd: empty block")
     ltype = body[0] & 3
     sf = (body[0] >> 2) & 3
-    if ltype == 3:
-        raise RuntimeError("zstd: treeless literals need the native "
-                           "decoder")
-    if ltype == 2:                      # Huffman-compressed literals
+    if ltype >= 2:                      # Huffman-compressed / treeless
         if sf <= 1:
             if len(body) < 3:
                 raise ValueError("zstd: truncated literals header")
@@ -1049,8 +1105,15 @@ def _py_block_decode(body: bytes) -> bytes:
         if regen > _BLOCK_MAX or off + comp > len(body):
             raise ValueError("zstd: bad literals section")
         area = body[off:off + comp]
-        sym, nb, log, used = _huf_parse_py(area)
-        area = area[used:]
+        if ltype == 2:
+            sym, nb, log, used = _huf_parse_py(area)
+            area = area[used:]
+            fstate["huf"] = (sym, nb, log)
+        else:                           # treeless: reuse the frame's
+            if "huf" not in fstate:     # last Huffman table
+                raise ValueError("zstd: treeless literals before any "
+                                 "tree")
+            sym, nb, log = fstate["huf"]
         if sf == 0:                     # single stream
             lits = _huf_stream_py(sym, nb, log, area, regen)
         else:                           # 4 streams, 6-byte jump table
@@ -1108,31 +1171,35 @@ def _py_block_decode(body: bytes) -> bytes:
     modes = body[off]
     off += 1
 
-    def seq_table(mode, predef_norm, predef_log, maxlog, maxsym):
+    def seq_table(slot, mode, predef_norm, predef_log, maxlog, maxsym):
         nonlocal off
         if mode == 0:
-            return (*_fse_decode_table(predef_norm, predef_log)[:3],
-                    predef_log)
-        if mode == 1:                   # RLE: log-0 single-entry table
+            t = (*_fse_decode_table(predef_norm, predef_log)[:3],
+                 predef_log)
+        elif mode == 1:                 # RLE: log-0 single-entry table
             sym = body[off]
             off += 1
             if sym > maxsym:
                 raise ValueError("zstd: RLE symbol out of range")
-            return bytes([sym]), bytes([0]), [0], 0
-        if mode == 2:                   # FSE-described
+            t = (bytes([sym]), bytes([0]), [0], 0)
+        elif mode == 2:                 # FSE-described
             (sym, nb, new, _), log, used = _fse_parse_py(
                 body[off:], maxlog, maxsym)
             off += used
-            return sym, nb, new, log
-        raise RuntimeError("zstd: repeat sequence tables need the "
-                           "native decoder")
+            t = (sym, nb, new, log)
+        else:                           # repeat: the frame's last-used
+            t = fstate.get(slot)        # table of ANY kind (libzstd)
+            if t is None:
+                raise ValueError("zstd: repeat mode before any table")
+        fstate[slot] = t
+        return t
 
     ll_sym, ll_nb, ll_new, ll_log = seq_table(
-        (modes >> 6) & 3, _LL_NORM, 6, 9, 35)
+        "ll", (modes >> 6) & 3, _LL_NORM, 6, 9, 35)
     of_sym, of_nb, of_new, of_log = seq_table(
-        (modes >> 4) & 3, _OF_NORM, 5, 8, 31)
+        "of", (modes >> 4) & 3, _OF_NORM, 5, 8, 31)
     ml_sym, ml_nb, ml_new, ml_log = seq_table(
-        (modes >> 2) & 3, _ML_NORM, 6, 9, 52)
+        "ml", (modes >> 2) & 3, _ML_NORM, 6, 9, 52)
     bits = _BitReader(body[off:])
     ll_s = bits.read(ll_log)
     of_s = bits.read(of_log)
@@ -1146,10 +1213,26 @@ def _py_block_decode(body: bytes) -> bytes:
         mlen = _ML_BASE[mc] + bits.read(_ML_BITS[mc])
         lc = ll_sym[ll_s]
         llen = _LL_BASE[lc] + bits.read(_LL_BITS[lc])
-        if ofv <= 3:
-            raise RuntimeError("zstd: repeat offsets need the native "
-                               "decoder")
-        offset = ofv - 3
+        if ofv > 3:
+            offset = ofv - 3
+            rep[:] = [offset, rep[0], rep[1]]
+        else:                           # RFC 8878 §3.1.1.5 resolution
+            idx = ofv - 1 + (1 if llen == 0 else 0)
+            if idx == 0:
+                offset = rep[0]
+            elif idx == 1:
+                offset = rep[1]
+                rep[:] = [offset, rep[0], rep[2]]
+            elif idx == 2:
+                offset = rep[2]
+                rep[:] = [offset, rep[0], rep[1]]
+            else:                       # idx 3: rep[0] - 1
+                if rep[0] <= 1:
+                    raise ValueError("zstd: bad repeat offset")
+                offset = rep[0] - 1
+                rep[:] = [offset, rep[0], rep[1]]
+            if offset == 0:
+                raise ValueError("zstd: zero offset")
         if i + 1 < nseq:
             ll_s = ll_new[ll_s] + bits.read(ll_nb[ll_s])
             ml_s = ml_new[ml_s] + bits.read(ml_nb[ml_s])
@@ -1158,22 +1241,31 @@ def _py_block_decode(body: bytes) -> bytes:
             raise ValueError("zstd: literals exhausted")
         out += lits[lit_pos:lit_pos + llen]
         lit_pos += llen
-        if offset > len(out):
-            # legal zstd (matches may cross block boundaries within a
-            # frame) but outside our subset
-            raise RuntimeError("zstd: cross-block matches need the "
-                               "native decoder")
         if len(out) + mlen > _BLOCK_MAX:
             # spec Block_Maximum_Size, enforced INSIDE the loop: a
             # crafted sequence stream regenerates ~128 KB per ~3 input
             # bytes, so a post-hoc cap would still be a memory/CPU bomb
             raise ValueError("zstd: block exceeds maximum size")
-        if offset >= mlen:              # non-overlapping: one slice
-            start = len(out) - offset
-            out += out[start:start + mlen]
-        else:
-            for _ in range(mlen):
-                out.append(out[-offset])
+        src = len(out) - offset
+        if src >= 0:
+            if offset >= mlen:          # non-overlapping: one slice
+                out += out[src:src + mlen]
+            else:
+                for _ in range(mlen):
+                    out.append(out[-offset])
+        else:                           # match reaches into PRIOR
+            if -src > prior_len:        # blocks of this frame
+                raise ValueError("zstd: match offset beyond window")
+            take = min(mlen, -src)      # the prior-resident part:
+            start = len(window) + src   # absolute index in the frame
+            out += window[start:start + take]
+            rest = mlen - take
+            if rest:                    # tail continues at in-block
+                if offset >= rest:      # position 0 (src + take == 0)
+                    out += out[0:rest]
+                else:
+                    for _ in range(rest):
+                        out.append(out[-offset])
     if not bits.done():
         raise ValueError("zstd: sequence bitstream not consumed")
     out += lits[lit_pos:]
@@ -1199,10 +1291,11 @@ def compress_frame(data: bytes) -> bytes:
     if n == 0:
         out.append(b"\x01\x00\x00")              # last empty raw block
         return b"".join(out)
+    rep = [1, 4, 8]                     # frame repeat-offset history
     for i in range(0, n, _BLOCK_MAX):
         blk = data[i:i + _BLOCK_MAX]
         last = 1 if i + _BLOCK_MAX >= n else 0
-        body = _compress_block(blk)
+        body = _compress_block(blk, rep)
         if body is None:
             bh = (len(blk) << 3) | last          # type 0 = raw
             out.append(struct.pack("<I", bh)[:3])
