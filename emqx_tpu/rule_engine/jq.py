@@ -62,10 +62,18 @@ Supported (the surface rule engines actually use):
   into JqError), lexical scoping, user defs shadow same-name/arity
   builtins — all jq semantics.
 
+* destructuring patterns in ``as`` and ``reduce``/``foreach``
+  (``. as [$a, {b: $c}] | ...``), incl. ``{$x}`` shorthand, string
+  and computed ``(expr):`` keys (generator fan-out), null-tolerant
+  bindings, mismatch errors.
+
 Out of scope (documented, erroring loudly rather than mis-evaluating):
-``label``/``break``, destructuring patterns in ``as``, slice
-assignment (``.[:2] = ...``), ``limit``/``..`` as path expressions,
-and ``ltrimstr`` etc. in LHS paths.
+``label``/``break`` (the eager list-based evaluator cannot preserve
+already-yielded outputs across an unwind; its main idiom is covered
+by the ``first(f)``/``limit(n;f)``/``until`` builtins), the ``?//``
+alternative-pattern operator, slice assignment (``.[:2] = ...``),
+``limit``/``..`` as path expressions, and ``ltrimstr`` etc. in LHS
+paths.
 
 jq's comparison/sort total order (null < false < true < numbers <
 strings < arrays < objects) is implemented so ``sort``/``min``/``max``
@@ -220,32 +228,71 @@ class _Parser:
 
     # precedence ladder ----------------------------------------------------
 
-    def _expect_var(self) -> str:
-        kind, text = self.next()
-        if kind != "var":
-            raise JqError(f"jq: expected $variable, got {text!r}")
-        return text[1:]
+    def parse_pattern(self):
+        """Destructuring pattern for ``as``: $var, [patterns...], or
+        {key: pattern, $shorthand, "str": pattern, (expr): pattern}."""
+        kind, text = self.peek()
+        if kind == "var":
+            self.next()
+            return ("pvar", text[1:])
+        if text == "[" and kind == "punct":
+            self.next()
+            pats = [self.parse_pattern()]
+            while self.eat(","):
+                pats.append(self.parse_pattern())
+            self.expect("]")
+            return ("parray", pats)
+        if text == "{" and kind == "punct":
+            self.next()
+            entries = []
+            while True:
+                ek, et = self.peek()
+                if ek == "var":                 # {$x} == {x: $x}
+                    self.next()
+                    entries.append((("lit", et[1:]), ("pvar", et[1:])))
+                elif ek == "ident" and et not in _KEYWORDS:
+                    self.next()
+                    self.expect(":")
+                    entries.append((("lit", et), self.parse_pattern()))
+                elif ek == "str":
+                    self.next()
+                    self.expect(":")
+                    entries.append((("lit", _unquote(et)),
+                                    self.parse_pattern()))
+                elif et == "(":
+                    self.next()
+                    keyexpr = self.parse_pipe()
+                    self.expect(")")
+                    self.expect(":")
+                    entries.append((keyexpr, self.parse_pattern()))
+                else:
+                    raise JqError(f"jq: bad pattern key {et!r}")
+                if not self.eat(","):
+                    break
+            self.expect("}")
+            return ("pobject", entries)
+        raise JqError(f"jq: bad destructuring pattern {text!r}")
 
     def parse_pipe(self):
         if self.peek() == ("ident", "def"):
             return self.parse_def()
         left = self.parse_comma()
         if self.peek() == ("ident", "as"):
-            # EXPR as $x | BODY — `.` stays the original input in BODY
+            # EXPR as PATTERN | BODY — `.` stays the original input
             self.next()
-            name = self._expect_var()
+            pat = self.parse_pattern()
             self.expect("|")
-            return ("as", left, name, self.parse_pipe())
+            return ("as", left, pat, self.parse_pipe())
         while self.eat("|"):
             if self.peek() == ("ident", "def"):
                 return ("pipe", left, self.parse_def())
             right = self.parse_comma()
             if self.peek() == ("ident", "as"):
                 self.next()
-                name = self._expect_var()
+                pat = self.parse_pattern()
                 self.expect("|")
                 return ("pipe", left,
-                        ("as", right, name, self.parse_pipe()))
+                        ("as", right, pat, self.parse_pipe()))
             left = ("pipe", left, right)
         return left
 
@@ -425,7 +472,7 @@ class _Parser:
                 self.next()
                 src = self.parse_postfix()
                 self.expect("as")
-                name = self._expect_var()
+                name = self.parse_pattern()
                 self.expect("(")
                 init = self.parse_pipe()
                 self.expect(";")
@@ -808,41 +855,44 @@ def _eval(node, v: Any, env=None) -> List[Any]:
     if tag == "as":
         out = []
         for x in _eval(node[1], v, env):
-            e2 = dict(env) if env else {}
-            e2[node[2]] = x
-            out.extend(_eval(node[3], v, e2))
+            for e2 in _destructure(node[2], x, env):
+                out.extend(_eval(node[3], v, e2))
         return out
     if tag == "reduce":
-        _, srcn, name, initn, updn = node
+        _, srcn, pat, initn, updn = node
         xs = _eval(srcn, v, env)
         out = []
         for acc in _eval(initn, v, env):
             alive = True
             for x in xs:
-                e2 = dict(env) if env else {}
-                e2[name] = x
-                outs = _eval(updn, acc, e2)
-                if not outs:            # empty update kills this fold
-                    alive = False
+                for e2 in _destructure(pat, x, env):
+                    outs = _eval(updn, acc, e2)
+                    if not outs:        # empty update kills this fold
+                        alive = False
+                        break
+                    acc = outs[-1]      # jq folds with the LAST output
+                if not alive:
                     break
-                acc = outs[-1]          # jq folds with the LAST output
             if alive:
                 out.append(acc)
         return out
     if tag == "foreach":
-        _, srcn, name, initn, updn, extn = node
+        _, srcn, pat, initn, updn, extn = node
         xs = _eval(srcn, v, env)
         out = []
         for acc in _eval(initn, v, env):
             for x in xs:
-                e2 = dict(env) if env else {}
-                e2[name] = x
-                outs = _eval(updn, acc, e2)
-                if not outs:
+                stop = False
+                for e2 in _destructure(pat, x, env):
+                    outs = _eval(updn, acc, e2)
+                    if not outs:
+                        stop = True
+                        break
+                    for o in outs:      # every update output is emitted
+                        out.extend(_eval(extn, o, e2) if extn else [o])
+                    acc = outs[-1]
+                if stop:
                     break
-                for o in outs:          # every update output is emitted
-                    out.extend(_eval(extn, o, e2) if extn else [o])
-                acc = outs[-1]
         return out
     if tag == "try":
         try:
@@ -1060,6 +1110,47 @@ def _getpath_value(v: Any, path: List[Any]) -> Any:
         got = _index(x, p, opt=True)
         x = got[0] if got else None
     return x
+
+
+def _destructure(pat, val, env) -> List[dict]:
+    """Bind a destructuring pattern against one value: returns the
+    environment(s) for the body — plural because ``(expr):`` pattern
+    keys are generators (evaluated with ``.`` bound to the value
+    being matched, like jq).  ``null`` destructures to all-null
+    bindings; container mismatches error, like jq."""
+    base = dict(env) if env else {}
+
+    def bind(p, value, envs):
+        tag = p[0]
+        if tag == "pvar":
+            for e in envs:
+                e[p[1]] = value
+            return envs
+        if tag == "parray":
+            if value is not None and not isinstance(value, list):
+                raise JqError(
+                    f"jq: cannot destructure {_jq_type(value)} as array")
+            for i, sub in enumerate(p[1]):
+                item = (None if value is None or i >= len(value)
+                        else value[i])
+                envs = bind(sub, item, envs)
+            return envs
+        if value is not None and not isinstance(value, dict):
+            raise JqError(
+                f"jq: cannot destructure {_jq_type(value)} as object")
+        for keyexpr, sub in p[1]:
+            nxt = []
+            for e in envs:
+                for k in _eval(keyexpr, value, e):
+                    if not isinstance(k, str):
+                        raise JqError("jq: pattern key must be a "
+                                      "string")
+                    item = None if value is None else value.get(k)
+                    nxt.extend(bind(sub, item, [dict(e)]))
+            envs = nxt
+        return envs
+
+    return bind(pat, val, [base])
 
 
 def _call_user(fn, args: List[Any], v: Any, env) -> List[Any]:

@@ -516,3 +516,40 @@ def test_jq_value_param_also_binds_filter_name():
     name stays callable (review finding)."""
     assert jq_eval('def f($x): x; f(7)', None) == [7]
     assert jq_eval('def f($x): $x + x; f(3)', None) == [6]
+
+
+DESTRUCTURE_CASES = [
+    ('. as [$a, $b] | $a + $b', [3, 4], [7]),
+    ('. as [$a, $b, $c] | $c', [1, 2], [None]),    # short array -> null
+    ('. as {a: $x} | $x', {"a": 9}, [9]),
+    ('. as {$a, $b} | [$a, $b]', {"a": 1, "b": 2}, [[1, 2]]),
+    ('. as {a: [$x, $y]} | $x * $y', {"a": [3, 5]}, [15]),
+    ('. as {"weird key": $w} | $w', {"weird key": 8}, [8]),
+    ('null as [$a] | $a', None, [None]),           # null binds nulls
+    ('reduce .[] as [$k, $n] (0; . + $n)', [["a", 1], ["b", 2]], [3]),
+    ('foreach .[] as {n: $n} (0; . + $n)', [{"n": 1}, {"n": 2}], [1, 3]),
+    ('.[] as [$a] | $a', [[1], [2]], [1, 2]),
+    ('. as {(.k): $v} | $v', {"k": "x", "x": 42}, [42]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", DESTRUCTURE_CASES,
+                         ids=[c[0] for c in DESTRUCTURE_CASES])
+def test_jq_destructuring(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_destructuring_mismatch_errors():
+    with pytest.raises(JqError, match="destructure"):
+        jq_eval('. as [$a] | $a', {"x": 1})
+    with pytest.raises(JqError, match="destructure"):
+        jq_eval('. as {a: $x} | $x', [1, 2])
+
+
+def test_jq_computed_pattern_key_sees_matched_value():
+    """(expr): pattern keys evaluate with `.` bound to the value being
+    destructured, not the as-site input (review finding)."""
+    assert jq_eval('.items[] as {(.k): $v} | $v',
+                   {"items": [{"k": "x", "x": 1}]}) == [1]
+    assert jq_eval('reduce .[] as {(.k): $n} (0; . + $n)',
+                   [{"k": "a", "a": 2}, {"k": "b", "b": 3}]) == [5]
