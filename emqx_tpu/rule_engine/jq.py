@@ -62,6 +62,9 @@ Supported (the surface rule engines actually use):
   into JqError), lexical scoping, user defs shadow same-name/arity
   builtins — all jq semantics.
 
+* ``@format`` strings — ``@text @json @csv @tsv @html @uri @sh
+  @base64 @base64d`` — as standalone filters and as
+  interpolation-formatting string prefixes (``@uri "q=\\(.q)"``);
 * destructuring patterns in ``as`` and ``reduce``/``foreach``
   (``. as [$a, {b: $c}] | ...``), incl. ``{$x}`` shorthand, string
   and computed ``(expr):`` keys (generator fan-out), null-tolerant
@@ -95,6 +98,103 @@ class JqError(ValueError):
 
 
 # ---------------------------------------------------------------------------
+# @format strings (applied to interpolations and as standalone filters)
+# ---------------------------------------------------------------------------
+
+def _fmt_tostr(v: Any) -> str:
+    return v if isinstance(v, str) else json.dumps(
+        v, separators=(",", ":"))
+
+
+def _fmt_csv_cell(x: Any) -> str:
+    if x is None:
+        return ""
+    if isinstance(x, bool):
+        return "true" if x else "false"
+    if isinstance(x, (int, float)):
+        return json.dumps(x)
+    if isinstance(x, str):
+        return '"' + x.replace('"', '""') + '"'
+    raise JqError(f"jq: @csv cannot format {_jq_type(x)}")
+
+
+def _fmt_tsv_cell(x: Any) -> str:
+    if x is None:
+        return ""
+    if isinstance(x, bool):
+        return "true" if x else "false"
+    if isinstance(x, (int, float)):
+        return json.dumps(x)
+    if isinstance(x, str):
+        return (x.replace("\\", "\\\\").replace("\t", "\\t")
+                .replace("\n", "\\n").replace("\r", "\\r"))
+    raise JqError(f"jq: @tsv cannot format {_jq_type(x)}")
+
+
+def _fmt_row(v: Any, cell, sep: str) -> str:
+    if not isinstance(v, list):
+        raise JqError("jq: @csv/@tsv need an array input")
+    return sep.join(cell(x) for x in v)
+
+
+def _fmt_sh(v: Any) -> str:
+    def one(x):
+        if isinstance(x, bool):
+            return "true" if x else "false"
+        if isinstance(x, (int, float)):
+            return json.dumps(x)
+        if isinstance(x, str):
+            return "'" + x.replace("'", "'\\''") + "'"
+        raise JqError(f"jq: @sh cannot format {_jq_type(x)}")
+    return " ".join(one(x) for x in v) if isinstance(v, list) else one(v)
+
+
+def _fmt_base64(v: Any) -> str:
+    import base64
+    return base64.b64encode(_fmt_tostr(v).encode()).decode()
+
+
+def _fmt_base64d(v: Any) -> str:
+    import base64
+    if not isinstance(v, str):
+        raise JqError("jq: @base64d needs a string")
+    try:
+        # validate=True: non-alphabet bytes must ERROR, not be
+        # silently discarded (b64decode's permissive default)
+        return base64.b64decode(v + "=" * (-len(v) % 4),
+                                validate=True).decode("utf-8", "replace")
+    except Exception:
+        raise JqError("jq: invalid base64")
+
+
+def _fmt_uri(v: Any) -> str:
+    import urllib.parse
+    # jq encodes everything outside alphanumerics and -_.~ (RFC 3986
+    # unreserved), stricter than quote()'s default
+    return urllib.parse.quote(_fmt_tostr(v), safe="-_.~")
+
+
+def _fmt_html(v: Any) -> str:
+    s = _fmt_tostr(v)
+    return (s.replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;").replace("'", "&#39;")
+            .replace('"', "&quot;"))
+
+
+_FORMATS = {
+    "text": _fmt_tostr,
+    "json": lambda v: json.dumps(v, separators=(",", ":")),
+    "csv": lambda v: _fmt_row(v, _fmt_csv_cell, ","),
+    "tsv": lambda v: _fmt_row(v, _fmt_tsv_cell, "\t"),
+    "html": _fmt_html,
+    "uri": _fmt_uri,
+    "sh": _fmt_sh,
+    "base64": _fmt_base64,
+    "base64d": _fmt_base64d,
+}
+
+
+# ---------------------------------------------------------------------------
 # lexer
 # ---------------------------------------------------------------------------
 
@@ -102,6 +202,7 @@ _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+)
   | (?P<num>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
   | (?P<var>\$[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<fmt>@[A-Za-z0-9_]+)
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
   | (?P<punct>\.\.|//=|//|==|!=|<=|>=|\|=|\+=|-=|\*=|/=|%=|=|\||,|\.|\[|\]|\{|\}|\(|\)|:|;|\?|<|>|\+|-|\*|/|%)
 """, re.VERBOSE)
@@ -188,6 +289,18 @@ def _lex(src: str) -> List[Tuple[str, str]]:
         toks.append((kind, m.group()))
     toks.append(("eof", ""))
     return toks
+
+
+def _istr_segs(parts):
+    """Lexer interpolation parts -> istr segments: literal text stays
+    ("lit", str); interpolations parse to ("iexpr", ast)."""
+    segs = []
+    for skind, src in parts:
+        if skind == "lit":
+            segs.append(("lit", _unquote('"' + src + '"')))
+        else:
+            segs.append(("iexpr", _parse(src)))
+    return segs
 
 
 def _unquote(s: str) -> str:
@@ -447,16 +560,23 @@ class _Parser:
             return ("lit", _unquote(text))
         if kind == "istr":
             self.next()
-            segs = []
-            for skind, s in text:       # text is the parts list here
-                if skind == "lit":
-                    segs.append(("lit", _unquote('"' + s + '"')))
-                else:
-                    segs.append(_parse(s))
-            return ("istr", segs)
+            return ("istr", _istr_segs(text))  # text is the parts list
         if kind == "var":
             self.next()
             return ("var", text[1:])
+        if kind == "fmt":
+            self.next()
+            fname = text[1:]
+            if fname not in _FORMATS:
+                raise JqError(f"jq: unknown format @{fname}")
+            nk, nt = self.peek()
+            if nk == "str":             # @fmt "..." formats the whole
+                self.next()             # literal's interpolations
+                return ("istr", [("lit", _unquote(nt))], fname)
+            if nk == "istr":
+                self.next()
+                return ("istr", _istr_segs(nt), fname)
+            return ("format", fname)
         if kind == "ident":
             if text == "true":
                 self.next(); return ("lit", True)
@@ -907,15 +1027,18 @@ def _eval(node, v: Any, env=None) -> List[Any]:
                     break
             return _eval(node[2], msg, env)
     if tag == "istr":
+        fmt = _FORMATS[node[2]] if len(node) > 2 else _fmt_tostr
         results = [""]
         for seg in node[1]:
-            pieces = []
-            for o in _eval(seg, v, env):
-                pieces.append(o if isinstance(o, str)
-                              else json.dumps(o, separators=(",", ":")))
+            if seg[0] == "lit":         # literal text: never formatted
+                pieces = [seg[1]]
+            else:
+                pieces = [fmt(o) for o in _eval(seg[1], v, env)]
             # cartesian: a multi-output interpolation fans the string out
             results = [r + p for r in results for p in pieces]
         return results
+    if tag == "format":
+        return [_FORMATS[node[1]](v)]
     if tag == "assign":
         return _eval_assign(node[1], node[2], node[3], v, env)
     raise JqError(f"jq: internal: unknown node {tag}")

@@ -553,3 +553,44 @@ def test_jq_computed_pattern_key_sees_matched_value():
                    {"items": [{"k": "x", "x": 1}]}) == [1]
     assert jq_eval('reduce .[] as {(.k): $n} (0; . + $n)',
                    [{"k": "a", "a": 2}, {"k": "b", "b": 3}]) == [5]
+
+
+FORMAT_CASES = [
+    ('@base64', "hi", ["aGk="]),
+    ('@base64d', "aGk=", ["hi"]),
+    ('@base64 | @base64d', "round", ["round"]),
+    ('@csv', [1, "a,b", None, True, 2.5], ['1,"a,b",,true,2.5']),
+    ('@tsv', ["a\tb", 3], ["a\\tb\t3"]),
+    ('@json', {"a": 1}, ['{"a":1}']),
+    ('@text', 42, ["42"]),
+    ('@html', "<b>&'\"", ["&lt;b&gt;&amp;&#39;&quot;"]),
+    ('@uri', "a b/c?", ["a%20b%2Fc%3F"]),
+    ('@sh', ["a b", "it's"], ["'a b' 'it'\\''s'"]),
+    # format-prefixed strings format INTERPOLATIONS only, jq-style
+    ('@base64 "user=\\(.u)"', {"u": "bob"}, ["user=Ym9i"]),
+    ('@uri "q=\\(.q)&x=1"', {"q": "a b"}, ["q=a%20b&x=1"]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", FORMAT_CASES,
+                         ids=[c[0] for c in FORMAT_CASES])
+def test_jq_format_strings(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_format_errors():
+    with pytest.raises(JqError, match="format"):
+        jq_eval("@nope", 1)
+    with pytest.raises(JqError):
+        jq_eval("@csv", "not an array")
+    with pytest.raises(JqError):
+        jq_eval("@base64d", 42)
+
+
+def test_jq_uri_and_base64d_strictness():
+    """@uri encodes everything outside RFC 3986 unreserved; @base64d
+    rejects non-alphabet input instead of silently discarding it
+    (review findings)."""
+    assert jq_eval('@uri', "don't(x)!*") == ["don%27t%28x%29%21%2A"]
+    with pytest.raises(JqError, match="base64"):
+        jq_eval('@base64d', "!!!")
