@@ -68,15 +68,16 @@ Supported (the surface rule engines actually use):
 * destructuring patterns in ``as`` and ``reduce``/``foreach``
   (``. as [$a, {b: $c}] | ...``), incl. ``{$x}`` shorthand, string
   and computed ``(expr):`` keys (generator fan-out), null-tolerant
-  bindings, mismatch errors.
+  bindings, mismatch errors, and ``?//`` alternatives (first
+  pattern whose match and body succeed wins; variables from
+  unmatched alternatives bind null).
 
 Out of scope (documented, erroring loudly rather than mis-evaluating):
 ``label``/``break`` (the eager list-based evaluator cannot preserve
 already-yielded outputs across an unwind; its main idiom is covered
-by the ``first(f)``/``limit(n;f)``/``until`` builtins), the ``?//``
-alternative-pattern operator, slice assignment (``.[:2] = ...``),
-``limit``/``..`` as path expressions, and ``ltrimstr`` etc. in LHS
-paths.
+by the ``first(f)``/``limit(n;f)``/``until`` builtins), slice
+assignment (``.[:2] = ...``), ``limit``/``..`` as path expressions,
+and ``ltrimstr`` etc. in LHS paths.
 
 jq's comparison/sort total order (null < false < true < numbers <
 strings < arrays < objects) is implemented so ``sort``/``min``/``max``
@@ -341,6 +342,18 @@ class _Parser:
 
     # precedence ladder ----------------------------------------------------
 
+    def parse_pattern_alts(self):
+        """PATTERN [?// PATTERN ...] — destructuring alternatives: the
+        first pattern whose match AND body succeed wins; variables
+        from unmatched alternatives bind null."""
+        pats = [self.parse_pattern()]
+        while (self.peek() == ("punct", "?")
+               and self.toks[self.i + 1] == ("punct", "//")):
+            self.next()
+            self.next()
+            pats.append(self.parse_pattern())
+        return pats[0] if len(pats) == 1 else ("palt", pats)
+
     def parse_pattern(self):
         """Destructuring pattern for ``as``: $var, [patterns...], or
         {key: pattern, $shorthand, "str": pattern, (expr): pattern}."""
@@ -393,7 +406,7 @@ class _Parser:
         if self.peek() == ("ident", "as"):
             # EXPR as PATTERN | BODY — `.` stays the original input
             self.next()
-            pat = self.parse_pattern()
+            pat = self.parse_pattern_alts()
             self.expect("|")
             return ("as", left, pat, self.parse_pipe())
         while self.eat("|"):
@@ -402,7 +415,7 @@ class _Parser:
             right = self.parse_comma()
             if self.peek() == ("ident", "as"):
                 self.next()
-                pat = self.parse_pattern()
+                pat = self.parse_pattern_alts()
                 self.expect("|")
                 return ("pipe", left,
                         ("as", right, pat, self.parse_pipe()))
@@ -592,7 +605,7 @@ class _Parser:
                 self.next()
                 src = self.parse_postfix()
                 self.expect("as")
-                name = self.parse_pattern()
+                name = self.parse_pattern_alts()
                 self.expect("(")
                 init = self.parse_pipe()
                 self.expect(";")
@@ -975,8 +988,7 @@ def _eval(node, v: Any, env=None) -> List[Any]:
     if tag == "as":
         out = []
         for x in _eval(node[1], v, env):
-            for e2 in _destructure(node[2], x, env):
-                out.extend(_eval(node[3], v, e2))
+            out.extend(_as_eval(node[2], x, env, node[3], v))
         return out
     if tag == "reduce":
         _, srcn, pat, initn, updn = node
@@ -985,14 +997,11 @@ def _eval(node, v: Any, env=None) -> List[Any]:
         for acc in _eval(initn, v, env):
             alive = True
             for x in xs:
-                for e2 in _destructure(pat, x, env):
-                    outs = _eval(updn, acc, e2)
-                    if not outs:        # empty update kills this fold
-                        alive = False
-                        break
-                    acc = outs[-1]      # jq folds with the LAST output
-                if not alive:
+                res = _fold_elem(pat, x, env, updn, acc)
+                if res is _FOLD_DEAD:   # empty update kills this fold
+                    alive = False
                     break
+                acc = res               # jq folds with the LAST output
             if alive:
                 out.append(acc)
         return out
@@ -1002,16 +1011,10 @@ def _eval(node, v: Any, env=None) -> List[Any]:
         out = []
         for acc in _eval(initn, v, env):
             for x in xs:
-                stop = False
-                for e2 in _destructure(pat, x, env):
-                    outs = _eval(updn, acc, e2)
-                    if not outs:
-                        stop = True
-                        break
-                    for o in outs:      # every update output is emitted
-                        out.extend(_eval(extn, o, e2) if extn else [o])
-                    acc = outs[-1]
-                if stop:
+                emitted, acc, stopped = _foreach_elem(
+                    pat, x, env, updn, extn, acc)
+                out.extend(emitted)     # every update output is emitted
+                if stopped:
                     break
         return out
     if tag == "try":
@@ -1233,6 +1236,105 @@ def _getpath_value(v: Any, path: List[Any]) -> Any:
         got = _index(x, p, opt=True)
         x = got[0] if got else None
     return x
+
+
+def _pattern_vars(pat, into: set) -> None:
+    if pat[0] == "pvar":
+        into.add(pat[1])
+    elif pat[0] == "parray":
+        for sub in pat[1]:
+            _pattern_vars(sub, into)
+    elif pat[0] == "pobject":
+        for _, sub in pat[1]:
+            _pattern_vars(sub, into)
+    else:                               # palt
+        for sub in pat[1]:
+            _pattern_vars(sub, into)
+
+
+def _alt_attempts(pat, val, env):
+    """Yield (envs, is_last) per ?// alternative whose MATCH succeeds
+    (match failure skips to the next unless last); callers retry the
+    next attempt when their BODY errors too — the full jq retry unit.
+    Variables only present in other alternatives bind null so the
+    body always sees the full variable set."""
+    if pat[0] != "palt":
+        yield _destructure(pat, val, env), True
+        return
+    allvars: set = set()
+    _pattern_vars(pat, allvars)
+    last = len(pat[1]) - 1
+    for k, p in enumerate(pat[1]):
+        try:
+            envs = _destructure(p, val, env)
+        except JqError:
+            if k == last:
+                raise
+            continue
+        mine: set = set()
+        _pattern_vars(p, mine)
+        for e in envs:
+            for name in allvars - mine:
+                e[name] = None
+        yield envs, k == last
+
+
+def _as_eval(pat, x, env, body, v) -> List[Any]:
+    """One `as` binding + body evaluation with ?// retry."""
+    for envs, is_last in _alt_attempts(pat, x, env):
+        try:
+            out = []
+            for e2 in envs:
+                out.extend(_eval(body, v, e2))
+            return out
+        except JqError:
+            if is_last:
+                raise
+    return []
+
+
+_FOLD_DEAD = object()       # sentinel: empty update killed the fold
+
+
+def _fold_elem(pat, x, env, updn, acc):
+    """One reduce step over one source element, with ?// retry on
+    update errors (same retry unit as `as`)."""
+    for envs, is_last in _alt_attempts(pat, x, env):
+        try:
+            a = acc
+            for e2 in envs:
+                outs = _eval(updn, a, e2)
+                if not outs:
+                    return _FOLD_DEAD
+                a = outs[-1]
+            return a
+        except JqError:
+            if is_last:
+                raise
+    return _FOLD_DEAD
+
+
+def _foreach_elem(pat, x, env, updn, extn, acc):
+    """One foreach step: returns (emitted, new_acc, stopped), with
+    ?// retry on update/extract errors."""
+    for envs, is_last in _alt_attempts(pat, x, env):
+        try:
+            trial: list = []
+            a = acc
+            stopped = False
+            for e2 in envs:
+                outs = _eval(updn, a, e2)
+                if not outs:
+                    stopped = True
+                    break
+                for o in outs:
+                    trial.extend(_eval(extn, o, e2) if extn else [o])
+                a = outs[-1]
+            return trial, a, stopped
+        except JqError:
+            if is_last:
+                raise
+    return [], acc, True
 
 
 def _destructure(pat, val, env) -> List[dict]:

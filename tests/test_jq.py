@@ -594,3 +594,42 @@ def test_jq_uri_and_base64d_strictness():
     assert jq_eval('@uri', "don't(x)!*") == ["don%27t%28x%29%21%2A"]
     with pytest.raises(JqError, match="base64"):
         jq_eval('@base64d', "!!!")
+
+
+ALT_PATTERN_CASES = [
+    ('. as [$a] ?// {a: $a} | $a', [7], [7]),
+    ('. as [$a] ?// {a: $a} | $a', {"a": 9}, [9]),
+    # vars only in the unmatched alternative bind null
+    ('. as [$a, $b] ?// {c: $c} | [$a, $b, $c]', {"c": 1},
+     [[None, None, 1]]),
+    ('. as {x: $x} ?// [$x] | $x', [5], [5]),
+    # a BODY error with one alternative retries the next (jq)
+    ('.[] as [$a] ?// $a | $a', [[1], 2], [1, 2]),
+    ('reduce .[] as [$n] ?// {n: $n} (0; . + $n)', [[1], {"n": 2}], [3]),
+]
+
+
+@pytest.mark.parametrize("prog,doc,want", ALT_PATTERN_CASES,
+                         ids=[c[0] for c in ALT_PATTERN_CASES])
+def test_jq_pattern_alternatives(prog, doc, want):
+    assert jq_eval(prog, doc) == want
+
+
+def test_jq_pattern_alternatives_all_fail():
+    with pytest.raises(JqError):
+        jq_eval('. as [$a] ?// {a: $a} | $a', "neither")
+
+
+def test_jq_pattern_alternative_body_error_retries():
+    """The ?// retry unit is MATCH AND BODY: a body/update error with
+    one alternative retries the next, in `as` and in reduce/foreach
+    alike (review finding)."""
+    assert jq_eval(
+        '.[] as [$a] ?// $a | '
+        '(if ($a | type) == "number" then $a else error("e") end)',
+        [[1], 2]) == [1, 2]
+    assert jq_eval(
+        'reduce .[] as [$n] ?// {n: $n} '
+        '(0; if ($n | type) == "number" then . + $n '
+        'else error("e") end)',
+        [[1], {"n": 2}]) == [3]
