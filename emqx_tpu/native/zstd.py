@@ -799,34 +799,48 @@ def _seq_table_choice(hist: dict, predef_norm, predef_log: int,
     return 0, predef_norm, predef_log, b""
 
 
-def _find_sequences(block: bytes):
-    """Greedy LZ77 over one block: 4-byte hash chains, matches stay
-    inside the block.  Returns ([(lit_len, match_len, offset)],
-    literals, tail_literals)."""
-    n = len(block)
+_LZ_WINDOW = 1 << 20                    # cross-block match range
+_LZ_TABLE_CAP = 500_000                 # ~50 MB peak dict; entries
+                                        # beyond the window are dead
+                                        # weight and get evicted first
+
+
+def _find_sequences(buf: bytes, start: int = 0, end: int = -1,
+                    table=None):
+    """Greedy LZ77 over buf[start:end] with a 4-gram table that the
+    CALLER persists across a frame's blocks — matches may reach up to
+    _LZ_WINDOW bytes back into prior blocks (cross-block window
+    matches; every decoder resolves them against the frame window,
+    and a single-segment frame's window is its whole content).
+    Returns ([(lit_len, match_len, offset)], literals,
+    tail_literals)."""
+    if end < 0:
+        end = len(buf)
+    if table is None:
+        table = {}
     seqs = []
     lits = bytearray()
-    table = {}
-    i = 0
-    anchor = 0
-    while i + 4 <= n:
-        key = block[i:i + 4]
+    i = start
+    anchor = start
+    while i + 4 <= end:
+        key = buf[i:i + 4]
         cand = table.get(key)
         table[key] = i
-        if cand is None or i - cand > 131072:
+        if cand is None or i - cand > _LZ_WINDOW:
             i += 1
             continue
         length = 4
-        while i + length < n and block[cand + length] == block[i + length]:
+        while i + length < end and buf[cand + length] == buf[i + length]:
             length += 1
-        lits += block[anchor:i]
+        lits += buf[anchor:i]
         seqs.append((i - anchor, length, i - cand))
         i += length
         anchor = i
-    return seqs, bytes(lits), block[anchor:]
+    return seqs, bytes(lits), bytes(buf[anchor:end])
 
 
-def _compress_block(block: bytes, rep=None):
+def _compress_block(data: bytes, start: int = 0, end: int = -1,
+                    rep=None, table=None):
     """One compressed block body (literals + sequences sections), or
     None when neither sequences nor literal compression pay.  With no
     sequences the block can still compress via its literals section
@@ -835,8 +849,24 @@ def _compress_block(block: bytes, rep=None):
     ``rep`` is the frame's 3-slot repeat-offset history (RFC 8878
     §3.1.1.5, persists across the frame's blocks); it is mutated ONLY
     when the sequence-coded body is actually returned — the
-    literals-only and raw fallbacks execute no sequences."""
-    seqs, lits, tail = _find_sequences(block)
+    literals-only and raw fallbacks execute no sequences.  ``table``
+    is the frame-persistent LZ77 4-gram table enabling cross-block
+    matches (see _find_sequences); the block itself is
+    data[start:end]."""
+    if end < 0:
+        end = len(data)
+    block = data[start:end]
+    if table is not None and len(table) > _LZ_TABLE_CAP:
+        # bound memory: evict out-of-window entries first; a full
+        # clear only when the WINDOW itself holds more distinct
+        # 4-grams than the cap (high-entropy data, where history
+        # wasn't going to match anyway)
+        fresh = {k: p for k, p in table.items()
+                 if start - p <= _LZ_WINDOW}
+        table.clear()
+        if len(fresh) <= _LZ_TABLE_CAP:
+            table.update(fresh)
+    seqs, lits, tail = _find_sequences(data, start, end, table)
     nseq = len(seqs)
     if nseq >= 0x7F00:
         return None
@@ -1292,10 +1322,11 @@ def compress_frame(data: bytes) -> bytes:
         out.append(b"\x01\x00\x00")              # last empty raw block
         return b"".join(out)
     rep = [1, 4, 8]                     # frame repeat-offset history
-    for i in range(0, n, _BLOCK_MAX):
+    table: dict = {}                    # frame LZ77 table: cross-block
+    for i in range(0, n, _BLOCK_MAX):   # matches up to _LZ_WINDOW back
         blk = data[i:i + _BLOCK_MAX]
         last = 1 if i + _BLOCK_MAX >= n else 0
-        body = _compress_block(blk, rep)
+        body = _compress_block(data, i, i + len(blk), rep, table)
         if body is None:
             bh = (len(blk) << 3) | last          # type 0 = raw
             out.append(struct.pack("<I", bh)[:3])

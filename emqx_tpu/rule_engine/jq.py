@@ -70,7 +70,10 @@ Supported (the surface rule engines actually use):
   and computed ``(expr):`` keys (generator fan-out), null-tolerant
   bindings, mismatch errors, and ``?//`` alternatives (first
   pattern whose match and body succeed wins; variables from
-  unmatched alternatives bind null).
+  unmatched alternatives bind null; known divergence: a retry
+  discards the failing attempt's already-produced outputs, where
+  real jq streams them first — needs the lazy evaluator noted
+  under label/break).
 
 Out of scope (documented, erroring loudly rather than mis-evaluating):
 ``label``/``break`` (the eager list-based evaluator cannot preserve
@@ -352,7 +355,17 @@ class _Parser:
             self.next()
             self.next()
             pats.append(self.parse_pattern())
-        return pats[0] if len(pats) == 1 else ("palt", pats)
+        if len(pats) == 1:
+            return pats[0]
+        # variable sets are static per pattern: compute once at parse
+        # time, not per source element in the evaluation hot path
+        varsets = []
+        for p in pats:
+            vs: set = set()
+            _pattern_vars(p, vs)
+            varsets.append(frozenset(vs))
+        allvars = frozenset().union(*varsets)
+        return ("palt", pats, varsets, allvars)
 
     def parse_pattern(self):
         """Destructuring pattern for ``as``: $var, [patterns...], or
@@ -1257,24 +1270,26 @@ def _alt_attempts(pat, val, env):
     (match failure skips to the next unless last); callers retry the
     next attempt when their BODY errors too — the full jq retry unit.
     Variables only present in other alternatives bind null so the
-    body always sees the full variable set."""
+    body always sees the full variable set.
+
+    Known divergence from jq (documented, deterministic): a retry
+    DISCARDS outputs the failing attempt's body already produced —
+    real jq streams them out before switching alternatives.  Exact
+    parity needs the same lazy evaluator label/break would."""
     if pat[0] != "palt":
         yield _destructure(pat, val, env), True
         return
-    allvars: set = set()
-    _pattern_vars(pat, allvars)
-    last = len(pat[1]) - 1
-    for k, p in enumerate(pat[1]):
+    _, pats, varsets, allvars = pat
+    last = len(pats) - 1
+    for k, p in enumerate(pats):
         try:
             envs = _destructure(p, val, env)
         except JqError:
             if k == last:
                 raise
             continue
-        mine: set = set()
-        _pattern_vars(p, mine)
         for e in envs:
-            for name in allvars - mine:
+            for name in allvars - varsets[k]:
                 e[name] = None
         yield envs, k == last
 

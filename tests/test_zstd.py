@@ -536,3 +536,20 @@ def test_fallback_decodes_foreign_frames_fully(monkeypatch):
     for d in corpora:
         for level in (1, 6, 19):
             assert zstd.decompress_frame(_ref_compress(d, level)) == d
+
+
+def test_cross_block_window_matches_on_encode():
+    """The encoder's LZ77 table persists across a frame's blocks: a
+    200 KB payload repeated immediately after itself compresses ~2:1
+    (the second copy is one long window match), where the per-block
+    era emitted it raw."""
+    random.seed(99)
+    unique = random.randbytes(200_000)
+    data = unique + unique
+    frame = zstd.compress_frame(data)
+    assert len(frame) < len(data) * 0.55
+    assert zstd._py_store_decompress(frame) == data
+    if zstd.available():
+        assert zstd.decompress_frame(frame) == data
+    if _syszstd() is not None:
+        assert _ref_decompress(frame, len(data)) == data
