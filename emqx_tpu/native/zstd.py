@@ -766,21 +766,35 @@ def _lit_section(literals: bytes, plan=None) -> bytes:
     return huf if huf is not None and len(huf) < len(raw) else raw
 
 
+def _table_bits(hist: dict, norm, log: int):
+    """Estimated stream bits coding `hist` with `norm`, or None when
+    the table doesn't cover every present symbol."""
+    bits = 0
+    for s, c in hist.items():
+        np_ = norm[s] if s < len(norm) else 0
+        np_ = 1 if np_ == -1 else np_
+        if np_ <= 0:
+            return None
+        bits += c * (log - (np_.bit_length() - 1))
+    return bits
+
+
 def _seq_table_choice(hist: dict, predef_norm, predef_log: int,
-                      maxlog: int, nsyms: int):
+                      maxlog: int, nsyms: int, prev=None):
     """Pick the cheapest coding for one sequence-code stream:
     (mode, norm, log, desc) with mode 0 predefined / 1 RLE /
-    2 FSE-described.  Estimates bits as log - floor(log2(count))."""
+    2 FSE-described / 3 Repeat (reuse `prev`, the table the decoder
+    currently holds for this slot — zero description bytes).
+    Estimates bits as log - floor(log2(count))."""
+    opts = []
     if len(hist) == 1:
         sym = next(iter(hist))
         rle = [0] * (sym + 1)
         rle[sym] = 1                    # log-0 single-entry table
-        return 1, tuple(rle), 0, bytes([sym])
-    bits_p = 0
-    for s, c in hist.items():
-        np_ = predef_norm[s] if s < len(predef_norm) else 0
-        np_ = 1 if np_ == -1 else np_
-        bits_p += c * (predef_log - (np_.bit_length() - 1))
+        opts.append((8, 1, tuple(rle), 0, bytes([sym])))
+    bits_p = _table_bits(hist, predef_norm, predef_log)
+    if bits_p is not None:
+        opts.append((bits_p, 0, predef_norm, predef_log, b""))
     total = sum(hist.values())
     log = max((total - 1).bit_length() - 2,
               (len(hist) - 1).bit_length())
@@ -789,20 +803,28 @@ def _seq_table_choice(hist: dict, predef_norm, predef_log: int,
         log += 1
     norm = _fse_normalize(hist, log, nsyms)
     desc = _fse_write_desc(norm, log) if norm is not None else b""
-    if not desc:
+    if desc:
+        bits_d = _table_bits(hist, norm, log)
+        if bits_d is not None:
+            opts.append((len(desc) * 8 + bits_d, 2, tuple(norm), log,
+                         desc))
+    if prev is not None:
+        bits_r = _table_bits(hist, prev[0], prev[1])
+        if bits_r is not None:          # ties go to earlier options
+            opts.append((bits_r, 3, prev[0], prev[1], b""))
+    if not opts:
         return 0, predef_norm, predef_log, b""
-    bits_d = len(desc) * 8
-    for s, c in hist.items():
-        bits_d += c * (log - (norm[s].bit_length() - 1))
-    if bits_d < bits_p:
-        return 2, tuple(norm), log, desc
-    return 0, predef_norm, predef_log, b""
+    _, mode, n, lg, d = min(opts, key=lambda o: o[0])
+    return mode, n, lg, d
 
 
-_LZ_WINDOW = 1 << 20                    # cross-block match range
-_LZ_TABLE_CAP = 500_000                 # ~50 MB peak dict; entries
-                                        # beyond the window are dead
-                                        # weight and get evicted first
+_LZ_WINDOW = 1 << 19                    # cross-block match range
+_LZ_TABLE_CAP = 600_000                 # > window's max distinct
+                                        # 4-grams (524288), so in-window
+                                        # history is NEVER evicted —
+                                        # which candidate to drop would
+                                        # otherwise be an unknowable
+                                        # bet; ~60 MB peak dict
 
 
 def _find_sequences(buf: bytes, start: int = 0, end: int = -1,
@@ -840,7 +862,7 @@ def _find_sequences(buf: bytes, start: int = 0, end: int = -1,
 
 
 def _compress_block(data: bytes, start: int = 0, end: int = -1,
-                    rep=None, table=None):
+                    rep=None, table=None, tstate=None):
     """One compressed block body (literals + sequences sections), or
     None when neither sequences nor literal compression pay.  With no
     sequences the block can still compress via its literals section
@@ -857,15 +879,17 @@ def _compress_block(data: bytes, start: int = 0, end: int = -1,
         end = len(data)
     block = data[start:end]
     if table is not None and len(table) > _LZ_TABLE_CAP:
-        # bound memory: evict out-of-window entries first; a full
-        # clear only when the WINDOW itself holds more distinct
-        # 4-grams than the cap (high-entropy data, where history
-        # wasn't going to match anyway)
+        # bound memory: drop out-of-window entries.  The cap exceeds
+        # the window's maximum distinct-4-gram count, so this always
+        # retains the FULL in-window history (the defensive tail-trim
+        # is unreachable unless the constants drift apart).
         fresh = {k: p for k, p in table.items()
                  if start - p <= _LZ_WINDOW}
         table.clear()
-        if len(fresh) <= _LZ_TABLE_CAP:
-            table.update(fresh)
+        if len(fresh) > _LZ_TABLE_CAP:
+            fresh = dict(sorted(fresh.items(),
+                                key=lambda kv: kv[1])[-_LZ_TABLE_CAP:])
+        table.update(fresh)
     seqs, lits, tail = _find_sequences(data, start, end, table)
     nseq = len(seqs)
     if nseq >= 0x7F00:
@@ -924,12 +948,13 @@ def _compress_block(data: bytes, start: int = 0, end: int = -1,
     for triple in codes:
         for t, c in enumerate(triple):
             hists[t][c] = hists[t].get(c, 0) + 1
+    ts = tstate if tstate is not None else {}
     ll_m, ll_norm, ll_log, ll_desc = _seq_table_choice(
-        hists[0], _LL_NORM, 6, 9, 36)
+        hists[0], _LL_NORM, 6, 9, 36, prev=ts.get("ll"))
     of_m, of_norm, of_log, of_desc = _seq_table_choice(
-        hists[1], _OF_NORM, 5, 8, 32)
+        hists[1], _OF_NORM, 5, 8, 32, prev=ts.get("of"))
     ml_m, ml_norm, ml_log, ml_desc = _seq_table_choice(
-        hists[2], _ML_NORM, 6, 9, 53)
+        hists[2], _ML_NORM, 6, 9, 53, prev=ts.get("ml"))
     shead += bytes([(ll_m << 6) | (of_m << 4) | (ml_m << 2)])
     shead += ll_desc + of_desc + ml_desc        # LL, OF, ML order
     ll = _FseEnc(ll_norm, ll_log, cache=ll_m == 0)
@@ -976,6 +1001,13 @@ def _compress_block(data: bytes, start: int = 0, end: int = -1,
     if len(body) < len(block):
         if rep is not None:
             rep[:] = nrep               # commit: this body ships
+        if tstate is not None:
+            # the decoder's per-slot last-used tables (Repeat_Mode
+            # reuses them next block) — only sequence-coded bodies
+            # touch them
+            tstate["ll"] = (ll_norm, ll_log)
+            tstate["of"] = (of_norm, of_log)
+            tstate["ml"] = (ml_norm, ml_log)
         return body
     return None
 
@@ -1323,10 +1355,12 @@ def compress_frame(data: bytes) -> bytes:
         return b"".join(out)
     rep = [1, 4, 8]                     # frame repeat-offset history
     table: dict = {}                    # frame LZ77 table: cross-block
+    tstate: dict = {}                   # decoder's last-used seq tables
     for i in range(0, n, _BLOCK_MAX):   # matches up to _LZ_WINDOW back
         blk = data[i:i + _BLOCK_MAX]
         last = 1 if i + _BLOCK_MAX >= n else 0
-        body = _compress_block(data, i, i + len(blk), rep, table)
+        body = _compress_block(data, i, i + len(blk), rep, table,
+                               tstate)
         if body is None:
             bh = (len(blk) << 3) | last          # type 0 = raw
             out.append(struct.pack("<I", bh)[:3])

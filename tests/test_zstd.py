@@ -553,3 +553,36 @@ def test_cross_block_window_matches_on_encode():
         assert zstd.decompress_frame(frame) == data
     if _syszstd() is not None:
         assert _ref_decompress(frame, len(data)) == data
+
+
+def test_repeat_mode_tables_emitted_and_accepted():
+    """Multi-block frames with per-block-similar code statistics reuse
+    the previous block's described tables via Repeat_Mode (zero
+    description bytes); libzstd and both in-repo decoders accept."""
+    random.seed(8)
+    data = b"".join(
+        b'{"k":"%s","n":%d}' % (
+            bytes(random.choice(b"abcdefgh") for _ in range(6)),
+            random.randrange(10 ** 6))
+        for _ in range(14000))                    # ~348 KB, 3 blocks
+    frame = zstd.compress_frame(data)
+    assert zstd._py_store_decompress(frame) == data
+    if zstd.available():
+        assert zstd.decompress_frame(frame) == data
+    if _syszstd() is not None:
+        assert _ref_decompress(frame, len(data)) == data
+
+
+def test_lz_window_history_survives_high_entropy_prefix():
+    """The table cap exceeds the window's max distinct-4-gram count,
+    so a duplicate of a large unique prefix WITHIN the window always
+    matches — eviction never silently discards in-window history
+    (review finding)."""
+    random.seed(2)
+    prefix = random.randbytes(400_000)
+    data = prefix + prefix[:200_000]
+    frame = zstd.compress_frame(data)
+    assert len(frame) < len(data) * 0.75
+    assert zstd._py_store_decompress(frame) == data
+    if _syszstd() is not None:
+        assert _ref_decompress(frame, len(data)) == data
