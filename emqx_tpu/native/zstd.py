@@ -20,18 +20,21 @@ Posture mirrors the snappy/lz4 modules:
   code; tree shipped as the direct 4-bit weight description or the
   **FSE-compressed weight description** — which lifts the direct
   form's 128-symbol cap, so high-byte binary payloads compress too;
-  1- or 4-stream), raw-block fallback when compression doesn't pay.
-  Measured ratios: ~1000x on repetitive text/JSON, ~2-2.6x on
-  skewed binary/small-alphabet data, 1.0 floor on incompressible
-  data.  Every mode is proven against libzstd.  (Still not emitted:
-  repeat-offset codes and Repeat_Mode table reuse across blocks.)
+  1- or 4-stream; TREELESS reuse when the frame's last tree codes a
+  section more cheaply), repeat-offset codes, Repeat_Mode table
+  reuse, cross-block window matches (frame-persistent LZ77 table)
+  and the RLE block type, with raw-block fallback when compression
+  doesn't pay — every non-dictionary construct of the format is
+  exercised on encode.  Measured ratios: ~1000x on repetitive
+  text/JSON, ~2-2.6x on skewed binary/small-alphabet data, 1.0
+  floor on incompressible data.  Every mode is proven against
+  libzstd.
 
 Interop against system libzstd (both directions, levels 1-22) is
 proven in ``tests/test_zstd.py``.  Without a toolchain,
-``decompress_frame`` falls back to a pure-Python decoder covering
-exactly the subset our encoder emits (plus store-mode frames), so a
-bridge's own production always round-trips; entropy-coded foreign
-frames then keep the legacy skip-with-offset-advance.
+``decompress_frame`` falls back to the pure-Python full-format
+decoder, so both a bridge's own production AND foreign frames
+round-trip toolchain-less (minus xxh64 verification).
 """
 
 from __future__ import annotations
@@ -636,9 +639,9 @@ def _huf_fse_weights_decode(blob: bytes):
 
 def _huf_plan(literals: bytes):
     """Code plan for Huffman-coding `literals`: (lengths, exact
-    stream bits, tree-description bytes), or None when Huffman can't
-    apply.  Cheap relative to encoding — Counter counts in C and
-    package-merge works on <=256 symbols — so it doubles as the
+    stream bits, tree-description bytes, freqs), or None when Huffman
+    can't apply.  Cheap relative to encoding — Counter counts in C
+    and package-merge works on <=256 symbols — so it doubles as the
     size ESTIMATE that gates whether a full encode is worth doing.
     The tree-size term uses the direct form; the FSE weight form
     (chosen at encode time when smaller) only shrinks it."""
@@ -652,7 +655,7 @@ def _huf_plan(literals: bytes):
     lengths = _package_merge(freqs, _HUF_MAX_BITS)  # form; FSE often
                                                     # beats it)
     bits = sum(freqs[s] * lengths[s] for s in freqs)
-    return lengths, bits, 1 + (max_sym + 1) // 2
+    return lengths, bits, 1 + (max_sym + 1) // 2, freqs
 
 
 def _huf_estimate(plan, n: int):
@@ -661,24 +664,16 @@ def _huf_estimate(plan, n: int):
     worst-case), or None."""
     if plan is None:
         return None
-    _, bits, tree = plan
+    _, bits, tree, _ = plan
     if n <= 1023:
         return 3 + tree + (bits + 1 + 7) // 8
     return 5 + tree + 6 + bits // 8 + 4
 
 
-def _huf_literals_section(literals: bytes, plan=None):
-    """Compressed_Literals_Block (type 2) bytes — header + direct
-    weight description + backward Huffman stream(s) — or None when
-    Huffman can't be used or doesn't pay.  Accepts a precomputed
-    ``_huf_plan`` so callers that already estimated don't re-count."""
-    n = len(literals)
-    if plan is None:
-        plan = _huf_plan(literals)
-    if plan is None:
-        return None
-    lengths, _, _ = plan
-    max_sym = max(lengths)
+def _huf_codes(lengths: dict):
+    """Canonical codes for a length assignment, per the decoder's
+    table construction (huf_build): weight-ascending ranges, symbol-
+    ascending within a weight."""
     maxbits = max(lengths.values())
     codes = {}
     pos = 0
@@ -689,6 +684,68 @@ def _huf_literals_section(literals: bytes, plan=None):
                 codes[s] = (pos >> (w - 1), ln)
                 pos += 1 << (w - 1)
     assert pos == 1 << maxbits          # Kraft-complete by construction
+    return codes, maxbits
+
+
+def _huf_section_bytes(literals: bytes, codes: dict, tree: bytes,
+                       ltype: int):
+    """Assemble one Huffman literals section (type 2 with a tree, or
+    type 3 treeless with ``tree=b""``): header + tree + backward
+    stream(s); None when it doesn't fit its header formats or doesn't
+    pay."""
+    n = len(literals)
+
+    def enc_stream(chunk):
+        w = _BitWriter()
+        for b in reversed(chunk):
+            c, ln = codes[b]
+            w.push(c, ln)
+        return w.finish()
+
+    if n <= 1023:                       # 1 stream, 10-bit sizes
+        stream = enc_stream(literals)
+        comp = len(tree) + len(stream)
+        if comp >= n or comp > 1023:
+            return None
+        head = (ltype | (n << 4) | (comp << 14)).to_bytes(3, "little")
+        return head + tree + stream
+    per = (n + 3) // 4                  # 4 streams + 6-byte jump table
+    chunks = [literals[0:per], literals[per:2 * per],
+              literals[2 * per:3 * per], literals[3 * per:]]
+    if not chunks[3]:
+        return None                     # stream 4 must be non-empty
+    streams = [enc_stream(c) for c in chunks]
+    if any(len(s) > 0xFFFF for s in streams[:3]):
+        return None
+    jump = struct.pack("<HHH", *(len(s) for s in streams[:3]))
+    comp = len(tree) + 6 + sum(len(s) for s in streams)
+    if comp >= n:
+        return None
+    if n <= 16383 and comp <= 16383:    # size_format 2: 14-bit sizes
+        head = (ltype | (2 << 2) | (n << 4) | (comp << 18)).to_bytes(
+            4, "little")
+    else:                               # size_format 3: 18-bit sizes
+        head = (ltype | (3 << 2) | (n << 4) | (comp << 22)).to_bytes(
+            5, "little")
+    return head + tree + jump + b"".join(streams)
+
+
+def _huf_literals_section(literals: bytes, plan=None, prev=None):
+    """Huffman literals section — (bytes, tree_info) where tree_info
+    is ("fresh", lengths) for a type-2 section (the decoder keeps its
+    tree for later treeless reuse), "treeless" for type 3, or the
+    pair (None, None) when Huffman can't be used or doesn't pay.
+    ``prev`` is the (lengths) of the frame's last shipped tree: when
+    it covers this section's bytes and codes them more cheaply than
+    a fresh tree + description, the section ships TREELESS."""
+    n = len(literals)
+    if plan is None:
+        plan = _huf_plan(literals)
+    if plan is None:
+        return None, None
+    lengths, fresh_bits, _, freqs = plan
+    max_sym = max(lengths)
+    codes, maxbits = _huf_codes(lengths)
     nw = max_sym                        # weights 0..max_sym-1; last inferred
     weights = [maxbits + 1 - lengths[s] if s in lengths else 0
                for s in range(nw)]
@@ -707,63 +764,51 @@ def _huf_literals_section(literals: bytes, plan=None):
         if fse_tree is not None and (tree is None
                                      or len(fse_tree) < len(tree)):
             tree = fse_tree
-    if tree is None:
-        return None
-
-    def enc_stream(chunk):
-        w = _BitWriter()
-        for b in reversed(chunk):
-            c, ln = codes[b]
-            w.push(c, ln)
-        return w.finish()
-
-    if n <= 1023:                       # 1 stream, 10-bit sizes
-        stream = enc_stream(literals)
-        comp = len(tree) + len(stream)
-        if comp >= n or comp > 1023:
-            return None
-        head = (2 | (n << 4) | (comp << 14)).to_bytes(3, "little")
-        return head + tree + stream
-    per = (n + 3) // 4                  # 4 streams + 6-byte jump table
-    chunks = [literals[0:per], literals[per:2 * per],
-              literals[2 * per:3 * per], literals[3 * per:]]
-    if not chunks[3]:
-        return None                     # stream 4 must be non-empty
-    streams = [enc_stream(c) for c in chunks]
-    if any(len(s) > 0xFFFF for s in streams[:3]):
-        return None
-    jump = struct.pack("<HHH", *(len(s) for s in streams[:3]))
-    comp = len(tree) + 6 + sum(len(s) for s in streams)
-    if comp >= n:
-        return None
-    if n <= 16383 and comp <= 16383:    # size_format 2: 14-bit sizes
-        head = (2 | (2 << 2) | (n << 4) | (comp << 18)).to_bytes(
-            4, "little")
-    else:                               # size_format 3: 18-bit sizes
-        head = (2 | (3 << 2) | (n << 4) | (comp << 22)).to_bytes(
-            5, "little")
-    return head + tree + jump + b"".join(streams)
+    best = None
+    info = None
+    if tree is not None:
+        best = _huf_section_bytes(literals, codes, tree, 2)
+        if best is not None:
+            info = ("fresh", lengths)
+    if prev is not None and all(s in prev for s in freqs):
+        # estimated treeless bits vs the fresh tree+stream total
+        prev_bits = sum(freqs[s] * prev[s] for s in freqs)
+        fresh_total = (len(tree) * 8 + fresh_bits) if tree is not None \
+            else None
+        if fresh_total is None or prev_bits < fresh_total:
+            pcodes, _ = _huf_codes(prev)
+            tl = _huf_section_bytes(literals, pcodes, b"", 3)
+            if tl is not None and (best is None or len(tl) < len(best)):
+                best, info = tl, "treeless"
+    if best is None:
+        return None, None
+    return best, info
 
 
-def _lit_section(literals: bytes, plan=None) -> bytes:
-    """Smallest literals section: raw, RLE, or Huffman-compressed."""
+def _lit_section(literals: bytes, plan=None, prev=None):
+    """Smallest literals section: raw, RLE, or Huffman-compressed
+    (fresh tree or treeless reuse of ``prev``).  Returns
+    (bytes, tree_info) — tree_info as _huf_literals_section (None for
+    raw/RLE sections, which don't touch the decoder's tree)."""
     ln = len(literals)
     if ln and ln == literals.count(literals[:1]):   # single repeated byte
         if ln < 32:
-            return bytes([0x01 | (ln << 3)]) + literals[:1]
+            return bytes([0x01 | (ln << 3)]) + literals[:1], None
         if ln < 4096:
             return (0x01 | 0x04 | (ln << 4)).to_bytes(2, "little") \
-                + literals[:1]
+                + literals[:1], None
         return (0x01 | 0x0C | (ln << 4)).to_bytes(3, "little") \
-            + literals[:1]
+            + literals[:1], None
     if ln < 32:
         raw = bytes([ln << 3]) + literals
     elif ln < 4096:
         raw = (0x04 | (ln << 4)).to_bytes(2, "little") + literals
     else:
         raw = (0x0C | (ln << 4)).to_bytes(3, "little") + literals
-    huf = _huf_literals_section(literals, plan=plan)
-    return huf if huf is not None and len(huf) < len(raw) else raw
+    huf, info = _huf_literals_section(literals, plan=plan, prev=prev)
+    if huf is not None and len(huf) < len(raw):
+        return huf, info
+    return raw, None
 
 
 def _table_bits(hist: dict, norm, log: int):
@@ -861,6 +906,14 @@ def _find_sequences(buf: bytes, start: int = 0, end: int = -1,
     return seqs, bytes(lits), bytes(buf[anchor:end])
 
 
+def _commit_lit(tstate, info) -> None:
+    """Mirror the decoder's literal-tree state: a shipped type-2
+    section replaces the frame tree; treeless/raw/RLE leave it."""
+    if tstate is not None and isinstance(info, tuple) \
+            and info[0] == "fresh":
+        tstate["huf"] = info[1]
+
+
 def _compress_block(data: bytes, start: int = 0, end: int = -1,
                     rep=None, table=None, tstate=None):
     """One compressed block body (literals + sequences sections), or
@@ -895,10 +948,14 @@ def _compress_block(data: bytes, start: int = 0, end: int = -1,
     if nseq >= 0x7F00:
         return None
     literals = lits + tail
-    lhead = _lit_section(literals)
+    ts = tstate if tstate is not None else {}
+    lhead, linfo = _lit_section(literals, prev=ts.get("huf"))
     if not nseq:                        # literals ARE the whole block
         body = lhead + b"\x00"
-        return body if len(body) < len(block) else None
+        if len(body) < len(block):
+            _commit_lit(tstate, linfo)  # compressed block: its type-2
+            return body                 # tree becomes the frame tree
+        return None
     if nseq < 128:
         shead = bytes([nseq])
     else:
@@ -948,7 +1005,6 @@ def _compress_block(data: bytes, start: int = 0, end: int = -1,
     for triple in codes:
         for t, c in enumerate(triple):
             hists[t][c] = hists[t].get(c, 0) + 1
-    ts = tstate if tstate is not None else {}
     ll_m, ll_norm, ll_log, ll_desc = _seq_table_choice(
         hists[0], _LL_NORM, 6, 9, 36, prev=ts.get("ll"))
     of_m, of_norm, of_log, of_desc = _seq_table_choice(
@@ -993,11 +1049,16 @@ def _compress_block(data: bytes, start: int = 0, end: int = -1,
     plan = _huf_plan(block)
     est = _huf_estimate(plan, len(block))
     if est is not None and est + 1 < len(body):
-        flat = _lit_section(block, plan=plan) + b"\x00"
+        fsec, finfo = _lit_section(block, plan=plan, prev=ts.get("huf"))
+        flat = fsec + b"\x00"
         if len(flat) < len(body):
-            # literals-only block: no sequences execute, history
-            # stays untouched
-            return flat if len(flat) < len(block) else None
+            # literals-only block: no sequences execute; rep and the
+            # sequence tables stay untouched, but a shipped type-2
+            # literal tree still becomes the frame tree
+            if len(flat) < len(block):
+                _commit_lit(tstate, finfo)
+                return flat
+            return None
     if len(body) < len(block):
         if rep is not None:
             rep[:] = nrep               # commit: this body ships
@@ -1008,6 +1069,7 @@ def _compress_block(data: bytes, start: int = 0, end: int = -1,
             tstate["ll"] = (ll_norm, ll_log)
             tstate["of"] = (of_norm, of_log)
             tstate["ml"] = (ml_norm, ml_log)
+        _commit_lit(tstate, linfo)
         return body
     return None
 
@@ -1359,6 +1421,17 @@ def compress_frame(data: bytes) -> bytes:
     for i in range(0, n, _BLOCK_MAX):   # matches up to _LZ_WINDOW back
         blk = data[i:i + _BLOCK_MAX]
         last = 1 if i + _BLOCK_MAX >= n else 0
+        if blk.count(blk[0]) == len(blk):
+            # whole block one repeated byte: RLE block type (4 bytes
+            # total).  Executes no sequences and parses no tables, so
+            # rep/tstate stay untouched — but the LZ table must still
+            # index these positions or a later block can't match into
+            # this run
+            _find_sequences(data, i, i + len(blk), table)
+            bh = (len(blk) << 3) | 0x02 | last
+            out.append(struct.pack("<I", bh)[:3])
+            out.append(blk[:1])
+            continue
         body = _compress_block(data, i, i + len(blk), rep, table,
                                tstate)
         if body is None:

@@ -586,3 +586,38 @@ def test_lz_window_history_survives_high_entropy_prefix():
     assert zstd._py_store_decompress(frame) == data
     if _syszstd() is not None:
         assert _ref_decompress(frame, len(data)) == data
+
+
+def test_treeless_literals_and_rle_blocks_emitted():
+    """The last two encode-side constructs: a stable literal
+    distribution across blocks ships later sections TREELESS (type 3,
+    zero tree bytes), and an all-one-byte block ships as the RLE
+    block type (4 bytes total).  All decoders accept."""
+    random.seed(44)
+    stable = bytes(random.choice(b"etaoinshrdlucmfwyp,. ")
+                   for _ in range(400_000))
+    frame = zstd.compress_frame(stable)
+    # scan literal section types across blocks
+    pos = 4
+    fhd = frame[pos]
+    pos += 1 + (1, 2, 4, 8)[fhd >> 6]
+    ltypes = []
+    while True:
+        bh = int.from_bytes(frame[pos:pos + 3], "little")
+        pos += 3
+        last, btype, bsize = bh & 1, (bh >> 1) & 3, bh >> 3
+        if btype == 2:
+            ltypes.append(frame[pos] & 3)
+        pos += bsize if btype != 1 else 1
+        if last:
+            break
+    assert 3 in ltypes, ltypes              # treeless reuse happened
+    assert zstd._py_store_decompress(frame) == stable
+    if _syszstd() is not None:
+        assert _ref_decompress(frame, len(stable)) == stable
+    rle = b"\x07" * 300_000
+    f2 = zstd.compress_frame(rle)
+    assert len(f2) < 32                     # RLE block type, not huffman
+    assert zstd._py_store_decompress(f2) == rle
+    if _syszstd() is not None:
+        assert _ref_decompress(f2, len(rle)) == rle
