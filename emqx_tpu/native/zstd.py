@@ -764,24 +764,40 @@ def _huf_literals_section(literals: bytes, plan=None, prev=None):
         if fse_tree is not None and (tree is None
                                      or len(fse_tree) < len(tree)):
             tree = fse_tree
-    best = None
-    info = None
-    if tree is not None:
-        best = _huf_section_bytes(literals, codes, tree, 2)
-        if best is not None:
-            info = ("fresh", lengths)
+    # choose by ESTIMATE first, then encode only the winner (the
+    # per-byte bit-pushing dominates encode cost — building both
+    # sections would double it on exactly the stable-distribution
+    # workload treeless targets); fall back to the loser only if the
+    # winner's section doesn't fit its header formats
+    prev_bits = None
     if prev is not None and all(s in prev for s in freqs):
-        # estimated treeless bits vs the fresh tree+stream total
         prev_bits = sum(freqs[s] * prev[s] for s in freqs)
-        fresh_total = (len(tree) * 8 + fresh_bits) if tree is not None \
-            else None
-        if fresh_total is None or prev_bits < fresh_total:
-            pcodes, _ = _huf_codes(prev)
-            tl = _huf_section_bytes(literals, pcodes, b"", 3)
-            if tl is not None and (best is None or len(tl) < len(best)):
-                best, info = tl, "treeless"
-    if best is None:
-        return None, None
+    fresh_total = (len(tree) * 8 + fresh_bits) if tree is not None \
+        else None
+
+    def fresh_section():
+        if tree is None:
+            return None, None
+        sec = _huf_section_bytes(literals, codes, tree, 2)
+        return (sec, ("fresh", lengths)) if sec is not None \
+            else (None, None)
+
+    def treeless_section():
+        if prev_bits is None:
+            return None, None
+        pcodes, _ = _huf_codes(prev)
+        sec = _huf_section_bytes(literals, pcodes, b"", 3)
+        return (sec, "treeless") if sec is not None else (None, None)
+
+    if prev_bits is not None and (fresh_total is None
+                                  or prev_bits < fresh_total):
+        best, info = treeless_section()
+        if best is None:
+            best, info = fresh_section()
+    else:
+        best, info = fresh_section()
+        if best is None:
+            best, info = treeless_section()
     return best, info
 
 
