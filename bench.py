@@ -350,6 +350,75 @@ def bench_config1(n_clients: int = 1000, rate_per_client: float = 10.0,
     }
 
 
+def bench_fanout_e2e(n_pub: int = 16, n_sub: int = 32, duration: float = 6.0,
+                     qos: int = 1, inflight: int = 32) -> dict:
+    """Publish→deliver pipeline A/B (CPU mode, host-path routing): the
+    SAME fan-out workload — ``n_pub`` unpaced QoS1 publishers with a
+    pipelined-ack window, ``n_sub`` wildcard (``bench/#``) subscribers so
+    every publish fans out ``n_sub`` ways (the telemetry-broadcast shape
+    where broker-side processing dominates) — through the per-message
+    path and through the batched fanout pipeline
+    (``broker.fanout.enable``).  Both runs drive the broker with lean
+    template publishers and counting subscribers so the A/B measures
+    broker capacity, not loadgen overhead.  Reports both runs and the
+    delivered-msgs/s ratio.  delivery_ratio is received /
+    (sent × n_sub): 1.0 means no fan-out leg was dropped."""
+    import asyncio as aio
+
+    from emqx_tpu.bench_client import run_scenario
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    async def run_one(fanout: bool):
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            + ('broker.fanout.enable = true\n' if fanout else '')
+        ))
+        cfg.put("tpu.enable", False)   # host-path e2e: no device drag
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            out = await run_scenario(
+                "pub", port=node.listeners.all()[0].port,
+                count=n_pub, rate=0.0, subscribers=n_sub,
+                topic="bench/%i", sub_topic="bench/#", sub_qos=0,
+                qos=qos, payload_size=64, duration=duration,
+                inflight=inflight, lean_subs=True, lean_pubs=True)
+        finally:
+            await node.stop()
+        return out
+
+    def shape(s: dict) -> dict:
+        lat = s.get("latency_us") or {}
+        sent = s.get("sent") or 0
+        return {
+            "sent": sent,
+            "received": s.get("received"),
+            "msgs_per_s": s.get("recv_rate"),
+            "delivery_ratio": round((s.get("received") or 0)
+                                    / max(1, sent * n_sub), 4),
+            "e2e_p50_us": lat.get("p50"),
+            "e2e_p99_us": lat.get("p99"),
+        }
+
+    per_msg = shape(aio.run(run_one(False)))
+    pipeline = shape(aio.run(run_one(True)))
+    return {
+        "workload": {"publishers": n_pub, "subscribers": n_sub,
+                     "fanout": n_sub, "qos": qos, "sub_qos": 0,
+                     "inflight": inflight, "duration_s": duration},
+        "per_message": per_msg,
+        "pipeline": pipeline,
+        "speedup": round((pipeline["msgs_per_s"] or 0.0)
+                         / max(1e-9, per_msg["msgs_per_s"] or 0.0), 2),
+    }
+
+
+def _fanout_e2e_size(smoke: bool) -> dict:
+    return ({"n_pub": 8, "n_sub": 8, "duration": 2.0} if smoke
+            else {"n_pub": 16, "n_sub": 32, "duration": 6.0})
+
+
 def _config1_size(smoke: bool) -> dict:
     """One definition for both call sites (full + device-unreachable):
     diverging sizes would silently measure different workloads under
@@ -630,6 +699,7 @@ def main():
         table, kind, build_s = build_table(filters, args.depth)
         cpu = bench_cpu_native(table, topics, args.cpu_budget_s)
         c1 = bench_config1(**_config1_size(args.smoke))
+        fe = bench_fanout_e2e(**_fanout_e2e_size(args.smoke))
         # the most recent full on-chip run is checked into the repo so a
         # tunnel outage at bench time (recurring: 2026-07-29, -30) does
         # not erase the measured result — clearly labeled as such
@@ -681,6 +751,7 @@ def main():
                    for k, v in cpu.items()},
             },
             "config1_broker_e2e": c1,
+            "fanout_e2e": fe,
         }))
         return
 
@@ -701,6 +772,10 @@ def main():
     c1 = bench_config1(**_config1_size(args.smoke))
     note(f"config1 broker e2e done: {c1['msgs_per_s']}/s "
          f"p99={c1['e2e_p99_us']}us")
+    fe = bench_fanout_e2e(**_fanout_e2e_size(args.smoke))
+    note(f"fanout e2e done: per-message {fe['per_message']['msgs_per_s']}/s"
+         f" vs pipeline {fe['pipeline']['msgs_per_s']}/s"
+         f" ({fe['speedup']}x)")
 
     dev, tpu = bench_device(table, topics, args.batch, args.iters,
                             args.depth, args.active_slots)
@@ -842,6 +917,7 @@ def main():
         "serve_cpu_iso": serve_cpu,
         "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,
+        "fanout_e2e": fe,
         "delta": deltas,
     }
     print(json.dumps(result))

@@ -23,8 +23,10 @@ import time
 from typing import Any, Dict, List, Optional
 
 from .client import Client
+from .mqtt import frame as F
+from .mqtt import packet as P
 
-__all__ = ["run_scenario", "BenchStats", "main"]
+__all__ = ["run_scenario", "BenchStats", "LeanSub", "main"]
 
 
 class BenchStats:
@@ -62,6 +64,214 @@ class BenchStats:
 
 def _topic_of(pattern: str, i: int) -> str:
     return pattern.replace("%i", str(i))
+
+
+class LeanSub:
+    """Minimal counting subscriber for broker-capacity A/Bs.
+
+    A full :class:`Client` pays ~4 Python frames, an ``InboundMessage``
+    and an ``asyncio.Queue`` hop per received PUBLISH — at fan-out 8+
+    the harness outweighs the broker under test and every path measures
+    the same loadgen ceiling.  This subscriber handshakes through the
+    real codec (CONNECT/SUBSCRIBE via :func:`frame.serialize`), then
+    counts QoS0 PUBLISH frames with an inline fixed-header scanner and
+    samples e2e latency from every ``sample``-th payload timestamp, so
+    the receive side costs ~1 frame per TCP read instead of per message.
+    """
+
+    def __init__(self, clientid: str, host: str, port: int,
+                 sample: int = 16) -> None:
+        self.clientid = clientid
+        self.host = host
+        self.port = port
+        self.sample = sample
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._parser = F.Parser()
+
+    async def _read_pkt(self, want: int):
+        while True:
+            data = await self._reader.read(65536)
+            if not data:
+                raise ConnectionError("closed during handshake")
+            for pkt in self._parser.feed(data):
+                if pkt.type == want:
+                    return pkt
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port)
+        from .transport.connection import set_nodelay
+        set_nodelay(self._writer.get_extra_info("socket"))
+        self._writer.write(F.serialize(P.Connect(
+            proto_ver=4, clientid=self.clientid, clean_start=True,
+            keepalive=0)))
+        pkt = await asyncio.wait_for(self._read_pkt(P.CONNACK), 10.0)
+        if pkt.reason_code != 0:
+            raise ConnectionError(f"CONNACK refused rc={pkt.reason_code}")
+
+    async def subscribe(self, flt: str) -> None:
+        self._writer.write(F.serialize(P.Subscribe(
+            packet_id=1, topic_filters=[(flt, {"qos": 0})])))
+        await asyncio.wait_for(self._read_pkt(P.SUBACK), 10.0)
+
+    async def drain(self, stats: "BenchStats") -> None:
+        """Count PUBLISH frames until cancelled/EOF.  QoS0-only (the
+        granted QoS of the bench subscription); other packet types are
+        skipped by remaining-length."""
+        reader = self._reader
+        buf = b""
+        recv = 0
+        sample = self.sample
+        unpack_from = struct.unpack_from
+        perf = time.perf_counter
+        lat = stats.latencies_us
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    return
+                mv = buf + data if buf else data
+                i, n = 0, len(mv)
+                now = perf()
+                while n - i >= 2:
+                    b1 = mv[i]
+                    rl = mv[i + 1]
+                    j = i + 2
+                    if rl & 0x80:       # multi-byte remaining length
+                        rl &= 0x7F
+                        shift = 7
+                        while True:
+                            if j >= n:
+                                rl = -1
+                                break
+                            b = mv[j]
+                            j += 1
+                            rl |= (b & 0x7F) << shift
+                            if not (b & 0x80):
+                                break
+                            shift += 7
+                        if rl < 0:
+                            break
+                    if j + rl > n:
+                        break
+                    if (b1 & 0xF0) == 0x30:
+                        recv += 1
+                        if recv % sample == 0:
+                            off = j + 2 + ((mv[j] << 8) | mv[j + 1])
+                            if b1 & 0x06:   # qos>0: skip packet id
+                                off += 2
+                            if j + rl - off >= 8:
+                                (t_send,) = unpack_from("<d", mv, off)
+                                lat.append((now - t_send) * 1e6)
+                    i = j + rl
+                stats.received += recv
+                recv = 0
+                buf = mv[i:] if i < n else b""
+        except (asyncio.CancelledError, ConnectionError):
+            stats.received += recv
+
+    async def disconnect(self) -> None:
+        try:
+            self._writer.write(F.serialize(P.Disconnect()))
+            self._writer.close()
+        except Exception:
+            pass
+
+
+class LeanPub(LeanSub):
+    """Minimal pipelined-QoS1 publisher: one pre-built PUBLISH frame
+    template per client, patched in place (packet id + payload
+    timestamp) per message, with PUBACKs counted by the same inline
+    scanner — the publish side of the broker-capacity A/B costs two
+    ``pack_into`` and one write per message instead of a dataclass,
+    a serializer pass and a pending-future per message."""
+
+    async def run(self, topic: str, payload_size: int, inflight: int,
+                  end: float, stats: "BenchStats") -> None:
+        tb = topic.encode()
+        rl = 2 + len(tb) + 2 + max(payload_size, 8)
+        head = bytes([0x32]) + F._enc_varint(rl) + struct.pack(
+            ">H", len(tb)) + tb
+        pid_off = len(head)
+        ts_off = pid_off + 2
+        frame = bytearray(head + b"\x00" * (2 + 8)
+                          + b"x" * (max(payload_size, 8) - 8))
+        writer = self._writer
+        pack_into = struct.pack_into
+        perf = time.perf_counter
+        self._acked = 0
+        self._ack_evt = asyncio.Event()
+        ack_task = asyncio.ensure_future(self._ack_loop())
+        sent = 0
+        pid = 0
+        try:
+            while perf() < end:
+                if sent - self._acked >= inflight:
+                    self._ack_evt.clear()
+                    try:
+                        await asyncio.wait_for(
+                            self._ack_evt.wait(), timeout=5.0)
+                    except (asyncio.TimeoutError, TimeoutError):
+                        return  # broker stalled: stop offering
+                    continue
+                pid = (pid % 65535) + 1
+                pack_into(">H", frame, pid_off, pid)
+                pack_into("<d", frame, ts_off, perf())
+                writer.write(bytes(frame))
+                sent += 1
+                stats.sent += 1
+                if not sent % inflight:
+                    await asyncio.sleep(0)  # loop fairness between refills
+            # drain outstanding acks so sent≈acked at summary time
+            t_end = perf() + 5.0
+            while self._acked < sent and perf() < t_end:
+                self._ack_evt.clear()
+                try:
+                    await asyncio.wait_for(self._ack_evt.wait(),
+                                           timeout=t_end - perf())
+                except (asyncio.TimeoutError, TimeoutError):
+                    break
+        finally:
+            ack_task.cancel()
+
+    async def _ack_loop(self) -> None:
+        reader = self._reader
+        buf = b""
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    return
+                mv = buf + data if buf else data
+                i, n = 0, len(mv)
+                while n - i >= 2:
+                    rl = mv[i + 1]
+                    j = i + 2
+                    if rl & 0x80:
+                        rl &= 0x7F
+                        shift = 7
+                        while True:
+                            if j >= n:
+                                rl = -1
+                                break
+                            b = mv[j]
+                            j += 1
+                            rl |= (b & 0x7F) << shift
+                            if not (b & 0x80):
+                                break
+                            shift += 7
+                        if rl < 0:
+                            break
+                    if j + rl > n:
+                        break
+                    if (mv[i] & 0xF0) == 0x40:   # PUBACK
+                        self._acked += 1
+                    i = j + rl
+                self._ack_evt.set()
+                buf = mv[i:] if i < n else b""
+        except (asyncio.CancelledError, ConnectionError):
+            pass
 
 
 async def _connect_group(
@@ -107,6 +317,14 @@ async def run_scenario(
     subscribers: int = 0,       # pub: also start in-process subscribers for e2e latency
     clean_start: bool = True,
     inflight: int = 0,          # pub qos1: pipelined-ack window (0 = serial)
+    sub_topic: Optional[str] = None,  # pub: subscriber filter pattern
+                                      # (defaults to `topic`; "bench/#"
+                                      # turns the pairwise workload into
+                                      # an n_sub-way fan-out)
+    sub_qos: Optional[int] = None,    # pub: subscriber granted QoS
+    lean_subs: bool = False,          # pub: LeanSub counting subscribers
+    lean_pubs: bool = False,          # pub: LeanPub template publishers
+                                      # (qos1 + inflight window only)
 ) -> Dict[str, Any]:
     stats = BenchStats()
 
@@ -135,15 +353,15 @@ async def run_scenario(
                 if left <= 0:
                     return
                 try:
-                    m = await c.recv(timeout=left)
+                    msgs = await c.recv_many(timeout=left)
                 except (asyncio.TimeoutError, TimeoutError):
                     return
-                stats.received += 1
-                if len(m.payload) >= 8:
-                    (t_send,) = struct.unpack_from("<d", m.payload)
-                    stats.latencies_us.append(
-                        (time.perf_counter() - t_send) * 1e6
-                    )
+                stats.received += len(msgs)
+                now = time.perf_counter()
+                for m in msgs:
+                    if len(m.payload) >= 8:
+                        (t_send,) = struct.unpack_from("<d", m.payload)
+                        stats.latencies_us.append((now - t_send) * 1e6)
 
         await asyncio.gather(*(drain(c) for c in clients))
         out = stats.summary()
@@ -151,31 +369,76 @@ async def run_scenario(
         return out
 
     if scenario == "pub":
-        subs: List[Client] = []
+        subs: List[Any] = []
         if subscribers:
-            subs = await _connect_group(
-                subscribers, host, port, "bench_psub_", 0.0, stats,
-                keepalive=300,
-            )
-            await asyncio.gather(
-                *(c.subscribe(_topic_of(topic, i), qos=qos)
-                  for i, c in enumerate(subs))
-            )
-
-            async def drain(c: Client):
-                while True:
+            stopic = sub_topic if sub_topic is not None else topic
+            sqos = sub_qos if sub_qos is not None else qos
+            if lean_subs and sqos == 0:
+                for i in range(subscribers):
+                    s = LeanSub(f"bench_psub_{i}", host, port)
                     try:
-                        m = await c.recv(timeout=duration + 5)
-                    except (asyncio.TimeoutError, TimeoutError):
-                        return
-                    stats.received += 1
-                    if len(m.payload) >= 8:
-                        (t_send,) = struct.unpack_from("<d", m.payload)
-                        stats.latencies_us.append(
-                            (time.perf_counter() - t_send) * 1e6
-                        )
+                        await s.connect()
+                        stats.connected += 1
+                        subs.append(s)
+                    except Exception:
+                        stats.connect_failures += 1
+                await asyncio.gather(
+                    *(s.subscribe(_topic_of(stopic, i))
+                      for i, s in enumerate(subs))
+                )
+                drainers = [asyncio.ensure_future(s.drain(stats))
+                            for s in subs]
+            else:
+                subs = await _connect_group(
+                    subscribers, host, port, "bench_psub_", 0.0, stats,
+                    keepalive=300,
+                )
+                await asyncio.gather(
+                    *(c.subscribe(_topic_of(stopic, i), qos=sqos)
+                      for i, c in enumerate(subs))
+                )
 
-            drainers = [asyncio.ensure_future(drain(c)) for c in subs]
+                async def drain(c: Client):
+                    while True:
+                        try:
+                            msgs = await c.recv_many(timeout=duration + 5)
+                        except (asyncio.TimeoutError, TimeoutError):
+                            return
+                        stats.received += len(msgs)
+                        now = time.perf_counter()
+                        for m in msgs:
+                            if len(m.payload) >= 8:
+                                (t_send,) = struct.unpack_from(
+                                    "<d", m.payload)
+                                stats.latencies_us.append(
+                                    (now - t_send) * 1e6)
+
+                drainers = [asyncio.ensure_future(drain(c)) for c in subs]
+
+        if lean_pubs and qos == 1 and inflight > 0 and not messages:
+            lpubs: List[LeanPub] = []
+            for i in range(count):
+                lp = LeanPub(f"bench_pub_{i}", host, port)
+                try:
+                    await lp.connect()
+                    stats.connected += 1
+                    lpubs.append(lp)
+                except Exception:
+                    stats.connect_failures += 1
+            end = time.perf_counter() + duration
+            await asyncio.gather(
+                *(lp.run(_topic_of(topic, i), payload_size, inflight,
+                         end, stats)
+                  for i, lp in enumerate(lpubs))
+            )
+            if subscribers:
+                await asyncio.sleep(0.2)
+                for d in drainers:
+                    d.cancel()
+            out = stats.summary()
+            await asyncio.gather(
+                *(c.disconnect() for c in lpubs + subs))
+            return out
 
         pubs = await _connect_group(
             count, host, port, "bench_pub_", 0.0, stats, keepalive=300

@@ -14,6 +14,7 @@ from .inflight import Inflight, InflightFullError
 from .session import MAX_PACKET_ID, Publish, Session, SubOpts
 from .shared_sub import STRATEGIES, SharedSub
 from .broker import Broker, DeliverResult
+from .fanout import FanoutPipeline
 from .cm import ConnectionManager
 from .channel import Channel
 from .banned import Banned, BanEntry
@@ -26,7 +27,7 @@ __all__ = [
     "Message", "make_message", "Hooks", "HOOK_POINTS", "OK", "STOP",
     "MQueue", "Inflight", "InflightFullError",
     "MAX_PACKET_ID", "Publish", "Session", "SubOpts",
-    "STRATEGIES", "SharedSub", "Broker", "DeliverResult",
+    "STRATEGIES", "SharedSub", "Broker", "DeliverResult", "FanoutPipeline",
     "ConnectionManager", "Channel",
     "Banned", "BanEntry", "Flapping", "LimiterGroup", "TokenBucket", "Olp",
 ]

@@ -27,6 +27,7 @@ across nodes using the same tables.  The device NFA mirror subscribes to
 
 from __future__ import annotations
 
+import logging
 from dataclasses import replace
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -37,6 +38,8 @@ from .mqueue import MQueue
 from .router import Router
 from .session import Publish, Session, SubOpts
 from .shared_sub import SharedSub
+
+log = logging.getLogger(__name__)
 
 __all__ = ["Broker", "DeliverResult"]
 
@@ -88,6 +91,14 @@ class Broker:
         # precomputed routes list for a topic when a fresh (same-epoch)
         # device answer exists, None otherwise (host trie then serves)
         self.device_match = None       # (topic) -> Optional[List[Route]]
+        # batched publish→deliver pipeline (broker/fanout.py): set by the
+        # node when broker.fanout.enable is on; the channel offers hot-path
+        # publishes here and falls back to the sync publish() when refused
+        self.fanout = None             # Optional[FanoutPipeline]
+        # counter table, set by observe(); broker-internal drop accounting
+        # (outbox overflow) lands here when present
+        self.metrics = None
+        self._outbox_warned: set = set()  # clients already logged for drops
 
     # ------------------------------------------------------------------
     # session lifecycle (emqx_cm:open_session semantics, simplified here;
@@ -125,6 +136,7 @@ class Broker:
             self._drop_session_state(sess)
             del self.sessions[clientid]
             self.outbox.pop(clientid, None)
+            self._outbox_warned.discard(clientid)
             self.usernames.pop(clientid, None)
             self.hooks.run("session.terminated", (clientid,))
         else:
@@ -282,14 +294,12 @@ class Broker:
         if member is None:
             self.hooks.run("message.dropped", (msg, "shared_no_available"))
 
-    def _deliver_to(
-        self, clientid: str, opts: SubOpts, msg: Message, res: DeliverResult
-    ) -> bool:
-        """Returns True iff *this* message was accepted (sent or queued) —
-        a queue eviction of an older message is not a nack."""
-        sess = self.sessions.get(clientid)
-        if sess is None:
-            return False
+    @staticmethod
+    def _effective(msg: Message, opts: SubOpts) -> Message:
+        """The per-subscription view of a routed message: QoS capped at
+        the granted QoS, Retain-As-Published, Subscription-Identifier.
+        Returns ``msg`` itself when no transform applies, so a fan-out
+        shares one Message (and its payload) across subscribers."""
         eff = msg.with_qos(min(msg.qos, opts.qos))
         if not opts.rap:
             # Retain-As-Published off → clear retain flag on forward
@@ -299,10 +309,23 @@ class Broker:
             eff = eff.clone(
                 properties={**eff.properties, "Subscription-Identifier": opts.subid}
             )
+        return eff
+
+    def _deliver_to(
+        self, clientid: str, opts: SubOpts, msg: Message, res: DeliverResult
+    ) -> bool:
+        """Returns True iff *this* message was accepted (sent or queued) —
+        a queue eviction of an older message is not a nack."""
+        sess = self.sessions.get(clientid)
+        if sess is None:
+            return False
+        eff = self._effective(msg, opts)
         sends, dropped = sess.deliver([eff])
         if sends:
             res.matched += 1
             res.publishes.setdefault(clientid, []).extend(sends)
+            if self.metrics is not None:
+                self.metrics.inc("messages.delivered")
             self.hooks.run("message.delivered", (clientid, eff))
         for d in dropped:
             res.dropped.append((clientid, d))
@@ -367,6 +390,8 @@ class Broker:
         for d in dropped:
             self.hooks.run("message.dropped", (d, "queue_full"))
         if sends:
+            if self.metrics is not None:
+                self.metrics.inc("messages.delivered", len(sends))
             for pub in sends:   # only actually-sent messages, not queued
                 self.hooks.run("message.delivered", (clientid, pub.msg))
             self.emit(clientid, sends)
@@ -381,11 +406,24 @@ class Broker:
 
     def outbox_put(self, clientid: str, pubs: List[Publish]) -> None:
         """Capped outbox append — the single fallback path for deliveries
-        with no live connection."""
+        with no live connection.  Overflow evicts oldest-first, counted
+        in ``broker.outbox.dropped`` and logged once per client (a silent
+        drop here cost a round of debugging — VERDICT lineage)."""
         box = self.outbox.setdefault(clientid, [])
         box.extend(pubs)
-        if len(box) > self.OUTBOX_MAX:
-            del box[: len(box) - self.OUTBOX_MAX]
+        over = len(box) - self.OUTBOX_MAX
+        if over > 0:
+            del box[:over]
+            if self.metrics is not None:
+                self.metrics.inc("broker.outbox.dropped", over)
+            if clientid not in self._outbox_warned:
+                self._outbox_warned.add(clientid)
+                log.warning(
+                    "outbox overflow for %r: dropped %d oldest "
+                    "(cap %d; further drops counted in "
+                    "broker.outbox.dropped, logged once per client)",
+                    clientid, over, self.OUTBOX_MAX,
+                )
 
     def take_outbox(self, clientid: str) -> List[Publish]:
         return self.outbox.pop(clientid, [])

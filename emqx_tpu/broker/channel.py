@@ -383,13 +383,24 @@ class Channel:
         if getattr(pkt, "allow_publish", True) is False:
             # vetoed upstream (exhook advisory): ack normally, never route
             msg = msg.clone(headers={**msg.headers, "allow_publish": False})
+        # batched fanout pipeline (broker/fanout.py): the hot path offers
+        # the message and acks immediately — PUBACK/PUBREC mean "broker
+        # took responsibility", so acking before the batch flushes is
+        # spec-faithful (NO_MATCHING_SUBSCRIBERS is a MAY, §3.4.2.1).
+        # A refusal (disabled / low-rate bypass / overload) falls back to
+        # the synchronous per-message path unchanged.
+        fanout = self.broker.fanout
         if pkt.qos == 2:
             st = self.session.publish_qos2(pkt.packet_id, msg)
             if st == "full":
                 return [("send", P.PubAck(P.PUBREC, pkt.packet_id, P.RC.QUOTA_EXCEEDED))]
-            if st == "ok":
+            if st == "ok" and not (fanout is not None and fanout.offer(msg)):
                 self.broker.publish(msg)
             return [("send", P.PubAck(P.PUBREC, pkt.packet_id))]
+        if fanout is not None and fanout.offer(msg):
+            if pkt.qos == 1:
+                return [("send", P.PubAck(P.PUBACK, pkt.packet_id))]
+            return []
         res = self.broker.publish(msg)
         if pkt.qos == 1:
             rc = (

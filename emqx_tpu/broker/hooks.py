@@ -73,21 +73,31 @@ class Hooks:
         priority: int = 0,
         name: Optional[str] = None,
     ) -> None:
-        cbs = self._points.setdefault(point, [])
+        # copy-on-write: mutations install a NEW list, so run()/run_fold()
+        # iterate the chain they started with without a per-call copy —
+        # the delivered/dropped hooks fire once per fan-out leg, and the
+        # defensive list() showed up in wide-fanout profiles
+        cbs = list(self._points.get(point, ()))
         cb = _Callback(priority, next(self._seq), fn, name or getattr(fn, "__name__", "fn"))
         keys = [c.sort_key() for c in cbs]
         cbs.insert(bisect.bisect_right(keys, cb.sort_key()), cb)
+        self._points[point] = cbs
 
     def delete(self, point: str, fn_or_name) -> bool:
         cbs = self._points.get(point, [])
         for i, cb in enumerate(cbs):
             if cb.fn is fn_or_name or cb.name == fn_or_name:
-                del cbs[i]
+                self._points[point] = cbs[:i] + cbs[i + 1:]
                 return True
         return False
 
     def callbacks(self, point: str) -> List[str]:
         return [cb.name for cb in self._points.get(point, [])]
+
+    def has(self, point: str) -> bool:
+        """True iff any callback is registered — lets per-item hot loops
+        skip the dispatch (and its args tuple) entirely when idle."""
+        return bool(self._points.get(point))
 
     # ------------------------------------------------------------------
 
@@ -96,7 +106,7 @@ class Hooks:
         cbs = self._points.get(point)
         if not cbs:
             return OK          # empty chains are the hot-path common case
-        for cb in list(cbs):   # copy: callbacks may mutate the chain
+        for cb in cbs:         # safe: mutations replace the list (CoW)
             res = cb.fn(*args)
             if res is None:
                 continue
@@ -110,7 +120,7 @@ class Hooks:
         cbs = self._points.get(point)
         if not cbs:
             return acc
-        for cb in list(cbs):
+        for cb in cbs:         # safe: mutations replace the list (CoW)
             res = cb.fn(*args, acc)
             if res is None:
                 continue

@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import itertools
 import time
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
 __all__ = ["Message", "make_message"]
@@ -47,10 +47,20 @@ class Message:
 
     def with_qos(self, qos: int) -> "Message":
         # hot path: QoS already effective for most deliveries — no copy
-        return self if qos == self.qos else replace(self, qos=qos)
+        return self if qos == self.qos else self.clone(qos=qos)
 
     def clone(self, **kw) -> "Message":
-        return replace(self, **kw)
+        # dataclasses.replace() re-runs __init__ + field introspection —
+        # measured as the dominant cost of wide fan-outs.  A __dict__
+        # copy is ~4x cheaper; derived copies must not inherit the
+        # serialized-wire cache (transport layer) since any field change
+        # invalidates it.
+        m = Message.__new__(Message)
+        d = dict(self.__dict__)
+        d.pop("_wire", None)
+        d.update(kw)
+        m.__dict__ = d
+        return m
 
 
 def make_message(

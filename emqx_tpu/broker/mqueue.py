@@ -67,6 +67,34 @@ class MQueue:
         self._push(prio, msg)
         return None
 
+    def insert_many(self, msgs: List[Message]) -> List[Message]:
+        """Bulk :meth:`insert` — returns every dropped message.  The
+        fast path (no bound pressure, no priorities, QoS0 storable)
+        appends the whole run into one band without per-message method
+        dispatch; anything else falls through to ``insert`` per message
+        so drop policy stays identical."""
+        if not msgs:
+            return []
+        room = (self.max_len - self._len) if self.max_len > 0 else len(msgs)
+        if (
+            room >= len(msgs)
+            and not self.priorities
+            and (self.store_qos0 or all(m.qos != 0 for m in msgs))
+        ):
+            prio = self.default_priority
+            q = self._qs.get(prio)
+            if q is None:
+                q = self._qs[prio] = deque()
+            q.extend(msgs)
+            self._len += len(msgs)
+            return []
+        dropped: List[Message] = []
+        for m in msgs:
+            victim = self.insert(m)
+            if victim is not None:
+                dropped.append(victim)
+        return dropped
+
     def _push(self, prio: int, msg: Message) -> None:
         q = self._qs.get(prio)
         if q is None:

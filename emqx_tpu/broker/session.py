@@ -121,27 +121,44 @@ class Session:
 
         Returns (to_send, dropped): QoS0 always sends; QoS1/2 send while
         the inflight window has room, else queue; queue overflow drops.
+
+        Called once per session per batch by the fanout pipeline, so the
+        common whole-batch cases (all-QoS0 to a connected client, client
+        away) take amortized bulk paths instead of the per-message loop.
         """
+        if not self.connected:
+            # client away: everything queues (QoS0 subject to the
+            # mqueue's store_qos0 policy) and drains on resume
+            return [], self.mqueue.insert_many(msgs)
+        if all(m.qos == 0 for m in msgs):
+            # fanout hot path: no window/queue bookkeeping.  A QoS0
+            # Publish (pid None) is never retried or mutated, so every
+            # session fanning out the same routed Message shares ONE
+            # Publish object, cached on the message like its wire bytes.
+            out = []
+            append = out.append
+            for m in msgs:
+                d = m.__dict__
+                p = d.get("_pub0")
+                if p is None:
+                    p = d["_pub0"] = Publish(None, m)
+                append(p)
+            return out, []
         out: List[Publish] = []
         dropped: List[Message] = []
+        inflight = self.inflight
+        mqueue = self.mqueue
         for msg in msgs:
-            if not self.connected:
-                # client away: everything queues (QoS0 subject to the
-                # mqueue's store_qos0 policy) and drains on resume
-                victim = self.mqueue.insert(msg)
-                if victim is not None:
-                    dropped.append(victim)
-                continue
             if msg.qos == 0:
                 out.append(Publish(None, msg))
                 continue
-            if self.inflight.is_full():
-                victim = self.mqueue.insert(msg)
+            if inflight.is_full():
+                victim = mqueue.insert(msg)
                 if victim is not None:
                     dropped.append(victim)
                 continue
             pid = self.next_packet_id()
-            self.inflight.insert(pid, ("publish", msg))
+            inflight.insert(pid, ("publish", msg))
             out.append(Publish(pid, msg))
         return out, dropped
 

@@ -91,8 +91,14 @@ class SharedSub:
         balance across the whole cluster (two-level pick: the remote
         node's own shared table chooses the concrete client there)."""
         key = (group, flt)
-        members = [m for m in self._members.get(key, ()) if m not in exclude]
-        members += [m for m in extra if m not in exclude and m not in members]
+        members: Sequence[Tuple[str, str]] = self._members.get(key, ())
+        if exclude or extra:
+            # redispatch / cluster candidates: build the filtered view
+            members = [m for m in members if m not in exclude]
+            members += [m for m in extra
+                        if m not in exclude and m not in members]
+        # else: serve straight off the live list — one publish picks one
+        # member, so the fanout hot path never allocates here
         if not members:
             return None
         s = self.strategy

@@ -216,6 +216,20 @@ class Client:
             return self.messages.get_nowait()
         return await asyncio.wait_for(self.messages.get(), timeout)
 
+    async def recv_many(self, timeout: float = 10.0,
+                        max_n: int = 0) -> List["InboundMessage"]:
+        """Wait for at least one message, then drain everything already
+        queued (up to ``max_n``; 0 = unbounded).  One await per burst
+        instead of one per message — the consumer-side analog of the
+        broker's batched fanout flush."""
+        q = self.messages
+        out: List[InboundMessage] = []
+        if q.empty():
+            out.append(await asyncio.wait_for(q.get(), timeout))
+        while not q.empty() and (not max_n or len(out) < max_n):
+            out.append(q.get_nowait())
+        return out
+
     async def disconnect(self, reason_code: int = 0) -> None:
         if self._writer is not None and not self._writer.is_closing():
             try:

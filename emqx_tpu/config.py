@@ -136,6 +136,18 @@ SCHEMA: Dict[str, Field] = {
               "hash_topic", "local"),
     ),
     "broker.shared_dispatch_ack_enabled": Field(False, _bool),
+    # batched publish→deliver fanout pipeline (broker/fanout.py) —
+    # opt-in; the per-message path stays the default-on fallback
+    "broker.fanout.enable": Field(False, _bool),
+    "broker.fanout.max_batch": Field(2048, int, lambda v: v >= 1),
+    "broker.fanout.min_batch": Field(8, int, lambda v: v >= 1),
+    "broker.fanout.window": Field(0.0005, duration),
+    # adaptive sizing: one batch covers at most this much arrival time
+    "broker.fanout.adapt_window": Field(0.05, duration),
+    # publishes/s below which offers bypass to the per-message path
+    # (0 disables bypassing — batch even single publishes)
+    "broker.fanout.bypass_rate": Field(0.0, float, lambda v: v >= 0),
+    "broker.fanout.queue_cap": Field(65536, int, lambda v: v >= 1),
     "broker.sys_msg_interval": Field(60.0, duration),
     "broker.sys_heartbeat_interval": Field(30.0, duration),
     "broker.enable_session_registry": Field(True, _bool),
@@ -298,7 +310,8 @@ SCHEMA: Dict[str, Field] = {
     # -- TPU data plane (ours) --------------------------------------------
     "tpu.enable": Field(True, _bool),
     "tpu.max_levels": Field(16, int, lambda v: 1 <= v <= 64),
-    "tpu.batch_size": Field(4096, int, lambda v: v >= 1),
+    # measured serving sweet spot: 2048 (BENCH_r05 serve_device_quarter_batch)
+    "tpu.batch_size": Field(2048, int, lambda v: v >= 1),
     "tpu.batch_deadline": Field(0.0002, duration),
     "tpu.active_slots": Field(16, int),
     # 128 keeps the 10M fan-out tail on device (round-5 measurement in

@@ -104,8 +104,14 @@ int32_t enc_encode(void* h, const uint8_t* buf, int64_t buflen,
                 memchr(w, '/', tend - w));
             if (wend == nullptr) wend = tend;
             if (nlevels < depth) {
+#if defined(__cpp_lib_generic_unordered_lookup)
                 auto it = enc->vocab.find(
                     std::string_view(w, static_cast<size_t>(wend - w)));
+#else
+                // libstdc++ < 11: no heterogeneous unordered lookup
+                auto it = enc->vocab.find(
+                    std::string(w, static_cast<size_t>(wend - w)));
+#endif
                 row[nlevels] = (it != enc->vocab.end()) ? it->second : 0;
             }
             ++nlevels;

@@ -165,7 +165,12 @@ struct Nfa {
   }
 
   int32_t intern(std::string_view w) {
+#if defined(__cpp_lib_generic_unordered_lookup)
     auto it = vocab.find(w);
+#else
+    // libstdc++ < 11 lacks heterogeneous unordered lookup: pay the temp
+    auto it = vocab.find(std::string(w));
+#endif
     if (it != vocab.end()) return it->second;
     int32_t id = int32_t(vocab.size()) + 1;  // 0 reserved UNKNOWN
     vocab.emplace(std::string(w), id);
@@ -174,7 +179,11 @@ struct Nfa {
   }
 
   int32_t vocab_get(std::string_view w) const {
+#if defined(__cpp_lib_generic_unordered_lookup)
     auto it = vocab.find(w);
+#else
+    auto it = vocab.find(std::string(w));
+#endif
     return it == vocab.end() ? 0 : it->second;
   }
 

@@ -167,6 +167,7 @@ class BrokerNode:
         self.quic_port = 0
         self.cluster = None  # built lazily in start() (needs a loop)
         self.match_service = None  # in-process TPU matcher (start())
+        self.fanout_pipeline = None  # batched publish fanout (start())
         self.mgmt = None
         self.mgmt_server = None
         self.gateways = None  # GatewayManager, built in start()
@@ -533,7 +534,11 @@ class BrokerNode:
                 await self.cluster.prepare_connect(pkt)
             except Exception:
                 log.exception("cluster takeover stage failed")
-        if self.match_service is not None and pkt.type == P.PUBLISH:
+        if self.match_service is not None and pkt.type == P.PUBLISH \
+                and self.broker.fanout is None:
+            # with the fanout pipeline active the per-publish prefetch is
+            # redundant: the pipeline batch-prefetches every topic in a
+            # batch through ONE prefetch_many call at drain time
             try:
                 await self.match_service.prefetch(pkt.topic)
             except Exception:
@@ -578,6 +583,7 @@ class BrokerNode:
 
     async def start(self) -> None:
         await self._start_match_service()
+        await self._start_fanout()
         await self._start_cluster()
         await self._start_exhook()
         await self._start_mgmt()
@@ -783,6 +789,28 @@ class BrokerNode:
             log.exception("TPU match service unavailable; host trie serves")
             self.match_service = None
 
+    async def _start_fanout(self) -> None:
+        if not self.config.get("broker.fanout.enable"):
+            return
+        from .broker.fanout import FanoutPipeline
+
+        cfg = self.config
+        self.fanout_pipeline = FanoutPipeline(
+            self.broker,
+            metrics=self.observed.metrics,
+            match_service=self.match_service,
+            max_batch=cfg.get("broker.fanout.max_batch"),
+            min_batch=cfg.get("broker.fanout.min_batch"),
+            window_s=cfg.get("broker.fanout.window"),
+            adapt_window_s=cfg.get("broker.fanout.adapt_window"),
+            bypass_rate=cfg.get("broker.fanout.bypass_rate"),
+            queue_cap=cfg.get("broker.fanout.queue_cap"),
+        )
+        await self.fanout_pipeline.start()
+        self.broker.fanout = self.fanout_pipeline
+        self.observed.stats.provide(
+            "broker.fanout.depth", self.fanout_pipeline.depth)
+
     async def _start_mgmt(self) -> None:
         if not self.config.get("dashboard.enable"):
             return
@@ -906,6 +934,12 @@ class BrokerNode:
             self.quic.close()
             self.quic = None
         await self.bridges.stop_all()
+        if self.fanout_pipeline is not None:
+            # detach first so the drain-on-stop republishes (and any
+            # in-flight channel offers) take the sync path
+            self.broker.fanout = None
+            await self.fanout_pipeline.stop()
+            self.fanout_pipeline = None
         if self.match_service is not None:
             await self.match_service.stop()
             self.broker.device_match = None
@@ -1027,5 +1061,7 @@ class BrokerNode:
             if self.cluster is not None else [],
             "tpu_match": (self.match_service.info()
                           if self.match_service is not None else None),
+            "fanout": (self.fanout_pipeline.info()
+                       if self.fanout_pipeline is not None else None),
             **self.broker.stats(),
         }

@@ -16,7 +16,9 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional
 
-__all__ = ["Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES"]
+__all__ = [
+    "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
+]
 
 # -- the reference's fixed counter names, grouped as in emqx_metrics.erl [U]
 METRIC_NAMES: List[str] = [
@@ -85,6 +87,18 @@ TPU_METRIC_NAMES: List[str] = [
     "tpu.match.hint_evicted",
 ]
 
+# -- batched fanout pipeline (broker/fanout.py) + broker drop accounting.
+# batch_size/depth are last-observed values (set), the rest accumulate
+# (inc); avg batch = fanout.msgs / fanout.batches, avg flush =
+# fanout.flush_us / fanout.batches.
+FANOUT_METRIC_NAMES: List[str] = [
+    "broker.fanout.batches", "broker.fanout.msgs",
+    "broker.fanout.batch_size", "broker.fanout.flush_us",
+    "broker.fanout.depth", "broker.fanout.bypass",
+    "broker.fanout.overflow", "broker.fanout.fallback",
+    "broker.outbox.dropped",
+]
+
 
 class Metrics:
     """A counter table with the reference's fixed name set.
@@ -98,6 +112,7 @@ class Metrics:
     def __init__(self, extra: Optional[Iterable[str]] = None) -> None:
         self._c: Dict[str, int] = {n: 0 for n in METRIC_NAMES}
         self._c.update({n: 0 for n in TPU_METRIC_NAMES})
+        self._c.update({n: 0 for n in FANOUT_METRIC_NAMES})
         if extra:
             self._c.update({n: 0 for n in extra})
 
@@ -106,6 +121,13 @@ class Metrics:
 
     def dec(self, name: str, n: int = 1) -> None:
         self._c[name] -= n
+
+    def set(self, name: str, v: int) -> None:
+        """Last-observed-value metrics (batch_size, queue depth) share
+        the fixed table; unknown names still raise like inc."""
+        if name not in self._c:
+            raise KeyError(name)
+        self._c[name] = v
 
     def get(self, name: str) -> int:
         return self._c[name]

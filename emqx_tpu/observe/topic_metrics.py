@@ -24,6 +24,8 @@ class TopicMetrics:
     def __init__(self, max_topics: int = MAX_TOPICS) -> None:
         self.max_topics = max_topics
         self._m: Dict[str, Dict[str, Any]] = {}
+        self._hooks = None
+        self._taps_on = False
 
     # -- registry -----------------------------------------------------------
 
@@ -45,10 +47,14 @@ class TopicMetrics:
             "messages.qos2.in": 0, "messages.dropped": 0,
             "_win_start": time.time(), "_win_in": 0, "rate.in": 0.0,
         }
+        self._sync_taps()
         return self.info(topic)
 
     def deregister(self, topic: str) -> bool:
-        return self._m.pop(topic, None) is not None
+        ok = self._m.pop(topic, None) is not None
+        if ok:
+            self._sync_taps()
+        return ok
 
     def reset(self, topic: Optional[str] = None) -> bool:
         """Zero one topic's counters (or all when topic is None);
@@ -113,10 +119,27 @@ class TopicMetrics:
         return [self.info(t) for t in self.topics()]
 
     def attach(self, broker: Any) -> "TopicMetrics":
-        broker.hooks.add("message.publish", self.on_publish,
-                         name="topic_metrics.in")
-        broker.hooks.add("message.delivered", self.on_delivered,
-                         name="topic_metrics.out")
-        broker.hooks.add("message.dropped", self.on_dropped,
-                         name="topic_metrics.dropped")
+        self._hooks = broker.hooks
+        self._sync_taps()
         return self
+
+    def _sync_taps(self) -> None:
+        """The taps ride the publish/deliver hot path (delivered fires
+        per fan-out leg), so they exist only while a topic is
+        registered — a broker with no tracked topics pays nothing."""
+        hooks = self._hooks
+        if hooks is None:
+            return
+        if self._m and not self._taps_on:
+            hooks.add("message.publish", self.on_publish,
+                      name="topic_metrics.in")
+            hooks.add("message.delivered", self.on_delivered,
+                      name="topic_metrics.out")
+            hooks.add("message.dropped", self.on_dropped,
+                      name="topic_metrics.dropped")
+            self._taps_on = True
+        elif not self._m and self._taps_on:
+            hooks.delete("message.publish", "topic_metrics.in")
+            hooks.delete("message.delivered", "topic_metrics.out")
+            hooks.delete("message.dropped", "topic_metrics.dropped")
+            self._taps_on = False

@@ -104,6 +104,7 @@ class TraceManager:
         data_dir = (node.config.get("node.data_dir") or "").strip() or "."
         self.dir = trace_dir or os.path.join(data_dir, "trace")
         self.traces: Dict[str, Trace] = {}
+        self._message_taps_on = False
         self._attach(node.broker)
 
     # -- lifecycle ---------------------------------------------------------
@@ -127,6 +128,7 @@ class TraceManager:
         tr = Trace(name, type_, value,
                    os.path.join(self.dir, f"{name}.jsonl"), start, end)
         self.traces[name] = tr
+        self._sync_message_taps()
         return tr
 
     def stop(self, name: str) -> bool:
@@ -141,6 +143,7 @@ class TraceManager:
         if tr is None:
             return False
         tr.stop()
+        self._sync_message_taps()
         try:
             os.unlink(tr.path)
         except OSError:
@@ -204,37 +207,56 @@ class TraceManager:
             "unsubscribe", cid, flt, None,
             {"clientid": cid, "topic": flt}),
             priority=-99, name="trace.unsubscribed")
+        self._usernames = usernames
 
-        def on_publish(msg):
-            # hot path: zero work unless a trace exists
-            if msg is None or not self.traces:
-                return msg
-            fields = {
-                "clientid": msg.sender,
-                "topic": msg.topic,
-                "qos": msg.qos,
-                "retain": msg.retain,
-                "payload_size": len(msg.payload),
-                "username": usernames.get(msg.sender),
-            }
-            ms = getattr(self.node, "match_service", None)
-            if ms is not None:
-                # device duty-cycle visibility (VERDICT r2 weak 4);
-                # non-consuming peek so broker metrics stay untouched
-                fields["match_path"] = (
-                    "device" if ms.hint_available(msg.topic) else "host"
-                )
-            self._fanout("publish", msg.sender, msg.topic, None, fields)
+    def _on_publish_tap(self, msg):
+        if msg is None:
             return msg
+        fields = {
+            "clientid": msg.sender,
+            "topic": msg.topic,
+            "qos": msg.qos,
+            "retain": msg.retain,
+            "payload_size": len(msg.payload),
+            "username": self._usernames.get(msg.sender),
+        }
+        ms = getattr(self.node, "match_service", None)
+        if ms is not None:
+            # device duty-cycle visibility (VERDICT r2 weak 4);
+            # non-consuming peek so broker metrics stay untouched
+            fields["match_path"] = (
+                "device" if ms.hint_available(msg.topic) else "host"
+            )
+        self._fanout("publish", msg.sender, msg.topic, None, fields)
+        return msg
 
-        hooks.add("message.publish", on_publish, priority=-99,
-                  name="trace.publish")
-        hooks.add("message.delivered", lambda cid, msg: self._fanout(
-            "deliver", cid, msg.topic, None,
-            {"clientid": cid, "topic": msg.topic, "from": msg.sender}),
-            priority=-99, name="trace.delivered")
-        hooks.add("message.dropped", lambda msg, reason: self._fanout(
-            "drop", getattr(msg, "sender", None),
-            getattr(msg, "topic", None), None,
-            {"topic": getattr(msg, "topic", None), "reason": str(reason)}),
-            priority=-99, name="trace.dropped")
+    def _on_delivered_tap(self, cid, msg):
+        self._fanout("deliver", cid, msg.topic, None,
+                     {"clientid": cid, "topic": msg.topic,
+                      "from": msg.sender})
+
+    def _on_dropped_tap(self, msg, reason):
+        self._fanout("drop", getattr(msg, "sender", None),
+                     getattr(msg, "topic", None), None,
+                     {"topic": getattr(msg, "topic", None),
+                      "reason": str(reason)})
+
+    def _sync_message_taps(self) -> None:
+        """The per-message taps ride the publish→deliver hot path, so
+        they exist only while at least one trace does — an idle broker
+        pays a single empty-chain dict lookup per event, not a lambda +
+        fields dict per delivered leg."""
+        hooks = self.node.broker.hooks
+        if self.traces and not self._message_taps_on:
+            hooks.add("message.publish", self._on_publish_tap,
+                      priority=-99, name="trace.publish")
+            hooks.add("message.delivered", self._on_delivered_tap,
+                      priority=-99, name="trace.delivered")
+            hooks.add("message.dropped", self._on_dropped_tap,
+                      priority=-99, name="trace.dropped")
+            self._message_taps_on = True
+        elif not self.traces and self._message_taps_on:
+            hooks.delete("message.publish", "trace.publish")
+            hooks.delete("message.delivered", "trace.delivered")
+            hooks.delete("message.dropped", "trace.dropped")
+            self._message_taps_on = False

@@ -32,6 +32,9 @@ def observe(broker: Broker, sys_interval: float = 60.0) -> Observed:
     m = Metrics()
     s = Stats()
     alarms = Alarms()
+    # broker-internal drop accounting (outbox overflow, fanout pipeline)
+    # bumps counters directly — no hook point exists inside those paths
+    broker.metrics = m
 
     def sys_publish(topic: str, payload: bytes):
         from ..broker.message import make_message
@@ -43,7 +46,11 @@ def observe(broker: Broker, sys_interval: float = 60.0) -> Observed:
 
     hooks = broker.hooks
     hooks.add("message.publish", lambda msg: m.inc_msg_received(msg.qos) if not msg.topic.startswith("$SYS") else None, name="metrics.publish")
-    hooks.add("message.delivered", lambda cid, msg: m.inc("messages.delivered"), name="metrics.delivered")
+    # messages.delivered is counted inline by the delivery paths via
+    # broker.metrics (set above): it fires once per fan-out LEG, and a
+    # hook dispatch + lambda per leg was the top line of the delivery
+    # profile.  The hook point itself stays for real consumers (trace,
+    # rule engine, slow_subs, exhook).
     hooks.add("message.acked", lambda cid, msg: m.inc("messages.acked"), name="metrics.acked")
 
     def on_dropped(msg, reason):

@@ -1,0 +1,26 @@
+"""shard_map across jax versions.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` to the top
+level and renamed ``check_rep`` to ``check_vma`` along the way.  The
+parallel modules are written against the current spelling; this wrapper
+keeps them importable (and runnable) on the older API.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax < 0.5 ships it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = set(inspect.signature(_shard_map).parameters)
+
+__all__ = ["shard_map"]
+
+
+def shard_map(*args, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(*args, **kwargs)

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ._shard_compat import shard_map
 
 from .. import topic as T
 from ..ops.incremental import IncrementalNfa

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ._shard_compat import shard_map
 
 from ..ops.compiler import NfaTable
 from ..ops.match_kernel import nfa_match

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ._shard_compat import shard_map
 
 __all__ = ["build_shared_selector", "make_group_masks", "host_pick"]
 

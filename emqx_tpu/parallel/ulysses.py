@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ._shard_compat import shard_map
 
 from ..ops.match_kernel import nfa_match
 from .sharded_match import or_accept_rows

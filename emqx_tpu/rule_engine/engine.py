@@ -90,6 +90,13 @@ class RuleEngine:
         self._listener_hooks: set = set()
         self._any_publish_rules = False
         self._listeners_epoch = -1
+        # per-message event taps (delivered/acked/dropped) fire per
+        # fan-out leg — they are registered only while an enabled rule
+        # listens on them (synced on rule churn), so a rule-less broker
+        # pays nothing on the delivery hot path
+        self._lazy_taps: Dict[str, tuple] = {}
+        self._taps_on: set = set()
+        self._hooks_ref = None
         if broker is not None:
             self._attach(broker)
 
@@ -142,6 +149,7 @@ class RuleEngine:
         self.rules[rule_id] = rule
         self._epoch += 1
         self._sync_rule_filters(rule)
+        self._sync_event_taps()
         return rule
 
     def delete_rule(self, rule_id: str) -> bool:
@@ -150,6 +158,7 @@ class RuleEngine:
             self._epoch += 1
             if self._match_service is not None:
                 self._match_service.unregister_rule(rule_id)
+            self._sync_event_taps()
         return ok
 
     def set_enable(self, rule_id: str, enable: bool) -> None:
@@ -157,6 +166,7 @@ class RuleEngine:
         rule.enable = enable
         self._epoch += 1
         self._sync_rule_filters(rule)
+        self._sync_event_taps()
 
     @property
     def epoch(self) -> int:
@@ -361,30 +371,44 @@ class RuleEngine:
             }),
             priority=-50, name="rule_engine.unsubscribed",
         )
-        broker.hooks.add(
-            "message.delivered",
-            mk("message.delivered", lambda cid, msg: {
-                **message_columns(msg), "event": "message.delivered",
-                "clientid": cid, "from_clientid": msg.sender,
-            }),
-            priority=-50, name="rule_engine.delivered",
-        )
-        broker.hooks.add(
-            "message.acked",
-            mk("message.acked", lambda cid, msg: {
-                **message_columns(msg), "event": "message.acked",
-                "clientid": cid, "from_clientid": msg.sender,
-            }),
-            priority=-50, name="rule_engine.acked",
-        )
-        broker.hooks.add(
-            "message.dropped",
-            mk("message.dropped", lambda msg, reason: {
-                **message_columns(msg), "event": "message.dropped",
-                "reason": reason,
-            }),
-            priority=-50, name="rule_engine.dropped",
-        )
+        self._hooks_ref = broker.hooks
+        self._lazy_taps = {
+            "message.delivered": ("rule_engine.delivered", mk(
+                "message.delivered", lambda cid, msg: {
+                    **message_columns(msg), "event": "message.delivered",
+                    "clientid": cid, "from_clientid": msg.sender,
+                })),
+            "message.acked": ("rule_engine.acked", mk(
+                "message.acked", lambda cid, msg: {
+                    **message_columns(msg), "event": "message.acked",
+                    "clientid": cid, "from_clientid": msg.sender,
+                })),
+            "message.dropped": ("rule_engine.dropped", mk(
+                "message.dropped", lambda msg, reason: {
+                    **message_columns(msg), "event": "message.dropped",
+                    "reason": reason,
+                })),
+        }
+        self._sync_event_taps()
+
+    def _sync_event_taps(self) -> None:
+        """Register/unregister the per-message event taps to mirror the
+        current enabled-rule listener set (see _lazy_taps above).  The
+        cb's own ``_event_has_listeners`` guard stays as a belt for any
+        add/delete race mid-batch."""
+        hooks = self._hooks_ref
+        if hooks is None:
+            return
+        if self._listeners_epoch != self._epoch:
+            self._refresh_listeners()
+        for point, (name, cb) in self._lazy_taps.items():
+            want = point in self._listener_hooks
+            if want and point not in self._taps_on:
+                hooks.add(point, cb, priority=-50, name=name)
+                self._taps_on.add(point)
+            elif not want and point in self._taps_on:
+                hooks.delete(point, name)
+                self._taps_on.discard(point)
 
     # ------------------------------------------------------------------
     # device co-batch (north star: BASELINE config #3)

@@ -228,7 +228,46 @@ class MqttProtocol(asyncio.Protocol):
     # -- broker-facing surface (same contract as Connection) -----------
 
     def deliver(self, pubs: List[Any]) -> None:
-        self._run_actions(self.channel.handle_deliver(pubs))
+        """Routed deliveries.  The fanout pipeline hands MANY publishes
+        per call, so this path serializes them all and issues ONE
+        transport write (vs one syscall per message), and QoS0 publishes
+        cache their wire bytes on the Message — a B-subscriber fan-out
+        of a shared (zero-copy) message serializes once, not B times.
+        The generic action path still serves everything else."""
+        if self._closed or self.transport is None:
+            return
+        channel = self.channel
+        ver = channel.proto_ver
+        chunks: List[bytes] = []
+        for p in pubs:
+            data = None
+            m = p.msg
+            if p.pid is None:
+                cache = m.__dict__.get("_wire")
+                if cache is not None:
+                    data = cache.get(ver)
+            if data is None:
+                try:
+                    data = F.serialize(channel._to_publish_pkt(p), ver=ver)
+                except Exception:
+                    log.exception("serialize failed (%s)",
+                                  self.conninfo.peername)
+                    continue
+                if p.pid is None and not m.dup:
+                    cache = m.__dict__.get("_wire")
+                    if cache is None:
+                        cache = m.__dict__["_wire"] = {}
+                    cache[ver] = data
+            chunks.append(data)
+        if not chunks:
+            return
+        self.pkts_out += len(chunks)
+        data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
+        self.bytes_out += len(data)
+        if self._paused_write:
+            self._pending_out.append(data)
+        else:
+            self.transport.write(data)
 
     def kick(self, reason: str = "kicked") -> None:
         self._run_actions(self.channel.handle_takeover()

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Broker publish→deliver e2e A/B — per-message path vs fanout pipeline.
+
+CPU-only (no device needed): measures the broker-side processing path
+the fanout pipeline amortizes, on the telemetry-broadcast shape
+(QoS1 publishers → wildcard QoS0 subscribers).
+
+Modes:
+
+* ``--smoke``  — small N, ~10 s wall: the per-PR tracking number
+  (wired as the ``slow``-marked ``tests/test_bench_e2e.py``).
+* default      — the full A/B shape ``bench.py`` reports under
+  ``fanout_e2e``.
+
+Prints one JSON object: per_message / pipeline sections plus the
+delivered-msgs/s ``speedup``.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(prog="bench_e2e")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small-N CPU smoke (<60 s), for per-PR tracking")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="override per-run duration (s)")
+    args = ap.parse_args(argv)
+
+    from bench import _fanout_e2e_size, bench_fanout_e2e
+
+    size = _fanout_e2e_size(args.smoke)
+    if args.duration is not None:
+        size["duration"] = args.duration
+    out = bench_fanout_e2e(**size)
+    print(json.dumps(out, indent=2))
+    return out
+
+
+if __name__ == "__main__":
+    main()

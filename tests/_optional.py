@@ -1,0 +1,46 @@
+"""Optional test-dependency shims.
+
+The tier-1 container has no ``hypothesis``; importing it at module top
+made six test modules fail COLLECTION, taking every non-property test in
+them down too (ROADMAP "seed tests failing").  This shim re-exports the
+real package when present and otherwise substitutes stubs that mark the
+property-based tests as skipped while letting the rest of the module
+collect and run.
+"""
+
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: F401
+
+    HAVE_HYPOTHESIS = True
+except ImportError:
+    import pytest
+
+    HAVE_HYPOTHESIS = False
+
+    class _Strategy:
+        """Stands in for any strategy expression built at import time."""
+
+        def __getattr__(self, name):
+            return lambda *a, **k: _Strategy()
+
+        def __call__(self, *a, **k):
+            return _Strategy()
+
+        def __or__(self, other):
+            return self
+
+    class _St:
+        def __getattr__(self, name):
+            return lambda *a, **k: _Strategy()
+
+    st = _St()
+
+    def given(*a, **k):
+        return lambda fn: pytest.mark.skip(
+            reason="hypothesis not installed"
+        )(fn)
+
+    def settings(*a, **k):
+        if a and callable(a[0]) and not k:
+            return a[0]  # bare @settings
+        return lambda fn: fn

@@ -29,6 +29,14 @@ os.environ["JAX_ENABLE_X64"] = "0"
 # Tests that exercise it opt in with an explicit `tpu.enable = true`.
 os.environ.setdefault("EMQX_TPU__ENABLE", "false")
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running (bench smoke, multihost) — excluded from "
+        "tier-1 via -m 'not slow'",
+    )
+
 # This box's sitecustomize force-registers the TPU PJRT plugin and rewrites
 # jax_platforms to "axon,cpu" for every interpreter; env vars alone don't
 # win.  Re-pin to CPU before any backend is initialized.

@@ -1,0 +1,35 @@
+"""Per-PR e2e tracking: the ``scripts/bench_e2e.py --smoke`` A/B must
+run clean on CPU and deliver every fan-out leg on BOTH paths.
+
+Marked ``slow`` (tier-1 runs ``-m 'not slow'``): the smoke A/B is two
+~2 s broker runs plus node start/stop.  The speedup itself is NOT
+asserted here — a loaded CI box makes ratios noisy; the bench reports
+it, the test pins correctness (delivery_ratio) and that the harness
+keeps working.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_e2e_smoke_delivers_everything():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_e2e.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout)
+    for path in ("per_message", "pipeline"):
+        sec = out[path]
+        assert sec["sent"] > 0, (path, sec)
+        assert sec["delivery_ratio"] == 1.0, (path, sec)
+    assert out["speedup"] > 0

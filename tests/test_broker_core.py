@@ -370,6 +370,38 @@ def test_queue_legacy_shared_sub_delivers():
     assert "c1" in res.publishes
 
 
+def test_outbox_overflow_counted_and_logged_once(caplog):
+    import logging
+
+    from emqx_tpu.observe.metrics import Metrics
+
+    b = Broker()
+    b.metrics = Metrics()
+    b.open_session("c")
+    b.subscribe("c", "t", SubOpts())
+    with caplog.at_level(logging.WARNING, logger="emqx_tpu.broker.broker"):
+        for i in range(b.OUTBOX_MAX + 25):
+            b.publish(msg(topic="t", payload=str(i).encode()))
+    assert len(b.outbox["c"]) == b.OUTBOX_MAX
+    # oldest dropped, newest kept
+    assert int(b.outbox["c"][0].msg.payload) == 25
+    assert b.metrics.get("broker.outbox.dropped") == 25
+    warnings = [r for r in caplog.records if "outbox overflow" in r.message]
+    assert len(warnings) == 1  # logged once per client, not per drop
+
+
+def test_effective_message_shared_when_no_transform():
+    b = Broker()
+    m = msg(topic="t", qos=0)
+    assert b._effective(m, SubOpts(qos=0)) is m        # zero-copy
+    eff = b._effective(msg(topic="t", qos=2), SubOpts(qos=1))
+    assert eff.qos == 1                                # capped
+    eff = b._effective(msg(topic="t", retain=True), SubOpts(rap=False))
+    assert eff.retain is False                         # RAP off clears
+    eff = b._effective(m, SubOpts(subid=7))
+    assert eff.properties["Subscription-Identifier"] == 7
+
+
 def test_expired_queued_messages_accounted():
     b = Broker()
     s, _ = b.open_session("c", max_inflight=1)

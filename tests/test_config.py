@@ -77,7 +77,7 @@ def test_hot_update_handler_two_phase():
     seen = []
     cfg.on_update("tpu.", lambda p, old, new: seen.append((p, old, new)))
     cfg.put("tpu.batch_size", 8192)
-    assert seen == [("tpu.batch_size", 4096, 8192)]
+    assert seen == [("tpu.batch_size", 2048, 8192)]
     assert cfg.get("tpu.batch_size") == 8192
 
     def boom(p, old, new):

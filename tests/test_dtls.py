@@ -4,6 +4,8 @@ for the UDP gateways, VERDICT r4 item 7)."""
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from emqx_tpu.transport.dtls import (
     DtlsConnection, DtlsEndpoint, PskStore,
 )

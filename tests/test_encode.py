@@ -5,7 +5,7 @@ here is what lets the native path replace the Python loop safely.
 """
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+from _optional import given, settings, st
 
 from emqx_tpu.ops import TopicEncoder, compile_filters, encode_batch
 from emqx_tpu.ops import encode as E

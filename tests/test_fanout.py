@@ -1,0 +1,343 @@
+"""Batched publish→deliver fanout pipeline (broker/fanout.py): delivery
+parity with the per-message path, ordering, QoS downgrade, shared-sub
+round-robin fidelity, bypass/overflow fallback, and the node-level
+opt-in wiring over real TCP."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu.broker import (
+    Broker, FanoutPipeline, Publish, SubOpts, make_message,
+)
+from emqx_tpu.observe.metrics import Metrics
+
+
+def msg(topic="t", qos=0, payload=b"x", sender="pub", **kw):
+    return make_message(sender, topic, payload, qos=qos, **kw)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_pipeline(broker, **kw):
+    kw.setdefault("window_s", 0.0)  # tests: flush on next loop tick
+    p = FanoutPipeline(broker, **kw)
+    await p.start()
+    broker.fanout = p
+    return p
+
+
+async def settle(p, timeout=2.0):
+    """Wait until the pipeline queue is drained and idle."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while (p._q or p._busy) and asyncio.get_event_loop().time() < deadline:
+        await asyncio.sleep(0.002)
+    await asyncio.sleep(0.01)
+
+
+# ---------------------------------------------------------------------------
+# delivery parity + grouping
+# ---------------------------------------------------------------------------
+
+def test_fanout_delivery_parity_with_publish():
+    async def main():
+        b = Broker()
+        got = {}
+        b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+        b.open_session("s1")
+        b.open_session("s2")
+        b.subscribe("s1", "sensors/+/temp", SubOpts(qos=1))
+        b.subscribe("s2", "sensors/#", SubOpts(qos=0))
+        p = await start_pipeline(b)
+        assert p.offer(msg(topic="sensors/kitchen/temp", qos=1))
+        await settle(p)
+        assert got["s1"][0].pid is not None      # QoS1 kept at 1
+        assert got["s2"][0].pid is None          # capped to granted 0
+        assert got["s1"][0].msg.payload == b"x"
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_groups_session_deliveries_and_emits_once():
+    async def main():
+        b = Broker()
+        emits = []
+        b.on_deliver = lambda cid, pubs: emits.append((cid, list(pubs)))
+        b.open_session("sub")
+        b.subscribe("sub", "bench/#", SubOpts(qos=0))
+        p = await start_pipeline(b)
+        for i in range(50):
+            assert p.offer(msg(topic=f"bench/{i}", payload=str(i).encode()))
+        await settle(p)
+        total = sum(len(pubs) for _, pubs in emits)
+        assert total == 50
+        # bulk flush: far fewer emit calls than messages (one per batch)
+        assert len(emits) < 50
+        assert p.batches >= 1 and p.msgs == 50
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_ordering_per_client_topic_preserved():
+    async def main():
+        b = Broker()
+        got = []
+        b.on_deliver = lambda cid, pubs: got.extend(
+            int(p.msg.payload) for p in pubs)
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts(qos=0))
+        p = await start_pipeline(b)
+        for i in range(200):
+            assert p.offer(msg(topic="t", payload=str(i).encode()))
+            if i % 37 == 0:
+                await asyncio.sleep(0)  # interleave with the drain loop
+        await settle(p)
+        assert got == list(range(200))
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_zero_copy_shares_message_across_subscribers():
+    async def main():
+        b = Broker()
+        got = {}
+        b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+        for c in ("a", "b", "c"):
+            b.open_session(c)
+            b.subscribe(c, "t/#", SubOpts(qos=0))
+        p = await start_pipeline(b)
+        m = msg(topic="t/1", qos=0)
+        assert p.offer(m)
+        await settle(p)
+        # no per-subscription transform applies → the SAME object (and
+        # payload buffer) is shared across all three fan-out legs
+        assert got["a"][0].msg is got["b"][0].msg is got["c"][0].msg is m
+        await p.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# semantics under batching
+# ---------------------------------------------------------------------------
+
+def test_fanout_shared_round_robin_unchanged():
+    async def main():
+        # per-message reference: round_robin alternates members in offer
+        # order — the pipeline must produce the identical pick sequence
+        b = Broker(shared_strategy="round_robin")
+        got = []
+        b.on_deliver = lambda cid, pubs: got.extend(
+            (cid, p.msg.payload) for p in pubs)
+        for c in ("c1", "c2"):
+            b.open_session(c)
+            b.subscribe(c, "$share/g/t/#", SubOpts(qos=1))
+        p = await start_pipeline(b)
+        for i in range(4):
+            assert p.offer(msg(topic="t/x", payload=str(i).encode()))
+        await settle(p)
+        assert sorted(got) == [
+            ("c1", b"0"), ("c1", b"2"), ("c2", b"1"), ("c2", b"3")]
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_no_local_and_veto_and_no_subscribers():
+    async def main():
+        b = Broker()
+        got = {}
+        dropped = []
+        b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+        b.hooks.add("message.dropped", lambda m, r: dropped.append(r))
+        b.open_session("c1")
+        b.subscribe("c1", "t", SubOpts(nl=True))
+        p = await start_pipeline(b)
+        assert p.offer(msg(topic="t", sender="c1"))    # No-Local suppressed
+        assert p.offer(msg(topic="nobody/listens"))    # no subscribers
+        vetoed = msg(topic="t", sender="other")
+        vetoed.headers["allow_publish"] = False        # upstream veto
+        assert p.offer(vetoed)
+        assert p.offer(msg(topic="t", sender="other")) # the one that lands
+        await settle(p)
+        assert [p_.msg.sender for p_ in got.get("c1", [])] == ["other"]
+        assert "no_subscribers" in dropped
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_qos1_inflight_window_and_queue():
+    async def main():
+        b = Broker()
+        sess, _ = b.open_session("sub", max_inflight=2)
+        b.subscribe("sub", "t", SubOpts(qos=1))
+        p = await start_pipeline(b)
+        for i in range(5):
+            assert p.offer(msg(topic="t", qos=1, payload=str(i).encode()))
+        await settle(p)
+        sends = b.take_outbox("sub")
+        assert len(sends) == 2                   # window=2, rest queued
+        assert len(sess.mqueue) == 3
+        _, more = sess.puback(sends[0].pid)
+        assert len(more) == 1                    # queue drains on ack
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_invalid_topic_raises_at_offer():
+    async def main():
+        b = Broker()
+        p = await start_pipeline(b)
+        with pytest.raises(ValueError):
+            p.offer(msg(topic="bad/+/wildcard-in-name"))
+        await p.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# fallback paths
+# ---------------------------------------------------------------------------
+
+def test_fanout_refuses_when_not_running():
+    b = Broker()
+    p = FanoutPipeline(b)  # never started
+    assert p.offer(msg()) is False
+    # channel-level contract: refusal means the caller publishes sync
+    assert b.fanout is None
+
+
+def test_fanout_low_rate_bypass_refuses_only_when_idle():
+    async def main():
+        b = Broker()
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts())
+        m = Metrics()
+        p = await start_pipeline(b, bypass_rate=1e9, metrics=m)
+        assert p.offer(msg(topic="t")) is False  # idle + low rate → sync
+        assert m.get("broker.fanout.bypass") == 1
+        # with the queue non-empty the bypass must NOT engage (ordering)
+        p.bypass_rate = 0.0
+        assert p.offer(msg(topic="t"))
+        p.bypass_rate = 1e9
+        assert p.offer(msg(topic="t"))           # queued behind the first
+        await settle(p)
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_overflow_sheds_to_sync_path():
+    async def main():
+        b = Broker()
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts())
+        m = Metrics()
+        p = FanoutPipeline(b, queue_cap=4, metrics=m)
+        p._running = True  # no drain task: queue can only fill
+        for _ in range(4):
+            assert p.offer(msg(topic="t"))
+        assert p.offer(msg(topic="t")) is False
+        assert m.get("broker.fanout.overflow") == 1
+        p._running = False
+
+    run(main())
+
+
+def test_fanout_stop_drains_queue_via_sync_publish():
+    async def main():
+        b = Broker()
+        got = {}
+        b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts())
+        p = FanoutPipeline(b, window_s=60.0)  # batch never flushes itself
+        await p.start()
+        for i in range(3):
+            assert p.offer(msg(topic="t", payload=str(i).encode()))
+        await p.stop()
+        assert [int(x.msg.payload) for x in got["sub"]] == [0, 1, 2]
+
+    run(main())
+
+
+def test_fanout_metrics_accounting():
+    async def main():
+        b = Broker()
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts())
+        m = Metrics()
+        p = await start_pipeline(b, metrics=m)
+        for _ in range(10):
+            p.offer(msg(topic="t"))
+        await settle(p)
+        assert m.get("broker.fanout.msgs") == 10
+        assert m.get("broker.fanout.batches") >= 1
+        assert m.get("broker.fanout.batch_size") >= 1
+        assert m.get("broker.fanout.flush_us") >= 0
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_adaptive_batch_bound_tracks_rate():
+    b = Broker()
+    p = FanoutPipeline(b, max_batch=2048, min_batch=8, adapt_window_s=0.05)
+    p._last_rate = 0.0
+    assert p._batch_bound() == 8           # idle → floor
+    p._last_rate = 10_000.0
+    assert p._batch_bound() == 500         # 50 ms of 10k/s arrivals
+    p._last_rate = 1e9
+    assert p._batch_bound() == 2048        # capped at the sweet spot
+
+
+# ---------------------------------------------------------------------------
+# node-level opt-in over real TCP (pipeline on AND off)
+# ---------------------------------------------------------------------------
+
+def _e2e_roundtrip(fanout_on: bool):
+    from emqx_tpu.client import Client
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    async def main():
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            + ('broker.fanout.enable = true\n' if fanout_on else '')
+        ))
+        cfg.put("tpu.enable", False)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            assert (node.fanout_pipeline is not None) is fanout_on
+            port = node.listeners.all()[0].port
+            sub = Client(clientid="sub", port=port)
+            pub = Client(clientid="pub", port=port)
+            await sub.connect()
+            await pub.connect()
+            await sub.subscribe("a/#", qos=1)
+            for i in range(20):
+                await pub.publish("a/b", str(i).encode(), qos=1)
+            got = [await sub.recv(timeout=5) for _ in range(20)]
+            assert [int(g.payload) for g in got] == list(range(20))
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_node_e2e_pipeline_off_default():
+    _e2e_roundtrip(False)
+
+
+def test_node_e2e_pipeline_on():
+    _e2e_roundtrip(True)

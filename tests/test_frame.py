@@ -2,7 +2,7 @@
 property tests (prop_emqx_frame style, SURVEY.md §4)."""
 
 import pytest
-from hypothesis import given, settings, strategies as st
+from _optional import given, settings, st
 
 from emqx_tpu.mqtt import FrameError, Parser, parse_one, serialize
 from emqx_tpu.mqtt import packet as P

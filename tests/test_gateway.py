@@ -1066,6 +1066,7 @@ def dtls_coap_cfg():
 
 
 def test_coap_gateway_over_dtls_psk():
+    pytest.importorskip("cryptography")  # DTLS PSK transport needs it
     """VERDICT r4 item 7: full CoAP pub/sub round-trip through the DTLS
     1.2 PSK transport — publish encrypted, MQTT subscriber receives,
     observe notification comes back encrypted."""
@@ -1117,6 +1118,7 @@ def test_coap_gateway_over_dtls_psk():
 
 
 def test_dtls_gateway_rejects_unknown_identity():
+    pytest.importorskip("cryptography")  # DTLS PSK transport needs it
     async def main():
         node = await start_node(dtls_coap_cfg())
         try:

@@ -396,6 +396,7 @@ def test_ssl_sni_selects_per_hostname_cert(tmp_path):
 
 
 def test_ssl_listener_crl_rejects_revoked_client(tmp_path):
+    pytest.importorskip("cryptography")
     """Client-cert verification with a CRL: a revoked client cert fails
     the handshake, a valid one connects (emqx_tls_lib CRL-check analog).
     Certs/CRL built with the cryptography package."""

@@ -9,7 +9,7 @@ import random
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+from _optional import given, settings, st
 
 from emqx_tpu import topic as T
 from emqx_tpu.ops import (

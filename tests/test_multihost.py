@@ -121,6 +121,8 @@ def test_two_process_jax_distributed_collectives():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    if any("aren't implemented on the CPU backend" in o for o in outs):
+        pytest.skip("jax CPU backend lacks multiprocess collectives")
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         assert f"MULTIHOST_OK rank={rank}" in out, out

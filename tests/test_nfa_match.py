@@ -6,7 +6,7 @@ topic batch, kernel matches ≡ FilterTrie.match ≡ {f | topic.match(n, f)}.
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+from _optional import given, settings, st
 
 from emqx_tpu import topic as T
 from emqx_tpu.broker import FilterTrie

@@ -8,6 +8,8 @@ import datetime
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from emqx_tpu.transport.ocsp import OcspCache, OcspError
 
 from cryptography import x509

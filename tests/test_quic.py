@@ -8,6 +8,8 @@ import socket
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from emqx_tpu.transport.quic import QuicClient, QuicServerConnection
 from emqx_tpu.transport.quic.crypto import initial_keys
 from emqx_tpu.transport.quic.packet import (

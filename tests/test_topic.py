@@ -4,7 +4,7 @@ style coverage (SURVEY.md §4): explicit spec cases + property tests."""
 import string
 
 import pytest
-from hypothesis import given, settings, strategies as st
+from _optional import given, settings, st
 
 from emqx_tpu import topic as T
 

@@ -274,6 +274,7 @@ def test_keepalive_timeout_closes():
 # ---------------------------------------------------------------------------
 
 def test_websocket_round_trip():
+    pytest.importorskip("websockets")
     async def main():
         import websockets
 

@@ -4,7 +4,7 @@ trie.match ≡ the topic.match oracle over the inserted key set."""
 
 import string
 
-from hypothesis import given, settings, strategies as st
+from _optional import given, settings, st
 
 from emqx_tpu import topic as T
 from emqx_tpu.broker import FilterTrie, TopicTrie, Router
