@@ -213,6 +213,15 @@ class Broker:
         if msg is None or msg.headers.get("allow_publish") is False:
             res.no_subscribers = True
             return res
+        return self._publish_folded(msg, res)
+
+    def publish_folded(self, msg: Message) -> DeliverResult:
+        """Dispatch a message whose ``'message.publish'`` fold ALREADY ran
+        (fanout-pipeline fallback after stage 1) — re-running the fold
+        here would fire retainer/delayed/rewrite side effects twice."""
+        return self._publish_folded(msg, DeliverResult())
+
+    def _publish_folded(self, msg: Message, res: DeliverResult) -> DeliverResult:
         # the TPU hot path (SURVEY.md §3.4): a fresh micro-batched device
         # answer replaces the per-publish host trie walk; stale/absent
         # hints fall back so correctness never depends on the device

@@ -44,6 +44,16 @@ is in flight, so a bypassed message can never overtake a queued one.
 The only exception is queue overload (``queue_cap``): refusal there
 hands messages to the sync path ahead of the backlog — survival over
 ordering, counted in ``broker.fanout.overflow``.
+
+Fault containment: an accepted publish is never lost.  A raising
+publish hook, route-planning failure, or delivery/emit callback error
+falls back to the per-message path for the affected messages (fold-
+skipping via ``Broker.publish_folded`` once the ``message.publish``
+fold has run, so retainer/delayed/rewrite side effects never fire
+twice) and the drain loop stays alive.  On ``stop()``, a batch
+cancelled at an await point re-queues its unprocessed remainder so the
+shutdown drain republishes it in order.  The delivery-stage fallback is
+at-least-once: a leg already delivered before the error may duplicate.
 """
 
 from __future__ import annotations
@@ -197,6 +207,16 @@ class FanoutPipeline:
             t0 = time.perf_counter()
             try:
                 await self._process(batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # belt-and-braces: _process guards each stage itself, but
+                # a bug here must never kill the drain task — offer()
+                # would keep accepting (and the channel PUBACK-ing)
+                # publishes that are never delivered
+                log.exception("fanout batch processing failed")
+                if self.metrics is not None:
+                    self.metrics.inc("broker.fanout.errors")
             finally:
                 self._busy = False
             if self.metrics is not None:
@@ -220,13 +240,34 @@ class FanoutPipeline:
     CHUNK = 256
 
     async def _process(self, batch: List[Message]) -> None:
-        for i in range(0, len(batch), self.CHUNK):
-            self._process_chunk(batch[i:i + self.CHUNK])
-            if i + self.CHUNK < len(batch):
-                await asyncio.sleep(0)
-        # batch-resolve device hints for the NEXT round: topics seen in
-        # this batch are prefetched once the flush is done (stage 2 below
-        # consumes fresh hints synchronously; see prefetch_many)
+        done = 0
+        try:
+            # batch-resolve device hints up front: ONE prefetch_many
+            # kernel dispatch covers every unique topic in the batch, so
+            # stage 2's device_match serves from fresh hints instead of
+            # one per-publish prefetch (bounded by the service's
+            # prefetch_timeout_s; failure → host trie serves)
+            if self.match_service is not None:
+                try:
+                    await self.match_service.prefetch_many(
+                        {m.topic for m in batch})
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    log.exception(
+                        "fanout prefetch_many failed (host trie serves)")
+            for i in range(0, len(batch), self.CHUNK):
+                self._process_chunk(batch[i:i + self.CHUNK])
+                done = i + self.CHUNK
+                if done < len(batch):
+                    await asyncio.sleep(0)
+        except asyncio.CancelledError:
+            # stop() cancelled us at an await point.  Chunks are
+            # synchronous, so everything from `done` on is untouched —
+            # hand it back to the queue front (order preserved) for
+            # stop()'s drain, honoring "accepted publishes never drop"
+            self._q.extendleft(reversed(batch[done:]))
+            raise
 
     def _plan_routes(self, topics) -> Dict[str, list]:
         broker = self.broker
@@ -238,37 +279,69 @@ class FanoutPipeline:
             routes_of[t] = routes if routes is not None else match_routes(t)
         return routes_of
 
+    def _fallback(self, msgs: List[Message], folded: bool) -> None:
+        """Per-message fallback for a failed pipeline stage.  ``folded``
+        selects ``publish_folded`` so messages whose ``message.publish``
+        fold already ran don't fire retainer/delayed/rewrite twice."""
+        broker = self.broker
+        if self.metrics is not None:
+            self.metrics.inc("broker.fanout.fallback", len(msgs))
+        publish = broker.publish_folded if folded else broker.publish
+        for m in msgs:
+            try:
+                publish(m)
+            except Exception:
+                log.exception("fanout fallback publish failed")
+
     def _process_chunk(self, batch: List[Message]) -> None:
         broker = self.broker
         hooks = broker.hooks
         # -- stage 1: publish hooks (retainer/rewrite/delayed ride this
-        # fold) — per message, identical to Broker.publish.  Any failure
-        # up to route resolution re-publishes the chunk on the sync path
-        # (nothing has been delivered yet, so no duplicates).
-        try:
-            msgs: List[Message] = []
-            for msg in batch:
+        # fold) — per message, identical to Broker.publish.  A raising
+        # hook sends THAT message down the sync path (its fold re-runs,
+        # same exposure as any sync retry); the rest stay batched.
+        msgs: List[Message] = []
+        for msg in batch:
+            try:
                 m = hooks.run_fold("message.publish", (), msg)
-                if m is None or m.headers.get("allow_publish") is False:
-                    continue
-                msgs.append(m)
-            if not msgs:
-                return
-            # -- stage 2: route resolution once per UNIQUE topic (device
-            # hints parked by prefetch_many serve here; host trie
-            # otherwise), not once per message
+            except Exception:
+                log.exception("publish fold failed; message falls back "
+                              "to the per-message path")
+                self._fallback([msg], folded=False)
+                continue
+            if m is None or m.headers.get("allow_publish") is False:
+                continue
+            msgs.append(m)
+        if not msgs:
+            return
+        # -- stage 2: route resolution once per UNIQUE topic (device
+        # hints parked by prefetch_many serve here; host trie
+        # otherwise), not once per message.  Nothing is delivered yet
+        # and every fold already ran, so failure falls back fold-skipping
+        # per message — no duplicates, no double hook side effects.
+        try:
             routes_of = self._plan_routes({m.topic for m in msgs})
         except Exception:
             log.exception("fanout planning failed; chunk falls back to "
                           "the per-message path")
-            if self.metrics is not None:
-                self.metrics.inc("broker.fanout.fallback", len(batch))
-            for msg in batch:
-                try:
-                    broker.publish(msg)
-                except Exception:
-                    log.exception("fanout fallback publish failed")
+            self._fallback(msgs, folded=True)
             return
+        try:
+            self._deliver_chunk(msgs, routes_of)
+        except Exception:
+            # stages 3–5 touch callbacks the broker doesn't guard
+            # (session.deliver, shared picks, delivered/dropped taps,
+            # emit).  Partial delivery may have happened, so the
+            # fold-skipping re-dispatch can duplicate a leg (at-least-
+            # once on this error path) — but accepted publishes are
+            # never lost and the drain loop survives.
+            log.exception("fanout delivery failed; chunk falls back to "
+                          "the per-message path")
+            self._fallback(msgs, folded=True)
+
+    def _deliver_chunk(self, msgs: List[Message], routes_of: Dict[str, list]) -> None:
+        broker = self.broker
+        hooks = broker.hooks
         # -- stage 3: group (session → [messages]); shared groups and
         # cluster forwards keep per-message semantics
         plan: Dict[str, List[Message]] = {}

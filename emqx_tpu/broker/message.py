@@ -52,12 +52,14 @@ class Message:
     def clone(self, **kw) -> "Message":
         # dataclasses.replace() re-runs __init__ + field introspection —
         # measured as the dominant cost of wide fan-outs.  A __dict__
-        # copy is ~4x cheaper; derived copies must not inherit the
-        # serialized-wire cache (transport layer) since any field change
-        # invalidates it.
+        # copy is ~4x cheaper; derived copies must not inherit per-object
+        # caches keyed on the ORIGINAL's fields: the serialized-wire
+        # cache (transport layer) and the shared QoS0 Publish
+        # (Session.deliver) both go stale on any field change.
         m = Message.__new__(Message)
         d = dict(self.__dict__)
         d.pop("_wire", None)
+        d.pop("_pub0", None)
         d.update(kw)
         m.__dict__ = d
         return m

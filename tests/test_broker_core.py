@@ -402,6 +402,48 @@ def test_effective_message_shared_when_no_transform():
     assert eff.properties["Subscription-Identifier"] == 7
 
 
+def test_clone_does_not_inherit_qos0_publish_cache():
+    # Session.deliver's bulk QoS0 path caches a shared Publish on the
+    # message (_pub0); a clone (subid / rap transform) must not inherit
+    # it or the transformed subscriber gets the ORIGINAL message back
+    m = msg(topic="t", qos=0)
+    s = Session("plain")
+    s.subscribe("t", SubOpts(qos=0))
+    sends, _ = s.deliver([m])
+    assert sends[0].msg is m              # cache primed on the original
+    eff = m.clone(properties={"Subscription-Identifier": 7})
+    s2 = Session("tagged")
+    s2.subscribe("t", SubOpts(qos=0, subid=7))
+    sends2, _ = s2.deliver([eff])
+    assert sends2[0].msg is eff           # NOT the stale cached Publish
+    assert sends2[0].msg.properties["Subscription-Identifier"] == 7
+
+
+def test_qos0_fanout_subid_and_rap_survive_publish_order():
+    # end-to-end shape of the same bug: a no-transform subscriber primes
+    # the cache on the ORIGINAL message, then subid/rap subscribers
+    # (whose view is a clone) must still see their transformed view
+    b = Broker()
+    got = {}
+    b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+    b.open_session("plain")
+    b.subscribe("plain", "t", SubOpts(qos=0))          # eff IS the original
+    b.open_session("tagged")
+    b.subscribe("tagged", "t", SubOpts(qos=0, subid=9))
+    b.publish(msg(topic="t", qos=0))
+    assert "Subscription-Identifier" not in got["plain"][0].msg.properties
+    assert got["tagged"][0].msg.properties["Subscription-Identifier"] == 9
+    # retain-as-published variant: the rap=True leg primes the cache on
+    # the original, the rap=False leg's clone must see retain cleared
+    b.open_session("keep")
+    b.subscribe("keep", "r", SubOpts(qos=0, rap=True))
+    b.open_session("clear")
+    b.subscribe("clear", "r", SubOpts(qos=0))
+    b.publish(msg(topic="r", qos=0, retain=True))
+    assert got["keep"][0].msg.retain is True
+    assert got["clear"][0].msg.retain is False
+
+
 def test_expired_queued_messages_accounted():
     b = Broker()
     s, _ = b.open_session("c", max_inflight=1)

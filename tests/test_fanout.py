@@ -268,6 +268,115 @@ def test_fanout_stop_drains_queue_via_sync_publish():
     run(main())
 
 
+def test_fanout_drain_loop_survives_raising_hook():
+    async def main():
+        # a raising message.delivered tap must not kill the drain task:
+        # the chunk falls back per message and LATER offers still deliver
+        b = Broker()
+        got = {}
+        b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts(qos=0))
+
+        def bomb(cid, m):
+            if m.payload == b"boom":
+                raise RuntimeError("hook exploded")
+
+        b.hooks.add("message.delivered", bomb)
+        m = Metrics()
+        p = await start_pipeline(b, metrics=m)
+        assert p.offer(msg(topic="t", payload=b"boom"))
+        await settle(p)
+        assert p.offer(msg(topic="t", payload=b"after"))
+        await settle(p)
+        assert not p._task.done()                # loop alive
+        assert b"after" in [x.msg.payload for x in got["sub"]]
+        assert m.get("broker.fanout.fallback") >= 1
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_plan_failure_falls_back_without_double_fold():
+    async def main():
+        # route planning blows up once → the chunk re-dispatches via the
+        # fold-skipping path: message.publish runs exactly once per msg
+        b = Broker()
+        got = {}
+        b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts(qos=0))
+        folds = []
+        b.hooks.add("message.publish", lambda m: folds.append(m.payload))
+        calls = {"n": 0}
+
+        def flaky_device_match(topic):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("device fell over")
+            return None                          # host trie serves
+
+        b.device_match = flaky_device_match
+        p = await start_pipeline(b)
+        assert p.offer(msg(topic="t", payload=b"0"))
+        assert p.offer(msg(topic="t", payload=b"1"))
+        await settle(p)
+        assert sorted(x.msg.payload for x in got["sub"]) == [b"0", b"1"]
+        assert sorted(folds) == [b"0", b"1"]     # one fold per message
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_batch_prefetches_topics_in_one_call():
+    async def main():
+        class RecordingMatchService:
+            def __init__(self):
+                self.calls = []
+
+            async def prefetch_many(self, topics):
+                self.calls.append(set(topics))
+
+        b = Broker()
+        b.open_session("sub")
+        b.subscribe("sub", "a/#", SubOpts(qos=0))
+        ms = RecordingMatchService()
+        p = await start_pipeline(b, match_service=ms)
+        for t in ("a/1", "a/2", "a/3"):
+            assert p.offer(msg(topic=t))
+        await settle(p)
+        assert ms.calls                          # pipeline DID prefetch
+        seen = set().union(*ms.calls)
+        assert seen == {"a/1", "a/2", "a/3"}
+        await p.stop()
+
+    run(main())
+
+
+def test_fanout_stop_requeues_inflight_batch():
+    async def main():
+        # cancellation lands at the prefetch await point with the whole
+        # batch popped off the queue — stop() must still deliver it
+        class StalledMatchService:
+            async def prefetch_many(self, topics):
+                await asyncio.Event().wait()     # never returns
+
+        b = Broker()
+        got = {}
+        b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts(qos=0))
+        p = await start_pipeline(b, match_service=StalledMatchService())
+        for i in range(5):
+            assert p.offer(msg(topic="t", payload=str(i).encode()))
+        await asyncio.sleep(0.02)                # batch pops, then stalls
+        assert p._busy and not p._q              # in flight, queue empty
+        await p.stop()
+        assert [int(x.msg.payload) for x in got["sub"]] == [0, 1, 2, 3, 4]
+
+    run(main())
+
+
 def test_fanout_metrics_accounting():
     async def main():
         b = Broker()
