@@ -414,9 +414,92 @@ def bench_fanout_e2e(n_pub: int = 16, n_sub: int = 32, duration: float = 6.0,
     }
 
 
+def bench_qos1_e2e(n_pub: int = 8, n_sub: int = 16, duration: float = 6.0,
+                   inflight: int = 32) -> dict:
+    """Acknowledged-delivery A/B (the PR-2 tracking number): the same
+    fan-out shape as ``fanout_e2e`` but the subscribers take **QoS1
+    grants with a live acknowledged window** — every delivered PUBLISH
+    carries a packet id, rides the subscriber session's inflight/mqueue
+    machinery, and is PUBACKed by the lean subscriber — so the A/B
+    measures the batched inflight admission + ack/write coalescing
+    stack end to end, per-message path vs pipeline.
+
+    delivery_ratio is received / (sent × n_sub); 1.0 means every
+    fan-out leg was (eventually) delivered — the run waits for the
+    queued backlog to drain through the ack window before summarizing.
+    ``duplicates`` counts DUP-flagged redeliveries and must be 0: the
+    session retry interval (30 s) far exceeds the run, so any DUP here
+    is a broker bug, not a genuine retry."""
+    import asyncio as aio
+
+    from emqx_tpu.bench_client import run_scenario
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    async def run_one(fanout: bool):
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            + ('broker.fanout.enable = true\n' if fanout else '')
+        ))
+        cfg.put("tpu.enable", False)   # host-path e2e: no device drag
+        # unbounded session queues: the A/B asserts delivery_ratio 1.0,
+        # so backlog between instant publisher acks and the subscriber
+        # ack window must park, not drop
+        cfg.put("mqtt.max_mqueue_len", 0)
+        # a deep acknowledged window (windowed-consumer shape): acks
+        # arrive in bursts the size of a TCP read's worth of deliveries
+        cfg.put("mqtt.max_inflight", 128)
+        # smaller pipeline queue = backpressure: overflow publishes take
+        # the synchronous path, which keeps the post-run drain bounded
+        cfg.put("broker.fanout.queue_cap", 4096)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            out = await run_scenario(
+                "pub", port=node.listeners.all()[0].port,
+                count=n_pub, rate=0.0, subscribers=n_sub,
+                topic="bench/%i", sub_topic="bench/#", sub_qos=1,
+                qos=1, payload_size=64, duration=duration,
+                inflight=inflight, lean_subs=True, lean_pubs=True)
+        finally:
+            await node.stop()
+        return out
+
+    def shape(s: dict) -> dict:
+        lat = s.get("latency_us") or {}
+        sent = s.get("sent") or 0
+        return {
+            "sent": sent,
+            "received": s.get("received"),
+            "msgs_per_s": s.get("recv_rate"),
+            "delivery_ratio": round((s.get("received") or 0)
+                                    / max(1, sent * n_sub), 4),
+            "duplicates": s.get("duplicates"),
+            "e2e_p50_us": lat.get("p50"),
+            "e2e_p99_us": lat.get("p99"),
+        }
+
+    per_msg = shape(aio.run(run_one(False)))
+    pipeline = shape(aio.run(run_one(True)))
+    return {
+        "workload": {"publishers": n_pub, "subscribers": n_sub,
+                     "fanout": n_sub, "qos": 1, "sub_qos": 1,
+                     "inflight": inflight, "duration_s": duration},
+        "per_message": per_msg,
+        "pipeline": pipeline,
+        "speedup": round((pipeline["msgs_per_s"] or 0.0)
+                         / max(1e-9, per_msg["msgs_per_s"] or 0.0), 2),
+    }
+
+
 def _fanout_e2e_size(smoke: bool) -> dict:
     return ({"n_pub": 8, "n_sub": 8, "duration": 2.0} if smoke
             else {"n_pub": 16, "n_sub": 32, "duration": 6.0})
+
+
+def _qos1_e2e_size(smoke: bool) -> dict:
+    return ({"n_pub": 4, "n_sub": 4, "duration": 1.5} if smoke
+            else {"n_pub": 8, "n_sub": 16, "duration": 6.0})
 
 
 def _config1_size(smoke: bool) -> dict:
@@ -700,6 +783,7 @@ def main():
         cpu = bench_cpu_native(table, topics, args.cpu_budget_s)
         c1 = bench_config1(**_config1_size(args.smoke))
         fe = bench_fanout_e2e(**_fanout_e2e_size(args.smoke))
+        q1 = bench_qos1_e2e(**_qos1_e2e_size(args.smoke))
         # the most recent full on-chip run is checked into the repo so a
         # tunnel outage at bench time (recurring: 2026-07-29, -30) does
         # not erase the measured result — clearly labeled as such
@@ -752,6 +836,7 @@ def main():
             },
             "config1_broker_e2e": c1,
             "fanout_e2e": fe,
+            "qos1_e2e": q1,
         }))
         return
 
@@ -776,6 +861,10 @@ def main():
     note(f"fanout e2e done: per-message {fe['per_message']['msgs_per_s']}/s"
          f" vs pipeline {fe['pipeline']['msgs_per_s']}/s"
          f" ({fe['speedup']}x)")
+    q1 = bench_qos1_e2e(**_qos1_e2e_size(args.smoke))
+    note(f"qos1 e2e done: per-message {q1['per_message']['msgs_per_s']}/s"
+         f" vs pipeline {q1['pipeline']['msgs_per_s']}/s"
+         f" ({q1['speedup']}x)")
 
     dev, tpu = bench_device(table, topics, args.batch, args.iters,
                             args.depth, args.active_slots)
@@ -918,6 +1007,7 @@ def main():
         "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,
         "fanout_e2e": fe,
+        "qos1_e2e": q1,
         "delta": deltas,
     }
     print(json.dumps(result))

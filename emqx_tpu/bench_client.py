@@ -35,6 +35,7 @@ class BenchStats:
         self.connect_failures = 0
         self.sent = 0
         self.received = 0
+        self.duplicates = 0   # DUP-flagged PUBLISHes seen by subscribers
         self.latencies_us: List[float] = []
         self.t0 = time.perf_counter()
 
@@ -55,6 +56,7 @@ class BenchStats:
             "received": self.received,
             "send_rate": round(self.sent / dt, 1),
             "recv_rate": round(self.received / dt, 1),
+            "duplicates": self.duplicates,
             "latency_us": {
                 "p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99),
                 "max": lat[-1] if lat else None, "n": len(lat),
@@ -66,6 +68,22 @@ def _topic_of(pattern: str, i: int) -> str:
     return pattern.replace("%i", str(i))
 
 
+async def _quiesce(stats: "BenchStats", idle_s: float = 0.25,
+                   deadline_s: float = 30.0) -> None:
+    """Wait until delivery stops progressing before cancelling the
+    drainers: QoS1 windowed subscribers keep draining the broker-side
+    queued backlog via their acks after publishers stop, and cutting
+    that tail short would undercount `received` (delivery_ratio < 1
+    for messages the broker still delivers)."""
+    last = -1
+    deadline = time.perf_counter() + deadline_s
+    while time.perf_counter() < deadline:
+        await asyncio.sleep(idle_s)
+        if stats.received == last:
+            return
+        last = stats.received
+
+
 class LeanSub:
     """Minimal counting subscriber for broker-capacity A/Bs.
 
@@ -74,17 +92,23 @@ class LeanSub:
     the harness outweighs the broker under test and every path measures
     the same loadgen ceiling.  This subscriber handshakes through the
     real codec (CONNECT/SUBSCRIBE via :func:`frame.serialize`), then
-    counts QoS0 PUBLISH frames with an inline fixed-header scanner and
+    counts PUBLISH frames with an inline fixed-header scanner and
     samples e2e latency from every ``sample``-th payload timestamp, so
     the receive side costs ~1 frame per TCP read instead of per message.
+
+    With ``qos=1`` it subscribes at QoS1 and keeps a live acknowledged
+    window: every QoS1 PUBLISH is PUBACKed (all acks for one TCP read
+    coalesce into ONE write — the windowed-consumer shape), and
+    DUP-flagged redeliveries are counted in ``stats.duplicates``.
     """
 
     def __init__(self, clientid: str, host: str, port: int,
-                 sample: int = 16) -> None:
+                 sample: int = 16, qos: int = 0) -> None:
         self.clientid = clientid
         self.host = host
         self.port = port
         self.sample = sample
+        self.qos = qos
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._parser = F.Parser()
@@ -110,18 +134,21 @@ class LeanSub:
         if pkt.reason_code != 0:
             raise ConnectionError(f"CONNACK refused rc={pkt.reason_code}")
 
-    async def subscribe(self, flt: str) -> None:
+    async def subscribe(self, flt: str, qos: Optional[int] = None) -> None:
+        q = self.qos if qos is None else qos
         self._writer.write(F.serialize(P.Subscribe(
-            packet_id=1, topic_filters=[(flt, {"qos": 0})])))
+            packet_id=1, topic_filters=[(flt, {"qos": q})])))
         await asyncio.wait_for(self._read_pkt(P.SUBACK), 10.0)
 
     async def drain(self, stats: "BenchStats") -> None:
-        """Count PUBLISH frames until cancelled/EOF.  QoS0-only (the
-        granted QoS of the bench subscription); other packet types are
-        skipped by remaining-length."""
+        """Count PUBLISH frames until cancelled/EOF; other packet types
+        are skipped by remaining-length.  QoS1-granted publishes are
+        PUBACKed with one coalesced write per TCP read."""
         reader = self._reader
+        writer = self._writer
         buf = b""
         recv = 0
+        dups = 0
         sample = self.sample
         unpack_from = struct.unpack_from
         perf = time.perf_counter
@@ -134,6 +161,7 @@ class LeanSub:
                 mv = buf + data if buf else data
                 i, n = 0, len(mv)
                 now = perf()
+                ack = bytearray()
                 while n - i >= 2:
                     b1 = mv[i]
                     rl = mv[i + 1]
@@ -157,19 +185,27 @@ class LeanSub:
                         break
                     if (b1 & 0xF0) == 0x30:
                         recv += 1
-                        if recv % sample == 0:
-                            off = j + 2 + ((mv[j] << 8) | mv[j + 1])
-                            if b1 & 0x06:   # qos>0: skip packet id
-                                off += 2
-                            if j + rl - off >= 8:
-                                (t_send,) = unpack_from("<d", mv, off)
-                                lat.append((now - t_send) * 1e6)
+                        if b1 & 0x08:       # DUP: broker retry fired
+                            dups += 1
+                        off = j + 2 + ((mv[j] << 8) | mv[j + 1])
+                        if b1 & 0x06:       # qos>0: packet id follows topic
+                            ack += b"\x40\x02"      # PUBACK header
+                            ack += mv[off:off + 2]  # echo the packet id
+                            off += 2
+                        if recv % sample == 0 and j + rl - off >= 8:
+                            (t_send,) = unpack_from("<d", mv, off)
+                            lat.append((now - t_send) * 1e6)
                     i = j + rl
+                if ack:
+                    writer.write(bytes(ack))
                 stats.received += recv
+                stats.duplicates += dups
                 recv = 0
+                dups = 0
                 buf = mv[i:] if i < n else b""
         except (asyncio.CancelledError, ConnectionError):
             stats.received += recv
+            stats.duplicates += dups
 
     async def disconnect(self) -> None:
         try:
@@ -373,9 +409,9 @@ async def run_scenario(
         if subscribers:
             stopic = sub_topic if sub_topic is not None else topic
             sqos = sub_qos if sub_qos is not None else qos
-            if lean_subs and sqos == 0:
+            if lean_subs and sqos in (0, 1):
                 for i in range(subscribers):
-                    s = LeanSub(f"bench_psub_{i}", host, port)
+                    s = LeanSub(f"bench_psub_{i}", host, port, qos=sqos)
                     try:
                         await s.connect()
                         stats.connected += 1
@@ -432,7 +468,7 @@ async def run_scenario(
                   for i, lp in enumerate(lpubs))
             )
             if subscribers:
-                await asyncio.sleep(0.2)
+                await _quiesce(stats)
                 for d in drainers:
                     d.cancel()
             out = stats.summary()
@@ -490,8 +526,8 @@ async def run_scenario(
             *(publish_loop(i, c) for i, c in enumerate(pubs))
         )
         if subscribers:
-            # let the tail drain, then stop the drainers
-            await asyncio.sleep(0.2)
+            # let the tail drain (until delivery quiesces), then stop
+            await _quiesce(stats)
             for d in drainers:
                 d.cancel()
         out = stats.summary()

@@ -124,6 +124,7 @@ class Broker:
             self.hooks.run("session.discarded", (clientid,))
         opts = {**self.session_defaults, **kw}
         sess = Session(clientid, clean_start=clean_start, **opts)
+        sess.metrics = self.metrics
         self.sessions[clientid] = sess
         self.hooks.run("session.created", (clientid,))
         return sess, False
@@ -260,9 +261,12 @@ class Broker:
                 continue  # MQTT5 No-Local
             self._deliver_to(clientid, opts, msg, res)
 
-    def _dispatch_shared(
+    def _shared_try_deliver(
         self, group: str, flt: str, msg: Message, res: DeliverResult
-    ) -> None:
+    ):
+        """The per-member acceptance probe shared by single-message and
+        batched $share dispatch (ack-aware redispatch calls it until a
+        member accepts)."""
         def try_deliver(member: Tuple[str, str]) -> bool:
             clientid, node = member
             if node != self.node:
@@ -288,6 +292,12 @@ class Broker:
                 return False
             return self._deliver_to(clientid, opts, msg, res)
 
+        return try_deliver
+
+    def _dispatch_shared(
+        self, group: str, flt: str, msg: Message, res: DeliverResult
+    ) -> None:
+        try_deliver = self._shared_try_deliver(group, flt, msg, res)
         extra = []
         if self.on_forward_shared is not None:
             # remote nodes holding members of this group, from the route
@@ -299,6 +309,97 @@ class Broker:
         member = self.shared.dispatch_with_ack(
             group, flt, msg.topic, try_deliver, msg.sender, self.node,
             extra=extra,
+        )
+        if member is None:
+            self.hooks.run("message.dropped", (msg, "shared_no_available"))
+
+    def _dispatch_shared_batch(
+        self, group: str, flt: str, msgs: List[Message], res: DeliverResult
+    ) -> None:
+        """Batched $share dispatch (fanout pipeline): ONE ``pick_batch``
+        call assigns a member per message — advancing round-robin/
+        sticky/hash state exactly as per-message picks would — then all
+        messages picked onto one member deliver through a single
+        ``Session.deliver``.  Anything the batch cannot keep faithful
+        (cluster candidates for this group, a member that nacks) falls
+        back to the per-message ack-aware redispatch for the affected
+        messages only."""
+        if self.on_forward_shared is not None and any(
+            isinstance(d, tuple) and d[0] == group and d[1] != self.node
+            for d in self.router.routes_of(flt)
+        ):
+            # remote members exist: keep the two-level cluster pick
+            for m in msgs:
+                self._dispatch_shared(group, flt, m, res)
+            return
+        picks = self.shared.pick_batch(
+            group, flt,
+            [(m.topic, m.sender) for m in msgs], self.node,
+        )
+        by_member: Dict[Tuple[str, str], List[Message]] = {}
+        for m, member in zip(msgs, picks):
+            if member is None:
+                self.hooks.run("message.dropped", (m, "shared_no_available"))
+                continue
+            bucket = by_member.get(member)
+            if bucket is None:
+                bucket = by_member[member] = []
+            bucket.append(m)
+        hooks = self.hooks
+        for member, mlist in by_member.items():
+            clientid, node = member
+            sess = self.sessions.get(clientid) if node == self.node else None
+            opts = None
+            if sess is not None:
+                opts = sess.subscriptions.get(T.make_share(group, flt))
+                if opts is None and group == T.QUEUE_PREFIX:
+                    opts = sess.subscriptions.get(f"{T.QUEUE_PREFIX}/{flt}")
+            if sess is None or opts is None:
+                # picked member can't take it (gone / unsubscribed /
+                # remote): redispatch each message excluding it
+                for m in mlist:
+                    self._redispatch_shared(group, flt, m, res, member)
+                continue
+            effs = [self._effective(m, opts) for m in mlist]
+            sends, dropped = sess.deliver(effs)
+            if sends:
+                res.matched += len(sends)
+                if self.metrics is not None:
+                    self.metrics.inc("messages.delivered", len(sends))
+                res.publishes.setdefault(clientid, []).extend(sends)
+                if hooks.has("message.delivered"):
+                    for p in sends:
+                        hooks.run("message.delivered", (clientid, p.msg))
+            if not dropped:
+                continue
+            dropped_ids = set()
+            for d in dropped:
+                dropped_ids.add(d.id)
+                res.dropped.append((clientid, d))
+                hooks.run("message.dropped", (d, "queue_full"))
+            # a message of THIS batch whose delivery was dropped (queue
+            # rejection, or eviction by a later message of the same
+            # batch) was never sent → redispatch it to another member;
+            # victims from earlier batches just count as drops, like the
+            # per-message path
+            for m, eff in zip(mlist, effs):
+                if eff.id in dropped_ids:
+                    self._redispatch_shared(group, flt, m, res, member)
+
+    def _redispatch_shared(
+        self,
+        group: str,
+        flt: str,
+        msg: Message,
+        res: DeliverResult,
+        nacked: Tuple[str, str],
+    ) -> None:
+        """Ack-aware redispatch of one message after ``nacked`` refused
+        it (the batch-path analog of dispatch_with_ack's retry loop)."""
+        member = self.shared.dispatch_with_ack(
+            group, flt, msg.topic,
+            self._shared_try_deliver(group, flt, msg, res),
+            msg.sender, self.node, exclude=(nacked,),
         )
         if member is None:
             self.hooks.run("message.dropped", (msg, "shared_no_available"))

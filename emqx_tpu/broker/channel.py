@@ -430,6 +430,22 @@ class Channel:
             self.broker.hooks.run("message.acked", (self.clientid, msg))
         return [("send", self._to_publish_pkt(p)) for p in more]
 
+    def handle_puback_batch(self, pkts: List[P.PubAck]) -> List[Publish]:
+        """A run of consecutive PUBACKs from one TCP read (the batched
+        datapath calls this instead of per-packet :meth:`handle_in`):
+        one window-refill cycle covers the whole burst.  Returns the
+        refill publishes for the caller's bulk send path — the same
+        packets per-ack handling would emit, in the same order."""
+        self.last_rx = time.time()
+        acked, more = self.session.puback_batch(
+            [pkt.packet_id for pkt in pkts])
+        if acked:
+            hooks = self.broker.hooks
+            if hooks.has("message.acked"):
+                for msg in acked:
+                    hooks.run("message.acked", (self.clientid, msg))
+        return more
+
     def _handle_pubrec(self, pkt: P.PubAck) -> List[Action]:
         if self.session.pubrec(pkt.packet_id):
             return [("send", P.PubAck(P.PUBREL, pkt.packet_id))]
@@ -543,20 +559,24 @@ class Channel:
     def handle_deliver(self, pubs: List[Publish]) -> List[Action]:
         return [("send", self._to_publish_pkt(p)) for p in pubs]
 
+    # MQTT5 §3.3.2.3: publish properties forwarded to subscribers
+    # (hoisted — this filter runs once per delivery/retry/resume leg,
+    # the per-leg hot path of the acknowledged-delivery stack)
+    _FWD_PROPS = frozenset((
+        "Payload-Format-Indicator", "Message-Expiry-Interval",
+        "Content-Type", "Response-Topic", "Correlation-Data",
+        "User-Property", "Subscription-Identifier",
+    ))
+
     def _to_publish_pkt(self, p: Publish) -> P.Publish:
         m = p.msg
+        props: Dict[str, Any] = {}
+        if self.proto_ver == 5 and m.properties:
+            fwd = self._FWD_PROPS
+            props = {k: v for k, v in m.properties.items() if k in fwd}
         return P.Publish(
             dup=m.dup, qos=m.qos, retain=m.retain, topic=m.topic,
-            packet_id=p.pid, payload=m.payload,
-            properties={
-                k: v
-                for k, v in m.properties.items()
-                if k in (
-                    "Payload-Format-Indicator", "Message-Expiry-Interval",
-                    "Content-Type", "Response-Topic", "Correlation-Data",
-                    "User-Property", "Subscription-Identifier",
-                )
-            } if self.proto_ver == 5 else {},
+            packet_id=p.pid, payload=m.payload, properties=props,
         )
 
     def check_keepalive(self, now: Optional[float] = None) -> List[Action]:

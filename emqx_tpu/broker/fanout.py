@@ -25,9 +25,19 @@ walk over micro-batches:
   across subscribers whenever no per-subscription transform applies;
 * per-client sends flush in bulk: ONE ``emit``/``outbox_put`` per client
   per batch instead of one per message;
-* shared-subscription routes go through the broker's own
-  ``_dispatch_shared`` per message, so ``$share`` pick strategies
-  (round-robin, sticky, ...) are bit-identical to the per-message path.
+* shared-subscription routes batch per ``(group, filter)`` slice
+  through :meth:`SharedSub.pick_batch` + ``Broker._dispatch_shared_batch``
+  — ONE strategy call assigns members for the whole slice, producing
+  the identical pick sequence (round-robin, sticky, ...) the
+  per-message path would, with ack-aware per-message redispatch only
+  when a picked member nacks.
+
+**Shape-aware gate** (``shape_routes``): the chunk delivery stage feeds
+an EWMA of observed fan-out legs per message back to ``offer()``.  When
+the workload is ~1:1 (paired clients, no fan-out to amortize) the offer
+refuses while idle — the per-message path with instant synchronous
+delivery is as fast or faster there — and a probe message is admitted
+every ``shape_probe_s`` so the estimate tracks workload changes.
 
 **Adaptive serve-batch sizing** (BENCH_r05: batch 2048 → p99 105 ms vs
 398 ms at 8192 at similar capacity): the batch bound follows the
@@ -85,6 +95,8 @@ class FanoutPipeline:
         adapt_window_s: float = 0.05,
         bypass_rate: float = 0.0,
         queue_cap: int = 65536,
+        shape_routes: float = 0.0,
+        shape_probe_s: float = 0.25,
     ) -> None:
         self.broker = broker
         self.metrics = metrics
@@ -95,6 +107,8 @@ class FanoutPipeline:
         self.adapt_window_s = adapt_window_s
         self.bypass_rate = bypass_rate
         self.queue_cap = queue_cap
+        self.shape_routes = shape_routes
+        self.shape_probe_s = shape_probe_s
 
         self._q: Deque[Message] = deque()
         self._wake = asyncio.Event()
@@ -105,6 +119,11 @@ class FanoutPipeline:
         self._win_start = time.monotonic()
         self._win_count = 0
         self._last_rate = 0.0
+        # shape gate state: EWMA of observed fan-out legs per message
+        # (None until the first batch is measured) and the next probe
+        # deadline that keeps the estimate fresh while bypassing
+        self._avg_routes: Optional[float] = None
+        self._shape_probe_at = 0.0
         # lifetime accounting (also mirrored into metrics when attached)
         self.batches = 0
         self.msgs = 0
@@ -173,6 +192,27 @@ class FanoutPipeline:
             if self.metrics is not None:
                 self.metrics.inc("broker.fanout.bypass")
             return False
+        if (
+            self.shape_routes > 0
+            and self._avg_routes is not None
+            and self._avg_routes <= self.shape_routes
+            and not self._q
+            and not self._busy
+        ):
+            # shape gate: batching amortizes per-message cost across
+            # fan-out legs; on ~1:1 paired-client shapes there is
+            # nothing to amortize and the per-message path's instant
+            # synchronous delivery wins.  Idle-only (same ordering
+            # argument as the rate bypass), and a probe message is let
+            # through every shape_probe_s so the estimate notices when
+            # the workload grows fan-out again.
+            now2 = time.monotonic()
+            if now2 >= self._shape_probe_at:
+                self._shape_probe_at = now2 + self.shape_probe_s
+            else:
+                if self.metrics is not None:
+                    self.metrics.inc("broker.fanout.shape_bypass")
+                return False
         self._q.append(msg)
         self._wake.set()
         return True
@@ -342,9 +382,11 @@ class FanoutPipeline:
     def _deliver_chunk(self, msgs: List[Message], routes_of: Dict[str, list]) -> None:
         broker = self.broker
         hooks = broker.hooks
-        # -- stage 3: group (session → [messages]); shared groups and
-        # cluster forwards keep per-message semantics
+        # -- stage 3: group (session → [messages]) and ($share group →
+        # [messages]); cluster forwards keep per-message semantics
         plan: Dict[str, List[Message]] = {}
+        shared_slices: Dict[Any, List[Message]] = {}  # (group, flt) → msgs
+        fwd_legs = 0
         res = DeliverResult()  # shared-path sends + accounting
         effective = broker._effective
         subscribers = broker.subscribers
@@ -363,7 +405,10 @@ class FanoutPipeline:
                     elif (group, flt) in seen_shared:
                         continue
                     seen_shared.add((group, flt))
-                    broker._dispatch_shared(group, flt, m, res)
+                    bucket = shared_slices.get((group, flt))
+                    if bucket is None:
+                        bucket = shared_slices[(group, flt)] = []
+                    bucket.append(m)
                 elif dest == node:
                     sender = m.sender
                     eff_cache: Dict[Any, Message] = {}
@@ -383,6 +428,20 @@ class FanoutPipeline:
                 elif broker.on_forward is not None:
                     if broker.on_forward(dest, flt, m):
                         res.matched += 1
+                        fwd_legs += 1
+        # -- stage 3.5: batched shared dispatch — ONE pick_batch per
+        # ($share group, filter) covers its whole batch slice, with
+        # per-message ack-aware redispatch only on nack
+        for (group, flt), ms in shared_slices.items():
+            broker._dispatch_shared_batch(group, flt, ms, res)
+        # shape signal for the offer() gate: observed fan-out legs per
+        # message this chunk (EWMA)
+        self._note_shape(
+            len(msgs),
+            sum(len(b) for b in plan.values())
+            + sum(len(b) for b in shared_slices.values())
+            + fwd_legs,
+        )
         # -- stage 4: one Session.deliver per session per batch
         out = res.publishes
         sessions = broker.sessions
@@ -415,6 +474,13 @@ class FanoutPipeline:
 
     # ------------------------------------------------------------------
 
+    def _note_shape(self, n_msgs: int, n_legs: int) -> None:
+        if n_msgs <= 0:
+            return
+        r = n_legs / n_msgs
+        a = self._avg_routes
+        self._avg_routes = r if a is None else a * 0.8 + r * 0.2
+
     def depth(self) -> int:
         return len(self._q)
 
@@ -426,4 +492,6 @@ class FanoutPipeline:
             "msgs": self.msgs,
             "batch_bound": self._batch_bound(),
             "last_rate": round(self._last_rate, 1),
+            "avg_routes": (round(self._avg_routes, 2)
+                           if self._avg_routes is not None else None),
         }

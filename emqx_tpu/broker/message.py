@@ -59,6 +59,7 @@ class Message:
         m = Message.__new__(Message)
         d = dict(self.__dict__)
         d.pop("_wire", None)
+        d.pop("_wire1", None)  # QoS1/2 wire template (transport layer)
         d.pop("_pub0", None)
         d.update(kw)
         m.__dict__ = d

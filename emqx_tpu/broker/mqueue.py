@@ -39,6 +39,13 @@ class MQueue:
         self._qs: Dict[int, Deque[Message]] = {}
         self._len = 0
         self.dropped = 0
+        # queued messages carrying a Message-Expiry-Interval: while 0,
+        # filter_expired short-circuits — nothing CAN expire, and the
+        # O(queue) sweep per ack-driven dequeue was the dominant cost of
+        # the acknowledged-delivery path under backlog.  Monotone
+        # overcount (decremented on the expiry sweep itself, not on
+        # pop/evict): a stale positive only costs one sweep.
+        self._expiring = 0
 
     def __len__(self) -> int:
         return self._len
@@ -87,6 +94,9 @@ class MQueue:
                 q = self._qs[prio] = deque()
             q.extend(msgs)
             self._len += len(msgs)
+            for m in msgs:
+                if "Message-Expiry-Interval" in m.properties:
+                    self._expiring += 1
             return []
         dropped: List[Message] = []
         for m in msgs:
@@ -101,6 +111,8 @@ class MQueue:
             q = self._qs[prio] = deque()
         q.append(msg)
         self._len += 1
+        if "Message-Expiry-Interval" in msg.properties:
+            self._expiring += 1
 
     def _drop_lowest_upto(self, prio: int) -> Optional[Message]:
         """Evict the oldest message from the lowest priority band ≤ prio."""
@@ -141,17 +153,29 @@ class MQueue:
         return out
 
     def filter_expired(self, now: Optional[float] = None) -> List[Message]:
-        """Drop and return expired messages (MQTT5 message expiry)."""
+        """Drop and return expired messages (MQTT5 message expiry).
+
+        O(1) while no queued message carries an expiry interval — the
+        common case, and this runs on every ack-driven dequeue."""
+        if self._expiring <= 0:
+            return []
         expired: List[Message] = []
+        expiring = 0
         for p in list(self._qs):
             q = self._qs[p]
             keep = deque()
             for m in q:
-                (expired if m.is_expired(now) else keep).append(m)
+                if m.is_expired(now):
+                    expired.append(m)
+                else:
+                    keep.append(m)
+                    if "Message-Expiry-Interval" in m.properties:
+                        expiring += 1
             if keep:
                 self._qs[p] = keep
             else:
                 del self._qs[p]
+        self._expiring = expiring
         self._len -= len(expired)
         self.dropped += len(expired)
         return expired

@@ -26,7 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from .inflight import Inflight
+from .inflight import Inflight, InflightFullError
 from .message import Message
 from .mqueue import MQueue
 
@@ -86,6 +86,9 @@ class Session:
         self.await_rel_timeout = await_rel_timeout
         self.expiry_interval = expiry_interval
         self._next_pid = 0
+        # counter table (broker.metrics), set by Broker.open_session;
+        # sessions built directly in tests run unmetered
+        self.metrics = None
 
     # ------------------------------------------------------------------
     # subscriptions
@@ -105,12 +108,49 @@ class Session:
     # ------------------------------------------------------------------
 
     def next_packet_id(self) -> int:
-        """1..65535, skipping ids still inflight (emqx wraps the same way)."""
+        """1..65535, skipping ids still inflight (emqx wraps the same way).
+
+        Raises :class:`InflightFullError` when the id space is saturated
+        instead of spinning the full 1..65535 range looking for a free
+        slot that cannot exist — callers treat it as window backpressure
+        (queue the message) rather than a crash.
+        """
+        inflight = self.inflight
+        if len(inflight) >= MAX_PACKET_ID:
+            raise InflightFullError("packet-id space exhausted")
+        contains = inflight.contains
         for _ in range(MAX_PACKET_ID):
             self._next_pid = (self._next_pid % MAX_PACKET_ID) + 1
-            if not self.inflight.contains(self._next_pid):
+            if not contains(self._next_pid):
                 return self._next_pid
-        raise RuntimeError("no free packet id")
+        raise InflightFullError("no free packet id after one wrap")
+
+    def alloc_packet_ids(self, k: int) -> List[int]:
+        """Allocate ``k`` free packet ids in ONE wrap/skip scan.
+
+        The batched delivery path reserves a (usually contiguous) run of
+        ids per admitted batch instead of re-entering the wrap loop per
+        message.  Raises :class:`InflightFullError` up front when fewer
+        than ``k`` ids are free; otherwise one pass over at most the
+        full id cycle finds them (each returned id is distinct — the
+        scan ends before any position repeats)."""
+        if k <= 0:
+            return []
+        inflight = self.inflight
+        if MAX_PACKET_ID - len(inflight) < k:
+            raise InflightFullError(
+                f"{k} packet ids requested, "
+                f"{MAX_PACKET_ID - len(inflight)} free")
+        out: List[int] = []
+        pid = self._next_pid
+        contains = inflight.contains
+        append = out.append
+        while len(out) < k:
+            pid = (pid % MAX_PACKET_ID) + 1
+            if not contains(pid):
+                append(pid)
+        self._next_pid = pid
+        return out
 
     # ------------------------------------------------------------------
     # outbound delivery
@@ -144,37 +184,68 @@ class Session:
                     p = d["_pub0"] = Publish(None, m)
                 append(p)
             return out, []
+        # batched QoS1/2 admission: ONE id-run allocation + ONE bulk
+        # inflight insert (single timestamp) for however many messages
+        # the window has room for right now; the rest queue, exactly as
+        # the per-message loop decided
         out: List[Publish] = []
         dropped: List[Message] = []
         inflight = self.inflight
         mqueue = self.mqueue
+        n12 = sum(1 for m in msgs if m.qos != 0)
+        admit = min(n12, self._window_room())
+        pids = self.alloc_packet_ids(admit)
+        entries: List[Tuple[int, Any]] = []
+        i = 0
         for msg in msgs:
             if msg.qos == 0:
                 out.append(Publish(None, msg))
                 continue
-            if inflight.is_full():
+            if i < admit:
+                pid = pids[i]
+                i += 1
+                entries.append((pid, ("publish", msg)))
+                out.append(Publish(pid, msg))
+            else:
                 victim = mqueue.insert(msg)
                 if victim is not None:
                     dropped.append(victim)
-                continue
-            pid = self.next_packet_id()
-            inflight.insert(pid, ("publish", msg))
-            out.append(Publish(pid, msg))
+        self._admit(entries)
         return out, dropped
+
+    def _window_room(self) -> int:
+        """Free inflight slots right now, bounded by the free packet-id
+        space (an unbounded window still cannot outgrow 1..65535)."""
+        inflight = self.inflight
+        room = MAX_PACKET_ID - len(inflight)
+        if inflight.max_size > 0:
+            room = min(room, inflight.max_size - len(inflight))
+        return max(0, room)
+
+    def _admit(self, entries: List[Tuple[int, Any]]) -> None:
+        if not entries:
+            return
+        self.inflight.insert_many(entries)
+        if self.metrics is not None and len(entries) > 1:
+            self.metrics.inc("broker.inflight.batch_admitted", len(entries))
 
     def _dequeue(self) -> List[Publish]:
         # expire first so drops are accounted in mqueue.dropped (and
         # visible via Session.info()) like every other drop path
         self.mqueue.filter_expired()
-        out: List[Publish] = []
-        while not self.inflight.is_full():
-            msg = self.mqueue.pop()
+        room = self._window_room()
+        if room <= 0 or self.mqueue.is_empty():
+            return []
+        msgs: List[Message] = []
+        pop = self.mqueue.pop
+        while len(msgs) < room:
+            msg = pop()
             if msg is None:
                 break
-            pid = self.next_packet_id()
-            self.inflight.insert(pid, ("publish", msg))
-            out.append(Publish(pid, msg))
-        return out
+            msgs.append(msg)
+        pids = self.alloc_packet_ids(len(msgs))
+        self._admit([(pid, ("publish", m)) for pid, m in zip(pids, msgs)])
+        return [Publish(pid, m) for pid, m in zip(pids, msgs)]
 
     def puback(self, pid: int) -> Tuple[Optional[Message], List[Publish]]:
         """QoS1 ack.  Returns (acked message | None, next publishes)."""
@@ -183,6 +254,24 @@ class Session:
             return None, []
         self.inflight.delete(pid)
         return item[1], self._dequeue()
+
+    def puback_batch(self, pids: List[int]) -> Tuple[List[Message], List[Publish]]:
+        """A burst of QoS1 acks in one call: every pid releases its
+        window slot first (unknown / wrong-state pids ignored, exactly
+        like :meth:`puback`), then ONE :meth:`_dequeue` refills the
+        freed room — one id-run allocation and one bulk inflight insert
+        instead of a full ack→refill cycle per packet.  Returns
+        (acked messages, next publishes); refill order matches the
+        sequential per-ack order (mqueue FIFO)."""
+        inflight = self.inflight
+        acked: List[Message] = []
+        for pid in pids:
+            item = inflight.lookup(pid)
+            if item is None or item[0] != "publish":
+                continue
+            inflight.delete(pid)
+            acked.append(item[1])
+        return acked, (self._dequeue() if acked else [])
 
     def pubrec(self, pid: int) -> bool:
         """QoS2 phase 1 ack; caller must send PUBREL(pid).  False if the

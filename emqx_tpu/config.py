@@ -148,6 +148,13 @@ SCHEMA: Dict[str, Field] = {
     # (0 disables bypassing — batch even single publishes)
     "broker.fanout.bypass_rate": Field(0.0, float, lambda v: v >= 0),
     "broker.fanout.queue_cap": Field(65536, int, lambda v: v >= 1),
+    # shape-aware gate: observed fan-out legs/message at or below this
+    # bypasses to the per-message path while idle (1:1 paired-client
+    # shapes have nothing for batching to amortize); 0 disables
+    "broker.fanout.shape_routes": Field(1.25, float, lambda v: v >= 0),
+    # while shape-bypassing, admit one probe message per interval so
+    # the routes/message estimate tracks workload changes
+    "broker.fanout.shape_probe": Field(0.25, duration),
     "broker.sys_msg_interval": Field(60.0, duration),
     "broker.sys_heartbeat_interval": Field(30.0, duration),
     "broker.enable_session_registry": Field(True, _bool),

@@ -456,6 +456,11 @@ class BrokerNode:
             limiter=self.limiter,
             on_closed=self._proto_closed,
             intercept=self._intercept if self._wants_intercept() else None,
+            metrics=self.observed.metrics,
+            # the batched-delivery stack is one opt-in: fanout pipeline
+            # + ack-burst batching + write coalescing ride the same
+            # flag, so the default datapath stays per-packet identical
+            coalesce=bool(self.config.get("broker.fanout.enable")),
         )
         channel.conn = proto
         self._register_on_connect(channel, proto)
@@ -805,6 +810,8 @@ class BrokerNode:
             adapt_window_s=cfg.get("broker.fanout.adapt_window"),
             bypass_rate=cfg.get("broker.fanout.bypass_rate"),
             queue_cap=cfg.get("broker.fanout.queue_cap"),
+            shape_routes=cfg.get("broker.fanout.shape_routes"),
+            shape_probe_s=cfg.get("broker.fanout.shape_probe"),
         )
         await self.fanout_pipeline.start()
         self.broker.fanout = self.fanout_pipeline

@@ -96,7 +96,11 @@ FANOUT_METRIC_NAMES: List[str] = [
     "broker.fanout.batch_size", "broker.fanout.flush_us",
     "broker.fanout.depth", "broker.fanout.bypass",
     "broker.fanout.overflow", "broker.fanout.fallback",
+    "broker.fanout.errors", "broker.fanout.shape_bypass",
     "broker.outbox.dropped",
+    # acknowledged-delivery stack (PR 2): bulk QoS1/2 window admissions
+    # and ack/write flushes that merged >1 packet into one write
+    "broker.inflight.batch_admitted", "broker.ack.coalesced_writes",
 ]
 
 

@@ -236,7 +236,10 @@ class Connection:
 
     async def _writer_loop(self) -> None:
         """Single writer: serializes queue order, applies backpressure via
-        drain() so one slow client never blocks the event loop."""
+        drain() so one slow client never blocks the event loop.  Packets
+        already queued coalesce into ONE stream write (ack bursts,
+        retained replays, resume floods) — bytes are identical to
+        per-packet writes, only the write boundaries merge."""
         while True:
             pkt = await self._outq.get()
             if pkt is None:
@@ -251,10 +254,20 @@ class Connection:
                     return
                 continue
             try:
-                data = F.serialize(pkt, ver=self.channel.proto_ver)
+                chunks = [F.serialize(pkt, ver=self.channel.proto_ver)]
+                while not self._outq.empty():
+                    nxt = self._outq.get_nowait()
+                    if nxt is None:
+                        # re-park the close sentinel behind this flush;
+                        # the goodbye packets were queued before it
+                        self._outq.put_nowait(None)
+                        break
+                    chunks.append(
+                        F.serialize(nxt, ver=self.channel.proto_ver))
+                data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
                 self.stream.write(data)
                 self.bytes_out += len(data)
-                self.pkts_out += 1
+                self.pkts_out += len(chunks)
                 if self._outq.empty():
                     await self.stream.drain()
             except ConnectionError:

@@ -59,6 +59,8 @@ class MqttProtocol(asyncio.Protocol):
         limiter: Optional[LimiterGroup] = None,
         on_closed=None,
         intercept=None,
+        metrics=None,
+        coalesce: bool = True,
     ) -> None:
         self.channel = channel
         self.conninfo = conninfo or ConnInfo()
@@ -66,6 +68,12 @@ class MqttProtocol(asyncio.Protocol):
         self.limiter = limiter
         self.on_closed = on_closed
         self.intercept = intercept
+        self.metrics = metrics
+        # the batched-stack opt-in (rides broker.fanout.enable at the
+        # node level): ack-burst batching, write coalescing and the
+        # QoS1 wire-template cache.  Off → per-packet handling and one
+        # write per packet, byte-for-byte the pre-batching datapath.
+        self.coalesce = coalesce
         self.transport: Optional[asyncio.Transport] = None
         self.bytes_in = 0
         self.bytes_out = 0
@@ -75,6 +83,16 @@ class MqttProtocol(asyncio.Protocol):
         self._close_reason = "closed"
         self._paused_write = False
         self._pending_out: List[bytes] = []
+        # write-coalescing buffer: while a batch is open (one TCP read's
+        # worth of inbound packets, one worker iteration, one timer
+        # tick), every outgoing packet lands here and flushes as ONE
+        # transport write — PUBACK/PUBREC/PUBREL/PUBCOMP bursts,
+        # retained replays and ack-triggered queue drains stop costing
+        # one syscall per packet.  Packet bytes are identical; only the
+        # write boundaries coalesce.
+        self._batching = False
+        self._wbuf: List[bytes] = []
+        self._wbuf_pkts = 0
         self._tick_handle = None
         self._msg_bucket = None
         self._byte_bucket = None
@@ -132,19 +150,64 @@ class MqttProtocol(asyncio.Protocol):
                 except RuntimeError:
                     self._paused_read_queue = False
             return
-        for pkt in pkts:
-            self.pkts_in += 1
-            if (
-                self._msg_bucket is not None
-                and not self._msg_bucket.unlimited
-                and pkt.type == P.PUBLISH
-            ):
-                ok, wait = self._msg_bucket.consume(1.0)
-                if not ok:
-                    self._pause_read_for(wait)
-            self._run_actions(self.channel.handle_in(pkt))
-            if self._closed:
-                return
+        if not self.coalesce:
+            for pkt in pkts:
+                self.pkts_in += 1
+                if (
+                    self._msg_bucket is not None
+                    and not self._msg_bucket.unlimited
+                    and pkt.type == P.PUBLISH
+                ):
+                    ok, wait = self._msg_bucket.consume(1.0)
+                    if not ok:
+                        self._pause_read_for(wait)
+                self._run_actions(self.channel.handle_in(pkt))
+                if self._closed:
+                    return
+            return
+        channel = self.channel
+        self._batching = True
+        try:
+            i = 0
+            n = len(pkts)
+            while i < n:
+                pkt = pkts[i]
+                if (
+                    pkt.type == P.PUBACK
+                    and channel.state == "connected"
+                    and i + 1 < n
+                    and pkts[i + 1].type == P.PUBACK
+                ):
+                    # PUBACK burst (a windowed consumer acks a whole
+                    # TCP read in one write): ack them all, refill the
+                    # window ONCE, send the refills through the bulk
+                    # wire path
+                    j = i + 2
+                    while j < n and pkts[j].type == P.PUBACK:
+                        j += 1
+                    self.pkts_in += j - i
+                    refill = channel.handle_puback_batch(pkts[i:j])
+                    if refill:
+                        self.deliver(refill)
+                    i = j
+                    if self._closed:
+                        return
+                    continue
+                self.pkts_in += 1
+                if (
+                    self._msg_bucket is not None
+                    and not self._msg_bucket.unlimited
+                    and pkt.type == P.PUBLISH
+                ):
+                    ok, wait = self._msg_bucket.consume(1.0)
+                    if not ok:
+                        self._pause_read_for(wait)
+                self._run_actions(channel.handle_in(pkt))
+                if self._closed:
+                    return
+                i += 1
+        finally:
+            self._flush_writes()
 
     def connection_lost(self, exc) -> None:
         if self._tick_handle is not None:
@@ -214,9 +277,17 @@ class MqttProtocol(asyncio.Protocol):
                         return
                     if actions is not None:
                         self.channel.last_rx = time.time()
-                        self._run_actions(actions)
+                        self._batching = self.coalesce
+                        try:
+                            self._run_actions(actions)
+                        finally:
+                            self._flush_writes()
                         continue
-                self._run_actions(self.channel.handle_in(pkt))
+                self._batching = self.coalesce
+                try:
+                    self._run_actions(self.channel.handle_in(pkt))
+                finally:
+                    self._flush_writes()
             except asyncio.CancelledError:
                 return
             except Exception:
@@ -233,7 +304,11 @@ class MqttProtocol(asyncio.Protocol):
         transport write (vs one syscall per message), and QoS0 publishes
         cache their wire bytes on the Message — a B-subscriber fan-out
         of a shared (zero-copy) message serializes once, not B times.
-        The generic action path still serves everything else."""
+        On the batched stack (``coalesce``), QoS1/2 publishes cache a
+        wire TEMPLATE: a fan-out leg differs from its siblings only in
+        the 2 packet-id bytes, so one serialize + a per-leg patch
+        replaces B full serializer passes.  The generic action path
+        still serves everything else."""
         if self._closed or self.transport is None:
             return
         channel = self.channel
@@ -246,6 +321,15 @@ class MqttProtocol(asyncio.Protocol):
                 cache = m.__dict__.get("_wire")
                 if cache is not None:
                     data = cache.get(ver)
+            elif self.coalesce and not m.dup:
+                cache = m.__dict__.get("_wire1")
+                ent = cache.get(ver) if cache is not None else None
+                if ent is not None:
+                    tpl, off = ent
+                    buf = bytearray(tpl)
+                    buf[off] = p.pid >> 8
+                    buf[off + 1] = p.pid & 0xFF
+                    data = bytes(buf)
             if data is None:
                 try:
                     data = F.serialize(channel._to_publish_pkt(p), ver=ver)
@@ -258,13 +342,33 @@ class MqttProtocol(asyncio.Protocol):
                     if cache is None:
                         cache = m.__dict__["_wire"] = {}
                     cache[ver] = data
+                elif self.coalesce and not m.dup:
+                    # packet id sits right after the topic string in
+                    # both v4 and v5 (§2.2.1 / §3.3.2.2): fixed header
+                    # byte + remaining-length varint + 2-byte topic
+                    # length + topic
+                    vi = 1
+                    while data[vi] & 0x80:
+                        vi += 1
+                    hdr = vi + 1
+                    off = hdr + 2 + ((data[hdr] << 8) | data[hdr + 1])
+                    cache = m.__dict__.get("_wire1")
+                    if cache is None:
+                        cache = m.__dict__["_wire1"] = {}
+                    cache[ver] = (data, off)
             chunks.append(data)
         if not chunks:
             return
         self.pkts_out += len(chunks)
         data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
         self.bytes_out += len(data)
-        if self._paused_write:
+        if self._batching:
+            # deliveries landing re-entrantly while an inbound batch is
+            # being handled (publisher subscribed to its own topic) stay
+            # FIFO with the buffered acks and share their flush write
+            self._wbuf.append(data)
+            self._wbuf_pkts += len(chunks)
+        elif self._paused_write:
             self._pending_out.append(data)
         else:
             self.transport.write(data)
@@ -295,9 +399,30 @@ class MqttProtocol(asyncio.Protocol):
             return
         self.bytes_out += len(data)
         self.pkts_out += 1
-        if self._paused_write:
+        if self._batching:
+            self._wbuf.append(data)
+            self._wbuf_pkts += 1
+        elif self._paused_write:
             self._pending_out.append(data)
         else:
+            self.transport.write(data)
+
+    def _flush_writes(self) -> None:
+        """Close the write batch: ONE transport write for everything
+        buffered since it opened (ack bursts coalesce here)."""
+        self._batching = False
+        buf = self._wbuf
+        if not buf:
+            self._wbuf_pkts = 0
+            return
+        data = buf[0] if len(buf) == 1 else b"".join(buf)
+        del buf[:]
+        if self._wbuf_pkts > 1 and self.metrics is not None:
+            self.metrics.inc("broker.ack.coalesced_writes")
+        self._wbuf_pkts = 0
+        if self._paused_write:
+            self._pending_out.append(data)
+        elif self.transport is not None:
             self.transport.write(data)
 
     def _do_close(self, reason: str) -> None:
@@ -309,10 +434,16 @@ class MqttProtocol(asyncio.Protocol):
             # flush the goodbye even under write pressure —
             # transport.write() only buffers while paused, and close()
             # tears down after the send buffer drains; dropping it
-            # would turn a takeover DISCONNECT into a bare TCP reset
+            # would turn a takeover DISCONNECT into a bare TCP reset.
+            # _pending_out (paused-period backlog) predates the open
+            # write batch, so it flushes first.
             for data in self._pending_out:
                 self.transport.write(data)
             self._pending_out.clear()
+            for data in self._wbuf:
+                self.transport.write(data)
+            self._wbuf.clear()
+            self._wbuf_pkts = 0
             self.transport.close()
 
     def _frame_error(self, e: F.FrameError) -> None:
@@ -345,8 +476,12 @@ class MqttProtocol(asyncio.Protocol):
         if self._closed:
             return
         try:
-            self._run_actions(self.channel.check_keepalive())
-            self._run_actions(self.channel.retry_deliveries())
+            self._batching = self.coalesce
+            try:
+                self._run_actions(self.channel.check_keepalive())
+                self._run_actions(self.channel.retry_deliveries())
+            finally:
+                self._flush_writes()
         except Exception:
             log.exception("tick failed (%s)", self.conninfo.peername)
         if not self._closed:

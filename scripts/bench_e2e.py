@@ -2,18 +2,24 @@
 """Broker publish→deliver e2e A/B — per-message path vs fanout pipeline.
 
 CPU-only (no device needed): measures the broker-side processing path
-the fanout pipeline amortizes, on the telemetry-broadcast shape
-(QoS1 publishers → wildcard QoS0 subscribers).
+the fanout pipeline amortizes, on the telemetry-broadcast shape — twice:
+
+* QoS1 publishers → wildcard **QoS0** subscribers (fire-and-forget
+  delivery, the PR-1 number), and
+* QoS1 publishers → wildcard **QoS1 windowed** subscribers with acks
+  flowing (the acknowledged-delivery stack: batched inflight admission
+  + ack/write coalescing, the PR-2 number) under ``"qos1"``.
 
 Modes:
 
-* ``--smoke``  — small N, ~10 s wall: the per-PR tracking number
+* ``--smoke``  — small N, ~15 s wall: the per-PR tracking numbers
   (wired as the ``slow``-marked ``tests/test_bench_e2e.py``).
-* default      — the full A/B shape ``bench.py`` reports under
-  ``fanout_e2e``.
+* default      — the full A/B shapes ``bench.py`` reports under
+  ``fanout_e2e`` / ``qos1_e2e``.
 
 Prints one JSON object: per_message / pipeline sections plus the
-delivered-msgs/s ``speedup``.
+delivered-msgs/s ``speedup`` (QoS0 fields at top level for
+compatibility; the acknowledged A/B nests under ``"qos1"``).
 """
 
 import argparse
@@ -33,12 +39,17 @@ def main(argv=None) -> dict:
                     help="override per-run duration (s)")
     args = ap.parse_args(argv)
 
-    from bench import _fanout_e2e_size, bench_fanout_e2e
+    from bench import (
+        _fanout_e2e_size, _qos1_e2e_size, bench_fanout_e2e, bench_qos1_e2e,
+    )
 
     size = _fanout_e2e_size(args.smoke)
+    qsize = _qos1_e2e_size(args.smoke)
     if args.duration is not None:
         size["duration"] = args.duration
+        qsize["duration"] = args.duration
     out = bench_fanout_e2e(**size)
+    out["qos1"] = bench_qos1_e2e(**qsize)
     print(json.dumps(out, indent=2))
     return out
 

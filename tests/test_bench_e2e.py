@@ -33,3 +33,12 @@ def test_bench_e2e_smoke_delivers_everything():
         assert sec["sent"] > 0, (path, sec)
         assert sec["delivery_ratio"] == 1.0, (path, sec)
     assert out["speedup"] > 0
+    # acknowledged-delivery A/B: QoS1 windowed subscribers, acks
+    # flowing — every fan-out leg delivered, and no DUP redelivery
+    # (retry_interval far exceeds the run, so a DUP is a broker bug)
+    for path in ("per_message", "pipeline"):
+        sec = out["qos1"][path]
+        assert sec["sent"] > 0, (path, sec)
+        assert sec["delivery_ratio"] == 1.0, (path, sec)
+        assert sec["duplicates"] == 0, (path, sec)
+    assert out["qos1"]["speedup"] > 0

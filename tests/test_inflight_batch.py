@@ -1,0 +1,222 @@
+"""Batched QoS1/2 inflight admission (PR 2): contiguous packet-id runs,
+bulk window inserts, the incremental retry scan, packet-id-space
+backpressure, and the batched ack→refill cycle."""
+
+import pytest
+
+from emqx_tpu.broker import (
+    MAX_PACKET_ID, Inflight, InflightFullError, Session, make_message,
+)
+from emqx_tpu.observe.metrics import Metrics
+
+
+def msg(topic="t", qos=1, payload=b"x", **kw):
+    return make_message("pub", topic, payload, qos=qos, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Inflight: incremental expiry scan
+# ---------------------------------------------------------------------------
+
+def test_inflight_older_than_incremental_scan():
+    inf = Inflight(0)
+    inf.insert(1, "a", now=100.0)
+    inf.insert(2, "b", now=103.0)
+    inf.insert(3, "c", now=106.0)
+    assert inf.older_than(10, now=111.0) == [1]
+    # a caller that neither touches nor deletes sees it again (the full
+    # scan behaved the same way)
+    assert inf.older_than(10, now=111.0) == [1]
+    inf.touch(1, now=111.0)
+    assert inf.older_than(10, now=116.5) == [2, 3]   # oldest first
+    inf.delete(2)
+    assert inf.older_than(10, now=117.0) == [3]
+    # the touched entry comes due again a full interval later
+    assert inf.older_than(10, now=121.5) == [3, 1]
+    assert inf.older_than(10, now=100.0) == []
+
+
+def test_inflight_insert_many_single_timestamp_and_order():
+    inf = Inflight(8)
+    inf.insert_many([(5, "a"), (2, "b"), (9, "c")], now=50.0)
+    rows = list(inf.items())
+    assert [pid for pid, _, _ in rows] == [5, 2, 9]   # insertion order
+    assert all(ts == 50.0 for _, ts, _ in rows)
+    # bulk insert past the bound refuses atomically
+    with pytest.raises(InflightFullError):
+        inf.insert_many([(i, "x") for i in (10, 11, 12, 13, 14, 15)])
+    with pytest.raises(KeyError):
+        inf.insert_many([(5, "dup")])
+    assert len(inf) == 3
+
+
+def test_inflight_expiry_survives_delete_churn_compaction():
+    inf = Inflight(0)
+    for i in range(1, 201):
+        inf.insert(i, i, now=float(i))
+    for i in range(1, 200):   # churn → stale heap entries → compaction
+        inf.delete(i)
+    assert inf.older_than(0.0, now=1000.0) == [200]
+
+
+# ---------------------------------------------------------------------------
+# packet-id allocation
+# ---------------------------------------------------------------------------
+
+def test_alloc_packet_ids_skips_live_ids_across_wrap():
+    s = Session("c1", max_inflight=0)
+    s._next_pid = 65530
+    for pid in (65531, 65533, 1, 3):
+        s.inflight.insert(pid, ("publish", None))
+    ids = s.alloc_packet_ids(6)
+    assert ids == [65532, 65534, 65535, 2, 4, 5]
+    assert not any(s.inflight.contains(i) for i in ids)
+    assert len(set(ids)) == 6
+    # the cursor continues where the run ended, like next_packet_id
+    assert s.next_packet_id() == 6
+
+
+def test_alloc_packet_ids_matches_per_message_sequence():
+    a = Session("a", max_inflight=0)
+    b = Session("b", max_inflight=0)
+    for s in (a, b):
+        s._next_pid = 65533
+        s.inflight.insert(65535, ("publish", None))
+        s.inflight.insert(2, ("publish", None))
+    assert a.alloc_packet_ids(4) == [b.next_packet_id() for _ in range(4)]
+
+
+def test_next_packet_id_backpressure_when_id_space_saturated():
+    s = Session("c1", max_inflight=0)
+    s.inflight.insert_many(
+        [(pid, ("publish", None)) for pid in range(1, MAX_PACKET_ID + 1)],
+        now=0.0,
+    )
+    # O(1) refusal, not a 65535-iteration spin ending in RuntimeError
+    with pytest.raises(InflightFullError):
+        s.next_packet_id()
+    with pytest.raises(InflightFullError):
+        s.alloc_packet_ids(1)
+    # deliver treats exhaustion as window backpressure: queue, not crash
+    out, dropped = s.deliver([msg(qos=1)])
+    assert out == [] and dropped == []
+    assert len(s.mqueue) == 1
+
+
+def test_alloc_packet_ids_insufficient_free_raises():
+    s = Session("c1", max_inflight=0)
+    s.inflight.insert_many(
+        [(pid, ("publish", None)) for pid in range(1, MAX_PACKET_ID - 1)])
+    assert len(s.alloc_packet_ids(2)) == 2  # exactly the free ids left
+    s2 = Session("c2", max_inflight=0)
+    s2.inflight.insert_many(
+        [(pid, ("publish", None)) for pid in range(1, MAX_PACKET_ID - 1)])
+    with pytest.raises(InflightFullError):
+        s2.alloc_packet_ids(3)
+
+
+# ---------------------------------------------------------------------------
+# batched deliver / dequeue
+# ---------------------------------------------------------------------------
+
+def test_batched_deliver_matches_per_message_deliver():
+    batched = Session("a", max_inflight=8)
+    serial = Session("b", max_inflight=8)
+    msgs = [msg(qos=qos, payload=str(i).encode())
+            for i, qos in enumerate([1, 0, 1, 2, 0, 1, 1, 1, 2, 1, 1, 0])]
+    out_b, drop_b = batched.deliver(list(msgs))
+    out_s, drop_s = [], []
+    for m in msgs:
+        o, d = serial.deliver([m])
+        out_s.extend(o)
+        drop_s.extend(d)
+    assert [(p.pid, p.msg.payload) for p in out_b] == \
+        [(p.pid, p.msg.payload) for p in out_s]
+    assert drop_b == drop_s == []
+    assert len(batched.inflight) == len(serial.inflight) == 8
+    assert len(batched.mqueue) == len(serial.mqueue)  # overflow queued
+    assert [m.payload for m in batched.mqueue.to_list()] == \
+        [m.payload for m in serial.mqueue.to_list()]
+
+
+def test_batched_deliver_ids_never_collide_with_live_inflight():
+    s = Session("c1", max_inflight=64)
+    s._next_pid = 65520
+    # live ids scattered across the wrap boundary
+    for pid in (65525, 65530, 3, 7, 40):
+        s.inflight.insert(pid, ("publish", None))
+    out, _ = s.deliver([msg(qos=1) for _ in range(40)])
+    pids = [p.pid for p in out]
+    assert len(pids) == len(set(pids)) == 40
+    assert not set(pids) & {65525, 65530, 3, 7, 40}
+    assert len(s.inflight) == 45
+
+
+def test_batch_admitted_metric_counts_bulk_admissions():
+    m = Metrics()
+    s = Session("c1", max_inflight=16)
+    s.metrics = m
+    s.deliver([msg(qos=1)])                       # single: not a batch
+    assert m.get("broker.inflight.batch_admitted") == 0
+    s.deliver([msg(qos=1) for _ in range(5)])
+    assert m.get("broker.inflight.batch_admitted") == 5
+
+
+def test_puback_batch_matches_sequential_acks():
+    batched = Session("a", max_inflight=4)
+    serial = Session("b", max_inflight=4)
+    msgs = [msg(qos=1, payload=str(i).encode()) for i in range(10)]
+    out_b, _ = batched.deliver(list(msgs))
+    out_s, _ = serial.deliver(list(msgs))
+    pids = [p.pid for p in out_b]
+    acked_b, more_b = batched.puback_batch(pids + [999])  # unknown pid ok
+    acked_s, more_s = [], []
+    for p in out_s:
+        a, more = serial.puback(p.pid)
+        if a is not None:
+            acked_s.append(a)
+        more_s.extend(more)
+    _, m999 = serial.puback(999)
+    assert m999 == []
+    assert [m.payload for m in acked_b] == [m.payload for m in acked_s]
+    assert [(p.pid, p.msg.payload) for p in more_b] == \
+        [(p.pid, p.msg.payload) for p in more_s]
+    assert len(batched.inflight) == len(serial.inflight) == 4
+
+
+def test_retry_fires_exactly_once_per_interval_under_incremental_scan():
+    s = Session("c1", max_inflight=8, retry_interval=10.0)
+    import time as _t
+    now = _t.time()
+    out, _ = s.deliver([msg(qos=1, payload=b"a"), msg(qos=1, payload=b"b"),
+                        msg(qos=2, payload=b"c")])
+    assert len(out) == 3
+    assert s.retry(now + 5) == []                  # nothing due yet
+    due = s.retry(now + 11)
+    assert sorted(p for p, _, _ in due) == sorted(p.pid for p in out)
+    assert all(m.dup for _, k, m in due if k == "publish")
+    assert s.retry(now + 12) == []                 # touched: not due again
+    assert len(s.retry(now + 21.5)) == 3           # due a full interval later
+    # acked entries leave the scan entirely
+    s.puback(out[0].pid)
+    assert sorted(p for p, _, _ in s.retry(now + 40)) == \
+        sorted(p.pid for p in out[1:])
+
+
+# ---------------------------------------------------------------------------
+# mqueue expiry short-circuit (the per-ack dequeue hot path)
+# ---------------------------------------------------------------------------
+
+def test_mqueue_filter_expired_short_circuits_without_expiring_msgs():
+    from emqx_tpu.broker import MQueue
+    q = MQueue(max_len=0)
+    q.insert_many([msg(qos=1) for _ in range(10)])
+    assert q._expiring == 0
+    assert q.filter_expired() == []                # O(1), no sweep
+    assert len(q) == 10
+    expiring = msg(qos=1, properties={"Message-Expiry-Interval": 1})
+    q.insert(expiring)
+    assert q._expiring == 1
+    import time as _t
+    assert q.filter_expired(now=_t.time() + 5) == [expiring]
+    assert q._expiring == 0 and len(q) == 10
