@@ -157,6 +157,7 @@ class BridgeManager:
         if bid in self.bridges:
             raise ValueError(f"bridge {bid} exists")
         br = self._build(btype, name, conf)
+        br.worker.supervisor = getattr(self.node, "supervisor", None)
         self.bridges[bid] = br
         return br
 
@@ -172,6 +173,7 @@ class BridgeManager:
         # build (and thereby validate) the replacement BEFORE touching the
         # running bridge: a bad conf leaves the old bridge untouched
         br = self._build(btype, name, conf)
+        br.worker.supervisor = getattr(self.node, "supervisor", None)
         await old.worker.stop()
         # migrate the buffered backlog (original enqueue stamps) so an
         # update while the remote is down doesn't drop the window

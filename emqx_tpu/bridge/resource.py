@@ -21,6 +21,8 @@ import time
 from collections import deque
 from typing import Any, Awaitable, Callable, Deque, Dict, List, Optional, Tuple
 
+from .. import faultinject as _fi
+
 log = logging.getLogger(__name__)
 
 __all__ = ["Connector", "SendError", "BufferedWorker"]
@@ -102,6 +104,9 @@ class BufferedWorker:
         self.health_interval = health_interval
 
         self.status = "stopped"  # stopped|connecting|connected|disconnected
+        # set by BridgeManager when the node carries a supervision tree:
+        # worker/health loops then run as supervised children
+        self.supervisor: Optional[Any] = None
         self.metrics: Dict[str, int] = {
             "matched": 0, "success": 0, "failed": 0, "retried": 0,
             "dropped": 0, "dropped.queue_full": 0, "dropped.expired": 0,
@@ -142,12 +147,20 @@ class BufferedWorker:
         except Exception as e:
             log.warning("resource %s connect failed: %s", self.name, e)
             self.status = "disconnected"
-        self._tasks = [
-            asyncio.create_task(self._run(), name=f"bridge-{self.name}"),
-            asyncio.create_task(
-                self._health_loop(), name=f"bridge-{self.name}-health"
-            ),
-        ]
+        if self.supervisor is not None:
+            self._tasks = [
+                self.supervisor.start_child(
+                    f"bridge.{self.name}", self._run),
+                self.supervisor.start_child(
+                    f"bridge.{self.name}.health", self._health_loop),
+            ]
+        else:
+            self._tasks = [
+                asyncio.create_task(self._run(), name=f"bridge-{self.name}"),
+                asyncio.create_task(
+                    self._health_loop(), name=f"bridge-{self.name}-health"
+                ),
+            ]
 
     async def stop(self) -> None:
         self._stopping = True
@@ -203,6 +216,18 @@ class BufferedWorker:
                 continue
             try:
                 try:
+                    if _fi._injector is not None:
+                        # chaos seam: a raised sink fault rides the
+                        # normal retryable-SendError path (backoff +
+                        # front-requeue); a delay simulates a slow
+                        # remote
+                        act = _fi._injector.act("bridge.sink")
+                        if act == "raise":
+                            raise SendError(
+                                "injected fault: bridge.sink",
+                                retryable=True)
+                        if act == "delay":
+                            await _fi._injector.pause()
                     rejected = await self.connector.send(
                         [item for _, item in batch]
                     ) or 0

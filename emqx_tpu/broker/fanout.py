@@ -55,6 +55,17 @@ The only exception is queue overload (``queue_cap``): refusal there
 hands messages to the sync path ahead of the backlog — survival over
 ordering, counted in ``broker.fanout.overflow``.
 
+**Supervision + overload** (PR 3): with a :class:`~emqx_tpu.supervise.
+Supervisor` attached, the drain loop runs as a permanent child — a
+crash or injected kill restarts it (backoff + restart-intensity
+escalation) instead of silently stopping delivery, and an un-drained
+queue re-publishes through the sync path on supervised shutdown.  With
+an :class:`~emqx_tpu.broker.olp.Olp` attached, sustained overload sheds
+per policy at ``offer()``: QoS0 drops first (``broker.olp.shed_qos0``),
+retained/delayed publishes defer until the overload clears
+(``broker.olp.deferred``), QoS1/2 keep riding the inflight-window
+backpressure — queues never grow unboundedly.
+
 Fault containment: an accepted publish is never lost.  A raising
 publish hook, route-planning failure, or delivery/emit callback error
 falls back to the per-message path for the affected messages (fold-
@@ -74,6 +85,7 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
+from .. import faultinject as _fi
 from .. import topic as T
 from .broker import DeliverResult
 from .message import Message
@@ -97,10 +109,16 @@ class FanoutPipeline:
         queue_cap: int = 65536,
         shape_routes: float = 0.0,
         shape_probe_s: float = 0.25,
+        supervisor: Any = None,
+        olp: Any = None,
+        deferred_cap: int = 4096,
     ) -> None:
         self.broker = broker
         self.metrics = metrics
         self.match_service = match_service
+        self.supervisor = supervisor
+        self.olp = olp
+        self.deferred_cap = deferred_cap
         self.max_batch = max_batch
         self.min_batch = min_batch
         self.window_s = window_s
@@ -111,8 +129,14 @@ class FanoutPipeline:
         self.shape_probe_s = shape_probe_s
 
         self._q: Deque[Message] = deque()
+        # overload-deferred retained/delayed publishes: parked while the
+        # Olp reports overload, re-queued when it clears (shed policy:
+        # QoS0 drops first, retained/delayed defer, QoS1/2 ride the
+        # window backpressure)
+        self._deferred: Deque[Message] = deque()
         self._wake = asyncio.Event()
         self._task: Optional[asyncio.Task] = None
+        self._child = None           # supervise.Child when supervised
         self._running = False
         self._busy = False  # a batch is mid-flight (prefetch await point)
         # arrival-rate window (mirrors MatchService._note_arrival)
@@ -134,12 +158,25 @@ class FanoutPipeline:
 
     async def start(self) -> None:
         self._running = True
-        self._task = asyncio.ensure_future(self._run())
+        if self.supervisor is not None:
+            # supervised: a crashed/killed drain loop restarts per
+            # policy instead of silently stopping delivery; the drain
+            # callback preserves the "accepted publishes never drop"
+            # guarantee if the SUPERVISOR stops us (node shutdown)
+            self._child = self.supervisor.start_child(
+                "broker.fanout", self._run, restart="permanent",
+                drain=self._drain_queue)
+        else:
+            self._task = asyncio.ensure_future(self._run())
 
     async def stop(self) -> None:
         """Stop draining; leftover queued messages take the per-message
         correctness path so shutdown never loses accepted publishes."""
         self._running = False
+        if self._child is not None:
+            await self._child.stop()   # runs _drain_queue after the task
+            self._child = None
+            return
         if self._task is not None:
             self._task.cancel()
             try:
@@ -147,6 +184,13 @@ class FanoutPipeline:
             except (asyncio.CancelledError, Exception):
                 pass
             self._task = None
+        self._drain_queue()
+
+    def _drain_queue(self) -> None:
+        """Republish everything still queued (and overload-deferred)
+        through the synchronous per-message path.  Idempotent."""
+        while self._deferred:
+            self._q.append(self._deferred.popleft())
         while self._q:
             msg = self._q.popleft()
             try:
@@ -174,6 +218,24 @@ class FanoutPipeline:
             return False
         T.validate(msg.topic, "name")  # parity with Broker.publish
         self._note_arrival()
+        olp = self.olp
+        if olp is not None and olp.overloaded():
+            # sustained overload (emqx_olp policy): shed QoS0 first,
+            # defer retained/delayed, and let QoS1/2 ride the normal
+            # queue — their backpressure is the inflight window
+            # (InflightFullError → mqueue) rather than queue growth.
+            if msg.retain or msg.topic.startswith("$delayed/"):
+                if len(self._deferred) < self.deferred_cap:
+                    self._deferred.append(msg)
+                    if self.metrics is not None:
+                        self.metrics.inc("broker.olp.deferred")
+                    return True
+                return False  # deferral full: sync path decides
+            if msg.qos == 0:
+                if self.metrics is not None:
+                    self.metrics.inc("broker.olp.shed_qos0")
+                self.broker.hooks.run("message.dropped", (msg, "olp_shed"))
+                return True   # consumed: dropped by policy, not queued
         if len(self._q) >= self.queue_cap:
             # overload: shed to the sync path rather than grow unbounded
             if self.metrics is not None:
@@ -229,9 +291,28 @@ class FanoutPipeline:
     # ------------------------------------------------------------------
 
     async def _run(self) -> None:
+        if self._q or self._deferred:
+            # supervisor restart mid-backlog: the previous run's wake
+            # may have been consumed — never stall on a non-empty queue
+            self._wake.set()
         while True:
             await self._wake.wait()
             self._wake.clear()
+            if _fi._injector is not None:
+                # chaos seam: BEFORE the batch pops, so a raise kills
+                # the drain task without stranding popped messages
+                act = _fi._injector.act("fanout.drain")
+                if act == "raise":
+                    raise _fi.InjectedFault("fanout.drain")
+                if act == "delay":
+                    await _fi._injector.pause()
+            if self.olp is not None:
+                self.olp.report(queue_depth=len(self._q))
+                if self._deferred and not self.olp.overloaded():
+                    # overload cleared: deferred retained/delayed
+                    # publishes rejoin the batch queue
+                    while self._deferred and len(self._q) < self.queue_cap:
+                        self._q.append(self._deferred.popleft())
             if not self._q:
                 continue
             if self.window_s > 0:
@@ -271,6 +352,9 @@ class FanoutPipeline:
                 )
             self.batches += 1
             self.msgs += n
+            if self._deferred and (
+                    self.olp is None or not self.olp.overloaded()):
+                self._wake.set()   # re-queue deferred next iteration
 
     # loop-fairness bound: at most this many messages fan out per
     # synchronous stretch; between chunks the drain loop yields so
@@ -488,6 +572,7 @@ class FanoutPipeline:
         return {
             "running": self._running,
             "depth": len(self._q),
+            "deferred": len(self._deferred),
             "batches": self.batches,
             "msgs": self.msgs,
             "batch_bound": self._batch_bound(),

@@ -19,6 +19,8 @@ import heapq
 import time
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
+from .. import faultinject as _fi
+
 __all__ = ["Inflight", "InflightFullError"]
 
 
@@ -47,6 +49,8 @@ class Inflight:
         return pid in self._d
 
     def insert(self, pid: int, value: Any, now: Optional[float] = None) -> None:
+        if _fi._injector is not None:
+            _fi._injector.check("inflight.insert")
         if self.is_full():
             raise InflightFullError(f"inflight window full ({self.max_size})")
         if pid in self._d:
@@ -61,6 +65,8 @@ class Inflight:
         """Bulk :meth:`insert` sharing ONE timestamp — the fanout
         pipeline admits a whole per-session batch with a single clock
         read and heap extension instead of one of each per message."""
+        if _fi._injector is not None:
+            _fi._injector.check("inflight.insert")
         items = list(items)
         if not items:
             return
@@ -117,6 +123,8 @@ class Inflight:
         pushed back — a caller that neither ``touch``es nor ``delete``s
         them sees them again next call, exactly like the full scan did.
         """
+        if _fi._injector is not None:
+            _fi._injector.check("inflight.retry")
         now = now if now is not None else time.time()
         cutoff = now - age_s
         exp = self._exp

@@ -52,6 +52,7 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from .. import faultinject as _fi
 from .. import topic as T
 from .trie import FilterTrie
 
@@ -180,10 +181,20 @@ class MatchService:
     async def start(self) -> None:
         self._running = True
         self._bootstrap()
-        self._tasks = [
-            asyncio.ensure_future(self._sync_loop()),
-            asyncio.ensure_future(self._batch_loop()),
-        ]
+        sup = getattr(self, "supervisor", None)
+        if sup is not None:
+            # supervised (node sets .supervisor before start): a crashed
+            # mirror-sync or batch loop restarts instead of freezing
+            # hint freshness / prefetch waiters until broker restart
+            self._tasks = [
+                sup.start_child("match.sync", self._sync_loop),
+                sup.start_child("match.batch", self._batch_loop),
+            ]
+        else:
+            self._tasks = [
+                asyncio.ensure_future(self._sync_loop()),
+                asyncio.ensure_future(self._batch_loop()),
+            ]
         self._dirty.set()
 
     async def stop(self) -> None:
@@ -466,6 +477,15 @@ class MatchService:
         whole set rides one batching window — one kernel call for the
         batch instead of one ``prefetch`` await per message.  Bounded by
         ``prefetch_timeout_s`` like the single-topic path."""
+        if _fi._injector is not None:
+            # chaos seam: a raised dispatch fault is caught by the
+            # fanout pipeline (host trie serves); a delay simulates a
+            # slow kernel round trip
+            act = _fi._injector.act("match.dispatch")
+            if act == "raise":
+                raise _fi.InjectedFault("match.dispatch")
+            if act == "delay":
+                await _fi._injector.pause()
         if not self._usable():
             return
         waits: List[asyncio.Future] = []

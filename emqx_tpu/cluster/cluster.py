@@ -160,11 +160,21 @@ class Cluster:
         for h, p in self.seeds:
             if (h, p) != (self.listen_host, self.listen_port):
                 await self._join(h, p)
+        sup = getattr(self.node, "supervisor", None)
+
+        def spawn(name, factory):
+            # supervised when the node carries a supervision tree: a
+            # crashed replication/heartbeat loop restarts with backoff
+            # instead of silently partitioning this node
+            if sup is not None:
+                return sup.start_child(name, factory)
+            return asyncio.ensure_future(factory())
+
         self._tasks = [
-            asyncio.ensure_future(self._heartbeat_loop()),
-            asyncio.ensure_future(self._sync_loop()),
-            asyncio.ensure_future(self._reconnect_loop()),
-            asyncio.ensure_future(self.durable.loop()),
+            spawn("cluster.heartbeat", self._heartbeat_loop),
+            spawn("cluster.sync", self._sync_loop),
+            spawn("cluster.reconnect", self._reconnect_loop),
+            spawn("cluster.durable", self.durable.loop),
         ]
 
     async def stop(self) -> None:

@@ -16,6 +16,7 @@ import logging
 import struct
 from typing import Awaitable, Callable, Dict, Optional
 
+from .. import faultinject as _fi
 from . import cluster_pb2 as pb
 
 log = logging.getLogger(__name__)
@@ -68,6 +69,16 @@ class PeerConn:
         owner's reconnect loop re-bootstraps), never buffered unbounded."""
         if self._closed:
             return
+        if _fi._injector is not None:
+            # chaos seam: drop one cluster frame on the floor (the
+            # replication seq-gap / heartbeat machinery must heal it)
+            # or fail the link outright (reconnect loop re-bootstraps)
+            act = _fi._injector.act("cluster.rpc")
+            if act == "drop":
+                return
+            if act == "raise":
+                self.close()
+                return
         try:
             transport = self._w.transport
             if (

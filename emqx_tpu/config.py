@@ -155,6 +155,21 @@ SCHEMA: Dict[str, Field] = {
     # while shape-bypassing, admit one probe message per interval so
     # the routes/message estimate tracks workload changes
     "broker.fanout.shape_probe": Field(0.25, duration),
+    # supervision tree (supervise.py): restart-intensity window and
+    # backoff for the node's long-lived background tasks.  Exceeding
+    # max_restarts within the window escalates to an alarm + degraded
+    # mode (restarts continue at backoff_max) instead of dying.
+    "supervisor.max_restarts": Field(5, int, lambda v: v >= 1),
+    "supervisor.window": Field(10.0, duration),
+    "supervisor.backoff_base": Field(0.05, duration),
+    "supervisor.backoff_max": Field(5.0, duration),
+    # overload protection (broker/olp.py, emqx_olp analog) wired into
+    # the fanout pipeline: sustained overload sheds QoS0 first and
+    # defers retained/delayed publishes instead of growing queues
+    "overload_protection.max_loop_lag": Field(0.5, duration),
+    "overload_protection.max_queue_depth": Field(
+        100_000, int, lambda v: v >= 1),
+    "overload_protection.cooloff": Field(5.0, duration),
     "broker.sys_msg_interval": Field(60.0, duration),
     "broker.sys_heartbeat_interval": Field(30.0, duration),
     "broker.enable_session_registry": Field(True, _bool),

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import grpc
 import grpc.aio
 
+from .. import faultinject as _fi
 from ..mqtt import packet as P
 from .rpc import HookProviderStub, MirrorSyncStub, pb
 
@@ -108,7 +109,12 @@ class ExHookManager:
             *(self._load_server(st) for st in self.servers)
         )
         self._register_notify_hooks()
-        self._reconnector = asyncio.ensure_future(self._reconnect_loop())
+        sup = getattr(self.node, "supervisor", None)
+        if sup is not None:
+            self._reconnector = sup.start_child(
+                "exhook.reconnect", self._reconnect_loop)
+        else:
+            self._reconnector = asyncio.ensure_future(self._reconnect_loop())
 
     async def _reconnect_loop(self) -> None:
         """Keep retrying servers that failed to load — a deny-policy
@@ -166,7 +172,16 @@ class ExHookManager:
             st.hooks = [h.name for h in resp.hooks if h.name in ALL_HOOKS]
             st.channel, st.stub = channel, stub
             if st.sender is None:
-                st.sender = asyncio.ensure_future(self._sender_loop(st))
+                sup = getattr(self.node, "supervisor", None)
+                if sup is not None:
+                    # supervised: a crashed notification drain restarts
+                    # instead of silently dropping every hook event for
+                    # this server until broker restart
+                    st.sender = sup.start_child(
+                        f"exhook.sender.{st.spec.name}",
+                        lambda st=st: self._sender_loop(st))
+                else:
+                    st.sender = asyncio.ensure_future(self._sender_loop(st))
             log.info("exhook server %s loaded hooks=%s", st.spec.name, st.hooks)
             await self._push_mirror_snapshot(st)
         except Exception as e:
@@ -515,6 +530,15 @@ class ExHookManager:
 
     async def _call(self, st: _ServerState, method: str, req) -> Tuple[Any, bool]:
         try:
+            if _fi._injector is not None:
+                # chaos seam: a raised call fault takes the server's
+                # failure_action path (deny fails closed, ignore open);
+                # a delay exercises the timeout handling
+                act = _fi._injector.act("exhook.call")
+                if act == "raise":
+                    raise _fi.InjectedFault("exhook.call")
+                if act == "delay":
+                    await _fi._injector.pause()
             resp = await asyncio.wait_for(
                 getattr(st.stub, method)(req), timeout=st.spec.timeout
             )

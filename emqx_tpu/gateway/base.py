@@ -201,7 +201,14 @@ class GatewayManager:
 
     async def load(self, name: str, conf: Dict[str, Any]) -> Gateway:
         if self._retry_task is None:
-            self._retry_task = asyncio.ensure_future(self._retry_loop())
+            sup = getattr(self.node, "supervisor", None)
+            if sup is not None:
+                # supervised: a crashed retry sweep restarts instead of
+                # leaving every gateway session's QoS1 inflight frozen
+                self._retry_task = sup.start_child(
+                    "gateway.retry", self._retry_loop)
+            else:
+                self._retry_task = asyncio.ensure_future(self._retry_loop())
         from .coap import CoapGateway
         from .exproto import ExProtoGateway
         from .lwm2m import Lwm2mGateway

@@ -13,6 +13,7 @@ from __future__ import annotations
 import struct
 from typing import Any, Dict, List, Optional, Tuple
 
+from .. import faultinject as _fi
 from . import packet as P
 
 __all__ = ["FrameError", "Parser", "serialize", "parse_one"]
@@ -238,6 +239,12 @@ class Parser:
         self._buf = bytearray()
 
     def feed(self, data: bytes) -> List[Any]:
+        if _fi._injector is not None:
+            # chaos seam: an injected parse fault takes the seam's
+            # NATIVE error path (FrameError → connection close), so
+            # recovery exercises the real malformed-packet handling
+            if _fi._injector.act("frame.parse") == "raise":
+                raise FrameError("injected fault: frame.parse")
         self._buf += data
         out: List[Any] = []
         while True:

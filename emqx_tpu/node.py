@@ -67,6 +67,28 @@ class BrokerNode:
         self.observed = observe(
             self.broker, sys_interval=cfg.get("broker.sys_msg_interval")
         )
+        # supervision tree (supervise.py): every long-lived background
+        # task (fanout drain, cluster loops, bridge workers, gateway
+        # retry, exhook senders, telemetry/statsd, housekeeping)
+        # registers here; restart intensity escalates to an alarm +
+        # degraded mode instead of dying
+        from .broker.olp import Olp
+        from .supervise import Supervisor
+
+        self.supervisor = Supervisor(
+            metrics=self.observed.metrics,
+            alarms=self.observed.alarms,
+            max_restarts=cfg.get("supervisor.max_restarts"),
+            window_s=cfg.get("supervisor.window"),
+            backoff_base=cfg.get("supervisor.backoff_base"),
+            backoff_max=cfg.get("supervisor.backoff_max"),
+        )
+        self.olp = Olp(
+            alarms=self.observed.alarms,
+            max_loop_lag=cfg.get("overload_protection.max_loop_lag"),
+            max_queue_depth=cfg.get("overload_protection.max_queue_depth"),
+            cooloff=cfg.get("overload_protection.cooloff"),
+        )
         # connection gauges come from the CM (a node-level table), so
         # they wire here rather than in observe(broker)
         self.observed.stats.provide(
@@ -183,7 +205,7 @@ class BrokerNode:
         # be able to close sockets that never completed a handshake
         self._all_conns: set = set()
         self.broker.on_deliver = self._on_deliver
-        self._jobs: List[asyncio.Task] = []
+        self._jobs: List[Any] = []  # tasks or supervised Child handles
         self.started_at = time.time()
         self._running = False
         self._configure_listeners()
@@ -600,6 +622,7 @@ class BrokerNode:
                 self.observed,
                 server=self.config.get("statsd.server"),
                 interval=self.config.get("statsd.flush_interval"),
+                supervisor=self.supervisor,
             )
             await self.statsd.start()
         if self.config.get("telemetry.enable"):
@@ -608,13 +631,15 @@ class BrokerNode:
             self.telemetry = Telemetry(
                 self, url=self.config.get("telemetry.url"),
                 interval=self.config.get("telemetry.interval"),
+                supervisor=self.supervisor,
             )
             await self.telemetry.start()
         self._start_ocsp()
         await self._start_quic()
         await self.listeners.start_all()
         self._running = True
-        self._jobs.append(asyncio.ensure_future(self._housekeeping()))
+        self._jobs.append(self.supervisor.start_child(
+            "node.housekeeping", self._housekeeping))
 
     async def _start_quic(self) -> None:
         """MQTT-over-QUIC listener (quicer analog): the in-repo
@@ -784,6 +809,7 @@ class BrokerNode:
                 short_depth=cfg.get("tpu.short_depth"),
                 split_min=cfg.get("tpu.split_min"),
             )
+            self.match_service.supervisor = self.supervisor
             await asyncio.wait_for(
                 self.match_service.start(),
                 timeout=cfg.get("tpu.start_timeout"),
@@ -812,6 +838,8 @@ class BrokerNode:
             queue_cap=cfg.get("broker.fanout.queue_cap"),
             shape_routes=cfg.get("broker.fanout.shape_routes"),
             shape_probe_s=cfg.get("broker.fanout.shape_probe"),
+            supervisor=self.supervisor,
+            olp=self.olp,
         )
         await self.fanout_pipeline.start()
         self.broker.fanout = self.fanout_pipeline
@@ -969,6 +997,9 @@ class BrokerNode:
         if self._jobs:
             await asyncio.gather(*self._jobs, return_exceptions=True)
         self._jobs.clear()
+        # sweep the supervision tree: any child not already stopped by
+        # its subsystem's stop() goes down here, reverse boot order
+        await self.supervisor.stop()
         if self.persistence is not None:
             self.persistence.close()
         # kick live connections BEFORE awaiting listener close: 3.12's
@@ -1070,5 +1101,6 @@ class BrokerNode:
                           if self.match_service is not None else None),
             "fanout": (self.fanout_pipeline.info()
                        if self.fanout_pipeline is not None else None),
+            "supervisor": self.supervisor.info(),
             **self.broker.stats(),
         }

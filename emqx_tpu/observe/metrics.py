@@ -18,6 +18,7 @@ from typing import Dict, Iterable, List, Optional
 
 __all__ = [
     "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
+    "ROBUSTNESS_METRIC_NAMES",
 ]
 
 # -- the reference's fixed counter names, grouped as in emqx_metrics.erl [U]
@@ -103,6 +104,14 @@ FANOUT_METRIC_NAMES: List[str] = [
     "broker.inflight.batch_admitted", "broker.ack.coalesced_writes",
 ]
 
+# -- supervision tree (supervise.py) + overload shedding on the batched
+# delivery path (broker/olp.py wired into broker/fanout.py).  restarts
+# accumulates; degraded is the CURRENT degraded-child count (set).
+ROBUSTNESS_METRIC_NAMES: List[str] = [
+    "broker.supervisor.restarts", "broker.supervisor.degraded",
+    "broker.olp.shed_qos0", "broker.olp.deferred",
+]
+
 
 class Metrics:
     """A counter table with the reference's fixed name set.
@@ -117,6 +126,7 @@ class Metrics:
         self._c: Dict[str, int] = {n: 0 for n in METRIC_NAMES}
         self._c.update({n: 0 for n in TPU_METRIC_NAMES})
         self._c.update({n: 0 for n in FANOUT_METRIC_NAMES})
+        self._c.update({n: 0 for n in ROBUSTNESS_METRIC_NAMES})
         if extra:
             self._c.update({n: 0 for n in extra})
 

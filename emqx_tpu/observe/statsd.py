@@ -20,12 +20,14 @@ __all__ = ["StatsdPusher"]
 
 class StatsdPusher:
     def __init__(self, observed: Any, server: str = "127.0.0.1:8125",
-                 interval: float = 30.0, prefix: str = "emqx") -> None:
+                 interval: float = 30.0, prefix: str = "emqx",
+                 supervisor: Any = None) -> None:
         host, _, port = server.rpartition(":")
         self.addr = (host or "127.0.0.1", int(port or 8125))
         self.observed = observed
         self.interval = interval
         self.prefix = prefix
+        self.supervisor = supervisor
         self._sock: Optional[socket.socket] = None
         self._task: Optional[asyncio.Task] = None
         self.pushes = 0
@@ -65,7 +67,10 @@ class StatsdPusher:
                 await asyncio.sleep(self.interval)
                 self.push()
 
-        self._task = asyncio.ensure_future(loop())
+        if self.supervisor is not None:
+            self._task = self.supervisor.start_child("observe.statsd", loop)
+        else:
+            self._task = asyncio.ensure_future(loop())
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -24,10 +24,12 @@ __all__ = ["Telemetry"]
 
 class Telemetry:
     def __init__(self, node: Any, url: str = "",
-                 interval: float = 7 * 24 * 3600.0) -> None:
+                 interval: float = 7 * 24 * 3600.0,
+                 supervisor: Any = None) -> None:
         self.node = node
         self.url = url
         self.interval = interval
+        self.supervisor = supervisor
         self.started_at = time.time()
         self.uuid = str(uuid.uuid4())   # random per boot; no identity
         self._task: Optional[asyncio.Task] = None
@@ -91,7 +93,11 @@ class Telemetry:
                 await self.send_once()
                 await asyncio.sleep(self.interval)
 
-        self._task = asyncio.ensure_future(loop())
+        if self.supervisor is not None:
+            self._task = self.supervisor.start_child(
+                "observe.telemetry", loop)
+        else:
+            self._task = asyncio.ensure_future(loop())
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -39,7 +39,8 @@ Supported (the surface rule engines actually use):
 * regex (Python ``re`` over the common Oniguruma subset, named groups
   auto-translated): ``test(re[;flags])``, ``match``, ``capture``,
   ``sub``, ``gsub`` — replacement expressions see the named captures
-  as ``.``, flags ``g i x s m``;
+  as ``.`` and fan multi-output replacements out cartesian-style over
+  every match (real-jq parity), flags ``g i x s m``;
 * dates (UTC, jq's gmtime family): ``now``, ``gmtime``, ``mktime``,
   ``todate[iso8601]``, ``fromdate[iso8601]``, ``strftime``,
   ``strptime``;
@@ -143,6 +144,8 @@ def _fmt_row(v: Any, cell, sep: str) -> str:
 
 def _fmt_sh(v: Any) -> str:
     def one(x):
+        if x is None:
+            return "null"   # jq formats null via tojson, like booleans
         if isinstance(x, bool):
             return "true" if x else "false"
         if isinstance(x, (int, float)):
@@ -1584,19 +1587,30 @@ def _call(name: str, args: List[Any], v: Any,
             raise JqError(f"jq: {name} needs a string input")
         flags = one(2) if n == 3 else ""
         rx = _jq_regex(one(0), flags)
-        count = 0 if name == "gsub" or "g" in flags else 1
-
-        def repl(m) -> str:
-            # jq evaluates the replacement EXPRESSION with the named
-            # captures as `.` (first output used when it fans out)
+        ms = list(rx.finditer(v))
+        if not (name == "gsub" or "g" in flags):
+            ms = ms[:1]
+        if not ms:
+            return [v]
+        # jq evaluates the replacement EXPRESSION with the named
+        # captures as `.` and fans its output stream out cartesian-style
+        # over every match: earlier matches vary slowest (the recursive
+        # sub-on-the-remainder order real jq produces)
+        acc = [""]
+        prev = 0
+        for m in ms:
             outs = _eval(args[1], m.groupdict(), env)
             if not outs:
                 raise JqError(f"jq: {name} replacement produced no value")
-            r = outs[0]
-            if not isinstance(r, str):
-                raise JqError(f"jq: {name} replacement must be a string")
-            return r
-        return [rx.sub(repl, v, count=count)]
+            for r in outs:
+                if not isinstance(r, str):
+                    raise JqError(
+                        f"jq: {name} replacement must be a string")
+            seg = v[prev:m.start()]
+            acc = [a + seg + r for a in acc for r in outs]
+            prev = m.end()
+        tail = v[prev:]
+        return [a + tail for a in acc]
     if name == "first" and n == 0:      # jq defines first as .[0]:
         if not isinstance(v, list):     # null on empty, not an error
             raise JqError("jq: first needs an array")

@@ -33,6 +33,7 @@ import logging
 import time
 from typing import Any, List, Optional
 
+from .. import faultinject as _fi
 from ..broker.channel import Channel
 from ..broker.limiter import LimiterGroup
 from ..mqtt import frame as F
@@ -362,6 +363,18 @@ class MqttProtocol(asyncio.Protocol):
         self.pkts_out += len(chunks)
         data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
         self.bytes_out += len(data)
+        if _fi._injector is not None and not self._batching:
+            # chaos seam: the fanout emit path writes here directly
+            # (outside an inbound batch) — same drop/dup semantics as
+            # the coalesced flush
+            act = _fi._injector.act("transport.write")
+            if act == "drop":
+                return
+            if act == "dup" and not self._paused_write \
+                    and self.transport is not None:
+                self.transport.write(data)
+            if act == "raise":
+                raise _fi.InjectedFault("transport.write")
         if self._batching:
             # deliveries landing re-entrantly while an inbound batch is
             # being handled (publisher subscribed to its own topic) stay
@@ -420,6 +433,17 @@ class MqttProtocol(asyncio.Protocol):
         if self._wbuf_pkts > 1 and self.metrics is not None:
             self.metrics.inc("broker.ack.coalesced_writes")
         self._wbuf_pkts = 0
+        if _fi._injector is not None:
+            # chaos seam: lose or duplicate one coalesced flush on the
+            # wire — the session retry machinery must heal the gap
+            act = _fi._injector.act("transport.write")
+            if act == "drop":
+                return
+            if act == "dup" and not self._paused_write \
+                    and self.transport is not None:
+                self.transport.write(data)
+            if act == "raise":
+                raise _fi.InjectedFault("transport.write")
         if self._paused_write:
             self._pending_out.append(data)
         elif self.transport is not None:

@@ -188,6 +188,12 @@ class _Conn:
             else [])
         self._mtu_fails: Dict[int, int] = {}
         self.mtu_probes_sent = 0
+        # black-hole detection state (RFC 8899 §4.3): consecutive losses
+        # of packets LARGER than the base PLPMTU, tracked independently
+        # of _pto_count (which resets on every ack — on a mixed-traffic
+        # path whose MTU shrank, small packets keep flowing and would
+        # keep resetting it, so the fallback would never fire)
+        self._big_loss_streak = 0
         self.last_seen = time.monotonic()
 
     # -- key plumbing --------------------------------------------------
@@ -292,10 +298,21 @@ class _Conn:
                     acked = [pn for pn in sent
                              if any(lo <= pn <= hi for lo, hi in rngs)]
                     now = time.monotonic()
+                    probe_pn = (self._mtu_probe[0]
+                                if level == LEVEL_APP
+                                and self._mtu_probe is not None else None)
                     for pn in acked:
-                        t_sent, _ = sent.pop(pn)
+                        t_sent, frs = sent.pop(pn)
                         if pn == fr.largest:    # RFC 9002 §5: sample on
                             self._rtt_sample(now - t_sent)  # largest
+                        if pn == probe_pn:
+                            # DPLPMTUD probe: discovery traffic, not
+                            # congestion feedback — no cwnd growth
+                            continue
+                        if self._frames_len(frs) > self._MTU_STREAM_CHUNK:
+                            # a full-size packet got through: the path
+                            # carries the validated MTU (RFC 8899 §4.3)
+                            self._big_loss_streak = 0
                         # congestion window growth, per acked packet
                         if self._cwnd < self._ssthresh:
                             self._cwnd += 1.0           # slow start
@@ -321,6 +338,15 @@ class _Conn:
             # on the next _service() after key derivation, instead of
             # being silently discarded
             return []
+        if level == LEVEL_APP:
+            # re-segment at FLUSH time, not only at the black-hole
+            # transition: stream frames requeued from _sent on a later
+            # PTO tick (or queued before the MTU shrank) must never
+            # leave oversized again
+            for fr in self._pending_frames[level]:
+                if len(fr) > self._mtu_chunk and 0x08 <= fr[0] <= 0x0F:
+                    self._resegment_app_frames()
+                    break
         frames = self._pending_frames[level]
         if self._ack_due[level] and self._recv_pns[level]:
             frames.insert(0, FR.encode_ack(self._recv_pns[level]))
@@ -491,7 +517,12 @@ class _Conn:
             return
         for pn in sorted(lost):         # original send order
             _, frames = sent.pop(pn)
+            if level == LEVEL_APP \
+                    and self._frames_len(frames) > self._MTU_STREAM_CHUNK:
+                self._big_loss_streak += 1
             self._pending_frames[level].extend(frames)
+        if level == LEVEL_APP:
+            self._maybe_mtu_black_hole()
         self.fast_retransmits += 1
         if max(lost) >= self._recovery_until[level]:
             # first loss of this round trip: one multiplicative
@@ -504,6 +535,37 @@ class _Conn:
 
     # -- DPLPMTUD (RFC 8899 / RFC 9000 §14.3) --------------------------
 
+    @staticmethod
+    def _frames_len(frames: List[bytes]) -> int:
+        return sum(len(f) for f in frames)
+
+    # consecutive larger-than-base-PLPMTU losses before the black-hole
+    # fallback fires (RFC 8899 §4.3's MAX_PROBES analog)
+    BLACK_HOLE_STREAK = 3
+
+    def _maybe_mtu_black_hole(self) -> None:
+        """Fire the PLPMTU black-hole fallback on a streak of big-packet
+        losses — independent of the ack-reset PTO counter, so a path
+        whose MTU shrank under mixed traffic (small packets still
+        flowing, acks resetting ``_pto_count``) still falls back."""
+        if (self._big_loss_streak < self.BLACK_HOLE_STREAK
+                or self.mtu_validated <= 1252):
+            return
+        self._mtu_black_hole_fallback()
+
+    def _mtu_black_hole_fallback(self) -> None:
+        """Persistent loss of full-size packets after an MTU was
+        validated usually means the path shrank (route change under a
+        DF socket) — fall back to the base PLPMTU and re-segment
+        anything queued at the old size.  The ladder stays retired: a
+        shrinking path has proven itself unstable."""
+        self.mtu_validated = 1252
+        self._mtu_chunk = self._MTU_STREAM_CHUNK
+        self._mtu_ladder = []
+        self._mtu_probe = None
+        self._big_loss_streak = 0
+        self._resegment_app_frames()
+
     def _maybe_send_mtu_probe(self) -> None:
         """One PING+PADDING probe datagram at the next ladder size;
         at most one in flight.  An acked probe raises the validated
@@ -512,6 +574,11 @@ class _Conn:
         validated size."""
         if (self._mtu_probe is not None or not self._mtu_ladder
                 or not self.handshake_done or self.closed):
+            return
+        if self._largest_acked[LEVEL_APP] < self._recovery_until[LEVEL_APP]:
+            # in recovery (RFC 9002 §7.3.2): discovery probes would
+            # compete with retransmissions for a shrunken window — wait
+            # until the loss edge is acked
             return
         keys = self._send_keys(LEVEL_APP)
         if keys is None:
@@ -615,6 +682,9 @@ class _Conn:
             fired = True
             for pn in sorted(late):     # original send order
                 _, frames = sent.pop(pn)
+                if level == LEVEL_APP \
+                        and self._frames_len(frames) > self._MTU_STREAM_CHUNK:
+                    self._big_loss_streak += 1
                 self._pending_frames[level].extend(frames)
         if not fired and (self._stream_txq or
                           (self.handshake_done and self._mtu_ladder
@@ -629,19 +699,13 @@ class _Conn:
         if fired:
             self.retransmits += 1
             self._pto_count += 1        # exponential backoff
+            # black-hole detection: the streak counter (big-packet
+            # losses, ack-independent — see _maybe_mtu_black_hole) is
+            # the primary trigger; two consecutive PTOs with a raised
+            # MTU stay as the belt-and-braces backstop
+            self._maybe_mtu_black_hole()
             if self._pto_count == 2 and self.mtu_validated > 1252:
-                # black-hole detection (RFC 8899 §4.3): persistent
-                # loss of full-size packets after an MTU was validated
-                # usually means the path shrank (route change under a
-                # DF socket) — fall back to the base PLPMTU and
-                # re-segment anything queued at the old size.  The
-                # ladder stays retired: a shrinking path has proven
-                # itself unstable.
-                self.mtu_validated = 1252
-                self._mtu_chunk = self._MTU_STREAM_CHUNK
-                self._mtu_ladder = []
-                self._mtu_probe = None
-                self._resegment_app_frames()
+                self._mtu_black_hole_fallback()
             if self._pto_count == 2:
                 # persistent congestion (RFC 9002 §7.6, PTO proxy):
                 # two consecutive timeouts with no ack in between —

@@ -20,6 +20,12 @@ Modes:
 Prints one JSON object: per_message / pipeline sections plus the
 delivered-msgs/s ``speedup`` (QoS0 fields at top level for
 compatibility; the acknowledged A/B nests under ``"qos1"``).
+
+``--chaos`` adds a ``"chaos"`` section: one kill-and-recover cycle per
+delivery subsystem (fanout drain, cluster replication, bridge sink,
+exhook channel) under the supervision tree, asserting QoS1 delivery
+stays exactly-once through the wound — the CI-fast slice of
+``tests/test_chaos_delivery.py``.
 """
 
 import argparse
@@ -31,10 +37,210 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def chaos_smoke() -> dict:
+    """One kill-and-recover cycle per subsystem; each section reports
+    ok plus the evidence (restart counts, delivered totals)."""
+    import asyncio as aio
+
+    from emqx_tpu.broker import (
+        Broker, FanoutPipeline, SubOpts, make_message,
+    )
+    from emqx_tpu.observe.metrics import Metrics
+    from emqx_tpu.supervise import Supervisor
+
+    def sup_of(m):
+        return Supervisor(metrics=m, backoff_base=0.001,
+                          backoff_max=0.01, jitter=0.0)
+
+    async def settle(pred, timeout=8.0):
+        deadline = aio.get_event_loop().time() + timeout
+        while not pred() and aio.get_event_loop().time() < deadline:
+            await aio.sleep(0.002)
+        return pred()
+
+    async def fanout_cycle():
+        b = Broker()
+        m = Metrics()
+        sup = sup_of(m)
+        sess, _ = b.open_session("sub", max_inflight=64)
+        b.subscribe("sub", "t/#", SubOpts(qos=1))
+        got, dups = [], [0]
+
+        def on_deliver(cid, pubs):
+            stack = list(pubs)
+            while stack:
+                p = stack.pop(0)
+                got.append(p.msg.payload)
+                if p.msg.dup:
+                    dups[0] += 1
+                if p.pid is not None:
+                    _, more = sess.puback(p.pid)
+                    stack.extend(more)
+
+        b.on_deliver = on_deliver
+        p = FanoutPipeline(b, window_s=0.0, supervisor=sup, metrics=m)
+        await p.start()
+        b.fanout = p
+        n = 200
+        killed = False
+        for i in range(n):
+            p.offer(make_message("pub", "t/x", b"%d" % i, qos=1))
+            if i == n // 2:
+                await aio.sleep(0.005)   # let the drain loop spin up
+                killed = p._child.kill()
+                await aio.sleep(0.003)   # ... and the restart land
+        ok = await settle(lambda: len(got) >= n)
+        delivered = len(got)
+        exactly_once = sorted(int(x) for x in got) == list(range(n))
+        restarts = m.get("broker.supervisor.restarts")
+        await p.stop()
+        await sup.stop()
+        return {"ok": bool(ok and killed and exactly_once and not dups[0]
+                           and restarts >= 1),
+                "delivered": delivered, "duplicates": dups[0],
+                "restarts": restarts}
+
+    async def cluster_cycle():
+        from emqx_tpu.client import Client
+        from emqx_tpu.config import Config
+        from emqx_tpu.node import BrokerNode
+
+        async def start(name, seeds=""):
+            cfg = Config(file_text=(
+                f'node.name = "{name}"\n'
+                'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+                'cluster.enable = true\n'
+                'cluster.listen = "127.0.0.1:0"\n'
+                f'cluster.seeds = "{seeds}"\n'
+                'cluster.heartbeat_interval = 200ms\n'
+            ))
+            cfg.put("tpu.enable", False)
+            node = BrokerNode(cfg)
+            await node.start()
+            node.cluster.SYNC_INTERVAL = 0.02
+            return node
+
+        n1 = await start("chaos1@smoke")
+        n2 = await start(
+            "chaos2@smoke", seeds=f"127.0.0.1:{n1.cluster.listen_port}")
+        try:
+            peered = await settle(
+                lambda: n2.cluster.name in n1.cluster.peers
+                and n1.cluster.peers[n2.cluster.name].up)
+            child = n1.supervisor.lookup("cluster.sync")
+            killed = child is not None and child.kill()
+            sub = Client(clientid="cs", port=n1.listeners.all()[0].port)
+            await sub.connect()
+            await sub.subscribe("chaos/+/x", qos=1)
+            replicated = await settle(
+                lambda: bool(n2.broker.router.match_routes("chaos/a/x")))
+            pub = Client(clientid="cp", port=n2.listeners.all()[0].port)
+            await pub.connect()
+            await pub.publish("chaos/a/x", b"hello", qos=1)
+            got = await sub.recv(timeout=5)
+            restarts = n1.observed.metrics.get("broker.supervisor.restarts")
+            await sub.disconnect()
+            await pub.disconnect()
+            return {"ok": bool(peered and killed and replicated
+                               and got.payload == b"hello"
+                               and restarts >= 1),
+                    "restarts": restarts}
+        finally:
+            await n2.stop()
+            await n1.stop()
+
+    async def bridge_cycle():
+        from emqx_tpu.bridge.resource import BufferedWorker, Connector
+
+        class Sink(Connector):
+            def __init__(self):
+                self.got = []
+
+            async def send(self, items):
+                self.got.extend(items)
+
+        m = Metrics()
+        sup = sup_of(m)
+        sink = Sink()
+        w = BufferedWorker(sink, name="chaos", batch_size=4,
+                           retry_base=0.001, retry_max=0.01)
+        w.supervisor = sup
+        await w.start()
+        items = [f"i{n}" for n in range(40)]
+        for i, it in enumerate(items):
+            w.enqueue(it)
+            if i == 20:
+                w._tasks[0].kill()
+                await aio.sleep(0.002)
+            await aio.sleep(0)
+        ok = await settle(lambda: set(sink.got) >= set(items))
+        restarts = m.get("broker.supervisor.restarts")
+        await w.stop()
+        await sup.stop()
+        return {"ok": bool(ok and restarts >= 1),
+                "delivered": len(set(sink.got)), "restarts": restarts}
+
+    async def exhook_cycle():
+        try:
+            import types
+
+            from emqx_tpu.exhook.manager import (
+                ExHookManager, ServerSpec, _ServerState,
+            )
+        except ImportError:
+            return {"skipped": "grpc unavailable"}
+
+        class FakeStub:
+            def __init__(self):
+                self.calls = []
+
+            def OnClientConnected(self, req):
+                async def go():
+                    self.calls.append(req)
+                return go()
+
+        b = Broker()
+        m = Metrics()
+        sup = sup_of(m)
+        node = types.SimpleNamespace(broker=b, supervisor=sup,
+                                     started_at=0.0)
+        mgr = ExHookManager(node, [])
+        st = _ServerState(spec=ServerSpec(name="s1", url="inproc"))
+        st.stub = FakeStub()
+        st.hooks = ["client.connected"]
+        mgr.servers = [st]
+        st.sender = sup.start_child("exhook.sender.s1",
+                                    lambda: mgr._sender_loop(st))
+        for i in range(3):
+            st.queue.put_nowait(("OnClientConnected", i))
+        await settle(lambda: len(st.stub.calls) == 3)
+        st.sender.kill()
+        for i in range(3, 6):
+            st.queue.put_nowait(("OnClientConnected", i))
+        ok = await settle(lambda: len(st.stub.calls) == 6)
+        restarts = m.get("broker.supervisor.restarts")
+        st.sender.cancel()
+        await sup.stop()
+        return {"ok": bool(ok and restarts >= 1),
+                "notified": len(st.stub.calls), "restarts": restarts}
+
+    async def all_cycles():
+        return {
+            "fanout": await fanout_cycle(),
+            "cluster": await cluster_cycle(),
+            "bridge": await bridge_cycle(),
+            "exhook": await exhook_cycle(),
+        }
+
+    return aio.run(all_cycles())
+
+
 def main(argv=None) -> dict:
     ap = argparse.ArgumentParser(prog="bench_e2e")
     ap.add_argument("--smoke", action="store_true",
                     help="small-N CPU smoke (<60 s), for per-PR tracking")
+    ap.add_argument("--chaos", action="store_true",
+                    help="add one kill-and-recover cycle per subsystem")
     ap.add_argument("--duration", type=float, default=None,
                     help="override per-run duration (s)")
     args = ap.parse_args(argv)
@@ -50,6 +256,8 @@ def main(argv=None) -> dict:
         qsize["duration"] = args.duration
     out = bench_fanout_e2e(**size)
     out["qos1"] = bench_qos1_e2e(**qsize)
+    if args.chaos:
+        out["chaos"] = chaos_smoke()
     print(json.dumps(out, indent=2))
     return out
 

@@ -22,7 +22,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_bench_e2e_smoke_delivers_everything():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "bench_e2e.py"),
-         "--smoke"],
+         "--smoke", "--chaos"],
         capture_output=True, text=True, timeout=300,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
@@ -42,3 +42,10 @@ def test_bench_e2e_smoke_delivers_everything():
         assert sec["delivery_ratio"] == 1.0, (path, sec)
         assert sec["duplicates"] == 0, (path, sec)
     assert out["qos1"]["speedup"] > 0
+    # chaos smoke: one kill-and-recover cycle per subsystem, each
+    # healing via supervisor restart with delivery intact
+    for name, section in out["chaos"].items():
+        if section.get("skipped"):
+            continue
+        assert section["ok"], (name, section)
+        assert section["restarts"] >= 1, (name, section)

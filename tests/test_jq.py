@@ -409,6 +409,14 @@ REGEX_CASES = [
     ('test("HI"; "i")', "hi there", [True]),
     ('test("nope")', "hi there", [False]),
     ('[splits("[, ]+")]', "a, b,c", [["a", "b", "c"]]),
+    # multi-output replacements fan out cartesian-style over matches
+    # (real-jq parity; earlier matches vary slowest)
+    ('[sub("a"; "x", "y")]', "banana", [["bxnana", "bynana"]]),
+    ('[gsub("n"; "1", "2")]', "banana",
+     [["ba1a1a", "ba1a2a", "ba2a1a", "ba2a2a"]]),
+    ('sub("zzz"; "x", "y")', "banana", ["banana"]),   # no match: input
+    ('[gsub("(?<c>[aeiou])"; .c, "_")]', "ox",
+     [["ox", "_x"]]),
 ]
 
 
@@ -566,6 +574,10 @@ FORMAT_CASES = [
     ('@html', "<b>&'\"", ["&lt;b&gt;&amp;&#39;&quot;"]),
     ('@uri', "a b/c?", ["a%20b%2Fc%3F"]),
     ('@sh', ["a b", "it's"], ["'a b' 'it'\\''s'"]),
+    # jq formats null via tojson (like booleans/numbers): "null", not
+    # an error
+    ('@sh', None, ["null"]),
+    ('@sh', [None, "x"], ["null 'x'"]),
     # format-prefixed strings format INTERPOLATIONS only, jq-style
     ('@base64 "user=\\(.u)"', {"u": "bob"}, ["user=Ym9i"]),
     ('@uri "q=\\(.q)&x=1"', {"q": "a b"}, ["q=a%20b&x=1"]),

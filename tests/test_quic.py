@@ -785,3 +785,116 @@ def test_pmtud_black_hole_falls_back_to_base_mtu():
         if bytes(box[0]._stream_in) == payload:
             break
     assert bytes(box[0]._stream_in) == payload
+
+
+# ---------------------------------------------------------------------------
+# PLPMTUD black-hole detection under mixed traffic (ADVICE round 5)
+# ---------------------------------------------------------------------------
+
+def test_blackhole_streak_fires_despite_ack_resets():
+    """Regression: on a path whose MTU shrank while SMALL packets keep
+    flowing, every ack resets _pto_count, so the old _pto_count==2
+    fallback never fired and jumbo frames retransmitted at the dead
+    size forever.  The streak counter (consecutive losses of packets
+    larger than the base PLPMTU, RFC 8899 §4.3) must fire regardless."""
+    from emqx_tpu.transport.quic import frames as FR
+    from emqx_tpu.transport.quic.tls13 import LEVEL_APP
+
+    client = QuicClient(mtu_discovery=True)
+    # pretend DPLPMTUD validated a jumbo path earlier
+    client.mtu_validated = 9000
+    client._mtu_chunk = 9000 - 70
+    big = FR.encode_stream(0, 0, b"x" * 5000)
+    for i in range(client.BLACK_HOLE_STREAK):
+        client._sent[LEVEL_APP][100 + i] = (0.0, [big])
+        assert client.on_timer(now=1e9) is True   # jumbo declared lost
+        # mixed traffic: an interleaved small-packet ack keeps resetting
+        # the PTO backoff counter — the OLD trigger can never reach 2
+        client._pto_count = 0
+    assert client.mtu_validated == 1252
+    assert client._mtu_chunk == client._MTU_STREAM_CHUNK
+    assert not client._mtu_ladder                 # ladder stays retired
+    # everything still pending was re-segmented to the base chunk
+    for fr in client._pending_frames[LEVEL_APP]:
+        if 0x08 <= fr[0] <= 0x0F:
+            assert len(fr) <= client._MTU_STREAM_CHUNK + 16
+
+
+def test_blackhole_streak_resets_when_big_packet_acked():
+    """A delivered full-size packet proves the path still carries the
+    validated MTU: the loss streak must restart from zero."""
+    from emqx_tpu.transport.quic import frames as FR
+    from emqx_tpu.transport.quic.packet import PKT_1RTT, PlainPacket
+    from emqx_tpu.transport.quic.tls13 import LEVEL_APP
+
+    client = QuicClient(mtu_discovery=True)
+    client.mtu_validated = 9000
+    client._mtu_chunk = 9000 - 70
+    big = FR.encode_stream(0, 0, b"x" * 5000)
+    for i in range(client.BLACK_HOLE_STREAK - 1):
+        client._sent[LEVEL_APP][100 + i] = (0.0, [big])
+        client.on_timer(now=1e9)
+        client._pto_count = 0
+    assert client._big_loss_streak == client.BLACK_HOLE_STREAK - 1
+    # a big packet gets through and is acked
+    client._sent[LEVEL_APP][200] = (0.0, [big])
+    client._on_packet(PlainPacket(kind=PKT_1RTT, dcid=b"", scid=b"",
+                                  pn=0, payload=FR.encode_ack([200])))
+    assert client._big_loss_streak == 0
+    assert client.mtu_validated == 9000           # no fallback
+
+
+def test_resegment_on_requeue_at_flush_time():
+    """Regression (ADVICE round 5, second half): a jumbo stream frame
+    requeued from _sent AFTER the fallback transition must be split at
+    flush time, not re-sent oversized indefinitely."""
+    from emqx_tpu.transport.quic import frames as FR
+    from emqx_tpu.transport.quic.tls13 import LEVEL_APP
+
+    client = QuicClient(mtu_discovery=True)
+    client._keys[LEVEL_APP] = initial_keys(b"\x00" * 8)
+    # a frame built when the validated MTU was 9000 ...
+    big = FR.encode_stream(0, 0, b"y" * 4000)
+    # ... lands in the pending queue after the path shrank back
+    client._mtu_chunk = client._MTU_STREAM_CHUNK
+    client._pending_frames[LEVEL_APP].append(big)
+    out = client._flush_level(LEVEL_APP)
+    assert out
+    assert all(len(pkt) <= 1252 for pkt in out)
+    assert not client._pending_frames[LEVEL_APP]  # all flushed, none jumbo
+
+
+def test_probe_ack_excluded_from_cwnd_growth():
+    """ADVICE round 5 (low): an acked DPLPMTUD probe is discovery
+    traffic, not congestion feedback — it must not grow cwnd."""
+    from emqx_tpu.transport.quic import frames as FR
+    from emqx_tpu.transport.quic.packet import PKT_1RTT, PlainPacket
+    from emqx_tpu.transport.quic.tls13 import LEVEL_APP
+
+    client = QuicClient(mtu_discovery=True)
+    client._mtu_probe = (7, 4096)
+    client._sent[LEVEL_APP][7] = (0.0, [])
+    cwnd0 = client._cwnd
+    client._on_packet(PlainPacket(kind=PKT_1RTT, dcid=b"", scid=b"",
+                                  pn=0, payload=FR.encode_ack([7])))
+    assert client._cwnd == cwnd0                 # no growth
+    assert client.mtu_validated == 4096          # probe result applied
+
+
+def test_no_mtu_probe_while_in_recovery():
+    """ADVICE round 5 (low): discovery probes must not compete with
+    retransmissions for a shrunken window — skip probing until the
+    loss edge is acked."""
+    from emqx_tpu.transport.quic.tls13 import LEVEL_APP
+
+    client = QuicClient(mtu_discovery=True)
+    client._keys[LEVEL_APP] = initial_keys(b"\x00" * 8)
+    client.handshake_done = True
+    client._mtu_ladder = [1452]
+    client._recovery_until[LEVEL_APP] = 10
+    client._largest_acked[LEVEL_APP] = 2          # edge not acked yet
+    client._maybe_send_mtu_probe()
+    assert client._mtu_probe is None              # held back
+    client._largest_acked[LEVEL_APP] = 10         # recovery over
+    client._maybe_send_mtu_probe()
+    assert client._mtu_probe is not None

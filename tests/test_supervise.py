@@ -1,0 +1,435 @@
+"""Supervision tree (supervise.py) + fault injector (faultinject.py):
+restart policies, deterministic backoff/jitter under an injected clock,
+restart-intensity escalation to degraded mode (alarm + metric), reverse
+shutdown ordering with drain, and the zero-cost-when-disabled guarantee
+of the injection seams."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu import faultinject
+from emqx_tpu.broker import Broker, FanoutPipeline, SubOpts, make_message
+from emqx_tpu.faultinject import FaultInjector, InjectedFault
+from emqx_tpu.observe.alarm import Alarms
+from emqx_tpu.observe.metrics import Metrics
+from emqx_tpu.supervise import Supervisor
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def until(pred, timeout=5.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not pred() and asyncio.get_event_loop().time() < deadline:
+        await asyncio.sleep(0.002)
+    return pred()
+
+
+def fast_sup(**kw):
+    kw.setdefault("backoff_base", 0.001)
+    kw.setdefault("backoff_max", 0.01)
+    kw.setdefault("jitter", 0.0)
+    return Supervisor(**kw)
+
+
+# ---------------------------------------------------------------------------
+# restart policies
+# ---------------------------------------------------------------------------
+
+def test_permanent_restarts_on_crash_and_normal_exit():
+    async def main():
+        runs = {"n": 0}
+
+        async def worker():
+            runs["n"] += 1
+            if runs["n"] == 1:
+                raise RuntimeError("boom")
+            if runs["n"] == 2:
+                return                      # normal exit: still restarted
+            await asyncio.Event().wait()    # park
+
+        m = Metrics()
+        sup = fast_sup(metrics=m)
+        child = sup.start_child("w", worker, restart="permanent")
+        assert await until(lambda: runs["n"] >= 3 and child.alive())
+        assert child.restarts == 2
+        assert m.get("broker.supervisor.restarts") == 2
+        await sup.stop()
+
+    run(main())
+
+
+def test_transient_restarts_on_crash_only():
+    async def main():
+        runs = {"n": 0}
+
+        async def worker():
+            runs["n"] += 1
+            if runs["n"] == 1:
+                raise RuntimeError("boom")
+            # second run returns cleanly → transient is DONE
+
+        sup = fast_sup()
+        child = sup.start_child("w", worker, restart="transient")
+        assert await until(lambda: child.state == "done")
+        assert runs["n"] == 2
+        await asyncio.sleep(0.02)
+        assert runs["n"] == 2               # no further restarts
+        await sup.stop()
+
+    run(main())
+
+
+def test_temporary_never_restarts():
+    async def main():
+        runs = {"n": 0}
+
+        async def worker():
+            runs["n"] += 1
+            raise RuntimeError("boom")
+
+        sup = fast_sup()
+        child = sup.start_child("w", worker, restart="temporary")
+        assert await until(lambda: child.state == "done")
+        assert runs["n"] == 1
+        await sup.stop()
+
+    run(main())
+
+
+def test_kill_restarts_cancel_stops():
+    async def main():
+        runs = {"n": 0}
+
+        async def worker():
+            runs["n"] += 1
+            await asyncio.Event().wait()
+
+        sup = fast_sup()
+        child = sup.start_child("w", worker)
+        assert await until(lambda: child.alive())
+        # kill = chaos: the current run dies, supervision restarts it
+        assert child.kill()
+        assert await until(lambda: runs["n"] == 2 and child.alive())
+        # cancel = stop: no restart
+        child.cancel()
+        assert await until(lambda: child.done())
+        await asyncio.sleep(0.02)
+        assert runs["n"] == 2
+        await sup.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# backoff determinism + intensity escalation
+# ---------------------------------------------------------------------------
+
+def _crashy_delays(seed):
+    """Record the backoff delays a seeded supervisor produces for a
+    child that crashes 5 times then parks."""
+    async def main():
+        delays = []
+
+        async def fake_sleep(d):
+            delays.append(d)
+            await asyncio.sleep(0)
+
+        runs = {"n": 0}
+
+        async def flaky():
+            runs["n"] += 1
+            if runs["n"] <= 5:
+                raise RuntimeError("boom")
+            await asyncio.Event().wait()
+
+        sup = Supervisor(seed=seed, sleep=fake_sleep,
+                         backoff_base=0.05, backoff_max=5.0, jitter=0.1)
+        child = sup.start_child("w", flaky)
+        assert await until(lambda: runs["n"] == 6 and child.alive())
+        await sup.stop()
+        return delays
+
+    return run(main())
+
+
+def test_backoff_exponential_with_deterministic_jitter():
+    a = _crashy_delays(seed=7)
+    b = _crashy_delays(seed=7)
+    c = _crashy_delays(seed=8)
+    assert a == b                           # same seed → same jitter
+    assert a != c                           # different seed → different
+    assert len(a) == 5
+    for i, d in enumerate(a):
+        base = 0.05 * (2 ** i)
+        assert base <= d <= base * 1.1 + 1e-9   # jitter adds ≤ 10%
+    assert a[0] < a[1] < a[2] < a[3] < a[4]
+
+
+def test_intensity_escalates_to_degraded_with_alarm():
+    async def main():
+        async def fake_sleep(d):
+            await asyncio.sleep(0)
+
+        tnow = [0.0]
+
+        async def always_crash():
+            raise RuntimeError("boom")
+
+        m = Metrics()
+        alarms = Alarms()
+        sup = Supervisor(metrics=m, alarms=alarms, max_restarts=3,
+                         window_s=10.0, seed=1, sleep=fake_sleep,
+                         clock=lambda: tnow[0])
+        child = sup.start_child("w", always_crash)
+        # intensity: >3 restarts inside the (frozen-clock) window
+        assert await until(lambda: child.degraded)
+        assert alarms.is_active("supervisor_degraded:w")
+        assert m.get("broker.supervisor.degraded") == 1
+        assert m.get("broker.supervisor.restarts") >= 4
+        assert sup.degraded
+        # escalation did NOT kill supervision: restarts keep coming
+        before = child.restarts
+        assert await until(lambda: child.restarts > before)
+        await sup.stop()
+        # stop clears the degraded alarm + metric
+        assert not alarms.is_active("supervisor_degraded:w")
+        assert m.get("broker.supervisor.degraded") == 0
+
+    run(main())
+
+
+def test_degraded_clears_after_long_clean_run():
+    async def main():
+        async def fake_sleep(d):
+            await asyncio.sleep(0)
+
+        tnow = [0.0]
+        mode = {"park": False}
+
+        async def worker():
+            if not mode["park"]:
+                raise RuntimeError("boom")
+            await asyncio.Event().wait()
+
+        alarms = Alarms()
+        sup = Supervisor(alarms=alarms, max_restarts=2, window_s=10.0,
+                         seed=1, sleep=fake_sleep, clock=lambda: tnow[0])
+        child = sup.start_child("w", worker)
+        assert await until(lambda: child.degraded)
+        mode["park"] = True
+        assert await until(lambda: child.alive())
+        tnow[0] += 100.0                    # "ran" well past the window
+        child.kill()                        # exit with long uptime
+        assert await until(lambda: child.alive() and not child.degraded)
+        assert not alarms.is_active("supervisor_degraded:w")
+        await sup.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# shutdown ordering + drain
+# ---------------------------------------------------------------------------
+
+def test_stop_reverse_registration_order():
+    async def main():
+        order = []
+
+        def make(name):
+            async def worker():
+                try:
+                    await asyncio.Event().wait()
+                except asyncio.CancelledError:
+                    order.append(name)
+                    raise
+            return worker
+
+        sup = fast_sup()
+        for name in ("a", "b", "c"):
+            sup.start_child(name, make(name))
+        await asyncio.sleep(0.01)
+        await sup.stop()
+        assert order == ["c", "b", "a"]     # reverse-dependency order
+
+    run(main())
+
+
+def test_supervised_fanout_stop_preserves_remainder():
+    async def main():
+        b = Broker()
+        got = {}
+        b.on_deliver = lambda cid, pubs: got.setdefault(cid, []).extend(pubs)
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts())
+        sup = fast_sup()
+        # window 60 s: the batch never flushes on its own, so the queue
+        # still holds everything when the SUPERVISOR stops the child
+        p = FanoutPipeline(b, window_s=60.0, supervisor=sup)
+        await p.start()
+        b.fanout = p
+        for i in range(3):
+            assert p.offer(make_message("pub", "t", str(i).encode()))
+        await sup.stop()                    # not p.stop(): drain callback
+        assert [int(x.msg.payload) for x in got["sub"]] == [0, 1, 2]
+
+    run(main())
+
+
+def test_supervised_fanout_restarts_after_kill_without_stall():
+    async def main():
+        b = Broker()
+        got = []
+        b.on_deliver = lambda cid, pubs: got.extend(
+            int(p.msg.payload) for p in pubs)
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts())
+        m = Metrics()
+        sup = fast_sup(metrics=m)
+        p = FanoutPipeline(b, window_s=0.0, supervisor=sup, metrics=m)
+        await p.start()
+        b.fanout = p
+        for i in range(10):
+            assert p.offer(make_message("pub", "t", str(i).encode()))
+        assert await until(lambda: len(got) == 10)
+        assert p._child.kill()
+        # messages offered while the child is down must deliver after
+        # the restart (the restarted drain loop re-arms its own wake)
+        for i in range(10, 20):
+            assert p.offer(make_message("pub", "t", str(i).encode()))
+        assert await until(lambda: len(got) == 20)
+        assert got == list(range(20))       # order preserved throughout
+        assert m.get("broker.supervisor.restarts") >= 1
+        await p.stop()
+        await sup.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# fault injector: schedules, determinism, zero-cost when disabled
+# ---------------------------------------------------------------------------
+
+def test_injector_schedule_skip_every_times():
+    inj = FaultInjector([{"point": "cluster.rpc", "action": "drop",
+                          "skip": 2, "every": 3, "times": 2}])
+    acts = [inj.act("cluster.rpc") for _ in range(12)]
+    # eligible passes start after skip=2; fire on passes 3, 6 (every 3rd
+    # eligible), capped at times=2
+    assert acts == [None, None, "drop", None, None, "drop",
+                    None, None, None, None, None, None]
+    assert inj.fired["cluster.rpc"] == 2
+
+
+def test_injector_unlimited_and_first_rule_wins():
+    inj = FaultInjector([
+        {"point": "bridge.sink", "action": "delay", "times": 1,
+         "delay_s": 0.5},
+        {"point": "bridge.sink", "action": "raise", "times": 0},
+    ])
+    assert inj.act("bridge.sink") == "delay"
+    assert inj._last_delay == 0.5
+    # first rule exhausted → the unlimited raise rule serves forever
+    assert [inj.act("bridge.sink") for _ in range(3)] == ["raise"] * 3
+
+
+def test_injector_prob_deterministic_by_seed():
+    def seq(seed):
+        inj = FaultInjector([{"point": "frame.parse", "action": "raise",
+                              "prob": 0.5, "times": 0}], seed=seed)
+        return [inj.act("frame.parse") for _ in range(40)]
+
+    assert seq(5) == seq(5)
+    assert seq(5) != seq(6)
+    fired = [a for a in seq(5) if a]
+    assert fired and len(fired) < 40        # some fired, some passed
+
+
+def test_injector_check_raises():
+    inj = FaultInjector([{"point": "inflight.insert", "action": "raise"}])
+    with pytest.raises(InjectedFault):
+        inj.check("inflight.insert")
+    assert inj.check("inflight.insert") is None     # times exhausted
+
+
+def test_injector_rejects_unknown_point_and_action():
+    with pytest.raises(ValueError):
+        FaultInjector([{"point": "nope", "action": "raise"}])
+    with pytest.raises(ValueError):
+        FaultInjector([{"point": "frame.parse", "action": "explode"}])
+
+
+def test_faultinject_disabled_is_zero_calls_on_hot_path(monkeypatch):
+    """The acceptance bar for the seams: with no injector installed the
+    hot path makes ZERO fault-injection calls — the guard is one module
+    attribute load + an identity test."""
+    assert faultinject.get() is None        # default state: disabled
+    calls = {"n": 0}
+    orig_act = FaultInjector.act
+    orig_check = FaultInjector.check
+
+    def spy_act(self, point):
+        calls["n"] += 1
+        return orig_act(self, point)
+
+    def spy_check(self, point):
+        calls["n"] += 1
+        return orig_check(self, point)
+
+    monkeypatch.setattr(FaultInjector, "act", spy_act)
+    monkeypatch.setattr(FaultInjector, "check", spy_check)
+
+    async def main():
+        from emqx_tpu.broker.inflight import Inflight
+        from emqx_tpu.mqtt import frame as F
+        from emqx_tpu.mqtt import packet as P
+
+        # frame.parse seam
+        parser = F.Parser()
+        parser.feed(F.serialize(P.Publish(qos=0, topic="t", payload=b"x")))
+        # inflight.insert / inflight.retry seams
+        inf = Inflight(max_size=8)
+        inf.insert_many([(1, "a"), (2, "b")])
+        inf.older_than(0.0)
+        # fanout.drain seam (full pipeline round trip)
+        b = Broker()
+        b.open_session("sub")
+        b.subscribe("sub", "t", SubOpts())
+        p = FanoutPipeline(b)
+        await p.start()
+        for i in range(5):
+            p.offer(make_message("pub", "t", b"%d" % i))
+        await until(lambda: not p._q and not p._busy)
+        await p.stop()
+
+    run(main())
+    assert calls["n"] == 0
+
+
+def test_faultinject_seams_fire_when_installed():
+    """Sanity inverse of the zero-cost test: installed rules actually
+    reach the seams."""
+    async def main():
+        from emqx_tpu.broker.inflight import Inflight
+        from emqx_tpu.mqtt import frame as F
+        from emqx_tpu.mqtt import packet as P
+
+        inj = faultinject.install(FaultInjector([
+            {"point": "frame.parse", "action": "raise"},
+            {"point": "inflight.insert", "action": "raise"},
+        ]))
+        try:
+            parser = F.Parser()
+            with pytest.raises(F.FrameError, match="injected"):
+                parser.feed(F.serialize(
+                    P.Publish(qos=0, topic="t", payload=b"x")))
+            inf = Inflight(max_size=8)
+            with pytest.raises(InjectedFault):
+                inf.insert(1, "a")
+            assert inj.fired == {"frame.parse": 1, "inflight.insert": 1}
+        finally:
+            faultinject.uninstall()
+
+    run(main())
