@@ -16,9 +16,12 @@ buffered bridge worker batches above this layer anyway.
 from __future__ import annotations
 
 import asyncio
+import logging
 import ssl as ssl_mod
 from typing import Dict, Optional, Tuple
 from urllib.parse import urlsplit
+
+log = logging.getLogger(__name__)
 
 __all__ = ["HttpResponse", "request", "HttpError"]
 
@@ -161,6 +164,6 @@ async def request(
             try:
                 await writer.wait_closed()
             except Exception:
-                pass
+                log.debug("http connection close failed", exc_info=True)
 
     return await asyncio.wait_for(_go(), timeout)

@@ -532,7 +532,18 @@ class KafkaConnector(Connector):
                         self.topic)
         if ing and self.local_publish is not None \
                 and self._poll_task is None:
-            self._poll_task = asyncio.create_task(self._poll_forever(ing))
+            # transient supervised child when the owning BufferedWorker
+            # runs under a node supervision tree: a poll loop that dies
+            # past its own backoff restarts instead of silently
+            # stopping ingress; clean return (stop) ends supervision
+            sup = self.supervisor
+            if sup is not None:
+                self._poll_task = sup.start_child(
+                    f"bridge.kafka.{self.name}.poll",
+                    lambda: self._poll_forever(ing), restart="transient")
+            else:
+                self._poll_task = asyncio.create_task(
+                    self._poll_forever(ing))
 
     async def stop(self) -> None:
         if self._poll_task is not None:
@@ -540,7 +551,8 @@ class KafkaConnector(Connector):
             try:
                 await self._poll_task
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+                log.debug("kafka ingress %s poll task exit", self.name,
+                          exc_info=True)
             self._poll_task = None
         await self.client.close()
 

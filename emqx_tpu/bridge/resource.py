@@ -59,7 +59,13 @@ class Connector:
     Lifecycle: ``start`` → (``send`` | ``health``)* → ``stop``.  ``send``
     raises :class:`SendError` (or any exception, treated retryable) on
     failure; the worker handles backoff and re-delivery.
+
+    ``supervisor`` is injected by :meth:`BufferedWorker.start` before
+    ``start()`` runs; connectors owning long-lived loops register them
+    there (kafka ingress poll) instead of spawning raw tasks.
     """
+
+    supervisor: Optional[Any] = None
 
     async def start(self) -> None:  # pragma: no cover - interface
         pass
@@ -141,6 +147,9 @@ class BufferedWorker:
             return
         self._stopping = False
         self.status = "connecting"
+        # connectors with their own long-lived loops (kafka ingress
+        # poll) register them as supervised children too
+        self.connector.supervisor = self.supervisor
         try:
             await self.connector.start()
             self.status = "connected"
@@ -171,12 +180,14 @@ class BufferedWorker:
             try:
                 await t
             except (asyncio.CancelledError, Exception):
-                pass
+                log.debug("resource %s worker exit", self.name,
+                          exc_info=True)
         self._tasks = []
         try:
             await self.connector.stop()
         except Exception:
-            pass
+            log.debug("resource %s connector stop failed", self.name,
+                      exc_info=True)
         self.status = "stopped"
 
     # -- worker loop -------------------------------------------------------
@@ -295,7 +306,8 @@ class BufferedWorker:
                 try:
                     await self.connector.start()
                 except Exception:
-                    pass
+                    log.debug("resource %s reconnect attempt failed",
+                              self.name, exc_info=True)
 
     def info(self) -> Dict[str, Any]:
         return {

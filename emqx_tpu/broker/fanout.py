@@ -182,7 +182,7 @@ class FanoutPipeline:
             try:
                 await self._task
             except (asyncio.CancelledError, Exception):
-                pass
+                log.debug("fanout drain task exit", exc_info=True)
             self._task = None
         self._drain_queue()
 

@@ -469,7 +469,8 @@ class MatchService:
         try:
             await asyncio.wait_for(fut, self.prefetch_timeout_s)
         except Exception:
-            pass  # timeout/cancel: publish falls back to the host path
+            # timeout/cancel: publish falls back to the host path
+            log.debug("prefetch for %r timed out", topic, exc_info=True)
 
     async def prefetch_many(self, topics) -> None:
         """Batched prefetch for the fanout pipeline: every topic missing
@@ -507,7 +508,9 @@ class MatchService:
                 asyncio.gather(*waits), self.prefetch_timeout_s
             )
         except Exception:
-            pass  # timeout/cancel: those topics fall back to the host trie
+            # timeout/cancel: those topics fall back to the host trie
+            log.debug("prefetch_many (%d topics) timed out", len(waits),
+                      exc_info=True)
 
     def hint_available(self, topic: str) -> bool:
         """Non-consuming freshness peek (observability/tracing): True iff

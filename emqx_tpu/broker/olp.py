@@ -3,18 +3,26 @@
 Behavioral reference: ``emqx_olp.erl`` / ``emqx_vm_mon`` / ``emqx_os_mon``
 [U] (SURVEY.md §2.1): scheduler-usage-based shedding of new connections
 and low-priority work, with alarms on sustained overload.  Our signals:
-event-loop lag (reported by the serving loop), pending publish-queue
-depth, and match-kernel backlog — pushed in via :meth:`report`.
+event-loop lag (sampled by :class:`LoopLagProbe`, the ``emqx_vm_mon``
+scheduler-usage analog), pending publish-queue depth, and match-kernel
+backlog — pushed in via :meth:`Olp.report`.
+
+The lag probe closes the PR-3 gap: the fanout drain reports queue depth,
+but a CPU-saturated loop with an *empty* queue (every cycle spent inside
+connection handlers) never grew a queue to observe.  Sleep drift is the
+direct measurement — ``asyncio.sleep(t)`` wakes ``t + lag`` after it was
+scheduled, where ``lag`` is exactly how far behind the loop is running.
 """
 
 from __future__ import annotations
 
+import asyncio
 import time
-from typing import Optional
+from typing import Any, Callable, Optional
 
 from ..observe.alarm import Alarms
 
-__all__ = ["Olp"]
+__all__ = ["Olp", "LoopLagProbe"]
 
 
 class Olp:
@@ -66,3 +74,62 @@ class Olp:
             self.shed_count += 1
             return True
         return False
+
+
+class LoopLagProbe:
+    """Sleep-drift sampler feeding :meth:`Olp.report`.
+
+    Each tick schedules ``asyncio.sleep(interval)`` and measures how
+    late it woke; an EWMA (``alpha``) smooths scheduler jitter so one
+    GC pause doesn't trip overload, while sustained saturation does.
+    Runs as a supervised child (``olp.lag_probe``); the clock and sleep
+    are injectable so tests drive it deterministically.
+    """
+
+    def __init__(
+        self,
+        olp: Olp,
+        metrics: Any = None,
+        interval: float = 0.1,
+        alpha: float = 0.3,
+        clock: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], Any]] = None,
+    ) -> None:
+        self.olp = olp
+        self.metrics = metrics
+        self.interval = interval
+        self.alpha = alpha
+        self._clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep if sleep is not None else asyncio.sleep
+        self.lag = 0.0       # EWMA-smoothed drift (seconds)
+        self.last_raw = 0.0  # most recent un-smoothed sample
+        self.samples = 0
+
+    def observe(self, raw_lag: float) -> float:
+        """Fold one drift sample in and report it; returns the EWMA.
+        Split out from :meth:`run` so tests feed samples directly."""
+        raw_lag = max(0.0, raw_lag)
+        self.last_raw = raw_lag
+        self.samples += 1
+        self.lag = (raw_lag if self.samples == 1
+                    else self.lag * (1.0 - self.alpha)
+                    + raw_lag * self.alpha)
+        self.olp.report(loop_lag=self.lag)
+        if self.metrics is not None:
+            self.metrics.set("broker.olp.loop_lag_us",
+                             int(self.lag * 1e6))
+        return self.lag
+
+    async def run(self) -> None:
+        """The supervised sampler loop."""
+        while True:
+            t0 = self._clock()
+            await self._sleep(self.interval)
+            self.observe(self._clock() - t0 - self.interval)
+
+    def info(self) -> dict:
+        return {
+            "lag_ms": round(self.lag * 1e3, 3),
+            "last_raw_ms": round(self.last_raw * 1e3, 3),
+            "samples": self.samples,
+        }

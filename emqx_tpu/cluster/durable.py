@@ -246,6 +246,8 @@ class DurableReplicator:
             try:
                 state = json.loads(op.session_json)
             except Exception:
+                log.debug("durable op for %s carried undecodable "
+                          "session state", key, exc_info=True)
                 return
             self._apply_session_put(key, ts, state)
         elif op.kind == pb.DurableOp.SESSION_DEL:
@@ -347,6 +349,8 @@ class DurableReplicator:
             try:
                 state = json.loads(ds.session_json)
             except Exception:
+                log.debug("durable snapshot carried undecodable session "
+                          "state for %s", ds.clientid, exc_info=True)
                 continue
             self._apply_session_put(ds.clientid, ds.ts, state)
 

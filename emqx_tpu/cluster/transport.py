@@ -166,7 +166,7 @@ class PeerConn:
         try:
             self._w.close()
         except Exception:
-            pass
+            log.debug("peer transport close failed", exc_info=True)
         if self._on_closed is not None:
             self._on_closed(self)
 

@@ -170,6 +170,9 @@ SCHEMA: Dict[str, Field] = {
     "overload_protection.max_queue_depth": Field(
         100_000, int, lambda v: v >= 1),
     "overload_protection.cooloff": Field(5.0, duration),
+    # event-loop lag sampler (LoopLagProbe): sleep-drift sampling tick;
+    # 0 disables the probe (queue depth stays the only overload signal)
+    "overload_protection.lag_probe_interval": Field(0.1, duration),
     "broker.sys_msg_interval": Field(60.0, duration),
     "broker.sys_heartbeat_interval": Field(30.0, duration),
     "broker.enable_session_registry": Field(True, _bool),

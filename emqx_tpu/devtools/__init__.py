@@ -1,0 +1,7 @@
+"""Developer tooling that ships with the tree but never imports from
+(or into) the runtime hot path — static analysis, codegen helpers.
+
+Nothing under here may be imported by ``emqx_tpu`` runtime modules;
+``tests/test_staticcheck.py`` enforces the reverse direction (the tools
+analyze the runtime tree).
+"""

@@ -1,0 +1,48 @@
+"""Project-invariant static analysis — the dialyzer/xref analog.
+
+Upstream EMQX wires dialyzer + xref passes into CI to keep concurrency
+and API invariants honest (SURVEY.md); this package is the equivalent
+cost floor for our 143-module asyncio hot path.  It is a small AST
+framework (one parse + one walk per file, every rule riding the same
+walker) plus a battery of project-specific rules:
+
+================  =====================================================
+no-unsupervised-task   ``asyncio.create_task``/``ensure_future`` outside
+                       :mod:`emqx_tpu.supervise` registration, a
+                       supervised-with-fallback branch, or an allowlisted
+                       request-scoped site (``project.ALLOWED_TASK_SITES``)
+no-blocking-in-async   ``time.sleep``, sync socket/DNS/subprocess/HTTP
+                       and sync file IO inside ``async def``
+no-swallowed-exceptions  bare/overbroad ``except`` whose handler drops
+                       the error without logging, re-raising, or
+                       handling it — delivery-path modules only
+await-under-lock       blocking waits (``asyncio.sleep``/``wait``/
+                       ``Event.wait``/nested lock acquisition) while an
+                       ``asyncio.Lock`` is held
+registry-drift         every literal metric / config key / faultinject
+                       point / alarm name must exist at its registration
+                       site (``observe/metrics.py``, ``config.py``,
+                       ``faultinject.py``, an ``activate`` call)
+unawaited-coroutine    coroutine calls whose result is discarded
+================  =====================================================
+
+Run it::
+
+    python scripts/staticcheck.py                 # whole tree, all rules
+    python scripts/staticcheck.py --rule registry-drift emqx_tpu/broker
+    python scripts/staticcheck.py --baseline write # stamp a waiver file
+
+Waivers expire (``waivers.py``); an expired waiver stops suppressing and
+is itself reported, so suppressions can never silently rot.  Tier-1
+enforcement lives in ``tests/test_staticcheck.py``.
+"""
+
+from .core import Finding, Rule, check_file, check_paths, iter_py_files
+from .registry import Registries
+from .rules import ALL_RULES, get_rules
+from .waivers import WaiverFile
+
+__all__ = [
+    "Finding", "Rule", "Registries", "WaiverFile",
+    "ALL_RULES", "get_rules", "check_file", "check_paths", "iter_py_files",
+]

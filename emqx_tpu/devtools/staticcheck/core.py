@@ -1,0 +1,383 @@
+"""Analysis core: one parse, one walk, every rule on the same walker.
+
+The walker maintains the context rules actually need for asyncio
+invariants — the enclosing function stack (with async-ness), the class
+stack, and the held-lock stack — and dispatches each AST node to the
+rules that registered interest in its type.  Findings carry a stable
+``key`` (rule + path + enclosing qualname + message hash, **no line
+number**) so waivers survive unrelated edits that merely move code.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding", "Rule", "FileContext", "Walker",
+    "check_file", "check_paths", "iter_py_files",
+    "call_name", "terminal_name",
+]
+
+
+# ---------------------------------------------------------------------------
+# findings
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative posix path when under the repo
+    line: int
+    col: int
+    message: str
+    context: str       # enclosing qualname ("<module>" at top level)
+
+    @property
+    def key(self) -> str:
+        """Stable waiver key: deliberately excludes the line number so a
+        waiver keeps matching while unrelated edits shift the file."""
+        digest = hashlib.sha1(self.message.encode()).hexdigest()[:10]
+        return f"{self.rule}:{self.path}:{self.context}:{digest}"
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the rules
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost name of a Name/Attribute chain: ``a.b.c`` → ``c``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted best-effort name of a call target: ``asyncio.create_task``,
+    ``self._lock.acquire`` → ``self._lock.acquire``."""
+    parts: List[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif isinstance(cur, ast.Call):
+        inner = call_name(cur)
+        if inner:
+            parts.append(f"{inner}()")
+    return ".".join(reversed(parts))
+
+
+def str_arg(node: ast.Call, index: int = 0) -> Optional[str]:
+    """Literal string at positional ``index``, else None."""
+    if len(node.args) > index:
+        arg = node.args[index]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+    return None
+
+
+def fstring_prefix(node: ast.AST) -> Optional[str]:
+    """Static prefix of an f-string (text before the first placeholder),
+    or the whole value for a plain literal.  None for anything else."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        if node.values and isinstance(node.values[0], ast.Constant) \
+                and isinstance(node.values[0].value, str):
+            return node.values[0].value
+        return ""
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-file context
+
+class _Func:
+    __slots__ = ("name", "is_async", "node")
+
+    def __init__(self, name: str, is_async: bool, node: ast.AST) -> None:
+        self.name = name
+        self.is_async = is_async
+        self.node = node
+
+
+class FileContext:
+    """Everything a rule can ask about the file and the current node's
+    surroundings while the walker descends."""
+
+    def __init__(self, path: str, relpath: str, tree: ast.Module,
+                 source: str) -> None:
+        self.path = path
+        self.relpath = relpath
+        self.tree = tree
+        self.source = source
+        self.findings: List[Finding] = []
+        # walk state (maintained by Walker)
+        self.func_stack: List[_Func] = []
+        self.class_stack: List[str] = []
+        self.lock_stack: List[Tuple[str, ast.AST]] = []  # (lockname, node)
+        self.if_test_names: List[set] = []  # names seen in enclosing If tests
+        self._func_if_names: Dict[int, set] = {}  # id(funcnode) → names
+        # pre-pass products
+        self.lock_names: set = set()
+        self.module_async_defs: set = set()
+        self.class_async_methods: Dict[str, set] = {}
+        self.module_sync_defs: set = set()
+        self._prescan()
+
+    # -- queries rules use ------------------------------------------------
+
+    @property
+    def in_async(self) -> bool:
+        return bool(self.func_stack) and self.func_stack[-1].is_async
+
+    @property
+    def held_locks(self) -> List[str]:
+        return [name for name, _ in self.lock_stack]
+
+    def qualname(self) -> str:
+        parts = self.class_stack + [f.name for f in self.func_stack]
+        return ".".join(parts) if parts else "<module>"
+
+    def enclosing_class(self) -> Optional[str]:
+        return self.class_stack[-1] if self.class_stack else None
+
+    def enclosing_if_mentions(self, *names: str) -> bool:
+        """True when an ``if`` test references one of ``names`` either
+        on the enclosing-If stack or anywhere in the innermost enclosing
+        function — the supervised-with-fallback shape in both its forms
+        (``if sup is not None: ... else: create_task(...)`` and the
+        guard-with-early-return variant)."""
+        for seen in self.if_test_names:
+            if seen.intersection(names):
+                return True
+        if self.func_stack:
+            fnode = self.func_stack[-1].node
+            cached = self._func_if_names.get(id(fnode))
+            if cached is None:
+                cached = set()
+                for sub in ast.walk(fnode):
+                    if isinstance(sub, ast.If):
+                        for n in ast.walk(sub.test):
+                            if isinstance(n, ast.Name):
+                                cached.add(n.id)
+                            elif isinstance(n, ast.Attribute):
+                                cached.add(n.attr)
+                self._func_if_names[id(fnode)] = cached
+            if cached.intersection(names):
+                return True
+        return False
+
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule=rule, path=self.relpath,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            message=message, context=self.qualname(),
+        ))
+
+    # -- pre-pass ---------------------------------------------------------
+
+    def _prescan(self) -> None:
+        """One linear pass collecting file-level facts the rules resolve
+        against: lock-valued names, async def names (module level and per
+        class) and sync def names (to veto ambiguous resolutions)."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if isinstance(value, ast.Call):
+                    vname = call_name(value)
+                    if vname in ("asyncio.Lock", "Lock", "asyncio.Condition",
+                                 "Condition", "asyncio.Semaphore",
+                                 "Semaphore"):
+                        targets = (node.targets
+                                   if isinstance(node, ast.Assign)
+                                   else [node.target])
+                        for t in targets:
+                            name = terminal_name(t)
+                            if name:
+                                self.lock_names.add(name)
+            elif isinstance(node, ast.ClassDef):
+                methods = self.class_async_methods.setdefault(
+                    node.name, set())
+                for item in node.body:
+                    if isinstance(item, ast.AsyncFunctionDef):
+                        methods.add(item.name)
+        for node in self.tree.body:
+            if isinstance(node, ast.AsyncFunctionDef):
+                self.module_async_defs.add(node.name)
+            elif isinstance(node, ast.FunctionDef):
+                self.module_sync_defs.add(node.name)
+
+
+# ---------------------------------------------------------------------------
+# rule base
+
+class Rule:
+    """One invariant.  Subclasses set ``name``/``description``, declare
+    the node types they want via ``node_types``, and implement
+    ``visit``.  Cross-file rules also use ``begin_run``/``finalize``."""
+
+    name = "rule"
+    description = ""
+    node_types: Tuple[type, ...] = ()
+
+    def begin_run(self) -> None:
+        """Called once before any file (reset cross-file state)."""
+
+    def begin_file(self, ctx: FileContext) -> None:
+        """Called before walking each file."""
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        """Called for every node whose type is in ``node_types``."""
+
+    def end_file(self, ctx: FileContext) -> None:
+        """Called after walking each file."""
+
+    def finalize(self) -> List[Finding]:
+        """Called once after every file; return cross-file findings."""
+        return []
+
+
+# ---------------------------------------------------------------------------
+# the walker
+
+class Walker:
+    """Single recursive descent maintaining function/class/lock/if
+    context, dispatching nodes to interested rules."""
+
+    def __init__(self, rules: Sequence[Rule]) -> None:
+        self.rules = list(rules)
+        self._dispatch: Dict[type, List[Rule]] = {}
+        for rule in self.rules:
+            for ntype in rule.node_types:
+                self._dispatch.setdefault(ntype, []).append(rule)
+
+    def walk(self, ctx: FileContext) -> None:
+        for rule in self.rules:
+            rule.begin_file(ctx)
+        self._visit(ctx.tree, ctx)
+        for rule in self.rules:
+            rule.end_file(ctx)
+
+    def _visit(self, node: ast.AST, ctx: FileContext) -> None:
+        interested = self._dispatch.get(type(node))
+        if interested:
+            for rule in interested:
+                rule.visit(node, ctx)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            ctx.func_stack.append(_Func(
+                node.name, isinstance(node, ast.AsyncFunctionDef), node))
+            self._walk_children(node, ctx)
+            ctx.func_stack.pop()
+        elif isinstance(node, ast.ClassDef):
+            ctx.class_stack.append(node.name)
+            self._walk_children(node, ctx)
+            ctx.class_stack.pop()
+        elif isinstance(node, ast.If):
+            names = {n.id for n in ast.walk(node.test)
+                     if isinstance(n, ast.Name)}
+            names.update(n.attr for n in ast.walk(node.test)
+                         if isinstance(n, ast.Attribute))
+            ctx.if_test_names.append(names)
+            self._walk_children(node, ctx)
+            ctx.if_test_names.pop()
+        elif isinstance(node, (ast.AsyncWith, ast.With)):
+            held = 0
+            for item in node.items:
+                name = self._lock_of(item.context_expr, ctx)
+                if name is not None:
+                    ctx.lock_stack.append((name, node))
+                    held += 1
+            self._walk_children(node, ctx)
+            for _ in range(held):
+                ctx.lock_stack.pop()
+        else:
+            self._walk_children(node, ctx)
+
+    @staticmethod
+    def _lock_of(expr: ast.AST, ctx: FileContext) -> Optional[str]:
+        """Lock name when ``expr`` is a known-lock context manager."""
+        name = terminal_name(expr)
+        if name is None:
+            return None
+        if name in ctx.lock_names or name == "lock" \
+                or name.endswith("_lock"):
+            return name
+        return None
+
+    def _walk_children(self, node: ast.AST, ctx: FileContext) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, ctx)
+
+
+# ---------------------------------------------------------------------------
+# runners
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules"}
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/directories to .py files; generated protobuf modules
+    (``*_pb2.py``) are machine output and skipped."""
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in _SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py") and not fn.endswith("_pb2.py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _relpath(path: str, root: Optional[str]) -> str:
+    ap = os.path.abspath(path)
+    if root:
+        root = os.path.abspath(root)
+        if ap.startswith(root + os.sep):
+            return os.path.relpath(ap, root).replace(os.sep, "/")
+    return ap.replace(os.sep, "/")
+
+
+def check_file(path: str, rules: Sequence[Rule],
+               root: Optional[str] = None) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    relpath = _relpath(path, root)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(
+            rule="syntax-error", path=relpath, line=e.lineno or 0,
+            col=e.offset or 0, message=f"file does not parse: {e.msg}",
+            context="<module>",
+        )]
+    ctx = FileContext(path, relpath, tree, source)
+    Walker(rules).walk(ctx)
+    return ctx.findings
+
+
+def check_paths(paths: Iterable[str], rules: Sequence[Rule],
+                root: Optional[str] = None) -> List[Finding]:
+    """Run ``rules`` over every file under ``paths``; one parse + one
+    walk per file, then the cross-file ``finalize`` pass."""
+    findings: List[Finding] = []
+    for rule in rules:
+        rule.begin_run()
+    for path in iter_py_files(paths):
+        findings.extend(check_file(path, rules, root=root))
+    for rule in rules:
+        findings.extend(rule.finalize())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,79 @@
+"""Project policy for the rules: what is structurally exempt and why.
+
+Two exemption mechanisms exist, with different lifetimes:
+
+* **Allowlists here** are *structural*: the site is correct by design
+  (request-scoped task that dies with its connection, bench harness,
+  one-shot event) and stays correct until the design changes.  Every
+  entry carries its reason and is reviewed like code.
+* **Waivers** (``waivers.py``) are *temporary*: a known finding someone
+  chose to defer.  They expire; an expired waiver resurfaces as its own
+  finding.
+
+Adding to an allowlist is a design statement; adding a waiver is debt.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = [
+    "ALLOWED_TASK_SITES", "DELIVERY_PATH_PREFIXES", "SUPERVISE_MODULE",
+]
+
+#: Module allowed to create raw tasks: the supervision tree itself.
+SUPERVISE_MODULE = "emqx_tpu/supervise.py"
+
+#: (repo-relative path, enclosing qualname) → reason.  These sites may
+#: call ``asyncio.create_task``/``ensure_future`` directly because the
+#: task is request/connection-scoped (it dies with the socket or event
+#: that spawned it — ROADMAP: "per-connection tasks stay unsupervised by
+#: design") or belongs to client/bench tooling that runs outside the
+#: broker's supervision tree.  Long-lived node loops do NOT belong here;
+#: they register with the supervisor (supervised-with-fallback sites are
+#: exempted structurally, not listed).
+ALLOWED_TASK_SITES: Dict[Tuple[str, str], str] = {
+    ("emqx_tpu/client.py", "Client.connect"):
+        "MQTT client library: read/ping loops die with the connection",
+    ("emqx_tpu/bench_client.py", "LeanPub.run"):
+        "bench harness: ack loop scoped to one bench run",
+    ("emqx_tpu/bench_client.py", "run_scenario"):
+        "bench harness: drain tasks scoped to one bench run",
+    ("emqx_tpu/gateway/exproto.py", "ExProtoConn.send_deliveries"):
+        "per-event gRPC notify; errors surface via the handler channel",
+    ("emqx_tpu/gateway/stomp.py", "StompConn.on_connect"):
+        "per-connection heartbeat, cancelled on close",
+    ("emqx_tpu/transport/connection.py", "Connection.run"):
+        "per-connection writer/tick loops, joined by the conn handler",
+    ("emqx_tpu/transport/proto_conn.py", "MqttProtocol.connection_made"):
+        "per-connection worker loop, cancelled in connection_lost",
+    ("emqx_tpu/transport/quic/connection.py",
+     "QuicEndpoint.datagram_received"):
+        "per-connection stream handler (the accept path)",
+    ("emqx_tpu/cluster/transport.py", "PeerConn.start"):
+        "per-peer-socket recv loop, cancelled on conn close",
+    ("emqx_tpu/cluster/durable.py", "DurableReplicator.apply_deltas"):
+        "one-shot re-bootstrap on seq gap; re-armed on next gap",
+    ("emqx_tpu/cluster/cluster.py", "Cluster._peer_up"):
+        "one-shot bootstrap per peer-up event",
+    ("emqx_tpu/cluster/cluster.py", "Cluster._apply_route_deltas"):
+        "one-shot re-bootstrap on seq gap; re-armed on next gap",
+    ("emqx_tpu/storage/backup.py", "import_data"):
+        "one-shot worker start during restore (worker loops themselves "
+        "register with the supervisor)",
+}
+
+#: Path prefixes (repo-relative) where a silently-swallowed exception is
+#: a delivery bug, not a style nit — the no-swallowed-exceptions rule
+#: only fires here.
+DELIVERY_PATH_PREFIXES: Tuple[str, ...] = (
+    "emqx_tpu/broker/",
+    "emqx_tpu/bridge/",
+    "emqx_tpu/gateway/",
+    "emqx_tpu/transport/",
+    "emqx_tpu/cluster/",
+    "emqx_tpu/exhook/",
+    "emqx_tpu/mqtt/",
+    "emqx_tpu/node.py",
+    "emqx_tpu/supervise.py",
+)

@@ -1,0 +1,106 @@
+"""Registration-site extraction for the registry-drift rule.
+
+Reads the three registries *statically* (AST, never import) so the
+checker works on a broken tree and never executes runtime code:
+
+* metric names — every string element of the ``*_METRIC_NAMES`` lists in
+  ``emqx_tpu/observe/metrics.py`` (the fixed-at-boot counter table);
+* config keys — the literal keys of the ``SCHEMA`` dict in
+  ``emqx_tpu/config.py``;
+* fault-injection points — the ``POINTS`` tuple in
+  ``emqx_tpu/faultinject.py`` (the scenario-table vocabulary).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Optional, Set
+
+__all__ = ["Registries"]
+
+
+def _parse(path: str) -> ast.Module:
+    with open(path, "r", encoding="utf-8") as f:
+        return ast.parse(f.read(), filename=path)
+
+
+def _str_elements(node: ast.AST) -> Set[str]:
+    return {
+        el.value
+        for el in ast.walk(node)
+        if isinstance(el, ast.Constant) and isinstance(el.value, str)
+    }
+
+
+class Registries:
+    """The project's three name registries, extracted once per run."""
+
+    def __init__(self, metric_names: Set[str], config_keys: Set[str],
+                 fault_points: Set[str]) -> None:
+        self.metric_names = metric_names
+        self.config_keys = config_keys
+        self.fault_points = fault_points
+
+    @classmethod
+    def load(cls, package_root: Optional[str] = None) -> "Registries":
+        """Extract from the live tree.  ``package_root`` is the
+        ``emqx_tpu`` package directory (defaults to the one this module
+        ships in)."""
+        if package_root is None:
+            package_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+        return cls(
+            metric_names=cls._metric_names(
+                os.path.join(package_root, "observe", "metrics.py")),
+            config_keys=cls._config_keys(
+                os.path.join(package_root, "config.py")),
+            fault_points=cls._fault_points(
+                os.path.join(package_root, "faultinject.py")),
+        )
+
+    @staticmethod
+    def _metric_names(path: str) -> Set[str]:
+        names: Set[str] = set()
+        for node in _parse(path).body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name) \
+                            and t.id.endswith("METRIC_NAMES") \
+                            and node.value is not None:
+                        names |= _str_elements(node.value)
+        if not names:
+            raise RuntimeError(f"no *_METRIC_NAMES lists found in {path}")
+        return names
+
+    @staticmethod
+    def _config_keys(path: str) -> Set[str]:
+        for node in _parse(path).body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if any(isinstance(t, ast.Name) and t.id == "SCHEMA"
+                       for t in targets) and node.value is not None:
+                    keys = {
+                        k.value for k in node.value.keys  # type: ignore
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                    }
+                    if keys:
+                        return keys
+        raise RuntimeError(f"no SCHEMA dict found in {path}")
+
+    @staticmethod
+    def _fault_points(path: str) -> Set[str]:
+        for node in _parse(path).body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if any(isinstance(t, ast.Name) and t.id == "POINTS"
+                       for t in targets) and node.value is not None:
+                    points = _str_elements(node.value)
+                    if points:
+                        return points
+        raise RuntimeError(f"no POINTS tuple found in {path}")

@@ -1,0 +1,69 @@
+"""Findings formatter: grep-able text (``path:line:col``) or JSON."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List, Sequence
+
+from .core import Finding
+from .waivers import Waiver
+
+__all__ = ["format_text", "format_json"]
+
+
+def format_text(
+    new: Sequence[Finding],
+    waived: Sequence[Finding] = (),
+    expired: Sequence[Waiver] = (),
+    stale: Sequence[Waiver] = (),
+    files_checked: int = 0,
+) -> str:
+    lines: List[str] = []
+    for f in new:
+        lines.append(f"{f.location()}: [{f.rule}] {f.message}"
+                     f"  (in {f.context})")
+    if expired:
+        lines.append("")
+        lines.append("expired waivers (no longer suppressing — fix or "
+                     "re-justify):")
+        for w in expired:
+            lines.append(f"  {w.path}: [{w.rule}] expired {w.expires}: "
+                         f"{w.reason}")
+    if stale:
+        lines.append("")
+        lines.append("stale waivers (finding is gone — delete the entry):")
+        for w in stale:
+            lines.append(f"  {w.path}: [{w.rule}] {w.message[:60]}")
+    lines.append("")
+    by_rule = Counter(f.rule for f in new)
+    summary = ", ".join(f"{r}: {n}" for r, n in sorted(by_rule.items())) \
+        or "clean"
+    lines.append(
+        f"{len(new)} finding(s) ({summary}); {len(waived)} waived, "
+        f"{len(expired)} expired waiver(s), {len(stale)} stale "
+        f"waiver(s); {files_checked} file(s) checked"
+    )
+    return "\n".join(lines)
+
+
+def format_json(
+    new: Sequence[Finding],
+    waived: Sequence[Finding] = (),
+    expired: Sequence[Waiver] = (),
+    stale: Sequence[Waiver] = (),
+    files_checked: int = 0,
+) -> str:
+    def fd(f: Finding) -> dict:
+        return {
+            "rule": f.rule, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message, "context": f.context,
+            "key": f.key,
+        }
+    return json.dumps({
+        "findings": [fd(f) for f in new],
+        "waived": [fd(f) for f in waived],
+        "expired_waivers": [w.to_dict() for w in expired],
+        "stale_waivers": [w.to_dict() for w in stale],
+        "files_checked": files_checked,
+    }, indent=2)

@@ -1,0 +1,49 @@
+"""The rule battery.  Each module holds one invariant; ``ALL_RULES``
+is the tier-1 set."""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from ..core import Rule
+from ..registry import Registries
+from .blocking import NoBlockingInAsync
+from .coroutines import UnawaitedCoroutine
+from .drift import RegistryDrift
+from .exceptions import NoSwallowedExceptions
+from .locks import AwaitUnderLock
+from .tasks import NoUnsupervisedTask
+
+ALL_RULES = [
+    NoUnsupervisedTask,
+    NoBlockingInAsync,
+    NoSwallowedExceptions,
+    AwaitUnderLock,
+    RegistryDrift,
+    UnawaitedCoroutine,
+]
+
+__all__ = ["ALL_RULES", "get_rules"]
+
+
+def get_rules(names: Optional[Iterable[str]] = None,
+              registries: Optional[Registries] = None) -> List[Rule]:
+    """Instantiate rules by name (all when ``names`` is None).  Unknown
+    names raise so CI typos fail loudly."""
+    by_name = {cls.name: cls for cls in ALL_RULES}
+    if names is None:
+        picked = list(ALL_RULES)
+    else:
+        picked = []
+        for n in names:
+            if n not in by_name:
+                raise KeyError(
+                    f"unknown rule {n!r}; known: {sorted(by_name)}")
+            picked.append(by_name[n])
+    out: List[Rule] = []
+    for cls in picked:
+        if cls is RegistryDrift:
+            out.append(cls(registries=registries))
+        else:
+            out.append(cls())
+    return out

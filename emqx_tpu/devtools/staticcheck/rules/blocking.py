@@ -1,0 +1,62 @@
+"""no-blocking-in-async: nothing on the event loop may block the loop.
+
+One ``time.sleep``/sync connect/sync file read inside ``async def``
+stalls every connection on the node for its duration — the exact
+unobserved seam brokers degrade at under load (PAPERS.md, broker
+benchmarking).  Flags a curated set of known-blocking calls inside
+``async def`` bodies; the fix is the async equivalent
+(``asyncio.sleep``, ``loop.sock_connect``, ``asyncio.to_thread`` for
+one-shot file IO).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule, call_name
+
+__all__ = ["NoBlockingInAsync"]
+
+#: exact dotted call names that block the loop
+_BLOCKING = {
+    "time.sleep",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname", "socket.gethostbyaddr",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.waitpid",
+    "urllib.request.urlopen",
+    "requests.get", "requests.post", "requests.put", "requests.delete",
+    "requests.head", "requests.patch", "requests.request",
+    "http.client.HTTPConnection", "http.client.HTTPSConnection",
+    "select.select",
+    "sqlite3.connect",
+}
+
+_FIX = {
+    "time.sleep": "await asyncio.sleep(...)",
+    "open": "await asyncio.to_thread(...) (or read before entering "
+            "the loop)",
+}
+
+
+class NoBlockingInAsync(Rule):
+    name = "no-blocking-in-async"
+    description = "blocking call inside async def"
+    node_types = (ast.Call,)
+
+    def visit(self, node: ast.Call, ctx: FileContext) -> None:
+        if not ctx.in_async:
+            return
+        name = call_name(node)
+        is_open = isinstance(node.func, ast.Name) and node.func.id == "open"
+        if name not in _BLOCKING and not is_open:
+            return
+        which = "open" if is_open else name
+        fix = _FIX.get(which, "an async equivalent")
+        ctx.report(
+            self.name, node,
+            f"blocking call {which}() inside async def "
+            f"{ctx.func_stack[-1].name!r} stalls the event loop; "
+            f"use {fix}",
+        )

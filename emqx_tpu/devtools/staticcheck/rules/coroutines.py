@@ -1,0 +1,52 @@
+"""unawaited-coroutine: a discarded coroutine call never runs.
+
+``self.flush()`` as a statement, where ``flush`` is ``async def``,
+creates a coroutine object and throws it away — the code *looks* like
+it did the work and Python only emits a RuntimeWarning when the object
+is garbage collected (often never surfaced under pytest/production
+logging).  Resolution is deliberately conservative to stay
+false-positive-free: only calls the walker can *prove* target an async
+function are flagged — module-level ``async def`` names (not shadowed
+by a sync def) and ``self.<method>`` where the enclosing class defines
+``<method>`` as ``async def``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule
+
+__all__ = ["UnawaitedCoroutine"]
+
+
+class UnawaitedCoroutine(Rule):
+    name = "unawaited-coroutine"
+    description = "coroutine call whose result is discarded"
+    node_types = (ast.Expr,)
+
+    def visit(self, node: ast.Expr, ctx: FileContext) -> None:
+        call = node.value
+        if not isinstance(call, ast.Call):
+            return
+        func = call.func
+        target = None
+        if isinstance(func, ast.Name):
+            if func.id in ctx.module_async_defs \
+                    and func.id not in ctx.module_sync_defs:
+                target = func.id
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            cls = ctx.enclosing_class()
+            if cls is not None and func.attr in \
+                    ctx.class_async_methods.get(cls, ()):
+                target = f"self.{func.attr}"
+        if target is None:
+            return
+        ctx.report(
+            self.name, node,
+            f"{target}() is async but the coroutine is discarded — it "
+            "never runs; await it, or hand it to the supervisor/"
+            "create_task if it is meant to run concurrently",
+        )

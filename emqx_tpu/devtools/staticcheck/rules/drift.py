@@ -1,0 +1,214 @@
+"""registry-drift: names used must exist where they are registered.
+
+Four fixed-vocabulary registries back the observability/config/chaos
+surfaces; a typo'd name at a call site either raises at runtime on a
+cold path nothing exercises (metrics/config) or silently never fires
+(faultinject points, alarm deactivation).  This rule cross-checks every
+*literal* name at a call site against its registration site:
+
+* ``metrics.inc/dec/set("name")`` → a ``*_METRIC_NAMES`` list in
+  ``observe/metrics.py``;
+* ``cfg.get/put("dotted.key")`` → the ``SCHEMA`` dict in ``config.py``;
+* ``_injector.act/check("point")`` → ``faultinject.POINTS``;
+* ``hooks.run("message.dropped", (msg, "reason"))`` → the derived
+  counter ``messages.dropped.<reason>`` must be registered (after the
+  ``wiring.py`` remap) — ``Metrics.inc_msg_dropped`` guards the detail
+  key with ``in self._c`` and silently under-counts on a typo;
+* ``alarms.deactivate("name")`` → some ``alarms.activate`` with a
+  matching name (f-string prefixes compared prefix-wise), anywhere in
+  the tree — a deactivate that can never match leaks the alarm active
+  forever.
+
+Dynamic names (f-strings, variables) are skipped except for the alarm
+prefix check; the registries are extracted statically (``registry.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from ..core import (FileContext, Finding, Rule, fstring_prefix, str_arg,
+                    terminal_name)
+from ..registry import Registries
+
+__all__ = ["RegistryDrift"]
+
+#: registry-name shape: lowercase dotted identifiers ("broker.fanout.x")
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+_METRIC_METHODS = {"inc", "dec", "set"}
+_CONFIG_METHODS = {"get", "put"}
+_FAULT_METHODS = {"act", "check"}
+_ALARM_METHODS = {"activate", "deactivate"}
+
+#: drop reasons observe/wiring.py rewrites before deriving the counter
+#: name (mirrors ``on_dropped``: shared_no_available counts against
+#: no_subscribers, matching the reference's accounting)
+_DROP_REASON_REMAP = {"shared_no_available": "no_subscribers"}
+
+
+def _receiver(node: ast.Call) -> Optional[str]:
+    if isinstance(node.func, ast.Attribute):
+        return terminal_name(node.func.value)
+    return None
+
+
+class RegistryDrift(Rule):
+    name = "registry-drift"
+    description = "name not present at its registration site"
+    node_types = (ast.Call,)
+
+    #: files that ARE the registration sites (their internal dynamic
+    #: key construction is the registry, not a use of it)
+    _REGISTRY_FILES = (
+        "emqx_tpu/observe/metrics.py", "emqx_tpu/config.py",
+        "emqx_tpu/faultinject.py",
+    )
+
+    def __init__(self, registries: Optional[Registries] = None) -> None:
+        self._registries = registries
+        self._activations: List[Tuple[str, bool]] = []  # (name, is_prefix)
+        self._deactivations: List[Tuple[str, bool, Finding]] = []
+
+    @property
+    def registries(self) -> Registries:
+        if self._registries is None:
+            self._registries = Registries.load()
+        return self._registries
+
+    def begin_run(self) -> None:
+        self._activations = []
+        self._deactivations = []
+
+    def visit(self, node: ast.Call, ctx: FileContext) -> None:
+        if ctx.relpath in self._REGISTRY_FILES:
+            return
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        method = func.attr
+        recv = _receiver(node)
+        if recv is None:
+            return
+        if method in _METRIC_METHODS and (
+                "metric" in recv or recv == "m"):
+            self._check_metric(node, ctx)
+        elif method in _CONFIG_METHODS and recv in ("cfg", "config"):
+            self._check_config(node, ctx)
+        elif method in _FAULT_METHODS and "injector" in recv:
+            self._check_fault(node, ctx)
+        elif method in _ALARM_METHODS and "alarm" in recv:
+            self._note_alarm(node, ctx, method)
+        elif method == "run" and recv == "hooks":
+            self._check_drop_reason(node, ctx)
+
+    # ------------------------------------------------------------------
+
+    def _check_metric(self, node: ast.Call, ctx: FileContext) -> None:
+        name = str_arg(node)
+        if name is None or not _NAME_RE.match(name):
+            return
+        if name not in self.registries.metric_names:
+            ctx.report(
+                self.name, node,
+                f"metric {name!r} is not registered in any "
+                "*_METRIC_NAMES list (emqx_tpu/observe/metrics.py) — "
+                "Metrics.inc would raise KeyError at runtime",
+            )
+
+    def _check_config(self, node: ast.Call, ctx: FileContext) -> None:
+        key = str_arg(node)
+        if key is None or not _NAME_RE.match(key):
+            return
+        if key not in self.registries.config_keys:
+            ctx.report(
+                self.name, node,
+                f"config key {key!r} is not in the SCHEMA dict "
+                "(emqx_tpu/config.py) — the read always returns the "
+                "fallback, silently ignoring configuration",
+            )
+
+    def _check_fault(self, node: ast.Call, ctx: FileContext) -> None:
+        point = str_arg(node)
+        if point is None:
+            return
+        if point not in self.registries.fault_points:
+            ctx.report(
+                self.name, node,
+                f"fault-injection point {point!r} is not declared in "
+                "faultinject.POINTS — no scenario can ever target it "
+                "(FaultInjector rejects unknown points)",
+            )
+
+    def _check_drop_reason(self, node: ast.Call, ctx: FileContext) -> None:
+        hook = str_arg(node)
+        if hook not in ("message.dropped", "delivery.dropped") \
+                or len(node.args) < 2:
+            return
+        args = node.args[1]
+        if not isinstance(args, ast.Tuple) or len(args.elts) < 2:
+            return
+        reason_node = args.elts[1]
+        if not (isinstance(reason_node, ast.Constant)
+                and isinstance(reason_node.value, str)):
+            return
+        reason = _DROP_REASON_REMAP.get(
+            reason_node.value, reason_node.value)
+        family = ("messages.dropped" if hook == "message.dropped"
+                  else "delivery.dropped")
+        derived = f"{family}.{reason}"
+        if derived not in self.registries.metric_names:
+            ctx.report(
+                self.name, node,
+                f"drop reason {reason_node.value!r} derives metric "
+                f"{derived!r}, which is not registered in "
+                "observe/metrics.py — inc_msg_dropped silently skips "
+                "the detail counter (only the total moves)",
+            )
+
+    def _note_alarm(self, node: ast.Call, ctx: FileContext,
+                    method: str) -> None:
+        if not node.args:
+            return
+        arg = node.args[0]
+        literal = str_arg(node)
+        if literal is not None:
+            entry = (literal, False)
+        else:
+            prefix = fstring_prefix(arg)
+            if prefix is None or not prefix:
+                return  # fully dynamic: nothing to check statically
+            entry = (prefix, True)
+        if method == "activate":
+            self._activations.append(entry)
+        else:
+            placeholder = Finding(
+                rule=self.name, path=ctx.relpath,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                message=(
+                    f"alarm {entry[0]!r} is deactivated but never "
+                    "activated anywhere in the tree — the deactivate "
+                    "can never match and the alarm name has drifted"
+                ),
+                context=ctx.qualname(),
+            )
+            self._deactivations.append((entry[0], entry[1], placeholder))
+
+    def finalize(self) -> List[Finding]:
+        out: List[Finding] = []
+        for name, is_prefix, finding in self._deactivations:
+            if not any(self._alarm_match(name, is_prefix, act, act_pfx)
+                       for act, act_pfx in self._activations):
+                out.append(finding)
+        return out
+
+    @staticmethod
+    def _alarm_match(deact: str, deact_pfx: bool, act: str,
+                     act_pfx: bool) -> bool:
+        if deact_pfx or act_pfx:
+            shorter = min(len(deact), len(act))
+            return deact[:shorter] == act[:shorter]
+        return deact == act

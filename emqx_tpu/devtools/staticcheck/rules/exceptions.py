@@ -1,0 +1,71 @@
+"""no-swallowed-exceptions: delivery-path errors must leave a trace.
+
+An overbroad ``except`` whose handler is pure ``pass`` turns a delivery
+bug into silence — the broker keeps accepting work it can no longer do.
+On delivery-path modules (``project.DELIVERY_PATH_PREFIXES``) every
+bare / ``Exception`` / ``BaseException`` handler must *do* something
+with the error: re-raise, log, count, return a status, or run recovery
+code.  A handler whose body is only ``pass``/``continue``/bare
+``return``/ellipsis is a finding; even best-effort cleanup gets a
+``log.debug(..., exc_info=True)`` so a recurring failure is observable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule, terminal_name
+from .. import project
+
+__all__ = ["NoSwallowedExceptions"]
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if terminal_name(t) in _BROAD:
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(terminal_name(el) in _BROAD for el in t.elts)
+    return False
+
+
+def _drops_silently(handler: ast.ExceptHandler) -> bool:
+    """True when the body neither raises, logs, calls anything, assigns
+    state, nor returns a value — i.e. the error vanishes."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+            continue
+        if isinstance(stmt, ast.Return) and (
+                stmt.value is None
+                or (isinstance(stmt.value, ast.Constant)
+                    and stmt.value.value is None)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant):
+            continue  # docstring / ellipsis
+        return False  # anything else handles the error somehow
+    return True
+
+
+class NoSwallowedExceptions(Rule):
+    name = "no-swallowed-exceptions"
+    description = "overbroad except silently drops the error"
+    node_types = (ast.ExceptHandler,)
+
+    def visit(self, node: ast.ExceptHandler, ctx: FileContext) -> None:
+        if not ctx.relpath.startswith(project.DELIVERY_PATH_PREFIXES):
+            return
+        if not _is_broad(node) or not _drops_silently(node):
+            return
+        caught = ("bare except" if node.type is None
+                  else f"except {ast.unparse(node.type)}")
+        ctx.report(
+            self.name, node,
+            f"{caught} swallows the error with no log/re-raise/handling "
+            "on a delivery-path module; at minimum log.debug(..., "
+            "exc_info=True) so a recurring failure is observable",
+        )

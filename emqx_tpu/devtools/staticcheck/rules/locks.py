@@ -1,0 +1,82 @@
+"""await-under-lock: don't wait for other tasks while holding a lock.
+
+The race shape the chaos suite can't deterministically hit: task A holds
+an ``asyncio.Lock`` and awaits something that only completes when
+another task runs — ``asyncio.wait``/``gather``, an ``Event.wait``, a
+second lock — while task B needs the held lock to make that progress.
+Best case the lock serializes the delivery path behind an unrelated
+wait; worst case it deadlocks.
+
+Awaiting a plain protocol call (one send/recv the lock exists to
+serialize) is fine and not flagged; what's flagged is *waiting for
+tasks*: ``asyncio.sleep``/``wait``/``wait_for``/``gather``/``shield``,
+``.wait()``/``.join()``, and acquiring another known lock while one is
+already held (lock-ordering hazard).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule, call_name, terminal_name
+
+__all__ = ["AwaitUnderLock"]
+
+#: waits-for-other-tasks calls.  asyncio.wait_for is deliberately NOT
+#: here: a deadline wrapper around the one exchange the lock exists to
+#: serialize (wire.LazyTcpClient._guarded) is the correct pattern.
+_TASK_WAITS = {
+    "asyncio.sleep", "asyncio.wait", "asyncio.gather", "asyncio.shield",
+}
+_WAIT_METHODS = {"wait", "join"}
+
+
+class AwaitUnderLock(Rule):
+    name = "await-under-lock"
+    description = "blocking wait while holding an asyncio.Lock"
+    node_types = (ast.Await, ast.AsyncWith)
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if not ctx.lock_stack:
+            return
+        held = ctx.held_locks[-1]
+        if isinstance(node, ast.AsyncWith):
+            # nested lock acquisition under a held lock: ordering hazard
+            for item in node.items:
+                name = terminal_name(item.context_expr)
+                if name is not None and name != held and (
+                        name in ctx.lock_names or name.endswith("_lock")
+                        or name == "lock"):
+                    ctx.report(
+                        self.name, node,
+                        f"acquiring lock {name!r} while already holding "
+                        f"{held!r}: lock-ordering hazard (deadlocks if "
+                        "any path takes them in the other order)",
+                    )
+            return
+        value = node.value
+        if not isinstance(value, ast.Call):
+            return
+        name = call_name(value)
+        terminal = terminal_name(value.func)
+        flagged = None
+        if name in _TASK_WAITS:
+            flagged = name
+        elif terminal in _WAIT_METHODS:
+            flagged = name or terminal
+        elif terminal == "acquire":
+            recv = terminal_name(value.func.value) \
+                if isinstance(value.func, ast.Attribute) else None
+            if recv is not None and recv != held and (
+                    recv in ctx.lock_names or recv.endswith("_lock")
+                    or recv == "lock"):
+                flagged = f"{recv}.acquire"
+        if flagged is None:
+            return
+        ctx.report(
+            self.name, node,
+            f"await {flagged}() while holding lock {held!r} waits for "
+            "other tasks with the lock held — every waiter serializes "
+            "behind this wait (deadlock if one of them needs the lock); "
+            "move the wait outside the critical section",
+        )

@@ -1,0 +1,59 @@
+"""no-unsupervised-task: every long-lived loop is a supervised child.
+
+PR 3's invariant: a raw ``asyncio.create_task``/``ensure_future`` that
+crashes silently stops delivering until node restart; tasks must
+register through :class:`emqx_tpu.supervise.Supervisor` instead.
+
+Exempt, in order of checking:
+
+* :mod:`emqx_tpu.supervise` itself (the registration mechanism);
+* the supervised-with-fallback shape — a spawn lexically inside an
+  ``if``/``else`` whose test mentions ``sup``/``supervisor`` (the
+  documented pattern for components usable without a node);
+* allowlisted request-scoped sites (``project.ALLOWED_TASK_SITES``) —
+  tasks that die with the connection/event that spawned them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule, call_name
+from .. import project
+
+__all__ = ["NoUnsupervisedTask"]
+
+_SPAWNERS = {"create_task", "ensure_future"}
+
+
+class NoUnsupervisedTask(Rule):
+    name = "no-unsupervised-task"
+    description = ("asyncio.create_task/ensure_future outside the "
+                   "supervision tree")
+    node_types = (ast.Call,)
+
+    def visit(self, node: ast.Call, ctx: FileContext) -> None:
+        func = node.func
+        terminal = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else None)
+        if terminal not in _SPAWNERS:
+            return
+        if ctx.relpath == project.SUPERVISE_MODULE:
+            return
+        if ctx.enclosing_if_mentions("sup", "supervisor"):
+            # supervised-with-fallback: the unsupervised branch is the
+            # explicit no-node fallback (telemetry/statsd/fanout shape)
+            return
+        qualname = ctx.qualname()
+        for (path, allowed), _reason in project.ALLOWED_TASK_SITES.items():
+            if path == ctx.relpath and (
+                    qualname == allowed
+                    or qualname.startswith(allowed + ".")):
+                return
+        ctx.report(
+            self.name, node,
+            f"{call_name(node)}() spawns an unsupervised task; register "
+            "it via Supervisor.start_child (emqx_tpu/supervise.py) or, "
+            "if it is request-scoped, allowlist the site in "
+            "devtools/staticcheck/project.py with a reason",
+        )

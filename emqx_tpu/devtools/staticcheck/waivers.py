@@ -1,0 +1,135 @@
+"""Expiring waivers: suppressions that cannot silently rot.
+
+A waiver ties a finding ``key`` (rule + path + context + message hash —
+line-number free, so unrelated edits don't invalidate it) to a reason
+and an **expiry date**.  Semantics:
+
+* a live waiver suppresses its finding (reported as waived, exit 0);
+* an **expired** waiver stops suppressing — the finding comes back AND
+  the expired entry itself is reported, so the debt resurfaces loudly;
+* a **stale** waiver (matches nothing — the finding was fixed) is
+  reported so the file shrinks back toward empty.
+
+``--baseline write`` stamps the current findings into the file with a
+default 30-day expiry; the intended steady state of the repo's waiver
+file is *empty*.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding
+
+__all__ = ["Waiver", "WaiverFile", "DEFAULT_EXPIRY_DAYS"]
+
+DEFAULT_EXPIRY_DAYS = 30
+
+
+@dataclass
+class Waiver:
+    key: str
+    rule: str
+    path: str
+    message: str
+    reason: str
+    expires: str  # ISO date YYYY-MM-DD
+
+    def expired(self, today: datetime.date) -> bool:
+        return datetime.date.fromisoformat(self.expires) < today
+
+    def to_dict(self) -> Dict[str, str]:
+        return {
+            "key": self.key, "rule": self.rule, "path": self.path,
+            "message": self.message, "reason": self.reason,
+            "expires": self.expires,
+        }
+
+
+class WaiverFile:
+    """The on-disk waiver set + the apply/diff logic."""
+
+    def __init__(self, waivers: Optional[List[Waiver]] = None) -> None:
+        self.waivers = waivers if waivers is not None else []
+
+    @classmethod
+    def load(cls, path: str) -> "WaiverFile":
+        if not os.path.exists(path):
+            return cls([])
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls([Waiver(**w) for w in data.get("waivers", [])])
+
+    def save(self, path: str) -> None:
+        payload = {
+            "version": 1,
+            "comment": (
+                "Expiring suppressions for scripts/staticcheck.py. "
+                "Steady state is an empty list; entries past 'expires' "
+                "stop suppressing and resurface as findings."
+            ),
+            "waivers": [w.to_dict() for w in self.waivers],
+        }
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    # ------------------------------------------------------------------
+
+    def apply(
+        self, findings: Sequence[Finding],
+        today: Optional[datetime.date] = None,
+    ) -> Tuple[List[Finding], List[Finding], List[Waiver], List[Waiver]]:
+        """Split ``findings`` against the waiver set.
+
+        Returns ``(new, waived, expired_hits, stale)``:
+        ``new`` = unwaived findings (fail the run); ``waived`` =
+        suppressed by a live waiver; ``expired_hits`` = waivers past
+        expiry whose finding still exists (their findings are in
+        ``new``); ``stale`` = waivers matching no current finding."""
+        today = today if today is not None else datetime.date.today()
+        by_key: Dict[str, Waiver] = {w.key: w for w in self.waivers}
+        new: List[Finding] = []
+        waived: List[Finding] = []
+        expired_hits: List[Waiver] = []
+        seen_keys = set()
+        for f in findings:
+            seen_keys.add(f.key)
+            w = by_key.get(f.key)
+            if w is None:
+                new.append(f)
+            elif w.expired(today):
+                expired_hits.append(w)
+                new.append(f)
+            else:
+                waived.append(f)
+        stale = [w for w in self.waivers if w.key not in seen_keys]
+        return new, waived, expired_hits, stale
+
+    @classmethod
+    def baseline(
+        cls, findings: Sequence[Finding],
+        reason: str = "baselined (fix before expiry)",
+        days: int = DEFAULT_EXPIRY_DAYS,
+        today: Optional[datetime.date] = None,
+    ) -> "WaiverFile":
+        """A waiver file covering ``findings``, stamped to expire in
+        ``days`` — the escape hatch for landing the checker on a tree
+        with known debt, never for new code."""
+        today = today if today is not None else datetime.date.today()
+        expires = (today + datetime.timedelta(days=days)).isoformat()
+        seen = set()
+        waivers = []
+        for f in findings:
+            if f.key in seen:
+                continue
+            seen.add(f.key)
+            waivers.append(Waiver(
+                key=f.key, rule=f.rule, path=f.path, message=f.message,
+                reason=reason, expires=expires,
+            ))
+        return cls(waivers)

@@ -144,7 +144,8 @@ class ExHookManager:
                         timeout=st.spec.timeout,
                     )
                 except Exception:
-                    pass
+                    log.debug("exhook %s OnProviderUnloaded failed",
+                              st.spec.name, exc_info=True)
             if st.channel is not None:
                 await st.channel.close()
                 st.channel = None

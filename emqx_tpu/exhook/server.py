@@ -179,10 +179,20 @@ class TpuMatchSidecar:
         self._running = True
         if self.checkpoint_path:
             self._restore_checkpoint()
-        self._tasks = [
-            asyncio.ensure_future(self._sync_loop()),
-            asyncio.ensure_future(self._batch_loop()),
-        ]
+        # supervised when a host sets .supervisor before start (embedded
+        # use); the standalone sidecar process has no supervision tree
+        # and falls back to raw tasks
+        sup = getattr(self, "supervisor", None)
+        if sup is not None:
+            self._tasks = [
+                sup.start_child("exhook.sidecar.sync", self._sync_loop),
+                sup.start_child("exhook.sidecar.batch", self._batch_loop),
+            ]
+        else:
+            self._tasks = [
+                asyncio.ensure_future(self._sync_loop()),
+                asyncio.ensure_future(self._batch_loop()),
+            ]
 
     def _restore_checkpoint(self) -> None:
         """Re-adopt the checkpointed filter set so the mirror serves

@@ -48,7 +48,8 @@ class GatewayConn:
             try:
                 old.kick("takeover by new gateway connection")
             except Exception:
-                pass
+                log.debug("takeover kick of %s failed", clientid,
+                          exc_info=True)
         sess, present = self.node.broker.open_session(
             clientid, clean_start=clean_start, **kw
         )
@@ -125,7 +126,8 @@ class GatewayConn:
         try:
             self.close_transport(reason)
         except Exception:
-            pass
+            log.debug("%s gateway transport close for %s failed",
+                      self.gateway, self.clientid, exc_info=True)
 
     # -- subclass surface ---------------------------------------------------
 
@@ -151,6 +153,17 @@ class Gateway:
 
     async def stop(self) -> None:  # pragma: no cover - interface
         raise NotImplementedError
+
+    def spawn_loop(self, name: str, factory: Any) -> Any:
+        """Start a gateway-lifetime loop (sweeper, heartbeat) as a
+        supervised child when the node carries a supervision tree — a
+        crashed sweeper otherwise silently stops expiring sessions
+        until node restart.  Returns a Task-like handle (``cancel()``
+        stops it either way)."""
+        sup = getattr(self.node, "supervisor", None)
+        if sup is not None:
+            return sup.start_child(f"gateway.{self.name}.{name}", factory)
+        return asyncio.ensure_future(factory())
 
     def info(self) -> Dict[str, Any]:
         return {
@@ -240,7 +253,7 @@ class GatewayManager:
             try:
                 await self._retry_task
             except (asyncio.CancelledError, Exception):
-                pass
+                log.debug("gateway retry task exit", exc_info=True)
             self._retry_task = None
         for name in list(self.gateways):
             await self.unload(name)

@@ -318,7 +318,7 @@ class CoapGateway(Gateway):
         )
         self.port = self.transport.get_extra_info("sockname")[1]
         wrap_dtls_transport(self)
-        self._sweeper = asyncio.ensure_future(self._sweep())
+        self._sweeper = self.spawn_loop("sweep", self._sweep)
         log.info("coap gateway on udp%s %s:%d",
                  "+dtls" if self.dtls else "", host, self.port)
 

@@ -286,7 +286,8 @@ class ExProtoGateway(Gateway):
                 await self.handler.OnSocketClosed(pb.SocketClosedRequest(
                     conn=conn_id, reason="closed"))
             except Exception:
-                pass
+                log.debug("exproto OnSocketClosed for %s failed",
+                          conn_id, exc_info=True)
 
     async def notify_messages(self, conn_id: str,
                               msgs: List[pb.Message]) -> None:

@@ -216,7 +216,7 @@ class Lwm2mGateway(Gateway):
         )
         self.port = self.transport.get_extra_info("sockname")[1]
         wrap_dtls_transport(self)
-        self._sweeper = asyncio.ensure_future(self._sweep())
+        self._sweeper = self.spawn_loop("sweep", self._sweep)
         log.info("lwm2m gateway on udp%s %s:%d",
                  "+dtls" if self.dtls else "", host, self.port)
 

@@ -387,7 +387,8 @@ class SnClient(GatewayConn):
         try:
             self.send(DISCONNECT, b"")
         except Exception:
-            pass
+            log.debug("mqttsn goodbye DISCONNECT to %s failed",
+                      self.addr, exc_info=True)
         self.gw.drop(self.addr)
 
 
@@ -425,7 +426,7 @@ class MqttSnGateway(Gateway):
             lambda: _Proto(self), local_addr=(host or "0.0.0.0", int(port))
         )
         self.port = self.transport.get_extra_info("sockname")[1]
-        self._sweeper = asyncio.ensure_future(self._sweep())
+        self._sweeper = self.spawn_loop("sweep", self._sweep)
         log.info("mqttsn gateway on udp %s:%d", host, self.port)
 
     async def stop(self) -> None:

@@ -371,7 +371,8 @@ class StompConn(GatewayConn):
         try:
             self._reply(StompFrame("ERROR", {"message": msg}))
         except Exception:
-            pass
+            log.debug("stomp ERROR frame to %s failed", self.clientid,
+                      exc_info=True)
 
     def _receipt(self, f: StompFrame) -> None:
         rid = f.headers.get("receipt")

@@ -89,6 +89,17 @@ class BrokerNode:
             max_queue_depth=cfg.get("overload_protection.max_queue_depth"),
             cooloff=cfg.get("overload_protection.cooloff"),
         )
+        # sleep-drift sampler: CPU saturation trips overload protection
+        # even when no queue grows (started as a supervised child)
+        from .broker.olp import LoopLagProbe
+
+        self.lag_probe = None
+        probe_interval = cfg.get("overload_protection.lag_probe_interval")
+        if probe_interval and probe_interval > 0:
+            self.lag_probe = LoopLagProbe(
+                self.olp, metrics=self.observed.metrics,
+                interval=probe_interval,
+            )
         # connection gauges come from the CM (a node-level table), so
         # they wire here rather than in observe(broker)
         self.observed.stats.provide(
@@ -640,6 +651,9 @@ class BrokerNode:
         self._running = True
         self._jobs.append(self.supervisor.start_child(
             "node.housekeeping", self._housekeeping))
+        if self.lag_probe is not None:
+            self._jobs.append(self.supervisor.start_child(
+                "olp.lag_probe", self.lag_probe.run))
 
     async def _start_quic(self) -> None:
         """MQTT-over-QUIC listener (quicer analog): the in-repo
@@ -655,10 +669,12 @@ class BrokerNode:
             log.warning("quic listener enabled without a cert pair")
             return
         try:
-            with open(cert, "rb") as f:
-                cert_pem = f.read()
-            with open(key, "rb") as f:
-                key_pem = f.read()
+            # cert reads off-loop: a slow/network filesystem must not
+            # stall connections already being served (staticcheck:
+            # no-blocking-in-async)
+            from pathlib import Path
+            cert_pem = await asyncio.to_thread(Path(cert).read_bytes)
+            key_pem = await asyncio.to_thread(Path(key).read_bytes)
             from .transport.connection import ConnInfo
             from .transport.quic import QuicEndpoint
 
@@ -706,7 +722,8 @@ class BrokerNode:
             self.quic = QuicEndpoint(
                 self._quic_transport, cert_pem, key_pem, on_connection,
                 max_connections=int(cfg.get(
-                    "listeners.quic.default.max_connections")))
+                    "listeners.quic.default.max_connections")),
+                supervisor=self.supervisor)
             log.info("quic listener on udp %s:%d", host, self.quic_port)
         except Exception:
             log.exception("quic listener failed to start")
@@ -740,6 +757,7 @@ class BrokerNode:
                     "listeners.ssl.default.ocsp.refresh_interval"),
                 refresh_http_timeout_s=cfg.get(
                     "listeners.ssl.default.ocsp.refresh_http_timeout"),
+                supervisor=self.supervisor,
             )
             self.ocsp_cache.start()
         except Exception:

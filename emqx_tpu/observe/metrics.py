@@ -56,6 +56,10 @@ METRIC_NAMES: List[str] = [
     "messages.dropped.no_subscribers", "messages.dropped.await_pubrel_timeout",
     "messages.dropped.receive_maximum", "messages.dropped.expired",
     "messages.dropped.queue_full", "messages.dropped.too_large",
+    # detail counters for drop reasons our delivery stack emits beyond
+    # the reference set (registry-drift: inc_msg_dropped silently skips
+    # unregistered detail keys — these two under-counted before PR 4)
+    "messages.dropped.olp_shed", "messages.dropped.forward_no_peer",
     "messages.forward", "messages.delayed", "messages.delivered",
     "messages.acked", "messages.retained",
     # delivery
@@ -110,6 +114,10 @@ FANOUT_METRIC_NAMES: List[str] = [
 ROBUSTNESS_METRIC_NAMES: List[str] = [
     "broker.supervisor.restarts", "broker.supervisor.degraded",
     "broker.olp.shed_qos0", "broker.olp.deferred",
+    # event-loop lag (sleep-drift sampler, broker/olp.py LoopLagProbe):
+    # last observed drift in µs (set) — the CPU-saturation overload
+    # signal that fires even when no queue grows
+    "broker.olp.loop_lag_us",
 ]
 
 

@@ -179,6 +179,13 @@ class Supervisor:
     ) -> Child:
         if restart not in (PERMANENT, TRANSIENT, TEMPORARY):
             raise ValueError(f"unknown restart policy {restart!r}")
+        # reap finished same-name children: transient loops that end and
+        # re-register per activity cycle (quic.timer) must not grow the
+        # child table across cycles
+        self.children = [
+            c for c in self.children
+            if not (c.name == name and c.done())
+        ]
         child = Child(
             self, name, factory, restart,
             backoff_base if backoff_base is not None else self.backoff_base,
@@ -216,7 +223,8 @@ class Supervisor:
             try:
                 await runner
             except (asyncio.CancelledError, Exception):
-                pass
+                log.debug("supervised child %r runner exit", child.name,
+                          exc_info=True)
         child.state = "stopped"
         if child.degraded:
             self._clear_degraded(child)
@@ -252,7 +260,8 @@ class Supervisor:
                     try:
                         await inner
                     except BaseException:
-                        pass
+                        log.debug("supervised child %r run exit on stop",
+                                  child.name, exc_info=True)
                     child.task = None
                     raise
                 child.task = None

@@ -74,13 +74,13 @@ class TcpStream:
         try:
             self._w.close()
         except Exception:
-            pass
+            log.debug("tcp transport close failed", exc_info=True)
 
     async def wait_closed(self) -> None:
         try:
             await self._w.wait_closed()
         except Exception:
-            pass
+            log.debug("tcp wait_closed failed", exc_info=True)
 
     def peername(self):
         return self._w.get_extra_info("peername")

@@ -49,8 +49,14 @@ class OcspCache:
         refresh_interval_s: float = 3600.0,
         refresh_http_timeout_s: float = 10.0,
         fetch: Optional[Callable] = None,
+        supervisor: Optional[object] = None,
     ) -> None:
         from cryptography import x509
+
+        # node's supervision tree (when embedded): the refresh loop
+        # registers there so a crashed refresher restarts instead of
+        # the staple silently going stale until node restart
+        self.supervisor = supervisor
 
         self.cert = x509.load_pem_x509_certificate(cert_pem)
         self.issuer = x509.load_pem_x509_certificate(issuer_pem)
@@ -201,7 +207,11 @@ class OcspCache:
 
     def start(self) -> None:
         if self._task is None:
-            self._task = asyncio.ensure_future(self._loop())
+            sup = self.supervisor
+            if sup is not None:
+                self._task = sup.start_child("transport.ocsp", self._loop)
+            else:
+                self._task = asyncio.ensure_future(self._loop())
 
     def stop(self) -> None:
         if self._task is not None:

@@ -879,7 +879,8 @@ class QuicEndpoint:
                  on_connection, alpn: str = "mqtt",
                  idle_timeout: float = 120.0,
                  max_connections: int = 4096,
-                 mtu_discovery: bool = True) -> None:
+                 mtu_discovery: bool = True,
+                 supervisor=None) -> None:
         self.transport = transport
         self.cert_pem = cert_pem
         self.key_pem = key_pem
@@ -900,7 +901,11 @@ class QuicEndpoint:
         self.dropped_initials = 0
         self.retransmits = 0        # endpoint-lifetime (survives drops)
         self.retransmit_tick = 0.2
-        self._timer_task: Optional[asyncio.Task] = None
+        # node's supervision tree (when embedded): the retransmission
+        # timer registers as a transient child there, so a crashed tick
+        # loop restarts instead of silently freezing every handshake PTO
+        self.supervisor = supervisor
+        self._timer_task = None     # asyncio.Task or supervise.Child
 
 
     def live_conns(self) -> list:
@@ -910,13 +915,22 @@ class QuicEndpoint:
     def _ensure_timer(self) -> None:
         """Retransmission timer: one endpoint-wide ~200 ms tick driving
         every connection's PTO (RFC 9002 analog; the 1 s node
-        housekeeping is too coarse for handshake recovery)."""
+        housekeeping is too coarse for handshake recovery).  Transient
+        supervised child when a supervisor is attached — the loop ends
+        normally when the last connection sweeps out and re-registers on
+        the next Initial; a crash restarts it with backoff."""
         if self._timer_task is None or self._timer_task.done():
-            try:
-                self._timer_task = asyncio.get_running_loop().create_task(
-                    self._timer_loop())
-            except RuntimeError:    # sans-io use (tests): no loop
-                pass
+            sup = self.supervisor
+            if sup is not None:
+                self._timer_task = sup.start_child(
+                    "quic.timer", self._timer_loop, restart="transient")
+            else:
+                try:
+                    self._timer_task = \
+                        asyncio.get_running_loop().create_task(
+                            self._timer_loop())
+                except RuntimeError:    # sans-io use (tests): no loop
+                    pass
 
     async def _timer_loop(self) -> None:
         while self.by_cid:

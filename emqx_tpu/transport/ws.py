@@ -14,7 +14,10 @@ from __future__ import annotations
 import asyncio
 import base64
 import hashlib
+import logging
 from typing import Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 _GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 
@@ -216,13 +219,13 @@ class WsStream:
         try:
             self._w.close()
         except Exception:
-            pass
+            log.debug("ws transport close failed", exc_info=True)
 
     async def wait_closed(self) -> None:
         try:
             await self._w.wait_closed()
         except Exception:
-            pass
+            log.debug("ws wait_closed failed", exc_info=True)
 
     def peername(self):
         return self._w.get_extra_info("peername")

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Project-invariant static analysis CLI (the dialyzer/xref analog).
+
+Usage::
+
+    python scripts/staticcheck.py                       # tree, all rules
+    python scripts/staticcheck.py emqx_tpu/broker       # subtree
+    python scripts/staticcheck.py --rule registry-drift --rule await-under-lock
+    python scripts/staticcheck.py --baseline write      # stamp waivers
+    python scripts/staticcheck.py --format json
+
+Exit codes: 0 = clean (all findings waived by live waivers), 1 = new
+findings (or expired waivers whose finding persists), 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from emqx_tpu.devtools.staticcheck import (  # noqa: E402
+    check_paths, get_rules, iter_py_files,
+)
+from emqx_tpu.devtools.staticcheck.report import (  # noqa: E402
+    format_json, format_text,
+)
+from emqx_tpu.devtools.staticcheck.rules import ALL_RULES  # noqa: E402
+from emqx_tpu.devtools.staticcheck.waivers import (  # noqa: E402
+    DEFAULT_EXPIRY_DAYS, WaiverFile,
+)
+
+DEFAULT_WAIVER_FILE = os.path.join(_REPO_ROOT, "staticcheck-waivers.json")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="staticcheck.py",
+        description="AST-based project-invariant checks for emqx_tpu",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        default=None,
+        help="files/directories to check (default: emqx_tpu/)",
+    )
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="NAME",
+        help="run only this rule (repeatable); known: "
+             + ", ".join(r.name for r in ALL_RULES),
+    )
+    parser.add_argument(
+        "--waivers", default=DEFAULT_WAIVER_FILE, metavar="FILE",
+        help="waiver file (default: staticcheck-waivers.json at repo "
+             "root)",
+    )
+    parser.add_argument(
+        "--baseline", choices=("write", "diff"), default="diff",
+        help="'write' stamps current findings into the waiver file "
+             "with a %d-day expiry; 'diff' (default) suppresses live "
+             "waivers and fails on anything new" % DEFAULT_EXPIRY_DAYS,
+    )
+    parser.add_argument(
+        "--expiry-days", type=int, default=DEFAULT_EXPIRY_DAYS,
+        help="expiry horizon for --baseline write",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    paths = args.paths or [os.path.join(_REPO_ROOT, "emqx_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"staticcheck: no such path: {p}", file=sys.stderr)
+            return 2
+    try:
+        rules = get_rules(args.rules)
+    except KeyError as e:
+        print(f"staticcheck: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    files = list(iter_py_files(paths))
+    findings = check_paths(files, rules, root=_REPO_ROOT)
+
+    if args.baseline == "write":
+        wf = WaiverFile.baseline(findings, days=args.expiry_days)
+        wf.save(args.waivers)
+        print(f"wrote {len(wf.waivers)} waiver(s) to {args.waivers} "
+              f"(expiring in {args.expiry_days} days)")
+        return 0
+
+    wf = WaiverFile.load(args.waivers)
+    new, waived, expired, stale = wf.apply(findings)
+    fmt = format_json if args.format == "json" else format_text
+    print(fmt(new, waived, expired, stale, files_checked=len(files)))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

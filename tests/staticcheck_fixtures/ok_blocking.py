@@ -1,0 +1,15 @@
+"""Must PASS no-blocking-in-async: async sleep, and sync calls in sync
+context."""
+import asyncio
+import time
+
+
+def sync_path():
+    time.sleep(0)
+    with open("/etc/hosts") as f:
+        return f.read()
+
+
+async def handler():
+    await asyncio.sleep(0)
+    return await asyncio.to_thread(sync_path)

@@ -1,0 +1,22 @@
+"""Must PASS unawaited-coroutine: awaited, returned, or task-wrapped."""
+
+
+async def helper():
+    pass
+
+
+async def main(supervisor):
+    await helper()
+    supervisor.start_child("h", helper)
+    return helper()
+
+
+class C:
+    async def flush(self):
+        pass
+
+    async def tick(self):
+        await self.flush()
+
+    def name_shadow(self, flush):
+        flush()  # plain callable param, not the async method

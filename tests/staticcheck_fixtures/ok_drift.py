@@ -1,0 +1,15 @@
+"""Must PASS registry-drift: registered names only, and a matched
+activate/deactivate pair (literal + f-string prefix)."""
+
+
+def f(metrics, cfg, alarms, hooks, _injector, name):
+    metrics.inc("messages.delivered")
+    metrics.set("broker.fanout.depth", 3)
+    cfg.get("mqtt.max_inflight")
+    _injector.check("fanout.drain")
+    alarms.activate("overload_fixture", {}, "hot")
+    alarms.deactivate("overload_fixture")
+    alarms.activate(f"degraded_fixture:{name}", {}, "bad")
+    alarms.deactivate(f"degraded_fixture:{name}")
+    hooks.run("message.dropped", (None, "queue_full"))
+    hooks.run("message.dropped", (None, "shared_no_available"))

@@ -1,0 +1,28 @@
+"""Must PASS no-swallowed-exceptions: narrow catches, logging, status
+returns, re-raises, recovery calls."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def deliver(batch, conn):
+    try:
+        batch.flush()
+    except Exception:
+        log.debug("flush failed", exc_info=True)
+    try:
+        conn.send(batch)
+    except ConnectionError:
+        pass  # narrow catch: not overbroad
+    try:
+        conn.health()
+    except Exception:
+        return False
+    try:
+        conn.ping()
+    except Exception:
+        conn.reconnect()
+    try:
+        conn.commit()
+    except Exception:
+        raise

@@ -1,0 +1,17 @@
+"""Must PASS await-under-lock: a deadline wrapper around the one
+exchange the lock serializes, and waits with no lock held."""
+import asyncio
+
+
+class C:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._evt = asyncio.Event()
+
+    async def guarded(self, op):
+        async with self._lock:
+            return await asyncio.wait_for(op(), 1.0)
+
+    async def unguarded_wait(self):
+        await self._evt.wait()
+        await asyncio.sleep(0)

@@ -1,0 +1,20 @@
+"""Must PASS no-unsupervised-task: the supervised-with-fallback shape,
+in both its forms."""
+import asyncio
+
+
+async def boot(supervisor):
+    if supervisor is not None:
+        supervisor.start_child("x", work)
+    else:
+        asyncio.ensure_future(work())
+
+
+def spawn(sup, factory):
+    if sup is not None:
+        return sup.start_child("x", factory)
+    return asyncio.ensure_future(factory())
+
+
+async def work():
+    pass

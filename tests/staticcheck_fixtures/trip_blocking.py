@@ -1,0 +1,8 @@
+"""Must TRIP no-blocking-in-async: sync sleep and file IO on the loop."""
+import time
+
+
+async def handler():
+    time.sleep(0.1)
+    with open("/etc/hosts") as f:
+        return f.read()

@@ -1,0 +1,17 @@
+"""Must TRIP unawaited-coroutine: discarded coroutine calls."""
+
+
+async def helper():
+    pass
+
+
+def main():
+    helper()
+
+
+class C:
+    async def flush(self):
+        pass
+
+    def tick(self):
+        self.flush()

@@ -1,0 +1,10 @@
+"""Must TRIP registry-drift on all five surfaces (checked against the
+real registries in observe/metrics.py / config.py / faultinject.py)."""
+
+
+def f(metrics, cfg, alarms, hooks, _injector):
+    metrics.inc("tpu.match.not_a_real_metric")
+    cfg.get("mqtt.not_a_real_key")
+    _injector.check("bogus.point")
+    alarms.deactivate("never_activated_alarm")
+    hooks.run("message.dropped", (None, "not_a_real_reason"))

@@ -1,0 +1,14 @@
+"""Must TRIP no-swallowed-exceptions (when placed on a delivery path):
+broad handlers whose body drops the error."""
+
+
+def deliver(batch):
+    for item in batch:
+        try:
+            item.send()
+        except Exception:
+            continue
+    try:
+        batch.flush()
+    except:  # noqa: E722
+        pass

@@ -1,0 +1,22 @@
+"""Must TRIP await-under-lock: task-waits and nested locks held."""
+import asyncio
+
+
+class C:
+    def __init__(self):
+        self._lock = asyncio.Lock()
+        self._other_lock = asyncio.Lock()
+        self._evt = asyncio.Event()
+
+    async def bad_sleep(self):
+        async with self._lock:
+            await asyncio.sleep(1)
+
+    async def bad_wait(self):
+        async with self._lock:
+            await self._evt.wait()
+
+    async def bad_nested(self):
+        async with self._lock:
+            async with self._other_lock:
+                pass

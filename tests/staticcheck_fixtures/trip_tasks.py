@@ -1,0 +1,12 @@
+"""Must TRIP no-unsupervised-task: raw spawns with no supervised path."""
+import asyncio
+
+
+async def boot():
+    asyncio.create_task(work())
+    asyncio.ensure_future(work())
+    asyncio.get_running_loop().create_task(work())
+
+
+async def work():
+    pass

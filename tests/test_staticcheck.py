@@ -1,0 +1,403 @@
+"""Tier-1 enforcement of the project-invariant static analysis suite
+(emqx_tpu/devtools/staticcheck) — the dialyzer/xref analog.
+
+Three layers:
+
+* **the tree is clean**: all six rules over ``emqx_tpu/`` produce zero
+  non-waived findings, and every waiver (if any ever lands) is an
+  explicit, justified, expiring entry — no silent suppressions;
+* **the rules work**: each rule has a tripping and a passing fixture
+  under ``tests/staticcheck_fixtures/``, waiver keys are line-stable,
+  and expiry/staleness behave;
+* **the CLI works**: a violation seeded into a copy of
+  ``broker/fanout.py`` is caught with a file:line finding and exit 1;
+  a clean run exits 0.
+
+Satellite coverage rides along: the event-loop lag probe
+(broker/olp.py) and the QUIC-timer / kafka-poll supervised children.
+"""
+
+import asyncio
+import datetime
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+from emqx_tpu.devtools.staticcheck import (
+    Registries, WaiverFile, check_paths, get_rules,
+)
+from emqx_tpu.devtools.staticcheck.rules import ALL_RULES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "emqx_tpu")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "staticcheck_fixtures")
+WAIVER_FILE = os.path.join(REPO, "staticcheck-waivers.json")
+CLI = os.path.join(REPO, "scripts", "staticcheck.py")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def check_fixture(name, rules, tmp_path, relpath="emqx_tpu/broker"):
+    """Run ``rules`` over one fixture file, staged under a repo-shaped
+    temp tree so path-scoped rules (delivery-path prefixes, allowlists)
+    see the intended relative path."""
+    dest_dir = tmp_path / relpath
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    dest = dest_dir / name
+    shutil.copy(os.path.join(FIXTURES, name), dest)
+    return check_paths([str(dest)], get_rules(rules), root=str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# the tree is clean (the tier-1 gate)
+# ---------------------------------------------------------------------------
+
+def test_tree_has_zero_nonwaived_findings():
+    findings = check_paths([PKG], get_rules(), root=REPO)
+    wf = WaiverFile.load(WAIVER_FILE)
+    new, waived, expired, stale = wf.apply(findings)
+    assert not new, (
+        "staticcheck found new violations (fix them or add an expiring "
+        "waiver with a reason):\n"
+        + "\n".join(f"  {f.location()}: [{f.rule}] {f.message}"
+                    for f in new)
+    )
+    assert not expired, (
+        "expired waivers still have live findings: "
+        + ", ".join(w.key for w in expired)
+    )
+
+
+def test_waiver_file_has_no_silent_suppressions():
+    with open(WAIVER_FILE) as f:
+        data = json.load(f)
+    for w in data.get("waivers", []):
+        assert w.get("reason"), f"waiver {w.get('key')} has no reason"
+        # a malformed date must fail here, not silently never expire
+        datetime.date.fromisoformat(w["expires"])
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixtures: each rule trips and passes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("rule,trip,ok,n_trip", [
+    ("no-unsupervised-task", "trip_tasks.py", "ok_tasks.py", 3),
+    ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
+    ("no-swallowed-exceptions", "trip_exceptions.py",
+     "ok_exceptions.py", 2),
+    ("await-under-lock", "trip_locks.py", "ok_locks.py", 3),
+    ("registry-drift", "trip_drift.py", "ok_drift.py", 5),
+    ("unawaited-coroutine", "trip_coroutines.py", "ok_coroutines.py", 2),
+])
+def test_rule_fixture_pair(rule, trip, ok, n_trip, tmp_path):
+    tripped = check_fixture(trip, [rule], tmp_path)
+    assert len(tripped) == n_trip, (
+        f"{rule} on {trip}: expected {n_trip} findings, got "
+        f"{[(f.line, f.message) for f in tripped]}"
+    )
+    assert all(f.rule == rule for f in tripped)
+    assert all(f.line > 0 for f in tripped)
+    passed = check_fixture(ok, [rule], tmp_path)
+    assert passed == [], (
+        f"{rule} on {ok} should be clean, got "
+        f"{[(f.line, f.message) for f in passed]}"
+    )
+
+
+def test_swallowed_exceptions_scoped_to_delivery_paths(tmp_path):
+    # the same tripping file is FINE outside the delivery-path prefixes
+    out = check_fixture("trip_exceptions.py", ["no-swallowed-exceptions"],
+                        tmp_path, relpath="emqx_tpu/ops")
+    assert out == []
+
+
+def test_task_allowlist_honors_site_and_reason(tmp_path):
+    # stage the tripping file at an allowlisted (path, qualname):
+    # client.py / Client.connect is allowlisted as request-scoped
+    dest_dir = tmp_path / "emqx_tpu"
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    dest = dest_dir / "client.py"
+    dest.write_text(
+        "import asyncio\n\n\n"
+        "class Client:\n"
+        "    async def connect(self):\n"
+        "        asyncio.ensure_future(self._read_loop())\n\n"
+        "    async def other(self):\n"
+        "        asyncio.ensure_future(self._read_loop())\n\n"
+        "    async def _read_loop(self):\n"
+        "        pass\n"
+    )
+    out = check_paths([str(dest)], get_rules(["no-unsupervised-task"]),
+                      root=str(tmp_path))
+    # connect() is allowlisted, other() is not
+    assert len(out) == 1 and out[0].context == "Client.other"
+
+
+# ---------------------------------------------------------------------------
+# waivers: keys, expiry, staleness
+# ---------------------------------------------------------------------------
+
+def _fixture_findings(tmp_path):
+    out = check_fixture("trip_blocking.py", ["no-blocking-in-async"],
+                        tmp_path)
+    assert out
+    return out
+
+
+def test_waiver_suppresses_until_expiry_then_resurfaces(tmp_path):
+    findings = _fixture_findings(tmp_path)
+    t0 = datetime.date(2026, 8, 1)
+    wf = WaiverFile.baseline(findings, days=30, today=t0)
+    # live: everything waived, run is clean
+    new, waived, expired, stale = wf.apply(
+        findings, today=t0 + datetime.timedelta(days=15))
+    assert not new and len(waived) == len(findings) and not expired
+    # past expiry: findings come back AND the expired entries surface
+    new, waived, expired, stale = wf.apply(
+        findings, today=t0 + datetime.timedelta(days=31))
+    assert len(new) == len(findings) and not waived
+    assert len(expired) == len(wf.waivers)
+
+
+def test_stale_waivers_are_reported(tmp_path):
+    findings = _fixture_findings(tmp_path)
+    wf = WaiverFile.baseline(findings, today=datetime.date(2026, 8, 1))
+    new, waived, expired, stale = wf.apply(
+        [], today=datetime.date(2026, 8, 2))
+    assert len(stale) == len(wf.waivers) and not new
+
+
+def test_waiver_keys_survive_line_drift(tmp_path):
+    a = check_fixture("trip_blocking.py", ["no-blocking-in-async"],
+                      tmp_path)
+    # same code shifted two lines down: same keys, different lines
+    src = open(os.path.join(FIXTURES, "trip_blocking.py")).read()
+    shifted = tmp_path / "emqx_tpu" / "broker" / "trip_blocking.py"
+    shifted.write_text("# shim\n# shim\n" + src)
+    b = check_paths([str(shifted)], get_rules(["no-blocking-in-async"]),
+                    root=str(tmp_path))
+    assert [f.key for f in a] == [f.key for f in b]
+    assert [f.line for f in a] != [f.line for f in b]
+
+
+def test_waiver_file_roundtrip(tmp_path):
+    findings = _fixture_findings(tmp_path)
+    wf = WaiverFile.baseline(findings, today=datetime.date(2026, 8, 1))
+    p = tmp_path / "w.json"
+    wf.save(str(p))
+    loaded = WaiverFile.load(str(p))
+    assert [w.key for w in loaded.waivers] == [w.key for w in wf.waivers]
+
+
+# ---------------------------------------------------------------------------
+# registries extract the real registration sites
+# ---------------------------------------------------------------------------
+
+def test_registries_extract_from_tree():
+    reg = Registries.load()
+    assert "messages.delivered" in reg.metric_names
+    assert "broker.olp.loop_lag_us" in reg.metric_names
+    assert "messages.dropped.olp_shed" in reg.metric_names
+    assert "mqtt.max_inflight" in reg.config_keys
+    assert "overload_protection.lag_probe_interval" in reg.config_keys
+    assert "fanout.drain" in reg.fault_points
+
+
+def test_registries_match_runtime_tables():
+    # the AST extraction and the live modules must agree, or the drift
+    # rule itself has drifted
+    from emqx_tpu import faultinject
+    from emqx_tpu.config import SCHEMA
+    from emqx_tpu.observe.metrics import Metrics
+
+    reg = Registries.load()
+    assert reg.metric_names == set(Metrics().all().keys())
+    assert reg.config_keys == set(SCHEMA.keys())
+    assert reg.fault_points == set(faultinject.POINTS)
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes + seeded-violation catch
+# ---------------------------------------------------------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, CLI, *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120,
+    )
+
+
+def test_cli_catches_seeded_fanout_violation(tmp_path):
+    src = open(os.path.join(PKG, "broker", "fanout.py")).read()
+    seeded = (
+        src
+        + "\n\nasync def _seeded_violation():\n"
+          "    time.sleep(0.001)\n"
+    )
+    dest_dir = tmp_path / "emqx_tpu" / "broker"
+    dest_dir.mkdir(parents=True)
+    dest = dest_dir / "fanout.py"
+    dest.write_text(seeded)
+    seed_line = seeded[:seeded.index("    time.sleep")].count("\n") + 1
+    r = _cli(str(dest))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert f"fanout.py:{seed_line}:" in r.stdout
+    assert "no-blocking-in-async" in r.stdout
+
+
+def test_cli_clean_file_exits_zero(tmp_path):
+    r = _cli(os.path.join(FIXTURES, "ok_blocking.py"))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_unknown_rule_exits_two():
+    r = _cli("--rule", "no-such-rule")
+    assert r.returncode == 2
+
+
+def test_cli_baseline_write_then_clean(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n\n\nasync def f():\n    time.sleep(1)\n")
+    wpath = tmp_path / "waivers.json"
+    r = _cli(str(bad), "--waivers", str(wpath), "--baseline", "write")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.load(open(wpath))["waivers"]
+    r = _cli(str(bad), "--waivers", str(wpath))
+    assert r.returncode == 0, r.stdout + r.stderr  # all waived now
+
+
+@pytest.mark.slow
+def test_cli_full_tree_under_ten_seconds():
+    t0 = time.monotonic()
+    r = _cli()
+    dt = time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert dt < 10.0, f"staticcheck took {dt:.1f}s over the tree"
+
+
+# ---------------------------------------------------------------------------
+# satellite: event-loop lag probe → Olp.report
+# ---------------------------------------------------------------------------
+
+def test_lag_probe_trips_overload_without_queue_growth():
+    from emqx_tpu.broker.olp import LoopLagProbe, Olp
+    from emqx_tpu.observe.alarm import Alarms
+    from emqx_tpu.observe.metrics import Metrics
+
+    alarms = Alarms()
+    olp = Olp(alarms=alarms, max_loop_lag=0.05, cooloff=10.0)
+    m = Metrics()
+    probe = LoopLagProbe(olp, metrics=m, interval=0.01, alpha=1.0)
+    assert not olp.overloaded()
+    probe.observe(0.2)  # 200 ms drift >> 50 ms budget, queue depth 0
+    assert olp.overloaded()
+    assert alarms.is_active("overload")
+    assert m.get("broker.olp.loop_lag_us") == 200_000
+
+
+def test_lag_probe_ewma_smooths_one_off_spikes():
+    from emqx_tpu.broker.olp import LoopLagProbe, Olp
+
+    olp = Olp(max_loop_lag=0.5, cooloff=10.0)
+    probe = LoopLagProbe(olp, interval=0.01, alpha=0.3)
+    probe.observe(0.0)
+    probe.observe(1.0)  # single spike: EWMA stays under the 0.5 budget
+    assert probe.lag == pytest.approx(0.3)
+    assert not olp.overloaded()
+    for _ in range(10):  # sustained saturation does trip it
+        probe.observe(1.0)
+    assert olp.overloaded()
+
+
+def test_lag_probe_run_measures_sleep_drift():
+    from emqx_tpu.broker.olp import LoopLagProbe, Olp
+
+    ticks = iter([0.0, 0.05, 0.05, 0.10])  # two samples of 40ms drift
+
+    async def fake_sleep(_):
+        try:
+            return None
+        finally:
+            fake_sleep.calls += 1
+            if fake_sleep.calls >= 2:
+                raise asyncio.CancelledError
+
+    fake_sleep.calls = 0
+    probe = LoopLagProbe(
+        Olp(max_loop_lag=10.0), interval=0.01,
+        clock=lambda: next(ticks), sleep=fake_sleep, alpha=1.0,
+    )
+
+    async def go():
+        with pytest.raises(asyncio.CancelledError):
+            await probe.run()
+
+    run(go())
+    assert probe.samples == 1  # second sleep cancelled before sampling
+    assert probe.last_raw == pytest.approx(0.04)
+
+
+# ---------------------------------------------------------------------------
+# satellite: QUIC endpoint timer + kafka poll as supervised children
+# ---------------------------------------------------------------------------
+
+def test_quic_timer_registers_as_transient_child_and_reaps():
+    pytest.importorskip(
+        "cryptography", reason="quic stack needs cryptography")
+    from emqx_tpu.supervise import Supervisor
+    from emqx_tpu.transport.quic import QuicEndpoint
+
+    async def go():
+        sup = Supervisor()
+        ep = QuicEndpoint(None, b"", b"", None, supervisor=sup)
+        ep._ensure_timer()
+        child = sup.lookup("quic.timer")
+        assert child is not None and child.restart == "transient"
+        # by_cid is empty: the loop returns normally, supervision ends
+        for _ in range(50):
+            if child.done():
+                break
+            await asyncio.sleep(0.01)
+        assert child.done() and child.state == "done"
+        # next activity cycle: a fresh child replaces (not accretes)
+        ep._timer_task = None
+        ep._ensure_timer()
+        assert sum(1 for c in sup.children if c.name == "quic.timer") == 1
+        await sup.stop()
+
+    run(go())
+
+
+def test_kafka_poll_registers_as_transient_child():
+    from emqx_tpu.bridge.kafka import KafkaConnector, KafkaError
+    from emqx_tpu.supervise import Supervisor
+
+    async def go():
+        sup = Supervisor()
+        conn = KafkaConnector(
+            {"server": "127.0.0.1:1", "ingress": {"topic": "t"}},
+            name="k", local_publish=lambda *a, **kw: None)
+        conn.supervisor = sup
+
+        async def no_meta(topic):
+            raise KafkaError("no metadata")
+
+        conn.client.partitions = no_meta  # ingress-only start path
+        await conn.start()
+        child = sup.lookup("bridge.kafka.k.poll")
+        assert child is not None and child.restart == "transient"
+        assert conn._poll_task is child
+        await conn.stop()
+        assert child.done()
+
+    run(go())
