@@ -414,15 +414,17 @@ def bench_fanout_e2e(n_pub: int = 16, n_sub: int = 32, duration: float = 6.0,
     }
 
 
-def bench_qos1_e2e(n_pub: int = 8, n_sub: int = 16, duration: float = 6.0,
-                   inflight: int = 32) -> dict:
-    """Acknowledged-delivery A/B (the PR-2 tracking number): the same
-    fan-out shape as ``fanout_e2e`` but the subscribers take **QoS1
-    grants with a live acknowledged window** — every delivered PUBLISH
-    carries a packet id, rides the subscriber session's inflight/mqueue
-    machinery, and is PUBACKed by the lean subscriber — so the A/B
-    measures the batched inflight admission + ack/write coalescing
-    stack end to end, per-message path vs pipeline.
+def _bench_acked_e2e(qos: int, n_pub: int, n_sub: int, duration: float,
+                     inflight: int) -> dict:
+    """Acknowledged-delivery A/B at QoS1 or QoS2 (shared harness for
+    ``qos1_e2e`` / ``qos2_e2e``): the fan-out shape of ``fanout_e2e``
+    but the subscribers take **grants with a live acknowledged
+    window** — every delivered PUBLISH carries a packet id, rides the
+    subscriber session's inflight/mqueue machinery, and is acked by
+    the lean subscriber (PUBACK at QoS1; the full PUBREC/PUBREL/
+    PUBCOMP exchange at QoS2) — so the A/B measures the batched
+    inflight admission + ack-run ingest + QoS2 batch + write
+    coalescing stack end to end, per-message path vs pipeline.
 
     delivery_ratio is received / (sent × n_sub); 1.0 means every
     fan-out leg was (eventually) delivered — the run waits for the
@@ -458,8 +460,8 @@ def bench_qos1_e2e(n_pub: int = 8, n_sub: int = 16, duration: float = 6.0,
             out = await run_scenario(
                 "pub", port=node.listeners.all()[0].port,
                 count=n_pub, rate=0.0, subscribers=n_sub,
-                topic="bench/%i", sub_topic="bench/#", sub_qos=1,
-                qos=1, payload_size=64, duration=duration,
+                topic="bench/%i", sub_topic="bench/#", sub_qos=qos,
+                qos=qos, payload_size=64, duration=duration,
                 inflight=inflight, lean_subs=True, lean_pubs=True)
         finally:
             await node.stop()
@@ -483,7 +485,7 @@ def bench_qos1_e2e(n_pub: int = 8, n_sub: int = 16, duration: float = 6.0,
     pipeline = shape(aio.run(run_one(True)))
     return {
         "workload": {"publishers": n_pub, "subscribers": n_sub,
-                     "fanout": n_sub, "qos": 1, "sub_qos": 1,
+                     "fanout": n_sub, "qos": qos, "sub_qos": qos,
                      "inflight": inflight, "duration_s": duration},
         "per_message": per_msg,
         "pipeline": pipeline,
@@ -492,12 +494,33 @@ def bench_qos1_e2e(n_pub: int = 8, n_sub: int = 16, duration: float = 6.0,
     }
 
 
+def bench_qos1_e2e(n_pub: int = 8, n_sub: int = 16, duration: float = 6.0,
+                   inflight: int = 32) -> dict:
+    """Acknowledged QoS1 A/B (the PR-2 tracking number); see
+    :func:`_bench_acked_e2e`."""
+    return _bench_acked_e2e(1, n_pub, n_sub, duration, inflight)
+
+
+def bench_qos2_e2e(n_pub: int = 8, n_sub: int = 16, duration: float = 6.0,
+                   inflight: int = 32) -> dict:
+    """Exactly-once QoS2 A/B (the PR-5 tracking number): four control
+    packets per delivered message — the shape where the ack-run ingest
+    fast path and the batched QoS2 state machine carry the win; see
+    :func:`_bench_acked_e2e`."""
+    return _bench_acked_e2e(2, n_pub, n_sub, duration, inflight)
+
+
 def _fanout_e2e_size(smoke: bool) -> dict:
     return ({"n_pub": 8, "n_sub": 8, "duration": 2.0} if smoke
             else {"n_pub": 16, "n_sub": 32, "duration": 6.0})
 
 
 def _qos1_e2e_size(smoke: bool) -> dict:
+    return ({"n_pub": 4, "n_sub": 4, "duration": 1.5} if smoke
+            else {"n_pub": 8, "n_sub": 16, "duration": 6.0})
+
+
+def _qos2_e2e_size(smoke: bool) -> dict:
     return ({"n_pub": 4, "n_sub": 4, "duration": 1.5} if smoke
             else {"n_pub": 8, "n_sub": 16, "duration": 6.0})
 
@@ -784,6 +807,7 @@ def main():
         c1 = bench_config1(**_config1_size(args.smoke))
         fe = bench_fanout_e2e(**_fanout_e2e_size(args.smoke))
         q1 = bench_qos1_e2e(**_qos1_e2e_size(args.smoke))
+        q2 = bench_qos2_e2e(**_qos2_e2e_size(args.smoke))
         # the most recent full on-chip run is checked into the repo so a
         # tunnel outage at bench time (recurring: 2026-07-29, -30) does
         # not erase the measured result — clearly labeled as such
@@ -837,6 +861,7 @@ def main():
             "config1_broker_e2e": c1,
             "fanout_e2e": fe,
             "qos1_e2e": q1,
+            "qos2_e2e": q2,
         }))
         return
 
@@ -865,6 +890,10 @@ def main():
     note(f"qos1 e2e done: per-message {q1['per_message']['msgs_per_s']}/s"
          f" vs pipeline {q1['pipeline']['msgs_per_s']}/s"
          f" ({q1['speedup']}x)")
+    q2 = bench_qos2_e2e(**_qos2_e2e_size(args.smoke))
+    note(f"qos2 e2e done: per-message {q2['per_message']['msgs_per_s']}/s"
+         f" vs pipeline {q2['pipeline']['msgs_per_s']}/s"
+         f" ({q2['speedup']}x)")
 
     dev, tpu = bench_device(table, topics, args.batch, args.iters,
                             args.depth, args.active_slots)
@@ -1008,6 +1037,7 @@ def main():
         "config1_broker_e2e": c1,
         "fanout_e2e": fe,
         "qos1_e2e": q1,
+        "qos2_e2e": q2,
         "delta": deltas,
     }
     print(json.dumps(result))

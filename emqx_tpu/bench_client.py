@@ -100,6 +100,10 @@ class LeanSub:
     window: every QoS1 PUBLISH is PUBACKed (all acks for one TCP read
     coalesce into ONE write — the windowed-consumer shape), and
     DUP-flagged redeliveries are counted in ``stats.duplicates``.
+
+    With ``qos=2`` it runs the full exactly-once receiver state machine
+    inline: QoS2 grants answer PUBREC, inbound PUBRELs answer PUBCOMP —
+    again one coalesced ack write per TCP read.
     """
 
     def __init__(self, clientid: str, host: str, port: int,
@@ -189,12 +193,17 @@ class LeanSub:
                             dups += 1
                         off = j + 2 + ((mv[j] << 8) | mv[j + 1])
                         if b1 & 0x06:       # qos>0: packet id follows topic
-                            ack += b"\x40\x02"      # PUBACK header
+                            # QoS1 grant → PUBACK; QoS2 grant → PUBREC
+                            # (phase 1 of the exactly-once receiver)
+                            ack += (b"\x40\x02" if (b1 & 0x06) == 0x02
+                                    else b"\x50\x02")
                             ack += mv[off:off + 2]  # echo the packet id
                             off += 2
                         if recv % sample == 0 and j + rl - off >= 8:
                             (t_send,) = unpack_from("<d", mv, off)
                             lat.append((now - t_send) * 1e6)
+                    elif b1 == 0x62:        # PUBREL → answer PUBCOMP
+                        ack += b"\x70\x02" + mv[j:j + 2]
                     i = j + rl
                 if ack:
                     writer.write(bytes(ack))
@@ -216,19 +225,23 @@ class LeanSub:
 
 
 class LeanPub(LeanSub):
-    """Minimal pipelined-QoS1 publisher: one pre-built PUBLISH frame
+    """Minimal pipelined-QoS1/2 publisher: one pre-built PUBLISH frame
     template per client, patched in place (packet id + payload
     timestamp) per message, with PUBACKs counted by the same inline
     scanner — the publish side of the broker-capacity A/B costs two
     ``pack_into`` and one write per message instead of a dataclass,
-    a serializer pass and a pending-future per message."""
+    a serializer pass and a pending-future per message.
+
+    With ``qos=2`` it drives the exactly-once sender flow: PUBRECs are
+    answered with one coalesced PUBREL burst per TCP read, and the
+    window advances on PUBCOMP."""
 
     async def run(self, topic: str, payload_size: int, inflight: int,
-                  end: float, stats: "BenchStats") -> None:
+                  end: float, stats: "BenchStats", qos: int = 1) -> None:
         tb = topic.encode()
         rl = 2 + len(tb) + 2 + max(payload_size, 8)
-        head = bytes([0x32]) + F._enc_varint(rl) + struct.pack(
-            ">H", len(tb)) + tb
+        head = bytes([0x32 if qos == 1 else 0x34]) + F._enc_varint(
+            rl) + struct.pack(">H", len(tb)) + tb
         pid_off = len(head)
         ts_off = pid_off + 2
         frame = bytearray(head + b"\x00" * (2 + 8)
@@ -273,6 +286,7 @@ class LeanPub(LeanSub):
 
     async def _ack_loop(self) -> None:
         reader = self._reader
+        writer = self._writer
         buf = b""
         try:
             while True:
@@ -281,6 +295,7 @@ class LeanPub(LeanSub):
                     return
                 mv = buf + data if buf else data
                 i, n = 0, len(mv)
+                rel = bytearray()
                 while n - i >= 2:
                     rl = mv[i + 1]
                     j = i + 2
@@ -301,9 +316,14 @@ class LeanPub(LeanSub):
                             break
                     if j + rl > n:
                         break
-                    if (mv[i] & 0xF0) == 0x40:   # PUBACK
+                    b1 = mv[i] & 0xF0
+                    if b1 == 0x40 or b1 == 0x70:  # PUBACK / PUBCOMP
                         self._acked += 1
+                    elif b1 == 0x50:              # PUBREC → PUBREL burst
+                        rel += b"\x62\x02" + mv[j:j + 2]
                     i = j + rl
+                if rel:
+                    writer.write(bytes(rel))
                 self._ack_evt.set()
                 buf = mv[i:] if i < n else b""
         except (asyncio.CancelledError, ConnectionError):
@@ -409,7 +429,7 @@ async def run_scenario(
         if subscribers:
             stopic = sub_topic if sub_topic is not None else topic
             sqos = sub_qos if sub_qos is not None else qos
-            if lean_subs and sqos in (0, 1):
+            if lean_subs and sqos in (0, 1, 2):
                 for i in range(subscribers):
                     s = LeanSub(f"bench_psub_{i}", host, port, qos=sqos)
                     try:
@@ -451,7 +471,7 @@ async def run_scenario(
 
                 drainers = [asyncio.ensure_future(drain(c)) for c in subs]
 
-        if lean_pubs and qos == 1 and inflight > 0 and not messages:
+        if lean_pubs and qos in (1, 2) and inflight > 0 and not messages:
             lpubs: List[LeanPub] = []
             for i in range(count):
                 lp = LeanPub(f"bench_pub_{i}", host, port)
@@ -464,7 +484,7 @@ async def run_scenario(
             end = time.perf_counter() + duration
             await asyncio.gather(
                 *(lp.run(_topic_of(topic, i), payload_size, inflight,
-                         end, stats)
+                         end, stats, qos=qos)
                   for i, lp in enumerate(lpubs))
             )
             if subscribers:

@@ -21,6 +21,7 @@ import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from .. import topic as T
+from ..mqtt import frame as F
 from ..mqtt import packet as P
 from .broker import Broker
 from .cm import ConnectionManager
@@ -67,6 +68,9 @@ class Channel:
         self.max_inflight = max_inflight
         self._aliases: Dict[int, str] = {}   # inbound alias → topic
         self.last_rx = time.time()
+        # peeked-but-uncommitted retry batch (see retry_deliveries /
+        # retry_commit): (entries, now) until the flush is confirmed
+        self._retry_pending = None
 
     # ------------------------------------------------------------------
 
@@ -446,6 +450,51 @@ class Channel:
                     hooks.run("message.acked", (self.clientid, msg))
         return more
 
+    # one reply head per inbound ack type that answers with an ack
+    _ACK_REPLY_HEAD = {
+        P.PUBREC: ((P.PUBREL << 4) | 2, P.PUBREL),
+        P.PUBREL: (P.PUBCOMP << 4, P.PUBCOMP),
+    }
+
+    def handle_ack_run(self, run: P.AckRun) -> Tuple[bytes, List[Publish]]:
+        """Consume a packed same-type ack run wholesale (the parser's
+        ack-run fast path): one batched session transition covers the
+        whole burst.  Returns ``(reply_bytes, refill)`` — the exact ack
+        frames the per-packet path would have sent back, pre-serialized
+        in order, plus the window-refill publishes for the caller's
+        bulk send path."""
+        self.last_rx = time.time()
+        sess = self.session
+        t = run.type
+        if t == P.PUBACK:
+            acked, more = sess.puback_batch(run.pids)
+            if acked:
+                hooks = self.broker.hooks
+                if hooks.has("message.acked"):
+                    for msg in acked:
+                        hooks.run("message.acked", (self.clientid, msg))
+            return b"", more
+        if t == P.PUBCOMP:
+            _known, more = sess.pubcomp_batch(run.pids)
+            return b"", more
+        if t == P.PUBREC:
+            oks = sess.pubrec_batch(run.pids)
+        else:  # PUBREL (inbound QoS2 release)
+            oks = sess.pubrel_received_batch(run.pids)
+        head, rtype = self._ACK_REPLY_HEAD[t]
+        out = bytearray()
+        v5 = self.proto_ver == 5
+        for pid, ok in zip(run.pids, oks):
+            if ok or not v5:
+                # 4-byte pid-only ack: rc 0 (or a v3/4 peer, where the
+                # reason code never hits the wire) — built inline, no
+                # serializer pass
+                out += bytes((head, 2, pid >> 8, pid & 0xFF))
+            else:
+                out += F.serialize(
+                    P.PubAck(rtype, pid, P.RC.PACKET_ID_NOT_FOUND), ver=5)
+        return bytes(out), []
+
     def _handle_pubrec(self, pkt: P.PubAck) -> List[Action]:
         if self.session.pubrec(pkt.packet_id):
             return [("send", P.PubAck(P.PUBREL, pkt.packet_id))]
@@ -589,12 +638,69 @@ class Channel:
         return []
 
     def retry_deliveries(self, now: Optional[float] = None) -> List[Action]:
+        """Resend actions for due inflight entries.  Peek-only: the DUP
+        clone / age-clock commit is deferred until the connection layer
+        confirms the flush with :meth:`retry_commit` — a dead transport
+        must not burn clones (and silently swallow a retry interval)
+        for resends that never left the process."""
         if self.session is None:
             return []
+        entries = self.session.retry_peek(now)
+        self._retry_pending = (entries, now)
         out: List[Action] = []
-        for pid, kind, msg in self.session.retry(now):
+        for pid, kind, msg in entries:
             if kind == "publish":
-                out.append(("send", self._to_publish_pkt(Publish(pid, msg))))
+                pkt = self._to_publish_pkt(Publish(pid, msg))
+                pkt.dup = True
+                out.append(("send", pkt))
             else:
                 out.append(("send", P.PubAck(P.PUBREL, pid)))
         return out
+
+    def retry_wire_batch(self, now: Optional[float] = None) -> List[bytes]:
+        """Batched-resend path (``broker.fanout.enable`` datapaths):
+        the same due entries as :meth:`retry_deliveries`, rendered as
+        wire bytes through the PR-2 QoS1/2 template cache — patch the
+        2 pid bytes and set the DUP bit instead of a full serializer
+        pass per resend — for ONE coalesced flush per tick.  Commit
+        rides :meth:`retry_commit` exactly like the action path."""
+        sess = self.session
+        if sess is None:
+            return []
+        entries = sess.retry_peek(now)
+        self._retry_pending = (entries, now)
+        if not entries:
+            return []
+        out: List[bytes] = []
+        ver = self.proto_ver
+        pubrel_head = (P.PUBREL << 4) | 2
+        for pid, kind, msg in entries:
+            if kind != "publish":
+                # PUBREL resend: 4-byte pid-only shape in any version
+                out.append(bytes((pubrel_head, 2, pid >> 8, pid & 0xFF)))
+                continue
+            data = None
+            cache = msg.__dict__.get("_wire1")
+            ent = cache.get(ver) if cache is not None else None
+            if ent is not None:
+                tpl, off = ent
+                buf = bytearray(tpl)
+                buf[0] |= 0x08           # DUP bit (fixed header, §3.3.1.1)
+                buf[off] = pid >> 8
+                buf[off + 1] = pid & 0xFF
+                data = bytes(buf)
+            else:
+                pkt = self._to_publish_pkt(Publish(pid, msg))
+                pkt.dup = True
+                data = F.serialize(pkt, ver=ver)
+            out.append(data)
+        return out
+
+    def retry_commit(self) -> None:
+        """Commit the last peeked retry batch (clone/touch) — called by
+        the connection layer once the resend flush went through."""
+        pending = getattr(self, "_retry_pending", None)
+        self._retry_pending = None
+        if pending and self.session is not None:
+            entries, now = pending
+            self.session.retry_commit(entries, now)

@@ -88,6 +88,15 @@ class Inflight:
         ts, _ = self._d[pid]
         self._d[pid] = (ts, value)
 
+    def update_many(self, pids: Iterable[int], value: Any) -> None:
+        """Bulk phase transition: every ``pid`` takes the SAME new value
+        with its timestamp preserved — the QoS2 state machine moves a
+        whole PUBREC run from ``publish`` to ``pubrel`` in one pass."""
+        d = self._d
+        for pid in pids:
+            ts, _ = d[pid]
+            d[pid] = (ts, value)
+
     def touch(self, pid: int, now: Optional[float] = None) -> None:
         """Reset the age clock (after a retransmission)."""
         if pid not in self._d:

@@ -283,6 +283,31 @@ class Session:
         self.inflight.update(pid, ("pubrel", None))
         return True
 
+    def pubrec_batch(self, pids: List[int]) -> List[bool]:
+        """A run of QoS2 phase-1 acks in one call: the known pids make
+        ONE bulk ``publish`` → ``pubrel`` inflight transition.  Returns
+        per-pid verdicts in order, exactly what sequential
+        :meth:`pubrec` calls would have said (a duplicate pid in the
+        run is False the second time — the slot already transitioned)."""
+        inflight = self.inflight
+        lookup = inflight.lookup
+        out: List[bool] = []
+        known: List[int] = []
+        seen: set = set()
+        for pid in pids:
+            item = lookup(pid)
+            if item is None or item[0] != "publish" or pid in seen:
+                out.append(False)
+            else:
+                known.append(pid)
+                seen.add(pid)
+                out.append(True)
+        if known:
+            inflight.update_many(known, ("pubrel", None))
+            if self.metrics is not None and len(pids) > 1:
+                self.metrics.inc("broker.qos2.batch")
+        return out
+
     def pubcomp(self, pid: int) -> Tuple[bool, List[Publish]]:
         """QoS2 completion.  Returns (known?, next publishes)."""
         item = self.inflight.lookup(pid)
@@ -291,20 +316,79 @@ class Session:
         self.inflight.delete(pid)
         return True, self._dequeue()
 
+    def pubcomp_batch(self, pids: List[int]) -> Tuple[int, List[Publish]]:
+        """A run of QoS2 completions: every known pid releases its
+        window slot first, then ONE :meth:`_dequeue` refills the freed
+        room (one id-run allocation + one bulk insert), mirroring
+        :meth:`puback_batch`.  Returns (known count, next publishes)."""
+        inflight = self.inflight
+        known = 0
+        for pid in pids:
+            item = inflight.lookup(pid)
+            if item is None or item[0] != "pubrel":
+                continue
+            inflight.delete(pid)
+            known += 1
+        if known and self.metrics is not None and len(pids) > 1:
+            self.metrics.inc("broker.qos2.batch")
+        return known, (self._dequeue() if known else [])
+
     def retry(self, now: Optional[float] = None) -> List[Tuple[int, str, Optional[Message]]]:
         """Unacked items older than retry_interval, for re-send with DUP.
 
         Returns [(pid, kind, msg|None)]: kind 'publish' → resend
-        PUBLISH(dup), kind 'pubrel' → resend PUBREL."""
-        out = []
-        for pid in self.inflight.older_than(self.retry_interval, now):
-            kind, msg = self.inflight.lookup(pid)
+        PUBLISH(dup), kind 'pubrel' → resend PUBREL.  Peek + commit in
+        one step — callers that can observe the resend write failing
+        use :meth:`retry_peek` / :meth:`retry_commit` instead, so a
+        dead transport doesn't burn a DUP clone (and reset the age
+        clock) for a resend that never reached the wire."""
+        entries = self.retry_peek(now)
+        self.retry_commit(entries, now)
+        out: List[Tuple[int, str, Optional[Message]]] = []
+        for pid, kind, msg in entries:
             if kind == "publish":
-                msg = msg.clone(dup=True)
-                self.inflight.update(pid, (kind, msg))
-            self.inflight.touch(pid, now)  # one resend per retry_interval
+                item = self.inflight.lookup(pid)
+                if item is not None:
+                    msg = item[1]    # the committed DUP clone
             out.append((pid, kind, msg))
         return out
+
+    def retry_peek(
+        self, now: Optional[float] = None
+    ) -> List[Tuple[int, str, Optional[Message]]]:
+        """Due entries WITHOUT mutating session state: no clone stored,
+        no age-clock touch.  ``msg`` is the stored message as-is (DUP
+        flag only set if a previous retry committed a clone); the
+        caller renders the resend with DUP regardless and calls
+        :meth:`retry_commit` once the write went through."""
+        out = []
+        lookup = self.inflight.lookup
+        for pid in self.inflight.older_than(self.retry_interval, now):
+            kind, msg = lookup(pid)
+            out.append((pid, kind, msg))
+        return out
+
+    def retry_commit(
+        self,
+        entries: List[Tuple[int, str, Optional[Message]]],
+        now: Optional[float] = None,
+    ) -> None:
+        """Commit a peeked retry batch after the resend flush succeeded:
+        store the DUP clone and reset the age clock (one resend per
+        retry_interval).  Entries acked between peek and commit are
+        skipped."""
+        inflight = self.inflight
+        for pid, kind, msg in entries:
+            cur = inflight.lookup(pid)
+            if cur is None:
+                continue
+            # only store the clone while the slot is still in the
+            # peeked phase — an ack that transitioned it (publish →
+            # pubrel) between peek and commit must not be clobbered
+            if kind == "publish" and cur[0] == "publish" \
+                    and msg is not None and not msg.dup:
+                inflight.update(pid, (kind, msg.clone(dup=True)))
+            inflight.touch(pid, now)
 
     # ------------------------------------------------------------------
     # inbound QoS2
@@ -327,6 +411,15 @@ class Session:
         """Inbound PUBREL; caller replies PUBCOMP.  False if unknown
         (reply reason 0x92 packet-id-not-found)."""
         return self.awaiting_rel.pop(pid, None) is not None
+
+    def pubrel_received_batch(self, pids: List[int]) -> List[bool]:
+        """A run of inbound PUBRELs released in one call (the receiver
+        side of a QoS2 publish burst); per-pid verdicts in order."""
+        pop = self.awaiting_rel.pop
+        out = [pop(pid, None) is not None for pid in pids]
+        if self.metrics is not None and len(pids) > 1:
+            self.metrics.inc("broker.qos2.batch")
+        return out
 
     def expire_awaiting_rel(self, now: Optional[float] = None) -> List[int]:
         now = now if now is not None else time.time()

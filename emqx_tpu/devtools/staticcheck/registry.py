@@ -1,6 +1,6 @@
 """Registration-site extraction for the registry-drift rule.
 
-Reads the three registries *statically* (AST, never import) so the
+Reads the four registries *statically* (AST, never import) so the
 checker works on a broken tree and never executes runtime code:
 
 * metric names — every string element of the ``*_METRIC_NAMES`` lists in
@@ -8,7 +8,10 @@ checker works on a broken tree and never executes runtime code:
 * config keys — the literal keys of the ``SCHEMA`` dict in
   ``emqx_tpu/config.py``;
 * fault-injection points — the ``POINTS`` tuple in
-  ``emqx_tpu/faultinject.py`` (the scenario-table vocabulary).
+  ``emqx_tpu/faultinject.py`` (the scenario-table vocabulary);
+* hook points — the ``HOOK_POINTS`` list in
+  ``emqx_tpu/broker/hooks.py`` (a typo'd ``hooks.add``/``run`` name
+  silently never fires — the chain dispatch is by exact string).
 """
 
 from __future__ import annotations
@@ -34,13 +37,15 @@ def _str_elements(node: ast.AST) -> Set[str]:
 
 
 class Registries:
-    """The project's three name registries, extracted once per run."""
+    """The project's four name registries, extracted once per run."""
 
     def __init__(self, metric_names: Set[str], config_keys: Set[str],
-                 fault_points: Set[str]) -> None:
+                 fault_points: Set[str],
+                 hook_points: Optional[Set[str]] = None) -> None:
         self.metric_names = metric_names
         self.config_keys = config_keys
         self.fault_points = fault_points
+        self.hook_points = hook_points if hook_points is not None else set()
 
     @classmethod
     def load(cls, package_root: Optional[str] = None) -> "Registries":
@@ -57,6 +62,8 @@ class Registries:
                 os.path.join(package_root, "config.py")),
             fault_points=cls._fault_points(
                 os.path.join(package_root, "faultinject.py")),
+            hook_points=cls._hook_points(
+                os.path.join(package_root, "broker", "hooks.py")),
         )
 
     @staticmethod
@@ -91,6 +98,19 @@ class Registries:
                     if keys:
                         return keys
         raise RuntimeError(f"no SCHEMA dict found in {path}")
+
+    @staticmethod
+    def _hook_points(path: str) -> Set[str]:
+        for node in _parse(path).body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if any(isinstance(t, ast.Name) and t.id == "HOOK_POINTS"
+                       for t in targets) and node.value is not None:
+                    points = _str_elements(node.value)
+                    if points:
+                        return points
+        raise RuntimeError(f"no HOOK_POINTS list found in {path}")
 
     @staticmethod
     def _fault_points(path: str) -> Set[str]:

@@ -10,6 +10,10 @@ cold path nothing exercises (metrics/config) or silently never fires
   ``observe/metrics.py``;
 * ``cfg.get/put("dotted.key")`` → the ``SCHEMA`` dict in ``config.py``;
 * ``_injector.act/check("point")`` → ``faultinject.POINTS``;
+* ``hooks.add/run/run_fold/has/delete("point", ...)`` → the
+  ``HOOK_POINTS`` list in ``broker/hooks.py`` — the chain dispatch is
+  by exact string, so a typo'd point name registers a callback (or
+  runs a chain) that nothing ever fires;
 * ``hooks.run("message.dropped", (msg, "reason"))`` → the derived
   counter ``messages.dropped.<reason>`` must be registered (after the
   ``wiring.py`` remap) — ``Metrics.inc_msg_dropped`` guards the detail
@@ -42,6 +46,7 @@ _METRIC_METHODS = {"inc", "dec", "set"}
 _CONFIG_METHODS = {"get", "put"}
 _FAULT_METHODS = {"act", "check"}
 _ALARM_METHODS = {"activate", "deactivate"}
+_HOOK_METHODS = {"add", "run", "run_fold", "has", "delete"}
 
 #: drop reasons observe/wiring.py rewrites before deriving the counter
 #: name (mirrors ``on_dropped``: shared_no_available counts against
@@ -64,7 +69,7 @@ class RegistryDrift(Rule):
     #: key construction is the registry, not a use of it)
     _REGISTRY_FILES = (
         "emqx_tpu/observe/metrics.py", "emqx_tpu/config.py",
-        "emqx_tpu/faultinject.py",
+        "emqx_tpu/faultinject.py", "emqx_tpu/broker/hooks.py",
     )
 
     def __init__(self, registries: Optional[Registries] = None) -> None:
@@ -101,8 +106,10 @@ class RegistryDrift(Rule):
             self._check_fault(node, ctx)
         elif method in _ALARM_METHODS and "alarm" in recv:
             self._note_alarm(node, ctx, method)
-        elif method == "run" and recv == "hooks":
-            self._check_drop_reason(node, ctx)
+        elif method in _HOOK_METHODS and recv == "hooks":
+            self._check_hook_point(node, ctx)
+            if method == "run":
+                self._check_drop_reason(node, ctx)
 
     # ------------------------------------------------------------------
 
@@ -140,6 +147,19 @@ class RegistryDrift(Rule):
                 f"fault-injection point {point!r} is not declared in "
                 "faultinject.POINTS — no scenario can ever target it "
                 "(FaultInjector rejects unknown points)",
+            )
+
+    def _check_hook_point(self, node: ast.Call, ctx: FileContext) -> None:
+        name = str_arg(node)
+        if name is None or not _NAME_RE.match(name):
+            return
+        if name not in self.registries.hook_points:
+            ctx.report(
+                self.name, node,
+                f"hook point {name!r} is not in HOOK_POINTS "
+                "(emqx_tpu/broker/hooks.py) — the chain dispatches by "
+                "exact string, so this callback/run can never pair "
+                "with the rest of the tree",
             )
 
     def _check_drop_reason(self, node: ast.Call, ctx: FileContext) -> None:

@@ -13,6 +13,7 @@ import asyncio
 import logging
 from typing import Any, Dict, List, Optional
 
+from .. import faultinject as _fi
 from ..broker.message import Message, make_message
 from ..broker.session import Publish, SubOpts
 
@@ -35,6 +36,16 @@ class GatewayConn:
         self.gateway = gateway
         self.clientid: Optional[str] = None
         self.closed = False
+        # the one batched-stack opt-in covers the gateway datapaths
+        # too: ack-run grouping and batched auto-ack/refill cycles
+        # engage only with it on, so the default path stays the
+        # per-message PR-4 behavior exactly
+        cfg = getattr(node, "config", None)
+        try:
+            self.batched = bool(cfg is not None
+                                and cfg.get("broker.fanout.enable"))
+        except Exception:
+            self.batched = False
 
     # -- session lifecycle -------------------------------------------------
 
@@ -154,6 +165,22 @@ class Gateway:
     async def stop(self) -> None:  # pragma: no cover - interface
         raise NotImplementedError
 
+    def sendto(self, data: bytes, addr: Any) -> None:
+        """Datagram send with the ``transport.write`` chaos seam: an
+        injected drop/dup models a lossy sensor-network path, which the
+        session retry sweep (QoS1) or the protocol's own retransmits
+        (CoAP CON dedup) must heal — same semantics as the MQTT
+        datapath's coalesced-flush seam."""
+        if _fi._injector is not None:
+            act = _fi._injector.act("transport.write")
+            if act == "drop":
+                return
+            if act == "dup":
+                self.transport.sendto(data, addr)
+            if act == "raise":
+                raise _fi.InjectedFault("transport.write")
+        self.transport.sendto(data, addr)
+
     def spawn_loop(self, name: str, factory: Any) -> Any:
         """Start a gateway-lifetime loop (sweeper, heartbeat) as a
         supervised child when the node carries a supervision tree — a
@@ -201,14 +228,20 @@ class GatewayManager:
                     sess = self.node.broker.sessions.get(cid)
                     if sess is None:
                         continue
+                    # peek → resend → commit: the whole due batch rides
+                    # ONE send_deliveries call, and the age clock only
+                    # resets when the resend didn't blow up — a raising
+                    # transport leaves the entries due for next sweep
                     try:
+                        entries = sess.retry_peek(now)
                         pubs = [
                             Publish(pid, msg)
-                            for pid, kind, msg in sess.retry(now)
+                            for pid, kind, msg in entries
                             if kind == "publish" and msg is not None
                         ]
                         if pubs:
-                            conn.deliver(pubs)
+                            conn.send_deliveries(pubs)
+                        sess.retry_commit(entries, now)
                     except Exception:
                         log.exception("gateway retry for %s failed", cid)
 

@@ -177,7 +177,7 @@ class CoapClient(GatewayConn):
         if req.type == CON:
             cached = self._mid_cache.get(req.mid)
             if cached is not None:
-                self.gw.transport.sendto(cached, self.addr)
+                self.gw.sendto(cached, self.addr)
                 return
         path = [v.decode("utf-8", "replace") for v in
                 req.opt_all(OPT_URI_PATH)]
@@ -256,32 +256,47 @@ class CoapClient(GatewayConn):
             self._mid_order.append(req.mid)
             while len(self._mid_order) > 16:
                 self._mid_cache.pop(self._mid_order.popleft(), None)
-        self.gw.transport.sendto(data, self.addr)
+        self.gw.sendto(data, self.addr)
 
     # -- deliveries --------------------------------------------------------
 
     def send_deliveries(self, pubs: List[Publish]) -> None:
         from .. import topic as T
 
-        for pub in pubs:
-            for flt, (token, seq) in list(self.observes.items()):
-                if not T.match(pub.msg.topic, flt):
-                    continue
-                self.observes[flt] = (token, (seq + 1) & 0xFFFFFF)
-                self.gw.transport.sendto(
-                    encode(CoapMessage(
-                        NON, CONTENT, self.next_mid(), token,
-                        [(OPT_OBSERVE, seq.to_bytes(3, "big").lstrip(b"\x00")
-                          or b"\x00")],
-                        pub.msg.payload,
-                    )),
-                    self.addr,
-                )
-            # QoS0 gateway: ack any QoS1 delivery immediately
-            if pub.pid is not None:
+        # QoS0 gateway: QoS1 deliveries ack immediately — per batch the
+        # pids collect and release as ONE window cycle, whose refill
+        # feeds the next round (drains the queued backlog instead of
+        # stranding it in inflight until the retry sweep)
+        pending = pubs
+        while pending:
+            ack_pids: List[int] = []
+            for pub in pending:
+                for flt, (token, seq) in list(self.observes.items()):
+                    if not T.match(pub.msg.topic, flt):
+                        continue
+                    self.observes[flt] = (token, (seq + 1) & 0xFFFFFF)
+                    self.gw.sendto(
+                        encode(CoapMessage(
+                            NON, CONTENT, self.next_mid(), token,
+                            [(OPT_OBSERVE,
+                              seq.to_bytes(3, "big").lstrip(b"\x00")
+                              or b"\x00")],
+                            pub.msg.payload,
+                        )),
+                        self.addr,
+                    )
+                if pub.pid is not None:
+                    if self.batched:
+                        ack_pids.append(pub.pid)
+                    else:
+                        sess = self.node.broker.sessions.get(self.clientid)
+                        if sess is not None:
+                            sess.puback(pub.pid)
+            pending = []
+            if ack_pids:
                 sess = self.node.broker.sessions.get(self.clientid)
                 if sess is not None:
-                    sess.puback(pub.pid)
+                    _, pending = sess.puback_batch(ack_pids)
 
     def close_transport(self, reason: str) -> None:
         self.gw.drop(self.addr)

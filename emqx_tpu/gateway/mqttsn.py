@@ -350,14 +350,18 @@ class SnClient(GatewayConn):
         mid = struct.unpack(">H", body[2:4])[0]
         sess = self.node.broker.sessions.get(self.clientid)
         if sess is not None:
-            _, more = sess.puback(mid)
+            # batched-session route: one datagram carries one ack, but
+            # the refill cycle (and its whole-window dequeue) is shared
+            # with the MQTT ack-run path
+            _, more = sess.puback_batch([mid])
             if more:
                 self.send_deliveries(more)
 
     # -- outbound ----------------------------------------------------------
 
     def send(self, msgtype: int, body: bytes) -> None:
-        self.gw.transport.sendto(_pack(msgtype, body), self.addr)
+        # gw.sendto carries the transport.write chaos seam
+        self.gw.sendto(_pack(msgtype, body), self.addr)
 
     def send_deliveries(self, pubs: List[Publish]) -> None:
         for pub in pubs:

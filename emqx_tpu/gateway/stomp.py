@@ -160,8 +160,7 @@ class StompConn(GatewayConn):
                     break
                 self._last_recv = time.monotonic()
                 self.buf.extend(data)
-                for frame in parse_frames(self.buf):
-                    self.handle_frame(frame)
+                self.handle_frames(list(parse_frames(self.buf)))
         except (ValueError, ConnectionError) as e:
             self.send_error(str(e))
         except asyncio.CancelledError:
@@ -172,6 +171,44 @@ class StompConn(GatewayConn):
             self.detach_session(discard=True, reason="connection closed")
             self.writer.close()
             self.gw.clients.pop(id(self), None)
+
+    def handle_frames(self, frames: List[StompFrame]) -> None:
+        """One TCP read's worth of frames: contiguous non-transactional
+        ACKs batch through :meth:`on_ack_batch` (one session window
+        cycle per run — the gateway analog of the MQTT ack-run ingest);
+        everything else takes the per-frame path unchanged."""
+        i, n = 0, len(frames)
+        while i < n:
+            f = frames[i]
+            if (self.batched and f.command == "ACK" and self.connected
+                    and "transaction" not in f.headers
+                    and i + 1 < n and frames[i + 1].command == "ACK"
+                    and "transaction" not in frames[i + 1].headers):
+                j = i + 2
+                while j < n and frames[j].command == "ACK" \
+                        and "transaction" not in frames[j].headers:
+                    j += 1
+                self.on_ack_batch(frames[i:j])
+                i = j
+                continue
+            self.handle_frame(f)
+            i += 1
+
+    def on_ack_batch(self, frames: List[StompFrame]) -> None:
+        pids: List[int] = []
+        for f in frames:
+            mid = f.headers.get("id") or f.headers.get("message-id")
+            pid = self.pending_acks.pop(mid, None)
+            if pid is not None:
+                pids.append(pid)
+        if pids:
+            sess = self.node.broker.sessions.get(self.clientid)
+            if sess is not None:
+                _, more = sess.puback_batch(pids)
+                if more:
+                    self.send_deliveries(more)
+        for f in frames:
+            self._receipt(f)
 
     def handle_frame(self, f: StompFrame) -> None:
         if f.command in ("CONNECT", "STOMP"):
@@ -328,44 +365,61 @@ class StompConn(GatewayConn):
     # -- outbound ----------------------------------------------------------
 
     def send_deliveries(self, pubs: List[Publish]) -> None:
-        for pub in pubs:
-            # find the subscription(s) this topic matched
-            from .. import topic as T
+        from .. import topic as T
 
-            matched = [
-                (sid, dest, ack) for sid, (dest, ack) in self.subs.items()
-                if T.match(pub.msg.topic, dest)
-            ]
-            if not matched:
-                continue
-            for sid, dest, ack in matched:
-                self._msg_seq += 1
-                mid = f"m{self._msg_seq}"
-                headers = {
-                    "subscription": sid,
-                    "message-id": mid,
-                    "destination": pub.msg.topic,
-                }
-                if ack != "auto":
-                    headers["ack"] = mid
-                ct = pub.msg.properties.get("Content-Type")
-                if ct:
-                    headers["content-type"] = ct
-                self._reply(StompFrame("MESSAGE", headers, pub.msg.payload))
-                if pub.pid is not None:
-                    if ack == "auto":
-                        sess = self.node.broker.sessions.get(self.clientid)
-                        if sess is not None:
-                            sess.puback(pub.pid)
-                    else:
-                        # a redelivery supersedes earlier message-ids for
-                        # the same pid (the gateway retry loop re-sends
-                        # unacked QoS1 deliveries)
-                        for old_mid, old_pid in list(
-                                self.pending_acks.items()):
-                            if old_pid == pub.pid:
-                                del self.pending_acks[old_mid]
-                        self.pending_acks[mid] = pub.pid
+        # auto-ack subscriptions release their QoS1 grants as ONE
+        # batched window cycle per delivery batch; the refill feeds the
+        # next round instead of stranding in inflight until retry
+        pending = pubs
+        while pending:
+            auto_pids: List[int] = []
+            for pub in pending:
+                # find the subscription(s) this topic matched
+                matched = [
+                    (sid, dest, ack)
+                    for sid, (dest, ack) in self.subs.items()
+                    if T.match(pub.msg.topic, dest)
+                ]
+                if not matched:
+                    continue
+                for sid, dest, ack in matched:
+                    self._msg_seq += 1
+                    mid = f"m{self._msg_seq}"
+                    headers = {
+                        "subscription": sid,
+                        "message-id": mid,
+                        "destination": pub.msg.topic,
+                    }
+                    if ack != "auto":
+                        headers["ack"] = mid
+                    ct = pub.msg.properties.get("Content-Type")
+                    if ct:
+                        headers["content-type"] = ct
+                    self._reply(StompFrame("MESSAGE", headers,
+                                           pub.msg.payload))
+                    if pub.pid is not None:
+                        if ack == "auto":
+                            if self.batched:
+                                auto_pids.append(pub.pid)
+                            else:
+                                sess = self.node.broker.sessions.get(
+                                    self.clientid)
+                                if sess is not None:
+                                    sess.puback(pub.pid)
+                        else:
+                            # a redelivery supersedes earlier message-ids
+                            # for the same pid (the gateway retry loop
+                            # re-sends unacked QoS1 deliveries)
+                            for old_mid, old_pid in list(
+                                    self.pending_acks.items()):
+                                if old_pid == pub.pid:
+                                    del self.pending_acks[old_mid]
+                            self.pending_acks[mid] = pub.pid
+            pending = []
+            if auto_pids:
+                sess = self.node.broker.sessions.get(self.clientid)
+                if sess is not None:
+                    _, pending = sess.puback_batch(auto_pids)
 
     def send_error(self, msg: str) -> None:
         try:

@@ -226,17 +226,42 @@ def _ser_props(props: Optional[Dict[str, Any]]) -> bytes:
 # parse
 # ---------------------------------------------------------------------------
 
+# first fixed-header byte of the four pid-only ack shapes the fast path
+# recognizes (PUBREL carries its mandatory 0b0010 flags; the other three
+# must have zero flags to match — anything else takes the slow path,
+# which validates exactly as before)
+_ACK_HEADS = frozenset((
+    P.PUBACK << 4, P.PUBREC << 4, (P.PUBREL << 4) | 2, P.PUBCOMP << 4,
+))
+
+
 class Parser:
     """Incremental stream parser: feed bytes, collect packets.
 
     ``proto_ver`` starts at 4 and is updated from an inbound CONNECT so
     subsequent packets parse with the negotiated version (mirrors
-    emqx_frame's parse-state options)."""
+    emqx_frame's parse-state options).
 
-    def __init__(self, max_packet_size: int = MAX_REMAINING_LEN, proto_ver: int = 4):
+    ``ack_runs`` (opt-in, the broker's batched-ingest datapath):
+    contiguous pid-only acks of one type (4-byte fixed shape —
+    remaining length 2, so reason code 0 and no properties in ANY
+    version) are recognized straight off the buffer, skipping
+    ``_try_parse``/``_Reader``/props machinery, and emitted packed as
+    one :class:`~emqx_tpu.mqtt.packet.AckRun`.  Acks carrying a v5
+    reason code or properties have remaining length > 2 and fall back
+    to the per-packet path, byte-identical."""
+
+    def __init__(self, max_packet_size: int = MAX_REMAINING_LEN,
+                 proto_ver: int = 4, ack_runs: bool = False):
         self.max_packet_size = max_packet_size
         self.proto_ver = proto_ver
+        self.ack_runs = ack_runs
         self._buf = bytearray()
+        # decoded fixed header of the (incomplete) head packet:
+        # (remaining_len, hdr_end), valid until bytes are consumed from
+        # the buffer head — avoids re-decoding the varint on every feed
+        # while a large packet straddles reads
+        self._hdr: Optional[Tuple[int, int]] = None
 
     def feed(self, data: bytes) -> List[Any]:
         if _fi._injector is not None:
@@ -245,14 +270,32 @@ class Parser:
             # recovery exercises the real malformed-packet handling
             if _fi._injector.act("frame.parse") == "raise":
                 raise FrameError("injected fault: frame.parse")
-        self._buf += data
+        buf = self._buf
+        buf += data
         out: List[Any] = []
+        ack_runs = self.ack_runs
         while True:
+            if ack_runs and len(buf) >= 4 and buf[0] in _ACK_HEADS \
+                    and buf[1] == 0x02:
+                # ack-run fast path: pack every contiguous same-type
+                # 4-byte ack at the buffer head into ONE AckRun
+                b1 = buf[0]
+                n = len(buf)
+                i = 4
+                pids = [(buf[2] << 8) | buf[3]]
+                append = pids.append
+                while n - i >= 4 and buf[i] == b1 and buf[i + 1] == 0x02:
+                    append((buf[i + 2] << 8) | buf[i + 3])
+                    i += 4
+                del buf[:i]
+                self._hdr = None
+                out.append(P.AckRun(b1 >> 4, pids))
+                continue
             pkt, consumed = self._try_parse()
             if pkt is None:
                 break
             out.append(pkt)
-            del self._buf[:consumed]
+            del buf[:consumed]
         return out
 
     def _try_parse(self):
@@ -262,15 +305,21 @@ class Parser:
         buf = self._buf
         if len(buf) < 2:
             return None, 0
-        try:
-            rl, hdr_end = _dec_varint(buf, 1)
-        except _NeedMore:
-            return None, 0
+        hdr = self._hdr
+        if hdr is None:
+            try:
+                rl, hdr_end = _dec_varint(buf, 1)
+            except _NeedMore:
+                return None, 0
+        else:
+            rl, hdr_end = hdr
         total = hdr_end + rl
         if total > self.max_packet_size:
             raise FrameError("packet too large", P.RC.PACKET_TOO_LARGE)
         if len(buf) < total:
+            self._hdr = (rl, hdr_end)
             return None, 0
+        self._hdr = None
         pkt = _parse_packet(buf[0], bytes(buf[hdr_end:total]), self.proto_ver)
         if isinstance(pkt, P.Connect):
             self.proto_ver = pkt.proto_ver

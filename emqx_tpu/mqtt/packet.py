@@ -15,7 +15,7 @@ __all__ = [
     "PINGREQ", "PINGRESP", "DISCONNECT", "AUTH",
     "TYPE_NAMES", "Connect", "Connack", "Publish", "PubAck", "Subscribe",
     "Suback", "Unsubscribe", "Unsuback", "PingReq", "PingResp",
-    "Disconnect", "Auth", "Will",
+    "Disconnect", "Auth", "Will", "AckRun",
     "RC",
 ]
 
@@ -119,6 +119,37 @@ class PubAck:
     packet_id: int = 0
     reason_code: int = 0
     properties: Dict[str, Any] = field(default_factory=dict)
+
+
+class AckRun:
+    """A contiguous run of same-type pid-only acks (PUBACK / PUBREC /
+    PUBREL / PUBCOMP, reason code 0, no properties), packed as one
+    object by the parser's ack-run fast path.
+
+    Not a wire packet itself: each pid stands for one 4-byte ack frame.
+    Consumers that cannot take the run wholesale call :meth:`expand` to
+    recover the per-packet :class:`PubAck` list the slow path would
+    have produced."""
+
+    __slots__ = ("type", "pids")
+
+    def __init__(self, type: int, pids: List[int]) -> None:
+        self.type = type
+        self.pids = pids
+
+    def expand(self) -> "List[PubAck]":
+        t = self.type
+        return [PubAck(t, pid) for pid in self.pids]
+
+    def __len__(self) -> int:
+        return len(self.pids)
+
+    def __eq__(self, other: Any) -> bool:
+        return (isinstance(other, AckRun) and other.type == self.type
+                and other.pids == self.pids)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"AckRun({TYPE_NAMES.get(self.type)}, {self.pids})"
 
 
 @dataclass

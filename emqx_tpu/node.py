@@ -516,6 +516,9 @@ class BrokerNode:
             max_packet_size=self.config.get("mqtt.max_packet_size"),
             limiter=self.limiter,
             on_closed=self._conn_closed,
+            # stream-path parity: the one batched-stack opt-in also
+            # turns on ack-run ingest here (ws/quic/tcp-stream riders)
+            coalesce=bool(self.config.get("broker.fanout.enable")),
         )
         channel.conn = conn  # takeover routing (connection.py)
         self._register_on_connect(channel, conn)

@@ -106,6 +106,10 @@ FANOUT_METRIC_NAMES: List[str] = [
     # acknowledged-delivery stack (PR 2): bulk QoS1/2 window admissions
     # and ack/write flushes that merged >1 packet into one write
     "broker.inflight.batch_admitted", "broker.ack.coalesced_writes",
+    # batched ingest (PR 5): ack runs recognized by the parser fast
+    # path (one inc per packed run) and QoS2 state transitions that
+    # covered >1 packet in one session call
+    "broker.ack.run_parsed", "broker.qos2.batch",
 ]
 
 # -- supervision tree (supervise.py) + overload shedding on the batched

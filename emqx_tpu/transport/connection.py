@@ -105,12 +105,19 @@ class Connection:
         max_packet_size: int = F.MAX_REMAINING_LEN,
         limiter: Optional[LimiterGroup] = None,
         on_closed=None,
+        coalesce: bool = False,
     ) -> None:
         self.stream = stream
         self.channel = channel
         self.conninfo = conninfo or ConnInfo()
         self.recv_buf = recv_buf
-        self.parser = F.Parser(max_packet_size=max_packet_size)
+        # stream-path parity with the batched proto datapath: the same
+        # opt-in enables the parser's ack-run fast path (packed AckRun
+        # consumption below) — off, parsing and handling stay the
+        # per-packet path, byte-identical
+        self.coalesce = coalesce
+        self.parser = F.Parser(max_packet_size=max_packet_size,
+                               ack_runs=coalesce)
         self.limiter = limiter
         self.on_closed = on_closed
         # optional async advisory stage (exhook): awaited per packet before
@@ -177,6 +184,26 @@ class Connection:
                 self._frame_error(e)
                 return
             for pkt in pkts:
+                if type(pkt) is P.AckRun:
+                    if self.channel.state != "connected":
+                        for sub in pkt.expand():
+                            self.pkts_in += 1
+                            self._run_actions(self.channel.handle_in(sub))
+                            if self._closing.is_set():
+                                return
+                        continue
+                    # packed ack run: one batched session transition,
+                    # reply burst rides the writer queue as raw bytes
+                    self.pkts_in += len(pkt.pids)
+                    reply, refill = self.channel.handle_ack_run(pkt)
+                    if reply:
+                        self._outq.put_nowait((reply, len(pkt.pids)))
+                    if refill:
+                        self._run_actions(
+                            self.channel.handle_deliver(refill))
+                    if self._closing.is_set():
+                        return
+                    continue
                 self.pkts_in += 1
                 if (
                     msg_bucket is not None
@@ -254,7 +281,20 @@ class Connection:
                     return
                 continue
             try:
-                chunks = [F.serialize(pkt, ver=self.channel.proto_ver)]
+                # queue items are parsed packets OR (raw_bytes, npkts)
+                # bursts from the ack-run path — both coalesce into one
+                # stream write
+                npkts = 0
+
+                def _render(item):
+                    nonlocal npkts
+                    if type(item) is tuple:
+                        npkts += item[1]
+                        return item[0]
+                    npkts += 1
+                    return F.serialize(item, ver=self.channel.proto_ver)
+
+                chunks = [_render(pkt)]
                 while not self._outq.empty():
                     nxt = self._outq.get_nowait()
                     if nxt is None:
@@ -262,12 +302,11 @@ class Connection:
                         # the goodbye packets were queued before it
                         self._outq.put_nowait(None)
                         break
-                    chunks.append(
-                        F.serialize(nxt, ver=self.channel.proto_ver))
+                    chunks.append(_render(nxt))
                 data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
                 self.stream.write(data)
                 self.bytes_out += len(data)
-                self.pkts_out += len(chunks)
+                self.pkts_out += npkts
                 if self._outq.empty():
                     await self.stream.drain()
             except ConnectionError:
@@ -279,6 +318,11 @@ class Connection:
             await asyncio.sleep(self.TICK_S)
             self._run_actions(self.channel.check_keepalive())
             self._run_actions(self.channel.retry_deliveries())
+            if not self._closing.is_set():
+                # resends queued to a live writer: commit the DUP
+                # clones / age clocks; a closed connection leaves the
+                # entries due for the session's next owner
+                self.channel.retry_commit()
 
     def info(self) -> dict:
         ch = self.channel

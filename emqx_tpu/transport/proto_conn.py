@@ -65,7 +65,11 @@ class MqttProtocol(asyncio.Protocol):
     ) -> None:
         self.channel = channel
         self.conninfo = conninfo or ConnInfo()
-        self.parser = F.Parser(max_packet_size=max_packet_size)
+        # ack-run fast path only on the zero-task datapath: with an
+        # advisory stage the ordered queue handles packets one at a
+        # time, so runs would just be re-expanded
+        self.parser = F.Parser(max_packet_size=max_packet_size,
+                               ack_runs=coalesce and intercept is None)
         self.limiter = limiter
         self.on_closed = on_closed
         self.intercept = intercept
@@ -173,6 +177,33 @@ class MqttProtocol(asyncio.Protocol):
             n = len(pkts)
             while i < n:
                 pkt = pkts[i]
+                if type(pkt) is P.AckRun:
+                    if channel.state != "connected":
+                        # pre-CONNECT acks are a protocol error: replay
+                        # per-packet so the close reason matches the
+                        # slow path exactly
+                        for sub in pkt.expand():
+                            self.pkts_in += 1
+                            self._run_actions(channel.handle_in(sub))
+                            if self._closed:
+                                return
+                        i += 1
+                        continue
+                    # packed ack run off the parser fast path: ONE
+                    # batched session transition for the whole burst,
+                    # one reply burst, one refill cycle
+                    self.pkts_in += len(pkt.pids)
+                    if self.metrics is not None:
+                        self.metrics.inc("broker.ack.run_parsed")
+                    reply, refill = channel.handle_ack_run(pkt)
+                    if reply:
+                        self._send_raw(reply, len(pkt.pids))
+                    if refill:
+                        self.deliver(refill)
+                    i += 1
+                    if self._closed:
+                        return
+                    continue
                 if (
                     pkt.type == P.PUBACK
                     and channel.state == "connected"
@@ -182,7 +213,9 @@ class MqttProtocol(asyncio.Protocol):
                     # PUBACK burst (a windowed consumer acks a whole
                     # TCP read in one write): ack them all, refill the
                     # window ONCE, send the refills through the bulk
-                    # wire path
+                    # wire path.  (With the ack-run parser these arrive
+                    # packed above; this branch covers coalesce mode
+                    # with an advisory stage, where runs are disabled.)
                     j = i + 2
                     while j < n and pkts[j].type == P.PUBACK:
                         j += 1
@@ -420,6 +453,22 @@ class MqttProtocol(asyncio.Protocol):
         else:
             self.transport.write(data)
 
+    def _send_raw(self, data: bytes, npkts: int) -> None:
+        """Queue pre-serialized wire bytes (ack reply bursts, template
+        resends) through the same batching/backpressure states as
+        :meth:`_send_pkt`."""
+        if self._closed or self.transport is None or not data:
+            return
+        self.bytes_out += len(data)
+        self.pkts_out += npkts
+        if self._batching:
+            self._wbuf.append(data)
+            self._wbuf_pkts += npkts
+        elif self._paused_write:
+            self._pending_out.append(data)
+        else:
+            self.transport.write(data)
+
     def _flush_writes(self) -> None:
         """Close the write batch: ONE transport write for everything
         buffered since it opened (ack bursts coalesce here)."""
@@ -503,9 +552,21 @@ class MqttProtocol(asyncio.Protocol):
             self._batching = self.coalesce
             try:
                 self._run_actions(self.channel.check_keepalive())
-                self._run_actions(self.channel.retry_deliveries())
+                if self.coalesce:
+                    # batched resend: template-patched wire bytes, one
+                    # coalesced flush for the whole tick
+                    for chunk in self.channel.retry_wire_batch():
+                        self._send_raw(chunk, 1)
+                else:
+                    self._run_actions(self.channel.retry_deliveries())
             finally:
                 self._flush_writes()
+            if not self._closed:
+                # the flush reached the transport: commit the DUP
+                # clones / age clocks (a raised write or a close mid-
+                # tick leaves the entries due, so the next tick
+                # re-offers them)
+                self.channel.retry_commit()
         except Exception:
             log.exception("tick failed (%s)", self.conninfo.peername)
         if not self._closed:

@@ -8,7 +8,10 @@ the fanout pipeline amortizes, on the telemetry-broadcast shape — twice:
   delivery, the PR-1 number), and
 * QoS1 publishers → wildcard **QoS1 windowed** subscribers with acks
   flowing (the acknowledged-delivery stack: batched inflight admission
-  + ack/write coalescing, the PR-2 number) under ``"qos1"``.
+  + ack/write coalescing, the PR-2 number) under ``"qos1"``, and
+* QoS2 publishers → wildcard **QoS2 windowed** subscribers running the
+  full exactly-once exchange (ack-run ingest + batched QoS2 state
+  machine, the PR-5 number) under ``"qos2"``.
 
 Modes:
 
@@ -246,16 +249,20 @@ def main(argv=None) -> dict:
     args = ap.parse_args(argv)
 
     from bench import (
-        _fanout_e2e_size, _qos1_e2e_size, bench_fanout_e2e, bench_qos1_e2e,
+        _fanout_e2e_size, _qos1_e2e_size, _qos2_e2e_size, bench_fanout_e2e,
+        bench_qos1_e2e, bench_qos2_e2e,
     )
 
     size = _fanout_e2e_size(args.smoke)
     qsize = _qos1_e2e_size(args.smoke)
+    q2size = _qos2_e2e_size(args.smoke)
     if args.duration is not None:
         size["duration"] = args.duration
         qsize["duration"] = args.duration
+        q2size["duration"] = args.duration
     out = bench_fanout_e2e(**size)
     out["qos1"] = bench_qos1_e2e(**qsize)
+    out["qos2"] = bench_qos2_e2e(**q2size)
     if args.chaos:
         out["chaos"] = chaos_smoke()
     print(json.dumps(out, indent=2))

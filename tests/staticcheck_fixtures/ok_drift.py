@@ -13,3 +13,9 @@ def f(metrics, cfg, alarms, hooks, _injector, name):
     alarms.deactivate(f"degraded_fixture:{name}")
     hooks.run("message.dropped", (None, "queue_full"))
     hooks.run("message.dropped", (None, "shared_no_available"))
+
+
+def g(hooks):
+    hooks.add("client.connected", lambda *a: None)
+    hooks.run_fold("client.authenticate", (None, None, None, {}), True)
+    hooks.has("message.delivered")

@@ -1,5 +1,6 @@
-"""Must TRIP registry-drift on all five surfaces (checked against the
-real registries in observe/metrics.py / config.py / faultinject.py)."""
+"""Must TRIP registry-drift on all six surfaces (checked against the
+real registries in observe/metrics.py / config.py / faultinject.py /
+broker/hooks.py)."""
 
 
 def f(metrics, cfg, alarms, hooks, _injector):
@@ -8,3 +9,7 @@ def f(metrics, cfg, alarms, hooks, _injector):
     _injector.check("bogus.point")
     alarms.deactivate("never_activated_alarm")
     hooks.run("message.dropped", (None, "not_a_real_reason"))
+
+
+def g(hooks):
+    hooks.add("client.not_a_real_point", lambda: None)

@@ -1,0 +1,422 @@
+"""Batched-ingest stack (ack-run parse → batched QoS2 state machine →
+coalesced replies): byte-parity against the per-packet path, parser
+fast-path/partial-header behavior, batched session-state parity, and
+the commit-after-flush retry semantics.
+
+The contract under test: with ``broker.fanout.enable`` off nothing
+changes at all; with it on, the wire output is byte-identical to the
+per-packet path — only the write boundaries, the per-packet Python
+work, and the session-call granularity change."""
+
+import asyncio
+
+from emqx_tpu import faultinject
+from emqx_tpu.broker import Broker, Channel, ConnectionManager
+from emqx_tpu.broker.session import Session
+from emqx_tpu.faultinject import FaultInjector
+from emqx_tpu.mqtt import frame as F
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.observe.metrics import Metrics
+from emqx_tpu.transport.connection import Connection
+from emqx_tpu.transport.proto_conn import MqttProtocol
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# parser: ack-run fast path + partial-header cache
+# ---------------------------------------------------------------------------
+
+def _expand(pkts):
+    out = []
+    for p in pkts:
+        out.extend(p.expand() if type(p) is P.AckRun else [p])
+    return out
+
+
+def _mixed_stream():
+    return b"".join([
+        F.serialize(P.PubAck(P.PUBACK, 1)),
+        F.serialize(P.PubAck(P.PUBACK, 2)),
+        F.serialize(P.PubAck(P.PUBACK, 3)),
+        F.serialize(P.Publish(qos=0, topic="t", payload=b"x")),
+        F.serialize(P.PubAck(P.PUBREC, 4)),
+        F.serialize(P.PubAck(P.PUBREC, 5)),
+        F.serialize(P.PubAck(P.PUBREL, 6)),
+        F.serialize(P.PubAck(P.PUBCOMP, 7)),
+        F.serialize(P.PubAck(P.PUBCOMP, 8)),
+        F.serialize(P.PingReq()),
+        F.serialize(P.PubAck(P.PUBACK, 9)),
+    ])
+
+
+def test_parser_ack_runs_pack_contiguous_same_type_acks():
+    data = _mixed_stream()
+    fast = F.Parser(ack_runs=True).feed(data)
+    # contiguous same-type acks pack; type changes and non-acks split
+    runs = [p for p in fast if type(p) is P.AckRun]
+    assert [(r.type, r.pids) for r in runs] == [
+        (P.PUBACK, [1, 2, 3]), (P.PUBREC, [4, 5]), (P.PUBREL, [6]),
+        (P.PUBCOMP, [7, 8]), (P.PUBACK, [9]),
+    ]
+    # expanded, the fast path equals the per-packet parse exactly
+    assert _expand(fast) == F.Parser().feed(data)
+
+
+def test_parser_ack_runs_equal_slow_path_at_every_split_boundary():
+    data = _mixed_stream()
+    want = F.Parser().feed(data)
+    for cut in range(len(data) + 1):
+        p = F.Parser(ack_runs=True)
+        got = p.feed(data[:cut]) + p.feed(data[cut:])
+        assert _expand(got) == want, cut
+
+
+def test_parser_ack_runs_v5_reason_code_acks_fall_back_per_packet():
+    # a v5 ack carrying rc/props has remaining length > 2: slow path
+    data = (F.serialize(P.PubAck(P.PUBACK, 1, 0x10), ver=5)
+            + F.serialize(P.PubAck(P.PUBACK, 2), ver=5)
+            + F.serialize(P.PubAck(P.PUBACK, 3, 0x80), ver=5))
+    p = F.Parser(proto_ver=5, ack_runs=True)
+    got = p.feed(data)
+    assert [type(x) for x in got] == [P.PubAck, P.AckRun, P.PubAck]
+    assert _expand(got) == F.Parser(proto_ver=5).feed(data)
+
+
+def test_parser_caches_decoded_header_across_partial_feeds():
+    pkt = F.serialize(P.Publish(qos=0, topic="big", payload=b"z" * 100_000))
+    p = F.Parser()
+    assert p.feed(pkt[:1]) == []
+    assert p._hdr is None                 # header itself not complete yet
+    assert p.feed(pkt[1:10]) == []
+    assert p._hdr is not None             # decoded once, cached
+    cached = p._hdr
+    mid = len(pkt) // 2
+    assert p.feed(pkt[10:mid]) == []
+    assert p._hdr == cached               # no re-decode while incomplete
+    [out] = p.feed(pkt[mid:])
+    assert p._hdr is None                 # consumed: cache invalidated
+    assert out.payload == b"z" * 100_000
+
+
+def test_parser_header_cache_cleared_by_ack_fast_path():
+    # a partial ack primes the cache; the fast path must clear it when
+    # it consumes the completed ack, or the NEXT packet parses with a
+    # stale header
+    p = F.Parser(ack_runs=True)
+    ack = F.serialize(P.PubAck(P.PUBACK, 7))
+    assert p.feed(ack[:2]) == []
+    assert p._hdr == (2, 2)
+    got = p.feed(ack[2:] + F.serialize(P.Publish(qos=0, topic="after",
+                                                 payload=b"ok")))
+    assert [type(x) for x in got] == [P.AckRun, P.Publish]
+    assert got[1].topic == "after"
+
+
+# ---------------------------------------------------------------------------
+# session: batched QoS2 transitions == sequential ones
+# ---------------------------------------------------------------------------
+
+def _msg(payload=b"m", qos=1):
+    from emqx_tpu.broker.message import make_message
+
+    return make_message("pub", "t", payload, qos=qos)
+
+
+def test_qos2_batch_transitions_match_sequential():
+    a, b = Session("a", max_inflight=8), Session("b", max_inflight=8)
+    for s in (a, b):
+        out, _ = s.deliver([_msg(b"%d" % i, qos=2) for i in range(4)])
+        assert [p.pid for p in out] == [1, 2, 3, 4]
+        # backlog so the pubcomp refill cycle has work to admit
+        s.mqueue.insert(_msg(b"q1", qos=2))
+        s.mqueue.insert(_msg(b"q2", qos=2))
+    seq = [a.pubrec(pid) for pid in (1, 2, 99, 2)]
+    assert b.pubrec_batch([1, 2, 99, 2]) == seq == [True, True, False, False]
+    seq_comp = [a.pubcomp(pid) for pid in (1, 99, 2)]
+    known, more = b.pubcomp_batch([1, 99, 2])
+    assert known == sum(1 for k, _ in seq_comp if k) == 2
+    # sequential dequeues after each pubcomp; batch dequeues once — the
+    # admitted refill set and pid sequence must match exactly
+    seq_more = [p for _, ms in seq_comp for p in ms]
+    assert [(p.pid, p.msg.payload) for p in more] == \
+        [(p.pid, p.msg.payload) for p in seq_more]
+    assert len(a.inflight) == len(b.inflight)
+
+
+def test_inbound_pubrel_batch_matches_sequential():
+    a, b = Session("a"), Session("b")
+    for s in (a, b):
+        for pid in (10, 11, 12):
+            assert s.publish_qos2(pid, _msg(qos=2)) == "ok"
+    seq = [a.pubrel_received(pid) for pid in (10, 99, 11, 10)]
+    assert b.pubrel_received_batch([10, 99, 11, 10]) == seq
+    assert set(a.awaiting_rel) == set(b.awaiting_rel) == {12}
+
+
+# ---------------------------------------------------------------------------
+# proto datapath: flag on/off byte parity (QoS2 + v5 error acks)
+# ---------------------------------------------------------------------------
+
+class _FakeTransport:
+    def __init__(self):
+        self.writes = []
+        self.closed = False
+
+    def write(self, data):
+        self.writes.append(bytes(data))
+
+    def close(self):
+        self.closed = True
+
+    def get_extra_info(self, key):
+        return None
+
+    def pause_reading(self):
+        pass
+
+    def resume_reading(self):
+        pass
+
+
+def _mk_proto(coalesce, max_inflight=2):
+    b = Broker()
+    cm = ConnectionManager(b)
+    chan = Channel(b, cm, max_inflight=max_inflight)
+    m = Metrics()
+    b.metrics = m
+    conn = MqttProtocol(chan, metrics=m, coalesce=coalesce)
+    b.on_deliver = lambda cid, pubs: conn.deliver(pubs)
+    t = _FakeTransport()
+    conn.connection_made(t)
+    return conn, t, m, b
+
+
+def _qos2_echo_session(coalesce):
+    """One client subscribes (QoS2) and publishes QoS2 to itself: the
+    full outbound PUBREC/PUBREL/PUBCOMP machine and the inbound
+    PUBREL release both run in ack bursts."""
+
+    async def main():
+        conn, t, m, b = _mk_proto(coalesce)
+        conn.data_received(F.serialize(P.Connect(
+            proto_ver=4, clientid="c", clean_start=True, keepalive=0)))
+        conn.data_received(F.serialize(P.Subscribe(
+            packet_id=1, topic_filters=[("t", {"qos": 2})])))
+        # 6 QoS2 publishes in ONE read: echoes 2 (window 2), queues 4
+        conn.data_received(b"".join(
+            F.serialize(P.Publish(qos=2, topic="t", packet_id=10 + i,
+                                  payload=b"m%d" % i))
+            for i in range(6)))
+        # release our inbound publishes as one PUBREL burst → PUBCOMPs
+        conn.data_received(b"".join(
+            F.serialize(P.PubAck(P.PUBREL, 10 + i)) for i in range(6)))
+        # drive the delivered QoS2 grants through their state machine
+        # in bursts: PUBREC run → PUBREL replies; PUBCOMP run → window
+        # refill publishes the next pair
+        for pids in ((1, 2), (3, 4), (5, 6)):
+            conn.data_received(b"".join(
+                F.serialize(P.PubAck(P.PUBREC, pid)) for pid in pids))
+            conn.data_received(b"".join(
+                F.serialize(P.PubAck(P.PUBCOMP, pid)) for pid in pids))
+        return conn, t, m
+
+    return run(main())
+
+
+def test_qos2_ack_stream_byte_identical_flag_on_vs_off():
+    conn_b, t_b, m = _qos2_echo_session(coalesce=True)
+    conn_p, t_p, _ = _qos2_echo_session(coalesce=False)
+    assert b"".join(t_b.writes) == b"".join(t_p.writes)
+    assert len(t_b.writes) < len(t_p.writes)
+    assert m.get("broker.ack.run_parsed") >= 4    # PUBREL/PUBREC/PUBCOMP runs
+    assert m.get("broker.qos2.batch") >= 3
+    # both sessions fully drained: exactly-once completed for all 6 legs
+    assert len(conn_b.channel.session.inflight) == 0
+    assert conn_b.channel.session.awaiting_rel == {}
+
+
+def _v5_unknown_ack_session(coalesce):
+    async def main():
+        conn, t, m, b = _mk_proto(coalesce)
+        conn.data_received(F.serialize(P.Connect(
+            proto_ver=5, clientid="c", clean_start=True, keepalive=0)))
+        # pid-only v5 ack runs for pids nothing ever delivered: the
+        # replies must carry rc 0x92, which in v5 changes the bytes —
+        # the batch path has to reproduce the per-packet serializer
+        conn.data_received(b"".join(
+            F.serialize(P.PubAck(P.PUBREL, pid), ver=5)
+            for pid in (60, 61, 62)))
+        conn.data_received(b"".join(
+            F.serialize(P.PubAck(P.PUBREC, pid), ver=5)
+            for pid in (70, 71)))
+        return t
+
+    return run(main())
+
+
+def test_v5_unknown_pid_ack_runs_byte_identical():
+    t_b = _v5_unknown_ack_session(coalesce=True)
+    t_p = _v5_unknown_ack_session(coalesce=False)
+    joined = b"".join(t_b.writes)
+    assert joined == b"".join(t_p.writes)
+    # and the 0x92 reason actually hit the wire (v5 long-form acks)
+    assert joined.count(bytes([P.RC.PACKET_ID_NOT_FOUND])) >= 5
+
+
+# ---------------------------------------------------------------------------
+# stream datapath parity (asyncio-streams Connection)
+# ---------------------------------------------------------------------------
+
+class _FakeStream:
+    def __init__(self):
+        self.inbox = asyncio.Queue()
+        self.writes = []
+
+    async def read(self, n):
+        return await self.inbox.get()
+
+    def write(self, data):
+        self.writes.append(bytes(data))
+
+    async def drain(self):
+        pass
+
+    def close(self):
+        pass
+
+    async def wait_closed(self):
+        pass
+
+    def peername(self):
+        return ("fake", 0)
+
+
+def _stream_session(coalesce):
+    async def main():
+        b = Broker()
+        cm = ConnectionManager(b)
+        chan = Channel(b, cm, max_inflight=2)
+        s = _FakeStream()
+        conn = Connection(s, chan, coalesce=coalesce)
+        b.on_deliver = lambda cid, pubs: conn.deliver(pubs)
+        task = asyncio.ensure_future(conn.run())
+        s.inbox.put_nowait(F.serialize(P.Connect(
+            proto_ver=4, clientid="c", clean_start=True, keepalive=0)))
+        s.inbox.put_nowait(F.serialize(P.Subscribe(
+            packet_id=1, topic_filters=[("t", {"qos": 1})])))
+        s.inbox.put_nowait(b"".join(
+            F.serialize(P.Publish(qos=1, topic="t", packet_id=10 + i,
+                                  payload=b"m%d" % i))
+            for i in range(6)))
+        await asyncio.sleep(0.05)
+        for pids in ((1, 2), (3, 4), (5, 6)):
+            s.inbox.put_nowait(b"".join(
+                F.serialize(P.PubAck(P.PUBACK, pid)) for pid in pids))
+            await asyncio.sleep(0.02)
+        s.inbox.put_nowait(b"")   # EOF
+        await task
+        return s.writes
+
+    return run(main())
+
+
+def test_stream_connection_ack_runs_byte_identical():
+    assert b"".join(_stream_session(True)) == \
+        b"".join(_stream_session(False))
+
+
+# ---------------------------------------------------------------------------
+# retry: peek/commit split + template resend parity
+# ---------------------------------------------------------------------------
+
+def test_session_retry_peek_does_not_commit():
+    import time as _t
+
+    s = Session("c", max_inflight=8, retry_interval=10.0)
+    now = _t.time()
+    out, _ = s.deliver([_msg(b"a"), _msg(b"b")])
+    entries = s.retry_peek(now + 11)
+    assert sorted(pid for pid, _, _ in entries) == [p.pid for p in out]
+    # nothing mutated: no DUP clone stored, age clock untouched
+    for pid, _, _ in entries:
+        assert s.inflight.lookup(pid)[1].dup is False
+    assert len(s.retry_peek(now + 11)) == 2      # still due
+    s.retry_commit(entries, now + 11)
+    for pid, _, _ in entries:
+        assert s.inflight.lookup(pid)[1].dup is True
+    assert s.retry_peek(now + 12) == []          # touched at commit
+    assert len(s.retry_peek(now + 21.5)) == 2    # due a full interval later
+
+
+def test_session_retry_commit_skips_entries_acked_in_between():
+    import time as _t
+
+    s = Session("c", max_inflight=8, retry_interval=10.0)
+    now = _t.time()
+    out, _ = s.deliver([_msg(b"a"), _msg(b"b")])
+    entries = s.retry_peek(now + 11)
+    s.puback(out[0].pid)                         # acked mid-flush
+    s.retry_commit(entries, now + 11)            # must not KeyError
+    assert not s.inflight.contains(out[0].pid)
+    assert s.inflight.lookup(out[1].pid)[1].dup is True
+
+
+def _retry_harness(coalesce):
+    conn, t, m, b = _mk_proto(coalesce, max_inflight=8)
+    conn.data_received(F.serialize(P.Connect(
+        proto_ver=4, clientid="c", clean_start=True, keepalive=0)))
+    conn.data_received(F.serialize(P.Subscribe(
+        packet_id=1, topic_filters=[("t", {"qos": 1})])))
+    conn.data_received(F.serialize(P.Publish(
+        qos=1, topic="t", packet_id=10, payload=b"hello")))
+    conn.channel.session.retry_interval = 0.0
+    return conn, t
+
+
+def test_retry_resend_bytes_template_path_matches_serializer():
+    async def main():
+        conn_b, t_b = _retry_harness(coalesce=True)
+        conn_p, t_p = _retry_harness(coalesce=False)
+        n_b, n_p = len(t_b.writes), len(t_p.writes)
+        conn_b._tick()
+        conn_p._tick()
+        resend_b = b"".join(t_b.writes[n_b:])
+        resend_p = b"".join(t_p.writes[n_p:])
+        assert resend_b and resend_b == resend_p
+        # the resend is the delivered PUBLISH with DUP set + same pid
+        pkt = F.parse_one(resend_b)
+        assert pkt.type == P.PUBLISH and pkt.dup is True
+        assert pkt.payload == b"hello"
+        # committed: stored message is now the DUP clone on both paths
+        for conn in (conn_b, conn_p):
+            (_pid, _ts, (kind, msg)), = conn.channel.session.inflight.items()
+            assert kind == "publish" and msg.dup is True
+
+    run(main())
+
+
+def test_retry_does_not_commit_when_flush_raises():
+    async def main():
+        conn, t = _retry_harness(coalesce=True)
+        inj = faultinject.install(FaultInjector([
+            {"point": "transport.write", "action": "raise", "times": 1},
+        ]))
+        try:
+            n0 = len(t.writes)
+            conn._tick()                   # flush raises: commit skipped
+            assert len(t.writes) == n0     # nothing reached the wire
+            (_pid, _ts, (kind, msg)), = \
+                conn.channel.session.inflight.items()
+            assert msg.dup is False        # no clone burned
+            assert inj.fired.get("transport.write") == 1
+        finally:
+            faultinject.uninstall()
+        conn._tick()                       # next tick: resend + commit
+        assert len(t.writes) > n0
+        (_pid, _ts, (kind, msg)), = conn.channel.session.inflight.items()
+        assert msg.dup is True
+
+    run(main())

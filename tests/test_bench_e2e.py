@@ -33,15 +33,17 @@ def test_bench_e2e_smoke_delivers_everything():
         assert sec["sent"] > 0, (path, sec)
         assert sec["delivery_ratio"] == 1.0, (path, sec)
     assert out["speedup"] > 0
-    # acknowledged-delivery A/B: QoS1 windowed subscribers, acks
-    # flowing — every fan-out leg delivered, and no DUP redelivery
+    # acknowledged-delivery A/Bs: QoS1 windowed subscribers (acks
+    # flowing) and QoS2 exactly-once (PUBREC/PUBREL/PUBCOMP flowing) —
+    # every fan-out leg delivered, and no DUP redelivery
     # (retry_interval far exceeds the run, so a DUP is a broker bug)
-    for path in ("per_message", "pipeline"):
-        sec = out["qos1"][path]
-        assert sec["sent"] > 0, (path, sec)
-        assert sec["delivery_ratio"] == 1.0, (path, sec)
-        assert sec["duplicates"] == 0, (path, sec)
-    assert out["qos1"]["speedup"] > 0
+    for section in ("qos1", "qos2"):
+        for path in ("per_message", "pipeline"):
+            sec = out[section][path]
+            assert sec["sent"] > 0, (section, path, sec)
+            assert sec["delivery_ratio"] == 1.0, (section, path, sec)
+            assert sec["duplicates"] == 0, (section, path, sec)
+        assert out[section]["speedup"] > 0
     # chaos smoke: one kill-and-recover cycle per subsystem, each
     # healing via supervisor restart with delivery intact
     for name, section in out["chaos"].items():

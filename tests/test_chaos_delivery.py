@@ -377,3 +377,200 @@ def test_chaos_injected_cluster_frame_drops_heal():
             await n1.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# 8. gateway datapaths under injected transport.write drops
+# ---------------------------------------------------------------------------
+
+async def _start_gateway_node(extra=""):
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    cfg = Config(file_text=(
+        'listeners.tcp.default.bind = "127.0.0.1:0"\n' + extra))
+    node = BrokerNode(cfg)
+    await node.start()
+    return node
+
+
+def test_chaos_mqttsn_retry_heals_dropped_delivery():
+    """An injected drop of the first QoS1 PUBLISH datagram to an
+    MQTT-SN client: the gateway retry sweep must resend it, the client
+    acks the redelivery, and the message lands exactly once with the
+    session inflight drained (the peek/commit retry path — no
+    committed resend for the dropped write's interval is required,
+    only eventual delivery)."""
+    import socket as _socket
+    import struct
+
+    from emqx_tpu.gateway.base import GatewayManager
+
+    old_interval = GatewayManager.RETRY_INTERVAL
+    GatewayManager.RETRY_INTERVAL = 0.05
+
+    async def main():
+        node = await _start_gateway_node(
+            'gateway.mqttsn.enable = true\n'
+            'gateway.mqttsn.bind = "127.0.0.1:0"\n')
+        try:
+            port = node.gateways.gateways["mqttsn"].port
+
+            class Sn:
+                def __init__(self):
+                    self.sock = _socket.socket(_socket.AF_INET,
+                                               _socket.SOCK_DGRAM)
+                    self.sock.settimeout(5.0)
+                    self.addr = ("127.0.0.1", port)
+
+                def send(self, t, body=b""):
+                    self.sock.sendto(bytes([len(body) + 2, t]) + body,
+                                     self.addr)
+
+                def recv(self, timeout=5.0):
+                    self.sock.settimeout(timeout)
+                    data, _ = self.sock.recvfrom(2048)
+                    return data[1], data[2:data[0]]
+
+            sn = Sn()
+
+            def handshake():
+                sn.send(0x04, bytes([0x04, 0x01])
+                        + struct.pack(">H", 300) + b"sn-chaos")
+                t, body = sn.recv()
+                assert t == 0x05 and body[0] == 0
+                # SUBSCRIBE qos1, concrete topic name
+                sn.send(0x12, bytes([0x20]) + struct.pack(">H", 2)
+                        + b"sn/q1")
+                t, body = sn.recv()
+                assert t == 0x13 and body[-1] == 0
+
+            await asyncio.to_thread(handshake)
+            # entries become due after the SESSION retry interval; the
+            # sweep period only bounds how often the gateway looks
+            node.broker.sessions["sn-chaos"].retry_interval = 0.02
+
+            from emqx_tpu.client import Client
+
+            mq = Client(clientid="mp",
+                        port=node.listeners.all()[0].port)
+            await mq.connect()
+            inj = faultinject.install(FaultInjector([
+                {"point": "transport.write", "action": "drop", "times": 1},
+            ]))
+            try:
+                rc = await mq.publish("sn/q1", b"heal-me", qos=1)
+                assert rc == 0
+                assert inj.fired.get("transport.write") == 1
+
+                def recv_retry_and_ack():
+                    # first copy dropped on the wire; the retry sweep
+                    # (50 ms) must resend it
+                    t, body = sn.recv(timeout=5.0)
+                    assert t == 0x0C, (t, body)
+                    assert body[5:] == b"heal-me"
+                    mid = struct.unpack(">H", body[3:5])[0]
+                    assert mid != 0          # QoS1 delivery carries a pid
+                    # PUBACK: topicid + msgid + rc
+                    sn.send(0x0D, body[1:3] + struct.pack(">H", mid)
+                            + b"\x00")
+                    # exactly once: no further PUBLISH arrives
+                    try:
+                        t2, body2 = sn.recv(timeout=0.4)
+                    except _socket.timeout:
+                        return None
+                    return (t2, body2)
+
+                extra = await asyncio.to_thread(recv_retry_and_ack)
+                assert extra is None, extra
+                sess = node.broker.sessions.get("sn-chaos")
+                assert await until(
+                    lambda: sess is not None and len(sess.inflight) == 0)
+                await mq.disconnect()
+            finally:
+                faultinject.uninstall()
+            sn.sock.close()
+        finally:
+            await node.stop()
+
+    try:
+        run(main())
+    finally:
+        GatewayManager.RETRY_INTERVAL = old_interval
+
+
+def test_chaos_coap_con_dedup_heals_dropped_reply():
+    """An injected drop of a CoAP CON response: the client's protocol
+    retransmit (same message id) must be answered from the §4.2 dedup
+    cache — identical response bytes, and the publish side effect
+    fires exactly once."""
+    import socket as _socket
+
+    async def main():
+        from emqx_tpu.client import Client
+        from emqx_tpu.gateway import coap as C
+
+        node = await _start_gateway_node(
+            'gateway.coap.enable = true\n'
+            'gateway.coap.bind = "127.0.0.1:0"\n')
+        try:
+            cport = node.gateways.gateways["coap"].port
+            mq = Client(clientid="watch",
+                        port=node.listeners.all()[0].port)
+            await mq.connect()
+            await mq.subscribe("chaos/t", qos=0)
+
+            req = C.encode(C.CoapMessage(
+                C.CON, C.PUT, 77, b"tk",
+                [(C.OPT_URI_PATH, b"ps"), (C.OPT_URI_PATH, b"chaos"),
+                 (C.OPT_URI_PATH, b"t"),
+                 (C.OPT_URI_QUERY, b"c=coapchaos")],
+                b"v1",
+            ))
+
+            sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+            addr = ("127.0.0.1", cport)
+
+            # pass 1 of the seam is the synchronous MQTT delivery to
+            # the watcher (proto_conn deliver); pass 2 is the CoAP ACK
+            # reply — skip the delivery, drop the reply
+            inj = faultinject.install(FaultInjector([
+                {"point": "transport.write", "action": "drop",
+                 "skip": 1, "times": 1},
+            ]))
+            try:
+                def send_and_retransmit():
+                    sock.settimeout(0.4)
+                    sock.sendto(req, addr)
+                    try:
+                        sock.recvfrom(2048)
+                        raise AssertionError("reply should have dropped")
+                    except _socket.timeout:
+                        pass
+                    # protocol retransmit: SAME mid → dedup cache answers
+                    sock.settimeout(5.0)
+                    sock.sendto(req, addr)
+                    data, _ = sock.recvfrom(2048)
+                    return data
+
+                data = await asyncio.to_thread(send_and_retransmit)
+                msg = C.decode(data)
+                assert msg.type == C.ACK and msg.mid == 77
+                assert msg.code == C.CHANGED
+                assert inj.fired.get("transport.write") == 1
+                # the publish fired exactly once despite two requests
+                got = await mq.recv(timeout=5)
+                assert (got.topic, got.payload) == ("chaos/t", b"v1")
+                try:
+                    dup = await mq.recv(timeout=0.4)
+                    raise AssertionError(f"duplicate publish: {dup}")
+                except (asyncio.TimeoutError, TimeoutError):
+                    pass
+                await mq.disconnect()
+            finally:
+                faultinject.uninstall()
+            sock.close()
+        finally:
+            await node.stop()
+
+    run(main())

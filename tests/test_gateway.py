@@ -1145,3 +1145,59 @@ def test_dtls_gateway_rejects_unknown_identity():
             await node.stop()
 
     run(main())
+
+
+def test_stomp_ack_run_batches_through_session_with_fanout_enabled():
+    """With the batched-stack opt-in on, a run of ACK frames arriving
+    in one TCP read releases the whole window through ONE
+    session.puback_batch cycle (receipts still answered per frame);
+    with it off the per-frame path is unchanged — both drain the
+    inflight window completely."""
+    async def main():
+        for flag in (True, False):
+            node = await start_node(
+                'broker.fanout.enable = true\n' if flag else '')
+            try:
+                sport = node.gateways.gateways["stomp"].port
+                c = StompClient()
+                await c.connect(sport)
+                await c.send("SUBSCRIBE", {"id": "1", "destination": "q/#",
+                                           "ack": "client-individual"})
+                mq = Client(clientid="m1", port=mqtt_port(node))
+                await mq.connect()
+                for i in range(4):
+                    await mq.publish(f"q/{i}", b"m%d" % i, qos=1)
+                acks = []
+                for _ in range(4):
+                    m = await c.recv()
+                    assert m.command == "MESSAGE"
+                    acks.append(m.headers["ack"])
+                conn = list(
+                    node.gateways.gateways["stomp"].clients.values())[0]
+                assert conn.batched is flag
+                sess = node.broker.sessions[conn.clientid]
+                assert len(sess.inflight) == 4
+                # all four ACKs (with receipts) land in ONE write
+                frames = b"".join(
+                    serialize_frame(StompFrame(
+                        "ACK", {"id": a, "receipt": f"r-{a}"}))
+                    for a in acks)
+                c.writer.write(frames)
+                await c.writer.drain()
+                receipts = set()
+                for _ in range(4):
+                    f = await c.recv()
+                    assert f.command == "RECEIPT"
+                    receipts.add(f.headers["receipt-id"])
+                assert receipts == {f"r-{a}" for a in acks}
+                for _ in range(50):
+                    if len(sess.inflight) == 0:
+                        break
+                    await asyncio.sleep(0.01)
+                assert len(sess.inflight) == 0
+                await c.close()
+                await mq.disconnect()
+            finally:
+                await node.stop()
+
+    run(main())

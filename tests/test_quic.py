@@ -898,3 +898,103 @@ def test_no_mtu_probe_while_in_recovery():
     client._largest_acked[LEVEL_APP] = 10         # recovery over
     client._maybe_send_mtu_probe()
     assert client._mtu_probe is not None
+
+
+def test_quic_listener_survives_parse_faults_mid_handshake(tmp_path):
+    """The ROADMAP chaos item for the QUIC listener, both wound shapes:
+
+    1. a corrupted datagram arriving MID-QUIC-HANDSHAKE (valid routing
+       prefix, garbage payload) must at worst drop that connection —
+       never the endpoint or the event loop;
+    2. an injected MQTT frame-parse fault on the stream — i.e. mid
+       MQTT handshake, the CONNECT itself — takes the native
+       FrameError path and closes that session while the listener
+       keeps accepting and serving new handshakes."""
+    from emqx_tpu import faultinject
+    from emqx_tpu.config import Config
+    from emqx_tpu.faultinject import FaultInjector
+    from emqx_tpu.mqtt import packet as P
+    from emqx_tpu.node import BrokerNode
+
+    (tmp_path / "c.pem").write_bytes(CERT_PEM)
+    (tmp_path / "k.pem").write_bytes(KEY_PEM)
+
+    async def main():
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'listeners.quic.default.enable = true\n'
+            'listeners.quic.default.bind = "127.0.0.1:0"\n'
+            f'listeners.quic.default.certfile = "{tmp_path}/c.pem"\n'
+            f'listeners.quic.default.keyfile = "{tmp_path}/k.pem"\n'
+        ))
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            port = node.quic_port
+
+            # -- wound 1: corrupted datagram mid-QUIC-handshake -------
+            def corrupt_mid_handshake():
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                sock.settimeout(5.0)
+                addr = ("127.0.0.1", port)
+                c = QuicClient()
+                dgs = c.take_outgoing()
+                assert dgs
+                for d in dgs:
+                    sock.sendto(d, addr)
+                sock.recvfrom(65536)       # server engaged the handshake
+                # replay the first client flight with its payload bytes
+                # flipped: routes to the live conn, fails packet parse
+                d0 = dgs[0]
+                corrupted = d0[:40] + bytes(b ^ 0xFF for b in d0[40:])
+                sock.sendto(corrupted, addr)
+                sock.close()
+            await asyncio.to_thread(corrupt_mid_handshake)
+            await asyncio.sleep(0.05)
+
+            # the endpoint survived: a fresh client completes
+            q1 = await asyncio.to_thread(MqttOverQuic, port)
+
+            # -- wound 2: injected MQTT parse fault on the CONNECT ----
+            inj = faultinject.install(FaultInjector([
+                {"point": "frame.parse", "action": "raise", "times": 1},
+            ]))
+            try:
+                def poke():
+                    q1.send_pkt(P.Connect(proto_ver=4, clientid="qc1",
+                                          clean_start=True, keepalive=60))
+                await asyncio.to_thread(poke)
+                # the server's reader hit the injected FrameError and
+                # closed that stream — without killing the listener
+                deadline = asyncio.get_event_loop().time() + 5.0
+                while (inj.fired.get("frame.parse", 0) < 1
+                       and asyncio.get_event_loop().time() < deadline):
+                    await asyncio.sleep(0.01)
+                assert inj.fired.get("frame.parse") == 1
+            finally:
+                faultinject.uninstall()
+            q1.close()
+            assert "qc1" not in node.broker.sessions
+
+            # listener still serves: full MQTT session over a new conn
+            q2 = await asyncio.to_thread(MqttOverQuic, port)
+
+            def full_flow():
+                q2.send_pkt(P.Connect(proto_ver=4, clientid="qc2",
+                                      clean_start=True, keepalive=60))
+                ack = q2.recv_pkt()
+                assert ack.type == P.CONNACK and ack.reason_code == 0
+                q2.send_pkt(P.Subscribe(
+                    packet_id=1, topic_filters=[("cq/t", {"qos": 1})]))
+                assert q2.recv_pkt().type == P.SUBACK
+                q2.send_pkt(P.Publish(qos=0, topic="cq/t",
+                                      payload=b"alive"))
+                msg = q2.recv_pkt()
+                assert (msg.topic, msg.payload) == ("cq/t", b"alive")
+            await asyncio.to_thread(full_flow)
+            assert "qc2" in node.broker.sessions
+            q2.close()
+        finally:
+            await node.stop()
+
+    run(main())

@@ -95,7 +95,7 @@ def test_waiver_file_has_no_silent_suppressions():
     ("no-swallowed-exceptions", "trip_exceptions.py",
      "ok_exceptions.py", 2),
     ("await-under-lock", "trip_locks.py", "ok_locks.py", 3),
-    ("registry-drift", "trip_drift.py", "ok_drift.py", 5),
+    ("registry-drift", "trip_drift.py", "ok_drift.py", 6),
     ("unawaited-coroutine", "trip_coroutines.py", "ok_coroutines.py", 2),
 ])
 def test_rule_fixture_pair(rule, trip, ok, n_trip, tmp_path):
@@ -210,6 +210,8 @@ def test_registries_extract_from_tree():
     assert "mqtt.max_inflight" in reg.config_keys
     assert "overload_protection.lag_probe_interval" in reg.config_keys
     assert "fanout.drain" in reg.fault_points
+    assert "message.acked" in reg.hook_points
+    assert "client.enhanced_authenticate" in reg.hook_points
 
 
 def test_registries_match_runtime_tables():
@@ -223,6 +225,8 @@ def test_registries_match_runtime_tables():
     assert reg.metric_names == set(Metrics().all().keys())
     assert reg.config_keys == set(SCHEMA.keys())
     assert reg.fault_points == set(faultinject.POINTS)
+    from emqx_tpu.broker.hooks import HOOK_POINTS
+    assert reg.hook_points == set(HOOK_POINTS)
 
 
 # ---------------------------------------------------------------------------
