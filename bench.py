@@ -298,17 +298,16 @@ def bench_device(table, topics, batch, iters, depth, active_slots):
     return dev, out
 
 
-def bench_config1(n_clients: int = 1000, rate_per_client: float = 10.0,
-                  duration: float = 10.0, qos: int = 1,
-                  inflight: int = 16) -> dict:
-    """BASELINE config 1 at its SPECIFIED shape (1k subs, 10k msg/s
-    offered): emqtt_bench-style broker e2e — N exact-topic subscriber/
-    publisher pairs through a LIVE in-process node over real TCP
-    (protocol-mode datapath), measuring delivered msg/s and end-to-end
-    p50/p99.  QoS1 with a pipelined-ack window (emqtt_bench async-pub
-    mode); load generator shares the single host core, so the number is
-    combined loadgen+broker capacity — conservative for the broker
-    alone."""
+def _config1_shards_default() -> int:
+    """Shard count for the flag-on config1 side: one worker loop per
+    spare core, capped at 4; on a single-core box one shard still
+    overlaps socket syscalls (GIL released) with the in-process
+    loadgen."""
+    return min(4, max(1, os.cpu_count() or 1))
+
+
+def _config1_run(n_clients, rate_per_client, duration, qos, inflight,
+                 fanout: bool, shards: int) -> dict:
     import asyncio as aio
 
     from emqx_tpu.bench_client import run_scenario
@@ -316,8 +315,13 @@ def bench_config1(n_clients: int = 1000, rate_per_client: float = 10.0,
     from emqx_tpu.node import BrokerNode
 
     async def run():
-        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            + ('broker.fanout.enable = true\n' if fanout else '')
+        ))
         cfg.put("tpu.enable", False)   # host-path e2e: no device drag
+        if fanout and shards:
+            cfg.put("broker.conn.shards", shards)
         node = BrokerNode(cfg)
         await node.start()
         try:
@@ -326,7 +330,7 @@ def bench_config1(n_clients: int = 1000, rate_per_client: float = 10.0,
                 count=n_clients, rate=rate_per_client,
                 subscribers=n_clients, topic="bench/%i",
                 qos=qos, payload_size=64, duration=duration,
-                inflight=inflight)
+                inflight=inflight, callback_subs=True)
         finally:
             await node.stop()
         return out
@@ -335,8 +339,6 @@ def bench_config1(n_clients: int = 1000, rate_per_client: float = 10.0,
     lat = s.get("latency_us") or {}
     sent = s.get("sent") or 0
     return {
-        "clients": n_clients,
-        "offered_msgs_per_s": int(n_clients * rate_per_client),
         "sent": sent,
         "received": s.get("received"),
         # recv_rate shares BenchStats' wall clock (connect phase + run
@@ -348,6 +350,79 @@ def bench_config1(n_clients: int = 1000, rate_per_client: float = 10.0,
         "e2e_p50_us": lat.get("p50"),
         "e2e_p99_us": lat.get("p99"),
     }
+
+
+def bench_config1(n_clients: int = 1000, rate_per_client: float = 10.0,
+                  duration: float = 10.0, qos: int = 1,
+                  inflight: int = 16, shards: int = None) -> dict:
+    """BASELINE config 1 at its SPECIFIED shape (1k subs, 10k msg/s
+    offered): emqtt_bench-style broker e2e — N exact-topic subscriber/
+    publisher pairs through a LIVE in-process node over real TCP
+    (protocol-mode datapath), measuring delivered msg/s and end-to-end
+    p50/p99.  QoS1 with a pipelined-ack window (emqtt_bench async-pub
+    mode); the load generator shares the host cores, so the number is
+    combined loadgen+broker capacity — conservative for the broker
+    alone.
+
+    Reported as a flag-off/flag-on A/B: ``per_message`` is the default
+    per-packet datapath, ``pipeline`` the batched stack
+    (``broker.fanout.enable`` + connection-plane shards + hashed timer
+    wheel + publish-run ingest).  Headline keys mirror the PIPELINE
+    side — the configuration this PR ships for this shape."""
+    if shards is None:
+        shards = _config1_shards_default()
+    per_msg = _config1_run(n_clients, rate_per_client, duration, qos,
+                           inflight, fanout=False, shards=0)
+    pipe = _config1_run(n_clients, rate_per_client, duration, qos,
+                        inflight, fanout=True, shards=shards)
+    return {
+        "clients": n_clients,
+        "offered_msgs_per_s": int(n_clients * rate_per_client),
+        "shards": shards,
+        **pipe,
+        "per_message": per_msg,
+        "pipeline": pipe,
+        "speedup": round((pipe["msgs_per_s"] or 0.0)
+                         / max(1e-9, per_msg["msgs_per_s"] or 0.0), 2),
+    }
+
+
+def bench_config1_sweep(counts=(1000, 5000, 10000),
+                        total_rate: float = 10000.0,
+                        duration: float = 10.0, qos: int = 1,
+                        inflight: int = 16, shards: int = None) -> list:
+    """Connection-count sweep at CONSTANT offered load (the
+    "Benchmarking Message Brokers for IoT Edge" connection-scaling
+    axis): each row runs the config1 shape flag-on with ``count`` total
+    clients (count/2 publisher/subscriber pairs) all offering
+    ``total_rate`` msgs/s combined, reporting per-count delivered rate
+    and e2e p50/p99.  Counts that cannot fit the process fd limit
+    (2 fds per in-process connection: client end + broker end) clamp
+    to the feasible maximum and record what was requested — delivery
+    correctness (ratio 1.0) is asserted at every count that runs."""
+    import resource
+
+    if shards is None:
+        shards = _config1_shards_default()
+    soft, _hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    # 4 fds per pair (2 conns × 2 ends) + slack for the node itself
+    max_pairs = max(1, (soft - 512) // 4)
+    rows = []
+    for count in counts:
+        pairs = count // 2
+        clamped = min(pairs, max_pairs)
+        rate = total_rate / max(1, clamped)
+        row = _config1_run(clamped, rate, duration, qos, inflight,
+                           fanout=True, shards=shards)
+        row = {
+            "clients": clamped * 2,
+            "requested_clients": count,
+            "fd_limited": clamped < pairs,
+            "offered_msgs_per_s": int(total_rate),
+            **row,
+        }
+        rows.append(row)
+    return rows
 
 
 def bench_fanout_e2e(n_pub: int = 16, n_sub: int = 32, duration: float = 6.0,
@@ -531,6 +606,13 @@ def _config1_size(smoke: bool) -> dict:
     the same result key."""
     return ({"n_clients": 10, "duration": 2.0} if smoke
             else {"n_clients": 1000, "duration": 10.0})
+
+
+def _config1_sweep_size(smoke: bool) -> dict:
+    return ({"counts": (8, 16), "total_rate": 200.0, "duration": 1.5}
+            if smoke
+            else {"counts": (1000, 5000, 10000), "total_rate": 10000.0,
+                  "duration": 10.0})
 
 
 SERVE_INFLIGHT = 8   # batches in flight: d2h of i overlaps compute of i+1..
@@ -805,6 +887,7 @@ def main():
         table, kind, build_s = build_table(filters, args.depth)
         cpu = bench_cpu_native(table, topics, args.cpu_budget_s)
         c1 = bench_config1(**_config1_size(args.smoke))
+        c1s = bench_config1_sweep(**_config1_sweep_size(args.smoke))
         fe = bench_fanout_e2e(**_fanout_e2e_size(args.smoke))
         q1 = bench_qos1_e2e(**_qos1_e2e_size(args.smoke))
         q2 = bench_qos2_e2e(**_qos2_e2e_size(args.smoke))
@@ -859,6 +942,7 @@ def main():
                    for k, v in cpu.items()},
             },
             "config1_broker_e2e": c1,
+            "config1_sweep": c1s,
             "fanout_e2e": fe,
             "qos1_e2e": q1,
             "qos2_e2e": q2,
@@ -880,8 +964,14 @@ def main():
         max_filters=200_000 if not args.smoke else 2000)
     note(f"cpu baselines done (native {cpu['topics_per_s']:.0f}/s)")
     c1 = bench_config1(**_config1_size(args.smoke))
-    note(f"config1 broker e2e done: {c1['msgs_per_s']}/s "
-         f"p99={c1['e2e_p99_us']}us")
+    note(f"config1 broker e2e done: per-message "
+         f"{c1['per_message']['msgs_per_s']}/s vs pipeline "
+         f"{c1['pipeline']['msgs_per_s']}/s p99="
+         f"{c1['pipeline']['e2e_p99_us']}us ({c1['speedup']}x)")
+    c1s = bench_config1_sweep(**_config1_sweep_size(args.smoke))
+    note("config1 sweep done: " + "; ".join(
+        f"{r['clients']}c {r['msgs_per_s']}/s p99={r['e2e_p99_us']}us"
+        for r in c1s))
     fe = bench_fanout_e2e(**_fanout_e2e_size(args.smoke))
     note(f"fanout e2e done: per-message {fe['per_message']['msgs_per_s']}/s"
          f" vs pipeline {fe['pipeline']['msgs_per_s']}/s"
@@ -1035,6 +1125,7 @@ def main():
         "serve_cpu_iso": serve_cpu,
         "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,
+        "config1_sweep": c1s,
         "fanout_e2e": fe,
         "qos1_e2e": q1,
         "qos2_e2e": q2,

@@ -381,6 +381,9 @@ async def run_scenario(
     lean_subs: bool = False,          # pub: LeanSub counting subscribers
     lean_pubs: bool = False,          # pub: LeanPub template publishers
                                       # (qos1 + inflight window only)
+    callback_subs: bool = False,      # pub: full Clients delivering via
+                                      # on_message callback (no queue
+                                      # hop / drain task per message)
 ) -> Dict[str, Any]:
     stats = BenchStats()
 
@@ -444,6 +447,32 @@ async def run_scenario(
                 )
                 drainers = [asyncio.ensure_future(s.drain(stats))
                             for s in subs]
+            elif callback_subs:
+                # full protocol clients, but deliveries land in an
+                # on_message callback: counting + latency sampling
+                # happen inline at parse time — no InboundMessage
+                # queue hop or drain-task wakeup per message
+                lat = stats.latencies_us
+                unpack_from = struct.unpack_from
+                perf = time.perf_counter
+
+                def on_msg(m):
+                    stats.received += 1
+                    if m.dup:
+                        stats.duplicates += 1
+                    if len(m.payload) >= 8:
+                        (t_send,) = unpack_from("<d", m.payload)
+                        lat.append((perf() - t_send) * 1e6)
+
+                subs = await _connect_group(
+                    subscribers, host, port, "bench_psub_", 0.0, stats,
+                    keepalive=300, on_message=on_msg,
+                )
+                await asyncio.gather(
+                    *(c.subscribe(_topic_of(stopic, i), qos=sqos)
+                      for i, c in enumerate(subs))
+                )
+                drainers = []
             else:
                 subs = await _connect_group(
                     subscribers, host, port, "bench_psub_", 0.0, stats,

@@ -361,7 +361,12 @@ class Broker:
                     self._redispatch_shared(group, flt, m, res, member)
                 continue
             effs = [self._effective(m, opts) for m in mlist]
-            sends, dropped = sess.deliver(effs)
+            mu = sess.mutex
+            if mu is None:
+                sends, dropped = sess.deliver(effs)
+            else:
+                with mu:
+                    sends, dropped = sess.deliver(effs)
             if sends:
                 res.matched += len(sends)
                 if self.metrics is not None:
@@ -430,7 +435,14 @@ class Broker:
         if sess is None:
             return False
         eff = self._effective(msg, opts)
-        sends, dropped = sess.deliver([eff])
+        mu = sess.mutex
+        if mu is None:
+            sends, dropped = sess.deliver([eff])
+        else:
+            # shard-owned session: exclude the owning shard loop's ack
+            # handling for the duration of the window admission
+            with mu:
+                sends, dropped = sess.deliver([eff])
         if sends:
             res.matched += 1
             res.publishes.setdefault(clientid, []).extend(sends)
@@ -494,9 +506,13 @@ class Broker:
         sess = self.sessions.get(clientid)
         if sess is None:
             return
-        sends, dropped = sess.deliver(
-            [m.with_qos(min(m.qos, opts.qos)) for m in msgs]
-        )
+        effs = [m.with_qos(min(m.qos, opts.qos)) for m in msgs]
+        mu = sess.mutex
+        if mu is None:
+            sends, dropped = sess.deliver(effs)
+        else:
+            with mu:
+                sends, dropped = sess.deliver(effs)
         for d in dropped:
             self.hooks.run("message.dropped", (d, "queue_full"))
         if sends:

@@ -129,6 +129,14 @@ class FanoutPipeline:
         self.shape_probe_s = shape_probe_s
 
         self._q: Deque[Message] = deque()
+        # sender → count of their messages currently in pipeline
+        # custody (queued, deferred, or mid-batch).  MQTT's ordering
+        # guarantee is per publisher connection per topic, so a message
+        # whose SENDER has nothing in flight can safely bypass to the
+        # synchronous path even while other senders' messages are
+        # queued — the key that lets the shape gate keep working under
+        # sustained ~1:1 load (config1) instead of only while idle.
+        self._pending_senders: Dict[Any, int] = {}
         # overload-deferred retained/delayed publishes: parked while the
         # Olp reports overload, re-queued when it clears (shed policy:
         # QoS0 drops first, retained/delayed defer, QoS1/2 ride the
@@ -193,6 +201,7 @@ class FanoutPipeline:
             self._q.append(self._deferred.popleft())
         while self._q:
             msg = self._q.popleft()
+            self._untrack([msg])
             try:
                 self.broker.publish(msg)
             except Exception:
@@ -227,6 +236,7 @@ class FanoutPipeline:
             if msg.retain or msg.topic.startswith("$delayed/"):
                 if len(self._deferred) < self.deferred_cap:
                     self._deferred.append(msg)
+                    self._track(msg)
                     if self.metrics is not None:
                         self.metrics.inc("broker.olp.deferred")
                     return True
@@ -258,16 +268,18 @@ class FanoutPipeline:
             self.shape_routes > 0
             and self._avg_routes is not None
             and self._avg_routes <= self.shape_routes
-            and not self._q
-            and not self._busy
+            and msg.sender not in self._pending_senders
         ):
             # shape gate: batching amortizes per-message cost across
             # fan-out legs; on ~1:1 paired-client shapes there is
             # nothing to amortize and the per-message path's instant
-            # synchronous delivery wins.  Idle-only (same ordering
-            # argument as the rate bypass), and a probe message is let
-            # through every shape_probe_s so the estimate notices when
-            # the workload grows fan-out again.
+            # synchronous delivery wins.  Safe whenever this SENDER has
+            # nothing in pipeline custody — MQTT orders per publisher
+            # per topic, so other senders' queued messages cannot be
+            # overtaken in any way the spec (or a subscriber) can
+            # observe.  A probe message is still admitted every
+            # shape_probe_s so the estimate notices when the workload
+            # grows fan-out again.
             now2 = time.monotonic()
             if now2 >= self._shape_probe_at:
                 self._shape_probe_at = now2 + self.shape_probe_s
@@ -276,7 +288,49 @@ class FanoutPipeline:
                     self.metrics.inc("broker.fanout.shape_bypass")
                 return False
         self._q.append(msg)
+        self._track(msg)
         self._wake.set()
+        return True
+
+    def _track(self, msg: Message) -> None:
+        d = self._pending_senders
+        s = msg.sender
+        d[s] = d.get(s, 0) + 1
+
+    def _untrack(self, msgs: List[Message]) -> None:
+        d = self._pending_senders
+        for m in msgs:
+            s = m.sender
+            v = d.get(s)
+            if v is not None:
+                if v <= 1:
+                    del d[s]
+                else:
+                    d[s] = v - 1
+
+    def will_accept(self, headroom: int = 1) -> bool:
+        """Side-effect-free preflight of :meth:`offer` for the
+        publish-run ingest fast path: True only when the next
+        ``headroom`` QoS1/2 offers are GUARANTEED to be accepted (and
+        none would consume gate state like the shape probe).  False in
+        every ambiguous case, so a bailing caller reproduces the
+        per-message path byte-for-byte.  Only valid from the pipeline's
+        own loop with no awaits between the check and the offers."""
+        if not self._running:
+            return False
+        if self.olp is not None and self.olp.overloaded():
+            return False
+        if len(self._q) + headroom > self.queue_cap:
+            return False
+        idle = not self._q and not self._busy
+        if self.bypass_rate > 0 and idle \
+                and self._last_rate < self.bypass_rate:
+            return False
+        if self.shape_routes > 0 \
+                and self._avg_routes is not None \
+                and self._avg_routes <= self.shape_routes:
+            # the shape gate may bypass per-sender at any queue depth
+            return False
         return True
 
     def _batch_bound(self) -> int:
@@ -418,6 +472,14 @@ class FanoutPipeline:
                 log.exception("fanout fallback publish failed")
 
     def _process_chunk(self, batch: List[Message]) -> None:
+        try:
+            self._process_chunk_inner(batch)
+        finally:
+            # the chunk left pipeline custody (delivered, dropped or
+            # fallen back) — its senders may shape-bypass again
+            self._untrack(batch)
+
+    def _process_chunk_inner(self, batch: List[Message]) -> None:
         broker = self.broker
         hooks = broker.hooks
         # -- stage 1: publish hooks (retainer/rewrite/delayed ride this
@@ -535,7 +597,15 @@ class FanoutPipeline:
             sess = sessions.get(clientid)
             if sess is None:
                 continue
-            sends, dropped = sess.deliver(effs)
+            mu = sess.mutex
+            if mu is None:
+                sends, dropped = sess.deliver(effs)
+            else:
+                # shard-owned session (transport/shards.py): exclude
+                # the owning shard loop's ack handling for the window
+                # admission
+                with mu:
+                    sends, dropped = sess.deliver(effs)
             if sends:
                 n_sends = len(sends)
                 res.matched += n_sends

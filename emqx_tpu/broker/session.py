@@ -89,6 +89,12 @@ class Session:
         # counter table (broker.metrics), set by Broker.open_session;
         # sessions built directly in tests run unmetered
         self.metrics = None
+        # cross-loop guard (transport/shards.py): when the owning
+        # connection lives on a shard loop this holds the channel's
+        # RLock, and every main-loop toucher (fanout deliver, direct
+        # delivery) takes it; None (the default) keeps the single-loop
+        # paths lock-free
+        self.mutex = None
 
     # ------------------------------------------------------------------
     # subscriptions

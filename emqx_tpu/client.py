@@ -10,6 +10,7 @@ an asyncio queue (or a callback), with the full QoS2 receiver FSM.
 from __future__ import annotations
 
 import asyncio
+import struct
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -69,6 +70,18 @@ class Client:
         self._writer: Optional[asyncio.StreamWriter] = None
         self._pid_counter = 0
         self._pending: Dict[Tuple[int, int], asyncio.Future] = {}
+        self._pids: set = set()   # pids awaiting an ack (O(1) alloc)
+        # (topic, payload_len) → serialized v4 QoS1 PUBLISH head: a
+        # pipelined publisher re-sending one topic patches 2 pid bytes
+        # instead of paying a serializer pass per message (bytes
+        # identical to frame.serialize; v5/props/retain use the
+        # serializer as before)
+        self._pub_heads: Dict[Tuple[str, int], bytes] = {}
+        # while a feed batch is being handled, outbound pid-only acks
+        # (PUBACK/PUBREC/PUBCOMP) collect here and flush as ONE write
+        # per TCP read — the consumer-side analog of the broker's
+        # coalesced ack writes
+        self._ack_buf: Optional[bytearray] = None
         self._rel_pending: Dict[int, P.Publish] = {}  # QoS2 rx, awaiting REL
         self._tasks: List[asyncio.Task] = []
         self._closed = asyncio.Event()
@@ -188,15 +201,35 @@ class Client:
         """Pipelined QoS1 publish: send now, return the PUBACK future —
         the emqtt_bench async-publish mode.  The caller bounds its own
         inflight window by awaiting futures."""
-        pkt = P.Publish(
-            qos=1, retain=retain, topic=topic, payload=payload,
-            properties=properties or {},
-        )
-        pid = pkt.packet_id = self._next_pid()
+        pid = self._next_pid()
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         key = (P.PUBACK, pid)
         self._pending[key] = fut
-        fut.add_done_callback(lambda _f: self._pending.pop(key, None))
+        self._pids.add(pid)
+        fut.add_done_callback(
+            lambda _f: (self._pending.pop(key, None),
+                        self._pids.discard(pid)))
+        if self.proto_ver < 5 and not retain and not properties:
+            # template fast path: head cached per (topic, len), only
+            # the 2 pid bytes differ between repeats — identical bytes
+            # to the serializer
+            hkey = (topic, len(payload))
+            head = self._pub_heads.get(hkey)
+            if head is None:
+                tb = topic.encode("utf-8")
+                rl = 2 + len(tb) + 2 + len(payload)
+                head = (bytes((0x32,)) + F._enc_varint(rl)
+                        + struct.pack(">H", len(tb)) + tb)
+                self._pub_heads[hkey] = head
+            if self._writer is None:
+                raise MqttError("not connected")
+            self._writer.write(
+                head + struct.pack(">H", pid) + payload)
+            return fut
+        pkt = P.Publish(
+            qos=1, retain=retain, topic=topic, payload=payload,
+            properties=properties or {}, packet_id=pid,
+        )
         self._send(pkt)
         return fut
 
@@ -260,11 +293,14 @@ class Client:
 
     def _next_pid(self) -> int:
         """1..65535 with wraparound, skipping ids still awaiting an ack
-        (MQTT §2.2.1 packet identifiers are 16-bit)."""
+        (MQTT §2.2.1 packet identifiers are 16-bit).  O(1) via the
+        in-use pid set (the old per-call scan of ``_pending`` was
+        O(window) per publish — measurable at bench windows)."""
+        in_use = self._pids
         for _ in range(65535):
             self._pid_counter = (self._pid_counter % 65535) + 1
             pid = self._pid_counter
-            if not any(k[1] == pid for k in self._pending):
+            if pid not in in_use:
                 return pid
         raise MqttError("no free packet id")
 
@@ -276,11 +312,13 @@ class Client:
     async def _request(self, pkt: Any, key: Tuple[int, int], timeout: float):
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         self._pending[key] = fut
+        self._pids.add(key[1])
         self._send(pkt)
         try:
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(key, None)
+            self._pids.discard(key[1])
 
     async def _ping_loop(self) -> None:
         while True:
@@ -296,8 +334,17 @@ class Client:
                 data = await self._reader.read(65536)
                 if not data:
                     break
-                for pkt in self._parser.feed(data):
-                    self._handle(pkt)
+                pkts = self._parser.feed(data)
+                self._ack_buf = ab = bytearray()
+                try:
+                    for pkt in pkts:
+                        self._handle(pkt)
+                finally:
+                    self._ack_buf = None
+                    if ab and self._writer is not None:
+                        # every pid-only ack for this TCP read in ONE
+                        # write (bytes identical to per-packet sends)
+                        self._writer.write(bytes(ab))
         except (ConnectionError, asyncio.CancelledError):
             pass
         finally:
@@ -319,7 +366,7 @@ class Client:
             held = self._rel_pending.pop(pkt.packet_id, None)
             if held is not None:
                 self._emit(held)
-            self._send(P.PubAck(P.PUBCOMP, pkt.packet_id))
+            self._ack(0x70, pkt.packet_id)  # PUBCOMP
         elif t == P.DISCONNECT:
             self.disconnect_reason = getattr(pkt, "reason_code", 0)
         elif t == P.AUTH and pkt.reason_code != P.RC.CONTINUE_AUTHENTICATION:
@@ -348,16 +395,25 @@ class Client:
                 self._resolve((P.CONNACK, 0), MqttError(f"auth failed: {e}"))
         # PINGRESP: nothing to do
 
+    def _ack(self, head: int, pid: int) -> None:
+        """Send a pid-only ack (rc 0 — 4 bytes in every version):
+        coalesced into one write per TCP read while a feed batch is
+        open, identical bytes to a per-packet serialize+send."""
+        if self._ack_buf is not None:
+            self._ack_buf += bytes((head, 2, pid >> 8, pid & 0xFF))
+        else:
+            self._send(P.PubAck(head >> 4, pid))
+
     def _handle_publish(self, pkt: P.Publish) -> None:
         if pkt.qos == 0:
             self._emit(pkt)
         elif pkt.qos == 1:
             self._emit(pkt)
-            self._send(P.PubAck(P.PUBACK, pkt.packet_id))
+            self._ack(0x40, pkt.packet_id)  # PUBACK
         else:  # QoS2 receiver: hold until PUBREL (exactly-once)
             if pkt.packet_id not in self._rel_pending:
                 self._rel_pending[pkt.packet_id] = pkt
-            self._send(P.PubAck(P.PUBREC, pkt.packet_id))
+            self._ack(0x50, pkt.packet_id)  # PUBREC
 
     def _emit(self, pkt: P.Publish) -> None:
         msg = InboundMessage(

@@ -155,6 +155,12 @@ SCHEMA: Dict[str, Field] = {
     # while shape-bypassing, admit one probe message per interval so
     # the routes/message estimate tracks workload changes
     "broker.fanout.shape_probe": Field(0.25, duration),
+    # connection-plane sharding (transport/shards.py): N worker event
+    # loops with SO_REUSEPORT listeners on the default TCP port; 0 =
+    # single-loop.  Requires broker.fanout.enable (the shard fast path
+    # acks with the pipeline's semantics) and the plain-TCP fast_path
+    # listener; incompatible with the async advisory stage.
+    "broker.conn.shards": Field(0, int, lambda v: v >= 0),
     # supervision tree (supervise.py): restart-intensity window and
     # backoff for the node's long-lived background tasks.  Exceeding
     # max_restarts within the window escalates to an alarm + degraded

@@ -13,9 +13,11 @@ from .drift import RegistryDrift
 from .exceptions import NoSwallowedExceptions
 from .locks import AwaitUnderLock
 from .tasks import NoUnsupervisedTask
+from .threads import LoopThreadTaint
 
 ALL_RULES = [
     NoUnsupervisedTask,
+    LoopThreadTaint,
     NoBlockingInAsync,
     NoSwallowedExceptions,
     AwaitUnderLock,

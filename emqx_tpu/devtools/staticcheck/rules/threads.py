@@ -1,0 +1,130 @@
+"""loop-thread-taint: event-loop-affine calls inside worker-thread code.
+
+The connection-plane sharding refactor (transport/shards.py) moves code
+across loop/thread boundaries: functions handed to ``asyncio.to_thread``
+/ ``loop.run_in_executor`` / ``threading.Thread(target=...)`` run OFF
+the event loop that spawned them.  Inside such a function, the
+loop-affine asyncio APIs are bugs, not style:
+
+* ``asyncio.create_task`` / ``ensure_future`` — schedules onto whatever
+  loop the thread happens to see (usually raises, occasionally worse);
+* ``loop.call_soon`` / ``call_later`` / ``call_at`` — the explicitly
+  NOT-thread-safe scheduling calls (``call_soon_threadsafe`` is the
+  sanctioned marshal and is allowed);
+* ``asyncio.get_running_loop`` — raises in a plain worker thread.
+
+The rule resolves thread-entry targets per file: module-local ``def``
+names, ``self.method`` references (resolved within the enclosing
+class), and inline lambdas.  Only the DIRECT body of the entered
+function is checked — a thread target that legitimately bootstraps its
+own loop (``new_event_loop`` + ``run_forever``) delegates loop-affine
+work to code running *on* that loop, which this rule correctly leaves
+alone.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import FileContext, Rule, call_name
+
+__all__ = ["LoopThreadTaint"]
+
+# loop-affine call terminals that are invalid from a plain worker thread
+_AFFINE = {
+    "create_task", "ensure_future", "call_soon", "call_later",
+    "call_at", "get_running_loop",
+}
+
+# a thread target whose body contains one of these is bootstrapping its
+# own event loop — loop-affine calls after that are that loop's, not a
+# foreign one's
+_LOOP_BOOT = {"run_forever", "run_until_complete", "set_event_loop"}
+
+
+class LoopThreadTaint(Rule):
+    name = "loop-thread-taint"
+    description = ("event-loop-affine asyncio calls inside functions "
+                   "handed to worker threads")
+    node_types = (ast.Call,)
+
+    def begin_file(self, ctx: FileContext) -> None:
+        # (target_ref, spawn_desc, enclosing_class) per spawn site;
+        # resolved against the def maps in end_file
+        self._spawns: List[Tuple[ast.AST, str, Optional[str]]] = []
+        self._module_defs: Dict[str, ast.AST] = {}
+        self._method_defs: Dict[Tuple[str, str], ast.AST] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._module_defs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self._method_defs[(node.name, item.name)] = item
+
+    def visit(self, node: ast.Call, ctx: FileContext) -> None:
+        func = node.func
+        terminal = (func.attr if isinstance(func, ast.Attribute)
+                    else func.id if isinstance(func, ast.Name) else None)
+        target: Optional[ast.AST] = None
+        if terminal == "to_thread" and node.args:
+            target = node.args[0]
+        elif terminal == "run_in_executor" and len(node.args) >= 2:
+            target = node.args[1]
+        elif terminal == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+                    break
+        if target is None:
+            return
+        self._spawns.append(
+            (target, call_name(node), ctx.enclosing_class()))
+
+    def end_file(self, ctx: FileContext) -> None:
+        for target, spawn, cls in self._spawns:
+            fn = self._resolve(target, cls)
+            if fn is None:
+                continue
+            self._check_body(fn, spawn, ctx)
+
+    def _resolve(self, target: ast.AST,
+                 cls: Optional[str]) -> Optional[ast.AST]:
+        if isinstance(target, ast.Lambda):
+            return target
+        if isinstance(target, ast.Name):
+            return self._module_defs.get(target.id)
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" and cls is not None:
+            return self._method_defs.get((cls, target.attr))
+        return None
+
+    def _check_body(self, fn: ast.AST, spawn: str,
+                    ctx: FileContext) -> None:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        affine: List[ast.Call] = []
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                t = (f.attr if isinstance(f, ast.Attribute)
+                     else f.id if isinstance(f, ast.Name) else None)
+                if t in _LOOP_BOOT:
+                    # bootstraps its own loop: loop-affine calls in this
+                    # body belong to that loop
+                    return
+                if t in _AFFINE:
+                    affine.append(sub)
+        name = getattr(fn, "name", "<lambda>")
+        for call in affine:
+            ctx.report(
+                self.name, call,
+                f"{call_name(call)}() inside {name!r}, which runs on a "
+                f"worker thread (via {spawn}); event-loop-affine calls "
+                "from a foreign thread must marshal through "
+                "call_soon_threadsafe / run_coroutine_threadsafe",
+            )

@@ -33,6 +33,7 @@ Named injection points (the seams the batched stack crosses):
 ``bridge.sink``      BufferedWorker → Connector.send (raise / delay)
 ``exhook.call``      ExHook advisory gRPC call (raise / delay)
 ``fanout.drain``     fanout pipeline drain loop (raise / delay)
+``shard.handoff``    cross-loop shard↔main batched drain (drop / raise)
 ==================  =====================================================
 
 Scenario table: a list of rule dicts, evaluated in order per point; the
@@ -78,7 +79,7 @@ __all__ = [
 POINTS = (
     "transport.write", "frame.parse", "match.dispatch",
     "inflight.insert", "inflight.retry", "cluster.rpc",
-    "bridge.sink", "exhook.call", "fanout.drain",
+    "bridge.sink", "exhook.call", "fanout.drain", "shard.handoff",
 )
 
 _ACTIONS = ("raise", "drop", "delay", "dup")

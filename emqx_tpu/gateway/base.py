@@ -181,6 +181,17 @@ class Gateway:
                 raise _fi.InjectedFault("transport.write")
         self.transport.sendto(data, addr)
 
+    async def sweep_sleep(self, delay: float) -> None:
+        """Periodic-sweeper sleep that rides the node's hashed timer
+        wheel when the batched stack is on (one scheduled callback per
+        wheel tick covers every sweeper and connection tick), falling
+        back to ``asyncio.sleep`` on the default path."""
+        wheel = getattr(self.node, "timer_wheel", None)
+        if wheel is not None:
+            await wheel.sleep(delay)
+        else:
+            await asyncio.sleep(delay)
+
     def spawn_loop(self, name: str, factory: Any) -> Any:
         """Start a gateway-lifetime loop (sweeper, heartbeat) as a
         supervised child when the node carries a supervision tree — a
@@ -218,7 +229,13 @@ class GatewayManager:
         import time as _time
 
         while True:
-            await asyncio.sleep(self.RETRY_INTERVAL)
+            wheel = getattr(self.node, "timer_wheel", None)
+            if wheel is not None:
+                # the gateway retry sweep rides the node wheel like
+                # every other connection-plane timer
+                await wheel.sleep(self.RETRY_INTERVAL)
+            else:
+                await asyncio.sleep(self.RETRY_INTERVAL)
             now = _time.time()
             for gw in self.gateways.values():
                 for conn in list(gw.clients.values()):

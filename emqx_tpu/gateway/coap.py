@@ -368,7 +368,7 @@ class CoapGateway(Gateway):
 
     async def _sweep(self) -> None:
         while True:
-            await asyncio.sleep(10.0)
+            await self.sweep_sleep(10.0)
             now = time.monotonic()
             for addr, c in list(self.by_addr.items()):
                 if now - c.last_seen > self.idle_timeout:

@@ -483,7 +483,7 @@ class MqttSnGateway(Gateway):
 
     async def _sweep(self) -> None:
         while True:
-            await asyncio.sleep(5.0)
+            await self.sweep_sleep(5.0)
             now = time.monotonic()
             for addr, c in list(self.by_addr.items()):
                 if c.asleep:

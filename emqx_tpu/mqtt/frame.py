@@ -252,10 +252,16 @@ class Parser:
     to the per-packet path, byte-identical."""
 
     def __init__(self, max_packet_size: int = MAX_REMAINING_LEN,
-                 proto_ver: int = 4, ack_runs: bool = False):
+                 proto_ver: int = 4, ack_runs: bool = False,
+                 publish_runs: bool = False):
         self.max_packet_size = max_packet_size
         self.proto_ver = proto_ver
         self.ack_runs = ack_runs
+        # opt-in (rides the same batched-ingest datapath as ack_runs):
+        # contiguous QoS1/2 PUBLISHes of one feed pack into a
+        # PublishRun so the channel amortizes per-run costs.  Off, the
+        # emitted packet list is exactly the per-packet parse.
+        self.publish_runs = publish_runs
         self._buf = bytearray()
         # decoded fixed header of the (incomplete) head packet:
         # (remaining_len, hdr_end), valid until bytes are consumed from
@@ -296,6 +302,35 @@ class Parser:
                 break
             out.append(pkt)
             del buf[:consumed]
+        if self.publish_runs and len(out) > 1:
+            out = self._pack_publish_runs(out)
+        return out
+
+    @staticmethod
+    def _pack_publish_runs(pkts: List[Any]) -> List[Any]:
+        """Group contiguous same-QoS (1/2) PUBLISHes into PublishRun
+        objects (runs of one stay bare packets).  Pure regrouping: the
+        concatenation of the output, runs expanded, is the input."""
+        out: List[Any] = []
+        run: List[Any] = []
+        run_qos = 0
+        for pkt in pkts:
+            if type(pkt) is P.Publish and pkt.qos in (1, 2):
+                if run and pkt.qos != run_qos:
+                    out.append(P.PublishRun(run_qos, run)
+                               if len(run) > 1 else run[0])
+                    run = []
+                run_qos = pkt.qos
+                run.append(pkt)
+                continue
+            if run:
+                out.append(P.PublishRun(run_qos, run)
+                           if len(run) > 1 else run[0])
+                run = []
+            out.append(pkt)
+        if run:
+            out.append(P.PublishRun(run_qos, run)
+                       if len(run) > 1 else run[0])
         return out
 
     def _try_parse(self):

@@ -15,7 +15,7 @@ __all__ = [
     "PINGREQ", "PINGRESP", "DISCONNECT", "AUTH",
     "TYPE_NAMES", "Connect", "Connack", "Publish", "PubAck", "Subscribe",
     "Suback", "Unsubscribe", "Unsuback", "PingReq", "PingResp",
-    "Disconnect", "Auth", "Will", "AckRun",
+    "Disconnect", "Auth", "Will", "AckRun", "PublishRun",
     "RC",
 ]
 
@@ -150,6 +150,38 @@ class AckRun:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"AckRun({TYPE_NAMES.get(self.type)}, {self.pids})"
+
+
+class PublishRun:
+    """A contiguous run of same-QoS (1 or 2) inbound PUBLISHes from one
+    client, packed by the parser's publish-run fast path (the ingest
+    analog of :class:`AckRun`).  Each element is a fully parsed
+    :class:`Publish`; packing only marks the contiguity so the channel
+    can amortize the authz fold / alias resolution per run and answer
+    with one PUBACK/PUBREC burst.
+
+    Consumers that cannot take the run wholesale call :meth:`expand`
+    to recover the per-packet list the slow path would have produced."""
+
+    __slots__ = ("qos", "pkts")
+    type = PUBLISH
+
+    def __init__(self, qos: int, pkts: "List[Publish]") -> None:
+        self.qos = qos
+        self.pkts = pkts
+
+    def expand(self) -> "List[Publish]":
+        return self.pkts
+
+    def __len__(self) -> int:
+        return len(self.pkts)
+
+    def __eq__(self, other: Any) -> bool:
+        return (isinstance(other, PublishRun) and other.qos == self.qos
+                and other.pkts == self.pkts)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"PublishRun(qos={self.qos}, n={len(self.pkts)})"
 
 
 @dataclass

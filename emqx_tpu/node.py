@@ -210,6 +210,17 @@ class BrokerNode:
             max_messages_rate=cfg.get("limiter.max_messages_rate"),
             max_bytes_rate=cfg.get("limiter.max_bytes_rate"),
         )
+        # hashed timer wheel (transport/timerwheel.py), part of the one
+        # batched-stack opt-in: per-connection keepalive/retry ticks and
+        # gateway sweeps ride coarse buckets — one scheduled callback
+        # per tick regardless of connection count.  Flag off keeps the
+        # PR-5 per-connection loop.call_later timers byte-for-byte.
+        self.timer_wheel = None
+        self.shard_pool = None  # connection-plane shards (start())
+        if cfg.get("broker.fanout.enable"):
+            from .transport.timerwheel import TimerWheel
+
+            self.timer_wheel = TimerWheel()
         self.listeners = Listeners()
         self.connections: Dict[str, Connection] = {}  # clientid -> conn
         # every accepted connection, incl. pre-CONNECT ones — stop() must
@@ -494,9 +505,44 @@ class BrokerNode:
             # + ack-burst batching + write coalescing ride the same
             # flag, so the default datapath stays per-packet identical
             coalesce=bool(self.config.get("broker.fanout.enable")),
+            wheel=self.timer_wheel,
         )
         channel.conn = proto
         self._register_on_connect(channel, proto)
+        self._all_conns.add(proto)
+        return proto
+
+    def make_shard_protocol(self, shard):
+        """Accept-time factory for a SHARD-owned connection: runs on
+        the shard's loop, so everything it builds is shard-affine —
+        the ShardChannel marshals broker-touching packets back here
+        (transport/shards.py has the full thread-safety contract)."""
+        from .transport.proto_conn import MqttProtocol  # noqa: F401
+        from .transport.shards import ShardChannel, _ShardProtocol
+
+        pool = self.shard_pool
+        cfg = self.config
+        info = ConnInfo(listener="tcp:default")
+        channel = ShardChannel(
+            pool, shard, self.broker, self.cm,
+            conninfo={"listener": info.listener},
+            max_topic_alias=cfg.get("mqtt.max_topic_alias"),
+            max_inflight=cfg.get("mqtt.max_inflight"),
+            server_keepalive=(cfg.get("mqtt.server_keepalive") or None),
+        )
+        proto = _ShardProtocol(
+            channel,
+            conninfo=info,
+            max_packet_size=cfg.get("mqtt.max_packet_size"),
+            limiter=shard.limiter,
+            on_closed=pool.conn_closed,
+            intercept=None,
+            metrics=self.observed.metrics,
+            coalesce=True,
+            wheel=shard.wheel,
+        )
+        proto.shard = shard
+        channel.conn = proto
         self._all_conns.add(proto)
         return proto
 
@@ -519,6 +565,7 @@ class BrokerNode:
             # stream-path parity: the one batched-stack opt-in also
             # turns on ack-run ingest here (ws/quic/tcp-stream riders)
             coalesce=bool(self.config.get("broker.fanout.enable")),
+            wheel=self.timer_wheel,
         )
         channel.conn = conn  # takeover routing (connection.py)
         self._register_on_connect(channel, conn)
@@ -538,10 +585,26 @@ class BrokerNode:
 
     def _on_deliver(self, clientid: str, pubs: List[Any]) -> None:
         conn = self.connections.get(clientid)
-        if conn is not None:
-            conn.deliver(pubs)
-        else:
+        if conn is None:
             self.broker.outbox_put(clientid, pubs)
+            return
+        shard = getattr(conn, "shard", None)
+        if shard is not None:
+            # reverse delivery path: serialize + write on the OWNING
+            # shard loop (batched: one wakeup per drained burst)
+            shard.post_deliver(conn, pubs)
+        else:
+            conn.deliver(pubs)
+
+    def _kick_conn(self, conn, reason: str) -> None:
+        """Kick that respects loop affinity: a shard-owned connection
+        must be closed on its own loop."""
+        shard = getattr(conn, "shard", None)
+        if shard is None:
+            conn.kick(reason)
+        elif shard.alive():
+            shard.post(lambda: conn.kick(reason))
+        # dead shard: its cleanup already closed the socket
 
     def kick_client(self, clientid: str) -> bool:
         """Management 'kick out client' (emqx_mgmt:kickout_client).
@@ -550,7 +613,7 @@ class BrokerNode:
         chan = self.cm.kick(clientid)  # discards the broker session too
         conn = self.connections.pop(clientid, None)
         if conn is not None:
-            conn.kick("kicked by management")
+            self._kick_conn(conn, "kicked by management")
         self._disconnected_at.pop(clientid, None)
         return chan is not None or conn is not None or had_session
 
@@ -650,6 +713,7 @@ class BrokerNode:
             await self.telemetry.start()
         self._start_ocsp()
         await self._start_quic()
+        self._maybe_shard()
         await self.listeners.start_all()
         self._running = True
         self._jobs.append(self.supervisor.start_child(
@@ -657,6 +721,35 @@ class BrokerNode:
         if self.lag_probe is not None:
             self._jobs.append(self.supervisor.start_child(
                 "olp.lag_probe", self.lag_probe.run))
+
+    def _maybe_shard(self) -> None:
+        """Attach the connection-plane shard pool to the default TCP
+        listener when configured and compatible (plain TCP fast path,
+        batched stack on, no async advisory stage — see
+        transport/shards.py for the exact contract)."""
+        cfg = self.config
+        n = int(cfg.get("broker.conn.shards") or 0)
+        if n <= 0:
+            return
+        if not cfg.get("broker.fanout.enable"):
+            log.warning("broker.conn.shards needs broker.fanout.enable; "
+                        "sharding disabled")
+            return
+        if self._wants_intercept():
+            log.warning("broker.conn.shards is incompatible with the "
+                        "async advisory stage (exhook/cluster/tpu/async "
+                        "auth); sharding disabled")
+            return
+        lst = self.listeners.get("tcp:default")
+        if lst is None or lst.proto_factory is None \
+                or lst.ssl_context is not None:
+            log.warning("broker.conn.shards needs the plain-TCP "
+                        "fast_path listener; sharding disabled")
+            return
+        from .transport.shards import ShardPool
+
+        self.shard_pool = ShardPool(self, n)
+        lst.shard_pool = self.shard_pool
 
     async def _start_quic(self) -> None:
         """MQTT-over-QUIC listener (quicer analog): the in-repo
@@ -1028,10 +1121,12 @@ class BrokerNode:
         # returns, so the order matters.  _all_conns covers sockets that
         # never completed CONNECT (absent from self.connections).
         for conn in list(self._all_conns):
-            conn.kick("node shutdown")
+            self._kick_conn(conn, "node shutdown")
         # give connections a beat to flush their goodbyes
         await asyncio.sleep(0)
         await self.listeners.stop_all()
+        if self.timer_wheel is not None:
+            self.timer_wheel.close()
 
     async def _housekeeping(self) -> None:
         """Periodic jobs: delayed-publish firing, retained expiry, session
@@ -1040,6 +1135,15 @@ class BrokerNode:
         while self._running:
             await asyncio.sleep(interval)
             try:
+                if self.timer_wheel is not None:
+                    # aggregate wheel-resident timer gauge: main-loop
+                    # wheel + every shard wheel (racy cross-thread int
+                    # reads — a gauge, not an invariant)
+                    conns = len(self.timer_wheel)
+                    if self.shard_pool is not None:
+                        conns += self.shard_pool.wheel_conns()
+                    self.observed.metrics.set(
+                        "broker.timer.wheel_conns", conns)
                 if self.delayed is not None:
                     self.delayed.tick()
                 if self.retainer is not None:

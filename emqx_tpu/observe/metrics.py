@@ -18,7 +18,7 @@ from typing import Dict, Iterable, List, Optional
 
 __all__ = [
     "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
-    "ROBUSTNESS_METRIC_NAMES",
+    "ROBUSTNESS_METRIC_NAMES", "CONNPLANE_METRIC_NAMES",
 ]
 
 # -- the reference's fixed counter names, grouped as in emqx_metrics.erl [U]
@@ -112,6 +112,16 @@ FANOUT_METRIC_NAMES: List[str] = [
     "broker.ack.run_parsed", "broker.qos2.batch",
 ]
 
+# -- connection plane (transport/shards.py + transport/timerwheel.py).
+# shards is the live worker-loop count (set), wheel_conns the aggregate
+# timers resident in the hashed wheels (set, sampled by housekeeping),
+# publish_runs accumulates one inc per packed same-client QoS1/2
+# PUBLISH run the ingest fast path consumed.
+CONNPLANE_METRIC_NAMES: List[str] = [
+    "broker.conn.shards", "broker.timer.wheel_conns",
+    "broker.ingest.publish_runs",
+]
+
 # -- supervision tree (supervise.py) + overload shedding on the batched
 # delivery path (broker/olp.py wired into broker/fanout.py).  restarts
 # accumulates; degraded is the CURRENT degraded-child count (set).
@@ -139,6 +149,7 @@ class Metrics:
         self._c.update({n: 0 for n in TPU_METRIC_NAMES})
         self._c.update({n: 0 for n in FANOUT_METRIC_NAMES})
         self._c.update({n: 0 for n in ROBUSTNESS_METRIC_NAMES})
+        self._c.update({n: 0 for n in CONNPLANE_METRIC_NAMES})
         if extra:
             self._c.update({n: 0 for n in extra})
 

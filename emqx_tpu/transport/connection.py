@@ -106,18 +106,24 @@ class Connection:
         limiter: Optional[LimiterGroup] = None,
         on_closed=None,
         coalesce: bool = False,
+        wheel=None,
     ) -> None:
         self.stream = stream
         self.channel = channel
         self.conninfo = conninfo or ConnInfo()
         self.recv_buf = recv_buf
         # stream-path parity with the batched proto datapath: the same
-        # opt-in enables the parser's ack-run fast path (packed AckRun
-        # consumption below) — off, parsing and handling stay the
-        # per-packet path, byte-identical
+        # opt-in enables the parser's ack-run + publish-run fast paths
+        # (packed AckRun/PublishRun consumption below) — off, parsing
+        # and handling stay the per-packet path, byte-identical
         self.coalesce = coalesce
         self.parser = F.Parser(max_packet_size=max_packet_size,
-                               ack_runs=coalesce)
+                               ack_runs=coalesce, publish_runs=coalesce)
+        # hashed timer wheel (transport/timerwheel.py): when provided,
+        # the keepalive/retry tick rides a shared bucket (one scheduled
+        # callback per tick for every connection) instead of a
+        # per-connection sleep loop task
+        self.wheel = wheel
         self.limiter = limiter
         self.on_closed = on_closed
         # optional async advisory stage (exhook): awaited per packet before
@@ -147,7 +153,9 @@ class Connection:
     async def run(self) -> None:
         """Serve until close; returns after the socket is torn down."""
         writer = asyncio.ensure_future(self._writer_loop())
-        ticker = asyncio.ensure_future(self._tick_loop())
+        ticker = (self.wheel.call_repeat(self.TICK_S, self._tick_once)
+                  if self.wheel is not None
+                  else asyncio.ensure_future(self._tick_loop()))
         try:
             await self._reader_loop()
         except Exception:
@@ -203,6 +211,63 @@ class Connection:
                             self.channel.handle_deliver(refill))
                     if self._closing.is_set():
                         return
+                    continue
+                if type(pkt) is P.PublishRun:
+                    if self.channel.state != "connected" \
+                            or self.intercept is not None:
+                        # pre-CONNECT replay / advisory stage present:
+                        # per-packet handling, byte-identical (the
+                        # intercept must see each PUBLISH)
+                        for sub in pkt.expand():
+                            self.pkts_in += 1
+                            if self.intercept is not None \
+                                    and self.channel.state == "connected":
+                                actions = await self.intercept(
+                                    self.channel, sub)
+                                if (self._closing.is_set() or
+                                        self.channel.state
+                                        == "disconnected"):
+                                    return
+                                if actions is not None:
+                                    self.channel.last_rx = time.time()
+                                    self._run_actions(actions)
+                                    if self._closing.is_set():
+                                        return
+                                    continue
+                            self._run_actions(self.channel.handle_in(sub))
+                            if self._closing.is_set():
+                                return
+                        continue
+                    reply, acts, rest = \
+                        self.channel.handle_publish_run(pkt)
+                    consumed = len(pkt.pkts) - len(rest)
+                    if consumed:
+                        self.pkts_in += consumed
+                        if (
+                            msg_bucket is not None
+                            and not msg_bucket.unlimited
+                        ):
+                            ok, wait = msg_bucket.consume(float(consumed))
+                            if not ok:
+                                await asyncio.sleep(wait)
+                    if reply:
+                        self._outq.put_nowait((reply, consumed))
+                    if acts:
+                        self._run_actions(acts)
+                    if self._closing.is_set():
+                        return
+                    for sub in rest:
+                        self.pkts_in += 1
+                        if (
+                            msg_bucket is not None
+                            and not msg_bucket.unlimited
+                        ):
+                            ok, wait = msg_bucket.consume(1.0)
+                            if not ok:
+                                await asyncio.sleep(wait)
+                        self._run_actions(self.channel.handle_in(sub))
+                        if self._closing.is_set():
+                            return
                     continue
                 self.pkts_in += 1
                 if (
@@ -316,13 +381,21 @@ class Connection:
     async def _tick_loop(self) -> None:
         while not self._closing.is_set():
             await asyncio.sleep(self.TICK_S)
-            self._run_actions(self.channel.check_keepalive())
-            self._run_actions(self.channel.retry_deliveries())
-            if not self._closing.is_set():
-                # resends queued to a live writer: commit the DUP
-                # clones / age clocks; a closed connection leaves the
-                # entries due for the session's next owner
-                self.channel.retry_commit()
+            self._tick_once()
+
+    def _tick_once(self) -> None:
+        """One keepalive/retry pass — synchronous, so it runs either
+        from the per-connection sleep loop or as a timer-wheel bucket
+        entry (one scheduled callback per tick for ALL connections)."""
+        if self._closing.is_set():
+            return
+        self._run_actions(self.channel.check_keepalive())
+        self._run_actions(self.channel.retry_deliveries())
+        if not self._closing.is_set():
+            # resends queued to a live writer: commit the DUP
+            # clones / age clocks; a closed connection leaves the
+            # entries due for the session's next owner
+            self.channel.retry_commit()
 
     def info(self) -> dict:
         ch = self.channel

@@ -46,6 +46,7 @@ class Listener:
         ws_path: str = "/mqtt",
         reuse_port: bool = False,
         proto_factory: Optional[Callable[[ConnInfo], object]] = None,
+        shard_pool=None,
     ) -> None:
         self.name = name
         self.kind = kind
@@ -64,16 +65,44 @@ class Listener:
         # per-connection tasks; used for plain TCP when the node
         # provides a factory
         self.proto_factory = proto_factory
+        # connection-plane sharding (transport/shards.py): when a pool
+        # is attached, the pool's per-shard SO_REUSEPORT listeners do
+        # the accepting (one per worker loop) and this listener object
+        # is the aggregate view — counts, caps and info() roll up the
+        # per-shard numbers
+        self.shard_pool = shard_pool
         self._conn_rate = TokenBucket(max_conn_rate)
         self._server: Optional[asyncio.AbstractServer] = None
-        self.current_connections = 0
+        self._main_conns = 0
         self.shed_count = 0
 
     @property
+    def current_connections(self) -> int:
+        """Live connections across the main-loop server AND every
+        shard (each shard counts its own accepts on its own loop; the
+        sum is a racy-but-monotonic-enough aggregate, exactly like
+        esockd's per-acceptor counters)."""
+        pool = self.shard_pool
+        return self._main_conns + (pool.conn_count()
+                                   if pool is not None else 0)
+
+    @property
     def running(self) -> bool:
-        return self._server is not None
+        if self._server is not None:
+            return True
+        pool = self.shard_pool
+        return pool is not None and pool.running
 
     async def start(self) -> None:
+        if self.shard_pool is not None and self.kind == "tcp" \
+                and self.ssl_context is None \
+                and self.proto_factory is not None:
+            self.shard_pool.listener = self
+            self.port = await self.shard_pool.start(self.host, self.port)
+            log.info("listener %s (%s) sharded ×%d on %s:%d", self.name,
+                     self.kind, self.shard_pool.n, self.host, self.port)
+            return
+        self.shard_pool = None  # pool unusable for this listener kind
         if self.proto_factory is not None and self.kind == "tcp" \
                 and self.ssl_context is None:
             loop = asyncio.get_running_loop()
@@ -94,6 +123,8 @@ class Listener:
                  self.host, self.port)
 
     async def stop(self) -> None:
+        if self.shard_pool is not None:
+            await self.shard_pool.stop()
         if self._server is not None:
             self._server.close()
             try:
@@ -122,13 +153,13 @@ class Listener:
         orig_lost = proto.connection_lost
 
         def made(transport):
-            self.current_connections += 1
+            self._main_conns += 1
             proto._listener_counted = True
             orig_made(transport)
 
         def lost(exc):
             if getattr(proto, "_listener_counted", False):
-                self.current_connections -= 1
+                self._main_conns -= 1
             orig_lost(exc)
 
         proto.connection_made = made
@@ -144,7 +175,7 @@ class Listener:
             self.shed_count += 1
             writer.close()
             return
-        self.current_connections += 1
+        self._main_conns += 1
         set_nodelay(writer.get_extra_info("socket"))
         info = ConnInfo(
             peername=writer.get_extra_info("peername"),
@@ -168,7 +199,7 @@ class Listener:
             log.exception("listener %s: connection handler crashed", self.name)
             writer.close()
         finally:
-            self.current_connections -= 1
+            self._main_conns -= 1
 
     def info(self) -> dict:
         return {
@@ -179,6 +210,8 @@ class Listener:
             "max_connections": self.max_connections,
             "current_connections": self.current_connections,
             "shed_count": self.shed_count,
+            **({"shards": self.shard_pool.info()}
+               if self.shard_pool is not None else {}),
         }
 
 

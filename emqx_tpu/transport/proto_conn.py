@@ -62,14 +62,22 @@ class MqttProtocol(asyncio.Protocol):
         intercept=None,
         metrics=None,
         coalesce: bool = True,
+        wheel=None,
     ) -> None:
         self.channel = channel
         self.conninfo = conninfo or ConnInfo()
-        # ack-run fast path only on the zero-task datapath: with an
-        # advisory stage the ordered queue handles packets one at a
-        # time, so runs would just be re-expanded
+        # ack-run + publish-run fast paths only on the zero-task
+        # datapath: with an advisory stage the ordered queue handles
+        # packets one at a time, so runs would just be re-expanded
         self.parser = F.Parser(max_packet_size=max_packet_size,
-                               ack_runs=coalesce and intercept is None)
+                               ack_runs=coalesce and intercept is None,
+                               publish_runs=coalesce and intercept is None)
+        # hashed timer wheel (transport/timerwheel.py): when the node
+        # provides one, the per-connection keepalive/retry tick rides a
+        # coarse bucket — one scheduled callback per wheel tick for ALL
+        # connections — instead of one loop.call_later per connection
+        # per second.  None keeps the PR-5 per-connection timer exactly.
+        self.wheel = wheel
         self.limiter = limiter
         self.on_closed = on_closed
         self.intercept = intercept
@@ -122,11 +130,19 @@ class MqttProtocol(asyncio.Protocol):
             # path so async round trips can't reorder handling
             self._queue = asyncio.Queue()
             self._worker = asyncio.ensure_future(self._worker_loop())
-        # jitter the first tick: connections accepted in one storm
-        # would otherwise fire thousands of keepalive timers in the
-        # same millisecond every second — a recurring latency spike
-        self._tick_handle = asyncio.get_running_loop().call_later(
-            self.TICK_S * (0.5 + (id(self) % 1024) / 1024.0), self._tick)
+        if self.wheel is not None:
+            # wheel mode: the storm problem the jitter below works
+            # around does not exist — all due connections run inside
+            # ONE bucket callback per tick, so alignment is free
+            self._tick_handle = self.wheel.call_later(
+                self.TICK_S, self._tick)
+        else:
+            # jitter the first tick: connections accepted in one storm
+            # would otherwise fire thousands of keepalive timers in the
+            # same millisecond every second — a recurring latency spike
+            self._tick_handle = asyncio.get_running_loop().call_later(
+                self.TICK_S * (0.5 + (id(self) % 1024) / 1024.0),
+                self._tick)
 
     def data_received(self, data: bytes) -> None:
         self.bytes_in += len(data)
@@ -203,6 +219,56 @@ class MqttProtocol(asyncio.Protocol):
                     i += 1
                     if self._closed:
                         return
+                    continue
+                if type(pkt) is P.PublishRun:
+                    if channel.state != "connected":
+                        # pre-CONNECT publishes are a protocol error:
+                        # replay per-packet so the close reason matches
+                        # the slow path exactly
+                        for sub in pkt.expand():
+                            self.pkts_in += 1
+                            self._run_actions(channel.handle_in(sub))
+                            if self._closed:
+                                return
+                        i += 1
+                        continue
+                    # contiguous same-client QoS1/2 PUBLISH run: ONE
+                    # amortized authz/alias pass, one PUBACK/PUBREC
+                    # burst through the open write batch.  `rest` is
+                    # whatever the fast path could not guarantee
+                    # (pipeline refusing) — replayed per-packet,
+                    # byte-identical to the slow path.
+                    reply, acts, rest = channel.handle_publish_run(pkt)
+                    consumed = len(pkt.pkts) - len(rest)
+                    if consumed:
+                        self.pkts_in += consumed
+                        if self._msg_bucket is not None \
+                                and not self._msg_bucket.unlimited:
+                            ok, wait = self._msg_bucket.consume(
+                                float(consumed))
+                            if not ok:
+                                self._pause_read_for(wait)
+                        if self.metrics is not None:
+                            self.metrics.inc("broker.ingest.publish_runs")
+                    if reply:
+                        self._send_raw(reply, consumed)
+                    if acts:
+                        self._run_actions(acts)
+                    if self._closed:
+                        return
+                    for sub in rest:
+                        self.pkts_in += 1
+                        if (
+                            self._msg_bucket is not None
+                            and not self._msg_bucket.unlimited
+                        ):
+                            ok, wait = self._msg_bucket.consume(1.0)
+                            if not ok:
+                                self._pause_read_for(wait)
+                        self._run_actions(channel.handle_in(sub))
+                        if self._closed:
+                            return
+                    i += 1
                     continue
                 if (
                     pkt.type == P.PUBACK
@@ -435,8 +501,23 @@ class MqttProtocol(asyncio.Protocol):
                 if old_conn is not None and old_conn is not self:
                     old_conn._run_actions(acts)
 
+    # pid-only ack heads whose wire shape is fixed 4 bytes (PUBREL
+    # carries its mandatory 0b0010 flags)
+    _ACK_HEADS = {P.PUBACK: P.PUBACK << 4, P.PUBREC: P.PUBREC << 4,
+                  P.PUBREL: (P.PUBREL << 4) | 2, P.PUBCOMP: P.PUBCOMP << 4}
+
     def _send_pkt(self, pkt: Any) -> None:
         if self._closed or self.transport is None:
+            return
+        head = self._ACK_HEADS.get(pkt.type) if self.coalesce else None
+        if head is not None and type(pkt) is P.PubAck and (
+            self.channel.proto_ver != 5
+            or (pkt.reason_code == 0 and not pkt.properties)
+        ):
+            # serializer-free pid-only ack: identical 4 bytes (a v3/v4
+            # wire never carries the rc; v5 rc-0/no-props is pid-only)
+            pid = pkt.packet_id
+            self._send_raw(bytes((head, 2, pid >> 8, pid & 0xFF)), 1)
             return
         try:
             data = F.serialize(pkt, ver=self.channel.proto_ver)
@@ -570,8 +651,12 @@ class MqttProtocol(asyncio.Protocol):
         except Exception:
             log.exception("tick failed (%s)", self.conninfo.peername)
         if not self._closed:
-            self._tick_handle = asyncio.get_running_loop().call_later(
-                self.TICK_S, self._tick)
+            if self.wheel is not None:
+                self._tick_handle = self.wheel.call_later(
+                    self.TICK_S, self._tick)
+            else:
+                self._tick_handle = asyncio.get_running_loop().call_later(
+                    self.TICK_S, self._tick)
 
     def info(self) -> dict:
         ch = self.channel

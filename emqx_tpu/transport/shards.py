@@ -1,0 +1,760 @@
+"""Connection-plane sharding: N worker event loops behind SO_REUSEPORT.
+
+Behavioral reference: esockd's acceptor pool + per-connection process
+model [U] (SURVEY.md §3.1) — the reference scales its connection plane
+by running many acceptor/connection processes over one listen socket.
+Here each **shard** is a worker thread running its own asyncio loop
+with its own ``SO_REUSEPORT`` listener on the broker port (the kernel
+load-balances accepted connections across shards), its own
+:class:`~emqx_tpu.transport.timerwheel.TimerWheel` and its own limiter
+group.  The shard loop runs everything per-connection: accept, frame
+parse, keepalive/retry ticks, ack handling, QoS window state and the
+serialize+write of deliveries — the costs that used to crowd the main
+loop's ready queue at 1k+ real clients (BENCH_r05 config1: e2e p50
+2.8 s of queueing on ONE loop).
+
+What stays on the main loop is the broker core: routing tables,
+session registry, hooks, the fanout pipeline, retained/delayed
+services.  The two planes meet at two **batched MPSC handoffs**
+(:class:`Handoff`): many shard threads → one ``call_soon_threadsafe``
+per drain into the main loop (publish offers, CONNECT/SUBSCRIBE
+marshals, close notifications), and one inbox per shard for the
+reverse delivery path (routed publishes posted back to the owning
+shard, batched the same way).  ``call_soon_threadsafe`` fires once per
+drain, not once per message.
+
+Thread-safety model (the part the ``loop-thread-taint`` staticcheck
+rule polices):
+
+* **broker state is main-loop-only.**  Every packet that touches it
+  (CONNECT/auth, SUBSCRIBE/UNSUBSCRIBE, DISCONNECT, AUTH, anything
+  pre-CONNECT, and PUBLISH whenever ``client.authorize`` hooks exist)
+  marshals through the handoff and runs ``Channel.handle_in`` on the
+  main loop; the resulting actions post back to the owning shard.
+  While a marshal is in flight the shard queues that connection's
+  subsequent packets behind it — per-connection packet order is
+  preserved exactly.
+* **session state is mutex-protected.**  A shard-owned
+  :class:`~emqx_tpu.broker.session.Session` is touched from its shard
+  (acks, QoS2 receiver state, retry peeks) and from the main loop
+  (fanout ``Session.deliver``): both sides take the channel's
+  ``mutex`` (an ``RLock``; ``Session.mutex`` is the same object).
+  Lock hold times are one handled packet batch — microseconds — and
+  neither side ever blocks on another lock while holding it.
+* **publishes are affine-free.**  The shard fast path builds the
+  :class:`Message`, acks, and hands the message to the main loop
+  (fanout offer / ``Broker.publish`` fallback) through the handoff —
+  one wire-level contract: PUBACK means "broker took responsibility",
+  exactly the fanout pipeline's semantics (shards require
+  ``broker.fanout.enable``).
+
+Shards register as supervised children (``broker.shard.<i>``) with the
+existing degraded-escalation policy: a crashed/killed shard loop closes
+its sockets, the supervisor respawns a fresh loop + listener on the
+same port, and the surviving shards keep serving — the chaos suite
+kills one mid-QoS1-traffic and asserts exactly-once delivery holds.
+
+Not supported with shards on (the pool refuses to start and the
+listener falls back to the single-loop path): the async advisory stage
+(exhook / cluster takeover / TPU prefetch / async auth backends) and
+TLS listeners.  Plain sync auth chains work — publishes then take the
+marshal path (``hooks.has("client.authorize")`` checked per connect).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import faultinject as _fi
+from .. import topic as T
+from ..broker.channel import Channel
+from ..broker.message import make_message
+from ..mqtt import frame as F
+from ..mqtt import packet as P
+from .connection import ConnInfo
+from .proto_conn import MqttProtocol
+from .timerwheel import TimerWheel
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Handoff", "Shard", "ShardPool", "ShardChannel"]
+
+
+class Handoff:
+    """Batched MPSC cross-loop queue: any thread may ``put``; items
+    drain on the consumer loop with ONE ``call_soon_threadsafe`` per
+    drain (not per item).  The ``shard.handoff`` chaos seam rides the
+    drain: an injected ``drop`` loses one drained batch, ``raise``
+    surfaces :class:`InjectedFault` to the consumer's error handling."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop,
+                 consume: Callable[[List[Any]], None],
+                 name: str = "handoff") -> None:
+        self._loop = loop
+        self._consume = consume
+        self.name = name
+        self._dq: deque = deque()
+        self._armed = False
+        self._lock = threading.Lock()
+        self.drains = 0
+        self.items = 0
+
+    def put(self, item: Any) -> None:
+        with self._lock:
+            self._dq.append(item)
+            if self._armed:
+                return
+            self._armed = True
+        try:
+            self._loop.call_soon_threadsafe(self._drain)
+        except RuntimeError:
+            # consumer loop is gone (shard died / node stopping): the
+            # items are dropped with it — QoS1/2 heals via retry, QoS0
+            # is best-effort by contract
+            with self._lock:
+                self._armed = False
+                self._dq.clear()
+
+    def depth(self) -> int:
+        return len(self._dq)
+
+    def _drain(self) -> None:
+        with self._lock:
+            items = list(self._dq)
+            self._dq.clear()
+            self._armed = False
+        if not items:
+            return
+        self.drains += 1
+        self.items += len(items)
+        if _fi._injector is not None:
+            act = _fi._injector.act("shard.handoff")
+            if act == "drop":
+                return
+            if act == "raise":
+                raise _fi.InjectedFault("shard.handoff")
+        self._consume(items)
+
+
+# ---------------------------------------------------------------------------
+# the shard-side channel
+# ---------------------------------------------------------------------------
+
+# packet types a connected shard channel handles locally (session-affine
+# state only; no broker tables)
+_SHARD_LOCAL = frozenset((
+    P.PUBACK, P.PUBREC, P.PUBREL, P.PUBCOMP, P.PINGREQ,
+))
+
+
+class ShardChannel(Channel):
+    """Channel variant whose broker-touching packets marshal to the
+    main loop (see module docstring).  Lives on a shard loop."""
+
+    def __init__(self, pool: "ShardPool", shard: "Shard",
+                 *args: Any, **kw: Any) -> None:
+        super().__init__(*args, **kw)
+        self.pool = pool
+        self.shard = shard
+        self.mutex = threading.RLock()
+        # FIFO of packets parked behind an in-flight marshal (None =
+        # no marshal in flight) — preserves per-connection order across
+        # the shard/main boundary
+        self._marshal_q: Optional[deque] = None
+        # True while no client.authorize hooks exist (refreshed per
+        # marshal on the main loop): publishes then skip the hook fold
+        # entirely and stay on the shard fast path
+        self._fast_pub = False
+        self._close_posted = False
+
+    # -- shard-loop surface -------------------------------------------
+
+    def handle_in(self, pkt: Any) -> List[Any]:
+        if self._marshal_q is not None:
+            self._marshal_q.append(pkt)
+            return []
+        if self.state == "connected":
+            t = pkt.type
+            if t == P.PUBLISH and self._fast_pub:
+                with self.mutex:
+                    return super().handle_in(pkt)
+            if t in _SHARD_LOCAL:
+                with self.mutex:
+                    return super().handle_in(pkt)
+        # CONNECT / SUBSCRIBE / UNSUBSCRIBE / DISCONNECT / AUTH,
+        # anything pre-CONNECT, and PUBLISH under an authz chain: runs
+        # on the main loop; replies return via the shard inbox
+        self._marshal_q = deque()
+        self.pool.marshal(self, pkt)
+        return []
+
+    def handle_ack_run(self, run: Any):
+        with self.mutex:
+            return super().handle_ack_run(run)
+
+    def handle_puback_batch(self, pkts: List[Any]):
+        with self.mutex:
+            return super().handle_puback_batch(pkts)
+
+    def handle_publish_run(self, run: Any):
+        if self.state != "connected" or not self._fast_pub \
+                or self._marshal_q is not None:
+            # per-packet discipline: rides the marshal queue ordering
+            return b"", [], run.pkts
+        with self.mutex:
+            sess = self.session
+            qos = run.qos
+            ack_head = P.PUBREC << 4 if qos == 2 else P.PUBACK << 4
+            valid: Dict[str, bool] = {}
+            out = bytearray()
+            actions: List[Any] = []
+            offer = self.pool.offer
+            for pkt in run.pkts:
+                topic = self._resolve_alias(pkt)
+                if topic is None:
+                    actions.append(("close", "topic alias invalid"))
+                    return bytes(out), actions, []
+                ok = valid.get(topic)
+                if ok is None:
+                    ok = valid[topic] = T.is_valid(topic, "name")
+                pid = pkt.packet_id
+                if not ok:
+                    if self.proto_ver == 5:
+                        out += F.serialize(P.PubAck(
+                            P.PUBREC if qos == 2 else P.PUBACK, pid,
+                            P.RC.TOPIC_NAME_INVALID), ver=5)
+                    else:
+                        out += bytes((ack_head, 2, pid >> 8, pid & 0xFF))
+                    continue
+                msg = make_message(
+                    self.clientid, topic, pkt.payload, qos=qos,
+                    retain=pkt.retain, properties=dict(pkt.properties),
+                )
+                if qos == 2:
+                    st = sess.publish_qos2(pid, msg)
+                    if st == "full" and self.proto_ver == 5:
+                        out += F.serialize(P.PubAck(
+                            P.PUBREC, pid, P.RC.QUOTA_EXCEEDED), ver=5)
+                        continue
+                    if st == "ok":
+                        offer(msg)
+                else:
+                    offer(msg)
+                out += bytes((ack_head, 2, pid >> 8, pid & 0xFF))
+            return bytes(out), actions, []
+
+    def check_keepalive(self, now: Optional[float] = None):
+        with self.mutex:
+            return super().check_keepalive(now)
+
+    def retry_deliveries(self, now: Optional[float] = None):
+        with self.mutex:
+            return super().retry_deliveries(now)
+
+    def retry_wire_batch(self, now: Optional[float] = None):
+        with self.mutex:
+            return super().retry_wire_batch(now)
+
+    def retry_commit(self) -> None:
+        with self.mutex:
+            super().retry_commit()
+
+    def _handle_publish(self, pkt: P.Publish) -> List[Any]:
+        """Shard fast path (only reached with ``_fast_pub``, i.e. no
+        ``client.authorize`` hooks): alias/validity checks and the QoS2
+        receiver transition run here; the message crosses to the main
+        loop through the batched handoff, which offers it to the fanout
+        pipeline (or ``Broker.publish`` on refusal).  Ack semantics are
+        the fanout pipeline's: ack now, deliver from the batch."""
+        topic = self._resolve_alias(pkt)
+        if topic is None:
+            return [("close", "topic alias invalid")]
+        if not T.is_valid(topic, "name"):
+            return self._puback_for(pkt, P.RC.TOPIC_NAME_INVALID)
+        msg = make_message(
+            self.clientid, topic, pkt.payload, qos=pkt.qos,
+            retain=pkt.retain, properties=dict(pkt.properties),
+        )
+        if pkt.qos == 2:
+            st = self.session.publish_qos2(pkt.packet_id, msg)
+            if st == "full":
+                return [("send", P.PubAck(P.PUBREC, pkt.packet_id,
+                                          P.RC.QUOTA_EXCEEDED))]
+            if st == "ok":
+                self.pool.offer(msg)
+            return [("send", P.PubAck(P.PUBREC, pkt.packet_id))]
+        self.pool.offer(msg)
+        if pkt.qos == 1:
+            return [("send", P.PubAck(P.PUBACK, pkt.packet_id))]
+        return []
+
+    def handle_close(self, reason: str = "closed") -> None:
+        """Transport died on the shard loop: the will publish, session
+        close and hooks all touch broker state → marshal."""
+        if self._close_posted:
+            return
+        self._close_posted = True
+        self.pool.post_close(self, reason)
+
+    # -- shard-loop continuation after a marshal round trip ------------
+
+    def marshal_done(self, conn: Any, actions: List[Any]) -> None:
+        """Runs on the shard loop with the main-loop verdict: apply the
+        actions, then replay any packets that queued behind the
+        marshal (stopping again if one of them re-marshals)."""
+        batching = conn is not None and conn.coalesce \
+            and conn.transport is not None
+        if batching:
+            conn._batching = True
+        try:
+            if conn is not None:
+                conn._run_actions(actions)
+            q = self._marshal_q
+            self._marshal_q = None
+            while q:
+                pkt = q.popleft()
+                acts = self.handle_in(pkt)
+                if conn is not None and not conn._closed:
+                    conn._run_actions(acts)
+                if self._marshal_q is not None:
+                    # re-marshalled: the rest stays parked behind it
+                    self._marshal_q.extend(q)
+                    break
+        finally:
+            if batching:
+                conn._flush_writes()
+
+
+class _ShardProtocol(MqttProtocol):
+    """MqttProtocol + handoff backpressure: when the shard→main handoff
+    backs up past the high-water mark, pause this socket briefly — the
+    kernel buffer (and the peer's window) absorbs the burst instead of
+    an unbounded cross-thread queue."""
+
+    shard: Optional["Shard"] = None
+
+    def data_received(self, data: bytes) -> None:
+        super().data_received(data)
+        shard = self.shard
+        if shard is not None and \
+                shard.pool.handoff.depth() > shard.pool.HANDOFF_HIGH_WATER:
+            self._pause_read_for(0.02)
+
+
+# ---------------------------------------------------------------------------
+# shards
+# ---------------------------------------------------------------------------
+
+
+class Shard:
+    """One worker thread: its own event loop, SO_REUSEPORT listener,
+    timer wheel, limiter group and delivery inbox."""
+
+    def __init__(self, pool: "ShardPool", index: int) -> None:
+        self.pool = pool
+        self.index = index
+        self.name = f"broker.shard.{index}"
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.thread: Optional[threading.Thread] = None
+        self.wheel: Optional[TimerWheel] = None
+        self.inbox: Optional[Handoff] = None
+        self.limiter = None
+        self.server: Optional[asyncio.AbstractServer] = None
+        self.conns: set = set()
+        self.accepted = 0
+        self.port = 0
+        self._started: Optional[threading.Event] = None
+        self._dead_evt: Optional[asyncio.Event] = None  # main-loop event
+        self._stopping = False
+        self._child = None
+
+    # -- lifecycle (called on the MAIN loop) ---------------------------
+
+    async def start(self, host: str, port: int) -> int:
+        self._stopping = False
+        self._dead_evt = asyncio.Event()
+        self._started = threading.Event()
+        self.conns.clear()  # a respawn starts with a clean registry
+        self.loop = asyncio.new_event_loop()
+        self.inbox = Handoff(self.loop, self._consume_inbox,
+                             name=f"{self.name}.inbox")
+        self.thread = threading.Thread(
+            target=self._thread_main, name=self.name, daemon=True)
+        self.thread.start()
+        ok = await asyncio.to_thread(self._started.wait, 5.0)
+        if not ok:
+            raise RuntimeError(f"{self.name}: loop did not start")
+        fut = asyncio.run_coroutine_threadsafe(
+            self._bind(host, port), self.loop)
+        self.port = await asyncio.wrap_future(fut)
+        return self.port
+
+    async def stop(self) -> None:
+        self._stopping = True
+        loop, thread = self.loop, self.thread
+        if loop is None or thread is None or not thread.is_alive():
+            return
+        try:
+            loop.call_soon_threadsafe(loop.stop)
+        except RuntimeError:
+            pass
+        await asyncio.to_thread(thread.join, 5.0)
+
+    def kill(self) -> bool:
+        """Chaos surface: stop the shard loop from outside, as a crash
+        would.  The supervised child notices and respawns."""
+        loop, thread = self.loop, self.thread
+        if loop is None or thread is None or not thread.is_alive():
+            return False
+        try:
+            loop.call_soon_threadsafe(loop.stop)
+        except RuntimeError:
+            return False
+        return True
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+    async def _supervised_run(self) -> None:
+        """The supervised-child body (main loop): (re)spawn the worker
+        thread if it is down, then watch for its death."""
+        if not self.alive():
+            await self.start(self.pool.host, self.pool.port)
+        await self._dead_evt.wait()
+        if not self._stopping:
+            raise RuntimeError(f"{self.name}: shard loop exited")
+
+    # -- worker thread -------------------------------------------------
+
+    def _thread_main(self) -> None:
+        loop = self.loop
+        asyncio.set_event_loop(loop)
+        self.wheel = TimerWheel()
+        from ..broker.limiter import LimiterGroup
+        cfg = self.pool.config
+        self.limiter = LimiterGroup(
+            max_conn_rate=cfg.get("limiter.max_conn_rate"),
+            max_messages_rate=cfg.get("limiter.max_messages_rate"),
+            max_bytes_rate=cfg.get("limiter.max_bytes_rate"),
+        ) if cfg is not None else None
+        self._started.set()
+        try:
+            loop.run_forever()
+        finally:
+            try:
+                loop.run_until_complete(self._cleanup())
+            except Exception:
+                log.exception("%s: cleanup failed", self.name)
+            try:
+                loop.close()
+            except Exception:
+                log.debug("%s: loop close failed", self.name, exc_info=True)
+            self.pool.notify_dead(self)
+
+    async def _bind(self, host: str, port: int) -> int:
+        # SO_REUSEPORT: all shards bind the broker port; the kernel
+        # load-balances accepted connections across their loops (the
+        # esockd acceptor-pool analog listener.py's comment gestures at)
+        self.server = await self.loop.create_server(
+            self._make_protocol, host, port, reuse_port=True)
+        socks = self.server.sockets or []
+        return socks[0].getsockname()[1] if socks else port
+
+    async def _cleanup(self) -> None:
+        if self.wheel is not None:
+            self.wheel.close()
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+        for conn in list(self.conns):
+            try:
+                conn._do_close("shard stopped")
+            except Exception:
+                log.debug("%s: conn close failed", self.name, exc_info=True)
+        # one beat so transports flush their goodbyes before close
+        await asyncio.sleep(0)
+
+    def _make_protocol(self):
+        pool = self.pool
+        if not pool.accept_allowed():
+            from .listener import _ShedProtocol
+            return _ShedProtocol()
+        proto = pool.make_protocol(self)
+        self.accepted += 1
+        orig_made = proto.connection_made
+        orig_lost = proto.connection_lost
+
+        def made(transport):
+            self.conns.add(proto)
+            orig_made(transport)
+
+        def lost(exc):
+            self.conns.discard(proto)
+            orig_lost(exc)
+
+        proto.connection_made = made
+        proto.connection_lost = lost
+        return proto
+
+    # -- cross-loop surface (any thread) -------------------------------
+
+    def post(self, fn: Callable[[], Any]) -> None:
+        """Run ``fn`` on the shard loop (batched with deliveries)."""
+        self.inbox.put(("call", fn))
+
+    def post_deliver(self, conn: Any, pubs: List[Any]) -> None:
+        """Reverse delivery path: routed publishes for a shard-owned
+        connection, serialized+written on the shard loop."""
+        self.inbox.put(("dlv", conn, pubs))
+
+    def post_actions(self, chan: ShardChannel, conn: Any,
+                     actions: List[Any]) -> None:
+        self.inbox.put(("acts", chan, conn, actions))
+
+    def _consume_inbox(self, items: List[Any]) -> None:
+        """Shard-loop drain of the inbox — one callback per batch."""
+        for it in items:
+            tag = it[0]
+            try:
+                if tag == "dlv":
+                    conn = it[1]
+                    if not conn._closed:
+                        conn.deliver(it[2])
+                elif tag == "acts":
+                    it[1].marshal_done(it[2], it[3])
+                else:  # "call"
+                    it[1]()
+            except Exception:
+                log.exception("%s: inbox item failed", self.name)
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "index": self.index, "alive": self.alive(),
+            "connections": len(self.conns), "accepted": self.accepted,
+            "wheel": (self.wheel.info() if self.wheel is not None
+                      else None),
+        }
+
+
+class ShardPool:
+    """The N shards of one listener + the shard→main handoff + the
+    main-loop marshal handlers.  Owned by the node, attached to the
+    TCP listener."""
+
+    HANDOFF_HIGH_WATER = 8192
+
+    def __init__(self, node: Any, n: int) -> None:
+        self.node = node
+        self.config = getattr(node, "config", None)
+        self.n = n
+        self.host = ""
+        self.port = 0
+        self.shards = [Shard(self, i) for i in range(n)]
+        self.handoff: Optional[Handoff] = None
+        self._main_loop: Optional[asyncio.AbstractEventLoop] = None
+        self.running = False
+
+    # -- lifecycle (main loop) ----------------------------------------
+
+    async def start(self, host: str, port: int) -> int:
+        """Bind every shard's SO_REUSEPORT listener (shard 0 resolves
+        ``:0`` to a concrete port for the rest), register the shards as
+        supervised children, and open the handoff."""
+        self._main_loop = asyncio.get_running_loop()
+        self.handoff = Handoff(self._main_loop,
+                               self._consume, name="shard.handoff")
+        self.host = host
+        self.port = await self.shards[0].start(host, port)
+        for shard in self.shards[1:]:
+            await shard.start(host, self.port)
+        sup = getattr(self.node, "supervisor", None)
+        if sup is not None:
+            for shard in self.shards:
+                shard._child = sup.start_child(
+                    shard.name, shard._supervised_run,
+                    restart="permanent", drain=shard.stop)
+        self.running = True
+        metrics = self._metrics()
+        if metrics is not None:
+            metrics.set("broker.conn.shards", self.n)
+        log.info("connection plane sharded: %d loops on %s:%d",
+                 self.n, host, self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if not self.running:
+            return
+        self.running = False
+        for shard in self.shards:
+            if shard._child is not None:
+                child, shard._child = shard._child, None
+                try:
+                    await child.stop()   # cancels the watcher, drains
+                except Exception:
+                    log.debug("shard child stop failed", exc_info=True)
+            else:
+                await shard.stop()
+        metrics = self._metrics()
+        if metrics is not None:
+            metrics.set("broker.conn.shards", 0)
+
+    def _metrics(self):
+        observed = getattr(self.node, "observed", None)
+        return getattr(observed, "metrics", None)
+
+    def notify_dead(self, shard: Shard) -> None:
+        """Called from a dying worker thread (its loop already closed):
+        flip the main-loop death event so the supervised watcher
+        restarts the shard (or, on orderly stop, just returns)."""
+        evt = shard._dead_evt
+        loop = getattr(self, "_main_loop", None)
+        if evt is None or loop is None:
+            return
+        try:
+            loop.call_soon_threadsafe(evt.set)
+        except RuntimeError:
+            pass  # main loop already gone (interpreter shutdown)
+
+    # -- accept-side helpers (called on shard loops) -------------------
+
+    def accept_allowed(self) -> bool:
+        listener = getattr(self, "listener", None)
+        if listener is None:
+            return True
+        # racy cross-thread read of the aggregate count: sheds are
+        # approximate by design, exactly like esockd's per-acceptor view
+        if listener.current_connections >= listener.max_connections:
+            listener.shed_count += 1
+            return False
+        return True
+
+    def make_protocol(self, shard: Shard):
+        return self.node.make_shard_protocol(shard)
+
+    def conn_count(self) -> int:
+        return sum(len(s.conns) for s in self.shards)
+
+    # -- shard → main handoff ------------------------------------------
+
+    def offer(self, msg: Any) -> None:
+        self.handoff.put(("pub", msg))
+
+    def marshal(self, chan: ShardChannel, pkt: Any) -> None:
+        self.handoff.put(("chan", chan, pkt))
+
+    def post_close(self, chan: ShardChannel, reason: str) -> None:
+        self.handoff.put(("close", chan, reason))
+
+    def conn_closed(self, proto: Any) -> None:
+        """proto_conn's ``on_closed`` callback (runs on the shard
+        loop): the registry cleanup happens on the main loop."""
+        self.handoff.put(("closed", proto))
+
+    def _consume(self, items: List[Any]) -> None:
+        """Main-loop drain: contiguous publish runs batch into the
+        fanout pipeline; marshals/closes interleave in FIFO order so
+        per-connection ordering is preserved end to end."""
+        pubs: List[Any] = []
+        for it in items:
+            tag = it[0]
+            if tag == "pub":
+                pubs.append(it[1])
+                continue
+            if pubs:
+                self._publish_batch(pubs)
+                pubs = []
+            try:
+                if tag == "chan":
+                    self._main_handle(it[1], it[2])
+                elif tag == "close":
+                    self._main_close(it[1], it[2])
+                elif tag == "closed":
+                    self._main_conn_closed(it[1])
+            except Exception:
+                log.exception("shard handoff item failed (%s)", tag)
+        if pubs:
+            self._publish_batch(pubs)
+
+    def _publish_batch(self, msgs: List[Any]) -> None:
+        broker = self.node.broker
+        fanout = broker.fanout
+        for m in msgs:
+            try:
+                if fanout is None or not fanout.offer(m):
+                    broker.publish(m)
+            except Exception:
+                log.exception("shard publish failed")
+
+    def _main_handle(self, chan: ShardChannel, pkt: Any) -> None:
+        """One marshaled packet, handled with full broker access on the
+        main loop; the verdict posts back to the owning shard."""
+        node = self.node
+        with chan.mutex:
+            try:
+                actions = Channel.handle_in(chan, pkt)
+            except Exception:
+                log.exception("marshaled packet handling failed")
+                actions = [("close", "internal error")]
+            sess = chan.session
+            if sess is not None and sess.mutex is None:
+                # main-loop deliveries and shard-loop acks now exclude
+                # each other through the channel's own lock
+                sess.mutex = chan.mutex
+            chan._fast_pub = not node.broker.hooks.has("client.authorize")
+        out: List[Any] = []
+        for act, arg in actions:
+            if act == "takeover":
+                self._takeover(arg)
+                continue
+            out.append((act, arg))
+        conn = chan.conn
+        cid = chan.clientid
+        if cid is not None and chan.state == "connected" \
+                and node.connections.get(cid) is not conn:
+            node.connections[cid] = conn
+        chan.shard.post_actions(chan, conn, out)
+
+    def _takeover(self, old_chan: Any) -> None:
+        """A shard client's CONNECT displaced ``old_chan``: run the
+        goodbye on whichever loop owns the old connection."""
+        old_conn = getattr(old_chan, "conn", None)
+        old_shard = getattr(old_chan, "shard", None)
+        if old_shard is not None and old_shard.alive():
+            def _go():
+                with old_chan.mutex:
+                    acts = old_chan.handle_takeover()
+                if old_conn is not None:
+                    old_conn._run_actions(acts)
+            old_shard.post(_go)
+            return
+        acts = old_chan.handle_takeover()
+        if old_conn is not None:
+            old_conn._run_actions(acts)
+
+    def _main_close(self, chan: ShardChannel, reason: str) -> None:
+        with chan.mutex:
+            Channel.handle_close(chan, reason)
+
+    def _main_conn_closed(self, proto: Any) -> None:
+        node = self.node
+        node._all_conns.discard(proto)
+        cid = proto.channel.clientid
+        if cid is not None and node.connections.get(cid) is proto:
+            del node.connections[cid]
+
+    # -- observability -------------------------------------------------
+
+    def wheel_conns(self) -> int:
+        total = 0
+        for s in self.shards:
+            w = s.wheel
+            if w is not None:
+                total += len(w)
+        return total
+
+    def info(self) -> List[Dict[str, Any]]:
+        return [s.info() for s in self.shards]

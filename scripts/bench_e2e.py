@@ -249,20 +249,30 @@ def main(argv=None) -> dict:
     args = ap.parse_args(argv)
 
     from bench import (
-        _fanout_e2e_size, _qos1_e2e_size, _qos2_e2e_size, bench_fanout_e2e,
-        bench_qos1_e2e, bench_qos2_e2e,
+        _config1_size, _config1_sweep_size, _fanout_e2e_size,
+        _qos1_e2e_size, _qos2_e2e_size, bench_config1,
+        bench_config1_sweep, bench_fanout_e2e, bench_qos1_e2e,
+        bench_qos2_e2e,
     )
 
     size = _fanout_e2e_size(args.smoke)
     qsize = _qos1_e2e_size(args.smoke)
     q2size = _qos2_e2e_size(args.smoke)
+    c1size = _config1_size(args.smoke)
+    c1ssize = _config1_sweep_size(args.smoke)
     if args.duration is not None:
         size["duration"] = args.duration
         qsize["duration"] = args.duration
         q2size["duration"] = args.duration
+        c1size["duration"] = args.duration
+        c1ssize["duration"] = args.duration
     out = bench_fanout_e2e(**size)
     out["qos1"] = bench_qos1_e2e(**qsize)
     out["qos2"] = bench_qos2_e2e(**q2size)
+    # connection-plane tracking numbers (PR 6): real-client config1
+    # flag-off/flag-on A/B + the client-count sweep at constant load
+    out["config1"] = bench_config1(**c1size)
+    out["config1_sweep"] = bench_config1_sweep(**c1ssize)
     if args.chaos:
         out["chaos"] = chaos_smoke()
     print(json.dumps(out, indent=2))

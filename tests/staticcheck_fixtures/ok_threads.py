@@ -1,0 +1,41 @@
+"""Fixture: loop-thread-taint must NOT flag any of these."""
+
+import asyncio
+import threading
+import time
+
+
+def _blocking_io(path):
+    # plain blocking work is exactly what worker threads are for
+    with open(path, "rb") as f:
+        return f.read()
+
+
+async def offload(path):
+    return await asyncio.to_thread(_blocking_io, path)
+
+
+class Notifier:
+    def __init__(self, loop, evt):
+        self.loop = loop
+        self.evt = evt
+        self.thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        # marshalling through call_soon_threadsafe is the sanctioned
+        # cross-thread entry point
+        time.sleep(0.1)
+        self.loop.call_soon_threadsafe(self.evt.set)
+
+
+class ShardLike:
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._thread_main)
+
+    def _thread_main(self):
+        # bootstraps its OWN loop: loop-affine calls in here belong to
+        # that loop, not a foreign one
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(print)
+        self.loop.run_forever()

@@ -1,0 +1,27 @@
+"""Fixture: loop-thread-taint MUST flag these (3 findings)."""
+
+import asyncio
+import threading
+
+
+def _compute():
+    # (1) create_task from a to_thread worker: schedules onto a loop
+    # this thread does not run
+    asyncio.create_task(asyncio.sleep(0))
+    return 42
+
+
+async def offload():
+    return await asyncio.to_thread(_compute)
+
+
+class Worker:
+    def __init__(self, loop):
+        self.loop = loop
+        self.thread = threading.Thread(target=self._run)
+
+    def _run(self):
+        # (2) call_later is not thread-safe; (3) get_running_loop
+        # raises in a plain worker thread
+        self.loop.call_later(1.0, print)
+        asyncio.get_running_loop()

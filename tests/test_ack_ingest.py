@@ -420,3 +420,162 @@ def test_retry_does_not_commit_when_flush_raises():
         assert msg.dup is True
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# publish-run ingest fast path (PR 6)
+# ---------------------------------------------------------------------------
+
+def _pub_stream():
+    return b"".join([
+        F.serialize(P.Publish(qos=1, topic="a/b", packet_id=1,
+                              payload=b"x1")),
+        F.serialize(P.Publish(qos=1, topic="a/b", packet_id=2,
+                              payload=b"x2")),
+        F.serialize(P.Publish(qos=1, topic="a/c", packet_id=3,
+                              payload=b"x3")),
+        F.serialize(P.Publish(qos=2, topic="a/b", packet_id=4,
+                              payload=b"x4")),
+        F.serialize(P.Publish(qos=2, topic="a/b", packet_id=5,
+                              payload=b"x5")),
+        F.serialize(P.Publish(qos=0, topic="a/b", payload=b"x6")),
+        F.serialize(P.PubAck(P.PUBACK, 9)),
+        F.serialize(P.Publish(qos=1, topic="a/d", packet_id=6,
+                              payload=b"x7")),
+    ])
+
+
+def _expand_all(pkts):
+    out = []
+    for p in pkts:
+        if type(p) in (P.AckRun, P.PublishRun):
+            out.extend(p.expand())
+        else:
+            out.append(p)
+    return out
+
+
+def test_parser_publish_runs_pack_contiguous_same_qos():
+    data = _pub_stream()
+    fast = F.Parser(publish_runs=True).feed(data)
+    runs = [p for p in fast if type(p) is P.PublishRun]
+    # qos1×2 | qos1×1 (bare: run of one stays a packet) | qos2×2 …
+    assert [(r.qos, [pp.packet_id for pp in r.pkts]) for r in runs] == [
+        (1, [1, 2, 3]), (2, [4, 5]),
+    ]
+    assert _expand_all(fast) == F.Parser().feed(data)
+
+
+def test_parser_publish_runs_equal_slow_path_at_every_split_boundary():
+    data = _pub_stream()
+    want = F.Parser().feed(data)
+    for cut in range(len(data) + 1):
+        p = F.Parser(publish_runs=True, ack_runs=True)
+        got = p.feed(data[:cut]) + p.feed(data[cut:])
+        assert _expand_all(got) == want, cut
+
+
+def test_parser_publish_runs_off_by_default():
+    data = _pub_stream()
+    assert not any(type(p) is P.PublishRun
+                   for p in F.Parser().feed(data))
+    assert not any(type(p) is P.PublishRun
+                   for p in F.Parser(ack_runs=True).feed(data))
+
+
+def _pipeline_node(coalesce):
+    """Broker + live fanout pipeline + proto conn — the publish-run
+    fast path engages only when the pipeline guarantees acceptance."""
+    from emqx_tpu.broker import FanoutPipeline
+
+    conn, t, m, b = _mk_proto(coalesce, max_inflight=64)
+    p = FanoutPipeline(b, metrics=m, window_s=0.0)
+    return conn, t, m, b, p
+
+
+def test_publish_run_burst_acks_match_per_packet_bytes():
+    """Flag-on with a live pipeline: a QoS1 publish burst answers with
+    one coalesced PUBACK burst whose bytes equal the per-packet acks,
+    the run counts in broker.ingest.publish_runs, and every message is
+    delivered by the pipeline."""
+    async def main():
+        conn, t, m, b, pipe = _pipeline_node(True)
+        await pipe.start()
+        b.fanout = pipe
+        got = []
+        sess, _ = b.open_session("watcher", max_inflight=64)
+        from emqx_tpu.broker.session import SubOpts
+        b.subscribe("watcher", "w/#", SubOpts())
+        prev = b.on_deliver
+        b.on_deliver = lambda cid, pubs: (
+            got.extend(p.msg.payload for p in pubs)
+            if cid == "watcher" else prev(cid, pubs))
+        conn.data_received(F.serialize(P.Connect(
+            proto_ver=4, clientid="c", clean_start=True, keepalive=0)))
+        t.writes.clear()
+        conn.data_received(b"".join(
+            F.serialize(P.Publish(qos=1, topic="w/t", packet_id=10 + i,
+                                  payload=b"m%d" % i))
+            for i in range(6)))
+        # ONE write: the 6 PUBACKs, byte-identical to per-packet acks
+        assert len(t.writes) == 1
+        assert t.writes[0] == b"".join(
+            F.serialize(P.PubAck(P.PUBACK, 10 + i)) for i in range(6))
+        assert m.get("broker.ingest.publish_runs") == 1
+        deadline = asyncio.get_event_loop().time() + 5
+        while len(got) < 6 and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.005)
+        assert got == [b"m%d" % i for i in range(6)]
+        await pipe.stop()
+
+    run(main())
+
+
+def test_publish_run_qos2_state_matches_per_packet():
+    """A QoS2 run drives publish_qos2 per packet and answers one PUBREC
+    burst; the receiver's awaiting-rel table matches the per-packet
+    path's."""
+    async def main():
+        conn, t, m, b, pipe = _pipeline_node(True)
+        await pipe.start()
+        b.fanout = pipe
+        conn.data_received(F.serialize(P.Connect(
+            proto_ver=4, clientid="c", clean_start=True, keepalive=0)))
+        t.writes.clear()
+        conn.data_received(b"".join(
+            F.serialize(P.Publish(qos=2, topic="z/t", packet_id=20 + i,
+                                  payload=b"m%d" % i))
+            for i in range(4)))
+        assert t.writes[0] == b"".join(
+            F.serialize(P.PubAck(P.PUBREC, 20 + i)) for i in range(4))
+        assert sorted(conn.channel.session.awaiting_rel) == [
+            20, 21, 22, 23]
+        # duplicate pids in a later run do NOT re-publish (exactly-once)
+        t.writes.clear()
+        conn.data_received(b"".join(
+            F.serialize(P.Publish(qos=2, topic="z/t", packet_id=20 + i,
+                                  payload=b"dup" ))
+            for i in range(2)))
+        assert t.writes[0] == b"".join(
+            F.serialize(P.PubAck(P.PUBREC, 20 + i)) for i in range(2))
+        await pipe.stop()
+
+    run(main())
+
+
+def test_publish_run_bails_to_per_packet_without_pipeline():
+    """No fanout pipeline: handle_publish_run consumes nothing (rest =
+    the whole run) so the caller replays per-packet — already proven
+    byte-identical by the _stream_session tests; here we pin the
+    contract directly."""
+    b = Broker()
+    cm = ConnectionManager(b)
+    chan = Channel(b, cm)
+    chan.state = "connected"
+    chan.clientid = "c"
+    run_pkt = P.PublishRun(1, [
+        P.Publish(qos=1, topic="t", packet_id=1, payload=b"a"),
+        P.Publish(qos=1, topic="t", packet_id=2, payload=b"b"),
+    ])
+    reply, acts, rest = chan.handle_publish_run(run_pkt)
+    assert reply == b"" and acts == [] and rest == run_pkt.pkts

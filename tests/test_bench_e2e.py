@@ -44,6 +44,19 @@ def test_bench_e2e_smoke_delivers_everything():
             assert sec["delivery_ratio"] == 1.0, (section, path, sec)
             assert sec["duplicates"] == 0, (section, path, sec)
         assert out[section]["speedup"] > 0
+    # connection-plane sections (PR 6): config1 real-client A/B (full
+    # protocol clients over the sharded + timer-wheel flag-on node)
+    # delivers everything on both sides, and every client-count sweep
+    # row completes with ratio 1.0
+    for path in ("per_message", "pipeline"):
+        sec = out["config1"][path]
+        assert sec["sent"] > 0, (path, sec)
+        assert sec["delivery_ratio"] == 1.0, (path, sec)
+    assert out["config1"]["shards"] >= 1
+    for row in out["config1_sweep"]:
+        assert row["sent"] > 0, row
+        assert row["delivery_ratio"] == 1.0, row
+        assert row["e2e_p99_us"] is not None, row
     # chaos smoke: one kill-and-recover cycle per subsystem, each
     # healing via supervisor restart with delivery intact
     for name, section in out["chaos"].items():

@@ -1,0 +1,294 @@
+"""Connection-plane sharding (transport/shards.py): e2e delivery over
+a multi-shard node, the marshal ordering discipline, cross-shard
+takeover, listener aggregation, config gating, the batched handoff
+contract, and the ``shard.handoff`` chaos seam."""
+
+import asyncio
+
+import pytest
+
+from emqx_tpu import faultinject
+from emqx_tpu.client import Client
+from emqx_tpu.config import Config
+from emqx_tpu.faultinject import FaultInjector
+from emqx_tpu.mqtt import frame as F
+from emqx_tpu.mqtt import packet as P
+from emqx_tpu.node import BrokerNode
+from emqx_tpu.transport.shards import Handoff
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def until(pred, timeout=8.0):
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while not pred() and loop.time() < deadline:
+        await asyncio.sleep(0.005)
+    return pred()
+
+
+async def start_node(shards=2, **cfg_puts):
+    cfg = Config(file_text=(
+        'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+        'broker.fanout.enable = true\n'
+    ))
+    cfg.put("tpu.enable", False)
+    cfg.put("broker.conn.shards", shards)
+    cfg.put("supervisor.backoff_base", 0.01)
+    for k, v in cfg_puts.items():
+        cfg.put(k, v)
+    node = BrokerNode(cfg)
+    await node.start()
+    return node, node.listeners.all()[0].port
+
+
+# ---------------------------------------------------------------------------
+# e2e over shards
+# ---------------------------------------------------------------------------
+
+def test_sharded_node_qos1_exactly_once_and_aggregated_info():
+    async def main():
+        node, port = await start_node(shards=2)
+        try:
+            assert node.shard_pool is not None
+            assert node.observed.metrics.get("broker.conn.shards") == 2
+            sub = Client(clientid="s1", port=port)
+            await sub.connect()
+            await sub.subscribe("t/#", qos=1)
+            pub = Client(clientid="p1", port=port)
+            await pub.connect()
+            for i in range(50):
+                await pub.publish("t/x", b"m%d" % i, qos=1)
+            msgs = []
+            while len(msgs) < 50:
+                msgs += await sub.recv_many(timeout=5)
+            assert len(msgs) == 50
+            assert [m.payload for m in msgs] == [b"m%d" % i
+                                                 for i in range(50)]
+            assert not any(m.dup for m in msgs)
+            info = node.listeners.all()[0].info()
+            # per-shard counts aggregate on the listener
+            assert info["current_connections"] == 2
+            assert sum(s["connections"] for s in info["shards"]) == 2
+            assert all(s["alive"] for s in info["shards"])
+            await sub.disconnect()
+            await pub.disconnect()
+            assert await until(
+                lambda: node.listeners.all()[0].current_connections == 0)
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_sharded_node_qos2_exactly_once():
+    async def main():
+        node, port = await start_node(shards=2)
+        try:
+            sub = Client(clientid="s1", port=port)
+            await sub.connect()
+            await sub.subscribe("q/#", qos=2)
+            pub = Client(clientid="p1", port=port)
+            await pub.connect()
+            for i in range(20):
+                await pub.publish("q/x", b"m%d" % i, qos=2)
+            msgs = []
+            while len(msgs) < 20:
+                msgs += await sub.recv_many(timeout=5)
+            assert sorted(m.payload for m in msgs) == sorted(
+                b"m%d" % i for i in range(20))
+            assert len(msgs) == 20
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_pipelined_connect_subscribe_publish_in_one_write():
+    """The marshal-queue ordering discipline: CONNECT + SUBSCRIBE +
+    PUBLISH pipelined into one TCP segment must apply strictly in
+    order (subscribe lands before the publish routes)."""
+    async def main():
+        node, port = await start_node(shards=2)
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(
+                F.serialize(P.Connect(proto_ver=4, clientid="pipe",
+                                      clean_start=True, keepalive=0))
+                + F.serialize(P.Subscribe(
+                    packet_id=1, topic_filters=[("loop/me", {"qos": 0})]))
+                + F.serialize(P.Publish(qos=1, topic="loop/me",
+                                        packet_id=7, payload=b"self"))
+            )
+            parser = F.Parser()
+            got = []
+            while not any(p.type == P.PUBLISH for p in got):
+                data = await asyncio.wait_for(r.read(65536), 5)
+                assert data
+                got += parser.feed(data)
+            types = [p.type for p in got]
+            # CONNACK, SUBACK, PUBACK, then our own publish delivered
+            assert types.index(P.CONNACK) < types.index(P.SUBACK)
+            assert types.index(P.SUBACK) < types.index(P.PUBLISH)
+            pub = [p for p in got if p.type == P.PUBLISH][0]
+            assert pub.payload == b"self"
+            w.close()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_cross_shard_takeover():
+    """A reconnect with the same clientid displaces the old connection
+    even when the two land on different shards (the takeover routes to
+    the owning loop)."""
+    async def main():
+        node, port = await start_node(shards=2)
+        try:
+            c1 = Client(clientid="dup", port=port)
+            await c1.connect()
+            c2 = Client(clientid="dup", port=port)
+            await c2.connect()
+            # old connection is closed by the broker
+            assert await until(lambda: not c1.connected)
+            # the new one is live
+            await c2.subscribe("tk/1", qos=0)
+            assert await until(
+                lambda: node.connections.get("dup") is not None)
+            await c2.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_shards_disabled_without_fanout_flag():
+    async def main():
+        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", False)
+        cfg.put("broker.conn.shards", 2)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            assert node.shard_pool is None   # flag off: PR-5 datapath
+            c = Client(clientid="c", port=node.listeners.all()[0].port)
+            await c.connect()
+            await c.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# the batched handoff
+# ---------------------------------------------------------------------------
+
+def test_handoff_batches_one_wakeup_per_drain():
+    async def main():
+        loop = asyncio.get_running_loop()
+        got = []
+        h = Handoff(loop, got.append, name="t")
+        calls = []
+        orig = loop.call_soon_threadsafe
+
+        def spy(cb, *a):
+            calls.append(cb)
+            return orig(cb, *a)
+
+        loop.call_soon_threadsafe = spy
+        try:
+            for i in range(100):
+                h.put(i)
+            # one scheduled drain for the whole burst
+            assert len(calls) == 1
+            await asyncio.sleep(0.01)
+            assert got and got[0] == list(range(100))
+            assert h.drains == 1 and h.items == 100
+        finally:
+            loop.call_soon_threadsafe = orig
+
+    run(main())
+
+
+def test_handoff_chaos_seam_drop_and_heal():
+    """An injected ``shard.handoff`` drop loses one drained batch (the
+    QoS0-style loss the seam models); subsequent traffic flows."""
+    async def main():
+        node, port = await start_node(shards=1)
+        try:
+            sub = Client(clientid="s", port=port)
+            await sub.connect()
+            await sub.subscribe("c/#", qos=0)
+            pub = Client(clientid="p", port=port)
+            await pub.connect()
+            await pub.publish("c/x", b"pre", qos=1)
+            got = [await sub.recv(timeout=5)]
+            faultinject.install(FaultInjector(rules=[
+                {"point": "shard.handoff", "action": "drop", "times": 1},
+            ]))
+            try:
+                await pub.publish("c/x", b"lost", qos=1)
+                await asyncio.sleep(0.1)
+                await pub.publish("c/x", b"post", qos=1)
+                got.append(await sub.recv(timeout=5))
+            finally:
+                faultinject.uninstall()
+            payloads = [m.payload for m in got]
+            assert payloads == [b"pre", b"post"]
+            fired = faultinject.get() is None  # uninstalled
+            assert fired
+            await sub.disconnect()
+            await pub.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_publish_runs_on_shard_fast_path():
+    """A pipelined QoS1 burst from one client parses as a PublishRun on
+    the shard and still delivers everything in order."""
+    async def main():
+        node, port = await start_node(shards=1)
+        try:
+            sub = Client(clientid="s", port=port)
+            await sub.connect()
+            await sub.subscribe("r/#", qos=0)
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(F.serialize(P.Connect(proto_ver=4, clientid="p",
+                                          clean_start=True, keepalive=0)))
+            parser = F.Parser()
+            while not any(p.type == P.CONNACK for p in parser.feed(
+                    await r.read(65536))):
+                pass
+            # one TCP segment with 8 QoS1 publishes → one PublishRun
+            w.write(b"".join(
+                F.serialize(P.Publish(qos=1, topic="r/t", packet_id=i + 1,
+                                      payload=b"b%d" % i))
+                for i in range(8)))
+            msgs = []
+            while len(msgs) < 8:
+                msgs += await sub.recv_many(timeout=5)
+            assert [m.payload for m in msgs] == [b"b%d" % i
+                                                 for i in range(8)]
+            # the ack burst came back (8 PUBACKs)
+            acks = []
+            while len(acks) < 8:
+                data = await asyncio.wait_for(r.read(65536), 5)
+                assert data
+                for p in parser.feed(data):
+                    if p.type == P.PUBACK:
+                        acks.append(p.packet_id)
+            assert acks == list(range(1, 9))
+            w.close()
+            await sub.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())

@@ -3,7 +3,7 @@
 
 Three layers:
 
-* **the tree is clean**: all six rules over ``emqx_tpu/`` produce zero
+* **the tree is clean**: all seven rules over ``emqx_tpu/`` produce zero
   non-waived findings, and every waiver (if any ever lands) is an
   explicit, justified, expiring entry — no silent suppressions;
 * **the rules work**: each rule has a tripping and a passing fixture
@@ -91,6 +91,7 @@ def test_waiver_file_has_no_silent_suppressions():
 
 @pytest.mark.parametrize("rule,trip,ok,n_trip", [
     ("no-unsupervised-task", "trip_tasks.py", "ok_tasks.py", 3),
+    ("loop-thread-taint", "trip_threads.py", "ok_threads.py", 3),
     ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
     ("no-swallowed-exceptions", "trip_exceptions.py",
      "ok_exceptions.py", 2),

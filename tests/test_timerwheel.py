@@ -1,0 +1,171 @@
+"""Hashed timer wheel (transport/timerwheel.py): bucket rounding,
+O(1) cancel, the one-scheduled-callback-per-tick contract (spy on the
+loop's ``call_later``), mass-expiry parity against per-connection
+``loop.call_later``, periodic re-insertion and the awaitable sleep."""
+
+import asyncio
+
+from emqx_tpu.transport.timerwheel import TimerWheel
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_bucket_rounding_never_fires_early():
+    # injectable clock: delays round UP to the next bucket boundary
+    now = [100.0]
+    w = TimerWheel(tick_s=1.0, clock=lambda: now[0])
+    t = w.call_later(0.01, lambda: None)
+    assert t.slot == 101          # not the current bucket (100)
+    t2 = w.call_later(1.0, lambda: None)
+    assert t2.slot == 101         # exactly on a boundary: fires there
+    t3 = w.call_later(1.5, lambda: None)
+    assert t3.slot == 102         # ceil: 1.5 waits for boundary 102
+    t4 = w.call_later(2.0, lambda: None)
+    assert t4.slot == 102
+    now[0] = 100.9
+    assert w.call_later(0.0, lambda: None).slot == 101
+    w.close()
+
+
+def test_cancel_is_o1_and_skipped_at_expiry():
+    async def main():
+        w = TimerWheel(tick_s=0.05)
+        fired = []
+        timers = [w.call_later(0.05, lambda i=i: fired.append(i))
+                  for i in range(10)]
+        for t in timers[::2]:
+            t.cancel()
+        await asyncio.sleep(0.2)
+        assert sorted(fired) == [1, 3, 5, 7, 9]
+        assert len(w) == 0        # cancelled entries reaped at advance
+        w.close()
+
+    run(main())
+
+
+def test_one_scheduled_callback_per_tick_regardless_of_timers():
+    """The wheel keeps exactly ONE loop.call_later outstanding: a
+    1000-connection keepalive storm costs one scheduled callback whose
+    body walks the bucket — spy-asserted on the loop."""
+    async def main():
+        loop = asyncio.get_running_loop()
+        orig = loop.call_later
+        sched = []
+
+        def spy(delay, cb, *args):
+            sched.append(cb)
+            return orig(delay, cb, *args)
+
+        loop.call_later = spy
+        try:
+            w = TimerWheel(tick_s=0.05)
+            fired = []
+            for i in range(1000):
+                w.call_later(0.05, lambda i=i: fired.append(i))
+            wheel_scheds = [cb for cb in sched if cb == w._advance]
+            assert len(wheel_scheds) == 1   # ONE timer for 1000 entries
+            await asyncio.sleep(0.15)
+            assert len(fired) == 1000       # all ran from that callback
+            # each advance re-arms at most once
+            assert len([cb for cb in sched if cb == w._advance]) \
+                <= w.ticks + 1
+            w.close()
+        finally:
+            loop.call_later = orig
+
+    run(main())
+
+
+def test_mass_expiry_parity_with_per_conn_call_later():
+    """Same observable effects as N per-connection loop.call_later
+    timers: every callback fires exactly once, late-not-early."""
+    async def main():
+        loop = asyncio.get_running_loop()
+        w = TimerWheel(tick_s=0.05)
+        wheel_fired = []
+        loop_fired = []
+        t0 = loop.time()
+        for i in range(50):
+            w.call_later(0.08, lambda i=i: wheel_fired.append(
+                (i, loop.time() - t0)))
+            loop.call_later(0.08, lambda i=i: loop_fired.append(i))
+        await asyncio.sleep(0.3)
+        assert sorted(i for i, _ in wheel_fired) == sorted(loop_fired)
+        # late, never early (bucket rounding)
+        assert all(dt >= 0.08 - 1e-3 for _, dt in wheel_fired)
+        w.close()
+
+    run(main())
+
+
+def test_call_repeat_reinserts_and_cancels():
+    async def main():
+        w = TimerWheel(tick_s=0.03)
+        ticks = []
+        t = w.call_repeat(0.03, lambda: ticks.append(1))
+        await asyncio.sleep(0.2)
+        assert len(ticks) >= 3
+        t.cancel()
+        n = len(ticks)
+        await asyncio.sleep(0.1)
+        assert len(ticks) == n
+        assert len(w) == 0
+        w.close()
+
+    run(main())
+
+
+def test_sleep_awaitable_and_cancellation_cleanup():
+    async def main():
+        w = TimerWheel(tick_s=0.03)
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await w.sleep(0.05)
+        assert loop.time() - t0 >= 0.05 - 1e-3
+        # a cancelled sleeper reaps its wheel entry
+        task = asyncio.ensure_future(w.sleep(5.0))
+        await asyncio.sleep(0.01)
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        await asyncio.sleep(0.07)   # let an advance reap it
+        assert len(w) == 0
+        w.close()
+
+    run(main())
+
+
+def test_close_drops_everything_and_new_inserts_are_dead():
+    async def main():
+        w = TimerWheel(tick_s=0.03)
+        fired = []
+        w.call_later(0.03, lambda: fired.append(1))
+        w.close()
+        t = w.call_later(0.03, lambda: fired.append(2))
+        assert t.cancelled
+        await asyncio.sleep(0.1)
+        assert fired == []
+
+    run(main())
+
+
+def test_callback_exception_does_not_stop_the_wheel():
+    async def main():
+        w = TimerWheel(tick_s=0.03)
+        fired = []
+
+        def boom():
+            raise RuntimeError("x")
+
+        w.call_later(0.03, boom)
+        w.call_later(0.03, lambda: fired.append(1))
+        w.call_later(0.09, lambda: fired.append(2))
+        await asyncio.sleep(0.2)
+        assert fired == [1, 2]
+        w.close()
+
+    run(main())
