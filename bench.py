@@ -724,28 +724,67 @@ def calibrate_serve(dev, table, topics, batch, depth=8,
     return done / (time.perf_counter() - t0)
 
 
+def _dl_buckets(batch: int) -> List[int]:
+    """Padded-batch shapes the deadline harness may dispatch (pow2 from
+    ``max(256, batch/32)`` up to ``batch``) — ALL warmed before the timed
+    window, so a partial flush never stalls on a cold XLA compile."""
+    lo = max(256, batch >> 5)
+    out = []
+    b = lo
+    while b < batch:
+        out.append(b)
+        b *= 2
+    out.append(batch)
+    return out
+
+
 async def serve_harness(dev, table, topics, batch, target_rate,
                         seconds, depth=8, window_s=0.0002,
-                        engine="device"):
+                        engine="device", deadline_ms=None,
+                        batch_hist=None):
     """Micro-batching serving loop against a VIRTUAL open-loop arrival
     process: topic i arrives at t0 + i/rate (computing arrivals
     analytically keeps the harness out of the measurement — a Python
     per-topic producer caps out near the engine's own rate).  Batcher
     flushes on window/size, dispatch via the serving engine, host re-run
     for spilled rows; per-topic latencies are done_t - arrival_t,
-    vectorized."""
+    vectorized.
+
+    ``deadline_ms`` switches the batcher to DEADLINE mode (the
+    MatchService continuous-batching loop's policy): the batch bound is
+    the budget's worth of arrivals after the EWMA-estimated dispatch
+    time is paid, a partial batch flushes the moment the oldest
+    arrival's remaining budget no longer covers a dispatch, partial
+    flushes pad to the smallest pre-warmed pow2 shape, and the device
+    pipeline depth drops to 2 (latency- over throughput-oriented).
+    ``batch_hist`` (a dict) receives the achieved batch-size histogram
+    keyed by padded shape."""
     lats: List[np.ndarray] = []
-    stop_at = time.perf_counter() + seconds
     n_topics = len(topics)
     spill_reruns = 0
     consumed = 0          # arrivals taken so far
-    t0 = time.perf_counter()
+    est = [0.005]         # EWMA dispatch→answer seconds (collector feeds)
+    deadline_flushes = [0]
 
-    inflight_q: asyncio.Queue = asyncio.Queue(maxsize=SERVE_INFLIGHT)
+    buckets = _dl_buckets(batch) if deadline_ms is not None else [batch]
+    if deadline_ms is not None and engine == "device":
+        for b in buckets:   # all shapes warm BEFORE the timed window
+            warm_serve(dev, table, topics, b, depth)
+
+    def _shape_of(take: int) -> int:
+        for b in buckets:
+            if take <= b:
+                return b
+        return batch
+
+    inflight = 2 if deadline_ms is not None else SERVE_INFLIGHT
+    inflight_q: asyncio.Queue = asyncio.Queue(maxsize=inflight)
+    stop_at = time.perf_counter() + seconds
+    t0 = time.perf_counter()
 
     async def batcher():
         """Encode + dispatch; readback happens in collector so up to
-        SERVE_INFLIGHT batches overlap on device (matching the raw
+        ``inflight`` batches overlap on device (matching the raw
         pipelined path — the round-2 harness synced per batch and
         measured dispatch latency, not serving capacity)."""
         nonlocal consumed, spill_reruns
@@ -759,17 +798,44 @@ async def serve_harness(dev, table, topics, batch, target_rate,
                 await asyncio.sleep(min(window_s, 0.001))
                 continue
             oldest_age = now - (t0 + consumed / target_rate)
-            if avail < batch and oldest_age < window_s:
-                await asyncio.sleep(window_s / 4)
-                continue
-            take = min(avail, batch)
+            if deadline_ms is not None:
+                budget = deadline_ms / 1e3
+                # budget term: arrivals the remaining budget can absorb.
+                # sustainability floor: a batch must at least cover the
+                # arrivals landing DURING one dispatch, or the loop
+                # falls behind by construction and the open-loop queue
+                # diverges — when the budget is infeasible at this load
+                # (est >= budget/2), throughput wins over the SLO.
+                bound = max(1, min(batch, max(
+                    int(target_rate * max(budget - est[0],
+                                          budget * 0.25)),
+                    int(target_rate * est[0] * 1.2))))
+                slack = budget - est[0] - oldest_age
+                if avail < bound and slack > 0:
+                    await asyncio.sleep(
+                        min(max(slack / 4, 0.0005), 0.005))
+                    continue
+                take = min(avail, bound)
+                if take < bound:
+                    deadline_flushes[0] += 1
+                pad = _shape_of(take)
+            else:
+                if avail < batch and oldest_age < window_s:
+                    await asyncio.sleep(window_s / 4)
+                    continue
+                take = min(avail, batch)
+                pad = batch
+            if batch_hist is not None:
+                key = str(pad)
+                batch_hist[key] = batch_hist.get(key, 0) + 1
             first = consumed
             consumed += take
             names = [topics[(first + j) % n_topics] for j in range(take)]
             if engine == "device":
+                disp_t = time.perf_counter()
                 r = await asyncio.to_thread(
-                    _dispatch, dev, table, names, depth, batch)
-                await inflight_q.put((first, take, names, r))
+                    _dispatch, dev, table, names, depth, pad)
+                await inflight_q.put((first, take, names, r, disp_t))
             else:  # cpu engine: the host trie answers the whole batch
                 await asyncio.to_thread(
                     lambda: [table.match_host(t) for t in names])
@@ -784,7 +850,7 @@ async def serve_harness(dev, table, topics, batch, target_rate,
             item = await inflight_q.get()
             if item is None:
                 return
-            first, take, names, r = item
+            first, take, names, r, disp_t = item
             ids, rows = await asyncio.to_thread(
                 _readback, r, dev.max_matches)
             rows = rows[rows < take]
@@ -793,6 +859,7 @@ async def serve_harness(dev, table, topics, batch, target_rate,
                 await asyncio.to_thread(
                     lambda: [table.match_host(names[i]) for i in rows])
             done_t = time.perf_counter()
+            est[0] = est[0] * 0.7 + (done_t - disp_t) * 0.3
             arr_t = t0 + (first + np.arange(take)) / target_rate
             lats.append(done_t - arr_t)
 
@@ -801,13 +868,68 @@ async def serve_harness(dev, table, topics, batch, target_rate,
         return None
     lat = np.concatenate(lats)
     arr = lat[len(lat) // 4:]  # drop cold-start ramp
-    return {
+    out = {
         "offered_rate": int(target_rate),
         "served": int(len(lat)),
         "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
         "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
         "spill_reruns": spill_reruns,
     }
+    if deadline_ms is not None:
+        out["deadline_ms"] = deadline_ms
+        out["deadline_flushes"] = deadline_flushes[0]
+        out["served_rate"] = int(len(lat) / max(seconds, 1e-9))
+    return out
+
+
+def bench_serve_deadline(dev, table, topics, batch, offered_rate,
+                         seconds, deadline_ms, depth=8,
+                         serve_static=None):
+    """A/B the deadline-mode serve loop against the static full-batch
+    loop at the SAME offered load: p50/p99 + the achieved batch-size
+    histogram.  ``serve_static`` reuses an already-measured static run
+    (the headline ``serve_device`` section) instead of re-running it."""
+    if serve_static is None:
+        serve_static = asyncio.run(serve_harness(
+            dev, table, topics, batch, offered_rate, seconds,
+            depth=depth))
+    hist: dict = {}
+    dl = asyncio.run(serve_harness(
+        dev, table, topics, batch, offered_rate, seconds, depth=depth,
+        deadline_ms=deadline_ms, batch_hist=hist))
+    out = {
+        "offered_rate": int(offered_rate),
+        "deadline_ms": deadline_ms,
+        "batch": batch,
+        "static": serve_static,
+        "deadline": ({**dl, "batch_hist": hist} if dl else None),
+    }
+    if dl and serve_static:
+        out["p99_improvement"] = round(
+            serve_static["p99_ms"] / max(dl["p99_ms"], 1e-6), 2)
+    return out
+
+
+def bench_serve_deadline_smoke(n_filters=2000, batch=256, seconds=1.5,
+                               deadline_ms=25.0, depth=8):
+    """CPU-jax tiny-scale serve_deadline A/B for bench_e2e --smoke: the
+    per-PR tracking number (structure + delivery, NOT the ratio — CI
+    boxes make kernel-latency ratios noise)."""
+    from emqx_tpu.ops.device_table import DeviceNfa
+
+    rng = np.random.default_rng(7)
+    filters, topics = build_workload(rng, n_filters, batch * 8, depth)
+    table, kind, _ = build_table(filters, depth)
+    dev = DeviceNfa(table, active_slots=8, compact_output=False,
+                    max_matches=_serve_max_matches())
+    cap = calibrate_serve(dev, table, topics, batch, depth=depth,
+                          seconds=0.8)
+    rate = 0.6 * cap
+    out = bench_serve_deadline(dev, table, topics, batch, rate, seconds,
+                               deadline_ms, depth=depth)
+    out["table"] = kind
+    out["n_filters"] = len(filters)
+    return out
 
 
 def bench_deltas(dev, table, n=1000):
@@ -1055,6 +1177,31 @@ def main():
                 serve_dev["offered_rate"] * eq_s - serve_cpu_eq["served"])
         note(f"cpu serve at device load done: {serve_cpu_eq}")
 
+    # deadline-aware serve A/B (ISSUE 7): static full-batch (the
+    # serve_device run above) vs the deadline-mode continuous-batching
+    # loop at the SAME offered load.  Budget = the measured CPU-iso p99
+    # (the match.deadline_ms default's derivation).  The acceptance
+    # gates compare against the static half/quarter-batch runs.
+    serve_deadline = None
+    if serve_dev:
+        dl_ms = serve_cpu["p99_ms"] if serve_cpu else 41.0
+        serve_deadline = bench_serve_deadline(
+            dev, table, topics, args.batch, serve_dev["offered_rate"],
+            min(args.serve_seconds, 6.0), dl_ms, depth=args.depth,
+            serve_static=serve_dev)
+        dl = serve_deadline.get("deadline")
+        if dl:
+            if serve_dev4:
+                serve_deadline["gate_p99_le_quarter_batch"] = bool(
+                    dl["p99_ms"] <= serve_dev4["p99_ms"])
+            if serve_dev2:
+                serve_deadline["gate_throughput_ge_half_batch"] = bool(
+                    dl["served_rate"] >= 0.95 * min(
+                        serve_dev2["offered_rate"],
+                        serve_dev2["served"]
+                        / max(1e-9, min(args.serve_seconds, 6.0))))
+        note(f"serve deadline A/B done: {serve_deadline}")
+
     deltas = bench_deltas(dev, table)
     note("deltas done")
 
@@ -1122,6 +1269,7 @@ def main():
         "serve_device": serve_dev,
         "serve_device_half_batch": serve_dev2,
         "serve_device_quarter_batch": serve_dev4,
+        "serve_deadline": serve_deadline,
         "serve_cpu_iso": serve_cpu,
         "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,

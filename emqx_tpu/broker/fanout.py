@@ -427,8 +427,15 @@ class FanoutPipeline:
             # prefetch_timeout_s; failure → host trie serves)
             if self.match_service is not None:
                 try:
-                    await self.match_service.prefetch_many(
-                        {m.topic for m in batch})
+                    # {topic: max qos} — the mapping iterates as the
+                    # topic set AND carries the QoS the deadline serve
+                    # plane's brownout stage-2 shed keys on
+                    topic_qos: Dict[str, int] = {}
+                    for m in batch:
+                        q = topic_qos.get(m.topic)
+                        if q is None or m.qos > q:
+                            topic_qos[m.topic] = m.qos
+                    await self.match_service.prefetch_many(topic_qos)
                 except asyncio.CancelledError:
                     raise
                 except Exception:

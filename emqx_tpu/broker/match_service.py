@@ -40,6 +40,43 @@ stays at host-path levels.
 Also co-batches the **rule engine**'s FROM filters (BASELINE config 3):
 rules register their topic filters here under a separate id namespace,
 and matched rule ids ride the same kernel call (see ``rule_filters``).
+
+**Deadline-aware serve plane** (opt-in, ``match.deadline.enable``): the
+fixed-window batch loop is replaced by a continuous-batching loop in
+which every prefetch carries a latency *budget* (``match.deadline_ms``,
+default = the measured CPU-iso serve p99) and latency is enforced, not
+emergent:
+
+* the loop dispatches a **partial batch** the moment the oldest waiter's
+  budget (minus the EWMA-estimated dispatch time) is about to expire —
+  ``broker.match.deadline_dispatch`` counts these forced flushes;
+* the batch bound **adapts to the arrival rate** (EWMA, the fanout-gate
+  estimator shape): a batch covers at most the budget's worth of
+  arrivals, so batch size tracks load instead of pinning p99 to the
+  worst-case fill time (BENCH_r05: batch 8192 → p99 398 ms, 2048 →
+  105 ms);
+* the short/long dual-lane depth split gets **per-lane caps** derived
+  from the observed short-topic fraction, so a deep-topic flood cannot
+  starve the cheap shallow kernel's latency;
+* every device dispatch runs under a **per-dispatch timeout** with
+  immediate CPU fallback: the host NFA + deep-filter trie answer the
+  whole batch and mint hints (``broker.match.cpu_fallback``), so a hung
+  kernel costs one timeout, not ``prefetch_timeout_s`` per waiter;
+* consecutive dispatch failures trip a **circuit breaker**
+  (``match.breaker.threshold``) into CPU-serve mode with the
+  ``match_degraded`` alarm raised; a supervised recovery child
+  (``match.probe``) re-dispatches a canary batch every
+  ``match.breaker.probe_interval`` and closes the breaker (and clears
+  the alarm) when the device answers again;
+* sustained overload walks the :class:`~emqx_tpu.broker.olp.Olp`
+  **brownout ladder**: stage 1 shrinks the adaptive batch caps, stage 2
+  sheds QoS0 prefetches to the CPU trie, stage 3 is full CPU serve —
+  degradation is latency-first, never queue-depth-first.
+
+Flag off, the pre-deadline fixed-window loop serves byte-identically.
+In BOTH modes a killed/crashed serve loop fails its in-flight waiters
+over to the CPU path immediately (and re-arms on supervised restart)
+instead of parking them for the full prefetch timeout.
 """
 
 from __future__ import annotations
@@ -59,6 +96,12 @@ from .trie import FilterTrie
 log = logging.getLogger(__name__)
 
 __all__ = ["MatchService"]
+
+
+class _StaleRace(RuntimeError):
+    """A benign serving race (aid reused mid-flight): the batch answer
+    can't be trusted, but the device itself is healthy — falls back to
+    the CPU path WITHOUT counting against the circuit breaker."""
 
 
 def _bucket(n: int, minimum: int = 64) -> int:
@@ -91,6 +134,13 @@ class MatchService:
         table: str = "auto",   # auto | native | python
         short_depth: int = 4,
         split_min: int = 256,
+        deadline: bool = False,
+        deadline_s: float = 0.041,
+        breaker_threshold: int = 5,
+        breaker_probe_interval_s: float = 1.0,
+        dispatch_timeout_s: Optional[float] = None,
+        alarms: Any = None,
+        olp: Any = None,
     ) -> None:
         from ..ops import IncrementalNfa
         from ..ops.device_table import DeviceNfa
@@ -116,6 +166,20 @@ class MatchService:
         # second kernel dispatch has a fixed cost that must amortize
         self.short_depth = short_depth
         self.split_min = split_min
+        # deadline-aware serve plane (module docstring).  Off = the
+        # fixed-window loop, byte-identical to the pre-deadline path.
+        self.deadline = bool(deadline)
+        self.deadline_s = deadline_s
+        self.breaker_threshold = breaker_threshold
+        self.breaker_probe_interval_s = breaker_probe_interval_s
+        # per-dispatch bound: well under the waiter timeout so a hung
+        # kernel degrades to ONE CPU-served batch, not a stalled queue
+        self.dispatch_timeout_s = (
+            dispatch_timeout_s if dispatch_timeout_s is not None
+            else min(max(4.0 * deadline_s, 0.1),
+                     max(prefetch_timeout_s * 0.8, 0.05)))
+        self.alarms = alarms
+        self.olp = olp
 
         # host table: the C++ incremental NFA when available (seconds at
         # 10M filters, Python-object-free), else the Python twin —
@@ -171,6 +235,17 @@ class MatchService:
         self._win_start = time.monotonic()
         self._win_count = 0
         self._last_rate = 0.0
+        # deadline-mode serving state: EWMA arrival rate + short-lane
+        # fraction (per-lane caps), EWMA dispatch latency (partial-flush
+        # trigger), circuit breaker, brownout cache
+        self._rate_ewma: Optional[float] = None
+        self._short_frac: Optional[float] = None
+        self._win_short = 0
+        self._est_dispatch_s = 0.005
+        self._breaker_failures = 0
+        self._breaker_open = False
+        self._probe_child: Any = None
+        self._last_brownout = 0
 
         self.router.listeners.append(self._on_router_mutation)
 
@@ -181,6 +256,8 @@ class MatchService:
     async def start(self) -> None:
         self._running = True
         self._bootstrap()
+        serve_loop = self._deadline_loop if self.deadline \
+            else self._batch_loop
         sup = getattr(self, "supervisor", None)
         if sup is not None:
             # supervised (node sets .supervisor before start): a crashed
@@ -188,17 +265,20 @@ class MatchService:
             # hint freshness / prefetch waiters until broker restart
             self._tasks = [
                 sup.start_child("match.sync", self._sync_loop),
-                sup.start_child("match.batch", self._batch_loop),
+                sup.start_child("match.batch", serve_loop),
             ]
         else:
             self._tasks = [
                 asyncio.ensure_future(self._sync_loop()),
-                asyncio.ensure_future(self._batch_loop()),
+                asyncio.ensure_future(serve_loop()),
             ]
         self._dirty.set()
 
     async def stop(self) -> None:
         self._running = False
+        if self._probe_child is not None:
+            self._probe_child.cancel()
+            self._probe_child = None
         for t in self._tasks:
             t.cancel()
         self._tasks = []
@@ -334,6 +414,17 @@ class MatchService:
     def _warm(self) -> None:
         from ..ops import encode_batch
 
+        if _fi._injector is not None:
+            # chaos seam: the compile/warm step is where growth
+            # re-uploads and cold starts stall — a raise here rides the
+            # _sync_loop's existing failure path (host trie serves,
+            # retry after 1 s); runs inside to_thread, so a delay is a
+            # plain blocking sleep
+            act = _fi._injector.act("match.compile")
+            if act == "raise":
+                raise _fi.InjectedFault("match.compile")
+            if act == "delay":
+                time.sleep(_fi._injector.last_delay)
         # flat_cap is a jit STATIC arg — warming without it would
         # compile the wrong variant and the first live batch would still
         # stall on an XLA compile
@@ -432,27 +523,68 @@ class MatchService:
                 return False
         return True
 
-    def _note_arrival(self) -> None:
+    def _note_arrival(self, topic: Optional[str] = None) -> None:
         now = time.monotonic()
         dt = now - self._win_start
         if dt >= 0.05:
             self._last_rate = self._win_count / dt
+            if self.deadline:
+                # EWMA-smooth the windowed rate (the fanout-gate
+                # estimator shape) for the adaptive batch bound, and
+                # track the short-lane traffic fraction for per-lane caps
+                a = 0.5
+                self._rate_ewma = (
+                    self._last_rate if self._rate_ewma is None
+                    else self._rate_ewma * (1.0 - a) + self._last_rate * a)
+                frac = self._win_short / max(1, self._win_count)
+                self._short_frac = (
+                    frac if self._short_frac is None
+                    else self._short_frac * (1.0 - a) + frac * a)
+                self._win_short = 0
             self._win_start = now
             self._win_count = 0
         self._win_count += 1
+        if topic is not None and self._is_short(topic):
+            self._win_short += 1
+
+    def _is_short(self, topic: str) -> bool:
+        return topic.count("/") < self.short_depth
 
     def _should_bypass(self) -> bool:
         if self.bypass_rate <= 0:
             return False
         return not self._pending and self._last_rate < self.bypass_rate
 
-    async def prefetch(self, topic: str) -> None:
+    async def prefetch(self, topic: str, qos: int = 0) -> None:
         """Async stage (connection intercept): micro-batch this topic
         through the kernel and park the answer in the hint cache.
         Bounded by ``prefetch_timeout_s`` — a stalled device (compile,
         growth re-upload) degrades to the host path, never blocks
-        publishes indefinitely."""
-        self._note_arrival()
+        publishes indefinitely.  In deadline mode the waiter carries its
+        latency budget, and breaker-open / brownout states short-circuit
+        straight to the CPU path (``qos`` feeds the stage-2 QoS0 shed)."""
+        if not self.deadline:
+            self._note_arrival()
+            if not self._usable():
+                return
+            hint = self._hints.get(topic)
+            if hint is not None and self._hint_fresh(topic, hint[0]) \
+                    and self._rules_fresh(topic, hint[1]):
+                return
+            if self._should_bypass():
+                if self.metrics is not None:
+                    self.metrics.inc("tpu.match.bypass")
+                return
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending.append((topic, fut))
+            self._batch_wake.set()
+            try:
+                await asyncio.wait_for(fut, self.prefetch_timeout_s)
+            except Exception:
+                # timeout/cancel: publish falls back to the host path
+                log.debug("prefetch for %r timed out", topic, exc_info=True)
+            return
+        self._note_arrival(topic)
         if not self._usable():
             return
         hint = self._hints.get(topic)
@@ -463,43 +595,65 @@ class MatchService:
             if self.metrics is not None:
                 self.metrics.inc("tpu.match.bypass")
             return
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending.append((topic, fut))
+        lvl = self._brownout()
+        if self._breaker_open or lvl >= 3 or (lvl >= 2 and qos == 0):
+            # CPU serve: no enqueue, no waiting — Broker.publish walks
+            # the host trie when no fresh hint exists
+            if self.metrics is not None:
+                self.metrics.inc("broker.match.cpu_fallback")
+            return
+        loop = asyncio.get_running_loop()
+        fut2: asyncio.Future = loop.create_future()
+        self._pending.append((topic, fut2, loop.time() + self.deadline_s))
         self._batch_wake.set()
         try:
-            await asyncio.wait_for(fut, self.prefetch_timeout_s)
+            await asyncio.wait_for(fut2, self.prefetch_timeout_s)
         except Exception:
-            # timeout/cancel: publish falls back to the host path
             log.debug("prefetch for %r timed out", topic, exc_info=True)
 
-    async def prefetch_many(self, topics) -> None:
+    async def prefetch_many(self, topics, qos_of=None) -> None:
         """Batched prefetch for the fanout pipeline: every topic missing
         a fresh hint is enqueued in the SAME event-loop tick, so the
         whole set rides one batching window — one kernel call for the
         batch instead of one ``prefetch`` await per message.  Bounded by
-        ``prefetch_timeout_s`` like the single-topic path."""
-        if _fi._injector is not None:
-            # chaos seam: a raised dispatch fault is caught by the
-            # fanout pipeline (host trie serves); a delay simulates a
-            # slow kernel round trip
-            act = _fi._injector.act("match.dispatch")
-            if act == "raise":
-                raise _fi.InjectedFault("match.dispatch")
-            if act == "delay":
-                await _fi._injector.pause()
+        ``prefetch_timeout_s`` like the single-topic path.
+
+        ``topics`` may be a ``{topic: max_qos}`` mapping (the fanout
+        pipeline passes one), which doubles as ``qos_of`` for the
+        deadline-mode brownout stage-2 QoS0 shed."""
         if not self._usable():
+            return
+        if qos_of is None and isinstance(topics, dict):
+            qos_of = topics
+        deadline = self.deadline
+        lvl = self._brownout() if deadline else 0
+        if deadline and (self._breaker_open or lvl >= 3):
+            # full CPU serve: the whole batch falls to the host trie
+            if self.metrics is not None:
+                self.metrics.inc("broker.match.cpu_fallback", len(topics))
             return
         waits: List[asyncio.Future] = []
         loop = asyncio.get_running_loop()
+        deadline_t = loop.time() + self.deadline_s if deadline else 0.0
+        shed = 0
         for topic in topics:
-            self._note_arrival()
+            self._note_arrival(topic if deadline else None)
             hint = self._hints.get(topic)
             if hint is not None and self._hint_fresh(topic, hint[0]) \
                     and self._rules_fresh(topic, hint[1]):
                 continue
+            if deadline and lvl >= 2 and qos_of is not None \
+                    and qos_of.get(topic, 1) == 0:
+                shed += 1   # brownout stage 2: QoS0 rides the CPU trie
+                continue
             fut = loop.create_future()
-            self._pending.append((topic, fut))
+            if deadline:
+                self._pending.append((topic, fut, deadline_t))
+            else:
+                self._pending.append((topic, fut))
             waits.append(fut)
+        if shed and self.metrics is not None:
+            self.metrics.inc("broker.match.cpu_fallback", shed)
         if not waits:
             return
         self._batch_wake.set()
@@ -634,103 +788,454 @@ class MatchService:
         return [(short, sd), (long_, self.depth)]
 
     async def _batch_loop(self) -> None:
+        """The pre-deadline fixed-window serve loop (default): wake,
+        sleep the batching window, pop up to ``max_batch`` waiters, one
+        kernel dispatch.  Byte-identical to the PR-6 path except for the
+        waiter-failover fix shared with the deadline loop: a killed or
+        crashed run resolves its in-flight waiters immediately (CPU path
+        serves) and a restart re-arms the wake on a non-empty queue."""
+        try:
+            if self._pending:
+                # supervisor restart mid-backlog: the dead run consumed
+                # the wake — never stall waiters on a non-empty queue
+                # (mirrors the fanout _run re-arm fix from PR 3)
+                self._batch_wake.set()
+            while True:
+                await self._batch_wake.wait()
+                self._batch_wake.clear()
+                if not self._pending:
+                    continue
+                await asyncio.sleep(self.batch_window_s)
+                pending, self._pending = self._pending[: self.max_batch], \
+                    self._pending[self.max_batch:]
+                if self._pending:
+                    self._batch_wake.set()
+                await self._serve_batch(pending)
+        finally:
+            self._fail_over_waiters()
+
+    async def _serve_batch(self, pending: List[Any]) -> None:
+        """Fixed-window dispatch: device rows → hints, any failure
+        resolves the waiters empty-handed (host trie serves)."""
+        topics = [p[0] for p in pending]
+        # the hint's provenance is the epoch the DEVICE table
+        # reflects (not the live router epoch — the table may lag;
+        # freshness is then proven forward from here at consume time)
+        epoch = self._synced_epoch
+        rule_gen = self._synced_rule_gen
+        try:
+            if not self._usable():
+                raise RuntimeError("mirror stale")
+            rows = await self._dispatch_guarded(topics)
+            self._mint_hints(pending, rows, epoch, rule_gen)
+        except Exception:
+            log.debug("device batch failed; publishes fall back",
+                      exc_info=True)
+            for p in pending:
+                if not p[1].done():
+                    p[1].set_result(None)
+
+    async def _fault_gate(self) -> None:
+        """The ``match.dispatch`` chaos seam, shared by both serve loops
+        and the breaker's recovery probe.  ``hang`` parks until the
+        caller's per-dispatch timeout (or cancellation) rescues it."""
+        if _fi._injector is not None:
+            act = _fi._injector.act("match.dispatch")
+            if act == "raise":
+                raise _fi.InjectedFault("match.dispatch")
+            if act == "delay":
+                await _fi._injector.pause()
+            elif act == "hang":
+                await _fi._injector.hang()
+
+    async def _dispatch_guarded(self, topics: List[str]) -> List[Any]:
+        await self._fault_gate()
+        return await self._device_serve(topics)
+
+    async def _device_serve(self, topics: List[str]) -> List[Any]:
+        """Encode + kernel dispatch + readback + spill/deep merge for one
+        batch; returns one aid row per topic.  Raises :class:`_StaleRace`
+        when a freed accept id was handed out mid-flight (benign — the
+        answer is untrusted but the device is healthy)."""
         from ..ops import encode_batch
 
-        while True:
-            await self._batch_wake.wait()
-            self._batch_wake.clear()
-            if not self._pending:
-                continue
-            await asyncio.sleep(self.batch_window_s)
-            pending, self._pending = self._pending[: self.max_batch], \
-                self._pending[self.max_batch:]
-            if self._pending:
-                self._batch_wake.set()
-            topics = [t for t, _ in pending]
-            # the hint's provenance is the epoch the DEVICE table
-            # reflects (not the live router epoch — the table may lag;
-            # freshness is then proven forward from here at consume time)
-            epoch = self._synced_epoch
-            rule_gen = self._synced_rule_gen
-            try:
-                if not self._usable():
-                    raise RuntimeError("mirror stale")
-                # aid-reuse guard: if a freed accept id is handed out
-                # again while this batch is in flight, the device rows
-                # may name it under its OLD filter — translating through
-                # the live accept_filters would be wrong at any epoch
-                reuses0 = self.inc.aid_reuses
-                groups = self._depth_groups(topics)
-                encs = [
-                    (encode_batch(self.inc, [topics[i] for i in idx],
-                                  batch=_bucket(len(idx)), depth=d),
-                     len(idx))
-                    for idx, d in groups
-                ]
-                results = await asyncio.to_thread(
-                    self._device_rows_grouped, encs
+        # aid-reuse guard: if a freed accept id is handed out
+        # again while this batch is in flight, the device rows
+        # may name it under its OLD filter — translating through
+        # the live accept_filters would be wrong at any epoch
+        reuses0 = self.inc.aid_reuses
+        groups = self._depth_groups(topics)
+        encs = [
+            (encode_batch(self.inc, [topics[i] for i in idx],
+                          batch=_bucket(len(idx)), depth=d),
+             len(idx))
+            for idx, d in groups
+        ]
+        results = await asyncio.to_thread(
+            self._device_rows_grouped, encs
+        )
+        rows: List[Any] = [None] * len(topics)
+        spilled: List[int] = []
+        for (idx, _d), (grows, gspill) in zip(groups, results):
+            for j, i in enumerate(idx):
+                rows[i] = grows[j]
+            spilled.extend(idx[j] for j in gspill)
+        if self.inc.aid_reuses != reuses0:
+            raise _StaleRace("aid reused mid-flight")
+        if self.metrics is not None:
+            # counted only once the whole batch is known good, so
+            # batches/topics counters stay consistent
+            self.metrics.inc("tpu.match.batches", len(groups))
+        spset = set(spilled)
+        for r in spilled:
+            rows[r] = self._host_ids(topics[r])
+            if self.metrics is not None:
+                self.metrics.inc("tpu.match.fallback_host")
+        if self._deep:
+            # too-deep filters live host-side; merge their hits
+            for r, t in enumerate(topics):
+                if r not in spset:
+                    rows[r].extend(self._deep_ids(t))
+        if self.metrics is not None:
+            self.metrics.inc("tpu.match.topics", len(topics))
+            if spilled:
+                self.metrics.inc(
+                    "tpu.match.active_overflow", len(spilled)
                 )
-                rows: List[Any] = [None] * len(topics)
-                spilled: List[int] = []
-                for (idx, _d), (grows, gspill) in zip(groups, results):
-                    for j, i in enumerate(idx):
-                        rows[i] = grows[j]
-                    spilled.extend(idx[j] for j in gspill)
-                if self.inc.aid_reuses != reuses0:
-                    raise RuntimeError("aid reused mid-flight")
-                if self.metrics is not None:
-                    # counted only once the whole batch is known good, so
-                    # batches/topics counters stay consistent
-                    self.metrics.inc("tpu.match.batches", len(groups))
-                spset = set(spilled)
-                for r in spilled:
-                    rows[r] = self._host_ids(topics[r])
-                    if self.metrics is not None:
-                        self.metrics.inc("tpu.match.fallback_host")
-                if self._deep:
-                    # too-deep filters live host-side; merge their hits
-                    for r, t in enumerate(topics):
-                        if r not in spset:
-                            rows[r].extend(self._deep_ids(t))
-                if self.metrics is not None:
-                    self.metrics.inc("tpu.match.topics", len(topics))
-                    if spilled:
-                        self.metrics.inc(
-                            "tpu.match.active_overflow", len(spilled)
-                        )
-                for (topic, fut), row in zip(pending, rows):
-                    # pop-then-insert: a refreshed hint is ACTIVE — plain
-                    # assignment would keep its stale dict position and
-                    # let the post-insert prune evict it ahead of colder
-                    # entries, wasting the device work just spent on it
-                    self._hints.pop(topic, None)
-                    self._hints[topic] = (epoch, rule_gen,
-                                          *self._split_row(row))
-                    if not fut.done():
-                        fut.set_result(None)
-                # evict AFTER insert, least-recently-SERVED first (dict
-                # order is recency: hint_routes re-appends on a hit).
-                # Post-insert pruning makes the cap a true invariant
-                # even when a single batch exceeds it (the batch's own
-                # oldest entries go too), counts refreshed-in-place
-                # topics as the no-ops they are, and the metric is the
-                # exact deletion count.  The old full-clear thrashed
-                # working sets just over hint_cap between full-cache
-                # and cold-cache — the hot head of a Zipf working set
-                # must survive the arrival of its own cold tail.
-                excess = len(self._hints) - self.hint_cap
-                if excess > 0:
-                    it = iter(self._hints)
-                    for k in [next(it) for _ in range(excess)]:
-                        del self._hints[k]
-                    if self.metrics is not None:
-                        self.metrics.inc("tpu.match.hint_evicted", excess)
+        return rows
+
+    def _mint_hints(self, pending: List[Any], rows: List[Any],
+                    epoch: int, rule_gen: int) -> None:
+        for p, row in zip(pending, rows):
+            topic, fut = p[0], p[1]
+            # pop-then-insert: a refreshed hint is ACTIVE — plain
+            # assignment would keep its stale dict position and
+            # let the post-insert prune evict it ahead of colder
+            # entries, wasting the device work just spent on it
+            self._hints.pop(topic, None)
+            self._hints[topic] = (epoch, rule_gen,
+                                  *self._split_row(row))
+            if not fut.done():
+                fut.set_result(None)
+        self._evict()
+        if self.deadline and self.metrics is not None:
+            self._count_misses(pending)
+
+    def _evict(self) -> None:
+        # evict AFTER insert, least-recently-SERVED first (dict
+        # order is recency: hint_routes re-appends on a hit).
+        # Post-insert pruning makes the cap a true invariant
+        # even when a single batch exceeds it (the batch's own
+        # oldest entries go too), counts refreshed-in-place
+        # topics as the no-ops they are, and the metric is the
+        # exact deletion count.  The old full-clear thrashed
+        # working sets just over hint_cap between full-cache
+        # and cold-cache — the hot head of a Zipf working set
+        # must survive the arrival of its own cold tail.
+        excess = len(self._hints) - self.hint_cap
+        if excess > 0:
+            it = iter(self._hints)
+            for k in [next(it) for _ in range(excess)]:
+                del self._hints[k]
+            if self.metrics is not None:
+                self.metrics.inc("tpu.match.hint_evicted", excess)
+
+    def _count_misses(self, pending: List[Any]) -> None:
+        try:
+            now = asyncio.get_running_loop().time()
+        except RuntimeError:
+            return
+        late = sum(1 for p in pending if len(p) > 2 and now > p[2])
+        if late:
+            self.metrics.inc("broker.match.deadline_miss", late)
+
+    def _fail_over_waiters(self) -> None:
+        """Serve-loop death (kill, crash, stop): resolve every in-flight
+        waiter NOW so each blocked ``prefetch`` falls to the CPU path
+        immediately instead of burning the full ``prefetch_timeout_s``."""
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        for p in pending:
+            if not p[1].done():
+                p[1].set_result(None)
+        if self.metrics is not None:
+            self.metrics.inc("broker.match.cpu_fallback", len(pending))
+        log.warning("match serve loop exited with %d waiter(s) in "
+                    "flight; failed over to the CPU path", len(pending))
+
+    # ------------------------------------------------------------------
+    # deadline-aware continuous-batching serve loop (opt-in)
+    # ------------------------------------------------------------------
+
+    async def _deadline_loop(self) -> None:
+        """Continuous batching under a latency budget: dispatch when the
+        adaptive bound fills OR the oldest waiter's remaining budget no
+        longer covers the (EWMA-estimated) dispatch time — whichever
+        comes first.  See the module docstring for the full ladder."""
+        loop = asyncio.get_running_loop()
+        try:
+            if self._pending:
+                # restart mid-backlog: the dead run consumed the wake
+                self._batch_wake.set()
+            while True:
+                await self._batch_wake.wait()
+                self._batch_wake.clear()
+                while self._pending:
+                    if not self._device_ok():
+                        # breaker open / brownout stage 3 / mirror gone
+                        # stale with waiters queued: CPU answers them now
+                        self._cpu_serve(self._pop_batch(len(self._pending)))
+                        continue
+                    bound = self._deadline_bound()
+                    slack = (self._pending[0][2] - loop.time()
+                             - self._est_dispatch_s)
+                    if len(self._pending) < bound and slack > 0:
+                        # gather window: admit more arrivals, but never
+                        # wait past the oldest waiter's budget; geometric
+                        # re-check keeps idle wakeups bounded while the
+                        # wake event stays responsive to new arrivals
+                        wait = min(slack,
+                                   max(self.batch_window_s, slack / 4))
+                        try:
+                            await asyncio.wait_for(
+                                self._batch_wake.wait(), wait)
+                        except asyncio.TimeoutError:
+                            pass
+                        self._batch_wake.clear()
+                        continue
+                    if len(self._pending) < bound \
+                            and self.metrics is not None:
+                        # partial batch forced out by the budget — the
+                        # deadline doing its job, not an anomaly
+                        self.metrics.inc("broker.match.deadline_dispatch")
+                    await self._serve_batch_deadline(self._pop_batch(bound))
+        finally:
+            self._fail_over_waiters()
+
+    def _deadline_bound(self) -> int:
+        """Arrival-rate-adaptive batch bound: a batch covers at most the
+        budget's worth of arrivals after the estimated dispatch time is
+        paid, so fill latency + dispatch fits the budget at any load —
+        floored at the arrivals landing DURING one dispatch, or the loop
+        would fall behind by construction (an infeasible budget degrades
+        to throughput mode, never to a diverging queue).  Brownout stage
+        1+ shrinks the cap (half, then quarter)."""
+        rate = (self._rate_ewma if self._rate_ewma is not None
+                else self._last_rate)
+        headroom = max(self.deadline_s - self._est_dispatch_s,
+                       self.deadline_s * 0.25)
+        bound = max(1, min(self.max_batch,
+                           max(int(rate * headroom),
+                               int(rate * self._est_dispatch_s * 1.2))))
+        lvl = self._brownout()
+        if lvl:
+            bound = max(1, bound >> min(lvl, 2))
+        return bound
+
+    def _lane_caps(self, bound: int) -> Tuple[int, int]:
+        """Per-lane (short-topic, long-topic) caps from the observed
+        short-lane traffic fraction — a deep-topic flood cannot consume
+        the whole bound and starve the cheap shallow kernel.  25% slack
+        per lane so a lagging estimate never starves shifting traffic."""
+        if not self.short_depth or self.short_depth >= self.depth:
+            return bound, bound
+        frac = self._short_frac if self._short_frac is not None else 0.5
+        short = min(bound, max(1, int(bound * frac * 1.25) + 1))
+        long_ = min(bound, max(1, int(bound * (1.0 - frac) * 1.25) + 1))
+        return short, long_
+
+    def _pop_batch(self, bound: int) -> List[Any]:
+        """Pop up to ``bound`` waiters from the queue head, honoring the
+        per-lane caps; waiters whose lane is full stay queued IN ORDER
+        (their budget forces the next dispatch soon enough).  The scan is
+        bounded so a deep backlog can't turn the pop quadratic."""
+        short_cap, long_cap = self._lane_caps(bound)
+        pend = self._pending
+        take: List[Any] = []
+        rest: List[Any] = []
+        limit = min(len(pend), 4 * bound)
+        pos = 0
+        while pos < limit and len(take) < bound:
+            entry = pend[pos]
+            pos += 1
+            if self._is_short(entry[0]):
+                if short_cap > 0:
+                    short_cap -= 1
+                    take.append(entry)
+                else:
+                    rest.append(entry)
+            elif long_cap > 0:
+                long_cap -= 1
+                take.append(entry)
+            else:
+                rest.append(entry)
+        rest.extend(pend[pos:])
+        self._pending = rest
+        return take
+
+    async def _serve_batch_deadline(self, pending: List[Any]) -> None:
+        """One deadline-mode dispatch: chaos seam + per-dispatch timeout
+        around the kernel call; ANY failure answers the whole batch from
+        the CPU tables immediately and feeds the circuit breaker."""
+        if not pending:
+            return
+        topics = [p[0] for p in pending]
+        epoch = self._synced_epoch
+        rule_gen = self._synced_rule_gen
+        t0 = time.monotonic()
+        try:
+            rows = await asyncio.wait_for(
+                self._dispatch_guarded(topics), self.dispatch_timeout_s)
+        except asyncio.CancelledError:
+            # loop death mid-dispatch: the finally-failover resolves
+            self._pending = pending + self._pending
+            raise
+        except _StaleRace:
+            self._cpu_serve(pending)    # benign race: no breaker strike
+            return
+        except Exception:
+            log.debug("deadline dispatch failed; CPU trie serves the "
+                      "batch", exc_info=True)
+            self._breaker_note_failure()
+            self._cpu_serve(pending)
+            return
+        self._breaker_note_ok()
+        # EWMA dispatch-time estimate drives the partial-flush trigger
+        dt = time.monotonic() - t0
+        self._est_dispatch_s = self._est_dispatch_s * 0.7 + dt * 0.3
+        self._mint_hints(pending, rows, epoch, rule_gen)
+
+    def _cpu_serve(self, pending: List[Any]) -> None:
+        """Answer a batch from the CPU tables (host NFA walk + deep
+        trie), minting hints at the MIRROR's epoch so the device outage
+        stays invisible to publishes — this is the fallback the whole
+        ladder bottoms out on (broker/trie.py answers every query the
+        device table does)."""
+        if not pending:
+            return
+        # the host table reflects every drained delta (_seen_epoch) and
+        # the live rule gen — host answers are as fresh as serving gets
+        epoch = self._seen_epoch
+        rule_gen = self._rule_gen
+        deep = (self._deep_trie.match_many([p[0] for p in pending])
+                if self._deep else None)
+        rows_of: Dict[str, List[int]] = {}
+        for p in pending:
+            topic, fut = p[0], p[1]
+            row = rows_of.get(topic)
+            if row is None:
+                row = list(self.inc.match_host(topic))
+                if deep is not None:
+                    row.extend(self._deep[f] for f in deep[topic])
+                rows_of[topic] = row
+            self._hints.pop(topic, None)
+            self._hints[topic] = (epoch, rule_gen, *self._split_row(row))
+            if not fut.done():
+                fut.set_result(None)
+        self._evict()
+        if self.metrics is not None:
+            self.metrics.inc("broker.match.cpu_fallback", len(pending))
+            self._count_misses(pending)
+
+    # ------------------------------------------------------------------
+    # circuit breaker + brownout
+    # ------------------------------------------------------------------
+
+    def _brownout(self) -> int:
+        olp = self.olp
+        lvl = 0 if olp is None else olp.brownout_level()
+        if lvl != self._last_brownout:
+            self._last_brownout = lvl
+            if self.metrics is not None:
+                self.metrics.set("broker.match.brownout_level", lvl)
+        return lvl
+
+    def _device_ok(self) -> bool:
+        """May the next dispatch go to the device?"""
+        if self._breaker_open or not self._usable():
+            return False
+        return self._brownout() < 3
+
+    def _breaker_note_ok(self) -> None:
+        self._breaker_failures = 0
+
+    def _breaker_note_failure(self) -> None:
+        self._breaker_failures += 1
+        if (not self._breaker_open
+                and self._breaker_failures >= self.breaker_threshold):
+            self._trip_breaker()
+
+    def _trip_breaker(self) -> None:
+        self._breaker_open = True
+        self._set_breaker_metric(1)
+        log.error("match-service breaker OPEN after %d consecutive "
+                  "dispatch failures; CPU trie serves",
+                  self._breaker_failures)
+        if self.alarms is not None:
+            self.alarms.activate(
+                "match_degraded",
+                {"failures": self._breaker_failures},
+                "device match dispatch failing; serving from CPU trie",
+            )
+        sup = getattr(self, "supervisor", None)
+        if sup is not None:
+            # supervised recovery child: a crashing probe restarts per
+            # policy instead of leaving the breaker open forever
+            self._probe_child = sup.start_child(
+                "match.probe", self._probe_loop, restart="transient")
+        else:
+            self._probe_child = asyncio.ensure_future(self._probe_loop())
+
+    def _close_breaker(self) -> None:
+        self._breaker_open = False
+        self._breaker_failures = 0
+        self._set_breaker_metric(0)
+        log.warning("match-service breaker closed: device dispatch "
+                    "healthy again")
+        if self.alarms is not None:
+            self.alarms.deactivate("match_degraded")
+
+    def _set_breaker_metric(self, state: int) -> None:
+        if self.metrics is not None:
+            self.metrics.set("broker.match.breaker_state", state)
+
+    async def _probe_loop(self) -> None:
+        """Breaker recovery: every ``probe_interval``, push one canary
+        batch through the full dispatch seam (same chaos gate, same
+        timeout).  First success closes the breaker and ends the child
+        (transient — a clean return is 'recovered')."""
+        while self._running and self._breaker_open:
+            await asyncio.sleep(self.breaker_probe_interval_s)
+            if not self._running:
+                return
+            self._set_breaker_metric(2)
+            try:
+                await asyncio.wait_for(
+                    self._probe_guarded(), self.dispatch_timeout_s)
+            except asyncio.CancelledError:
+                raise
             except Exception:
-                log.debug("device batch failed; publishes fall back",
+                log.debug("match breaker probe failed; staying open",
                           exc_info=True)
-                for _, fut in pending:
-                    if not fut.done():
-                        fut.set_result(None)
+                self._set_breaker_metric(1)
+                continue
+            self._close_breaker()
+            return
+
+    async def _probe_guarded(self) -> None:
+        await self._fault_gate()
+        await asyncio.to_thread(self._probe_dispatch)
+
+    def _probe_dispatch(self) -> None:
+        """One tiny dispatch through the warmed kernel shape — proves
+        encode → device → readback end to end without touching the
+        serving counters."""
+        from ..ops import encode_batch
+
+        enc = encode_batch(self.inc, ["probe/health"], batch=64)
+        res = self.dev.match(*enc, flat_cap=self.FLAT_MULT * 64)
+        self._readback_rows(res, 1, self.dev.max_matches)
 
     def info(self) -> dict:
         return {
@@ -743,4 +1248,10 @@ class MatchService:
             "synced_epoch": self._synced_epoch,
             "uploads": self.dev.uploads,
             "delta_applies": self.dev.delta_applies,
+            "deadline": self.deadline,
+            "breaker": "open" if self._breaker_open else "closed",
+            "breaker_failures": self._breaker_failures,
+            "brownout": self._last_brownout,
+            "est_dispatch_ms": round(self._est_dispatch_s * 1e3, 3),
+            "pending": len(self._pending),
         }

@@ -12,6 +12,17 @@ but a CPU-saturated loop with an *empty* queue (every cycle spent inside
 connection handlers) never grew a queue to observe.  Sleep drift is the
 direct measurement — ``asyncio.sleep(t)`` wakes ``t + lag`` after it was
 scheduled, where ``lag`` is exactly how far behind the loop is running.
+
+**Brownout ladder** (the serve-plane extension): sustained overload
+escalates through three stages instead of flipping one binary, so the
+match serve plane degrades *latency-first* — stage 1 shrinks the serve
+batch caps (smaller kernels, lower fill latency), stage 2 sheds QoS0
+prefetches to the CPU trie (the device budget goes to acknowledged
+traffic), stage 3 is full CPU serve.  :meth:`Olp.brownout_level` derives
+the stage from how long the current overload episode has lasted: level 1
+on entry, +1 per ``escalate`` seconds hot (default: the cooloff window),
+capped at 3.  De-escalation rides the existing cooloff — once reports go
+quiet the episode ends and the level drops straight to 0.
 """
 
 from __future__ import annotations
@@ -32,12 +43,17 @@ class Olp:
         max_loop_lag: float = 0.5,
         max_queue_depth: int = 100_000,
         cooloff: float = 5.0,
+        escalate: Optional[float] = None,
     ) -> None:
         self.alarms = alarms
         self.max_loop_lag = max_loop_lag
         self.max_queue_depth = max_queue_depth
         self.cooloff = cooloff
+        # seconds of sustained overload per brownout stage; defaults to
+        # the cooloff window so the ladder and recovery share one clock
+        self.escalate = escalate if escalate is not None else cooloff
         self._overloaded_at: Optional[float] = None
+        self._hot_since: Optional[float] = None  # current episode start
         self.shed_count = 0
 
     def report(
@@ -47,6 +63,14 @@ class Olp:
         now = now if now is not None else time.time()
         hot = loop_lag > self.max_loop_lag or queue_depth > self.max_queue_depth
         if hot:
+            if self._hot_since is None or (
+                self._overloaded_at is not None
+                and now - self._overloaded_at > self.cooloff
+            ):
+                # first hot report, or overload resuming after a silent
+                # gap longer than the cooloff: a NEW episode — the ladder
+                # must not inherit the old episode's escalation
+                self._hot_since = now
             self._overloaded_at = now
             if self.alarms is not None:
                 self.alarms.activate(
@@ -59,6 +83,7 @@ class Olp:
             and now - self._overloaded_at > self.cooloff
         ):
             self._overloaded_at = None
+            self._hot_since = None
             if self.alarms is not None:
                 self.alarms.deactivate("overload")
 
@@ -67,6 +92,20 @@ class Olp:
             return False
         now = now if now is not None else time.time()
         return now - self._overloaded_at <= self.cooloff
+
+    def brownout_level(self, now: Optional[float] = None) -> int:
+        """Staged-brownout stage (0–3) for the serve plane.
+
+        0 = healthy; 1 on overload entry (shrink serve batch caps); one
+        more stage per ``escalate`` seconds of sustained overload —
+        2 sheds QoS0 prefetches to CPU, 3 is full CPU serve.  Returns to
+        0 as soon as :meth:`overloaded` clears (cooloff elapsed)."""
+        now = now if now is not None else time.time()
+        if not self.overloaded(now) or self._hot_since is None:
+            return 0
+        if self.escalate <= 0:
+            return 3
+        return 1 + min(2, int((now - self._hot_since) / self.escalate))
 
     def should_shed_connect(self, now: Optional[float] = None) -> bool:
         """New CONNECTs are the first thing shed under overload."""

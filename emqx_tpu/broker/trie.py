@@ -165,6 +165,17 @@ class FilterTrie(_TrieBase):
                     stack.append((plus, i + 1, acc + ("+",)))
         return out
 
+    def match_many(self, names: Sequence[str]) -> Dict[str, List[str]]:
+        """Batch :meth:`match` with duplicate-topic dedup — the CPU
+        fallback path of the deadline serve loop answers a whole failed
+        dispatch batch here, and publish storms repeat topics heavily
+        (one trie walk per UNIQUE topic, not per waiter)."""
+        out: Dict[str, List[str]] = {}
+        for name in names:
+            if name not in out:
+                out[name] = self.match(name)
+        return out
+
 
 class TopicTrie(_TrieBase):
     """Concrete topics indexed; match a wildcard filter against them

@@ -369,6 +369,24 @@ SCHEMA: Dict[str, Field] = {
     # window (host trie is faster at low concurrency); 0 disables
     "tpu.bypass_rate": Field(500.0, float, lambda v: v >= 0),
     "tpu.prefetch_timeout": Field(0.5, duration),
+
+    # -- deadline-aware serve plane (broker/match_service.py) -------------
+    # opt-in: replaces the fixed-window batch loop with the continuous-
+    # batching deadline loop (partial dispatch when the oldest waiter's
+    # budget nears expiry, arrival-rate-adaptive per-lane batch caps,
+    # per-dispatch timeout with CPU-trie fallback, circuit breaker +
+    # brownout ladder).  Off = the pre-deadline loop, byte-identical.
+    "match.deadline.enable": Field(False, _bool),
+    # per-prefetch latency budget in MILLISECONDS; default 41 = the
+    # measured CPU-iso serve p99 (BENCH_r05 serve_cpu_iso.p99_ms) — the
+    # device must beat the host path's tail to earn the traffic
+    "match.deadline_ms": Field(41.0, float, lambda v: v > 0),
+    # circuit breaker: consecutive device-dispatch failures (timeout or
+    # raise) before the service trips into CPU-serve mode with the
+    # match_degraded alarm; a supervised probe child closes it again
+    "match.breaker.threshold": Field(5, int, lambda v: v >= 1),
+    # cadence of the recovery probe while the breaker is open
+    "match.breaker.probe_interval": Field(1.0, duration),
 }
 
 

@@ -15,11 +15,17 @@ loop-affine asyncio APIs are bugs, not style:
 
 The rule resolves thread-entry targets per file: module-local ``def``
 names, ``self.method`` references (resolved within the enclosing
-class), and inline lambdas.  Only the DIRECT body of the entered
-function is checked — a thread target that legitimately bootstraps its
-own loop (``new_event_loop`` + ``run_forever``) delegates loop-affine
-work to code running *on* that loop, which this rule correctly leaves
-alone.
+class), and inline lambdas.  The DIRECT body of the entered function is
+checked, plus **one level of transitive call resolution**: a
+thread-entered function that *calls* a module-local helper (or a
+``self`` method of its own class) whose body contains loop-affine calls
+is flagged at the call site — the taint crosses exactly one hop, which
+is where the shard refactors actually hid bugs (a thread main
+delegating to an innocently-named ``_notify``).  A thread target (or a
+called helper) that legitimately bootstraps its own loop
+(``new_event_loop`` + ``run_forever``) delegates loop-affine work to
+code running *on* that loop, which this rule correctly leaves alone at
+either hop.
 """
 
 from __future__ import annotations
@@ -85,27 +91,33 @@ class LoopThreadTaint(Rule):
 
     def end_file(self, ctx: FileContext) -> None:
         for target, spawn, cls in self._spawns:
-            fn = self._resolve(target, cls)
+            fn, owner = self._resolve(target, cls)
             if fn is None:
                 continue
-            self._check_body(fn, spawn, ctx)
+            self._check_body(fn, owner, spawn, ctx)
 
-    def _resolve(self, target: ast.AST,
-                 cls: Optional[str]) -> Optional[ast.AST]:
+    def _resolve(
+        self, target: ast.AST, cls: Optional[str],
+    ) -> Tuple[Optional[ast.AST], Optional[str]]:
+        """Resolve a callable reference to its def in this file, plus
+        the class owning it (for resolving ``self.x()`` calls inside)."""
         if isinstance(target, ast.Lambda):
-            return target
+            return target, cls
         if isinstance(target, ast.Name):
-            return self._module_defs.get(target.id)
+            return self._module_defs.get(target.id), None
         if isinstance(target, ast.Attribute) \
                 and isinstance(target.value, ast.Name) \
                 and target.value.id == "self" and cls is not None:
-            return self._method_defs.get((cls, target.attr))
-        return None
+            return self._method_defs.get((cls, target.attr)), cls
+        return None, None
 
-    def _check_body(self, fn: ast.AST, spawn: str,
-                    ctx: FileContext) -> None:
+    @staticmethod
+    def _scan(fn: ast.AST):
+        """One pass over a function body: (affine calls, bootstraps own
+        loop?, candidate local-helper call sites)."""
         body = fn.body if isinstance(fn.body, list) else [fn.body]
         affine: List[ast.Call] = []
+        helper_calls: List[ast.Call] = []
         for stmt in body:
             for sub in ast.walk(stmt):
                 if not isinstance(sub, ast.Call):
@@ -116,9 +128,21 @@ class LoopThreadTaint(Rule):
                 if t in _LOOP_BOOT:
                     # bootstraps its own loop: loop-affine calls in this
                     # body belong to that loop
-                    return
+                    return [], True, []
                 if t in _AFFINE:
                     affine.append(sub)
+                elif isinstance(f, ast.Name) or (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id == "self"):
+                    helper_calls.append(sub)
+        return affine, False, helper_calls
+
+    def _check_body(self, fn: ast.AST, owner: Optional[str], spawn: str,
+                    ctx: FileContext) -> None:
+        affine, boots, helper_calls = self._scan(fn)
+        if boots:
+            return
         name = getattr(fn, "name", "<lambda>")
         for call in affine:
             ctx.report(
@@ -127,4 +151,24 @@ class LoopThreadTaint(Rule):
                 f"worker thread (via {spawn}); event-loop-affine calls "
                 "from a foreign thread must marshal through "
                 "call_soon_threadsafe / run_coroutine_threadsafe",
+            )
+        # one-level transitive resolution: a helper this thread-entered
+        # function calls carries the taint with it — flag the call site
+        # so the fix (marshal at the boundary) lands in the right frame
+        for call in helper_calls:
+            sub_fn, _ = self._resolve(call.func, owner)
+            if sub_fn is None or sub_fn is fn:
+                continue
+            sub_affine, sub_boots, _ = self._scan(sub_fn)
+            if sub_boots or not sub_affine:
+                continue
+            sub_name = getattr(sub_fn, "name", "<lambda>")
+            inner = ", ".join(sorted({call_name(c) for c in sub_affine}))
+            ctx.report(
+                self.name, call,
+                f"{name!r} runs on a worker thread (via {spawn}) and "
+                f"calls {sub_name!r}, whose body makes event-loop-affine "
+                f"calls ({inner}); the taint crosses the call — marshal "
+                "through call_soon_threadsafe / run_coroutine_threadsafe "
+                "at this boundary",
             )

@@ -26,7 +26,11 @@ Named injection points (the seams the batched stack crosses):
 ==================  =====================================================
 ``transport.write``  proto-conn coalesced flush (drop / dup / raise)
 ``frame.parse``      MQTT frame parser ingress (raise → FrameError path)
-``match.dispatch``   MatchService.prefetch_many (raise / delay)
+``match.dispatch``   MatchService device dispatch — both serve loops'
+                     kernel call and the breaker's recovery probe (raise
+                     / delay / hang; in deadline mode a hang is rescued
+                     by the per-dispatch timeout)
+``match.compile``    MatchService warm/compile seam (raise / delay)
 ``inflight.insert``  Inflight.insert / insert_many (raise)
 ``inflight.retry``   Inflight.older_than retry scan (raise)
 ``cluster.rpc``      PeerConn.cast — all cluster frames (drop / raise)
@@ -53,7 +57,7 @@ first rule whose schedule triggers wins that pass::
     ], seed=42))
 
 Rule fields: ``point`` (required), ``action`` (``raise`` | ``drop`` |
-``delay`` | ``dup``), ``skip`` (eligible passes let through before the
+``delay`` | ``dup`` | ``hang``), ``skip`` (eligible passes let through before the
 first fire, default 0), ``every`` (fire each Nth eligible pass, default
 1 = consecutive), ``times`` (max fires; default 1, ``0``/``None`` =
 unlimited), ``prob`` (fire probability, seeded RNG), ``delay_s`` (used
@@ -77,12 +81,12 @@ __all__ = [
 ]
 
 POINTS = (
-    "transport.write", "frame.parse", "match.dispatch",
+    "transport.write", "frame.parse", "match.dispatch", "match.compile",
     "inflight.insert", "inflight.retry", "cluster.rpc",
     "bridge.sink", "exhook.call", "fanout.drain", "shard.handoff",
 )
 
-_ACTIONS = ("raise", "drop", "delay", "dup")
+_ACTIONS = ("raise", "drop", "delay", "dup", "hang")
 
 
 class InjectedFault(Exception):
@@ -167,6 +171,18 @@ class FaultInjector:
     async def pause(self) -> None:
         """Serve the most recent ``delay`` action (async seams only)."""
         await self._sleep(self._last_delay)
+
+    async def hang(self) -> None:
+        """Serve a ``hang`` action: never returns on its own — the seam's
+        own timeout/cancellation machinery must rescue the caller (the
+        per-dispatch timeout at ``match.dispatch``, stop() elsewhere)."""
+        await asyncio.Event().wait()
+
+    @property
+    def last_delay(self) -> float:
+        """Most recent ``delay`` rule's delay_s (sync seams sleep this
+        themselves — ``pause`` needs a running loop)."""
+        return self._last_delay
 
     def info(self) -> Dict[str, Any]:
         return {

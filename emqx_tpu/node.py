@@ -644,7 +644,7 @@ class BrokerNode:
             # redundant: the pipeline batch-prefetches every topic in a
             # batch through ONE prefetch_many call at drain time
             try:
-                await self.match_service.prefetch(pkt.topic)
+                await self.match_service.prefetch(pkt.topic, qos=pkt.qos)
             except Exception:
                 log.exception("match prefetch failed (host path serves)")
         ac = self.access_control
@@ -922,6 +922,13 @@ class BrokerNode:
                 table=cfg.get("tpu.table"),
                 short_depth=cfg.get("tpu.short_depth"),
                 split_min=cfg.get("tpu.split_min"),
+                deadline=cfg.get("match.deadline.enable"),
+                deadline_s=cfg.get("match.deadline_ms") / 1e3,
+                breaker_threshold=cfg.get("match.breaker.threshold"),
+                breaker_probe_interval_s=cfg.get(
+                    "match.breaker.probe_interval"),
+                alarms=self.observed.alarms,
+                olp=self.olp,
             )
             self.match_service.supervisor = self.supervisor
             await asyncio.wait_for(

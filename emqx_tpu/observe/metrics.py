@@ -19,6 +19,7 @@ from typing import Dict, Iterable, List, Optional
 __all__ = [
     "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
     "ROBUSTNESS_METRIC_NAMES", "CONNPLANE_METRIC_NAMES",
+    "MATCH_SERVE_METRIC_NAMES",
 ]
 
 # -- the reference's fixed counter names, grouped as in emqx_metrics.erl [U]
@@ -134,6 +135,21 @@ ROBUSTNESS_METRIC_NAMES: List[str] = [
     "broker.olp.loop_lag_us",
 ]
 
+# -- deadline-aware serve plane (broker/match_service.py, opt-in via
+# match.deadline.enable).  deadline_dispatch counts partial batches the
+# loop flushed because the oldest waiter's budget was about to expire;
+# cpu_fallback counts waiters served from the CPU trie instead of the
+# device (dispatch timeout/failure, breaker open, brownout shed, loop
+# death); deadline_miss counts waiters resolved after their budget had
+# already elapsed; breaker_state is the live circuit-breaker state
+# (set: 0 closed, 1 open, 2 probing) and brownout_level the live olp
+# brownout stage (set: 0-3).
+MATCH_SERVE_METRIC_NAMES: List[str] = [
+    "broker.match.deadline_dispatch", "broker.match.cpu_fallback",
+    "broker.match.deadline_miss", "broker.match.breaker_state",
+    "broker.match.brownout_level",
+]
+
 
 class Metrics:
     """A counter table with the reference's fixed name set.
@@ -150,6 +166,7 @@ class Metrics:
         self._c.update({n: 0 for n in FANOUT_METRIC_NAMES})
         self._c.update({n: 0 for n in ROBUSTNESS_METRIC_NAMES})
         self._c.update({n: 0 for n in CONNPLANE_METRIC_NAMES})
+        self._c.update({n: 0 for n in MATCH_SERVE_METRIC_NAMES})
         if extra:
             self._c.update({n: 0 for n in extra})
 

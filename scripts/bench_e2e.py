@@ -227,12 +227,119 @@ def chaos_smoke() -> dict:
         return {"ok": bool(ok and restarts >= 1),
                 "notified": len(st.stub.calls), "restarts": restarts}
 
+    async def match_cycle():
+        """Serve-plane kill-and-recover (ISSUE 7): a clean prefetch+
+        publish storm, the same storm with the match.batch loop killed
+        mid-flight, a 10%-fault storm, then a breaker trip + recovery —
+        delivery 1.0 throughout, waiters resolved without budget-length
+        stalls, and the faulted storm's worst waiter within 2x the clean
+        one (floored at 50 ms for tiny-denominator noise)."""
+        import time as _time
+
+        from emqx_tpu import faultinject as fi
+        from emqx_tpu.broker.message import make_message
+        from emqx_tpu.config import Config
+        from emqx_tpu.faultinject import FaultInjector
+        from emqx_tpu.node import BrokerNode
+
+        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", True)
+        cfg.put("tpu.mirror_refresh_interval", 0.01)
+        cfg.put("tpu.bypass_rate", 0.0)
+        cfg.put("match.deadline.enable", True)
+        cfg.put("match.deadline_ms", 50.0)
+        cfg.put("match.breaker.threshold", 3)
+        cfg.put("match.breaker.probe_interval", 0.05)
+        cfg.put("supervisor.backoff_base", 0.005)
+        cfg.put("supervisor.backoff_max", 0.05)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            if ms is None:
+                return {"skipped": "match service unavailable"}
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            await settle(lambda: ms.ready and ms.dev.epoch == ms.inc.epoch,
+                         timeout=60)
+
+            async def storm(n, base, kill_at=None):
+                child = node.supervisor.lookup("match.batch")
+                waits = []
+                for i in range(n):
+                    topic = f"t/{base + i}/x"   # unique: every prefetch
+                    t0 = _time.perf_counter()   # parks a real waiter
+                    await ms.prefetch(topic)
+                    waits.append(_time.perf_counter() - t0)
+                    b.publish(make_message(
+                        "pub", topic, b"%d" % (base + i)))
+                    if kill_at is not None and i == kill_at:
+                        child.kill()
+                return waits
+
+            n = 120
+            clean = await storm(n, 0)
+            killed = await storm(n, 1000, kill_at=40)
+            fi.install(FaultInjector([
+                {"point": "match.dispatch", "action": "raise",
+                 "prob": 0.1, "times": 0}], seed=11))
+            wounded = await storm(n, 2000)
+            fi.uninstall()
+            # breaker trip + recovery
+            fi.install(FaultInjector([
+                {"point": "match.dispatch", "action": "raise",
+                 "times": 3}]))
+            for i in range(3):
+                await ms.prefetch(f"t/brk{i}/x")
+            tripped = bool(ms._breaker_open) and \
+                node.observed.alarms.is_active("match_degraded")
+            for i in range(10):   # CPU path keeps serving while open
+                topic = f"t/cpu{i}/x"
+                await ms.prefetch(topic)
+                b.publish(make_message("pub", topic, b"c%d" % i))
+            recovered = await settle(lambda: not ms._breaker_open,
+                                     timeout=15)
+            alarm_cleared = not node.observed.alarms.is_active(
+                "match_degraded")
+            fi.uninstall()
+
+            sent = 3 * n + 10
+            delivered = len(got)
+            restarts = node.observed.metrics.get(
+                "broker.supervisor.restarts")
+            waiter_bound = ms.prefetch_timeout_s * 0.9
+            worst = max(clean + killed + wounded)
+            p99_ratio = round(max(wounded) / max(max(clean), 1e-9), 2)
+            p99_gate = max(wounded) <= max(2.0 * max(clean), 0.05)
+            return {
+                "ok": bool(delivered == sent and restarts >= 1
+                           and tripped and recovered and alarm_cleared
+                           and worst < waiter_bound and p99_gate),
+                "delivered": delivered, "sent": sent,
+                "delivery_ratio": round(delivered / max(1, sent), 4),
+                "restarts": restarts,
+                "breaker_tripped": tripped,
+                "breaker_recovered": bool(recovered and alarm_cleared),
+                "worst_waiter_ms": round(worst * 1e3, 1),
+                "fault_vs_clean_worst_ratio": p99_ratio,
+                "cpu_fallback": node.observed.metrics.get(
+                    "broker.match.cpu_fallback"),
+            }
+        finally:
+            fi.uninstall()
+            await node.stop()
+
     async def all_cycles():
         return {
             "fanout": await fanout_cycle(),
             "cluster": await cluster_cycle(),
             "bridge": await bridge_cycle(),
             "exhook": await exhook_cycle(),
+            "match": await match_cycle(),
         }
 
     return aio.run(all_cycles())
@@ -252,7 +359,7 @@ def main(argv=None) -> dict:
         _config1_size, _config1_sweep_size, _fanout_e2e_size,
         _qos1_e2e_size, _qos2_e2e_size, bench_config1,
         bench_config1_sweep, bench_fanout_e2e, bench_qos1_e2e,
-        bench_qos2_e2e,
+        bench_qos2_e2e, bench_serve_deadline_smoke,
     )
 
     size = _fanout_e2e_size(args.smoke)
@@ -273,6 +380,11 @@ def main(argv=None) -> dict:
     # flag-off/flag-on A/B + the client-count sweep at constant load
     out["config1"] = bench_config1(**c1size)
     out["config1_sweep"] = bench_config1_sweep(**c1ssize)
+    # deadline serve A/B (ISSUE 7): static vs deadline-mode continuous
+    # batching at the same offered load, CPU-jax tiny scale — tracks
+    # structure + delivery per PR; the real ratio comes from bench.py
+    out["serve_deadline"] = bench_serve_deadline_smoke(
+        seconds=(1.2 if args.smoke else 4.0))
     if args.chaos:
         out["chaos"] = chaos_smoke()
     print(json.dumps(out, indent=2))

@@ -39,3 +39,34 @@ class ShardLike:
         asyncio.set_event_loop(self.loop)
         self.loop.call_soon(print)
         self.loop.run_forever()
+
+
+class DelegatingShard:
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._main)
+
+    def _main(self):
+        # transitive pass: the helper bootstraps its own loop, so its
+        # loop-affine calls belong to the loop it runs
+        self._boot()
+
+    def _boot(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.call_soon(print)
+        self.loop.run_forever()
+
+
+def _marshal(loop, evt):
+    # transitive pass: the helper only uses the sanctioned
+    # cross-thread entry point
+    loop.call_soon_threadsafe(evt.set)
+
+
+def _worker_ok(loop, evt):
+    time.sleep(0.05)
+    _marshal(loop, evt)
+
+
+async def offload_marshal(loop, evt):
+    await asyncio.to_thread(_worker_ok, loop, evt)

@@ -1,4 +1,4 @@
-"""Fixture: loop-thread-taint MUST flag these (3 findings)."""
+"""Fixture: loop-thread-taint MUST flag these (4 findings)."""
 
 import asyncio
 import threading
@@ -25,3 +25,19 @@ class Worker:
         # raises in a plain worker thread
         self.loop.call_later(1.0, print)
         asyncio.get_running_loop()
+
+
+def _notify():
+    # innocent-looking helper — but it schedules onto a foreign loop
+    asyncio.ensure_future(asyncio.sleep(0))
+
+
+def _worker():
+    # (4) transitive (one level): _worker runs on a thread and calls
+    # _notify, whose body is loop-affine
+    _notify()
+    return 0
+
+
+async def spawn_transitive():
+    return await asyncio.to_thread(_worker)

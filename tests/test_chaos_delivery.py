@@ -577,6 +577,203 @@ def test_chaos_coap_con_dedup_heals_dropped_reply():
 
 
 # ---------------------------------------------------------------------------
+# 7. serve plane: match batch loop killed / wounded mid-publish-storm
+#    (ISSUE 7 deadline-aware serve plane)
+# ---------------------------------------------------------------------------
+
+async def _start_match_node(**extra):
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+    cfg.put("tpu.enable", True)
+    cfg.put("tpu.mirror_refresh_interval", 0.01)
+    cfg.put("tpu.bypass_rate", 0.0)
+    cfg.put("match.deadline.enable", True)
+    cfg.put("match.deadline_ms", 50.0)
+    cfg.put("match.breaker.threshold", 3)
+    cfg.put("match.breaker.probe_interval", 0.05)
+    cfg.put("supervisor.backoff_base", 0.005)
+    cfg.put("supervisor.backoff_max", 0.05)
+    for k, v in extra.items():
+        cfg.put(k, v)
+    node = BrokerNode(cfg)
+    await node.start()
+    return node
+
+
+async def _match_storm(node, got, n, base, kill_at=None):
+    """Prefetch+publish storm through the serve plane; returns per-
+    prefetch wall times (the waiter-resolution latencies the deadline
+    machinery must bound).  Topics are UNIQUE per message so every
+    prefetch really parks a waiter on the serve loop — repeated topics
+    would serve from the hint cache and never touch it."""
+    import time as _time
+
+    from emqx_tpu.broker.message import make_message
+
+    b = node.broker
+    ms = node.match_service
+    child = node.supervisor.lookup("match.batch")
+    waits = []
+    for i in range(n):
+        topic = f"t/{base + i}/x"
+        t0 = _time.perf_counter()
+        await ms.prefetch(topic)
+        waits.append(_time.perf_counter() - t0)
+        b.publish(make_message("pub", topic, b"%d" % (base + i)))
+        if kill_at is not None and i == kill_at:
+            assert child.kill()
+    return waits
+
+
+def test_chaos_match_batch_kill_midstorm_delivery_holds():
+    """Kill the match.batch serve loop mid-publish-storm (twice):
+    delivery_ratio stays 1.0, every prefetch waiter resolves well under
+    the budget-length stall the old loop burned, and the supervisor
+    restart re-arms the loop (device serves again)."""
+
+    async def main():
+        node = await _start_match_node()
+        try:
+            b = node.broker
+            ms = node.match_service
+            assert ms is not None and ms.deadline
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(
+                lambda: ms.ready and ms.dev.epoch == ms.inc.epoch,
+                timeout=60)
+
+            n = 160
+            waits = await _match_storm(node, got, n, 0, kill_at=40)
+            waits += await _match_storm(node, got, n, 1000, kill_at=90)
+            # delivery_ratio 1.0: every publish delivered exactly once
+            assert len(got) == 2 * n
+            assert sorted(int(x) for x in got) == sorted(
+                list(range(n)) + list(range(1000, 1000 + n)))
+            # every waiter resolved without a budget-length stall: the
+            # old loop parked killed waiters for prefetch_timeout_s (0.5)
+            assert max(waits) < ms.prefetch_timeout_s * 0.9, max(waits)
+            m = node.observed.metrics
+            assert m.get("broker.supervisor.restarts") >= 2
+            assert m.get("broker.match.cpu_fallback") >= 0
+            # the restarted loop serves from the device again
+            assert await until(
+                lambda: ms.ready and ms.dev.epoch == ms.inc.epoch)
+            await ms.prefetch("t/readback/x")
+            assert ms.hint_routes("t/readback/x") is not None
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_chaos_match_dispatch_faults_storm_delivery_holds():
+    """10% injected match.dispatch faults through a publish storm:
+    delivery_ratio 1.0, every waiter resolved promptly (failed batches
+    answer from the CPU tables in one hop, no budget-length stalls)."""
+
+    async def main():
+        node = await _start_match_node()
+        try:
+            b = node.broker
+            ms = node.match_service
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(
+                lambda: ms.ready and ms.dev.epoch == ms.inc.epoch,
+                timeout=60)
+            # fault-free baseline storm, then the wounded storm
+            n = 150
+            clean = await _match_storm(node, got, n, 0)
+            inj = faultinject.install(FaultInjector([
+                {"point": "match.dispatch", "action": "raise",
+                 "prob": 0.1, "times": 0},
+            ], seed=11))
+            try:
+                wounded = await _match_storm(node, got, n, 2000)
+            finally:
+                faultinject.uninstall()
+            assert len(got) == 2 * n           # delivery_ratio 1.0
+            assert len(set(got)) == 2 * n      # exactly once
+            assert inj.fired.get("match.dispatch", 0) >= 1
+            # no waiter stalled anywhere near the prefetch timeout: a
+            # raised dispatch resolves its whole batch from CPU NOW
+            assert max(wounded) < ms.prefetch_timeout_s * 0.9
+            m = node.observed.metrics
+            assert m.get("broker.match.cpu_fallback") >= 1
+            # tail didn't collapse: the wounded storm stays within 2x
+            # the fault-free storm's worst waiter (plus a floor for
+            # scheduler noise on tiny absolute numbers)
+            assert max(wounded) <= max(2.0 * max(clean), 0.1), (
+                max(clean), max(wounded))
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_chaos_match_breaker_cpu_serve_with_alarm_and_recovery():
+    """Breaker trip under persistent dispatch failures: serving
+    continues on the CPU path with the match_degraded alarm active, and
+    the supervised probe closes the breaker + clears the alarm once the
+    device answers again (acceptance gate, ISSUE 7)."""
+
+    async def main():
+        from emqx_tpu.broker.message import make_message
+
+        node = await _start_match_node()
+        try:
+            b = node.broker
+            ms = node.match_service
+            alarms = node.observed.alarms
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(
+                lambda: ms.ready and ms.dev.epoch == ms.inc.epoch,
+                timeout=60)
+            await ms.prefetch("t/warm/x")
+            inj = faultinject.install(FaultInjector([
+                {"point": "match.dispatch", "action": "raise", "times": 3},
+            ]))
+            try:
+                for i in range(3):
+                    await ms.prefetch(f"t/f{i}/x")
+                assert ms._breaker_open
+                assert alarms.is_active("match_degraded")
+                # serving continues on the CPU path while open
+                for i in range(20):
+                    await ms.prefetch(f"t/open{i}/x")
+                    b.publish(make_message(
+                        "pub", f"t/open{i}/x", b"o%d" % i))
+                assert len(got) == 20
+                # faults exhausted → probe closes breaker, alarm clears
+                assert await until(lambda: not ms._breaker_open,
+                                   timeout=15)
+                assert not alarms.is_active("match_degraded")
+            finally:
+                faultinject.uninstall()
+            assert inj.fired.get("match.dispatch") == 3
+            # recovered: the device mints hints again
+            await ms.prefetch("t/rec/x")
+            assert ms.hint_routes("t/rec/x") is not None
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
 # 8. shard loop killed mid-QoS1 traffic (PR 6 connection-plane sharding)
 # ---------------------------------------------------------------------------
 

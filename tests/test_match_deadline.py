@@ -1,0 +1,442 @@
+"""Deadline-aware serve plane (ISSUE 7): latency budgets, partial
+dispatch, adaptive per-lane batch caps, per-dispatch timeout with
+CPU-trie fallback, circuit breaker with supervised recovery probe, and
+the staged olp brownout ladder.
+
+The flag-off path (match.deadline.enable = false, the default) is the
+pre-deadline fixed-window loop and is covered by the pre-existing
+tests/test_match_service.py suite — which this PR keeps passing
+unchanged.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from emqx_tpu import faultinject
+from emqx_tpu import topic as T
+from emqx_tpu.broker.olp import Olp
+from emqx_tpu.config import Config
+from emqx_tpu.faultinject import FaultInjector
+from emqx_tpu.node import BrokerNode
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def settle(pred, timeout=60.0, interval=0.01):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return pred()
+
+
+def make_node(**extra):
+    cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+    cfg.put("tpu.enable", True)  # env layer disables it for other tests
+    cfg.put("tpu.mirror_refresh_interval", 0.01)
+    cfg.put("tpu.bypass_rate", 0.0)  # pin the device path on
+    cfg.put("match.deadline.enable", True)
+    cfg.put("match.deadline_ms", 60.0)
+    cfg.put("match.breaker.threshold", 3)
+    cfg.put("match.breaker.probe_interval", 0.05)
+    cfg.put("supervisor.backoff_base", 0.005)
+    cfg.put("supervisor.backoff_max", 0.05)
+    for k, v in extra.items():
+        cfg.put(k, v)
+    return BrokerNode(cfg)
+
+
+def sub(b, cid, flt):
+    if cid not in b.sessions:
+        b.open_session(cid)
+    b.subscribe(cid, flt)
+
+
+def ms_synced(node):
+    ms = node.match_service
+    return (
+        ms is not None and ms.ready
+        and ms._seen_epoch == node.broker.router.epoch
+        and ms.dev.epoch == ms.inc.epoch
+    )
+
+
+# ---------------------------------------------------------------------------
+# olp brownout ladder (pure unit, injected clock)
+# ---------------------------------------------------------------------------
+
+def test_olp_brownout_ladder_escalates_and_recovers():
+    o = Olp(max_queue_depth=10, cooloff=1.0)
+    assert o.brownout_level(now=0.0) == 0
+    o.report(queue_depth=100, now=0.0)
+    assert o.brownout_level(now=0.0) == 1          # entry: stage 1
+    o.report(queue_depth=100, now=0.9)
+    assert o.brownout_level(now=1.1) == 2          # sustained: stage 2
+    o.report(queue_depth=100, now=1.9)
+    assert o.brownout_level(now=2.1) == 3          # stage 3 (capped)
+    o.report(queue_depth=100, now=2.9)
+    assert o.brownout_level(now=3.5) == 3          # still within cooloff
+    o.report(queue_depth=0, now=4.5)               # cool report past cooloff
+    assert o.brownout_level(now=4.5) == 0          # straight back to 0
+
+
+def test_olp_brownout_new_episode_resets_escalation():
+    o = Olp(max_queue_depth=10, cooloff=1.0)
+    o.report(queue_depth=100, now=0.0)
+    o.report(queue_depth=100, now=0.9)
+    assert o.brownout_level(now=1.0) == 2
+    # silent gap > cooloff: overload cleared on its own; the next hot
+    # report starts a NEW episode at stage 1, not stage 3
+    o.report(queue_depth=100, now=5.0)
+    assert o.brownout_level(now=5.0) == 1
+
+
+# ---------------------------------------------------------------------------
+# deadline loop: parity + partial dispatch + adaptive caps
+# ---------------------------------------------------------------------------
+
+def test_deadline_loop_serves_with_parity():
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            assert ms is not None and ms.deadline
+            for i in range(4):
+                sub(b, f"s{i}", f"room/+/k{i}")
+            assert await settle(lambda: ms_synced(node))
+            topics = [f"room/{i}/k{i % 4}" for i in range(24)]
+            await asyncio.gather(*[ms.prefetch(t) for t in topics])
+            for t in topics:
+                hint = ms.hint_routes(t)
+                assert hint is not None, t
+                want = b.router.match_routes(t)
+                assert sorted(map(tuple, hint)) == sorted(map(tuple, want))
+            m = node.observed.metrics
+            assert m.get("tpu.match.batches") >= 1
+            assert ms.info()["breaker"] == "closed"
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_partial_dispatch_on_budget_expiry():
+    """With the adaptive bound far above the queued count, the loop must
+    flush a PARTIAL batch once the oldest waiter's budget (minus the
+    dispatch estimate) runs out — within the budget, not at batch-full."""
+
+    async def main():
+        node = make_node(**{"match.deadline_ms": 80.0})
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            sub(b, "c1", "a/+")
+            assert await settle(lambda: ms_synced(node))
+            await ms.prefetch("a/warm")   # pay the first-dispatch costs
+            # pin the arrival-rate estimate high so bound == max_batch,
+            # far above the 3 waiters below — only the budget can flush
+            ms._rate_ewma = 1e9
+            t0 = time.perf_counter()
+            await asyncio.gather(*[ms.prefetch(f"a/p{i}") for i in range(3)])
+            el = time.perf_counter() - t0
+            # resolved by the deadline (plus dispatch + margin), far
+            # below the prefetch timeout the old loop would burn
+            assert el < 0.4, el
+            for i in range(3):
+                assert ms.hint_routes(f"a/p{i}") is not None
+            assert node.observed.metrics.get(
+                "broker.match.deadline_dispatch") >= 1
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_adaptive_bound_and_lane_caps():
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            ms = node.match_service
+            # bound follows the EWMA arrival rate under the budget
+            ms._est_dispatch_s = 0.01
+            ms._rate_ewma = 1000.0   # 1k/s, 60 ms budget - 10 ms est
+            b1 = ms._deadline_bound()
+            assert 1 <= b1 <= ms.max_batch
+            assert b1 == int(1000.0 * (ms.deadline_s - 0.01))
+            ms._rate_ewma = 1e9
+            assert ms._deadline_bound() == ms.max_batch
+
+            # brownout stage 1/2 shrinks the cap (half, quarter)
+            class FakeOlp:
+                lvl = 0
+
+                def brownout_level(self, now=None):
+                    return self.lvl
+
+            ms.olp = FakeOlp()
+            ms.olp.lvl = 1
+            assert ms._deadline_bound() == ms.max_batch >> 1
+            ms.olp.lvl = 2
+            assert ms._deadline_bound() == ms.max_batch >> 2
+            ms.olp = None
+
+            # per-lane caps: a deep-topic flood cannot starve the short
+            # lane; skipped waiters stay queued in order
+            ms._short_frac = 0.5
+            short_cap, long_cap = ms._lane_caps(8)
+            assert 1 <= short_cap <= 8 and 1 <= long_cap <= 8
+            loop = asyncio.get_running_loop()
+            mk = lambda t: (t, loop.create_future(), loop.time() + 1.0)
+            deep = [mk(f"a/b/c/d/e/f{i}") for i in range(10)]
+            shallow = [mk(f"s{i}") for i in range(4)]
+            ms._pending = deep + shallow
+            batch = ms._pop_batch(8)
+            lanes = [t.count("/") < ms.short_depth for t, _f, _d in batch]
+            assert any(lanes), "short lane starved by the deep flood"
+            # order preserved within what stayed queued
+            left = [t for t, _f, _d in ms._pending]
+            assert left == sorted(left, key=lambda t: (
+                [p[0] for p in deep + shallow].index(t)))
+            for p in ms._pending:   # clean up the fabricated waiters
+                p[1].cancel()
+            ms._pending = []
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# robustness: dispatch timeout, breaker, brownout shed, loop death
+# ---------------------------------------------------------------------------
+
+def test_dispatch_hang_times_out_to_cpu_fallback():
+    """A hung device dispatch must cost ONE dispatch timeout — the batch
+    is answered from the CPU tables (hints minted, host parity), never
+    the full prefetch timeout per waiter."""
+
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            sub(b, "c1", "a/+")
+            assert await settle(lambda: ms_synced(node))
+            await ms.prefetch("a/warm")
+            faultinject.install(FaultInjector([
+                {"point": "match.dispatch", "action": "hang", "times": 1},
+            ]))
+            t0 = time.perf_counter()
+            await ms.prefetch("a/hung")
+            el = time.perf_counter() - t0
+            assert el < ms.prefetch_timeout_s * 0.9, el
+            hint = ms.hint_routes("a/hung")
+            assert hint is not None, "CPU fallback minted no hint"
+            want = b.router.match_routes("a/hung")
+            assert sorted(map(tuple, hint)) == sorted(map(tuple, want))
+            m = node.observed.metrics
+            assert m.get("broker.match.cpu_fallback") >= 1
+            assert ms._breaker_failures >= 1   # counted toward the breaker
+        finally:
+            faultinject.uninstall()
+            await node.stop()
+
+    run(main())
+
+
+def test_breaker_trips_probes_and_recovers():
+    """threshold consecutive dispatch failures → breaker OPEN: CPU-serve
+    mode, match_degraded alarm, breaker_state metric; the supervised
+    probe child closes it (and clears the alarm) once the device answers
+    again — here, once the injected faults exhaust."""
+
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            m = node.observed.metrics
+            alarms = node.observed.alarms
+            sub(b, "c1", "a/+")
+            assert await settle(lambda: ms_synced(node))
+            await ms.prefetch("a/warm")
+            inj = faultinject.install(FaultInjector([
+                {"point": "match.dispatch", "action": "raise", "times": 3},
+            ]))
+            for i in range(3):
+                await ms.prefetch(f"a/f{i}")
+                # failed dispatches still answer from CPU immediately
+                assert ms.hint_routes(f"a/f{i}") is not None
+            assert ms._breaker_open
+            assert alarms.is_active("match_degraded")
+            assert m.get("broker.match.breaker_state") >= 1
+            # the probe registered as a supervised child
+            assert node.supervisor.lookup("match.probe") is not None
+            # while open: prefetch short-circuits (no waiter, no budget)
+            t0 = time.perf_counter()
+            await ms.prefetch("a/open")
+            assert time.perf_counter() - t0 < 0.05
+            assert m.get("broker.match.cpu_fallback") >= 4
+            # faults exhausted → the next probe closes the breaker
+            assert await settle(lambda: not ms._breaker_open, timeout=15)
+            assert not alarms.is_active("match_degraded")
+            assert m.get("broker.match.breaker_state") == 0
+            assert inj.fired.get("match.dispatch") == 3
+            # device serves again
+            await ms.prefetch("a/back")
+            assert ms.hint_routes("a/back") is not None
+        finally:
+            faultinject.uninstall()
+            await node.stop()
+
+    run(main())
+
+
+def test_brownout_sheds_qos0_then_everything():
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            m = node.observed.metrics
+            sub(b, "c1", "a/+")
+            assert await settle(lambda: ms_synced(node))
+
+            class FakeOlp:
+                lvl = 0
+
+                def brownout_level(self, now=None):
+                    return self.lvl
+
+            ms.olp = FakeOlp()
+            # stage 2: QoS0 prefetches shed to CPU, QoS1+ still device
+            ms.olp.lvl = 2
+            before = m.get("broker.match.cpu_fallback")
+            await ms.prefetch("a/q0", qos=0)
+            assert m.get("broker.match.cpu_fallback") == before + 1
+            assert ms.hint_routes("a/q0") is None   # host trie serves it
+            await ms.prefetch("a/q1", qos=1)
+            assert ms.hint_routes("a/q1") is not None  # device served
+            assert m.get("broker.match.brownout_level") == 2
+            # stage 3: full CPU serve regardless of QoS
+            ms.olp.lvl = 3
+            t0 = time.perf_counter()
+            await ms.prefetch("a/q2", qos=2)
+            assert time.perf_counter() - t0 < 0.05
+            assert ms.hint_routes("a/q2") is None
+            # recovery: back to device serving
+            ms.olp.lvl = 0
+            await ms.prefetch("a/rec", qos=0)
+            assert ms.hint_routes("a/rec") is not None
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def _kill_failover_body(deadline: bool):
+    async def main():
+        extra = {} if deadline else {"match.deadline.enable": False}
+        node = make_node(**extra)
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            assert ms.deadline is deadline
+            sub(b, "c1", "a/+")
+            assert await settle(lambda: ms_synced(node))
+            await ms.prefetch("a/warm")
+            child = node.supervisor.lookup("match.batch")
+            assert child is not None
+            # park a waiter, then kill the serve loop under it
+            task = asyncio.ensure_future(ms.prefetch("a/kill"))
+            await asyncio.sleep(0)          # waiter enqueued
+            t0 = time.perf_counter()
+            assert child.kill()
+            await task
+            el = time.perf_counter() - t0
+            # the bugfix: resolved on loop DEATH, not after the full
+            # prefetch_timeout_s (0.5 s) stall the old code burned
+            assert el < 0.2, el
+            assert node.observed.metrics.get(
+                "broker.match.cpu_fallback") >= 1
+            # restart re-arms: the next prefetch is served by the device
+            assert await settle(lambda: child.alive(), timeout=10)
+            assert await settle(lambda: ms_synced(node))
+            await ms.prefetch("a/again")
+            assert ms.hint_routes("a/again") is not None
+            assert node.observed.metrics.get(
+                "broker.supervisor.restarts") >= 1
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_deadline_loop_death_fails_waiters_over_immediately():
+    _kill_failover_body(deadline=True)
+
+
+def test_legacy_loop_death_fails_waiters_over_immediately():
+    """The satellite bugfix applies to the default fixed-window loop
+    too: kill → waiters resolve now; restart → re-armed wake."""
+    _kill_failover_body(deadline=False)
+
+
+def test_match_compile_fault_host_serves_then_recovers():
+    """An injected fault at the match.compile seam (the warm/compile
+    step) rides the sync loop's failure path: the node still starts,
+    the host path serves, and the retry heals the mirror."""
+
+    async def main():
+        faultinject.install(FaultInjector([
+            {"point": "match.compile", "action": "raise", "times": 1},
+        ]))
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            assert ms is not None
+            sub(b, "c1", "a/+")
+            # the first warm raised; the 1 s retry re-syncs and serves
+            assert await settle(lambda: ms_synced(node), timeout=60)
+            inj = faultinject.get()
+            assert inj is not None and inj.fired.get("match.compile") == 1
+            await ms.prefetch("a/x")
+            assert ms.hint_routes("a/x") is not None
+        finally:
+            faultinject.uninstall()
+            await node.stop()
+
+    run(main())
+
+
+def test_deadline_default_off_keeps_legacy_loop():
+    async def main():
+        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", True)
+        cfg.put("tpu.mirror_refresh_interval", 0.01)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            ms = node.match_service
+            assert ms is not None
+            assert ms.deadline is False          # opt-in stays off
+            assert ms.info()["deadline"] is False
+        finally:
+            await node.stop()
+
+    run(main())

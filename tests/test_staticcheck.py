@@ -91,7 +91,7 @@ def test_waiver_file_has_no_silent_suppressions():
 
 @pytest.mark.parametrize("rule,trip,ok,n_trip", [
     ("no-unsupervised-task", "trip_tasks.py", "ok_tasks.py", 3),
-    ("loop-thread-taint", "trip_threads.py", "ok_threads.py", 3),
+    ("loop-thread-taint", "trip_threads.py", "ok_threads.py", 4),
     ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
     ("no-swallowed-exceptions", "trip_exceptions.py",
      "ok_exceptions.py", 2),
