@@ -285,7 +285,7 @@ class MatchService:
         try:
             self.router.listeners.remove(self._on_router_mutation)
         except ValueError:
-            pass
+            pass  # already unhooked (double stop is legal)
 
     # ------------------------------------------------------------------
     # mirror maintenance (event loop)
@@ -944,6 +944,8 @@ class MatchService:
         try:
             now = asyncio.get_running_loop().time()
         except RuntimeError:
+            # no running loop (direct sync call in tests): deadline
+            # accounting is loop-time based, so there is nothing to count
             return
         late = sum(1 for p in pending if len(p) > 2 and now > p[2])
         if late:

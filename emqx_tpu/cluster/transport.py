@@ -147,7 +147,8 @@ class PeerConn:
                 if resp is not None and frame.seq:
                     self.reply(frame, resp)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
-            pass
+            pass  # peer socket died: normal churn — the finally below
+            #     runs the close path and the reconnect loop heals it
         except asyncio.CancelledError:
             raise
         except Exception:

@@ -2,47 +2,70 @@
 
 Upstream EMQX wires dialyzer + xref passes into CI to keep concurrency
 and API invariants honest (SURVEY.md); this package is the equivalent
-cost floor for our 143-module asyncio hot path.  It is a small AST
-framework (one parse + one walk per file, every rule riding the same
-walker) plus a battery of project-specific rules:
+cost floor for our asyncio hot path.  It is a **two-pass whole-program
+analysis**: pass 1 (:mod:`.symbols`) walks every file once and builds
+the project symbol table + import graph (module-qualified functions and
+methods, ``from .x import y`` aliases, class MRO for ``self.`` calls,
+call/write/spawn edges); pass 2 (:mod:`.graph` + the per-file walker in
+:mod:`.core`) runs the rules against **resolved callees** instead of
+syntactic names — per-file rules ride one shared walker, graph rules
+(affinity, deep taint) run over the whole-program call graph.  Pass-1
+summaries and per-file findings cache under ``.staticcheck_cache/``
+(:mod:`.cache`) so the tier-1 full-tree scan stays ~1 s warm.
 
 ================  =====================================================
 no-unsupervised-task   ``asyncio.create_task``/``ensure_future`` outside
                        :mod:`emqx_tpu.supervise` registration, a
                        supervised-with-fallback branch, or an allowlisted
                        request-scoped site (``project.ALLOWED_TASK_SITES``)
+loop-thread-taint      event-loop-affine asyncio calls reachable at ANY
+                       call depth from worker-thread entries
+                       (``to_thread``/``run_in_executor``/``Thread``),
+                       across module boundaries
+shard-affinity         writes to main-loop-owned state (Broker/Router/
+                       MatchService; Session/Channel fields outside the
+                       documented RLock set) reachable from shard-affine
+                       code without the channel RLock held — the prose
+                       invariants of transport/shards.py, checked
 no-blocking-in-async   ``time.sleep``, sync socket/DNS/subprocess/HTTP
                        and sync file IO inside ``async def``
 no-swallowed-exceptions  bare/overbroad ``except`` whose handler drops
-                       the error without logging, re-raising, or
-                       handling it — delivery-path modules only
+                       the error, and narrow silent handlers with no
+                       written-down reason — delivery-path modules only
 await-under-lock       blocking waits (``asyncio.sleep``/``wait``/
                        ``Event.wait``/nested lock acquisition) while an
                        ``asyncio.Lock`` is held
 registry-drift         every literal metric / config key / faultinject
                        point / alarm name must exist at its registration
-                       site (``observe/metrics.py``, ``config.py``,
-                       ``faultinject.py``, an ``activate`` call)
-unawaited-coroutine    coroutine calls whose result is discarded
+                       site — including the metric *reads* bench.py and
+                       scripts/bench_e2e.py consume by literal
+unawaited-coroutine    coroutine calls whose result is discarded —
+                       resolved across modules and through the MRO
 ================  =====================================================
 
 Run it::
 
     python scripts/staticcheck.py                 # whole tree, all rules
     python scripts/staticcheck.py --rule registry-drift emqx_tpu/broker
+    python scripts/staticcheck.py --changed        # git-diff + dependents
+    python scripts/staticcheck.py --no-cache       # full cold scan
     python scripts/staticcheck.py --baseline write # stamp a waiver file
 
 Waivers expire (``waivers.py``); an expired waiver stops suppressing and
-is itself reported, so suppressions can never silently rot.  Tier-1
-enforcement lives in ``tests/test_staticcheck.py``.
+is itself reported, so suppressions can never silently rot.  Ownership
+facts (affinity seeds, owned classes, RLock field sets) are declarative
+tables in ``project.py``.  Tier-1 enforcement lives in
+``tests/test_staticcheck.py``.
 """
 
-from .core import Finding, Rule, check_file, check_paths, iter_py_files
+from .core import (AnalysisResult, Finding, Rule, analyze, check_file,
+                   check_paths, iter_py_files)
 from .registry import Registries
 from .rules import ALL_RULES, get_rules
 from .waivers import WaiverFile
 
 __all__ = [
-    "Finding", "Rule", "Registries", "WaiverFile",
-    "ALL_RULES", "get_rules", "check_file", "check_paths", "iter_py_files",
+    "AnalysisResult", "Finding", "Rule", "Registries", "WaiverFile",
+    "ALL_RULES", "analyze", "get_rules", "check_file", "check_paths",
+    "iter_py_files",
 ]

@@ -1,0 +1,177 @@
+"""Analysis cache: the full-tree scan stays ~1 s as the tree grows.
+
+Per-file pass-1 summaries and per-file rule findings persist under
+``.staticcheck_cache/cache.json``, keyed so staleness is impossible:
+
+* a **summary** is valid while the file's content hash matches
+  (``(mtime, size)`` is the fast path that avoids re-reading);
+* **findings** are valid while, additionally, the **environment
+  digest** (rule set + registry contents + the ownership-facts module
+  itself) and the file's **transitive import-closure digest** match —
+  a change to any module a file resolves against invalidates exactly
+  the files that could see it, nothing else.
+
+Cross-file findings (affinity propagation, alarm pairing) are cheap
+graph passes over the summaries and are recomputed every run — only
+the parse+walk work is cached.  ``scripts/staticcheck.py --no-cache``
+bypasses the whole mechanism.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .core import Finding
+from .symbols import ModuleSummary
+
+__all__ = ["AnalysisCache", "environment_digest", "CACHE_VERSION"]
+
+CACHE_VERSION = 1
+
+
+def environment_digest(rule_names, registries=None,
+                       package_root: Optional[str] = None) -> str:
+    """Digest of everything *besides the file itself* that per-file
+    findings depend on: the rule set, the extracted registries, and the
+    ownership-facts module (project.py) source."""
+    h = hashlib.sha1()
+    h.update(f"v{CACHE_VERSION};".encode())
+    h.update(";".join(sorted(rule_names)).encode())
+    if registries is not None:
+        for names in (registries.metric_names, registries.config_keys,
+                      registries.fault_points, registries.hook_points):
+            h.update(";".join(sorted(names)).encode())
+            h.update(b"|")
+    policy = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "project.py")
+    try:
+        with open(policy, "rb") as f:
+            h.update(hashlib.sha1(f.read()).hexdigest().encode())
+    except OSError:
+        pass
+    return h.hexdigest()
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return {"rule": f.rule, "path": f.path, "line": f.line,
+            "col": f.col, "message": f.message, "context": f.context}
+
+
+class AnalysisCache:
+    """The on-disk cache + validity logic.  All lookups are by
+    repo-relative path; content digests make renames/moves safe."""
+
+    def __init__(self, directory: str, env: str) -> None:
+        self.directory = directory
+        self.path = os.path.join(directory, "cache.json")
+        self.env = env
+        self._files: Dict[str, dict] = {}
+        self._dirty = False
+        self.hits = 0
+        self.misses = 0
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        if data.get("version") != CACHE_VERSION \
+                or data.get("env") != self.env:
+            return
+        files = data.get("files")
+        if isinstance(files, dict):
+            self._files = files
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"version": CACHE_VERSION, "env": self.env,
+                           "files": self._files}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # caching is best-effort; the scan already ran
+
+    # -- summaries -----------------------------------------------------
+
+    def summary(self, relpath: str, path: str) -> Optional[
+            Tuple[ModuleSummary, str]]:
+        """Cached (summary, digest) when the file is byte-identical.
+        Stat fast path first; on stat mismatch the content hash
+        decides (and refreshes the stat)."""
+        entry = self._files.get(relpath)
+        if entry is None:
+            return None
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        if entry.get("mtime") == st.st_mtime \
+                and entry.get("size") == st.st_size:
+            summary = ModuleSummary.from_dict(entry["summary"])
+            self.hits += 1
+            return summary, entry["digest"]
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        digest = hashlib.sha1(source.encode()).hexdigest()
+        if digest != entry.get("digest"):
+            return None
+        entry["mtime"] = st.st_mtime
+        entry["size"] = st.st_size
+        self._dirty = True
+        self.hits += 1
+        return ModuleSummary.from_dict(entry["summary"]), digest
+
+    def store_summary(self, relpath: str, path: str,
+                      summary: ModuleSummary) -> None:
+        try:
+            st = os.stat(path)
+            mtime, size = st.st_mtime, st.st_size
+        except OSError:
+            mtime, size = 0, 0
+        self._files[relpath] = {
+            "mtime": mtime, "size": size, "digest": summary.digest,
+            "summary": summary.to_dict(), "findings": None,
+        }
+        self.misses += 1
+        self._dirty = True
+
+    # -- per-file findings ---------------------------------------------
+
+    def findings(self, relpath: str, digest: str,
+                 deps_digest: str) -> Optional[List[Finding]]:
+        entry = self._files.get(relpath)
+        if entry is None or entry.get("digest") != digest:
+            return None
+        cached = entry.get("findings")
+        if not isinstance(cached, dict) \
+                or cached.get("deps") != deps_digest:
+            return None
+        return [Finding(**d) for d in cached["items"]]
+
+    def store_findings(self, relpath: str, deps_digest: str,
+                       findings: List[Finding]) -> None:
+        entry = self._files.get(relpath)
+        if entry is None:
+            return
+        entry["findings"] = {
+            "deps": deps_digest,
+            "items": [_finding_to_dict(f) for f in findings],
+        }
+        self._dirty = True
+
+    def prune(self, live_relpaths) -> None:
+        """Drop entries for files no longer in the scan set."""
+        live = set(live_relpaths)
+        dead = [p for p in self._files if p not in live]
+        for p in dead:
+            del self._files[p]
+            self._dirty = True

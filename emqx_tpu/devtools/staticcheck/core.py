@@ -1,4 +1,13 @@
-"""Analysis core: one parse, one walk, every rule on the same walker.
+"""Analysis core: two passes, one parse, every rule on the same walker.
+
+**Pass 1** walks every file once and builds the project symbol table +
+import graph (:mod:`.symbols` / :mod:`.graph`): module-qualified
+functions and methods, ``from .x import y`` aliases, class MRO for
+``self.`` calls, call/write/spawn edges.  **Pass 2** runs the rules —
+the per-file walker below for local rules (now resolving callees
+through ``ctx.resolve_call`` instead of matching syntactic names), and
+the graph rules (shard-affinity, deep loop-thread-taint) over the
+whole-program call graph in ``finalize``.
 
 The walker maintains the context rules actually need for asyncio
 invariants — the enclosing function stack (with async-ness), the class
@@ -14,11 +23,12 @@ import ast
 import hashlib
 import os
 from dataclasses import dataclass
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import (Any, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Tuple)
 
 __all__ = [
-    "Finding", "Rule", "FileContext", "Walker",
-    "check_file", "check_paths", "iter_py_files",
+    "Finding", "Rule", "FileContext", "Walker", "AnalysisResult",
+    "analyze", "check_file", "check_paths", "iter_py_files",
     "call_name", "terminal_name",
 ]
 
@@ -114,11 +124,17 @@ class FileContext:
     surroundings while the walker descends."""
 
     def __init__(self, path: str, relpath: str, tree: ast.Module,
-                 source: str) -> None:
+                 source: str, project: Any = None) -> None:
         self.path = path
         self.relpath = relpath
         self.tree = tree
         self.source = source
+        #: the whole-program symbol graph (graph.Project); set for every
+        #: analyze()/check_paths() run, None only for bare check_file
+        self.project = project
+        self.summary = None
+        if project is not None:
+            self.summary = project.by_relpath.get(relpath)
         self.findings: List[Finding] = []
         # walk state (maintained by Walker)
         self.func_stack: List[_Func] = []
@@ -176,6 +192,30 @@ class FileContext:
                 return True
         return False
 
+    def resolve_call(self, node: ast.Call):
+        """Resolve a call's receiver chain through the project symbol
+        graph: a :class:`graph.Resolution` (project function / class /
+        external dotted name) or None when unresolvable or when no
+        project is attached."""
+        if self.project is None or self.summary is None:
+            return None
+        from .symbols import chain_of
+        chain = chain_of(node.func)
+        if chain is None:
+            return None
+        fn = self.summary.functions.get(self.qualname())
+        return self.project.resolve(self.summary, fn, chain)
+
+    def resolved_name(self, node: ast.Call) -> Optional[str]:
+        """External dotted name a call resolves to (after import-alias
+        substitution): ``from time import sleep as zz; zz()`` →
+        ``"time.sleep"``.  None for project-internal or unresolvable
+        targets."""
+        r = self.resolve_call(node)
+        if r is not None and r.kind == "external":
+            return r.external
+        return None
+
     def report(self, rule: str, node: ast.AST, message: str) -> None:
         self.findings.append(Finding(
             rule=rule, path=self.relpath,
@@ -232,6 +272,9 @@ class Rule:
 
     def begin_run(self) -> None:
         """Called once before any file (reset cross-file state)."""
+
+    def begin_project(self, project: Any) -> None:
+        """Called once after pass 1, with the whole-program graph."""
 
     def begin_file(self, ctx: FileContext) -> None:
         """Called before walking each file."""
@@ -351,7 +394,8 @@ def _relpath(path: str, root: Optional[str]) -> str:
 
 
 def check_file(path: str, rules: Sequence[Rule],
-               root: Optional[str] = None) -> List[Finding]:
+               root: Optional[str] = None,
+               project: Any = None) -> List[Finding]:
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
     relpath = _relpath(path, root)
@@ -363,21 +407,126 @@ def check_file(path: str, rules: Sequence[Rule],
             col=e.offset or 0, message=f"file does not parse: {e.msg}",
             context="<module>",
         )]
-    ctx = FileContext(path, relpath, tree, source)
+    ctx = FileContext(path, relpath, tree, source, project=project)
     Walker(rules).walk(ctx)
     return ctx.findings
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]
+    files: List[str]
+    project: Any
+    files_walked: int = 0
+    files_cached: int = 0
+
+
+def analyze(paths: Iterable[str], rules: Sequence[Rule],
+            root: Optional[str] = None, cache: Any = None,
+            targets: Optional[Iterable[str]] = None,
+            prune_cache: bool = False) -> AnalysisResult:
+    """The two-pass pipeline.
+
+    Pass 1 builds a :class:`graph.Project` over EVERY file (using
+    cached summaries when valid).  Pass 2 walks the per-file rules over
+    the target set (all files by default; ``--changed`` narrows it)
+    with cached findings reused when the file, its transitive imports,
+    and the rule environment are all unchanged — then runs each rule's
+    cross-file ``finalize`` over the project.
+    """
+    from .graph import Project
+    from .symbols import extract_module
+
+    files = list(iter_py_files(paths))
+    summaries = []
+    parsed: Dict[str, Tuple[ast.Module, str]] = {}  # relpath → tree,src
+    syntax_errors: Dict[str, Finding] = {}
+    relpaths: Dict[str, str] = {}
+    for path in files:
+        relpath = _relpath(path, root)
+        relpaths[path] = relpath
+        cached = cache.summary(relpath, path) if cache is not None \
+            else None
+        if cached is not None:
+            summaries.append(cached[0])
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            syntax_errors[relpath] = Finding(
+                rule="syntax-error", path=relpath, line=e.lineno or 0,
+                col=e.offset or 0,
+                message=f"file does not parse: {e.msg}",
+                context="<module>")
+            continue
+        parsed[relpath] = (tree, source)
+        summary = extract_module(relpath, tree, source)
+        summaries.append(summary)
+        if cache is not None:
+            cache.store_summary(relpath, path, summary)
+
+    project = Project(summaries)
+    for rule in rules:
+        rule.begin_run()
+    for rule in rules:
+        rule.begin_project(project)
+
+    target_set = (set(targets) if targets is not None
+                  else set(relpaths.values()))
+    findings: List[Finding] = []
+    walker = Walker(rules)
+    walked = cached_files = 0
+    for path in files:
+        relpath = relpaths[path]
+        if relpath in syntax_errors:
+            findings.append(syntax_errors[relpath])
+            continue
+        if relpath not in target_set:
+            continue
+        summary = project.by_relpath.get(relpath)
+        deps = (project.deps_digest(summary.module)
+                if summary is not None else "")
+        if cache is not None and summary is not None:
+            hit = cache.findings(relpath, summary.digest, deps)
+            if hit is not None:
+                findings.extend(hit)
+                cached_files += 1
+                continue
+        entry = parsed.get(relpath)
+        if entry is None:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        else:
+            tree, source = entry
+        ctx = FileContext(path, relpath, tree, source, project=project)
+        walker.walk(ctx)
+        findings.extend(ctx.findings)
+        walked += 1
+        if cache is not None and summary is not None:
+            cache.store_findings(relpath, deps, ctx.findings)
+    for rule in rules:
+        fin = rule.finalize()
+        if targets is not None:
+            fin = [f for f in fin if f.path in target_set]
+        findings.extend(fin)
+    if cache is not None:
+        if prune_cache:
+            # only on full-default scans: a single-file invocation must
+            # not evict the rest of the tree's entries
+            cache.prune(relpaths.values())
+        cache.save()
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return AnalysisResult(findings=findings, files=files,
+                          project=project, files_walked=walked,
+                          files_cached=cached_files)
 
 
 def check_paths(paths: Iterable[str], rules: Sequence[Rule],
                 root: Optional[str] = None) -> List[Finding]:
     """Run ``rules`` over every file under ``paths``; one parse + one
-    walk per file, then the cross-file ``finalize`` pass."""
-    findings: List[Finding] = []
-    for rule in rules:
-        rule.begin_run()
-    for path in iter_py_files(paths):
-        findings.extend(check_file(path, rules, root=root))
-    for rule in rules:
-        findings.extend(rule.finalize())
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return findings
+    walk per file, then the cross-file ``finalize`` pass.  (The thin
+    uncached wrapper around :func:`analyze`.)"""
+    return analyze(paths, rules, root=root).findings

@@ -1,0 +1,510 @@
+"""Pass 2 of the whole-program analysis: the project symbol graph.
+
+:class:`Project` joins the per-file summaries (:mod:`.symbols`) into one
+queryable structure:
+
+* **import graph** — module → imported project modules, plus the
+  reverse graph (who imports me), used by ``--changed`` and by the
+  cache's transitive dependency digests;
+* **chain resolution** — a dotted receiver chain from a call/spawn/write
+  site resolves to a project function (following import aliases,
+  module-level defs, nested defs, ``self``/``super()`` through the class
+  MRO, inferred ``self.attr = Cls(...)`` types and the declarative
+  ``ATTR_TYPES``/``VARNAME_HINTS`` ownership facts), a project class, or
+  an **external** dotted name (``asyncio.create_task``) when the root
+  leaves the project;
+* **affinity analysis** — the shard-affinity lattice: every function
+  gets the set of execution contexts it is reachable from
+  (``main`` loop / ``shard`` loop / plain worker ``thread``), each
+  paired with whether the channel RLock (``mutex``) is held on that
+  path.  Seeds come from the ownership facts in :mod:`.project` plus
+  auto-detected thread/child spawn sites; propagation runs over
+  resolved call edges to a fixpoint.  ``call_soon_threadsafe`` /
+  ``run_coroutine_threadsafe`` targets are marshal boundaries (no
+  propagation); declared dispatch barriers (``Channel.handle_in``)
+  stop propagation where packet-type dispatch is modeled by explicit
+  seeds instead.
+
+Resolution is deliberately view-dependent in one documented way: under
+a shard context, attributes in ``SHARD_ATTR_TYPES`` (the ``channel`` a
+shard protocol holds IS a :class:`ShardChannel`) resolve to the
+shard-side class, so the lock-taking overrides are the ones the
+propagation walks through — exactly the prose invariant PR 6 shipped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import project as facts
+from .symbols import FuncInfo, ClassInfo, ModuleSummary
+
+__all__ = ["Project", "Resolution", "AffinityAnalysis",
+           "MAIN", "SHARD", "THREAD"]
+
+MAIN = "main"
+SHARD = "shard"
+THREAD = "thread"
+
+
+class Resolution:
+    """Outcome of resolving a dotted chain."""
+
+    __slots__ = ("kind", "func", "module", "external", "cls")
+
+    def __init__(self, kind: str, func: Optional[FuncInfo] = None,
+                 module: Optional[str] = None,
+                 external: Optional[str] = None,
+                 cls: Optional[ClassInfo] = None) -> None:
+        self.kind = kind          # "func" | "class" | "external"
+        self.func = func
+        self.module = module      # module the func/class lives in
+        self.external = external  # dotted name outside the project
+        self.cls = cls
+
+    @property
+    def fqid(self) -> Optional[str]:
+        if self.kind == "func" and self.func is not None:
+            return f"{self.module}:{self.func.qualname}"
+        return None
+
+
+class Project:
+    """The whole-program symbol table + import graph + affinity."""
+
+    def __init__(self, summaries: Iterable[ModuleSummary]) -> None:
+        self.modules: Dict[str, ModuleSummary] = {}
+        self.by_relpath: Dict[str, ModuleSummary] = {}
+        for s in summaries:
+            self.modules[s.module] = s
+            self.by_relpath[s.relpath] = s
+        # class basename → [(module, ClassInfo)]
+        self.class_index: Dict[str, List[Tuple[str, ClassInfo]]] = {}
+        for s in self.modules.values():
+            for ci in s.classes.values():
+                self.class_index.setdefault(ci.name, []).append(
+                    (s.module, ci))
+        self._mro_cache: Dict[Tuple[str, str], List[
+            Tuple[str, ClassInfo]]] = {}
+        self._import_edges: Optional[Dict[str, Set[str]]] = None
+        self._reverse_edges: Optional[Dict[str, Set[str]]] = None
+        self._deps_digests: Dict[str, str] = {}
+        self._affinity: Optional["AffinityAnalysis"] = None
+
+    # -- function table ------------------------------------------------
+
+    def functions(self) -> Iterable[Tuple[str, ModuleSummary, FuncInfo]]:
+        for s in self.modules.values():
+            for fi in s.functions.values():
+                yield f"{s.module}:{fi.qualname}", s, fi
+
+    def func(self, fqid: str) -> Optional[Tuple[ModuleSummary, FuncInfo]]:
+        module, _, qualname = fqid.partition(":")
+        s = self.modules.get(module)
+        if s is None:
+            return None
+        fi = s.functions.get(qualname)
+        return (s, fi) if fi is not None else None
+
+    # -- import graph --------------------------------------------------
+
+    def import_edges(self) -> Dict[str, Set[str]]:
+        """module → project modules it imports (intra-project only)."""
+        if self._import_edges is None:
+            edges: Dict[str, Set[str]] = {m: set() for m in self.modules}
+            for s in self.modules.values():
+                for dotted in s.imports.values():
+                    m = self._module_prefix(dotted)
+                    if m is not None and m != s.module:
+                        edges[s.module].add(m)
+            self._import_edges = edges
+        return self._import_edges
+
+    def reverse_edges(self) -> Dict[str, Set[str]]:
+        if self._reverse_edges is None:
+            rev: Dict[str, Set[str]] = {m: set() for m in self.modules}
+            for m, deps in self.import_edges().items():
+                for d in deps:
+                    rev.setdefault(d, set()).add(m)
+            self._reverse_edges = rev
+        return self._reverse_edges
+
+    def dependents_closure(self, modules: Iterable[str]) -> Set[str]:
+        """``modules`` plus everything that (transitively) imports
+        them — the sound ``--changed`` re-check set."""
+        rev = self.reverse_edges()
+        out: Set[str] = set()
+        stack = [m for m in modules if m in self.modules]
+        while stack:
+            m = stack.pop()
+            if m in out:
+                continue
+            out.add(m)
+            stack.extend(rev.get(m, ()))
+        return out
+
+    def deps_digest(self, module: str) -> str:
+        """Digest of the transitive import closure's source digests —
+        the cache key component that invalidates a file's findings when
+        anything it (transitively) resolves against changes."""
+        cached = self._deps_digests.get(module)
+        if cached is not None:
+            return cached
+        edges = self.import_edges()
+        seen: Set[str] = set()
+        stack = [module]
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(edges.get(m, ()))
+        h = hashlib.sha1()
+        for m in sorted(seen):
+            s = self.modules.get(m)
+            if s is not None:
+                h.update(f"{m}:{s.digest};".encode())
+        digest = h.hexdigest()
+        self._deps_digests[module] = digest
+        return digest
+
+    def _module_prefix(self, dotted: str) -> Optional[str]:
+        """Longest project-module prefix of a dotted name."""
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            m = ".".join(parts[:i])
+            if m in self.modules:
+                return m
+        return None
+
+    # -- MRO -----------------------------------------------------------
+
+    def mro(self, module: str, ci: ClassInfo) -> List[
+            Tuple[str, ClassInfo]]:
+        """[(module, ClassInfo)] linearization: the class, then bases
+        depth-first left-to-right (project classes only), deduped."""
+        key = (module, ci.name)
+        cached = self._mro_cache.get(key)
+        if cached is not None:
+            return cached
+        out: List[Tuple[str, ClassInfo]] = []
+        seen: Set[Tuple[str, str]] = set()
+        self._mro_cache[key] = out  # placed first: cycle guard
+        stack: List[Tuple[str, ClassInfo]] = [(module, ci)]
+        while stack:
+            mod, c = stack.pop(0)
+            if (mod, c.name) in seen:
+                continue
+            seen.add((mod, c.name))
+            out.append((mod, c))
+            s = self.modules.get(mod)
+            if s is None:
+                continue
+            bases: List[Tuple[str, ClassInfo]] = []
+            for bchain in c.bases:
+                r = self.resolve(s, None, bchain)
+                if r is not None and r.kind == "class":
+                    bases.append((r.module, r.cls))
+            stack = bases + stack
+        return out
+
+    def lookup_method(self, module: str, ci: ClassInfo, name: str,
+                      skip_self: bool = False) -> Optional[Resolution]:
+        """Resolve ``self.name``/``super().name`` through the MRO."""
+        chain = self.mro(module, ci)
+        if skip_self:
+            chain = chain[1:]
+        for mod, c in chain:
+            q = c.methods.get(name)
+            if q is not None:
+                s = self.modules[mod]
+                fi = s.functions.get(q)
+                if fi is not None:
+                    return Resolution("func", func=fi, module=mod)
+        return None
+
+    def class_by_name(self, name: str) -> Optional[Tuple[str, ClassInfo]]:
+        """Unique project class with this basename, else None."""
+        hits = self.class_index.get(name, ())
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    # -- chain resolution ----------------------------------------------
+
+    def resolve(self, s: ModuleSummary, fn: Optional[FuncInfo],
+                chain: Tuple[str, ...], view: str = MAIN,
+                _depth: int = 0) -> Optional[Resolution]:
+        """Resolve a dotted receiver chain from a site in ``fn`` (or at
+        module level) of module ``s``.  ``view`` selects the execution
+        perspective: under a shard context, ``SHARD_ATTR_TYPES``
+        override the attribute typing (see module docstring)."""
+        if not chain or _depth > 4:
+            return None
+        root = chain[0]
+        if root == "<local>" and len(chain) == 2:
+            fi = s.functions.get(chain[1])
+            if fi is not None:
+                return Resolution("func", func=fi, module=s.module)
+            return None
+        # function-local alias substitution (one hop)
+        if fn is not None and root in fn.aliases and root != "self":
+            ali = fn.aliases[root]
+            if ali[0] != root:
+                return self.resolve(
+                    s, fn, tuple(ali) + tuple(chain[1:]), view,
+                    _depth + 1)
+        if root == "self" and fn is not None and fn.cls is not None:
+            return self._resolve_self(s, fn, chain, view)
+        if root == "super()" and fn is not None and fn.cls is not None \
+                and len(chain) == 2:
+            ci = s.classes.get(fn.cls)
+            if ci is None:
+                return None
+            return self.lookup_method(s.module, ci, chain[1],
+                                      skip_self=True)
+        if fn is not None and root in fn.params:
+            # dynamic root: a parameter shadows any same-named
+            # import/def — only the declarative name hints may type it
+            hint = self._hint_class(root, view)
+            if hint is not None and len(chain) == 2:
+                mod, hci = hint
+                return self.lookup_method(mod, hci, chain[1])
+            return None
+        if len(chain) == 1:
+            if fn is not None and root in fn.local_defs:
+                fi = s.functions.get(fn.local_defs[root])
+                if fi is not None:
+                    return Resolution("func", func=fi, module=s.module)
+            q = s.module_defs.get(root)
+            if q is not None:
+                fi = s.functions.get(q)
+                if fi is not None:
+                    return Resolution("func", func=fi, module=s.module)
+            ci = s.classes.get(root)
+            if ci is not None:
+                return Resolution("class", cls=ci, module=s.module)
+        if root in s.imports:
+            dotted = s.imports[root].split(".") + list(chain[1:])
+            return self._resolve_dotted(tuple(dotted))
+        # local class: ClassName.method / ClassName(...)
+        ci = s.classes.get(root)
+        if ci is not None and len(chain) == 2:
+            return self.lookup_method(s.module, ci, chain[1])
+        # declarative variable-name hints ("sess" → Session)
+        hint = self._hint_class(root, view)
+        if hint is not None and len(chain) == 2:
+            mod, ci = hint
+            return self.lookup_method(mod, ci, chain[1])
+        return None
+
+    def _resolve_self(self, s: ModuleSummary, fn: FuncInfo,
+                      chain: Tuple[str, ...],
+                      view: str) -> Optional[Resolution]:
+        ci = s.classes.get(fn.cls)
+        if ci is None:
+            return None
+        if len(chain) == 2:
+            return self.lookup_method(s.module, ci, chain[1])
+        if len(chain) == 3:
+            owner = self.attr_class(s, ci, chain[1], view)
+            if owner is not None:
+                mod, oci = owner
+                return self.lookup_method(mod, oci, chain[2])
+        return None
+
+    def attr_class(self, s: ModuleSummary, ci: ClassInfo, attr: str,
+                   view: str = MAIN) -> Optional[Tuple[str, ClassInfo]]:
+        """Class of ``self.<attr>``: shard-view facts first (under a
+        shard context the channel IS a ShardChannel), then inferred
+        ``self.attr = Cls(...)`` assignments anywhere in the MRO, then
+        the declarative ``ATTR_TYPES`` name facts."""
+        hinted = self._hint_class(attr, view, table="attr")
+        if hinted is not None:
+            return hinted
+        for mod, c in self.mro(s.module, ci):
+            tchain = c.attr_types.get(attr)
+            if tchain is not None:
+                ms = self.modules.get(mod)
+                if ms is not None:
+                    r = self.resolve(ms, None, tchain)
+                    if r is not None and r.kind == "class":
+                        return (r.module, r.cls)
+        return None
+
+    def _hint_class(self, name: str, view: str,
+                    table: str = "var") -> Optional[
+                        Tuple[str, ClassInfo]]:
+        if table == "attr":
+            if view in (SHARD, THREAD):
+                cls_name = facts.SHARD_ATTR_TYPES.get(name) \
+                    or facts.ATTR_TYPES.get(name)
+            else:
+                cls_name = facts.ATTR_TYPES.get(name)
+        else:
+            cls_name = facts.VARNAME_HINTS.get(name)
+            if cls_name is not None and view in (SHARD, THREAD):
+                cls_name = facts.SHARD_ATTR_TYPES.get(name, cls_name)
+        if cls_name is None:
+            return None
+        return self.class_by_name(cls_name)
+
+    def _resolve_dotted(self, parts: Tuple[str, ...]) -> Resolution:
+        for i in range(len(parts), 0, -1):
+            m = ".".join(parts[:i])
+            s = self.modules.get(m)
+            if s is None:
+                continue
+            rest = parts[i:]
+            if not rest:
+                return Resolution("external", external=m, module=m)
+            if len(rest) == 1:
+                q = s.module_defs.get(rest[0])
+                if q is not None:
+                    return Resolution("func", func=s.functions[q],
+                                      module=m)
+                ci = s.classes.get(rest[0])
+                if ci is not None:
+                    return Resolution("class", cls=ci, module=m)
+            elif len(rest) == 2 and rest[0] in s.classes:
+                r = self.lookup_method(m, s.classes[rest[0]], rest[1])
+                if r is not None:
+                    return r
+            return Resolution("external", external=".".join(parts))
+        return Resolution("external", external=".".join(parts))
+
+    # -- affinity ------------------------------------------------------
+
+    def affinity(self) -> "AffinityAnalysis":
+        if self._affinity is None:
+            self._affinity = AffinityAnalysis(self)
+        return self._affinity
+
+
+# ---------------------------------------------------------------------------
+# the shard-affinity lattice
+# ---------------------------------------------------------------------------
+
+def _suffix_match(qualname: str, suffix: str) -> bool:
+    return qualname == suffix or qualname.endswith("." + suffix)
+
+
+class AffinityAnalysis:
+    """Fixpoint propagation of (context, mutex-held) pairs over the
+    resolved call graph.  ``state[fqid]`` maps each reached
+    ``(context, locked)`` pair to the (parent fqid, via-line) that first
+    reached it, so findings can print the entry chain."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.state: Dict[str, Dict[Tuple[str, bool],
+                                   Optional[Tuple[str, int]]]] = {}
+        self._run()
+
+    # -- queries -------------------------------------------------------
+
+    def contexts(self, fqid: str) -> Set[Tuple[str, bool]]:
+        return set(self.state.get(fqid, ()))
+
+    def label(self, fqid: str) -> str:
+        """Human lattice point: main / shard / thread / either."""
+        ctxs = {c for c, _ in self.contexts(fqid)}
+        if not ctxs:
+            return "unreached"
+        if len(ctxs) == 1:
+            return next(iter(ctxs))
+        return "either"
+
+    def trace(self, fqid: str, ctx: Tuple[str, bool],
+              limit: int = 8) -> List[str]:
+        """Entry chain (function qualnames, entry first) that reached
+        ``fqid`` in context ``ctx`` — line-number free so finding keys
+        stay stable under unrelated edits."""
+        out: List[str] = []
+        cur: Optional[str] = fqid
+        cur_ctx = ctx
+        seen: Set[str] = set()
+        while cur is not None and cur not in seen and len(out) < limit:
+            seen.add(cur)
+            out.append(cur.split(":", 1)[1])
+            parent = self.state.get(cur, {}).get(cur_ctx)
+            if parent is None:
+                break
+            cur = parent[0]
+            # parents were reached with any-locked state; find one
+            pstates = self.state.get(cur, {})
+            for c in ((cur_ctx[0], False), (cur_ctx[0], True)):
+                if c in pstates:
+                    cur_ctx = c
+                    break
+            else:
+                break
+        out.reverse()
+        return out
+
+    # -- the fixpoint --------------------------------------------------
+
+    def _seed(self, fqid: str, ctx: str, locked: bool,
+              worklist: List[Tuple[str, Tuple[str, bool]]]) -> None:
+        st = self.state.setdefault(fqid, {})
+        key = (ctx, locked)
+        if key not in st:
+            st[key] = None
+            worklist.append((fqid, key))
+
+    def _reach(self, fqid: str, ctx: str, locked: bool,
+               parent: Tuple[str, int],
+               worklist: List[Tuple[str, Tuple[str, bool]]]) -> None:
+        st = self.state.setdefault(fqid, {})
+        key = (ctx, locked)
+        if key not in st:
+            st[key] = parent
+            worklist.append((fqid, key))
+
+    def _run(self) -> None:
+        project = self.project
+        worklist: List[Tuple[str, Tuple[str, bool]]] = []
+        barrier_ids: Set[str] = set()
+        for fqid, s, fi in project.functions():
+            # declared seeds (ownership facts)
+            for suffix, (ctx, locked) in facts.AFFINITY_SEEDS.items():
+                if _suffix_match(fi.qualname, suffix):
+                    self._seed(fqid, ctx, locked, worklist)
+            for suffix in facts.AFFINITY_BARRIERS:
+                if _suffix_match(fi.qualname, suffix):
+                    barrier_ids.add(fqid)
+            # auto seeds: spawn targets
+            for sp in fi.spawns:
+                r = project.resolve(s, fi, sp.target)
+                if r is None or r.kind != "func":
+                    continue
+                tid = r.fqid
+                if sp.kind == "thread":
+                    if not r.func.boots_loop:
+                        self._seed(tid, THREAD, False, worklist)
+                elif sp.kind == "child":
+                    self._seed(tid, MAIN, False, worklist)
+                # marshal targets: boundary — the posted callable runs
+                # on whatever loop owns the consumer; facts seed those
+        self._barriers = barrier_ids
+        while worklist:
+            fqid, (ctx, locked) = worklist.pop()
+            entry = project.func(fqid)
+            if entry is None:
+                continue
+            s, fi = entry
+            view = ctx if ctx in (SHARD, THREAD) else MAIN
+            for call in fi.calls:
+                r = project.resolve(s, fi, call.chain, view=view)
+                if r is None or r.kind != "func":
+                    continue
+                tid = r.fqid
+                if tid == fqid or tid in barrier_ids:
+                    continue
+                if ctx == THREAD and r.func.boots_loop:
+                    continue  # bootstraps its own loop: absorbed
+                site_locked = locked or any(
+                    lk in facts.AFFINITY_LOCKS for lk in call.locks)
+                self._reach(tid, ctx, site_locked, (fqid, call.line),
+                            worklist)

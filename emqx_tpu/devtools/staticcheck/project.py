@@ -15,10 +15,13 @@ Adding to an allowlist is a design statement; adding a waiver is debt.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, FrozenSet, Tuple
 
 __all__ = [
     "ALLOWED_TASK_SITES", "DELIVERY_PATH_PREFIXES", "SUPERVISE_MODULE",
+    "AFFINITY_SEEDS", "AFFINITY_BARRIERS", "AFFINITY_LOCKS",
+    "MAIN_ONLY_CLASSES", "LOCKED_FIELDS", "ATTR_TYPES",
+    "SHARD_ATTR_TYPES", "VARNAME_HINTS", "AFFINITY_ALLOWED_SITES",
 ]
 
 #: Module allowed to create raw tasks: the supervision tree itself.
@@ -77,3 +80,146 @@ DELIVERY_PATH_PREFIXES: Tuple[str, ...] = (
     "emqx_tpu/node.py",
     "emqx_tpu/supervise.py",
 )
+
+#: Modules added since PR 4 that MUST be inside the delivery-path scope
+#: (asserted by tests/test_staticcheck.py so a prefix refactor cannot
+#: silently drop them): transport/shards.py, transport/timerwheel.py,
+#: broker/match_service.py, broker/olp.py — all covered by the
+#: ``emqx_tpu/transport/`` and ``emqx_tpu/broker/`` prefixes above.
+DELIVERY_PATH_REQUIRED_MODULES: Tuple[str, ...] = (
+    "emqx_tpu/transport/shards.py",
+    "emqx_tpu/transport/timerwheel.py",
+    "emqx_tpu/broker/match_service.py",
+    "emqx_tpu/broker/olp.py",
+)
+
+
+# ---------------------------------------------------------------------------
+# shard-affinity ownership facts (PR 8)
+# ---------------------------------------------------------------------------
+# The connection-plane sharding (transport/shards.py) rests on prose
+# invariants: broker state is main-loop-only, session state is touched
+# from shards only under the channel RLock (``Session.mutex`` is the
+# same object), shard-affine helpers never touch the main loop.  These
+# tables turn that prose into facts the affinity analysis propagates
+# and CHECKS — editing them is a design statement, reviewed like code.
+
+#: Affinity seeds: qualname suffix → (context, mutex-held-on-entry).
+#: Contexts: "main" (the broker event loop), "shard" (a shard worker's
+#: own event loop), "thread" (plain worker thread, no running loop).
+#: A seed with locked=True records that every real entry into the
+#: function takes the channel RLock first (e.g. Channel ack handlers
+#: are only shard-reachable through the ShardChannel wrappers / the
+#: marshal path, both of which hold the mutex).
+AFFINITY_SEEDS: Dict[str, Tuple[str, bool]] = {
+    # shard-loop surfaces (transport/shards.py)
+    "ShardChannel.handle_in": ("shard", False),
+    "ShardChannel.handle_ack_run": ("shard", False),
+    "ShardChannel.handle_puback_batch": ("shard", False),
+    "ShardChannel.handle_publish_run": ("shard", False),
+    "ShardChannel.check_keepalive": ("shard", False),
+    "ShardChannel.retry_deliveries": ("shard", False),
+    "ShardChannel.retry_wire_batch": ("shard", False),
+    "ShardChannel.retry_commit": ("shard", False),
+    "ShardChannel.handle_close": ("shard", False),
+    "ShardChannel.marshal_done": ("shard", False),
+    # dispatched from ShardChannel.handle_in under the mutex
+    "ShardChannel._handle_publish": ("shard", True),
+    "Channel._handle_puback": ("shard", True),
+    "Channel._handle_pubrec": ("shard", True),
+    "Channel._handle_pubrel": ("shard", True),
+    "Channel._handle_pubcomp": ("shard", True),
+    "Shard._consume_inbox": ("shard", False),
+    "_ShardProtocol.data_received": ("shard", False),
+    # main-loop surfaces of the same file (the marshal consumers)
+    "ShardPool._consume": ("main", False),
+    "ShardPool._publish_batch": ("main", False),
+    "ShardPool._main_handle": ("main", False),
+    "ShardPool._takeover": ("main", False),
+    "ShardPool._main_close": ("main", False),
+    "ShardPool._main_conn_closed": ("main", False),
+    "ShardPool.start": ("main", False),
+    "ShardPool.stop": ("main", False),
+}
+
+#: Dispatch barriers: propagation stops at these functions because
+#: their fan-out depends on runtime packet types; the shard-reachable
+#: subset of their dispatch targets is seeded explicitly above.
+#: (``Channel.handle_in`` dispatches CONNECT/SUBSCRIBE/... which only
+#: ever run marshaled on the main loop — seeding the ack handlers and
+#: barring the dispatcher encodes exactly that contract.)
+AFFINITY_BARRIERS: Tuple[str, ...] = (
+    "Channel.handle_in",
+    "Channel.handle_close",
+)
+
+#: Lock names that satisfy the "channel RLock held" requirement at a
+#: call/write site (``Session.mutex`` is the same object as the
+#: channel's RLock by construction — see transport/shards.py).
+AFFINITY_LOCKS: FrozenSet[str] = frozenset({"mutex"})
+
+#: Classes (by basename) whose attribute state belongs to the MAIN
+#: loop outright: ANY write reachable from shard-affine code is a race,
+#: locked or not — shards must marshal instead.
+MAIN_ONLY_CLASSES: FrozenSet[str] = frozenset({
+    "Broker", "Router", "MatchService", "FanoutPipeline", "Retainer",
+    "SharedSub",
+})
+
+#: Classes with a documented RLock-protected field set: shard-affine
+#: writes to the listed fields are legal **with the mutex held**;
+#: writes to any OTHER field of the class remain main-loop-only even
+#: under the lock (the lock protects the QoS window, not the session's
+#: identity/registry fields).
+LOCKED_FIELDS: Dict[str, FrozenSet[str]] = {
+    "Session": frozenset({
+        "inflight", "mqueue", "awaiting_rel", "_next_pid", "mutex",
+    }),
+    "Channel": frozenset({
+        # connection-local packet-processing state: only ever touched
+        # while handling that connection's packets, which on shards
+        # happens under the channel mutex (ShardChannel wrappers)
+        "last_rx", "_retry_pending", "_aliases",
+    }),
+}
+
+#: Declarative attribute typing (ownership facts): attribute name →
+#: project class basename, used when ``self.attr = Cls(...)`` inference
+#: has nothing to say.  Keep this table small and obvious.
+ATTR_TYPES: Dict[str, str] = {
+    "session": "Session",
+    "channel": "Channel",
+    "broker": "Broker",
+    "router": "Router",
+    "inflight": "Inflight",
+    "mqueue": "MQueue",
+    "pool": "ShardPool",
+    "handoff": "Handoff",
+}
+
+#: Shard-view attribute typing: under a shard/thread context these
+#: override ``ATTR_TYPES`` — on a shard loop the protocol's channel IS
+#: a ShardChannel (node.make_shard_protocol builds nothing else), so
+#: propagation walks through the mutex-taking overrides.
+SHARD_ATTR_TYPES: Dict[str, str] = {
+    "channel": "ShardChannel",
+    "chan": "ShardChannel",
+}
+
+#: Variable-name → class basename hints for non-self receivers
+#: (``sess.puback_batch(...)``), same spirit as ATTR_TYPES.
+VARNAME_HINTS: Dict[str, str] = {
+    "sess": "Session",
+    "session": "Session",
+    "chan": "Channel",
+    "channel": "Channel",
+    "broker": "Broker",
+    "router": "Router",
+}
+
+#: (repo-relative path, enclosing qualname) → reason.  Structural
+#: exemptions for the shard-affinity rule: sites the analysis flags but
+#: that are correct by design (same lifetime rules as
+#: ALLOWED_TASK_SITES — a reasoned allowlist, not a waiver).
+AFFINITY_ALLOWED_SITES: Dict[Tuple[str, str], str] = {
+}

@@ -7,6 +7,7 @@ from typing import Iterable, List, Optional
 
 from ..core import Rule
 from ..registry import Registries
+from .affinity import ShardAffinity
 from .blocking import NoBlockingInAsync
 from .coroutines import UnawaitedCoroutine
 from .drift import RegistryDrift
@@ -18,6 +19,7 @@ from .threads import LoopThreadTaint
 ALL_RULES = [
     NoUnsupervisedTask,
     LoopThreadTaint,
+    ShardAffinity,
     NoBlockingInAsync,
     NoSwallowedExceptions,
     AwaitUnderLock,

@@ -1,0 +1,160 @@
+"""shard-affinity: writes to main-loop-owned state from shard code.
+
+The sharded connection plane (transport/shards.py) is safe because of
+three prose invariants: broker state is main-loop-only, session QoS
+state is only touched under the channel RLock (``Session.mutex`` is
+the same object), and shard-affine helpers never touch the main loop.
+This rule turns the prose into a checked property.
+
+The affinity lattice (:mod:`..graph`): every function carries the set
+of execution contexts it is reachable from — ``main`` (broker loop),
+``shard`` (a shard worker's own loop), ``thread`` (plain worker
+thread) — each paired with whether the channel RLock is held on that
+path.  Seeds come from the declarative ownership facts
+(``project.AFFINITY_SEEDS``: ShardChannel handlers, shard inbox
+consumers, supervised children, ``asyncio.to_thread`` targets) and
+propagate over resolved call edges to a fixpoint.
+
+Flagged, using the ownership tables in
+``devtools/staticcheck/project.py``:
+
+* a write to an attribute of a ``MAIN_ONLY_CLASSES`` instance
+  (Broker, Router, MatchService, ...) reachable from shard/thread
+  context — **any** such write is a race; shards marshal instead;
+* a write to a ``LOCKED_FIELDS`` class (Session, Channel): fields in
+  the documented RLock set require the mutex held on every shard
+  path; fields **outside** the set are main-loop-only even under the
+  lock (the lock protects the QoS window, not the registry fields).
+
+Structural exemptions live in ``project.AFFINITY_ALLOWED_SITES`` with
+a reason each; temporary suppressions go through the expiring waiver
+file like every other rule.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .. import project as facts
+from ..core import Finding, Rule
+from ..graph import SHARD, THREAD, Project
+
+__all__ = ["ShardAffinity"]
+
+
+class ShardAffinity(Rule):
+    name = "shard-affinity"
+    description = ("write to main-loop-owned state reachable from "
+                   "shard-affine code without the channel RLock")
+    node_types = ()  # graph rule: everything happens in finalize
+
+    def begin_run(self) -> None:
+        self._project: Project = None  # type: ignore[assignment]
+
+    def begin_project(self, project: Project) -> None:
+        self._project = project
+
+    # ------------------------------------------------------------------
+
+    def _owner_class(self, project: Project, s, fi,
+                     chain: Tuple[str, ...]) -> Optional[str]:
+        """Basename of the class owning the written attribute, or None
+        when untyped.  ``("self",)`` → the enclosing class;
+        ``("self", "session")`` / ``("sess",)`` → attr/var typing."""
+        if chain == ("self",):
+            return fi.cls
+        if len(chain) >= 2 and chain[0] == "self" and fi.cls:
+            ci = s.classes.get(fi.cls)
+            if ci is not None:
+                owner = project.attr_class(s, ci, chain[-1], view=SHARD)
+                if owner is not None:
+                    return owner[1].name
+            return facts.ATTR_TYPES.get(chain[-1])
+        if len(chain) == 1:
+            # local variable: alias typing, then declarative hints
+            ali = fi.aliases.get(chain[0])
+            if ali is not None and len(ali) >= 2:
+                return self._owner_class(project, s, fi, tuple(ali))
+            return facts.VARNAME_HINTS.get(chain[0])
+        # ``x.session.attr = ...``: type the penultimate attribute
+        return facts.ATTR_TYPES.get(chain[-1])
+
+    def finalize(self) -> List[Finding]:
+        project = self._project
+        if project is None:
+            return []
+        aff = project.affinity()
+        out: List[Finding] = []
+        for fqid, s, fi in project.functions():
+            ctxs = aff.contexts(fqid)
+            shardish = [(c, lk) for c, lk in ctxs
+                        if c in (SHARD, THREAD)]
+            if not shardish:
+                continue
+            allowed = facts.AFFINITY_ALLOWED_SITES.get(
+                (s.relpath, fi.qualname))
+            if allowed is not None:
+                continue
+            unlocked = [c for c in shardish if not c[1]]
+            label = aff.label(fqid)
+            for w in fi.writes:
+                owner = self._owner_class(project, s, fi, w.chain)
+                if owner is None:
+                    continue
+                target = ".".join(w.chain + (w.attr,))
+                if owner in facts.MAIN_ONLY_CLASSES:
+                    entry = aff.trace(fqid, shardish[0])
+                    via = " -> ".join(entry)
+                    out.append(Finding(
+                        rule=self.name, path=s.relpath, line=w.line,
+                        col=w.col,
+                        message=(
+                            f"write to {target} ({owner} state is "
+                            f"main-loop-only) in {fi.qualname!r}, "
+                            f"reachable from shard-affine code "
+                            f"(affinity: {label}; entry: {via}); "
+                            "marshal the mutation to the main loop "
+                            "through the shard handoff instead"),
+                        context=fi.qualname,
+                    ))
+                    continue
+                locked_set = facts.LOCKED_FIELDS.get(owner)
+                if locked_set is None:
+                    continue
+                site_locked = any(lk in facts.AFFINITY_LOCKS
+                                  for lk in w.locks)
+                if w.attr in locked_set:
+                    # legal under the RLock: flag only paths that can
+                    # arrive without it
+                    if site_locked or not unlocked:
+                        continue
+                    entry = aff.trace(fqid, unlocked[0])
+                    via = " -> ".join(entry)
+                    out.append(Finding(
+                        rule=self.name, path=s.relpath, line=w.line,
+                        col=w.col,
+                        message=(
+                            f"write to {target} ({owner} field in the "
+                            "documented RLock set) reachable from "
+                            f"shard-affine code WITHOUT the channel "
+                            f"RLock/Session.mutex held (entry: {via}); "
+                            "take the channel mutex around this "
+                            "mutation"),
+                        context=fi.qualname,
+                    ))
+                else:
+                    entry = aff.trace(fqid, shardish[0])
+                    via = " -> ".join(entry)
+                    out.append(Finding(
+                        rule=self.name, path=s.relpath, line=w.line,
+                        col=w.col,
+                        message=(
+                            f"write to {target} ({owner} field OUTSIDE "
+                            "the documented RLock set — main-loop-only "
+                            f"even under the lock) in {fi.qualname!r}, "
+                            f"reachable from shard-affine code (entry: "
+                            f"{via}); marshal to the main loop or add "
+                            "the field to LOCKED_FIELDS with a reason"),
+                        context=fi.qualname,
+                    ))
+        return out

@@ -51,7 +51,11 @@ class NoBlockingInAsync(Rule):
         name = call_name(node)
         is_open = isinstance(node.func, ast.Name) and node.func.id == "open"
         if name not in _BLOCKING and not is_open:
-            return
+            # resolved-callee check: ``from time import sleep`` (and
+            # aliases thereof) still blocks the loop
+            name = ctx.resolved_name(node)
+            if name not in _BLOCKING:
+                return
         which = "open" if is_open else name
         fix = _FIX.get(which, "an async equivalent")
         ctx.report(

@@ -4,11 +4,16 @@
 creates a coroutine object and throws it away — the code *looks* like
 it did the work and Python only emits a RuntimeWarning when the object
 is garbage collected (often never surfaced under pytest/production
-logging).  Resolution is deliberately conservative to stay
-false-positive-free: only calls the walker can *prove* target an async
-function are flagged — module-level ``async def`` names (not shadowed
-by a sync def) and ``self.<method>`` where the enclosing class defines
-``<method>`` as ``async def``.
+logging).  Resolution is conservative to stay false-positive-free:
+only calls that *provably* target an async function are flagged.
+
+With the whole-program symbol graph the proof now crosses module
+boundaries: besides module-level ``async def`` names (not shadowed by
+a sync def) and ``self.<method>`` of the enclosing class, the rule
+resolves ``from .x import y`` aliases, module-qualified calls
+(``helpers.flush()``), inherited ``self.`` methods through the class
+MRO, and ``super().<method>()`` — wherever the resolved def is
+``async`` and the result is discarded, it fires.
 """
 
 from __future__ import annotations
@@ -16,6 +21,7 @@ from __future__ import annotations
 import ast
 
 from ..core import FileContext, Rule
+from ..symbols import chain_of
 
 __all__ = ["UnawaitedCoroutine"]
 
@@ -29,19 +35,9 @@ class UnawaitedCoroutine(Rule):
         call = node.value
         if not isinstance(call, ast.Call):
             return
-        func = call.func
-        target = None
-        if isinstance(func, ast.Name):
-            if func.id in ctx.module_async_defs \
-                    and func.id not in ctx.module_sync_defs:
-                target = func.id
-        elif isinstance(func, ast.Attribute) \
-                and isinstance(func.value, ast.Name) \
-                and func.value.id == "self":
-            cls = ctx.enclosing_class()
-            if cls is not None and func.attr in \
-                    ctx.class_async_methods.get(cls, ()):
-                target = f"self.{func.attr}"
+        target = self._local_proof(call, ctx)
+        if target is None:
+            target = self._project_proof(call, ctx)
         if target is None:
             return
         ctx.report(
@@ -50,3 +46,40 @@ class UnawaitedCoroutine(Rule):
             "never runs; await it, or hand it to the supervisor/"
             "create_task if it is meant to run concurrently",
         )
+
+    @staticmethod
+    def _local_proof(call: ast.Call, ctx: FileContext):
+        """The original single-file proof (kept first: it needs no
+        project and covers the common cases)."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            if func.id in ctx.module_async_defs \
+                    and func.id not in ctx.module_sync_defs:
+                return func.id
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            cls = ctx.enclosing_class()
+            if cls is not None and func.attr in \
+                    ctx.class_async_methods.get(cls, ()):
+                return f"self.{func.attr}"
+        return None
+
+    @staticmethod
+    def _project_proof(call: ast.Call, ctx: FileContext):
+        """Cross-module proof through the symbol graph: imported async
+        defs, module-qualified calls, MRO-inherited self methods."""
+        if ctx.project is None:
+            return None
+        r = ctx.resolve_call(call)
+        if r is None or r.kind != "func" or not r.func.is_async:
+            return None
+        chain = chain_of(call.func)
+        dotted = ".".join(chain) if chain else r.func.qualname
+        # a name shadowed by a local sync def already failed the local
+        # proof; the graph resolves imports/self-MRO unambiguously, so
+        # an async resolution here is a real discarded coroutine
+        return dotted
+
+    def end_file(self, ctx: FileContext) -> None:
+        pass

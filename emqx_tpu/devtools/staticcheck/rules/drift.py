@@ -33,8 +33,7 @@ import ast
 import re
 from typing import List, Optional, Tuple
 
-from ..core import (FileContext, Finding, Rule, fstring_prefix, str_arg,
-                    terminal_name)
+from ..core import FileContext, Finding, Rule, str_arg, terminal_name
 from ..registry import Registries
 
 __all__ = ["RegistryDrift"]
@@ -42,7 +41,7 @@ __all__ = ["RegistryDrift"]
 #: registry-name shape: lowercase dotted identifiers ("broker.fanout.x")
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
 
-_METRIC_METHODS = {"inc", "dec", "set"}
+_METRIC_METHODS = {"inc", "dec", "set", "get"}
 _CONFIG_METHODS = {"get", "put"}
 _FAULT_METHODS = {"act", "check"}
 _ALARM_METHODS = {"activate", "deactivate"}
@@ -74,8 +73,7 @@ class RegistryDrift(Rule):
 
     def __init__(self, registries: Optional[Registries] = None) -> None:
         self._registries = registries
-        self._activations: List[Tuple[str, bool]] = []  # (name, is_prefix)
-        self._deactivations: List[Tuple[str, bool, Finding]] = []
+        self._project = None
 
     @property
     def registries(self) -> Registries:
@@ -84,8 +82,12 @@ class RegistryDrift(Rule):
         return self._registries
 
     def begin_run(self) -> None:
-        self._activations = []
-        self._deactivations = []
+        self._project = None
+
+    def begin_project(self, project) -> None:
+        # alarm activate/deactivate pairing reads the pass-1 summaries
+        # (so it stays correct when per-file walks are cache-skipped)
+        self._project = project
 
     def visit(self, node: ast.Call, ctx: FileContext) -> None:
         if ctx.relpath in self._REGISTRY_FILES:
@@ -104,8 +106,6 @@ class RegistryDrift(Rule):
             self._check_config(node, ctx)
         elif method in _FAULT_METHODS and "injector" in recv:
             self._check_fault(node, ctx)
-        elif method in _ALARM_METHODS and "alarm" in recv:
-            self._note_alarm(node, ctx, method)
         elif method in _HOOK_METHODS and recv == "hooks":
             self._check_hook_point(node, ctx)
             if method == "run":
@@ -188,41 +188,38 @@ class RegistryDrift(Rule):
                 "the detail counter (only the total moves)",
             )
 
-    def _note_alarm(self, node: ast.Call, ctx: FileContext,
-                    method: str) -> None:
-        if not node.args:
-            return
-        arg = node.args[0]
-        literal = str_arg(node)
-        if literal is not None:
-            entry = (literal, False)
-        else:
-            prefix = fstring_prefix(arg)
-            if prefix is None or not prefix:
-                return  # fully dynamic: nothing to check statically
-            entry = (prefix, True)
-        if method == "activate":
-            self._activations.append(entry)
-        else:
-            placeholder = Finding(
-                rule=self.name, path=ctx.relpath,
-                line=getattr(node, "lineno", 0),
-                col=getattr(node, "col_offset", 0),
+    def finalize(self) -> List[Finding]:
+        """Alarm activate/deactivate pairing over the whole project:
+        a deactivate whose name can never match any activate leaks the
+        alarm active forever.  Reads the pass-1 summaries so the check
+        stays whole-program even when per-file walks were served from
+        the analysis cache."""
+        if self._project is None:
+            return []
+        activations: List[Tuple[str, bool]] = []
+        registry_files = set(self._REGISTRY_FILES)
+        deacts = []
+        for s in self._project.modules.values():
+            if s.relpath in registry_files:
+                continue
+            activations.extend(s.alarm_acts)
+            for name, is_prefix, line, col, qualname in s.alarm_deacts:
+                deacts.append((name, is_prefix, s.relpath, line, col,
+                               qualname))
+        out: List[Finding] = []
+        for name, is_prefix, relpath, line, col, qualname in deacts:
+            if any(self._alarm_match(name, is_prefix, act, act_pfx)
+                   for act, act_pfx in activations):
+                continue
+            out.append(Finding(
+                rule=self.name, path=relpath, line=line, col=col,
                 message=(
-                    f"alarm {entry[0]!r} is deactivated but never "
+                    f"alarm {name!r} is deactivated but never "
                     "activated anywhere in the tree — the deactivate "
                     "can never match and the alarm name has drifted"
                 ),
-                context=ctx.qualname(),
-            )
-            self._deactivations.append((entry[0], entry[1], placeholder))
-
-    def finalize(self) -> List[Finding]:
-        out: List[Finding] = []
-        for name, is_prefix, finding in self._deactivations:
-            if not any(self._alarm_match(name, is_prefix, act, act_pfx)
-                       for act, act_pfx in self._activations):
-                out.append(finding)
+                context=qualname,
+            ))
         return out
 
     @staticmethod

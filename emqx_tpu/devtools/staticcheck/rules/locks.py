@@ -62,6 +62,9 @@ class AwaitUnderLock(Rule):
         flagged = None
         if name in _TASK_WAITS:
             flagged = name
+        elif ctx.resolved_name(value) in _TASK_WAITS:
+            # resolved-callee check: ``from asyncio import gather``
+            flagged = ctx.resolved_name(value)
         elif terminal in _WAIT_METHODS:
             flagged = name or terminal
         elif terminal == "acquire":

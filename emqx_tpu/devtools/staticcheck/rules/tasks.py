@@ -37,7 +37,13 @@ class NoUnsupervisedTask(Rule):
         terminal = (func.attr if isinstance(func, ast.Attribute)
                     else func.id if isinstance(func, ast.Name) else None)
         if terminal not in _SPAWNERS:
-            return
+            # resolved-callee check: an aliased spawner
+            # (``from asyncio import create_task as spawn``) is still
+            # a spawner after import resolution
+            resolved = ctx.resolved_name(node)
+            if resolved not in ("asyncio.create_task",
+                                "asyncio.ensure_future"):
+                return
         if ctx.relpath == project.SUPERVISE_MODULE:
             return
         if ctx.enclosing_if_mentions("sup", "supervisor"):

@@ -1,10 +1,11 @@
-"""loop-thread-taint: event-loop-affine calls inside worker-thread code.
+"""loop-thread-taint: event-loop-affine calls reachable from threads.
 
-The connection-plane sharding refactor (transport/shards.py) moves code
-across loop/thread boundaries: functions handed to ``asyncio.to_thread``
-/ ``loop.run_in_executor`` / ``threading.Thread(target=...)`` run OFF
-the event loop that spawned them.  Inside such a function, the
-loop-affine asyncio APIs are bugs, not style:
+The connection-plane sharding (transport/shards.py) moves code across
+loop/thread boundaries: functions handed to ``asyncio.to_thread`` /
+``loop.run_in_executor`` / ``threading.Thread(target=...)`` run OFF any
+event loop.  Inside code reachable from such an entry — **at any call
+depth**, via the whole-program affinity propagation (:mod:`..graph`) —
+the loop-affine asyncio APIs are bugs, not style:
 
 * ``asyncio.create_task`` / ``ensure_future`` — schedules onto whatever
   loop the thread happens to see (usually raises, occasionally worse);
@@ -13,162 +14,87 @@ loop-affine asyncio APIs are bugs, not style:
   sanctioned marshal and is allowed);
 * ``asyncio.get_running_loop`` — raises in a plain worker thread.
 
-The rule resolves thread-entry targets per file: module-local ``def``
-names, ``self.method`` references (resolved within the enclosing
-class), and inline lambdas.  The DIRECT body of the entered function is
-checked, plus **one level of transitive call resolution**: a
-thread-entered function that *calls* a module-local helper (or a
-``self`` method of its own class) whose body contains loop-affine calls
-is flagged at the call site — the taint crosses exactly one hop, which
-is where the shard refactors actually hid bugs (a thread main
-delegating to an innocently-named ``_notify``).  A thread target (or a
-called helper) that legitimately bootstraps its own loop
-(``new_event_loop`` + ``run_forever``) delegates loop-affine work to
-code running *on* that loop, which this rule correctly leaves alone at
-either hop.
+PR 7's version resolved one transitive hop inside one file; this one
+rides the project call graph: the taint follows resolved callees across
+``from .x import y`` aliases, ``self``-method MRO and helper modules
+until a marshal boundary (``call_soon_threadsafe`` /
+``run_coroutine_threadsafe`` targets), a declared dispatch barrier, or
+a function that bootstraps its own loop (``run_forever`` /
+``set_event_loop``) absorbs it.  Findings land at the affine call site
+with the entry chain in the message, so the fix (marshal at the
+boundary) has its frame named.
 """
 
 from __future__ import annotations
 
-import ast
-from typing import Dict, List, Optional, Tuple
+from typing import List
 
-from ..core import FileContext, Rule, call_name
+from ..core import Finding, Rule
+from ..graph import THREAD, Project
 
-__all__ = ["LoopThreadTaint"]
+__all__ = ["LoopThreadTaint", "AFFINE_TERMINALS"]
 
-# loop-affine call terminals that are invalid from a plain worker thread
-_AFFINE = {
+#: loop-affine call terminals that are invalid off-loop
+AFFINE_TERMINALS = {
     "create_task", "ensure_future", "call_soon", "call_later",
     "call_at", "get_running_loop",
 }
 
-# a thread target whose body contains one of these is bootstrapping its
-# own event loop — loop-affine calls after that are that loop's, not a
-# foreign one's
-_LOOP_BOOT = {"run_forever", "run_until_complete", "set_event_loop"}
+#: resolved external names that are loop-affine even when aliased
+#: (``from asyncio import create_task as spawn``)
+AFFINE_EXTERNALS = {
+    "asyncio.create_task", "asyncio.ensure_future",
+    "asyncio.get_running_loop",
+}
 
 
 class LoopThreadTaint(Rule):
     name = "loop-thread-taint"
-    description = ("event-loop-affine asyncio calls inside functions "
-                   "handed to worker threads")
-    node_types = (ast.Call,)
+    description = ("event-loop-affine asyncio calls reachable (at any "
+                   "depth) from worker-thread entry points")
+    node_types = ()  # graph rule: everything happens in finalize
 
-    def begin_file(self, ctx: FileContext) -> None:
-        # (target_ref, spawn_desc, enclosing_class) per spawn site;
-        # resolved against the def maps in end_file
-        self._spawns: List[Tuple[ast.AST, str, Optional[str]]] = []
-        self._module_defs: Dict[str, ast.AST] = {}
-        self._method_defs: Dict[Tuple[str, str], ast.AST] = {}
-        for node in ctx.tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self._module_defs[node.name] = node
-            elif isinstance(node, ast.ClassDef):
-                for item in node.body:
-                    if isinstance(item,
-                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
-                        self._method_defs[(node.name, item.name)] = item
+    def begin_run(self) -> None:
+        self._project: Project = None  # type: ignore[assignment]
 
-    def visit(self, node: ast.Call, ctx: FileContext) -> None:
-        func = node.func
-        terminal = (func.attr if isinstance(func, ast.Attribute)
-                    else func.id if isinstance(func, ast.Name) else None)
-        target: Optional[ast.AST] = None
-        if terminal == "to_thread" and node.args:
-            target = node.args[0]
-        elif terminal == "run_in_executor" and len(node.args) >= 2:
-            target = node.args[1]
-        elif terminal == "Thread":
-            for kw in node.keywords:
-                if kw.arg == "target":
-                    target = kw.value
-                    break
-        if target is None:
-            return
-        self._spawns.append(
-            (target, call_name(node), ctx.enclosing_class()))
+    def begin_project(self, project: Project) -> None:
+        self._project = project
 
-    def end_file(self, ctx: FileContext) -> None:
-        for target, spawn, cls in self._spawns:
-            fn, owner = self._resolve(target, cls)
-            if fn is None:
+    def finalize(self) -> List[Finding]:
+        project = self._project
+        if project is None:
+            return []
+        aff = project.affinity()
+        out: List[Finding] = []
+        for fqid, s, fi in project.functions():
+            ctxs = aff.contexts(fqid)
+            if not any(c == THREAD for c, _ in ctxs):
                 continue
-            self._check_body(fn, owner, spawn, ctx)
-
-    def _resolve(
-        self, target: ast.AST, cls: Optional[str],
-    ) -> Tuple[Optional[ast.AST], Optional[str]]:
-        """Resolve a callable reference to its def in this file, plus
-        the class owning it (for resolving ``self.x()`` calls inside)."""
-        if isinstance(target, ast.Lambda):
-            return target, cls
-        if isinstance(target, ast.Name):
-            return self._module_defs.get(target.id), None
-        if isinstance(target, ast.Attribute) \
-                and isinstance(target.value, ast.Name) \
-                and target.value.id == "self" and cls is not None:
-            return self._method_defs.get((cls, target.attr)), cls
-        return None, None
-
-    @staticmethod
-    def _scan(fn: ast.AST):
-        """One pass over a function body: (affine calls, bootstraps own
-        loop?, candidate local-helper call sites)."""
-        body = fn.body if isinstance(fn.body, list) else [fn.body]
-        affine: List[ast.Call] = []
-        helper_calls: List[ast.Call] = []
-        for stmt in body:
-            for sub in ast.walk(stmt):
-                if not isinstance(sub, ast.Call):
-                    continue
-                f = sub.func
-                t = (f.attr if isinstance(f, ast.Attribute)
-                     else f.id if isinstance(f, ast.Name) else None)
-                if t in _LOOP_BOOT:
-                    # bootstraps its own loop: loop-affine calls in this
-                    # body belong to that loop
-                    return [], True, []
-                if t in _AFFINE:
-                    affine.append(sub)
-                elif isinstance(f, ast.Name) or (
-                        isinstance(f, ast.Attribute)
-                        and isinstance(f.value, ast.Name)
-                        and f.value.id == "self"):
-                    helper_calls.append(sub)
-        return affine, False, helper_calls
-
-    def _check_body(self, fn: ast.AST, owner: Optional[str], spawn: str,
-                    ctx: FileContext) -> None:
-        affine, boots, helper_calls = self._scan(fn)
-        if boots:
-            return
-        name = getattr(fn, "name", "<lambda>")
-        for call in affine:
-            ctx.report(
-                self.name, call,
-                f"{call_name(call)}() inside {name!r}, which runs on a "
-                f"worker thread (via {spawn}); event-loop-affine calls "
-                "from a foreign thread must marshal through "
-                "call_soon_threadsafe / run_coroutine_threadsafe",
-            )
-        # one-level transitive resolution: a helper this thread-entered
-        # function calls carries the taint with it — flag the call site
-        # so the fix (marshal at the boundary) lands in the right frame
-        for call in helper_calls:
-            sub_fn, _ = self._resolve(call.func, owner)
-            if sub_fn is None or sub_fn is fn:
-                continue
-            sub_affine, sub_boots, _ = self._scan(sub_fn)
-            if sub_boots or not sub_affine:
-                continue
-            sub_name = getattr(sub_fn, "name", "<lambda>")
-            inner = ", ".join(sorted({call_name(c) for c in sub_affine}))
-            ctx.report(
-                self.name, call,
-                f"{name!r} runs on a worker thread (via {spawn}) and "
-                f"calls {sub_name!r}, whose body makes event-loop-affine "
-                f"calls ({inner}); the taint crosses the call — marshal "
-                "through call_soon_threadsafe / run_coroutine_threadsafe "
-                "at this boundary",
-            )
+            locked = (THREAD, True) in ctxs and (THREAD, False) not in ctxs
+            entry = aff.trace(fqid, (THREAD, locked))
+            chain = " -> ".join(entry) if len(entry) > 1 else None
+            for call in fi.calls:
+                terminal = call.chain[-1]
+                affine = terminal in AFFINE_TERMINALS
+                if not affine:
+                    r = project.resolve(s, fi, call.chain, view=THREAD)
+                    affine = (r is not None and r.kind == "external"
+                              and r.external in AFFINE_EXTERNALS)
+                    if not affine:
+                        continue
+                    terminal = r.external
+                via = (f" (thread entry chain: {chain})" if chain
+                       else "")
+                out.append(Finding(
+                    rule=self.name, path=s.relpath, line=call.line,
+                    col=call.col,
+                    message=(
+                        f"{'.'.join(call.chain)}() inside "
+                        f"{fi.qualname!r}, which is reachable from a "
+                        f"worker thread{via}; event-loop-affine calls "
+                        "from a foreign thread must marshal through "
+                        "call_soon_threadsafe / "
+                        "run_coroutine_threadsafe"),
+                    context=fi.qualname,
+                ))
+        return out

@@ -1,0 +1,610 @@
+"""Pass 1 of the whole-program analysis: per-file symbol extraction.
+
+One parse + one walk per file produces a :class:`ModuleSummary` — the
+file's contribution to the project symbol table: import aliases
+(including relative ``from .x import y`` forms), classes with their
+bases / methods / inferred ``self.attr`` types, and per-function fact
+records:
+
+* **call sites** — the dotted receiver chain (``("self", "session",
+  "puback_batch")``), whether the result is discarded (a bare
+  expression statement), and which locks are held at the site;
+* **write sites** — attribute assignments/mutations (``self.x = v``,
+  ``sess.inflight[k] = v``, ``del obj.attr[k]``) with the same held-lock
+  context;
+* **spawn sites** — callables handed across an execution boundary:
+  worker threads (``asyncio.to_thread`` / ``run_in_executor`` /
+  ``threading.Thread(target=...)``), loop marshals
+  (``call_soon_threadsafe`` / ``run_coroutine_threadsafe``) and
+  supervised children (``start_child`` / ``spawn_loop``);
+* **alarm notes** — ``alarms.activate``/``deactivate`` literals, so the
+  registry-drift cross-file pairing works off cached summaries.
+
+Summaries are pure data (``to_dict``/``from_dict``) so the analysis
+cache can persist them; resolution against OTHER modules happens in
+pass 2 (:mod:`.graph`), never here.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "CallSite", "SpawnSite", "WriteSite", "FuncInfo", "ClassInfo",
+    "ModuleSummary", "extract_module", "module_name_for", "chain_of",
+]
+
+#: body contains one of these → the function bootstraps its OWN event
+#: loop; loop-affine calls inside belong to that loop, not a foreign one
+_LOOP_BOOT = {"run_forever", "run_until_complete", "set_event_loop"}
+
+#: spawn terminals → (kind, how to find the target)
+_MARSHAL_TERMINALS = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+_CHILD_TERMINALS = {"start_child", "spawn_loop"}
+
+
+def chain_of(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Dotted receiver chain of a Name/Attribute expression:
+    ``self.session.puback_batch`` → ``("self", "session",
+    "puback_batch")``; ``super().handle_in`` → ``("super()",
+    "handle_in")``.  None when the root is not a plain name (a call
+    result, subscript, literal, ...)."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif isinstance(cur, ast.Call) and isinstance(cur.func, ast.Name) \
+            and cur.func.id == "super" and not cur.args:
+        parts.append("super()")
+    else:
+        return None
+    return tuple(reversed(parts))
+
+
+@dataclass
+class CallSite:
+    chain: Tuple[str, ...]
+    line: int
+    col: int
+    discarded: bool = False
+    locks: Tuple[str, ...] = ()
+
+    def to_dict(self) -> list:
+        return [list(self.chain), self.line, self.col,
+                int(self.discarded), list(self.locks)]
+
+    @classmethod
+    def from_dict(cls, d: list) -> "CallSite":
+        return cls(tuple(d[0]), d[1], d[2], bool(d[3]), tuple(d[4]))
+
+
+@dataclass
+class SpawnSite:
+    kind: str                 # "thread" | "marshal" | "child"
+    target: Tuple[str, ...]   # chain, or ("<local>", qualname) for
+    line: int                 # lambdas/nested defs captured in place
+    col: int
+
+    def to_dict(self) -> list:
+        return [self.kind, list(self.target), self.line, self.col]
+
+    @classmethod
+    def from_dict(cls, d: list) -> "SpawnSite":
+        return cls(d[0], tuple(d[1]), d[2], d[3])
+
+
+@dataclass
+class WriteSite:
+    chain: Tuple[str, ...]    # receiver chain ("self",) for self.attr=
+    attr: str
+    line: int
+    col: int
+    locks: Tuple[str, ...] = ()
+
+    def to_dict(self) -> list:
+        return [list(self.chain), self.attr, self.line, self.col,
+                list(self.locks)]
+
+    @classmethod
+    def from_dict(cls, d: list) -> "WriteSite":
+        return cls(tuple(d[0]), d[1], d[2], d[3], tuple(d[4]))
+
+
+@dataclass
+class FuncInfo:
+    name: str
+    qualname: str             # "Class.method", "fn", "fn.inner"
+    cls: Optional[str]        # enclosing class name (innermost)
+    line: int
+    is_async: bool
+    boots_loop: bool = False
+    calls: List[CallSite] = field(default_factory=list)
+    spawns: List[SpawnSite] = field(default_factory=list)
+    writes: List[WriteSite] = field(default_factory=list)
+    #: simple local aliases: ``sess = self.session`` → sess → chain
+    aliases: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    #: nested defs visible in this function's scope: name → qualname
+    local_defs: Dict[str, str] = field(default_factory=dict)
+    #: parameter names: dynamic roots that must never resolve to an
+    #: import/module-def of the same name
+    params: Tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "qualname": self.qualname,
+            "cls": self.cls, "line": self.line,
+            "is_async": int(self.is_async),
+            "boots_loop": int(self.boots_loop),
+            "calls": [c.to_dict() for c in self.calls],
+            "spawns": [s.to_dict() for s in self.spawns],
+            "writes": [w.to_dict() for w in self.writes],
+            "aliases": {k: list(v) for k, v in self.aliases.items()},
+            "local_defs": dict(self.local_defs),
+            "params": list(self.params),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FuncInfo":
+        return cls(
+            name=d["name"], qualname=d["qualname"], cls=d["cls"],
+            line=d["line"], is_async=bool(d["is_async"]),
+            boots_loop=bool(d["boots_loop"]),
+            calls=[CallSite.from_dict(c) for c in d["calls"]],
+            spawns=[SpawnSite.from_dict(s) for s in d["spawns"]],
+            writes=[WriteSite.from_dict(w) for w in d["writes"]],
+            aliases={k: tuple(v) for k, v in d["aliases"].items()},
+            local_defs=dict(d["local_defs"]),
+            params=tuple(d.get("params", ())),
+        )
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    line: int
+    bases: List[Tuple[str, ...]] = field(default_factory=list)
+    methods: Dict[str, str] = field(default_factory=dict)  # → qualname
+    async_methods: set = field(default_factory=set)
+    #: inferred ``self.attr = SomeClass(...)`` types: attr → class chain
+    attr_types: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name, "line": self.line,
+            "bases": [list(b) for b in self.bases],
+            "methods": dict(self.methods),
+            "async_methods": sorted(self.async_methods),
+            "attr_types": {k: list(v) for k, v in
+                           self.attr_types.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ClassInfo":
+        return cls(
+            name=d["name"], line=d["line"],
+            bases=[tuple(b) for b in d["bases"]],
+            methods=dict(d["methods"]),
+            async_methods=set(d["async_methods"]),
+            attr_types={k: tuple(v) for k, v in d["attr_types"].items()},
+        )
+
+
+@dataclass
+class ModuleSummary:
+    module: str               # dotted module name ("emqx_tpu.broker.x")
+    relpath: str
+    digest: str               # sha1 of the source
+    is_package: bool = False  # True for __init__.py
+    imports: Dict[str, str] = field(default_factory=dict)  # alias → dotted
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    module_defs: Dict[str, str] = field(default_factory=dict)
+    module_async_defs: set = field(default_factory=set)
+    module_sync_defs: set = field(default_factory=set)
+    alarm_acts: List[Tuple[str, bool]] = field(default_factory=list)
+    # (name, is_prefix, line, col, qualname)
+    alarm_deacts: List[Tuple[str, bool, int, int, str]] = \
+        field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "module": self.module, "relpath": self.relpath,
+            "digest": self.digest, "is_package": int(self.is_package),
+            "imports": dict(self.imports),
+            "classes": {k: v.to_dict() for k, v in self.classes.items()},
+            "functions": {k: v.to_dict()
+                          for k, v in self.functions.items()},
+            "module_defs": dict(self.module_defs),
+            "module_async_defs": sorted(self.module_async_defs),
+            "module_sync_defs": sorted(self.module_sync_defs),
+            "alarm_acts": [list(a) for a in self.alarm_acts],
+            "alarm_deacts": [list(a) for a in self.alarm_deacts],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModuleSummary":
+        return cls(
+            module=d["module"], relpath=d["relpath"], digest=d["digest"],
+            is_package=bool(d["is_package"]),
+            imports=dict(d["imports"]),
+            classes={k: ClassInfo.from_dict(v)
+                     for k, v in d["classes"].items()},
+            functions={k: FuncInfo.from_dict(v)
+                       for k, v in d["functions"].items()},
+            module_defs=dict(d["module_defs"]),
+            module_async_defs=set(d["module_async_defs"]),
+            module_sync_defs=set(d["module_sync_defs"]),
+            alarm_acts=[(a[0], bool(a[1])) for a in d["alarm_acts"]],
+            alarm_deacts=[(a[0], bool(a[1]), a[2], a[3], a[4])
+                          for a in d["alarm_deacts"]],
+        )
+
+
+def module_name_for(relpath: str) -> Tuple[str, bool]:
+    """(dotted module name, is_package) for a repo-relative path."""
+    p = relpath
+    if p.endswith(".py"):
+        p = p[:-3]
+    is_package = False
+    if p.endswith("/__init__") or p == "__init__":
+        p = p[:-len("/__init__")] if "/" in p else p[:-len("__init__")]
+        is_package = True
+    p = p.strip("/")
+    return p.replace("/", "."), is_package
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fstring_prefix(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        if node.values and isinstance(node.values[0], ast.Constant) \
+                and isinstance(node.values[0].value, str):
+            return node.values[0].value
+        return ""
+    return None
+
+
+class _Extractor:
+    """Recursive walk building the ModuleSummary."""
+
+    def __init__(self, summary: ModuleSummary, tree: ast.Module) -> None:
+        self.s = summary
+        self.tree = tree
+        self.class_stack: List[ClassInfo] = []
+        self.func_stack: List[FuncInfo] = []
+        self.lock_stack: List[str] = []
+
+    # -- helpers -------------------------------------------------------
+
+    def _qual(self, name: str) -> str:
+        parts = [c.name for c in self.class_stack] \
+            + [f.name for f in self.func_stack] + [name]
+        return ".".join(parts)
+
+    def _qualname(self) -> str:
+        parts = [c.name for c in self.class_stack] \
+            + [f.name for f in self.func_stack]
+        return ".".join(parts) if parts else "<module>"
+
+    def _locks(self) -> Tuple[str, ...]:
+        return tuple(self.lock_stack)
+
+    def _lock_name(self, expr: ast.AST) -> Optional[str]:
+        """Terminal lock name of a with-item, following one level of
+        local alias (``mu = sess.mutex`` → ``with mu`` holds "mutex")."""
+        chain = chain_of(expr)
+        if chain is None:
+            return None
+        name = chain[-1]
+        if len(chain) == 1 and self.func_stack:
+            ali = self.func_stack[-1].aliases.get(name)
+            if ali:
+                name = ali[-1]
+        if name == "mutex" or name == "lock" or name.endswith("_lock") \
+                or name in ("Lock", "RLock"):
+            return name
+        return None
+
+    # -- walk ----------------------------------------------------------
+
+    def run(self) -> None:
+        for node in self.tree.body:
+            self._visit(node)
+
+    def _visit(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            self._imports(node)
+        elif isinstance(node, ast.ClassDef):
+            self._class(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._func(node)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            held = 0
+            for item in node.items:
+                name = self._lock_name(item.context_expr)
+                if name is not None:
+                    self.lock_stack.append(name)
+                    held += 1
+                self._visit_expr(item.context_expr)
+            for child in node.body:
+                self._visit(child)
+            for _ in range(held):
+                self.lock_stack.pop()
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._assign(node)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._write_target(t)
+        elif isinstance(node, ast.Expr):
+            if isinstance(node.value, ast.Call):
+                self._call(node.value, discarded=True)
+            else:
+                self._visit_expr(node.value)
+        elif isinstance(node, ast.Call):
+            self._call(node, discarded=False)
+        else:
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+
+    def _visit_expr(self, node: ast.AST) -> None:
+        """Descend into an expression looking for calls."""
+        if isinstance(node, ast.Call):
+            self._call(node, discarded=False)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._visit_expr(child)
+
+    # -- imports -------------------------------------------------------
+
+    def _imports(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    self.s.imports[a.asname] = a.name
+                else:
+                    # ``import a.b.c`` binds root name "a"
+                    root = a.name.split(".")[0]
+                    self.s.imports.setdefault(root, root)
+        elif isinstance(node, ast.ImportFrom):
+            base = self._import_base(node)
+            if base is None:
+                return
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                self.s.imports[local] = (
+                    f"{base}.{a.name}" if base else a.name)
+
+    def _import_base(self, node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module or ""
+        parts = self.s.module.split(".")
+        if not self.s.is_package:
+            parts = parts[:-1]
+        up = node.level - 1
+        if up > len(parts):
+            return None
+        if up:
+            parts = parts[:-up]
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts)
+
+    # -- defs ----------------------------------------------------------
+
+    def _class(self, node: ast.ClassDef) -> None:
+        ci = ClassInfo(name=node.name, line=node.lineno)
+        for b in node.bases:
+            chain = chain_of(b)
+            if chain is not None:
+                ci.bases.append(chain)
+        if not self.class_stack and not self.func_stack:
+            self.s.classes[node.name] = ci
+        self.class_stack.append(ci)
+        for child in node.body:
+            self._visit(child)
+        self.class_stack.pop()
+
+    def _func(self, node: ast.AST) -> None:
+        is_async = isinstance(node, ast.AsyncFunctionDef)
+        qualname = self._qual(node.name)
+        a = node.args
+        params = tuple(
+            p.arg for p in (list(a.posonlyargs) + list(a.args)
+                            + list(a.kwonlyargs))
+        ) + tuple(p.arg for p in (a.vararg, a.kwarg) if p is not None)
+        fi = FuncInfo(
+            name=node.name, qualname=qualname,
+            cls=(self.class_stack[-1].name if self.class_stack else None),
+            line=node.lineno, is_async=is_async, params=params,
+        )
+        fi.boots_loop = any(
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _LOOP_BOOT
+            or isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+            and sub.func.id in _LOOP_BOOT
+            for sub in ast.walk(node)
+        )
+        self.s.functions[qualname] = fi
+        if self.class_stack and len(self.func_stack) == 0:
+            ci = self.class_stack[-1]
+            ci.methods[node.name] = qualname
+            if is_async:
+                ci.async_methods.add(node.name)
+        elif not self.class_stack and not self.func_stack:
+            self.s.module_defs[node.name] = qualname
+            (self.s.module_async_defs if is_async
+             else self.s.module_sync_defs).add(node.name)
+        if self.func_stack:
+            self.func_stack[-1].local_defs[node.name] = qualname
+        self.func_stack.append(fi)
+        outer_locks = self.lock_stack
+        self.lock_stack = []
+        for child in node.body:
+            self._visit(child)
+        self.lock_stack = outer_locks
+        self.func_stack.pop()
+
+    # -- assignments / writes ------------------------------------------
+
+    def _assign(self, node: ast.AST) -> None:
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        value = getattr(node, "value", None)
+        fn = self.func_stack[-1] if self.func_stack else None
+        for t in targets:
+            self._write_target(t)
+            # alias tracking: ``sess = self.session`` / attr-type
+            # inference: ``self.session = Session(...)``
+            if fn is not None and isinstance(t, ast.Name) \
+                    and value is not None and not isinstance(node,
+                                                            ast.AugAssign):
+                chain = chain_of(value)
+                if chain is not None and len(chain) > 1:
+                    fn.aliases[t.id] = chain
+            if self.class_stack and isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self" \
+                    and isinstance(value, ast.Call):
+                cchain = chain_of(value.func)
+                if cchain is not None:
+                    self.class_stack[-1].attr_types.setdefault(
+                        t.attr, cchain)
+        if value is not None:
+            self._visit_expr(value)
+
+    def _write_target(self, t: ast.AST) -> None:
+        fn = self.func_stack[-1] if self.func_stack else None
+        if fn is None:
+            return
+        # self.x = v / obj.attr = v
+        if isinstance(t, ast.Attribute):
+            chain = chain_of(t.value)
+            if chain is not None:
+                fn.writes.append(WriteSite(
+                    chain=chain, attr=t.attr, line=t.lineno,
+                    col=t.col_offset, locks=self._locks()))
+        # self.x[k] = v → mutation of attr x
+        elif isinstance(t, ast.Subscript):
+            if isinstance(t.value, ast.Attribute):
+                chain = chain_of(t.value.value)
+                if chain is not None:
+                    fn.writes.append(WriteSite(
+                        chain=chain, attr=t.value.attr, line=t.lineno,
+                        col=t.col_offset, locks=self._locks()))
+            self._visit_expr(t.slice)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._write_target(el)
+
+    # -- calls ---------------------------------------------------------
+
+    def _call(self, node: ast.Call, discarded: bool) -> None:
+        fn = self.func_stack[-1] if self.func_stack else None
+        chain = chain_of(node.func)
+        terminal = chain[-1] if chain else None
+        if fn is not None and chain is not None:
+            fn.calls.append(CallSite(
+                chain=chain, line=node.lineno, col=node.col_offset,
+                discarded=discarded, locks=self._locks()))
+        # alarm notes (registry-drift cross-file pairing)
+        if terminal in ("activate", "deactivate") and chain is not None \
+                and len(chain) >= 2 and "alarm" in chain[-2].lower() \
+                and node.args:
+            self._alarm_note(node, terminal)
+        # spawn sites
+        if fn is not None:
+            self._spawn(node, terminal, fn)
+        for arg in node.args:
+            self._visit_expr(arg)
+        for kw in node.keywords:
+            self._visit_expr(kw.value)
+
+    def _alarm_note(self, node: ast.Call, method: str) -> None:
+        arg = node.args[0]
+        literal = _literal_str(arg)
+        if literal is not None:
+            entry = (literal, False)
+        else:
+            prefix = _fstring_prefix(arg)
+            if not prefix:
+                return
+            entry = (prefix, True)
+        if method == "activate":
+            self.s.alarm_acts.append(entry)
+        else:
+            self.s.alarm_deacts.append(
+                (entry[0], entry[1], node.lineno, node.col_offset,
+                 self._qualname()))
+
+    def _spawn(self, node: ast.Call, terminal: Optional[str],
+               fn: FuncInfo) -> None:
+        target: Optional[ast.AST] = None
+        kind = None
+        if terminal == "to_thread" and node.args:
+            target, kind = node.args[0], "thread"
+        elif terminal == "run_in_executor" and len(node.args) >= 2:
+            target, kind = node.args[1], "thread"
+        elif terminal == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target, kind = kw.value, "thread"
+                    break
+        elif terminal in _MARSHAL_TERMINALS and node.args:
+            target, kind = node.args[0], "marshal"
+        elif terminal in _CHILD_TERMINALS and len(node.args) >= 2:
+            target, kind = node.args[1], "child"
+        if target is None or kind is None:
+            return
+        if isinstance(target, ast.Lambda):
+            q = self._qual(f"<lambda:{target.lineno}>")
+            li = FuncInfo(
+                name="<lambda>", qualname=q,
+                cls=(self.class_stack[-1].name if self.class_stack
+                     else None),
+                line=target.lineno, is_async=False)
+            self.s.functions[q] = li
+            self.func_stack.append(li)
+            self._visit_expr(target.body)
+            self.func_stack.pop()
+            fn.spawns.append(SpawnSite(
+                kind=kind, target=("<local>", q),
+                line=node.lineno, col=node.col_offset))
+            return
+        chain = chain_of(target)
+        if chain is None:
+            return
+        if len(chain) == 1 and chain[0] in fn.local_defs:
+            chain = ("<local>", fn.local_defs[chain[0]])
+        fn.spawns.append(SpawnSite(
+            kind=kind, target=chain, line=node.lineno,
+            col=node.col_offset))
+
+
+def extract_module(relpath: str, tree: ast.Module,
+                   source: str) -> ModuleSummary:
+    module, is_package = module_name_for(relpath)
+    digest = hashlib.sha1(source.encode()).hexdigest()
+    summary = ModuleSummary(
+        module=module, relpath=relpath, digest=digest,
+        is_package=is_package)
+    _Extractor(summary, tree).run()
+    return summary

@@ -140,6 +140,8 @@ def decode(data: bytes) -> Optional[CoapMessage]:
             delta, i = ext(dn, i)
             length, i = ext(ln, i)
         except (ValueError, IndexError):
+            # truncated/garbled option block: a malformed datagram is
+            # dropped whole by contract (decode() → None)
             return None
         num += delta
         msg.options.append((num, data[i:i + length]))

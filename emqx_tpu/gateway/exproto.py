@@ -276,7 +276,8 @@ class ExProtoGateway(Gateway):
         except grpc.aio.AioRpcError as e:
             log.warning("exproto handler unreachable: %s", e.code())
         except (ConnectionError, asyncio.CancelledError):
-            pass
+            pass  # socket died / gateway stopping: the finally below
+            #     unregisters the connection either way
         finally:
             self.conns.pop(conn_id, None)
             self.clients.pop(conn_id, None)

@@ -164,7 +164,8 @@ class StompConn(GatewayConn):
         except (ValueError, ConnectionError) as e:
             self.send_error(str(e))
         except asyncio.CancelledError:
-            pass
+            pass  # gateway stopping: the finally cancels the
+            #     per-connection tasks and closes the socket
         finally:
             for t in self._tasks:
                 t.cancel()

@@ -35,7 +35,7 @@ def set_nodelay(sock) -> None:
 
         sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
     except OSError:
-        pass
+        pass  # best-effort opt: not a TCP socket, or already closed
 
 
 @dataclass
@@ -341,7 +341,7 @@ class Connection:
                     try:
                         await self.stream.drain()
                     except ConnectionError:
-                        pass
+                        pass  # peer already gone: close() below still runs
                     self.stream.close()
                     return
                 continue

@@ -84,6 +84,7 @@ class OcspCache:
             aia = self.cert.extensions.get_extension_for_oid(
                 ExtensionOID.AUTHORITY_INFORMATION_ACCESS).value
         except x509.ExtensionNotFound:
+            # the cert simply has no AIA extension: OCSP not applicable
             return None
         for desc in aia:
             if desc.access_method == AuthorityInformationAccessOID.OCSP:

@@ -331,7 +331,7 @@ class MqttProtocol(asyncio.Protocol):
             try:
                 self.transport.pause_reading()
             except RuntimeError:
-                pass
+                pass  # transport already closing: nothing to pause
 
     def resume_writing(self) -> None:
         self._paused_write = False
@@ -344,7 +344,7 @@ class MqttProtocol(asyncio.Protocol):
             try:
                 self.transport.resume_reading()
             except RuntimeError:
-                pass
+                pass  # transport already closing: nothing to resume
 
     # -- async advisory path -------------------------------------------
 
@@ -358,7 +358,7 @@ class MqttProtocol(asyncio.Protocol):
                     try:
                         self.transport.resume_reading()
                     except RuntimeError:
-                        pass
+                        pass  # transport already closing mid-drain
             self.pkts_in += 1
             try:
                 if (
@@ -389,7 +389,7 @@ class MqttProtocol(asyncio.Protocol):
                 finally:
                     self._flush_writes()
             except asyncio.CancelledError:
-                return
+                return  # connection closing: the worker exits with it
             except Exception:
                 log.exception("protocol worker crashed (%s)",
                               self.conninfo.peername)
@@ -611,7 +611,7 @@ class MqttProtocol(asyncio.Protocol):
         try:
             self.transport.pause_reading()
         except RuntimeError:
-            return
+            return  # transport already closing: no pacing needed
 
         def _resume():
             # a limiter resume must not undo queue/write backpressure —
@@ -622,7 +622,7 @@ class MqttProtocol(asyncio.Protocol):
                 try:
                     self.transport.resume_reading()
                 except RuntimeError:
-                    pass
+                    pass  # transport closed while the pause timer ran
 
         asyncio.get_running_loop().call_later(max(wait, 0.001), _resume)
 

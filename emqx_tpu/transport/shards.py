@@ -401,7 +401,8 @@ class Shard:
         try:
             loop.call_soon_threadsafe(loop.stop)
         except RuntimeError:
-            pass
+            pass  # loop already closed (shard died on its own):
+            #     the join below reaps the thread either way
         await asyncio.to_thread(thread.join, 5.0)
 
     def kill(self) -> bool:

@@ -167,7 +167,7 @@ class WsStream:
                         encode_frame(OP_CLOSE, (1002).to_bytes(2, "big"))
                     )
                 except ConnectionError:
-                    pass
+                    pass  # peer is gone: nothing to wave goodbye to
                 self.closed = True
                 break
         out = bytes(self._buf[:n])
@@ -186,7 +186,7 @@ class WsStream:
             try:
                 self._w.write(encode_frame(OP_CLOSE, payload[:2]))
             except ConnectionError:
-                pass
+                pass  # peer is gone: the close echo has no recipient
             self.closed = True
             return True
         if op in (OP_BIN, OP_TEXT):
@@ -215,7 +215,7 @@ class WsStream:
             try:
                 self._w.write(encode_frame(OP_CLOSE, (1000).to_bytes(2, "big")))
             except ConnectionError:
-                pass
+                pass  # peer is gone: skip the goodbye, close below
         try:
             self._w.close()
         except Exception:

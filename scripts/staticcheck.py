@@ -6,8 +6,20 @@ Usage::
     python scripts/staticcheck.py                       # tree, all rules
     python scripts/staticcheck.py emqx_tpu/broker       # subtree
     python scripts/staticcheck.py --rule registry-drift --rule await-under-lock
+    python scripts/staticcheck.py --changed             # git-diff scope
+    python scripts/staticcheck.py --no-cache            # full cold scan
     python scripts/staticcheck.py --baseline write      # stamp waivers
     python scripts/staticcheck.py --format json
+
+The two-pass whole-program analysis always builds the project symbol
+graph over the full default path set (cross-module resolution needs
+it); ``--changed`` narrows only which files' per-file findings are
+(re)computed and reported — changed files from ``git diff`` plus their
+reverse import-graph dependents, which the import graph makes sound.
+
+Per-file results cache under ``.staticcheck_cache/`` keyed on
+(path, mtime, size, content-hash) plus the rule/registry environment
+and each file's transitive import closure; ``--no-cache`` bypasses.
 
 Exit codes: 0 = clean (all findings waived by live waivers), 1 = new
 findings (or expired waivers whose finding persists), 2 = usage error.
@@ -17,23 +29,57 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
 from emqx_tpu.devtools.staticcheck import (  # noqa: E402
-    check_paths, get_rules, iter_py_files,
+    Registries, analyze, get_rules, iter_py_files,
+)
+from emqx_tpu.devtools.staticcheck.cache import (  # noqa: E402
+    AnalysisCache, environment_digest,
 )
 from emqx_tpu.devtools.staticcheck.report import (  # noqa: E402
     format_json, format_text,
 )
 from emqx_tpu.devtools.staticcheck.rules import ALL_RULES  # noqa: E402
+from emqx_tpu.devtools.staticcheck.symbols import (  # noqa: E402
+    module_name_for,
+)
 from emqx_tpu.devtools.staticcheck.waivers import (  # noqa: E402
     DEFAULT_EXPIRY_DAYS, WaiverFile,
 )
 
 DEFAULT_WAIVER_FILE = os.path.join(_REPO_ROOT, "staticcheck-waivers.json")
+DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".staticcheck_cache")
+
+#: the tier-1 scan set: the package, plus the bench drivers that
+#: consume metric/config names by literal (they have drifted before)
+DEFAULT_SCAN_PATHS = ("emqx_tpu", "bench.py", "scripts/bench_e2e.py")
+
+
+def _default_paths(root: str):
+    return [os.path.join(root, p) for p in DEFAULT_SCAN_PATHS]
+
+
+def _changed_relpaths(root: str):
+    """Repo-relative .py files touched per git (staged + unstaged +
+    untracked)."""
+    out = set()
+    for args in (["diff", "--name-only", "HEAD"],
+                 ["ls-files", "--others", "--exclude-standard"]):
+        try:
+            r = subprocess.run(
+                ["git", "-C", root, *args],
+                capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode != 0:
+            return None
+        out.update(l.strip() for l in r.stdout.splitlines() if l.strip())
+    return {p for p in out if p.endswith(".py")}
 
 
 def main(argv=None) -> int:
@@ -44,7 +90,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "paths", nargs="*",
         default=None,
-        help="files/directories to check (default: emqx_tpu/)",
+        help="files/directories to check (default: emqx_tpu/, bench.py, "
+             "scripts/bench_e2e.py)",
     )
     parser.add_argument(
         "--rule", action="append", dest="rules", metavar="NAME",
@@ -67,6 +114,22 @@ def main(argv=None) -> int:
         help="expiry horizon for --baseline write",
     )
     parser.add_argument(
+        "--changed", action="store_true",
+        help="only report findings for files in git diff (+ untracked) "
+             "plus their reverse import-graph dependents",
+    )
+    parser.add_argument(
+        "--no-cache", action="store_true",
+        help="bypass the analysis cache (.staticcheck_cache/)",
+    )
+    parser.add_argument(
+        "--cache-dir", default=DEFAULT_CACHE_DIR, metavar="DIR",
+        help="analysis cache directory",
+    )
+    parser.add_argument(
+        "--root", default=_REPO_ROOT, help=argparse.SUPPRESS,
+    )
+    parser.add_argument(
         "--format", choices=("text", "json"), default="text",
     )
     try:
@@ -74,7 +137,8 @@ def main(argv=None) -> int:
     except SystemExit as e:
         return 2 if e.code not in (0, None) else 0
 
-    paths = args.paths or [os.path.join(_REPO_ROOT, "emqx_tpu")]
+    root = os.path.abspath(args.root)
+    paths = args.paths or _default_paths(root)
     for p in paths:
         if not os.path.exists(p):
             print(f"staticcheck: no such path: {p}", file=sys.stderr)
@@ -85,8 +149,45 @@ def main(argv=None) -> int:
         print(f"staticcheck: {e.args[0]}", file=sys.stderr)
         return 2
 
-    files = list(iter_py_files(paths))
-    findings = check_paths(files, rules, root=_REPO_ROOT)
+    cache = None
+    if not args.no_cache:
+        registries = None
+        if any(r.name == "registry-drift" for r in rules):
+            try:
+                registries = Registries.load()
+            except Exception:
+                registries = None
+        env = environment_digest([r.name for r in rules], registries)
+        cache = AnalysisCache(args.cache_dir, env)
+
+    targets = None
+    if args.changed:
+        changed = _changed_relpaths(root)
+        if changed is None:
+            print("staticcheck: --changed needs a git checkout",
+                  file=sys.stderr)
+            return 2
+        if not changed:
+            print("0 finding(s) (clean); nothing changed per git")
+            return 0
+        # expand over the reverse import graph after pass 1 — done via
+        # a pre-analysis to learn the graph, then the real run
+        pre = analyze(paths, [], root=root, cache=cache, targets=set())
+        project = pre.project
+        changed_mods = [module_name_for(p)[0] for p in changed]
+        keep_mods = project.dependents_closure(changed_mods)
+        targets = {
+            s.relpath for s in project.modules.values()
+            if s.module in keep_mods or s.relpath in changed
+        }
+        if not targets:
+            print("0 finding(s) (clean); changed files outside the "
+                  "scan set")
+            return 0
+
+    result = analyze(paths, rules, root=root, cache=cache,
+                     targets=targets, prune_cache=not args.paths)
+    findings = result.findings
 
     if args.baseline == "write":
         wf = WaiverFile.baseline(findings, days=args.expiry_days)
@@ -98,7 +199,8 @@ def main(argv=None) -> int:
     wf = WaiverFile.load(args.waivers)
     new, waived, expired, stale = wf.apply(findings)
     fmt = format_json if args.format == "json" else format_text
-    print(fmt(new, waived, expired, stale, files_checked=len(files)))
+    print(fmt(new, waived, expired, stale,
+              files_checked=len(result.files)))
     return 1 if new else 0
 
 

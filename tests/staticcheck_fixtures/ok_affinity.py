@@ -1,0 +1,50 @@
+"""Fixture: shard-affinity must NOT flag any of these."""
+
+import threading
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}
+
+
+class Session:
+    def __init__(self):
+        self.inflight = {}
+        self.subscriptions = {}
+
+
+class ShardChannel:
+    def __init__(self, broker, session, pool):
+        self.broker = broker
+        self.session = session
+        self.pool = pool
+        self.mutex = threading.RLock()
+
+    def handle_ack_run(self, run):
+        # RLock-set session field under the mutex: the documented
+        # shard-side pattern
+        with self.mutex:
+            self.session.inflight[1] = run
+            self._ack(run)
+        # broker-touching work marshals instead of writing
+        self.pool.marshal(self, run)
+
+    def _ack(self, run):
+        self.session.inflight[2] = ("pubrel", None)
+
+
+class ShardPool:
+    def __init__(self, broker):
+        self.broker = broker
+
+    def _main_handle(self, chan, pkt):
+        # main-loop surface: broker writes are its job
+        self.broker.routes["x"] = pkt
+
+
+def fanout_deliver(sess, msgs):
+    # unreached from any shard/thread entry: main-loop-only helpers
+    # write session registry state freely
+    sess.subscriptions["t"] = 1
+    return msgs

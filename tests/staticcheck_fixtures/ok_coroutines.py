@@ -20,3 +20,21 @@ class C:
 
     def name_shadow(self, flush):
         flush()  # plain callable param, not the async method
+
+
+class Base:
+    async def aclose(self):
+        pass
+
+
+class E(Base):
+    async def shutdown(self):
+        await self.aclose()  # inherited, awaited
+
+
+class F(Base):
+    def aclose(self):  # sync override shadows the async base method
+        pass
+
+    def shutdown(self):
+        self.aclose()  # resolves to the SYNC override via the MRO

@@ -5,6 +5,7 @@ activate/deactivate pair (literal + f-string prefix)."""
 def f(metrics, cfg, alarms, hooks, _injector, name):
     metrics.inc("messages.delivered")
     metrics.set("broker.fanout.depth", 3)
+    metrics.get("broker.supervisor.restarts")
     cfg.get("mqtt.max_inflight")
     _injector.check("fanout.drain")
     alarms.activate("overload_fixture", {}, "hot")

@@ -26,3 +26,11 @@ def deliver(batch, conn):
         conn.commit()
     except Exception:
         raise
+
+
+def wait(conn):
+
+    try:
+        conn.wait(timeout=1.0)
+    except TimeoutError:
+        pass

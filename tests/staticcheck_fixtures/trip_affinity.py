@@ -1,0 +1,47 @@
+"""Fixture: shard-affinity MUST flag these (3 findings)."""
+
+import threading
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}
+        self.sessions = {}
+
+
+class Session:
+    def __init__(self):
+        self.inflight = {}
+        self.subscriptions = {}
+        self.mutex = None
+
+
+class ShardChannel:
+    """Matches the AFFINITY_SEEDS qualname suffixes, so its handler
+    surface is shard-affine by declaration."""
+
+    def __init__(self, broker, session):
+        self.broker = broker
+        self.session = session
+        self.mutex = threading.RLock()
+
+    def handle_ack_run(self, run):
+        # (1) Broker state is main-loop-only: a shard-side write is a
+        # race whether or not any lock is held
+        self.broker.routes["x"] = run
+        with self.mutex:
+            self._ack(run)
+        # (2) Session field in the documented RLock set, written
+        # WITHOUT the mutex on a shard path
+        self.session.inflight[1] = run
+
+    def _ack(self, run):
+        # fine: inflight mutation, reached only under the mutex
+        self.session.inflight[2] = ("pubrel", None)
+
+    def check_keepalive(self):
+        # (3) Session field OUTSIDE the RLock set: main-loop-only even
+        # under the lock (the mutex protects the QoS window, not the
+        # subscription registry)
+        with self.mutex:
+            self.session.subscriptions["t"] = 1

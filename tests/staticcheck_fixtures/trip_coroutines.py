@@ -15,3 +15,14 @@ class C:
 
     def tick(self):
         self.flush()
+
+
+class Base:
+    async def aclose(self):
+        pass
+
+
+class D(Base):
+    def shutdown(self):
+        # inherited async method: resolved through the class MRO
+        self.aclose()

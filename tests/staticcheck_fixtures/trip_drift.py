@@ -5,6 +5,7 @@ broker/hooks.py)."""
 
 def f(metrics, cfg, alarms, hooks, _injector):
     metrics.inc("tpu.match.not_a_real_metric")
+    metrics.get("tpu.match.not_a_real_read")
     cfg.get("mqtt.not_a_real_key")
     _injector.check("bogus.point")
     alarms.deactivate("never_activated_alarm")

@@ -1,7 +1,8 @@
-"""Fixture: loop-thread-taint MUST flag these (4 findings)."""
+"""Fixture: loop-thread-taint MUST flag these (6 findings)."""
 
 import asyncio
 import threading
+from asyncio import ensure_future as _ef
 
 
 def _compute():
@@ -28,16 +29,44 @@ class Worker:
 
 
 def _notify():
-    # innocent-looking helper — but it schedules onto a foreign loop
+    # (4) innocent-looking helper — but it schedules onto a foreign
+    # loop; the taint reaches it transitively through _worker and the
+    # finding lands here, at the affine call itself
     asyncio.ensure_future(asyncio.sleep(0))
 
 
 def _worker():
-    # (4) transitive (one level): _worker runs on a thread and calls
-    # _notify, whose body is loop-affine
+    # thread entry that delegates: the taint crosses the call
     _notify()
     return 0
 
 
 async def spawn_transitive():
     return await asyncio.to_thread(_worker)
+
+
+def _hop2():
+    # (5) TWO hops from the thread entry: any-depth propagation
+    asyncio.create_task(asyncio.sleep(0))
+
+
+def _hop1():
+    _hop2()
+
+
+def _deep_worker():
+    _hop1()
+    return 0
+
+
+async def spawn_deep():
+    return await asyncio.to_thread(_deep_worker)
+
+
+def _aliased():
+    # (6) aliased spawner caught through import resolution
+    _ef(asyncio.sleep(0))
+
+
+async def spawn_aliased():
+    return await asyncio.to_thread(_aliased)

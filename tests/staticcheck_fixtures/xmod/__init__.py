@@ -1,0 +1,3 @@
+"""Multi-file fixture package: proves the whole-program analysis
+actually crosses module boundaries — the thread/loop entries live in
+``entry.py`` while every offending call lives in a sibling module."""

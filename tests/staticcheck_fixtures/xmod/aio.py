@@ -1,0 +1,9 @@
+"""Async API consumed (wrongly and rightly) from entry.py."""
+
+
+async def flush():
+    pass
+
+
+async def drain():
+    pass

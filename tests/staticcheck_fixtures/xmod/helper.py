@@ -1,0 +1,19 @@
+"""Innocent-looking helpers; the taint arrives from entry.py."""
+
+import asyncio
+
+
+def notify(evt):
+    # loop-affine, one module away from the thread entry: the finding
+    # must land HERE (helper.py), not in entry.py
+    asyncio.ensure_future(asyncio.sleep(0))
+
+
+def relay(evt):
+    # second hop, still cross-module
+    notify(evt)
+
+
+def marshal_ok(loop, evt):
+    # the sanctioned cross-thread entry point: never flagged
+    loop.call_soon_threadsafe(evt.set)

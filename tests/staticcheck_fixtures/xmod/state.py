@@ -1,0 +1,6 @@
+"""Main-loop-owned state, written from the wrong module/context."""
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}

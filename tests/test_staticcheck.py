@@ -1,14 +1,21 @@
 """Tier-1 enforcement of the project-invariant static analysis suite
 (emqx_tpu/devtools/staticcheck) — the dialyzer/xref analog.
 
-Three layers:
+Layers:
 
-* **the tree is clean**: all seven rules over ``emqx_tpu/`` produce zero
+* **the tree is clean**: all eight rules over ``emqx_tpu/`` plus the
+  bench drivers (``bench.py``, ``scripts/bench_e2e.py``) produce zero
   non-waived findings, and every waiver (if any ever lands) is an
   explicit, justified, expiring entry — no silent suppressions;
 * **the rules work**: each rule has a tripping and a passing fixture
   under ``tests/staticcheck_fixtures/``, waiver keys are line-stable,
   and expiry/staleness behave;
+* **the whole-program analysis crosses modules**: the ``xmod`` fixture
+  package puts every offending call in a different module than its
+  thread/loop entry and the findings land at the right file:line;
+* **the cache is sound**: warm runs reuse summaries+findings, a dep
+  edit invalidates exactly its dependents, ``--changed`` re-checks
+  changed files plus reverse import-graph dependents;
 * **the CLI works**: a violation seeded into a copy of
   ``broker/fanout.py`` is caught with a file:line finding and exit 1;
   a clean run exits 0.
@@ -60,8 +67,14 @@ def check_fixture(name, rules, tmp_path, relpath="emqx_tpu/broker"):
 # the tree is clean (the tier-1 gate)
 # ---------------------------------------------------------------------------
 
+#: the tier-1 scan set: the package plus the bench drivers whose
+#: metric/config literals have silently drifted before
+SCAN_PATHS = [PKG, os.path.join(REPO, "bench.py"),
+              os.path.join(REPO, "scripts", "bench_e2e.py")]
+
+
 def test_tree_has_zero_nonwaived_findings():
-    findings = check_paths([PKG], get_rules(), root=REPO)
+    findings = check_paths(SCAN_PATHS, get_rules(), root=REPO)
     wf = WaiverFile.load(WAIVER_FILE)
     new, waived, expired, stale = wf.apply(findings)
     assert not new, (
@@ -91,13 +104,14 @@ def test_waiver_file_has_no_silent_suppressions():
 
 @pytest.mark.parametrize("rule,trip,ok,n_trip", [
     ("no-unsupervised-task", "trip_tasks.py", "ok_tasks.py", 3),
-    ("loop-thread-taint", "trip_threads.py", "ok_threads.py", 4),
+    ("loop-thread-taint", "trip_threads.py", "ok_threads.py", 6),
+    ("shard-affinity", "trip_affinity.py", "ok_affinity.py", 3),
     ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
     ("no-swallowed-exceptions", "trip_exceptions.py",
-     "ok_exceptions.py", 2),
+     "ok_exceptions.py", 3),
     ("await-under-lock", "trip_locks.py", "ok_locks.py", 3),
-    ("registry-drift", "trip_drift.py", "ok_drift.py", 6),
-    ("unawaited-coroutine", "trip_coroutines.py", "ok_coroutines.py", 2),
+    ("registry-drift", "trip_drift.py", "ok_drift.py", 7),
+    ("unawaited-coroutine", "trip_coroutines.py", "ok_coroutines.py", 3),
 ])
 def test_rule_fixture_pair(rule, trip, ok, n_trip, tmp_path):
     tripped = check_fixture(trip, [rule], tmp_path)
@@ -228,6 +242,207 @@ def test_registries_match_runtime_tables():
     assert reg.fault_points == set(faultinject.POINTS)
     from emqx_tpu.broker.hooks import HOOK_POINTS
     assert reg.hook_points == set(HOOK_POINTS)
+
+
+# ---------------------------------------------------------------------------
+# whole-program analysis: cross-module resolution (the xmod package)
+# ---------------------------------------------------------------------------
+
+def _stage_xmod(tmp_path):
+    dest = tmp_path / "xmod"
+    shutil.copytree(os.path.join(FIXTURES, "xmod"), dest)
+    return dest
+
+
+def test_cross_module_taint_lands_in_the_helper_module(tmp_path):
+    dest = _stage_xmod(tmp_path)
+    out = check_paths([str(dest)], get_rules(["loop-thread-taint"]),
+                      root=str(tmp_path))
+    # the thread entry is entry.py; the affine call (and the finding)
+    # is two modules away in helper.py, at the ensure_future line
+    assert len(out) == 1, [(f.path, f.line, f.message) for f in out]
+    f = out[0]
+    assert f.path == "xmod/helper.py"
+    src = open(os.path.join(FIXTURES, "xmod", "helper.py")).read()
+    want = src[:src.index("asyncio.ensure_future")].count("\n") + 1
+    assert f.line == want
+    assert "relay" in f.message and "notify" in f.message
+
+
+def test_cross_module_unawaited_coroutine(tmp_path):
+    dest = _stage_xmod(tmp_path)
+    out = check_paths([str(dest)], get_rules(["unawaited-coroutine"]),
+                      root=str(tmp_path))
+    assert len(out) == 1, [(f.path, f.line, f.message) for f in out]
+    assert out[0].path == "xmod/entry.py"
+    assert "flush" in out[0].message
+
+
+def test_cross_module_shard_affinity_write(tmp_path):
+    dest = _stage_xmod(tmp_path)
+    out = check_paths([str(dest)], get_rules(["shard-affinity"]),
+                      root=str(tmp_path))
+    assert len(out) == 1, [(f.path, f.line, f.message) for f in out]
+    f = out[0]
+    assert f.path == "xmod/entry.py" and f.context == "shard_worker"
+    assert "main-loop-only" in f.message
+
+
+def test_affinity_keys_survive_line_drift(tmp_path):
+    a = check_fixture("trip_affinity.py", ["shard-affinity"], tmp_path)
+    src = open(os.path.join(FIXTURES, "trip_affinity.py")).read()
+    shifted = tmp_path / "emqx_tpu" / "broker" / "trip_affinity.py"
+    shifted.write_text("# shim\n# shim\n" + src)
+    b = check_paths([str(shifted)], get_rules(["shard-affinity"]),
+                    root=str(tmp_path))
+    assert [f.key for f in a] == [f.key for f in b]
+    assert [f.line for f in a] != [f.line for f in b]
+
+
+def test_delivery_path_scope_covers_post_pr4_modules():
+    from emqx_tpu.devtools.staticcheck import project
+
+    for mod in project.DELIVERY_PATH_REQUIRED_MODULES:
+        assert mod.startswith(project.DELIVERY_PATH_PREFIXES), mod
+        assert os.path.exists(os.path.join(REPO, mod)), mod
+
+
+def test_drift_checks_metric_reads_like_the_bench_drivers(tmp_path):
+    # bench.py / scripts/bench_e2e.py read metrics by literal name
+    # (metrics.get); a drifted name must trip like a write would
+    dest_dir = tmp_path / "emqx_tpu" / "broker"
+    dest_dir.mkdir(parents=True)
+    dest = dest_dir / "snap.py"
+    dest.write_text(
+        "def snap(metrics):\n"
+        "    ok = metrics.get(\"broker.supervisor.restarts\")\n"
+        "    bad = metrics.get(\"broker.not_a_real_metric\")\n"
+        "    return ok, bad\n"
+    )
+    out = check_paths([str(dest)], get_rules(["registry-drift"]),
+                      root=str(tmp_path))
+    assert len(out) == 1 and out[0].line == 3
+
+
+def test_cli_default_scan_set_includes_bench_drivers():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("sc_cli", CLI)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert "bench.py" in mod.DEFAULT_SCAN_PATHS
+    assert "scripts/bench_e2e.py" in mod.DEFAULT_SCAN_PATHS
+
+
+# ---------------------------------------------------------------------------
+# the analysis cache: warm reuse, dep-edit invalidation, --changed
+# ---------------------------------------------------------------------------
+
+def _mini_pkg(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text("async def go():\n    pass\n")
+    (pkg / "b.py").write_text(
+        "from .a import go\n\n\ndef run():\n    go()\n")
+    return pkg
+
+
+def _mini_analyze(tmp_path, pkg):
+    from emqx_tpu.devtools.staticcheck import analyze
+    from emqx_tpu.devtools.staticcheck.cache import (
+        AnalysisCache, environment_digest)
+
+    env = environment_digest(["unawaited-coroutine"])
+    cache = AnalysisCache(str(tmp_path / "cc"), env)
+    return analyze([str(pkg)], get_rules(["unawaited-coroutine"]),
+                   root=str(tmp_path), cache=cache)
+
+
+def test_cache_warm_run_reuses_everything(tmp_path):
+    pkg = _mini_pkg(tmp_path)
+    r1 = _mini_analyze(tmp_path, pkg)
+    assert len(r1.findings) == 1 and r1.files_walked == 3
+    r2 = _mini_analyze(tmp_path, pkg)
+    assert [f.key for f in r2.findings] == [f.key for f in r1.findings]
+    assert r2.files_walked == 0 and r2.files_cached == 3
+
+
+def test_cache_invalidates_on_dependency_edit(tmp_path):
+    pkg = _mini_pkg(tmp_path)
+    assert len(_mini_analyze(tmp_path, pkg).findings) == 1
+    # a.go becomes sync: b.py is byte-identical but its finding must
+    # disappear — the transitive deps digest invalidates it
+    (pkg / "a.py").write_text("def go():\n    pass\n")
+    r = _mini_analyze(tmp_path, pkg)
+    assert r.findings == []
+    assert r.files_walked >= 2  # a.py (changed) AND b.py (dependent)
+
+
+def test_cache_invalidates_on_content_edit(tmp_path):
+    pkg = _mini_pkg(tmp_path)
+    assert len(_mini_analyze(tmp_path, pkg).findings) == 1
+    (pkg / "b.py").write_text(
+        "from .a import go\n\n\nasync def run():\n    await go()\n")
+    assert _mini_analyze(tmp_path, pkg).findings == []
+
+
+def test_cli_no_cache_flag_skips_the_cache(tmp_path):
+    pkg = _mini_pkg(tmp_path)
+    cache_dir = tmp_path / "cachedir"
+    r = _cli("--root", str(tmp_path), "--cache-dir", str(cache_dir),
+             "--no-cache", str(pkg))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert not cache_dir.exists()
+    r = _cli("--root", str(tmp_path), "--cache-dir", str(cache_dir),
+             str(pkg))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert (cache_dir / "cache.json").exists()
+
+
+def _git(tmp_path, *args):
+    return subprocess.run(
+        ["git", "-C", str(tmp_path), "-c", "user.email=t@t",
+         "-c", "user.name=t", *args],
+        capture_output=True, text=True, timeout=30)
+
+
+def test_cli_changed_mode_rechecks_reverse_dependents(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text("def go():\n    pass\n")
+    (pkg / "b.py").write_text(
+        "from .a import go\n\n\ndef run():\n    go()\n")
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    assert _git(tmp_path, "add", "-A").returncode == 0
+    assert _git(tmp_path, "commit", "-qm", "seed").returncode == 0
+    # clean at HEAD: --changed with nothing changed is a no-op pass
+    r = _cli("--root", str(tmp_path), "--no-cache", "--changed",
+             str(pkg))
+    assert r.returncode == 0, r.stdout + r.stderr
+    # flip a.go to async: b.py (UNCHANGED per git) now discards a
+    # coroutine — --changed must re-check it as a reverse dependent
+    (pkg / "a.py").write_text("async def go():\n    pass\n")
+    r = _cli("--root", str(tmp_path), "--no-cache", "--changed",
+             str(pkg))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "b.py" in r.stdout and "unawaited-coroutine" in r.stdout
+
+
+@pytest.mark.slow
+def test_full_tree_scan_cold_and_warm_budgets(tmp_path):
+    cache_dir = tmp_path / "cc"
+    t0 = time.monotonic()
+    r = _cli("--cache-dir", str(cache_dir))
+    cold = time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    t0 = time.monotonic()
+    r = _cli("--cache-dir", str(cache_dir))
+    warm = time.monotonic() - t0
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert cold <= 3.0, f"cold full-tree scan took {cold:.2f}s"
+    assert warm <= 1.0, f"warm full-tree scan took {warm:.2f}s"
 
 
 # ---------------------------------------------------------------------------
