@@ -387,6 +387,27 @@ SCHEMA: Dict[str, Field] = {
     "match.breaker.threshold": Field(5, int, lambda v: v >= 1),
     # cadence of the recovery probe while the breaker is open
     "match.breaker.probe_interval": Field(1.0, duration),
+
+    # -- streaming table lifecycle (broker/match_service.py) --------------
+    # opt-in: cold start from persistent compacted segments + background
+    # delta compaction with atomic swap + dirty-region device upload +
+    # padded-shape kernel compile cache.  Off = the rebuild lifecycle,
+    # byte-identical to the pre-segments path.
+    "match.segments.enable": Field(False, _bool),
+    # segment directory; empty = "<node.data_dir or data>/segments"
+    "match.segments.dir": Field("", str),
+    # background compaction cadence and the mutation count below which a
+    # cycle is skipped (as long as a segment already exists on disk)
+    "match.segments.compact_interval": Field(30.0, duration),
+    "match.segments.compact_min_mutations": Field(
+        1024, int, lambda v: v >= 1),
+    # dirty fraction (dirty rows / total rows) above which one
+    # contiguous full upload beats the scatter path on a resize
+    "match.segments.dirty_threshold": Field(
+        0.5, float, lambda v: 0.0 < v <= 1.0),
+    # pre-compile the next pow2 table shapes in the background before
+    # growth reaches them (the resize then serves from the cache)
+    "match.segments.prewarm": Field(True, _bool),
 }
 
 

@@ -29,7 +29,7 @@ from .symbols import ModuleSummary
 
 __all__ = ["AnalysisCache", "environment_digest", "CACHE_VERSION"]
 
-CACHE_VERSION = 1
+CACHE_VERSION = 2  # v2: ModuleSummary grew shard_local + dispatch facts
 
 
 def environment_digest(rule_names, registries=None,

@@ -462,10 +462,39 @@ class AffinityAnalysis:
             st[key] = parent
             worklist.append((fqid, key))
 
+    def _generated_seeds(self) -> Set[str]:
+        """Seeds GENERATED from the ``_SHARD_LOCAL`` packet-type set
+        itself: every type a module declares shard-legal is joined with
+        every ``handle_in`` dispatch-dict fact, so the dispatch
+        barrier's shard-reachable targets seed automatically — a new
+        shard-legal handler cannot silently miss its seed (the old
+        hand-kept list in project.py could).  The generated context is
+        ``(shard, locked=True)``: the declaring dispatcher takes the
+        channel mutex around the shard-local super() call."""
+        shard_local: Set[str] = set()
+        for s in self.project.modules.values():
+            shard_local.update(s.shard_local)
+        out: Set[str] = set()
+        if not shard_local:
+            return out
+        for s in self.project.modules.values():
+            for ci in s.classes.values():
+                for ptype, method in ci.dispatch.items():
+                    if ptype not in shard_local:
+                        continue
+                    q = ci.methods.get(method)
+                    if q is not None:
+                        out.add(f"{s.module}:{q}")
+        return out
+
     def _run(self) -> None:
         project = self.project
         worklist: List[Tuple[str, Tuple[str, bool]]] = []
         barrier_ids: Set[str] = set()
+        self.generated_seeds = self._generated_seeds()
+        for fqid in self.generated_seeds:
+            if project.func(fqid) is not None:
+                self._seed(fqid, SHARD, True, worklist)
         for fqid, s, fi in project.functions():
             # declared seeds (ownership facts)
             for suffix, (ctx, locked) in facts.AFFINITY_SEEDS.items():

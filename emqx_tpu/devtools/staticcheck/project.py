@@ -123,12 +123,15 @@ AFFINITY_SEEDS: Dict[str, Tuple[str, bool]] = {
     "ShardChannel.retry_commit": ("shard", False),
     "ShardChannel.handle_close": ("shard", False),
     "ShardChannel.marshal_done": ("shard", False),
-    # dispatched from ShardChannel.handle_in under the mutex
+    # dispatched from ShardChannel.handle_in under the mutex (the
+    # _fast_pub gate, not _SHARD_LOCAL — so it stays a hand seed)
     "ShardChannel._handle_publish": ("shard", True),
-    "Channel._handle_puback": ("shard", True),
-    "Channel._handle_pubrec": ("shard", True),
-    "Channel._handle_pubrel": ("shard", True),
-    "Channel._handle_pubcomp": ("shard", True),
+    # NOTE: the Channel._handle_puback/_handle_pubrec/_handle_pubrel/
+    # _handle_pubcomp seeds are no longer hand-kept here — pass 2
+    # GENERATES them by joining the `_SHARD_LOCAL` packet-type set
+    # (transport/shards.py) with the `handle_in` dispatch-dict facts
+    # (AffinityAnalysis._generated_seeds), so adding a packet type to
+    # _SHARD_LOCAL automatically seeds its dispatch handler.
     "Shard._consume_inbox": ("shard", False),
     "_ShardProtocol.data_received": ("shard", False),
     # main-loop surfaces of the same file (the marshal consumers)

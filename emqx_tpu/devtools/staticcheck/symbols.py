@@ -173,6 +173,11 @@ class ClassInfo:
     async_methods: set = field(default_factory=set)
     #: inferred ``self.attr = SomeClass(...)`` types: attr → class chain
     attr_types: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    #: packet-type dispatch extracted from a ``handle_in`` dict literal:
+    #: packet-type terminal name ("PUBACK") → self-method name — joined
+    #: in pass 2 with ``_SHARD_LOCAL`` sets to GENERATE the shard seeds
+    #: for shard-legal handlers (no hand-kept list to forget)
+    dispatch: Dict[str, str] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -182,6 +187,7 @@ class ClassInfo:
             "async_methods": sorted(self.async_methods),
             "attr_types": {k: list(v) for k, v in
                            self.attr_types.items()},
+            "dispatch": dict(self.dispatch),
         }
 
     @classmethod
@@ -192,6 +198,7 @@ class ClassInfo:
             methods=dict(d["methods"]),
             async_methods=set(d["async_methods"]),
             attr_types={k: tuple(v) for k, v in d["attr_types"].items()},
+            dispatch=dict(d.get("dispatch", {})),
         )
 
 
@@ -211,6 +218,10 @@ class ModuleSummary:
     # (name, is_prefix, line, col, qualname)
     alarm_deacts: List[Tuple[str, bool, int, int, str]] = \
         field(default_factory=list)
+    #: terminal names of a module-level ``_SHARD_LOCAL`` packet-type
+    #: set ("PUBACK", ...) — the ownership fact the shard-affinity
+    #: seeds generate from (see ClassInfo.dispatch)
+    shard_local: List[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -225,6 +236,7 @@ class ModuleSummary:
             "module_sync_defs": sorted(self.module_sync_defs),
             "alarm_acts": [list(a) for a in self.alarm_acts],
             "alarm_deacts": [list(a) for a in self.alarm_deacts],
+            "shard_local": list(self.shard_local),
         }
 
     @classmethod
@@ -243,6 +255,7 @@ class ModuleSummary:
             alarm_acts=[(a[0], bool(a[1])) for a in d["alarm_acts"]],
             alarm_deacts=[(a[0], bool(a[1]), a[2], a[3], a[4])
                           for a in d["alarm_deacts"]],
+            shard_local=list(d.get("shard_local", [])),
         )
 
 
@@ -449,6 +462,23 @@ class _Extractor:
             ci.methods[node.name] = qualname
             if is_async:
                 ci.async_methods.add(node.name)
+            if node.name == "handle_in":
+                # packet-type dispatch facts: {P.PUBACK: self._handle_x}
+                # dict literals join with _SHARD_LOCAL in pass 2 to
+                # generate the shard-legal handler seeds
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Dict):
+                        continue
+                    for k, v in zip(sub.keys, sub.values):
+                        if isinstance(k, ast.Attribute):
+                            key = k.attr
+                        elif isinstance(k, ast.Name):
+                            key = k.id
+                        else:
+                            continue
+                        ch = chain_of(v)
+                        if ch and len(ch) == 2 and ch[0] == "self":
+                            ci.dispatch[key] = ch[1]
         elif not self.class_stack and not self.func_stack:
             self.s.module_defs[node.name] = qualname
             (self.s.module_async_defs if is_async
@@ -465,10 +495,32 @@ class _Extractor:
 
     # -- assignments / writes ------------------------------------------
 
+    @staticmethod
+    def _ptype_names(value: ast.AST) -> List[str]:
+        """Terminal names of the packet-type elements of a
+        ``frozenset((P.PUBACK, ...))`` / set / tuple literal."""
+        v = value
+        if isinstance(v, ast.Call) and v.args:
+            v = v.args[0]
+        if not isinstance(v, (ast.Tuple, ast.Set, ast.List)):
+            return []
+        out = []
+        for el in v.elts:
+            if isinstance(el, ast.Attribute):
+                out.append(el.attr)
+            elif isinstance(el, ast.Name):
+                out.append(el.id)
+        return sorted(set(out))
+
     def _assign(self, node: ast.AST) -> None:
         targets = (node.targets if isinstance(node, ast.Assign)
                    else [node.target])
         value = getattr(node, "value", None)
+        if not self.func_stack and not self.class_stack \
+                and value is not None:
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "_SHARD_LOCAL":
+                    self.s.shard_local = self._ptype_names(value)
         fn = self.func_stack[-1] if self.func_stack else None
         for t in targets:
             self._write_target(t)

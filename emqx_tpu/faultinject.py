@@ -31,6 +31,13 @@ Named injection points (the seams the batched stack crosses):
                      / delay / hang; in deadline mode a hang is rescued
                      by the per-dispatch timeout)
 ``match.compile``    MatchService warm/compile seam (raise / delay)
+``table.load``       MatchService segment cold-start load (raise ⇒
+                     treated like a corrupt segment: checksum-reject
+                     path, full rebuild serves)
+``table.swap``       MatchService compacted-table swap, fired BEFORE
+                     any state mutates (raise ⇒ the table.compact
+                     child dies mid-swap as a no-op; supervised
+                     restart compacts again)
 ``inflight.insert``  Inflight.insert / insert_many (raise)
 ``inflight.retry``   Inflight.older_than retry scan (raise)
 ``cluster.rpc``      PeerConn.cast — all cluster frames (drop / raise)
@@ -82,6 +89,7 @@ __all__ = [
 
 POINTS = (
     "transport.write", "frame.parse", "match.dispatch", "match.compile",
+    "table.load", "table.swap",
     "inflight.insert", "inflight.retry", "cluster.rpc",
     "bridge.sink", "exhook.call", "fanout.drain", "shard.handoff",
 )

@@ -906,6 +906,10 @@ class BrokerNode:
         from .broker.match_service import MatchService
 
         cfg = self.config
+        seg_dir = ""
+        if cfg.get("match.segments.enable"):
+            seg_dir = cfg.get("match.segments.dir") or os.path.join(
+                cfg.get("node.data_dir") or "data", "segments")
         try:
             self.match_service = MatchService(
                 self.broker,
@@ -929,6 +933,14 @@ class BrokerNode:
                     "match.breaker.probe_interval"),
                 alarms=self.observed.alarms,
                 olp=self.olp,
+                segments=cfg.get("match.segments.enable"),
+                segments_dir=seg_dir,
+                compact_interval_s=cfg.get(
+                    "match.segments.compact_interval"),
+                compact_min_mutations=cfg.get(
+                    "match.segments.compact_min_mutations"),
+                dirty_threshold=cfg.get("match.segments.dirty_threshold"),
+                prewarm=cfg.get("match.segments.prewarm"),
             )
             self.match_service.supervisor = self.supervisor
             await asyncio.wait_for(

@@ -19,7 +19,7 @@ from typing import Dict, Iterable, List, Optional
 __all__ = [
     "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
     "ROBUSTNESS_METRIC_NAMES", "CONNPLANE_METRIC_NAMES",
-    "MATCH_SERVE_METRIC_NAMES",
+    "MATCH_SERVE_METRIC_NAMES", "TABLE_METRIC_NAMES",
 ]
 
 # -- the reference's fixed counter names, grouped as in emqx_metrics.erl [U]
@@ -150,6 +150,18 @@ MATCH_SERVE_METRIC_NAMES: List[str] = [
     "broker.match.brownout_level",
 ]
 
+# -- streaming table lifecycle (broker/match_service.py, opt-in via
+# match.segments.enable).  segment_load_s is the last cold-start
+# segment load+reconcile time in seconds (set); compact_runs counts
+# background compaction swaps (inc); dirty_rows_uploaded is the
+# accumulated row count shipped by the scatter/grow-in-place paths
+# (set, sampled from DeviceNfa each sync); compile_cache_hits is the
+# kernel-cache hit count (set, sampled each sync).
+TABLE_METRIC_NAMES: List[str] = [
+    "tpu.table.segment_load_s", "tpu.table.compact_runs",
+    "tpu.table.dirty_rows_uploaded", "tpu.table.compile_cache_hits",
+]
+
 
 class Metrics:
     """A counter table with the reference's fixed name set.
@@ -167,6 +179,7 @@ class Metrics:
         self._c.update({n: 0 for n in ROBUSTNESS_METRIC_NAMES})
         self._c.update({n: 0 for n in CONNPLANE_METRIC_NAMES})
         self._c.update({n: 0 for n in MATCH_SERVE_METRIC_NAMES})
+        self._c.update({n: 0 for n in TABLE_METRIC_NAMES})
         if extra:
             self._c.update({n: 0 for n in extra})
 

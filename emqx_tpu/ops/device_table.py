@@ -67,6 +67,11 @@ class PendingSync(NamedTuple):
     full: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]  # re-upload
     shape_key: Tuple[int, int, int]
     epoch: int
+    # dirty-region grow path (``dirty_regions`` mode): a resized delta
+    # whose node prefix is still valid on device ships only the grown
+    # region + dirty rows; when the edge table was rehashed its full
+    # contents ride here (node still grows in place).
+    edge_full: Optional[np.ndarray] = None
 
     @property
     def empty(self) -> bool:
@@ -98,6 +103,21 @@ class DeviceNfa:
         self.epoch = -1
         self.uploads = 0        # full table uploads (growth / first sync)
         self.delta_applies = 0  # in-place scatter batches
+        # dirty-region mode (streaming table lifecycle, opt-in): a table
+        # resize grows the device buffers in place (pad + scatter the
+        # tracked dirty rows) instead of re-shipping everything; above
+        # dirty_full_threshold (dirty rows / total rows) the one
+        # contiguous device_put wins and drain() falls back to it.
+        # Requires a host table with track_regions (the Python
+        # IncrementalNfa); the native table keeps the full-upload path.
+        self.dirty_regions = False
+        self.dirty_full_threshold = 0.5
+        self.grow_applies = 0           # in-place grow resizes applied
+        self.dirty_rows_uploaded = 0    # rows shipped by scatter/grow
+        # optional shape-keyed AOT compile cache (ops/kernel_cache.py):
+        # when set, match() dispatches through pre-compiled executables
+        # so a table resize never stalls a serve batch on an XLA compile
+        self.kernel_cache = None
         self._shape_key = None
         self._arrs: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
         self._lock = threading.Lock()
@@ -139,8 +159,18 @@ class DeviceNfa:
     def drain(self, full: bool = False) -> PendingSync:
         """OWNER-THREAD step: flush host dirty state into a stable,
         thread-safe :class:`PendingSync`.  O(delta) except when a full
-        upload is needed (first sync / growth), which copies the table."""
+        upload is needed (first sync / growth), which copies the table.
+        In ``dirty_regions`` mode a growth resize whose dirty sets
+        survived (track_regions host table) ships as a grow-in-place
+        sync instead — O(dirty) + the rehashed edge table at most."""
         delta = self.inc.flush()
+        if not full and delta.resized and self._grow_ok(delta):
+            key = self.inc.shape_key()
+            rehash = delta.edges_rehashed or key[1] != self._shape_key[1]
+            return PendingSync(
+                delta=delta, full=None, shape_key=key, epoch=delta.epoch,
+                edge_full=self.inc.edge_tab.copy() if rehash else None,
+            )
         if full or delta.resized or self._shape_key != self.inc.shape_key():
             if hasattr(self.inc, "tables"):  # native table: one export
                 tabs = self.inc.tables()
@@ -160,6 +190,23 @@ class DeviceNfa:
             delta=delta, full=None,
             shape_key=self.inc.shape_key(), epoch=delta.epoch,
         )
+
+    def _grow_ok(self, delta: NfaDelta) -> bool:
+        """May this resized delta ride the grow-in-place path?  Needs the
+        mode on, a synced device twin whose node prefix matches the
+        delta's valid-prefix marker, an unchanged depth, and a dirty
+        fraction below the measured full-upload crossover."""
+        if not self.dirty_regions or self._shape_key is None \
+                or self._arrs is None:
+            return False
+        if delta.node_grown_from < 0 \
+                or delta.node_grown_from != self._shape_key[0]:
+            return False
+        key = self.inc.shape_key()
+        if key[2] != self._shape_key[2]:
+            return False
+        n_dirty = len(delta.state_idx) + len(delta.bucket_idx)
+        return n_dirty <= self.dirty_full_threshold * (key[0] + key[1])
 
     def apply_pending(self, p: PendingSync) -> bool:
         """ANY-THREAD step: ship a drained sync to the device.
@@ -195,6 +242,8 @@ class DeviceNfa:
                 self.inc.device_epoch or -1, p.epoch
             )
             return False
+        if p.delta.resized:
+            return self._apply_grow(p)
         node, edge, seeds = self._arrs
         for idx, rows in _chunks(p.delta.state_idx, p.delta.state_rows):
             node = _scatter_rows(node, self._put(idx), self._put(rows))
@@ -204,6 +253,46 @@ class DeviceNfa:
         self.epoch = p.delta.epoch
         self.inc.device_epoch = p.delta.epoch
         self.delta_applies += 1
+        self.dirty_rows_uploaded += (
+            len(p.delta.state_idx) + len(p.delta.bucket_idx))
+        return True
+
+    def _apply_grow(self, p: PendingSync) -> bool:
+        """Grow-in-place resize: pad the node table device-side to the
+        new S (no h2d traffic for the surviving prefix), swap in the
+        rehashed edge table when it moved, then scatter the tracked
+        dirty rows — replacing the whole-table ``device_put`` the old
+        resize path paid (25–107 s at 10M filters, BENCH_r03/r05)."""
+        node, edge, seeds = self._arrs
+        target_s, target_hb, _d = p.shape_key
+        if int(node.shape[0]) != p.delta.node_grown_from:
+            # base mismatch (missed sync): poison via the caller's
+            # except path — the next drain ships full tables
+            raise RuntimeError(
+                f"grow-in-place base mismatch: device S={node.shape[0]} "
+                f"!= host prefix {p.delta.node_grown_from}")
+        grow = target_s - int(node.shape[0])
+        if grow > 0:
+            pad = jnp.broadcast_to(
+                jnp.asarray([-1, -1, -1, 0], jnp.int32), (grow, 4))
+            node = jnp.concatenate([node, pad], axis=0)
+        if p.edge_full is not None:
+            edge = self._put(p.edge_full)
+        elif int(edge.shape[0]) != target_hb:
+            raise RuntimeError(
+                f"grow-in-place edge mismatch: device Hb={edge.shape[0]} "
+                f"!= host {target_hb} with no rehashed table shipped")
+        for idx, rows in _chunks(p.delta.state_idx, p.delta.state_rows):
+            node = _scatter_rows(node, self._put(idx), self._put(rows))
+        for idx, rows in _chunks(p.delta.bucket_idx, p.delta.bucket_rows):
+            edge = _scatter_rows(edge, self._put(idx), self._put(rows))
+        self._shape_key = p.shape_key
+        self._arrs = (node, edge, seeds)
+        self.epoch = p.delta.epoch
+        self.inc.device_epoch = p.delta.epoch
+        self.grow_applies += 1
+        self.dirty_rows_uploaded += (
+            len(p.delta.state_idx) + len(p.delta.bucket_idx))
         return True
 
     def sync(self, full: bool = False) -> bool:
@@ -228,14 +317,29 @@ class DeviceNfa:
     # -- serving -----------------------------------------------------------
 
     def match(self, words, lens, is_sys, *,
-              flat_cap: int = 0) -> MatchResult:
+              flat_cap: int = 0, block_compile: bool = True) -> MatchResult:
         """Run the kernel on already-encoded operands.  Dispatch happens
         under the device lock; the returned arrays are futures — callers
         block (np.asarray) outside any lock.  ``flat_cap`` > 0 selects
         the flat compacted output (minimal-readback serving mode; see
-        match_kernel.decode_flat)."""
+        match_kernel.decode_flat).  With a kernel cache attached and
+        ``block_compile=False``, an uncompiled shape raises
+        :class:`~emqx_tpu.ops.kernel_cache.CompileMiss` instead of
+        stalling the caller behind XLA (serving fail-open contract)."""
         with self._lock:
             node, edge, seeds = self.arrays()
+            kc = self.kernel_cache
+            if kc is not None and self.device is None:
+                fn = kc.executable(
+                    tuple(words.shape), int(node.shape[0]),
+                    int(edge.shape[0]),
+                    active_slots=self.active_slots,
+                    max_matches=self.max_matches,
+                    compact_output=self.compact_output,
+                    flat_cap=flat_cap,
+                    block=block_compile,
+                )
+                return fn(words, lens, is_sys, node, edge, seeds)
             return nfa_match(
                 words, lens, is_sys, node, edge, seeds,
                 active_slots=self.active_slots,

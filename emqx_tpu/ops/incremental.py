@@ -63,6 +63,16 @@ class NfaDelta(NamedTuple):
     state_rows: np.ndarray     # (n, 4) int32 current contents
     bucket_idx: np.ndarray     # (m,) int32 dirty edge_tab rows
     bucket_rows: np.ndarray    # (m, 16) int32 current contents
+    # dirty-region resize tracking (``track_regions`` mode, opt-in): when
+    # a resized delta STILL carries valid dirty rows, the consumer can
+    # grow the device buffers in place (pad + scatter) instead of
+    # re-shipping the whole table.  node_grown_from = the S the node_tab
+    # had before the first growth since the last flush (-1 = unchanged);
+    # edges_rehashed = the edge table was rebuilt with fresh seeds (its
+    # contents must ship fully; the default True means "unknown", which
+    # legacy producers resolve to the full re-upload path).
+    node_grown_from: int = -1
+    edges_rehashed: bool = True
 
     @property
     def empty(self) -> bool:
@@ -131,6 +141,21 @@ class IncrementalNfa:
         self._dirty_states = {0}
         self._dirty_buckets: set = set()
         self._resized = False
+        # dirty-region mode (streaming table lifecycle, opt-in): growth
+        # keeps the dirty sets valid across the resize so the device
+        # twin can pad-and-scatter instead of re-shipping the table.
+        # Off by default — flush() behavior is byte-identical when off.
+        self.track_regions = False
+        self._node_grown_from = -1   # S before the first growth, -1 = none
+        self._edges_rehashed = False
+        self._node_wholesale = False  # compact(): every node row replaced
+        # lazy trie hydration (segment restore): a callable that links
+        # the _INode tree from the persisted flat relation; None on
+        # normally-built tables.  Mutation/walk entry points call
+        # _hydrate() first, so a segment cold start pays only the array
+        # load and the relink happens in the background (or on first
+        # touch, whichever comes first — the callable is idempotent).
+        self._pending_trie = None
 
     # -- shapes ------------------------------------------------------------
 
@@ -156,6 +181,10 @@ class IncrementalNfa:
             self.node_tab = grown
             self._free_sids = list(range(S * 2 - 1, S - 1, -1))
             self._resized = True
+            if self.track_regions and self._node_grown_from < 0:
+                # existing rows were copied verbatim: the dirty set stays
+                # valid, the consumer only needs to pad [S, 2S) rows
+                self._node_grown_from = S
         return self._free_sids.pop()
 
     def _alloc_aid(self, flt: str) -> int:
@@ -255,6 +284,11 @@ class IncrementalNfa:
                     self._seed_ints = (int(seeds[0]), int(seeds[1]))
                     self._resized = True
                     self._dirty_buckets.clear()
+                    if self.track_regions:
+                        # every edge moved: bucket dirt restarts against
+                        # the NEW table (the consumer ships it fully);
+                        # node rows are untouched by an edge rehash
+                        self._edges_rehashed = True
                     return
 
     def _place_all(self, edges, slots, seeds, mask) -> bool:
@@ -288,9 +322,15 @@ class IncrementalNfa:
 
     # -- filter mutation ---------------------------------------------------
 
+    def _hydrate(self) -> None:
+        pending = self._pending_trie
+        if pending is not None:
+            pending()
+
     def add(self, flt: str) -> bool:
         """Insert ``flt``; returns False if it was already present.
         Raises ValueError when the filter is deeper than the table."""
+        self._hydrate()
         ws = T.words(flt)
         if len(ws) > self.depth:
             raise ValueError(
@@ -340,6 +380,7 @@ class IncrementalNfa:
     def remove(self, flt: str) -> bool:
         """Delete ``flt``; returns False if absent.  Prunes now-empty
         trie branches, returning their states/edges to the free lists."""
+        self._hydrate()
         ws = T.words(flt)
         if len(ws) > self.depth:
             return False
@@ -388,9 +429,14 @@ class IncrementalNfa:
 
     def flush(self) -> NfaDelta:
         """Drain dirty rows.  After a resize the row sets are meaningless
-        (the whole table moved) — the consumer must re-upload."""
+        (the whole table moved) — the consumer must re-upload.  In
+        ``track_regions`` mode growth keeps the dirty sets valid (node
+        rows are copied verbatim on state growth; an edge rehash clears
+        only the bucket dirt) and the delta carries the region facts, so
+        the consumer can grow the device buffers in place."""
         resized = self._resized
-        if resized:
+        track = self.track_regions
+        if resized and not track:
             sidx = np.zeros(0, np.int32)
             bidx = np.zeros(0, np.int32)
         else:
@@ -405,10 +451,23 @@ class IncrementalNfa:
             state_rows=self.node_tab[sidx].copy(),
             bucket_idx=bidx,
             bucket_rows=self.edge_tab[bidx].copy(),
+            # node_grown_from doubles as the device-valid node PREFIX:
+            # old-S on growth, full-S when the node table didn't move,
+            # -1 when every row was replaced (compact) — full upload
+            node_grown_from=(
+                -1 if (not track or self._node_wholesale)
+                else (self._node_grown_from
+                      if self._node_grown_from >= 0 else self.S)),
+            edges_rehashed=(
+                (self._edges_rehashed or self._node_wholesale)
+                if track else True),
         )
         self._dirty_states = set()
         self._dirty_buckets = set()
         self._resized = False
+        self._node_grown_from = -1
+        self._edges_rehashed = False
+        self._node_wholesale = False
         return delta
 
     def snapshot(self) -> NfaTable:
@@ -444,6 +503,7 @@ class IncrementalNfa:
         Same semantics as the oracle (``emqx_topic:match`` rules): ``+``
         one level, ``#`` zero-or-more trailing levels, root wildcards
         suppressed for ``$``-topics.  Returns accept ids."""
+        self._hydrate()
         ws = T.words(topic)
         is_sys = topic.startswith("$")
         out: List[int] = []
@@ -472,6 +532,7 @@ class IncrementalNfa:
         """Accept id of a present filter, -1 if absent.  O(depth) walk —
         used by the fail-open path to map host-trie matches into the
         device id space."""
+        self._hydrate()
         ws = T.words(flt)
         if len(ws) > self.depth:
             return -1
@@ -528,9 +589,15 @@ class IncrementalNfa:
         for f in alias_filters:
             fresh.alloc_alias(f)
         old_reuses = self.aid_reuses
+        track = self.track_regions
         self.__dict__.update(fresh.__dict__)
         self.epoch = old_epoch + 1
         self.device_epoch = old_device_epoch
         # every aid was reassigned: force in-flight decoders to discard
         self.aid_reuses = old_reuses + 1
         self._resized = True
+        # region tracking survives the rebuild, but the rebuild itself is
+        # wholesale: no device row survives, so the next drain must ship
+        # full tables even in track_regions mode
+        self.track_regions = track
+        self._node_wholesale = True

@@ -1,0 +1,208 @@
+"""Padded-shape kernel compile cache — resizes never stall on XLA.
+
+The match kernel (:func:`~emqx_tpu.ops.match_kernel.nfa_match`) compiles
+one executable per ``(B, D, S, Hb, A, K, flat_cap, compact)`` bucket;
+table shapes are padded to powers of two exactly so growth RARELY
+changes them — but when growth does cross a pow2 boundary, the next
+dispatch stalls 9–19 s on an XLA compile at 10M filters (BENCH_r03/r05)
+and the serve plane browns out to the host path for the whole window.
+
+This cache closes that window two ways:
+
+* **AOT executables** — keys compile via ``jit(nfa_match).lower(...).
+  compile()`` against :class:`jax.ShapeDtypeStruct` operands (no dummy
+  arrays materialized, no device upload paid just to warm a shape) and
+  the resulting ``Compiled`` is what serving dispatches through, so the
+  compile-or-hit decision is explicit and countable (the compile-counter
+  spy in tests/test_match_segments.py);
+* **next-pow2 prewarm** — the serving layer watches table occupancy and
+  calls :meth:`prewarm_shape` for the next ``shape_key`` *before* growth
+  reaches it, for every (batch, depth, output-mode) combo observed so
+  far; the resize is then served entirely from the cache.
+
+Thread model: ``executable()`` may be called from serve worker threads
+and ``prewarm_shape`` from a background thread.  A per-key in-flight set
+under one lock makes concurrent compiles of the same key collapse into
+one; the dict lookup on the hit path is one lock acquisition.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Dict, Optional, Set, Tuple
+
+log = logging.getLogger(__name__)
+
+__all__ = ["MatchKernelCache", "CompileMiss"]
+
+#: (B, D, S, Hb, active_slots, max_matches, compact, flat_cap)
+Key = Tuple[int, int, int, int, int, int, bool, int]
+
+
+class CompileMiss(RuntimeError):
+    """Raised by a non-blocking executable() miss: the caller serves the
+    batch from the CPU tables NOW (never a breaker strike — the device
+    is healthy) while the key compiles in the background."""
+
+
+class MatchKernelCache:
+    """Shape-keyed AOT compile cache for the match kernel."""
+
+    def __init__(self) -> None:
+        self._compiled: Dict[Key, Any] = {}
+        self._inflight: Set[Key] = set()
+        self._lock = threading.Lock()
+        self._done = threading.Condition(self._lock)
+        # every (B, D, A, K, compact, flat_cap) combo ever requested:
+        # what prewarm_shape replays against the NEXT table shape
+        self._combos: Set[Tuple[int, int, int, int, bool, int]] = set()
+        self.compiles = 0
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def key(batch_shape: Tuple[int, int], s: int, hb: int, *,
+            active_slots: int, max_matches: int,
+            compact_output: bool, flat_cap: int) -> Key:
+        b, d = batch_shape
+        return (b, d, s, hb, active_slots, max_matches,
+                bool(compact_output), flat_cap)
+
+    def executable(self, batch_shape: Tuple[int, int], s: int, hb: int, *,
+                   active_slots: int, max_matches: int,
+                   compact_output: bool, flat_cap: int,
+                   block: bool = True):
+        """The compiled executable for these operand shapes — cached, or
+        compiled NOW (blocking; counted, so a resize that was prewarmed
+        shows zero compiles on the serve path).  With ``block=False`` a
+        miss kicks a background compile and raises :class:`CompileMiss`
+        instead — the serving contract: a prefetch is NEVER parked
+        behind XLA, the CPU trie answers while the shape warms."""
+        k = self.key(batch_shape, s, hb, active_slots=active_slots,
+                     max_matches=max_matches,
+                     compact_output=compact_output, flat_cap=flat_cap)
+        with self._lock:
+            self._combos.add((k[0], k[1], k[4], k[5], k[6], k[7]))
+            fn = self._compiled.get(k)
+            if fn is not None:
+                self.hits += 1
+                return fn
+            self.misses += 1
+            if not block:
+                if k not in self._inflight:
+                    self._inflight.add(k)
+                    # non-daemon: a daemon compile thread racing XLA
+                    # teardown at interpreter exit segfaults; exit
+                    # instead waits out the in-flight compile
+                    threading.Thread(
+                        target=self._compile_bg, args=(k,),
+                        name="match-kernel-compile",
+                    ).start()
+                raise CompileMiss(str(k))
+        return self._compile(k)
+
+    def _compile_bg(self, k: Key) -> None:
+        """Background half of a non-blocking miss: the key was already
+        marked in-flight by the caller under the lock."""
+        try:
+            fn = self._lower(k)
+            with self._lock:
+                self._compiled[k] = fn
+                self.compiles += 1
+        except Exception:  # pragma: no cover - XLA failure surfaces on
+            log.exception("background kernel compile failed for %s", k)
+        finally:
+            with self._lock:
+                self._inflight.discard(k)
+                self._done.notify_all()
+
+    def warmed(self, batch_shape: Tuple[int, int], s: int, hb: int, *,
+               active_slots: int, max_matches: int,
+               compact_output: bool, flat_cap: int) -> bool:
+        k = self.key(batch_shape, s, hb, active_slots=active_slots,
+                     max_matches=max_matches,
+                     compact_output=compact_output, flat_cap=flat_cap)
+        with self._lock:
+            return k in self._compiled
+
+    def shape_covered(self, s: int, hb: int) -> bool:
+        """Every observed batch combo already compiled for (s, hb)?"""
+        with self._lock:
+            combos = list(self._combos)
+            return bool(combos) and all(
+                (b, d, s, hb, a, m, c, f) in self._compiled
+                for (b, d, a, m, c, f) in combos
+            )
+
+    def prewarm_shape(self, s: int, hb: int) -> int:
+        """Compile every observed batch combo against table shape
+        ``(s, hb)`` — the background step that makes the NEXT pow2
+        resize free.  Returns the number of fresh compiles."""
+        with self._lock:
+            combos = list(self._combos)
+        n = 0
+        for (b, d, a, m, c, f) in combos:
+            k = (b, d, s, hb, a, m, c, f)
+            with self._lock:
+                if k in self._compiled:
+                    continue
+            self._compile(k)
+            n += 1
+        return n
+
+    # ------------------------------------------------------------------
+
+    def _compile(self, k: Key):
+        with self._lock:
+            while k in self._inflight:
+                self._done.wait()
+            fn = self._compiled.get(k)
+            if fn is not None:
+                return fn
+            self._inflight.add(k)
+        try:
+            fn = self._lower(k)
+            with self._lock:
+                self._compiled[k] = fn
+                self.compiles += 1
+                return fn
+        finally:
+            with self._lock:
+                self._inflight.discard(k)
+                self._done.notify_all()
+
+    @staticmethod
+    def _lower(k: Key):
+        import jax
+        import jax.numpy as jnp
+
+        from .compiler import BUCKET_SLOTS
+        from .match_kernel import nfa_match
+
+        b, d, s, hb, a, m, compact, flat_cap = k
+        i32 = jnp.int32
+        sd = jax.ShapeDtypeStruct
+        lowered = nfa_match.lower(
+            sd((b, d), i32),                      # words
+            sd((b,), i32),                        # lens
+            sd((b,), jnp.bool_),                  # is_sys
+            sd((s, 4), i32),                      # node_tab
+            sd((hb, BUCKET_SLOTS * 4), i32),      # edge_tab
+            sd((2,), i32),                        # seeds
+            active_slots=a, max_matches=m,
+            compact_output=compact, flat_cap=flat_cap,
+        )
+        return lowered.compile()
+
+    def info(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._compiled),
+                "combos": len(self._combos),
+                "compiles": self.compiles,
+                "hits": self.hits,
+                "misses": self.misses,
+            }

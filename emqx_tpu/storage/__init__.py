@@ -5,10 +5,14 @@ checkpoints, and data import/export."""
 from .backup import export_data, import_data
 from .checkpoint import load_table, save_table
 from .persistence import Persistence
+from .segments import (
+    SegmentError, load_segment, restore_incremental, save_segment,
+)
 from .store import Store, Table
 
 __all__ = [
     "Store", "Table", "Persistence",
     "save_table", "load_table",
+    "save_segment", "load_segment", "restore_incremental", "SegmentError",
     "export_data", "import_data",
 ]

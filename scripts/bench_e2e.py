@@ -333,6 +333,131 @@ def chaos_smoke() -> dict:
             fi.uninstall()
             await node.stop()
 
+    async def segments_cycle():
+        """Table-lifecycle chaos (ISSUE 9): kill the table.compact
+        child mid-swap AND inject a table.swap fault (serving
+        unaffected either way, the next cycle resumes), then corrupt
+        the on-disk segment and cold-start a second node — checksum
+        reject, full rebuild serves, delivery 1.0 throughout."""
+        import tempfile
+
+        from emqx_tpu import faultinject as fi
+        from emqx_tpu.broker.message import make_message
+        from emqx_tpu.config import Config
+        from emqx_tpu.faultinject import FaultInjector
+        from emqx_tpu.node import BrokerNode
+
+        seg_dir = tempfile.mkdtemp(prefix="chaos_seg_")
+
+        def make_cfg():
+            cfg = Config(
+                file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+            cfg.put("tpu.enable", True)
+            cfg.put("tpu.mirror_refresh_interval", 0.01)
+            cfg.put("tpu.bypass_rate", 0.0)
+            cfg.put("tpu.table", "python")
+            cfg.put("match.deadline.enable", True)
+            cfg.put("match.deadline_ms", 100.0)
+            cfg.put("match.segments.enable", True)
+            cfg.put("match.segments.dir", seg_dir)
+            cfg.put("match.segments.compact_interval", 0.1)
+            cfg.put("match.segments.compact_min_mutations", 1)
+            cfg.put("supervisor.backoff_base", 0.005)
+            cfg.put("supervisor.backoff_max", 0.05)
+            return cfg
+
+        node = BrokerNode(make_cfg())
+        await node.start()
+        got = []
+        try:
+            b = node.broker
+            ms = node.match_service
+            if ms is None:
+                return {"skipped": "match service unavailable"}
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            await settle(lambda: ms.ready, timeout=60)
+            # injected swap fault: the cycle aborts atomically (no state
+            # mutated) and the next interval compacts clean
+            fi.install(FaultInjector([
+                {"point": "table.swap", "action": "raise", "times": 1}]))
+            sent = 0
+            for i in range(60):
+                topic = f"t/{i}/x"
+                await ms.prefetch(topic)
+                b.publish(make_message("pub", topic, b"%d" % i))
+                sent += 1
+            swapped = await settle(lambda: ms._table_gen >= 1, timeout=20)
+            fi.uninstall()
+            # kill the compact child mid-cycle: supervised restart
+            child = node.supervisor.lookup("table.compact")
+            killed = child is not None and child.kill()
+            gen0 = ms._table_gen
+            for i in range(60, 120):
+                topic = f"t/{i}/x"
+                # table mutations so the restarted compact child has
+                # something to fold into the next segment
+                b.subscribe("sub", f"chaos/{i}/+", SubOpts())
+                await ms.prefetch(topic)
+                b.publish(make_message("pub", topic, b"%d" % i))
+                sent += 1
+            resumed = await settle(
+                lambda: ms._table_gen > gen0, timeout=20)
+            restarts = node.observed.metrics.get(
+                "broker.supervisor.restarts")
+            compact_runs = node.observed.metrics.get(
+                "tpu.table.compact_runs")
+            seg_exists = os.path.exists(ms._segment_path)
+            delivered = len(got)
+        finally:
+            fi.uninstall()
+            await node.stop()
+        # corrupt the segment: the next cold start must checksum-reject
+        # it and serve from the full rebuild
+        seg_path = os.path.join(seg_dir, "match_table.seg.npz")
+
+        def flip_bytes():
+            with open(seg_path, "r+b") as f:
+                f.seek(256)
+                f.write(b"\xff\xff\xff\xff")
+
+        await aio.to_thread(flip_bytes)
+        node2 = BrokerNode(make_cfg())
+        await node2.start()
+        got2 = []
+        try:
+            b2 = node2.broker
+            ms2 = node2.match_service
+            rejected = ms2 is not None and not ms2._segment_loaded
+            b2.on_deliver = lambda cid, pubs: got2.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b2.open_session("sub2")
+            b2.subscribe("sub2", "t/#", SubOpts())
+            await settle(lambda: ms2 is not None and ms2.ready,
+                         timeout=60)
+            for i in range(40):
+                topic = f"t/r{i}/x"
+                await ms2.prefetch(topic)
+                b2.publish(make_message("pub", topic, b"r%d" % i))
+            rebuilt_ok = await settle(lambda: len(got2) >= 40)
+        finally:
+            await node2.stop()
+        return {
+            "ok": bool(swapped and killed and resumed and seg_exists
+                       and delivered == sent and rejected
+                       and rebuilt_ok and restarts >= 1),
+            "delivered": delivered, "sent": sent,
+            "delivery_ratio": round(delivered / max(1, sent), 4),
+            "restarts": restarts,
+            "compact_runs": compact_runs,
+            "swap_fault_recovered": swapped,
+            "kill_resumed": resumed,
+            "corrupt_segment_rejected": rejected,
+            "rebuild_served": bool(rebuilt_ok),
+        }
+
     async def all_cycles():
         return {
             "fanout": await fanout_cycle(),
@@ -340,6 +465,7 @@ def chaos_smoke() -> dict:
             "bridge": await bridge_cycle(),
             "exhook": await exhook_cycle(),
             "match": await match_cycle(),
+            "segments": await segments_cycle(),
         }
 
     return aio.run(all_cycles())
@@ -357,9 +483,10 @@ def main(argv=None) -> dict:
 
     from bench import (
         _config1_size, _config1_sweep_size, _fanout_e2e_size,
-        _qos1_e2e_size, _qos2_e2e_size, bench_config1,
-        bench_config1_sweep, bench_fanout_e2e, bench_qos1_e2e,
-        bench_qos2_e2e, bench_serve_deadline_smoke,
+        _qos1_e2e_size, _qos2_e2e_size, _table_lifecycle_size,
+        bench_config1, bench_config1_sweep, bench_fanout_e2e,
+        bench_qos1_e2e, bench_qos2_e2e, bench_serve_deadline_smoke,
+        bench_table_lifecycle,
     )
 
     size = _fanout_e2e_size(args.smoke)
@@ -385,6 +512,10 @@ def main(argv=None) -> dict:
     # structure + delivery per PR; the real ratio comes from bench.py
     out["serve_deadline"] = bench_serve_deadline_smoke(
         seconds=(1.2 if args.smoke else 4.0))
+    # streaming table lifecycle A/B (ISSUE 9): segment cold start vs
+    # full rebuild + churn soak across live compaction swaps
+    out["table_lifecycle"] = bench_table_lifecycle(
+        **_table_lifecycle_size(args.smoke))
     if args.chaos:
         out["chaos"] = chaos_smoke()
     print(json.dumps(out, indent=2))

@@ -1,0 +1,49 @@
+"""Fixture: GENERATED shard-affinity seeds must NOT flag these — the
+shard-legal handler only touches RLock-set session state under the
+mutex (the documented pattern), and marshals broker work instead."""
+
+import threading
+
+
+class P:
+    PUBACK = 4
+    SUBSCRIBE = 8
+
+
+_SHARD_LOCAL = frozenset((P.PUBACK,))
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}
+
+
+class Session:
+    def __init__(self):
+        self.inflight = {}
+
+
+class Channel:
+    def __init__(self, broker, session, pool):
+        self.broker = broker
+        self.session = session
+        self.pool = pool
+        self.mutex = threading.RLock()
+
+    def handle_in(self, pkt):
+        handler = {
+            P.PUBACK: self._handle_puback,
+            P.SUBSCRIBE: self._handle_subscribe,
+        }.get(pkt.type)
+        return handler(pkt)
+
+    def _handle_puback(self, pkt):
+        # shard-legal by generation: RLock-set field under the mutex
+        with self.mutex:
+            self.session.inflight[1] = pkt
+        # broker-touching work marshals instead of writing
+        self.pool.marshal(self, pkt)
+
+    def _handle_subscribe(self, pkt):
+        # not shard-local: main-loop-only, broker writes are its job
+        self.broker.routes["x"] = pkt

@@ -1,0 +1,45 @@
+"""Fixture: GENERATED shard-affinity seeds — a handler whose packet
+type is in ``_SHARD_LOCAL`` seeds automatically from the ``handle_in``
+dispatch dict and MUST trip on a broker-state write (1 finding).  The
+``_handle_puback`` twin is NOT in ``_SHARD_LOCAL`` here and is only
+reachable through the ``Channel.handle_in`` dispatch barrier, so the
+same write does not trip — proving the seed came from the generation,
+not from a hand-kept list."""
+
+import threading
+
+
+class P:
+    PUBACK = 4
+    SUBSCRIBE = 8
+
+
+_SHARD_LOCAL = frozenset((P.SUBSCRIBE,))
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}
+
+
+class Channel:
+    def __init__(self, broker):
+        self.broker = broker
+        self.mutex = threading.RLock()
+
+    def handle_in(self, pkt):
+        handler = {
+            P.SUBSCRIBE: self._handle_subscribe,
+            P.PUBACK: self._handle_puback,
+        }.get(pkt.type)
+        return handler(pkt)
+
+    def _handle_subscribe(self, pkt):
+        # (1) shard-legal by _SHARD_LOCAL generation: Broker state is
+        # main-loop-only, so this write is a race even under the mutex
+        self.broker.routes["x"] = pkt
+
+    def _handle_puback(self, pkt):
+        # same write, but PUBACK is NOT shard-local here: main-loop
+        # only, legal
+        self.broker.routes["y"] = pkt

@@ -66,6 +66,19 @@ def test_bench_e2e_smoke_delivers_everything():
     assert sd["static"]["served"] > 0, sd
     assert sd["deadline"]["served"] > 0, sd
     assert sd["deadline"]["batch_hist"], sd
+    # streaming table lifecycle A/B (ISSUE 9): segment cold start >=10x
+    # the full rebuild at bench scale, arrays byte-identical after the
+    # round trip, and the churn soak sustains mutations across >=1 live
+    # segment swap with zero waiters stalled toward the prefetch
+    # timeout (the acceptance gate booleans ride in the JSON)
+    tl = out["table_lifecycle"]
+    cold = tl["cold_start"]
+    assert cold["arrays_identical"], cold
+    assert cold["gate_cold_start_10x"], cold
+    churn = tl["churn"]
+    assert churn["ops"] > 0 and churn["prefetches"] > 0, churn
+    assert churn["segment_swaps"] >= 1, churn
+    assert churn["gate_zero_stalls"], churn
     # chaos smoke: one kill-and-recover cycle per subsystem (including
     # the ISSUE-7 serve plane under "match"), each healing via
     # supervisor restart with delivery intact
@@ -77,3 +90,10 @@ def test_bench_e2e_smoke_delivers_everything():
     match = out["chaos"]["match"]
     assert match["delivery_ratio"] == 1.0, match
     assert match["breaker_tripped"] and match["breaker_recovered"], match
+    # table-lifecycle chaos (ISSUE 9): swap fault + compact kill both
+    # heal with delivery intact; a corrupt segment checksum-rejects and
+    # the full rebuild serves
+    seg = out["chaos"]["segments"]
+    assert seg["delivery_ratio"] == 1.0, seg
+    assert seg["corrupt_segment_rejected"] and seg["rebuild_served"], seg
+    assert seg["swap_fault_recovered"] and seg["kill_resumed"], seg

@@ -865,3 +865,162 @@ def test_chaos_shard_kill_midtraffic_qos1_exactly_once():
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# 9. streaming table lifecycle chaos (ISSUE 9: table.load / table.swap)
+# ---------------------------------------------------------------------------
+
+async def _start_segment_node(seg_dir, **extra):
+    extra.setdefault("tpu.table", "python")
+    extra.setdefault("match.segments.enable", True)
+    extra.setdefault("match.segments.dir", str(seg_dir))
+    extra.setdefault("match.segments.compact_interval", 0.05)
+    extra.setdefault("match.segments.compact_min_mutations", 1)
+    return await _start_match_node(**extra)
+
+
+def test_chaos_corrupt_segment_rejected_full_rebuild_serves(tmp_path):
+    """Corrupt segment -> checksum reject -> cold start falls back to
+    the full rebuild and delivery holds at 1.0."""
+    async def main():
+        from emqx_tpu.broker.message import make_message
+
+        node = await _start_segment_node(tmp_path)
+        try:
+            ms = node.match_service
+            assert ms is not None and ms.segments
+            b = node.broker
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(lambda: ms._table_gen >= 1, timeout=30)
+            seg_path = ms._segment_path
+        finally:
+            await node.stop()
+        # flip bytes mid-file: the sha1 in the meta record must reject
+        with open(seg_path, "r+b") as f:
+            f.seek(300)
+            f.write(b"\xde\xad\xbe\xef")
+        node = await _start_segment_node(
+            tmp_path, **{"match.segments.compact_interval": 30.0,
+                         "match.segments.compact_min_mutations": 10**9})
+        try:
+            ms = node.match_service
+            assert ms is not None
+            assert not ms._segment_loaded   # rejected, rebuilt
+            b = node.broker
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(lambda: ms.ready, timeout=30)
+            n = 40
+            for i in range(n):
+                topic = f"t/{i}/x"
+                await ms.prefetch(topic)
+                b.publish(make_message("pub", topic, b"%d" % i))
+            assert await until(lambda: len(got) >= n)
+            assert len(got) == n   # delivery_ratio 1.0
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_chaos_injected_table_load_fault_falls_back(tmp_path):
+    """A raise at the table.load seam behaves exactly like corruption:
+    segment rejected, full rebuild serves."""
+    async def main():
+        node = await _start_segment_node(tmp_path)
+        try:
+            ms = node.match_service
+            assert ms is not None
+            b = node.broker
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(lambda: ms._table_gen >= 1, timeout=30)
+        finally:
+            await node.stop()
+        faultinject.install(FaultInjector([
+            {"point": "table.load", "action": "raise"}]))
+        try:
+            node = await _start_segment_node(
+                tmp_path, **{"match.segments.compact_interval": 30.0,
+                             "match.segments.compact_min_mutations": 10**9})
+        finally:
+            faultinject.uninstall()
+        try:
+            ms = node.match_service
+            assert ms is not None and not ms._segment_loaded
+            b = node.broker
+            b.open_session("sub")
+            b.subscribe("sub", "x/+/y", SubOpts())
+            assert await until(lambda: ms.ready, timeout=30)
+            await ms.prefetch("x/1/y")
+            assert ms.hint_routes("x/1/y") is not None
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_chaos_compact_killed_midswap_serving_unaffected(tmp_path):
+    """Kill table.compact mid-swap (both the supervised kill and the
+    injected table.swap raise): no state mutates, serving continues,
+    the supervised restart resumes compaction, delivery_ratio 1.0."""
+    async def main():
+        from emqx_tpu.broker.message import make_message
+
+        node = await _start_segment_node(tmp_path)
+        try:
+            ms = node.match_service
+            assert ms is not None
+            b = node.broker
+            m = node.observed.metrics
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(lambda: ms.ready, timeout=30)
+            # phase 1: injected swap fault — the cycle dies BEFORE any
+            # state mutates (atomic no-op) and the next cycle swaps
+            faultinject.install(FaultInjector([
+                {"point": "table.swap", "action": "raise", "times": 1}]))
+            sent = 0
+            for i in range(40):
+                topic = f"t/{i}/x"
+                b.subscribe("sub", f"churn/{i}/+", SubOpts())
+                await ms.prefetch(topic)
+                b.publish(make_message("pub", topic, b"%d" % i))
+                sent += 1
+            assert await until(lambda: ms._table_gen >= 1, timeout=30)
+            inj = faultinject.get()
+            assert inj.fired.get("table.swap") == 1
+            faultinject.uninstall()
+            # phase 2: kill the supervised child mid-cycle
+            child = node.supervisor.lookup("table.compact")
+            assert child is not None and child.kill()
+            gen0 = ms._table_gen
+            for i in range(40, 80):
+                topic = f"t/{i}/x"
+                b.subscribe("sub", f"churn/{i}/+", SubOpts())
+                await ms.prefetch(topic)
+                b.publish(make_message("pub", topic, b"%d" % i))
+                sent += 1
+            assert await until(lambda: ms._table_gen > gen0, timeout=30)
+            assert m.get("broker.supervisor.restarts") >= 1
+            assert await until(lambda: len(got) >= sent)
+            assert len(got) == sent   # delivery_ratio 1.0
+            # hints minted before the swaps still serve with parity
+            await ms.prefetch("t/5/x")
+            want = b.router.match_routes("t/5/x")
+            hint = ms.hint_routes("t/5/x")
+            assert hint is not None
+            assert sorted(map(tuple, hint)) == sorted(map(tuple, want))
+        finally:
+            faultinject.uninstall()
+            await node.stop()
+
+    run(main())

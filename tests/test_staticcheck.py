@@ -106,6 +106,9 @@ def test_waiver_file_has_no_silent_suppressions():
     ("no-unsupervised-task", "trip_tasks.py", "ok_tasks.py", 3),
     ("loop-thread-taint", "trip_threads.py", "ok_threads.py", 6),
     ("shard-affinity", "trip_affinity.py", "ok_affinity.py", 3),
+    # seeds GENERATED from _SHARD_LOCAL x handle_in dispatch facts: a
+    # shard-legal handler can no longer silently miss its seed
+    ("shard-affinity", "trip_affinity_gen.py", "ok_affinity_gen.py", 1),
     ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
     ("no-swallowed-exceptions", "trip_exceptions.py",
      "ok_exceptions.py", 3),
@@ -286,6 +289,38 @@ def test_cross_module_shard_affinity_write(tmp_path):
     f = out[0]
     assert f.path == "xmod/entry.py" and f.context == "shard_worker"
     assert "main-loop-only" in f.message
+
+
+def test_generated_seeds_cover_real_shard_local_handlers():
+    """The real tree's Channel._handle_puback/... seeds come from the
+    _SHARD_LOCAL x handle_in join, not from a hand-kept list: every
+    packet type shards handle locally has its dispatch handler seeded
+    (shard, locked), and the main-only handlers (SUBSCRIBE, ...) do
+    not."""
+    import ast as _ast
+
+    from emqx_tpu.devtools.staticcheck.graph import Project
+    from emqx_tpu.devtools.staticcheck.symbols import extract_module
+
+    summaries = []
+    for rel in ("emqx_tpu/transport/shards.py",
+                "emqx_tpu/broker/channel.py"):
+        with open(os.path.join(REPO, rel)) as f:
+            src = f.read()
+        summaries.append(extract_module(rel, _ast.parse(src), src))
+    shards, channel = summaries
+    assert "PUBACK" in shards.shard_local
+    assert channel.classes["Channel"].dispatch["PUBACK"] == \
+        "_handle_puback"
+    aff = Project(summaries).affinity()
+    for m in ("_handle_puback", "_handle_pubrec", "_handle_pubrel",
+              "_handle_pubcomp"):
+        fqid = f"emqx_tpu.broker.channel:Channel.{m}"
+        assert fqid in aff.generated_seeds, (m, aff.generated_seeds)
+        assert ("shard", True) in aff.contexts(fqid)
+    # a main-only dispatch target must NOT be seeded by generation
+    assert "emqx_tpu.broker.channel:Channel._handle_subscribe" \
+        not in aff.generated_seeds
 
 
 def test_affinity_keys_survive_line_drift(tmp_path):
