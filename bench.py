@@ -1078,14 +1078,21 @@ def bench_table_lifecycle(n_filters=20000, seconds=3.0, churn_sessions=32,
                 hist[">1000ms"] += 1
         # the deadline loop GATHERS up to the budget under light load
         # (PR-7 design: fill latency is spent, not saved), so a healthy
-        # wait hovers at ~budget + dispatch (+ GIL contention on a
-        # 1-core bench box — see the config1 caveat).  A STALL is a
-        # waiter held toward the prefetch timeout — the signature of a
-        # blocking rebuild/upload/compile on the serve path (the
-        # pre-lifecycle failure mode), same bound the serve chaos suite
-        # gates on.  The full wait histogram rides along so budget-scale
-        # tails stay visible.
-        stall_bound_ms = ms.prefetch_timeout_s * 0.9 * 1e3
+        # wait hovers at ~budget + dispatch.  A STALL is a waiter held
+        # past that — the signature of a blocking rebuild/upload/
+        # compile on the serve path (the pre-lifecycle failure mode).
+        # On a multi-core host the build thread gets its own core, so
+        # the gate tightens to the 2x-budget bound (ROADMAP
+        # table-lifecycle leftover (c)); the 1-core bench VM keeps the
+        # looser prefetch-timeout bound because GIL contention from the
+        # compaction thread legitimately produces ~2x-budget tails.
+        # The full wait histogram rides along either way so
+        # budget-scale tails stay visible.
+        multi_core = (os.cpu_count() or 1) > 1
+        budget_bound_ms = 2.0 * deadline_ms
+        timeout_bound_ms = ms.prefetch_timeout_s * 0.9 * 1e3
+        stall_bound_ms = (budget_bound_ms if multi_core
+                          else timeout_bound_ms)
         stalls = sum(1 for w in waits if w * 1e3 > stall_bound_ms)
         return {
             "ops": churn,
@@ -1094,6 +1101,11 @@ def bench_table_lifecycle(n_filters=20000, seconds=3.0, churn_sessions=32,
             "worst_wait_ms": round(max(waits) * 1e3, 1) if waits else 0,
             "stall_hist": hist,
             "stall_bound_ms": round(stall_bound_ms, 1),
+            # which bound gated this run (host-dependent): "2x_budget"
+            # needs a core for the build thread, "prefetch_timeout" is
+            # the 1-core GIL-contention fallback
+            "stall_bound": ("2x_budget" if multi_core
+                            else "prefetch_timeout"),
             "stalls_past_budget": stalls,
             "deadline_miss": deadline_miss,
             "segment_swaps": swaps,

@@ -408,6 +408,10 @@ SCHEMA: Dict[str, Field] = {
     # pre-compile the next pow2 table shapes in the background before
     # growth reaches them (the resize then serves from the cache)
     "match.segments.prewarm": Field(True, _bool),
+    # persistent XLA compilation cache under "<segments dir>/xla_cache"
+    # (effective only with match.segments.enable): even the FIRST
+    # cold-start compile after a process restart is a disk hit
+    "match.segments.xla_cache": Field(True, _bool),
 }
 
 

@@ -6,12 +6,17 @@ cost floor for our asyncio hot path.  It is a **two-pass whole-program
 analysis**: pass 1 (:mod:`.symbols`) walks every file once and builds
 the project symbol table + import graph (module-qualified functions and
 methods, ``from .x import y`` aliases, class MRO for ``self.`` calls,
-call/write/spawn edges); pass 2 (:mod:`.graph` + the per-file walker in
-:mod:`.core`) runs the rules against **resolved callees** instead of
-syntactic names — per-file rules ride one shared walker, graph rules
-(affinity, deep taint) run over the whole-program call graph.  Pass-1
-summaries and per-file findings cache under ``.staticcheck_cache/``
-(:mod:`.cache`) so the tier-1 full-tree scan stays ~1 s warm.
+call/write/read/acquire/spawn edges); pass 2 (:mod:`.graph` + the
+per-file walker in :mod:`.core`) runs the rules against **resolved
+callees** instead of syntactic names — per-file rules ride one shared
+walker, graph rules (affinity, torn-read, lock-order, deep taint) run
+over the whole-program call graph.  The affinity lattice is
+**context-sensitive** (k=1 CFA): functions carry reachability *paths*
+(plane × lock-held × caller) with exact parents, so findings name the
+offending entry chain and allow/absorb facts scope per context.
+Pass-1 summaries and per-file findings cache under
+``.staticcheck_cache/`` (:mod:`.cache`) so the tier-1 full-tree scan
+stays ~1 s warm.
 
 ================  =====================================================
 no-unsupervised-task   ``asyncio.create_task``/``ensure_future`` outside
@@ -27,6 +32,19 @@ shard-affinity         writes to main-loop-owned state (Broker/Router/
                        documented RLock set) reachable from shard-affine
                        code without the channel RLock held — the prose
                        invariants of transport/shards.py, checked
+                       per-path: a helper shared by a locked-from-main
+                       and an unlocked-from-shard caller flags only the
+                       shard path
+torn-read              ≥2 fields of one declared multi-field invariant
+                       (``project.INVARIANT_GROUPS``: Session window,
+                       QoS2 pairing, Inflight map+expiry heap) read
+                       from shard/thread context without the group's
+                       lock held ACROSS the reads — the reader-side
+                       race the write detector can't see
+lock-order             cycles in the lock-acquisition graph (lock A
+                       held while acquiring B, directly or through
+                       resolved calls) — the shard-loop vs main-loop
+                       deadlock shape no runtime test reproduces
 no-blocking-in-async   ``time.sleep``, sync socket/DNS/subprocess/HTTP
                        and sync file IO inside ``async def``
 no-swallowed-exceptions  bare/overbroad ``except`` whose handler drops

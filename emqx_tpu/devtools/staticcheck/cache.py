@@ -29,7 +29,9 @@ from .symbols import ModuleSummary
 
 __all__ = ["AnalysisCache", "environment_digest", "CACHE_VERSION"]
 
-CACHE_VERSION = 2  # v2: ModuleSummary grew shard_local + dispatch facts
+# v3: ModuleSummary grew read/acquire sites (the read-set model + the
+# lock-order graph) and findings carry a context chain
+CACHE_VERSION = 3
 
 
 def environment_digest(rule_names, registries=None,
@@ -57,7 +59,15 @@ def environment_digest(rule_names, registries=None,
 
 def _finding_to_dict(f: Finding) -> dict:
     return {"rule": f.rule, "path": f.path, "line": f.line,
-            "col": f.col, "message": f.message, "context": f.context}
+            "col": f.col, "message": f.message, "context": f.context,
+            "chain": list(f.chain)}
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(
+        rule=d["rule"], path=d["path"], line=d["line"], col=d["col"],
+        message=d["message"], context=d["context"],
+        chain=tuple(d.get("chain", ())))
 
 
 class AnalysisCache:
@@ -155,7 +165,7 @@ class AnalysisCache:
         if not isinstance(cached, dict) \
                 or cached.get("deps") != deps_digest:
             return None
-        return [Finding(**d) for d in cached["items"]]
+        return [_finding_from_dict(d) for d in cached["items"]]
 
     def store_findings(self, relpath: str, deps_digest: str,
                        findings: List[Finding]) -> None:

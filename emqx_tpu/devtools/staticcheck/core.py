@@ -44,6 +44,11 @@ class Finding:
     col: int
     message: str
     context: str       # enclosing qualname ("<module>" at top level)
+    #: context path for graph-rule findings: the entry chain (entry
+    #: point first) that reaches the offending site — printed by the
+    #: report layer, deliberately NOT part of the waiver key so a
+    #: refactor that reroutes the path keeps the waiver matching
+    chain: Tuple[str, ...] = ()
 
     @property
     def key(self) -> str:

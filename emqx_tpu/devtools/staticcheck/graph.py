@@ -13,17 +13,31 @@ queryable structure:
   ``ATTR_TYPES``/``VARNAME_HINTS`` ownership facts), a project class, or
   an **external** dotted name (``asyncio.create_task``) when the root
   leaves the project;
-* **affinity analysis** — the shard-affinity lattice: every function
-  gets the set of execution contexts it is reachable from
-  (``main`` loop / ``shard`` loop / plain worker ``thread``), each
-  paired with whether the channel RLock (``mutex``) is held on that
-  path.  Seeds come from the ownership facts in :mod:`.project` plus
-  auto-detected thread/child spawn sites; propagation runs over
-  resolved call edges to a fixpoint.  ``call_soon_threadsafe`` /
-  ``run_coroutine_threadsafe`` targets are marshal boundaries (no
-  propagation); declared dispatch barriers (``Channel.handle_in``)
-  stop propagation where packet-type dispatch is modeled by explicit
-  seeds instead.
+* **affinity analysis** — the shard-affinity lattice, now
+  **context-sensitive** (1-call-site-sensitive, k=1 CFA): every
+  function carries the set of *paths* it is reachable on — each a
+  ``(plane, lock-held, caller)`` triple where the plane is ``main``
+  loop / ``shard`` loop / plain worker ``thread`` — with the exact
+  parent path recorded, so a helper reached from the main loop under
+  the RLock and from a shard without it keeps the two disciplines
+  separate and a finding names only the offending entry chain.  Seeds
+  come from the ownership facts in :mod:`.project` plus auto-detected
+  thread/child spawn sites; propagation runs over resolved call edges
+  to a fixpoint with a bounded per-function summary cache (out-edges
+  expand once per ``(function, plane, locked)``; callers beyond the
+  bound merge into a ``*`` context so hub functions stay cheap).
+  ``call_soon_threadsafe`` / ``run_coroutine_threadsafe`` targets are
+  marshal boundaries (no propagation); declared dispatch barriers
+  (``Channel.handle_in``) stop propagation — per-plane when the fact
+  says so — where packet-type dispatch is modeled by explicit seeds
+  instead;
+
+* **lock-order graph** — every ``with <lock>:`` recorded by pass 1
+  contributes "held ``A`` while acquiring ``B``" edges, both directly
+  and across resolved call edges (a call made under ``A`` into a
+  function whose transitive acquire set contains ``B``).  Cycles in
+  this graph are the classic shard-loop vs main-loop deadlock shape —
+  :mod:`.rules.lockorder` reports them.
 
 Resolution is deliberately view-dependent in one documented way: under
 a shard context, attributes in ``SHARD_ATTR_TYPES`` (the ``channel`` a
@@ -41,7 +55,7 @@ from . import project as facts
 from .symbols import FuncInfo, ClassInfo, ModuleSummary
 
 __all__ = ["Project", "Resolution", "AffinityAnalysis",
-           "MAIN", "SHARD", "THREAD"]
+           "LockOrderGraph", "MAIN", "SHARD", "THREAD"]
 
 MAIN = "main"
 SHARD = "shard"
@@ -91,6 +105,7 @@ class Project:
         self._reverse_edges: Optional[Dict[str, Set[str]]] = None
         self._deps_digests: Dict[str, str] = {}
         self._affinity: Optional["AffinityAnalysis"] = None
+        self._lock_order: Optional["LockOrderGraph"] = None
 
     # -- function table ------------------------------------------------
 
@@ -374,12 +389,43 @@ class Project:
             return Resolution("external", external=".".join(parts))
         return Resolution("external", external=".".join(parts))
 
-    # -- affinity ------------------------------------------------------
+    # -- site-owner typing (shared by affinity + torn-read) ------------
+
+    def owner_class(self, s: ModuleSummary, fi: FuncInfo,
+                    chain: Tuple[str, ...],
+                    view: str = SHARD) -> Optional[str]:
+        """Basename of the class owning the attribute a write/read site
+        targets, or None when untyped.  ``("self",)`` → the enclosing
+        class; ``("self", "session")`` / ``("sess",)`` → attr/var
+        typing; local aliases followed one hop."""
+        if chain == ("self",):
+            return fi.cls
+        if len(chain) >= 2 and chain[0] == "self" and fi.cls:
+            ci = s.classes.get(fi.cls)
+            if ci is not None:
+                owner = self.attr_class(s, ci, chain[-1], view)
+                if owner is not None:
+                    return owner[1].name
+            return facts.ATTR_TYPES.get(chain[-1])
+        if len(chain) == 1:
+            ali = fi.aliases.get(chain[0])
+            if ali is not None and len(ali) >= 2:
+                return self.owner_class(s, fi, tuple(ali), view)
+            return facts.VARNAME_HINTS.get(chain[0])
+        # ``x.session.attr``: type the penultimate attribute
+        return facts.ATTR_TYPES.get(chain[-1])
+
+    # -- affinity / lock order -----------------------------------------
 
     def affinity(self) -> "AffinityAnalysis":
         if self._affinity is None:
             self._affinity = AffinityAnalysis(self)
         return self._affinity
+
+    def lock_order(self) -> "LockOrderGraph":
+        if self._lock_order is None:
+            self._lock_order = LockOrderGraph(self)
+        return self._lock_order
 
 
 # ---------------------------------------------------------------------------
@@ -390,22 +436,49 @@ def _suffix_match(qualname: str, suffix: str) -> bool:
     return qualname == suffix or qualname.endswith("." + suffix)
 
 
+#: a reachability path context: (plane, lock-held, immediate caller
+#: fqid).  The caller component is "" for a seeded entry and "*" once
+#: the per-function caller bound is exceeded (the bounded summary
+#: cache — hub functions keep a merged context instead of one per
+#: caller).
+Ctx = Tuple[str, bool, str]
+
+
 class AffinityAnalysis:
-    """Fixpoint propagation of (context, mutex-held) pairs over the
-    resolved call graph.  ``state[fqid]`` maps each reached
-    ``(context, locked)`` pair to the (parent fqid, via-line) that first
-    reached it, so findings can print the entry chain."""
+    """Context-sensitive (k=1 CFA) fixpoint propagation of
+    (plane, mutex-held) paths over the resolved call graph.
+    ``state[fqid]`` maps each reached ``(plane, locked, caller)``
+    context to the exact ``(parent fqid, parent ctx, via-line)`` that
+    first reached it, so a finding's entry chain is the real path —
+    not a guess across merged contexts.
+
+    Out-edges of a function expand **once** per ``(plane, locked)``
+    (additional callers only record their path, they re-derive
+    nothing), which keeps the context-sensitive run the same order of
+    work as the old context-insensitive one."""
+
+    #: distinct recorded callers per (function, plane, locked) before
+    #: further callers collapse into the "*" context
+    MAX_CALLERS = 12
 
     def __init__(self, project: Project) -> None:
         self.project = project
-        self.state: Dict[str, Dict[Tuple[str, bool],
-                                   Optional[Tuple[str, int]]]] = {}
+        self.state: Dict[str, Dict[Ctx, Optional[
+            Tuple[str, Ctx, int]]]] = {}
+        self._expanded: Set[Tuple[str, str, bool]] = set()
         self._run()
 
     # -- queries -------------------------------------------------------
 
     def contexts(self, fqid: str) -> Set[Tuple[str, bool]]:
-        return set(self.state.get(fqid, ()))
+        """The classic (plane, locked) lattice view — every per-path
+        context collapsed to its plane/lock pair."""
+        return {(c[0], c[1]) for c in self.state.get(fqid, ())}
+
+    def paths(self, fqid: str) -> List[Ctx]:
+        """All reached path contexts, deterministic order (seeded
+        entries sort first: "" < any caller fqid)."""
+        return sorted(self.state.get(fqid, ()))
 
     def label(self, fqid: str) -> str:
         """Human lattice point: main / shard / thread / either."""
@@ -416,51 +489,60 @@ class AffinityAnalysis:
             return next(iter(ctxs))
         return "either"
 
-    def trace(self, fqid: str, ctx: Tuple[str, bool],
-              limit: int = 8) -> List[str]:
-        """Entry chain (function qualnames, entry first) that reached
-        ``fqid`` in context ``ctx`` — line-number free so finding keys
-        stay stable under unrelated edits."""
+    def trace_ctx(self, fqid: str, ctx: Ctx,
+                  limit: int = 12) -> List[str]:
+        """Exact entry chain (function qualnames, entry first) of one
+        path context — line-number free so finding keys stay stable
+        under unrelated edits."""
         out: List[str] = []
         cur: Optional[str] = fqid
         cur_ctx = ctx
-        seen: Set[str] = set()
-        while cur is not None and cur not in seen and len(out) < limit:
-            seen.add(cur)
+        seen: Set[Tuple[str, Ctx]] = set()
+        while cur is not None and (cur, cur_ctx) not in seen \
+                and len(out) < limit:
+            seen.add((cur, cur_ctx))
             out.append(cur.split(":", 1)[1])
             parent = self.state.get(cur, {}).get(cur_ctx)
             if parent is None:
                 break
-            cur = parent[0]
-            # parents were reached with any-locked state; find one
-            pstates = self.state.get(cur, {})
-            for c in ((cur_ctx[0], False), (cur_ctx[0], True)):
-                if c in pstates:
-                    cur_ctx = c
-                    break
-            else:
-                break
+            cur, cur_ctx = parent[0], parent[1]
         out.reverse()
         return out
 
+    def trace(self, fqid: str, ctx: Tuple[str, bool],
+              limit: int = 12) -> List[str]:
+        """Entry chain for the first path context matching a
+        (plane, locked) pair (seeded paths preferred)."""
+        for c in self.paths(fqid):
+            if (c[0], c[1]) == ctx:
+                return self.trace_ctx(fqid, c, limit)
+        return [fqid.split(":", 1)[1]]
+
     # -- the fixpoint --------------------------------------------------
 
-    def _seed(self, fqid: str, ctx: str, locked: bool,
-              worklist: List[Tuple[str, Tuple[str, bool]]]) -> None:
+    def _seed(self, fqid: str, plane: str, locked: bool,
+              worklist: List[Tuple[str, Ctx]]) -> None:
         st = self.state.setdefault(fqid, {})
-        key = (ctx, locked)
+        key: Ctx = (plane, locked, "")
         if key not in st:
             st[key] = None
             worklist.append((fqid, key))
 
-    def _reach(self, fqid: str, ctx: str, locked: bool,
-               parent: Tuple[str, int],
-               worklist: List[Tuple[str, Tuple[str, bool]]]) -> None:
+    def _reach(self, fqid: str, plane: str, locked: bool,
+               parent_fqid: str, parent_ctx: Ctx, line: int,
+               worklist: List[Tuple[str, Ctx]]) -> None:
         st = self.state.setdefault(fqid, {})
-        key = (ctx, locked)
-        if key not in st:
-            st[key] = parent
-            worklist.append((fqid, key))
+        key: Ctx = (plane, locked, parent_fqid)
+        if key in st:
+            return
+        ncallers = sum(1 for c in st
+                       if c[0] == plane and c[1] == locked)
+        if ncallers >= self.MAX_CALLERS:
+            key = (plane, locked, "*")
+            if key in st:
+                return
+        st[key] = (parent_fqid, parent_ctx, line)
+        worklist.append((fqid, key))
 
     def _generated_seeds(self) -> Set[str]:
         """Seeds GENERATED from the ``_SHARD_LOCAL`` packet-type set
@@ -489,8 +571,11 @@ class AffinityAnalysis:
 
     def _run(self) -> None:
         project = self.project
-        worklist: List[Tuple[str, Tuple[str, bool]]] = []
-        barrier_ids: Set[str] = set()
+        worklist: List[Tuple[str, Ctx]] = []
+        # per-plane barriers: fqid → planes the barrier absorbs
+        barrier_ids: Dict[str, Tuple[str, ...]] = {}
+        barrier_facts = [facts.barrier_fact(b)
+                         for b in facts.AFFINITY_BARRIERS]
         self.generated_seeds = self._generated_seeds()
         for fqid in self.generated_seeds:
             if project.func(fqid) is not None:
@@ -500,9 +585,9 @@ class AffinityAnalysis:
             for suffix, (ctx, locked) in facts.AFFINITY_SEEDS.items():
                 if _suffix_match(fi.qualname, suffix):
                     self._seed(fqid, ctx, locked, worklist)
-            for suffix in facts.AFFINITY_BARRIERS:
+            for suffix, planes in barrier_facts:
                 if _suffix_match(fi.qualname, suffix):
-                    barrier_ids.add(fqid)
+                    barrier_ids[fqid] = planes
             # auto seeds: spawn targets
             for sp in fi.spawns:
                 r = project.resolve(s, fi, sp.target)
@@ -518,22 +603,222 @@ class AffinityAnalysis:
                 # on whatever loop owns the consumer; facts seed those
         self._barriers = barrier_ids
         while worklist:
-            fqid, (ctx, locked) = worklist.pop()
+            fqid, ctx = worklist.pop()
+            plane, locked, _caller = ctx
+            # bounded summary cache: out-edges of a function expand
+            # once per (plane, locked); later callers only record paths
+            if (fqid, plane, locked) in self._expanded:
+                continue
+            self._expanded.add((fqid, plane, locked))
             entry = project.func(fqid)
             if entry is None:
                 continue
             s, fi = entry
-            view = ctx if ctx in (SHARD, THREAD) else MAIN
+            view = plane if plane in (SHARD, THREAD) else MAIN
             for call in fi.calls:
                 r = project.resolve(s, fi, call.chain, view=view)
                 if r is None or r.kind != "func":
                     continue
                 tid = r.fqid
-                if tid == fqid or tid in barrier_ids:
+                if tid == fqid:
                     continue
-                if ctx == THREAD and r.func.boots_loop:
+                bplanes = barrier_ids.get(tid)
+                if bplanes is not None and plane in bplanes:
+                    continue
+                if plane == THREAD and r.func.boots_loop:
                     continue  # bootstraps its own loop: absorbed
                 site_locked = locked or any(
                     lk in facts.AFFINITY_LOCKS for lk in call.locks)
-                self._reach(tid, ctx, site_locked, (fqid, call.line),
-                            worklist)
+                self._reach(tid, plane, site_locked, fqid, ctx,
+                            call.line, worklist)
+
+
+# ---------------------------------------------------------------------------
+# the lock-order (deadlock-cycle) graph
+# ---------------------------------------------------------------------------
+
+class LockOrderGraph:
+    """Lock-acquisition ordering, assembled from the held-lock stacks
+    pass 1 already records.  "Lock ``A`` held while acquiring ``B``"
+    contributes an ``A → B`` edge — directly (nested ``with``) and
+    across resolved call edges (a call made under ``A`` into a function
+    whose *transitive* acquire set contains ``B``).  A cycle means two
+    code paths take the same locks in opposite orders: the classic
+    shard-loop vs main-loop deadlock no runtime test reliably
+    reproduces.
+
+    Lock identity is by declared name (``mutex``, ``a_lock``, …) — the
+    same convention the held-lock tracking uses everywhere else.
+    Same-name nesting is never an edge (the re-entrant ``RLock``
+    pattern)."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        #: (held, acquired) → list of (relpath, line, qualname, note)
+        self.edges: Dict[Tuple[str, str],
+                         List[Tuple[str, int, str, str]]] = {}
+        self._build()
+
+    # -- queries -------------------------------------------------------
+
+    def cycles(self) -> List[List[str]]:
+        """One representative cycle per strongly-connected component
+        of ≥2 locks, deterministic: nodes sorted, entry = smallest."""
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        sccs = _tarjan(adj)
+        out: List[List[str]] = []
+        for comp in sccs:
+            if len(comp) < 2:
+                continue
+            nodes = sorted(comp)
+            cyc = self._walk_cycle(nodes[0], set(comp), adj)
+            if cyc:
+                out.append(cyc)
+        out.sort()
+        return out
+
+    def _walk_cycle(self, start: str, comp: Set[str],
+                    adj: Dict[str, Set[str]]) -> Optional[List[str]]:
+        """DFS inside one SCC for a concrete start → … → start walk."""
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ()), reverse=True):
+                if nxt == start and len(path) > 1:
+                    return path + [start]
+                if nxt in comp and nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def witnesses(self, cycle: List[str]) -> List[str]:
+        """Human-readable edge witnesses for a cycle walk."""
+        out = []
+        for a, b in zip(cycle, cycle[1:]):
+            sites = self.edges.get((a, b), ())
+            if sites:
+                relpath, line, qual, note = sites[0]
+                out.append(f"{a}->{b} @ {relpath}:{line} in {qual}"
+                           f" ({note})")
+        return out
+
+    # -- assembly ------------------------------------------------------
+
+    def _edge(self, held: str, acquired: str, relpath: str, line: int,
+              qualname: str, note: str) -> None:
+        if held == acquired:
+            return  # re-entrant same-lock nesting, never an edge
+        self.edges.setdefault((held, acquired), []).append(
+            (relpath, line, qualname, note))
+
+    def _build(self) -> None:
+        project = self.project
+        aff = project.affinity()
+        # resolved call adjacency (+ per-site held locks), both views
+        # where a shard context makes the shard typing reachable
+        direct: Dict[str, Set[str]] = {}
+        calls: Dict[str, List[Tuple[str, str, int,
+                                    Tuple[str, ...]]]] = {}
+        callers: Dict[str, Set[str]] = {}
+        for fqid, s, fi in project.functions():
+            direct[fqid] = {a.name for a in fi.acquires}
+            lst = calls.setdefault(fqid, [])
+            views = [MAIN]
+            if any(p in (SHARD, THREAD)
+                   for p, _ in aff.contexts(fqid)):
+                views.append(SHARD)
+            seen: Set[Tuple[str, int]] = set()
+            for call in fi.calls:
+                for view in views:
+                    r = project.resolve(s, fi, call.chain, view=view)
+                    if r is None or r.kind != "func" \
+                            or r.fqid == fqid:
+                        continue
+                    key = (r.fqid, call.line)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    lst.append((r.fqid, r.func.qualname, call.line,
+                                call.locks))
+                    callers.setdefault(r.fqid, set()).add(fqid)
+        # transitive acquire sets to fixpoint
+        trans: Dict[str, Set[str]] = {f: set(v)
+                                      for f, v in direct.items()}
+        work = [f for f, v in trans.items() if v]
+        while work:
+            f = work.pop()
+            got = trans.get(f, ())
+            for caller in callers.get(f, ()):
+                tc = trans.setdefault(caller, set())
+                before = len(tc)
+                tc.update(got)
+                if len(tc) != before:
+                    work.append(caller)
+        # edges: direct nesting + call-through
+        for fqid, s, fi in project.functions():
+            for a in fi.acquires:
+                for h in a.locks:
+                    self._edge(h, a.name, s.relpath, a.line,
+                               fi.qualname,
+                               f"with {a.name} while holding {h}")
+            for tid, tqual, line, locks in calls.get(fqid, ()):
+                if not locks:
+                    continue
+                for b in trans.get(tid, ()):
+                    if b in locks:
+                        continue  # caller already holds it: re-entrant
+                    for h in locks:
+                        self._edge(h, b, s.relpath, line, fi.qualname,
+                                   f"call into {tqual} which acquires "
+                                   f"{b}")
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC over a name graph."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            succs = sorted(adj.get(node, ()))
+            for i in range(pi, len(succs)):
+                nxt = succs[i]
+                if nxt not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((nxt, 0))
+                    advanced = True
+                    break
+                if nxt in on_stack:
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return sccs

@@ -15,13 +15,15 @@ Adding to an allowlist is a design statement; adding a waiver is debt.
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, Tuple
+from typing import Dict, FrozenSet, Optional, Tuple
 
 __all__ = [
     "ALLOWED_TASK_SITES", "DELIVERY_PATH_PREFIXES", "SUPERVISE_MODULE",
     "AFFINITY_SEEDS", "AFFINITY_BARRIERS", "AFFINITY_LOCKS",
     "MAIN_ONLY_CLASSES", "LOCKED_FIELDS", "ATTR_TYPES",
     "SHARD_ATTR_TYPES", "VARNAME_HINTS", "AFFINITY_ALLOWED_SITES",
+    "INVARIANT_GROUPS", "TORN_READ_ALLOWED_SITES",
+    "LOCK_ORDER_ALLOWED", "barrier_fact", "site_exemption",
 ]
 
 #: Module allowed to create raw tasks: the supervision tree itself.
@@ -151,10 +153,30 @@ AFFINITY_SEEDS: Dict[str, Tuple[str, bool]] = {
 #: (``Channel.handle_in`` dispatches CONNECT/SUBSCRIBE/... which only
 #: ever run marshaled on the main loop — seeding the ack handlers and
 #: barring the dispatcher encodes exactly that contract.)
-AFFINITY_BARRIERS: Tuple[str, ...] = (
+#:
+#: An entry is either a qualname suffix (absorbs EVERY plane — the
+#: over-broad form) or ``(suffix, planes)`` absorbing only the named
+#: planes: a per-context absorb fact.  ``barrier_fact`` normalizes.
+AFFINITY_BARRIERS: Tuple[object, ...] = (
     "Channel.handle_in",
-    "Channel.handle_close",
+    # converted from the over-broad all-plane form: the close path's
+    # packet-type fan-out is only dispatch-opaque on the SHARD plane
+    # (ShardChannel.handle_close marshals the broker-touching half);
+    # main/thread paths through Channel.handle_close propagate and
+    # stay checked instead of being absorbed with it
+    ("Channel.handle_close", ("shard",)),
 )
+
+_ALL_PLANES: Tuple[str, ...] = ("main", "shard", "thread")
+
+
+def barrier_fact(entry: object) -> Tuple[str, Tuple[str, ...]]:
+    """Normalize an ``AFFINITY_BARRIERS`` entry to
+    ``(suffix, planes-it-absorbs)``."""
+    if isinstance(entry, str):
+        return entry, _ALL_PLANES
+    suffix, planes = entry
+    return suffix, tuple(planes)
 
 #: Lock names that satisfy the "channel RLock held" requirement at a
 #: call/write site (``Session.mutex`` is the same object as the
@@ -220,9 +242,78 @@ VARNAME_HINTS: Dict[str, str] = {
     "router": "Router",
 }
 
-#: (repo-relative path, enclosing qualname) → reason.  Structural
+#: (repo-relative path, enclosing qualname) → exemption.  Structural
 #: exemptions for the shard-affinity rule: sites the analysis flags but
 #: that are correct by design (same lifetime rules as
 #: ALLOWED_TASK_SITES — a reasoned allowlist, not a waiver).
-AFFINITY_ALLOWED_SITES: Dict[Tuple[str, str], str] = {
+#:
+#: With the context-sensitive lattice these are **per-context facts**:
+#: the value is either a bare reason string (exempts EVERY path — the
+#: old, over-broad form, kept for sites that really are safe from
+#: everywhere) or ``(reason, plane, entry-suffix)`` exempting only
+#: paths on ``plane`` whose entry point matches ``entry-suffix``
+#: (either may be None to wildcard it).  A site safe when reached
+#: locked-from-main no longer absorbs the unlocked-from-shard path.
+AFFINITY_ALLOWED_SITES: Dict[Tuple[str, str], object] = {
+}
+
+
+def site_exemption(table: Dict[Tuple[str, str], object], relpath: str,
+                   qualname: str, plane: str,
+                   entry: str) -> Optional[str]:
+    """Reason when ``(relpath, qualname)`` is exempt for a path on
+    ``plane`` entered at ``entry``, else None.  Shared by the
+    shard-affinity and torn-read rules."""
+    val = table.get((relpath, qualname))
+    if val is None:
+        return None
+    if isinstance(val, str):
+        return val
+    reason, p, ent = val
+    if p is not None and p != plane:
+        return None
+    if ent is not None and entry != ent \
+            and not entry.endswith("." + ent):
+        return None
+    return reason
+
+
+# ---------------------------------------------------------------------------
+# read-set model: declarative multi-field invariants (torn-read rule)
+# ---------------------------------------------------------------------------
+
+#: group name → (owner class basename, the fields whose combination is
+#: an invariant, the lock that must be held ACROSS any multi-field
+#: read, why).  A function that reads ≥2 of a group's fields from
+#: shard/thread context without the lock held over one contiguous
+#: critical section observes a torn invariant — the reader-side race
+#: the write-only detector can't see.
+INVARIANT_GROUPS: Dict[str, Tuple[str, FrozenSet[str], str, str]] = {
+    "session-window": (
+        "Session", frozenset({"inflight", "mqueue"}), "mutex",
+        "window admission/refill reads the inflight map and the mqueue "
+        "together; a torn view double-admits past the window or "
+        "strands queued messages until the next ack"),
+    "session-qos2": (
+        "Session", frozenset({"inflight", "awaiting_rel"}), "mutex",
+        "the exactly-once handshake pairs sender inflight state with "
+        "receiver awaiting_rel state; a torn view re-delivers or "
+        "drops a release"),
+    "inflight-expiry": (
+        "Inflight", frozenset({"_d", "_exp"}), "mutex",
+        "the lazy expiry heap mirrors the pid map; a torn view "
+        "resurrects acked pids into the retry scan or skips a due "
+        "retry"),
+}
+
+#: (repo-relative path, enclosing qualname) → exemption for the
+#: torn-read rule; same value forms and per-context semantics as
+#: AFFINITY_ALLOWED_SITES.
+TORN_READ_ALLOWED_SITES: Dict[Tuple[str, str], object] = {
+}
+
+#: Reasoned exemptions for the lock-order rule, keyed by the sorted
+#: tuple of the cycle's lock names — e.g. a pair of locks proven to
+#: belong to disjoint object graphs despite sharing a name shape.
+LOCK_ORDER_ALLOWED: Dict[Tuple[str, ...], str] = {
 }

@@ -23,6 +23,10 @@ def format_text(
     for f in new:
         lines.append(f"{f.location()}: [{f.rule}] {f.message}"
                      f"  (in {f.context})")
+        if f.chain:
+            # the context path that reaches the site (entry point
+            # first) — actionable without re-running trace() by hand
+            lines.append(f"    path: {' -> '.join(f.chain)}")
     if expired:
         lines.append("")
         lines.append("expired waivers (no longer suppressing — fix or "
@@ -58,7 +62,7 @@ def format_json(
         return {
             "rule": f.rule, "path": f.path, "line": f.line,
             "col": f.col, "message": f.message, "context": f.context,
-            "key": f.key,
+            "key": f.key, "chain": list(f.chain),
         }
     return json.dumps({
         "findings": [fd(f) for f in new],

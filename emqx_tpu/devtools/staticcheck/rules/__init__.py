@@ -12,14 +12,18 @@ from .blocking import NoBlockingInAsync
 from .coroutines import UnawaitedCoroutine
 from .drift import RegistryDrift
 from .exceptions import NoSwallowedExceptions
+from .lockorder import LockOrder
 from .locks import AwaitUnderLock
 from .tasks import NoUnsupervisedTask
 from .threads import LoopThreadTaint
+from .tornread import TornRead
 
 ALL_RULES = [
     NoUnsupervisedTask,
     LoopThreadTaint,
     ShardAffinity,
+    TornRead,
+    LockOrder,
     NoBlockingInAsync,
     NoSwallowedExceptions,
     AwaitUnderLock,

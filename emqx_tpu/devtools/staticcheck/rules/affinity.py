@@ -6,14 +6,17 @@ state is only touched under the channel RLock (``Session.mutex`` is
 the same object), and shard-affine helpers never touch the main loop.
 This rule turns the prose into a checked property.
 
-The affinity lattice (:mod:`..graph`): every function carries the set
-of execution contexts it is reachable from — ``main`` (broker loop),
-``shard`` (a shard worker's own loop), ``thread`` (plain worker
-thread) — each paired with whether the channel RLock is held on that
-path.  Seeds come from the declarative ownership facts
-(``project.AFFINITY_SEEDS``: ShardChannel handlers, shard inbox
-consumers, supervised children, ``asyncio.to_thread`` targets) and
-propagate over resolved call edges to a fixpoint.
+The affinity lattice (:mod:`..graph`) is **context-sensitive**
+(1-call-site-sensitive, k=1 CFA): every function carries the set of
+*paths* it is reachable on — ``(plane, lock-held, caller)`` triples
+with exact parents — so a helper reached from the main loop under the
+RLock and from a shard without it keeps the two disciplines separate:
+the finding fires only for the offending path and its report names
+that path's entry chain (``Finding.chain``).  Seeds come from the
+declarative ownership facts (``project.AFFINITY_SEEDS``: ShardChannel
+handlers, shard inbox consumers, supervised children,
+``asyncio.to_thread`` targets) and propagate over resolved call edges
+to a fixpoint.
 
 Flagged, using the ownership tables in
 ``devtools/staticcheck/project.py``:
@@ -26,14 +29,17 @@ Flagged, using the ownership tables in
   path; fields **outside** the set are main-loop-only even under the
   lock (the lock protects the QoS window, not the registry fields).
 
-Structural exemptions live in ``project.AFFINITY_ALLOWED_SITES`` with
-a reason each; temporary suppressions go through the expiring waiver
-file like every other rule.
+Structural exemptions live in ``project.AFFINITY_ALLOWED_SITES`` —
+now **per-context facts**: an entry may exempt every path (a bare
+reason) or only paths on one plane / through one entry point, so
+allowing a benign main-loop path no longer absorbs the shard path.
+Temporary suppressions go through the expiring waiver file like every
+other rule.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Sequence, Tuple
 
 from .. import project as facts
 from ..core import Finding, Rule
@@ -56,28 +62,19 @@ class ShardAffinity(Rule):
 
     # ------------------------------------------------------------------
 
-    def _owner_class(self, project: Project, s, fi,
-                     chain: Tuple[str, ...]) -> Optional[str]:
-        """Basename of the class owning the written attribute, or None
-        when untyped.  ``("self",)`` → the enclosing class;
-        ``("self", "session")`` / ``("sess",)`` → attr/var typing."""
-        if chain == ("self",):
-            return fi.cls
-        if len(chain) >= 2 and chain[0] == "self" and fi.cls:
-            ci = s.classes.get(fi.cls)
-            if ci is not None:
-                owner = project.attr_class(s, ci, chain[-1], view=SHARD)
-                if owner is not None:
-                    return owner[1].name
-            return facts.ATTR_TYPES.get(chain[-1])
-        if len(chain) == 1:
-            # local variable: alias typing, then declarative hints
-            ali = fi.aliases.get(chain[0])
-            if ali is not None and len(ali) >= 2:
-                return self._owner_class(project, s, fi, tuple(ali))
-            return facts.VARNAME_HINTS.get(chain[0])
-        # ``x.session.attr = ...``: type the penultimate attribute
-        return facts.ATTR_TYPES.get(chain[-1])
+    def _surviving(self, aff, fqid: str, s, fi,
+                   ctxs: Sequence[Tuple[str, bool, str]]):
+        """(ctx, entry-chain) pairs not covered by a per-context
+        allow fact, for the offending contexts of one site."""
+        out = []
+        for ctx in ctxs:
+            chain = aff.trace_ctx(fqid, ctx)
+            entry = chain[0] if chain else fi.qualname
+            if facts.site_exemption(
+                    facts.AFFINITY_ALLOWED_SITES, s.relpath,
+                    fi.qualname, ctx[0], entry) is None:
+                out.append((ctx, chain))
+        return out
 
     def finalize(self) -> List[Finding]:
         project = self._project
@@ -86,25 +83,22 @@ class ShardAffinity(Rule):
         aff = project.affinity()
         out: List[Finding] = []
         for fqid, s, fi in project.functions():
-            ctxs = aff.contexts(fqid)
-            shardish = [(c, lk) for c, lk in ctxs
-                        if c in (SHARD, THREAD)]
+            paths = aff.paths(fqid)
+            shardish = [c for c in paths if c[0] in (SHARD, THREAD)]
             if not shardish:
-                continue
-            allowed = facts.AFFINITY_ALLOWED_SITES.get(
-                (s.relpath, fi.qualname))
-            if allowed is not None:
                 continue
             unlocked = [c for c in shardish if not c[1]]
             label = aff.label(fqid)
             for w in fi.writes:
-                owner = self._owner_class(project, s, fi, w.chain)
+                owner = project.owner_class(s, fi, w.chain, view=SHARD)
                 if owner is None:
                     continue
                 target = ".".join(w.chain + (w.attr,))
                 if owner in facts.MAIN_ONLY_CLASSES:
-                    entry = aff.trace(fqid, shardish[0])
-                    via = " -> ".join(entry)
+                    hits = self._surviving(aff, fqid, s, fi, shardish)
+                    if not hits:
+                        continue
+                    ctx, chain = hits[0]
                     out.append(Finding(
                         rule=self.name, path=s.relpath, line=w.line,
                         col=w.col,
@@ -112,10 +106,10 @@ class ShardAffinity(Rule):
                             f"write to {target} ({owner} state is "
                             f"main-loop-only) in {fi.qualname!r}, "
                             f"reachable from shard-affine code "
-                            f"(affinity: {label}; entry: {via}); "
-                            "marshal the mutation to the main loop "
-                            "through the shard handoff instead"),
-                        context=fi.qualname,
+                            f"(affinity: {label}); marshal the "
+                            "mutation to the main loop through the "
+                            "shard handoff instead"),
+                        context=fi.qualname, chain=tuple(chain),
                     ))
                     continue
                 locked_set = facts.LOCKED_FIELDS.get(owner)
@@ -128,8 +122,10 @@ class ShardAffinity(Rule):
                     # arrive without it
                     if site_locked or not unlocked:
                         continue
-                    entry = aff.trace(fqid, unlocked[0])
-                    via = " -> ".join(entry)
+                    hits = self._surviving(aff, fqid, s, fi, unlocked)
+                    if not hits:
+                        continue
+                    ctx, chain = hits[0]
                     out.append(Finding(
                         rule=self.name, path=s.relpath, line=w.line,
                         col=w.col,
@@ -137,14 +133,15 @@ class ShardAffinity(Rule):
                             f"write to {target} ({owner} field in the "
                             "documented RLock set) reachable from "
                             f"shard-affine code WITHOUT the channel "
-                            f"RLock/Session.mutex held (entry: {via}); "
-                            "take the channel mutex around this "
-                            "mutation"),
-                        context=fi.qualname,
+                            f"RLock/Session.mutex held; take the "
+                            "channel mutex around this mutation"),
+                        context=fi.qualname, chain=tuple(chain),
                     ))
                 else:
-                    entry = aff.trace(fqid, shardish[0])
-                    via = " -> ".join(entry)
+                    hits = self._surviving(aff, fqid, s, fi, shardish)
+                    if not hits:
+                        continue
+                    ctx, chain = hits[0]
                     out.append(Finding(
                         rule=self.name, path=s.relpath, line=w.line,
                         col=w.col,
@@ -152,9 +149,9 @@ class ShardAffinity(Rule):
                             f"write to {target} ({owner} field OUTSIDE "
                             "the documented RLock set — main-loop-only "
                             f"even under the lock) in {fi.qualname!r}, "
-                            f"reachable from shard-affine code (entry: "
-                            f"{via}); marshal to the main loop or add "
-                            "the field to LOCKED_FIELDS with a reason"),
-                        context=fi.qualname,
+                            "reachable from shard-affine code; marshal "
+                            "to the main loop or add the field to "
+                            "LOCKED_FIELDS with a reason"),
+                        context=fi.qualname, chain=tuple(chain),
                     ))
         return out

@@ -1,0 +1,74 @@
+"""lock-order: cycles in the lock-acquisition graph.
+
+Two code paths that take the same pair of locks in opposite orders
+deadlock the moment they interleave — the classic shard-loop vs
+main-loop hang that no runtime test reliably reproduces (both suites
+pass alone; production wedges under load).  Pass 1 already records
+every ``with <lock>:`` with the locks held at that point;
+:class:`..graph.LockOrderGraph` turns those into "held ``A`` while
+acquiring ``B``" edges — directly for nested ``with`` blocks and
+across **resolved call edges** for a call made under ``A`` into a
+function whose transitive acquire set contains ``B`` — and this rule
+reports every cycle.
+
+Lock identity is the declared name (``mutex``, ``a_lock``), matching
+the held-lock convention of the affinity/torn-read rules; same-name
+nesting is never an edge (the re-entrant ``RLock`` pattern).  One
+finding per strongly-connected component, anchored at the first
+witness edge, with every witness in the message and the cycle walk in
+``Finding.chain``.  Reasoned exemptions:
+``project.LOCK_ORDER_ALLOWED`` keyed by the sorted lock-name tuple.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import project as facts
+from ..core import Finding, Rule
+from ..graph import Project
+
+__all__ = ["LockOrder"]
+
+
+class LockOrder(Rule):
+    name = "lock-order"
+    description = ("lock-acquisition cycle: the same locks taken in "
+                   "opposite orders on different paths")
+    node_types = ()  # graph rule: everything happens in finalize
+
+    def begin_run(self) -> None:
+        self._project: Project = None  # type: ignore[assignment]
+
+    def begin_project(self, project: Project) -> None:
+        self._project = project
+
+    def finalize(self) -> List[Finding]:
+        project = self._project
+        if project is None:
+            return []
+        graph = project.lock_order()
+        out: List[Finding] = []
+        for cycle in graph.cycles():
+            key = tuple(sorted(set(cycle)))
+            if key in facts.LOCK_ORDER_ALLOWED:
+                continue
+            witnesses = graph.witnesses(cycle)
+            if not witnesses:
+                continue
+            first = graph.edges[(cycle[0], cycle[1])][0]
+            relpath, line, qualname, _note = first
+            walk = " -> ".join(cycle)
+            out.append(Finding(
+                rule=self.name, path=relpath, line=line, col=0,
+                message=(
+                    f"lock-order cycle {walk}: these locks are taken "
+                    "in opposite orders on different paths and "
+                    "deadlock when the paths interleave; pick one "
+                    "global order (or record the cycle in "
+                    "LOCK_ORDER_ALLOWED with the reason the locks "
+                    "can never contend)"),
+                context=qualname, chain=tuple(witnesses),
+            ))
+        out.sort(key=lambda f: (f.path, f.line, f.message))
+        return out

@@ -67,12 +67,11 @@ class LoopThreadTaint(Rule):
         aff = project.affinity()
         out: List[Finding] = []
         for fqid, s, fi in project.functions():
-            ctxs = aff.contexts(fqid)
-            if not any(c == THREAD for c, _ in ctxs):
+            thread_paths = [c for c in aff.paths(fqid)
+                            if c[0] == THREAD]
+            if not thread_paths:
                 continue
-            locked = (THREAD, True) in ctxs and (THREAD, False) not in ctxs
-            entry = aff.trace(fqid, (THREAD, locked))
-            chain = " -> ".join(entry) if len(entry) > 1 else None
+            entry = aff.trace_ctx(fqid, thread_paths[0])
             for call in fi.calls:
                 terminal = call.chain[-1]
                 affine = terminal in AFFINE_TERMINALS
@@ -83,18 +82,17 @@ class LoopThreadTaint(Rule):
                     if not affine:
                         continue
                     terminal = r.external
-                via = (f" (thread entry chain: {chain})" if chain
-                       else "")
                 out.append(Finding(
                     rule=self.name, path=s.relpath, line=call.line,
                     col=call.col,
                     message=(
                         f"{'.'.join(call.chain)}() inside "
                         f"{fi.qualname!r}, which is reachable from a "
-                        f"worker thread{via}; event-loop-affine calls "
+                        f"worker thread; event-loop-affine calls "
                         "from a foreign thread must marshal through "
                         "call_soon_threadsafe / "
                         "run_coroutine_threadsafe"),
                     context=fi.qualname,
+                    chain=tuple(entry) if len(entry) > 1 else (),
                 ))
         return out

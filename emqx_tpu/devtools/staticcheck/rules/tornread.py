@@ -1,0 +1,105 @@
+"""torn-read: multi-field invariant reads from shard context without
+the group's lock held across the reads.
+
+The shard-affinity race detector flags *writes* to owned state; a
+reader can still observe a torn multi-field invariant — e.g. the
+``Session`` inflight map consistent with one moment and the mqueue
+with another, or the ``Inflight`` pid map disagreeing with its expiry
+heap — with no write of its own.  This rule closes that hole with a
+**read-set model** on the same pass-1 summaries: :mod:`..symbols`
+records every attribute load with its held-lock context *and* the
+identity of the enclosing lock block, and
+``project.INVARIANT_GROUPS`` declares which field combinations form
+one invariant and which lock protects them.
+
+Flagged: a function reachable from shard/thread context on a path
+that does NOT already hold the group's lock (the context-sensitive
+lattice supplies the per-path lock state) which reads ≥2 fields of
+one group, unless every one of those reads sits inside the SAME
+``with <lock>:`` block — individually-locked reads with the lock
+released in between are exactly the torn interleaving.  The finding
+carries the offending path's entry chain (``Finding.chain``).
+
+Structural exemptions: ``project.TORN_READ_ALLOWED_SITES``, same
+per-context value forms as the affinity allowlist.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import project as facts
+from ..core import Finding, Rule
+from ..graph import SHARD, THREAD, Project
+
+__all__ = ["TornRead"]
+
+
+class TornRead(Rule):
+    name = "torn-read"
+    description = ("multi-field invariant read from shard/thread "
+                   "context without the group's lock held across the "
+                   "reads")
+    node_types = ()  # graph rule: everything happens in finalize
+
+    def begin_run(self) -> None:
+        self._project: Project = None  # type: ignore[assignment]
+
+    def begin_project(self, project: Project) -> None:
+        self._project = project
+
+    def finalize(self) -> List[Finding]:
+        project = self._project
+        if project is None:
+            return []
+        aff = project.affinity()
+        out: List[Finding] = []
+        for fqid, s, fi in project.functions():
+            if not fi.reads:
+                continue
+            # offending paths: shard/thread entry WITHOUT the lock —
+            # a locked path covers every read in the function
+            offending = [c for c in aff.paths(fqid)
+                         if c[0] in (SHARD, THREAD) and not c[1]]
+            if not offending:
+                continue
+            for gname, (owner, fields, lock, why) in sorted(
+                    facts.INVARIANT_GROUPS.items()):
+                sites = [
+                    r for r in fi.reads
+                    if r.attr in fields
+                    and project.owner_class(
+                        s, fi, r.chain, view=SHARD) == owner
+                ]
+                if len({r.attr for r in sites}) < 2:
+                    continue
+                blocks = {r.block_of(lock) for r in sites}
+                if None not in blocks and len(blocks) == 1:
+                    continue  # one critical section covers the set
+                survivors = []
+                for ctx in offending:
+                    chain = aff.trace_ctx(fqid, ctx)
+                    entry = chain[0] if chain else fi.qualname
+                    if facts.site_exemption(
+                            facts.TORN_READ_ALLOWED_SITES, s.relpath,
+                            fi.qualname, ctx[0], entry) is None:
+                        survivors.append((ctx, chain))
+                if not survivors:
+                    continue
+                ctx, chain = survivors[0]
+                first = min(sites, key=lambda r: (r.line, r.col))
+                read_fields = ", ".join(sorted(
+                    {r.attr for r in sites}))
+                out.append(Finding(
+                    rule=self.name, path=s.relpath, line=first.line,
+                    col=first.col,
+                    message=(
+                        f"{fi.qualname!r} reads {read_fields} of "
+                        f"{owner} (invariant group {gname!r}: {why}) "
+                        f"from {ctx[0]} context without {lock!r} held "
+                        "across the reads; hold the lock over one "
+                        "critical section or marshal the read to the "
+                        "owning loop"),
+                    context=fi.qualname, chain=tuple(chain),
+                ))
+        return out

@@ -12,6 +12,14 @@ records:
 * **write sites** — attribute assignments/mutations (``self.x = v``,
   ``sess.inflight[k] = v``, ``del obj.attr[k]``) with the same held-lock
   context;
+* **read sites** — attribute *loads* (``self.session.inflight``,
+  including the receiver of a method call) with the held-lock context
+  AND the identity of the enclosing lock block, so the torn-read rule
+  can tell "both reads inside ONE ``with mutex:``" apart from "each
+  read locked, lock released in between" — the read-set model;
+* **acquire sites** — every recognized lock taken by a ``with``, with
+  the locks already held at that point: the raw material of the
+  lock-order (deadlock-cycle) graph;
 * **spawn sites** — callables handed across an execution boundary:
   worker threads (``asyncio.to_thread`` / ``run_in_executor`` /
   ``threading.Thread(target=...)``), loop marshals
@@ -33,8 +41,9 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 __all__ = [
-    "CallSite", "SpawnSite", "WriteSite", "FuncInfo", "ClassInfo",
-    "ModuleSummary", "extract_module", "module_name_for", "chain_of",
+    "CallSite", "SpawnSite", "WriteSite", "ReadSite", "AcquireSite",
+    "FuncInfo", "ClassInfo", "ModuleSummary", "extract_module",
+    "module_name_for", "chain_of",
 ]
 
 #: body contains one of these → the function bootstraps its OWN event
@@ -117,6 +126,57 @@ class WriteSite:
 
 
 @dataclass
+class ReadSite:
+    """Attribute load (``sess.inflight``): the receiver chain plus the
+    read attribute, the locks held, and — parallel to ``locks`` — the
+    line of each lock's ``with`` block, so "held across" (same block)
+    is distinguishable from "held at each site" (re-acquired)."""
+
+    chain: Tuple[str, ...]
+    attr: str
+    line: int
+    col: int
+    locks: Tuple[str, ...] = ()
+    blocks: Tuple[int, ...] = ()
+
+    def block_of(self, lock: str) -> Optional[int]:
+        """Line of the innermost ``with`` holding ``lock`` at this
+        read, or None when the lock is not held here."""
+        for name, blk in zip(reversed(self.locks),
+                             reversed(self.blocks)):
+            if name == lock:
+                return blk
+        return None
+
+    def to_dict(self) -> list:
+        return [list(self.chain), self.attr, self.line, self.col,
+                list(self.locks), list(self.blocks)]
+
+    @classmethod
+    def from_dict(cls, d: list) -> "ReadSite":
+        return cls(tuple(d[0]), d[1], d[2], d[3], tuple(d[4]),
+                   tuple(d[5]))
+
+
+@dataclass
+class AcquireSite:
+    """A ``with <lock>:`` entry: the lock taken and the locks already
+    held — one edge candidate of the lock-order graph."""
+
+    name: str
+    line: int
+    col: int
+    locks: Tuple[str, ...] = ()   # held BEFORE this acquisition
+
+    def to_dict(self) -> list:
+        return [self.name, self.line, self.col, list(self.locks)]
+
+    @classmethod
+    def from_dict(cls, d: list) -> "AcquireSite":
+        return cls(d[0], d[1], d[2], tuple(d[3]))
+
+
+@dataclass
 class FuncInfo:
     name: str
     qualname: str             # "Class.method", "fn", "fn.inner"
@@ -127,6 +187,8 @@ class FuncInfo:
     calls: List[CallSite] = field(default_factory=list)
     spawns: List[SpawnSite] = field(default_factory=list)
     writes: List[WriteSite] = field(default_factory=list)
+    reads: List[ReadSite] = field(default_factory=list)
+    acquires: List[AcquireSite] = field(default_factory=list)
     #: simple local aliases: ``sess = self.session`` → sess → chain
     aliases: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
     #: nested defs visible in this function's scope: name → qualname
@@ -144,6 +206,8 @@ class FuncInfo:
             "calls": [c.to_dict() for c in self.calls],
             "spawns": [s.to_dict() for s in self.spawns],
             "writes": [w.to_dict() for w in self.writes],
+            "reads": [r.to_dict() for r in self.reads],
+            "acquires": [a.to_dict() for a in self.acquires],
             "aliases": {k: list(v) for k, v in self.aliases.items()},
             "local_defs": dict(self.local_defs),
             "params": list(self.params),
@@ -158,6 +222,9 @@ class FuncInfo:
             calls=[CallSite.from_dict(c) for c in d["calls"]],
             spawns=[SpawnSite.from_dict(s) for s in d["spawns"]],
             writes=[WriteSite.from_dict(w) for w in d["writes"]],
+            reads=[ReadSite.from_dict(r) for r in d.get("reads", [])],
+            acquires=[AcquireSite.from_dict(a)
+                      for a in d.get("acquires", [])],
             aliases={k: tuple(v) for k, v in d["aliases"].items()},
             local_defs=dict(d["local_defs"]),
             params=tuple(d.get("params", ())),
@@ -301,7 +368,12 @@ class _Extractor:
         self.tree = tree
         self.class_stack: List[ClassInfo] = []
         self.func_stack: List[FuncInfo] = []
-        self.lock_stack: List[str] = []
+        # (lock name, line of the holding ``with``): the line is the
+        # block identity the read-set model distinguishes critical
+        # sections by
+        self.lock_stack: List[Tuple[str, int]] = []
+        # per-function read dedup: (qualname, chain, attr, locks, blocks)
+        self._read_seen: set = set()
 
     # -- helpers -------------------------------------------------------
 
@@ -316,7 +388,10 @@ class _Extractor:
         return ".".join(parts) if parts else "<module>"
 
     def _locks(self) -> Tuple[str, ...]:
-        return tuple(self.lock_stack)
+        return tuple(name for name, _ in self.lock_stack)
+
+    def _blocks(self) -> Tuple[int, ...]:
+        return tuple(line for _, line in self.lock_stack)
 
     def _lock_name(self, expr: ast.AST) -> Optional[str]:
         """Terminal lock name of a with-item, following one level of
@@ -352,7 +427,12 @@ class _Extractor:
             for item in node.items:
                 name = self._lock_name(item.context_expr)
                 if name is not None:
-                    self.lock_stack.append(name)
+                    fn = self.func_stack[-1] if self.func_stack else None
+                    if fn is not None:
+                        fn.acquires.append(AcquireSite(
+                            name=name, line=node.lineno,
+                            col=node.col_offset, locks=self._locks()))
+                    self.lock_stack.append((name, node.lineno))
                     held += 1
                 self._visit_expr(item.context_expr)
             for child in node.body:
@@ -376,12 +456,39 @@ class _Extractor:
                 self._visit(child)
 
     def _visit_expr(self, node: ast.AST) -> None:
-        """Descend into an expression looking for calls."""
+        """Descend into an expression looking for calls and attribute
+        loads (read sites)."""
         if isinstance(node, ast.Call):
             self._call(node, discarded=False)
             return
+        if isinstance(node, ast.Attribute):
+            chain = chain_of(node)
+            if chain is not None:
+                self._record_reads(chain, node)
+                return  # sub-chains recorded; nothing left below
         for child in ast.iter_child_nodes(node):
             self._visit_expr(child)
+
+    def _record_reads(self, chain: Tuple[str, ...],
+                      node: ast.AST) -> None:
+        """Register every attribute segment of a load chain as a read:
+        ``self.session.inflight`` reads ``session`` of ``self`` and
+        ``inflight`` of ``self.session``.  Deduped per function on
+        (receiver, attr, lock context) keeping the first site."""
+        fn = self.func_stack[-1] if self.func_stack else None
+        if fn is None or len(chain) < 2 or chain[0] == "super()":
+            return
+        locks, blocks = self._locks(), self._blocks()
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        for i in range(1, len(chain)):
+            key = (fn.qualname, chain[:i], chain[i], locks, blocks)
+            if key in self._read_seen:
+                continue
+            self._read_seen.add(key)
+            fn.reads.append(ReadSite(
+                chain=chain[:i], attr=chain[i], line=line, col=col,
+                locks=locks, blocks=blocks))
 
     # -- imports -------------------------------------------------------
 
@@ -577,6 +684,11 @@ class _Extractor:
             fn.calls.append(CallSite(
                 chain=chain, line=node.lineno, col=node.col_offset,
                 discarded=discarded, locks=self._locks()))
+            # the call's receiver is read to reach the method: the
+            # read-set model sees ``sess.inflight.lookup()`` touch
+            # ``inflight`` (terminal method name itself excluded)
+            if len(chain) > 2:
+                self._record_reads(chain[:-1], node)
         # alarm notes (registry-drift cross-file pairing)
         if terminal in ("activate", "deactivate") and chain is not None \
                 and len(chain) >= 2 and "alarm" in chain[-2].lower() \

@@ -34,6 +34,36 @@ from .transport.listener import Listener, Listeners
 
 log = logging.getLogger(__name__)
 
+
+def enable_xla_cache(path: str) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` (under the
+    segments dir): a process restart finds every previously-compiled
+    serve executable on disk, so even the FIRST cold-start compile is
+    a cache hit instead of an XLA run.  Returns True when the cache is
+    active; best-effort — a jax without the knobs (or no jax at all)
+    degrades to in-memory compiles, never a startup failure."""
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        log.exception("persistent XLA compilation cache unavailable; "
+                      "cold-start compiles stay in-memory")
+        return False
+    # cache every executable however fast its compile was (the serve
+    # kernels are small; the default min-time floor would skip them) —
+    # tuning knobs are advisory, absence is not an error
+    for knob, value in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, value)
+        except Exception:  # noqa: PERF203 — per-knob isolation
+            log.debug("XLA cache knob %s unsupported by this jax",
+                      knob, exc_info=True)
+    return True
+
 __all__ = ["BrokerNode"]
 
 
@@ -910,6 +940,8 @@ class BrokerNode:
         if cfg.get("match.segments.enable"):
             seg_dir = cfg.get("match.segments.dir") or os.path.join(
                 cfg.get("node.data_dir") or "data", "segments")
+            if cfg.get("match.segments.xla_cache"):
+                enable_xla_cache(os.path.join(seg_dir, "xla_cache"))
         try:
             self.match_service = MatchService(
                 self.broker,

@@ -59,6 +59,27 @@ DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".staticcheck_cache")
 #: consume metric/config names by literal (they have drifted before)
 DEFAULT_SCAN_PATHS = ("emqx_tpu", "bench.py", "scripts/bench_e2e.py")
 
+#: a change under the analysis itself (rules, ownership facts —
+#: notably project.py INVARIANT_GROUPS/LOCKED_FIELDS edits) can
+#: re-surface findings in ANY file; --changed then re-checks the full
+#: tree instead of the import-graph dependents (which would miss
+#: every file, since nothing imports the checker)
+ANALYSIS_RELPATH_PREFIX = "emqx_tpu/devtools/staticcheck/"
+
+
+def changed_targets(project, changed):
+    """The ``--changed`` re-check set: the changed relpaths plus their
+    reverse import-graph dependents — or None (re-check EVERYTHING)
+    when the analysis/facts modules themselves changed."""
+    if any(p.startswith(ANALYSIS_RELPATH_PREFIX) for p in changed):
+        return None
+    changed_mods = [module_name_for(p)[0] for p in changed]
+    keep_mods = project.dependents_closure(changed_mods)
+    return {
+        s.relpath for s in project.modules.values()
+        if s.module in keep_mods or s.relpath in changed
+    }
+
 
 def _default_paths(root: str):
     return [os.path.join(root, p) for p in DEFAULT_SCAN_PATHS]
@@ -173,14 +194,8 @@ def main(argv=None) -> int:
         # expand over the reverse import graph after pass 1 — done via
         # a pre-analysis to learn the graph, then the real run
         pre = analyze(paths, [], root=root, cache=cache, targets=set())
-        project = pre.project
-        changed_mods = [module_name_for(p)[0] for p in changed]
-        keep_mods = project.dependents_closure(changed_mods)
-        targets = {
-            s.relpath for s in project.modules.values()
-            if s.module in keep_mods or s.relpath in changed
-        }
-        if not targets:
+        targets = changed_targets(pre.project, changed)
+        if targets is not None and not targets:
             print("0 finding(s) (clean); changed files outside the "
                   "scan set")
             return 0

@@ -1,0 +1,31 @@
+"""Fixture: lock-order must NOT flag any of these."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+        self.mutex = threading.RLock()
+
+    def fwd(self):
+        # one global order, everywhere: a_lock before b_lock
+        with self.a_lock:
+            with self.b_lock:
+                return 1
+
+    def also_fwd(self):
+        with self.a_lock:
+            return self._grab_b()
+
+    def _grab_b(self):
+        with self.b_lock:
+            return 2
+
+    def reentrant(self):
+        # same-name nesting is the re-entrant RLock pattern, not an
+        # ordering edge
+        with self.mutex:
+            with self.mutex:
+                return 3

@@ -1,0 +1,34 @@
+"""Fixture: torn-read must NOT flag any of these."""
+
+import threading
+
+
+class Session:
+    def __init__(self):
+        self.inflight = {}
+        self.mqueue = []
+        self.mutex = None
+
+
+class ShardChannel:
+    def __init__(self, session):
+        self.session = session
+        self.mutex = threading.RLock()
+
+    def check_keepalive(self):
+        # both group fields read inside ONE critical section: the
+        # documented shard-side pattern
+        with self.mutex:
+            return bool(self.session.inflight) or bool(
+                self.session.mqueue)
+
+    def retry_deliveries(self):
+        # single-field read: no multi-field invariant to tear
+        with self.mutex:
+            return len(self.session.inflight)
+
+
+def fanout_deliver(sess):
+    # unreached from any shard/thread entry: main-loop readers see a
+    # single-threaded view and need no lock
+    return len(sess.inflight) + len(sess.mqueue)

@@ -1,0 +1,26 @@
+"""Fixture: lock-order MUST flag this (1 cycle finding).
+
+``fwd`` nests a_lock → b_lock directly; ``rev`` holds b_lock while
+calling a helper that acquires a_lock (the edge crosses a resolved
+call).  Interleaved, the two paths deadlock."""
+
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+
+    def fwd(self):
+        with self.a_lock:
+            with self.b_lock:
+                return 1
+
+    def rev(self):
+        with self.b_lock:
+            return self._grab_a()
+
+    def _grab_a(self):
+        with self.a_lock:
+            return 2

@@ -1,0 +1,37 @@
+"""Fixture: torn-read MUST flag these (2 findings)."""
+
+import threading
+
+
+class Session:
+    def __init__(self):
+        self.inflight = {}
+        self.mqueue = []
+        self.mutex = None
+
+
+class ShardChannel:
+    """Matches the AFFINITY_SEEDS qualname suffixes, so its handler
+    surface is shard-affine by declaration (entry unlocked)."""
+
+    def __init__(self, session):
+        self.session = session
+        self.mutex = threading.RLock()
+
+    def check_keepalive(self):
+        # (1) two fields of the session-window invariant group read
+        # with NO lock at all on a shard path: the reader can see the
+        # inflight map of one moment and the mqueue of another
+        if len(self.session.inflight) or len(self.session.mqueue):
+            return True
+        return False
+
+    def retry_deliveries(self):
+        # (2) each read individually under the mutex, but the lock is
+        # RELEASED between the two blocks — exactly the torn
+        # interleaving ("held at each site" is not "held across")
+        with self.mutex:
+            a = len(self.session.inflight)
+        with self.mutex:
+            b = len(self.session.mqueue)
+        return a + b

@@ -1,0 +1,7 @@
+"""Two-plane fixture package: proves the affinity lattice is
+context-sensitive.  The SAME helper (``helper.bump``) is reached from
+the main loop **with** the channel RLock held (``mainline.py``) and
+from a shard **without** it (``shardline.py``).  A context-insensitive
+analysis must either over-flag (both paths) or over-absorb (neither);
+the k=1 lattice flags exactly once, on the shard path, with the chain
+naming the shard entry."""

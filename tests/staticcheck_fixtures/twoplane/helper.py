@@ -1,0 +1,9 @@
+"""The shared helper: correct when every caller holds the mutex,
+racy when one does not.  Which is which depends entirely on the PATH
+— this file alone cannot tell."""
+
+
+def bump(sess):
+    # write to an RLock-set Session field with no lock at the site:
+    # legal iff every entry path holds the mutex
+    sess.inflight[0] = 1

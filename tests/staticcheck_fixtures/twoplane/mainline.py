@@ -1,0 +1,16 @@
+"""Main-loop plane: calls the helper WITH the channel RLock held
+(ShardPool._main_handle is a declared main seed)."""
+
+import threading
+
+from .helper import bump
+
+
+class ShardPool:
+    def __init__(self):
+        self.mutex = threading.RLock()
+
+    def _main_handle(self, sess):
+        # locked-from-main: this path must produce ZERO findings
+        with self.mutex:
+            bump(sess)

@@ -1,0 +1,11 @@
+"""Shard plane: calls the SAME helper without the mutex
+(ShardChannel.handle_ack_run is a declared shard seed, unlocked)."""
+
+from .helper import bump
+
+
+class ShardChannel:
+    def handle_ack_run(self, sess):
+        # unlocked-from-shard: THE offending path — the one finding,
+        # whose chain must name this entry
+        bump(sess)

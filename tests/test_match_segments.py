@@ -428,3 +428,73 @@ def test_flag_off_structures_inert():
     assert not getattr(ms.inc, "track_regions", False)
     # no compact/hydrate/prewarm machinery arms without the flag
     assert ms._table_gen == 0 and ms._mut_count == 0
+
+
+def test_xla_cache_dir_configured_under_segments_dir(tmp_path,
+                                                    monkeypatch):
+    """match.segments.xla_cache (ROADMAP table-lifecycle leftover (d)):
+    the persistent XLA compilation cache lands under the segments dir
+    so even the FIRST cold-start compile is a disk hit."""
+    import jax
+
+    from emqx_tpu.node import enable_xla_cache
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        path = os.path.join(str(tmp_path), "segments", "xla_cache")
+        assert enable_xla_cache(path)
+        assert jax.config.jax_compilation_cache_dir == path
+        assert os.path.isdir(path)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_xla_cache_config_key_registered():
+    from emqx_tpu.config import SCHEMA
+
+    field = SCHEMA["match.segments.xla_cache"]
+    assert field.default is True
+
+
+def test_node_wires_xla_cache_only_with_segments_enabled(monkeypatch):
+    """The node start path calls enable_xla_cache iff segments AND the
+    xla_cache key are on, rooted under the segments dir."""
+    import emqx_tpu.node as node_mod
+    from emqx_tpu.config import Config
+
+    calls = []
+    monkeypatch.setattr(node_mod, "enable_xla_cache",
+                        lambda p: calls.append(p) or True)
+
+    class _Cfg:
+        def __init__(self, overrides):
+            self._c = Config()
+            self._o = overrides
+
+        def get(self, key):
+            return self._o.get(key, self._c.get(key))
+
+    async def probe(overrides):
+        calls.clear()
+        n = node_mod.BrokerNode.__new__(node_mod.BrokerNode)
+        n.config = _Cfg(overrides)
+        await n._start_match_service()
+
+    # tpu.enable off: nothing runs (the early return)
+    run(probe({"tpu.enable": False, "match.segments.enable": True}))
+    assert calls == []
+    # segments off: no cache dir either
+    run(probe({"tpu.enable": True, "match.segments.enable": False,
+               "tpu.start_timeout": 0.001}))
+    assert calls == []
+    # segments on + xla_cache off: skipped
+    run(probe({"tpu.enable": True, "match.segments.enable": True,
+               "match.segments.xla_cache": False,
+               "match.segments.dir": "/tmp/segdir",
+               "tpu.start_timeout": 0.001}))
+    assert calls == []
+    # segments on + xla_cache on (default): rooted under segments dir
+    run(probe({"tpu.enable": True, "match.segments.enable": True,
+               "match.segments.dir": "/tmp/segdir",
+               "tpu.start_timeout": 0.001}))
+    assert calls == [os.path.join("/tmp/segdir", "xla_cache")]

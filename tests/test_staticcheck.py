@@ -80,8 +80,11 @@ def test_tree_has_zero_nonwaived_findings():
     assert not new, (
         "staticcheck found new violations (fix them or add an expiring "
         "waiver with a reason):\n"
-        + "\n".join(f"  {f.location()}: [{f.rule}] {f.message}"
-                    for f in new)
+        + "\n".join(
+            f"  {f.location()}: [{f.rule}] {f.message}"
+            + (f"\n      path: {' -> '.join(f.chain)}" if f.chain
+               else "")
+            for f in new)
     )
     assert not expired, (
         "expired waivers still have live findings: "
@@ -109,6 +112,8 @@ def test_waiver_file_has_no_silent_suppressions():
     # seeds GENERATED from _SHARD_LOCAL x handle_in dispatch facts: a
     # shard-legal handler can no longer silently miss its seed
     ("shard-affinity", "trip_affinity_gen.py", "ok_affinity_gen.py", 1),
+    ("torn-read", "trip_tornread.py", "ok_tornread.py", 2),
+    ("lock-order", "trip_lockorder.py", "ok_lockorder.py", 1),
     ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
     ("no-swallowed-exceptions", "trip_exceptions.py",
      "ok_exceptions.py", 3),
@@ -269,7 +274,9 @@ def test_cross_module_taint_lands_in_the_helper_module(tmp_path):
     src = open(os.path.join(FIXTURES, "xmod", "helper.py")).read()
     want = src[:src.index("asyncio.ensure_future")].count("\n") + 1
     assert f.line == want
-    assert "relay" in f.message and "notify" in f.message
+    assert "notify" in f.message
+    # the thread-entry chain rides the structured chain field now
+    assert "relay" in f.chain and f.chain[-1] == "notify"
 
 
 def test_cross_module_unawaited_coroutine(tmp_path):
@@ -321,6 +328,162 @@ def test_generated_seeds_cover_real_shard_local_handlers():
     # a main-only dispatch target must NOT be seeded by generation
     assert "emqx_tpu.broker.channel:Channel._handle_subscribe" \
         not in aff.generated_seeds
+
+
+# ---------------------------------------------------------------------------
+# context sensitivity: the twoplane package (k=1 paths)
+# ---------------------------------------------------------------------------
+
+def _stage_twoplane(tmp_path, drop=None):
+    dest = tmp_path / "twoplane"
+    shutil.copytree(os.path.join(FIXTURES, "twoplane"), dest)
+    if drop:
+        (dest / drop).unlink()
+    return dest
+
+
+def test_twoplane_flags_only_the_shard_path(tmp_path):
+    """The SAME helper is called locked-from-main and unlocked-from-
+    shard: exactly one finding, on the shard path, chain naming the
+    shard entry — the context-insensitive lattice had to over-flag or
+    over-absorb here."""
+    dest = _stage_twoplane(tmp_path)
+    out = check_paths([str(dest)], get_rules(["shard-affinity"]),
+                      root=str(tmp_path))
+    assert len(out) == 1, [(f.path, f.line, f.message) for f in out]
+    f = out[0]
+    assert f.path == "twoplane/helper.py" and f.context == "bump"
+    assert f.chain[0] == "ShardChannel.handle_ack_run"
+    assert "ShardPool._main_handle" not in f.chain
+
+
+def test_twoplane_locked_main_path_alone_is_clean(tmp_path):
+    # with the shard caller gone, the only path is locked-from-main:
+    # zero findings
+    dest = _stage_twoplane(tmp_path, drop="shardline.py")
+    out = check_paths([str(dest)], get_rules(["shard-affinity"]),
+                      root=str(tmp_path))
+    assert out == [], [(f.path, f.line, f.message) for f in out]
+
+
+def test_per_context_allow_fact_scopes_to_the_path(tmp_path, monkeypatch):
+    """An AFFINITY_ALLOWED_SITES entry scoped (plane, entry) exempts
+    only that path: scoping it to the main entry keeps the shard
+    finding; scoping it to the shard entry clears the tree."""
+    from emqx_tpu.devtools.staticcheck import project as facts
+
+    dest = _stage_twoplane(tmp_path)
+    site = ("twoplane/helper.py", "bump")
+    # scoped to the benign main path: the shard finding survives
+    monkeypatch.setattr(facts, "AFFINITY_ALLOWED_SITES", {
+        site: ("main path holds the mutex by construction", "main",
+               "ShardPool._main_handle"),
+    })
+    out = check_paths([str(dest)], get_rules(["shard-affinity"]),
+                      root=str(tmp_path))
+    assert len(out) == 1 and out[0].chain[0] == \
+        "ShardChannel.handle_ack_run"
+    # scoped to the offending shard path: tree goes clean
+    monkeypatch.setattr(facts, "AFFINITY_ALLOWED_SITES", {
+        site: ("hypothetical: shard entry serializes via its own loop",
+               "shard", "ShardChannel.handle_ack_run"),
+    })
+    out = check_paths([str(dest)], get_rules(["shard-affinity"]),
+                      root=str(tmp_path))
+    assert out == []
+    # the old over-broad string form still exempts every path
+    monkeypatch.setattr(facts, "AFFINITY_ALLOWED_SITES", {
+        site: "over-broad: every path exempt",
+    })
+    out = check_paths([str(dest)], get_rules(["shard-affinity"]),
+                      root=str(tmp_path))
+    assert out == []
+
+
+def test_torn_read_locked_entry_path_is_clean(tmp_path, monkeypatch):
+    """A (shard, locked) entry covers every read in the function: only
+    the unlocked path makes the group reads a finding."""
+    from emqx_tpu.devtools.staticcheck import project as facts
+
+    dest_dir = tmp_path / "emqx_tpu" / "broker"
+    dest_dir.mkdir(parents=True)
+    dest = dest_dir / "lockedreader.py"
+    # _handle_publish is seeded (shard, locked=True): reads need no
+    # site-level lock
+    dest.write_text(
+        "class Session:\n"
+        "    def __init__(self):\n"
+        "        self.inflight = {}\n"
+        "        self.mqueue = []\n\n\n"
+        "class ShardChannel:\n"
+        "    def _handle_publish(self, sess):\n"
+        "        return len(sess.inflight) + len(sess.mqueue)\n"
+    )
+    out = check_paths([str(dest)], get_rules(["torn-read"]),
+                      root=str(tmp_path))
+    assert out == [], [(f.line, f.message) for f in out]
+
+
+def test_finding_chain_rides_json_and_text_reports(tmp_path):
+    from emqx_tpu.devtools.staticcheck.report import (
+        format_json, format_text)
+
+    dest = _stage_twoplane(tmp_path)
+    out = check_paths([str(dest)], get_rules(["shard-affinity"]),
+                      root=str(tmp_path))
+    assert len(out) == 1
+    blob = json.loads(format_json(out))
+    assert blob["findings"][0]["chain"] == [
+        "ShardChannel.handle_ack_run", "bump"]
+    text = format_text(out)
+    assert "path: ShardChannel.handle_ack_run -> bump" in text
+
+
+def test_lock_order_allowed_fact_suppresses_cycle(tmp_path, monkeypatch):
+    from emqx_tpu.devtools.staticcheck import project as facts
+
+    monkeypatch.setattr(facts, "LOCK_ORDER_ALLOWED", {
+        ("a_lock", "b_lock"): "fixture locks never contend (test)",
+    })
+    out = check_fixture("trip_lockorder.py", ["lock-order"], tmp_path)
+    assert out == []
+
+
+def test_lock_order_witnesses_name_both_edges(tmp_path):
+    out = check_fixture("trip_lockorder.py", ["lock-order"], tmp_path)
+    assert len(out) == 1
+    chain = " | ".join(out[0].chain)
+    assert "a_lock->b_lock" in chain and "b_lock->a_lock" in chain
+    assert "Pair._grab_a" in chain  # the cross-call edge is named
+
+
+def test_real_tree_lock_graph_has_no_cycle_and_known_edge():
+    """The real tree's lock graph: the shard fast path takes the
+    handoff lock under the channel mutex (mutex → _lock) and nothing
+    acquires them in the opposite order."""
+    from emqx_tpu.devtools.staticcheck import analyze
+
+    res = analyze([PKG], get_rules([]), root=REPO)
+    lo = res.project.lock_order()
+    assert ("mutex", "_lock") in lo.edges
+    assert lo.cycles() == []
+
+
+def test_affinity_paths_expose_k1_callers():
+    """The real tree's lattice keeps per-caller paths: Channel
+    ack handlers generated-seeded (shard, locked) AND reachable from
+    main-plane consumers stay separable."""
+    from emqx_tpu.devtools.staticcheck import analyze
+
+    res = analyze([PKG], get_rules([]), root=REPO)
+    aff = res.project.affinity()
+    fqid = "emqx_tpu.broker.channel:Channel._handle_puback"
+    paths = aff.paths(fqid)
+    assert ("shard", True, "") in paths  # the generated seed
+    # every recorded path resolves to an exact, non-guessed chain
+    for ctx in paths:
+        chain = aff.trace_ctx(fqid, ctx)
+        assert chain[-1] == "Channel._handle_puback"
 
 
 def test_affinity_keys_survive_line_drift(tmp_path):
@@ -463,6 +626,76 @@ def test_cli_changed_mode_rechecks_reverse_dependents(tmp_path):
              str(pkg))
     assert r.returncode == 1, r.stdout + r.stderr
     assert "b.py" in r.stdout and "unawaited-coroutine" in r.stdout
+
+
+def test_cli_changed_mode_facts_edit_rechecks_everything(tmp_path):
+    """Editing the ownership-facts module (project.py INVARIANT_GROUPS
+    et al.) re-surfaces per-context findings in files git considers
+    UNCHANGED: --changed widens to the full tree because nothing
+    imports the checker."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    shutil.copy(os.path.join(FIXTURES, "trip_tornread.py"),
+                pkg / "reader.py")
+    facts_dir = tmp_path / "emqx_tpu" / "devtools" / "staticcheck"
+    facts_dir.mkdir(parents=True)
+    facts_file = facts_dir / "project.py"
+    facts_file.write_text("# stand-in for the facts module\n")
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    assert _git(tmp_path, "add", "-A").returncode == 0
+    assert _git(tmp_path, "commit", "-qm", "seed").returncode == 0
+    # nothing changed: --changed is a no-op pass (findings and all)
+    r = _cli("--root", str(tmp_path), "--no-cache", "--changed",
+             "--rule", "torn-read", str(pkg))
+    assert r.returncode == 0, r.stdout + r.stderr
+    # a facts edit: reader.py is unchanged per git, its per-context
+    # findings must re-surface anyway
+    facts_file.write_text(
+        "# stand-in for the facts module\n# INVARIANT_GROUPS edited\n")
+    r = _cli("--root", str(tmp_path), "--no-cache", "--changed",
+             "--rule", "torn-read", str(pkg))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "reader.py" in r.stdout and "torn-read" in r.stdout
+
+
+def test_changed_targets_helper_widens_on_facts_edit():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("sc_cli2", CLI)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from emqx_tpu.devtools.staticcheck import analyze
+
+    res = analyze([PKG], get_rules([]), root=REPO)
+    # a facts/rules edit → None (full re-check)
+    assert mod.changed_targets(
+        res.project,
+        {"emqx_tpu/devtools/staticcheck/project.py"}) is None
+    # an ordinary edit → the file + reverse dependents only
+    targets = mod.changed_targets(
+        res.project, {"emqx_tpu/broker/inflight.py"})
+    assert "emqx_tpu/broker/inflight.py" in targets
+    assert "emqx_tpu/broker/session.py" in targets  # imports inflight
+    assert "emqx_tpu/topic.py" not in targets
+
+
+def test_cache_findings_roundtrip_context_chain(tmp_path):
+    """Cached per-file findings keep the chain field across the
+    save/load cycle (v3 cache payload)."""
+    from emqx_tpu.devtools.staticcheck.cache import (
+        _finding_from_dict, _finding_to_dict)
+    from emqx_tpu.devtools.staticcheck.core import Finding
+
+    f = Finding(rule="torn-read", path="p.py", line=3, col=1,
+                message="m", context="C.f",
+                chain=("ShardChannel.handle_ack_run", "C.f"))
+    assert _finding_from_dict(_finding_to_dict(f)) == f
+
+
+def test_new_rules_are_in_the_tier1_battery():
+    names = {r.name for r in ALL_RULES}
+    assert {"shard-affinity", "torn-read", "lock-order"} <= names
 
 
 @pytest.mark.slow
