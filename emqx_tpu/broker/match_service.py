@@ -102,6 +102,41 @@ rebuilds or recompiles on the hot path:
   before growth reaches it — a resize is served from the cache instead
   of stalling a prefetch on an XLA compile.
 
+**Overlapped serve pipeline** (opt-in, ``match.pipeline.enable``): the
+dispatch tax BENCH_r05 measured (match kernel ~17 ms p99 vs 398 ms
+served at batch 8192 — the gap is host-side encode, serialized
+dispatch, and a d2h readback sized to the table) is killed by
+overlapping the three serve stages, the way the FPGA XML-filtering
+architecture streams documents through match units while I/O overlaps
+compute:
+
+* **encode off the loop, overlapped**: ``encode_batch`` for batch N+1
+  runs in a worker thread while batch N computes on device; the batch
+  operand buffers are DONATED to the kernel (the ``_scatter_rows``
+  donation idiom), so the chain never holds two generations of encode
+  buffers;
+* **double-buffered dispatch**: up to ``match.pipeline.depth``
+  (default 2) batches sit past dispatch awaiting readback
+  (``broker.match.pipeline_inflight``); the serve loop goes back to
+  batching the moment a dispatch lands, instead of parking on the
+  round trip;
+* **match-proportional two-phase readback** in a supervised
+  ``match.readback`` child: phase 1 ships the tiny packed per-row
+  meta vector (counts + fail-open flags, 4·B bytes), phase 2 ships
+  exactly ``sum(counts)`` ids from the on-device-compacted flat
+  buffer — ``tpu.match.readback_bytes`` is 4·(B + Σcounts) per batch
+  instead of the 4·FLAT_MULT·B slab the serial path reads;
+* **per-slot staleness guards**: every in-flight slot carries the
+  table generation + aid-reuse counters it dispatched against; a
+  segment swap or aid reuse landing mid-flight discards exactly the
+  stale slot (CPU trie answers it, no breaker strike) while fresher
+  slots keep their device answers;
+* the ``match.readback`` chaos seam (raise / delay / hang) sits at the
+  d2h boundary of BOTH the pipelined child and the flag-off path; a
+  killed readback child resolves its in-flight slots immediately
+  (waiters fail over to the CPU trie) and the supervised restart
+  resumes consuming.
+
 Flag off, the pre-deadline fixed-window loop serves byte-identically.
 In BOTH modes a killed/crashed serve loop fails its in-flight waiters
 over to the CPU path immediately (and re-arms on supervised restart)
@@ -234,6 +269,8 @@ class MatchService:
         split_min: int = 256,
         deadline: bool = False,
         deadline_s: float = 0.041,
+        pipeline: bool = False,
+        pipeline_depth: int = 2,
         breaker_threshold: int = 5,
         breaker_probe_interval_s: float = 1.0,
         dispatch_timeout_s: Optional[float] = None,
@@ -274,6 +311,12 @@ class MatchService:
         # fixed-window loop, byte-identical to the pre-deadline path.
         self.deadline = bool(deadline)
         self.deadline_s = deadline_s
+        # overlapped serve pipeline (module docstring).  Off = the
+        # serial dispatch→readback round trip, byte-identical to PR 10.
+        self.pipeline = bool(pipeline)
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight_q: Optional[asyncio.Queue] = None
+        self._inflight_n = 0
         self.breaker_threshold = breaker_threshold
         self.breaker_probe_interval_s = breaker_probe_interval_s
         # per-dispatch bound: well under the waiter timeout so a hung
@@ -388,6 +431,13 @@ class MatchService:
         self._bootstrap()
         serve_loop = self._deadline_loop if self.deadline \
             else self._batch_loop
+        if self.pipeline:
+            # in-flight slot queue: maxsize bounds batches QUEUED for
+            # readback; with one more in the readback child itself, at
+            # most pipeline_depth batches sit past dispatch (depth 2 =
+            # classic double buffering)
+            self._inflight_q = asyncio.Queue(
+                maxsize=max(1, self.pipeline_depth - 1))
         sup = getattr(self, "supervisor", None)
         if sup is not None:
             # supervised (node sets .supervisor before start): a crashed
@@ -397,6 +447,9 @@ class MatchService:
                 sup.start_child("match.sync", self._sync_loop),
                 sup.start_child("match.batch", serve_loop),
             ]
+            if self.pipeline:
+                self._tasks.append(
+                    sup.start_child("match.readback", self._readback_loop))
             if self.segments:
                 self._tasks.append(
                     sup.start_child("table.compact", self._compact_loop))
@@ -405,6 +458,9 @@ class MatchService:
                 asyncio.ensure_future(self._sync_loop()),
                 asyncio.ensure_future(serve_loop()),
             ]
+            if self.pipeline:
+                self._tasks.append(
+                    asyncio.ensure_future(self._readback_loop()))
             if self.segments:
                 self._tasks.append(
                     asyncio.ensure_future(self._compact_loop()))
@@ -724,16 +780,22 @@ class MatchService:
                 time.sleep(_fi._injector.last_delay)
         # flat_cap is a jit STATIC arg — warming without it would
         # compile the wrong variant and the first live batch would still
-        # stall on an XLA compile
-        words, lens, is_sys = encode_batch(self.inc, [], batch=64)
-        self.dev.match(words, lens, is_sys,
-                       flat_cap=self.FLAT_MULT * 64)
-        if self.short_depth and self.short_depth < self.depth:
-            # pre-pay the short-depth kernel shape too, or the first
-            # split batch stalls the serving loop on an XLA compile
-            w, l, sy = encode_batch(self.inc, [], batch=64,
-                                    depth=self.short_depth)
-            self.dev.match(w, l, sy, flat_cap=self.FLAT_MULT * 64)
+        # stall on an XLA compile.  Pipeline mode dispatches through the
+        # DONATED jit twin, a separate executable: warm that variant too
+        # (fresh operands each pass — donation consumes them).
+        donates = (False, True) if self.pipeline else (False,)
+        for donate in donates:
+            words, lens, is_sys = encode_batch(self.inc, [], batch=64)
+            self.dev.match(words, lens, is_sys,
+                           flat_cap=self.FLAT_MULT * 64,
+                           donate_inputs=donate)
+            if self.short_depth and self.short_depth < self.depth:
+                # pre-pay the short-depth kernel shape too, or the first
+                # split batch stalls the serving loop on an XLA compile
+                w, l, sy = encode_batch(self.inc, [], batch=64,
+                                        depth=self.short_depth)
+                self.dev.match(w, l, sy, flat_cap=self.FLAT_MULT * 64,
+                               donate_inputs=donate)
 
     async def _compact_loop(self) -> None:
         """Supervised ``table.compact`` child: periodically folds the
@@ -1219,25 +1281,75 @@ class MatchService:
                 for seg in decode_flat(matches, counts, k)[:n]]
         return rows, np.flatnonzero(sp[:n]).tolist()
 
-    def _device_rows_grouped(self, encs, dev=None):
-        """Dispatch EVERY group's kernel first (dispatch only holds the
-        device lock), then read back — group 2 executes on device while
-        group 1's results stream back, so a depth split costs one extra
-        dispatch, not a second full round trip.  ``dev`` pins the twin
-        the batch encoded against (a segment swap mid-flight must not
-        mix tables; the gen guard discards the answer either way)."""
-        dev = self.dev if dev is None else dev
-        handles = [
-            (dev.match(
+    @staticmethod
+    def _readback_rows_twophase(res, n: int, k: int):
+        """Match-proportional two-phase d2h (pipeline mode): phase 1
+        ships the packed (B,) ``row_meta`` vector (counts + fail-open
+        flags), phase 2 exactly ``sum(counts)`` ids from the flat
+        buffer — the first Σ nk[:n] entries are the real rows by the
+        cumsum-offset construction (padding rows pack strictly after).
+        Returns ``(rows, spilled row indices, d2h bytes shipped)``."""
+        import jax
+
+        from ..ops.match_kernel import decode_row_meta, fetch_flat_prefix
+
+        meta = jax.device_get(res.row_meta)
+        nk, sp = decode_row_meta(meta)
+        nk = np.minimum(nk, k)
+        total = int(nk[:n].sum())
+        ids = fetch_flat_prefix(res.matches, total)
+        offs = np.cumsum(nk[:n]) - nk[:n]
+        rows = [ids[o:o + c].tolist() for o, c in zip(offs, nk[:n])]
+        return (rows, np.flatnonzero(sp[:n]).tolist(),
+                4 * (meta.size + total))
+
+    def _encode_dispatch(self, inc, dev, topics, groups, donate):
+        """WORKER-THREAD stage: encode every depth group and dispatch
+        its kernel — both OFF the event loop (the encode of a 2048
+        batch held the loop ~2.3 ms per dispatch; vocab dict reads are
+        GIL-atomic, and any concurrently-landed mutation is caught by
+        the per-flight aid-reuse/table-gen guards or the hint freshness
+        proof).  Dispatch only holds the device lock; the returned
+        handles are lazy device results, so group 2 executes while
+        group 1's answers stream back and — in pipeline mode — batch
+        N+1 encodes while batch N computes.  ``donate`` hands the
+        operand buffers to the kernel (pipeline mode; nothing reads
+        them after dispatch)."""
+        from ..ops import encode_batch
+
+        handles = []
+        for idx, d in groups:
+            enc = encode_batch(inc, [topics[i] for i in idx],
+                               batch=_bucket(len(idx)), depth=d)
+            res = dev.match(
                 *enc, flat_cap=self.FLAT_MULT * enc[0].shape[0],
                 # serving never parks behind XLA: an uncompiled shape
                 # raises CompileMiss (CPU trie answers, shape warms in
                 # the background) instead of stalling the batch
-                block_compile=(dev.kernel_cache is None)), n)
-            for enc, n in encs
-        ]
-        return [self._readback_rows(res, n, dev.max_matches)
-                for res, n in handles]
+                block_compile=(dev.kernel_cache is None),
+                donate_inputs=donate)
+            handles.append((res, len(idx)))
+        return handles
+
+    def _readback_groups(self, handles, dev, proportional):
+        """WORKER-THREAD stage: block on every group's d2h.  Serial
+        (flag-off) mode reads the full flat slab exactly as PR 10 did;
+        ``proportional`` (pipeline mode) rides the two-phase contract.
+        Returns ``([(rows, spilled)...], total d2h bytes)``."""
+        out = []
+        nbytes = 0
+        for res, n in handles:
+            if proportional:
+                rows, sp, b = self._readback_rows_twophase(
+                    res, n, dev.max_matches)
+            else:
+                rows, sp = self._readback_rows(res, n, dev.max_matches)
+                # the slab cost: the flat id buffer + counts and both
+                # overflow vectors (what device_get above shipped)
+                b = 4 * int(res.matches.size + 3 * res.n_matches.size)
+            nbytes += b
+            out.append((rows, sp))
+        return out, nbytes
 
     def _depth_groups(self, topics: List[str]) -> List[Tuple[List[int], int]]:
         """Partition batch indices into (indices, kernel_depth) groups.
@@ -1285,6 +1397,9 @@ class MatchService:
     async def _serve_batch(self, pending: List[Any]) -> None:
         """Fixed-window dispatch: device rows → hints, any failure
         resolves the waiters empty-handed (host trie serves)."""
+        if self.pipeline:
+            await self._pipeline_dispatch(pending, deadline_mode=False)
+            return
         topics = [p[0] for p in pending]
         # the hint's provenance is the epoch the DEVICE table
         # reflects (not the live router epoch — the table may lag;
@@ -1316,6 +1431,21 @@ class MatchService:
             elif act == "hang":
                 await _fi._injector.hang()
 
+    async def _readback_gate(self) -> None:
+        """The ``match.readback`` chaos seam at the d2h boundary,
+        shared by the flag-off serve path and the pipelined
+        ``match.readback`` child.  ``hang`` parks until the pipelined
+        per-slot timeout (or the waiters' prefetch timeout on the
+        flag-off path) rescues it."""
+        if _fi._injector is not None:
+            act = _fi._injector.act("match.readback")
+            if act == "raise":
+                raise _fi.InjectedFault("match.readback")
+            if act == "delay":
+                await _fi._injector.pause()
+            elif act == "hang":
+                await _fi._injector.hang()
+
     async def _dispatch_guarded(self, topics: List[str]) -> List[Any]:
         await self._fault_gate()
         return await self._device_serve(topics)
@@ -1325,8 +1455,6 @@ class MatchService:
         batch; returns one aid row per topic.  Raises :class:`_StaleRace`
         when a freed accept id was handed out mid-flight (benign — the
         answer is untrusted but the device is healthy)."""
-        from ..ops import encode_batch
-
         # aid-reuse guard: if a freed accept id is handed out
         # again while this batch is in flight, the device rows
         # may name it under its OLD filter — translating through
@@ -1338,15 +1466,24 @@ class MatchService:
         reuses0 = inc.aid_reuses
         gen0 = self._table_gen
         groups = self._depth_groups(topics)
-        encs = [
-            (encode_batch(inc, [topics[i] for i in idx],
-                          batch=_bucket(len(idx)), depth=d),
-             len(idx))
-            for idx, d in groups
-        ]
-        results = await asyncio.to_thread(
-            self._device_rows_grouped, encs, dev
+        handles = await asyncio.to_thread(
+            self._encode_dispatch, inc, dev, topics, groups, False
         )
+        await self._readback_gate()
+        results, nbytes = await asyncio.to_thread(
+            self._readback_groups, handles, dev, False
+        )
+        if self.metrics is not None:
+            self.metrics.inc("tpu.match.readback_bytes", nbytes)
+        return self._collect_rows(topics, groups, results,
+                                  inc, reuses0, gen0)
+
+    def _collect_rows(self, topics: List[str], groups, results,
+                      inc, reuses0: int, gen0: int) -> List[Any]:
+        """Loop-side epilogue shared by the serial path and the
+        pipelined readback child: stitch group results back into batch
+        order, enforce the per-flight staleness guards, re-run spilled
+        rows on the host tables, merge deep-filter hits."""
         rows: List[Any] = [None] * len(topics)
         spilled: List[int] = []
         for (idx, _d), (grows, gspill) in zip(groups, results):
@@ -1556,6 +1693,9 @@ class MatchService:
         the CPU tables immediately and feeds the circuit breaker."""
         if not pending:
             return
+        if self.pipeline:
+            await self._pipeline_dispatch(pending, deadline_mode=True)
+            return
         topics = [p[0] for p in pending]
         epoch = self._synced_epoch
         rule_gen = self._synced_rule_gen
@@ -1619,6 +1759,152 @@ class MatchService:
         if self.metrics is not None:
             self.metrics.inc("broker.match.cpu_fallback", len(pending))
             self._count_misses(pending)
+
+    # ------------------------------------------------------------------
+    # overlapped serve pipeline (opt-in, match.pipeline.enable)
+    # ------------------------------------------------------------------
+
+    async def _pipeline_dispatch(self, pending: List[Any],
+                                 deadline_mode: bool) -> None:
+        """Pipeline-mode front half of a serve batch: encode + dispatch
+        in a worker thread (donated operand buffers), then hand the
+        in-flight slot to the ``match.readback`` child and return — the
+        serve loop goes straight back to batching (and encoding batch
+        N+1) while this batch computes on device.  Every slot carries
+        the aid-reuse/table-gen guards it dispatched against, so a swap
+        or reuse landing mid-flight discards exactly the stale slot."""
+        if not pending:
+            return
+        topics = [p[0] for p in pending]
+        epoch = self._synced_epoch
+        rule_gen = self._synced_rule_gen
+        inc = self.inc
+        dev = self.dev
+        reuses0 = inc.aid_reuses
+        gen0 = self._table_gen
+        t0 = time.monotonic()
+        try:
+            if not self._usable():
+                raise RuntimeError("mirror stale")
+            await self._fault_gate()
+            groups = self._depth_groups(topics)
+            dispatch = asyncio.to_thread(
+                self._encode_dispatch, inc, dev, topics, groups, True)
+            if deadline_mode:
+                handles = await asyncio.wait_for(
+                    dispatch, self.dispatch_timeout_s)
+            else:
+                handles = await dispatch
+            slot = (pending, topics, groups, handles, inc, dev,
+                    reuses0, gen0, epoch, rule_gen, t0, deadline_mode)
+            await self._inflight_q.put(slot)   # backpressure at depth
+            self._inflight_n += 1
+            self._set_inflight_metric()
+        except asyncio.CancelledError:
+            # loop death mid-dispatch (or mid-put): the finally-failover
+            # resolves these waiters immediately
+            self._pending = pending + self._pending
+            raise
+        except _StaleRace:
+            self._cpu_serve(pending)        # benign race: no strike
+        except CompileMiss:
+            self._cpu_serve(pending)        # shape warms in background
+        except Exception:
+            log.debug("pipelined dispatch failed; CPU trie serves the "
+                      "batch", exc_info=True)
+            if deadline_mode:
+                self._breaker_note_failure()
+            self._cpu_serve(pending)
+
+    async def _readback_loop(self) -> None:
+        """Supervised ``match.readback`` child: drains the in-flight
+        slot queue, rides the two-phase match-proportional d2h, and
+        mints hints — the back half of the double-buffered chain.  A
+        kill resolves every queued slot's waiters NOW (CPU path serves)
+        and the supervised restart resumes consuming."""
+        try:
+            while True:
+                slot = await self._inflight_q.get()
+                try:
+                    await self._finish_slot(slot)
+                finally:
+                    self._inflight_n -= 1
+                    self._set_inflight_metric()
+        finally:
+            self._fail_over_slots()
+
+    async def _finish_slot(self, slot: Tuple[Any, ...]) -> None:
+        """Readback + guard check + hint mint for one in-flight slot;
+        ANY failure (chaos seam, timeout, stale guard) answers the
+        slot's batch from the CPU tables.  The finally backstop keeps
+        the kill path from stranding waiters on the prefetch timeout."""
+        (pending, topics, groups, handles, inc, dev, reuses0, gen0,
+         epoch, rule_gen, t0, deadline_mode) = slot
+        try:
+            try:
+                await self._readback_gate()
+                results, nbytes = await asyncio.wait_for(
+                    asyncio.to_thread(
+                        self._readback_groups, handles, dev, True),
+                    self.dispatch_timeout_s)
+                if self.metrics is not None:
+                    self.metrics.inc("tpu.match.readback_bytes", nbytes)
+                rows = self._collect_rows(topics, groups, results,
+                                          inc, reuses0, gen0)
+            except asyncio.CancelledError:
+                raise
+            except _StaleRace:
+                # the swap/reuse happened AFTER this slot dispatched:
+                # only this slot's answer is untrusted — CPU serves it,
+                # no breaker strike (the device is healthy)
+                self._cpu_serve(pending)
+                return
+            except Exception:
+                log.debug("pipelined readback failed; CPU trie serves "
+                          "the batch", exc_info=True)
+                if deadline_mode:
+                    self._breaker_note_failure()
+                self._cpu_serve(pending)
+                return
+            if deadline_mode:
+                self._breaker_note_ok()
+                # full dispatch→readback time feeds the partial-flush
+                # estimate: with the stages overlapped this is the
+                # latency a waiter actually experiences
+                dt = time.monotonic() - t0
+                self._est_dispatch_s = (
+                    self._est_dispatch_s * 0.7 + dt * 0.3)
+            self._mint_hints(pending, rows, epoch, rule_gen)
+        finally:
+            for p in pending:
+                if not p[1].done():
+                    p[1].set_result(None)
+
+    def _fail_over_slots(self) -> None:
+        """Readback-child death: resolve every queued slot's waiters so
+        their publishes fall to the CPU path immediately instead of
+        burning the full prefetch timeout (the in-flight twin of
+        :meth:`_fail_over_waiters`)."""
+        q = self._inflight_q
+        n = 0
+        while q is not None and not q.empty():
+            slot = q.get_nowait()
+            for p in slot[0]:
+                if not p[1].done():
+                    p[1].set_result(None)
+                    n += 1
+        self._inflight_n = 0
+        self._set_inflight_metric()
+        if n:
+            if self.metrics is not None:
+                self.metrics.inc("broker.match.cpu_fallback", n)
+            log.warning("match readback loop exited with %d waiter(s) "
+                        "in flight; failed over to the CPU path", n)
+
+    def _set_inflight_metric(self) -> None:
+        if self.metrics is not None:
+            self.metrics.set("broker.match.pipeline_inflight",
+                             self._inflight_n)
 
     # ------------------------------------------------------------------
     # circuit breaker + brownout
@@ -1731,6 +2017,9 @@ class MatchService:
             "uploads": self.dev.uploads,
             "delta_applies": self.dev.delta_applies,
             "deadline": self.deadline,
+            "pipeline": self.pipeline,
+            "pipeline_depth": self.pipeline_depth,
+            "pipeline_inflight": self._inflight_n,
             "breaker": "open" if self._breaker_open else "closed",
             "breaker_failures": self._breaker_failures,
             "brownout": self._last_brownout,

@@ -387,6 +387,16 @@ SCHEMA: Dict[str, Field] = {
     "match.breaker.threshold": Field(5, int, lambda v: v >= 1),
     # cadence of the recovery probe while the breaker is open
     "match.breaker.probe_interval": Field(1.0, duration),
+    # overlapped serve pipeline (broker/match_service.py): encode batch
+    # N+1 in a worker thread while batch N computes on device (donated
+    # input buffers), readback as a supervised match.readback child with
+    # match-proportional two-phase d2h (counts vector first, then
+    # exactly sum(counts) ids).  Off = the PR-10 serve path,
+    # byte-identical.
+    "match.pipeline.enable": Field(False, _bool),
+    # max device batches past dispatch awaiting readback (2 = classic
+    # double buffering: one queued while one reads back)
+    "match.pipeline.depth": Field(2, int, lambda v: v >= 1),
 
     # -- streaming table lifecycle (broker/match_service.py) --------------
     # opt-in: cold start from persistent compacted segments + background

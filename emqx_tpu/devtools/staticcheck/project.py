@@ -136,6 +136,16 @@ AFFINITY_SEEDS: Dict[str, Tuple[str, bool]] = {
     # _SHARD_LOCAL automatically seeds its dispatch handler.
     "Shard._consume_inbox": ("shard", False),
     "_ShardProtocol.data_received": ("shard", False),
+    # serve-pipeline worker stages (broker/match_service.py, PR 11):
+    # the encode/dispatch stage and the two-phase readback stage are
+    # entered via asyncio.to_thread (auto-seeded too — these facts
+    # write the contract down): PURE COMPUTE against captured
+    # arguments.  MatchService is MAIN_ONLY, so any state write (or a
+    # Broker touch) from either worker trips shard-affinity — hint
+    # minting, metrics, and breaker notes stay on the event loop in
+    # the match.batch / match.readback children.
+    "MatchService._encode_dispatch": ("thread", False),
+    "MatchService._readback_groups": ("thread", False),
     # main-loop surfaces of the same file (the marshal consumers)
     "ShardPool._consume": ("main", False),
     "ShardPool._publish_batch": ("main", False),

@@ -31,6 +31,11 @@ Named injection points (the seams the batched stack crosses):
                      / delay / hang; in deadline mode a hang is rescued
                      by the per-dispatch timeout)
 ``match.compile``    MatchService warm/compile seam (raise / delay)
+``match.readback``   MatchService d2h readback boundary — shared by the
+                     flag-off serve path and the pipelined
+                     ``match.readback`` child (raise / delay / hang; a
+                     hang on the pipelined path is rescued by the
+                     per-dispatch timeout)
 ``table.load``       MatchService segment cold-start load (raise ⇒
                      treated like a corrupt segment: checksum-reject
                      path, full rebuild serves)
@@ -89,7 +94,7 @@ __all__ = [
 
 POINTS = (
     "transport.write", "frame.parse", "match.dispatch", "match.compile",
-    "table.load", "table.swap",
+    "match.readback", "table.load", "table.swap",
     "inflight.insert", "inflight.retry", "cluster.rpc",
     "bridge.sink", "exhook.call", "fanout.drain", "shard.handoff",
 )

@@ -960,6 +960,8 @@ class BrokerNode:
                 split_min=cfg.get("tpu.split_min"),
                 deadline=cfg.get("match.deadline.enable"),
                 deadline_s=cfg.get("match.deadline_ms") / 1e3,
+                pipeline=cfg.get("match.pipeline.enable"),
+                pipeline_depth=cfg.get("match.pipeline.depth"),
                 breaker_threshold=cfg.get("match.breaker.threshold"),
                 breaker_probe_interval_s=cfg.get(
                     "match.breaker.probe_interval"),

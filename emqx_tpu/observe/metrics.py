@@ -143,11 +143,17 @@ ROBUSTNESS_METRIC_NAMES: List[str] = [
 # death); deadline_miss counts waiters resolved after their budget had
 # already elapsed; breaker_state is the live circuit-breaker state
 # (set: 0 closed, 1 open, 2 probing) and brownout_level the live olp
-# brownout stage (set: 0-3).
+# brownout stage (set: 0-3).  pipeline_inflight is the live count of
+# pipelined batches past dispatch awaiting readback (set, opt-in via
+# match.pipeline.enable); readback_bytes accumulates the d2h bytes the
+# match readback path actually shipped (inc) — with the two-phase
+# proportional readback this is 4·(B + Σcounts) per batch instead of
+# the 4·FLAT_MULT·B slab.
 MATCH_SERVE_METRIC_NAMES: List[str] = [
     "broker.match.deadline_dispatch", "broker.match.cpu_fallback",
     "broker.match.deadline_miss", "broker.match.breaker_state",
-    "broker.match.brownout_level",
+    "broker.match.brownout_level", "broker.match.pipeline_inflight",
+    "tpu.match.readback_bytes",
 ]
 
 # -- streaming table lifecycle (broker/match_service.py, opt-in via

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .incremental import IncrementalNfa, NfaDelta
-from .match_kernel import MatchResult, nfa_match
+from .match_kernel import MatchResult, nfa_match, nfa_match_donated
 
 __all__ = ["DeviceNfa", "PendingSync", "SCATTER_CHUNK"]
 
@@ -317,7 +317,8 @@ class DeviceNfa:
     # -- serving -----------------------------------------------------------
 
     def match(self, words, lens, is_sys, *,
-              flat_cap: int = 0, block_compile: bool = True) -> MatchResult:
+              flat_cap: int = 0, block_compile: bool = True,
+              donate_inputs: bool = False) -> MatchResult:
         """Run the kernel on already-encoded operands.  Dispatch happens
         under the device lock; the returned arrays are futures — callers
         block (np.asarray) outside any lock.  ``flat_cap`` > 0 selects
@@ -325,7 +326,11 @@ class DeviceNfa:
         match_kernel.decode_flat).  With a kernel cache attached and
         ``block_compile=False``, an uncompiled shape raises
         :class:`~emqx_tpu.ops.kernel_cache.CompileMiss` instead of
-        stalling the caller behind XLA (serving fail-open contract)."""
+        stalling the caller behind XLA (serving fail-open contract).
+        ``donate_inputs`` hands the batch operand buffers to the kernel
+        (the pipelined serve chain's idiom — the caller must not touch
+        words/lens/is_sys afterwards; same donation contract as
+        ``_scatter_rows``)."""
         with self._lock:
             node, edge, seeds = self.arrays()
             kc = self.kernel_cache
@@ -337,10 +342,12 @@ class DeviceNfa:
                     max_matches=self.max_matches,
                     compact_output=self.compact_output,
                     flat_cap=flat_cap,
+                    donate=donate_inputs,
                     block=block_compile,
                 )
                 return fn(words, lens, is_sys, node, edge, seeds)
-            return nfa_match(
+            fn = nfa_match_donated if donate_inputs else nfa_match
+            return fn(
                 words, lens, is_sys, node, edge, seeds,
                 active_slots=self.active_slots,
                 max_matches=self.max_matches,

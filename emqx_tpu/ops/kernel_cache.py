@@ -36,8 +36,8 @@ log = logging.getLogger(__name__)
 
 __all__ = ["MatchKernelCache", "CompileMiss"]
 
-#: (B, D, S, Hb, active_slots, max_matches, compact, flat_cap)
-Key = Tuple[int, int, int, int, int, int, bool, int]
+#: (B, D, S, Hb, active_slots, max_matches, compact, flat_cap, donate)
+Key = Tuple[int, int, int, int, int, int, bool, int, bool]
 
 
 class CompileMiss(RuntimeError):
@@ -54,9 +54,11 @@ class MatchKernelCache:
         self._inflight: Set[Key] = set()
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
-        # every (B, D, A, K, compact, flat_cap) combo ever requested:
-        # what prewarm_shape replays against the NEXT table shape
-        self._combos: Set[Tuple[int, int, int, int, bool, int]] = set()
+        # every (B, D, A, K, compact, flat_cap, donate) combo ever
+        # requested: what prewarm_shape replays against the NEXT table
+        # shape
+        self._combos: Set[Tuple[int, int, int, int, bool, int,
+                                bool]] = set()
         self.compiles = 0
         self.hits = 0
         self.misses = 0
@@ -66,15 +68,16 @@ class MatchKernelCache:
     @staticmethod
     def key(batch_shape: Tuple[int, int], s: int, hb: int, *,
             active_slots: int, max_matches: int,
-            compact_output: bool, flat_cap: int) -> Key:
+            compact_output: bool, flat_cap: int,
+            donate: bool = False) -> Key:
         b, d = batch_shape
         return (b, d, s, hb, active_slots, max_matches,
-                bool(compact_output), flat_cap)
+                bool(compact_output), flat_cap, bool(donate))
 
     def executable(self, batch_shape: Tuple[int, int], s: int, hb: int, *,
                    active_slots: int, max_matches: int,
                    compact_output: bool, flat_cap: int,
-                   block: bool = True):
+                   donate: bool = False, block: bool = True):
         """The compiled executable for these operand shapes — cached, or
         compiled NOW (blocking; counted, so a resize that was prewarmed
         shows zero compiles on the serve path).  With ``block=False`` a
@@ -83,9 +86,10 @@ class MatchKernelCache:
         behind XLA, the CPU trie answers while the shape warms."""
         k = self.key(batch_shape, s, hb, active_slots=active_slots,
                      max_matches=max_matches,
-                     compact_output=compact_output, flat_cap=flat_cap)
+                     compact_output=compact_output, flat_cap=flat_cap,
+                     donate=donate)
         with self._lock:
-            self._combos.add((k[0], k[1], k[4], k[5], k[6], k[7]))
+            self._combos.add((k[0], k[1], k[4], k[5], k[6], k[7], k[8]))
             fn = self._compiled.get(k)
             if fn is not None:
                 self.hits += 1
@@ -121,10 +125,12 @@ class MatchKernelCache:
 
     def warmed(self, batch_shape: Tuple[int, int], s: int, hb: int, *,
                active_slots: int, max_matches: int,
-               compact_output: bool, flat_cap: int) -> bool:
+               compact_output: bool, flat_cap: int,
+               donate: bool = False) -> bool:
         k = self.key(batch_shape, s, hb, active_slots=active_slots,
                      max_matches=max_matches,
-                     compact_output=compact_output, flat_cap=flat_cap)
+                     compact_output=compact_output, flat_cap=flat_cap,
+                     donate=donate)
         with self._lock:
             return k in self._compiled
 
@@ -133,8 +139,8 @@ class MatchKernelCache:
         with self._lock:
             combos = list(self._combos)
             return bool(combos) and all(
-                (b, d, s, hb, a, m, c, f) in self._compiled
-                for (b, d, a, m, c, f) in combos
+                (b, d, s, hb, a, m, c, f, dn) in self._compiled
+                for (b, d, a, m, c, f, dn) in combos
             )
 
     def prewarm_shape(self, s: int, hb: int) -> int:
@@ -144,8 +150,8 @@ class MatchKernelCache:
         with self._lock:
             combos = list(self._combos)
         n = 0
-        for (b, d, a, m, c, f) in combos:
-            k = (b, d, s, hb, a, m, c, f)
+        for (b, d, a, m, c, f, dn) in combos:
+            k = (b, d, s, hb, a, m, c, f, dn)
             with self._lock:
                 if k in self._compiled:
                     continue
@@ -180,12 +186,13 @@ class MatchKernelCache:
         import jax.numpy as jnp
 
         from .compiler import BUCKET_SLOTS
-        from .match_kernel import nfa_match
+        from .match_kernel import nfa_match, nfa_match_donated
 
-        b, d, s, hb, a, m, compact, flat_cap = k
+        b, d, s, hb, a, m, compact, flat_cap, donate = k
         i32 = jnp.int32
         sd = jax.ShapeDtypeStruct
-        lowered = nfa_match.lower(
+        fn = nfa_match_donated if donate else nfa_match
+        lowered = fn.lower(
             sd((b, d), i32),                      # words
             sd((b,), i32),                        # lens
             sd((b,), jnp.bool_),                  # is_sys

@@ -37,7 +37,7 @@ XLA compilation per (D, A, K, B, S, Hb) bucket.
 from __future__ import annotations
 
 from functools import partial
-from typing import List, NamedTuple, Sequence
+from typing import List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -46,7 +46,8 @@ import numpy as np
 from .compiler import BUCKET_SLOTS, NfaTable, encode_topics
 
 __all__ = ["MatchResult", "SERVE_FLAT_MULT", "build_matcher",
-           "decode_flat", "match_topics", "nfa_match"]
+           "decode_flat", "decode_row_meta", "fetch_flat_prefix",
+           "match_topics", "nfa_match", "nfa_match_donated"]
 
 # serving flat-output capacity per padded batch row (ids/topic): shared
 # by every serving engine so the fan-out tuning cannot drift between
@@ -58,6 +59,53 @@ __all__ = ["MatchResult", "SERVE_FLAT_MULT", "build_matcher",
 SERVE_FLAT_MULT = 8
 
 
+#: ``row_meta`` packing: low 16 bits = per-row flat-buffer entry count
+#: (min(n, K)); bit 16 = the row's fail-open flag (active-set OR match
+#: overflow).  One (B,) vector carries everything a two-phase readback
+#: needs, so phase 1 of a match-proportional d2h costs 4·B bytes, not
+#: the 12·B of fetching counts + both overflow vectors separately.
+ROW_META_COUNT_MASK = 0xFFFF
+ROW_META_SPILL_SHIFT = 16
+
+
+def decode_row_meta(meta: np.ndarray):
+    """(B,) packed row_meta → (per-row flat entry counts, spilled rows
+    bool) — the host half of the two-phase readback contract."""
+    return (meta & ROW_META_COUNT_MASK), (meta >> ROW_META_SPILL_SHIFT) > 0
+
+
+def fetch_flat_prefix(matches, total: int) -> np.ndarray:
+    """Phase 2 of the two-phase readback: ship EXACTLY the first
+    ``total`` ids of the flat buffer with a BOUNDED executable set.
+
+    A naive ``matches[:total]`` compiles one XLA slice per distinct
+    total — unbounded compile churn on the serve path (measured: the
+    pipelined p99 collapsed under it).  Instead the prefix is fetched
+    by binary decomposition into pow2-sized ``dynamic_slice`` chunks:
+    the slice SIZE is static (one executable per (buffer, pow2) pair,
+    ≤ log2(flat_cap) of them ever) and the offset rides as a traced
+    scalar, so arbitrary totals reuse the same executables.  Bytes
+    shipped = 4·total exactly; chunk count ≤ log2(total)+1 (the d2h
+    path is bandwidth-bound, BASELINE.md tunnel table)."""
+    import jax
+
+    if total <= 0:
+        return np.empty(0, np.int32)
+    parts = []
+    off = 0
+    bit = 1 << (int(total).bit_length() - 1)
+    rem = int(total)
+    while rem:
+        if rem >= bit:
+            chunk = jax.lax.dynamic_slice(
+                matches, (jnp.int32(off),), (bit,))
+            parts.append(np.asarray(jax.device_get(chunk)))
+            off += bit
+            rem -= bit
+        bit >>= 1
+    return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
 class MatchResult(NamedTuple):
     matches: jax.Array     # (B, K) int32 accept ids, valids first, -1 pad
                            # flat mode: (flat_cap,) globally compacted ids
@@ -65,6 +113,9 @@ class MatchResult(NamedTuple):
     active_overflow: jax.Array  # (B,) int32 — per-row active-set spills
     match_overflow: jax.Array   # (B,) int32 — 1 where count > K (flat
                            # mode: also rows truncated by the global cap)
+    # flat mode only: packed per-row metadata for match-proportional
+    # two-phase readback (see decode_row_meta); None otherwise
+    row_meta: Optional[jax.Array] = None
 
     def spilled_rows(self):
         """Bool (B,) — rows whose answer may be truncated (fail-open set)."""
@@ -127,10 +178,35 @@ def _compact(cand: jax.Array, width: int) -> jax.Array:
     return jnp.max(jnp.where(onehot, cand[..., None], -1), axis=1)
 
 
-@partial(jax.jit,
-         static_argnames=("active_slots", "max_matches", "compact_output",
-                          "flat_cap"))
-def nfa_match(
+def flat_epilogue(flat, n, aover, max_matches: int, flat_cap: int):
+    """The fused on-device compaction epilogue for flat serving mode:
+    per-row top-K compaction, a GLOBAL cumsum-offset scatter into one
+    ``(flat_cap,)`` buffer, and the packed ``row_meta`` vector — the
+    dense (row, accept-id) list is produced entirely on device, so a
+    two-phase readback ships 4·B meta bytes + 4·Σcounts id bytes
+    instead of the 4·flat_cap slab.  Shared by :func:`nfa_match` and
+    the pallas walk (:func:`~emqx_tpu.ops.pallas_match
+    .pallas_small_match_flat`) so both backends honor one readback
+    contract.  Returns ``(matches, mover, row_meta)``."""
+    K = max_matches
+    per_row = _compact(flat, K)                        # (B, K)
+    nk = jnp.minimum(n, K)
+    offs = jnp.cumsum(nk) - nk                         # (B,)
+    col = jnp.arange(K, dtype=jnp.int32)[None, :]
+    valid = col < nk[:, None]
+    idx = jnp.where(valid, offs[:, None] + col, flat_cap)
+    out = jnp.full((flat_cap,), -1, jnp.int32)
+    matches = out.at[idx.reshape(-1)].set(
+        per_row.reshape(-1), mode="drop")              # OOB dropped
+    # truncated rows: count exceeded K, or the segment ran past the
+    # global cap — both land in the fail-open set
+    mover = ((n > K) | (offs + nk > flat_cap)).astype(jnp.int32)
+    spilled = ((aover > 0) | (mover > 0)).astype(jnp.int32)
+    row_meta = nk | (spilled << ROW_META_SPILL_SHIFT)
+    return matches, mover, row_meta
+
+
+def _nfa_match(
     words,        # (B, D) int32
     lens,         # (B,) int32
     is_sys,       # (B,) bool
@@ -200,25 +276,15 @@ def nfa_match(
         jnp.sum(jnp.stack(spills), axis=0) if spills
         else jnp.zeros((B,), jnp.int32)
     )
+    row_meta = None
     if flat_cap:
-        # flat mode: per-row top-K compaction, then a GLOBAL cumsum-offset
-        # scatter into one (flat_cap,) buffer — readback shrinks from
-        # B·K·4 bytes to ~avg_fanout·4 bytes per topic, which is what the
-        # serving path is bound by on remote-attached devices (d2h
-        # latency/bandwidth, measured 2026-07-30: ~12.5 MB/s through the
-        # tunnel vs 1.4 GB/s h2d).
-        per_row = _compact(flat, K)                        # (B, K)
-        nk = jnp.minimum(n, K)
-        offs = jnp.cumsum(nk) - nk                         # (B,)
-        col = jnp.arange(K, dtype=jnp.int32)[None, :]
-        valid = col < nk[:, None]
-        idx = jnp.where(valid, offs[:, None] + col, flat_cap)
-        out = jnp.full((flat_cap,), -1, jnp.int32)
-        matches = out.at[idx.reshape(-1)].set(
-            per_row.reshape(-1), mode="drop")              # OOB dropped
-        # truncated rows: count exceeded K, or the segment ran past the
-        # global cap — both land in the fail-open set
-        mover = ((n > K) | (offs + nk > flat_cap)).astype(jnp.int32)
+        # flat mode: the fused compaction epilogue — readback shrinks
+        # from B·K·4 bytes to ~avg_fanout·4 bytes per topic, which is
+        # what the serving path is bound by on remote-attached devices
+        # (d2h latency/bandwidth, measured 2026-07-30: ~12.5 MB/s
+        # through the tunnel vs 1.4 GB/s h2d).
+        matches, mover, row_meta = flat_epilogue(
+            flat, n, aover, K, flat_cap)
     elif compact_output:
         matches = _compact(flat, K)                        # valids first
         mover = (n > K).astype(jnp.int32)
@@ -235,7 +301,32 @@ def nfa_match(
         n_matches=n,
         active_overflow=aover,
         match_overflow=mover,
+        row_meta=row_meta,
     )
+
+
+_MATCH_STATIC = ("active_slots", "max_matches", "compact_output",
+                 "flat_cap")
+
+#: the shipping entry point — one compilation per shape bucket
+nfa_match = jax.jit(_nfa_match, static_argnames=_MATCH_STATIC)
+
+#: pipelined-serving twin: the batch operands (words, lens, is_sys) are
+#: DONATED to the kernel (the ``_scatter_rows`` idiom — the dispatch
+#: consumes the uploaded buffers, so a double-buffered serve chain
+#: never holds two generations of encode buffers on device).  Table
+#: arrays are NOT donated: they serve every in-flight batch.
+nfa_match_donated = jax.jit(_nfa_match, static_argnames=_MATCH_STATIC,
+                            donate_argnums=(0, 1, 2))
+
+# a donated operand whose shape no kernel output can alias degrades to
+# a plain argument; XLA warns once per compile, which is noise on the
+# serve path (the donation is best-effort by design)
+import warnings as _warnings  # noqa: E402 — scoped to the filter below
+
+_warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable",
+    category=UserWarning)
 
 
 def build_matcher(active_slots: int = 16, max_matches: int = 32):

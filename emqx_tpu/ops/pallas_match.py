@@ -38,7 +38,8 @@ import numpy as np
 
 from .compiler import BUCKET_SLOTS
 
-__all__ = ["pallas_small_match", "supports_table", "bench_pallas_small"]
+__all__ = ["pallas_small_match", "pallas_small_match_flat",
+           "supports_table", "bench_pallas_small"]
 
 VMEM_BUDGET_BYTES = 8 << 20   # tables beyond this stay on nfa_match
 TILE_B = 256                  # batch rows per grid step
@@ -167,6 +168,34 @@ def pallas_small_match(words, lens, is_sys, node_tab, edge_tab, seeds,
         interpret=interpret,
     )(words, lens, is_sys, node_tab, edge_tab, seeds)
     return acc, aover
+
+
+@partial(jax.jit, static_argnames=("depth", "active_slots",
+                                   "max_matches", "flat_cap",
+                                   "interpret"))
+def pallas_small_match_flat(words, lens, is_sys, node_tab, edge_tab,
+                            seeds, *, depth: int, active_slots: int = 8,
+                            max_matches: int = 32, flat_cap: int,
+                            interpret: bool = False):
+    """Pallas walk + the SHARED flat compaction epilogue
+    (:func:`~emqx_tpu.ops.match_kernel.flat_epilogue`): the dense
+    (row, accept-id) list and the packed ``row_meta`` vector are
+    produced on device, so the match-proportional two-phase readback
+    contract holds identically for both kernel backends — the VMEM
+    walk fuses straight into the cumsum-offset scatter under one jit.
+    Returns the same :class:`~emqx_tpu.ops.match_kernel.MatchResult`
+    layout as ``nfa_match(flat_cap=...)``."""
+    from .match_kernel import MatchResult, flat_epilogue
+
+    acc, aover = pallas_small_match(
+        words, lens, is_sys, node_tab, edge_tab, seeds, depth=depth,
+        active_slots=active_slots, interpret=interpret)
+    n = jnp.sum((acc >= 0).astype(jnp.int32), axis=1)
+    matches, mover, row_meta = flat_epilogue(
+        acc, n, aover, max_matches, flat_cap)
+    return MatchResult(matches=matches, n_matches=n,
+                       active_overflow=aover, match_overflow=mover,
+                       row_meta=row_meta)
 
 
 def bench_pallas_small(n_filters: int = 50_000, batch: int = 8192,

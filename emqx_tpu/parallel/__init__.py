@@ -3,11 +3,19 @@
 from .mesh import make_mesh, pick_shape
 from .multihost import MultihostRuntime, dcn_env, hybrid_mesh_from
 from .prefix_ep import EpTables, build_ep_matcher, build_partitions, owner_of
-from .ring_fanout import build_ring_fanout, shard_bitmap_rows
+from .ring_fanout import (
+    build_ring_fanout,
+    build_ring_fanout_compact,
+    shard_bitmap_rows,
+)
 from .shared_group import build_shared_selector, host_pick, make_group_masks
 from .sharded_match import (
+    CompactFanoutResult,
     FanoutResult,
     build_sharded_matcher,
+    build_sharded_matcher_compact,
+    compact_bitmap_ids,
+    decode_compact_rows,
     make_accept_bitmap,
 )
 from .ulysses import (
@@ -23,13 +31,18 @@ __all__ = [
     "MultihostRuntime",
     "dcn_env",
     "hybrid_mesh_from",
+    "CompactFanoutResult",
     "FanoutResult",
     "build_sharded_matcher",
+    "build_sharded_matcher_compact",
+    "compact_bitmap_ids",
+    "decode_compact_rows",
     "make_accept_bitmap",
     "build_shared_selector",
     "make_group_masks",
     "host_pick",
     "build_ring_fanout",
+    "build_ring_fanout_compact",
     "shard_bitmap_rows",
     "EpTables",
     "build_partitions",

@@ -32,8 +32,10 @@ from ._shard_compat import shard_map
 from ..ops.compiler import NfaTable
 from ..ops.match_kernel import nfa_match
 
-__all__ = ["FanoutResult", "build_sharded_matcher", "make_accept_bitmap",
-           "or_accept_rows"]
+__all__ = ["CompactFanoutResult", "FanoutResult",
+           "build_sharded_matcher", "build_sharded_matcher_compact",
+           "compact_bitmap_ids", "decode_compact_rows",
+           "make_accept_bitmap", "or_accept_rows"]
 
 
 class FanoutResult(NamedTuple):
@@ -42,6 +44,23 @@ class FanoutResult(NamedTuple):
     n_matches: jax.Array    # (B,) int32 — matched filter count
     active_overflow: jax.Array  # (B,) int32 per-row spills (fail-open set)
     match_overflow: jax.Array   # (B,) int32 per-row 1 where count > K
+
+
+class CompactFanoutResult(NamedTuple):
+    """Dense-id fan-out (shard-locally compacted): what leaves the mesh
+    is proportional to MATCHES, not table width.  ``ids`` holds GLOBAL
+    subscriber ids (-1 padded) — each tp shard compacts its own bitmap
+    columns with the same popcount + prefix-scan gather the match
+    kernel's flat epilogue uses, and tp shards own disjoint subscriber
+    ranges, so the per-row union across tp segments is a plain
+    concatenation (no dedup pass)."""
+
+    ids: jax.Array          # (B, tp·cap_row) int32, ascending per segment
+    counts: jax.Array       # (B, tp) int32 — ids per tp segment
+    overflow: jax.Array     # (B, tp) int32 — 1 where a segment truncated
+    n_matches: jax.Array    # (B,) int32
+    active_overflow: jax.Array  # (B,) int32 (fail-open set)
+    match_overflow: jax.Array   # (B,) int32
 
 
 def make_accept_bitmap(
@@ -76,6 +95,113 @@ def or_accept_rows(accept_bitmap: jax.Array, matches: jax.Array) -> jax.Array:
     return jax.lax.reduce(
         rows, np.uint32(0), jax.lax.bitwise_or, (1,)
     )
+
+
+def compact_bitmap_ids(bitmap: jax.Array, cap_row: int,
+                       id_base=0) -> Tuple[jax.Array, jax.Array,
+                                           jax.Array]:
+    """Shard-local bitmap compaction: (B, W) uint32 → dense per-row
+    subscriber-id lists, entirely on device.
+
+    The same popcount + prefix-scan gather shape as the match kernel's
+    flat epilogue: expand set bits, cumsum positions within the row,
+    compare-scatter into a (B, cap_row) buffer (-1 padded, ascending).
+    ``id_base`` offsets local bit positions into the GLOBAL subscriber
+    id space (a tp shard passes its column offset).  Returns
+    ``(ids, counts, overflow)`` with overflow = 1 where a row's
+    popcount exceeded ``cap_row`` (fail-open set — the host re-runs
+    those rows against the full bitmap)."""
+    B, W = bitmap.shape
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = ((bitmap[:, :, None] >> shifts) & jnp.uint32(1)) \
+        .astype(jnp.int32).reshape(B, W * 32)               # (B, W·32)
+    sub = id_base + jnp.arange(W * 32, dtype=jnp.int32)     # global ids
+    n = jnp.sum(bits, axis=1)
+    pos = jnp.cumsum(bits, axis=1) - 1
+    pos = jnp.where(bits > 0, pos, cap_row)                 # OOB-drop
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], pos.shape)
+    out = jnp.full((B, cap_row), -1, jnp.int32)
+    ids = out.at[rows, pos].set(
+        jnp.broadcast_to(sub[None, :], pos.shape), mode="drop")
+    overflow = (n > cap_row).astype(jnp.int32)
+    return ids, n, overflow
+
+
+def decode_compact_rows(ids: np.ndarray, counts: np.ndarray,
+                        cap_row: int):
+    """Host decode of a :class:`CompactFanoutResult`: per-topic global
+    subscriber-id arrays, tp segments concatenated.  ``ids`` is
+    (B, tp·cap_row), ``counts`` (B, tp); segments are disjoint by
+    construction so no dedup is needed.  Truncated segments (overflow)
+    decode to their surviving prefix — callers re-run flagged rows."""
+    B, tp = counts.shape
+    out = []
+    for r in range(B):
+        segs = [ids[r, t * cap_row:t * cap_row
+                    + min(int(counts[r, t]), cap_row)]
+                for t in range(tp)]
+        out.append(np.concatenate(segs) if segs else
+                   np.empty(0, np.int32))
+    return out
+
+
+def build_sharded_matcher_compact(
+    mesh: Mesh,
+    cap_row: int = 64,
+    active_slots: int = 16,
+    max_matches: int = 32,
+):
+    """Dense-id twin of :func:`build_sharded_matcher`: each (dp, tp)
+    shard OR-assembles its bitmap slice locally, then COMPACTS it on
+    shard — the cross-chip output is per-topic dense global subscriber
+    ids + counts (4·(tp·cap_row + tp) bytes/topic, matches-proportional
+    with cap_row sized to the fan-out tail) instead of the full (B, W)
+    bitmap tile (W words/topic ≈ 1.2 MB/topic at 10M filters).  The
+    readback-side contract mirrors the serve plane's two-phase d2h:
+    counts first, then the dense segments."""
+    repl = P()
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("dp", None),  # words
+            P("dp"),        # lens
+            P("dp"),        # is_sys
+            repl, repl, repl,  # NFA arrays
+            P(None, "tp"),  # accept_bitmap columns
+        ),
+        out_specs=CompactFanoutResult(
+            ids=P("dp", "tp"),
+            counts=P("dp", "tp"),
+            overflow=P("dp", "tp"),
+            n_matches=P("dp"),
+            active_overflow=P("dp"),
+            match_overflow=P("dp"),
+        ),
+        check_vma=False,
+    )
+    def step(words, lens, is_sys, node_tab, edge_tab, seeds,
+             accept_bitmap):
+        res = nfa_match(
+            words, lens, is_sys, node_tab, edge_tab, seeds,
+            active_slots=active_slots, max_matches=max_matches,
+        )
+        bitmap = or_accept_rows(accept_bitmap, res.matches)  # (Bl, Wl)
+        # local columns → global subscriber ids: tp shard t owns words
+        # [t·Wl, (t+1)·Wl) of the padded bitmap row
+        base = jax.lax.axis_index("tp") * bitmap.shape[1] * 32
+        ids, n, over = compact_bitmap_ids(bitmap, cap_row, id_base=base)
+        return CompactFanoutResult(
+            ids=ids,
+            counts=n[:, None],
+            overflow=over[:, None],
+            n_matches=res.n_matches,
+            active_overflow=res.active_overflow,
+            match_overflow=res.match_overflow,
+        )
+
+    return jax.jit(step)
 
 
 def build_sharded_matcher(

@@ -458,6 +458,90 @@ def chaos_smoke() -> dict:
             "rebuild_served": bool(rebuilt_ok),
         }
 
+    async def pipeline_cycle():
+        """Overlapped-serve-pipeline chaos (ISSUE 11): a clean storm,
+        a storm with the match.readback child killed mid-flight, and a
+        10%-injected match.readback fault storm — delivery 1.0
+        throughout, waiters failing over to the CPU trie instead of
+        stalling toward the prefetch timeout, supervised restart
+        resumes the two-phase readback."""
+        import time as _time
+
+        from emqx_tpu import faultinject as fi
+        from emqx_tpu.broker.message import make_message
+        from emqx_tpu.config import Config
+        from emqx_tpu.faultinject import FaultInjector
+        from emqx_tpu.node import BrokerNode
+
+        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", True)
+        cfg.put("tpu.mirror_refresh_interval", 0.01)
+        cfg.put("tpu.bypass_rate", 0.0)
+        cfg.put("match.pipeline.enable", True)
+        cfg.put("supervisor.backoff_base", 0.005)
+        cfg.put("supervisor.backoff_max", 0.05)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            if ms is None:
+                return {"skipped": "match service unavailable"}
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            await settle(lambda: ms.ready and ms.dev.epoch == ms.inc.epoch,
+                         timeout=60)
+
+            async def storm(n, base, kill_at=None):
+                child = node.supervisor.lookup("match.readback")
+                waits = []
+                for i in range(n):
+                    topic = f"t/{base + i}/x"
+                    t0 = _time.perf_counter()
+                    await ms.prefetch(topic)
+                    waits.append(_time.perf_counter() - t0)
+                    b.publish(make_message(
+                        "pub", topic, b"%d" % (base + i)))
+                    if kill_at is not None and i == kill_at:
+                        child.kill()
+                return waits
+
+            n = 100
+            clean = await storm(n, 0)
+            killed = await storm(n, 1000, kill_at=40)
+            inj = fi.install(FaultInjector([
+                {"point": "match.readback", "action": "raise",
+                 "prob": 0.1, "times": 0}], seed=7))
+            wounded = await storm(n, 2000)
+            fi.uninstall()
+            sent = 3 * n
+            delivered = len(got)
+            restarts = node.observed.metrics.get(
+                "broker.supervisor.restarts")
+            worst = max(clean + killed + wounded)
+            rb_bytes = node.observed.metrics.get(
+                "tpu.match.readback_bytes")
+            return {
+                "ok": bool(delivered == sent and restarts >= 1
+                           and inj.fired.get("match.readback", 0) >= 1
+                           and worst < ms.prefetch_timeout_s * 0.9
+                           and rb_bytes > 0),
+                "delivered": delivered, "sent": sent,
+                "delivery_ratio": round(delivered / max(1, sent), 4),
+                "restarts": restarts,
+                "readback_faults": inj.fired.get("match.readback", 0),
+                "worst_waiter_ms": round(worst * 1e3, 1),
+                "readback_bytes": rb_bytes,
+                "cpu_fallback": node.observed.metrics.get(
+                    "broker.match.cpu_fallback"),
+            }
+        finally:
+            fi.uninstall()
+            await node.stop()
+
     async def all_cycles():
         return {
             "fanout": await fanout_cycle(),
@@ -465,6 +549,7 @@ def chaos_smoke() -> dict:
             "bridge": await bridge_cycle(),
             "exhook": await exhook_cycle(),
             "match": await match_cycle(),
+            "pipeline": await pipeline_cycle(),
             "segments": await segments_cycle(),
         }
 
@@ -486,7 +571,7 @@ def main(argv=None) -> dict:
         _qos1_e2e_size, _qos2_e2e_size, _table_lifecycle_size,
         bench_config1, bench_config1_sweep, bench_fanout_e2e,
         bench_qos1_e2e, bench_qos2_e2e, bench_serve_deadline_smoke,
-        bench_table_lifecycle,
+        bench_serve_pipeline_smoke, bench_table_lifecycle,
     )
 
     size = _fanout_e2e_size(args.smoke)
@@ -511,6 +596,12 @@ def main(argv=None) -> dict:
     # batching at the same offered load, CPU-jax tiny scale — tracks
     # structure + delivery per PR; the real ratio comes from bench.py
     out["serve_deadline"] = bench_serve_deadline_smoke(
+        seconds=(1.2 if args.smoke else 4.0))
+    # overlapped serve pipeline A/B (ISSUE 11): serial round trips vs
+    # the double-buffered chain with match-proportional two-phase
+    # readback, same offered load; gates ride the JSON with the
+    # host-dependent p99 bound (1-core hosts can't overlap stages)
+    out["serve_pipeline"] = bench_serve_pipeline_smoke(
         seconds=(1.2 if args.smoke else 4.0))
     # streaming table lifecycle A/B (ISSUE 9): segment cold start vs
     # full rebuild + churn soak across live compaction swaps

@@ -29,13 +29,19 @@ os.environ["JAX_ENABLE_X64"] = "0"
 # Tests that exercise it opt in with an explicit `tpu.enable = true`.
 os.environ.setdefault("EMQX_TPU__ENABLE", "false")
 
-
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: long-running (bench smoke, multihost) — excluded from "
         "tier-1 via -m 'not slow'",
     )
+    # Donated-operand kernels (the serve pipeline's nfa_match_donated)
+    # warn once per compile when a donated buffer can't be aliased —
+    # best-effort donation by design (match_kernel.py filters this in
+    # production; pytest's per-test filter reset needs the ini form).
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Some donated buffers were not usable")
 
 # This box's sitecustomize force-registers the TPU PJRT plugin and rewrites
 # jax_platforms to "axon,cpu" for every interpreter; env vars alone don't

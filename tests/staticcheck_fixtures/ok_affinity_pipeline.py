@@ -1,0 +1,25 @@
+"""Fixture: shard-affinity must NOT flag the disciplined pipeline
+shape — worker stages are pure compute against captured arguments;
+every broker/service write happens back on the event loop."""
+
+import asyncio
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}
+
+
+class MatchPipeline:
+    def __init__(self, broker):
+        self.broker = broker
+
+    async def dispatch(self, topics):
+        rows = await asyncio.to_thread(self._encode_worker, topics)
+        # loop side: minting the answer into broker state is legal here
+        self.broker.routes["hint"] = rows
+        return rows
+
+    def _encode_worker(self, topics):
+        # thread side: reads its arguments, writes nothing shared
+        return [t.upper() for t in topics]

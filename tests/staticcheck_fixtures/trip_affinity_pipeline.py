@@ -1,0 +1,28 @@
+"""Fixture: serve-pipeline worker threads (ISSUE 11) — a
+``to_thread``-entered pipeline stage touching Broker state MUST trip
+shard-affinity (1 finding).  Thread entry auto-seeds from the spawn
+site, so an UNSEEDED worker cannot slip a broker write past the
+analysis; the project-tree workers carry explicit AFFINITY_SEEDS facts
+on top (pure compute, writes stay on the loop)."""
+
+import asyncio
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}
+
+
+class MatchPipeline:
+    def __init__(self, broker):
+        self.broker = broker
+
+    async def dispatch(self, topics):
+        return await asyncio.to_thread(self._encode_worker, topics)
+
+    def _encode_worker(self, topics):
+        # (1) Broker state is main-loop-only: a pipeline worker thread
+        # must hand its results back to the loop, never write broker
+        # state directly
+        self.broker.routes["hint"] = topics
+        return [t.upper() for t in topics]

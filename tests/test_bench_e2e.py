@@ -66,6 +66,27 @@ def test_bench_e2e_smoke_delivers_everything():
     assert sd["static"]["served"] > 0, sd
     assert sd["deadline"]["served"] > 0, sd
     assert sd["deadline"]["batch_hist"], sd
+    # overlapped serve pipeline A/B (ISSUE 11): both sides served the
+    # offered storm at equal load; the pipelined side's two-phase
+    # readback held the 4·(B + sum(counts)) byte contract on EVERY
+    # batch (vs the serial 4·FLAT_MULT·B slab), throughput matched
+    # serial, and p99 stayed within the host-dependent bound recorded
+    # in the JSON (1.1x serial on multi-core; serial + depth pipeline
+    # cycles on a 1-core host where the stages cannot overlap)
+    sp = out["serve_pipeline"]
+    assert sp["serial"]["served"] > 0, sp
+    assert sp["pipeline"]["served"] > 0, sp
+    assert sp["pipeline"]["readback_bound_ok"], sp
+    assert sp["pipeline"]["readback_bytes_per_batch"] \
+        < sp["serial"]["readback_bytes_per_batch"], sp
+    assert sp["gate_readback_proportional"], sp
+    assert sp["gate_throughput_ge_serial"], sp
+    assert sp["gate_p99_no_worse"], sp
+    want_bound = "1.1x_serial" if (os.cpu_count() or 1) > 1 \
+        else "serial_plus_depth_cycles"
+    assert sp["p99_bound"] == want_bound, sp
+    assert sp["pipeline"]["readback_bytes_hist"], sp
+    assert sp["pipeline"]["stage_overlap_ms_hist"], sp
     # streaming table lifecycle A/B (ISSUE 9): segment cold start >=10x
     # the full rebuild at bench scale, arrays byte-identical after the
     # round trip, and the churn soak sustains mutations across >=1 live
@@ -97,6 +118,14 @@ def test_bench_e2e_smoke_delivers_everything():
     match = out["chaos"]["match"]
     assert match["delivery_ratio"] == 1.0, match
     assert match["breaker_tripped"] and match["breaker_recovered"], match
+    # serve-pipeline chaos (ISSUE 11): readback child killed mid-storm
+    # + 10% injected match.readback faults both hold delivery 1.0 with
+    # waiters failing over to the CPU trie, and the two-phase readback
+    # shipped real (non-slab) byte counts
+    pc = out["chaos"]["pipeline"]
+    assert pc["delivery_ratio"] == 1.0, pc
+    assert pc["readback_faults"] >= 1, pc
+    assert pc["readback_bytes"] > 0, pc
     # table-lifecycle chaos (ISSUE 9): swap fault + compact kill both
     # heal with delivery intact; a corrupt segment checksum-rejects and
     # the full rebuild serves

@@ -774,6 +774,127 @@ def test_chaos_match_breaker_cpu_serve_with_alarm_and_recovery():
 
 
 # ---------------------------------------------------------------------------
+# 7b. overlapped serve pipeline: match.readback child killed / wounded
+#     mid-publish-storm (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+async def _pipeline_storm(node, got, n, base, kill_at=None):
+    """Prefetch+publish storm through the pipelined serve plane; kills
+    target the match.readback child (the back half of the chain)."""
+    import time as _time
+
+    from emqx_tpu.broker.message import make_message
+
+    b = node.broker
+    ms = node.match_service
+    child = node.supervisor.lookup("match.readback")
+    waits = []
+    for i in range(n):
+        topic = f"t/{base + i}/x"
+        t0 = _time.perf_counter()
+        await ms.prefetch(topic)
+        waits.append(_time.perf_counter() - t0)
+        b.publish(make_message("pub", topic, b"%d" % (base + i)))
+        if kill_at is not None and i == kill_at:
+            assert child.kill()
+    return waits
+
+
+def test_chaos_pipeline_readback_kill_midstorm_delivery_holds():
+    """Kill the match.readback child mid-publish-storm (twice):
+    delivery_ratio stays 1.0, in-flight slot waiters fail over to the
+    CPU trie immediately (no prefetch-timeout stalls), and the
+    supervised restart resumes the two-phase readback."""
+
+    async def main():
+        node = await _start_match_node(**{
+            "match.deadline.enable": False,
+            "match.pipeline.enable": True,
+        })
+        try:
+            b = node.broker
+            ms = node.match_service
+            assert ms is not None and ms.pipeline
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(
+                lambda: ms.ready and ms.dev.epoch == ms.inc.epoch,
+                timeout=60)
+
+            n = 120
+            waits = await _pipeline_storm(node, got, n, 0, kill_at=40)
+            waits += await _pipeline_storm(node, got, n, 1000,
+                                           kill_at=70)
+            assert len(got) == 2 * n        # delivery_ratio 1.0
+            assert sorted(int(x) for x in got) == sorted(
+                list(range(n)) + list(range(1000, 1000 + n)))
+            assert max(waits) < ms.prefetch_timeout_s * 0.9, max(waits)
+            m = node.observed.metrics
+            assert m.get("broker.supervisor.restarts") >= 2
+            # the restarted child reads back from the device again —
+            # fresh hints mint and the two-phase byte counter advances
+            rb0 = m.get("tpu.match.readback_bytes")
+            await ms.prefetch("t/after/x")
+            assert ms.hint_routes("t/after/x") is not None
+            assert await until(
+                lambda: m.get("tpu.match.readback_bytes") >= rb0)
+            assert ms._inflight_n == 0
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_chaos_pipeline_injected_readback_faults_delivery_holds():
+    """10% injected match.readback faults through a pipelined publish
+    storm: delivery_ratio 1.0, every waiter failed over to the CPU trie
+    in one hop (no budget-length stalls), device serving resumes
+    between faults."""
+
+    async def main():
+        node = await _start_match_node(**{
+            "match.deadline.enable": False,
+            "match.pipeline.enable": True,
+        })
+        try:
+            b = node.broker
+            ms = node.match_service
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(
+                lambda: ms.ready and ms.dev.epoch == ms.inc.epoch,
+                timeout=60)
+            n = 150
+            clean = await _pipeline_storm(node, got, n, 0)
+            inj = faultinject.install(FaultInjector([
+                {"point": "match.readback", "action": "raise",
+                 "prob": 0.1, "times": 0},
+            ], seed=19))
+            try:
+                wounded = await _pipeline_storm(node, got, n, 2000)
+            finally:
+                faultinject.uninstall()
+            assert len(got) == 2 * n           # delivery_ratio 1.0
+            assert len(set(got)) == 2 * n      # exactly once
+            assert inj.fired.get("match.readback", 0) >= 1
+            assert max(wounded) < ms.prefetch_timeout_s * 0.9
+            m = node.observed.metrics
+            assert m.get("broker.match.cpu_fallback") >= 1
+            assert max(wounded) <= max(2.0 * max(clean), 0.1), (
+                max(clean), max(wounded))
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
 # 8. shard loop killed mid-QoS1 traffic (PR 6 connection-plane sharding)
 # ---------------------------------------------------------------------------
 

@@ -41,6 +41,41 @@ def test_pallas_parity_interpret():
         assert got == want or counts[i] > len(got)
 
 
+def test_pallas_flat_epilogue_parity_interpret():
+    """The SHARED flat compaction epilogue rides the pallas walk too
+    (ISSUE 11): pallas_small_match_flat produces the same dense flat
+    buffer + packed row_meta as nfa_match(flat_cap=...), so both
+    backends honor one two-phase readback contract."""
+    from emqx_tpu.ops.match_kernel import decode_flat, decode_row_meta
+    from emqx_tpu.ops.pallas_match import pallas_small_match_flat
+
+    t = compile_filters(FILTERS, depth=8, state_bucket=8)
+    words, lens, is_sys = encode_topics(t, TOPICS, batch=256)
+    args = (jnp.asarray(words), jnp.asarray(lens), jnp.asarray(is_sys),
+            *[jnp.asarray(a) for a in t.device_arrays()])
+    K = 8
+    cap = 8 * 256
+    ref = nfa_match(*args, active_slots=8, max_matches=K, flat_cap=cap)
+    got = pallas_small_match_flat(*args, depth=8, active_slots=8,
+                                  max_matches=K, flat_cap=cap,
+                                  interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref.n_matches),
+                                  np.asarray(got.n_matches))
+    np.testing.assert_array_equal(np.asarray(ref.row_meta),
+                                  np.asarray(got.row_meta))
+    # same per-row id SETS (slot order within a row may differ between
+    # backends; the epilogue's compaction is order-preserving per input
+    # layout, so compare decoded sets)
+    n1 = np.asarray(ref.n_matches)
+    rows_ref = decode_flat(np.asarray(ref.matches), n1, K)
+    rows_got = decode_flat(np.asarray(got.matches),
+                           np.asarray(got.n_matches), K)
+    nk, sp = decode_row_meta(np.asarray(got.row_meta))
+    for i in range(len(TOPICS)):
+        if not sp[i]:
+            assert set(rows_ref[i]) == set(rows_got[i]), i
+
+
 def test_pallas_rejects_ragged_batch():
     t = compile_filters(FILTERS, depth=8, state_bucket=8)
     words, lens, is_sys = encode_topics(t, TOPICS[:100], batch=100)

@@ -112,6 +112,11 @@ def test_waiver_file_has_no_silent_suppressions():
     # seeds GENERATED from _SHARD_LOCAL x handle_in dispatch facts: a
     # shard-legal handler can no longer silently miss its seed
     ("shard-affinity", "trip_affinity_gen.py", "ok_affinity_gen.py", 1),
+    # serve-pipeline worker threads (ISSUE 11): an unseeded to_thread
+    # pipeline stage writing Broker state trips; the pure-compute
+    # worker + loop-side-write shape passes
+    ("shard-affinity", "trip_affinity_pipeline.py",
+     "ok_affinity_pipeline.py", 1),
     ("torn-read", "trip_tornread.py", "ok_tornread.py", 2),
     ("lock-order", "trip_lockorder.py", "ok_lockorder.py", 1),
     ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
