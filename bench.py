@@ -724,6 +724,14 @@ def calibrate_serve(dev, table, topics, batch, depth=8,
     return done / (time.perf_counter() - t0)
 
 
+def _hist_parity_ok(hist_ms: float, np_ms: float) -> bool:
+    """Histogram-vs-np.percentile parity: the log2 sub-buckets bound
+    the relative error at ~1/16 per octave; 12% relative (plus a tiny
+    absolute floor for sub-ms values where scheduler noise dominates)
+    is the honest tolerance."""
+    return abs(hist_ms - np_ms) <= max(0.12 * abs(np_ms), 0.05)
+
+
 def _dl_buckets(batch: int) -> List[int]:
     """Padded-batch shapes the deadline harness may dispatch (pow2 from
     ``max(256, batch/32)`` up to ``batch``) — ALL warmed before the timed
@@ -758,12 +766,32 @@ async def serve_harness(dev, table, topics, batch, target_rate,
     flushes pad to the smallest pre-warmed pow2 shape, and the device
     pipeline depth drops to 2 (latency- over throughput-oriented).
     ``batch_hist`` (a dict) receives the achieved batch-size histogram
-    keyed by padded shape."""
-    lats: List[np.ndarray] = []
+    keyed by padded shape.
+
+    Latency accounting rides the PRODUCT's histograms (observe/hist.py
+    — same buckets, same percentile extraction the broker exports via
+    $SYS/REST/statsd) instead of a private parallel list: ``p50_ms``/
+    ``p99_ms`` are histogram-sourced, per-stage distributions ride the
+    ``stages`` dict, and ``p50_np_ms``/``p99_np_ms`` keep the legacy
+    ``np.percentile`` extraction over the SAME post-warmup samples so
+    the smoke can assert parity (``gate_hist_parity``).  The deadline
+    estimator mirrors the serve plane's SPLIT dispatch-vs-readback
+    estimate (combined EWMA as the cold fallback)."""
+    from emqx_tpu.observe.hist import LatencyHistogram
+
+    h_e2e = LatencyHistogram()
+    h_wait = LatencyHistogram()
+    h_disp = LatencyHistogram()
+    h_rb = LatencyHistogram()
+    np_lats: List[np.ndarray] = []   # same post-warmup subset (parity)
+    served = [0]
     n_topics = len(topics)
     spill_reruns = 0
     consumed = 0          # arrivals taken so far
     est = [0.005]         # EWMA dispatch→answer seconds (collector feeds)
+    est_d = [0.004]       # split: dispatch component (batcher feeds)
+    est_r = [0.001]       # split: readback component (collector feeds)
+    est_samples = [0]
     deadline_flushes = [0]
 
     buckets = _dl_buckets(batch) if deadline_ms is not None else [batch]
@@ -781,6 +809,15 @@ async def serve_harness(dev, table, topics, batch, target_rate,
     inflight_q: asyncio.Queue = asyncio.Queue(maxsize=inflight)
     stop_at = time.perf_counter() + seconds
     t0 = time.perf_counter()
+    # histograms (and the np parity subset) record only past the
+    # cold-start ramp — the time-based twin of the old len//4 trim
+    warm_at = t0 + seconds * 0.25
+
+    def _e2e_record(done_t: float, lat_arr: np.ndarray) -> None:
+        served[0] += len(lat_arr)
+        if done_t >= warm_at:
+            h_e2e.record_many_s(lat_arr)
+            np_lats.append(lat_arr)
 
     async def batcher():
         """Encode + dispatch; readback happens in collector so up to
@@ -800,6 +837,12 @@ async def serve_harness(dev, table, topics, batch, target_rate,
             oldest_age = now - (t0 + consumed / target_rate)
             if deadline_ms is not None:
                 budget = deadline_ms / 1e3
+                # the serve plane's split estimate: dispatch + readback
+                # components (fed where each stage runs) once warm, the
+                # combined EWMA as the cold fallback — queue-wait never
+                # pollutes the partial-flush trigger
+                est_eff = (est_d[0] + est_r[0] if est_samples[0] >= 8
+                           else est[0])
                 # budget term: arrivals the remaining budget can absorb.
                 # sustainability floor: a batch must at least cover the
                 # arrivals landing DURING one dispatch, or the loop
@@ -807,10 +850,10 @@ async def serve_harness(dev, table, topics, batch, target_rate,
                 # diverges — when the budget is infeasible at this load
                 # (est >= budget/2), throughput wins over the SLO.
                 bound = max(1, min(batch, max(
-                    int(target_rate * max(budget - est[0],
+                    int(target_rate * max(budget - est_eff,
                                           budget * 0.25)),
-                    int(target_rate * est[0] * 1.2))))
-                slack = budget - est[0] - oldest_age
+                    int(target_rate * est_eff * 1.2))))
+                slack = budget - est_eff - oldest_age
                 if avail < bound and slack > 0:
                     await asyncio.sleep(
                         min(max(slack / 4, 0.0005), 0.005))
@@ -833,15 +876,23 @@ async def serve_harness(dev, table, topics, batch, target_rate,
             names = [topics[(first + j) % n_topics] for j in range(take)]
             if engine == "device":
                 disp_t = time.perf_counter()
+                if disp_t >= warm_at:
+                    # match_wait analog: oldest arrival → dispatch start
+                    h_wait.record_s(
+                        max(0.0, disp_t - (t0 + first / target_rate)))
                 r = await asyncio.to_thread(
                     _dispatch, dev, table, names, depth, pad)
+                d_end = time.perf_counter()
+                est_d[0] = est_d[0] * 0.7 + (d_end - disp_t) * 0.3
+                if d_end >= warm_at:
+                    h_disp.record_s(d_end - disp_t)
                 await inflight_q.put((first, take, names, r, disp_t))
             else:  # cpu engine: the host trie answers the whole batch
                 await asyncio.to_thread(
                     lambda: [table.match_host(t) for t in names])
                 done_t = time.perf_counter()
                 arr_t = t0 + (first + np.arange(take)) / target_rate
-                lats.append(done_t - arr_t)
+                _e2e_record(done_t, done_t - arr_t)
         await inflight_q.put(None)
 
     async def collector():
@@ -851,8 +902,14 @@ async def serve_harness(dev, table, topics, batch, target_rate,
             if item is None:
                 return
             first, take, names, r, disp_t = item
+            rb0 = time.perf_counter()
             ids, rows = await asyncio.to_thread(
                 _readback, r, dev.max_matches)
+            rb1 = time.perf_counter()
+            est_r[0] = est_r[0] * 0.7 + (rb1 - rb0) * 0.3
+            est_samples[0] += 1
+            if rb1 >= warm_at:
+                h_rb.record_s(rb1 - rb0)
             rows = rows[rows < take]
             if len(rows):
                 spill_reruns += len(rows)
@@ -861,24 +918,46 @@ async def serve_harness(dev, table, topics, batch, target_rate,
             done_t = time.perf_counter()
             est[0] = est[0] * 0.7 + (done_t - disp_t) * 0.3
             arr_t = t0 + (first + np.arange(take)) / target_rate
-            lats.append(done_t - arr_t)
+            _e2e_record(done_t, done_t - arr_t)
 
     await asyncio.gather(batcher(), collector())
-    if not lats:
+    if not served[0]:
         return None
-    lat = np.concatenate(lats)
-    arr = lat[len(lat) // 4:]  # drop cold-start ramp
     out = {
         "offered_rate": int(target_rate),
-        "served": int(len(lat)),
-        "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
-        "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+        "served": served[0],
+        # histogram-sourced (the product's extraction); *_np_ms is the
+        # legacy np.percentile over the SAME post-warmup samples — the
+        # smoke asserts the two agree (gate_hist_parity)
+        "p50_ms": round(h_e2e.percentile_ms(50), 2),
+        "p99_ms": round(h_e2e.percentile_ms(99), 2),
         "spill_reruns": spill_reruns,
+        "stages": {
+            "match_wait": h_wait.to_dict(),
+            "match_dispatch": h_disp.to_dict(),
+            "match_readback": h_rb.to_dict(),
+        },
+        "hist": h_e2e.to_dict(),
     }
+    if np_lats:
+        arr = np.concatenate(np_lats)
+        p50np = float(np.percentile(arr, 50)) * 1e3
+        p99np = float(np.percentile(arr, 99)) * 1e3
+        out["p50_np_ms"] = round(p50np, 2)
+        out["p99_np_ms"] = round(p99np, 2)
+        out["gate_hist_parity"] = _hist_parity_ok(
+            out["p50_ms"], p50np) and _hist_parity_ok(
+            out["p99_ms"], p99np)
     if deadline_ms is not None:
         out["deadline_ms"] = deadline_ms
         out["deadline_flushes"] = deadline_flushes[0]
-        out["served_rate"] = int(len(lat) / max(seconds, 1e-9))
+        out["served_rate"] = int(served[0] / max(seconds, 1e-9))
+        # the split dispatch/readback estimates the deadline loop ran
+        # with (the ROADMAP dispatch-tax (c) closure, JSON-recorded)
+        out["est_dispatch_ms"] = round(est_d[0] * 1e3, 3)
+        out["est_readback_ms"] = round(est_r[0] * 1e3, 3)
+        out["est_combined_ms"] = round(est[0] * 1e3, 3)
+        out["est_split_warm"] = est_samples[0] >= 8
     return out
 
 
@@ -992,7 +1071,11 @@ async def serve_pipeline_harness(dev, table, topics, batch, target_rate,
     per-batch readback-bytes bound check."""
     import jax.numpy as jnp
 
-    lats: List[np.ndarray] = []
+    from emqx_tpu.observe.hist import LatencyHistogram
+
+    h_e2e = LatencyHistogram()
+    np_lats: List[np.ndarray] = []   # post-warmup parity subset
+    served = [0]
     enc_iv: List[tuple] = []   # encode+dispatch wall intervals
     rb_iv: List[tuple] = []    # readback wall intervals
     rb_hist: dict = {}         # readback bytes per batch (histogram)
@@ -1020,6 +1103,7 @@ async def serve_pipeline_harness(dev, table, topics, batch, target_rate,
     q: asyncio.Queue = asyncio.Queue(maxsize=max(1, inflight - 1))
     t0 = time.perf_counter()
     stop_at = t0 + seconds
+    warm_at = t0 + seconds * 0.25   # hist/parity record post-ramp only
 
     def next_batch(first):
         return [topics[(first + j) % n_topics] for j in range(batch)]
@@ -1076,7 +1160,11 @@ async def serve_pipeline_harness(dev, table, topics, batch, target_rate,
             bound_ok[0] = False
         done_t = time.perf_counter()
         arr_t = t0 + (first + np.arange(take)) / target_rate
-        lats.append(done_t - arr_t)
+        lat_arr = done_t - arr_t
+        served[0] += len(lat_arr)
+        if done_t >= warm_at:
+            h_e2e.record_many_s(lat_arr)
+            np_lats.append(lat_arr)
 
     async def collector():
         while True:
@@ -1096,22 +1184,35 @@ async def serve_pipeline_harness(dev, table, topics, batch, target_rate,
     else:
         await batcher()
         q.get_nowait()   # drain the sentinel
-    if not lats:
+    if not served[0]:
         return None
-    lat = np.concatenate(lats)
-    arr = lat[len(lat) // 4:]
     # stage overlap: ms of each encode interval spent while some
     # readback was in flight — the pipelining evidence (serial ≈ 0)
     ov_hist: dict = {}
     for iv in enc_iv:
         _hist_add(ov_hist, round(_overlap_ms(iv, rb_iv), 1))
+    # per-stage latency distributions from the PRODUCT's histogram
+    # buckets (post-warmup intervals) — one definition with the broker
+    h_disp = LatencyHistogram()
+    h_rb = LatencyHistogram()
+    for a, b in enc_iv:
+        if a >= warm_at:
+            h_disp.record_s(b - a)
+    for a, b in rb_iv:
+        if a >= warm_at:
+            h_rb.record_s(b - a)
     n_batches = max(1, len(enc_iv))
-    return {
+    out = {
         "offered_rate": int(target_rate),
-        "served": int(len(lat)),
-        "served_rate": int(len(lat) / max(seconds, 1e-9)),
-        "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 2),
-        "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 2),
+        "served": served[0],
+        "served_rate": int(served[0] / max(seconds, 1e-9)),
+        "p50_ms": round(h_e2e.percentile_ms(50), 2),
+        "p99_ms": round(h_e2e.percentile_ms(99), 2),
+        "hist": h_e2e.to_dict(),
+        "stages": {
+            "match_dispatch": h_disp.to_dict(),
+            "match_readback": h_rb.to_dict(),
+        },
         "dispatch_mean_ms": round(
             float(np.mean([b - a for a, b in enc_iv])) * 1e3, 2),
         "readback_mean_ms": round(
@@ -1126,6 +1227,16 @@ async def serve_pipeline_harness(dev, table, topics, batch, target_rate,
         "stage_overlap_ms_hist": ov_hist,
         "readback_bound_ok": bound_ok[0],
     }
+    if np_lats:
+        arr = np.concatenate(np_lats)
+        p50np = float(np.percentile(arr, 50)) * 1e3
+        p99np = float(np.percentile(arr, 99)) * 1e3
+        out["p50_np_ms"] = round(p50np, 2)
+        out["p99_np_ms"] = round(p99np, 2)
+        out["gate_hist_parity"] = _hist_parity_ok(
+            out["p50_ms"], p50np) and _hist_parity_ok(
+            out["p99_ms"], p99np)
+    return out
 
 
 def bench_serve_pipeline(dev, table, topics, batch, offered_rate,

@@ -87,12 +87,18 @@ from typing import Any, Deque, Dict, List, Optional
 
 from .. import faultinject as _fi
 from .. import topic as T
+from ..observe.flightrec import STAGES as _FR_STAGES
 from .broker import DeliverResult
 from .message import Message
 
 log = logging.getLogger(__name__)
 
 __all__ = ["FanoutPipeline"]
+
+# packed flight-recorder stage ids (observe/flightrec.py STAGES)
+_SID_QUEUE = _FR_STAGES.index("fanout_queue")
+_SID_DELIVER = _FR_STAGES.index("deliver")
+_SID_FLUSH = _FR_STAGES.index("flush")
 
 
 class FanoutPipeline:
@@ -112,6 +118,8 @@ class FanoutPipeline:
         supervisor: Any = None,
         olp: Any = None,
         deferred_cap: int = 4096,
+        hists: Any = None,
+        flightrec: Any = None,
     ) -> None:
         self.broker = broker
         self.metrics = metrics
@@ -159,6 +167,25 @@ class FanoutPipeline:
         # lifetime accounting (also mirrored into metrics when attached)
         self.batches = 0
         self.msgs = 0
+        # stage-level latency observatory (observe/hist.py): direct
+        # histogram references, None = zero-call recording sites.  All
+        # four are written by the drain loop (main plane, one writer).
+        self.hists = hists
+        self._h_queue = self._h_deliver = None
+        self._h_flush = self._h_e2e = None
+        if hists is not None:
+            self._h_queue = hists.hist("obs.stage.fanout_queue")
+            self._h_deliver = hists.hist("obs.stage.deliver")
+            self._h_flush = hists.hist("obs.stage.flush")
+            self._h_e2e = hists.hist("obs.e2e.publish_deliver")
+        # queue-head arrival stamp for the fanout_queue span: set when
+        # a message lands in an EMPTY queue, re-armed at each batch pop
+        # — per-batch oldest-wait without a parallel timestamp deque
+        # (deferred re-queues and cancel-requeues stay approximate)
+        self._q_head_ns = 0
+        self.flightrec = flightrec
+        self._ring = (flightrec.ring("fanout")
+                      if flightrec is not None else None)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -287,6 +314,8 @@ class FanoutPipeline:
                 if self.metrics is not None:
                     self.metrics.inc("broker.fanout.shape_bypass")
                 return False
+        if self._h_queue is not None and not self._q:
+            self._q_head_ns = time.perf_counter_ns()
         self._q.append(msg)
         self._track(msg)
         self._wake.set()
@@ -376,6 +405,17 @@ class FanoutPipeline:
             n = min(len(self._q), bound)
             popleft = self._q.popleft
             batch = [popleft() for _ in range(n)]
+            if self._h_queue is not None:
+                # fanout_queue span: oldest queue wait for this batch
+                # (head stamp → pop), re-armed for the remaining queue
+                now_ns = time.perf_counter_ns()
+                head = self._q_head_ns
+                if head:
+                    self._h_queue.record(now_ns - head)
+                    if self._ring is not None:
+                        self._ring.push(_SID_QUEUE, head,
+                                        now_ns - head, n)
+                self._q_head_ns = now_ns if self._q else 0
             if self._q:
                 self._wake.set()
             self._busy = True
@@ -600,6 +640,9 @@ class FanoutPipeline:
         sessions = broker.sessions
         delivered_taps = hooks.has("message.delivered")
         bmetrics = broker.metrics
+        h_e2e = self._h_e2e
+        t4 = time.perf_counter_ns() if self._h_deliver is not None else 0
+        now_wall = time.time() if h_e2e is not None else 0.0
         for clientid, effs in plan.items():
             sess = sessions.get(clientid)
             if sess is None:
@@ -618,6 +661,13 @@ class FanoutPipeline:
                 res.matched += n_sends
                 if bmetrics is not None:
                     bmetrics.inc("messages.delivered", n_sends)
+                if h_e2e is not None:
+                    # publish→deliver e2e, SAMPLED once per session per
+                    # chunk on the oldest leg (the legs of one deliver
+                    # share a batch window, so per-leg recording would
+                    # pay per-message cost for sub-window resolution);
+                    # SlowSubs records per leg when enabled
+                    h_e2e.record_s(now_wall - sends[0].msg.timestamp)
                 bucket = out.get(clientid)
                 if bucket is None:
                     out[clientid] = sends
@@ -629,9 +679,17 @@ class FanoutPipeline:
             for d in dropped:
                 hooks.run("message.dropped", (d, "queue_full"))
         # -- stage 5: bulk flush — ONE emit per client per batch
+        t5 = time.perf_counter_ns() if self._h_deliver is not None else 0
         emit = broker.emit
         for clientid, pubs in out.items():
             emit(clientid, pubs)
+        if self._h_deliver is not None:
+            t6 = time.perf_counter_ns()
+            self._h_deliver.record(t5 - t4)
+            self._h_flush.record(t6 - t5)
+            if self._ring is not None:
+                self._ring.push(_SID_DELIVER, t4, t5 - t4, len(msgs))
+                self._ring.push(_SID_FLUSH, t5, t6 - t5, len(out))
 
     # ------------------------------------------------------------------
 

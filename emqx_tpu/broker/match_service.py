@@ -156,12 +156,20 @@ import numpy as np
 
 from .. import faultinject as _fi
 from .. import topic as T
+from ..observe.flightrec import STAGES as _FR_STAGES
 from ..ops.kernel_cache import CompileMiss
 from .trie import FilterTrie
 
 log = logging.getLogger(__name__)
 
 __all__ = ["MatchService"]
+
+
+# packed flight-recorder stage ids (observe/flightrec.py STAGES)
+_SID_WAIT = _FR_STAGES.index("match_wait")
+_SID_ENCODE = _FR_STAGES.index("match_encode")
+_SID_DISPATCH = _FR_STAGES.index("match_dispatch")
+_SID_READBACK = _FR_STAGES.index("match_readback")
 
 
 class _StaleRace(RuntimeError):
@@ -282,6 +290,8 @@ class MatchService:
         compact_min_mutations: int = 1024,
         dirty_threshold: float = 0.5,
         prewarm: bool = True,
+        hists: Any = None,
+        flightrec: Any = None,
     ) -> None:
         from ..ops import IncrementalNfa
         from ..ops.device_table import DeviceNfa
@@ -415,10 +425,44 @@ class MatchService:
         self._short_frac: Optional[float] = None
         self._win_short = 0
         self._est_dispatch_s = 0.005
+        # split dispatch-vs-readback estimate (ROADMAP dispatch-tax
+        # residual (c)): the combined EWMA above times the WHOLE
+        # t0→resolve span, which in pipeline mode includes time a slot
+        # sits queued for readback — queue-wait polluting the
+        # partial-flush trigger.  The split components are fed from the
+        # stage timers where each stage actually runs (encode+dispatch
+        # in the worker thread, readback in the readback worker), so
+        # their sum is the true device round trip.  The combined
+        # estimate stays as the fallback while the split is cold.
+        self._est_disp_s = 0.004
+        self._est_rb_s = 0.001
+        self._est_split_samples = 0
         self._breaker_failures = 0
         self._breaker_open = False
         self._probe_child: Any = None
         self._last_brownout = 0
+
+        # stage-level latency observatory (observe/hist.py): direct
+        # histogram references, None = zero-call recording sites.  The
+        # match_* histograms are written by the (single in-flight)
+        # worker-thread stages; match_wait by the serve loop — one
+        # writer per histogram, merged at read time.
+        self.hists = hists
+        self._h_wait = self._h_encode = None
+        self._h_dispatch = self._h_readback = None
+        if hists is not None:
+            self._h_wait = hists.hist("obs.stage.match_wait")
+            self._h_encode = hists.hist("obs.stage.match_encode")
+            self._h_dispatch = hists.hist("obs.stage.match_dispatch")
+            self._h_readback = hists.hist("obs.stage.match_readback")
+        # always-on flight recorder (observe/flightrec.py): per-writer
+        # event rings + the breaker/brownout dump triggers
+        self.flightrec = flightrec
+        self._ring_loop = self._ring_disp = self._ring_rb = None
+        if flightrec is not None:
+            self._ring_loop = flightrec.ring("match.serve")
+            self._ring_disp = flightrec.ring("match.encode")
+            self._ring_rb = flightrec.ring("match.readback")
 
         self.router.listeners.append(self._on_router_mutation)
 
@@ -1099,7 +1143,15 @@ class MatchService:
                     self.metrics.inc("tpu.match.bypass")
                 return
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._pending.append((topic, fut))
+            # the match_wait stamp rides as the LAST element (only when
+            # histograms are on — entries stay 2-tuples otherwise);
+            # every consumer indexes from the front, and the deadline
+            # accounting below is mode-gated, so the extra element is
+            # invisible outside the histogram record
+            if self._h_wait is not None:
+                self._pending.append((topic, fut, time.perf_counter_ns()))
+            else:
+                self._pending.append((topic, fut))
             self._batch_wake.set()
             try:
                 await asyncio.wait_for(fut, self.prefetch_timeout_s)
@@ -1127,7 +1179,13 @@ class MatchService:
             return
         loop = asyncio.get_running_loop()
         fut2: asyncio.Future = loop.create_future()
-        self._pending.append((topic, fut2, loop.time() + self.deadline_s))
+        if self._h_wait is not None:
+            self._pending.append((topic, fut2,
+                                  loop.time() + self.deadline_s,
+                                  time.perf_counter_ns()))
+        else:
+            self._pending.append(
+                (topic, fut2, loop.time() + self.deadline_s))
         self._batch_wake.set()
         try:
             await asyncio.wait_for(fut2, self.prefetch_timeout_s)
@@ -1170,7 +1228,12 @@ class MatchService:
                 shed += 1   # brownout stage 2: QoS0 rides the CPU trie
                 continue
             fut = loop.create_future()
-            if deadline:
+            if self._h_wait is not None:
+                ts = time.perf_counter_ns()
+                self._pending.append(
+                    (topic, fut, deadline_t, ts) if deadline
+                    else (topic, fut, ts))
+            elif deadline:
                 self._pending.append((topic, fut, deadline_t))
             else:
                 self._pending.append((topic, fut))
@@ -1318,9 +1381,13 @@ class MatchService:
         from ..ops import encode_batch
 
         handles = []
+        enc_ns = disp_ns = 0
+        gen = self._table_gen
         for idx, d in groups:
+            t0 = time.perf_counter_ns()
             enc = encode_batch(inc, [topics[i] for i in idx],
                                batch=_bucket(len(idx)), depth=d)
+            t1 = time.perf_counter_ns()
             res = dev.match(
                 *enc, flat_cap=self.FLAT_MULT * enc[0].shape[0],
                 # serving never parks behind XLA: an uncompiled shape
@@ -1328,16 +1395,33 @@ class MatchService:
                 # the background) instead of stalling the batch
                 block_compile=(dev.kernel_cache is None),
                 donate_inputs=donate)
+            t2 = time.perf_counter_ns()
+            enc_ns += t1 - t0
+            disp_ns += t2 - t1
+            # stage spans: this worker is the single in-flight encode
+            # stage, so it is the sole writer of these two histograms
+            # and its flight-recorder ring
+            if self._h_encode is not None:
+                self._h_encode.record(t1 - t0)
+                self._h_dispatch.record(t2 - t1)
+            if self._ring_disp is not None:
+                self._ring_disp.push(_SID_ENCODE, t0, t1 - t0,
+                                     len(idx), gen)
+                self._ring_disp.push(_SID_DISPATCH, t1, t2 - t1,
+                                     len(idx), gen)
             handles.append((res, len(idx)))
-        return handles
+        return handles, enc_ns, disp_ns
 
     def _readback_groups(self, handles, dev, proportional):
         """WORKER-THREAD stage: block on every group's d2h.  Serial
         (flag-off) mode reads the full flat slab exactly as PR 10 did;
         ``proportional`` (pipeline mode) rides the two-phase contract.
-        Returns ``([(rows, spilled)...], total d2h bytes)``."""
+        Returns ``([(rows, spilled)...], total d2h bytes, readback
+        ns)``."""
         out = []
         nbytes = 0
+        t0 = time.perf_counter_ns()
+        total = 0
         for res, n in handles:
             if proportional:
                 rows, sp, b = self._readback_rows_twophase(
@@ -1348,8 +1432,17 @@ class MatchService:
                 # overflow vectors (what device_get above shipped)
                 b = 4 * int(res.matches.size + 3 * res.n_matches.size)
             nbytes += b
+            total += n
             out.append((rows, sp))
-        return out, nbytes
+        rb_ns = time.perf_counter_ns() - t0
+        # single writer: the flag-off serve loop's to_thread hop OR the
+        # pipelined readback child — never both in one mode
+        if self._h_readback is not None:
+            self._h_readback.record(rb_ns)
+        if self._ring_rb is not None:
+            self._ring_rb.push(_SID_READBACK, t0, rb_ns, total,
+                               self._table_gen)
+        return out, nbytes, rb_ns
 
     def _depth_groups(self, topics: List[str]) -> List[Tuple[List[int], int]]:
         """Partition batch indices into (indices, kernel_depth) groups.
@@ -1394,9 +1487,37 @@ class MatchService:
         finally:
             self._fail_over_waiters()
 
+    def _rec_wait(self, pending: List[Any]) -> None:
+        """Record each popped waiter's queue wait (enqueue → dispatch
+        start) + one flight-recorder event per batch.  Only reachable
+        with histograms on — the stamps ride the waiter tuples' tail."""
+        h = self._h_wait
+        if h is None or not pending:
+            return
+        now_ns = time.perf_counter_ns()
+        rec = h.record
+        oldest = now_ns
+        n = 0
+        for p in pending:
+            ts = p[-1]
+            # the stamp is an int (perf_counter_ns); a deadline tail is
+            # a float and a bare test-injected waiter ends in a future —
+            # neither is a stamp, and recording must never be the thing
+            # that kills the serve loop
+            if type(ts) is not int:
+                continue
+            rec(now_ns - ts)
+            n += 1
+            if ts < oldest:
+                oldest = ts
+        if n and self._ring_loop is not None:
+            self._ring_loop.push(_SID_WAIT, oldest, now_ns - oldest,
+                                 n, self._table_gen)
+
     async def _serve_batch(self, pending: List[Any]) -> None:
         """Fixed-window dispatch: device rows → hints, any failure
         resolves the waiters empty-handed (host trie serves)."""
+        self._rec_wait(pending)
         if self.pipeline:
             await self._pipeline_dispatch(pending, deadline_mode=False)
             return
@@ -1466,13 +1587,14 @@ class MatchService:
         reuses0 = inc.aid_reuses
         gen0 = self._table_gen
         groups = self._depth_groups(topics)
-        handles = await asyncio.to_thread(
+        handles, enc_ns, disp_ns = await asyncio.to_thread(
             self._encode_dispatch, inc, dev, topics, groups, False
         )
         await self._readback_gate()
-        results, nbytes = await asyncio.to_thread(
+        results, nbytes, rb_ns = await asyncio.to_thread(
             self._readback_groups, handles, dev, False
         )
+        self._note_split((enc_ns + disp_ns) / 1e9, rb_ns / 1e9)
         if self.metrics is not None:
             self.metrics.inc("tpu.match.readback_bytes", nbytes)
         return self._collect_rows(topics, groups, results,
@@ -1562,6 +1684,32 @@ class MatchService:
         if late:
             self.metrics.inc("broker.match.deadline_miss", late)
 
+    def _note_split(self, disp_s: float, rb_s: float) -> None:
+        """Feed the split dispatch-vs-readback estimate from the stage
+        timers: ``disp_s`` is the worker-thread encode+dispatch span,
+        ``rb_s`` the d2h readback span — neither includes queue-wait,
+        which the combined ``_est_dispatch_s`` EWMA picks up in
+        pipeline mode (slots sit in the inflight queue inside its
+        t0→resolve window)."""
+        self._est_disp_s = self._est_disp_s * 0.7 + disp_s * 0.3
+        self._est_rb_s = self._est_rb_s * 0.7 + rb_s * 0.3
+        if self._est_split_samples < 1 << 30:
+            self._est_split_samples += 1
+
+    #: split-estimate warm threshold: below this many component
+    #: samples the combined EWMA serves (the histograms/timers are
+    #: cold right after start or a long idle gap)
+    SPLIT_WARM = 8
+
+    def _dispatch_est(self) -> float:
+        """The dispatch-time estimate the partial-flush trigger and the
+        adaptive bound subtract from the budget: the split components'
+        sum once warm (queue-wait-free), the combined EWMA as the cold
+        fallback."""
+        if self._est_split_samples >= self.SPLIT_WARM:
+            return self._est_disp_s + self._est_rb_s
+        return self._est_dispatch_s
+
     def _fail_over_waiters(self) -> None:
         """Serve-loop death (kill, crash, stop): resolve every in-flight
         waiter NOW so each blocked ``prefetch`` falls to the CPU path
@@ -1602,7 +1750,7 @@ class MatchService:
                         continue
                     bound = self._deadline_bound()
                     slack = (self._pending[0][2] - loop.time()
-                             - self._est_dispatch_s)
+                             - self._dispatch_est())
                     if len(self._pending) < bound and slack > 0:
                         # gather window: admit more arrivals, but never
                         # wait past the oldest waiter's budget; geometric
@@ -1636,11 +1784,12 @@ class MatchService:
         1+ shrinks the cap (half, then quarter)."""
         rate = (self._rate_ewma if self._rate_ewma is not None
                 else self._last_rate)
-        headroom = max(self.deadline_s - self._est_dispatch_s,
+        est = self._dispatch_est()
+        headroom = max(self.deadline_s - est,
                        self.deadline_s * 0.25)
         bound = max(1, min(self.max_batch,
                            max(int(rate * headroom),
-                               int(rate * self._est_dispatch_s * 1.2))))
+                               int(rate * est * 1.2))))
         lvl = self._brownout()
         if lvl:
             bound = max(1, bound >> min(lvl, 2))
@@ -1693,6 +1842,7 @@ class MatchService:
         the CPU tables immediately and feeds the circuit breaker."""
         if not pending:
             return
+        self._rec_wait(pending)
         if self.pipeline:
             await self._pipeline_dispatch(pending, deadline_mode=True)
             return
@@ -1791,12 +1941,13 @@ class MatchService:
             dispatch = asyncio.to_thread(
                 self._encode_dispatch, inc, dev, topics, groups, True)
             if deadline_mode:
-                handles = await asyncio.wait_for(
+                handles, enc_ns, disp_ns = await asyncio.wait_for(
                     dispatch, self.dispatch_timeout_s)
             else:
-                handles = await dispatch
+                handles, enc_ns, disp_ns = await dispatch
             slot = (pending, topics, groups, handles, inc, dev,
-                    reuses0, gen0, epoch, rule_gen, t0, deadline_mode)
+                    reuses0, gen0, epoch, rule_gen, t0, deadline_mode,
+                    enc_ns + disp_ns)
             await self._inflight_q.put(slot)   # backpressure at depth
             self._inflight_n += 1
             self._set_inflight_metric()
@@ -1839,14 +1990,15 @@ class MatchService:
         slot's batch from the CPU tables.  The finally backstop keeps
         the kill path from stranding waiters on the prefetch timeout."""
         (pending, topics, groups, handles, inc, dev, reuses0, gen0,
-         epoch, rule_gen, t0, deadline_mode) = slot
+         epoch, rule_gen, t0, deadline_mode, dispatch_ns) = slot
         try:
             try:
                 await self._readback_gate()
-                results, nbytes = await asyncio.wait_for(
+                results, nbytes, rb_ns = await asyncio.wait_for(
                     asyncio.to_thread(
                         self._readback_groups, handles, dev, True),
                     self.dispatch_timeout_s)
+                self._note_split(dispatch_ns / 1e9, rb_ns / 1e9)
                 if self.metrics is not None:
                     self.metrics.inc("tpu.match.readback_bytes", nbytes)
                 rows = self._collect_rows(topics, groups, results,
@@ -1914,6 +2066,11 @@ class MatchService:
         olp = self.olp
         lvl = 0 if olp is None else olp.brownout_level()
         if lvl != self._last_brownout:
+            if lvl > self._last_brownout and self.flightrec is not None:
+                # brownout ESCALATION: capture what the last few
+                # hundred batches were doing when the ladder stepped
+                # (de-escalation is recovery, nothing to forensic)
+                self.flightrec.dump("brownout")
             self._last_brownout = lvl
             if self.metrics is not None:
                 self.metrics.set("broker.match.brownout_level", lvl)
@@ -1946,6 +2103,10 @@ class MatchService:
                 {"failures": self._breaker_failures},
                 "device match dispatch failing; serving from CPU trie",
             )
+        if self.flightrec is not None:
+            # the forensic payoff: what the serve path was doing for
+            # the last few hundred batches before the trip
+            self.flightrec.dump("breaker_trip")
         sup = getattr(self, "supervisor", None)
         if sup is not None:
             # supervised recovery child: a crashing probe restarts per
@@ -2024,6 +2185,13 @@ class MatchService:
             "breaker_failures": self._breaker_failures,
             "brownout": self._last_brownout,
             "est_dispatch_ms": round(self._est_dispatch_s * 1e3, 3),
+            # the split components (satellite of ROADMAP dispatch-tax
+            # (c)): what the partial-flush trigger actually subtracts
+            # once warm, and whether it is warm
+            "est_disp_ms": round(self._est_disp_s * 1e3, 3),
+            "est_readback_ms": round(self._est_rb_s * 1e3, 3),
+            "est_split_warm": (
+                self._est_split_samples >= self.SPLIT_WARM),
             "pending": len(self._pending),
             "segments": ({
                 "dir": self.segments_dir,

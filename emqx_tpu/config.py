@@ -302,6 +302,13 @@ SCHEMA: Dict[str, Field] = {
     "statsd.enable": Field(False, _bool),
     "statsd.server": Field("127.0.0.1:8125", str),
     "statsd.flush_interval": Field(30.0, duration),
+    # stage-level latency observatory (observe/hist.py): per-stage
+    # log2-bucket histograms on every plane.  Off = recording sites are
+    # zero-call (the faultinject idiom); on costs one subtract + one
+    # index per record.  The flight recorder (observe/flightrec.py) is
+    # ALWAYS on — depth bounds each plane's preallocated event ring.
+    "obs.hist.enable": Field(True, _bool),
+    "obs.flightrec.depth": Field(4096, int, lambda v: 64 <= v <= 1 << 20),
     "telemetry.enable": Field(False, _bool),
     "telemetry.url": Field("", str),
     "telemetry.interval": Field(604800.0, duration),

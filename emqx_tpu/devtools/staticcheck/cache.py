@@ -44,7 +44,8 @@ def environment_digest(rule_names, registries=None,
     h.update(";".join(sorted(rule_names)).encode())
     if registries is not None:
         for names in (registries.metric_names, registries.config_keys,
-                      registries.fault_points, registries.hook_points):
+                      registries.fault_points, registries.hook_points,
+                      registries.hist_names, registries.dump_reasons):
             h.update(";".join(sorted(names)).encode())
             h.update(b"|")
     policy = os.path.join(os.path.dirname(os.path.abspath(__file__)),

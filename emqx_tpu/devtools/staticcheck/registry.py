@@ -11,7 +11,13 @@ checker works on a broken tree and never executes runtime code:
   ``emqx_tpu/faultinject.py`` (the scenario-table vocabulary);
 * hook points — the ``HOOK_POINTS`` list in
   ``emqx_tpu/broker/hooks.py`` (a typo'd ``hooks.add``/``run`` name
-  silently never fires — the chain dispatch is by exact string).
+  silently never fires — the chain dispatch is by exact string);
+* histogram names — the ``HIST_NAMES`` list in
+  ``emqx_tpu/observe/hist.py`` (a typo'd ``.hist("...")`` lookup
+  raises KeyError at a cold setup site nothing may exercise);
+* flight-recorder dump reasons — the ``DUMP_REASONS`` tuple in
+  ``emqx_tpu/observe/flightrec.py`` (an undeclared reason raises at
+  the trigger site — which is the breaker-trip path).
 """
 
 from __future__ import annotations
@@ -41,11 +47,16 @@ class Registries:
 
     def __init__(self, metric_names: Set[str], config_keys: Set[str],
                  fault_points: Set[str],
-                 hook_points: Optional[Set[str]] = None) -> None:
+                 hook_points: Optional[Set[str]] = None,
+                 hist_names: Optional[Set[str]] = None,
+                 dump_reasons: Optional[Set[str]] = None) -> None:
         self.metric_names = metric_names
         self.config_keys = config_keys
         self.fault_points = fault_points
         self.hook_points = hook_points if hook_points is not None else set()
+        self.hist_names = hist_names if hist_names is not None else set()
+        self.dump_reasons = (dump_reasons if dump_reasons is not None
+                             else set())
 
     @classmethod
     def load(cls, package_root: Optional[str] = None) -> "Registries":
@@ -64,7 +75,28 @@ class Registries:
                 os.path.join(package_root, "faultinject.py")),
             hook_points=cls._hook_points(
                 os.path.join(package_root, "broker", "hooks.py")),
+            hist_names=cls._named_list(
+                os.path.join(package_root, "observe", "hist.py"),
+                "HIST_NAMES"),
+            dump_reasons=cls._named_list(
+                os.path.join(package_root, "observe", "flightrec.py"),
+                "DUMP_REASONS"),
         )
+
+    @staticmethod
+    def _named_list(path: str, varname: str) -> Set[str]:
+        """String elements of a top-level ``varname = [...]`` (or
+        tuple) assignment — the HIST_NAMES / DUMP_REASONS shape."""
+        for node in _parse(path).body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if any(isinstance(t, ast.Name) and t.id == varname
+                       for t in targets) and node.value is not None:
+                    names = _str_elements(node.value)
+                    if names:
+                        return names
+        raise RuntimeError(f"no {varname} found in {path}")
 
     @staticmethod
     def _metric_names(path: str) -> Set[str]:

@@ -21,7 +21,13 @@ cold path nothing exercises (metrics/config) or silently never fires
 * ``alarms.deactivate("name")`` → some ``alarms.activate`` with a
   matching name (f-string prefixes compared prefix-wise), anywhere in
   the tree — a deactivate that can never match leaks the alarm active
-  forever.
+  forever;
+* ``hists.hist("name")`` → the ``HIST_NAMES`` list in
+  ``observe/hist.py`` — ``HistSet.hist`` raises KeyError on a typo,
+  at a COLD setup site nothing in tier-1 may exercise;
+* ``flightrec.dump("reason")`` → the ``DUMP_REASONS`` tuple in
+  ``observe/flightrec.py`` — an undeclared reason raises at the
+  trigger site, which is the breaker-trip / escalation path.
 
 Dynamic names (f-strings, variables) are skipped except for the alarm
 prefix check; the registries are extracted statically (``registry.py``).
@@ -46,6 +52,8 @@ _CONFIG_METHODS = {"get", "put"}
 _FAULT_METHODS = {"act", "check"}
 _ALARM_METHODS = {"activate", "deactivate"}
 _HOOK_METHODS = {"add", "run", "run_fold", "has", "delete"}
+_HIST_METHODS = {"hist"}
+_DUMP_METHODS = {"dump"}
 
 #: drop reasons observe/wiring.py rewrites before deriving the counter
 #: name (mirrors ``on_dropped``: shared_no_available counts against
@@ -69,6 +77,7 @@ class RegistryDrift(Rule):
     _REGISTRY_FILES = (
         "emqx_tpu/observe/metrics.py", "emqx_tpu/config.py",
         "emqx_tpu/faultinject.py", "emqx_tpu/broker/hooks.py",
+        "emqx_tpu/observe/hist.py", "emqx_tpu/observe/flightrec.py",
     )
 
     def __init__(self, registries: Optional[Registries] = None) -> None:
@@ -110,6 +119,10 @@ class RegistryDrift(Rule):
             self._check_hook_point(node, ctx)
             if method == "run":
                 self._check_drop_reason(node, ctx)
+        elif method in _HIST_METHODS and "hist" in recv:
+            self._check_hist(node, ctx)
+        elif method in _DUMP_METHODS and "flightrec" in recv:
+            self._check_dump_reason(node, ctx)
 
     # ------------------------------------------------------------------
 
@@ -160,6 +173,31 @@ class RegistryDrift(Rule):
                 "(emqx_tpu/broker/hooks.py) — the chain dispatches by "
                 "exact string, so this callback/run can never pair "
                 "with the rest of the tree",
+            )
+
+    def _check_hist(self, node: ast.Call, ctx: FileContext) -> None:
+        name = str_arg(node)
+        if name is None or not _NAME_RE.match(name):
+            return
+        if name not in self.registries.hist_names:
+            ctx.report(
+                self.name, node,
+                f"histogram {name!r} is not registered in HIST_NAMES "
+                "(emqx_tpu/observe/hist.py) — HistSet.hist raises "
+                "KeyError at this (cold, setup-time) lookup",
+            )
+
+    def _check_dump_reason(self, node: ast.Call, ctx: FileContext) -> None:
+        reason = str_arg(node)
+        if reason is None:
+            return
+        if reason not in self.registries.dump_reasons:
+            ctx.report(
+                self.name, node,
+                f"flight-recorder dump reason {reason!r} is not "
+                "declared in DUMP_REASONS (emqx_tpu/observe/"
+                "flightrec.py) — FlightRecorder.dump raises at the "
+                "trigger site",
             )
 
     def _check_drop_reason(self, node: ast.Call, ctx: FileContext) -> None:

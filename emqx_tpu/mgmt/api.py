@@ -117,6 +117,9 @@ class MgmtApi:
           self.topic_metrics_reset)
         r("GET", f"{v}/slow_subscriptions", self.slow_subs_list)
         r("DELETE", f"{v}/slow_subscriptions", self.slow_subs_clear)
+        r("GET", f"{v}/observability/histograms", self.histograms)
+        r("GET", f"{v}/observability/flightrec", self.flightrec_info)
+        r("POST", f"{v}/observability/flightrec", self.flightrec_dump)
         r("GET", f"{v}/plugins", self.plugins_list)
         r("PUT", f"{v}/plugins/{{name}}/{{action}}", self.plugins_action)
         r("GET", f"{v}/psk", self.psk_list)
@@ -740,14 +743,39 @@ class MgmtApi:
         return Response(204)
 
     async def slow_subs_list(self, req: Request) -> Response:
+        # the top-N *who* next to the moving-window *how slow* — the
+        # e2e histogram answers what the ranking alone never could
         ss = getattr(self.node, "slow_subs", None)
-        return json_response(ss.ranking() if ss is not None else [])
+        if ss is None:
+            return json_response({"data": [], "e2e": None})
+        return json_response({"data": ss.ranking(), "e2e": ss.e2e()})
 
     async def slow_subs_clear(self, req: Request) -> Response:
         ss = getattr(self.node, "slow_subs", None)
         if ss is not None:
             ss.clear()
         return Response(204)
+
+    # -- stage-level latency observatory --------------------------------
+
+    async def histograms(self, req: Request) -> Response:
+        """Merged cross-plane stage percentiles (observe/hist.py) —
+        the same extraction $SYS, statsd and bench.py read."""
+        return json_response({
+            "enabled": self.node.hists is not None,
+            "histograms": self.node.hist_percentiles(),
+        })
+
+    async def flightrec_info(self, req: Request) -> Response:
+        return json_response(self.node.flightrec.info())
+
+    async def flightrec_dump(self, req: Request) -> Response:
+        """The manual trigger: snapshot every plane's ring NOW and
+        write a Perfetto trace, same path as the automatic reasons."""
+        path = self.node.flightrec.dump("manual")
+        if path is None:
+            return json_response({"message": "dump failed"}, status=503)
+        return json_response({"path": path, "reason": "manual"})
 
     async def plugins_list(self, req: Request) -> Response:
         return json_response(self.node.plugins.list())

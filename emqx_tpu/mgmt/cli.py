@@ -154,6 +154,13 @@ def main(argv=None) -> int:
     p.add_argument("action", choices=["list", "clear"], nargs="?",
                    default="list")
 
+    # stage-level latency observatory: merged per-stage percentiles +
+    # the flight recorder's manual dump trigger
+    sub.add_parser("hist")
+    p = sub.add_parser("flightrec")
+    p.add_argument("action", choices=["info", "dump"], nargs="?",
+                   default="info")
+
     p = sub.add_parser("users")
     p.add_argument("action", choices=["list", "add", "delete"])
     p.add_argument("username", nargs="?")
@@ -285,6 +292,13 @@ def main(argv=None) -> int:
         else:
             ctl.call("DELETE", f"{v}/slow_subscriptions")
             print("cleared")
+    elif args.cmd == "hist":
+        _print(ctl.call("GET", f"{v}/observability/histograms"))
+    elif args.cmd == "flightrec":
+        if args.action == "dump":
+            _print(ctl.call("POST", f"{v}/observability/flightrec"))
+        else:
+            _print(ctl.call("GET", f"{v}/observability/flightrec"))
     elif args.cmd == "users":
         if args.action == "list":
             _print(ctl.call("GET", f"{v}/users"))

@@ -183,6 +183,23 @@ class BrokerNode:
         from .observe.trace import TraceManager
 
         self.tracing = TraceManager(self)
+        # stage-level latency observatory: the main plane's histogram
+        # set (None = every recording site is zero-call) + the
+        # always-on flight recorder dumping into the TraceManager dir
+        # on breaker trip / brownout escalation / supervisor_degraded /
+        # the mgmt manual trigger
+        from .observe.flightrec import FlightRecorder
+        from .observe.hist import HistSet
+
+        self.hists = HistSet("main") if cfg.get("obs.hist.enable") \
+            else None
+        self.flightrec = FlightRecorder(
+            self.tracing.dir,
+            depth=cfg.get("obs.flightrec.depth"),
+            metrics=self.observed.metrics,
+        )
+        self.supervisor.flightrec = self.flightrec
+        self.observed.sys.attach_hists(self.hist_percentiles)
         from .observe.slow_subs import SlowSubs
         from .plugins import PluginManager
 
@@ -537,6 +554,8 @@ class BrokerNode:
             coalesce=bool(self.config.get("broker.fanout.enable")),
             wheel=self.timer_wheel,
         )
+        if self.hists is not None:
+            proto._h_parse = self.hists.hist("obs.stage.ingest_parse")
         channel.conn = proto
         self._register_on_connect(channel, proto)
         self._all_conns.add(proto)
@@ -572,6 +591,10 @@ class BrokerNode:
             wheel=shard.wheel,
         )
         proto.shard = shard
+        if shard.hists is not None:
+            # the shard's OWN ingest_parse histogram: written only by
+            # this shard's loop thread, merged at read time
+            proto._h_parse = shard.hists.hist("obs.stage.ingest_parse")
         channel.conn = proto
         self._all_conns.add(proto)
         return proto
@@ -730,6 +753,7 @@ class BrokerNode:
                 server=self.config.get("statsd.server"),
                 interval=self.config.get("statsd.flush_interval"),
                 supervisor=self.supervisor,
+                hist_source=self.hist_percentiles,
             )
             await self.statsd.start()
         if self.config.get("telemetry.enable"):
@@ -975,6 +999,8 @@ class BrokerNode:
                     "match.segments.compact_min_mutations"),
                 dirty_threshold=cfg.get("match.segments.dirty_threshold"),
                 prewarm=cfg.get("match.segments.prewarm"),
+                hists=self.hists,
+                flightrec=self.flightrec,
             )
             self.match_service.supervisor = self.supervisor
             await asyncio.wait_for(
@@ -1007,6 +1033,8 @@ class BrokerNode:
             shape_probe_s=cfg.get("broker.fanout.shape_probe"),
             supervisor=self.supervisor,
             olp=self.olp,
+            hists=self.hists,
+            flightrec=self.flightrec,
         )
         await self.fanout_pipeline.start()
         self.broker.fanout = self.fanout_pipeline
@@ -1280,5 +1308,28 @@ class BrokerNode:
             "fanout": (self.fanout_pipeline.info()
                        if self.fanout_pipeline is not None else None),
             "supervisor": self.supervisor.info(),
+            "flightrec": self.flightrec.info(),
             **self.broker.stats(),
         }
+
+    # -- stage-level latency observatory (observe/hist.py) -------------
+
+    def hist_sets(self) -> List[Any]:
+        """Every live plane's histogram set: the main set (also written
+        by the match worker stages — one writer per histogram) plus one
+        per shard loop.  Empty when ``obs.hist.enable`` is off."""
+        if self.hists is None:
+            return []
+        sets = [self.hists]
+        pool = self.shard_pool
+        if pool is not None:
+            sets.extend(s.hists for s in pool.shards
+                        if s.hists is not None)
+        return sets
+
+    def hist_percentiles(self) -> Dict[str, Dict[str, float]]:
+        """Merged cross-plane percentiles — the one latency definition
+        every export surface ($SYS, REST/CLI, statsd, bench) reads."""
+        from .observe.hist import HistSet
+
+        return HistSet.percentiles(self.hist_sets())

@@ -12,9 +12,13 @@ from .stats import Stats, STAT_NAMES
 from .alarm import Alarms, Alarm
 from .topic_metrics import TopicMetrics
 from .sys_topics import SysBroker
+from .hist import LatencyHistogram, HistSet, HIST_NAMES
+from .flightrec import FlightRecorder, DUMP_REASONS
 
 __all__ = [
     "TopicMetrics",
     "Metrics", "METRIC_NAMES", "Stats", "STAT_NAMES",
     "Alarms", "Alarm", "SysBroker",
+    "LatencyHistogram", "HistSet", "HIST_NAMES",
+    "FlightRecorder", "DUMP_REASONS",
 ]

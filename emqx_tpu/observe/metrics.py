@@ -20,6 +20,7 @@ __all__ = [
     "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
     "ROBUSTNESS_METRIC_NAMES", "CONNPLANE_METRIC_NAMES",
     "MATCH_SERVE_METRIC_NAMES", "TABLE_METRIC_NAMES",
+    "OBS_METRIC_NAMES",
 ]
 
 # -- the reference's fixed counter names, grouped as in emqx_metrics.erl [U]
@@ -168,6 +169,15 @@ TABLE_METRIC_NAMES: List[str] = [
     "tpu.table.dirty_rows_uploaded", "tpu.table.compile_cache_hits",
 ]
 
+# -- stage-level latency observatory (observe/hist.py + flightrec.py).
+# dumps counts flight-recorder trace files written (inc, one per
+# trigger: breaker trip, brownout escalation, supervisor_degraded,
+# manual).  The latency histograms themselves live in HIST_NAMES
+# (observe/hist.py), not here — they are distributions, not counters.
+OBS_METRIC_NAMES: List[str] = [
+    "obs.flightrec.dumps",
+]
+
 
 class Metrics:
     """A counter table with the reference's fixed name set.
@@ -186,6 +196,7 @@ class Metrics:
         self._c.update({n: 0 for n in CONNPLANE_METRIC_NAMES})
         self._c.update({n: 0 for n in MATCH_SERVE_METRIC_NAMES})
         self._c.update({n: 0 for n in TABLE_METRIC_NAMES})
+        self._c.update({n: 0 for n in OBS_METRIC_NAMES})
         if extra:
             self._c.update({n: 0 for n in extra})
 

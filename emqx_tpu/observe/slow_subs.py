@@ -5,12 +5,21 @@ measure per-delivery latency (publish timestamp → delivery to the
 subscriber), keep a bounded top-N ranking of the slowest
 (clientid, topic) pairs over a moving window, expire stale entries,
 expose + clear over REST.
+
+Observatory extension: alongside the top-N *who*, a moving-window
+**e2e delivery histogram** (observe/hist.py buckets, window = two
+rotating halves of ``window_s``) answers *how slow is slow* — every
+delivery under the ceiling records (the threshold only gates the
+ranking), and mgmt REST/CLI report the percentiles next to the
+ranking.  One ``time.time()`` per delivery feeds both.
 """
 
 from __future__ import annotations
 
 import time
 from typing import Any, Dict, List, Tuple
+
+from .hist import LatencyHistogram
 
 __all__ = ["SlowSubs"]
 
@@ -28,6 +37,12 @@ class SlowSubs:
         self.max_ms = max_ms
         # (clientid, topic) -> (latency_ms, last_update)
         self._table: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        # moving-window e2e histogram: two rotating halves, reported
+        # merged — a sample lives between window_s/2 and window_s, the
+        # standard rotation approximation of a true sliding window
+        self._h_cur = LatencyHistogram()
+        self._h_prev = LatencyHistogram()
+        self._rotate_at = time.time() + window_s / 2.0
 
     def attach(self, broker: Any) -> "SlowSubs":
         broker.hooks.add("message.delivered", self._on_delivered,
@@ -39,10 +54,23 @@ class SlowSubs:
         # publish timestamp is arbitrarily old BY DESIGN
         if getattr(msg, "retain", False):
             return
-        lat_ms = (time.time() - msg.timestamp) * 1e3
-        if lat_ms < self.threshold_ms or lat_ms > self.max_ms:
-            return
+        # ONE wall-clock read per delivery: it is both the latency
+        # end-stamp and the table's last_update (the old second call
+        # was pure hot-path waste)
         now = time.time()
+        lat_ms = (now - msg.timestamp) * 1e3
+        if lat_ms > self.max_ms:
+            return          # by-design delay ($delayed), not slowness
+        if now >= self._rotate_at:
+            self._h_prev = self._h_cur
+            self._h_cur = LatencyHistogram()
+            self._rotate_at = now + self.window_s / 2.0
+        # the histogram sees EVERY in-ceiling delivery — the threshold
+        # only gates the ranking, or "how slow is slow" would be
+        # censored at exactly the interesting boundary
+        self._h_cur.record(int(lat_ms * 1e6))
+        if lat_ms < self.threshold_ms:
+            return
         key = (clientid, msg.topic)
         prev = self._table.get(key)
         if prev is None or lat_ms > prev[0]:
@@ -66,5 +94,17 @@ class SlowSubs:
             for (cid, topic), (lat, ts) in rows[: self.top_k]
         ]
 
+    def e2e(self) -> Dict[str, float]:
+        """Moving-window e2e delivery percentiles (merged halves) —
+        reported by mgmt REST/CLI next to the ranking."""
+        if time.time() >= self._rotate_at + self.window_s / 2.0:
+            # no deliveries for a whole window: both halves are stale
+            self._h_prev = LatencyHistogram()
+            self._h_cur = LatencyHistogram()
+        return LatencyHistogram.merged(
+            (self._h_prev, self._h_cur)).to_dict()
+
     def clear(self) -> None:
         self._table.clear()
+        self._h_cur = LatencyHistogram()
+        self._h_prev = LatencyHistogram()

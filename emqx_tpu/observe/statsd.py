@@ -4,6 +4,13 @@ Behavioral reference: ``apps/emqx_statsd`` [U] (SURVEY.md §2.3):
 periodic UDP push of the metric counters and stat gauges in statsd
 line protocol (``<name>:<value>|c`` for counters, ``|g`` for gauges),
 names dot-separated as the reference emits them.
+
+Stage-latency extension (observe/hist.py): when a ``hist_source`` is
+attached, each non-empty merged histogram also emits timing lines —
+``<prefix>.<name>.p50:<ms>|ms`` (and p95/p99) plus a ``.count|g``
+gauge — the same percentile extraction every other surface reads.
+Payloads past ~8 KB split into multiple datagrams on LINE boundaries
+(a line torn across datagrams is garbage to every statsd server).
 """
 
 from __future__ import annotations
@@ -21,24 +28,37 @@ __all__ = ["StatsdPusher"]
 class StatsdPusher:
     def __init__(self, observed: Any, server: str = "127.0.0.1:8125",
                  interval: float = 30.0, prefix: str = "emqx",
-                 supervisor: Any = None) -> None:
+                 supervisor: Any = None, hist_source: Any = None) -> None:
         host, _, port = server.rpartition(":")
         self.addr = (host or "127.0.0.1", int(port or 8125))
         self.observed = observed
         self.interval = interval
         self.prefix = prefix
         self.supervisor = supervisor
+        # () -> {name: {count, p50_ms, p95_ms, p99_ms, ...}} — the
+        # node's merged cross-plane percentile snapshot
+        self.hist_source = hist_source
         self._sock: Optional[socket.socket] = None
         self._task: Optional[asyncio.Task] = None
         self.pushes = 0
 
     def render(self) -> bytes:
-        """One datagram per flush: counters then gauges."""
+        """One payload per flush: counters, gauges, then histogram
+        timing lines (chunked into datagrams by :meth:`push`)."""
         lines = []
         for name, value in self.observed.metrics.all().items():
             lines.append(f"{self.prefix}.{name}:{value}|c")
         for name, value in self.observed.stats.all().items():
             lines.append(f"{self.prefix}.{name}:{value}|g")
+        if self.hist_source is not None:
+            for name, pct in self.hist_source().items():
+                if not pct.get("count"):
+                    continue   # empty histograms are noise, not zeros
+                for q in ("p50", "p95", "p99"):
+                    lines.append(
+                        f"{self.prefix}.{name}.{q}:{pct[q + '_ms']}|ms")
+                lines.append(
+                    f"{self.prefix}.{name}.count:{pct['count']}|g")
         return "\n".join(lines).encode()
 
     def push(self) -> None:

@@ -40,6 +40,7 @@ class SysBroker:
         self._last_tick = 0.0
         self._stats_fn: Optional[Callable[[], Dict[str, int]]] = None
         self._metrics_fn: Optional[Callable[[], Dict[str, int]]] = None
+        self._hists_fn: Optional[Callable[[], Dict[str, Any]]] = None
 
     def prefix(self) -> str:
         return f"$SYS/brokers/{self.node}"
@@ -51,6 +52,14 @@ class SysBroker:
     ) -> None:
         self._stats_fn = stats
         self._metrics_fn = metrics
+
+    def attach_hists(
+        self, hists: Optional[Callable[[], Dict[str, Any]]],
+    ) -> None:
+        """Stage-latency histogram source (``{name: {count, p50_ms,
+        ...}}``): each name publishes one JSON payload under
+        ``$SYS/brokers/<node>/hist/<name>`` per tick."""
+        self._hists_fn = hists
 
     # ------------------------------------------------------------------
 
@@ -77,6 +86,11 @@ class SysBroker:
         if self._metrics_fn:
             for k, v in self._metrics_fn().items():
                 self._publish(f"{p}/metrics/{k}", str(v).encode())
+        if self._hists_fn:
+            for k, v in self._hists_fn().items():
+                if v.get("count"):
+                    self._publish(f"{p}/hist/{k}",
+                                  json.dumps(v).encode())
         return True
 
     # -- event publishes (called from connection/alarm paths) -------------

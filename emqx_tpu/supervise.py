@@ -152,6 +152,10 @@ class Supervisor:
     ) -> None:
         self.metrics = metrics
         self.alarms = alarms
+        # always-on flight recorder (observe/flightrec.py): set by the
+        # node; a degraded-mode escalation dumps the last few hundred
+        # batch events so the forensics survive the restart storm
+        self.flightrec = None
         self.max_restarts = max_restarts
         self.window_s = window_s
         self.backoff_base = backoff_base
@@ -325,6 +329,8 @@ class Supervisor:
                 {"child": child.name, "restarts": child.restarts},
                 f"supervised child {child.name} restarting too fast",
             )
+        if self.flightrec is not None:
+            self.flightrec.dump("supervisor_degraded", note=child.name)
         self._sync_degraded_metric()
 
     def _clear_degraded(self, child: Child) -> None:

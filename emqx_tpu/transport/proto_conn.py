@@ -51,6 +51,11 @@ class MqttProtocol(asyncio.Protocol):
     # HIGH and resumes once the worker drains below LOW
     QUEUE_HIGH_WATER = 256
     QUEUE_LOW_WATER = 64
+    # ingest_parse stage histogram (observe/hist.py): the node's
+    # factory points this at its plane's histogram (shard conns get
+    # their shard's instance — each is written only by its own loop);
+    # None keeps the parse path at zero recording calls
+    _h_parse = None
 
     def __init__(
         self,
@@ -150,11 +155,16 @@ class MqttProtocol(asyncio.Protocol):
             ok, wait = self._byte_bucket.consume(len(data))
             if not ok:
                 self._pause_read_for(wait)
+        h_parse = self._h_parse
+        t0 = time.perf_counter_ns() if h_parse is not None else 0
         try:
             pkts = self.parser.feed(data)
         except F.FrameError as e:
             self._frame_error(e)
             return
+        if h_parse is not None:
+            # one record per transport read: wire bytes → packet objects
+            h_parse.record(time.perf_counter_ns() - t0)
         if self._queue is not None:
             for pkt in pkts:
                 self._queue.put_nowait(pkt)

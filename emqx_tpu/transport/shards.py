@@ -358,6 +358,16 @@ class Shard:
         self.pool = pool
         self.index = index
         self.name = f"broker.shard.{index}"
+        # stage-level latency observatory: the shard plane writes its
+        # OWN histogram set from its own loop thread (single writer);
+        # node.hist_sets() merges it with the main plane at read time.
+        # None (obs.hist.enable off) keeps the shard at zero records.
+        node_hists = getattr(pool.node, "hists", None)
+        self.hists = None
+        if node_hists is not None:
+            from ..observe.hist import HistSet
+
+            self.hists = HistSet(self.name)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.thread: Optional[threading.Thread] = None
         self.wheel: Optional[TimerWheel] = None

@@ -607,6 +607,24 @@ def main(argv=None) -> dict:
     # full rebuild + churn soak across live compaction swaps
     out["table_lifecycle"] = bench_table_lifecycle(
         **_table_lifecycle_size(args.smoke))
+    # stage-latency observatory parity (ISSUE 12): the serve sections'
+    # p50/p99 now come from the product's histograms (observe/hist.py);
+    # the legacy np.percentile extraction over the SAME post-warmup
+    # samples must agree before the parallel lists stay deleted.  A
+    # parity break here is a histogram-math bug, so the smoke fails
+    # loudly instead of recording a gate nobody reads.
+    for side in ("static", "deadline"):
+        sec = out["serve_deadline"].get(side)
+        if sec and "gate_hist_parity" in sec:
+            assert sec["gate_hist_parity"], (
+                "serve_deadline histogram/np.percentile parity broke",
+                side, sec)
+    for side in ("serial", "pipeline"):
+        sec = out["serve_pipeline"].get(side)
+        if sec and "gate_hist_parity" in sec:
+            assert sec["gate_hist_parity"], (
+                "serve_pipeline histogram/np.percentile parity broke",
+                side, sec)
     if args.chaos:
         out["chaos"] = chaos_smoke()
     print(json.dumps(out, indent=2))

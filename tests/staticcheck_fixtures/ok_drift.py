@@ -20,3 +20,10 @@ def g(hooks):
     hooks.add("client.connected", lambda *a: None)
     hooks.run_fold("client.authenticate", (None, None, None, {}), True)
     hooks.has("message.delivered")
+
+
+def h(hists, flightrec):
+    hists.hist("obs.stage.match_dispatch")
+    hists.hist("obs.e2e.publish_deliver")
+    flightrec.dump("breaker_trip")
+    flightrec.dump("manual")

@@ -1,6 +1,6 @@
-"""Must TRIP registry-drift on all six surfaces (checked against the
+"""Must TRIP registry-drift on all eight surfaces (checked against the
 real registries in observe/metrics.py / config.py / faultinject.py /
-broker/hooks.py)."""
+broker/hooks.py / observe/hist.py / observe/flightrec.py)."""
 
 
 def f(metrics, cfg, alarms, hooks, _injector):
@@ -14,3 +14,8 @@ def f(metrics, cfg, alarms, hooks, _injector):
 
 def g(hooks):
     hooks.add("client.not_a_real_point", lambda: None)
+
+
+def h(hists, flightrec):
+    hists.hist("obs.stage.not_a_real_stage")
+    flightrec.dump("not_a_declared_reason")

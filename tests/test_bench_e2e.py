@@ -66,6 +66,18 @@ def test_bench_e2e_smoke_delivers_everything():
     assert sd["static"]["served"] > 0, sd
     assert sd["deadline"]["served"] > 0, sd
     assert sd["deadline"]["batch_hist"], sd
+    # stage-latency observatory (ISSUE 12): the serve sections report
+    # per-stage p50/p99 from the PRODUCT's histograms, parity-checked
+    # against the legacy np.percentile extraction over the same
+    # post-warmup samples, and the deadline JSON records the split
+    # dispatch/readback estimates
+    for side in ("static", "deadline"):
+        sec = sd[side]
+        assert sec["gate_hist_parity"], (side, sec)
+        assert sec["stages"]["match_dispatch"]["count"] > 0, sec
+        assert sec["hist"]["count"] > 0, sec
+    assert sd["deadline"]["est_dispatch_ms"] > 0, sd
+    assert sd["deadline"]["est_readback_ms"] > 0, sd
     # overlapped serve pipeline A/B (ISSUE 11): both sides served the
     # offered storm at equal load; the pipelined side's two-phase
     # readback held the 4·(B + sum(counts)) byte contract on EVERY
@@ -87,6 +99,9 @@ def test_bench_e2e_smoke_delivers_everything():
     assert sp["p99_bound"] == want_bound, sp
     assert sp["pipeline"]["readback_bytes_hist"], sp
     assert sp["pipeline"]["stage_overlap_ms_hist"], sp
+    for side in ("serial", "pipeline"):
+        assert sp[side]["gate_hist_parity"], (side, sp[side])
+        assert sp[side]["stages"]["match_readback"]["count"] > 0, sp
     # streaming table lifecycle A/B (ISSUE 9): segment cold start >=10x
     # the full rebuild at bench scale, arrays byte-identical after the
     # round trip, and the churn soak sustains mutations across >=1 live

@@ -1145,3 +1145,157 @@ def test_chaos_compact_killed_midswap_serving_unaffected(tmp_path):
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# 7c. flight recorder (ISSUE 12): breaker trip and brownout escalation
+#     each produce exactly one well-formed Perfetto dump; a kill
+#     mid-dump leaves no torn file
+# ---------------------------------------------------------------------------
+
+def _flightrec_files(node, reason):
+    import glob
+    import os
+
+    return sorted(glob.glob(os.path.join(
+        node.tracing.dir, f"flightrec-{reason}-*.json")))
+
+
+def _assert_wellformed_dump(path, reason):
+    """Valid trace-event JSON, reason recorded, slices time-ordered."""
+    import json
+
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["reason"] == reason
+    evs = payload["traceEvents"]
+    slices = [e for e in evs if e["ph"] == "X"]
+    ts = [e["ts"] for e in slices]
+    assert ts == sorted(ts), "slices not time-ordered"
+    for e in slices:
+        assert e["name"] and "dur" in e and "args" in e
+    return len(slices)
+
+
+def test_chaos_breaker_trip_dumps_flightrec_once(tmp_path):
+    """Breaker trip under persistent dispatch failures writes EXACTLY
+    one well-formed flight-recorder dump carrying the serve path's
+    recent stage events (the forensic acceptance gate, ISSUE 12)."""
+
+    async def main():
+        node = await _start_match_node()
+        try:
+            b = node.broker
+            ms = node.match_service
+            assert ms.flightrec is node.flightrec
+            # isolate from the shared ./trace dir (dumps accumulate
+            # across tests/runs there by design)
+            node.flightrec.out_dir = str(tmp_path)
+            node.tracing.dir = str(tmp_path)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(
+                lambda: ms.ready and ms.dev.epoch == ms.inc.epoch,
+                timeout=60)
+            # a few healthy dispatches so the rings hold real events
+            for i in range(5):
+                await ms.prefetch(f"t/warm{i}/x")
+            assert _flightrec_files(node, "breaker_trip") == []
+            faultinject.install(FaultInjector([
+                {"point": "match.dispatch", "action": "raise",
+                 "times": 3},
+            ]))
+            try:
+                for i in range(3):
+                    await ms.prefetch(f"t/f{i}/x")
+                assert ms._breaker_open
+                files = _flightrec_files(node, "breaker_trip")
+                assert len(files) == 1, files          # exactly one
+                n_slices = _assert_wellformed_dump(
+                    files[0], "breaker_trip")
+                assert n_slices >= 1     # the warm dispatches' spans
+                m = node.observed.metrics
+                assert m.get("obs.flightrec.dumps") == 1
+                # recovery does NOT dump again
+                assert await until(lambda: not ms._breaker_open,
+                                   timeout=15)
+                assert len(_flightrec_files(node, "breaker_trip")) == 1
+            finally:
+                faultinject.uninstall()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_chaos_brownout_escalation_dumps_flightrec_once(tmp_path):
+    """Each brownout ESCALATION (level step up) dumps exactly once;
+    de-escalation back to 0 does not."""
+
+    async def main():
+        node = await _start_match_node(**{
+            "overload_protection.cooloff": 0.2,
+        })
+        try:
+            ms = node.match_service
+            olp = node.olp
+            node.flightrec.out_dir = str(tmp_path)
+            node.tracing.dir = str(tmp_path)
+            assert _flightrec_files(node, "brownout") == []
+            # drive the olp hot: queue depth over the limit → level 1
+            olp.report(queue_depth=10 ** 9)
+            assert olp.brownout_level() >= 1
+            lvl = ms._brownout()
+            assert lvl >= 1
+            files = _flightrec_files(node, "brownout")
+            assert len(files) == 1, files              # exactly one
+            _assert_wellformed_dump(files[0], "brownout")
+            # same level re-observed: no second dump
+            assert ms._brownout() == lvl
+            assert len(_flightrec_files(node, "brownout")) == 1
+            # cooloff passes → level drops to 0 → still no new dump
+            await asyncio.sleep(0.3)
+            assert ms._brownout() == 0
+            assert len(_flightrec_files(node, "brownout")) == 1
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_chaos_kill_mid_dump_leaves_no_torn_file(tmp_path):
+    """A crash at ANY point of the dump write (simulated at the worst
+    spot: mid-serialization) leaves neither a torn JSON nor a stray
+    temp file — the temp-file + atomic-rename contract."""
+    tmp_path2 = [tmp_path]
+
+    async def main():
+        import glob
+        import json as _json
+        import os
+        from unittest import mock
+
+        node = await _start_match_node()
+        try:
+            fr = node.flightrec
+            fr.out_dir = node.tracing.dir = str(tmp_path2[0])
+            fr.ring("fanout").push(1, 100, 50, batch=2)
+            before = set(glob.glob(os.path.join(node.tracing.dir, "*")))
+
+            def die_mid_write(obj, fh, **kw):
+                fh.write('{"traceEvents": [{"torn":')   # partial bytes
+                raise OSError("killed mid-dump")
+
+            with mock.patch.object(_json, "dump", die_mid_write):
+                assert fr.dump("manual") is None
+            after = set(glob.glob(os.path.join(node.tracing.dir, "*")))
+            assert after == before           # no torn file, no .tmp
+            # the recorder survives and the next dump is whole
+            path = fr.dump("manual")
+            assert path is not None
+            with open(path) as f:
+                _json.load(f)                # parses end to end
+        finally:
+            await node.stop()
+
+    run(main())

@@ -85,7 +85,10 @@ def test_statsd_pushes_counters_and_gauges():
             data = await asyncio.to_thread(sink.recvfrom, 65535)
             lines = data[0].decode().splitlines()
             kinds = {ln.rsplit("|", 1)[1] for ln in lines}
-            assert kinds == {"c", "g"}
+            # counters + gauges always; |ms histogram timing lines ride
+            # later datagrams when the payload chunks (test_observe.py
+            # covers the timing lines and the chunk boundaries)
+            assert {"c", "g"} <= kinds <= {"c", "g", "ms"}
             names = {ln.split(":", 1)[0] for ln in lines}
             assert "emqx.messages.received" in names
             assert "emqx.connections.count" in names

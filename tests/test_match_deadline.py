@@ -440,3 +440,66 @@ def test_deadline_default_off_keeps_legacy_loop():
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# split dispatch-vs-readback estimate (ISSUE 12, ROADMAP dispatch-tax (c))
+# ---------------------------------------------------------------------------
+
+def test_split_estimate_warm_fallback_and_feed():
+    """Cold: the combined EWMA serves the partial-flush trigger.  Warm
+    (>= SPLIT_WARM component samples): the trigger subtracts the
+    queue-wait-free dispatch + readback component sum instead."""
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            ms = node.match_service
+            # cold: no component samples yet → combined fallback
+            ms._est_split_samples = 0
+            ms._est_dispatch_s = 0.033
+            ms._est_disp_s = 0.004
+            ms._est_rb_s = 0.002
+            assert ms._dispatch_est() == 0.033
+            # feed the stage timers to warmth
+            for _ in range(ms.SPLIT_WARM):
+                ms._note_split(0.010, 0.005)
+            assert ms._est_split_samples >= ms.SPLIT_WARM
+            est = ms._dispatch_est()
+            assert est == ms._est_disp_s + ms._est_rb_s
+            assert 0.003 < ms._est_disp_s < 0.011
+            assert 0.001 < ms._est_rb_s < 0.006
+            # the bound uses the split estimate once warm
+            ms._rate_ewma = 1000.0
+            want = int(1000.0 * (ms.deadline_s - est))
+            assert ms._deadline_bound() == want
+            info = ms.info()
+            assert info["est_split_warm"] is True
+            assert info["est_disp_ms"] > 0
+            assert info["est_readback_ms"] > 0
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_split_estimate_fed_by_real_dispatches():
+    """A real serve path feeds the split components: after live
+    prefetches the component estimates carry measured stage times."""
+    async def main():
+        node = make_node()
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            sub(b, "c1", "a/+")
+            assert await settle(lambda: ms_synced(node))
+            before = ms._est_split_samples
+            for i in range(3):
+                await ms.prefetch(f"a/real{i}")
+            assert ms._est_split_samples > before
+            assert ms._est_disp_s > 0 and ms._est_rb_s > 0
+        finally:
+            await node.stop()
+
+    run(main())

@@ -162,7 +162,7 @@ def test_two_phase_readback_exact_bytes_and_row_parity():
         await ms.start()
         assert await synced(ms, b)
         topics = [f"a/{i}/k{i % 6}" for i in range(24)]
-        handles = ms._encode_dispatch(
+        handles, _enc_ns, _disp_ns = ms._encode_dispatch(
             ms.inc, ms.dev, topics,
             [(list(range(len(topics))), ms.depth)], False)
         (res, n) = handles[0]
@@ -240,11 +240,12 @@ def test_inflight_slot_swap_or_reuse_discards_via_guards(mutate):
         pending = [(t, loop.create_future(), loop.time() + 1.0)
                    for t in topics]
         groups = [(list(range(len(topics))), ms.depth)]
-        handles = ms._encode_dispatch(ms.inc, ms.dev, topics, groups,
-                                      True)
+        handles, enc_ns, disp_ns = ms._encode_dispatch(
+            ms.inc, ms.dev, topics, groups, True)
         slot = (pending, topics, groups, handles, ms.inc, ms.dev,
                 ms.inc.aid_reuses, ms._table_gen, ms._synced_epoch,
-                ms._synced_rule_gen, loop.time(), True)
+                ms._synced_rule_gen, loop.time(), True,
+                enc_ns + disp_ns)
         # the swap/reuse lands while the slot is in flight
         if mutate == "gen":
             ms._table_gen += 1

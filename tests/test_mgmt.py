@@ -422,3 +422,77 @@ def test_cli_round3_commands(capsys):
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# stage-level latency observatory (ISSUE 12): REST + CLI surfaces
+# ---------------------------------------------------------------------------
+
+def test_observability_histograms_and_flightrec_rest(tmp_path):
+    async def main():
+        node = await start_node('slow_subs.enable = true\n')
+        try:
+            node.flightrec.out_dir = str(tmp_path)  # isolate dumps
+            # feed one stage histogram so the merge carries real data
+            node.hists.hist("obs.stage.deliver").record(2_500_000)
+            st, body = await api(node, "GET",
+                                 "/api/v5/observability/histograms")
+            assert st == 200 and body["enabled"] is True
+            h = body["histograms"]["obs.stage.deliver"]
+            assert h["count"] == 1 and h["p50_ms"] > 0
+            # every registered stage is present (merged, maybe empty)
+            from emqx_tpu.observe.hist import HIST_NAMES
+            assert set(body["histograms"]) == set(HIST_NAMES)
+
+            # the manual flight-recorder trigger writes a real dump
+            node.flightrec.ring("fanout").push(1, 10, 5, batch=1)
+            st, body = await api(node, "POST",
+                                 "/api/v5/observability/flightrec")
+            assert st == 200 and body["reason"] == "manual"
+            import json as _json
+            with open(body["path"]) as f:
+                assert _json.load(f)["traceEvents"]
+            st, info = await api(node, "GET",
+                                 "/api/v5/observability/flightrec")
+            assert st == 200 and info["dumps"] == 1
+            assert node.observed.metrics.get("obs.flightrec.dumps") == 1
+
+            # slow_subs now reports the e2e window histogram alongside
+            # the ranking ("how slow is slow" next to who is slow)
+            st, body = await api(node, "GET",
+                                 "/api/v5/slow_subscriptions")
+            assert st == 200
+            assert body["data"] == []
+            assert body["e2e"]["count"] == 0
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_cli_hist_and_flightrec_commands(capsys, tmp_path):
+    async def main():
+        node = await start_node()
+        try:
+            node.flightrec.out_dir = str(tmp_path)
+            from emqx_tpu.mgmt.cli import main as ctl_main
+
+            base = f"http://127.0.0.1:{node.mgmt_server.port}"
+
+            def run_ctl(*argv):
+                rc = ctl_main(["--url", base, *argv])
+                out = capsys.readouterr().out
+                assert rc == 0
+                return out
+
+            node.hists.hist("obs.stage.flush").record(800_000)
+            out = await asyncio.to_thread(run_ctl, "hist")
+            assert "obs.stage.flush" in out
+            out = await asyncio.to_thread(run_ctl, "flightrec", "dump")
+            assert "manual" in out
+            out = await asyncio.to_thread(run_ctl, "flightrec")
+            assert '"dumps": 1' in out
+        finally:
+            await node.stop()
+
+    run(main())

@@ -236,3 +236,379 @@ def test_topic_metrics_counts_and_rest():
             await node.stop()
 
     asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# stage-level latency observatory (ISSUE 12): histograms + flight recorder
+# ---------------------------------------------------------------------------
+
+def test_hist_percentiles_track_np_percentile():
+    import numpy as np
+
+    from emqx_tpu.observe.hist import LatencyHistogram
+
+    rng = np.random.default_rng(3)
+    # lognormal ns around ~5 ms — the shape real stage latencies have
+    vals = rng.lognormal(mean=np.log(5e6), sigma=0.9, size=30000)
+    h = LatencyHistogram()
+    for v in vals:
+        h.record(int(v))
+    for q in (50, 95, 99):
+        hp = h.percentile_ns(q)
+        npp = float(np.percentile(vals, q))
+        # the bench parity gate's tolerance: 1/16-octave sub-buckets
+        assert abs(hp - npp) <= 0.12 * npp, (q, hp, npp)
+    assert h.count == len(vals)
+    assert h.to_dict()["p50_ms"] > 0
+
+
+def test_hist_record_many_matches_scalar_records():
+    import numpy as np
+
+    from emqx_tpu.observe.hist import LatencyHistogram
+
+    rng = np.random.default_rng(4)
+    secs = rng.lognormal(mean=np.log(3e-3), sigma=1.2, size=5000)
+    a, b = LatencyHistogram(), LatencyHistogram()
+    for s in secs:
+        a.record(int(s * 1e9))
+    b.record_many_s(secs)
+    assert a.counts == b.counts
+
+
+def test_hist_merge_sums_planes_and_registry_is_fixed():
+    import pytest as _pytest
+
+    from emqx_tpu.observe.hist import (
+        HIST_NAMES, HistSet, LatencyHistogram,
+    )
+
+    main, shard = HistSet("main"), HistSet("shard0")
+    main.hist("obs.stage.deliver").record(1_000_000)
+    shard.hist("obs.stage.deliver").record(2_000_000)
+    shard.hist("obs.stage.ingest_parse").record(5_000)
+    merged = HistSet.merge_all([main, shard])
+    assert merged["obs.stage.deliver"].count == 2
+    assert merged["obs.stage.ingest_parse"].count == 1
+    pct = HistSet.percentiles([main, shard])
+    assert set(pct) == set(HIST_NAMES)
+    assert pct["obs.stage.deliver"]["count"] == 2
+    # the fixed-table discipline: a typo'd name raises at the lookup
+    with _pytest.raises(KeyError):
+        main.hist("obs.stage.not_a_stage")
+    # single-writer merge is a read-time sum, sources keep counting
+    main.hist("obs.stage.deliver").record(1_000_000)
+    assert merged["obs.stage.deliver"].count == 2  # snapshot, not live
+    assert LatencyHistogram.merged(
+        [main.hist("obs.stage.deliver")]).count == 2
+
+
+def test_hist_recording_sites_zero_call_when_disabled(monkeypatch):
+    """The overhead-gate spy (the faultinject idiom): with hists=None
+    every recording site is an attribute check, never a call."""
+    import asyncio as aio
+
+    from emqx_tpu.broker import Broker, FanoutPipeline, SubOpts, \
+        make_message
+    from emqx_tpu.observe.hist import LatencyHistogram
+
+    calls = []
+    monkeypatch.setattr(
+        LatencyHistogram, "record",
+        lambda self, ns: calls.append(ns))
+    monkeypatch.setattr(
+        LatencyHistogram, "record_s",
+        lambda self, s: calls.append(s))
+
+    async def main():
+        b = Broker()
+        got = []
+        b.on_deliver = lambda cid, pubs: got.extend(pubs)
+        b.open_session("s")
+        b.subscribe("s", "t/#", SubOpts())
+        p = FanoutPipeline(b, window_s=0.0)   # hists defaults to None
+        await p.start()
+        for i in range(20):
+            assert p.offer(make_message("pub", f"t/{i}", b"x"))
+        deadline = aio.get_event_loop().time() + 2.0
+        while (p._q or p._busy) and \
+                aio.get_event_loop().time() < deadline:
+            await aio.sleep(0.002)
+        await p.stop()
+        assert len(got) == 20
+        assert calls == []          # not one record() anywhere
+
+    aio.run(main())
+
+
+def test_hist_recording_sites_record_when_enabled():
+    import asyncio as aio
+
+    from emqx_tpu.broker import Broker, FanoutPipeline, SubOpts, \
+        make_message
+    from emqx_tpu.observe.hist import HistSet
+
+    async def main():
+        b = Broker()
+        b.on_deliver = lambda cid, pubs: None
+        b.open_session("s")
+        b.subscribe("s", "t/#", SubOpts())
+        hs = HistSet("main")
+        p = FanoutPipeline(b, window_s=0.0, hists=hs)
+        await p.start()
+        for i in range(20):
+            assert p.offer(make_message("pub", f"t/{i}", b"x"))
+        deadline = aio.get_event_loop().time() + 2.0
+        while (p._q or p._busy) and \
+                aio.get_event_loop().time() < deadline:
+            await aio.sleep(0.002)
+        await p.stop()
+        assert hs.hist("obs.stage.fanout_queue").count >= 1
+        assert hs.hist("obs.stage.deliver").count >= 1
+        assert hs.hist("obs.stage.flush").count >= 1
+        assert hs.hist("obs.e2e.publish_deliver").count >= 1
+
+    aio.run(main())
+
+
+def test_flightrec_ring_wraps_and_snapshots_in_order():
+    from emqx_tpu.observe.flightrec import Ring
+
+    r = Ring("main", depth=64)
+    for i in range(100):
+        r.push(1, i, 10, batch=i)
+    snap = r.snapshot()
+    assert len(snap) == 64
+    starts = [e[1] for e in snap]
+    assert starts == list(range(36, 100))   # oldest→newest, wrapped
+    # depth rounds up to a power of two
+    assert len(Ring("x", depth=100).buf) == 128
+
+
+def test_flightrec_dump_writes_valid_perfetto_trace(tmp_path):
+    import json as _json
+
+    from emqx_tpu.observe.flightrec import (
+        DUMP_REASONS, FlightRecorder,
+    )
+    from emqx_tpu.observe.metrics import Metrics
+
+    m = Metrics()
+    fr = FlightRecorder(str(tmp_path), depth=128, metrics=m)
+    ring = fr.ring("match.encode")
+    for i in range(10):
+        ring.push(3, 1000 + i * 100, 50, batch=8, gen=i)
+    fr.ring("fanout").push(1, 500, 20, batch=4)
+    path = fr.dump("manual", note="test")
+    assert path is not None and path.endswith(".json")
+    with open(path) as f:
+        payload = _json.load(f)
+    assert payload["reason"] == "manual"
+    evs = payload["traceEvents"]
+    slices = [e for e in evs if e["ph"] == "X"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert len(slices) == 11
+    assert len(metas) == 2           # one thread_name per plane
+    # events ordered by ts (the chaos-test contract)
+    ts = [e["ts"] for e in slices]
+    assert ts == sorted(ts)
+    assert slices[0]["name"] == "fanout_queue"
+    assert {e["args"]["name"] for e in metas} == {
+        "match.encode", "fanout"}
+    assert m.get("obs.flightrec.dumps") == 1
+    assert fr.dumps == 1 and fr.last_reason == "manual"
+    # reasons are a fixed vocabulary
+    assert "breaker_trip" in DUMP_REASONS
+    with pytest.raises(ValueError):
+        fr.dump("no_such_reason")
+
+
+def test_flightrec_dump_failure_leaves_no_torn_file(tmp_path, monkeypatch):
+    import json as _json
+    import os as _os
+
+    from emqx_tpu.observe.flightrec import FlightRecorder
+
+    fr = FlightRecorder(str(tmp_path), depth=64)
+    fr.ring("main").push(0, 1, 2)
+
+    def boom(*a, **kw):
+        raise OSError("disk died mid-write")
+
+    monkeypatch.setattr(_json, "dump", boom)
+    assert fr.dump("manual") is None          # contained, not raised
+    assert fr.dumps == 0
+    # no torn JSON, no leftover temp file
+    assert [p for p in _os.listdir(tmp_path)] == []
+    monkeypatch.undo()
+    # and the recorder still works afterwards
+    assert fr.dump("manual") is not None
+
+
+def test_slow_subs_e2e_histogram_one_clock_read(monkeypatch):
+    import time as _time
+
+    from emqx_tpu.observe.slow_subs import SlowSubs
+
+    ss = SlowSubs(threshold_ms=100.0, window_s=10.0)
+    reads = [0]
+    real = _time.time
+
+    def counting_time():
+        reads[0] += 1
+        return real()
+
+    class Msg:
+        retain = False
+        topic = "a/b"
+
+        def __init__(self, age_s):
+            self.timestamp = real() - age_s
+
+    monkeypatch.setattr(
+        "emqx_tpu.observe.slow_subs.time.time", counting_time)
+    reads[0] = 0
+    ss._on_delivered("c1", Msg(0.5))      # past threshold: ranked
+    assert reads[0] == 1                  # ONE wall-clock read
+    reads[0] = 0
+    ss._on_delivered("c1", Msg(0.01))     # fast: histogram only
+    assert reads[0] == 1
+    monkeypatch.undo()
+    assert len(ss.ranking()) == 1         # only the slow one ranked
+    e2e = ss.e2e()
+    assert e2e["count"] == 2              # but BOTH deliveries measured
+    assert e2e["p50_ms"] > 0
+    ss.clear()
+    assert ss.e2e()["count"] == 0
+
+
+def test_sys_broker_publishes_hist_payloads():
+    import json as _json
+
+    got = []
+    sysb = SysBroker("n1", lambda t, p: got.append((t, p)), interval=0)
+    sysb.attach_hists(lambda: {
+        "obs.stage.deliver": {"count": 3, "p50_ms": 1.5, "p95_ms": 2.0,
+                              "p99_ms": 2.5, "max_ms": 3.0},
+        "obs.stage.flush": {"count": 0},     # empty: skipped
+    })
+    assert sysb.tick(now=1e9)
+    hist_topics = {t: p for t, p in got if "/hist/" in t}
+    assert list(hist_topics) == ["$SYS/brokers/n1/hist/obs.stage.deliver"]
+    body = _json.loads(next(iter(hist_topics.values())))
+    assert body["p99_ms"] == 2.5
+
+
+def test_statsd_hist_timing_lines_and_line_boundary_chunking():
+    from emqx_tpu.observe.statsd import StatsdPusher
+
+    class FakeMetrics:
+        def __init__(self, n):
+            self._d = {f"fake.counter.{i:04d}": i for i in range(n)}
+
+        def all(self):
+            return dict(self._d)
+
+    class FakeStats(FakeMetrics):
+        pass
+
+    class Observed:
+        metrics = FakeMetrics(400)      # ~10 KB of counter lines
+        stats = FakeStats(50)
+
+    pusher = StatsdPusher(
+        Observed(), server="127.0.0.1:1",
+        hist_source=lambda: {
+            "obs.stage.deliver": {"count": 7, "p50_ms": 1.25,
+                                  "p95_ms": 2.5, "p99_ms": 4.75,
+                                  "max_ms": 9.0},
+            "obs.stage.flush": {"count": 0},
+        })
+    payload = pusher.render()
+    text = payload.decode()
+    assert "emqx.obs.stage.deliver.p99:4.75|ms" in text
+    assert "emqx.obs.stage.deliver.p50:1.25|ms" in text
+    assert "emqx.obs.stage.deliver.count:7|g" in text
+    assert "obs.stage.flush" not in text     # empty hists are skipped
+    assert len(payload) > 8000               # forces the chunk path
+
+    sent = []
+
+    class FakeSock:
+        def sendto(self, data, addr):
+            sent.append(bytes(data))
+
+        def close(self):
+            pass
+
+    pusher._sock = FakeSock()
+    pusher.push()
+    assert len(sent) >= 2                    # multi-datagram flush
+    for chunk in sent:
+        assert len(chunk) <= 8000
+        for line in chunk.decode().splitlines():
+            # every line in every datagram is whole: name:value|type
+            name, rest = line.split(":", 1)
+            assert name and rest.rsplit("|", 1)[1] in ("c", "g", "ms")
+    # recombining the datagrams yields exactly the rendered payload
+    assert b"\n".join(sent) == payload
+    assert pusher.pushes == 1
+
+
+def test_obs_hist_disable_wires_none_everywhere():
+    """obs.hist.enable = false: every plane's histogram handle is None,
+    so (with the spy test above proving None ⇒ no call) the whole
+    recording surface is zero-call."""
+    import asyncio as aio
+
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    async def main():
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            "obs.hist.enable = false\n"))
+        cfg.put("tpu.enable", True)
+        cfg.put("broker.fanout.enable", True)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            assert node.hists is None
+            assert node.hist_sets() == []
+            assert node.hist_percentiles() == {}
+            fp = node.fanout_pipeline
+            assert fp._h_queue is None and fp._h_e2e is None
+            ms = node.match_service
+            if ms is not None:   # device may be absent on CI
+                assert ms._h_wait is None and ms._h_encode is None
+            # the flight recorder stays ALWAYS on regardless
+            assert node.flightrec is not None
+            assert node.supervisor.flightrec is node.flightrec
+        finally:
+            await node.stop()
+
+    aio.run(main())
+
+
+def test_obs_hist_enabled_by_default_and_wired():
+    import asyncio as aio
+
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    async def main():
+        cfg = Config(
+            file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("broker.fanout.enable", True)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            assert node.hists is not None
+            assert node.fanout_pipeline._h_queue is not None
+            pct = node.hist_percentiles()
+            from emqx_tpu.observe.hist import HIST_NAMES
+            assert set(pct) == set(HIST_NAMES)
+        finally:
+            await node.stop()
+
+    aio.run(main())

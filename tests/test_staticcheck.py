@@ -123,7 +123,7 @@ def test_waiver_file_has_no_silent_suppressions():
     ("no-swallowed-exceptions", "trip_exceptions.py",
      "ok_exceptions.py", 3),
     ("await-under-lock", "trip_locks.py", "ok_locks.py", 3),
-    ("registry-drift", "trip_drift.py", "ok_drift.py", 7),
+    ("registry-drift", "trip_drift.py", "ok_drift.py", 9),
     ("unawaited-coroutine", "trip_coroutines.py", "ok_coroutines.py", 3),
 ])
 def test_rule_fixture_pair(rule, trip, ok, n_trip, tmp_path):
@@ -240,6 +240,12 @@ def test_registries_extract_from_tree():
     assert "fanout.drain" in reg.fault_points
     assert "message.acked" in reg.hook_points
     assert "client.enhanced_authenticate" in reg.hook_points
+    assert "obs.stage.match_readback" in reg.hist_names
+    assert "obs.e2e.publish_deliver" in reg.hist_names
+    assert "breaker_trip" in reg.dump_reasons
+    assert "supervisor_degraded" in reg.dump_reasons
+    assert "obs.flightrec.dumps" in reg.metric_names
+    assert "obs.hist.enable" in reg.config_keys
 
 
 def test_registries_match_runtime_tables():
@@ -255,6 +261,10 @@ def test_registries_match_runtime_tables():
     assert reg.fault_points == set(faultinject.POINTS)
     from emqx_tpu.broker.hooks import HOOK_POINTS
     assert reg.hook_points == set(HOOK_POINTS)
+    from emqx_tpu.observe.flightrec import DUMP_REASONS
+    from emqx_tpu.observe.hist import HIST_NAMES
+    assert reg.hist_names == set(HIST_NAMES)
+    assert reg.dump_reasons == set(DUMP_REASONS)
 
 
 # ---------------------------------------------------------------------------
