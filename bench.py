@@ -1314,6 +1314,110 @@ def bench_serve_pipeline_smoke(n_filters=2000, batch=256, seconds=1.5,
     return out
 
 
+def bench_kernel_join(table, topics, batches=(256, 2048), iters=20,
+                      depth=8, short_depth=4, reps=3):
+    """Hash vs join vs auto kernel A/B (ISSUE 13).
+
+    For every (batch, topic-mix) shape: dispatch the SAME encoded batch
+    through the cuckoo-probe kernel and the sorted-relation join kernel
+    (flat/row_meta serving mode — the readback contract both share),
+    assert bit-for-bit parity, time both, then let the autotuner pick
+    and time the auto route.  Gates ride the JSON for the r06
+    real-hardware round: parity on every shape (CI-asserted), join
+    ≥1.3× on at least one shape class, and auto within 5% of the
+    better single backend on every measured shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from emqx_tpu.ops.device_table import DeviceNfa
+    from emqx_tpu.ops.join_match import BackendAutotuner
+
+    dev = DeviceNfa(table, active_slots=8,
+                    max_matches=_serve_max_matches())
+    dev.enable_join()
+    short = [t for t in topics if t.count("/") < short_depth] or topics
+    deep = [t for t in topics if t.count("/") >= short_depth] or topics
+    tuner = BackendAutotuner(reps=reps)
+    rows = []
+    parity_all = True
+    fields = ("matches", "n_matches", "row_meta",
+              "active_overflow", "match_overflow")
+    for B in batches:
+        cap = _serve_flat_cap(B)
+        for mix, src, d in (("short", short, short_depth),
+                            ("deep", deep, depth)):
+            names = (src * (B // max(1, len(src)) + 1))[:B]
+            w, l, s = _encode(table, names, d, B)
+            args = tuple(map(jnp.asarray, (w, l, s)))
+
+            def run(be):
+                def go():
+                    r = dev.match(*args, flat_cap=cap, backend=be)
+                    jax.device_get(r.row_meta)  # block to completion
+                    return r
+                return go
+
+            rh, rj = run("hash")(), run("join")()
+            parity = all(
+                np.array_equal(np.asarray(jax.device_get(getattr(rh, f))),
+                               np.asarray(jax.device_get(getattr(rj, f))))
+                for f in fields)
+            parity_all &= parity
+
+            def best(go):
+                t = float("inf")
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        go()
+                    t = min(t, (time.perf_counter() - t0) / iters)
+                return t
+
+            t_hash = best(run("hash"))
+            t_join = best(run("join"))
+            s_, hb_, _d = table.shape_key()
+            pick = tuner.measure(tuner.sig(B, d, s_, hb_),
+                                 {"hash": run("hash"),
+                                  "join": run("join")})
+            t_auto = best(run(pick))
+            rows.append({
+                "batch": B, "mix": mix, "depth": d,
+                "parity": bool(parity),
+                "hash_us": round(t_hash * 1e6, 1),
+                "join_us": round(t_join * 1e6, 1),
+                "auto_us": round(t_auto * 1e6, 1),
+                "auto_backend": pick,
+                "join_speedup": round(t_hash / max(t_join, 1e-9), 3),
+                "auto_within_5pct": bool(
+                    t_auto <= 1.05 * min(t_hash, t_join)),
+            })
+    return {
+        "rows": rows,
+        "gate_parity_all": bool(parity_all),
+        "best_join_speedup": max(
+            (r["join_speedup"] for r in rows), default=0.0),
+        "gate_join_ge_1_3x_any": bool(any(
+            r["join_speedup"] >= 1.3 for r in rows)),
+        "gate_auto_within_5pct": bool(all(
+            r["auto_within_5pct"] for r in rows)),
+        "autotune_picks": dict(tuner.picks),
+    }
+
+
+def bench_kernel_join_smoke(n_filters=2000, batch=256, depth=8):
+    """CPU-jax tiny-scale kernel_join A/B for bench_e2e --smoke: the
+    parity row is the CI gate; the ratios are tracking numbers (kernel
+    timings on a loaded CPU box are noise — bench.py owns the claim)."""
+    rng = np.random.default_rng(17)
+    filters, topics = build_workload(rng, n_filters, batch * 8, depth)
+    table, kind, _ = build_table(filters, depth)
+    out = bench_kernel_join(table, topics, batches=(batch,), iters=5,
+                            depth=depth, reps=2)
+    out["table"] = kind
+    out["n_filters"] = len(filters)
+    return out
+
+
 def _table_lifecycle_size(smoke: bool) -> dict:
     return (dict(n_filters=6000, seconds=1.5) if smoke
             else dict(n_filters=20000, seconds=3.0))
@@ -1690,6 +1794,16 @@ def main():
     note(f"device throughput {tpu['topics_per_s']:.0f}/s "
          f"(spill {tpu['spill_rate']})")
 
+    # kernel backend A/B (ISSUE 13): hash vs join vs auto at the serve
+    # shapes, short- and deep-topic mixes, parity-gated
+    kj = bench_kernel_join(
+        table, topics,
+        batches=(max(256, args.batch // 8), args.batch),
+        iters=max(5, args.iters // 2), depth=args.depth)
+    note(f"kernel join A/B done: parity={kj['gate_parity_all']} "
+         f"best_join_speedup={kj['best_join_speedup']}x "
+         f"auto_within_5pct={kj['gate_auto_within_5pct']}")
+
     # serving: device at 70% of its measured max; CPU at 70% of ITS max
     # through the same harness (iso-harness, each engine at its own
     # sustainable load) — the honest p99 comparison
@@ -1858,6 +1972,7 @@ def main():
         "serve_device_quarter_batch": serve_dev4,
         "serve_deadline": serve_deadline,
         "serve_pipeline": serve_pipeline,
+        "kernel_join": kj,
         "serve_cpu_iso": serve_cpu,
         "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,

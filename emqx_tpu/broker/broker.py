@@ -28,6 +28,7 @@ across nodes using the same tables.  The device NFA mirror subscribes to
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import replace
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -99,6 +100,18 @@ class Broker:
         # (outbox overflow) lands here when present
         self.metrics = None
         self._outbox_warned: set = set()  # clients already logged for drops
+        # stage-level latency observatory (observe/hist.py): direct
+        # histogram references for the PER-MESSAGE sync publish path —
+        # None = zero-call recording sites.  The batched fanout drain
+        # records its own spans; without these, traffic that bypasses
+        # the pipeline (shape gate, fanout off, direct publish callers)
+        # is invisible in the deliver/e2e histograms (ISSUE 13
+        # observability follow-on (b)).  Same main-loop writer thread
+        # as the fanout drain, so the single-writer discipline holds.
+        self.hists = None
+        self._h_deliver = None
+        self._h_flush = None
+        self._h_e2e = None
 
     # ------------------------------------------------------------------
     # session lifecycle (emqx_cm:open_session semantics, simplified here;
@@ -222,10 +235,19 @@ class Broker:
         here would fire retainer/delayed/rewrite side effects twice."""
         return self._publish_folded(msg, DeliverResult())
 
+    def attach_hists(self, hists) -> None:
+        """Wire the sync publish path's span recording sites (node
+        startup; no-op cost when never called)."""
+        self.hists = hists
+        self._h_deliver = hists.hist("obs.stage.deliver")
+        self._h_flush = hists.hist("obs.stage.flush")
+        self._h_e2e = hists.hist("obs.e2e.publish_deliver")
+
     def _publish_folded(self, msg: Message, res: DeliverResult) -> DeliverResult:
         # the TPU hot path (SURVEY.md §3.4): a fresh micro-batched device
         # answer replaces the per-publish host trie walk; stale/absent
         # hints fall back so correctness never depends on the device
+        t0 = time.perf_counter_ns() if self._h_deliver is not None else 0
         routes = None
         if self.device_match is not None:
             routes = self.device_match(msg.topic)
@@ -251,8 +273,20 @@ class Broker:
                     res.matched += 1
         # push the fan-out to the connection layer (or the outbox when no
         # serving layer is attached — unit tests read res.publishes instead)
+        t1 = time.perf_counter_ns() if self._h_deliver is not None else 0
         for clientid, pubs in res.publishes.items():
             self.emit(clientid, pubs)
+        if self._h_deliver is not None:
+            # per-message spans for bypass traffic: match+deliver as one
+            # deliver span, the emit fan-out as flush, plus the e2e
+            # publish→deliver sample when anything was delivered — the
+            # same three histograms the batched drain writes, so bypass
+            # rates climbing no longer hollow out the distributions
+            t2 = time.perf_counter_ns()
+            self._h_deliver.record(t1 - t0)
+            self._h_flush.record(t2 - t1)
+            if res.matched and self._h_e2e is not None:
+                self._h_e2e.record_s(time.time() - msg.timestamp)
         return res
 
     def _dispatch(self, flt: str, msg: Message, res: DeliverResult) -> None:

@@ -199,7 +199,8 @@ def _build_compacted(table_kind: str, depth: int, filters: List[str],
                      deep_filters: List[str], routing: Set[str],
                      active_slots: int, max_matches: int,
                      compact_output: bool, kcache: Any,
-                     dirty_threshold: float, segment_path: str):
+                     dirty_threshold: float, segment_path: str,
+                     join: bool = False):
     """Worker-thread half of a compaction cycle: build the fresh
     compacted table + device twin from the snapshot, write the next
     segment, and pre-pay the kernel compiles for the fresh shapes.
@@ -236,16 +237,22 @@ def _build_compacted(table_kind: str, depth: int, filters: List[str],
         if aid >= 0:
             new_routing.add(aid)
     # the next segment lands BEFORE the swap: a crash after this point
-    # leaves a valid fresh segment on disk and the old table serving
+    # leaves a valid fresh segment on disk and the old table serving.
+    # With the join backend on, the relation persists too (built clean
+    # from the fresh table — the full-rebuild-on-compact contract).
     save_segment(segment_path, fresh, deep=new_deep,
-                 routing_aids=new_routing, filters=filters)
+                 routing_aids=new_routing, filters=filters,
+                 join_relation=join)
     newdev = DeviceNfa(
         fresh, active_slots=active_slots, max_matches=max_matches,
-        compact_output=compact_output, lazy=False,
+        compact_output=compact_output, lazy=True,
     )
     newdev.kernel_cache = kcache
     newdev.dirty_full_threshold = dirty_threshold
     newdev.dirty_regions = hasattr(fresh, "track_regions")
+    if join:
+        newdev.join_enabled = True
+    newdev.sync(full=True)
     if kcache is not None:
         s, hb, _d = fresh.shape_key()
         kcache.prewarm_shape(s, hb)
@@ -290,6 +297,9 @@ class MatchService:
         compact_min_mutations: int = 1024,
         dirty_threshold: float = 0.5,
         prewarm: bool = True,
+        backend: str = "hash",
+        autotune: bool = True,
+        autotune_reps: int = 3,
         hists: Any = None,
         flightrec: Any = None,
     ) -> None:
@@ -388,6 +398,33 @@ class MatchService:
             if hasattr(self.inc, "track_regions"):
                 self.inc.track_regions = True
                 self.dev.dirty_regions = True
+        # kernel backend routing (ISSUE 13): "hash" = the cuckoo-probe
+        # kernel (default, byte-identical to the pre-join path),
+        # "join" = the sorted-relation kernel, "auto" = per-shape picks
+        # from a measured, persisted autotuner.  join/auto turn the
+        # DeviceNfa relation mirror on; flag off every join structure
+        # stays unbuilt.
+        self.backend = backend
+        self.tuner = None
+        self._tuning: Set[str] = set()
+        self._seg_join_seed = None   # (epoch, shape_key, arrays)
+        # reservoir of recently SERVED topics: what autotune measures
+        # with, so picks reflect real traffic shape, not dummy batches
+        self._topic_sample: Deque[str] = deque(maxlen=256)
+        if backend in ("join", "auto"):
+            self.dev.enable_join()
+        if backend == "auto" and autotune:
+            from ..ops.join_match import BackendAutotuner
+
+            self.tuner = BackendAutotuner(
+                path=(os.path.join(segments_dir, "autotune.json")
+                      if self.segments else None),
+                reps=autotune_reps)
+        if self.kcache is not None and backend == "auto":
+            # prewarm must cover BOTH kernel families, or the first
+            # auto-routed join dispatch on a fresh shape eats a
+            # CompileMiss → CPU hop (ISSUE 13 bugfix)
+            self.kcache.auto_backends = ("hash", "join")
         self._ref: Dict[str, int] = {}     # wildcard filter -> route count
         self._deep: Dict[str, int] = {}    # too-deep filter -> alias aid
         self._deep_trie = FilterTrie()     # host match for too-deep filters
@@ -667,6 +704,16 @@ class MatchService:
                     inc.track_regions = True
                 self._deep = dict(seg.deep)
                 self._routing_aids = set(seg.routing_aids)
+                if seg.join_start is not None:
+                    # persisted sorted relation: seeds the join mirror
+                    # at the first full sync iff the epoch still
+                    # matches (no drift since the segment was written)
+                    self._seg_join_seed = (
+                        seg.epoch,
+                        (int(seg.node_tab.shape[0]),
+                         int(seg.edge_tab.shape[0]), seg.depth),
+                        (seg.join_start, seg.join_word, seg.join_next),
+                    )
             else:
                 # native table (or a kind mismatch): replay the filter
                 # blob through the bulk path — one native call, not one
@@ -719,6 +766,9 @@ class MatchService:
         dev.dirty_full_threshold = self.dev.dirty_full_threshold
         dev.dirty_regions = (self.segments
                              and hasattr(inc, "track_regions"))
+        if self.backend in ("join", "auto"):
+            seed, self._seg_join_seed = self._seg_join_seed, None
+            dev.enable_join(seed=seed)
         self.dev = dev
 
     def _reconcile_with_router(self, table_set: Set[str],
@@ -826,20 +876,93 @@ class MatchService:
         # compile the wrong variant and the first live batch would still
         # stall on an XLA compile.  Pipeline mode dispatches through the
         # DONATED jit twin, a separate executable: warm that variant too
-        # (fresh operands each pass — donation consumes them).
+        # (fresh operands each pass — donation consumes them).  Under
+        # backend routing every family auto can pick must warm, or the
+        # first re-routed batch stalls exactly like an unwarmed shape.
         donates = (False, True) if self.pipeline else (False,)
+        backends = (("hash", "join") if self.backend == "auto"
+                    else (self.backend,))
         for donate in donates:
-            words, lens, is_sys = encode_batch(self.inc, [], batch=64)
-            self.dev.match(words, lens, is_sys,
-                           flat_cap=self.FLAT_MULT * 64,
-                           donate_inputs=donate)
-            if self.short_depth and self.short_depth < self.depth:
-                # pre-pay the short-depth kernel shape too, or the first
-                # split batch stalls the serving loop on an XLA compile
-                w, l, sy = encode_batch(self.inc, [], batch=64,
-                                        depth=self.short_depth)
-                self.dev.match(w, l, sy, flat_cap=self.FLAT_MULT * 64,
-                               donate_inputs=donate)
+            for be in backends:
+                words, lens, is_sys = encode_batch(self.inc, [], batch=64)
+                self.dev.match(words, lens, is_sys,
+                               flat_cap=self.FLAT_MULT * 64,
+                               donate_inputs=donate, backend=be)
+                if self.short_depth and self.short_depth < self.depth:
+                    # pre-pay the short-depth kernel shape too, or the
+                    # first split batch stalls the loop on an XLA compile
+                    w, l, sy = encode_batch(self.inc, [], batch=64,
+                                            depth=self.short_depth)
+                    self.dev.match(w, l, sy,
+                                   flat_cap=self.FLAT_MULT * 64,
+                                   donate_inputs=donate, backend=be)
+
+    # ------------------------------------------------------------------
+    # kernel backend routing (opt-in, match.backend)
+    # ------------------------------------------------------------------
+
+    def _backend_for(self, b: int, d: int) -> str:
+        """Which kernel family serves a (batch, depth) group: the pinned
+        backend, or — under ``auto`` — the autotuner's measured pick for
+        the current table shape.  An unmeasured shape serves hash (the
+        known-good default) and schedules a background measurement; the
+        dispatch path never waits on one."""
+        if self.backend != "auto":
+            return self.backend
+        t = self.tuner
+        if t is None:
+            return "hash"
+        s, hb, _depth = self.inc.shape_key()
+        sig = t.sig(b, d, s, hb)
+        pick = t.pick(sig)
+        if pick is not None:
+            return pick
+        if sig not in self._tuning and self._topic_sample:
+            self._tuning.add(sig)
+            # non-daemon, like the kernel cache's background compile: a
+            # daemon thread racing XLA teardown at exit segfaults
+            import threading
+
+            threading.Thread(
+                target=self._autotune_measure, args=(sig, b, d),
+                name="match-autotune",
+            ).start()
+        return "hash"
+
+    def _autotune_measure(self, sig: str, b: int, d: int) -> None:
+        """Measurement thread: time hash vs join on the reservoir of
+        recently served topics at exactly the dispatch shape, record
+        the pick (persisted when segments are on).  Failures leave the
+        default routing — a lost measurement is retried on a later
+        dispatch of the same shape."""
+        import jax
+
+        from ..ops import encode_batch
+
+        try:
+            topics = list(self._topic_sample)
+            if not topics or self.tuner is None:
+                return
+            names = (topics * (b // len(topics) + 1))[:b]
+            inc, dev = self.inc, self.dev
+
+            def runner(be):
+                def go():
+                    enc = encode_batch(inc, names, batch=b, depth=d)
+                    res = dev.match(
+                        *enc, flat_cap=self.FLAT_MULT * b, backend=be)
+                    jax.device_get(res.n_matches)   # block to completion
+                return go
+
+            self.tuner.measure(
+                sig, {"hash": runner("hash"), "join": runner("join")})
+            if self.metrics is not None:
+                self.metrics.inc("tpu.match.autotune_picks")
+        except Exception:
+            log.debug("autotune measurement for %s failed", sig,
+                      exc_info=True)
+        finally:
+            self._tuning.discard(sig)
 
     async def _compact_loop(self) -> None:
         """Supervised ``table.compact`` child: periodically folds the
@@ -886,6 +1009,7 @@ class MatchService:
                 self.dev.active_slots, self.dev.max_matches,
                 self.dev.compact_output, self.kcache,
                 self.dev.dirty_full_threshold, self._segment_path,
+                self.backend in ("join", "auto"),
             )
         finally:
             self._compact_recording = False
@@ -1383,7 +1507,11 @@ class MatchService:
         handles = []
         enc_ns = disp_ns = 0
         gen = self._table_gen
+        # autotune reservoir: a slice of what this dispatch actually
+        # serves (deque append is GIL-atomic; readers tolerate skew)
+        self._topic_sample.extend(topics[:8])
         for idx, d in groups:
+            be = self._backend_for(_bucket(len(idx)), d)
             t0 = time.perf_counter_ns()
             enc = encode_batch(inc, [topics[i] for i in idx],
                                batch=_bucket(len(idx)), depth=d)
@@ -1394,8 +1522,12 @@ class MatchService:
                 # raises CompileMiss (CPU trie answers, shape warms in
                 # the background) instead of stalling the batch
                 block_compile=(dev.kernel_cache is None),
-                donate_inputs=donate)
+                donate_inputs=donate, backend=be)
             t2 = time.perf_counter_ns()
+            if be == "join" and self.metrics is not None:
+                # this worker is the single in-flight encode stage, so
+                # the counter has one writer (same as the histograms)
+                self.metrics.inc("tpu.match.backend_join_dispatches")
             enc_ns += t1 - t0
             disp_ns += t2 - t1
             # stage spans: this worker is the single in-flight encode
@@ -2193,6 +2325,11 @@ class MatchService:
             "est_split_warm": (
                 self._est_split_samples >= self.SPLIT_WARM),
             "pending": len(self._pending),
+            # kernel backend routing (ISSUE 13)
+            "backend": self.backend,
+            "join_rebuilds": self.dev.join_rebuilds,
+            "autotune": (self.tuner.info()
+                         if self.tuner is not None else None),
             "segments": ({
                 "dir": self.segments_dir,
                 "loaded": self._segment_loaded,

@@ -404,6 +404,19 @@ SCHEMA: Dict[str, Field] = {
     # max device batches past dispatch awaiting readback (2 = classic
     # double buffering: one queued while one reads back)
     "match.pipeline.depth": Field(2, int, lambda v: v >= 1),
+    # kernel backend for the device match (ops/join_match.py): "hash"
+    # keeps the cuckoo-probe kernel (byte-identical default), "join"
+    # serves every dispatch from the sorted-relation kernel (TrieJax
+    # recast: searchsorted intersections, no bucket padding), "auto"
+    # routes per shape from the measured autotuner pick table
+    "match.backend": Field("hash", _enum("hash", "join", "auto")),
+    # autotuner (effective only with match.backend=auto): measure
+    # hash-vs-join per (B, D, S, Hb) shape on recently served topics;
+    # the pick table persists as checksummed JSON next to the XLA disk
+    # cache when match.segments.enable is on (corrupt files rejected)
+    "match.autotune.enable": Field(True, _bool),
+    # timing repetitions per backend per shape (min is taken)
+    "match.autotune.reps": Field(3, int, lambda v: 1 <= v <= 64),
 
     # -- streaming table lifecycle (broker/match_service.py) --------------
     # opt-in: cold start from persistent compacted segments + background

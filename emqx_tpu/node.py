@@ -193,6 +193,11 @@ class BrokerNode:
 
         self.hists = HistSet("main") if cfg.get("obs.hist.enable") \
             else None
+        if self.hists is not None:
+            # sync publish path spans: traffic bypassing the fanout
+            # pipeline (shape gate, fanout off) records into the same
+            # deliver/flush/e2e histograms the batched drain writes
+            self.broker.attach_hists(self.hists)
         self.flightrec = FlightRecorder(
             self.tracing.dir,
             depth=cfg.get("obs.flightrec.depth"),
@@ -999,6 +1004,9 @@ class BrokerNode:
                     "match.segments.compact_min_mutations"),
                 dirty_threshold=cfg.get("match.segments.dirty_threshold"),
                 prewarm=cfg.get("match.segments.prewarm"),
+                backend=cfg.get("match.backend"),
+                autotune=cfg.get("match.autotune.enable"),
+                autotune_reps=cfg.get("match.autotune.reps"),
                 hists=self.hists,
                 flightrec=self.flightrec,
             )

@@ -149,12 +149,17 @@ ROBUSTNESS_METRIC_NAMES: List[str] = [
 # match.pipeline.enable); readback_bytes accumulates the d2h bytes the
 # match readback path actually shipped (inc) — with the two-phase
 # proportional readback this is 4·(B + Σcounts) per batch instead of
-# the 4·FLAT_MULT·B slab.
+# the 4·FLAT_MULT·B slab.  backend_join_dispatches counts kernel
+# dispatches served by the relational-join backend (inc, one per depth
+# group; opt-in via match.backend) and autotune_picks the per-shape
+# hash-vs-join measurements the autotuner recorded (inc, one per
+# freshly measured shape).
 MATCH_SERVE_METRIC_NAMES: List[str] = [
     "broker.match.deadline_dispatch", "broker.match.cpu_fallback",
     "broker.match.deadline_miss", "broker.match.breaker_state",
     "broker.match.brownout_level", "broker.match.pipeline_inflight",
     "tpu.match.readback_bytes",
+    "tpu.match.backend_join_dispatches", "tpu.match.autotune_picks",
 ]
 
 # -- streaming table lifecycle (broker/match_service.py, opt-in via

@@ -4,9 +4,13 @@ from .compiler import BUCKET_SLOTS, NfaTable, compile_filters, encode_topics
 from .device_table import DeviceNfa
 from .encode import TopicEncoder, encode_batch
 from .incremental import IncrementalNfa, NfaDelta
+from .join_match import BackendAutotuner, JoinRelation, join_match
 from .match_kernel import MatchResult, build_matcher, match_topics, nfa_match
 
 __all__ = [
+    "BackendAutotuner",
+    "JoinRelation",
+    "join_match",
     "BUCKET_SLOTS",
     "NfaTable",
     "compile_filters",

@@ -41,6 +41,13 @@ def _scatter_rows(tab, idx, rows):
     return tab.at[idx].set(rows, mode="drop", unique_indices=False)
 
 
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_vals(arr, idx, vals):
+    """arr[idx] = vals for 1-D arrays, in place (donated) — the join
+    relation's tombstone/revival path."""
+    return arr.at[idx].set(vals, mode="drop", unique_indices=False)
+
+
 # fixed scatter chunk: every delta ships as ceil(n/CHUNK) scatters of
 # exactly CHUNK rows (padding repeats row 0 — same index, same contents,
 # an idempotent no-op scatter).
@@ -59,6 +66,20 @@ def _chunks(idx: np.ndarray, rows: np.ndarray):
         yield ci, cr
 
 
+def _chunks1(idx: np.ndarray, vals: np.ndarray):
+    """1-D twin of :func:`_chunks` (join-relation value scatters):
+    fixed-size chunks, padding repeats entry 0 (idempotent)."""
+    n = len(idx)
+    for lo in range(0, n, SCATTER_CHUNK):
+        ci = idx[lo:lo + SCATTER_CHUNK]
+        cv = vals[lo:lo + SCATTER_CHUNK]
+        if len(ci) < SCATTER_CHUNK:
+            pad = SCATTER_CHUNK - len(ci)
+            ci = np.concatenate([ci, np.full(pad, ci[0], ci.dtype)])
+            cv = np.concatenate([cv, np.full(pad, cv[0], cv.dtype)])
+        yield ci, cv
+
+
 class PendingSync(NamedTuple):
     """Drained host state, safe to apply from any thread: the arrays are
     stable copies, never aliases of the live mutable table."""
@@ -70,8 +91,13 @@ class PendingSync(NamedTuple):
     # dirty-region grow path (``dirty_regions`` mode): a resized delta
     # whose node prefix is still valid on device ships only the grown
     # region + dirty rows; when the edge table was rehashed its full
-    # contents ride here (node still grows in place).
+    # contents ride here (node still grows in place).  A rehash also
+    # drew FRESH seeds — they must ship with the table, or the device
+    # keeps mixing with the old pair and every lookup misses (found by
+    # the join backend's parity suite: the relation is seed-free, so
+    # it kept answering while the hash kernel went dark).
     edge_full: Optional[np.ndarray] = None
+    seeds_full: Optional[np.ndarray] = None
 
     @property
     def empty(self) -> bool:
@@ -118,6 +144,16 @@ class DeviceNfa:
         # when set, match() dispatches through pre-compiled executables
         # so a table resize never stalls a serve batch on an XLA compile
         self.kernel_cache = None
+        # relational-join backend (ops/join_match.py, opt-in): when
+        # enabled the device ALSO mirrors the sorted edge relation so
+        # match(backend="join") can serve; maintenance rides the same
+        # drain/apply cycle (tombstone/overlay scatters per delta, one
+        # rebuild on rehash/compact/overlay-overflow)
+        self.join_enabled = False
+        self._join = None                 # host JoinRelation
+        self._jarrs = None                # device relation arrays
+        self._join_seed = None            # (epoch, shape_key, arrays)
+        self.join_rebuilds = 0            # full relation re-uploads
         self._shape_key = None
         self._arrs: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
         self._lock = threading.Lock()
@@ -170,6 +206,7 @@ class DeviceNfa:
             return PendingSync(
                 delta=delta, full=None, shape_key=key, epoch=delta.epoch,
                 edge_full=self.inc.edge_tab.copy() if rehash else None,
+                seeds_full=self.inc.seeds.copy() if rehash else None,
             )
         if full or delta.resized or self._shape_key != self.inc.shape_key():
             if hasattr(self.inc, "tables"):  # native table: one export
@@ -222,6 +259,8 @@ class DeviceNfa:
             except Exception:
                 self._arrs = None
                 self._shape_key = None  # force full re-upload next drain
+                self._join = None       # relation rebuilt with the table
+                self._jarrs = None
                 raise
 
     def _apply_locked(self, p: PendingSync) -> bool:
@@ -233,6 +272,8 @@ class DeviceNfa:
             self.uploads += 1
             node, edge = self._warm_scatter(node, edge, p.full)
             self._arrs = (node, edge, seeds)
+            if self.join_enabled:
+                self._join_full(p)
             self.epoch = p.epoch
             self.inc.device_epoch = p.epoch
             return True
@@ -250,6 +291,8 @@ class DeviceNfa:
         for idx, rows in _chunks(p.delta.bucket_idx, p.delta.bucket_rows):
             edge = _scatter_rows(edge, self._put(idx), self._put(rows))
         self._arrs = (node, edge, seeds)
+        if self.join_enabled and self._join is not None:
+            self._join_delta(p.delta)
         self.epoch = p.delta.epoch
         self.inc.device_epoch = p.delta.epoch
         self.delta_applies += 1
@@ -278,6 +321,8 @@ class DeviceNfa:
             node = jnp.concatenate([node, pad], axis=0)
         if p.edge_full is not None:
             edge = self._put(p.edge_full)
+            if p.seeds_full is not None:
+                seeds = self._put(p.seeds_full)
         elif int(edge.shape[0]) != target_hb:
             raise RuntimeError(
                 f"grow-in-place edge mismatch: device Hb={edge.shape[0]} "
@@ -288,6 +333,26 @@ class DeviceNfa:
             edge = _scatter_rows(edge, self._put(idx), self._put(rows))
         self._shape_key = p.shape_key
         self._arrs = (node, edge, seeds)
+        if self.join_enabled and self._join is not None:
+            if p.edge_full is not None:
+                # cuckoo rehash: the relation's CAPACITY moved with Hb,
+                # so rebuild from the shipped table (note the edge SET
+                # often barely changed — the rebuild is the capacity
+                # resize, same amortized class as the rehash itself)
+                self._join.rebuild(target_s, p.edge_full)
+                self._put_join()
+            else:
+                self._join.grow_states(target_s)
+                ss, ew, en, ov = self._jarrs
+                grow_ss = (target_s + 1) - int(ss.shape[0])
+                if grow_ss > 0:
+                    # new states have no CSR segment: pad the offsets
+                    # device-side with the terminal value (no h2d for
+                    # the surviving prefix — the grow-in-place idiom)
+                    ss = jnp.concatenate(
+                        [ss, jnp.broadcast_to(ss[-1:], (grow_ss,))])
+                self._jarrs = (ss, ew, en, ov)
+                self._join_delta(p.delta)
         self.epoch = p.delta.epoch
         self.inc.device_epoch = p.delta.epoch
         self.grow_applies += 1
@@ -298,6 +363,82 @@ class DeviceNfa:
     def sync(self, full: bool = False) -> bool:
         """Single-threaded convenience: drain + apply in one call."""
         return self.apply_pending(self.drain(full=full))
+
+    # -- join-relation mirror (ops/join_match.py, opt-in) ------------------
+
+    def enable_join(self, seed=None) -> None:
+        """Turn the sorted-relation mirror on.  ``seed`` is an optional
+        ``(epoch, shape_key, (state_start, edge_word, edge_next))``
+        tuple from a persisted segment — used at the next full upload
+        iff the epoch still matches (skips the build sort).  On an
+        ALREADY-synced twin the relation builds now, from the device
+        copy of the edge table (the truth the kernels see)."""
+        self.join_enabled = True
+        self._join_seed = seed
+        if self._arrs is not None and self._jarrs is None:
+            from .join_match import JoinRelation
+
+            node, edge, _seeds = self._arrs
+            self._join = JoinRelation(
+                int(node.shape[0]), np.asarray(jax.device_get(edge)))
+            self._put_join()
+
+    def _join_full(self, p: PendingSync) -> None:
+        """Full-upload half of the relation mirror: seed from a
+        persisted segment when provably fresh, else one lexsort."""
+        from .join_match import JoinRelation
+
+        s = int(p.full[0].shape[0])
+        seed = self._join_seed
+        self._join_seed = None
+        self._join = None
+        if seed is not None and seed[0] == p.epoch \
+                and tuple(seed[1]) == tuple(p.shape_key):
+            try:
+                self._join = JoinRelation(s, p.full[1], arrays=seed[2])
+            except ValueError:
+                self._join = None  # malformed seed: sort fresh below
+        if self._join is None:
+            self._join = JoinRelation(s, p.full[1])
+        self._put_join()
+
+    def _put_join(self) -> None:
+        """Ship the whole relation + warm its scatter shapes (the same
+        pre-pay idiom as ``_warm_scatter``)."""
+        start, word, nxt, overlay = self._join.arrays()
+        ss = self._put(start)
+        ew = self._put(word)
+        en = self._put(nxt)
+        ov = self._put(overlay)
+        z = self._put(np.zeros(SCATTER_CHUNK, np.int32))
+        en = _scatter_vals(
+            en, z, self._put(np.full(SCATTER_CHUNK, nxt[0], np.int32)))
+        ov = _scatter_rows(
+            ov, z, self._put(np.tile(overlay[0], (SCATTER_CHUNK, 1))))
+        self._jarrs = (ss, ew, en, ov)
+        self.join_rebuilds += 1
+
+    def _join_delta(self, delta: NfaDelta) -> None:
+        """Delta half: tombstone/revival scatters on ``edge_next`` +
+        overlay row writes — O(changed edges) d2h, zero for the node
+        side.  Overlay overflow (or shadow drift) rebuilds from the
+        already-updated shadow."""
+        from .join_match import OverlayFull
+
+        try:
+            mpos, mval, opos, orows = self._join.apply_bucket_delta(
+                delta.bucket_idx, delta.bucket_rows)
+        except OverlayFull:
+            self._join.rebuild(len(self._join.state_start) - 1)
+            self._put_join()
+            return
+        ss, ew, en, ov = self._jarrs
+        for idx, vals in _chunks1(mpos, mval):
+            en = _scatter_vals(en, self._put(idx), self._put(vals))
+        for idx, rows in _chunks(opos, orows):
+            ov = _scatter_rows(ov, self._put(idx), self._put(rows))
+        self._jarrs = (ss, ew, en, ov)
+        self.dirty_rows_uploaded += len(mpos) + len(opos)
 
     def _warm_scatter(self, node, edge, full):
         """Pre-pay the scatter compiles for the current shapes so the
@@ -318,7 +459,8 @@ class DeviceNfa:
 
     def match(self, words, lens, is_sys, *,
               flat_cap: int = 0, block_compile: bool = True,
-              donate_inputs: bool = False) -> MatchResult:
+              donate_inputs: bool = False,
+              backend: Optional[str] = None) -> MatchResult:
         """Run the kernel on already-encoded operands.  Dispatch happens
         under the device lock; the returned arrays are futures — callers
         block (np.asarray) outside any lock.  ``flat_cap`` > 0 selects
@@ -330,9 +472,15 @@ class DeviceNfa:
         ``donate_inputs`` hands the batch operand buffers to the kernel
         (the pipelined serve chain's idiom — the caller must not touch
         words/lens/is_sys afterwards; same donation contract as
-        ``_scatter_rows``)."""
+        ``_scatter_rows``).  ``backend`` selects the edge-structure
+        kernel ("hash" default; "join" rides the sorted-relation mirror
+        and silently falls back to hash while the relation is not yet
+        mirrored — both kernels answer identically)."""
         with self._lock:
             node, edge, seeds = self.arrays()
+            be = backend or "hash"
+            if be == "join" and self._jarrs is None:
+                be = "hash"
             kc = self.kernel_cache
             if kc is not None and self.device is None:
                 fn = kc.executable(
@@ -343,9 +491,23 @@ class DeviceNfa:
                     compact_output=self.compact_output,
                     flat_cap=flat_cap,
                     donate=donate_inputs,
+                    backend=be,
                     block=block_compile,
                 )
+                if be == "join":
+                    return fn(words, lens, is_sys, node, *self._jarrs)
                 return fn(words, lens, is_sys, node, edge, seeds)
+            if be == "join":
+                from .join_match import join_match, join_match_donated
+
+                jfn = join_match_donated if donate_inputs else join_match
+                return jfn(
+                    words, lens, is_sys, node, *self._jarrs,
+                    active_slots=self.active_slots,
+                    max_matches=self.max_matches,
+                    compact_output=self.compact_output,
+                    flat_cap=flat_cap,
+                )
             fn = nfa_match_donated if donate_inputs else nfa_match
             return fn(
                 words, lens, is_sys, node, edge, seeds,

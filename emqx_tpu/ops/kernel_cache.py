@@ -36,8 +36,13 @@ log = logging.getLogger(__name__)
 
 __all__ = ["MatchKernelCache", "CompileMiss"]
 
-#: (B, D, S, Hb, active_slots, max_matches, compact, flat_cap, donate)
-Key = Tuple[int, int, int, int, int, int, bool, int, bool]
+#: (B, D, S, Hb, active_slots, max_matches, compact, flat_cap, donate,
+#: backend).  ``backend`` selects the kernel family: "hash" is the
+#: cuckoo-probe nfa_match, "join" the sorted-relation kernel
+#: (ops/join_match.py) whose edge-structure shapes DERIVE from the same
+#: (S, Hb) pair (relation capacity = Hb * BUCKET_SLOTS), so one shape
+#: key covers both families.
+Key = Tuple[int, int, int, int, int, int, bool, int, bool, str]
 
 
 class CompileMiss(RuntimeError):
@@ -54,11 +59,18 @@ class MatchKernelCache:
         self._inflight: Set[Key] = set()
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
-        # every (B, D, A, K, compact, flat_cap, donate) combo ever
-        # requested: what prewarm_shape replays against the NEXT table
-        # shape
+        # every (B, D, A, K, compact, flat_cap, donate, backend) combo
+        # ever requested: what prewarm_shape replays against the NEXT
+        # table shape
         self._combos: Set[Tuple[int, int, int, int, bool, int,
-                                bool]] = set()
+                                bool, str]] = set()
+        # backends prewarm_shape covers for EVERY combo regardless of
+        # which backend the combo was first requested under: with
+        # match.backend=auto the first requests route hash (the cold
+        # default), so a combo-only replay would leave the join variant
+        # uncompiled and the first auto-routed join dispatch on a fresh
+        # shape would eat a CompileMiss → CPU hop (ISSUE 13 bugfix)
+        self.auto_backends: Tuple[str, ...] = ()
         self.compiles = 0
         self.hits = 0
         self.misses = 0
@@ -69,15 +81,16 @@ class MatchKernelCache:
     def key(batch_shape: Tuple[int, int], s: int, hb: int, *,
             active_slots: int, max_matches: int,
             compact_output: bool, flat_cap: int,
-            donate: bool = False) -> Key:
+            donate: bool = False, backend: str = "hash") -> Key:
         b, d = batch_shape
         return (b, d, s, hb, active_slots, max_matches,
-                bool(compact_output), flat_cap, bool(donate))
+                bool(compact_output), flat_cap, bool(donate), backend)
 
     def executable(self, batch_shape: Tuple[int, int], s: int, hb: int, *,
                    active_slots: int, max_matches: int,
                    compact_output: bool, flat_cap: int,
-                   donate: bool = False, block: bool = True):
+                   donate: bool = False, backend: str = "hash",
+                   block: bool = True):
         """The compiled executable for these operand shapes — cached, or
         compiled NOW (blocking; counted, so a resize that was prewarmed
         shows zero compiles on the serve path).  With ``block=False`` a
@@ -87,9 +100,10 @@ class MatchKernelCache:
         k = self.key(batch_shape, s, hb, active_slots=active_slots,
                      max_matches=max_matches,
                      compact_output=compact_output, flat_cap=flat_cap,
-                     donate=donate)
+                     donate=donate, backend=backend)
         with self._lock:
-            self._combos.add((k[0], k[1], k[4], k[5], k[6], k[7], k[8]))
+            self._combos.add((k[0], k[1], k[4], k[5], k[6], k[7], k[8],
+                              k[9]))
             fn = self._compiled.get(k)
             if fn is not None:
                 self.hits += 1
@@ -126,32 +140,49 @@ class MatchKernelCache:
     def warmed(self, batch_shape: Tuple[int, int], s: int, hb: int, *,
                active_slots: int, max_matches: int,
                compact_output: bool, flat_cap: int,
-               donate: bool = False) -> bool:
+               donate: bool = False, backend: str = "hash") -> bool:
         k = self.key(batch_shape, s, hb, active_slots=active_slots,
                      max_matches=max_matches,
                      compact_output=compact_output, flat_cap=flat_cap,
-                     donate=donate)
+                     donate=donate, backend=backend)
         with self._lock:
             return k in self._compiled
 
-    def shape_covered(self, s: int, hb: int) -> bool:
-        """Every observed batch combo already compiled for (s, hb)?"""
+    def _expanded_combos(self) -> list:
+        """Observed combos crossed with ``auto_backends``: under
+        per-shape routing every covered shape must hold BOTH kernel
+        families, or the autotuner's first re-route eats a miss."""
         with self._lock:
             combos = list(self._combos)
+            extra = tuple(self.auto_backends)
+        out = []
+        seen = set()
+        for combo in combos:
+            for be in (combo[7],) + extra:
+                c = combo[:7] + (be,)
+                if c not in seen:
+                    seen.add(c)
+                    out.append(c)
+        return out
+
+    def shape_covered(self, s: int, hb: int) -> bool:
+        """Every observed batch combo (crossed with the auto-routing
+        backends) already compiled for (s, hb)?"""
+        combos = self._expanded_combos()
+        with self._lock:
             return bool(combos) and all(
-                (b, d, s, hb, a, m, c, f, dn) in self._compiled
-                for (b, d, a, m, c, f, dn) in combos
+                (b, d, s, hb, a, m, c, f, dn, be) in self._compiled
+                for (b, d, a, m, c, f, dn, be) in combos
             )
 
     def prewarm_shape(self, s: int, hb: int) -> int:
         """Compile every observed batch combo against table shape
         ``(s, hb)`` — the background step that makes the NEXT pow2
-        resize free.  Returns the number of fresh compiles."""
-        with self._lock:
-            combos = list(self._combos)
+        resize free — for every backend ``auto`` may route to.
+        Returns the number of fresh compiles."""
         n = 0
-        for (b, d, a, m, c, f, dn) in combos:
-            k = (b, d, s, hb, a, m, c, f, dn)
+        for (b, d, a, m, c, f, dn, be) in self._expanded_combos():
+            k = (b, d, s, hb, a, m, c, f, dn, be)
             with self._lock:
                 if k in self._compiled:
                     continue
@@ -188,15 +219,36 @@ class MatchKernelCache:
         from .compiler import BUCKET_SLOTS
         from .match_kernel import nfa_match, nfa_match_donated
 
-        b, d, s, hb, a, m, compact, flat_cap, donate = k
+        b, d, s, hb, a, m, compact, flat_cap, donate, backend = k
         i32 = jnp.int32
         sd = jax.ShapeDtypeStruct
-        fn = nfa_match_donated if donate else nfa_match
-        lowered = fn.lower(
+        batch = (
             sd((b, d), i32),                      # words
             sd((b,), i32),                        # lens
             sd((b,), jnp.bool_),                  # is_sys
             sd((s, 4), i32),                      # node_tab
+        )
+        if backend == "join":
+            from .join_match import (
+                OVERLAY_CAP, join_match, join_match_donated,
+                relation_capacity,
+            )
+
+            e_cap = relation_capacity(hb)
+            fn = join_match_donated if donate else join_match
+            lowered = fn.lower(
+                *batch,
+                sd((s + 1,), i32),                # state_start
+                sd((e_cap,), i32),                # edge_word
+                sd((e_cap,), i32),                # edge_next
+                sd((OVERLAY_CAP, 3), i32),        # overlay
+                active_slots=a, max_matches=m,
+                compact_output=compact, flat_cap=flat_cap,
+            )
+            return lowered.compile()
+        fn = nfa_match_donated if donate else nfa_match
+        lowered = fn.lower(
+            *batch,
             sd((hb, BUCKET_SLOTS * 4), i32),      # edge_tab
             sd((2,), i32),                        # seeds
             active_slots=a, max_matches=m,

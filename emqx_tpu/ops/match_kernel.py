@@ -47,7 +47,7 @@ from .compiler import BUCKET_SLOTS, NfaTable, encode_topics
 
 __all__ = ["MatchResult", "SERVE_FLAT_MULT", "build_matcher",
            "decode_flat", "decode_row_meta", "fetch_flat_prefix",
-           "match_topics", "nfa_match", "nfa_match_donated"]
+           "match_topics", "nfa_match", "nfa_match_donated", "nfa_walk"]
 
 # serving flat-output capacity per padded batch row (ids/topic): shared
 # by every serving engine so the fan-out tuning cannot drift between
@@ -206,19 +206,24 @@ def flat_epilogue(flat, n, aover, max_matches: int, flat_cap: int):
     return matches, mover, row_meta
 
 
-def _nfa_match(
+def nfa_walk(
     words,        # (B, D) int32
     lens,         # (B,) int32
     is_sys,       # (B,) bool
     node_tab,     # (S, 4) int32: [plus_child, hash_accept, accept, 0]
-    edge_tab,     # (Hb, BUCKET_SLOTS*4) int32 cuckoo buckets
-    seeds,        # (2,) int32
+    edge_lookup,  # (state (B,w), word (B,w)) -> next (B,w), -1 on miss
     *,
     active_slots: int = 16,
     max_matches: int = 32,
     compact_output: bool = True,
     flat_cap: int = 0,
 ) -> MatchResult:
+    """The backend-agnostic level walk: accepts, ``+`` transitions and
+    the epilogue are identical for every edge-structure backend — only
+    the literal-edge lookup is pluggable (the cuckoo hash probe here,
+    the sorted-relation ``searchsorted`` join step in
+    :mod:`~emqx_tpu.ops.join_match`), so hint/match parity between
+    backends is structural, not re-implemented."""
     B, D = words.shape
     A = active_slots
     K = max_matches
@@ -254,7 +259,7 @@ def _nfa_match(
 
         # --- transition ---------------------------------------------------
         w = jnp.broadcast_to(words[:, t][:, None], active.shape)
-        lit = _edge_lookup(active, w, edge_tab, seeds)
+        lit = edge_lookup(active, w)
         lit = jnp.where(valid, lit, -1)
         plus = jnp.where(valid, plus_child, -1)
         if t == 0:
@@ -302,6 +307,27 @@ def _nfa_match(
         active_overflow=aover,
         match_overflow=mover,
         row_meta=row_meta,
+    )
+
+
+def _nfa_match(
+    words,        # (B, D) int32
+    lens,         # (B,) int32
+    is_sys,       # (B,) bool
+    node_tab,     # (S, 4) int32: [plus_child, hash_accept, accept, 0]
+    edge_tab,     # (Hb, BUCKET_SLOTS*4) int32 cuckoo buckets
+    seeds,        # (2,) int32
+    *,
+    active_slots: int = 16,
+    max_matches: int = 32,
+    compact_output: bool = True,
+    flat_cap: int = 0,
+) -> MatchResult:
+    return nfa_walk(
+        words, lens, is_sys, node_tab,
+        lambda st, w: _edge_lookup(st, w, edge_tab, seeds),
+        active_slots=active_slots, max_matches=max_matches,
+        compact_output=compact_output, flat_cap=flat_cap,
     )
 
 

@@ -47,7 +47,12 @@ import numpy as np
 __all__ = ["SegmentError", "Segment", "save_segment", "load_segment",
            "restore_incremental", "SEGMENT_VERSION"]
 
-SEGMENT_VERSION = 1
+# v2 (ISSUE 13): state segments may carry the sorted join-relation
+# arrays (join_start/join_word/join_next — the relational-join match
+# backend's CSR edge relation, ops/join_match.py) so a cold start can
+# seed the device mirror without re-paying the build sort.  v1 files
+# are version-rejected (full rebuild serves once after upgrade).
+SEGMENT_VERSION = 2
 
 _SEP = "\x00"  # MQTT strings never contain U+0000 (MQTT-1.5.4-2)
 
@@ -82,6 +87,11 @@ class Segment:
     n_filters: int = 0
     n_states: int = 0
     aid_reuses: int = 0
+    # sorted join-relation arrays (v2, optional — present when the
+    # writer served the join backend): CSR offsets + word/next columns
+    join_start: Optional[np.ndarray] = None  # (S+1,) int32
+    join_word: Optional[np.ndarray] = None   # (E_cap,) int32
+    join_next: Optional[np.ndarray] = None   # (E_cap,) int32
 
 
 def _blob(strings) -> np.ndarray:
@@ -135,11 +145,17 @@ def _trie_rows(inc) -> np.ndarray:
 
 def save_segment(path: str, inc, *, deep: Dict[str, int],
                  routing_aids, filters: Optional[List[str]] = None,
-                 extra_meta: Optional[dict] = None) -> dict:
+                 extra_meta: Optional[dict] = None,
+                 join_relation: bool = False) -> dict:
     """Serialize ``inc`` (+ the serving layer's deep/routing id state)
     to ``path`` atomically.  ``filters`` must be supplied for native
     tables (the caller already has the list — iterating the accept view
-    back out would cost one ctypes round trip per filter)."""
+    back out would cost one ctypes round trip per filter).
+
+    ``join_relation`` (state segments only) additionally persists the
+    sorted edge relation built fresh from the edge table — always
+    overlay-free, so a restore can seed the join backend's device
+    mirror verbatim (epoch-guarded by the consumer)."""
     is_state = hasattr(inc, "node_tab") and hasattr(inc, "root")
     meta: dict = {
         "version": SEGMENT_VERSION,
@@ -173,6 +189,16 @@ def save_segment(path: str, inc, *, deep: Dict[str, int],
                 [(e, a) for e, a in inc._free_aids], np.int64
             ).reshape(-1, 2),
         )
+        if join_relation:
+            from ..ops.join_match import JoinRelation
+
+            rel = JoinRelation(
+                int(inc.node_tab.shape[0]), inc.edge_tab)
+            arrays.update(
+                join_start=rel.state_start,
+                join_word=rel.edge_word,
+                join_next=rel.edge_next,
+            )
     else:
         if filters is None:
             raise ValueError(
@@ -244,6 +270,10 @@ def load_segment(path: str) -> Segment:
         seg.accept_filters = accept_filters
         seg.alias_aids = arrays["alias_aids"].tolist()
         seg.free_aids = arrays["free_aids"]
+        if "join_start" in arrays:
+            seg.join_start = arrays["join_start"]
+            seg.join_word = arrays["join_word"]
+            seg.join_next = arrays["join_next"]
         alias = set(seg.alias_aids)
         seg.filters = [
             f for aid, f in enumerate(accept_filters)

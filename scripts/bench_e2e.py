@@ -570,8 +570,9 @@ def main(argv=None) -> dict:
         _config1_size, _config1_sweep_size, _fanout_e2e_size,
         _qos1_e2e_size, _qos2_e2e_size, _table_lifecycle_size,
         bench_config1, bench_config1_sweep, bench_fanout_e2e,
-        bench_qos1_e2e, bench_qos2_e2e, bench_serve_deadline_smoke,
-        bench_serve_pipeline_smoke, bench_table_lifecycle,
+        bench_kernel_join_smoke, bench_qos1_e2e, bench_qos2_e2e,
+        bench_serve_deadline_smoke, bench_serve_pipeline_smoke,
+        bench_table_lifecycle,
     )
 
     size = _fanout_e2e_size(args.smoke)
@@ -607,6 +608,11 @@ def main(argv=None) -> dict:
     # full rebuild + churn soak across live compaction swaps
     out["table_lifecycle"] = bench_table_lifecycle(
         **_table_lifecycle_size(args.smoke))
+    # kernel backend A/B (ISSUE 13): hash vs join vs auto at one serve
+    # shape, short+deep mixes — the parity gate is CI-asserted, the
+    # speedup ratios are tracking numbers for the r06 hardware round
+    out["kernel_join"] = bench_kernel_join_smoke(
+        n_filters=(2000 if args.smoke else 20000))
     # stage-latency observatory parity (ISSUE 12): the serve sections'
     # p50/p99 now come from the product's histograms (observe/hist.py);
     # the legacy np.percentile extraction over the SAME post-warmup

@@ -6,6 +6,12 @@ def f(metrics, cfg, alarms, hooks, _injector, name):
     metrics.inc("messages.delivered")
     metrics.set("broker.fanout.depth", 3)
     metrics.get("broker.supervisor.restarts")
+    # kernel-backend routing literals (ISSUE 13)
+    metrics.inc("tpu.match.backend_join_dispatches")
+    metrics.inc("tpu.match.autotune_picks")
+    cfg.get("match.backend")
+    cfg.get("match.autotune.enable")
+    cfg.get("match.autotune.reps")
     cfg.get("mqtt.max_inflight")
     _injector.check("fanout.drain")
     alarms.activate("overload_fixture", {}, "hot")

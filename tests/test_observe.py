@@ -371,6 +371,62 @@ def test_hist_recording_sites_record_when_enabled():
     aio.run(main())
 
 
+def test_sync_publish_path_records_spans_on_fanout_bypass():
+    """ISSUE 13 observability follow-on (b): traffic the fanout gate
+    BYPASSES to the per-message ``Broker.publish`` path must still land
+    deliver/flush/e2e spans — bypass rates climbing no longer hollow
+    out the histograms."""
+    import asyncio as aio
+
+    from emqx_tpu.broker import Broker, FanoutPipeline, SubOpts, \
+        make_message
+    from emqx_tpu.observe.hist import HistSet
+
+    async def main():
+        b = Broker()
+        got = []
+        b.on_deliver = lambda cid, pubs: got.extend(pubs)
+        b.open_session("s")
+        b.subscribe("s", "t/#", SubOpts())
+        hs = HistSet("main")
+        b.attach_hists(hs)
+        # huge bypass threshold: the low-rate gate refuses every offer,
+        # exactly the path a quiet publisher rides in production
+        p = FanoutPipeline(b, window_s=0.0, hists=hs, bypass_rate=1e9)
+        await p.start()
+        b.fanout = p
+        for i in range(10):
+            m = make_message("pub", f"t/{i}", b"x")
+            if not p.offer(m):        # the caller contract: bypass →
+                b.publish(m)          # per-message sync path
+        await p.stop()
+        assert len(got) == 10
+        assert b.metrics is None     # bypass metric needs observe();
+        assert hs.hist("obs.stage.deliver").count >= 10
+        assert hs.hist("obs.stage.flush").count >= 10
+        assert hs.hist("obs.e2e.publish_deliver").count >= 10
+
+    aio.run(main())
+
+
+def test_sync_publish_spans_zero_call_when_unattached(monkeypatch):
+    """Without attach_hists the sync path stays an attribute check —
+    the zero-cost-when-off discipline every recording site follows."""
+    from emqx_tpu.observe.hist import LatencyHistogram
+
+    calls = []
+    monkeypatch.setattr(LatencyHistogram, "record",
+                        lambda self, ns: calls.append(ns))
+    monkeypatch.setattr(LatencyHistogram, "record_s",
+                        lambda self, s: calls.append(s))
+    b = Broker()
+    b.open_session("s")
+    b.subscribe("s", "t/#", SubOpts())
+    res = b.publish(make_message("pub", "t/1", b"x"))
+    assert res.matched == 1
+    assert calls == []
+
+
 def test_flightrec_ring_wraps_and_snapshots_in_order():
     from emqx_tpu.observe.flightrec import Ring
 
