@@ -425,6 +425,228 @@ def bench_config1_sweep(counts=(1000, 5000, 10000),
     return rows
 
 
+def _adversarial_size(smoke: bool) -> dict:
+    return ({"n_honest": 8, "honest_rate": 20.0, "duration": 2.5}
+            if smoke
+            else {"n_honest": 64, "honest_rate": 20.0, "duration": 8.0})
+
+
+def bench_adversarial(n_honest: int = 64, honest_rate: float = 20.0,
+                      duration: float = 8.0, attacker_frac: float = 0.05,
+                      attacker_mult: float = 10.0,
+                      storm_rate: float = 25.0,
+                      inflight: int = 16) -> dict:
+    """Hostile-traffic A/B (ISSUE 14, the P4-pipeline adversarial
+    scenario): ``n_honest`` QoS1 publisher/subscriber pairs at
+    ``honest_rate`` msgs/s each, plus **5% attackers at 10× the honest
+    rate** (QoS0 topic-scan floods — every message a fresh topic, the
+    shape the distinct-topic sketch exists for) and a CONNECT storm
+    (reconnect churn over a small clientid pool).  Three runs:
+
+    * ``clean``      — honest only, admission off: the p99 baseline;
+    * ``attack_off`` — attackers + storm, ``admission.enable`` OFF: the
+      brownout the admission plane exists to prevent (recorded, not
+      gated — it IS the regression);
+    * ``attack_on``  — same hostile mix, admission ON: the gates.
+
+    Gate booleans ride the JSON: flag-on holds honest delivery_ratio
+    1.0 and p99 within 1.5× of clean while the attackers are throttled
+    / quarantined / banned, and no honest client is ever flagged."""
+    import asyncio as aio
+
+    from emqx_tpu.bench_client import run_scenario
+    from emqx_tpu.config import Config
+    from emqx_tpu.mqtt import frame as F
+    from emqx_tpu.mqtt import packet as P
+    from emqx_tpu.node import BrokerNode
+
+    n_attackers = max(1, int(n_honest * attacker_frac))
+    attacker_rate = honest_rate * attacker_mult
+
+    async def attacker_loop(i: int, port: int, end_at: float,
+                            out: dict) -> None:
+        """QoS0 topic-scan flood from one attacker: distinct topic per
+        message.  A kick/ban closes the socket; the loop retries and
+        counts refused CONNECTs — the cheap-rejection win."""
+        seq = 0
+        interval = 1.0 / attacker_rate
+        while time.perf_counter() < end_at:
+            try:
+                reader, writer = await aio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(F.serialize(P.Connect(
+                    proto_ver=4, clientid=f"atk_{i}", clean_start=True)))
+                data = await aio.wait_for(reader.read(64), 5.0)
+                # CONNACK rc != 0 (BANNED maps to v3 code 5): refused
+                if len(data) >= 4 and data[3] != 0:
+                    out["refused"] += 1
+                    writer.close()
+                    await aio.sleep(0.25)
+                    continue
+                next_at = time.perf_counter()
+                while time.perf_counter() < end_at:
+                    now = time.perf_counter()
+                    if now < next_at:
+                        await aio.sleep(next_at - now)
+                    next_at += interval
+                    seq += 1
+                    writer.write(F.serialize(P.Publish(
+                        qos=0, topic=f"scan/{i}/{seq}", payload=b"x" * 64)))
+                    out["sent"] += 1
+                    if seq % 64 == 0:
+                        await writer.drain()
+                writer.close()
+            except (ConnectionError, OSError, aio.TimeoutError,
+                    aio.IncompleteReadError):
+                out["dropped_conns"] += 1
+                await aio.sleep(0.1)
+
+    async def storm_loop(port: int, end_at: float, out: dict) -> None:
+        """CONNECT storm: reconnect churn over 4 clientids — each one's
+        connect rate is storm_rate/4, far past any honest client's."""
+        j = 0
+        interval = 1.0 / storm_rate
+        while time.perf_counter() < end_at:
+            t0 = time.perf_counter()
+            try:
+                reader, writer = await aio.open_connection(
+                    "127.0.0.1", port)
+                writer.write(F.serialize(P.Connect(
+                    proto_ver=4, clientid=f"storm_{j % 4}",
+                    clean_start=True)))
+                data = await aio.wait_for(reader.read(64), 5.0)
+                if len(data) >= 4 and data[3] != 0:
+                    out["refused"] += 1
+                else:
+                    out["connects"] += 1
+                writer.close()
+            except (ConnectionError, OSError, aio.TimeoutError):
+                out["dropped_conns"] += 1
+            j += 1
+            delay = interval - (time.perf_counter() - t0)
+            if delay > 0:
+                await aio.sleep(delay)
+
+    async def run_one(admission_on: bool, with_attackers: bool):
+        cfg = Config(file_text=(
+            'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+            'broker.fanout.enable = true\n'
+        ))
+        cfg.put("tpu.enable", False)
+        if admission_on:
+            cfg.put("admission.enable", True)
+            cfg.put("admission.tick", 0.25)
+            cfg.put("admission.hold_ticks", 2)
+            cfg.put("admission.decay_ticks", 4)
+            cfg.put("admission.ban_time", 30.0)
+            # thresholds: 3x the honest per-client shape, an order of
+            # magnitude under the attacker's — honest headroom AND a
+            # fast verdict
+            cfg.put("admission.max_publish_rate", honest_rate * 3)
+            cfg.put("admission.max_topic_fan", 30.0)
+            cfg.put("admission.max_connect_rate", 2.0)
+        node = BrokerNode(cfg)
+        await node.start()
+        port = node.listeners.all()[0].port
+        atk: list = []
+        atk_out = {"sent": 0, "refused": 0, "dropped_conns": 0}
+        storm_out = {"connects": 0, "refused": 0, "dropped_conns": 0}
+        try:
+            if with_attackers:
+                end_at = time.perf_counter() + duration + 1.0
+                atk = [aio.ensure_future(
+                    attacker_loop(i, port, end_at, atk_out))
+                    for i in range(n_attackers)]
+                atk.append(aio.ensure_future(
+                    storm_loop(port, end_at, storm_out)))
+            honest = await run_scenario(
+                "pub", port=port, count=n_honest, rate=honest_rate,
+                subscribers=n_honest, topic="bench/%i", qos=1,
+                payload_size=64, duration=duration, inflight=inflight,
+                callback_subs=True)
+            for t in atk:
+                t.cancel()
+            if atk:
+                await aio.gather(*atk, return_exceptions=True)
+            adm = node.admission
+            decisions = (adm.list_decisions(all_rows=True)
+                         if adm is not None else [])
+            adm_info = adm.info() if adm is not None else None
+            banned_by_admission = [
+                e.who for e in node.banned.list() if e.by == "admission"]
+            m = node.observed.metrics
+            shed = m.get("broker.admission.shed_qos0")
+            bans = m.get("broker.admission.banned")
+        finally:
+            await node.stop()
+        lat = honest.get("latency_us") or {}
+        sent = honest.get("sent") or 0
+        flagged = [d for d in decisions if d["level"] > 0]
+        honest_flagged = [
+            d["clientid"] for d in flagged
+            if d["clientid"].startswith("bench_")
+        ] + [w for w in banned_by_admission if w.startswith("bench_")]
+        return {
+            "honest": {
+                "sent": sent,
+                "received": honest.get("received"),
+                "delivery_ratio": round(
+                    (honest.get("received") or 0) / max(1, sent), 4),
+                "msgs_per_s": honest.get("recv_rate"),
+                "e2e_p50_us": lat.get("p50"),
+                "e2e_p99_us": lat.get("p99"),
+            },
+            "attackers": {
+                "count": n_attackers,
+                "rate_per_attacker": attacker_rate,
+                "sent": atk_out["sent"],
+                "connects_refused": atk_out["refused"],
+                "dropped_conns": atk_out["dropped_conns"],
+                "storm_connects": storm_out["connects"],
+                "storm_refused": storm_out["refused"],
+            } if with_attackers else None,
+            "admission": adm_info,
+            "decisions": flagged,
+            "banned_by_admission": banned_by_admission,
+            "honest_flagged": honest_flagged,
+            "shed_qos0": shed,
+            "bans": bans,
+        }
+
+    clean = aio.run(run_one(False, False))
+    attack_off = aio.run(run_one(False, True))
+    attack_on = aio.run(run_one(True, True))
+
+    clean_p99 = clean["honest"]["e2e_p99_us"] or 0.0
+    on_p99 = attack_on["honest"]["e2e_p99_us"] or 0.0
+    off_p99 = attack_off["honest"]["e2e_p99_us"] or 0.0
+    limited = (attack_on["bans"]
+               + len(attack_on["decisions"])
+               + attack_on["attackers"]["connects_refused"]
+               + attack_on["attackers"]["storm_refused"])
+    return {
+        "workload": {
+            "honest_pairs": n_honest, "honest_rate": honest_rate,
+            "attackers": n_attackers, "attacker_rate": attacker_rate,
+            "storm_rate": storm_rate, "duration_s": duration,
+        },
+        "clean": clean,
+        "attack_off": attack_off,
+        "attack_on": attack_on,
+        # the flag-off brownout ratio is the regression the gates
+        # protect against — recorded, never asserted (host-dependent)
+        "p99_off_vs_clean": round(off_p99 / max(clean_p99, 1e-9), 2),
+        "p99_on_vs_clean": round(on_p99 / max(clean_p99, 1e-9), 2),
+        "gate_honest_delivery":
+            attack_on["honest"]["delivery_ratio"] == 1.0,
+        "gate_honest_p99": bool(
+            on_p99 <= max(1.5 * clean_p99, 50_000.0)),
+        "gate_attackers_limited": bool(limited >= 1),
+        "gate_no_honest_flagged":
+            not attack_on["honest_flagged"],
+    }
+
+
 def bench_fanout_e2e(n_pub: int = 16, n_sub: int = 32, duration: float = 6.0,
                      qos: int = 1, inflight: int = 32) -> dict:
     """Publish→deliver pipeline A/B (CPU mode, host-path routing): the
@@ -1688,6 +1910,7 @@ def main():
         q1 = bench_qos1_e2e(**_qos1_e2e_size(args.smoke))
         q2 = bench_qos2_e2e(**_qos2_e2e_size(args.smoke))
         tl = bench_table_lifecycle(**_table_lifecycle_size(args.smoke))
+        adv = bench_adversarial(**_adversarial_size(args.smoke))
         # the most recent full on-chip run is checked into the repo so a
         # tunnel outage at bench time (recurring: 2026-07-29, -30) does
         # not erase the measured result — clearly labeled as such
@@ -1744,6 +1967,7 @@ def main():
             "qos1_e2e": q1,
             "qos2_e2e": q2,
             "table_lifecycle": tl,
+            "adversarial": adv,
         }))
         return
 
@@ -1788,6 +2012,11 @@ def main():
          f"{tl['churn']['ops_per_s']} ops/s across "
          f"{tl['churn']['segment_swaps']} swap(s), "
          f"{tl['churn']['stalls_past_budget']} stall(s)")
+    adv = bench_adversarial(**_adversarial_size(args.smoke))
+    note(f"adversarial A/B done: p99 off {adv['p99_off_vs_clean']}x / "
+         f"on {adv['p99_on_vs_clean']}x of clean, honest delivery "
+         f"{adv['attack_on']['honest']['delivery_ratio']}, "
+         f"attackers_limited={adv['gate_attackers_limited']}")
 
     dev, tpu = bench_device(table, topics, args.batch, args.iters,
                             args.depth, args.active_slots)
@@ -1981,6 +2210,7 @@ def main():
         "qos1_e2e": q1,
         "qos2_e2e": q2,
         "table_lifecycle": tl,
+        "adversarial": adv,
         "delta": deltas,
     }
     print(json.dumps(result))

@@ -17,6 +17,7 @@ from .broker import Broker, DeliverResult
 from .fanout import FanoutPipeline
 from .cm import ConnectionManager
 from .channel import Channel
+from .admission import Admission
 from .banned import Banned, BanEntry
 from .flapping import Flapping
 from .limiter import LimiterGroup, TokenBucket
@@ -29,5 +30,6 @@ __all__ = [
     "MAX_PACKET_ID", "Publish", "Session", "SubOpts",
     "STRATEGIES", "SharedSub", "Broker", "DeliverResult", "FanoutPipeline",
     "ConnectionManager", "Channel",
+    "Admission",
     "Banned", "BanEntry", "Flapping", "LimiterGroup", "TokenBucket", "Olp",
 ]

@@ -1,0 +1,657 @@
+"""Batched admission plane: vectorized anomaly screening + quarantine
+ladder + fail-open degradation.
+
+Behavioral reference: the P4-pipeline paper (PAPERS.md, arxiv
+2601.07536) pushes MQTT security screening and anomaly mitigation into
+the dataplane at line rate.  The analog here is a **batched scoring
+stage on the ingest path**: the per-connection token buckets
+(``broker/limiter.py``) and disconnect-count bans (``broker/flapping.py``)
+gate *volume*; nothing before this module scored *behavior*, so a
+CONNECT storm or a topic-scan flood browned out honest clients right
+alongside the attackers.
+
+Dataflow::
+
+    ingest seams ──O(1) notes──▶ per-client counter rows (numpy slabs)
+                                        │  admission.score child,
+                                        ▼  one vectorized pass / tick
+                                EWMA feature rows ──▶ score = Σ wᵢ·fᵢ/tᵢ
+                                        │
+                                        ▼  hysteresis (hold/decay ticks)
+              quarantine ladder: 0 observe → 1 throttle (TokenBucket)
+                  → 2 quarantine (QoS0-shed) → 3 temp-ban (Banned)
+
+* **O(1) accumulation.**  Every seam call (`note_connect`,
+  ``note_publish``, ...) is one row lookup + a few slab increments —
+  no per-event allocation on the hot path.  Feature rows live in
+  preallocated numpy arrays (``_counts``/``_feat``) with a free-list
+  allocator, so the per-tick scoring pass is genuinely vectorized over
+  ALL active clients: rates = counts/dt, EWMA fold, weighted score —
+  three numpy expressions regardless of client count.
+* **Distinct-topic fan** uses a 64-bit per-client sketch (one bit per
+  ``hash(topic) & 63``): O(1) update, linear-counting estimate
+  ``-m·ln(z/m)`` at tick time — a topic-scan flood saturates it while
+  a telemetry client publishing one topic sets one bit.
+* **Ladder hysteresis**: escalate one level after ``hold_ticks``
+  consecutive ticks at or above the threshold, de-escalate after
+  ``decay_ticks`` consecutive calm ticks — recovered clients climb
+  back down, flapping around the threshold moves nobody.
+* **Fail-open by construction**: the scorer runs as a supervised
+  ``admission.score`` child.  A crash, kill, or injected fault clears
+  every standing decision (shed set emptied, throttles restored),
+  raises the ``admission_degraded`` alarm and lets the supervisor
+  restart it — degradation means *less screening*, never a new drop
+  path.  The first successful tick after recovery clears the alarm.
+* **Zero-cost when off**: ``admission.enable`` off leaves
+  ``broker.admission`` as ``None`` and every seam guards with one
+  attribute load + identity test (the faultinject idiom) — no function
+  call at all, spy-asserted by tests/test_admission.py.
+* **Explainable**: every standing decision carries its feature row —
+  ``ctl admission`` / ``GET /api/v5/admission`` show *why* a client is
+  throttled, not just that it is.
+
+Thread-safety: all state is main-loop-affine.  The one seam that fires
+on shard loops — the frame-parse error path — appends to a deque
+(atomic under the GIL) that the tick drains on the main loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import faultinject as _fi
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Admission", "FEATURES", "LEVELS"]
+
+#: feature-row column order (the explain surface names them verbatim)
+FEATURES = (
+    "connect_rate", "disconnect_rate", "malformed_rate",
+    "auth_fail_rate", "publish_rate", "publish_bytes_rate", "topic_fan",
+)
+_N_FEAT = len(FEATURES)
+# counter-slab columns 0..5 map to FEATURES 0..5; topic_fan comes from
+# the per-row bit sketch, not a counter
+_C_CONNECT, _C_DISCONNECT, _C_MALFORMED = 0, 1, 2
+_C_AUTH_FAIL, _C_PUB, _C_BYTES = 3, 4, 5
+_N_COUNT = 6
+
+LEVELS = ("observe", "throttle", "quarantine", "ban")
+
+_SKETCH_BITS = 64
+
+
+class Admission:
+    """The per-node admission plane (see module docstring).
+
+    Ownership: constructed by the node when ``admission.enable`` is on,
+    published as ``broker.admission`` (the seams' None-guard handle) and
+    driven by the supervised ``admission.score`` child (:meth:`run`).
+    """
+
+    def __init__(
+        self,
+        banned: Any = None,
+        alarms: Any = None,
+        metrics: Any = None,
+        flightrec: Any = None,
+        olp: Any = None,
+        tick_s: float = 1.0,
+        fan_window: float = 1.0,
+        alpha: float = 0.3,
+        threshold: float = 1.0,
+        clear_ratio: float = 0.5,
+        hold_ticks: int = 2,
+        decay_ticks: int = 5,
+        throttle_rate: float = 50.0,
+        restore_rate: float = 0.0,
+        ban_time: float = 60.0,
+        idle_expiry: float = 300.0,
+        max_connect_rate: float = 2.0,
+        max_malformed_rate: float = 1.0,
+        max_auth_fail_rate: float = 1.0,
+        max_publish_rate: float = 500.0,
+        max_publish_bytes_rate: float = 4.0 * 1024 * 1024,
+        max_topic_fan: float = 50.0,
+        clock: Optional[Callable[[], float]] = None,
+        wall: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], Any]] = None,
+    ) -> None:
+        self.banned = banned
+        self.alarms = alarms
+        self.metrics = metrics
+        self.flightrec = flightrec
+        self.olp = olp
+        self.tick_s = tick_s
+        # the distinct-topic sketch accumulates across ticks and folds
+        # once per fan_window: "distinct topics per second" must count
+        # NEW topics, not re-count one topic once per (possibly very
+        # short) tick — at a 20 ms tick a single-topic client would
+        # otherwise read as 50 distinct/s
+        self.fan_window = max(fan_window, tick_s)
+        self.alpha = alpha
+        self.threshold = threshold
+        self.clear_ratio = clear_ratio
+        self.hold_ticks = hold_ticks
+        self.decay_ticks = decay_ticks
+        self.throttle_rate = throttle_rate
+        # the configured per-connection message rate (limiter.max_
+        # messages_rate) a de-escalated client is restored to; 0 =
+        # unlimited, TokenBucket's own convention
+        self.restore_rate = restore_rate
+        self.ban_time = ban_time
+        self.idle_expiry = idle_expiry
+        # per-feature thresholds (per second); the score is the
+        # weighted sum of feature/threshold ratios, so 1.0 ≈ one
+        # dimension fully saturated.  Disconnect shares the connect
+        # threshold (a storm flaps both identically).
+        self._thresholds = np.array([
+            max_connect_rate, max_connect_rate, max_malformed_rate,
+            max_auth_fail_rate, max_publish_rate, max_publish_bytes_rate,
+            max_topic_fan,
+        ], dtype=np.float64)
+        self._weights = np.ones(_N_FEAT, dtype=np.float64)
+        self._clock = clock if clock is not None else time.monotonic
+        # the Banned table keys expiry on wall time; the scorer's own
+        # cadence is monotonic — both injectable (supervise.py idiom)
+        self._wall = wall if wall is not None else time.time
+        self._sleep = sleep if sleep is not None else asyncio.sleep
+
+        # ladder action callbacks, wired by the node:
+        #   throttle_cb(clientid, rate_or_None)  None = restore default
+        #   kick_cb(clientid)                    close the live conn
+        self.throttle_cb: Optional[Callable[[str, Optional[float]], Any]] \
+            = None
+        self.kick_cb: Optional[Callable[[str], Any]] = None
+
+        # row storage: key -> slot; preallocated slabs grow by doubling
+        cap = 256
+        self._slots: Dict[str, int] = {}
+        self._keys: List[Optional[str]] = [None] * cap
+        self._free: List[int] = list(range(cap - 1, -1, -1))
+        self._counts = np.zeros((cap, _N_COUNT), dtype=np.float64)
+        self._feat = np.zeros((cap, _N_FEAT), dtype=np.float64)
+        self._score = np.zeros(cap, dtype=np.float64)
+        self._level = np.zeros(cap, dtype=np.int8)
+        self._hold = np.zeros(cap, dtype=np.int32)   # consecutive hot
+        self._calm = np.zeros(cap, dtype=np.int32)   # consecutive calm
+        self._last_seen = np.zeros(cap, dtype=np.float64)
+        self._since = np.zeros(cap, dtype=np.float64)  # level!=0 entry
+        self._sketch: List[int] = [0] * cap
+
+        # enforcement state the hot paths consult
+        self._shed: set = set()           # clientids at level >= 2
+        # shard-loop-safe ingress for the frame-parse error seam
+        # (deque.append is atomic under the GIL; drained at tick)
+        self._malformed_q: deque = deque()
+
+        self._last_tick = self._clock()
+        self._fan_started = self._last_tick
+        self.ticks = 0
+        self.degraded = False
+        self.bans = 0
+        self.shed_count = 0
+
+    # ------------------------------------------------------------------
+    # O(1) accumulation seams (main loop unless noted)
+    # ------------------------------------------------------------------
+
+    def _slot(self, key: str, now: Optional[float] = None) -> int:
+        idx = self._slots.get(key)
+        if idx is None:
+            if not self._free:
+                self._grow()
+            idx = self._free.pop()
+            self._slots[key] = idx
+            self._keys[idx] = key
+            self._counts[idx] = 0.0
+            self._feat[idx] = 0.0
+            self._score[idx] = 0.0
+            self._level[idx] = 0
+            self._hold[idx] = 0
+            self._calm[idx] = 0
+            self._since[idx] = 0.0
+            self._sketch[idx] = 0
+        self._last_seen[idx] = now if now is not None else self._clock()
+        return idx
+
+    def _grow(self) -> None:
+        old = len(self._keys)
+        new = old * 2
+        self._keys.extend([None] * old)
+        self._free.extend(range(new - 1, old - 1, -1))
+        for name in ("_counts", "_feat", "_score", "_level", "_hold",
+                     "_calm", "_last_seen", "_since"):
+            arr = getattr(self, name)
+            grown = np.zeros((new,) + arr.shape[1:], dtype=arr.dtype)
+            grown[:old] = arr
+            setattr(self, name, grown)
+        self._sketch.extend([0] * old)
+
+    # NOTE: every increment resolves the slot FIRST — ``_slot`` may
+    # grow (and rebind) the slabs, and ``self._counts[self._slot(k)]``
+    # would subscript the pre-grow array Python already loaded.
+
+    def note_connect(self, clientid: str) -> None:
+        i = self._slot(clientid)
+        self._counts[i, _C_CONNECT] += 1.0
+
+    def note_disconnect(self, clientid: str) -> None:
+        i = self._slot(clientid)
+        self._counts[i, _C_DISCONNECT] += 1.0
+
+    def note_auth_failure(self, clientid: str) -> None:
+        i = self._slot(clientid)
+        self._counts[i, _C_CONNECT] += 1.0
+        self._counts[i, _C_AUTH_FAIL] += 1.0
+
+    def note_publish(self, clientid: Optional[str], topic: str,
+                     nbytes: int, n: int = 1) -> None:
+        if clientid is None:
+            return
+        i = self._slot(clientid)
+        self._counts[i, _C_PUB] += float(n)
+        self._counts[i, _C_BYTES] += float(nbytes)
+        self._sketch[i] |= 1 << (hash(topic) & (_SKETCH_BITS - 1))
+
+    def note_publish_batch(self, clientid: Optional[str],
+                           pkts: List[Any]) -> None:
+        """Publish-run ingest seam: one row lookup for the whole run."""
+        if clientid is None or not pkts:
+            return
+        i = self._slot(clientid)
+        self._counts[i, _C_PUB] += float(len(pkts))
+        self._counts[i, _C_BYTES] += float(
+            sum(len(p.payload) for p in pkts))
+        s = self._sketch[i]
+        for p in pkts:
+            s |= 1 << (hash(p.topic) & (_SKETCH_BITS - 1))
+        self._sketch[i] = s
+
+    def note_malformed(self, clientid: Optional[str],
+                       peername: Any) -> None:
+        """Frame-parse error seam.  May be called from a SHARD loop
+        (proto_conn._frame_error) — the deque append is the only
+        cross-thread write, drained on the main loop at tick time.
+        Pre-CONNECT errors key on the peer host."""
+        if clientid is None:
+            host = peername[0] if isinstance(peername, (tuple, list)) \
+                and peername else peername
+            if host is None:
+                return
+            key = f"ip:{host}"
+        else:
+            key = clientid
+        self._malformed_q.append(key)
+
+    # ------------------------------------------------------------------
+    # enforcement surfaces (hot paths; None-guarded by the callers)
+    # ------------------------------------------------------------------
+
+    def shed_qos0(self, clientid: Optional[str]) -> bool:
+        """True ⇒ drop this QoS0 publish (sender is quarantined).
+        The common case — sender not quarantined — is one set lookup;
+        the freshness check runs only for quarantined senders, so a
+        hung (not crashed) scorer still fails open within 4 ticks."""
+        if clientid not in self._shed:
+            return False
+        if self._clock() - self._last_tick > 4.0 * self.tick_s:
+            return False  # stale decisions never drop traffic
+        self.shed_count += 1
+        if self.metrics is not None:
+            self.metrics.inc("broker.admission.shed_qos0")
+        return True
+
+    # ------------------------------------------------------------------
+    # the supervised scorer
+    # ------------------------------------------------------------------
+
+    async def run(self) -> None:
+        """``admission.score`` child body: one vectorized scoring pass
+        per tick.  Any exit — crash, kill, injected fault — fails open
+        before the supervisor restarts it."""
+        try:
+            while True:
+                await self._sleep(self.tick_s)
+                if _fi._injector is not None:
+                    act = _fi._injector.act("admission.score")
+                    if act == "raise":
+                        raise _fi.InjectedFault("admission.score")
+                    if act == "delay":
+                        await _fi._injector.pause()
+                    elif act == "hang":
+                        await _fi._injector.hang()
+                self.score_tick()
+        except asyncio.CancelledError:
+            self._fail_open("killed")
+            raise
+        except Exception:
+            self._fail_open("crashed")
+            raise
+
+    def _fail_open(self, why: str) -> None:
+        """Degrade by screening LESS: every standing decision clears,
+        traffic flows, the alarm tells the operator scoring is down.
+        Idempotent — repeated crash/restart cycles re-enter cleanly."""
+        log.warning("admission scorer %s: failing open "
+                    "(decisions cleared, traffic unscreened)", why)
+        self.degraded = True
+        if self.metrics is not None:
+            self.metrics.inc("broker.admission.fail_open")
+        self._shed.clear()
+        n = len(self._keys)
+        for idx in range(n):
+            if self._level[idx] > 0:
+                key = self._keys[idx]
+                self._level[idx] = 0
+                self._hold[idx] = 0
+                self._calm[idx] = 0
+                if key is not None and self.throttle_cb is not None:
+                    try:
+                        self.throttle_cb(key, None)
+                    except Exception:
+                        log.debug("admission unthrottle failed for %r",
+                                  key, exc_info=True)
+        self._sync_gauges()
+        if self.alarms is not None:
+            self.alarms.activate(
+                "admission_degraded",
+                {"why": why},
+                "admission scorer down; fail-open, traffic unscreened",
+            )
+
+    def _recovered(self) -> None:
+        self.degraded = False
+        if self.alarms is not None:
+            self.alarms.deactivate("admission_degraded")
+
+    # ------------------------------------------------------------------
+
+    def score_tick(self, now: Optional[float] = None) -> None:
+        """One vectorized pass over every active client: rates → EWMA
+        features → weighted score → ladder transitions → eviction."""
+        now = now if now is not None else self._clock()
+        dt = max(now - self._last_tick, 1e-6)
+        self._last_tick = now
+        self.ticks += 1
+        # drain the cross-thread malformed queue into the slabs
+        q = self._malformed_q
+        while q:
+            try:
+                key = q.popleft()
+            except IndexError:  # raced a concurrent producer drain
+                break
+            i = self._slot(key, now)
+            self._counts[i, _C_MALFORMED] += 1.0
+
+        # -- the vectorized core: numpy expressions cover every row --
+        n = len(self._keys)
+        counts = self._counts[:n]
+        feat = self._feat[:n]
+        alpha = self.alpha
+        rate_cols = feat[:, :_N_COUNT]
+        np.multiply(rate_cols, 1.0 - alpha, out=rate_cols)
+        rate_cols += alpha * (counts / dt)
+        # topic fan folds on its OWN window: the sketch keeps
+        # accumulating across ticks, then a linear-counting distinct
+        # estimate per second folds in and the sketch resets (a
+        # saturated sketch caps far above any sane threshold)
+        fan_dt = now - self._fan_started
+        if fan_dt >= self.fan_window:
+            self._fan_started = now
+            fan = np.zeros(n, dtype=np.float64)
+            for idx in self._slots.values():
+                bits = self._sketch[idx]
+                if bits:
+                    z = _SKETCH_BITS - bin(bits).count("1")
+                    est = (_SKETCH_BITS * math.log(_SKETCH_BITS / z)
+                           if z > 0 else float(_SKETCH_BITS) * 4.0)
+                    fan[idx] = est / fan_dt
+                    self._sketch[idx] = 0
+            fan_col = feat[:, _N_COUNT]
+            np.multiply(fan_col, 1.0 - alpha, out=fan_col)
+            fan_col += alpha * fan
+        # fresh-evidence mask BEFORE the counters reset: escalation
+        # requires activity THIS tick, so a client that stopped freezes
+        # at its level while the EWMA drains instead of marching to a
+        # ban on stale memory — decay is reachable by construction
+        active = counts.sum(axis=1) > 0.0
+        counts[:] = 0.0
+        scores = (feat / self._thresholds) @ self._weights
+        self._score[:n] = scores
+
+        # overload tightens the gate: under a live brownout the broker
+        # cannot afford to watch an attacker for long — each brownout
+        # stage lowers the effective threshold 25%
+        threshold = self.threshold
+        if self.olp is not None:
+            level = self.olp.brownout_level()
+            if level:
+                threshold *= max(0.25, 1.0 - 0.25 * level)
+        clear = threshold * self.clear_ratio
+
+        hot = scores >= threshold
+        calm = scores <= clear
+        self._hold[:n] = np.where(
+            hot, np.where(active, self._hold[:n] + 1, self._hold[:n]), 0)
+        self._calm[:n] = np.where(calm, self._calm[:n] + 1, 0)
+        self._transition(now, threshold)
+        self._evict_idle(now)
+        self._sync_gauges()
+        if self.degraded:
+            self._recovered()
+
+    def _transition(self, now: float, threshold: float) -> None:
+        """Apply ladder moves for rows whose hysteresis counters just
+        crossed (python loop over the HANDFUL of crossing rows, not the
+        population — the masks come from the vectorized pass)."""
+        escalated_to_quarantine = False
+        up = np.nonzero((self._hold >= self.hold_ticks)
+                        & (self._level < 3))[0]
+        for idx in up:
+            key = self._keys[idx]
+            if key is None:
+                continue
+            self._hold[idx] = 0
+            self._calm[idx] = 0
+            new = int(self._level[idx]) + 1
+            self._level[idx] = new
+            if self._since[idx] == 0.0:
+                self._since[idx] = now
+            log.warning(
+                "admission: %r escalated to %s (score %.2f >= %.2f)",
+                key, LEVELS[new], float(self._score[idx]), threshold)
+            if new == 1:
+                self._apply_throttle(key, self.throttle_rate)
+            elif new == 2:
+                self._shed.add(key)
+                escalated_to_quarantine = True
+            elif new == 3:
+                self._ban(key, idx)
+        down = np.nonzero((self._calm >= self.decay_ticks)
+                          & (self._level > 0))[0]
+        for idx in down:
+            key = self._keys[idx]
+            if key is None:
+                continue
+            self._calm[idx] = 0
+            new = int(self._level[idx]) - 1
+            self._level[idx] = new
+            log.info("admission: %r de-escalated to %s", key, LEVELS[new])
+            if new == 1:      # quarantine -> throttle: stop shedding
+                self._shed.discard(key)
+            elif new == 0:    # throttle -> observe: restore the bucket
+                self._apply_throttle(key, None)
+                self._since[idx] = 0.0
+        if escalated_to_quarantine:
+            # ladder escalations are operator events: alarm while any
+            # client sits in quarantine, one flight-recorder dump per
+            # tick at most (an attack wave must not write N files)
+            if self.flightrec is not None:
+                self.flightrec.dump("admission_escalation")
+        if self.alarms is not None:
+            if self._shed:
+                self.alarms.activate(
+                    "admission_quarantine",
+                    {"clients": len(self._shed)},
+                    "clients quarantined by the admission plane",
+                )
+            else:
+                self.alarms.deactivate("admission_quarantine")
+
+    def _apply_throttle(self, key: str, rate: Optional[float]) -> None:
+        if key.startswith("ip:") or self.throttle_cb is None:
+            return
+        try:
+            self.throttle_cb(key, rate)
+        except Exception:
+            log.debug("admission throttle(%r, %r) failed", key, rate,
+                      exc_info=True)
+
+    def _ban(self, key: str, idx: int) -> None:
+        self.bans += 1
+        if self.metrics is not None:
+            self.metrics.inc("broker.admission.banned")
+        if self.banned is not None:
+            kind, who = ("peerhost", key[3:]) if key.startswith("ip:") \
+                else ("clientid", key)
+            self.banned.add(kind, who, duration=self.ban_time,
+                            by="admission",
+                            reason=f"admission score "
+                                   f"{float(self._score[idx]):.2f}",
+                            now=self._wall())
+        self._apply_throttle(key, None)
+        self._shed.discard(key)
+        if self.kick_cb is not None and not key.startswith("ip:"):
+            try:
+                self.kick_cb(key)
+            except Exception:
+                log.debug("admission kick(%r) failed", key, exc_info=True)
+        # the ban owns the client now; drop the row so a post-expiry
+        # reconnect starts back at observe (climb-down by construction)
+        self._drop(idx)
+
+    def _drop(self, idx: int) -> None:
+        key = self._keys[idx]
+        if key is None:
+            return
+        self._shed.discard(key)
+        del self._slots[key]
+        self._keys[idx] = None
+        self._level[idx] = 0
+        self._free.append(idx)
+
+    def _evict_idle(self, now: float) -> None:
+        """Bound per-client state under reconnect churn: rows idle past
+        ``idle_expiry`` with no standing decision are freed."""
+        n = len(self._keys)
+        stale = np.nonzero(
+            (self._last_seen[:n] < now - self.idle_expiry)
+            & (self._level[:n] == 0))[0]
+        for idx in stale:
+            self._drop(idx)
+
+    def _sync_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.set("broker.admission.tracked_clients",
+                         len(self._slots))
+        lv = self._level[:len(self._keys)]
+        self.metrics.set("broker.admission.throttled",
+                         int(np.count_nonzero(lv >= 1)))
+        self.metrics.set("broker.admission.quarantined",
+                         int(np.count_nonzero(lv >= 2)))
+
+    # ------------------------------------------------------------------
+    # explain surface (ctl admission / GET /api/v5/admission)
+    # ------------------------------------------------------------------
+
+    def explain(self, key: str) -> Optional[Dict[str, Any]]:
+        idx = self._slots.get(key)
+        if idx is None:
+            return None
+        return self._row(key, idx)
+
+    def _row(self, key: str, idx: int) -> Dict[str, Any]:
+        return {
+            "clientid": key,
+            "level": int(self._level[idx]),
+            "level_name": LEVELS[int(self._level[idx])],
+            "score": round(float(self._score[idx]), 4),
+            "for_s": (round(self._clock() - self._since[idx], 3)
+                      if self._since[idx] else None),
+            "features": {
+                name: round(float(self._feat[idx, f]), 4)
+                for f, name in enumerate(FEATURES)
+            },
+        }
+
+    def list_decisions(self, all_rows: bool = False,
+                       limit: int = 256) -> List[Dict[str, Any]]:
+        """Standing decisions (level > 0), worst score first; with
+        ``all_rows`` every tracked client, for forensics."""
+        rows = []
+        for key, idx in self._slots.items():
+            if all_rows or self._level[idx] > 0:
+                rows.append(self._row(key, idx))
+        rows.sort(key=lambda r: (-r["level"], -r["score"]))
+        return rows[:limit]
+
+    def clear(self, key: str) -> bool:
+        """Operator override: lift a standing decision NOW (REST
+        DELETE).  The feature row survives — a still-hostile client
+        climbs right back."""
+        idx = self._slots.get(key)
+        if idx is None:
+            return False
+        if self._level[idx] > 0:
+            self._shed.discard(key)
+            self._apply_throttle(key, None)
+            self._level[idx] = 0
+            self._hold[idx] = 0
+            self._calm[idx] = 0
+            self._since[idx] = 0.0
+            self._sync_gauges()
+        return True
+
+    def info(self) -> Dict[str, Any]:
+        lv = self._level[:len(self._keys)]
+        return {
+            "enabled": True,
+            "degraded": self.degraded,
+            "ticks": self.ticks,
+            "tracked_clients": len(self._slots),
+            "throttled": int(np.count_nonzero(lv == 1)),
+            "quarantined": int(np.count_nonzero(lv >= 2)),
+            "bans": self.bans,
+            "shed_qos0": self.shed_count,
+            "tick_s": self.tick_s,
+            "threshold": self.threshold,
+        }
+
+    # ------------------------------------------------------------------
+
+    def attach(self, broker: Any) -> "Admission":
+        """Publish the enforcement handle + register the lifecycle
+        hooks (the lazily-registered idiom: hooks exist only while the
+        plane is enabled, so the flag-off tree never dispatches them)."""
+        broker.admission = self
+        broker.hooks.add(
+            "client.connected",
+            lambda cid, info: self.note_connect(cid),
+            name="admission.connect",
+        )
+        broker.hooks.add(
+            "client.disconnected",
+            lambda cid, reason: self.note_disconnect(cid),
+            name="admission.disconnect",
+        )
+        return self

@@ -40,11 +40,13 @@ class Banned:
 
     def add(
         self, kind: str, who: str, duration: Optional[float] = None,
-        by: str = "mgmt", reason: str = "",
+        by: str = "mgmt", reason: str = "", now: Optional[float] = None,
     ) -> BanEntry:
+        """``now`` lets clock-injected callers (flapping, admission
+        tests) keep the expiry on their deterministic clock."""
         if kind not in WHO_KINDS:
             raise ValueError(f"bad ban kind {kind!r}")
-        now = time.time()
+        now = now if now is not None else time.time()
         e = BanEntry(
             kind, who, by, reason, now,
             None if duration is None else now + duration,

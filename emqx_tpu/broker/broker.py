@@ -96,6 +96,10 @@ class Broker:
         # node when broker.fanout.enable is on; the channel offers hot-path
         # publishes here and falls back to the sync publish() when refused
         self.fanout = None             # Optional[FanoutPipeline]
+        # batched admission plane (broker/admission.py): set by
+        # Admission.attach when admission.enable is on.  None keeps
+        # every admission seam at one attr load + identity test.
+        self.admission = None          # Optional[Admission]
         # counter table, set by observe(); broker-internal drop accounting
         # (outbox overflow) lands here when present
         self.metrics = None
@@ -223,6 +227,16 @@ class Broker:
     def publish(self, msg: Message) -> DeliverResult:
         T.validate(msg.topic, "name")
         res = DeliverResult()
+        adm = self.admission
+        if adm is not None and msg.qos == 0 \
+                and adm.shed_qos0(msg.sender):
+            # quarantined sender: QoS0 is best-effort by contract, so
+            # the shed happens BEFORE the publish fold (no retainer /
+            # delayed side effects for dropped attack traffic); QoS1/2
+            # ride the throttled token bucket instead of a drop path
+            res.no_subscribers = True
+            self.hooks.run("message.dropped", (msg, "admission_shed"))
+            return res
         msg = self.hooks.run_fold("message.publish", (), msg)
         if msg is None or msg.headers.get("allow_publish") is False:
             res.no_subscribers = True

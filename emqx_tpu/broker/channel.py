@@ -195,6 +195,12 @@ class Channel:
             True,
         )
         if ok is not True:
+            adm = self.broker.admission
+            if adm is not None:
+                # admission feature seam: auth-failure rate (a
+                # credential-stuffing storm never reaches
+                # client.connected, so the connect hook can't see it)
+                adm.note_auth_failure(clientid)
             rc = ok if isinstance(ok, int) else P.RC.NOT_AUTHORIZED
             return self._connack_error(rc)
         return self._complete_connect(pkt, props, clientid)
@@ -367,6 +373,11 @@ class Channel:
         topic = self._resolve_alias(pkt)
         if topic is None:
             return [("close", "topic alias invalid")]
+        adm = self.broker.admission
+        if adm is not None:
+            # admission feature seam: publish rate / bytes / topic fan,
+            # noted BEFORE validity/authz so denied floods register too
+            adm.note_publish(self.clientid, topic, len(pkt.payload))
         if not T.is_valid(topic, "name"):
             return self._puback_for(pkt, P.RC.TOPIC_NAME_INVALID)
         allowed = self.broker.hooks.run_fold(
@@ -443,6 +454,11 @@ class Channel:
         pkts = run.pkts
         if fanout is None or not fanout.will_accept(len(pkts)):
             return b"", [], pkts
+        adm = broker.admission
+        if adm is not None:
+            # admission feature seam, batch form: one row lookup for
+            # the whole publish run
+            adm.note_publish_batch(self.clientid, pkts)
         sess = self.session
         v5 = self.proto_ver == 5
         run_fold = broker.hooks.run_fold

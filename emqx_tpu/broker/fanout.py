@@ -253,6 +253,15 @@ class FanoutPipeline:
         if not self._running:
             return False
         T.validate(msg.topic, "name")  # parity with Broker.publish
+        adm = self.broker.admission
+        if adm is not None and msg.qos == 0 \
+                and adm.shed_qos0(msg.sender):
+            # admission quarantine (broker/admission.py): the batched
+            # twin of the Broker.publish shed — consumed by policy,
+            # never queued, mirroring the olp QoS0 shed below
+            self.broker.hooks.run("message.dropped",
+                                  (msg, "admission_shed"))
+            return True
         self._note_arrival()
         olp = self.olp
         if olp is not None and olp.overloaded():

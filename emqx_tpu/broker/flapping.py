@@ -3,13 +3,18 @@
 Behavioral reference: ``apps/emqx/src/emqx_flapping.erl`` [U] (SURVEY.md
 §2.1): count a client's disconnects inside a sliding window; crossing
 ``max_count`` bans the clientid for ``ban_time`` via the banned table.
+
+The clock is injectable (the ``supervise.py`` discipline): tests drive
+window slides, ban expiry and idle sweeps with a fake clock instead of
+sleeping, and the ban handed to :class:`Banned` carries the SAME ``now``
+so the whole decision chain is deterministic.
 """
 
 from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Deque, Dict, Optional
+from typing import Callable, Deque, Dict, Optional
 
 from .banned import Banned
 from .broker import Broker
@@ -25,30 +30,28 @@ class Flapping:
         window_time: float = 60.0,
         ban_time: float = 300.0,
         enable: bool = True,
+        clock: Optional[Callable[[], float]] = None,
     ) -> None:
         self.banned = banned
         self.max_count = max_count
         self.window_time = window_time
         self.ban_time = ban_time
         self.enable = enable
+        self._clock = clock if clock is not None else time.time
         self._events: Dict[str, Deque[float]] = {}
+        self._gc_tick = 0
         self.detected = 0
 
     def record_disconnect(self, clientid: str, now: Optional[float] = None) -> bool:
         """Returns True if this event tripped the detector (ban issued)."""
         if not self.enable:
             return False
-        now = now if now is not None else time.time()
-        self._gc_tick = getattr(self, "_gc_tick", 0) + 1
+        now = now if now is not None else self._clock()
+        self._gc_tick += 1
         if self._gc_tick % 256 == 0:
             # amortized sweep: drop clientids whose whole window elapsed,
             # else the table grows with every clientid ever seen
-            stale = [
-                cid for cid, evs in self._events.items()
-                if not evs or now - evs[-1] > self.window_time
-            ]
-            for cid in stale:
-                del self._events[cid]
+            self.sweep(now)
         q = self._events.setdefault(clientid, deque())
         q.append(now)
         while q and now - q[0] > self.window_time:
@@ -56,12 +59,30 @@ class Flapping:
         if len(q) >= self.max_count:
             self.banned.add(
                 "clientid", clientid, duration=self.ban_time,
-                by="flapping", reason="flapping detected",
+                by="flapping", reason="flapping detected", now=now,
             )
             self.detected += 1
             del self._events[clientid]
             return True
         return False
+
+    def sweep(self, now: Optional[float] = None) -> int:
+        """Drop clientids whose whole window elapsed.  Runs amortized
+        from :meth:`record_disconnect` AND from node housekeeping — the
+        amortized path alone only fires while disconnects keep coming,
+        so a churn burst followed by silence would pin its table
+        forever (the per-client-state growth audit)."""
+        now = now if now is not None else self._clock()
+        stale = [
+            cid for cid, evs in self._events.items()
+            if not evs or now - evs[-1] > self.window_time
+        ]
+        for cid in stale:
+            del self._events[cid]
+        return len(stale)
+
+    def tracked(self) -> int:
+        return len(self._events)
 
     def attach(self, broker: Broker) -> "Flapping":
         broker.hooks.add(

@@ -57,6 +57,18 @@ class TokenBucket:
         self._refill(now if now is not None else time.time())
         return self._tokens
 
+    def retune(self, rate: float, burst: Optional[float] = None) -> None:
+        """Re-rate this bucket IN PLACE (admission throttle / restore):
+        call sites hold direct references to the bucket object, so a
+        swap would silently detach them.  Coming from unlimited the
+        bucket starts full (a fresh bucket's semantics); tightening a
+        limited one clamps, so the throttle bites on the next consume."""
+        was_unlimited = self.unlimited
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else max(rate, 1.0))
+        self._tokens = self.burst if was_unlimited \
+            else min(self._tokens, self.burst)
+
 
 class LimiterGroup:
     """The three reference limiter dimensions, from config keys
@@ -103,6 +115,27 @@ class LimiterGroup:
 
     def drop_conn(self, connid: str) -> None:
         self._per_conn.pop(connid, None)
+
+    def tracked(self) -> int:
+        return len(self._per_conn)
+
+    def sweep_idle(self, idle_s: float, now: Optional[float] = None) -> int:
+        """Evict bucket pairs idle past ``idle_s`` (per-client-state
+        growth audit: every close path calls drop_conn, but a handler
+        that dies between accept and close would leak its pair forever;
+        this is the belt-and-braces bound).  A live-but-idle connection
+        whose entry is evicted just gets a fresh pair on its next
+        allow_publish — unlimited buckets identically, limited ones
+        with a reset burst, both harmless."""
+        now = now if now is not None else time.time()
+        stale = [
+            cid for cid, (msgs, byts) in self._per_conn.items()
+            if (msgs._last or 0.0) < now - idle_s
+            and (byts._last or 0.0) < now - idle_s
+        ]
+        for cid in stale:
+            del self._per_conn[cid]
+        return len(stale)
 
     def allow_publish(
         self, connid: str, nbytes: int, now: Optional[float] = None

@@ -197,6 +197,49 @@ SCHEMA: Dict[str, Field] = {
     "flapping_detect.window_time": Field(60.0, duration),
     "flapping_detect.ban_time": Field(300.0, duration),
 
+    # -- batched admission plane (broker/admission.py) --------------------
+    # opt-in: per-client EWMA behavior features accumulated O(1) at the
+    # ingest seams, scored in one vectorized pass per tick by the
+    # supervised admission.score child, feeding the quarantine ladder
+    # observe → throttle → QoS0-shed → temp-ban.  Off = broker.admission
+    # stays None and every seam is one attr load + identity test.
+    "admission.enable": Field(False, _bool),
+    "admission.tick": Field(1.0, duration, lambda v: v > 0),
+    # distinct-topic sketch window: the fan feature folds once per this
+    # interval (clamped to >= tick) so "distinct topics per second"
+    # counts NEW topics, not one topic re-counted every short tick
+    "admission.fan_window": Field(1.0, duration, lambda v: v > 0),
+    # EWMA fold factor per tick for the feature rows
+    "admission.alpha": Field(0.3, float, lambda v: 0 < v <= 1),
+    # composite score (sum of feature/threshold ratios) at or above
+    # which a client is "hot"; hysteresis below decides transitions
+    "admission.threshold": Field(1.0, float, lambda v: v > 0),
+    # fraction of the (possibly brownout-tightened) threshold below
+    # which a tick counts as calm
+    "admission.clear_ratio": Field(0.5, float, lambda v: 0 < v < 1),
+    # consecutive hot ticks before escalating one ladder level /
+    # consecutive calm ticks before de-escalating one
+    "admission.hold_ticks": Field(2, int, lambda v: v >= 1),
+    "admission.decay_ticks": Field(5, int, lambda v: v >= 1),
+    # level-1 throttle: the client's message TokenBucket is retuned to
+    # this rate (msgs/s); de-escalation restores limiter.max_messages_rate
+    "admission.throttle_rate": Field(50.0, float, lambda v: v > 0),
+    # level-3 temp-ban duration (Banned, by="admission")
+    "admission.ban_time": Field(60.0, duration, lambda v: v > 0),
+    # feature rows idle this long with no standing decision are evicted
+    # (reconnect-churn memory bound; broker.admission.tracked_clients)
+    "admission.idle_expiry": Field(300.0, duration, lambda v: v > 0),
+    # per-feature rate thresholds (per second); the score saturates at
+    # 1.0 when ONE dimension hits its threshold, so defaults are "an
+    # order of magnitude past honest" for each behavior
+    "admission.max_connect_rate": Field(2.0, float, lambda v: v > 0),
+    "admission.max_malformed_rate": Field(1.0, float, lambda v: v > 0),
+    "admission.max_auth_fail_rate": Field(1.0, float, lambda v: v > 0),
+    "admission.max_publish_rate": Field(500.0, float, lambda v: v > 0),
+    "admission.max_publish_bytes_rate": Field(
+        4 << 20, bytesize, lambda v: v > 0),
+    "admission.max_topic_fan": Field(50.0, float, lambda v: v > 0),
+
     "force_shutdown.max_mailbox_size": Field(1000, int),
     "force_shutdown.max_heap_size": Field(32 << 20, bytesize),
 

@@ -44,6 +44,9 @@ ALLOWED_TASK_SITES: Dict[Tuple[str, str], str] = {
         "bench harness: ack loop scoped to one bench run",
     ("emqx_tpu/bench_client.py", "run_scenario"):
         "bench harness: drain tasks scoped to one bench run",
+    ("bench.py", "bench_adversarial.run_one"):
+        "bench harness: attacker/storm loops scoped to one A/B run, "
+        "cancelled + gathered before the node stops",
     ("emqx_tpu/gateway/exproto.py", "ExProtoConn.send_deliveries"):
         "per-event gRPC notify; errors surface via the handler channel",
     ("emqx_tpu/gateway/stomp.py", "StompConn.on_connect"):

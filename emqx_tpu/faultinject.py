@@ -50,6 +50,13 @@ Named injection points (the seams the batched stack crosses):
 ``exhook.call``      ExHook advisory gRPC call (raise / delay)
 ``fanout.drain``     fanout pipeline drain loop (raise / delay)
 ``shard.handoff``    cross-loop shard↔main batched drain (drop / raise)
+``admission.score``  admission scorer tick (raise / delay / hang; a
+                     raise crashes the supervised ``admission.score``
+                     child, which FAILS OPEN — standing decisions
+                     clear, traffic flows unscreened, the
+                     ``admission_degraded`` alarm raises until the
+                     restarted scorer completes a tick; a hang is
+                     rescued by the shed path's staleness guard)
 ==================  =====================================================
 
 Scenario table: a list of rule dicts, evaluated in order per point; the
@@ -97,6 +104,7 @@ POINTS = (
     "match.readback", "table.load", "table.swap",
     "inflight.insert", "inflight.retry", "cluster.rpc",
     "bridge.sink", "exhook.call", "fanout.drain", "shard.handoff",
+    "admission.score",
 )
 
 _ACTIONS = ("raise", "drop", "delay", "dup", "hang")

@@ -120,6 +120,8 @@ class MgmtApi:
         r("GET", f"{v}/observability/histograms", self.histograms)
         r("GET", f"{v}/observability/flightrec", self.flightrec_info)
         r("POST", f"{v}/observability/flightrec", self.flightrec_dump)
+        r("GET", f"{v}/admission", self.admission_list)
+        r("DELETE", f"{v}/admission/{{clientid}}", self.admission_clear)
         r("GET", f"{v}/plugins", self.plugins_list)
         r("PUT", f"{v}/plugins/{{name}}/{{action}}", self.plugins_action)
         r("GET", f"{v}/psk", self.psk_list)
@@ -776,6 +778,34 @@ class MgmtApi:
         if path is None:
             return json_response({"message": "dump failed"}, status=503)
         return json_response({"path": path, "reason": "manual"})
+
+    # -- batched admission plane (broker/admission.py) -------------------
+
+    async def admission_list(self, req: Request) -> Response:
+        """Every standing admission decision WITH its feature row — the
+        explainability contract: an operator sees *why* a client is
+        throttled/quarantined, not just that it is.  ``?all=true``
+        lists every tracked client (forensics)."""
+        adm = getattr(self.node, "admission", None)
+        if adm is None:
+            return json_response({"enabled": False, "data": []})
+        all_rows = (req.q("all", "false") or "").lower() \
+            in ("true", "1", "yes")
+        return json_response({
+            **adm.info(),
+            "data": adm.list_decisions(all_rows=all_rows),
+        })
+
+    async def admission_clear(self, req: Request) -> Response:
+        """Operator override: lift a client's standing decision now
+        (the feature row survives — a still-hostile client re-climbs)."""
+        adm = getattr(self.node, "admission", None)
+        if adm is None:
+            return json_response({"message": "admission disabled"},
+                                 status=404)
+        if not adm.clear(req.params["clientid"]):
+            return json_response({"message": "not tracked"}, status=404)
+        return Response(204)
 
     async def plugins_list(self, req: Request) -> Response:
         return json_response(self.node.plugins.list())

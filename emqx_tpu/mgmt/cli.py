@@ -154,6 +154,15 @@ def main(argv=None) -> int:
     p.add_argument("action", choices=["list", "clear"], nargs="?",
                    default="list")
 
+    # batched admission plane: standing decisions with feature rows
+    # (list), every tracked client (list --all), operator clear
+    p = sub.add_parser("admission")
+    p.add_argument("action", choices=["list", "clear"], nargs="?",
+                   default="list")
+    p.add_argument("clientid", nargs="?")
+    p.add_argument("--all", action="store_true", dest="adm_all",
+                   help="every tracked client, not just decisions")
+
     # stage-level latency observatory: merged per-stage percentiles +
     # the flight recorder's manual dump trigger
     sub.add_parser("hist")
@@ -292,6 +301,16 @@ def main(argv=None) -> int:
         else:
             ctl.call("DELETE", f"{v}/slow_subscriptions")
             print("cleared")
+    elif args.cmd == "admission":
+        if args.action == "clear":
+            if not args.clientid:
+                print("clientid required", file=sys.stderr)
+                return 1
+            ctl.call("DELETE", f"{v}/admission/{args.clientid}")
+            print(f"cleared {args.clientid}")
+        else:
+            suffix = "?all=true" if args.adm_all else ""
+            _print(ctl.call("GET", f"{v}/admission{suffix}"))
     elif args.cmd == "hist":
         _print(ctl.call("GET", f"{v}/observability/histograms"))
     elif args.cmd == "flightrec":

@@ -144,6 +144,44 @@ class BrokerNode:
             ban_time=cfg.get("flapping_detect.ban_time"),
             enable=cfg.get("flapping_detect.enable"),
         ).attach(self.broker)
+        # batched admission plane (broker/admission.py): per-client
+        # behavior features scored in one vectorized pass per tick by
+        # the supervised admission.score child, feeding the quarantine
+        # ladder (throttle via the client's TokenBucket, QoS0-shed,
+        # temp-ban via Banned).  Off keeps broker.admission None —
+        # every seam stays one attr load + identity test.
+        self.admission = None
+        if cfg.get("admission.enable"):
+            from .broker.admission import Admission
+
+            self.admission = Admission(
+                banned=self.banned,
+                alarms=self.observed.alarms,
+                metrics=self.observed.metrics,
+                olp=self.olp,
+                tick_s=cfg.get("admission.tick"),
+                fan_window=cfg.get("admission.fan_window"),
+                alpha=cfg.get("admission.alpha"),
+                threshold=cfg.get("admission.threshold"),
+                clear_ratio=cfg.get("admission.clear_ratio"),
+                hold_ticks=cfg.get("admission.hold_ticks"),
+                decay_ticks=cfg.get("admission.decay_ticks"),
+                throttle_rate=cfg.get("admission.throttle_rate"),
+                restore_rate=cfg.get("limiter.max_messages_rate"),
+                ban_time=cfg.get("admission.ban_time"),
+                idle_expiry=cfg.get("admission.idle_expiry"),
+                max_connect_rate=cfg.get("admission.max_connect_rate"),
+                max_malformed_rate=cfg.get(
+                    "admission.max_malformed_rate"),
+                max_auth_fail_rate=cfg.get(
+                    "admission.max_auth_fail_rate"),
+                max_publish_rate=cfg.get("admission.max_publish_rate"),
+                max_publish_bytes_rate=cfg.get(
+                    "admission.max_publish_bytes_rate"),
+                max_topic_fan=cfg.get("admission.max_topic_fan"),
+            ).attach(self.broker)
+            self.admission.throttle_cb = self._admission_throttle
+            self.admission.kick_cb = self.kick_client
         self.retainer = (
             Retainer(
                 msg_expiry_interval=cfg.get("retainer.msg_expiry_interval"),
@@ -204,6 +242,10 @@ class BrokerNode:
             metrics=self.observed.metrics,
         )
         self.supervisor.flightrec = self.flightrec
+        if self.admission is not None:
+            # built above, before the recorder existed: escalation
+            # dumps (reason admission_escalation) wire up here
+            self.admission.flightrec = self.flightrec
         self.observed.sys.attach_hists(self.hist_percentiles)
         from .observe.slow_subs import SlowSubs
         from .plugins import PluginManager
@@ -282,6 +324,7 @@ class BrokerNode:
         self._jobs: List[Any] = []  # tasks or supervised Child handles
         self.started_at = time.time()
         self._running = False
+        self._last_idle_sweep = time.monotonic()
         self._configure_listeners()
 
     # ------------------------------------------------------------------
@@ -361,6 +404,28 @@ class BrokerNode:
                     self.access_control.authz, "no_match", n
                 ),
             )
+
+    def _admission_throttle(self, clientid: str,
+                            rate: Optional[float]) -> bool:
+        """Admission-ladder level 1: retune the live connection's
+        message TokenBucket IN PLACE (the proto holds a direct
+        reference, so a dict swap would detach it).  ``rate`` None
+        restores the configured limiter.max_messages_rate.  Shard-owned
+        connections share the same bucket object; the retune is a pair
+        of float stores — a racy read on the shard loop sees either
+        rate, both valid (the gauge-not-invariant discipline)."""
+        conn = self.connections.get(clientid)
+        if conn is None:
+            return False
+        bucket = getattr(conn, "_msg_bucket", None)
+        if bucket is None:
+            return False
+        if rate is None:
+            restore = float(self.config.get("limiter.max_messages_rate"))
+            bucket.retune(restore)
+        else:
+            bucket.retune(rate)
+        return True
 
     def _mark_disconnected(self, clientid: str) -> None:
         sess = self.broker.sessions.get(clientid)
@@ -780,6 +845,12 @@ class BrokerNode:
         if self.lag_probe is not None:
             self._jobs.append(self.supervisor.start_child(
                 "olp.lag_probe", self.lag_probe.run))
+        if self.admission is not None:
+            # the vectorized anomaly scorer: a crash/kill/injected
+            # fault fails open (decisions clear, admission_degraded
+            # alarm) and the supervisor restarts it
+            self._jobs.append(self.supervisor.start_child(
+                "admission.score", self.admission.run))
 
     def _maybe_shard(self) -> None:
         """Attach the connection-plane shard pool to the default TCP
@@ -1238,6 +1309,15 @@ class BrokerNode:
                 if self.retainer is not None:
                     self.retainer.clean_expired()
                 self.banned.clean_expired()
+                # per-client keyed-state growth bounds (churn audit):
+                # flapping windows and idle limiter bucket pairs are
+                # swept here; admission feature rows evict themselves
+                # inside score_tick (idle_expiry)
+                now_mono = time.monotonic()
+                if now_mono - self._last_idle_sweep >= 60.0:
+                    self._last_idle_sweep = now_mono
+                    self.flapping.sweep()
+                    self.limiter.sweep_idle(600.0)
                 self._expire_sessions()
                 if self.quic is not None:
                     self.quic.sweep()
@@ -1317,6 +1397,8 @@ class BrokerNode:
                        if self.fanout_pipeline is not None else None),
             "supervisor": self.supervisor.info(),
             "flightrec": self.flightrec.info(),
+            "admission": (self.admission.info()
+                          if self.admission is not None else None),
             **self.broker.stats(),
         }
 

@@ -20,7 +20,7 @@ __all__ = [
     "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
     "ROBUSTNESS_METRIC_NAMES", "CONNPLANE_METRIC_NAMES",
     "MATCH_SERVE_METRIC_NAMES", "TABLE_METRIC_NAMES",
-    "OBS_METRIC_NAMES",
+    "OBS_METRIC_NAMES", "ADMISSION_METRIC_NAMES",
 ]
 
 # -- the reference's fixed counter names, grouped as in emqx_metrics.erl [U]
@@ -183,6 +183,23 @@ OBS_METRIC_NAMES: List[str] = [
     "obs.flightrec.dumps",
 ]
 
+# -- batched admission plane (broker/admission.py, opt-in via
+# admission.enable).  tracked_clients is the live feature-row count
+# (set each tick — the reconnect-churn memory bound); throttled /
+# quarantined are the CURRENT ladder populations at level >= 1 / >= 2
+# (set); banned accumulates level-3 temp-bans issued (inc); shed_qos0
+# accumulates QoS0 publishes dropped for quarantined senders (inc);
+# fail_open counts scorer crash/kill/fault events that cleared every
+# standing decision and raised admission_degraded (inc).  The derived
+# drop detail messages.dropped.admission_shed rides the main list's
+# inc_msg_dropped discipline.
+ADMISSION_METRIC_NAMES: List[str] = [
+    "broker.admission.tracked_clients", "broker.admission.throttled",
+    "broker.admission.quarantined", "broker.admission.banned",
+    "broker.admission.shed_qos0", "broker.admission.fail_open",
+    "messages.dropped.admission_shed",
+]
+
 
 class Metrics:
     """A counter table with the reference's fixed name set.
@@ -202,6 +219,7 @@ class Metrics:
         self._c.update({n: 0 for n in MATCH_SERVE_METRIC_NAMES})
         self._c.update({n: 0 for n in TABLE_METRIC_NAMES})
         self._c.update({n: 0 for n in OBS_METRIC_NAMES})
+        self._c.update({n: 0 for n in ADMISSION_METRIC_NAMES})
         if extra:
             self._c.update({n: 0 for n in extra})
 

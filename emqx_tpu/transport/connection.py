@@ -302,6 +302,12 @@ class Connection:
                     return
 
     def _frame_error(self, e: F.FrameError) -> None:
+        adm = self.channel.broker.admission
+        if adm is not None:
+            # admission feature seam: malformed-frame rate (stream-path
+            # parity with proto_conn._frame_error)
+            adm.note_malformed(self.channel.clientid,
+                               self.conninfo.peername)
         # MQTT5 §4.13: respond DISCONNECT with the reason, then drop
         if self.channel.proto_ver == 5 and self.channel.state == "connected":
             self._send_pkt(P.Disconnect(reason_code=e.reason_code))

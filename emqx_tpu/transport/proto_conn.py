@@ -611,6 +611,13 @@ class MqttProtocol(asyncio.Protocol):
             self.transport.close()
 
     def _frame_error(self, e: F.FrameError) -> None:
+        adm = self.channel.broker.admission
+        if adm is not None:
+            # admission feature seam: malformed-frame rate.  Safe from
+            # a shard loop — note_malformed only appends to a deque,
+            # drained by the scorer on the main loop.
+            adm.note_malformed(self.channel.clientid,
+                               self.conninfo.peername)
         if self.channel.proto_ver == 5 and self.channel.state == "connected":
             self._send_pkt(P.Disconnect(reason_code=e.reason_code))
         self._do_close(f"frame error: {e}")

@@ -693,8 +693,15 @@ class ShardPool:
     def _publish_batch(self, msgs: List[Any]) -> None:
         broker = self.node.broker
         fanout = broker.fanout
+        adm = broker.admission
         for m in msgs:
             try:
+                if adm is not None:
+                    # admission feature seam for the shard ingest: the
+                    # shard loops never touch admission state — every
+                    # fast-path publish is noted here, on the main-loop
+                    # side of the handoff, exactly once
+                    adm.note_publish(m.sender, m.topic, len(m.payload))
                 if fanout is None or not fanout.offer(m):
                     broker.publish(m)
             except Exception:

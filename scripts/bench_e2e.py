@@ -542,6 +542,159 @@ def chaos_smoke() -> dict:
             fi.uninstall()
             await node.stop()
 
+    async def admission_cycle():
+        """Admission-plane chaos (ISSUE 14): an attacker is quarantined
+        mid-storm, then the admission.score child is killed AND 10%
+        admission.score faults are injected — every failure FAILS OPEN
+        (standing decisions clear, admission_degraded raises, honest
+        AND attacker traffic flows — never a new drop path), and the
+        supervised restart resumes scoring, re-quarantines the
+        attacker and clears the alarm."""
+        from emqx_tpu import faultinject as fi
+        from emqx_tpu.broker.message import make_message
+        from emqx_tpu.config import Config
+        from emqx_tpu.faultinject import FaultInjector
+        from emqx_tpu.node import BrokerNode
+
+        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", False)
+        cfg.put("admission.enable", True)
+        cfg.put("admission.tick", 0.02)
+        cfg.put("admission.hold_ticks", 2)
+        cfg.put("admission.decay_ticks", 1000)   # no decay mid-test
+        # the synthetic storm drives BOTH clients at the same msgs/s;
+        # only the attacker's topic-scan shape (fresh topic per
+        # message) must trip, so the verdict rides the fan dimension
+        cfg.put("admission.max_publish_rate", 1_000_000.0)
+        cfg.put("admission.fan_window", 0.1)
+        cfg.put("admission.max_topic_fan", 50.0)
+        cfg.put("supervisor.backoff_base", 0.005)
+        cfg.put("supervisor.backoff_max", 0.05)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            b = node.broker
+            adm = node.admission
+            alarms = node.observed.alarms
+            sess, _ = b.open_session("sub", max_inflight=64)
+            b.subscribe("sub", "t/#", SubOpts(qos=1))
+            got = []
+
+            def on_deliver(cid, pubs):
+                stack = list(pubs)
+                while stack:
+                    p = stack.pop(0)
+                    got.append(p.msg.payload)
+                    if p.pid is not None:
+                        _, more = sess.puback(p.pid)
+                        stack.extend(more)
+
+            b.on_deliver = on_deliver
+            seq = [0]
+            sent = [0]
+
+            def storm(n_honest=40, atk_per=40):
+                # drive the REAL ingest seams: publish notes + the
+                # QoS0-shed enforcement path in Broker.publish
+                for _ in range(n_honest):
+                    i = seq[0]
+                    seq[0] += 1
+                    sent[0] += 1
+                    adm.note_publish("honest", "t/h", 64)
+                    b.publish(make_message("honest", "t/h", b"%d" % i,
+                                           qos=1))
+                for k in range(atk_per):
+                    topic = f"scan/{seq[0]}/{k}"
+                    adm.note_publish("attacker", topic, 64)
+                    b.publish(make_message("attacker", topic, b"a",
+                                           qos=0))
+
+            # phase 1: attacker climbs to quarantine; honest stays clean
+            for _ in range(60):
+                storm()
+                await aio.sleep(0.01)
+                if "attacker" in adm._shed:
+                    break
+            quarantined = "attacker" in adm._shed
+            honest_row = adm.explain("honest")
+            honest_clean = bool(
+                honest_row is not None and honest_row["level"] == 0
+                and not node.banned.check(clientid="honest"))
+            shed_before = adm.shed_count
+            storm()
+            attacker_shed = adm.shed_count > shed_before
+
+            # phase 2: a PERSISTENT injected fault crashes every tick
+            # (the restarted child dies again) + an explicit kill —
+            # fail-open must hold the whole time: shed set empty,
+            # alarm active, attacker traffic flowing unscreened
+            fi.install(FaultInjector([
+                {"point": "admission.score", "action": "raise",
+                 "times": 0}]))
+            child = node.supervisor.lookup("admission.score")
+            killed = child is not None and child.kill()
+            failed_open = await settle(
+                lambda: adm.degraded
+                and alarms.is_active("admission_degraded")
+                and "attacker" not in adm._shed)
+            shed_frozen = adm.shed_count
+            storm()
+            no_new_drop_path = adm.shed_count == shed_frozen
+
+            # phase 3: lift the fault → supervised restart resumes
+            # scoring, re-quarantines the attacker, clears the alarm
+            fi.uninstall()
+            give_up = aio.get_event_loop().time() + 10.0
+            while "attacker" not in adm._shed \
+                    and aio.get_event_loop().time() < give_up:
+                storm()
+                await aio.sleep(0.01)
+            recovered = "attacker" in adm._shed
+            alarm_cleared = await settle(
+                lambda: not alarms.is_active("admission_degraded"))
+
+            # phase 4: 10% injected admission.score faults mid-storm —
+            # wounded ticks fail open + restart, honest delivery holds
+            inj = fi.install(FaultInjector([
+                {"point": "admission.score", "action": "raise",
+                 "prob": 0.1, "times": 0}], seed=5))
+            for _ in range(30):
+                storm()
+                await aio.sleep(0.01)
+            fi.uninstall()
+            faults = inj.fired.get("admission.score", 0)
+            ok_drain = await settle(lambda: len(got) >= sent[0])
+            restarts = node.observed.metrics.get(
+                "broker.supervisor.restarts")
+            fail_opens = node.observed.metrics.get(
+                "broker.admission.fail_open")
+            delivered = len(got)
+            return {
+                "ok": bool(quarantined and honest_clean
+                           and attacker_shed and killed
+                           and failed_open and no_new_drop_path
+                           and recovered and alarm_cleared and ok_drain
+                           and delivered == sent[0]
+                           and restarts >= 1 and faults >= 1),
+                "delivered": delivered, "sent": sent[0],
+                "delivery_ratio": round(
+                    delivered / max(1, sent[0]), 4),
+                "restarts": restarts,
+                "fail_opens": fail_opens,
+                "score_faults": faults,
+                "quarantined_then_shed": bool(quarantined
+                                              and attacker_shed),
+                "honest_never_flagged": honest_clean,
+                "failed_open": bool(failed_open),
+                "no_new_drop_path": bool(no_new_drop_path),
+                "alarm_raised_and_cleared": bool(failed_open
+                                                 and alarm_cleared),
+                "requarantined_after_restart": bool(recovered),
+            }
+        finally:
+            fi.uninstall()
+            await node.stop()
+
     async def all_cycles():
         return {
             "fanout": await fanout_cycle(),
@@ -551,6 +704,7 @@ def chaos_smoke() -> dict:
             "match": await match_cycle(),
             "pipeline": await pipeline_cycle(),
             "segments": await segments_cycle(),
+            "admission": await admission_cycle(),
         }
 
     return aio.run(all_cycles())
@@ -567,12 +721,12 @@ def main(argv=None) -> dict:
     args = ap.parse_args(argv)
 
     from bench import (
-        _config1_size, _config1_sweep_size, _fanout_e2e_size,
-        _qos1_e2e_size, _qos2_e2e_size, _table_lifecycle_size,
-        bench_config1, bench_config1_sweep, bench_fanout_e2e,
-        bench_kernel_join_smoke, bench_qos1_e2e, bench_qos2_e2e,
-        bench_serve_deadline_smoke, bench_serve_pipeline_smoke,
-        bench_table_lifecycle,
+        _adversarial_size, _config1_size, _config1_sweep_size,
+        _fanout_e2e_size, _qos1_e2e_size, _qos2_e2e_size,
+        _table_lifecycle_size, bench_adversarial, bench_config1,
+        bench_config1_sweep, bench_fanout_e2e, bench_kernel_join_smoke,
+        bench_qos1_e2e, bench_qos2_e2e, bench_serve_deadline_smoke,
+        bench_serve_pipeline_smoke, bench_table_lifecycle,
     )
 
     size = _fanout_e2e_size(args.smoke)
@@ -608,6 +762,11 @@ def main(argv=None) -> dict:
     # full rebuild + churn soak across live compaction swaps
     out["table_lifecycle"] = bench_table_lifecycle(
         **_table_lifecycle_size(args.smoke))
+    # adversarial admission A/B (ISSUE 14): 5% attackers at 10x the
+    # honest rate + a CONNECT storm — flag-on holds honest delivery 1.0
+    # and p99 near clean while the ladder throttles/quarantines/bans
+    # the attackers; flag-off records the brownout the gate prevents
+    out["adversarial"] = bench_adversarial(**_adversarial_size(args.smoke))
     # kernel backend A/B (ISSUE 13): hash vs join vs auto at one serve
     # shape, short+deep mixes — the parity gate is CI-asserted, the
     # speedup ratios are tracking numbers for the r06 hardware round

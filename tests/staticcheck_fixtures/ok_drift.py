@@ -20,6 +20,18 @@ def f(metrics, cfg, alarms, hooks, _injector, name):
     alarms.deactivate(f"degraded_fixture:{name}")
     hooks.run("message.dropped", (None, "queue_full"))
     hooks.run("message.dropped", (None, "shared_no_available"))
+    # batched admission plane literals (ISSUE 14)
+    metrics.inc("broker.admission.shed_qos0")
+    metrics.set("broker.admission.tracked_clients", 0)
+    cfg.get("admission.enable")
+    cfg.get("admission.tick")
+    cfg.get("admission.max_topic_fan")
+    _injector.check("admission.score")
+    alarms.activate("admission_degraded", {}, "scorer down")
+    alarms.deactivate("admission_degraded")
+    alarms.activate("admission_quarantine", {}, "clients quarantined")
+    alarms.deactivate("admission_quarantine")
+    hooks.run("message.dropped", (None, "admission_shed"))
 
 
 def g(hooks):
@@ -33,3 +45,4 @@ def h(hists, flightrec):
     hists.hist("obs.e2e.publish_deliver")
     flightrec.dump("breaker_trip")
     flightrec.dump("manual")
+    flightrec.dump("admission_escalation")

@@ -1,0 +1,699 @@
+"""Batched admission plane (broker/admission.py, ISSUE 14): O(1)
+feature accumulation, vectorized scoring, the quarantine ladder with
+hysteresis, fail-open degradation, zero-cost-when-off, per-client state
+bounds, and the ctl/REST explain surface."""
+
+import asyncio
+import json
+import threading
+
+import pytest
+
+from emqx_tpu.broker import Broker, FanoutPipeline, SubOpts, make_message
+from emqx_tpu.broker.admission import FEATURES, LEVELS, Admission
+from emqx_tpu.broker.banned import Banned
+from emqx_tpu.broker.limiter import LimiterGroup, TokenBucket
+from emqx_tpu.observe.alarm import Alarms
+from emqx_tpu.observe.metrics import Metrics
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class Harness:
+    """One Admission on an injected clock with recording callbacks."""
+
+    def __init__(self, **kw):
+        self.now = [0.0]
+        self.banned = Banned()
+        self.alarms = Alarms()
+        self.metrics = Metrics()
+        self.throttles = {}
+        self.kicked = []
+        kw.setdefault("tick_s", 1.0)
+        kw.setdefault("hold_ticks", 2)
+        kw.setdefault("decay_ticks", 3)
+        kw.setdefault("max_publish_rate", 100.0)
+        kw.setdefault("max_topic_fan", 20.0)
+        kw.setdefault("ban_time", 60.0)
+        self.adm = Admission(
+            banned=self.banned, alarms=self.alarms, metrics=self.metrics,
+            clock=lambda: self.now[0], wall=lambda: self.now[0], **kw)
+        self.adm.throttle_cb = \
+            lambda cid, rate: self.throttles.__setitem__(cid, rate)
+        self.adm.kick_cb = self.kicked.append
+
+    def tick(self, dt=1.0):
+        self.now[0] += dt
+        self.adm.score_tick()
+
+    def flood(self, cid, rate=1000, distinct=True, tag=0):
+        for i in range(rate):
+            topic = f"scan/{tag}/{i}" if distinct else "tele/1"
+            self.adm.note_publish(cid, topic, 64)
+
+
+# ---------------------------------------------------------------------------
+# features
+# ---------------------------------------------------------------------------
+
+def test_feature_rows_accumulate_and_ewma_fold():
+    h = Harness(alpha=0.5)
+    for _ in range(100):
+        h.adm.note_publish("c", "t/1", 64)
+    h.adm.note_connect("c")
+    h.adm.note_auth_failure("c")
+    h.tick()
+    row = h.adm.explain("c")
+    f = row["features"]
+    # first tick: EWMA folds alpha * rate from zero
+    assert f["publish_rate"] == pytest.approx(50.0, rel=0.01)
+    assert f["publish_bytes_rate"] == pytest.approx(3200.0, rel=0.01)
+    assert f["connect_rate"] == pytest.approx(1.0, rel=0.01)
+    assert f["auth_fail_rate"] == pytest.approx(0.5, rel=0.01)
+    # second identical tick folds toward the true rate
+    for _ in range(100):
+        h.adm.note_publish("c", "t/1", 64)
+    h.tick()
+    f2 = h.adm.explain("c")["features"]
+    assert f2["publish_rate"] == pytest.approx(75.0, rel=0.01)
+    # counters were reset at each tick (rates, not totals)
+    h.tick()
+    assert h.adm.explain("c")["features"]["publish_rate"] \
+        < f2["publish_rate"]
+    assert list(f) == list(FEATURES)
+
+
+def test_topic_fan_sketch_separates_scan_from_telemetry():
+    h = Harness()
+    h.flood("scanner", rate=500, distinct=True)
+    h.flood("telemetry", rate=500, distinct=False)
+    h.tick()
+    fan_scan = h.adm.explain("scanner")["features"]["topic_fan"]
+    fan_tele = h.adm.explain("telemetry")["features"]["topic_fan"]
+    # one topic sets one sketch bit; 500 distinct topics saturate it
+    assert fan_tele < 2.0
+    assert fan_scan > 10 * max(fan_tele, 0.1)
+
+
+def test_publish_batch_note_matches_per_message_notes():
+    class Pkt:
+        def __init__(self, topic, payload):
+            self.topic = topic
+            self.payload = payload
+
+    h1, h2 = Harness(), Harness()
+    pkts = [Pkt(f"a/{i % 7}", b"x" * 32) for i in range(64)]
+    for p in pkts:
+        h1.adm.note_publish("c", p.topic, len(p.payload))
+    h2.adm.note_publish_batch("c", pkts)
+    h1.tick()
+    h2.tick()
+    assert h1.adm.explain("c")["features"] == h2.adm.explain("c")["features"]
+
+
+def test_malformed_notes_are_thread_safe_and_key_on_peer():
+    h = Harness(max_malformed_rate=1.0)
+    done = threading.Event()
+
+    def shard_thread():
+        for _ in range(50):
+            h.adm.note_malformed(None, ("10.1.2.3", 55000))
+            h.adm.note_malformed("evil", ("10.9.9.9", 1))
+        done.set()
+
+    t = threading.Thread(target=shard_thread)
+    t.start()
+    t.join(5.0)
+    assert done.is_set()
+    h.tick()
+    assert h.adm.explain("ip:10.1.2.3")["features"]["malformed_rate"] > 0
+    assert h.adm.explain("evil")["features"]["malformed_rate"] > 0
+
+
+# ---------------------------------------------------------------------------
+# the ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_escalates_with_hysteresis_throttle_shed_ban():
+    h = Harness()
+    # one hot tick is NOT enough (hold_ticks=2)
+    h.flood("atk", tag=0)
+    h.tick()
+    assert h.adm.explain("atk")["level"] == 0
+    # second consecutive hot tick -> throttle
+    h.flood("atk", tag=1)
+    h.tick()
+    assert h.adm.explain("atk")["level_name"] == "throttle"
+    assert h.throttles["atk"] == h.adm.throttle_rate
+    assert not h.adm.shed_qos0("atk")
+    # two more -> quarantine: QoS0 shed engages
+    for t in (2, 3):
+        h.flood("atk", tag=t)
+        h.tick()
+    assert h.adm.explain("atk")["level_name"] == "quarantine"
+    assert h.adm.shed_qos0("atk")
+    assert h.alarms.is_active("admission_quarantine")
+    assert h.metrics.get("broker.admission.quarantined") == 1
+    # two more -> temp-ban: Banned row, kick, feature row dropped
+    for t in (4, 5):
+        h.flood("atk", tag=t)
+        h.tick()
+    assert h.banned.check(clientid="atk", now=h.now[0])
+    assert h.kicked == ["atk"]
+    assert h.adm.explain("atk") is None       # row dropped with the ban
+    assert h.throttles["atk"] is None         # throttle restored
+    assert not h.adm.shed_qos0("atk")
+    assert h.metrics.get("broker.admission.banned") == 1
+    # quarantine alarm clears once nobody is quarantined
+    assert not h.alarms.is_active("admission_quarantine")
+    # the ban expires on the SAME injected clock -> clean reconnect
+    assert not h.banned.check(clientid="atk", now=h.now[0] + 61.0)
+
+
+def test_ladder_decays_and_restores_throttle():
+    h = Harness()
+    for t in range(4):
+        h.flood("atk", tag=t)
+        h.tick()
+    assert h.adm.explain("atk")["level_name"] == "quarantine"
+    # the attacker STOPS: escalation freezes (a hot-but-idle EWMA must
+    # not march to a ban on stale memory), the score drains, and each
+    # decay_ticks run of calm ticks climbs one level back down
+    seen = []
+    for _ in range(40):
+        h.tick()
+        row = h.adm.explain("atk")
+        assert row is not None, "stopped client must never be banned"
+        if not seen or seen[-1] != row["level_name"]:
+            seen.append(row["level_name"])
+        if row["level"] == 0:
+            break
+    assert seen == ["quarantine", "throttle", "observe"]
+    assert not h.adm.shed_qos0("atk")
+    assert h.throttles["atk"] is None  # bucket restored
+    assert h.adm.bans == 0
+
+
+def test_honest_client_never_climbs():
+    h = Harness()
+    for t in range(10):
+        h.flood("honest", rate=50, distinct=False)
+        h.tick()
+    assert h.adm.explain("honest")["level"] == 0
+    assert h.adm.list_decisions() == []
+
+
+def test_olp_brownout_tightens_threshold():
+    class HotOlp:
+        def brownout_level(self):
+            return 2
+
+    calm, hot = Harness(), Harness()
+    hot.adm.olp = HotOlp()
+    # a borderline flood: ~70% of the publish threshold, under the
+    # normal gate but past the brownout-tightened one (1 - 0.25*2)
+    for t in range(8):
+        for harness in (calm, hot):
+            for i in range(70):
+                harness.adm.note_publish("gray", "tele/x", 64)
+            harness.tick()
+    assert calm.adm.explain("gray")["level"] == 0
+    assert hot.adm.explain("gray")["level"] >= 1
+
+
+def test_flightrec_dumps_once_per_escalation_tick():
+    class Rec:
+        def __init__(self):
+            self.reasons = []
+
+        def dump(self, reason, note=None):
+            self.reasons.append(reason)
+
+    h = Harness()
+    rec = Rec()
+    h.adm.flightrec = rec
+    # two attackers reach quarantine on the SAME tick -> one dump
+    for t in range(4):
+        h.flood("a1", tag=t)
+        h.flood("a2", tag=100 + t)
+        h.tick()
+    assert rec.reasons == ["admission_escalation"]
+
+
+def test_explain_clear_and_list_decisions():
+    h = Harness()
+    for t in range(4):
+        h.flood("atk", tag=t)
+        h.tick()
+    rows = h.adm.list_decisions()
+    assert [r["clientid"] for r in rows] == ["atk"]
+    assert rows[0]["level_name"] == "quarantine"
+    assert set(rows[0]["features"]) == set(FEATURES)
+    assert rows[0]["score"] > 1.0
+    # operator clear lifts the decision now; the row survives
+    assert h.adm.clear("atk")
+    assert h.adm.explain("atk")["level"] == 0
+    assert not h.adm.shed_qos0("atk")
+    assert h.throttles["atk"] is None
+    assert not h.adm.clear("ghost")
+    # levels vocabulary is stable (the REST/CLI contract)
+    assert LEVELS == ("observe", "throttle", "quarantine", "ban")
+
+
+# ---------------------------------------------------------------------------
+# fail-open
+# ---------------------------------------------------------------------------
+
+def test_fail_open_clears_decisions_raises_alarm_recovers():
+    h = Harness()
+    for t in range(4):
+        h.flood("atk", tag=t)
+        h.tick()
+    assert h.adm.shed_qos0("atk")
+    h.adm._fail_open("crashed")
+    # every standing decision cleared: traffic flows unscreened
+    assert not h.adm.shed_qos0("atk")
+    assert h.adm.explain("atk")["level"] == 0
+    assert h.throttles["atk"] is None
+    assert h.alarms.is_active("admission_degraded")
+    assert h.metrics.get("broker.admission.fail_open") == 1
+    # the next successful tick clears the alarm; the attacker re-climbs
+    for t in range(4):
+        h.flood("atk", tag=10 + t)
+        h.tick()
+    assert not h.alarms.is_active("admission_degraded")
+    assert h.adm.explain("atk")["level_name"] == "quarantine"
+
+
+def test_scorer_child_crash_fails_open_via_run_loop():
+    async def main():
+        h = Harness()
+        h.adm.tick_s = 0.005
+        for t in range(4):
+            h.flood("atk", tag=t)
+            h.tick()
+        assert h.adm.shed_qos0("atk")
+
+        boom = [False]
+        orig = h.adm.score_tick
+
+        def tick_or_boom():
+            if boom[0]:
+                raise RuntimeError("scorer bug")
+            orig()
+
+        h.adm.score_tick = tick_or_boom
+        task = asyncio.ensure_future(h.adm.run())
+        boom[0] = True
+        with pytest.raises(RuntimeError):
+            await task
+        assert not h.adm.shed_qos0("atk")
+        assert h.alarms.is_active("admission_degraded")
+        # a KILL (cancellation) fails open too
+        h2 = Harness()
+        h2.adm.tick_s = 10.0
+        task2 = asyncio.ensure_future(h2.adm.run())
+        await asyncio.sleep(0)
+        task2.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await task2
+        assert h2.alarms.is_active("admission_degraded")
+
+    run(main())
+
+
+def test_shed_goes_stale_when_scorer_hangs():
+    h = Harness()
+    for t in range(4):
+        h.flood("atk", tag=t)
+        h.tick()
+    assert h.adm.shed_qos0("atk")
+    # no tick for > 4 tick periods (a HUNG scorer, not a crashed one):
+    # the staleness guard fails open without any cleanup running
+    h.now[0] += 5.0 * h.adm.tick_s
+    assert not h.adm.shed_qos0("atk")
+
+
+# ---------------------------------------------------------------------------
+# per-client state bounds (churn audit)
+# ---------------------------------------------------------------------------
+
+def test_idle_rows_evicted_tracked_clients_bounded():
+    h = Harness(idle_expiry=30.0)
+    for i in range(500):
+        h.adm.note_connect(f"churn{i}")
+        h.adm.note_disconnect(f"churn{i}")
+    # an attacker with a standing decision must SURVIVE eviction
+    for t in range(4):
+        h.flood("atk", tag=t)
+        h.tick(dt=0.1)
+    assert h.adm.explain("atk")["level_name"] == "quarantine"
+    assert h.metrics.get("broker.admission.tracked_clients") == 501
+    h.tick(dt=31.0)
+    assert h.metrics.get("broker.admission.tracked_clients") == 1
+    assert h.adm.explain("atk") is not None
+    assert h.adm.explain("churn0") is None
+    # slots are REUSED after eviction (free-list, no slab growth)
+    cap_before = len(h.adm._keys)
+    for i in range(400):
+        h.adm.note_connect(f"wave2_{i}")
+    assert len(h.adm._keys) == cap_before
+
+
+def test_reconnect_churn_keeps_all_keyed_state_bounded():
+    """The audit satellite end-to-end: feature rows, flapping deques
+    and limiter bucket pairs all stay bounded through 1000 reconnect
+    cycles + sweeps."""
+    from emqx_tpu.broker.flapping import Flapping
+
+    h = Harness(idle_expiry=10.0)
+    now = [0.0]
+    banned = Banned()
+    flap = Flapping(banned, max_count=50, window_time=5.0,
+                    clock=lambda: now[0])
+    lg = LimiterGroup(max_messages_rate=100.0, max_bytes_rate=0.0)
+    for i in range(1000):
+        cid = f"churner{i}"
+        h.adm.note_connect(cid)
+        flap.record_disconnect(cid)
+        lg.allow_publish(cid, 10, now=now[0])
+        now[0] += 0.01
+    h.now[0] = now[0]
+    h.tick(dt=60.0)
+    now[0] += 60.0
+    flap.sweep(now[0])
+    lg.sweep_idle(30.0, now=now[0])
+    assert h.adm.info()["tracked_clients"] == 0
+    assert flap.tracked() == 0
+    assert lg.tracked() == 0
+
+
+# ---------------------------------------------------------------------------
+# enforcement seams: broker.publish / fanout.offer / token bucket
+# ---------------------------------------------------------------------------
+
+def _quarantine(h, cid="atk"):
+    for t in range(4):
+        h.flood(cid, tag=t)
+        h.tick()
+    assert cid in h.adm._shed
+
+
+def test_broker_publish_sheds_quarantined_qos0_only():
+    h = Harness()
+    b = Broker()
+    b.metrics = h.metrics
+    h.adm.attach(b)
+    b.open_session("sub")
+    b.subscribe("sub", "#", SubOpts(qos=1))
+    _quarantine(h)
+    dropped = []
+    b.hooks.add("message.dropped",
+                lambda msg, reason: dropped.append((msg.sender, reason)))
+    res = b.publish(make_message("atk", "t/x", b"flood", qos=0))
+    assert res.no_subscribers and res.matched == 0
+    assert dropped == [("atk", "admission_shed")]
+    assert h.metrics.get("broker.admission.shed_qos0") >= 1
+    # QoS1 from the same sender rides the throttle, NOT a drop path
+    res = b.publish(make_message("atk", "t/x", b"acked", qos=1))
+    assert res.matched == 1
+    # honest senders are untouched
+    res = b.publish(make_message("honest", "t/x", b"ok", qos=0))
+    assert res.matched == 1
+
+
+def test_fanout_offer_sheds_quarantined_qos0_only():
+    async def main():
+        h = Harness()
+        b = Broker()
+        b.metrics = h.metrics
+        h.adm.attach(b)
+        b.open_session("sub")
+        b.subscribe("sub", "#", SubOpts(qos=0))
+        _quarantine(h)
+        got = []
+        b.on_deliver = lambda cid, pubs: got.extend(pubs)
+        p = FanoutPipeline(b, window_s=0.0, metrics=h.metrics)
+        await p.start()
+        b.fanout = p
+        assert p.offer(make_message("atk", "t/x", b"flood", qos=0))
+        assert p.offer(make_message("honest", "t/x", b"ok", qos=0))
+        await asyncio.sleep(0.05)
+        payloads = [bytes(pub.msg.payload) for pub in got]
+        assert payloads == [b"ok"]       # consumed-by-policy, not queued
+        assert h.metrics.get("broker.admission.shed_qos0") >= 1
+        await p.stop()
+
+    run(main())
+
+
+def test_token_bucket_retune_in_place():
+    tb = TokenBucket(0.0)           # unlimited (the default limiter)
+    assert tb.unlimited
+    tb.retune(5.0)
+    assert not tb.unlimited and tb.burst == 5.0
+    ok, _ = tb.consume(5.0, now=0.0)
+    assert ok
+    ok, wait = tb.consume(1.0, now=0.0)
+    assert not ok and wait > 0
+    tb.retune(0.0)                  # restore unlimited
+    assert tb.unlimited
+    assert tb.consume(1000.0)[0]
+
+
+def test_limiter_sweep_idle_evicts_stale_pairs():
+    lg = LimiterGroup(max_messages_rate=10.0)
+    lg.allow_publish("old", 1, now=0.0)
+    lg.allow_publish("fresh", 1, now=500.0)
+    assert lg.tracked() == 2
+    assert lg.sweep_idle(100.0, now=550.0) == 1
+    assert lg.tracked() == 1
+    # recreation on demand is seamless
+    assert lg.allow_publish("old", 1, now=551.0)[0]
+
+
+# ---------------------------------------------------------------------------
+# zero-cost when disabled
+# ---------------------------------------------------------------------------
+
+def test_flag_off_is_zero_call(monkeypatch):
+    """The None-guard contract: with admission off, NO Admission method
+    runs on any seam — class-level spies would catch a stray call."""
+    for name in ("note_publish", "note_publish_batch", "note_connect",
+                 "note_disconnect", "note_auth_failure",
+                 "note_malformed", "shed_qos0"):
+        monkeypatch.setattr(
+            Admission, name,
+            lambda self, *a, **kw: pytest.fail(
+                "admission seam called while disabled"),
+        )
+    b = Broker()
+    assert b.admission is None
+    b.open_session("sub")
+    b.subscribe("sub", "#", SubOpts(qos=0))
+    res = b.publish(make_message("c", "t/x", b"m", qos=0))
+    assert res.matched == 1
+
+    async def fanout_path():
+        p = FanoutPipeline(b, window_s=0.0)
+        await p.start()
+        b.fanout = p
+        assert p.offer(make_message("c", "t/x", b"m2", qos=0))
+        await asyncio.sleep(0.02)
+        await p.stop()
+
+    run(fanout_path())
+
+
+def test_node_flag_off_builds_no_admission():
+    async def main():
+        from emqx_tpu.config import Config
+        from emqx_tpu.node import BrokerNode
+
+        cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", False)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            assert node.admission is None
+            assert node.broker.admission is None
+            assert node.supervisor.lookup("admission.score") is None
+            assert node.info()["admission"] is None
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# node wiring: live seams, throttle retune, REST/CLI surface
+# ---------------------------------------------------------------------------
+
+async def _start_admission_node(extra=""):
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    cfg = Config(file_text=(
+        'listeners.tcp.default.bind = "127.0.0.1:0"\n'
+        'admission.enable = true\n'
+        'admission.tick = 0.02\n'
+        'admission.hold_ticks = 2\n'
+        'admission.decay_ticks = 1000\n'
+        'admission.max_topic_fan = 20\n'
+        'admission.max_publish_rate = 1000000\n'
+        + extra
+    ))
+    cfg.put("tpu.enable", False)
+    node = BrokerNode(cfg)
+    await node.start()
+    return node
+
+
+async def _until(pred, timeout=8.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while not pred() and asyncio.get_event_loop().time() < deadline:
+        await asyncio.sleep(0.005)
+    return pred()
+
+
+def test_node_live_seams_score_and_throttle_real_connection():
+    """A real attacker connection over TCP: the channel publish seam
+    feeds the rows, the scorer child escalates, and the level-1
+    throttle retunes the LIVE connection's message bucket in place;
+    the operator clear restores it."""
+    from emqx_tpu.client import Client
+
+    async def main():
+        node = await _start_admission_node()
+        port = node.listeners.all()[0].port
+        atk = Client(clientid="atk", port=port)
+        await atk.connect()
+        try:
+            ok = False
+            for wave in range(200):
+                for i in range(40):
+                    await atk.publish(f"scan/{wave}/{i}", b"x", qos=0)
+                if node.admission.explain("atk") and \
+                        node.admission.explain("atk")["level"] >= 1:
+                    ok = True
+                    break
+                await asyncio.sleep(0.01)
+            assert ok, node.admission.list_decisions(all_rows=True)
+            conn = node.connections["atk"]
+            assert await _until(
+                lambda: conn._msg_bucket.rate
+                == node.admission.throttle_rate)
+            # operator clear restores the configured (unlimited) rate
+            node.admission.clear("atk")
+            assert conn._msg_bucket.unlimited
+            # the connect/disconnect hooks feed rows too
+            row = node.admission.explain("atk")
+            assert row is not None
+        finally:
+            await atk.disconnect()
+            await node.stop()
+
+    run(main())
+
+
+def test_node_frame_error_and_auth_failure_seams():
+    async def main():
+        node = await _start_admission_node()
+        port = node.listeners.all()[0].port
+        # garbage bytes -> FrameError -> malformed note keyed on peer
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"\xff\xff\xff\xff\xff\xff\xff\xff")
+        try:
+            await asyncio.wait_for(reader.read(64), 2.0)
+        except asyncio.TimeoutError:
+            pass
+        writer.close()
+        assert await _until(
+            lambda: (node.admission.explain("ip:127.0.0.1") or {})
+            .get("features", {}).get("malformed_rate", 0) > 0)
+        # failed CONNECT (banned clientid) -> auth-failure note
+        node.banned.add("clientid", "mallory")
+        from emqx_tpu.mqtt import frame as F
+        from emqx_tpu.mqtt import packet as P
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(F.serialize(P.Connect(proto_ver=4,
+                                           clientid="mallory")))
+        data = await asyncio.wait_for(reader.read(64), 5.0)
+        assert len(data) >= 4 and data[3] != 0  # refused
+        writer.close()
+        assert await _until(
+            lambda: (node.admission.explain("mallory") or {})
+            .get("features", {}).get("auth_fail_rate", 0) > 0)
+        await node.stop()
+
+    run(main())
+
+
+def test_admission_rest_and_cli_surface():
+    """GET /api/v5/admission lists decisions WITH feature rows (the
+    explainability contract); DELETE lifts one; the ctl subcommand
+    drives the same endpoints."""
+    import io
+    from contextlib import redirect_stdout
+    from urllib.request import urlopen
+
+    from emqx_tpu.mgmt.cli import main as ctl_main
+
+    async def main():
+        node = await _start_admission_node(
+            'dashboard.enable = true\n'
+            'dashboard.auth = false\n'
+            'dashboard.listen = "127.0.0.1:0"\n'
+        )
+        adm = node.admission
+        try:
+            # quarantine an attacker through the plane itself
+            for t in range(4):
+                for i in range(300):
+                    adm.note_publish("atk", f"scan/{t}/{i}", 64)
+                adm.score_tick(now=float(t + 1))
+            assert "atk" in adm._shed
+            mport = node.mgmt_server.port
+
+            def rest(method, path):
+                import urllib.request
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{mport}{path}", method=method)
+                with urlopen(req, timeout=5) as resp:
+                    body = resp.read()
+                    return resp.status, \
+                        json.loads(body) if body else None
+
+            status, out = await asyncio.to_thread(
+                rest, "GET", "/api/v5/admission")
+            assert status == 200 and out["enabled"]
+            row = next(d for d in out["data"]
+                       if d["clientid"] == "atk")
+            assert row["level_name"] == "quarantine"
+            assert set(row["features"]) == set(FEATURES)
+            # ctl admission renders the same payload
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                rc = await asyncio.to_thread(
+                    ctl_main,
+                    ["--url", f"http://127.0.0.1:{mport}", "admission"])
+            assert rc == 0 and '"atk"' in buf.getvalue()
+            # DELETE lifts the decision
+            status, _ = await asyncio.to_thread(
+                rest, "DELETE", "/api/v5/admission/atk")
+            assert status == 204
+            assert adm.explain("atk")["level"] == 0
+            status, out = await asyncio.to_thread(
+                rest, "GET", "/api/v5/admission")
+            assert out["data"] == []
+            # ?all=true shows tracked-but-clean rows
+            status, out = await asyncio.to_thread(
+                rest, "GET", "/api/v5/admission?all=true")
+            assert any(d["clientid"] == "atk" for d in out["data"])
+        finally:
+            await node.stop()
+
+    run(main())

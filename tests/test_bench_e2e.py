@@ -140,6 +140,20 @@ def test_bench_e2e_smoke_delivers_everything():
     want = "2x_budget" if (_os.cpu_count() or 1) > 1 \
         else "prefetch_timeout"
     assert churn["stall_bound"] == want, churn
+    # adversarial admission A/B (ISSUE 14): flag-on holds honest
+    # delivery 1.0 with no honest client ever flagged while the ladder
+    # limits the attackers (throttle/quarantine/ban/refused CONNECTs);
+    # the p99-vs-clean ratios are recorded for the bench (latency
+    # ratios on a loaded CI box are noise — the 1.5x gate boolean rides
+    # the JSON with a 50 ms noise floor and is asserted as present)
+    adv = out["adversarial"]
+    assert adv["attack_on"]["honest"]["sent"] > 0, adv
+    assert adv["gate_honest_delivery"], adv
+    assert adv["gate_attackers_limited"], adv
+    assert adv["gate_no_honest_flagged"], adv
+    assert "gate_honest_p99" in adv and "p99_off_vs_clean" in adv, adv
+    assert adv["attack_on"]["bans"] >= 1 \
+        or adv["attack_on"]["decisions"], adv
     # chaos smoke: one kill-and-recover cycle per subsystem (including
     # the ISSUE-7 serve plane under "match"), each healing via
     # supervisor restart with delivery intact
@@ -166,3 +180,17 @@ def test_bench_e2e_smoke_delivers_everything():
     assert seg["delivery_ratio"] == 1.0, seg
     assert seg["corrupt_segment_rejected"] and seg["rebuild_served"], seg
     assert seg["swap_fault_recovered"] and seg["kill_resumed"], seg
+    # admission chaos (ISSUE 14): scorer killed + held down by a
+    # persistent injected fault mid-storm → FAIL-OPEN (standing
+    # decisions clear, admission_degraded raised, attacker traffic
+    # flows — never a new drop path), zero honest drops attributable
+    # to admission, supervised restart resumes scoring and clears the
+    # alarm; a 10%-fault storm holds delivery 1.0 too
+    ac = out["chaos"]["admission"]
+    assert ac["delivery_ratio"] == 1.0, ac
+    assert ac["quarantined_then_shed"], ac
+    assert ac["honest_never_flagged"], ac
+    assert ac["failed_open"] and ac["no_new_drop_path"], ac
+    assert ac["alarm_raised_and_cleared"], ac
+    assert ac["requarantined_after_restart"], ac
+    assert ac["score_faults"] >= 1 and ac["fail_opens"] >= 1, ac

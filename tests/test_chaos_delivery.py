@@ -1299,3 +1299,171 @@ def test_chaos_kill_mid_dump_leaves_no_torn_file(tmp_path):
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# 14. admission plane: scorer killed / held down / fault-stormed (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+async def _start_admission_node(**extra):
+    from emqx_tpu.config import Config
+    from emqx_tpu.node import BrokerNode
+
+    cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+    cfg.put("tpu.enable", False)
+    cfg.put("admission.enable", True)
+    cfg.put("admission.tick", 0.02)
+    cfg.put("admission.hold_ticks", 2)
+    cfg.put("admission.decay_ticks", 1000)
+    # the chaos storm drives both clients at the same msgs/s; only the
+    # attacker's fresh-topic-per-message shape must trip (fan dimension)
+    cfg.put("admission.max_publish_rate", 1_000_000.0)
+    cfg.put("admission.fan_window", 0.1)
+    cfg.put("admission.max_topic_fan", 50.0)
+    cfg.put("supervisor.backoff_base", 0.005)
+    cfg.put("supervisor.backoff_max", 0.05)
+    for k, v in extra.items():
+        cfg.put(k, v)
+    node = BrokerNode(cfg)
+    await node.start()
+    return node
+
+
+def _admission_storm(node, sent, seq, n_honest=40, atk_per=40):
+    """Drive the real seams: honest QoS1 publishes (delivery-checked)
+    + a QoS0 topic-scan flood that must hit the shed path when (and
+    only when) the scorer stands."""
+    b = node.broker
+    adm = node.admission
+    for _ in range(n_honest):
+        i = seq[0]
+        seq[0] += 1
+        sent[0] += 1
+        adm.note_publish("honest", "t/h", 64)
+        b.publish(make_message("honest", "t/h", b"%d" % i, qos=1))
+    for k in range(atk_per):
+        topic = f"scan/{seq[0]}/{k}"
+        adm.note_publish("attacker", topic, 64)
+        b.publish(make_message("attacker", topic, b"a", qos=0))
+
+
+def _acking_subscriber(node):
+    sess, _ = node.broker.open_session("sub", max_inflight=64)
+    node.broker.subscribe("sub", "t/#", SubOpts(qos=1))
+    got = []
+
+    def on_deliver(cid, pubs):
+        stack = list(pubs)
+        while stack:
+            p = stack.pop(0)
+            got.append(p.msg.payload)
+            if p.pid is not None:
+                _, more = sess.puback(p.pid)
+                stack.extend(more)
+
+    node.broker.on_deliver = on_deliver
+    return got
+
+
+def test_chaos_admission_scorer_kill_fails_open_and_recovers(tmp_path):
+    """The fail-open acceptance gate: kill the admission.score child
+    (held down by a persistent injected fault) mid-storm — every
+    standing decision clears, the admission_degraded alarm raises,
+    attacker traffic flows unscreened (never a new drop path), honest
+    delivery stays 1.0; lifting the fault lets the supervised restart
+    resume scoring, re-quarantine the attacker and clear the alarm."""
+
+    async def main():
+        node = await _start_admission_node()
+        try:
+            adm = node.admission
+            alarms = node.observed.alarms
+            node.flightrec.out_dir = node.tracing.dir = str(tmp_path)
+            got = _acking_subscriber(node)
+            sent, seq = [0], [0]
+            # phase 1: the attacker climbs to quarantine; honest clean
+            for _ in range(60):
+                _admission_storm(node, sent, seq)
+                await asyncio.sleep(0.01)
+                if "attacker" in adm._shed:
+                    break
+            assert "attacker" in adm._shed
+            assert adm.explain("honest")["level"] == 0
+            # quarantine escalations dumped forensics exactly per tick
+            dumps = _flightrec_files(node, "admission_escalation")
+            assert len(dumps) >= 1
+            _assert_wellformed_dump(dumps[0], "admission_escalation")
+            shed_before = adm.shed_count
+            _admission_storm(node, sent, seq)
+            assert adm.shed_count > shed_before   # shed path is LIVE
+            # phase 2: persistent fault + kill — fail-open must HOLD
+            faultinject.install(FaultInjector([
+                {"point": "admission.score", "action": "raise",
+                 "times": 0}]))
+            child = node.supervisor.lookup("admission.score")
+            assert child is not None and child.kill()
+            assert await until(
+                lambda: adm.degraded
+                and alarms.is_active("admission_degraded")
+                and "attacker" not in adm._shed)
+            frozen = adm.shed_count
+            _admission_storm(node, sent, seq)
+            assert adm.shed_count == frozen   # unscreened, no drops
+            # phase 3: lift the fault → restart resumes, alarm clears
+            faultinject.uninstall()
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while "attacker" not in adm._shed \
+                    and asyncio.get_event_loop().time() < deadline:
+                _admission_storm(node, sent, seq)
+                await asyncio.sleep(0.01)
+            assert "attacker" in adm._shed
+            assert await until(
+                lambda: not alarms.is_active("admission_degraded"))
+            # zero honest drops attributable to admission, end to end
+            assert await until(lambda: len(got) >= sent[0])
+            assert len(got) == sent[0]
+            assert node.observed.metrics.get(
+                "broker.supervisor.restarts") >= 1
+            assert node.observed.metrics.get(
+                "broker.admission.fail_open") >= 1
+        finally:
+            faultinject.uninstall()
+            await node.stop()
+
+    run(main())
+
+
+def test_chaos_admission_injected_fault_storm_delivery_holds():
+    """10% admission.score faults mid-storm: wounded ticks fail open
+    and restart, honest delivery stays 1.0 throughout, and scoring
+    keeps converging between the wounds (the attacker still ends up
+    screened)."""
+
+    async def main():
+        node = await _start_admission_node()
+        try:
+            adm = node.admission
+            got = _acking_subscriber(node)
+            sent, seq = [0], [0]
+            inj = faultinject.install(FaultInjector([
+                {"point": "admission.score", "action": "raise",
+                 "prob": 0.1, "times": 0}], seed=5))
+            screened = False
+            for _ in range(120):
+                _admission_storm(node, sent, seq)
+                await asyncio.sleep(0.01)
+                screened = screened or "attacker" in adm._shed
+            faultinject.uninstall()
+            assert inj.fired.get("admission.score", 0) >= 1
+            assert screened
+            assert adm.explain("honest")["level"] == 0
+            assert not node.banned.check(clientid="honest")
+            assert await until(lambda: len(got) >= sent[0])
+            assert len(got) == sent[0]
+            assert node.observed.metrics.get(
+                "broker.supervisor.restarts") >= 1
+        finally:
+            faultinject.uninstall()
+            await node.stop()
+
+    run(main())

@@ -34,15 +34,24 @@ def test_banned_blocks_connect_with_banned_rc():
 
 
 def test_flapping_bans_after_threshold():
+    # clock-injectable (the supervise.py discipline): the hook-driven
+    # detector, the ban it issues and the expiry all ride ONE fake
+    # clock — no wall-clock reads anywhere in the assertion chain
+    now = [1000.0]
     broker = Broker()
     banned = Banned().attach(broker)
-    f = Flapping(banned, max_count=3, window_time=10, ban_time=60).attach(broker)
+    f = Flapping(banned, max_count=3, window_time=10, ban_time=60,
+                 clock=lambda: now[0]).attach(broker)
     for _ in range(2):
         broker.hooks.run("client.disconnected", ("c1", "x"))
-    assert not banned.check(clientid="c1")
+        now[0] += 1.0
+    assert not banned.check(clientid="c1", now=now[0])
     broker.hooks.run("client.disconnected", ("c1", "x"))
-    assert banned.check(clientid="c1")
+    assert banned.check(clientid="c1", now=now[0])
     assert f.detected == 1
+    # the ban carries the injected clock: expiry is deterministic
+    assert banned.check(clientid="c1", now=now[0] + 59.0)
+    assert not banned.check(clientid="c1", now=now[0] + 61.0)
 
 
 def test_flapping_window_slides():
@@ -52,6 +61,25 @@ def test_flapping_window_slides():
     f.record_disconnect("c", now=1)
     f.record_disconnect("c", now=12)  # first two aged out
     assert not banned.check(clientid="c")
+
+
+def test_flapping_sweep_bounds_table_under_churn():
+    # the churn-audit satellite: a burst of one-shot clientids followed
+    # by SILENCE must not pin the events table (the amortized in-line
+    # sweep only runs while disconnects keep arriving — housekeeping
+    # calls sweep() explicitly)
+    now = [0.0]
+    f = Flapping(Banned(), max_count=5, window_time=10,
+                 clock=lambda: now[0])
+    for i in range(300):
+        f.record_disconnect(f"churn{i}")
+        now[0] += 0.01
+    tracked = f.tracked()
+    assert tracked > 0
+    now[0] += 11.0            # whole window elapsed for everyone
+    assert f.sweep() == tracked
+    assert f.tracked() == 0
+    assert f.sweep() == 0     # idempotent
 
 
 def test_token_bucket():
