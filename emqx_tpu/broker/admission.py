@@ -32,6 +32,13 @@ Dataflow::
   ``hash(topic) & 63``): O(1) update, linear-counting estimate
   ``-m·ln(z/m)`` at tick time — a topic-scan flood saturates it while
   a telemetry client publishing one topic sets one bit.
+* **Host-keyed storm rows**: CONNECT and auth-failure features
+  accumulate on an ``ip:<peerhost>`` row ALONGSIDE the per-clientid
+  row, so a distributed-clientid flood from one host concentrates on
+  the host row instead of diluting across fresh per-client EWMAs.
+  The ip ladder skips throttle/kick (no single channel to retune) and
+  bottoms out at the peerhost temp-ban, which refuses the whole host
+  at CONNACK.
 * **Ladder hysteresis**: escalate one level after ``hold_ticks``
   consecutive ticks at or above the threshold, de-escalate after
   ``decay_ticks`` consecutive calm ticks — recovered clients climb
@@ -240,18 +247,35 @@ class Admission:
     # grow (and rebind) the slabs, and ``self._counts[self._slot(k)]``
     # would subscript the pre-grow array Python already loaded.
 
-    def note_connect(self, clientid: str) -> None:
+    def note_connect(self, clientid: str,
+                     peerhost: Optional[str] = None) -> None:
+        """CONNECT seam.  The storm features ALSO key on the ``ip:``
+        peerhost row when the caller knows it: a distributed-clientid
+        flood from one host spreads one connect per fresh row and
+        never trips the per-client EWMA — the host row sums them.
+        The ip ladder skips throttle/kick (no live channel to retune)
+        and lands at the peerhost temp-ban."""
         i = self._slot(clientid)
         self._counts[i, _C_CONNECT] += 1.0
+        if peerhost:
+            j = self._slot(f"ip:{peerhost}")
+            self._counts[j, _C_CONNECT] += 1.0
 
     def note_disconnect(self, clientid: str) -> None:
         i = self._slot(clientid)
         self._counts[i, _C_DISCONNECT] += 1.0
 
-    def note_auth_failure(self, clientid: str) -> None:
+    def note_auth_failure(self, clientid: str,
+                          peerhost: Optional[str] = None) -> None:
         i = self._slot(clientid)
         self._counts[i, _C_CONNECT] += 1.0
         self._counts[i, _C_AUTH_FAIL] += 1.0
+        if peerhost:
+            # credential stuffing rotates clientids freely; the source
+            # host is the stable key (see note_connect)
+            j = self._slot(f"ip:{peerhost}")
+            self._counts[j, _C_CONNECT] += 1.0
+            self._counts[j, _C_AUTH_FAIL] += 1.0
 
     def note_publish(self, clientid: Optional[str], topic: str,
                      nbytes: int, n: int = 1) -> None:
@@ -646,7 +670,9 @@ class Admission:
         broker.admission = self
         broker.hooks.add(
             "client.connected",
-            lambda cid, info: self.note_connect(cid),
+            lambda cid, info: self.note_connect(
+                cid, (info or {}).get("peerhost")
+                if isinstance(info, dict) else None),
             name="admission.connect",
         )
         broker.hooks.add(

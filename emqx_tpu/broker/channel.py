@@ -199,8 +199,11 @@ class Channel:
             if adm is not None:
                 # admission feature seam: auth-failure rate (a
                 # credential-stuffing storm never reaches
-                # client.connected, so the connect hook can't see it)
-                adm.note_auth_failure(clientid)
+                # client.connected, so the connect hook can't see it);
+                # the peerhost rides along so host-keyed rows catch
+                # rotating-clientid stuffing from one source
+                adm.note_auth_failure(clientid,
+                                      self.conninfo.get("peerhost"))
             rc = ok if isinstance(ok, int) else P.RC.NOT_AUTHORIZED
             return self._connack_error(rc)
         return self._complete_connect(pkt, props, clientid)

@@ -137,6 +137,35 @@ compute:
   (waiters fail over to the CPU trie) and the supervised restart
   resumes consuming.
 
+**Multichip serve backend** (opt-in, ``match.multichip.enable``): the
+match TABLE shards by topic-prefix over a dp×tp device mesh
+(``parallel/multichip_serve.py``) and real publish traffic serves from
+ALL chips — the on-device analog of the reference's cluster routing
+(ekka/mria replicated route tables), and the dryrun→serve step for
+every MULTICHIP_r05 configuration:
+
+* each ``tp`` shard owns the filters whose root token hashes to it
+  (8 chips hold 8× the filters — the path past 10M toward 100M);
+  publish batches are fanned over ``tp`` and sharded over ``dp``;
+* per-shard matches translate through a local→service accept-id map ON
+  DEVICE and leave the mesh as the dense compact contract
+  (``CompactFanoutResult``: per-row disjoint id segments,
+  concat-no-dedup), so ring/ICI + d2h traffic is proportional to
+  MATCHES, never table width (ROADMAP dispatch-tax residual (d));
+* maintenance rides the SAME drain/apply cycle: ``_table_add``/
+  ``_table_del`` note mutations into per-shard host subtables, the
+  sync loop applies deltas off the event loop, a compaction swap
+  repartitions from the fresh aid space (single-chip path serves
+  while the partition rebuilds);
+* per-shard segments persist next to the main segment with an
+  epoch-guarded, checksummed manifest — a cold start only seeds from
+  them when the service epoch still matches, else it repartitions;
+* failure semantics compose unchanged: a dead (``kill_shard``) or
+  fault-injected (``match.shard``) shard raises at dispatch and the
+  batch fails over to the CPU trie exactly like any other device
+  failure (breaker strike in deadline mode, probe recovery through
+  the mesh, ``_StaleRace``/stale-slot discards stay strike-free).
+
 Flag off, the pre-deadline fixed-window loop serves byte-identically.
 In BOTH modes a killed/crashed serve loop fails its in-flight waiters
 over to the CPU path immediately (and re-arms on supervised restart)
@@ -300,6 +329,8 @@ class MatchService:
         backend: str = "hash",
         autotune: bool = True,
         autotune_reps: int = 3,
+        multichip: bool = False,
+        multichip_tp: int = 0,
         hists: Any = None,
         flightrec: Any = None,
     ) -> None:
@@ -425,6 +456,21 @@ class MatchService:
             # auto-routed join dispatch on a fresh shape eats a
             # CompileMiss → CPU hop (ISSUE 13 bugfix)
             self.kcache.auto_backends = ("hash", "join")
+        # multichip serve backend (module docstring; opt-in, flag off
+        # leaves self.mc None and every seam below one None-test so the
+        # single-chip path is byte-identical — spy-asserted)
+        self.mc = None
+        if multichip:
+            try:
+                from ..parallel.multichip_serve import MultichipMatcher
+
+                self.mc = MultichipMatcher(
+                    depth=depth, tp=multichip_tp,
+                    active_slots=active_slots, max_matches=max_matches,
+                    metrics=metrics, kernel_cache=self.kcache)
+            except Exception:
+                log.exception("multichip serve backend unavailable; "
+                              "single-chip path serves")
         self._ref: Dict[str, int] = {}     # wildcard filter -> route count
         self._deep: Dict[str, int] = {}    # too-deep filter -> alias aid
         self._deep_trie = FilterTrie()     # host match for too-deep filters
@@ -510,6 +556,16 @@ class MatchService:
     async def start(self) -> None:
         self._running = True
         self._bootstrap()
+        if self.mc is not None:
+            # seed the shard partition: per-shard segments when the
+            # main table cold-started from ITS segment and the epochs
+            # still agree, else a full repartition from the live aid
+            # space (note_add events during bootstrap are superseded —
+            # rebuild clears the pending log)
+            if not (self.segments and self._segment_loaded
+                    and self.mc.load_segments(self.segments_dir,
+                                              self.inc.epoch)):
+                self.mc.rebuild(self._mc_pairs())
         serve_loop = self._deadline_loop if self.deadline \
             else self._batch_loop
         if self.pipeline:
@@ -602,6 +658,10 @@ class MatchService:
         try:
             self.inc.add(flt)
             aid = self.inc.aid_of(flt)
+            if self.mc is not None:
+                # mirror the mutation into the shard partition (deep
+                # aliases stay host-only — the deep trie serves them)
+                self.mc.note_add(flt, aid)
         except ValueError:
             if flt in self._deep:
                 aid = self._deep[flt]
@@ -629,6 +689,8 @@ class MatchService:
             self.inc.free_alias(aid)
         else:
             self.inc.remove(flt)
+            if self.mc is not None:
+                self.mc.note_del(flt)
         self._note_mutation(flt)
 
     def _note_mutation(self, flt: str) -> None:
@@ -835,6 +897,10 @@ class MatchService:
                 await asyncio.to_thread(self.dev.apply_pending, pending)
                 if first or pending.full is not None:
                     await asyncio.to_thread(self._warm)
+                if self.mc is not None and self.mc.dirty:
+                    # shard partition applies in lockstep with the
+                    # device twin so both reflect _synced_epoch below
+                    await asyncio.to_thread(self._mc_apply)
                 self.ready = True
                 self._synced_epoch = router_epoch
                 self._synced_rule_gen = rule_gen
@@ -898,6 +964,53 @@ class MatchService:
                                    donate_inputs=donate, backend=be)
 
     # ------------------------------------------------------------------
+    # multichip serve backend (opt-in, match.multichip.enable)
+    # ------------------------------------------------------------------
+
+    def _mc_pairs(self) -> List[Tuple[str, int]]:
+        """(filter, service aid) for every NFA-resident filter (routing
+        + rules; deep aliases excluded — the host trie serves them):
+        the full repartition input for cold start / compaction swap."""
+        ruled = {f for refs in self._rule_refs.values() for f in refs}
+        out: List[Tuple[str, int]] = []
+        for flt in set(self._ref) | ruled:
+            if flt in self._deep:
+                continue
+            aid = self.inc.aid_of(flt)
+            if aid >= 0:
+                out.append((flt, aid))
+        return out
+
+    def _mc_apply(self) -> None:
+        """WORKER-THREAD step: fold the noted mutations (or a queued
+        repartition) into the shard subtables + stacked device arrays.
+        Any failure leaves the single-chip path serving — the partition
+        re-applies on the next sync pass."""
+        mc = self.mc
+        try:
+            first = not mc.ready
+            if mc.apply_pending() and first:
+                # pre-pay the mesh step compiles for the serve shapes
+                # (the _warm twin); covers the short lane when split
+                depths = ((self.short_depth, self.depth)
+                          if self.short_depth
+                          and self.short_depth < self.depth
+                          else (self.depth,))
+                mc.warm(batches=(64,), depths=depths)
+            if self.segments and mc._persist_due:
+                mc.save_segments(self.segments_dir, self.inc.epoch)
+        except Exception:
+            log.exception("multichip apply failed; single-chip path "
+                          "serves")
+
+    def _mc_active(self):
+        """The multichip matcher when it may serve the next dispatch,
+        else None (single-chip device path).  One attribute test on the
+        flag-off path."""
+        mc = self.mc
+        return mc if mc is not None and mc.ready else None
+
+    # ------------------------------------------------------------------
     # kernel backend routing (opt-in, match.backend)
     # ------------------------------------------------------------------
 
@@ -913,10 +1026,13 @@ class MatchService:
         if t is None:
             return "hash"
         s, hb, _depth = self.inc.shape_key()
-        sig = t.sig(b, d, s, hb)
-        pick = t.pick(sig)
+        # exact pick, else the pow2 (S, Hb)-family consensus: a growth
+        # step inherits the family's measured answer instead of
+        # re-measuring cold (ROADMAP join residual (d))
+        pick = t.pick_for(b, d, s, hb)
         if pick is not None:
             return pick
+        sig = t.sig(b, d, s, hb)
         if sig not in self._tuning and self._topic_sample:
             self._tuning.add(sig)
             # non-daemon, like the kernel cache's background compile: a
@@ -1074,6 +1190,12 @@ class MatchService:
         self._mut_count = len(self._compact_dirty)
         self._compact_dirty = set()
         self.ready = True
+        if self.mc is not None:
+            # the fresh table reassigned EVERY aid: repartition the
+            # shard subtables from the new space; mc.ready drops and
+            # the single-chip path serves until the rebuild applies
+            self.mc.rebuild(self._mc_pairs())
+            self._dirty.set()
         if self.metrics is not None:
             self.metrics.inc("tpu.table.compact_runs")
         log.info("compacted table swapped in (gen %d, %d filters)",
@@ -1507,23 +1629,37 @@ class MatchService:
         handles = []
         enc_ns = disp_ns = 0
         gen = self._table_gen
+        multichip = getattr(dev, "is_multichip", False)
         # autotune reservoir: a slice of what this dispatch actually
         # serves (deque append is GIL-atomic; readers tolerate skew)
         self._topic_sample.extend(topics[:8])
         for idx, d in groups:
-            be = self._backend_for(_bucket(len(idx)), d)
+            be = "hash" if multichip else \
+                self._backend_for(_bucket(len(idx)), d)
             t0 = time.perf_counter_ns()
-            enc = encode_batch(inc, [topics[i] for i in idx],
-                               batch=_bucket(len(idx)), depth=d)
-            t1 = time.perf_counter_ns()
-            res = dev.match(
-                *enc, flat_cap=self.FLAT_MULT * enc[0].shape[0],
-                # serving never parks behind XLA: an uncompiled shape
-                # raises CompileMiss (CPU trie answers, shape warms in
-                # the background) instead of stalling the batch
-                block_compile=(dev.kernel_cache is None),
-                donate_inputs=donate, backend=be)
-            t2 = time.perf_counter_ns()
+            if multichip:
+                # the shard partition's SHARED vocab assigns different
+                # word ids than the service table — encode there, then
+                # fan the batch over the mesh (rows come back already
+                # translated to service accept ids)
+                enc = dev.encode([topics[i] for i in idx],
+                                 batch=_bucket(len(idx)), depth=d)
+                t1 = time.perf_counter_ns()
+                res = dev.dispatch(
+                    enc, block_compile=(dev.kernel_cache is None))
+                t2 = time.perf_counter_ns()
+            else:
+                enc = encode_batch(inc, [topics[i] for i in idx],
+                                   batch=_bucket(len(idx)), depth=d)
+                t1 = time.perf_counter_ns()
+                res = dev.match(
+                    *enc, flat_cap=self.FLAT_MULT * enc[0].shape[0],
+                    # serving never parks behind XLA: an uncompiled
+                    # shape raises CompileMiss (CPU trie answers, shape
+                    # warms in the background) instead of stalling
+                    block_compile=(dev.kernel_cache is None),
+                    donate_inputs=donate, backend=be)
+                t2 = time.perf_counter_ns()
             if be == "join" and self.metrics is not None:
                 # this worker is the single in-flight encode stage, so
                 # the counter has one writer (same as the histograms)
@@ -1554,8 +1690,13 @@ class MatchService:
         nbytes = 0
         t0 = time.perf_counter_ns()
         total = 0
+        multichip = getattr(dev, "is_multichip", False)
         for res, n in handles:
-            if proportional:
+            if multichip:
+                # dense compact contract off the mesh: d2h is already
+                # matches-proportional in BOTH serve modes
+                rows, sp, b = dev.readback(res, n)
+            elif proportional:
                 rows, sp, b = self._readback_rows_twophase(
                     res, n, dev.max_matches)
             else:
@@ -1715,7 +1856,7 @@ class MatchService:
         # The table-gen guard is the segment-swap twin: a compacted
         # table swapped in mid-flight reassigned EVERY aid.
         inc = self.inc
-        dev = self.dev
+        dev = self._mc_active() or self.dev
         reuses0 = inc.aid_reuses
         gen0 = self._table_gen
         groups = self._depth_groups(topics)
@@ -2061,7 +2202,7 @@ class MatchService:
         epoch = self._synced_epoch
         rule_gen = self._synced_rule_gen
         inc = self.inc
-        dev = self.dev
+        dev = self._mc_active() or self.dev
         reuses0 = inc.aid_reuses
         gen0 = self._table_gen
         t0 = time.monotonic()
@@ -2291,9 +2432,17 @@ class MatchService:
     def _probe_dispatch(self) -> None:
         """One tiny dispatch through the warmed kernel shape — proves
         encode → device → readback end to end without touching the
-        serving counters."""
+        serving counters.  With the multichip backend active the probe
+        rides the mesh, so a dead shard keeps the breaker open until
+        the shard recovers."""
         from ..ops import encode_batch
 
+        mc = self._mc_active()
+        if mc is not None:
+            enc = mc.encode(["probe/health"], batch=64)
+            res = mc.dispatch(enc)
+            mc.readback(res, 1)
+            return
         enc = encode_batch(self.inc, ["probe/health"], batch=64)
         res = self.dev.match(*enc, flat_cap=self.FLAT_MULT * 64)
         self._readback_rows(res, 1, self.dev.max_matches)
@@ -2330,6 +2479,9 @@ class MatchService:
             "join_rebuilds": self.dev.join_rebuilds,
             "autotune": (self.tuner.info()
                          if self.tuner is not None else None),
+            # multichip serve backend (ISSUE 15)
+            "multichip": (self.mc.info() if self.mc is not None
+                          else None),
             "segments": ({
                 "dir": self.segments_dir,
                 "loaded": self._segment_loaded,

@@ -460,6 +460,15 @@ SCHEMA: Dict[str, Field] = {
     "match.autotune.enable": Field(True, _bool),
     # timing repetitions per backend per shape (min is taken)
     "match.autotune.reps": Field(3, int, lambda v: 1 <= v <= 64),
+    # multichip serve backend (parallel/multichip_serve.py): shard the
+    # match table by topic-prefix over the dp×tp device mesh and serve
+    # publish traffic from EVERY chip (8 chips hold 8x the filters;
+    # bitmapless dense compact results ride the ring).  Off = the
+    # single-chip serve path, byte-identical.
+    "match.multichip.enable": Field(False, _bool),
+    # tp (table-shard) axis width; 0 = auto — the widest pow2 <= 4 that
+    # divides the device count; the remaining factor becomes dp
+    "match.multichip.tp": Field(0, int, lambda v: v >= 0),
 
     # -- streaming table lifecycle (broker/match_service.py) --------------
     # opt-in: cold start from persistent compacted segments + background

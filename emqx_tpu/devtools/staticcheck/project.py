@@ -149,6 +149,19 @@ AFFINITY_SEEDS: Dict[str, Tuple[str, bool]] = {
     # the match.batch / match.readback children.
     "MatchService._encode_dispatch": ("thread", False),
     "MatchService._readback_groups": ("thread", False),
+    # multichip mesh worker surfaces (ISSUE 15): the sync loop's
+    # partition apply (MatchService._mc_apply via to_thread) and the
+    # matcher methods it reaches.  The contract mirrors the pipeline
+    # workers: MultichipMatcher owns its OWN state under its lock
+    # (single writer = the sync worker; dispatch snapshots under the
+    # same lock), and NOTHING in these workers may touch Broker /
+    # MatchService state — MatchService is MAIN_ONLY, so a write from
+    # here trips shard-affinity (fixture pair
+    # trip/ok_affinity_mesh.py).
+    "MatchService._mc_apply": ("thread", False),
+    "MultichipMatcher.apply_pending": ("thread", False),
+    "MultichipMatcher.dispatch": ("thread", False),
+    "MultichipMatcher.readback": ("thread", False),
     # main-loop surfaces of the same file (the marshal consumers)
     "ShardPool._consume": ("main", False),
     "ShardPool._publish_batch": ("main", False),

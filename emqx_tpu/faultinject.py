@@ -36,6 +36,11 @@ Named injection points (the seams the batched stack crosses):
                      ``match.readback`` child (raise / delay / hang; a
                      hang on the pipelined path is rescued by the
                      per-dispatch timeout)
+``match.shard``      multichip mesh dispatch gate (raise / delay; a
+                     raise is a shard failure — the batch fails over
+                     to the CPU trie like any device failure, breaker
+                     accounting applies, the mesh probe must answer
+                     before the breaker closes)
 ``table.load``       MatchService segment cold-start load (raise ⇒
                      treated like a corrupt segment: checksum-reject
                      path, full rebuild serves)
@@ -101,7 +106,7 @@ __all__ = [
 
 POINTS = (
     "transport.write", "frame.parse", "match.dispatch", "match.compile",
-    "match.readback", "table.load", "table.swap",
+    "match.readback", "match.shard", "table.load", "table.swap",
     "inflight.insert", "inflight.retry", "cluster.rpc",
     "bridge.sink", "exhook.call", "fanout.drain", "shard.handoff",
     "admission.score",

@@ -1078,6 +1078,8 @@ class BrokerNode:
                 backend=cfg.get("match.backend"),
                 autotune=cfg.get("match.autotune.enable"),
                 autotune_reps=cfg.get("match.autotune.reps"),
+                multichip=cfg.get("match.multichip.enable"),
+                multichip_tp=cfg.get("match.multichip.tp"),
                 hists=self.hists,
                 flightrec=self.flightrec,
             )

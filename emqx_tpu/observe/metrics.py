@@ -19,7 +19,8 @@ from typing import Dict, Iterable, List, Optional
 __all__ = [
     "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
     "ROBUSTNESS_METRIC_NAMES", "CONNPLANE_METRIC_NAMES",
-    "MATCH_SERVE_METRIC_NAMES", "TABLE_METRIC_NAMES",
+    "MATCH_SERVE_METRIC_NAMES", "MULTICHIP_METRIC_NAMES",
+    "TABLE_METRIC_NAMES",
     "OBS_METRIC_NAMES", "ADMISSION_METRIC_NAMES",
 ]
 
@@ -162,6 +163,19 @@ MATCH_SERVE_METRIC_NAMES: List[str] = [
     "tpu.match.backend_join_dispatches", "tpu.match.autotune_picks",
 ]
 
+# -- multichip serve backend (parallel/multichip_serve.py, opt-in via
+# match.multichip.enable).  shard_devices is the mesh size dp*tp (set
+# at construction); shard_dispatches counts publish batches served
+# from the sharded table (inc, one per depth group); shard_failover
+# counts dispatches refused at the match.shard seam — dead or
+# fault-injected shard, the batch fell over to the CPU trie (inc);
+# shard_restacks is the accumulated full re-upload count of the
+# stacked per-shard tables (set).
+MULTICHIP_METRIC_NAMES: List[str] = [
+    "tpu.match.shard_devices", "tpu.match.shard_dispatches",
+    "tpu.match.shard_failover", "tpu.match.shard_restacks",
+]
+
 # -- streaming table lifecycle (broker/match_service.py, opt-in via
 # match.segments.enable).  segment_load_s is the last cold-start
 # segment load+reconcile time in seconds (set); compact_runs counts
@@ -217,6 +231,7 @@ class Metrics:
         self._c.update({n: 0 for n in ROBUSTNESS_METRIC_NAMES})
         self._c.update({n: 0 for n in CONNPLANE_METRIC_NAMES})
         self._c.update({n: 0 for n in MATCH_SERVE_METRIC_NAMES})
+        self._c.update({n: 0 for n in MULTICHIP_METRIC_NAMES})
         self._c.update({n: 0 for n in TABLE_METRIC_NAMES})
         self._c.update({n: 0 for n in OBS_METRIC_NAMES})
         self._c.update({n: 0 for n in ADMISSION_METRIC_NAMES})

@@ -393,6 +393,7 @@ class BackendAutotuner:
         self.picks: Dict[str, str] = {}
         self.measured: Dict[str, Dict[str, float]] = {}
         self.rejected = False
+        self.family_hits = 0
         self._lock = threading.Lock()
         if path:
             self._load()
@@ -401,8 +402,34 @@ class BackendAutotuner:
     def sig(b: int, d: int, s: int, hb: int) -> str:
         return f"b{b}:d{d}:s{s}:h{hb}"
 
+    @staticmethod
+    def family(sig: str) -> str:
+        """The pow2 (S, Hb) family a sig belongs to: the (batch,
+        depth) prefix — table shapes are padded pow2s, so every
+        growth step lands in the same family."""
+        return sig.split(":s", 1)[0]
+
     def pick(self, sig: str) -> Optional[str]:
         return self.picks.get(sig)
+
+    def pick_for(self, b: int, d: int, s: int, hb: int) -> Optional[str]:
+        """The serving pick for a shape: the exact measured sig, else
+        the (B, D)-family CONSENSUS across pow2 (S, Hb) shapes — the
+        pick rarely flips within a family (ROADMAP join residual (d)),
+        so a growth step inherits the family's answer instead of
+        re-measuring cold.  A split family (measured shapes disagree)
+        returns None and the exact shape measures as before."""
+        sig = self.sig(b, d, s, hb)
+        p = self.picks.get(sig)
+        if p is not None:
+            return p
+        fam = self.family(sig)
+        seen = {v for k, v in self.picks.items()
+                if self.family(k) == fam}
+        if len(seen) == 1:
+            self.family_hits += 1
+            return next(iter(seen))
+        return None
 
     # -- measurement -------------------------------------------------------
 
@@ -486,6 +513,7 @@ class BackendAutotuner:
         return {
             "picks": dict(self.picks),
             "measured_shapes": len(self.measured),
+            "family_hits": self.family_hits,
             "rejected_file": self.rejected,
             "path": self.path,
         }

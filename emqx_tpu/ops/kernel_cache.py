@@ -37,12 +37,17 @@ log = logging.getLogger(__name__)
 __all__ = ["MatchKernelCache", "CompileMiss"]
 
 #: (B, D, S, Hb, active_slots, max_matches, compact, flat_cap, donate,
-#: backend).  ``backend`` selects the kernel family: "hash" is the
-#: cuckoo-probe nfa_match, "join" the sorted-relation kernel
+#: backend, mesh).  ``backend`` selects the kernel family: "hash" is
+#: the cuckoo-probe nfa_match, "join" the sorted-relation kernel
 #: (ops/join_match.py) whose edge-structure shapes DERIVE from the same
 #: (S, Hb) pair (relation capacity = Hb * BUCKET_SLOTS), so one shape
-#: key covers both families.
-Key = Tuple[int, int, int, int, int, int, bool, int, bool, str]
+#: key covers both families.  ``mesh`` is None for single-device keys;
+#: the multichip serve backend (parallel/multichip_serve.py) keys its
+#: shard_map executables with ``(dp, tp, acap)`` and installs a
+#: ``mesh_lower`` hook the cache delegates those keys to — the same
+#: prewarm/CompileMiss contract then covers the mesh step.
+Key = Tuple[int, int, int, int, int, int, bool, int, bool, str,
+            Optional[Tuple[int, int, int]]]
 
 
 class CompileMiss(RuntimeError):
@@ -59,11 +64,15 @@ class MatchKernelCache:
         self._inflight: Set[Key] = set()
         self._lock = threading.Lock()
         self._done = threading.Condition(self._lock)
-        # every (B, D, A, K, compact, flat_cap, donate, backend) combo
-        # ever requested: what prewarm_shape replays against the NEXT
-        # table shape
+        # every (B, D, A, K, compact, flat_cap, donate, backend, mesh)
+        # combo ever requested: what prewarm_shape replays against the
+        # NEXT table shape
         self._combos: Set[Tuple[int, int, int, int, bool, int,
-                                bool, str]] = set()
+                                bool, str,
+                                Optional[Tuple[int, int, int]]]] = set()
+        # mesh-key lowering hook, installed by the multichip matcher
+        # that owns the mesh (the cache itself stays mesh-agnostic)
+        self.mesh_lower: Any = None
         # backends prewarm_shape covers for EVERY combo regardless of
         # which backend the combo was first requested under: with
         # match.backend=auto the first requests route hash (the cold
@@ -81,15 +90,18 @@ class MatchKernelCache:
     def key(batch_shape: Tuple[int, int], s: int, hb: int, *,
             active_slots: int, max_matches: int,
             compact_output: bool, flat_cap: int,
-            donate: bool = False, backend: str = "hash") -> Key:
+            donate: bool = False, backend: str = "hash",
+            mesh: Optional[Tuple[int, int, int]] = None) -> Key:
         b, d = batch_shape
         return (b, d, s, hb, active_slots, max_matches,
-                bool(compact_output), flat_cap, bool(donate), backend)
+                bool(compact_output), flat_cap, bool(donate), backend,
+                mesh)
 
     def executable(self, batch_shape: Tuple[int, int], s: int, hb: int, *,
                    active_slots: int, max_matches: int,
                    compact_output: bool, flat_cap: int,
                    donate: bool = False, backend: str = "hash",
+                   mesh: Optional[Tuple[int, int, int]] = None,
                    block: bool = True):
         """The compiled executable for these operand shapes — cached, or
         compiled NOW (blocking; counted, so a resize that was prewarmed
@@ -100,10 +112,10 @@ class MatchKernelCache:
         k = self.key(batch_shape, s, hb, active_slots=active_slots,
                      max_matches=max_matches,
                      compact_output=compact_output, flat_cap=flat_cap,
-                     donate=donate, backend=backend)
+                     donate=donate, backend=backend, mesh=mesh)
         with self._lock:
             self._combos.add((k[0], k[1], k[4], k[5], k[6], k[7], k[8],
-                              k[9]))
+                              k[9], k[10]))
             fn = self._compiled.get(k)
             if fn is not None:
                 self.hits += 1
@@ -140,26 +152,31 @@ class MatchKernelCache:
     def warmed(self, batch_shape: Tuple[int, int], s: int, hb: int, *,
                active_slots: int, max_matches: int,
                compact_output: bool, flat_cap: int,
-               donate: bool = False, backend: str = "hash") -> bool:
+               donate: bool = False, backend: str = "hash",
+               mesh: Optional[Tuple[int, int, int]] = None) -> bool:
         k = self.key(batch_shape, s, hb, active_slots=active_slots,
                      max_matches=max_matches,
                      compact_output=compact_output, flat_cap=flat_cap,
-                     donate=donate, backend=backend)
+                     donate=donate, backend=backend, mesh=mesh)
         with self._lock:
             return k in self._compiled
 
     def _expanded_combos(self) -> list:
         """Observed combos crossed with ``auto_backends``: under
         per-shape routing every covered shape must hold BOTH kernel
-        families, or the autotuner's first re-route eats a miss."""
+        families, or the autotuner's first re-route eats a miss.
+        Mesh combos stay on their own backend — the shard_map step has
+        no join twin."""
         with self._lock:
             combos = list(self._combos)
             extra = tuple(self.auto_backends)
         out = []
         seen = set()
         for combo in combos:
-            for be in (combo[7],) + extra:
-                c = combo[:7] + (be,)
+            backends = (combo[7],) if combo[8] is not None \
+                else (combo[7],) + extra
+            for be in backends:
+                c = combo[:7] + (be,) + combo[8:]
                 if c not in seen:
                     seen.add(c)
                     out.append(c)
@@ -171,8 +188,8 @@ class MatchKernelCache:
         combos = self._expanded_combos()
         with self._lock:
             return bool(combos) and all(
-                (b, d, s, hb, a, m, c, f, dn, be) in self._compiled
-                for (b, d, a, m, c, f, dn, be) in combos
+                (b, d, s, hb, a, m, c, f, dn, be, mesh) in self._compiled
+                for (b, d, a, m, c, f, dn, be, mesh) in combos
             )
 
     def prewarm_shape(self, s: int, hb: int) -> int:
@@ -181,8 +198,8 @@ class MatchKernelCache:
         resize free — for every backend ``auto`` may route to.
         Returns the number of fresh compiles."""
         n = 0
-        for (b, d, a, m, c, f, dn, be) in self._expanded_combos():
-            k = (b, d, s, hb, a, m, c, f, dn, be)
+        for (b, d, a, m, c, f, dn, be, mesh) in self._expanded_combos():
+            k = (b, d, s, hb, a, m, c, f, dn, be, mesh)
             with self._lock:
                 if k in self._compiled:
                     continue
@@ -211,15 +228,20 @@ class MatchKernelCache:
                 self._inflight.discard(k)
                 self._done.notify_all()
 
-    @staticmethod
-    def _lower(k: Key):
+    def _lower(self, k: Key):
         import jax
         import jax.numpy as jnp
 
         from .compiler import BUCKET_SLOTS
         from .match_kernel import nfa_match, nfa_match_donated
 
-        b, d, s, hb, a, m, compact, flat_cap, donate, backend = k
+        b, d, s, hb, a, m, compact, flat_cap, donate, backend, mesh = k
+        if mesh is not None:
+            if self.mesh_lower is None:
+                raise RuntimeError(
+                    "mesh-keyed compile requested but no mesh_lower "
+                    "hook is installed")
+            return self.mesh_lower(k)
         i32 = jnp.int32
         sd = jax.ShapeDtypeStruct
         batch = (

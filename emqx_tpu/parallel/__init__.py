@@ -1,6 +1,13 @@
 """Multi-chip parallelism: mesh construction and sharded match/fan-out."""
 
 from .mesh import make_mesh, pick_shape
+from .multichip_serve import (
+    MultichipMatcher,
+    ShardDead,
+    build_multichip_step,
+    serve_mesh_shape,
+    shard_of_filter,
+)
 from .multihost import MultihostRuntime, dcn_env, hybrid_mesh_from
 from .prefix_ep import EpTables, build_ep_matcher, build_partitions, owner_of
 from .ring_fanout import (
@@ -28,6 +35,11 @@ from .ulysses import (
 __all__ = [
     "make_mesh",
     "pick_shape",
+    "MultichipMatcher",
+    "ShardDead",
+    "build_multichip_step",
+    "serve_mesh_shape",
+    "shard_of_filter",
     "MultihostRuntime",
     "dcn_env",
     "hybrid_mesh_from",

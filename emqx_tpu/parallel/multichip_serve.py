@@ -1,0 +1,673 @@
+"""Multichip serve backend: the match TABLE sharded by topic-prefix
+over the mesh, serving real publish traffic (ISSUE 15).
+
+Every 8-device configuration in MULTICHIP_r05 passed dry runs with
+parity checks, but the serving path was capped at one chip's table.
+This module is the on-device analog of the reference's cluster routing
+(PAPER.md: ekka/mria replicated route tables): instead of replicating
+the NFA everywhere and sharding the *subscriber bitmap*
+(:func:`~emqx_tpu.parallel.sharded_match.build_sharded_matcher_compact`),
+the **table itself shards** — each ``tp`` shard owns the filters whose
+root token hashes to it, so 8 chips hold 8× the filters:
+
+* ``dp`` — publish-batch rows (each chip matches its slice, zero comms);
+* ``tp`` — table shards; the batch is **fanned** (replicated) over this
+  axis and every shard walks its OWN subtable;
+* per-shard matches map through a local→service accept-id table and
+  leave the mesh as the **dense compact contract**
+  (:class:`~emqx_tpu.parallel.sharded_match.CompactFanoutResult`):
+  per-row id segments in disjoint per-shard order, concat-no-dedup,
+  decoded by the same :func:`decode_compact_rows` the bitmap
+  compaction path uses — what crosses the wire is proportional to
+  MATCHES, never to table width, so the ring/ICI traffic is dense end
+  to end (ROADMAP dispatch-tax residual (d));
+* per-row truncation/active-set spills are ``psum``'d over ``tp``
+  (the fail-open set — the host re-runs exactly those rows on the CPU
+  trie, the single-chip spill contract unchanged).
+
+Maintenance rides the existing drain/apply cycle: the service's
+``_table_add``/``_table_del`` seams note filter mutations here, the
+sync loop applies them off the event loop (per-shard host subtables →
+``flush()`` deltas → scatters into the stacked device arrays, full
+restack only on a resize — the DeviceNfa discipline), and a compaction
+swap rebuilds the whole partition from the fresh aid space.
+
+Failure semantics: a dead (``kill_shard``) or fault-injected
+(``match.shard`` point) shard raises at dispatch — the affected batch
+fails over to the CPU trie through the serve plane's existing
+device-failure paths (breaker strike in deadline mode, probe recovery,
+stale-slot discards stay strike-free), exactly like any other device
+failure.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import zlib
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import faultinject as _fi
+from ._shard_compat import shard_map
+from .sharded_match import CompactFanoutResult, decode_compact_rows
+
+log = logging.getLogger(__name__)
+
+__all__ = ["MultichipMatcher", "ShardDead", "build_multichip_step",
+           "serve_mesh_shape", "shard_of_filter"]
+
+
+class ShardDead(RuntimeError):
+    """A mesh shard is down: the dispatch cannot produce a trustworthy
+    answer for ANY row (every shard owns part of the table).  Treated
+    by the serve plane as a device failure — CPU trie serves the
+    batch, breaker accounting applies."""
+
+
+def serve_mesh_shape(n_devices: int, tp: int = 0) -> Dict[str, int]:
+    """Mesh factorization for the serve backend: ``tp`` table shards
+    (0 = the widest pow2 ≤ 4 that divides the device count — the
+    :func:`~emqx_tpu.parallel.mesh.pick_shape` default), rest ``dp``
+    batch rows."""
+    from .mesh import pick_shape
+
+    return pick_shape(n_devices, tp if tp > 0 else None)
+
+
+def shard_of_filter(flt: str, tp: int) -> int:
+    """Topic-prefix partition: a filter lives on the shard its ROOT
+    token hashes to.  Wildcard roots (``+``/``#``) hash their literal
+    token — ownership is arbitrary for them (every topic visits every
+    shard), it only has to be deterministic."""
+    root = flt.split("/", 1)[0]
+    return zlib.crc32(root.encode("utf-8")) % tp
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_stacked(tab, tvec, idx, rows):
+    """stacked[t, idx] = rows, in place (donated) — the per-shard
+    delta scatter into the (tp, ...) stacked table.  Callers hold the
+    matcher lock across the scatter AND every dispatch-side read of
+    ``_arrs``, so a donated-away buffer is never re-dispatched."""
+    return tab.at[tvec, idx].set(rows, mode="drop", unique_indices=False)
+
+
+def build_multichip_step(mesh, active_slots: int = 16,
+                         max_matches: int = 32):
+    """Return a jitted ``step(words, lens, is_sys, node_stk, edge_stk,
+    seeds_stk, aid_stk) -> CompactFanoutResult``.
+
+    Input layouts: batch arrays sharded over ``dp`` (replicated —
+    *fanned* — over ``tp``); the stacked per-shard tables
+    ``node_stk (tp, S, 4)``, ``edge_stk (tp, Hb, slots·4)``,
+    ``seeds_stk (tp, 2)`` and the local→service accept-id map
+    ``aid_stk (tp, A)`` sharded over ``tp``.  Output ``ids`` is the
+    dense compact contract: (B, tp·K) service accept ids, -1 padded,
+    per-shard segments disjoint by partition construction; ``counts``
+    (B, tp); ``overflow`` (B, tp) per-segment truncation; the spill
+    vectors psum over ``tp``."""
+    from ..ops.match_kernel import nfa_match
+
+    K = max_matches
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P("dp", None),        # words
+            P("dp"),              # lens
+            P("dp"),              # is_sys
+            P("tp", None, None),  # node_stk
+            P("tp", None, None),  # edge_stk
+            P("tp", None),        # seeds_stk
+            P("tp", None),        # aid_stk
+        ),
+        out_specs=CompactFanoutResult(
+            ids=P("dp", "tp"),
+            counts=P("dp", "tp"),
+            overflow=P("dp", "tp"),
+            n_matches=P("dp"),
+            active_overflow=P("dp"),
+            match_overflow=P("dp"),
+        ),
+        check_vma=False,
+    )
+    def step(words, lens, is_sys, node_stk, edge_stk, seeds_stk, aid_stk):
+        node, edge, seeds, amap = (
+            node_stk[0], edge_stk[0], seeds_stk[0], aid_stk[0])
+        res = nfa_match(
+            words, lens, is_sys, node, edge, seeds,
+            active_slots=active_slots, max_matches=K,
+        )
+        m = res.matches                                  # (Bl, K) local
+        gids = jnp.where(m >= 0, amap[jnp.maximum(m, 0)], -1)
+        return CompactFanoutResult(
+            ids=gids,
+            counts=jnp.minimum(res.n_matches, K)[:, None],
+            overflow=res.match_overflow[:, None],
+            n_matches=jax.lax.psum(res.n_matches, "tp"),
+            active_overflow=jax.lax.psum(res.active_overflow, "tp"),
+            match_overflow=jax.lax.psum(res.match_overflow, "tp"),
+        )
+
+    return jax.jit(step)
+
+
+class MultichipMatcher:
+    """Host side of the multichip serve backend: per-shard subtables
+    (shared vocab, one encode serves every shard), the stacked device
+    twin, and the mesh-compiled step cache.
+
+    Threading model (the MatchService discipline): ``note_add``/
+    ``note_del``/``rebuild`` run on the event loop and only append to a
+    pending op list; ``apply_pending`` runs in the sync loop's worker
+    thread and is the single writer of the subtables + stacked arrays;
+    ``dispatch`` runs in the serve plane's encode worker thread and
+    captures one consistent (arrays, aid map) snapshot under the lock.
+    """
+
+    MANIFEST_VERSION = 1
+    #: serve-plane dispatch routing marker (MatchService checks this
+    #: instead of importing the class on its hot path)
+    is_multichip = True
+
+    def __init__(
+        self,
+        depth: int = 8,
+        tp: int = 0,
+        devices: Optional[Sequence[Any]] = None,
+        active_slots: int = 16,
+        max_matches: int = 32,
+        metrics: Any = None,
+        kernel_cache: Any = None,
+    ) -> None:
+        from .mesh import make_mesh
+
+        devs = list(devices if devices is not None else jax.devices())
+        shape = serve_mesh_shape(len(devs), tp)
+        self.mesh = make_mesh(shape, devs)
+        self.dp = shape["dp"]
+        self.tp = shape["tp"]
+        self.n_devices = self.dp * self.tp
+        self.depth = depth
+        self.active_slots = active_slots
+        self.max_matches = max_matches
+        self.metrics = metrics
+        self.kernel_cache = kernel_cache
+        if kernel_cache is not None:
+            # mesh-keyed executables compile through the shared cache
+            # (CompileMiss semantics, zero-compile prewarm spies)
+            kernel_cache.mesh_lower = self._lower_step
+
+        self.vocab: Dict[str, int] = {}
+        self._subs: List[Any] = []
+        self._aid_maps: List[np.ndarray] = []
+        self._reset_subs()
+
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[str, str, int]] = []  # (op, flt, aid)
+        self._rebuild_pairs: Optional[List[Tuple[str, int]]] = None
+        self._restack_due = False      # segment restore awaiting upload
+        self._arrs: Optional[Tuple[Any, Any, Any, Any]] = None
+        self._stacked_shape: Optional[Tuple[int, int, int]] = None
+        self._steps: Dict[Tuple[int, int], Any] = {}
+        self._dead: set = set()
+        self.gen = 0                    # bumped on every restack
+        self.dispatches = 0
+        self.failovers = 0
+        self.applies = 0
+        self.restacks = 0
+        self.seeded_from_segments = False
+        self._persist_due = False
+        if metrics is not None:
+            metrics.set("tpu.match.shard_devices", self.n_devices)
+
+    # ------------------------------------------------------------------
+    # partition maintenance (event loop: enqueue; worker thread: apply)
+    # ------------------------------------------------------------------
+
+    def _reset_subs(self) -> None:
+        from ..ops.incremental import IncrementalNfa
+
+        self.vocab = {}
+        self._subs = []
+        self._aid_maps = []
+        for _ in range(self.tp):
+            sub = IncrementalNfa(depth=self.depth)
+            # one vocab dict shared by every subtable: a single encode
+            # pass serves all shards (interning appends consistently)
+            sub.vocab = self.vocab
+            self._subs.append(sub)
+            self._aid_maps.append(np.full(64, -1, np.int32))
+
+    def note_add(self, flt: str, service_aid: int) -> None:
+        with self._lock:
+            self._pending.append(("add", flt, service_aid))
+
+    def note_del(self, flt: str) -> None:
+        with self._lock:
+            self._pending.append(("del", flt, -1))
+
+    def rebuild(self, pairs: List[Tuple[str, int]]) -> None:
+        """Full repartition (cold start, compaction swap — the service
+        aid space was reassigned wholesale).  Cheap on the loop: the
+        build itself happens at the next ``apply_pending``; until then
+        ``ready`` is False and the single-chip path serves."""
+        with self._lock:
+            self._rebuild_pairs = list(pairs)
+            self._pending = []
+            self._restack_due = False
+            self._arrs = None
+            self._steps = {}
+
+    @property
+    def ready(self) -> bool:
+        return self._arrs is not None
+
+    @property
+    def dirty(self) -> bool:
+        return (bool(self._pending) or self._rebuild_pairs is not None
+                or self._restack_due)
+
+    def _host_add(self, flt: str, service_aid: int) -> None:
+        t = shard_of_filter(flt, self.tp)
+        sub = self._subs[t]
+        sub.add(flt)
+        laid = sub.aid_of(flt)
+        amap = self._aid_maps[t]
+        if laid >= len(amap):
+            grown = np.full(max(2 * len(amap), laid + 1), -1, np.int32)
+            grown[:len(amap)] = amap
+            amap = self._aid_maps[t] = grown
+        amap[laid] = service_aid
+
+    def _host_del(self, flt: str) -> None:
+        t = shard_of_filter(flt, self.tp)
+        sub = self._subs[t]
+        laid = sub.aid_of(flt)
+        if laid < 0:
+            return
+        self._aid_maps[t][laid] = -1
+        sub.remove(flt)
+
+    def apply_pending(self) -> bool:
+        """WORKER-THREAD step (the sync loop's ``to_thread`` hop):
+        drain the queued mutations into the per-shard subtables, then
+        ship the result — per-shard ``flush()`` deltas scatter into the
+        stacked arrays in place; any resize/repartition restacks (the
+        DeviceNfa full-upload analog).  Returns True when the device
+        state changed."""
+        with self._lock:
+            ops, self._pending = self._pending, []
+            rebuild, self._rebuild_pairs = self._rebuild_pairs, None
+            restack_due, self._restack_due = self._restack_due, False
+        if rebuild is not None:
+            self._reset_subs()
+            for flt, aid in rebuild:
+                self._host_add(flt, aid)
+            # notes enqueued AFTER the rebuild request (rebuild()
+            # clears the pending log, so every drained op postdates
+            # it) apply on top — dropping them would serve a partition
+            # missing live mutations
+            for op, flt, aid in ops:
+                if op == "add":
+                    self._host_add(flt, aid)
+                else:
+                    self._host_del(flt)
+            for sub in self._subs:
+                sub.flush()     # clear dirty sets; restack ships all
+            self._restack()
+            self._persist_due = True
+            return True
+        if not ops:
+            if self._arrs is None and restack_due:
+                # segment restore: the subtables are populated but the
+                # stacked device twin was never shipped
+                self._restack()
+                return True
+            return False
+        for op, flt, aid in ops:
+            if op == "add":
+                self._host_add(flt, aid)
+            else:
+                self._host_del(flt)
+        deltas = [sub.flush() for sub in self._subs]
+        shape = self._required_shape()
+        if (self._arrs is None or self._stacked_shape != shape
+                or any(d.resized for d in deltas)):
+            self._restack()
+            return True
+        from ..ops.device_table import _chunks
+
+        # the scatters DONATE the stacked buffers: the lock must span
+        # the whole read-modify-publish so a concurrent dispatch never
+        # captures a donated-away array
+        with self._lock:
+            node_stk, edge_stk, seeds_stk, _ = self._arrs
+            for t, d in enumerate(deltas):
+                if d.empty:
+                    continue
+                for idx, rows in _chunks(d.state_idx, d.state_rows):
+                    node_stk = _scatter_stacked(
+                        node_stk, jnp.full(idx.shape, t, jnp.int32),
+                        jnp.asarray(idx), jnp.asarray(rows))
+                for idx, rows in _chunks(d.bucket_idx, d.bucket_rows):
+                    edge_stk = _scatter_stacked(
+                        edge_stk, jnp.full(idx.shape, t, jnp.int32),
+                        jnp.asarray(idx), jnp.asarray(rows))
+            aid_stk = jnp.asarray(self._stacked_aid_maps(shape[2]))
+            self._arrs = (node_stk, edge_stk, seeds_stk, aid_stk)
+        self.applies += 1
+        return True
+
+    def _required_shape(self) -> Tuple[int, int, int]:
+        """Common stacked (S, Hb, A_cap): node tables pad (states index
+        directly — pad rows are unreachable), edge tables must SHARE a
+        real bucket count (lookups hash modulo Hb), aid maps pad."""
+        smax = max(sub.S for sub in self._subs)
+        hbmax = max(sub.Hb for sub in self._subs)
+        acap = 64
+        for amap in self._aid_maps:
+            while acap < len(amap):
+                acap *= 2
+        return smax, hbmax, acap
+
+    def _stacked_aid_maps(self, acap: int) -> np.ndarray:
+        out = np.full((self.tp, acap), -1, np.int32)
+        for t, amap in enumerate(self._aid_maps):
+            out[t, :len(amap)] = amap
+        return out
+
+    def _restack(self) -> None:
+        """Full re-upload of the stacked per-shard tables.  Smaller
+        shards grow their edge table to the common Hb (hash-correct —
+        a padded edge table would probe modulo the wrong size), node
+        tables pad with inert rows."""
+        hbmax = max(sub.Hb for sub in self._subs)
+        for sub in self._subs:
+            while sub.Hb < hbmax:
+                sub._grow_edges()
+            sub.flush()         # growth marked dirty; the restack ships all
+        shape = self._required_shape()
+        smax, hbmax, acap = shape
+        nodes = []
+        for sub in self._subs:
+            tab = np.full((smax, 4), -1, np.int32)
+            tab[:, 3] = 0
+            tab[:sub.S] = sub.node_tab
+            nodes.append(tab)
+        node_stk = jnp.asarray(np.stack(nodes))
+        edge_stk = jnp.asarray(np.stack(
+            [sub.edge_tab for sub in self._subs]))
+        seeds_stk = jnp.asarray(np.stack(
+            [sub.seeds for sub in self._subs]))
+        aid_stk = jnp.asarray(self._stacked_aid_maps(acap))
+        with self._lock:
+            self._arrs = (node_stk, edge_stk, seeds_stk, aid_stk)
+            self._stacked_shape = shape
+        self.gen += 1
+        self.applies += 1
+        self.restacks += 1
+        if self.metrics is not None:
+            self.metrics.set("tpu.match.shard_restacks", self.restacks)
+
+    # ------------------------------------------------------------------
+    # serving (encode worker thread)
+    # ------------------------------------------------------------------
+
+    def encode(self, topics: Sequence[str], batch: int,
+               depth: Optional[int] = None):
+        """Encode against the SHARED shard vocab (one pass serves every
+        shard) — the service's table vocab assigns different word ids,
+        so multichip-routed groups must encode here."""
+        from ..ops.encode import encode_batch
+
+        return encode_batch(self, topics, batch=batch, depth=depth)
+
+    def kill_shard(self, t: int) -> None:
+        """Chaos surface: mark shard ``t`` dead.  Every subsequent
+        dispatch raises :class:`ShardDead` until ``revive_shard`` —
+        the whole table is partition-resident, so no shard can answer
+        alone."""
+        self._dead.add(int(t))
+
+    def revive_shard(self, t: int) -> None:
+        self._dead.discard(int(t))
+
+    def _gate(self) -> None:
+        if self._dead:
+            self._note_failover()
+            raise ShardDead(f"mesh shard(s) {sorted(self._dead)} dead")
+        if _fi._injector is not None:
+            act = _fi._injector.act("match.shard")
+            if act == "raise":
+                self._note_failover()
+                raise _fi.InjectedFault("match.shard")
+            if act == "delay":
+                # sync seam (worker thread): a plain blocking sleep,
+                # the match.compile idiom
+                import time
+
+                time.sleep(_fi._injector.last_delay)
+
+    def _note_failover(self) -> None:
+        self.failovers += 1
+        if self.metrics is not None:
+            self.metrics.inc("tpu.match.shard_failover")
+
+    def dispatch(self, enc, *, block_compile: bool = True):
+        """One mesh dispatch of an already-encoded batch; returns the
+        lazy :class:`CompactFanoutResult` handle (readback blocks
+        later, outside any lock).  Raises :class:`ShardDead` /
+        :class:`~emqx_tpu.faultinject.InjectedFault` at the
+        ``match.shard`` seam, :class:`CompileMiss` on a cold mesh
+        shape when a kernel cache is attached."""
+        self._gate()
+        words, lens, is_sys = enc
+        step = self._step_for(
+            (int(words.shape[0]), int(words.shape[1])),
+            block_compile=block_compile)
+        with self._lock:
+            if self._arrs is None:
+                raise RuntimeError("multichip mirror not synced yet")
+            res = step(jnp.asarray(words), jnp.asarray(lens),
+                       jnp.asarray(is_sys), *self._arrs)
+        self.dispatches += 1
+        if self.metrics is not None:
+            self.metrics.inc("tpu.match.shard_dispatches")
+        return res
+
+    def readback(self, res, n: int):
+        """Block on the dense compact readback and decode to per-topic
+        SERVICE accept-id rows: per-shard segments concatenate (the
+        partition makes them disjoint — no dedup), rows flagged by the
+        psum'd spill vectors go back to the host tables.  Returns
+        ``(rows, spilled row indices, d2h bytes)``."""
+        ids, counts, nm, ao, mo = jax.device_get(
+            (res.ids, res.counts, res.n_matches,
+             res.active_overflow, res.match_overflow))
+        rows = decode_compact_rows(ids, counts, self.max_matches)[:n]
+        out = [[int(a) for a in row if a >= 0] for row in rows]
+        sp = (ao > 0) | (mo > 0)
+        nbytes = 4 * int(ids.size + counts.size + nm.size
+                         + ao.size + mo.size)
+        return out, np.flatnonzero(sp[:n]).tolist(), nbytes
+
+    def _step_for(self, batch_shape: Tuple[int, int], *,
+                  block_compile: bool = True):
+        kc = self.kernel_cache
+        if kc is not None and self._stacked_shape is not None:
+            smax, hbmax, acap = self._stacked_shape
+            return kc.executable(
+                batch_shape, smax, hbmax,
+                active_slots=self.active_slots,
+                max_matches=self.max_matches,
+                compact_output=True, flat_cap=0,
+                mesh=(self.dp, self.tp, acap),
+                block=block_compile,
+            )
+        key = (int(batch_shape[0]), int(batch_shape[1]))
+        fn = self._steps.get(key)
+        if fn is None:
+            fn = self._steps[key] = build_multichip_step(
+                self.mesh, self.active_slots, self.max_matches)
+        return fn
+
+    def _lower_step(self, key):
+        """Mesh half of the kernel cache's ``_lower``: AOT-compile the
+        shard_map step for one (B, D, S, Hb, ..., (dp, tp, acap)) key
+        (proven on the CPU mesh — jit(shard_map).lower(
+        ShapeDtypeStruct...) works)."""
+        from ..ops.compiler import BUCKET_SLOTS
+
+        b, d, s, hb = key[0], key[1], key[2], key[3]
+        acap = key[10][2]
+        step = build_multichip_step(self.mesh, key[4], key[5])
+        sd = jax.ShapeDtypeStruct
+        i32 = jnp.int32
+        return step.lower(
+            sd((b, d), i32), sd((b,), i32), sd((b,), jnp.bool_),
+            sd((self.tp, s, 4), i32),
+            sd((self.tp, hb, BUCKET_SLOTS * 4), i32),
+            sd((self.tp, 2), i32),
+            sd((self.tp, acap), i32),
+        ).compile()
+
+    def warm(self, batches=(64,), depths=None) -> None:
+        """Pre-pay the mesh step compiles for the serve shapes (the
+        service ``_warm`` twin); no-op until the first apply."""
+        if self._arrs is None:
+            return
+        for b in batches:
+            for d in (depths or (self.depth,)):
+                enc = self.encode([], batch=b, depth=d)
+                res = self.dispatch(enc)
+                self.readback(res, 0)
+
+    # ------------------------------------------------------------------
+    # per-shard segment persistence (opt-in via match.segments.enable)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _seg_dir(segments_dir: str) -> str:
+        return os.path.join(segments_dir, "multichip")
+
+    def save_segments(self, segments_dir: str, epoch: int) -> None:
+        """WORKER-THREAD step: persist every shard subtable (the
+        existing segment format — trie relation, shared vocab verbatim)
+        plus a checksummed manifest carrying the service-table epoch
+        and the local→service aid maps.  Cold start seeds from these
+        iff the epoch still matches (the ``_seg_join_seed`` idiom)."""
+        from ..storage.segments import save_segment
+
+        d = self._seg_dir(segments_dir)
+        os.makedirs(d, exist_ok=True)
+        for t, sub in enumerate(self._subs):
+            save_segment(os.path.join(d, f"shard{t}.seg.npz"), sub,
+                         deep={}, routing_aids=set(),
+                         filters=sub.filters())
+        maps = {f"m{t}": amap for t, amap in enumerate(self._aid_maps)}
+        meta = {"version": self.MANIFEST_VERSION, "epoch": int(epoch),
+                "tp": self.tp, "depth": self.depth}
+        digest = self._manifest_checksum(meta, maps)
+        np.savez(os.path.join(d, "aid_maps.npz"), **maps)
+        # the manifest lands LAST (atomic replace = the commit point):
+        # a crash mid-save leaves either the old manifest or none
+        tmp = os.path.join(d, "manifest.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump({**meta, "checksum": digest}, f, sort_keys=True)
+        os.replace(tmp, os.path.join(d, "manifest.json"))
+        self._persist_due = False
+
+    @staticmethod
+    def _manifest_checksum(meta: dict, maps: Dict[str, np.ndarray]) -> str:
+        import hashlib
+
+        h = hashlib.sha1(json.dumps(meta, sort_keys=True).encode())
+        for k in sorted(maps):
+            h.update(k.encode())
+            h.update(np.ascontiguousarray(maps[k]).tobytes())
+        return h.hexdigest()
+
+    def load_segments(self, segments_dir: str, expect_epoch: int) -> bool:
+        """Cold start: restore the shard partition from the persisted
+        per-shard segments iff the manifest's service epoch matches the
+        just-restored main table (no drift since the save) — else the
+        caller rebuilds the partition from the live service state.
+        Returns True when seeded."""
+        from ..storage.segments import load_segment, restore_incremental
+
+        d = self._seg_dir(segments_dir)
+        try:
+            with open(os.path.join(d, "manifest.json")) as f:
+                meta = json.load(f)
+            if meta.get("version") != self.MANIFEST_VERSION \
+                    or meta.get("tp") != self.tp \
+                    or meta.get("depth") != self.depth \
+                    or meta.get("epoch") != int(expect_epoch):
+                return False
+            npz = np.load(os.path.join(d, "aid_maps.npz"))
+            maps = {k: np.asarray(npz[k], np.int32) for k in npz.files}
+            want = meta.get("checksum")
+            meta_core = {k: meta[k] for k in
+                         ("version", "epoch", "tp", "depth")}
+            if want != self._manifest_checksum(meta_core, maps):
+                log.warning("multichip manifest checksum mismatch; "
+                            "repartition serves")
+                return False
+            subs = []
+            for t in range(self.tp):
+                seg = load_segment(os.path.join(d, f"shard{t}.seg.npz"))
+                if seg.kind != "state" or seg.depth != self.depth:
+                    return False
+                subs.append(restore_incremental(seg))
+        except FileNotFoundError:
+            return False
+        except Exception:
+            log.warning("multichip segment load failed; repartition "
+                        "serves", exc_info=True)
+            return False
+        # every shard persisted the SAME shared vocab — rebind them to
+        # one dict instance so future interning stays consistent
+        v0 = subs[0].vocab
+        for sub in subs[1:]:
+            if sub.vocab != v0:
+                log.warning("multichip shard vocabs diverged; "
+                            "repartition serves")
+                return False
+            sub.vocab = v0
+        with self._lock:
+            self.vocab = v0
+            self._subs = subs
+            self._aid_maps = [maps.get(f"m{t}",
+                                       np.full(64, -1, np.int32))
+                              for t in range(self.tp)]
+            self._pending = []
+            self._rebuild_pairs = None
+            self._restack_due = True
+            self._arrs = None
+        self.seeded_from_segments = True
+        return True
+
+    def info(self) -> dict:
+        return {
+            "devices": self.n_devices,
+            "mesh": {"dp": self.dp, "tp": self.tp},
+            "ready": self.ready,
+            "gen": self.gen,
+            "dispatches": self.dispatches,
+            "failovers": self.failovers,
+            "applies": self.applies,
+            "restacks": self.restacks,
+            "dead_shards": sorted(self._dead),
+            "shard_filters": [sub.n_filters for sub in self._subs],
+            "seeded_from_segments": self.seeded_from_segments,
+        }

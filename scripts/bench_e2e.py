@@ -39,6 +39,33 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def multichip_serve_smoke(n_filters: int) -> dict:
+    """The multichip_serve row in ITS OWN subprocess with a virtual
+    8-device CPU mesh (the conftest pattern).  Forcing 8 XLA host
+    devices in THIS process would slow every single-chip row (8
+    device threads on a 1-core box stall the table_lifecycle churn
+    gates), so the mesh A/B is isolated instead."""
+    import subprocess
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8") \
+            .strip()
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import json, bench; print(json.dumps("
+         f"bench.bench_multichip_serve_smoke(n_filters={n_filters})))"],
+        capture_output=True, text=True, cwd=REPO, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags},
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"multichip_serve smoke failed: {proc.stderr[-2000:]}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
 
 def chaos_smoke() -> dict:
     """One kill-and-recover cycle per subsystem; each section reports
@@ -771,6 +798,15 @@ def main(argv=None) -> dict:
     # shape, short+deep mixes — the parity gate is CI-asserted, the
     # speedup ratios are tracking numbers for the r06 hardware round
     out["kernel_join"] = bench_kernel_join_smoke(
+        n_filters=(2000 if args.smoke else 20000))
+    # multichip serve A/B (ISSUE 15): the table sharded by topic-prefix
+    # over the virtual 8-device CPU mesh vs the single-chip serve path
+    # — parity / truncation-psum / shard-kill gates are CI-asserted;
+    # the scaling ratio is a tracking number (8 host threads share one
+    # CPU; bench.py's r06 hardware round owns the ≥6x claim).  Runs in
+    # its own subprocess so the forced 8-device mesh cannot slow the
+    # single-chip rows above.
+    out["multichip_serve"] = multichip_serve_smoke(
         n_filters=(2000 if args.smoke else 20000))
     # stage-latency observatory parity (ISSUE 12): the serve sections'
     # p50/p99 now come from the product's histograms (observe/hist.py);

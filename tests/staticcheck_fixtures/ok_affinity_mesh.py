@@ -1,0 +1,32 @@
+"""Fixture: shard-affinity must NOT flag the disciplined mesh-worker
+shape — the partition apply mutates only the matcher's own state under
+its lock; every broker write happens back on the event loop."""
+
+import asyncio
+import threading
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}
+
+
+class ShardedMatcher:
+    def __init__(self, broker):
+        self.broker = broker
+        self._lock = threading.Lock()
+        self.subtables = {}
+
+    async def sync_once(self):
+        changed = await asyncio.to_thread(self.apply_worker)
+        # loop side: publishing the applied partition into broker
+        # state is legal here
+        if changed:
+            self.broker.routes["hint"] = list(self.subtables)
+
+    def apply_worker(self):
+        # thread side: the matcher is the single writer of its own
+        # subtables; the lock orders it against dispatch snapshots
+        with self._lock:
+            self.subtables["shard0"] = [1, 2, 3]
+        return True

@@ -1,0 +1,31 @@
+"""Fixture: multichip mesh worker threads (ISSUE 15) — a
+``to_thread``-entered partition-apply stage touching Broker state MUST
+trip shard-affinity (1 finding).  The mesh matcher owns its own
+subtables under its lock; hint minting, epochs, and readiness flips
+stay on the event loop."""
+
+import asyncio
+import threading
+
+
+class Broker:
+    def __init__(self):
+        self.routes = {}
+
+
+class ShardedMatcher:
+    def __init__(self, broker):
+        self.broker = broker
+        self._lock = threading.Lock()
+        self.subtables = {}
+
+    async def sync_once(self):
+        await asyncio.to_thread(self.apply_worker)
+
+    def apply_worker(self):
+        with self._lock:
+            self.subtables["shard0"] = [1, 2, 3]
+        # (1) Broker state is main-loop-only: the mesh partition-apply
+        # worker must hand results back to the sync loop, never mint
+        # routes/hints into broker state from the apply thread
+        self.broker.routes["hint"] = list(self.subtables)

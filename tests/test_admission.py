@@ -697,3 +697,54 @@ def test_admission_rest_and_cli_surface():
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# peerhost-keyed CONNECT-storm rows (ROADMAP admission residual (c))
+# ---------------------------------------------------------------------------
+
+def test_distributed_clientid_storm_concentrates_on_ip_row():
+    """A CONNECT storm rotating clientids from ONE host spreads one
+    connect per fresh per-client row (each stays calm) but SUMS on the
+    ip: row, which climbs the ladder to a peerhost temp-ban — the
+    dilution hole the per-clientid keying left open."""
+    h = Harness(hold_ticks=1, decay_ticks=2)
+    for tick in range(4):
+        for i in range(60):
+            h.adm.note_connect(f"bot-{tick}-{i}", peerhost="10.0.0.9")
+        h.tick()
+    # no individual bot ever scored hot (1 connect each, threshold 2/s)
+    assert all(h.adm.explain(f"bot-0-{i}")["level"] == 0
+               for i in range(5)
+               if h.adm.explain(f"bot-0-{i}") is not None)
+    # the host row concentrated the storm: observe -> throttle(no-op)
+    # -> quarantine(no-op) -> peerhost temp-ban
+    assert h.banned.check(peerhost="10.0.0.9", now=h.now[0])
+    # ip rows never retune a token bucket nor kick a single channel
+    assert "ip:10.0.0.9" not in h.throttles
+    assert "ip:10.0.0.9" not in h.kicked
+
+
+def test_auth_failure_storm_keys_on_ip_row():
+    """Credential stuffing rotates clientids freely; the auth-failure
+    seam feeds the stable source-host row alongside the per-client
+    one."""
+    h = Harness()
+    for i in range(40):
+        h.adm.note_auth_failure(f"stuff{i}", peerhost="10.9.9.9")
+    h.tick()
+    row = h.adm.explain("ip:10.9.9.9")
+    assert row is not None
+    assert row["features"]["auth_fail_rate"] > 0
+    assert row["features"]["connect_rate"] > 0
+    # per-client rows saw exactly their own single failure
+    one = h.adm.explain("stuff0")
+    assert one["features"]["auth_fail_rate"] < \
+        row["features"]["auth_fail_rate"]
+
+
+def test_note_connect_without_peerhost_adds_no_ip_row():
+    h = Harness()
+    h.adm.note_connect("plain")
+    h.tick()
+    assert not any(k.startswith("ip:") for k in h.adm._slots)

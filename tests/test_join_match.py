@@ -620,3 +620,34 @@ def test_service_auto_with_segments_persists_picks(tmp_path):
         assert reloaded.picks == ms.tuner.picks and reloaded.picks
 
     run(main())
+
+
+def test_autotuner_family_pick_generalizes_across_pow2_shapes():
+    """ROADMAP join residual (d): a pick measured at one pow2 (S, Hb)
+    shape serves the whole (B, D) family — a growth step inherits the
+    family consensus instead of re-measuring cold."""
+    t = BackendAutotuner(reps=1)
+    t.record(t.sig(256, 8, 1024, 64), "join")
+    t.record(t.sig(256, 8, 2048, 64), "join")
+    # exact hit stays exact
+    assert t.pick_for(256, 8, 1024, 64) == "join"
+    assert t.family_hits == 0
+    # unmeasured grown shape inherits the family consensus
+    assert t.pick_for(256, 8, 4096, 128) == "join"
+    assert t.family_hits == 1
+    # a different (B, D) family has no pick
+    assert t.pick_for(512, 8, 4096, 128) is None
+    assert t.pick_for(256, 4, 4096, 128) is None
+
+
+def test_autotuner_family_split_measures_exact():
+    """A family whose measured shapes DISAGREE returns no consensus:
+    the exact shape measures as before (a wrong inherited pick is only
+    slow, but a split family is real signal)."""
+    t = BackendAutotuner(reps=1)
+    t.record(t.sig(256, 8, 1024, 64), "join")
+    t.record(t.sig(256, 8, 2048, 128), "hash")
+    assert t.pick_for(256, 8, 4096, 256) is None
+    assert t.family_hits == 0
+    # persisted format stays the versioned checksummed JSON
+    assert "family_hits" in t.info()

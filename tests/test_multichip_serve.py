@@ -1,0 +1,456 @@
+"""Multichip serve backend (ISSUE 15): the match table sharded by
+topic-prefix over the virtual 8-device CPU mesh, serving real publish
+traffic through MatchService.
+
+Covers: compact-contract parity against the host tables and the
+single-chip flat path (bit-for-bit), per-shard truncation psum
+fail-open, delta churn + growth restacks, per-shard segment
+persistence with the epoch/checksum guards, kernel-cache mesh keys
+(CompileMiss + prewarm), shard-kill / ``match.shard`` fault chaos with
+delivery held at 1.0 via CPU failover, and the flag-off spy (the
+single-chip path is byte-identical — no matcher is even constructed).
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from emqx_tpu import faultinject
+from emqx_tpu import topic as T
+from emqx_tpu.client import Client
+from emqx_tpu.config import Config
+from emqx_tpu.faultinject import FaultInjector
+from emqx_tpu.node import BrokerNode
+from emqx_tpu.ops.incremental import IncrementalNfa
+from emqx_tpu.parallel import multichip_serve as mcs_mod
+from emqx_tpu.parallel.multichip_serve import (
+    MultichipMatcher, ShardDead, serve_mesh_shape, shard_of_filter,
+)
+
+FILTERS = ["a/+", "a/#", "+/b", "#", "x/y/z", "x/+/z", "$SYS/#",
+           "rooms/+/temp", "rooms/1/#", "b/c", "deep/+/q/+", "m/n"]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def settle(pred, timeout=60.0, interval=0.02):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    return pred()
+
+
+def make_node(**extra):
+    cfg = Config(file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+    cfg.put("tpu.enable", True)
+    cfg.put("tpu.mirror_refresh_interval", 0.01)
+    cfg.put("tpu.bypass_rate", 0.0)
+    cfg.put("match.multichip.enable", True)
+    for k, v in extra.items():
+        cfg.put(k, v)
+    return BrokerNode(cfg)
+
+
+def build_pair(filters=FILTERS, depth=8, **mc_kw):
+    """(service table, matcher with the same aid space, pairs)."""
+    inc = IncrementalNfa(depth=depth)
+    pairs = []
+    for f in filters:
+        inc.add(f)
+        pairs.append((f, inc.aid_of(f)))
+    mc = MultichipMatcher(depth=depth, **mc_kw)
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    return inc, mc, pairs
+
+
+def topics_for(n, seed=5):
+    rng = np.random.default_rng(seed)
+    words = ["a", "b", "x", "y", "z", "rooms", "1", "temp", "m", "n",
+             "deep", "q"]
+    return ["/".join(rng.choice(words, size=rng.integers(1, 5)))
+            for _ in range(n)]
+
+
+def mesh_rows(mc, topics, batch=64, depth=None):
+    enc = mc.encode(topics, batch=batch, depth=depth)
+    return mc.readback(mc.dispatch(enc), len(topics))
+
+
+# ---------------------------------------------------------------------------
+# partition + parity (CPU mesh)
+# ---------------------------------------------------------------------------
+
+def test_mesh_shape_and_partition_determinism():
+    assert serve_mesh_shape(8) == {"dp": 2, "tp": 4}
+    assert serve_mesh_shape(8, tp=2) == {"dp": 4, "tp": 2}
+    assert serve_mesh_shape(1) == {"dp": 1, "tp": 1}
+    for f in FILTERS:
+        t = shard_of_filter(f, 4)
+        assert 0 <= t < 4
+        assert t == shard_of_filter(f, 4)  # deterministic
+    # the partition spreads the whole table over the shards
+    _inc, mc, _pairs = build_pair()
+    per_shard = [sub.n_filters for sub in mc._subs]
+    assert sum(per_shard) == len(FILTERS)
+    assert mc.dp * mc.tp == 8
+
+
+def test_compact_rows_bit_for_bit_vs_host_and_single_chip():
+    """The dense compact contract off the mesh must reproduce the
+    single-chip serve path's rows bit-for-bit (same service accept
+    ids) and agree with the host walk on every topic."""
+    from emqx_tpu.broker.match_service import MatchService
+    from emqx_tpu.ops import encode_batch
+    from emqx_tpu.ops.device_table import DeviceNfa
+
+    inc, mc, _pairs = build_pair()
+    dev = DeviceNfa(inc, active_slots=8, max_matches=16)
+    topics = topics_for(64)
+    rows8, sp8, nbytes = mesh_rows(mc, topics)
+    assert nbytes > 0
+    enc = encode_batch(inc, topics, batch=64)
+    res = dev.match(*enc, flat_cap=8 * 64)
+    rows1, sp1 = MatchService._readback_rows(res, len(topics), 16)
+    assert not sp8 and not sp1
+    for t, r8, r1 in zip(topics, rows8, rows1):
+        assert sorted(r8) == sorted(r1) == sorted(inc.match_host(t)), t
+
+
+def test_delta_churn_and_growth_restack_parity():
+    """note_add/note_del ride the drain/apply cycle; enough adds to
+    cross a pow2 boundary force a restack (gen bump) and parity must
+    hold through both regimes."""
+    inc, mc, _pairs = build_pair()
+    gen0 = mc.gen
+    # small delta: scatters, no restack
+    for f in ("live/+/one", "live/two"):
+        inc.add(f)
+        mc.note_add(f, inc.aid_of(f))
+    inc.remove("a/+")
+    mc.note_del("a/+")
+    assert mc.apply_pending()
+    topics = topics_for(32) + ["live/x/one", "live/two", "a/q"]
+    rows, sp, _ = mesh_rows(mc, topics)
+    for t, r in zip(topics, rows):
+        if topics.index(t) in sp:
+            continue
+        assert sorted(r) == sorted(inc.match_host(t)), t
+    # bulk growth: resized deltas restack the stacked tables
+    for i in range(400):
+        f = f"grow/{i}/+"
+        inc.add(f)
+        mc.note_add(f, inc.aid_of(f))
+    assert mc.apply_pending()
+    assert mc.gen > gen0
+    rows, sp, _ = mesh_rows(mc, ["grow/7/z", "grow/399/z", "m/n"])
+    assert not sp
+    for t, r in zip(["grow/7/z", "grow/399/z", "m/n"], rows):
+        assert sorted(r) == sorted(inc.match_host(t)), t
+
+
+def test_truncation_psum_fail_open():
+    """Per-shard truncation: every row the psum'd overflow did NOT
+    flag must be COMPLETE (the flag may over-approximate — the host
+    re-runs flagged rows — but never under-approximate)."""
+    inc, mc, _pairs = build_pair(max_matches=2)
+    # "#" + "a/+" + "a/#" etc: topics under a/ match >2 filters
+    topics = ["a/b", "a/b/c", "x/y/z", "m/n", "b/c"]
+    rows, sp, _ = mesh_rows(mc, topics)
+    spset = set(sp)
+    assert spset, "expected at least one truncated row"
+    for i, t in enumerate(topics):
+        if i not in spset:
+            assert sorted(rows[i]) == sorted(inc.match_host(t)), t
+
+
+# ---------------------------------------------------------------------------
+# chaos: dead shards + the match.shard seam (matcher level)
+# ---------------------------------------------------------------------------
+
+def test_shard_kill_raises_and_counts_failover():
+    inc, mc, _pairs = build_pair()
+    enc = mc.encode(["a/b"], batch=64)
+    mc.dispatch(enc)
+    mc.kill_shard(2)
+    with pytest.raises(ShardDead):
+        mc.dispatch(enc)
+    assert mc.failovers == 1
+    mc.revive_shard(2)
+    rows, _, _ = mesh_rows(mc, ["a/b"])
+    assert sorted(rows[0]) == sorted(inc.match_host("a/b"))
+
+
+def test_match_shard_fault_injection_point():
+    inc, mc, _pairs = build_pair()
+    enc = mc.encode(["a/b"], batch=64)
+    faultinject.install(FaultInjector([
+        {"point": "match.shard", "action": "raise", "times": 1},
+    ]))
+    try:
+        with pytest.raises(faultinject.InjectedFault):
+            mc.dispatch(enc)
+        assert mc.failovers == 1
+        mc.dispatch(enc)   # rule exhausted: healthy again
+    finally:
+        faultinject.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# per-shard segment persistence
+# ---------------------------------------------------------------------------
+
+def test_segments_roundtrip_epoch_and_checksum_guards(tmp_path):
+    inc, mc, _pairs = build_pair()
+    d = str(tmp_path)
+    mc.save_segments(d, epoch=inc.epoch)
+    topics = topics_for(16)
+    want, _, _ = mesh_rows(mc, topics)
+
+    # epoch mismatch -> repartition serves
+    mc2 = MultichipMatcher(depth=8)
+    assert not mc2.load_segments(d, expect_epoch=inc.epoch + 1)
+    # matching epoch -> seeded, restacked at the next apply, parity
+    mc3 = MultichipMatcher(depth=8)
+    assert mc3.load_segments(d, expect_epoch=inc.epoch)
+    assert mc3.dirty and not mc3.ready
+    assert mc3.apply_pending()
+    got, _, _ = mesh_rows(mc3, topics)
+    assert [sorted(r) for r in got] == [sorted(r) for r in want]
+    assert mc3.seeded_from_segments
+
+    # tampered aid maps -> checksum reject
+    mpath = os.path.join(d, "multichip", "aid_maps.npz")
+    maps = dict(np.load(mpath))
+    maps["m0"] = np.asarray(maps["m0"], np.int32) + 1
+    np.savez(mpath, **maps)
+    mc4 = MultichipMatcher(depth=8)
+    assert not mc4.load_segments(d, expect_epoch=inc.epoch)
+
+    # wrong tp layout -> rejected before any array is trusted
+    mc5 = MultichipMatcher(depth=8, tp=2)
+    assert not mc5.load_segments(d, expect_epoch=inc.epoch)
+
+
+# ---------------------------------------------------------------------------
+# kernel-cache mesh dimension
+# ---------------------------------------------------------------------------
+
+def test_kernel_cache_mesh_keys_compile_miss_and_prewarm():
+    from emqx_tpu.ops.kernel_cache import CompileMiss, MatchKernelCache
+
+    kc = MatchKernelCache()
+    inc, mc, _pairs = build_pair(kernel_cache=kc)
+    enc = mc.encode(["a/b"], batch=64)
+    # non-blocking cold shape: the serving contract (CPU answers NOW)
+    with pytest.raises(CompileMiss):
+        mc.dispatch(enc, block_compile=False)
+    # blocking compile, then a hit
+    rows, _, _ = mc.readback(mc.dispatch(enc, block_compile=True), 1)
+    assert sorted(rows[0]) == sorted(inc.match_host("a/b"))
+    h0 = kc.hits
+    mc.dispatch(enc)
+    assert kc.hits > h0
+    # prewarm replays the MESH combo against the next pow2 table shape
+    smax, hbmax, _acap = mc._stacked_shape
+    assert not kc.shape_covered(2 * smax, hbmax)
+    n = kc.prewarm_shape(2 * smax, hbmax)
+    assert n >= 1
+    assert kc.shape_covered(2 * smax, hbmax)
+
+
+# ---------------------------------------------------------------------------
+# MatchService integration (full node on the CPU mesh)
+# ---------------------------------------------------------------------------
+
+def test_node_multichip_serves_then_shard_kill_holds_delivery():
+    """Real traffic through the sharded table: hints ride the mesh
+    with parity; a killed shard degrades like any device failure —
+    the CPU trie answers and delivery stays 1.0 (tier-1 chaos)."""
+
+    async def main():
+        node = make_node()
+        await node.start()
+        ms = node.match_service
+        assert ms is not None and ms.mc is not None
+        assert ms.mc.n_devices == 8
+        port = node.listeners.all()[0].port
+        try:
+            subs, filters = [], []
+            for i in range(4):
+                c = Client(clientid=f"s{i}", port=port)
+                await c.connect()
+                flt = f"room/+/kind{i % 2}"
+                await c.subscribe(flt, qos=0)
+                subs.append(c)
+                filters.append(flt)
+            assert await settle(lambda: ms.ready and ms.mc.ready)
+            d0 = ms.mc.dispatches
+            pub = Client(clientid="p", port=port)
+            await pub.connect()
+            topics = [f"room/{i}/kind{i % 2}" for i in range(20)]
+            for t in topics:
+                await pub.publish(t, b"x", qos=0)
+            want = sum(1 for t in topics for f in filters
+                       if T.match(t, f))
+            assert await settle(
+                lambda: sum(s.messages.qsize() for s in subs) >= want)
+            m = node.observed.metrics
+            assert ms.mc.dispatches > d0, "batches did not ride the mesh"
+            assert m.get("tpu.match.shard_dispatches") >= 1
+            assert m.get("tpu.match.shard_devices") == 8
+            assert m.get("tpu.match.batches") >= 1
+
+            # chaos: dead shard -> CPU failover, delivery_ratio 1.0
+            ms.mc.kill_shard(1)
+            topics2 = [f"room/{100 + i}/kind{i % 2}" for i in range(20)]
+            for t in topics2:
+                await pub.publish(t, b"y", qos=0)
+            want2 = want + sum(1 for t in topics2 for f in filters
+                               if T.match(t, f))
+            assert await settle(
+                lambda: sum(s.messages.qsize() for s in subs) >= want2)
+            assert m.get("tpu.match.shard_failover") >= 1
+            info = ms.info()["multichip"]
+            assert info["dead_shards"] == [1]
+            for s in subs:
+                await s.disconnect()
+            await pub.disconnect()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_node_match_shard_fault_failover_and_recovery():
+    """An injected ``match.shard`` raise behaves like a device
+    failure: the batch falls to the CPU trie (hints still answer),
+    and once the rule is exhausted the mesh serves again."""
+
+    async def main():
+        node = make_node()
+        await node.start()
+        ms = node.match_service
+        assert ms is not None and ms.mc is not None
+        try:
+            b = node.broker
+            if "c1" not in b.sessions:
+                b.open_session("c1")
+            b.subscribe("c1", "f/+")
+            assert await settle(lambda: ms.ready and ms.mc.ready)
+            faultinject.install(FaultInjector([
+                {"point": "match.shard", "action": "raise", "times": 2},
+            ]))
+            await ms.prefetch("f/one")
+            # device path refused; the publish path still answers via
+            # the host trie (no fresh hint was minted)
+            inj = faultinject.get()
+            assert inj is not None
+            assert inj.fired.get("match.shard", 0) >= 1
+            faultinject.uninstall()
+            d0 = ms.mc.dispatches
+            await ms.prefetch("f/two")
+            assert await settle(lambda: ms.mc.dispatches > d0)
+            assert ms.hint_routes("f/two") is not None
+        finally:
+            faultinject.uninstall()
+            await node.stop()
+
+    run(main())
+
+
+def test_compaction_swap_repartitions_and_serves():
+    """A compacted-table swap reassigns EVERY aid: the shard
+    partition rebuilds from the fresh space (mc.gen bumps) and serving
+    parity holds on the new table."""
+
+    async def main():
+        import tempfile
+
+        seg = tempfile.mkdtemp()
+        node = make_node(**{
+            "match.segments.enable": True,
+            "match.segments.dir": seg,
+            "match.segments.compact_interval": 0.2,
+            "match.segments.compact_min_mutations": 1,
+        })
+        await node.start()
+        ms = node.match_service
+        assert ms is not None and ms.mc is not None
+        try:
+            b = node.broker
+            if "c1" not in b.sessions:
+                b.open_session("c1")
+            for i in range(8):
+                b.subscribe("c1", f"swap/{i}/+")
+            assert await settle(lambda: ms.ready and ms.mc.ready)
+            gen0 = ms.mc.gen
+            assert await settle(lambda: ms._table_gen >= 1, timeout=30)
+            # the repartition lands on the next sync pass
+            assert await settle(
+                lambda: ms.mc.ready and ms.mc.gen > gen0, timeout=30)
+            await ms.prefetch("swap/3/x")
+            routes = ms.hint_routes("swap/3/x")
+            assert routes is not None
+            # per-shard segments persisted next to the main segment
+            assert os.path.exists(
+                os.path.join(seg, "multichip", "manifest.json"))
+            with open(os.path.join(seg, "multichip",
+                                   "manifest.json")) as f:
+                assert json.load(f)["tp"] == ms.mc.tp
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_flag_off_is_byte_identical_single_chip_path(monkeypatch):
+    """match.multichip.enable off: no matcher is constructed (spy),
+    the serve plane dispatches through the single-chip DeviceNfa, and
+    the shard metrics stay zero."""
+    calls = []
+    real = mcs_mod.MultichipMatcher
+
+    class Spy(real):
+        def __init__(self, *a, **kw):
+            calls.append(1)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(mcs_mod, "MultichipMatcher", Spy)
+
+    async def main():
+        cfg = Config(
+            file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", True)
+        cfg.put("tpu.mirror_refresh_interval", 0.01)
+        cfg.put("tpu.bypass_rate", 0.0)
+        node = BrokerNode(cfg)
+        await node.start()
+        ms = node.match_service
+        try:
+            assert ms is not None
+            assert ms.mc is None
+            b = node.broker
+            if "c1" not in b.sessions:
+                b.open_session("c1")
+            b.subscribe("c1", "off/+")
+            assert await settle(lambda: ms.ready)
+            await ms.prefetch("off/x")
+            assert ms.hint_routes("off/x") is not None
+            m = node.observed.metrics
+            assert m.get("tpu.match.batches") >= 1
+            assert m.get("tpu.match.shard_dispatches") == 0
+            assert m.get("tpu.match.shard_devices") == 0
+            assert not calls, "flag off must not construct a matcher"
+            assert ms.info()["multichip"] is None
+        finally:
+            await node.stop()
+
+    run(main())

@@ -117,6 +117,11 @@ def test_waiver_file_has_no_silent_suppressions():
     # worker + loop-side-write shape passes
     ("shard-affinity", "trip_affinity_pipeline.py",
      "ok_affinity_pipeline.py", 1),
+    # multichip mesh worker threads (ISSUE 15): an unseeded to_thread
+    # partition-apply writing MatchService state trips; the
+    # matcher-owns-its-own-state + loop-side-readiness shape passes
+    ("shard-affinity", "trip_affinity_mesh.py",
+     "ok_affinity_mesh.py", 1),
     ("torn-read", "trip_tornread.py", "ok_tornread.py", 2),
     ("lock-order", "trip_lockorder.py", "ok_lockorder.py", 1),
     ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
