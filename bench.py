@@ -33,6 +33,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import math
 import os
 import sys
 import time
@@ -1793,6 +1794,166 @@ def _multichip_serve_size(smoke: bool) -> dict:
                                iters=10))
 
 
+def bench_multichip_ep(n_filters=200_000, batch=2048, iters=10,
+                       depth=8, tp=0, reps=3, ep_slack=2.0):
+    """Prefix-EP routed vs replicated multichip A/B (ISSUE 16): the
+    same mesh, the same filters, the same offered load — one side
+    replicates every topic row to every tp shard, the other buckets
+    rows by root-token owner and all_to_all-routes them so each shard
+    walks only what it owns.  Gates:
+
+    * ``gate_routed_parity_all`` — routed service-aid rows agree
+      BIT-FOR-BIT with the replicated backend (spilled rows re-run on
+      the host tables on both sides);
+    * ``gate_overflow_failopen`` — a root-skewed corpus overflows the
+      (tp, C) bucket grid at slack 1.0; every flagged row re-runs on
+      the host tables and stays COMPLETE (the dead-shard discipline);
+    * ``gate_shard_width_le_batch_over_tp`` — per-shard processed
+      batch width tp*C <= ceil(slack * Bl / tp): the routed step cut
+      per-shard work by ~tp/slack vs the replicated Bl;
+    * ``gate_shard_kill_failover`` — a killed shard raises BEFORE any
+      all_to_all on the routed path; the host tables answer at
+      delivery_ratio 1.0."""
+    import jax
+
+    from emqx_tpu.observe.metrics import Metrics
+    from emqx_tpu.ops.incremental import IncrementalNfa
+    from emqx_tpu.parallel.multichip_serve import (
+        MultichipMatcher, ShardDead,
+    )
+
+    max_matches = _serve_max_matches()
+    rng = np.random.default_rng(31)
+    filters, topics = build_workload(rng, n_filters, batch * 4, depth)
+    inc = IncrementalNfa(depth=depth)   # host oracle
+    pairs = []
+    for f in filters:
+        try:
+            inc.add(f)
+            pairs.append((f, inc.aid_of(f)))
+        except ValueError:
+            pass
+
+    def build(ep, slack=ep_slack):
+        met = Metrics()
+        mc = MultichipMatcher(depth=depth, tp=tp, active_slots=8,
+                              max_matches=max_matches, metrics=met,
+                              ep=ep, ep_slack=slack)
+        mc.rebuild(pairs)
+        mc.apply_pending()
+        return mc, met
+
+    mc_rep, _ = build(False)
+    mc_ep, met = build(True)
+    names = (topics * (batch // max(1, len(topics)) + 1))[:batch]
+
+    def rows_of(mc, nm, b):
+        enc = mc.encode(nm, batch=b, depth=depth)
+        rows, sp, nbytes = mc.readback(mc.dispatch(enc), len(nm))
+        return rows, set(sp), nbytes
+
+    rows_r, sp_r, _ = rows_of(mc_rep, names, batch)
+    rows_e, sp_e, _ = rows_of(mc_ep, names, batch)
+    ici_bytes = int(met.get("tpu.match.ep_ici_bytes"))
+    routed_used = met.get("tpu.match.ep_dispatches") > 0
+    parity = all(
+        (sorted(inc.match_host(t)) if i in sp_r else sorted(rows_r[i]))
+        == (sorted(inc.match_host(t)) if i in sp_e else sorted(rows_e[i]))
+        for i, t in enumerate(names))
+
+    # overflow fail-open: every row shares one root, so one owner's
+    # bucket column takes the whole source slice — at slack 1.0 the
+    # grid cannot hold it, the overflowing rows are psum-flagged, and
+    # the host tables keep them complete
+    mc_ov, _ = build(True, slack=1.0)
+    skew = [f"hot/{i}/x" for i in range(batch)]
+    rows_s, sp_s, _ = rows_of(mc_ov, skew, batch)
+    failopen_ok = all(
+        (sorted(inc.match_host(t)) if i in sp_s else sorted(rows_s[i]))
+        == sorted(inc.match_host(t)) for i, t in enumerate(skew))
+    overflow_flagged = len(sp_s)
+
+    # the width contract (per-shard processed rows, routed vs
+    # replicated) — analytic, the same numbers the ep_shard_width /
+    # ep_ici_bytes metrics export
+    Bl = batch // mc_ep.dp
+    C = mc_ep.ep_capacity(batch)
+    width = mc_ep.tp * C
+    gate_width = bool(
+        routed_used and width <= math.ceil(ep_slack * Bl / mc_ep.tp))
+
+    def best(run):
+        t = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run()
+            t = min(t, (time.perf_counter() - t0) / iters)
+        return t
+
+    t_rep = best(lambda: rows_of(mc_rep, names, batch))
+    t_ep = best(lambda: rows_of(mc_ep, names, batch))
+
+    # shard-kill on the routed path: the gate raises before any
+    # all_to_all (a dead shard cannot answer for the roots it owns)
+    mc_ep.kill_shard(0)
+    killed_raises = False
+    try:
+        mc_ep.dispatch(mc_ep.encode(names, batch=batch, depth=depth))
+    except ShardDead:
+        killed_raises = True
+    mc_ep.revive_shard(0)
+    host4 = [sorted(inc.match_host(t)) for t in names[:4]]
+    ref4 = [sorted(inc.match_host(names[i])) if i in sp_e
+            else sorted(rows_e[i]) for i in range(4)]
+    delivery_ratio = (sum(1 for a, b in zip(host4, ref4) if a == b)
+                      / max(1, len(host4)))
+
+    return {
+        "n_filters": int(inc.n_filters),
+        "batch": batch,
+        "devices": mc_ep.n_devices,
+        "mesh": {"dp": mc_ep.dp, "tp": mc_ep.tp},
+        "measured_on": jax.devices()[0].platform,
+        "native_subtables": bool(mc_ep.native),
+        "ep_capacity": int(C),
+        "replicated_shard_width": int(Bl),
+        "routed_shard_width": int(width),
+        "ici_bytes_per_batch": ici_bytes,
+        "replicated_us": round(t_rep * 1e6, 1),
+        "routed_us": round(t_ep * 1e6, 1),
+        "replicated_topics_per_s": round(batch / max(t_rep, 1e-9)),
+        "routed_topics_per_s": round(batch / max(t_ep, 1e-9)),
+        # host-thread CPU meshes pay the all_to_all without the ICI
+        # win, so this is a tracking number off-hardware (same
+        # regime caveat as gate_scaling_ge_6x_at_8)
+        "routed_speedup_x": round(t_rep / max(t_ep, 1e-9), 3),
+        "overflow_rows_flagged": int(overflow_flagged),
+        "gate_routed_parity_all": bool(parity and routed_used),
+        "gate_overflow_failopen": bool(
+            overflow_flagged > 0 and failopen_ok),
+        "gate_shard_width_le_batch_over_tp": gate_width,
+        "gate_shard_kill_failover": bool(
+            killed_raises and delivery_ratio == 1.0),
+    }
+
+
+def bench_multichip_ep_smoke(n_filters=2000, batch=256, depth=8):
+    """CPU-mesh tiny-scale multichip_ep A/B for bench_e2e --smoke: the
+    routed-parity / overflow-fail-open / width gates are the CI
+    assertions; the speedup is a tracking number (host threads share
+    cores and pay the all_to_all without the per-shard width win —
+    bench.py's r06 round owns the throughput claim)."""
+    return bench_multichip_ep(n_filters=n_filters, batch=batch,
+                              iters=3, depth=depth, reps=2)
+
+
+def _multichip_ep_size(smoke: bool) -> dict:
+    return (dict(n_filters=2000, batch=256, iters=3)
+            if smoke else dict(n_filters=1_000_000, batch=2048,
+                               iters=10))
+
+
 def bench_kernel_join_smoke(n_filters=2000, batch=256, depth=8):
     """CPU-jax tiny-scale kernel_join A/B for bench_e2e --smoke: the
     parity row is the CI gate; the ratios are tracking numbers (kernel
@@ -2211,6 +2372,16 @@ def main():
          f"on {mcs['devices']}x{mcs['measured_on']} "
          f"ge_6x_at_8={mcs['gate_scaling_ge_6x_at_8']}")
 
+    # prefix-EP routed vs replicated A/B (ISSUE 16): routed parity,
+    # bucket-overflow fail-open, the per-shard width contract, and
+    # shard-kill failover on the routed path
+    mce = bench_multichip_ep(
+        **_multichip_ep_size(args.smoke), depth=args.depth)
+    note(f"multichip EP A/B done: parity="
+         f"{mce['gate_routed_parity_all']} width="
+         f"{mce['routed_shard_width']}/{mce['replicated_shard_width']} "
+         f"width_gate={mce['gate_shard_width_le_batch_over_tp']}")
+
     # serving: device at 70% of its measured max; CPU at 70% of ITS max
     # through the same harness (iso-harness, each engine at its own
     # sustainable load) — the honest p99 comparison
@@ -2381,6 +2552,7 @@ def main():
         "serve_pipeline": serve_pipeline,
         "kernel_join": kj,
         "multichip_serve": mcs,
+        "multichip_ep": mce,
         "serve_cpu_iso": serve_cpu,
         "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,

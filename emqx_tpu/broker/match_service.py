@@ -331,6 +331,10 @@ class MatchService:
         autotune_reps: int = 3,
         multichip: bool = False,
         multichip_tp: int = 0,
+        multichip_native: bool = True,
+        multichip_ep: bool = False,
+        multichip_ep_slack: float = 2.0,
+        multichip_ep_micro: int = 8,
         hists: Any = None,
         flightrec: Any = None,
     ) -> None:
@@ -467,7 +471,10 @@ class MatchService:
                 self.mc = MultichipMatcher(
                     depth=depth, tp=multichip_tp,
                     active_slots=active_slots, max_matches=max_matches,
-                    metrics=metrics, kernel_cache=self.kcache)
+                    metrics=metrics, kernel_cache=self.kcache,
+                    native=multichip_native, ep=multichip_ep,
+                    ep_slack=multichip_ep_slack,
+                    ep_micro_matches=multichip_ep_micro)
             except Exception:
                 log.exception("multichip serve backend unavailable; "
                               "single-chip path serves")

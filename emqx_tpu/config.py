@@ -469,6 +469,24 @@ SCHEMA: Dict[str, Field] = {
     # tp (table-shard) axis width; 0 = auto — the widest pow2 <= 4 that
     # divides the device count; the remaining factor becomes dp
     "match.multichip.tp": Field(0, int, lambda v: v >= 0),
+    # native (C++) shard subtables — per-shard capacity matches the
+    # single-chip native table (10M filters); falls back to the Python
+    # IncrementalNfa when the toolchain didn't build the .so
+    "match.multichip.native": Field(True, _bool),
+    # prefix-EP routed front end (parallel/prefix_ep.py promoted to
+    # serving): publish rows all_to_all-route to the one shard owning
+    # their root token, cutting per-shard batch width ~tp× on
+    # literal-rooted tables.  Bucket overflow fails open to the CPU
+    # trie.  Off = every shard walks the full batch (replicated fan).
+    "match.multichip.ep.enable": Field(False, _bool),
+    # per-(source, owner) bucket headroom over the uniform share
+    # Bs/tp; per-shard processed width stays <= ceil(slack * B / tp)
+    "match.multichip.ep.capacity_slack": Field(
+        2.0, float, lambda v: v >= 1.0),
+    # answer-segment slots reserved for the replicated wildcard-root
+    # micro-table (merged behind the owning shard's own matches)
+    "match.multichip.ep.micro_matches": Field(
+        8, int, lambda v: 1 <= v <= 256),
 
     # -- streaming table lifecycle (broker/match_service.py) --------------
     # opt-in: cold start from persistent compacted segments + background

@@ -47,6 +47,21 @@ class GatewayConn:
         except Exception:
             self.batched = False
 
+    # -- admission plane ---------------------------------------------------
+    # Gateway datapaths feed the same PR-14 admission features as the
+    # MQTT channel, else a CoAP/SN/STOMP flood is invisible to the
+    # screening plane.  Same zero-cost discipline as the channel: one
+    # getattr + None test when the plane is off (no note call at all).
+
+    def _admission(self) -> Any:
+        return getattr(self.node.broker, "admission", None)
+
+    def _peerhost(self) -> Optional[str]:
+        addr = getattr(self, "addr", None)
+        if isinstance(addr, tuple) and addr:
+            return str(addr[0])
+        return addr if isinstance(addr, str) else None
+
     # -- session lifecycle -------------------------------------------------
 
     def attach_session(self, clientid: str, clean_start: bool = True,
@@ -65,9 +80,13 @@ class GatewayConn:
             clientid, clean_start=clean_start, **kw
         )
         self.node.connections[clientid] = self
-        self.node.broker.hooks.run(
-            "client.connected", (clientid, {"gateway": self.gateway})
-        )
+        # peerhost rides the hook info so the admission connect note
+        # (registered on client.connected) keys churn per source host
+        info = {"gateway": self.gateway}
+        host = self._peerhost()
+        if host is not None:
+            info["peerhost"] = host
+        self.node.broker.hooks.run("client.connected", (clientid, info))
         return present
 
     def detach_session(self, discard: bool = True,
@@ -100,7 +119,12 @@ class GatewayConn:
              {"gateway": self.gateway, **(conninfo or {})}),
             True,
         )
-        return acc is True
+        if acc is not True:
+            adm = self._admission()
+            if adm is not None:
+                adm.note_auth_failure(self.clientid, self._peerhost())
+            return False
+        return True
 
     def authorize(self, action: str, topic: str, qos: int = 0) -> bool:
         acc = self.node.broker.hooks.run_fold(
@@ -113,6 +137,12 @@ class GatewayConn:
     def publish(self, topic: str, payload: bytes, qos: int = 0,
                 retain: bool = False,
                 properties: Optional[Dict] = None) -> None:
+        # noted BEFORE the broker call so a denied/raising publish
+        # still registers in the per-client rate features (the MQTT
+        # channel orders its note the same way)
+        adm = self._admission()
+        if adm is not None:
+            adm.note_publish(self.clientid, topic, len(payload))
         msg = make_message(self.clientid, topic, payload, qos=qos,
                            retain=retain, properties=properties or {})
         self.node.broker.publish(msg)

@@ -355,6 +355,12 @@ class CoapGateway(Gateway):
     def on_datagram(self, data: bytes, addr) -> None:
         msg = decode(data)
         if msg is None:
+            # garbled datagram: feed the admission malformed-frame
+            # feature (keyed on the source address pre-CONNECT) so a
+            # CoAP garbage flood screens like an MQTT one
+            adm = getattr(self.node.broker, "admission", None)
+            if adm is not None:
+                adm.note_malformed(None, addr)
             return
         client = self.by_addr.get(addr)
         if client is None:

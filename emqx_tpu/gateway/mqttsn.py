@@ -448,6 +448,11 @@ class MqttSnGateway(Gateway):
     def on_datagram(self, data: bytes, addr) -> None:
         parsed = _unpack(data)
         if parsed is None:
+            # garbled datagram → admission malformed-frame feature,
+            # keyed on the source address (no clientid pre-CONNECT)
+            adm = getattr(self.node.broker, "admission", None)
+            if adm is not None:
+                adm.note_malformed(None, addr)
             return
         msgtype, body = parsed
         if msgtype == SEARCHGW:

@@ -137,6 +137,7 @@ class StompConn(GatewayConn):
         self.gw = gw
         self.reader = reader
         self.writer = writer
+        self.addr = writer.get_extra_info("peername")
         self.buf = bytearray()
         self.connected = False
         self.subs: Dict[str, Tuple[str, str]] = {}  # sub id -> (dest, ack)
@@ -162,6 +163,13 @@ class StompConn(GatewayConn):
                 self.buf.extend(data)
                 self.handle_frames(list(parse_frames(self.buf)))
         except (ValueError, ConnectionError) as e:
+            if isinstance(e, ValueError):
+                # unparseable frame: note the admission malformed
+                # feature before tearing down, same as the MQTT
+                # FrameError path
+                adm = self._admission()
+                if adm is not None:
+                    adm.note_malformed(self.clientid, self.addr)
             self.send_error(str(e))
         except asyncio.CancelledError:
             pass  # gateway stopping: the finally cancels the

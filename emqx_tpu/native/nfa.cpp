@@ -561,6 +561,44 @@ int64_t nfa_bulk_add(void* h, const char* buf, int64_t len) {
   return added;
 }
 
+// intern one word WITHOUT adding any filter; returns its vocab id.
+// Ids assign append-only (vocab.size()+1), so replaying the same word
+// sequence into several tables keeps their vocabs identical — the
+// multichip shard subtables share one encode vocab this way.
+int32_t nfa_intern(void* h, const char* s, int32_t n) {
+  return static_cast<Nfa*>(h)->intern(std::string_view(s, size_t(n)));
+}
+
+// NUL-separated words (topic words may legally contain '\n', never
+// NUL); interns each in order, returns the count consumed
+int64_t nfa_bulk_intern(void* h, const char* buf, int64_t len) {
+  Nfa* nfa = static_cast<Nfa*>(h);
+  int64_t approx = 0;
+  for (int64_t i = 0; i < len; ++i) approx += buf[i] == '\0';
+  nfa->vocab.reserve(nfa->vocab.size() + size_t(approx) + 1);
+  int64_t count = 0;
+  int64_t start = 0;
+  for (int64_t i = 0; i <= len; ++i) {
+    if (i == len || buf[i] == '\0') {
+      if (i > start) {
+        nfa->intern(std::string_view(buf + start, size_t(i - start)));
+        ++count;
+      }
+      start = i + 1;
+    }
+  }
+  return count;
+}
+
+// grow the cuckoo edge table until Hb >= hb_target (pow2 doublings,
+// full rehash each step — the multichip restack needs every shard on
+// one COMMON Hb, because lookups probe modulo the table size)
+int64_t nfa_grow_edges_to(void* h, int64_t hb_target) {
+  Nfa* nfa = static_cast<Nfa*>(h);
+  while (int64_t(nfa->Hb) < hb_target) nfa->grow(false);
+  return int64_t(nfa->Hb);
+}
+
 int32_t nfa_aid_of(void* h, const char* s, int32_t n) {
   return static_cast<Nfa*>(h)->aid_of(std::string_view(s, size_t(n)));
 }

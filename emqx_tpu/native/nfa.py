@@ -60,6 +60,12 @@ def _load():
     lib.nfa_bulk_add.restype = ctypes.c_int64
     lib.nfa_bulk_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                  ctypes.c_int64]
+    lib.nfa_intern.restype = ctypes.c_int32
+    lib.nfa_intern.argtypes = lib.nfa_add.argtypes
+    lib.nfa_bulk_intern.restype = ctypes.c_int64
+    lib.nfa_bulk_intern.argtypes = lib.nfa_bulk_add.argtypes
+    lib.nfa_grow_edges_to.restype = ctypes.c_int64
+    lib.nfa_grow_edges_to.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.nfa_aid_of.restype = ctypes.c_int32
     lib.nfa_aid_of.argtypes = lib.nfa_add.argtypes
     lib.nfa_alloc_alias.restype = ctypes.c_int32
@@ -200,6 +206,37 @@ class NativeNfa:
                 np.empty((4096, 4), np.int32)
             self._lib.nfa_mark_resized(self._h)
         return n
+
+    def intern(self, word: str) -> int:
+        """Intern ``word`` into the native vocab WITHOUT adding a
+        filter; returns its id.  Ids assign append-only (size+1), so
+        replaying one word sequence into several tables keeps their
+        vocabs identical — the multichip shard subtables share an
+        encode vocab this way."""
+        b = word.encode()
+        wid = int(self._lib.nfa_intern(self._h, b, len(b)))
+        # keep the live dict view in lockstep (append-only invariant)
+        if word not in self._vocab:
+            self._vocab[word] = wid
+        return wid
+
+    def bulk_intern(self, words: Sequence[str]) -> int:
+        """Intern many words in id order with one native call (the
+        segment-restore path; NUL framing — words may contain '\\n',
+        never NUL)."""
+        blob = "\x00".join(words).encode()
+        n = int(self._lib.nfa_bulk_intern(self._h, blob, len(blob)))
+        for w in words:
+            if w not in self._vocab:
+                self._vocab[w] = len(self._vocab) + 1
+        return n
+
+    def grow_edges_to(self, hb_target: int) -> int:
+        """Grow the cuckoo edge table until Hb >= ``hb_target`` (the
+        multichip common-Hb restack: lookups probe modulo the table
+        size, so stacked shards must share one real bucket count).
+        Marks the table resized — the consumer re-uploads in full."""
+        return int(self._lib.nfa_grow_edges_to(self._h, int(hb_target)))
 
     # -- introspection -----------------------------------------------------
 

@@ -1080,6 +1080,12 @@ class BrokerNode:
                 autotune_reps=cfg.get("match.autotune.reps"),
                 multichip=cfg.get("match.multichip.enable"),
                 multichip_tp=cfg.get("match.multichip.tp"),
+                multichip_native=cfg.get("match.multichip.native"),
+                multichip_ep=cfg.get("match.multichip.ep.enable"),
+                multichip_ep_slack=cfg.get(
+                    "match.multichip.ep.capacity_slack"),
+                multichip_ep_micro=cfg.get(
+                    "match.multichip.ep.micro_matches"),
                 hists=self.hists,
                 flightrec=self.flightrec,
             )

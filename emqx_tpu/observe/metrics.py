@@ -171,9 +171,21 @@ MATCH_SERVE_METRIC_NAMES: List[str] = [
 # fault-injected shard, the batch fell over to the CPU trie (inc);
 # shard_restacks is the accumulated full re-upload count of the
 # stacked per-shard tables (set).
+#
+# The ep_* names cover the prefix-EP routed front end (opt-in via
+# match.multichip.ep.enable): ep_dispatches counts batches served
+# through the routed step (inc); ep_overflow_rows accumulates rows the
+# routed path failed open to the CPU trie — bucket overflow plus
+# truncation (inc, by amount); ep_shard_width is the per-shard
+# processed batch width tp*C of the last routed dispatch (set — the
+# gate_shard_width_le_batch_over_tp numerator); ep_ici_bytes
+# accumulates the analytic interconnect bill of the routing
+# all_to_all (inc, by amount).
 MULTICHIP_METRIC_NAMES: List[str] = [
     "tpu.match.shard_devices", "tpu.match.shard_dispatches",
     "tpu.match.shard_failover", "tpu.match.shard_restacks",
+    "tpu.match.ep_dispatches", "tpu.match.ep_overflow_rows",
+    "tpu.match.ep_shard_width", "tpu.match.ep_ici_bytes",
 ]
 
 # -- streaming table lifecycle (broker/match_service.py, opt-in via

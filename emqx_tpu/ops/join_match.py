@@ -22,8 +22,15 @@ probes:
   device copy — survive without a rebuild);
 * ``overlay (OVERLAY_CAP, 3) int32`` — rows ``[state, word, next]`` of
   edges added since the last rebuild: insertions cannot keep a packed
-  CSR sorted in place, so they land here (checked by a tiny vectorized
-  compare) until the next compaction folds them in.
+  CSR sorted in place, so they land here until the next compaction
+  folds them in.  The overlay itself is SORTED by (state, word) with
+  ``INT32_MAX`` sentinel rows packed at the end, so the kernel
+  resolves it with a second unrolled lower-bound search —
+  ``log2(OVERLAY_CAP)`` two-int32 gathers per (row, slot) instead of
+  the former dense 256-wide compare (ROADMAP maintenance (c): that
+  compare was O(B·A·256) on EVERY dispatch, paid even with an empty
+  overlay).  The host re-sorts on mutation and ships the overlay
+  whole (3 KB) — mutations are rare, dispatches are not.
 
 The lookup per (row, slot) is one CSR-offset gather plus an unrolled
 lower-bound binary search over the state's own segment — ``log2(E)``
@@ -70,15 +77,21 @@ from .compiler import BUCKET_SLOTS
 
 log = logging.getLogger(__name__)
 
-__all__ = ["OVERLAY_CAP", "JoinRelation", "OverlayFull", "join_match",
-           "join_match_donated", "relation_capacity", "BackendAutotuner"]
+__all__ = ["OVERLAY_CAP", "OVERLAY_EMPTY", "JoinRelation", "OverlayFull",
+           "join_match", "join_match_donated", "relation_capacity",
+           "BackendAutotuner"]
 
 #: overlay rows available between rebuilds.  Small on purpose: the
-#: kernel compares every (row, slot) against the whole overlay, so its
-#: cost rides every dispatch; a full overlay just means one rebuild
-#: (a lexsort over live edges — cheaper than the cuckoo growth path
-#: that lands in the same sync).
+#: kernel binary-searches the overlay per (row, slot), so its size
+#: rides every dispatch (log2(CAP) steps); a full overlay just means
+#: one rebuild (a lexsort over live edges — cheaper than the cuckoo
+#: growth path that lands in the same sync).
 OVERLAY_CAP = 256
+
+#: sentinel state/word for unused overlay rows: sorts AFTER every live
+#: (state, word) pair, so the lower-bound search never lands on one
+#: (and no live state or word id can ever equal it)
+OVERLAY_EMPTY = np.int32(2**31 - 1)
 
 
 def relation_capacity(hb: int) -> int:
@@ -97,11 +110,16 @@ def relation_capacity(hb: int) -> int:
 
 
 def _join_edge_lookup(state, word, state_start, edge_word, edge_next,
-                      overlay):
+                      overlay, linear_overlay: bool = False):
     """Literal-edge lookup for (B, w) (state, word) pairs against the
     sorted relation: CSR segment bounds (2 gathers) + an unrolled
     lower-bound binary search (1 int32 gather/step), then the overlay
-    intersection.  Misses and tombstones both resolve to -1."""
+    intersection — a second unrolled lower bound over the sorted
+    (state, word) overlay rows (2 int32 gathers/step, log2(CAP)
+    steps).  Misses and tombstones both resolve to -1.
+
+    ``linear_overlay`` keeps the pre-ISSUE-16 dense O(CAP) overlay
+    compare compilable as the parity oracle for the sorted search."""
     import jax.numpy as jnp
 
     E = int(edge_word.shape[0])
@@ -120,15 +138,42 @@ def _join_edge_lookup(state, word, state_start, edge_word, edge_next,
     pos = jnp.clip(lo, 0, E - 1)
     hit = (lo < hi0) & (edge_word[pos] == word)
     nxt = jnp.where(hit, edge_next[pos], -1)
-    # overlay intersection: edges added since the last rebuild.  The
-    # compare is (B, w, OVERLAY_CAP) int32 — bounded by OVERLAY_CAP,
-    # and cleared slots carry next = -1 so they never win the max.
+    # overlay intersection: edges added since the last rebuild
     o_state = overlay[:, 0]
     o_word = overlay[:, 1]
     o_next = overlay[:, 2]
-    eq = (state[..., None] == o_state[None, None, :]) & (
-        word[..., None] == o_word[None, None, :])
-    nxt_o = jnp.max(jnp.where(eq, o_next[None, None, :], -1), axis=-1)
+    if linear_overlay:
+        # dense compare, (B, w, OVERLAY_CAP) int32: the historical
+        # path, kept as the bit-parity oracle (sentinel rows never
+        # equal a live query, and their next = -1 never wins the max)
+        eq = (state[..., None] == o_state[None, None, :]) & (
+            word[..., None] == o_word[None, None, :])
+        nxt_o = jnp.max(
+            jnp.where(eq, o_next[None, None, :], -1), axis=-1)
+        return jnp.maximum(nxt, nxt_o)
+    # sorted overlay: lower-bound search on the lexicographic
+    # (state, word) order; OVERLAY_EMPTY sentinel rows pack at the
+    # end and compare greater than every live pair, so the search
+    # never resolves to one.  Inactive slots query state = -1, which
+    # compares less than every live row — lo lands at 0 and the
+    # equality check misses.
+    cap = int(o_state.shape[0])
+    osteps = max(1, cap.bit_length())
+    olo = jnp.zeros_like(state)
+    ohi = jnp.full_like(state, cap)
+    for _ in range(osteps):
+        act = olo < ohi
+        mid = (olo + ohi) >> 1
+        midc = jnp.clip(mid, 0, cap - 1)
+        ms = o_state[midc]
+        mw = o_word[midc]
+        right = act & ((ms < state) | ((ms == state) & (mw < word)))
+        olo = jnp.where(right, mid + 1, olo)
+        ohi = jnp.where(act & ~right, mid, ohi)
+    opos = jnp.clip(olo, 0, cap - 1)
+    ohit = ((olo < cap) & (o_state[opos] == state)
+            & (o_word[opos] == word))
+    nxt_o = jnp.where(ohit, o_next[opos], -1)
     return jnp.maximum(nxt, nxt_o)
 
 
@@ -146,13 +191,15 @@ def _join_match(
     max_matches: int = 32,
     compact_output: bool = True,
     flat_cap: int = 0,
+    linear_overlay: bool = False,
 ):
     from .match_kernel import nfa_walk
 
     return nfa_walk(
         words, lens, is_sys, node_tab,
         lambda st, w: _join_edge_lookup(
-            st, w, state_start, edge_word, edge_next, overlay),
+            st, w, state_start, edge_word, edge_next, overlay,
+            linear_overlay=linear_overlay),
         active_slots=active_slots, max_matches=max_matches,
         compact_output=compact_output, flat_cap=flat_cap,
     )
@@ -163,10 +210,11 @@ def _jit_pair():
 
     from .match_kernel import _MATCH_STATIC
 
-    fn = jax.jit(_join_match, static_argnames=_MATCH_STATIC)
+    statics = tuple(_MATCH_STATIC) + ("linear_overlay",)
+    fn = jax.jit(_join_match, static_argnames=statics)
     # pipelined twin: batch operands donated, table/relation arrays NOT
     # (they serve every in-flight batch) — same contract as nfa_match
-    fn_d = jax.jit(_join_match, static_argnames=_MATCH_STATIC,
+    fn_d = jax.jit(_join_match, static_argnames=statics,
                    donate_argnums=(0, 1, 2))
     return fn, fn_d
 
@@ -199,9 +247,13 @@ class JoinRelation:
         self.shadow = np.array(edge_tab, np.int32, copy=True)
         hb = int(edge_tab.shape[0])
         self.cap = relation_capacity(hb)
-        self.overlay = np.full((OVERLAY_CAP, 3), -1, np.int32)
-        self._o_free: List[int] = list(range(OVERLAY_CAP - 1, -1, -1))
-        self._o_pos: Dict[Tuple[int, int], int] = {}
+        # overlay edges keyed (state, word); the materialized array is
+        # kept SORTED (sentinel rows at the end) so the kernel's
+        # lower-bound search stays valid — any mutation re-sorts and
+        # ships the whole 3 KB array
+        self.overlay = np.empty((OVERLAY_CAP, 3), np.int32)
+        self._o_map: Dict[Tuple[int, int], int] = {}
+        self._materialize_overlay()
         if arrays is not None:
             start, word, nxt = arrays
             self.state_start = np.array(start, np.int32, copy=True)
@@ -233,9 +285,19 @@ class JoinRelation:
         self.state_start = start
         self.edge_word = word
         self.edge_next = nxt
-        self.overlay[:] = -1
-        self._o_free = list(range(OVERLAY_CAP - 1, -1, -1))
-        self._o_pos = {}
+        self._o_map = {}
+        self._materialize_overlay()
+
+    def _materialize_overlay(self) -> None:
+        """Re-sort the overlay rows by (state, word); unused rows pack
+        at the end as OVERLAY_EMPTY sentinels (they must compare
+        GREATER than every live pair for the device lower bound)."""
+        self.overlay[:, 0] = OVERLAY_EMPTY
+        self.overlay[:, 1] = OVERLAY_EMPTY
+        self.overlay[:, 2] = -1
+        if self._o_map:
+            rows = [(s, w, n) for (s, w), n in sorted(self._o_map.items())]
+            self.overlay[:len(rows)] = np.asarray(rows, np.int32)
 
     # -- queries -----------------------------------------------------------
 
@@ -249,10 +311,7 @@ class JoinRelation:
         pos = self._csr_find(s, w)
         if pos is not None and self.edge_next[pos] >= 0:
             return int(self.edge_next[pos])
-        slot = self._o_pos.get((s, w))
-        if slot is not None and self.overlay[slot, 2] >= 0:
-            return int(self.overlay[slot, 2])
-        return -1
+        return self._o_map.get((s, w), -1)
 
     def _csr_find(self, s: int, w: int) -> Optional[int]:
         start = self.state_start
@@ -281,9 +340,12 @@ class JoinRelation:
 
         Returns ``(main_pos, main_val, olay_pos, olay_rows)`` numpy
         arrays (possibly empty): ``edge_next[main_pos] = main_val`` and
-        ``overlay[olay_pos] = olay_rows``.  Raises :class:`OverlayFull`
-        when an insertion finds no overlay slot — the caller rebuilds
-        (the shadow is ALREADY updated, so ``rebuild()`` is enough)."""
+        ``overlay[olay_pos] = olay_rows``.  Any overlay mutation
+        re-sorts and returns the WHOLE overlay (sortedness is the
+        device search's invariant; 3 KB per rare mutation beats 256
+        compares per dispatch).  Raises :class:`OverlayFull` when an
+        insertion finds no overlay slot — the caller rebuilds (the
+        shadow is ALREADY updated, so ``rebuild()`` is enough)."""
         if len(bucket_idx) and int(bucket_idx.max()) >= len(self.shadow):
             # shadow shape drift (a resize the caller didn't route
             # through rebuild()): force the rebuild path rather than
@@ -310,13 +372,10 @@ class JoinRelation:
             del removed[k]
         main_pos: List[int] = []
         main_val: List[int] = []
-        olay: Dict[int, Tuple[int, int, int]] = {}
+        o_dirty = False
         for (s, w) in removed:
-            slot = self._o_pos.pop((s, w), None)
-            if slot is not None:
-                self.overlay[slot] = (-1, -1, -1)
-                self._o_free.append(slot)
-                olay[slot] = (-1, -1, -1)
+            if self._o_map.pop((s, w), None) is not None:
+                o_dirty = True
                 continue
             pos = self._csr_find(s, w)
             if pos is None:  # shadow/relation drift: force a rebuild
@@ -331,21 +390,24 @@ class JoinRelation:
                 main_pos.append(pos)
                 main_val.append(nv)
                 continue
-            slot = self._o_pos.get((s, w))
-            if slot is None:
-                if not self._o_free:
-                    raise OverlayFull(
-                        f"overlay full ({OVERLAY_CAP} rows)")
-                slot = self._o_free.pop()
-                self._o_pos[(s, w)] = slot
-            self.overlay[slot] = (s, w, nv)
-            olay[slot] = (s, w, nv)
+            if (s, w) not in self._o_map and \
+                    len(self._o_map) >= OVERLAY_CAP:
+                raise OverlayFull(f"overlay full ({OVERLAY_CAP} rows)")
+            if self._o_map.get((s, w)) != nv:
+                self._o_map[(s, w)] = nv
+                o_dirty = True
+        if o_dirty:
+            self._materialize_overlay()
+            olay_pos = np.arange(OVERLAY_CAP, dtype=np.int32)
+            olay_rows = self.overlay.copy()
+        else:
+            olay_pos = np.empty(0, np.int32)
+            olay_rows = np.empty((0, 3), np.int32)
         return (
             np.asarray(main_pos, np.int32),
             np.asarray(main_val, np.int32),
-            np.asarray(sorted(olay), np.int32),
-            np.asarray([olay[i] for i in sorted(olay)],
-                       np.int32).reshape(-1, 3),
+            olay_pos,
+            olay_rows,
         )
 
     def grow_states(self, new_s: int) -> None:

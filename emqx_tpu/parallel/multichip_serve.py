@@ -1,5 +1,6 @@
 """Multichip serve backend: the match TABLE sharded by topic-prefix
-over the mesh, serving real publish traffic (ISSUE 15).
+over the mesh, serving real publish traffic (ISSUE 15, extended to the
+100M-filter regime in ISSUE 16).
 
 Every 8-device configuration in MULTICHIP_r05 passed dry runs with
 parity checks, but the serving path was capped at one chip's table.
@@ -11,19 +12,39 @@ the **table itself shards** — each ``tp`` shard owns the filters whose
 root token hashes to it, so 8 chips hold 8× the filters:
 
 * ``dp`` — publish-batch rows (each chip matches its slice, zero comms);
-* ``tp`` — table shards; the batch is **fanned** (replicated) over this
-  axis and every shard walks its OWN subtable;
+* ``tp`` — table shards.  In the default **replicated** mode the batch
+  is fanned over this axis and every shard walks its OWN subtable; in
+  **EP-routed** mode (``match.multichip.ep.enable``, the
+  ``prefix_ep.py`` dryrun promoted to serving) each row is bucketed by
+  its ROOT-token owner and ``all_to_all``-routed only to the one shard
+  that can match it — per-shard batch width drops from ``B/dp`` to
+  ``slack·B/(dp·tp)`` for literal-rooted tables, and ICI traffic with
+  it.  Bucket overflow (a hot root skewing one owner) fails open to
+  the CPU trie exactly like the dead-shard path;
+* **wildcard-root micro-table** — ``+``/``#``-first filters would
+  crc32-hash to one arbitrary shard and break single-owner routing;
+  they live instead in a small table replicated to every device and
+  merged into the owning shard's answer segment (shard 0's in
+  replicated mode), so EP answers stay complete and a hot wildcard
+  set can't skew one shard;
 * per-shard matches map through a local→service accept-id table and
   leave the mesh as the **dense compact contract**
   (:class:`~emqx_tpu.parallel.sharded_match.CompactFanoutResult`):
   per-row id segments in disjoint per-shard order, concat-no-dedup,
   decoded by the same :func:`decode_compact_rows` the bitmap
   compaction path uses — what crosses the wire is proportional to
-  MATCHES, never to table width, so the ring/ICI traffic is dense end
-  to end (ROADMAP dispatch-tax residual (d));
-* per-row truncation/active-set spills are ``psum``'d over ``tp``
-  (the fail-open set — the host re-runs exactly those rows on the CPU
-  trie, the single-chip spill contract unchanged).
+  MATCHES, never to table width (ROADMAP dispatch-tax residual (d));
+* per-row truncation/active-set/bucket-overflow spills are ``psum``'d
+  over ``tp`` (the fail-open set — the host re-runs exactly those rows
+  on the CPU trie, the single-chip spill contract unchanged).
+
+Shard subtables are **native** (``native/nfa.cpp``) when the toolchain
+built the .so — per-shard capacity then matches the single-chip native
+table (10M filters, BENCH_r03/r05), putting ``tp × 10M`` within one
+mesh.  Every subtable (and the micro-table) interns the SAME word
+sequence, so all vocabs stay identical to the shared encode vocab by
+construction (ids assign append-only).  The Python ``IncrementalNfa``
+path remains as the no-toolchain fallback (one literally shared dict).
 
 Maintenance rides the existing drain/apply cycle: the service's
 ``_table_add``/``_table_del`` seams note filter mutations here, the
@@ -33,17 +54,18 @@ restack only on a resize — the DeviceNfa discipline), and a compaction
 swap rebuilds the whole partition from the fresh aid space.
 
 Failure semantics: a dead (``kill_shard``) or fault-injected
-(``match.shard`` point) shard raises at dispatch — the affected batch
-fails over to the CPU trie through the serve plane's existing
-device-failure paths (breaker strike in deadline mode, probe recovery,
-stale-slot discards stay strike-free), exactly like any other device
-failure.
+(``match.shard`` point; ``ep.route`` for the routed front end) shard
+raises at dispatch — the affected batch fails over to the CPU trie
+through the serve plane's existing device-failure paths (breaker
+strike in deadline mode, probe recovery, stale-slot discards stay
+strike-free), exactly like any other device failure.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import math
 import os
 import threading
 import zlib
@@ -56,13 +78,14 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .. import faultinject as _fi
+from .. import topic as T
 from ._shard_compat import shard_map
 from .sharded_match import CompactFanoutResult, decode_compact_rows
 
 log = logging.getLogger(__name__)
 
 __all__ = ["MultichipMatcher", "ShardDead", "build_multichip_step",
-           "serve_mesh_shape", "shard_of_filter"]
+           "serve_mesh_shape", "shard_of_filter", "is_micro_filter"]
 
 
 class ShardDead(RuntimeError):
@@ -85,10 +108,18 @@ def serve_mesh_shape(n_devices: int, tp: int = 0) -> Dict[str, int]:
 def shard_of_filter(flt: str, tp: int) -> int:
     """Topic-prefix partition: a filter lives on the shard its ROOT
     token hashes to.  Wildcard roots (``+``/``#``) hash their literal
-    token — ownership is arbitrary for them (every topic visits every
-    shard), it only has to be deterministic."""
+    token here too (deterministic), but the matcher diverts them to
+    the replicated micro-table (:func:`is_micro_filter`) — a filter
+    every topic can match has no single owner under EP routing."""
     root = flt.split("/", 1)[0]
     return zlib.crc32(root.encode("utf-8")) % tp
+
+
+def is_micro_filter(flt: str) -> bool:
+    """Wildcard-root filters (``+``/``#`` first token) match topics
+    with ANY root — they live in the replicated micro-table, merged
+    into the owning shard's answer segment."""
+    return flt.split("/", 1)[0] in ("+", "#")
 
 
 @partial(jax.jit, donate_argnums=(0,))
@@ -101,22 +132,51 @@ def _scatter_stacked(tab, tvec, idx, rows):
 
 
 def build_multichip_step(mesh, active_slots: int = 16,
-                         max_matches: int = 32):
+                         max_matches: int = 32, micro_matches: int = 8,
+                         routed: bool = False, capacity: int = 0):
     """Return a jitted ``step(words, lens, is_sys, node_stk, edge_stk,
-    seeds_stk, aid_stk) -> CompactFanoutResult``.
+    seeds_stk, aid_stk, micro_node, micro_edge, micro_seeds,
+    micro_amap, word_owner) -> CompactFanoutResult``.
 
     Input layouts: batch arrays sharded over ``dp`` (replicated —
     *fanned* — over ``tp``); the stacked per-shard tables
     ``node_stk (tp, S, 4)``, ``edge_stk (tp, Hb, slots·4)``,
     ``seeds_stk (tp, 2)`` and the local→service accept-id map
-    ``aid_stk (tp, A)`` sharded over ``tp``.  Output ``ids`` is the
-    dense compact contract: (B, tp·K) service accept ids, -1 padded,
-    per-shard segments disjoint by partition construction; ``counts``
-    (B, tp); ``overflow`` (B, tp) per-segment truncation; the spill
-    vectors psum over ``tp``."""
+    ``aid_stk (tp, A)`` sharded over ``tp``; the wildcard-root
+    micro-table arrays and the root-token ``word_owner`` routing map
+    fully replicated.  Output ``ids`` is the dense compact contract:
+    (B, tp·(K+Km)) service accept ids, -1 padded, per-shard segments
+    disjoint by partition construction; ``counts`` (B, tp); the spill
+    vectors psum over ``tp``.
+
+    ``routed=True`` compiles the EP front end: each ``tp`` instance
+    takes its 1/tp source slice of the dp-local batch, buckets rows
+    by ``word_owner[root]`` into a (tp, ``capacity``) grid, and one
+    ``all_to_all`` lands every row on the single shard that owns its
+    root.  The owner merges its own + micro answers into ITS segment
+    (other segments stay count-0 for that row), so no return
+    ``all_to_all`` is needed.  Rows past ``capacity`` fail open
+    (match_overflow) at the source."""
     from ..ops.match_kernel import nfa_match
 
     K = max_matches
+    Km = micro_matches
+    W = K + Km
+    tp = mesh.shape["tp"]
+    C = capacity
+
+    def merge_micro(gids, cnt_own, mg, mcnt):
+        """Pack ``mcnt`` micro ids behind each row's ``cnt_own`` own
+        ids — decode_compact_rows prefix-takes ``count`` entries per
+        segment, so the merged segment must be contiguous from 0."""
+        R = gids.shape[0]
+        out = jnp.full((R, W), -1, jnp.int32).at[:, :K].set(gids)
+        pos = cnt_own[:, None] + jnp.arange(Km, dtype=jnp.int32)[None, :]
+        pos = jnp.where(
+            jnp.arange(Km, dtype=jnp.int32)[None, :] < mcnt[:, None],
+            pos, W)
+        out = out.at[jnp.arange(R)[:, None], pos].set(mg, mode="drop")
+        return out, cnt_own + mcnt
 
     @partial(
         shard_map,
@@ -129,6 +189,11 @@ def build_multichip_step(mesh, active_slots: int = 16,
             P("tp", None, None),  # edge_stk
             P("tp", None),        # seeds_stk
             P("tp", None),        # aid_stk
+            P(None, None),        # micro_node (replicated)
+            P(None, None),        # micro_edge
+            P(None),              # micro_seeds
+            P(None),              # micro_amap
+            P(None),              # word_owner
         ),
         out_specs=CompactFanoutResult(
             ids=P("dp", "tp"),
@@ -140,22 +205,126 @@ def build_multichip_step(mesh, active_slots: int = 16,
         ),
         check_vma=False,
     )
-    def step(words, lens, is_sys, node_stk, edge_stk, seeds_stk, aid_stk):
+    def step(words, lens, is_sys, node_stk, edge_stk, seeds_stk, aid_stk,
+             micro_node, micro_edge, micro_seeds, micro_amap, word_owner):
         node, edge, seeds, amap = (
             node_stk[0], edge_stk[0], seeds_stk[0], aid_stk[0])
-        res = nfa_match(
-            words, lens, is_sys, node, edge, seeds,
-            active_slots=active_slots, max_matches=K,
-        )
-        m = res.matches                                  # (Bl, K) local
-        gids = jnp.where(m >= 0, amap[jnp.maximum(m, 0)], -1)
+
+        def match_both(w, l, s):
+            res = nfa_match(
+                w, l, s, node, edge, seeds,
+                active_slots=active_slots, max_matches=K,
+            )
+            gids = jnp.where(
+                res.matches >= 0, amap[jnp.maximum(res.matches, 0)], -1)
+            mres = nfa_match(
+                w, l, s, micro_node, micro_edge, micro_seeds,
+                active_slots=active_slots, max_matches=Km,
+            )
+            mg = jnp.where(
+                mres.matches >= 0,
+                micro_amap[jnp.maximum(mres.matches, 0)], -1)
+            return res, gids, mres, mg
+
+        if not routed:
+            res, gids, mres, mg = match_both(words, lens, is_sys)
+            # segments must stay DISJOINT per row: exactly one shard
+            # (the first) merges the replicated micro answers
+            is0 = jax.lax.axis_index("tp") == 0
+            mcnt = jnp.where(is0, jnp.minimum(mres.n_matches, Km), 0)
+            ids, cnt = merge_micro(
+                gids, jnp.minimum(res.n_matches, K), mg, mcnt)
+            seg_ov = (res.match_overflow
+                      + jnp.where(is0, mres.match_overflow, 0))
+            return CompactFanoutResult(
+                ids=ids,
+                counts=cnt[:, None],
+                overflow=seg_ov[:, None],
+                n_matches=jax.lax.psum(
+                    res.n_matches + jnp.where(is0, mres.n_matches, 0),
+                    "tp"),
+                active_overflow=jax.lax.psum(
+                    res.active_overflow
+                    + jnp.where(is0, mres.active_overflow, 0), "tp"),
+                match_overflow=jax.lax.psum(seg_ov, "tp"),
+            )
+
+        # -- EP-routed front end ----------------------------------------
+        Bl, D = words.shape
+        i = jax.lax.axis_index("tp")
+        Bs = Bl // tp
+        start = i * Bs
+        myw = jax.lax.dynamic_slice_in_dim(words, start, Bs)
+        myl = jax.lax.dynamic_slice_in_dim(lens, start, Bs)
+        mys = jax.lax.dynamic_slice_in_dim(is_sys, start, Bs)
+        root = jnp.clip(myw[:, 0], 0, word_owner.shape[0] - 1)
+        owner = word_owner[root]                            # (Bs,) in [0,tp)
+        routable = myl <= D          # encode pads with the D+2 sentinel
+        # rank within each owner group (cumsum compaction, prefix_ep)
+        onehot = ((owner[:, None] == jnp.arange(tp)[None, :])
+                  & routable[:, None])
+        rank = jnp.cumsum(onehot.astype(jnp.int32), axis=0) - 1
+        my_rank = jnp.take_along_axis(rank, owner[:, None], axis=1)[:, 0]
+        keep = routable & (my_rank < C)
+        bucket_ov = (routable & (my_rank >= C)).astype(jnp.int32)
+        # overflowed/pad rows must scatter NOWHERE (an in-range dummy
+        # slot would clobber a legitimate row): route them out of range
+        # and let mode="drop" discard the write
+        owner_idx = jnp.where(keep, owner, tp)
+        slot = jnp.where(keep, my_rank, 0)
+        grid_w = jnp.zeros((tp, C, D), jnp.int32).at[owner_idx, slot].set(
+            myw, mode="drop")
+        grid_l = jnp.full((tp, C), D + 2, jnp.int32).at[
+            owner_idx, slot].set(myl, mode="drop")
+        grid_s = jnp.ones((tp, C), bool).at[owner_idx, slot].set(
+            mys, mode="drop")
+        grid_src = jnp.full((tp, C), -1, jnp.int32).at[
+            owner_idx, slot].set(
+                jnp.arange(Bs, dtype=jnp.int32), mode="drop")
+
+        # ragged all-to-all: (owner, C, ...) leaves, (source, C, ...)
+        # lands — each shard now holds exactly the rows it owns
+        w2 = jax.lax.all_to_all(grid_w, "tp", 0, 0, tiled=False)
+        l2 = jax.lax.all_to_all(grid_l, "tp", 0, 0, tiled=False)
+        s2 = jax.lax.all_to_all(grid_s, "tp", 0, 0, tiled=False)
+        src2 = jax.lax.all_to_all(grid_src, "tp", 0, 0, tiled=False)
+
+        R = tp * C
+        res, gids, mres, mg = match_both(
+            w2.reshape(R, D), l2.reshape(R), s2.reshape(R))
+        # the owner is the ONLY shard seeing this row: merge micro here
+        merged, merged_cnt = merge_micro(
+            gids, jnp.minimum(res.n_matches, K),
+            mg, jnp.minimum(mres.n_matches, Km))
+
+        # scatter into MY output segment at the row's dp-local position
+        # (source j's slice starts at j*Bs); no return all_to_all —
+        # other shards' segments stay count-0 for rows they don't own
+        flat_src = src2.reshape(R)
+        pos = (jnp.arange(tp, dtype=jnp.int32)[:, None] * Bs
+               + src2).reshape(R)
+        safe = jnp.where(flat_src >= 0, pos, Bl)
+        ids_out = jnp.full((Bl, W), -1, jnp.int32).at[safe].set(
+            merged, mode="drop")
+        cnt_out = jnp.zeros((Bl,), jnp.int32).at[safe].set(
+            merged_cnt, mode="drop")
+        seg_ov = jnp.zeros((Bl,), jnp.int32).at[safe].set(
+            res.match_overflow + mres.match_overflow, mode="drop")
+        nm = jnp.zeros((Bl,), jnp.int32).at[safe].set(
+            res.n_matches + mres.n_matches, mode="drop")
+        ao = jnp.zeros((Bl,), jnp.int32).at[safe].set(
+            res.active_overflow + mres.active_overflow, mode="drop")
+        # source-side bucket overflow flags MY slice's rows: psum folds
+        # them into the fail-open set alongside owner-side truncation
+        src_ov = jax.lax.dynamic_update_slice(
+            jnp.zeros((Bl,), jnp.int32), bucket_ov, (start,))
         return CompactFanoutResult(
-            ids=gids,
-            counts=jnp.minimum(res.n_matches, K)[:, None],
-            overflow=res.match_overflow[:, None],
-            n_matches=jax.lax.psum(res.n_matches, "tp"),
-            active_overflow=jax.lax.psum(res.active_overflow, "tp"),
-            match_overflow=jax.lax.psum(res.match_overflow, "tp"),
+            ids=ids_out,
+            counts=cnt_out[:, None],
+            overflow=seg_ov[:, None],
+            n_matches=jax.lax.psum(nm, "tp"),
+            active_overflow=jax.lax.psum(ao, "tp"),
+            match_overflow=jax.lax.psum(seg_ov + src_ov, "tp"),
         )
 
     return jax.jit(step)
@@ -163,8 +332,9 @@ def build_multichip_step(mesh, active_slots: int = 16,
 
 class MultichipMatcher:
     """Host side of the multichip serve backend: per-shard subtables
-    (shared vocab, one encode serves every shard), the stacked device
-    twin, and the mesh-compiled step cache.
+    (identical vocabs, one encode serves every shard), the wildcard
+    micro-table, the stacked device twin, and the mesh-compiled step
+    cache.
 
     Threading model (the MatchService discipline): ``note_add``/
     ``note_del``/``rebuild`` run on the event loop and only append to a
@@ -174,7 +344,7 @@ class MultichipMatcher:
     captures one consistent (arrays, aid map) snapshot under the lock.
     """
 
-    MANIFEST_VERSION = 1
+    MANIFEST_VERSION = 2
     #: serve-plane dispatch routing marker (MatchService checks this
     #: instead of importing the class on its hot path)
     is_multichip = True
@@ -188,6 +358,10 @@ class MultichipMatcher:
         max_matches: int = 32,
         metrics: Any = None,
         kernel_cache: Any = None,
+        native: bool = True,
+        ep: bool = False,
+        ep_slack: float = 2.0,
+        ep_micro_matches: int = 8,
     ) -> None:
         from .mesh import make_mesh
 
@@ -202,6 +376,17 @@ class MultichipMatcher:
         self.max_matches = max_matches
         self.metrics = metrics
         self.kernel_cache = kernel_cache
+        self.ep = bool(ep)
+        self.ep_slack = float(ep_slack)
+        self.ep_micro_matches = int(ep_micro_matches)
+        if native:
+            from ..native.nfa import available
+
+            native = available()
+            if not native:
+                log.warning("native nfa unavailable; multichip shard "
+                            "subtables fall back to IncrementalNfa")
+        self.native = bool(native)
         if kernel_cache is not None:
             # mesh-keyed executables compile through the shared cache
             # (CompileMiss semantics, zero-compile prewarm spies)
@@ -210,18 +395,26 @@ class MultichipMatcher:
         self.vocab: Dict[str, int] = {}
         self._subs: List[Any] = []
         self._aid_maps: List[np.ndarray] = []
+        self._filters: List[Dict[str, int]] = []
+        self._micro: Any = None
+        self._micro_amap: np.ndarray = np.full(8, -1, np.int32)
+        self._micro_filters: Dict[str, int] = {}
+        self._word_owner = np.zeros(1024, np.int32)
+        self._word_owner_n = 0
         self._reset_subs()
 
         self._lock = threading.Lock()
         self._pending: List[Tuple[str, str, int]] = []  # (op, flt, aid)
         self._rebuild_pairs: Optional[List[Tuple[str, int]]] = None
         self._restack_due = False      # segment restore awaiting upload
-        self._arrs: Optional[Tuple[Any, Any, Any, Any]] = None
-        self._stacked_shape: Optional[Tuple[int, int, int]] = None
-        self._steps: Dict[Tuple[int, int], Any] = {}
+        self._arrs: Optional[Tuple[Any, ...]] = None
+        self._stacked_shape: Optional[Tuple[int, ...]] = None
+        self._steps: Dict[Tuple[int, int, bool], Any] = {}
+        self._routed_live: set = set()  # id(res) of in-flight EP handles
         self._dead: set = set()
         self.gen = 0                    # bumped on every restack
         self.dispatches = 0
+        self.ep_dispatches = 0
         self.failovers = 0
         self.applies = 0
         self.restacks = 0
@@ -234,19 +427,36 @@ class MultichipMatcher:
     # partition maintenance (event loop: enqueue; worker thread: apply)
     # ------------------------------------------------------------------
 
-    def _reset_subs(self) -> None:
+    def _new_sub(self):
+        if self.native:
+            from ..native.nfa import NativeNfa
+
+            return NativeNfa(depth=self.depth)
         from ..ops.incremental import IncrementalNfa
 
+        sub = IncrementalNfa(depth=self.depth)
+        # one vocab dict shared by every subtable: a single encode
+        # pass serves all shards (interning appends consistently)
+        sub.vocab = self.vocab
+        return sub
+
+    def _reset_subs(self) -> None:
         self.vocab = {}
         self._subs = []
         self._aid_maps = []
+        self._filters = []
+        self._word_owner = np.zeros(1024, np.int32)
+        self._word_owner_n = 0
         for _ in range(self.tp):
-            sub = IncrementalNfa(depth=self.depth)
-            # one vocab dict shared by every subtable: a single encode
-            # pass serves all shards (interning appends consistently)
-            sub.vocab = self.vocab
-            self._subs.append(sub)
+            self._subs.append(self._new_sub())
             self._aid_maps.append(np.full(64, -1, np.int32))
+            self._filters.append({})
+        self._micro = self._new_sub()
+        self._micro_amap = np.full(8, -1, np.int32)
+        self._micro_filters = {}
+
+    def _all_tables(self) -> List[Any]:
+        return [*self._subs, self._micro]
 
     def note_add(self, flt: str, service_aid: int) -> None:
         with self._lock:
@@ -277,7 +487,35 @@ class MultichipMatcher:
         return (bool(self._pending) or self._rebuild_pairs is not None
                 or self._restack_due)
 
+    def _intern_filter_words(self, flt: str) -> None:
+        """Intern the filter's literal words into the shared encode
+        vocab AND every subtable (native vocabs are per-table; ids
+        assign append-only, so replaying one word sequence everywhere
+        keeps them all identical — the EP word_owner map and the
+        stacked edge tables then agree with encode_batch)."""
+        for w in T.words(flt):
+            if w in ("+", "#") or w in self.vocab:
+                continue
+            wid = len(self.vocab) + 1
+            self.vocab[w] = wid
+            if self.native:
+                for tbl in self._all_tables():
+                    tbl.intern(w)
+
     def _host_add(self, flt: str, service_aid: int) -> None:
+        self._intern_filter_words(flt)
+        if is_micro_filter(flt):
+            sub = self._micro
+            sub.add(flt)
+            laid = sub.aid_of(flt)
+            amap = self._micro_amap
+            if laid >= len(amap):
+                grown = np.full(max(2 * len(amap), laid + 1), -1, np.int32)
+                grown[:len(amap)] = amap
+                amap = self._micro_amap = grown
+            amap[laid] = service_aid
+            self._micro_filters[flt] = service_aid
+            return
         t = shard_of_filter(flt, self.tp)
         sub = self._subs[t]
         sub.add(flt)
@@ -288,8 +526,17 @@ class MultichipMatcher:
             grown[:len(amap)] = amap
             amap = self._aid_maps[t] = grown
         amap[laid] = service_aid
+        self._filters[t][flt] = service_aid
 
     def _host_del(self, flt: str) -> None:
+        if is_micro_filter(flt):
+            laid = self._micro.aid_of(flt)
+            if laid < 0:
+                return
+            self._micro_amap[laid] = -1
+            self._micro.remove(flt)
+            self._micro_filters.pop(flt, None)
+            return
         t = shard_of_filter(flt, self.tp)
         sub = self._subs[t]
         laid = sub.aid_of(flt)
@@ -297,6 +544,26 @@ class MultichipMatcher:
             return
         self._aid_maps[t][laid] = -1
         sub.remove(flt)
+        self._filters[t].pop(flt, None)
+
+    def _sync_word_owner(self) -> bool:
+        """Fill routing owners (crc32(word) % tp — the device twin of
+        :func:`shard_of_filter`) for vocab words interned since the
+        last sync; pow2 growth.  Returns True when entries changed."""
+        n = len(self.vocab)
+        if self._word_owner_n >= n:
+            return False
+        cap = len(self._word_owner)
+        if n + 1 > cap:
+            while cap < n + 1:
+                cap *= 2
+            grown = np.zeros(cap, np.int32)
+            grown[:len(self._word_owner)] = self._word_owner
+            self._word_owner = grown
+        for w, wid in list(self.vocab.items())[self._word_owner_n:]:
+            self._word_owner[wid] = zlib.crc32(w.encode("utf-8")) % self.tp
+        self._word_owner_n = n
+        return True
 
     def apply_pending(self) -> bool:
         """WORKER-THREAD step (the sync loop's ``to_thread`` hop):
@@ -311,6 +578,18 @@ class MultichipMatcher:
             restack_due, self._restack_due = self._restack_due, False
         if rebuild is not None:
             self._reset_subs()
+            if self.native:
+                # pre-intern the whole word sequence with one native
+                # call per table (the bulk-build fast path; per-filter
+                # interning would pay tp+1 ctypes hops per word)
+                words: List[str] = []
+                for flt, _aid in rebuild:
+                    for w in T.words(flt):
+                        if w not in ("+", "#") and w not in self.vocab:
+                            self.vocab[w] = len(self.vocab) + 1
+                            words.append(w)
+                for tbl in self._all_tables():
+                    tbl.bulk_intern(words)
             for flt, aid in rebuild:
                 self._host_add(flt, aid)
             # notes enqueued AFTER the rebuild request (rebuild()
@@ -322,8 +601,8 @@ class MultichipMatcher:
                     self._host_add(flt, aid)
                 else:
                     self._host_del(flt)
-            for sub in self._subs:
-                sub.flush()     # clear dirty sets; restack ships all
+            for tbl in self._all_tables():
+                tbl.flush()     # clear dirty sets; restack ships all
             self._restack()
             self._persist_due = True
             return True
@@ -340,9 +619,11 @@ class MultichipMatcher:
             else:
                 self._host_del(flt)
         deltas = [sub.flush() for sub in self._subs]
+        mdelta = self._micro.flush()
+        wo_changed = self._sync_word_owner()
         shape = self._required_shape()
         if (self._arrs is None or self._stacked_shape != shape
-                or any(d.resized for d in deltas)):
+                or any(d.resized for d in deltas) or mdelta.resized):
             self._restack()
             return True
         from ..ops.device_table import _chunks
@@ -351,7 +632,9 @@ class MultichipMatcher:
         # the whole read-modify-publish so a concurrent dispatch never
         # captures a donated-away array
         with self._lock:
-            node_stk, edge_stk, seeds_stk, _ = self._arrs
+            (node_stk, edge_stk, seeds_stk, _aid_stk,
+             micro_node, micro_edge, micro_seeds, micro_amap,
+             word_owner) = self._arrs
             for t, d in enumerate(deltas):
                 if d.empty:
                     continue
@@ -364,21 +647,55 @@ class MultichipMatcher:
                         edge_stk, jnp.full(idx.shape, t, jnp.int32),
                         jnp.asarray(idx), jnp.asarray(rows))
             aid_stk = jnp.asarray(self._stacked_aid_maps(shape[2]))
-            self._arrs = (node_stk, edge_stk, seeds_stk, aid_stk)
+            if not mdelta.empty:
+                # the micro-table is small and replicated: a dirty
+                # micro ships as a full (fresh-array) upload
+                mn, me, ms = self._table_arrays(self._micro)
+                micro_node = jnp.asarray(mn)
+                micro_edge = jnp.asarray(me)
+                micro_seeds = jnp.asarray(ms)
+            if not mdelta.empty or wo_changed:
+                micro_amap = jnp.asarray(
+                    self._padded_micro_amap(shape[5]))
+                word_owner = jnp.asarray(self._word_owner)
+            self._arrs = (node_stk, edge_stk, seeds_stk, aid_stk,
+                          micro_node, micro_edge, micro_seeds,
+                          micro_amap, word_owner)
         self.applies += 1
         return True
 
-    def _required_shape(self) -> Tuple[int, int, int]:
-        """Common stacked (S, Hb, A_cap): node tables pad (states index
-        directly — pad rows are unreachable), edge tables must SHARE a
-        real bucket count (lookups hash modulo Hb), aid maps pad."""
-        smax = max(sub.S for sub in self._subs)
-        hbmax = max(sub.Hb for sub in self._subs)
+    @staticmethod
+    def _table_shape(sub) -> Tuple[int, int]:
+        """(S, Hb) for either table implementation."""
+        if hasattr(sub, "node_tab"):
+            return int(sub.S), int(sub.Hb)
+        s, hb, _depth = sub.shape_key()
+        return int(s), int(hb)
+
+    @staticmethod
+    def _table_arrays(sub):
+        """(node_tab, edge_tab, seeds) for either table implementation."""
+        if hasattr(sub, "node_tab"):
+            return sub.node_tab, sub.edge_tab, sub.seeds
+        return sub.tables()
+
+    def _required_shape(self) -> Tuple[int, int, int, int, int, int, int]:
+        """Common stacked (S, Hb, A_cap) plus the replicated shapes
+        (micro S, micro Hb, micro A_cap, word_owner cap): node tables
+        pad (states index directly — pad rows are unreachable), edge
+        tables must SHARE a real bucket count (lookups hash modulo
+        Hb), aid maps pad."""
+        smax = max(self._table_shape(sub)[0] for sub in self._subs)
+        hbmax = max(self._table_shape(sub)[1] for sub in self._subs)
         acap = 64
         for amap in self._aid_maps:
             while acap < len(amap):
                 acap *= 2
-        return smax, hbmax, acap
+        sm, hbm = self._table_shape(self._micro)
+        am = 8
+        while am < len(self._micro_amap):
+            am *= 2
+        return (smax, hbmax, acap, sm, hbm, am, len(self._word_owner))
 
     def _stacked_aid_maps(self, acap: int) -> np.ndarray:
         out = np.full((self.tp, acap), -1, np.int32)
@@ -386,32 +703,49 @@ class MultichipMatcher:
             out[t, :len(amap)] = amap
         return out
 
+    def _padded_micro_amap(self, am: int) -> np.ndarray:
+        out = np.full(am, -1, np.int32)
+        out[:len(self._micro_amap)] = self._micro_amap
+        return out
+
     def _restack(self) -> None:
-        """Full re-upload of the stacked per-shard tables.  Smaller
-        shards grow their edge table to the common Hb (hash-correct —
-        a padded edge table would probe modulo the wrong size), node
-        tables pad with inert rows."""
-        hbmax = max(sub.Hb for sub in self._subs)
+        """Full re-upload of the stacked per-shard tables (+ the
+        replicated micro/word_owner arrays).  Smaller shards grow
+        their edge table to the common Hb (hash-correct — a padded
+        edge table would probe modulo the wrong size), node tables pad
+        with inert rows."""
+        hbmax = max(self._table_shape(sub)[1] for sub in self._subs)
         for sub in self._subs:
-            while sub.Hb < hbmax:
-                sub._grow_edges()
-            sub.flush()         # growth marked dirty; the restack ships all
+            if hasattr(sub, "grow_edges_to"):
+                sub.grow_edges_to(hbmax)
+            else:
+                while sub.Hb < hbmax:
+                    sub._grow_edges()
+            sub.flush()     # growth marked dirty; the restack ships all
+        self._micro.flush()
+        self._sync_word_owner()
         shape = self._required_shape()
-        smax, hbmax, acap = shape
-        nodes = []
+        smax, hbmax, acap, _sm, _hbm, am, _wcap = shape
+        nodes, edges, seeds = [], [], []
         for sub in self._subs:
+            node, edge, sd = self._table_arrays(sub)
             tab = np.full((smax, 4), -1, np.int32)
             tab[:, 3] = 0
-            tab[:sub.S] = sub.node_tab
+            tab[:node.shape[0]] = node
             nodes.append(tab)
+            edges.append(edge)
+            seeds.append(sd)
         node_stk = jnp.asarray(np.stack(nodes))
-        edge_stk = jnp.asarray(np.stack(
-            [sub.edge_tab for sub in self._subs]))
-        seeds_stk = jnp.asarray(np.stack(
-            [sub.seeds for sub in self._subs]))
+        edge_stk = jnp.asarray(np.stack(edges))
+        seeds_stk = jnp.asarray(np.stack(seeds))
         aid_stk = jnp.asarray(self._stacked_aid_maps(acap))
+        mn, me, ms = self._table_arrays(self._micro)
+        arrs = (node_stk, edge_stk, seeds_stk, aid_stk,
+                jnp.asarray(mn), jnp.asarray(me), jnp.asarray(ms),
+                jnp.asarray(self._padded_micro_amap(am)),
+                jnp.asarray(self._word_owner))
         with self._lock:
-            self._arrs = (node_stk, edge_stk, seeds_stk, aid_stk)
+            self._arrs = arrs
             self._stacked_shape = shape
         self.gen += 1
         self.applies += 1
@@ -458,23 +792,56 @@ class MultichipMatcher:
 
                 time.sleep(_fi._injector.last_delay)
 
+    def _gate_ep(self) -> None:
+        """The routed front end's own chaos seam: an injected
+        ``ep.route`` fault refuses the dispatch (CPU trie serves the
+        batch) without taking the whole mesh down."""
+        if _fi._injector is not None:
+            act = _fi._injector.act("ep.route")
+            if act == "raise":
+                self._note_failover()
+                raise _fi.InjectedFault("ep.route")
+            if act == "delay":
+                import time
+
+                time.sleep(_fi._injector.last_delay)
+
     def _note_failover(self) -> None:
         self.failovers += 1
         if self.metrics is not None:
             self.metrics.inc("tpu.match.shard_failover")
+
+    def ep_capacity(self, batch: int) -> int:
+        """Per-(source, owner) bucket size for a routed batch: the
+        uniform share ``Bs/tp`` with ``ep_slack`` headroom.  Per-shard
+        processed width is ``tp * C <= ceil(slack * Bl / tp)`` — the
+        ``gate_shard_width_le_batch_over_tp`` contract."""
+        bs = (batch // self.dp) // self.tp
+        return max(1, int(math.ceil(self.ep_slack * bs / self.tp)))
+
+    def _routed_for(self, batch: int) -> bool:
+        """EP routing serves a batch iff the dp-local slice splits
+        evenly into tp source slices; anything else (odd warm shapes)
+        falls back to the replicated step for that dispatch."""
+        return (self.ep and self.tp > 1
+                and batch % (self.dp * self.tp) == 0
+                and (batch // self.dp) >= self.tp)
 
     def dispatch(self, enc, *, block_compile: bool = True):
         """One mesh dispatch of an already-encoded batch; returns the
         lazy :class:`CompactFanoutResult` handle (readback blocks
         later, outside any lock).  Raises :class:`ShardDead` /
         :class:`~emqx_tpu.faultinject.InjectedFault` at the
-        ``match.shard`` seam, :class:`CompileMiss` on a cold mesh
-        shape when a kernel cache is attached."""
+        ``match.shard`` / ``ep.route`` seams, :class:`CompileMiss` on
+        a cold mesh shape when a kernel cache is attached."""
         self._gate()
         words, lens, is_sys = enc
-        step = self._step_for(
-            (int(words.shape[0]), int(words.shape[1])),
-            block_compile=block_compile)
+        b, d = int(words.shape[0]), int(words.shape[1])
+        routed = self._routed_for(b)
+        if routed:
+            self._gate_ep()
+        step = self._step_for((b, d), routed=routed,
+                              block_compile=block_compile)
         with self._lock:
             if self._arrs is None:
                 raise RuntimeError("multichip mirror not synced yet")
@@ -483,6 +850,21 @@ class MultichipMatcher:
         self.dispatches += 1
         if self.metrics is not None:
             self.metrics.inc("tpu.match.shard_dispatches")
+        if routed:
+            self.ep_dispatches += 1
+            self._routed_live.add(id(res))
+            if self.metrics is not None:
+                cap = self.ep_capacity(b)
+                self.metrics.inc("tpu.match.ep_dispatches")
+                self.metrics.set("tpu.match.ep_shard_width",
+                                 self.tp * cap)
+                # analytic ICI bill for the routing all_to_all: each
+                # instance ships (tp-1)/tp of its (tp, C) grid — words
+                # + lens + is_sys + src per slot
+                self.metrics.inc(
+                    "tpu.match.ep_ici_bytes",
+                    self.dp * self.tp * (self.tp - 1) * cap
+                    * (d + 3) * 4)
         return res
 
     def readback(self, res, n: int):
@@ -491,46 +873,60 @@ class MultichipMatcher:
         partition makes them disjoint — no dedup), rows flagged by the
         psum'd spill vectors go back to the host tables.  Returns
         ``(rows, spilled row indices, d2h bytes)``."""
+        routed = id(res) in self._routed_live
+        self._routed_live.discard(id(res))
         ids, counts, nm, ao, mo = jax.device_get(
             (res.ids, res.counts, res.n_matches,
              res.active_overflow, res.match_overflow))
-        rows = decode_compact_rows(ids, counts, self.max_matches)[:n]
+        cap_row = ids.shape[1] // counts.shape[1]
+        rows = decode_compact_rows(ids, counts, cap_row)[:n]
         out = [[int(a) for a in row if a >= 0] for row in rows]
         sp = (ao > 0) | (mo > 0)
+        spilled = np.flatnonzero(sp[:n]).tolist()
+        if routed and spilled and self.metrics is not None:
+            # the routed fail-open set: bucket overflow + truncation
+            # rows the CPU trie re-runs
+            self.metrics.inc("tpu.match.ep_overflow_rows", len(spilled))
         nbytes = 4 * int(ids.size + counts.size + nm.size
                          + ao.size + mo.size)
-        return out, np.flatnonzero(sp[:n]).tolist(), nbytes
+        return out, spilled, nbytes
 
-    def _step_for(self, batch_shape: Tuple[int, int], *,
+    def _step_for(self, batch_shape: Tuple[int, int], routed: bool, *,
                   block_compile: bool = True):
+        cap = self.ep_capacity(batch_shape[0]) if routed else 0
         kc = self.kernel_cache
         if kc is not None and self._stacked_shape is not None:
-            smax, hbmax, acap = self._stacked_shape
+            smax, hbmax, acap, sm, hbm, am, wcap = self._stacked_shape
             return kc.executable(
                 batch_shape, smax, hbmax,
                 active_slots=self.active_slots,
                 max_matches=self.max_matches,
                 compact_output=True, flat_cap=0,
-                mesh=(self.dp, self.tp, acap),
+                mesh=(self.dp, self.tp, acap, 1 if routed else 0, cap,
+                      sm, hbm, am, wcap, self.ep_micro_matches),
                 block=block_compile,
             )
-        key = (int(batch_shape[0]), int(batch_shape[1]))
+        key = (int(batch_shape[0]), int(batch_shape[1]), routed)
         fn = self._steps.get(key)
         if fn is None:
             fn = self._steps[key] = build_multichip_step(
-                self.mesh, self.active_slots, self.max_matches)
+                self.mesh, self.active_slots, self.max_matches,
+                micro_matches=self.ep_micro_matches,
+                routed=routed, capacity=cap)
         return fn
 
     def _lower_step(self, key):
         """Mesh half of the kernel cache's ``_lower``: AOT-compile the
-        shard_map step for one (B, D, S, Hb, ..., (dp, tp, acap)) key
-        (proven on the CPU mesh — jit(shard_map).lower(
-        ShapeDtypeStruct...) works)."""
+        shard_map step for one (B, D, S, Hb, ..., (dp, tp, acap, kind,
+        C, Sm, Hbm, Am, Wcap, Km)) key (proven on the CPU mesh —
+        jit(shard_map).lower(ShapeDtypeStruct...) works)."""
         from ..ops.compiler import BUCKET_SLOTS
 
         b, d, s, hb = key[0], key[1], key[2], key[3]
-        acap = key[10][2]
-        step = build_multichip_step(self.mesh, key[4], key[5])
+        _dp, _tp, acap, kind, cap, sm, hbm, am, wcap, km = key[10]
+        step = build_multichip_step(
+            self.mesh, key[4], key[5], micro_matches=km,
+            routed=bool(kind), capacity=cap)
         sd = jax.ShapeDtypeStruct
         i32 = jnp.int32
         return step.lower(
@@ -539,6 +935,11 @@ class MultichipMatcher:
             sd((self.tp, hb, BUCKET_SLOTS * 4), i32),
             sd((self.tp, 2), i32),
             sd((self.tp, acap), i32),
+            sd((sm, 4), i32),
+            sd((hbm, BUCKET_SLOTS * 4), i32),
+            sd((2,), i32),
+            sd((am,), i32),
+            sd((wcap,), i32),
         ).compile()
 
     def warm(self, batches=(64,), depths=None) -> None:
@@ -561,24 +962,43 @@ class MultichipMatcher:
         return os.path.join(segments_dir, "multichip")
 
     def save_segments(self, segments_dir: str, epoch: int) -> None:
-        """WORKER-THREAD step: persist every shard subtable (the
-        existing segment format — trie relation, shared vocab verbatim)
-        plus a checksummed manifest carrying the service-table epoch
-        and the local→service aid maps.  Cold start seeds from these
-        iff the epoch still matches (the ``_seg_join_seed`` idiom)."""
+        """WORKER-THREAD step: persist every shard subtable + the
+        micro-table (native tables ride the NUL-framed "filters"
+        segment kind, Python tables the full "state" kind) plus a
+        checksummed manifest carrying the service-table epoch, the
+        shared vocab in id order, per-filter service aids, and the
+        local→service aid maps.  Cold start seeds from these iff the
+        epoch still matches (the ``_seg_join_seed`` idiom)."""
         from ..storage.segments import save_segment
 
         d = self._seg_dir(segments_dir)
         os.makedirs(d, exist_ok=True)
+        arrays: Dict[str, np.ndarray] = {}
         for t, sub in enumerate(self._subs):
+            flts = list(self._filters[t])
             save_segment(os.path.join(d, f"shard{t}.seg.npz"), sub,
-                         deep={}, routing_aids=set(),
-                         filters=sub.filters())
-        maps = {f"m{t}": amap for t, amap in enumerate(self._aid_maps)}
+                         deep={}, routing_aids=set(), filters=flts)
+            arrays[f"m{t}"] = np.asarray(self._aid_maps[t], np.int32)
+            arrays[f"sa{t}"] = np.asarray(
+                [self._filters[t][f] for f in flts], np.int32)
+        mflts = list(self._micro_filters)
+        save_segment(os.path.join(d, "micro.seg.npz"), self._micro,
+                     deep={}, routing_aids=set(), filters=mflts)
+        arrays["mm"] = np.asarray(self._micro_amap, np.int32)
+        arrays["sam"] = np.asarray(
+            [self._micro_filters[f] for f in mflts], np.int32)
+        # the shared vocab in id order (NUL-framed: words may contain
+        # '\n', never NUL) — the restore replays it FIRST so every
+        # fresh native vocab assigns the same ids
+        words = [w for w, _i in sorted(self.vocab.items(),
+                                       key=lambda kv: kv[1])]
+        arrays["vw"] = np.frombuffer(
+            "\x00".join(words).encode("utf-8"), np.uint8).copy()
         meta = {"version": self.MANIFEST_VERSION, "epoch": int(epoch),
-                "tp": self.tp, "depth": self.depth}
-        digest = self._manifest_checksum(meta, maps)
-        np.savez(os.path.join(d, "aid_maps.npz"), **maps)
+                "tp": self.tp, "depth": self.depth,
+                "native": bool(self.native)}
+        digest = self._manifest_checksum(meta, arrays)
+        np.savez(os.path.join(d, "aid_maps.npz"), **arrays)
         # the manifest lands LAST (atomic replace = the commit point):
         # a crash mid-save leaves either the old manifest or none
         tmp = os.path.join(d, "manifest.json.tmp")
@@ -597,13 +1017,56 @@ class MultichipMatcher:
             h.update(np.ascontiguousarray(maps[k]).tobytes())
         return h.hexdigest()
 
+    def _restore_sub(self, seg, arrays, sa_key: str):
+        """One subtable + its (filter → service aid) dict from a
+        segment: native replays the NUL/newline filter blob through
+        ``bulk_add`` and rebuilds aids via ``aid_of`` (robust to
+        bulk-order drift); Python restores the full state."""
+        from ..storage.segments import restore_incremental
+
+        if seg.kind == "filters":
+            if not self.native:
+                raise ValueError("filters-kind segment without native")
+            sub = self._new_sub()
+            sub.bulk_intern(self._restored_words)
+            flts = list(seg.filters)
+            sub.bulk_add(flts)
+            sa = np.asarray(arrays[sa_key], np.int32)
+            if len(sa) != len(flts):
+                raise ValueError("service-aid array length mismatch")
+            amap = np.full(max(64, sub.n_filters + 1), -1, np.int32)
+            fdict: Dict[str, int] = {}
+            for f, service_aid in zip(flts, sa.tolist()):
+                laid = sub.aid_of(f)
+                if laid < 0:
+                    raise ValueError(f"restored filter missing: {f!r}")
+                if laid >= len(amap):
+                    grown = np.full(
+                        max(2 * len(amap), laid + 1), -1, np.int32)
+                    grown[:len(amap)] = amap
+                    amap = grown
+                amap[laid] = service_aid
+                fdict[f] = service_aid
+            return sub, amap, fdict
+        if seg.kind != "state" or self.native:
+            raise ValueError(f"unexpected segment kind {seg.kind!r}")
+        sub = restore_incremental(seg)
+        amap_key = "m" + sa_key[2:] if sa_key.startswith("sa") else "mm"
+        amap = np.asarray(arrays[amap_key], np.int32)
+        fdict = {}
+        for f in sub.filters():
+            laid = sub.aid_of(f)
+            if 0 <= laid < len(amap) and amap[laid] >= 0:
+                fdict[f] = int(amap[laid])
+        return sub, amap, fdict
+
     def load_segments(self, segments_dir: str, expect_epoch: int) -> bool:
         """Cold start: restore the shard partition from the persisted
         per-shard segments iff the manifest's service epoch matches the
         just-restored main table (no drift since the save) — else the
         caller rebuilds the partition from the live service state.
         Returns True when seeded."""
-        from ..storage.segments import load_segment, restore_incremental
+        from ..storage.segments import load_segment
 
         d = self._seg_dir(segments_dir)
         try:
@@ -612,44 +1075,76 @@ class MultichipMatcher:
             if meta.get("version") != self.MANIFEST_VERSION \
                     or meta.get("tp") != self.tp \
                     or meta.get("depth") != self.depth \
+                    or meta.get("native") != bool(self.native) \
                     or meta.get("epoch") != int(expect_epoch):
                 return False
             npz = np.load(os.path.join(d, "aid_maps.npz"))
-            maps = {k: np.asarray(npz[k], np.int32) for k in npz.files}
+            arrays = {k: npz[k] for k in npz.files}
             want = meta.get("checksum")
             meta_core = {k: meta[k] for k in
-                         ("version", "epoch", "tp", "depth")}
-            if want != self._manifest_checksum(meta_core, maps):
+                         ("version", "epoch", "tp", "depth", "native")}
+            if want != self._manifest_checksum(meta_core, arrays):
                 log.warning("multichip manifest checksum mismatch; "
                             "repartition serves")
                 return False
-            subs = []
+            self._restored_words = (
+                bytes(np.asarray(arrays["vw"], np.uint8))
+                .decode("utf-8").split("\x00")
+                if len(arrays.get("vw", ())) else [])
+            subs, amaps, fdicts = [], [], []
             for t in range(self.tp):
                 seg = load_segment(os.path.join(d, f"shard{t}.seg.npz"))
-                if seg.kind != "state" or seg.depth != self.depth:
+                if seg.depth != self.depth:
                     return False
-                subs.append(restore_incremental(seg))
+                sub, amap, fdict = self._restore_sub(
+                    seg, arrays, f"sa{t}")
+                subs.append(sub)
+                amaps.append(amap)
+                fdicts.append(fdict)
+            mseg = load_segment(os.path.join(d, "micro.seg.npz"))
+            if mseg.depth != self.depth:
+                return False
+            micro, micro_amap, micro_fdict = self._restore_sub(
+                mseg, arrays, "sam")
         except FileNotFoundError:
             return False
         except Exception:
             log.warning("multichip segment load failed; repartition "
                         "serves", exc_info=True)
             return False
-        # every shard persisted the SAME shared vocab — rebind them to
-        # one dict instance so future interning stays consistent
-        v0 = subs[0].vocab
-        for sub in subs[1:]:
-            if sub.vocab != v0:
-                log.warning("multichip shard vocabs diverged; "
-                            "repartition serves")
-                return False
-            sub.vocab = v0
+        if self.native:
+            # bulk_add's warm probe interns a few sentinel words past
+            # the persisted list; every table replayed the identical
+            # sequence, so adopt one table's (refreshed) vocab as the
+            # shared encode vocab and guard that they all agree —
+            # otherwise the next live intern would assign drifting ids
+            vocab = dict(subs[0].vocab)
+            for tbl in [*subs[1:], micro]:
+                if tbl.vocab != vocab:
+                    log.warning("multichip shard vocabs diverged; "
+                                "repartition serves")
+                    return False
+        else:
+            # every shard persisted the SAME shared vocab — rebind
+            # them to one dict instance so future interning stays
+            # consistent
+            vocab = subs[0].vocab
+            for tbl in [*subs[1:], micro]:
+                if tbl.vocab != vocab:
+                    log.warning("multichip shard vocabs diverged; "
+                                "repartition serves")
+                    return False
+                tbl.vocab = vocab
         with self._lock:
-            self.vocab = v0
+            self.vocab = vocab
             self._subs = subs
-            self._aid_maps = [maps.get(f"m{t}",
-                                       np.full(64, -1, np.int32))
-                              for t in range(self.tp)]
+            self._aid_maps = amaps
+            self._filters = fdicts
+            self._micro = micro
+            self._micro_amap = micro_amap
+            self._micro_filters = micro_fdict
+            self._word_owner = np.zeros(1024, np.int32)
+            self._word_owner_n = 0
             self._pending = []
             self._rebuild_pairs = None
             self._restack_due = True
@@ -662,12 +1157,16 @@ class MultichipMatcher:
             "devices": self.n_devices,
             "mesh": {"dp": self.dp, "tp": self.tp},
             "ready": self.ready,
+            "native": self.native,
+            "ep": self.ep,
             "gen": self.gen,
             "dispatches": self.dispatches,
+            "ep_dispatches": self.ep_dispatches,
             "failovers": self.failovers,
             "applies": self.applies,
             "restacks": self.restacks,
             "dead_shards": sorted(self._dead),
             "shard_filters": [sub.n_filters for sub in self._subs],
+            "micro_filters": len(self._micro_filters),
             "seeded_from_segments": self.seeded_from_segments,
         }

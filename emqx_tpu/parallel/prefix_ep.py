@@ -23,6 +23,15 @@ Pipeline (one `shard_map` over an ``ep`` axis):
 
 Tables are built per partition with SHARED shapes and a SHARED vocab
 (so one encode serves all shards) by :func:`build_partitions`.
+
+This module remains the standalone dryrun (bench ``prefix_ep``,
+MULTICHIP_r03+: parts=4, overflow=0).  The SERVING implementation of
+the same router lives in :mod:`.multichip_serve` (ISSUE 16,
+``match.multichip.ep.enable``): there the bucket/route step rides the
+serve backend's dp×tp mesh, the owner merges a replicated
+wildcard-root micro-table into its answer segment instead of
+replicating root wildcards into every partition, and overflow joins
+the serve plane's CPU-trie fail-open set.
 """
 
 from __future__ import annotations

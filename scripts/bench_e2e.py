@@ -42,12 +42,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def multichip_serve_smoke(n_filters: int) -> dict:
-    """The multichip_serve row in ITS OWN subprocess with a virtual
+def _mesh_smoke(fn: str, n_filters: int) -> dict:
+    """One bench.<fn> mesh row in ITS OWN subprocess with a virtual
     8-device CPU mesh (the conftest pattern).  Forcing 8 XLA host
     devices in THIS process would slow every single-chip row (8
     device threads on a 1-core box stall the table_lifecycle churn
-    gates), so the mesh A/B is isolated instead."""
+    gates), so the mesh A/Bs are isolated instead."""
     import subprocess
 
     flags = os.environ.get("XLA_FLAGS", "")
@@ -57,14 +57,22 @@ def multichip_serve_smoke(n_filters: int) -> dict:
     proc = subprocess.run(
         [sys.executable, "-c",
          "import json, bench; print(json.dumps("
-         f"bench.bench_multichip_serve_smoke(n_filters={n_filters})))"],
+         f"bench.{fn}(n_filters={n_filters})))"],
         capture_output=True, text=True, cwd=REPO, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags},
     )
     if proc.returncode != 0:
         raise RuntimeError(
-            f"multichip_serve smoke failed: {proc.stderr[-2000:]}")
+            f"{fn} smoke failed: {proc.stderr[-2000:]}")
     return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def multichip_serve_smoke(n_filters: int) -> dict:
+    return _mesh_smoke("bench_multichip_serve_smoke", n_filters)
+
+
+def multichip_ep_smoke(n_filters: int) -> dict:
+    return _mesh_smoke("bench_multichip_ep_smoke", n_filters)
 
 
 def chaos_smoke() -> dict:
@@ -807,6 +815,13 @@ def main(argv=None) -> dict:
     # its own subprocess so the forced 8-device mesh cannot slow the
     # single-chip rows above.
     out["multichip_serve"] = multichip_serve_smoke(
+        n_filters=(2000 if args.smoke else 20000))
+    # prefix-EP routed vs replicated A/B (ISSUE 16): routed parity,
+    # bucket-overflow fail-open, the per-shard width contract
+    # (gate_shard_width_le_batch_over_tp) and routed-path shard-kill
+    # failover are CI-asserted; the routed speedup is a tracking
+    # number (host threads pay the all_to_all without the ICI win).
+    out["multichip_ep"] = multichip_ep_smoke(
         n_filters=(2000 if args.smoke else 20000))
     # stage-latency observatory parity (ISSUE 12): the serve sections'
     # p50/p99 now come from the product's histograms (observe/hist.py);

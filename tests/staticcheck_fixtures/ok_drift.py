@@ -32,6 +32,16 @@ def f(metrics, cfg, alarms, hooks, _injector, name):
     alarms.activate("admission_quarantine", {}, "clients quarantined")
     alarms.deactivate("admission_quarantine")
     hooks.run("message.dropped", (None, "admission_shed"))
+    # multichip EP routing literals (ISSUE 16)
+    metrics.inc("tpu.match.ep_dispatches")
+    metrics.inc("tpu.match.ep_overflow_rows")
+    metrics.set("tpu.match.ep_shard_width", 0)
+    metrics.inc("tpu.match.ep_ici_bytes")
+    cfg.get("match.multichip.native")
+    cfg.get("match.multichip.ep.enable")
+    cfg.get("match.multichip.ep.capacity_slack")
+    cfg.get("match.multichip.ep.micro_matches")
+    _injector.check("ep.route")
 
 
 def g(hooks):

@@ -632,6 +632,89 @@ def test_node_frame_error_and_auth_failure_seams():
     run(main())
 
 
+def test_gateway_flood_feeds_admission_plane():
+    """The ISSUE 16 gateway seams: a publish storm through the gateway
+    publish seam climbs the same quarantine ladder as an MQTT flood,
+    the gateway connect rides the client.connected hook with its
+    peerhost, auth failure notes the feature row, and a garbled-CoAP
+    datagram flood registers malformed notes keyed on the source
+    address pre-CONNECT."""
+    from emqx_tpu.gateway.base import GatewayConn
+    from emqx_tpu.gateway.coap import CoapGateway
+
+    h = Harness()
+    b = Broker()
+    h.adm.attach(b)
+
+    class _Node:
+        broker = b
+        connections = {}
+
+    node = _Node()
+    conn = GatewayConn(node, "coap")
+    conn.addr = ("10.9.9.9", 40123)
+    conn.send_deliveries = lambda pubs: None
+    conn.close_transport = lambda reason: None
+    conn.attach_session("gw-atk")
+    h.tick()
+    row = h.adm.explain("gw-atk")
+    assert row is not None and row["features"]["connect_rate"] > 0
+    # distinct-topic publish storm through GatewayConn.publish — the
+    # same shape as Harness.flood but riding the gateway datapath
+    for t in range(4):
+        for i in range(1000):
+            conn.publish(f"scan/{t}/{i}", b"x" * 64)
+        h.tick()
+    assert h.adm.explain("gw-atk")["level_name"] == "quarantine"
+    assert h.adm.shed_qos0("gw-atk")
+    # auth failure through the gateway authn fold
+    b.hooks.add("client.authenticate", lambda cid, u, p, info, acc: False)
+    assert conn.authenticate("eve", b"bad") is False
+    h.tick()
+    assert h.adm.explain("gw-atk")["features"]["auth_fail_rate"] > 0
+    # garbled datagrams key the malformed feature on the peer address
+    gw = CoapGateway(node, {})
+    for _ in range(5):
+        gw.on_datagram(b"\xff\xff", ("10.7.7.7", 5683))
+    h.tick()
+    mrow = h.adm.explain("ip:10.7.7.7")
+    assert mrow is not None and mrow["features"]["malformed_rate"] > 0
+
+
+def test_gateway_seams_zero_call_when_disabled(monkeypatch):
+    """Flag-off discipline extends to the gateway seams: no Admission
+    method may run from attach/publish/auth/datagram paths when the
+    plane is off."""
+    from emqx_tpu.gateway.base import GatewayConn
+    from emqx_tpu.gateway.coap import CoapGateway
+
+    for name in ("note_publish", "note_connect", "note_disconnect",
+                 "note_auth_failure", "note_malformed"):
+        monkeypatch.setattr(
+            Admission, name,
+            lambda self, *a, **kw: pytest.fail(
+                "gateway admission seam called while disabled"),
+        )
+    b = Broker()
+    assert b.admission is None
+
+    class _Node:
+        broker = b
+        connections = {}
+
+    node = _Node()
+    conn = GatewayConn(node, "stomp")
+    conn.addr = ("127.0.0.1", 1)
+    conn.send_deliveries = lambda pubs: None
+    conn.close_transport = lambda reason: None
+    conn.attach_session("quiet")
+    conn.publish("t/x", b"m")
+    assert conn.authenticate(None, None) is True
+    gw = CoapGateway(node, {})
+    gw.on_datagram(b"\xff\xff", ("127.0.0.1", 2))
+    conn.detach_session()
+
+
 def test_admission_rest_and_cli_surface():
     """GET /api/v5/admission lists decisions WITH feature rows (the
     explainability contract); DELETE lifts one; the ctl subcommand

@@ -134,6 +134,23 @@ def test_bench_e2e_smoke_delivers_everything():
     assert mcs["mesh_topics_per_s"] > 0, mcs
     assert "gate_scaling_ge_6x_at_8" in mcs, mcs
     assert mcs["measured_on"] == "cpu", mcs
+    # prefix-EP routed vs replicated A/B (ISSUE 16): routed answers
+    # are bit-parity with the replicated backend, a root-skewed
+    # corpus overflows the bucket grid and fails open complete, the
+    # per-shard processed width honors tp*C <= ceil(slack*Bl/tp),
+    # and a killed shard raises before routing (delivery 1.0 via the
+    # host tables).  Routed speedup is a tracking number off-hardware.
+    mce = out["multichip_ep"]
+    assert mce["gate_routed_parity_all"], mce
+    assert mce["gate_overflow_failopen"], mce
+    assert mce["gate_shard_width_le_batch_over_tp"], mce
+    assert mce["gate_shard_kill_failover"], mce
+    assert mce["devices"] == 8 and mce["mesh"]["tp"] > 1, mce
+    assert mce["routed_shard_width"] <= mce["replicated_shard_width"], mce
+    assert mce["ici_bytes_per_batch"] > 0, mce
+    assert mce["overflow_rows_flagged"] > 0, mce
+    assert mce["replicated_topics_per_s"] > 0, mce
+    assert mce["routed_topics_per_s"] > 0, mce
     assert "gate_auto_within_5pct" in kj, kj
     assert kj["autotune_picks"], kj
     # streaming table lifecycle A/B (ISSUE 9): segment cold start >=10x

@@ -651,3 +651,51 @@ def test_autotuner_family_split_measures_exact():
     assert t.family_hits == 0
     # persisted format stays the versioned checksummed JSON
     assert "family_hits" in t.info()
+
+
+def test_sorted_overlay_bit_parity_vs_linear_scan():
+    """ISSUE 16 satellite: the sorted-overlay lower-bound search must
+    be bit-identical to the historical dense overlay compare — same
+    matches, counts, and overflow vectors — with the overlay well
+    populated and a tombstoned CSR edge in play."""
+    from emqx_tpu.ops.join_match import OVERLAY_EMPTY, join_match
+
+    inc = _table(CORPUS, state_bucket=1024, edge_bucket=1024)
+    rel = JoinRelation(inc.S, inc.edge_tab)
+    inc.flush()
+    # fresh edges land in the overlay; a removal tombstones the CSR
+    for i in range(40):
+        inc.add(f"ov{i}/+/leaf{i}")
+    inc.remove("a/+/c")
+    d = inc.flush()
+    rel.grow_states(inc.S)
+    mpos, mval, opos, orows = rel.apply_bucket_delta(
+        d.bucket_idx, d.bucket_rows)
+    assert (mval == -1).any()          # the tombstone
+    assert len(opos) == OVERLAY_CAP    # overlay ships whole, sorted
+    # sortedness invariant: live rows ascending, sentinels at the end
+    ov = rel.overlay
+    live = ov[ov[:, 0] != OVERLAY_EMPTY]
+    assert len(live) >= 40
+    keys = [tuple(r[:2]) for r in live.tolist()]
+    assert keys == sorted(keys)
+    assert (ov[len(live):, 0] == OVERLAY_EMPTY).all()
+
+    topics = ["ov3/q/leaf3", "a/b/c", "ov39/x/leaf39", "a/z/c",
+              "nope/x", "d1/d2/d3/d4/d5/d6"]
+    enc = encode_batch(inc, topics, batch=8)
+    kw = dict(active_slots=8, max_matches=16)
+    r_sorted = join_match(*enc, inc.node_tab, *rel.arrays(), **kw)
+    r_linear = join_match(*enc, inc.node_tab, *rel.arrays(),
+                          linear_overlay=True, **kw)
+    assert_result_parity(r_sorted, r_linear, "overlay search")
+    r_sorted_f = join_match(*enc, inc.node_tab, *rel.arrays(),
+                            flat_cap=8 * 16, **kw)
+    r_linear_f = join_match(*enc, inc.node_tab, *rel.arrays(),
+                            flat_cap=8 * 16, linear_overlay=True, **kw)
+    assert_result_parity(r_sorted_f, r_linear_f, "overlay search flat")
+    # and the host walk agrees (the overlay answers are REAL edges)
+    m = np.asarray(r_sorted.matches)
+    for r, t in enumerate(topics):
+        got = sorted(x for x in m[r] if x >= 0)
+        assert got == sorted(inc.match_host(t)), (t, got)

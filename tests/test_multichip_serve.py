@@ -27,7 +27,8 @@ from emqx_tpu.node import BrokerNode
 from emqx_tpu.ops.incremental import IncrementalNfa
 from emqx_tpu.parallel import multichip_serve as mcs_mod
 from emqx_tpu.parallel.multichip_serve import (
-    MultichipMatcher, ShardDead, serve_mesh_shape, shard_of_filter,
+    MultichipMatcher, ShardDead, is_micro_filter, serve_mesh_shape,
+    shard_of_filter,
 )
 
 FILTERS = ["a/+", "a/#", "+/b", "#", "x/y/z", "x/+/z", "$SYS/#",
@@ -96,10 +97,15 @@ def test_mesh_shape_and_partition_determinism():
         t = shard_of_filter(f, 4)
         assert 0 <= t < 4
         assert t == shard_of_filter(f, 4)  # deterministic
-    # the partition spreads the whole table over the shards
+    # the partition spreads the whole table over the shards; the
+    # wildcard-root filters live in the replicated micro-table instead
+    # of crc32-hashing to one arbitrary shard (ISSUE 16)
     _inc, mc, _pairs = build_pair()
     per_shard = [sub.n_filters for sub in mc._subs]
-    assert sum(per_shard) == len(FILTERS)
+    n_micro = sum(1 for f in FILTERS if is_micro_filter(f))
+    assert n_micro >= 2            # corpus keeps the micro path honest
+    assert len(mc._micro_filters) == n_micro
+    assert sum(per_shard) == len(FILTERS) - n_micro
     assert mc.dp * mc.tp == 8
 
 
@@ -160,8 +166,10 @@ def test_truncation_psum_fail_open():
     """Per-shard truncation: every row the psum'd overflow did NOT
     flag must be COMPLETE (the flag may over-approximate — the host
     re-runs flagged rows — but never under-approximate)."""
-    inc, mc, _pairs = build_pair(max_matches=2)
-    # "#" + "a/+" + "a/#" etc: topics under a/ match >2 filters
+    inc, mc, _pairs = build_pair(max_matches=1, ep_micro_matches=1)
+    # shard segments truncate ("x/y/z" matches x/y/z + x/+/z on the
+    # "x" shard) AND the micro segment truncates ("a/b" matches the
+    # wildcard-root "+/b" + "#" past the 1-slot micro cap)
     topics = ["a/b", "a/b/c", "x/y/z", "m/n", "b/c"]
     rows, sp, _ = mesh_rows(mc, topics)
     spset = set(sp)
@@ -259,7 +267,7 @@ def test_kernel_cache_mesh_keys_compile_miss_and_prewarm():
     mc.dispatch(enc)
     assert kc.hits > h0
     # prewarm replays the MESH combo against the next pow2 table shape
-    smax, hbmax, _acap = mc._stacked_shape
+    smax, hbmax = mc._stacked_shape[0], mc._stacked_shape[1]
     assert not kc.shape_covered(2 * smax, hbmax)
     n = kc.prewarm_shape(2 * smax, hbmax)
     assert n >= 1
@@ -450,6 +458,200 @@ def test_flag_off_is_byte_identical_single_chip_path(monkeypatch):
             assert m.get("tpu.match.shard_devices") == 0
             assert not calls, "flag off must not construct a matcher"
             assert ms.info()["multichip"] is None
+        finally:
+            await node.stop()
+
+    run(main())
+
+# ---------------------------------------------------------------------------
+# prefix-EP routed front end (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def build_ep_pair(filters=FILTERS, depth=8, **mc_kw):
+    inc = IncrementalNfa(depth=depth)
+    pairs = []
+    for f in filters:
+        inc.add(f)
+        pairs.append((f, inc.aid_of(f)))
+    mc = MultichipMatcher(depth=depth, ep=True, **mc_kw)
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    return inc, mc, pairs
+
+
+def test_ep_routed_parity_vs_replicated_mixed_roots():
+    """Routed bit-parity: a mixed literal/wildcard-root corpus served
+    through the EP front end must reproduce the replicated-batch
+    backend's rows (and the host walk) exactly — the owner's merged
+    own+micro segment covers everything the fanned batch saw."""
+    inc, mc_rep, pairs = build_pair()
+    mc_ep = MultichipMatcher(depth=8, ep=True, ep_slack=4.0)
+    mc_ep.rebuild(pairs)
+    assert mc_ep.apply_pending()
+    topics = topics_for(48)
+    rows_r, sp_r, _ = mesh_rows(mc_rep, topics)
+    rows_e, sp_e, _ = mesh_rows(mc_ep, topics)
+    assert mc_ep.ep_dispatches == 1 and mc_rep.ep_dispatches == 0
+    assert not sp_r and not sp_e
+    for t, rr, re_ in zip(topics, rows_r, rows_e):
+        assert sorted(re_) == sorted(rr) == sorted(inc.match_host(t)), t
+
+
+def test_ep_bucket_overflow_fails_open():
+    """A hot root skewing every row of a source slice to ONE owner
+    overflows the (source, owner) bucket: overflowed rows are flagged
+    for the CPU trie (never silently dropped), unflagged rows stay
+    complete."""
+    inc, mc, _pairs = build_ep_pair(ep_slack=1.0)
+    # every topic under x/: all 8 rows of each source slice route to
+    # the "x" owner, capacity ceil(1.0*8/4) = 2 -> 6 overflow/source
+    topics = [f"x/{i}/z" for i in range(24)] + ["x/y/z"] * 8
+    rows, sp, _ = mc.readback(
+        mc.dispatch(mc.encode(topics, batch=64)), len(topics))
+    assert sp, "expected bucket overflow on the skewed corpus"
+    spset = set(sp)
+    assert len(spset) < len(topics), "slack must keep some rows routed"
+    for i, t in enumerate(topics):
+        if i not in spset:
+            assert sorted(rows[i]) == sorted(inc.match_host(t)), t
+
+
+def test_ep_micro_table_completeness_unknown_roots():
+    """Wildcard-root filters live in the replicated micro-table: a
+    topic whose root was NEVER interned (word id 0, owner shard 0)
+    still collects its full wildcard answer set on the routed path."""
+    inc, mc, _pairs = build_ep_pair(ep_slack=4.0)
+    topics = ["zzz/b", "unknown/word/here", "qqq"]
+    rows, sp, _ = mc.readback(
+        mc.dispatch(mc.encode(topics, batch=64)), len(topics))
+    assert not sp
+    for t, r in zip(topics, rows):
+        want = sorted(inc.match_host(t))
+        assert sorted(r) == want, (t, r, want)
+        assert want, f"corpus must exercise the micro path for {t}"
+
+
+def test_ep_micro_table_tracks_churn():
+    """note_add/note_del of wildcard-root filters mutate the micro
+    partition (not a crc32 shard) and serve on the next apply."""
+    inc, mc, _pairs = build_ep_pair(ep_slack=4.0)
+    inc.add("+/added")
+    mc.note_add("+/added", inc.aid_of("+/added"))
+    inc.remove("#")
+    mc.note_del("#")
+    assert mc.apply_pending()
+    assert "+/added" in mc._micro_filters
+    assert "#" not in mc._micro_filters
+    topics = ["q/added", "zz/yy"]
+    rows, sp, _ = mc.readback(
+        mc.dispatch(mc.encode(topics, batch=64)), len(topics))
+    assert not sp
+    for t, r in zip(topics, rows):
+        assert sorted(r) == sorted(inc.match_host(t)), t
+
+
+def test_ep_route_fault_injection_point():
+    """The routed front end's own seam: an injected ep.route raise
+    refuses the dispatch (failover counted) without touching the
+    replicated path."""
+    inc, mc, _pairs = build_ep_pair(ep_slack=4.0)
+    enc = mc.encode(["a/b"], batch=64)
+    faultinject.install(FaultInjector([
+        {"point": "ep.route", "action": "raise", "times": 1},
+    ]))
+    try:
+        with pytest.raises(faultinject.InjectedFault):
+            mc.dispatch(enc)
+        assert mc.failovers == 1
+        rows, _, _ = mc.readback(mc.dispatch(enc), 1)
+        assert sorted(rows[0]) == sorted(inc.match_host("a/b"))
+    finally:
+        faultinject.uninstall()
+
+
+def test_ep_shard_kill_raises_before_routing():
+    inc, mc, _pairs = build_ep_pair(ep_slack=4.0)
+    enc = mc.encode(["a/b"], batch=64)
+    mc.dispatch(enc)
+    mc.kill_shard(3)
+    with pytest.raises(ShardDead):
+        mc.dispatch(enc)
+    assert mc.failovers == 1
+
+
+def test_ep_metrics_width_gate_and_odd_batches_fall_back():
+    """Routed dispatches publish the per-shard width tp*C (the
+    gate_shard_width_le_batch_over_tp numerator) and the analytic ICI
+    bill; batch shapes that don't split into tp source slices fall
+    back to the replicated step for that dispatch."""
+    from emqx_tpu.observe.metrics import Metrics
+
+    m = Metrics()
+    inc, mc, pairs = build_ep_pair(metrics=m)
+    b = 64
+    rows, _, _ = mc.readback(
+        mc.dispatch(mc.encode(["a/b"], batch=b)), 1)
+    assert sorted(rows[0]) == sorted(inc.match_host("a/b"))
+    assert m.get("tpu.match.ep_dispatches") == 1
+    width = m.get("tpu.match.ep_shard_width")
+    assert width == mc.tp * mc.ep_capacity(b)
+    import math
+    assert width <= math.ceil(mc.ep_slack * (b // mc.dp) / mc.tp)
+    assert m.get("tpu.match.ep_ici_bytes") > 0
+    # 4-row batch: 4 % (dp*tp) != 0 -> replicated fallback, parity holds
+    rows2, _, _ = mc.readback(
+        mc.dispatch(mc.encode(["a/b"], batch=4)), 1)
+    assert sorted(rows2[0]) == sorted(inc.match_host("a/b"))
+    assert m.get("tpu.match.ep_dispatches") == 1  # unchanged
+
+
+def test_node_ep_routed_serves_and_shard_kill_holds_delivery():
+    """The full node with match.multichip.ep.enable: real publishes
+    ride the routed step (ep metrics move), and a killed shard on the
+    ROUTED path still degrades to the CPU trie at delivery 1.0."""
+
+    async def main():
+        node = make_node(**{"match.multichip.ep.enable": True})
+        await node.start()
+        ms = node.match_service
+        assert ms is not None and ms.mc is not None and ms.mc.ep
+        port = node.listeners.all()[0].port
+        try:
+            subs, filters = [], []
+            for i in range(4):
+                c = Client(clientid=f"s{i}", port=port)
+                await c.connect()
+                flt = f"room/+/kind{i % 2}"
+                await c.subscribe(flt, qos=0)
+                subs.append(c)
+                filters.append(flt)
+            assert await settle(lambda: ms.ready and ms.mc.ready)
+            pub = Client(clientid="p", port=port)
+            await pub.connect()
+            topics = [f"room/{i}/kind{i % 2}" for i in range(20)]
+            for t in topics:
+                await pub.publish(t, b"x", qos=0)
+            want = sum(1 for t in topics for f in filters
+                       if T.match(t, f))
+            assert await settle(
+                lambda: sum(s.messages.qsize() for s in subs) >= want)
+            m = node.observed.metrics
+            assert await settle(
+                lambda: m.get("tpu.match.ep_dispatches") >= 1)
+            assert m.get("tpu.match.ep_shard_width") >= 1
+
+            ms.mc.kill_shard(2)
+            topics2 = [f"room/{100 + i}/kind{i % 2}" for i in range(20)]
+            for t in topics2:
+                await pub.publish(t, b"y", qos=0)
+            want2 = want + sum(1 for t in topics2 for f in filters
+                               if T.match(t, f))
+            assert await settle(
+                lambda: sum(s.messages.qsize() for s in subs) >= want2)
+            assert m.get("tpu.match.shard_failover") >= 1
+            for s in subs:
+                await s.disconnect()
+            await pub.disconnect()
         finally:
             await node.stop()
 
