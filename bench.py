@@ -1263,6 +1263,34 @@ def _readback_twophase(r, n, k):
         counts_raw
 
 
+def _readback_ragged(r, n, k):
+    """Bench twin of the ragged single-transfer readback
+    (``match.readback.mode = ragged``): phase 1 the packed (B,)
+    row_meta, phase 2 ONE dynamic_slice padded to the pow2 capacity
+    class and trimmed on host.  Returns (rows, spilled, d2h bytes,
+    raw counts total, d2h round trips) — trips is the headline: 2
+    whenever anything matched, 1 when the meta says nothing did."""
+    import jax
+
+    from emqx_tpu.ops.match_kernel import (
+        decode_row_meta, fetch_flat_ragged, ragged_capacity,
+    )
+
+    meta = jax.device_get(r.row_meta)
+    nk, sp = decode_row_meta(meta)
+    nk = np.minimum(nk, k)
+    total = int(nk[:n].sum())
+    ids = fetch_flat_ragged(r.matches, total)
+    trips = 1 + (1 if total else 0)
+    nbytes = 4 * (meta.size
+                  + ragged_capacity(total, int(r.matches.shape[0])))
+    offs = np.cumsum(nk[:n]) - nk[:n]
+    rows = [ids[o:o + c] for o, c in zip(offs, nk[:n])]
+    counts_raw = int(np.asarray(
+        jax.device_get(r.n_matches))[:n].sum())
+    return rows, np.flatnonzero(sp[:n]), nbytes, counts_raw, trips
+
+
 def _hist_add(hist, key):
     k = str(key)
     hist[k] = hist.get(k, 0) + 1
@@ -1532,6 +1560,170 @@ def bench_serve_pipeline_smoke(n_filters=2000, batch=256, seconds=1.5,
     rate = 0.6 * cap
     out = bench_serve_pipeline(dev, table, topics, batch, rate, seconds,
                                depth=depth)
+    out["table"] = kind
+    out["n_filters"] = len(filters)
+    return out
+
+
+def serve_roundtrip_run(dev, table, topics, batch, target_rate,
+                        seconds, depth=8, window_s=0.0002,
+                        mode="chunked"):
+    """Open-loop serial serve over the two-phase readback contract in
+    one transfer shape.  The headline is the per-batch d2h ROUND-TRIP
+    histogram: chunked pays 1 + popcount(Σcounts), ragged exactly
+    1 + (anything matched) — the quantity a real-link RTT multiplies
+    (BASELINE.md tunnel table)."""
+    import jax.numpy as jnp
+
+    from emqx_tpu.observe.hist import LatencyHistogram
+
+    n_topics = len(topics)
+    k = dev.max_matches
+    h_e2e = LatencyHistogram()
+    trips_hist: dict = {}
+    bytes_total = 0
+    trips_total = 0
+    trips_max = 0
+    batches = 0
+    served = 0
+    spill_reruns = 0
+
+    def _dispatch_once(names):
+        w, l, s = _encode(table, names, depth, batch)
+        return dev.match(jnp.asarray(w), jnp.asarray(l),
+                         jnp.asarray(s),
+                         flat_cap=_serve_flat_cap(batch))
+
+    rb = _readback_ragged if mode == "ragged" else None
+    # warm outside the timed window
+    r0 = _dispatch_once(topics[:batch])
+    (rb or _readback_twophase)(r0, batch, k)
+    t0 = time.perf_counter()
+    stop_at = t0 + seconds
+    warm_at = t0 + seconds * 0.25
+    consumed = 0
+    while True:
+        now = time.perf_counter()
+        if now >= stop_at:
+            break
+        arrived = int((now - t0) * target_rate)
+        avail = arrived - consumed
+        oldest_age = (now - (t0 + consumed / target_rate)
+                      if avail > 0 else 0.0)
+        if avail <= 0 or (avail < batch and oldest_age < window_s):
+            time.sleep(window_s / 2)
+            continue
+        take = min(avail, batch)
+        first = consumed
+        consumed += take
+        names = [topics[(first + j) % n_topics] for j in range(batch)]
+        r = _dispatch_once(names)
+        if rb is not None:
+            rows, sp, nbytes, _counts, trips = rb(r, take, k)
+        else:
+            rows, sp, nbytes, _counts = _readback_twophase(r, take, k)
+            trips = 1 + bin(sum(len(x) for x in rows)).count("1")
+        sp = np.asarray(sp)
+        sp = sp[sp < take]
+        if len(sp):
+            spill_reruns += len(sp)
+            for i in sp:
+                table.match_host(names[i])
+        batches += 1
+        bytes_total += nbytes
+        trips_total += trips
+        trips_max = max(trips_max, trips)
+        _hist_add(trips_hist, trips)
+        done_t = time.perf_counter()
+        served += take
+        if done_t >= warm_at:
+            h_e2e.record_many_s(
+                done_t - (t0 + (first + np.arange(take)) / target_rate))
+    if not batches:
+        return None
+    return {
+        "mode": mode,
+        "offered_rate": int(target_rate),
+        "served": served,
+        "served_rate": int(served / max(seconds, 1e-9)),
+        "p50_ms": round(h_e2e.percentile_ms(50), 2),
+        "p99_ms": round(h_e2e.percentile_ms(99), 2),
+        "batches": batches,
+        "spill_reruns": spill_reruns,
+        "readback_bytes_per_batch": bytes_total // batches,
+        "d2h_calls_hist": trips_hist,
+        "roundtrips_per_batch": round(trips_total / batches, 2),
+        "roundtrips_max": trips_max,
+    }
+
+
+def bench_serve_roundtrip(dev, table, topics, batch, offered_rate,
+                          seconds, depth=8):
+    """Chunked vs ragged readback at EQUAL offered load (ISSUE 17).
+
+    Gate booleans ride the JSON: every ragged batch reads back in ≤ 2
+    d2h round trips (``gate_roundtrips_le_2``) and a same-dispatch
+    probe decodes bit-identical rows through both transfer shapes
+    (``gate_ragged_parity``).  On loopback the trip count is latency
+    noise — the A/B exists to carry the d2h-call histograms whose
+    RTT-multiplied cost the r06 real-hardware round prices."""
+    import jax.numpy as jnp
+
+    # same-dispatch parity probe, outside the timed windows
+    w, l, s = _encode(table, topics[:batch], depth, batch)
+    r = dev.match(jnp.asarray(w), jnp.asarray(l), jnp.asarray(s),
+                  flat_cap=_serve_flat_cap(batch))
+    k = dev.max_matches
+    rows_c, sp_c, _b, _n = _readback_twophase(r, batch, k)
+    rows_r, sp_r, _b2, _n2, probe_trips = _readback_ragged(r, batch, k)
+    parity = (len(rows_c) == len(rows_r)
+              and all(np.array_equal(a, b)
+                      for a, b in zip(rows_c, rows_r))
+              and np.array_equal(sp_c, sp_r))
+    chunked = serve_roundtrip_run(dev, table, topics, batch,
+                                  offered_rate, seconds, depth=depth,
+                                  mode="chunked")
+    ragged = serve_roundtrip_run(dev, table, topics, batch,
+                                 offered_rate, seconds, depth=depth,
+                                 mode="ragged")
+    out = {
+        "offered_rate": int(offered_rate),
+        "batch": batch,
+        "chunked": chunked,
+        "ragged": ragged,
+        "gate_ragged_parity": bool(parity and probe_trips <= 2),
+    }
+    if chunked and ragged:
+        out["roundtrip_ratio"] = round(
+            chunked["roundtrips_per_batch"]
+            / max(ragged["roundtrips_per_batch"], 1e-9), 2)
+        out["bytes_ratio"] = round(
+            ragged["readback_bytes_per_batch"]
+            / max(1, chunked["readback_bytes_per_batch"]), 2)
+        out["gate_roundtrips_le_2"] = bool(ragged["roundtrips_max"] <= 2)
+        # the padding price of the single transfer is bounded: the
+        # capacity class is < 2× the exact prefix
+        out["gate_ragged_bytes_bounded"] = bool(
+            ragged["readback_bytes_per_batch"]
+            <= 2 * chunked["readback_bytes_per_batch"])
+    return out
+
+
+def bench_serve_roundtrip_smoke(n_filters=2000, batch=256, seconds=1.2,
+                                depth=8):
+    """CPU-jax tiny-scale chunked-vs-ragged A/B for bench_e2e --smoke."""
+    from emqx_tpu.ops.device_table import DeviceNfa
+
+    rng = np.random.default_rng(17)
+    filters, topics = build_workload(rng, n_filters, batch * 8, depth)
+    table, kind, _ = build_table(filters, depth)
+    dev = DeviceNfa(table, active_slots=8, compact_output=False,
+                    max_matches=_serve_max_matches())
+    cap = calibrate_serve(dev, table, topics, batch, depth=depth,
+                          seconds=0.8)
+    rate = 0.6 * cap
+    out = bench_serve_roundtrip(dev, table, topics, batch, rate,
+                                seconds, depth=depth)
     out["table"] = kind
     out["n_filters"] = len(filters)
     return out
@@ -2481,6 +2673,15 @@ def main():
             min(args.serve_seconds, 6.0), depth=args.depth)
         note(f"serve pipeline A/B done: {serve_pipeline}")
 
+    # one-round-trip serve A/B (ISSUE 17): chunked vs ragged readback
+    # transfer shape at the same load, d2h-call histograms + gates
+    serve_roundtrip = None
+    if serve_dev:
+        serve_roundtrip = bench_serve_roundtrip(
+            dev, table, topics, args.batch, serve_dev["offered_rate"],
+            min(args.serve_seconds, 6.0), depth=args.depth)
+        note(f"serve roundtrip A/B done: {serve_roundtrip}")
+
     deltas = bench_deltas(dev, table)
     note("deltas done")
 
@@ -2550,6 +2751,7 @@ def main():
         "serve_device_quarter_batch": serve_dev4,
         "serve_deadline": serve_deadline,
         "serve_pipeline": serve_pipeline,
+        "serve_roundtrip": serve_roundtrip,
         "kernel_join": kj,
         "multichip_serve": mcs,
         "multichip_ep": mce,

@@ -119,6 +119,7 @@ class FanoutPipeline:
         olp: Any = None,
         deferred_cap: int = 4096,
         hists: Any = None,
+        e2e_per_leg_sample: int = 0,
         flightrec: Any = None,
     ) -> None:
         self.broker = broker
@@ -172,12 +173,20 @@ class FanoutPipeline:
         # four are written by the drain loop (main plane, one writer).
         self.hists = hists
         self._h_queue = self._h_deliver = None
-        self._h_flush = self._h_e2e = None
+        self._h_flush = self._h_e2e = self._h_e2e_leg = None
+        # per-leg e2e sampling knob (obs.hist.e2e_per_leg_sample):
+        # 0 = off (the leg histogram's recording site is zero-call,
+        # spy-asserted), N = record every Nth delivery leg — the
+        # per-subscriber skew signal without the per-delivery cost
+        self.e2e_per_leg_sample = int(e2e_per_leg_sample)
+        self._leg_ctr = 0
         if hists is not None:
             self._h_queue = hists.hist("obs.stage.fanout_queue")
             self._h_deliver = hists.hist("obs.stage.deliver")
             self._h_flush = hists.hist("obs.stage.flush")
             self._h_e2e = hists.hist("obs.e2e.publish_deliver")
+            if self.e2e_per_leg_sample > 0:
+                self._h_e2e_leg = hists.hist("obs.e2e.publish_deliver_leg")
         # queue-head arrival stamp for the fanout_queue span: set when
         # a message lands in an EMPTY queue, re-armed at each batch pop
         # — per-batch oldest-wait without a parallel timestamp deque
@@ -650,8 +659,10 @@ class FanoutPipeline:
         delivered_taps = hooks.has("message.delivered")
         bmetrics = broker.metrics
         h_e2e = self._h_e2e
+        h_leg = self._h_e2e_leg
         t4 = time.perf_counter_ns() if self._h_deliver is not None else 0
-        now_wall = time.time() if h_e2e is not None else 0.0
+        now_wall = (time.time()
+                    if h_e2e is not None or h_leg is not None else 0.0)
         for clientid, effs in plan.items():
             sess = sessions.get(clientid)
             if sess is None:
@@ -677,6 +688,16 @@ class FanoutPipeline:
                     # pay per-message cost for sub-window resolution);
                     # SlowSubs records per leg when enabled
                     h_e2e.record_s(now_wall - sends[0].msg.timestamp)
+                if h_leg is not None:
+                    # per-LEG variant, every Nth leg across chunks (the
+                    # counter persists, so skewed fan-outs can't dodge
+                    # the sampler by staying under N legs per session)
+                    step = self.e2e_per_leg_sample
+                    for p in sends:
+                        self._leg_ctr += 1
+                        if self._leg_ctr >= step:
+                            self._leg_ctr = 0
+                            h_leg.record_s(now_wall - p.msg.timestamp)
                 bucket = out.get(clientid)
                 if bucket is None:
                     out[clientid] = sends

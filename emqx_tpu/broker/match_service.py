@@ -335,6 +335,8 @@ class MatchService:
         multichip_ep: bool = False,
         multichip_ep_slack: float = 2.0,
         multichip_ep_micro: int = 8,
+        multichip_ep_compact: bool = False,
+        readback_mode: str = "chunked",
         hists: Any = None,
         flightrec: Any = None,
     ) -> None:
@@ -440,13 +442,20 @@ class MatchService:
         # DeviceNfa relation mirror on; flag off every join structure
         # stays unbuilt.
         self.backend = backend
+        # phase-2 readback shape (module docstring): "chunked" = the
+        # pow2 binary decomposition (byte-identical to PR 16), "ragged"
+        # = ONE padded-to-capacity-class transfer per batch (two d2h
+        # round trips total, meta + payload), "auto" = ragged exactly
+        # when the total is not a power of two (pow2 totals are one
+        # chunk either way, so the decomposition already costs 2).
+        self.readback_mode = readback_mode
         self.tuner = None
         self._tuning: Set[str] = set()
         self._seg_join_seed = None   # (epoch, shape_key, arrays)
         # reservoir of recently SERVED topics: what autotune measures
         # with, so picks reflect real traffic shape, not dummy batches
         self._topic_sample: Deque[str] = deque(maxlen=256)
-        if backend in ("join", "auto"):
+        if backend in ("join", "join-pallas", "auto"):
             self.dev.enable_join()
         if backend == "auto" and autotune:
             from ..ops.join_match import BackendAutotuner
@@ -474,7 +483,8 @@ class MatchService:
                     metrics=metrics, kernel_cache=self.kcache,
                     native=multichip_native, ep=multichip_ep,
                     ep_slack=multichip_ep_slack,
-                    ep_micro_matches=multichip_ep_micro)
+                    ep_micro_matches=multichip_ep_micro,
+                    ep_compact=multichip_ep_compact)
             except Exception:
                 log.exception("multichip serve backend unavailable; "
                               "single-chip path serves")
@@ -835,7 +845,7 @@ class MatchService:
         dev.dirty_full_threshold = self.dev.dirty_full_threshold
         dev.dirty_regions = (self.segments
                              and hasattr(inc, "track_regions"))
-        if self.backend in ("join", "auto"):
+        if self.backend in ("join", "join-pallas", "auto"):
             seed, self._seg_join_seed = self._seg_join_seed, None
             dev.enable_join(seed=seed)
         self.dev = dev
@@ -1077,8 +1087,20 @@ class MatchService:
                     jax.device_get(res.n_matches)   # block to completion
                 return go
 
-            self.tuner.measure(
-                sig, {"hash": runner("hash"), "join": runner("join")})
+            runners = {"hash": runner("hash"), "join": runner("join")}
+            # the Pallas join walk competes when the relation fits its
+            # VMEM budget — same answer bits, so losing shapes simply
+            # never route to it
+            try:
+                from ..ops.pallas_match import supports_join_table
+
+                if dev._jarrs is not None and supports_join_table(
+                        dev.arrays()[0], *dev._jarrs):
+                    runners["join-pallas"] = runner("join-pallas")
+            except Exception:
+                log.debug("join-pallas candidate probe for %s failed",
+                          sig, exc_info=True)
+            self.tuner.measure(sig, runners)
             if self.metrics is not None:
                 self.metrics.inc("tpu.match.autotune_picks")
         except Exception:
@@ -1132,7 +1154,7 @@ class MatchService:
                 self.dev.active_slots, self.dev.max_matches,
                 self.dev.compact_output, self.kcache,
                 self.dev.dirty_full_threshold, self._segment_path,
-                self.backend in ("join", "auto"),
+                self.backend in ("join", "join-pallas", "auto"),
             )
         finally:
             self._compact_recording = False
@@ -1598,26 +1620,46 @@ class MatchService:
         return rows, np.flatnonzero(sp[:n]).tolist()
 
     @staticmethod
-    def _readback_rows_twophase(res, n: int, k: int):
-        """Match-proportional two-phase d2h (pipeline mode): phase 1
-        ships the packed (B,) ``row_meta`` vector (counts + fail-open
-        flags), phase 2 exactly ``sum(counts)`` ids from the flat
-        buffer — the first Σ nk[:n] entries are the real rows by the
-        cumsum-offset construction (padding rows pack strictly after).
-        Returns ``(rows, spilled row indices, d2h bytes shipped)``."""
+    def _readback_rows_twophase(res, n: int, k: int,
+                                mode: str = "chunked"):
+        """Match-proportional two-phase d2h: phase 1 ships the packed
+        (B,) ``row_meta`` vector (counts + fail-open flags), phase 2
+        exactly ``sum(counts)`` ids from the flat buffer — the first
+        Σ nk[:n] entries are the real rows by the cumsum-offset
+        construction (padding rows pack strictly after).  ``mode``
+        picks the phase-2 transfer shape: "chunked" is the pow2 binary
+        decomposition (popcount(total) transfers, zero padding bytes),
+        "ragged" ONE padded-to-capacity-class transfer (a batch then
+        costs exactly TWO d2h round trips, meta + payload), "auto"
+        ragged exactly when the total is not a power of two (a pow2
+        total is one chunk either way — identical bytes AND trips).
+        Returns ``(rows, spilled row indices, d2h bytes shipped, d2h
+        round trips performed)``."""
         import jax
 
-        from ..ops.match_kernel import decode_row_meta, fetch_flat_prefix
+        from ..ops.match_kernel import (
+            decode_row_meta, fetch_flat_prefix, fetch_flat_ragged,
+            ragged_capacity,
+        )
 
         meta = jax.device_get(res.row_meta)
         nk, sp = decode_row_meta(meta)
         nk = np.minimum(nk, k)
         total = int(nk[:n].sum())
-        ids = fetch_flat_prefix(res.matches, total)
+        ragged = mode == "ragged" or (
+            mode == "auto" and bool(total & (total - 1)))
+        if ragged:
+            ids = fetch_flat_ragged(res.matches, total)
+            nbytes = 4 * (meta.size +
+                          ragged_capacity(total, int(res.matches.shape[0])))
+            trips = 1 + (1 if total else 0)
+        else:
+            ids = fetch_flat_prefix(res.matches, total)
+            nbytes = 4 * (meta.size + total)
+            trips = 1 + bin(total).count("1")
         offs = np.cumsum(nk[:n]) - nk[:n]
         rows = [ids[o:o + c].tolist() for o, c in zip(offs, nk[:n])]
-        return (rows, np.flatnonzero(sp[:n]).tolist(),
-                4 * (meta.size + total))
+        return rows, np.flatnonzero(sp[:n]).tolist(), nbytes, trips
 
     def _encode_dispatch(self, inc, dev, topics, groups, donate):
         """WORKER-THREAD stage: encode every depth group and dispatch
@@ -1667,7 +1709,7 @@ class MatchService:
                     block_compile=(dev.kernel_cache is None),
                     donate_inputs=donate, backend=be)
                 t2 = time.perf_counter_ns()
-            if be == "join" and self.metrics is not None:
+            if be in ("join", "join-pallas") and self.metrics is not None:
                 # this worker is the single in-flight encode stage, so
                 # the counter has one writer (same as the histograms)
                 self.metrics.inc("tpu.match.backend_join_dispatches")
@@ -1689,30 +1731,38 @@ class MatchService:
 
     def _readback_groups(self, handles, dev, proportional):
         """WORKER-THREAD stage: block on every group's d2h.  Serial
-        (flag-off) mode reads the full flat slab exactly as PR 10 did;
-        ``proportional`` (pipeline mode) rides the two-phase contract.
-        Returns ``([(rows, spilled)...], total d2h bytes, readback
-        ns)``."""
+        (flag-off) mode reads the full flat slab exactly as PR 10 did
+        unless ``match.readback.mode`` asks for the ragged contract;
+        ``proportional`` (pipeline mode) rides the two-phase contract
+        in the configured transfer shape.  Returns ``([(rows,
+        spilled)...], total d2h bytes, readback ns, d2h round
+        trips)``."""
         out = []
         nbytes = 0
         t0 = time.perf_counter_ns()
         total = 0
+        trips = 0
         multichip = getattr(dev, "is_multichip", False)
         for res, n in handles:
             if multichip:
                 # dense compact contract off the mesh: d2h is already
-                # matches-proportional in BOTH serve modes
+                # matches-proportional in BOTH serve modes, one
+                # device_get round trip
                 rows, sp, b = dev.readback(res, n)
-            elif proportional:
-                rows, sp, b = self._readback_rows_twophase(
-                    res, n, dev.max_matches)
+                t = 1
+            elif proportional or self.readback_mode != "chunked":
+                rows, sp, b, t = self._readback_rows_twophase(
+                    res, n, dev.max_matches, mode=self.readback_mode)
             else:
                 rows, sp = self._readback_rows(res, n, dev.max_matches)
                 # the slab cost: the flat id buffer + counts and both
-                # overflow vectors (what device_get above shipped)
+                # overflow vectors (what device_get above shipped) in
+                # one round trip
                 b = 4 * int(res.matches.size + 3 * res.n_matches.size)
+                t = 1
             nbytes += b
             total += n
+            trips += t
             out.append((rows, sp))
         rb_ns = time.perf_counter_ns() - t0
         # single writer: the flag-off serve loop's to_thread hop OR the
@@ -1722,7 +1772,7 @@ class MatchService:
         if self._ring_rb is not None:
             self._ring_rb.push(_SID_READBACK, t0, rb_ns, total,
                                self._table_gen)
-        return out, nbytes, rb_ns
+        return out, nbytes, rb_ns, trips
 
     def _depth_groups(self, topics: List[str]) -> List[Tuple[List[int], int]]:
         """Partition batch indices into (indices, kernel_depth) groups.
@@ -1871,12 +1921,13 @@ class MatchService:
             self._encode_dispatch, inc, dev, topics, groups, False
         )
         await self._readback_gate()
-        results, nbytes, rb_ns = await asyncio.to_thread(
+        results, nbytes, rb_ns, trips = await asyncio.to_thread(
             self._readback_groups, handles, dev, False
         )
         self._note_split((enc_ns + disp_ns) / 1e9, rb_ns / 1e9)
         if self.metrics is not None:
             self.metrics.inc("tpu.match.readback_bytes", nbytes)
+            self.metrics.inc("tpu.match.readback_roundtrips", trips)
         return self._collect_rows(topics, groups, results,
                                   inc, reuses0, gen0)
 
@@ -2274,13 +2325,15 @@ class MatchService:
         try:
             try:
                 await self._readback_gate()
-                results, nbytes, rb_ns = await asyncio.wait_for(
+                results, nbytes, rb_ns, trips = await asyncio.wait_for(
                     asyncio.to_thread(
                         self._readback_groups, handles, dev, True),
                     self.dispatch_timeout_s)
                 self._note_split(dispatch_ns / 1e9, rb_ns / 1e9)
                 if self.metrics is not None:
                     self.metrics.inc("tpu.match.readback_bytes", nbytes)
+                    self.metrics.inc("tpu.match.readback_roundtrips",
+                                     trips)
                 rows = self._collect_rows(topics, groups, results,
                                           inc, reuses0, gen0)
             except asyncio.CancelledError:
@@ -2483,6 +2536,7 @@ class MatchService:
             "pending": len(self._pending),
             # kernel backend routing (ISSUE 13)
             "backend": self.backend,
+            "readback_mode": self.readback_mode,
             "join_rebuilds": self.dev.join_rebuilds,
             "autotune": (self.tuner.info()
                          if self.tuner is not None else None),

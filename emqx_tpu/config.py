@@ -351,6 +351,12 @@ SCHEMA: Dict[str, Field] = {
     # index per record.  The flight recorder (observe/flightrec.py) is
     # ALWAYS on — depth bounds each plane's preallocated event ring.
     "obs.hist.enable": Field(True, _bool),
+    # per-leg e2e latency sampling (broker/fanout.py): record the
+    # publish→deliver span of every Nth DELIVERY LEG (not just the
+    # first leg of a chunk) into obs.e2e.publish_deliver_leg, making
+    # per-subscriber skew visible.  0 = off (zero-call, spy-asserted);
+    # N records ~1/N of legs.
+    "obs.hist.e2e_per_leg_sample": Field(0, int, lambda v: v >= 0),
     "obs.flightrec.depth": Field(4096, int, lambda v: 64 <= v <= 1 << 20),
     "telemetry.enable": Field(False, _bool),
     "telemetry.url": Field("", str),
@@ -452,7 +458,20 @@ SCHEMA: Dict[str, Field] = {
     # serves every dispatch from the sorted-relation kernel (TrieJax
     # recast: searchsorted intersections, no bucket padding), "auto"
     # routes per shape from the measured autotuner pick table
-    "match.backend": Field("hash", _enum("hash", "join", "auto")),
+    # "join-pallas" walks the same sorted relation with the fused
+    # Pallas kernel (ops/pallas_match.py) — identical answer bits,
+    # VMEM-resident tables; auto measures it alongside hash/join
+    "match.backend": Field(
+        "hash", _enum("hash", "join", "join-pallas", "auto")),
+    # phase-2 readback transfer shape (broker/match_service.py):
+    # "chunked" = pow2 binary decomposition (1+popcount(total) d2h
+    # trips, zero padding bytes), "ragged" = ONE padded-to-capacity-
+    # class transfer (exactly TWO trips per batch: meta + payload),
+    # "auto" = ragged exactly when the total is not a power of two.
+    # Capacity classes reuse the chunked (buffer, pow2) executables,
+    # so flipping modes never grows the executable set.
+    "match.readback.mode": Field(
+        "chunked", _enum("chunked", "ragged", "auto")),
     # autotuner (effective only with match.backend=auto): measure
     # hash-vs-join per (B, D, S, Hb) shape on recently served topics;
     # the pick table persists as checksummed JSON next to the XLA disk
@@ -487,6 +506,12 @@ SCHEMA: Dict[str, Field] = {
     # micro-table (merged behind the owning shard's own matches)
     "match.multichip.ep.micro_matches": Field(
         8, int, lambda v: 1 <= v <= 256),
+    # count-compact the routed output on-mesh before d2h: the disjoint
+    # per-shard segments psum-collapse from (B, tp·W) to (B, W), so
+    # routed readback bytes drop ~tp× on literal-rooted tables.
+    # Identical decoded rows (parity-gated); off = the PR-16 routed
+    # segment layout, byte-identical.
+    "match.multichip.ep.compact": Field(False, _bool),
 
     # -- streaming table lifecycle (broker/match_service.py) --------------
     # opt-in: cold start from persistent compacted segments + background

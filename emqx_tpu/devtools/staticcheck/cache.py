@@ -31,7 +31,7 @@ __all__ = ["AnalysisCache", "environment_digest", "CACHE_VERSION"]
 
 # v3: ModuleSummary grew read/acquire sites (the read-set model + the
 # lock-order graph) and findings carry a context chain
-CACHE_VERSION = 3
+CACHE_VERSION = 4
 
 
 def environment_digest(rule_names, registries=None,

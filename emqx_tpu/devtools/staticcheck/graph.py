@@ -647,10 +647,15 @@ class LockOrderGraph:
     shard-loop vs main-loop deadlock no runtime test reliably
     reproduces.
 
-    Lock identity is by declared name (``mutex``, ``a_lock``, …) — the
-    same convention the held-lock tracking uses everywhere else.
-    Same-name nesting is never an edge (the re-entrant ``RLock``
-    pattern)."""
+    Lock identity is object-sensitive: a lock node is keyed on
+    ``(owner class, attr)`` — ``Pair.a_lock`` — whenever the acquire
+    site's receiver chain types (the affinity ``owner_class`` machinery:
+    ``self`` → the enclosing class, attr/var hints for the rest), so
+    two unrelated ``_lock`` attrs on different classes never alias in
+    the graph.  Untyped receivers fall back to the declared name
+    (``mutex``, ``a_lock``, …) — the same convention the held-lock
+    tracking uses everywhere else.  Same-name nesting is never an edge
+    (the re-entrant ``RLock`` pattern)."""
 
     def __init__(self, project: Project) -> None:
         self.project = project
@@ -713,6 +718,37 @@ class LockOrderGraph:
         self.edges.setdefault((held, acquired), []).append(
             (relpath, line, qualname, note))
 
+    def _qualify_chain(self, s, fi, chain: Tuple[str, ...],
+                       name: str) -> str:
+        """Object-sensitive node id for one lock: ``Owner.attr`` when
+        the receiver chain types, else the plain declared name."""
+        if len(chain) >= 2:
+            owner = self.project.owner_class(s, fi, chain[:-1])
+            if owner:
+                return f"{owner}.{name}"
+        return name
+
+    def _qualify(self, s, fi, a) -> str:
+        """Node id of an :class:`..symbols.AcquireSite`."""
+        return self._qualify_chain(s, fi, a.chain, a.name)
+
+    def _qual_map(self, s, fi) -> Dict[str, str]:
+        """plain name → qualified node for THIS function.  Held-lock
+        stacks record plain names, and the stack resets per function,
+        so a held name always refers to one of this function's own
+        acquires.  A name acquired under two DIFFERENT owners in one
+        function stays plain (sound: the plain node only merges what
+        this function genuinely conflates)."""
+        m: Dict[str, str] = {}
+        for a in fi.acquires:
+            q = self._qualify(s, fi, a)
+            prev = m.get(a.name)
+            if prev is None:
+                m[a.name] = q
+            elif prev != q:
+                m[a.name] = a.name
+        return m
+
     def _build(self) -> None:
         project = self.project
         aff = project.affinity()
@@ -722,8 +758,11 @@ class LockOrderGraph:
         calls: Dict[str, List[Tuple[str, str, int,
                                     Tuple[str, ...]]]] = {}
         callers: Dict[str, Set[str]] = {}
+        qmaps: Dict[str, Dict[str, str]] = {}
         for fqid, s, fi in project.functions():
-            direct[fqid] = {a.name for a in fi.acquires}
+            qmaps[fqid] = self._qual_map(s, fi)
+            direct[fqid] = {self._qualify(s, fi, a)
+                            for a in fi.acquires}
             lst = calls.setdefault(fqid, [])
             views = [MAIN]
             if any(p in (SHARD, THREAD)
@@ -756,20 +795,30 @@ class LockOrderGraph:
                 tc.update(got)
                 if len(tc) != before:
                     work.append(caller)
-        # edges: direct nesting + call-through
+        # edges: direct nesting + call-through (held names qualify
+        # through the holder function's own acquire map)
         for fqid, s, fi in project.functions():
+            qm = qmaps.get(fqid, {})
             for a in fi.acquires:
-                for h in a.locks:
-                    self._edge(h, a.name, s.relpath, a.line,
+                qa = self._qualify(s, fi, a)
+                if len(a.held_chains) == len(a.locks):
+                    # held side keyed on its own receiver chain
+                    qheld = [self._qualify_chain(s, fi, hc, h)
+                             for h, hc in zip(a.locks, a.held_chains)]
+                else:  # stale summary without chains: name map
+                    qheld = [qm.get(h, h) for h in a.locks]
+                for qh in qheld:
+                    self._edge(qh, qa, s.relpath, a.line,
                                fi.qualname,
-                               f"with {a.name} while holding {h}")
+                               f"with {qa} while holding {qh}")
             for tid, tqual, line, locks in calls.get(fqid, ()):
                 if not locks:
                     continue
+                qlocks = {qm.get(h, h) for h in locks}
                 for b in trans.get(tid, ()):
-                    if b in locks:
+                    if b in qlocks:
                         continue  # caller already holds it: re-entrant
-                    for h in locks:
+                    for h in qlocks:
                         self._edge(h, b, s.relpath, line, fi.qualname,
                                    f"call into {tqual} which acquires "
                                    f"{b}")

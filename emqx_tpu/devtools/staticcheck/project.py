@@ -339,7 +339,9 @@ TORN_READ_ALLOWED_SITES: Dict[Tuple[str, str], object] = {
 }
 
 #: Reasoned exemptions for the lock-order rule, keyed by the sorted
-#: tuple of the cycle's lock names — e.g. a pair of locks proven to
-#: belong to disjoint object graphs despite sharing a name shape.
+#: tuple of the cycle's lock NODE names — object-qualified
+#: (``Pair.a_lock``) when the acquire sites typed, plain otherwise —
+#: e.g. a pair of locks proven never to contend despite the ordering
+#: edges.
 LOCK_ORDER_ALLOWED: Dict[Tuple[str, ...], str] = {
 }

@@ -11,9 +11,14 @@ across **resolved call edges** for a call made under ``A`` into a
 function whose transitive acquire set contains ``B`` — and this rule
 reports every cycle.
 
-Lock identity is the declared name (``mutex``, ``a_lock``), matching
-the held-lock convention of the affinity/torn-read rules; same-name
-nesting is never an edge (the re-entrant ``RLock`` pattern).  One
+Lock identity is object-sensitive: nodes key on ``(owner class,
+attr)`` — ``Pair.a_lock`` — whenever the acquire site's receiver
+chain types through the affinity ``owner_class`` machinery, so two
+unrelated ``_lock`` attrs on different classes never alias into a
+false cycle; untyped receivers fall back to the declared name
+(``mutex``, ``a_lock``), matching the held-lock convention of the
+affinity/torn-read rules.  Same-name nesting on the SAME owner is
+never an edge (the re-entrant ``RLock`` pattern).  One
 finding per strongly-connected component, anchored at the first
 witness edge, with every witness in the message and the cycle walk in
 ``Finding.chain``.  Reasoned exemptions:

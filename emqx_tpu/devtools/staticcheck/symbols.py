@@ -161,19 +161,32 @@ class ReadSite:
 @dataclass
 class AcquireSite:
     """A ``with <lock>:`` entry: the lock taken and the locks already
-    held — one edge candidate of the lock-order graph."""
+    held — one edge candidate of the lock-order graph.  ``chain`` is
+    the receiver chain of the with-expression after alias expansion
+    (``("self", "a_lock")`` for ``with self.a_lock``) — what the graph
+    uses to key the lock on its OWNER class instead of the bare attr
+    name (two unrelated ``_lock`` attrs must not alias)."""
 
     name: str
     line: int
     col: int
     locks: Tuple[str, ...] = ()   # held BEFORE this acquisition
+    chain: Tuple[str, ...] = ()   # receiver chain incl. the lock attr
+    #: receiver chains of the held locks, parallel to ``locks`` — so
+    #: the held side of an edge keys on its owner too (two same-named
+    #: locks held in one function must not conflate)
+    held_chains: Tuple[Tuple[str, ...], ...] = ()
 
     def to_dict(self) -> list:
-        return [self.name, self.line, self.col, list(self.locks)]
+        return [self.name, self.line, self.col, list(self.locks),
+                list(self.chain),
+                [list(c) for c in self.held_chains]]
 
     @classmethod
     def from_dict(cls, d: list) -> "AcquireSite":
-        return cls(d[0], d[1], d[2], tuple(d[3]))
+        return cls(d[0], d[1], d[2], tuple(d[3]),
+                   tuple(d[4]) if len(d) > 4 else (),
+                   tuple(tuple(c) for c in d[5]) if len(d) > 5 else ())
 
 
 @dataclass
@@ -368,10 +381,11 @@ class _Extractor:
         self.tree = tree
         self.class_stack: List[ClassInfo] = []
         self.func_stack: List[FuncInfo] = []
-        # (lock name, line of the holding ``with``): the line is the
-        # block identity the read-set model distinguishes critical
-        # sections by
-        self.lock_stack: List[Tuple[str, int]] = []
+        # (lock name, line of the holding ``with``, receiver chain):
+        # the line is the block identity the read-set model
+        # distinguishes critical sections by; the chain keys the lock
+        # on its owner in the lock-order graph
+        self.lock_stack: List[Tuple[str, int, Tuple[str, ...]]] = []
         # per-function read dedup: (qualname, chain, attr, locks, blocks)
         self._read_seen: set = set()
 
@@ -388,26 +402,35 @@ class _Extractor:
         return ".".join(parts) if parts else "<module>"
 
     def _locks(self) -> Tuple[str, ...]:
-        return tuple(name for name, _ in self.lock_stack)
+        return tuple(e[0] for e in self.lock_stack)
 
     def _blocks(self) -> Tuple[int, ...]:
-        return tuple(line for _, line in self.lock_stack)
+        return tuple(e[1] for e in self.lock_stack)
 
-    def _lock_name(self, expr: ast.AST) -> Optional[str]:
-        """Terminal lock name of a with-item, following one level of
-        local alias (``mu = sess.mutex`` → ``with mu`` holds "mutex")."""
+    def _held_chains(self) -> Tuple[Tuple[str, ...], ...]:
+        return tuple(e[2] for e in self.lock_stack)
+
+    def _lock_chain(self, expr: ast.AST) -> Optional[Tuple[str, ...]]:
+        """Alias-expanded receiver chain of a with-item whose terminal
+        name looks like a lock, following one level of local alias
+        (``mu = sess.mutex`` → ``with mu`` holds ("sess", "mutex"))."""
         chain = chain_of(expr)
         if chain is None:
             return None
-        name = chain[-1]
         if len(chain) == 1 and self.func_stack:
-            ali = self.func_stack[-1].aliases.get(name)
+            ali = self.func_stack[-1].aliases.get(chain[0])
             if ali:
-                name = ali[-1]
+                chain = ali
+        name = chain[-1]
         if name == "mutex" or name == "lock" or name.endswith("_lock") \
                 or name in ("Lock", "RLock"):
-            return name
+            return chain
         return None
+
+    def _lock_name(self, expr: ast.AST) -> Optional[str]:
+        """Terminal lock name of a with-item (see :meth:`_lock_chain`)."""
+        chain = self._lock_chain(expr)
+        return chain[-1] if chain else None
 
     # -- walk ----------------------------------------------------------
 
@@ -425,14 +448,17 @@ class _Extractor:
         elif isinstance(node, (ast.With, ast.AsyncWith)):
             held = 0
             for item in node.items:
-                name = self._lock_name(item.context_expr)
-                if name is not None:
+                lchain = self._lock_chain(item.context_expr)
+                if lchain is not None:
+                    name = lchain[-1]
                     fn = self.func_stack[-1] if self.func_stack else None
                     if fn is not None:
                         fn.acquires.append(AcquireSite(
                             name=name, line=node.lineno,
-                            col=node.col_offset, locks=self._locks()))
-                    self.lock_stack.append((name, node.lineno))
+                            col=node.col_offset, locks=self._locks(),
+                            chain=lchain,
+                            held_chains=self._held_chains()))
+                    self.lock_stack.append((name, node.lineno, lchain))
                     held += 1
                 self._visit_expr(item.context_expr)
             for child in node.body:

@@ -1086,6 +1086,9 @@ class BrokerNode:
                     "match.multichip.ep.capacity_slack"),
                 multichip_ep_micro=cfg.get(
                     "match.multichip.ep.micro_matches"),
+                multichip_ep_compact=cfg.get(
+                    "match.multichip.ep.compact"),
+                readback_mode=cfg.get("match.readback.mode"),
                 hists=self.hists,
                 flightrec=self.flightrec,
             )
@@ -1121,6 +1124,7 @@ class BrokerNode:
             supervisor=self.supervisor,
             olp=self.olp,
             hists=self.hists,
+            e2e_per_leg_sample=cfg.get("obs.hist.e2e_per_leg_sample"),
             flightrec=self.flightrec,
         )
         await self.fanout_pipeline.start()

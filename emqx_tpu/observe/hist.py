@@ -41,6 +41,10 @@ The stage names map the serve path end to end (see README §span map):
 ``obs.e2e.publish_deliver``   publish timestamp → delivery (sampled once
                               per session per chunk on the batched path;
                               per-leg via SlowSubs when enabled)
+``obs.e2e.publish_deliver_leg``  per-LEG publish→deliver variant, every
+                              Nth delivery leg (the per-subscriber skew
+                              signal; ``obs.hist.e2e_per_leg_sample``,
+                              0 = off and the site is zero-call)
 ========================  ==================================================
 
 **Zero cost when off** (the ``_injector is None`` idiom): recording
@@ -69,6 +73,7 @@ HIST_NAMES: List[str] = [
     "obs.stage.deliver",
     "obs.stage.flush",
     "obs.e2e.publish_deliver",
+    "obs.e2e.publish_deliver_leg",
 ]
 
 # -- bucket geometry --------------------------------------------------------

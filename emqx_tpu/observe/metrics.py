@@ -154,12 +154,16 @@ ROBUSTNESS_METRIC_NAMES: List[str] = [
 # dispatches served by the relational-join backend (inc, one per depth
 # group; opt-in via match.backend) and autotune_picks the per-shape
 # hash-vs-join measurements the autotuner recorded (inc, one per
-# freshly measured shape).
+# freshly measured shape).  readback_roundtrips accumulates the d2h
+# round trips (device_get calls) the readback path performed (inc, by
+# amount per batch group) — the ragged single-transfer contract keeps
+# this at ≤2 per batch where the chunked decomposition pays
+# 1 + popcount(Σcounts).
 MATCH_SERVE_METRIC_NAMES: List[str] = [
     "broker.match.deadline_dispatch", "broker.match.cpu_fallback",
     "broker.match.deadline_miss", "broker.match.breaker_state",
     "broker.match.brownout_level", "broker.match.pipeline_inflight",
-    "tpu.match.readback_bytes",
+    "tpu.match.readback_bytes", "tpu.match.readback_roundtrips",
     "tpu.match.backend_join_dispatches", "tpu.match.autotune_picks",
 ]
 

@@ -475,12 +475,21 @@ class DeviceNfa:
         ``_scatter_rows``).  ``backend`` selects the edge-structure
         kernel ("hash" default; "join" rides the sorted-relation mirror
         and silently falls back to hash while the relation is not yet
-        mirrored — both kernels answer identically)."""
+        mirrored — both kernels answer identically; "join-pallas" walks
+        the same relation with the fused Pallas kernel and falls back
+        to "join" when the shape doesn't fit its tiling contract —
+        flat output only, batch a multiple of its tile)."""
         with self._lock:
             node, edge, seeds = self.arrays()
             be = backend or "hash"
-            if be == "join" and self._jarrs is None:
+            if be in ("join", "join-pallas") and self._jarrs is None:
                 be = "hash"
+            if be == "join-pallas":
+                from .pallas_match import TILE_B
+
+                b = int(words.shape[0])
+                if flat_cap <= 0 or b % min(TILE_B, b):
+                    be = "join"
             kc = self.kernel_cache
             if kc is not None and self.device is None:
                 fn = kc.executable(
@@ -494,9 +503,22 @@ class DeviceNfa:
                     backend=be,
                     block=block_compile,
                 )
-                if be == "join":
+                if be in ("join", "join-pallas"):
                     return fn(words, lens, is_sys, node, *self._jarrs)
                 return fn(words, lens, is_sys, node, edge, seeds)
+            if be == "join-pallas":
+                import jax
+
+                from .pallas_match import pallas_join_match_flat
+
+                return pallas_join_match_flat(
+                    words, lens, is_sys, node, *self._jarrs,
+                    depth=int(words.shape[1]),
+                    active_slots=self.active_slots,
+                    max_matches=self.max_matches,
+                    flat_cap=flat_cap,
+                    interpret=(jax.default_backend() != "tpu"),
+                )
             if be == "join":
                 from .join_match import join_match, join_match_donated
 

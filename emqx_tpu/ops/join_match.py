@@ -539,7 +539,8 @@ class BackendAutotuner:
                 raise ValueError(f"version {doc.get('version')!r}")
             picks = doc.get("picks")
             if not isinstance(picks, dict) or any(
-                    v not in ("hash", "join") for v in picks.values()):
+                    v not in ("hash", "join", "join-pallas")
+                    for v in picks.values()):
                 raise ValueError("malformed picks")
             if doc.get("checksum") != self._checksum(picks):
                 raise ValueError("checksum mismatch")

@@ -41,7 +41,9 @@ __all__ = ["MatchKernelCache", "CompileMiss"]
 #: the cuckoo-probe nfa_match, "join" the sorted-relation kernel
 #: (ops/join_match.py) whose edge-structure shapes DERIVE from the same
 #: (S, Hb) pair (relation capacity = Hb * BUCKET_SLOTS), so one shape
-#: key covers both families.  ``mesh`` is None for single-device keys;
+#: key covers both families; "join-pallas" is the same join relation
+#: walked by the fused Pallas kernel (ops/pallas_match.py) — identical
+#: operand shapes, flat-output only.  ``mesh`` is None for single-device keys;
 #: the multichip serve backend (parallel/multichip_serve.py) keys its
 #: shard_map executables with ``(dp, tp, acap)`` and installs a
 #: ``mesh_lower`` hook the cache delegates those keys to — the same
@@ -266,6 +268,30 @@ class MatchKernelCache:
                 sd((OVERLAY_CAP, 3), i32),        # overlay
                 active_slots=a, max_matches=m,
                 compact_output=compact, flat_cap=flat_cap,
+            )
+            return lowered.compile()
+        if backend == "join-pallas":
+            from .join_match import OVERLAY_CAP, relation_capacity
+            from .pallas_match import (
+                pallas_join_match_flat, pallas_join_match_flat_donated,
+            )
+
+            if flat_cap <= 0:
+                raise ValueError(
+                    "join-pallas backend is flat-output only "
+                    "(flat_cap > 0 required)")
+            e_cap = relation_capacity(hb)
+            fn = (pallas_join_match_flat_donated if donate
+                  else pallas_join_match_flat)
+            lowered = fn.lower(
+                *batch,
+                sd((s + 1,), i32),                # state_start
+                sd((e_cap,), i32),                # edge_word
+                sd((e_cap,), i32),                # edge_next
+                sd((OVERLAY_CAP, 3), i32),        # overlay
+                depth=d, active_slots=a, max_matches=m,
+                flat_cap=flat_cap,
+                interpret=(jax.default_backend() != "tpu"),
             )
             return lowered.compile()
         fn = nfa_match_donated if donate else nfa_match

@@ -47,7 +47,8 @@ from .compiler import BUCKET_SLOTS, NfaTable, encode_topics
 
 __all__ = ["MatchResult", "SERVE_FLAT_MULT", "build_matcher",
            "decode_flat", "decode_row_meta", "fetch_flat_prefix",
-           "match_topics", "nfa_match", "nfa_match_donated", "nfa_walk"]
+           "fetch_flat_ragged", "match_topics", "nfa_match",
+           "nfa_match_donated", "nfa_walk", "ragged_capacity"]
 
 # serving flat-output capacity per padded batch row (ids/topic): shared
 # by every serving engine so the fan-out tuning cannot drift between
@@ -104,6 +105,41 @@ def fetch_flat_prefix(matches, total: int) -> np.ndarray:
             rem -= bit
         bit >>= 1
     return np.concatenate(parts) if len(parts) > 1 else parts[0]
+
+
+def ragged_capacity(total: int, flat_cap: int) -> int:
+    """Capacity class for a ragged single-transfer readback: the
+    smallest pow2 ≥ ``total``, clipped to the flat buffer size.  The
+    class set is what bounds the executable count (≤ log2(flat_cap)
+    distinct slice sizes per buffer shape — the same discipline as the
+    binary decomposition, reused by :func:`fetch_flat_ragged`)."""
+    if total <= 0:
+        return 0
+    return min(1 << max(0, int(total) - 1).bit_length(), int(flat_cap))
+
+
+def fetch_flat_ragged(matches, total: int) -> np.ndarray:
+    """Single-transfer twin of :func:`fetch_flat_prefix`: ship the
+    first ``total`` ids of the flat buffer in ONE d2h.
+
+    The chunked decomposition keeps bytes exact (4·total) but pays one
+    d2h round trip per set bit of ``total`` — on a high-latency link
+    p99 tracks RTT·popcount instead of kernel time.  Here the prefix
+    is fetched as ONE ``dynamic_slice`` padded up to its pow2
+    **capacity class** (:func:`ragged_capacity`) and trimmed on host:
+    the slice SIZE stays static (the executables are the SAME
+    (buffer, pow2) pairs the chunked path compiles, so mode flips
+    never grow the executable set) and the transfer count is exactly
+    one.  Bytes shipped = 4·capacity ≤ 8·total — the padding is the
+    price of the round trip, which is the right trade whenever RTT
+    beats bandwidth (BASELINE.md tunnel table)."""
+    import jax
+
+    if total <= 0:
+        return np.empty(0, np.int32)
+    cap = ragged_capacity(total, int(matches.shape[0]))
+    chunk = jax.lax.dynamic_slice(matches, (jnp.int32(0),), (cap,))
+    return np.asarray(jax.device_get(chunk))[:int(total)]
 
 
 class MatchResult(NamedTuple):

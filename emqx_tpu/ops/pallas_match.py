@@ -39,7 +39,9 @@ import numpy as np
 from .compiler import BUCKET_SLOTS
 
 __all__ = ["pallas_small_match", "pallas_small_match_flat",
-           "supports_table", "bench_pallas_small"]
+           "pallas_join_match", "pallas_join_match_flat",
+           "pallas_join_match_flat_donated", "supports_table",
+           "supports_join_table", "bench_pallas_small"]
 
 VMEM_BUDGET_BYTES = 8 << 20   # tables beyond this stay on nfa_match
 TILE_B = 256                  # batch rows per grid step
@@ -47,6 +49,16 @@ TILE_B = 256                  # batch rows per grid step
 
 def supports_table(node_tab: np.ndarray, edge_tab: np.ndarray) -> bool:
     return (node_tab.nbytes + edge_tab.nbytes) <= VMEM_BUDGET_BYTES
+
+
+def supports_join_table(node_tab, state_start, edge_word,
+                        edge_next, overlay) -> bool:
+    """VMEM fit check for the join-relation walk: node table + CSR
+    offsets + both relation columns + the overlay must co-reside."""
+    total = sum(int(np.asarray(a).nbytes)
+                for a in (node_tab, state_start, edge_word, edge_next,
+                          overlay))
+    return total <= VMEM_BUDGET_BYTES
 
 
 def _hash(state, word, seed, mask):
@@ -59,21 +71,14 @@ def _hash(state, word, seed, mask):
     return (h & jnp.uint32(mask)).astype(jnp.int32)
 
 
-def _kernel(words_ref, lens_ref, issys_ref, node_ref, edge_ref, seeds_ref,
-            acc_ref, aover_ref, *, depth: int, active_slots: int):
-    """One batch tile: the full D-step walk with VMEM-resident tables.
+def _walk_tile(words, lens, is_sys, node_tab, lit_lookup,
+               acc_ref, aover_ref, *, depth: int, active_slots: int):
+    """One batch tile: the full D-step walk with VMEM-resident tables,
+    the literal-edge lookup pluggable (the ``nfa_walk`` factoring).
 
     Mirrors ``nfa_match`` exactly (same per-step widths, same accept
     slot layout) so parity is bit-for-bit and callers can decode with
     the same host code."""
-    words = words_ref[...]
-    lens = lens_ref[...]
-    is_sys = issys_ref[...]
-    node_tab = node_ref[...]
-    edge_tab = edge_ref[...]
-    seeds = seeds_ref[...]
-    Hb = edge_tab.shape[0]
-    mask = Hb - 1
     B = words.shape[0]
     A = active_slots
 
@@ -95,16 +100,7 @@ def _kernel(words_ref, lens_ref, issys_ref, node_ref, edge_ref, seeds_ref,
         if t == depth:
             break
         w = jnp.broadcast_to(words[:, t][:, None], active.shape)
-        hits = []
-        for k in range(2):
-            b = _hash(active, w, seeds[k], mask)
-            rows = edge_tab[b].reshape(B, active.shape[1],
-                                       BUCKET_SLOTS, 4)
-            hit = (rows[..., 0] == active[..., None]) & (
-                rows[..., 1] == w[..., None])
-            hits.append(jnp.max(jnp.where(hit, rows[..., 2], -1),
-                                axis=-1))
-        lit = jnp.where(valid, jnp.maximum(hits[0], hits[1]), -1)
+        lit = jnp.where(valid, lit_lookup(active, w), -1)
         plus = jnp.where(valid, node[..., 0], -1)
         if t == 0:
             plus = jnp.where(is_sys[:, None], -1, plus)
@@ -118,6 +114,92 @@ def _kernel(words_ref, lens_ref, issys_ref, node_ref, edge_ref, seeds_ref,
             n_kept = jnp.sum((active >= 0).astype(jnp.int32), axis=1)
             aover = aover + (n_cand - n_kept)
     aover_ref[...] = aover
+
+
+def _kernel(words_ref, lens_ref, issys_ref, node_ref, edge_ref, seeds_ref,
+            acc_ref, aover_ref, *, depth: int, active_slots: int):
+    """Hash-backend tile: the cuckoo 2-choice probe as the literal
+    lookup, every probe hitting VMEM."""
+    edge_tab = edge_ref[...]
+    seeds = seeds_ref[...]
+    Hb = edge_tab.shape[0]
+    mask = Hb - 1
+    B = words_ref.shape[0]
+
+    def lookup(active, w):
+        hits = []
+        for k in range(2):
+            b = _hash(active, w, seeds[k], mask)
+            rows = edge_tab[b].reshape(B, active.shape[1],
+                                       BUCKET_SLOTS, 4)
+            hit = (rows[..., 0] == active[..., None]) & (
+                rows[..., 1] == w[..., None])
+            hits.append(jnp.max(jnp.where(hit, rows[..., 2], -1),
+                                axis=-1))
+        return jnp.maximum(hits[0], hits[1])
+
+    _walk_tile(words_ref[...], lens_ref[...], issys_ref[...],
+               node_ref[...], lookup, acc_ref, aover_ref,
+               depth=depth, active_slots=active_slots)
+
+
+def _join_kernel(words_ref, lens_ref, issys_ref, node_ref, start_ref,
+                 eword_ref, enext_ref, overlay_ref, acc_ref, aover_ref,
+                 *, depth: int, active_slots: int):
+    """Join-backend tile: the whole sorted-relation lower-bound walk
+    (``ops/join_match._join_edge_lookup`` ported verbatim — CSR
+    segment bounds + unrolled binary search, then the sorted-overlay
+    lower bound) runs on-chip, so the seed-free join backend composes
+    with the VMEM walk end-to-end — no per-step HBM round trips, no
+    host bounce for the search steps."""
+    state_start = start_ref[...]
+    edge_word = eword_ref[...]
+    edge_next = enext_ref[...]
+    overlay = overlay_ref[...]
+    E = int(edge_word.shape[0])
+    steps = max(1, E.bit_length())          # ceil(log2(E)) + 1 margin
+    o_state = overlay[:, 0]
+    o_word = overlay[:, 1]
+    o_next = overlay[:, 2]
+    cap = int(o_state.shape[0])
+    osteps = max(1, cap.bit_length())
+
+    def lookup(active, word):
+        sa = jnp.maximum(active, 0)          # safe gather index
+        lo = state_start[sa]
+        hi0 = state_start[sa + 1]
+        hi = hi0
+        for _ in range(steps):
+            act = lo < hi
+            mid = (lo + hi) >> 1
+            wm = edge_word[jnp.clip(mid, 0, E - 1)]
+            right = act & (wm < word)
+            lo = jnp.where(right, mid + 1, lo)
+            hi = jnp.where(act & ~right, mid, hi)
+        pos = jnp.clip(lo, 0, E - 1)
+        hit = (lo < hi0) & (edge_word[pos] == word)
+        nxt = jnp.where(hit, edge_next[pos], -1)
+        # sorted overlay: lexicographic (state, word) lower bound
+        olo = jnp.zeros_like(active)
+        ohi = jnp.full_like(active, cap)
+        for _ in range(osteps):
+            act = olo < ohi
+            mid = (olo + ohi) >> 1
+            midc = jnp.clip(mid, 0, cap - 1)
+            ms = o_state[midc]
+            mw = o_word[midc]
+            right = act & ((ms < active) | ((ms == active) & (mw < word)))
+            olo = jnp.where(right, mid + 1, olo)
+            ohi = jnp.where(act & ~right, mid, ohi)
+        opos = jnp.clip(olo, 0, cap - 1)
+        ohit = ((olo < cap) & (o_state[opos] == active)
+                & (o_word[opos] == word))
+        nxt_o = jnp.where(ohit, o_next[opos], -1)
+        return jnp.maximum(nxt, nxt_o)
+
+    _walk_tile(words_ref[...], lens_ref[...], issys_ref[...],
+               node_ref[...], lookup, acc_ref, aover_ref,
+               depth=depth, active_slots=active_slots)
 
 
 def _accept_cols(depth: int, active_slots: int) -> int:
@@ -196,6 +278,93 @@ def pallas_small_match_flat(words, lens, is_sys, node_tab, edge_tab,
     return MatchResult(matches=matches, n_matches=n,
                        active_overflow=aover, match_overflow=mover,
                        row_meta=row_meta)
+
+
+@partial(jax.jit, static_argnames=("depth", "active_slots", "interpret"))
+def pallas_join_match(words, lens, is_sys, node_tab, state_start,
+                      edge_word, edge_next, overlay, *, depth: int,
+                      active_slots: int = 8,
+                      interpret: bool = False) -> Tuple[jax.Array,
+                                                        jax.Array]:
+    """Join-relation twin of :func:`pallas_small_match`: the unrolled
+    lower-bound walk (``join-pallas`` backend) over VMEM-resident CSR
+    relation arrays.  -> (raw accept slots (B, C), active_overflow
+    (B,)) — the same raw-mode layout as ``nfa_match
+    (compact_output=False)``.  Tiles adapt down to the batch (pow2
+    serve buckets below ``TILE_B`` run as one tile), so the warm
+    shapes (B=64) compile without padding."""
+    from jax.experimental import pallas as pl
+
+    B, D = words.shape
+    assert D == depth, (D, depth)
+    tile = min(TILE_B, B)
+    if B % tile:
+        raise ValueError(f"batch {B} must be a multiple of {tile}")
+    C = _accept_cols(depth, active_slots)
+    kernel = partial(_join_kernel, depth=depth,
+                     active_slots=active_slots)
+    grid = (B // tile,)
+    acc, aover = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, C), jnp.int32),
+            jax.ShapeDtypeStruct((B,), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile, D), lambda i: (i, 0)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec(node_tab.shape, lambda i: (0, 0)),
+            pl.BlockSpec(state_start.shape, lambda i: (0,)),
+            pl.BlockSpec(edge_word.shape, lambda i: (0,)),
+            pl.BlockSpec(edge_next.shape, lambda i: (0,)),
+            pl.BlockSpec(overlay.shape, lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile, C), lambda i: (i, 0)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+        ),
+        interpret=interpret,
+    )(words, lens, is_sys, node_tab, state_start, edge_word,
+      edge_next, overlay)
+    return acc, aover
+
+
+_JOIN_FLAT_STATIC = ("depth", "active_slots", "max_matches", "flat_cap",
+                     "interpret")
+
+
+def _pallas_join_match_flat(words, lens, is_sys, node_tab, state_start,
+                            edge_word, edge_next, overlay, *,
+                            depth: int, active_slots: int = 8,
+                            max_matches: int = 32, flat_cap: int,
+                            interpret: bool = False):
+    from .match_kernel import MatchResult, flat_epilogue
+
+    acc, aover = pallas_join_match(
+        words, lens, is_sys, node_tab, state_start, edge_word,
+        edge_next, overlay, depth=depth, active_slots=active_slots,
+        interpret=interpret)
+    n = jnp.sum((acc >= 0).astype(jnp.int32), axis=1)
+    matches, mover, row_meta = flat_epilogue(
+        acc, n, aover, max_matches, flat_cap)
+    return MatchResult(matches=matches, n_matches=n,
+                       active_overflow=aover, match_overflow=mover,
+                       row_meta=row_meta)
+
+
+#: Pallas join walk + the SHARED flat compaction epilogue — the same
+#: readback contract as ``nfa_match(flat_cap=...)`` / ``join_match``,
+#: so the two-phase (and ragged) d2h decode is backend-agnostic.
+pallas_join_match_flat = jax.jit(
+    _pallas_join_match_flat, static_argnames=_JOIN_FLAT_STATIC)
+
+#: pipelined twin: batch operands donated, table/relation arrays NOT
+#: (they serve every in-flight batch) — the nfa_match_donated contract
+pallas_join_match_flat_donated = jax.jit(
+    _pallas_join_match_flat, static_argnames=_JOIN_FLAT_STATIC,
+    donate_argnums=(0, 1, 2))
 
 
 def bench_pallas_small(n_filters: int = 50_000, batch: int = 8192,

@@ -133,7 +133,8 @@ def _scatter_stacked(tab, tvec, idx, rows):
 
 def build_multichip_step(mesh, active_slots: int = 16,
                          max_matches: int = 32, micro_matches: int = 8,
-                         routed: bool = False, capacity: int = 0):
+                         routed: bool = False, capacity: int = 0,
+                         compact: bool = False):
     """Return a jitted ``step(words, lens, is_sys, node_stk, edge_stk,
     seeds_stk, aid_stk, micro_node, micro_edge, micro_seeds,
     micro_amap, word_owner) -> CompactFanoutResult``.
@@ -156,7 +157,14 @@ def build_multichip_step(mesh, active_slots: int = 16,
     root.  The owner merges its own + micro answers into ITS segment
     (other segments stay count-0 for that row), so no return
     ``all_to_all`` is needed.  Rows past ``capacity`` fail open
-    (match_overflow) at the source."""
+    (match_overflow) at the source.
+
+    ``compact=True`` (routed only) applies the count-compact contract
+    to the ROUTED output: exactly one owner writes each row, so a
+    psum over ``tp`` of the bias-encoded segments collapses the
+    (B, tp·W) id plane to (B, W) and counts to (B, 1) — routed d2h
+    drops ~tp× with identical decoded rows (the owner's segment is
+    already contiguous from 0)."""
     from ..ops.match_kernel import nfa_match
 
     K = max_matches
@@ -164,6 +172,8 @@ def build_multichip_step(mesh, active_slots: int = 16,
     W = K + Km
     tp = mesh.shape["tp"]
     C = capacity
+    compact = bool(compact) and bool(routed)
+    seg_spec = P("dp", None) if compact else P("dp", "tp")
 
     def merge_micro(gids, cnt_own, mg, mcnt):
         """Pack ``mcnt`` micro ids behind each row's ``cnt_own`` own
@@ -196,9 +206,9 @@ def build_multichip_step(mesh, active_slots: int = 16,
             P(None),              # word_owner
         ),
         out_specs=CompactFanoutResult(
-            ids=P("dp", "tp"),
-            counts=P("dp", "tp"),
-            overflow=P("dp", "tp"),
+            ids=seg_spec,
+            counts=seg_spec,
+            overflow=seg_spec,
             n_matches=P("dp"),
             active_overflow=P("dp"),
             match_overflow=P("dp"),
@@ -318,6 +328,22 @@ def build_multichip_step(mesh, active_slots: int = 16,
         # them into the fail-open set alongside owner-side truncation
         src_ov = jax.lax.dynamic_update_slice(
             jnp.zeros((Bl,), jnp.int32), bucket_ov, (start,))
+        if compact:
+            # exactly ONE owner wrote each row (the partition makes
+            # segments disjoint; non-owners left -1/0), so a psum of
+            # the +1-biased ids collapses tp segments into one (B, W)
+            # plane — the contiguous-from-0 owner segment survives
+            # verbatim and routed d2h bytes drop ~tp×
+            ids_c = jax.lax.psum(
+                jnp.where(ids_out >= 0, ids_out + 1, 0), "tp") - 1
+            return CompactFanoutResult(
+                ids=ids_c,
+                counts=jax.lax.psum(cnt_out, "tp")[:, None],
+                overflow=jax.lax.psum(seg_ov, "tp")[:, None],
+                n_matches=jax.lax.psum(nm, "tp"),
+                active_overflow=jax.lax.psum(ao, "tp"),
+                match_overflow=jax.lax.psum(seg_ov + src_ov, "tp"),
+            )
         return CompactFanoutResult(
             ids=ids_out,
             counts=cnt_out[:, None],
@@ -362,6 +388,7 @@ class MultichipMatcher:
         ep: bool = False,
         ep_slack: float = 2.0,
         ep_micro_matches: int = 8,
+        ep_compact: bool = False,
     ) -> None:
         from .mesh import make_mesh
 
@@ -379,6 +406,10 @@ class MultichipMatcher:
         self.ep = bool(ep)
         self.ep_slack = float(ep_slack)
         self.ep_micro_matches = int(ep_micro_matches)
+        # count-compact the routed output before d2h (ISSUE 17): the
+        # (B, tp·W) segment plane collapses to (B, W) on-mesh, so
+        # routed readback bytes drop ~tp× on literal-rooted tables
+        self.ep_compact = bool(ep_compact)
         if native:
             from ..native.nfa import available
 
@@ -409,7 +440,7 @@ class MultichipMatcher:
         self._restack_due = False      # segment restore awaiting upload
         self._arrs: Optional[Tuple[Any, ...]] = None
         self._stacked_shape: Optional[Tuple[int, ...]] = None
-        self._steps: Dict[Tuple[int, int, bool], Any] = {}
+        self._steps: Dict[Tuple[int, int, int], Any] = {}
         self._routed_live: set = set()  # id(res) of in-flight EP handles
         self._dead: set = set()
         self.gen = 0                    # bumped on every restack
@@ -894,6 +925,10 @@ class MultichipMatcher:
     def _step_for(self, batch_shape: Tuple[int, int], routed: bool, *,
                   block_compile: bool = True):
         cap = self.ep_capacity(batch_shape[0]) if routed else 0
+        # mesh-key ``kind``: 0 = replicated, 1 = routed, 2 = routed
+        # with the count-compact output contract
+        compact = routed and self.ep_compact
+        kind = (2 if compact else 1) if routed else 0
         kc = self.kernel_cache
         if kc is not None and self._stacked_shape is not None:
             smax, hbmax, acap, sm, hbm, am, wcap = self._stacked_shape
@@ -902,17 +937,17 @@ class MultichipMatcher:
                 active_slots=self.active_slots,
                 max_matches=self.max_matches,
                 compact_output=True, flat_cap=0,
-                mesh=(self.dp, self.tp, acap, 1 if routed else 0, cap,
+                mesh=(self.dp, self.tp, acap, kind, cap,
                       sm, hbm, am, wcap, self.ep_micro_matches),
                 block=block_compile,
             )
-        key = (int(batch_shape[0]), int(batch_shape[1]), routed)
+        key = (int(batch_shape[0]), int(batch_shape[1]), kind)
         fn = self._steps.get(key)
         if fn is None:
             fn = self._steps[key] = build_multichip_step(
                 self.mesh, self.active_slots, self.max_matches,
                 micro_matches=self.ep_micro_matches,
-                routed=routed, capacity=cap)
+                routed=routed, capacity=cap, compact=compact)
         return fn
 
     def _lower_step(self, key):
@@ -926,7 +961,7 @@ class MultichipMatcher:
         _dp, _tp, acap, kind, cap, sm, hbm, am, wcap, km = key[10]
         step = build_multichip_step(
             self.mesh, key[4], key[5], micro_matches=km,
-            routed=bool(kind), capacity=cap)
+            routed=kind >= 1, capacity=cap, compact=kind == 2)
         sd = jax.ShapeDtypeStruct
         i32 = jnp.int32
         return step.lower(
@@ -1159,6 +1194,7 @@ class MultichipMatcher:
             "ready": self.ready,
             "native": self.native,
             "ep": self.ep,
+            "ep_compact": self.ep_compact,
             "gen": self.gen,
             "dispatches": self.dispatches,
             "ep_dispatches": self.ep_dispatches,

@@ -761,7 +761,8 @@ def main(argv=None) -> dict:
         _table_lifecycle_size, bench_adversarial, bench_config1,
         bench_config1_sweep, bench_fanout_e2e, bench_kernel_join_smoke,
         bench_qos1_e2e, bench_qos2_e2e, bench_serve_deadline_smoke,
-        bench_serve_pipeline_smoke, bench_table_lifecycle,
+        bench_serve_pipeline_smoke, bench_serve_roundtrip_smoke,
+        bench_table_lifecycle,
     )
 
     size = _fanout_e2e_size(args.smoke)
@@ -793,6 +794,12 @@ def main(argv=None) -> dict:
     # host-dependent p99 bound (1-core hosts can't overlap stages)
     out["serve_pipeline"] = bench_serve_pipeline_smoke(
         seconds=(1.2 if args.smoke else 4.0))
+    # one-round-trip serve A/B (ISSUE 17): chunked vs ragged readback
+    # transfer shape at equal load — the ≤2-round-trip and bit-parity
+    # gates are CI-asserted; the latency ratio is a tracking number
+    # (loopback d2h has no RTT for the single transfer to win back)
+    out["serve_roundtrip"] = bench_serve_roundtrip_smoke(
+        seconds=(1.0 if args.smoke else 3.0))
     # streaming table lifecycle A/B (ISSUE 9): segment cold start vs
     # full rebuild + churn soak across live compaction swaps
     out["table_lifecycle"] = bench_table_lifecycle(

@@ -1,0 +1,35 @@
+"""Fixture: object-sensitive lock-order must NOT flag this.
+
+A lock chain across three classes: Writer._lock → Journal.mutex →
+Index._lock.  Name-keyed identity aliased the two unrelated ``_lock``
+attrs into one node and reported a false ``_lock ⇄ mutex`` cycle;
+keyed on (owner class, attr) the chain is acyclic."""
+
+import threading
+
+
+class Index:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class Journal:
+    def __init__(self):
+        self.mutex = threading.Lock()
+        self.index = Index()
+
+    def rotate(self):
+        with self.mutex:
+            with self.index._lock:
+                return 1
+
+
+class Writer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.journal = Journal()
+
+    def append(self):
+        with self._lock:
+            with self.journal.mutex:
+                return 2

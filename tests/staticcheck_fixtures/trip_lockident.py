@@ -1,0 +1,31 @@
+"""Fixture: object-sensitive lock-order MUST flag this (1 cycle).
+
+Two DIFFERENT classes each own a ``_lock``: ``Front.push`` takes
+Front's then Back's, ``Back.drain`` takes Back's then Front's.
+Name-keyed identity saw same-name nesting (the re-entrant RLock
+pattern) and suppressed both edges — a missed deadlock; keying on
+(owner class, attr) yields Front._lock ⇄ Back._lock."""
+
+import threading
+
+
+class Back:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.front = Front()
+
+    def drain(self):
+        with self._lock:
+            with self.front._lock:
+                return 1
+
+
+class Front:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.back = Back()
+
+    def push(self):
+        with self._lock:
+            with self.back._lock:
+                return 2

@@ -102,6 +102,22 @@ def test_bench_e2e_smoke_delivers_everything():
     for side in ("serial", "pipeline"):
         assert sp[side]["gate_hist_parity"], (side, sp[side])
         assert sp[side]["stages"]["match_readback"]["count"] > 0, sp
+    # one-round-trip serve A/B (ISSUE 17): chunked vs ragged readback
+    # transfer shape at equal load — every ragged batch read back in
+    # ≤ 2 d2h round trips with bit-identical rows to the chunked
+    # decomposition, the padding stayed under 2x the exact prefix, and
+    # the d2h-call histograms rode the JSON for the r06 hardware round
+    # (loopback has no RTT, so the latency ratio is a tracking number)
+    sr = out["serve_roundtrip"]
+    assert sr["gate_ragged_parity"], sr
+    assert sr["gate_roundtrips_le_2"], sr
+    assert sr["gate_ragged_bytes_bounded"], sr
+    assert sr["chunked"]["served"] > 0, sr
+    assert sr["ragged"]["served"] > 0, sr
+    assert sr["ragged"]["roundtrips_max"] <= 2, sr
+    assert sr["ragged"]["d2h_calls_hist"], sr
+    assert sr["chunked"]["d2h_calls_hist"], sr
+    assert sr["roundtrip_ratio"] >= 1.0, sr
     # kernel backend A/B (ISSUE 13): the join kernel answers every
     # shape bit-for-bit like the hash kernel (matches, counts,
     # row_meta, overflow vectors), the autotuner picked a real backend

@@ -656,3 +656,65 @@ def test_node_ep_routed_serves_and_shard_kill_holds_delivery():
             await node.stop()
 
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# count-compacted routed readback (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_ep_compact_parity_and_bytes_reduction():
+    """The routed step's tp·K-wide segment answers collapse to one
+    K-wide segment per row under ``ep_compact``: rows stay bit-equal
+    to the routed AND replicated contracts (and the host walk) while
+    the routed d2h bytes drop ~tp× — exactly one owner wrote each
+    row, so the psum-merge loses nothing."""
+    inc, mc_rep, pairs = build_pair()
+    mc_ep = MultichipMatcher(depth=8, ep=True, ep_slack=4.0)
+    mc_ep.rebuild(pairs)
+    assert mc_ep.apply_pending()
+    mc_c = MultichipMatcher(depth=8, ep=True, ep_slack=4.0,
+                            ep_compact=True)
+    mc_c.rebuild(pairs)
+    assert mc_c.apply_pending()
+    assert mc_c.info()["ep_compact"] is True
+    assert mc_ep.info()["ep_compact"] is False
+    topics = topics_for(48)
+    rows_r, sp_r, nb_r = mesh_rows(mc_rep, topics)
+    rows_e, sp_e, nb_e = mesh_rows(mc_ep, topics)
+    rows_c, sp_c, nb_c = mesh_rows(mc_c, topics)
+    assert mc_c.ep_dispatches == 1 and mc_ep.ep_dispatches == 1
+    assert not sp_r and not sp_e and not sp_c
+    for t, rr, re_, rc in zip(topics, rows_r, rows_e, rows_c):
+        assert sorted(rc) == sorted(re_) == sorted(rr) \
+            == sorted(inc.match_host(t)), t
+    # the compact contract ships (B, K) ids instead of (B, tp·K)
+    assert nb_c < nb_e, (nb_c, nb_e)
+    assert nb_c <= nb_e // 2, (nb_c, nb_e)
+
+
+def test_ep_compact_overflow_fails_open():
+    """Bucket overflow under the compact contract keeps the fail-open
+    discipline: psum carries every shard's overflow flag into the
+    collapsed row, so skewed rows are flagged for the CPU trie and
+    unflagged rows stay complete."""
+    inc, mc, _pairs = build_ep_pair(ep_slack=1.0, ep_compact=True)
+    topics = [f"x/{i}/z" for i in range(24)] + ["x/y/z"] * 8
+    rows, sp, _ = mc.readback(
+        mc.dispatch(mc.encode(topics, batch=64)), len(topics))
+    assert sp, "expected bucket overflow on the skewed corpus"
+    spset = set(sp)
+    assert len(spset) < len(topics), "slack must keep some rows routed"
+    for i, t in enumerate(topics):
+        if i not in spset:
+            assert sorted(rows[i]) == sorted(inc.match_host(t)), t
+
+
+def test_ep_compact_odd_batch_falls_back_replicated():
+    """Batch shapes that can't split into tp source slices fall back
+    to the replicated step under ep_compact too — same fallback gate,
+    parity holds."""
+    inc, mc, _pairs = build_ep_pair(ep_slack=4.0, ep_compact=True)
+    rows, _, _ = mc.readback(
+        mc.dispatch(mc.encode(["a/b"], batch=4)), 1)
+    assert sorted(rows[0]) == sorted(inc.match_host("a/b"))
+    assert mc.ep_dispatches == 0   # replicated fallback served

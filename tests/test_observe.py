@@ -668,3 +668,81 @@ def test_obs_hist_enabled_by_default_and_wired():
             await node.stop()
 
     aio.run(main())
+
+
+def test_per_leg_e2e_hist_sampled_when_enabled():
+    """``obs.hist.e2e_per_leg_sample = N`` records every Nth delivery
+    leg into the per-leg e2e histogram — the per-subscriber skew
+    signal the batch-level e2e span can't see."""
+    import asyncio as aio
+
+    from emqx_tpu.broker import Broker, FanoutPipeline, SubOpts, \
+        make_message
+    from emqx_tpu.observe.hist import HistSet
+
+    async def main():
+        b = Broker()
+        b.on_deliver = lambda cid, pubs: None
+        for i in range(4):
+            b.open_session(f"s{i}")
+            b.subscribe(f"s{i}", "t/#", SubOpts())
+        hs = HistSet("main")
+        p = FanoutPipeline(b, window_s=0.0, hists=hs,
+                           e2e_per_leg_sample=2)
+        await p.start()
+        for i in range(10):
+            assert p.offer(make_message("pub", f"t/{i}", b"x"))
+        deadline = aio.get_event_loop().time() + 2.0
+        while (p._q or p._busy) and \
+                aio.get_event_loop().time() < deadline:
+            await aio.sleep(0.002)
+        await p.stop()
+        # 10 msgs × 4 subscribers = 40 legs, sampled every 2nd
+        leg = hs.hist("obs.e2e.publish_deliver_leg")
+        assert leg.count == 20, leg.count
+        # the batch-level span keeps recording alongside
+        assert hs.hist("obs.e2e.publish_deliver").count >= 1
+
+    aio.run(main())
+
+
+def test_per_leg_e2e_hist_zero_call_when_off(monkeypatch):
+    """Default off: the per-leg histogram is never looked up and
+    record_s is never called for it — the recording site stays an
+    attribute check (spy-asserted)."""
+    import asyncio as aio
+
+    from emqx_tpu.broker import Broker, FanoutPipeline, SubOpts, \
+        make_message
+    from emqx_tpu.observe.hist import HistSet, LatencyHistogram
+
+    async def main():
+        b = Broker()
+        b.on_deliver = lambda cid, pubs: None
+        b.open_session("s")
+        b.subscribe("s", "t/#", SubOpts())
+        hs = HistSet("main")
+        leg_calls = []
+        orig = LatencyHistogram.record_s
+        leg_hist = hs.hist("obs.e2e.publish_deliver_leg")
+
+        def spy(self, s):
+            if self is leg_hist:
+                leg_calls.append(s)
+            return orig(self, s)
+
+        monkeypatch.setattr(LatencyHistogram, "record_s", spy)
+        p = FanoutPipeline(b, window_s=0.0, hists=hs)  # sample=0 (off)
+        assert p._h_e2e_leg is None
+        await p.start()
+        for i in range(10):
+            assert p.offer(make_message("pub", f"t/{i}", b"x"))
+        deadline = aio.get_event_loop().time() + 2.0
+        while (p._q or p._busy) and \
+                aio.get_event_loop().time() < deadline:
+            await aio.sleep(0.002)
+        await p.stop()
+        assert leg_calls == []       # not one record for the leg hist
+        assert hs.hist("obs.e2e.publish_deliver").count >= 1
+
+    aio.run(main())

@@ -124,6 +124,10 @@ def test_waiver_file_has_no_silent_suppressions():
      "ok_affinity_mesh.py", 1),
     ("torn-read", "trip_tornread.py", "ok_tornread.py", 2),
     ("lock-order", "trip_lockorder.py", "ok_lockorder.py", 1),
+    # object-sensitive lock identity (ISSUE 17): two unrelated _lock
+    # attrs on different classes no longer alias — the cross-class
+    # same-name deadlock trips, the cross-class chain passes
+    ("lock-order", "trip_lockident.py", "ok_lockident.py", 1),
     ("no-blocking-in-async", "trip_blocking.py", "ok_blocking.py", 2),
     ("no-swallowed-exceptions", "trip_exceptions.py",
      "ok_exceptions.py", 3),
@@ -463,7 +467,8 @@ def test_lock_order_allowed_fact_suppresses_cycle(tmp_path, monkeypatch):
     from emqx_tpu.devtools.staticcheck import project as facts
 
     monkeypatch.setattr(facts, "LOCK_ORDER_ALLOWED", {
-        ("a_lock", "b_lock"): "fixture locks never contend (test)",
+        ("Pair.a_lock", "Pair.b_lock"):
+            "fixture locks never contend (test)",
     })
     out = check_fixture("trip_lockorder.py", ["lock-order"], tmp_path)
     assert out == []
@@ -473,19 +478,21 @@ def test_lock_order_witnesses_name_both_edges(tmp_path):
     out = check_fixture("trip_lockorder.py", ["lock-order"], tmp_path)
     assert len(out) == 1
     chain = " | ".join(out[0].chain)
-    assert "a_lock->b_lock" in chain and "b_lock->a_lock" in chain
+    assert ("Pair.a_lock->Pair.b_lock" in chain
+            and "Pair.b_lock->Pair.a_lock" in chain)
     assert "Pair._grab_a" in chain  # the cross-call edge is named
 
 
 def test_real_tree_lock_graph_has_no_cycle_and_known_edge():
     """The real tree's lock graph: the shard fast path takes the
-    handoff lock under the channel mutex (mutex → _lock) and nothing
-    acquires them in the opposite order."""
+    handoff lock under the channel mutex (ShardChannel.mutex →
+    Handoff._lock, object-qualified) and nothing acquires them in the
+    opposite order."""
     from emqx_tpu.devtools.staticcheck import analyze
 
     res = analyze([PKG], get_rules([]), root=REPO)
     lo = res.project.lock_order()
-    assert ("mutex", "_lock") in lo.edges
+    assert ("ShardChannel.mutex", "Handoff._lock") in lo.edges
     assert lo.cycles() == []
 
 
