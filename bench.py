@@ -2146,6 +2146,299 @@ def _multichip_ep_size(smoke: bool) -> dict:
                                iters=10))
 
 
+def bench_mesh_degraded(n_filters=200_000, batch=2048, iters=10,
+                        depth=8, tp=0, reps=3):
+    """Degraded-mesh serve A/B (ISSUE 18): the same mesh at the same
+    offered load in three regimes — healthy, one shard dead (scoped
+    failover), rebuild-in-flight — then the canary re-admit round
+    trip.  Gates:
+
+    * ``gate_degraded_rows_on_device_ge_7_8ths`` — with one of tp=8
+      shards dead, >= 7/8 of a root-balanced batch still serves on
+      device (only the dead shard's EP-owned rows divert to the CPU
+      trie; recorded False off tp=8);
+    * ``gate_degraded_delivery_all`` — every degraded-batch row
+      (on-device + CPU fill) agrees BIT-FOR-BIT with the host oracle:
+      delivery_ratio 1.0 while degraded;
+    * ``gate_readmit_zero_stale`` — after online rebuild + re-admit
+      the full batch agrees bit-for-bit with the host oracle AND a
+      filter added while the shard was dead (the delta tail) serves
+      on-device: no stale subtable rows survive re-admission."""
+    from emqx_tpu.observe.metrics import Metrics
+    from emqx_tpu.ops.incremental import IncrementalNfa
+    from emqx_tpu.parallel.multichip_serve import (
+        MultichipMatcher, shard_of_filter,
+    )
+
+    import jax
+
+    max_matches = _serve_max_matches()
+    met = Metrics()
+    if tp == 0 and len(jax.devices()) % 8 == 0:
+        tp = 8     # the gate regime: dp=1 x tp=8, all chips matching
+    mc = MultichipMatcher(depth=depth, tp=tp, active_slots=8,
+                          max_matches=max_matches, metrics=met,
+                          ep=True, degraded=True)
+    tpn = mc.tp
+    if tpn < 2:
+        return {"skipped": f"mesh has tp={tpn}; degraded A/B needs "
+                "tp >= 2 (run under a multi-device mesh)"}
+
+    # root-balanced corpus: every shard owns the same share of the
+    # batch's roots, so the on-device fraction under one dead shard is
+    # exactly (tp-1)/tp when the scoped failover works
+    per_owner = max(1, n_filters // (2 * tpn))
+    roots: dict = {t: [] for t in range(tpn)}
+    i = 0
+    while any(len(v) < per_owner for v in roots.values()):
+        r = f"r{i}"
+        o = shard_of_filter(r, tpn)
+        if len(roots[o]) < per_owner:
+            roots[o].append(r)
+        i += 1
+    inc = IncrementalNfa(depth=depth)   # host oracle
+    pairs = []
+
+    def add(flt):
+        inc.add(flt)
+        pairs.append((flt, inc.aid_of(flt)))
+
+    for o in range(tpn):
+        for r in roots[o]:
+            add(f"{r}/a/+")
+            add(f"{r}/b/#")
+    add("+/m/#")                        # one micro (replicated) filter
+    mc.rebuild(pairs)
+    mc.apply_pending()
+
+    names = [f"{roots[k % tpn][(k // tpn) % per_owner]}/a/x"
+             for k in range(batch)]
+
+    def rows_of(nm):
+        enc = mc.encode(nm, batch=batch, depth=depth)
+        rows, sp, _ = mc.readback(mc.dispatch(enc), len(nm))
+        return rows, set(sp)
+
+    def parity(rows, sp, fill=frozenset()):
+        for k, t in enumerate(names):
+            host = set(inc.match_host(t))
+            got = host if k in sp else set(rows[k]) | (host & fill)
+            if got != host:
+                return False
+        return True
+
+    def best(run):
+        t = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run()
+            t = min(t, (time.perf_counter() - t0) / iters)
+        return t
+
+    rows_h, sp_h = rows_of(names)
+    healthy_ok = parity(rows_h, sp_h)
+    t_h = best(lambda: rows_of(names))
+
+    # one shard dead: its EP-owned rows divert to the CPU trie, every
+    # other row stays on device; micro merge migrates off shard 0
+    mc.kill_shard(0)
+    rows_d, sp_d = rows_of(names)
+    on_device_frac = 1.0 - len(sp_d) / max(1, batch)
+    delivery_all = parity(rows_d, sp_d, fill=mc.dead_aids())
+    t_d = best(lambda: rows_of(names))
+
+    # delta lands WHILE the shard is dead: the online rebuild must
+    # replay it from the live pair state (the zero-stale contract)
+    delta_flt = f"{roots[0][0]}/c/+"
+    add(delta_flt)
+    delta_aid = pairs[-1][1]
+
+    # rebuild-in-flight: serving continues while a worker thread
+    # reconstructs the lost subtable (same offered load as above)
+    import threading as _threading
+    th = _threading.Thread(target=mc.rebuild_shard, args=(0, pairs))
+    th.start()
+    t_r = best(lambda: rows_of(names))
+    th.join()
+
+    # canary re-admit: the rebuilt shard's own topics, bit-parity vs
+    # the host oracle before the shard may serve again
+    ctop = mc.canary_topics(0)
+    cb = 64
+    while cb < len(ctop):
+        cb <<= 1
+    crows, csp = mc.canary_rows(ctop, cb, 0)
+    csps = set(csp)
+    canary_ok = all(
+        set(crows[k]) == set(inc.match_host(t))
+        for k, t in enumerate(ctop) if k not in csps)
+    if canary_ok:
+        mc.revive_shard(0)
+
+    rows_p, sp_p = rows_of(names)
+    post_ok = parity(rows_p, sp_p)
+    drows, dsp = rows_of([f"{roots[0][0]}/c/z"] + names[1:])
+    delta_served = 0 not in dsp and delta_aid in drows[0]
+
+    return {
+        "n_filters": int(inc.n_filters),
+        "batch": batch,
+        "mesh": {"dp": mc.dp, "tp": tpn},
+        "devices": mc.n_devices,
+        "healthy_us": round(t_h * 1e6, 1),
+        "one_dead_us": round(t_d * 1e6, 1),
+        "rebuild_inflight_us": round(t_r * 1e6, 1),
+        "degraded_on_device_frac": round(on_device_frac, 4),
+        "degraded_cpu_rows": len(sp_d),
+        "degraded_batches": int(mc.degraded_batches),
+        "cpu_filled_rows": int(mc.cpu_filled_rows),
+        "rebuild_s": round(float(met.get("tpu.mesh.rebuild_s")), 3),
+        "readmit_canary_fails": int(mc.readmit_canary_fails),
+        "gate_healthy_parity_all": bool(healthy_ok),
+        "gate_degraded_rows_on_device_ge_7_8ths": bool(
+            tpn == 8 and on_device_frac >= 7 / 8),
+        "gate_degraded_delivery_all": bool(delivery_all),
+        "gate_readmit_zero_stale": bool(
+            canary_ok and post_ok and delta_served),
+    }
+
+
+def bench_mesh_degraded_smoke(n_filters=2000, batch=256, depth=8):
+    """CPU-mesh tiny-scale mesh_degraded A/B: the row-accounting /
+    delivery / zero-stale gates are the CI assertions; the regime
+    timings are tracking numbers (8 host threads share one CPU)."""
+    return bench_mesh_degraded(n_filters=n_filters, batch=batch,
+                               iters=3, depth=depth, reps=2)
+
+
+def _mesh_degraded_size(smoke: bool) -> dict:
+    return (dict(n_filters=2000, batch=256, iters=3)
+            if smoke else dict(n_filters=1_000_000, batch=2048,
+                               iters=10))
+
+
+def bench_mesh_chaos_smoke(n_filters=96, depth=8):
+    """Node-level degraded-mesh kill→degraded→rebuild→re-admit cycle
+    (ISSUE 18) — the bench_e2e --chaos ``"mesh"`` section.  Needs a
+    multi-device mesh (bench_e2e isolates it in a subprocess with
+    ``--xla_force_host_platform_device_count=8``); tp < 2 reports
+    skipped.  One injected ``mesh.rebuild`` fault crashes the
+    supervised rebuild child (the section's restarts >= 1 evidence);
+    the restarted child rebuilds, canaries, and re-admits — delivery
+    1.0 end to end, mesh_degraded alarm raised and cleared."""
+    import asyncio
+
+    from emqx_tpu import faultinject as fi
+    from emqx_tpu.broker import SubOpts
+    from emqx_tpu.broker.message import make_message
+    from emqx_tpu.config import Config
+    from emqx_tpu.faultinject import FaultInjector
+    from emqx_tpu.node import BrokerNode
+
+    async def settle(pred, timeout=20.0):
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while not pred() and loop.time() < deadline:
+            await asyncio.sleep(0.002)
+        return pred()
+
+    async def cycle():
+        cfg = Config(
+            file_text='listeners.tcp.default.bind = "127.0.0.1:0"\n')
+        cfg.put("tpu.enable", True)
+        cfg.put("tpu.mirror_refresh_interval", 0.01)
+        cfg.put("tpu.bypass_rate", 0.0)
+        cfg.put("match.deadline.enable", True)
+        cfg.put("match.deadline_ms", 100.0)
+        cfg.put("match.multichip.enable", True)
+        cfg.put("match.multichip.ep.enable", True)
+        cfg.put("match.multichip.degraded.enable", True)
+        cfg.put("supervisor.backoff_base", 0.005)
+        cfg.put("supervisor.backoff_max", 0.05)
+        node = BrokerNode(cfg)
+        await node.start()
+        try:
+            b = node.broker
+            ms = node.match_service
+            mc = ms.mc if ms is not None else None
+            if mc is None or mc.tp < 2:
+                return {"skipped": "multichip mesh unavailable "
+                        f"(tp={getattr(mc, 'tp', 0)})"}
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            for i in range(n_filters):
+                b.subscribe("sub", f"r{i}/a/+", SubOpts())
+            await settle(lambda: ms.ready and mc.ready, timeout=120)
+
+            sent = 0
+
+            async def storm(lo, hi):
+                # DISJOINT topic ranges per phase: every prefetch
+                # parks a fresh waiter and dispatches (a repeated
+                # topic would serve from its hint without touching
+                # the mesh)
+                nonlocal sent
+                for i in range(lo, hi):
+                    topic = f"r{i}/a/x"
+                    await ms.prefetch(topic)
+                    b.publish(make_message("pub", topic, b"%d" % i))
+                    sent += 1
+
+            third = n_filters // 3
+            await storm(0, third)
+            # one injected rebuild fault: the supervised mesh.rebuild
+            # child crashes once and the supervisor restart retries
+            fi.install(FaultInjector([
+                {"point": "mesh.rebuild", "action": "raise",
+                 "times": 1}]))
+            mc.kill_shard(0)
+            await storm(third, third + 3)
+            # sample the degraded evidence EARLY (the rebuild child
+            # may re-admit mid-storm); the flight-recorder dump is
+            # the durable latch
+            alarm_raised = (
+                node.observed.alarms.is_active("mesh_degraded")
+                or node.flightrec.last_reason == "mesh_degraded")
+            await storm(third + 3, 2 * third)
+            degraded_seen = mc.degraded_batches > 0
+            readmitted = await settle(lambda: not mc.dead_shards,
+                                      timeout=60)
+            fi.uninstall()
+            alarm_cleared = await settle(
+                lambda: not node.observed.alarms.is_active(
+                    "mesh_degraded"), timeout=30)
+            await storm(2 * third, n_filters)
+            await settle(lambda: len(got) >= sent, timeout=30)
+            restarts = node.observed.metrics.get(
+                "broker.supervisor.restarts")
+            return {
+                "ok": bool(len(got) == sent and restarts >= 1
+                           and degraded_seen and alarm_raised
+                           and readmitted and alarm_cleared
+                           and mc.rebuilds >= 1),
+                "delivered": len(got), "sent": sent,
+                "delivery_ratio": round(len(got) / max(1, sent), 4),
+                "restarts": restarts,
+                "degraded_batches": int(mc.degraded_batches),
+                "cpu_filled_rows": int(mc.cpu_filled_rows),
+                "rebuilds": int(mc.rebuilds),
+                "readmit_canary_fails": int(mc.readmit_canary_fails),
+                "alarm_raised_and_cleared": bool(alarm_raised
+                                                 and alarm_cleared),
+                "flightrec_dumped": bool(
+                    node.flightrec.last_reason == "mesh_degraded"),
+                "mesh_state": mc.mesh_state(),
+            }
+        finally:
+            fi.uninstall()
+            await node.stop()
+
+    return asyncio.run(cycle())
+
+
 def bench_kernel_join_smoke(n_filters=2000, batch=256, depth=8):
     """CPU-jax tiny-scale kernel_join A/B for bench_e2e --smoke: the
     parity row is the CI gate; the ratios are tracking numbers (kernel
@@ -2574,6 +2867,19 @@ def main():
          f"{mce['routed_shard_width']}/{mce['replicated_shard_width']} "
          f"width_gate={mce['gate_shard_width_le_batch_over_tp']}")
 
+    # degraded-mesh A/B (ISSUE 18): healthy vs one-dead vs
+    # rebuild-in-flight at equal offered load — the scoped-failover
+    # row accounting, delivery 1.0 while degraded, and the zero-stale
+    # re-admit gate (needs a multi-device mesh; skipped on 1 device)
+    msd = bench_mesh_degraded(
+        **_mesh_degraded_size(args.smoke), depth=args.depth)
+    note(f"mesh degraded A/B done: on_device="
+         f"{msd.get('degraded_on_device_frac')} "
+         f"delivery={msd.get('gate_degraded_delivery_all')} "
+         f"readmit_zero_stale={msd.get('gate_readmit_zero_stale')}"
+         if "skipped" not in msd else
+         f"mesh degraded A/B skipped: {msd['skipped']}")
+
     # serving: device at 70% of its measured max; CPU at 70% of ITS max
     # through the same harness (iso-harness, each engine at its own
     # sustainable load) — the honest p99 comparison
@@ -2755,6 +3061,7 @@ def main():
         "kernel_join": kj,
         "multichip_serve": mcs,
         "multichip_ep": mce,
+        "mesh_degraded": msd,
         "serve_cpu_iso": serve_cpu,
         "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,

@@ -336,7 +336,11 @@ class MatchService:
         multichip_ep_slack: float = 2.0,
         multichip_ep_micro: int = 8,
         multichip_ep_compact: bool = False,
+        multichip_degraded: bool = False,
+        multichip_degraded_threshold: int = 3,
+        multichip_ep_overflow_warn: float = 0.5,
         readback_mode: str = "chunked",
+        readback_auto_slack: float = 1.0,
         hists: Any = None,
         flightrec: Any = None,
     ) -> None:
@@ -449,6 +453,10 @@ class MatchService:
         # when the total is not a power of two (pow2 totals are one
         # chunk either way, so the decomposition already costs 2).
         self.readback_mode = readback_mode
+        # auto-mode ragged crossover (satellite, ISSUE 18): padding
+        # slack tolerated before auto falls back to chunked; 1.0 admits
+        # every pow2-capacity class (byte-identical to the PR 17 rule)
+        self.readback_auto_slack = float(readback_auto_slack)
         self.tuner = None
         self._tuning: Set[str] = set()
         self._seg_join_seed = None   # (epoch, shape_key, arrays)
@@ -484,10 +492,18 @@ class MatchService:
                     native=multichip_native, ep=multichip_ep,
                     ep_slack=multichip_ep_slack,
                     ep_micro_matches=multichip_ep_micro,
-                    ep_compact=multichip_ep_compact)
+                    ep_compact=multichip_ep_compact,
+                    degraded=multichip_degraded,
+                    degraded_fail_threshold=multichip_degraded_threshold,
+                    ep_overflow_warn=multichip_ep_overflow_warn)
             except Exception:
                 log.exception("multichip serve backend unavailable; "
                               "single-chip path serves")
+        # degraded-mesh service state (inert unless the mc degraded
+        # flag is on): the mesh_degraded alarm latch and the supervised
+        # mesh.rebuild child's running flag
+        self._mesh_alarmed = False
+        self._mesh_rebuilding = False
         self._ref: Dict[str, int] = {}     # wildcard filter -> route count
         self._deep: Dict[str, int] = {}    # too-deep filter -> alias aid
         self._deep_trie = FilterTrie()     # host match for too-deep filters
@@ -918,6 +934,8 @@ class MatchService:
                     # shard partition applies in lockstep with the
                     # device twin so both reflect _synced_epoch below
                     await asyncio.to_thread(self._mc_apply)
+                if self.mc is not None:
+                    self._mesh_watch()
                 self.ready = True
                 self._synced_epoch = router_epoch
                 self._synced_rule_gen = rule_gen
@@ -1026,6 +1044,135 @@ class MatchService:
         flag-off path."""
         mc = self.mc
         return mc if mc is not None and mc.ready else None
+
+    # ------------------------------------------------------------------
+    # degraded mesh: health ladder + online shard rebuild
+    # (opt-in, match.multichip.degraded.enable)
+    # ------------------------------------------------------------------
+
+    def _mesh_watch(self) -> None:
+        """Reconcile the mesh health ladder with the service's alarm /
+        flight-recorder / rebuild machinery.  Called from the serve
+        paths after a shard failure surfaces and from the sync loop;
+        cheap when healthy (one attribute walk, no allocation)."""
+        mc = self.mc
+        if mc is None or not getattr(mc, "degraded", False):
+            return
+        dead = mc.dead_shards
+        if self.metrics is not None:
+            self.metrics.set("tpu.mesh.state", mc.mesh_state())
+        if dead and not self._mesh_alarmed:
+            self._mesh_alarmed = True
+            if self.alarms is not None:
+                self.alarms.activate(
+                    "mesh_degraded",
+                    {"dead_shards": list(dead), "tp": mc.tp},
+                    "mesh shard(s) dead; degraded serving with CPU fill",
+                )
+            if self.flightrec is not None:
+                # the forensic payoff: what the serve path was doing
+                # for the last few hundred batches before the shard
+                # died
+                self.flightrec.dump("mesh_degraded")
+        elif not dead and self._mesh_alarmed:
+            self._mesh_alarmed = False
+            if self.alarms is not None:
+                self.alarms.deactivate("mesh_degraded")
+        if dead and not self._mesh_rebuilding:
+            self._mesh_rebuilding = True
+            sup = getattr(self, "supervisor", None)
+            if sup is not None:
+                # supervised rebuild child: a crashing rebuild restarts
+                # per policy instead of leaving the shard out forever
+                sup.start_child("mesh.rebuild", self._mesh_rebuild_loop,
+                                restart="transient")
+            else:
+                try:
+                    asyncio.ensure_future(self._mesh_rebuild_loop())
+                except RuntimeError:
+                    # no running loop (sync-context caller, e.g. a
+                    # direct-call test): the next loop-side watch
+                    # starts the rebuild
+                    self._mesh_rebuilding = False
+
+    async def _mesh_rebuild_loop(self) -> None:
+        """Online shard rebuild (transient supervised child): lowest
+        dead shard first, reconstruct its subtable OFF the serve path
+        (degraded serving continues on the survivors), canary the
+        rebuilt shard against the CPU trie, re-admit only on bit
+        parity.  A crash — including an injected ``mesh.rebuild``
+        fault — restarts the child per supervisor policy and the
+        rebuild starts over; a clean return means every shard is live
+        again.  ``_mesh_rebuilding`` stays True across crash-restarts
+        so ``_mesh_watch`` never starts a second child."""
+        mc = self.mc
+        while self._running and mc is not None and mc.dead_shards:
+            t = mc.dead_shards[0]
+            await asyncio.to_thread(
+                mc.rebuild_shard, t, self._mc_pairs(),
+                self.segments_dir if self.segments else None,
+                self.inc.epoch)
+            if not await self._mesh_canary(t):
+                mc.readmit_canary_fails += 1
+                if self.metrics is not None:
+                    self.metrics.inc("tpu.mesh.readmit_canary_fails")
+                log.error("mesh shard %d rebuild canary FAILED; shard "
+                          "stays out", t)
+                await asyncio.sleep(0.05)
+                continue
+            mc.revive_shard(t)
+            # in-flight slots dispatched against the degraded plane
+            # discard via the table-generation guard — no breaker
+            # strike; those publishes re-serve from the CPU trie
+            self._table_gen += 1
+            log.warning("mesh shard %d rebuilt and re-admitted "
+                        "(canary passed)", t)
+        self._mesh_rebuilding = False
+        self._mesh_watch()
+
+    async def _mesh_canary(self, t: int) -> bool:
+        """Bit-parity canary gating shard ``t``'s re-admission: push
+        the rebuilt shard's own filters' topics through the mesh with
+        ``t`` treated as live (other dead shards stay masked) and
+        compare every on-device row against the CPU trie.  Aids the
+        degraded plane CPU-fills anyway (other dead shards') are
+        credited on the device side, same as the serve path.  True
+        only when at least one row was actually checked and every
+        checked row matched."""
+        mc = self.mc
+        topics = mc.canary_topics(t)
+        if not topics:
+            return True     # shard owns nothing: vacuous pass
+        try:
+            rows, spilled = await asyncio.to_thread(
+                mc.canary_rows, topics, _bucket(len(topics)), t)
+        except Exception:
+            log.exception("mesh canary dispatch for shard %d failed", t)
+            return False
+        fill = mc.dead_aids(exclude=t)
+        sp = set(spilled)
+        checked = 0
+        for i, topic in enumerate(topics):
+            if i in sp:
+                continue
+            host = set(self._host_ids(topic))
+            if set(rows[i]) | (host & fill) != host:
+                log.error("mesh canary mismatch on %r (shard %d)",
+                          topic, t)
+                return False
+            checked += 1
+        return checked > 0
+
+    def mesh_info(self) -> Optional[Dict[str, Any]]:
+        """Mesh health snapshot for ``ctl mesh`` / ``GET /api/v5/mesh``
+        — None when the multichip backend is off."""
+        mc = self.mc
+        if mc is None:
+            return None
+        out = mc.info()
+        out["alarmed"] = self._mesh_alarmed
+        out["rebuilding"] = self._mesh_rebuilding
+        return out
 
     # ------------------------------------------------------------------
     # kernel backend routing (opt-in, match.backend)
@@ -1621,7 +1768,8 @@ class MatchService:
 
     @staticmethod
     def _readback_rows_twophase(res, n: int, k: int,
-                                mode: str = "chunked"):
+                                mode: str = "chunked",
+                                auto_slack: float = 1.0):
         """Match-proportional two-phase d2h: phase 1 ships the packed
         (B,) ``row_meta`` vector (counts + fail-open flags), phase 2
         exactly ``sum(counts)`` ids from the flat buffer — the first
@@ -1631,10 +1779,16 @@ class MatchService:
         decomposition (popcount(total) transfers, zero padding bytes),
         "ragged" ONE padded-to-capacity-class transfer (a batch then
         costs exactly TWO d2h round trips, meta + payload), "auto"
-        ragged exactly when the total is not a power of two (a pow2
+        ragged when the total is not a power of two AND the capacity
+        padding stays within ``auto_slack``·total extra ids (a pow2
         total is one chunk either way — identical bytes AND trips).
-        Returns ``(rows, spilled row indices, d2h bytes shipped, d2h
-        round trips performed)``."""
+        ``auto_slack`` is the crossover knob (``match.readback
+        .auto_slack``): pow2 capacity classes pad < total for any
+        non-pow2 total, so the 1.0 default always takes the ragged
+        trip — exactly the pre-knob heuristic; a low-bandwidth link
+        dials it down to keep byte-bloated totals on the chunked
+        path.  Returns ``(rows, spilled row indices, d2h bytes
+        shipped, d2h round trips performed)``."""
         import jax
 
         from ..ops.match_kernel import (
@@ -1647,7 +1801,9 @@ class MatchService:
         nk = np.minimum(nk, k)
         total = int(nk[:n].sum())
         ragged = mode == "ragged" or (
-            mode == "auto" and bool(total & (total - 1)))
+            mode == "auto" and bool(total & (total - 1))
+            and (ragged_capacity(total, int(res.matches.shape[0]))
+                 - total) <= auto_slack * total)
         if ragged:
             ids = fetch_flat_ragged(res.matches, total)
             nbytes = 4 * (meta.size +
@@ -1752,7 +1908,8 @@ class MatchService:
                 t = 1
             elif proportional or self.readback_mode != "chunked":
                 rows, sp, b, t = self._readback_rows_twophase(
-                    res, n, dev.max_matches, mode=self.readback_mode)
+                    res, n, dev.max_matches, mode=self.readback_mode,
+                    auto_slack=self.readback_auto_slack)
             else:
                 rows, sp = self._readback_rows(res, n, dev.max_matches)
                 # the slab cost: the flat id buffer + counts and both
@@ -1955,6 +2112,29 @@ class MatchService:
             rows[r] = self._host_ids(topics[r])
             if self.metrics is not None:
                 self.metrics.inc("tpu.match.fallback_host")
+        mc = self.mc
+        if mc is not None and mc.degraded_serving:
+            # degraded mesh: replicated rows lost the dead shards'
+            # answer segments — CPU-fill ONLY those aids (a live
+            # EP-routed row never intersects: every literal-root match
+            # lives on the root's owner shard, which is alive, and
+            # wildcard-root filters ride the replicated micro-table)
+            fill = mc.dead_aids()
+            if fill:
+                filled = 0
+                for r, t in enumerate(topics):
+                    if r in spset:
+                        continue    # host-served: already complete
+                    add = [a for a in self._host_ids(t) if a in fill]
+                    if add:
+                        rows[r].extend(add)
+                        filled += 1
+                if filled:
+                    mc.cpu_filled_rows += filled
+                    if self.metrics is not None:
+                        self.metrics.inc("tpu.mesh.cpu_filled_rows",
+                                         filled)
+            self._mesh_watch()
         if self._deep:
             # too-deep filters live host-side; merge their hits
             for r, t in enumerate(topics):
@@ -2240,6 +2420,9 @@ class MatchService:
         if self.metrics is not None:
             self.metrics.inc("broker.match.cpu_fallback", len(pending))
             self._count_misses(pending)
+        # a shard failure lands here (the failed batch CPU-serves):
+        # reconcile the mesh ladder — alarm, state metric, rebuild
+        self._mesh_watch()
 
     # ------------------------------------------------------------------
     # overlapped serve pipeline (opt-in, match.pipeline.enable)
@@ -2537,6 +2720,7 @@ class MatchService:
             # kernel backend routing (ISSUE 13)
             "backend": self.backend,
             "readback_mode": self.readback_mode,
+            "readback_auto_slack": self.readback_auto_slack,
             "join_rebuilds": self.dev.join_rebuilds,
             "autotune": (self.tuner.info()
                          if self.tuner is not None else None),

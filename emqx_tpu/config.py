@@ -472,6 +472,13 @@ SCHEMA: Dict[str, Field] = {
     # so flipping modes never grows the executable set.
     "match.readback.mode": Field(
         "chunked", _enum("chunked", "ragged", "auto")),
+    # auto-mode crossover (effective only with match.readback.mode =
+    # auto): ragged serves a non-pow2 total only when its padding slack
+    # (capacity - total) stays <= auto_slack * total — 1.0 admits every
+    # pow2-capacity class (the PR 17 heuristic, byte-identical); r06
+    # tunes this down from measured link numbers without a code change
+    "match.readback.auto_slack": Field(
+        1.0, float, lambda v: v >= 0.0),
     # autotuner (effective only with match.backend=auto): measure
     # hash-vs-join per (B, D, S, Hb) shape on recently served topics;
     # the pick table persists as checksummed JSON next to the XLA disk
@@ -512,6 +519,23 @@ SCHEMA: Dict[str, Field] = {
     # Identical decoded rows (parity-gated); off = the PR-16 routed
     # segment layout, byte-identical.
     "match.multichip.ep.compact": Field(False, _bool),
+    # routed overflow-rate EWMA threshold: a log-once warning (and the
+    # tpu.match.ep_overflow_ewma gauge crossing it) flags a hot root
+    # skewing one owner shard; 0 disables the warning
+    "match.multichip.ep.overflow_warn": Field(
+        0.5, float, lambda v: 0.0 <= v <= 1.0),
+    # degraded-mesh serving (ISSUE 18): on shard death keep serving on
+    # the survivors — EP-routed rows owned by the dead shard (and the
+    # dead shard's replicated answer segment) divert to the CPU trie,
+    # the micro-merge owner migrates off a dead shard 0, a supervised
+    # mesh.rebuild child reconstructs the lost subtable and re-admits
+    # it only after a bit-parity canary passes.  Off = ANY dead shard
+    # fails the whole plane over (the PR 17 path, byte-identical).
+    "match.multichip.degraded.enable": Field(False, _bool),
+    # consecutive injected/observed match.shard failures before the
+    # health ladder marks a shard dead (healthy → degraded(S))
+    "match.multichip.degraded.fail_threshold": Field(
+        3, int, lambda v: v >= 1),
 
     # -- streaming table lifecycle (broker/match_service.py) --------------
     # opt-in: cold start from persistent compacted segments + background

@@ -109,7 +109,7 @@ POINTS = (
     "match.readback", "match.shard", "table.load", "table.swap",
     "inflight.insert", "inflight.retry", "cluster.rpc",
     "bridge.sink", "exhook.call", "fanout.drain", "shard.handoff",
-    "admission.score", "ep.route",
+    "admission.score", "ep.route", "mesh.rebuild",
 )
 
 _ACTIONS = ("raise", "drop", "delay", "dup", "hang")

@@ -120,6 +120,7 @@ class MgmtApi:
         r("GET", f"{v}/observability/histograms", self.histograms)
         r("GET", f"{v}/observability/flightrec", self.flightrec_info)
         r("POST", f"{v}/observability/flightrec", self.flightrec_dump)
+        r("GET", f"{v}/mesh", self.mesh)
         r("GET", f"{v}/admission", self.admission_list)
         r("DELETE", f"{v}/admission/{{clientid}}", self.admission_clear)
         r("GET", f"{v}/plugins", self.plugins_list)
@@ -778,6 +779,18 @@ class MgmtApi:
         if path is None:
             return json_response({"message": "dump failed"}, status=503)
         return json_response({"path": path, "reason": "manual"})
+
+    # -- degraded mesh (parallel/multichip_serve.py) ---------------------
+
+    async def mesh(self, req: Request) -> Response:
+        """Mesh health for operators: ladder state, dead shards, strike
+        counters, rebuild/canary progress.  404s when the multichip
+        backend is off — the single-chip plane has no mesh to report."""
+        ms = getattr(self.node, "match_service", None)
+        info = ms.mesh_info() if ms is not None else None
+        if info is None:
+            return json_response({"message": "multichip disabled"}, 404)
+        return json_response(info)
 
     # -- batched admission plane (broker/admission.py) -------------------
 

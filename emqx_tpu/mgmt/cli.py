@@ -163,6 +163,10 @@ def main(argv=None) -> int:
     p.add_argument("--all", action="store_true", dest="adm_all",
                    help="every tracked client, not just decisions")
 
+    # degraded mesh health: ladder state, dead shards, rebuild/canary
+    # progress (multichip backend only)
+    sub.add_parser("mesh")
+
     # stage-level latency observatory: merged per-stage percentiles +
     # the flight recorder's manual dump trigger
     sub.add_parser("hist")
@@ -311,6 +315,8 @@ def main(argv=None) -> int:
         else:
             suffix = "?all=true" if args.adm_all else ""
             _print(ctl.call("GET", f"{v}/admission{suffix}"))
+    elif args.cmd == "mesh":
+        _print(ctl.call("GET", f"{v}/mesh"))
     elif args.cmd == "hist":
         _print(ctl.call("GET", f"{v}/observability/histograms"))
     elif args.cmd == "flightrec":

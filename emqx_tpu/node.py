@@ -1088,7 +1088,14 @@ class BrokerNode:
                     "match.multichip.ep.micro_matches"),
                 multichip_ep_compact=cfg.get(
                     "match.multichip.ep.compact"),
+                multichip_degraded=cfg.get(
+                    "match.multichip.degraded.enable"),
+                multichip_degraded_threshold=cfg.get(
+                    "match.multichip.degraded.fail_threshold"),
+                multichip_ep_overflow_warn=cfg.get(
+                    "match.multichip.ep.overflow_warn"),
                 readback_mode=cfg.get("match.readback.mode"),
+                readback_auto_slack=cfg.get("match.readback.auto_slack"),
                 hists=self.hists,
                 flightrec=self.flightrec,
             )

@@ -46,7 +46,7 @@ __all__ = ["FlightRecorder", "Ring", "DUMP_REASONS", "STAGES"]
 #: the fixed dump-reason vocabulary — drift-checked like POINTS
 DUMP_REASONS = (
     "breaker_trip", "brownout", "supervisor_degraded", "manual",
-    "admission_escalation",
+    "admission_escalation", "mesh_degraded",
 )
 
 #: packed stage ids: index into this tuple == the event's stage id

@@ -20,7 +20,7 @@ __all__ = [
     "Metrics", "METRIC_NAMES", "TPU_METRIC_NAMES", "FANOUT_METRIC_NAMES",
     "ROBUSTNESS_METRIC_NAMES", "CONNPLANE_METRIC_NAMES",
     "MATCH_SERVE_METRIC_NAMES", "MULTICHIP_METRIC_NAMES",
-    "TABLE_METRIC_NAMES",
+    "MESH_METRIC_NAMES", "TABLE_METRIC_NAMES",
     "OBS_METRIC_NAMES", "ADMISSION_METRIC_NAMES",
 ]
 
@@ -190,6 +190,29 @@ MULTICHIP_METRIC_NAMES: List[str] = [
     "tpu.match.shard_failover", "tpu.match.shard_restacks",
     "tpu.match.ep_dispatches", "tpu.match.ep_overflow_rows",
     "tpu.match.ep_shard_width", "tpu.match.ep_ici_bytes",
+    # routed overflow-rate EWMA (set, 0..1): the smoothed fraction of
+    # each routed batch that failed open via the psum'd overflow flags
+    # — the input a future bucket-grid resize keys on; a log-once
+    # warning fires when it crosses match.multichip.ep.overflow_warn
+    "tpu.match.ep_overflow_ewma",
+]
+
+# -- degraded-mesh serving (parallel/multichip_serve.py +
+# broker/match_service.py, opt-in via match.multichip.degraded.enable).
+# state is the live health-ladder rung (set: 0 healthy, 1 degraded(S)
+# — scoped failover serving on the survivors, 2 cpu-only);
+# degraded_batches counts dispatches served while at least one shard
+# was dead (inc); cpu_filled_rows accumulates the rows (EP-routed:
+# whole rows owned by a dead shard; replicated: rows whose dead-owned
+# filters were host-filled) the CPU trie answered under scoped
+# failover (inc, by amount); rebuild_s is the last online shard
+# rebuild's wall seconds (set); readmit_canary_fails counts re-admit
+# attempts refused because the bit-parity canary batch disagreed with
+# the CPU trie (inc) — the shard stays out.
+MESH_METRIC_NAMES: List[str] = [
+    "tpu.mesh.state", "tpu.mesh.degraded_batches",
+    "tpu.mesh.cpu_filled_rows", "tpu.mesh.rebuild_s",
+    "tpu.mesh.readmit_canary_fails",
 ]
 
 # -- streaming table lifecycle (broker/match_service.py, opt-in via
@@ -248,6 +271,7 @@ class Metrics:
         self._c.update({n: 0 for n in CONNPLANE_METRIC_NAMES})
         self._c.update({n: 0 for n in MATCH_SERVE_METRIC_NAMES})
         self._c.update({n: 0 for n in MULTICHIP_METRIC_NAMES})
+        self._c.update({n: 0 for n in MESH_METRIC_NAMES})
         self._c.update({n: 0 for n in TABLE_METRIC_NAMES})
         self._c.update({n: 0 for n in OBS_METRIC_NAMES})
         self._c.update({n: 0 for n in ADMISSION_METRIC_NAMES})

@@ -59,6 +59,22 @@ raises at dispatch — the affected batch fails over to the CPU trie
 through the serve plane's existing device-failure paths (breaker
 strike in deadline mode, probe recovery, stale-slot discards stay
 strike-free), exactly like any other device failure.
+
+Degraded-mesh mode (ISSUE 18, opt-in ``match.multichip.degraded.
+enable``) scopes that failover to the dead shard alone: EP-routed
+rows owned by a dead shard divert to the CPU trie (host-side
+``word_owner`` lookup — the device grid still runs, the dead owner's
+answers are discarded), replicated dispatches mask the dead shard's
+answer segment and the service CPU-fills only ``shard_of_filter(flt)
+== dead`` filters, and the replicated micro-table's merge point
+migrates to the lowest LIVE shard when shard 0 dies.  Per-shard
+consecutive-failure counters (injected ``match.shard`` faults
+attribute round-robin over the live shards) drive the health ladder
+healthy → degraded(S) → cpu-only; ``rebuild_shard`` reconstructs a
+lost subtable (epoch-guarded per-shard segment + delta-tail replay
+from the service filter state) and the service re-admits it only
+after a bit-parity canary passes.  Flag off, every path above is
+byte-identical to the whole-plane failover.
 """
 
 from __future__ import annotations
@@ -134,7 +150,7 @@ def _scatter_stacked(tab, tvec, idx, rows):
 def build_multichip_step(mesh, active_slots: int = 16,
                          max_matches: int = 32, micro_matches: int = 8,
                          routed: bool = False, capacity: int = 0,
-                         compact: bool = False):
+                         compact: bool = False, micro_owner: int = 0):
     """Return a jitted ``step(words, lens, is_sys, node_stk, edge_stk,
     seeds_stk, aid_stk, micro_node, micro_edge, micro_seeds,
     micro_amap, word_owner) -> CompactFanoutResult``.
@@ -158,6 +174,11 @@ def build_multichip_step(mesh, active_slots: int = 16,
     (other segments stay count-0 for that row), so no return
     ``all_to_all`` is needed.  Rows past ``capacity`` fail open
     (match_overflow) at the source.
+
+    ``micro_owner`` names the shard that merges the replicated
+    micro-table's answers in replicated mode (default 0; the degraded
+    mesh migrates it to the lowest LIVE shard when shard 0 dies, so
+    wildcard-root answers never go dark with their merge point).
 
     ``compact=True`` (routed only) applies the count-compact contract
     to the ROUTED output: exactly one owner writes each row, so a
@@ -239,8 +260,10 @@ def build_multichip_step(mesh, active_slots: int = 16,
         if not routed:
             res, gids, mres, mg = match_both(words, lens, is_sys)
             # segments must stay DISJOINT per row: exactly one shard
-            # (the first) merges the replicated micro answers
-            is0 = jax.lax.axis_index("tp") == 0
+            # (the micro owner — shard 0 unless the degraded mesh
+            # migrated the merge point) merges the replicated micro
+            # answers
+            is0 = jax.lax.axis_index("tp") == micro_owner
             mcnt = jnp.where(is0, jnp.minimum(mres.n_matches, Km), 0)
             ids, cnt = merge_micro(
                 gids, jnp.minimum(res.n_matches, K), mg, mcnt)
@@ -374,6 +397,8 @@ class MultichipMatcher:
     #: serve-plane dispatch routing marker (MatchService checks this
     #: instead of importing the class on its hot path)
     is_multichip = True
+    #: smoothing factor for the per-dispatch routed overflow-rate EWMA
+    EP_OVERFLOW_ALPHA = 0.1
 
     def __init__(
         self,
@@ -389,6 +414,9 @@ class MultichipMatcher:
         ep_slack: float = 2.0,
         ep_micro_matches: int = 8,
         ep_compact: bool = False,
+        degraded: bool = False,
+        degraded_fail_threshold: int = 3,
+        ep_overflow_warn: float = 0.5,
     ) -> None:
         from .mesh import make_mesh
 
@@ -410,6 +438,12 @@ class MultichipMatcher:
         # (B, tp·W) segment plane collapses to (B, W) on-mesh, so
         # routed readback bytes drop ~tp× on literal-rooted tables
         self.ep_compact = bool(ep_compact)
+        # degraded-mesh serving (ISSUE 18): scoped shard failover +
+        # the health ladder; flag off every dead shard fails the
+        # whole plane over (the PR 17 contract, byte-identical)
+        self.degraded = bool(degraded)
+        self.fail_threshold = max(1, int(degraded_fail_threshold))
+        self.ep_overflow_warn = float(ep_overflow_warn)
         if native:
             from ..native.nfa import available
 
@@ -435,14 +469,33 @@ class MultichipMatcher:
         self._reset_subs()
 
         self._lock = threading.Lock()
+        # serializes table maintenance (apply_pending / save_segments /
+        # rebuild_shard) — the rebuild child's worker hop must not race
+        # the sync loop's
+        self._maint_lock = threading.Lock()
         self._pending: List[Tuple[str, str, int]] = []  # (op, flt, aid)
         self._rebuild_pairs: Optional[List[Tuple[str, int]]] = None
         self._restack_due = False      # segment restore awaiting upload
         self._arrs: Optional[Tuple[Any, ...]] = None
         self._stacked_shape: Optional[Tuple[int, ...]] = None
-        self._steps: Dict[Tuple[int, int, int], Any] = {}
+        self._steps: Dict[Tuple[int, ...], Any] = {}
         self._routed_live: set = set()  # id(res) of in-flight EP handles
         self._dead: set = set()
+        # degraded-mesh state: per-dispatch failover metadata keyed by
+        # id(res), per-shard consecutive-failure strikes, and the
+        # round-robin cursor that attributes anonymous match.shard
+        # faults to a live shard
+        self._degraded_meta: Dict[int, Tuple[Any, ...]] = {}
+        self._fail_counts: Dict[int, int] = {}
+        self._fault_rr = 0
+        self.degraded_batches = 0
+        self.cpu_filled_rows = 0
+        self.rebuilds = 0
+        self.readmit_canary_fails = 0
+        # satellite: routed overflow-rate EWMA (the bucket-grid resize
+        # input) + its log-once warning latch
+        self._ov_ewma = 0.0
+        self._ov_warned = False
         self.gen = 0                    # bumped on every restack
         self.dispatches = 0
         self.ep_dispatches = 0
@@ -603,6 +656,10 @@ class MultichipMatcher:
         stacked arrays in place; any resize/repartition restacks (the
         DeviceNfa full-upload analog).  Returns True when the device
         state changed."""
+        with self._maint_lock:
+            return self._apply_locked()
+
+    def _apply_locked(self) -> bool:
         with self._lock:
             ops, self._pending = self._pending, []
             rebuild, self._rebuild_pairs = self._rebuild_pairs, None
@@ -798,23 +855,103 @@ class MultichipMatcher:
         return encode_batch(self, topics, batch=batch, depth=depth)
 
     def kill_shard(self, t: int) -> None:
-        """Chaos surface: mark shard ``t`` dead.  Every subsequent
-        dispatch raises :class:`ShardDead` until ``revive_shard`` —
-        the whole table is partition-resident, so no shard can answer
-        alone."""
+        """Chaos surface: mark shard ``t`` dead.  Flag off, every
+        subsequent dispatch raises :class:`ShardDead` until
+        ``revive_shard`` (whole-plane failover); degraded mode keeps
+        serving on the survivors and diverts only the dead shard's
+        share of the answers to the CPU trie (scoped failover)."""
         self._dead.add(int(t))
+        self._fail_counts.pop(int(t), None)
+        self._set_state_metric()
 
     def revive_shard(self, t: int) -> None:
         self._dead.discard(int(t))
+        self._fail_counts.pop(int(t), None)
+        self._set_state_metric()
+
+    # -- health ladder -------------------------------------------------
+
+    def mesh_state(self) -> int:
+        """Health-ladder rung: 0 healthy, 1 degraded(S) (scoped
+        failover serving on the survivors around ONE dead shard), 2
+        cpu-only (every dispatch refused: two or more shards dead —
+        the double-kill rung — or any dead shard with the flag off)."""
+        if not self._dead:
+            return 0
+        if self.degraded_serving:
+            return 1
+        return 2
+
+    @property
+    def dead_shards(self) -> List[int]:
+        return sorted(self._dead)
+
+    @property
+    def degraded_serving(self) -> bool:
+        """True while scoped failover is answering on the survivors.
+        Scoped failover covers exactly ONE dead shard (degraded(S));
+        a second death drops the plane to cpu-only until the staged
+        re-admit climbs back through degraded(S) to healthy."""
+        return bool(self.degraded and len(self._dead) == 1
+                    and self.tp > 1)
+
+    def note_shard_failure(self, t: int) -> bool:
+        """One consecutive-failure strike against shard ``t`` (the
+        health ladder's input); at ``fail_threshold`` strikes the
+        shard is marked dead.  Returns True when this strike killed
+        it."""
+        t = int(t)
+        if t in self._dead:
+            return False
+        c = self._fail_counts.get(t, 0) + 1
+        self._fail_counts[t] = c
+        if c < self.fail_threshold:
+            return False
+        self._fail_counts.pop(t, None)
+        self._dead.add(t)
+        log.warning("mesh shard %d dead after %d consecutive failures",
+                    t, c)
+        self._set_state_metric()
+        return True
+
+    def _note_fault_failure(self) -> None:
+        """An injected ``match.shard`` fault names no shard: attribute
+        it round-robin over the LIVE shards so a sustained fault storm
+        marches the ladder one shard at a time toward cpu-only."""
+        live = [t for t in range(self.tp) if t not in self._dead]
+        if not live:
+            return
+        t = live[self._fault_rr % len(live)]
+        self._fault_rr += 1
+        self.note_shard_failure(t)
+
+    def _set_state_metric(self) -> None:
+        if self.degraded and self.metrics is not None:
+            self.metrics.set("tpu.mesh.state", self.mesh_state())
+
+    def dead_aids(self, exclude: Optional[int] = None) -> frozenset:
+        """Service accept ids owned by dead shards — the replicated
+        scoped-failover CPU-fill set (host-known: ``shard_of_filter``
+        is a pure function of the filter)."""
+        out: set = set()
+        for t in self._dead:
+            if exclude is not None and int(t) == int(exclude):
+                continue
+            out.update(self._filters[t].values())
+        return frozenset(out)
 
     def _gate(self) -> None:
         if self._dead:
-            self._note_failover()
-            raise ShardDead(f"mesh shard(s) {sorted(self._dead)} dead")
+            if not self.degraded_serving:
+                self._note_failover()
+                raise ShardDead(
+                    f"mesh shard(s) {sorted(self._dead)} dead")
         if _fi._injector is not None:
             act = _fi._injector.act("match.shard")
             if act == "raise":
                 self._note_failover()
+                if self.degraded:
+                    self._note_fault_failure()
                 raise _fi.InjectedFault("match.shard")
             if act == "delay":
                 # sync seam (worker thread): a plain blocking sleep,
@@ -871,13 +1008,38 @@ class MultichipMatcher:
         routed = self._routed_for(b)
         if routed:
             self._gate_ep()
-        step = self._step_for((b, d), routed=routed,
+        dead = (frozenset(int(x) for x in self._dead)
+                if self.degraded_serving else None)
+        owner = 0
+        dead_rows: List[int] = []
+        if dead is not None:
+            if routed:
+                # scoped EP failover: the rows whose crc32-root owner
+                # is dead divert to the CPU trie at readback (the
+                # device grid still runs; the dead owner's segment is
+                # discarded with them)
+                dead_rows = self._dead_row_indices(words, lens, d, dead)
+            else:
+                # replicated micro-merge owner migrates to the lowest
+                # live shard when its default owner (shard 0) is dead
+                owner = min(x for x in range(self.tp) if x not in dead)
+        step = self._step_for((b, d), routed=routed, micro_owner=owner,
                               block_compile=block_compile)
         with self._lock:
             if self._arrs is None:
                 raise RuntimeError("multichip mirror not synced yet")
             res = step(jnp.asarray(words), jnp.asarray(lens),
                        jnp.asarray(is_sys), *self._arrs)
+        if dead is not None:
+            self._degraded_meta[id(res)] = (dead, dead_rows)
+            self.degraded_batches += 1
+            if self.metrics is not None:
+                self.metrics.inc("tpu.mesh.degraded_batches")
+                self.metrics.set("tpu.mesh.state", self.mesh_state())
+        if self.degraded and self._fail_counts:
+            # a dispatch that made it out clears the CONSECUTIVE
+            # failure strikes on the still-live shards
+            self._fail_counts.clear()
         self.dispatches += 1
         if self.metrics is not None:
             self.metrics.inc("tpu.match.shard_dispatches")
@@ -902,13 +1064,24 @@ class MultichipMatcher:
         """Block on the dense compact readback and decode to per-topic
         SERVICE accept-id rows: per-shard segments concatenate (the
         partition makes them disjoint — no dedup), rows flagged by the
-        psum'd spill vectors go back to the host tables.  Returns
-        ``(rows, spilled row indices, d2h bytes)``."""
+        psum'd spill vectors go back to the host tables.  Degraded
+        serving masks the dead shards' replicated answer segments and
+        appends the dead-owned routed rows to the spill set (the
+        scoped CPU-fill contract).  Returns ``(rows, spilled row
+        indices, d2h bytes)``."""
         routed = id(res) in self._routed_live
         self._routed_live.discard(id(res))
+        meta = self._degraded_meta.pop(id(res), None)
         ids, counts, nm, ao, mo = jax.device_get(
             (res.ids, res.counts, res.n_matches,
              res.active_overflow, res.match_overflow))
+        if meta is not None and not routed \
+                and counts.shape[1] == self.tp:
+            # replicated scoped failover: zero the dead shards'
+            # per-row counts so their (stale) segments decode empty —
+            # the service CPU-fills exactly those shards' filters
+            counts = np.array(counts)
+            counts[:, sorted(meta[0])] = 0
         cap_row = ids.shape[1] // counts.shape[1]
         rows = decode_compact_rows(ids, counts, cap_row)[:n]
         out = [[int(a) for a in row if a >= 0] for row in rows]
@@ -918,12 +1091,51 @@ class MultichipMatcher:
             # the routed fail-open set: bucket overflow + truncation
             # rows the CPU trie re-runs
             self.metrics.inc("tpu.match.ep_overflow_rows", len(spilled))
+        if routed and n:
+            # overflow-rate EWMA over the psum'd flags (the input the
+            # bucket-grid resize will key on), warn once on crossing
+            frac = len(spilled) / n
+            self._ov_ewma += self.EP_OVERFLOW_ALPHA * (
+                frac - self._ov_ewma)
+            if self.metrics is not None:
+                self.metrics.set("tpu.match.ep_overflow_ewma",
+                                 round(self._ov_ewma, 6))
+            if self._ov_ewma >= self.ep_overflow_warn > 0:
+                if not self._ov_warned:
+                    self._ov_warned = True
+                    log.warning(
+                        "EP bucket overflow EWMA %.3f crossed %.3f: "
+                        "a hot root is skewing one owner shard "
+                        "(rows fail open to the CPU trie)",
+                        self._ov_ewma, self.ep_overflow_warn)
+            else:
+                self._ov_warned = False
+        if meta is not None and routed:
+            extra = [r for r in meta[1] if r < n and not sp[r]]
+            if extra:
+                self.cpu_filled_rows += len(extra)
+                if self.metrics is not None:
+                    self.metrics.inc("tpu.mesh.cpu_filled_rows",
+                                     len(extra))
+                spilled = sorted(set(spilled).union(extra))
         nbytes = 4 * int(ids.size + counts.size + nm.size
                          + ao.size + mo.size)
         return out, spilled, nbytes
 
+    def _dead_row_indices(self, words, lens, depth: int,
+                          dead: frozenset) -> List[int]:
+        """Routable rows whose crc32-root owner shard is dead, from
+        the HOST ``word_owner`` map (the same array the device routes
+        by) — the scoped EP failover's CPU divert set."""
+        wo = self._word_owner
+        roots = np.clip(np.asarray(words)[:, 0], 0, len(wo) - 1)
+        owners = wo[roots]
+        routable = np.asarray(lens) <= depth
+        return np.flatnonzero(
+            routable & np.isin(owners, sorted(dead))).tolist()
+
     def _step_for(self, batch_shape: Tuple[int, int], routed: bool, *,
-                  block_compile: bool = True):
+                  micro_owner: int = 0, block_compile: bool = True):
         cap = self.ep_capacity(batch_shape[0]) if routed else 0
         # mesh-key ``kind``: 0 = replicated, 1 = routed, 2 = routed
         # with the count-compact output contract
@@ -932,36 +1144,48 @@ class MultichipMatcher:
         kc = self.kernel_cache
         if kc is not None and self._stacked_shape is not None:
             smax, hbmax, acap, sm, hbm, am, wcap = self._stacked_shape
+            mesh_key = (self.dp, self.tp, acap, kind, cap,
+                        sm, hbm, am, wcap, self.ep_micro_matches)
+            if micro_owner:
+                # degraded-only key extension: flag off (or owner 0)
+                # the cache keys stay the PR 17 shape verbatim
+                mesh_key += (int(micro_owner),)
             return kc.executable(
                 batch_shape, smax, hbmax,
                 active_slots=self.active_slots,
                 max_matches=self.max_matches,
                 compact_output=True, flat_cap=0,
-                mesh=(self.dp, self.tp, acap, kind, cap,
-                      sm, hbm, am, wcap, self.ep_micro_matches),
+                mesh=mesh_key,
                 block=block_compile,
             )
-        key = (int(batch_shape[0]), int(batch_shape[1]), kind)
+        key: Tuple[int, ...] = (
+            int(batch_shape[0]), int(batch_shape[1]), kind)
+        if micro_owner:
+            key += (int(micro_owner),)
         fn = self._steps.get(key)
         if fn is None:
             fn = self._steps[key] = build_multichip_step(
                 self.mesh, self.active_slots, self.max_matches,
                 micro_matches=self.ep_micro_matches,
-                routed=routed, capacity=cap, compact=compact)
+                routed=routed, capacity=cap, compact=compact,
+                micro_owner=int(micro_owner))
         return fn
 
     def _lower_step(self, key):
         """Mesh half of the kernel cache's ``_lower``: AOT-compile the
         shard_map step for one (B, D, S, Hb, ..., (dp, tp, acap, kind,
-        C, Sm, Hbm, Am, Wcap, Km)) key (proven on the CPU mesh —
-        jit(shard_map).lower(ShapeDtypeStruct...) works)."""
+        C, Sm, Hbm, Am, Wcap, Km[, micro_owner])) key (proven on the
+        CPU mesh — jit(shard_map).lower(ShapeDtypeStruct...) works)."""
         from ..ops.compiler import BUCKET_SLOTS
 
         b, d, s, hb = key[0], key[1], key[2], key[3]
-        _dp, _tp, acap, kind, cap, sm, hbm, am, wcap, km = key[10]
+        mk = key[10]
+        _dp, _tp, acap, kind, cap, sm, hbm, am, wcap, km = mk[:10]
+        owner = int(mk[10]) if len(mk) > 10 else 0
         step = build_multichip_step(
             self.mesh, key[4], key[5], micro_matches=km,
-            routed=kind >= 1, capacity=cap, compact=kind == 2)
+            routed=kind >= 1, capacity=cap, compact=kind == 2,
+            micro_owner=owner)
         sd = jax.ShapeDtypeStruct
         i32 = jnp.int32
         return step.lower(
@@ -989,6 +1213,195 @@ class MultichipMatcher:
                 self.readback(res, 0)
 
     # ------------------------------------------------------------------
+    # online shard rebuild + canary re-admit (degraded mesh, ISSUE 18)
+    # ------------------------------------------------------------------
+
+    def canary_topics(self, t: int, cap: int = 64) -> List[str]:
+        """Concrete topics derived from shard ``t``'s own filter set
+        (each wildcard level degraded to a literal token), so the
+        re-admit canary batch exercises exactly the rebuilt subtable."""
+        out = []
+        for flt in list(self._filters[int(t)])[:cap]:
+            out.append("/".join(
+                w if w not in ("+", "#") else "c" for w in T.words(flt)))
+        return out
+
+    def canary_rows(self, topics: Sequence[str], batch: int,
+                    readmit: int) -> Tuple[List[List[int]], List[int]]:
+        """Dispatch a canary batch with shard ``readmit`` treated LIVE
+        (any OTHER dead shard stays masked/diverted) — the bit-parity
+        probe that gates re-admission.  Serving counters and the
+        failure ladder are untouched; gates are bypassed on purpose
+        (the probe must run while the shard is still marked dead)."""
+        enc = self.encode(topics, batch=batch)
+        words, lens, is_sys = enc
+        b, d = int(words.shape[0]), int(words.shape[1])
+        routed = self._routed_for(b)
+        dead = frozenset(int(x) for x in self._dead
+                         if int(x) != int(readmit))
+        owner = 0
+        dead_rows: List[int] = []
+        if dead:
+            if routed:
+                dead_rows = self._dead_row_indices(words, lens, d, dead)
+            else:
+                owner = min(x for x in range(self.tp) if x not in dead)
+        step = self._step_for((b, d), routed=routed, micro_owner=owner,
+                              block_compile=True)
+        with self._lock:
+            if self._arrs is None:
+                raise RuntimeError("multichip mirror not synced yet")
+            res = step(jnp.asarray(words), jnp.asarray(lens),
+                       jnp.asarray(is_sys), *self._arrs)
+        ids, counts, ao, mo = jax.device_get(
+            (res.ids, res.counts, res.active_overflow,
+             res.match_overflow))
+        if dead and not routed and counts.shape[1] == self.tp:
+            counts = np.array(counts)
+            counts[:, sorted(dead)] = 0
+        cap_row = ids.shape[1] // counts.shape[1]
+        n = len(topics)
+        rows = decode_compact_rows(ids, counts, cap_row)[:n]
+        out = [[int(a) for a in row if a >= 0] for row in rows]
+        sp = (ao > 0) | (mo > 0)
+        spilled = set(np.flatnonzero(sp[:n]).tolist())
+        spilled.update(r for r in dead_rows if r < n)
+        return out, sorted(spilled)
+
+    def rebuild_shard(self, t: int, pairs: List[Tuple[str, int]],
+                      segments_dir: Optional[str] = None,
+                      expect_epoch: Optional[int] = None) -> float:
+        """WORKER-THREAD step (the supervised ``mesh.rebuild`` child's
+        ``to_thread`` hop): reconstruct shard ``t``'s subtable — seeded
+        from its epoch-guarded per-shard segment when one matches, then
+        a delta-tail replay from the service-level ``pairs`` converges
+        it on the live filter state — and restack/re-upload the stacked
+        twin.  Does NOT re-admit: the caller runs the bit-parity canary
+        first.  Returns the rebuild wall seconds; an injected
+        ``mesh.rebuild`` fault raises (the supervised child restarts
+        and retries)."""
+        import time as _time
+
+        if _fi._injector is not None:
+            act = _fi._injector.act("mesh.rebuild")
+            if act == "raise":
+                raise _fi.InjectedFault("mesh.rebuild")
+            if act == "delay":
+                _time.sleep(_fi._injector.last_delay)
+        t = int(t)
+        t0 = _time.perf_counter()
+        want = {flt: aid for flt, aid in pairs
+                if not is_micro_filter(flt)
+                and shard_of_filter(flt, self.tp) == t}
+        with self._maint_lock:
+            seeded = self._seg_seed_filters(t, segments_dir,
+                                            expect_epoch)
+            sub = self._new_sub()
+            seed_flts = [f for f in (seeded or ())]
+            if self.native:
+                # replay the live shared vocab in id order first so the
+                # fresh native table assigns identical word ids
+                sub.bulk_intern(
+                    [w for w, _i in sorted(self.vocab.items(),
+                                           key=lambda kv: kv[1])])
+                sub.bulk_add(seed_flts)
+            else:
+                for f in seed_flts:
+                    sub.add(f)
+            # delta-tail replay: adds since the snapshot, then removes
+            # of filters the service no longer holds
+            for f in want:
+                if seeded is None or f not in seeded:
+                    sub.add(f)
+            for f in seed_flts:
+                if f not in want:
+                    sub.remove(f)
+            if self.native:
+                self._adopt_vocab_tail(sub)
+            amap = np.full(max(64, sub.n_filters + 1), -1, np.int32)
+            for flt, aid in want.items():
+                laid = sub.aid_of(flt)
+                if laid < 0:
+                    raise RuntimeError(
+                        f"rebuilt filter missing: {flt!r}")
+                if laid >= len(amap):
+                    grown = np.full(max(2 * len(amap), laid + 1), -1,
+                                    np.int32)
+                    grown[:len(amap)] = amap
+                    amap = grown
+                amap[laid] = aid
+            self._subs[t] = sub
+            self._aid_maps[t] = amap
+            self._filters[t] = dict(want)
+            self._restack()
+        dt = _time.perf_counter() - t0
+        self.rebuilds += 1
+        if self.metrics is not None:
+            self.metrics.set("tpu.mesh.rebuild_s", round(dt, 6))
+        log.warning("mesh shard %d rebuilt (%d filters, %s seed) in "
+                    "%.3fs — canary gates re-admission", t, len(want),
+                    "segment" if seeded is not None else "full", dt)
+        return dt
+
+    def _adopt_vocab_tail(self, sub) -> None:
+        """``bulk_add``'s warm probe may intern sentinel words past the
+        replayed shared sequence: append them to the shared vocab and
+        every OTHER table too (ids assign append-only from the same
+        prefix, so all vocabs stay identical)."""
+        extra = [(w, i) for w, i in sub.vocab.items()
+                 if w not in self.vocab]
+        for w, _i in sorted(extra, key=lambda kv: kv[1]):
+            self.vocab[w] = len(self.vocab) + 1
+            for tbl in self._all_tables():
+                if tbl is not sub:
+                    tbl.intern(w)
+
+    def _seg_seed_filters(self, t: int, segments_dir: Optional[str],
+                          expect_epoch: Optional[int],
+                          ) -> Optional[Dict[str, int]]:
+        """Shard ``t``'s persisted (filter → service aid) snapshot iff
+        the manifest's epoch/shape/checksum still match — the rebuild
+        seed.  None → the rebuild runs from the live pairs alone."""
+        if segments_dir is None or expect_epoch is None:
+            return None
+        from ..storage.segments import load_segment
+
+        d = self._seg_dir(segments_dir)
+        try:
+            with open(os.path.join(d, "manifest.json")) as f:
+                meta = json.load(f)
+            if (meta.get("version") != self.MANIFEST_VERSION
+                    or meta.get("tp") != self.tp
+                    or meta.get("depth") != self.depth
+                    or meta.get("native") != bool(self.native)
+                    or meta.get("epoch") != int(expect_epoch)):
+                return None
+            npz = np.load(os.path.join(d, "aid_maps.npz"))
+            arrays = {k: npz[k] for k in npz.files}
+            meta_core = {k: meta[k] for k in
+                         ("version", "epoch", "tp", "depth", "native")}
+            if meta.get("checksum") != self._manifest_checksum(
+                    meta_core, arrays):
+                return None
+            seg = load_segment(os.path.join(d, f"shard{t}.seg.npz"))
+            if seg.depth != self.depth:
+                return None
+            if seg.kind == "filters":
+                sa = np.asarray(arrays[f"sa{t}"], np.int32)
+                if len(sa) != len(seg.filters):
+                    return None
+                return dict(zip(seg.filters, sa.tolist()))
+            amap = np.asarray(arrays[f"m{t}"], np.int32)
+            return {f: int(amap[aid]) for aid, f in
+                    enumerate(seg.accept_filters or [])
+                    if f is not None and aid < len(amap)
+                    and amap[aid] >= 0}
+        except Exception:
+            log.warning("mesh rebuild segment seed unavailable; full "
+                        "rebuild from service state", exc_info=True)
+            return None
+
+    # ------------------------------------------------------------------
     # per-shard segment persistence (opt-in via match.segments.enable)
     # ------------------------------------------------------------------
 
@@ -1004,6 +1417,10 @@ class MultichipMatcher:
         shared vocab in id order, per-filter service aids, and the
         local→service aid maps.  Cold start seeds from these iff the
         epoch still matches (the ``_seg_join_seed`` idiom)."""
+        with self._maint_lock:
+            self._save_segments_locked(segments_dir, epoch)
+
+    def _save_segments_locked(self, segments_dir: str, epoch: int) -> None:
         from ..storage.segments import save_segment
 
         d = self._seg_dir(segments_dir)
@@ -1205,4 +1622,14 @@ class MultichipMatcher:
             "shard_filters": [sub.n_filters for sub in self._subs],
             "micro_filters": len(self._micro_filters),
             "seeded_from_segments": self.seeded_from_segments,
+            "degraded": self.degraded,
+            "mesh_state": ("healthy", "degraded",
+                           "cpu-only")[self.mesh_state()],
+            "fail_counts": {str(t): c for t, c in
+                            sorted(self._fail_counts.items())},
+            "degraded_batches": self.degraded_batches,
+            "cpu_filled_rows": self.cpu_filled_rows,
+            "rebuilds": self.rebuilds,
+            "readmit_canary_fails": self.readmit_canary_fails,
+            "ep_overflow_ewma": round(self._ov_ewma, 6),
         }

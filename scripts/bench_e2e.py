@@ -740,6 +740,13 @@ def chaos_smoke() -> dict:
             "pipeline": await pipeline_cycle(),
             "segments": await segments_cycle(),
             "admission": await admission_cycle(),
+            # degraded-mesh cycle (ISSUE 18): shard kill → degraded
+            # serving → supervised rebuild (one injected crash =
+            # restart evidence) → canary re-admit, delivery 1.0
+            # throughout.  Needs an 8-device mesh, so it rides the
+            # same subprocess isolation as the multichip A/Bs.
+            "mesh": await aio.to_thread(
+                _mesh_smoke, "bench_mesh_chaos_smoke", 96),
         }
 
     return aio.run(all_cycles())

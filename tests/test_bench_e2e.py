@@ -243,3 +243,16 @@ def test_bench_e2e_smoke_delivers_everything():
     assert ac["alarm_raised_and_cleared"], ac
     assert ac["requarantined_after_restart"], ac
     assert ac["score_faults"] >= 1 and ac["fail_opens"] >= 1, ac
+    # degraded-mesh chaos (ISSUE 18): shard killed mid-storm with the
+    # degraded flag on → scoped failover serves (degraded batches
+    # counted), the mesh_degraded alarm + flightrec dump fire, the
+    # supervised rebuild survives one injected mesh.rebuild crash
+    # (the restart evidence), and the canary re-admits the shard —
+    # delivery 1.0 across the whole cycle, ladder back to healthy
+    mdc = out["chaos"]["mesh"]
+    assert mdc["delivery_ratio"] == 1.0, mdc
+    assert mdc["degraded_batches"] >= 1, mdc
+    assert mdc["rebuilds"] >= 1, mdc
+    assert mdc["alarm_raised_and_cleared"], mdc
+    assert mdc["flightrec_dumped"], mdc
+    assert mdc["mesh_state"] == 0, mdc

@@ -24,6 +24,7 @@ from emqx_tpu.client import Client
 from emqx_tpu.config import Config
 from emqx_tpu.faultinject import FaultInjector
 from emqx_tpu.node import BrokerNode
+from emqx_tpu.observe.metrics import Metrics
 from emqx_tpu.ops.incremental import IncrementalNfa
 from emqx_tpu.parallel import multichip_serve as mcs_mod
 from emqx_tpu.parallel.multichip_serve import (
@@ -718,3 +719,321 @@ def test_ep_compact_odd_batch_falls_back_replicated():
         mc.dispatch(mc.encode(["a/b"], batch=4)), 1)
     assert sorted(rows[0]) == sorted(inc.match_host("a/b"))
     assert mc.ep_dispatches == 0   # replicated fallback served
+
+
+# ---------------------------------------------------------------------------
+# degraded mesh: scoped failover, health ladder, online rebuild (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+def fill_parity(inc, mc, topics, rows, sp, fill=None):
+    """The scoped-failover delivery contract: every non-spilled row,
+    credited with the CPU fill of the dead shards' aids, reproduces
+    the host walk exactly."""
+    fill = mc.dead_aids() if fill is None else fill
+    spset = set(sp)
+    for i, t in enumerate(topics):
+        if i in spset:
+            continue
+        host = set(inc.match_host(t))
+        assert set(rows[i]) | (host & fill) == host, t
+
+
+def test_degraded_flag_off_whole_plane_failover_unchanged():
+    """Flag OFF: a dead shard refuses every dispatch (the PR 17
+    whole-plane CPU failover, byte-identical) and the step cache keys
+    carry no micro_owner extension."""
+    inc, mc, _pairs = build_pair()
+    assert mc.degraded is False
+    mc.kill_shard(0)
+    assert not mc.degraded_serving
+    assert mc.mesh_state() == 2
+    with pytest.raises(ShardDead):
+        mc.dispatch(mc.encode(["a/b"], batch=64))
+    assert mc.failovers == 1 and mc.degraded_batches == 0
+    mc.revive_shard(0)
+    assert mc.mesh_state() == 0
+    rows, _, _ = mesh_rows(mc, ["a/b"])
+    assert sorted(rows[0]) == sorted(inc.match_host("a/b"))
+    # PR 17 key shape verbatim: (batch, depth, kind) — no owner element
+    assert all(len(k) == 3 for k in mc._steps)
+
+
+def test_degraded_replicated_mask_and_micro_owner_migration():
+    """Replicated scoped failover: the dead shard's answer segment is
+    masked (rows decode exactly the LIVE shards' answers — the service
+    CPU-fills the rest), micro filters never enter the fill set, and
+    killing shard 0 migrates the micro merge point to the lowest live
+    shard so wildcard-root answers stay on-device."""
+    met = Metrics()
+    inc, mc, _pairs = build_pair(degraded=True, metrics=met)
+    topics = topics_for(24) + ["m/n", "q/b"]
+    want = [set(inc.match_host(t)) for t in topics]
+    mc.kill_shard(0)     # owns "m/n" AND the default micro merge point
+    assert mc.degraded_serving and mc.mesh_state() == 1
+    dead = mc.dead_aids()
+    assert dead, "victim shard must own part of the corpus"
+    micro_aids = set(mc._micro_filters.values())
+    assert micro_aids and not (micro_aids & dead)
+    rows, sp, _ = mesh_rows(mc, topics)
+    assert not sp
+    for t, r, w in zip(topics, rows, want):
+        assert set(r) == w - dead, t
+    # "q/b" matches only wildcard-root (micro) filters: fully on-device
+    # through the MIGRATED merge owner
+    i = topics.index("q/b")
+    assert set(rows[i]) == want[i]
+    assert mc.degraded_batches >= 1
+    assert met.get("tpu.mesh.degraded_batches") >= 1
+    assert met.get("tpu.mesh.state") == 1
+    mc.revive_shard(0)
+    rows2, _, _ = mesh_rows(mc, topics)
+    assert [set(r) for r in rows2] == want
+
+
+def test_degraded_ep_scoped_failover_row_accounting():
+    """EP-routed degraded serving: EXACTLY the rows whose crc32-root
+    owner is dead divert to the CPU trie; the other (tp-1)/tp of an
+    owner-balanced batch stays on-device with bit-exact host parity
+    (the dead shard's literal filters share no root with a live-owned
+    row), and the divert set is counted on ``cpu_filled_rows``."""
+    met = Metrics()
+    tp = serve_mesh_shape(8)["tp"]
+    roots: dict = {t: [] for t in range(tp)}
+    i = 0
+    while any(len(v) < 2 for v in roots.values()):
+        r = f"r{i}"
+        i += 1
+        roots[shard_of_filter(f"{r}/a/+", tp)].append(r)
+    inc = IncrementalNfa(depth=8)
+    pairs = []
+    for t in range(tp):
+        for r in roots[t][:2]:
+            for f in (f"{r}/a/+", f"{r}/b/#"):
+                inc.add(f)
+                pairs.append((f, inc.aid_of(f)))
+    inc.add("+/m/#")
+    pairs.append(("+/m/#", inc.aid_of("+/m/#")))
+    mc = MultichipMatcher(depth=8, ep=True, ep_slack=4.0,
+                          degraded=True, metrics=met)
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    batch = 64
+    topics = [f"{roots[k % tp][(k // tp) % 2]}/a/x" for k in range(batch)]
+    rows0, sp0, _ = mesh_rows(mc, topics, batch=batch)
+    assert not sp0 and mc.ep_dispatches == 1
+    victim = 1
+    mc.kill_shard(victim)
+    assert mc.degraded_serving
+    rows, sp, _ = mesh_rows(mc, topics, batch=batch)
+    dead_rows = {k for k, t in enumerate(topics)
+                 if shard_of_filter(t, tp) == victim}
+    assert set(sp) == dead_rows
+    assert len(sp) == batch // tp          # owner-balanced: exactly 1/tp
+    for k, t in enumerate(topics):
+        if k not in dead_rows:
+            assert sorted(rows[k]) == sorted(inc.match_host(t)), t
+    assert mc.cpu_filled_rows == len(dead_rows)
+    assert met.get("tpu.mesh.cpu_filled_rows") == len(dead_rows)
+    assert met.get("tpu.mesh.degraded_batches") >= 1
+
+
+def test_degraded_double_kill_cpu_only_then_staged_readmit():
+    """The double-kill rung: two dead shards drop the plane to
+    cpu-only (every dispatch refused), and the staged re-admit climbs
+    back — lowest shard rebuilt + canaried first (serving resumes
+    degraded around the remaining dead shard), then the second, back
+    to healthy with bit parity."""
+    inc, mc, pairs = build_pair(degraded=True)
+    topics = topics_for(24) + ["m/n", "b/c"]
+    mc.kill_shard(0)
+    assert mc.degraded_serving and mc.mesh_state() == 1
+    mc.kill_shard(1)
+    assert not mc.degraded_serving and mc.mesh_state() == 2
+    with pytest.raises(ShardDead):
+        mc.dispatch(mc.encode(topics, batch=64))
+    for t in (0, 1):
+        assert mc.rebuild_shard(t, pairs) >= 0.0
+        ctop = mc.canary_topics(t)
+        assert ctop, "victim shards own filters in this corpus"
+        crows, csp = mc.canary_rows(ctop, 64, t)
+        fill_parity(inc, mc, ctop, crows, csp,
+                    fill=mc.dead_aids(exclude=t))
+        mc.revive_shard(t)
+        assert mc.mesh_state() == (1 if t == 0 else 0)
+        if t == 0:
+            # middle rung: degraded(S) serving around shard 1
+            rows, sp, _ = mesh_rows(mc, topics)
+            assert not sp
+            fill_parity(inc, mc, topics, rows, sp)
+    rows, sp, _ = mesh_rows(mc, topics)
+    assert not sp
+    for t_, r in zip(topics, rows):
+        assert sorted(r) == sorted(inc.match_host(t_)), t_
+    assert mc.rebuilds == 2
+
+
+def test_rebuild_shard_delta_tail_replay_and_readmit_zero_stale():
+    """Online rebuild converges on the LIVE filter state: a filter
+    added while its owner shard was dead is replayed from the service
+    pairs into the fresh subtable, the canary proves bit parity, and
+    after re-admission the delta filter serves on-device (zero-stale
+    re-admission)."""
+    inc, mc, pairs = build_pair(degraded=True)
+    f = "delta/x/+"
+    t = shard_of_filter(f, mc.tp)
+    mc.kill_shard(t)
+    inc.add(f)
+    pairs.append((f, inc.aid_of(f)))      # the delta lands while dead
+    assert mc.rebuild_shard(t, pairs) >= 0.0
+    ctop = mc.canary_topics(t)
+    assert any(c.startswith("delta/") for c in ctop)
+    crows, csp = mc.canary_rows(ctop, 64, t)
+    csps = set(csp)
+    for i, topic in enumerate(ctop):
+        if i in csps:
+            continue
+        assert sorted(crows[i]) == sorted(inc.match_host(topic)), topic
+    mc.revive_shard(t)
+    assert mc.mesh_state() == 0
+    rows, sp, _ = mesh_rows(mc, ["delta/x/y"])
+    assert not sp
+    assert inc.aid_of(f) in rows[0]
+    assert sorted(rows[0]) == sorted(inc.match_host("delta/x/y"))
+
+
+def test_shard_kill_races_apply_pending_restack():
+    """Satellite chaos: a shard dies WHILE ``apply_pending`` is
+    mid-restack (inside the maintenance lock).  The swap completes on
+    the full grid, degraded serving picks the death up afterwards with
+    the fill contract intact, and the online rebuild re-admits it with
+    parity — maintenance and the health ladder never tear the table."""
+    inc, mc, pairs = build_pair(degraded=True)
+    victim = 1
+    real = mc._restack
+
+    def racy():
+        mc.kill_shard(victim)     # death lands mid-maintenance
+        real()
+
+    mc._restack = racy
+    try:
+        for f in ("race/a/+", "race/b/#"):
+            inc.add(f)
+            pairs.append((f, inc.aid_of(f)))
+        mc.rebuild(pairs)          # the full-restack (swap) path
+        assert mc.apply_pending()
+    finally:
+        mc._restack = real
+    assert mc.dead_shards == [victim] and mc.degraded_serving
+    topics = topics_for(16) + ["race/a/x", "b/c"]
+    rows, sp, _ = mesh_rows(mc, topics)
+    assert not sp
+    fill_parity(inc, mc, topics, rows, sp)
+    assert mc.rebuild_shard(victim, pairs) >= 0.0
+    mc.revive_shard(victim)
+    rows2, sp2, _ = mesh_rows(mc, topics)
+    assert not sp2
+    for t, r in zip(topics, rows2):
+        assert sorted(r) == sorted(inc.match_host(t)), t
+
+
+def test_node_shard_kill_races_compaction_swap_then_readmits():
+    """Satellite chaos at node level: kill a shard in the compaction
+    swap window (the service just bumped ``_table_gen``; the mesh
+    repartition hasn't landed).  The swap completes, the health ladder
+    raises the degraded alarm, and the supervised rebuild re-admits
+    the shard through the canary — serving never stops."""
+
+    async def main():
+        import tempfile
+
+        seg = tempfile.mkdtemp()
+        node = make_node(**{
+            "match.segments.enable": True,
+            "match.segments.dir": seg,
+            "match.segments.compact_interval": 0.2,
+            "match.segments.compact_min_mutations": 1,
+            "match.multichip.degraded.enable": True,
+            "supervisor.backoff_base": 0.005,
+            "supervisor.backoff_max": 0.05,
+        })
+        await node.start()
+        ms = node.match_service
+        assert ms is not None and ms.mc is not None and ms.mc.degraded
+        try:
+            b = node.broker
+            if "c1" not in b.sessions:
+                b.open_session("c1")
+            for i in range(8):
+                b.subscribe("c1", f"swap/{i}/+")
+            assert await settle(lambda: ms.ready and ms.mc.ready)
+            gen0 = ms.mc.gen
+            assert await settle(lambda: ms._table_gen >= 1, timeout=30)
+            ms.mc.kill_shard(1)            # mid-swap-window death
+            assert await settle(
+                lambda: ms.mc.ready and ms.mc.gen > gen0, timeout=30)
+            # the supervised rebuild re-admits it (canary-gated)
+            assert await settle(lambda: not ms.mc.dead_shards,
+                                timeout=60)
+            assert ms.mc.rebuilds >= 1
+            assert await settle(
+                lambda: not node.observed.alarms.is_active(
+                    "mesh_degraded"), timeout=30)
+            await ms.prefetch("swap/3/x")
+            assert ms.hint_routes("swap/3/x") is not None
+            assert node.observed.metrics.get("tpu.mesh.state") == 0
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_node_canary_failure_blocks_readmit_until_parity():
+    """A failing bit-parity canary keeps the rebuilt shard OUT:
+    ``tpu.mesh.readmit_canary_fails`` counts the refusals, the
+    degraded alarm stays up, and the moment parity is restored the
+    shard re-admits and the alarm clears."""
+
+    async def main():
+        node = make_node(**{
+            "match.multichip.degraded.enable": True,
+            "supervisor.backoff_base": 0.005,
+            "supervisor.backoff_max": 0.05,
+        })
+        await node.start()
+        ms = node.match_service
+        assert ms is not None and ms.mc is not None
+        try:
+            b = node.broker
+            if "c1" not in b.sessions:
+                b.open_session("c1")
+            for i in range(6):
+                b.subscribe("c1", f"cn/{i}/+")
+            assert await settle(lambda: ms.ready and ms.mc.ready)
+
+            async def failing(t):
+                return False
+
+            ms._mesh_canary = failing     # parity probe refuses
+            ms.mc.kill_shard(0)
+            await ms.prefetch("cn/0/x")   # serve pass trips the watch
+            m = node.observed.metrics
+            assert await settle(
+                lambda: m.get("tpu.mesh.readmit_canary_fails") >= 2,
+                timeout=30)
+            assert ms.mc.dead_shards == [0]      # stays OUT
+            assert node.observed.alarms.is_active("mesh_degraded")
+            info = ms.mesh_info()
+            assert info["alarmed"] and info["rebuilding"]
+            del ms._mesh_canary           # parity restored
+            assert await settle(lambda: not ms.mc.dead_shards,
+                                timeout=60)
+            assert await settle(
+                lambda: not node.observed.alarms.is_active(
+                    "mesh_degraded"), timeout=30)
+            assert ms.mc.readmit_canary_fails >= 2
+            assert ms.mc.rebuilds >= 1
+        finally:
+            await node.stop()
+
+    run(main())
