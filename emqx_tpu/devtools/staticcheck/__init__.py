@@ -6,17 +6,21 @@ cost floor for our asyncio hot path.  It is a **two-pass whole-program
 analysis**: pass 1 (:mod:`.symbols`) walks every file once and builds
 the project symbol table + import graph (module-qualified functions and
 methods, ``from .x import y`` aliases, class MRO for ``self.`` calls,
-call/write/read/acquire/spawn edges); pass 2 (:mod:`.graph` + the
+call/write/read/acquire/spawn edges, suspension points, donated
+dispatches with operand roots and later uses, device-sync sites,
+faultinject point decl/use facts); pass 2 (:mod:`.graph` + the
 per-file walker in :mod:`.core`) runs the rules against **resolved
 callees** instead of syntactic names — per-file rules ride one shared
-walker, graph rules (affinity, torn-read, lock-order, deep taint) run
-over the whole-program call graph.  The affinity lattice is
-**context-sensitive** (k=1 CFA): functions carry reachability *paths*
-(plane × lock-held × caller) with exact parents, so findings name the
-offending entry chain and allow/absorb facts scope per context.
+walker, graph rules (affinity, torn-read, await-torn-read,
+lock-order, use-after-donate, host-sync-in-loop, deep taint) run over
+the whole-program call graph.  The affinity lattice is
+**context-sensitive** (k=2 CFA): functions carry reachability *paths*
+(plane × lock-held × ≤2-hop caller chain, nearest first), so findings
+name the offending entry chain, allow/absorb facts scope per context,
+and two entries through one shared mid-function stay distinct.
 Pass-1 summaries and per-file findings cache under
 ``.staticcheck_cache/`` (:mod:`.cache`) so the tier-1 full-tree scan
-stays ~1 s warm.
+stays ~1 s warm; ``--jobs`` fans the cold parse over a process pool.
 
 ================  =====================================================
 no-unsupervised-task   ``asyncio.create_task``/``ensure_future`` outside
@@ -56,9 +60,24 @@ await-under-lock       blocking waits (``asyncio.sleep``/``wait``/
 registry-drift         every literal metric / config key / faultinject
                        point / alarm name must exist at its registration
                        site — including the metric *reads* bench.py and
-                       scripts/bench_e2e.py consume by literal
+                       scripts/bench_e2e.py consume by literal; and the
+                       reverse: every declared faultinject point needs
+                       ≥1 literal act/check gate (dead-seam detection)
 unawaited-coroutine    coroutine calls whose result is discarded —
                        resolved across modules and through the MRO
+await-torn-read        ≥2 fields of one invariant group read on an
+                       unlocked main-loop path with an await/async-for/
+                       async-with suspension BETWEEN the reads — the
+                       loop's own preemption point tears the invariant
+use-after-donate       a local read or re-dispatched after flowing into
+                       a donated operand position (``nfa_match_donated``,
+                       donate-keyed kernel_cache executables): the read
+                       observes freed device storage; the rebind idiom
+                       ``x = fn_donated(x, ...)`` is clean
+host-sync-in-loop      ``block_until_ready``/``device_get``/
+                       ``device_put``/``np.asarray``-of-device-value
+                       reachable on a main/shard event-loop path — the
+                       stall belongs behind asyncio.to_thread
 ================  =====================================================
 
 Run it::

@@ -31,7 +31,9 @@ __all__ = ["AnalysisCache", "environment_digest", "CACHE_VERSION"]
 
 # v3: ModuleSummary grew read/acquire sites (the read-set model + the
 # lock-order graph) and findings carry a context chain
-CACHE_VERSION = 4
+# v5: device-plane sites (await/donate/device-sync), fault-point
+# decl/use facts, and the k=2 affinity contexts
+CACHE_VERSION = 5
 
 
 def environment_digest(rule_names, registries=None,

@@ -426,27 +426,59 @@ class AnalysisResult:
     files_cached: int = 0
 
 
+#: cold misses below this stay serial: process-pool spin-up costs more
+#: than parsing a handful of files
+_POOL_MIN_FILES = 4
+
+
+def _pass1_worker(item: Tuple[str, str]) -> Tuple[
+        str, Optional[dict], Optional[Tuple[int, int, str]]]:
+    """Process-pool pass-1 unit: parse + extract one file.  Returns
+    ``(relpath, summary-dict, syntax-error)`` — pure picklable data
+    only (the AST never crosses the process boundary; pass 2 re-parses
+    on demand through the existing ``parsed`` fallback)."""
+    from .symbols import extract_module
+
+    path, relpath = item
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    except OSError as e:
+        return relpath, None, (0, 0, str(e))
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return relpath, None, (e.lineno or 0, e.offset or 0,
+                               e.msg or "syntax error")
+    return relpath, extract_module(relpath, tree, source).to_dict(), None
+
+
 def analyze(paths: Iterable[str], rules: Sequence[Rule],
             root: Optional[str] = None, cache: Any = None,
             targets: Optional[Iterable[str]] = None,
-            prune_cache: bool = False) -> AnalysisResult:
+            prune_cache: bool = False,
+            jobs: Optional[int] = None) -> AnalysisResult:
     """The two-pass pipeline.
 
     Pass 1 builds a :class:`graph.Project` over EVERY file (using
-    cached summaries when valid).  Pass 2 walks the per-file rules over
-    the target set (all files by default; ``--changed`` narrows it)
-    with cached findings reused when the file, its transitive imports,
-    and the rule environment are all unchanged — then runs each rule's
-    cross-file ``finalize`` over the project.
+    cached summaries when valid).  With ``jobs`` > 1 and enough cold
+    misses, parsing/extraction fans out over a process pool — the
+    summaries are pure data, so only the join changes.  Pass 2 walks
+    the per-file rules over the target set (all files by default;
+    ``--changed`` narrows it) with cached findings reused when the
+    file, its transitive imports, and the rule environment are all
+    unchanged — then runs each rule's cross-file ``finalize`` over the
+    project.
     """
     from .graph import Project
-    from .symbols import extract_module
+    from .symbols import ModuleSummary, extract_module
 
     files = list(iter_py_files(paths))
     summaries = []
     parsed: Dict[str, Tuple[ast.Module, str]] = {}  # relpath → tree,src
     syntax_errors: Dict[str, Finding] = {}
     relpaths: Dict[str, str] = {}
+    pending: List[Tuple[str, str]] = []  # cold misses: (path, relpath)
     for path in files:
         relpath = _relpath(path, root)
         relpaths[path] = relpath
@@ -455,22 +487,53 @@ def analyze(paths: Iterable[str], rules: Sequence[Rule],
         if cached is not None:
             summaries.append(cached[0])
             continue
-        with open(path, "r", encoding="utf-8") as f:
-            source = f.read()
-        try:
-            tree = ast.parse(source, filename=path)
-        except SyntaxError as e:
-            syntax_errors[relpath] = Finding(
-                rule="syntax-error", path=relpath, line=e.lineno or 0,
-                col=e.offset or 0,
-                message=f"file does not parse: {e.msg}",
-                context="<module>")
-            continue
-        parsed[relpath] = (tree, source)
-        summary = extract_module(relpath, tree, source)
-        summaries.append(summary)
-        if cache is not None:
-            cache.store_summary(relpath, path, summary)
+        pending.append((path, relpath))
+    pool_jobs = min(jobs or 1, len(pending))
+    if pool_jobs > 1 and len(pending) >= _POOL_MIN_FILES:
+        import concurrent.futures
+        import multiprocessing
+
+        path_of = {rp: p for p, rp in pending}
+        # spawn, not fork: the analysis is often invoked from a
+        # process that already imported jax (tests, bench drivers),
+        # and forking a multithreaded runtime can deadlock the child;
+        # the workers only parse ASTs, so a fresh interpreter is cheap
+        with concurrent.futures.ProcessPoolExecutor(
+                max_workers=pool_jobs,
+                mp_context=multiprocessing.get_context("spawn"),
+        ) as pool:
+            for relpath, sdict, err in pool.map(
+                    _pass1_worker, pending, chunksize=8):
+                if err is not None:
+                    syntax_errors[relpath] = Finding(
+                        rule="syntax-error", path=relpath, line=err[0],
+                        col=err[1],
+                        message=f"file does not parse: {err[2]}",
+                        context="<module>")
+                    continue
+                summary = ModuleSummary.from_dict(sdict)
+                summaries.append(summary)
+                if cache is not None:
+                    cache.store_summary(
+                        relpath, path_of[relpath], summary)
+    else:
+        for path, relpath in pending:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as e:
+                syntax_errors[relpath] = Finding(
+                    rule="syntax-error", path=relpath,
+                    line=e.lineno or 0, col=e.offset or 0,
+                    message=f"file does not parse: {e.msg}",
+                    context="<module>")
+                continue
+            parsed[relpath] = (tree, source)
+            summary = extract_module(relpath, tree, source)
+            summaries.append(summary)
+            if cache is not None:
+                cache.store_summary(relpath, path, summary)
 
     project = Project(summaries)
     for rule in rules:

@@ -436,36 +436,53 @@ def _suffix_match(qualname: str, suffix: str) -> bool:
     return qualname == suffix or qualname.endswith("." + suffix)
 
 
-#: a reachability path context: (plane, lock-held, immediate caller
-#: fqid).  The caller component is "" for a seeded entry and "*" once
-#: the per-function caller bound is exceeded (the bounded summary
-#: cache — hub functions keep a merged context instead of one per
-#: caller).
-Ctx = Tuple[str, bool, str]
+#: a reachability path context: (plane, lock-held, caller chain).  The
+#: chain is the last ≤2 caller fqids, nearest first — k=2 call-site
+#: sensitivity.  ``()`` marks a seeded entry; ``("*",)`` the merged
+#: context hub functions collapse into once the per-function caller
+#: bound is exceeded (the bounded summary cache).
+Ctx = Tuple[str, bool, Tuple[str, ...]]
+
+#: the merged hub context (shared instance: contexts are interned)
+_STAR: Tuple[str, ...] = ("*",)
 
 
 class AffinityAnalysis:
-    """Context-sensitive (k=1 CFA) fixpoint propagation of
+    """Context-sensitive (k=2 CFA) fixpoint propagation of
     (plane, mutex-held) paths over the resolved call graph.
-    ``state[fqid]`` maps each reached ``(plane, locked, caller)``
+    ``state[fqid]`` maps each reached ``(plane, locked, caller-chain)``
     context to the exact ``(parent fqid, parent ctx, via-line)`` that
     first reached it, so a finding's entry chain is the real path —
     not a guess across merged contexts.
 
-    Out-edges of a function expand **once** per ``(plane, locked)``
-    (additional callers only record their path, they re-derive
-    nothing), which keeps the context-sensitive run the same order of
-    work as the old context-insensitive one."""
+    k=2 is what makes per-entry exemptions sound: when two entries
+    reach a helper through the SAME mid function, k=1 held a single
+    ``(plane, locked, mid)`` context at the helper — the first path
+    won, and exempting that one entry silently absorbed the second.
+    With 2-deep chains ``(mid, entryA)`` and ``(mid, entryB)`` stay
+    distinct contexts, each with its own parent pointer.
 
-    #: distinct recorded callers per (function, plane, locked) before
-    #: further callers collapse into the "*" context
+    Cost is bounded three ways: out-edge resolution is cached per
+    ``(function, view)`` so re-expansion per context never re-resolves;
+    contexts per ``(function, plane, locked)`` collapse into ``("*",)``
+    past MAX_CALLERS; and chain tuples are interned, so memory holds
+    one instance per distinct chain."""
+
+    #: distinct recorded caller chains per (function, plane, locked)
+    #: before further callers collapse into the ("*",) context
     MAX_CALLERS = 12
 
     def __init__(self, project: Project) -> None:
         self.project = project
         self.state: Dict[str, Dict[Ctx, Optional[
             Tuple[str, Ctx, int]]]] = {}
-        self._expanded: Set[Tuple[str, str, bool]] = set()
+        self._expanded: Set[Tuple[str, Ctx]] = set()
+        self._ctx_pool: Dict[Tuple[str, ...], Tuple[str, ...]] = {
+            (): (), _STAR: _STAR}
+        # (fqid, view) → resolved out-edges
+        # [(target fqid, line, lock-elevating, boots_loop)]
+        self._edge_cache: Dict[Tuple[str, str],
+                               List[Tuple[str, int, bool, bool]]] = {}
         self._run()
 
     # -- queries -------------------------------------------------------
@@ -477,7 +494,7 @@ class AffinityAnalysis:
 
     def paths(self, fqid: str) -> List[Ctx]:
         """All reached path contexts, deterministic order (seeded
-        entries sort first: "" < any caller fqid)."""
+        entries sort first: ``()`` < any caller chain)."""
         return sorted(self.state.get(fqid, ()))
 
     def label(self, fqid: str) -> str:
@@ -523,7 +540,7 @@ class AffinityAnalysis:
     def _seed(self, fqid: str, plane: str, locked: bool,
               worklist: List[Tuple[str, Ctx]]) -> None:
         st = self.state.setdefault(fqid, {})
-        key: Ctx = (plane, locked, "")
+        key: Ctx = (plane, locked, ())
         if key not in st:
             st[key] = None
             worklist.append((fqid, key))
@@ -532,13 +549,16 @@ class AffinityAnalysis:
                parent_fqid: str, parent_ctx: Ctx, line: int,
                worklist: List[Tuple[str, Ctx]]) -> None:
         st = self.state.setdefault(fqid, {})
-        key: Ctx = (plane, locked, parent_fqid)
+        # k=2: this call site plus the nearest caller of the parent
+        chain = (parent_fqid,) + parent_ctx[2][:1]
+        chain = self._ctx_pool.setdefault(chain, chain)
+        key: Ctx = (plane, locked, chain)
         if key in st:
             return
         ncallers = sum(1 for c in st
                        if c[0] == plane and c[1] == locked)
         if ncallers >= self.MAX_CALLERS:
-            key = (plane, locked, "*")
+            key = (plane, locked, _STAR)
             if key in st:
                 return
         st[key] = (parent_fqid, parent_ctx, line)
@@ -604,33 +624,48 @@ class AffinityAnalysis:
         self._barriers = barrier_ids
         while worklist:
             fqid, ctx = worklist.pop()
-            plane, locked, _caller = ctx
-            # bounded summary cache: out-edges of a function expand
-            # once per (plane, locked); later callers only record paths
-            if (fqid, plane, locked) in self._expanded:
+            plane, locked, _chain = ctx
+            # each recorded context expands once: under k=2 the second
+            # grandparent's chain must flow past shared mid functions,
+            # so expansion is per context — the out-edge cache keeps
+            # the repeated expansions resolution-free
+            if (fqid, ctx) in self._expanded:
                 continue
-            self._expanded.add((fqid, plane, locked))
-            entry = project.func(fqid)
-            if entry is None:
-                continue
-            s, fi = entry
+            self._expanded.add((fqid, ctx))
             view = plane if plane in (SHARD, THREAD) else MAIN
-            for call in fi.calls:
-                r = project.resolve(s, fi, call.chain, view=view)
-                if r is None or r.kind != "func":
-                    continue
-                tid = r.fqid
+            for tid, line, lock_elev, boots in \
+                    self._out_edges(fqid, view):
                 if tid == fqid:
                     continue
                 bplanes = barrier_ids.get(tid)
                 if bplanes is not None and plane in bplanes:
                     continue
-                if plane == THREAD and r.func.boots_loop:
+                if plane == THREAD and boots:
                     continue  # bootstraps its own loop: absorbed
-                site_locked = locked or any(
-                    lk in facts.AFFINITY_LOCKS for lk in call.locks)
-                self._reach(tid, plane, site_locked, fqid, ctx,
-                            call.line, worklist)
+                self._reach(tid, plane, locked or lock_elev, fqid, ctx,
+                            line, worklist)
+
+    def _out_edges(self, fqid: str,
+                   view: str) -> List[Tuple[str, int, bool, bool]]:
+        """Resolved call targets of one function under one attr-typing
+        view, cached — context re-expansion never re-resolves."""
+        cached = self._edge_cache.get((fqid, view))
+        if cached is not None:
+            return cached
+        out: List[Tuple[str, int, bool, bool]] = []
+        entry = self.project.func(fqid)
+        if entry is not None:
+            s, fi = entry
+            for call in fi.calls:
+                r = self.project.resolve(s, fi, call.chain, view=view)
+                if r is None or r.kind != "func":
+                    continue
+                lock_elev = any(lk in facts.AFFINITY_LOCKS
+                                for lk in call.locks)
+                out.append((r.fqid, call.line, lock_elev,
+                            r.func.boots_loop))
+        self._edge_cache[(fqid, view)] = out
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -23,6 +23,7 @@ __all__ = [
     "MAIN_ONLY_CLASSES", "LOCKED_FIELDS", "ATTR_TYPES",
     "SHARD_ATTR_TYPES", "VARNAME_HINTS", "AFFINITY_ALLOWED_SITES",
     "INVARIANT_GROUPS", "TORN_READ_ALLOWED_SITES",
+    "HOST_SYNC_ALLOWED_SITES", "DONATE_ALLOWED_SITES",
     "LOCK_ORDER_ALLOWED", "barrier_fact", "site_exemption",
 ]
 
@@ -336,6 +337,24 @@ INVARIANT_GROUPS: Dict[str, Tuple[str, FrozenSet[str], str, str]] = {
 #: torn-read rule; same value forms and per-context semantics as
 #: AFFINITY_ALLOWED_SITES.
 TORN_READ_ALLOWED_SITES: Dict[Tuple[str, str], object] = {
+}
+
+#: (repo-relative path, enclosing qualname) → exemption for the
+#: host-sync-in-loop rule; same value forms and per-context semantics
+#: as AFFINITY_ALLOWED_SITES.  An entry here states that a device
+#: synchronization on a loop-affine path is acceptable — a strong
+#: claim, so each reason must say why the stall is bounded (startup
+#: one-shot, shutdown drain, cold path behind a breaker, ...).
+HOST_SYNC_ALLOWED_SITES: Dict[Tuple[str, str], object] = {
+}
+
+#: (repo-relative path, enclosing qualname) → reason for the
+#: use-after-donate rule.  Donation legality does not vary by plane,
+#: so the value is always a bare reason string.  Should stay EMPTY:
+#: a use-after-donate is a memory-safety bug on real devices (the CPU
+#: backend hides it by copying), and the rebind idiom
+#: ``x = fn_donated(x, ...)`` is already clean by construction.
+DONATE_ALLOWED_SITES: Dict[Tuple[str, str], str] = {
 }
 
 #: Reasoned exemptions for the lock-order rule, keyed by the sorted

@@ -8,10 +8,13 @@ from typing import Iterable, List, Optional
 from ..core import Rule
 from ..registry import Registries
 from .affinity import ShardAffinity
+from .awaittorn import AwaitTornRead
 from .blocking import NoBlockingInAsync
 from .coroutines import UnawaitedCoroutine
+from .donate import UseAfterDonate
 from .drift import RegistryDrift
 from .exceptions import NoSwallowedExceptions
+from .hostsync import HostSyncInLoop
 from .lockorder import LockOrder
 from .locks import AwaitUnderLock
 from .tasks import NoUnsupervisedTask
@@ -23,12 +26,15 @@ ALL_RULES = [
     LoopThreadTaint,
     ShardAffinity,
     TornRead,
+    AwaitTornRead,
     LockOrder,
     NoBlockingInAsync,
     NoSwallowedExceptions,
     AwaitUnderLock,
     RegistryDrift,
     UnawaitedCoroutine,
+    UseAfterDonate,
+    HostSyncInLoop,
 ]
 
 __all__ = ["ALL_RULES", "get_rules"]

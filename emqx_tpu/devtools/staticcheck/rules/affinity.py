@@ -7,10 +7,12 @@ the same object), and shard-affine helpers never touch the main loop.
 This rule turns the prose into a checked property.
 
 The affinity lattice (:mod:`..graph`) is **context-sensitive**
-(1-call-site-sensitive, k=1 CFA): every function carries the set of
-*paths* it is reachable on — ``(plane, lock-held, caller)`` triples
-with exact parents — so a helper reached from the main loop under the
-RLock and from a shard without it keeps the two disciplines separate:
+(2-call-site-sensitive, k=2 CFA): every function carries the set of
+*paths* it is reachable on — ``(plane, lock-held, caller-chain)``
+triples with exact parents — so a helper reached from the main loop
+under the RLock and from a shard without it keeps the two disciplines
+separate, and two entries reaching it through one shared mid function
+stay distinct contexts:
 the finding fires only for the offending path and its report names
 that path's entry chain (``Finding.chain``).  Seeds come from the
 declarative ownership facts (``project.AFFINITY_SEEDS``: ShardChannel
@@ -63,7 +65,7 @@ class ShardAffinity(Rule):
     # ------------------------------------------------------------------
 
     def _surviving(self, aff, fqid: str, s, fi,
-                   ctxs: Sequence[Tuple[str, bool, str]]):
+                   ctxs: Sequence[Tuple[str, bool, Tuple[str, ...]]]):
         """(ctx, entry-chain) pairs not covered by a per-context
         allow fact, for the offending contexts of one site."""
         out = []

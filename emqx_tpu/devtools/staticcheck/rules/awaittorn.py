@@ -1,0 +1,113 @@
+"""await-torn-read: main-plane extension of torn-read — an ``await``
+between reads of one multi-field invariant.
+
+The shard/thread torn-read rule models *preemptive* interleaving;
+the main loop has its own preemption point: every ``await`` (and
+``async for`` / ``async with`` header) hands the loop to any other
+runnable task, which may mutate the same session state before the
+coroutine resumes.  Reading ``Session.inflight`` before an await and
+``Session.mqueue`` after it observes two different moments of the
+QoS window — the exact torn pair the shard rule flags, minus the
+thread.
+
+Pass 1 records every suspension point (:class:`~..symbols.AwaitSite`)
+alongside the read-set model, so the check is positional: ≥2 fields
+of one ``project.INVARIANT_GROUPS`` group read in a function that is
+main-plane reachable, with a suspension point strictly between the
+first and last of those reads, and no single ``with <lock>:`` block
+covering the set (one critical section cannot be torn — the loop
+only suspends at awaits, and a sync lock block contains none).
+Paths that already hold the group's lock at entry are clean: the
+RLock is held across the awaits, so lock-respecting mutators cannot
+interleave.
+
+Structural exemptions: ``project.TORN_READ_ALLOWED_SITES`` — shared
+with the shard rule on purpose: a site-level reason why a torn
+observation of a group is benign does not depend on which plane
+tears it.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import project as facts
+from ..core import Finding, Rule
+from ..graph import MAIN, Project
+
+__all__ = ["AwaitTornRead"]
+
+
+class AwaitTornRead(Rule):
+    name = "await-torn-read"
+    description = ("multi-field invariant read torn by an await "
+                   "suspension on a main-loop path")
+    node_types = ()  # graph rule: everything happens in finalize
+
+    def begin_run(self) -> None:
+        self._project: Project = None  # type: ignore[assignment]
+
+    def begin_project(self, project: Project) -> None:
+        self._project = project
+
+    def finalize(self) -> List[Finding]:
+        project = self._project
+        if project is None:
+            return []
+        aff = project.affinity()
+        out: List[Finding] = []
+        for fqid, s, fi in project.functions():
+            if not fi.awaits or not fi.reads:
+                continue
+            offending = [c for c in aff.paths(fqid)
+                         if c[0] == MAIN and not c[1]]
+            if not offending:
+                continue
+            for gname, (owner, fields, lock, why) in sorted(
+                    facts.INVARIANT_GROUPS.items()):
+                sites = [
+                    r for r in fi.reads
+                    if r.attr in fields
+                    and project.owner_class(
+                        s, fi, r.chain, view=MAIN) == owner
+                ]
+                if len({r.attr for r in sites}) < 2:
+                    continue
+                blocks = {r.block_of(lock) for r in sites}
+                if None not in blocks and len(blocks) == 1:
+                    continue  # one critical section covers the set
+                lo = min(r.line for r in sites)
+                hi = max(r.line for r in sites)
+                tearing = [a for a in fi.awaits
+                           if lo <= a.line < hi]
+                if not tearing:
+                    continue
+                survivors = []
+                for ctx in offending:
+                    chain = aff.trace_ctx(fqid, ctx)
+                    entry = chain[0] if chain else fi.qualname
+                    if facts.site_exemption(
+                            facts.TORN_READ_ALLOWED_SITES, s.relpath,
+                            fi.qualname, ctx[0], entry) is None:
+                        survivors.append((ctx, chain))
+                if not survivors:
+                    continue
+                ctx, chain = survivors[0]
+                susp = tearing[0]
+                read_fields = ", ".join(sorted(
+                    {r.attr for r in sites}))
+                out.append(Finding(
+                    rule=self.name, path=s.relpath, line=lo,
+                    col=min(sites,
+                            key=lambda r: (r.line, r.col)).col,
+                    message=(
+                        f"{fi.qualname!r} reads {read_fields} of "
+                        f"{owner} (invariant group {gname!r}: {why}) "
+                        f"on a main-loop path with a suspension point "
+                        f"({susp.kind}, line {susp.line}) between the "
+                        "reads; any task may run there and mutate the "
+                        "group — take both reads before the await, or "
+                        "hold the group's lock across them"),
+                    context=fi.qualname, chain=tuple(chain),
+                ))
+        return out

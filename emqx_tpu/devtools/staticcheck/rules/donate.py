@@ -1,0 +1,79 @@
+"""use-after-donate: a local read or re-dispatched after its buffer
+was handed to a donated operand position.
+
+The donated-jit twins (``nfa_match_donated`` and any donate-keyed
+``kernel_cache`` executable) alias their input buffers into the
+output — that is the whole point of donation: the steady-state serve
+path rewrites the match scratch in place instead of allocating.  The
+flip side is that after the dispatch the Python name still *looks*
+alive while its device buffer is gone; reading it returns whatever
+XLA wrote over the storage, and re-dispatching it donates freed
+memory.  JAX only reports this at runtime (and only on real devices —
+the CPU backend silently copies), so the bug class the PR-11 donation
+seam made possible is exactly the kind tier-1 CI never sees.
+
+Pass 1 (:mod:`..symbols`) records every :class:`~..symbols.DonateSite`
+with the simple-name roots handed to donated operand positions and
+every later use of those roots before a rebinding; the rebind idiom
+``words = fn_donated(words, ...)`` is clean by construction (the name
+now holds the *result* buffer).  The check is purely local — donation
+is a per-call-site property, no affinity path is needed — which is
+why this is the cheapest rule in the set.
+
+Structural exemptions: ``project.DONATE_ALLOWED_SITES``, keyed
+``(relpath, qualname)`` with a reason string (donation legality does
+not vary by plane, so the per-context forms are not needed here).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import project as facts
+from ..core import Finding, Rule
+from ..graph import Project
+
+__all__ = ["UseAfterDonate"]
+
+
+class UseAfterDonate(Rule):
+    name = "use-after-donate"
+    description = ("local read or re-dispatched after flowing into a "
+                   "donated operand position")
+    node_types = ()  # graph rule: everything happens in finalize
+
+    def begin_run(self) -> None:
+        self._project: Project = None  # type: ignore[assignment]
+
+    def begin_project(self, project: Project) -> None:
+        self._project = project
+
+    def finalize(self) -> List[Finding]:
+        project = self._project
+        if project is None:
+            return []
+        out: List[Finding] = []
+        for fqid, s, fi in project.functions():
+            for site in fi.donates:
+                if not site.reuses:
+                    continue
+                if facts.DONATE_ALLOWED_SITES.get(
+                        (s.relpath, fi.qualname)) is not None:
+                    continue
+                callee = ".".join(site.chain)
+                names = sorted({n for n, _ in site.reuses})
+                first_line = min(ln for _, ln in site.reuses)
+                out.append(Finding(
+                    rule=self.name, path=s.relpath, line=first_line,
+                    col=site.col,
+                    message=(
+                        f"{fi.qualname!r} uses {', '.join(names)} "
+                        f"after donating its buffer to {callee!r} "
+                        f"(line {site.line}); the dispatch aliases "
+                        "the input storage into the output, so this "
+                        "read observes freed device memory — use the "
+                        "call's result, or rebind the name "
+                        "(x = fn_donated(x, ...))"),
+                    context=fi.qualname,
+                ))
+        return out

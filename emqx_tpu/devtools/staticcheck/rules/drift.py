@@ -22,6 +22,11 @@ cold path nothing exercises (metrics/config) or silently never fires
   matching name (f-string prefixes compared prefix-wise), anywhere in
   the tree — a deactivate that can never match leaks the alarm active
   forever;
+* **dead seams** (the reverse direction): every point a
+  ``faultinject`` module declares in ``POINTS`` must have ≥1 literal
+  ``_injector.act/check`` gate somewhere in the tree — a
+  registered-but-never-fired chaos point is a hole in the chaos
+  story: scenarios can target it, but nothing ever trips;
 * ``hists.hist("name")`` → the ``HIST_NAMES`` list in
   ``observe/hist.py`` — ``HistSet.hist`` raises KeyError on a typo,
   at a COLD setup site nothing in tier-1 may exercise;
@@ -257,6 +262,36 @@ class RegistryDrift(Rule):
                     "can never match and the alarm name has drifted"
                 ),
                 context=qualname,
+            ))
+        out.extend(self._dead_seams())
+        return out
+
+    def _dead_seams(self) -> List[Finding]:
+        """Declared-but-never-gated fault points, summary-driven: the
+        check only engages when a scanned module DECLARES points (the
+        fixture trees that don't ship a faultinject module stay
+        silent), and the use set is the project-wide union of literal
+        ``.act``/``.check`` gates from pass 1."""
+        declared: List[Tuple[str, str, int]] = []
+        used = set()
+        for s in self._project.modules.values():
+            declared.extend((p, s.relpath, line)
+                            for p, line in s.fault_points)
+            used.update(s.fault_uses)
+        out: List[Finding] = []
+        for point, relpath, line in sorted(declared):
+            if point in used:
+                continue
+            out.append(Finding(
+                rule=self.name, path=relpath, line=line, col=0,
+                message=(
+                    f"fault-injection point {point!r} is declared in "
+                    "faultinject.POINTS but no call site ever gates "
+                    "on it — a registered-but-never-fired chaos point "
+                    "is a hole in the chaos story; wire an "
+                    "_injector.act/check seam or drop the point"
+                ),
+                context="<module>",
             ))
         return out
 

@@ -1,0 +1,91 @@
+"""host-sync-in-loop: a device synchronization reachable on a
+loop-affine path.
+
+``jax.device_get`` / ``device_put`` / ``.block_until_ready()`` (and
+``np.asarray`` over a device value) stall the calling thread until
+the device round-trip completes — milliseconds during which an event
+loop dispatches nothing.  The serve architecture is built around
+keeping those stalls OFF the loops: encode and readback run in
+``asyncio.to_thread`` workers, and the spawn boundary is visible to
+the affinity lattice (a spawned target is seeded THREAD, the caller's
+plane does not propagate through it).  That makes the legality
+condition checkable: a :class:`~..symbols.DeviceSyncSite` is fine in
+a function whose only reachable contexts are worker threads, and a
+stall wherever a main- or shard-loop path can arrive — the PR-11
+"encode on the event loop" bug, caught statically instead of by the
+spy-thread regression test.
+
+Flagged: a function containing a device-sync site with at least one
+main/shard affinity path.  The finding names the offending path's
+entry chain; the fix is almost always to push the sync behind
+``asyncio.to_thread`` (or marshal the value through the readback
+worker), not to exempt the site.
+
+Structural exemptions: ``project.HOST_SYNC_ALLOWED_SITES``, same
+per-context value forms as the affinity allowlist — a bare reason
+exempts every path, ``(reason, plane, entry-suffix)`` only the
+matching ones.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .. import project as facts
+from ..core import Finding, Rule
+from ..graph import MAIN, SHARD, Project
+
+__all__ = ["HostSyncInLoop"]
+
+
+class HostSyncInLoop(Rule):
+    name = "host-sync-in-loop"
+    description = ("blocking device synchronization reachable on a "
+                   "main/shard event-loop path")
+    node_types = ()  # graph rule: everything happens in finalize
+
+    def begin_run(self) -> None:
+        self._project: Project = None  # type: ignore[assignment]
+
+    def begin_project(self, project: Project) -> None:
+        self._project = project
+
+    def finalize(self) -> List[Finding]:
+        project = self._project
+        if project is None:
+            return []
+        aff = project.affinity()
+        out: List[Finding] = []
+        for fqid, s, fi in project.functions():
+            if not fi.syncs:
+                continue
+            loopish = [c for c in aff.paths(fqid)
+                       if c[0] in (MAIN, SHARD)]
+            if not loopish:
+                continue  # worker-thread only (or unreached): legal
+            survivors = []
+            for ctx in loopish:
+                chain = aff.trace_ctx(fqid, ctx)
+                entry = chain[0] if chain else fi.qualname
+                if facts.site_exemption(
+                        facts.HOST_SYNC_ALLOWED_SITES, s.relpath,
+                        fi.qualname, ctx[0], entry) is None:
+                    survivors.append((ctx, chain))
+            if not survivors:
+                continue
+            ctx, chain = survivors[0]
+            for site in fi.syncs:
+                callee = ".".join(site.chain)
+                out.append(Finding(
+                    rule=self.name, path=s.relpath, line=site.line,
+                    col=site.col,
+                    message=(
+                        f"{fi.qualname!r} forces a host⇄device "
+                        f"sync ({callee}, {site.kind}) and is "
+                        f"reachable on a {ctx[0]}-loop path; the "
+                        "stall blocks every task on that loop — move "
+                        "the sync behind asyncio.to_thread or the "
+                        "readback worker"),
+                    context=fi.qualname, chain=tuple(chain),
+                ))
+        return out

@@ -25,8 +25,24 @@ records:
   ``threading.Thread(target=...)``), loop marshals
   (``call_soon_threadsafe`` / ``run_coroutine_threadsafe``) and
   supervised children (``start_child`` / ``spawn_loop``);
+* **await sites** — every suspension point (``await`` expression,
+  ``async for``, ``async with``) with its line, so the main-plane
+  torn-read extension can position suspensions relative to reads;
+* **donate sites** — calls through the donated-jit twins (any
+  ``*_donated`` terminal, or a local bound to a donate-keyed
+  ``kcache.executable(..., donate=True)``), with the local roots
+  handed to donated operand positions AND every later use of those
+  roots before a rebinding — the raw material of ``use-after-donate``;
+* **device-sync sites** — anything that forces a host⇄device sync:
+  ``.block_until_ready()``, ``jax.device_get``, ``jax.device_put``,
+  and ``np.asarray``/``np.array`` over a device-tracked local (one
+  assigned from ``device_put`` or a donated-kernel dispatch);
 * **alarm notes** — ``alarms.activate``/``deactivate`` literals, so the
-  registry-drift cross-file pairing works off cached summaries.
+  registry-drift cross-file pairing works off cached summaries;
+* **fault-point facts** — the ``POINTS`` tuple a ``faultinject``
+  module declares (with per-name lines) and every literal
+  ``_injector.act/check`` gate, so the dead-seam check (a
+  registered-but-never-fired chaos point) runs off cached summaries.
 
 Summaries are pure data (``to_dict``/``from_dict``) so the analysis
 cache can persist them; resolution against OTHER modules happens in
@@ -42,6 +58,7 @@ from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "CallSite", "SpawnSite", "WriteSite", "ReadSite", "AcquireSite",
+    "AwaitSite", "DonateSite", "DeviceSyncSite",
     "FuncInfo", "ClassInfo", "ModuleSummary", "extract_module",
     "module_name_for", "chain_of",
 ]
@@ -53,6 +70,14 @@ _LOOP_BOOT = {"run_forever", "run_until_complete", "set_event_loop"}
 #: spawn terminals → (kind, how to find the target)
 _MARSHAL_TERMINALS = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
 _CHILD_TERMINALS = {"start_child", "spawn_loop"}
+
+#: call terminals that force a host⇄device synchronization outright
+_SYNC_TERMINALS = {"block_until_ready", "device_get", "device_put"}
+#: host-materialization terminals — a sync only when fed a
+#: device-tracked value (``jnp.asarray`` stays on device, so only the
+#: numpy spellings count)
+_ASARRAY_TERMINALS = {"asarray", "array"}
+_ARRAY_MODULES = {"np", "numpy"}
 
 
 def chain_of(node: ast.AST) -> Optional[Tuple[str, ...]]:
@@ -190,6 +215,71 @@ class AcquireSite:
 
 
 @dataclass
+class AwaitSite:
+    """A suspension point of the enclosing coroutine: an ``await``
+    expression, an ``async for`` header, or an ``async with`` entry.
+    The event loop may run ANY other task here — the main plane's
+    moral equivalent of thread preemption, which is what lets the
+    await-torn-read rule position suspensions between field reads."""
+
+    kind: str                 # "await" | "async_for" | "async_with"
+    line: int
+    col: int
+
+    def to_dict(self) -> list:
+        return [self.kind, self.line, self.col]
+
+    @classmethod
+    def from_dict(cls, d: list) -> "AwaitSite":
+        return cls(d[0], d[1], d[2])
+
+
+@dataclass
+class DonateSite:
+    """A call through a donated-jit twin: any ``*_donated`` terminal,
+    or a call through a local bound to a donate-keyed
+    ``kcache.executable(..., donate=True)``.  ``args`` holds the
+    simple-name roots handed to donated operand positions; ``reuses``
+    every later use of such a root before a rebinding — after XLA
+    aliases the buffer, those reads observe freed device memory."""
+
+    chain: Tuple[str, ...]
+    line: int
+    col: int
+    args: Tuple[str, ...] = ()
+    reuses: List[Tuple[str, int]] = field(default_factory=list)
+
+    def to_dict(self) -> list:
+        return [list(self.chain), self.line, self.col, list(self.args),
+                [list(r) for r in self.reuses]]
+
+    @classmethod
+    def from_dict(cls, d: list) -> "DonateSite":
+        return cls(tuple(d[0]), d[1], d[2], tuple(d[3]),
+                   [(r[0], r[1]) for r in d[4]])
+
+
+@dataclass
+class DeviceSyncSite:
+    """A call that forces a host⇄device sync: ``.block_until_ready()``,
+    ``jax.device_get`` / ``jax.device_put``, or ``np.asarray`` /
+    ``np.array`` over a device-tracked local.  Legal on a worker
+    thread; a stall everywhere a loop-affine path can reach it."""
+
+    chain: Tuple[str, ...]
+    kind: str     # "block_until_ready" | "device_get" | "device_put"
+    line: int     # | "asarray"
+    col: int
+
+    def to_dict(self) -> list:
+        return [list(self.chain), self.kind, self.line, self.col]
+
+    @classmethod
+    def from_dict(cls, d: list) -> "DeviceSyncSite":
+        return cls(tuple(d[0]), d[1], d[2], d[3])
+
+
+@dataclass
 class FuncInfo:
     name: str
     qualname: str             # "Class.method", "fn", "fn.inner"
@@ -202,6 +292,9 @@ class FuncInfo:
     writes: List[WriteSite] = field(default_factory=list)
     reads: List[ReadSite] = field(default_factory=list)
     acquires: List[AcquireSite] = field(default_factory=list)
+    awaits: List[AwaitSite] = field(default_factory=list)
+    donates: List[DonateSite] = field(default_factory=list)
+    syncs: List[DeviceSyncSite] = field(default_factory=list)
     #: simple local aliases: ``sess = self.session`` → sess → chain
     aliases: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
     #: nested defs visible in this function's scope: name → qualname
@@ -221,6 +314,9 @@ class FuncInfo:
             "writes": [w.to_dict() for w in self.writes],
             "reads": [r.to_dict() for r in self.reads],
             "acquires": [a.to_dict() for a in self.acquires],
+            "awaits": [a.to_dict() for a in self.awaits],
+            "donates": [x.to_dict() for x in self.donates],
+            "syncs": [x.to_dict() for x in self.syncs],
             "aliases": {k: list(v) for k, v in self.aliases.items()},
             "local_defs": dict(self.local_defs),
             "params": list(self.params),
@@ -238,6 +334,11 @@ class FuncInfo:
             reads=[ReadSite.from_dict(r) for r in d.get("reads", [])],
             acquires=[AcquireSite.from_dict(a)
                       for a in d.get("acquires", [])],
+            awaits=[AwaitSite.from_dict(a) for a in d.get("awaits", [])],
+            donates=[DonateSite.from_dict(x)
+                     for x in d.get("donates", [])],
+            syncs=[DeviceSyncSite.from_dict(x)
+                   for x in d.get("syncs", [])],
             aliases={k: tuple(v) for k, v in d["aliases"].items()},
             local_defs=dict(d["local_defs"]),
             params=tuple(d.get("params", ())),
@@ -302,6 +403,12 @@ class ModuleSummary:
     #: set ("PUBACK", ...) — the ownership fact the shard-affinity
     #: seeds generate from (see ClassInfo.dispatch)
     shard_local: List[str] = field(default_factory=list)
+    #: fault-injection points a ``faultinject`` module declares in its
+    #: module-level ``POINTS`` tuple, with the declaring line — joined
+    #: against ``fault_uses`` project-wide by the dead-seam check
+    fault_points: List[Tuple[str, int]] = field(default_factory=list)
+    #: literal first args of every ``*injector*.act/check(...)`` gate
+    fault_uses: List[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -317,6 +424,8 @@ class ModuleSummary:
             "alarm_acts": [list(a) for a in self.alarm_acts],
             "alarm_deacts": [list(a) for a in self.alarm_deacts],
             "shard_local": list(self.shard_local),
+            "fault_points": [list(p) for p in self.fault_points],
+            "fault_uses": list(self.fault_uses),
         }
 
     @classmethod
@@ -336,6 +445,9 @@ class ModuleSummary:
             alarm_deacts=[(a[0], bool(a[1]), a[2], a[3], a[4])
                           for a in d["alarm_deacts"]],
             shard_local=list(d.get("shard_local", [])),
+            fault_points=[(p[0], p[1])
+                          for p in d.get("fault_points", [])],
+            fault_uses=list(d.get("fault_uses", [])),
         )
 
 
@@ -388,6 +500,16 @@ class _Extractor:
         self.lock_stack: List[Tuple[str, int, Tuple[str, ...]]] = []
         # per-function read dedup: (qualname, chain, attr, locks, blocks)
         self._read_seen: set = set()
+        # device-plane dataflow state, all per-function (saved/restored
+        # around nested defs): donated local → its DonateSite, locals
+        # bound to donate-keyed executables, locals holding device
+        # values, and the Name targets of the assignment currently
+        # being visited (a rebind `x = fn_donated(x)` hands back a
+        # FRESH buffer, so the target must not be marked donated)
+        self._donated: Dict[str, DonateSite] = {}
+        self._donate_execs: set = set()
+        self._device_locals: set = set()
+        self._assign_targets: set = set()
 
     # -- helpers -------------------------------------------------------
 
@@ -445,7 +567,24 @@ class _Extractor:
             self._class(node)
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             self._func(node)
+        elif isinstance(node, ast.Await):
+            self._await_note(node, "await")
+            self._visit_expr(node.value)
+        elif isinstance(node, (ast.Return, ast.Raise)):
+            # the path ends here: a donation inside this statement (the
+            # ``return fn_donated(words, ...)`` dispatch idiom) cannot
+            # be reused afterwards, and marks from THIS branch must not
+            # leak into sibling dispatch branches' own returns
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+            self._donated.clear()
+        elif isinstance(node, ast.AsyncFor):
+            self._await_note(node, "async_for")
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
         elif isinstance(node, (ast.With, ast.AsyncWith)):
+            if isinstance(node, ast.AsyncWith):
+                self._await_note(node, "async_with")
             held = 0
             for item in node.items:
                 lchain = self._lock_chain(item.context_expr)
@@ -482,18 +621,38 @@ class _Extractor:
                 self._visit(child)
 
     def _visit_expr(self, node: ast.AST) -> None:
-        """Descend into an expression looking for calls and attribute
-        loads (read sites)."""
+        """Descend into an expression looking for calls, attribute
+        loads (read sites), suspension points and donated-local uses."""
+        if isinstance(node, ast.Await):
+            self._await_note(node, "await")
+            self._visit_expr(node.value)
+            return
         if isinstance(node, ast.Call):
             self._call(node, discarded=False)
             return
         if isinstance(node, ast.Attribute):
             chain = chain_of(node)
             if chain is not None:
+                self._use(chain[0], node.lineno)
                 self._record_reads(chain, node)
                 return  # sub-chains recorded; nothing left below
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            self._use(node.id, node.lineno)
         for child in ast.iter_child_nodes(node):
             self._visit_expr(child)
+
+    def _await_note(self, node: ast.AST, kind: str) -> None:
+        fn = self.func_stack[-1] if self.func_stack else None
+        if fn is not None:
+            fn.awaits.append(AwaitSite(
+                kind=kind, line=node.lineno, col=node.col_offset))
+
+    def _use(self, name: str, line: int) -> None:
+        """Record a use of ``name``; a reuse when a donate site already
+        consumed that local's buffer on this path."""
+        site = self._donated.get(name)
+        if site is not None and line >= site.line:
+            site.reuses.append((name, line))
 
     def _record_reads(self, chain: Tuple[str, ...],
                       node: ast.AST) -> None:
@@ -621,9 +780,15 @@ class _Extractor:
         self.func_stack.append(fi)
         outer_locks = self.lock_stack
         self.lock_stack = []
+        outer_dev = (self._donated, self._donate_execs,
+                     self._device_locals)
+        self._donated, self._donate_execs = {}, set()
+        self._device_locals = set()
         for child in node.body:
             self._visit(child)
         self.lock_stack = outer_locks
+        (self._donated, self._donate_execs,
+         self._device_locals) = outer_dev
         self.func_stack.pop()
 
     # -- assignments / writes ------------------------------------------
@@ -654,6 +819,17 @@ class _Extractor:
             for t in targets:
                 if isinstance(t, ast.Name) and t.id == "_SHARD_LOCAL":
                     self.s.shard_local = self._ptype_names(value)
+                if isinstance(t, ast.Name) and t.id == "POINTS" \
+                        and self.s.module.rsplit(".", 1)[-1] \
+                        == "faultinject":
+                    v = value
+                    if isinstance(v, ast.Call) and v.args:
+                        v = v.args[0]
+                    for el in (v.elts if isinstance(
+                            v, (ast.Tuple, ast.List, ast.Set)) else ()):
+                        lit = _literal_str(el)
+                        if lit is not None:
+                            self.s.fault_points.append((lit, el.lineno))
         fn = self.func_stack[-1] if self.func_stack else None
         for t in targets:
             self._write_target(t)
@@ -673,8 +849,63 @@ class _Extractor:
                 if cchain is not None:
                     self.class_stack[-1].attr_types.setdefault(
                         t.attr, cchain)
+        if isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name):
+            # ``x += 1`` reads x: a reuse when x's buffer was donated
+            self._use(node.target.id, node.lineno)
         if value is not None:
-            self._visit_expr(value)
+            prev = self._assign_targets
+            self._assign_targets = {
+                t.id for t in targets if isinstance(t, ast.Name)}
+            try:
+                self._visit_expr(value)
+            finally:
+                self._assign_targets = prev
+        # device-plane local tracking: a plain-Name rebind always hands
+        # the name a fresh binding (clearing any donated/device marks);
+        # the new value may re-mark it
+        if fn is not None and value is not None \
+                and not isinstance(node, ast.AugAssign):
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            for nm in names:
+                self._donated.pop(nm, None)
+                self._device_locals.discard(nm)
+                self._donate_execs.discard(nm)
+            vterm = None
+            if isinstance(value, ast.Call):
+                vchain = chain_of(value.func)
+                vterm = vchain[-1] if vchain else None
+            if vterm == "device_put" \
+                    or (vterm is not None
+                        and vterm.endswith("_donated")) \
+                    or vterm in self._donate_execs:
+                self._device_locals.update(names)
+            elif vterm == "executable" and any(
+                    kw.arg == "donate" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and not kw.value.value)
+                    for kw in value.keywords):
+                # a donate key that is not literally falsy MAY donate
+                # (``donate=donate_inputs``) — conservative may-donate
+                self._donate_execs.update(names)
+            elif any(t.endswith("_donated")
+                     for t in self._alias_terms(value)):
+                # ``jfn = join_match_donated if flag else join_match``
+                # / ``fn = nfa_match_donated``: calls through the
+                # local may donate
+                self._donate_execs.update(names)
+
+    @staticmethod
+    def _alias_terms(value: ast.AST) -> List[str]:
+        """Terminal names a function-reference value may resolve to:
+        a plain Name/Attribute, or either arm of a conditional."""
+        if isinstance(value, (ast.Name, ast.Attribute)):
+            c = chain_of(value)
+            return [c[-1]] if c else []
+        if isinstance(value, ast.IfExp):
+            return (_Extractor._alias_terms(value.body)
+                    + _Extractor._alias_terms(value.orelse))
+        return []
 
     def _write_target(self, t: ast.AST) -> None:
         fn = self.func_stack[-1] if self.func_stack else None
@@ -715,11 +946,20 @@ class _Extractor:
             # ``inflight`` (terminal method name itself excluded)
             if len(chain) > 2:
                 self._record_reads(chain[:-1], node)
+            # ``words.sum()`` after donating words is a reuse
+            self._use(chain[0], node.lineno)
         # alarm notes (registry-drift cross-file pairing)
         if terminal in ("activate", "deactivate") and chain is not None \
                 and len(chain) >= 2 and "alarm" in chain[-2].lower() \
                 and node.args:
             self._alarm_note(node, terminal)
+        # fault-point gates (dead-seam side of registry-drift)
+        if terminal in ("act", "check") and chain is not None \
+                and len(chain) >= 2 and "injector" in chain[-2] \
+                and node.args:
+            lit = _literal_str(node.args[0])
+            if lit is not None:
+                self.s.fault_uses.append(lit)
         # spawn sites
         if fn is not None:
             self._spawn(node, terminal, fn)
@@ -727,6 +967,46 @@ class _Extractor:
             self._visit_expr(arg)
         for kw in node.keywords:
             self._visit_expr(kw.value)
+        # device-plane notes LAST: marking the donate call's operands
+        # after visiting its args keeps the call's own arg list from
+        # self-reporting as a reuse
+        if fn is not None and chain is not None:
+            self._device_notes(node, chain, terminal, fn)
+
+    def _device_notes(self, node: ast.Call, chain: Tuple[str, ...],
+                      terminal: Optional[str], fn: FuncInfo) -> None:
+        """Donate sites and host-sync sites of one call."""
+        donated_call = (terminal is not None
+                        and terminal.endswith("_donated")) \
+            or (len(chain) == 1 and chain[0] in self._donate_execs)
+        if donated_call:
+            # the donated twins donate the BATCH operands — the first
+            # three positionals (donate_argnums=(0, 1, 2) throughout
+            # ops/) — never the trailing table/relation arrays, which
+            # serve every in-flight batch
+            roots = []
+            for arg in node.args[:3]:
+                c = chain_of(arg)
+                if c is not None and len(c) == 1 \
+                        and c[0] not in self._assign_targets:
+                    roots.append(c[0])
+            site = DonateSite(chain=chain, line=node.lineno,
+                              col=node.col_offset, args=tuple(roots))
+            fn.donates.append(site)
+            for r in roots:
+                self._donated[r] = site
+        kind = None
+        if terminal in _SYNC_TERMINALS:
+            kind = terminal
+        elif terminal in _ASARRAY_TERMINALS and len(chain) == 2 \
+                and chain[0] in _ARRAY_MODULES and node.args:
+            c = chain_of(node.args[0])
+            if c is not None and c[0] in self._device_locals:
+                kind = "asarray"
+        if kind is not None:
+            fn.syncs.append(DeviceSyncSite(
+                chain=chain, kind=kind, line=node.lineno,
+                col=node.col_offset))
 
     def _alarm_note(self, node: ast.Call, method: str) -> None:
         arg = node.args[0]

@@ -75,6 +75,48 @@ def multichip_ep_smoke(n_filters: int) -> dict:
     return _mesh_smoke("bench_multichip_ep_smoke", n_filters)
 
 
+def staticcheck_gate() -> dict:
+    """Cold full-tree staticcheck as a CI gate row (ISSUE 19): runs
+    ``scripts/staticcheck.py`` in a subprocess against a throwaway
+    cache dir (so the row always measures the COLD cost, never a
+    warm cache someone else left behind) and reports the exit code
+    plus wall seconds.  ``gate_clean`` is the real invariant — the
+    tree must scan clean with zero live waivers; ``gate_budget`` is
+    the cold-scan ceiling (10 s here: the bench box is allowed to be
+    slower than the ≤4 s dev-loop budget tests/test_staticcheck.py
+    asserts, but a 10 s cold scan means the analysis went
+    super-linear and the dev loop is next)."""
+    import shutil
+    import subprocess
+    import tempfile
+    import time
+
+    cache_dir = tempfile.mkdtemp(prefix="staticcheck_bench_")
+    try:
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "staticcheck.py"),
+             "--cache-dir", cache_dir],
+            capture_output=True, text=True, cwd=REPO, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        cold_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    from emqx_tpu.devtools.staticcheck.rules import ALL_RULES
+
+    tail = (proc.stdout or "").strip().splitlines()
+    return {
+        "exit_code": proc.returncode,
+        "cold_s": round(cold_s, 3),
+        "rules": len(ALL_RULES),
+        "summary": tail[-1] if tail else "",
+        "gate_clean": proc.returncode == 0,
+        "gate_budget": cold_s <= 10.0,
+    }
+
+
 def chaos_smoke() -> dict:
     """One kill-and-recover cycle per subsystem; each section reports
     ok plus the evidence (restart counts, delivered totals)."""
@@ -855,6 +897,14 @@ def main(argv=None) -> dict:
             assert sec["gate_hist_parity"], (
                 "serve_pipeline histogram/np.percentile parity broke",
                 side, sec)
+    # staticcheck gate row (ISSUE 19): the cold full-tree scan must
+    # stay clean (exit 0, zero live waivers) and under the bench-box
+    # cold budget — the per-PR smoke is where analysis regressions
+    # (a rule gone quadratic, a new real finding) surface first
+    out["staticcheck"] = staticcheck_gate()
+    assert out["staticcheck"]["gate_clean"], (
+        "staticcheck found new findings (or the CLI crashed)",
+        out["staticcheck"])
     if args.chaos:
         out["chaos"] = chaos_smoke()
     print(json.dumps(out, indent=2))

@@ -144,6 +144,11 @@ def main(argv=None) -> int:
         help="bypass the analysis cache (.staticcheck_cache/)",
     )
     parser.add_argument(
+        "--jobs", type=int, default=os.cpu_count() or 1, metavar="N",
+        help="process-pool width for the cold pass-1 parse (default: "
+             "os.cpu_count(); 1 forces serial)",
+    )
+    parser.add_argument(
         "--cache-dir", default=DEFAULT_CACHE_DIR, metavar="DIR",
         help="analysis cache directory",
     )
@@ -193,7 +198,8 @@ def main(argv=None) -> int:
             return 0
         # expand over the reverse import graph after pass 1 — done via
         # a pre-analysis to learn the graph, then the real run
-        pre = analyze(paths, [], root=root, cache=cache, targets=set())
+        pre = analyze(paths, [], root=root, cache=cache, targets=set(),
+                      jobs=args.jobs)
         targets = changed_targets(pre.project, changed)
         if targets is not None and not targets:
             print("0 finding(s) (clean); changed files outside the "
@@ -201,7 +207,8 @@ def main(argv=None) -> int:
             return 0
 
     result = analyze(paths, rules, root=root, cache=cache,
-                     targets=targets, prune_cache=not args.paths)
+                     targets=targets, prune_cache=not args.paths,
+                     jobs=args.jobs)
     findings = result.findings
 
     if args.baseline == "write":

@@ -1,0 +1,3 @@
+"""Dead-seam fixture (passing): every point the package's
+faultinject module declares has at least one literal gate — both
+directions of the registry check hold."""

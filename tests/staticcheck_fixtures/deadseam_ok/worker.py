@@ -1,0 +1,12 @@
+"""Every declared point gated: ``act`` and ``check`` both count."""
+
+
+def drain(_injector, batch):
+    _injector.act("fanout.drain", len(batch))
+    return batch
+
+
+def rebuild(_injector, shard):
+    if _injector.check("mesh.rebuild"):
+        return None
+    return shard

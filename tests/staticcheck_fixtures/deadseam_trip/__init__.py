@@ -1,0 +1,6 @@
+"""Dead-seam fixture (tripping): the package's faultinject module
+declares two points but only one has a literal ``_injector.act`` gate
+anywhere in the tree — the other is a registered-but-never-fired
+chaos point (one registry-drift finding).  Point names reuse the real
+``faultinject.POINTS`` vocabulary so the forward unknown-point check
+stays quiet and ONLY the dead-seam direction is exercised."""

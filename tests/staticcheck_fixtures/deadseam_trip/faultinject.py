@@ -1,0 +1,6 @@
+"""Chaos-point declarations for the fixture package."""
+
+POINTS = (
+    "fanout.drain",
+    "mesh.rebuild",
+)

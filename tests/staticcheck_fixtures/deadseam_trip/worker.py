@@ -1,0 +1,7 @@
+"""Gates only ONE of the two declared points: "mesh.rebuild" is a
+hole in the chaos story — declared, targetable, never fired."""
+
+
+def drain(_injector, batch):
+    _injector.act("fanout.drain", len(batch))
+    return batch

@@ -1,0 +1,27 @@
+"""Fixture: await-torn-read must NOT flag any of these."""
+
+
+class ShardPool:
+    async def _main_handle(self, sess):
+        # both group fields read inside ONE critical section; the
+        # await comes after the invariant was observed atomically
+        with sess.mutex:
+            n = len(sess.inflight) + len(sess.mqueue)
+        await self.flush()
+        return n
+
+    async def flush(self):
+        pass
+
+    async def _consume(self, sess, runs):
+        # suspension BEFORE the reads: the pair is taken in one
+        # uninterrupted stretch of the coroutine
+        await self.flush()
+        return len(sess.inflight) + len(sess.mqueue)
+
+
+async def probe(sess):
+    # unreached from any main entry: no loop can interleave a mutator
+    a = len(sess.inflight)
+    await sess.drain()
+    return a + len(sess.mqueue)

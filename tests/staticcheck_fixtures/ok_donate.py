@@ -1,0 +1,44 @@
+"""Fixture: use-after-donate must NOT flag any of these."""
+
+
+def nfa_match(words, lens, is_sys, table):
+    return words
+
+
+def nfa_match_donated(words, lens, is_sys, table):
+    return words
+
+
+class KernelCache:
+    def executable(self, key, donate=False):
+        return nfa_match_donated
+
+
+def serve_rebind(words, lens, is_sys, table):
+    # the rebind idiom: the name now holds the RESULT buffer, so the
+    # later read is of live storage — clean by construction
+    words = nfa_match_donated(words, lens, is_sys, table)
+    return words.sum()
+
+
+def serve_result_only(words, lens, is_sys, table):
+    # donated operands never read again: the steady-state serve shape
+    m = nfa_match_donated(words, lens, is_sys, table)
+    return m
+
+
+def serve_undonated(kc, words, lens, is_sys):
+    # donate=False keys the UNdonated executable: re-dispatch is fine
+    fn = kc.executable(1, donate=False)
+    m = fn(words, lens, is_sys)
+    counts = fn(words, lens, is_sys)
+    return m, counts
+
+
+def serve_dispatch(words, lens, is_sys, table, donate_inputs):
+    # the real tree's branch-dispatch shape: each return ends its
+    # path, so the donation cannot be reused on any path
+    fn = nfa_match_donated if donate_inputs else nfa_match
+    if donate_inputs:
+        return fn(words, lens, is_sys, table)
+    return fn(words, lens, is_sys, table)

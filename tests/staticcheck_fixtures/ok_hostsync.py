@@ -1,0 +1,27 @@
+"""Fixture: host-sync-in-loop must NOT flag any of these."""
+
+import jax
+import numpy as np
+
+
+class MatchService:
+    def _encode_dispatch(self, reqs):
+        # thread-plane worker (the to_thread contract, a declared
+        # seed): syncing the device IS the worker's job — the spawn
+        # boundary keeps the stall off every loop
+        enc = jax.device_put(reqs)
+        return np.asarray(enc)
+
+
+class ShardPool:
+    def _main_handle(self, batch):
+        # np.asarray over a HOST value: no device round trip, no sync
+        rows = np.asarray(batch)
+        return rows
+
+
+def debug_dump(arr):
+    # unreached from any loop entry: a cold debugging helper may
+    # block its caller
+    arr.block_until_ready()
+    return jax.device_get(arr)

@@ -1,0 +1,24 @@
+"""Fixture: await-torn-read MUST flag these (2 findings)."""
+
+
+class ShardPool:
+    async def _main_handle(self, sess):
+        # (1) read inflight, SUSPEND, read mqueue: the await hands the
+        # loop to any runnable task, which may admit/refill the window
+        # between the two observations of the session-window group
+        n = len(sess.inflight)
+        await self.flush()
+        if n < 4 and len(sess.mqueue):
+            return True
+        return False
+
+    async def flush(self):
+        pass
+
+    async def _consume(self, sess, runs):
+        # (2) the async-for header is a suspension point too: each
+        # iteration parks the coroutine between the group reads
+        total = len(sess.inflight)
+        async for run in runs:
+            total += run
+        return total + len(sess.mqueue)

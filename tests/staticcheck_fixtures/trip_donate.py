@@ -1,0 +1,26 @@
+"""Fixture: use-after-donate MUST flag these (2 findings)."""
+
+
+def nfa_match_donated(words, lens, is_sys, table):
+    return words
+
+
+class KernelCache:
+    def executable(self, key, donate=False):
+        return nfa_match_donated
+
+
+def serve_batch(words, lens, is_sys, table):
+    # (1) the donated twin aliases the words/lens/is_sys buffers into
+    # its output; reading `words` afterwards observes freed storage
+    out = nfa_match_donated(words, lens, is_sys, table)
+    return out, words.sum()
+
+
+def serve_cached(kc, words, lens, is_sys):
+    # (2) a donate-keyed executable is the same seam under an alias:
+    # the SECOND dispatch hands the already-donated buffers back in
+    fn = kc.executable(1, donate=True)
+    m = fn(words, lens, is_sys)
+    counts = fn(words, lens, is_sys)
+    return m, counts

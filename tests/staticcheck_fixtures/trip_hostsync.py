@@ -1,0 +1,22 @@
+"""Fixture: host-sync-in-loop MUST flag these (4 findings)."""
+
+import jax
+import numpy as np
+
+
+class ShardChannel:
+    def handle_ack_run(self, acks):
+        # shard-loop entry (declared seed): both syncs stall the
+        # shard's event loop for a device round trip
+        host = jax.device_get(acks)       # (1)
+        acks.block_until_ready()          # (2)
+        return host
+
+
+class ShardPool:
+    def _main_handle(self, batch):
+        # main-loop entry (declared seed): the h2d transfer and the
+        # d2h copy np.asarray forces both block the broker loop
+        dev = jax.device_put(batch)       # (3)
+        rows = np.asarray(dev)            # (4) d2h of a device value
+        return rows

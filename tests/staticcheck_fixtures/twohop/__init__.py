@@ -1,0 +1,8 @@
+"""Two-hop fixture package: proves the k=2 call-site contexts.  TWO
+shard entries (``entries.ShardChannel.handle_ack_run`` and
+``.check_keepalive``) reach the SAME offending helper
+(``helper.bump``) through one shared mid-function (``mid.relay``).
+Under k=1 both paths collapse at the mid hop — a (plane, entry)
+exemption cannot tell them apart; the k=2 chain keeps the grandparent
+entry distinct, so exempting one entry leaves the other's finding
+standing, with the chain naming the right entry."""

@@ -1,0 +1,12 @@
+"""Two distinct shard entries (declared seeds, unlocked) into the
+same relay → bump chain."""
+
+from .mid import relay
+
+
+class ShardChannel:
+    def handle_ack_run(self, sess):
+        relay(sess)
+
+    def check_keepalive(self, sess):
+        relay(sess)

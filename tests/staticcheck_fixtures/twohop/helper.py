@@ -1,0 +1,6 @@
+"""The shared leaf: an unlocked write to RLock-set Session state —
+offending on every unlocked shard path that reaches it."""
+
+
+def bump(sess):
+    sess.inflight[0] = 1

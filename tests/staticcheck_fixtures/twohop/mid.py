@@ -1,0 +1,8 @@
+"""The shared mid hop: k=1 collapses both entries here; the k=2
+chain carries each entry one hop further."""
+
+from .helper import bump
+
+
+def relay(sess):
+    bump(sess)

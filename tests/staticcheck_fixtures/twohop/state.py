@@ -1,0 +1,8 @@
+"""Lock-protected session state (LOCKED_FIELDS class)."""
+
+
+class Session:
+    def __init__(self):
+        self.inflight = {}
+        self.mqueue = []
+        self.mutex = None

@@ -203,6 +203,17 @@ def test_bench_e2e_smoke_delivers_everything():
     assert "gate_honest_p99" in adv and "p99_off_vs_clean" in adv, adv
     assert adv["attack_on"]["bans"] >= 1 \
         or adv["attack_on"]["decisions"], adv
+    # staticcheck gate row (ISSUE 19): the cold full-tree scan ran in
+    # a subprocess against a throwaway cache, came back clean (exit 0,
+    # zero live waivers — staticcheck-waivers.json is empty by policy)
+    # and under the bench-box cold budget, with all 13 rules active
+    sc = out["staticcheck"]
+    assert sc["gate_clean"], sc
+    assert sc["exit_code"] == 0, sc
+    assert sc["gate_budget"], sc
+    assert sc["rules"] == 13, sc
+    assert sc["cold_s"] > 0, sc
+    assert "0 finding(s)" in sc["summary"], sc
     # chaos smoke: one kill-and-recover cycle per subsystem (including
     # the ISSUE-7 serve plane under "match"), each healing via
     # supervisor restart with delivery intact
