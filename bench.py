@@ -2318,6 +2318,248 @@ def _mesh_degraded_size(smoke: bool) -> dict:
                                iters=10))
 
 
+def bench_multichip_balance(n_filters=200_000, batch=2048, iters=10,
+                            depth=8, tp=0, reps=3):
+    """Load-adaptive match plane A/B (ISSUE 20): a root-skewed corpus
+    whose hot roots all crc32-collide on shard 0, served static
+    (crc32 placement, fixed bucket grid) vs adaptive (overflow-EWMA
+    capacity grow + popularity rebalance) on the same mesh.  Gates:
+
+    * ``gate_grow_zero_drops`` — the overflow EWMA triggers at least
+      one background capacity grow, and EVERY row of every batch
+      served through the compile window stays complete (spilled rows
+      re-run on the host tables — fail-open, zero breaker strikes);
+    * ``gate_balance_width_ge_1_5x`` — after one balance pass + apply,
+      the worst shard's share of the batch's rows (host placement
+      bincount) drops by >= 1.5x vs the static crc32 placement;
+    * ``gate_routed_parity_all`` — post-remap routed rows agree
+      BIT-FOR-BIT with the replicated backend (spilled rows re-run on
+      the host tables on both sides);
+    * ``gate_coldstart_placement_restored`` — save/load round trip
+      after both the resize and the remap restores the identical
+      override map and serves the skewed batch complete;
+    * ``gate_rebalance_fault_noop`` — an injected ``ep.rebalance``
+      fault raises BEFORE anything is staged: placement unchanged,
+      the next batch delivers 1.0."""
+    import tempfile
+
+    import jax
+
+    from emqx_tpu import faultinject as fi
+    from emqx_tpu.faultinject import FaultInjector, InjectedFault
+    from emqx_tpu.observe.metrics import Metrics
+    from emqx_tpu.ops.incremental import IncrementalNfa
+    from emqx_tpu.parallel.multichip_serve import (
+        MultichipMatcher, shard_of_filter,
+    )
+
+    max_matches = _serve_max_matches()
+    if tp == 0 and len(jax.devices()) % 8 == 0:
+        tp = 8
+    met = Metrics()
+    mkw = dict(depth=depth, tp=tp, active_slots=8,
+               max_matches=max_matches, ep=True, ep_slack=1.0)
+    mc_ad = MultichipMatcher(metrics=met, ep_autotune=True,
+                             ep_grow_threshold=0.02,
+                             balance_budget=64, **mkw)
+    tpn = mc_ad.tp
+    if tpn < 2:
+        return {"skipped": f"mesh has tp={tpn}; balance A/B needs "
+                "tp >= 2 (run under a multi-device mesh)"}
+
+    # skewed corpus: every hot root crc32-owns shard 0, plus a thin
+    # root-balanced cold tail so the other shards are not empty
+    n_hot = max(4, tpn)
+    per_shard = max(1, n_filters // (4 * tpn))
+    hot: list = []
+    cold: dict = {t: [] for t in range(tpn)}
+    i = 0
+    while (len(hot) < n_hot
+           or any(len(v) < per_shard for v in cold.values())):
+        r = f"b{i}"
+        o = shard_of_filter(r, tpn)
+        if o == 0 and len(hot) < n_hot:
+            hot.append(r)
+        elif len(cold[o]) < per_shard:
+            cold[o].append(r)
+        i += 1
+    inc = IncrementalNfa(depth=depth)   # host oracle
+    pairs = []
+
+    def add(flt):
+        inc.add(flt)
+        pairs.append((flt, inc.aid_of(flt)))
+
+    for r in hot:
+        add(f"{r}/a/+")
+        add(f"{r}/b/#")
+    for o in range(tpn):
+        for r in cold[o]:
+            add(f"{r}/a/+")
+    mc_ad.rebuild(pairs)
+    mc_ad.apply_pending()
+    mc_static = MultichipMatcher(**mkw)
+    mc_static.rebuild(pairs)
+    mc_static.apply_pending()
+    mc_rep = MultichipMatcher(depth=depth, tp=tp, active_slots=8,
+                              max_matches=max_matches, ep=False)
+    mc_rep.rebuild(pairs)
+    mc_rep.apply_pending()
+
+    # 7/8 of the batch lands on the hot (shard-0) roots — the static
+    # placement's worst shard takes nearly the whole batch
+    names = []
+    for k in range(batch):
+        if k % 8 != 0:
+            names.append(f"{hot[k % n_hot]}/a/x")
+        else:
+            o = (k // 8) % tpn
+            names.append(f"{cold[o][(k // (8 * tpn)) % len(cold[o])]}/a/x")
+
+    def rows_of(mc, nm, b):
+        enc = mc.encode(nm, batch=b, depth=depth)
+        rows, sp, nbytes = mc.readback(mc.dispatch(enc), len(nm))
+        return rows, set(sp), nbytes
+
+    def complete(rows, sp, nm):
+        return all(
+            (sorted(inc.match_host(t)) if k in sp else sorted(rows[k]))
+            == sorted(inc.match_host(t)) for k, t in enumerate(nm))
+
+    def worst_width(mc):
+        cnt = np.zeros(mc.tp, np.int64)
+        for t in names:
+            cnt[mc.shard_of(t)] += 1
+        return int(cnt.max())
+
+    # phase 1 — capacity grow under overflow: at slack 1.0 the hot
+    # rows overflow shard 0's bucket column every batch; the EWMA
+    # crosses the grow threshold and the grid grows in the background
+    # while every batch keeps serving (fail-open) through the window
+    grow_ok = True
+    overflow_static = 0
+    deadline = time.perf_counter() + 90.0
+    while mc_ad.ep_resizes < 1 and time.perf_counter() < deadline:
+        rows_g, sp_g, _ = rows_of(mc_ad, names, batch)
+        overflow_static = max(overflow_static, len(sp_g))
+        grow_ok = grow_ok and complete(rows_g, sp_g, names)
+    while mc_ad._resize_busy and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    rows_g, sp_g, _ = rows_of(mc_ad, names, batch)
+    grow_ok = grow_ok and complete(rows_g, sp_g, names)
+    gate_grow = bool(mc_ad.ep_resizes >= 1 and grow_ok
+                     and mc_ad.failovers == 0)
+
+    # phase 2 — popularity rebalance: the load slab accumulated
+    # through phase 1; one balance pass stages the override map, the
+    # next rebuild applies it (the compaction-swap cadence)
+    moved = mc_ad.plan_rebalance()
+    mc_ad.rebuild(pairs)
+    mc_ad.apply_pending()
+    w_static = worst_width(mc_static)
+    w_ad = worst_width(mc_ad)
+    ratio = w_static / max(1, w_ad)
+    gate_balance = bool(moved > 0 and ratio >= 1.5)
+
+    rows_r, sp_r, _ = rows_of(mc_rep, names, batch)
+    rows_e, sp_e, _ = rows_of(mc_ad, names, batch)
+    overflow_adaptive = len(sp_e)
+    routed_used = met.get("tpu.match.ep_dispatches") > 0
+    parity = all(
+        (sorted(inc.match_host(t)) if k in sp_r else sorted(rows_r[k]))
+        == (sorted(inc.match_host(t)) if k in sp_e else sorted(rows_e[k]))
+        for k, t in enumerate(names))
+
+    def best(run):
+        t = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                run()
+            t = min(t, (time.perf_counter() - t0) / iters)
+        return t
+
+    t_static = best(lambda: rows_of(mc_static, names, batch))
+    t_ad = best(lambda: rows_of(mc_ad, names, batch))
+
+    # phase 3 — cold start after both resize and remap: the override
+    # map round-trips through the v3 segment set and the restored
+    # partition serves the same skewed batch complete
+    with tempfile.TemporaryDirectory() as td:
+        mc_ad.save_segments(td, epoch=3)
+        mc2 = MultichipMatcher(ep_autotune=True, **mkw)
+        restored = mc2.load_segments(td, expect_epoch=3)
+        cold_ok = False
+        if restored:
+            mc2.apply_pending()
+            rows_c, sp_c, _ = rows_of(mc2, names, batch)
+            cold_ok = (mc2._placement == mc_ad._placement
+                       and worst_width(mc2) == w_ad
+                       and complete(rows_c, sp_c, names))
+
+    # phase 4 — injected ep.rebalance fault: raises before anything
+    # is staged; placement unchanged, the next batch delivers 1.0
+    place_before = dict(mc_ad._placement)
+    fi.install(FaultInjector([
+        {"point": "ep.rebalance", "action": "raise", "times": 1}]))
+    fault_raised = False
+    try:
+        try:
+            mc_ad.plan_rebalance()
+        except InjectedFault:
+            fault_raised = True
+    finally:
+        fi.uninstall()
+    rows_f, sp_f, _ = rows_of(mc_ad, names, batch)
+    gate_fault = bool(fault_raised
+                      and mc_ad._placement == place_before
+                      and mc_ad._placement_next is None
+                      and complete(rows_f, sp_f, names))
+
+    return {
+        "n_filters": int(inc.n_filters),
+        "batch": batch,
+        "devices": mc_ad.n_devices,
+        "mesh": {"dp": mc_ad.dp, "tp": tpn},
+        "measured_on": jax.devices()[0].platform,
+        "hot_roots": n_hot,
+        "moved_roots": int(moved),
+        "placement_overrides": len(mc_ad._placement),
+        "ep_resizes": int(mc_ad.ep_resizes),
+        "ep_cap_class": int(mc_ad._cap_class),
+        "overflow_rows_static_worst": int(overflow_static),
+        "overflow_rows_adaptive": int(overflow_adaptive),
+        "static_worst_width": int(w_static),
+        "adaptive_worst_width": int(w_ad),
+        "worst_width_ratio_x": round(ratio, 3),
+        "static_us": round(t_static * 1e6, 1),
+        "adaptive_us": round(t_ad * 1e6, 1),
+        # host-thread CPU meshes share cores, so the speedup is a
+        # tracking number off-hardware (r06 owns the throughput claim)
+        "adaptive_speedup_x": round(t_static / max(t_ad, 1e-9), 3),
+        "gate_grow_zero_drops": gate_grow,
+        "gate_balance_width_ge_1_5x": gate_balance,
+        "gate_routed_parity_all": bool(parity and routed_used),
+        "gate_coldstart_placement_restored": bool(restored and cold_ok),
+        "gate_rebalance_fault_noop": gate_fault,
+    }
+
+
+def bench_multichip_balance_smoke(n_filters=2000, batch=256, depth=8):
+    """CPU-mesh tiny-scale multichip_balance A/B for bench_e2e
+    --smoke: the grow/balance/parity/cold-start/fault gates are the
+    CI assertions; the speedup is a tracking number (host threads
+    share cores — bench.py's r06 round owns the throughput claim)."""
+    return bench_multichip_balance(n_filters=n_filters, batch=batch,
+                                   iters=3, depth=depth, reps=2)
+
+
+def _multichip_balance_size(smoke: bool) -> dict:
+    return (dict(n_filters=2000, batch=256, iters=3)
+            if smoke else dict(n_filters=1_000_000, batch=2048,
+                               iters=10))
+
+
 def bench_mesh_chaos_smoke(n_filters=96, depth=8):
     """Node-level degraded-mesh kill→degraded→rebuild→re-admit cycle
     (ISSUE 18) — the bench_e2e --chaos ``"mesh"`` section.  Needs a
@@ -2880,6 +3122,19 @@ def main():
          if "skipped" not in msd else
          f"mesh degraded A/B skipped: {msd['skipped']}")
 
+    # load-adaptive plane A/B (ISSUE 20): overflow-EWMA capacity grow
+    # with zero dropped rows, popularity rebalance worst-shard width
+    # cut, post-remap parity, cold-start placement restore, and the
+    # ep.rebalance fault no-op (needs a multi-device mesh)
+    mcb = bench_multichip_balance(
+        **_multichip_balance_size(args.smoke), depth=args.depth)
+    note(f"multichip balance A/B done: width_ratio="
+         f"{mcb['worst_width_ratio_x']}x resizes={mcb['ep_resizes']} "
+         f"grow={mcb['gate_grow_zero_drops']} "
+         f"balance={mcb['gate_balance_width_ge_1_5x']}"
+         if "skipped" not in mcb else
+         f"multichip balance A/B skipped: {mcb['skipped']}")
+
     # serving: device at 70% of its measured max; CPU at 70% of ITS max
     # through the same harness (iso-harness, each engine at its own
     # sustainable load) — the honest p99 comparison
@@ -3062,6 +3317,7 @@ def main():
         "multichip_serve": mcs,
         "multichip_ep": mce,
         "mesh_degraded": msd,
+        "multichip_balance": mcb,
         "serve_cpu_iso": serve_cpu,
         "serve_cpu_equal_load": serve_cpu_eq,
         "config1_broker_e2e": c1,

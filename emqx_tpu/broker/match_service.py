@@ -339,6 +339,11 @@ class MatchService:
         multichip_degraded: bool = False,
         multichip_degraded_threshold: int = 3,
         multichip_ep_overflow_warn: float = 0.5,
+        multichip_ep_autotune: bool = False,
+        multichip_ep_grow_threshold: float = 0.05,
+        multichip_ep_shrink_threshold: float = 0.01,
+        multichip_ep_max_cap_class: int = 3,
+        multichip_balance_budget: int = 64,
         readback_mode: str = "chunked",
         readback_auto_slack: float = 1.0,
         hists: Any = None,
@@ -495,7 +500,12 @@ class MatchService:
                     ep_compact=multichip_ep_compact,
                     degraded=multichip_degraded,
                     degraded_fail_threshold=multichip_degraded_threshold,
-                    ep_overflow_warn=multichip_ep_overflow_warn)
+                    ep_overflow_warn=multichip_ep_overflow_warn,
+                    ep_autotune=multichip_ep_autotune,
+                    ep_grow_threshold=multichip_ep_grow_threshold,
+                    ep_shrink_threshold=multichip_ep_shrink_threshold,
+                    ep_max_cap_class=multichip_ep_max_cap_class,
+                    balance_budget=multichip_balance_budget)
             except Exception:
                 log.exception("multichip serve backend unavailable; "
                               "single-chip path serves")
@@ -662,6 +672,11 @@ class MatchService:
             self.router.listeners.remove(self._on_router_mutation)
         except ValueError:
             pass  # already unhooked (double stop is legal)
+        if self.mc is not None and getattr(self.mc, "ep_autotune",
+                                           False):
+            # a capacity-rebuild compile must not outlive the service:
+            # left running it keeps XLA on every host core after stop
+            await asyncio.to_thread(self.mc.drain_resize, 60.0)
 
     # ------------------------------------------------------------------
     # mirror maintenance (event loop)
@@ -1312,6 +1327,20 @@ class MatchService:
             log.warning("table compaction abandoned: %d filters "
                         "changed mid-build", len(self._compact_dirty))
             return False
+        mc = self.mc
+        if mc is not None and getattr(mc, "ep_autotune", False):
+            # popularity balance pass rides the compaction worker
+            # cadence: it STAGES a placement override map that the
+            # repartition triggered by _swap_in below applies — so a
+            # remap always lands with a fresh aid space and the
+            # table-gen guard discarding in-flight slots.  A failure
+            # (including an injected ep.rebalance fault) is a no-op:
+            # the old placement keeps serving.
+            try:
+                await asyncio.to_thread(mc.plan_rebalance)
+            except Exception:
+                log.warning("EP balance pass failed; placement "
+                            "unchanged", exc_info=True)
         self._swap_in(built)
         return True
 

@@ -477,8 +477,11 @@ SCHEMA: Dict[str, Field] = {
     # (capacity - total) stays <= auto_slack * total — 1.0 admits every
     # pow2-capacity class (the PR 17 heuristic, byte-identical); r06
     # tunes this down from measured link numbers without a code change
+    # a slack is a padding FRACTION: values past 1.0 would admit every
+    # capacity class and negative ones none — both misbehave only at
+    # serve time, so reject them at load time instead
     "match.readback.auto_slack": Field(
-        1.0, float, lambda v: v >= 0.0),
+        1.0, float, lambda v: 0.0 <= v <= 1.0),
     # autotuner (effective only with match.backend=auto): measure
     # hash-vs-join per (B, D, S, Hb) shape on recently served topics;
     # the pick table persists as checksummed JSON next to the XLA disk
@@ -524,6 +527,28 @@ SCHEMA: Dict[str, Field] = {
     # skewing one owner shard; 0 disables the warning
     "match.multichip.ep.overflow_warn": Field(
         0.5, float, lambda v: 0.0 <= v <= 1.0),
+    # load-adaptive EP plane (ISSUE 20): capacity auto-resize keyed on
+    # the overflow EWMA + popularity-aware shard placement staged at
+    # compaction cadence.  Off = static crc32 placement and the fixed
+    # capacity_slack grid, byte-identical.
+    "match.multichip.ep.autotune.enable": Field(False, _bool),
+    # overflow-EWMA level at which the bucket grid grows one pow2
+    # capacity class (background compile first — no dispatch parks)
+    "match.multichip.ep.autotune.grow_threshold": Field(
+        0.05, float, lambda v: 0.0 < v <= 1.0),
+    # hysteresis floor: the grid shrinks a class only after the EWMA
+    # settles at/below this (and a cooldown of routed readbacks at the
+    # current class passes); must sit below grow_threshold
+    "match.multichip.ep.autotune.shrink_threshold": Field(
+        0.01, float, lambda v: 0.0 <= v <= 1.0),
+    # pow2 growth ceiling: capacity tops out at base << max_cap_class
+    # (and never past the full source-slice width)
+    "match.multichip.ep.autotune.max_cap_class": Field(
+        3, int, lambda v: 0 <= v <= 8),
+    # per-balance-pass budget of hot roots the greedy reassignment may
+    # move off their crc32 shard (0 disables placement, resize only)
+    "match.multichip.ep.autotune.max_moved_roots": Field(
+        64, int, lambda v: 0 <= v <= 4096),
     # degraded-mesh serving (ISSUE 18): on shard death keep serving on
     # the survivors — EP-routed rows owned by the dead shard (and the
     # dead shard's replicated answer segment) divert to the CPU trie,

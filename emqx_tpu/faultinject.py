@@ -109,7 +109,7 @@ POINTS = (
     "match.readback", "match.shard", "table.load", "table.swap",
     "inflight.insert", "inflight.retry", "cluster.rpc",
     "bridge.sink", "exhook.call", "fanout.drain", "shard.handoff",
-    "admission.score", "ep.route", "mesh.rebuild",
+    "admission.score", "ep.route", "mesh.rebuild", "ep.rebalance",
 )
 
 _ACTIONS = ("raise", "drop", "delay", "dup", "hang")

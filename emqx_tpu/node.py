@@ -1094,6 +1094,16 @@ class BrokerNode:
                     "match.multichip.degraded.fail_threshold"),
                 multichip_ep_overflow_warn=cfg.get(
                     "match.multichip.ep.overflow_warn"),
+                multichip_ep_autotune=cfg.get(
+                    "match.multichip.ep.autotune.enable"),
+                multichip_ep_grow_threshold=cfg.get(
+                    "match.multichip.ep.autotune.grow_threshold"),
+                multichip_ep_shrink_threshold=cfg.get(
+                    "match.multichip.ep.autotune.shrink_threshold"),
+                multichip_ep_max_cap_class=cfg.get(
+                    "match.multichip.ep.autotune.max_cap_class"),
+                multichip_balance_budget=cfg.get(
+                    "match.multichip.ep.autotune.max_moved_roots"),
                 readback_mode=cfg.get("match.readback.mode"),
                 readback_auto_slack=cfg.get("match.readback.auto_slack"),
                 hists=self.hists,

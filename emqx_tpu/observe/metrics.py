@@ -192,9 +192,19 @@ MULTICHIP_METRIC_NAMES: List[str] = [
     "tpu.match.ep_shard_width", "tpu.match.ep_ici_bytes",
     # routed overflow-rate EWMA (set, 0..1): the smoothed fraction of
     # each routed batch that failed open via the psum'd overflow flags
-    # — the input a future bucket-grid resize keys on; a log-once
-    # warning fires when it crosses match.multichip.ep.overflow_warn
+    # — the input the capacity auto-resize keys on; a log-once warning
+    # fires when it crosses match.multichip.ep.overflow_warn (the
+    # latch re-arms after a successful capacity grow)
     "tpu.match.ep_overflow_ewma",
+    # load-adaptive EP plane (opt-in via match.multichip.ep.autotune.
+    # enable).  ep_cap_class is the live pow2 capacity-class exponent
+    # (set on every flip; absent/0 = the static grid); ep_resizes
+    # counts completed background capacity-class flips (inc);
+    # ep_rebalances counts balance passes that staged a placement
+    # override map (inc); ep_moved_roots is the number of roots the
+    # LAST balance pass moved off their crc32 shard (set)
+    "tpu.match.ep_cap_class", "tpu.match.ep_resizes",
+    "tpu.match.ep_rebalances", "tpu.match.ep_moved_roots",
 ]
 
 # -- degraded-mesh serving (parallel/multichip_serve.py +

@@ -45,11 +45,15 @@ __all__ = ["MatchKernelCache", "CompileMiss"]
 #: walked by the fused Pallas kernel (ops/pallas_match.py) — identical
 #: operand shapes, flat-output only.  ``mesh`` is None for single-device keys;
 #: the multichip serve backend (parallel/multichip_serve.py) keys its
-#: shard_map executables with ``(dp, tp, acap)`` and installs a
-#: ``mesh_lower`` hook the cache delegates those keys to — the same
+#: shard_map executables with ``(dp, tp, acap, kind, cap, ...)`` —
+#: note the routed bucket CAPACITY is part of the key, so the EP
+#: capacity auto-resize pre-compiles its target grid through this
+#: cache (block=True off the serve path) and the post-flip dispatch
+#: hits without ever parking behind XLA — and installs a
+#: ``mesh_lower`` hook the cache delegates those keys to; the same
 #: prewarm/CompileMiss contract then covers the mesh step.
 Key = Tuple[int, int, int, int, int, int, bool, int, bool, str,
-            Optional[Tuple[int, int, int]]]
+            Optional[Tuple[int, ...]]]
 
 
 class CompileMiss(RuntimeError):
@@ -71,7 +75,7 @@ class MatchKernelCache:
         # NEXT table shape
         self._combos: Set[Tuple[int, int, int, int, bool, int,
                                 bool, str,
-                                Optional[Tuple[int, int, int]]]] = set()
+                                Optional[Tuple[int, ...]]]] = set()
         # mesh-key lowering hook, installed by the multichip matcher
         # that owns the mesh (the cache itself stays mesh-agnostic)
         self.mesh_lower: Any = None
@@ -93,7 +97,7 @@ class MatchKernelCache:
             active_slots: int, max_matches: int,
             compact_output: bool, flat_cap: int,
             donate: bool = False, backend: str = "hash",
-            mesh: Optional[Tuple[int, int, int]] = None) -> Key:
+            mesh: Optional[Tuple[int, ...]] = None) -> Key:
         b, d = batch_shape
         return (b, d, s, hb, active_slots, max_matches,
                 bool(compact_output), flat_cap, bool(donate), backend,
@@ -103,7 +107,7 @@ class MatchKernelCache:
                    active_slots: int, max_matches: int,
                    compact_output: bool, flat_cap: int,
                    donate: bool = False, backend: str = "hash",
-                   mesh: Optional[Tuple[int, int, int]] = None,
+                   mesh: Optional[Tuple[int, ...]] = None,
                    block: bool = True):
         """The compiled executable for these operand shapes — cached, or
         compiled NOW (blocking; counted, so a resize that was prewarmed
@@ -155,7 +159,7 @@ class MatchKernelCache:
                active_slots: int, max_matches: int,
                compact_output: bool, flat_cap: int,
                donate: bool = False, backend: str = "hash",
-               mesh: Optional[Tuple[int, int, int]] = None) -> bool:
+               mesh: Optional[Tuple[int, ...]] = None) -> bool:
         k = self.key(batch_shape, s, hb, active_slots=active_slots,
                      max_matches=max_matches,
                      compact_output=compact_output, flat_cap=flat_cap,

@@ -75,6 +75,32 @@ lost subtable (epoch-guarded per-shard segment + delta-tail replay
 from the service filter state) and the service re-admits it only
 after a bit-parity canary passes.  Flag off, every path above is
 byte-identical to the whole-plane failover.
+
+Load-adaptive plane (ISSUE 20, opt-in ``match.multichip.ep.autotune.
+enable``): two feedback loops close the ROADMAP 100M residuals (b)/(c)
+on the PR 18 measurement plumbing.  (1) **EP capacity auto-resize** —
+when the routed overflow EWMA crosses ``grow_threshold`` the bucket
+grid rebuilds at the next pow2 capacity class (hysteresis band +
+cooldown for shrink) on a background thread: the new-capacity step
+compiles through the kernel cache / a local warm exec FIRST and the
+class flips under the lock afterwards, so no dispatch ever parks
+behind XLA and overflow rows keep failing open to the CPU trie
+throughout the window.  A successful grow re-arms the overflow-warn
+log-once latch and zeroes the EWMA so it measures the NEW grid.
+(2) **Popularity-aware placement** — routed dispatches bump a per-root
+popularity slab (numpy, the admission-plane feature-row idiom);
+:meth:`MultichipMatcher.plan_rebalance` (the service's ``table.
+compact`` worker cadence) greedily reassigns the hottest roots off the
+most-loaded shard within a max-moved-roots budget and stages a small
+``root → shard`` override map that :meth:`MultichipMatcher.shard_of`
+consults before the crc32 default.  The staged map swaps in at the
+next ``rebuild()`` apply — aid spans remap during that restack, and
+in-flight slots discard via the service's table-gen guard exactly like
+any compaction swap.  The map persists in the per-shard segment
+manifest (format v3; checksum-rejected on skew) so cold start restores
+placement.  A rebalance proposed while any shard is dead/rebuilding
+defers — roots never remap onto a dead owner.  Flag off, every path
+above is byte-identical: class stays 0, the override map stays empty.
 """
 
 from __future__ import annotations
@@ -126,7 +152,12 @@ def shard_of_filter(flt: str, tp: int) -> int:
     token hashes to.  Wildcard roots (``+``/``#``) hash their literal
     token here too (deterministic), but the matcher diverts them to
     the replicated micro-table (:func:`is_micro_filter`) — a filter
-    every topic can match has no single owner under EP routing."""
+    every topic can match has no single owner under EP routing.
+
+    This is the DEFAULT placement only: the load-adaptive matcher
+    consults its popularity override map first
+    (:meth:`MultichipMatcher.shard_of`); use that instance method
+    wherever a live matcher is in hand."""
     root = flt.split("/", 1)[0]
     return zlib.crc32(root.encode("utf-8")) % tp
 
@@ -393,12 +424,21 @@ class MultichipMatcher:
     captures one consistent (arrays, aid map) snapshot under the lock.
     """
 
-    MANIFEST_VERSION = 2
+    # v3 (ISSUE 20): the manifest's aid_maps.npz additionally carries
+    # the popularity placement override map (NUL-framed roots + int32
+    # owners, covered by the same sha1) so cold start restores
+    # placement; v2 manifests are version-rejected (one repartition
+    # serves after upgrade — same contract as any manifest skew)
+    MANIFEST_VERSION = 3
     #: serve-plane dispatch routing marker (MatchService checks this
     #: instead of importing the class on its hot path)
     is_multichip = True
     #: smoothing factor for the per-dispatch routed overflow-rate EWMA
     EP_OVERFLOW_ALPHA = 0.1
+    #: routed readbacks that must land at the current capacity class
+    #: before a shrink is considered — the EWMA zeroes on every flip,
+    #: so an immediate shrink-back would thrash the grid
+    EP_SHRINK_COOLDOWN = 64
 
     def __init__(
         self,
@@ -417,6 +457,11 @@ class MultichipMatcher:
         degraded: bool = False,
         degraded_fail_threshold: int = 3,
         ep_overflow_warn: float = 0.5,
+        ep_autotune: bool = False,
+        ep_grow_threshold: float = 0.05,
+        ep_shrink_threshold: float = 0.01,
+        ep_max_cap_class: int = 3,
+        balance_budget: int = 64,
     ) -> None:
         from .mesh import make_mesh
 
@@ -444,6 +489,29 @@ class MultichipMatcher:
         self.degraded = bool(degraded)
         self.fail_threshold = max(1, int(degraded_fail_threshold))
         self.ep_overflow_warn = float(ep_overflow_warn)
+        # load-adaptive plane (ISSUE 20, module docstring): capacity
+        # auto-resize + popularity-aware placement; flag off every
+        # structure below stays inert (class 0, empty override map)
+        self.ep_autotune = bool(ep_autotune)
+        self.ep_grow_threshold = float(ep_grow_threshold)
+        self.ep_shrink_threshold = float(ep_shrink_threshold)
+        self.ep_max_cap_class = max(0, int(ep_max_cap_class))
+        self.balance_budget = max(0, int(balance_budget))
+        self._cap_class = 0            # live pow2 capacity exponent
+        self._class_readbacks = 0      # routed readbacks at this class
+        self._resize_busy = False      # one background resize at a time
+        self._resize_thread: Optional[threading.Thread] = None
+        self._ep_shapes: set = set()   # observed routed (B, D) shapes
+        # popularity placement: override map consulted before the crc32
+        # default, the staged map the next rebuild swaps in, and the
+        # per-root load slab (indexed by root word id, lock-free stats
+        # — a dropped bump under a concurrent aging pass is benign)
+        self._placement: Dict[str, int] = {}
+        self._placement_next: Optional[Dict[str, int]] = None
+        self._root_load = np.zeros(1024, np.float64)
+        self.ep_resizes = 0
+        self.ep_rebalances = 0
+        self.moved_roots = 0
         if native:
             from ..native.nfa import available
 
@@ -542,6 +610,18 @@ class MultichipMatcher:
     def _all_tables(self) -> List[Any]:
         return [*self._subs, self._micro]
 
+    def shard_of(self, flt: str) -> int:
+        """Placement-aware :func:`shard_of_filter`: the popularity
+        override map (root → shard, staged by :meth:`plan_rebalance`
+        and swapped in at a rebuild) is consulted before the crc32
+        default.  Empty map (flag off, or nothing hot enough to move)
+        → byte-identical to the pure hash."""
+        if self._placement:
+            o = self._placement.get(flt.split("/", 1)[0])
+            if o is not None:
+                return int(o)
+        return shard_of_filter(flt, self.tp)
+
     def note_add(self, flt: str, service_aid: int) -> None:
         with self._lock:
             self._pending.append(("add", flt, service_aid))
@@ -600,7 +680,7 @@ class MultichipMatcher:
             amap[laid] = service_aid
             self._micro_filters[flt] = service_aid
             return
-        t = shard_of_filter(flt, self.tp)
+        t = self.shard_of(flt)
         sub = self._subs[t]
         sub.add(flt)
         laid = sub.aid_of(flt)
@@ -621,7 +701,7 @@ class MultichipMatcher:
             self._micro.remove(flt)
             self._micro_filters.pop(flt, None)
             return
-        t = shard_of_filter(flt, self.tp)
+        t = self.shard_of(flt)
         sub = self._subs[t]
         laid = sub.aid_of(flt)
         if laid < 0:
@@ -631,9 +711,10 @@ class MultichipMatcher:
         self._filters[t].pop(flt, None)
 
     def _sync_word_owner(self) -> bool:
-        """Fill routing owners (crc32(word) % tp — the device twin of
-        :func:`shard_of_filter`) for vocab words interned since the
-        last sync; pow2 growth.  Returns True when entries changed."""
+        """Fill routing owners (the device twin of :meth:`shard_of` —
+        placement override first, crc32(word) % tp default) for vocab
+        words interned since the last sync; pow2 growth.  Returns True
+        when entries changed."""
         n = len(self.vocab)
         if self._word_owner_n >= n:
             return False
@@ -644,8 +725,12 @@ class MultichipMatcher:
             grown = np.zeros(cap, np.int32)
             grown[:len(self._word_owner)] = self._word_owner
             self._word_owner = grown
+        place = self._placement
         for w, wid in list(self.vocab.items())[self._word_owner_n:]:
-            self._word_owner[wid] = zlib.crc32(w.encode("utf-8")) % self.tp
+            o = place.get(w) if place else None
+            self._word_owner[wid] = (
+                zlib.crc32(w.encode("utf-8")) % self.tp
+                if o is None else int(o))
         self._word_owner_n = n
         return True
 
@@ -665,6 +750,18 @@ class MultichipMatcher:
             rebuild, self._rebuild_pairs = self._rebuild_pairs, None
             restack_due, self._restack_due = self._restack_due, False
         if rebuild is not None:
+            with self._lock:
+                staged, self._placement_next = self._placement_next, None
+            if staged is not None:
+                # a full repartition rebuilds every aid span anyway —
+                # the staged override map swaps in HERE so the restack
+                # below remaps spans and word_owner in the same pass
+                # (in-flight slots discard via the service table-gen
+                # guard, like any compaction swap)
+                self._placement = staged
+                self._persist_due = True
+                log.info("EP placement override map applied: %d "
+                         "root(s) off their crc32 shard", len(staged))
             self._reset_subs()
             if self.native:
                 # pre-intern the whole word sequence with one native
@@ -983,9 +1080,24 @@ class MultichipMatcher:
         """Per-(source, owner) bucket size for a routed batch: the
         uniform share ``Bs/tp`` with ``ep_slack`` headroom.  Per-shard
         processed width is ``tp * C <= ceil(slack * Bl / tp)`` — the
-        ``gate_shard_width_le_batch_over_tp`` contract."""
+        ``gate_shard_width_le_batch_over_tp`` contract.  The autotune
+        capacity class scales this by pow2 steps, ceilinged at the
+        full source-slice width (where bucket overflow is impossible);
+        class 0 — flag off, or never grown — is byte-identical."""
         bs = (batch // self.dp) // self.tp
-        return max(1, int(math.ceil(self.ep_slack * bs / self.tp)))
+        base = max(1, int(math.ceil(self.ep_slack * bs / self.tp)))
+        if self._cap_class:
+            base = min(max(bs, 1), base << self._cap_class)
+        return base
+
+    def _capacity_at(self, batch: int, cap_class: int) -> int:
+        """:meth:`ep_capacity` at an explicit class — what the resize
+        worker compiles for before flipping ``_cap_class``."""
+        bs = (batch // self.dp) // self.tp
+        base = max(1, int(math.ceil(self.ep_slack * bs / self.tp)))
+        if cap_class:
+            base = min(max(bs, 1), base << cap_class)
+        return base
 
     def _routed_for(self, batch: int) -> bool:
         """EP routing serves a batch iff the dp-local slice splits
@@ -1046,6 +1158,9 @@ class MultichipMatcher:
         if routed:
             self.ep_dispatches += 1
             self._routed_live.add(id(res))
+            if self.ep_autotune:
+                self._ep_shapes.add((b, d))
+                self._note_root_load(words, lens, d)
             if self.metrics is not None:
                 cap = self.ep_capacity(b)
                 self.metrics.inc("tpu.match.ep_dispatches")
@@ -1110,6 +1225,9 @@ class MultichipMatcher:
                         self._ov_ewma, self.ep_overflow_warn)
             else:
                 self._ov_warned = False
+            self._class_readbacks += 1
+            if self.ep_autotune:
+                self._maybe_resize()
         if meta is not None and routed:
             extra = [r for r in meta[1] if r < n and not sp[r]]
             if extra:
@@ -1160,6 +1278,11 @@ class MultichipMatcher:
             )
         key: Tuple[int, ...] = (
             int(batch_shape[0]), int(batch_shape[1]), kind)
+        if self.ep_autotune:
+            # autotune-only key extension: a class flip must select a
+            # freshly built grid, never silently reuse the old one;
+            # flag off the keys stay the PR 17 shape verbatim
+            key += (cap,)
         if micro_owner:
             key += (int(micro_owner),)
         fn = self._steps.get(key)
@@ -1211,6 +1334,222 @@ class MultichipMatcher:
                 enc = self.encode([], batch=b, depth=d)
                 res = self.dispatch(enc)
                 self.readback(res, 0)
+
+    # ------------------------------------------------------------------
+    # load-adaptive plane: capacity auto-resize + popularity placement
+    # (ISSUE 20, opt-in match.multichip.ep.autotune.enable)
+    # ------------------------------------------------------------------
+
+    def _note_root_load(self, words, lens, depth: int) -> None:
+        """Per-root popularity counters (numpy slab indexed by root
+        word id — the admission-plane feature-row idiom): every
+        routable row of a routed dispatch bumps its root.  The slab
+        ages by halving at each balance pass, so it behaves as an EWMA
+        at compaction cadence.  Lock-free: a bump lost under a
+        concurrent aging pass skews a statistic, never an answer."""
+        w = np.asarray(words)[:, 0]
+        routable = (np.asarray(lens) <= depth) & (w > 0)
+        if not routable.any():
+            return
+        if len(self._root_load) < len(self._word_owner):
+            grown = np.zeros(len(self._word_owner), np.float64)
+            grown[:len(self._root_load)] = self._root_load
+            self._root_load = grown
+        roots = np.clip(w[routable], 0, len(self._root_load) - 1)
+        np.add.at(self._root_load, roots, 1.0)
+
+    def _maybe_resize(self) -> None:
+        """Capacity-class trigger (routed readback, worker thread):
+        grow one pow2 class when the overflow EWMA crosses the grow
+        threshold; shrink one class inside the hysteresis band after
+        ``EP_SHRINK_COOLDOWN`` readbacks at the current class.  The
+        rebuild runs on a background thread — dispatches keep serving
+        the old grid (overflow failing open to the CPU trie) until the
+        new step is compiled.  Deferred entirely while any shard is
+        dead: the degraded mesh owns the plane then."""
+        if self._resize_busy or self._dead:
+            return
+        target = None
+        if (self._ov_ewma >= self.ep_grow_threshold
+                and self._cap_class < self.ep_max_cap_class):
+            target = self._cap_class + 1
+            shapes = list(self._ep_shapes)
+            if shapes and all(
+                    self.ep_capacity(b) >= max(1, (b // self.dp)
+                                               // self.tp)
+                    for b, _d in shapes):
+                return   # already at the source-slice ceiling
+        elif (self._cap_class > 0
+              and self._class_readbacks >= self.EP_SHRINK_COOLDOWN
+              and self._ov_ewma <= self.ep_shrink_threshold):
+            target = self._cap_class - 1
+        if target is None:
+            return
+        self._resize_busy = True
+        self._resize_thread = threading.Thread(
+            target=self._resize_worker, args=(target,),
+            name="mc-ep-resize", daemon=True)
+        self._resize_thread.start()
+
+    def drain_resize(self, timeout: Optional[float] = None) -> bool:
+        """Teardown drain: join the in-flight capacity rebuild.  The
+        worker is a daemon thread, but daemon only helps at interpreter
+        exit — a compile left churning after the matcher's owner stops
+        keeps XLA on every host core, stealing CPU from whatever the
+        process runs next.  Returns True when no resize is in flight."""
+        t = self._resize_thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        return not self._resize_busy
+
+    def _resize_worker(self, target: int) -> None:
+        """Background capacity rebuild: compile the routed step at the
+        target class for every observed serve shape FIRST (kernel
+        cache AOT when attached — the prewarm machinery — else a local
+        warm exec), then flip ``_cap_class`` under the lock.  The flip
+        is a key swap, so no dispatch ever parks behind XLA; rows keep
+        failing open throughout the compile window.  A successful GROW
+        re-arms the overflow-warn latch and zeroes the EWMA (satellite
+        bugfix: it must measure the new grid, and a later regression
+        must warn again)."""
+        grew = target > self._cap_class
+        try:
+            for b, d in sorted(self._ep_shapes):
+                self._warm_capacity((b, d), target)
+            with self._lock:
+                self._cap_class = target
+                self._class_readbacks = 0
+                if grew:
+                    self._ov_ewma = 0.0
+                    self._ov_warned = False
+            self.ep_resizes += 1
+            if self.metrics is not None:
+                self.metrics.set("tpu.match.ep_cap_class", target)
+                self.metrics.inc("tpu.match.ep_resizes")
+            log.warning("EP bucket grid %s to capacity class %d "
+                        "(overflow EWMA keyed)",
+                        "grew" if grew else "shrank", target)
+        except Exception:
+            log.warning("EP capacity resize to class %d failed; grid "
+                        "unchanged", target, exc_info=True)
+        finally:
+            self._resize_busy = False
+
+    def _warm_capacity(self, batch_shape: Tuple[int, int],
+                       cap_class: int) -> None:
+        """Compile the routed step for ``batch_shape`` at an explicit
+        capacity class WITHOUT flipping the live class.  With a kernel
+        cache the compile lands in the shared cache (a post-flip
+        dispatch with ``block=False`` hits, never a CompileMiss); the
+        no-cache path warm-executes the local step once so its jit
+        cache is hot."""
+        b, d = int(batch_shape[0]), int(batch_shape[1])
+        cap = self._capacity_at(b, cap_class)
+        compact = self.ep_compact
+        kind = 2 if compact else 1
+        kc = self.kernel_cache
+        if kc is not None and self._stacked_shape is not None:
+            smax, hbmax, acap, sm, hbm, am, wcap = self._stacked_shape
+            mesh_key = (self.dp, self.tp, acap, kind, cap,
+                        sm, hbm, am, wcap, self.ep_micro_matches)
+            kc.executable(
+                (b, d), smax, hbmax,
+                active_slots=self.active_slots,
+                max_matches=self.max_matches,
+                compact_output=True, flat_cap=0,
+                mesh=mesh_key, block=True)
+            return
+        key = (b, d, kind, cap)
+        if key in self._steps:
+            return
+        fn = build_multichip_step(
+            self.mesh, self.active_slots, self.max_matches,
+            micro_matches=self.ep_micro_matches,
+            routed=True, capacity=cap, compact=compact)
+        with self._lock:
+            arrs = self._arrs
+        if arrs is not None:
+            try:
+                enc = self.encode([], batch=b, depth=d)
+                res = fn(jnp.asarray(enc[0]), jnp.asarray(enc[1]),
+                         jnp.asarray(enc[2]), *arrs)
+                jax.block_until_ready(res.counts)
+            except Exception:
+                # a concurrent apply donated the snapshot away: the
+                # compile simply happens at the first dispatch instead
+                # (the pre-existing no-cache contract)
+                log.debug("EP capacity warm exec lost the snapshot "
+                          "race", exc_info=True)
+        self._steps[key] = fn
+
+    def plan_rebalance(self) -> int:
+        """WORKER-THREAD step (the service's ``table.compact`` worker
+        cadence): greedy hot-root reassignment off the popularity
+        slab.  Moves the hottest improving root from the most- to the
+        least-loaded shard, at most ``balance_budget`` times, and
+        stages the result as a ``root → shard`` override map that the
+        NEXT ``rebuild()`` apply swaps in (aid spans remap during that
+        restack).  Defers — stages nothing, returns 0 — while any
+        shard is dead or rebuilding: roots never remap onto a dead
+        owner, and the readmit canary must judge the placement it was
+        built against.  An injected ``ep.rebalance`` fault raises
+        BEFORE anything is staged (kill mid-rebalance = no-op).
+        Returns the number of roots moved."""
+        if not self.ep_autotune or self.tp < 2 or self.balance_budget <= 0:
+            return 0
+        if _fi._injector is not None:
+            act = _fi._injector.act("ep.rebalance")
+            if act == "raise":
+                raise _fi.InjectedFault("ep.rebalance")
+            if act == "delay":
+                import time
+
+                time.sleep(_fi._injector.last_delay)
+        if self._dead:
+            return 0
+        with self._lock:
+            load = self._root_load.copy()
+            placement = dict(self._placement)
+            vocab_items = list(self.vocab.items())
+        self._root_load *= 0.5   # age: EWMA at compaction cadence
+        cand = [(w, wid) for w, wid in vocab_items
+                if 0 < wid < len(load) and load[wid] > 0.0]
+        if not cand:
+            return 0
+        owners: Dict[str, int] = {}
+        loads: Dict[str, float] = {}
+        for w, wid in cand:
+            o = placement.get(w)
+            if o is None:
+                o = zlib.crc32(w.encode("utf-8")) % self.tp
+            owners[w] = int(o)
+            loads[w] = float(load[wid])
+        from .prefix_ep import greedy_balance
+
+        owners, moved = greedy_balance(
+            loads, owners, self.tp, self.balance_budget)
+        # the override map keeps only roots off their crc32 default;
+        # overrides for roots with no observed load this round persist
+        # (their filters still live on the overridden shard)
+        new_place = {
+            w: o for w, o in owners.items()
+            if o != zlib.crc32(w.encode("utf-8")) % self.tp}
+        for w, o in placement.items():
+            if w not in owners:
+                new_place.setdefault(w, o)
+        if new_place == placement:
+            return 0
+        with self._lock:
+            self._placement_next = new_place
+        self.ep_rebalances += 1
+        self.moved_roots = moved
+        if self.metrics is not None:
+            self.metrics.inc("tpu.match.ep_rebalances")
+            self.metrics.set("tpu.match.ep_moved_roots", moved)
+        log.info("EP balance pass staged %d root move(s) (%d "
+                 "override(s) total); the next rebuild applies",
+                 moved, len(new_place))
+        return moved
 
     # ------------------------------------------------------------------
     # online shard rebuild + canary re-admit (degraded mesh, ISSUE 18)
@@ -1292,7 +1631,7 @@ class MultichipMatcher:
         t0 = _time.perf_counter()
         want = {flt: aid for flt, aid in pairs
                 if not is_micro_filter(flt)
-                and shard_of_filter(flt, self.tp) == t}
+                and self.shard_of(flt) == t}
         with self._maint_lock:
             seeded = self._seg_seed_filters(t, segments_dir,
                                             expect_epoch)
@@ -1386,6 +1725,12 @@ class MultichipMatcher:
             seg = load_segment(os.path.join(d, f"shard{t}.seg.npz"))
             if seg.depth != self.depth:
                 return None
+            if seg.meta.get("placement_crc") != self._place_crc(
+                    self._placement):
+                # the segment was cut under a different placement: its
+                # filter set is not this shard's under the LIVE map —
+                # the full rebuild from service pairs serves instead
+                return None
             if seg.kind == "filters":
                 sa = np.asarray(arrays[f"sa{t}"], np.int32)
                 if len(sa) != len(seg.filters):
@@ -1409,6 +1754,17 @@ class MultichipMatcher:
     def _seg_dir(segments_dir: str) -> str:
         return os.path.join(segments_dir, "multichip")
 
+    @staticmethod
+    def _place_crc(place: Dict[str, int]) -> int:
+        """Canonical crc32 of a placement override map — stamped into
+        every per-shard segment's (checksummed) meta so a shard file
+        cut under a DIFFERENT placement than the manifest restores is
+        rejected (a torn save can leave mixed generations; the epoch
+        guard alone can't see a placement-only swap)."""
+        return zlib.crc32(json.dumps(
+            sorted(place.items()),
+            separators=(",", ":")).encode("utf-8"))
+
     def save_segments(self, segments_dir: str, epoch: int) -> None:
         """WORKER-THREAD step: persist every shard subtable + the
         micro-table (native tables ride the NUL-framed "filters"
@@ -1425,17 +1781,20 @@ class MultichipMatcher:
 
         d = self._seg_dir(segments_dir)
         os.makedirs(d, exist_ok=True)
+        pcrc = self._place_crc(self._placement)
         arrays: Dict[str, np.ndarray] = {}
         for t, sub in enumerate(self._subs):
             flts = list(self._filters[t])
             save_segment(os.path.join(d, f"shard{t}.seg.npz"), sub,
-                         deep={}, routing_aids=set(), filters=flts)
+                         deep={}, routing_aids=set(), filters=flts,
+                         extra_meta={"placement_crc": pcrc})
             arrays[f"m{t}"] = np.asarray(self._aid_maps[t], np.int32)
             arrays[f"sa{t}"] = np.asarray(
                 [self._filters[t][f] for f in flts], np.int32)
         mflts = list(self._micro_filters)
         save_segment(os.path.join(d, "micro.seg.npz"), self._micro,
-                     deep={}, routing_aids=set(), filters=mflts)
+                     deep={}, routing_aids=set(), filters=mflts,
+                     extra_meta={"placement_crc": pcrc})
         arrays["mm"] = np.asarray(self._micro_amap, np.int32)
         arrays["sam"] = np.asarray(
             [self._micro_filters[f] for f in mflts], np.int32)
@@ -1446,6 +1805,16 @@ class MultichipMatcher:
                                        key=lambda kv: kv[1])]
         arrays["vw"] = np.frombuffer(
             "\x00".join(words).encode("utf-8"), np.uint8).copy()
+        # v3: the popularity placement override map (NUL-framed roots
+        # + parallel int32 owners, deterministic order) — cold start
+        # restores placement BEFORE the restack, so the restored
+        # partition and the shard_of it will serve under agree
+        proots = sorted(self._placement)
+        arrays["pr"] = (np.frombuffer(
+            "\x00".join(proots).encode("utf-8"), np.uint8).copy()
+            if proots else np.zeros(0, np.uint8))
+        arrays["ps"] = np.asarray(
+            [self._placement[w] for w in proots], np.int32)
         meta = {"version": self.MANIFEST_VERSION, "epoch": int(epoch),
                 "tp": self.tp, "depth": self.depth,
                 "native": bool(self.native)}
@@ -1543,10 +1912,28 @@ class MultichipMatcher:
                 bytes(np.asarray(arrays["vw"], np.uint8))
                 .decode("utf-8").split("\x00")
                 if len(arrays.get("vw", ())) else [])
+            place: Dict[str, int] = {}
+            if len(arrays.get("pr", ())):
+                proots = (bytes(np.asarray(arrays["pr"], np.uint8))
+                          .decode("utf-8").split("\x00"))
+                powners = np.asarray(arrays["ps"], np.int32).tolist()
+                if len(proots) != len(powners) or any(
+                        not 0 <= o < self.tp for o in powners):
+                    log.warning("multichip placement map malformed; "
+                                "repartition serves")
+                    return False
+                place = dict(zip(proots, powners))
+            pcrc = self._place_crc(place)
             subs, amaps, fdicts = [], [], []
             for t in range(self.tp):
                 seg = load_segment(os.path.join(d, f"shard{t}.seg.npz"))
                 if seg.depth != self.depth:
+                    return False
+                if seg.meta.get("placement_crc") != pcrc:
+                    # a torn save left this shard file cut under a
+                    # different placement than the manifest restores
+                    log.warning("multichip shard %d segment placement "
+                                "skew; repartition serves", t)
                     return False
                 sub, amap, fdict = self._restore_sub(
                     seg, arrays, f"sa{t}")
@@ -1555,6 +1942,10 @@ class MultichipMatcher:
                 fdicts.append(fdict)
             mseg = load_segment(os.path.join(d, "micro.seg.npz"))
             if mseg.depth != self.depth:
+                return False
+            if mseg.meta.get("placement_crc") != pcrc:
+                log.warning("multichip micro segment placement skew; "
+                            "repartition serves")
                 return False
             micro, micro_amap, micro_fdict = self._restore_sub(
                 mseg, arrays, "sam")
@@ -1595,6 +1986,11 @@ class MultichipMatcher:
             self._micro = micro
             self._micro_amap = micro_amap
             self._micro_filters = micro_fdict
+            # placement restores FIRST relative to the word_owner
+            # resync the pending restack performs — the restored
+            # partition was saved under exactly this map
+            self._placement = place
+            self._placement_next = None
             self._word_owner = np.zeros(1024, np.int32)
             self._word_owner_n = 0
             self._pending = []
@@ -1632,4 +2028,9 @@ class MultichipMatcher:
             "rebuilds": self.rebuilds,
             "readmit_canary_fails": self.readmit_canary_fails,
             "ep_overflow_ewma": round(self._ov_ewma, 6),
+            "ep_autotune": self.ep_autotune,
+            "ep_cap_class": self._cap_class,
+            "ep_resizes": self.ep_resizes,
+            "ep_rebalances": self.ep_rebalances,
+            "placement_overrides": len(self._placement),
         }

@@ -32,6 +32,13 @@ serve backend's dp×tp mesh, the owner merges a replicated
 wildcard-root micro-table into its answer segment instead of
 replicating root wildcards into every partition, and overflow joins
 the serve plane's CPU-trie fail-open set.
+
+:func:`greedy_balance` is the partition-balancing core the serving
+plane's popularity-aware placement (ISSUE 20,
+``match.multichip.ep.autotune.enable``) runs at compaction cadence: a
+pure strict-improvement greedy over observed per-root loads, so the
+same function is unit-testable here and auditable against the dryrun's
+uniform ``owner_of`` rule it overrides.
 """
 
 from __future__ import annotations
@@ -48,13 +55,48 @@ from ._shard_compat import shard_map
 from .. import topic as T
 from ..ops.incremental import IncrementalNfa
 
-__all__ = ["EpTables", "build_partitions", "build_ep_matcher", "owner_of"]
+__all__ = ["EpTables", "build_partitions", "build_ep_matcher",
+           "owner_of", "greedy_balance"]
 
 
 def owner_of(flt_or_topic: str, vocab: Dict[str, int], n_parts: int) -> int:
     """Partition rule: root word's vocab id mod E (UNKNOWN → 0)."""
     root = flt_or_topic.split("/", 1)[0]
     return vocab.get(root, 0) % n_parts
+
+
+def greedy_balance(loads: Dict[str, float], owners: Dict[str, int],
+                   n_parts: int, budget: int,
+                   ) -> Tuple[Dict[str, int], int]:
+    """Greedy hot-root reassignment: repeatedly move the hottest
+    strictly-improving root from the most- to the least-loaded
+    partition, at most ``budget`` times.  A root heavier than the
+    hi−lo gap never moves (it would only swap which partition is hot),
+    so every move shrinks the spread and the loop terminates early
+    when no improving move remains.  Pure: returns ``(new owners,
+    moves made)`` without touching the inputs."""
+    owners = dict(owners)
+    shard_load = np.zeros(max(1, n_parts), np.float64)
+    for w, o in owners.items():
+        shard_load[o] += loads.get(w, 0.0)
+    moved = 0
+    for _ in range(max(0, budget)):
+        hi = int(np.argmax(shard_load))
+        lo = int(np.argmin(shard_load))
+        gap = float(shard_load[hi] - shard_load[lo])
+        best = None
+        best_load = 0.0
+        for w, o in owners.items():
+            lw = loads.get(w, 0.0)
+            if o == hi and 0.0 < lw < gap and lw > best_load:
+                best, best_load = w, lw
+        if best is None:
+            break
+        owners[best] = lo
+        shard_load[hi] -= best_load
+        shard_load[lo] += best_load
+        moved += 1
+    return owners, moved
 
 
 class EpTables(NamedTuple):

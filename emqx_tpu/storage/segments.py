@@ -31,6 +31,15 @@ rebuild (chaos-tested in tests/test_chaos_delivery.py).
 Alias/deep-filter state (filters deeper than the device table) and the
 routing-aid set ride in both kinds so the serving layer restores its
 id-space bookkeeping without an O(n) re-derivation.
+
+``extra_meta`` entries land inside the checksummed meta record, so a
+writer can bind a segment to state that lives OUTSIDE the file: the
+multichip plane stamps ``placement_crc`` (the crc32 of its popularity
+placement override map, ISSUE 20) into every per-shard segment — a
+shard file cut under a different placement than the manifest restores
+is then rejected at load even though its own payload checksum is
+intact (the torn-save mixed-generation case the epoch guard alone
+cannot see).
 """
 
 from __future__ import annotations

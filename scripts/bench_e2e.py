@@ -75,6 +75,10 @@ def multichip_ep_smoke(n_filters: int) -> dict:
     return _mesh_smoke("bench_multichip_ep_smoke", n_filters)
 
 
+def multichip_balance_smoke(n_filters: int) -> dict:
+    return _mesh_smoke("bench_multichip_balance_smoke", n_filters)
+
+
 def staticcheck_gate() -> dict:
     """Cold full-tree staticcheck as a CI gate row (ISSUE 19): runs
     ``scripts/staticcheck.py`` in a subprocess against a throwaway
@@ -878,6 +882,14 @@ def main(argv=None) -> dict:
     # failover are CI-asserted; the routed speedup is a tracking
     # number (host threads pay the all_to_all without the ICI win).
     out["multichip_ep"] = multichip_ep_smoke(
+        n_filters=(2000 if args.smoke else 20000))
+    # load-adaptive plane A/B (ISSUE 20): overflow-EWMA capacity grow
+    # with zero dropped rows through the compile window, popularity
+    # rebalance worst-shard width cut >= 1.5x on the skewed corpus,
+    # post-remap routed parity, cold-start placement restore, and the
+    # ep.rebalance fault no-op — all CI-asserted; the adaptive
+    # speedup is a tracking number (host threads share one CPU).
+    out["multichip_balance"] = multichip_balance_smoke(
         n_filters=(2000 if args.smoke else 20000))
     # stage-latency observatory parity (ISSUE 12): the serve sections'
     # p50/p99 now come from the product's histograms (observe/hist.py);

@@ -23,7 +23,7 @@ def test_bench_e2e_smoke_delivers_everything():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "bench_e2e.py"),
          "--smoke", "--chaos"],
-        capture_output=True, text=True, timeout=300,
+        capture_output=True, text=True, timeout=600,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
@@ -169,6 +169,25 @@ def test_bench_e2e_smoke_delivers_everything():
     assert mce["routed_topics_per_s"] > 0, mce
     assert "gate_auto_within_5pct" in kj, kj
     assert kj["autotune_picks"], kj
+    # load-adaptive plane A/B (ISSUE 20): the overflow EWMA grew the
+    # bucket grid at least once with every row complete through the
+    # compile window (fail-open, zero breaker strikes), one balance
+    # pass cut the worst shard's row share >= 1.5x on the skewed
+    # corpus, the post-remap routed rows are bit-parity with the
+    # replicated backend, the override map survives a cold start, and
+    # an injected ep.rebalance fault stages nothing.  The adaptive
+    # speedup is a tracking number (host threads share one CPU).
+    mcb = out["multichip_balance"]
+    assert mcb["gate_grow_zero_drops"], mcb
+    assert mcb["gate_balance_width_ge_1_5x"], mcb
+    assert mcb["gate_routed_parity_all"], mcb
+    assert mcb["gate_coldstart_placement_restored"], mcb
+    assert mcb["gate_rebalance_fault_noop"], mcb
+    assert mcb["devices"] == 8 and mcb["mesh"]["tp"] > 1, mcb
+    assert mcb["ep_resizes"] >= 1, mcb
+    assert mcb["moved_roots"] >= 1, mcb
+    assert mcb["worst_width_ratio_x"] >= 1.5, mcb
+    assert mcb["adaptive_worst_width"] < mcb["static_worst_width"], mcb
     # streaming table lifecycle A/B (ISSUE 9): segment cold start >=10x
     # the full rebuild at bench scale, arrays byte-identical after the
     # round trip, and the churn soak sustains mutations across >=1 live

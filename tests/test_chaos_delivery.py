@@ -1605,3 +1605,81 @@ def test_chaos_mesh_sustained_shard_faults_ladder_to_cpu_only():
             await node.stop()
 
     run(main())
+
+# ---------------------------------------------------------------------------
+# 14. load-adaptive plane chaos (ISSUE 20): the balance pass killed
+#     mid-rebalance is a NO-OP (nothing staged, old placement serves),
+#     and a degraded mesh defers the pass — delivery 1.0 throughout
+# ---------------------------------------------------------------------------
+
+def test_chaos_rebalance_killed_midpass_noop_delivery_holds():
+    """Every balance pass on the compaction cadence dies at the
+    injected ``ep.rebalance`` seam: the fault fires BEFORE anything is
+    staged, so the crc32 placement keeps serving untouched and the
+    storm delivers 1.0.  A shard killed afterwards makes the pass
+    defer (return 0, stage nothing) until re-admission — then a clean
+    pass may stage again."""
+    import tempfile
+
+    async def main():
+        seg = tempfile.mkdtemp()
+        node = await _start_match_node(**{
+            "match.multichip.enable": True,
+            "match.multichip.ep.enable": True,
+            "match.multichip.ep.autotune.enable": True,
+            "match.multichip.degraded.enable": True,
+            "match.segments.enable": True,
+            "match.segments.dir": seg,
+            "match.segments.compact_interval": 0.1,
+            "match.segments.compact_min_mutations": 1,
+        })
+        try:
+            b = node.broker
+            ms = node.match_service
+            mc = ms.mc
+            assert mc is not None and mc.ep_autotune
+            got = []
+            b.on_deliver = lambda cid, pubs: got.extend(
+                bytes(p.msg.payload) for p in pubs)
+            b.open_session("sub")
+            b.subscribe("sub", "t/#", SubOpts())
+            assert await until(lambda: ms.ready and mc.ready,
+                               timeout=60)
+            n = 60
+            inj = faultinject.install(FaultInjector([
+                {"point": "ep.rebalance", "action": "raise",
+                 "times": 0},
+            ]))
+            try:
+                await _match_storm(node, got, n, 0)
+                # compactions keep firing on the 0.1 s cadence; every
+                # balance pass dies at the seam = a no-op
+                assert await until(
+                    lambda: inj.fired.get("ep.rebalance", 0) >= 1,
+                    timeout=30)
+                assert mc._placement == {}
+                assert mc._placement_next is None
+                assert mc.ep_rebalances == 0
+                assert len(got) == n          # delivery held at 1.0
+            finally:
+                faultinject.uninstall()
+            # degraded race, deterministic: while a shard is dead the
+            # pass returns 0 and stages nothing (never remaps onto a
+            # dead owner; the canary judges the placement it was
+            # built against)
+            mc.kill_shard(2)
+            assert mc.plan_rebalance() == 0
+            assert mc._placement_next is None
+            await _match_storm(node, got, n, 1000)
+            assert await until(lambda: not mc.dead_shards, timeout=60)
+            await _match_storm(node, got, n, 2000)
+            assert await until(lambda: len(got) >= 3 * n)
+            assert len(got) == 3 * n          # 1.0 across the cycle
+            assert sorted(int(x) for x in got) == sorted(
+                list(range(n)) + list(range(1000, 1000 + n))
+                + list(range(2000, 2000 + n)))
+        finally:
+            faultinject.uninstall()
+            await node.stop()
+
+    run(main())

@@ -94,3 +94,35 @@ def test_duration_and_size_coercion_via_env():
                       "EMQX_TPU__BATCH_DEADLINE": "500ms"})
     assert cfg.get("mqtt.max_packet_size") == 2 << 20
     assert cfg.get("tpu.batch_deadline") == 0.5
+
+
+def test_schema_clamps_multichip_autotune_keys():
+    """ISSUE 20 registry hygiene: the autotune keys validate their
+    documented ranges, and ``match.readback.auto_slack`` is a
+    FRACTION — values outside [0, 1] are config errors, not silent
+    extrapolation."""
+    cfg = Config(env={})
+    assert cfg.get("match.multichip.ep.autotune.enable") is False
+    cfg.put("match.multichip.ep.autotune.enable", True)
+    cfg.put("match.multichip.ep.autotune.grow_threshold", 0.1)
+    cfg.put("match.multichip.ep.autotune.shrink_threshold", 0.0)
+    cfg.put("match.multichip.ep.autotune.max_cap_class", 8)
+    cfg.put("match.multichip.ep.autotune.max_moved_roots", 0)
+    with pytest.raises(ValueError):
+        cfg.put("match.multichip.ep.autotune.grow_threshold", 0.0)
+    with pytest.raises(ValueError):
+        cfg.put("match.multichip.ep.autotune.grow_threshold", 1.5)
+    with pytest.raises(ValueError):
+        cfg.put("match.multichip.ep.autotune.shrink_threshold", -0.1)
+    with pytest.raises(ValueError):
+        cfg.put("match.multichip.ep.autotune.max_cap_class", 9)
+    with pytest.raises(ValueError):
+        cfg.put("match.multichip.ep.autotune.max_cap_class", -1)
+    with pytest.raises(ValueError):
+        cfg.put("match.multichip.ep.autotune.max_moved_roots", 5000)
+    cfg.put("match.readback.auto_slack", 0.0)
+    cfg.put("match.readback.auto_slack", 1.0)
+    with pytest.raises(ValueError):
+        cfg.put("match.readback.auto_slack", 1.5)
+    with pytest.raises(ValueError):
+        cfg.put("match.readback.auto_slack", -0.1)

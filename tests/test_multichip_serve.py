@@ -1037,3 +1037,381 @@ def test_node_canary_failure_blocks_readmit_until_parity():
             await node.stop()
 
     run(main())
+
+# ---------------------------------------------------------------------------
+# load-adaptive plane (ISSUE 20): capacity auto-resize + popularity
+# placement
+# ---------------------------------------------------------------------------
+
+def _colliding_roots(tp, n, prefix="h"):
+    """First ``n`` synthetic roots that crc32-hash to ONE shard — the
+    skew every popularity test needs."""
+    out, i = [], 0
+    while len(out) < n:
+        r = f"{prefix}{i}"
+        if shard_of_filter(r, tp) == shard_of_filter(f"{prefix}0", tp):
+            out.append(r)
+        i += 1
+    return out
+
+
+def test_greedy_balance_pure_strict_improvement():
+    """The pure core: every move is strictly improving (hottest root
+    whose load fits inside the hi-lo gap), the worst shard's load
+    drops, budget 0 is a no-op, and a balanced input stays put."""
+    from emqx_tpu.parallel.prefix_ep import greedy_balance
+
+    loads = {"h0": 100.0, "h1": 90.0, "h2": 80.0, "h3": 70.0,
+             "c0": 1.0}
+    owners = {"h0": 0, "h1": 0, "h2": 0, "h3": 0, "c0": 1}
+
+    def worst(o):
+        per = [0.0] * 4
+        for w, t in o.items():
+            per[t] += loads[w]
+        return max(per)
+
+    new, moved = greedy_balance(loads, owners, 4, 64)
+    assert moved >= 3
+    assert worst(new) < worst(owners)
+    assert worst(new) <= 100.0          # no shard above the hottest root
+    assert set(new) == set(owners)      # no root invented or dropped
+    assert all(0 <= t < 4 for t in new.values())
+    # budget 0: identity
+    same, n0 = greedy_balance(loads, owners, 4, 0)
+    assert n0 == 0 and same == owners
+    # already balanced: strict improvement finds nothing to move
+    flat = {f"r{i}": 10.0 for i in range(4)}
+    fown = {f"r{i}": i for i in range(4)}
+    kept, nk = greedy_balance(flat, fown, 4, 64)
+    assert nk == 0 and kept == fown
+
+
+def test_autotune_flag_off_byte_identical():
+    """Flag off (the default ctor): no load is noted, no resize ever
+    triggers, the placement map stays empty, ``shard_of`` is the pure
+    crc32 hash, and rows are bit-identical to an autotune-on matcher
+    that never crossed a threshold."""
+    inc, mc_off, pairs = build_ep_pair(ep_slack=4.0)
+    mc_on = MultichipMatcher(depth=8, ep=True, ep_slack=4.0,
+                             ep_autotune=True)
+    mc_on.rebuild(pairs)
+    assert mc_on.apply_pending()
+    topics = topics_for(48)
+    rows_off, sp_off, _ = mesh_rows(mc_off, topics)
+    rows_on, sp_on, _ = mesh_rows(mc_on, topics)
+    assert sp_off == sp_on
+    assert [sorted(r) for r in rows_off] == [sorted(r) for r in rows_on]
+    assert not mc_off.ep_autotune
+    assert mc_off._cap_class == 0 and mc_off._placement == {}
+    assert mc_off.ep_resizes == 0 and not mc_off._root_load.any()
+    assert mc_off.plan_rebalance() == 0     # flag off: a no-op
+    assert mc_off._placement_next is None
+    for f in FILTERS:
+        assert mc_off.shard_of(f) == shard_of_filter(f, mc_off.tp)
+    assert mc_off.ep_capacity(64) == mc_on.ep_capacity(64)
+    # autotune on but idle: still byte-identical state
+    assert mc_on._cap_class == 0 and mc_on._placement == {}
+
+
+def test_overflow_ewma_grow_rearms_warn_latch_rows_complete(caplog):
+    """EWMA-triggered grow: a hot root overflowing every source slice
+    crosses the grow threshold, the grid grows on a background thread
+    while EVERY row of every batch stays complete (fail-open, zero
+    failover strikes), the grow zeroes the EWMA and re-arms the
+    warn-once latch, and the SECOND regression at the grown class
+    warns again (satellite: the latch must reset on grow)."""
+    import logging
+    import time
+
+    # grow_threshold ABOVE the warn threshold so each grow happens
+    # after the warn fired: warn/grow at class 0, re-warn/grow at 1
+    inc, mc, _pairs = build_ep_pair(
+        ep_slack=0.5, ep_autotune=True, ep_grow_threshold=0.6)
+    assert mc.ep_autotune and mc._cap_class == 0
+    topics = [f"x/{i}/z" for i in range(56)] + ["x/y/z"] * 8
+    cap0 = mc.ep_capacity(64)
+    with caplog.at_level(logging.WARNING,
+                         logger="emqx_tpu.parallel.multichip_serve"):
+        deadline = time.monotonic() + 120.0
+        complete = True
+        while mc.ep_resizes < 2 and time.monotonic() < deadline:
+            rows, sp, _ = mc.readback(
+                mc.dispatch(mc.encode(topics, batch=64)), len(topics))
+            spset = set(sp)
+            complete = complete and all(
+                (sorted(inc.match_host(t)) if k in spset
+                 else sorted(rows[k])) == sorted(inc.match_host(t))
+                for k, t in enumerate(topics))
+        while mc._resize_busy and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert mc.ep_resizes >= 2, "EWMA never triggered the grow"
+    assert mc._cap_class >= 2
+    assert complete, "rows dropped during the compile window"
+    assert mc.failovers == 0            # zero breaker strikes
+    assert mc.ep_capacity(64) > cap0
+    warns = [r for r in caplog.records if "overflow EWMA" in r.message]
+    assert len(warns) >= 2, "grow must re-arm the warn-once latch"
+    # the flip reset the measurement state for the new grid
+    grows = [r for r in caplog.records if "grew to capacity" in r.message]
+    assert len(grows) >= 2
+    # post-grow serve on the wider grid still bit-complete
+    rows, sp, _ = mc.readback(
+        mc.dispatch(mc.encode(topics, batch=64)), len(topics))
+    spset = set(sp)
+    for k, t in enumerate(topics):
+        if k not in spset:
+            assert sorted(rows[k]) == sorted(inc.match_host(t)), t
+    # the last readback may have kicked one more grow: drain it so the
+    # compile thread can't leak CPU into the rest of the suite
+    assert mc.drain_resize(120.0)
+
+
+def test_kernel_cache_grow_compiles_ahead_no_dispatch_parks():
+    """With a kernel cache attached the resize worker compiles the
+    grown grid THROUGH the cache before flipping: a post-flip
+    dispatch with ``block_compile=False`` hits — never a CompileMiss,
+    so no serve dispatch ever parks behind XLA."""
+    import time
+
+    from emqx_tpu.ops.kernel_cache import MatchKernelCache
+
+    kc = MatchKernelCache()
+    inc, mc, _pairs = build_ep_pair(
+        ep_slack=0.5, ep_autotune=True, ep_grow_threshold=0.05,
+        kernel_cache=kc)
+    topics = [f"x/{i}/z" for i in range(64)]
+    enc = mc.encode(topics, batch=64)
+    mc.readback(mc.dispatch(enc, block_compile=True), len(topics))
+    deadline = time.monotonic() + 120.0
+    while mc.ep_resizes < 1 and time.monotonic() < deadline:
+        mc.readback(mc.dispatch(enc, block_compile=True), len(topics))
+    while mc._resize_busy and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mc.ep_resizes >= 1 and mc._cap_class >= 1
+    # the serving contract: the grown-grid step is already cached
+    rows, sp, _ = mc.readback(
+        mc.dispatch(enc, block_compile=False), len(topics))
+    spset = set(sp)
+    for k, t in enumerate(topics):
+        if k not in spset:
+            assert sorted(rows[k]) == sorted(inc.match_host(t)), t
+    assert mc.drain_resize(120.0)
+
+
+def test_plan_rebalance_stages_and_rebuild_applies_with_parity():
+    """The popularity pass STAGES; only the next rebuild applies: the
+    override map is invisible to serving until the repartition swap,
+    then the moved hot roots spread across shards, ``_word_owner``
+    routes to the new owners, and rows stay bit-parity with the host
+    oracle and the replicated backend."""
+    mc = MultichipMatcher(depth=8, ep=True, ep_slack=4.0,
+                          ep_autotune=True, balance_budget=64)
+    hot = _colliding_roots(mc.tp, 4)
+    home = shard_of_filter(f"{hot[0]}/a/+", mc.tp)
+    inc = IncrementalNfa(depth=8)
+    pairs = []
+    for r in hot:
+        for f in (f"{r}/a/+", f"{r}/b/#"):
+            inc.add(f)
+            pairs.append((f, inc.aid_of(f)))
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    assert all(mc.shard_of(f"{r}/a/x") == home for r in hot)
+    topics = [f"{hot[k % 4]}/a/x" for k in range(64)]
+    for _ in range(3):                      # accumulate the load slab
+        mesh_rows(mc, topics)
+    assert mc._root_load.any()
+    moved = mc.plan_rebalance()
+    assert moved >= 1
+    # staged, not applied: serving still routes to the crc32 home
+    assert mc._placement == {} and mc._placement_next
+    assert all(mc.shard_of(f"{r}/a/x") == home for r in hot)
+    rows0, sp0, _ = mesh_rows(mc, topics)
+    assert not sp0
+    # the next rebuild (the compaction-swap cadence) applies the map
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    assert mc._placement and mc._placement_next is None
+    owners = {mc.shard_of(f"{r}/a/x") for r in hot}
+    assert len(owners) > 1, "hot roots must spread after the remap"
+    for r in hot:                            # device routing agrees
+        wid = mc.vocab[r]
+        assert int(mc._word_owner[wid]) == mc.shard_of(f"{r}/a/x")
+    mc_rep = MultichipMatcher(depth=8)
+    mc_rep.rebuild(pairs)
+    assert mc_rep.apply_pending()
+    rows_e, sp_e, _ = mesh_rows(mc, topics)
+    rows_r, sp_r, _ = mesh_rows(mc_rep, topics)
+    assert not sp_e and not sp_r
+    for t, re_, rr, r0 in zip(topics, rows_e, rows_r, rows0):
+        want = sorted(inc.match_host(t))
+        assert sorted(re_) == sorted(rr) == sorted(r0) == want, t
+    assert mc.ep_rebalances == 1 and mc.moved_roots == moved
+
+
+def test_placement_segments_roundtrip_v3_and_skew_rejection(tmp_path):
+    """The override map rides the v3 segment set: a cold start
+    restores placement bit-identical BEFORE the restack (the restored
+    partition and its shard_of agree); a placement tampered after the
+    save fails the per-segment placement_crc guard even with a
+    recomputed manifest checksum (torn-save mixed generations); a v2
+    manifest is rejected outright."""
+    mc = MultichipMatcher(depth=8, ep=True, ep_slack=4.0,
+                          ep_autotune=True, balance_budget=64)
+    hot = _colliding_roots(mc.tp, 4)
+    inc = IncrementalNfa(depth=8)
+    pairs = []
+    for r in hot:
+        for f in (f"{r}/a/+", f"{r}/b/#"):
+            inc.add(f)
+            pairs.append((f, inc.aid_of(f)))
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    topics = [f"{hot[k % 4]}/a/x" for k in range(64)]
+    for _ in range(3):
+        mesh_rows(mc, topics)
+    assert mc.plan_rebalance() >= 1
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    assert mc._placement
+    d = str(tmp_path)
+    mc.save_segments(d, epoch=inc.epoch)
+    want, _, _ = mesh_rows(mc, topics)
+
+    mc2 = MultichipMatcher(depth=8, ep=True, ep_slack=4.0,
+                           ep_autotune=True)
+    assert mc2.load_segments(d, expect_epoch=inc.epoch)
+    assert mc2._placement == mc._placement
+    assert mc2.apply_pending()
+    assert all(mc2.shard_of(f"{r}/a/x") == mc.shard_of(f"{r}/a/x")
+               for r in hot)
+    got, sp, _ = mesh_rows(mc2, topics)
+    assert not sp
+    assert [sorted(r) for r in got] == [sorted(r) for r in want]
+
+    # tamper the persisted owners + recompute the manifest checksum:
+    # the per-segment placement_crc (cut under the ORIGINAL map) must
+    # reject the mixed generation
+    mpath = os.path.join(d, "multichip", "aid_maps.npz")
+    maps = dict(np.load(mpath))
+    assert len(maps["ps"]), "round trip must persist real overrides"
+    ps = np.asarray(maps["ps"], np.int32)
+    ps[0] = (ps[0] + 1) % mc.tp
+    maps["ps"] = ps
+    np.savez(mpath, **maps)
+    manp = os.path.join(d, "multichip", "manifest.json")
+    with open(manp) as f:
+        meta = json.load(f)
+    core = {k: meta[k] for k in
+            ("version", "epoch", "tp", "depth", "native")}
+    meta["checksum"] = MultichipMatcher._manifest_checksum(core, maps)
+    with open(manp, "w") as f:
+        json.dump(meta, f, sort_keys=True)
+    mc3 = MultichipMatcher(depth=8, ep=True, ep_autotune=True)
+    assert not mc3.load_segments(d, expect_epoch=inc.epoch)
+
+    # a v2 manifest (pre-placement format) is rejected by version
+    meta["version"] = 2
+    with open(manp, "w") as f:
+        json.dump(meta, f, sort_keys=True)
+    mc4 = MultichipMatcher(depth=8, ep=True, ep_autotune=True)
+    assert not mc4.load_segments(d, expect_epoch=inc.epoch)
+
+
+def test_rebalance_defers_while_degraded_then_readmit_post_remap():
+    """Rebalance racing the degraded mesh: while ANY shard is dead the
+    balance pass stages NOTHING (roots never remap onto a dead owner);
+    after re-admission the pass stages and applies, and a shard killed
+    POST-remap rebuilds + canaries against the remapped placement (the
+    canary judges the placement the rebuild was built against)."""
+    mc = MultichipMatcher(depth=8, ep=True, ep_slack=4.0,
+                          ep_autotune=True, balance_budget=64,
+                          degraded=True)
+    hot = _colliding_roots(mc.tp, 4)
+    home = shard_of_filter(f"{hot[0]}/a/+", mc.tp)
+    inc = IncrementalNfa(depth=8)
+    pairs = []
+    for r in hot:
+        for f in (f"{r}/a/+", f"{r}/b/#"):
+            inc.add(f)
+            pairs.append((f, inc.aid_of(f)))
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    topics = [f"{hot[k % 4]}/a/x" for k in range(64)]
+    for _ in range(3):
+        mesh_rows(mc, topics)
+    # dead shard: the pass defers outright
+    mc.kill_shard(home)
+    assert mc.plan_rebalance() == 0
+    assert mc._placement_next is None and mc.ep_rebalances == 0
+    rows_d, sp_d, _ = mesh_rows(mc, topics)   # scoped failover serves
+    spset = set(sp_d)
+    assert spset, "hot rows owned by the dead shard must divert"
+    for k, t in enumerate(topics):
+        if k not in spset:
+            assert sorted(rows_d[k]) == sorted(inc.match_host(t)), t
+    # readmit, then the pass stages and the rebuild applies
+    assert mc.rebuild_shard(home, pairs) >= 0.0
+    mc.revive_shard(home)
+    assert mc.plan_rebalance() >= 1
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    moved = [r for r in hot
+             if mc.shard_of(f"{r}/a/x") != home]
+    assert moved, "the remap must have moved a hot root off home"
+    # post-remap kill of a MOVED root's new owner: the online rebuild
+    # partitions by the live (overridden) placement and the canary
+    # proves parity against exactly that placement
+    t2 = mc.shard_of(f"{moved[0]}/a/x")
+    mc.kill_shard(t2)
+    assert mc.plan_rebalance() == 0           # still defers while dead
+    assert mc.rebuild_shard(t2, pairs) >= 0.0
+    ctop = mc.canary_topics(t2)
+    assert any(c.startswith(f"{moved[0]}/") for c in ctop)
+    crows, csp = mc.canary_rows(ctop, 64, t2)
+    csps = set(csp)
+    for i, topic in enumerate(ctop):
+        if i not in csps:
+            assert sorted(crows[i]) == sorted(inc.match_host(topic)), \
+                topic
+    mc.revive_shard(t2)
+    rows_p, sp_p, _ = mesh_rows(mc, topics)
+    assert not sp_p
+    for t, r in zip(topics, rows_p):
+        assert sorted(r) == sorted(inc.match_host(t)), t
+
+
+def test_ep_rebalance_fault_injection_noop():
+    """An injected ``ep.rebalance`` fault raises BEFORE anything is
+    staged (kill mid-rebalance = no-op): placement unchanged, nothing
+    pending, and the next un-faulted pass stages normally."""
+    mc = MultichipMatcher(depth=8, ep=True, ep_slack=4.0,
+                          ep_autotune=True, balance_budget=64)
+    hot = _colliding_roots(mc.tp, 4)
+    inc = IncrementalNfa(depth=8)
+    pairs = []
+    for r in hot:
+        inc.add(f"{r}/a/+")
+        pairs.append((f"{r}/a/+", inc.aid_of(f"{r}/a/+")))
+    mc.rebuild(pairs)
+    assert mc.apply_pending()
+    topics = [f"{hot[k % 4]}/a/x" for k in range(64)]
+    for _ in range(3):
+        mesh_rows(mc, topics)
+    faultinject.install(FaultInjector([
+        {"point": "ep.rebalance", "action": "raise", "times": 1},
+    ]))
+    try:
+        with pytest.raises(faultinject.InjectedFault):
+            mc.plan_rebalance()
+        assert mc._placement == {} and mc._placement_next is None
+        assert mc.ep_rebalances == 0
+        rows, sp, _ = mesh_rows(mc, topics)   # delivery holds
+        assert not sp
+        for t, r in zip(topics, rows):
+            assert sorted(r) == sorted(inc.match_host(t)), t
+        assert mc.plan_rebalance() >= 1       # un-faulted: stages
+        assert mc._placement_next
+    finally:
+        faultinject.uninstall()
